@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Mirrors the reference's envtest trick (SURVEY.md §4): run everything on
+CPU with a virtual 8-device platform so mesh/sharding code is exercised
+without TPU hardware.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

@@ -1,0 +1,191 @@
+"""API type tests (reference test model: api/v1alpha1/healthcheck_types_unit_test.go)."""
+
+import datetime
+
+import pytest
+import yaml
+
+from activemonitor_tpu.api import (
+    HealthCheck,
+    HealthCheckSpec,
+    HealthCheckStatus,
+    RemedyWorkflow,
+    ResourceObject,
+    Workflow,
+)
+
+REFERENCE_STYLE_YAML = """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata:
+  name: inline-hello
+  namespace: health
+spec:
+  schedule:
+    cron: "@every 1m"
+  level: cluster
+  workflow:
+    generateName: inline-hello-
+    resource:
+      namespace: health
+      serviceAccount: activemonitor-controller-sa
+      source:
+        inline: |
+          apiVersion: argoproj.io/v1alpha1
+          kind: Workflow
+          spec:
+            entrypoint: whalesay
+"""
+
+REMEDY_YAML = """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata:
+  generateName: fail-healthcheck-
+  namespace: health
+spec:
+  repeatAfterSec: 30
+  level: cluster
+  remedyRunsLimit: 2
+  remedyResetInterval: 300
+  workflow:
+    generateName: randomfail-workflow-
+    workflowtimeout: 20
+    resource:
+      namespace: health
+      serviceAccount: activemonitor-controller-sa
+      source:
+        inline: "apiVersion: argoproj.io/v1alpha1"
+  remedyworkflow:
+    generateName: remedy-test-
+    resource:
+      namespace: health
+      serviceAccount: activemonitor-remedy-sa
+      source:
+        inline: "apiVersion: argoproj.io/v1alpha1"
+"""
+
+
+def test_loads_reference_yaml_unchanged():
+    hc = HealthCheck.from_yaml(REFERENCE_STYLE_YAML)
+    assert hc.name == "inline-hello"
+    assert hc.namespace == "health"
+    assert hc.key == "health/inline-hello"
+    assert hc.spec.schedule.cron == "@every 1m"
+    assert hc.spec.level == "cluster"
+    assert hc.spec.workflow.generate_name == "inline-hello-"
+    assert hc.spec.workflow.resource.service_account == "activemonitor-controller-sa"
+    assert "entrypoint: whalesay" in hc.spec.workflow.resource.source.inline
+    assert hc.spec.remedy_workflow.is_empty()
+
+
+def test_loads_remedy_yaml_with_gates():
+    hc = HealthCheck.from_yaml(REMEDY_YAML)
+    assert hc.spec.repeat_after_sec == 30
+    assert hc.spec.remedy_runs_limit == 2
+    assert hc.spec.remedy_reset_interval == 300
+    assert hc.spec.workflow.timeout == 20  # json tag "workflowtimeout"
+    assert not hc.spec.remedy_workflow.is_empty()
+    assert hc.spec.remedy_workflow.resource.service_account == "activemonitor-remedy-sa"
+
+
+def test_remedy_is_empty_semantics():
+    # reference: healthcheck_types.go:104-106 (reflect.DeepEqual with zero value)
+    assert RemedyWorkflow().is_empty()
+    assert not RemedyWorkflow(generate_name="x-").is_empty()
+    assert not RemedyWorkflow(resource=ResourceObject(namespace="health")).is_empty()
+
+
+def test_round_trip_uses_json_aliases():
+    hc = HealthCheck.from_yaml(REMEDY_YAML)
+    d = hc.to_dict()
+    assert d["spec"]["repeatAfterSec"] == 30
+    assert d["spec"]["remedyRunsLimit"] == 2
+    assert "remedyworkflow" in d["spec"]
+    assert d["spec"]["workflow"]["generateName"] == "randomfail-workflow-"
+    # round trip must be lossless
+    assert HealthCheck.from_dict(d) == hc
+
+
+def test_status_remedy_started_at_serializes_as_remedyTriggeredAt():
+    # parity quirk: json tag is remedyTriggeredAt (healthcheck_types.go:53)
+    st = HealthCheckStatus(
+        remedy_started_at=datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+    )
+    assert "remedyTriggeredAt" in st.to_json_dict()
+
+
+def test_status_reset_remedy():
+    st = HealthCheckStatus(
+        remedy_total_runs=3,
+        remedy_success_count=2,
+        remedy_failed_count=1,
+        remedy_started_at=datetime.datetime.now(datetime.timezone.utc),
+        remedy_finished_at=datetime.datetime.now(datetime.timezone.utc),
+        remedy_last_failed_at=datetime.datetime.now(datetime.timezone.utc),
+    )
+    st.reset_remedy("HealthCheck Passed so Remedy is reset")
+    assert st.remedy_total_runs == 0
+    assert st.remedy_success_count == 0
+    assert st.remedy_failed_count == 0
+    assert st.remedy_started_at is None
+    assert st.remedy_finished_at is None
+    assert st.remedy_last_failed_at is None
+    assert st.remedy_status == "HealthCheck Passed so Remedy is reset"
+
+
+def test_verify_cert_default_is_none():
+    from activemonitor_tpu.api import URLArtifact
+
+    u = URLArtifact(path="https://example.com/wf.yaml")
+    assert u.verify_cert is None  # secure default handled by the reader
+
+
+def test_printer_row_matches_reference_columns():
+    hc = HealthCheck.from_yaml(REFERENCE_STYLE_YAML)
+    hc.status.status = "Succeeded"
+    hc.status.success_count = 7
+    row = hc.printer_row()
+    assert row["LATEST STATUS"] == "Succeeded"
+    assert row["SUCCESS CNT"] == 7
+    assert set(row) == {
+        "NAME",
+        "LATEST STATUS",
+        "SUCCESS CNT",
+        "FAIL CNT",
+        "REMEDY SUCCESS CNT",
+        "REMEDY FAIL CNT",
+        "AGE",
+    }
+
+
+def test_deepcopy_is_independent():
+    hc = HealthCheck.from_yaml(REMEDY_YAML)
+    cp = hc.deepcopy()
+    cp.status.success_count = 99
+    cp.spec.workflow.resource.namespace = "other"
+    assert hc.status.success_count == 0
+    assert hc.spec.workflow.resource.namespace == "health"
+
+
+def test_every_reference_example_parses():
+    """All 12+ reference example HealthChecks must load unchanged."""
+    import glob
+    import os
+
+    ref_examples = glob.glob("/root/reference/examples/**/*.yaml", recursive=True)
+    if not ref_examples:
+        pytest.skip("reference examples not mounted")
+    loaded = 0
+    for path in ref_examples:
+        with open(path) as f:
+            try:
+                doc = yaml.safe_load(f)
+            except yaml.YAMLError:
+                continue
+        if not isinstance(doc, dict) or doc.get("kind") != "HealthCheck":
+            continue
+        hc = HealthCheck.from_dict(doc)
+        assert hc.spec.workflow is not None
+        loaded += 1
+    assert loaded >= 10

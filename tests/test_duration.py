@@ -1,0 +1,26 @@
+import pytest
+
+from activemonitor_tpu.utils import parse_go_duration
+
+
+@pytest.mark.parametrize(
+    "text,seconds",
+    [
+        ("1m", 60.0),
+        ("3s", 3.0),
+        ("1m30s", 90.0),
+        ("1.5h", 5400.0),
+        ("2h45m", 9900.0),
+        ("300ms", 0.3),
+        ("0", 0.0),
+        ("-10s", -10.0),
+    ],
+)
+def test_parse_valid(text, seconds):
+    assert parse_go_duration(text) == pytest.approx(seconds)
+
+
+@pytest.mark.parametrize("text", ["", "abc", "10", "1d", "s", "1m 30s"])
+def test_parse_invalid(text):
+    with pytest.raises(ValueError):
+        parse_go_duration(text)

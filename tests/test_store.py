@@ -1,0 +1,154 @@
+"""Artifact store tests (reference test model: internal/store/store_test.go:
+httptest servers as fake endpoints, TLS-verify secure default, 404/network errors)."""
+
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from activemonitor_tpu.api import ArtifactLocation, FileArtifact, URLArtifact
+from activemonitor_tpu.store import (
+    FileReader,
+    InlineReader,
+    URLReader,
+    UnknownArtifactLocation,
+    get_artifact_reader,
+)
+
+WF = b"apiVersion: argoproj.io/v1alpha1\nkind: Workflow\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/wf.yaml":
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(WF)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def http_server():
+    srv = HTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_inline_reader():
+    assert InlineReader("hello: world").read() == b"hello: world"
+
+
+def test_inline_reader_empty_rejected():
+    with pytest.raises(ValueError):
+        InlineReader("")
+
+
+def test_dispatch_inline_first():
+    loc = ArtifactLocation(inline="a: b", url=URLArtifact(path="http://x/"))
+    assert isinstance(get_artifact_reader(loc), InlineReader)
+
+
+def test_dispatch_unknown_location():
+    with pytest.raises(UnknownArtifactLocation):
+        get_artifact_reader(ArtifactLocation())
+
+
+def test_url_reader_reads(http_server):
+    r = URLReader(URLArtifact(path=f"{http_server}/wf.yaml"))
+    assert r.read() == WF
+
+
+def test_url_reader_404(http_server):
+    r = URLReader(URLArtifact(path=f"{http_server}/missing.yaml"))
+    with pytest.raises(IOError):
+        r.read()
+
+
+def test_url_reader_network_error():
+    r = URLReader(URLArtifact(path="http://127.0.0.1:1/wf.yaml"))
+    with pytest.raises(Exception):
+        r.read()
+
+
+def test_url_verify_cert_nil_defaults_to_verify(tmp_path):
+    """Secure default (reference: store_test.go
+    TestURLReader_VerifyCert_Nil_DefaultsToVerify, url.go:29-32):
+    a self-signed TLS server must be REJECTED when verifyCert is omitted
+    and accepted when verifyCert: false."""
+    import datetime
+
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        pytest.skip("cryptography not available to mint a self-signed cert")
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .sign(key, hashes.SHA256())
+    )
+    certfile = tmp_path / "cert.pem"
+    keyfile = tmp_path / "key.pem"
+    certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    keyfile.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+
+    srv = HTTPServer(("127.0.0.1", 0), _Handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=str(certfile), keyfile=str(keyfile))
+    srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"https://127.0.0.1:{srv.server_port}/wf.yaml"
+        # nil -> verify -> self-signed must fail
+        with pytest.raises(Exception):
+            URLReader(URLArtifact(path=url)).read()
+        # explicit false -> skip verification -> succeeds
+        r = URLReader(URLArtifact(path=url, verify_cert=False))
+        assert r.read() == WF
+    finally:
+        srv.shutdown()
+
+
+def test_file_reader(tmp_path):
+    p = tmp_path / "wf.yaml"
+    p.write_bytes(WF)
+    r = get_artifact_reader(ArtifactLocation(file=FileArtifact(path=str(p))))
+    assert isinstance(r, FileReader)
+    assert r.read() == WF
+
+
+def test_file_reader_missing_file(tmp_path):
+    r = FileReader(FileArtifact(path=str(tmp_path / "nope.yaml")))
+    with pytest.raises(FileNotFoundError):
+        r.read()
+
+
+def test_file_reader_empty_path_rejected():
+    with pytest.raises(ValueError):
+        FileReader(FileArtifact(path=""))
