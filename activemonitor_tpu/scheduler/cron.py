@@ -1,0 +1,227 @@
+"""Cron expression parsing and next-fire computation.
+
+Grammar-compatible with the robfig/cron "standard" parser the reference
+uses (reference: healthcheck_controller.go:34,253 cron.ParseStandard;
+spec docs linked from healthcheck_types.go:149):
+
+- five fields: minute hour day-of-month month day-of-week
+- ``*`` and ``?`` wildcards, lists ``a,b,c``, ranges ``a-b``, steps
+  ``*/n``, ``a-b/n``, ``a/n`` (a to max by n)
+- month and weekday names (``JAN``-``DEC``, ``SUN``-``SAT``), 7 ≡ Sunday
+- descriptors ``@yearly``/``@annually``, ``@monthly``, ``@weekly``,
+  ``@daily``/``@midnight``, ``@hourly``
+- ``@every <duration>`` with Go duration syntax
+
+Standard-cron quirk preserved: when **both** day-of-month and
+day-of-week are restricted, a time matches if **either** matches.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import FrozenSet
+
+from activemonitor_tpu.utils.duration import parse_go_duration
+
+_MONTH_NAMES = {
+    name: i + 1
+    for i, name in enumerate(
+        ["JAN", "FEB", "MAR", "APR", "MAY", "JUN", "JUL", "AUG", "SEP", "OCT", "NOV", "DEC"]
+    )
+}
+_DOW_NAMES = {
+    name: i for i, name in enumerate(["SUN", "MON", "TUE", "WED", "THU", "FRI", "SAT"])
+}
+
+_DESCRIPTORS = {
+    "@yearly": "0 0 1 1 *",
+    "@annually": "0 0 1 1 *",
+    "@monthly": "0 0 1 * *",
+    "@weekly": "0 0 * * 0",
+    "@daily": "0 0 * * *",
+    "@midnight": "0 0 * * *",
+    "@hourly": "0 * * * *",
+}
+
+# Search horizon for next(): far beyond the longest gap any valid
+# expression can produce (Feb 29 recurs within 8 years).
+_MAX_YEARS_AHEAD = 9
+
+
+class CronParseError(ValueError):
+    """The expression is not valid standard cron."""
+
+
+@dataclass(frozen=True)
+class EverySchedule:
+    """Constant-delay schedule from ``@every <duration>``."""
+
+    interval_seconds: float
+
+    def next(self, after: datetime.datetime) -> datetime.datetime:
+        # robfig's ConstantDelaySchedule truncates the delay to a whole
+        # second (min 1 s) and fires at t + delay truncated to the second.
+        delay = max(1.0, float(int(self.interval_seconds)))
+        fired = after + datetime.timedelta(seconds=delay)
+        return fired.replace(microsecond=0)
+
+
+@dataclass(frozen=True)
+class CronSchedule:
+    """Field-set schedule for standard five-field expressions."""
+
+    minutes: FrozenSet[int]
+    hours: FrozenSet[int]
+    days_of_month: FrozenSet[int]
+    months: FrozenSet[int]
+    days_of_week: FrozenSet[int]
+    dom_star: bool
+    dow_star: bool
+
+    def _day_matches(self, t: datetime.datetime) -> bool:
+        dom_ok = t.day in self.days_of_month
+        # Python weekday(): Monday=0; cron: Sunday=0.
+        dow_ok = (t.weekday() + 1) % 7 in self.days_of_week
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok  # both restricted: OR (standard cron)
+
+    def next(self, after: datetime.datetime) -> datetime.datetime:
+        # Next minute boundary strictly after `after`.
+        t = after.replace(second=0, microsecond=0) + datetime.timedelta(minutes=1)
+        limit = after.replace(
+            year=after.year + _MAX_YEARS_AHEAD, month=1, day=1,
+            hour=0, minute=0, second=0, microsecond=0,
+        )
+        while t < limit:
+            if t.month not in self.months:
+                # jump to first instant of the next month
+                if t.month == 12:
+                    t = t.replace(year=t.year + 1, month=1, day=1, hour=0, minute=0)
+                else:
+                    t = t.replace(month=t.month + 1, day=1, hour=0, minute=0)
+                continue
+            if not self._day_matches(t):
+                t = (t + datetime.timedelta(days=1)).replace(hour=0, minute=0)
+                continue
+            if t.hour not in self.hours:
+                t = (t + datetime.timedelta(hours=1)).replace(minute=0)
+                continue
+            if t.minute not in self.minutes:
+                t = t + datetime.timedelta(minutes=1)
+                continue
+            return t
+        raise CronParseError("expression never fires within the search horizon")
+
+
+def _parse_value(token: str, names: dict, lo: int, hi: int, what: str) -> int:
+    token = token.strip()
+    if token.upper() in names:
+        return names[token.upper()]
+    try:
+        v = int(token)
+    except ValueError:
+        raise CronParseError(f"invalid {what} value {token!r}")
+    return v
+
+
+def _parse_field(field: str, lo: int, hi: int, names: dict, what: str) -> FrozenSet[int]:
+    values: set[int] = set()
+    for part in field.split(","):
+        part = part.strip()
+        if not part:
+            raise CronParseError(f"empty {what} list item in {field!r}")
+        step = 1
+        if "/" in part:
+            rng, _, step_s = part.partition("/")
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronParseError(f"invalid step {step_s!r} in {what}")
+            if step <= 0:
+                raise CronParseError(f"step must be positive in {what}")
+        else:
+            rng = part
+        if rng in ("*", "?"):
+            start, end = lo, hi
+        elif "-" in rng:
+            a, _, b = rng.partition("-")
+            start = _parse_value(a, names, lo, hi, what)
+            end = _parse_value(b, names, lo, hi, what)
+        else:
+            start = _parse_value(rng, names, lo, hi, what)
+            # "a/n" means a..max by n (robfig semantics); bare "a" is a singleton
+            end = hi if "/" in part else start
+        if start < lo or end > hi or start > end:
+            raise CronParseError(
+                f"{what} value out of range [{lo},{hi}]: {part!r}"
+            )
+        values.update(range(start, end + 1, step))
+    if not values:
+        raise CronParseError(f"empty {what} field")
+    return frozenset(values)
+
+
+def parse_cron(expr: str):
+    """Parse a cron expression; returns an object with ``.next(after)``."""
+    expr = expr.strip()
+    if not expr:
+        raise CronParseError("empty cron expression")
+    if expr in _DESCRIPTORS:
+        expr = _DESCRIPTORS[expr]
+    elif expr.startswith("@every "):
+        try:
+            seconds = parse_go_duration(expr[len("@every "):])
+        except ValueError as e:
+            raise CronParseError(str(e))
+        if seconds <= 0:
+            raise CronParseError(f"@every duration must be positive: {expr!r}")
+        return EverySchedule(seconds)
+    elif expr.startswith("@"):
+        raise CronParseError(f"unrecognized descriptor {expr!r}")
+
+    fields = expr.split()
+    if len(fields) != 5:
+        raise CronParseError(
+            f"expected 5 fields, got {len(fields)} in {expr!r}"
+        )
+    minutes = _parse_field(fields[0], 0, 59, {}, "minute")
+    hours = _parse_field(fields[1], 0, 23, {}, "hour")
+    dom = _parse_field(fields[2], 1, 31, {}, "day-of-month")
+    months = _parse_field(fields[3], 1, 12, _MONTH_NAMES, "month")
+    # bounds 0-7: 7 is accepted and folded onto Sunday (0) below
+    dow = _parse_field(fields[4], 0, 7, _DOW_NAMES, "day-of-week")
+    dow = frozenset(0 if v == 7 else v for v in dow)
+    return CronSchedule(
+        minutes=minutes,
+        hours=hours,
+        days_of_month=dom,
+        months=months,
+        days_of_week=dow,
+        dom_star=_has_star(fields[2]),
+        dow_star=_has_star(fields[4]),
+    )
+
+
+def _has_star(field: str) -> bool:
+    """robfig sets a field's star bit when any list item's range portion
+    is a wildcard — including step-on-wildcard forms like ``*/2``."""
+    return any(
+        part.strip().partition("/")[0].strip() in ("*", "?")
+        for part in field.split(",")
+    )
+
+
+def seconds_until_next(expr: str, now: datetime.datetime) -> int:
+    """Delta to the next cron fire, as the reference computes it
+    (reference: healthcheck_controller.go:259-262 — int truncation of the
+    sub-second remainder loses up to a second, so +1s keeps the fire
+    time at-or-after the schedule point)."""
+    schedule = parse_cron(expr)
+    delta = (schedule.next(now) - now).total_seconds()
+    return int(delta) + 1

@@ -1,0 +1,84 @@
+"""Backoff tests (reference test model:
+healthcheck_controller_unit_test.go:679-753 backoff param matrix)."""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.scheduler import InverseExpBackoff, compute_backoff_params
+from activemonitor_tpu.utils.clock import FakeClock
+
+
+class TestComputeBackoffParams:
+    def test_defaults_from_timeout(self):
+        p = compute_backoff_params(workflow_timeout=600)
+        assert p.max_delay == 300.0  # timeout/2
+        assert p.min_delay == 10.0  # timeout/60
+        assert p.factor == 0.5
+        assert p.timeout == 600.0
+
+    def test_small_timeout_clamps_to_one_second(self):
+        p = compute_backoff_params(workflow_timeout=1)
+        assert p.max_delay == 1.0
+        assert p.min_delay == 1.0
+
+    def test_zero_timeout_clamps(self):
+        p = compute_backoff_params(workflow_timeout=0)
+        assert p.max_delay == 1.0
+        assert p.min_delay == 1.0
+        assert p.timeout == 0.0
+
+    def test_explicit_overrides(self):
+        p = compute_backoff_params(
+            workflow_timeout=60, backoff_max=2, backoff_min=1, backoff_factor="0.1"
+        )
+        assert p.max_delay == 2.0
+        assert p.min_delay == 1.0
+        assert p.factor == 0.1
+
+    def test_bad_factor_falls_back(self):
+        # reference: healthcheck_controller.go:595-601 logs and keeps 0.5
+        p = compute_backoff_params(workflow_timeout=60, backoff_factor="not-a-float")
+        assert p.factor == 0.5
+
+
+@pytest.mark.asyncio
+async def test_delays_decrease_to_min():
+    clock = FakeClock()
+    p = compute_backoff_params(workflow_timeout=120)  # max 60, min 2
+    ieb = InverseExpBackoff(p, clock)
+    seen = []
+
+    async def driver():
+        for _ in range(7):
+            seen.append(ieb.current_delay)
+            ok = await ieb.next()
+            assert ok
+
+    task = asyncio.create_task(driver())
+    await clock.advance(60 + 30 + 15 + 7.5 + 3.75 + 2 + 2 + 1)
+    await task
+    assert seen == [60.0, 30.0, 15.0, 7.5, 3.75, 2.0, 2.0]
+
+
+@pytest.mark.asyncio
+async def test_timeout_returns_false_without_sleeping():
+    clock = FakeClock()
+    p = compute_backoff_params(workflow_timeout=10)  # max 5, min 1, timeout 10
+    ieb = InverseExpBackoff(p, clock)
+    results = []
+
+    async def driver():
+        while True:
+            ok = await ieb.next()
+            results.append(ok)
+            if not ok:
+                return
+
+    task = asyncio.create_task(driver())
+    await clock.advance(30)
+    await task
+    # 5 + 2.5 + 1.25 + 1 = 9.75 < 10; next wait crosses the deadline
+    assert results[-1] is False
+    assert all(results[:-1])
+    assert clock.monotonic() >= 10.0

@@ -1,0 +1,116 @@
+"""Cron parser tests (reference test model:
+healthcheck_controller_unit_test.go:617-660 cron parse cases)."""
+
+import datetime
+
+import pytest
+
+from activemonitor_tpu.scheduler import (
+    CronParseError,
+    EverySchedule,
+    parse_cron,
+    seconds_until_next,
+)
+
+UTC = datetime.timezone.utc
+
+
+def dt(*args):
+    return datetime.datetime(*args, tzinfo=UTC)
+
+
+def test_every_minute():
+    s = parse_cron("* * * * *")
+    assert s.next(dt(2026, 7, 28, 10, 0, 30)) == dt(2026, 7, 28, 10, 1)
+    assert s.next(dt(2026, 7, 28, 10, 0, 0)) == dt(2026, 7, 28, 10, 1)
+
+
+def test_specific_minute_hour():
+    s = parse_cron("30 14 * * *")
+    assert s.next(dt(2026, 7, 28, 10, 0)) == dt(2026, 7, 28, 14, 30)
+    assert s.next(dt(2026, 7, 28, 15, 0)) == dt(2026, 7, 29, 14, 30)
+
+
+def test_step_and_range():
+    s = parse_cron("*/15 9-17 * * *")
+    assert s.next(dt(2026, 7, 28, 9, 16)) == dt(2026, 7, 28, 9, 30)
+    assert s.next(dt(2026, 7, 28, 17, 46)) == dt(2026, 7, 29, 9, 0)
+
+
+def test_list_and_names():
+    s = parse_cron("0 12 * JAN,JUL MON-FRI")
+    # 2026-07-28 is a Tuesday
+    assert s.next(dt(2026, 7, 28, 13, 0)) == dt(2026, 7, 29, 12, 0)
+    # from late December, jumps into January
+    assert s.next(dt(2026, 12, 31, 13, 0)) == dt(2027, 1, 1, 12, 0)
+
+
+def test_dow_seven_is_sunday():
+    a = parse_cron("0 0 * * 0")
+    b = parse_cron("0 0 * * 7")
+    t = dt(2026, 7, 28)
+    assert a.next(t) == b.next(t)
+    # 2026-08-02 is a Sunday
+    assert a.next(t) == dt(2026, 8, 2)
+
+
+def test_dom_dow_or_semantics():
+    # standard cron: both restricted -> either matches
+    s = parse_cron("0 0 15 * MON")
+    # from the 10th (Fri Jul 10 2026? -> check): next is the first Monday or the 15th
+    nxt = s.next(dt(2026, 7, 10))
+    assert nxt == dt(2026, 7, 13)  # Monday Jul 13 comes before Wed Jul 15
+    nxt2 = s.next(nxt)
+    assert nxt2 == dt(2026, 7, 15)
+
+
+def test_step_on_wildcard_keeps_star_bit():
+    # robfig sets the star bit for '*/2'-style fields: dow stays a
+    # wildcard for the dom-OR-dow rule, so this fires only on the 15th.
+    s = parse_cron("0 0 15 * */2")
+    assert s.next(dt(2026, 7, 1)) == dt(2026, 7, 15)
+
+
+def test_every_fractional_seconds_truncate():
+    s = parse_cron("@every 1.5s")
+    assert s.next(dt(2026, 1, 1)) == dt(2026, 1, 1, 0, 0, 1)
+
+
+def test_descriptors():
+    assert parse_cron("@hourly").next(dt(2026, 7, 28, 10, 30)) == dt(2026, 7, 28, 11, 0)
+    assert parse_cron("@daily").next(dt(2026, 7, 28, 10, 30)) == dt(2026, 7, 29, 0, 0)
+    assert parse_cron("@weekly").next(dt(2026, 7, 28, 10, 30)) == dt(2026, 8, 2, 0, 0)
+    assert parse_cron("@monthly").next(dt(2026, 7, 28)) == dt(2026, 8, 1)
+    assert parse_cron("@yearly").next(dt(2026, 7, 28)) == dt(2027, 1, 1)
+
+
+def test_every_duration():
+    s = parse_cron("@every 1m")
+    assert isinstance(s, EverySchedule)
+    assert s.next(dt(2026, 7, 28, 10, 0, 30)) == dt(2026, 7, 28, 10, 1, 30)
+    s3 = parse_cron("@every 3s")  # examples/bdd/inlineCustomBackoffTest.yaml
+    assert s3.next(dt(2026, 7, 28, 10, 0, 0)) == dt(2026, 7, 28, 10, 0, 3)
+
+
+def test_feb29():
+    s = parse_cron("0 0 29 2 *")
+    assert s.next(dt(2026, 1, 1)) == dt(2028, 2, 29)
+
+
+@pytest.mark.parametrize(
+    "expr",
+    ["", "bogus", "* * * *", "* * * * * *", "61 * * * *", "* 25 * * *",
+     "*/0 * * * *", "@every", "@every nope", "@every -3s", "@fortnightly",
+     "5-1 * * * *", "a,b * * * *"],
+)
+def test_invalid_expressions(expr):
+    with pytest.raises(CronParseError):
+        parse_cron(expr)
+
+
+def test_seconds_until_next_adds_rounding_second():
+    # reference: healthcheck_controller.go:259-262
+    now = dt(2026, 7, 28, 10, 0, 30)
+    # next fire 10:01:00 -> delta 30s -> int(30)+1
+    assert seconds_until_next("* * * * *", now) == 31
+    assert seconds_until_next("@every 1m", now) == 61

@@ -1,0 +1,109 @@
+"""Timer wheel tests (reference behavior: healthcheck_controller.go:745-754
+reschedule, :180-184 cancel-on-delete, :264-267 exists-for-dedupe)."""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.scheduler import TimerWheel
+from activemonitor_tpu.utils.clock import FakeClock
+
+
+@pytest.mark.asyncio
+async def test_fires_after_delay():
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+    fired = []
+
+    async def cb():
+        fired.append(clock.monotonic())
+
+    wheel.schedule("hc-a", 30, cb)
+    await clock.advance(29)
+    assert fired == []
+    await clock.advance(2)
+    assert fired == [30.0]
+
+
+@pytest.mark.asyncio
+async def test_reschedule_replaces_pending_timer():
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+    fired = []
+
+    async def mk(tag):
+        async def cb():
+            fired.append(tag)
+        return cb
+
+    wheel.schedule("hc-a", 30, await mk("first"))
+    await clock.advance(10)
+    wheel.schedule("hc-a", 30, await mk("second"))
+    await clock.advance(100)
+    assert fired == ["second"]
+
+
+@pytest.mark.asyncio
+async def test_stop_cancels_pending():
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+    fired = []
+
+    async def cb():
+        fired.append(1)
+
+    wheel.schedule("hc-a", 30, cb)
+    assert wheel.pending("hc-a")
+    assert wheel.stop("hc-a") is True
+    await clock.advance(100)
+    assert fired == []
+    assert not wheel.exists("hc-a")
+
+
+@pytest.mark.asyncio
+async def test_exists_after_firing_for_dedupe():
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+
+    async def cb():
+        pass
+
+    wheel.schedule("hc-a", 1, cb)
+    await clock.advance(5)
+    assert wheel.exists("hc-a")  # fired entries remain (dedupe contract)
+    assert not wheel.pending("hc-a")
+    assert wheel.stop("hc-a") is False  # nothing pending to cancel
+
+
+@pytest.mark.asyncio
+async def test_callback_exception_does_not_kill_wheel(caplog):
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+
+    async def boom():
+        raise RuntimeError("probe exploded")
+
+    async def ok():
+        fired.append(1)
+
+    fired = []
+    wheel.schedule("hc-bad", 1, boom)
+    wheel.schedule("hc-good", 2, ok)
+    await clock.advance(5)
+    assert fired == [1]
+
+
+@pytest.mark.asyncio
+async def test_shutdown_cancels_everything():
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+    fired = []
+
+    async def cb():
+        fired.append(1)
+
+    for i in range(5):
+        wheel.schedule(f"hc-{i}", 10, cb)
+    await wheel.shutdown()
+    await clock.advance(100)
+    assert fired == []
