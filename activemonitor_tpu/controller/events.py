@@ -1,0 +1,59 @@
+"""Event recording.
+
+The reference emits a Kubernetes Event on every significant transition
+(~40 call sites; reference: healthcheck_controller.go:135 recorder,
+SURVEY.md §5.5). Here events always land in structured logs and an
+in-memory ring (queryable by tests and the CLI); a Kubernetes-backed
+recorder can wrap this one in cluster mode.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import logging
+from dataclasses import dataclass, field
+from typing import Deque, List
+
+from activemonitor_tpu.api.types import HealthCheck
+
+log = logging.getLogger("activemonitor.events")
+
+EVENT_NORMAL = "Normal"
+EVENT_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    type: str
+    reason: str
+    message: str
+    namespace: str
+    name: str
+    timestamp: datetime.datetime = field(
+        default_factory=lambda: datetime.datetime.now(datetime.timezone.utc)
+    )
+
+
+class EventRecorder:
+    def __init__(self, capacity: int = 1000):
+        self._events: Deque[Event] = collections.deque(maxlen=capacity)
+
+    def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        ev = Event(
+            type=type_,
+            reason=reason,
+            message=message,
+            namespace=hc.metadata.namespace,
+            name=hc.metadata.name,
+        )
+        self._events.append(ev)
+        level = logging.WARNING if type_ == EVENT_WARNING else logging.INFO
+        log.log(level, "%s/%s: %s: %s", ev.namespace, ev.name, reason, message)
+
+    def events_for(self, namespace: str, name: str) -> List[Event]:
+        return [e for e in self._events if e.namespace == namespace and e.name == name]
+
+    @property
+    def all(self) -> List[Event]:
+        return list(self._events)

@@ -1,0 +1,123 @@
+"""Fake workflow engine — the data model is real, no executor runs.
+
+Mirrors the reference's envtest strategy (SURVEY.md §4): the Workflow
+CRD exists so objects can be created and polled, but nothing drives them
+to completion unless the test scripts it. Default behavior is therefore
+"never completes", which exercises the poll-timeout → synthesized-Failed
+path exactly like the reference integration tests do
+(reference: internal/controllers/healthcheck_controller_test.go:41-242).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional
+
+from activemonitor_tpu.engine.base import (
+    PHASE_FAILED,
+    PHASE_SUCCEEDED,
+    generate_name,
+)
+
+# completer(workflow, poll_count) -> status dict to set, or None to leave pending
+Completer = Callable[[dict, int], Optional[dict]]
+
+
+def succeed_after(polls: int, outputs: Optional[dict] = None) -> Completer:
+    """Workflow reaches Succeeded on the Nth poll (1-based)."""
+
+    def completer(wf: dict, count: int) -> Optional[dict]:
+        if count >= polls:
+            status = {"phase": PHASE_SUCCEEDED}
+            if outputs is not None:
+                status["outputs"] = outputs
+            return status
+        return None
+
+    return completer
+
+
+def fail_after(polls: int, message: str = "probe failed") -> Completer:
+    def completer(wf: dict, count: int) -> Optional[dict]:
+        if count >= polls:
+            return {"phase": PHASE_FAILED, "message": message}
+        return None
+
+    return completer
+
+
+def never_complete() -> Completer:
+    return lambda wf, count: None
+
+
+class FakeWorkflowEngine:
+    def __init__(self, completer: Completer | None = None):
+        self._workflows: Dict[str, dict] = {}  # key: ns/name
+        self._poll_counts: Dict[str, int] = {}
+        self._default_completer = completer or never_complete()
+        # per-generateName-prefix overrides, matched by startswith
+        self._prefix_completers: List[tuple[str, Completer]] = []
+        self.submitted: List[dict] = []  # submission log for assertions
+
+    def on_prefix(self, prefix: str, completer: Completer) -> None:
+        """Script behavior for workflows whose name starts with prefix."""
+        self._prefix_completers.append((prefix, completer))
+
+    def _completer_for(self, name: str) -> Completer:
+        for prefix, completer in self._prefix_completers:
+            if name.startswith(prefix):
+                return completer
+        return self._default_completer
+
+    async def submit(self, manifest: dict) -> str:
+        manifest = copy.deepcopy(manifest)
+        meta = manifest.setdefault("metadata", {})
+        name = meta.get("name") or generate_name(meta.get("generateName", "wf-"))
+        meta["name"] = name
+        namespace = meta.get("namespace", "default")
+        self._workflows[f"{namespace}/{name}"] = manifest
+        self._poll_counts[f"{namespace}/{name}"] = 0
+        self.submitted.append(manifest)
+        return name
+
+    async def get(self, namespace: str, name: str) -> Optional[dict]:
+        key = f"{namespace}/{name}"
+        wf = self._workflows.get(key)
+        if wf is None:
+            return None
+        self._poll_counts[key] += 1
+        if "status" not in wf or wf["status"].get("phase") not in (
+            PHASE_SUCCEEDED,
+            PHASE_FAILED,
+        ):
+            status = self._completer_for(name)(wf, self._poll_counts[key])
+            if status is not None:
+                wf["status"] = status
+        return copy.deepcopy(wf)
+
+    # test helpers -----------------------------------------------------
+    def set_status(self, namespace: str, name: str, status: dict) -> None:
+        self._workflows[f"{namespace}/{name}"]["status"] = status
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._workflows.pop(f"{namespace}/{name}", None)
+
+    def delete_owned_by(self, uid: str) -> int:
+        """GC workflows owned by a HealthCheck UID (the ownerReference
+        cascade the API server provides in the reference,
+        healthcheck_controller.go:512-522)."""
+        doomed = [
+            k
+            for k, wf in self._workflows.items()
+            if any(
+                ref.get("uid") == uid
+                for ref in wf.get("metadata", {}).get("ownerReferences", [])
+            )
+        ]
+        for k in doomed:
+            del self._workflows[k]
+        return len(doomed)
+
+    @property
+    def workflows(self) -> Dict[str, dict]:
+        return self._workflows

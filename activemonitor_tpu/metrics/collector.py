@@ -1,0 +1,164 @@
+"""Prometheus metrics.
+
+Exact metric names/labels of the reference
+(reference: internal/metrics/collector.go:19-48):
+
+- ``healthcheck_success_count``  counter {healthcheck_name, workflow}
+- ``healthcheck_error_count``    counter {healthcheck_name, workflow}
+- ``healthcheck_runtime_seconds`` gauge  {healthcheck_name, workflow}
+- ``healthcheck_starttime``      gauge   {healthcheck_name, workflow}
+- ``healthcheck_finishedtime``   gauge   {healthcheck_name, workflow}
+
+with ``workflow`` ∈ {healthCheck, remedy}, plus dynamic custom gauges
+parsed from workflow global output parameters in the
+``{"metrics": [{name, value, metrictype, help}]}`` contract
+(reference: collector.go:68-115). Two deliberate fixes over the
+reference: custom metrics are actually invoked from the controller (the
+reference implements but never calls them — SURVEY.md §2 known
+defects), and the metric-name sanitizer handles the metric's own name,
+not just the HealthCheck name (collector.go:90 only rewrites ``name``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from typing import Dict, Optional
+
+from prometheus_client import CollectorRegistry, Gauge
+
+log = logging.getLogger(__name__)
+
+LABEL_HC = "healthcheck_name"
+LABEL_WF = "workflow"
+
+WORKFLOW_LABEL_HEALTHCHECK = "healthCheck"
+WORKFLOW_LABEL_REMEDY = "remedy"
+
+_INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    return _INVALID_CHARS.sub("_", name)
+
+
+class MetricsCollector:
+    """Holds a registry; constructible per-test (the reference's global
+    registry makes its own tests race — collector_test.go:82-88)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        labels = [LABEL_HC, LABEL_WF]
+        # The two counters are exposed as monotonically-increasing gauges:
+        # prometheus_client appends "_total" to Counter names in the
+        # exposition, the Go client does not — and the scrape contract is
+        # the exact name `healthcheck_success_count` (collector.go:20).
+        self.monitor_success = Gauge(
+            "healthcheck_success_count",
+            "The total number of successful healthcheck resources",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_error = Gauge(
+            "healthcheck_error_count",
+            "The total number of errored healthcheck resources",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_runtime = Gauge(
+            "healthcheck_runtime_seconds",
+            "Time taken for the workflow to complete.",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_started_time = Gauge(
+            "healthcheck_starttime",
+            "Time the workflow started.",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_finished_time = Gauge(
+            "healthcheck_finishedtime",
+            "Time the workflow finished.",
+            labels,
+            registry=self.registry,
+        )
+        self._custom_gauges: Dict[str, Gauge] = {}
+        self._custom_lock = threading.Lock()
+
+    # -- run accounting (reference call sites:
+    #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
+    def record_success(
+        self, hc_name: str, workflow: str, started: float, finished: float
+    ) -> None:
+        self.monitor_success.labels(hc_name, workflow).inc()
+        self.monitor_runtime.labels(hc_name, workflow).set(finished - started)
+        self.monitor_started_time.labels(hc_name, workflow).set(started)
+        self.monitor_finished_time.labels(hc_name, workflow).set(finished)
+
+    def record_failure(
+        self, hc_name: str, workflow: str, started: float, finished: float
+    ) -> None:
+        self.monitor_error.labels(hc_name, workflow).inc()
+        self.monitor_started_time.labels(hc_name, workflow).set(started)
+        self.monitor_finished_time.labels(hc_name, workflow).set(finished)
+
+    # -- dynamic custom metrics ---------------------------------------
+    def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:
+        """Parse workflow global output parameters for the custom-metric
+        contract and set gauges. Returns how many metrics were recorded.
+
+        Malformed JSON / entries are skipped with a log, never raised
+        (reference: collector.go:73-87).
+        """
+        outputs = (workflow_status or {}).get("outputs") or {}
+        parameters = outputs.get("parameters") or []
+        recorded = 0
+        for parameter in parameters:
+            value = parameter.get("value") if isinstance(parameter, dict) else None
+            if not isinstance(value, str):
+                continue
+            try:
+                doc = json.loads(value)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(doc, dict):
+                continue
+            for raw in doc.get("metrics") or []:
+                if not isinstance(raw, dict):
+                    continue
+                metric_name = raw.get("name") or ""
+                try:
+                    metric_value = float(raw.get("value"))
+                except (TypeError, ValueError):
+                    log.error("skipping custom metric with bad value: %r", raw)
+                    continue
+                if not metric_name:
+                    log.error("skipping invalid custom metric for %s: %r", hc_name, raw)
+                    continue
+                full_name = _sanitize(hc_name) + "_" + _sanitize(metric_name)
+                with self._custom_lock:
+                    gauge = self._custom_gauges.get(full_name)
+                    if gauge is None:
+                        gauge = Gauge(
+                            full_name,
+                            str(raw.get("help") or full_name),
+                            [LABEL_HC],
+                            registry=self.registry,
+                        )
+                        self._custom_gauges[full_name] = gauge
+                gauge.labels(hc_name).set(metric_value)
+                recorded += 1
+        return recorded
+
+    # -- exposition ----------------------------------------------------
+    def exposition(self) -> bytes:
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)
+
+    def sample_value(self, name: str, labels: dict) -> Optional[float]:
+        """Test helper: read a sample from the registry."""
+        return self.registry.get_sample_value(name, labels)

@@ -64,11 +64,13 @@ class TimerWheel:
 
     def stop(self, name: str) -> bool:
         """Cancel a pending run if any; keeps no map entry. Returns True
-        if a pending timer was actually cancelled."""
+        if a pending timer was actually cancelled. A timer task stopping
+        itself from within its own callback (the reschedule-at-watch-end
+        path) is popped but never cancelled mid-flight."""
         t = self._timers.pop(name, None)
         if t is None:
             return False
-        if not t.done():
+        if not t.done() and t is not asyncio.current_task():
             t.cancel()
             return True
         return False

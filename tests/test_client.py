@@ -1,0 +1,139 @@
+"""In-memory HealthCheck client tests: CAS semantics, conflict retry, watch."""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    ConflictError,
+    InMemoryHealthCheckClient,
+    NotFoundError,
+    retry_on_conflict,
+)
+
+
+def make_hc(name="hc-a"):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {"repeatAfterSec": 60, "level": "cluster"},
+        }
+    )
+
+
+@pytest.mark.asyncio
+async def test_apply_get_roundtrip():
+    c = InMemoryHealthCheckClient()
+    created = await c.apply(make_hc())
+    assert created.metadata.uid
+    assert created.metadata.resource_version
+    got = await c.get("health", "hc-a")
+    assert got == created
+
+
+@pytest.mark.asyncio
+async def test_get_missing_returns_none():
+    c = InMemoryHealthCheckClient()
+    assert await c.get("health", "nope") is None
+
+
+@pytest.mark.asyncio
+async def test_generate_name_assigns_name():
+    c = InMemoryHealthCheckClient()
+    hc = make_hc()
+    hc.metadata.name = ""
+    hc.metadata.generate_name = "gen-"
+    created = await c.apply(hc)
+    assert created.metadata.name.startswith("gen-")
+    assert len(created.metadata.name) > len("gen-")
+
+
+@pytest.mark.asyncio
+async def test_update_status_cas_conflict():
+    c = InMemoryHealthCheckClient()
+    created = await c.apply(make_hc())
+    stale = created.deepcopy()
+    fresh = await c.get("health", "hc-a")
+    fresh.status.success_count = 1
+    await c.update_status(fresh)
+    stale.status.success_count = 99
+    with pytest.raises(ConflictError):
+        await c.update_status(stale)
+    # the fresh write won
+    now = await c.get("health", "hc-a")
+    assert now.status.success_count == 1
+
+
+@pytest.mark.asyncio
+async def test_update_status_deleted_raises_not_found():
+    c = InMemoryHealthCheckClient()
+    created = await c.apply(make_hc())
+    await c.delete("health", "hc-a")
+    with pytest.raises(NotFoundError):
+        await c.update_status(created)
+
+
+@pytest.mark.asyncio
+async def test_retry_on_conflict_retries_then_succeeds():
+    c = InMemoryHealthCheckClient()
+    await c.apply(make_hc())
+    c.force_conflicts(2)
+    attempts = 0
+
+    async def attempt():
+        nonlocal attempts
+        attempts += 1
+        fresh = await c.get("health", "hc-a")
+        fresh.status.success_count = 5
+        return await c.update_status(fresh)
+
+    await retry_on_conflict(attempt)
+    assert attempts == 3
+    assert (await c.get("health", "hc-a")).status.success_count == 5
+
+
+@pytest.mark.asyncio
+async def test_retry_on_conflict_gives_up():
+    async def always_conflict():
+        raise ConflictError("nope")
+
+    with pytest.raises(ConflictError):
+        await retry_on_conflict(always_conflict, attempts=3, base_delay=0.001)
+
+
+@pytest.mark.asyncio
+async def test_watch_sees_lifecycle_events():
+    c = InMemoryHealthCheckClient()
+    events = []
+
+    async def watcher():
+        async for ev in c.watch():
+            events.append((ev.type, ev.name))
+            if len(events) == 3:
+                return
+
+    task = asyncio.create_task(watcher())
+    await asyncio.sleep(0)
+    created = await c.apply(make_hc())
+    fresh = await c.get("health", "hc-a")
+    fresh.status.success_count = 1
+    await c.update_status(fresh)
+    await c.delete("health", "hc-a")
+    await asyncio.wait_for(task, 2)
+    assert events == [("ADDED", "hc-a"), ("MODIFIED", "hc-a"), ("DELETED", "hc-a")]
+
+
+@pytest.mark.asyncio
+async def test_spec_update_preserves_status():
+    c = InMemoryHealthCheckClient()
+    await c.apply(make_hc())
+    fresh = await c.get("health", "hc-a")
+    fresh.status.success_count = 7
+    await c.update_status(fresh)
+    updated_spec = make_hc()
+    updated_spec.spec.repeat_after_sec = 120
+    await c.apply(updated_spec)
+    got = await c.get("health", "hc-a")
+    assert got.spec.repeat_after_sec == 120
+    assert got.status.success_count == 7  # apply does not clobber status

@@ -1,0 +1,203 @@
+"""Workflow engine tests: fake scripting and local-process execution."""
+
+import asyncio
+import sys
+
+import pytest
+
+from activemonitor_tpu.engine import (
+    FakeWorkflowEngine,
+    LocalProcessEngine,
+    fail_after,
+    succeed_after,
+)
+
+MANIFEST = {
+    "apiVersion": "argoproj.io/v1alpha1",
+    "kind": "Workflow",
+    "metadata": {"generateName": "probe-", "namespace": "health"},
+    "spec": {"entrypoint": "main", "templates": []},
+}
+
+
+@pytest.mark.asyncio
+async def test_fake_submit_generates_name():
+    eng = FakeWorkflowEngine()
+    name = await eng.submit(MANIFEST)
+    assert name.startswith("probe-") and len(name) > len("probe-")
+    wf = await eng.get("health", name)
+    assert wf["metadata"]["name"] == name
+
+
+@pytest.mark.asyncio
+async def test_fake_default_never_completes():
+    eng = FakeWorkflowEngine()
+    name = await eng.submit(MANIFEST)
+    for _ in range(5):
+        wf = await eng.get("health", name)
+    assert "status" not in wf or wf["status"].get("phase") not in ("Succeeded", "Failed")
+
+
+@pytest.mark.asyncio
+async def test_fake_succeed_after_and_outputs():
+    outputs = {"parameters": [{"name": "m", "value": '{"metrics": []}'}]}
+    eng = FakeWorkflowEngine(succeed_after(2, outputs=outputs))
+    name = await eng.submit(MANIFEST)
+    wf1 = await eng.get("health", name)
+    assert wf1.get("status") is None
+    wf2 = await eng.get("health", name)
+    assert wf2["status"]["phase"] == "Succeeded"
+    assert wf2["status"]["outputs"] == outputs
+
+
+@pytest.mark.asyncio
+async def test_fake_prefix_scripting():
+    eng = FakeWorkflowEngine(succeed_after(1))
+    eng.on_prefix("bad-", fail_after(1, "boom"))
+    good = await eng.submit(MANIFEST)
+    bad = await eng.submit({**MANIFEST, "metadata": {"generateName": "bad-", "namespace": "health"}})
+    assert (await eng.get("health", good))["status"]["phase"] == "Succeeded"
+    assert (await eng.get("health", bad))["status"]["phase"] == "Failed"
+    assert (await eng.get("health", bad))["status"]["message"] == "boom"
+
+
+@pytest.mark.asyncio
+async def test_fake_get_missing_returns_none():
+    eng = FakeWorkflowEngine()
+    assert await eng.get("health", "nope") is None
+
+
+@pytest.mark.asyncio
+async def test_fake_delete_owned_by():
+    eng = FakeWorkflowEngine()
+    m = {**MANIFEST, "metadata": {**MANIFEST["metadata"], "ownerReferences": [{"uid": "u1"}]}}
+    await eng.submit(m)
+    await eng.submit(m)
+    await eng.submit(MANIFEST)
+    assert eng.delete_owned_by("u1") == 2
+    assert len(eng.workflows) == 1
+
+
+# -- local process engine ---------------------------------------------
+
+
+def container_wf(command, args=None, deadline=None):
+    spec = {
+        "entrypoint": "main",
+        "templates": [
+            {"name": "main", "container": {"image": "ignored", "command": command, "args": args or []}}
+        ],
+    }
+    if deadline is not None:
+        spec["activeDeadlineSeconds"] = deadline
+    return {
+        "metadata": {"generateName": "local-", "namespace": "default"},
+        "spec": spec,
+    }
+
+
+async def wait_terminal(eng, name, timeout=10.0):
+    for _ in range(int(timeout / 0.05)):
+        wf = await eng.get("default", name)
+        if wf["status"]["phase"] in ("Succeeded", "Failed"):
+            return wf
+        await asyncio.sleep(0.05)
+    raise TimeoutError(wf)
+
+
+@pytest.mark.asyncio
+async def test_local_container_success():
+    eng = LocalProcessEngine()
+    name = await eng.submit(container_wf(["/bin/sh", "-c"], ["exit 0"]))
+    wf = await wait_terminal(eng, name)
+    assert wf["status"]["phase"] == "Succeeded"
+
+
+@pytest.mark.asyncio
+async def test_local_container_failure_has_message():
+    eng = LocalProcessEngine()
+    name = await eng.submit(container_wf(["/bin/sh", "-c"], ["echo oh no; exit 3"]))
+    wf = await wait_terminal(eng, name)
+    assert wf["status"]["phase"] == "Failed"
+    assert "exited 3" in wf["status"]["message"]
+    assert "oh no" in wf["status"]["message"]
+
+
+@pytest.mark.asyncio
+async def test_local_deadline_kills_and_fails():
+    eng = LocalProcessEngine()
+    name = await eng.submit(container_wf(["/bin/sh", "-c"], ["sleep 30"], deadline=1))
+    wf = await wait_terminal(eng, name, timeout=15)
+    assert wf["status"]["phase"] == "Failed"
+    assert "activeDeadlineSeconds" in wf["status"]["message"]
+
+
+@pytest.mark.asyncio
+async def test_local_script_template():
+    eng = LocalProcessEngine()
+    manifest = {
+        "metadata": {"generateName": "script-", "namespace": "default"},
+        "spec": {
+            "entrypoint": "main",
+            "templates": [
+                {
+                    "name": "main",
+                    "script": {
+                        "command": [sys.executable],
+                        "source": "print('hello from probe')",
+                    },
+                }
+            ],
+        },
+    }
+    name = await eng.submit(manifest)
+    wf = await wait_terminal(eng, name)
+    assert wf["status"]["phase"] == "Succeeded"
+
+
+@pytest.mark.asyncio
+async def test_local_metrics_contract_captured_as_outputs():
+    payload = '{"metrics": [{"name": "bw", "value": 42.0, "metrictype": "gauge", "help": "x"}]}'
+    eng = LocalProcessEngine()
+    name = await eng.submit(
+        container_wf(["/bin/sh", "-c"], [f"echo 'starting'; echo '{payload}'"])
+    )
+    wf = await wait_terminal(eng, name)
+    assert wf["status"]["phase"] == "Succeeded"
+    params = wf["status"]["outputs"]["parameters"]
+    assert params[0]["value"] == payload
+
+
+@pytest.mark.asyncio
+async def test_local_steps_run_sequentially(tmp_path):
+    out = tmp_path / "order.txt"
+    manifest = {
+        "metadata": {"generateName": "steps-", "namespace": "default"},
+        "spec": {
+            "entrypoint": "main",
+            "templates": [
+                {
+                    "name": "main",
+                    "steps": [[{"name": "a", "template": "one"}], [{"name": "b", "template": "two"}]],
+                },
+                {"name": "one", "container": {"command": ["/bin/sh", "-c"], "args": [f"echo 1 >> {out}"]}},
+                {"name": "two", "container": {"command": ["/bin/sh", "-c"], "args": [f"echo 2 >> {out}"]}},
+            ],
+        },
+    }
+    eng = LocalProcessEngine()
+    name = await eng.submit(manifest)
+    wf = await wait_terminal(eng, name)
+    assert wf["status"]["phase"] == "Succeeded"
+    assert out.read_text().split() == ["1", "2"]
+
+
+@pytest.mark.asyncio
+async def test_local_bad_entrypoint_fails():
+    eng = LocalProcessEngine()
+    name = await eng.submit(
+        {"metadata": {"generateName": "bad-", "namespace": "default"},
+         "spec": {"entrypoint": "missing", "templates": []}}
+    )
+    wf = await wait_terminal(eng, name)
+    assert wf["status"]["phase"] == "Failed"

@@ -1,0 +1,122 @@
+"""Metrics tests (reference test model: internal/metrics/collector_test.go —
+malformed custom-metric table against a private registry)."""
+
+import pytest
+
+from activemonitor_tpu.metrics import (
+    MetricsCollector,
+    WORKFLOW_LABEL_HEALTHCHECK,
+    WORKFLOW_LABEL_REMEDY,
+)
+
+
+@pytest.fixture()
+def collector():
+    return MetricsCollector()
+
+
+def labels(name, wf=WORKFLOW_LABEL_HEALTHCHECK):
+    return {"healthcheck_name": name, "workflow": wf}
+
+
+def test_record_success_sets_all_vecs(collector):
+    collector.record_success("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 100.0, 107.5)
+    assert collector.sample_value("healthcheck_success_count", labels("hc-a")) == 1
+    assert collector.sample_value("healthcheck_runtime_seconds", labels("hc-a")) == 7.5
+    assert collector.sample_value("healthcheck_starttime", labels("hc-a")) == 100.0
+    assert collector.sample_value("healthcheck_finishedtime", labels("hc-a")) == 107.5
+
+
+def test_record_failure_increments_error(collector):
+    collector.record_failure("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 100.0, 101.0)
+    collector.record_failure("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 102.0, 103.0)
+    assert collector.sample_value("healthcheck_error_count", labels("hc-a")) == 2
+    assert collector.sample_value("healthcheck_success_count", labels("hc-a")) is None
+
+
+def test_remedy_label_dimension(collector):
+    collector.record_success("hc-a", WORKFLOW_LABEL_REMEDY, 0, 1)
+    assert (
+        collector.sample_value(
+            "healthcheck_success_count", labels("hc-a", WORKFLOW_LABEL_REMEDY)
+        )
+        == 1
+    )
+
+
+def test_exposition_contains_reference_metric_names(collector):
+    collector.record_success("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 0, 1)
+    text = collector.exposition().decode()
+    # exact names, no _total suffix (scrape contract of the reference)
+    assert "healthcheck_success_count{" in text
+    assert "healthcheck_runtime_seconds{" in text
+
+
+def test_custom_metrics_from_outputs(collector):
+    status = {
+        "outputs": {
+            "parameters": [
+                {
+                    "name": "metrics",
+                    "value": '{"metrics": [{"name": "ici-allreduce-gbps", '
+                    '"value": 123.4, "metrictype": "gauge", "help": "ICI bw"}]}',
+                }
+            ]
+        }
+    }
+    n = collector.record_custom_metrics("tpu-probe", status)
+    assert n == 1
+    # both hc name and metric name sanitized: "-" -> "_"
+    assert (
+        collector.sample_value(
+            "tpu_probe_ici_allreduce_gbps", {"healthcheck_name": "tpu-probe"}
+        )
+        == 123.4
+    )
+
+
+def test_custom_metrics_updates_existing_gauge(collector):
+    def status(v):
+        return {
+            "outputs": {
+                "parameters": [
+                    {"name": "m", "value": '{"metrics": [{"name": "bw", "value": %f}]}' % v}
+                ]
+            }
+        }
+
+    collector.record_custom_metrics("hc", status(1.0))
+    collector.record_custom_metrics("hc", status(2.0))
+    assert collector.sample_value("hc_bw", {"healthcheck_name": "hc"}) == 2.0
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        "not json at all",
+        '{"metrics": "not-a-list"}',
+        '{"metrics": [{"value": 1.0}]}',  # missing name
+        '{"metrics": [{"name": "x", "value": "NaN-ish-string"}]}',
+        '{"metrics": [42]}',
+        '{"other": []}',
+        "",
+    ],
+)
+def test_malformed_custom_metrics_are_skipped(collector, value):
+    status = {"outputs": {"parameters": [{"name": "m", "value": value}]}}
+    assert collector.record_custom_metrics("hc", status) == 0
+
+
+def test_no_outputs_is_noop(collector):
+    assert collector.record_custom_metrics("hc", {}) == 0
+    assert collector.record_custom_metrics("hc", {"outputs": None}) == 0
+    assert collector.record_custom_metrics("hc", {"outputs": {"parameters": None}}) == 0
+
+
+def test_two_collectors_do_not_share_registries():
+    # the reference's global registry caused a documented race
+    # (collector_test.go:82-88); per-instance registries avoid it
+    a = MetricsCollector()
+    b = MetricsCollector()
+    a.record_success("hc", WORKFLOW_LABEL_HEALTHCHECK, 0, 1)
+    assert b.sample_value("healthcheck_success_count", labels("hc")) is None

@@ -1,0 +1,203 @@
+"""RBAC provisioner tests (reference test model:
+healthcheck_controller_unit_test.go:310-613)."""
+
+import pytest
+
+from activemonitor_tpu.api import (
+    ArtifactLocation,
+    HealthCheck,
+    HealthCheckSpec,
+    PolicyRule,
+    RemedyWorkflow,
+    ResourceObject,
+    Workflow,
+)
+from activemonitor_tpu.controller import (
+    DEFAULT_HEALTHCHECK_RULES,
+    DEFAULT_REMEDY_RULES,
+    InMemoryRBACBackend,
+    MANAGED_BY_LABEL_KEY,
+    MANAGED_BY_VALUE,
+    RBACError,
+    RBACObject,
+    RBACProvisioner,
+    resolve_rbac_rules,
+)
+
+
+def make_hc(level="cluster", sa="check-sa", remedy_sa=None, custom_rules=None):
+    remedy = RemedyWorkflow()
+    if remedy_sa is not None:
+        remedy = RemedyWorkflow(
+            generate_name="remedy-",
+            resource=ResourceObject(
+                namespace="health",
+                service_account=remedy_sa,
+                source=ArtifactLocation(inline="kind: Workflow"),
+            ),
+        )
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": "hc-test", "namespace": "health", "uid": "u1"},
+            "spec": {
+                "level": level,
+                "repeatAfterSec": 60,
+                "workflow": {
+                    "generateName": "check-",
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": sa,
+                        "source": {"inline": "kind: Workflow"},
+                    },
+                    "rbacRules": custom_rules or [],
+                },
+                "remedyworkflow": remedy.model_dump(by_alias=True, exclude_none=True),
+            },
+        }
+    )
+
+
+@pytest.fixture()
+def backend():
+    return InMemoryRBACBackend()
+
+
+@pytest.fixture()
+def prov(backend):
+    return RBACProvisioner(backend)
+
+
+@pytest.mark.asyncio
+async def test_cluster_level_creates_sa_role_binding(prov, backend):
+    await prov.create_rbac_for_workflow(make_hc(), "healthCheck")
+    assert ("ServiceAccount", "health", "check-sa") in backend.objects
+    role = backend.objects[("ClusterRole", "", "check-sa-cluster-role")]
+    binding = backend.objects[("ClusterRoleBinding", "", "check-sa-cluster-role-binding")]
+    assert binding.role_ref == "check-sa-cluster-role"
+    assert binding.subject == "health/check-sa"
+    # read-only verbs (reference: :85-101)
+    for rule in role.rules:
+        assert set(rule.verbs) == {"get", "list", "watch"}
+
+
+@pytest.mark.asyncio
+async def test_namespace_level_creates_ns_role(prov, backend):
+    await prov.create_rbac_for_workflow(make_hc(level="namespace"), "healthCheck")
+    assert ("Role", "health", "check-sa-ns-role") in backend.objects
+    assert ("RoleBinding", "health", "check-sa-ns-role-binding") in backend.objects
+    assert ("ClusterRole", "", "check-sa-cluster-role") not in backend.objects
+
+
+@pytest.mark.asyncio
+async def test_remedy_gets_write_verbs(prov, backend):
+    hc = make_hc(remedy_sa="remedy-sa")
+    await prov.create_rbac_for_workflow(hc, "remedy")
+    role = backend.objects[("ClusterRole", "", "remedy-sa-cluster-role")]
+    for rule in role.rules:
+        assert "create" in rule.verbs and "delete" in rule.verbs
+
+
+@pytest.mark.asyncio
+async def test_sa_collision_renames_remedy_sa(prov, backend):
+    # reference: :316-319
+    hc = make_hc(sa="shared-sa", remedy_sa="shared-sa")
+    await prov.create_rbac_for_workflow(hc, "remedy")
+    assert hc.spec.remedy_workflow.resource.service_account == "shared-sa-remedy"
+    assert ("ServiceAccount", "health", "shared-sa-remedy") in backend.objects
+
+
+@pytest.mark.asyncio
+async def test_remedy_missing_sa_is_error(prov):
+    hc = make_hc()
+    hc.spec.remedy_workflow = RemedyWorkflow(
+        generate_name="remedy-",
+        resource=ResourceObject(namespace="health", source=ArtifactLocation(inline="x: y")),
+    )
+    with pytest.raises(RBACError, match="ServiceAccount for the RemedyWorkflow"):
+        await prov.create_rbac_for_workflow(hc, "healthCheck")
+
+
+@pytest.mark.asyncio
+async def test_remedy_nil_resource_is_error(prov):
+    hc = make_hc()
+    hc.spec.remedy_workflow = RemedyWorkflow(generate_name="remedy-")
+    with pytest.raises(RBACError, match="Resource is nil"):
+        await prov.create_rbac_for_workflow(hc, "healthCheck")
+
+
+@pytest.mark.asyncio
+async def test_unset_level_is_error(prov):
+    with pytest.raises(RBACError, match="level is not set"):
+        await prov.create_rbac_for_workflow(make_hc(level=""), "healthCheck")
+
+
+@pytest.mark.asyncio
+async def test_custom_rules_override(prov, backend):
+    custom = [{"apiGroups": ["batch"], "resources": ["jobs"], "verbs": ["get"]}]
+    await prov.create_rbac_for_workflow(make_hc(custom_rules=custom), "healthCheck")
+    role = backend.objects[("ClusterRole", "", "check-sa-cluster-role")]
+    assert len(role.rules) == 1
+    assert role.rules[0].resources == ["jobs"]
+
+
+@pytest.mark.asyncio
+async def test_idempotent_create_reuses_existing(prov, backend):
+    hc = make_hc()
+    await prov.create_rbac_for_workflow(hc, "healthCheck")
+    marker = backend.objects[("ServiceAccount", "health", "check-sa")]
+    await prov.create_rbac_for_workflow(hc, "healthCheck")
+    assert backend.objects[("ServiceAccount", "health", "check-sa")] is marker
+
+
+@pytest.mark.asyncio
+async def test_delete_remedy_rbac_guarded_by_managed_label(prov, backend):
+    # reference: delete guard, e.g. healthcheck_controller.go:1169,:1242
+    hc = make_hc(remedy_sa="remedy-sa")
+    await prov.create_rbac_for_workflow(hc, "remedy")
+    # plant a user-owned object with the same name pattern
+    backend.objects[("ClusterRole", "", "user-role")] = RBACObject(
+        kind="ClusterRole", name="user-role", labels={}
+    )
+    await prov.delete_rbac_for_workflow(hc)
+    assert ("ServiceAccount", "health", "remedy-sa") not in backend.objects
+    assert ("ClusterRole", "", "remedy-sa-cluster-role") not in backend.objects
+    assert ("ClusterRoleBinding", "", "remedy-sa-cluster-role-binding") not in backend.objects
+
+
+@pytest.mark.asyncio
+async def test_delete_skips_unmanaged_objects(prov, backend):
+    hc = make_hc(remedy_sa="remedy-sa")
+    # object exists but without our label -> left alone
+    backend.objects[("ServiceAccount", "health", "remedy-sa")] = RBACObject(
+        kind="ServiceAccount", name="remedy-sa", namespace="health", labels={}
+    )
+    await prov.delete_rbac_for_workflow(hc)
+    assert ("ServiceAccount", "health", "remedy-sa") in backend.objects
+
+
+@pytest.mark.asyncio
+async def test_delete_with_nil_remedy_resource_is_noop(prov):
+    hc = make_hc()  # empty remedy
+    await prov.delete_rbac_for_workflow(hc)  # must not raise
+
+
+def test_no_wildcards_in_default_rules():
+    # reference invariant (healthcheck_controller_unit_test.go:447-457)
+    for rules in (DEFAULT_HEALTHCHECK_RULES, DEFAULT_REMEDY_RULES):
+        for rule in rules:
+            assert "*" not in rule.verbs
+            assert "*" not in rule.resources
+            assert "*" not in rule.api_groups
+
+
+def test_resolve_rules_prefers_custom():
+    custom = [PolicyRule(api_groups=["x"], resources=["y"], verbs=["get"])]
+    assert resolve_rbac_rules(custom, DEFAULT_HEALTHCHECK_RULES) is custom
+    assert resolve_rbac_rules([], DEFAULT_HEALTHCHECK_RULES) is DEFAULT_HEALTHCHECK_RULES
+
+
+@pytest.mark.asyncio
+async def test_managed_by_labels_on_created_objects(prov, backend):
+    await prov.create_rbac_for_workflow(make_hc(), "healthCheck")
+    for obj in backend.objects.values():
+        assert obj.labels[MANAGED_BY_LABEL_KEY] == MANAGED_BY_VALUE

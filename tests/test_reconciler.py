@@ -1,0 +1,527 @@
+"""Reconciler integration tests — FakeClock + FakeWorkflowEngine.
+
+The controller equivalent of the reference's envtest suites
+(healthcheck_controller_test.go, healthcheck_controller_edge_test.go):
+the data model is real, the executor is scripted, and timing is
+deterministic via the fake clock.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.engine import FakeWorkflowEngine, fail_after, succeed_after
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = """
+apiVersion: argoproj.io/v1alpha1
+kind: Workflow
+spec:
+  entrypoint: main
+  templates:
+    - name: main
+      container:
+        command: [probe]
+"""
+
+
+def make_hc(
+    name="hc-a",
+    repeat=60,
+    timeout=10,
+    cron="",
+    remedy=False,
+    remedy_runs_limit=0,
+    remedy_reset_interval=0,
+):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        "workflow": {
+            "generateName": "check-",
+            "workflowtimeout": timeout,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "check-sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if cron:
+        spec["schedule"] = {"cron": cron}
+    if remedy:
+        spec["remedyworkflow"] = {
+            "generateName": "remedy-",
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "remedy-sa",
+                "source": {"inline": WF_INLINE},
+            },
+        }
+    if remedy_runs_limit:
+        spec["remedyRunsLimit"] = remedy_runs_limit
+    if remedy_reset_interval:
+        spec["remedyResetInterval"] = remedy_reset_interval
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+class Harness:
+    def __init__(self, completer=None):
+        self.clock = FakeClock()
+        self.client = InMemoryHealthCheckClient()
+        self.engine = FakeWorkflowEngine(completer)
+        self.backend = InMemoryRBACBackend()
+        self.recorder = EventRecorder()
+        self.metrics = MetricsCollector()
+        self.reconciler = HealthCheckReconciler(
+            client=self.client,
+            engine=self.engine,
+            rbac=RBACProvisioner(self.backend),
+            recorder=self.recorder,
+            metrics=self.metrics,
+            clock=self.clock,
+        )
+
+    async def apply_and_reconcile(self, hc):
+        created = await self.client.apply(hc)
+        await self.reconciler.reconcile(created.namespace, created.name)
+        return created
+
+    async def settle(self, seconds=0.0):
+        if seconds:
+            await self.clock.advance(seconds)
+        else:
+            for _ in range(20):
+                await asyncio.sleep(0)
+
+    async def status(self, name="hc-a"):
+        return (await self.client.get("health", name)).status
+
+
+@pytest.mark.asyncio
+async def test_success_flow_updates_status_and_metrics():
+    h = Harness(succeed_after(1))
+    await h.apply_and_reconcile(make_hc())
+    await h.settle()
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.status == "Succeeded"
+    assert st.success_count == 1
+    assert st.total_healthcheck_runs == 1
+    assert st.started_at is not None and st.finished_at is not None
+    assert st.last_successful_workflow.startswith("check-")
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_success_count",
+            {"healthcheck_name": "hc-a", "workflow": "healthCheck"},
+        )
+        == 1
+    )
+    # RBAC provisioned
+    assert ("ServiceAccount", "health", "check-sa") in h.backend.objects
+
+
+@pytest.mark.asyncio
+async def test_periodic_reschedule_runs_again():
+    h = Harness(succeed_after(1))
+    await h.apply_and_reconcile(make_hc(repeat=60))
+    await h.settle()
+    assert (await h.status()).success_count == 1
+    # timer fires at +60s -> second run -> counts advance
+    await h.clock.advance(61)
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 2
+    assert len(h.engine.submitted) == 2
+
+
+@pytest.mark.asyncio
+async def test_failure_flow_records_error():
+    h = Harness(fail_after(1, "deliberate failure"))
+    await h.apply_and_reconcile(make_hc())
+    await h.settle()
+    st = await h.status()
+    assert st.status == "Failed"
+    assert st.failed_count == 1
+    assert st.error_message == "deliberate failure"
+    assert st.last_failed_at is not None
+    assert st.last_failed_workflow.startswith("check-")
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_error_count",
+            {"healthcheck_name": "hc-a", "workflow": "healthCheck"},
+        )
+        == 1
+    )
+
+
+@pytest.mark.asyncio
+async def test_poll_timeout_synthesizes_failure():
+    # fake engine never completes; workflow timeout 10s -> synthesized Failed
+    # (reference: healthcheck_controller.go:627-632; envtest exploits the
+    # same behavior since no Argo controller runs)
+    h = Harness()  # never_complete
+    await h.apply_and_reconcile(make_hc(timeout=10))
+    await h.clock.advance(30)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.status == "Failed"
+    assert st.failed_count == 1
+
+
+@pytest.mark.asyncio
+async def test_pause_sets_stopped():
+    h = Harness()
+    hc = make_hc(repeat=0)
+    await h.apply_and_reconcile(hc)
+    st = await h.status()
+    assert st.status == "Stopped"
+    assert "stopped" in st.error_message
+    assert st.finished_at is not None
+    assert len(h.engine.submitted) == 0
+
+
+@pytest.mark.asyncio
+async def test_cron_schedule_runs_and_reschedules():
+    h = Harness(succeed_after(1))
+    await h.apply_and_reconcile(make_hc(repeat=0, cron="@every 30s", timeout=5))
+    await h.settle()
+    assert (await h.status()).success_count == 1
+    await h.clock.advance(32)
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 2
+
+
+@pytest.mark.asyncio
+async def test_invalid_cron_no_panic():
+    # reference edge test: invalid cron must not crash the controller
+    h = Harness()
+    requeue = None
+    hc = make_hc(repeat=0, cron="not-a-cron")
+    created = await h.client.apply(hc)
+    requeue = await h.reconciler.reconcile(created.namespace, created.name)
+    assert requeue == 1.0  # 1s requeue on process error (reference: :204)
+    assert len(h.engine.submitted) == 0
+
+
+@pytest.mark.asyncio
+async def test_dedupe_skips_recent_run():
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=60))
+    await h.settle()
+    assert len(h.engine.submitted) == 1
+    # a watch-event-driven reconcile right after completion must dedupe
+    await h.reconciler.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert len(h.engine.submitted) == 1
+
+
+@pytest.mark.asyncio
+async def test_cron_dedupe_no_churn():
+    """Divergence 4: status-write events must not resubmit cron checks
+    (the reference resubmits immediately on every event)."""
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=0, cron="@every 60s", timeout=5))
+    await h.settle()
+    assert len(h.engine.submitted) == 1
+    await h.reconciler.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert len(h.engine.submitted) == 1  # deduped, next run comes from the timer
+
+
+@pytest.mark.asyncio
+async def test_delete_cancels_timer():
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=60))
+    await h.settle()
+    assert h.reconciler.timers.pending("health/hc-a")
+    await h.client.delete("health", "hc-a")
+    await h.reconciler.reconcile(created.namespace, created.name)
+    assert not h.reconciler.timers.pending("health/hc-a")
+    # time passes; nothing new submitted
+    await h.clock.advance(120)
+    assert len(h.engine.submitted) == 1
+
+
+@pytest.mark.asyncio
+async def test_conflict_on_status_write_retries():
+    h = Harness(succeed_after(1))
+    await h.client.apply(make_hc())
+    h.client.force_conflicts(2)
+    await h.reconciler.reconcile("health", "hc-a")
+    await h.settle()
+    await h.reconciler.wait_watches()  # waits through the retry backoff
+    assert (await h.status()).success_count == 1
+
+
+@pytest.mark.asyncio
+async def test_nil_workflow_resource_is_noop():
+    # reference edge test: nil Workflow.Resource must no-op, not crash
+    h = Harness()
+    hc = make_hc()
+    hc.spec.workflow.resource = None
+    created = await h.client.apply(hc)
+    requeue = await h.reconciler.reconcile(created.namespace, created.name)
+    assert requeue is None
+    assert len(h.engine.submitted) == 0
+
+
+@pytest.mark.asyncio
+async def test_missing_level_errors_and_requeues():
+    h = Harness()
+    hc = make_hc()
+    hc.spec.level = ""
+    created = await h.client.apply(hc)
+    requeue = await h.reconciler.reconcile(created.namespace, created.name)
+    assert requeue == 1.0
+
+
+# -- remedy paths ------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_failure_triggers_remedy_and_cleans_rbac():
+    h = Harness(succeed_after(1))
+    h.engine.on_prefix("check-", fail_after(1, "check failed"))
+    await h.apply_and_reconcile(make_hc(remedy=True))
+    await h.settle()
+    st = await h.status()
+    assert st.status == "Failed"
+    assert st.remedy_status == "Succeeded"
+    assert st.remedy_success_count == 1
+    assert st.remedy_total_runs == 1
+    # remedy RBAC was created then deleted (ephemeral)
+    assert ("ServiceAccount", "health", "remedy-sa") not in h.backend.objects
+    # but the check RBAC remains
+    assert ("ServiceAccount", "health", "check-sa") in h.backend.objects
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_success_count",
+            {"healthcheck_name": "hc-a", "workflow": "remedy"},
+        )
+        == 1
+    )
+
+
+@pytest.mark.asyncio
+async def test_remedy_failure_records_remedy_error():
+    h = Harness(fail_after(1, "all failing"))
+    await h.apply_and_reconcile(make_hc(remedy=True))
+    await h.settle()
+    st = await h.status()
+    assert st.remedy_status == "Failed"
+    assert st.remedy_failed_count == 1
+    assert st.remedy_error_message == "all failing"
+    assert st.remedy_last_failed_at is not None
+
+
+@pytest.mark.asyncio
+async def test_success_resets_remedy_state():
+    # reference: healthcheck_controller.go:649-660
+    h = Harness(succeed_after(1))
+    h.engine.on_prefix("check-", fail_after(1))
+    await h.apply_and_reconcile(make_hc(repeat=60, remedy=True))
+    await h.settle()
+    assert (await h.status()).remedy_total_runs == 1
+    # next run: check succeeds -> remedy state reset
+    h.engine._prefix_completers.clear()
+    await h.clock.advance(61)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.status == "Succeeded"
+    assert st.remedy_total_runs == 0
+    assert st.remedy_success_count == 0
+    assert st.remedy_status == "HealthCheck Passed so Remedy is reset"
+
+
+@pytest.mark.asyncio
+async def test_remedy_runs_limit_gates_until_reset_interval():
+    # reference: healthcheck_controller.go:679-711; examples:
+    # Remedy_Examples/inlineMemoryRemedy_limit.yaml (limit 2, reset 300)
+    h = Harness(fail_after(1, "persistent failure"))
+    await h.apply_and_reconcile(
+        make_hc(repeat=30, remedy=True, remedy_runs_limit=2, remedy_reset_interval=300)
+    )
+    await h.settle()
+    assert (await h.status()).remedy_total_runs == 1
+    # run 2: still under limit
+    await h.clock.advance(31)
+    await h.reconciler.wait_watches()
+    assert (await h.status()).remedy_total_runs == 2
+    # run 3: limit reached, within reset interval -> remedy skipped
+    await h.clock.advance(31)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.remedy_total_runs == 2
+    assert st.failed_count == 3
+    # after the reset interval elapses -> reset and run again
+    await h.clock.advance(301)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.remedy_total_runs == 1  # reset to 0, then ran once
+    assert st.failed_count >= 4
+
+
+@pytest.mark.asyncio
+async def test_remedy_without_gates_always_runs():
+    h = Harness(fail_after(1))
+    await h.apply_and_reconcile(make_hc(repeat=30, remedy=True))
+    await h.settle()
+    for i in range(2, 5):
+        await h.clock.advance(31)
+        await h.reconciler.wait_watches()
+        assert (await h.status()).remedy_total_runs == i
+
+
+@pytest.mark.asyncio
+async def test_events_recorded():
+    h = Harness(succeed_after(1))
+    await h.apply_and_reconcile(make_hc())
+    await h.settle()
+    reasons = [e.message for e in h.recorder.events_for("health", "hc-a")]
+    assert "Successfully created workflow" in reasons
+    assert "Workflow status is Succeeded" in reasons
+    assert "Rescheduled workflow for next run" in reasons
+
+
+@pytest.mark.asyncio
+async def test_custom_metrics_wired_from_outputs():
+    """The reference implements custom metrics but never calls them
+    (SURVEY.md §2 known defects) — here they must actually flow."""
+    outputs = {
+        "parameters": [
+            {
+                "name": "metrics",
+                "value": '{"metrics": [{"name": "ici-bw-gbps", "value": 512.3,'
+                ' "metrictype": "gauge", "help": "measured ICI bandwidth"}]}',
+            }
+        ]
+    }
+    h = Harness(succeed_after(1, outputs=outputs))
+    await h.apply_and_reconcile(make_hc())
+    await h.settle()
+    assert (
+        h.metrics.sample_value("hc_a_ici_bw_gbps", {"healthcheck_name": "hc-a"})
+        == 512.3
+    )
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_resume_from_status():
+    """SURVEY.md §5.4: durable state lives in the CR status; a fresh
+    reconciler (controller restart) rebuilds its schedule idempotently
+    without double-running a recently-finished check."""
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=60))
+    await h.settle()
+    assert (await h.status()).success_count == 1
+
+    # "restart": new reconciler, same client state, fresh timers
+    r2 = HealthCheckReconciler(
+        client=h.client,
+        engine=h.engine,
+        rbac=RBACProvisioner(h.backend),
+        recorder=h.recorder,
+        metrics=h.metrics,
+        clock=h.clock,
+    )
+    # boot-time reconcile: no timer exists yet, finished recently -> the
+    # reference would resubmit (timer map lost on restart); ours does too
+    # since exists() is False -> submits. This matches reference restart
+    # semantics (resubmit once, then dedupe).
+    await r2.reconcile(created.namespace, created.name)
+    await h.settle()
+    await r2.wait_watches()
+    assert (await h.status()).success_count == 2
+    # subsequent reconciles dedupe
+    await r2.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert (await h.status()).success_count == 2
+
+
+# -- review-finding regressions ---------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_same_name_different_namespace_timers_independent():
+    """Timers are keyed namespace/name: same-named checks in different
+    namespaces must not clobber each other (reference keys by bare name)."""
+    h = Harness(succeed_after(1))
+    a = make_hc(name="disk-check")
+    b = make_hc(name="disk-check")
+    b.metadata.namespace = "team-b"
+    created_a = await h.client.apply(a)
+    created_b = await h.client.apply(b)
+    await h.reconciler.reconcile(created_a.namespace, created_a.name)
+    await h.reconciler.reconcile(created_b.namespace, created_b.name)
+    await h.settle()
+    assert h.reconciler.timers.pending("health/disk-check")
+    assert h.reconciler.timers.pending("team-b/disk-check")
+    # deleting one cancels only its own timer
+    await h.client.delete("team-b", "disk-check")
+    await h.reconciler.reconcile("team-b", "disk-check")
+    assert h.reconciler.timers.pending("health/disk-check")
+    assert not h.reconciler.timers.pending("team-b/disk-check")
+
+
+@pytest.mark.asyncio
+async def test_watch_engine_error_requeues_instead_of_dying():
+    """A transient engine error in the detached watch must re-reconcile
+    after ~1s, not silently kill the schedule."""
+    h = Harness(succeed_after(1))
+    calls = {"n": 0}
+    orig_get = h.engine.get
+
+    async def flaky_get(namespace, name):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient API blip")
+        return await orig_get(namespace, name)
+
+    h.engine.get = flaky_get
+    await h.apply_and_reconcile(make_hc(repeat=60))
+    await h.settle()
+    await h.clock.advance(2)  # ride out the 1s requeue delay
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.success_count == 1  # recovered and completed
+
+
+@pytest.mark.asyncio
+async def test_no_duplicate_submission_while_workflow_in_flight():
+    """A reconcile event landing while the workflow is still running
+    (run outlives the interval) must not stack a second workflow."""
+    h = Harness(succeed_after(10))  # needs 10 polls -> long-running
+    created = await h.apply_and_reconcile(make_hc(repeat=5, timeout=1000))
+    await h.settle()
+    assert len(h.engine.submitted) == 1
+    # interval elapses but the run is still in flight; event-driven
+    # reconciles must not submit a duplicate
+    await h.clock.advance(6)
+    await h.reconciler.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert len(h.engine.submitted) == 1
+
+
+@pytest.mark.asyncio
+async def test_terminal_phase_on_final_poll_wins_over_timeout():
+    """A workflow observed Succeeded on the final (post-deadline) poll is
+    recorded as a success, not a synthesized failure."""
+    h = Harness(succeed_after(3))  # succeeds on the 3rd poll
+    await h.apply_and_reconcile(make_hc(timeout=4))  # max 2s, min 1s
+    # polls: t=0 (1), t=2 (2), deadline at 4 -> final poll sees Succeeded
+    await h.clock.advance(10)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.status == "Succeeded"
+    assert st.failed_count == 0

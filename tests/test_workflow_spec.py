@@ -1,0 +1,197 @@
+"""Workflow manifest mutation tests (reference test model:
+healthcheck_controller_unit_test.go:102-256 parse/type-safety cases)."""
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    WF_INSTANCE_ID,
+    WF_INSTANCE_ID_LABEL_KEY,
+    WorkflowSpecError,
+    parse_remedy_workflow_from_healthcheck,
+    parse_workflow_from_healthcheck,
+)
+
+BASE_WF = """
+apiVersion: argoproj.io/v1alpha1
+kind: Workflow
+metadata:
+  generateName: hello-world-
+spec:
+  entrypoint: whalesay
+  templates:
+    - name: whalesay
+      container:
+        image: docker/whalesay
+        command: [cowsay]
+"""
+
+
+def make_hc(inline=BASE_WF, remedy_inline=None, repeat=60, timeout=0, sa="check-sa"):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        "workflow": {
+            "generateName": "check-",
+            "workflowtimeout": timeout,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": sa,
+                "source": {"inline": inline},
+            },
+        },
+    }
+    if remedy_inline is not None:
+        spec["remedyworkflow"] = {
+            "generateName": "remedy-",
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "remedy-sa",
+                "source": {"inline": remedy_inline},
+            },
+        }
+    return HealthCheck.from_dict(
+        {"metadata": {"name": "hc-a", "namespace": "health", "uid": "uid-9"}, "spec": spec}
+    )
+
+
+def test_injects_gvk_metadata_and_owner_reference():
+    hc = make_hc()
+    wf = parse_workflow_from_healthcheck(hc)
+    assert wf["apiVersion"] == "argoproj.io/v1alpha1"
+    assert wf["kind"] == "Workflow"
+    assert wf["metadata"]["namespace"] == "health"
+    assert wf["metadata"]["generateName"] == "check-"
+    ref = wf["metadata"]["ownerReferences"][0]
+    assert ref["uid"] == "uid-9"
+    assert ref["controller"] is True
+    assert ref["kind"] == "HealthCheck"
+
+
+def test_default_instance_id_label_when_no_labels():
+    wf = parse_workflow_from_healthcheck(make_hc())
+    assert wf["metadata"]["labels"] == {WF_INSTANCE_ID_LABEL_KEY: WF_INSTANCE_ID}
+
+
+def test_manifest_labels_used_when_present():
+    inline = BASE_WF.replace(
+        "metadata:\n  generateName: hello-world-",
+        "metadata:\n  labels:\n    team: sre\n  generateName: hello-world-",
+    )
+    wf = parse_workflow_from_healthcheck(make_hc(inline=inline))
+    assert wf["metadata"]["labels"] == {"team": "sre"}
+
+
+def test_labels_do_not_leak_between_checks():
+    """The reference's shared workflowLabels map leaks labels across
+    HealthChecks (SURVEY.md §2 defect); per-check computation must not."""
+    inline = BASE_WF.replace(
+        "metadata:\n  generateName: hello-world-",
+        "metadata:\n  labels:\n    team: sre\n  generateName: hello-world-",
+    )
+    parse_workflow_from_healthcheck(make_hc(inline=inline))
+    wf2 = parse_workflow_from_healthcheck(make_hc())  # no labels in manifest
+    assert wf2["metadata"]["labels"] == {WF_INSTANCE_ID_LABEL_KEY: WF_INSTANCE_ID}
+
+
+def test_pod_gc_defaulted():
+    wf = parse_workflow_from_healthcheck(make_hc())
+    assert wf["spec"]["podGC"] == {"strategy": "OnPodCompletion"}
+
+
+def test_pod_gc_preserved_if_present():
+    inline = BASE_WF + "  podGC:\n    strategy: OnWorkflowSuccess\n"
+    wf = parse_workflow_from_healthcheck(make_hc(inline=inline))
+    assert wf["spec"]["podGC"] == {"strategy": "OnWorkflowSuccess"}
+
+
+def test_service_account_injected():
+    wf = parse_workflow_from_healthcheck(make_hc())
+    assert wf["spec"]["serviceAccountName"] == "check-sa"
+
+
+def test_timeout_defaults_to_repeat_after_sec():
+    # reference: healthcheck_controller.go:981-986 (mutates the spec)
+    hc = make_hc(repeat=45, timeout=0)
+    wf = parse_workflow_from_healthcheck(hc)
+    assert hc.spec.workflow.timeout == 45
+    assert wf["spec"]["activeDeadlineSeconds"] == 45
+
+
+def test_explicit_timeout_wins():
+    hc = make_hc(repeat=45, timeout=20)
+    wf = parse_workflow_from_healthcheck(hc)
+    assert wf["spec"]["activeDeadlineSeconds"] == 20
+
+
+def test_manifest_active_deadline_preserved():
+    inline = BASE_WF + "  activeDeadlineSeconds: 99\n"
+    wf = parse_workflow_from_healthcheck(make_hc(inline=inline))
+    assert wf["spec"]["activeDeadlineSeconds"] == 99
+
+
+def test_missing_spec_is_error():
+    with pytest.raises(WorkflowSpecError, match="missing spec"):
+        parse_workflow_from_healthcheck(make_hc(inline="apiVersion: v1\nkind: Workflow"))
+
+
+def test_non_map_spec_is_error():
+    with pytest.raises(WorkflowSpecError, match="spec is not a map"):
+        parse_workflow_from_healthcheck(
+            make_hc(inline="apiVersion: v1\nspec: just-a-string")
+        )
+
+
+def test_non_map_manifest_is_error():
+    with pytest.raises(WorkflowSpecError, match="invalid spec file"):
+        parse_workflow_from_healthcheck(make_hc(inline="- a\n- b"))
+
+
+def test_non_map_metadata_treated_as_unset():
+    # reference: :930-932 type-assertion safety
+    inline = "metadata: just-a-string\nspec:\n  entrypoint: x\n"
+    wf = parse_workflow_from_healthcheck(make_hc(inline=inline))
+    assert wf["metadata"]["labels"] == {WF_INSTANCE_ID_LABEL_KEY: WF_INSTANCE_ID}
+
+
+def test_non_map_labels_fall_back_to_default():
+    inline = "metadata:\n  labels: nope\nspec:\n  entrypoint: x\n"
+    wf = parse_workflow_from_healthcheck(make_hc(inline=inline))
+    assert wf["metadata"]["labels"] == {WF_INSTANCE_ID_LABEL_KEY: WF_INSTANCE_ID}
+
+
+def test_annotations_preserved():
+    inline = "metadata:\n  annotations:\n    note: keep-me\nspec:\n  entrypoint: x\n"
+    wf = parse_workflow_from_healthcheck(make_hc(inline=inline))
+    assert wf["metadata"]["annotations"] == {"note": "keep-me"}
+
+
+# -- remedy variant ----------------------------------------------------
+
+
+def test_remedy_deadline_defaults_to_repeat_after_sec():
+    hc = make_hc(remedy_inline=BASE_WF, repeat=30)
+    wf = parse_remedy_workflow_from_healthcheck(hc)
+    assert wf["spec"]["activeDeadlineSeconds"] == 30
+    assert hc.spec.remedy_workflow.timeout == 30
+    assert wf["spec"]["serviceAccountName"] == "remedy-sa"
+
+
+def test_remedy_numeric_deadline_sets_timeout():
+    # reference: :1113-1115
+    hc = make_hc(remedy_inline=BASE_WF + "  activeDeadlineSeconds: 77\n", repeat=30)
+    parse_remedy_workflow_from_healthcheck(hc)
+    assert hc.spec.remedy_workflow.timeout == 77
+
+
+def test_remedy_non_numeric_deadline_falls_back():
+    # reference: :1116-1119
+    hc = make_hc(remedy_inline=BASE_WF + "  activeDeadlineSeconds: soon\n", repeat=30)
+    parse_remedy_workflow_from_healthcheck(hc)
+    assert hc.spec.remedy_workflow.timeout == 30
+
+
+def test_remedy_nil_resource_is_error():
+    hc = make_hc()
+    with pytest.raises(WorkflowSpecError, match="Resource is nil"):
+        parse_remedy_workflow_from_healthcheck(hc)
