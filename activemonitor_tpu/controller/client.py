@@ -66,7 +66,8 @@ async def retry_on_conflict(fn, *, attempts: int = 5, base_delay: float = 0.01):
             return await fn()
         except ConflictError as e:
             last = e
-            await asyncio.sleep(base_delay * (2**i))
+            if i + 1 < attempts:  # no pointless sleep after the final try
+                await asyncio.sleep(base_delay * (2**i))
     raise last  # type: ignore[misc]
 
 

@@ -142,12 +142,23 @@ class MetricsCollector:
                 with self._custom_lock:
                     gauge = self._custom_gauges.get(full_name)
                     if gauge is None:
-                        gauge = Gauge(
-                            full_name,
-                            str(raw.get("help") or full_name),
-                            [LABEL_HC],
-                            registry=self.registry,
-                        )
+                        try:
+                            gauge = Gauge(
+                                full_name,
+                                str(raw.get("help") or full_name),
+                                [LABEL_HC],
+                                registry=self.registry,
+                            )
+                        except ValueError:
+                            # name collides with an already-registered
+                            # metric (e.g. a static vec) — skip, keep the
+                            # never-raise contract
+                            log.error(
+                                "custom metric %s collides with an existing "
+                                "registration; skipping",
+                                full_name,
+                            )
+                            continue
                         self._custom_gauges[full_name] = gauge
                 gauge.labels(hc_name).set(metric_value)
                 recorded += 1

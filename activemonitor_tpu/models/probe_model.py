@@ -1,0 +1,146 @@
+"""The probe transformer — flagship payload of the training-step and
+compile-smoke probes.
+
+A deliberately canonical decoder (embed → N×[LN, causal attention,
+residual, LN, MLP, residual] → LN → logits) written as a pure-functional
+JAX model: the parameter tree is an explicit dict built next to a
+parallel tree of `PartitionSpec`s, so the tensor/data-parallel layout is
+visible in one place instead of being threaded through module metadata.
+
+Design for the MXU: every matmul is a large dense einsum in bfloat16
+(params kept in float32, cast at use); shapes are static; no Python
+control flow under jit. Sharding follows the standard megatron layout —
+attention heads and MLP hidden dim split over the "model" axis, batch
+over "data" — so the only collectives jit inserts are the psums after
+the down-projections, riding ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ProbeModelConfig:
+    vocab_size: int = 4096
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approximate forward FLOPs/token (2·params matmul convention)."""
+        per_layer = (
+            2 * 4 * self.d_model * self.d_model  # qkv + out projections
+            + 2 * 2 * self.d_model * self.d_ff  # up + down
+        )
+        embed = 2 * self.d_model * self.vocab_size
+        return per_layer * self.n_layers + embed
+
+
+def tiny_config() -> ProbeModelConfig:
+    """Small enough to train a step on CPU in tests."""
+    return ProbeModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq_len=64
+    )
+
+
+def init_params(key: jax.Array, cfg: ProbeModelConfig) -> Dict:
+    """Explicit parameter tree (float32 master copies)."""
+    keys = jax.random.split(key, cfg.n_layers * 6 + 2)
+    k = iter(keys)
+
+    def dense(kk, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / jnp.sqrt(shape[0]))
+        return (jax.random.normal(kk, shape, jnp.float32) * scale)
+
+    params: Dict = {
+        "embed": dense(next(k), (cfg.vocab_size, cfg.d_model), scale=0.02),
+        "layers": [],
+        "final_ln": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append(
+            {
+                "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+                "wqkv": dense(next(k), (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
+                "wo": dense(next(k), (cfg.n_heads, cfg.head_dim, cfg.d_model)),
+                "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+                "w_up": dense(next(k), (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(next(k), (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return params
+
+
+def param_specs(cfg: ProbeModelConfig) -> Dict:
+    """PartitionSpec tree matching init_params: megatron tp over "model"."""
+    layer = {
+        "ln1": {"scale": P()},
+        "wqkv": P(None, None, "model", None),  # heads sharded
+        "wo": P("model", None, None),
+        "ln2": {"scale": P()},
+        "w_up": P(None, "model"),  # hidden dim sharded
+        "w_down": P("model", None),
+    }
+    return {
+        "embed": P(None, None),
+        "layers": [layer] * cfg.n_layers,
+        "final_ln": {"scale": P()},
+    }
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V]. Jit-friendly: static
+    shapes, lax-only control flow, bf16 compute."""
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]  # [B, S, D]
+    seq = tokens.shape[1]
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"]["scale"])
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
+        q, k_, v = qkv[0], qkv[1], qkv[2]  # [B, S, H, K]
+        scores = jnp.einsum("bshk,bthk->bhst", q, k_) / jnp.sqrt(
+            jnp.asarray(cfg.head_dim, dt)
+        )
+        scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e9, dt))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
+        h = _rmsnorm(x, layer["ln2"]["scale"])
+        up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt)))
+        x = x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(dt))
+    x = _rmsnorm(x, params["final_ln"]["scale"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
+    """Next-token cross-entropy (the training-step probe's objective)."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def param_count(cfg: ProbeModelConfig) -> int:
+    d, f, v, h, k = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.head_dim
+    per_layer = d + 3 * d * h * k + h * k * d + d + d * f + f * d
+    return v * d + cfg.n_layers * per_layer + d

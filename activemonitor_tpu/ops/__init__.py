@@ -1,0 +1,5 @@
+"""TPU kernels (Pallas) used by probes."""
+
+from activemonitor_tpu.ops.stream import stream_scale_pallas, stream_scale_xla
+
+__all__ = ["stream_scale_pallas", "stream_scale_xla"]

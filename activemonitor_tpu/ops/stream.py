@@ -1,0 +1,49 @@
+"""HBM streaming kernel (Pallas) — the memory-bandwidth probe's hot op.
+
+A blocked scale-copy: each grid step moves one (block, 1024) tile
+HBM → VMEM, scales on the VPU, and writes back — 2 bytes moved per
+payload byte, the STREAM "scale" pattern. A hand-set grid keeps each
+tile within VMEM while the pipeline overlaps the next tile's DMA with
+the current tile's compute (Pallas double-buffers automatically).
+
+On non-TPU platforms the kernel runs in interpret mode (correct but
+slow), so tests exercise the same code path on CPU; the probe falls
+back to a plain jnp expression for *timing* there.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _scale_copy_kernel(in_ref, out_ref, *, scale):
+    out_ref[:] = in_ref[:] * scale
+
+
+def stream_scale_pallas(x: jax.Array, scale: float = 2.0, block_rows: int = 512):
+    """Blocked scale-copy via Pallas; requires x.shape = (rows, 1024)
+    with rows % block_rows == 0."""
+    from jax.experimental import pallas as pl
+
+    rows, cols = x.shape
+    if rows % block_rows:
+        raise ValueError(f"rows {rows} not divisible by block {block_rows}")
+    interpret = jax.devices()[0].platform != "tpu"
+    return pl.pallas_call(
+        partial(_scale_copy_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x)
+
+
+def stream_scale_xla(x: jax.Array, scale: float = 2.0):
+    """XLA fallback of the same op. The optimization barrier stops XLA
+    from algebraically collapsing a chain of these into a single
+    multiply (x * scale**k), which would fake k× the real bandwidth."""
+    return jax.lax.optimization_barrier(x * scale)

@@ -1,0 +1,159 @@
+"""Timed collectives — the measurement core of the ICI bandwidth probes.
+
+The communication backend is XLA collectives over ICI/DCN
+(`psum` / `all_gather` / `ppermute` under `shard_map` on a Mesh) — the
+TPU-native equivalent of the NCCL/MPI backends the mandate describes;
+the reference itself has none (SURVEY.md §5.8).
+
+Measurement discipline (SURVEY.md §7 hard part (d)): time the
+collective, not the compile and not the dispatch — each benchmark jits
+a chain of k data-dependent collectives and takes the (2k−k) wall-clock
+difference through a forced host readback, so compile, tunnel
+roundtrips, and dispatch overhead cancel
+(see utils/timing.chain_delta_seconds).
+
+Bandwidth conventions follow NCCL-tests:
+
+- *algbw* = payload bytes / time
+- *busbw* = algbw × 2(n-1)/n for all-reduce (ring transfer volume),
+  algbw × (n-1)/n for all-gather — the number comparable against rated
+  link bandwidth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+
+@dataclass(frozen=True)
+class CollectiveResult:
+    name: str
+    payload_bytes: int
+    n_devices: int
+    seconds_per_op: float
+    algbw_gbps: float  # GB/s, payload/time
+    busbw_gbps: float  # GB/s, NCCL busbw convention
+
+
+def _payload(size_mb: float, dtype) -> tuple[int, int, int]:
+    itemsize = jnp.dtype(dtype).itemsize
+    cols = 1024
+    rows = max(8, int(size_mb * 1e6 / itemsize) // cols)
+    return rows, cols, rows * cols * itemsize
+
+
+def _sharded_chain(mesh: Mesh, body, k: int):
+    """jit(shard_map(chain of k body applications)) ending in a scalar."""
+    axis = mesh.axis_names[0]
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(None),
+        check_vma=False,
+    )
+    def chain(x):
+        for _ in range(k):
+            x = body(x)
+        # full-reduction readback: psum so every shard contributes
+        return jax.lax.psum(x.astype(jnp.float32).sum(), axis)[None]
+
+    return lambda x: chain(x)[0]
+
+
+def all_reduce_bandwidth(
+    mesh: Mesh, size_mb: float = 64.0, dtype=jnp.bfloat16, iters: int = 5
+) -> CollectiveResult:
+    """Chained psum all-reduce over the mesh's first axis."""
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    rows, cols, payload_bytes = _payload(size_mb, dtype)
+    inv_n = jnp.asarray(1.0 / n, dtype)
+
+    def body(x):
+        return jax.lax.psum(x, axis) * inv_n  # mean keeps magnitude stable
+
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(
+        lambda k: _sharded_chain(mesh, body, k), x, k1=2, k2=6, iters=iters
+    )
+    algbw = payload_bytes / seconds / 1e9
+    busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+    return CollectiveResult(
+        name="all_reduce",
+        payload_bytes=payload_bytes,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=busbw,
+    )
+
+
+def all_gather_bandwidth(
+    mesh: Mesh, size_mb: float = 64.0, dtype=jnp.bfloat16, iters: int = 5
+) -> CollectiveResult:
+    """Chained all-gather; each round gathers all shards then reduces
+    back to shard shape (the reduce keeps rounds data-dependent — its
+    local cost is included, so this slightly understates pure comm bw)."""
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    rows, cols, shard_bytes = _payload(size_mb, dtype)
+    inv_n = jnp.asarray(1.0 / n, dtype)
+
+    def body(x):
+        g = jax.lax.all_gather(x, axis)  # [n, rows, cols]
+        return jnp.sum(g, axis=0) * inv_n
+
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(
+        lambda k: _sharded_chain(mesh, body, k), x, k1=2, k2=6, iters=iters
+    )
+    total_bytes = shard_bytes * n
+    algbw = total_bytes / seconds / 1e9
+    busbw = algbw * ((n - 1) / n) if n > 1 else algbw
+    return CollectiveResult(
+        name="all_gather",
+        payload_bytes=total_bytes,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=busbw,
+    )
+
+
+def ppermute_ring_bandwidth(
+    mesh: Mesh, size_mb: float = 64.0, dtype=jnp.bfloat16, iters: int = 5
+) -> CollectiveResult:
+    """Chained neighbor-shift over a ring — isolates single-hop ICI link
+    speed (the building block of ring attention / pipelined collectives)."""
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    rows, cols, payload_bytes = _payload(size_mb, dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        return jax.lax.ppermute(x, axis, perm)
+
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(
+        lambda k: _sharded_chain(mesh, body, k), x, k1=2, k2=6, iters=iters
+    )
+    algbw = payload_bytes / seconds / 1e9
+    return CollectiveResult(
+        name="ppermute_ring",
+        payload_bytes=payload_bytes,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw,
+    )

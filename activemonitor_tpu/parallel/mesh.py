@@ -1,0 +1,57 @@
+"""Device mesh construction helpers.
+
+Probes run over a `jax.sharding.Mesh` — 1D ("ici") for collective
+bandwidth probes, 2D ("data", "model") for the sharded training-step
+probe. The same code runs on a real TPU slice or on a virtual CPU
+device set (``--xla_force_host_platform_device_count``), mirroring the
+reference's envtest strategy (SURVEY.md §4): data model real, hardware
+optional.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_info() -> dict:
+    """Inventory of visible devices (the devices-probe payload)."""
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform if devices else "none",
+        "device_kind": devices[0].device_kind if devices else "none",
+        "count": len(devices),
+        "process_count": jax.process_count(),
+        "local_count": jax.local_device_count(),
+    }
+
+
+def make_1d_mesh(axis: str = "ici", devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis,))
+
+
+def best_2d_shape(n: int) -> Tuple[int, int]:
+    """Most-square factorization of n, favoring a larger second (model)
+    axis so tensor-parallel collectives ride the shorter ICI hops."""
+    best = (1, n)
+    for a in range(1, int(np.sqrt(n)) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    return best
+
+
+def make_2d_mesh(
+    axes: Tuple[str, str] = ("data", "model"),
+    devices: Optional[Sequence] = None,
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = best_2d_shape(len(devices))
+    if shape[0] * shape[1] != len(devices):
+        raise ValueError(f"mesh shape {shape} does not fit {len(devices)} devices")
+    return Mesh(np.array(devices).reshape(shape), axes)

@@ -1,0 +1,115 @@
+"""Probe CLI — ``python -m activemonitor_tpu.probes <probe> [options]``.
+
+This is what workflow templates invoke (container command or script) in
+every engine; stdout's final line is the custom-metrics contract, the
+exit code is the verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m activemonitor_tpu.probes",
+        description="TPU health probe payloads",
+    )
+    sub = parser.add_subparsers(dest="probe", required=True)
+
+    p = sub.add_parser("devices", help="device inventory check")
+    p.add_argument("--expect", type=int, default=None, help="required device count")
+    p.add_argument(
+        "--require-platform", default="", help="required platform (e.g. tpu)"
+    )
+
+    p = sub.add_parser("ici-allreduce", help="ICI all-reduce bandwidth check")
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--threshold", type=float, default=0.9)
+    p.add_argument("--no-ring", action="store_true")
+
+    p = sub.add_parser("compile-smoke", help="XLA compile smoke test")
+    p.add_argument("--deadline", type=float, default=120.0)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--tiny", action="store_true")
+
+    p = sub.add_parser("training-step", help="sharded train-step probe")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--batch-per-device", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--steps", type=int, default=3)
+
+    p = sub.add_parser("hbm", help="HBM bandwidth check")
+    p.add_argument("--size-mb", type=float, default=256.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--threshold", type=float, default=0.6)
+    p.add_argument("--no-pallas", action="store_true")
+
+    p = sub.add_parser("matmul", help="MXU matmul throughput check")
+    p.add_argument("--dim", type=int, default=8192)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--threshold", type=float, default=0.75)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.probe == "devices":
+        from activemonitor_tpu.probes import devices
+
+        result = devices.run(
+            expect_devices=args.expect, require_platform=args.require_platform
+        )
+    elif args.probe == "ici-allreduce":
+        from activemonitor_tpu.probes import ici
+
+        result = ici.run(
+            size_mb=args.size_mb,
+            iters=args.iters,
+            threshold=args.threshold,
+            include_ring=not args.no_ring,
+        )
+    elif args.probe == "compile-smoke":
+        from activemonitor_tpu.probes import compile_smoke
+
+        result = compile_smoke.run(
+            compile_deadline_seconds=args.deadline,
+            batch=args.batch,
+            seq=args.seq,
+            tiny=args.tiny,
+        )
+    elif args.probe == "training-step":
+        from activemonitor_tpu.probes import training_step
+
+        result = training_step.run(
+            tiny=args.tiny,
+            batch_per_device=args.batch_per_device,
+            seq=args.seq,
+            steps=args.steps,
+        )
+    elif args.probe == "hbm":
+        from activemonitor_tpu.probes import hbm
+
+        result = hbm.run(
+            size_mb=args.size_mb,
+            iters=args.iters,
+            threshold=args.threshold,
+            use_pallas=not args.no_pallas,
+        )
+    elif args.probe == "matmul":
+        from activemonitor_tpu.probes import matmul
+
+        result = matmul.run(
+            dim=args.dim, iters=args.iters, threshold=args.threshold
+        )
+    else:  # pragma: no cover - argparse guards
+        raise SystemExit(2)
+    return result.emit()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""XLA compile smoke-test probe.
+
+Detects the stuck-compile failure mode (SURVEY.md §5.3 TPU detectors):
+jits the canonical probe transformer forward, wall-clocks cold compile
+and warm execution, and fails if compile exceeds its deadline. First
+TPU compiles legitimately take tens of seconds — the default threshold
+reflects that; persistent-cache hits make subsequent runs fast.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.models.probe_model import (
+    ProbeModelConfig,
+    forward,
+    init_params,
+    tiny_config,
+)
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+
+
+def run(
+    compile_deadline_seconds: float = 120.0,
+    batch: int = 4,
+    seq: int = 128,
+    tiny: bool = False,
+) -> ProbeResult:
+    cfg = tiny_config() if tiny else ProbeModelConfig()
+    seq = min(seq, cfg.max_seq_len)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+
+    fwd = jax.jit(lambda p, t: forward(p, t, cfg))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwd(params, tokens))
+    compile_seconds = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwd(params, tokens))
+    exec_seconds = time.perf_counter() - t0
+
+    ok = compile_seconds <= compile_deadline_seconds
+    return ProbeResult(
+        ok=ok,
+        summary=(
+            f"compile {compile_seconds:.2f}s (deadline {compile_deadline_seconds:.0f}s), "
+            f"exec {exec_seconds * 1e3:.2f}ms"
+        ),
+        metrics=[
+            ProbeMetric(
+                "xla-compile-seconds",
+                compile_seconds,
+                help="Cold jit compile wall-clock of the probe transformer forward",
+            ),
+            ProbeMetric(
+                "xla-exec-milliseconds",
+                exec_seconds * 1e3,
+                help="Warm execution wall-clock of the compiled forward",
+            ),
+        ],
+        details={
+            "batch": batch,
+            "seq": seq,
+            "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers,
+        },
+    )

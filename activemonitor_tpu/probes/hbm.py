@@ -1,0 +1,82 @@
+"""HBM bandwidth probe.
+
+Times a STREAM-scale pass (read + write = 2× payload bytes) and
+compares achieved GB/s against the chip's rated HBM bandwidth. Uses the
+Pallas kernel on TPU (ops/stream.py) and the fused XLA expression
+elsewhere (interpret-mode Pallas is functionally identical but not
+timeable).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.ops.stream import stream_scale_pallas, stream_scale_xla
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+
+def run(
+    size_mb: float = 256.0,
+    iters: int = 10,
+    threshold: float = 0.6,
+    use_pallas: bool = True,
+) -> ProbeResult:
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    dtype = jnp.bfloat16
+    cols = 1024
+    rows = max(512, int(size_mb * 1e6 / jnp.dtype(dtype).itemsize) // cols)
+    rows -= rows % 512
+    x = jnp.ones((rows, cols), dtype)
+    payload = rows * cols * jnp.dtype(dtype).itemsize
+
+    op = stream_scale_pallas if (on_tpu and use_pallas) else stream_scale_xla
+    # bf16 scale factor chosen representable so chained values stay finite
+    scale = 1.0078125
+
+    def make_chain(k):
+        @jax.jit
+        def chain(x):
+            for _ in range(k):  # data-dependent chain of full passes
+                x = op(x, scale)
+            # full reduction: a partial slice would let XLA dead-code
+            # the untouched elements of every pass in the chain
+            return x.astype(jnp.float32).sum()
+
+        return chain
+
+    # wide k spread: a single pass is sub-millisecond, so the delta must
+    # tower over tunnel/dispatch jitter
+    seconds = chain_delta_seconds(make_chain, x, k1=4, k2=28, iters=iters)
+    gbps = 2 * payload / seconds / 1e9  # read + write per pass
+
+    rated = rated_for(device.device_kind)
+    metrics = [
+        ProbeMetric("hbm-stream-gbps", gbps, help="Achieved STREAM-scale bandwidth, GB/s")
+    ]
+    details = {
+        "payload_mb": payload / 1e6,
+        "seconds_per_op": seconds,
+        "kernel": "pallas" if (on_tpu and use_pallas) else "xla",
+        "device_kind": device.device_kind,
+    }
+    ok = True
+    if rated is not None and on_tpu:
+        fraction = gbps / rated.hbm_gbps
+        metrics.append(
+            ProbeMetric(
+                "hbm-fraction-of-rated",
+                fraction,
+                help="Achieved / rated HBM bandwidth",
+            )
+        )
+        details["rated_gbps"] = rated.hbm_gbps
+        details["fraction"] = round(fraction, 3)
+        ok = fraction >= threshold
+        summary = f"HBM {gbps:.0f} GB/s = {fraction:.0%} of rated {rated.hbm_gbps:.0f} GB/s"
+    else:
+        summary = f"memory bandwidth {gbps:.1f} GB/s on {device.platform} (no rated comparison)"
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

@@ -1,0 +1,63 @@
+"""MXU matmul probe.
+
+Times a large bf16 matmul — the op the systolic array exists for — and
+compares achieved TFLOP/s against the chip's rated bf16 peak. A chip
+delivering well under rated peak on a clean 8k×8k×8k matmul is
+throttled, misconfigured, or sick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+
+def run(
+    dim: int = 8192,
+    iters: int = 10,
+    threshold: float = 0.75,
+) -> ProbeResult:
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if not on_tpu and dim > 2048:
+        dim = 1024  # keep CPU runs quick; no rated comparison there anyway
+    a = jax.random.normal(jax.random.key(0), (dim, dim), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (dim, dim), jnp.bfloat16)
+
+    def make_chain(k):
+        @jax.jit
+        def chain(a, b):
+            x = b
+            for _ in range(k):  # data-dependent: each feeds the next
+                x = jnp.dot(a, x, preferred_element_type=jnp.bfloat16)
+            return x.astype(jnp.float32).sum()
+
+        return chain
+
+    seconds = chain_delta_seconds(make_chain, a, b, k1=2, k2=8, iters=iters)
+    tflops = 2 * dim**3 / seconds / 1e12
+
+    rated = rated_for(device.device_kind)
+    metrics = [
+        ProbeMetric("mxu-matmul-tflops", tflops, help="Achieved bf16 matmul TFLOP/s")
+    ]
+    details = {"dim": dim, "seconds_per_op": seconds, "device_kind": device.device_kind}
+    ok = True
+    if rated is not None and on_tpu:
+        fraction = tflops / rated.bf16_tflops
+        metrics.append(
+            ProbeMetric(
+                "mxu-fraction-of-rated", fraction, help="Achieved / rated bf16 peak"
+            )
+        )
+        details["rated_tflops"] = rated.bf16_tflops
+        details["fraction"] = round(fraction, 3)
+        ok = fraction >= threshold
+        summary = f"matmul {tflops:.0f} TFLOP/s = {fraction:.0%} of rated {rated.bf16_tflops:.0f}"
+    else:
+        summary = f"matmul {tflops:.2f} TFLOP/s on {device.platform} (no rated comparison)"
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

@@ -1,0 +1,63 @@
+"""Rated hardware specs per TPU generation.
+
+Denominators for the "fraction of rated" gauges the probes export
+(BASELINE.md north star: ICI all-reduce ≥90 % of rated on a v5e-8).
+Figures are the public per-chip numbers (cf. the "How to Scale Your
+Model" rooflines); every value can be overridden via environment
+variables for new silicon or corrected ratings:
+
+    ACTIVEMONITOR_RATED_BF16_TFLOPS
+    ACTIVEMONITOR_RATED_HBM_GBPS
+    ACTIVEMONITOR_RATED_ICI_GBPS   (per-link, one direction)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RatedSpec:
+    generation: str
+    bf16_tflops: float  # peak dense bf16 matmul TFLOP/s per chip
+    hbm_gbps: float  # HBM bandwidth GB/s per chip
+    ici_unidir_gbps: float  # ICI bandwidth per link, one direction, GB/s
+    ici_links: int  # ICI links per chip
+
+
+# device_kind substrings -> rated spec
+_RATED = [
+    ("v6", RatedSpec("v6e", bf16_tflops=918.0, hbm_gbps=1640.0, ici_unidir_gbps=90.0, ici_links=4)),
+    ("v5p", RatedSpec("v5p", bf16_tflops=459.0, hbm_gbps=2765.0, ici_unidir_gbps=90.0, ici_links=6)),
+    ("v5 lite", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4)),
+    ("v5e", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4)),
+    ("v4", RatedSpec("v4", bf16_tflops=275.0, hbm_gbps=1228.0, ici_unidir_gbps=45.0, ici_links=6)),
+]
+
+
+def _override(value: float, env: str) -> float:
+    raw = os.environ.get(env)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return value
+
+
+def rated_for(device_kind: str) -> Optional[RatedSpec]:
+    """Spec for a jax device_kind string (e.g. "TPU v5 lite"), or None
+    for unknown/non-TPU hardware."""
+    kind = device_kind.lower()
+    for needle, spec in _RATED:
+        if needle in kind:
+            return RatedSpec(
+                generation=spec.generation,
+                bf16_tflops=_override(spec.bf16_tflops, "ACTIVEMONITOR_RATED_BF16_TFLOPS"),
+                hbm_gbps=_override(spec.hbm_gbps, "ACTIVEMONITOR_RATED_HBM_GBPS"),
+                ici_unidir_gbps=_override(spec.ici_unidir_gbps, "ACTIVEMONITOR_RATED_ICI_GBPS"),
+                ici_links=spec.ici_links,
+            )
+    return None

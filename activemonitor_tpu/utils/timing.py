@@ -1,0 +1,65 @@
+"""Device timing that survives remote-tunneled TPUs.
+
+Two hazards in timing XLA work (SURVEY.md §7 hard part (d)):
+
+1. compile time — handled by warmup before measurement;
+2. dispatch/transport overhead — on tunneled devices (e.g. a TPU behind
+   a network PJRT proxy) ``block_until_ready`` can return before the
+   device finishes and every host sync costs a network roundtrip that
+   dwarfs the op (observed ~70 ms vs a ~6 ms matmul).
+
+The fix for both: force a scalar host readback (a transfer cannot lie)
+and measure the *difference* between a chain of k ops and a chain of 2k
+ops — constant overhead cancels, leaving pure device time per op.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+
+def median_readback_seconds(fn: Callable, *args, iters: int = 5, warmup: int = 2) -> float:
+    """Median wall-clock of fn(*args) forced through a scalar readback.
+    ``fn`` must return something float()-able (a scalar array)."""
+    return _readback_samples(fn, *args, iters=iters, warmup=warmup)[iters // 2]
+
+
+def min_readback_seconds(fn: Callable, *args, iters: int = 5, warmup: int = 2) -> float:
+    """Minimum wall-clock — the cleanest estimate of true device time
+    under one-sided noise (network jitter only ever adds)."""
+    return _readback_samples(fn, *args, iters=iters, warmup=warmup)[0]
+
+
+def _readback_samples(fn: Callable, *args, iters: int, warmup: int) -> list:
+    import time
+
+    for _ in range(warmup):
+        float(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples
+
+
+def chain_delta_seconds(
+    make_chain: Callable[[int], Callable],
+    *args,
+    k1: int = 4,
+    k2: int = 12,
+    iters: int = 5,
+) -> float:
+    """Per-op device seconds via the difference method.
+
+    ``make_chain(k)`` must return a jitted callable running k
+    *data-dependent* repetitions of the op and returning a scalar.
+    Data dependence matters: independent ops get overlapped or CSE'd by
+    XLA and the difference collapses to zero.
+    """
+    t1 = min_readback_seconds(make_chain(k1), *args, iters=iters)
+    t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
+    return max((t2 - t1) / (k2 - k1), 1e-9)

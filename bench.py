@@ -1,0 +1,86 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Adaptive to the hardware it lands on (BASELINE.md):
+
+- multi-chip TPU: the north-star ICI all-reduce probe — fraction of
+  rated ring bandwidth (target ≥ 0.9).
+- single-chip TPU: the MXU matmul probe — fraction of rated bf16 peak
+  (the per-chip floor under every distributed target).
+- CPU (virtual mesh): informational all-reduce GB/s.
+
+``vs_baseline`` is measured / target-fraction (0.9): ≥1.0 beats the
+BASELINE.md bar. All timing uses the chain-difference method so tunnel
+and dispatch overhead cancel (utils/timing.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    import jax
+
+    devices = jax.devices()
+    n = len(devices)
+    platform = devices[0].platform
+    target_fraction = 0.9
+
+    if platform == "tpu" and n > 1:
+        from activemonitor_tpu.probes import ici
+
+        result = ici.run(size_mb=64, iters=5, threshold=target_fraction)
+        by_name = {m.name: m.value for m in result.metrics}
+        fraction = by_name.get("ici-allreduce-fraction-of-rated")
+        if fraction is not None:
+            doc = {
+                "metric": "ici_allreduce_fraction_of_rated",
+                "value": round(fraction, 4),
+                "unit": "fraction",
+                "vs_baseline": round(fraction / target_fraction, 4),
+            }
+        else:
+            doc = {
+                "metric": "ici_allreduce_busbw",
+                "value": round(by_name["ici-allreduce-busbw-gbps"], 2),
+                "unit": "GB/s",
+                "vs_baseline": 1.0,
+            }
+    elif platform == "tpu":
+        from activemonitor_tpu.probes import matmul
+
+        result = matmul.run(iters=5, threshold=target_fraction)
+        by_name = {m.name: m.value for m in result.metrics}
+        fraction = by_name.get("mxu-fraction-of-rated")
+        if fraction is not None:
+            doc = {
+                "metric": "mxu_bf16_fraction_of_rated",
+                "value": round(fraction, 4),
+                "unit": "fraction",
+                "vs_baseline": round(fraction / target_fraction, 4),
+            }
+        else:
+            doc = {
+                "metric": "mxu_bf16_tflops",
+                "value": round(by_name["mxu-matmul-tflops"], 2),
+                "unit": "TFLOP/s",
+                "vs_baseline": 1.0,
+            }
+    else:
+        from activemonitor_tpu.probes import ici
+
+        result = ici.run(size_mb=8, iters=3)
+        by_name = {m.name: m.value for m in result.metrics}
+        doc = {
+            "metric": "allreduce_busbw_cpu_mesh",
+            "value": round(by_name["ici-allreduce-busbw-gbps"], 2),
+            "unit": "GB/s",
+            "vs_baseline": 1.0,
+        }
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

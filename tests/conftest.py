@@ -10,12 +10,21 @@ import sys
 from pathlib import Path
 
 # Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Tests run on the virtual 8-device CPU platform by default — the env
+# may carry JAX_PLATFORMS pointing at real/tunneled TPU hardware (e.g.
+# "axon"), and the config API outranks it. Opt into hardware tests
+# explicitly with ACTIVEMONITOR_TEST_TPU=1.
+if os.environ.get("ACTIVEMONITOR_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 

@@ -1,0 +1,224 @@
+"""Probe library tests on the virtual 8-device CPU mesh (the
+CRD-without-controller trick applied to hardware: SURVEY.md §4)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from activemonitor_tpu.models.probe_model import (
+    forward,
+    init_params,
+    loss_fn,
+    param_count,
+    param_specs,
+    tiny_config,
+)
+from activemonitor_tpu.parallel import (
+    all_gather_bandwidth,
+    all_reduce_bandwidth,
+    best_2d_shape,
+    make_1d_mesh,
+    make_2d_mesh,
+    ppermute_ring_bandwidth,
+)
+from activemonitor_tpu.probes import devices as devices_probe
+from activemonitor_tpu.probes import ici as ici_probe
+from activemonitor_tpu.probes import compile_smoke, training_step
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+from activemonitor_tpu.ops.stream import stream_scale_pallas, stream_scale_xla
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_mesh_shapes():
+    assert best_2d_shape(8) == (2, 4)
+    assert best_2d_shape(16) == (4, 4)
+    assert best_2d_shape(7) == (1, 7)
+    assert make_1d_mesh().devices.size == 8
+    assert dict(make_2d_mesh().shape) == {"data": 2, "model": 4}
+    with pytest.raises(ValueError):
+        make_2d_mesh(shape=(3, 2))
+
+
+def test_collectives_run_and_report():
+    mesh = make_1d_mesh()
+    r = all_reduce_bandwidth(mesh, size_mb=1, iters=2)
+    assert r.n_devices == 8
+    assert r.algbw_gbps > 0
+    assert r.busbw_gbps == pytest.approx(r.algbw_gbps * 2 * 7 / 8)
+    g = all_gather_bandwidth(mesh, size_mb=0.5, iters=2)
+    assert g.busbw_gbps > 0
+    p = ppermute_ring_bandwidth(mesh, size_mb=0.5, iters=2)
+    assert p.algbw_gbps > 0
+
+
+def test_collective_correctness():
+    """The timing chain must still compute a correct mean-all-reduce."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_1d_mesh()
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh, in_specs=P("ici"), out_specs=P("ici"), check_vma=False
+    )
+    def mean_allreduce(x):
+        return jax.lax.psum(x, "ici") / 8
+
+    x = jnp.arange(16.0)
+    out = mean_allreduce(x)
+    assert out.shape == x.shape
+    # shard i holds [2i, 2i+1]; the mean over shards replicates to every shard
+    shard_means = x.reshape(8, 2).mean(axis=0)
+    assert jnp.allclose(out, jnp.tile(shard_means, 8))
+
+
+def test_devices_probe_pass_and_fail():
+    ok = devices_probe.run(expect_devices=8)
+    assert ok.ok
+    bad = devices_probe.run(expect_devices=9)
+    assert not bad.ok
+    assert "expected 9" in bad.summary
+    plat = devices_probe.run(require_platform="tpu")
+    assert not plat.ok  # cpu test platform
+
+
+def test_ici_probe_on_cpu_mesh():
+    r = ici_probe.run(size_mb=1, iters=2)
+    assert r.ok  # no rated comparison on cpu -> informational pass
+    names = [m.name for m in r.metrics]
+    assert "ici-allreduce-busbw-gbps" in names
+    assert "ici-ring-hop-gbps" in names
+    assert "ici-allreduce-fraction-of-rated" not in names  # unknown hardware
+
+
+def test_compile_smoke_probe():
+    r = compile_smoke.run(tiny=True, batch=2, seq=16)
+    assert r.ok
+    names = {m.name for m in r.metrics}
+    assert names == {"xla-compile-seconds", "xla-exec-milliseconds"}
+
+
+def test_training_step_probe_tiny():
+    r = training_step.run(tiny=True, batch_per_device=2, seq=16, steps=2)
+    assert r.ok
+    assert r.details["mesh"] == {"data": 2, "model": 4}
+    by_name = {m.name: m.value for m in r.metrics}
+    assert by_name["train-tokens-per-second"] > 0
+    # finite, sane loss for random data over 256 vocab (~ln 256 ≈ 5.5)
+    assert 0 < r.details["loss_last"] < 10
+
+
+def test_probe_contract_line_parses():
+    r = ProbeResult(
+        ok=True,
+        summary="x",
+        metrics=[ProbeMetric("ici-bw-gbps", 123.4, help="h")],
+    )
+    doc = json.loads(r.contract_line())
+    assert doc["metrics"][0]["name"] == "ici-bw-gbps"
+    assert doc["metrics"][0]["value"] == 123.4
+    assert doc["metrics"][0]["metrictype"] == "gauge"
+
+
+def test_rated_table():
+    v5e = rated_for("TPU v5 lite")
+    assert v5e is not None and v5e.generation == "v5e"
+    assert v5e.bf16_tflops == 197.0
+    assert rated_for("TPU v4") is not None
+    assert rated_for("cpu") is None
+    assert rated_for("NVIDIA H100") is None
+
+
+def test_rated_env_override(monkeypatch):
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_ICI_GBPS", "100")
+    assert rated_for("TPU v5 lite").ici_unidir_gbps == 100.0
+
+
+# -- model -------------------------------------------------------------
+
+
+def test_probe_model_forward_shapes():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert jnp.isfinite(logits).all()
+
+
+def test_probe_model_param_count_matches_tree():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == param_count(cfg)
+
+
+def test_param_specs_tree_matches_params():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    specs = param_specs(cfg)
+    from jax.sharding import PartitionSpec as P
+
+    jax.tree.map(
+        lambda p, s: None, params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )  # raises if structures mismatch
+
+
+def test_loss_decreases_under_sgd():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 17), 0, cfg.vocab_size)
+    grad_fn = jax.jit(jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg)))
+    loss0, grads = grad_fn(params)
+    for _ in range(5):
+        loss, grads = grad_fn(params)
+        params = jax.tree.map(lambda p, g: p - 0.5 * g, params, grads)
+    loss_end, _ = grad_fn(params)
+    assert float(loss_end) < float(loss0)
+
+
+# -- ops ---------------------------------------------------------------
+
+
+def test_pallas_stream_matches_xla():
+    x = jax.random.normal(jax.random.key(0), (1024, 1024), jnp.float32)
+    got = stream_scale_pallas(x, 2.0, block_rows=512)
+    want = stream_scale_xla(x, 2.0)
+    assert jnp.allclose(got, want)
+
+
+def test_pallas_stream_rejects_ragged_blocks():
+    x = jnp.ones((1000, 1024), jnp.float32)
+    with pytest.raises(ValueError):
+        stream_scale_pallas(x, 2.0, block_rows=512)
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_cli_devices(capsys):
+    from activemonitor_tpu.probes.cli import main
+
+    rc = main(["devices", "--expect", "8"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = json.loads(out)
+    assert doc["metrics"][0]["value"] == 8.0
+
+
+def test_cli_failure_exit_code(capsys):
+    from activemonitor_tpu.probes.cli import main
+
+    rc = main(["devices", "--expect", "3"])
+    assert rc == 1
