@@ -1,0 +1,245 @@
+"""Controller CLI — ``python -m activemonitor_tpu <command>``.
+
+``run`` mirrors the reference's process flags (reference:
+cmd/main.go:138-144 — metrics-bind-address :8443,
+health-probe-bind-address :8081, leader-elect off, max-workers 10) and
+adds the engine/store selection this framework's local mode needs.
+``apply``/``get``/``delete`` give the kubectl-equivalent UX against the
+file-backed store; ``crd`` prints the generated CRD manifest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="activemonitor_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run the controller")
+    run.add_argument(
+        "--metrics-bind-address",
+        default=":8443",
+        help="metrics endpoint address ('0' to disable)",
+    )
+    run.add_argument(
+        "--health-probe-bind-address",
+        default=":8081",
+        help="health/readiness probe address ('0' to disable)",
+    )
+    run.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="enable leader election for multi-replica HA",
+    )
+    run.add_argument(
+        "--max-workers",
+        type=int,
+        default=10,
+        help="maximum concurrent reconciles",
+    )
+    run.add_argument(
+        "--engine",
+        choices=["local", "argo"],
+        default="local",
+        help="workflow execution backend",
+    )
+    run.add_argument(
+        "--client",
+        choices=["file", "k8s"],
+        default=None,
+        help="HealthCheck store: file directory or the Kubernetes API "
+        "(default: k8s when --engine=argo, else file)",
+    )
+    run.add_argument(
+        "--store",
+        default="./healthchecks",
+        help="directory of HealthCheck YAML specs (file-backed store)",
+    )
+    run.add_argument(
+        "-f",
+        "--filename",
+        action="append",
+        default=[],
+        help="HealthCheck manifest(s) to apply at startup",
+    )
+    run.add_argument("--log-level", default="INFO")
+
+    for name, help_text in [
+        ("apply", "apply a HealthCheck manifest to the store"),
+        ("delete", "delete a HealthCheck from the store"),
+    ]:
+        p = sub.add_parser(name, help=help_text)
+        p.add_argument("--store", default="./healthchecks")
+        if name == "apply":
+            p.add_argument("-f", "--filename", required=True)
+        else:
+            p.add_argument("name")
+            p.add_argument("--namespace", "-n", default="default")
+
+    get = sub.add_parser("get", help="list HealthChecks (kubectl get hc)")
+    get.add_argument("resource", nargs="?", default="hc", choices=["hc", "hcs", "healthchecks", "healthcheck"])
+    get.add_argument("--store", default="./healthchecks")
+    get.add_argument("--namespace", "-n", default=None)
+
+    sub.add_parser("crd", help="print the HealthCheck CRD manifest")
+    sub.add_parser("version", help="print version")
+    return parser
+
+
+async def _run(args) -> int:
+    logging.basicConfig(
+        level=args.log_level.upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from activemonitor_tpu.api.types import HealthCheck
+    from activemonitor_tpu.controller.events import EventRecorder
+    from activemonitor_tpu.controller.leader import AlwaysLeader, FileLeaderElector
+    from activemonitor_tpu.controller.manager import Manager
+    from activemonitor_tpu.controller.rbac import InMemoryRBACBackend, RBACProvisioner
+    from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+    from activemonitor_tpu.metrics.collector import MetricsCollector
+
+    client_kind = args.client or ("k8s" if args.engine == "argo" else "file")
+    if client_kind == "k8s":
+        from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+
+        client = KubernetesHealthCheckClient()
+    else:
+        from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+
+        client = FileHealthCheckClient(args.store)
+    if args.engine == "argo":
+        from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
+
+        engine = ArgoWorkflowEngine()
+    else:
+        from activemonitor_tpu.engine.local import LocalProcessEngine
+
+        engine = LocalProcessEngine()
+
+    if args.leader_elect:
+        if client_kind == "k8s":
+            from activemonitor_tpu.controller.leader import KubernetesLeaseElector
+
+            elector = KubernetesLeaseElector()
+        else:
+            # flock is per-host: only meaningful for co-hosted replicas
+            elector = FileLeaderElector()
+    else:
+        elector = AlwaysLeader()
+
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+    )
+    for path in args.filename:
+        with open(path) as f:
+            await client.apply(HealthCheck.from_yaml(f.read()))
+
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        max_parallel=args.max_workers,
+        metrics_bind_address=(
+            "" if args.metrics_bind_address == "0" else args.metrics_bind_address
+        ),
+        health_probe_bind_address=(
+            ""
+            if args.health_probe_bind_address == "0"
+            else args.health_probe_bind_address
+        ),
+        leader_elector=elector,
+    )
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await manager.start()
+    logging.getLogger("activemonitor").info(
+        "controller running: store=%s engine=%s workers=%d",
+        args.store,
+        args.engine,
+        args.max_workers,
+    )
+    await stop.wait()
+    await manager.stop()
+    return 0
+
+
+async def _apply(args) -> int:
+    from activemonitor_tpu.api.types import HealthCheck
+    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+
+    client = FileHealthCheckClient(args.store)
+    with open(args.filename) as f:
+        hc = await client.apply(HealthCheck.from_yaml(f.read()))
+    print(f"healthcheck.{hc.api_version.split('/')[0]}/{hc.metadata.name} applied")
+    return 0
+
+
+async def _delete(args) -> int:
+    from activemonitor_tpu.controller.client import NotFoundError
+    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+
+    client = FileHealthCheckClient(args.store)
+    try:
+        await client.delete(args.namespace, args.name)
+    except NotFoundError:
+        print(f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr)
+        return 1
+    print(f"healthcheck {args.namespace}/{args.name} deleted")
+    return 0
+
+
+async def _get(args) -> int:
+    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+
+    client = FileHealthCheckClient(args.store)
+    rows = [hc.printer_row() for hc in await client.list(args.namespace)]
+    if not rows:
+        print("No resources found.")
+        return 0
+    headers = list(rows[0].keys())
+    widths = [
+        max(len(str(h)), *(len(str(r[h])) for r in rows)) for h in headers
+    ]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for r in rows:
+        print("  ".join(str(r[h]).ljust(w) for h, w in zip(headers, widths)))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        from activemonitor_tpu import __version__
+
+        print(__version__)
+        return 0
+    if args.command == "crd":
+        from activemonitor_tpu.api.crd import crd_yaml
+
+        print(crd_yaml(), end="")
+        return 0
+    handler = {
+        "run": _run,
+        "apply": _apply,
+        "delete": _delete,
+        "get": _get,
+    }[args.command]
+    return asyncio.run(handler(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

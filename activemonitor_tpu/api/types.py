@@ -95,6 +95,21 @@ class ResourceObject(_Base):
     source: ArtifactLocation = Field(default_factory=ArtifactLocation)
 
 
+class TPUPlacement(_Base):
+    """TPU slice placement for the probe workload (extension; no
+    counterpart in the reference — SURVEY.md §7.7: the controller
+    injects TPU node selectors the way podGC is injected today).
+
+    Maps onto the GKE TPU scheduling contract: nodeSelector
+    ``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology`` and
+    the ``google.com/tpu`` chip resource on probe containers.
+    """
+
+    accelerator: str = ""  # e.g. "tpu-v5-lite-podslice"
+    topology: str = ""  # e.g. "2x4"
+    chips: int = 0  # google.com/tpu resource per probe pod
+
+
 class Workflow(_Base):
     """Describes the probe workflow (reference: healthcheck_types.go:109-114)."""
 
@@ -102,6 +117,7 @@ class Workflow(_Base):
     resource: Optional[ResourceObject] = None
     timeout: int = Field(default=0, alias="workflowtimeout")
     rbac_rules: List[PolicyRule] = Field(default_factory=list, alias="rbacRules")
+    tpu: Optional[TPUPlacement] = None
 
 
 class RemedyWorkflow(Workflow):

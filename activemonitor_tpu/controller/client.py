@@ -54,7 +54,10 @@ class HealthCheckClient(Protocol):
 
     async def delete(self, namespace: str, name: str) -> None: ...
 
-    def watch(self) -> AsyncIterator[WatchEvent]: ...
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """MUST register/baseline synchronously at call time; the manager
+        calls watch() before its boot-resync list so nothing is lost."""
+        ...
 
 
 async def retry_on_conflict(fn, *, attempts: int = 5, base_delay: float = 0.01):
@@ -155,11 +158,19 @@ class InMemoryHealthCheckClient:
         for q in self._watchers:
             q.put_nowait(ev)
 
-    async def watch(self) -> AsyncIterator[WatchEvent]:
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """Registers the subscription SYNCHRONOUSLY (at call time, not at
+        first iteration) so no event can fall between creating the watch
+        and a subsequent list — the list-then-watch ordering the manager
+        relies on."""
         q: asyncio.Queue = asyncio.Queue()
         self._watchers.append(q)
-        try:
-            while True:
-                yield await q.get()
-        finally:
-            self._watchers.remove(q)
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                self._watchers.remove(q)
+
+        return gen()

@@ -1,0 +1,193 @@
+"""Kubernetes-backed HealthCheck client — cluster mode.
+
+Watches HealthCheck CRs through the API server exactly as the reference
+controller does (reference: cached client + status subresource writes,
+healthcheck_controller.go:175,208-215,1445-1462). Import of the
+``kubernetes`` package is deferred to construction so the rest of the
+framework works where it isn't installed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import AsyncIterator, List, Optional
+
+from activemonitor_tpu import GROUP, VERSION
+from activemonitor_tpu.api.types import HealthCheck
+from activemonitor_tpu.controller.client import (
+    ConflictError,
+    NotFoundError,
+    WatchEvent,
+)
+
+log = logging.getLogger(__name__)
+
+PLURAL = "healthchecks"
+
+
+class KubernetesHealthCheckClient:
+    def __init__(self, api_client=None):  # pragma: no cover - needs a cluster
+        try:
+            from kubernetes import client, config  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "the 'kubernetes' package is required for cluster mode; "
+                "use the file-backed store (--client file) instead"
+            ) from e
+        if api_client is None:
+            try:
+                config.load_incluster_config()
+            except Exception:
+                config.load_kube_config()
+        self._api = client.CustomObjectsApi(api_client)
+
+    async def get(self, namespace: str, name: str) -> Optional[HealthCheck]:
+        from kubernetes.client.rest import ApiException  # type: ignore
+
+        try:
+            obj = await asyncio.to_thread(
+                self._api.get_namespaced_custom_object,
+                GROUP,
+                VERSION,
+                namespace,
+                PLURAL,
+                name,
+            )
+        except ApiException as e:
+            if e.status == 404:
+                return None
+            raise
+        return HealthCheck.from_dict(obj)
+
+    async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]:
+        if namespace:
+            raw = await asyncio.to_thread(
+                self._api.list_namespaced_custom_object,
+                GROUP,
+                VERSION,
+                namespace,
+                PLURAL,
+            )
+        else:
+            raw = await asyncio.to_thread(
+                self._api.list_cluster_custom_object, GROUP, VERSION, PLURAL
+            )
+        return [HealthCheck.from_dict(item) for item in raw.get("items", [])]
+
+    async def apply(self, hc: HealthCheck) -> HealthCheck:
+        from kubernetes.client.rest import ApiException  # type: ignore
+
+        body = hc.to_dict()
+        body.pop("status", None)
+        try:
+            created = await asyncio.to_thread(
+                self._api.create_namespaced_custom_object,
+                GROUP,
+                VERSION,
+                hc.metadata.namespace,
+                PLURAL,
+                body,
+            )
+        except ApiException as e:
+            if e.status != 409:
+                raise
+            created = await asyncio.to_thread(
+                self._api.patch_namespaced_custom_object,
+                GROUP,
+                VERSION,
+                hc.metadata.namespace,
+                PLURAL,
+                hc.metadata.name,
+                {"spec": body.get("spec", {})},
+            )
+        return HealthCheck.from_dict(created)
+
+    async def update_status(self, hc: HealthCheck) -> HealthCheck:
+        from kubernetes.client.rest import ApiException  # type: ignore
+
+        body = {
+            "metadata": {"resourceVersion": hc.metadata.resource_version or None},
+            "status": hc.status.to_json_dict(),
+        }
+        try:
+            updated = await asyncio.to_thread(
+                self._api.patch_namespaced_custom_object_status,
+                GROUP,
+                VERSION,
+                hc.metadata.namespace,
+                PLURAL,
+                hc.metadata.name,
+                body,
+            )
+        except ApiException as e:
+            if e.status == 409:
+                raise ConflictError(hc.key) from e
+            if e.status == 404:
+                raise NotFoundError(hc.key) from e
+            raise
+        return HealthCheck.from_dict(updated)
+
+    async def delete(self, namespace: str, name: str) -> None:
+        from kubernetes.client.rest import ApiException  # type: ignore
+
+        try:
+            await asyncio.to_thread(
+                self._api.delete_namespaced_custom_object,
+                GROUP,
+                VERSION,
+                namespace,
+                PLURAL,
+                name,
+            )
+        except ApiException as e:
+            if e.status == 404:
+                raise NotFoundError(f"{namespace}/{name}") from e
+            raise
+
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """API-server watch pumped from a thread into an asyncio queue.
+        The stream (and its registration) starts at call time."""
+        from kubernetes import watch as k8s_watch  # type: ignore
+
+        loop = asyncio.get_event_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        stop = threading.Event()
+
+        def pump() -> None:
+            while not stop.is_set():
+                w = k8s_watch.Watch()
+                try:
+                    for event in w.stream(
+                        self._api.list_cluster_custom_object,
+                        GROUP,
+                        VERSION,
+                        PLURAL,
+                        timeout_seconds=300,
+                    ):
+                        obj = event.get("object", {})
+                        meta = obj.get("metadata", {})
+                        loop.call_soon_threadsafe(
+                            queue.put_nowait,
+                            WatchEvent(
+                                type=event.get("type", "MODIFIED"),
+                                namespace=meta.get("namespace", ""),
+                                name=meta.get("name", ""),
+                            ),
+                        )
+                except Exception:
+                    log.exception("watch stream broke; re-establishing")
+                    stop.wait(1.0)
+
+        thread = threading.Thread(target=pump, daemon=True, name="hc-watch")
+        thread.start()
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            try:
+                while True:
+                    yield await queue.get()
+            finally:
+                stop.set()
+
+        return gen()

@@ -1,0 +1,147 @@
+"""Leader election — multi-replica controller safety.
+
+The reference gets HA via controller-runtime's Lease-based leader
+election (reference: cmd/main.go:87-88, election ID
+"689451f8.keikoproj.io"). Equivalents here:
+
+- :class:`FileLeaderElector` — flock-based, for multiple controller
+  processes sharing a host/volume (the local deployment mode).
+- :class:`KubernetesLeaseElector` — coordination.k8s.io/v1 Lease
+  objects with renewal/takeover timing, import-gated on ``kubernetes``.
+- :class:`AlwaysLeader` — single-replica default (election off, like
+  the reference's default ``--leader-elect=false``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Protocol
+
+ELECTION_ID = "689451f8.keikoproj.io"  # parity with the reference
+
+
+class LeaderElector(Protocol):
+    async def acquire(self) -> None:
+        """Blocks until this process holds leadership."""
+        ...
+
+    def release(self) -> None: ...
+
+
+class AlwaysLeader:
+    async def acquire(self) -> None:
+        return None
+
+    def release(self) -> None:
+        return None
+
+
+class FileLeaderElector:
+    """flock-based election for co-hosted replicas."""
+
+    def __init__(self, path: str = "", poll_seconds: float = 1.0):
+        self._path = path or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"activemonitor-{ELECTION_ID}.lock"
+        )
+        self._poll = poll_seconds
+        self._fd = None
+
+    async def acquire(self) -> None:
+        import fcntl
+
+        self._fd = open(self._path, "w")
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fd.write(str(os.getpid()))
+                self._fd.flush()
+                return
+            except BlockingIOError:
+                await asyncio.sleep(self._poll)
+
+    def release(self) -> None:
+        if self._fd is not None:
+            import fcntl
+
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            finally:
+                self._fd.close()
+                self._fd = None
+
+
+class KubernetesLeaseElector:  # pragma: no cover - needs a cluster
+    """coordination.k8s.io Lease election (import-gated)."""
+
+    def __init__(
+        self,
+        namespace: str = "health",
+        name: str = ELECTION_ID,
+        identity: str = "",
+        lease_seconds: int = 15,
+    ):
+        try:
+            from kubernetes import client  # type: ignore  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "the 'kubernetes' package is required for KubernetesLeaseElector"
+            ) from e
+        import socket
+        import uuid
+
+        self._namespace = namespace
+        self._name = name
+        self._identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self._lease_seconds = lease_seconds
+        self._stop = False
+
+    async def acquire(self) -> None:
+        import datetime
+
+        from kubernetes import client  # type: ignore
+        from kubernetes.client.rest import ApiException  # type: ignore
+
+        api = client.CoordinationV1Api()
+        while not self._stop:
+            now = datetime.datetime.now(datetime.timezone.utc)
+            body = client.V1Lease(
+                metadata=client.V1ObjectMeta(name=self._name, namespace=self._namespace),
+                spec=client.V1LeaseSpec(
+                    holder_identity=self._identity,
+                    lease_duration_seconds=self._lease_seconds,
+                    renew_time=now,
+                ),
+            )
+            try:
+                existing = await asyncio.to_thread(
+                    api.read_namespaced_lease, self._name, self._namespace
+                )
+                holder = existing.spec.holder_identity
+                renew = existing.spec.renew_time
+                expired = (
+                    renew is None
+                    or (now - renew).total_seconds() > self._lease_seconds
+                )
+                if holder == self._identity or expired:
+                    existing.spec = body.spec
+                    await asyncio.to_thread(
+                        api.replace_namespaced_lease,
+                        self._name,
+                        self._namespace,
+                        existing,
+                    )
+                    return
+            except ApiException as e:
+                if e.status == 404:
+                    try:
+                        await asyncio.to_thread(
+                            api.create_namespaced_lease, self._namespace, body
+                        )
+                        return
+                    except ApiException:
+                        pass
+            await asyncio.sleep(self._lease_seconds / 3)
+
+    def release(self) -> None:
+        self._stop = True

@@ -1,0 +1,199 @@
+"""Controller manager — the process shell around the reconciler.
+
+The reference's manager (reference: cmd/main.go:68-133) provides: watch
+→ workqueue → bounded concurrent reconciles, leader election, metrics
+server with optional auth, health/readiness probes. Equivalent here:
+
+- watch events from the client feed an asyncio queue; ``max_parallel``
+  workers drain it (reference: MaxConcurrentReconciles,
+  healthcheck_controller.go:298 / cmd/main.go:144 default 10)
+- keys are deduplicated while queued (a queued key absorbs new events,
+  like controller-runtime's workqueue)
+- on start, all existing HealthChecks are enqueued (boot resync — the
+  checkpoint/resume path, SURVEY.md §5.4)
+- an aiohttp server exposes /metrics, /healthz, /readyz
+  (reference: cmd/main.go:74-81,121-126)
+- leadership is acquired before reconciling (reference: cmd/main.go:87-88)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Set
+
+from activemonitor_tpu.controller.client import HealthCheckClient
+from activemonitor_tpu.controller.leader import AlwaysLeader, LeaderElector
+from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+
+log = logging.getLogger("activemonitor.manager")
+
+DEFAULT_MAX_PARALLEL = 10  # reference: cmd/main.go:144
+
+
+class Manager:
+    def __init__(
+        self,
+        client: HealthCheckClient,
+        reconciler: HealthCheckReconciler,
+        max_parallel: int = DEFAULT_MAX_PARALLEL,
+        metrics_bind_address: str = "",  # "host:port" or "" to disable
+        health_probe_bind_address: str = "",
+        leader_elector: Optional[LeaderElector] = None,
+    ):
+        self.client = client
+        self.reconciler = reconciler
+        self.max_parallel = max_parallel
+        self._metrics_addr = metrics_bind_address
+        self._health_addr = health_probe_bind_address
+        self._elector = leader_elector or AlwaysLeader()
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queued: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._dirty: Set[str] = set()
+        self._ready = asyncio.Event()
+        self._stopping = asyncio.Event()
+        self._tasks: list = []
+        self._requeue_tasks: Set[asyncio.Task] = set()
+        self._http_runners: list = []
+
+    # -- queue ----------------------------------------------------------
+    # controller-runtime workqueue semantics: a queued key coalesces new
+    # events; a key being PROCESSED is marked dirty and re-queued after
+    # its reconcile finishes, so one key never reconciles concurrently.
+    def enqueue(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        if key in self._processing:
+            self._dirty.add(key)
+            return
+        if key in self._queued:
+            return  # coalesce: already pending
+        self._queued.add(key)
+        self._queue.put_nowait((namespace, name))
+
+    async def _watch_loop(self, iterator) -> None:
+        async for event in iterator:
+            self.enqueue(event.namespace, event.name)
+
+    async def _worker(self, index: int) -> None:
+        while True:
+            namespace, name = await self._queue.get()
+            key = f"{namespace}/{name}"
+            self._queued.discard(key)
+            self._processing.add(key)
+            try:
+                requeue_after = await self.reconciler.reconcile(namespace, name)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("reconcile %s/%s crashed", namespace, name)
+                requeue_after = 1.0
+            finally:
+                self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                self.enqueue(namespace, name)
+            if requeue_after:
+                task = asyncio.create_task(
+                    self._requeue_later(namespace, name, requeue_after)
+                )
+                # hold a strong reference: the loop keeps only a weakref
+                # and an unreferenced sleeper can be GC'd before firing
+                self._requeue_tasks.add(task)
+                task.add_done_callback(self._requeue_tasks.discard)
+            self._queue.task_done()
+
+    async def _requeue_later(self, namespace: str, name: str, delay: float) -> None:
+        await self.reconciler.clock.sleep(delay)
+        if not self._stopping.is_set():
+            self.enqueue(namespace, name)
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        """Acquire leadership, start HTTP endpoints, resync, serve."""
+        await self._start_http()
+        log.info("waiting for leadership (%s)", type(self._elector).__name__)
+        await self._elector.acquire()
+        log.info("leadership acquired; starting %d workers", self.max_parallel)
+
+        # watch FIRST (registration is synchronous in client.watch()),
+        # resync list second: events between the two are never lost
+        watch_iterator = self.client.watch()
+        self._tasks.append(asyncio.create_task(self._watch_loop(watch_iterator)))
+        for i in range(self.max_parallel):
+            self._tasks.append(asyncio.create_task(self._worker(i)))
+        # boot resync: reconcile everything that already exists
+        for hc in await self.client.list():
+            self.enqueue(hc.metadata.namespace, hc.metadata.name)
+        self._ready.set()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        await self._stopping.wait()
+
+    async def stop(self) -> None:
+        self._stopping.set()
+        for t in list(self._tasks) + list(self._requeue_tasks):
+            t.cancel()
+        await asyncio.gather(
+            *self._tasks, *self._requeue_tasks, return_exceptions=True
+        )
+        self._tasks.clear()
+        self._requeue_tasks.clear()
+        await self.reconciler.shutdown()
+        for runner in self._http_runners:
+            await runner.cleanup()
+        self._http_runners.clear()
+        self._elector.release()
+
+    # -- HTTP endpoints ---------------------------------------------------
+    async def _start_http(self) -> None:
+        if not self._metrics_addr and not self._health_addr:
+            return
+        from aiohttp import web
+
+        async def metrics(request):
+            data = self.reconciler.metrics.exposition()
+            return web.Response(
+                body=data, content_type="text/plain", charset="utf-8"
+            )
+
+        async def healthz(request):
+            return web.Response(text="ok")
+
+        async def readyz(request):
+            if self._ready.is_set():
+                return web.Response(text="ok")
+            return web.Response(status=503, text="not ready")
+
+        async def bind(addr: str, routes) -> None:
+            host, _, port = addr.rpartition(":")
+            app = web.Application()
+            app.add_routes(routes)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, host or "0.0.0.0", int(port))
+            await site.start()
+            self._http_runners.append(runner)
+
+        if self._metrics_addr and self._metrics_addr == self._health_addr:
+            await bind(
+                self._metrics_addr,
+                [
+                    web.get("/metrics", metrics),
+                    web.get("/healthz", healthz),
+                    web.get("/readyz", readyz),
+                ],
+            )
+            return
+        if self._metrics_addr:
+            await bind(self._metrics_addr, [web.get("/metrics", metrics)])
+        if self._health_addr:
+            await bind(
+                self._health_addr,
+                [web.get("/healthz", healthz), web.get("/readyz", readyz)],
+            )
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()

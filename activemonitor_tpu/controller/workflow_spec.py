@@ -95,6 +95,43 @@ def _spec_of(data: dict, what: str) -> dict:
     return spec
 
 
+def _inject_tpu_placement(spec: dict, tpu) -> None:
+    """Place the probe onto a TPU node pool: GKE TPU node selectors at
+    the workflow level, chip resources on every container template
+    (framework extension — SURVEY.md §7.7)."""
+    if tpu.accelerator or tpu.topology:
+        selector = spec.get("nodeSelector")
+        if not isinstance(selector, dict):
+            selector = {}
+        if tpu.accelerator:
+            selector.setdefault("cloud.google.com/gke-tpu-accelerator", tpu.accelerator)
+        if tpu.topology:
+            selector.setdefault("cloud.google.com/gke-tpu-topology", tpu.topology)
+        spec["nodeSelector"] = selector
+    tolerations = spec.get("tolerations")
+    if not isinstance(tolerations, list):
+        tolerations = []
+    if not any(
+        isinstance(t, dict) and t.get("key") == "google.com/tpu" for t in tolerations
+    ):
+        tolerations.append(
+            {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+        )
+    spec["tolerations"] = tolerations
+    if tpu.chips > 0:
+        for template in spec.get("templates") or []:
+            if not isinstance(template, dict):
+                continue
+            for kind in ("container", "script"):  # both run as pods
+                runnable = template.get(kind)
+                if isinstance(runnable, dict):
+                    resources = runnable.setdefault("resources", {})
+                    limits = resources.setdefault("limits", {})
+                    limits.setdefault("google.com/tpu", tpu.chips)
+                    requests = resources.setdefault("requests", {})
+                    requests.setdefault("google.com/tpu", tpu.chips)
+
+
 def parse_workflow_from_healthcheck(hc: HealthCheck) -> dict:
     """Build the probe workflow manifest
     (reference: healthcheck_controller.go:876-1000 + submit-side
@@ -117,6 +154,8 @@ def parse_workflow_from_healthcheck(hc: HealthCheck) -> dict:
         spec["serviceAccountName"] = wf.resource.service_account
     if spec.get("activeDeadlineSeconds") is None:
         spec["activeDeadlineSeconds"] = timeout
+    if wf.tpu is not None:
+        _inject_tpu_placement(spec, wf.tpu)
 
     data["apiVersion"] = WF_API_VERSION
     data["kind"] = WF_KIND

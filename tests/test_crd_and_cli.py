@@ -1,0 +1,101 @@
+"""CRD generation and controller-CLI tests."""
+
+import json
+
+import pytest
+import yaml
+
+from activemonitor_tpu.__main__ import main
+from activemonitor_tpu.api.crd import build_crd, crd_yaml
+
+
+def test_crd_shape():
+    crd = build_crd()
+    assert crd["metadata"]["name"] == "healthchecks.activemonitor.keikoproj.io"
+    spec = crd["spec"]
+    assert spec["group"] == "activemonitor.keikoproj.io"
+    assert spec["names"]["shortNames"] == ["hc", "hcs"]
+    version = spec["versions"][0]
+    assert version["name"] == "v1alpha1"
+    assert version["subresources"] == {"status": {}}
+    cols = {c["jsonPath"] for c in version["additionalPrinterColumns"]}
+    assert ".status.status" in cols
+    assert ".status.successCount" in cols
+
+
+def test_crd_schema_has_reference_spec_fields():
+    crd = build_crd()
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]
+    spec_props = props["spec"]["properties"]
+    # the full field surface of the reference CRD
+    # (api/v1alpha1/healthcheck_types.go:32-44)
+    for field in [
+        "repeatAfterSec",
+        "description",
+        "workflow",
+        "level",
+        "schedule",
+        "remedyworkflow",
+        "backoffFactor",
+        "backoffMax",
+        "backoffMin",
+        "remedyRunsLimit",
+        "remedyResetInterval",
+    ]:
+        assert field in spec_props, field
+    wf = spec_props["workflow"]["properties"]
+    assert set(wf) >= {"generateName", "resource", "workflowtimeout", "rbacRules"}
+    status_props = props["status"]["properties"]
+    assert "remedyTriggeredAt" in status_props  # parity quirk preserved
+    assert "totalHealthCheckRuns" in status_props
+
+
+def test_crd_has_no_refs_or_nulls():
+    text = crd_yaml()
+    assert "$ref" not in text
+    assert "$defs" not in text
+    doc = yaml.safe_load(text)
+
+    def no_null_types(node):
+        if isinstance(node, dict):
+            assert node.get("type") != "null"
+            for v in node.values():
+                no_null_types(v)
+        elif isinstance(node, list):
+            for v in node:
+                no_null_types(v)
+
+    no_null_types(doc)
+
+
+def test_cli_crd_and_version(capsys):
+    assert main(["crd"]) == 0
+    out = capsys.readouterr().out
+    assert yaml.safe_load(out)["kind"] == "CustomResourceDefinition"
+    assert main(["version"]) == 0
+
+
+def test_cli_apply_get_delete(tmp_path, capsys):
+    manifest = tmp_path / "hc.yaml"
+    manifest.write_text(
+        """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: cli-check, namespace: health}
+spec: {repeatAfterSec: 60, level: cluster}
+"""
+    )
+    store = str(tmp_path / "store")
+    assert main(["apply", "--store", store, "-f", str(manifest)]) == 0
+    assert main(["get", "hc", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "cli-check" in out
+    assert "LATEST STATUS" in out
+    assert main(["delete", "cli-check", "-n", "health", "--store", store]) == 0
+    assert main(["get", "hc", "--store", store]) == 0
+    assert "No resources found" in capsys.readouterr().out
+
+
+def test_cli_delete_missing_returns_error(tmp_path):
+    store = str(tmp_path / "store")
+    assert main(["delete", "ghost", "--store", store]) == 1

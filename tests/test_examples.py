@@ -1,0 +1,99 @@
+"""The examples corpus is a behavioral spec (SURVEY.md §2 #16): every
+example must load, validate, and produce a submittable manifest."""
+
+import glob
+from pathlib import Path
+
+import pytest
+import yaml
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import parse_workflow_from_healthcheck
+
+EXAMPLES = sorted(
+    p
+    for p in glob.glob("examples/**/*.yaml", recursive=True)
+    if "workflows/" not in p
+)
+
+
+def load_healthchecks(path):
+    for doc in yaml.safe_load_all(Path(path).read_text()):
+        if isinstance(doc, dict) and doc.get("kind") == "HealthCheck":
+            yield HealthCheck.from_dict(doc)
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 12
+
+
+@pytest.mark.parametrize("path", EXAMPLES)
+def test_example_validates(path):
+    checks = list(load_healthchecks(path))
+    assert checks, f"{path} contains no HealthCheck"
+    for hc in checks:
+        assert hc.metadata.name
+        assert hc.spec.level in ("cluster", "namespace", "")
+
+
+@pytest.mark.parametrize("path", EXAMPLES)
+def test_inline_examples_produce_submittable_manifests(path):
+    for hc in load_healthchecks(path):
+        if hc.spec.workflow.resource.source.inline is None:
+            continue  # url/file sources need live endpoints
+        wf = parse_workflow_from_healthcheck(hc)
+        assert wf["kind"] == "Workflow"
+        assert wf["spec"]["templates"]
+
+
+def test_feature_matrix_coverage():
+    """The corpus must cover the reference's feature matrix plus the
+    TPU extensions."""
+    all_checks = [hc for p in EXAMPLES for hc in load_healthchecks(p)]
+    assert any(hc.spec.repeat_after_sec > 0 for hc in all_checks)  # interval
+    assert any(hc.spec.schedule.cron for hc in all_checks)  # cron
+    assert any(hc.spec.level == "namespace" for hc in all_checks)
+    assert any(hc.spec.level == "cluster" for hc in all_checks)
+    assert any(  # pause
+        hc.spec.repeat_after_sec <= 0 and not hc.spec.schedule.cron
+        for hc in all_checks
+    )
+    assert any(hc.spec.workflow.resource.source.url for hc in all_checks)
+    assert any(hc.spec.workflow.resource.source.file for hc in all_checks)
+    assert any(not hc.spec.remedy_workflow.is_empty() for hc in all_checks)
+    assert any(hc.spec.remedy_runs_limit > 0 for hc in all_checks)  # gated remedy
+    assert any(hc.spec.backoff_max > 0 for hc in all_checks)  # custom backoff
+    assert any(hc.spec.workflow.tpu is not None for hc in all_checks)  # TPU
+    tpu_checks = [hc for hc in all_checks if hc.spec.workflow.tpu]
+    assert any(hc.spec.workflow.tpu.chips == 8 for hc in tpu_checks)
+
+
+def test_tpu_example_gets_placement_injected():
+    (hc,) = load_healthchecks("examples/tpu/tpu-ici-allreduce.yaml")
+    wf = parse_workflow_from_healthcheck(hc)
+    sel = wf["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    limits = wf["spec"]["templates"][0]["container"]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 8
+
+
+def test_generated_crd_manifest_is_current():
+    """config/crd must match what the code generates (drift guard)."""
+    from activemonitor_tpu.api.crd import crd_yaml
+
+    on_disk = Path("config/crd/activemonitor.keikoproj.io_healthchecks.yaml").read_text()
+    assert yaml.safe_load(on_disk) == yaml.safe_load(crd_yaml())
+
+
+def test_deploy_manifest_parses():
+    docs = list(
+        yaml.safe_load_all(Path("deploy/deploy-active-monitor-tpu.yaml").read_text())
+    )
+    kinds = [d["kind"] for d in docs]
+    assert kinds == [
+        "Namespace",
+        "ServiceAccount",
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "Deployment",
+    ]

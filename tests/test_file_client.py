@@ -1,0 +1,181 @@
+"""File-backed client tests: CRUD, status persistence across restarts, watch."""
+
+import asyncio
+
+import pytest
+import yaml
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller.client import ConflictError, NotFoundError
+from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+
+
+def make_hc(name="hc-a", repeat=60):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {"repeatAfterSec": repeat, "level": "cluster"},
+        }
+    )
+
+
+@pytest.mark.asyncio
+async def test_apply_get_list_delete(tmp_path):
+    c = FileHealthCheckClient(str(tmp_path))
+    await c.apply(make_hc("a"))
+    await c.apply(make_hc("b"))
+    assert len(await c.list()) == 2
+    got = await c.get("health", "a")
+    assert got.spec.repeat_after_sec == 60
+    await c.delete("health", "a")
+    assert await c.get("health", "a") is None
+    with pytest.raises(NotFoundError):
+        await c.delete("health", "a")
+
+
+@pytest.mark.asyncio
+async def test_user_authored_yaml_is_read(tmp_path):
+    # the store is just files: a user can drop a manifest in directly
+    (tmp_path / "mine.yaml").write_text(
+        """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: dropped-in, namespace: health}
+spec: {repeatAfterSec: 30, level: namespace}
+"""
+    )
+    c = FileHealthCheckClient(str(tmp_path))
+    got = await c.get("health", "dropped-in")
+    assert got is not None
+    assert got.spec.level == "namespace"
+
+
+@pytest.mark.asyncio
+async def test_status_persists_across_client_instances(tmp_path):
+    """SURVEY.md §5.4 — the status sidecar is the durable checkpoint."""
+    c1 = FileHealthCheckClient(str(tmp_path))
+    await c1.apply(make_hc())
+    hc = await c1.get("health", "hc-a")
+    hc.status.success_count = 7
+    hc.status.status = "Succeeded"
+    await c1.update_status(hc)
+
+    c2 = FileHealthCheckClient(str(tmp_path))  # "controller restart"
+    got = await c2.get("health", "hc-a")
+    assert got.status.success_count == 7
+    assert got.status.status == "Succeeded"
+
+
+@pytest.mark.asyncio
+async def test_update_status_missing_raises(tmp_path):
+    c = FileHealthCheckClient(str(tmp_path))
+    with pytest.raises(NotFoundError):
+        await c.update_status(make_hc())
+
+
+@pytest.mark.asyncio
+async def test_conflict_on_stale_resource_version(tmp_path):
+    c = FileHealthCheckClient(str(tmp_path))
+    await c.apply(make_hc())
+    first = await c.get("health", "hc-a")
+    updated = await c.update_status(first)
+    stale = first.deepcopy()
+    stale.metadata.resource_version = "does-not-match"
+    stale.status.success_count = 9
+    with pytest.raises(ConflictError):
+        await c.update_status(stale)
+    # the winning write is intact
+    assert (await c.get("health", "hc-a")).metadata.resource_version == updated.metadata.resource_version
+
+
+@pytest.mark.asyncio
+async def test_delete_removes_status_sidecar(tmp_path):
+    c = FileHealthCheckClient(str(tmp_path))
+    await c.apply(make_hc())
+    hc = await c.get("health", "hc-a")
+    await c.update_status(hc)
+    assert list((tmp_path / ".status").iterdir())
+    await c.delete("health", "hc-a")
+    assert not list((tmp_path / ".status").iterdir())
+
+
+@pytest.mark.asyncio
+async def test_corrupt_yaml_skipped(tmp_path, caplog):
+    (tmp_path / "bad.yaml").write_text("{unclosed: [")
+    c = FileHealthCheckClient(str(tmp_path))
+    assert await c.list() == []
+
+
+@pytest.mark.asyncio
+async def test_watch_emits_lifecycle_events(tmp_path):
+    c = FileHealthCheckClient(str(tmp_path), poll_seconds=0.05)
+    events = []
+
+    async def watcher():
+        async for ev in c.watch():
+            events.append((ev.type, ev.name))
+            if len(events) >= 3:
+                return
+
+    task = asyncio.create_task(watcher())
+    await asyncio.sleep(0.15)  # let the initial scan settle
+    await c.apply(make_hc("w1"))
+    await asyncio.sleep(0.15)
+    changed = make_hc("w1", repeat=120)
+    await c.apply(changed)
+    await asyncio.sleep(0.15)
+    await c.delete("health", "w1")
+    await asyncio.wait_for(task, 5)
+    assert events == [("ADDED", "w1"), ("MODIFIED", "w1"), ("DELETED", "w1")]
+
+
+@pytest.mark.asyncio
+async def test_status_update_does_not_emit_watch_event(tmp_path):
+    """Status writes must not re-trigger reconciles (no churn by design
+    in the file store — unlike the API-server-backed path)."""
+    c = FileHealthCheckClient(str(tmp_path), poll_seconds=0.05)
+    await c.apply(make_hc())
+    events = []
+
+    async def watcher():
+        async for ev in c.watch():
+            events.append(ev)
+
+    task = asyncio.create_task(watcher())
+    await asyncio.sleep(0.15)
+    hc = await c.get("health", "hc-a")
+    hc.status.success_count = 1
+    await c.update_status(hc)
+    await asyncio.sleep(0.2)
+    task.cancel()
+    assert events == []
+
+
+@pytest.mark.asyncio
+async def test_one_invalid_check_does_not_break_store(tmp_path):
+    (tmp_path / "bad-check.yaml").write_text(
+        "kind: HealthCheck\nmetadata: {name: broken}\nspec: {repeatAfterSec: sixty}\n"
+    )
+    c = FileHealthCheckClient(str(tmp_path))
+    await c.apply(make_hc("good"))
+    names = [hc.metadata.name for hc in await c.list()]
+    assert names == ["good"]  # bad one skipped, store still works
+
+
+@pytest.mark.asyncio
+async def test_apply_updates_user_named_file_in_place(tmp_path):
+    user_file = tmp_path / "zz-mine.yaml"
+    user_file.write_text(
+        """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: hc-a, namespace: health}
+spec: {repeatAfterSec: 60, level: cluster}
+"""
+    )
+    c = FileHealthCheckClient(str(tmp_path))
+    updated = make_hc("hc-a", repeat=120)
+    await c.apply(updated)
+    got = await c.get("health", "hc-a")
+    assert got.spec.repeat_after_sec == 120  # no stale duplicate wins
+    assert not (tmp_path / "health__hc-a.yaml").exists()  # rewritten in place

@@ -1,0 +1,210 @@
+"""Manager tests: watch→queue→workers, coalescing, boot resync, requeue."""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.leader import AlwaysLeader, FileLeaderElector
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine, succeed_after
+from activemonitor_tpu.metrics import MetricsCollector
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+
+def make_hc(name="hc-a", repeat=60):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": repeat,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": f"{name}-",
+                    "workflowtimeout": 5,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "sa",
+                        "source": {"inline": WF_INLINE},
+                    },
+                },
+            },
+        }
+    )
+
+
+def make_manager(client=None, engine=None, **kwargs):
+    client = client or InMemoryHealthCheckClient()
+    engine = engine or FakeWorkflowEngine(succeed_after(1))
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+    )
+    return Manager(client=client, reconciler=reconciler, **kwargs), client, engine
+
+
+@pytest.mark.asyncio
+async def test_watch_event_drives_reconcile():
+    manager, client, engine = make_manager()
+    await manager.start()
+    try:
+        await client.apply(make_hc())
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            hc = await client.get("health", "hc-a")
+            if hc and hc.status.success_count >= 1:
+                break
+        assert hc.status.status == "Succeeded"
+        assert hc.status.success_count == 1
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_boot_resync_reconciles_existing():
+    client = InMemoryHealthCheckClient()
+    await client.apply(make_hc("pre-existing"))
+    manager, client, engine = make_manager(client=client)
+    await manager.start()
+    try:
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            hc = await client.get("health", "pre-existing")
+            if hc.status.success_count >= 1:
+                break
+        assert hc.status.success_count == 1
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_queue_coalesces_duplicate_keys():
+    manager, client, engine = make_manager()
+    manager.enqueue("health", "hc-a")
+    manager.enqueue("health", "hc-a")
+    manager.enqueue("health", "hc-a")
+    assert manager._queue.qsize() == 1
+
+
+@pytest.mark.asyncio
+async def test_requeue_after_error():
+    client = InMemoryHealthCheckClient()
+    hc = make_hc()
+    hc.spec.level = ""  # provokes RBAC "level is not set" -> 1s requeue
+    await client.apply(hc)
+    manager, client, engine = make_manager(client=client)
+    await manager.start()
+    try:
+        await asyncio.sleep(0.1)
+        # fix the spec; the requeue (1s) should pick it up and succeed
+        fixed = make_hc()
+        await client.apply(fixed)
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            got = await client.get("health", "hc-a")
+            if got.status.success_count >= 1:
+                break
+        assert got.status.success_count >= 1
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_ready_flag_and_stop_idempotence():
+    manager, client, engine = make_manager()
+    assert not manager.ready
+    await manager.start()
+    assert manager.ready
+    await manager.stop()
+    await manager.stop()  # second stop must not raise
+
+
+@pytest.mark.asyncio
+async def test_file_leader_election_excludes_second_acquirer(tmp_path):
+    lock = str(tmp_path / "leader.lock")
+    a = FileLeaderElector(lock, poll_seconds=0.05)
+    b = FileLeaderElector(lock, poll_seconds=0.05)
+    await a.acquire()
+    waiter = asyncio.create_task(b.acquire())
+    await asyncio.sleep(0.2)
+    assert not waiter.done()  # b blocked while a leads
+    a.release()
+    await asyncio.wait_for(waiter, 5)  # b takes over
+    b.release()
+
+
+@pytest.mark.asyncio
+async def test_http_endpoints(unused_tcp_port_factory=None):
+    import aiohttp
+
+    port_metrics = 18600
+    port_health = 18601
+    manager, client, engine = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port_metrics}",
+        health_probe_bind_address=f"127.0.0.1:{port_health}",
+    )
+    await manager.start()
+    try:
+        await client.apply(make_hc())
+        await asyncio.sleep(0.3)
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port_health}/healthz") as r:
+                assert r.status == 200
+            async with session.get(f"http://127.0.0.1:{port_health}/readyz") as r:
+                assert r.status == 200
+            async with session.get(f"http://127.0.0.1:{port_metrics}/metrics") as r:
+                text = await r.text()
+                assert "healthcheck_success_count" in text
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_event_during_processing_requeues_after(monkeypatch):
+    """Workqueue semantics: a key being reconciled is marked dirty and
+    re-processed after, never concurrently."""
+    manager, client, engine = make_manager()
+    in_flight = asyncio.Event()
+    release = asyncio.Event()
+    concurrent = []
+    active = set()
+
+    orig = manager.reconciler.reconcile
+
+    async def slow_reconcile(ns, name):
+        key = f"{ns}/{name}"
+        assert key not in active, "concurrent reconcile of one key"
+        active.add(key)
+        in_flight.set()
+        await release.wait()
+        active.discard(key)
+        concurrent.append(key)
+        return None
+
+    manager.reconciler.reconcile = slow_reconcile
+    await manager.start()
+    try:
+        manager.enqueue("health", "hc-a")
+        await asyncio.wait_for(in_flight.wait(), 2)
+        manager.enqueue("health", "hc-a")  # event mid-reconcile -> dirty
+        await asyncio.sleep(0.05)
+        release.set()
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if len(concurrent) == 2:
+                break
+        assert len(concurrent) == 2  # processed twice, sequentially
+    finally:
+        manager.reconciler.reconcile = orig
+        await manager.stop()

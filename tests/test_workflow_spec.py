@@ -4,6 +4,7 @@ healthcheck_controller_unit_test.go:102-256 parse/type-safety cases)."""
 import pytest
 
 from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.api.types import TPUPlacement
 from activemonitor_tpu.controller import (
     WF_INSTANCE_ID,
     WF_INSTANCE_ID_LABEL_KEY,
@@ -195,3 +196,33 @@ def test_remedy_nil_resource_is_error():
     hc = make_hc()
     with pytest.raises(WorkflowSpecError, match="Resource is nil"):
         parse_remedy_workflow_from_healthcheck(hc)
+
+
+# -- TPU placement injection (framework extension) ----------------------
+
+
+def test_tpu_placement_injected():
+    hc = make_hc()
+    hc.spec.workflow.tpu = TPUPlacement(accelerator="tpu-v5-lite-podslice", topology="2x4", chips=8)
+    wf = parse_workflow_from_healthcheck(hc)
+    sel = wf["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert wf["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+    limits = wf["spec"]["templates"][0]["container"]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 8
+
+
+def test_tpu_placement_respects_existing_selectors():
+    inline = BASE_WF + "  nodeSelector:\n    cloud.google.com/gke-tpu-topology: 4x4\n"
+    hc = make_hc(inline=inline)
+    hc.spec.workflow.tpu = TPUPlacement(accelerator="a", topology="2x4")
+    wf = parse_workflow_from_healthcheck(hc)
+    # user's explicit topology wins (setdefault semantics)
+    assert wf["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+
+
+def test_no_tpu_block_means_no_injection():
+    wf = parse_workflow_from_healthcheck(make_hc())
+    assert "nodeSelector" not in wf["spec"]
+    assert "tolerations" not in wf["spec"]
