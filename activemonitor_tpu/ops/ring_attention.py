@@ -1,0 +1,156 @@
+"""Ring attention — sequence-parallel attention over the device mesh.
+
+The long-context path of the framework: the sequence axis is sharded
+across devices, K/V blocks rotate around the ring via ``ppermute``
+while each device accumulates attention for its resident Q block with
+an online (flash-style) softmax — peak memory stays O(S/n) per device
+and all communication is neighbor-hop ICI traffic that overlaps with
+block compute under XLA's scheduler.
+
+Used by the ``ring-attention`` probe both as a correctness check
+(sequence-parallel result must match single-device attention) and as a
+sequence-parallelism bandwidth/throughput canary for long-context
+workloads.
+
+Shapes inside ``shard_map`` (per device): q, k, v are
+``[batch, seq_local, heads, head_dim]``; the global sequence is
+``seq_local × n_devices`` with device i owning the i-th contiguous
+block. Causality is enforced blockwise: a KV block strictly after the
+Q block is skipped entirely, the diagonal block gets the triangular
+mask, earlier blocks attend fully.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, mask):
+    """Scores for one (Q-block, KV-block) pair.
+
+    Returns (scores_max, exp_scores @ v, exp_scores row sums) for the
+    online-softmax accumulation. q: [B,Sq,H,D]; k,v: [B,Sk,H,D];
+    mask: [Sq,Sk] bool (True = attend) or None.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+    block_max = jnp.max(scores, axis=-1)  # [B,H,Sq]
+    exp = jnp.exp(scores - block_max[..., None])
+    if mask is not None:
+        # rows with no visible keys: exp(NEG_INF - NEG_INF) = 1 — zero them
+        any_visible = jnp.any(mask, axis=-1)  # [Sq]
+        exp = exp * any_visible[None, None, :, None]
+    out = jnp.einsum("bhqk,bkhd->bqhd", exp, v)
+    denom = jnp.sum(exp, axis=-1)  # [B,H,Sq]
+    return block_max, out, denom
+
+
+def _ring_attention_sharded(q, k, v, *, axis_name: str, n_devices: int, causal: bool):
+    """Body run per device inside shard_map. The ring rotation is a
+    ``lax.scan`` — one traced step regardless of ring size, so compile
+    time and HLO size stay flat as slices grow."""
+    my_idx = jax.lax.axis_index(axis_name)
+    batch, seq_local, heads, head_dim = q.shape
+
+    causal_mask = jnp.tril(jnp.ones((seq_local, seq_local), jnp.bool_))
+    perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+
+    qf = q.astype(jnp.float32)
+    init = (
+        k.astype(jnp.float32),
+        v.astype(jnp.float32),
+        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # acc
+        jnp.zeros((batch, heads, seq_local), jnp.float32),  # denom
+        jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),  # running max
+    )
+
+    def step_fn(carry, step):
+        kf, vf, acc, denom, running_max = carry
+        kv_idx = (my_idx - step) % n_devices  # owner of the current K/V block
+        if causal:
+            # kv block strictly after our q block ⇒ nothing to attend:
+            # skip the einsums entirely (lax.cond, so the dead ~half of
+            # the causal grid costs nothing at runtime); diagonal block
+            # gets the triangular mask, earlier blocks attend fully
+            def attend(qf, kf, vf):
+                mask = jnp.where(
+                    kv_idx == my_idx, causal_mask, jnp.ones_like(causal_mask)
+                )
+                return _block_attend(qf, kf, vf, mask)
+
+            def skip(qf, kf, vf):
+                return (
+                    jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),
+                    jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),
+                    jnp.zeros((batch, heads, seq_local), jnp.float32),
+                )
+
+            block_max, block_out, block_denom = jax.lax.cond(
+                kv_idx > my_idx, skip, attend, qf, kf, vf
+            )
+        else:
+            block_max, block_out, block_denom = _block_attend(qf, kf, vf, None)
+        new_max = jnp.maximum(running_max, block_max)
+        old_scale = jnp.exp(running_max - new_max)
+        blk_scale = jnp.exp(block_max - new_max)
+        acc = acc * old_scale.transpose(0, 2, 1)[..., None] + block_out * (
+            blk_scale.transpose(0, 2, 1)[..., None]
+        )
+        denom = denom * old_scale + block_denom * blk_scale
+        # rotate K/V to the next neighbor (the final rotation returns
+        # them home — a no-op cost-wise next to n-1 real hops)
+        kf = jax.lax.ppermute(kf, axis_name, perm)
+        vf = jax.lax.ppermute(vf, axis_name, perm)
+        return (kf, vf, acc, denom, new_max), None
+
+    (_, _, acc, denom, _), _ = jax.lax.scan(
+        step_fn, init, jnp.arange(n_devices)
+    )
+    out = acc / jnp.maximum(denom.transpose(0, 2, 1)[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Sequence-parallel attention over ``mesh[axis]``.
+
+    q, k, v: global ``[batch, seq, heads, head_dim]`` arrays; the seq
+    dim is sharded over the axis. Returns attention output with the
+    same global shape/sharding.
+    """
+    n = mesh.shape[axis]
+    body = partial(
+        _ring_attention_sharded, axis_name=axis, n_devices=n, causal=causal
+    )
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Single-device attention for correctness checks."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        seq_q, seq_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((seq_q, seq_k), jnp.bool_))
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)

@@ -17,6 +17,19 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m activemonitor_tpu.probes",
         description="TPU health probe payloads",
     )
+    parser.add_argument(
+        "--profile",
+        default="",
+        metavar="DIR",
+        help="capture a jax.profiler trace of the probe into DIR "
+        "(view with TensorBoard / xprof)",
+    )
+    parser.add_argument(
+        "--distributed",
+        action="store_true",
+        help="force jax.distributed.initialize (multi-host slices; "
+        "auto-detected from TPU_WORKER_HOSTNAMES otherwise)",
+    )
     sub = parser.add_subparsers(dest="probe", required=True)
 
     p = sub.add_parser("devices", help="device inventory check")
@@ -53,11 +66,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dim", type=int, default=8192)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--threshold", type=float, default=0.75)
+
+    p = sub.add_parser(
+        "ring-attention", help="sequence-parallel attention correctness + throughput"
+    )
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--seq-per-device", type=int, default=1024)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=5)
     return parser
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    from activemonitor_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed(force=args.distributed)
+
+    profile_ctx = None
+    if args.profile:
+        import jax
+
+        profile_ctx = jax.profiler.trace(args.profile)
+        profile_ctx.__enter__()
+    try:
+        return _dispatch(args)
+    finally:
+        if profile_ctx is not None:
+            profile_ctx.__exit__(None, None, None)
+
+
+def _dispatch(args) -> int:
     if args.probe == "devices":
         from activemonitor_tpu.probes import devices
 
@@ -105,6 +145,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         result = matmul.run(
             dim=args.dim, iters=args.iters, threshold=args.threshold
+        )
+    elif args.probe == "ring-attention":
+        from activemonitor_tpu.probes import ring
+
+        result = ring.run(
+            batch=args.batch,
+            seq_per_device=args.seq_per_device,
+            heads=args.heads,
+            head_dim=args.head_dim,
+            iters=args.iters,
         )
     else:  # pragma: no cover - argparse guards
         raise SystemExit(2)
