@@ -1,0 +1,23 @@
+# Controller + probe payload image (reference equivalent: distroless
+# runtime image, Dockerfile:24-28). One image serves both roles: the
+# controller entrypoint and the probe CLI invoked by workflow templates.
+FROM python:3.12-slim AS base
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY activemonitor_tpu ./activemonitor_tpu
+RUN pip install --no-cache-dir .
+
+# TPU probe pods additionally need libtpu; GKE TPU node images provide
+# the device plumbing — install the TPU-enabled jax wheel at build time
+# for probe images:
+#   docker build --build-arg JAX_VARIANT="jax[tpu]" -t $IMG .
+ARG JAX_VARIANT=""
+RUN if [ -n "$JAX_VARIANT" ]; then \
+        pip install --no-cache-dir "$JAX_VARIANT" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html; \
+    fi
+
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "activemonitor_tpu"]
+CMD ["run", "--help"]

@@ -1,0 +1,37 @@
+# Developer entry points (reference equivalent: kubebuilder-style Makefile).
+
+PYTHON ?= python
+IMG ?= ghcr.io/activemonitor-tpu/controller:latest
+
+.PHONY: all test test-tpu bench crd manifests run lint docker-build install help
+
+all: test crd
+
+test: ## run the suite on the virtual 8-device CPU mesh
+	$(PYTHON) -m pytest tests/ -q
+
+test-tpu: ## opt into real-hardware tests
+	ACTIVEMONITOR_TEST_TPU=1 $(PYTHON) -m pytest tests/ -q
+
+bench: ## one-line JSON benchmark (adaptive to hardware)
+	$(PYTHON) bench.py
+
+crd: ## regenerate the CRD manifest from the pydantic models
+	$(PYTHON) -m activemonitor_tpu crd > config/crd/activemonitor.keikoproj.io_healthchecks.yaml
+
+manifests: crd ## alias matching the reference's make target
+
+run: ## run the controller locally (file store + local engine)
+	$(PYTHON) -m activemonitor_tpu run --engine local --store ./healthchecks
+
+lint: ## syntax check everything
+	$(PYTHON) -m compileall -q activemonitor_tpu tests bench.py __graft_entry__.py
+
+docker-build: ## build the controller+probes image
+	docker build -t $(IMG) .
+
+install: ## editable install
+	$(PYTHON) -m pip install -e .
+
+help:
+	@grep -E '^[a-zA-Z_-]+:.*?## ' $(MAKEFILE_LIST) | awk 'BEGIN {FS = ":.*?## "}; {printf "  %-14s %s\n", $$1, $$2}'

@@ -109,12 +109,15 @@ async def _run(args) -> int:
     client_kind = args.client or ("k8s" if args.engine == "argo" else "file")
     if client_kind == "k8s":
         from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+        from activemonitor_tpu.controller.events import KubernetesEventRecorder
 
         client = KubernetesHealthCheckClient()
+        recorder = KubernetesEventRecorder()
     else:
         from activemonitor_tpu.controller.client_file import FileHealthCheckClient
 
         client = FileHealthCheckClient(args.store)
+        recorder = EventRecorder()
     if args.engine == "argo":
         from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
 
@@ -139,7 +142,7 @@ async def _run(args) -> int:
         client=client,
         engine=engine,
         rbac=RBACProvisioner(InMemoryRBACBackend()),
-        recorder=EventRecorder(),
+        recorder=recorder,
         metrics=MetricsCollector(),
     )
     for path in args.filename:

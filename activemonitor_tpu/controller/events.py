@@ -57,3 +57,64 @@ class EventRecorder:
     @property
     def all(self) -> List[Event]:
         return list(self._events)
+
+
+class KubernetesEventRecorder(EventRecorder):  # pragma: no cover - needs a cluster
+    """Also posts core/v1 Events against the HealthCheck object, like the
+    reference's record.EventRecorder (reference: healthcheck_controller.go:135,
+    ~40 call sites). Import-gated on ``kubernetes``; failures to post are
+    logged, never raised — events are best-effort."""
+
+    def __init__(self, api_client=None, component: str = "active-monitor-tpu"):
+        super().__init__()
+        try:
+            from kubernetes import client  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "the 'kubernetes' package is required for KubernetesEventRecorder"
+            ) from e
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._core = client.CoreV1Api(api_client)
+        self._component = component
+        # posts happen off-thread: recorder.event() is called from async
+        # reconcile paths and a blocking API-server POST would freeze
+        # the whole event loop
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="events")
+
+    def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        super().event(hc, type_, reason, message)
+        import datetime as _dt
+        import uuid
+
+        from kubernetes import client  # type: ignore
+
+        namespace = hc.metadata.namespace or "default"
+        now = _dt.datetime.now(_dt.timezone.utc)
+        body = client.CoreV1Event(
+            metadata=client.V1ObjectMeta(
+                name=f"{hc.metadata.name}.{uuid.uuid4().hex[:12]}",
+                namespace=namespace,
+            ),
+            involved_object=client.V1ObjectReference(
+                api_version=hc.api_version,
+                kind=hc.kind,
+                name=hc.metadata.name,
+                namespace=namespace,  # must match the event's namespace
+                uid=hc.metadata.uid or None,
+            ),
+            reason=reason,
+            message=message,
+            type=type_,
+            source=client.V1EventSource(component=self._component),
+            first_timestamp=now,
+            last_timestamp=now,
+            count=1,
+        )
+        self._executor.submit(self._post, namespace, body, hc.key)
+
+    def _post(self, namespace: str, body, key: str) -> None:
+        try:
+            self._core.create_namespaced_event(namespace, body)
+        except Exception:
+            log.exception("failed to post event for %s", key)

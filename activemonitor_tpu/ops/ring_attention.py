@@ -40,6 +40,10 @@ def _block_attend(q, k, v, mask):
     mask: [Sq,Sk] bool (True = attend) or None.
     """
     scale = 1.0 / jnp.sqrt(q.shape[-1])
+    # upcast K/V here, not before the ring rotation: ppermute moves the
+    # input-dtype blocks, so bf16 inputs cost bf16 (not f32) ICI traffic
+    k = k.astype(jnp.float32)
+    v = v.astype(jnp.float32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
         scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
@@ -66,8 +70,8 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, n_devices: int, causal: 
 
     qf = q.astype(jnp.float32)
     init = (
-        k.astype(jnp.float32),
-        v.astype(jnp.float32),
+        k,  # rotated in input dtype — bf16 inputs keep bf16 ICI traffic
+        v,
         jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # acc
         jnp.zeros((batch, heads, seq_local), jnp.float32),  # denom
         jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),  # running max

@@ -84,17 +84,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     maybe_initialize_distributed(force=args.distributed)
 
-    profile_ctx = None
     if args.profile:
         import jax
 
-        profile_ctx = jax.profiler.trace(args.profile)
-        profile_ctx.__enter__()
-    try:
-        return _dispatch(args)
-    finally:
-        if profile_ctx is not None:
-            profile_ctx.__exit__(None, None, None)
+        with jax.profiler.trace(args.profile):
+            return _dispatch(args)
+    return _dispatch(args)
 
 
 def _dispatch(args) -> int:

@@ -1,0 +1,145 @@
+"""Concurrency stress — the race-detection tier (SURVEY.md §5.2).
+
+The reference relies on manual lock discipline and leaves one
+documented race; here scheduler state is single-owner on the event
+loop, so the invariants under load are: no cross-check contamination,
+no lost or duplicated runs, no concurrent reconcile of one key.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine, fail_after, succeed_after
+from activemonitor_tpu.metrics import MetricsCollector
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+N_CHECKS = 40
+
+
+def make_hc(i: int):
+    # odd checks fail, even succeed — cross-contamination would show up
+    # as wrong counters on either side
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": f"stress-{i:03d}", "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": 3600,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": f"stress-{i:03d}-",
+                    "workflowtimeout": 5,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": f"sa-{i:03d}",
+                        "source": {"inline": WF_INLINE},
+                    },
+                },
+            },
+        }
+    )
+
+
+@pytest.mark.asyncio
+async def test_many_checks_under_concurrent_reconciles():
+    client = InMemoryHealthCheckClient()
+    engine = FakeWorkflowEngine(succeed_after(1))
+    for i in range(1, N_CHECKS, 2):
+        engine.on_prefix(f"stress-{i:03d}-", fail_after(1, f"fail-{i:03d}"))
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(capacity=100000),
+        metrics=MetricsCollector(),
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=10)
+    await manager.start()
+    try:
+        # apply all checks concurrently + storm duplicate events
+        await asyncio.gather(*(client.apply(make_hc(i)) for i in range(N_CHECKS)))
+        for _ in range(3):
+            for i in range(N_CHECKS):
+                manager.enqueue("health", f"stress-{i:03d}")
+            await asyncio.sleep(0.01)
+
+        async def settled():
+            for _ in range(400):
+                await asyncio.sleep(0.025)
+                done = 0
+                for i in range(N_CHECKS):
+                    hc = await client.get("health", f"stress-{i:03d}")
+                    if hc.status.total_healthcheck_runs >= 1:
+                        done += 1
+                if done == N_CHECKS:
+                    return True
+            return False
+
+        assert await settled(), "not all checks completed a run"
+        await reconciler.wait_watches()
+
+        for i in range(N_CHECKS):
+            hc = await client.get("health", f"stress-{i:03d}")
+            if i % 2:
+                assert hc.status.status == "Failed", i
+                assert hc.status.failed_count == 1, (i, hc.status)
+                assert hc.status.error_message == f"fail-{i:03d}", i
+                assert hc.status.success_count == 0, i
+            else:
+                assert hc.status.status == "Succeeded", i
+                assert hc.status.success_count == 1, (i, hc.status)
+                assert hc.status.failed_count == 0, i
+            # exactly one workflow per check despite the event storm
+            prefix = f"stress-{i:03d}-"
+            count = sum(
+                1
+                for wf in engine.submitted
+                if wf["metadata"]["generateName"] == prefix
+            )
+            assert count == 1, (i, count)
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_interleaved_apply_delete_storm():
+    """Rapid create/delete cycles must end clean: no timers or watches
+    left for deleted checks, no crash."""
+    client = InMemoryHealthCheckClient()
+    engine = FakeWorkflowEngine(succeed_after(1))
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=10)
+    await manager.start()
+    try:
+        for cycle in range(5):
+            await asyncio.gather(*(client.apply(make_hc(i)) for i in range(10)))
+            await asyncio.sleep(0.05)
+            for i in range(10):
+                try:
+                    await client.delete("health", f"stress-{i:03d}")
+                except Exception:
+                    pass
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.3)
+        await reconciler.wait_watches()
+        # all deleted: no pending timers may survive
+        for i in range(10):
+            assert not reconciler.timers.pending(f"health/stress-{i:03d}")
+    finally:
+        await manager.stop()
