@@ -58,6 +58,9 @@ class EventRecorder:
     def all(self) -> List[Event]:
         return list(self._events)
 
+    def close(self) -> None:
+        """Release any transport resources (no-op for the in-memory ring)."""
+
 
 class KubernetesEventRecorder(EventRecorder):  # pragma: no cover - needs a cluster
     """Also posts core/v1 Events against the HealthCheck object, like the
@@ -115,6 +118,16 @@ class KubernetesEventRecorder(EventRecorder):  # pragma: no cover - needs a clus
 
     def _post(self, namespace: str, body, key: str) -> None:
         try:
-            self._core.create_namespaced_event(namespace, body)
+            # bounded request time: a hung API server must not pin the
+            # worker thread (and with it the post queue) forever
+            self._core.create_namespaced_event(
+                namespace, body, _request_timeout=10
+            )
         except Exception:
             log.exception("failed to post event for %s", key)
+
+    def close(self) -> None:
+        """Drop pending posts and release the worker thread (called on
+        manager shutdown; without it interpreter exit joins the
+        non-daemon executor thread)."""
+        self._executor.shutdown(wait=False, cancel_futures=True)

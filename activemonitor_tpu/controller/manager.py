@@ -141,6 +141,7 @@ class Manager:
         self._tasks.clear()
         self._requeue_tasks.clear()
         await self.reconciler.shutdown()
+        self.reconciler.recorder.close()
         for runner in self._http_runners:
             await runner.cleanup()
         self._http_runners.clear()

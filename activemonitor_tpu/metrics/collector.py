@@ -27,7 +27,7 @@ import re
 import threading
 from typing import Dict, Optional
 
-from prometheus_client import CollectorRegistry, Gauge
+from prometheus_client import CollectorRegistry, Gauge, Histogram
 
 log = logging.getLogger(__name__)
 
@@ -85,6 +85,15 @@ class MetricsCollector:
             labels,
             registry=self.registry,
         )
+        # beyond the reference (SURVEY.md §5.1): a duration histogram so
+        # probe latency distributions are queryable, not just last-run
+        self.monitor_runtime_histogram = Histogram(
+            "healthcheck_runtime_histogram_seconds",
+            "Distribution of workflow run durations.",
+            labels,
+            registry=self.registry,
+            buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, float("inf")),
+        )
         self._custom_gauges: Dict[str, Gauge] = {}
         self._custom_lock = threading.Lock()
 
@@ -97,6 +106,9 @@ class MetricsCollector:
         self.monitor_runtime.labels(hc_name, workflow).set(finished - started)
         self.monitor_started_time.labels(hc_name, workflow).set(started)
         self.monitor_finished_time.labels(hc_name, workflow).set(finished)
+        self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
+            max(0.0, finished - started)
+        )
 
     def record_failure(
         self, hc_name: str, workflow: str, started: float, finished: float
@@ -104,6 +116,9 @@ class MetricsCollector:
         self.monitor_error.labels(hc_name, workflow).inc()
         self.monitor_started_time.labels(hc_name, workflow).set(started)
         self.monitor_finished_time.labels(hc_name, workflow).set(finished)
+        self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
+            max(0.0, finished - started)
+        )
 
     # -- dynamic custom metrics ---------------------------------------
     def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:

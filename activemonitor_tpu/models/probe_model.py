@@ -140,6 +140,53 @@ def loss_fn(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array
     return jnp.mean(nll)
 
 
+def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:
+    """KV cache for autoregressive decoding: one [B, S, H, Dh] pair per
+    layer, float-typed in the compute dtype."""
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def decode_step(
+    params: Dict, cache: Dict, token: jax.Array, pos: jax.Array, cfg: ProbeModelConfig
+):
+    """One autoregressive decode step (the serving hot loop).
+
+    token: [B] int32, pos: scalar int32 position. Returns (logits [B,V],
+    updated cache). Static shapes throughout: the cache is full-length
+    and masked by position, so the step jits once and reruns for every
+    token (lax-friendly, no dynamic shapes).
+    """
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[token]  # [B, D]
+    max_seq = cache["k"].shape[2]
+    visible = jnp.arange(max_seq) <= pos  # [S]
+    for li, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1"]["scale"])
+        qkv = jnp.einsum("bd,dthk->tbhk", h, layer["wqkv"].astype(dt))
+        q, k_new, v_new = qkv[0], qkv[1], qkv[2]  # [B, H, K]
+        cache["k"] = cache["k"].at[li, :, pos].set(k_new)
+        cache["v"] = cache["v"].at[li, :, pos].set(v_new)
+        keys = cache["k"][li]  # [B, S, H, K]
+        values = cache["v"][li]
+        scores = jnp.einsum("bhk,bshk->bhs", q, keys) / jnp.sqrt(
+            jnp.asarray(cfg.head_dim, dt)
+        )
+        scores = jnp.where(visible[None, None, :], scores, jnp.asarray(-1e9, dt))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        attn = jnp.einsum("bhs,bshk->bhk", probs, values)
+        x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"].astype(dt))
+        h = _rmsnorm(x, layer["ln2"]["scale"])
+        up = jax.nn.gelu(jnp.einsum("bd,df->bf", h, layer["w_up"].astype(dt)))
+        x = x + jnp.einsum("bf,fd->bd", up, layer["w_down"].astype(dt))
+    x = _rmsnorm(x, params["final_ln"]["scale"])
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(dt))
+    return logits.astype(jnp.float32), cache
+
+
 def param_count(cfg: ProbeModelConfig) -> int:
     d, f, v, h, k = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.head_dim
     per_layer = d + 3 * d * h * k + h * k * d + d + d * f + f * d

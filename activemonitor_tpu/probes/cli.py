@@ -75,6 +75,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--iters", type=int, default=5)
+
+    p = sub.add_parser("decode", help="KV-cache decode-step latency + consistency")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--decode-tokens", type=int, default=32)
+    p.add_argument("--iters", type=int, default=5)
+
+    p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
+    p.add_argument("--probe-gb", type=float, default=1.0)
     return parser
 
 
@@ -151,6 +161,20 @@ def _dispatch(args) -> int:
             head_dim=args.head_dim,
             iters=args.iters,
         )
+    elif args.probe == "decode":
+        from activemonitor_tpu.probes import decode
+
+        result = decode.run(
+            tiny=args.tiny,
+            batch=args.batch,
+            prompt_len=args.prompt_len,
+            decode_tokens=args.decode_tokens,
+            iters=args.iters,
+        )
+    elif args.probe == "memory":
+        from activemonitor_tpu.probes import memory
+
+        result = memory.run(probe_gb=args.probe_gb)
     else:  # pragma: no cover - argparse guards
         raise SystemExit(2)
     return result.emit()

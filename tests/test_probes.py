@@ -222,3 +222,71 @@ def test_cli_failure_exit_code(capsys):
 
     rc = main(["devices", "--expect", "3"])
     assert rc == 1
+
+
+# -- decode + memory probes --------------------------------------------
+
+
+def test_decode_probe_consistency_and_latency():
+    from activemonitor_tpu.probes import decode
+
+    r = decode.run(tiny=True, batch=2, prompt_len=4, decode_tokens=4, iters=2)
+    assert r.ok
+    by_name = {m.name: m.value for m in r.metrics}
+    assert by_name["decode-consistency"] == 1.0
+    assert by_name["decode-step-milliseconds"] > 0
+    assert by_name["decode-tokens-per-second"] > 0
+
+
+def test_decode_step_matches_forward_logits():
+    """The cached single-token path must produce the same logits as the
+    batched forward at the corresponding position."""
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import (
+        decode_step,
+        forward,
+        init_kv_cache,
+        init_params,
+        tiny_config,
+    )
+
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab_size)
+    full_logits = forward(params, tokens, cfg)
+
+    cache = init_kv_cache(cfg, 2, 8)
+    for i in range(tokens.shape[1]):
+        step_logits, cache = decode_step(
+            params, cache, tokens[:, i], jnp.asarray(i), cfg
+        )
+        assert jnp.allclose(step_logits, full_logits[:, i], atol=2e-2), i
+
+
+def test_memory_probe():
+    from activemonitor_tpu.probes import memory
+
+    r = memory.run(probe_gb=0.02)
+    assert r.ok
+    by_name = {m.name: m.value for m in r.metrics}
+    assert by_name["hbm-headroom-probe-ok"] == 1.0
+
+
+def test_runtime_histogram_observed():
+    from activemonitor_tpu.metrics import MetricsCollector, WORKFLOW_LABEL_HEALTHCHECK
+
+    c = MetricsCollector()
+    c.record_success("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 100.0, 107.0)
+    c.record_failure("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 100.0, 140.0)
+    count = c.sample_value(
+        "healthcheck_runtime_histogram_seconds_count",
+        {"healthcheck_name": "hc-a", "workflow": "healthCheck"},
+    )
+    assert count == 2
+    le15 = c.sample_value(
+        "healthcheck_runtime_histogram_seconds_bucket",
+        {"healthcheck_name": "hc-a", "workflow": "healthCheck", "le": "15.0"},
+    )
+    assert le15 == 1  # only the 7s run
