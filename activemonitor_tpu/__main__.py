@@ -85,8 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     get = sub.add_parser("get", help="list HealthChecks (kubectl get hc)")
     get.add_argument("resource", nargs="?", default="hc", choices=["hc", "hcs", "healthchecks", "healthcheck"])
+    get.add_argument("name", nargs="?", default=None)
     get.add_argument("--store", default="./healthchecks")
     get.add_argument("--namespace", "-n", default=None)
+    get.add_argument(
+        "-o", "--output", choices=["table", "yaml", "json"], default="table"
+    )
 
     sub.add_parser("crd", help="print the HealthCheck CRD manifest")
     sub.add_parser("version", help="print version")
@@ -206,10 +210,30 @@ async def _delete(args) -> int:
 
 
 async def _get(args) -> int:
+    import json as _json
+
+    import yaml as _yaml
+
     from activemonitor_tpu.controller.client_file import FileHealthCheckClient
 
     client = FileHealthCheckClient(args.store)
-    rows = [hc.printer_row() for hc in await client.list(args.namespace)]
+    checks = await client.list(args.namespace)
+    if args.name:
+        checks = [hc for hc in checks if hc.metadata.name == args.name]
+        if not checks:
+            print(f"healthcheck {args.name!r} not found", file=sys.stderr)
+            return 1
+    if args.output in ("yaml", "json"):
+        docs = [hc.to_dict() for hc in checks]
+        if args.output == "yaml":
+            print(_yaml.safe_dump_all(docs, sort_keys=False), end="")
+        else:
+            # stable shape for scripts: a name lookup returns one object,
+            # a listing always returns an array
+            payload = docs[0] if (args.name and len(docs) == 1) else docs
+            print(_json.dumps(payload, indent=2, default=str))
+        return 0
+    rows = [hc.printer_row() for hc in checks]
     if not rows:
         print("No resources found.")
         return 0

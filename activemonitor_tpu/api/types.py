@@ -280,9 +280,9 @@ class HealthCheck(_Base):
     def to_dict(self) -> dict:
         # apiVersion/kind equal their defaults, so omitempty-style dumping
         # would drop them — but a manifest without them is not applyable.
-        d = self.to_json_dict()
-        d["apiVersion"] = self.api_version
-        d["kind"] = self.kind
+        # They lead the dict, kubectl-style.
+        d = {"apiVersion": self.api_version, "kind": self.kind}
+        d.update(self.to_json_dict())
         return d
 
     def deepcopy(self) -> "HealthCheck":

@@ -180,6 +180,11 @@ def parse_remedy_workflow_from_healthcheck(hc: HealthCheck) -> dict:
     if remedy.resource.service_account:
         spec["serviceAccountName"] = remedy.resource.service_account
 
+    if remedy.tpu is not None:
+        # remedies inherit the placement machinery: a fix for a TPU node
+        # pool usually has to run on/next to that pool
+        _inject_tpu_placement(spec, remedy.tpu)
+
     default_timeout = hc.spec.repeat_after_sec
     deadline = spec.get("activeDeadlineSeconds")
     if deadline is None:

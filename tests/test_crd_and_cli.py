@@ -99,3 +99,25 @@ spec: {repeatAfterSec: 60, level: cluster}
 def test_cli_delete_missing_returns_error(tmp_path):
     store = str(tmp_path / "store")
     assert main(["delete", "ghost", "--store", store]) == 1
+
+
+def test_cli_get_output_yaml_and_json(tmp_path, capsys):
+    manifest = tmp_path / "hc.yaml"
+    manifest.write_text(
+        """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: fmt-check, namespace: health}
+spec: {repeatAfterSec: 60, level: cluster}
+"""
+    )
+    store = str(tmp_path / "store")
+    assert main(["apply", "--store", store, "-f", str(manifest)]) == 0
+    capsys.readouterr()
+    assert main(["get", "hc", "--store", store, "-o", "yaml"]) == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["metadata"]["name"] == "fmt-check"
+    assert main(["get", "hc", "fmt-check", "--store", store, "-o", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["spec"]["repeatAfterSec"] == 60
+    assert main(["get", "hc", "ghost", "--store", store]) == 1

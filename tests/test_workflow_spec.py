@@ -226,3 +226,17 @@ def test_no_tpu_block_means_no_injection():
     wf = parse_workflow_from_healthcheck(make_hc())
     assert "nodeSelector" not in wf["spec"]
     assert "tolerations" not in wf["spec"]
+
+
+def test_remedy_tpu_placement_injected():
+    hc = make_hc(remedy_inline=BASE_WF, repeat=30)
+    hc.spec.remedy_workflow.tpu = TPUPlacement(
+        accelerator="tpu-v5-lite-podslice", topology="2x4", chips=8
+    )
+    wf = parse_remedy_workflow_from_healthcheck(hc)
+    assert (
+        wf["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+        == "tpu-v5-lite-podslice"
+    )
+    limits = wf["spec"]["templates"][0]["container"]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 8
