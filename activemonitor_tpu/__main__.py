@@ -217,7 +217,10 @@ async def _get(args) -> int:
     from activemonitor_tpu.controller.client_file import FileHealthCheckClient
 
     client = FileHealthCheckClient(args.store)
-    checks = await client.list(args.namespace)
+    # name lookups are namespace-scoped like kubectl (default ns when
+    # -n is unset) so the output shape never depends on collisions
+    namespace = args.namespace or ("default" if args.name else None)
+    checks = await client.list(namespace)
     if args.name:
         checks = [hc for hc in checks if hc.metadata.name == args.name]
         if not checks:

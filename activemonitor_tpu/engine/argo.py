@@ -15,15 +15,15 @@ WF_VERSION = "v1alpha1"
 WF_PLURAL = "workflows"
 
 
-def _is_api_not_found(e: Exception) -> bool:
-    """True only for a genuine API-server 404. When the kubernetes
-    package is importable, the type check is strict (an arbitrary
-    exception carrying status=404 must not masquerade as not-found);
-    the duck-typed fallback exists solely for injected test stubs."""
-    try:
-        from kubernetes.client.rest import ApiException  # type: ignore
-    except ImportError:
+def _is_api_not_found(e: Exception, stub_mode: bool) -> bool:
+    """True only for a genuine API-server 404. In real-client mode the
+    type check is strict (an arbitrary exception carrying status=404
+    must not masquerade as not-found); injected test stubs get the
+    duck-typed check regardless of what packages are installed."""
+    if stub_mode:
         return getattr(e, "status", None) == 404
+    from kubernetes.client.rest import ApiException  # type: ignore
+
     return isinstance(e, ApiException) and e.status == 404
 
 
@@ -32,6 +32,7 @@ class ArgoWorkflowEngine:
         """``custom_objects_api`` lets tests inject a stub implementing
         the CustomObjectsApi surface; otherwise the real client is
         constructed from in-cluster/kubeconfig credentials."""
+        self._stub_mode = custom_objects_api is not None
         if custom_objects_api is not None:
             self._api = custom_objects_api
             return
@@ -76,6 +77,6 @@ class ArgoWorkflowEngine:
                 name,
             )
         except Exception as e:
-            if _is_api_not_found(e):
+            if _is_api_not_found(e, self._stub_mode):
                 return None
             raise

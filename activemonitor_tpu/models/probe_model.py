@@ -106,29 +106,43 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V]. Jit-friendly: static
-    shapes, lax-only control flow, bf16 compute."""
+def _forward_with_attention(
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn
+) -> jax.Array:
+    """Shared decoder body: ``attention_fn(q, k, v) -> attn`` supplies
+    the attention mechanism (dense causal, or ring attention for the
+    context-parallel path) — everything else is identical by
+    construction, so the two paths cannot drift."""
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]  # [B, S, D]
-    seq = tokens.shape[1]
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["ln1"]["scale"])
         qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
-        q, k_, v = qkv[0], qkv[1], qkv[2]  # [B, S, H, K]
-        scores = jnp.einsum("bshk,bthk->bhst", q, k_) / jnp.sqrt(
-            jnp.asarray(cfg.head_dim, dt)
-        )
-        scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e9, dt))
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
-        attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+        attn = attention_fn(qkv[0], qkv[1], qkv[2])  # [B, S, H, K]
         x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
         h = _rmsnorm(x, layer["ln2"]["scale"])
         up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt)))
         x = x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(dt))
     x = _rmsnorm(x, params["final_ln"]["scale"])
     return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt)).astype(jnp.float32)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V]. Jit-friendly: static
+    shapes, lax-only control flow, bf16 compute."""
+    dt = cfg.dtype
+    seq = tokens.shape[1]
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+
+    def dense_attention(q, k, v):
+        scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(cfg.head_dim, dt)
+        )
+        scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e9, dt))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        return jnp.einsum("bhst,bthk->bshk", probs, v)
+
+    return _forward_with_attention(params, tokens, cfg, dense_attention)
 
 
 def loss_fn(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
@@ -138,6 +152,24 @@ def loss_fn(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
     return jnp.mean(nll)
+
+
+def forward_context_parallel(
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, mesh, axis: str = "sp"
+) -> jax.Array:
+    """Long-context forward: the sequence axis lives sharded across
+    ``mesh[axis]`` and attention runs as ring attention
+    (ops/ring_attention.py), so a sequence n× longer than one device's
+    memory fits. Everything else (embedding, norms, MLP) is pointwise
+    along the sequence and needs no communication — XLA keeps those ops
+    local to each shard; the only inter-device traffic is the K/V ring.
+    """
+    from activemonitor_tpu.ops.ring_attention import ring_attention
+
+    def ring(q, k, v):
+        return ring_attention(q, k, v, mesh, axis, causal=True)
+
+    return _forward_with_attention(params, tokens, cfg, ring)
 
 
 def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:

@@ -117,7 +117,7 @@ spec: {repeatAfterSec: 60, level: cluster}
     assert main(["get", "hc", "--store", store, "-o", "yaml"]) == 0
     doc = yaml.safe_load(capsys.readouterr().out)
     assert doc["metadata"]["name"] == "fmt-check"
-    assert main(["get", "hc", "fmt-check", "--store", store, "-o", "json"]) == 0
+    assert main(["get", "hc", "fmt-check", "-n", "health", "--store", store, "-o", "json"]) == 0
     doc = json.loads(capsys.readouterr().out)
     assert doc["spec"]["repeatAfterSec"] == 60
     assert main(["get", "hc", "ghost", "--store", store]) == 1

@@ -1,0 +1,154 @@
+"""End-to-end slice: the real wiring from SURVEY.md §7 driven in-process.
+
+File-backed store + local process engine + manager — the exact stack
+``python -m activemonitor_tpu run --engine local`` assembles — applied a
+HealthCheck whose probe really executes as a subprocess, observed
+through status, metrics, and events. The local-mode equivalent of the
+reference's kind-cluster manual tier (SURVEY.md §4 tier 3), but
+automated.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine.local import LocalProcessEngine
+from activemonitor_tpu.metrics import MetricsCollector
+
+CHECK = """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: e2e-echo, namespace: default}
+spec:
+  repeatAfterSec: 2
+  backoffMax: 1
+  backoffMin: 1
+  level: cluster
+  workflow:
+    generateName: e2e-echo-
+    workflowtimeout: 10
+    resource:
+      namespace: default
+      serviceAccount: local
+      source:
+        inline: |
+          apiVersion: argoproj.io/v1alpha1
+          kind: Workflow
+          spec:
+            entrypoint: main
+            templates:
+              - name: main
+                container:
+                  command: [/bin/sh, -c]
+                  args: ['echo "{\\"metrics\\": [{\\"name\\": \\"e2e-gauge\\", \\"value\\": 3.5, \\"metrictype\\": \\"gauge\\", \\"help\\": \\"x\\"}]}"']
+"""
+
+FAILING_CHECK = """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: e2e-fail, namespace: default}
+spec:
+  repeatAfterSec: 3600
+  backoffMax: 1
+  backoffMin: 1
+  level: cluster
+  workflow:
+    generateName: e2e-fail-
+    workflowtimeout: 10
+    resource:
+      namespace: default
+      serviceAccount: local
+      source:
+        inline: |
+          apiVersion: argoproj.io/v1alpha1
+          kind: Workflow
+          spec:
+            entrypoint: main
+            templates:
+              - name: main
+                container:
+                  command: [/bin/sh, -c]
+                  args: ["echo broken probe; exit 7"]
+  remedyworkflow:
+    generateName: e2e-remedy-
+    resource:
+      namespace: default
+      serviceAccount: local-remedy
+      source:
+        inline: |
+          apiVersion: argoproj.io/v1alpha1
+          kind: Workflow
+          spec:
+            entrypoint: fix
+            templates:
+              - name: fix
+                container:
+                  command: [/bin/true]
+"""
+
+
+async def wait_for(client, name, predicate, timeout=20.0):
+    for _ in range(int(timeout / 0.1)):
+        hc = await client.get("default", name)
+        if hc is not None and predicate(hc):
+            return hc
+        await asyncio.sleep(0.1)
+    raise TimeoutError(name)
+
+
+@pytest.mark.asyncio
+async def test_local_stack_end_to_end(tmp_path):
+    client = FileHealthCheckClient(str(tmp_path), poll_seconds=0.1)
+    engine = LocalProcessEngine()
+    recorder = EventRecorder()
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=recorder,
+        metrics=metrics,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=4)
+    await manager.start()
+    try:
+        # live apply through the watch path (no manual enqueue)
+        await client.apply(HealthCheck.from_yaml(CHECK))
+        hc = await wait_for(client, "e2e-echo", lambda h: h.status.success_count >= 1)
+        assert hc.status.status == "Succeeded"
+        # custom metric flowed probe stdout -> engine outputs -> gauge
+        assert (
+            metrics.sample_value("e2e_echo_e2e_gauge", {"healthcheck_name": "e2e-echo"})
+            == 3.5
+        )
+        # periodic: a second run arrives on the real clock
+        await wait_for(client, "e2e-echo", lambda h: h.status.success_count >= 2)
+
+        # failure path incl. remedy subprocess + rbac cleanup
+        await client.apply(HealthCheck.from_yaml(FAILING_CHECK))
+        hc = await wait_for(client, "e2e-fail", lambda h: h.status.failed_count >= 1)
+        assert hc.status.status == "Failed"
+        assert "exited 7" in hc.status.error_message
+        hc = await wait_for(
+            client, "e2e-fail", lambda h: h.status.remedy_success_count >= 1
+        )
+        assert hc.status.remedy_status == "Succeeded"
+        messages = [e.message for e in recorder.events_for("default", "e2e-fail")]
+        assert "Successfully created remedyWorkflow" in messages
+
+        # durability: a fresh client (restart) sees the same status
+        fresh = FileHealthCheckClient(str(tmp_path))
+        persisted = await fresh.get("default", "e2e-echo")
+        assert persisted.status.success_count >= 2
+    finally:
+        await manager.stop()

@@ -79,3 +79,25 @@ def test_distributed_detection(monkeypatch):
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
     monkeypatch.setenv("ACTIVEMONITOR_DISTRIBUTED", "1")
     assert detect_multihost_env()
+
+
+def test_context_parallel_forward_matches_dense(mesh):
+    """The long-context model path (seq sharded + ring attention) must
+    agree with the dense single-device forward."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from activemonitor_tpu.models.probe_model import (
+        forward,
+        forward_context_parallel,
+        init_params,
+        tiny_config,
+    )
+
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)
+    sharded = jax.device_put(tokens, NamedSharding(mesh, P(None, "sp")))
+    got = forward_context_parallel(params, sharded, cfg, mesh)
+    want = forward(params, tokens, cfg)
+    assert jnp.max(jnp.abs(got - want)) < 3e-2  # bf16 compute tolerance
