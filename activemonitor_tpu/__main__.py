@@ -231,9 +231,9 @@ async def _get(args) -> int:
         if args.output == "yaml":
             print(_yaml.safe_dump_all(docs, sort_keys=False), end="")
         else:
-            # stable shape for scripts: a name lookup returns one object,
-            # a listing always returns an array
-            payload = docs[0] if (args.name and len(docs) == 1) else docs
+            # stable shape for scripts: a name lookup returns one object
+            # (namespace-scoped, so exactly one), a listing an array
+            payload = docs[0] if args.name else docs
             print(_json.dumps(payload, indent=2, default=str))
         return 0
     rows = [hc.printer_row() for hc in checks]

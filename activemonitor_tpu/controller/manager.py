@@ -122,10 +122,52 @@ class Manager:
         self._tasks.append(asyncio.create_task(self._watch_loop(watch_iterator)))
         for i in range(self.max_parallel):
             self._tasks.append(asyncio.create_task(self._worker(i)))
+        self._tasks.append(asyncio.create_task(self._goodput_loop()))
         # boot resync: reconcile everything that already exists
         for hc in await self.client.list():
             self.enqueue(hc.metadata.namespace, hc.metadata.name)
         self._ready.set()
+
+    async def _goodput_loop(self, interval: float = 30.0) -> None:
+        """Periodically roll up fleet health: the fraction of scheduled
+        checks whose latest run succeeded within 2x their cadence."""
+        clock = self.reconciler.clock
+        while True:
+            try:
+                checks = await self.client.list()
+                scheduled = 0
+                good = 0
+                now = clock.now()
+                for hc in checks:
+                    interval_s = hc.spec.repeat_after_sec
+                    if interval_s <= 0 and not hc.spec.schedule.cron:
+                        continue  # paused checks don't count either way
+                    scheduled += 1
+                    if hc.status.status != "Succeeded" or hc.status.finished_at is None:
+                        continue
+                    # cadence precedence mirrors the reconciler's
+                    # _effective_repeat_after: a cron schedule wins even
+                    # when repeatAfterSec is also set
+                    if hc.spec.schedule.cron:
+                        # cron period around now (handles non-uniform crons
+                        # approximately: the gap between the next two fires)
+                        try:
+                            from activemonitor_tpu.scheduler import parse_cron
+
+                            sched = parse_cron(hc.spec.schedule.cron)
+                            fire1 = sched.next(now)
+                            interval_s = (sched.next(fire1) - fire1).total_seconds()
+                        except Exception:
+                            continue
+                    if (now - hc.status.finished_at).total_seconds() <= 2 * interval_s:
+                        good += 1
+                if scheduled:
+                    self.reconciler.metrics.cadence_goodput.set(good / scheduled)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("goodput rollup failed")
+            await clock.sleep(interval)
 
     async def run_forever(self) -> None:
         await self.start()

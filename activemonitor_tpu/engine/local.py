@@ -58,12 +58,19 @@ class _DeadlineExceeded(RuntimeError):
 
 
 class LocalProcessEngine:
-    def __init__(self, env: Optional[dict] = None):
+    def __init__(self, env: Optional[dict] = None, default_ttl_seconds: float = 3600.0):
         self._workflows: Dict[str, dict] = {}
         self._tasks: Dict[str, asyncio.Task] = {}
+        self._finished_at: Dict[str, float] = {}
         self._env = env
+        # terminal workflows are pruned after their manifest's
+        # ttlSecondsAfterFinished (or this default) — the local stand-in
+        # for Argo's TTL controller, so a long-lived daemon's workflow
+        # map doesn't grow without bound
+        self._default_ttl = default_ttl_seconds
 
     async def submit(self, manifest: dict) -> str:
+        self._prune()
         manifest = copy.deepcopy(manifest)
         meta = manifest.setdefault("metadata", {})
         name = meta.get("name") or generate_name(meta.get("generateName", "wf-"))
@@ -74,6 +81,28 @@ class LocalProcessEngine:
         self._workflows[key] = manifest
         self._tasks[key] = asyncio.create_task(self._run(key, manifest))
         return name
+
+    # effective TTLs are floored so a finished workflow always outlives
+    # the reconciler's slowest status poll (max backoff = timeout/2) —
+    # pruning a status before its watcher reads it would stall the check
+    MIN_TTL_SECONDS = 60.0
+
+    def _prune(self) -> None:
+        now = time.monotonic()
+        doomed = []
+        for key, finished in self._finished_at.items():
+            spec = (self._workflows.get(key) or {}).get("spec") or {}
+            ttl = spec.get("ttlSecondsAfterFinished", self._default_ttl)
+            try:
+                ttl = float(ttl)
+            except (TypeError, ValueError):
+                ttl = self._default_ttl
+            if now - finished > max(ttl, self.MIN_TTL_SECONDS):
+                doomed.append(key)
+        for key in doomed:
+            self._workflows.pop(key, None)
+            self._tasks.pop(key, None)
+            self._finished_at.pop(key, None)
 
     async def get(self, namespace: str, name: str) -> Optional[dict]:
         wf = self._workflows.get(f"{namespace}/{name}")
@@ -86,6 +115,12 @@ class LocalProcessEngine:
             await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _run(self, key: str, manifest: dict) -> None:
+        try:
+            await self._run_inner(manifest)
+        finally:
+            self._finished_at[key] = time.monotonic()
+
+    async def _run_inner(self, manifest: dict) -> None:
         spec = manifest.get("spec") or {}
         deadline = spec.get("activeDeadlineSeconds")
         deadline_at = (

@@ -94,6 +94,15 @@ class MetricsCollector:
             registry=self.registry,
             buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, float("inf")),
         )
+        # fleet rollup (beyond the reference; cf. ML-productivity-goodput
+        # style metrics): what fraction of checks are healthy AND meeting
+        # their cadence — the one number a fleet dashboard leads with
+        self.cadence_goodput = Gauge(
+            "healthcheck_cadence_goodput",
+            "Fraction of HealthChecks whose last run succeeded within "
+            "2x their interval",
+            registry=self.registry,
+        )
         self._custom_gauges: Dict[str, Gauge] = {}
         self._custom_lock = threading.Lock()
 

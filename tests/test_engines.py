@@ -201,3 +201,30 @@ async def test_local_bad_entrypoint_fails():
     )
     wf = await wait_terminal(eng, name)
     assert wf["status"]["phase"] == "Failed"
+
+
+@pytest.mark.asyncio
+async def test_local_ttl_prunes_finished_workflows():
+    eng = LocalProcessEngine(default_ttl_seconds=0.2)
+    eng.MIN_TTL_SECONDS = 0.0  # tests bypass the safety floor
+    name = await eng.submit(container_wf(["/bin/true"]))
+    await wait_terminal(eng, name)
+    assert await eng.get("default", name) is not None
+    await asyncio.sleep(0.3)
+    # pruning happens on the next submit
+    other = await eng.submit(container_wf(["/bin/true"]))
+    assert await eng.get("default", name) is None
+    await wait_terminal(eng, other)
+
+
+@pytest.mark.asyncio
+async def test_local_ttl_respects_manifest_override():
+    eng = LocalProcessEngine(default_ttl_seconds=0.1)
+    eng.MIN_TTL_SECONDS = 0.0
+    wf = container_wf(["/bin/true"])
+    wf["spec"]["ttlSecondsAfterFinished"] = 3600
+    name = await eng.submit(wf)
+    await wait_terminal(eng, name)
+    await asyncio.sleep(0.3)
+    await eng.submit(container_wf(["/bin/true"]))
+    assert await eng.get("default", name) is not None  # long TTL kept it

@@ -208,3 +208,39 @@ async def test_event_during_processing_requeues_after(monkeypatch):
     finally:
         manager.reconciler.reconcile = orig
         await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_goodput_rollup():
+    import datetime
+
+    manager, client, engine = make_manager()
+    await manager.start()
+    try:
+        # healthy recent check + stale failed check + paused check
+        good = make_hc("good")
+        await client.apply(good)
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            hc = await client.get("health", "good")
+            if hc.status.success_count >= 1:
+                break
+        bad = make_hc("bad")
+        created = await client.apply(bad)
+        fresh = await client.get("health", "bad")
+        fresh.status.status = "Failed"
+        fresh.status.finished_at = datetime.datetime.now(datetime.timezone.utc)
+        await client.update_status(fresh)
+        paused = make_hc("paused", repeat=0)
+        await client.apply(paused)
+
+        # run one rollup pass directly instead of waiting 30s
+        task = asyncio.create_task(manager._goodput_loop(interval=3600))
+        await asyncio.sleep(0.2)
+        task.cancel()
+        value = manager.reconciler.metrics.registry.get_sample_value(
+            "healthcheck_cadence_goodput"
+        )
+        assert value == 0.5  # good=1 of scheduled=2 (paused excluded)
+    finally:
+        await manager.stop()
