@@ -91,6 +91,19 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument(
         "-o", "--output", choices=["table", "yaml", "json"], default="table"
     )
+    get.add_argument(
+        "--watch",
+        "-w",
+        action="store_true",
+        help="keep printing the table as it changes",
+    )
+
+    describe = sub.add_parser(
+        "describe", help="spec + status + recent events for one HealthCheck"
+    )
+    describe.add_argument("name")
+    describe.add_argument("--store", default="./healthchecks")
+    describe.add_argument("--namespace", "-n", default="default")
 
     sub.add_parser("crd", help="print the HealthCheck CRD manifest")
     sub.add_parser("version", help="print version")
@@ -119,9 +132,10 @@ async def _run(args) -> int:
         recorder = KubernetesEventRecorder()
     else:
         from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+        from activemonitor_tpu.controller.events import FileEventRecorder
 
         client = FileHealthCheckClient(args.store)
-        recorder = EventRecorder()
+        recorder = FileEventRecorder(args.store)
     if args.engine == "argo":
         from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
 
@@ -226,6 +240,9 @@ async def _get(args) -> int:
         if not checks:
             print(f"healthcheck {args.name!r} not found", file=sys.stderr)
             return 1
+    if getattr(args, "watch", False) and args.output != "table":
+        print("--watch only supports table output", file=sys.stderr)
+        return 2
     if args.output in ("yaml", "json"):
         docs = [hc.to_dict() for hc in checks]
         if args.output == "yaml":
@@ -236,17 +253,66 @@ async def _get(args) -> int:
             payload = docs[0] if args.name else docs
             print(_json.dumps(payload, indent=2, default=str))
         return 0
-    rows = [hc.printer_row() for hc in checks]
-    if not rows:
-        print("No resources found.")
-        return 0
-    headers = list(rows[0].keys())
-    widths = [
-        max(len(str(h)), *(len(str(r[h])) for r in rows)) for h in headers
-    ]
-    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
-    for r in rows:
-        print("  ".join(str(r[h]).ljust(w) for h, w in zip(headers, widths)))
+    def print_table(checks) -> None:
+        rows = [hc.printer_row() for hc in checks]
+        if not rows:
+            print("No resources found.")
+            return
+        headers = list(rows[0].keys())
+        widths = [
+            max(len(str(h)), *(len(str(r[h])) for r in rows)) for h in headers
+        ]
+        print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for r in rows:
+            print("  ".join(str(r[h]).ljust(w) for h, w in zip(headers, widths)))
+
+    print_table(checks)
+    if getattr(args, "watch", False):
+        last = [hc.to_dict() for hc in checks]
+        try:
+            while True:
+                await asyncio.sleep(1.0)
+                checks = await client.list(namespace)
+                if args.name:
+                    checks = [hc for hc in checks if hc.metadata.name == args.name]
+                current = [hc.to_dict() for hc in checks]
+                if current != last:
+                    last = current
+                    print()
+                    print_table(checks)
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            return 0
+    return 0
+
+
+async def _describe(args) -> int:
+    import yaml as _yaml
+
+    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+    from activemonitor_tpu.controller.events import FileEventRecorder
+
+    client = FileHealthCheckClient(args.store)
+    hc = await client.get(args.namespace, args.name)
+    if hc is None:
+        print(f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr)
+        return 1
+    print(f"Name:       {hc.metadata.name}")
+    print(f"Namespace:  {hc.metadata.namespace}")
+    print(f"Status:     {hc.status.status or '<none>'}")
+    print("Spec:")
+    for line in _yaml.safe_dump(
+        hc.spec.to_json_dict(), sort_keys=False
+    ).splitlines():
+        print(f"  {line}")
+    print("Status detail:")
+    for line in _yaml.safe_dump(
+        hc.status.to_json_dict(), sort_keys=False, default_flow_style=False
+    ).splitlines():
+        print(f"  {line}")
+    events = FileEventRecorder.read_events(args.store, args.namespace, args.name)
+    print(f"Events ({len(events)} recorded):")
+    for ev in events[-20:]:
+        print(f"  {ev.get('time', '')}  {ev.get('type', ''):8} {ev.get('message', '')}")
     return 0
 
 
@@ -267,6 +333,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "apply": _apply,
         "delete": _delete,
         "get": _get,
+        "describe": _describe,
     }[args.command]
     return asyncio.run(handler(args))
 

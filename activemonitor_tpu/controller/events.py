@@ -62,6 +62,71 @@ class EventRecorder:
         """Release any transport resources (no-op for the in-memory ring)."""
 
 
+class FileEventRecorder(EventRecorder):
+    """Also appends events to JSONL sidecars under ``<dir>/.events/`` so
+    the ``describe`` CLI (a separate process) can show a check's recent
+    history — the local-mode analogue of Events in ``kubectl describe``.
+    Files are capped by line count to bound disk use."""
+
+    def __init__(self, directory: str, capacity: int = 1000, max_lines: int = 200):
+        super().__init__(capacity=capacity)
+        import pathlib
+
+        self._dir = pathlib.Path(directory) / ".events"
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._max_lines = max_lines
+        # we are the only writer: line counts are cached so the steady
+        # state is a pure append — the file is re-read only when the
+        # cached count hits the cap (then trimmed in one rewrite)
+        self._line_counts: dict = {}
+
+    def _path(self, namespace: str, name: str):
+        return self._dir / f"{namespace}__{name}.jsonl"
+
+    def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        super().event(hc, type_, reason, message)
+        import json
+
+        path = self._path(hc.metadata.namespace or "default", hc.metadata.name)
+        line = json.dumps(
+            {
+                "time": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                "type": type_,
+                "reason": reason,
+                "message": message,
+            }
+        )
+        try:
+            count = self._line_counts.get(path)
+            if count is None:
+                count = len(path.read_text().splitlines()) if path.exists() else 0
+            if count >= self._max_lines:
+                lines = path.read_text().splitlines()[-(self._max_lines - 1):]
+                path.write_text("\n".join(lines) + "\n")
+                count = len(lines)
+            with path.open("a") as f:
+                f.write(line + "\n")
+            self._line_counts[path] = count + 1
+        except OSError:
+            log.exception("failed to persist event for %s", hc.key)
+
+    @staticmethod
+    def read_events(directory: str, namespace: str, name: str) -> List[dict]:
+        import json
+        import pathlib
+
+        path = pathlib.Path(directory) / ".events" / f"{namespace}__{name}.jsonl"
+        if not path.exists():
+            return []
+        out = []
+        for line in path.read_text().splitlines():
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+
 class KubernetesEventRecorder(EventRecorder):  # pragma: no cover - needs a cluster
     """Also posts core/v1 Events against the HealthCheck object, like the
     reference's record.EventRecorder (reference: healthcheck_controller.go:135,

@@ -161,8 +161,11 @@ class Manager:
                             continue
                     if (now - hc.status.finished_at).total_seconds() <= 2 * interval_s:
                         good += 1
-                if scheduled:
-                    self.reconciler.metrics.cadence_goodput.set(good / scheduled)
+                # an empty fleet is vacuously healthy — and the gauge
+                # must not freeze at a stale fraction
+                self.reconciler.metrics.cadence_goodput.set(
+                    good / scheduled if scheduled else 1.0
+                )
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -78,13 +78,17 @@ class LocalProcessEngine:
         namespace = meta.get("namespace", "default")
         key = f"{namespace}/{name}"
         manifest["status"] = {"phase": PHASE_RUNNING}
+        # a reused key must shed its old finished-timestamp, or a later
+        # prune would evict the RUNNING resubmission
+        self._finished_at.pop(key, None)
         self._workflows[key] = manifest
         self._tasks[key] = asyncio.create_task(self._run(key, manifest))
         return name
 
     # effective TTLs are floored so a finished workflow always outlives
-    # the reconciler's slowest status poll (max backoff = timeout/2) —
-    # pruning a status before its watcher reads it would stall the check
+    # the reconciler's slowest status poll: the poll backoff maxes at
+    # workflowtimeout/2, and activeDeadlineSeconds carries that timeout
+    # into the manifest — so the floor is max(60s, activeDeadlineSeconds)
     MIN_TTL_SECONDS = 60.0
 
     def _prune(self) -> None:
@@ -97,7 +101,11 @@ class LocalProcessEngine:
                 ttl = float(ttl)
             except (TypeError, ValueError):
                 ttl = self._default_ttl
-            if now - finished > max(ttl, self.MIN_TTL_SECONDS):
+            try:
+                deadline = float(spec.get("activeDeadlineSeconds") or 0)
+            except (TypeError, ValueError):
+                deadline = 0.0
+            if now - finished > max(ttl, self.MIN_TTL_SECONDS, deadline):
                 doomed.append(key)
         for key in doomed:
             self._workflows.pop(key, None)

@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
     p.add_argument("--probe-gb", type=float, default=1.0)
+
+    p = sub.add_parser("all", help="run the whole probe battery in one payload")
+    p.add_argument("--quick", action="store_true", help="smaller/faster variants")
+    p.add_argument(
+        "--skip", action="append", default=[], metavar="PROBE", help="probe to skip"
+    )
     return parser
 
 
@@ -175,6 +181,10 @@ def _dispatch(args) -> int:
         from activemonitor_tpu.probes import memory
 
         result = memory.run(probe_gb=args.probe_gb)
+    elif args.probe == "all":
+        from activemonitor_tpu.probes import suite
+
+        result = suite.run(quick=args.quick, skip=args.skip)
     else:  # pragma: no cover - argparse guards
         raise SystemExit(2)
     return result.emit()

@@ -1,0 +1,86 @@
+"""Aggregate probe suite — the whole battery in one payload.
+
+One workflow, one compile cache, one verdict: runs every applicable
+probe and merges their metrics into a single contract line. The
+natural payload for a single "is this TPU healthy" HealthCheck; probes
+inapplicable to the hardware (rated comparisons on unknown chips,
+multi-device checks on one chip) degrade the way they do individually.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Tuple
+
+from activemonitor_tpu.probes.base import ProbeResult
+
+
+def run(
+    quick: bool = False,
+    skip: Optional[List[str]] = None,
+) -> ProbeResult:
+    skip = set(skip or [])
+    results: List[Tuple[str, ProbeResult]] = []
+
+    def add(name: str, fn) -> None:
+        if name in skip:
+            return
+        try:
+            results.append((name, fn()))
+        except Exception as e:  # a crashing probe is a failing probe
+            results.append(
+                (name, ProbeResult(ok=False, summary=f"{name} crashed: {e!r}"))
+            )
+
+    from activemonitor_tpu.probes import (
+        compile_smoke,
+        decode,
+        devices,
+        hbm,
+        ici,
+        matmul,
+        memory,
+        ring,
+        training_step,
+    )
+
+    iters = 3 if quick else 5
+    add("devices", lambda: devices.run())
+    add("memory", lambda: memory.run(probe_gb=0.5 if quick else 1.0))
+    add("compile-smoke", lambda: compile_smoke.run(tiny=quick))
+    add("matmul", lambda: matmul.run(dim=4096 if quick else 8192, iters=iters))
+    add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
+    add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
+    add(
+        "ring-attention",
+        lambda: ring.run(seq_per_device=256 if quick else 1024, iters=iters),
+    )
+    add(
+        "training-step",
+        lambda: training_step.run(tiny=quick, batch_per_device=4, seq=64),
+    )
+    add(
+        "decode",
+        lambda: decode.run(tiny=quick, batch=4, prompt_len=8, iters=iters),
+    )
+
+    metrics = []
+    failed = []
+    for name, result in results:
+        metrics.extend(result.metrics)
+        status = "OK " if result.ok else "FAIL"
+        print(f"  [{status}] {name}: {result.summary}", file=sys.stderr)
+        if not result.ok:
+            failed.append(name)
+    ok = not failed
+    summary = (
+        f"all {len(results)} probes passed"
+        if ok
+        else f"{len(failed)}/{len(results)} probes failed: {', '.join(failed)}"
+    )
+    return ProbeResult(
+        ok=ok,
+        summary=summary,
+        metrics=metrics,
+        details={"probes_run": len(results), "failed": failed},
+    )

@@ -52,6 +52,7 @@ def chain_delta_seconds(
     k1: int = 4,
     k2: int = 12,
     iters: int = 5,
+    _retries: int = 2,
 ) -> float:
     """Per-op device seconds via the difference method.
 
@@ -59,7 +60,20 @@ def chain_delta_seconds(
     *data-dependent* repetitions of the op and returning a scalar.
     Data dependence matters: independent ops get overlapped or CSE'd by
     XLA and the difference collapses to zero.
+
+    When the measured difference is inside the noise floor (ops much
+    faster than dispatch jitter — tiny payloads, fast hardware), the
+    chain is lengthened and remeasured up to ``_retries`` times so the
+    delta towers over the noise instead of reporting a garbage rate.
+    Each retry reuses the longer chain's timing as its new short-chain
+    baseline rather than re-running it.
     """
     t1 = min_readback_seconds(make_chain(k1), *args, iters=iters)
     t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
+    for _ in range(_retries):
+        if (t2 - t1) >= max(0.05 * t1, 1e-3):
+            break
+        k1, t1 = k2, t2
+        k2 = k2 * 4
+        t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
     return max((t2 - t1) / (k2 - k1), 1e-9)

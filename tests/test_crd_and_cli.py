@@ -121,3 +121,53 @@ spec: {repeatAfterSec: 60, level: cluster}
     doc = json.loads(capsys.readouterr().out)
     assert doc["spec"]["repeatAfterSec"] == 60
     assert main(["get", "hc", "ghost", "--store", store]) == 1
+
+
+def test_cli_describe(tmp_path, capsys):
+    manifest = tmp_path / "hc.yaml"
+    manifest.write_text(
+        """
+apiVersion: activemonitor.keikoproj.io/v1alpha1
+kind: HealthCheck
+metadata: {name: desc-check, namespace: default}
+spec: {repeatAfterSec: 60, level: cluster}
+"""
+    )
+    store = str(tmp_path / "store")
+    assert main(["apply", "--store", store, "-f", str(manifest)]) == 0
+    capsys.readouterr()
+    assert main(["describe", "desc-check", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "Name:       desc-check" in out
+    assert "repeatAfterSec: 60" in out
+    assert "Events (0 recorded):" in out
+    assert main(["describe", "ghost", "--store", store]) == 1
+
+
+def test_file_event_recorder_persists_and_caps(tmp_path):
+    from activemonitor_tpu.api import HealthCheck
+    from activemonitor_tpu.controller.events import FileEventRecorder
+
+    hc = HealthCheck.from_dict(
+        {"metadata": {"name": "ev", "namespace": "default"}, "spec": {}}
+    )
+    rec = FileEventRecorder(str(tmp_path), max_lines=10)
+    for i in range(25):
+        rec.event(hc, "Normal", "Normal", f"message-{i}")
+    events = FileEventRecorder.read_events(str(tmp_path), "default", "ev")
+    assert len(events) <= 10
+    assert events[-1]["message"] == "message-24"
+
+
+def test_probe_suite_quick(capsys):
+    from activemonitor_tpu.probes import suite
+
+    result = suite.run(
+        quick=True,
+        skip=["matmul", "hbm", "ici-allreduce", "ring-attention", "training-step", "decode"],
+    )
+    assert result.ok
+    assert result.details["probes_run"] == 3  # devices, memory, compile-smoke
+    names = {m.name for m in result.metrics}
+    assert "tpu-device-count" in names
+    assert "xla-compile-seconds" in names
