@@ -116,7 +116,6 @@ async def _run(args) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     from activemonitor_tpu.api.types import HealthCheck
-    from activemonitor_tpu.controller.events import EventRecorder
     from activemonitor_tpu.controller.leader import AlwaysLeader, FileLeaderElector
     from activemonitor_tpu.controller.manager import Manager
     from activemonitor_tpu.controller.rbac import InMemoryRBACBackend, RBACProvisioner
@@ -230,19 +229,24 @@ async def _get(args) -> int:
 
     from activemonitor_tpu.controller.client_file import FileHealthCheckClient
 
+    if args.watch and args.output != "table":
+        print("--watch only supports table output", file=sys.stderr)
+        return 2
     client = FileHealthCheckClient(args.store)
     # name lookups are namespace-scoped like kubectl (default ns when
     # -n is unset) so the output shape never depends on collisions
     namespace = args.namespace or ("default" if args.name else None)
-    checks = await client.list(namespace)
-    if args.name:
-        checks = [hc for hc in checks if hc.metadata.name == args.name]
-        if not checks:
-            print(f"healthcheck {args.name!r} not found", file=sys.stderr)
-            return 1
-    if getattr(args, "watch", False) and args.output != "table":
-        print("--watch only supports table output", file=sys.stderr)
-        return 2
+
+    async def fetch():
+        checks = await client.list(namespace)
+        if args.name:
+            checks = [hc for hc in checks if hc.metadata.name == args.name]
+        return checks
+
+    checks = await fetch()
+    if args.name and not checks:
+        print(f"healthcheck {args.name!r} not found", file=sys.stderr)
+        return 1
     if args.output in ("yaml", "json"):
         docs = [hc.to_dict() for hc in checks]
         if args.output == "yaml":
@@ -267,14 +271,12 @@ async def _get(args) -> int:
             print("  ".join(str(r[h]).ljust(w) for h, w in zip(headers, widths)))
 
     print_table(checks)
-    if getattr(args, "watch", False):
+    if args.watch:
         last = [hc.to_dict() for hc in checks]
         try:
             while True:
                 await asyncio.sleep(1.0)
-                checks = await client.list(namespace)
-                if args.name:
-                    checks = [hc for hc in checks if hc.metadata.name == args.name]
+                checks = await fetch()
                 current = [hc.to_dict() for hc in checks]
                 if current != last:
                     last = current
@@ -296,19 +298,17 @@ async def _describe(args) -> int:
     if hc is None:
         print(f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr)
         return 1
+    def print_indented(doc) -> None:
+        for line in _yaml.safe_dump(doc, sort_keys=False).splitlines():
+            print(f"  {line}")
+
     print(f"Name:       {hc.metadata.name}")
     print(f"Namespace:  {hc.metadata.namespace}")
     print(f"Status:     {hc.status.status or '<none>'}")
     print("Spec:")
-    for line in _yaml.safe_dump(
-        hc.spec.to_json_dict(), sort_keys=False
-    ).splitlines():
-        print(f"  {line}")
+    print_indented(hc.spec.to_json_dict())
     print("Status detail:")
-    for line in _yaml.safe_dump(
-        hc.status.to_json_dict(), sort_keys=False, default_flow_style=False
-    ).splitlines():
-        print(f"  {line}")
+    print_indented(hc.status.to_json_dict())
     events = FileEventRecorder.read_events(args.store, args.namespace, args.name)
     print(f"Events ({len(events)} recorded):")
     for ev in events[-20:]:

@@ -101,7 +101,11 @@ class FileEventRecorder(EventRecorder):
             if count is None:
                 count = len(path.read_text().splitlines()) if path.exists() else 0
             if count >= self._max_lines:
-                lines = path.read_text().splitlines()[-(self._max_lines - 1):]
+                # trim to a low watermark so the cap is hit (and the
+                # file rewritten) once per max_lines/2 events, not on
+                # every append thereafter
+                keep = self._max_lines // 2
+                lines = path.read_text().splitlines()[-keep:]
                 path.write_text("\n".join(lines) + "\n")
                 count = len(lines)
             with path.open("a") as f:

@@ -131,6 +131,8 @@ class Manager:
     async def _goodput_loop(self, interval: float = 30.0) -> None:
         """Periodically roll up fleet health: the fraction of scheduled
         checks whose latest run succeeded within 2x their cadence."""
+        from activemonitor_tpu.scheduler import parse_cron
+
         clock = self.reconciler.clock
         while True:
             try:
@@ -152,8 +154,6 @@ class Manager:
                         # cron period around now (handles non-uniform crons
                         # approximately: the gap between the next two fires)
                         try:
-                            from activemonitor_tpu.scheduler import parse_cron
-
                             sched = parse_cron(hc.spec.schedule.cron)
                             fire1 = sched.next(now)
                             interval_s = (sched.next(fire1) - fire1).total_seconds()

@@ -126,7 +126,11 @@ class LocalProcessEngine:
         try:
             await self._run_inner(manifest)
         finally:
-            self._finished_at[key] = time.monotonic()
+            # only the task currently owning the key may stamp it:
+            # a stale overlapping run must not mark a resubmitted
+            # RUNNING workflow as finished (and thus prunable)
+            if self._tasks.get(key) is asyncio.current_task():
+                self._finished_at[key] = time.monotonic()
 
     async def _run_inner(self, manifest: dict) -> None:
         spec = manifest.get("spec") or {}

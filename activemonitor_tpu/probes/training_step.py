@@ -138,13 +138,19 @@ def run(
     t_small, _ = timed_chain(k_small)
     t_big, last_loss = timed_chain(k_big)
     # lengthen the chain when the delta is inside the noise floor
-    # (tiny models on fast hardware), mirroring chain_delta_seconds;
+    # (tiny models on fast hardware) — same policy as chain_delta_seconds;
     # the longer chain's timing becomes the next baseline (no re-run)
-    for _ in range(2):
-        if (t_big - t_small) >= max(0.05 * t_small, 1e-3):
+    from activemonitor_tpu.utils.timing import (
+        CHAIN_GROWTH,
+        CHAIN_RETRIES,
+        needs_longer_chain,
+    )
+
+    for _ in range(CHAIN_RETRIES):
+        if not needs_longer_chain(t_small, t_big):
             break
         k_small, t_small = k_big, t_big
-        k_big = k_big * 4
+        k_big = k_big * CHAIN_GROWTH
         t_big, last_loss = timed_chain(k_big)
     step_seconds = max((t_big - t_small) / (k_big - k_small), 1e-9)
     losses.append(last_loss)

@@ -46,13 +46,25 @@ def _readback_samples(fn: Callable, *args, iters: int, warmup: int) -> list:
     return samples
 
 
+# shared noise-floor policy for chain-delta measurements (also used by
+# probes that run their own chains, e.g. the training-step probe)
+CHAIN_GROWTH = 4
+CHAIN_RETRIES = 2
+
+
+def needs_longer_chain(t1: float, t2: float) -> bool:
+    """True when the (t2 - t1) delta is inside the noise floor and the
+    chain should be lengthened before trusting the rate."""
+    return (t2 - t1) < max(0.05 * t1, 1e-3)
+
+
 def chain_delta_seconds(
     make_chain: Callable[[int], Callable],
     *args,
     k1: int = 4,
     k2: int = 12,
     iters: int = 5,
-    _retries: int = 2,
+    _retries: int = CHAIN_RETRIES,
 ) -> float:
     """Per-op device seconds via the difference method.
 
@@ -71,9 +83,9 @@ def chain_delta_seconds(
     t1 = min_readback_seconds(make_chain(k1), *args, iters=iters)
     t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
     for _ in range(_retries):
-        if (t2 - t1) >= max(0.05 * t1, 1e-3):
+        if not needs_longer_chain(t1, t2):
             break
         k1, t1 = k2, t2
-        k2 = k2 * 4
+        k2 = k2 * CHAIN_GROWTH
         t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
     return max((t2 - t1) / (k2 - k1), 1e-9)
