@@ -335,7 +335,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "get": _get,
         "describe": _describe,
     }[args.command]
-    return asyncio.run(handler(args))
+    from activemonitor_tpu.errors import MissingDependencyError
+
+    try:
+        return asyncio.run(handler(args))
+    except MissingDependencyError as e:
+        # missing optional backend (e.g. cluster mode without the
+        # kubernetes package) reads as a usage error, not a crash
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -15,6 +15,8 @@ import threading
 from typing import AsyncIterator, List, Optional
 
 from activemonitor_tpu import GROUP, VERSION
+
+from activemonitor_tpu.errors import MissingDependencyError
 from activemonitor_tpu.api.types import HealthCheck
 from activemonitor_tpu.controller.client import (
     ConflictError,
@@ -32,7 +34,7 @@ class KubernetesHealthCheckClient:
         try:
             from kubernetes import client, config  # type: ignore
         except ImportError as e:
-            raise RuntimeError(
+            raise MissingDependencyError(
                 "the 'kubernetes' package is required for cluster mode; "
                 "use the file-backed store (--client file) instead"
             ) from e

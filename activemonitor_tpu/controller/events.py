@@ -17,6 +17,8 @@ from typing import Deque, List
 
 from activemonitor_tpu.api.types import HealthCheck
 
+from activemonitor_tpu.errors import MissingDependencyError
+
 log = logging.getLogger("activemonitor.events")
 
 EVENT_NORMAL = "Normal"
@@ -142,7 +144,7 @@ class KubernetesEventRecorder(EventRecorder):  # pragma: no cover - needs a clus
         try:
             from kubernetes import client  # type: ignore
         except ImportError as e:
-            raise RuntimeError(
+            raise MissingDependencyError(
                 "the 'kubernetes' package is required for KubernetesEventRecorder"
             ) from e
         from concurrent.futures import ThreadPoolExecutor

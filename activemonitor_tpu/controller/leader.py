@@ -18,6 +18,8 @@ import asyncio
 import os
 from typing import Protocol
 
+from activemonitor_tpu.errors import MissingDependencyError
+
 ELECTION_ID = "689451f8.keikoproj.io"  # parity with the reference
 
 
@@ -84,7 +86,7 @@ class KubernetesLeaseElector:  # pragma: no cover - needs a cluster
         try:
             from kubernetes import client  # type: ignore  # noqa: F401
         except ImportError as e:
-            raise RuntimeError(
+            raise MissingDependencyError(
                 "the 'kubernetes' package is required for KubernetesLeaseElector"
             ) from e
         import socket

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from activemonitor_tpu.errors import MissingDependencyError
+
 WF_GROUP = "argoproj.io"
 WF_VERSION = "v1alpha1"
 WF_PLURAL = "workflows"
@@ -39,7 +41,7 @@ class ArgoWorkflowEngine:
         try:
             from kubernetes import client, config  # type: ignore
         except ImportError as e:  # pragma: no cover - depends on environment
-            raise RuntimeError(
+            raise MissingDependencyError(
                 "the 'kubernetes' package is required for ArgoWorkflowEngine; "
                 "use LocalProcessEngine or FakeWorkflowEngine instead"
             ) from e

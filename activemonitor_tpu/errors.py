@@ -1,0 +1,6 @@
+"""Shared exception types."""
+
+
+class MissingDependencyError(RuntimeError):
+    """An optional backend's package is not installed (e.g. cluster mode
+    without ``kubernetes``). The CLI turns this into a usage error."""

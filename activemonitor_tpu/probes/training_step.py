@@ -35,6 +35,11 @@ from activemonitor_tpu.models.probe_model import (
 from activemonitor_tpu.parallel.mesh import make_2d_mesh
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
+from activemonitor_tpu.utils.timing import (
+    CHAIN_GROWTH,
+    CHAIN_RETRIES,
+    needs_longer_chain,
+)
 
 
 def build_sharded_train_step(
@@ -140,12 +145,6 @@ def run(
     # lengthen the chain when the delta is inside the noise floor
     # (tiny models on fast hardware) — same policy as chain_delta_seconds;
     # the longer chain's timing becomes the next baseline (no re-run)
-    from activemonitor_tpu.utils.timing import (
-        CHAIN_GROWTH,
-        CHAIN_RETRIES,
-        needs_longer_chain,
-    )
-
     for _ in range(CHAIN_RETRIES):
         if not needs_longer_chain(t_small, t_big):
             break
