@@ -50,9 +50,8 @@ def _payload(size_mb: float, dtype) -> tuple[int, int, int]:
     return rows, cols, rows * cols * itemsize
 
 
-def _sharded_chain(mesh: Mesh, body, k: int):
+def _sharded_chain(mesh: Mesh, body, k: int, axis: str):
     """jit(shard_map(chain of k body applications)) ending in a scalar."""
-    axis = mesh.axis_names[0]
 
     @jax.jit
     @partial(
@@ -72,11 +71,17 @@ def _sharded_chain(mesh: Mesh, body, k: int):
 
 
 def all_reduce_bandwidth(
-    mesh: Mesh, size_mb: float = 64.0, dtype=jnp.bfloat16, iters: int = 5
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    axis: str = "",
 ) -> CollectiveResult:
-    """Chained psum all-reduce over the mesh's first axis."""
-    axis = mesh.axis_names[0]
-    n = mesh.devices.size
+    """Chained psum all-reduce over ``axis`` (default: the mesh's first
+    axis — pass "dcn" on a multihost mesh to measure the cross-host
+    direction; the other axes stay replicated)."""
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
     rows, cols, payload_bytes = _payload(size_mb, dtype)
     inv_n = jnp.asarray(1.0 / n, dtype)
 
@@ -85,7 +90,7 @@ def all_reduce_bandwidth(
 
     x = jnp.ones((rows * n, cols), dtype=dtype)
     seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k), x, k1=2, k2=6, iters=iters
+        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
     )
     algbw = payload_bytes / seconds / 1e9
     busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
@@ -100,13 +105,17 @@ def all_reduce_bandwidth(
 
 
 def all_gather_bandwidth(
-    mesh: Mesh, size_mb: float = 64.0, dtype=jnp.bfloat16, iters: int = 5
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    axis: str = "",
 ) -> CollectiveResult:
     """Chained all-gather; each round gathers all shards then reduces
     back to shard shape (the reduce keeps rounds data-dependent — its
     local cost is included, so this slightly understates pure comm bw)."""
-    axis = mesh.axis_names[0]
-    n = mesh.devices.size
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
     rows, cols, shard_bytes = _payload(size_mb, dtype)
     inv_n = jnp.asarray(1.0 / n, dtype)
 
@@ -116,7 +125,7 @@ def all_gather_bandwidth(
 
     x = jnp.ones((rows * n, cols), dtype=dtype)
     seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k), x, k1=2, k2=6, iters=iters
+        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
     )
     total_bytes = shard_bytes * n
     algbw = total_bytes / seconds / 1e9
@@ -132,12 +141,16 @@ def all_gather_bandwidth(
 
 
 def ppermute_ring_bandwidth(
-    mesh: Mesh, size_mb: float = 64.0, dtype=jnp.bfloat16, iters: int = 5
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    axis: str = "",
 ) -> CollectiveResult:
     """Chained neighbor-shift over a ring — isolates single-hop ICI link
     speed (the building block of ring attention / pipelined collectives)."""
-    axis = mesh.axis_names[0]
-    n = mesh.devices.size
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
     rows, cols, payload_bytes = _payload(size_mb, dtype)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -146,7 +159,7 @@ def ppermute_ring_bandwidth(
 
     x = jnp.ones((rows * n, cols), dtype=dtype)
     seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k), x, k1=2, k2=6, iters=iters
+        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
     )
     algbw = payload_bytes / seconds / 1e9
     return CollectiveResult(

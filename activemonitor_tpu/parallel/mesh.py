@@ -55,3 +55,26 @@ def make_2d_mesh(
     if shape[0] * shape[1] != len(devices):
         raise ValueError(f"mesh shape {shape} does not fit {len(devices)} devices")
     return Mesh(np.array(devices).reshape(shape), axes)
+
+
+def make_multihost_mesh(axes: Tuple[str, str] = ("dcn", "ici")) -> Mesh:
+    """Hierarchical mesh for multi-host runs: the outer axis spans
+    processes (hosts — traffic rides DCN between slices/hosts), the
+    inner axis spans each host's local devices (traffic rides ICI).
+    Requires jax.distributed to be initialized so all hosts share one
+    global device set. Devices are grouped by owning process so the
+    outer axis really is the cross-host direction."""
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_proc = jax.process_count()
+    counts: dict = {}
+    for d in devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    if len(set(counts.values())) != 1:
+        # unequal per-host device counts would silently mix intra- and
+        # cross-host traffic on the "dcn" axis after the reshape
+        raise ValueError(
+            f"uneven devices per process ({counts}); cannot form a "
+            "rectangular (dcn, ici) mesh"
+        )
+    local = len(devices) // n_proc
+    return Mesh(np.array(devices).reshape(n_proc, local), axes)

@@ -30,6 +30,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="force jax.distributed.initialize (multi-host slices; "
         "auto-detected from TPU_WORKER_HOSTNAMES otherwise)",
     )
+    parser.add_argument(
+        "--coordinator",
+        default=None,
+        metavar="HOST:PORT",
+        help="explicit jax.distributed coordinator (implies --distributed)",
+    )
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     sub = parser.add_subparsers(dest="probe", required=True)
 
     p = sub.add_parser("devices", help="device inventory check")
@@ -86,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
     p.add_argument("--probe-gb", type=float, default=1.0)
 
+    p = sub.add_parser(
+        "dcn-allreduce", help="cross-host all-reduce bandwidth + correctness"
+    )
+    p.add_argument("--size-mb", type=float, default=16.0)
+    p.add_argument("--iters", type=int, default=4)
+
     p = sub.add_parser("all", help="run the whole probe battery in one payload")
     p.add_argument("--quick", action="store_true", help="smaller/faster variants")
     p.add_argument(
@@ -98,7 +112,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     from activemonitor_tpu.parallel.distributed import maybe_initialize_distributed
 
-    maybe_initialize_distributed(force=args.distributed)
+    if (
+        args.num_processes is not None or args.process_id is not None
+    ) and not (args.coordinator or args.distributed):
+        print(
+            "error: --num-processes/--process-id require --coordinator "
+            "(or --distributed)",
+            file=sys.stderr,
+        )
+        return 2
+    maybe_initialize_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        force=args.distributed,
+    )
 
     if args.profile:
         import jax
@@ -181,6 +209,10 @@ def _dispatch(args) -> int:
         from activemonitor_tpu.probes import memory
 
         result = memory.run(probe_gb=args.probe_gb)
+    elif args.probe == "dcn-allreduce":
+        from activemonitor_tpu.probes import dcn
+
+        result = dcn.run(size_mb=args.size_mb, iters=args.iters)
     elif args.probe == "all":
         from activemonitor_tpu.probes import suite
 

@@ -63,6 +63,11 @@ def run(
         "decode",
         lambda: decode.run(tiny=quick, batch=4, prompt_len=8, iters=iters),
     )
+    from activemonitor_tpu.probes import dcn
+
+    # informational pass on single-process runs; real coverage on
+    # multi-host slices where jax.distributed is initialized
+    add("dcn-allreduce", lambda: dcn.run(size_mb=4 if quick else 16, iters=iters))
 
     metrics = []
     failed = []
