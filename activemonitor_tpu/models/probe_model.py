@@ -18,6 +18,7 @@ the down-projections, riding ICI.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -106,23 +107,46 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
 
+def apply_block(
+    x: jax.Array, layer: Dict, cfg: ProbeModelConfig, attention_fn=None
+) -> jax.Array:
+    """One decoder block on [B, S, D]. ``attention_fn(q, k, v) -> attn``
+    overrides the attention mechanism (ring attention for the
+    context-parallel path); the default is dense causal. Shared by the
+    dense, context-parallel, and pipeline-parallel forwards so the
+    paths cannot drift."""
+    dt = cfg.dtype
+    if attention_fn is None:
+        attention_fn = partial(dense_causal_attention, cfg=cfg)
+    h = _rmsnorm(x, layer["ln1"]["scale"])
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
+    attn = attention_fn(qkv[0], qkv[1], qkv[2])  # [B, S, H, K]
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
+    h = _rmsnorm(x, layer["ln2"]["scale"])
+    up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt)))
+    return x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(dt))
+
+
+def dense_causal_attention(q, k, v, cfg: ProbeModelConfig):
+    dt = cfg.dtype
+    seq = q.shape[1]
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(cfg.head_dim, dt)
+    )
+    scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e9, dt))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+    return jnp.einsum("bhst,bthk->bshk", probs, v)
+
+
 def _forward_with_attention(
     params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn
 ) -> jax.Array:
-    """Shared decoder body: ``attention_fn(q, k, v) -> attn`` supplies
-    the attention mechanism (dense causal, or ring attention for the
-    context-parallel path) — everything else is identical by
-    construction, so the two paths cannot drift."""
+    """Shared decoder body around :func:`apply_block`."""
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]  # [B, S, D]
     for layer in params["layers"]:
-        h = _rmsnorm(x, layer["ln1"]["scale"])
-        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
-        attn = attention_fn(qkv[0], qkv[1], qkv[2])  # [B, S, H, K]
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
-        h = _rmsnorm(x, layer["ln2"]["scale"])
-        up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt)))
-        x = x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(dt))
+        x = apply_block(x, layer, cfg, attention_fn)
     x = _rmsnorm(x, params["final_ln"]["scale"])
     return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt)).astype(jnp.float32)
 
@@ -130,19 +154,9 @@ def _forward_with_attention(
 def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V]. Jit-friendly: static
     shapes, lax-only control flow, bf16 compute."""
-    dt = cfg.dtype
-    seq = tokens.shape[1]
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-
-    def dense_attention(q, k, v):
-        scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
-            jnp.asarray(cfg.head_dim, dt)
-        )
-        scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e9, dt))
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
-        return jnp.einsum("bhst,bthk->bshk", probs, v)
-
-    return _forward_with_attention(params, tokens, cfg, dense_attention)
+    return _forward_with_attention(
+        params, tokens, cfg, partial(dense_causal_attention, cfg=cfg)
+    )
 
 
 def loss_fn(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:

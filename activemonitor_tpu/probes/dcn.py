@@ -70,7 +70,17 @@ def run(size_mb: float = 16.0, iters: int = 4) -> ProbeResult:
     expected = jnp.broadcast_to(x.sum(axis=0), (1, local))
     correct = bool(jnp.allclose(got, expected))
 
-    result = all_reduce_bandwidth(mesh, size_mb=size_mb, iters=iters, axis="dcn")
+    # bandwidth is measured over ONE device per host: on the full
+    # (dcn, ici) mesh the payload would be replicated across the ici
+    # axis and every local device would run an identical concurrent
+    # psum group, contending for the same NICs while the accounting
+    # counted only one group's bytes — understating busbw by the
+    # per-host device count.
+    representatives = [mesh.devices[p, 0] for p in range(n_proc)]
+    from activemonitor_tpu.parallel.mesh import make_1d_mesh
+
+    bw_mesh = make_1d_mesh("dcn", devices=representatives)
+    result = all_reduce_bandwidth(bw_mesh, size_mb=size_mb, iters=iters, axis="dcn")
     metrics = [
         ProbeMetric("dcn-hosts", n_proc, help="Number of hosts in the distributed run"),
         ProbeMetric(

@@ -24,7 +24,12 @@ def test_two_process_dcn_allreduce():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # 1 local device per process keeps it fast
-    env["ACTIVEMONITOR_FORCE_CPU"] = "1"
+    # pick a free port so concurrent/parallel test runs don't collide
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     workers = []
     for rank in range(2):
         workers.append(
@@ -36,7 +41,7 @@ def test_two_process_dcn_allreduce():
                     "import jax; jax.config.update('jax_platforms', 'cpu');"
                     "from activemonitor_tpu.probes.cli import main; import sys;"
                     "sys.exit(main(["
-                    "'--coordinator', '127.0.0.1:19741',"
+                    f"'--coordinator', '127.0.0.1:{port}',"
                     f"'--num-processes', '2', '--process-id', '{rank}',"
                     "'dcn-allreduce', '--size-mb', '1', '--iters', '2']))",
                 ],

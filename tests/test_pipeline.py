@@ -1,0 +1,74 @@
+"""Pipeline-parallelism tests on the 8-stage CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from activemonitor_tpu.models.probe_model import (
+    ProbeModelConfig,
+    apply_block,
+    init_params,
+)
+from activemonitor_tpu.ops.pipeline import pipeline_forward_blocks, stack_layer_params
+from activemonitor_tpu.parallel.mesh import make_1d_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ProbeModelConfig(
+        vocab_size=64,
+        d_model=32,
+        n_heads=2,
+        n_layers=8,
+        d_ff=64,
+        max_seq_len=32,
+        dtype=jnp.float32,  # exact comparison; bf16 differs by summation order
+    )
+    params = init_params(jax.random.key(0), cfg)
+    mesh = make_1d_mesh("pp")
+    x = jax.random.normal(jax.random.key(1), (8, 16, cfg.d_model), jnp.float32)
+    ref = x
+    for layer in params["layers"]:
+        ref = apply_block(ref, layer, cfg)
+    return cfg, params, mesh, x, ref
+
+
+@pytest.mark.parametrize("microbatches", [2, 4, 8])
+def test_pipeline_matches_dense(setup, microbatches):
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    got = pipeline_forward_blocks(
+        stacked, x, cfg, mesh, "pp", num_microbatches=microbatches
+    )
+    assert jnp.max(jnp.abs(got - ref)) < 1e-4
+
+
+def test_pipeline_jits(setup):
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    fn = jax.jit(
+        lambda layers, x: pipeline_forward_blocks(
+            layers, x, cfg, mesh, "pp", num_microbatches=4
+        )
+    )
+    out = fn(stacked, x)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+def test_pipeline_validates_divisibility(setup):
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_forward_blocks(stacked, x, cfg, mesh, "pp", num_microbatches=3)
+    bad = ProbeModelConfig(n_layers=6)
+    bad_params = init_params(jax.random.key(0), bad)
+    bad_stacked = stack_layer_params(bad_params["layers"])
+    with pytest.raises(ValueError, match="stages"):
+        pipeline_forward_blocks(bad_stacked, x, bad, mesh, "pp")
+
+
+def test_stack_layer_params_shapes(setup):
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    assert stacked["wqkv"].shape[0] == cfg.n_layers
+    assert stacked["ln1"]["scale"].shape == (cfg.n_layers, cfg.d_model)
