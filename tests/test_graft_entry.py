@@ -1,0 +1,66 @@
+"""Driver-artifact guards: __graft_entry__ and bench must keep working.
+
+The driver compile-checks entry() single-chip and runs
+dryrun_multichip(N) on a virtual CPU platform; breaking either breaks
+the round's evaluation, so CI pins them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+import __graft_entry__  # conftest puts the repo root on sys.path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_entry_returns_jittable_fn():
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (4, 128, 4096)
+    assert out.dtype.name == "float32"
+
+
+def test_entry_lowers_without_execution():
+    """The driver's compile check only needs lowering to succeed."""
+    fn, args = __graft_entry__.entry()
+    lowered = jax.jit(fn).lower(*args)
+    assert "func" in lowered.as_text()[:2000]
+
+
+def test_dryrun_multichip_full_matrix():
+    # conftest already forces the 8-device virtual CPU platform
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_dryrun_insufficient_devices_errors():
+    with pytest.raises(RuntimeError, match="need 64 devices"):
+        __graft_entry__.dryrun_multichip(64)
+
+
+def test_bench_emits_single_json_line():
+    """bench.py on whatever platform CI has must print exactly one JSON
+    object with the required keys."""
+    result = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+    )
+    assert result.returncode == 0, result.stderr[-800:]
+    lines = [l for l in result.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    doc = json.loads(lines[0])
+    assert set(doc) == {"metric", "value", "unit", "vs_baseline"}
+    assert isinstance(doc["value"], (int, float))
