@@ -493,7 +493,9 @@ class HealthCheckReconciler:
             if workflow is None:
                 return  # parent deleted / GC'd (reference: :806-810)
             status = workflow.get("status") or {}
-            if timed_out:
+            if timed_out and status.get("phase") not in (PHASE_SUCCEEDED, PHASE_FAILED):
+                # same final-poll policy as the healthcheck loop above: a
+                # terminal phase seen at the deadline is honored, not discarded
                 status = {"phase": PHASE_FAILED, "message": PHASE_FAILED}
                 self.recorder.event(
                     hc, EVENT_WARNING, "Warning", "remedy workflow is timedout"

@@ -62,6 +62,8 @@ def moe_ffn_expert_parallel(
     n_experts = params["router"].shape[1]
     if n_experts % n:
         raise ValueError(f"{n_experts} experts do not split over {n} devices")
+    if x.shape[0] % n:
+        raise ValueError(f"{x.shape[0]} tokens do not shard over {n} devices")
     e_local = n_experts // n
 
     @partial(

@@ -158,8 +158,10 @@ def run(
     # train FLOPs ≈ 3 × forward (fwd + bwd ≈ 2× fwd)
     model_flops = 3 * cfg.flops_per_token() * tokens_per_step
     achieved_tflops = model_flops / step_seconds / 1e12
-    devices = jax.devices()
-    rated = rated_for(devices[0].device_kind)
+    # rated peak, platform gate and denominator all follow the mesh the
+    # step actually ran on, not the process-default device set
+    mesh_device = mesh.devices.flat[0]
+    rated = rated_for(mesh_device.device_kind)
     details = {
         "mesh": dict(mesh.shape),
         "params": param_count(cfg),
@@ -185,8 +187,8 @@ def run(
             help="Achieved model FLOP/s (3x fwd convention), TFLOP/s",
         ),
     ]
-    if rated is not None and devices[0].platform == "tpu":
-        mfu = achieved_tflops / (rated.bf16_tflops * len(devices))
+    if rated is not None and mesh_device.platform == "tpu":
+        mfu = achieved_tflops / (rated.bf16_tflops * mesh.devices.size)
         metrics.append(
             ProbeMetric("train-mfu", mfu, help="Model FLOPs utilization vs rated peak")
         )

@@ -40,15 +40,16 @@ def compute_backoff_params(
     Defaults: max = timeout/2, min = timeout/60, both clamped ≥ 1 s;
     factor 0.5 unless the spec's string field parses as a float
     (reference: healthcheck_controller.go:575-605 — unparseable factor
-    logs and falls back, it does not error).
+    logs and falls back, it does not error). Spec values ≤ 0 are treated
+    as unset — a negative delay would otherwise become a hot poll loop.
     """
-    if backoff_max == 0:
+    if backoff_max <= 0:
         max_delay = float(workflow_timeout // 2)
         if max_delay <= 0:
             max_delay = 1.0
     else:
         max_delay = float(backoff_max)
-    if backoff_min == 0:
+    if backoff_min <= 0:
         min_delay = float(workflow_timeout // 60)
         if min_delay <= 0:
             min_delay = 1.0

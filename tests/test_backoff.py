@@ -36,6 +36,13 @@ class TestComputeBackoffParams:
         assert p.min_delay == 1.0
         assert p.factor == 0.1
 
+    def test_negative_spec_values_are_treated_as_unset(self):
+        # a negative delay would become a hot poll loop (asyncio treats
+        # negative sleeps as 0) — fall back to the timeout-derived defaults
+        p = compute_backoff_params(workflow_timeout=600, backoff_max=-5, backoff_min=-1)
+        assert p.max_delay == 300.0
+        assert p.min_delay == 10.0
+
     def test_bad_factor_falls_back(self):
         # reference: healthcheck_controller.go:595-601 logs and keeps 0.5
         p = compute_backoff_params(workflow_timeout=60, backoff_factor="not-a-float")

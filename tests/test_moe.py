@@ -41,6 +41,13 @@ def test_expert_count_must_divide(mesh):
         moe_ffn_expert_parallel(params, x, mesh, "ep")
 
 
+def test_token_count_must_divide(mesh):
+    params = init_moe_params(jax.random.key(0), d_model=32, d_ff=64, n_experts=8)
+    x = jnp.zeros((17, 32), jnp.float32)
+    with pytest.raises(ValueError, match="tokens"):
+        moe_ffn_expert_parallel(params, x, mesh, "ep")
+
+
 def test_all_experts_used_somewhere(mesh):
     """Sanity: with enough random tokens, routing spreads across experts
     (a degenerate router would silently under-test expert parallelism)."""

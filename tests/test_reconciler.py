@@ -525,3 +525,21 @@ async def test_terminal_phase_on_final_poll_wins_over_timeout():
     st = await h.status()
     assert st.status == "Succeeded"
     assert st.failed_count == 0
+
+
+@pytest.mark.asyncio
+async def test_remedy_terminal_phase_on_final_poll_wins_over_timeout():
+    """Same final-poll policy for the remedy loop: a remedy that reached a
+    terminal phase right at the deadline is not miscounted as failed."""
+    h = Harness()
+    h.engine.on_prefix("check-", fail_after(1))
+    # remedy stays pending through the deadline; the final (post-timeout)
+    # poll observes Succeeded
+    h.engine.on_prefix("remedy-", succeed_after(5))
+    await h.apply_and_reconcile(make_hc(timeout=4, remedy=True))
+    await h.clock.advance(30)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.remedy_status == "Succeeded"
+    assert st.remedy_success_count == 1
+    assert st.remedy_failed_count == 0
