@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="directory of HealthCheck YAML specs (file-backed store)",
     )
     run.add_argument(
+        "--kubeconfig",
+        default=None,
+        help="kubeconfig path for cluster mode (default: $KUBECONFIG, "
+        "then in-cluster credentials, then ~/.kube/config)",
+    )
+    run.add_argument(
         "-f",
         "--filename",
         action="append",
@@ -115,6 +121,27 @@ async def _run(args) -> int:
         level=args.log_level.upper(),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    client_kind = args.client or ("k8s" if args.engine == "argo" else "file")
+    # one REST session shared by every cluster-facing component
+    kube_api = None
+    kube_cfg = None
+    if client_kind == "k8s" or args.engine == "argo":
+        from activemonitor_tpu.kube import KubeApi
+        from activemonitor_tpu.kube.config import load_kube_config
+
+        kube_cfg = load_kube_config(getattr(args, "kubeconfig", None))
+        kube_api = KubeApi(kube_cfg)
+    # the session must outlive everything built on it and close on EVERY
+    # exit path, including construction failures — hence the try begins
+    # immediately after the session exists
+    try:
+        return await _run_controller(args, client_kind, kube_api, kube_cfg)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+
+
+async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
     from activemonitor_tpu.api.types import HealthCheck
     from activemonitor_tpu.controller.leader import AlwaysLeader, FileLeaderElector
     from activemonitor_tpu.controller.manager import Manager
@@ -122,23 +149,32 @@ async def _run(args) -> int:
     from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
     from activemonitor_tpu.metrics.collector import MetricsCollector
 
-    client_kind = args.client or ("k8s" if args.engine == "argo" else "file")
     if client_kind == "k8s":
         from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
         from activemonitor_tpu.controller.events import KubernetesEventRecorder
 
-        client = KubernetesHealthCheckClient()
-        recorder = KubernetesEventRecorder()
+        client = KubernetesHealthCheckClient(kube_api)
+        recorder = KubernetesEventRecorder(kube_api)
     else:
         from activemonitor_tpu.controller.client_file import FileHealthCheckClient
         from activemonitor_tpu.controller.events import FileEventRecorder
 
         client = FileHealthCheckClient(args.store)
         recorder = FileEventRecorder(args.store)
+    if kube_api is not None:
+        # whenever a cluster is in play (k8s store OR argo engine), the
+        # per-check RBAC that submitted workflows reference must be real
+        # cluster state (reference: healthcheck_controller.go:302-415,
+        # 1128-1443) — an in-memory SA would leave probe pods Forbidden
+        from activemonitor_tpu.controller.rbac import KubernetesRBACBackend
+
+        rbac_backend = KubernetesRBACBackend(kube_api)
+    else:
+        rbac_backend = InMemoryRBACBackend()
     if args.engine == "argo":
         from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
 
-        engine = ArgoWorkflowEngine()
+        engine = ArgoWorkflowEngine(kube_api)
     else:
         from activemonitor_tpu.engine.local import LocalProcessEngine
 
@@ -148,7 +184,11 @@ async def _run(args) -> int:
         if client_kind == "k8s":
             from activemonitor_tpu.controller.leader import KubernetesLeaseElector
 
-            elector = KubernetesLeaseElector()
+            # the Lease lives in the namespace the controller runs in
+            # (in-cluster SA namespace / kubeconfig context namespace)
+            elector = KubernetesLeaseElector(
+                kube_api, namespace=kube_cfg.namespace or "default"
+            )
         else:
             # flock is per-host: only meaningful for co-hosted replicas
             elector = FileLeaderElector()
@@ -158,7 +198,7 @@ async def _run(args) -> int:
     reconciler = HealthCheckReconciler(
         client=client,
         engine=engine,
-        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        rbac=RBACProvisioner(rbac_backend),
         recorder=recorder,
         metrics=MetricsCollector(),
     )
@@ -185,16 +225,43 @@ async def _run(args) -> int:
     stop = asyncio.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
-    await manager.start()
-    logging.getLogger("activemonitor").info(
-        "controller running: store=%s engine=%s workers=%d",
-        args.store,
-        args.engine,
-        args.max_workers,
-    )
-    await stop.wait()
-    await manager.stop()
-    return 0
+    # start as a task: a standby replica blocks inside the election until
+    # it wins, and SIGTERM must still shut it down gracefully meanwhile
+    start_task = asyncio.create_task(manager.start())
+    stop_wait = asyncio.ensure_future(stop.wait())
+    lost_leadership = False
+    try:
+        await asyncio.wait(
+            {start_task, stop_wait}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if not start_task.done():
+            # signalled while standing by for leadership
+            start_task.cancel()
+            await asyncio.gather(start_task, return_exceptions=True)
+            return 0
+        start_task.result()  # propagate startup failures
+        logging.getLogger("activemonitor").info(
+            "controller running: store=%s engine=%s workers=%d",
+            args.store,
+            args.engine,
+            args.max_workers,
+        )
+        # stop on signal OR on the manager stopping itself (leadership lost)
+        stopping_wait = asyncio.ensure_future(manager.stopping.wait())
+        await asyncio.wait(
+            {stop_wait, stopping_wait}, return_when=asyncio.FIRST_COMPLETED
+        )
+        stopping_wait.cancel()
+        # a self-initiated stop without a signal means leadership was
+        # lost: exit non-zero so the orchestrator restarts this replica
+        # into the candidate pool (controller-runtime exits fatally too)
+        lost_leadership = manager.stopping.is_set() and not stop.is_set()
+    finally:
+        # teardown runs on every path, including startup failures —
+        # otherwise bound sockets stay held
+        stop_wait.cancel()
+        await manager.stop()
+    return 1 if lost_leadership else 0
 
 
 async def _apply(args) -> int:

@@ -18,6 +18,7 @@ from activemonitor_tpu.controller.rbac import (
     DEFAULT_HEALTHCHECK_RULES,
     DEFAULT_REMEDY_RULES,
     InMemoryRBACBackend,
+    KubernetesRBACBackend,
     MANAGED_BY_LABEL_KEY,
     MANAGED_BY_VALUE,
     RBACError,
@@ -46,6 +47,7 @@ __all__ = [
     "HealthCheckReconciler",
     "InMemoryHealthCheckClient",
     "InMemoryRBACBackend",
+    "KubernetesRBACBackend",
     "MANAGED_BY_LABEL_KEY",
     "MANAGED_BY_VALUE",
     "NotFoundError",

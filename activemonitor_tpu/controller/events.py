@@ -17,8 +17,6 @@ from typing import Deque, List
 
 from activemonitor_tpu.api.types import HealthCheck
 
-from activemonitor_tpu.errors import MissingDependencyError
-
 log = logging.getLogger("activemonitor.events")
 
 EVENT_NORMAL = "Normal"
@@ -133,72 +131,90 @@ class FileEventRecorder(EventRecorder):
         return out
 
 
-class KubernetesEventRecorder(EventRecorder):  # pragma: no cover - needs a cluster
+class KubernetesEventRecorder(EventRecorder):
     """Also posts core/v1 Events against the HealthCheck object, like the
     reference's record.EventRecorder (reference: healthcheck_controller.go:135,
-    ~40 call sites). Import-gated on ``kubernetes``; failures to post are
+    ~40 call sites). Built on the native REST layer; failures to post are
     logged, never raised — events are best-effort."""
 
-    def __init__(self, api_client=None, component: str = "active-monitor-tpu"):
+    def __init__(self, api=None, component: str = "active-monitor-tpu"):
         super().__init__()
-        try:
-            from kubernetes import client  # type: ignore
-        except ImportError as e:
-            raise MissingDependencyError(
-                "the 'kubernetes' package is required for KubernetesEventRecorder"
-            ) from e
-        from concurrent.futures import ThreadPoolExecutor
+        if api is None:
+            from activemonitor_tpu.kube import KubeApi
 
-        self._core = client.CoreV1Api(api_client)
+            api = KubeApi.from_default_config()
+        self._api = api
         self._component = component
-        # posts happen off-thread: recorder.event() is called from async
-        # reconcile paths and a blocking API-server POST would freeze
-        # the whole event loop
-        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="events")
+        # posts are serialized through a bounded queue drained by one
+        # task: recorder.event() is a sync call on async reconcile paths
+        # and must never block on the API server
+        import asyncio
+
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self._worker: asyncio.Task | None = None
 
     def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
         super().event(hc, type_, reason, message)
-        import datetime as _dt
+        import asyncio
         import uuid
 
-        from kubernetes import client  # type: ignore
-
         namespace = hc.metadata.namespace or "default"
-        now = _dt.datetime.now(_dt.timezone.utc)
-        body = client.CoreV1Event(
-            metadata=client.V1ObjectMeta(
-                name=f"{hc.metadata.name}.{uuid.uuid4().hex[:12]}",
-                namespace=namespace,
-            ),
-            involved_object=client.V1ObjectReference(
-                api_version=hc.api_version,
-                kind=hc.kind,
-                name=hc.metadata.name,
-                namespace=namespace,  # must match the event's namespace
-                uid=hc.metadata.uid or None,
-            ),
-            reason=reason,
-            message=message,
-            type=type_,
-            source=client.V1EventSource(component=self._component),
-            first_timestamp=now,
-            last_timestamp=now,
-            count=1,
-        )
-        self._executor.submit(self._post, namespace, body, hc.key)
-
-    def _post(self, namespace: str, body, key: str) -> None:
+        now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        body = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{hc.metadata.name}.{uuid.uuid4().hex[:12]}",
+                "namespace": namespace,
+            },
+            "involvedObject": {
+                "apiVersion": hc.api_version,
+                "kind": hc.kind,
+                "name": hc.metadata.name,
+                "namespace": namespace,  # must match the event's namespace
+                "uid": hc.metadata.uid or None,
+            },
+            "reason": reason,
+            "message": message,
+            "type": type_,
+            "source": {"component": self._component},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
         try:
-            # bounded request time: a hung API server must not pin the
-            # worker thread (and with it the post queue) forever
-            self._core.create_namespaced_event(
-                namespace, body, _request_timeout=10
-            )
-        except Exception:
-            log.exception("failed to post event for %s", key)
+            self._queue.put_nowait((namespace, body, hc.key))
+        except asyncio.QueueFull:
+            log.warning("event queue full; dropping event for %s", hc.key)
+            return
+        if self._worker is None or self._worker.done():
+            try:
+                self._worker = asyncio.get_running_loop().create_task(self._drain())
+            except RuntimeError:
+                pass  # no loop (sync CLI context) — events stay local
+
+    async def _drain(self) -> None:
+        from activemonitor_tpu.kube import core_path
+
+        while True:
+            namespace, body, key = await self._queue.get()
+            try:
+                await self._api.request(
+                    "POST", core_path("events", namespace), body=body, timeout=10
+                )
+            except Exception:
+                log.exception("failed to post event for %s", key)
+            finally:
+                self._queue.task_done()
+
+    async def flush(self) -> None:
+        """Wait until every queued event has been posted (tests and
+        orderly shutdown)."""
+        await self._queue.join()
 
     def close(self) -> None:
-        """Drop pending posts and release the worker thread (called on
-        manager shutdown; without it interpreter exit joins the
-        non-daemon executor thread)."""
-        self._executor.shutdown(wait=False, cancel_futures=True)
+        """Drop pending posts and release the drain task (called on
+        manager shutdown)."""
+        if self._worker is not None:
+            self._worker.cancel()
+            self._worker = None

@@ -116,8 +116,19 @@ class Manager:
         await self._elector.acquire()
         log.info("leadership acquired; starting %d workers", self.max_parallel)
 
-        # watch FIRST (registration is synchronous in client.watch()),
-        # resync list second: events between the two are never lost
+        # a lost election must stop reconciling immediately — the other
+        # replica is already active (reference: controller-runtime
+        # terminates the process on lost leadership)
+        lost = getattr(self._elector, "lost", None)
+        if isinstance(lost, asyncio.Event):
+            self._tasks.append(asyncio.create_task(self._leadership_watch(lost)))
+
+        # watch FIRST, resync list second. No-lost-events rests on one of
+        # two client guarantees: in-memory/file watches register
+        # synchronously at call time; the k8s watch starts without a
+        # resourceVersion, so the server replays the full current state
+        # as synthetic ADDED events once the stream connects. Either way
+        # nothing can fall between watch() and the list below.
         watch_iterator = self.client.watch()
         self._tasks.append(asyncio.create_task(self._watch_loop(watch_iterator)))
         for i in range(self.max_parallel):
@@ -172,6 +183,23 @@ class Manager:
                 log.exception("goodput rollup failed")
             await clock.sleep(interval)
 
+    async def _leadership_watch(self, lost: asyncio.Event) -> None:
+        await lost.wait()
+        log.critical("leadership lost; stopping reconcile workers")
+        # flip the stop signal (run_forever / the CLI observe it) and
+        # halt all work without awaiting our own cancellation
+        self._stopping.set()
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+        for t in self._requeue_tasks:
+            t.cancel()
+
+    @property
+    def stopping(self) -> asyncio.Event:
+        """Set when the manager is shutting down (or has lost leadership)."""
+        return self._stopping
+
     async def run_forever(self) -> None:
         await self.start()
         await self._stopping.wait()
@@ -186,11 +214,26 @@ class Manager:
         self._tasks.clear()
         self._requeue_tasks.clear()
         await self.reconciler.shutdown()
+        # drain queued event posts (bounded) before closing the recorder,
+        # so the final transitions recorded during shutdown still reach
+        # the Events API
+        flush = getattr(self.reconciler.recorder, "flush", None)
+        if flush is not None:
+            try:
+                await asyncio.wait_for(flush(), timeout=5.0)
+            except Exception:
+                pass  # best-effort: a hung API server must not stall stop()
         self.reconciler.recorder.close()
         for runner in self._http_runners:
             await runner.cleanup()
         self._http_runners.clear()
-        self._elector.release()
+        # awaitable release guarantees the lease handoff completes before
+        # the caller tears down the shared API session
+        release_async = getattr(self._elector, "release_async", None)
+        if release_async is not None:
+            await release_async()
+        else:
+            self._elector.release()
 
     # -- HTTP endpoints ---------------------------------------------------
     async def _start_http(self) -> None:

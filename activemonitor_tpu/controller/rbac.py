@@ -30,6 +30,7 @@ from activemonitor_tpu.api.types import (
     LEVEL_NAMESPACE,
     WORKFLOW_TYPE_REMEDY,
 )
+from activemonitor_tpu.kube import ApiError, api_path, core_path
 
 # labels (reference: healthcheck_controller.go:67-68)
 MANAGED_BY_LABEL_KEY = "workflows.argoproj.io/managed-by"
@@ -128,6 +129,128 @@ class InMemoryRBACBackend:
 
     async def delete(self, kind: str, namespace: str, name: str) -> None:
         self.objects.pop(self._key(kind, namespace, name), None)
+
+
+class KubernetesRBACBackend:
+    """Real cluster state: ServiceAccounts, (Cluster)Roles and bindings
+    created through the API server, like the reference's typed-clientset
+    helpers (reference: healthcheck_controller.go:1128-1443). The
+    :class:`RBACObject` ↔ manifest mapping lives here so the
+    provisioner stays backend-agnostic."""
+
+    RBAC_GROUP = "rbac.authorization.k8s.io"
+    RBAC_VERSION = "v1"
+    _PLURALS = {
+        "ClusterRole": "clusterroles",
+        "ClusterRoleBinding": "clusterrolebindings",
+        "Role": "roles",
+        "RoleBinding": "rolebindings",
+    }
+
+    def __init__(self, api):
+        self._api = api
+
+    def _path(self, kind: str, namespace: str, name: str = "") -> str:
+        if kind == "ServiceAccount":
+            return core_path("serviceaccounts", namespace, name)
+        plural = self._PLURALS[kind]
+        # Cluster* kinds are cluster-scoped regardless of the namespace arg
+        scoped_ns = "" if kind.startswith("Cluster") else namespace
+        return api_path(self.RBAC_GROUP, self.RBAC_VERSION, plural, scoped_ns, name)
+
+    # -- RBACObject <-> manifest ---------------------------------------
+    def _to_manifest(self, obj: RBACObject) -> dict:
+        meta = {"name": obj.name, "labels": dict(obj.labels)}
+        if obj.namespace and not obj.kind.startswith("Cluster"):
+            meta["namespace"] = obj.namespace
+        manifest: dict = {"metadata": meta}
+        if obj.kind == "ServiceAccount":
+            manifest["apiVersion"] = "v1"
+            manifest["kind"] = "ServiceAccount"
+        elif obj.kind in ("ClusterRole", "Role"):
+            manifest["apiVersion"] = f"{self.RBAC_GROUP}/{self.RBAC_VERSION}"
+            manifest["kind"] = obj.kind
+            manifest["rules"] = [
+                {
+                    "apiGroups": r.api_groups,
+                    "resources": r.resources,
+                    "verbs": r.verbs,
+                }
+                for r in obj.rules
+            ]
+        elif obj.kind in ("ClusterRoleBinding", "RoleBinding"):
+            manifest["apiVersion"] = f"{self.RBAC_GROUP}/{self.RBAC_VERSION}"
+            manifest["kind"] = obj.kind
+            sa_namespace, _, sa_name = obj.subject.partition("/")
+            manifest["subjects"] = [
+                {
+                    "kind": "ServiceAccount",
+                    "name": sa_name,
+                    "namespace": sa_namespace,
+                }
+            ]
+            manifest["roleRef"] = {
+                "apiGroup": self.RBAC_GROUP,
+                "kind": "ClusterRole" if obj.kind == "ClusterRoleBinding" else "Role",
+                "name": obj.role_ref,
+            }
+        else:
+            raise RBACError(f"unknown RBAC kind {obj.kind!r}")
+        return manifest
+
+    @staticmethod
+    def _from_manifest(kind: str, namespace: str, manifest: dict) -> RBACObject:
+        meta = manifest.get("metadata", {})
+        subject = ""
+        if manifest.get("subjects"):
+            s = manifest["subjects"][0]
+            subject = f"{s.get('namespace', '')}/{s.get('name', '')}"
+        return RBACObject(
+            kind=kind,
+            name=meta.get("name", ""),
+            namespace="" if kind.startswith("Cluster") else namespace,
+            rules=[
+                PolicyRule(
+                    api_groups=r.get("apiGroups", []),
+                    resources=r.get("resources", []),
+                    verbs=r.get("verbs", []),
+                )
+                for r in manifest.get("rules", [])
+            ],
+            labels=meta.get("labels", {}) or {},
+            subject=subject,
+            role_ref=(manifest.get("roleRef") or {}).get("name", ""),
+        )
+
+    # -- backend protocol ----------------------------------------------
+    async def get(self, kind: str, namespace: str, name: str) -> Optional[RBACObject]:
+        try:
+            manifest = await self._api.get(self._path(kind, namespace, name))
+        except ApiError as e:
+            if e.not_found:
+                return None
+            raise
+        return self._from_manifest(kind, namespace, manifest)
+
+    async def create(self, obj: RBACObject) -> RBACObject:
+        try:
+            await self._api.create(
+                self._path(obj.kind, obj.namespace), self._to_manifest(obj)
+            )
+        except ApiError as e:
+            # lost race with a concurrent creator: the object exists,
+            # which is all _ensure() wants (reference idempotent create,
+            # healthcheck_controller.go:1129-1135)
+            if not e.conflict:
+                raise
+        return obj
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        try:
+            await self._api.delete(self._path(kind, namespace, name))
+        except ApiError as e:
+            if not e.not_found:
+                raise
 
 
 class RBACProvisioner:

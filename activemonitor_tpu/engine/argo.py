@@ -2,83 +2,39 @@
 
 Capability-parity backend for cluster deployments
 (reference: healthcheck_controller.go:502-534 create, :617 dynamic-client
-poll). Import of the ``kubernetes`` package is deferred to construction
-so the rest of the framework works where it isn't installed.
+poll), on the framework's own REST layer — the Argo controller is an
+external process; this engine only creates Workflow objects and polls
+``status.phase``, exactly the process boundary the reference keeps.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from activemonitor_tpu.errors import MissingDependencyError
+from activemonitor_tpu.kube import ApiError, KubeApi, api_path
 
 WF_GROUP = "argoproj.io"
 WF_VERSION = "v1alpha1"
 WF_PLURAL = "workflows"
 
 
-def _is_api_not_found(e: Exception, stub_mode: bool) -> bool:
-    """True only for a genuine API-server 404. In real-client mode the
-    type check is strict (an arbitrary exception carrying status=404
-    must not masquerade as not-found); injected test stubs get the
-    duck-typed check regardless of what packages are installed."""
-    if stub_mode:
-        return getattr(e, "status", None) == 404
-    from kubernetes.client.rest import ApiException  # type: ignore
-
-    return isinstance(e, ApiException) and e.status == 404
-
-
 class ArgoWorkflowEngine:
-    def __init__(self, api_client=None, custom_objects_api=None):
-        """``custom_objects_api`` lets tests inject a stub implementing
-        the CustomObjectsApi surface; otherwise the real client is
-        constructed from in-cluster/kubeconfig credentials."""
-        self._stub_mode = custom_objects_api is not None
-        if custom_objects_api is not None:
-            self._api = custom_objects_api
-            return
-        try:
-            from kubernetes import client, config  # type: ignore
-        except ImportError as e:  # pragma: no cover - depends on environment
-            raise MissingDependencyError(
-                "the 'kubernetes' package is required for ArgoWorkflowEngine; "
-                "use LocalProcessEngine or FakeWorkflowEngine instead"
-            ) from e
-        if api_client is None:  # pragma: no cover - needs a cluster
-            try:
-                config.load_incluster_config()
-            except Exception:
-                config.load_kube_config()
-        self._api = client.CustomObjectsApi(api_client)
+    def __init__(self, api: Optional[KubeApi] = None):
+        self._api = api if api is not None else KubeApi.from_default_config()
 
     async def submit(self, manifest: dict) -> str:
-        import asyncio
-
         namespace = manifest.get("metadata", {}).get("namespace", "default")
-        created = await asyncio.to_thread(
-            self._api.create_namespaced_custom_object,
-            WF_GROUP,
-            WF_VERSION,
-            namespace,
-            WF_PLURAL,
-            manifest,
+        created = await self._api.create(
+            api_path(WF_GROUP, WF_VERSION, WF_PLURAL, namespace), manifest
         )
         return created["metadata"]["name"]
 
     async def get(self, namespace: str, name: str) -> Optional[dict]:
-        import asyncio
-
         try:
-            return await asyncio.to_thread(
-                self._api.get_namespaced_custom_object,
-                WF_GROUP,
-                WF_VERSION,
-                namespace,
-                WF_PLURAL,
-                name,
+            return await self._api.get(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, namespace, name)
             )
-        except Exception as e:
-            if _is_api_not_found(e, self._stub_mode):
+        except ApiError as e:
+            if e.not_found:
                 return None
             raise

@@ -1,0 +1,339 @@
+"""In-process stub Kubernetes API server.
+
+The reference's integration tier runs a real kube-apiserver via envtest
+(reference: internal/controllers/suite_test.go:67-134) — the data model
+is real, no controllers run. This module is that tier for this
+framework: a generic aiohttp server speaking enough of the Kubernetes
+REST dialect for every cluster-mode component to run against it for
+real — CRUD + generateName, resourceVersion conflict semantics, the
+status subresource, JSON merge patch, list + streaming watch, and
+optional bearer-token auth. Resource-agnostic by design: HealthChecks,
+Argo Workflows, RBAC objects, Leases and Events all flow through the
+same store, like an API server with ``x-kubernetes-preserve-unknown-
+fields`` CRDs installed (the reference's trick for Argo Workflows,
+config/crd/bases/argoproj_v1alpha1_workflows.yaml).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import secrets
+from typing import Dict, List, Tuple
+
+Key = Tuple[str, str, str]  # (group, version, plural); core v1 -> ("", "v1", ...)
+
+
+def merge_patch(target, patch):
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    result = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            result.pop(k, None)
+        else:
+            result[k] = merge_patch(result.get(k), v)
+    return result
+
+
+class StubApiServer:
+    """Start with :meth:`start`, point a :class:`KubeApi` at ``.url``."""
+
+    def __init__(self, token: str = ""):
+        self._token = token
+        self._objects: Dict[Key, Dict[Tuple[str, str], dict]] = {}
+        self._rv = 0
+        # bounded event history for watch resume; (rv, key, event)
+        self._history: List[Tuple[int, Key, str, dict]] = []
+        self._watchers: List[Tuple[Key, str, asyncio.Queue]] = []
+        self._runner = None
+        self.url = ""
+        self.requests: List[Tuple[str, str]] = []  # (method, path) log
+
+    # -- store ----------------------------------------------------------
+    def _bump(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _bucket(self, key: Key) -> Dict[Tuple[str, str], dict]:
+        return self._objects.setdefault(key, {})
+
+    def _broadcast(self, key: Key, namespace: str, type_: str, obj: dict) -> None:
+        event = {"type": type_, "object": copy.deepcopy(obj)}
+        self._history.append((self._rv, key, namespace, event))
+        del self._history[:-1000]
+        for wkey, wns, queue in self._watchers:
+            if wkey == key and (not wns or wns == namespace):
+                queue.put_nowait(event)
+
+    # test-visible accessors -------------------------------------------
+    def obj(self, group: str, version: str, plural: str, namespace: str, name: str):
+        return self._bucket((group, version, plural)).get((namespace, name))
+
+    def objs(self, group: str, version: str, plural: str) -> List[dict]:
+        return list(self._bucket((group, version, plural)).values())
+
+    def seed(self, group: str, version: str, plural: str, obj: dict) -> dict:
+        """Directly place an object (test fixture setup)."""
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("resourceVersion", self._bump())
+        meta.setdefault("uid", secrets.token_hex(8))
+        key = (group, version, plural)
+        namespace = meta.get("namespace", "")
+        self._bucket(key)[(namespace, meta["name"])] = obj
+        self._broadcast(key, namespace, "ADDED", obj)
+        return obj
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        from aiohttp import web
+
+        # accept bodies up to what etcd would (default 1 MiB is too small)
+        app = web.Application(
+            middlewares=[self._auth_middleware], client_max_size=4 * 1024**2
+        )
+        # longest patterns first: aiohttp resolves dynamic routes in
+        # registration order, and /apis/{g}/{v}/{plural}/{name} would
+        # otherwise swallow /apis/{g}/{v}/namespaces/{ns}/{plural}
+        patterns = [
+            ("/apis/{group}/{version}/namespaces/{namespace}/{plural}/{name}/status", True),
+            ("/apis/{group}/{version}/namespaces/{namespace}/{plural}/{name}", False),
+            ("/apis/{group}/{version}/namespaces/{namespace}/{plural}", None),
+            ("/apis/{group}/{version}/{plural}/{name}/status", True),
+            ("/apis/{group}/{version}/{plural}/{name}", False),
+            ("/apis/{group}/{version}/{plural}", None),
+            ("/api/v1/namespaces/{namespace}/{plural}/{name}", False),
+            ("/api/v1/namespaces/{namespace}/{plural}", None),
+            ("/api/v1/{plural}/{name}", False),
+            ("/api/v1/{plural}", None),
+        ]
+        for pattern, status_sub in patterns:
+            if status_sub is None:  # collection
+                app.router.add_get(pattern, self._handle_list_or_watch)
+                app.router.add_post(pattern, self._handle_create)
+            else:
+                handler = self._handle_status if status_sub else self._handle_object
+                app.router.add_get(pattern, handler)
+                app.router.add_put(pattern, handler)
+                app.router.add_patch(pattern, handler)
+                if not status_sub:
+                    app.router.add_delete(pattern, handler)
+        # don't wait out live watch streams on cleanup (default 60 s)
+        self._runner = web.AppRunner(app, shutdown_timeout=0.25)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://{host}:{actual_port}"
+        return self.url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- request plumbing ----------------------------------------------
+    @staticmethod
+    def _parse(request) -> Tuple[Key, str, str]:
+        info = request.match_info
+        group = info.get("group", "")
+        version = info.get("version", "v1")
+        return (
+            (group, version, info["plural"]),
+            info.get("namespace", ""),
+            info.get("name", ""),
+        )
+
+    @staticmethod
+    def _error(status: int, message: str):
+        from aiohttp import web
+
+        return web.json_response(
+            {"kind": "Status", "status": "Failure", "code": status, "message": message},
+            status=status,
+        )
+
+    from aiohttp import web as _web  # for the middleware decorator
+
+    @_web.middleware
+    async def _auth_middleware(self, request, handler):
+        self.requests.append((request.method, request.path))
+        if self._token:
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self._token}":
+                return self._error(401, "Unauthorized")
+        return await handler(request)
+
+    # -- handlers -------------------------------------------------------
+    async def _handle_list_or_watch(self, request):
+        from aiohttp import web
+
+        key, namespace, _ = self._parse(request)
+        if request.query.get("watch") == "true":
+            return await self._serve_watch(request, key, namespace)
+        items = [
+            copy.deepcopy(obj)
+            for (ns, _), obj in self._bucket(key).items()
+            if not namespace or ns == namespace
+        ]
+        return web.json_response(
+            {
+                "kind": "List",
+                "items": items,
+                "metadata": {"resourceVersion": str(self._rv)},
+            }
+        )
+
+    async def _serve_watch(self, request, key: Key, namespace: str):
+        from aiohttp import web
+
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+
+        start_rv = request.query.get("resourceVersion", "")
+        if start_rv:
+            oldest = self._history[0][0] if self._history else self._rv + 1
+            if int(start_rv) + 1 < oldest and int(start_rv) < self._rv:
+                # requested window already evicted
+                line = json.dumps(
+                    {
+                        "type": "ERROR",
+                        "object": {"code": 410, "message": "too old resource version"},
+                    }
+                )
+                await resp.write(line.encode() + b"\n")
+                return resp
+            backlog = [
+                ev
+                for rv, k, ns, ev in self._history
+                if k == key and (not namespace or ns == namespace) and rv > int(start_rv)
+            ]
+        else:
+            # no resourceVersion: synthesize ADDED for current state
+            backlog = [
+                {"type": "ADDED", "object": copy.deepcopy(obj)}
+                for (ns, _), obj in self._bucket(key).items()
+                if not namespace or ns == namespace
+            ]
+        entry = (key, namespace, queue)
+        self._watchers.append(entry)
+        try:
+            for ev in backlog:
+                await resp.write(json.dumps(ev).encode() + b"\n")
+            timeout = float(request.query.get("timeoutSeconds", "300"))
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + timeout
+            while True:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    ev = await asyncio.wait_for(queue.get(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    break
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watchers.remove(entry)
+        return resp
+
+    async def _handle_create(self, request):
+        from aiohttp import web
+
+        key, namespace, _ = self._parse(request)
+        body = await request.json()
+        meta = body.setdefault("metadata", {})
+        if namespace:
+            meta["namespace"] = namespace
+        name = meta.get("name", "")
+        if not name:
+            generate = meta.get("generateName")
+            if not generate:
+                return self._error(422, "name or generateName is required")
+            name = generate + secrets.token_hex(3)[:5]
+            meta["name"] = name
+        if (namespace, name) in self._bucket(key):
+            return self._error(409, f"{key[2]} {name!r} already exists")
+        meta["resourceVersion"] = self._bump()
+        meta["uid"] = secrets.token_hex(8)
+        meta.setdefault("creationTimestamp", _now_iso())
+        self._bucket(key)[(namespace, name)] = body
+        self._broadcast(key, namespace, "ADDED", body)
+        return web.json_response(copy.deepcopy(body), status=201)
+
+    async def _handle_object(self, request):
+        return await self._object_rw(request, status_only=False)
+
+    async def _handle_status(self, request):
+        if request.method == "GET":
+            return self._error(405, "GET on status subresource not supported")
+        return await self._object_rw(request, status_only=True)
+
+    async def _object_rw(self, request, status_only: bool):
+        from aiohttp import web
+
+        key, namespace, name = self._parse(request)
+        existing = self._bucket(key).get((namespace, name))
+        if existing is None:
+            return self._error(404, f"{key[2]} {namespace}/{name} not found")
+
+        if request.method == "GET":
+            return web.json_response(copy.deepcopy(existing))
+
+        if request.method == "DELETE":
+            del self._bucket(key)[(namespace, name)]
+            self._bump()
+            self._broadcast(key, namespace, "DELETED", existing)
+            return web.json_response({"kind": "Status", "status": "Success"})
+
+        body = await request.json()
+        # optimistic concurrency: a stale resourceVersion in the payload
+        # is a conflict (this is what RetryOnConflict paths exercise)
+        claimed = (body.get("metadata") or {}).get("resourceVersion")
+        if claimed and claimed != existing["metadata"]["resourceVersion"]:
+            return self._error(
+                409,
+                f"the object has been modified; requested {claimed} "
+                f"but current is {existing['metadata']['resourceVersion']}",
+            )
+
+        if request.method == "PUT":
+            updated = body
+            if status_only:
+                updated = copy.deepcopy(existing)
+                updated["status"] = body.get("status")
+            else:
+                # status is a subresource: a main-resource replace never
+                # touches it (real API-server behavior for CRDs with the
+                # status subresource enabled)
+                updated.pop("status", None)
+                if "status" in existing:
+                    updated["status"] = existing["status"]
+        else:  # PATCH (JSON merge patch)
+            patch = {"status": body.get("status")} if status_only else body
+            updated = merge_patch(existing, patch)
+        meta = updated.setdefault("metadata", {})
+        meta["name"] = name
+        if namespace:
+            meta["namespace"] = namespace
+        meta["uid"] = existing["metadata"].get("uid", secrets.token_hex(8))
+        meta["resourceVersion"] = self._bump()
+        self._bucket(key)[(namespace, name)] = updated
+        self._broadcast(key, namespace, "MODIFIED", updated)
+        return web.json_response(copy.deepcopy(updated))
+
+
+def _now_iso() -> str:
+    import datetime
+
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .replace(microsecond=0)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )

@@ -1,0 +1,24 @@
+"""Shared harness for cluster-mode tests.
+
+Each coroutine test runs in its own event loop (see conftest.py), so the
+stub API server must be started *inside* the test body — an async
+context manager, not a fixture.
+"""
+
+from contextlib import asynccontextmanager
+
+from activemonitor_tpu.kube import KubeApi, KubeConfig
+from activemonitor_tpu.kube.stub import StubApiServer
+
+
+@asynccontextmanager
+async def stub_env(token: str = ""):
+    """An in-process API server plus a client pointed at it."""
+    server = StubApiServer(token=token)
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url, token=token))
+    try:
+        yield server, api
+    finally:
+        await api.close()
+        await server.stop()

@@ -1,38 +1,18 @@
-"""Argo engine tests against a stub CustomObjectsApi (no cluster)."""
+"""Argo engine tests against the in-process stub API server.
+
+The engine creates/polls real Workflow CRs over REST — the stub plays
+the API server with the Workflow CRD installed, exactly the reference's
+envtest trick (SURVEY.md §4: the CRD itself is the fake backend).
+"""
+
+import asyncio
 
 import pytest
 
-from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
+from activemonitor_tpu.engine.argo import WF_GROUP, WF_PLURAL, WF_VERSION, ArgoWorkflowEngine
+from activemonitor_tpu.kube import ApiError
 
-
-class _NotFound(Exception):
-    status = 404
-
-
-class _ServerError(Exception):
-    status = 500
-
-
-class StubCustomObjectsApi:
-    def __init__(self):
-        self.objects = {}
-        self.calls = []
-
-    def create_namespaced_custom_object(self, group, version, namespace, plural, body):
-        assert (group, version, plural) == ("argoproj.io", "v1alpha1", "workflows")
-        name = body["metadata"].get("name") or body["metadata"]["generateName"] + "abc12"
-        body = {**body, "metadata": {**body["metadata"], "name": name}}
-        self.objects[f"{namespace}/{name}"] = body
-        self.calls.append(("create", namespace, name))
-        return body
-
-    def get_namespaced_custom_object(self, group, version, namespace, plural, name):
-        self.calls.append(("get", namespace, name))
-        key = f"{namespace}/{name}"
-        if key not in self.objects:
-            raise _NotFound(key)
-        return self.objects[key]
-
+from tests.kube_harness import stub_env
 
 MANIFEST = {
     "apiVersion": "argoproj.io/v1alpha1",
@@ -44,37 +24,40 @@ MANIFEST = {
 
 @pytest.mark.asyncio
 async def test_submit_returns_generated_name():
-    stub = StubCustomObjectsApi()
-    eng = ArgoWorkflowEngine(custom_objects_api=stub)
-    name = await eng.submit(MANIFEST)
-    assert name.startswith("probe-")
-    assert ("create", "health", name) in stub.calls
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        name = await eng.submit(dict(MANIFEST))
+        assert name.startswith("probe-")
+        assert server.obj(WF_GROUP, WF_VERSION, WF_PLURAL, "health", name) is not None
 
 
 @pytest.mark.asyncio
 async def test_get_found_and_not_found():
-    stub = StubCustomObjectsApi()
-    eng = ArgoWorkflowEngine(custom_objects_api=stub)
-    name = await eng.submit(MANIFEST)
-    wf = await eng.get("health", name)
-    assert wf["metadata"]["name"] == name
-    assert await eng.get("health", "ghost") is None  # 404 -> None
+    async with stub_env() as (_, api):
+        eng = ArgoWorkflowEngine(api)
+        name = await eng.submit(dict(MANIFEST))
+        wf = await eng.get("health", name)
+        assert wf["metadata"]["name"] == name
+        assert await eng.get("health", "ghost") is None  # 404 -> None
 
 
 @pytest.mark.asyncio
 async def test_get_other_errors_propagate():
-    class Broken(StubCustomObjectsApi):
-        def get_namespaced_custom_object(self, *a):
-            raise _ServerError("boom")
+    async with stub_env(token="sekret") as (server, _):
+        from activemonitor_tpu.kube import KubeApi, KubeConfig
 
-    eng = ArgoWorkflowEngine(custom_objects_api=Broken())
-    with pytest.raises(_ServerError):
-        await eng.get("health", "x")
+        unauthed = KubeApi(KubeConfig(server=server.url))  # 401s
+        try:
+            eng = ArgoWorkflowEngine(unauthed)
+            with pytest.raises(ApiError):
+                await eng.get("health", "x")
+        finally:
+            await unauthed.close()
 
 
 @pytest.mark.asyncio
 async def test_reconciler_works_through_argo_engine():
-    """Full reconcile loop over the stubbed Argo API: submit, poll,
+    """Full reconcile loop over the stub API server: submit, poll,
     scripted completion, status + reschedule."""
     from activemonitor_tpu.api import HealthCheck
     from activemonitor_tpu.controller import (
@@ -87,50 +70,51 @@ async def test_reconciler_works_through_argo_engine():
     from activemonitor_tpu.metrics import MetricsCollector
     from activemonitor_tpu.utils.clock import FakeClock
 
-    stub = StubCustomObjectsApi()
-    orig_get = stub.get_namespaced_custom_object
-
-    def completing_get(group, version, namespace, plural, name):
-        obj = orig_get(group, version, namespace, plural, name)
-        obj["status"] = {"phase": "Succeeded"}
-        return obj
-
-    stub.get_namespaced_custom_object = completing_get
-
-    client = InMemoryHealthCheckClient()
-    clock = FakeClock()
-    reconciler = HealthCheckReconciler(
-        client=client,
-        engine=ArgoWorkflowEngine(custom_objects_api=stub),
-        rbac=RBACProvisioner(InMemoryRBACBackend()),
-        recorder=EventRecorder(),
-        metrics=MetricsCollector(),
-        clock=clock,
-    )
-    hc = HealthCheck.from_dict(
-        {
-            "metadata": {"name": "argo-hc", "namespace": "health"},
-            "spec": {
-                "repeatAfterSec": 60,
-                "level": "cluster",
-                "workflow": {
-                    "generateName": "argo-hc-",
-                    "workflowtimeout": 10,
-                    "resource": {
-                        "namespace": "health",
-                        "serviceAccount": "sa",
-                        "source": {
-                            "inline": "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+    async with stub_env() as (server, api):
+        client = InMemoryHealthCheckClient()
+        clock = FakeClock()
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=ArgoWorkflowEngine(api),
+            rbac=RBACProvisioner(InMemoryRBACBackend()),
+            recorder=EventRecorder(),
+            metrics=MetricsCollector(),
+            clock=clock,
+        )
+        hc = HealthCheck.from_dict(
+            {
+                "metadata": {"name": "argo-hc", "namespace": "health"},
+                "spec": {
+                    "repeatAfterSec": 60,
+                    "level": "cluster",
+                    "workflow": {
+                        "generateName": "argo-hc-",
+                        "workflowtimeout": 10,
+                        "resource": {
+                            "namespace": "health",
+                            "serviceAccount": "sa",
+                            "source": {
+                                "inline": "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+                            },
                         },
                     },
                 },
-            },
-        }
-    )
-    created = await client.apply(hc)
-    await reconciler.reconcile(created.namespace, created.name)
-    await clock.advance(0)
-    await reconciler.wait_watches()
-    st = (await client.get("health", "argo-hc")).status
-    assert st.status == "Succeeded"
-    assert st.success_count == 1
+            }
+        )
+        created = await client.apply(hc)
+        await reconciler.reconcile(created.namespace, created.name)
+        # deterministic wait: poll for the submitted workflow with a
+        # deadline instead of a fixed sleep (CI machines vary)
+        deadline = asyncio.get_event_loop().time() + 10
+        while not server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+            assert asyncio.get_event_loop().time() < deadline, "no workflow submitted"
+            await asyncio.sleep(0.02)
+        # the Argo controller "completes" the workflow
+        wfs = server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)
+        assert len(wfs) == 1
+        wfs[0]["status"] = {"phase": "Succeeded"}
+        await clock.advance(10)  # next poll observes the terminal phase
+        await reconciler.wait_watches()
+        st = (await client.get("health", "argo-hc")).status
+        assert st.status == "Succeeded"
+        assert st.success_count == 1

@@ -1,0 +1,393 @@
+"""Cluster-mode components against the stub API server.
+
+This is the tier the reference gets from envtest (suite_test.go:67-134):
+the Kubernetes data model is real (CRUD, conflicts, watch, RBAC
+objects, Events), no external controllers run. Every class that was
+previously gated on a live cluster executes here for real:
+KubernetesHealthCheckClient, KubernetesRBACBackend,
+KubernetesEventRecorder, and (in test_leader_k8s.py) the lease elector.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu import GROUP, VERSION
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    ConflictError,
+    KubernetesRBACBackend,
+    MANAGED_BY_LABEL_KEY,
+    MANAGED_BY_VALUE,
+    NotFoundError,
+    RBACObject,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.client_k8s import PLURAL, KubernetesHealthCheckClient
+from activemonitor_tpu.controller.events import KubernetesEventRecorder
+
+from tests.kube_harness import stub_env
+
+RBAC_GROUP = "rbac.authorization.k8s.io"
+
+WF_INLINE = """
+apiVersion: argoproj.io/v1alpha1
+kind: Workflow
+spec:
+  entrypoint: main
+"""
+
+
+def make_hc(name="hc-a", level="cluster", remedy=False):
+    spec = {
+        "repeatAfterSec": 60,
+        "level": level,
+        "workflow": {
+            "generateName": "check-",
+            "workflowtimeout": 10,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "check-sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if remedy:
+        spec["remedyworkflow"] = {
+            "generateName": "remedy-",
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "remedy-sa",
+                "source": {"inline": WF_INLINE},
+            },
+        }
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+# ---------------------------------------------------------------------------
+# KubernetesHealthCheckClient
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_crud_roundtrip():
+    async with stub_env() as (_, api):
+        client = KubernetesHealthCheckClient(api)
+        created = await client.apply(make_hc())
+        assert created.metadata.resource_version
+        assert created.metadata.uid
+
+        got = await client.get("health", "hc-a")
+        assert got is not None and got.spec.repeat_after_sec == 60
+        assert await client.get("health", "ghost") is None
+
+        listed = await client.list()
+        assert [hc.metadata.name for hc in listed] == ["hc-a"]
+        assert await client.list("other-ns") == []
+
+        await client.delete("health", "hc-a")
+        with pytest.raises(NotFoundError):
+            await client.delete("health", "hc-a")
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_apply_updates_spec_preserving_status():
+    async with stub_env() as (server, api):
+        client = KubernetesHealthCheckClient(api)
+        created = await client.apply(make_hc())
+        created.status.status = "Succeeded"
+        created.status.success_count = 3
+        await client.update_status(created)
+
+        hc2 = make_hc()
+        hc2.spec.repeat_after_sec = 30
+        updated = await client.apply(hc2)  # create conflicts -> spec patch
+        assert updated.spec.repeat_after_sec == 30
+        assert updated.status.success_count == 3  # status survived the apply
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_apply_removes_dropped_spec_fields():
+    """Editing the manifest to drop remedyworkflow and re-applying must
+    actually remove it — a merge-patch would silently keep the remedy
+    running forever."""
+    async with stub_env() as (_, api):
+        client = KubernetesHealthCheckClient(api)
+        await client.apply(make_hc(remedy=True))
+        got = await client.get("health", "hc-a")
+        assert not got.spec.remedy_workflow.is_empty()
+
+        await client.apply(make_hc(remedy=False))
+        got = await client.get("health", "hc-a")
+        assert got.spec.remedy_workflow.is_empty()
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_apply_merges_labels_additively():
+    """Labels set by other tools survive an apply; labels in the
+    manifest land."""
+    async with stub_env() as (server, api):
+        client = KubernetesHealthCheckClient(api)
+        await client.apply(make_hc())
+        # another tool labels the object
+        from activemonitor_tpu import GROUP as G, VERSION as V
+
+        obj = server.obj(G, V, PLURAL, "health", "hc-a")
+        obj["metadata"].setdefault("labels", {})["helm.sh/chart"] = "x-1.0"
+
+        hc = make_hc()
+        hc.metadata.labels = {"team": "sre"}
+        updated = await client.apply(hc)
+        assert updated.metadata.labels["helm.sh/chart"] == "x-1.0"
+        assert updated.metadata.labels["team"] == "sre"
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_status_conflict_maps_to_conflict_error():
+    async with stub_env() as (_, api):
+        client = KubernetesHealthCheckClient(api)
+        created = await client.apply(make_hc())
+        stale = created.deepcopy()
+        created.status.status = "Succeeded"
+        await client.update_status(created)  # bumps resourceVersion
+
+        stale.status.status = "Failed"
+        with pytest.raises(ConflictError):
+            await client.update_status(stale)
+
+        ghost = make_hc("ghost")
+        ghost.metadata.resource_version = ""
+        with pytest.raises(NotFoundError):
+            await client.update_status(ghost)
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_watch_delivers_and_survives_reconnect():
+    async with stub_env() as (server, api):
+        client = KubernetesHealthCheckClient(api)
+        seen = []
+        done = asyncio.Event()
+
+        async def consume():
+            async for ev in client.watch():
+                seen.append((ev.type, ev.name))
+                if len(seen) >= 3:
+                    done.set()
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.1)
+        await client.apply(make_hc("hc-1"))
+        await asyncio.sleep(0.05)
+        await client.apply(make_hc("hc-2"))
+        await client.delete("health", "hc-1")
+        await asyncio.wait_for(done.wait(), 5)
+        task.cancel()
+        assert ("ADDED", "hc-1") in seen
+        assert ("ADDED", "hc-2") in seen
+        assert ("DELETED", "hc-1") in seen
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_watch_410_synthesizes_missed_deletions():
+    """A watch gap that outlives etcd compaction (410 Gone) swallows
+    DELETED events; the client must list+diff and synthesize them, or
+    deleted checks keep their schedules forever."""
+    from activemonitor_tpu.kube import ApiError
+
+    def ev(type_, name, rv):
+        return {
+            "type": type_,
+            "object": {
+                "metadata": {"namespace": "health", "name": name, "resourceVersion": rv}
+            },
+        }
+
+    class ScriptedApi:
+        def __init__(self):
+            self.calls = 0
+
+        async def watch(self, path, resource_version=""):
+            self.calls += 1
+            if self.calls == 1:
+                yield ev("ADDED", "hc-keep", "1")
+                yield ev("ADDED", "hc-gone", "2")
+                raise ApiError(410, "too old resource version")
+            # post-410 stream: server replays current state only
+            yield ev("ADDED", "hc-keep", "9")
+
+        async def get(self, path, params=None):
+            # the re-list: hc-gone was deleted during the gap
+            return {
+                "items": [
+                    {"metadata": {"namespace": "health", "name": "hc-keep"}}
+                ]
+            }
+
+    client = KubernetesHealthCheckClient(ScriptedApi())
+    seen = []
+    async for event in client.watch():
+        seen.append((event.type, event.name))
+        if len(seen) >= 4:
+            break
+    assert ("DELETED", "hc-gone") in seen
+    # the synthesized deletion lands between the streams, before the replay
+    assert seen.index(("DELETED", "hc-gone")) < seen.index(("ADDED", "hc-keep"), 1)
+
+
+# ---------------------------------------------------------------------------
+# KubernetesRBACBackend
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_rbac_provisioner_creates_real_cluster_objects():
+    async with stub_env() as (server, api):
+        prov = RBACProvisioner(KubernetesRBACBackend(api))
+        await prov.create_rbac_for_workflow(make_hc(), "healthCheck")
+
+        sa = server.obj("", "v1", "serviceaccounts", "health", "check-sa")
+        assert sa is not None
+        assert sa["metadata"]["labels"][MANAGED_BY_LABEL_KEY] == MANAGED_BY_VALUE
+
+        role = server.obj(RBAC_GROUP, "v1", "clusterroles", "", "check-sa-cluster-role")
+        assert role is not None
+        # read-only defaults: no write verbs anywhere (reference :85-101)
+        for rule in role["rules"]:
+            assert set(rule["verbs"]) == {"get", "list", "watch"}
+            assert "*" not in rule["resources"]
+
+        binding = server.obj(
+            RBAC_GROUP, "v1", "clusterrolebindings", "", "check-sa-cluster-role-binding"
+        )
+        assert binding["roleRef"] == {
+            "apiGroup": RBAC_GROUP,
+            "kind": "ClusterRole",
+            "name": "check-sa-cluster-role",
+        }
+        assert binding["subjects"] == [
+            {"kind": "ServiceAccount", "name": "check-sa", "namespace": "health"}
+        ]
+
+
+@pytest.mark.asyncio
+async def test_rbac_namespace_level_uses_roles():
+    async with stub_env() as (server, api):
+        prov = RBACProvisioner(KubernetesRBACBackend(api))
+        await prov.create_rbac_for_workflow(make_hc(level="namespace"), "healthCheck")
+        role = server.obj(RBAC_GROUP, "v1", "roles", "health", "check-sa-ns-role")
+        assert role is not None
+        binding = server.obj(
+            RBAC_GROUP, "v1", "rolebindings", "health", "check-sa-ns-role-binding"
+        )
+        assert binding["roleRef"]["kind"] == "Role"
+        assert server.objs(RBAC_GROUP, "v1", "clusterroles") == []
+
+
+@pytest.mark.asyncio
+async def test_rbac_create_is_idempotent_and_keeps_existing():
+    async with stub_env() as (server, api):
+        prov = RBACProvisioner(KubernetesRBACBackend(api))
+        await prov.create_rbac_for_workflow(make_hc(), "healthCheck")
+        sa_uid = server.obj("", "v1", "serviceaccounts", "health", "check-sa")[
+            "metadata"
+        ]["uid"]
+        await prov.create_rbac_for_workflow(make_hc(), "healthCheck")
+        assert (
+            server.obj("", "v1", "serviceaccounts", "health", "check-sa")["metadata"][
+                "uid"
+            ]
+            == sa_uid
+        )
+
+
+@pytest.mark.asyncio
+async def test_remedy_rbac_lifecycle_and_managed_by_guard():
+    async with stub_env() as (server, api):
+        backend = KubernetesRBACBackend(api)
+        prov = RBACProvisioner(backend)
+        hc = make_hc(remedy=True)
+        await prov.create_rbac_for_workflow(hc, "remedy")
+
+        role = server.obj(RBAC_GROUP, "v1", "clusterroles", "", "remedy-sa-cluster-role")
+        # write-capable defaults for remedies (reference :104-120)
+        assert any("delete" in rule["verbs"] for rule in role["rules"])
+
+        # a user-owned SA with the same name as remedy cleanup target is
+        # not ours: plant one without the managed-by label
+        server.seed(
+            "",
+            "v1",
+            "serviceaccounts",
+            {"metadata": {"name": "user-sa", "namespace": "health", "labels": {}}},
+        )
+        await prov.delete_rbac_for_workflow(hc)
+        assert server.obj("", "v1", "serviceaccounts", "health", "remedy-sa") is None
+        assert server.obj(RBAC_GROUP, "v1", "clusterroles", "", "remedy-sa-cluster-role") is None
+        # unmanaged object untouched
+        assert server.obj("", "v1", "serviceaccounts", "health", "user-sa") is not None
+
+        # double delete is fine (404 tolerated)
+        await prov.delete_rbac_for_workflow(hc)
+
+
+@pytest.mark.asyncio
+async def test_rbac_custom_rules_override_defaults():
+    async with stub_env() as (server, api):
+        hc = make_hc()
+        hc.spec.workflow.rbac_rules = [
+            __import__(
+                "activemonitor_tpu.api.types", fromlist=["PolicyRule"]
+            ).PolicyRule(api_groups=[""], resources=["secrets"], verbs=["get"])
+        ]
+        prov = RBACProvisioner(KubernetesRBACBackend(api))
+        await prov.create_rbac_for_workflow(hc, "healthCheck")
+        role = server.obj(RBAC_GROUP, "v1", "clusterroles", "", "check-sa-cluster-role")
+        assert role["rules"] == [
+            {"apiGroups": [""], "resources": ["secrets"], "verbs": ["get"]}
+        ]
+
+
+# ---------------------------------------------------------------------------
+# KubernetesEventRecorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_event_recorder_posts_core_events():
+    async with stub_env() as (server, api):
+        recorder = KubernetesEventRecorder(api)
+        hc = make_hc()
+        hc.metadata.uid = "uid-123"
+        recorder.event(hc, "Normal", "Testing", "workflow submitted")
+        recorder.event(hc, "Warning", "Failed", "workflow failed")
+        await recorder.flush()
+        recorder.close()
+
+        events = server.objs("", "v1", "events")
+        assert len(events) == 2
+        by_reason = {e["reason"]: e for e in events}
+        assert by_reason["Testing"]["involvedObject"]["name"] == "hc-a"
+        assert by_reason["Testing"]["involvedObject"]["uid"] == "uid-123"
+        assert by_reason["Failed"]["type"] == "Warning"
+        # the in-memory ring still works (CLI/describe path)
+        assert len(recorder.events_for("health", "hc-a")) == 2
+
+
+@pytest.mark.asyncio
+async def test_event_recorder_survives_post_failures():
+    async with stub_env(token="sekret") as (server, _):
+        from activemonitor_tpu.kube import KubeApi, KubeConfig
+
+        unauthed = KubeApi(KubeConfig(server=server.url))  # all posts 401
+        try:
+            recorder = KubernetesEventRecorder(unauthed)
+            recorder.event(make_hc(), "Normal", "Testing", "msg")
+            await recorder.flush()  # must not raise
+            recorder.close()
+            assert server.objs("", "v1", "events") == []
+            assert len(recorder.all) == 1  # local ring unaffected
+        finally:
+            await unauthed.close()

@@ -1,0 +1,364 @@
+"""The native Kubernetes REST layer against the stub API server.
+
+This pair (KubeApi ↔ StubApiServer) is the foundation of the cluster-
+mode test tier — the analogue of the reference's envtest harness
+(reference: internal/controllers/suite_test.go:67-134), so its own
+semantics (conflicts, watch, subresources) are pinned down here first.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.kube import ApiError, KubeApi, KubeConfig, api_path, core_path
+from activemonitor_tpu.kube.stub import StubApiServer, merge_patch
+
+from tests.kube_harness import stub_env
+
+GROUP, VERSION, PLURAL = "activemonitor.keikoproj.io", "v1alpha1", "healthchecks"
+
+
+def hc_body(name="hc-a", namespace="health"):
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "HealthCheck",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"repeatAfterSec": 60},
+    }
+
+
+@pytest.mark.asyncio
+async def test_crud_roundtrip():
+    async with stub_env() as (_, api):
+        path = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        created = await api.create(path, hc_body())
+        assert created["metadata"]["resourceVersion"]
+        assert created["metadata"]["uid"]
+
+        got = await api.get(api_path(GROUP, VERSION, PLURAL, "health", "hc-a"))
+        assert got["spec"]["repeatAfterSec"] == 60
+
+        listed = await api.get(path)
+        assert len(listed["items"]) == 1
+
+        await api.delete(api_path(GROUP, VERSION, PLURAL, "health", "hc-a"))
+        with pytest.raises(ApiError) as e:
+            await api.get(api_path(GROUP, VERSION, PLURAL, "health", "hc-a"))
+        assert e.value.not_found
+
+
+@pytest.mark.asyncio
+async def test_create_existing_conflicts():
+    async with stub_env() as (_, api):
+        path = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        await api.create(path, hc_body())
+        with pytest.raises(ApiError) as e:
+            await api.create(path, hc_body())
+        assert e.value.conflict
+
+
+@pytest.mark.asyncio
+async def test_generate_name():
+    async with stub_env() as (_, api):
+        path = api_path("argoproj.io", "v1alpha1", "workflows", namespace="health")
+        body = {"metadata": {"generateName": "check-"}, "spec": {}}
+        created = await api.create(path, body)
+        assert created["metadata"]["name"].startswith("check-")
+        assert len(created["metadata"]["name"]) > len("check-")
+
+
+@pytest.mark.asyncio
+async def test_stale_resource_version_conflicts():
+    async with stub_env() as (_, api):
+        col = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        created = await api.create(col, hc_body())
+        obj_path = api_path(GROUP, VERSION, PLURAL, "health", "hc-a")
+        stale_rv = created["metadata"]["resourceVersion"]
+
+        created["spec"]["repeatAfterSec"] = 30
+        updated = await api.replace(obj_path, created)
+        assert updated["metadata"]["resourceVersion"] != stale_rv
+
+        # replay with the stale rv -> 409
+        created["metadata"]["resourceVersion"] = stale_rv
+        with pytest.raises(ApiError) as e:
+            await api.replace(obj_path, created)
+        assert e.value.conflict
+
+
+@pytest.mark.asyncio
+async def test_status_subresource_is_isolated():
+    async with stub_env() as (_, api):
+        col = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        await api.create(col, hc_body())
+        status_path = api_path(GROUP, VERSION, PLURAL, "health", "hc-a", "status")
+        await api.merge_patch(
+            status_path, {"status": {"status": "Succeeded"}, "spec": "x"}
+        )
+        got = await api.get(api_path(GROUP, VERSION, PLURAL, "health", "hc-a"))
+        # spec untouched by a status write; status landed
+        assert got["spec"]["repeatAfterSec"] == 60
+        assert got["status"]["status"] == "Succeeded"
+
+
+def test_merge_patch_deletes_on_null():
+    assert merge_patch({"a": 1, "b": {"c": 2, "d": 3}}, {"b": {"c": None}, "e": 4}) == {
+        "a": 1,
+        "b": {"d": 3},
+        "e": 4,
+    }
+
+
+@pytest.mark.asyncio
+async def test_watch_sees_existing_then_live_events():
+    async with stub_env() as (_, api):
+        col = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        await api.create(col, hc_body("hc-pre"))
+
+        events = []
+        got_two = asyncio.Event()
+
+        async def consume():
+            async for ev in api.watch(api_path(GROUP, VERSION, PLURAL)):
+                events.append((ev["type"], ev["object"]["metadata"]["name"]))
+                if len(events) >= 2:
+                    got_two.set()
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.1)  # watch established (synthetic ADDED delivered)
+        await api.create(col, hc_body("hc-live"))
+        await asyncio.wait_for(got_two.wait(), 5)
+        task.cancel()
+        assert events == [("ADDED", "hc-pre"), ("ADDED", "hc-live")]
+
+
+@pytest.mark.asyncio
+async def test_watch_survives_large_objects():
+    """A watch event bigger than aiohttp's default 64 KiB line buffer
+    must not kill the stream (etcd allows ~1.5 MiB objects)."""
+    async with stub_env() as (_, api):
+        col = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        big = hc_body("hc-big")
+        big["spec"]["payload"] = "x" * (1 << 20)  # ~1 MiB
+        await api.create(col, big)
+        async for ev in api.watch(api_path(GROUP, VERSION, PLURAL), timeout_seconds=5):
+            assert ev["object"]["metadata"]["name"] == "hc-big"
+            assert len(ev["object"]["spec"]["payload"]) == 1 << 20
+            break
+
+
+@pytest.mark.asyncio
+async def test_watch_resume_and_410():
+    async with stub_env() as (server, api):
+        col = api_path(GROUP, VERSION, PLURAL, namespace="health")
+        created = await api.create(col, hc_body("hc-a"))
+        rv = created["metadata"]["resourceVersion"]
+        await api.delete(api_path(GROUP, VERSION, PLURAL, "health", "hc-a"))
+
+        # resume from rv: only the DELETED event replays
+        events = []
+        async for ev in api.watch(
+            api_path(GROUP, VERSION, PLURAL), resource_version=rv, timeout_seconds=1
+        ):
+            events.append(ev["type"])
+            break
+        assert events == ["DELETED"]
+
+        # evict history -> too-old rv surfaces as 410
+        for _ in range(3):
+            await api.create(col, hc_body("hc-churn"))
+            await api.delete(api_path(GROUP, VERSION, PLURAL, "health", "hc-churn"))
+        server._history[:] = server._history[-1:]
+        with pytest.raises(ApiError) as e:
+            async for _ in api.watch(
+                api_path(GROUP, VERSION, PLURAL), resource_version=rv
+            ):
+                pass
+        assert e.value.status == 410
+
+
+@pytest.mark.asyncio
+async def test_bearer_token_auth():
+    async with stub_env(token="sekret") as (server, good):
+        bad = KubeApi(KubeConfig(server=server.url))
+        try:
+            with pytest.raises(ApiError) as e:
+                await bad.get(core_path("serviceaccounts", "health"))
+            assert e.value.status == 401
+        finally:
+            await bad.close()
+
+        listed = await good.get(core_path("serviceaccounts", "health"))
+        assert listed["items"] == []
+
+
+def test_server_url_with_path_prefix_is_preserved():
+    """Proxied clusters (Rancher etc.) serve the API under a path prefix;
+    it must survive in front of /api|/apis (an RFC 3986 join would
+    replace it)."""
+    api = KubeApi(KubeConfig(server="https://host/k8s/clusters/c-abc"))
+    assert api._url("/api/v1/pods") == "https://host/k8s/clusters/c-abc/api/v1/pods"
+    api2 = KubeApi(KubeConfig(server="https://host/k8s/clusters/c-abc/"))
+    assert api2._url("/apis/x/v1/y") == "https://host/k8s/clusters/c-abc/apis/x/v1/y"
+
+
+def test_bearer_token_rotates_from_file(tmp_path):
+    """Bound SA tokens rotate; a file-backed config must pick up the
+    new token after the TTL instead of caching the boot-time one."""
+    from activemonitor_tpu.kube import KubeConfig
+
+    tok = tmp_path / "token"
+    tok.write_text("token-v1\n")
+    cfg = KubeConfig(server="https://api", token="token-v1", token_file=str(tok))
+    assert cfg.bearer_token() == "token-v1"
+    tok.write_text("token-v2\n")
+    assert cfg.bearer_token() == "token-v1"  # inside the TTL: cached
+    cfg._token_read_at = 0.0  # TTL elapsed
+    assert cfg.bearer_token() == "token-v2"
+
+
+def test_exec_plugin_credentials(tmp_path):
+    """kubeconfig user.exec plugins (gke-gcloud-auth-plugin shape): run
+    the command, parse ExecCredential, cache until expirationTimestamp."""
+    import stat
+
+    from activemonitor_tpu.kube import KubeConfig
+
+    plugin = tmp_path / "fake-auth-plugin"
+    counter = tmp_path / "calls"
+    plugin.write_text(
+        "#!/bin/sh\n"
+        f"echo x >> {counter}\n"
+        'echo \'{"apiVersion": "client.authentication.k8s.io/v1beta1",'
+        ' "kind": "ExecCredential", "status": {"token": "exec-token-1",'
+        ' "expirationTimestamp": "2999-01-01T00:00:00Z"}}\'\n'
+    )
+    plugin.chmod(plugin.stat().st_mode | stat.S_IEXEC)
+    cfg = KubeConfig(server="https://api", exec_spec={"command": str(plugin)})
+    assert cfg.bearer_token() == "exec-token-1"
+    assert cfg.bearer_token() == "exec-token-1"  # cached: far-future expiry
+    assert counter.read_text().count("x") == 1
+
+
+def test_exec_plugin_failures_are_explained(tmp_path):
+    import stat
+
+    from activemonitor_tpu.kube import KubeConfig
+    from activemonitor_tpu.kube.config import KubeConfigError
+
+    bad = tmp_path / "broken-plugin"
+    bad.write_text("#!/bin/sh\necho nope >&2\nexit 3\n")
+    bad.chmod(bad.stat().st_mode | stat.S_IEXEC)
+    cfg = KubeConfig(server="https://api", exec_spec={"command": str(bad)})
+    with pytest.raises(KubeConfigError, match="exited 3"):
+        cfg.bearer_token()
+
+
+def test_kubeconfig_with_exec_user_loads(tmp_path):
+    import yaml
+
+    from activemonitor_tpu.kube.config import kubeconfig_file_config
+
+    path = tmp_path / "config"
+    path.write_text(
+        yaml.safe_dump(
+            {
+                "current-context": "gke",
+                "contexts": [{"name": "gke", "context": {"cluster": "c", "user": "u"}}],
+                "clusters": [{"name": "c", "cluster": {"server": "https://1.2.3.4"}}],
+                "users": [
+                    {
+                        "name": "u",
+                        "user": {
+                            "exec": {
+                                "apiVersion": "client.authentication.k8s.io/v1beta1",
+                                "command": "gke-gcloud-auth-plugin",
+                            }
+                        },
+                    }
+                ],
+            }
+        )
+    )
+    cfg = kubeconfig_file_config(str(path))
+    assert cfg is not None and cfg.exec_spec["command"] == "gke-gcloud-auth-plugin"
+
+
+def test_kubeconfig_unsupported_auth_provider_is_explained(tmp_path):
+    import yaml
+
+    from activemonitor_tpu.kube.config import KubeConfigError, kubeconfig_file_config
+
+    path = tmp_path / "config"
+    path.write_text(
+        yaml.safe_dump(
+            {
+                "current-context": "old",
+                "contexts": [{"name": "old", "context": {"cluster": "c", "user": "u"}}],
+                "clusters": [{"name": "c", "cluster": {"server": "https://1.2.3.4"}}],
+                "users": [{"name": "u", "user": {"auth-provider": {"name": "gcp"}}}],
+            }
+        )
+    )
+    with pytest.raises(KubeConfigError, match="gcp"):
+        kubeconfig_file_config(str(path))
+
+
+def test_kubeconfig_env_is_a_colon_separated_list(tmp_path, monkeypatch):
+    """kubectl semantics: $KUBECONFIG may list several files; the first
+    with a usable current-context wins."""
+    import yaml
+
+    from activemonitor_tpu.kube.config import kubeconfig_file_config
+
+    empty = tmp_path / "empty"
+    empty.write_text("{}")
+    good = tmp_path / "good"
+    good.write_text(
+        yaml.safe_dump(
+            {
+                "current-context": "c",
+                "contexts": [{"name": "c", "context": {"cluster": "c", "user": "u"}}],
+                "clusters": [{"name": "c", "cluster": {"server": "http://127.0.0.1:1"}}],
+                "users": [{"name": "u", "user": {"token": "t"}}],
+            }
+        )
+    )
+    import os
+
+    monkeypatch.setenv("KUBECONFIG", f"{empty}{os.pathsep}{good}")
+    cfg = kubeconfig_file_config()
+    assert cfg is not None and cfg.token == "t"
+
+
+def test_malformed_kubeconfig_returns_none(tmp_path):
+    from activemonitor_tpu.kube.config import kubeconfig_file_config
+
+    path = tmp_path / "config"
+    path.write_text("contexts: [{name: x}]\ncurrent-context: x\n")
+    assert kubeconfig_file_config(str(path)) is None
+    path.write_text("just a string")
+    assert kubeconfig_file_config(str(path)) is None
+
+
+@pytest.mark.asyncio
+async def test_core_and_cluster_scoped_paths():
+    async with stub_env() as (_, api):
+        # core v1 namespaced (serviceaccounts) and rbac cluster-scoped
+        sa = await api.create(
+            core_path("serviceaccounts", "health"),
+            {"metadata": {"name": "probe-sa"}},
+        )
+        assert sa["metadata"]["namespace"] == "health"
+        role = await api.create(
+            api_path("rbac.authorization.k8s.io", "v1", "clusterroles"),
+            {"metadata": {"name": "probe-role"}, "rules": []},
+        )
+        assert "namespace" not in role["metadata"]
+        got = await api.get(
+            api_path(
+                "rbac.authorization.k8s.io", "v1", "clusterroles", name="probe-role"
+            )
+        )
+        assert got["metadata"]["name"] == "probe-role"

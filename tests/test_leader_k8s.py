@@ -1,0 +1,302 @@
+"""KubernetesLeaseElector against the stub API server with a fake clock.
+
+Deterministic election semantics (reference: controller-runtime's
+leaderelection used at cmd/main.go:87-88): renew-keeps-leadership,
+expiry-takeover, step-down-before-takeover (never two leaders), and
+release-hands-over.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.controller.leader import KubernetesLeaseElector
+from activemonitor_tpu.kube import ApiError, KubeApi, KubeConfig
+from activemonitor_tpu.utils.clock import FakeClock
+
+from tests.kube_harness import stub_env
+
+LEASE = 15.0
+
+
+def elector(api, clock, identity):
+    return KubernetesLeaseElector(
+        api=api, namespace="health", identity=identity, lease_seconds=LEASE, clock=clock
+    )
+
+
+async def advance(clock, seconds, step=2.5):
+    """Advance the fake clock in small steps with real-time pauses so
+    HTTP roundtrips triggered by woken coroutines can complete."""
+    remaining = seconds
+    while remaining > 0:
+        await clock.advance(min(step, remaining))
+        await asyncio.sleep(0.05)
+        remaining -= step
+
+
+class FlakyApi:
+    """KubeApi wrapper with a switchable failure mode (simulated
+    API-server partition for one client only)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.failing = False
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in ("get", "create", "replace", "merge_patch", "delete", "request"):
+            async def wrapper(*a, **kw):
+                if self.failing:
+                    raise OSError("partitioned")
+                return await attr(*a, **kw)
+
+            return wrapper
+        return attr
+
+
+@pytest.mark.asyncio
+async def test_acquire_creates_lease_and_renews():
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert lease["spec"]["holderIdentity"] == "replica-a"
+        first_renew = lease["spec"]["renewTime"]
+
+        await advance(clock, LEASE)  # several renew periods
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert lease["spec"]["renewTime"] > first_renew
+        assert not a.lost.is_set()
+        a.release()
+
+
+@pytest.mark.asyncio
+async def test_standby_waits_while_leader_renews():
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+
+        b = elector(api, clock, "replica-b")
+        b_acquired = asyncio.Event()
+
+        async def b_runs():
+            await b.acquire()
+            b_acquired.set()
+
+        task = asyncio.create_task(b_runs())
+        await advance(clock, LEASE * 4)  # a renews throughout
+        assert not b_acquired.is_set()
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert lease["spec"]["holderIdentity"] == "replica-a"
+        a.release()
+        b.release()
+        task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_dead_leader_is_taken_over_after_expiry():
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+        # replica-a dies without releasing (no relinquish, no renewal)
+        a._renew_task.cancel()
+        a._stop = True
+
+        b = elector(api, clock, "replica-b")
+        b_acquired = asyncio.Event()
+
+        async def b_runs():
+            await b.acquire()
+            b_acquired.set()
+
+        task = asyncio.create_task(b_runs())
+        await advance(clock, LEASE / 2)
+        assert not b_acquired.is_set()  # lease not yet expired
+        await advance(clock, LEASE * 1.5)
+        await asyncio.wait_for(b_acquired.wait(), 5)
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", b._name)
+        assert lease["spec"]["holderIdentity"] == "replica-b"
+        b.release()
+        task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_partitioned_leader_steps_down_before_takeover():
+    """The failing holder hits its renew deadline (2/3 lease) and fires
+    ``lost`` BEFORE the challenger's takeover window (full lease)
+    opens — the split-brain ordering guarantee."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        flaky = FlakyApi(api)
+        a = elector(flaky, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+
+        b = elector(api, clock, "replica-b")
+        b_acquired_at = []
+
+        async def b_runs():
+            await b.acquire()
+            b_acquired_at.append(clock.monotonic())
+
+        task = asyncio.create_task(b_runs())
+        await advance(clock, 2.6)  # let b observe a live leader first
+        flaky.failing = True
+        a_lost_at = []
+
+        async def watch_lost():
+            await a.lost.wait()
+            a_lost_at.append(clock.monotonic())
+
+        lost_task = asyncio.create_task(watch_lost())
+        await advance(clock, LEASE * 3)
+        await asyncio.wait_for(a.lost.wait(), 5)
+        await asyncio.wait_for(lost_task, 5)
+        await asyncio.wait_for(task, 10)
+
+        assert a_lost_at and b_acquired_at
+        # the old leader stood down strictly before the new one rose
+        assert a_lost_at[0] < b_acquired_at[0]
+        b.release()
+
+
+@pytest.mark.asyncio
+async def test_single_transient_renewal_failure_does_not_lose_leadership():
+    """One API blip must be retried on the short retry cadence and
+    recovered — not turn into a full failover."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        flaky = FlakyApi(api)
+        a = elector(flaky, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+
+        # fail exactly one renewal attempt (t=5), then recover
+        await advance(clock, 4.9)
+        flaky.failing = True
+        await advance(clock, 0.2)  # the t=5 renew attempt fails
+        flaky.failing = False
+        await advance(clock, LEASE * 2)  # retries recover well before deadline
+        assert not a.lost.is_set()
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert lease["spec"]["holderIdentity"] == "replica-a"
+        a.release()
+
+
+@pytest.mark.asyncio
+async def test_takeover_observed_by_renewal_fires_lost():
+    """If another replica somehow takes the lease (e.g. after a long GC
+    pause on the holder), the holder's next renewal sees the foreign
+    identity and declares leadership lost rather than fighting."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+
+        # replace the holder behind a's back
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        lease["spec"]["holderIdentity"] = "replica-b"
+
+        await advance(clock, LEASE)
+        await asyncio.wait_for(a.lost.wait(), 5)
+
+
+@pytest.mark.asyncio
+async def test_release_relinquishes_for_fast_handover():
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+        a.release()
+        await asyncio.sleep(0.2)  # relinquish task runs in real time
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert lease["spec"]["holderIdentity"] == ""
+
+        # a standby acquires without waiting out the lease duration
+        b = elector(api, clock, "replica-b")
+        await asyncio.wait_for(b.acquire(), 5)
+        b.release()
+
+
+@pytest.mark.asyncio
+async def test_manager_stops_reconciling_on_lost_leadership():
+    """The manager end of the contract: when the elector fires ``lost``,
+    reconcile workers stop — the reference terminates the process
+    (controller-runtime semantics); here the stop signal propagates to
+    the CLI, which exits."""
+    from activemonitor_tpu.controller import (
+        EventRecorder,
+        HealthCheckReconciler,
+        InMemoryHealthCheckClient,
+        InMemoryRBACBackend,
+        RBACProvisioner,
+    )
+    from activemonitor_tpu.controller.manager import Manager
+    from activemonitor_tpu.engine import FakeWorkflowEngine
+    from activemonitor_tpu.metrics import MetricsCollector
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        client = InMemoryHealthCheckClient()
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=FakeWorkflowEngine(),
+            rbac=RBACProvisioner(InMemoryRBACBackend()),
+            recorder=EventRecorder(),
+            metrics=MetricsCollector(),
+        )
+        manager = Manager(
+            client=client, reconciler=reconciler, max_parallel=2, leader_elector=a
+        )
+        await manager.start()
+        assert manager.ready and not manager.stopping.is_set()
+
+        # another replica takes the lease behind our back
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        lease["spec"]["holderIdentity"] = "replica-b"
+        await advance(clock, LEASE)
+        await asyncio.wait_for(a.lost.wait(), 5)
+        await asyncio.wait_for(manager.stopping.wait(), 5)
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_two_challengers_race_one_wins():
+    """Preconditioned takeover: with an expired lease, two challengers
+    race the replace; exactly one must win the round."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        dead = elector(api, clock, "replica-dead")
+        await asyncio.wait_for(dead.acquire(), 5)
+        dead._renew_task.cancel()
+        dead._stop = True
+
+        api2 = KubeApi(KubeConfig(server=server.url))
+        try:
+            b = elector(api, clock, "replica-b")
+            c = elector(api2, clock, "replica-c")
+            winners = []
+
+            async def run(e, name):
+                await e.acquire()
+                winners.append(name)
+
+            tb = asyncio.create_task(run(b, "b"))
+            tc = asyncio.create_task(run(c, "c"))
+            await advance(clock, LEASE * 2.5)
+            await asyncio.sleep(0.3)
+            assert len(winners) == 1
+            holder = server.obj(
+                "coordination.k8s.io", "v1", "leases", "health", b._name
+            )["spec"]["holderIdentity"]
+            assert holder == f"replica-{winners[0]}"
+            for t in (tb, tc):
+                t.cancel()
+            b.release()
+            c.release()
+        finally:
+            await api2.close()
