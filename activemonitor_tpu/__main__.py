@@ -29,6 +29,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="metrics endpoint address ('0' to disable)",
     )
     run.add_argument(
+        "--metrics-secure",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="serve metrics over TLS (self-signed unless cert/key given; "
+        "reference parity: secure by default on :8443)",
+    )
+    run.add_argument(
+        "--metrics-cert-file",
+        default="",
+        help="PEM certificate for the metrics endpoint",
+    )
+    run.add_argument(
+        "--metrics-key-file",
+        default="",
+        help="PEM private key for the metrics endpoint",
+    )
+    run.add_argument(
+        "--metrics-auth-token-file",
+        default="",
+        help="file holding a static bearer token required to scrape "
+        "/metrics (the reference's authn/z filter equivalent)",
+    )
+    run.add_argument(
         "--health-probe-bind-address",
         default=":8081",
         help="health/readiness probe address ('0' to disable)",
@@ -77,12 +100,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument("--log-level", default="INFO")
 
+    def add_client_flags(p) -> None:
+        """kubectl-verb parity: every CLI verb can target the file store
+        (local mode) or the cluster (--client k8s)."""
+        p.add_argument("--store", default="./healthchecks")
+        p.add_argument("--client", choices=["file", "k8s"], default="file")
+        p.add_argument("--kubeconfig", default=None)
+
     for name, help_text in [
         ("apply", "apply a HealthCheck manifest to the store"),
         ("delete", "delete a HealthCheck from the store"),
     ]:
         p = sub.add_parser(name, help=help_text)
-        p.add_argument("--store", default="./healthchecks")
+        add_client_flags(p)
         if name == "apply":
             p.add_argument("-f", "--filename", required=True)
         else:
@@ -92,7 +122,7 @@ def build_parser() -> argparse.ArgumentParser:
     get = sub.add_parser("get", help="list HealthChecks (kubectl get hc)")
     get.add_argument("resource", nargs="?", default="hc", choices=["hc", "hcs", "healthchecks", "healthcheck"])
     get.add_argument("name", nargs="?", default=None)
-    get.add_argument("--store", default="./healthchecks")
+    add_client_flags(get)
     get.add_argument("--namespace", "-n", default=None)
     get.add_argument(
         "-o", "--output", choices=["table", "yaml", "json"], default="table"
@@ -108,7 +138,7 @@ def build_parser() -> argparse.ArgumentParser:
         "describe", help="spec + status + recent events for one HealthCheck"
     )
     describe.add_argument("name")
-    describe.add_argument("--store", default="./healthchecks")
+    add_client_flags(describe)
     describe.add_argument("--namespace", "-n", default="default")
 
     sub.add_parser("crd", help="print the HealthCheck CRD manifest")
@@ -202,10 +232,8 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         recorder=recorder,
         metrics=MetricsCollector(),
     )
-    for path in args.filename:
-        with open(path) as f:
-            await client.apply(HealthCheck.from_yaml(f.read()))
-
+    # Manager construction validates the flag combination BEFORE the -f
+    # manifests are applied (no side effects on a usage error)
     manager = Manager(
         client=client,
         reconciler=reconciler,
@@ -219,7 +247,13 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
             else args.health_probe_bind_address
         ),
         leader_elector=elector,
+        metrics_secure=args.metrics_secure,
+        metrics_cert_file=args.metrics_cert_file,
+        metrics_key_file=args.metrics_key_file,
+        metrics_auth_token_file=args.metrics_auth_token_file,
     )
+    for path in args.filename:
+        await client.apply(_load_manifest(HealthCheck, path))
 
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()
@@ -264,42 +298,86 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
     return 1 if lost_leadership else 0
 
 
-async def _apply(args) -> int:
-    from activemonitor_tpu.api.types import HealthCheck
+def _load_manifest(model, path: str):
+    """Parse a user-supplied manifest, converting parse/validation
+    failures into usage errors — ONLY at this boundary, so internal
+    ValidationErrors elsewhere keep their tracebacks."""
+    import yaml as _yaml
+
+    from pydantic import ValidationError
+
+    from activemonitor_tpu.errors import ConfigurationError
+
+    try:
+        with open(path) as f:
+            return model.from_yaml(f.read())
+    except (ValidationError, _yaml.YAMLError) as e:
+        raise ConfigurationError(f"invalid manifest {path!r}: {e}") from e
+    except OSError as e:
+        raise ConfigurationError(f"cannot read manifest {path!r}: {e}") from e
+
+
+def _cli_client(args):
+    """(client, kube_api-or-None) for a CLI verb, honoring --client."""
+    if getattr(args, "client", "file") == "k8s":
+        from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+        from activemonitor_tpu.kube import KubeApi
+        from activemonitor_tpu.kube.config import load_kube_config
+
+        api = KubeApi(load_kube_config(getattr(args, "kubeconfig", None)))
+        return KubernetesHealthCheckClient(api), api
     from activemonitor_tpu.controller.client_file import FileHealthCheckClient
 
-    client = FileHealthCheckClient(args.store)
-    with open(args.filename) as f:
-        hc = await client.apply(HealthCheck.from_yaml(f.read()))
+    return FileHealthCheckClient(args.store), None
+
+
+async def _apply(args) -> int:
+    from activemonitor_tpu.api.types import HealthCheck
+
+    hc = _load_manifest(HealthCheck, args.filename)
+    client, kube_api = _cli_client(args)
+    try:
+        hc = await client.apply(hc)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
     print(f"healthcheck.{hc.api_version.split('/')[0]}/{hc.metadata.name} applied")
     return 0
 
 
 async def _delete(args) -> int:
     from activemonitor_tpu.controller.client import NotFoundError
-    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
 
-    client = FileHealthCheckClient(args.store)
+    client, kube_api = _cli_client(args)
     try:
         await client.delete(args.namespace, args.name)
     except NotFoundError:
         print(f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr)
         return 1
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
     print(f"healthcheck {args.namespace}/{args.name} deleted")
     return 0
 
 
 async def _get(args) -> int:
+    if args.watch and args.output != "table":
+        print("--watch only supports table output", file=sys.stderr)
+        return 2
+    client, kube_api = _cli_client(args)
+    try:
+        return await _get_inner(args, client)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+
+
+async def _get_inner(args, client) -> int:
     import json as _json
 
     import yaml as _yaml
 
-    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
-
-    if args.watch and args.output != "table":
-        print("--watch only supports table output", file=sys.stderr)
-        return 2
-    client = FileHealthCheckClient(args.store)
     # name lookups are namespace-scoped like kubectl (default ns when
     # -n is unset) so the output shape never depends on collisions
     namespace = args.namespace or ("default" if args.name else None)
@@ -340,15 +418,49 @@ async def _get(args) -> int:
     print_table(checks)
     if args.watch:
         last = [hc.to_dict() for hc in checks]
+
+        async def refresh() -> None:
+            nonlocal last
+            current_checks = await fetch()
+            current = [hc.to_dict() for hc in current_checks]
+            if current != last:
+                last = current
+                print()
+                print_table(current_checks)
+
         try:
-            while True:
-                await asyncio.sleep(1.0)
-                checks = await fetch()
-                current = [hc.to_dict() for hc in checks]
-                if current != last:
-                    last = current
-                    print()
-                    print_table(checks)
+            if getattr(args, "client", "file") == "k8s":
+                # event-driven but rate-limited: events only mark dirty;
+                # one LIST refresh at most per second coalesces bursts
+                # (the initial synthetic-ADDED replay, reconcile churn)
+                dirty = asyncio.Event()
+
+                async def mark_dirty() -> None:
+                    async for _event in client.watch():
+                        dirty.set()
+
+                marker = asyncio.create_task(mark_dirty())
+                try:
+                    while True:
+                        await dirty.wait()
+                        dirty.clear()
+                        try:
+                            await refresh()
+                        except Exception as e:
+                            # transient LIST failure must not kill a
+                            # long-running watch (the stream reconnects;
+                            # so do we, on the next event)
+                            print(f"refresh failed ({e}); retrying", file=sys.stderr)
+                        await asyncio.sleep(1.0)
+                finally:
+                    marker.cancel()
+                    await asyncio.gather(marker, return_exceptions=True)
+            else:
+                # the file store is written by other processes — no
+                # cross-process change feed, so poll
+                while True:
+                    await asyncio.sleep(1.0)
+                    await refresh()
         except (KeyboardInterrupt, asyncio.CancelledError):
             return 0
     return 0
@@ -357,14 +469,19 @@ async def _get(args) -> int:
 async def _describe(args) -> int:
     import yaml as _yaml
 
-    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
-    from activemonitor_tpu.controller.events import FileEventRecorder
+    client, kube_api = _cli_client(args)
+    try:
+        hc = await client.get(args.namespace, args.name)
+        if hc is None:
+            print(
+                f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr
+            )
+            return 1
+        events = await _describe_events(args, kube_api)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
 
-    client = FileHealthCheckClient(args.store)
-    hc = await client.get(args.namespace, args.name)
-    if hc is None:
-        print(f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr)
-        return 1
     def print_indented(doc) -> None:
         for line in _yaml.safe_dump(doc, sort_keys=False).splitlines():
             print(f"  {line}")
@@ -376,11 +493,52 @@ async def _describe(args) -> int:
     print_indented(hc.spec.to_json_dict())
     print("Status detail:")
     print_indented(hc.status.to_json_dict())
-    events = FileEventRecorder.read_events(args.store, args.namespace, args.name)
     print(f"Events ({len(events)} recorded):")
     for ev in events[-20:]:
         print(f"  {ev.get('time', '')}  {ev.get('type', ''):8} {ev.get('message', '')}")
     return 0
+
+
+async def _describe_events(args, kube_api) -> list:
+    """Recent events for the check: the Events API in cluster mode
+    (what kubectl describe shows), the JSONL sidecars in file mode."""
+    if kube_api is not None:
+        from activemonitor_tpu.kube import core_path
+
+        # server-side filtering like kubectl; the client-side filter
+        # below stays as a belt (not every server honors the selector)
+        raw = await kube_api.get(
+            core_path("events", args.namespace),
+            params={
+                "fieldSelector": (
+                    f"involvedObject.name={args.name},"
+                    "involvedObject.kind=HealthCheck"
+                )
+            },
+        )
+        out = []
+        for ev in raw.get("items", []):
+            involved = ev.get("involvedObject") or {}
+            if involved.get("kind") == "HealthCheck" and involved.get("name") == args.name:
+                out.append(
+                    {
+                        # events.k8s.io-created events carry null first/
+                        # lastTimestamp (eventTime instead) — never None
+                        "time": (
+                            ev.get("lastTimestamp")
+                            or ev.get("firstTimestamp")
+                            or ev.get("eventTime")
+                            or ""
+                        ),
+                        "type": ev.get("type", ""),
+                        "reason": ev.get("reason", ""),
+                        "message": ev.get("message", ""),
+                    }
+                )
+        return sorted(out, key=lambda e: e["time"])
+    from activemonitor_tpu.controller.events import FileEventRecorder
+
+    return FileEventRecorder.read_events(args.store, args.namespace, args.name)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -404,13 +562,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     }[args.command]
     from activemonitor_tpu.errors import MissingDependencyError
 
+    from activemonitor_tpu.errors import ConfigurationError
+
     try:
         return asyncio.run(handler(args))
-    except MissingDependencyError as e:
-        # missing optional backend (e.g. cluster mode without the
-        # kubernetes package) reads as a usage error, not a crash
+    except (MissingDependencyError, ConfigurationError) as e:
+        # configuration problems (missing credentials, invalid flag
+        # combinations, bad manifests — wrapped as ConfigurationError at
+        # the parse site) read as usage errors, not crashes. Deliberately
+        # NOT every ValueError/ValidationError: those would eat
+        # tracebacks for internal bugs in a long-running controller
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return 2
 
 
 if __name__ == "__main__":

@@ -54,9 +54,12 @@ class KubernetesHealthCheckClient:
         by this write."""
         body = hc.to_dict()
         body.pop("status", None)
-        obj_path = api_path(
-            GROUP, VERSION, PLURAL, hc.metadata.namespace, hc.metadata.name
-        )
+        # an empty namespace would target the cluster-wide collection
+        # path, which a real API server rejects for namespaced CRs —
+        # default it like kubectl does
+        namespace = hc.metadata.namespace or "default"
+        body.setdefault("metadata", {})["namespace"] = namespace
+        obj_path = api_path(GROUP, VERSION, PLURAL, namespace, hc.metadata.name)
         for attempt in range(5):
             if attempt:
                 # bounded, backed-off retries: a webhook mutating every
@@ -64,7 +67,7 @@ class KubernetesHealthCheckClient:
                 await asyncio.sleep(0.05 * 2**attempt)
             try:
                 created = await self._api.create(
-                    api_path(GROUP, VERSION, PLURAL, hc.metadata.namespace), body
+                    api_path(GROUP, VERSION, PLURAL, namespace), body
                 )
                 break
             except ApiError as e:
@@ -183,12 +186,24 @@ class KubernetesHealthCheckClient:
 
     async def _vanished(self, known: set) -> list:
         """Keys in ``known`` that no longer exist on the server (the
-        deletions a 410 gap swallowed). Empty on list failure — the
-        retry happens on the next 410."""
-        try:
-            raw = await self._api.get(api_path(GROUP, VERSION, PLURAL))
-        except Exception:
-            log.warning("post-410 re-list failed; deletions may be delayed")
+        deletions a 410 gap swallowed). The list is retried with
+        backoff — it is the ONLY path that recovers those deletions
+        (another 410 may never come), so giving up after one attempt
+        would leave deleted checks' schedules firing forever."""
+        raw = None
+        for attempt in range(6):
+            if attempt:
+                await asyncio.sleep(min(0.2 * 2**attempt, 5.0))
+            try:
+                raw = await self._api.get(api_path(GROUP, VERSION, PLURAL))
+                break
+            except Exception:
+                continue
+        if raw is None:
+            log.error(
+                "post-410 re-list failed repeatedly; deletions during the "
+                "watch gap will only be noticed on the next 410/restart"
+            )
             return []
         current = {
             (

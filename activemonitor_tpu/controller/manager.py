@@ -40,12 +40,67 @@ class Manager:
         metrics_bind_address: str = "",  # "host:port" or "" to disable
         health_probe_bind_address: str = "",
         leader_elector: Optional[LeaderElector] = None,
+        metrics_secure: bool = False,  # TLS on the metrics endpoint
+        metrics_cert_file: str = "",  # self-signed fallback when empty
+        metrics_key_file: str = "",
+        metrics_auth_token: str = "",  # static bearer token; "" = open
+        metrics_auth_token_file: str = "",  # re-read with a TTL (rotation)
     ):
         self.client = client
         self.reconciler = reconciler
         self.max_parallel = max_parallel
         self._metrics_addr = metrics_bind_address
         self._health_addr = health_probe_bind_address
+        self._metrics_secure = metrics_secure
+        self._metrics_cert_file = metrics_cert_file
+        self._metrics_key_file = metrics_key_file
+        from activemonitor_tpu.utils.tokenfile import FileToken
+
+        self._metrics_token = FileToken(
+            path=metrics_auth_token_file, initial=metrics_auth_token
+        )
+        from activemonitor_tpu.errors import ConfigurationError
+
+        def addr_conflict(a: str, b: str) -> bool:
+            """Same port with overlapping hosts — ':8081' equals
+            '0.0.0.0:8081', localhost equals 127.0.0.1, and any
+            wildcard (v4 or v6) overlaps every host."""
+            wildcards = {"", "0.0.0.0", "::", "[::]", "*"}
+
+            def norm(host: str) -> str:
+                return "127.0.0.1" if host == "localhost" else host
+
+            if not a or not b:
+                return False
+            host_a, _, port_a = a.rpartition(":")
+            host_b, _, port_b = b.rpartition(":")
+            if port_a != port_b:
+                return False
+            host_a, host_b = norm(host_a), norm(host_b)
+            return (
+                host_a == host_b
+                or host_a in wildcards
+                or host_b in wildcards
+            )
+
+        if metrics_secure and addr_conflict(
+            metrics_bind_address, health_probe_bind_address
+        ):
+            # health probes must stay plaintext for the kubelet's default
+            # httpGet scheme; a shared TLS port would restart-loop the
+            # pod. Refuse at construction, before any side effects.
+            raise ConfigurationError(
+                "metrics and health probes cannot share an address when "
+                "--metrics-secure is on; use separate ports or "
+                "--no-metrics-secure"
+            )
+        if bool(metrics_cert_file) != bool(metrics_key_file):
+            # also a construction-time usage error: failing later at
+            # bind time would come after -f manifests were applied
+            raise ConfigurationError(
+                "metrics TLS needs BOTH --metrics-cert-file and "
+                "--metrics-key-file (got only one)"
+            )
         self._elector = leader_elector or AlwaysLeader()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: Set[str] = set()
@@ -242,6 +297,26 @@ class Manager:
         from aiohttp import web
 
         async def metrics(request):
+            # auth filter on the metrics endpoint only, like the
+            # reference's authn/z-filtered :8443 (cmd/main.go:74-81);
+            # health probes stay open for the kubelet
+            token = self._metrics_token.get()
+            if self._metrics_token.path and not token:
+                # a token file was configured but yields nothing (not
+                # mounted yet / wrong path): FAIL CLOSED — the operator
+                # asked for auth, so an empty token must not mean "open"
+                return web.Response(status=401, text="unauthorized")
+            if token:
+                import hmac
+
+                auth = request.headers.get("Authorization", "")
+                # bytes compare: compare_digest on str raises for
+                # non-ASCII headers (fuzzed input would 500, not 401)
+                if not hmac.compare_digest(
+                    auth.encode("utf-8", "surrogateescape"),
+                    f"Bearer {token}".encode(),
+                ):
+                    return web.Response(status=401, text="unauthorized")
             data = self.reconciler.metrics.exposition()
             return web.Response(
                 body=data, content_type="text/plain", charset="utf-8"
@@ -255,17 +330,27 @@ class Manager:
                 return web.Response(text="ok")
             return web.Response(status=503, text="not ready")
 
-        async def bind(addr: str, routes) -> None:
+        async def bind(addr: str, routes, secure: bool = False) -> None:
             host, _, port = addr.rpartition(":")
             app = web.Application()
             app.add_routes(routes)
             runner = web.AppRunner(app)
             await runner.setup()
-            site = web.TCPSite(runner, host or "0.0.0.0", int(port))
+            ssl_ctx = None
+            if secure:
+                from activemonitor_tpu.utils.tls import server_ssl_context
+
+                ssl_ctx = server_ssl_context(
+                    self._metrics_cert_file, self._metrics_key_file
+                )
+            site = web.TCPSite(
+                runner, host or "0.0.0.0", int(port), ssl_context=ssl_ctx
+            )
             await site.start()
             self._http_runners.append(runner)
 
         if self._metrics_addr and self._metrics_addr == self._health_addr:
+            # the secure+shared combination was rejected in __init__
             await bind(
                 self._metrics_addr,
                 [
@@ -276,7 +361,11 @@ class Manager:
             )
             return
         if self._metrics_addr:
-            await bind(self._metrics_addr, [web.get("/metrics", metrics)])
+            await bind(
+                self._metrics_addr,
+                [web.get("/metrics", metrics)],
+                secure=self._metrics_secure,
+            )
         if self._health_addr:
             await bind(
                 self._health_addr,

@@ -105,12 +105,17 @@ class KubeApi:
 
         headers = {"Accept": "application/json", "Content-Type": content_type}
         if self._config.exec_spec is not None:
-            # credential plugins shell out (up to tens of seconds cold) —
-            # off the event loop, one refresh at a time
-            if self._auth_lock is None:
-                self._auth_lock = asyncio.Lock()
-            async with self._auth_lock:
-                token = await asyncio.to_thread(self._config.bearer_token)
+            # fast path when the config says its cached token is still
+            # fresh — no lock/thread hop (lease renewals have a hard
+            # deadline on this path)
+            token = self._config.cached_token()
+            if token is None:
+                # credential plugins shell out (up to tens of seconds
+                # cold) — off the event loop, one refresh at a time
+                if self._auth_lock is None:
+                    self._auth_lock = asyncio.Lock()
+                async with self._auth_lock:
+                    token = await asyncio.to_thread(self._config.bearer_token)
         else:
             token = self._config.bearer_token()
         if token:

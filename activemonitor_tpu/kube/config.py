@@ -44,10 +44,21 @@ class KubeConfig:
     # aws eks get-token, ...): run on demand, cached until expiry
     exec_spec: Optional[dict] = None
     _tempfiles: list = field(default_factory=list, repr=False)
-    _token_read_at: float = field(default=0.0, repr=False)
+    _file_token: object = field(default=None, repr=False)
     _exec_valid_until: float = field(default=0.0, repr=False)
 
-    TOKEN_TTL = 60.0  # seconds between token-file re-reads
+    def cached_token(self) -> Optional[str]:
+        """The token WITHOUT any refresh, or None when a (potentially
+        slow, blocking) refresh is needed — the async client's lock-free
+        fast path. Owns the freshness rule so callers never touch the
+        internals."""
+        import time
+
+        if self.exec_spec is not None:
+            if time.monotonic() < self._exec_valid_until:
+                return self.token
+            return None
+        return None  # non-exec refreshes are cheap; take the slow path
 
     def bearer_token(self) -> str:
         """The current token, honoring file rotation and exec plugins."""
@@ -57,11 +68,12 @@ class KubeConfig:
             if time.monotonic() >= self._exec_valid_until:
                 self._run_exec_plugin()
             return self.token
-        if self.token_file and time.monotonic() - self._token_read_at > self.TOKEN_TTL:
-            fresh = _read_maybe(self.token_file)
-            if fresh:
-                self.token = fresh.decode().strip()
-            self._token_read_at = time.monotonic()
+        if self.token_file:
+            if self._file_token is None:
+                from activemonitor_tpu.utils.tokenfile import FileToken
+
+                self._file_token = FileToken(self.token_file, initial=self.token)
+            self.token = self._file_token.get() or self.token
         return self.token
 
     def _run_exec_plugin(self) -> None:
@@ -115,8 +127,10 @@ class KubeConfig:
             raise KubeConfigError(
                 f"credential plugin {cmd[0]!r} returned no token"
             )
+        from activemonitor_tpu.utils.tokenfile import DEFAULT_TTL
+
         self.token = token
-        valid = self.TOKEN_TTL
+        valid = DEFAULT_TTL
         expiry_raw = status.get("expirationTimestamp")
         if expiry_raw:
             try:
@@ -209,13 +223,20 @@ def kubeconfig_file_config(path: Optional[str] = None) -> Optional[KubeConfig]:
         candidates = [
             p for p in os.environ.get("KUBECONFIG", "").split(os.pathsep) if p
         ] or [os.path.expanduser("~/.kube/config")]
+        first_error: KubeConfigError | None = None
         for candidate in candidates:
             try:
                 cfg = kubeconfig_file_config(candidate)
-            except KubeConfigError:
+            except KubeConfigError as e:
+                first_error = first_error or e
                 continue  # unusable credentials: try the next file
             if cfg is not None:
                 return cfg
+        if first_error is not None:
+            # a file EXISTED but its credentials are unusable: silently
+            # falling through to other credential sources would connect
+            # to a different cluster than the operator named
+            raise first_error
         return None
     raw = _read_maybe(path)
     if raw is None:
@@ -264,10 +285,13 @@ def kubeconfig_file_config(path: Optional[str] = None) -> Optional[KubeConfig]:
                 "client certificates, exec plugins)"
             )
         return cfg
-    except (KeyError, AttributeError, TypeError, yaml.YAMLError):
-        # structurally malformed kubeconfig: same signal as "missing" so
-        # the caller raises the explanatory KubeConfigError
-        return None
+    except (KeyError, AttributeError, TypeError, yaml.YAMLError) as e:
+        # structurally malformed is NOT the same as missing: the operator
+        # named this file, so silently falling through to other
+        # credential sources could connect to the wrong cluster
+        raise KubeConfigError(
+            f"malformed kubeconfig at {path!r}: {type(e).__name__}: {e}"
+        ) from e
 
 
 def load_kube_config(kubeconfig: Optional[str] = None) -> KubeConfig:
@@ -280,14 +304,9 @@ def load_kube_config(kubeconfig: Optional[str] = None) -> KubeConfig:
         if cfg is None:
             raise KubeConfigError(f"unusable kubeconfig at {kubeconfig!r}")
         return cfg
-    env_paths = [
-        p for p in os.environ.get("KUBECONFIG", "").split(os.pathsep) if p
-    ]
-    for candidate in env_paths:
-        try:
-            cfg = kubeconfig_file_config(candidate)
-        except KubeConfigError:
-            continue  # unusable credentials: try the next file
+    if os.environ.get("KUBECONFIG"):
+        # delegate the colon-separated-list iteration (first usable wins)
+        cfg = kubeconfig_file_config(None)
         if cfg is not None:
             return cfg
     cfg = incluster_config() or kubeconfig_file_config(

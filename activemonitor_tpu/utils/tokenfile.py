@@ -1,0 +1,48 @@
+"""TTL-cached token file.
+
+Secrets mounted into pods rotate (bound SA tokens ~1h, scrape tokens on
+operator action); anything comparing or sending such a token must
+re-read the file periodically instead of snapshotting it at startup.
+One implementation, shared by the metrics auth filter and the cluster
+credentials (kube.config).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("activemonitor.tokenfile")
+
+DEFAULT_TTL = 60.0
+
+
+class FileToken:
+    """A token string, re-read from ``path`` at most every ``ttl``
+    seconds. With no path it is just a static value. A read failure
+    keeps the previous value (and logs); whether an EMPTY result means
+    "open" or "deny" is the caller's policy — see :meth:`get`."""
+
+    def __init__(self, path: str = "", initial: str = "", ttl: float = DEFAULT_TTL):
+        self.path = path
+        self._value = initial
+        self._ttl = ttl
+        # -inf, not 0.0: monotonic() starts near zero after host boot,
+        # and "never read" must always trigger the first read
+        self._read_at = float("-inf")
+
+    def get(self) -> str:
+        if self.path and time.monotonic() - self._read_at > self._ttl:
+            try:
+                with open(self.path) as f:
+                    self._value = f.read().strip()
+            except OSError:
+                log.warning(
+                    "token file %s unreadable; keeping previous value", self.path
+                )
+            self._read_at = time.monotonic()
+        return self._value
+
+    def expire(self) -> None:
+        """Force the next get() to re-read (tests)."""
+        self._read_at = float("-inf")

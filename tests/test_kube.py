@@ -214,7 +214,7 @@ def test_bearer_token_rotates_from_file(tmp_path):
     assert cfg.bearer_token() == "token-v1"
     tok.write_text("token-v2\n")
     assert cfg.bearer_token() == "token-v1"  # inside the TTL: cached
-    cfg._token_read_at = 0.0  # TTL elapsed
+    cfg._file_token.expire()  # TTL elapsed
     assert cfg.bearer_token() == "token-v2"
 
 
@@ -332,14 +332,25 @@ def test_kubeconfig_env_is_a_colon_separated_list(tmp_path, monkeypatch):
     assert cfg is not None and cfg.token == "t"
 
 
-def test_malformed_kubeconfig_returns_none(tmp_path):
-    from activemonitor_tpu.kube.config import kubeconfig_file_config
+def test_malformed_kubeconfig_is_a_loud_error(tmp_path, monkeypatch):
+    """A named-but-broken kubeconfig must error, never silently fall
+    through to other credential sources (wrong-cluster hazard)."""
+    from activemonitor_tpu.kube.config import (
+        KubeConfigError,
+        kubeconfig_file_config,
+        load_kube_config,
+    )
 
     path = tmp_path / "config"
-    path.write_text("contexts: [{name: x}]\ncurrent-context: x\n")
-    assert kubeconfig_file_config(str(path)) is None
     path.write_text("just a string")
-    assert kubeconfig_file_config(str(path)) is None
+    with pytest.raises(KubeConfigError, match="malformed"):
+        kubeconfig_file_config(str(path))
+    # ...including via $KUBECONFIG discovery
+    monkeypatch.setenv("KUBECONFIG", str(path))
+    with pytest.raises(KubeConfigError, match="malformed"):
+        load_kube_config()
+    # a MISSING file is not an error (fall through to other sources)
+    assert kubeconfig_file_config(str(tmp_path / "nope")) is None
 
 
 @pytest.mark.asyncio

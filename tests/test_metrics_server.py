@@ -1,0 +1,225 @@
+"""Secure metrics serving — TLS + bearer-token auth.
+
+Reference parity: metrics on :8443 secure-by-default with an authn/z
+filter, self-signed fallback when no cert is supplied
+(reference: cmd/main.go:74-81, flags :138-144). Health probes stay
+plaintext and unauthenticated for the kubelet.
+"""
+
+import asyncio
+import ssl
+
+import pytest
+
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.utils.tls import generate_self_signed_cert
+
+
+def free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_manager(**kwargs):
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=FakeWorkflowEngine(),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+    )
+    return Manager(client=client, reconciler=reconciler, max_parallel=1, **kwargs)
+
+
+async def fetch(url, token=None, verify=False, ca_pem=None):
+    import aiohttp
+
+    if url.startswith("https"):
+        if ca_pem is not None:
+            ctx = ssl.create_default_context(cadata=ca_pem.decode())
+            ctx.check_hostname = False  # IP connect vs DNS SAN
+        else:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+    else:
+        ctx = None
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    async with aiohttp.ClientSession() as session:
+        async with session.get(url, ssl=ctx, headers=headers) as resp:
+            return resp.status, await resp.text()
+
+
+@pytest.mark.asyncio
+async def test_metrics_tls_self_signed_by_default():
+    port = free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}", metrics_secure=True
+    )
+    await manager.start()
+    try:
+        # https works (self-signed, so no verification)
+        status, text = await fetch(f"https://127.0.0.1:{port}/metrics")
+        assert status == 200
+        assert "healthcheck_success_count" in text
+        # plaintext scrape against the TLS port fails
+        with pytest.raises(Exception):
+            await fetch(f"http://127.0.0.1:{port}/metrics")
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_tls_with_supplied_certificate(tmp_path):
+    cert_pem, key_pem = generate_self_signed_cert("metrics.test")
+    cert_file = tmp_path / "tls.crt"
+    key_file = tmp_path / "tls.key"
+    cert_file.write_bytes(cert_pem)
+    key_file.write_bytes(key_pem)
+
+    port = free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}",
+        metrics_secure=True,
+        metrics_cert_file=str(cert_file),
+        metrics_key_file=str(key_file),
+    )
+    await manager.start()
+    try:
+        # the client VERIFIES against the supplied cert — proof the
+        # server actually serves it, not an ephemeral one
+        status, _ = await fetch(f"https://127.0.0.1:{port}/metrics", ca_pem=cert_pem)
+        assert status == 200
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_bearer_auth():
+    port_metrics, port_health = free_port(), free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port_metrics}",
+        health_probe_bind_address=f"127.0.0.1:{port_health}",
+        metrics_auth_token="scrape-me",
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(f"http://127.0.0.1:{port_metrics}/metrics")
+        assert status == 401
+        status, _ = await fetch(
+            f"http://127.0.0.1:{port_metrics}/metrics", token="wrong"
+        )
+        assert status == 401
+        status, text = await fetch(
+            f"http://127.0.0.1:{port_metrics}/metrics", token="scrape-me"
+        )
+        assert status == 200 and "healthcheck" in text
+        # health probes stay open (kubelet has no tokens)
+        status, _ = await fetch(f"http://127.0.0.1:{port_health}/healthz")
+        assert status == 200
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_token_rotation_from_file(tmp_path):
+    """A rotated scrape-token Secret must be picked up without a
+    restart (TTL re-read)."""
+    token_file = tmp_path / "token"
+    token_file.write_text("first\n")
+    port = free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}",
+        metrics_auth_token_file=str(token_file),
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="first")
+        assert status == 200
+        token_file.write_text("second\n")
+        manager._metrics_token.expire()  # TTL elapsed
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="first")
+        assert status == 401
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="second")
+        assert status == 200
+        # fuzzed non-ASCII header is a 401, not a 500
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="tök€n")
+        assert status == 401
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_auth_fails_closed_on_unreadable_token_file():
+    """--metrics-auth-token-file pointing at a missing file (Secret not
+    mounted) must DENY, not silently serve unauthenticated."""
+    port = free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}",
+        metrics_auth_token_file="/nonexistent/scrape-token",
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics")
+        assert status == 401
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="anything")
+        assert status == 401
+    finally:
+        await manager.stop()
+
+
+def test_half_supplied_cert_pair_is_refused(tmp_path):
+    from activemonitor_tpu.utils.tls import server_ssl_context
+
+    with pytest.raises(ValueError, match="BOTH"):
+        server_ssl_context(cert_file=str(tmp_path / "only.crt"))
+
+
+@pytest.mark.asyncio
+async def test_metrics_plaintext_when_explicitly_insecure():
+    port = free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}", metrics_secure=False
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200
+    finally:
+        await manager.stop()
+
+
+def test_shared_secure_address_is_refused():
+    """TLS on a port shared with health probes would break kubelet
+    httpGet probes — refused at construction, before any side effects."""
+    with pytest.raises(ValueError, match="share an address"):
+        make_manager(
+            metrics_bind_address="127.0.0.1:9999",
+            health_probe_bind_address="127.0.0.1:9999",
+            metrics_secure=True,
+        )
+
+
+def test_cli_defaults_secure():
+    from activemonitor_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(["run"])
+    assert args.metrics_secure is True
+    assert args.metrics_bind_address == ":8443"
+    args = build_parser().parse_args(["run", "--no-metrics-secure"])
+    assert args.metrics_secure is False
