@@ -30,6 +30,40 @@ log = logging.getLogger("activemonitor.manager")
 
 DEFAULT_MAX_PARALLEL = 10  # reference: cmd/main.go:144
 
+WILDCARD_HOSTS = {"", "0.0.0.0", "::", "[::]", "*"}
+
+
+def _norm_host(host: str) -> str:
+    return "127.0.0.1" if host == "localhost" else host
+
+
+def addr_conflict(a: str, b: str) -> bool:
+    """Same port with overlapping hosts — ':8081' equals
+    '0.0.0.0:8081', localhost equals 127.0.0.1, and any wildcard
+    (v4 or v6) overlaps every host."""
+    if not a or not b:
+        return False
+    host_a, _, port_a = a.rpartition(":")
+    host_b, _, port_b = b.rpartition(":")
+    if port_a != port_b:
+        return False
+    host_a, host_b = _norm_host(host_a), _norm_host(host_b)
+    return (
+        host_a == host_b or host_a in WILDCARD_HOSTS or host_b in WILDCARD_HOSTS
+    )
+
+
+def addr_same(a: str, b: str) -> bool:
+    """Exactly the same socket (normalized host + port) — the only
+    overlap that can be served as one merged site without changing
+    either endpoint's exposure."""
+    host_a, _, port_a = a.rpartition(":")
+    host_b, _, port_b = b.rpartition(":")
+    host_a, host_b = _norm_host(host_a), _norm_host(host_b)
+    if host_a in WILDCARD_HOSTS and host_b in WILDCARD_HOSTS:
+        host_a = host_b = "0.0.0.0"
+    return port_a == port_b and host_a == host_b
+
 
 class Manager:
     def __init__(
@@ -56,36 +90,33 @@ class Manager:
         self._metrics_key_file = metrics_key_file
         from activemonitor_tpu.utils.tokenfile import FileToken
 
+        # on_error="clear": a deleted/unmounted token file means access
+        # was revoked — the gate fails closed, never "last token wins"
         self._metrics_token = FileToken(
-            path=metrics_auth_token_file, initial=metrics_auth_token
+            path=metrics_auth_token_file,
+            initial=metrics_auth_token,
+            on_error="clear",
         )
         from activemonitor_tpu.errors import ConfigurationError
 
-        def addr_conflict(a: str, b: str) -> bool:
-            """Same port with overlapping hosts — ':8081' equals
-            '0.0.0.0:8081', localhost equals 127.0.0.1, and any
-            wildcard (v4 or v6) overlaps every host."""
-            wildcards = {"", "0.0.0.0", "::", "[::]", "*"}
-
-            def norm(host: str) -> str:
-                return "127.0.0.1" if host == "localhost" else host
-
-            if not a or not b:
-                return False
-            host_a, _, port_a = a.rpartition(":")
-            host_b, _, port_b = b.rpartition(":")
-            if port_a != port_b:
-                return False
-            host_a, host_b = norm(host_a), norm(host_b)
-            return (
-                host_a == host_b
-                or host_a in wildcards
-                or host_b in wildcards
-            )
-
-        if metrics_secure and addr_conflict(
+        # one overlap decision drives both the secure refusal and the
+        # plaintext single-site merge — a string-equality merge would
+        # double-bind ':9090' vs '0.0.0.0:9090' (EADDRINUSE mid-start)
+        conflict = addr_conflict(metrics_bind_address, health_probe_bind_address)
+        self._shared_addr = conflict and addr_same(
             metrics_bind_address, health_probe_bind_address
-        ):
+        )
+        if conflict and not self._shared_addr:
+            # same port, DIFFERENT hosts (one a wildcard): a merge would
+            # silently widen or narrow one endpoint's exposure — refuse,
+            # whether secure or not
+            raise ConfigurationError(
+                "metrics and health probe addresses overlap on one port "
+                "with different hosts "
+                f"({metrics_bind_address!r} vs {health_probe_bind_address!r}); "
+                "use identical addresses to share the port, or different ports"
+            )
+        if metrics_secure and self._shared_addr:
             # health probes must stay plaintext for the kubelet's default
             # httpGet scheme; a shared TLS port would restart-loop the
             # pod. Refuse at construction, before any side effects.
@@ -101,6 +132,22 @@ class Manager:
                 "metrics TLS needs BOTH --metrics-cert-file and "
                 "--metrics-key-file (got only one)"
             )
+        # build the TLS context NOW so a missing/malformed PEM is a
+        # usage error before any side effects, not a bind-time traceback
+        self._metrics_ssl = None
+        if metrics_secure and metrics_bind_address:
+            import ssl as _ssl
+
+            from activemonitor_tpu.utils.tls import server_ssl_context
+
+            try:
+                self._metrics_ssl = server_ssl_context(
+                    metrics_cert_file, metrics_key_file
+                )
+            except (OSError, _ssl.SSLError) as e:
+                raise ConfigurationError(
+                    f"metrics TLS certificate unusable: {e}"
+                ) from e
         self._elector = leader_elector or AlwaysLeader()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: Set[str] = set()
@@ -336,21 +383,19 @@ class Manager:
             app.add_routes(routes)
             runner = web.AppRunner(app)
             await runner.setup()
-            ssl_ctx = None
-            if secure:
-                from activemonitor_tpu.utils.tls import server_ssl_context
-
-                ssl_ctx = server_ssl_context(
-                    self._metrics_cert_file, self._metrics_key_file
-                )
             site = web.TCPSite(
-                runner, host or "0.0.0.0", int(port), ssl_context=ssl_ctx
+                runner,
+                host or "0.0.0.0",
+                int(port),
+                ssl_context=self._metrics_ssl if secure else None,
             )
             await site.start()
             self._http_runners.append(runner)
 
-        if self._metrics_addr and self._metrics_addr == self._health_addr:
-            # the secure+shared combination was rejected in __init__
+        if self._metrics_addr and self._shared_addr:
+            # identical sockets only (addr_same in __init__); overlapping
+            # -but-different hosts were refused there, so this merge
+            # cannot change either endpoint's exposure
             await bind(
                 self._metrics_addr,
                 [

@@ -56,6 +56,16 @@ DEFAULT_HEALTHCHECK_RULES = [
         resources=["workflows"],
         verbs=["get", "list", "watch"],
     ),
+    # divergence from the reference defaults (which predate Argo 3.4):
+    # the Argo executor sidecar reports step results via
+    # workflowtaskresults, so probe pods without this grant fail to
+    # report on modern Argo. Write access is scoped to exactly that
+    # reporting resource; everything else stays read-only.
+    PolicyRule(
+        api_groups=["argoproj.io"],
+        resources=["workflowtaskresults"],
+        verbs=["create", "patch"],
+    ),
 ]
 
 # reference: healthcheck_controller.go:104-120

@@ -79,7 +79,8 @@ class KubeConfig:
     def _run_exec_plugin(self) -> None:
         """client-go exec credential protocol: run the plugin, parse the
         ExecCredential JSON it prints, cache the token until its
-        expirationTimestamp (minus slack) or TOKEN_TTL."""
+        expirationTimestamp (minus slack), or for the default token TTL
+        when the plugin reports no expiry."""
         import datetime
         import json
         import subprocess

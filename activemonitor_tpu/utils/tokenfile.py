@@ -19,14 +19,26 @@ DEFAULT_TTL = 60.0
 
 class FileToken:
     """A token string, re-read from ``path`` at most every ``ttl``
-    seconds. With no path it is just a static value. A read failure
-    keeps the previous value (and logs); whether an EMPTY result means
-    "open" or "deny" is the caller's policy — see :meth:`get`."""
+    seconds. With no path it is just a static value.
 
-    def __init__(self, path: str = "", initial: str = "", ttl: float = DEFAULT_TTL):
+    ``on_error`` picks the failure policy — the two consumers genuinely
+    differ: ``"keep"`` (default) holds the last good value, right for
+    CLIENT credentials where a transient kubelet-rotation glitch must
+    not drop cluster auth; ``"clear"`` empties the value, right for
+    SERVER-side auth where a deleted/unmounted token file means the
+    operator revoked access and the gate must fail closed."""
+
+    def __init__(
+        self,
+        path: str = "",
+        initial: str = "",
+        ttl: float = DEFAULT_TTL,
+        on_error: str = "keep",
+    ):
         self.path = path
         self._value = initial
         self._ttl = ttl
+        self._on_error = on_error
         # -inf, not 0.0: monotonic() starts near zero after host boot,
         # and "never read" must always trigger the first read
         self._read_at = float("-inf")
@@ -37,9 +49,16 @@ class FileToken:
                 with open(self.path) as f:
                     self._value = f.read().strip()
             except OSError:
-                log.warning(
-                    "token file %s unreadable; keeping previous value", self.path
-                )
+                if self._on_error == "clear":
+                    log.warning(
+                        "token file %s unreadable; clearing value (fail closed)",
+                        self.path,
+                    )
+                    self._value = ""
+                else:
+                    log.warning(
+                        "token file %s unreadable; keeping previous value", self.path
+                    )
             self._read_at = time.monotonic()
         return self._value
 

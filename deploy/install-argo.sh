@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-shot Argo Workflows install for active-monitor-tpu.
+# Reference equivalent: deploy/deploy-argo.yaml (which vendors the full
+# Argo distribution); here the release is pinned and pulled from
+# upstream, then scoped to this framework via the instance-id contract.
+#
+# NAMESPACE defaults to "argo" because the upstream install.yaml's
+# ClusterRoleBindings hardcode subjects in the "argo" namespace —
+# installing it anywhere else leaves the workflow-controller SA unbound
+# (Forbidden on every watch). The controller is a cluster install: it
+# processes labeled workflows in EVERY namespace, including "health"
+# where active-monitor-tpu submits probes.
+set -euo pipefail
+
+ARGO_VERSION="${ARGO_VERSION:-v3.5.8}"
+NAMESPACE="${NAMESPACE:-argo}"
+HERE="$(cd "$(dirname "$0")" && pwd)"
+
+kubectl create namespace "${NAMESPACE}" --dry-run=client -o yaml | kubectl apply -f -
+
+# pinned upstream distribution (CRDs + workflow-controller + server)
+kubectl apply -n "${NAMESPACE}" -f \
+  "https://github.com/argoproj/argo-workflows/releases/download/${ARGO_VERSION}/install.yaml"
+
+# instance-id contract: only workflows labeled
+# workflows.argoproj.io/controller-instanceid=activemonitor-workflows
+# are processed by this controller (active-monitor-tpu labels every
+# submission; see activemonitor_tpu/controller/workflow_spec.py:34-35).
+# The ConfigMap is namespace-less and applied with -n so it always lands
+# next to the workflow-controller that reads it.
+kubectl apply -n "${NAMESPACE}" -f "${HERE}/install-argo.yaml"
+kubectl -n "${NAMESPACE}" rollout restart deployment workflow-controller
+
+kubectl -n "${NAMESPACE}" rollout status deployment workflow-controller --timeout=120s
+echo "Argo ${ARGO_VERSION} installed in namespace ${NAMESPACE} (instance-id: activemonitor-workflows)"

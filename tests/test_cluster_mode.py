@@ -254,9 +254,13 @@ async def test_rbac_provisioner_creates_real_cluster_objects():
 
         role = server.obj(RBAC_GROUP, "v1", "clusterroles", "", "check-sa-cluster-role")
         assert role is not None
-        # read-only defaults: no write verbs anywhere (reference :85-101)
+        # read-only defaults (reference :85-101), except the scoped
+        # Argo-executor reporting grant (divergence #9, docs/design.md)
         for rule in role["rules"]:
-            assert set(rule["verbs"]) == {"get", "list", "watch"}
+            if rule["resources"] == ["workflowtaskresults"]:
+                assert set(rule["verbs"]) == {"create", "patch"}
+            else:
+                assert set(rule["verbs"]) == {"get", "list", "watch"}
             assert "*" not in rule["resources"]
 
         binding = server.obj(

@@ -97,3 +97,53 @@ def test_deploy_manifest_parses():
         "ClusterRoleBinding",
         "Deployment",
     ]
+
+
+def test_argo_install_wires_the_instance_id_contract():
+    """deploy/install-argo.{sh,yaml} must configure Argo with the SAME
+    instance id the spec mutator stamps on every submitted workflow —
+    a mismatch would make Argo silently ignore all probes."""
+    from activemonitor_tpu.controller import WF_INSTANCE_ID
+
+    docs = list(yaml.safe_load_all(Path("deploy/install-argo.yaml").read_text()))
+    configmaps = [d for d in docs if d and d.get("kind") == "ConfigMap"]
+    assert any(
+        cm["data"].get("instanceID") == WF_INSTANCE_ID for cm in configmaps
+    ), "workflow-controller-configmap must carry the framework's instanceID"
+
+    script = Path("deploy/install-argo.sh").read_text()
+    assert WF_INSTANCE_ID in script
+    assert "install.yaml" in script  # pinned upstream distribution
+    import os
+
+    assert os.access("deploy/install-argo.sh", os.X_OK)
+
+
+def test_manager_clusterrole_covers_every_api_the_controller_uses():
+    """Manager-role parity (reference: config/rbac/role.yaml): each
+    group/resource the runtime touches must be grantable from the
+    deploy manifest's ClusterRole."""
+    docs = list(
+        yaml.safe_load_all(Path("deploy/deploy-active-monitor-tpu.yaml").read_text())
+    )
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    granted = {
+        (group, resource)
+        for rule in role["rules"]
+        for group in rule["apiGroups"]
+        for resource in rule["resources"]
+    }
+    needed = {
+        ("activemonitor.keikoproj.io", "healthchecks"),  # client_k8s.py
+        ("activemonitor.keikoproj.io", "healthchecks/status"),
+        ("argoproj.io", "workflows"),  # engine/argo.py
+        ("", "serviceaccounts"),  # rbac.py KubernetesRBACBackend
+        ("rbac.authorization.k8s.io", "roles"),
+        ("rbac.authorization.k8s.io", "rolebindings"),
+        ("rbac.authorization.k8s.io", "clusterroles"),
+        ("rbac.authorization.k8s.io", "clusterrolebindings"),
+        ("", "events"),  # events.py KubernetesEventRecorder
+        ("coordination.k8s.io", "leases"),  # leader.py
+    }
+    missing = needed - granted
+    assert not missing, f"deploy ClusterRole missing grants: {missing}"

@@ -33,9 +33,36 @@ def test_entry_lowers_without_execution():
     assert "func" in lowered.as_text()[:2000]
 
 
-def test_dryrun_multichip_full_matrix():
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_dryrun_multichip_full_matrix(n_devices):
+    """Mesh-shape edge cases stay covered as the parallelism code
+    evolves: 2 (degenerate 1x2), 4 (square 2x2), 8 (the driver's
+    non-square 2x4)."""
     # conftest already forces the 8-device virtual CPU platform
-    __graft_entry__.dryrun_multichip(8)
+    __graft_entry__.dryrun_multichip(n_devices)
+
+
+def test_dryrun_multichip_16_devices_subprocess():
+    """16 devices exceeds this process's virtual platform — exercise the
+    larger mesh (4x4, deeper pipeline staging) in a fresh interpreter."""
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__ as g; g.dryrun_multichip(16); print('ok16')",
+        ],
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=16",
+        },
+    )
+    assert result.returncode == 0, result.stderr[-1500:]
+    assert "ok16" in result.stdout
 
 
 def test_dryrun_insufficient_devices_errors():

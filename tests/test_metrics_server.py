@@ -165,6 +165,59 @@ async def test_metrics_token_rotation_from_file(tmp_path):
 
 
 @pytest.mark.asyncio
+async def test_metrics_auth_fails_closed_when_token_file_deleted(tmp_path):
+    """Revoking the token by deleting the file must 401 the old token
+    after the TTL — fail closed, not last-token-wins."""
+    token_file = tmp_path / "token"
+    token_file.write_text("live-token\n")
+    port = free_port()
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}",
+        metrics_auth_token_file=str(token_file),
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="live-token")
+        assert status == 200
+        token_file.unlink()  # operator revokes access
+        manager._metrics_token.expire()
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="live-token")
+        assert status == 401
+    finally:
+        await manager.stop()
+
+
+def test_plaintext_overlapping_addresses_merge_instead_of_double_binding():
+    """':P' and '0.0.0.0:P' are the same socket — the manager must
+    serve one combined site, not crash with EADDRINUSE mid-start."""
+    from activemonitor_tpu.controller.manager import addr_conflict, addr_same
+
+    assert addr_conflict(":9090", "0.0.0.0:9090")
+    assert addr_conflict("localhost:9090", "127.0.0.1:9090")
+    assert not addr_conflict(":9090", ":9091")
+    assert not addr_conflict("", ":9090")
+    assert addr_same(":9090", "0.0.0.0:9090")
+    assert not addr_same("127.0.0.1:9090", "0.0.0.0:9090")
+    m = make_manager(
+        metrics_bind_address=":9090",
+        health_probe_bind_address="0.0.0.0:9090",
+        metrics_secure=False,
+    )
+    assert m._shared_addr
+
+
+def test_same_port_different_hosts_is_refused():
+    """Merging '127.0.0.1:P' onto '0.0.0.0:P' would silently widen (or
+    narrow) an endpoint's exposure — refused, secure or not."""
+    with pytest.raises(ValueError, match="different hosts"):
+        make_manager(
+            metrics_bind_address="127.0.0.1:9090",
+            health_probe_bind_address="0.0.0.0:9090",
+            metrics_secure=False,
+        )
+
+
+@pytest.mark.asyncio
 async def test_metrics_auth_fails_closed_on_unreadable_token_file():
     """--metrics-auth-token-file pointing at a missing file (Secret not
     mounted) must DENY, not silently serve unauthenticated."""
@@ -188,6 +241,28 @@ def test_half_supplied_cert_pair_is_refused(tmp_path):
 
     with pytest.raises(ValueError, match="BOTH"):
         server_ssl_context(cert_file=str(tmp_path / "only.crt"))
+
+
+def test_unusable_cert_is_a_construction_time_usage_error(tmp_path):
+    """Missing or malformed PEM files fail at Manager construction (as
+    ConfigurationError → clean CLI exit), not at bind time after
+    manifests were applied."""
+    with pytest.raises(ValueError, match="certificate unusable"):
+        make_manager(
+            metrics_bind_address="127.0.0.1:9443",
+            metrics_secure=True,
+            metrics_cert_file=str(tmp_path / "missing.crt"),
+            metrics_key_file=str(tmp_path / "missing.key"),
+        )
+    bad = tmp_path / "bad.pem"
+    bad.write_text("not a pem")
+    with pytest.raises(ValueError, match="certificate unusable"):
+        make_manager(
+            metrics_bind_address="127.0.0.1:9443",
+            metrics_secure=True,
+            metrics_cert_file=str(bad),
+            metrics_key_file=str(bad),
+        )
 
 
 @pytest.mark.asyncio

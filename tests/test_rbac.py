@@ -75,9 +75,14 @@ async def test_cluster_level_creates_sa_role_binding(prov, backend):
     binding = backend.objects[("ClusterRoleBinding", "", "check-sa-cluster-role-binding")]
     assert binding.role_ref == "check-sa-cluster-role"
     assert binding.subject == "health/check-sa"
-    # read-only verbs (reference: :85-101)
+    # read-only verbs (reference: :85-101) — except the Argo 3.4+
+    # executor-reporting grant, which is write-scoped to exactly
+    # workflowtaskresults (divergence #9, docs/design.md)
     for rule in role.rules:
-        assert set(rule.verbs) == {"get", "list", "watch"}
+        if rule.resources == ["workflowtaskresults"]:
+            assert set(rule.verbs) == {"create", "patch"}
+        else:
+            assert set(rule.verbs) == {"get", "list", "watch"}
 
 
 @pytest.mark.asyncio
