@@ -9,17 +9,53 @@ multi-device checks on one chip) degrade the way they do individually.
 
 from __future__ import annotations
 
+import logging
+import os
 import sys
 from typing import List, Optional, Tuple
 
 from activemonitor_tpu.probes.base import ProbeResult
 
+log = logging.getLogger("activemonitor.probes")
+
+
+def enable_persistent_compile_cache(directory: str = "") -> Optional[str]:
+    """Point XLA's persistent compilation cache at a stable directory so
+    repeated battery runs (the steady state of a periodic HealthCheck)
+    skip recompilation — the dominant cost of a cold `probes all` run on
+    TPU. Override with $ACTIVEMONITOR_COMPILE_CACHE; returns the
+    directory, or None if the cache could not be enabled."""
+    import jax
+
+    directory = (
+        directory
+        or os.environ.get("ACTIVEMONITOR_COMPILE_CACHE")
+        or os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "activemonitor-tpu",
+            "xla-cache",
+        )
+    )
+    try:
+        os.makedirs(directory, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", directory)
+        # cache even fast compiles: the battery compiles dozens of small
+        # programs and their sum is what the cadence pays
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        return directory
+    except Exception as e:
+        log.warning("persistent compile cache unavailable (%s)", e)
+        return None
+
 
 def run(
     quick: bool = False,
     skip: Optional[List[str]] = None,
+    compile_cache: bool = True,
 ) -> ProbeResult:
     skip = set(skip or [])
+    if compile_cache:
+        enable_persistent_compile_cache()
     results: List[Tuple[str, ProbeResult]] = []
 
     def add(name: str, fn) -> None:

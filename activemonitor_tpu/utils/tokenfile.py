@@ -35,6 +35,10 @@ class FileToken:
         ttl: float = DEFAULT_TTL,
         on_error: str = "keep",
     ):
+        if on_error not in ("keep", "clear"):
+            # a typo silently meaning fail-open would defeat the very
+            # policy this parameter selects
+            raise ValueError(f"on_error must be 'keep' or 'clear', got {on_error!r}")
         self.path = path
         self._value = initial
         self._ttl = ttl

@@ -204,6 +204,38 @@ def test_pallas_stream_rejects_ragged_blocks():
         stream_scale_pallas(x, 2.0, block_rows=512)
 
 
+def test_pallas_stream_double_buffered_matches_xla():
+    """The hand-scheduled DMA pipeline must be bit-identical to the
+    reference expression, including the single-chunk edge (no second
+    slot in flight) and multi-chunk drains."""
+    from activemonitor_tpu.ops.stream import stream_scale_pallas_db
+
+    for rows in (512, 1024, 2048):  # 1, 2 and 4 chunks
+        x = jax.random.normal(jax.random.key(rows), (rows, 1024), jnp.float32)
+        got = stream_scale_pallas_db(x, 1.5, block_rows=512)
+        want = stream_scale_xla(x, 1.5)
+        assert jnp.allclose(got, want), rows
+    with pytest.raises(ValueError):
+        stream_scale_pallas_db(jnp.ones((1000, 1024), jnp.float32), 2.0)
+
+
+def test_suite_compile_cache_configured(tmp_path, monkeypatch):
+    from activemonitor_tpu.probes.suite import enable_persistent_compile_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    cache = tmp_path / "xla-cache"
+    monkeypatch.setenv("ACTIVEMONITOR_COMPILE_CACHE", str(cache))
+    try:
+        assert enable_persistent_compile_cache() == str(cache)
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        # global jax.config state must not leak into later tests
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
 # -- CLI ---------------------------------------------------------------
 
 
