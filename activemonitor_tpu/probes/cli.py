@@ -71,7 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-pallas", action="store_true")
 
     p = sub.add_parser("matmul", help="MXU matmul throughput check")
-    p.add_argument("--dim", type=int, default=8192)
+    p.add_argument(
+        "--dim",
+        type=int,
+        default=None,
+        help="single dimension (default: sweep 4096/8192 and report best)",
+    )
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--threshold", type=float, default=0.75)
 

@@ -84,7 +84,9 @@ def run(
     add("devices", lambda: devices.run())
     add("memory", lambda: memory.run(probe_gb=0.5 if quick else 1.0))
     add("compile-smoke", lambda: compile_smoke.run(tiny=quick))
-    add("matmul", lambda: matmul.run(dim=4096 if quick else 8192, iters=iters))
+    # quick mode pins the cheap dim; full mode uses the default sweep so
+    # the battery reports the same max-over-dims signal as `probes matmul`
+    add("matmul", lambda: matmul.run(dim=4096 if quick else None, iters=iters))
     add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
     add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
     add(
