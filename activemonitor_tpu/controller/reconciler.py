@@ -75,6 +75,7 @@ from activemonitor_tpu.scheduler import (
     InverseExpBackoff,
     TimerWheel,
     compute_backoff_params,
+    parse_cron,
     seconds_until_next,
 )
 from activemonitor_tpu.utils.clock import Clock
@@ -171,18 +172,48 @@ class HealthCheckReconciler:
                 self.recorder.event(hc, EVENT_WARNING, "Warning", "Fail to parse cron")
                 log.error("fail to parse cron for %s: %s", hc.key, e)
                 raise
-        # dedupe (reference: :264-267): already ran within the interval
-        # and a timer is known for this check ⇒ the schedule is healthy.
+        # dedupe (reference: :264-267): the schedule is current (no run
+        # is owed yet) and a timer is known for this check ⇒ healthy.
         # Divergence 4: unlike the reference (where this guard is an
         # `else if` that cron specs never reach, so each status-write
         # event resubmits immediately — continuous churn), the guard
-        # applies to cron checks too, using the computed interval.
-        if self._recently_finished(hc) and self.timers.exists(hc.key):
+        # applies to cron checks too — "current" for a cron spec means
+        # no fire has passed since the last finish (comparing elapsed
+        # against the delta-to-NEXT-fire is wrong for absolute schedules
+        # reconciled late in a period).
+        remaining = self._schedule_remaining(hc)
+        # nothing owed yet AND a live (unfired) timer ⇒ the schedule is
+        # healthy; let the timer drive the next run. Time-bounding the
+        # guard matters: a fired-but-bailed timer entry must not wedge
+        # the check forever, and a spec edited to a faster cadence must
+        # not wait out the old timer.
+        if remaining is not None and self.timers.pending(hc.key):
             return None
         # a watch for this check is still in flight (workflow running
         # longer than the interval): don't stack a duplicate run
         if self._watch_active(hc.key):
             return None
+        # Divergence 10: true resume after a controller restart. The
+        # reference's dedupe needs its process-local timer, so a restart
+        # resubmits EVERY recent check at once (a restart storm). Here a
+        # current-schedule check with no live timer — the boot-resync
+        # state, or a cadence shrunk by a spec edit — (re)builds its
+        # timer from durable status for the remaining time to the owed
+        # fire. Overdue checks (a fire passed while down) fall through
+        # and run immediately.
+        if remaining is not None:
+            self.timers.schedule(hc.key, remaining, self._resubmit_callback(hc))
+            self.recorder.event(
+                hc,
+                EVENT_NORMAL,
+                "Normal",
+                "Schedule resumed from durable status for the remaining interval",
+            )
+            return None
+        # a run is owed NOW: cancel any still-pending timer first (the
+        # sub-second rounding sliver, or a stale long timer after a spec
+        # edit) so it cannot double-fire behind this submission
+        self.timers.stop(hc.key)
 
         # per-run RBAC (reference: :269)
         await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_HEALTHCHECK)
@@ -191,11 +222,30 @@ class HealthCheckReconciler:
         self._spawn_watch(hc, wf_name)
         return None
 
-    def _recently_finished(self, hc: HealthCheck) -> bool:
+    def _schedule_remaining(self, hc: HealthCheck) -> Optional[float]:
+        """Seconds until the NEXT owed fire, judged purely from durable
+        status — or None when a run is owed right now (never ran, or a
+        fire/interval passed since finished_at, e.g. while the
+        controller was down). One definition serves both the dedupe
+        guard (remaining is not None ⇒ nothing owed yet) and the
+        restart-resume timer (anchored at finished_at, so downtime
+        neither double-runs nor stretches the cadence)."""
         if hc.status.finished_at is None:
-            return False
-        elapsed = (self.clock.now() - hc.status.finished_at).total_seconds()
-        return elapsed < hc.spec.repeat_after_sec
+            return None  # never ran: owed now
+        now = self.clock.now()
+        if hc.spec.schedule.cron:
+            try:
+                schedule = parse_cron(hc.spec.schedule.cron)
+                next_after_finish = schedule.next(hc.status.finished_at)
+            except CronParseError:
+                return None  # unparseable: let the normal path complain
+            if next_after_finish <= now:
+                return None  # a fire passed since the last finish: owed
+            return max(1.0, (next_after_finish - now).total_seconds())
+        elapsed = (now - hc.status.finished_at).total_seconds()
+        if elapsed >= hc.spec.repeat_after_sec:
+            return None  # interval elapsed: owed
+        return max(1.0, hc.spec.repeat_after_sec - elapsed)
 
     # ------------------------------------------------------------------
     # submit (reference: createSubmitWorkflow, :502-534)
@@ -388,8 +438,29 @@ class HealthCheckReconciler:
         namespace, name = prev_hc.metadata.namespace, prev_hc.metadata.name
 
         async def resubmit() -> None:
+            # atomically (no awaits) check-and-claim the in-flight slot:
+            # registering BEFORE the first await means a concurrent
+            # reconcile sees _watch_active and cannot cancel this timer
+            # task mid-submit (which would orphan a created workflow)
+            current = asyncio.current_task()
+            existing = self._watch_tasks.get(f"{namespace}/{name}")
+            if existing is not None and not existing.done() and existing is not current:
+                # a run is still in flight (it will reschedule on its
+                # own completion) — never stack a duplicate
+                return
+            if current is not None:
+                self._watch_tasks[f"{namespace}/{name}"] = current
+
             hc = await self.client.get(namespace, name)
             if hc is None:
+                return
+            # the spec may have changed since this timer was armed: if
+            # nothing is owed under the CURRENT spec (cadence slowed, or
+            # a sub-second rounding sliver), re-arm for the remaining
+            # time instead of firing early
+            remaining = self._schedule_remaining(hc)
+            if remaining is not None:
+                self.timers.schedule(hc.key, remaining, self._resubmit_callback(hc))
                 return
             # keep the effective interval for timeout/backoff derivation
             if hc.spec.repeat_after_sec <= 0 and hc.spec.schedule.cron:
@@ -412,11 +483,9 @@ class HealthCheckReconciler:
                     hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
                 )
                 return
-            # register the timer task as this check's watch so reconcile's
-            # in-flight guard and wait_watches() see timer-driven runs too
-            current = asyncio.current_task()
-            if current is not None:
-                self._watch_tasks[hc.key] = current
+            # already registered in _watch_tasks at the top, so
+            # reconcile's in-flight guard and wait_watches() saw this
+            # timer-driven run from before the submit
             await self._watch_guarded(hc, wf_name)
 
         return resubmit

@@ -14,6 +14,7 @@ numbers kept in the details.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
@@ -22,6 +23,8 @@ import jax.numpy as jnp
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+log = logging.getLogger("activemonitor.probes")
 
 
 def _measure(dim: int, iters: int) -> float:
@@ -54,9 +57,20 @@ def run(
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
     if dim is not None:
-        dims = (dim,)  # explicit dim: no sweep (CLI --dim), any platform
-    elif not on_tpu:
-        dims = (1024,)  # keep CPU runs quick; no rated comparison there
+        dims = (dim,)  # explicit dim: no sweep (CLI --dim)
+    requested_dims = tuple(sorted(set(dims)))
+    dims = requested_dims
+    if not on_tpu:
+        # any large dim is downsized off-TPU (a 4096 bf16 chain takes
+        # minutes on CPU and there is no rated comparison there) —
+        # loudly, and recorded in the details below, so numbers are
+        # never silently compared across the clamp
+        dims = tuple(sorted({1024 if d > 2048 else d for d in requested_dims}))
+        if dims != requested_dims:
+            log.warning(
+                "matmul dims %s downsized to %s off-TPU; numbers are NOT "
+                "comparable to a TPU run", requested_dims, dims,
+            )
 
     per_dim = {d: _measure(d, iters) for d in dims}
     dim, tflops = max(per_dim.items(), key=lambda kv: kv[1])
@@ -72,6 +86,8 @@ def run(
         "seconds_per_op": seconds,
         "device_kind": device.device_kind,
     }
+    if tuple(dims) != requested_dims:
+        details["requested_dims"] = list(requested_dims)  # downsized off-TPU
     ok = True
     if rated is not None and on_tpu:
         fraction = tflops / rated.bf16_tflops

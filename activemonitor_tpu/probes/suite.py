@@ -84,9 +84,14 @@ def run(
     add("devices", lambda: devices.run())
     add("memory", lambda: memory.run(probe_gb=0.5 if quick else 1.0))
     add("compile-smoke", lambda: compile_smoke.run(tiny=quick))
-    # quick mode pins the cheap dim; full mode uses the default sweep so
-    # the battery reports the same max-over-dims signal as `probes matmul`
-    add("matmul", lambda: matmul.run(dim=4096 if quick else None, iters=iters))
+    # quick mode narrows the sweep to the cheap dim; full mode uses the
+    # probe's own default sweep (single source of truth) so the battery
+    # reports the same max-over-dims signal as `probes matmul`. The
+    # probe itself owns the off-TPU downsizing.
+    if quick:
+        add("matmul", lambda: matmul.run(dims=(4096,), iters=iters))
+    else:
+        add("matmul", lambda: matmul.run(iters=iters))
     add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
     add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
     add(

@@ -7,10 +7,11 @@ is an asyncio task owned by the wheel — single-owner state on one event
 loop, so no lock is needed (SURVEY.md §5.2's discipline: scheduler state
 in a single-owner task instead of a shared map).
 
-Semantics preserved for the reconciler's dedupe logic
-(reference: healthcheck_controller.go:264-267): entries stay in the map
-after firing, so ``exists(name)`` means "this check has been scheduled
-at least once", not "a run is pending".
+Entries stay in the map after firing, so ``exists(name)`` means "this
+check has been scheduled at least once", not "a run is pending". The
+reconciler's dedupe deliberately uses ``pending(name)`` (a live, unfired
+timer): trusting a fired-but-bailed entry would wedge a check's schedule
+forever. ``exists`` remains for delete-time bookkeeping and tests.
 """
 
 from __future__ import annotations

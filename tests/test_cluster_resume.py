@@ -1,0 +1,198 @@
+"""Cluster-mode checkpoint/resume and load behavior.
+
+The reference's durability model (SURVEY.md §5.4): the CR status
+subresource is the only durable state; on restart the controller
+re-lists, rebuilds its in-memory schedule idempotently, and the
+FinishedAt dedupe prevents double-running recent checks. Here that
+contract is exercised across a REAL controller restart against the
+stub API server — the data outlives the manager because it lives in
+the (stub) apiserver, exactly like etcd.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import RBACProvisioner
+from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+from activemonitor_tpu.controller.events import KubernetesEventRecorder
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.controller.rbac import KubernetesRBACBackend
+from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+from activemonitor_tpu.engine.argo import WF_GROUP, WF_PLURAL, WF_VERSION, ArgoWorkflowEngine
+from activemonitor_tpu.kube import api_path
+from activemonitor_tpu.metrics import MetricsCollector
+
+from tests.kube_harness import stub_env
+
+WF_INLINE = """
+apiVersion: argoproj.io/v1alpha1
+kind: Workflow
+spec:
+  entrypoint: main
+"""
+
+
+def make_hc(name, repeat=3600):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": repeat,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": f"{name}-",
+                    "workflowtimeout": 5,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": f"{name}-sa",
+                        "source": {"inline": WF_INLINE},
+                    },
+                },
+            },
+        }
+    )
+
+
+def build_controller(api):
+    client = KubernetesHealthCheckClient(api)
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=ArgoWorkflowEngine(api),
+        rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+        recorder=KubernetesEventRecorder(api),
+        metrics=MetricsCollector(),
+    )
+    return client, Manager(client=client, reconciler=reconciler, max_parallel=4)
+
+
+async def wait_for(predicate, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        result = await predicate()
+        if result:
+            return result
+        assert asyncio.get_event_loop().time() < deadline, "condition not met"
+        await asyncio.sleep(0.05)
+
+
+async def complete_workflows(server, api):
+    """Play the Argo controller: succeed every pending workflow."""
+    for wf in server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+        if (wf.get("status") or {}).get("phase") not in ("Succeeded", "Failed"):
+            await api.merge_patch(
+                api_path(
+                    WF_GROUP, WF_VERSION, WF_PLURAL,
+                    wf["metadata"]["namespace"], wf["metadata"]["name"], "status",
+                ),
+                {"status": {"phase": "Succeeded"}},
+            )
+
+
+@pytest.mark.asyncio
+async def test_restart_resumes_without_double_running_recent_checks():
+    async with stub_env() as (server, api):
+        client, manager = build_controller(api)
+        await manager.start()
+        try:
+            await client.apply(make_hc("resume-hc"))
+            await wait_for(
+                lambda: asyncio.sleep(0, server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+            )
+            await complete_workflows(server, api)
+
+            async def succeeded():
+                hc = await client.get("health", "resume-hc")
+                return hc if hc and hc.status.status == "Succeeded" else None
+
+            await wait_for(succeeded)
+        finally:
+            await manager.stop()
+        runs_before = len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+        assert runs_before == 1
+
+        # controller restart: fresh manager + reconciler, SAME apiserver.
+        # boot resync re-lists and reconciles, and the FinishedAt dedupe
+        # must not resubmit a check that just ran (reference :264-267)
+        client2, manager2 = build_controller(api)
+        await manager2.start()
+        try:
+            await asyncio.sleep(0.5)  # boot resync + any reconciles settle
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == runs_before
+            hc = await client2.get("health", "resume-hc")
+            assert hc.status.success_count == 1  # status survived the restart
+            # and the schedule was rebuilt: the timer exists again
+            assert manager2.reconciler.timers.exists("health/resume-hc")
+        finally:
+            await manager2.stop()
+
+
+@pytest.mark.asyncio
+async def test_restart_reruns_overdue_checks():
+    """A check whose FinishedAt is older than its interval must run
+    again right after restart (resume means resume, not amnesia)."""
+    async with stub_env() as (server, api):
+        client, manager = build_controller(api)
+        await manager.start()
+        try:
+            await client.apply(make_hc("overdue-hc", repeat=1))
+            await wait_for(
+                lambda: asyncio.sleep(0, server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+            )
+            await complete_workflows(server, api)
+
+            async def succeeded():
+                hc = await client.get("health", "overdue-hc")
+                return hc if hc and hc.status.success_count >= 1 else None
+
+            await wait_for(succeeded)
+        finally:
+            await manager.stop()
+
+        await asyncio.sleep(1.1)  # the 1s interval elapses while "down"
+        client2, manager2 = build_controller(api)
+        await manager2.start()
+        try:
+            await wait_for(
+                lambda: asyncio.sleep(
+                    0,
+                    len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) >= 2 or None,
+                )
+            )
+        finally:
+            await manager2.stop()
+
+
+@pytest.mark.asyncio
+async def test_cluster_mode_check_storm():
+    """Load: a fleet of checks applied at once against the stub
+    apiserver; every one must run, succeed, and carry real RBAC —
+    the cluster-tier version of tests/test_stress.py."""
+    N = 20
+    async with stub_env() as (server, api):
+        client, manager = build_controller(api)
+        await manager.start()
+        try:
+            for i in range(N):
+                await client.apply(make_hc(f"storm-{i:02d}"))
+
+            async def all_submitted():
+                return len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) >= N or None
+
+            await wait_for(all_submitted, timeout=20)
+            await complete_workflows(server, api)
+
+            async def all_succeeded():
+                checks = await client.list()
+                done = [hc for hc in checks if hc.status.status == "Succeeded"]
+                return len(done) == N or None
+
+            await wait_for(all_succeeded, timeout=20)
+            # every check got its own real ServiceAccount
+            sas = {
+                o["metadata"]["name"] for o in server.objs("", "v1", "serviceaccounts")
+            }
+            assert {f"storm-{i:02d}-sa" for i in range(N)} <= sas
+        finally:
+            await manager.stop()

@@ -426,8 +426,31 @@ async def test_checkpoint_resume_from_status():
     await h.settle()
     assert (await h.status()).success_count == 1
 
-    # "restart": new reconciler, same client state, fresh timers
-    r2 = HealthCheckReconciler(
+    # "restart": the old process dies (its timers with it), new
+    # reconciler over the same durable client state
+    await h.reconciler.shutdown()
+    r2 = make_restarted_reconciler(h)
+    # boot-time reconcile: finished recently, no timer -> divergence 10:
+    # the schedule is REBUILT for the remaining interval instead of
+    # re-running immediately (the reference resubmits everything on
+    # restart — a restart storm)
+    await r2.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert (await h.status()).success_count == 1  # no double-run
+    assert r2.timers.exists(created.key)
+    # subsequent reconciles stay deduped
+    await r2.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert (await h.status()).success_count == 1
+    # ...and the rebuilt timer fires at the original cadence
+    await h.clock.advance(61)
+    await r2.wait_watches()
+    assert (await h.status()).success_count == 2
+    await r2.shutdown()
+
+
+def make_restarted_reconciler(h):
+    return HealthCheckReconciler(
         client=h.client,
         engine=h.engine,
         rbac=RBACProvisioner(h.backend),
@@ -435,18 +458,124 @@ async def test_checkpoint_resume_from_status():
         metrics=h.metrics,
         clock=h.clock,
     )
-    # boot-time reconcile: no timer exists yet, finished recently -> the
-    # reference would resubmit (timer map lost on restart); ours does too
-    # since exists() is False -> submits. This matches reference restart
-    # semantics (resubmit once, then dedupe).
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_resume_cron_keeps_anchored_cadence():
+    """Cron resume: the rebuilt timer is anchored at the fire owed when
+    the process died (finished_at + period), so downtime neither fires
+    early (double-counting elapsed) nor stretches the cadence."""
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=0, cron="@every 60s", timeout=5))
+    await h.settle()
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 1
+
+    await h.clock.advance(20)  # controller "down" for 20s
+    await h.reconciler.shutdown()
+    r2 = make_restarted_reconciler(h)
+    await r2.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert (await h.status()).success_count == 1  # no immediate re-run
+    # anchored fire at finished+60 = restart+40: not at +35...
+    await h.clock.advance(35)
+    await r2.wait_watches()
+    assert (await h.status()).success_count == 1
+    # ...but by +45
+    await h.clock.advance(10)
+    await r2.wait_watches()
+    assert (await h.status()).success_count == 2
+    await r2.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_resume_absolute_cron_late_in_period():
+    """Absolute cron restarted LATE in its period (elapsed > time to the
+    next fire): still current — no spurious boot run, and the timer
+    lands on the real next fire. (Comparing elapsed against the next-
+    fire delta would wrongly call this overdue.)"""
+    h = Harness(succeed_after(1))
+    # FakeClock epoch is midnight: hourly fires at :00
+    created = await h.apply_and_reconcile(make_hc(repeat=0, cron="0 * * * *", timeout=5))
+    await h.settle()
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 1  # first run at apply
+
+    await h.clock.advance(2400)  # restart at :40 — no fire missed
+    await h.reconciler.shutdown()
+    r2 = make_restarted_reconciler(h)
+    await r2.reconcile(created.namespace, created.name)
+    await h.settle()
+    assert (await h.status()).success_count == 1  # NO spurious re-run
+    assert r2.timers.exists(created.key)
+    await h.clock.advance(1300)  # past the 01:00 fire
+    await r2.wait_watches()
+    assert (await h.status()).success_count == 2
+    await r2.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_spec_edit_to_slower_cadence_rearms_instead_of_firing():
+    """A spec edited to a slower cadence must not run at the old faster
+    cadence: the already-armed timer re-checks the CURRENT spec at fire
+    time and re-arms for the remaining interval."""
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=60))
+    await h.settle()
+    assert (await h.status()).success_count == 1
+
+    slow = make_hc(repeat=3600)
+    await h.client.apply(slow)
+    await h.reconciler.reconcile(created.namespace, created.name)
+    await h.settle()
+
+    # the old 60s timer fires, sees nothing owed under the new spec,
+    # and re-arms — no run
+    await h.clock.advance(100)
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 1
+    # the new cadence is honored (next run at finished+3600)
+    await h.clock.advance(3600)
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 2
+
+
+@pytest.mark.asyncio
+async def test_spec_edit_to_faster_cadence_takes_effect():
+    """The opposite direction: shrinking the cadence must not wait out
+    the old long timer."""
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=3600))
+    await h.settle()
+    assert (await h.status()).success_count == 1
+
+    fast = make_hc(repeat=30)
+    await h.client.apply(fast)
+    await h.clock.advance(31)  # old timer far away; new cadence owed
+    await h.reconciler.reconcile(created.namespace, created.name)
+    await h.settle()
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 2
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_resume_runs_missed_cron_fire_immediately():
+    """A cron fire missed during downtime must run at boot — skipping it
+    would leave a daily check silent for a full extra period."""
+    h = Harness(succeed_after(1))
+    created = await h.apply_and_reconcile(make_hc(repeat=0, cron="@every 60s", timeout=5))
+    await h.settle()
+    await h.reconciler.wait_watches()
+    assert (await h.status()).success_count == 1
+
+    await h.clock.advance(90)  # down PAST the next fire (finished+60)
+    await h.reconciler.shutdown()
+    r2 = make_restarted_reconciler(h)
     await r2.reconcile(created.namespace, created.name)
     await h.settle()
     await r2.wait_watches()
-    assert (await h.status()).success_count == 2
-    # subsequent reconciles dedupe
-    await r2.reconcile(created.namespace, created.name)
-    await h.settle()
-    assert (await h.status()).success_count == 2
+    assert (await h.status()).success_count == 2  # missed fire ran at boot
+    await r2.shutdown()
 
 
 # -- review-finding regressions ---------------------------------------
