@@ -7,8 +7,13 @@ scatter updates, and per-token dispatch stress entirely different parts
 of the stack than big batched matmuls.
 
 Exports per-token latency and decoded tokens/s; the correctness gate is
-greedy-decode consistency: the same prompt must reproduce the same
-continuation as the batched forward pass (cache vs no-cache agreement).
+cache consistency: teacher-forcing the batched (no-cache) forward on
+the cached greedy continuation must reproduce the cached path's logits
+within numeric tolerance. Exact token equality is deliberately NOT the
+gate — on TPU the two paths lower to differently-shaped matmuls whose
+accumulation orders differ, so near-tie argmax flips are expected and
+benign; a broken cache shows up as large logit divergence, not a tie
+flip. Token agreement is still exported as an informational metric.
 """
 
 from __future__ import annotations
@@ -51,8 +56,8 @@ def run(
 
     step = jax.jit(lambda p, c, t, pos: decode_step(p, c, t, pos, cfg))
 
-    # correctness: greedy continuation via the cache must match the
-    # batched forward pass run over the growing sequence
+    # correctness: decode greedily via the cache, then teacher-force the
+    # batched forward on the SAME tokens and compare logits per position
     cache = init_kv_cache(cfg, batch, max_seq)
     # prefill token-by-token (simple and exercises the cache path)
     for i in range(prompt_len):
@@ -60,21 +65,45 @@ def run(
     # the cache has room for max_seq - prompt_len generated positions
     n_check = min(4, max_seq - prompt_len - 1)
     cached_tokens = []
+    cached_logits = [logits]  # prediction for position prompt_len
     token = jnp.argmax(logits, axis=-1)
     for i in range(n_check):
         cached_tokens.append(token)
         logits, cache = step(
             params, cache, token, jnp.asarray(prompt_len + i)
         )
+        cached_logits.append(logits)
         token = jnp.argmax(logits, axis=-1)
 
-    full = prompt
-    for i in range(n_check):
-        logits_full = forward(params, full, cfg)[:, -1]
-        full = jnp.concatenate(
-            [full, jnp.argmax(logits_full, axis=-1)[:, None]], axis=1
+    # one batched pass over prompt + cached continuation: position
+    # (prompt_len - 1 + i) predicts the i-th checked step. One
+    # vectorized on-device comparison, one scalar readback (host syncs
+    # cost ~70 ms each through a tunneled device).
+    cached_tokens_arr = jnp.stack(cached_tokens, 1)  # [batch, n_check]
+    seq = jnp.concatenate([prompt, cached_tokens_arr], axis=1)
+    full_logits = forward(params, seq, cfg)
+    lc_all = jnp.stack(cached_logits, 1)  # [batch, n_check+1, vocab]
+    lf_all = full_logits[:, prompt_len - 1 : prompt_len + n_check]
+    scale = jnp.maximum(jnp.max(jnp.abs(lf_all)), 1e-6)
+    full_tokens = jnp.argmax(lf_all[:, :n_check], axis=-1)
+    max_rel_diff, token_agreement = (
+        float(v)
+        for v in jax.device_get(
+            jnp.stack(
+                [
+                    jnp.max(jnp.abs(lf_all - lc_all)) / scale,
+                    jnp.mean((full_tokens == cached_tokens_arr).astype(jnp.float32)),
+                ]
+            )
         )
-    consistent = bool(jnp.array_equal(full[:, prompt_len:], jnp.stack(cached_tokens, 1)))
+    )
+    # bf16-decomposed f32 matmuls on TPU differ up to ~1e-2 relative
+    # between shapes (observed 7.5e-3 on v5e, 8.6e-3 on CPU tiny); a
+    # broken cache (stale/shifted K/V) reads O(1) — orders above this.
+    # NaN anywhere makes max_rel_diff NaN, and NaN <= x is False, so
+    # broken-device NaN logits FAIL the gate rather than slipping by.
+    # token_agreement is informational: how often argmax agreed anyway.
+    consistent = max_rel_diff <= 0.05
 
     # throughput: a lax.scan of decode steps (token feeds the next step;
     # one traced step, so long chains compile as fast as short ones).
@@ -120,14 +149,22 @@ def run(
         ProbeMetric(
             "decode-consistency",
             1.0 if consistent else 0.0,
-            help="1 when cached greedy decode matches the batched forward",
+            help="1 when cached logits match the teacher-forced batched "
+            "forward within tolerance",
+        ),
+        ProbeMetric(
+            "decode-token-agreement",
+            token_agreement,
+            help="Fraction of greedy tokens agreeing across paths "
+            "(informational: near-tie argmax flips are benign)",
         ),
     ]
     return ProbeResult(
         ok=consistent,
         summary=(
             f"decode {seconds * 1e3:.2f}ms/token, {tokens_per_second:,.0f} tok/s, "
-            f"cache consistency {'OK' if consistent else 'MISMATCH'}"
+            f"cache consistency {'OK' if consistent else 'MISMATCH'} "
+            f"(max rel logit diff {max_rel_diff:.1e})"
         ),
         metrics=metrics,
         details={
@@ -135,5 +172,7 @@ def run(
             "prompt_len": prompt_len,
             "max_seq": max_seq,
             "seconds_per_token": seconds,
+            "max_rel_logit_diff": max_rel_diff,
+            "token_agreement": token_agreement,
         },
     )
