@@ -140,6 +140,82 @@ def all_gather_bandwidth(
     )
 
 
+def reduce_scatter_bandwidth(
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    axis: str = "",
+) -> CollectiveResult:
+    """Chained psum-scatter; each round reduce-scatters the shard then
+    tiles the result back to shard shape (a local copy that keeps rounds
+    data-dependent and shape-stable — its HBM cost is included, so this
+    slightly understates pure comm bw, mirroring all_gather above)."""
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    rows, cols, shard_bytes = _payload(size_mb, dtype)
+    # rows must divide by n so the scattered shard keeps a static shape
+    rows = max(n, rows - rows % n)
+    shard_bytes = rows * cols * jnp.dtype(dtype).itemsize
+    inv_n = jnp.asarray(1.0 / n, dtype)
+
+    def body(x):
+        s = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+        return jnp.concatenate([s] * n, axis=0) * inv_n
+
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(
+        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
+    )
+    algbw = shard_bytes / seconds / 1e9
+    busbw = algbw * ((n - 1) / n) if n > 1 else algbw
+    return CollectiveResult(
+        name="reduce_scatter",
+        payload_bytes=shard_bytes,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=busbw,
+    )
+
+
+def all_to_all_bandwidth(
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    axis: str = "",
+) -> CollectiveResult:
+    """Chained tiled all-to-all (the expert-parallel dispatch pattern,
+    ops/moe.py) — shape-preserving, so the chain is pure communication;
+    each round every device exchanges (n-1)/n of its shard."""
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    rows, cols, shard_bytes = _payload(size_mb, dtype)
+    rows = max(n, rows - rows % n)
+    shard_bytes = rows * cols * jnp.dtype(dtype).itemsize
+
+    def body(x):
+        return jax.lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True
+        )
+
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(
+        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
+    )
+    algbw = shard_bytes / seconds / 1e9
+    busbw = algbw * ((n - 1) / n) if n > 1 else algbw
+    return CollectiveResult(
+        name="all_to_all",
+        payload_bytes=shard_bytes,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=busbw,
+    )
+
+
 def ppermute_ring_bandwidth(
     mesh: Mesh,
     size_mb: float = 64.0,

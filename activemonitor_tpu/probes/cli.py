@@ -52,6 +52,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--threshold", type=float, default=0.9)
     p.add_argument("--no-ring", action="store_true")
 
+    p = sub.add_parser(
+        "collectives",
+        help="full collective sweep: all-reduce/-gather, reduce-scatter, "
+        "all-to-all, ring hop",
+    )
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--threshold", type=float, default=0.8)
+
     p = sub.add_parser("compile-smoke", help="XLA compile smoke test")
     p.add_argument("--deadline", type=float, default=120.0)
     p.add_argument("--batch", type=int, default=4)
@@ -156,6 +165,12 @@ def _dispatch(args) -> int:
             iters=args.iters,
             threshold=args.threshold,
             include_ring=not args.no_ring,
+        )
+    elif args.probe == "collectives":
+        from activemonitor_tpu.probes import collectives
+
+        result = collectives.run(
+            size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
         )
     elif args.probe == "compile-smoke":
         from activemonitor_tpu.probes import compile_smoke

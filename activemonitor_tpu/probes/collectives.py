@@ -1,0 +1,138 @@
+"""Collectives-sweep probe — the full XLA collective set over ICI.
+
+The ici-allreduce probe answers the north-star question; this probe
+characterizes the whole communication surface the parallelism code
+relies on: all-reduce (dp gradient sync), all-gather (tp/weight
+gather), reduce-scatter (ZeRO/psum_scatter), all-to-all (ep dispatch,
+ops/moe.py) and single-hop ppermute (ring attention, ops/ring_attention
+.py; pipeline, ops/pipeline.py). A degradation only one pattern hits —
+e.g. a routing fault that halves the bisection but leaves neighbor
+links intact — shows up here before it shows up as slow training.
+
+Exports, per collective C in {allreduce, allgather, reducescatter,
+alltoall, ringhop} (prefix ``collective-``, distinct from the
+north-star probe's ``ici-`` gauges so a merged battery contract never
+carries duplicate names):
+
+- ``collective-<C>-busbw-gbps`` — NCCL busbw convention
+- ``collective-<C>-fraction-of-rated`` — busbw / rated ceiling (TPU)
+
+Rated ceilings assume the same bidirectional-ring model as probes/ici:
+2 x unidir link bw for the ring collectives, 1 x for a single hop —
+except all-to-all, which is bisection-bound on a ring: each half
+exchanges n*S/4 bytes per direction across the cut's 2 links, capping
+busbw at 8*B*(n-1)/n^2.
+
+Verdict: every collective's fraction must clear ``threshold`` (rated
+hardware, >1 device); otherwise informational-pass, like the other
+bandwidth probes. No reference counterpart (the reference has no
+communication backend at all, SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from activemonitor_tpu.parallel.collectives import (
+    CollectiveResult,
+    all_gather_bandwidth,
+    all_reduce_bandwidth,
+    all_to_all_bandwidth,
+    ppermute_ring_bandwidth,
+    reduce_scatter_bandwidth,
+)
+from activemonitor_tpu.parallel.mesh import make_1d_mesh
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+
+ALL_CASES = ("allreduce", "allgather", "reducescatter", "alltoall", "ringhop")
+
+_BENCH: Dict[str, Callable] = {
+    "allreduce": all_reduce_bandwidth,
+    "allgather": all_gather_bandwidth,
+    "reducescatter": reduce_scatter_bandwidth,
+    "alltoall": all_to_all_bandwidth,
+    "ringhop": ppermute_ring_bandwidth,
+}
+
+
+def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
+    """Achievable-busbw ceiling on a bidirectional ring of n devices
+    with per-direction link bandwidth ``unidir_gbps`` (see module doc)."""
+    if name == "ringhop":
+        return unidir_gbps
+    if name == "alltoall":
+        return 8 * unidir_gbps * (n - 1) / n**2
+    return 2 * unidir_gbps
+
+
+def run(
+    size_mb: float = 64.0,
+    iters: int = 5,
+    threshold: float = 0.8,
+    cases: Optional[Sequence[str]] = None,
+) -> ProbeResult:
+    cases = tuple(cases) if cases else ALL_CASES
+    unknown = [c for c in cases if c not in _BENCH]
+    if unknown:
+        raise ValueError(f"unknown collectives {unknown}; pick from {ALL_CASES}")
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return ProbeResult(
+            ok=True,
+            summary=f"collectives sweep skipped: {n} device(s), nothing to move",
+            metrics=[],
+            details={"devices": n, "skipped": True},
+        )
+
+    mesh = make_1d_mesh()
+    results: List[Tuple[str, CollectiveResult]] = [
+        (name, _BENCH[name](mesh, size_mb=size_mb, iters=iters)) for name in cases
+    ]
+    rated = rated_for(devices[0].device_kind)
+    on_tpu = devices[0].platform == "tpu"
+
+    metrics: List[ProbeMetric] = []
+    details: Dict = {"devices": n, "device_kind": devices[0].device_kind}
+    fractions: Dict[str, float] = {}
+    for name, result in results:
+        metrics.append(
+            ProbeMetric(
+                f"collective-{name}-busbw-gbps",
+                result.busbw_gbps,
+                help=f"Measured {result.name} bus bandwidth (NCCL convention), GB/s",
+            )
+        )
+        details[f"{name}_busbw_gbps"] = round(result.busbw_gbps, 2)
+        if rated is not None and on_tpu:
+            rated_busbw = _rated_busbw(name, rated.ici_unidir_gbps, n)
+            fraction = result.busbw_gbps / rated_busbw
+            fractions[name] = fraction
+            metrics.append(
+                ProbeMetric(
+                    f"collective-{name}-fraction-of-rated",
+                    fraction,
+                    help=f"{result.name} busbw / achievable ring ceiling",
+                )
+            )
+            details[f"{name}_fraction_of_rated"] = round(fraction, 3)
+
+    if fractions:
+        worst = min(fractions, key=fractions.get)
+        ok = fractions[worst] >= threshold
+        summary = (
+            f"{len(results)} collectives over {n}x {rated.generation}: worst "
+            f"{worst} at {fractions[worst]:.0%} of rated"
+            + ("" if ok else f" (< {threshold:.0%} threshold)")
+        )
+    else:
+        ok = True
+        best = max(results, key=lambda nr: nr[1].busbw_gbps)
+        summary = (
+            f"{len(results)} collectives over {n} device(s): best {best[0]} "
+            f"{best[1].busbw_gbps:.1f} GB/s (no rated comparison)"
+        )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

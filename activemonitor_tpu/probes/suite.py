@@ -94,6 +94,18 @@ def run(
         add("matmul", lambda: matmul.run(iters=iters))
     add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
     add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
+    from activemonitor_tpu.probes import collectives as collectives_probe
+
+    # the ici probe already measured all-reduce and the ring hop; the
+    # sweep adds only the patterns it hasn't covered
+    add(
+        "collectives",
+        lambda: collectives_probe.run(
+            size_mb=16 if quick else 64,
+            iters=iters,
+            cases=("allgather", "reducescatter", "alltoall"),
+        ),
+    )
     add(
         "ring-attention",
         lambda: ring.run(seq_per_device=256 if quick else 1024, iters=iters),
