@@ -291,8 +291,22 @@ class HealthCheckReconciler:
             # see this (still-running) task and skip the retry
             if self._watch_tasks.get(hc.key) is asyncio.current_task():
                 del self._watch_tasks[hc.key]
-            await self.clock.sleep(1.0)
-            await self.reconcile(hc.metadata.namespace, hc.metadata.name)
+            # keep requeueing until a reconcile lands cleanly — a single
+            # shot would strand the schedule if the API-server outage
+            # outlives one retry (the reference's workqueue re-rate-
+            # limits indefinitely; deletion ends the loop via None)
+            delay: Optional[float] = 1.0
+            while delay:
+                await self.clock.sleep(delay)
+                try:
+                    delay = await self.reconcile(
+                        hc.metadata.namespace, hc.metadata.name
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("requeued reconcile of %s failed", hc.key)
+                    delay = 1.0
 
     async def wait_watches(self) -> None:
         """Test/shutdown helper: wait for all in-flight watches."""
@@ -407,12 +421,16 @@ class HealthCheckReconciler:
                 self.timers.stop(hc.key)
                 return
             except Exception:
+                # transient write failure (API-server blip outliving the
+                # conflict retries): raise so _watch_guarded requeues in
+                # 1s like the reference's reconcile error path (:204).
+                # Stopping the timer here instead would leave the check
+                # schedule dead until some external watch event arrived.
                 log.exception("error updating healthcheck resource %s", hc.key)
                 self.recorder.event(
                     hc, EVENT_WARNING, "Warning", "Error updating healthcheck resource"
                 )
-                self.timers.stop(hc.key)
-                return
+                raise
             repeat = self._effective_repeat_after(hc)
             if repeat > 0:
                 self.timers.schedule(hc.key, repeat, self._resubmit_callback(hc))

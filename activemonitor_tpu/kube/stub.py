@@ -51,6 +51,9 @@ class StubApiServer:
         self._runner = None
         self.url = ""
         self.requests: List[Tuple[str, str]] = []  # (method, path) log
+        # chaos injection (see inject_fault / drop_watches / latency)
+        self.faults: List[dict] = []
+        self.latency = 0.0
 
     # -- store ----------------------------------------------------------
     def _bump(self) -> str:
@@ -85,6 +88,50 @@ class StubApiServer:
         self._bucket(key)[(namespace, meta["name"])] = obj
         self._broadcast(key, namespace, "ADDED", obj)
         return obj
+
+    # -- chaos injection (the fault-injection tier: SURVEY.md §5.3) ----
+    def inject_fault(
+        self,
+        path_substr: str,
+        *,
+        status: int = 500,
+        times: int = 1,
+        method: str = "",
+    ) -> None:
+        """The next ``times`` requests whose path contains
+        ``path_substr`` (and match ``method``, if given) fail with
+        ``status``. Faults are consumed in registration order."""
+        self.faults.append(
+            {
+                "path_substr": path_substr,
+                "status": status,
+                "remaining": times,
+                "method": method.upper(),
+            }
+        )
+
+    def _consume_fault(self, request):
+        for fault in self.faults:
+            if fault["remaining"] <= 0:
+                continue
+            if fault["method"] and fault["method"] != request.method:
+                continue
+            if fault["path_substr"] not in request.path:
+                continue
+            fault["remaining"] -= 1
+            return self._error(
+                fault["status"], f"chaos: injected {fault['status']}"
+            )
+        return None
+
+    def drop_watches(self) -> int:
+        """Abruptly end every live watch stream (the client sees EOF and
+        must reconnect). Returns how many streams were dropped."""
+        dropped = 0
+        for _, _, queue in list(self._watchers):
+            queue.put_nowait(None)  # sentinel: close the stream
+            dropped += 1
+        return dropped
 
     # -- lifecycle ------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -164,6 +211,11 @@ class StubApiServer:
             auth = request.headers.get("Authorization", "")
             if auth != f"Bearer {self._token}":
                 return self._error(401, "Unauthorized")
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        injected = self._consume_fault(request)
+        if injected is not None:
+            return injected
         return await handler(request)
 
     # -- handlers -------------------------------------------------------
@@ -234,6 +286,8 @@ class StubApiServer:
                 try:
                     ev = await asyncio.wait_for(queue.get(), timeout=remaining)
                 except asyncio.TimeoutError:
+                    break
+                if ev is None:  # drop_watches sentinel: abrupt stream end
                     break
                 await resp.write(json.dumps(ev).encode() + b"\n")
         except (ConnectionResetError, asyncio.CancelledError):

@@ -16,14 +16,15 @@ Bandwidth conventions follow NCCL-tests:
 
 - *algbw* = payload bytes / time
 - *busbw* = algbw × 2(n-1)/n for all-reduce (ring transfer volume),
-  algbw × (n-1)/n for all-gather — the number comparable against rated
-  link bandwidth.
+  algbw × (n-1)/n for all-gather / reduce-scatter / all-to-all — the
+  number comparable against rated link bandwidth.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -70,6 +71,49 @@ def _sharded_chain(mesh: Mesh, body, k: int, axis: str):
     return lambda x: chain(x)[0]
 
 
+def _bench(
+    name: str,
+    mesh: Mesh,
+    axis: str,
+    size_mb: float,
+    dtype,
+    iters: int,
+    make_body: Callable[[int, str], Callable],
+    *,
+    rows_multiple_of_n: bool = False,
+    payload_mult: float = 1.0,
+    busbw_factor: Callable[[int], float] = lambda n: 1.0,
+) -> CollectiveResult:
+    """Shared scaffold: payload shaping, the timed shard_map chain, and
+    the NCCL accounting. ``make_body(n, axis)`` returns the per-round,
+    shape-preserving collective body; ``payload_mult`` scales the
+    per-shard bytes into the convention's reported payload (e.g. ×n for
+    all-gather's total-data accounting)."""
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    rows, cols, shard_bytes = _payload(size_mb, dtype)
+    if rows_multiple_of_n:
+        # rows must divide by n so scattered shards keep a static shape
+        rows = max(n, rows - rows % n)
+        shard_bytes = rows * cols * jnp.dtype(dtype).itemsize
+    body = make_body(n, axis)
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(
+        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
+    )
+    payload = int(shard_bytes * payload_mult)
+    algbw = payload / seconds / 1e9
+    busbw = algbw * busbw_factor(n) if n > 1 else algbw
+    return CollectiveResult(
+        name=name,
+        payload_bytes=payload,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=busbw,
+    )
+
+
 def all_reduce_bandwidth(
     mesh: Mesh,
     size_mb: float = 64.0,
@@ -80,27 +124,14 @@ def all_reduce_bandwidth(
     """Chained psum all-reduce over ``axis`` (default: the mesh's first
     axis — pass "dcn" on a multihost mesh to measure the cross-host
     direction; the other axes stay replicated)."""
-    axis = axis or mesh.axis_names[0]
-    n = mesh.shape[axis]
-    rows, cols, payload_bytes = _payload(size_mb, dtype)
-    inv_n = jnp.asarray(1.0 / n, dtype)
 
-    def body(x):
-        return jax.lax.psum(x, axis) * inv_n  # mean keeps magnitude stable
+    def make_body(n, ax):
+        inv_n = jnp.asarray(1.0 / n, dtype)
+        return lambda x: jax.lax.psum(x, ax) * inv_n  # mean keeps magnitude stable
 
-    x = jnp.ones((rows * n, cols), dtype=dtype)
-    seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
-    )
-    algbw = payload_bytes / seconds / 1e9
-    busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
-    return CollectiveResult(
-        name="all_reduce",
-        payload_bytes=payload_bytes,
-        n_devices=n,
-        seconds_per_op=seconds,
-        algbw_gbps=algbw,
-        busbw_gbps=busbw,
+    return _bench(
+        "all_reduce", mesh, axis, size_mb, dtype, iters, make_body,
+        busbw_factor=lambda n: 2 * (n - 1) / n,
     )
 
 
@@ -114,29 +145,22 @@ def all_gather_bandwidth(
     """Chained all-gather; each round gathers all shards then reduces
     back to shard shape (the reduce keeps rounds data-dependent — its
     local cost is included, so this slightly understates pure comm bw)."""
-    axis = axis or mesh.axis_names[0]
-    n = mesh.shape[axis]
-    rows, cols, shard_bytes = _payload(size_mb, dtype)
-    inv_n = jnp.asarray(1.0 / n, dtype)
 
-    def body(x):
-        g = jax.lax.all_gather(x, axis)  # [n, rows, cols]
-        return jnp.sum(g, axis=0) * inv_n
+    def make_body(n, ax):
+        inv_n = jnp.asarray(1.0 / n, dtype)
 
-    x = jnp.ones((rows * n, cols), dtype=dtype)
-    seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
-    )
-    total_bytes = shard_bytes * n
-    algbw = total_bytes / seconds / 1e9
-    busbw = algbw * ((n - 1) / n) if n > 1 else algbw
-    return CollectiveResult(
-        name="all_gather",
-        payload_bytes=total_bytes,
-        n_devices=n,
-        seconds_per_op=seconds,
-        algbw_gbps=algbw,
-        busbw_gbps=busbw,
+        def body(x):
+            g = jax.lax.all_gather(x, ax)  # [n, rows, cols]
+            return jnp.sum(g, axis=0) * inv_n
+
+        return body
+
+    # all-gather's NCCL accounting reports total gathered data (n×shard)
+    n = mesh.shape[axis or mesh.axis_names[0]]
+    return _bench(
+        "all_gather", mesh, axis, size_mb, dtype, iters, make_body,
+        payload_mult=float(n),
+        busbw_factor=lambda n: (n - 1) / n,
     )
 
 
@@ -151,31 +175,20 @@ def reduce_scatter_bandwidth(
     tiles the result back to shard shape (a local copy that keeps rounds
     data-dependent and shape-stable — its HBM cost is included, so this
     slightly understates pure comm bw, mirroring all_gather above)."""
-    axis = axis or mesh.axis_names[0]
-    n = mesh.shape[axis]
-    rows, cols, shard_bytes = _payload(size_mb, dtype)
-    # rows must divide by n so the scattered shard keeps a static shape
-    rows = max(n, rows - rows % n)
-    shard_bytes = rows * cols * jnp.dtype(dtype).itemsize
-    inv_n = jnp.asarray(1.0 / n, dtype)
 
-    def body(x):
-        s = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
-        return jnp.concatenate([s] * n, axis=0) * inv_n
+    def make_body(n, ax):
+        inv_n = jnp.asarray(1.0 / n, dtype)
 
-    x = jnp.ones((rows * n, cols), dtype=dtype)
-    seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
-    )
-    algbw = shard_bytes / seconds / 1e9
-    busbw = algbw * ((n - 1) / n) if n > 1 else algbw
-    return CollectiveResult(
-        name="reduce_scatter",
-        payload_bytes=shard_bytes,
-        n_devices=n,
-        seconds_per_op=seconds,
-        algbw_gbps=algbw,
-        busbw_gbps=busbw,
+        def body(x):
+            s = jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+            return jnp.concatenate([s] * n, axis=0) * inv_n
+
+        return body
+
+    return _bench(
+        "reduce_scatter", mesh, axis, size_mb, dtype, iters, make_body,
+        rows_multiple_of_n=True,
+        busbw_factor=lambda n: (n - 1) / n,
     )
 
 
@@ -189,30 +202,16 @@ def all_to_all_bandwidth(
     """Chained tiled all-to-all (the expert-parallel dispatch pattern,
     ops/moe.py) — shape-preserving, so the chain is pure communication;
     each round every device exchanges (n-1)/n of its shard."""
-    axis = axis or mesh.axis_names[0]
-    n = mesh.shape[axis]
-    rows, cols, shard_bytes = _payload(size_mb, dtype)
-    rows = max(n, rows - rows % n)
-    shard_bytes = rows * cols * jnp.dtype(dtype).itemsize
 
-    def body(x):
-        return jax.lax.all_to_all(
-            x, axis, split_axis=0, concat_axis=0, tiled=True
+    def make_body(n, ax):
+        return lambda x: jax.lax.all_to_all(
+            x, ax, split_axis=0, concat_axis=0, tiled=True
         )
 
-    x = jnp.ones((rows * n, cols), dtype=dtype)
-    seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
-    )
-    algbw = shard_bytes / seconds / 1e9
-    busbw = algbw * ((n - 1) / n) if n > 1 else algbw
-    return CollectiveResult(
-        name="all_to_all",
-        payload_bytes=shard_bytes,
-        n_devices=n,
-        seconds_per_op=seconds,
-        algbw_gbps=algbw,
-        busbw_gbps=busbw,
+    return _bench(
+        "all_to_all", mesh, axis, size_mb, dtype, iters, make_body,
+        rows_multiple_of_n=True,
+        busbw_factor=lambda n: (n - 1) / n,
     )
 
 
@@ -225,24 +224,9 @@ def ppermute_ring_bandwidth(
 ) -> CollectiveResult:
     """Chained neighbor-shift over a ring — isolates single-hop ICI link
     speed (the building block of ring attention / pipelined collectives)."""
-    axis = axis or mesh.axis_names[0]
-    n = mesh.shape[axis]
-    rows, cols, payload_bytes = _payload(size_mb, dtype)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(x):
-        return jax.lax.ppermute(x, axis, perm)
+    def make_body(n, ax):
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return lambda x: jax.lax.ppermute(x, ax, perm)
 
-    x = jnp.ones((rows * n, cols), dtype=dtype)
-    seconds = chain_delta_seconds(
-        lambda k: _sharded_chain(mesh, body, k, axis), x, k1=2, k2=6, iters=iters
-    )
-    algbw = payload_bytes / seconds / 1e9
-    return CollectiveResult(
-        name="ppermute_ring",
-        payload_bytes=payload_bytes,
-        n_devices=n,
-        seconds_per_op=seconds,
-        algbw_gbps=algbw,
-        busbw_gbps=algbw,
-    )
+    return _bench("ppermute_ring", mesh, axis, size_mb, dtype, iters, make_body)
