@@ -19,7 +19,10 @@ bench: ## one-line JSON benchmark (adaptive to hardware)
 crd: ## regenerate the CRD manifest from the pydantic models
 	$(PYTHON) -m activemonitor_tpu crd > config/crd/activemonitor.keikoproj.io_healthchecks.yaml
 
-manifests: crd ## alias matching the reference's make target
+deploy-manifest: ## regenerate the one-shot deploy file from config/
+	$(PYTHON) hack/gen_deploy.py
+
+manifests: crd deploy-manifest ## alias matching the reference's make target
 
 run: ## run the controller locally (file store + local engine)
 	$(PYTHON) -m activemonitor_tpu run --engine local --store ./healthchecks

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Generate deploy/deploy-active-monitor-tpu.yaml from the config/
+kustomize tree — config/ is the single source of truth; the one-shot
+deploy file is build output, drift-checked in CI like the generated CRD
+(reference split: config/ kubebuilder tree vs deploy/ one-shots).
+
+Usage: python hack/gen_deploy.py [--check]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import yaml
+
+ROOT = Path(__file__).resolve().parent.parent
+OUT = ROOT / "deploy" / "deploy-active-monitor-tpu.yaml"
+
+HEADER = """\
+# One-shot install of the controller into namespace "health"
+# (reference equivalent: deploy/deploy-active-monitor.yaml).
+# Apply config/crd/activemonitor.keikoproj.io_healthchecks.yaml first.
+# GENERATED from config/{manager,rbac} by hack/gen_deploy.py — edit
+# those files, then `make deploy-manifest`.
+"""
+
+# install order: namespace first, then identity, grants, workload
+SOURCES = [
+    "config/manager/namespace.yaml",
+    "config/rbac/service_account.yaml",
+    "config/rbac/role.yaml",
+    "config/rbac/role_binding.yaml",
+    "config/manager/manager.yaml",
+]
+
+
+def render() -> str:
+    chunks = []
+    for rel in SOURCES:
+        text = (ROOT / rel).read_text()
+        # drop each source file's own header comment (lines before the
+        # first key) — the deploy file carries its own header; object-
+        # internal comments are preserved verbatim
+        lines = text.split("\n")
+        start = 0
+        while start < len(lines) and (
+            lines[start].startswith("#") or not lines[start].strip()
+        ):
+            start += 1
+        chunk = "\n".join(lines[start:]).strip("\n")
+        assert yaml.safe_load(chunk), f"{rel} renders no object"
+        chunks.append(chunk)
+    return HEADER + "\n---\n".join(chunks) + "\n"
+
+
+def main() -> int:
+    content = render()
+    if "--check" in sys.argv:
+        current = OUT.read_text() if OUT.exists() else ""
+        if current != content:
+            print(
+                f"{OUT.relative_to(ROOT)} is stale; run `make deploy-manifest`",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    OUT.write_text(content)
+    print(f"wrote {OUT.relative_to(ROOT)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,3 +147,73 @@ def test_manager_clusterrole_covers_every_api_the_controller_uses():
     }
     missing = needed - granted
     assert not missing, f"deploy ClusterRole missing grants: {missing}"
+
+
+def test_kustomize_tree_matches_deploy():
+    """config/ (kubectl apply -k config/default) and the one-shot
+    deploy manifest must install identical object SETS — keyed by
+    (kind, name), compared both directions, so an object added to
+    either tree alone fails here. config/ is the source of truth: the
+    deploy file is generated by hack/gen_deploy.py (CI drift-checks)."""
+
+    def doc_set(paths):
+        docs = {}
+        for path in paths:
+            if path.endswith("kustomization.yaml"):
+                continue
+            for doc in yaml.safe_load_all(Path(path).read_text()):
+                if doc:
+                    key = (doc["kind"], doc["metadata"]["name"])
+                    assert key not in docs, f"duplicate {key} in {path}"
+                    docs[key] = doc
+        return docs
+
+    deploy_docs = doc_set(["deploy/deploy-active-monitor-tpu.yaml"])
+    tree_docs = doc_set(
+        glob.glob("config/rbac/*.yaml") + glob.glob("config/manager/*.yaml")
+    )
+    assert set(tree_docs) == set(deploy_docs), (
+        "object sets drifted between config/ and deploy/: "
+        f"{set(tree_docs) ^ set(deploy_docs)}"
+    )
+    for key, doc in tree_docs.items():
+        assert doc == deploy_docs[key], f"{key} drifted between config/ and deploy/"
+
+
+def test_deploy_manifest_is_generated_from_config_tree():
+    """The committed deploy file must be exactly what the generator
+    renders from config/ (same check CI runs)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "hack/gen_deploy.py", "--check"], capture_output=True
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+def test_kustomization_resources_resolve():
+    """Every kustomization's resource entries must exist on disk — and a
+    directory resource must itself be a kustomize base (contain a
+    kustomization.yaml), or `kubectl apply -k` fails at install time."""
+    kfiles = glob.glob("config/**/kustomization.yaml", recursive=True)
+    assert len(kfiles) >= 5  # crd, rbac, manager, default, samples
+    for kfile in kfiles:
+        base = Path(kfile).parent
+        doc = yaml.safe_load(Path(kfile).read_text())
+        for res in doc["resources"]:
+            target = base / res
+            assert target.exists(), f"{kfile}: missing resource {res}"
+            if target.is_dir():
+                assert (target / "kustomization.yaml").exists(), (
+                    f"{kfile}: resource {res} is not a kustomize base"
+                )
+    default = yaml.safe_load(Path("config/default/kustomization.yaml").read_text())
+    assert set(default["resources"]) == {"../crd", "../rbac", "../manager"}
+
+
+def test_config_sample_healthcheck_validates():
+    checks = list(load_healthchecks("config/samples/healthcheck_sample.yaml"))
+    assert checks
+    wf = parse_workflow_from_healthcheck(checks[0])
+    assert wf["kind"] == "Workflow"
