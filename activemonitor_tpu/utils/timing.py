@@ -26,12 +26,6 @@ def median_readback_seconds(fn: Callable, *args, iters: int = 5, warmup: int = 2
     return _readback_samples(fn, *args, iters=iters, warmup=warmup)[iters // 2]
 
 
-def min_readback_seconds(fn: Callable, *args, iters: int = 5, warmup: int = 2) -> float:
-    """Minimum wall-clock — the cleanest estimate of true device time
-    under one-sided noise (network jitter only ever adds)."""
-    return _readback_samples(fn, *args, iters=iters, warmup=warmup)[0]
-
-
 def _readback_samples(fn: Callable, *args, iters: int, warmup: int) -> list:
     import time
 
@@ -44,6 +38,32 @@ def _readback_samples(fn: Callable, *args, iters: int, warmup: int) -> list:
         samples.append(time.perf_counter() - t0)
     samples.sort()
     return samples
+
+
+def _interleaved_min_pair(
+    fn1: Callable, fn2: Callable, *args, iters: int, warmup: int = 2
+) -> tuple:
+    """(min t1, min t2) with the two chains sampled alternately.
+
+    Sampling all of t1 then all of t2 lets anything that drifts between
+    the phases (clock throttle, tunnel congestion) land entirely on one
+    side of the difference; alternating spreads it across both. Both
+    mins see the same noise environment, so the min-bias of the delta
+    shrinks with iters instead of depending on which phase was lucky."""
+    import time
+
+    for _ in range(warmup):
+        float(fn1(*args))
+        float(fn2(*args))
+    t1s, t2s = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn1(*args))
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(fn2(*args))
+        t2s.append(time.perf_counter() - t0)
+    return min(t1s), min(t2s)
 
 
 # shared noise-floor policy for chain-delta measurements (also used by
@@ -77,15 +97,22 @@ def chain_delta_seconds(
     faster than dispatch jitter — tiny payloads, fast hardware), the
     chain is lengthened and remeasured up to ``_retries`` times so the
     delta towers over the noise instead of reporting a garbage rate.
-    Each retry reuses the longer chain's timing as its new short-chain
-    baseline rather than re-running it.
+
+    The two chains are sampled ALTERNATELY (see _interleaved_min_pair):
+    phase-separated sampling let drift land on one side of the
+    difference, which is how the MXU probe once reported a physically
+    impossible >1.0-of-rated rate.
     """
-    t1 = min_readback_seconds(make_chain(k1), *args, iters=iters)
-    t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
+    fn1, fn2 = make_chain(k1), make_chain(k2)
+    t1, t2 = _interleaved_min_pair(fn1, fn2, *args, iters=iters)
     for _ in range(_retries):
         if not needs_longer_chain(t1, t2):
             break
-        k1, t1 = k2, t2
+        k1, fn1, t1 = k2, fn2, t2
         k2 = k2 * CHAIN_GROWTH
-        t2 = min_readback_seconds(make_chain(k2), *args, iters=iters)
+        fn2 = make_chain(k2)
+        # fn1 is already warm; one warmup pass compiles fn2. Both sides
+        # of the delta come from THIS round — never min a side against a
+        # previous round, or cross-round drift skews the difference
+        t1, t2 = _interleaved_min_pair(fn1, fn2, *args, iters=iters, warmup=1)
     return max((t2 - t1) / (k2 - k1), 1e-9)

@@ -217,3 +217,22 @@ def test_config_sample_healthcheck_validates():
     assert checks
     wf = parse_workflow_from_healthcheck(checks[0])
     assert wf["kind"] == "Workflow"
+
+
+def test_config_sample_matches_example():
+    """config/samples mirrors examples/inline-hello.yaml (same object,
+    kind/name/namespace included) — guard the pair like config↔deploy,
+    or they silently diverge and collide on apply."""
+    sample = next(
+        d
+        for d in yaml.safe_load_all(
+            Path("config/samples/healthcheck_sample.yaml").read_text()
+        )
+        if d
+    )
+    example = next(
+        d
+        for d in yaml.safe_load_all(Path("examples/inline-hello.yaml").read_text())
+        if d
+    )
+    assert sample == example, "config/samples drifted from examples/inline-hello.yaml"

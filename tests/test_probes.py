@@ -396,3 +396,42 @@ def test_runtime_histogram_observed():
         {"healthcheck_name": "hc-a", "workflow": "healthCheck", "le": "15.0"},
     )
     assert le15 == 1  # only the 7s run
+
+
+def test_chain_delta_recovers_per_op_time_under_constant_overhead():
+    """The difference method must cancel constant dispatch overhead and
+    survive one-sided noise (the tunnel hazard it exists for)."""
+    import random
+    import time as _time
+
+    from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+    op = 0.002  # true per-op seconds
+    rng = random.Random(0)
+
+    def make_chain(k):
+        def fn():
+            # k ops + constant dispatch cost + one-sided noise
+            _time.sleep(k * op + 0.005 + rng.random() * 0.001)
+            return 0.0
+
+        return fn
+
+    sec = chain_delta_seconds(make_chain, k1=4, k2=12, iters=4)
+    assert 0.0014 < sec < 0.0030, sec
+
+
+def test_chain_delta_lengthens_chain_inside_noise_floor():
+    """Ops far below the noise floor trigger the lengthen-and-remeasure
+    policy instead of returning a garbage rate."""
+    from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+    calls = []
+
+    def make_chain(k):
+        calls.append(k)
+        return lambda: 0.0  # instantaneous: delta always in the noise
+
+    sec = chain_delta_seconds(make_chain, k1=2, k2=6, iters=2)
+    assert sec > 0
+    assert max(calls) > 6  # the chain actually grew
