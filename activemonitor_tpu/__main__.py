@@ -99,6 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="HealthCheck manifest(s) to apply at startup",
     )
     run.add_argument("--log-level", default="INFO")
+    run.add_argument(
+        "--log-format",
+        choices=("text", "json"),
+        default="text",
+        help="console text or structured JSON lines "
+        "(reference parity: zap --zap-encoder, cmd/main.go:146-152)",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -147,10 +154,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def _run(args) -> int:
-    logging.basicConfig(
-        level=args.log_level.upper(),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    from activemonitor_tpu.utils.logfmt import configure_logging
+
+    configure_logging(args.log_level, getattr(args, "log_format", "text"))
     client_kind = args.client or ("k8s" if args.engine == "argo" else "file")
     # one REST session shared by every cluster-facing component
     kube_api = None

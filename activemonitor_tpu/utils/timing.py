@@ -108,7 +108,7 @@ def chain_delta_seconds(
     for _ in range(_retries):
         if not needs_longer_chain(t1, t2):
             break
-        k1, fn1, t1 = k2, fn2, t2
+        k1, fn1 = k2, fn2
         k2 = k2 * CHAIN_GROWTH
         fn2 = make_chain(k2)
         # fn1 is already warm; one warmup pass compiles fn2. Both sides

@@ -171,3 +171,29 @@ def test_probe_suite_quick(capsys):
     names = {m.name for m in result.metrics}
     assert "tpu-device-count" in names
     assert "xla-compile-seconds" in names
+
+
+def test_json_log_format(capsys):
+    import json as _json
+    import logging
+
+    from activemonitor_tpu.utils.logfmt import configure_logging
+
+    configure_logging("INFO", "json")
+    try:
+        log = logging.getLogger("activemonitor.test")
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            log.exception("something failed")
+        handler = logging.getLogger().handlers[0]
+        record = logging.LogRecord(
+            "activemonitor.test", logging.INFO, __file__, 1, "hello %s", ("x",), None
+        )
+        line = handler.format(record)
+        doc = _json.loads(line)
+        assert doc["msg"] == "hello x"
+        assert doc["level"] == "info"
+        assert doc["logger"] == "activemonitor.test"
+    finally:
+        configure_logging("INFO", "text")  # restore for other tests
