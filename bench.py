@@ -37,19 +37,29 @@ def _device_reachable() -> bool:
             timeout=_PROBE_TIMEOUT,
             capture_output=True,
         )
-        return proc.returncode == 0
     except subprocess.TimeoutExpired:
+        print(
+            f"device probe hung past {_PROBE_TIMEOUT:.0f}s (wedged tunnel?)",
+            file=sys.stderr,
+        )
         return False
+    if proc.returncode != 0:
+        # surface the real diagnostic (libtpu init error, plugin
+        # mismatch, OOM) instead of a misleading timeout claim
+        tail = proc.stderr.decode(errors="replace").strip().splitlines()[-8:]
+        print(
+            "device probe exited with "
+            f"{proc.returncode}:\n" + "\n".join(tail),
+            file=sys.stderr,
+        )
+        return False
+    return True
 
 
 def main() -> int:
     # known-CPU runs have no tunnel to hang on — skip the probe cost
     if os.environ.get("JAX_PLATFORMS") != "cpu" and not _device_reachable():
-        print(
-            f"device unreachable within {_PROBE_TIMEOUT:.0f}s; "
-            "falling back to the virtual CPU mesh",
-            file=sys.stderr,
-        )
+        print("falling back to the virtual CPU mesh", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:

@@ -5,6 +5,7 @@ stub API server must be started *inside* the test body — an async
 context manager, not a fixture.
 """
 
+import asyncio
 from contextlib import asynccontextmanager
 
 from activemonitor_tpu.kube import KubeApi, KubeConfig
@@ -22,3 +23,13 @@ async def stub_env(token: str = ""):
     finally:
         await api.close()
         await server.stop()
+
+
+async def advance(clock, seconds, step=2.5):
+    """Advance a FakeClock in small steps with real-time pauses so HTTP
+    roundtrips triggered by woken coroutines can complete."""
+    remaining = seconds
+    while remaining > 0:
+        await clock.advance(min(step, remaining))
+        await asyncio.sleep(0.05)
+        remaining -= step

@@ -242,3 +242,139 @@ async def test_slow_apiserver_full_lifecycle():
         finally:
             player.cancel()
             await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_ha_failover_without_double_submission():
+    """Two full controllers, lease election, one check: the standby must
+    take over on leader shutdown, resume the schedule from durable
+    status (divergence 10) WITHOUT resubmitting the recent run, and own
+    the next fire."""
+    from activemonitor_tpu.controller.leader import KubernetesLeaseElector
+    from activemonitor_tpu.kube import KubeApi, KubeConfig
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    from tests.kube_harness import advance
+
+    async def drive_until(clock, predicate, max_seconds=60.0, step=2.5):
+        """Everything time-driven (workflow polls, election, timers)
+        sleeps on the shared fake clock — interleave predicate checks
+        with clock advances, stopping the moment the predicate holds so
+        fake time never runs ahead of the scenario."""
+        elapsed = 0.0
+        while True:
+            result = await predicate()
+            if result:
+                return result
+            if elapsed >= max_seconds:
+                raise TimeoutError(f"condition not met after {elapsed}s fake time")
+            await advance(clock, step)
+            elapsed += step
+
+    async with stub_env() as (server, api_a):
+        clock = FakeClock()
+        api_b = KubeApi(KubeConfig(server=server.url))
+
+        def controller(api, identity):
+            client = KubernetesHealthCheckClient(api)
+            reconciler = HealthCheckReconciler(
+                client=client,
+                engine=ArgoWorkflowEngine(api),
+                rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+                recorder=KubernetesEventRecorder(api),
+                metrics=MetricsCollector(),
+                clock=clock,
+            )
+            elector = KubernetesLeaseElector(
+                api=api,
+                namespace="health",
+                identity=identity,
+                lease_seconds=15.0,
+                clock=clock,
+            )
+            return client, Manager(
+                client=client,
+                reconciler=reconciler,
+                max_parallel=2,
+                leader_elector=elector,
+            )
+
+        client_a, mgr_a = controller(api_a, "replica-a")
+        client_b, mgr_b = controller(api_b, "replica-b")
+        a_stopped = False
+        b_start = None
+        try:
+            await mgr_a.start()
+            b_start = asyncio.create_task(mgr_b.start())
+            await asyncio.sleep(0.2)
+            assert not b_start.done()  # B stands by while A leads
+
+            await client_a.apply(chaos_check("ha-check"))
+            workflows = await wait_for(
+                lambda: asyncio.sleep(0, server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+            )
+            wf1 = workflows[0]["metadata"]["name"]
+            await api_a.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", wf1, "status"),
+                {"status": {"phase": "Succeeded"}},
+            )
+
+            async def succeeded(count):
+                async def check():
+                    hc = await client_b.get("health", "ha-check")
+                    return hc if hc and hc.status.success_count == count else None
+
+                # the poll loop between submit and terminal phase runs on
+                # the fake clock: drive it
+                return await drive_until(clock, check)
+
+            await succeeded(1)
+
+            # graceful failover: A releases the lease, B acquires
+            await mgr_a.stop()
+            a_stopped = True
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, b_start.done()), max_seconds=30
+            )
+            await b_start
+
+            # B boot-resynced: the schedule must resume from status, not
+            # resubmit the run that just finished
+            await asyncio.sleep(0.3)
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 1
+            await wait_for(
+                lambda: asyncio.sleep(
+                    0, mgr_b.reconciler.timers.exists("health/ha-check")
+                )
+            )
+
+            # the next fire is B's: advance past the 60s interval
+            await advance(clock, 61)
+            workflows = await wait_for(
+                lambda: asyncio.sleep(
+                    0,
+                    len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2
+                    and server.objs(WF_GROUP, WF_VERSION, WF_PLURAL),
+                ),
+                timeout=5.0,
+            )
+            wf2 = next(
+                w["metadata"]["name"]
+                for w in workflows
+                if w["metadata"]["name"] != wf1
+            )
+            await api_b.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", wf2, "status"),
+                {"status": {"phase": "Succeeded"}},
+            )
+            hc = await succeeded(2)
+            assert hc.status.total_healthcheck_runs == 2
+            # exactly two runs ever: no duplicate across the failover
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2
+        finally:
+            if not a_stopped:
+                await mgr_a.stop()
+            if b_start is not None and not b_start.done():
+                b_start.cancel()
+            await mgr_b.stop()
+            await api_b.close()

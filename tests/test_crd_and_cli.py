@@ -173,27 +173,43 @@ def test_probe_suite_quick(capsys):
     assert "xla-compile-seconds" in names
 
 
-def test_json_log_format(capsys):
+def test_json_log_format():
     import json as _json
     import logging
+    import sys
 
-    from activemonitor_tpu.utils.logfmt import configure_logging
+    from activemonitor_tpu.utils.logfmt import JsonFormatter, configure_logging
 
-    configure_logging("INFO", "json")
+    # formatter semantics, no global state involved
+    fmt = JsonFormatter()
+    record = logging.LogRecord(
+        "activemonitor.test", logging.INFO, __file__, 1, "hello %s", ("x",), None
+    )
+    doc = _json.loads(fmt.format(record))
+    assert doc["msg"] == "hello x"
+    assert doc["level"] == "info"
+    assert doc["logger"] == "activemonitor.test"
+
+    exc_record = logging.LogRecord(
+        "activemonitor.test", logging.ERROR, __file__, 1, "boom", (), None
+    )
     try:
-        log = logging.getLogger("activemonitor.test")
-        try:
-            raise ValueError("boom")
-        except ValueError:
-            log.exception("something failed")
-        handler = logging.getLogger().handlers[0]
-        record = logging.LogRecord(
-            "activemonitor.test", logging.INFO, __file__, 1, "hello %s", ("x",), None
-        )
-        line = handler.format(record)
-        doc = _json.loads(line)
-        assert doc["msg"] == "hello x"
-        assert doc["level"] == "info"
-        assert doc["logger"] == "activemonitor.test"
+        raise ValueError("kapow")
+    except ValueError:
+        exc_record.exc_info = sys.exc_info()
+    doc = _json.loads(fmt.format(exc_record))
+    assert "kapow" in doc["exception"]
+
+    # configure wires the formatter onto the root handler; clear the
+    # handlers afterwards so no handler bound to this test's stderr
+    # outlives the test
+    root = logging.getLogger()
+    saved = root.handlers[:]
+    try:
+        configure_logging("INFO", "json")
+        assert isinstance(root.handlers[0].formatter, JsonFormatter)
     finally:
-        configure_logging("INFO", "text")  # restore for other tests
+        for h in root.handlers[:]:
+            root.removeHandler(h)
+        for h in saved:
+            root.addHandler(h)

@@ -14,7 +14,7 @@ from activemonitor_tpu.controller.leader import KubernetesLeaseElector
 from activemonitor_tpu.kube import ApiError, KubeApi, KubeConfig
 from activemonitor_tpu.utils.clock import FakeClock
 
-from tests.kube_harness import stub_env
+from tests.kube_harness import advance, stub_env
 
 LEASE = 15.0
 
@@ -23,16 +23,6 @@ def elector(api, clock, identity):
     return KubernetesLeaseElector(
         api=api, namespace="health", identity=identity, lease_seconds=LEASE, clock=clock
     )
-
-
-async def advance(clock, seconds, step=2.5):
-    """Advance the fake clock in small steps with real-time pauses so
-    HTTP roundtrips triggered by woken coroutines can complete."""
-    remaining = seconds
-    while remaining > 0:
-        await clock.advance(min(step, remaining))
-        await asyncio.sleep(0.05)
-        remaining -= step
 
 
 class FlakyApi:
