@@ -33,3 +33,19 @@ async def advance(clock, seconds, step=2.5):
         await clock.advance(min(step, remaining))
         await asyncio.sleep(0.05)
         remaining -= step
+
+
+async def drive_until(clock, predicate, max_seconds=60.0, step=2.5):
+    """Fake-clock-aware wait: everything time-driven (workflow polls,
+    election, timers) sleeps on the FakeClock — interleave predicate
+    checks with clock advances, stopping the moment the predicate holds
+    so fake time never runs ahead of the scenario."""
+    elapsed = 0.0
+    while True:
+        result = await predicate()
+        if result:
+            return result
+        if elapsed >= max_seconds:
+            raise TimeoutError(f"condition not met after {elapsed}s fake time")
+        await advance(clock, step)
+        elapsed += step

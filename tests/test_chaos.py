@@ -254,22 +254,7 @@ async def test_ha_failover_without_double_submission():
     from activemonitor_tpu.kube import KubeApi, KubeConfig
     from activemonitor_tpu.utils.clock import FakeClock
 
-    from tests.kube_harness import advance
-
-    async def drive_until(clock, predicate, max_seconds=60.0, step=2.5):
-        """Everything time-driven (workflow polls, election, timers)
-        sleeps on the shared fake clock — interleave predicate checks
-        with clock advances, stopping the moment the predicate holds so
-        fake time never runs ahead of the scenario."""
-        elapsed = 0.0
-        while True:
-            result = await predicate()
-            if result:
-                return result
-            if elapsed >= max_seconds:
-                raise TimeoutError(f"condition not met after {elapsed}s fake time")
-            await advance(clock, step)
-            elapsed += step
+    from tests.kube_harness import advance, drive_until
 
     async with stub_env() as (server, api_a):
         clock = FakeClock()

@@ -200,16 +200,21 @@ def test_json_log_format():
     doc = _json.loads(fmt.format(exc_record))
     assert "kapow" in doc["exception"]
 
-    # configure wires the formatter onto the root handler; clear the
-    # handlers afterwards so no handler bound to this test's stderr
-    # outlives the test
+    # configure wires the formatter onto the root handler. Detach the
+    # existing handlers FIRST: basicConfig(force=True) would close any
+    # it finds (a closed pytest log handler breaks every later test),
+    # then restore handlers and level afterwards.
     root = logging.getLogger()
-    saved = root.handlers[:]
+    saved_handlers = root.handlers[:]
+    saved_level = root.level
+    for h in saved_handlers:
+        root.removeHandler(h)
     try:
         configure_logging("INFO", "json")
         assert isinstance(root.handlers[0].formatter, JsonFormatter)
     finally:
         for h in root.handlers[:]:
             root.removeHandler(h)
-        for h in saved:
+        for h in saved_handlers:
             root.addHandler(h)
+        root.setLevel(saved_level)

@@ -182,3 +182,118 @@ async def test_cluster_mode_delete_stops_timer_and_cleans_up():
             assert await client.get("health", "inline-hello") is None
         finally:
             await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_cluster_mode_remedy_lifecycle():
+    """Failure path in cluster mode: the check fails, the remedy runs
+    under its OWN ephemeral write-scoped RBAC (reference: remedy rules
+    :104-120, delete after :779), remedy status lands, and the remedy
+    RBAC is gone afterwards while the check RBAC stays."""
+    remedy_inline = INLINE_HELLO.replace("hello-tpu-", "remedy-tpu-")
+    hc = HealthCheck.from_dict(
+        {
+            "metadata": {"name": "remedy-check", "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": 60,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": "hello-tpu-",
+                    "workflowtimeout": 5,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "check-sa",
+                        "source": {"inline": INLINE_HELLO},
+                    },
+                },
+                "remedyworkflow": {
+                    "generateName": "remedy-tpu-",
+                    "workflowtimeout": 5,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "fix-sa",
+                        "source": {"inline": remedy_inline},
+                    },
+                },
+            },
+        }
+    )
+    async with stub_env() as (server, api):
+        client = KubernetesHealthCheckClient(api)
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=ArgoWorkflowEngine(api),
+            rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+            recorder=KubernetesEventRecorder(api),
+            metrics=MetricsCollector(),
+        )
+        manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+        await manager.start()
+        try:
+            await client.apply(hc)
+            workflows = await wait_for(
+                lambda: asyncio.sleep(0, server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+            )
+            check_wf = workflows[0]["metadata"]["name"]
+            assert check_wf.startswith("hello-tpu-")
+            # fail the check -> the remedy must be provisioned + submitted
+            await api.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", check_wf, "status"),
+                {"status": {"phase": "Failed", "message": "probe died"}},
+            )
+
+            async def remedy_wf():
+                for wf in server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+                    if wf["metadata"]["name"].startswith("remedy-tpu-"):
+                        return wf
+                return None
+
+            wf = await wait_for(remedy_wf)
+            assert wf["spec"]["serviceAccountName"] == "fix-sa"
+            # remedy RBAC exists while the remedy is in flight, with
+            # WRITE verbs (the check role is read-only)
+            fix_role = server.obj(RBAC_GROUP, "v1", "clusterroles", "", "fix-sa-cluster-role")
+            assert fix_role is not None
+            fix_verbs = {v for rule in fix_role["rules"] for v in rule["verbs"]}
+            assert {"create", "delete"} <= fix_verbs
+            # the check role is read-only except the documented
+            # workflowtaskresults divergence (Argo >=3.4 executor reporting)
+            check_role = server.obj(RBAC_GROUP, "v1", "clusterroles", "", "check-sa-cluster-role")
+            writable = {
+                (group, resource)
+                for rule in check_role["rules"]
+                for group in rule["apiGroups"]
+                for resource in rule["resources"]
+                if {"create", "update", "patch", "delete"} & set(rule["verbs"])
+            }
+            assert writable == {("argoproj.io", "workflowtaskresults")}
+
+            await api.merge_patch(
+                api_path(
+                    WF_GROUP, WF_VERSION, WF_PLURAL,
+                    "health", wf["metadata"]["name"], "status",
+                ),
+                {"status": {"phase": "Succeeded"}},
+            )
+
+            async def remedy_done():
+                got = await client.get("health", "remedy-check")
+                return got if got and got.status.remedy_success_count == 1 else None
+
+            got = await wait_for(remedy_done)
+            assert got.status.status == "Failed"  # the CHECK failed
+            assert got.status.remedy_total_runs == 1
+            assert got.status.failed_count == 1
+
+            # ephemeral remedy RBAC deleted after the run; check RBAC stays
+            async def remedy_rbac_gone():
+                return (
+                    server.obj(RBAC_GROUP, "v1", "clusterroles", "", "fix-sa-cluster-role")
+                    is None
+                    and server.obj("", "v1", "serviceaccounts", "health", "fix-sa") is None
+                )
+
+            await wait_for(remedy_rbac_gone)
+            assert server.obj("", "v1", "serviceaccounts", "health", "check-sa")
+        finally:
+            await manager.stop()
