@@ -11,6 +11,9 @@ spec docs linked from healthcheck_types.go:149):
 - descriptors ``@yearly``/``@annually``, ``@monthly``, ``@weekly``,
   ``@daily``/``@midnight``, ``@hourly``
 - ``@every <duration>`` with Go duration syntax
+- ``TZ=<zone>`` / ``CRON_TZ=<zone>`` prefix: the schedule's wall-clock
+  fields are interpreted in that IANA zone (robfig ParseStandard
+  behavior), e.g. ``CRON_TZ=Asia/Tokyo 0 6 * * *``
 
 Standard-cron quirk preserved: when **both** day-of-month and
 day-of-week are restricted, a time matches if **either** matches.
@@ -119,6 +122,20 @@ class CronSchedule:
         raise CronParseError("expression never fires within the search horizon")
 
 
+@dataclass(frozen=True)
+class ZonedSchedule:
+    """Wraps a CronSchedule so its wall-clock fields are evaluated in a
+    specific IANA zone (the ``TZ=``/``CRON_TZ=`` prefix)."""
+
+    inner: CronSchedule
+    zone: datetime.tzinfo
+
+    def next(self, after: datetime.datetime) -> datetime.datetime:
+        if after.tzinfo is None:
+            after = after.replace(tzinfo=datetime.timezone.utc)
+        return self.inner.next(after.astimezone(self.zone))
+
+
 def _parse_value(token: str, names: dict, lo: int, hi: int, what: str) -> int:
     token = token.strip()
     if token.upper() in names:
@@ -172,6 +189,25 @@ def parse_cron(expr: str):
     expr = expr.strip()
     if not expr:
         raise CronParseError("empty cron expression")
+    if expr.startswith(("TZ=", "CRON_TZ=")):
+        prefix, _, rest = expr.partition(" ")
+        zone_name = prefix.split("=", 1)[1]
+        if not zone_name or not rest.strip():
+            raise CronParseError(f"malformed timezone prefix in {expr!r}")
+        try:
+            from zoneinfo import ZoneInfo
+
+            zone = ZoneInfo(zone_name)
+        except Exception:
+            raise CronParseError(f"unknown timezone {zone_name!r}")
+        if rest.lstrip().startswith(("TZ=", "CRON_TZ=")):
+            # robfig strips exactly one prefix; a second one is part of
+            # the field list and fails to parse — never a silent nesting
+            raise CronParseError(f"multiple timezone prefixes in {expr!r}")
+        schedule = parse_cron(rest)
+        if isinstance(schedule, EverySchedule):
+            return schedule  # constant interval: zone is irrelevant
+        return ZonedSchedule(inner=schedule, zone=zone)
     if expr in _DESCRIPTORS:
         expr = _DESCRIPTORS[expr]
     elif expr.startswith("@every "):
@@ -223,5 +259,9 @@ def seconds_until_next(expr: str, now: datetime.datetime) -> int:
     sub-second remainder loses up to a second, so +1s keeps the fire
     time at-or-after the schedule point)."""
     schedule = parse_cron(expr)
+    if now.tzinfo is None:
+        # TZ-prefixed schedules return aware datetimes; keep the delta
+        # arithmetic uniform by promoting a naive now to UTC
+        now = now.replace(tzinfo=datetime.timezone.utc)
     delta = (schedule.next(now) - now).total_seconds()
     return int(delta) + 1

@@ -114,3 +114,75 @@ def test_seconds_until_next_adds_rounding_second():
     # next fire 10:01:00 -> delta 30s -> int(30)+1
     assert seconds_until_next("* * * * *", now) == 31
     assert seconds_until_next("@every 1m", now) == 61
+
+
+def test_tz_prefix_interprets_wall_clock_in_zone():
+    """robfig ParseStandard parity: CRON_TZ=/TZ= prefixes (reference
+    parses with cron.ParseStandard, healthcheck_controller.go:253)."""
+    import datetime
+
+    from activemonitor_tpu.scheduler.cron import parse_cron
+
+    # 09:00 Tokyo == 00:00 UTC (no DST in Asia/Tokyo)
+    now = datetime.datetime(2026, 3, 1, 22, 0, tzinfo=datetime.timezone.utc)
+    schedule = parse_cron("CRON_TZ=Asia/Tokyo 0 9 * * *")
+    nxt = schedule.next(now)
+    assert nxt.astimezone(datetime.timezone.utc) == datetime.datetime(
+        2026, 3, 2, 0, 0, tzinfo=datetime.timezone.utc
+    )
+    # TZ= spelling, and descriptors compose with the prefix: now is
+    # already Mar 2 07:00 in Tokyo, so the next Tokyo midnight is Mar 3
+    schedule = parse_cron("TZ=Asia/Tokyo @daily")
+    nxt = schedule.next(now)
+    assert nxt.astimezone(datetime.timezone.utc) == datetime.datetime(
+        2026, 3, 2, 15, 0, tzinfo=datetime.timezone.utc
+    )
+
+
+def test_tz_prefix_errors_and_every_passthrough():
+    import pytest as _pytest
+
+    from activemonitor_tpu.scheduler.cron import (
+        CronParseError,
+        EverySchedule,
+        parse_cron,
+    )
+
+    with _pytest.raises(CronParseError, match="unknown timezone"):
+        parse_cron("CRON_TZ=Not/AZone * * * * *")
+    with _pytest.raises(CronParseError, match="malformed timezone"):
+        parse_cron("TZ= * * * * *")
+    with _pytest.raises(CronParseError, match="malformed timezone"):
+        parse_cron("CRON_TZ=UTC")
+    # @every is a constant interval: the zone cannot matter
+    assert isinstance(parse_cron("TZ=Asia/Tokyo @every 90s"), EverySchedule)
+
+
+def test_tz_prefix_naive_after_is_treated_as_utc():
+    import datetime
+
+    from activemonitor_tpu.scheduler.cron import parse_cron
+
+    schedule = parse_cron("CRON_TZ=UTC 30 12 * * *")
+    nxt = schedule.next(datetime.datetime(2026, 5, 1, 12, 0))
+    assert (nxt.hour, nxt.minute) == (12, 30)
+
+
+def test_tz_prefix_rejects_stacking_and_naive_seconds_until_next():
+    import pytest as _pytest
+
+    from activemonitor_tpu.scheduler.cron import (
+        CronParseError,
+        parse_cron,
+        seconds_until_next,
+    )
+
+    with _pytest.raises(CronParseError, match="multiple timezone prefixes"):
+        parse_cron("TZ=UTC CRON_TZ=Asia/Tokyo 0 9 * * *")
+    # naive now works through the exported helper too
+    import datetime
+
+    delta = seconds_until_next(
+        "CRON_TZ=UTC 30 12 * * *", datetime.datetime(2026, 5, 1, 12, 0)
+    )
+    assert delta == 30 * 60 + 1
