@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--threshold", type=float, default=0.75)
+    p.add_argument(
+        "--dtype",
+        choices=("bf16", "int8"),
+        default="bf16",
+        help="MXU throughput mode (int8 is rated 2x bf16 on v5e+)",
+    )
 
     p = sub.add_parser(
         "ring-attention", help="sequence-parallel attention correctness + throughput"
@@ -203,7 +209,8 @@ def _dispatch(args) -> int:
         from activemonitor_tpu.probes import matmul
 
         result = matmul.run(
-            dim=args.dim, iters=args.iters, threshold=args.threshold
+            dim=args.dim, iters=args.iters, threshold=args.threshold,
+            dtype=args.dtype,
         )
     elif args.probe == "ring-attention":
         from activemonitor_tpu.probes import ring

@@ -7,6 +7,7 @@ Model" rooflines); every value can be overridden via environment
 variables for new silicon or corrected ratings:
 
     ACTIVEMONITOR_RATED_BF16_TFLOPS
+    ACTIVEMONITOR_RATED_INT8_TOPS
     ACTIVEMONITOR_RATED_HBM_GBPS
     ACTIVEMONITOR_RATED_ICI_GBPS   (per-link, one direction)
 """
@@ -25,14 +26,16 @@ class RatedSpec:
     hbm_gbps: float  # HBM bandwidth GB/s per chip
     ici_unidir_gbps: float  # ICI bandwidth per link, one direction, GB/s
     ici_links: int  # ICI links per chip
+    int8_tops: float = 0.0  # peak dense int8 matmul TOP/s per chip (0 = n/a)
 
 
 # device_kind substrings -> rated spec
 _RATED = [
-    ("v6", RatedSpec("v6e", bf16_tflops=918.0, hbm_gbps=1640.0, ici_unidir_gbps=90.0, ici_links=4)),
-    ("v5p", RatedSpec("v5p", bf16_tflops=459.0, hbm_gbps=2765.0, ici_unidir_gbps=90.0, ici_links=6)),
-    ("v5 lite", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4)),
-    ("v5e", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4)),
+    ("v6", RatedSpec("v6e", bf16_tflops=918.0, hbm_gbps=1640.0, ici_unidir_gbps=90.0, ici_links=4, int8_tops=1836.0)),
+    ("v5p", RatedSpec("v5p", bf16_tflops=459.0, hbm_gbps=2765.0, ici_unidir_gbps=90.0, ici_links=6, int8_tops=918.0)),
+    ("v5 lite", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0)),
+    ("v5e", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0)),
+    # v4 has no int8 MXU mode (int8 ships with v5)
     ("v4", RatedSpec("v4", bf16_tflops=275.0, hbm_gbps=1228.0, ici_unidir_gbps=45.0, ici_links=6)),
 ]
 
@@ -59,5 +62,6 @@ def rated_for(device_kind: str) -> Optional[RatedSpec]:
                 hbm_gbps=_override(spec.hbm_gbps, "ACTIVEMONITOR_RATED_HBM_GBPS"),
                 ici_unidir_gbps=_override(spec.ici_unidir_gbps, "ACTIVEMONITOR_RATED_ICI_GBPS"),
                 ici_links=spec.ici_links,
+                int8_tops=_override(spec.int8_tops, "ACTIVEMONITOR_RATED_INT8_TOPS"),
             )
     return None

@@ -435,3 +435,21 @@ def test_chain_delta_lengthens_chain_inside_noise_floor():
     sec = chain_delta_seconds(make_chain, k1=2, k2=6, iters=2)
     assert sec > 0
     assert max(calls) > 6  # the chain actually grew
+
+
+def test_matmul_int8_mode_on_cpu():
+    from activemonitor_tpu.probes import matmul
+
+    r = matmul.run(dim=256, iters=2, dtype="int8")
+    assert r.ok  # no rated comparison on cpu
+    names = {m.name for m in r.metrics}
+    assert "mxu-int8-matmul-tops" in names
+    assert "mxu-matmul-tflops" not in names
+    assert r.details["dtype"] == "int8"
+    with pytest.raises(ValueError, match="dtype"):
+        matmul.run(dim=128, dtype="fp8")
+
+
+def test_rated_int8_tops():
+    assert rated_for("TPU v5 lite").int8_tops == 394.0
+    assert rated_for("TPU v4").int8_tops == 0.0  # no int8 MXU mode on v4
