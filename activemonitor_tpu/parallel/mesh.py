@@ -49,11 +49,23 @@ def make_2d_mesh(
     devices: Optional[Sequence] = None,
     shape: Optional[Tuple[int, int]] = None,
 ) -> Mesh:
+    explicit_devices = devices is not None
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
         shape = best_2d_shape(len(devices))
     if shape[0] * shape[1] != len(devices):
         raise ValueError(f"mesh shape {shape} does not fit {len(devices)} devices")
+    if not explicit_devices and devices and devices[0].platform == "tpu":
+        # align logical axes with the physical torus: a naive id-order
+        # reshape interleaves torus rows/columns, so each logical-axis
+        # ring would traverse BOTH physical dimensions (and per-axis
+        # bandwidth probes could not localize a sick link direction)
+        try:
+            from jax.experimental import mesh_utils
+
+            return Mesh(mesh_utils.create_device_mesh(shape), axes)
+        except Exception:  # unknown topology: fall back to id order
+            pass
     return Mesh(np.array(devices).reshape(shape), axes)
 
 

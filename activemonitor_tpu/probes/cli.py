@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--size-mb", type=float, default=64.0)
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--threshold", type=float, default=0.8)
+    p.add_argument(
+        "--per-axis",
+        action="store_true",
+        help="measure each 2D-mesh axis separately (localizes which "
+        "torus direction is degraded)",
+    )
 
     p = sub.add_parser("compile-smoke", help="XLA compile smoke test")
     p.add_argument("--deadline", type=float, default=120.0)
@@ -175,9 +181,14 @@ def _dispatch(args) -> int:
     elif args.probe == "collectives":
         from activemonitor_tpu.probes import collectives
 
-        result = collectives.run(
-            size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
-        )
+        if args.per_axis:
+            result = collectives.run_per_axis(
+                size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
+            )
+        else:
+            result = collectives.run(
+                size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
+            )
     elif args.probe == "compile-smoke":
         from activemonitor_tpu.probes import compile_smoke
 

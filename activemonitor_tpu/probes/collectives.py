@@ -43,7 +43,7 @@ from activemonitor_tpu.parallel.collectives import (
     ppermute_ring_bandwidth,
     reduce_scatter_bandwidth,
 )
-from activemonitor_tpu.parallel.mesh import make_1d_mesh
+from activemonitor_tpu.parallel.mesh import make_1d_mesh, make_2d_mesh
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 
@@ -68,6 +68,104 @@ def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
     return 2 * unidir_gbps
 
 
+def _emit(
+    entries: List[Tuple[str, str, int, CollectiveResult]],
+    threshold: float,
+    context: str,
+    details: Dict,
+) -> ProbeResult:
+    """Shared emission scaffolding for the flat and per-axis sweeps.
+
+    ``entries``: (label, base_case, ring_n, result) — the label is the
+    metric suffix ("allreduce" or "allreduce-data"), the base case picks
+    the rated comparator, ring_n its ring size. ``context`` names the
+    measured surface in the summary."""
+    devices = jax.devices()
+    rated = rated_for(devices[0].device_kind)
+    on_tpu = devices[0].platform == "tpu"
+    metrics: List[ProbeMetric] = []
+    fractions: Dict[str, float] = {}
+    for label, base_case, ring_n, result in entries:
+        key = label.replace("-", "_")
+        metrics.append(
+            ProbeMetric(
+                f"collective-{label}-busbw-gbps",
+                result.busbw_gbps,
+                help=f"Measured {result.name} bus bandwidth (NCCL convention), GB/s",
+            )
+        )
+        details[f"{key}_busbw_gbps"] = round(result.busbw_gbps, 2)
+        if rated is not None and on_tpu:
+            rated_busbw = _rated_busbw(base_case, rated.ici_unidir_gbps, ring_n)
+            fraction = result.busbw_gbps / rated_busbw
+            fractions[label] = fraction
+            metrics.append(
+                ProbeMetric(
+                    f"collective-{label}-fraction-of-rated",
+                    fraction,
+                    help=f"{result.name} busbw / achievable ring ceiling",
+                )
+            )
+            details[f"{key}_fraction_of_rated"] = round(fraction, 3)
+
+    if fractions:
+        worst = min(fractions, key=fractions.get)
+        ok = fractions[worst] >= threshold
+        summary = (
+            f"{context}: worst {worst} at {fractions[worst]:.0%} of rated"
+            + ("" if ok else f" (< {threshold:.0%} threshold)")
+        )
+    else:
+        ok = True
+        best = max(entries, key=lambda e: e[3].busbw_gbps)
+        summary = (
+            f"{context}: best {best[0]} {best[3].busbw_gbps:.1f} GB/s "
+            "(no rated comparison)"
+        )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+
+
+def run_per_axis(
+    size_mb: float = 64.0,
+    iters: int = 5,
+    threshold: float = 0.8,
+) -> ProbeResult:
+    """Per-axis variant over the 2D mesh: all-reduce and single-hop
+    ppermute restricted to EACH mesh axis. The mesh is built with
+    physical-topology alignment (parallel/mesh.make_2d_mesh uses
+    mesh_utils.create_device_mesh on TPU), so on a real slice the two
+    axes ride different torus dimensions and a degradation confined to
+    one link direction shows up as one axis's fraction dropping while
+    the other stays healthy — `collectives` alone can only say "slow",
+    this says "slow WHERE"."""
+    devices = jax.devices()
+    n = len(devices)
+    if n < 4:
+        return ProbeResult(
+            ok=True,
+            summary=f"per-axis sweep skipped: {n} device(s), no 2D mesh",
+            metrics=[],
+            details={"devices": n, "skipped": True},
+        )
+    mesh = make_2d_mesh()
+    entries = [
+        (f"{name}-{axis}", name, mesh.shape[axis],
+         bench(mesh, size_mb=size_mb, iters=iters, axis=axis))
+        for axis in mesh.axis_names
+        if mesh.shape[axis] >= 2  # nothing to move along a singleton axis
+        for name, bench in (("allreduce", all_reduce_bandwidth),
+                            ("ringhop", ppermute_ring_bandwidth))
+    ]
+    details = {
+        "devices": n,
+        "device_kind": devices[0].device_kind,
+        "mesh": dict(mesh.shape),
+    }
+    return _emit(
+        entries, threshold, f"per-axis sweep over mesh {dict(mesh.shape)}", details
+    )
+
+
 def run(
     size_mb: float = 64.0,
     iters: int = 5,
@@ -89,50 +187,11 @@ def run(
         )
 
     mesh = make_1d_mesh()
-    results: List[Tuple[str, CollectiveResult]] = [
-        (name, _BENCH[name](mesh, size_mb=size_mb, iters=iters)) for name in cases
+    entries = [
+        (name, name, n, _BENCH[name](mesh, size_mb=size_mb, iters=iters))
+        for name in cases
     ]
-    rated = rated_for(devices[0].device_kind)
-    on_tpu = devices[0].platform == "tpu"
-
-    metrics: List[ProbeMetric] = []
-    details: Dict = {"devices": n, "device_kind": devices[0].device_kind}
-    fractions: Dict[str, float] = {}
-    for name, result in results:
-        metrics.append(
-            ProbeMetric(
-                f"collective-{name}-busbw-gbps",
-                result.busbw_gbps,
-                help=f"Measured {result.name} bus bandwidth (NCCL convention), GB/s",
-            )
-        )
-        details[f"{name}_busbw_gbps"] = round(result.busbw_gbps, 2)
-        if rated is not None and on_tpu:
-            rated_busbw = _rated_busbw(name, rated.ici_unidir_gbps, n)
-            fraction = result.busbw_gbps / rated_busbw
-            fractions[name] = fraction
-            metrics.append(
-                ProbeMetric(
-                    f"collective-{name}-fraction-of-rated",
-                    fraction,
-                    help=f"{result.name} busbw / achievable ring ceiling",
-                )
-            )
-            details[f"{name}_fraction_of_rated"] = round(fraction, 3)
-
-    if fractions:
-        worst = min(fractions, key=fractions.get)
-        ok = fractions[worst] >= threshold
-        summary = (
-            f"{len(results)} collectives over {n}x {rated.generation}: worst "
-            f"{worst} at {fractions[worst]:.0%} of rated"
-            + ("" if ok else f" (< {threshold:.0%} threshold)")
-        )
-    else:
-        ok = True
-        best = max(results, key=lambda nr: nr[1].busbw_gbps)
-        summary = (
-            f"{len(results)} collectives over {n} device(s): best {best[0]} "
-            f"{best[1].busbw_gbps:.1f} GB/s (no rated comparison)"
-        )
-    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    details = {"devices": n, "device_kind": devices[0].device_kind}
+    return _emit(
+        entries, threshold, f"{len(entries)} collectives over {n} device(s)", details
+    )

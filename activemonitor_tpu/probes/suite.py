@@ -93,11 +93,10 @@ def run(
     else:
         add("matmul", lambda: matmul.run(iters=iters))
         # the MXU's other throughput mode (v5e+); v4/unknown chips
-        # degrade to an informational pass inside the probe
-        add(
-            "matmul-int8",
-            lambda: matmul.run(dims=(4096,), iters=iters, dtype="int8"),
-        )
+        # degrade to an informational pass inside the probe. Same full
+        # dim sweep as bf16: which dim the compiler tiles best varies,
+        # and a single pinned dim could fail a healthy chip
+        add("matmul-int8", lambda: matmul.run(iters=iters, dtype="int8"))
     add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
     add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
     from activemonitor_tpu.probes import collectives as collectives_probe

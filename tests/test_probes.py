@@ -453,3 +453,18 @@ def test_matmul_int8_mode_on_cpu():
 def test_rated_int8_tops():
     assert rated_for("TPU v5 lite").int8_tops == 394.0
     assert rated_for("TPU v4").int8_tops == 0.0  # no int8 MXU mode on v4
+
+
+def test_collectives_per_axis_on_cpu_mesh():
+    r = collectives_probe.run_per_axis(size_mb=0.5, iters=2)
+    assert r.ok
+    assert r.details["mesh"] == {"data": 2, "model": 4}
+    names = {m.name for m in r.metrics}
+    assert names == {
+        "collective-allreduce-data-busbw-gbps",
+        "collective-ringhop-data-busbw-gbps",
+        "collective-allreduce-model-busbw-gbps",
+        "collective-ringhop-model-busbw-gbps",
+    }
+    # each axis reports a positive number; no cross-axis name collision
+    assert all(m.value > 0 for m in r.metrics)
