@@ -112,7 +112,8 @@ def _emit(
         worst = min(fractions, key=fractions.get)
         ok = fractions[worst] >= threshold
         summary = (
-            f"{context}: worst {worst} at {fractions[worst]:.0%} of rated"
+            f"{context}: worst {worst} at {fractions[worst]:.0%} of "
+            f"rated {rated.generation}"
             + ("" if ok else f" (< {threshold:.0%} threshold)")
         )
     else:

@@ -58,7 +58,8 @@ def _device_reachable() -> bool:
 
 def main() -> int:
     # known-CPU runs have no tunnel to hang on — skip the probe cost
-    if os.environ.get("JAX_PLATFORMS") != "cpu" and not _device_reachable():
+    want_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+    if not want_cpu and not _device_reachable():
         print("falling back to the virtual CPU mesh", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
@@ -66,10 +67,13 @@ def main() -> int:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")  # outranks plugin env
+        want_cpu = True
     import jax
+
+    if want_cpu:
+        # site customizations (e.g. an accelerator plugin on PYTHONPATH)
+        # can override the env var; the config API outranks them
+        jax.config.update("jax_platforms", "cpu")
 
     devices = jax.devices()
     n = len(devices)
