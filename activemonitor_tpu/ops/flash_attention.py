@@ -1,0 +1,192 @@
+"""Fused flash attention (Pallas) — single-chip attention hot op.
+
+A fused online-softmax attention kernel: for each Q block the kernel
+sweeps K/V blocks, keeping the running max/denominator and the output
+accumulator in VMEM scratch — the [S, S] score matrix is never
+materialized in HBM. This is the op the decode/ring/training probes
+lean on XLA fusion for; owning the schedule buys two things XLA cannot
+guarantee:
+
+- scores live entirely in VMEM (HBM traffic is O(S·D), not O(S²)), so
+  long sequences stay bandwidth-feasible on one chip;
+- causal blocks strictly above the diagonal are skipped inside the
+  kernel (``pl.when``), so the dead half of the causal grid costs no
+  MXU time.
+
+On non-TPU platforms the kernel runs in interpret mode (functionally
+identical, slow) so the same code path is exercised by the CPU test
+suite — mirrors ops/stream.py.
+
+The grid is (batch, heads, q_blocks, k_blocks) with the K sweep
+innermost: TPU grids execute sequentially, so VMEM scratch carries the
+online-softmax state across K iterations of one Q block, and the output
+block is written once, at each Q row's last visible K block.
+
+Complements ops/ring_attention.py: ring attention shards the sequence
+ACROSS chips (ICI traffic, sequence parallelism); flash attention fuses
+the per-chip block compute. Reference has no analogue (active-monitor
+is a Go controller; this is part of the TPU probe library built per
+SURVEY.md §5.7-5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+# lane width of the m/l scratch rows; TPU vregs are (8, 128) so scalars
+# carried per Q row live broadcast across one 128-lane vector
+_LANES = 128
+
+
+def _make_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        # causal: K blocks strictly after this Q block's last row have
+        # nothing to attend — skip the matmuls entirely
+        q_last = qi * block_q + block_q - 1
+        visible = (ki * block_k <= q_last) if causal else (ki >= 0)
+
+        @pl.when(visible)
+        def _attend():
+            q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+            k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+            v = v_ref[0, 0].astype(jnp.float32)
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [block_q, block_k]
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+            m_prev = m_ref[:]  # [block_q, LANES] (broadcast rows)
+            l_prev = l_ref[:]
+            m_curr = jnp.max(s, axis=1)[:, None]  # [block_q, 1]
+            m_next = jnp.maximum(m_prev, m_curr)  # [block_q, LANES]
+            # rows fully masked so far have m_next == NEG_INF; shifting
+            # by it would make exp(NEG_INF - NEG_INF)=1 for masked
+            # entries, so clamp the shift (the row's p is 0 either way)
+            shift = jnp.maximum(m_next[:, :1], _NEG_INF / 2)
+            p = jnp.exp(s - shift)  # [block_q, block_k]
+            if causal:
+                p = jnp.where(q_pos >= k_pos, p, 0.0)
+            alpha = jnp.exp(m_prev - jnp.maximum(m_next, _NEG_INF / 2))
+            l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
+            m_ref[:] = m_next
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [block_q, D]
+            acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+
+        # write the output once, at this Q block's last visible K block
+        last_visible = (q_last // block_k) if causal else (num_k - 1)
+
+        @pl.when(ki == last_visible)
+        def _finalize():
+            o_ref[0, 0] = (
+                acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+            ).astype(o_ref.dtype)
+
+    return kernel
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    layout: str = "bshd",
+) -> jax.Array:
+    """Fused attention. ``layout="bshd"`` takes ``[batch, seq, heads,
+    head_dim]`` (what ops/ring_attention.py uses) and transposes to the
+    kernel's native ``[batch, heads, seq, head_dim]``; pass
+    ``layout="bhsd"`` when the caller already keeps heads-major arrays
+    to skip the transpose passes (3 HBM round-trips per call).
+    Sequence length must be divisible by the block sizes (blocks are
+    clamped to seq).
+
+    Default blocks are the measured optimum on v5e (bq=bk=1024:
+    ~90 TFLOP/s causal at S=4096, ~4-5x the unfused XLA attention on
+    the same chip; bigger blocks exceed the 16 MB scoped-VMEM limit)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if layout == "bshd":
+        batch, seq, heads, head_dim = q.shape
+    elif layout == "bhsd":
+        batch, heads, seq, head_dim = q.shape
+    else:
+        raise ValueError(f"layout must be bshd or bhsd, got {layout!r}")
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    if seq % block_q or seq % block_k:
+        raise ValueError(
+            f"seq {seq} not divisible by blocks ({block_q}, {block_k})"
+        )
+    num_q, num_k = seq // block_q, seq // block_k
+    scale = 1.0 / (head_dim ** 0.5)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    # [B, S, H, D] -> [B, H, S, D]: the kernel tiles the last two dims
+    # (seq-block × head_dim), which is the MXU-friendly layout
+    if layout == "bshd":
+        qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    else:
+        qt, kt, vt = q, k, v
+
+    kernel = _make_kernel(causal, block_q, block_k, num_k, scale)
+    spec_q = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
+    )
+    spec_kv = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        grid=(batch, heads, num_q, num_k),
+        in_specs=[spec_q, spec_kv, spec_kv],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2) if layout == "bshd" else out
+
+
+def attention_flops(batch: int, seq: int, heads: int, head_dim: int, causal: bool) -> float:
+    """Model FLOPs for one attention forward (QK^T + PV matmuls)."""
+    pairs = seq * (seq + 1) / 2 if causal else float(seq * seq)
+    return 4.0 * head_dim * batch * heads * pairs

@@ -110,6 +110,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--iters", type=int, default=5)
 
+    p = sub.add_parser(
+        "flash-attention", help="fused attention kernel correctness + throughput"
+    )
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=4096)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--no-causal", action="store_true")
+
     p = sub.add_parser("decode", help="KV-cache decode-step latency + consistency")
     p.add_argument("--tiny", action="store_true")
     p.add_argument("--batch", type=int, default=8)
@@ -232,6 +242,17 @@ def _dispatch(args) -> int:
             heads=args.heads,
             head_dim=args.head_dim,
             iters=args.iters,
+        )
+    elif args.probe == "flash-attention":
+        from activemonitor_tpu.probes import flash
+
+        result = flash.run(
+            batch=args.batch,
+            seq=args.seq,
+            heads=args.heads,
+            head_dim=args.head_dim,
+            iters=args.iters,
+            causal=not args.no_causal,
         )
     elif args.probe == "decode":
         from activemonitor_tpu.probes import decode

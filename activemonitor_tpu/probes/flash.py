@@ -1,0 +1,153 @@
+"""Flash-attention probe — fused single-chip attention health + perf.
+
+Two verdicts in one probe (the single-chip sibling of the ring probe):
+
+1. correctness — the Pallas fused kernel (ops/flash_attention.py) must
+   match unfused reference attention; a mismatch means the Mosaic
+   compile or the chip's MXU/VPU path is producing wrong numbers;
+2. throughput — achieved attention TFLOP/s of the fused kernel, with
+   the unfused XLA attention timed alongside as the speedup baseline.
+   A fused/unfused ratio collapsing toward 1 means the kernel stopped
+   being fused (toolchain regression) long before absolute numbers
+   drift.
+
+Off-TPU the kernel runs in interpret mode: correctness is still checked
+(same code path) but timing falls back to the XLA expression, mirroring
+the HBM probe's policy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.ops.flash_attention import attention_flops, flash_attention
+from activemonitor_tpu.ops.ring_attention import reference_attention
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+
+def run(
+    batch: int = 4,
+    seq: int = 4096,
+    heads: int = 8,
+    head_dim: int = 128,
+    iters: int = 5,
+    causal: bool = True,
+    tolerance: float = 2e-2,
+) -> ProbeResult:
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if not on_tpu and seq > 512:
+        seq = 512  # interpret-mode correctness is O(minutes) beyond this
+    dtype = jnp.bfloat16
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (batch, seq, heads, head_dim), dtype) for kk in keys
+    )
+
+    # correctness on a small slice (unfused reference materializes the
+    # [S, S] scores — keep it tractable); block sizes forced small so
+    # the online-softmax accumulation really iterates
+    small = min(seq, 512)
+    got = flash_attention(
+        q[:, :small], k[:, :small], v[:, :small],
+        causal=causal, block_q=128, block_k=128,
+    )
+    want = reference_attention(q[:, :small], k[:, :small], v[:, :small], causal=causal)
+    max_err = float(
+        jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)))
+    )
+    correct = max_err <= tolerance
+
+    def make_chain(op):
+        def factory(kreps):
+            @jax.jit
+            def chain(q, k, v):
+                x = q
+                for _ in range(kreps):  # data-dependent: output feeds next Q
+                    x = op(x, k, v)
+                return x.astype(jnp.float32).sum()
+
+            return chain
+
+        return factory
+
+    flops = attention_flops(batch, seq, heads, head_dim, causal)
+    fused = lambda q, k, v: flash_attention(q, k, v, causal=causal)
+    unfused = lambda q, k, v: reference_attention(q, k, v, causal=causal)
+    per_variant = {}
+    if on_tpu:
+        per_variant["flash"] = flops / chain_delta_seconds(
+            make_chain(fused), q, k, v, k1=2, k2=6, iters=iters
+        ) / 1e12
+    per_variant["xla"] = flops / chain_delta_seconds(
+        make_chain(unfused), q, k, v, k1=2, k2=6, iters=iters
+    ) / 1e12
+    # the headline gauge is the FUSED kernel's own throughput — a fused
+    # regression below the XLA baseline must show in the gauge, not be
+    # papered over by a max(); off-TPU (interpret mode not timeable)
+    # the XLA timing stands in, flagged via details["kernel"]
+    kernel = "flash" if "flash" in per_variant else "xla"
+    tflops = per_variant[kernel]
+
+    metrics = [
+        ProbeMetric(
+            "flash-attention-max-error",
+            max_err,
+            help="Max abs error of fused vs unfused attention",
+        ),
+        ProbeMetric(
+            "flash-attention-tflops",
+            tflops,
+            help="Achieved fused attention TFLOP/s",
+        ),
+    ]
+    details = {
+        "batch": batch,
+        "seq": seq,
+        "heads": heads,
+        "head_dim": head_dim,
+        "causal": causal,
+        "max_error": max_err,
+        "kernel": kernel,
+        "per_variant_tflops": {k: round(v, 1) for k, v in per_variant.items()},
+        "device_kind": device.device_kind,
+    }
+    ok = correct
+    if "flash" in per_variant and "xla" in per_variant:
+        speedup = per_variant["flash"] / per_variant["xla"]
+        metrics.append(
+            ProbeMetric(
+                "flash-attention-speedup",
+                speedup,
+                help="Fused kernel throughput / unfused XLA attention",
+            )
+        )
+        details["speedup"] = round(speedup, 2)
+    rated = rated_for(device.device_kind)
+    if rated is not None and on_tpu:
+        fraction = tflops / rated.bf16_tflops
+        metrics.append(
+            ProbeMetric(
+                "flash-attention-fraction-of-rated",
+                fraction,
+                help="Achieved attention TFLOP/s / rated bf16 peak",
+            )
+        )
+        details["rated_tflops"] = rated.bf16_tflops
+        details["fraction"] = round(fraction, 3)
+        summary = (
+            f"flash attention err {max_err:.1e} "
+            f"({'OK' if correct else 'MISMATCH'}), {tflops:.0f} TFLOP/s "
+            f"= {fraction:.0%} of rated"
+            + (f", {details['speedup']}x vs unfused" if "speedup" in details else "")
+        )
+    else:
+        summary = (
+            f"flash attention err {max_err:.1e} "
+            f"({'OK' if correct else 'MISMATCH'}) on {device.platform} "
+            f"(timing via {kernel})"
+        )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

@@ -115,6 +115,12 @@ def run(
         "ring-attention",
         lambda: ring.run(seq_per_device=256 if quick else 1024, iters=iters),
     )
+    from activemonitor_tpu.probes import flash
+
+    add(
+        "flash-attention",
+        lambda: flash.run(seq=1024 if quick else 4096, iters=iters),
+    )
     add(
         "training-step",
         lambda: training_step.run(tiny=quick, batch_per_device=4, seq=64),
