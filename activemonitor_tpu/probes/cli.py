@@ -131,6 +131,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-gb", type=float, default=1.0)
 
     p = sub.add_parser(
+        "straggler", help="per-device timing/numerics spread — find the sick chip"
+    )
+    p.add_argument("--dim", type=int, default=0, help="matmul dim (0 = auto)")
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=1.25,
+        help="flag devices slower than this multiple of the median",
+    )
+
+    p = sub.add_parser("transfer", help="host<->device bandwidth (data-feed path)")
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--min-gbps",
+        type=float,
+        default=0.0,
+        help="fail below this bandwidth in either direction (0 = informational)",
+    )
+
+    p = sub.add_parser(
         "dcn-allreduce", help="cross-host all-reduce bandwidth + correctness"
     )
     p.add_argument("--size-mb", type=float, default=16.0)
@@ -268,6 +290,18 @@ def _dispatch(args) -> int:
         from activemonitor_tpu.probes import memory
 
         result = memory.run(probe_gb=args.probe_gb)
+    elif args.probe == "straggler":
+        from activemonitor_tpu.probes import straggler
+
+        result = straggler.run(
+            dim=args.dim, iters=args.iters, threshold=args.threshold
+        )
+    elif args.probe == "transfer":
+        from activemonitor_tpu.probes import transfer
+
+        result = transfer.run(
+            size_mb=args.size_mb, iters=args.iters, min_gbps=args.min_gbps
+        )
     elif args.probe == "dcn-allreduce":
         from activemonitor_tpu.probes import dcn
 

@@ -129,6 +129,13 @@ def run(
         "decode",
         lambda: decode.run(tiny=quick, batch=4, prompt_len=8, iters=iters),
     )
+    from activemonitor_tpu.probes import straggler, transfer
+
+    add(
+        "straggler",
+        lambda: straggler.run(dim=1024 if quick else 0, iters=iters),
+    )
+    add("transfer", lambda: transfer.run(size_mb=16 if quick else 64, iters=iters))
     from activemonitor_tpu.probes import dcn
 
     # informational pass on single-process runs; real coverage on
