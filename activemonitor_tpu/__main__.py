@@ -301,6 +301,9 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         # otherwise bound sockets stay held
         stop_wait.cancel()
         await manager.stop()
+        closer = getattr(engine, "close", None)
+        if closer is not None:
+            await closer()  # stop workflow watch streams
     return 1 if lost_leadership else 0
 
 

@@ -262,6 +262,51 @@ class HealthCheckReconciler:
         self.recorder.event(hc, EVENT_NORMAL, "Normal", "Successfully created workflow")
         return wf_name
 
+    async def _pace_poll(
+        self, ieb: InverseExpBackoff, wf_namespace: str, wf_name: str
+    ) -> bool:
+        """One backoff step between status polls. Engines exposing
+        ``wait_change`` (the Argo engine's watch-backed cache) wake the
+        loop the moment the workflow object changes instead of sleeping
+        out the whole delay — detection becomes event-driven with the
+        inverse-exp cadence as the fallback bound. The change-wait races
+        the pacing sleep on ``self.clock``, so fake-clock tests drive
+        time exactly as with poll-only engines. Returns False once the
+        poll deadline has passed (caller synthesizes failure)."""
+        waiter = getattr(self.engine, "wait_change", None)
+        if waiter is None:
+            return await ieb.next()
+        if ieb.expired():
+            return False
+        sleep_task = asyncio.ensure_future(self.clock.sleep(ieb.advance()))
+        wake_task = asyncio.ensure_future(waiter(wf_namespace, wf_name))
+        try:
+            await asyncio.wait(
+                {sleep_task, wake_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if (
+                wake_task.done()
+                and not wake_task.cancelled()
+                and wake_task.exception() is not None
+                and not sleep_task.done()
+            ):
+                # a raising wait_change must not turn into an unpaced
+                # hot poll loop: log it and let the backoff sleep pace
+                log.warning(
+                    "wait_change for %s/%s failed (%r); falling back to "
+                    "timed polling for this step",
+                    wf_namespace,
+                    wf_name,
+                    wake_task.exception(),
+                )
+                await sleep_task
+        finally:
+            for task in (sleep_task, wake_task):
+                if not task.done():
+                    task.cancel()
+            await asyncio.gather(sleep_task, wake_task, return_exceptions=True)
+        return True
+
     def _watch_active(self, key: str) -> bool:
         t = self._watch_tasks.get(key)
         return t is not None and not t.done()
@@ -337,7 +382,14 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
-            workflow = await self.engine.get(wf_namespace, wf_name)
+            if timed_out:
+                # the deadline verdict must come from the API server,
+                # not a possibly-lagging watch cache: a terminal phase
+                # that landed during a watch reconnect gap must win
+                getter = getattr(self.engine, "get_fresh", self.engine.get)
+                workflow = await getter(wf_namespace, wf_name)
+            else:
+                workflow = await self.engine.get(wf_namespace, wf_name)
             if workflow is None:
                 # workflow GC'd / healthcheck deleted: swallow, no reschedule
                 # (reference: :618-623)
@@ -410,7 +462,7 @@ class HealthCheckReconciler:
                 await self._maybe_run_remedy(hc)
                 break
 
-            if not await ieb.next():
+            if not await self._pace_poll(ieb, wf_namespace, wf_name):
                 timed_out = True
 
         # status write + reschedule (reference: :732-755)
@@ -576,7 +628,14 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
-            workflow = await self.engine.get(wf_namespace, wf_name)
+            if timed_out:
+                # the deadline verdict must come from the API server,
+                # not a possibly-lagging watch cache: a terminal phase
+                # that landed during a watch reconnect gap must win
+                getter = getattr(self.engine, "get_fresh", self.engine.get)
+                workflow = await getter(wf_namespace, wf_name)
+            else:
+                workflow = await self.engine.get(wf_namespace, wf_name)
             if workflow is None:
                 return  # parent deleted / GC'd (reference: :806-810)
             status = workflow.get("status") or {}
@@ -632,7 +691,7 @@ class HealthCheckReconciler:
                 self.metrics.record_custom_metrics(hc.metadata.name, status)
                 break
 
-            if not await ieb.next():
+            if not await self._pace_poll(ieb, wf_namespace, wf_name):
                 timed_out = True
 
         if hc.metadata.deletion_timestamp is None:

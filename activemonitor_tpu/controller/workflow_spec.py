@@ -27,12 +27,13 @@ import yaml
 
 from activemonitor_tpu import API_VERSION, KIND
 from activemonitor_tpu.api.types import HealthCheck
-from activemonitor_tpu.engine.base import WF_API_VERSION, WF_KIND
+from activemonitor_tpu.engine.base import (
+    WF_API_VERSION,
+    WF_INSTANCE_ID,
+    WF_INSTANCE_ID_LABEL_KEY,
+    WF_KIND,
+)
 from activemonitor_tpu.store import get_artifact_reader
-
-# reference: healthcheck_controller.go:64-66
-WF_INSTANCE_ID_LABEL_KEY = "workflows.argoproj.io/controller-instanceid"
-WF_INSTANCE_ID = "activemonitor-workflows"
 POD_GC_ON_POD_COMPLETION = "OnPodCompletion"
 
 

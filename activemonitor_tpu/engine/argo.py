@@ -3,33 +3,206 @@
 Capability-parity backend for cluster deployments
 (reference: healthcheck_controller.go:502-534 create, :617 dynamic-client
 poll), on the framework's own REST layer — the Argo controller is an
-external process; this engine only creates Workflow objects and polls
+external process; this engine only creates Workflow objects and reads
 ``status.phase``, exactly the process boundary the reference keeps.
+
+Divergence (improvement) from the reference's poll-only design: the
+engine maintains a **watch-backed cache** per namespace (the informer
+pattern controller-runtime uses for the HealthCheck objects themselves
+but the reference never applies to Workflows). One WATCH stream per
+namespace replaces O(checks × polls) GETs, and
+:meth:`ArgoWorkflowEngine.wait_change` lets the reconciler's poll loop
+wake the moment the Argo controller writes a terminal phase instead of
+sleeping out its backoff delay — completion latency becomes
+event-driven while the inverse-exp poll cadence remains as the upper
+bound. The cache degrades transparently: a miss or an unhealthy watch
+falls back to a direct GET, so a broken watch path can slow detection
+but never change behavior.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import asyncio
+import copy
+import logging
+from typing import Dict, Optional, Tuple
 
+from activemonitor_tpu.engine.base import WF_INSTANCE_ID, WF_INSTANCE_ID_LABEL_KEY
 from activemonitor_tpu.kube import ApiError, KubeApi, api_path
 
 WF_GROUP = "argoproj.io"
 WF_VERSION = "v1alpha1"
 WF_PLURAL = "workflows"
 
+# the cache only tracks THIS controller's workflows (the instance-id
+# label every submitted spec carries) — a shared Argo namespace full of
+# foreign workflows must not be mirrored into controller memory
+WF_WATCH_SELECTOR = f"{WF_INSTANCE_ID_LABEL_KEY}={WF_INSTANCE_ID}"
+
+log = logging.getLogger("activemonitor.engine")
+
+
+class _NamespaceWatch:
+    """One namespace's workflow watch: list-then-watch with reconnect
+    and 410 re-list, feeding a local cache and a change condition."""
+
+    def __init__(self, api: KubeApi, namespace: str):
+        self._api = api
+        self._namespace = namespace
+        self._cache: Dict[str, dict] = {}
+        self._healthy = False
+        self._task: Optional[asyncio.Task] = None
+        self.changed = asyncio.Condition()
+
+    @property
+    def healthy(self) -> bool:
+        return self._healthy
+
+    def lookup(self, name: str) -> Optional[dict]:
+        """Cached object, or None on a miss (caller falls back to GET —
+        a miss can be a not-yet-observed create just as well as a
+        deletion, so the cache never asserts absence)."""
+        obj = self._cache.get(name)
+        return copy.deepcopy(obj) if obj is not None else None
+
+    def rv(self, name: str) -> Optional[str]:
+        """resourceVersion without the deepcopy lookup() pays — change
+        predicates compare this one string per notification."""
+        obj = self._cache.get(name)
+        if obj is None:
+            return None
+        return obj.get("metadata", {}).get("resourceVersion")
+
+    def ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(
+                self._run(), name=f"wfwatch:{self._namespace}"
+            )
+
+    async def stop(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                current = asyncio.current_task()
+                if current is not None and current.cancelling():
+                    raise  # the CALLER is being cancelled — propagate
+            except Exception:
+                pass
+
+    async def _notify(self) -> None:
+        async with self.changed:
+            self.changed.notify_all()
+
+    async def _run(self) -> None:
+        path = api_path(WF_GROUP, WF_VERSION, WF_PLURAL, self._namespace)
+        resource_version = ""
+        while True:
+            try:
+                if not resource_version:
+                    listing = await self._api.get(
+                        path, params={"labelSelector": WF_WATCH_SELECTOR}
+                    )
+                    self._cache = {
+                        o["metadata"]["name"]: o
+                        for o in listing.get("items", [])
+                    }
+                    resource_version = listing.get("metadata", {}).get(
+                        "resourceVersion", ""
+                    )
+                    self._healthy = True
+                    await self._notify()
+                async for event in self._api.watch(
+                    path,
+                    resource_version=resource_version,
+                    label_selector=WF_WATCH_SELECTOR,
+                ):
+                    obj = event.get("object", {}) or {}
+                    rv = obj.get("metadata", {}).get("resourceVersion", "")
+                    if rv:
+                        resource_version = rv
+                    etype = event.get("type")
+                    if etype == "BOOKMARK":
+                        continue
+                    name = obj.get("metadata", {}).get("name", "")
+                    if not name:
+                        continue
+                    if etype == "DELETED":
+                        self._cache.pop(name, None)
+                    else:
+                        self._cache[name] = obj
+                    await self._notify()
+                # server closed the stream (timeout): reconnect from the
+                # last seen resourceVersion, cache stays warm
+            except asyncio.CancelledError:
+                raise
+            except ApiError as e:
+                if e.status == 410:
+                    # history expired: full re-list, cache rebuilt
+                    resource_version = ""
+                    continue
+                self._healthy = False
+                await self._notify()
+                log.warning(
+                    "workflow watch for %s degraded (%s); retrying in 1s",
+                    self._namespace,
+                    e,
+                )
+                await asyncio.sleep(1.0)
+                resource_version = ""
+            except Exception as e:
+                self._healthy = False
+                await self._notify()
+                log.warning(
+                    "workflow watch for %s failed (%r); retrying in 1s",
+                    self._namespace,
+                    e,
+                )
+                await asyncio.sleep(1.0)
+                resource_version = ""
+
 
 class ArgoWorkflowEngine:
-    def __init__(self, api: Optional[KubeApi] = None):
+    def __init__(self, api: Optional[KubeApi] = None, watch: bool = True):
         self._api = api if api is not None else KubeApi.from_default_config()
+        self._watch_enabled = watch
+        self._watches: Dict[str, _NamespaceWatch] = {}
+
+    def _watch_for(self, namespace: str) -> Optional[_NamespaceWatch]:
+        if not self._watch_enabled:
+            return None
+        watch = self._watches.get(namespace)
+        if watch is None:
+            watch = _NamespaceWatch(self._api, namespace)
+            self._watches[namespace] = watch
+        watch.ensure_started()
+        return watch
 
     async def submit(self, manifest: dict) -> str:
         namespace = manifest.get("metadata", {}).get("namespace", "default")
         created = await self._api.create(
             api_path(WF_GROUP, WF_VERSION, WF_PLURAL, namespace), manifest
         )
+        # start the namespace watch alongside the first submission so it
+        # is warm by the time the status loop starts reading
+        self._watch_for(namespace)
         return created["metadata"]["name"]
 
     async def get(self, namespace: str, name: str) -> Optional[dict]:
+        watch = self._watch_for(namespace)
+        if watch is not None and watch.healthy:
+            cached = watch.lookup(name)
+            if cached is not None:
+                return cached
+            # miss: not-yet-observed create or a deletion — ask directly
+        return await self.get_fresh(namespace, name)
+
+    async def get_fresh(self, namespace: str, name: str) -> Optional[dict]:
+        """Authoritative direct GET, bypassing the cache — the final
+        poll after a timeout must judge the workflow on what the API
+        server says NOW, not on a possibly-lagging cache (a Succeeded
+        that landed during a watch reconnect gap must win)."""
         try:
             return await self._api.get(
                 api_path(WF_GROUP, WF_VERSION, WF_PLURAL, namespace, name)
@@ -38,3 +211,31 @@ class ArgoWorkflowEngine:
             if e.not_found:
                 return None
             raise
+
+    async def wait_change(self, namespace: str, name: str) -> None:
+        """Block until the named workflow (or the watch's health)
+        changes. No internal timeout: the caller races this against its
+        own pacing sleep (the reconciler races it with clock.sleep so
+        fake-clock tests keep driving time), cancelling the loser. With
+        the watch disabled this never completes — the pacing sleep
+        governs, preserving pure poll behavior."""
+        watch = self._watch_for(namespace)
+        if watch is None:
+            await asyncio.Event().wait()  # pragma: no cover - never set
+            return
+        healthy0 = watch.healthy
+        before_rv = watch.rv(name) if healthy0 else None
+
+        def _changed() -> bool:
+            if watch.healthy != healthy0:
+                return True  # health flip: caller should re-poll directly
+            if not watch.healthy:
+                return False  # stay blocked while down; the sleep paces
+            return watch.rv(name) != before_rv
+
+        async with watch.changed:
+            await watch.changed.wait_for(_changed)
+
+    async def close(self) -> None:
+        for watch in self._watches.values():
+            await watch.stop()

@@ -24,6 +24,12 @@ from typing import Optional, Protocol
 WF_API_VERSION = "argoproj.io/v1alpha1"
 WF_KIND = "Workflow"
 
+# instance-id label contract every submitted workflow carries
+# (reference: healthcheck_controller.go:64-65); also scopes the Argo
+# engine's watch cache to this controller's workflows
+WF_INSTANCE_ID_LABEL_KEY = "workflows.argoproj.io/controller-instanceid"
+WF_INSTANCE_ID = "activemonitor-workflows"
+
 PHASE_SUCCEEDED = "Succeeded"
 PHASE_FAILED = "Failed"
 PHASE_RUNNING = "Running"

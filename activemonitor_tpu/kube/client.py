@@ -211,6 +211,7 @@ class KubeApi:
         *,
         resource_version: str = "",
         timeout_seconds: int = 300,
+        label_selector: str = "",
     ) -> AsyncIterator[dict]:
         """One watch connection: yields ``{"type": ..., "object": ...}``
         events until the server closes the stream (or ``timeout_seconds``
@@ -228,6 +229,8 @@ class KubeApi:
         }
         if resource_version:
             params["resourceVersion"] = resource_version
+        if label_selector:
+            params["labelSelector"] = label_selector
         async with session.get(
             self._url(path),
             params=params,

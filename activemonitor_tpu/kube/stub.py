@@ -25,6 +25,22 @@ from typing import Dict, List, Tuple
 Key = Tuple[str, str, str]  # (group, version, plural); core v1 -> ("", "v1", ...)
 
 
+def _match_selector(obj: dict, selector: str) -> bool:
+    """Equality-based labelSelector (``k=v,k2=v2``) — the subset the
+    framework's clients use."""
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for clause in selector.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        k, _, v = clause.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
 def merge_patch(target, patch):
     """RFC 7386 JSON merge patch."""
     if not isinstance(patch, dict):
@@ -67,8 +83,12 @@ class StubApiServer:
         event = {"type": type_, "object": copy.deepcopy(obj)}
         self._history.append((self._rv, key, namespace, event))
         del self._history[:-1000]
-        for wkey, wns, queue in self._watchers:
-            if wkey == key and (not wns or wns == namespace):
+        for wkey, wns, selector, queue in self._watchers:
+            if (
+                wkey == key
+                and (not wns or wns == namespace)
+                and _match_selector(obj, selector)
+            ):
                 queue.put_nowait(event)
 
     # test-visible accessors -------------------------------------------
@@ -128,7 +148,7 @@ class StubApiServer:
         """Abruptly end every live watch stream (the client sees EOF and
         must reconnect). Returns how many streams were dropped."""
         dropped = 0
-        for _, _, queue in list(self._watchers):
+        for _, _, _, queue in list(self._watchers):
             queue.put_nowait(None)  # sentinel: close the stream
             dropped += 1
         return dropped
@@ -225,10 +245,12 @@ class StubApiServer:
         key, namespace, _ = self._parse(request)
         if request.query.get("watch") == "true":
             return await self._serve_watch(request, key, namespace)
+        selector = request.query.get("labelSelector", "")
         items = [
             copy.deepcopy(obj)
             for (ns, _), obj in self._bucket(key).items()
-            if not namespace or ns == namespace
+            if (not namespace or ns == namespace)
+            and _match_selector(obj, selector)
         ]
         return web.json_response(
             {
@@ -246,6 +268,7 @@ class StubApiServer:
         await resp.prepare(request)
         queue: asyncio.Queue = asyncio.Queue()
 
+        selector = request.query.get("labelSelector", "")
         start_rv = request.query.get("resourceVersion", "")
         if start_rv:
             oldest = self._history[0][0] if self._history else self._rv + 1
@@ -262,16 +285,20 @@ class StubApiServer:
             backlog = [
                 ev
                 for rv, k, ns, ev in self._history
-                if k == key and (not namespace or ns == namespace) and rv > int(start_rv)
+                if k == key
+                and (not namespace or ns == namespace)
+                and rv > int(start_rv)
+                and _match_selector(ev.get("object", {}), selector)
             ]
         else:
             # no resourceVersion: synthesize ADDED for current state
             backlog = [
                 {"type": "ADDED", "object": copy.deepcopy(obj)}
                 for (ns, _), obj in self._bucket(key).items()
-                if not namespace or ns == namespace
+                if (not namespace or ns == namespace)
+                and _match_selector(obj, selector)
             ]
-        entry = (key, namespace, queue)
+        entry = (key, namespace, selector, queue)
         self._watchers.append(entry)
         try:
             for ev in backlog:

@@ -100,9 +100,22 @@ class InverseExpBackoff:
     def current_delay(self) -> float:
         return self._delay
 
-    async def next(self) -> bool:
-        if self._deadline is not None and self._clock.monotonic() >= self._deadline:
-            return False
-        await self._clock.sleep(self._delay)
+    def expired(self) -> bool:
+        return (
+            self._deadline is not None
+            and self._clock.monotonic() >= self._deadline
+        )
+
+    def advance(self) -> float:
+        """Current delay, advancing the schedule — for callers that pace
+        themselves (e.g. waiting on a watch event bounded by the delay)
+        instead of sleeping here."""
+        delay = self._delay
         self._delay = max(self._delay * self._params.factor, self._params.min_delay)
+        return delay
+
+    async def next(self) -> bool:
+        if self.expired():
+            return False
+        await self._clock.sleep(self.advance())
         return True

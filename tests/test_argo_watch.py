@@ -1,0 +1,287 @@
+"""Watch-backed Argo engine: cache, event-driven wake, degradation.
+
+The informer divergence (docs/design.md): one WATCH per namespace
+replaces per-workflow polling GETs, and the reconciler's poll loop
+wakes on the workflow's terminal event instead of sleeping out its
+inverse-exp delay.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.engine.argo import (
+    WF_GROUP,
+    WF_PLURAL,
+    WF_VERSION,
+    ArgoWorkflowEngine,
+)
+from activemonitor_tpu.kube import api_path
+
+from tests.kube_harness import stub_env
+
+from activemonitor_tpu.engine.base import WF_INSTANCE_ID, WF_INSTANCE_ID_LABEL_KEY
+
+# carries the instance-id label like every spec the workflow mutator
+# renders — the watch cache is scoped to it
+MANIFEST = {
+    "apiVersion": "argoproj.io/v1alpha1",
+    "kind": "Workflow",
+    "metadata": {
+        "generateName": "probe-",
+        "namespace": "health",
+        "labels": {WF_INSTANCE_ID_LABEL_KEY: WF_INSTANCE_ID},
+    },
+    "spec": {"entrypoint": "main"},
+}
+
+
+async def _warm_watch(engine, namespace="health"):
+    watch = engine._watches[namespace]
+    for _ in range(100):
+        if watch.healthy:
+            return watch
+        await asyncio.sleep(0.02)
+    raise TimeoutError("watch never became healthy")
+
+
+@pytest.mark.asyncio
+async def test_get_served_from_cache_without_apiserver_roundtrip():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            await _warm_watch(eng)
+            # any direct GET of the object would consume this fault; a
+            # cache hit never touches the server
+            server.inject_fault(f"/workflows/{name}", status=500, method="GET")
+            wf = await eng.get("health", name)
+            assert wf["metadata"]["name"] == name
+            assert server.faults[0]["remaining"] == 1  # untouched
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_cache_tracks_status_patches():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            watch = await _warm_watch(eng)
+            await api.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", name, "status"),
+                {"status": {"phase": "Succeeded"}},
+            )
+            for _ in range(100):
+                cached = watch.lookup(name)
+                if (cached.get("status") or {}).get("phase") == "Succeeded":
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError("cache never saw the status patch")
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_wait_change_wakes_on_patch():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            await _warm_watch(eng)
+            waiter = asyncio.create_task(eng.wait_change("health", name))
+            await asyncio.sleep(0.05)
+            assert not waiter.done()  # no change yet: blocked
+            await api.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", name, "status"),
+                {"status": {"phase": "Succeeded"}},
+            )
+            await asyncio.wait_for(waiter, timeout=5.0)  # event-driven wake
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_watch_survives_stream_drop():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            watch = await _warm_watch(eng)
+            assert server.drop_watches() >= 1
+            await asyncio.sleep(0.1)
+            await api.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", name, "status"),
+                {"status": {"phase": "Failed"}},
+            )
+            # reconnected watch (or GET fallback) must converge
+            for _ in range(200):
+                wf = await eng.get("health", name)
+                if (wf.get("status") or {}).get("phase") == "Failed":
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError("engine never converged after stream drop")
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_cache_miss_falls_back_to_direct_get():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            await eng.submit(dict(MANIFEST))
+            await _warm_watch(eng)
+            # created behind the cache's back is impossible (events cover
+            # it) — but a never-existing name must come back None via the
+            # direct GET, not a false cache verdict
+            assert await eng.get("health", "ghost") is None
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_cache_scoped_to_instance_id_label():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            await eng.submit(dict(MANIFEST))
+            watch = await _warm_watch(eng)
+            # a foreign workflow in the same namespace (no instance-id
+            # label) must never be mirrored into controller memory
+            foreign = {
+                "apiVersion": "argoproj.io/v1alpha1",
+                "kind": "Workflow",
+                "metadata": {"name": "foreign-wf", "namespace": "health"},
+                "spec": {},
+            }
+            server.seed(WF_GROUP, WF_VERSION, WF_PLURAL, foreign)
+            await asyncio.sleep(0.2)
+            assert watch.lookup("foreign-wf") is None
+            # ...but a direct get still reaches it (fallback path)
+            wf = await eng.get("health", "foreign-wf")
+            assert wf["metadata"]["name"] == "foreign-wf"
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_get_fresh_bypasses_stale_cache():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            watch = await _warm_watch(eng)
+            await api.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", name, "status"),
+                {"status": {"phase": "Succeeded"}},
+            )
+            # simulate a lagging cache (watch reconnect gap): the entry
+            # still says Running while the server says Succeeded —
+            # get() serves the stale hit, the timed-out final poll's
+            # get_fresh() must see the server's truth
+            watch._cache[name] = {
+                "metadata": {"name": name, "resourceVersion": "0"},
+                "status": {"phase": "Running"},
+            }
+            stale = await eng.get("health", name)
+            assert (stale.get("status") or {}).get("phase") == "Running"
+            fresh = await eng.get_fresh("health", name)
+            assert (fresh.get("status") or {}).get("phase") == "Succeeded"
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_watch_disabled_engine_never_watches():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api, watch=False)
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            assert eng._watches == {}
+            wf = await eng.get("health", name)  # plain GET path
+            assert wf["metadata"]["name"] == name
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_reconciler_completes_event_driven():
+    """The latency win end-to-end: workflow timeout 120s means the first
+    poll delay is 60s — the check still completes in seconds because the
+    status patch wakes the loop through the watch."""
+    from activemonitor_tpu.api import HealthCheck
+    from activemonitor_tpu.controller import RBACProvisioner
+    from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+    from activemonitor_tpu.controller.events import KubernetesEventRecorder
+    from activemonitor_tpu.controller.rbac import KubernetesRBACBackend
+    from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+    from activemonitor_tpu.metrics import MetricsCollector
+
+    check = HealthCheck.from_dict(
+        {
+            "metadata": {"name": "fast-detect", "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": 600,
+                "level": "namespace",
+                "workflow": {
+                    "generateName": "fast-",
+                    "workflowtimeout": 120,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "fast-sa",
+                        "source": {
+                            "inline": (
+                                "apiVersion: argoproj.io/v1alpha1\n"
+                                "kind: Workflow\n"
+                                "metadata:\n  generateName: fast-\n"
+                                "spec:\n  entrypoint: main\n"
+                            )
+                        },
+                    },
+                },
+            },
+        }
+    )
+    async with stub_env() as (server, api):
+        client = KubernetesHealthCheckClient(api)
+        eng = ArgoWorkflowEngine(api)
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=eng,
+            rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+            recorder=KubernetesEventRecorder(api),
+            metrics=MetricsCollector(),
+        )
+        try:
+            await client.apply(check)
+            await reconciler.reconcile("health", "fast-detect")
+            for _ in range(100):
+                wfs = server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)
+                if wfs:
+                    break
+                await asyncio.sleep(0.05)
+            name = wfs[0]["metadata"]["name"]
+            await api.merge_patch(
+                api_path(WF_GROUP, WF_VERSION, WF_PLURAL, "health", name, "status"),
+                {"status": {"phase": "Succeeded"}},
+            )
+
+            async def succeeded():
+                hc = await client.get("health", "fast-detect")
+                return hc is not None and hc.status.status == "Succeeded"
+
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while not await succeeded():
+                assert (
+                    asyncio.get_event_loop().time() < deadline
+                ), "event-driven wake missed: loop slept out its 60s delay"
+                await asyncio.sleep(0.05)
+            hc = await client.get("health", "fast-detect")
+            assert hc.status.success_count == 1
+        finally:
+            await reconciler.shutdown()
+            await eng.close()

@@ -333,15 +333,18 @@ async def test_ha_failover_without_double_submission():
                 )
             )
 
-            # the next fire is B's: advance past the 60s interval
-            await advance(clock, 61)
-            workflows = await wait_for(
+            # the next fire is B's: advance toward the 60s interval, but
+            # STOP the moment wf2 appears — its (fake) workflowtimeout
+            # starts at submission, and jumping fake time past it before
+            # the test plays Argo would synthesize a timeout failure
+            workflows = await drive_until(
+                clock,
                 lambda: asyncio.sleep(
                     0,
                     len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2
                     and server.objs(WF_GROUP, WF_VERSION, WF_PLURAL),
                 ),
-                timeout=5.0,
+                max_seconds=75,
             )
             wf2 = next(
                 w["metadata"]["name"]
