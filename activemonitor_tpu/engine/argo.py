@@ -85,8 +85,9 @@ class _NamespaceWatch:
             try:
                 await self._task
             except asyncio.CancelledError:
-                current = asyncio.current_task()
-                if current is not None and current.cancelling():
+                # Task.cancelling() is 3.11+; requires-python allows 3.10
+                cancelling = getattr(asyncio.current_task(), "cancelling", None)
+                if cancelling is not None and cancelling():
                     raise  # the CALLER is being cancelled — propagate
             except Exception:
                 pass

@@ -63,7 +63,7 @@ class StubApiServer:
         self._rv = 0
         # bounded event history for watch resume; (rv, key, event)
         self._history: List[Tuple[int, Key, str, dict]] = []
-        self._watchers: List[Tuple[Key, str, asyncio.Queue]] = []
+        self._watchers: List[Tuple[Key, str, str, asyncio.Queue]] = []
         self._runner = None
         self.url = ""
         self.requests: List[Tuple[str, str]] = []  # (method, path) log

@@ -153,6 +153,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     p = sub.add_parser(
+        "checkpoint", help="sharded orbax save/restore round-trip + bandwidth"
+    )
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument(
+        "--directory",
+        default="",
+        help="checkpoint under this directory (default: throwaway temp dir)",
+    )
+
+    p = sub.add_parser(
         "dcn-allreduce", help="cross-host all-reduce bandwidth + correctness"
     )
     p.add_argument("--size-mb", type=float, default=16.0)
@@ -302,6 +312,10 @@ def _dispatch(args) -> int:
         result = transfer.run(
             size_mb=args.size_mb, iters=args.iters, min_gbps=args.min_gbps
         )
+    elif args.probe == "checkpoint":
+        from activemonitor_tpu.probes import checkpoint
+
+        result = checkpoint.run(size_mb=args.size_mb, directory=args.directory)
     elif args.probe == "dcn-allreduce":
         from activemonitor_tpu.probes import dcn
 

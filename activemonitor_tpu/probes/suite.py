@@ -136,6 +136,9 @@ def run(
         lambda: straggler.run(dim=1024 if quick else 0, iters=iters),
     )
     add("transfer", lambda: transfer.run(size_mb=16 if quick else 64, iters=iters))
+    from activemonitor_tpu.probes import checkpoint
+
+    add("checkpoint", lambda: checkpoint.run(size_mb=16 if quick else 64))
     from activemonitor_tpu.probes import dcn
 
     # informational pass on single-process runs; real coverage on

@@ -1,0 +1,46 @@
+"""Checkpoint probe (orbax sharded save/restore) on the CPU mesh."""
+
+import json
+
+from activemonitor_tpu.probes import checkpoint
+
+
+def test_roundtrip_over_virtual_mesh(tmp_path):
+    result = checkpoint.run(size_mb=4.0, directory=str(tmp_path))
+    assert result.ok
+    assert result.details["devices"] == 8
+    assert result.details["bitwise"] is True
+    assert result.details["sharding_preserved"] is True
+    names = {m.name for m in result.metrics}
+    assert names == {
+        "checkpoint-save-gbps",
+        "checkpoint-restore-gbps",
+        "checkpoint-roundtrip-ok",
+    }
+    ok = next(m for m in result.metrics if m.name == "checkpoint-roundtrip-ok")
+    assert ok.value == 1.0
+
+
+def test_temp_dir_cleaned_up():
+    import glob
+    import tempfile
+
+    before = set(glob.glob(tempfile.gettempdir() + "/activemonitor-ckpt-*"))
+    result = checkpoint.run(size_mb=2.0)
+    after = set(glob.glob(tempfile.gettempdir() + "/activemonitor-ckpt-*"))
+    assert result.ok
+    assert after == before  # throwaway dir removed
+
+
+def test_rerun_same_directory(tmp_path):
+    # a periodic HealthCheck reuses its --directory every run — the
+    # second save must overwrite, not crash on the existing path
+    first = checkpoint.run(size_mb=2.0, directory=str(tmp_path))
+    second = checkpoint.run(size_mb=2.0, directory=str(tmp_path))
+    assert first.ok and second.ok
+
+
+def test_contract_line(tmp_path):
+    result = checkpoint.run(size_mb=2.0, directory=str(tmp_path))
+    parsed = json.loads(result.contract_line())
+    assert len(parsed["metrics"]) == 3
