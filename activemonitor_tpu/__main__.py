@@ -207,10 +207,13 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         rbac_backend = KubernetesRBACBackend(kube_api)
     else:
         rbac_backend = InMemoryRBACBackend()
+    metrics = MetricsCollector()
     if args.engine == "argo":
         from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
 
-        engine = ArgoWorkflowEngine(kube_api)
+        engine = ArgoWorkflowEngine(
+            kube_api, on_watch_health=metrics.record_watch_health
+        )
     else:
         from activemonitor_tpu.engine.local import LocalProcessEngine
 
@@ -236,7 +239,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         engine=engine,
         rbac=RBACProvisioner(rbac_backend),
         recorder=recorder,
-        metrics=MetricsCollector(),
+        metrics=metrics,
     )
     # Manager construction validates the flag combination BEFORE the -f
     # manifests are applied (no side effects on a usage error)

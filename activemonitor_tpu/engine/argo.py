@@ -25,7 +25,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import logging
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional
 
 from activemonitor_tpu.engine.base import WF_INSTANCE_ID, WF_INSTANCE_ID_LABEL_KEY
 from activemonitor_tpu.kube import ApiError, KubeApi, api_path
@@ -46,17 +46,35 @@ class _NamespaceWatch:
     """One namespace's workflow watch: list-then-watch with reconnect
     and 410 re-list, feeding a local cache and a change condition."""
 
-    def __init__(self, api: KubeApi, namespace: str):
+    def __init__(
+        self,
+        api: KubeApi,
+        namespace: str,
+        on_health: Optional[Callable[[str, bool], None]] = None,
+    ):
         self._api = api
         self._namespace = namespace
         self._cache: Dict[str, dict] = {}
         self._healthy = False
         self._task: Optional[asyncio.Task] = None
+        self._on_health = on_health
         self.changed = asyncio.Condition()
 
     @property
     def healthy(self) -> bool:
         return self._healthy
+
+    def _emit_health(self, healthy: bool) -> None:
+        if self._on_health is not None:
+            try:
+                self._on_health(self._namespace, healthy)
+            except Exception:  # observability must never break the watch
+                log.exception("watch health callback failed")
+
+    def _set_healthy(self, healthy: bool) -> None:
+        if healthy != self._healthy:
+            self._emit_health(healthy)
+        self._healthy = healthy
 
     def lookup(self, name: str) -> Optional[dict]:
         """Cached object, or None on a miss (caller falls back to GET —
@@ -75,6 +93,12 @@ class _NamespaceWatch:
 
     def ensure_started(self) -> None:
         if self._task is None or self._task.done():
+            if self._task is None:
+                # seed the gauge so a watch that is unhealthy from its
+                # very first connection attempt still has a 0 series —
+                # the transition guard in _set_healthy would otherwise
+                # never emit for a startup-degraded watch
+                self._emit_health(self._healthy)
             self._task = asyncio.create_task(
                 self._run(), name=f"wfwatch:{self._namespace}"
             )
@@ -112,7 +136,7 @@ class _NamespaceWatch:
                     resource_version = listing.get("metadata", {}).get(
                         "resourceVersion", ""
                     )
-                    self._healthy = True
+                    self._set_healthy(True)
                     await self._notify()
                 async for event in self._api.watch(
                     path,
@@ -143,7 +167,7 @@ class _NamespaceWatch:
                     # history expired: full re-list, cache rebuilt
                     resource_version = ""
                     continue
-                self._healthy = False
+                self._set_healthy(False)
                 await self._notify()
                 log.warning(
                     "workflow watch for %s degraded (%s); retrying in 1s",
@@ -153,7 +177,7 @@ class _NamespaceWatch:
                 await asyncio.sleep(1.0)
                 resource_version = ""
             except Exception as e:
-                self._healthy = False
+                self._set_healthy(False)
                 await self._notify()
                 log.warning(
                     "workflow watch for %s failed (%r); retrying in 1s",
@@ -165,9 +189,15 @@ class _NamespaceWatch:
 
 
 class ArgoWorkflowEngine:
-    def __init__(self, api: Optional[KubeApi] = None, watch: bool = True):
+    def __init__(
+        self,
+        api: Optional[KubeApi] = None,
+        watch: bool = True,
+        on_watch_health: Optional[Callable[[str, bool], None]] = None,
+    ):
         self._api = api if api is not None else KubeApi.from_default_config()
         self._watch_enabled = watch
+        self._on_watch_health = on_watch_health
         self._watches: Dict[str, _NamespaceWatch] = {}
 
     def _watch_for(self, namespace: str) -> Optional[_NamespaceWatch]:
@@ -175,7 +205,9 @@ class ArgoWorkflowEngine:
             return None
         watch = self._watches.get(namespace)
         if watch is None:
-            watch = _NamespaceWatch(self._api, namespace)
+            watch = _NamespaceWatch(
+                self._api, namespace, on_health=self._on_watch_health
+            )
             self._watches[namespace] = watch
         watch.ensure_started()
         return watch

@@ -103,6 +103,17 @@ class MetricsCollector:
             "2x their interval",
             registry=self.registry,
         )
+        # engine observability: is the per-namespace workflow watch
+        # stream (divergence 11) healthy, or is the controller paying
+        # direct-GET fallbacks? A sustained 0 here explains elevated
+        # apiserver load and slower failure detection
+        self.workflow_watch_healthy = Gauge(
+            "workflow_watch_healthy",
+            "1 while the namespace's workflow watch stream feeds the "
+            "status cache; 0 while degraded to direct GETs",
+            ["namespace"],
+            registry=self.registry,
+        )
         self._custom_gauges: Dict[str, Gauge] = {}
         self._custom_lock = threading.Lock()
 
@@ -128,6 +139,9 @@ class MetricsCollector:
         self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
             max(0.0, finished - started)
         )
+
+    def record_watch_health(self, namespace: str, healthy: bool) -> None:
+        self.workflow_watch_healthy.labels(namespace).set(1.0 if healthy else 0.0)
 
     # -- dynamic custom metrics ---------------------------------------
     def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:

@@ -1,26 +1,26 @@
-"""Fused flash attention (Pallas) — single-chip attention hot op.
+"""Fused flash attention (Pallas) — training-grade single-chip attention.
 
-A fused online-softmax attention kernel: for each Q block the kernel
-sweeps K/V blocks, keeping the running max/denominator and the output
-accumulator in VMEM scratch — the [S, S] score matrix is never
-materialized in HBM. This is the op the decode/ring/training probes
-lean on XLA fusion for; owning the schedule buys two things XLA cannot
-guarantee:
+A fused online-softmax attention kernel with a custom VJP: forward
+sweeps K/V blocks per Q block keeping the running max/denominator and
+output accumulator in VMEM (the [S, S] score matrix never touches HBM),
+and the backward pass recomputes attention probabilities blockwise from
+the saved logsumexp — the standard flash-attention recompute strategy,
+so training memory stays O(S·D) too. Owning the schedule buys what XLA
+fusion cannot guarantee:
 
-- scores live entirely in VMEM (HBM traffic is O(S·D), not O(S²)), so
-  long sequences stay bandwidth-feasible on one chip;
-- causal blocks strictly above the diagonal are skipped inside the
+- scores/probabilities live entirely in VMEM, forward AND backward
+  (HBM traffic O(S·D), not O(S²)) — long sequences stay feasible;
+- causal blocks strictly above the diagonal are skipped inside every
   kernel (``pl.when``), so the dead half of the causal grid costs no
-  MXU time.
+  MXU time in either pass.
 
-On non-TPU platforms the kernel runs in interpret mode (functionally
-identical, slow) so the same code path is exercised by the CPU test
+On non-TPU platforms the kernels run in interpret mode (functionally
+identical, slow) so the same code paths are exercised by the CPU test
 suite — mirrors ops/stream.py.
 
-The grid is (batch, heads, q_blocks, k_blocks) with the K sweep
-innermost: TPU grids execute sequentially, so VMEM scratch carries the
-online-softmax state across K iterations of one Q block, and the output
-block is written once, at each Q row's last visible K block.
+Grids put the reduction sweep innermost (TPU grids execute
+sequentially, so VMEM scratch carries state across the sweep): forward
+and dQ sweep K blocks per Q block; dK/dV sweeps Q blocks per K block.
 
 Complements ops/ring_attention.py: ring attention shards the sequence
 ACROSS chips (ICI traffic, sequence parallelism); flash attention fuses
@@ -40,12 +40,31 @@ _NEG_INF = -1e30
 # lane width of the m/l scratch rows; TPU vregs are (8, 128) so scalars
 # carried per Q row live broadcast across one 128-lane vector
 _LANES = 128
+# backward blocks default smaller than forward: the backward body holds
+# four [bq, bk] f32 temporaries (s, p, dp, ds) against the ~16 MB
+# scoped-VMEM limit
+# measured on v5e at S=2048 (contention-noisy tunnel, best-of-sweep):
+# 512x512 ~25 TFLOP/s effective fwd+bwd, 1024x256 ~111, 2048x256 ~117 —
+# the tall-q/narrow-k shape wins decisively; 1024x256 keeps the causal
+# block skip meaningful at long sequence lengths
+_BWD_BLOCK_Q = 1024
+_BWD_BLOCK_K = 256
 
 
-def _make_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
+def _causal_mask(qi, ki, block_q: int, block_k: int):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos >= k_pos
+
+
+def _make_fwd_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
         qi = pl.program_id(2)
         ki = pl.program_id(3)
 
@@ -73,13 +92,8 @@ def _make_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: fl
                 * scale
             )  # [block_q, block_k]
             if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+                mask = _causal_mask(qi, ki, block_q, block_k)
+                s = jnp.where(mask, s, _NEG_INF)
 
             m_prev = m_ref[:]  # [block_q, LANES] (broadcast rows)
             l_prev = l_ref[:]
@@ -91,7 +105,7 @@ def _make_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: fl
             shift = jnp.maximum(m_next[:, :1], _NEG_INF / 2)
             p = jnp.exp(s - shift)  # [block_q, block_k]
             if causal:
-                p = jnp.where(q_pos >= k_pos, p, 0.0)
+                p = jnp.where(mask, p, 0.0)
             alpha = jnp.exp(m_prev - jnp.maximum(m_next, _NEG_INF / 2))
             l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
             m_ref[:] = m_next
@@ -101,16 +115,269 @@ def _make_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: fl
             )  # [block_q, D]
             acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
 
-        # write the output once, at this Q block's last visible K block
+        # write the outputs once, at this Q block's last visible K block
         last_visible = (q_last // block_k) if causal else (num_k - 1)
 
         @pl.when(ki == last_visible)
         def _finalize():
-            o_ref[0, 0] = (
-                acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
-            ).astype(o_ref.dtype)
+            l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+            o_ref[0, 0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
+            # logsumexp of the scaled scores — the backward recompute
+            # reconstructs p = exp(s - lse) from this
+            lse_ref[0, 0] = (
+                jnp.maximum(m_ref[:, :1], _NEG_INF / 2) + jnp.log(l_final)
+            )
 
     return kernel
+
+
+def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc):
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+        q_last = qi * block_q + block_q - 1
+        visible = (ki * block_k <= q_last) if causal else (ki >= 0)
+
+        @pl.when(visible)
+        def _accumulate():
+            q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+            k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+            v = v_ref[0, 0].astype(jnp.float32)
+            do = do_ref[0, 0].astype(jnp.float32)  # [bq, D]
+            lse = lse_ref[0, 0]  # [bq, 1]
+            delta = delta_ref[0, 0]  # [bq, 1]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            if causal:
+                s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+            p = jnp.exp(s - lse)  # masked entries underflow to 0
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            ds = p * (dp - delta) * scale
+            dq_acc[:] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        last_visible = (q_last // block_k) if causal else (num_k - 1)
+
+        @pl.when(ki == last_visible)
+        def _finalize():
+            dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    def kernel(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+        dk_ref, dv_ref, dk_acc, dv_acc,
+    ):
+        ki = pl.program_id(2)  # K block owns this grid row
+        qi = pl.program_id(3)  # Q sweep innermost
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        q_last = qi * block_q + block_q - 1
+        visible = (ki * block_k <= q_last) if causal else (qi >= 0)
+
+        @pl.when(visible)
+        def _accumulate():
+            q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+            k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+            v = v_ref[0, 0].astype(jnp.float32)
+            do = do_ref[0, 0].astype(jnp.float32)  # [bq, D]
+            lse = lse_ref[0, 0]  # [bq, 1]
+            delta = delta_ref[0, 0]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            if causal:
+                s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+            p = jnp.exp(s - lse)  # [bq, bk]
+            dv_acc[:] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # p^T @ dO -> [bk, D]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            ds = p * (dp - delta) * scale
+            dk_acc[:] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # ds^T @ q -> [bk, D]
+
+        # the LAST Q block attends every K block even under causality,
+        # so the write point is unconditional
+        @pl.when(qi == num_q - 1)
+        def _finalize():
+            dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _check_blocks(seq: int, block_q: int, block_k: int):
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    if seq % block_q or seq % block_k:
+        raise ValueError(
+            f"seq {seq} not divisible by blocks ({block_q}, {block_k})"
+        )
+    return block_q, block_k
+
+
+def _fit_block(seq: int, preferred: int) -> int:
+    """Largest divisor of ``seq`` that is <= preferred and TPU-tileable
+    (a multiple of 8), falling back to ``seq`` itself (a block equal to
+    the array dim is always legal). The backward pass uses this so ANY
+    sequence the forward accepted can be differentiated — its block
+    preference must never re-impose a divisibility the caller's forward
+    blocks did not."""
+    for block in range(min(preferred, seq), 7, -1):
+        if seq % block == 0 and block % 8 == 0:
+            return block
+    return seq
+
+
+def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
+    """(out, lse) on [B, H, S, D] arrays; lse is [B, H, S] float32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq, head_dim = q.shape
+    block_q, block_k = _check_blocks(seq, block_q, block_k)
+    num_q, num_k = seq // block_q, seq // block_k
+    scale = 1.0 / (head_dim ** 0.5)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    kernel = _make_fwd_kernel(causal, block_q, block_k, num_k, scale)
+    spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
+    spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            # [B, H, S, 1]: the trailing singleton satisfies the TPU
+            # block rule (last dim equal to the array's) without padding
+            # the row statistics out to a full 128-lane vector
+            jax.ShapeDtypeStruct((batch, heads, seq, 1), jnp.float32),
+        ),
+        grid=(batch, heads, num_q, num_k),
+        in_specs=[spec_q, spec_kv, spec_kv],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _backward_bhsd(q, k, v, out, lse, dout, causal: bool):
+    """dQ/dK/dV on [B, H, S, D] arrays via blockwise recompute."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq, head_dim = q.shape
+    block_q = _fit_block(seq, _BWD_BLOCK_Q)
+    block_k = _fit_block(seq, _BWD_BLOCK_K)
+    num_q, num_k = seq // block_q, seq // block_k
+    scale = 1.0 / (head_dim ** 0.5)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    # D_i = rowsum(dO ∘ O) — cheap elementwise pass XLA fuses; the
+    # kernels read it per Q row like the logsumexp
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )  # [B, H, S, 1]
+
+    spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
+    spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    spec_row = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        _make_dq_kernel(causal, block_q, block_k, num_k, scale),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(batch, heads, num_q, num_k),
+        in_specs=[spec_q, spec_kv, spec_kv, spec_q, spec_row, spec_row],
+        out_specs=spec_q,
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    # dK/dV grid: K block outer, Q sweep inner — index maps swap i/j
+    spec_q_t = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    spec_kv_t = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, i, 0))
+    spec_row_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(causal, block_q, block_k, num_q, scale),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        grid=(batch, heads, num_k, num_q),
+        in_specs=[spec_q_t, spec_kv_t, spec_kv_t, spec_q_t, spec_row_t, spec_row_t],
+        out_specs=(spec_kv_t, spec_kv_t),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
+    out, _ = _forward_bhsd(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _forward_bhsd(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(causal, block_q, block_k, residuals, dout):
+    q, k, v, out, lse = residuals
+    dq, dk, dv = _backward_bhsd(q, k, v, out, lse, dout, causal)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def flash_attention(
@@ -122,20 +389,21 @@ def flash_attention(
     block_k: int = 1024,
     layout: str = "bshd",
 ) -> jax.Array:
-    """Fused attention. ``layout="bshd"`` takes ``[batch, seq, heads,
-    head_dim]`` (what ops/ring_attention.py uses) and transposes to the
-    kernel's native ``[batch, heads, seq, head_dim]``; pass
-    ``layout="bhsd"`` when the caller already keeps heads-major arrays
-    to skip the transpose passes (3 HBM round-trips per call).
-    Sequence length must be divisible by the block sizes (blocks are
-    clamped to seq).
+    """Fused attention, differentiable (custom VJP with blockwise
+    recompute from the saved logsumexp — flash-attention backward).
 
-    Default blocks are the measured optimum on v5e (bq=bk=1024:
+    ``layout="bshd"`` takes ``[batch, seq, heads, head_dim]`` (what
+    ops/ring_attention.py uses) and transposes to the kernel's native
+    ``[batch, heads, seq, head_dim]``; pass ``layout="bhsd"`` when the
+    caller already keeps heads-major arrays to skip the transpose passes
+    (3 HBM round-trips per call). Sequence length must be divisible by
+    the block sizes (blocks are clamped to seq; the backward pass picks
+    its own blocks — preferring 1024x256 against the scoped-VMEM limit,
+    shrunk to fit any seq the forward accepted).
+
+    Default forward blocks are the measured optimum on v5e (bq=bk=1024:
     ~90 TFLOP/s causal at S=4096, ~4-5x the unfused XLA attention on
     the same chip; bigger blocks exceed the 16 MB scoped-VMEM limit)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     if layout == "bshd":
         batch, seq, heads, head_dim = q.shape
     elif layout == "bhsd":
@@ -144,45 +412,16 @@ def flash_attention(
         raise ValueError(f"layout must be bshd or bhsd, got {layout!r}")
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
-    block_q = min(block_q, seq)
-    block_k = min(block_k, seq)
-    if seq % block_q or seq % block_k:
-        raise ValueError(
-            f"seq {seq} not divisible by blocks ({block_q}, {block_k})"
-        )
-    num_q, num_k = seq // block_q, seq // block_k
-    scale = 1.0 / (head_dim ** 0.5)
-    interpret = jax.devices()[0].platform != "tpu"
+    block_q, block_k = _check_blocks(seq, block_q, block_k)
 
-    # [B, S, H, D] -> [B, H, S, D]: the kernel tiles the last two dims
+    # [B, S, H, D] -> [B, H, S, D]: the kernels tile the last two dims
     # (seq-block × head_dim), which is the MXU-friendly layout
     if layout == "bshd":
         qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     else:
         qt, kt, vt = q, k, v
 
-    kernel = _make_kernel(causal, block_q, block_k, num_k, scale)
-    spec_q = pl.BlockSpec(
-        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
-    )
-    spec_kv = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
-    )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-        grid=(batch, heads, num_q, num_k),
-        in_specs=[spec_q, spec_kv, spec_kv],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, head_dim), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qt, kt, vt)
+    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k)
     return jnp.swapaxes(out, 1, 2) if layout == "bshd" else out
 
 

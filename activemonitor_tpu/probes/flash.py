@@ -59,7 +59,38 @@ def run(
     max_err = float(
         jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)))
     )
-    correct = max_err <= tolerance
+
+    # gradient correctness through the custom-VJP backward kernels —
+    # wrong dQ/dK/dV silently corrupts training in a way the forward
+    # check cannot see
+    def _loss(fn):
+        def inner(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+        return inner
+
+    # grad check runs the backward kernels too — in interpret mode that
+    # is ~3-4x the forward work, so shrink the slice further off-TPU
+    gsmall = small if on_tpu else min(small, 256)
+    small_args = (q[:, :gsmall], k[:, :gsmall], v[:, :gsmall])
+    grads_flash = jax.grad(
+        _loss(lambda a, b, c: flash_attention(a, b, c, causal=causal,
+                                              block_q=128, block_k=128)),
+        argnums=(0, 1, 2),
+    )(*small_args)
+    grads_ref = jax.grad(
+        _loss(lambda a, b, c: reference_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(*small_args)
+    grad_rel_err = 0.0
+    for a, b in zip(grads_flash, grads_ref):
+        norm = max(1e-9, float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+        grad_rel_err = max(
+            grad_rel_err,
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+            / norm,
+        )
+    correct = max_err <= tolerance and grad_rel_err <= 5e-2
 
     def make_chain(op):
         def factory(kreps):
@@ -85,6 +116,31 @@ def run(
     per_variant["xla"] = flops / chain_delta_seconds(
         make_chain(unfused), q, k, v, k1=2, k2=6, iters=iters
     ) / 1e12
+
+    # training path: fwd + custom-VJP backward (the blockwise-recompute
+    # kernels), chained through dL/dQ so steps stay data-dependent.
+    # ~3.5x forward FLOPs is the standard fwd+bwd attention accounting
+    train_tflops = None
+    if on_tpu:
+
+        def make_grad_chain(kreps):
+            grad = jax.grad(
+                lambda q, k, v: jnp.sum(fused(q, k, v).astype(jnp.float32))
+            )
+
+            @jax.jit
+            def chain(q, k, v):
+                x = q
+                for _ in range(kreps):
+                    x = grad(x, k, v).astype(q.dtype)
+                return x.astype(jnp.float32).sum()
+
+            return chain
+
+        train_seconds = chain_delta_seconds(
+            make_grad_chain, q, k, v, k1=1, k2=3, iters=iters
+        )
+        train_tflops = 3.5 * flops / train_seconds / 1e12
     # the headline gauge is the FUSED kernel's own throughput — a fused
     # regression below the XLA baseline must show in the gauge, not be
     # papered over by a max(); off-TPU (interpret mode not timeable)
@@ -99,6 +155,11 @@ def run(
             help="Max abs error of fused vs unfused attention",
         ),
         ProbeMetric(
+            "flash-attention-grad-rel-error",
+            grad_rel_err,
+            help="Max relative error of custom-VJP gradients vs autodiff",
+        ),
+        ProbeMetric(
             "flash-attention-tflops",
             tflops,
             help="Achieved fused attention TFLOP/s",
@@ -111,11 +172,21 @@ def run(
         "head_dim": head_dim,
         "causal": causal,
         "max_error": max_err,
+        "grad_rel_error": grad_rel_err,
         "kernel": kernel,
         "per_variant_tflops": {k: round(v, 1) for k, v in per_variant.items()},
         "device_kind": device.device_kind,
     }
     ok = correct
+    if train_tflops is not None:
+        metrics.append(
+            ProbeMetric(
+                "flash-attention-train-tflops",
+                train_tflops,
+                help="Effective fwd+bwd TFLOP/s through the custom-VJP kernels",
+            )
+        )
+        details["train_tflops"] = round(train_tflops, 1)
     if "flash" in per_variant and "xla" in per_variant:
         speedup = per_variant["flash"] / per_variant["xla"]
         metrics.append(

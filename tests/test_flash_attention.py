@@ -79,6 +79,74 @@ def test_mismatched_shapes_rejected():
         flash_attention(q, k[:, :64], v)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    q, k, v = _qkv(seq=256)
+    tgt = jax.random.normal(jax.random.key(9), q.shape, jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            (flash_attention(q, k, v, causal=causal, block_q=128, block_k=128) - tgt)
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum((reference_attention(q, k, v, causal=causal) - tgt) ** 2)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", got, want):
+        err = float(jnp.max(jnp.abs(a - b)))
+        assert err < 1e-4, f"d{name} err {err}"
+
+
+def test_gradients_adapt_blocks_to_any_forward_seq():
+    # seq=384 divides the forward's 128-blocks but not the backward's
+    # preferred 1024x256 — the backward must shrink its blocks, not raise
+    q, k, v = _qkv(seq=384)
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, block_q=128, block_k=128) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(got, want):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_fit_block_prefers_tileable_divisors():
+    from activemonitor_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(4096, 1024) == 1024
+    assert _fit_block(384, 256) == 192  # divisor, multiple of 8
+    assert _fit_block(640, 256) == 160
+    assert _fit_block(100, 256) == 100  # no tileable divisor: whole seq
+
+
+def test_gradients_bf16_and_uneven_blocks():
+    # bwd uses its own block shape (1024x256 clamped to seq) — distinct
+    # q/k blocking must still produce reference-level gradients
+    q, k, v = _qkv(seq=128, dtype=jnp.bfloat16)
+
+    def loss(fn):
+        def inner(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+        return inner
+
+    got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, want):
+        assert a.dtype == jnp.bfloat16
+        scale = max(1e-9, float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+        rel = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))) / scale
+        assert rel < 5e-2  # bf16 grads
+
+
 def test_attention_flops_causal_half():
     full = attention_flops(2, 256, 4, 64, causal=False)
     causal = attention_flops(2, 256, 4, 64, causal=True)
