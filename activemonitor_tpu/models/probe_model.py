@@ -127,6 +127,41 @@ def apply_block(
     return x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(dt))
 
 
+def flash_attention_fn(cfg: ProbeModelConfig, mesh=None, axis: str = "model"):
+    """Attention override running the fused Pallas kernel
+    (ops/flash_attention.py, differentiable via its custom VJP).
+
+    Unsharded (no mesh, or a 1-sized axis) the kernel is called
+    directly. With heads tensor-parallel over ``mesh[axis]`` it runs
+    under ``shard_map`` — attention is embarrassingly parallel across
+    heads, so each shard computes its local heads with zero
+    communication, exactly what XLA's sharding propagation does for the
+    unfused path. Unlike GSPMD (which pads uneven shardings for the
+    dense path), shard_map needs the heads dim to divide evenly — a
+    too-large tp axis is rejected up front with the actual constraint
+    rather than a trace-time shape error."""
+    from jax import shard_map
+
+    from activemonitor_tpu.ops.flash_attention import flash_attention
+
+    def fused(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return fused
+    axis_size = mesh.shape[axis]
+    if cfg.n_heads % axis_size:
+        raise ValueError(
+            f"flash attention needs n_heads ({cfg.n_heads}) divisible by "
+            f"the '{axis}' mesh axis ({axis_size}); use dense attention "
+            "or a smaller tensor-parallel group"
+        )
+    spec = P("data" if "data" in mesh.shape else None, None, axis, None)
+    return shard_map(
+        fused, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
+    )
+
+
 def dense_causal_attention(q, k, v, cfg: ProbeModelConfig):
     dt = cfg.dtype
     seq = q.shape[1]
@@ -159,9 +194,14 @@ def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array
     )
 
 
-def loss_fn(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
-    """Next-token cross-entropy (the training-step probe's objective)."""
-    logits = forward(params, tokens[:, :-1], cfg)
+def loss_fn(
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn=None
+) -> jax.Array:
+    """Next-token cross-entropy (the training-step probe's objective).
+    ``attention_fn`` overrides the attention mechanism (e.g.
+    :func:`flash_attention_fn` for the fused-kernel training path);
+    None means dense causal (apply_block's default)."""
+    logits = _forward_with_attention(params, tokens[:, :-1], cfg, attention_fn)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)

@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-per-device", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--steps", type=int, default=3)
+    p.add_argument(
+        "--attention",
+        choices=("dense", "flash"),
+        default="dense",
+        help="attention implementation: dense (XLA) or the fused "
+        "flash kernel (custom-VJP Pallas; shard_map over tp heads)",
+    )
 
     p = sub.add_parser("hbm", help="HBM bandwidth check")
     p.add_argument("--size-mb", type=float, default=256.0)
@@ -248,6 +255,7 @@ def _dispatch(args) -> int:
             batch_per_device=args.batch_per_device,
             seq=args.seq,
             steps=args.steps,
+            attention=args.attention,
         )
     elif args.probe == "hbm":
         from activemonitor_tpu.probes import hbm

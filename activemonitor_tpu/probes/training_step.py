@@ -46,6 +46,7 @@ def build_sharded_train_step(
     cfg: ProbeModelConfig,
     mesh: Mesh,
     learning_rate: float = 1e-3,
+    attention: str = "dense",
 ):
     """Returns (step_fn, params, opt_state, data_sharding).
 
@@ -66,8 +67,19 @@ def build_sharded_train_step(
     opt_state = optimizer.init(params)
     opt_sh = _opt_shardings(opt_state, param_sh, replicated)
 
+    if attention == "flash":
+        from activemonitor_tpu.models.probe_model import flash_attention_fn
+
+        attention_fn = flash_attention_fn(cfg, mesh)
+    elif attention == "dense":
+        attention_fn = None
+    else:
+        raise ValueError(f"attention must be dense or flash, got {attention!r}")
+
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, attention_fn
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -109,6 +121,7 @@ def run(
     seq: int = 128,
     steps: int = 3,
     mesh: Optional[Mesh] = None,
+    attention: str = "dense",
 ) -> ProbeResult:
     cfg = tiny_config() if tiny else ProbeModelConfig()
     seq = min(seq, cfg.max_seq_len - 1)
@@ -116,7 +129,9 @@ def run(
     n_data = mesh.shape["data"]
     batch = batch_per_device * n_data
 
-    step_fn, params, opt_state, data_sh = build_sharded_train_step(cfg, mesh)
+    step_fn, params, opt_state, data_sh = build_sharded_train_step(
+        cfg, mesh, attention=attention
+    )
     tokens = jax.device_put(
         jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size),
         data_sh,
@@ -164,6 +179,7 @@ def run(
     rated = rated_for(mesh_device.device_kind)
     details = {
         "mesh": dict(mesh.shape),
+        "attention": attention,
         "params": param_count(cfg),
         "batch": batch,
         "seq": seq,

@@ -154,6 +154,55 @@ def test_attention_flops_causal_half():
     assert abs(causal / full - 0.5) < 0.01  # (S+1)/2S
 
 
+def test_model_flash_attention_matches_dense_on_mesh():
+    # the probe model's flash path (shard_map over tp heads on the
+    # dp x tp mesh) must agree with dense attention in loss and grads
+    from activemonitor_tpu.models.probe_model import (
+        flash_attention_fn,
+        init_params,
+        loss_fn,
+        tiny_config,
+    )
+    from activemonitor_tpu.parallel.mesh import make_2d_mesh
+
+    mesh = make_2d_mesh()
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 33), 0, cfg.vocab_size)
+    dense = float(loss_fn(params, tokens, cfg))
+    flash = float(loss_fn(params, tokens, cfg, flash_attention_fn(cfg, mesh)))
+    assert abs(dense - flash) < 1e-3  # bf16 compute
+    grads_dense = jax.grad(lambda p: loss_fn(p, tokens, cfg))(params)
+    grads_flash = jax.grad(
+        lambda p: loss_fn(p, tokens, cfg, flash_attention_fn(cfg, mesh))
+    )(params)
+    errs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), grads_dense, grads_flash
+    )
+    assert max(jax.tree.leaves(errs)) < 5e-3
+
+
+def test_model_flash_rejects_oversized_tp_axis():
+    from activemonitor_tpu.models.probe_model import flash_attention_fn, tiny_config
+    from jax.sharding import Mesh
+    import numpy as np
+
+    # tiny_config has 4 heads; an 8-wide model axis cannot shard them
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("data", "model"))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention_fn(tiny_config(), mesh)
+
+
+def test_training_step_probe_flash_attention():
+    from activemonitor_tpu.probes import training_step
+
+    result = training_step.run(
+        tiny=True, batch_per_device=2, seq=32, steps=1, attention="flash"
+    )
+    assert result.ok
+    assert result.details["attention"] == "flash"
+
+
 def test_probe_runs_on_cpu():
     from activemonitor_tpu.probes import flash
 
