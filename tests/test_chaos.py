@@ -141,6 +141,44 @@ async def test_watch_stream_drop_reconnects():
 
 
 @pytest.mark.asyncio
+async def test_degraded_workflow_watch_full_lifecycle():
+    """The workflow watch stream (divergence 11) is storm-degraded —
+    500s on every workflow read plus repeated stream drops — while a
+    check runs. The engine must fall back to direct GETs/pacing sleeps
+    and the check must still reach Succeeded; nothing may depend on the
+    informer being alive."""
+    async with stub_env() as (server, api):
+        client, manager = build_controller(api)
+        await manager.start()
+        player = argo_player(server, api)
+        dropper_running = True
+
+        async def dropper():
+            while dropper_running:
+                server.drop_watches()
+                await asyncio.sleep(0.05)
+
+        drop_task = asyncio.create_task(dropper())
+        # every workflow read (list, watch reconnect, fallback GET)
+        # fails 20 times before the path clears
+        server.inject_fault("/workflows", status=500, times=20, method="GET")
+        try:
+            await client.apply(chaos_check("degraded-watch"))
+
+            async def succeeded():
+                hc = await client.get("health", "degraded-watch")
+                return hc if hc and hc.status.status == "Succeeded" else None
+
+            hc = await wait_for(succeeded, timeout=30.0)
+            assert hc.status.success_count == 1
+        finally:
+            dropper_running = False
+            drop_task.cancel()
+            player.cancel()
+            await manager.stop()
+
+
+@pytest.mark.asyncio
 async def test_workflow_submit_500_storm_recovers():
     """The first submits fail with 500s; the requeue ladder must retry
     until the API server heals, then the check completes normally."""
