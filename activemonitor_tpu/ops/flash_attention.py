@@ -61,10 +61,23 @@ def _causal_mask(qi, ki, block_q: int, block_k: int):
     return q_pos >= k_pos
 
 
-def _make_fwd_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
+def _make_attention_kernel(
+    causal: bool, block_q: int, block_k: int, num_k: int, scale: float,
+    partial: bool,
+):
+    """One builder for both forward flavors — identical online-softmax
+    body (init, causal visibility, attend, last-visible write point);
+    only the finalize differs: the full kernel emits the normalized
+    output + logsumexp, the ``partial`` kernel emits the raw
+    (accumulator, max, denominator) merge state ring attention combines
+    across devices (ops/ring_attention.py)."""
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        if partial:
+            acc_out, m_out, l_out, acc_ref, m_ref, l_ref = rest
+        else:
+            o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
         qi = pl.program_id(2)
         ki = pl.program_id(3)
 
@@ -120,15 +133,77 @@ def _make_fwd_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale
 
         @pl.when(ki == last_visible)
         def _finalize():
-            l_final = jnp.maximum(l_ref[:, :1], 1e-30)
-            o_ref[0, 0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
-            # logsumexp of the scaled scores — the backward recompute
-            # reconstructs p = exp(s - lse) from this
-            lse_ref[0, 0] = (
-                jnp.maximum(m_ref[:, :1], _NEG_INF / 2) + jnp.log(l_final)
-            )
+            if partial:
+                acc_out[0, 0] = acc_ref[:]
+                m_out[0, 0] = m_ref[:, :1]
+                l_out[0, 0] = l_ref[:, :1]
+            else:
+                l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+                o_ref[0, 0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
+                # logsumexp of the scaled scores — the backward
+                # recompute reconstructs p = exp(s - lse) from this
+                lse_ref[0, 0] = (
+                    jnp.maximum(m_ref[:, :1], _NEG_INF / 2) + jnp.log(l_final)
+                )
 
     return kernel
+
+
+def flash_attention_partial(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int = 1024,
+    block_k: int = 1024,
+):
+    """Unnormalized fused attention for one (Q block, KV block) pair in
+    ``[batch, seq_q, heads, head_dim]`` layout (ring attention's).
+
+    Returns ``(block_max [B, H, Sq], out_unnormalized [B, Sq, H, D]
+    float32, denom [B, H, Sq])`` — the exact contract of ring
+    attention's ``_block_attend`` so the K/V ring can merge fused block
+    results across devices with its online-softmax recurrence. Not
+    differentiable (the ring path is a forward-only probe op); use
+    :func:`flash_attention` for training."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = _fit_block(seq_q, block_q)
+    block_k = _fit_block(seq_k, block_k)
+    num_q, num_k = seq_q // block_q, seq_k // block_k
+    scale = 1.0 / (head_dim ** 0.5)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    kernel = _make_attention_kernel(
+        causal, block_q, block_k, num_k, scale, partial=True
+    )
+    spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
+    spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    spec_row = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    acc, m, l = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(qt.shape[:3] + (head_dim,), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
+        ),
+        grid=(batch, heads, num_q, num_k),
+        in_specs=[spec_q, spec_kv, spec_kv],
+        out_specs=(spec_q, spec_row, spec_row),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return m[..., 0], jnp.swapaxes(acc, 1, 2), l[..., 0]
 
 
 def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
@@ -276,7 +351,7 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
     scale = 1.0 / (head_dim ** 0.5)
     interpret = jax.devices()[0].platform != "tpu"
 
-    kernel = _make_fwd_kernel(causal, block_q, block_k, num_k, scale)
+    kernel = _make_attention_kernel(causal, block_q, block_k, num_k, scale, partial=False)
     spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
     spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
     out, lse = pl.pallas_call(

@@ -58,15 +58,24 @@ def _block_attend(q, k, v, mask):
     return block_max, out, denom
 
 
-def _ring_attention_sharded(q, k, v, *, axis_name: str, n_devices: int, causal: bool):
+def _ring_attention_sharded(
+    q, k, v, *, axis_name: str, n_devices: int, causal: bool, use_flash: bool
+):
     """Body run per device inside shard_map. The ring rotation is a
     ``lax.scan`` — one traced step regardless of ring size, so compile
-    time and HLO size stay flat as slices grow."""
+    time and HLO size stay flat as slices grow. With ``use_flash`` the
+    per-step block compute runs the fused Pallas kernel
+    (ops/flash_attention.py partial mode) instead of XLA einsums —
+    same (max, unnormalized out, denom) merge contract, but the local
+    score matrix stays in VMEM."""
     my_idx = jax.lax.axis_index(axis_name)
     batch, seq_local, heads, head_dim = q.shape
 
     causal_mask = jnp.tril(jnp.ones((seq_local, seq_local), jnp.bool_))
     perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+
+    if use_flash:
+        from activemonitor_tpu.ops.flash_attention import flash_attention_partial
 
     qf = q.astype(jnp.float32)
     init = (
@@ -80,7 +89,40 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, n_devices: int, causal: 
     def step_fn(carry, step):
         kf, vf, acc, denom, running_max = carry
         kv_idx = (my_idx - step) % n_devices  # owner of the current K/V block
-        if causal:
+        def skip(q_in, kf, vf):
+            # one skip state for every branch construct below: a
+            # (NEG_INF max, zero acc, zero denom) triple the merge
+            # treats as an empty block
+            return (
+                jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),
+                jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),
+                jnp.zeros((batch, heads, seq_local), jnp.float32),
+            )
+
+        if use_flash:
+            # fused path: diagonal block runs the causal kernel, earlier
+            # blocks the unmasked one — two pallas variants under
+            # lax.switch so each step's compute stays in VMEM. The
+            # kernel upcasts internally, so it gets the ORIGINAL-dtype q
+            # (bf16 inputs keep bf16 Q-block HBM traffic; the f32 qf
+            # exists for the XLA einsum path)
+            def attend_full(q_in, kf, vf):
+                return flash_attention_partial(q_in, kf, vf, causal=False)
+
+            def attend_diag(q_in, kf, vf):
+                return flash_attention_partial(q_in, kf, vf, causal=True)
+
+            if causal:
+                branch = (
+                    (kv_idx < my_idx).astype(jnp.int32)
+                    + 2 * (kv_idx == my_idx).astype(jnp.int32)
+                )  # 0 = skip (kv after us), 1 = full, 2 = diagonal
+                block_max, block_out, block_denom = jax.lax.switch(
+                    branch, (skip, attend_full, attend_diag), q, kf, vf
+                )
+            else:
+                block_max, block_out, block_denom = attend_full(q, kf, vf)
+        elif causal:
             # kv block strictly after our q block ⇒ nothing to attend:
             # skip the einsums entirely (lax.cond, so the dead ~half of
             # the causal grid costs nothing at runtime); diagonal block
@@ -90,13 +132,6 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, n_devices: int, causal: 
                     kv_idx == my_idx, causal_mask, jnp.ones_like(causal_mask)
                 )
                 return _block_attend(qf, kf, vf, mask)
-
-            def skip(qf, kf, vf):
-                return (
-                    jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),
-                    jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),
-                    jnp.zeros((batch, heads, seq_local), jnp.float32),
-                )
 
             block_max, block_out, block_denom = jax.lax.cond(
                 kv_idx > my_idx, skip, attend, qf, kf, vf
@@ -130,16 +165,22 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = True,
+    use_flash: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention over ``mesh[axis]``.
 
     q, k, v: global ``[batch, seq, heads, head_dim]`` arrays; the seq
     dim is sharded over the axis. Returns attention output with the
-    same global shape/sharding.
+    same global shape/sharding. ``use_flash`` runs each ring step's
+    block compute through the fused Pallas kernel (forward-only).
     """
     n = mesh.shape[axis]
     body = partial(
-        _ring_attention_sharded, axis_name=axis, n_devices=n, causal=causal
+        _ring_attention_sharded,
+        axis_name=axis,
+        n_devices=n,
+        causal=causal,
+        use_flash=use_flash,
     )
     spec = P(None, axis, None, None)
     fn = shard_map(

@@ -116,6 +116,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--flash",
+        action="store_true",
+        help="run each ring step's block compute through the fused "
+        "Pallas kernel instead of XLA einsums",
+    )
 
     p = sub.add_parser(
         "flash-attention", help="fused attention kernel correctness + throughput"
@@ -282,6 +288,7 @@ def _dispatch(args) -> int:
             heads=args.heads,
             head_dim=args.head_dim,
             iters=args.iters,
+            use_flash=args.flash,
         )
     elif args.probe == "flash-attention":
         from activemonitor_tpu.probes import flash

@@ -28,6 +28,7 @@ def run(
     head_dim: int = 128,
     iters: int = 5,
     tolerance: float = 2e-2,
+    use_flash: bool = False,
 ) -> ProbeResult:
     mesh = make_1d_mesh("sp")
     n = mesh.devices.size
@@ -41,7 +42,9 @@ def run(
     # correctness on a small slice (full reference attention is O(S^2)
     # on one device — keep it tractable)
     small = min(seq, 64 * n)
-    got = ring_attention(q[:, :small], k[:, :small], v[:, :small], mesh, "sp")
+    got = ring_attention(
+        q[:, :small], k[:, :small], v[:, :small], mesh, "sp", use_flash=use_flash
+    )
     want = reference_attention(q[:, :small], k[:, :small], v[:, :small])
     max_err = float(
         jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)))
@@ -54,7 +57,7 @@ def run(
         def chain(q, k, v):
             x = q
             for _ in range(kreps):
-                x = ring_attention(x, k, v, mesh, "sp")
+                x = ring_attention(x, k, v, mesh, "sp", use_flash=use_flash)
             return x.astype(jnp.float32).sum()
 
         return chain
@@ -91,6 +94,7 @@ def run(
         summary=summary,
         details={
             "devices": n,
+            "block_compute": "flash" if use_flash else "xla",
             "seq": seq,
             "seq_per_device": seq_per_device,
             "heads": heads,

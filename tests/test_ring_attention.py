@@ -29,6 +29,27 @@ def test_matches_reference(mesh, causal):
     assert jnp.max(jnp.abs(got - want)) < 1e-5
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_block_compute_matches_reference(mesh, causal):
+    # the fused per-step block compute (flash_attention_partial under
+    # the ring's lax.switch) must agree with both the XLA path and the
+    # single-device reference
+    q, k, v = qkv(seq=128)
+    flash = ring_attention(q, k, v, mesh, "sp", causal=causal, use_flash=True)
+    plain = ring_attention(q, k, v, mesh, "sp", causal=causal)
+    want = reference_attention(q, k, v, causal=causal)
+    assert float(jnp.max(jnp.abs(flash - want))) < 1e-5
+    assert float(jnp.max(jnp.abs(flash - plain))) < 1e-5
+
+
+def test_probe_flash_mode(mesh):
+    result = ring_probe.run(
+        batch=1, seq_per_device=16, heads=2, head_dim=16, iters=2, use_flash=True
+    )
+    assert result.ok
+    assert result.details["block_compute"] == "flash"
+
+
 def test_matches_reference_bf16(mesh):
     q, k, v = qkv(dtype=jnp.bfloat16)
     got = ring_attention(q, k, v, mesh, "sp")
