@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMG ?= ghcr.io/activemonitor-tpu/controller:latest
 
-.PHONY: all test test-tpu bench crd manifests run lint docker-build install help
+.PHONY: all test test-tpu bench crd manifests run lint kind-e2e docker-build install help
 
 all: test crd
 
@@ -27,8 +27,12 @@ manifests: crd deploy-manifest ## alias matching the reference's make target
 run: ## run the controller locally (file store + local engine)
 	$(PYTHON) -m activemonitor_tpu run --engine local --store ./healthchecks
 
-lint: ## syntax check everything
+lint: ## syntax + AST lint (undefined names, unused imports, bare except, ...)
 	$(PYTHON) -m compileall -q activemonitor_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) hack/lint.py
+
+kind-e2e: ## real-cluster tier: kind + Argo + controller + a Succeeded check
+	./hack/kind-e2e.sh
 
 docker-build: ## build the controller+probes image
 	docker build -t $(IMG) .

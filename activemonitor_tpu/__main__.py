@@ -49,7 +49,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-auth-token-file",
         default="",
         help="file holding a static bearer token required to scrape "
-        "/metrics (the reference's authn/z filter equivalent)",
+        "/metrics (fallback credential; see --metrics-k8s-auth)",
+    )
+    run.add_argument(
+        "--metrics-k8s-auth",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="authenticate+authorize /metrics scrapes through the "
+        "cluster (TokenReview + SubjectAccessReview, the reference's "
+        "WithAuthenticationAndAuthorization filter, cmd/main.go:74-81). "
+        "'auto' enables it whenever cluster credentials are in use "
+        "(--client k8s / --engine argo); a static token file, if also "
+        "given, stays honored as a fallback credential",
     )
     run.add_argument(
         "--health-probe-bind-address",
@@ -241,6 +252,20 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         recorder=recorder,
         metrics=metrics,
     )
+    metrics_authorizer = None
+    k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
+    if k8s_auth == "on" and kube_api is None:
+        from activemonitor_tpu.errors import ConfigurationError
+
+        raise ConfigurationError(
+            "--metrics-k8s-auth on needs cluster credentials "
+            "(--client k8s or --engine argo)"
+        )
+    if kube_api is not None and k8s_auth in ("auto", "on"):
+        from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+        metrics_authorizer = KubeScrapeAuthorizer(kube_api)
+
     # Manager construction validates the flag combination BEFORE the -f
     # manifests are applied (no side effects on a usage error)
     manager = Manager(
@@ -260,6 +285,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         metrics_cert_file=args.metrics_cert_file,
         metrics_key_file=args.metrics_key_file,
         metrics_auth_token_file=args.metrics_auth_token_file,
+        metrics_authorizer=metrics_authorizer,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))

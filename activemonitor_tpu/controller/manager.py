@@ -79,6 +79,7 @@ class Manager:
         metrics_key_file: str = "",
         metrics_auth_token: str = "",  # static bearer token; "" = open
         metrics_auth_token_file: str = "",  # re-read with a TTL (rotation)
+        metrics_authorizer=None,  # KubeScrapeAuthorizer: TokenReview+SAR
     ):
         self.client = client
         self.reconciler = reconciler
@@ -97,6 +98,7 @@ class Manager:
             initial=metrics_auth_token,
             on_error="clear",
         )
+        self._metrics_authorizer = metrics_authorizer
         from activemonitor_tpu.errors import ConfigurationError
 
         # one overlap decision drives both the secure refusal and the
@@ -343,26 +345,56 @@ class Manager:
             return
         from aiohttp import web
 
-        async def metrics(request):
-            # auth filter on the metrics endpoint only, like the
-            # reference's authn/z-filtered :8443 (cmd/main.go:74-81);
-            # health probes stay open for the kubelet
+        def static_token_matches(request) -> Optional[bool]:
+            """True/False against the static bearer token; None when no
+            static token is configured at all."""
             token = self._metrics_token.get()
             if self._metrics_token.path and not token:
                 # a token file was configured but yields nothing (not
                 # mounted yet / wrong path): FAIL CLOSED — the operator
                 # asked for auth, so an empty token must not mean "open"
-                return web.Response(status=401, text="unauthorized")
-            if token:
-                import hmac
+                return False
+            if not token:
+                return None
+            import hmac
 
+            auth = request.headers.get("Authorization", "")
+            # bytes compare: compare_digest on str raises for
+            # non-ASCII headers (fuzzed input would 500, not 401)
+            return hmac.compare_digest(
+                auth.encode("utf-8", "surrogateescape"),
+                f"Bearer {token}".encode(),
+            )
+
+        async def metrics(request):
+            # auth filter on the metrics endpoint only, like the
+            # reference's authn/z-filtered :8443 (cmd/main.go:74-81);
+            # health probes stay open for the kubelet
+            if self._metrics_authorizer is not None:
+                # K8s-native path (TokenReview + SubjectAccessReview):
+                # the CLUSTER decides who scrapes, per identity, via
+                # RBAC — exactly the reference's filter. The static
+                # token (if also configured) stays honored as the
+                # documented migration/fallback credential.
                 auth = request.headers.get("Authorization", "")
-                # bytes compare: compare_digest on str raises for
-                # non-ASCII headers (fuzzed input would 500, not 401)
-                if not hmac.compare_digest(
-                    auth.encode("utf-8", "surrogateescape"),
-                    f"Bearer {token}".encode(),
-                ):
+                bearer = auth[7:] if auth.startswith("Bearer ") else ""
+                verdict = await self._metrics_authorizer.allowed(bearer)
+                if verdict is not True:
+                    static = static_token_matches(request)
+                    if static is not True:
+                        if verdict is None:
+                            # authorizer infra failure and the fallback
+                            # credential (if any) didn't match: fail
+                            # closed, but tell the scraper it is US,
+                            # not them — a 401 here would send the
+                            # operator chasing good credentials
+                            return web.Response(
+                                status=503, text="authorization unavailable"
+                            )
+                        return web.Response(status=401, text="unauthorized")
+            else:
+                static = static_token_matches(request)
+                if static is False:
                     return web.Response(status=401, text="unauthorized")
             data = self.reconciler.metrics.exposition()
             return web.Response(

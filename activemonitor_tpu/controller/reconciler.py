@@ -382,6 +382,12 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
+            # NOTE: a transient engine error here deliberately PROPAGATES
+            # (unlike the remedy watch below): _watch_guarded aborts this
+            # attempt and requeues the whole check at the reference's 1s
+            # cadence (:204) — each retry gets a fresh poll window, so a
+            # long apiserver storm cannot eat the check's own timeout.
+            # The check's RBAC is not ephemeral, so aborting leaks nothing.
             if timed_out:
                 # the deadline verdict must come from the API server,
                 # not a possibly-lagging watch cache: a terminal phase
@@ -600,23 +606,40 @@ class HealthCheckReconciler:
 
     async def _process_remedy(self, hc: HealthCheck) -> None:
         await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_REMEDY)
+        # remedy RBAC is ephemeral (reference: :779-784) — and because
+        # it is the WRITE-capable identity, it must be torn down on
+        # every exit path: a parse error, a submit failure, or an engine
+        # exception mid-watch may not leave the SA/Role/Binding behind
+        # (the reference shares this leak shape at
+        # healthcheck_controller.go:773-784; we close it)
         try:
-            manifest = parse_remedy_workflow_from_healthcheck(hc)
-        except Exception:
+            try:
+                manifest = parse_remedy_workflow_from_healthcheck(hc)
+            except Exception:
+                self.recorder.event(
+                    hc,
+                    EVENT_WARNING,
+                    "Warning",
+                    "Error creating or submitting remedyworkflow",
+                )
+                raise
+            wf_name = await self.engine.submit(manifest)
             self.recorder.event(
-                hc,
-                EVENT_WARNING,
-                "Warning",
-                "Error creating or submitting remedyworkflow",
+                hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
             )
-            raise
-        wf_name = await self.engine.submit(manifest)
-        self.recorder.event(
-            hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
-        )
-        await self._watch_remedy_workflow(hc, wf_name)
-        # remedy RBAC is ephemeral (reference: :779-784)
-        await self.rbac.delete_rbac_for_workflow(hc)
+            await self._watch_remedy_workflow(hc, wf_name)
+        finally:
+            try:
+                await self.rbac.delete_rbac_for_workflow(hc)
+            except Exception:
+                # a failed teardown must not mask the original error;
+                # the next remedy run retries the delete via the
+                # collision-rename path
+                log.warning(
+                    "failed to delete ephemeral remedy RBAC for %s",
+                    hc.key,
+                    exc_info=True,
+                )
 
     async def _watch_remedy_workflow(self, hc: HealthCheck, wf_name: str) -> None:
         wf_namespace = hc.spec.remedy_workflow.resource.namespace
@@ -628,14 +651,38 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
-            if timed_out:
-                # the deadline verdict must come from the API server,
-                # not a possibly-lagging watch cache: a terminal phase
-                # that landed during a watch reconnect gap must win
-                getter = getattr(self.engine, "get_fresh", self.engine.get)
-                workflow = await getter(wf_namespace, wf_name)
-            else:
-                workflow = await self.engine.get(wf_namespace, wf_name)
+            try:
+                if timed_out:
+                    # the deadline verdict must come from the API server,
+                    # not a possibly-lagging watch cache: a terminal phase
+                    # that landed during a watch reconnect gap must win
+                    getter = getattr(self.engine, "get_fresh", self.engine.get)
+                    workflow = await getter(wf_namespace, wf_name)
+                else:
+                    workflow = await self.engine.get(wf_namespace, wf_name)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # transient errors must not abort the remedy watch: the
+                # finally in _process_remedy would tear down the WRITE-
+                # capable RBAC while the remedy workflow is still running
+                # and strand its later steps. Retry at the 1s requeue
+                # cadence; a persistent outage ends via the deadline
+                # (≈ the workflow's own activeDeadlineSeconds, so Argo
+                # is killing it too) and only then is the ephemeral
+                # identity reclaimed.
+                log.warning(
+                    "transient error polling remedy workflow %s/%s",
+                    wf_namespace,
+                    wf_name,
+                    exc_info=True,
+                )
+                if not timed_out:
+                    await self.clock.sleep(1.0)
+                    if ieb.expired():
+                        timed_out = True
+                    continue
+                workflow = {}  # deadline passed, confirm-read failed too
             if workflow is None:
                 return  # parent deleted / GC'd (reference: :806-810)
             status = workflow.get("status") or {}

@@ -21,7 +21,6 @@ Turns a HealthCheck's artifact into a submittable workflow manifest
 
 from __future__ import annotations
 
-from typing import Optional
 
 import yaml
 

@@ -111,8 +111,14 @@ class _NamespaceWatch:
             except asyncio.CancelledError:
                 # Task.cancelling() is 3.11+; requires-python allows 3.10
                 cancelling = getattr(asyncio.current_task(), "cancelling", None)
-                if cancelling is not None and cancelling():
-                    raise  # the CALLER is being cancelled — propagate
+                if cancelling is not None:
+                    if cancelling():
+                        raise  # the CALLER is being cancelled — propagate
+                elif not self._task.done():
+                    # 3.10 fallback: the child task has not finished, so
+                    # this CancelledError was delivered to US (the caller
+                    # being cancelled mid-await), not raised by the child
+                    raise
             except Exception:
                 pass
 

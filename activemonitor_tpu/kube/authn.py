@@ -1,0 +1,105 @@
+"""Kubernetes-native scrape authn/z — TokenReview + SubjectAccessReview.
+
+The reference guards /metrics with controller-runtime's
+``WithAuthenticationAndAuthorization`` filter
+(/root/reference/cmd/main.go:74-81): every scrape's bearer token is
+validated by the API server (TokenReview) and the resulting identity
+is authorized for the endpoint (SubjectAccessReview on the
+non-resource URL). This module is that filter for the aiohttp metrics
+endpoint: the cluster decides who may scrape, per identity, with RBAC
+— no shared static secret to rotate.
+
+Decisions are cached per token for a short TTL (the filter would
+otherwise issue two API-server round trips per scrape; controller-
+runtime caches the same way). Infra failures return ``None`` so the
+caller can apply its fallback policy (static token if configured,
+else fail closed) — an API-server blip must not silently open the
+endpoint.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from activemonitor_tpu.kube.client import KubeApi
+
+TOKENREVIEW_PATH = "/apis/authentication.k8s.io/v1/tokenreviews"
+SAR_PATH = "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+
+
+class KubeScrapeAuthorizer:
+    """allowed(token) -> True | False | None (infra failure)."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        path: str = "/metrics",
+        verb: str = "get",
+        cache_ttl: float = 60.0,
+        monotonic=time.monotonic,
+    ):
+        self._api = api
+        self._path = path
+        self._verb = verb
+        self._ttl = cache_ttl
+        self._monotonic = monotonic
+        # token -> (expiry, verdict); only definitive verdicts cached
+        self._cache: Dict[str, Tuple[float, bool]] = {}
+
+    async def allowed(self, token: str) -> Optional[bool]:
+        if not token:
+            return False
+        now = self._monotonic()
+        hit = self._cache.get(token)
+        if hit is not None and hit[0] > now:
+            return hit[1]
+
+        try:
+            review = await self._api.create(
+                TOKENREVIEW_PATH,
+                {
+                    "apiVersion": "authentication.k8s.io/v1",
+                    "kind": "TokenReview",
+                    "spec": {"token": token},
+                },
+            )
+        except Exception:
+            # includes 401/403 on OUR credentials (a setup problem —
+            # missing system:auth-delegator binding — not a verdict on
+            # the scraper): every failure to ASK is an infra failure,
+            # never a deny
+            return None
+        status = review.get("status") or {}
+        if not status.get("authenticated"):
+            self._remember(token, False, now)
+            return False
+        user = status.get("user") or {}
+
+        try:
+            sar = await self._api.create(
+                SAR_PATH,
+                {
+                    "apiVersion": "authorization.k8s.io/v1",
+                    "kind": "SubjectAccessReview",
+                    "spec": {
+                        "user": user.get("username", ""),
+                        "groups": user.get("groups") or [],
+                        "uid": user.get("uid", ""),
+                        "nonResourceAttributes": {
+                            "path": self._path,
+                            "verb": self._verb,
+                        },
+                    },
+                },
+            )
+        except Exception:
+            return None
+        verdict = bool((sar.get("status") or {}).get("allowed"))
+        self._remember(token, verdict, now)
+        return verdict
+
+    def _remember(self, token: str, verdict: bool, now: float) -> None:
+        if len(self._cache) > 1024:  # bound memory under token churn
+            self._cache.clear()
+        self._cache[token] = (now + self._ttl, verdict)

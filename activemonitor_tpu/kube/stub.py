@@ -70,6 +70,11 @@ class StubApiServer:
         # chaos injection (see inject_fault / drop_watches / latency)
         self.faults: List[dict] = []
         self.latency = 0.0
+        # TokenReview/SubjectAccessReview tables (kube-native scrape
+        # auth tests): token -> username it authenticates as, and the
+        # set of usernames allowed to GET non-resource /metrics
+        self.scrape_tokens: Dict[str, str] = {}
+        self.metrics_allowed_users: set = set()
 
     # -- store ----------------------------------------------------------
     def _bump(self) -> str:
@@ -328,6 +333,9 @@ class StubApiServer:
 
         key, namespace, _ = self._parse(request)
         body = await request.json()
+        if key[2] in ("tokenreviews", "subjectaccessreviews"):
+            # review APIs evaluate and answer — nothing is stored
+            return web.json_response(self._evaluate_review(key[2], body), status=201)
         meta = body.setdefault("metadata", {})
         if namespace:
             meta["namespace"] = namespace
@@ -346,6 +354,29 @@ class StubApiServer:
         self._bucket(key)[(namespace, name)] = body
         self._broadcast(key, namespace, "ADDED", body)
         return web.json_response(copy.deepcopy(body), status=201)
+
+    def _evaluate_review(self, plural: str, body: dict) -> dict:
+        """The authentication/authorization review APIs, table-driven:
+        ``scrape_tokens`` authenticates, ``metrics_allowed_users``
+        authorizes GETs of the non-resource /metrics path."""
+        spec = body.get("spec") or {}
+        if plural == "tokenreviews":
+            username = self.scrape_tokens.get(spec.get("token", ""))
+            status = (
+                {"authenticated": True, "user": {"username": username, "groups": []}}
+                if username
+                else {"authenticated": False}
+            )
+        else:
+            attrs = spec.get("nonResourceAttributes") or {}
+            status = {
+                "allowed": (
+                    spec.get("user", "") in self.metrics_allowed_users
+                    and attrs.get("path") == "/metrics"
+                    and attrs.get("verb") == "get"
+                )
+            }
+        return {**body, "status": status}
 
     async def _handle_object(self, request):
         return await self._object_rw(request, status_only=False)

@@ -44,6 +44,25 @@ def _sanitize(name: str) -> str:
     return _INVALID_CHARS.sub("_", name)
 
 
+def _prefix_dedupe(hc: str, metric: str) -> str:
+    """Join the hc-name prefix and metric name WITHOUT the reference's
+    stutter (collector.go:90 yields names like
+    ``tpu_ici_allreduce_ici_allreduce_busbw_gbps``): the longest token
+    suffix of the hc name that is also a token prefix of the metric
+    name is merged, so that example becomes
+    ``tpu_ici_allreduce_busbw_gbps``. Deliberate, documented divergence
+    (README metrics table): the per-check prefix survives (dashboards
+    can still group by it), the repetition does not. Distinct checks
+    whose merged names coincide stay separable via the
+    ``healthcheck_name`` label every custom gauge carries."""
+    hc_tokens = hc.split("_")
+    metric_tokens = metric.split("_")
+    for k in range(min(len(hc_tokens), len(metric_tokens)), 0, -1):
+        if hc_tokens[-k:] == metric_tokens[:k]:
+            return "_".join(hc_tokens + metric_tokens[k:])
+    return hc + "_" + metric
+
+
 class MetricsCollector:
     """Holds a registry; constructible per-test (the reference's global
     registry makes its own tests race — collector_test.go:82-88)."""
@@ -115,6 +134,10 @@ class MetricsCollector:
             registry=self.registry,
         )
         self._custom_gauges: Dict[str, Gauge] = {}
+        # (hc_name, merged_name) -> raw metric name: two DIFFERENT
+        # metrics from one check must never collapse onto one series
+        # (e.g. check a-b emitting b-c and c both merge to a_b_c)
+        self._custom_origin: Dict[tuple, str] = {}
         self._custom_lock = threading.Lock()
 
     # -- run accounting (reference call sites:
@@ -176,8 +199,28 @@ class MetricsCollector:
                 if not metric_name:
                     log.error("skipping invalid custom metric for %s: %r", hc_name, raw)
                     continue
-                full_name = _sanitize(hc_name) + "_" + _sanitize(metric_name)
+                full_name = _prefix_dedupe(
+                    _sanitize(hc_name), _sanitize(metric_name)
+                )
                 with self._custom_lock:
+                    origin = self._custom_origin.setdefault(
+                        (hc_name, full_name), metric_name
+                    )
+                    if origin != metric_name:
+                        # same check, different raw metric, same merged
+                        # name: recording would silently overwrite the
+                        # other metric's series — skip loudly instead
+                        # (never-raise contract, like the registration
+                        # collision below)
+                        log.error(
+                            "custom metric %r of %s merges to %s, already "
+                            "taken by metric %r of the same check; skipping",
+                            metric_name,
+                            hc_name,
+                            full_name,
+                            origin,
+                        )
+                        continue
                     gauge = self._custom_gauges.get(full_name)
                     if gauge is None:
                         try:

@@ -42,11 +42,14 @@ _NEG_INF = -1e30
 _LANES = 128
 # backward blocks default smaller than forward: the backward body holds
 # four [bq, bk] f32 temporaries (s, p, dp, ds) against the ~16 MB
-# scoped-VMEM limit
-# measured on v5e at S=2048 (contention-noisy tunnel, best-of-sweep):
+# scoped-VMEM limit.
+# Tuned from the reproducible sweep `python -m activemonitor_tpu.probes
+# flash-attention --sweep` (probes/flash.py sweep(); interleaved
+# best-of-rounds against tunnel contention). Measured on v5e at S=2048:
 # 512x512 ~25 TFLOP/s effective fwd+bwd, 1024x256 ~111, 2048x256 ~117 —
 # the tall-q/narrow-k shape wins decisively; 1024x256 keeps the causal
-# block skip meaningful at long sequence lengths
+# block skip meaningful at long sequence lengths. Re-run the sweep on
+# new silicon before trusting these.
 _BWD_BLOCK_Q = 1024
 _BWD_BLOCK_K = 256
 
@@ -318,25 +321,48 @@ def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int, scale
 
 
 def _check_blocks(seq: int, block_q: int, block_k: int):
+    """Clamp requested blocks to ``seq`` under the same tileability
+    rule the backward's ``_fit_block`` enforces: blocks must divide seq
+    AND be multiples of 8 (the vreg sublane width). A non-8-multiple
+    tile fails Mosaic compilation on real TPU even though CPU interpret
+    mode happily runs it — rejecting it here keeps the CPU test suite
+    honest about what the hardware accepts."""
     block_q = min(block_q, seq)
     block_k = min(block_k, seq)
     if seq % block_q or seq % block_k:
         raise ValueError(
             f"seq {seq} not divisible by blocks ({block_q}, {block_k})"
         )
+    if block_q % 8 or block_k % 8:
+        raise ValueError(
+            f"blocks ({block_q}, {block_k}) must be multiples of 8 to tile "
+            f"on TPU; pad seq {seq} to a multiple of 8 or use unfused attention"
+        )
+    # seq%8 with blocks%8==0 is impossible (blocks divide seq), so the
+    # two validators (_check_blocks for explicit blocks, _fit_block for
+    # adapted ones) enforce one tileability rule between them
     return block_q, block_k
 
 
 def _fit_block(seq: int, preferred: int) -> int:
     """Largest divisor of ``seq`` that is <= preferred and TPU-tileable
-    (a multiple of 8), falling back to ``seq`` itself (a block equal to
-    the array dim is always legal). The backward pass uses this so ANY
-    sequence the forward accepted can be differentiated — its block
-    preference must never re-impose a divisibility the caller's forward
-    blocks did not."""
+    (a multiple of 8). An 8-aligned ``seq`` always has one (itself, if
+    nothing smaller divides); a non-8-aligned ``seq`` has none, and the
+    only candidate tile (the whole seq) fails Mosaic compilation on real
+    TPU even though CPU interpret mode would run it — raise the same
+    clear error everywhere (_check_blocks, flash_attention_partial, the
+    backward pass) instead of letting CPU tests green-light a shape the
+    hardware rejects. The backward pass uses this so ANY sequence the
+    forward accepted can be differentiated — its block preference must
+    never re-impose a divisibility the caller's forward blocks did not."""
     for block in range(min(preferred, seq), 7, -1):
         if seq % block == 0 and block % 8 == 0:
             return block
+    if seq % 8:
+        raise ValueError(
+            f"seq {seq} has no TPU-tileable block (blocks must be multiples "
+            "of 8); pad seq to a multiple of 8 or use unfused attention"
+        )
     return seq
 
 
@@ -379,14 +405,17 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
     return out, lse
 
 
-def _backward_bhsd(q, k, v, out, lse, dout, causal: bool):
-    """dQ/dK/dV on [B, H, S, D] arrays via blockwise recompute."""
+def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None, block_k=None):
+    """dQ/dK/dV on [B, H, S, D] arrays via blockwise recompute.
+    ``block_q``/``block_k`` override the tuned defaults (the flash
+    probe's ``--sweep`` uses this to re-measure the table the defaults
+    cite)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     batch, heads, seq, head_dim = q.shape
-    block_q = _fit_block(seq, _BWD_BLOCK_Q)
-    block_k = _fit_block(seq, _BWD_BLOCK_K)
+    block_q = _fit_block(seq, block_q or _BWD_BLOCK_Q)
+    block_k = _fit_block(seq, block_k or _BWD_BLOCK_K)
     num_q, num_k = seq // block_q, seq // block_k
     scale = 1.0 / (head_dim ** 0.5)
     interpret = jax.devices()[0].platform != "tpu"

@@ -41,6 +41,22 @@ def stack_layer_params(layers) -> Dict:
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *layers)
 
 
+def stacked_layer_specs(pp_axis: str = "pp", tp_axis: str = "model") -> Dict:
+    """PartitionSpec tree matching :func:`stack_layer_params` output:
+    the leading layer axis splits over ``pp_axis`` (each stage holds
+    its own layers) and within each layer the megatron tensor-parallel
+    layout of probe_model.param_specs splits over ``tp_axis`` — the
+    spec tree that lets one parameter tree be pp×tp sharded at once."""
+    return {
+        "ln1": {"scale": P(pp_axis, None)},
+        "wqkv": P(pp_axis, None, None, tp_axis, None),  # heads sharded
+        "wo": P(pp_axis, tp_axis, None, None),
+        "ln2": {"scale": P(pp_axis, None)},
+        "w_up": P(pp_axis, None, tp_axis),  # hidden dim sharded
+        "w_down": P(pp_axis, tp_axis, None),
+    }
+
+
 def pipeline_forward_blocks(
     stacked_layers: Dict,
     x: jax.Array,
@@ -48,10 +64,22 @@ def pipeline_forward_blocks(
     mesh: Mesh,
     axis: str = "pp",
     num_microbatches: int = 0,
+    composed: bool = False,
 ) -> jax.Array:
     """Run the block stack over ``x`` [B, S, D] with the layers
     pipelined across ``mesh[axis]``. Embedding/head stay outside (they
     are cheap and replicated). Returns [B, S, D].
+
+    With ``composed=True`` the shard_map is MANUAL only over ``axis``
+    (``axis_names={axis}``): every other mesh axis stays
+    compiler-managed, so each stage's layer compute keeps whatever
+    data/tensor shardings its parameters and activations carry — this
+    is how dp×tp×pp composes on one mesh (the pipeline schedule is
+    hand-written ppermute over "pp"; the per-stage matmul collectives
+    over "model" and the gradient psum over "data" are still inserted
+    by XLA from the sharding annotations, the scaling-book split of
+    labor). Composed mode must run under ``jax.jit`` — partially-manual
+    shard_map has no eager path (JAX 0.9 rejects it outside a trace).
     """
     n_stages = mesh.shape[axis]
     batch = x.shape[0]
@@ -62,7 +90,14 @@ def pipeline_forward_blocks(
     if n_layers % n_stages:
         raise ValueError(f"{n_layers} layers do not split over {n_stages} stages")
 
-    micro = x.reshape(m, batch // m, *x.shape[1:])  # [M, mb, S, D]
+    # composed mode keeps the shard_map boundary (inputs, carries, the
+    # final psum) in float32: XLA's CPU AllReducePromotion pass (as of
+    # ~2026-07) crashes cloning the bf16 all-reduces that the
+    # partially-manual transpose emits ("Invalid binary instruction
+    # opcode copy"). Stage compute still runs in cfg.dtype; on TPU this
+    # costs 2x ppermute bytes in a path whose job is correctness.
+    wire_dt = jnp.float32 if composed else x.dtype
+    micro = x.astype(wire_dt).reshape(m, batch // m, *x.shape[1:])  # [M, mb, S, D]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def stage_apply(local_layers, act):
@@ -71,8 +106,8 @@ def pipeline_forward_blocks(
         def body(h, layer):
             return apply_block(h, layer, cfg), None
 
-        out, _ = jax.lax.scan(body, act, local_layers)
-        return out
+        out, _ = jax.lax.scan(body, act.astype(x.dtype), local_layers)
+        return out.astype(wire_dt)
 
     @partial(
         shard_map,
@@ -80,6 +115,7 @@ def pipeline_forward_blocks(
         in_specs=(P(axis), P(None, None, None, None)),
         out_specs=P(None, None, None, None),
         check_vma=False,
+        axis_names=frozenset({axis}) if composed else frozenset(),
     )
     def pipelined(local_layers, micro_all):
         # local_layers leaves: [layers_per_stage, ...]; micro_all: [M, mb, S, D]
@@ -115,4 +151,4 @@ def pipeline_forward_blocks(
         return jax.lax.psum(outputs * is_last, axis)
 
     out = pipelined(stacked_layers, micro)  # [M, mb, S, D]
-    return out.reshape(batch, *x.shape[1:])
+    return out.reshape(batch, *x.shape[1:]).astype(x.dtype)

@@ -16,7 +16,6 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-import jax.numpy as jnp
 
 
 def _scale_copy_kernel(in_ref, out_ref, *, scale):

@@ -69,6 +69,28 @@ def make_2d_mesh(
     return Mesh(np.array(devices).reshape(shape), axes)
 
 
+def make_mesh(
+    axes: Sequence[str],
+    shape: Sequence[int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """N-dimensional mesh (the ≥3-axis composed case: dp×tp×pp). On
+    real TPU, axes are aligned to the physical torus via
+    ``mesh_utils.create_device_mesh`` like :func:`make_2d_mesh`."""
+    explicit_devices = devices is not None
+    devices = list(devices if devices is not None else jax.devices())
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {tuple(shape)} does not fit {len(devices)} devices")
+    if not explicit_devices and devices and devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            return Mesh(mesh_utils.create_device_mesh(tuple(shape)), tuple(axes))
+        except Exception:  # unknown topology: fall back to id order
+            pass
+    return Mesh(np.array(devices).reshape(tuple(shape)), tuple(axes))
+
+
 def make_multihost_mesh(axes: Tuple[str, str] = ("dcn", "ici")) -> Mesh:
     """Hierarchical mesh for multi-host runs: the outer axis spans
     processes (hosts — traffic rides DCN between slices/hosts), the

@@ -127,11 +127,37 @@ def build_parser() -> argparse.ArgumentParser:
         "flash-attention", help="fused attention kernel correctness + throughput"
     )
     p.add_argument("--batch", type=int, default=4)
-    p.add_argument("--seq", type=int, default=4096)
+    p.add_argument(
+        "--seq",
+        type=int,
+        default=None,
+        help="sequence length (default: 4096, or 2048 for --sweep)",
+    )
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--no-causal", action="store_true")
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=2e-2,
+        help="forward max-abs-error gate; the gradient gate is a "
+        "documented 2.5x of this",
+    )
+    p.add_argument(
+        "--sweep",
+        action="store_true",
+        help="measure the (block_q, block_k) -> TFLOP/s tables the "
+        "kernel defaults cite (forward grid + backward shapes) "
+        "instead of the correctness/throughput probe",
+    )
+    p.add_argument(
+        "--sweep-rounds",
+        type=int,
+        default=2,
+        help="interleaved full passes over the sweep grid (per-config "
+        "best kept; guards against contention bursts)",
+    )
 
     p = sub.add_parser("decode", help="KV-cache decode-step latency + consistency")
     p.add_argument("--tiny", action="store_true")
@@ -293,14 +319,27 @@ def _dispatch(args) -> int:
     elif args.probe == "flash-attention":
         from activemonitor_tpu.probes import flash
 
-        result = flash.run(
-            batch=args.batch,
-            seq=args.seq,
-            heads=args.heads,
-            head_dim=args.head_dim,
-            iters=args.iters,
-            causal=not args.no_causal,
-        )
+        if args.sweep:
+            result = flash.sweep(
+                batch=args.batch,
+                # per-mode default only — an explicit --seq always wins
+                seq=args.seq if args.seq is not None else 2048,
+                heads=args.heads,
+                head_dim=args.head_dim,
+                iters=args.iters,
+                causal=not args.no_causal,
+                rounds=args.sweep_rounds,
+            )
+        else:
+            result = flash.run(
+                batch=args.batch,
+                seq=args.seq if args.seq is not None else 4096,
+                heads=args.heads,
+                head_dim=args.head_dim,
+                iters=args.iters,
+                causal=not args.no_causal,
+                tolerance=args.tolerance,
+            )
     elif args.probe == "decode":
         from activemonitor_tpu.probes import decode
 

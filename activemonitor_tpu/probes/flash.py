@@ -28,6 +28,181 @@ from activemonitor_tpu.probes.rated import rated_for
 from activemonitor_tpu.utils.timing import chain_delta_seconds
 
 
+def sweep(
+    batch: int = 4,
+    seq: int = 2048,
+    heads: int = 8,
+    head_dim: int = 128,
+    iters: int = 3,
+    causal: bool = True,
+    rounds: int = 2,
+    fwd_blocks: tuple = (256, 512, 1024, 2048),
+    bwd_blocks: tuple = ((512, 512), (1024, 256), (2048, 256), (1024, 512)),
+    train: bool = True,
+) -> ProbeResult:
+    """(block_q, block_k) → TFLOP/s tables — the measurements the
+    kernel defaults in ops/flash_attention.py cite, reproducible on
+    demand instead of comment-lore.
+
+    Forward sweeps a square-ish grid of (bq, bk); the backward sweep
+    times the dQ + dK/dV kernels DIRECTLY (chained through dout) over
+    the candidate (bwd_q, bwd_k) shapes, reporting effective fwd+bwd
+    TFLOP/s with the best forward config. ``rounds`` full passes are
+    interleaved round-robin and the per-config best kept — on a shared
+    chip a single pass can be skewed by a contention burst landing on
+    one config (utils/timing.py's drift rule, applied across configs).
+    Configs the hardware rejects (scoped-VMEM overflow) are recorded as
+    errors, not crashes."""
+    from activemonitor_tpu.ops.flash_attention import (
+        _backward_bhsd,
+        _forward_bhsd,
+    )
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if not on_tpu and seq > 256:
+        seq = 256  # interpret mode: keep the sweep finishable
+    dtype = jnp.bfloat16
+    keys = jax.random.split(jax.random.key(0), 3)
+    # kernel-native [B, H, S, D] layout so the sweep times the kernel,
+    # not the bshd transposes
+    q, k, v = (
+        jax.random.normal(kk, (batch, heads, seq, head_dim), dtype) for kk in keys
+    )
+    flops = attention_flops(batch, seq, heads, head_dim, causal)
+
+    def time_forward(bq, bk):
+        def make_chain(reps):
+            @jax.jit
+            def chain(q, k, v):
+                x = q
+                for _ in range(reps):
+                    x, _ = _forward_bhsd(x, k, v, causal, bq, bk)
+                return x.astype(jnp.float32).sum()
+
+            return chain
+
+        return flops / chain_delta_seconds(
+            make_chain, q, k, v, k1=1, k2=3, iters=iters
+        ) / 1e12
+
+    fwd_table: dict = {}
+    fwd_configs = [
+        (bq, bk)
+        for bq in fwd_blocks
+        for bk in fwd_blocks
+        if bq <= seq and bk <= seq and seq % bq == 0 and seq % bk == 0
+    ]
+    for _ in range(rounds):
+        for bq, bk in fwd_configs:
+            key = f"{bq}x{bk}"
+            try:
+                tflops = time_forward(bq, bk)
+            except Exception as exc:
+                fwd_table.setdefault(key, f"error: {str(exc)[:60]}")
+                continue
+            prev = fwd_table.get(key)
+            if not isinstance(prev, float) or tflops > prev:
+                fwd_table[key] = tflops
+
+    numeric = {k_: v for k_, v in fwd_table.items() if isinstance(v, float)}
+    best_fwd_key = max(numeric, key=numeric.get) if numeric else ""
+    best_fwd = numeric.get(best_fwd_key, 0.0)
+
+    metrics = [
+        ProbeMetric(
+            "flash-sweep-best-fwd-tflops",
+            best_fwd,
+            help="Best forward TFLOP/s across the block sweep",
+        )
+    ]
+    details = {
+        "batch": batch,
+        "seq": seq,
+        "heads": heads,
+        "head_dim": head_dim,
+        "causal": causal,
+        "rounds": rounds,
+        "forward_table_tflops": {
+            k_: (round(v, 1) if isinstance(v, float) else v)
+            for k_, v in fwd_table.items()
+        },
+        "best_forward": best_fwd_key,
+        "device_kind": device.device_kind,
+    }
+
+    train_table: dict = {}
+    best_train_key = ""
+    if train and best_fwd_key:
+        fbq, fbk = (int(x) for x in best_fwd_key.split("x"))
+        out, lse = _forward_bhsd(q, k, v, causal, fbq, fbk)
+        fwd_seconds = flops / (best_fwd * 1e12)
+
+        def time_backward(bq, bk):
+            def make_chain(reps):
+                @jax.jit
+                def chain(q, k, v, dout):
+                    x = dout
+                    for _ in range(reps):
+                        x, _, _ = _backward_bhsd(
+                            q, k, v, out, lse, x, causal,
+                            block_q=bq, block_k=bk,
+                        )
+                    return x.astype(jnp.float32).sum()
+
+                return chain
+
+            return chain_delta_seconds(
+                make_chain, q, k, v, out, k1=1, k2=3, iters=iters
+            )
+
+        bwd_configs = [
+            (bq, bk)
+            for bq, bk in bwd_blocks
+            if bq <= seq and bk <= seq and seq % bq == 0 and seq % bk == 0
+        ]
+        for _ in range(rounds):
+            for bq, bk in bwd_configs:
+                key = f"{bq}x{bk}"
+                try:
+                    bwd_seconds = time_backward(bq, bk)
+                except Exception as exc:
+                    train_table.setdefault(key, f"error: {str(exc)[:60]}")
+                    continue
+                # 3.5x fwd FLOPs: standard attention fwd+bwd accounting
+                eff = 3.5 * flops / (fwd_seconds + bwd_seconds) / 1e12
+                prev = train_table.get(key)
+                if not isinstance(prev, float) or eff > prev:
+                    train_table[key] = eff
+        numeric_t = {k_: v for k_, v in train_table.items() if isinstance(v, float)}
+        if numeric_t:
+            best_train_key = max(numeric_t, key=numeric_t.get)
+            metrics.append(
+                ProbeMetric(
+                    "flash-sweep-best-train-tflops",
+                    numeric_t[best_train_key],
+                    help="Best effective fwd+bwd TFLOP/s (backward-block sweep)",
+                )
+            )
+        details["train_table_tflops"] = {
+            k_: (round(v, 1) if isinstance(v, float) else v)
+            for k_, v in train_table.items()
+        }
+        details["best_backward"] = best_train_key
+
+    summary = (
+        f"flash sweep @ S={seq}: best fwd {best_fwd:.0f} TFLOP/s ({best_fwd_key})"
+        + (
+            f", best fwd+bwd {train_table[best_train_key]:.0f} TFLOP/s "
+            f"(bwd {best_train_key})"
+            if best_train_key
+            else ""
+        )
+        + ("" if on_tpu else " [interpret mode: timings not meaningful]")
+    )
+    return ProbeResult(ok=True, summary=summary, metrics=metrics, details=details)
+
+
 def run(
     batch: int = 4,
     seq: int = 4096,
@@ -90,7 +265,11 @@ def run(
             float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
             / norm,
         )
-    correct = max_err <= tolerance and grad_rel_err <= 5e-2
+    # backward accumulates one extra recompute rounding pass over the
+    # forward, so its gate is a documented 2.5x of --tolerance (default
+    # 2e-2 -> 5e-2) — tightening the flag tightens both verdicts
+    grad_tolerance = 2.5 * tolerance
+    correct = max_err <= tolerance and grad_rel_err <= grad_tolerance
 
     def make_chain(op):
         def factory(kreps):
@@ -173,6 +352,8 @@ def run(
         "causal": causal,
         "max_error": max_err,
         "grad_rel_error": grad_rel_err,
+        "tolerance": tolerance,
+        "grad_tolerance": grad_tolerance,
         "kernel": kernel,
         "per_variant_tflops": {k: round(v, 1) for k, v in per_variant.items()},
         "device_kind": device.device_kind,

@@ -80,7 +80,11 @@ def run(
     """``threshold`` is the worst/median timing ratio above which a
     device is flagged (collectives run at the slowest chip's pace, so
     1.25 means ~25 % of the whole slice's throughput is being lost)."""
-    devices = jax.devices()
+    # local devices only: on multi-host slices most of jax.devices() is
+    # non-addressable from this process and device_put would raise —
+    # each host measures its own chips (run the probe once per host to
+    # cover a pod; the battery runs host-local by construction)
+    devices = jax.local_devices()
     on_tpu = devices[0].platform == "tpu"
     if dim <= 0:
         dim = 2048 if on_tpu else 256
@@ -120,6 +124,8 @@ def run(
     ]
     details = {
         "devices": len(devices),
+        "hosts": jax.process_count(),
+        "host_local": jax.process_count() > 1,
         "dim": dim,
         "per_device_ms": {d: round(s * 1e3, 3) for d, s in per_device.items()},
         "median_ms": round(median * 1e3, 3),

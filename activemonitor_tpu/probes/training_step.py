@@ -17,7 +17,7 @@ scaling-book recipe rather than hand-written communication.
 from __future__ import annotations
 
 import time
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +83,98 @@ def build_sharded_train_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(param_sh, opt_sh, data_sh),
+        out_shardings=(param_sh, opt_sh, replicated),
+        donate_argnums=(0, 1),
+    )
+    return step_fn, params, opt_state, data_sh
+
+
+def build_composed_train_step(
+    cfg: ProbeModelConfig,
+    mesh: Mesh,
+    learning_rate: float = 1e-3,
+    num_microbatches: int = 0,
+):
+    """dp×tp×pp composed train step on ONE mesh — the ≥3-axis recipe.
+
+    ``mesh`` must carry axes ``data``, ``model`` and ``pp``
+    (size-1 axes are fine). The layer stack is stacked
+    (ops/pipeline.stack_layer_params) and sharded pp-major with
+    megatron tp inside each layer (stacked_layer_specs); the forward
+    pipelines microbatches over "pp" with the shard_map manual ONLY
+    over that axis, so each stage's layer compute keeps its dp×tp
+    shardings and XLA still inserts the "model" psums and "data"
+    gradient reductions. Requires cfg.n_layers % mesh.shape['pp'] == 0.
+
+    Returns (step_fn, params, opt_state, data_sharding) like
+    :func:`build_sharded_train_step`.
+    """
+    from activemonitor_tpu.models.probe_model import _rmsnorm
+    from activemonitor_tpu.ops.pipeline import (
+        pipeline_forward_blocks,
+        stack_layer_params,
+        stacked_layer_specs,
+    )
+
+    for needed in ("data", "model", "pp"):
+        if needed not in mesh.shape:
+            raise ValueError(f"composed mesh needs a '{needed}' axis, has {dict(mesh.shape)}")
+    if cfg.n_layers % mesh.shape["pp"]:
+        raise ValueError(
+            f"{cfg.n_layers} layers do not split over {mesh.shape['pp']} pp stages"
+        )
+
+    optimizer = optax.adamw(learning_rate)
+    specs = {
+        "embed": P(None, None),
+        "layers": stacked_layer_specs("pp", "model"),
+        "final_ln": {"scale": P()},
+    }
+    param_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    data_sh = NamedSharding(mesh, P("data", None))
+    replicated = NamedSharding(mesh, P())
+
+    raw = init_params(jax.random.key(0), cfg)
+    params = jax.device_put(
+        {
+            "embed": raw["embed"],
+            "layers": stack_layer_params(raw["layers"]),
+            "final_ln": raw["final_ln"],
+        },
+        param_sh,
+    )
+    opt_state = optimizer.init(params)
+    opt_sh = _opt_shardings(opt_state, param_sh, replicated)
+
+    def loss(params, tokens):
+        dt = cfg.dtype
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        x = params["embed"].astype(dt)[inputs]  # [B, S, D]
+        x = pipeline_forward_blocks(
+            params["layers"], x, cfg, mesh, "pp",
+            num_microbatches=num_microbatches, composed=True,
+        )
+        x = _rmsnorm(x, params["final_ln"]["scale"])
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"].astype(dt)
+        ).astype(jnp.float32)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def step(params, opt_state, tokens):
+        loss_value, grads = jax.value_and_grad(loss)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss_value
 
     step_fn = jax.jit(
         step,

@@ -122,7 +122,9 @@ def run(
     iters: int = 5,
     min_gbps: float = 0.0,
 ) -> ProbeResult:
-    device = jax.devices()[0]
+    # local device: jax.devices()[0] is non-addressable on processes
+    # other than 0 in multi-host runs — each host measures its own feed
+    device = jax.local_devices()[0]
     nbytes = int(size_mb * 1e6)
     nbytes -= nbytes % (4 * 1024)
 

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import Callable
 
-import jax
 
 
 def median_readback_seconds(fn: Callable, *args, iters: int = 5, warmup: int = 2) -> float:

@@ -5,12 +5,22 @@ Adaptive to the hardware it lands on (BASELINE.md):
 - multi-chip TPU: the north-star ICI all-reduce probe — fraction of
   rated ring bandwidth (target ≥ 0.9).
 - single-chip TPU: the MXU matmul probe — fraction of rated bf16 peak
-  (the per-chip floor under every distributed target).
+  (the per-chip floor under every distributed target) — plus secondary
+  metrics for the kernel work (flash-attention fwd and fwd+bwd
+  TFLOP/s, HBM stream fraction, int8 fraction) so perf claims are
+  driver-evidenced, not comment-lore.
 - CPU (virtual mesh): informational all-reduce GB/s.
 
 ``vs_baseline`` is measured / target-fraction (0.9): ≥1.0 beats the
 BASELINE.md bar. All timing uses the chain-difference method so tunnel
 and dispatch overhead cancel (utils/timing.py).
+
+Resilience: the device tunnel can wedge (observed: jax.devices() hangs
+forever), usually transiently. Reachability is probed in a killable
+subprocess with RETRIES spread over ~10 minutes, and the real TPU
+measurement itself runs in a killable subprocess under a deadline — a
+wedge at any point degrades to the CPU-mesh fallback with the real
+diagnostic instead of hanging the driver.
 """
 
 from __future__ import annotations
@@ -19,10 +29,14 @@ import json
 import os
 import subprocess
 import sys
+import time
 
-# a wedged device tunnel must degrade to a CPU-mesh measurement, not
-# hang the driver: probe reachability in a killable subprocess first
-_PROBE_TIMEOUT = float(os.environ.get("ACTIVEMONITOR_BENCH_PROBE_TIMEOUT", "180"))
+_PROBE_TIMEOUT = float(os.environ.get("ACTIVEMONITOR_BENCH_PROBE_TIMEOUT", "120"))
+_PROBE_ATTEMPTS = int(os.environ.get("ACTIVEMONITOR_BENCH_PROBE_ATTEMPTS", "4"))
+# deadline for the full TPU measurement pass (compiles included)
+_MEASURE_TIMEOUT = float(os.environ.get("ACTIVEMONITOR_BENCH_MEASURE_TIMEOUT", "1800"))
+_TARGET_FRACTION = 0.9
+
 _PROBE_SRC = (
     "import jax, jax.numpy as jnp;"
     "print(float(jax.jit(lambda a:(a@a).astype(jnp.float32).sum())"
@@ -31,43 +45,113 @@ _PROBE_SRC = (
 
 
 def _device_reachable() -> bool:
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC],
-            timeout=_PROBE_TIMEOUT,
-            capture_output=True,
-        )
-    except subprocess.TimeoutExpired:
-        print(
-            f"device probe hung past {_PROBE_TIMEOUT:.0f}s (wedged tunnel?)",
-            file=sys.stderr,
-        )
-        return False
-    if proc.returncode != 0:
-        # surface the real diagnostic (libtpu init error, plugin
-        # mismatch, OOM) instead of a misleading timeout claim
-        tail = proc.stderr.decode(errors="replace").strip().splitlines()[-8:]
-        print(
-            "device probe exited with "
-            f"{proc.returncode}:\n" + "\n".join(tail),
-            file=sys.stderr,
-        )
-        return False
-    return True
+    """Probe the device in a killable subprocess, retrying across a
+    ~10-minute window: tunnel wedges are transient (BENCH_r02 lost its
+    TPU artifact to a single 180s attempt that would have succeeded
+    minutes later)."""
+    for attempt in range(1, _PROBE_ATTEMPTS + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                timeout=_PROBE_TIMEOUT,
+                capture_output=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"device probe attempt {attempt}/{_PROBE_ATTEMPTS} hung past "
+                f"{_PROBE_TIMEOUT:.0f}s (wedged tunnel?)",
+                file=sys.stderr,
+            )
+        else:
+            if proc.returncode == 0:
+                return True
+            # surface the real diagnostic (libtpu init error, plugin
+            # mismatch, OOM) instead of a misleading timeout claim
+            tail = proc.stderr.decode(errors="replace").strip().splitlines()[-8:]
+            print(
+                f"device probe attempt {attempt}/{_PROBE_ATTEMPTS} exited with "
+                f"{proc.returncode}:\n" + "\n".join(tail),
+                file=sys.stderr,
+            )
+        if attempt < _PROBE_ATTEMPTS:
+            delay = 30.0 * attempt  # 30/60/90s between 4 attempts ≈ 11 min worst case
+            print(f"retrying device probe in {delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+    return False
 
 
-def main() -> int:
-    # known-CPU runs have no tunnel to hang on — skip the probe cost
-    want_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
-    if not want_cpu and not _device_reachable():
-        print("falling back to the virtual CPU mesh", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
-        want_cpu = True
+def _force_cpu_mesh() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _secondary_metrics() -> dict:
+    """Kernel/memory-path numbers measured alongside the primary on a
+    real chip. Each is individually guarded: one failing probe costs
+    that entry, not the bench artifact."""
+    secondary: dict = {}
+
+    def guarded(name, fn):
+        try:
+            fn()
+        except Exception as exc:  # pragma: no cover - depends on hardware
+            print(f"secondary metric {name} failed: {exc!r}", file=sys.stderr)
+            secondary[f"{name}_error"] = str(exc)[:200]
+
+    def flash():
+        from activemonitor_tpu.probes import flash as flash_probe
+
+        result = flash_probe.run(iters=3)
+        by_name = {m.name: m.value for m in result.metrics}
+        secondary["flash_attention_tflops"] = round(
+            by_name["flash-attention-tflops"], 2
+        )
+        if "flash-attention-train-tflops" in by_name:
+            secondary["flash_attention_train_tflops"] = round(
+                by_name["flash-attention-train-tflops"], 2
+            )
+        if "flash-attention-fraction-of-rated" in by_name:
+            secondary["flash_attention_fraction_of_rated"] = round(
+                by_name["flash-attention-fraction-of-rated"], 4
+            )
+        if "flash-attention-speedup" in by_name:
+            secondary["flash_attention_speedup_vs_xla"] = round(
+                by_name["flash-attention-speedup"], 2
+            )
+
+    def hbm():
+        from activemonitor_tpu.probes import hbm as hbm_probe
+
+        result = hbm_probe.run(iters=5)
+        by_name = {m.name: m.value for m in result.metrics}
+        secondary["hbm_stream_gbps"] = round(by_name["hbm-stream-gbps"], 1)
+        if "hbm-fraction-of-rated" in by_name:
+            secondary["hbm_stream_fraction_of_rated"] = round(
+                by_name["hbm-fraction-of-rated"], 4
+            )
+
+    def int8():
+        from activemonitor_tpu.probes import matmul as matmul_probe
+
+        result = matmul_probe.run(iters=5, dtype="int8")
+        by_name = {m.name: m.value for m in result.metrics}
+        secondary["mxu_int8_tops"] = round(by_name["mxu-int8-matmul-tops"], 1)
+        if "mxu-int8-fraction-of-rated" in by_name:
+            secondary["mxu_int8_fraction_of_rated"] = round(
+                by_name["mxu-int8-fraction-of-rated"], 4
+            )
+
+    guarded("flash_attention", flash)
+    guarded("hbm_stream", hbm)
+    guarded("mxu_int8", int8)
+    return secondary
+
+
+def _measure(want_cpu: bool) -> dict:
     import jax
 
     if want_cpu:
@@ -75,15 +159,23 @@ def main() -> int:
         # can override the env var; the config API outranks them
         jax.config.update("jax_platforms", "cpu")
 
+    # persistent compile cache: the secondary probes re-run kernels the
+    # battery already compiled on this chip
+    try:
+        from activemonitor_tpu.probes.suite import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
+    except Exception:
+        pass
+
     devices = jax.devices()
     n = len(devices)
     platform = devices[0].platform
-    target_fraction = 0.9
 
     if platform == "tpu" and n > 1:
         from activemonitor_tpu.probes import ici
 
-        result = ici.run(size_mb=64, iters=5, threshold=target_fraction)
+        result = ici.run(size_mb=64, iters=5, threshold=_TARGET_FRACTION)
         by_name = {m.name: m.value for m in result.metrics}
         fraction = by_name.get("ici-allreduce-fraction-of-rated")
         if fraction is not None:
@@ -91,7 +183,7 @@ def main() -> int:
                 "metric": "ici_allreduce_fraction_of_rated",
                 "value": round(fraction, 4),
                 "unit": "fraction",
-                "vs_baseline": round(fraction / target_fraction, 4),
+                "vs_baseline": round(fraction / _TARGET_FRACTION, 4),
             }
         else:
             doc = {
@@ -100,6 +192,7 @@ def main() -> int:
                 "unit": "GB/s",
                 "vs_baseline": 1.0,
             }
+        doc["secondary"] = _secondary_metrics()
     elif platform == "tpu":
         from activemonitor_tpu.probes import matmul
 
@@ -109,7 +202,7 @@ def main() -> int:
         # readings, while the median stays an honest estimate
         runs = []
         for _ in range(3):
-            result = matmul.run(iters=5, threshold=target_fraction)
+            result = matmul.run(iters=5, threshold=_TARGET_FRACTION)
             runs.append({m.name: m.value for m in result.metrics})
         runs.sort(key=lambda r: r.get("mxu-matmul-tflops", 0))
         by_name = runs[len(runs) // 2]
@@ -119,7 +212,7 @@ def main() -> int:
                 "metric": "mxu_bf16_fraction_of_rated",
                 "value": round(fraction, 4),
                 "unit": "fraction",
-                "vs_baseline": round(fraction / target_fraction, 4),
+                "vs_baseline": round(fraction / _TARGET_FRACTION, 4),
             }
         else:
             doc = {
@@ -128,6 +221,7 @@ def main() -> int:
                 "unit": "TFLOP/s",
                 "vs_baseline": 1.0,
             }
+        doc["secondary"] = _secondary_metrics()
     else:
         from activemonitor_tpu.probes import ici
 
@@ -139,7 +233,62 @@ def main() -> int:
             "unit": "GB/s",
             "vs_baseline": 1.0,
         }
-    print(json.dumps(doc))
+    doc["platform"] = platform
+    doc["n_devices"] = n
+    doc["device_kind"] = devices[0].device_kind
+    return doc
+
+
+def main() -> int:
+    if "--measure" in sys.argv:
+        # child mode: do the real measurement and print the JSON line.
+        # Only the TPU path spawns a child (CPU runs measure in-process
+        # — nothing to hang on), so this is never a CPU measurement.
+        print(json.dumps(_measure(want_cpu=False)))
+        return 0
+
+    # known-CPU runs have no tunnel to hang on — measure in-process
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        print(json.dumps(_measure(want_cpu=True)))
+        return 0
+
+    if _device_reachable():
+        # the measurement itself can also hit a mid-run wedge — run it
+        # killable so the driver never hangs on us
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure"],
+                timeout=_MEASURE_TIMEOUT,
+                capture_output=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"TPU measurement hung past {_MEASURE_TIMEOUT:.0f}s "
+                "(tunnel wedged mid-run?)",
+                file=sys.stderr,
+            )
+        else:
+            sys.stderr.write(proc.stderr.decode(errors="replace"))
+            lines = [
+                ln for ln in proc.stdout.decode(errors="replace").splitlines() if ln
+            ]
+            if proc.returncode == 0 and lines:
+                try:
+                    doc = json.loads(lines[-1])
+                except json.JSONDecodeError:
+                    doc = None
+                if doc is not None:
+                    print(json.dumps(doc))
+                    return 0
+            print(
+                f"TPU measurement exited with {proc.returncode}; "
+                "stdout tail: " + " | ".join(lines[-3:]),
+                file=sys.stderr,
+            )
+
+    print("falling back to the virtual CPU mesh", file=sys.stderr)
+    _force_cpu_mesh()
+    print(json.dumps(_measure(want_cpu=True)))
     return 0
 
 

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Real-cluster e2e tier, runnable locally with one command:
+#
+#   make kind-e2e
+#
+# Stands up a kind cluster (hack/kind-cluster.yaml), installs the CRD +
+# Argo (pinned, instance-id contract wired), runs the controller
+# against the cluster, applies examples/inline-hello.yaml and asserts
+# it reaches Succeeded with real per-check RBAC objects and Events.
+# The same steps run in CI (ci.yml kind-e2e job calls this script) —
+# reference equivalent: the manual kind flow in README.md:54-79.
+#
+# Requirements: kind, kubectl, docker, python (with this repo installed
+# or `pip install -e .`-able).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CLUSTER_NAME="${KIND_CLUSTER_NAME:-activemonitor-e2e}"
+KEEP_CLUSTER="${KEEP_CLUSTER:-0}"
+TIMEOUT_SECS="${E2E_TIMEOUT_SECS:-300}"
+CONTROLLER_PID=""
+
+cleanup() {
+  [ -n "$CONTROLLER_PID" ] && kill "$CONTROLLER_PID" 2>/dev/null || true
+  if [ "$KEEP_CLUSTER" != "1" ]; then
+    kind delete cluster --name "$CLUSTER_NAME" 2>/dev/null || true
+  fi
+}
+trap cleanup EXIT
+
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER_NAME"; then
+  kind create cluster --name "$CLUSTER_NAME" --config hack/kind-cluster.yaml
+fi
+kubectl config use-context "kind-$CLUSTER_NAME"
+
+echo "--- installing CRD, namespace, Argo"
+kubectl apply -f config/crd/activemonitor.keikoproj.io_healthchecks.yaml
+kubectl create namespace health --dry-run=client -o yaml | kubectl apply -f -
+./deploy/install-argo.sh
+
+echo "--- starting controller against the kind cluster"
+python -m activemonitor_tpu run --client k8s --engine argo \
+  --no-metrics-secure --metrics-bind-address 127.0.0.1:18443 \
+  --health-probe-bind-address 127.0.0.1:18081 &
+CONTROLLER_PID=$!
+sleep 5
+kill -0 "$CONTROLLER_PID" || { echo "controller died at startup"; exit 1; }
+
+echo "--- applying examples/inline-hello.yaml and waiting for Succeeded"
+python -m activemonitor_tpu apply --client k8s -f examples/inline-hello.yaml
+
+status=""
+deadline=$((SECONDS + TIMEOUT_SECS))
+while [ "$SECONDS" -lt "$deadline" ]; do
+  status=$(kubectl -n health get hc inline-hello \
+    -o jsonpath='{.status.status}' 2>/dev/null || true)
+  [ "$status" = "Succeeded" ] && break
+  sleep 5
+done
+if [ "$status" != "Succeeded" ]; then
+  echo "check never reached Succeeded (last: '$status'); dumping state"
+  kubectl -n health get hc -o yaml || true
+  kubectl -n health get workflows.argoproj.io -o wide || true
+  kubectl -n health get pods -o wide || true
+  exit 1
+fi
+
+echo "--- asserting real per-check RBAC + Events"
+kubectl -n health get serviceaccount activemonitor-probe-sa
+kubectl -n health get events \
+  --field-selector involvedObject.kind=HealthCheck | head
+
+echo "kind-e2e OK: inline-hello Succeeded with real RBAC and Events"

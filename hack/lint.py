@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""In-repo AST linter — the `make lint` / CI gate.
+
+The reference gates CI on golangci-lint
+(/root/reference/.github/workflows/golangci-lint.yml). This
+environment has no Python linter installed and installing one is not
+an option, so the gate is implemented here: a small pyflakes-style
+checker over the AST. Checks (lenient by construction — a false
+positive that makes `make lint` cry wolf is worse than a miss):
+
+- undefined-name: a Name load that no enclosing scope binds and
+  builtins don't provide (pyflakes F821, the check that catches real
+  bugs: typos, stale refactors, missing imports).
+- unused-import: imported at module scope, never referenced anywhere
+  in the file (F401). `__init__.py` re-exports are exempt.
+- unused-local: a simple `x = ...` local never read afterwards (F841);
+  only plain single-name targets, `_`-prefixed exempt.
+- bare-except: `except:` swallowing KeyboardInterrupt/SystemExit (E722).
+- mutable-default: list/dict/set literals as parameter defaults (B006).
+- f-string-no-placeholder: f"..." with nothing interpolated (F541).
+- duplicate-dict-key: literal dict with a repeated constant key (F601-ish).
+
+Usage: python hack/lint.py [paths...]   (default: the package + tests
++ the root entry points). Exit 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+from pathlib import Path
+
+DEFAULT_TARGETS = [
+    "activemonitor_tpu",
+    "tests",
+    "hack",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+BUILTINS = set(dir(builtins)) | {
+    "__file__",
+    "__name__",
+    "__doc__",
+    "__package__",
+    "__spec__",
+    "__loader__",
+    "__builtins__",
+    "__debug__",
+    "__annotations__",
+    "__dict__",
+    "__class__",
+}
+
+
+class Scope:
+    __slots__ = ("node", "bound", "loads", "global_names", "parent", "is_class")
+
+    def __init__(self, node, parent=None, is_class=False):
+        self.node = node
+        self.parent = parent
+        self.is_class = is_class
+        self.bound: set[str] = set()
+        self.loads: list[tuple[str, int, int]] = []
+        self.global_names: set[str] = set()
+
+
+class Checker(ast.NodeVisitor):
+    """One pass collecting bindings + loads per scope; resolution is
+    deferred to the end so forward references (functions referring to
+    later module-level names) never false-positive — the same two-phase
+    shape pyflakes uses."""
+
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.findings: list[tuple[int, str, str]] = []
+        self.module_scope = Scope(tree)
+        self.scopes = [self.module_scope]
+        self.all_scopes = [self.module_scope]
+        # import name -> (lineno, was it ever referenced anywhere)
+        self.imports: dict[str, int] = {}
+        self.referenced: set[str] = set()
+        self.has_star_import = False
+        self.is_init = path.endswith("__init__.py")
+        self.source = source
+
+    # -- scope plumbing -------------------------------------------------
+    @property
+    def scope(self) -> Scope:
+        return self.scopes[-1]
+
+    def bind(self, name: str) -> None:
+        if name in self.scope.global_names:
+            self.module_scope.bound.add(name)
+        else:
+            self.scope.bound.add(name)
+
+    def push(self, node, is_class=False) -> None:
+        scope = Scope(node, parent=self.scope, is_class=is_class)
+        self.scopes.append(scope)
+        self.all_scopes.append(scope)
+
+    def pop(self) -> None:
+        self.scopes.pop()
+
+    # -- names ----------------------------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.scope.loads.append((node.id, node.lineno, node.col_offset))
+            self.referenced.add(node.id)
+        else:  # Store / Del
+            self.bind(node.id)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.scope.global_names.update(node.names)
+        self.module_scope.bound.update(node.names)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        # lenient: treat as bound here and in the parent chain
+        self.scope.bound.update(node.names)
+
+    # -- imports --------------------------------------------------------
+    def _record_import(self, alias: ast.alias, node) -> None:
+        name = alias.asname or alias.name.split(".")[0]
+        if alias.name == "*":
+            self.has_star_import = True
+            return
+        self.bind(name)
+        if self.scope is self.module_scope and not alias.name.startswith("__"):
+            self.imports.setdefault(name, node.lineno)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self._record_import(alias, node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            for alias in node.names:
+                self.bind(alias.asname or alias.name)
+            return
+        for alias in node.names:
+            self._record_import(alias, node)
+
+    # -- definitions ----------------------------------------------------
+    def _visit_function(self, node) -> None:
+        self.bind(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for default in defaults:
+            self.visit(default)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(
+                    (
+                        default.lineno,
+                        "mutable-default",
+                        f"mutable default argument in {node.name}()",
+                    )
+                )
+        for annotation in self._annotations(node):
+            self.visit(annotation)
+        self.push(node)
+        args = node.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            self.scope.bound.add(a.arg)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.pop()
+
+    @staticmethod
+    def _annotations(node):
+        args = node.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            if a.annotation is not None:
+                yield a.annotation
+        if node.returns is not None:
+            yield node.returns
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            self.visit(default)
+        self.push(node)
+        args = node.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            self.scope.bound.add(a.arg)
+        self.visit(node.body)
+        self.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.bind(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for base in node.bases + [kw.value for kw in node.keywords]:
+            self.visit(base)
+        self.push(node, is_class=True)
+        self.scope.bound.add("__qualname__")
+        self.scope.bound.add("__module__")
+        for stmt in node.body:
+            self.visit(stmt)
+        self.pop()
+
+    def _visit_comprehension(self, node) -> None:
+        # first iterable evaluates in the enclosing scope
+        self.visit(node.generators[0].iter)
+        self.push(node)
+        for gen in node.generators:
+            self.visit(gen.target)
+            for cond in gen.ifs:
+                self.visit(cond)
+        for gen in node.generators[1:]:
+            self.visit(gen.iter)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self.pop()
+
+    visit_ListComp = _visit_comprehension
+    visit_SetComp = _visit_comprehension
+    visit_GeneratorExp = _visit_comprehension
+    visit_DictComp = _visit_comprehension
+
+    # -- other checks ---------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.findings.append(
+                (node.lineno, "bare-except", "bare `except:` (catches SystemExit)")
+            )
+        if node.name:
+            self.bind(node.name)
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # a format spec like `:.1e` parses as a placeholder-less
+        # JoinedStr — visiting it through visit_JoinedStr would flag
+        # every format spec in the file
+        self.visit(node.value)
+        if node.format_spec is not None:
+            for part in node.format_spec.values:
+                if isinstance(part, ast.FormattedValue):
+                    self.visit(part)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.findings.append(
+                (node.lineno, "f-string-no-placeholder", "f-string without placeholders")
+            )
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        seen: set = set()
+        for key in node.keys:
+            if isinstance(key, ast.Constant):
+                try:
+                    hashable = key.value
+                    if hashable in seen:
+                        self.findings.append(
+                            (
+                                key.lineno,
+                                "duplicate-dict-key",
+                                f"duplicate dict key {key.value!r}",
+                            )
+                        )
+                    seen.add(hashable)
+                except TypeError:
+                    pass
+        self.generic_visit(node)
+
+    # -- resolution -----------------------------------------------------
+    def finish(self) -> None:
+        for scope in self.all_scopes:
+            for name, lineno, _col in scope.loads:
+                if name in BUILTINS:
+                    continue
+                cursor = scope
+                found = False
+                while cursor is not None:
+                    # class scopes are invisible to nested function
+                    # scopes — but being lenient costs only misses
+                    if name in cursor.bound:
+                        found = True
+                        break
+                    cursor = cursor.parent
+                if not found and not self.has_star_import:
+                    self.findings.append(
+                        (lineno, "undefined-name", f"undefined name `{name}`")
+                    )
+        if not self.is_init and not self.has_star_import:
+            # a module-scope import only counts as used if the name is
+            # loaded somewhere OR re-exported via __all__
+            exported = self._all_exports()
+            for name, lineno in self.imports.items():
+                if name not in self.referenced and name not in exported:
+                    self.findings.append(
+                        (lineno, "unused-import", f"`{name}` imported but unused")
+                    )
+        self._unused_locals()
+
+    def _all_exports(self) -> set:
+        for node in self.module_scope.node.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                return {
+                    elt.value
+                    for elt in node.value.elts
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+                }
+        return set()
+
+    def _unused_locals(self) -> None:
+        for scope in self.all_scopes:
+            if scope is self.module_scope or scope.is_class:
+                continue
+            if not isinstance(scope.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            loads = {name for name, _l, _c in scope.loads}
+            # nested scopes may close over these locals
+            for inner in self.all_scopes:
+                cursor = inner
+                while cursor is not None:
+                    if cursor is scope and inner is not scope:
+                        loads |= {name for name, _l, _c in inner.loads}
+                    cursor = cursor.parent
+            for stmt in ast.walk(scope.node):
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    target = stmt.targets[0]
+                    if (
+                        isinstance(target, ast.Name)
+                        and not target.id.startswith("_")
+                        and target.id not in loads
+                        and target.id not in scope.global_names
+                        and self._owning_function(stmt, scope.node)
+                    ):
+                        self.findings.append(
+                            (
+                                stmt.lineno,
+                                "unused-local",
+                                f"local `{target.id}` assigned but never used",
+                            )
+                        )
+
+    def _owning_function(self, stmt, func_node) -> bool:
+        """True if stmt belongs to func_node directly (not to a nested
+        function, which has its own scope entry)."""
+        for node in ast.walk(func_node):
+            if node is stmt:
+                continue
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+                and node is not func_node
+                and any(n is stmt for n in ast.walk(node))
+            ):
+                return False
+        return True
+
+
+def lint_file(path: Path) -> list[str]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: syntax-error: {exc.msg}"]
+    checker = Checker(str(path), tree, source)
+    checker.visit(tree)
+    checker.finish()
+    return [
+        f"{path}:{lineno}: {code}: {message}"
+        for lineno, code, message in sorted(checker.findings)
+    ]
+
+
+def main(argv: list[str]) -> int:
+    targets = argv or DEFAULT_TARGETS
+    files: list[Path] = []
+    for target in targets:
+        p = Path(target)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    failures = 0
+    for f in files:
+        for line in lint_file(f):
+            print(line)
+            failures += 1
+    if failures:
+        print(f"\n{failures} lint finding(s)", file=sys.stderr)
+        return 1
+    print(f"lint OK ({len(files)} files)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

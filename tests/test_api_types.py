@@ -7,11 +7,9 @@ import yaml
 
 from activemonitor_tpu.api import (
     HealthCheck,
-    HealthCheckSpec,
     HealthCheckStatus,
     RemedyWorkflow,
     ResourceObject,
-    Workflow,
 )
 
 REFERENCE_STYLE_YAML = """

@@ -109,7 +109,7 @@ async def test_watch_survives_stream_drop():
         eng = ArgoWorkflowEngine(api)
         try:
             name = await eng.submit(dict(MANIFEST))
-            watch = await _warm_watch(eng)
+            await _warm_watch(eng)
             assert server.drop_watches() >= 1
             await asyncio.sleep(0.1)
             await api.merge_patch(

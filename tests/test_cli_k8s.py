@@ -7,7 +7,6 @@ import yaml
 import pytest
 
 from activemonitor_tpu.__main__ import _apply, _delete, _describe, _get, build_parser
-from activemonitor_tpu.kube import api_path
 
 from tests.kube_harness import stub_env
 

@@ -115,7 +115,7 @@ async def test_watch_sees_lifecycle_events():
 
     task = asyncio.create_task(watcher())
     await asyncio.sleep(0)
-    created = await c.apply(make_hc())
+    await c.apply(make_hc())
     fresh = await c.get("health", "hc-a")
     fresh.status.success_count = 1
     await c.update_status(fresh)

@@ -12,7 +12,6 @@ import asyncio
 
 import pytest
 
-from activemonitor_tpu import GROUP, VERSION
 from activemonitor_tpu.api import HealthCheck
 from activemonitor_tpu.controller import (
     ConflictError,
@@ -20,7 +19,6 @@ from activemonitor_tpu.controller import (
     MANAGED_BY_LABEL_KEY,
     MANAGED_BY_VALUE,
     NotFoundError,
-    RBACObject,
     RBACProvisioner,
 )
 from activemonitor_tpu.controller.client_k8s import PLURAL, KubernetesHealthCheckClient

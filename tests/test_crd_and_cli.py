@@ -2,7 +2,6 @@
 
 import json
 
-import pytest
 import yaml
 
 from activemonitor_tpu.__main__ import main

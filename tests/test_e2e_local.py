@@ -9,7 +9,6 @@ automated.
 """
 
 import asyncio
-import json
 
 import pytest
 

@@ -66,6 +66,22 @@ def test_feature_matrix_coverage():
     assert any(hc.spec.workflow.tpu is not None for hc in all_checks)  # TPU
     tpu_checks = [hc for hc in all_checks if hc.spec.workflow.tpu]
     assert any(hc.spec.workflow.tpu.chips == 8 for hc in tpu_checks)
+    # Argo loops pass through the spec mutator intact (reference:
+    # examples/inlineLoops.yaml)
+    assert any(
+        "withItems" in (hc.spec.workflow.resource.source.inline or "")
+        for hc in all_checks
+    )
+
+
+def test_loops_example_passes_withitems_through():
+    (hc,) = load_healthchecks("examples/inline-loops.yaml")
+    wf = parse_workflow_from_healthcheck(hc)
+    steps = wf["spec"]["templates"][0]["steps"]
+    assert steps[0][0]["withItems"] == [
+        "kubernetes.default.svc",
+        "metrics-server.kube-system.svc",
+    ]
 
 
 def test_tpu_example_gets_placement_injected():

@@ -3,7 +3,6 @@
 import asyncio
 
 import pytest
-import yaml
 
 from activemonitor_tpu.api import HealthCheck
 from activemonitor_tpu.controller.client import ConflictError, NotFoundError

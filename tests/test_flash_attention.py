@@ -124,7 +124,18 @@ def test_fit_block_prefers_tileable_divisors():
     assert _fit_block(4096, 1024) == 1024
     assert _fit_block(384, 256) == 192  # divisor, multiple of 8
     assert _fit_block(640, 256) == 160
-    assert _fit_block(100, 256) == 100  # no tileable divisor: whole seq
+    assert _fit_block(24, 1024) == 24  # 8-aligned seq: whole seq is legal
+    with pytest.raises(ValueError, match="no TPU-tileable block"):
+        _fit_block(100, 256)  # non-8-aligned: Mosaic would reject any tile
+
+
+def test_non_tileable_seq_rejected():
+    # seq=100 divides its clamped block (100) but a 100-row tile is not
+    # a multiple of 8 — Mosaic rejects it on real TPU, so the validator
+    # must reject it on CPU too instead of letting interpret mode pass
+    q, k, v = _qkv(seq=100)
+    with pytest.raises(ValueError, match="multiples of 8"):
+        flash_attention(q, k, v)
 
 
 def test_gradients_bf16_and_uneven_blocks():
@@ -227,3 +238,30 @@ def test_probe_contract_line_parses():
         "flash-attention-max-error",
         "flash-attention-tflops",
     }
+
+
+def test_probe_tolerance_drives_gradient_gate():
+    from activemonitor_tpu.probes import flash
+
+    # an absurdly tight tolerance must fail the combined verdict (the
+    # gradient gate is 2.5x of it — ADVICE r2: --tolerance must bite)
+    result = flash.run(batch=1, seq=128, heads=2, head_dim=64, iters=2, tolerance=1e-9)
+    assert not result.ok
+    assert result.details["grad_tolerance"] == 2.5e-9
+
+
+def test_sweep_produces_block_tables():
+    from activemonitor_tpu.probes import flash
+
+    result = flash.sweep(
+        batch=1, seq=128, heads=2, head_dim=64, iters=1, rounds=1,
+        fwd_blocks=(64, 128), bwd_blocks=((64, 64), (128, 64)),
+    )
+    assert result.ok
+    fwd = result.details["forward_table_tflops"]
+    assert set(fwd) == {"64x64", "64x128", "128x64", "128x128"}
+    assert result.details["best_forward"] in fwd
+    train = result.details["train_table_tflops"]
+    assert set(train) == {"64x64", "128x64"}
+    names = {m.name for m in result.metrics}
+    assert "flash-sweep-best-fwd-tflops" in names

@@ -11,7 +11,7 @@ import asyncio
 import pytest
 
 from activemonitor_tpu.kube import ApiError, KubeApi, KubeConfig, api_path, core_path
-from activemonitor_tpu.kube.stub import StubApiServer, merge_patch
+from activemonitor_tpu.kube.stub import merge_patch
 
 from tests.kube_harness import stub_env
 

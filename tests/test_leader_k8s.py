@@ -11,7 +11,7 @@ import asyncio
 import pytest
 
 from activemonitor_tpu.controller.leader import KubernetesLeaseElector
-from activemonitor_tpu.kube import ApiError, KubeApi, KubeConfig
+from activemonitor_tpu.kube import KubeApi, KubeConfig
 from activemonitor_tpu.utils.clock import FakeClock
 
 from tests.kube_harness import advance, stub_env

@@ -12,7 +12,7 @@ from activemonitor_tpu.controller import (
     InMemoryRBACBackend,
     RBACProvisioner,
 )
-from activemonitor_tpu.controller.leader import AlwaysLeader, FileLeaderElector
+from activemonitor_tpu.controller.leader import FileLeaderElector
 from activemonitor_tpu.controller.manager import Manager
 from activemonitor_tpu.engine import FakeWorkflowEngine, succeed_after
 from activemonitor_tpu.metrics import MetricsCollector
@@ -226,7 +226,7 @@ async def test_goodput_rollup():
             if hc.status.success_count >= 1:
                 break
         bad = make_hc("bad")
-        created = await client.apply(bad)
+        await client.apply(bad)
         fresh = await client.get("health", "bad")
         fresh.status.status = "Failed"
         fresh.status.finished_at = datetime.datetime.now(datetime.timezone.utc)

@@ -75,6 +75,69 @@ def test_custom_metrics_from_outputs(collector):
     )
 
 
+def test_custom_metric_name_overlap_deduped(collector):
+    # deliberate divergence from collector.go:90 (design.md #12): the
+    # hc-name prefix merges with the metric name's leading overlap
+    # instead of stuttering
+    status = {
+        "outputs": {
+            "parameters": [
+                {
+                    "name": "metrics",
+                    "value": '{"metrics": [{"name": "ici-allreduce-busbw-gbps", '
+                    '"value": 600.0}]}',
+                }
+            ]
+        }
+    }
+    assert collector.record_custom_metrics("tpu-ici-allreduce", status) == 1
+    assert (
+        collector.sample_value(
+            "tpu_ici_allreduce_busbw_gbps",
+            {"healthcheck_name": "tpu-ici-allreduce"},
+        )
+        == 600.0
+    )
+    # the stuttered reference name must NOT exist
+    assert (
+        collector.sample_value(
+            "tpu_ici_allreduce_ici_allreduce_busbw_gbps",
+            {"healthcheck_name": "tpu-ici-allreduce"},
+        )
+        is None
+    )
+
+
+def test_same_check_merged_name_collision_skipped(collector):
+    # check a-b emitting b-c and c: both merge to a_b_c — the second
+    # must be skipped (logged), never silently overwrite the first
+    status = {
+        "outputs": {
+            "parameters": [
+                {
+                    "name": "metrics",
+                    "value": '{"metrics": [{"name": "b-c", "value": 1.0}, '
+                    '{"name": "c", "value": 2.0}]}',
+                }
+            ]
+        }
+    }
+    assert collector.record_custom_metrics("a-b", status) == 1
+    assert collector.sample_value("a_b_c", {"healthcheck_name": "a-b"}) == 1.0
+
+
+def test_prefix_dedupe_rules():
+    from activemonitor_tpu.metrics.collector import _prefix_dedupe
+
+    assert _prefix_dedupe("tpu_ici_allreduce", "ici_allreduce_busbw_gbps") == (
+        "tpu_ici_allreduce_busbw_gbps"
+    )
+    assert _prefix_dedupe("hc", "bw") == "hc_bw"  # no overlap: plain join
+    assert _prefix_dedupe("hc", "hc") == "hc"  # full overlap
+    # overlap matches whole tokens only — "al" vs "allreduce" is no match
+    assert _prefix_dedupe("tpu_al", "allreduce_gbps") == "tpu_al_allreduce_gbps"
+
+
 def test_custom_metrics_updates_existing_gauge(collector):
     def status(v):
         return {

@@ -6,7 +6,6 @@ filter, self-signed fallback when no cert is supplied
 plaintext and unauthenticated for the kubelet.
 """
 
-import asyncio
 import ssl
 
 import pytest
@@ -296,5 +295,127 @@ def test_cli_defaults_secure():
     args = build_parser().parse_args(["run"])
     assert args.metrics_secure is True
     assert args.metrics_bind_address == ":8443"
+    assert args.metrics_k8s_auth == "auto"
     args = build_parser().parse_args(["run", "--no-metrics-secure"])
     assert args.metrics_secure is False
+
+
+# -- k8s-native scrape authn/z (TokenReview + SubjectAccessReview) -----
+# reference: cmd/main.go:74-81 WithAuthenticationAndAuthorization
+
+
+async def k8s_auth_manager(port, **kwargs):
+    """Manager wired to a stub apiserver playing the review APIs."""
+    from activemonitor_tpu.kube import KubeApi, KubeConfig
+    from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+    from activemonitor_tpu.kube.stub import StubApiServer
+
+    server = StubApiServer()
+    await server.start()
+    server.scrape_tokens["prom-token"] = "system:serviceaccount:monitoring:prometheus"
+    server.metrics_allowed_users.add("system:serviceaccount:monitoring:prometheus")
+    server.scrape_tokens["peon-token"] = "peon"  # authenticates, no RBAC
+    api = KubeApi(KubeConfig(server=server.url))
+    manager = make_manager(
+        metrics_bind_address=f"127.0.0.1:{port}",
+        metrics_authorizer=KubeScrapeAuthorizer(api),
+        **kwargs,
+    )
+    return server, api, manager
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_allows_rbac_authorized_identity():
+    port = free_port()
+    server, api, manager = await k8s_auth_manager(port)
+    await manager.start()
+    try:
+        # cluster-authorized identity scrapes
+        status, text = await fetch(
+            f"http://127.0.0.1:{port}/metrics", token="prom-token"
+        )
+        assert status == 200 and "healthcheck" in text
+        # authenticated but not RBAC-authorized for /metrics: denied
+        status, _ = await fetch(
+            f"http://127.0.0.1:{port}/metrics", token="peon-token"
+        )
+        assert status == 401
+        # unauthenticated / unknown token: denied
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="junk")
+        assert status == 401
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics")
+        assert status == 401
+    finally:
+        await manager.stop()
+        await api.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_static_token_stays_honored_as_fallback():
+    port = free_port()
+    server, api, manager = await k8s_auth_manager(
+        port, metrics_auth_token="legacy-scraper"
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(
+            f"http://127.0.0.1:{port}/metrics", token="legacy-scraper"
+        )
+        assert status == 200
+        status, _ = await fetch(f"http://127.0.0.1:{port}/metrics", token="junk")
+        assert status == 401
+    finally:
+        await manager.stop()
+        await api.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_fails_closed_when_apiserver_down():
+    """TokenReview infra failure + no fallback credential: 503, never
+    an open endpoint."""
+    port = free_port()
+    server, api, manager = await k8s_auth_manager(port)
+    await server.stop()  # apiserver gone before the first scrape
+    await manager.start()
+    try:
+        status, _ = await fetch(
+            f"http://127.0.0.1:{port}/metrics", token="prom-token"
+        )
+        assert status == 503
+    finally:
+        await manager.stop()
+        await api.close()
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_decision_is_cached():
+    port = free_port()
+    server, api, manager = await k8s_auth_manager(port)
+    await manager.start()
+    try:
+        for _ in range(3):
+            status, _ = await fetch(
+                f"http://127.0.0.1:{port}/metrics", token="prom-token"
+            )
+            assert status == 200
+        reviews = [p for _m, p in server.requests if "tokenreviews" in p]
+        assert len(reviews) == 1  # one TokenReview for three scrapes
+    finally:
+        await manager.stop()
+        await api.close()
+        await server.stop()
+
+
+def test_cli_k8s_auth_on_requires_cluster_credentials():
+    import asyncio as aio
+
+    from activemonitor_tpu.__main__ import _run_controller, build_parser
+    from activemonitor_tpu.errors import ConfigurationError
+
+    args = build_parser().parse_args(
+        ["run", "--engine", "local", "--metrics-k8s-auth", "on"]
+    )
+    with pytest.raises(ConfigurationError, match="cluster credentials"):
+        aio.run(_run_controller(args, "file", None, None))

@@ -6,11 +6,9 @@ import pytest
 from activemonitor_tpu.api import (
     ArtifactLocation,
     HealthCheck,
-    HealthCheckSpec,
     PolicyRule,
     RemedyWorkflow,
     ResourceObject,
-    Workflow,
 )
 from activemonitor_tpu.controller import (
     DEFAULT_HEALTHCHECK_RULES,

@@ -314,6 +314,58 @@ async def test_failure_triggers_remedy_and_cleans_rbac():
 
 
 @pytest.mark.asyncio
+async def test_remedy_rbac_cleaned_when_engine_fails_mid_watch():
+    # an engine exception while polling the remedy workflow must not
+    # leak the ephemeral WRITE-capable SA/Role/Binding into the cluster
+    # (the reference leaks here, healthcheck_controller.go:773-784)
+    def explode(wf, count):
+        raise RuntimeError("apiserver gone mid-remedy-watch")
+
+    h = Harness(succeed_after(1))
+    h.engine.on_prefix("check-", fail_after(1, "check failed"))
+    h.engine.on_prefix("remedy-", explode)
+    await h.apply_and_reconcile(make_hc(remedy=True))
+    # transient errors pace rather than abort: the verdict comes from
+    # the poll deadline (workflow timeout 10s), so drive time past it
+    await h.settle(15)
+    await h.reconciler.wait_watches()
+    st = await h.status()
+    assert st.remedy_status == "Failed"  # synthesized at the deadline
+    assert st.remedy_failed_count == 1
+    assert ("ServiceAccount", "health", "remedy-sa") not in h.backend.objects
+    assert ("ClusterRole", "", "remedy-sa-cluster-role") not in h.backend.objects
+    assert (
+        "ClusterRoleBinding",
+        "",
+        "remedy-sa-cluster-role-binding",
+    ) not in h.backend.objects
+    # the check's own (read-only) RBAC is not ephemeral and stays
+    assert ("ServiceAccount", "health", "check-sa") in h.backend.objects
+
+
+@pytest.mark.asyncio
+async def test_remedy_rbac_cleaned_when_submit_fails():
+    # same guarantee one step earlier: a submit() rejection (e.g. a 5xx
+    # storm) may not strand the write-capable identity either
+    h = Harness(succeed_after(1))
+    h.engine.on_prefix("check-", fail_after(1, "check failed"))
+    real_submit = h.engine.submit
+
+    async def submit(manifest):
+        name = manifest.get("metadata", {}).get("generateName", "")
+        if name.startswith("remedy-"):
+            raise RuntimeError("503 submitting remedy")
+        return await real_submit(manifest)
+
+    h.engine.submit = submit
+    await h.apply_and_reconcile(make_hc(remedy=True))
+    await h.settle()
+    await h.reconciler.wait_watches()
+    assert ("ServiceAccount", "health", "remedy-sa") not in h.backend.objects
+    assert ("ClusterRole", "", "remedy-sa-cluster-role") not in h.backend.objects
+
+
+@pytest.mark.asyncio
 async def test_remedy_failure_records_remedy_error():
     h = Harness(fail_after(1, "all failing"))
     await h.apply_and_reconcile(make_hc(remedy=True))

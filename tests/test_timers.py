@@ -1,7 +1,6 @@
 """Timer wheel tests (reference behavior: healthcheck_controller.go:745-754
 reschedule, :180-184 cancel-on-delete, :264-267 exists-for-dedupe)."""
 
-import asyncio
 
 import pytest
 
