@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMG ?= ghcr.io/activemonitor-tpu/controller:latest
 
-.PHONY: all test test-tpu bench crd manifests run lint kind-e2e docker-build install help
+.PHONY: all test test-tpu bench bench-tpu bench-tpu-watch crd manifests run lint kind-e2e docker-build install help
 
 all: test crd
 
@@ -15,6 +15,12 @@ test-tpu: ## opt into real-hardware tests
 
 bench: ## one-line JSON benchmark (adaptive to hardware)
 	$(PYTHON) bench.py
+
+bench-tpu: ## one opportunistic TPU capture -> BENCH_TPU.json + SWEEP_TPU.md
+	$(PYTHON) hack/tpu_evidence.py
+
+bench-tpu-watch: ## poll for hours, capture whenever the tunnel is healthy
+	$(PYTHON) hack/tpu_evidence.py --watch
 
 crd: ## regenerate the CRD manifest from the pydantic models
 	$(PYTHON) -m activemonitor_tpu crd > config/crd/activemonitor.keikoproj.io_healthchecks.yaml

@@ -1,0 +1,231 @@
+"""Opportunistic TPU-evidence harness.
+
+The device tunnel wedges for HOURS at a time (it ate the round-2 and
+round-3 driver bench artifacts despite bench.py retrying over ~11
+minutes).  Betting a round's perf evidence on one end-of-round window
+is the wrong capture strategy; this harness inverts it:
+
+    python hack/tpu_evidence.py --watch            # poll for hours
+    make bench-tpu                                 # one capture attempt
+
+Each cycle probes device reachability in a killable subprocess.  When
+the tunnel is healthy it runs the FULL capture — bench.py's primary
+metric, the secondary kernel metrics (flash fwd/bwd, HBM stream, int8),
+and the flash block-size sweep — in another killable subprocess, then
+atomically writes:
+
+- ``BENCH_TPU.json``  — machine-readable last-known-good TPU numbers,
+  timestamped; bench.py's CPU fallback embeds this block so even a
+  wedged end-of-round artifact carries real measurements.
+- ``SWEEP_TPU.md``    — the human-readable sweep tables that the block
+  defaults in ops/flash_attention.py cite.
+
+Writes are tmp+rename so a reader (bench.py, the driver, a human) never
+sees a torn file.  The harness never touches git: the builder commits
+artifacts deliberately, keeping the repo index free of daemon races.
+
+Reference analogue: the reference has no perf bar at all (BASELINE.md —
+no published numbers); this harness exists because OUR bar (BASELINE.md
+targets) requires driver-verifiable TPU measurements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "print(float(jax.jit(lambda a:(a@a).astype(jnp.float32).sum())"
+    "(jnp.ones((128,128), jnp.bfloat16))))"
+)
+
+
+def _log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[tpu-evidence {stamp}Z] {msg}", file=sys.stderr, flush=True)
+
+
+def device_reachable(timeout: float) -> bool:
+    """One killable probe attempt (no retries — the watch loop IS the
+    retry policy, spread over hours rather than minutes)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout,
+            capture_output=True,
+            cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"probe hung past {timeout:.0f}s (wedged tunnel)")
+        return False
+    if proc.returncode != 0:
+        tail = proc.stderr.decode(errors="replace").strip().splitlines()[-4:]
+        _log("probe exited %d: %s" % (proc.returncode, " | ".join(tail)))
+        return False
+    return True
+
+
+def _capture() -> dict:
+    """Child-mode body: run on the real device, return the evidence doc.
+
+    Imports bench.py for the primary + secondary measurement so the
+    harness can never drift from what the driver's bench reports.
+    """
+    sys.path.insert(0, _REPO)
+    import bench  # noqa: E402
+
+    doc = bench._measure(want_cpu=False)
+    if doc.get("platform") != "tpu":
+        raise SystemExit(f"capture landed on {doc.get('platform')}, not tpu")
+
+    from activemonitor_tpu.probes import flash as flash_probe
+
+    try:
+        sweep = flash_probe.sweep(rounds=2, iters=3)
+        doc["flash_sweep"] = {
+            "summary": sweep.summary,
+            "details": sweep.details,
+        }
+    except Exception as exc:  # pragma: no cover - hardware dependent
+        doc["flash_sweep"] = {"error": str(exc)[:200]}
+    return doc
+
+
+def _render_sweep_md(doc: dict) -> str:
+    """SWEEP_TPU.md — the block-size tables, human-readable."""
+    sweep = doc.get("flash_sweep", {})
+    details = sweep.get("details", {})
+    lines = [
+        "# Flash-attention block-size sweep (real TPU capture)",
+        "",
+        f"- captured: {doc.get('captured_at', '?')}",
+        f"- device: {doc.get('device_kind', '?')} ({doc.get('n_devices', '?')} chip)",
+        f"- shape: B={details.get('batch')} S={details.get('seq')} "
+        f"H={details.get('heads')} D={details.get('head_dim')} "
+        f"causal={details.get('causal')}",
+        "",
+        f"**{sweep.get('summary', sweep.get('error', 'capture failed'))}**",
+        "",
+    ]
+
+    def table(name: str, tbl: dict) -> list:
+        if not tbl:
+            return []
+        out = [f"## {name}", "", "| blocks (q×k) | TFLOP/s |", "|---|---|"]
+        for key, val in sorted(tbl.items()):
+            out.append(f"| {key} | {val} |")
+        out.append("")
+        return out
+
+    lines += table("Forward", details.get("forward_table_tflops", {}))
+    lines += table(
+        "Effective fwd+bwd (best fwd + swept bwd blocks)",
+        details.get("train_table_tflops", {}),
+    )
+    lines += [
+        "Captured by `hack/tpu_evidence.py` when the device tunnel was",
+        "healthy; regenerate with `make bench-tpu`.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def capture_once(args: argparse.Namespace) -> bool:
+    """Probe → capture → write artifacts. True on a committed capture."""
+    if not device_reachable(args.probe_timeout):
+        return False
+    _log("tunnel healthy — starting full capture (compiles may take minutes)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child-capture"],
+            timeout=args.capture_timeout,
+            capture_output=True,
+            cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"capture hung past {args.capture_timeout:.0f}s (mid-run wedge)")
+        return False
+    sys.stderr.write(proc.stderr.decode(errors="replace"))
+    lines = [ln for ln in proc.stdout.decode(errors="replace").splitlines() if ln]
+    if proc.returncode != 0 or not lines:
+        _log(f"capture exited {proc.returncode}")
+        return False
+    try:
+        doc = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        _log("capture emitted no JSON tail")
+        return False
+    doc["captured_at"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+    doc["harness"] = "hack/tpu_evidence.py"
+    out = os.path.join(_REPO, args.out)
+    _atomic_write(out, json.dumps(doc, indent=2) + "\n")
+    _atomic_write(os.path.join(_REPO, args.sweep_out), _render_sweep_md(doc))
+    _log(
+        f"captured {doc.get('metric')}={doc.get('value')} {doc.get('unit')} "
+        f"→ {args.out} + {args.sweep_out}"
+    )
+    return True
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--watch", action="store_true",
+                        help="poll until --max-hours instead of one attempt")
+    parser.add_argument("--interval", type=float, default=300.0,
+                        help="seconds between probes while wedged")
+    parser.add_argument("--refresh", type=float, default=7200.0,
+                        help="seconds between captures once one succeeded")
+    parser.add_argument("--max-hours", type=float, default=11.0,
+                        help="watch-mode lifetime")
+    parser.add_argument("--probe-timeout", type=float, default=90.0)
+    parser.add_argument("--capture-timeout", type=float, default=2400.0)
+    parser.add_argument("--out", default="BENCH_TPU.json")
+    parser.add_argument("--sweep-out", default="SWEEP_TPU.md")
+    parser.add_argument("--child-capture", action="store_true",
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    if args.child_capture:
+        print(json.dumps(_capture()))
+        return 0
+
+    if not args.watch:
+        ok = capture_once(args)
+        _log("capture %s" % ("succeeded" if ok else "failed — tunnel wedged?"))
+        return 0 if ok else 1
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    captured = 0
+    while time.monotonic() < deadline:
+        if capture_once(args):
+            captured += 1
+            sleep = args.refresh
+        else:
+            sleep = args.interval
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        time.sleep(min(sleep, remaining))
+    _log(f"watch window over — {captured} capture(s)")
+    return 0 if captured else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

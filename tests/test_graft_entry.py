@@ -95,3 +95,38 @@ def test_bench_emits_single_json_line():
     assert isinstance(doc["value"], (int, float))
     assert doc["platform"] == "cpu"
     assert doc["n_devices"] == 8
+    # honesty contract (VERDICT r3 weak #1): a CPU artifact must not
+    # read as "meets baseline", and must still evidence the kernels run
+    assert doc["vs_baseline"] is None
+    assert "flash_fwd_max_error_interpret" in doc["secondary"]
+    assert doc["secondary"]["flash_fwd_max_error_interpret"] < 2e-2
+    assert "flash_grad_rel_error_interpret" in doc["secondary"]
+    assert doc["secondary"]["composed_dp_tp_pp_loss"] > 0
+
+
+def test_last_known_good_tpu_block(tmp_path):
+    """The CPU fallback embeds the opportunistic harness's capture,
+    trimmed to the summary keys, with its timestamp."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    capture = {
+        "metric": "mxu_bf16_fraction_of_rated",
+        "value": 0.93,
+        "unit": "fraction",
+        "vs_baseline": 1.03,
+        "platform": "tpu",
+        "n_devices": 1,
+        "device_kind": "TPU v5e",
+        "secondary": {"flash_attention_tflops": 90.0},
+        "captured_at": "2026-07-29T12:00:00+00:00",
+        "flash_sweep": {"summary": "best fwd 90 TFLOP/s", "details": {"x": 1}},
+    }
+    path = tmp_path / "BENCH_TPU.json"
+    path.write_text(json.dumps(capture))
+    block = bench._last_known_good_tpu(str(path))
+    assert block["value"] == 0.93
+    assert block["captured_at"] == "2026-07-29T12:00:00+00:00"
+    assert block["flash_sweep_summary"] == "best fwd 90 TFLOP/s"
+    assert "details" not in str(block.get("flash_sweep", ""))
+    assert bench._last_known_good_tpu(str(tmp_path / "missing.json")) is None
