@@ -35,15 +35,25 @@ class ProbeModelConfig:
     d_ff: int = 2048
     max_seq_len: int = 512
     dtype: Any = jnp.bfloat16
+    # GQA/MQA: K/V heads (must divide n_heads); None = standard MHA.
+    # The fused kernel path (ops/flash_attention.py) runs grouped heads
+    # natively; the dense path repeats K/V heads for the einsum.
+    n_kv_heads: int | None = None
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
     def flops_per_token(self) -> float:
         """Approximate forward FLOPs/token (2·params matmul convention)."""
+        kv_dim = self.kv_heads * self.head_dim
         per_layer = (
-            2 * 4 * self.d_model * self.d_model  # qkv + out projections
+            2 * 2 * self.d_model * self.d_model  # q + out projections
+            + 2 * 2 * self.d_model * kv_dim  # k + v projections
             + 2 * 2 * self.d_model * self.d_ff  # up + down
         )
         embed = 2 * self.d_model * self.vocab_size
@@ -72,10 +82,20 @@ def init_params(key: jax.Array, cfg: ProbeModelConfig) -> Dict:
         "final_ln": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
     }
     for _ in range(cfg.n_layers):
+        if cfg.kv_heads == cfg.n_heads:
+            # MHA keeps the single fused projection (and its specs);
+            # key-draw order is part of the init contract — wqkv first
+            attn = {"wqkv": dense(next(k), (cfg.d_model, 3, cfg.n_heads, cfg.head_dim))}
+        else:
+            # GQA: separate q and (narrower) kv projections
+            attn = {
+                "wq": dense(next(k), (cfg.d_model, cfg.n_heads, cfg.head_dim)),
+                "wkv": dense(next(k), (cfg.d_model, 2, cfg.kv_heads, cfg.head_dim)),
+            }
         params["layers"].append(
             {
                 "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
-                "wqkv": dense(next(k), (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
+                **attn,
                 "wo": dense(next(k), (cfg.n_heads, cfg.head_dim, cfg.d_model)),
                 "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
                 "w_up": dense(next(k), (cfg.d_model, cfg.d_ff)),
@@ -87,9 +107,16 @@ def init_params(key: jax.Array, cfg: ProbeModelConfig) -> Dict:
 
 def param_specs(cfg: ProbeModelConfig) -> Dict:
     """PartitionSpec tree matching init_params: megatron tp over "model"."""
+    if cfg.kv_heads == cfg.n_heads:
+        attn = {"wqkv": P(None, None, "model", None)}  # heads sharded
+    else:
+        attn = {
+            "wq": P(None, "model", None),
+            "wkv": P(None, None, "model", None),  # kv heads sharded
+        }
     layer = {
         "ln1": {"scale": P()},
-        "wqkv": P(None, None, "model", None),  # heads sharded
+        **attn,
         "wo": P("model", None, None),
         "ln2": {"scale": P()},
         "w_up": P(None, "model"),  # hidden dim sharded
@@ -119,8 +146,14 @@ def apply_block(
     if attention_fn is None:
         attention_fn = partial(dense_causal_attention, cfg=cfg)
     h = _rmsnorm(x, layer["ln1"]["scale"])
-    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
-    attn = attention_fn(qkv[0], qkv[1], qkv[2])  # [B, S, H, K]
+    if "wqkv" in layer:
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
+        q, key, val = qkv[0], qkv[1], qkv[2]
+    else:  # GQA: separate q and narrower kv projections
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+        kv = jnp.einsum("bsd,dthk->tbshk", h, layer["wkv"].astype(dt))
+        key, val = kv[0], kv[1]
+    attn = attention_fn(q, key, val)  # [B, S, H, K]
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
     h = _rmsnorm(x, layer["ln2"]["scale"])
     up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt)))
@@ -156,15 +189,66 @@ def flash_attention_fn(cfg: ProbeModelConfig, mesh=None, axis: str = "model"):
             f"the '{axis}' mesh axis ({axis_size}); use dense attention "
             "or a smaller tensor-parallel group"
         )
+    if cfg.kv_heads % axis_size:
+        raise ValueError(
+            f"flash attention needs n_kv_heads ({cfg.kv_heads}) divisible "
+            f"by the '{axis}' mesh axis ({axis_size}); each shard must "
+            "hold whole K/V heads for its query-head group"
+        )
     spec = P("data" if "data" in mesh.shape else None, None, axis, None)
     return shard_map(
         fused, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
     )
 
 
+def ring_attention_fn(
+    cfg: ProbeModelConfig, mesh, axis: str = "sp", tp_axis: str = "model"
+):
+    """Attention override running sequence-parallel ring attention
+    (ops/ring_attention.py, differentiable via its custom VJP) inside a
+    composed train step.
+
+    The sequence dim shards over ``mesh[axis]``; batch rides "data" and
+    heads ride ``tp_axis`` when those axes exist — both are
+    embarrassingly parallel for the ring (the only communication is the
+    K/V rotation over ``axis``), so a dp×tp×sp step needs no extra
+    collectives beyond what the ring and XLA's sharding propagation
+    already insert."""
+    from activemonitor_tpu.ops.ring_attention import ring_attention
+
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"ring attention needs a {axis!r} mesh axis, mesh has {dict(mesh.shape)}"
+        )
+    if cfg.kv_heads != cfg.n_heads:
+        raise ValueError(
+            f"ring attention does not support GQA (n_kv_heads "
+            f"{cfg.kv_heads} != n_heads {cfg.n_heads}); use attention='flash' "
+            "(the fused kernel runs grouped heads natively) or dense"
+        )
+    heads_axis = None
+    if tp_axis in mesh.shape and mesh.shape[tp_axis] > 1:
+        if cfg.n_heads % mesh.shape[tp_axis]:
+            raise ValueError(
+                f"ring attention needs n_heads ({cfg.n_heads}) divisible by "
+                f"the {tp_axis!r} mesh axis ({mesh.shape[tp_axis]})"
+            )
+        heads_axis = tp_axis
+    spec = P("data" if "data" in mesh.shape else None, axis, heads_axis, None)
+
+    def ring(q, k, v):
+        return ring_attention(q, k, v, mesh, axis, causal=True, in_spec=spec)
+
+    return ring
+
+
 def dense_causal_attention(q, k, v, cfg: ProbeModelConfig):
     dt = cfg.dtype
     seq = q.shape[1]
+    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads for the einsum
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
     scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
         jnp.asarray(cfg.head_dim, dt)
@@ -227,9 +311,10 @@ def forward_context_parallel(
 
 
 def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:
-    """KV cache for autoregressive decoding: one [B, S, H, Dh] pair per
-    layer, float-typed in the compute dtype."""
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    """KV cache for autoregressive decoding: one [B, S, Hkv, Dh] pair
+    per layer, float-typed in the compute dtype. GQA caches only the
+    kv_heads — the memory win that motivates grouped heads in serving."""
+    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -250,20 +335,30 @@ def decode_step(
     x = params["embed"].astype(dt)[token]  # [B, D]
     max_seq = cache["k"].shape[2]
     visible = jnp.arange(max_seq) <= pos  # [S]
+    group = cfg.n_heads // cfg.kv_heads
     for li, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"]["scale"])
-        qkv = jnp.einsum("bd,dthk->tbhk", h, layer["wqkv"].astype(dt))
-        q, k_new, v_new = qkv[0], qkv[1], qkv[2]  # [B, H, K]
+        if "wqkv" in layer:
+            qkv = jnp.einsum("bd,dthk->tbhk", h, layer["wqkv"].astype(dt))
+            q, k_new, v_new = qkv[0], qkv[1], qkv[2]  # [B, H, K]
+        else:  # GQA: q over n_heads, k/v over the narrower kv_heads
+            q = jnp.einsum("bd,dhk->bhk", h, layer["wq"].astype(dt))
+            kv = jnp.einsum("bd,dthk->tbhk", h, layer["wkv"].astype(dt))
+            k_new, v_new = kv[0], kv[1]  # [B, Hkv, K]
         cache["k"] = cache["k"].at[li, :, pos].set(k_new)
         cache["v"] = cache["v"].at[li, :, pos].set(v_new)
-        keys = cache["k"][li]  # [B, S, H, K]
+        keys = cache["k"][li]  # [B, S, Hkv, K]
         values = cache["v"][li]
-        scores = jnp.einsum("bhk,bshk->bhs", q, keys) / jnp.sqrt(
+        # grouped view: [B, H, K] -> [B, Hkv, G, K]; each group of query
+        # heads attends its shared kv head straight out of the cache
+        qg = q.reshape(q.shape[0], cfg.kv_heads, group, cfg.head_dim)
+        scores = jnp.einsum("bhgk,bshk->bhgs", qg, keys) / jnp.sqrt(
             jnp.asarray(cfg.head_dim, dt)
         )
-        scores = jnp.where(visible[None, None, :], scores, jnp.asarray(-1e9, dt))
+        scores = jnp.where(visible[None, None, None, :], scores, jnp.asarray(-1e9, dt))
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
-        attn = jnp.einsum("bhs,bshk->bhk", probs, values)
+        attn = jnp.einsum("bhgs,bshk->bhgk", probs, values)
+        attn = attn.reshape(q.shape[0], cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"].astype(dt))
         h = _rmsnorm(x, layer["ln2"]["scale"])
         up = jax.nn.gelu(jnp.einsum("bd,df->bf", h, layer["w_up"].astype(dt)))
@@ -275,5 +370,6 @@ def decode_step(
 
 def param_count(cfg: ProbeModelConfig) -> int:
     d, f, v, h, k = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.head_dim
-    per_layer = d + 3 * d * h * k + h * k * d + d + d * f + f * d
+    qkv = d * h * k + 2 * d * cfg.kv_heads * k  # q + (possibly grouped) kv
+    per_layer = d + qkv + h * k * d + d + d * f + f * d
     return v * d + cfg.n_layers * per_layer + d

@@ -54,27 +54,57 @@ _BWD_BLOCK_Q = 1024
 _BWD_BLOCK_K = 256
 
 
-def _causal_mask(qi, ki, block_q: int, block_k: int):
+def _causal_mask(qi, ki, block_q: int, block_k: int, offset: int = 0):
+    """Causal visibility for one block pair. ``offset = seq_k - seq_q``
+    aligns the diagonal BOTTOM-RIGHT for cross-length attention (the
+    flash-attn convention): query row i attends keys ≤ i + offset, so a
+    decode-shaped call (q shorter than the KV it extends) sees the full
+    prefix and squares reduce to the standard mask (offset 0)."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return q_pos >= k_pos
+    return q_pos + offset >= k_pos
+
+
+def _block_mask(causal, qi, ki, block_q: int, block_k: int, offset: int,
+                kv_mask_from: int | None):
+    """Combined visibility mask for one block pair, or None when every
+    entry attends. ``kv_mask_from`` is the first INVALID key position
+    (real seq_k) when K/V were padded to a tileable length — padded
+    keys must never receive weight."""
+    mask = _causal_mask(qi, ki, block_q, block_k, offset) if causal else None
+    if kv_mask_from is not None:
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < kv_mask_from
+        mask = valid if mask is None else (mask & valid)
+    return mask
 
 
 def _make_attention_kernel(
     causal: bool, block_q: int, block_k: int, num_k: int, scale: float,
-    partial: bool,
+    partial: bool, offset: int = 0, kv_len: int | None = None,
 ):
     """One builder for both forward flavors — identical online-softmax
     body (init, causal visibility, attend, last-visible write point);
     only the finalize differs: the full kernel emits the normalized
     output + logsumexp, the ``partial`` kernel emits the raw
     (accumulator, max, denominator) merge state ring attention combines
-    across devices (ops/ring_attention.py)."""
+    across devices (ops/ring_attention.py). ``offset``/``kv_len``
+    generalize to cross-length attention and padded K/V (see
+    :func:`_block_mask`)."""
     from jax.experimental import pallas as pl
+
+    # only mask keys when padding actually added invalid positions
+    kv_mask_from = (
+        kv_len if kv_len is not None and kv_len < num_k * block_k else None
+    )
+    # last K block holding any VALID key (padded tail blocks are dead)
+    last_k = (kv_mask_from - 1) // block_k if kv_mask_from else num_k - 1
 
     def kernel(q_ref, k_ref, v_ref, *rest):
         if partial:
@@ -90,10 +120,12 @@ def _make_attention_kernel(
             m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
             l_ref[:] = jnp.zeros_like(l_ref)
 
-        # causal: K blocks strictly after this Q block's last row have
-        # nothing to attend — skip the matmuls entirely
-        q_last = qi * block_q + block_q - 1
+        # causal: K blocks strictly after this Q block's last attendable
+        # key have nothing to attend — skip the matmuls entirely (same
+        # for all-padding K blocks)
+        q_last = qi * block_q + block_q - 1 + offset
         visible = (ki * block_k <= q_last) if causal else (ki >= 0)
+        visible &= ki <= last_k
 
         @pl.when(visible)
         def _attend():
@@ -107,8 +139,8 @@ def _make_attention_kernel(
                 )
                 * scale
             )  # [block_q, block_k]
-            if causal:
-                mask = _causal_mask(qi, ki, block_q, block_k)
+            mask = _block_mask(causal, qi, ki, block_q, block_k, offset, kv_mask_from)
+            if mask is not None:
                 s = jnp.where(mask, s, _NEG_INF)
 
             m_prev = m_ref[:]  # [block_q, LANES] (broadcast rows)
@@ -120,7 +152,7 @@ def _make_attention_kernel(
             # entries, so clamp the shift (the row's p is 0 either way)
             shift = jnp.maximum(m_next[:, :1], _NEG_INF / 2)
             p = jnp.exp(s - shift)  # [block_q, block_k]
-            if causal:
+            if mask is not None:
                 p = jnp.where(mask, p, 0.0)
             alpha = jnp.exp(m_prev - jnp.maximum(m_next, _NEG_INF / 2))
             l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
@@ -132,7 +164,12 @@ def _make_attention_kernel(
             acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
 
         # write the outputs once, at this Q block's last visible K block
-        last_visible = (q_last // block_k) if causal else (num_k - 1)
+        # (clamped into range: a negative-offset Q block with nothing to
+        # attend still needs its write point so the output is zeroed)
+        if causal:
+            last_visible = jnp.clip(q_last // block_k, 0, last_k)
+        else:
+            last_visible = last_k
 
         @pl.when(ki == last_visible)
         def _finalize():
@@ -167,8 +204,9 @@ def flash_attention_partial(
     float32, denom [B, H, Sq])`` — the exact contract of ring
     attention's ``_block_attend`` so the K/V ring can merge fused block
     results across devices with its online-softmax recurrence. Not
-    differentiable (the ring path is a forward-only probe op); use
-    :func:`flash_attention` for training."""
+    differentiable itself — ring attention's own custom VJP pairs it
+    with :func:`flash_attention_backward_block` on the backward ring
+    pass; use :func:`flash_attention` for single-chip training."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -209,8 +247,14 @@ def flash_attention_partial(
     return m[..., 0], jnp.swapaxes(acc, 1, 2), l[..., 0]
 
 
-def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale: float):
+def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int,
+                    scale: float, offset: int = 0, kv_len: int | None = None):
     from jax.experimental import pallas as pl
+
+    kv_mask_from = (
+        kv_len if kv_len is not None and kv_len < num_k * block_k else None
+    )
+    last_k = (kv_mask_from - 1) // block_k if kv_mask_from else num_k - 1
 
     def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc):
         qi = pl.program_id(2)
@@ -220,8 +264,9 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale:
         def _init():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
-        q_last = qi * block_q + block_q - 1
+        q_last = qi * block_q + block_q - 1 + offset
         visible = (ki * block_k <= q_last) if causal else (ki >= 0)
+        visible &= ki <= last_k
 
         @pl.when(visible)
         def _accumulate():
@@ -238,8 +283,9 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale:
                 )
                 * scale
             )
-            if causal:
-                s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+            mask = _block_mask(causal, qi, ki, block_q, block_k, offset, kv_mask_from)
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)  # masked entries underflow to 0
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -251,7 +297,10 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale:
                 preferred_element_type=jnp.float32,
             )
 
-        last_visible = (q_last // block_k) if causal else (num_k - 1)
+        if causal:
+            last_visible = jnp.clip(q_last // block_k, 0, last_k)
+        else:
+            last_visible = last_k
 
         @pl.when(ki == last_visible)
         def _finalize():
@@ -260,23 +309,38 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int, scale:
     return kernel
 
 
-def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int, scale: float):
+def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int,
+                     scale: float, group: int = 1, offset: int = 0,
+                     kv_len: int | None = None, num_k: int | None = None):
+    """dK/dV kernel. Grid is (batch, heads_KV, num_k, group·num_q): for
+    GQA the inner sweep enumerates every (query head in the group,
+    Q block) pair while the SAME dk/dv accumulator block stays resident
+    in VMEM — the cross-head gradient sum happens in one consecutive
+    write window, never via racy revisits or a materialized per-q-head
+    gradient."""
     from jax.experimental import pallas as pl
+
+    kv_mask_from = (
+        kv_len
+        if kv_len is not None and num_k is not None and kv_len < num_k * block_k
+        else None
+    )
 
     def kernel(
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref, dv_ref, dk_acc, dv_acc,
     ):
         ki = pl.program_id(2)  # K block owns this grid row
-        qi = pl.program_id(3)  # Q sweep innermost
+        t = pl.program_id(3)  # (group, Q) sweep innermost
+        qi = jax.lax.rem(t, num_q)
 
-        @pl.when(qi == 0)
+        @pl.when(t == 0)
         def _init():
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
-        q_last = qi * block_q + block_q - 1
-        visible = (ki * block_k <= q_last) if causal else (qi >= 0)
+        q_last = qi * block_q + block_q - 1 + offset
+        visible = (ki * block_k <= q_last) if causal else (t >= 0)
 
         @pl.when(visible)
         def _accumulate():
@@ -293,8 +357,9 @@ def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int, scale
                 )
                 * scale
             )
-            if causal:
-                s = jnp.where(_causal_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+            mask = _block_mask(causal, qi, ki, block_q, block_k, offset, kv_mask_from)
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)  # [bq, bk]
             dv_acc[:] += jax.lax.dot_general(
                 p, do, (((0,), (0,)), ((), ())),
@@ -310,9 +375,9 @@ def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int, scale
                 preferred_element_type=jnp.float32,
             )  # ds^T @ q -> [bk, D]
 
-        # the LAST Q block attends every K block even under causality,
-        # so the write point is unconditional
-        @pl.when(qi == num_q - 1)
+        # the LAST (head, Q block) attends every K block even under
+        # causality, so the write point is unconditional
+        @pl.when(t == group * num_q - 1)
         def _finalize():
             dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
             dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -320,28 +385,24 @@ def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int, scale
     return kernel
 
 
-def _check_blocks(seq: int, block_q: int, block_k: int):
-    """Clamp requested blocks to ``seq`` under the same tileability
-    rule the backward's ``_fit_block`` enforces: blocks must divide seq
-    AND be multiples of 8 (the vreg sublane width). A non-8-multiple
-    tile fails Mosaic compilation on real TPU even though CPU interpret
-    mode happily runs it — rejecting it here keeps the CPU test suite
-    honest about what the hardware accepts."""
-    block_q = min(block_q, seq)
-    block_k = min(block_k, seq)
-    if seq % block_q or seq % block_k:
+def _check_block(seq: int, block: int) -> int:
+    """Clamp a requested block to ``seq`` under the same tileability
+    rule ``_fit_block`` enforces: the block must divide seq AND be a
+    multiple of 8 (the vreg sublane width). A non-8-multiple tile fails
+    Mosaic compilation on real TPU even though CPU interpret mode
+    happily runs it — rejecting it here keeps the CPU test suite honest
+    about what the hardware accepts. (The public wrapper pads + adapts
+    instead; this exact-fit validator guards the direct kernel entry
+    points the sweep measures.)"""
+    block = min(block, seq)
+    if seq % block:
+        raise ValueError(f"seq {seq} not divisible by block {block}")
+    if block % 8:
         raise ValueError(
-            f"seq {seq} not divisible by blocks ({block_q}, {block_k})"
+            f"block {block} must be a multiple of 8 to tile on TPU; "
+            f"pad seq {seq} to a multiple of 8 or use unfused attention"
         )
-    if block_q % 8 or block_k % 8:
-        raise ValueError(
-            f"blocks ({block_q}, {block_k}) must be multiples of 8 to tile "
-            f"on TPU; pad seq {seq} to a multiple of 8 or use unfused attention"
-        )
-    # seq%8 with blocks%8==0 is impossible (blocks divide seq), so the
-    # two validators (_check_blocks for explicit blocks, _fit_block for
-    # adapted ones) enforce one tileability rule between them
-    return block_q, block_k
+    return block
 
 
 def _fit_block(seq: int, preferred: int) -> int:
@@ -350,7 +411,7 @@ def _fit_block(seq: int, preferred: int) -> int:
     nothing smaller divides); a non-8-aligned ``seq`` has none, and the
     only candidate tile (the whole seq) fails Mosaic compilation on real
     TPU even though CPU interpret mode would run it — raise the same
-    clear error everywhere (_check_blocks, flash_attention_partial, the
+    clear error everywhere (_check_block, flash_attention_partial, the
     backward pass) instead of letting CPU tests green-light a shape the
     hardware rejects. The backward pass uses this so ANY sequence the
     forward accepted can be differentiated — its block preference must
@@ -366,20 +427,36 @@ def _fit_block(seq: int, preferred: int) -> int:
     return seq
 
 
-def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
-    """(out, lse) on [B, H, S, D] arrays; lse is [B, H, S] float32."""
+def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
+                  offset: int = 0, kv_len: int | None = None):
+    """(out, lse) on [B, H, S, D] arrays; lse is [B, H, Sq, 1] float32.
+
+    Generalized shapes: ``k``/``v`` may carry a different sequence
+    length (cross-attention; ``offset`` bottom-right-aligns the causal
+    diagonal) and FEWER heads than ``q`` (GQA/MQA — the BlockSpec index
+    map points each group of ``heads_q // heads_kv`` query heads at the
+    same K/V head, so grouped keys are read in place, never
+    materialized per-query-head)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    batch, heads, seq, head_dim = q.shape
-    block_q, block_k = _check_blocks(seq, block_q, block_k)
-    num_q, num_k = seq // block_q, seq // block_k
+    batch, heads, seq_q, head_dim = q.shape
+    heads_kv, seq_k = k.shape[1], k.shape[2]
+    group = heads // heads_kv
+    block_q = _check_block(seq_q, block_q)
+    block_k = _check_block(seq_k, block_k)
+    num_q, num_k = seq_q // block_q, seq_k // block_k
     scale = 1.0 / (head_dim ** 0.5)
     interpret = jax.devices()[0].platform != "tpu"
 
-    kernel = _make_attention_kernel(causal, block_q, block_k, num_k, scale, partial=False)
+    kernel = _make_attention_kernel(
+        causal, block_q, block_k, num_k, scale, partial=False,
+        offset=offset, kv_len=kv_len,
+    )
     spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
-    spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    spec_kv = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
+    )
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
@@ -387,7 +464,7 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
             # [B, H, S, 1]: the trailing singleton satisfies the TPU
             # block rule (last dim equal to the array's) without padding
             # the row statistics out to a full 128-lane vector
-            jax.ShapeDtypeStruct((batch, heads, seq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
         ),
         grid=(batch, heads, num_q, num_k),
         in_specs=[spec_q, spec_kv, spec_kv],
@@ -405,36 +482,64 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
     return out, lse
 
 
-def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None, block_k=None):
+def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None,
+                   block_k=None, offset: int = 0, kv_len: int | None = None):
     """dQ/dK/dV on [B, H, S, D] arrays via blockwise recompute.
     ``block_q``/``block_k`` override the tuned defaults (the flash
     probe's ``--sweep`` uses this to re-measure the table the defaults
     cite)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    batch, heads, seq, head_dim = q.shape
-    block_q = _fit_block(seq, block_q or _BWD_BLOCK_Q)
-    block_k = _fit_block(seq, block_k or _BWD_BLOCK_K)
-    num_q, num_k = seq // block_q, seq // block_k
-    scale = 1.0 / (head_dim ** 0.5)
-    interpret = jax.devices()[0].platform != "tpu"
-
     # D_i = rowsum(dO ∘ O) — cheap elementwise pass XLA fuses; the
     # kernels read it per Q row like the logsumexp
     delta = jnp.sum(
         dout.astype(jnp.float32) * out.astype(jnp.float32),
         axis=-1,
         keepdims=True,
-    )  # [B, H, S, 1]
+    )  # [B, H, Sq, 1]
+    return _backward_bhsd_core(
+        q, k, v, lse, delta, dout, causal,
+        _fit_block(q.shape[2], block_q or _BWD_BLOCK_Q),
+        _fit_block(k.shape[2], block_k or _BWD_BLOCK_K),
+        offset=offset, kv_len=kv_len,
+    )
+
+
+def _backward_bhsd_core(
+    q, k, v, lse, delta, dout, causal: bool, block_q: int, block_k: int,
+    out_dtype=None, offset: int = 0, kv_len: int | None = None,
+):
+    """The backward pallas calls with EXTERNAL per-row statistics.
+
+    ``lse``/``delta`` are [B, H, Sq, 1] float32. Factored out of
+    :func:`_backward_bhsd` so ring attention's backward can recompute
+    block probabilities against the GLOBAL logsumexp saved by its
+    forward (ops/ring_attention.py) — p = exp(s - lse) is then the true
+    global probability, and per-device dK/dV block contributions sum
+    exactly. ``out_dtype`` overrides the gradient dtype (the ring path
+    accumulates blocks across devices in float32). K/V may carry fewer
+    heads (GQA) and a different sequence length (cross-attention) than
+    Q — dK/dV come back in K/V's own shape with the query-head group
+    already summed."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq_q, head_dim = q.shape
+    heads_kv, seq_k = k.shape[1], k.shape[2]
+    group = heads // heads_kv
+    num_q, num_k = seq_q // block_q, seq_k // block_k
+    scale = 1.0 / (head_dim ** 0.5)
+    interpret = jax.devices()[0].platform != "tpu"
+    grad_dtype = out_dtype or q.dtype
 
     spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
-    spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    spec_kv = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
+    )
     spec_row = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
     dq = pl.pallas_call(
-        _make_dq_kernel(causal, block_q, block_k, num_k, scale),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        _make_dq_kernel(causal, block_q, block_k, num_k, scale,
+                        offset=offset, kv_len=kv_len),
+        out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype),
         grid=(batch, heads, num_q, num_k),
         in_specs=[spec_q, spec_kv, spec_kv, spec_q, spec_row, spec_row],
         out_specs=spec_q,
@@ -442,17 +547,25 @@ def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None, block_k=
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 
-    # dK/dV grid: K block outer, Q sweep inner — index maps swap i/j
-    spec_q_t = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    # dK/dV grid: K block outer, (group·Q) sweep inner — the index maps
+    # decompose the inner counter j into (query head in group, Q block)
+    spec_q_t = pl.BlockSpec(
+        (1, 1, block_q, head_dim),
+        lambda b, h, i, j: (b, h * group + j // num_q, j % num_q, 0),
+    )
     spec_kv_t = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, i, 0))
-    spec_row_t = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0))
+    spec_row_t = pl.BlockSpec(
+        (1, 1, block_q, 1),
+        lambda b, h, i, j: (b, h * group + j // num_q, j % num_q, 0),
+    )
     dk, dv = pl.pallas_call(
-        _make_dkv_kernel(causal, block_q, block_k, num_q, scale),
+        _make_dkv_kernel(causal, block_q, block_k, num_q, scale, group=group,
+                         offset=offset, kv_len=kv_len, num_k=num_k),
         out_shape=(
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, grad_dtype),
+            jax.ShapeDtypeStruct(v.shape, grad_dtype),
         ),
-        grid=(batch, heads, num_k, num_q),
+        grid=(batch, heads_kv, num_k, group * num_q),
         in_specs=[spec_q_t, spec_kv_t, spec_kv_t, spec_q_t, spec_row_t, spec_row_t],
         out_specs=(spec_kv_t, spec_kv_t),
         scratch_shapes=[
@@ -464,24 +577,102 @@ def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None, block_k=
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int):
-    out, _ = _forward_bhsd(q, k, v, causal, block_q, block_k)
+def flash_attention_backward_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lse: jax.Array,
+    delta: jax.Array,
+    dout: jax.Array,
+    causal: bool,
+    block_q: int | None = None,
+    block_k: int | None = None,
+):
+    """Fused backward for ONE (Q block, KV block) pair against GLOBAL
+    row statistics — ring attention's backward building block
+    (ops/ring_attention.py).
+
+    Layout matches :func:`flash_attention_partial`: q/dout are
+    ``[B, Sq, H, D]``, k/v ``[B, Sk, H, D]`` (``Sq == Sk`` per ring
+    step); ``lse``/``delta`` are ``[B, H, Sq]`` float32 — the GLOBAL
+    logsumexp from the ring forward and rowsum(dO ∘ O). Because p =
+    exp(s − lse_global) is the true global attention probability, the
+    (dq, dk, dv) this returns are exact per-block contributions that
+    the ring sums across devices. Gradients come back float32 in the
+    same ``[B, S, H, D]`` layout for that cross-device accumulation."""
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    if seq_q != seq_k:
+        raise ValueError(
+            f"ring block backward needs equal local blocks, got {seq_q} vs {seq_k}"
+        )
+    qt, kt, vt, dot = (jnp.swapaxes(x, 1, 2) for x in (q, k, v, dout))
+    dq, dk, dv = _backward_bhsd_core(
+        qt, kt, vt,
+        lse[..., None].astype(jnp.float32),
+        delta[..., None].astype(jnp.float32),
+        dot, causal,
+        _fit_block(seq_q, block_q or _BWD_BLOCK_Q),
+        _fit_block(seq_k, block_k or _BWD_BLOCK_K),
+        out_dtype=jnp.float32,
+    )
+    return (
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
+                offset: int = 0, kv_len: int | None = None):
+    out, _ = _forward_bhsd(q, k, v, causal, block_q, block_k, offset, kv_len)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _forward_bhsd(q, k, v, causal, block_q, block_k)
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, offset, kv_len):
+    out, lse = _forward_bhsd(q, k, v, causal, block_q, block_k, offset, kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_k, residuals, dout):
+def _flash_bhsd_bwd(causal, block_q, block_k, offset, kv_len, residuals, dout):
     q, k, v, out, lse = residuals
-    dq, dk, dv = _backward_bhsd(q, k, v, out, lse, dout, causal)
+    dq, dk, dv = _backward_bhsd(
+        q, k, v, out, lse, dout, causal, offset=offset, kv_len=kv_len
+    )
     return dq, dk, dv
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def _pad_seq(x: jax.Array, pad: int) -> jax.Array:
+    """Zero-pad the seq dim (axis 2 of [B, H, S, D])."""
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+def _plan_padding(seq: int, preferred: int) -> tuple:
+    """(padded_seq, block): how much to pad one sequence side and which
+    block to run it with.
+
+    Padding to the next 8-multiple is always needed (Mosaic's tiling
+    unit). On top of that, when the only tileable divisor COLLAPSES far
+    below the requested block (e.g. seq=136 → sole divisor 8 — a
+     17×17 grid of tiny tiles instead of one MXU-sized block), pad
+    further to the next multiple of the requested block instead: a few
+    masked rows are far cheaper than an order-of-magnitude block-size
+    cliff. The fitted divisor wins whenever it stays within 2× of the
+    request (seq=192 with 128-blocks runs 96-blocks on 192 rows, better
+    than 128-blocks on a padded 256)."""
+    pad8 = seq + ((-seq) % 8)
+    block = _fit_block(pad8, preferred)
+    target = min(preferred, pad8)
+    target = max(8, target - target % 8)
+    if block * 2 < target:
+        padded = -(-seq // target) * target
+        return padded, target
+    return pad8, block
 
 
 def flash_attention(
@@ -496,27 +687,54 @@ def flash_attention(
     """Fused attention, differentiable (custom VJP with blockwise
     recompute from the saved logsumexp — flash-attention backward).
 
+    Shapes real models run (all differentiable):
+
+    - **GQA/MQA** — ``k``/``v`` may carry fewer heads than ``q`` (any
+      divisor, down to 1 for MQA). The kernels point each query-head
+      group at its shared K/V head via the BlockSpec index map; grouped
+      K/V are never materialized per query head, and the dK/dV kernel
+      sums the group's gradient in one resident VMEM accumulator.
+    - **Cross-attention / decode** — ``seq_k`` may differ from
+      ``seq_q``. Causal masking is bottom-right aligned (query row i
+      attends keys ≤ i + seq_k − seq_q), so a short-q-long-KV decode
+      call sees its full prefix; equal lengths reduce to the standard
+      mask.
+    - **Any sequence length** — non-8-multiple lengths (Mosaic's tiling
+      unit) are zero-padded to the next multiple and the padded keys
+      masked out; outputs/gradients are sliced back, so callers never
+      see the padding.
+
     ``layout="bshd"`` takes ``[batch, seq, heads, head_dim]`` (what
     ops/ring_attention.py uses) and transposes to the kernel's native
     ``[batch, heads, seq, head_dim]``; pass ``layout="bhsd"`` when the
     caller already keeps heads-major arrays to skip the transpose passes
-    (3 HBM round-trips per call). Sequence length must be divisible by
-    the block sizes (blocks are clamped to seq; the backward pass picks
+    (3 HBM round-trips per call). Requested blocks adapt to the largest
+    tileable divisor of each (padded) sequence; the backward pass picks
     its own blocks — preferring 1024x256 against the scoped-VMEM limit,
-    shrunk to fit any seq the forward accepted).
+    shrunk to fit any seq the forward accepted.
 
     Default forward blocks are the measured optimum on v5e (bq=bk=1024:
     ~90 TFLOP/s causal at S=4096, ~4-5x the unfused XLA attention on
     the same chip; bigger blocks exceed the 16 MB scoped-VMEM limit)."""
     if layout == "bshd":
-        batch, seq, heads, head_dim = q.shape
+        seq_axis, head_axis = 1, 2
     elif layout == "bhsd":
-        batch, heads, seq, head_dim = q.shape
+        seq_axis, head_axis = 2, 1
     else:
         raise ValueError(f"layout must be bshd or bhsd, got {layout!r}")
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
-    block_q, block_k = _check_blocks(seq, block_q, block_k)
+    batch, head_dim = q.shape[0], q.shape[3]
+    seq_q, heads = q.shape[seq_axis], q.shape[head_axis]
+    seq_k, heads_kv = k.shape[seq_axis], k.shape[head_axis]
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} {v.shape}")
+    if k.shape[0] != batch or k.shape[3] != head_dim:
+        raise ValueError(
+            f"q/k batch or head_dim differ: {q.shape} vs {k.shape}"
+        )
+    if heads % heads_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({heads}) divisible by n_kv_heads ({heads_kv})"
+        )
 
     # [B, S, H, D] -> [B, H, S, D]: the kernels tile the last two dims
     # (seq-block × head_dim), which is the MXU-friendly layout
@@ -525,7 +743,29 @@ def flash_attention(
     else:
         qt, kt, vt = q, k, v
 
-    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k)
+    if causal and seq_q > seq_k:
+        raise ValueError(
+            f"causal attention with seq_q ({seq_q}) > seq_k ({seq_k}) leaves "
+            "leading queries with no visible keys (undefined softmax rows); "
+            "pass causal=False or align the sequences"
+        )
+
+    # pad to Mosaic's 8-row tiling unit — or further, to the requested
+    # block, when the seq's divisor structure would collapse the block
+    # size (_plan_padding); padded keys are masked via kv_len, padded
+    # query rows produce zero cotangents (the output slice's
+    # pad-transpose) so they perturb nothing
+    seq_q_p, block_q = _plan_padding(seq_q, block_q)
+    seq_k_p, block_k = _plan_padding(seq_k, block_k)
+    qt = _pad_seq(qt, seq_q_p - seq_q)
+    kt, vt = _pad_seq(kt, seq_k_p - seq_k), _pad_seq(vt, seq_k_p - seq_k)
+    # causal alignment uses REAL lengths: padding never shifts the diagonal
+    offset = (seq_k - seq_q) if causal else 0
+    kv_len = seq_k if seq_k_p != seq_k else None
+
+    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k, offset, kv_len)
+    if seq_q_p != seq_q:
+        out = out[:, :, :seq_q]
     return jnp.swapaxes(out, 1, 2) if layout == "bshd" else out
 
 

@@ -7,6 +7,17 @@ an online (flash-style) softmax — peak memory stays O(S/n) per device
 and all communication is neighbor-hop ICI traffic that overlaps with
 block compute under XLA's scheduler.
 
+TRAINING-GRADE: the op carries a ``jax.custom_vjp``. The forward scan
+also produces the GLOBAL logsumexp per query row; the backward runs a
+second ring pass that rotates K/V again and recomputes each block's
+probabilities as ``p = exp(s − lse_global)`` — exact global attention
+probabilities, so per-block dK/dV contributions sum exactly. The dK/dV
+accumulators rotate WITH their K/V blocks (the accumulator for block j
+starts at home, visits every device collecting that device's Q-block
+contribution, and lands home after n hops), keeping backward memory
+O(S/n) per device too — the sequence-parallel axis can appear in a
+differentiated train step (build_sharded_train_step(attention="ring")).
+
 Used by the ``ring-attention`` probe both as a correctness check
 (sequence-parallel result must match single-device attention) and as a
 sequence-parallelism bandwidth/throughput canary for long-context
@@ -22,7 +33,7 @@ mask, earlier blocks attend fully.
 
 from __future__ import annotations
 
-from functools import partial
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -61,13 +72,14 @@ def _block_attend(q, k, v, mask):
 def _ring_attention_sharded(
     q, k, v, *, axis_name: str, n_devices: int, causal: bool, use_flash: bool
 ):
-    """Body run per device inside shard_map. The ring rotation is a
-    ``lax.scan`` — one traced step regardless of ring size, so compile
-    time and HLO size stay flat as slices grow. With ``use_flash`` the
-    per-step block compute runs the fused Pallas kernel
-    (ops/flash_attention.py partial mode) instead of XLA einsums —
-    same (max, unnormalized out, denom) merge contract, but the local
-    score matrix stays in VMEM."""
+    """Body run per device inside shard_map; returns ``(out, lse)``
+    where ``lse`` is the GLOBAL logsumexp per query row (the backward
+    pass's residual). The ring rotation is a ``lax.scan`` — one traced
+    step regardless of ring size, so compile time and HLO size stay
+    flat as slices grow. With ``use_flash`` the per-step block compute
+    runs the fused Pallas kernel (ops/flash_attention.py partial mode)
+    instead of XLA einsums — same (max, unnormalized out, denom) merge
+    contract, but the local score matrix stays in VMEM."""
     my_idx = jax.lax.axis_index(axis_name)
     batch, seq_local, heads, head_dim = q.shape
 
@@ -151,11 +163,146 @@ def _ring_attention_sharded(
         vf = jax.lax.ppermute(vf, axis_name, perm)
         return (kf, vf, acc, denom, new_max), None
 
-    (_, _, acc, denom, _), _ = jax.lax.scan(
+    (_, _, acc, denom, running_max), _ = jax.lax.scan(
         step_fn, init, jnp.arange(n_devices)
     )
     out = acc / jnp.maximum(denom.transpose(0, 2, 1)[..., None], 1e-30)
-    return out.astype(q.dtype)
+    # global logsumexp per query row — the backward pass reconstructs
+    # exact global probabilities from this (p = exp(s - lse)); clamped
+    # like the flash kernel so fully-masked rows stay finite
+    lse = jnp.maximum(running_max, _NEG_INF / 2) + jnp.log(
+        jnp.maximum(denom, 1e-30)
+    )  # [B, H, Sq] float32
+    return out.astype(q.dtype), lse
+
+
+def _ring_attention_bwd_sharded(
+    q, k, v, out, lse, dout, *, axis_name: str, n_devices: int,
+    causal: bool, use_flash: bool,
+):
+    """Second ring pass: dQ/dK/dV per device.
+
+    K/V rotate around the ring exactly as in the forward; the float32
+    dK/dV accumulators rotate IN LOCKSTEP, so the accumulator for block
+    j is always resident with block j itself — each device adds its
+    Q-block's contribution to whatever block is visiting, and after n
+    hops every accumulator has collected all contributions and sits on
+    its home device. dQ accumulates locally. With ``use_flash`` the
+    per-block gradient math runs the fused backward kernels against the
+    global statistics (flash_attention_backward_block); otherwise XLA
+    einsums recompute s and p = exp(s − lse_global) directly."""
+    my_idx = jax.lax.axis_index(axis_name)
+    batch, seq_local, heads, head_dim = q.shape
+    scale = 1.0 / (head_dim ** 0.5)
+    perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+    causal_mask = jnp.tril(jnp.ones((seq_local, seq_local), jnp.bool_))
+
+    qf = q.astype(jnp.float32)
+    dof = dout.astype(jnp.float32)
+    # per-row correction Δ = rowsum(dO ∘ O), same as the single-chip
+    # backward kernels (ops/flash_attention.py _backward_bhsd)
+    delta = jnp.einsum("bqhd,bqhd->bhq", dof, out.astype(jnp.float32))
+
+    if use_flash:
+        from activemonitor_tpu.ops.flash_attention import (
+            flash_attention_backward_block,
+        )
+
+        def attend_full(q_in, kf, vf):
+            return flash_attention_backward_block(
+                q_in, kf, vf, lse, delta, dout, causal=False
+            )
+
+        def attend_diag(q_in, kf, vf):
+            return flash_attention_backward_block(
+                q_in, kf, vf, lse, delta, dout, causal=True
+            )
+    else:
+
+        def _attend(q_in, kf, vf, diagonal):
+            kff = kf.astype(jnp.float32)
+            vff = vf.astype(jnp.float32)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, kff) * scale
+            if diagonal:
+                s = jnp.where(causal_mask[None, None], s, _NEG_INF)
+            p = jnp.exp(s - lse[..., None])  # exact global probabilities
+            dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vff)
+            ds = p * (dp - delta[..., None]) * scale
+            dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, kff)
+            dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+            return dq_blk, dk_blk, dv_blk
+
+        def attend_full(q_in, kf, vf):
+            return _attend(q_in, kf, vf, diagonal=False)
+
+        def attend_diag(q_in, kf, vf):
+            return _attend(q_in, kf, vf, diagonal=True)
+
+    def skip(q_in, kf, vf):
+        z = jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32)
+        return z, z, z
+
+    init = (
+        k,  # rotates in input dtype, like the forward
+        v,
+        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # dk
+        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # dv
+        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # dq
+    )
+
+    def step_fn(carry, step):
+        kf, vf, dk, dv, dq = carry
+        kv_idx = (my_idx - step) % n_devices
+        if causal:
+            branch = (
+                (kv_idx < my_idx).astype(jnp.int32)
+                + 2 * (kv_idx == my_idx).astype(jnp.int32)
+            )  # 0 = skip (kv after us), 1 = full, 2 = diagonal
+            dq_blk, dk_blk, dv_blk = jax.lax.switch(
+                branch, (skip, attend_full, attend_diag), q, kf, vf
+            )
+        else:
+            dq_blk, dk_blk, dv_blk = attend_full(q, kf, vf)
+        dq = dq + dq_blk
+        dk = dk + dk_blk
+        dv = dv + dv_blk
+        kf = jax.lax.ppermute(kf, axis_name, perm)
+        vf = jax.lax.ppermute(vf, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return (kf, vf, dk, dv, dq), None
+
+    (_, _, dk, dv, dq), _ = jax.lax.scan(step_fn, init, jnp.arange(n_devices))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_diff(q, k, v, axis_name, n_devices, causal, use_flash):
+    out, _ = _ring_attention_sharded(
+        q, k, v, axis_name=axis_name, n_devices=n_devices,
+        causal=causal, use_flash=use_flash,
+    )
+    return out
+
+
+def _ring_diff_fwd(q, k, v, axis_name, n_devices, causal, use_flash):
+    out, lse = _ring_attention_sharded(
+        q, k, v, axis_name=axis_name, n_devices=n_devices,
+        causal=causal, use_flash=use_flash,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_diff_bwd(axis_name, n_devices, causal, use_flash, residuals, dout):
+    q, k, v, out, lse = residuals
+    return _ring_attention_bwd_sharded(
+        q, k, v, out, lse, dout, axis_name=axis_name, n_devices=n_devices,
+        causal=causal, use_flash=use_flash,
+    )
+
+
+_ring_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)
 
 
 def ring_attention(
@@ -166,23 +313,32 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     use_flash: bool = False,
+    in_spec: P | None = None,
 ) -> jax.Array:
-    """Sequence-parallel attention over ``mesh[axis]``.
+    """Sequence-parallel attention over ``mesh[axis]``, differentiable
+    (custom VJP: the backward is a second K/V ring pass recomputing
+    block probabilities from the saved global logsumexp).
 
     q, k, v: global ``[batch, seq, heads, head_dim]`` arrays; the seq
     dim is sharded over the axis. Returns attention output with the
     same global shape/sharding. ``use_flash`` runs each ring step's
-    block compute through the fused Pallas kernel (forward-only).
+    block compute (forward AND backward) through the fused Pallas
+    kernels. ``in_spec`` overrides the shard_map partitioning for
+    composed meshes — e.g. ``P("data", "sp", "model", None)`` to run
+    the ring inside a dp×tp×sp train step (batch and heads are
+    embarrassingly parallel for the ring; only position 1, the sequence
+    dim, must carry ``axis``).
     """
     n = mesh.shape[axis]
-    body = partial(
-        _ring_attention_sharded,
-        axis_name=axis,
-        n_devices=n,
-        causal=causal,
-        use_flash=use_flash,
-    )
-    spec = P(None, axis, None, None)
+    spec = in_spec if in_spec is not None else P(None, axis, None, None)
+    if len(spec) > 1 and spec[1] != axis:
+        raise ValueError(
+            f"in_spec must shard the sequence dim (position 1) over {axis!r}, got {spec}"
+        )
+    def body(q, k, v):
+        # positional call: custom_vjp rejects keyword arguments
+        return _ring_diff(q, k, v, axis, n, causal, use_flash)
+
     fn = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )
@@ -190,12 +346,24 @@ def ring_attention(
 
 
 def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
-    """Single-device attention for correctness checks."""
+    """Single-device attention for correctness checks.
+
+    Generalized the same way as the fused kernel
+    (ops/flash_attention.py): K/V may carry fewer heads (GQA — each
+    group of ``n_heads // n_kv_heads`` query heads shares a K/V head)
+    and a different sequence length (causal masking bottom-right
+    aligned: query row i attends keys ≤ i + seq_k − seq_q, the decode
+    convention; equal lengths reduce to the standard mask)."""
     scale = 1.0 / jnp.sqrt(q.shape[-1])
+    heads, heads_kv = q.shape[2], k.shape[2]
+    if heads != heads_kv:
+        k = jnp.repeat(k, heads // heads_kv, axis=2)
+        v = jnp.repeat(v, heads // heads_kv, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:
         seq_q, seq_k = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((seq_q, seq_k), jnp.bool_))
+        q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
+        mask = q_pos >= jnp.arange(seq_k)[None, :]
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)

@@ -71,10 +71,16 @@ def build_sharded_train_step(
         from activemonitor_tpu.models.probe_model import flash_attention_fn
 
         attention_fn = flash_attention_fn(cfg, mesh)
+    elif attention == "ring":
+        from activemonitor_tpu.models.probe_model import ring_attention_fn
+
+        attention_fn = ring_attention_fn(cfg, mesh)
     elif attention == "dense":
         attention_fn = None
     else:
-        raise ValueError(f"attention must be dense or flash, got {attention!r}")
+        raise ValueError(
+            f"attention must be dense, flash or ring, got {attention!r}"
+        )
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(

@@ -67,16 +67,24 @@ def test_bad_layout_rejected():
         flash_attention(q, k, v, layout="sbhd")
 
 
-def test_indivisible_seq_rejected():
+def test_indivisible_blocks_adapt():
+    # explicit 128-blocks don't divide seq=192 — the wrapper adapts to
+    # the largest tileable divisor (96) instead of raising
     q, k, v = _qkv(seq=192)
-    with pytest.raises(ValueError, match="not divisible"):
-        flash_attention(q, k, v, block_q=128, block_k=128)
+    got = flash_attention(q, k, v, block_q=128, block_k=128)
+    want = reference_attention(q, k, v)
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
 
 
 def test_mismatched_shapes_rejected():
     q, k, v = _qkv(seq=128)
-    with pytest.raises(ValueError, match="shapes differ"):
+    with pytest.raises(ValueError, match="k/v shapes differ"):
         flash_attention(q, k[:, :64], v)
+    with pytest.raises(ValueError, match="batch or head_dim"):
+        flash_attention(q, k[:, :, :, :32], v[:, :, :, :32])
+    with pytest.raises(ValueError, match="divisible by n_kv_heads"):
+        # 2 q heads cannot group over 2-but-sliced-to-odd kv heads
+        flash_attention(_qkv(seq=128, heads=4)[0], k[:, :, :1].repeat(3, 2), v[:, :, :1].repeat(3, 2))
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -118,6 +126,39 @@ def test_gradients_adapt_blocks_to_any_forward_seq():
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
+def test_plan_padding_avoids_block_collapse():
+    from activemonitor_tpu.ops.flash_attention import _plan_padding
+
+    # healthy divisors: just the 8-alignment pad, fitted block kept
+    assert _plan_padding(4096, 1024) == (4096, 1024)
+    assert _plan_padding(100, 1024) == (104, 104)
+    assert _plan_padding(192, 128) == (192, 96)  # within 2x: no extra pad
+    # divisor collapse (136 = 8x17 -> only divisor 8): pad to the block
+    assert _plan_padding(136, 128) == (256, 128)
+    assert _plan_padding(1000, 512) == (1024, 512)
+
+
+def test_block_collapse_seq_still_correct():
+    # seq=136 pads to 256 with 128-blocks and masked keys — must match
+    # the unpadded reference in forward and gradients
+    q, k, v = _qkv(seq=136)
+    got = flash_attention(q, k, v, block_q=128, block_k=128)
+    want = reference_attention(q, k, v)
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+    g = jax.grad(
+        lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, block_q=128, block_k=128) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda a, b, c: jnp.sum(reference_attention(a, b, c) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
 def test_fit_block_prefers_tileable_divisors():
     from activemonitor_tpu.ops.flash_attention import _fit_block
 
@@ -129,13 +170,127 @@ def test_fit_block_prefers_tileable_divisors():
         _fit_block(100, 256)  # non-8-aligned: Mosaic would reject any tile
 
 
-def test_non_tileable_seq_rejected():
-    # seq=100 divides its clamped block (100) but a 100-row tile is not
-    # a multiple of 8 — Mosaic rejects it on real TPU, so the validator
-    # must reject it on CPU too instead of letting interpret mode pass
+@pytest.mark.parametrize("causal", [True, False])
+def test_non_tileable_seq_pads_and_masks(causal):
+    # seq=100 is not a multiple of Mosaic's 8-row tiling unit — the
+    # wrapper zero-pads to 104, masks the fake keys, and slices the
+    # output back; forward AND gradients must match the unpadded
+    # reference exactly
     q, k, v = _qkv(seq=100)
-    with pytest.raises(ValueError, match="multiples of 8"):
-        flash_attention(q, k, v)
+    got = flash_attention(q, k, v, causal=causal)
+    want = reference_attention(q, k, v, causal=causal)
+    assert got.shape == want.shape
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(
+        loss(lambda a, b, c: flash_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda a, b, c: reference_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert a.shape == b.shape
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+@pytest.mark.parametrize("n_heads,n_kv_heads", [(8, 2), (4, 1), (4, 4)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_grouped_heads(n_heads, n_kv_heads, causal):
+    """GQA/MQA: fewer K/V heads than query heads, never materialized —
+    forward and the group-summed dK/dV must match the repeat-heads
+    reference (whose autodiff sums the group implicitly)."""
+    keys = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(keys[0], (2, 128, n_heads, 32), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 128, n_kv_heads, 32), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 128, n_kv_heads, 32), jnp.float32)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    want = reference_attention(q, k, v, causal=causal)
+    assert got.shape == q.shape
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(
+        loss(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, block_q=64, block_k=64
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda a, b, c: reference_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    assert g_flash[1].shape == k.shape  # group already summed
+    for a, b in zip(g_flash, g_ref):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+@pytest.mark.parametrize("seq_q,seq_k", [(64, 256), (64, 192), (100, 50)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_cross_attention_lengths(seq_q, seq_k, causal):
+    """seq_k != seq_q (decode / cross-attention shapes). Causal masking
+    is bottom-right aligned: a short q sees the whole KV prefix."""
+    if causal and seq_q > seq_k:
+        # leading queries would have no visible keys — rejected up front
+        q, k, v = (
+            jax.random.normal(kk, (1, s, 2, 32), jnp.float32)
+            for kk, s in zip(jax.random.split(jax.random.key(2), 3),
+                             (seq_q, seq_k, seq_k))
+        )
+        with pytest.raises(ValueError, match="no visible keys"):
+            flash_attention(q, k, v, causal=True)
+        return
+    keys = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(keys[0], (2, seq_q, 2, 32), jnp.float32)
+    k = jax.random.normal(keys[1], (2, seq_k, 2, 32), jnp.float32)
+    v = jax.random.normal(keys[2], (2, seq_k, 2, 32), jnp.float32)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    want = reference_attention(q, k, v, causal=causal)
+    assert got.shape == q.shape
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(
+        loss(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, block_q=64, block_k=64
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda a, b, c: reference_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_gqa_cross_odd_seq_combined():
+    """All three generalizations at once: grouped heads + differing
+    odd (padded) lengths + causal offset, with gradients."""
+    keys = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(keys[0], (1, 50, 4, 32), jnp.float32)
+    k = jax.random.normal(keys[1], (1, 100, 2, 32), jnp.float32)
+    v = jax.random.normal(keys[2], (1, 100, 2, 32), jnp.float32)
+    got = flash_attention(q, k, v)
+    want = reference_attention(q, k, v)
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+    g = jax.grad(
+        lambda a, b, c: jnp.sum(flash_attention(a, b, c) ** 2), argnums=(0, 1, 2)
+    )(q, k, v)
+    gr = jax.grad(
+        lambda a, b, c: jnp.sum(reference_attention(a, b, c) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
 def test_gradients_bf16_and_uneven_blocks():
@@ -202,6 +357,79 @@ def test_model_flash_rejects_oversized_tp_axis():
     mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("data", "model"))
     with pytest.raises(ValueError, match="divisible"):
         flash_attention_fn(tiny_config(), mesh)
+
+
+def test_probe_model_gqa_trains_and_decodes():
+    """The probe model runs GQA end to end: dense and fused-kernel
+    losses agree, a train step works, and the decode cache holds only
+    the narrower kv heads."""
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        decode_step,
+        flash_attention_fn,
+        init_kv_cache,
+        init_params,
+        loss_fn,
+        param_count,
+    )
+    from activemonitor_tpu.parallel.mesh import make_2d_mesh
+
+    cfg = ProbeModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=64,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    assert params["layers"][0]["wkv"].shape == (64, 2, 2, 16)
+    assert param_count(cfg) == sum(
+        x.size for x in jax.tree.leaves(params)
+    )
+    tokens = jax.random.randint(jax.random.key(1), (4, 33), 0, cfg.vocab_size)
+    dense = float(loss_fn(params, tokens, cfg))
+    assert dense == dense and dense > 0
+    # tp axis must divide the NARROW kv heads too (2) — 2-wide model axis
+    mesh = make_2d_mesh(shape=(4, 2))
+    flash = float(loss_fn(params, tokens, cfg, flash_attention_fn(cfg, mesh)))
+    assert abs(dense - flash) < 1e-3
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        flash_attention_fn(cfg, make_2d_mesh(shape=(2, 4)))
+    grads = jax.grad(lambda p: loss_fn(p, tokens, cfg))(params)
+    assert all(
+        bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads)
+    )
+
+    cache = init_kv_cache(cfg, batch=2, max_seq=8)
+    assert cache["k"].shape == (2, 2, 8, 2, 16)  # kv heads only
+    token = jnp.zeros((2,), jnp.int32)
+    logits, cache = decode_step(params, cache, token, jnp.int32(0), cfg)
+    assert logits.shape == (2, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_gqa_decode_matches_forward():
+    """Decode-cache GQA attention must agree with the batched forward
+    on the same prefix (the decode path reshapes query groups against
+    the narrow cache)."""
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        decode_step,
+        forward,
+        init_kv_cache,
+        init_params,
+    )
+
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=1, n_layers=2,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab_size)
+    want = forward(params, tokens, cfg)  # [B, S, V]
+    cache = init_kv_cache(cfg, batch=2, max_seq=8)
+    for pos in range(tokens.shape[1]):
+        logits, cache = decode_step(
+            params, cache, tokens[:, pos], jnp.int32(pos), cfg
+        )
+    assert float(jnp.max(jnp.abs(logits - want[:, -1]))) < 1e-4
 
 
 def test_training_step_probe_flash_attention():

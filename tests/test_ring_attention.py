@@ -102,6 +102,91 @@ def test_distributed_detection(monkeypatch):
     assert detect_multihost_env()
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_gradients_match_reference(mesh, causal, use_flash):
+    """The custom-VJP backward (second K/V ring pass against the saved
+    global logsumexp) must agree with autodiff through single-device
+    attention — for the XLA einsum blocks AND the fused kernel blocks."""
+    q, k, v = qkv()
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_ring = jax.grad(
+        loss(lambda a, b, c: ring_attention(
+            a, b, c, mesh, "sp", causal=causal, use_flash=use_flash
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda a, b, c: reference_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for got, want in zip(g_ring, g_ref):
+        assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+
+
+def test_gradients_bf16(mesh):
+    """bf16 inputs keep bf16 on the wire in BOTH ring passes; gradients
+    still track the float32 reference within bf16 rounding."""
+    q, k, v = qkv(dtype=jnp.bfloat16)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_ring = jax.grad(
+        loss(lambda a, b, c: ring_attention(a, b, c, mesh, "sp")),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda a, b, c: reference_attention(
+            a.astype(jnp.float32), b.astype(jnp.float32), c.astype(jnp.float32)
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for got, want in zip(g_ring, g_ref):
+        norm = max(1e-9, float(jnp.max(jnp.abs(want))))
+        rel = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want))) / norm
+        assert rel < 5e-2
+
+
+def test_train_step_ring_attention():
+    """attention="ring" trains: a dp×tp×sp composed step through ring
+    attention's custom VJP produces a finite loss that decreases."""
+    from activemonitor_tpu.models.probe_model import tiny_config
+    from activemonitor_tpu.parallel.mesh import make_mesh
+    from activemonitor_tpu.probes.training_step import build_sharded_train_step
+
+    sp_mesh = make_mesh(("data", "model", "sp"), (2, 2, 2))
+    cfg = tiny_config()
+    step, params, opt, data_sh = build_sharded_train_step(
+        cfg, sp_mesh, attention="ring"
+    )
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(3), (4, 17), 0, cfg.vocab_size),
+        data_sh,
+    )
+    losses = []
+    for _ in range(3):
+        params, opt, loss = step(params, opt, tokens)
+        losses.append(float(loss))
+    assert all(l == l for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_ring_attention_fn_validates_axes():
+    from activemonitor_tpu.models.probe_model import ring_attention_fn, tiny_config
+    from activemonitor_tpu.parallel.mesh import make_mesh
+
+    cfg = tiny_config()
+    with pytest.raises(ValueError, match="'sp' mesh axis"):
+        ring_attention_fn(cfg, make_mesh(("data", "model"), (2, 4)))
+    with pytest.raises(ValueError, match="divisible"):
+        # tiny_config has 4 heads; tp axis of 8 cannot split them
+        ring_attention_fn(cfg, make_mesh(("model", "sp"), (8, 1)))
+
+
 def test_context_parallel_forward_matches_dense(mesh):
     """The long-context model path (seq sharded + ring attention) must
     agree with the dense single-device forward."""
