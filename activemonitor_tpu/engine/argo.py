@@ -114,10 +114,13 @@ class _NamespaceWatch:
                 if cancelling is not None:
                     if cancelling():
                         raise  # the CALLER is being cancelled — propagate
-                elif not self._task.done():
-                    # 3.10 fallback: the child task has not finished, so
-                    # this CancelledError was delivered to US (the caller
-                    # being cancelled mid-await), not raised by the child
+                elif not self._task.done() or not self._task.cancelled():
+                    # 3.10 fallback: the child either has not finished
+                    # (the CancelledError was delivered to US mid-await)
+                    # or finished WITHOUT being cancelled — a completed,
+                    # uncancelled child cannot be the origin of a
+                    # CancelledError, so the caller is being cancelled
+                    # and one-shot cancel delivery must propagate
                     raise
             except Exception:
                 pass

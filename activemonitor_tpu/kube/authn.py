@@ -19,6 +19,7 @@ endpoint.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Dict, Optional, Tuple
 
@@ -37,21 +38,37 @@ class KubeScrapeAuthorizer:
         path: str = "/metrics",
         verb: str = "get",
         cache_ttl: float = 60.0,
+        negative_ttl: float = 10.0,
         monotonic=time.monotonic,
+        max_entries: int = 1024,
     ):
         self._api = api
         self._path = path
         self._verb = verb
         self._ttl = cache_ttl
+        # denials age out faster: a scraper whose token/RBAC was just
+        # provisioned must not keep eating 401s for a full positive TTL
+        # (controller-runtime's filter uses a short failure TTL the
+        # same way)
+        self._neg_ttl = negative_ttl
         self._monotonic = monotonic
-        # token -> (expiry, verdict); only definitive verdicts cached
+        self._max_entries = max_entries
+        # sha256(token) -> (expiry, verdict); only definitive verdicts
+        # cached. Hashing keeps raw bearer tokens out of process memory
+        # dumps, and eviction is per-entry so junk-token spam cannot
+        # flush the legitimate scraper's verdict wholesale
         self._cache: Dict[str, Tuple[float, bool]] = {}
+
+    @staticmethod
+    def _key(token: str) -> str:
+        return hashlib.sha256(token.encode()).hexdigest()
 
     async def allowed(self, token: str) -> Optional[bool]:
         if not token:
             return False
         now = self._monotonic()
-        hit = self._cache.get(token)
+        key = self._key(token)
+        hit = self._cache.get(key)
         if hit is not None and hit[0] > now:
             return hit[1]
 
@@ -72,7 +89,7 @@ class KubeScrapeAuthorizer:
             return None
         status = review.get("status") or {}
         if not status.get("authenticated"):
-            self._remember(token, False, now)
+            self._remember(key, False, now)
             return False
         user = status.get("user") or {}
 
@@ -96,10 +113,20 @@ class KubeScrapeAuthorizer:
         except Exception:
             return None
         verdict = bool((sar.get("status") or {}).get("allowed"))
-        self._remember(token, verdict, now)
+        self._remember(key, verdict, now)
         return verdict
 
-    def _remember(self, token: str, verdict: bool, now: float) -> None:
-        if len(self._cache) > 1024:  # bound memory under token churn
-            self._cache.clear()
-        self._cache[token] = (now + self._ttl, verdict)
+    def _remember(self, key: str, verdict: bool, now: float) -> None:
+        if len(self._cache) >= self._max_entries:
+            # bound memory under token churn WITHOUT collateral damage:
+            # drop expired entries first, then the soonest-to-expire —
+            # a spammer cycling junk tokens evicts its own junk, not
+            # the legitimate scraper's fresh verdict
+            expired = [k for k, (exp, _v) in self._cache.items() if exp <= now]
+            for k in expired:
+                del self._cache[k]
+            while len(self._cache) >= self._max_entries:
+                soonest = min(self._cache, key=lambda k: self._cache[k][0])
+                del self._cache[soonest]
+        ttl = self._ttl if verdict else self._neg_ttl
+        self._cache[key] = (now + ttl, verdict)

@@ -322,8 +322,9 @@ def _dispatch(args) -> int:
         if args.sweep:
             result = flash.sweep(
                 batch=args.batch,
-                # per-mode default only — an explicit --seq always wins
-                seq=args.seq if args.seq is not None else 2048,
+                # None = per-mode default (clamped off-TPU); an explicit
+                # --seq reaches the probe verbatim and always wins
+                seq=args.seq,
                 heads=args.heads,
                 head_dim=args.head_dim,
                 iters=args.iters,
@@ -333,7 +334,7 @@ def _dispatch(args) -> int:
         else:
             result = flash.run(
                 batch=args.batch,
-                seq=args.seq if args.seq is not None else 4096,
+                seq=args.seq,
                 heads=args.heads,
                 head_dim=args.head_dim,
                 iters=args.iters,

@@ -30,7 +30,7 @@ from activemonitor_tpu.utils.timing import chain_delta_seconds
 
 def sweep(
     batch: int = 4,
-    seq: int = 2048,
+    seq: int | None = None,
     heads: int = 8,
     head_dim: int = 128,
     iters: int = 3,
@@ -60,8 +60,11 @@ def sweep(
 
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
-    if not on_tpu and seq > 256:
-        seq = 256  # interpret mode: keep the sweep finishable
+    # only the DEFAULT clamps off-TPU (interpret mode: keep the sweep
+    # finishable); an explicit seq is honored verbatim — the CLI
+    # promises "an explicit --seq always wins" (ADVICE r3)
+    if seq is None:
+        seq = 2048 if on_tpu else 256
     dtype = jnp.bfloat16
     keys = jax.random.split(jax.random.key(0), 3)
     # kernel-native [B, H, S, D] layout so the sweep times the kernel,
@@ -205,7 +208,7 @@ def sweep(
 
 def run(
     batch: int = 4,
-    seq: int = 4096,
+    seq: int | None = None,
     heads: int = 8,
     head_dim: int = 128,
     iters: int = 5,
@@ -214,8 +217,10 @@ def run(
 ) -> ProbeResult:
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
-    if not on_tpu and seq > 512:
-        seq = 512  # interpret-mode correctness is O(minutes) beyond this
+    # default only — interpret-mode correctness is O(minutes) past 512,
+    # but an explicit seq always wins (ADVICE r3)
+    if seq is None:
+        seq = 4096 if on_tpu else 512
     dtype = jnp.bfloat16
     keys = jax.random.split(jax.random.key(0), 3)
     q, k, v = (

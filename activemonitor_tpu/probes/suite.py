@@ -117,9 +117,13 @@ def run(
     )
     from activemonitor_tpu.probes import flash
 
+    # seq=None: the per-platform default (4096 on TPU, the interpret-
+    # mode 512 cap elsewhere — an explicit seq would now be honored
+    # verbatim and stall a CPU suite run for hours); quick mode still
+    # pins a short explicit length, safe on every platform
     add(
         "flash-attention",
-        lambda: flash.run(seq=1024 if quick else 4096, iters=iters),
+        lambda: flash.run(seq=1024 if quick else None, iters=iters),
     )
     add(
         "training-step",

@@ -19,6 +19,17 @@ positive that makes `make lint` cry wolf is worse than a miss):
 - mutable-default: list/dict/set literals as parameter defaults (B006).
 - f-string-no-placeholder: f"..." with nothing interpolated (F541).
 - duplicate-dict-key: literal dict with a repeated constant key (F601-ish).
+- unawaited-coroutine: an expression statement calling a name that this
+  file only ever defines as `async def` — the coroutine is created and
+  dropped, the body never runs (asyncio's classic silent bug; RUF006 /
+  ASYNC102 territory).
+- shadowed-builtin: a module/function-level binding (assignment, def,
+  or parameter) that reuses a builtin name like `list` or `id`
+  (flake8-builtins A001-A002). Class bodies are exempt — field names
+  mirroring builtins (`type:`, `id:`) are idiomatic in API models.
+- redefined-test: the same scope defines `def test_x` twice — pytest
+  collects only the last one, silently dropping the first (F811 for
+  the case that actually loses coverage).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -54,8 +65,23 @@ BUILTINS = set(dir(builtins)) | {
 }
 
 
+# names the shadowed-builtin check defends. Deliberately not all of
+# dir(builtins): lowercase builtins people actually call, minus ones
+# whose shadowing is idiomatic in this tree's domain (`input` for probe
+# payloads, `format` for CLI flags, `compile` for XLA wrappers would
+# all cry wolf — leniency rule from the module docstring).
+_SHADOW_BUILTINS = {
+    name
+    for name in dir(builtins)
+    if name.islower() and not name.startswith("_")
+} - {"input", "format", "compile", "copyright", "credits", "license", "help"}
+
+
 class Scope:
-    __slots__ = ("node", "bound", "loads", "global_names", "parent", "is_class")
+    __slots__ = (
+        "node", "bound", "loads", "global_names", "parent", "is_class",
+        "def_names",
+    )
 
     def __init__(self, node, parent=None, is_class=False):
         self.node = node
@@ -64,6 +90,7 @@ class Scope:
         self.bound: set[str] = set()
         self.loads: list[tuple[str, int, int]] = []
         self.global_names: set[str] = set()
+        self.def_names: set[str] = set()  # function defs seen in this scope
 
 
 class Checker(ast.NodeVisitor):
@@ -84,6 +111,14 @@ class Checker(ast.NodeVisitor):
         self.has_star_import = False
         self.is_init = path.endswith("__init__.py")
         self.source = source
+        # names defined `async def` / plain `def` anywhere in the file
+        # (functions AND methods) — the unawaited-coroutine check only
+        # fires on names that are EXCLUSIVELY async, so a sync function
+        # sharing a name anywhere silences it (lenient by construction)
+        self.async_defs: set[str] = set()
+        self.sync_defs: set[str] = set()
+        # bare/attribute calls used as whole statements: (name, lineno)
+        self.stmt_calls: list[tuple[str, int]] = []
 
     # -- scope plumbing -------------------------------------------------
     @property
@@ -110,7 +145,21 @@ class Checker(ast.NodeVisitor):
             self.scope.loads.append((node.id, node.lineno, node.col_offset))
             self.referenced.add(node.id)
         else:  # Store / Del
+            if isinstance(node.ctx, ast.Store):
+                self._check_shadow(node.id, node.lineno, "assignment to")
             self.bind(node.id)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        # a call used as a whole statement: candidate for the
+        # unawaited-coroutine check (resolved in finish() once every
+        # def in the file has been seen)
+        if isinstance(node.value, ast.Call):
+            fn = node.value.func
+            if isinstance(fn, ast.Name):
+                self.stmt_calls.append((fn.id, node.lineno))
+            elif isinstance(fn, ast.Attribute):
+                self.stmt_calls.append((fn.attr, node.lineno))
+        self.generic_visit(node)
 
     def visit_Global(self, node: ast.Global) -> None:
         self.scope.global_names.update(node.names)
@@ -142,8 +191,36 @@ class Checker(ast.NodeVisitor):
         for alias in node.names:
             self._record_import(alias, node)
 
+    def _check_shadow(self, name: str, lineno: int, what: str) -> None:
+        """flake8-builtins-style A001/A002; class bodies exempt (API
+        models legitimately declare fields like `type` / `id`)."""
+        if self.scope.is_class:
+            return
+        if name in _SHADOW_BUILTINS:
+            self.findings.append(
+                (lineno, "shadowed-builtin", f"{what} `{name}` shadows a builtin")
+            )
+
     # -- definitions ----------------------------------------------------
     def _visit_function(self, node) -> None:
+        if (
+            node.name.startswith("test_")
+            and node.name in self.scope.def_names
+        ):
+            self.findings.append(
+                (
+                    node.lineno,
+                    "redefined-test",
+                    f"duplicate `def {node.name}` — pytest keeps only the "
+                    "last definition, the first never runs",
+                )
+            )
+        self.scope.def_names.add(node.name)
+        if isinstance(node, ast.AsyncFunctionDef):
+            self.async_defs.add(node.name)
+        else:
+            self.sync_defs.add(node.name)
+        self._check_shadow(node.name, node.lineno, "function")
         self.bind(node.name)
         for dec in node.decorator_list:
             self.visit(dec)
@@ -172,6 +249,7 @@ class Checker(ast.NodeVisitor):
             + ([args.kwarg] if args.kwarg else [])
         ):
             self.scope.bound.add(a.arg)
+            self._check_shadow(a.arg, a.lineno, "parameter")
         for stmt in node.body:
             self.visit(stmt)
         self.pop()
@@ -321,6 +399,16 @@ class Checker(ast.NodeVisitor):
                     self.findings.append(
                         (lineno, "unused-import", f"`{name}` imported but unused")
                     )
+        for name, lineno in self.stmt_calls:
+            if name in self.async_defs and name not in self.sync_defs:
+                self.findings.append(
+                    (
+                        lineno,
+                        "unawaited-coroutine",
+                        f"`{name}(...)` creates a coroutine that is never "
+                        "awaited — the body never runs",
+                    )
+                )
         self._unused_locals()
 
     def _all_exports(self) -> set:

@@ -1,0 +1,137 @@
+"""hack/lint.py — the in-repo AST lint gate.
+
+Each check must fire on a seeded example and stay quiet on the
+idiomatic counter-example (the linter's leniency contract: a false
+positive that makes `make lint` cry wolf is worse than a miss).
+Reference analogue: golangci-lint gating CI
+(/root/reference/.github/workflows/golangci-lint.yml).
+"""
+
+import importlib.util
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location("lint", REPO / "hack" / "lint.py")
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+
+def findings(tmp_path, source, name="mod.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return lint.lint_file(path)
+
+
+def codes(results):
+    return {line.split(": ")[1] for line in results}
+
+
+def test_unawaited_coroutine_fires(tmp_path):
+    got = findings(
+        tmp_path,
+        "async def fetch():\n"
+        "    return 1\n"
+        "def schedule():\n"
+        "    fetch()\n",
+    )
+    assert codes(got) == {"unawaited-coroutine"}
+
+
+def test_unawaited_coroutine_quiet_when_awaited_or_wrapped(tmp_path):
+    got = findings(
+        tmp_path,
+        "import asyncio\n"
+        "async def fetch():\n"
+        "    return 1\n"
+        "async def main():\n"
+        "    await fetch()\n"
+        "    task = asyncio.create_task(fetch())\n"
+        "    return task\n",
+    )
+    assert got == []
+
+
+def test_unawaited_coroutine_quiet_on_sync_name_collision(tmp_path):
+    # a sync def sharing the name anywhere in the file silences the
+    # check — leniency beats a wrong accusation
+    got = findings(
+        tmp_path,
+        "class A:\n"
+        "    async def run(self):\n"
+        "        return 1\n"
+        "class B:\n"
+        "    def run(self):\n"
+        "        return 2\n"
+        "def go(b):\n"
+        "    b.run()\n",
+    )
+    assert got == []
+
+
+def test_shadowed_builtin_fires_on_assign_param_and_def(tmp_path):
+    got = findings(
+        tmp_path,
+        "list = [1]\n"
+        "def handler(id):\n"
+        "    type = 'x'\n"
+        "    return id, type\n"
+        "def sum():\n"
+        "    return 0\n",
+    )
+    assert codes(got) == {"shadowed-builtin"}
+    assert len(got) == 4  # list, id, type, sum
+
+
+def test_shadowed_builtin_exempts_class_fields(tmp_path):
+    # API models legitimately mirror builtin names as field names
+    got = findings(
+        tmp_path,
+        "class Probe:\n"
+        "    type: str = 'x'\n"
+        "    id: int = 0\n",
+    )
+    assert got == []
+
+
+def test_redefined_test_fires(tmp_path):
+    got = findings(
+        tmp_path,
+        "def test_a():\n"
+        "    assert True\n"
+        "def test_a():\n"
+        "    assert False\n",
+        name="test_mod.py",
+    )
+    assert codes(got) == {"redefined-test"}
+
+
+def test_redefined_test_quiet_on_distinct_scopes(tmp_path):
+    got = findings(
+        tmp_path,
+        "class TestA:\n"
+        "    def test_x(self):\n"
+        "        pass\n"
+        "class TestB:\n"
+        "    def test_x(self):\n"
+        "        pass\n",
+        name="test_mod.py",
+    )
+    assert got == []
+
+
+def test_undefined_name_and_unused_import_still_fire(tmp_path):
+    got = findings(tmp_path, "import os\nprint(sys.argv)\n")
+    assert codes(got) == {"undefined-name", "unused-import"}
+
+
+def test_repo_tree_is_clean():
+    """The gate the CI run enforces, as a test: every default target
+    lints clean (mirrors `make lint`)."""
+    assert lint.main([]) == 0
+
+
+def test_seeded_file_exits_nonzero(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    return undefined_thing\n")
+    assert lint.main([str(bad)]) == 1

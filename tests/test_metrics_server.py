@@ -408,6 +408,81 @@ async def test_k8s_auth_decision_is_cached():
         await server.stop()
 
 
+class _FakeReviewApi:
+    """Plays just the TokenReview/SAR endpoints for cache unit tests."""
+
+    def __init__(self):
+        self.token_reviews = 0
+
+    async def create(self, path, body):
+        if "tokenreviews" in path:
+            self.token_reviews += 1
+            token = body["spec"]["token"]
+            if token.startswith(("good", "norbac")):
+                return {
+                    "status": {
+                        "authenticated": True,
+                        "user": {"username": token},
+                    }
+                }
+            return {"status": {"authenticated": False}}
+        return {"status": {"allowed": body["spec"]["user"].startswith("good")}}
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_cache_never_stores_raw_tokens():
+    from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+    auth = KubeScrapeAuthorizer(_FakeReviewApi())
+    assert await auth.allowed("good-secret-bearer") is True
+    assert "good-secret-bearer" not in auth._cache  # only sha256 keys
+    assert all(len(k) == 64 for k in auth._cache)
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_negative_verdicts_age_out_faster():
+    """A denial cached at provisioning time must not outlive the short
+    negative TTL — the scraper whose RBAC just landed recovers in
+    seconds, not a full positive TTL."""
+    from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+    clock = [0.0]
+    api = _FakeReviewApi()
+    auth = KubeScrapeAuthorizer(
+        api, cache_ttl=60.0, negative_ttl=10.0, monotonic=lambda: clock[0]
+    )
+    assert await auth.allowed("norbac-scraper") is False
+    assert await auth.allowed("good-scraper") is True
+    reviews = api.token_reviews
+    clock[0] = 11.0  # past the negative TTL, inside the positive one
+    assert await auth.allowed("norbac-scraper") is False
+    assert api.token_reviews == reviews + 1  # denial re-evaluated
+    assert await auth.allowed("good-scraper") is True
+    assert api.token_reviews == reviews + 1  # positive still cached
+
+
+@pytest.mark.asyncio
+async def test_k8s_auth_junk_spam_cannot_evict_live_verdict():
+    """Per-entry eviction: junk-token churn drops its own (soonest-to-
+    expire) entries, never the legitimate scraper's fresh verdict."""
+    from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+    clock = [0.0]
+    api = _FakeReviewApi()
+    auth = KubeScrapeAuthorizer(
+        api, cache_ttl=60.0, negative_ttl=10.0,
+        monotonic=lambda: clock[0], max_entries=4,
+    )
+    assert await auth.allowed("good-scraper") is True
+    reviews = api.token_reviews
+    for i in range(20):  # spam well past max_entries
+        clock[0] += 0.01
+        assert await auth.allowed(f"junk-{i}") is False
+    assert len(auth._cache) <= 4
+    assert await auth.allowed("good-scraper") is True
+    assert api.token_reviews == reviews + 20  # no re-review of the scraper
+
+
 def test_cli_k8s_auth_on_requires_cluster_credentials():
     import asyncio as aio
 
