@@ -256,8 +256,13 @@ class KubeApi:
                     log.warning("undecodable watch line: %.120r", line)
                     continue
                 if event.get("type") == "ERROR":
+                    # the event's object is a full Status — keep it on
+                    # the error so callers can branch on reason
+                    # (Expired vs InternalError), like typed clients do
                     obj = event.get("object", {}) or {}
                     raise ApiError(
-                        int(obj.get("code", 500)), obj.get("message", "watch error")
+                        int(obj.get("code", 500)),
+                        obj.get("message", "watch error"),
+                        obj,
                     )
                 yield event

@@ -218,13 +218,54 @@ class StubApiServer:
             info.get("name", ""),
         )
 
+    # default StatusReason per HTTP code, mirroring apimachinery's
+    # reasonAndCodeForError mapping — the conformance fixtures
+    # (tests/fixtures/apiserver/) pin these against the real wire shape
+    _REASONS = {
+        400: "BadRequest",
+        401: "Unauthorized",
+        403: "Forbidden",
+        404: "NotFound",
+        405: "MethodNotAllowed",
+        409: "Conflict",
+        410: "Expired",
+        422: "Invalid",
+        500: "InternalError",
+        503: "ServiceUnavailable",
+    }
+
     @staticmethod
-    def _error(status: int, message: str):
+    def _qualified(key: Key) -> str:
+        """Resource rendering in real Status messages: grouped resources
+        as ``plural.group``, core (empty-group) resources as bare
+        ``plural`` — never a trailing dot."""
+        return f"{key[2]}.{key[0]}" if key[0] else key[2]
+
+    @classmethod
+    def _status_body(
+        cls, status: int, message: str, reason: str = "", details: dict | None = None
+    ) -> dict:
+        body = {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "metadata": {},
+            "status": "Failure",
+            "message": message,
+            "reason": reason or cls._REASONS.get(status, ""),
+            "code": status,
+        }
+        if details:
+            body["details"] = details
+        return body
+
+    @classmethod
+    def _error(
+        cls, status: int, message: str, reason: str = "", details: dict | None = None
+    ):
         from aiohttp import web
 
         return web.json_response(
-            {"kind": "Status", "status": "Failure", "code": status, "message": message},
-            status=status,
+            cls._status_body(status, message, reason, details), status=status
         )
 
     from aiohttp import web as _web  # for the middleware decorator
@@ -278,11 +319,17 @@ class StubApiServer:
         if start_rv:
             oldest = self._history[0][0] if self._history else self._rv + 1
             if int(start_rv) + 1 < oldest and int(start_rv) < self._rv:
-                # requested window already evicted
+                # requested window already evicted — real apiserver
+                # sends an ERROR event whose object is a full Status
+                # with reason Expired
                 line = json.dumps(
                     {
                         "type": "ERROR",
-                        "object": {"code": 410, "message": "too old resource version"},
+                        "object": self._status_body(
+                            410,
+                            f"too old resource version: {start_rv} ({self._rv})",
+                            reason="Expired",
+                        ),
                     }
                 )
                 await resp.write(line.encode() + b"\n")
@@ -347,7 +394,14 @@ class StubApiServer:
             name = generate + secrets.token_hex(3)[:5]
             meta["name"] = name
         if (namespace, name) in self._bucket(key):
-            return self._error(409, f"{key[2]} {name!r} already exists")
+            # real apiserver: 409 with reason AlreadyExists (distinct
+            # from optimistic-concurrency Conflict at the same code)
+            return self._error(
+                409,
+                f'{self._qualified(key)} "{name}" already exists',
+                reason="AlreadyExists",
+                details={"name": name, "group": key[0], "kind": key[2]},
+            )
         meta["resourceVersion"] = self._bump()
         meta["uid"] = secrets.token_hex(8)
         meta.setdefault("creationTimestamp", _now_iso())
@@ -392,7 +446,11 @@ class StubApiServer:
         key, namespace, name = self._parse(request)
         existing = self._bucket(key).get((namespace, name))
         if existing is None:
-            return self._error(404, f"{key[2]} {namespace}/{name} not found")
+            return self._error(
+                404,
+                f'{self._qualified(key)} "{name}" not found',
+                details={"name": name, "group": key[0], "kind": key[2]},
+            )
 
         if request.method == "GET":
             return web.json_response(copy.deepcopy(existing))
@@ -401,7 +459,20 @@ class StubApiServer:
             del self._bucket(key)[(namespace, name)]
             self._bump()
             self._broadcast(key, namespace, "DELETED", existing)
-            return web.json_response({"kind": "Status", "status": "Success"})
+            return web.json_response(
+                {
+                    "kind": "Status",
+                    "apiVersion": "v1",
+                    "metadata": {},
+                    "status": "Success",
+                    "details": {
+                        "name": name,
+                        "group": key[0],
+                        "kind": key[2],
+                        "uid": existing["metadata"].get("uid", ""),
+                    },
+                }
+            )
 
         body = await request.json()
         # optimistic concurrency: a stale resourceVersion in the payload
@@ -410,8 +481,11 @@ class StubApiServer:
         if claimed and claimed != existing["metadata"]["resourceVersion"]:
             return self._error(
                 409,
-                f"the object has been modified; requested {claimed} "
-                f"but current is {existing['metadata']['resourceVersion']}",
+                f'Operation cannot be fulfilled on {self._qualified(key)} "{name}": '
+                "the object has been modified; please apply your changes to "
+                "the latest version and try again",
+                reason="Conflict",
+                details={"name": name, "group": key[0], "kind": key[2]},
             )
 
         if request.method == "PUT":

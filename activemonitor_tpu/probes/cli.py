@@ -80,10 +80,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=3)
     p.add_argument(
         "--attention",
-        choices=("dense", "flash"),
+        choices=("dense", "flash", "ring"),
         default="dense",
-        help="attention implementation: dense (XLA) or the fused "
-        "flash kernel (custom-VJP Pallas; shard_map over tp heads)",
+        help="attention implementation: dense (XLA), the fused flash "
+        "kernel (custom-VJP Pallas; shard_map over tp heads), or "
+        "sequence-parallel ring attention (needs an 'sp' mesh axis)",
+    )
+    p.add_argument(
+        "--mfu-threshold",
+        type=float,
+        default=None,
+        help="fail the probe below this MFU (BASELINE.md single-chip "
+        "bar; the battery applies rated.TRAIN_MFU_BAR)",
     )
 
     p = sub.add_parser("hbm", help="HBM bandwidth check")
@@ -143,6 +151,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=2e-2,
         help="forward max-abs-error gate; the gradient gate is a "
         "documented 2.5x of this",
+    )
+    p.add_argument(
+        "--min-fraction",
+        type=float,
+        default=None,
+        help="fail the probe below this fraction of rated bf16 peak "
+        "(BASELINE.md single-chip bar; the battery applies "
+        "rated.FLASH_FRACTION_BAR)",
     )
     p.add_argument(
         "--sweep",
@@ -288,6 +304,7 @@ def _dispatch(args) -> int:
             seq=args.seq,
             steps=args.steps,
             attention=args.attention,
+            mfu_threshold=args.mfu_threshold,
         )
     elif args.probe == "hbm":
         from activemonitor_tpu.probes import hbm
@@ -330,6 +347,7 @@ def _dispatch(args) -> int:
                 iters=args.iters,
                 causal=not args.no_causal,
                 rounds=args.sweep_rounds,
+                min_fraction=args.min_fraction,
             )
         else:
             result = flash.run(
@@ -340,6 +358,7 @@ def _dispatch(args) -> int:
                 iters=args.iters,
                 causal=not args.no_causal,
                 tolerance=args.tolerance,
+                min_fraction=args.min_fraction,
             )
     elif args.probe == "decode":
         from activemonitor_tpu.probes import decode

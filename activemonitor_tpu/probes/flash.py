@@ -39,6 +39,7 @@ def sweep(
     fwd_blocks: tuple = (256, 512, 1024, 2048),
     bwd_blocks: tuple = ((512, 512), (1024, 256), (2048, 256), (1024, 512)),
     train: bool = True,
+    min_fraction: float | None = None,
 ) -> ProbeResult:
     """(block_q, block_k) → TFLOP/s tables — the measurements the
     kernel defaults in ops/flash_attention.py cite, reproducible on
@@ -193,6 +194,22 @@ def sweep(
         }
         details["best_backward"] = best_train_key
 
+    # the same BASELINE.md bar the non-sweep probe enforces, against
+    # the sweep's best forward config (inert off-TPU)
+    ok = True
+    rated = rated_for(device.device_kind)
+    if rated is not None and on_tpu:
+        fraction = best_fwd / rated.bf16_tflops
+        details["best_fraction_of_rated"] = round(fraction, 3)
+        if min_fraction is not None:
+            details["min_fraction"] = min_fraction
+            if fraction < min_fraction:
+                details["fraction_gate"] = (
+                    f"FAILED ({fraction:.3f} < {min_fraction})"
+                )
+                ok = False
+            else:
+                details["fraction_gate"] = "passed"
     summary = (
         f"flash sweep @ S={seq}: best fwd {best_fwd:.0f} TFLOP/s ({best_fwd_key})"
         + (
@@ -203,7 +220,7 @@ def sweep(
         )
         + ("" if on_tpu else " [interpret mode: timings not meaningful]")
     )
-    return ProbeResult(ok=True, summary=summary, metrics=metrics, details=details)
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
 
 
 def run(
@@ -214,7 +231,12 @@ def run(
     iters: int = 5,
     causal: bool = True,
     tolerance: float = 2e-2,
+    min_fraction: float | None = None,
 ) -> ProbeResult:
+    """``min_fraction`` gates the verdict on achieved fwd TFLOP/s as a
+    fraction of the chip's rated bf16 peak (BASELINE.md single-chip
+    bar, rated.FLASH_FRACTION_BAR) — inert off-TPU where the fraction
+    cannot be measured."""
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
     # default only — interpret-mode correctness is O(minutes) past 512,
@@ -395,6 +417,15 @@ def run(
         )
         details["rated_tflops"] = rated.bf16_tflops
         details["fraction"] = round(fraction, 3)
+        if min_fraction is not None:
+            details["min_fraction"] = min_fraction
+            if fraction < min_fraction:
+                details["fraction_gate"] = (
+                    f"FAILED ({fraction:.3f} < {min_fraction})"
+                )
+                ok = False
+            else:
+                details["fraction_gate"] = "passed"
         summary = (
             f"flash attention err {max_err:.1e} "
             f"({'OK' if correct else 'MISMATCH'}), {tflops:.0f} TFLOP/s "

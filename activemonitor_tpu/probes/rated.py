@@ -50,6 +50,24 @@ def _override(value: float, env: str) -> float:
     return value
 
 
+# Single-chip performance bars (BASELINE.md § single-chip bar): the
+# battery enforces these on real TPU hardware so an underperforming
+# chip FAILS its HealthCheck instead of merely reporting low gauges.
+# - flash fwd ≥0.40 of rated bf16 peak: measured ~0.46 on a healthy
+#   v5e (ops/flash_attention.py block-sweep tables; re-captured into
+#   SWEEP_TPU.md by hack/tpu_evidence.py) — 0.40 leaves headroom for
+#   shared-chip contention without passing a sick MXU/Mosaic path.
+# - training-step ≥0.15 MFU: PROVISIONAL floor for the probe
+#   transformer (small-model steps are overhead-bound well below the
+#   large-model 40-50% regime); raise once hack/tpu_evidence.py commits
+#   a measured train_mfu to BENCH_TPU.json. Overridable per run via
+#   --mfu-threshold / --min-fraction.
+TRAIN_MFU_BAR = float(os.environ.get("ACTIVEMONITOR_TRAIN_MFU_BAR", "0.15"))
+FLASH_FRACTION_BAR = float(
+    os.environ.get("ACTIVEMONITOR_FLASH_FRACTION_BAR", "0.40")
+)
+
+
 def rated_for(device_kind: str) -> Optional[RatedSpec]:
     """Spec for a jax device_kind string (e.g. "TPU v5 lite"), or None
     for unknown/non-TPU hardware."""

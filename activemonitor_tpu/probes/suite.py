@@ -117,17 +117,35 @@ def run(
     )
     from activemonitor_tpu.probes import flash
 
+    from activemonitor_tpu.probes.rated import FLASH_FRACTION_BAR, TRAIN_MFU_BAR
+
     # seq=None: the per-platform default (4096 on TPU, the interpret-
     # mode 512 cap elsewhere — an explicit seq would now be honored
     # verbatim and stall a CPU suite run for hours); quick mode still
-    # pins a short explicit length, safe on every platform
+    # pins a short explicit length, safe on every platform.
+    # The full battery enforces the BASELINE.md single-chip bars — an
+    # underperforming chip FAILS, it doesn't just report low gauges;
+    # quick mode (tiny shapes, throwaway timings) skips the bars
     add(
         "flash-attention",
-        lambda: flash.run(seq=1024 if quick else None, iters=iters),
+        lambda: flash.run(
+            seq=1024 if quick else None,
+            iters=iters,
+            min_fraction=None if quick else FLASH_FRACTION_BAR,
+        ),
     )
+    # full mode runs the SAME shape bench.py's train() calibration
+    # measures (batch_per_device=8, seq=128) — the bar and the evidence
+    # it is raised from must see the same per-step workload, or a bar
+    # calibrated on big steps fails healthy chips on small ones
     add(
         "training-step",
-        lambda: training_step.run(tiny=quick, batch_per_device=4, seq=64),
+        lambda: training_step.run(
+            tiny=quick,
+            batch_per_device=4 if quick else 8,
+            seq=64 if quick else 128,
+            mfu_threshold=None if quick else TRAIN_MFU_BAR,
+        ),
     )
     add(
         "decode",

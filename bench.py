@@ -145,9 +145,23 @@ def _secondary_metrics() -> dict:
                 by_name["mxu-int8-fraction-of-rated"], 4
             )
 
+    def train():
+        from activemonitor_tpu.probes import training_step as train_probe
+
+        result = train_probe.run(batch_per_device=8, seq=128, steps=3)
+        by_name = {m.name: m.value for m in result.metrics}
+        if "train-mfu" in by_name:
+            # the measured value BASELINE.md's provisional TRAIN_MFU_BAR
+            # waits on — captured to BENCH_TPU.json by the evidence harness
+            secondary["train_mfu"] = round(by_name["train-mfu"], 4)
+        secondary["train_tokens_per_second"] = round(
+            by_name["train-tokens-per-second"]
+        )
+
     guarded("flash_attention", flash)
     guarded("hbm_stream", hbm)
     guarded("mxu_int8", int8)
+    guarded("training_step", train)
     return secondary
 
 

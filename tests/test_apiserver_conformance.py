@@ -1,0 +1,373 @@
+"""Real-apiserver conformance: recorded wire-shape fixtures, replayed.
+
+The reference proves its controller against a REAL `kube-apiserver` +
+`etcd` on every CI run (envtest,
+/root/reference/internal/controllers/suite_test.go:67-134). No
+Kubernetes binaries exist in this sandbox, so the equivalent evidence
+is built in two directions around a fixture corpus
+(tests/fixtures/apiserver/*.json — real apiserver response shapes with
+per-fixture provenance):
+
+1. **Client conformance** — the REAL ``KubeApi`` client is driven
+   against a replay server that answers with the fixture bytes
+   (including adversarially-chunked watch streams), asserting the
+   client's error mapping, watch framing, and review handling against
+   the real wire format rather than the in-repo stub's.
+2. **Stub conformance** — the in-repo ``StubApiServer`` (which the
+   whole cluster-mode test tier trusts) is held to the SAME fixtures:
+   each scenario's live stub response must carry the real shape
+   (Status kind/apiVersion/metadata, reason, code). The stub can no
+   longer drift from apiserver semantics without a test failing.
+
+``docs/conformance.md`` inventories which semantics are fixture-backed
+vs still stub-assumed, and ``hack/capture_apiserver_fixtures.sh``
+regenerates the corpus from a live cluster when one is reachable.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from activemonitor_tpu.kube import KubeApi, KubeConfig
+from activemonitor_tpu.kube.client import ApiError
+from activemonitor_tpu.kube.stub import StubApiServer
+
+FIXTURE_DIR = Path(__file__).resolve().parent / "fixtures" / "apiserver"
+FIXTURES = {
+    path.stem: json.loads(path.read_text())
+    for path in sorted(FIXTURE_DIR.glob("*.json"))
+}
+
+
+def test_fixture_corpus_is_wellformed():
+    assert len(FIXTURES) >= 10
+    for name, fx in FIXTURES.items():
+        assert fx["name"] == name
+        # provenance must be declared — hand-transcribed (the committed
+        # corpus) or machine-captured (after the upgrade script ran)
+        src = fx.get("source", "").lower()
+        assert "transcribed" in src or "machine-captured" in src
+        assert "request" in fx
+        assert "response" in fx or "stream" in fx
+        assert "client_expect" in fx
+
+
+class ReplayServer:
+    """Answers every request with one fixture's recorded response.
+
+    ``chunking`` shapes how watch streams hit the socket: "line" (one
+    write per event line), "single" (whole stream in one write), or
+    "split" (7-byte writes straddling line boundaries) — the client
+    must frame identically in all three.
+    """
+
+    def __init__(self, fixture: dict, chunking: str = "line"):
+        self.fixture = fixture
+        self.chunking = chunking
+        self._runner = None
+        self.url = ""
+
+    async def start(self) -> str:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        host, port = site._server.sockets[0].getsockname()[:2]
+        self.url = f"http://{host}:{port}"
+        return self.url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        req = self.fixture["request"]
+        assert request.method == req["method"], (
+            f"fixture {self.fixture['name']}: got {request.method} "
+            f"{request.path}, recorded {req['method']} {req['path']}"
+        )
+        assert request.path == req["path"]
+        if "stream" in self.fixture:
+            payload = b"".join(
+                json.dumps(ev).encode() + b"\n" for ev in self.fixture["stream"]
+            )
+            resp = web.StreamResponse()
+            resp.content_type = "application/json"
+            await resp.prepare(request)
+            if self.chunking == "single":
+                await resp.write(payload)
+            elif self.chunking == "split":
+                for i in range(0, len(payload), 7):
+                    await resp.write(payload[i : i + 7])
+            else:
+                for line in payload.splitlines(keepends=True):
+                    await resp.write(line)
+            return resp
+        recorded = self.fixture["response"]
+        return web.json_response(recorded["body"], status=recorded["status"])
+
+
+async def _drive_client(fixture: dict, chunking: str = "line"):
+    """Run the real KubeApi against the fixture; return (result, error)."""
+    server = ReplayServer(fixture, chunking)
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url))
+    req = fixture["request"]
+    try:
+        if "stream" in fixture:
+            events = []
+            query = req.get("query", {})
+            try:
+                async for ev in api.watch(
+                    req["path"], resource_version=query.get("resourceVersion", "")
+                ):
+                    events.append(ev)
+            except ApiError as exc:
+                return events, exc
+            return events, None
+        try:
+            result = await api.request(
+                req["method"], req["path"], body=req.get("body")
+            )
+        except ApiError as exc:
+            return None, exc
+        return result, None
+    finally:
+        await api.close()
+        await server.stop()
+
+
+def _check_error(expect: dict, err: ApiError):
+    assert err is not None, "recorded response is an error; client returned none"
+    assert err.status == expect["error_status"]
+    if "reason_contains" in expect:
+        assert expect["reason_contains"] in err.reason
+    if expect.get("not_found"):
+        assert err.not_found
+    if expect.get("conflict"):
+        assert err.conflict
+    # the full recorded Status body must survive into the exception so
+    # callers can branch on reason (AlreadyExists vs Conflict)
+    if isinstance(err.body, dict):
+        assert err.body.get("kind") == "Status"
+        assert err.body.get("reason")
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize(
+    "name",
+    [n for n, f in FIXTURES.items() if "error_status" in f["client_expect"]],
+)
+async def test_client_maps_recorded_errors(name):
+    fixture = FIXTURES[name]
+    result, err = await _drive_client(fixture)
+    _check_error(fixture["client_expect"], err)
+
+
+@pytest.mark.asyncio
+async def test_client_parses_recorded_delete_success():
+    fixture = FIXTURES["delete_success"]
+    result, err = await _drive_client(fixture)
+    assert err is None
+    assert result["kind"] == "Status" and result["status"] == "Success"
+    assert result["details"]["name"] == "demo"
+
+
+@pytest.mark.asyncio
+async def test_client_parses_recorded_list_envelope():
+    fixture = FIXTURES["list_envelope"]
+    result, err = await _drive_client(fixture)
+    assert err is None
+    expect = fixture["client_expect"]
+    assert result["metadata"]["resourceVersion"] == expect["list_rv"]
+    assert len(result["items"]) == expect["items_len"]
+    assert result["kind"].endswith("List")
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("chunking", ["line", "single", "split"])
+async def test_client_frames_recorded_watch_stream(chunking):
+    """NDJSON framing must be independent of TCP chunk boundaries, and
+    BOOKMARK events (metadata-only objects) must pass through with
+    their resume resourceVersion intact."""
+    fixture = FIXTURES["watch_stream"]
+    events, err = await _drive_client(fixture, chunking)
+    assert err is None
+    expect = fixture["client_expect"]
+    assert [e["type"] for e in events] == expect["event_types"]
+    bookmark = events[-1]
+    assert (
+        bookmark["object"]["metadata"]["resourceVersion"]
+        == expect["bookmark_rv"]
+    )
+
+
+@pytest.mark.asyncio
+async def test_client_raises_on_recorded_watch_expiry():
+    events, err = await _drive_client(FIXTURES["watch_expired"])
+    assert events == []
+    _check_error(FIXTURES["watch_expired"]["client_expect"], err)
+
+
+@pytest.mark.asyncio
+async def test_authorizer_against_recorded_review_responses():
+    """KubeScrapeAuthorizer end-to-end against the RECORDED TokenReview
+    and SubjectAccessReview bodies a real apiserver returns."""
+    from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+    class BothReviews(ReplayServer):
+        async def _handle(self, request):
+            from aiohttp import web
+
+            name = (
+                "tokenreview"
+                if "tokenreviews" in request.path
+                else "subjectaccessreview"
+            )
+            recorded = FIXTURES[name]["response"]
+            return web.json_response(recorded["body"], status=recorded["status"])
+
+    server = BothReviews(FIXTURES["tokenreview"])
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url))
+    try:
+        auth = KubeScrapeAuthorizer(api)
+        assert await auth.allowed("<redacted-sa-token>") is True
+    finally:
+        await api.close()
+        await server.stop()
+
+
+# -- stub conformance ---------------------------------------------------
+
+
+def _status_shape_invariants(body: dict, invariants: dict):
+    """The live stub response must carry the real apiserver shape the
+    fixture records — keys AND the discriminating reason."""
+    assert body.get("kind") == "Status"
+    assert body.get("apiVersion") == "v1"
+    assert "metadata" in body
+    for key, want in invariants.items():
+        assert body.get(key) == want, f"stub {key}={body.get(key)!r}, real {want!r}"
+    if body.get("status") == "Failure":
+        assert body.get("message")
+
+
+async def _stub_scenario(scenario: str, invariants: dict):
+    token = "secret" if scenario == "bad_token" else ""
+    server = StubApiServer(token=token)
+    await server.start()
+    api = KubeApi(
+        KubeConfig(
+            server=server.url,
+            token="wrong" if scenario == "bad_token" else token,
+        )
+    )
+    path = "/apis/activemonitor.keikoproj.io/v1alpha1/namespaces/health/healthchecks"
+    obj = {
+        "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+        "kind": "HealthCheck",
+        "metadata": {"name": "demo", "namespace": "health"},
+        "spec": {"repeatAfterSec": 60},
+    }
+    try:
+        if scenario == "get_missing":
+            with pytest.raises(ApiError) as exc:
+                await api.get(f"{path}/demo")
+        elif scenario == "bad_token":
+            with pytest.raises(ApiError) as exc:
+                await api.get(f"{path}/demo")
+        elif scenario == "create_duplicate":
+            await api.create(path, obj)
+            with pytest.raises(ApiError) as exc:
+                await api.create(path, obj)
+        elif scenario == "replace_stale_rv":
+            created = await api.create(path, obj)
+            await api.merge_patch(f"{path}/demo", {"spec": {"repeatAfterSec": 30}})
+            stale = dict(obj, metadata=dict(obj["metadata"]))
+            stale["metadata"]["resourceVersion"] = created["metadata"][
+                "resourceVersion"
+            ]
+            with pytest.raises(ApiError) as exc:
+                await api.replace(f"{path}/demo", stale)
+        elif scenario == "delete_existing":
+            await api.create(path, obj)
+            body = await api.delete(f"{path}/demo")
+            return body
+        elif scenario == "watch_ancient_rv":
+            await api.create(path, obj)
+            for sec in (10, 20, 30):
+                await api.merge_patch(
+                    f"{path}/demo", {"spec": {"repeatAfterSec": sec}}
+                )
+            # simulate the watch cache window moving past rv 1
+            server._history = server._history[-1:]
+            with pytest.raises(ApiError) as exc:
+                async for _ in api.watch(path, resource_version="1"):
+                    pass
+        else:  # pragma: no cover - fixture/scenario drift guard
+            raise AssertionError(f"unknown stub scenario {scenario}")
+        err = exc.value
+        assert err.status == invariants["code"]
+        assert isinstance(err.body, dict)
+        return err.body
+    finally:
+        await api.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize(
+    "name", [n for n, f in FIXTURES.items() if "stub" in f]
+)
+async def test_stub_matches_recorded_shape(name):
+    fixture = FIXTURES[name]
+    body = await _stub_scenario(
+        fixture["stub"]["scenario"], fixture["stub"].get("invariants", {})
+    )
+    _status_shape_invariants(body, fixture["stub"].get("invariants", {}))
+
+
+@pytest.mark.asyncio
+async def test_stub_watch_expiry_event_shape():
+    """The stub's 410 travels as a watch ERROR event whose object is a
+    full Status — same framing the watch_expired fixture records."""
+    server = StubApiServer()
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url))
+    path = "/apis/activemonitor.keikoproj.io/v1alpha1/namespaces/health/healthchecks"
+    try:
+        await api.create(
+            path,
+            {
+                "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+                "kind": "HealthCheck",
+                "metadata": {"name": "demo", "namespace": "health"},
+            },
+        )
+        for sec in (10, 20, 30):
+            await api.merge_patch(f"{path}/demo", {"spec": {"repeatAfterSec": sec}})
+        server._history = server._history[-1:]
+        # read the raw stream to inspect the event envelope itself
+        session = await api._ensure_session()
+        async with session.get(
+            api._url(path),
+            params={"watch": "true", "resourceVersion": "1"},
+            headers=await api._headers(),
+        ) as resp:
+            line = await resp.content.readline()
+        event = json.loads(line)
+        assert event["type"] == "ERROR"
+        _status_shape_invariants(
+            event["object"], {"code": 410, "reason": "Expired"}
+        )
+    finally:
+        await api.close()
+        await server.stop()

@@ -192,6 +192,62 @@ def test_training_step_probe_tiny():
     assert 0 < r.details["loss_last"] < 10
 
 
+def test_training_step_mfu_gate_enforces_bar(monkeypatch):
+    """BASELINE.md single-chip bar: with a rated spec present, MFU
+    below the threshold FAILS the verdict; without a threshold the MFU
+    stays a gauge."""
+    from activemonitor_tpu.probes.rated import RatedSpec
+
+    absurd = RatedSpec(
+        "v5e", bf16_tflops=1e9, hbm_gbps=819.0,
+        ici_unidir_gbps=45.0, ici_links=4,
+    )  # makes any real measurement a ~zero MFU
+    monkeypatch.setattr(training_step, "rated_for", lambda kind: absurd)
+    r = training_step.run(
+        tiny=True, batch_per_device=2, seq=16, steps=1, mfu_threshold=0.5
+    )
+    assert not r.ok
+    assert r.details["mfu_gate"].startswith("FAILED")
+    assert r.details["mfu_threshold"] == 0.5
+    assert any(m.name == "train-mfu" for m in r.metrics)
+    # same chip, no threshold: gauge only, verdict unaffected
+    r = training_step.run(tiny=True, batch_per_device=2, seq=16, steps=1)
+    assert r.ok and "mfu_gate" not in r.details
+
+
+def test_training_step_mfu_gate_skipped_without_rated_spec():
+    """A threshold against hardware with no rated spec reports the gap
+    instead of guessing a verdict (CPU mesh: rated_for is None)."""
+    r = training_step.run(
+        tiny=True, batch_per_device=2, seq=16, steps=1, mfu_threshold=0.5
+    )
+    assert r.ok
+    assert "no rated spec" in r.details["mfu_gate"]
+
+
+def test_training_step_ring_attention_builds_sp_mesh():
+    """attention="ring" with no mesh auto-builds a dp×sp mesh and the
+    differentiated ring step produces a finite loss."""
+    r = training_step.run(
+        tiny=True, batch_per_device=2, seq=32, steps=1, attention="ring"
+    )
+    assert r.ok
+    assert r.details["mesh"]["sp"] == 2
+    assert r.details["attention"] == "ring"
+    assert 0 < r.details["loss_last"] < 10
+
+
+def test_flash_probe_fraction_gate_inert_off_tpu():
+    """min_fraction gates only where the fraction is measurable — a CPU
+    run stays a correctness check, never a bogus perf verdict."""
+    from activemonitor_tpu.probes import flash
+
+    r = flash.run(batch=1, seq=128, heads=2, head_dim=64, iters=2,
+                  min_fraction=0.99)
+    assert r.ok
+    assert "fraction_gate" not in r.details
+
+
 def test_probe_contract_line_parses():
     r = ProbeResult(
         ok=True,
