@@ -85,9 +85,19 @@ def _block_mask(causal, qi, ki, block_q: int, block_k: int, offset: int,
     return mask
 
 
+def _seg_mask(qseg_ref, kseg_ref):
+    """Packed-sequence visibility: q row attends k only within the same
+    segment. Refs hold the [1, block] int32 id slices for this block
+    pair."""
+    q_seg = qseg_ref[0]  # [block_q]
+    k_seg = kseg_ref[0]  # [block_k]
+    return q_seg[:, None] == k_seg[None, :]
+
+
 def _make_attention_kernel(
     causal: bool, block_q: int, block_k: int, num_k: int, scale: float,
     partial: bool, offset: int = 0, kv_len: int | None = None,
+    segmented: bool = False,
 ):
     """One builder for both forward flavors — identical online-softmax
     body (init, causal visibility, attend, last-visible write point);
@@ -96,7 +106,8 @@ def _make_attention_kernel(
     (accumulator, max, denominator) merge state ring attention combines
     across devices (ops/ring_attention.py). ``offset``/``kv_len``
     generalize to cross-length attention and padded K/V (see
-    :func:`_block_mask`)."""
+    :func:`_block_mask`); ``segmented`` adds per-row segment-id masking
+    for packed sequences (two extra [B, S] int32 inputs)."""
     from jax.experimental import pallas as pl
 
     # only mask keys when padding actually added invalid positions
@@ -107,6 +118,9 @@ def _make_attention_kernel(
     last_k = (kv_mask_from - 1) // block_k if kv_mask_from else num_k - 1
 
     def kernel(q_ref, k_ref, v_ref, *rest):
+        if segmented:
+            qseg_ref, kseg_ref = rest[:2]
+            rest = rest[2:]
         if partial:
             acc_out, m_out, l_out, acc_ref, m_ref, l_ref = rest
         else:
@@ -140,6 +154,9 @@ def _make_attention_kernel(
                 * scale
             )  # [block_q, block_k]
             mask = _block_mask(causal, qi, ki, block_q, block_k, offset, kv_mask_from)
+            if segmented:
+                seg = _seg_mask(qseg_ref, kseg_ref)
+                mask = seg if mask is None else (mask & seg)
             if mask is not None:
                 s = jnp.where(mask, s, _NEG_INF)
 
@@ -248,7 +265,8 @@ def flash_attention_partial(
 
 
 def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int,
-                    scale: float, offset: int = 0, kv_len: int | None = None):
+                    scale: float, offset: int = 0, kv_len: int | None = None,
+                    segmented: bool = False):
     from jax.experimental import pallas as pl
 
     kv_mask_from = (
@@ -256,7 +274,11 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int,
     )
     last_k = (kv_mask_from - 1) // block_k if kv_mask_from else num_k - 1
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc):
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest):
+        if segmented:
+            qseg_ref, kseg_ref, dq_ref, dq_acc = rest
+        else:
+            dq_ref, dq_acc = rest
         qi = pl.program_id(2)
         ki = pl.program_id(3)
 
@@ -284,6 +306,9 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int,
                 * scale
             )
             mask = _block_mask(causal, qi, ki, block_q, block_k, offset, kv_mask_from)
+            if segmented:
+                seg = _seg_mask(qseg_ref, kseg_ref)
+                mask = seg if mask is None else (mask & seg)
             if mask is not None:
                 s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)  # masked entries underflow to 0
@@ -311,7 +336,8 @@ def _make_dq_kernel(causal: bool, block_q: int, block_k: int, num_k: int,
 
 def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int,
                      scale: float, group: int = 1, offset: int = 0,
-                     kv_len: int | None = None, num_k: int | None = None):
+                     kv_len: int | None = None, num_k: int | None = None,
+                     segmented: bool = False):
     """dK/dV kernel. Grid is (batch, heads_KV, num_k, group·num_q): for
     GQA the inner sweep enumerates every (query head in the group,
     Q block) pair while the SAME dk/dv accumulator block stays resident
@@ -326,10 +352,11 @@ def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int,
         else None
     )
 
-    def kernel(
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-        dk_ref, dv_ref, dk_acc, dv_acc,
-    ):
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest):
+        if segmented:
+            qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+        else:
+            dk_ref, dv_ref, dk_acc, dv_acc = rest
         ki = pl.program_id(2)  # K block owns this grid row
         t = pl.program_id(3)  # (group, Q) sweep innermost
         qi = jax.lax.rem(t, num_q)
@@ -358,6 +385,9 @@ def _make_dkv_kernel(causal: bool, block_q: int, block_k: int, num_q: int,
                 * scale
             )
             mask = _block_mask(causal, qi, ki, block_q, block_k, offset, kv_mask_from)
+            if segmented:
+                seg = _seg_mask(qseg_ref, kseg_ref)
+                mask = seg if mask is None else (mask & seg)
             if mask is not None:
                 s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)  # [bq, bk]
@@ -428,7 +458,8 @@ def _fit_block(seq: int, preferred: int) -> int:
 
 
 def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
-                  offset: int = 0, kv_len: int | None = None):
+                  offset: int = 0, kv_len: int | None = None,
+                  segments: tuple | None = None):
     """(out, lse) on [B, H, S, D] arrays; lse is [B, H, Sq, 1] float32.
 
     Generalized shapes: ``k``/``v`` may carry a different sequence
@@ -436,7 +467,8 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
     diagonal) and FEWER heads than ``q`` (GQA/MQA — the BlockSpec index
     map points each group of ``heads_q // heads_kv`` query heads at the
     same K/V head, so grouped keys are read in place, never
-    materialized per-query-head)."""
+    materialized per-query-head). ``segments`` = (q_seg [B, Sq],
+    kv_seg [B, Sk]) int32 adds packed-sequence masking."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -451,12 +483,20 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
 
     kernel = _make_attention_kernel(
         causal, block_q, block_k, num_k, scale, partial=False,
-        offset=offset, kv_len=kv_len,
+        offset=offset, kv_len=kv_len, segmented=segments is not None,
     )
     spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
     spec_kv = pl.BlockSpec(
         (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
     )
+    inputs = [q, k, v]
+    in_specs = [spec_q, spec_kv, spec_kv]
+    if segments is not None:
+        inputs += [segments[0], segments[1]]
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
+        ]
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
@@ -467,7 +507,7 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
             jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
         ),
         grid=(batch, heads, num_q, num_k),
-        in_specs=[spec_q, spec_kv, spec_kv],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -478,12 +518,13 @@ def _forward_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
 def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None,
-                   block_k=None, offset: int = 0, kv_len: int | None = None):
+                   block_k=None, offset: int = 0, kv_len: int | None = None,
+                   segments: tuple | None = None):
     """dQ/dK/dV on [B, H, S, D] arrays via blockwise recompute.
     ``block_q``/``block_k`` override the tuned defaults (the flash
     probe's ``--sweep`` uses this to re-measure the table the defaults
@@ -499,13 +540,14 @@ def _backward_bhsd(q, k, v, out, lse, dout, causal: bool, block_q=None,
         q, k, v, lse, delta, dout, causal,
         _fit_block(q.shape[2], block_q or _BWD_BLOCK_Q),
         _fit_block(k.shape[2], block_k or _BWD_BLOCK_K),
-        offset=offset, kv_len=kv_len,
+        offset=offset, kv_len=kv_len, segments=segments,
     )
 
 
 def _backward_bhsd_core(
     q, k, v, lse, delta, dout, causal: bool, block_q: int, block_k: int,
     out_dtype=None, offset: int = 0, kv_len: int | None = None,
+    segments: tuple | None = None,
 ):
     """The backward pallas calls with EXTERNAL per-row statistics.
 
@@ -536,16 +578,25 @@ def _backward_bhsd_core(
     )
     spec_row = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
 
+    dq_inputs = [q, k, v, dout, lse, delta]
+    dq_specs = [spec_q, spec_kv, spec_kv, spec_q, spec_row, spec_row]
+    if segments is not None:
+        dq_inputs += [segments[0], segments[1]]
+        dq_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
+        ]
     dq = pl.pallas_call(
         _make_dq_kernel(causal, block_q, block_k, num_k, scale,
-                        offset=offset, kv_len=kv_len),
+                        offset=offset, kv_len=kv_len,
+                        segmented=segments is not None),
         out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype),
         grid=(batch, heads, num_q, num_k),
-        in_specs=[spec_q, spec_kv, spec_kv, spec_q, spec_row, spec_row],
+        in_specs=dq_specs,
         out_specs=spec_q,
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(*dq_inputs)
 
     # dK/dV grid: K block outer, (group·Q) sweep inner — the index maps
     # decompose the inner counter j into (query head in group, Q block)
@@ -558,22 +609,31 @@ def _backward_bhsd_core(
         (1, 1, block_q, 1),
         lambda b, h, i, j: (b, h * group + j // num_q, j % num_q, 0),
     )
+    dkv_inputs = [q, k, v, dout, lse, delta]
+    dkv_specs = [spec_q_t, spec_kv_t, spec_kv_t, spec_q_t, spec_row_t, spec_row_t]
+    if segments is not None:
+        dkv_inputs += [segments[0], segments[1]]
+        dkv_specs += [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, j % num_q)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, i)),
+        ]
     dk, dv = pl.pallas_call(
         _make_dkv_kernel(causal, block_q, block_k, num_q, scale, group=group,
-                         offset=offset, kv_len=kv_len, num_k=num_k),
+                         offset=offset, kv_len=kv_len, num_k=num_k,
+                         segmented=segments is not None),
         out_shape=(
             jax.ShapeDtypeStruct(k.shape, grad_dtype),
             jax.ShapeDtypeStruct(v.shape, grad_dtype),
         ),
         grid=(batch, heads_kv, num_k, group * num_q),
-        in_specs=[spec_q_t, spec_kv_t, spec_kv_t, spec_q_t, spec_row_t, spec_row_t],
+        in_specs=dkv_specs,
         out_specs=(spec_kv_t, spec_kv_t),
         scratch_shapes=[
             pltpu.VMEM((block_k, head_dim), jnp.float32),
             pltpu.VMEM((block_k, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -645,6 +705,35 @@ def _flash_bhsd_bwd(causal, block_q, block_k, offset, kv_len, residuals, dout):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd_seg(q, k, v, q_seg, kv_seg, causal, block_q, block_k,
+                    offset, kv_len):
+    out, _ = _forward_bhsd(q, k, v, causal, block_q, block_k, offset,
+                           kv_len, segments=(q_seg, kv_seg))
+    return out
+
+
+def _flash_bhsd_seg_fwd(q, k, v, q_seg, kv_seg, causal, block_q, block_k,
+                        offset, kv_len):
+    out, lse = _forward_bhsd(q, k, v, causal, block_q, block_k, offset,
+                             kv_len, segments=(q_seg, kv_seg))
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
+
+
+def _flash_bhsd_seg_bwd(causal, block_q, block_k, offset, kv_len,
+                        residuals, dout):
+    q, k, v, q_seg, kv_seg, out, lse = residuals
+    dq, dk, dv = _backward_bhsd(
+        q, k, v, out, lse, dout, causal, offset=offset, kv_len=kv_len,
+        segments=(q_seg, kv_seg),
+    )
+    # segment ids are integer inputs: None = symbolic-zero cotangent
+    return dq, dk, dv, None, None
+
+
+_flash_bhsd_seg.defvjp(_flash_bhsd_seg_fwd, _flash_bhsd_seg_bwd)
+
+
 def _pad_seq(x: jax.Array, pad: int) -> jax.Array:
     """Zero-pad the seq dim (axis 2 of [B, H, S, D])."""
     if not pad:
@@ -683,6 +772,7 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     layout: str = "bshd",
+    segment_ids=None,
 ) -> jax.Array:
     """Fused attention, differentiable (custom VJP with blockwise
     recompute from the saved logsumexp — flash-attention backward).
@@ -703,6 +793,12 @@ def flash_attention(
       unit) are zero-padded to the next multiple and the padded keys
       masked out; outputs/gradients are sliced back, so callers never
       see the padding.
+    - **Packed sequences** — ``segment_ids`` masks attention to
+      same-segment pairs: one ``[B, S]`` int array for self-attention,
+      or a ``(q_ids [B, Sq], kv_ids [B, Sk])`` tuple for cross-length
+      calls. Ids must be ≥ 0 (padding uses negative sentinels that
+      match nothing). Causal + segments composes to the standard
+      packed-causal mask.
 
     ``layout="bshd"`` takes ``[batch, seq, heads, head_dim]`` (what
     ops/ring_attention.py uses) and transposes to the kernel's native
@@ -763,7 +859,37 @@ def flash_attention(
     offset = (seq_k - seq_q) if causal else 0
     kv_len = seq_k if seq_k_p != seq_k else None
 
-    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k, offset, kv_len)
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            q_seg, kv_seg = segment_ids
+        else:
+            if seq_q != seq_k:
+                raise ValueError(
+                    "cross-length attention needs a (q_ids, kv_ids) "
+                    "segment_ids tuple, got one array for "
+                    f"seq_q={seq_q} vs seq_k={seq_k}"
+                )
+            q_seg = kv_seg = segment_ids
+        if q_seg.shape != (batch, seq_q) or kv_seg.shape != (batch, seq_k):
+            raise ValueError(
+                f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} do not "
+                f"match [batch, seq] = [{batch}, {seq_q}]/[{batch}, {seq_k}]"
+            )
+        # distinct negative sentinels: padded queries and padded keys
+        # match nothing, including each other
+        q_seg = jnp.pad(
+            q_seg.astype(jnp.int32),
+            ((0, 0), (0, seq_q_p - seq_q)), constant_values=-1,
+        )
+        kv_seg = jnp.pad(
+            kv_seg.astype(jnp.int32),
+            ((0, 0), (0, seq_k_p - seq_k)), constant_values=-2,
+        )
+        out = _flash_bhsd_seg(
+            qt, kt, vt, q_seg, kv_seg, causal, block_q, block_k, offset, kv_len
+        )
+    else:
+        out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k, offset, kv_len)
     if seq_q_p != seq_q:
         out = out[:, :, :seq_q]
     return jnp.swapaxes(out, 1, 2) if layout == "bshd" else out

@@ -345,15 +345,17 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+def reference_attention(q, k, v, causal: bool = True, segment_ids=None) -> jax.Array:
     """Single-device attention for correctness checks.
 
     Generalized the same way as the fused kernel
     (ops/flash_attention.py): K/V may carry fewer heads (GQA — each
-    group of ``n_heads // n_kv_heads`` query heads shares a K/V head)
-    and a different sequence length (causal masking bottom-right
-    aligned: query row i attends keys ≤ i + seq_k − seq_q, the decode
-    convention; equal lengths reduce to the standard mask)."""
+    group of ``n_heads // n_kv_heads`` query heads shares a K/V head),
+    a different sequence length (causal masking bottom-right aligned:
+    query row i attends keys ≤ i + seq_k − seq_q, the decode
+    convention; equal lengths reduce to the standard mask), and packed
+    sequences (``segment_ids``: one [B, S] array or a (q_ids, kv_ids)
+    tuple — attention only within matching segments)."""
     scale = 1.0 / jnp.sqrt(q.shape[-1])
     heads, heads_kv = q.shape[2], k.shape[2]
     if heads != heads_kv:
@@ -365,5 +367,12 @@ def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
         q_pos = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
         mask = q_pos >= jnp.arange(seq_k)[None, :]
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            q_seg, kv_seg = segment_ids
+        else:
+            q_seg = kv_seg = segment_ids
+        seg = q_seg[:, :, None] == kv_seg[:, None, :]  # [B, Sq, Sk]
+        scores = jnp.where(seg[:, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)

@@ -28,6 +28,20 @@ from activemonitor_tpu.probes.rated import rated_for
 from activemonitor_tpu.utils.timing import chain_delta_seconds
 
 
+def _apply_fraction_gate(details: dict, fraction: float, min_fraction) -> bool:
+    """Record the BASELINE.md fraction-of-rated bar in ``details`` and
+    return the verdict. Shared by run() and sweep() so the gate policy
+    and the details keys cannot drift between the two probes."""
+    if min_fraction is None:
+        return True
+    details["min_fraction"] = min_fraction
+    if fraction < min_fraction:
+        details["fraction_gate"] = f"FAILED ({fraction:.3f} < {min_fraction})"
+        return False
+    details["fraction_gate"] = "passed"
+    return True
+
+
 def sweep(
     batch: int = 4,
     seq: int | None = None,
@@ -201,15 +215,7 @@ def sweep(
     if rated is not None and on_tpu:
         fraction = best_fwd / rated.bf16_tflops
         details["best_fraction_of_rated"] = round(fraction, 3)
-        if min_fraction is not None:
-            details["min_fraction"] = min_fraction
-            if fraction < min_fraction:
-                details["fraction_gate"] = (
-                    f"FAILED ({fraction:.3f} < {min_fraction})"
-                )
-                ok = False
-            else:
-                details["fraction_gate"] = "passed"
+        ok = _apply_fraction_gate(details, fraction, min_fraction)
     summary = (
         f"flash sweep @ S={seq}: best fwd {best_fwd:.0f} TFLOP/s ({best_fwd_key})"
         + (
@@ -417,15 +423,7 @@ def run(
         )
         details["rated_tflops"] = rated.bf16_tflops
         details["fraction"] = round(fraction, 3)
-        if min_fraction is not None:
-            details["min_fraction"] = min_fraction
-            if fraction < min_fraction:
-                details["fraction_gate"] = (
-                    f"FAILED ({fraction:.3f} < {min_fraction})"
-                )
-                ok = False
-            else:
-                details["fraction_gate"] = "passed"
+        ok = ok and _apply_fraction_gate(details, fraction, min_fraction)
         summary = (
             f"flash attention err {max_err:.1e} "
             f"({'OK' if correct else 'MISMATCH'}), {tflops:.0f} TFLOP/s "

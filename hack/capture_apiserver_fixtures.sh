@@ -21,14 +21,12 @@ VERSION="v1alpha1"
 OUT_DIR="$(cd "$(dirname "$0")/.." && pwd)/tests/fixtures/apiserver"
 
 API_SERVER=$(kubectl config view --minify -o jsonpath='{.clusters[0].cluster.server}')
-TOKEN=$(kubectl create token default --duration=10m 2>/dev/null \
-  || kubectl get secret -n default -o jsonpath='{.items[0].data.token}' | base64 -d)
 
-kcurl() { # method path [body]
-  local method=$1 path=$2 body=${3:-}
+kcurl() { # method path [body] [content-type]
+  local method=$1 path=$2 body=${3:-} ctype=${4:-application/json}
   if [ -n "$body" ]; then
     curl -ksS -X "$method" -H "Authorization: Bearer $TOKEN" \
-      -H "Content-Type: application/json" -d "$body" \
+      -H "Content-Type: $ctype" -d "$body" \
       -w '\n%{http_code}' "$API_SERVER$path"
   else
     curl -ksS -X "$method" -H "Authorization: Bearer $TOKEN" \
@@ -68,7 +66,18 @@ HC_PATH="/apis/$GROUP/$VERSION/namespaces/$NS/healthchecks"
 DEMO='{"apiVersion":"'$GROUP'/'$VERSION'","kind":"HealthCheck","metadata":{"name":"demo","namespace":"'$NS'"},"spec":{"repeatAfterSec":60,"workflow":{"generateName":"demo-","resource":{"namespace":"'$NS'","source":{"inline":"{}"}}}}}'
 
 kubectl create namespace "$NS" --dry-run=client -o yaml | kubectl apply -f -
-trap 'kubectl delete namespace "$NS" --wait=false >/dev/null 2>&1 || true' EXIT
+trap 'kubectl delete namespace "$NS" --wait=false >/dev/null 2>&1 || true;
+      kubectl delete clusterrolebinding fixture-capture-admin >/dev/null 2>&1 || true' EXIT
+
+# the captures must run with enough RBAC to exercise the CRD verbs —
+# an unprivileged token would record 403s over every intended shape
+kubectl create serviceaccount fixture-capture -n "$NS" \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl create clusterrolebinding fixture-capture-admin \
+  --clusterrole=cluster-admin \
+  --serviceaccount="$NS:fixture-capture" \
+  --dry-run=client -o yaml | kubectl apply -f -
+TOKEN=$(kubectl create token fixture-capture -n "$NS" --duration=10m)
 
 echo "== 404 NotFound"
 capture get_notfound GET "$HC_PATH/demo"
@@ -79,7 +88,11 @@ capture post_alreadyexists POST "$HC_PATH" "$DEMO"
 
 echo "== 409 Conflict (stale resourceVersion)"
 STALE=$(kcurl GET "$HC_PATH/demo" | head -n -1)
-kcurl PATCH "$HC_PATH/demo" '{"spec":{"repeatAfterSec":61}}' >/dev/null || true
+# merge-patch content type: a real apiserver rejects PATCH with plain
+# application/json (415), which would leave the RV unbumped and turn
+# the PUT below into a 200 instead of the Conflict being captured
+kcurl PATCH "$HC_PATH/demo" '{"spec":{"repeatAfterSec":61}}' \
+  application/merge-patch+json >/dev/null
 capture put_conflict PUT "$HC_PATH/demo" "$STALE"
 
 echo "== 422 Invalid"

@@ -272,6 +272,100 @@ def test_cross_attention_lengths(seq_q, seq_k, causal):
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_segment_ids_match_reference(causal):
+    """Packed sequences: attention stays within matching segment ids,
+    forward and gradients, against the reference oracle."""
+    q, k, v = _qkv(batch=2, seq=128, heads=4, head_dim=32)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 50), jnp.int32), jnp.ones((2, 78), jnp.int32)], axis=1
+    )
+    got = flash_attention(
+        q, k, v, causal=causal, segment_ids=seg, block_q=64, block_k=64
+    )
+    want = reference_attention(q, k, v, causal=causal, segment_ids=seg)
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(
+        loss(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, segment_ids=seg, block_q=64, block_k=64
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        loss(lambda a, b, c: reference_attention(
+            a, b, c, causal=causal, segment_ids=seg
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_segment_ids_equal_separate_sequences():
+    """The gold semantic: a packed batch must reproduce each sequence
+    attended SEPARATELY — packing is an optimization, not a semantics
+    change."""
+    q, k, v = _qkv(batch=2, seq=128, heads=4, head_dim=32)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 50), jnp.int32), jnp.ones((2, 78), jnp.int32)], axis=1
+    )
+    packed = flash_attention(
+        q, k, v, causal=True, segment_ids=seg, block_q=64, block_k=64
+    )
+    sep_a = flash_attention(
+        q[:, :50], k[:, :50], v[:, :50], causal=True, block_q=64, block_k=64
+    )
+    sep_b = flash_attention(
+        q[:, 50:], k[:, 50:], v[:, 50:], causal=True, block_q=64, block_k=64
+    )
+    assert float(jnp.max(jnp.abs(packed[:, :50] - sep_a))) < 1e-5
+    assert float(jnp.max(jnp.abs(packed[:, 50:] - sep_b))) < 1e-5
+
+
+def test_segment_ids_compose_with_gqa_and_padding():
+    """Segments + grouped heads + non-8-multiple (padded) lengths in
+    one call — padding sentinels must never match a real segment."""
+    keys = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(keys[0], (1, 100, 4, 32), jnp.float32)
+    k = jax.random.normal(keys[1], (1, 100, 2, 32), jnp.float32)
+    v = jax.random.normal(keys[2], (1, 100, 2, 32), jnp.float32)
+    seg = jnp.concatenate(
+        [jnp.zeros((1, 40), jnp.int32), jnp.ones((1, 60), jnp.int32)], axis=1
+    )
+    got = flash_attention(q, k, v, segment_ids=seg)
+    want = reference_attention(q, k, v, segment_ids=seg)
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-5
+    g = jax.grad(
+        lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, segment_ids=seg) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda a, b, c: jnp.sum(
+            reference_attention(a, b, c, segment_ids=seg) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_segment_ids_validation():
+    q, k, v = _qkv(seq=128)
+    with pytest.raises(ValueError, match="segment_ids shapes"):
+        flash_attention(q, k, v, segment_ids=jnp.zeros((1, 64), jnp.int32))
+    with pytest.raises(ValueError, match="tuple"):
+        flash_attention(
+            q, k[:, :64], v[:, :64], causal=False,
+            segment_ids=jnp.zeros((1, 128), jnp.int32),
+        )
+
+
 def test_gqa_cross_odd_seq_combined():
     """All three generalizations at once: grouped heads + differing
     odd (padded) lengths + causal offset, with gradients."""
