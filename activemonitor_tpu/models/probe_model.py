@@ -220,18 +220,18 @@ def ring_attention_fn(
         raise ValueError(
             f"ring attention needs a {axis!r} mesh axis, mesh has {dict(mesh.shape)}"
         )
-    if cfg.kv_heads != cfg.n_heads:
-        raise ValueError(
-            f"ring attention does not support GQA (n_kv_heads "
-            f"{cfg.kv_heads} != n_heads {cfg.n_heads}); use attention='flash' "
-            "(the fused kernel runs grouped heads natively) or dense"
-        )
     heads_axis = None
     if tp_axis in mesh.shape and mesh.shape[tp_axis] > 1:
         if cfg.n_heads % mesh.shape[tp_axis]:
             raise ValueError(
                 f"ring attention needs n_heads ({cfg.n_heads}) divisible by "
                 f"the {tp_axis!r} mesh axis ({mesh.shape[tp_axis]})"
+            )
+        if cfg.kv_heads % mesh.shape[tp_axis]:
+            raise ValueError(
+                f"ring attention needs n_kv_heads ({cfg.kv_heads}) divisible "
+                f"by the {tp_axis!r} mesh axis ({mesh.shape[tp_axis]}); each "
+                "shard must hold whole K/V heads for its query-head group"
             )
         heads_axis = tp_axis
     spec = P("data" if "data" in mesh.shape else None, axis, heads_axis, None)

@@ -215,7 +215,9 @@ def flash_attention_partial(
     block_k: int = 1024,
 ):
     """Unnormalized fused attention for one (Q block, KV block) pair in
-    ``[batch, seq_q, heads, head_dim]`` layout (ring attention's).
+    ``[batch, seq_q, heads, head_dim]`` layout (ring attention's). K/V
+    may carry fewer heads (GQA: any divisor of q's heads) — the index
+    map points each query-head group at its shared K/V head.
 
     Returns ``(block_max [B, H, Sq], out_unnormalized [B, Sq, H, D]
     float32, denom [B, H, Sq])`` — the exact contract of ring
@@ -229,6 +231,7 @@ def flash_attention_partial(
 
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
+    group = heads // k.shape[2]  # GQA: Hkv divides H, same as the full kernel
     block_q = _fit_block(seq_q, block_q)
     block_k = _fit_block(seq_k, block_k)
     num_q, num_k = seq_q // block_q, seq_k // block_k
@@ -242,7 +245,9 @@ def flash_attention_partial(
         causal, block_q, block_k, num_k, scale, partial=True
     )
     spec_q = pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0))
-    spec_kv = pl.BlockSpec((1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0))
+    spec_kv = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
+    )
     spec_row = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     acc, m, l = pl.pallas_call(
         kernel,
@@ -653,8 +658,10 @@ def flash_attention_backward_block(
     (ops/ring_attention.py).
 
     Layout matches :func:`flash_attention_partial`: q/dout are
-    ``[B, Sq, H, D]``, k/v ``[B, Sk, H, D]`` (``Sq == Sk`` per ring
-    step); ``lse``/``delta`` are ``[B, H, Sq]`` float32 — the GLOBAL
+    ``[B, Sq, H, D]``, k/v ``[B, Sk, Hkv, D]`` with Hkv dividing H
+    (GQA: dK/dV come back group-summed in K/V's own narrow shape;
+    ``Sq == Sk`` per ring step); ``lse``/``delta`` are ``[B, H, Sq]``
+    float32 — the GLOBAL
     logsumexp from the ring forward and rowsum(dO ∘ O). Because p =
     exp(s − lse_global) is the true global attention probability, the
     (dq, dk, dv) this returns are exact per-block contributions that

@@ -47,26 +47,38 @@ def _block_attend(q, k, v, mask):
     """Scores for one (Q-block, KV-block) pair.
 
     Returns (scores_max, exp_scores @ v, exp_scores row sums) for the
-    online-softmax accumulation. q: [B,Sq,H,D]; k,v: [B,Sk,H,D];
-    mask: [Sq,Sk] bool (True = attend) or None.
+    online-softmax accumulation. q: [B,Sq,H,D]; k,v: [B,Sk,Hkv,D] with
+    Hkv dividing H (GQA: each group of H//Hkv query heads shares a K/V
+    head); mask: [Sq,Sk] bool (True = attend) or None. The merge state
+    comes back q-head-indexed ([B,H,Sq]) regardless of grouping.
     """
     scale = 1.0 / jnp.sqrt(q.shape[-1])
+    batch, seq_q, heads, head_dim = q.shape
+    heads_kv = k.shape[2]
+    group = heads // heads_kv
     # upcast K/V here, not before the ring rotation: ppermute moves the
     # input-dtype blocks, so bf16 inputs cost bf16 (not f32) ICI traffic
     k = k.astype(jnp.float32)
     v = v.astype(jnp.float32)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    qg = q.reshape(batch, seq_q, heads_kv, group, head_dim)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * scale
     if mask is not None:
-        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
-    block_max = jnp.max(scores, axis=-1)  # [B,H,Sq]
+        scores = jnp.where(mask[None, None, None, :, :], scores, _NEG_INF)
+    block_max = jnp.max(scores, axis=-1)  # [B,Hkv,G,Sq]
     exp = jnp.exp(scores - block_max[..., None])
     if mask is not None:
         # rows with no visible keys: exp(NEG_INF - NEG_INF) = 1 — zero them
         any_visible = jnp.any(mask, axis=-1)  # [Sq]
-        exp = exp * any_visible[None, None, :, None]
-    out = jnp.einsum("bhqk,bkhd->bqhd", exp, v)
-    denom = jnp.sum(exp, axis=-1)  # [B,H,Sq]
-    return block_max, out, denom
+        exp = exp * any_visible[None, None, None, :, None]
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", exp, v).reshape(
+        batch, seq_q, heads, head_dim
+    )
+    denom = jnp.sum(exp, axis=-1)  # [B,Hkv,G,Sq]
+    return (
+        block_max.reshape(batch, heads, seq_q),
+        out,
+        denom.reshape(batch, heads, seq_q),
+    )
 
 
 def _ring_attention_sharded(
@@ -193,6 +205,8 @@ def _ring_attention_bwd_sharded(
     einsums recompute s and p = exp(s − lse_global) directly."""
     my_idx = jax.lax.axis_index(axis_name)
     batch, seq_local, heads, head_dim = q.shape
+    heads_kv = k.shape[2]
+    group = heads // heads_kv  # GQA: grouped heads share a K/V head
     scale = 1.0 / (head_dim ** 0.5)
     perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
     causal_mask = jnp.tril(jnp.ones((seq_local, seq_local), jnp.bool_))
@@ -218,19 +232,27 @@ def _ring_attention_bwd_sharded(
                 q_in, kf, vf, lse, delta, dout, causal=True
             )
     else:
+        # grouped views: head index h = hkv*group + g, matching the
+        # forward's reshape; dK/dV einsums sum over the group axis
+        qg = qf.reshape(batch, seq_local, heads_kv, group, head_dim)
+        dog = dof.reshape(batch, seq_local, heads_kv, group, head_dim)
+        lse_g = lse.reshape(batch, heads_kv, group, seq_local)
+        delta_g = delta.reshape(batch, heads_kv, group, seq_local)
 
         def _attend(q_in, kf, vf, diagonal):
             kff = kf.astype(jnp.float32)
             vff = vf.astype(jnp.float32)
-            s = jnp.einsum("bqhd,bkhd->bhqk", qf, kff) * scale
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kff) * scale
             if diagonal:
-                s = jnp.where(causal_mask[None, None], s, _NEG_INF)
-            p = jnp.exp(s - lse[..., None])  # exact global probabilities
-            dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-            dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vff)
-            ds = p * (dp - delta[..., None]) * scale
-            dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, kff)
-            dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+                s = jnp.where(causal_mask[None, None, None], s, _NEG_INF)
+            p = jnp.exp(s - lse_g[..., None])  # exact global probabilities
+            dv_blk = jnp.einsum("bhgqk,bqhgd->bkhd", p, dog)
+            dp = jnp.einsum("bqhgd,bkhd->bhgqk", dog, vff)
+            ds = p * (dp - delta_g[..., None]) * scale
+            dq_blk = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kff).reshape(
+                batch, seq_local, heads, head_dim
+            )
+            dk_blk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg)
             return dq_blk, dk_blk, dv_blk
 
         def attend_full(q_in, kf, vf):
@@ -240,14 +262,15 @@ def _ring_attention_bwd_sharded(
             return _attend(q_in, kf, vf, diagonal=True)
 
     def skip(q_in, kf, vf):
-        z = jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32)
-        return z, z, z
+        zq = jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32)
+        zkv = jnp.zeros((batch, seq_local, heads_kv, head_dim), jnp.float32)
+        return zq, zkv, zkv
 
     init = (
         k,  # rotates in input dtype, like the forward
         v,
-        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # dk
-        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # dv
+        jnp.zeros((batch, seq_local, heads_kv, head_dim), jnp.float32),  # dk
+        jnp.zeros((batch, seq_local, heads_kv, head_dim), jnp.float32),  # dv
         jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # dq
     )
 
@@ -320,16 +343,25 @@ def ring_attention(
     block probabilities from the saved global logsumexp).
 
     q, k, v: global ``[batch, seq, heads, head_dim]`` arrays; the seq
-    dim is sharded over the axis. Returns attention output with the
-    same global shape/sharding. ``use_flash`` runs each ring step's
-    block compute (forward AND backward) through the fused Pallas
-    kernels. ``in_spec`` overrides the shard_map partitioning for
-    composed meshes — e.g. ``P("data", "sp", "model", None)`` to run
-    the ring inside a dp×tp×sp train step (batch and heads are
-    embarrassingly parallel for the ring; only position 1, the sequence
-    dim, must carry ``axis``).
+    dim is sharded over the axis. K/V may carry FEWER heads (GQA — any
+    divisor of q's heads, down to 1 for MQA): the narrow K/V blocks are
+    what rotates, so grouped heads shrink ICI traffic by the group
+    factor, and dK/dV come back group-summed in K/V's own shape.
+    Returns attention output with q's global shape/sharding.
+    ``use_flash`` runs each ring step's block compute (forward AND
+    backward) through the fused Pallas kernels. ``in_spec`` overrides
+    the shard_map partitioning for composed meshes — e.g.
+    ``P("data", "sp", "model", None)`` to run the ring inside a
+    dp×tp×sp train step (batch and heads are embarrassingly parallel
+    for the ring; only position 1, the sequence dim, must carry
+    ``axis``).
     """
     n = mesh.shape[axis]
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"GQA needs n_heads ({q.shape[2]}) divisible by n_kv_heads "
+            f"({k.shape[2]})"
+        )
     spec = in_spec if in_spec is not None else P(None, axis, None, None)
     if len(spec) > 1 and spec[1] != axis:
         raise ValueError(

@@ -547,6 +547,12 @@ def test_probe_runs_on_cpu():
     assert result.details["max_error"] < 1e-2
     # off-TPU: timing falls back to the XLA expression
     assert result.details["kernel"] == "xla"
+    # the generalized kernel paths (GQA, packed segments, cross-length)
+    # are part of every probe run, so a real-TPU battery validates
+    # their Mosaic compilation — not just interpret mode
+    gen = result.details["generalized_max_errors"]
+    assert set(gen) == {"gqa", "packed", "cross"}
+    assert all(isinstance(e, float) and e < 1e-2 for e in gen.values())
 
 
 def test_probe_contract_line_parses():
