@@ -311,10 +311,14 @@ def forward_context_parallel(
 
 
 def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:
-    """KV cache for autoregressive decoding: one [B, S, Hkv, Dh] pair
-    per layer, float-typed in the compute dtype. GQA caches only the
-    kv_heads — the memory win that motivates grouped heads in serving."""
-    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.head_dim)
+    """KV cache for autoregressive decoding: one [B, Hkv, S, Dh] pair
+    per layer (heads-major — the fused decode kernel's tiling wants
+    contiguous [S, Dh] planes per head), float-typed in the compute
+    dtype. GQA caches only the kv_heads — the memory win that motivates
+    grouped heads in serving. Capacity rounds up to a multiple of 8
+    (Mosaic's tiling unit); position masking makes the slack inert."""
+    cap = -(-max_seq // 8) * 8
+    shape = (cfg.n_layers, batch, cfg.kv_heads, cap, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -322,20 +326,25 @@ def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:
 
 
 def decode_step(
-    params: Dict, cache: Dict, token: jax.Array, pos: jax.Array, cfg: ProbeModelConfig
+    params: Dict, cache: Dict, token: jax.Array, pos: jax.Array,
+    cfg: ProbeModelConfig, use_flash: bool = False,
 ):
     """One autoregressive decode step (the serving hot loop).
 
     token: [B] int32, pos: scalar int32 position. Returns (logits [B,V],
     updated cache). Static shapes throughout: the cache is full-length
     and masked by position, so the step jits once and reruns for every
-    token (lax-friendly, no dynamic shapes).
-    """
+    token (lax-friendly, no dynamic shapes). ``use_flash`` routes the
+    cache attention through the fused decode kernel
+    (ops/flash_attention.flash_decode): one blockwise HBM pass with the
+    online-softmax state in VMEM, dead cache capacity skipped."""
     dt = cfg.dtype
     x = params["embed"].astype(dt)[token]  # [B, D]
-    max_seq = cache["k"].shape[2]
-    visible = jnp.arange(max_seq) <= pos  # [S]
+    cap = cache["k"].shape[3]
+    visible = jnp.arange(cap) <= pos  # [S]
     group = cfg.n_heads // cfg.kv_heads
+    if use_flash:
+        from activemonitor_tpu.ops.flash_attention import flash_decode
     for li, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1"]["scale"])
         if "wqkv" in layer:
@@ -345,20 +354,25 @@ def decode_step(
             q = jnp.einsum("bd,dhk->bhk", h, layer["wq"].astype(dt))
             kv = jnp.einsum("bd,dthk->tbhk", h, layer["wkv"].astype(dt))
             k_new, v_new = kv[0], kv[1]  # [B, Hkv, K]
-        cache["k"] = cache["k"].at[li, :, pos].set(k_new)
-        cache["v"] = cache["v"].at[li, :, pos].set(v_new)
-        keys = cache["k"][li]  # [B, S, Hkv, K]
+        cache["k"] = cache["k"].at[li, :, :, pos].set(k_new)
+        cache["v"] = cache["v"].at[li, :, :, pos].set(v_new)
+        keys = cache["k"][li]  # [B, Hkv, S, K]
         values = cache["v"][li]
-        # grouped view: [B, H, K] -> [B, Hkv, G, K]; each group of query
-        # heads attends its shared kv head straight out of the cache
-        qg = q.reshape(q.shape[0], cfg.kv_heads, group, cfg.head_dim)
-        scores = jnp.einsum("bhgk,bshk->bhgs", qg, keys) / jnp.sqrt(
-            jnp.asarray(cfg.head_dim, dt)
-        )
-        scores = jnp.where(visible[None, None, None, :], scores, jnp.asarray(-1e9, dt))
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
-        attn = jnp.einsum("bhgs,bshk->bhgk", probs, values)
-        attn = attn.reshape(q.shape[0], cfg.n_heads, cfg.head_dim)
+        if use_flash:
+            attn = flash_decode(q, keys, values, pos)  # [B, H, K]
+        else:
+            # grouped view: [B, H, K] -> [B, Hkv, G, K]; each group of
+            # query heads attends its shared kv head out of the cache
+            qg = q.reshape(q.shape[0], cfg.kv_heads, group, cfg.head_dim)
+            scores = jnp.einsum("bhgk,bhsk->bhgs", qg, keys) / jnp.sqrt(
+                jnp.asarray(cfg.head_dim, dt)
+            )
+            scores = jnp.where(
+                visible[None, None, None, :], scores, jnp.asarray(-1e9, dt)
+            )
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+            attn = jnp.einsum("bhgs,bhsk->bhgk", probs, values)
+            attn = attn.reshape(q.shape[0], cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"].astype(dt))
         h = _rmsnorm(x, layer["ln2"]["scale"])
         up = jax.nn.gelu(jnp.einsum("bd,df->bf", h, layer["w_up"].astype(dt)))

@@ -231,6 +231,12 @@ def flash_attention_partial(
 
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
+    if heads % k.shape[2]:
+        # Pallas clamps out-of-range block indices on TPU, so a bad
+        # group here would silently mis-associate heads, not crash
+        raise ValueError(
+            f"GQA needs n_heads ({heads}) divisible by n_kv_heads ({k.shape[2]})"
+        )
     group = heads // k.shape[2]  # GQA: Hkv divides H, same as the full kernel
     block_q = _fit_block(seq_q, block_q)
     block_k = _fit_block(seq_k, block_k)
@@ -900,6 +906,147 @@ def flash_attention(
     if seq_q_p != seq_q:
         out = out[:, :, :seq_q]
     return jnp.swapaxes(out, 1, 2) if layout == "bshd" else out
+
+
+def _make_decode_kernel(block_k: int, scale: float, group_p: int):
+    """Online-softmax decode step: one Q row group against the KV
+    cache, swept blockwise. Mirrors the forward kernel's recurrence
+    with the position mask driven by the prefetched scalar ``pos``."""
+    from jax.experimental import pallas as pl
+
+    def kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        j = pl.program_id(2)
+        pos = pos_ref[0]
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        @pl.when(j * block_k <= pos)
+        def _attend():
+            q = q_ref[0, 0].astype(jnp.float32)  # [Gp, D]
+            k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+            v = v_ref[0, 0].astype(jnp.float32)
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [Gp, block_k]
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (group_p, block_k), 1
+            )
+            mask = k_pos <= pos
+            s = jnp.where(mask, s, _NEG_INF)
+            m_prev = m_ref[:]
+            l_prev = l_ref[:]
+            m_curr = jnp.max(s, axis=1)[:, None]
+            m_next = jnp.maximum(m_prev, m_curr)
+            shift = jnp.maximum(m_next[:, :1], _NEG_INF / 2)
+            p = jnp.where(mask, jnp.exp(s - shift), 0.0)
+            alpha = jnp.exp(m_prev - jnp.maximum(m_next, _NEG_INF / 2))
+            l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
+            m_ref[:] = m_next
+            pv = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+
+        @pl.when(j == pos // block_k)
+        def _finalize():
+            o_ref[0, 0] = (
+                acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+            ).astype(o_ref.dtype)
+
+    return kernel
+
+
+def flash_decode(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    block_k: int = 512,
+) -> jax.Array:
+    """Fused single-token decode attention — the serving hot loop.
+
+    ``q``: ``[B, H, D]`` (this step's query); ``k_cache``/``v_cache``:
+    ``[B, Hkv, S, D]`` full-capacity caches (``S`` a multiple of 8,
+    ``Hkv`` dividing ``H`` — GQA reads each narrow K/V head once for
+    its whole query group); ``pos``: scalar int32 — keys ``0..pos``
+    are visible (the static-shape masked-cache recipe
+    models/probe_model.decode_step uses). Returns ``[B, H, D]``.
+
+    One blockwise HBM pass over the cache with the online-softmax state
+    in VMEM: no ``[B, H, S]`` score tensor is ever materialized, and
+    cache blocks past ``pos`` are skipped via the prefetched scalar —
+    dead capacity costs no bandwidth, which is the decode bottleneck.
+    Not differentiable (decoding is inference); train with
+    :func:`flash_attention`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, head_dim = q.shape
+    heads_kv, cap = k_cache.shape[1], k_cache.shape[2]
+    if heads % heads_kv:
+        raise ValueError(
+            f"GQA needs n_heads ({heads}) divisible by n_kv_heads ({heads_kv})"
+        )
+    if cap % 8:
+        raise ValueError(f"cache capacity {cap} must be a multiple of 8")
+    group = heads // heads_kv
+    # pad the query group to the 8-row sublane tile; padded rows compute
+    # garbage that is sliced away (bandwidth-bound: the cost is nil)
+    group_p = -(-group // 8) * 8
+    block_k = _fit_block(cap, block_k)
+    num_kb = cap // block_k
+    scale = 1.0 / (head_dim ** 0.5)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    qg = q.reshape(batch, heads_kv, group, head_dim)
+    if group_p != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, group_p - group), (0, 0)))
+
+    def kv_index(b, h, j, pos):
+        # THE point of the prefetched scalar: blocks past pos re-map to
+        # the last live block, so the pipeline issues no new DMA for
+        # dead cache capacity (their compute is already skipped by the
+        # kernel's pl.when) — decode reads only ~pos bytes per head,
+        # not the full rounded-up capacity
+        return (b, h, jnp.minimum(j, pos[0] // block_k), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, heads_kv, num_kb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group_p, head_dim), lambda b, h, j, pos: (b, h, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_index),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group_p, head_dim), lambda b, h, j, pos: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group_p, head_dim), jnp.float32),
+            pltpu.VMEM((group_p, _LANES), jnp.float32),
+            pltpu.VMEM((group_p, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        _make_decode_kernel(block_k, scale, group_p),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, heads_kv, group_p, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), qg, k_cache, v_cache)
+    return out[:, :, :group].reshape(batch, heads, head_dim)
 
 
 def attention_flops(batch: int, seq: int, heads: int, head_dim: int, causal: bool) -> float:

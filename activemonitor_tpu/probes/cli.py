@@ -181,6 +181,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prompt-len", type=int, default=16)
     p.add_argument("--decode-tokens", type=int, default=32)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--flash",
+        action="store_true",
+        help="time the loop through the fused decode kernel "
+        "(flash_decode: one blockwise HBM pass over the cache)",
+    )
 
     p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
     p.add_argument("--probe-gb", type=float, default=1.0)
@@ -369,6 +375,7 @@ def _dispatch(args) -> int:
             prompt_len=args.prompt_len,
             decode_tokens=args.decode_tokens,
             iters=args.iters,
+            use_flash=args.flash,
         )
     elif args.probe == "memory":
         from activemonitor_tpu.probes import memory

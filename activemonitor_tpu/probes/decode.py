@@ -39,7 +39,12 @@ def run(
     prompt_len: int = 16,
     decode_tokens: int = 32,
     iters: int = 5,
+    use_flash: bool = False,
 ) -> ProbeResult:
+    """``use_flash`` times the loop through the fused decode kernel
+    (ops/flash_attention.flash_decode). Either way a fused-vs-dense
+    logits agreement check runs, so a real-TPU battery validates the
+    kernel's Mosaic compilation."""
     cfg = tiny_config() if tiny else ProbeModelConfig()
     if prompt_len < 1 or decode_tokens < 1:
         raise ValueError("prompt_len and decode_tokens must be >= 1")
@@ -54,7 +59,9 @@ def run(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
     )
 
-    step = jax.jit(lambda p, c, t, pos: decode_step(p, c, t, pos, cfg))
+    step = jax.jit(
+        lambda p, c, t, pos: decode_step(p, c, t, pos, cfg, use_flash=use_flash)
+    )
 
     # correctness: decode greedily via the cache, then teacher-force the
     # batched forward on the SAME tokens and compare logits per position
@@ -105,6 +112,24 @@ def run(
     # token_agreement is informational: how often argmax agreed anyway.
     consistent = max_rel_diff <= 0.05
 
+    # fused-vs-dense agreement on one step from the live cache: both
+    # attention paths must produce the same logits — and running the
+    # fused kernel here means a real-TPU battery validates its Mosaic
+    # compilation even when the timed loop is dense
+    other = jax.jit(
+        lambda p, c, t, pos: decode_step(
+            p, c, t, pos, cfg, use_flash=not use_flash
+        )
+    )
+    check_pos = jnp.asarray(prompt_len + n_check)
+    logits_a, _ = step(params, cache, token, check_pos)
+    logits_b, _ = other(params, cache, token, check_pos)
+    flash_rel_diff = float(
+        jnp.max(jnp.abs(logits_a - logits_b))
+        / jnp.maximum(jnp.max(jnp.abs(logits_a)), 1e-6)
+    )
+    consistent = consistent and flash_rel_diff <= 0.05
+
     # throughput: a lax.scan of decode steps (token feeds the next step;
     # one traced step, so long chains compile as fast as short ones).
     # Single decode steps are microseconds on TPU — the k spread must be
@@ -118,7 +143,9 @@ def run(
                 pos = jnp.asarray(prompt_len, jnp.int32) + jnp.mod(
                     i, max_seq - prompt_len
                 )
-                logits, cache = decode_step(params, cache, token, pos, cfg)
+                logits, cache = decode_step(
+                    params, cache, token, pos, cfg, use_flash=use_flash
+                )
                 return (cache, jnp.argmax(logits, axis=-1)), logits[0, 0]
 
             (_, _), outs = jax.lax.scan(
@@ -171,8 +198,10 @@ def run(
             "batch": batch,
             "prompt_len": prompt_len,
             "max_seq": max_seq,
+            "attention": "flash" if use_flash else "dense",
             "seconds_per_token": seconds,
             "max_rel_logit_diff": max_rel_diff,
+            "flash_vs_dense_rel_diff": round(flash_rel_diff, 6),
             "token_agreement": token_agreement,
         },
     )
