@@ -1038,6 +1038,11 @@ def flash_decode(
             pltpu.VMEM((group_p, _LANES), jnp.float32),
         ],
     )
+    # pos is traced (unvalidatable at trace time); out of range it would
+    # gate the finalize write off every grid step and return an
+    # UNWRITTEN output buffer — clamp so overflow degrades to attending
+    # the full cache, matching the dense masked path
+    pos = jnp.minimum(jnp.asarray(pos, jnp.int32), cap - 1)
     out = pl.pallas_call(
         _make_decode_kernel(block_k, scale, group_p),
         grid_spec=grid_spec,
@@ -1045,7 +1050,7 @@ def flash_decode(
             (batch, heads_kv, group_p, head_dim), q.dtype
         ),
         interpret=interpret,
-    )(jnp.asarray(pos, jnp.int32).reshape(1), qg, k_cache, v_cache)
+    )(pos.reshape(1), qg, k_cache, v_cache)
     return out[:, :, :group].reshape(batch, heads, head_dim)
 
 

@@ -191,7 +191,8 @@ def run(
         summary=(
             f"decode {seconds * 1e3:.2f}ms/token, {tokens_per_second:,.0f} tok/s, "
             f"cache consistency {'OK' if consistent else 'MISMATCH'} "
-            f"(max rel logit diff {max_rel_diff:.1e})"
+            f"(teacher-forced rel diff {max_rel_diff:.1e}, "
+            f"fused-vs-dense {flash_rel_diff:.1e})"
         ),
         metrics=metrics,
         details={

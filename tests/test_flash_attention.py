@@ -528,6 +528,10 @@ def test_flash_decode_matches_masked_dense(n_kv_heads):
     fn = jax.jit(lambda p: flash_decode(q, kc, vc, p, block_k=64))
     got = fn(jnp.int32(77))
     assert float(jnp.max(jnp.abs(got - dense(77)))) < 1e-5
+    # out-of-range pos clamps to the full cache instead of returning an
+    # unwritten output buffer (pos is traced — unvalidatable)
+    got = fn(jnp.int32(S + 100))
+    assert float(jnp.max(jnp.abs(got - dense(S - 1)))) < 1e-5
 
 
 def test_flash_decode_validation():

@@ -55,3 +55,62 @@ def test_all_experts_used_somewhere(mesh):
     x = jax.random.normal(jax.random.key(3), (512, 32), jnp.float32)
     expert = jnp.argmax(x @ params["router"], axis=-1)
     assert len(jnp.unique(expert)) >= 6
+
+
+def test_expert_parallel_gradients_match_reference(mesh):
+    """Expert parallelism is a TRAINING capability: gradients flow
+    through the all_gather/psum_scatter dispatch collectives (their
+    autodiff transposes) and match the dense single-device oracle for
+    every parameter and the tokens."""
+    params = init_moe_params(jax.random.key(0), d_model=32, d_ff=64, n_experts=16)
+    x = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+
+    def loss(fn):
+        return lambda p, x: jnp.sum(fn(p, x).astype(jnp.float32) ** 2)
+
+    g_ep = jax.jit(
+        jax.grad(
+            loss(lambda p, x: moe_ffn_expert_parallel(p, x, mesh, "ep")),
+            argnums=(0, 1),
+        )
+    )(params, x)
+    g_ref = jax.jit(
+        jax.grad(loss(moe_ffn_reference), argnums=(0, 1))
+    )(params, x)
+    errs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ep[0], g_ref[0]
+    )
+    assert max(errs.values()) < 1e-4, errs
+    assert float(jnp.max(jnp.abs(g_ep[1] - g_ref[1]))) < 1e-4
+
+
+def test_expert_parallel_sgd_reduces_loss(mesh):
+    """A few SGD steps through the sharded MoE drive a regression loss
+    down — the end-to-end trainability check, not just one gradient."""
+    import optax
+
+    params = init_moe_params(jax.random.key(4), d_model=16, d_ff=32, n_experts=8)
+    x = jax.random.normal(jax.random.key(5), (64, 16), jnp.float32)
+    target = jax.random.normal(jax.random.key(6), (64, 16), jnp.float32)
+    opt = optax.sgd(1e-1)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        def loss(p):
+            out = moe_ffn_expert_parallel(p, x, mesh, "ep")
+            return jnp.mean((out - target) ** 2)
+
+        value, grads = jax.value_and_grad(loss)(params)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, value
+
+    losses = []
+    for _ in range(8):
+        params, state, value = step(params, state)
+        losses.append(float(value))
+    # fitting noise with one top-1 MoE layer moves slowly; the gate is
+    # a meaningful overall decrease, not per-step monotonicity (SGD
+    # crossing an argmax routing boundary can raise a single step, and
+    # platform numerics can flip near-tie routings)
+    assert losses[-1] < losses[0] - 1e-2, losses
