@@ -325,6 +325,53 @@ def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:
     }
 
 
+def prefill(
+    params: Dict,
+    cache: Dict,
+    tokens: jax.Array,
+    cfg: ProbeModelConfig,
+    use_flash: bool = False,
+):
+    """Batched prompt ingestion — the serving cold half.
+
+    Runs the causal forward over ``tokens`` [B, S] ONCE (big MXU-shaped
+    matmuls; ``use_flash`` routes attention through the fused kernel)
+    while writing every position's K/V into the cache, so decoding can
+    start at position S. Returns (last-token logits [B, V], cache) —
+    equivalent to S ``decode_step`` calls but without S tiny dispatches.
+    """
+    dt = cfg.dtype
+    seq = tokens.shape[1]
+    x = params["embed"].astype(dt)[tokens]  # [B, S, D]
+    if use_flash:
+        from activemonitor_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    else:
+        attention_fn = partial(dense_causal_attention, cfg=cfg)
+    for li, layer in enumerate(params["layers"]):
+        # reuse apply_block (the single decoder-block definition — the
+        # paths must not drift); the wrapper captures this layer's K/V
+        # projections at trace time for cache banking
+        banked: Dict = {}
+
+        def capturing(q, k, v, _banked=banked):
+            _banked["k"], _banked["v"] = k, v
+            return attention_fn(q, k, v)
+
+        x = apply_block(x, layer, cfg, capturing)
+        # bank K/V heads-major ([B, Hkv, S, K]) for the decode kernel
+        cache["k"] = cache["k"].at[li, :, :, :seq].set(
+            jnp.swapaxes(banked["k"], 1, 2)
+        )
+        cache["v"] = cache["v"].at[li, :, :, :seq].set(
+            jnp.swapaxes(banked["v"], 1, 2)
+        )
+    x = _rmsnorm(x[:, -1], params["final_ln"]["scale"])  # last position only
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(dt))
+    return logits.astype(jnp.float32), cache
+
+
 def decode_step(
     params: Dict, cache: Dict, token: jax.Array, pos: jax.Array,
     cfg: ProbeModelConfig, use_flash: bool = False,

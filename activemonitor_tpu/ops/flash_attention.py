@@ -1038,11 +1038,11 @@ def flash_decode(
             pltpu.VMEM((group_p, _LANES), jnp.float32),
         ],
     )
-    # pos is traced (unvalidatable at trace time); out of range it would
-    # gate the finalize write off every grid step and return an
-    # UNWRITTEN output buffer — clamp so overflow degrades to attending
-    # the full cache, matching the dense masked path
-    pos = jnp.minimum(jnp.asarray(pos, jnp.int32), cap - 1)
+    # pos is traced (unvalidatable at trace time); out of range in
+    # EITHER direction it would gate the finalize write off every grid
+    # step and return an UNWRITTEN output buffer — clamp so overflow
+    # attends the full cache and negative pos attends position 0
+    pos = jnp.clip(jnp.asarray(pos, jnp.int32), 0, cap - 1)
     out = pl.pallas_call(
         _make_decode_kernel(block_k, scale, group_p),
         grid_spec=grid_spec,

@@ -27,6 +27,7 @@ from activemonitor_tpu.models.probe_model import (
     forward,
     init_kv_cache,
     init_params,
+    prefill,
     tiny_config,
 )
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
@@ -66,9 +67,11 @@ def run(
     # correctness: decode greedily via the cache, then teacher-force the
     # batched forward on the SAME tokens and compare logits per position
     cache = init_kv_cache(cfg, batch, max_seq)
-    # prefill token-by-token (simple and exercises the cache path)
-    for i in range(prompt_len):
-        logits, cache = step(params, cache, prompt[:, i], jnp.asarray(i))
+    # batched prefill (the serving cold half: one MXU-shaped pass banks
+    # the whole prompt's K/V; prefill==stepping is pinned by unit tests)
+    logits, cache = jax.jit(
+        lambda p, c, t: prefill(p, c, t, cfg, use_flash=use_flash)
+    )(params, cache, prompt)
     # the cache has room for max_seq - prompt_len generated positions
     n_check = min(4, max_seq - prompt_len - 1)
     cached_tokens = []

@@ -576,6 +576,44 @@ def test_decode_step_flash_matches_dense():
         assert float(jnp.max(jnp.abs(la - lb))) < 1e-4
 
 
+@pytest.mark.parametrize("n_kv_heads,use_flash", [(4, False), (2, False), (2, True)])
+def test_prefill_matches_stepped_decode(n_kv_heads, use_flash):
+    """Batched prefill must be indistinguishable from feeding the
+    prompt token-by-token through decode_step — same last-token logits,
+    same banked K/V, and a fused decode continues correctly from it."""
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        decode_step,
+        init_kv_cache,
+        init_params,
+        prefill,
+    )
+
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=n_kv_heads,
+        n_layers=2, d_ff=64, max_seq_len=16, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab_size)
+    cache_a = init_kv_cache(cfg, 2, 8)
+    for pos in range(tokens.shape[1]):
+        la, cache_a = decode_step(
+            params, cache_a, tokens[:, pos], jnp.int32(pos), cfg
+        )
+    cache_b = init_kv_cache(cfg, 2, 8)
+    lb, cache_b = prefill(params, cache_b, tokens, cfg, use_flash=use_flash)
+    assert float(jnp.max(jnp.abs(la - lb))) < 1e-5
+    assert (
+        float(jnp.max(jnp.abs(cache_a["k"][..., :6, :] - cache_b["k"][..., :6, :])))
+        < 1e-5
+    )
+    next_a, _ = decode_step(params, cache_a, tokens[:, 0], jnp.int32(6), cfg)
+    next_b, _ = decode_step(
+        params, cache_b, tokens[:, 0], jnp.int32(6), cfg, use_flash=True
+    )
+    assert float(jnp.max(jnp.abs(next_a - next_b))) < 1e-4
+
+
 def test_gqa_decode_matches_forward():
     """Decode-cache GQA attention must agree with the batched forward
     on the same prefix (the decode path reshapes query groups against
