@@ -158,10 +158,30 @@ def _secondary_metrics() -> dict:
             by_name["train-tokens-per-second"]
         )
 
+    def decode():
+        from activemonitor_tpu.probes import decode as decode_probe
+
+        result = decode_probe.run(
+            batch=8, prompt_len=64, decode_tokens=128, iters=3, use_flash=True
+        )
+        by_name = {m.name: m.value for m in result.metrics}
+        secondary["decode_fused_vs_dense_rel_diff"] = result.details[
+            "flash_vs_dense_rel_diff"
+        ]
+        if not result.ok:
+            # a throughput number must not outlive a failed correctness
+            # gate — record the failure, not a clean-looking tokens/s
+            secondary["decode_fused_error"] = result.summary[:200]
+            return
+        secondary["decode_fused_tokens_per_second"] = round(
+            by_name["decode-tokens-per-second"]
+        )
+
     guarded("flash_attention", flash)
     guarded("hbm_stream", hbm)
     guarded("mxu_int8", int8)
     guarded("training_step", train)
+    guarded("decode_fused", decode)
     return secondary
 
 
