@@ -259,13 +259,23 @@ def dense_causal_attention(q, k, v, cfg: ProbeModelConfig):
 
 
 def _forward_with_attention(
-    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn,
+    remat: bool = False,
 ) -> jax.Array:
-    """Shared decoder body around :func:`apply_block`."""
+    """Shared decoder body around :func:`apply_block`. ``remat``
+    rematerializes each block's activations in the backward pass
+    (``jax.checkpoint``) — the standard FLOPs-for-HBM trade that lets
+    sequence length or depth grow past what saved activations allow."""
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]  # [B, S, D]
+
+    def block(x, layer):
+        return apply_block(x, layer, cfg, attention_fn)
+
+    if remat:
+        block = jax.checkpoint(block)
     for layer in params["layers"]:
-        x = apply_block(x, layer, cfg, attention_fn)
+        x = block(x, layer)
     x = _rmsnorm(x, params["final_ln"]["scale"])
     return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt)).astype(jnp.float32)
 
@@ -279,13 +289,17 @@ def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array
 
 
 def loss_fn(
-    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn=None
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn=None,
+    remat: bool = False,
 ) -> jax.Array:
     """Next-token cross-entropy (the training-step probe's objective).
     ``attention_fn`` overrides the attention mechanism (e.g.
     :func:`flash_attention_fn` for the fused-kernel training path);
-    None means dense causal (apply_block's default)."""
-    logits = _forward_with_attention(params, tokens[:, :-1], cfg, attention_fn)
+    None means dense causal (apply_block's default). ``remat``
+    rematerializes block activations in the backward."""
+    logits = _forward_with_attention(
+        params, tokens[:, :-1], cfg, attention_fn, remat=remat
+    )
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)

@@ -93,6 +93,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="fail the probe below this MFU (BASELINE.md single-chip "
         "bar; the battery applies rated.TRAIN_MFU_BAR)",
     )
+    p.add_argument(
+        "--zero1",
+        action="store_true",
+        help="ZeRO-1: shard AdamW mu/nu over the data axis too",
+    )
+    p.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialize block activations in the backward",
+    )
+    p.add_argument(
+        "--accum-steps",
+        type=int,
+        default=1,
+        help="gradient accumulation microbatches per step",
+    )
 
     p = sub.add_parser("hbm", help="HBM bandwidth check")
     p.add_argument("--size-mb", type=float, default=256.0)
@@ -311,6 +327,9 @@ def _dispatch(args) -> int:
             steps=args.steps,
             attention=args.attention,
             mfu_threshold=args.mfu_threshold,
+            zero1=args.zero1,
+            remat=args.remat,
+            accum_steps=args.accum_steps,
         )
     elif args.probe == "hbm":
         from activemonitor_tpu.probes import hbm
