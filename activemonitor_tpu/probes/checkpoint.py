@@ -100,12 +100,9 @@ def run(
         checkpointer.wait_until_finished()
         save_seconds = time.perf_counter() - t0
 
-        targets = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
-            if hasattr(a, "sharding")
-            else a,
-            state,
-        )
+        from activemonitor_tpu.probes.training_step import restore_targets
+
+        targets = restore_targets(state)
         t0 = time.perf_counter()
         restored = checkpointer.restore(path, targets)
         jax.block_until_ready(restored)

@@ -73,25 +73,12 @@ def build_sharded_train_step(
       passes and applies ONE averaged update.
     """
     optimizer = optax.adamw(learning_rate)
-    specs = param_specs(cfg)
-    param_sh = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
     data_sh = NamedSharding(mesh, P("data", None))
-    replicated = NamedSharding(mesh, P())
 
-    params = jax.device_put(init_params(jax.random.key(0), cfg), param_sh)
+    params = init_params(jax.random.key(0), cfg)
+    param_sh, state_sh, replicated = _state_shardings(cfg, mesh, zero1, params)
+    params = jax.device_put(params, param_sh)
     opt_state = optimizer.init(params)
-    state_sh = param_sh
-    if zero1:
-        state_sh = jax.tree.map(
-            lambda leaf, spec: _zero1_sharding(leaf, spec, mesh),
-            params,
-            specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
     opt_sh = _opt_shardings(opt_state, param_sh, replicated, state_sh=state_sh)
     # place the freshly-initialized state onto its shardings (under
     # zero1 mu/nu leave the param layout for the dp-extended one)
@@ -153,6 +140,29 @@ def build_sharded_train_step(
         donate_argnums=(0, 1),
     )
     return step_fn, params, opt_state, data_sh
+
+
+def _state_shardings(cfg: ProbeModelConfig, mesh: Mesh, zero1: bool, params_like):
+    """(param_sh, state_sh, replicated) sharding trees for a training
+    state on ``mesh``. ``params_like`` may be concrete arrays or
+    ShapeDtypeStructs — only shapes are read (the ZeRO-1 divisibility
+    rule), so the abstract template path allocates nothing."""
+    specs = param_specs(cfg)
+    param_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    replicated = NamedSharding(mesh, P())
+    state_sh = param_sh
+    if zero1:
+        state_sh = jax.tree.map(
+            lambda leaf, spec: _zero1_sharding(leaf, spec, mesh),
+            params_like,
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    return param_sh, state_sh, replicated
 
 
 def _zero1_sharding(leaf, spec: P, mesh: Mesh) -> NamedSharding:
@@ -263,6 +273,95 @@ def build_composed_train_step(
         donate_argnums=(0, 1),
     )
     return step_fn, params, opt_state, data_sh
+
+
+def restore_targets(tree):
+    """Map a (concrete OR abstract) pytree to orbax restore targets:
+    ShapeDtypeStructs carrying each leaf's sharding. Shared by
+    :func:`restore_train_state` and the checkpoint probe so
+    restore-target construction cannot drift."""
+
+    def target(leaf):
+        if hasattr(leaf, "sharding") and leaf.sharding is not None:
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+        return leaf
+
+    return jax.tree.map(target, tree)
+
+
+def train_state_templates(
+    cfg: ProbeModelConfig,
+    mesh: Mesh,
+    learning_rate: float = 1e-3,
+    zero1: bool = False,
+):
+    """ABSTRACT (params, opt_state) templates — ShapeDtypeStructs
+    carrying the exact shardings :func:`build_sharded_train_step` would
+    produce, built via ``jax.eval_shape`` so NOTHING is materialized.
+    This is what resume should pass to :func:`restore_train_state`: a
+    zero1/remat job that is HBM-tight in steady state must not allocate
+    a throwaway random init (plus optimizer state) just to describe the
+    restore layout."""
+    optimizer = optax.adamw(learning_rate)
+    abstract_params = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+    param_sh, state_sh, replicated = _state_shardings(
+        cfg, mesh, zero1, abstract_params
+    )
+    opt_sh = _opt_shardings(abstract_opt, param_sh, replicated, state_sh=state_sh)
+
+    def attach(sds, sharding):
+        return jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sharding)
+
+    return (
+        jax.tree.map(attach, abstract_params, param_sh),
+        jax.tree.map(attach, abstract_opt, opt_sh),
+    )
+
+
+def save_train_state(directory: str, params, opt_state, step: int,
+                     keep: int = 2) -> None:
+    """Persist the sharded training state (params + optimizer state)
+    under a STEP-NUMBERED checkpoint: orbax's CheckpointManager keeps
+    the previous checkpoint until the new one commits, so a preemption
+    mid-save (the whole gather + serialize window) still leaves a valid
+    state to resume from — durable means crash-durable, not
+    happy-path-durable. ``keep`` bounds retained checkpoints."""
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(
+        directory, options=ocp.CheckpointManagerOptions(max_to_keep=keep)
+    ) as manager:
+        manager.save(
+            step, args=ocp.args.StandardSave({"params": params, "opt": opt_state})
+        )
+        manager.wait_until_finished()
+
+
+def restore_train_state(directory: str, params_like, opt_state_like,
+                        step: int | None = None):
+    """Restore (params, opt_state, step) onto the layouts of the given
+    templates — :func:`train_state_templates` abstractions (preferred:
+    nothing gets materialized twice) or concrete trees from
+    :func:`build_sharded_train_step`. Because the targets carry their
+    own NamedShardings, orbax reshards on load: a checkpoint written
+    from a dp=2×tp=4 run (with or without ZeRO-1 optimizer layouts)
+    restores cleanly onto dp=4×tp=2, ZeRO-1 on or off — values
+    identical, layout the new mesh's. Elastic resume is a restore-time
+    property, not a save-time decision. ``step`` None restores the
+    latest committed checkpoint."""
+    import orbax.checkpoint as ocp
+
+    targets = restore_targets({"params": params_like, "opt": opt_state_like})
+    with ocp.CheckpointManager(directory) as manager:
+        if step is None:
+            step = manager.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint under {directory!r}"
+                )
+        restored = manager.restore(step, args=ocp.args.StandardRestore(targets))
+    return restored["params"], restored["opt"], step
 
 
 def _opt_shardings(opt_state, param_sh, replicated, state_sh=None):
