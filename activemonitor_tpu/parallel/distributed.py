@@ -66,3 +66,32 @@ def maybe_initialize_distributed(
         len(jax.devices()),
     )
     return True
+
+
+def distribute(array, sharding):
+    """Place a host-resident (or local-device) array onto a sharding
+    that may span PROCESSES.
+
+    Single-process: plain ``device_put``. Multi-process: every process
+    passes the same GLOBAL logical array (deterministic construction —
+    same seed on every host) and contributes only its addressable
+    shards via ``make_array_from_callback`` — the multi-host answer to
+    "how does a global batch/parameter land on a DCN-spanning mesh"
+    without any host ever holding another host's shard on device.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    host = np.asarray(array)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def distribute_tree(tree, sharding_tree):
+    """:func:`distribute` over a pytree of arrays + matching shardings."""
+    import jax
+
+    return jax.tree.map(distribute, tree, sharding_tree)

@@ -50,8 +50,15 @@ def build_sharded_train_step(
     zero1: bool = False,
     remat: bool = False,
     accum_steps: int = 1,
+    init_state: bool = True,
 ):
     """Returns (step_fn, params, opt_state, data_sharding).
+
+    ``init_state=False`` returns ``(step_fn, None, None, data_sh)``
+    without allocating anything — shardings come from abstract shapes.
+    The resume path pairs it with :func:`train_state_templates` +
+    :func:`restore_train_state`, so an HBM-tight job never materializes
+    a throwaway random init on restart.
 
     step_fn(params, opt_state, tokens) -> (params, opt_state, loss) is
     jitted with explicit in/out shardings; XLA inserts all collectives.
@@ -72,17 +79,28 @@ def build_sharded_train_step(
       consumes the same global batch in accum_steps forward/backward
       passes and applies ONE averaged update.
     """
+    from activemonitor_tpu.parallel.distributed import distribute_tree
+
     optimizer = optax.adamw(learning_rate)
     data_sh = NamedSharding(mesh, P("data", None))
 
-    params = init_params(jax.random.key(0), cfg)
-    param_sh, state_sh, replicated = _state_shardings(cfg, mesh, zero1, params)
-    params = jax.device_put(params, param_sh)
-    opt_state = optimizer.init(params)
-    opt_sh = _opt_shardings(opt_state, param_sh, replicated, state_sh=state_sh)
-    # place the freshly-initialized state onto its shardings (under
-    # zero1 mu/nu leave the param layout for the dp-extended one)
-    opt_state = jax.device_put(opt_state, opt_sh)
+    # shardings derive from ABSTRACT shapes — nothing allocated yet
+    abstract_params = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    param_sh, state_sh, replicated = _state_shardings(
+        cfg, mesh, zero1, abstract_params
+    )
+    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+    opt_sh = _opt_shardings(abstract_opt, param_sh, replicated, state_sh=state_sh)
+    if init_state:
+        # every process computes the same init (same key), then
+        # contributes its shards — single-chip and DCN-spanning meshes
+        # alike; the optimizer state is born ON its shardings (zero1:
+        # the dp-extended layout) — eager init would choke on
+        # multi-process global params anyway
+        params = distribute_tree(init_params(jax.random.key(0), cfg), param_sh)
+        opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)(params)
+    else:
+        params = opt_state = None
 
     if attention == "flash":
         from activemonitor_tpu.models.probe_model import flash_attention_fn
@@ -422,11 +440,13 @@ def run(
     n_data = mesh.shape["data"]
     batch = batch_per_device * n_data
 
+    from activemonitor_tpu.parallel.distributed import distribute
+
     step_fn, params, opt_state, data_sh = build_sharded_train_step(
         cfg, mesh, attention=attention, zero1=zero1, remat=remat,
         accum_steps=accum_steps,
     )
-    tokens = jax.device_put(
+    tokens = distribute(
         jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size),
         data_sh,
     )
@@ -453,8 +473,13 @@ def run(
     t_big, last_loss = timed_chain(k_big)
     # lengthen the chain when the delta is inside the noise floor
     # (tiny models on fast hardware) — same policy as chain_delta_seconds;
-    # the longer chain's timing becomes the next baseline (no re-run)
-    for _ in range(CHAIN_RETRIES):
+    # the longer chain's timing becomes the next baseline (no re-run).
+    # MULTI-PROCESS: the retry decision is wall-clock local, and a step
+    # contains collectives — processes disagreeing on how many steps to
+    # run would deadlock the mesh, so the adaptive loop only runs when
+    # this process owns every device
+    adaptive = jax.process_count() == 1
+    for _ in range(CHAIN_RETRIES if adaptive else 0):
         if not needs_longer_chain(t_small, t_big):
             break
         k_small, t_small = k_big, t_big

@@ -3,6 +3,7 @@ localhost Gloo, the CI stand-in for a multi-host TPU slice."""
 
 import json
 import os
+import socket
 import subprocess
 import sys
 
@@ -17,47 +18,95 @@ def test_single_process_degrades_gracefully():
     assert result.metrics[0].name == "dcn-hosts"
 
 
-def test_two_process_dcn_allreduce():
-    """Spawn two real worker processes; both run the dcn-allreduce probe
-    CLI against a localhost coordinator and must agree + succeed."""
+def _run_two_workers(make_argv, timeout: float):
+    """Spawn two worker processes against a fresh localhost coordinator
+    and reap them. ``make_argv(rank, port)`` returns each worker's
+    argv. Survivors are ALWAYS killed — a worker wedged in a collective
+    (the exact failure these tests guard) must not outlive the test and
+    leak into the rest of the CI run."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # 1 local device per process keeps it fast
     # pick a free port so concurrent/parallel test runs don't collide
-    import socket
-
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    workers = []
-    for rank in range(2):
-        workers.append(
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    "-c",
-                    # config API beats the env-registered tunnel plugin
-                    "import jax; jax.config.update('jax_platforms', 'cpu');"
-                    "from activemonitor_tpu.probes.cli import main; import sys;"
-                    "sys.exit(main(["
-                    f"'--coordinator', '127.0.0.1:{port}',"
-                    f"'--num-processes', '2', '--process-id', '{rank}',"
-                    "'dcn-allreduce', '--size-mb', '1', '--iters', '2']))",
-                ],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            )
+    workers = [
+        subprocess.Popen(
+            make_argv(rank, port),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
+        for rank in range(2)
+    ]
     outputs = []
-    for proc in workers:
-        out, _ = proc.communicate(timeout=150)
-        outputs.append(out.decode())
-        assert proc.returncode == 0, out.decode()[-1500:]
-    for out in outputs:
+    try:
+        for proc in workers:
+            out, _ = proc.communicate(timeout=timeout)
+            outputs.append(out.decode())
+            assert proc.returncode == 0, out.decode()[-1500:]
+    finally:
+        for proc in workers:
+            if proc.poll() is None:
+                proc.kill()
+    return outputs
+
+
+def test_two_process_dcn_allreduce():
+    """Spawn two real worker processes; both run the dcn-allreduce probe
+    CLI against a localhost coordinator and must agree + succeed."""
+
+    def argv(rank, port):
+        return [
+            sys.executable,
+            "-c",
+            # config API beats the env-registered tunnel plugin
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from activemonitor_tpu.probes.cli import main; import sys;"
+            "sys.exit(main(["
+            f"'--coordinator', '127.0.0.1:{port}',"
+            f"'--num-processes', '2', '--process-id', '{rank}',"
+            "'dcn-allreduce', '--size-mb', '1', '--iters', '2']))",
+        ]
+
+    for out in _run_two_workers(argv, timeout=150):
         contract = json.loads(out.strip().splitlines()[-1])
         by_name = {m["name"]: m["value"] for m in contract["metrics"]}
         assert by_name["dcn-hosts"] == 2
         assert by_name["dcn-allreduce-correct"] == 1.0
         assert by_name["dcn-allreduce-busbw-gbps"] > 0
+
+
+def test_two_process_train_step_over_dcn():
+    """The flagship train step spans HOSTS: two real processes form one
+    dp=2 mesh over the distributed runtime (gradient psums ride DCN),
+    each contributes its own batch shard, and both must agree on the
+    (replicated) loss — the multi-host story the reference's NCCL/MPI
+    backend plays, as an executable test."""
+
+    def argv(rank, port):
+        driver = (
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "import jax.numpy as jnp;"
+            f"jax.distributed.initialize('127.0.0.1:{port}', 2, {rank});"
+            "from activemonitor_tpu.parallel.mesh import make_2d_mesh;"
+            "from activemonitor_tpu.probes import training_step;"
+            "mesh = make_2d_mesh(shape=(2, 1));"  # pure dp across the hosts
+            "r = training_step.run(tiny=True, batch_per_device=2, seq=16,"
+            "                      steps=1, mesh=mesh);"
+            "assert r.ok, r.summary;"
+            "print('LOSS', round(r.details['loss_last'], 6));"
+            "print('MESH', r.details['mesh'])"
+        )
+        return [sys.executable, "-c", driver]
+
+    outputs = _run_two_workers(argv, timeout=300)
+    losses = []
+    for out in outputs:
+        (loss_line,) = [l for l in out.splitlines() if l.startswith("LOSS ")]
+        losses.append(loss_line)
+        assert "{'data': 2, 'model': 1}" in out
+    # the loss is replicated over the mesh: both hosts see the same value
+    assert losses[0] == losses[1], outputs

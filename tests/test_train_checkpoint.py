@@ -47,9 +47,12 @@ def test_resume_same_mesh_is_bitwise(tmp_path):
         ref_losses.append(float(loss))
 
     # resume from disk on the same mesh: bitwise identical continuation.
-    # Templates are ABSTRACT (train_state_templates) — resume must not
-    # materialize a throwaway init just to describe the layout
-    step2, _, _, _ = build_sharded_train_step(cfg, mesh)
+    # Templates are ABSTRACT and the builder allocates NOTHING
+    # (init_state=False) — resume never materializes a throwaway init
+    step2, no_params, no_opt, _ = build_sharded_train_step(
+        cfg, mesh, init_state=False
+    )
+    assert no_params is None and no_opt is None
     p_like, o_like = train_state_templates(cfg, mesh)
     r_params, r_opt, at_step = restore_train_state(
         str(tmp_path / "ckpt"), p_like, o_like
@@ -86,7 +89,9 @@ def test_resume_reshards_onto_different_mesh_and_zero1(tmp_path):
         ref_losses.append(float(loss))
 
     mesh_b = make_2d_mesh(shape=(4, 2))
-    step_b, _, _, data_sh_b = build_sharded_train_step(cfg, mesh_b, zero1=True)
+    step_b, _, _, data_sh_b = build_sharded_train_step(
+        cfg, mesh_b, zero1=True, init_state=False
+    )
     p_like, o_like = train_state_templates(cfg, mesh_b, zero1=True)
     r_params, r_opt, _ = restore_train_state(
         str(tmp_path / "ckpt"), p_like, o_like
