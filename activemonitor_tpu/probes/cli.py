@@ -254,6 +254,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # a "CPU" battery run must not silently land on (and wedge against)
+    # a site-plugin-registered remote device — shared rule, see
+    # utils/platform.py
+    from activemonitor_tpu.utils.platform import force_cpu_if_requested
+
+    force_cpu_if_requested()
     args = build_parser().parse_args(argv)
     from activemonitor_tpu.parallel.distributed import maybe_initialize_distributed
 

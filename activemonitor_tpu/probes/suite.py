@@ -117,19 +117,26 @@ def run(
     )
     from activemonitor_tpu.probes import flash
 
+    import jax as _jax
+
     from activemonitor_tpu.probes.rated import FLASH_FRACTION_BAR, TRAIN_MFU_BAR
 
     # seq=None: the per-platform default (4096 on TPU, the interpret-
-    # mode 512 cap elsewhere — an explicit seq would now be honored
-    # verbatim and stall a CPU suite run for hours); quick mode still
-    # pins a short explicit length, safe on every platform.
+    # mode 512 cap elsewhere — an explicit seq is honored verbatim and
+    # would stall a CPU suite run); quick mode pins the short
+    # per-platform length the battery always used (1024 on TPU, 512 in
+    # interpret mode). The device lookup stays INSIDE the lambda so a
+    # backend-init failure is a failing probe, not an aborted battery.
     # The full battery enforces the BASELINE.md single-chip bars — an
     # underperforming chip FAILS, it doesn't just report low gauges;
     # quick mode (tiny shapes, throwaway timings) skips the bars
+    def _quick_seq():
+        return 1024 if _jax.devices()[0].platform == "tpu" else 512
+
     add(
         "flash-attention",
         lambda: flash.run(
-            seq=1024 if quick else None,
+            seq=_quick_seq() if quick else None,
             iters=iters,
             min_fraction=None if quick else FLASH_FRACTION_BAR,
         ),

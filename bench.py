@@ -298,8 +298,11 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
 
     if want_cpu:
         # site customizations (e.g. an accelerator plugin on PYTHONPATH)
-        # can override the env var; the config API outranks them
-        jax.config.update("jax_platforms", "cpu")
+        # can override the env var; the config API outranks them —
+        # shared primitive, activemonitor_tpu/utils/platform.py
+        from activemonitor_tpu.utils.platform import force_cpu
+
+        force_cpu()
 
     # persistent compile cache: the secondary probes re-run kernels the
     # battery already compiled on this chip
