@@ -256,10 +256,16 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     # a "CPU" battery run must not silently land on (and wedge against)
     # a site-plugin-registered remote device — shared rule, see
-    # utils/platform.py
+    # utils/platform.py (env-var trigger only: a stale XLA_FLAGS must
+    # not silently downgrade a real-chip battery to interpret mode)
     from activemonitor_tpu.utils.platform import force_cpu_if_requested
 
-    force_cpu_if_requested()
+    if force_cpu_if_requested() is False:
+        print(
+            "warning: JAX_PLATFORMS=cpu requested but the backend is "
+            "already initialized on another platform",
+            file=sys.stderr,
+        )
     args = build_parser().parse_args(argv)
     from activemonitor_tpu.parallel.distributed import maybe_initialize_distributed
 

@@ -28,15 +28,24 @@ def force_cpu() -> bool:
     return True
 
 
-def force_cpu_if_requested() -> bool:
+def force_cpu_if_requested(include_flags: bool = False):
     """Apply :func:`force_cpu` when the environment asks for a virtual
-    CPU run: an explicit ``JAX_PLATFORMS=cpu``, or the driver's
-    ``--xla_force_host_platform_device_count`` flag (requesting a
-    virtual device mesh only the CPU backend provides)."""
+    CPU run. Returns True when forced, False when a request was present
+    but could not be applied, None when nothing requested it.
+
+    The base trigger is an explicit ``JAX_PLATFORMS=cpu``.
+    ``include_flags=True`` additionally triggers on the driver's
+    ``--xla_force_host_platform_device_count`` flag (a virtual device
+    mesh only the CPU backend provides) — that broad rule belongs to
+    the graft-driver contract (``__graft_entry__``), where the ambient
+    environment may pin another platform; operator-facing entry points
+    like the probe CLI deliberately do NOT use it, because a stale
+    XLA_FLAGS in a shell would otherwise silently turn a real-chip
+    battery run into CPU interpret-mode numbers labeled as chip
+    health."""
     flags = os.environ.get("XLA_FLAGS", "")
-    if (
-        os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
-        or "xla_force_host_platform_device_count" in flags
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
+        include_flags and "xla_force_host_platform_device_count" in flags
     ):
         return force_cpu()
-    return False
+    return None

@@ -299,10 +299,16 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
     if want_cpu:
         # site customizations (e.g. an accelerator plugin on PYTHONPATH)
         # can override the env var; the config API outranks them —
-        # shared primitive, activemonitor_tpu/utils/platform.py
+        # shared primitive, activemonitor_tpu/utils/platform.py. Fail
+        # LOUD if the pin doesn't take: numbers measured on the remote
+        # device must never be emitted labeled as the CPU fallback
         from activemonitor_tpu.utils.platform import force_cpu
 
-        force_cpu()
+        if not force_cpu():
+            raise RuntimeError(
+                "could not pin the CPU backend (already initialized on "
+                "another platform) — refusing to mislabel measurements"
+            )
 
     # persistent compile cache: the secondary probes re-run kernels the
     # battery already compiled on this chip

@@ -110,3 +110,45 @@ def test_two_process_train_step_over_dcn():
         assert "{'data': 2, 'model': 1}" in out
     # the loss is replicated over the mesh: both hosts see the same value
     assert losses[0] == losses[1], outputs
+
+
+def test_two_process_checkpoint_resume_over_dcn(tmp_path):
+    """Multi-host durability: both processes of a dp=2 mesh save ONE
+    sharded checkpoint to shared storage (orbax's multi-process
+    barriers), restore it, and continue to the same replicated loss —
+    the preemption-recovery flow of a real multi-host slice."""
+    shared = str(tmp_path / "ckpt")
+
+    def argv(rank, port):
+        driver = (
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            f"jax.distributed.initialize('127.0.0.1:{port}', 2, {rank});"
+            "from activemonitor_tpu.models.probe_model import tiny_config;"
+            "from activemonitor_tpu.parallel.mesh import make_2d_mesh;"
+            "from activemonitor_tpu.parallel.distributed import distribute;"
+            "from activemonitor_tpu.probes.training_step import ("
+            "    build_sharded_train_step, save_train_state,"
+            "    restore_train_state, train_state_templates);"
+            "cfg = tiny_config();"
+            "mesh = make_2d_mesh(shape=(2, 1));"
+            "step, params, opt, data_sh = build_sharded_train_step(cfg, mesh);"
+            "tokens = distribute(jax.random.randint("
+            "    jax.random.key(3), (4, 17), 0, cfg.vocab_size), data_sh);"
+            "params, opt, l1 = step(params, opt, tokens);"
+            f"save_train_state({shared!r}, params, opt, step=1);"
+            "p_like, o_like = train_state_templates(cfg, mesh);"
+            f"r_params, r_opt, at = restore_train_state({shared!r}, p_like, o_like);"
+            "assert at == 1;"
+            "_, _, l2 = step(r_params, r_opt, tokens);"
+            "print('LOSSES', round(float(l1), 6), round(float(l2), 6))"
+        )
+        return [sys.executable, "-c", driver]
+
+    outputs = _run_two_workers(argv, timeout=240)
+    lines = []
+    for out in outputs:
+        (line,) = [l for l in out.splitlines() if l.startswith("LOSSES ")]
+        lines.append(line)
+    # both the pre-save loss and the post-restore continuation agree
+    # across hosts (replicated loss, one shared checkpoint)
+    assert lines[0] == lines[1], outputs
