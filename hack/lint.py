@@ -462,12 +462,17 @@ class Checker(ast.NodeVisitor):
 
     def _owning_function(self, stmt, func_node) -> bool:
         """True if stmt belongs to func_node directly (not to a nested
-        function, which has its own scope entry)."""
+        function OR class body, which have their own scope entries —
+        a `class X:` defined inside a function binds its body
+        assignments as class attributes, not function locals)."""
         for node in ast.walk(func_node):
             if node is stmt:
                 continue
             if (
-                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+                isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+                )
                 and node is not func_node
                 and any(n is stmt for n in ast.walk(node))
             ):

@@ -120,6 +120,20 @@ def test_redefined_test_quiet_on_distinct_scopes(tmp_path):
     assert got == []
 
 
+def test_unused_local_exempts_class_body_in_function(tmp_path):
+    # attributes of a class DEFINED INSIDE a function are class members
+    # (a common test-double idiom), not dead function locals
+    got = findings(
+        tmp_path,
+        "def make_stub():\n"
+        "    class Proc:\n"
+        "        returncode = 0\n"
+        "        stdout = b''\n"
+        "    return Proc\n",
+    )
+    assert got == []
+
+
 def test_undefined_name_and_unused_import_still_fire(tmp_path):
     got = findings(tmp_path, "import os\nprint(sys.argv)\n")
     assert codes(got) == {"undefined-name", "unused-import"}
