@@ -21,6 +21,7 @@ import os
 from typing import Protocol
 
 from activemonitor_tpu.kube import ApiError, api_path
+from activemonitor_tpu.utils.clock import micro_time
 
 log = logging.getLogger("activemonitor.leader")
 
@@ -141,7 +142,7 @@ class KubernetesLeaseElector:
         spec = {
             "holderIdentity": self._identity,
             "leaseDurationSeconds": int(self._lease_seconds),
-            "renewTime": self._clock.now().isoformat(),
+            "renewTime": micro_time(self._clock.now()),
         }
         if acquire_time:
             spec["acquireTime"] = acquire_time
@@ -175,7 +176,7 @@ class KubernetesLeaseElector:
                         "kind": "Lease",
                         "metadata": {"name": self._name, "namespace": self._namespace},
                         "spec": self._spec(
-                            acquire_time=self._clock.now().isoformat()
+                            acquire_time=micro_time(self._clock.now())
                         ),
                     }
                     try:
@@ -204,7 +205,7 @@ class KubernetesLeaseElector:
                     # resourceVersion just read, so if another challenger
                     # won the race this write turns into a 409
                     existing["spec"] = self._spec(
-                        acquire_time=self._clock.now().isoformat()
+                        acquire_time=micro_time(self._clock.now())
                     )
                     try:
                         await self._api.replace(self._path(), existing)
@@ -306,7 +307,7 @@ class KubernetesLeaseElector:
                     log.error("renew deadline exceeded; leadership lost")
                     self.lost.set()
                     return
-                spec["renewTime"] = self._clock.now().isoformat()
+                spec["renewTime"] = micro_time(self._clock.now())
                 existing["spec"] = spec
                 await self._api.request(
                     "PUT", self._path(), body=existing, timeout=remaining()

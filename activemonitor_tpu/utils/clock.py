@@ -74,3 +74,26 @@ class FakeClock(Clock):
         self._t = target
         for _ in range(10):
             await asyncio.sleep(0)
+
+
+def micro_time(dt: datetime.datetime) -> str:
+    """Kubernetes ``MicroTime`` canonical wire format: RFC3339 with
+    EXACTLY six fractional digits (``2026-07-30T04:10:11.000123Z``) —
+    what client-go always writes.
+
+    ``datetime.isoformat()`` omits the fraction entirely when
+    ``microsecond == 0``. Older apiservers parsed MicroTime with the
+    strict RFC3339Micro layout (fraction REQUIRED → a flaky 400 on
+    lease renewal); current apimachinery falls back to lenient RFC3339,
+    but the canonical six-digit form is valid against every version and
+    is what fixed-epoch FakeClock tests (microsecond ALWAYS 0) would
+    otherwise silently diverge from. Documented in docs/conformance.md;
+    every MicroTime field (Lease renewTime/acquireTime) goes through
+    here. Naive datetimes are interpreted as UTC — the repo convention
+    — never as host-local time."""
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return (
+        dt.astimezone(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")
+        + "Z"
+    )

@@ -290,3 +290,38 @@ async def test_two_challengers_race_one_wins():
             c.release()
         finally:
             await api2.close()
+
+
+def test_lease_timestamps_are_strict_microtime():
+    """Lease renewTime/acquireTime are Kubernetes MicroTime — the real
+    apiserver's parser REQUIRES six fractional digits, while
+    datetime.isoformat() omits the fraction at microsecond == 0 (which
+    FakeClock's fixed epoch hits on every write). Pin the wire format
+    so the stub tier can't hide a flaky real-cluster 400."""
+    import datetime
+    import re
+
+    from activemonitor_tpu.utils.clock import micro_time
+
+    strict = re.compile(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z$")
+    # the exact hazard: zero microseconds must still carry .000000
+    zero_us = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+    assert strict.match(micro_time(zero_us)), micro_time(zero_us)
+    assert micro_time(zero_us).endswith(".000000Z")
+    assert strict.match(
+        micro_time(datetime.datetime.now(datetime.timezone.utc))
+    )
+    # non-UTC input normalizes to Z
+    offset = datetime.timezone(datetime.timedelta(hours=5))
+    assert micro_time(zero_us.astimezone(offset)) == micro_time(zero_us)
+
+
+def test_micro_time_treats_naive_as_utc():
+    import datetime
+
+    from activemonitor_tpu.utils.clock import micro_time
+
+    aware = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+    naive = datetime.datetime(2026, 1, 1)
+    # naive input must mean UTC (repo convention), never host-local time
+    assert micro_time(naive) == micro_time(aware)
