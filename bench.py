@@ -241,6 +241,41 @@ def _cpu_secondary_metrics() -> dict:
 
     try:
         import jax
+        import jax.numpy as jnp
+
+        from activemonitor_tpu.models.probe_model import (
+            ProbeModelConfig,
+            decode_step,
+            init_kv_cache,
+            init_params,
+        )
+
+        cfg = ProbeModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+            d_ff=64, max_seq_len=16, dtype=jnp.float32,
+        )
+        params = init_params(jax.random.key(0), cfg)
+        # several positions, so the fused online softmax actually sweeps
+        # multiple visible keys — a pos=0 comparison is vacuous (both
+        # paths return v_new when only one key is visible)
+        tokens = jax.random.randint(jax.random.key(2), (2, 4), 0, cfg.vocab_size)
+        cache_d = init_kv_cache(cfg, 2, 8)
+        cache_f = init_kv_cache(cfg, 2, 8)
+        for p in range(tokens.shape[1]):
+            dense_logits, cache_d = decode_step(
+                params, cache_d, tokens[:, p], jnp.int32(p), cfg
+            )
+            fused_logits, cache_f = decode_step(
+                params, cache_f, tokens[:, p], jnp.int32(p), cfg, use_flash=True
+            )
+        secondary["decode_fused_vs_dense_interpret"] = round(
+            float(jnp.max(jnp.abs(dense_logits - fused_logits))), 6
+        )
+    except Exception as exc:  # pragma: no cover - defensive
+        secondary["decode_interpret_error"] = str(exc)[:200]
+
+    try:
+        import jax
 
         if len(jax.devices()) >= 8:
             from activemonitor_tpu.models.probe_model import tiny_config

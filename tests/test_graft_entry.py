@@ -101,6 +101,10 @@ def test_bench_emits_single_json_line():
     assert "flash_fwd_max_error_interpret" in doc["secondary"]
     assert doc["secondary"]["flash_fwd_max_error_interpret"] < 2e-2
     assert "flash_grad_rel_error_interpret" in doc["secondary"]
+    assert "decode_fused_vs_dense_interpret" in doc["secondary"], doc[
+        "secondary"
+    ].get("decode_interpret_error", doc["secondary"])
+    assert doc["secondary"]["decode_fused_vs_dense_interpret"] < 1e-3
     assert doc["secondary"]["composed_dp_tp_pp_loss"] > 0
 
 
