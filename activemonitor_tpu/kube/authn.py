@@ -20,8 +20,9 @@ endpoint.
 from __future__ import annotations
 
 import hashlib
+import heapq
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from activemonitor_tpu.kube.client import KubeApi
 
@@ -58,6 +59,12 @@ class KubeScrapeAuthorizer:
         # dumps, and eviction is per-entry so junk-token spam cannot
         # flush the legitimate scraper's verdict wholesale
         self._cache: Dict[str, Tuple[float, bool]] = {}
+        # (expiry, key) min-heap mirroring the cache, with lazy
+        # invalidation (a re-remembered key leaves its old heap entry
+        # behind; the pop loop skips entries whose expiry no longer
+        # matches). Keeps eviction O(log n) per insert — a junk-token
+        # flood at capacity must not pay a full-cache scan per request
+        self._expiries: List[Tuple[float, str]] = []
 
     @staticmethod
     def _key(token: str) -> str:
@@ -117,16 +124,26 @@ class KubeScrapeAuthorizer:
         return verdict
 
     def _remember(self, key: str, verdict: bool, now: float) -> None:
-        if len(self._cache) >= self._max_entries:
+        if key not in self._cache and len(self._cache) >= self._max_entries:
             # bound memory under token churn WITHOUT collateral damage:
-            # drop expired entries first, then the soonest-to-expire —
-            # a spammer cycling junk tokens evicts its own junk, not
-            # the legitimate scraper's fresh verdict
-            expired = [k for k, (exp, _v) in self._cache.items() if exp <= now]
-            for k in expired:
-                del self._cache[k]
-            while len(self._cache) >= self._max_entries:
-                soonest = min(self._cache, key=lambda k: self._cache[k][0])
-                del self._cache[soonest]
+            # the heap yields expired entries first, then the soonest-
+            # to-expire — a spammer cycling junk tokens (shortest,
+            # negative TTLs) evicts its own junk, not the legitimate
+            # scraper's fresh verdict
+            while self._expiries and len(self._cache) >= self._max_entries:
+                exp, k = heapq.heappop(self._expiries)
+                live = self._cache.get(k)
+                if live is not None and live[0] == exp:
+                    del self._cache[k]
         ttl = self._ttl if verdict else self._neg_ttl
-        self._cache[key] = (now + ttl, verdict)
+        expiry = now + ttl
+        self._cache[key] = (expiry, verdict)
+        heapq.heappush(self._expiries, (expiry, key))
+        if len(self._expiries) > 2 * self._max_entries:
+            # compact stale (re-remembered) heap entries so the heap
+            # stays O(max_entries) even under verdict refresh churn
+            self._expiries = [
+                (exp, k)
+                for k, (exp, _v) in self._cache.items()
+            ]
+            heapq.heapify(self._expiries)

@@ -41,6 +41,84 @@ def _match_selector(obj: dict, selector: str) -> bool:
     return True
 
 
+def _json_type(value) -> str:
+    """The JSON type name apiserver error messages use."""
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, int):
+        return "integer"
+    if isinstance(value, float):
+        return "number"
+    if isinstance(value, str):
+        return "string"
+    if isinstance(value, list):
+        return "array"
+    if isinstance(value, dict):
+        return "object"
+    return "null"
+
+
+def _validate_openapi(value, schema: dict, path: str, causes: list) -> None:
+    """Structural-schema subset of apiserver CRD validation: type,
+    required, enum, properties/items recursion. Renders causes in the
+    real wire shape ({reason, message, field}) so the 422 the stub
+    returns matches the machine format fixtures pin
+    (tests/fixtures/apiserver/invalid_422.json). Unknown fields are
+    accepted (the stub models preserve-unknown-fields CRDs; pruning is
+    out of scope), and ``metadata`` is skipped at the root — the real
+    apiserver validates ObjectMeta separately from the CRD schema."""
+    expected = schema.get("type")
+    if expected:
+        actual = _json_type(value)
+        if actual != expected and not (
+            expected == "number" and actual == "integer"
+        ):
+            causes.append(
+                {
+                    "reason": "FieldValueInvalid",
+                    "message": (
+                        f'Invalid value: "{actual}": {path or "body"} in '
+                        f'body must be of type {expected}: "{actual}"'
+                    ),
+                    "field": path or "<root>",
+                }
+            )
+            return  # children of a mistyped node can't be checked
+    if "enum" in schema and value not in schema["enum"]:
+        supported = ", ".join(f'"{v}"' for v in schema["enum"])
+        causes.append(
+            {
+                "reason": "FieldValueNotSupported",
+                "message": (
+                    f'Unsupported value: "{value}": supported values: '
+                    f"{supported}"
+                ),
+                "field": path or "<root>",
+            }
+        )
+    if isinstance(value, dict):
+        props = schema.get("properties") or {}
+        for req in schema.get("required") or []:
+            if req not in value:
+                causes.append(
+                    {
+                        "reason": "FieldValueRequired",
+                        "message": "Required value",
+                        "field": f"{path}.{req}" if path else req,
+                    }
+                )
+        for k, v in value.items():
+            if not path and k == "metadata":
+                continue
+            if k in props:
+                _validate_openapi(
+                    v, props[k], f"{path}.{k}" if path else k, causes
+                )
+    if isinstance(value, list) and "items" in schema:
+        for i, item in enumerate(value):
+            _validate_openapi(item, schema["items"], f"{path}[{i}]", causes)
+
+
 def merge_patch(target, patch):
     """RFC 7386 JSON merge patch."""
     if not isinstance(patch, dict):
@@ -63,10 +141,23 @@ class StubApiServer:
         self._rv = 0
         # bounded event history for watch resume; (rv, key, event)
         self._history: List[Tuple[int, Key, str, dict]] = []
-        self._watchers: List[Tuple[Key, str, str, asyncio.Queue]] = []
+        self._watchers: List[dict] = []
         self._runner = None
         self.url = ""
         self.requests: List[Tuple[str, str]] = []  # (method, path) log
+        # every watch connection's query params, for tests asserting
+        # resume behavior (which resourceVersion a reconnect carried)
+        self.watch_params: List[dict] = []
+        # schema registry: key -> (Kind, openAPIV3Schema). Registered
+        # resources get real server-side 422 validation (see
+        # register_crd); unregistered ones stay schemaless, like CRDs
+        # with x-kubernetes-preserve-unknown-fields
+        self._schemas: Dict[Key, Tuple[str, dict]] = {}
+        self._kinds: Dict[Key, str] = {}  # last-seen kind per resource
+        # watch BOOKMARK cadence for clients that sent
+        # allowWatchBookmarks=true (real apiservers send them about
+        # once a minute; tests shrink this to exercise the path)
+        self.bookmark_interval = 60.0
         # chaos injection (see inject_fault / drop_watches / latency)
         self.faults: List[dict] = []
         self.latency = 0.0
@@ -88,13 +179,13 @@ class StubApiServer:
         event = {"type": type_, "object": copy.deepcopy(obj)}
         self._history.append((self._rv, key, namespace, event))
         del self._history[:-1000]
-        for wkey, wns, selector, queue in self._watchers:
+        for w in self._watchers:
             if (
-                wkey == key
-                and (not wns or wns == namespace)
-                and _match_selector(obj, selector)
+                w["key"] == key
+                and (not w["namespace"] or w["namespace"] == namespace)
+                and _match_selector(obj, w["selector"])
             ):
-                queue.put_nowait(event)
+                w["queue"].put_nowait(event)
 
     # test-visible accessors -------------------------------------------
     def obj(self, group: str, version: str, plural: str, namespace: str, name: str):
@@ -109,10 +200,68 @@ class StubApiServer:
         meta.setdefault("resourceVersion", self._bump())
         meta.setdefault("uid", secrets.token_hex(8))
         key = (group, version, plural)
+        if obj.get("kind"):
+            self._kinds.setdefault(key, obj["kind"])
         namespace = meta.get("namespace", "")
         self._bucket(key)[(namespace, meta["name"])] = obj
         self._broadcast(key, namespace, "ADDED", obj)
         return obj
+
+    # -- schema validation ----------------------------------------------
+    def register_schema(
+        self, group: str, version: str, plural: str, kind: str, schema: dict
+    ) -> None:
+        """Turn on server-side 422 validation for one resource. The
+        schema is an openAPIV3Schema dict (what a CRD manifest carries);
+        creates and updates of this resource are validated and rejected
+        with a real ``Invalid`` Status carrying ``details.causes``, the
+        way a real apiserver enforces structural CRD schemas."""
+        key = (group, version, plural)
+        self._schemas[key] = (kind, schema)
+        self._kinds[key] = kind
+
+    def register_crd(self, crd: dict) -> None:
+        """Install a CRD manifest (e.g. ``api.crd.build_crd()``):
+        registers the served version's schema for validation."""
+        spec = crd["spec"]
+        group = spec["group"]
+        plural = spec["names"]["plural"]
+        kind = spec["names"]["kind"]
+        for version in spec["versions"]:
+            schema = (version.get("schema") or {}).get("openAPIV3Schema")
+            if schema:
+                self.register_schema(
+                    group, version["name"], plural, kind, schema
+                )
+
+    def _invalid(self, key: Key, name: str, causes: List[dict]):
+        """422 Invalid the way apimachinery's NewInvalid renders it:
+        message aggregates every cause (bracketed when more than one),
+        details.kind is the KIND (unlike NotFound's resource)."""
+        kind = self._schemas[key][0]
+        group = key[0]
+        qualified = f"{kind}.{group}" if group else kind
+        parts = [f"{c['field']}: {c['message']}" for c in causes]
+        agg = parts[0] if len(parts) == 1 else "[" + ", ".join(parts) + "]"
+        return self._error(
+            422,
+            f'{qualified} "{name}" is invalid: {agg}',
+            reason="Invalid",
+            details={
+                "name": name,
+                "group": group,
+                "kind": kind,
+                "causes": causes,
+            },
+        )
+
+    def _schema_causes(self, key: Key, obj: dict) -> List[dict]:
+        entry = self._schemas.get(key)
+        if entry is None:
+            return []
+        causes: List[dict] = []
+        _validate_openapi(obj, entry[1], "", causes)
+        return causes
 
     # -- chaos injection (the fault-injection tier: SURVEY.md §5.3) ----
     def inject_fault(
@@ -153,10 +302,42 @@ class StubApiServer:
         """Abruptly end every live watch stream (the client sees EOF and
         must reconnect). Returns how many streams were dropped."""
         dropped = 0
-        for _, _, _, queue in list(self._watchers):
-            queue.put_nowait(None)  # sentinel: close the stream
+        for w in list(self._watchers):
+            w["queue"].put_nowait(None)  # sentinel: close the stream
             dropped += 1
         return dropped
+
+    def emit_bookmarks(self) -> int:
+        """Push an immediate BOOKMARK to every live watch that asked
+        for them (``allowWatchBookmarks=true``) — the on-demand
+        counterpart of the interval cadence, so tests can exercise the
+        client's bookmark-resume path without waiting."""
+        sent = 0
+        for w in self._watchers:
+            if w["bookmarks"]:
+                # render NOW, not at dequeue: events already queued
+                # behind this bookmark must not be covered by its RV
+                # (a resume from the bookmark would skip them forever)
+                w["queue"].put_nowait(self._bookmark_event(w["key"]))
+                sent += 1
+        return sent
+
+    def _bookmark_event(self, key: Key) -> dict:
+        """Metadata-only progress event: just the resume RV, shaped
+        like the real wire (fixture watch_stream's BOOKMARK entry)."""
+        group, version, _plural = key
+        kind = self._kinds.get(key, "Object")
+        return {
+            "type": "BOOKMARK",
+            "object": {
+                "apiVersion": f"{group}/{version}" if group else version,
+                "kind": kind,
+                "metadata": {
+                    "resourceVersion": str(self._rv),
+                    "creationTimestamp": None,
+                },
+            },
+        }
 
     # -- lifecycle ------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -309,6 +490,7 @@ class StubApiServer:
     async def _serve_watch(self, request, key: Key, namespace: str):
         from aiohttp import web
 
+        self.watch_params.append(dict(request.query))
         resp = web.StreamResponse()
         resp.content_type = "application/json"
         await resp.prepare(request)
@@ -316,6 +498,7 @@ class StubApiServer:
 
         selector = request.query.get("labelSelector", "")
         start_rv = request.query.get("resourceVersion", "")
+        bookmarks = request.query.get("allowWatchBookmarks") == "true"
         if start_rv:
             oldest = self._history[0][0] if self._history else self._rv + 1
             if int(start_rv) + 1 < oldest and int(start_rv) < self._rv:
@@ -350,7 +533,13 @@ class StubApiServer:
                 if (not namespace or ns == namespace)
                 and _match_selector(obj, selector)
             ]
-        entry = (key, namespace, selector, queue)
+        entry = {
+            "key": key,
+            "namespace": namespace,
+            "selector": selector,
+            "queue": queue,
+            "bookmarks": bookmarks,
+        }
         self._watchers.append(entry)
         try:
             for ev in backlog:
@@ -358,14 +547,35 @@ class StubApiServer:
             timeout = float(request.query.get("timeoutSeconds", "300"))
             loop = asyncio.get_event_loop()
             deadline = loop.time() + timeout
+            next_bookmark = (
+                loop.time() + self.bookmark_interval
+                if bookmarks and self.bookmark_interval > 0
+                else None
+            )
             while True:
-                remaining = deadline - loop.time()
+                now = loop.time()
+                remaining = deadline - now
                 if remaining <= 0:
                     break
+                wait = remaining
+                if next_bookmark is not None:
+                    wait = min(wait, max(next_bookmark - now, 0.0))
                 try:
-                    ev = await asyncio.wait_for(queue.get(), timeout=remaining)
+                    ev = await asyncio.wait_for(
+                        queue.get(), timeout=wait
+                    )
                 except asyncio.TimeoutError:
-                    break
+                    if (
+                        next_bookmark is not None
+                        and loop.time() >= next_bookmark
+                    ):
+                        # queue is empty here (the wait timed out), so
+                        # a bookmark at the CURRENT rv covers nothing
+                        # undelivered on this stream
+                        ev = self._bookmark_event(key)
+                        next_bookmark = loop.time() + self.bookmark_interval
+                    else:
+                        break  # server-side timeoutSeconds elapsed
                 if ev is None:  # drop_watches sentinel: abrupt stream end
                     break
                 await resp.write(json.dumps(ev).encode() + b"\n")
@@ -393,6 +603,13 @@ class StubApiServer:
                 return self._error(422, "name or generateName is required")
             name = generate + secrets.token_hex(3)[:5]
             meta["name"] = name
+        if body.get("kind"):
+            self._kinds.setdefault(key, body["kind"])
+        causes = self._schema_causes(key, body)
+        if causes:
+            # schema validation rejects before storage is consulted —
+            # an invalid duplicate gets 422, not AlreadyExists
+            return self._invalid(key, name, causes)
         if (namespace, name) in self._bucket(key):
             # real apiserver: 409 with reason AlreadyExists (distinct
             # from optimistic-concurrency Conflict at the same code)
@@ -503,6 +720,12 @@ class StubApiServer:
         else:  # PATCH (JSON merge patch)
             patch = {"status": body.get("status")} if status_only else body
             updated = merge_patch(existing, patch)
+        causes = self._schema_causes(key, updated)
+        if causes:
+            # updates are validated on the FULL post-merge object (the
+            # real apiserver validates what would be stored, so a merge
+            # patch cannot smuggle a schema-invalid field in)
+            return self._invalid(key, name, causes)
         meta = updated.setdefault("metadata", {})
         meta["name"] = name
         if namespace:

@@ -761,19 +761,31 @@ def _plan_padding(seq: int, preferred: int) -> tuple:
     Padding to the next 8-multiple is always needed (Mosaic's tiling
     unit). On top of that, when the only tileable divisor COLLAPSES far
     below the requested block (e.g. seq=136 → sole divisor 8 — a
-     17×17 grid of tiny tiles instead of one MXU-sized block), pad
-    further to the next multiple of the requested block instead: a few
+    17×17 grid of tiny tiles instead of one MXU-sized block), padding
+    further to the next multiple of the requested block can win: a few
     masked rows are far cheaper than an order-of-magnitude block-size
-    cliff. The fitted divisor wins whenever it stays within 2× of the
-    request (seq=192 with 128-blocks runs 96-blocks on 192 rows, better
-    than 128-blocks on a padded 256)."""
+    cliff. But padding also SQUARES into attention work (both padded
+    halves of a [S, S] score matrix are computed; only fully-dead K
+    blocks are skipped), so the two options are compared on estimated
+    cost: rows² weighted by a block-efficiency factor that rises
+    linearly to a knee at 512 (small tiles under-fill the MXU pipeline;
+    past ~512 the measured v5e sweep is flat). seq=192 with 128-blocks
+    keeps 96-blocks on 192 rows (beats 128-blocks on a padded 256);
+    seq=1000 pads to 1024 for 512-blocks (2.4% extra rows buys a 2.5×
+    better block); seq=1032 keeps 344-blocks rather than doubling to
+    2048 rows for 1024-blocks."""
     pad8 = seq + ((-seq) % 8)
     block = _fit_block(pad8, preferred)
     target = min(preferred, pad8)
     target = max(8, target - target % 8)
-    if block * 2 < target:
+    if block < target:
         padded = -(-seq // target) * target
-        return padded, target
+
+        def cost(rows: int, b: int) -> float:
+            return rows * rows / (min(b, 512) / 512)
+
+        if cost(padded, target) < cost(pad8, block):
+            return padded, target
     return pad8, block
 
 

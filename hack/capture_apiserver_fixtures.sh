@@ -39,6 +39,10 @@ update_fixture() { # name status body
 import json, sys
 path, status = sys.argv[1], int(sys.argv[2])
 body = json.load(sys.stdin)
+# a TokenReview response echoes the full object back, INCLUDING the
+# live bearer token in spec.token — redact before it can reach git
+if body.get("kind") == "TokenReview" and (body.get("spec") or {}).get("token"):
+    body["spec"]["token"] = "<redacted-sa-token>"
 with open(path) as fh:
     fx = json.load(fh)
 fx["response"] = {"status": status, "body": body}
@@ -106,7 +110,10 @@ echo "== DELETE Status/Success"
 capture delete_success DELETE "$HC_PATH/demo"
 
 echo "== 401 Unauthorized"
-TOKEN="invalid-bearer" capture unauthorized GET "$HC_PATH/demo" || true
+# subshell: a plain `TOKEN=... capture ...` prefix is a bash-ism whose
+# temporary-environment scoping flips in POSIX mode — the assignment
+# would persist and poison every capture after this one
+(TOKEN="invalid-bearer"; capture unauthorized GET "$HC_PATH/demo") || true
 
 echo "== TokenReview / SubjectAccessReview"
 SA_TOKEN=$(kubectl create token default --duration=10m)
@@ -122,5 +129,6 @@ echo "  curl -ksN -H \"Authorization: Bearer \$TOKEN\" \\"
 echo "    \"$API_SERVER$HC_PATH?watch=true&allowWatchBookmarks=true\""
 echo "and paste the observed event lines into the fixtures' \"stream\"."
 echo
-echo "Done. Scrub any real tokens from tokenreview.json before committing,"
+echo "Done. tokenreview.json's spec.token is auto-redacted; verify with"
+echo "  grep -r 'redacted-sa-token' tests/fixtures/apiserver/tokenreview.json"
 echo "then run: python -m pytest tests/test_apiserver_conformance.py"

@@ -14,8 +14,16 @@ from activemonitor_tpu.kube.stub import StubApiServer
 
 @asynccontextmanager
 async def stub_env(token: str = ""):
-    """An in-process API server plus a client pointed at it."""
+    """An in-process API server plus a client pointed at it.
+
+    The HealthCheck CRD schema is installed, so every cluster-mode test
+    runs under real server-side 422 validation — any schema-invalid
+    object the controller writes fails the test, the way envtest's real
+    apiserver would fail the reference's suite."""
+    from activemonitor_tpu.api.crd import build_crd
+
     server = StubApiServer(token=token)
+    server.register_crd(build_crd())
     await server.start()
     api = KubeApi(KubeConfig(server=server.url, token=token))
     try:

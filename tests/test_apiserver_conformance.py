@@ -371,3 +371,162 @@ async def test_stub_watch_expiry_event_shape():
     finally:
         await api.close()
         await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_stub_422_byte_equal_to_fixture():
+    """With the generated CRD schema installed, the stub's live 422
+    must equal the recorded wire bytes field for field — message
+    aggregation, reason, AND details.causes (the invalid_422 fixture's
+    stub column was previously unproven; see docs/conformance.md)."""
+    from activemonitor_tpu.api.crd import build_crd
+
+    fixture = FIXTURES["invalid_422"]
+    server = StubApiServer()
+    server.register_crd(build_crd())
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url))
+    try:
+        with pytest.raises(ApiError) as exc:
+            await api.create(
+                fixture["request"]["path"], fixture["request"]["body"]
+            )
+        assert exc.value.status == 422
+        assert exc.value.body == fixture["response"]["body"]
+    finally:
+        await api.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_stub_422_on_merge_patch_result():
+    """Validation runs on the post-merge object: a patch that flips a
+    valid field to the wrong type is rejected, nothing stored."""
+    from activemonitor_tpu.api.crd import build_crd
+
+    server = StubApiServer()
+    server.register_crd(build_crd())
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url))
+    path = "/apis/activemonitor.keikoproj.io/v1alpha1/namespaces/health/healthchecks"
+    try:
+        await api.create(
+            path,
+            {
+                "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+                "kind": "HealthCheck",
+                "metadata": {"name": "demo", "namespace": "health"},
+                "spec": {"repeatAfterSec": 60},
+            },
+        )
+        with pytest.raises(ApiError) as exc:
+            await api.merge_patch(
+                f"{path}/demo", {"spec": {"repeatAfterSec": "bad"}}
+            )
+        assert exc.value.status == 422
+        causes = (exc.value.body.get("details") or {}).get("causes") or []
+        assert causes and causes[0]["field"] == "spec.repeatAfterSec"
+        stored = server.obj(
+            "activemonitor.keikoproj.io",
+            "v1alpha1",
+            "healthchecks",
+            "health",
+            "demo",
+        )
+        assert stored["spec"]["repeatAfterSec"] == 60  # patch not stored
+    finally:
+        await api.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_stub_emits_interval_bookmarks():
+    """A watch with allowWatchBookmarks=true receives metadata-only
+    BOOKMARK events on the configured cadence, shaped like the
+    watch_stream fixture's BOOKMARK entry."""
+    import asyncio as aio
+
+    server = StubApiServer()
+    server.bookmark_interval = 0.05
+    await server.start()
+    api = KubeApi(KubeConfig(server=server.url))
+    path = "/apis/activemonitor.keikoproj.io/v1alpha1/namespaces/health/healthchecks"
+    try:
+        await api.create(
+            path,
+            {
+                "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+                "kind": "HealthCheck",
+                "metadata": {"name": "demo", "namespace": "health"},
+                "spec": {"repeatAfterSec": 60},
+            },
+        )
+
+        async def first_bookmark():
+            async for event in api.watch(path):
+                if event["type"] == "BOOKMARK":
+                    return event
+
+        event = await aio.wait_for(first_bookmark(), timeout=5.0)
+        obj = event["object"]
+        assert obj["kind"] == "HealthCheck"
+        assert obj["apiVersion"] == "activemonitor.keikoproj.io/v1alpha1"
+        # metadata-only: the resume RV and nothing object-specific
+        assert obj["metadata"]["resourceVersion"] == str(server._rv)
+        assert "name" not in obj["metadata"]
+        assert "spec" not in obj
+    finally:
+        await api.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_client_bookmark_resume_end_to_end():
+    """The controller watch's resume path, against a live server: a
+    BOOKMARK advances the client's resume RV past the last real event,
+    and the reconnect after a dropped stream carries the bookmark's RV
+    (previously only replay-proven; the stub never sent bookmarks)."""
+    import asyncio as aio
+
+    from activemonitor_tpu.controller.client_k8s import (
+        KubernetesHealthCheckClient,
+    )
+
+    server = StubApiServer()
+    await server.start()  # interval off (60 s); emit_bookmarks() drives
+    api = KubeApi(KubeConfig(server=server.url))
+    path = "/apis/activemonitor.keikoproj.io/v1alpha1/namespaces/health/healthchecks"
+
+    def hc(name):
+        return {
+            "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+            "kind": "HealthCheck",
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {"repeatAfterSec": 60},
+        }
+
+    try:
+        client = KubernetesHealthCheckClient(api)
+        gen = client.watch()
+        await api.create(path, hc("first"))
+        event = await aio.wait_for(gen.__anext__(), timeout=5.0)
+        assert (event.type, event.name) == ("ADDED", "first")
+        # advance the global RV past the last HealthCheck event, then
+        # bookmark: the client's resume point moves WITHOUT a real event
+        await api.create(
+            "/api/v1/namespaces/health/configmaps",
+            {"kind": "ConfigMap", "metadata": {"name": "noise"}},
+        )
+        bookmark_rv = str(server._rv)
+        assert server.emit_bookmarks() == 1
+        await aio.sleep(0.1)  # let the bookmark reach the client
+        server.drop_watches()
+        await api.create(path, hc("second"))
+        event = await aio.wait_for(gen.__anext__(), timeout=5.0)
+        assert (event.type, event.name) == ("ADDED", "second")
+        resumed = [p for p in server.watch_params if "resourceVersion" in p]
+        assert resumed and resumed[-1]["resourceVersion"] == bookmark_rv
+        await gen.aclose()
+    finally:
+        await api.close()
+        await server.stop()

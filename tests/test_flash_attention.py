@@ -136,6 +136,13 @@ def test_plan_padding_avoids_block_collapse():
     # divisor collapse (136 = 8x17 -> only divisor 8): pad to the block
     assert _plan_padding(136, 128) == (256, 128)
     assert _plan_padding(1000, 512) == (1024, 512)
+    # a healthy mid-size divisor must NOT trigger near-2x padding:
+    # 1032 = 8*3*43 fits 344-blocks — doubling rows to 2048 for
+    # 1024-blocks costs ~4x attention work for a ~3x block gain
+    assert _plan_padding(1032, 1024) == (1032, 344)
+    assert _plan_padding(4104, 1024) == (4104, 456)
+    # but a true cliff (8*131 -> sole divisor 8) still pads
+    assert _plan_padding(1048, 1024) == (2048, 1024)
 
 
 def test_block_collapse_seq_still_correct():

@@ -483,6 +483,35 @@ async def test_k8s_auth_junk_spam_cannot_evict_live_verdict():
     assert api.token_reviews == reviews + 20  # no re-review of the scraper
 
 
+@pytest.mark.asyncio
+async def test_k8s_auth_expiry_heap_stays_bounded():
+    """Re-remembering the same tokens leaves stale heap entries behind;
+    compaction must keep the heap O(max_entries) under refresh churn,
+    and lazy invalidation must never evict a key via a stale entry."""
+    from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+    clock = [0.0]
+    api = _FakeReviewApi()
+    auth = KubeScrapeAuthorizer(
+        api, cache_ttl=60.0, negative_ttl=10.0,
+        monotonic=lambda: clock[0], max_entries=4,
+    )
+    for round_ in range(50):  # each re-review pushes a fresh heap entry
+        clock[0] = round_ * 61.0  # past the positive TTL: re-evaluated
+        for i in range(3):
+            assert await auth.allowed(f"norbac-{i}") is False
+    assert len(auth._expiries) <= 2 * 4
+    assert len(auth._cache) <= 4
+    # a live verdict inserted now survives junk churn at capacity
+    assert await auth.allowed("good-scraper") is True
+    reviews = api.token_reviews
+    for i in range(10):
+        clock[0] += 0.01
+        assert await auth.allowed(f"junk-{i}") is False
+    assert await auth.allowed("good-scraper") is True
+    assert api.token_reviews == reviews + 10
+
+
 def test_cli_k8s_auth_on_requires_cluster_credentials():
     import asyncio as aio
 
