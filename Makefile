@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMG ?= ghcr.io/activemonitor-tpu/controller:latest
 
-.PHONY: all test test-tpu bench bench-tpu bench-tpu-watch crd manifests run lint kind-e2e docker-build install help
+.PHONY: all test test-tpu bench bench-tpu bench-tpu-watch crd manifests run lint kind-e2e docker-build release-dryrun install help
 
 all: test crd
 
@@ -42,6 +42,9 @@ kind-e2e: ## real-cluster tier: kind + Argo + controller + a Succeeded check
 
 docker-build: ## build the controller+probes image
 	docker build -t $(IMG) .
+
+release-dryrun: ## every release.yml step that runs without docker/egress
+	./hack/release_dryrun.sh
 
 install: ## editable install
 	$(PYTHON) -m pip install -e .

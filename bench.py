@@ -351,8 +351,9 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
         from activemonitor_tpu.probes.suite import enable_persistent_compile_cache
 
         enable_persistent_compile_cache()
-    except Exception:
-        pass
+    except Exception as e:
+        # cold-compile still works, just slower; say so off the JSON line
+        print(f"compile cache unavailable: {e}", file=sys.stderr)
 
     devices = jax.devices()
     n = len(devices)

@@ -1,0 +1,43 @@
+"""activemonitor_tpu — a TPU-native monitoring-and-self-healing framework.
+
+A brand-new framework with the capabilities of keikoproj/active-monitor
+(reference: /root/reference): a controller that runs user-defined
+``HealthCheck`` specs as periodic probe workflows (interval or cron
+scheduled, with inverse-exponential status polling, per-check
+least-privilege RBAC, pause semantics, Prometheus/event/status
+observability) and, on failure, triggers bounded ``RemedyWorkflow``
+self-healing with run limits and reset-interval hysteresis.
+
+Unlike the Go reference, probe payloads are first-class TPU workloads:
+JAX programs that verify device inventory, measure ICI all-reduce
+bandwidth against rated throughput, and smoke-test XLA compilation of a
+sharded training step — exported through the same custom-metrics
+contract the reference defines (reference: internal/metrics/collector.go:68-115).
+
+Layout (see SURVEY.md §7 for the build plan):
+
+- ``api``        — HealthCheck spec/status types + CRD generation
+                   (reference: api/v1alpha1/healthcheck_types.go)
+- ``store``      — artifact readers: inline / URL / file
+                   (reference: internal/store/)
+- ``scheduler``  — cron parsing, inverse-exponential backoff, timer wheel
+                   (reference: healthcheck_controller.go:251-263,575-605,745-754)
+- ``engine``     — workflow execution backends: fake (tests), local
+                   process (single host), Argo (Kubernetes)
+                   (reference boundary: healthcheck_controller.go:502-534,617)
+- ``controller`` — reconciler state machine, RBAC provisioner, events
+                   (reference: internal/controllers/healthcheck_controller.go)
+- ``metrics``    — Prometheus collectors incl. dynamic custom gauges
+                   (reference: internal/metrics/collector.go)
+- ``probes``     — the TPU-native probe payload library (new)
+- ``models``     — the probe transformer used by the training-step probe (new)
+- ``parallel``   — device mesh + timed-collective helpers (new)
+- ``ops``        — TPU kernels (Pallas) used by probes (new)
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "activemonitor.keikoproj.io"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "HealthCheck"

@@ -1,0 +1,618 @@
+"""Controller CLI — ``python -m activemonitor_tpu <command>``.
+
+``run`` mirrors the reference's process flags (reference:
+cmd/main.go:138-144 — metrics-bind-address :8443,
+health-probe-bind-address :8081, leader-elect off, max-workers 10) and
+adds the engine/store selection this framework's local mode needs.
+``apply``/``get``/``delete`` give the kubectl-equivalent UX against the
+file-backed store; ``crd`` prints the generated CRD manifest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="activemonitor_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run the controller")
+    run.add_argument(
+        "--metrics-bind-address",
+        default=":8443",
+        help="metrics endpoint address ('0' to disable)",
+    )
+    run.add_argument(
+        "--metrics-secure",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="serve metrics over TLS (self-signed unless cert/key given; "
+        "reference parity: secure by default on :8443)",
+    )
+    run.add_argument(
+        "--metrics-cert-file",
+        default="",
+        help="PEM certificate for the metrics endpoint",
+    )
+    run.add_argument(
+        "--metrics-key-file",
+        default="",
+        help="PEM private key for the metrics endpoint",
+    )
+    run.add_argument(
+        "--metrics-auth-token-file",
+        default="",
+        help="file holding a static bearer token required to scrape "
+        "/metrics (fallback credential; see --metrics-k8s-auth)",
+    )
+    run.add_argument(
+        "--metrics-k8s-auth",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="authenticate+authorize /metrics scrapes through the "
+        "cluster (TokenReview + SubjectAccessReview, the reference's "
+        "WithAuthenticationAndAuthorization filter, cmd/main.go:74-81). "
+        "'auto' enables it whenever cluster credentials are in use "
+        "(--client k8s / --engine argo); a static token file, if also "
+        "given, stays honored as a fallback credential",
+    )
+    run.add_argument(
+        "--health-probe-bind-address",
+        default=":8081",
+        help="health/readiness probe address ('0' to disable)",
+    )
+    run.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="enable leader election for multi-replica HA",
+    )
+    run.add_argument(
+        "--max-workers",
+        type=int,
+        default=10,
+        help="maximum concurrent reconciles",
+    )
+    run.add_argument(
+        "--engine",
+        choices=["local", "argo"],
+        default="local",
+        help="workflow execution backend",
+    )
+    run.add_argument(
+        "--client",
+        choices=["file", "k8s"],
+        default=None,
+        help="HealthCheck store: file directory or the Kubernetes API "
+        "(default: k8s when --engine=argo, else file)",
+    )
+    run.add_argument(
+        "--store",
+        default="./healthchecks",
+        help="directory of HealthCheck YAML specs (file-backed store)",
+    )
+    run.add_argument(
+        "--kubeconfig",
+        default=None,
+        help="kubeconfig path for cluster mode (default: $KUBECONFIG, "
+        "then in-cluster credentials, then ~/.kube/config)",
+    )
+    run.add_argument(
+        "-f",
+        "--filename",
+        action="append",
+        default=[],
+        help="HealthCheck manifest(s) to apply at startup",
+    )
+    run.add_argument("--log-level", default="INFO")
+    run.add_argument(
+        "--log-format",
+        choices=("text", "json"),
+        default="text",
+        help="console text or structured JSON lines "
+        "(reference parity: zap --zap-encoder, cmd/main.go:146-152)",
+    )
+
+    def add_client_flags(p) -> None:
+        """kubectl-verb parity: every CLI verb can target the file store
+        (local mode) or the cluster (--client k8s)."""
+        p.add_argument("--store", default="./healthchecks")
+        p.add_argument("--client", choices=["file", "k8s"], default="file")
+        p.add_argument("--kubeconfig", default=None)
+
+    for name, help_text in [
+        ("apply", "apply a HealthCheck manifest to the store"),
+        ("delete", "delete a HealthCheck from the store"),
+    ]:
+        p = sub.add_parser(name, help=help_text)
+        add_client_flags(p)
+        if name == "apply":
+            p.add_argument("-f", "--filename", required=True)
+        else:
+            p.add_argument("name")
+            p.add_argument("--namespace", "-n", default="default")
+
+    get = sub.add_parser("get", help="list HealthChecks (kubectl get hc)")
+    get.add_argument("resource", nargs="?", default="hc", choices=["hc", "hcs", "healthchecks", "healthcheck"])
+    get.add_argument("name", nargs="?", default=None)
+    add_client_flags(get)
+    get.add_argument("--namespace", "-n", default=None)
+    get.add_argument(
+        "-o", "--output", choices=["table", "yaml", "json"], default="table"
+    )
+    get.add_argument(
+        "--watch",
+        "-w",
+        action="store_true",
+        help="keep printing the table as it changes",
+    )
+
+    describe = sub.add_parser(
+        "describe", help="spec + status + recent events for one HealthCheck"
+    )
+    describe.add_argument("name")
+    add_client_flags(describe)
+    describe.add_argument("--namespace", "-n", default="default")
+
+    sub.add_parser("crd", help="print the HealthCheck CRD manifest")
+    sub.add_parser("version", help="print version")
+    return parser
+
+
+async def _run(args) -> int:
+    from activemonitor_tpu.utils.logfmt import configure_logging
+
+    configure_logging(args.log_level, getattr(args, "log_format", "text"))
+    client_kind = args.client or ("k8s" if args.engine == "argo" else "file")
+    # one REST session shared by every cluster-facing component
+    kube_api = None
+    kube_cfg = None
+    if client_kind == "k8s" or args.engine == "argo":
+        from activemonitor_tpu.kube import KubeApi
+        from activemonitor_tpu.kube.config import load_kube_config
+
+        kube_cfg = load_kube_config(getattr(args, "kubeconfig", None))
+        kube_api = KubeApi(kube_cfg)
+    # the session must outlive everything built on it and close on EVERY
+    # exit path, including construction failures — hence the try begins
+    # immediately after the session exists
+    try:
+        return await _run_controller(args, client_kind, kube_api, kube_cfg)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+
+
+async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
+    from activemonitor_tpu.api.types import HealthCheck
+    from activemonitor_tpu.controller.leader import AlwaysLeader, FileLeaderElector
+    from activemonitor_tpu.controller.manager import Manager
+    from activemonitor_tpu.controller.rbac import InMemoryRBACBackend, RBACProvisioner
+    from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+    from activemonitor_tpu.metrics.collector import MetricsCollector
+
+    if client_kind == "k8s":
+        from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+        from activemonitor_tpu.controller.events import KubernetesEventRecorder
+
+        client = KubernetesHealthCheckClient(kube_api)
+        recorder = KubernetesEventRecorder(kube_api)
+    else:
+        from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+        from activemonitor_tpu.controller.events import FileEventRecorder
+
+        client = FileHealthCheckClient(args.store)
+        recorder = FileEventRecorder(args.store)
+    if kube_api is not None:
+        # whenever a cluster is in play (k8s store OR argo engine), the
+        # per-check RBAC that submitted workflows reference must be real
+        # cluster state (reference: healthcheck_controller.go:302-415,
+        # 1128-1443) — an in-memory SA would leave probe pods Forbidden
+        from activemonitor_tpu.controller.rbac import KubernetesRBACBackend
+
+        rbac_backend = KubernetesRBACBackend(kube_api)
+    else:
+        rbac_backend = InMemoryRBACBackend()
+    metrics = MetricsCollector()
+    if args.engine == "argo":
+        from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
+
+        engine = ArgoWorkflowEngine(
+            kube_api, on_watch_health=metrics.record_watch_health
+        )
+    else:
+        from activemonitor_tpu.engine.local import LocalProcessEngine
+
+        engine = LocalProcessEngine()
+
+    if args.leader_elect:
+        if client_kind == "k8s":
+            from activemonitor_tpu.controller.leader import KubernetesLeaseElector
+
+            # the Lease lives in the namespace the controller runs in
+            # (in-cluster SA namespace / kubeconfig context namespace)
+            elector = KubernetesLeaseElector(
+                kube_api, namespace=kube_cfg.namespace or "default"
+            )
+        else:
+            # flock is per-host: only meaningful for co-hosted replicas
+            elector = FileLeaderElector()
+    else:
+        elector = AlwaysLeader()
+
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(rbac_backend),
+        recorder=recorder,
+        metrics=metrics,
+    )
+    metrics_authorizer = None
+    k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
+    if k8s_auth == "on" and kube_api is None:
+        from activemonitor_tpu.errors import ConfigurationError
+
+        raise ConfigurationError(
+            "--metrics-k8s-auth on needs cluster credentials "
+            "(--client k8s or --engine argo)"
+        )
+    if kube_api is not None and k8s_auth in ("auto", "on"):
+        from activemonitor_tpu.kube.authn import KubeScrapeAuthorizer
+
+        metrics_authorizer = KubeScrapeAuthorizer(kube_api)
+
+    # Manager construction validates the flag combination BEFORE the -f
+    # manifests are applied (no side effects on a usage error)
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        max_parallel=args.max_workers,
+        metrics_bind_address=(
+            "" if args.metrics_bind_address == "0" else args.metrics_bind_address
+        ),
+        health_probe_bind_address=(
+            ""
+            if args.health_probe_bind_address == "0"
+            else args.health_probe_bind_address
+        ),
+        leader_elector=elector,
+        metrics_secure=args.metrics_secure,
+        metrics_cert_file=args.metrics_cert_file,
+        metrics_key_file=args.metrics_key_file,
+        metrics_auth_token_file=args.metrics_auth_token_file,
+        metrics_authorizer=metrics_authorizer,
+    )
+    for path in args.filename:
+        await client.apply(_load_manifest(HealthCheck, path))
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    # start as a task: a standby replica blocks inside the election until
+    # it wins, and SIGTERM must still shut it down gracefully meanwhile
+    start_task = asyncio.create_task(manager.start())
+    stop_wait = asyncio.ensure_future(stop.wait())
+    lost_leadership = False
+    try:
+        await asyncio.wait(
+            {start_task, stop_wait}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if not start_task.done():
+            # signalled while standing by for leadership
+            start_task.cancel()
+            await asyncio.gather(start_task, return_exceptions=True)
+            return 0
+        start_task.result()  # propagate startup failures
+        logging.getLogger("activemonitor").info(
+            "controller running: store=%s engine=%s workers=%d",
+            args.store,
+            args.engine,
+            args.max_workers,
+        )
+        # stop on signal OR on the manager stopping itself (leadership lost)
+        stopping_wait = asyncio.ensure_future(manager.stopping.wait())
+        await asyncio.wait(
+            {stop_wait, stopping_wait}, return_when=asyncio.FIRST_COMPLETED
+        )
+        stopping_wait.cancel()
+        # a self-initiated stop without a signal means leadership was
+        # lost: exit non-zero so the orchestrator restarts this replica
+        # into the candidate pool (controller-runtime exits fatally too)
+        lost_leadership = manager.stopping.is_set() and not stop.is_set()
+    finally:
+        # teardown runs on every path, including startup failures —
+        # otherwise bound sockets stay held
+        stop_wait.cancel()
+        await manager.stop()
+        closer = getattr(engine, "close", None)
+        if closer is not None:
+            await closer()  # stop workflow watch streams
+    return 1 if lost_leadership else 0
+
+
+def _load_manifest(model, path: str):
+    """Parse a user-supplied manifest, converting parse/validation
+    failures into usage errors — ONLY at this boundary, so internal
+    ValidationErrors elsewhere keep their tracebacks."""
+    import yaml as _yaml
+
+    from pydantic import ValidationError
+
+    from activemonitor_tpu.errors import ConfigurationError
+
+    try:
+        with open(path) as f:
+            return model.from_yaml(f.read())
+    except (ValidationError, _yaml.YAMLError) as e:
+        raise ConfigurationError(f"invalid manifest {path!r}: {e}") from e
+    except OSError as e:
+        raise ConfigurationError(f"cannot read manifest {path!r}: {e}") from e
+
+
+def _cli_client(args):
+    """(client, kube_api-or-None) for a CLI verb, honoring --client."""
+    if getattr(args, "client", "file") == "k8s":
+        from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+        from activemonitor_tpu.kube import KubeApi
+        from activemonitor_tpu.kube.config import load_kube_config
+
+        api = KubeApi(load_kube_config(getattr(args, "kubeconfig", None)))
+        return KubernetesHealthCheckClient(api), api
+    from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+
+    return FileHealthCheckClient(args.store), None
+
+
+async def _apply(args) -> int:
+    from activemonitor_tpu.api.types import HealthCheck
+
+    hc = _load_manifest(HealthCheck, args.filename)
+    client, kube_api = _cli_client(args)
+    try:
+        hc = await client.apply(hc)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+    print(f"healthcheck.{hc.api_version.split('/')[0]}/{hc.metadata.name} applied")
+    return 0
+
+
+async def _delete(args) -> int:
+    from activemonitor_tpu.controller.client import NotFoundError
+
+    client, kube_api = _cli_client(args)
+    try:
+        await client.delete(args.namespace, args.name)
+    except NotFoundError:
+        print(f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr)
+        return 1
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+    print(f"healthcheck {args.namespace}/{args.name} deleted")
+    return 0
+
+
+async def _get(args) -> int:
+    if args.watch and args.output != "table":
+        print("--watch only supports table output", file=sys.stderr)
+        return 2
+    client, kube_api = _cli_client(args)
+    try:
+        return await _get_inner(args, client)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+
+
+async def _get_inner(args, client) -> int:
+    import json as _json
+
+    import yaml as _yaml
+
+    # name lookups are namespace-scoped like kubectl (default ns when
+    # -n is unset) so the output shape never depends on collisions
+    namespace = args.namespace or ("default" if args.name else None)
+
+    async def fetch():
+        checks = await client.list(namespace)
+        if args.name:
+            checks = [hc for hc in checks if hc.metadata.name == args.name]
+        return checks
+
+    checks = await fetch()
+    if args.name and not checks:
+        print(f"healthcheck {args.name!r} not found", file=sys.stderr)
+        return 1
+    if args.output in ("yaml", "json"):
+        docs = [hc.to_dict() for hc in checks]
+        if args.output == "yaml":
+            print(_yaml.safe_dump_all(docs, sort_keys=False), end="")
+        else:
+            # stable shape for scripts: a name lookup returns one object
+            # (namespace-scoped, so exactly one), a listing an array
+            payload = docs[0] if args.name else docs
+            print(_json.dumps(payload, indent=2, default=str))
+        return 0
+    def print_table(checks) -> None:
+        rows = [hc.printer_row() for hc in checks]
+        if not rows:
+            print("No resources found.")
+            return
+        headers = list(rows[0].keys())
+        widths = [
+            max(len(str(h)), *(len(str(r[h])) for r in rows)) for h in headers
+        ]
+        print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for r in rows:
+            print("  ".join(str(r[h]).ljust(w) for h, w in zip(headers, widths)))
+
+    print_table(checks)
+    if args.watch:
+        last = [hc.to_dict() for hc in checks]
+
+        async def refresh() -> None:
+            nonlocal last
+            current_checks = await fetch()
+            current = [hc.to_dict() for hc in current_checks]
+            if current != last:
+                last = current
+                print()
+                print_table(current_checks)
+
+        try:
+            if getattr(args, "client", "file") == "k8s":
+                # event-driven but rate-limited: events only mark dirty;
+                # one LIST refresh at most per second coalesces bursts
+                # (the initial synthetic-ADDED replay, reconcile churn)
+                dirty = asyncio.Event()
+
+                async def mark_dirty() -> None:
+                    async for _event in client.watch():
+                        dirty.set()
+
+                marker = asyncio.create_task(mark_dirty())
+                try:
+                    while True:
+                        await dirty.wait()
+                        dirty.clear()
+                        try:
+                            await refresh()
+                        except Exception as e:
+                            # transient LIST failure must not kill a
+                            # long-running watch (the stream reconnects;
+                            # so do we, on the next event)
+                            print(f"refresh failed ({e}); retrying", file=sys.stderr)
+                        await asyncio.sleep(1.0)
+                finally:
+                    marker.cancel()
+                    await asyncio.gather(marker, return_exceptions=True)
+            else:
+                # the file store is written by other processes — no
+                # cross-process change feed, so poll
+                while True:
+                    await asyncio.sleep(1.0)
+                    await refresh()
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            return 0
+    return 0
+
+
+async def _describe(args) -> int:
+    import yaml as _yaml
+
+    client, kube_api = _cli_client(args)
+    try:
+        hc = await client.get(args.namespace, args.name)
+        if hc is None:
+            print(
+                f"healthcheck {args.namespace}/{args.name} not found", file=sys.stderr
+            )
+            return 1
+        events = await _describe_events(args, kube_api)
+    finally:
+        if kube_api is not None:
+            await kube_api.close()
+
+    def print_indented(doc) -> None:
+        for line in _yaml.safe_dump(doc, sort_keys=False).splitlines():
+            print(f"  {line}")
+
+    print(f"Name:       {hc.metadata.name}")
+    print(f"Namespace:  {hc.metadata.namespace}")
+    print(f"Status:     {hc.status.status or '<none>'}")
+    print("Spec:")
+    print_indented(hc.spec.to_json_dict())
+    print("Status detail:")
+    print_indented(hc.status.to_json_dict())
+    print(f"Events ({len(events)} recorded):")
+    for ev in events[-20:]:
+        print(f"  {ev.get('time', '')}  {ev.get('type', ''):8} {ev.get('message', '')}")
+    return 0
+
+
+async def _describe_events(args, kube_api) -> list:
+    """Recent events for the check: the Events API in cluster mode
+    (what kubectl describe shows), the JSONL sidecars in file mode."""
+    if kube_api is not None:
+        from activemonitor_tpu.kube import core_path
+
+        # server-side filtering like kubectl; the client-side filter
+        # below stays as a belt (not every server honors the selector)
+        raw = await kube_api.get(
+            core_path("events", args.namespace),
+            params={
+                "fieldSelector": (
+                    f"involvedObject.name={args.name},"
+                    "involvedObject.kind=HealthCheck"
+                )
+            },
+        )
+        out = []
+        for ev in raw.get("items", []):
+            involved = ev.get("involvedObject") or {}
+            if involved.get("kind") == "HealthCheck" and involved.get("name") == args.name:
+                out.append(
+                    {
+                        # events.k8s.io-created events carry null first/
+                        # lastTimestamp (eventTime instead) — never None
+                        "time": (
+                            ev.get("lastTimestamp")
+                            or ev.get("firstTimestamp")
+                            or ev.get("eventTime")
+                            or ""
+                        ),
+                        "type": ev.get("type", ""),
+                        "reason": ev.get("reason", ""),
+                        "message": ev.get("message", ""),
+                    }
+                )
+        return sorted(out, key=lambda e: e["time"])
+    from activemonitor_tpu.controller.events import FileEventRecorder
+
+    return FileEventRecorder.read_events(args.store, args.namespace, args.name)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        from activemonitor_tpu import __version__
+
+        print(__version__)
+        return 0
+    if args.command == "crd":
+        from activemonitor_tpu.api.crd import crd_yaml
+
+        print(crd_yaml(), end="")
+        return 0
+    handler = {
+        "run": _run,
+        "apply": _apply,
+        "delete": _delete,
+        "get": _get,
+        "describe": _describe,
+    }[args.command]
+    from activemonitor_tpu.errors import MissingDependencyError
+
+    from activemonitor_tpu.errors import ConfigurationError
+
+    try:
+        return asyncio.run(handler(args))
+    except (MissingDependencyError, ConfigurationError) as e:
+        # configuration problems (missing credentials, invalid flag
+        # combinations, bad manifests — wrapped as ConfigurationError at
+        # the parse site) read as usage errors, not crashes. Deliberately
+        # NOT every ValueError/ValidationError: those would eat
+        # tracebacks for internal bugs in a long-running controller
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""API types for the HealthCheck resource (group activemonitor.keikoproj.io/v1alpha1)."""
+
+from activemonitor_tpu.api.types import (
+    ArtifactLocation,
+    FileArtifact,
+    HealthCheck,
+    HealthCheckList,
+    HealthCheckSpec,
+    HealthCheckStatus,
+    ObjectMeta,
+    OwnerReference,
+    PolicyRule,
+    RemedyWorkflow,
+    ResourceObject,
+    ScheduleSpec,
+    URLArtifact,
+    Workflow,
+)
+
+__all__ = [
+    "ArtifactLocation",
+    "FileArtifact",
+    "HealthCheck",
+    "HealthCheckList",
+    "HealthCheckSpec",
+    "HealthCheckStatus",
+    "ObjectMeta",
+    "OwnerReference",
+    "PolicyRule",
+    "RemedyWorkflow",
+    "ResourceObject",
+    "ScheduleSpec",
+    "URLArtifact",
+    "Workflow",
+]

@@ -1,0 +1,132 @@
+"""CRD manifest generation.
+
+Produces the CustomResourceDefinition for HealthCheck — the controller-gen
+output equivalent (reference:
+config/crd/bases/activemonitor.keikoproj.io_healthchecks.yaml), with the
+same group/version/kind, short names ``hc``/``hcs``, status subresource,
+and printer columns (reference: api/v1alpha1/healthcheck_types.go:68-76).
+
+The OpenAPI schema is derived from the pydantic models, so the CRD can
+never drift from the code — run ``python -m activemonitor_tpu crd``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import yaml
+
+from activemonitor_tpu import GROUP, KIND, VERSION
+from activemonitor_tpu.api.types import HealthCheckSpec, HealthCheckStatus
+
+PLURAL = "healthchecks"
+SINGULAR = "healthcheck"
+SHORT_NAMES = ["hc", "hcs"]
+
+PRINTER_COLUMNS = [
+    {"name": "LATEST STATUS", "type": "string", "jsonPath": ".status.status"},
+    {"name": "SUCCESS CNT  ", "type": "string", "jsonPath": ".status.successCount"},
+    {"name": "FAIL CNT", "type": "string", "jsonPath": ".status.failedCount"},
+    {
+        "name": "REMEDY SUCCESS CNT  ",
+        "type": "string",
+        "jsonPath": ".status.remedySuccessCount",
+    },
+    {
+        "name": "REMEDY FAIL CNT",
+        "type": "string",
+        "jsonPath": ".status.remedyFailedCount",
+    },
+    {"name": "Age", "type": "date", "jsonPath": ".metadata.creationTimestamp"},
+]
+
+
+def _collapse_optionals(schema: Dict[str, Any]) -> Dict[str, Any]:
+    """Optional fields produce anyOf[{...}, {type: null}] — CRD schemas
+    want the plain type with the field simply not required."""
+
+    def collapse(node: Any) -> Any:
+        if isinstance(node, dict):
+            if "anyOf" in node:
+                non_null = [a for a in node["anyOf"] if a.get("type") != "null"]
+                if len(non_null) == 1:
+                    merged = {k: v for k, v in node.items() if k != "anyOf"}
+                    merged.update(non_null[0])
+                    return collapse(merged)
+            return {k: collapse(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [collapse(v) for v in node]
+        return node
+
+    return collapse(schema)
+
+
+def build_crd() -> Dict[str, Any]:
+    # keep anyOf through ref-inlining, then collapse the Optional pattern
+    spec_schema = HealthCheckSpec.model_json_schema(
+        by_alias=True, ref_template="#/$defs/{model}"
+    )
+    status_schema = HealthCheckStatus.model_json_schema(
+        by_alias=True, ref_template="#/$defs/{model}"
+    )
+
+    def finalize(raw: Dict[str, Any]) -> Dict[str, Any]:
+        defs = raw.pop("$defs", {})
+
+        def inline(node: Any) -> Any:
+            if isinstance(node, dict):
+                if "$ref" in node:
+                    name = node["$ref"].split("/")[-1]
+                    return inline(dict(defs[name]))
+                return {
+                    k: inline(v)
+                    for k, v in node.items()
+                    if k not in ("title", "default")
+                }
+            if isinstance(node, list):
+                return [inline(v) for v in node]
+            return node
+
+        return _collapse_optionals(inline(raw))
+
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": f"{KIND}List",
+                "plural": PLURAL,
+                "singular": SINGULAR,
+                "shortNames": SHORT_NAMES,
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": PRINTER_COLUMNS,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": finalize(spec_schema),
+                                "status": finalize(status_schema),
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def crd_yaml() -> str:
+    return yaml.safe_dump(build_crd(), sort_keys=False)

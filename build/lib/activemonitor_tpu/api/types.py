@@ -1,0 +1,316 @@
+"""HealthCheck API types.
+
+Field-for-field capability match of the reference CRD schema
+(reference: api/v1alpha1/healthcheck_types.go:32-151), expressed as
+pydantic models so specs validate on load (the reference relies on the
+generated OpenAPI schema in
+config/crd/bases/activemonitor.keikoproj.io_healthchecks.yaml for this).
+
+JSON field names (aliases) match the reference json tags exactly, so any
+YAML written for the reference loads unchanged.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from activemonitor_tpu import API_VERSION, KIND
+
+# Level values (reference: healthcheck_controller.go:62-63)
+LEVEL_CLUSTER = "cluster"
+LEVEL_NAMESPACE = "namespace"
+
+# Workflow type discriminators (reference: healthcheck_controller.go:60-61)
+WORKFLOW_TYPE_HEALTHCHECK = "healthCheck"
+WORKFLOW_TYPE_REMEDY = "remedy"
+
+# Terminal phases (reference: healthcheck_controller.go:58-59)
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+STATUS_STOPPED = "Stopped"
+
+
+class _Base(BaseModel):
+    """Common config: accept both pythonic names and JSON aliases."""
+
+    model_config = ConfigDict(populate_by_name=True, extra="ignore")
+
+    def to_json_dict(self) -> dict:
+        return self.model_dump(by_alias=True, exclude_none=True, exclude_defaults=True)
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+class PolicyRule(_Base):
+    """RBAC policy rule (mirror of rbacv1.PolicyRule as used by the
+    reference rbacRules fields; reference: healthcheck_types.go:101,113)."""
+
+    api_groups: List[str] = Field(default_factory=list, alias="apiGroups")
+    resources: List[str] = Field(default_factory=list, alias="resources")
+    verbs: List[str] = Field(default_factory=list, alias="verbs")
+    resource_names: List[str] = Field(default_factory=list, alias="resourceNames")
+    non_resource_urls: List[str] = Field(default_factory=list, alias="nonResourceURLs")
+
+
+class FileArtifact(_Base):
+    """Artifact on the local filesystem (reference: healthcheck_types.go:134-136).
+
+    The reference declares this field but never implements a reader
+    (store/store.go:15-21 returns "unknown artifact location"); this
+    framework implements it for real (see store/file.py).
+    """
+
+    path: str = ""
+
+
+class URLArtifact(_Base):
+    """Artifact at an HTTP(S) endpoint (reference: healthcheck_types.go:139-145).
+
+    verify_cert=None (omitted) or True verifies TLS certificates — the
+    secure default; only an explicit False disables verification.
+    """
+
+    path: str = ""
+    verify_cert: Optional[bool] = Field(default=None, alias="verifyCert")
+
+
+class ArtifactLocation(_Base):
+    """Source location of a workflow manifest (reference: healthcheck_types.go:127-131)."""
+
+    inline: Optional[str] = None
+    file: Optional[FileArtifact] = None
+    url: Optional[URLArtifact] = None
+
+
+class ResourceObject(_Base):
+    """The workflow resource to create (reference: healthcheck_types.go:117-124)."""
+
+    namespace: str = ""
+    service_account: str = Field(default="", alias="serviceAccount")
+    source: ArtifactLocation = Field(default_factory=ArtifactLocation)
+
+
+class TPUPlacement(_Base):
+    """TPU slice placement for the probe workload (extension; no
+    counterpart in the reference — SURVEY.md §7.7: the controller
+    injects TPU node selectors the way podGC is injected today).
+
+    Maps onto the GKE TPU scheduling contract: nodeSelector
+    ``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology`` and
+    the ``google.com/tpu`` chip resource on probe containers.
+    """
+
+    accelerator: str = ""  # e.g. "tpu-v5-lite-podslice"
+    topology: str = ""  # e.g. "2x4"
+    chips: int = 0  # google.com/tpu resource per probe pod
+
+
+class Workflow(_Base):
+    """Describes the probe workflow (reference: healthcheck_types.go:109-114)."""
+
+    generate_name: str = Field(default="", alias="generateName")
+    resource: Optional[ResourceObject] = None
+    timeout: int = Field(default=0, alias="workflowtimeout")
+    rbac_rules: List[PolicyRule] = Field(default_factory=list, alias="rbacRules")
+    tpu: Optional[TPUPlacement] = None
+
+
+class RemedyWorkflow(Workflow):
+    """Describes the self-healing workflow (reference: healthcheck_types.go:97-106).
+
+    Same schema as Workflow; only the emptiness test differs.
+    """
+
+    def is_empty(self) -> bool:
+        """True when no remedy is configured (reference: healthcheck_types.go:104-106)."""
+        return self == RemedyWorkflow()
+
+
+class ScheduleSpec(_Base):
+    """Cron schedule (reference: healthcheck_types.go:148-151).
+
+    Accepts robfig/cron standard expressions: 5-field cron,
+    @hourly/@daily/@weekly/@monthly/@yearly descriptors, and
+    "@every <duration>".
+    """
+
+    cron: str = ""
+
+
+class HealthCheckSpec(_Base):
+    """Desired state (reference: healthcheck_types.go:32-44).
+
+    Either repeat_after_sec or schedule.cron must be set for the check
+    to run; neither set ⇒ the check is paused ("Stopped").
+    """
+
+    repeat_after_sec: int = Field(default=0, alias="repeatAfterSec")
+    description: str = ""
+    workflow: Workflow = Field(default_factory=Workflow)
+    level: str = ""  # "namespace" | "cluster"
+    schedule: ScheduleSpec = Field(default_factory=ScheduleSpec)
+    remedy_workflow: RemedyWorkflow = Field(
+        default_factory=RemedyWorkflow, alias="remedyworkflow"
+    )
+    backoff_factor: str = Field(default="", alias="backoffFactor")
+    backoff_max: int = Field(default=0, alias="backoffMax")
+    backoff_min: int = Field(default=0, alias="backoffMin")
+    remedy_runs_limit: int = Field(default=0, alias="remedyRunsLimit")
+    remedy_reset_interval: int = Field(default=0, alias="remedyResetInterval")
+
+
+class HealthCheckStatus(_Base):
+    """Observed state — the durable checkpoint of the framework
+    (reference: healthcheck_types.go:47-66; checkpoint/resume semantics
+    per SURVEY.md §5.4: all durable state lives here, in-memory timers
+    are rebuilt idempotently from finished_at on boot)."""
+
+    error_message: str = Field(default="", alias="errorMessage")
+    remedy_error_message: str = Field(default="", alias="remedyErrorMessage")
+    started_at: Optional[datetime.datetime] = Field(default=None, alias="startedAt")
+    finished_at: Optional[datetime.datetime] = Field(default=None, alias="finishedAt")
+    last_failed_at: Optional[datetime.datetime] = Field(default=None, alias="lastFailedAt")
+    # NB: the reference serializes RemedyStartedAt under json tag
+    # "remedyTriggeredAt" (healthcheck_types.go:53) — kept for parity.
+    remedy_started_at: Optional[datetime.datetime] = Field(
+        default=None, alias="remedyTriggeredAt"
+    )
+    remedy_finished_at: Optional[datetime.datetime] = Field(
+        default=None, alias="remedyFinishedAt"
+    )
+    remedy_last_failed_at: Optional[datetime.datetime] = Field(
+        default=None, alias="remedyLastFailedAt"
+    )
+    last_failed_workflow: str = Field(default="", alias="lastFailedWorkflow")
+    last_successful_workflow: str = Field(default="", alias="lastSuccessfulWorkflow")
+    success_count: int = Field(default=0, alias="successCount")
+    failed_count: int = Field(default=0, alias="failedCount")
+    remedy_success_count: int = Field(default=0, alias="remedySuccessCount")
+    remedy_failed_count: int = Field(default=0, alias="remedyFailedCount")
+    remedy_total_runs: int = Field(default=0, alias="remedyTotalRuns")
+    total_healthcheck_runs: int = Field(default=0, alias="totalHealthCheckRuns")
+    status: str = ""
+    remedy_status: str = Field(default="", alias="remedyStatus")
+
+    def reset_remedy(self, reason: str) -> None:
+        """Zero all remedy bookkeeping (reference: healthcheck_controller.go:649-660,695-703)."""
+        self.remedy_total_runs = 0
+        self.remedy_finished_at = None
+        self.remedy_started_at = None
+        self.remedy_failed_count = 0
+        self.remedy_success_count = 0
+        self.remedy_last_failed_at = None
+        self.remedy_status = reason
+
+
+class OwnerReference(_Base):
+    """Owner reference enabling GC of workflows on HealthCheck delete
+    (reference: healthcheck_controller.go:512-522)."""
+
+    api_version: str = Field(default="", alias="apiVersion")
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+
+
+class ObjectMeta(_Base):
+    """Subset of k8s ObjectMeta used by the framework."""
+
+    name: str = ""
+    generate_name: str = Field(default="", alias="generateName")
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = Field(default="", alias="resourceVersion")
+    creation_timestamp: Optional[datetime.datetime] = Field(
+        default=None, alias="creationTimestamp"
+    )
+    deletion_timestamp: Optional[datetime.datetime] = Field(
+        default=None, alias="deletionTimestamp"
+    )
+    labels: dict = Field(default_factory=dict)
+    annotations: dict = Field(default_factory=dict)
+    owner_references: List[OwnerReference] = Field(
+        default_factory=list, alias="ownerReferences"
+    )
+
+
+class HealthCheck(_Base):
+    """The HealthCheck resource (reference: healthcheck_types.go:79-85).
+
+    Printer-column equivalents (reference: healthcheck_types.go:71-76)
+    are exposed via :meth:`printer_row`; short names ``hc``/``hcs``
+    are honored by the CLI.
+    """
+
+    api_version: str = Field(default=API_VERSION, alias="apiVersion")
+    kind: str = KIND
+    metadata: ObjectMeta = Field(default_factory=ObjectMeta)
+    spec: HealthCheckSpec = Field(default_factory=HealthCheckSpec)
+    status: HealthCheckStatus = Field(default_factory=HealthCheckStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def key(self) -> str:
+        """namespace/name key used by the work queue and timer wheel."""
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "HealthCheck":
+        return cls.model_validate(data)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "HealthCheck":
+        import yaml
+
+        return cls.from_dict(yaml.safe_load(text))
+
+    def to_dict(self) -> dict:
+        # apiVersion/kind equal their defaults, so omitempty-style dumping
+        # would drop them — but a manifest without them is not applyable.
+        # They lead the dict, kubectl-style.
+        d = {"apiVersion": self.api_version, "kind": self.kind}
+        d.update(self.to_json_dict())
+        return d
+
+    def deepcopy(self) -> "HealthCheck":
+        """Equivalent of the generated DeepCopy (reference: zz_generated.deepcopy.go)."""
+        return self.model_copy(deep=True)
+
+    def printer_row(self) -> dict:
+        """Columns of `kubectl get hc` (reference: healthcheck_types.go:71-76)."""
+        age: Any = ""
+        if self.metadata.creation_timestamp is not None:
+            created = self.metadata.creation_timestamp
+            if created.tzinfo is None:
+                created = created.replace(tzinfo=datetime.timezone.utc)
+            age = _utcnow() - created
+        return {
+            "NAME": self.metadata.name,
+            "LATEST STATUS": self.status.status,
+            "SUCCESS CNT": self.status.success_count,
+            "FAIL CNT": self.status.failed_count,
+            "REMEDY SUCCESS CNT": self.status.remedy_success_count,
+            "REMEDY FAIL CNT": self.status.remedy_failed_count,
+            "AGE": age,
+        }
+
+
+class HealthCheckList(_Base):
+    """List of HealthChecks (reference: healthcheck_types.go:90-94)."""
+
+    api_version: str = Field(default=API_VERSION, alias="apiVersion")
+    kind: str = "HealthCheckList"
+    items: List[HealthCheck] = Field(default_factory=list)

@@ -1,0 +1,65 @@
+"""Controller: reconciler state machine, clients, RBAC, events."""
+
+from activemonitor_tpu.controller.client import (
+    ConflictError,
+    HealthCheckClient,
+    InMemoryHealthCheckClient,
+    NotFoundError,
+    WatchEvent,
+    retry_on_conflict,
+)
+from activemonitor_tpu.controller.events import (
+    EVENT_NORMAL,
+    EVENT_WARNING,
+    Event,
+    EventRecorder,
+)
+from activemonitor_tpu.controller.rbac import (
+    DEFAULT_HEALTHCHECK_RULES,
+    DEFAULT_REMEDY_RULES,
+    InMemoryRBACBackend,
+    KubernetesRBACBackend,
+    MANAGED_BY_LABEL_KEY,
+    MANAGED_BY_VALUE,
+    RBACError,
+    RBACObject,
+    RBACProvisioner,
+    resolve_rbac_rules,
+)
+from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+from activemonitor_tpu.controller.workflow_spec import (
+    WF_INSTANCE_ID,
+    WF_INSTANCE_ID_LABEL_KEY,
+    WorkflowSpecError,
+    parse_remedy_workflow_from_healthcheck,
+    parse_workflow_from_healthcheck,
+)
+
+__all__ = [
+    "ConflictError",
+    "DEFAULT_HEALTHCHECK_RULES",
+    "DEFAULT_REMEDY_RULES",
+    "EVENT_NORMAL",
+    "EVENT_WARNING",
+    "Event",
+    "EventRecorder",
+    "HealthCheckClient",
+    "HealthCheckReconciler",
+    "InMemoryHealthCheckClient",
+    "InMemoryRBACBackend",
+    "KubernetesRBACBackend",
+    "MANAGED_BY_LABEL_KEY",
+    "MANAGED_BY_VALUE",
+    "NotFoundError",
+    "RBACError",
+    "RBACObject",
+    "RBACProvisioner",
+    "WF_INSTANCE_ID",
+    "WF_INSTANCE_ID_LABEL_KEY",
+    "WatchEvent",
+    "WorkflowSpecError",
+    "parse_remedy_workflow_from_healthcheck",
+    "parse_workflow_from_healthcheck",
+    "resolve_rbac_rules",
+    "retry_on_conflict",
+]

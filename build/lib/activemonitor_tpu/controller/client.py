@@ -1,0 +1,176 @@
+"""HealthCheck resource clients.
+
+The reconciler reads/writes HealthCheck objects through this small
+interface — backed by etcd via the API server in cluster mode, or by an
+in-memory conflict-simulating store everywhere else (the controller
+equivalent of the reference's envtest setup, SURVEY.md §4).
+
+Status is a subresource: ``update_status`` writes only ``.status`` and
+participates in optimistic concurrency via resourceVersion, so the
+conflict-retry discipline of the reference
+(reference: healthcheck_controller.go:208-215,1445-1462) is testable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import itertools
+from dataclasses import dataclass
+from typing import AsyncIterator, Dict, List, Optional, Protocol
+
+from activemonitor_tpu.api.types import HealthCheck
+
+
+class ConflictError(Exception):
+    """resourceVersion mismatch on write."""
+
+
+class NotFoundError(Exception):
+    """Object does not exist (the reference treats these as storage
+    errors to swallow for already-deleted resources,
+    healthcheck_controller.go:201-203,1473-1478)."""
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    namespace: str
+    name: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class HealthCheckClient(Protocol):
+    async def get(self, namespace: str, name: str) -> Optional[HealthCheck]: ...
+
+    async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]: ...
+
+    async def apply(self, hc: HealthCheck) -> HealthCheck: ...
+
+    async def update_status(self, hc: HealthCheck) -> HealthCheck: ...
+
+    async def delete(self, namespace: str, name: str) -> None: ...
+
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """MUST register/baseline synchronously at call time; the manager
+        calls watch() before its boot-resync list so nothing is lost."""
+        ...
+
+
+async def retry_on_conflict(fn, *, attempts: int = 5, base_delay: float = 0.01):
+    """Conflict-retry with jittered backoff, the RetryOnConflict shape
+    (reference: healthcheck_controller.go:208-215)."""
+    last: Exception | None = None
+    for i in range(attempts):
+        try:
+            return await fn()
+        except ConflictError as e:
+            last = e
+            if i + 1 < attempts:  # no pointless sleep after the final try
+                await asyncio.sleep(base_delay * (2**i))
+    raise last  # type: ignore[misc]
+
+
+class InMemoryHealthCheckClient:
+    """In-memory store with resourceVersion CAS and watch events."""
+
+    def __init__(self):
+        self._objects: Dict[str, HealthCheck] = {}
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        self._watchers: List[asyncio.Queue] = []
+        self._force_conflicts = 0  # test hook: fail next N status updates
+
+    # -- test hooks ----------------------------------------------------
+    def force_conflicts(self, n: int) -> None:
+        self._force_conflicts = n
+
+    # -- CRUD ----------------------------------------------------------
+    async def get(self, namespace: str, name: str) -> Optional[HealthCheck]:
+        hc = self._objects.get(f"{namespace}/{name}")
+        return hc.deepcopy() if hc is not None else None
+
+    async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]:
+        return [
+            hc.deepcopy()
+            for key, hc in sorted(self._objects.items())
+            if namespace is None or hc.metadata.namespace == namespace
+        ]
+
+    async def apply(self, hc: HealthCheck) -> HealthCheck:
+        """Create or update the spec (not status), like kubectl apply."""
+        hc = hc.deepcopy()
+        if not hc.metadata.name:
+            from activemonitor_tpu.engine.base import generate_name
+
+            hc.metadata.name = generate_name(hc.metadata.generate_name or "hc-")
+        key = hc.key
+        existing = self._objects.get(key)
+        if existing is None:
+            hc.metadata.uid = f"uid-{next(self._uid)}"
+            hc.metadata.creation_timestamp = datetime.datetime.now(
+                datetime.timezone.utc
+            )
+            hc.metadata.resource_version = str(next(self._rv))
+            self._objects[key] = hc.deepcopy()
+            self._notify("ADDED", hc)
+        else:
+            existing.spec = hc.spec
+            existing.metadata.labels = hc.metadata.labels
+            existing.metadata.annotations = hc.metadata.annotations
+            existing.metadata.resource_version = str(next(self._rv))
+            hc = existing.deepcopy()
+            self._notify("MODIFIED", hc)
+        return hc.deepcopy()
+
+    async def update_status(self, hc: HealthCheck) -> HealthCheck:
+        key = hc.key
+        existing = self._objects.get(key)
+        if existing is None:
+            raise NotFoundError(key)
+        if self._force_conflicts > 0:
+            self._force_conflicts -= 1
+            raise ConflictError(key)
+        if (
+            hc.metadata.resource_version
+            and hc.metadata.resource_version != existing.metadata.resource_version
+        ):
+            raise ConflictError(
+                f"{key}: rv {hc.metadata.resource_version} != {existing.metadata.resource_version}"
+            )
+        existing.status = hc.status.model_copy(deep=True)
+        existing.metadata.resource_version = str(next(self._rv))
+        self._notify("MODIFIED", existing)
+        return existing.deepcopy()
+
+    async def delete(self, namespace: str, name: str) -> None:
+        hc = self._objects.pop(f"{namespace}/{name}", None)
+        if hc is None:
+            raise NotFoundError(f"{namespace}/{name}")
+        self._notify("DELETED", hc)
+
+    # -- watch ---------------------------------------------------------
+    def _notify(self, type_: str, hc: HealthCheck) -> None:
+        ev = WatchEvent(type=type_, namespace=hc.metadata.namespace, name=hc.metadata.name)
+        for q in self._watchers:
+            q.put_nowait(ev)
+
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """Registers the subscription SYNCHRONOUSLY (at call time, not at
+        first iteration) so no event can fall between creating the watch
+        and a subsequent list — the list-then-watch ordering the manager
+        relies on."""
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(q)
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                self._watchers.remove(q)
+
+        return gen()

@@ -1,0 +1,219 @@
+"""File-backed HealthCheck client — the durable local-mode store.
+
+Single-host deployments (a TPU VM with no Kubernetes) keep HealthCheck
+specs as YAML files in a directory; the controller watches the
+directory the way it would watch the API server. Status lives in a
+sidecar JSON per check, preserving the reference's checkpoint semantics
+(SURVEY.md §5.4: durable state only in the CR status; timers rebuilt
+from ``finishedAt`` on boot) across controller restarts.
+
+Layout::
+
+    <dir>/<anything>.yaml          # HealthCheck manifests (user-owned)
+    <dir>/.status/<ns>__<name>.json  # status subresource (controller-owned)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from pathlib import Path
+from typing import AsyncIterator, Dict, List, Optional
+
+import yaml
+
+from activemonitor_tpu.api.types import HealthCheck, HealthCheckStatus
+from activemonitor_tpu.controller.client import ConflictError, NotFoundError, WatchEvent
+
+log = logging.getLogger(__name__)
+
+
+class FileHealthCheckClient:
+    def __init__(self, directory: str, poll_seconds: float = 0.5):
+        self._dir = Path(directory)
+        self._status_dir = self._dir / ".status"
+        self._status_dir.mkdir(parents=True, exist_ok=True)
+        self._poll = poll_seconds
+        self._rv = 0
+
+    # -- loading --------------------------------------------------------
+    def _status_path(self, namespace: str, name: str) -> Path:
+        return self._status_dir / f"{namespace}__{name}.json"
+
+    def _load_all(self) -> Dict[str, HealthCheck]:
+        out: Dict[str, HealthCheck] = {}
+        for path in sorted(self._dir.glob("*.yaml")) + sorted(self._dir.glob("*.yml")):
+            try:
+                docs = list(yaml.safe_load_all(path.read_text()))
+            except yaml.YAMLError as e:
+                log.error("%s: invalid YAML skipped: %s", path, e)
+                continue
+            for doc in docs:
+                if not isinstance(doc, dict) or doc.get("kind") != "HealthCheck":
+                    continue
+                try:
+                    hc = HealthCheck.from_dict(doc)
+                except Exception as e:
+                    # one invalid check must not take down the store
+                    log.error(
+                        "%s: invalid HealthCheck %r skipped: %s",
+                        path,
+                        doc.get("metadata", {}).get("name"),
+                        e,
+                    )
+                    continue
+                if not hc.metadata.name:
+                    log.warning("%s: HealthCheck without metadata.name skipped", path)
+                    continue
+                if not hc.metadata.namespace:
+                    hc.metadata.namespace = "default"
+                if not hc.metadata.uid:
+                    hc.metadata.uid = f"file-{hc.key}"
+                self._merge_status(hc)
+                out[hc.key] = hc
+        return out
+
+    def _merge_status(self, hc: HealthCheck) -> None:
+        path = self._status_path(hc.metadata.namespace, hc.metadata.name)
+        if path.exists():
+            try:
+                doc = json.loads(path.read_text())
+                hc.status = HealthCheckStatus.model_validate(doc.get("status", {}))
+                hc.metadata.resource_version = str(doc.get("resourceVersion", ""))
+            except (json.JSONDecodeError, ValueError) as e:
+                log.error("%s: corrupt status sidecar ignored: %s", path, e)
+
+    # -- client API -------------------------------------------------------
+    async def get(self, namespace: str, name: str) -> Optional[HealthCheck]:
+        return self._load_all().get(f"{namespace}/{name}")
+
+    async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]:
+        return [
+            hc
+            for key, hc in sorted(self._load_all().items())
+            if namespace is None or hc.metadata.namespace == namespace
+        ]
+
+    async def apply(self, hc: HealthCheck) -> HealthCheck:
+        hc = hc.deepcopy()
+        if not hc.metadata.namespace:
+            hc.metadata.namespace = "default"
+        if not hc.metadata.name:
+            from activemonitor_tpu.engine.base import generate_name
+
+            hc.metadata.name = generate_name(hc.metadata.generate_name or "hc-")
+        doc = hc.to_dict()
+        doc.pop("status", None)  # status lives in the sidecar
+        # update in place if the check already lives in a user-named
+        # file: writing a second copy elsewhere would leave the
+        # alphabetically-later (possibly stale) doc winning _load_all
+        if self._rewrite_in_place(hc.metadata.namespace, hc.metadata.name, doc):
+            return hc
+        path = self._dir / f"{hc.metadata.namespace}__{hc.metadata.name}.yaml"
+        path.write_text(yaml.safe_dump(doc, sort_keys=False))
+        return hc
+
+    def _rewrite_in_place(self, namespace: str, name: str, new_doc: dict) -> bool:
+        for path in list(self._dir.glob("*.yaml")) + list(self._dir.glob("*.yml")):
+            try:
+                docs = list(yaml.safe_load_all(path.read_text()))
+            except yaml.YAMLError:
+                continue
+            replaced = False
+            for i, doc in enumerate(docs):
+                if (
+                    isinstance(doc, dict)
+                    and doc.get("kind") == "HealthCheck"
+                    and doc.get("metadata", {}).get("name") == name
+                    and doc.get("metadata", {}).get("namespace", "default") == namespace
+                ):
+                    docs[i] = new_doc
+                    replaced = True
+            if replaced:
+                path.write_text(yaml.safe_dump_all(docs, sort_keys=False))
+                return True
+        return False
+
+    async def update_status(self, hc: HealthCheck) -> HealthCheck:
+        existing = await self.get(hc.metadata.namespace, hc.metadata.name)
+        if existing is None:
+            raise NotFoundError(hc.key)
+        if (
+            hc.metadata.resource_version
+            and existing.metadata.resource_version
+            and hc.metadata.resource_version != existing.metadata.resource_version
+        ):
+            raise ConflictError(hc.key)
+        self._rv += 1
+        payload = {
+            "status": hc.status.to_json_dict(),
+            "resourceVersion": str(self._rv),
+        }
+        self._status_path(hc.metadata.namespace, hc.metadata.name).write_text(
+            json.dumps(payload, default=str)
+        )
+        hc = hc.deepcopy()
+        hc.metadata.resource_version = str(self._rv)
+        return hc
+
+    async def delete(self, namespace: str, name: str) -> None:
+        found = False
+        for path in list(self._dir.glob("*.yaml")) + list(self._dir.glob("*.yml")):
+            try:
+                docs = list(yaml.safe_load_all(path.read_text()))
+            except yaml.YAMLError:
+                continue
+            keep = [
+                d
+                for d in docs
+                if not (
+                    isinstance(d, dict)
+                    and d.get("kind") == "HealthCheck"
+                    and d.get("metadata", {}).get("name") == name
+                    and d.get("metadata", {}).get("namespace", "default") == namespace
+                )
+            ]
+            if len(keep) != len(docs):
+                found = True
+                if keep:
+                    path.write_text(yaml.safe_dump_all(keep, sort_keys=False))
+                else:
+                    path.unlink()
+        status = self._status_path(namespace, name)
+        if status.exists():
+            status.unlink()
+        if not found:
+            raise NotFoundError(f"{namespace}/{name}")
+
+    # -- watch --------------------------------------------------------------
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """Poll the directory; emits ADDED/MODIFIED (spec change)/DELETED.
+
+        The baseline snapshot is taken SYNCHRONOUSLY at call time: specs
+        existing now are the manager's boot-resync job; anything that
+        changes after this call is a watch event — no gap between the
+        two (list-then-watch ordering)."""
+        known: Dict[str, dict] = {
+            k: hc.spec.to_json_dict() for k, hc in self._load_all().items()
+        }
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            nonlocal known
+            while True:
+                await asyncio.sleep(self._poll)
+                current = self._load_all()
+                specs = {k: hc.spec.to_json_dict() for k, hc in current.items()}
+                for key in specs.keys() - known.keys():
+                    ns, _, name = key.partition("/")
+                    yield WatchEvent(type="ADDED", namespace=ns, name=name)
+                for key in known.keys() - specs.keys():
+                    ns, _, name = key.partition("/")
+                    yield WatchEvent(type="DELETED", namespace=ns, name=name)
+                for key in specs.keys() & known.keys():
+                    if specs[key] != known[key]:
+                        ns, _, name = key.partition("/")
+                        yield WatchEvent(type="MODIFIED", namespace=ns, name=name)
+                known = specs
+
+        return gen()

@@ -1,0 +1,215 @@
+"""Kubernetes-backed HealthCheck client — cluster mode.
+
+Watches HealthCheck CRs through the API server exactly as the reference
+controller does (reference: cached client + status subresource writes,
+healthcheck_controller.go:175,208-215,1445-1462), built on the
+framework's own REST layer (:mod:`activemonitor_tpu.kube`) — fully
+async, no threads, no dependency on the ``kubernetes`` package.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, List, Optional
+
+from activemonitor_tpu import GROUP, VERSION
+from activemonitor_tpu.api.types import HealthCheck
+from activemonitor_tpu.controller.client import (
+    ConflictError,
+    NotFoundError,
+    WatchEvent,
+)
+from activemonitor_tpu.kube import ApiError, KubeApi, api_path
+
+log = logging.getLogger(__name__)
+
+PLURAL = "healthchecks"
+
+
+class KubernetesHealthCheckClient:
+    def __init__(self, api: Optional[KubeApi] = None):
+        self._api = api if api is not None else KubeApi.from_default_config()
+
+    async def get(self, namespace: str, name: str) -> Optional[HealthCheck]:
+        try:
+            obj = await self._api.get(api_path(GROUP, VERSION, PLURAL, namespace, name))
+        except ApiError as e:
+            if e.not_found:
+                return None
+            raise
+        return HealthCheck.from_dict(obj)
+
+    async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]:
+        raw = await self._api.get(api_path(GROUP, VERSION, PLURAL, namespace or ""))
+        return [HealthCheck.from_dict(item) for item in raw.get("items", [])]
+
+    async def apply(self, hc: HealthCheck) -> HealthCheck:
+        """Create, or update an existing object. The spec is replaced
+        wholesale (fields removed from the manifest disappear — a
+        deleted ``remedyworkflow`` stops running), while labels and
+        annotations are merged additively (keys owned by other tools
+        are never deleted; full kubectl-apply three-way semantics would
+        need last-applied tracking). Status is a subresource, untouched
+        by this write."""
+        body = hc.to_dict()
+        body.pop("status", None)
+        # an empty namespace would target the cluster-wide collection
+        # path, which a real API server rejects for namespaced CRs —
+        # default it like kubectl does
+        namespace = hc.metadata.namespace or "default"
+        body.setdefault("metadata", {})["namespace"] = namespace
+        obj_path = api_path(GROUP, VERSION, PLURAL, namespace, hc.metadata.name)
+        for attempt in range(5):
+            if attempt:
+                # bounded, backed-off retries: a webhook mutating every
+                # write must not turn this loop into an API-server DoS
+                await asyncio.sleep(0.05 * 2**attempt)
+            try:
+                created = await self._api.create(
+                    api_path(GROUP, VERSION, PLURAL, namespace), body
+                )
+                break
+            except ApiError as e:
+                if not e.conflict:
+                    raise
+            try:
+                existing = await self._api.get(obj_path)
+            except ApiError as e:
+                if e.not_found:
+                    continue  # deleted between the 409 and here: recreate
+                raise
+            existing["spec"] = body.get("spec", {})
+            meta = existing.setdefault("metadata", {})
+            for key in ("labels", "annotations"):
+                incoming = body.get("metadata", {}).get(key)
+                if incoming:
+                    merged = dict(meta.get(key) or {})
+                    merged.update(incoming)
+                    meta[key] = merged
+            try:
+                # the PUT carries the resourceVersion just read, so a
+                # concurrent writer turns this into a 409 and we retry
+                created = await self._api.replace(obj_path, existing)
+                break
+            except ApiError as e:
+                if not e.conflict and not e.not_found:
+                    raise
+        else:
+            raise ConflictError(hc.key)
+        return HealthCheck.from_dict(created)
+
+    async def update_status(self, hc: HealthCheck) -> HealthCheck:
+        body = {
+            "metadata": {"resourceVersion": hc.metadata.resource_version or None},
+            "status": hc.status.to_json_dict(),
+        }
+        try:
+            updated = await self._api.merge_patch(
+                api_path(
+                    GROUP, VERSION, PLURAL, hc.metadata.namespace, hc.metadata.name,
+                    subresource="status",
+                ),
+                body,
+            )
+        except ApiError as e:
+            if e.conflict:
+                raise ConflictError(hc.key) from e
+            if e.not_found:
+                raise NotFoundError(hc.key) from e
+            raise
+        return HealthCheck.from_dict(updated)
+
+    async def delete(self, namespace: str, name: str) -> None:
+        try:
+            await self._api.delete(api_path(GROUP, VERSION, PLURAL, namespace, name))
+        except ApiError as e:
+            if e.not_found:
+                raise NotFoundError(f"{namespace}/{name}") from e
+            raise
+
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        """All-namespaces watch with automatic reconnect.
+
+        The server sends synthetic ADDED events for the existing state
+        when a watch starts without a resourceVersion, and the manager
+        boot-resyncs via list() right after — so events cannot fall into
+        the registration gap. On stream loss we resume from the last
+        seen resourceVersion. On 410 Gone (the gap outlived etcd's
+        compaction window) the restart-from-scratch ADDEDs cover
+        additions and updates but NOT objects deleted during the gap —
+        so we list and synthesize DELETED for every key that vanished,
+        otherwise their timers would keep firing spurious runs."""
+        path = api_path(GROUP, VERSION, PLURAL)
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            resource_version = ""
+            known: set = set()  # (namespace, name) seen alive on this stream
+            while True:
+                try:
+                    async for event in self._api.watch(
+                        path, resource_version=resource_version
+                    ):
+                        obj = event.get("object", {}) or {}
+                        meta = obj.get("metadata", {}) or {}
+                        if meta.get("resourceVersion"):
+                            resource_version = meta["resourceVersion"]
+                        if event.get("type") == "BOOKMARK":
+                            continue  # rv bookkeeping only, nothing changed
+                        key = (meta.get("namespace", ""), meta.get("name", ""))
+                        if event.get("type") == "DELETED":
+                            known.discard(key)
+                        else:
+                            known.add(key)
+                        yield WatchEvent(
+                            type=event.get("type", "MODIFIED"),
+                            namespace=key[0],
+                            name=key[1],
+                        )
+                except ApiError as e:
+                    if e.status == 410:
+                        log.info("watch expired (410); re-listing from scratch")
+                        resource_version = ""
+                        for ns, name in await self._vanished(known):
+                            known.discard((ns, name))
+                            yield WatchEvent(type="DELETED", namespace=ns, name=name)
+                    else:
+                        log.warning("watch broke (%s); re-establishing", e)
+                        await asyncio.sleep(1.0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("watch stream broke; re-establishing")
+                    await asyncio.sleep(1.0)
+
+        return gen()
+
+    async def _vanished(self, known: set) -> list:
+        """Keys in ``known`` that no longer exist on the server (the
+        deletions a 410 gap swallowed). The list is retried with
+        backoff — it is the ONLY path that recovers those deletions
+        (another 410 may never come), so giving up after one attempt
+        would leave deleted checks' schedules firing forever."""
+        raw = None
+        for attempt in range(6):
+            if attempt:
+                await asyncio.sleep(min(0.2 * 2**attempt, 5.0))
+            try:
+                raw = await self._api.get(api_path(GROUP, VERSION, PLURAL))
+                break
+            except Exception:
+                continue
+        if raw is None:
+            log.error(
+                "post-410 re-list failed repeatedly; deletions during the "
+                "watch gap will only be noticed on the next 410/restart"
+            )
+            return []
+        current = {
+            (
+                item.get("metadata", {}).get("namespace", ""),
+                item.get("metadata", {}).get("name", ""),
+            )
+            for item in raw.get("items", [])
+        }
+        return sorted(known - current)

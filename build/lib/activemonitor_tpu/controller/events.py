@@ -1,0 +1,220 @@
+"""Event recording.
+
+The reference emits a Kubernetes Event on every significant transition
+(~40 call sites; reference: healthcheck_controller.go:135 recorder,
+SURVEY.md §5.5). Here events always land in structured logs and an
+in-memory ring (queryable by tests and the CLI); a Kubernetes-backed
+recorder can wrap this one in cluster mode.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import logging
+from dataclasses import dataclass, field
+from typing import Deque, List
+
+from activemonitor_tpu.api.types import HealthCheck
+
+log = logging.getLogger("activemonitor.events")
+
+EVENT_NORMAL = "Normal"
+EVENT_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    type: str
+    reason: str
+    message: str
+    namespace: str
+    name: str
+    timestamp: datetime.datetime = field(
+        default_factory=lambda: datetime.datetime.now(datetime.timezone.utc)
+    )
+
+
+class EventRecorder:
+    def __init__(self, capacity: int = 1000):
+        self._events: Deque[Event] = collections.deque(maxlen=capacity)
+
+    def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        ev = Event(
+            type=type_,
+            reason=reason,
+            message=message,
+            namespace=hc.metadata.namespace,
+            name=hc.metadata.name,
+        )
+        self._events.append(ev)
+        level = logging.WARNING if type_ == EVENT_WARNING else logging.INFO
+        log.log(level, "%s/%s: %s: %s", ev.namespace, ev.name, reason, message)
+
+    def events_for(self, namespace: str, name: str) -> List[Event]:
+        return [e for e in self._events if e.namespace == namespace and e.name == name]
+
+    @property
+    def all(self) -> List[Event]:
+        return list(self._events)
+
+    def close(self) -> None:
+        """Release any transport resources (no-op for the in-memory ring)."""
+
+
+class FileEventRecorder(EventRecorder):
+    """Also appends events to JSONL sidecars under ``<dir>/.events/`` so
+    the ``describe`` CLI (a separate process) can show a check's recent
+    history — the local-mode analogue of Events in ``kubectl describe``.
+    Files are capped by line count to bound disk use."""
+
+    def __init__(self, directory: str, capacity: int = 1000, max_lines: int = 200):
+        super().__init__(capacity=capacity)
+        import pathlib
+
+        self._dir = pathlib.Path(directory) / ".events"
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._max_lines = max_lines
+        # we are the only writer: line counts are cached so the steady
+        # state is a pure append — the file is re-read only when the
+        # cached count hits the cap (then trimmed in one rewrite)
+        self._line_counts: dict = {}
+
+    def _path(self, namespace: str, name: str):
+        return self._dir / f"{namespace}__{name}.jsonl"
+
+    def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        super().event(hc, type_, reason, message)
+        import json
+
+        path = self._path(hc.metadata.namespace or "default", hc.metadata.name)
+        line = json.dumps(
+            {
+                "time": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                "type": type_,
+                "reason": reason,
+                "message": message,
+            }
+        )
+        try:
+            count = self._line_counts.get(path)
+            if count is None:
+                count = len(path.read_text().splitlines()) if path.exists() else 0
+            if count >= self._max_lines:
+                # trim to a low watermark so the cap is hit (and the
+                # file rewritten) once per max_lines/2 events, not on
+                # every append thereafter
+                keep = self._max_lines // 2
+                lines = path.read_text().splitlines()[-keep:]
+                path.write_text("\n".join(lines) + "\n")
+                count = len(lines)
+            with path.open("a") as f:
+                f.write(line + "\n")
+            self._line_counts[path] = count + 1
+        except OSError:
+            log.exception("failed to persist event for %s", hc.key)
+
+    @staticmethod
+    def read_events(directory: str, namespace: str, name: str) -> List[dict]:
+        import json
+        import pathlib
+
+        path = pathlib.Path(directory) / ".events" / f"{namespace}__{name}.jsonl"
+        if not path.exists():
+            return []
+        out = []
+        for line in path.read_text().splitlines():
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+
+class KubernetesEventRecorder(EventRecorder):
+    """Also posts core/v1 Events against the HealthCheck object, like the
+    reference's record.EventRecorder (reference: healthcheck_controller.go:135,
+    ~40 call sites). Built on the native REST layer; failures to post are
+    logged, never raised — events are best-effort."""
+
+    def __init__(self, api=None, component: str = "active-monitor-tpu"):
+        super().__init__()
+        if api is None:
+            from activemonitor_tpu.kube import KubeApi
+
+            api = KubeApi.from_default_config()
+        self._api = api
+        self._component = component
+        # posts are serialized through a bounded queue drained by one
+        # task: recorder.event() is a sync call on async reconcile paths
+        # and must never block on the API server
+        import asyncio
+
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self._worker: asyncio.Task | None = None
+
+    def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        super().event(hc, type_, reason, message)
+        import asyncio
+        import uuid
+
+        namespace = hc.metadata.namespace or "default"
+        now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        body = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{hc.metadata.name}.{uuid.uuid4().hex[:12]}",
+                "namespace": namespace,
+            },
+            "involvedObject": {
+                "apiVersion": hc.api_version,
+                "kind": hc.kind,
+                "name": hc.metadata.name,
+                "namespace": namespace,  # must match the event's namespace
+                "uid": hc.metadata.uid or None,
+            },
+            "reason": reason,
+            "message": message,
+            "type": type_,
+            "source": {"component": self._component},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        try:
+            self._queue.put_nowait((namespace, body, hc.key))
+        except asyncio.QueueFull:
+            log.warning("event queue full; dropping event for %s", hc.key)
+            return
+        if self._worker is None or self._worker.done():
+            try:
+                self._worker = asyncio.get_running_loop().create_task(self._drain())
+            except RuntimeError:
+                pass  # no loop (sync CLI context) — events stay local
+
+    async def _drain(self) -> None:
+        from activemonitor_tpu.kube import core_path
+
+        while True:
+            namespace, body, key = await self._queue.get()
+            try:
+                await self._api.request(
+                    "POST", core_path("events", namespace), body=body, timeout=10
+                )
+            except Exception:
+                log.exception("failed to post event for %s", key)
+            finally:
+                self._queue.task_done()
+
+    async def flush(self) -> None:
+        """Wait until every queued event has been posted (tests and
+        orderly shutdown)."""
+        await self._queue.join()
+
+    def close(self) -> None:
+        """Drop pending posts and release the drain task (called on
+        manager shutdown)."""
+        if self._worker is not None:
+            self._worker.cancel()
+            self._worker = None

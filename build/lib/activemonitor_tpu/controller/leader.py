@@ -1,0 +1,379 @@
+"""Leader election — multi-replica controller safety.
+
+The reference gets HA via controller-runtime's Lease-based leader
+election (reference: cmd/main.go:87-88, election ID
+"689451f8.keikoproj.io"). Equivalents here:
+
+- :class:`FileLeaderElector` — flock-based, for multiple controller
+  processes sharing a host/volume (the local deployment mode).
+- :class:`KubernetesLeaseElector` — coordination.k8s.io/v1 Lease
+  objects with continuous renewal, preconditioned takeover and a
+  ``lost`` signal, on the native REST layer (activemonitor_tpu.kube).
+- :class:`AlwaysLeader` — single-replica default (election off, like
+  the reference's default ``--leader-elect=false``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Protocol
+
+from activemonitor_tpu.kube import ApiError, api_path
+from activemonitor_tpu.utils.clock import micro_time
+
+log = logging.getLogger("activemonitor.leader")
+
+ELECTION_ID = "689451f8.keikoproj.io"  # parity with the reference
+
+
+class LeaderElector(Protocol):
+    async def acquire(self) -> None:
+        """Blocks until this process holds leadership."""
+        ...
+
+    def release(self) -> None: ...
+
+
+class AlwaysLeader:
+    async def acquire(self) -> None:
+        return None
+
+    def release(self) -> None:
+        return None
+
+
+class FileLeaderElector:
+    """flock-based election for co-hosted replicas."""
+
+    def __init__(self, path: str = "", poll_seconds: float = 1.0):
+        self._path = path or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"activemonitor-{ELECTION_ID}.lock"
+        )
+        self._poll = poll_seconds
+        self._fd = None
+
+    async def acquire(self) -> None:
+        import fcntl
+
+        self._fd = open(self._path, "w")
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fd.write(str(os.getpid()))
+                self._fd.flush()
+                return
+            except BlockingIOError:
+                await asyncio.sleep(self._poll)
+
+    def release(self) -> None:
+        if self._fd is not None:
+            import fcntl
+
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            finally:
+                self._fd.close()
+                self._fd = None
+
+
+class KubernetesLeaseElector:
+    """coordination.k8s.io Lease election with continuous renewal.
+
+    Semantics match controller-runtime's leaderelection (the reference's
+    HA mode, cmd/main.go:87-88): the winner renews ``spec.renewTime``
+    every ``lease_seconds/3``; challengers take over only when the lease
+    has not been renewed for ``lease_seconds``; every takeover/renewal
+    PUT replays the resourceVersion it just read, so the API server
+    rejects the loser of any write race with a 409 and two challengers
+    can never both win the same takeover; on renewal failure or holder
+    change the
+    :attr:`lost` event fires and the manager must stop reconciling
+    (the reference terminates the process)."""
+
+    LEASE_GROUP = "coordination.k8s.io"
+    LEASE_VERSION = "v1"
+    LEASE_PLURAL = "leases"
+
+    def __init__(
+        self,
+        api=None,
+        namespace: str = "health",
+        name: str = ELECTION_ID,
+        identity: str = "",
+        lease_seconds: float = 15.0,
+        clock=None,
+    ):
+        import socket
+        import uuid
+
+        from activemonitor_tpu.utils.clock import Clock
+
+        if api is None:
+            from activemonitor_tpu.kube import KubeApi
+
+            api = KubeApi.from_default_config()
+        self._api = api
+        self._namespace = namespace
+        self._name = name
+        self._identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self._lease_seconds = float(lease_seconds)
+        self._clock = clock or Clock()
+        self._stop = False
+        self._acquired = False
+        self._renew_task = None
+        self._relinquish_task = None
+        self.lost = asyncio.Event()
+
+    # -- lease plumbing -------------------------------------------------
+    def _path(self) -> str:
+        return api_path(
+            self.LEASE_GROUP, self.LEASE_VERSION, self.LEASE_PLURAL,
+            self._namespace, self._name,
+        )
+
+    def _collection_path(self) -> str:
+        return api_path(
+            self.LEASE_GROUP, self.LEASE_VERSION, self.LEASE_PLURAL, self._namespace
+        )
+
+    def _spec(self, acquire_time: str = "") -> dict:
+        spec = {
+            "holderIdentity": self._identity,
+            "leaseDurationSeconds": int(self._lease_seconds),
+            "renewTime": micro_time(self._clock.now()),
+        }
+        if acquire_time:
+            spec["acquireTime"] = acquire_time
+        return spec
+
+    # -- election -------------------------------------------------------
+    async def acquire(self) -> None:
+        """Blocks until this process holds the lease, then starts the
+        background renewal loop. Every API failure here is transient by
+        definition — a candidate has nothing to lose by retrying, so
+        it never crashes the process (an unreachable API server during
+        a rollout must not kill a standby replica).
+
+        Expiry is timed from the moment THIS process last observed the
+        lease record change (resourceVersion movement on our own clock),
+        never from the holder's renewTime wall-clock timestamp — a
+        leader on a skewed clock must not look expired while it is
+        renewing fine (controller-runtime does the same)."""
+        observed_rv: str | None = None
+        observed_at = 0.0
+        while not self._stop:
+            try:
+                try:
+                    existing = await self._api.get(self._path())
+                except ApiError as e:
+                    if not e.not_found:
+                        raise
+                    # no lease yet: create it (a losing racer sees 409)
+                    body = {
+                        "apiVersion": f"{self.LEASE_GROUP}/{self.LEASE_VERSION}",
+                        "kind": "Lease",
+                        "metadata": {"name": self._name, "namespace": self._namespace},
+                        "spec": self._spec(
+                            acquire_time=micro_time(self._clock.now())
+                        ),
+                    }
+                    try:
+                        await self._api.create(self._collection_path(), body)
+                        self._start_renewal()
+                        return
+                    except ApiError as e2:
+                        if not e2.conflict:
+                            raise
+                        continue  # somebody else created it; evaluate theirs
+                spec = existing.get("spec", {}) or {}
+                rv = (existing.get("metadata") or {}).get("resourceVersion")
+                if not spec.get("holderIdentity") or not spec.get("renewTime"):
+                    expired = True  # relinquished or never renewed
+                elif rv != observed_rv:
+                    # the record moved: the holder is alive; restart OUR
+                    # local staleness window
+                    observed_rv, observed_at = rv, self._clock.monotonic()
+                    expired = False
+                else:
+                    expired = (
+                        self._clock.monotonic() - observed_at > self._lease_seconds
+                    )
+                if spec.get("holderIdentity") == self._identity or expired:
+                    # preconditioned takeover: the PUT carries the
+                    # resourceVersion just read, so if another challenger
+                    # won the race this write turns into a 409
+                    existing["spec"] = self._spec(
+                        acquire_time=micro_time(self._clock.now())
+                    )
+                    try:
+                        await self._api.replace(self._path(), existing)
+                    except ApiError as e:
+                        if not e.conflict:
+                            raise
+                        continue
+                    self._start_renewal()
+                    return
+            except asyncio.CancelledError:
+                raise
+            except ApiError as e:
+                if e.status in (401, 403):
+                    # deterministic misconfiguration — retried for parity
+                    # with controller-runtime, but LOUD: the operator must
+                    # see why this replica never becomes leader
+                    log.error(
+                        "election blocked by the API server (%s): check the "
+                        "controller's RBAC on leases in namespace %r and its "
+                        "credentials; retrying",
+                        e, self._namespace,
+                    )
+                else:
+                    log.warning("election attempt failed (%s); retrying", e)
+                await self._clock.sleep(self._lease_seconds / 3)
+                continue
+            except Exception as e:
+                # includes credential-plugin hiccups (STS throttling, a
+                # slow gcloud): a standby has nothing to lose by retrying,
+                # and a deterministic breakage just keeps logging loudly
+                log.warning("election attempt failed (%s); retrying", e)
+                await self._clock.sleep(1.0)
+                continue
+            await self._clock.sleep(self._lease_seconds / 3)
+        # released/stopped while standing by: falling through as if the
+        # lease was won would let the caller reconcile without leadership
+        raise asyncio.CancelledError("elector stopped before acquiring the lease")
+
+    def _start_renewal(self) -> None:
+        self._acquired = True
+        self.lost.clear()
+        self._renew_task = asyncio.create_task(self._renew_loop())
+        # safety net: if the renew task ever dies with an unexpected
+        # exception, leadership is no longer being maintained — that IS
+        # lost leadership, never a silent no-op
+        self._renew_task.add_done_callback(self._renew_done)
+
+    def _renew_done(self, task) -> None:
+        if task.cancelled() or self._stop:
+            return
+        exc = task.exception()
+        if exc is not None:
+            log.error("renewal task died (%s); leadership lost", exc)
+            self.lost.set()
+
+    async def _renew_loop(self) -> None:
+        """Re-write renewTime every lease_seconds/3. Transient failures
+        are retried only until the renew deadline (2/3 of the lease,
+        controller-runtime's renewDeadline<leaseDuration ratio): the
+        holder steps down strictly BEFORE any challenger's takeover
+        window opens, so two active leaders are impossible. A holder
+        change observed mid-renewal also declares leadership lost."""
+        renew_deadline = self._lease_seconds * 2.0 / 3.0
+        # after a failed attempt, retry on a SHORT cadence (controller-
+        # runtime's RetryPeriod idea): sleeping a full lease/3 between
+        # failures would burn the whole renew budget on a single blip
+        retry_period = min(2.0, self._lease_seconds / 6.0)
+        last_renew = self._clock.monotonic()
+        delay = self._lease_seconds / 3
+        while not self._stop:
+            await self._clock.sleep(delay)
+            if self._stop:
+                return
+            # every request's HTTP time is capped by what's left of the
+            # renew deadline (recomputed per request — GET and PUT share
+            # one budget): a black-holed connection must not let a stale
+            # leader keep reconciling into a challenger's takeover window
+            # (KubeApi's default 30 s > the 10 s deadline)
+            def remaining() -> float:
+                return renew_deadline - (self._clock.monotonic() - last_renew)
+
+            if remaining() <= 0:
+                log.error("renew deadline exceeded; leadership lost")
+                self.lost.set()
+                return
+            try:
+                existing = await self._api.request(
+                    "GET", self._path(), timeout=remaining()
+                )
+                spec = existing.get("spec", {}) or {}
+                if spec.get("holderIdentity") != self._identity:
+                    log.error(
+                        "lease %s/%s taken over by %r; leadership lost",
+                        self._namespace, self._name, spec.get("holderIdentity"),
+                    )
+                    self.lost.set()
+                    return
+                if remaining() <= 0:
+                    log.error("renew deadline exceeded; leadership lost")
+                    self.lost.set()
+                    return
+                spec["renewTime"] = micro_time(self._clock.now())
+                existing["spec"] = spec
+                await self._api.request(
+                    "PUT", self._path(), body=existing, timeout=remaining()
+                )
+                last_renew = self._clock.monotonic()
+                delay = self._lease_seconds / 3
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # ANY failure (aiohttp's ServerDisconnectedError is not
+                # an OSError) is transient only until the renew deadline
+                if self._clock.monotonic() - last_renew >= renew_deadline:
+                    log.error("lease renewal failing (%s); leadership lost", e)
+                    self.lost.set()
+                    return
+                log.warning("lease renewal attempt failed (%s); retrying", e)
+                delay = retry_period
+
+    def release(self) -> None:
+        """Stop renewing and relinquish the lease so a standby takes
+        over immediately instead of waiting out the duration. Callers
+        that can await should prefer :meth:`release_async` — the
+        fire-and-forget task spawned here loses the handoff race if the
+        event loop (or the shared API session) shuts down right after."""
+        self._stop = True
+        if self._renew_task is not None:
+            self._renew_task.cancel()
+            self._renew_task = None
+        # best-effort async relinquish; fine if the loop is shutting
+        # down. The strong reference matters: the loop tracks tasks by
+        # weakref only, and an unreferenced task can be GC'd unrun.
+        try:
+            self._relinquish_task = asyncio.get_running_loop().create_task(
+                self._relinquish()
+            )
+        except RuntimeError:
+            pass
+
+    async def release_async(self) -> None:
+        """Like :meth:`release`, but the lease is relinquished before
+        returning — use during orderly shutdown, before closing the
+        underlying API session."""
+        self.release()
+        if self._relinquish_task is not None:
+            await self._relinquish_task
+
+    async def _relinquish(self) -> None:
+        if not self._acquired:
+            # a standby never held the lease: nothing to hand over, and
+            # a doomed GET would only stall shutdown
+            return
+        try:
+            # short timeouts: this runs during shutdown and must finish
+            # inside a pod's termination grace period even when the API
+            # server is unreachable
+            existing = await self._api.request("GET", self._path(), timeout=5)
+            spec = existing.get("spec", {}) or {}
+            if spec.get("holderIdentity") != self._identity:
+                return
+            spec["holderIdentity"] = ""
+            existing["spec"] = spec
+            await self._api.request("PUT", self._path(), body=existing, timeout=5)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # genuinely best-effort: network failures here (including
+            # aiohttp ClientErrors, which are not OSErrors) must never
+            # crash an orderly shutdown — the lease just expires instead
+            log.debug("lease relinquish failed; standby waits out the lease")

@@ -1,0 +1,398 @@
+"""Per-check RBAC provisioning.
+
+Every HealthCheck gets its own ServiceAccount plus a least-privilege
+(Cluster)Role and binding, scoped by ``spec.level``; remedy workflows
+get a separate, write-capable identity that is created per run and
+deleted after (reference: healthcheck_controller.go:302-474 and the
+CRUD helpers :1128-1443).
+
+Semantics preserved:
+
+- read-only defaults for checks vs write defaults for remedies
+  (reference: :85-120), overridable per-spec via rbacRules (:124-129)
+- SA-name collision between check and remedy auto-renames the remedy SA
+  to ``<sa>-remedy`` (:316-319)
+- deletes are guarded by the managed-by label so user-owned objects are
+  never removed (:1169,:1242 etc.)
+- missing level / missing remedy SA / nil remedy resource are errors
+  (:327-329,:409-412,:312-315)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+from activemonitor_tpu.api.types import (
+    HealthCheck,
+    PolicyRule,
+    LEVEL_CLUSTER,
+    LEVEL_NAMESPACE,
+    WORKFLOW_TYPE_REMEDY,
+)
+from activemonitor_tpu.kube import ApiError, api_path, core_path
+
+# labels (reference: healthcheck_controller.go:67-68)
+MANAGED_BY_LABEL_KEY = "workflows.argoproj.io/managed-by"
+MANAGED_BY_VALUE = "active-monitor"
+
+# reference: healthcheck_controller.go:85-101
+DEFAULT_HEALTHCHECK_RULES = [
+    PolicyRule(
+        api_groups=[""],
+        resources=[
+            "pods", "nodes", "events", "services", "configmaps",
+            "namespaces", "endpoints",
+        ],
+        verbs=["get", "list", "watch"],
+    ),
+    PolicyRule(
+        api_groups=["apps"],
+        resources=["deployments", "replicasets", "statefulsets", "daemonsets"],
+        verbs=["get", "list", "watch"],
+    ),
+    PolicyRule(
+        api_groups=["argoproj.io"],
+        resources=["workflows"],
+        verbs=["get", "list", "watch"],
+    ),
+    # divergence from the reference defaults (which predate Argo 3.4):
+    # the Argo executor sidecar reports step results via
+    # workflowtaskresults, so probe pods without this grant fail to
+    # report on modern Argo. Write access is scoped to exactly that
+    # reporting resource; everything else stays read-only.
+    PolicyRule(
+        api_groups=["argoproj.io"],
+        resources=["workflowtaskresults"],
+        verbs=["create", "patch"],
+    ),
+]
+
+# reference: healthcheck_controller.go:104-120
+DEFAULT_REMEDY_RULES = [
+    PolicyRule(
+        api_groups=[""],
+        resources=["pods", "events", "services", "configmaps", "endpoints"],
+        verbs=["get", "list", "watch", "create", "update", "patch", "delete"],
+    ),
+    PolicyRule(
+        api_groups=["apps"],
+        resources=["deployments", "replicasets", "statefulsets"],
+        verbs=["get", "list", "watch", "create", "update", "patch", "delete"],
+    ),
+    PolicyRule(
+        api_groups=["argoproj.io"],
+        resources=["workflows"],
+        verbs=["get", "list", "watch", "create", "update", "patch", "delete"],
+    ),
+]
+
+
+def resolve_rbac_rules(
+    custom: List[PolicyRule], defaults: List[PolicyRule]
+) -> List[PolicyRule]:
+    """Custom rules win when provided (reference: healthcheck_controller.go:124-129)."""
+    return custom if custom else defaults
+
+
+class RBACError(RuntimeError):
+    pass
+
+
+@dataclass
+class RBACObject:
+    kind: str  # ServiceAccount | ClusterRole | ClusterRoleBinding | Role | RoleBinding
+    name: str
+    namespace: str = ""  # empty for cluster-scoped
+    rules: List[PolicyRule] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    subject: str = ""  # SA name for bindings
+    role_ref: str = ""  # role name for bindings
+
+
+class RBACBackend(Protocol):
+    """Storage for RBAC objects (Kubernetes API in cluster mode,
+    in-memory store elsewhere/tests, like the reference unit tests'
+    fake clientset, healthcheck_controller_unit_test.go:312)."""
+
+    async def get(self, kind: str, namespace: str, name: str) -> Optional[RBACObject]: ...
+
+    async def create(self, obj: RBACObject) -> RBACObject: ...
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+
+class InMemoryRBACBackend:
+    def __init__(self):
+        self.objects: Dict[tuple, RBACObject] = {}
+
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> tuple:
+        return (kind, namespace, name)
+
+    async def get(self, kind: str, namespace: str, name: str) -> Optional[RBACObject]:
+        return self.objects.get(self._key(kind, namespace, name))
+
+    async def create(self, obj: RBACObject) -> RBACObject:
+        self.objects[self._key(obj.kind, obj.namespace, obj.name)] = obj
+        return obj
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.objects.pop(self._key(kind, namespace, name), None)
+
+
+class KubernetesRBACBackend:
+    """Real cluster state: ServiceAccounts, (Cluster)Roles and bindings
+    created through the API server, like the reference's typed-clientset
+    helpers (reference: healthcheck_controller.go:1128-1443). The
+    :class:`RBACObject` ↔ manifest mapping lives here so the
+    provisioner stays backend-agnostic."""
+
+    RBAC_GROUP = "rbac.authorization.k8s.io"
+    RBAC_VERSION = "v1"
+    _PLURALS = {
+        "ClusterRole": "clusterroles",
+        "ClusterRoleBinding": "clusterrolebindings",
+        "Role": "roles",
+        "RoleBinding": "rolebindings",
+    }
+
+    def __init__(self, api):
+        self._api = api
+
+    def _path(self, kind: str, namespace: str, name: str = "") -> str:
+        if kind == "ServiceAccount":
+            return core_path("serviceaccounts", namespace, name)
+        plural = self._PLURALS[kind]
+        # Cluster* kinds are cluster-scoped regardless of the namespace arg
+        scoped_ns = "" if kind.startswith("Cluster") else namespace
+        return api_path(self.RBAC_GROUP, self.RBAC_VERSION, plural, scoped_ns, name)
+
+    # -- RBACObject <-> manifest ---------------------------------------
+    def _to_manifest(self, obj: RBACObject) -> dict:
+        meta = {"name": obj.name, "labels": dict(obj.labels)}
+        if obj.namespace and not obj.kind.startswith("Cluster"):
+            meta["namespace"] = obj.namespace
+        manifest: dict = {"metadata": meta}
+        if obj.kind == "ServiceAccount":
+            manifest["apiVersion"] = "v1"
+            manifest["kind"] = "ServiceAccount"
+        elif obj.kind in ("ClusterRole", "Role"):
+            manifest["apiVersion"] = f"{self.RBAC_GROUP}/{self.RBAC_VERSION}"
+            manifest["kind"] = obj.kind
+            manifest["rules"] = [
+                {
+                    "apiGroups": r.api_groups,
+                    "resources": r.resources,
+                    "verbs": r.verbs,
+                }
+                for r in obj.rules
+            ]
+        elif obj.kind in ("ClusterRoleBinding", "RoleBinding"):
+            manifest["apiVersion"] = f"{self.RBAC_GROUP}/{self.RBAC_VERSION}"
+            manifest["kind"] = obj.kind
+            sa_namespace, _, sa_name = obj.subject.partition("/")
+            manifest["subjects"] = [
+                {
+                    "kind": "ServiceAccount",
+                    "name": sa_name,
+                    "namespace": sa_namespace,
+                }
+            ]
+            manifest["roleRef"] = {
+                "apiGroup": self.RBAC_GROUP,
+                "kind": "ClusterRole" if obj.kind == "ClusterRoleBinding" else "Role",
+                "name": obj.role_ref,
+            }
+        else:
+            raise RBACError(f"unknown RBAC kind {obj.kind!r}")
+        return manifest
+
+    @staticmethod
+    def _from_manifest(kind: str, namespace: str, manifest: dict) -> RBACObject:
+        meta = manifest.get("metadata", {})
+        subject = ""
+        if manifest.get("subjects"):
+            s = manifest["subjects"][0]
+            subject = f"{s.get('namespace', '')}/{s.get('name', '')}"
+        return RBACObject(
+            kind=kind,
+            name=meta.get("name", ""),
+            namespace="" if kind.startswith("Cluster") else namespace,
+            rules=[
+                PolicyRule(
+                    api_groups=r.get("apiGroups", []),
+                    resources=r.get("resources", []),
+                    verbs=r.get("verbs", []),
+                )
+                for r in manifest.get("rules", [])
+            ],
+            labels=meta.get("labels", {}) or {},
+            subject=subject,
+            role_ref=(manifest.get("roleRef") or {}).get("name", ""),
+        )
+
+    # -- backend protocol ----------------------------------------------
+    async def get(self, kind: str, namespace: str, name: str) -> Optional[RBACObject]:
+        try:
+            manifest = await self._api.get(self._path(kind, namespace, name))
+        except ApiError as e:
+            if e.not_found:
+                return None
+            raise
+        return self._from_manifest(kind, namespace, manifest)
+
+    async def create(self, obj: RBACObject) -> RBACObject:
+        try:
+            await self._api.create(
+                self._path(obj.kind, obj.namespace), self._to_manifest(obj)
+            )
+        except ApiError as e:
+            # lost race with a concurrent creator: the object exists,
+            # which is all _ensure() wants (reference idempotent create,
+            # healthcheck_controller.go:1129-1135)
+            if not e.conflict:
+                raise
+        return obj
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        try:
+            await self._api.delete(self._path(kind, namespace, name))
+        except ApiError as e:
+            if not e.not_found:
+                raise
+
+
+class RBACProvisioner:
+    def __init__(self, backend: RBACBackend):
+        self._backend = backend
+
+    # -- create path ---------------------------------------------------
+    async def create_rbac_for_workflow(
+        self, hc: HealthCheck, workflow_type: str
+    ) -> None:
+        """Provision SA + role + binding for a check or remedy run
+        (reference: healthcheck_controller.go:302-415)."""
+        level = hc.spec.level
+        wf = hc.spec.workflow
+        if wf.resource is None:
+            raise RBACError("workflow resource is nil")
+        hc_sa = wf.resource.service_account
+        wf_namespace = wf.resource.namespace
+
+        remedy_sa = ""
+        remedy_namespace = ""
+        if not hc.spec.remedy_workflow.is_empty():
+            remedy = hc.spec.remedy_workflow
+            if remedy.resource is None:
+                raise RBACError("RemedyWorkflow is set but Resource is nil")
+            if not remedy.resource.service_account:
+                raise RBACError("ServiceAccount for the RemedyWorkflow is not specified")
+            # collision rename (reference: :316-319) — mutates the spec
+            # in memory exactly like the reference does
+            if remedy.resource.service_account == hc_sa:
+                remedy.resource.service_account = hc_sa + "-remedy"
+            remedy_sa = remedy.resource.service_account
+            remedy_namespace = remedy.resource.namespace
+
+        if workflow_type == WORKFLOW_TYPE_REMEDY:
+            sa, namespace = remedy_sa, remedy_namespace
+            rules = resolve_rbac_rules(
+                hc.spec.remedy_workflow.rbac_rules, DEFAULT_REMEDY_RULES
+            )
+        else:
+            sa, namespace = hc_sa, wf_namespace
+            rules = resolve_rbac_rules(hc.spec.workflow.rbac_rules, DEFAULT_HEALTHCHECK_RULES)
+
+        await self._ensure(
+            RBACObject(
+                kind="ServiceAccount",
+                name=sa,
+                namespace=namespace,
+                labels={MANAGED_BY_LABEL_KEY: MANAGED_BY_VALUE},
+            )
+        )
+
+        if level == LEVEL_CLUSTER:
+            role_name = f"{sa}-cluster-role"
+            await self._ensure(
+                RBACObject(
+                    kind="ClusterRole",
+                    name=role_name,
+                    rules=rules,
+                    labels={MANAGED_BY_LABEL_KEY: MANAGED_BY_VALUE},
+                )
+            )
+            await self._ensure(
+                RBACObject(
+                    kind="ClusterRoleBinding",
+                    name=f"{sa}-cluster-role-binding",
+                    subject=f"{namespace}/{sa}",
+                    role_ref=role_name,
+                    labels={MANAGED_BY_LABEL_KEY: MANAGED_BY_VALUE},
+                )
+            )
+        elif level == LEVEL_NAMESPACE:
+            role_name = f"{sa}-ns-role"
+            await self._ensure(
+                RBACObject(
+                    kind="Role",
+                    name=role_name,
+                    namespace=namespace,
+                    rules=rules,
+                    labels={MANAGED_BY_LABEL_KEY: MANAGED_BY_VALUE},
+                )
+            )
+            await self._ensure(
+                RBACObject(
+                    kind="RoleBinding",
+                    name=f"{sa}-ns-role-binding",
+                    namespace=namespace,
+                    subject=f"{namespace}/{sa}",
+                    role_ref=role_name,
+                    labels={MANAGED_BY_LABEL_KEY: MANAGED_BY_VALUE},
+                )
+            )
+        else:
+            raise RBACError("level is not set")
+
+    async def _ensure(self, obj: RBACObject) -> None:
+        """Idempotent create: an existing object is reused untouched
+        (reference: healthcheck_controller.go:1129-1135)."""
+        existing = await self._backend.get(obj.kind, obj.namespace, obj.name)
+        if existing is None:
+            await self._backend.create(obj)
+
+    # -- delete path (remedy RBAC is ephemeral) ------------------------
+    async def delete_rbac_for_workflow(self, hc: HealthCheck) -> None:
+        """Delete the remedy's SA/role/binding after its run
+        (reference: healthcheck_controller.go:417-474). Objects without
+        our managed-by label are left alone."""
+        remedy = hc.spec.remedy_workflow
+        if remedy.resource is None:
+            return  # nothing to clean up (reference: :418-421)
+        level = hc.spec.level
+        sa = remedy.resource.service_account
+        namespace = remedy.resource.namespace
+
+        await self._delete_if_managed("ServiceAccount", namespace, sa)
+        if level == LEVEL_CLUSTER:
+            await self._delete_if_managed("ClusterRole", "", f"{sa}-cluster-role")
+            await self._delete_if_managed(
+                "ClusterRoleBinding", "", f"{sa}-cluster-role-binding"
+            )
+        elif level == LEVEL_NAMESPACE:
+            await self._delete_if_managed("Role", namespace, f"{sa}-ns-role")
+            await self._delete_if_managed(
+                "RoleBinding", namespace, f"{sa}-ns-role-binding"
+            )
+        else:
+            raise RBACError("level is not set")
+
+    async def _delete_if_managed(self, kind: str, namespace: str, name: str) -> None:
+        obj = await self._backend.get(kind, namespace, name)
+        if obj is None:
+            return
+        if obj.labels.get(MANAGED_BY_LABEL_KEY) != MANAGED_BY_VALUE:
+            return  # not ours — leave it (reference delete guard)
+        await self._backend.delete(kind, namespace, name)

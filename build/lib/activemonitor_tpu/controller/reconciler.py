@@ -1,0 +1,762 @@
+"""The HealthCheck reconciler — the core state machine.
+
+Implements the reference's reconcile flow (SURVEY.md §3.2-3.4;
+reference: internal/controllers/healthcheck_controller.go:170-874) as
+cooperating asyncio tasks:
+
+reconcile(key)
+├─ get: gone ⇒ stop timer, done               (:175-186)
+└─ process (exceptions recovered, 1s requeue)  (:190-223)
+   ├─ pause: no interval and no cron ⇒ Stopped (:238-250)
+   ├─ cron ⇒ effective interval = next-fire delta (+1s) (:251-263)
+   ├─ dedupe: finished recently AND timer known ⇒ no-op (:264-267)
+   ├─ provision check RBAC                     (:269)
+   ├─ submit workflow                          (:277)
+   └─ spawn watch task                         (:283)
+
+watch task (one per in-flight workflow)
+├─ poll engine with inverse-exp backoff; timeout ⇒ synthesized Failed (:607-632)
+├─ Succeeded ⇒ counters/metrics/remedy-reset  (:635-661)
+├─ Failed ⇒ counters/metrics + remedy gating  (:662-723)
+│  └─ remedy: RBAC → submit → watch → delete RBAC (:759-874)
+├─ conflict-retried status write               (:734,:1445-1462)
+└─ reschedule via timer wheel                  (:745-754)
+
+Deliberate divergences from the reference (each marked inline):
+
+1. The watch loop runs as its own task instead of blocking a reconcile
+   worker for the whole workflow duration — the reference's known
+   throughput bound (SURVEY.md §2 defect (e)).
+2. The timer-fired resubmission recomputes the effective interval (cron
+   delta or repeatAfterSec) at reschedule time. The reference reuses the
+   re-fetched spec's repeatAfterSec, which is 0 for cron-only specs and
+   degenerates into an immediate-refire loop until the next watch event
+   corrects it.
+3. Workflow labels are computed per-check (see workflow_spec.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from activemonitor_tpu.api.types import (
+    HealthCheck,
+    PHASE_FAILED,
+    PHASE_SUCCEEDED,
+    STATUS_STOPPED,
+    WORKFLOW_TYPE_HEALTHCHECK,
+    WORKFLOW_TYPE_REMEDY,
+)
+from activemonitor_tpu.controller.client import (
+    HealthCheckClient,
+    NotFoundError,
+    retry_on_conflict,
+)
+from activemonitor_tpu.controller.events import (
+    EVENT_NORMAL,
+    EVENT_WARNING,
+    EventRecorder,
+)
+from activemonitor_tpu.controller.rbac import RBACProvisioner
+from activemonitor_tpu.controller.workflow_spec import (
+    parse_remedy_workflow_from_healthcheck,
+    parse_workflow_from_healthcheck,
+)
+from activemonitor_tpu.engine.base import WorkflowEngine
+from activemonitor_tpu.metrics.collector import (
+    MetricsCollector,
+    WORKFLOW_LABEL_HEALTHCHECK,
+    WORKFLOW_LABEL_REMEDY,
+)
+from activemonitor_tpu.scheduler import (
+    CronParseError,
+    InverseExpBackoff,
+    TimerWheel,
+    compute_backoff_params,
+    parse_cron,
+    seconds_until_next,
+)
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.reconciler")
+
+
+class HealthCheckReconciler:
+    def __init__(
+        self,
+        client: HealthCheckClient,
+        engine: WorkflowEngine,
+        rbac: RBACProvisioner,
+        recorder: EventRecorder,
+        metrics: MetricsCollector,
+        clock: Optional[Clock] = None,
+    ):
+        self.client = client
+        self.engine = engine
+        self.rbac = rbac
+        self.recorder = recorder
+        self.metrics = metrics
+        self.clock = clock or Clock()
+        self.timers = TimerWheel(self.clock)
+        self._watch_tasks: Dict[str, asyncio.Task] = {}
+
+    # ------------------------------------------------------------------
+    # entry point (reference: Reconcile, healthcheck_controller.go:170-188)
+    # ------------------------------------------------------------------
+    async def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        """Returns a requeue-after delay in seconds, or None."""
+        hc = await self.client.get(namespace, name)
+        if hc is None:
+            # deleted: cancel the next scheduled run (reference: :180-184).
+            # Timers are keyed by namespace/name — the reference keys by
+            # bare name (:139), letting same-named checks in different
+            # namespaces clobber each other's schedules.
+            key = f"{namespace}/{name}"
+            if self.timers.exists(key):
+                log.info("cancelling scheduled run for deleted healthcheck %s", key)
+                self.timers.stop(key)
+            return None
+        return await self._process_or_recover(hc)
+
+    async def _process_or_recover(self, hc: HealthCheck) -> Optional[float]:
+        # panic-recover equivalent (reference: :191-195)
+        try:
+            return await self._process(hc)
+        except NotFoundError:
+            # resource vanished mid-process: swallow (reference: :201-203)
+            return None
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception(
+                "error processing healthcheck %s", hc.key
+            )
+            return 1.0  # 1s requeue on process error (reference: :204)
+
+    # ------------------------------------------------------------------
+    # decision logic (reference: processHealthCheck, :225-291)
+    # ------------------------------------------------------------------
+    async def _process(self, hc: HealthCheck) -> Optional[float]:
+        spec = hc.spec
+        if spec.workflow.resource is None:
+            return None  # nothing to run (reference guards on Resource != nil, :227)
+
+        # pause (reference: :238-250)
+        if spec.repeat_after_sec <= 0 and not spec.schedule.cron:
+            hc.status.status = STATUS_STOPPED
+            hc.status.error_message = (
+                "workflow execution is stopped; either spec.RepeatAfterSec or "
+                f"spec.Schedule must be provided. spec.RepeatAfterSec set to "
+                f"{spec.repeat_after_sec}. spec.Schedule set to {spec.schedule.cron!r}"
+            )
+            hc.status.finished_at = self.clock.now()
+            self.recorder.event(
+                hc,
+                EVENT_WARNING,
+                "Warning",
+                "Workflow execution is stopped; either spec.RepeatAfterSec or "
+                "spec.Schedule must be provided",
+            )
+            await self._update_status(hc)
+            return None
+
+        # cron → effective interval (reference: :251-263)
+        if spec.repeat_after_sec <= 0 and spec.schedule.cron:
+            try:
+                hc.spec.repeat_after_sec = seconds_until_next(
+                    spec.schedule.cron, self.clock.now()
+                )
+            except CronParseError as e:
+                self.recorder.event(hc, EVENT_WARNING, "Warning", "Fail to parse cron")
+                log.error("fail to parse cron for %s: %s", hc.key, e)
+                raise
+        # dedupe (reference: :264-267): the schedule is current (no run
+        # is owed yet) and a timer is known for this check ⇒ healthy.
+        # Divergence 4: unlike the reference (where this guard is an
+        # `else if` that cron specs never reach, so each status-write
+        # event resubmits immediately — continuous churn), the guard
+        # applies to cron checks too — "current" for a cron spec means
+        # no fire has passed since the last finish (comparing elapsed
+        # against the delta-to-NEXT-fire is wrong for absolute schedules
+        # reconciled late in a period).
+        remaining = self._schedule_remaining(hc)
+        # nothing owed yet AND a live (unfired) timer ⇒ the schedule is
+        # healthy; let the timer drive the next run. Time-bounding the
+        # guard matters: a fired-but-bailed timer entry must not wedge
+        # the check forever, and a spec edited to a faster cadence must
+        # not wait out the old timer.
+        if remaining is not None and self.timers.pending(hc.key):
+            return None
+        # a watch for this check is still in flight (workflow running
+        # longer than the interval): don't stack a duplicate run
+        if self._watch_active(hc.key):
+            return None
+        # Divergence 10: true resume after a controller restart. The
+        # reference's dedupe needs its process-local timer, so a restart
+        # resubmits EVERY recent check at once (a restart storm). Here a
+        # current-schedule check with no live timer — the boot-resync
+        # state, or a cadence shrunk by a spec edit — (re)builds its
+        # timer from durable status for the remaining time to the owed
+        # fire. Overdue checks (a fire passed while down) fall through
+        # and run immediately.
+        if remaining is not None:
+            self.timers.schedule(hc.key, remaining, self._resubmit_callback(hc))
+            self.recorder.event(
+                hc,
+                EVENT_NORMAL,
+                "Normal",
+                "Schedule resumed from durable status for the remaining interval",
+            )
+            return None
+        # a run is owed NOW: cancel any still-pending timer first (the
+        # sub-second rounding sliver, or a stale long timer after a spec
+        # edit) so it cannot double-fire behind this submission
+        self.timers.stop(hc.key)
+
+        # per-run RBAC (reference: :269)
+        await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_HEALTHCHECK)
+
+        wf_name = await self._submit_workflow(hc)
+        self._spawn_watch(hc, wf_name)
+        return None
+
+    def _schedule_remaining(self, hc: HealthCheck) -> Optional[float]:
+        """Seconds until the NEXT owed fire, judged purely from durable
+        status — or None when a run is owed right now (never ran, or a
+        fire/interval passed since finished_at, e.g. while the
+        controller was down). One definition serves both the dedupe
+        guard (remaining is not None ⇒ nothing owed yet) and the
+        restart-resume timer (anchored at finished_at, so downtime
+        neither double-runs nor stretches the cadence)."""
+        if hc.status.finished_at is None:
+            return None  # never ran: owed now
+        now = self.clock.now()
+        if hc.spec.schedule.cron:
+            try:
+                schedule = parse_cron(hc.spec.schedule.cron)
+                next_after_finish = schedule.next(hc.status.finished_at)
+            except CronParseError:
+                return None  # unparseable: let the normal path complain
+            if next_after_finish <= now:
+                return None  # a fire passed since the last finish: owed
+            return max(1.0, (next_after_finish - now).total_seconds())
+        elapsed = (now - hc.status.finished_at).total_seconds()
+        if elapsed >= hc.spec.repeat_after_sec:
+            return None  # interval elapsed: owed
+        return max(1.0, hc.spec.repeat_after_sec - elapsed)
+
+    # ------------------------------------------------------------------
+    # submit (reference: createSubmitWorkflow, :502-534)
+    # ------------------------------------------------------------------
+    async def _submit_workflow(self, hc: HealthCheck) -> str:
+        try:
+            manifest = parse_workflow_from_healthcheck(hc)
+        except Exception:
+            self.recorder.event(
+                hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
+            )
+            raise
+        wf_name = await self.engine.submit(manifest)
+        self.recorder.event(hc, EVENT_NORMAL, "Normal", "Successfully created workflow")
+        return wf_name
+
+    async def _pace_poll(
+        self, ieb: InverseExpBackoff, wf_namespace: str, wf_name: str
+    ) -> bool:
+        """One backoff step between status polls. Engines exposing
+        ``wait_change`` (the Argo engine's watch-backed cache) wake the
+        loop the moment the workflow object changes instead of sleeping
+        out the whole delay — detection becomes event-driven with the
+        inverse-exp cadence as the fallback bound. The change-wait races
+        the pacing sleep on ``self.clock``, so fake-clock tests drive
+        time exactly as with poll-only engines. Returns False once the
+        poll deadline has passed (caller synthesizes failure)."""
+        waiter = getattr(self.engine, "wait_change", None)
+        if waiter is None:
+            return await ieb.next()
+        if ieb.expired():
+            return False
+        sleep_task = asyncio.ensure_future(self.clock.sleep(ieb.advance()))
+        wake_task = asyncio.ensure_future(waiter(wf_namespace, wf_name))
+        try:
+            await asyncio.wait(
+                {sleep_task, wake_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if (
+                wake_task.done()
+                and not wake_task.cancelled()
+                and wake_task.exception() is not None
+                and not sleep_task.done()
+            ):
+                # a raising wait_change must not turn into an unpaced
+                # hot poll loop: log it and let the backoff sleep pace
+                log.warning(
+                    "wait_change for %s/%s failed (%r); falling back to "
+                    "timed polling for this step",
+                    wf_namespace,
+                    wf_name,
+                    wake_task.exception(),
+                )
+                await sleep_task
+        finally:
+            for task in (sleep_task, wake_task):
+                if not task.done():
+                    task.cancel()
+            await asyncio.gather(sleep_task, wake_task, return_exceptions=True)
+        return True
+
+    def _watch_active(self, key: str) -> bool:
+        t = self._watch_tasks.get(key)
+        return t is not None and not t.done()
+
+    def _spawn_watch(self, hc: HealthCheck, wf_name: str) -> None:
+        """Divergence 1: poll in a free task, not in the reconcile worker."""
+        key = hc.key
+        self._watch_tasks[key] = asyncio.create_task(
+            self._watch_guarded(hc, wf_name),
+            name=f"watch:{key}:{wf_name}",
+        )
+
+    async def _watch_guarded(self, hc: HealthCheck, wf_name: str) -> None:
+        """Exception recovery for detached watch tasks: a transient
+        engine/client error must not silently kill the check's schedule
+        — emulate the reference's 1s requeue (:204) by re-reconciling."""
+        try:
+            await self._watch_workflow_reschedule(hc, wf_name)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("watch failed for %s; requeueing in 1s", hc.key)
+            self.recorder.event(
+                hc, EVENT_WARNING, "Warning", "Error executing Workflow"
+            )
+            # deregister before requeueing: the in-flight guard must not
+            # see this (still-running) task and skip the retry
+            if self._watch_tasks.get(hc.key) is asyncio.current_task():
+                del self._watch_tasks[hc.key]
+            # keep requeueing until a reconcile lands cleanly — a single
+            # shot would strand the schedule if the API-server outage
+            # outlives one retry (the reference's workqueue re-rate-
+            # limits indefinitely; deletion ends the loop via None)
+            delay: Optional[float] = 1.0
+            while delay:
+                await self.clock.sleep(delay)
+                try:
+                    delay = await self.reconcile(
+                        hc.metadata.namespace, hc.metadata.name
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("requeued reconcile of %s failed", hc.key)
+                    delay = 1.0
+
+    async def wait_watches(self) -> None:
+        """Test/shutdown helper: wait for all in-flight watches."""
+        tasks = [t for t in self._watch_tasks.values() if not t.done()]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def shutdown(self) -> None:
+        for t in self._watch_tasks.values():
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(*self._watch_tasks.values(), return_exceptions=True)
+        await self.timers.shutdown()
+
+    # ------------------------------------------------------------------
+    # watch + status + reschedule (reference: watchWorkflowReschedule, :607-757)
+    # ------------------------------------------------------------------
+    async def _watch_workflow_reschedule(self, hc: HealthCheck, wf_name: str) -> None:
+        wf_namespace = hc.spec.workflow.resource.namespace
+        then = self.clock.now()
+        params = compute_backoff_params(
+            workflow_timeout=hc.spec.workflow.timeout,
+            backoff_max=hc.spec.backoff_max,
+            backoff_min=hc.spec.backoff_min,
+            backoff_factor=hc.spec.backoff_factor,
+        )
+        ieb = InverseExpBackoff(params, self.clock)
+        timed_out = False
+        while True:
+            now = self.clock.now()
+            # NOTE: a transient engine error here deliberately PROPAGATES
+            # (unlike the remedy watch below): _watch_guarded aborts this
+            # attempt and requeues the whole check at the reference's 1s
+            # cadence (:204) — each retry gets a fresh poll window, so a
+            # long apiserver storm cannot eat the check's own timeout.
+            # The check's RBAC is not ephemeral, so aborting leaks nothing.
+            if timed_out:
+                # the deadline verdict must come from the API server,
+                # not a possibly-lagging watch cache: a terminal phase
+                # that landed during a watch reconnect gap must win
+                getter = getattr(self.engine, "get_fresh", self.engine.get)
+                workflow = await getter(wf_namespace, wf_name)
+            else:
+                workflow = await self.engine.get(wf_namespace, wf_name)
+            if workflow is None:
+                # workflow GC'd / healthcheck deleted: swallow, no reschedule
+                # (reference: :618-623)
+                self.recorder.event(
+                    hc,
+                    EVENT_WARNING,
+                    "Warning",
+                    "Error attempting to find workflow for healthcheck. This may "
+                    "indicate that either the healthcheck was removed or the "
+                    "Workflow was GC'd before active-monitor could obtain the status",
+                )
+                return
+            status = workflow.get("status") or {}
+            if timed_out and status.get("phase") not in (PHASE_SUCCEEDED, PHASE_FAILED):
+                # poll deadline exceeded ⇒ synthesized failure (reference:
+                # :627-632 — though unlike the reference, a terminal phase
+                # seen on this final poll is honored rather than discarded)
+                status = {"phase": PHASE_FAILED, "message": PHASE_FAILED}
+                self.recorder.event(hc, EVENT_WARNING, "Warning", "Workflow timed out")
+            phase = status.get("phase")
+
+            if phase == PHASE_SUCCEEDED:
+                self.recorder.event(
+                    hc, EVENT_NORMAL, "Normal", "Workflow status is Succeeded"
+                )
+                hc.status.status = PHASE_SUCCEEDED
+                hc.status.started_at = then
+                hc.status.finished_at = now
+                hc.status.success_count += 1
+                hc.status.total_healthcheck_runs = (
+                    hc.status.success_count + hc.status.failed_count
+                )
+                hc.status.last_successful_workflow = wf_name
+                self.metrics.record_success(
+                    hc.metadata.name,
+                    WORKFLOW_LABEL_HEALTHCHECK,
+                    then.timestamp(),
+                    now.timestamp(),
+                )
+                # custom metrics, wired for real (reference gap: SURVEY.md §2)
+                self.metrics.record_custom_metrics(hc.metadata.name, status)
+                if not hc.spec.remedy_workflow.is_empty() and hc.status.remedy_total_runs >= 1:
+                    hc.status.reset_remedy("HealthCheck Passed so Remedy is reset")
+                    self.recorder.event(
+                        hc, EVENT_NORMAL, "Normal", "HealthCheck passed so Remedy is reset"
+                    )
+                break
+
+            if phase == PHASE_FAILED:
+                self.recorder.event(
+                    hc, EVENT_WARNING, "Warning", "Workflow status is Failed"
+                )
+                hc.status.status = PHASE_FAILED
+                hc.status.started_at = then
+                hc.status.finished_at = now
+                hc.status.last_failed_at = now
+                hc.status.error_message = str(status.get("message") or "")
+                hc.status.failed_count += 1
+                hc.status.total_healthcheck_runs = (
+                    hc.status.success_count + hc.status.failed_count
+                )
+                hc.status.last_failed_workflow = wf_name
+                self.metrics.record_failure(
+                    hc.metadata.name,
+                    WORKFLOW_LABEL_HEALTHCHECK,
+                    then.timestamp(),
+                    now.timestamp(),
+                )
+                self.metrics.record_custom_metrics(hc.metadata.name, status)
+                await self._maybe_run_remedy(hc)
+                break
+
+            if not await self._pace_poll(ieb, wf_namespace, wf_name):
+                timed_out = True
+
+        # status write + reschedule (reference: :732-755)
+        if hc.metadata.deletion_timestamp is None:
+            try:
+                await self._update_status(hc)
+            except NotFoundError:
+                self.timers.stop(hc.key)
+                return
+            except Exception:
+                # transient write failure (API-server blip outliving the
+                # conflict retries): raise so _watch_guarded requeues in
+                # 1s like the reference's reconcile error path (:204).
+                # Stopping the timer here instead would leave the check
+                # schedule dead until some external watch event arrived.
+                log.exception("error updating healthcheck resource %s", hc.key)
+                self.recorder.event(
+                    hc, EVENT_WARNING, "Warning", "Error updating healthcheck resource"
+                )
+                raise
+            repeat = self._effective_repeat_after(hc)
+            if repeat > 0:
+                self.timers.schedule(hc.key, repeat, self._resubmit_callback(hc))
+                self.recorder.event(
+                    hc, EVENT_NORMAL, "Normal", "Rescheduled workflow for next run"
+                )
+
+    def _effective_repeat_after(self, hc: HealthCheck) -> int:
+        """Divergence 2: recompute the interval at reschedule time."""
+        if hc.spec.repeat_after_sec > 0 and not hc.spec.schedule.cron:
+            return hc.spec.repeat_after_sec
+        if hc.spec.schedule.cron:
+            try:
+                return seconds_until_next(hc.spec.schedule.cron, self.clock.now())
+            except CronParseError:
+                return 0
+        return hc.spec.repeat_after_sec
+
+    def _resubmit_callback(self, prev_hc: HealthCheck):
+        """Timer-fired resubmission (reference: createSubmitWorkflowHelper,
+        :479-500): re-fetch the CR, submit, watch."""
+
+        namespace, name = prev_hc.metadata.namespace, prev_hc.metadata.name
+
+        async def resubmit() -> None:
+            # atomically (no awaits) check-and-claim the in-flight slot:
+            # registering BEFORE the first await means a concurrent
+            # reconcile sees _watch_active and cannot cancel this timer
+            # task mid-submit (which would orphan a created workflow)
+            current = asyncio.current_task()
+            existing = self._watch_tasks.get(f"{namespace}/{name}")
+            if existing is not None and not existing.done() and existing is not current:
+                # a run is still in flight (it will reschedule on its
+                # own completion) — never stack a duplicate
+                return
+            if current is not None:
+                self._watch_tasks[f"{namespace}/{name}"] = current
+
+            hc = await self.client.get(namespace, name)
+            if hc is None:
+                return
+            # the spec may have changed since this timer was armed: if
+            # nothing is owed under the CURRENT spec (cadence slowed, or
+            # a sub-second rounding sliver), re-arm for the remaining
+            # time instead of firing early
+            remaining = self._schedule_remaining(hc)
+            if remaining is not None:
+                self.timers.schedule(hc.key, remaining, self._resubmit_callback(hc))
+                return
+            # keep the effective interval for timeout/backoff derivation
+            if hc.spec.repeat_after_sec <= 0 and hc.spec.schedule.cron:
+                try:
+                    hc.spec.repeat_after_sec = seconds_until_next(
+                        hc.spec.schedule.cron, self.clock.now()
+                    )
+                except CronParseError:
+                    return
+            if hc.spec.repeat_after_sec <= 0:
+                return  # paused since the timer was armed
+            try:
+                await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_HEALTHCHECK)
+                wf_name = await self._submit_workflow(hc)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("error creating or submitting workflow for %s", hc.key)
+                self.recorder.event(
+                    hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
+                )
+                return
+            # already registered in _watch_tasks at the top, so
+            # reconcile's in-flight guard and wait_watches() saw this
+            # timer-driven run from before the submit
+            await self._watch_guarded(hc, wf_name)
+
+        return resubmit
+
+    # ------------------------------------------------------------------
+    # remedy (reference: :677-721 gating, processRemedyWorkflow :759-786,
+    # watchRemedyWorkflow :788-874)
+    # ------------------------------------------------------------------
+    async def _maybe_run_remedy(self, hc: HealthCheck) -> None:
+        spec = hc.spec
+        if spec.remedy_workflow.is_empty():
+            return
+        if spec.remedy_runs_limit != 0 and spec.remedy_reset_interval != 0:
+            if spec.remedy_runs_limit > hc.status.remedy_total_runs:
+                await self._process_remedy(hc)
+            else:
+                # limit hit: wait out the reset interval, then reset and run
+                # (reference: :689-711)
+                since_last = (
+                    (self.clock.now() - hc.status.remedy_finished_at).total_seconds()
+                    if hc.status.remedy_finished_at is not None
+                    else float("inf")
+                )
+                if spec.remedy_reset_interval >= since_last:
+                    log.info(
+                        "skipping remedy for %s: run limit reached, waiting out "
+                        "the reset interval",
+                        hc.key,
+                    )
+                else:
+                    hc.status.reset_remedy("RemedyResetInterval elapsed so Remedy is reset")
+                    self.recorder.event(
+                        hc,
+                        EVENT_NORMAL,
+                        "Normal",
+                        "RemedyResetInterval elapsed so Remedy is reset",
+                    )
+                    await self._process_remedy(hc)
+        else:
+            # gates unset ⇒ always run (reference: :712-720)
+            await self._process_remedy(hc)
+
+    async def _process_remedy(self, hc: HealthCheck) -> None:
+        await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_REMEDY)
+        # remedy RBAC is ephemeral (reference: :779-784) — and because
+        # it is the WRITE-capable identity, it must be torn down on
+        # every exit path: a parse error, a submit failure, or an engine
+        # exception mid-watch may not leave the SA/Role/Binding behind
+        # (the reference shares this leak shape at
+        # healthcheck_controller.go:773-784; we close it)
+        try:
+            try:
+                manifest = parse_remedy_workflow_from_healthcheck(hc)
+            except Exception:
+                self.recorder.event(
+                    hc,
+                    EVENT_WARNING,
+                    "Warning",
+                    "Error creating or submitting remedyworkflow",
+                )
+                raise
+            wf_name = await self.engine.submit(manifest)
+            self.recorder.event(
+                hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
+            )
+            await self._watch_remedy_workflow(hc, wf_name)
+        finally:
+            try:
+                await self.rbac.delete_rbac_for_workflow(hc)
+            except Exception:
+                # a failed teardown must not mask the original error;
+                # the next remedy run retries the delete via the
+                # collision-rename path
+                log.warning(
+                    "failed to delete ephemeral remedy RBAC for %s",
+                    hc.key,
+                    exc_info=True,
+                )
+
+    async def _watch_remedy_workflow(self, hc: HealthCheck, wf_name: str) -> None:
+        wf_namespace = hc.spec.remedy_workflow.resource.namespace
+        then = self.clock.now()
+        # remedy polling derives from the CHECK's timeout with default
+        # factor — parity with the reference (:791-801)
+        params = compute_backoff_params(workflow_timeout=hc.spec.workflow.timeout)
+        ieb = InverseExpBackoff(params, self.clock)
+        timed_out = False
+        while True:
+            now = self.clock.now()
+            try:
+                if timed_out:
+                    # the deadline verdict must come from the API server,
+                    # not a possibly-lagging watch cache: a terminal phase
+                    # that landed during a watch reconnect gap must win
+                    getter = getattr(self.engine, "get_fresh", self.engine.get)
+                    workflow = await getter(wf_namespace, wf_name)
+                else:
+                    workflow = await self.engine.get(wf_namespace, wf_name)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # transient errors must not abort the remedy watch: the
+                # finally in _process_remedy would tear down the WRITE-
+                # capable RBAC while the remedy workflow is still running
+                # and strand its later steps. Retry at the 1s requeue
+                # cadence; a persistent outage ends via the deadline
+                # (≈ the workflow's own activeDeadlineSeconds, so Argo
+                # is killing it too) and only then is the ephemeral
+                # identity reclaimed.
+                log.warning(
+                    "transient error polling remedy workflow %s/%s",
+                    wf_namespace,
+                    wf_name,
+                    exc_info=True,
+                )
+                if not timed_out:
+                    await self.clock.sleep(1.0)
+                    if ieb.expired():
+                        timed_out = True
+                    continue
+                workflow = {}  # deadline passed, confirm-read failed too
+            if workflow is None:
+                return  # parent deleted / GC'd (reference: :806-810)
+            status = workflow.get("status") or {}
+            if timed_out and status.get("phase") not in (PHASE_SUCCEEDED, PHASE_FAILED):
+                # same final-poll policy as the healthcheck loop above: a
+                # terminal phase seen at the deadline is honored, not discarded
+                status = {"phase": PHASE_FAILED, "message": PHASE_FAILED}
+                self.recorder.event(
+                    hc, EVENT_WARNING, "Warning", "remedy workflow is timedout"
+                )
+            phase = status.get("phase")
+
+            if phase == PHASE_SUCCEEDED:
+                self.recorder.event(
+                    hc, EVENT_NORMAL, "Normal", "Remedy workflow status is Succeeded"
+                )
+                hc.status.remedy_status = PHASE_SUCCEEDED
+                hc.status.remedy_started_at = then
+                hc.status.remedy_finished_at = now
+                hc.status.remedy_success_count += 1
+                hc.status.remedy_total_runs = (
+                    hc.status.remedy_success_count + hc.status.remedy_failed_count
+                )
+                hc.status.last_successful_workflow = wf_name
+                self.metrics.record_success(
+                    hc.metadata.name,
+                    WORKFLOW_LABEL_REMEDY,
+                    then.timestamp(),
+                    now.timestamp(),
+                )
+                self.metrics.record_custom_metrics(hc.metadata.name, status)
+                break
+            if phase == PHASE_FAILED:
+                self.recorder.event(
+                    hc, EVENT_WARNING, "Warning", "remedy workflow status is failed"
+                )
+                hc.status.remedy_status = PHASE_FAILED
+                hc.status.remedy_started_at = then
+                hc.status.remedy_finished_at = now
+                hc.status.remedy_last_failed_at = now
+                hc.status.remedy_error_message = str(status.get("message") or "")
+                hc.status.remedy_failed_count += 1
+                hc.status.remedy_total_runs = (
+                    hc.status.remedy_success_count + hc.status.remedy_failed_count
+                )
+                hc.status.last_failed_workflow = wf_name
+                self.metrics.record_failure(
+                    hc.metadata.name,
+                    WORKFLOW_LABEL_REMEDY,
+                    then.timestamp(),
+                    now.timestamp(),
+                )
+                self.metrics.record_custom_metrics(hc.metadata.name, status)
+                break
+
+            if not await self._pace_poll(ieb, wf_namespace, wf_name):
+                timed_out = True
+
+        if hc.metadata.deletion_timestamp is None:
+            try:
+                await self._update_status(hc)
+            except NotFoundError:
+                self.timers.stop(hc.key)
+
+    # ------------------------------------------------------------------
+    # status writes (reference: updateHealthCheckStatus, :1445-1462)
+    # ------------------------------------------------------------------
+    async def _update_status(self, hc: HealthCheck) -> None:
+        async def attempt():
+            fresh = await self.client.get(hc.metadata.namespace, hc.metadata.name)
+            if fresh is None:
+                raise NotFoundError(hc.key)
+            fresh.status = hc.status.model_copy(deep=True)
+            return await self.client.update_status(fresh)
+
+        updated = await retry_on_conflict(attempt)
+        hc.metadata.resource_version = updated.metadata.resource_version

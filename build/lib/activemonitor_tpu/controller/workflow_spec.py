@@ -1,0 +1,205 @@
+"""Workflow manifest parsing and mutation.
+
+Turns a HealthCheck's artifact into a submittable workflow manifest
+(reference: healthcheck_controller.go:876-1125):
+
+- resolve + read the artifact, YAML-parse it
+- labels: manifest labels are used when present and map-shaped,
+  otherwise the default controller-instanceid label is applied.
+  Divergence from the reference, on purpose: labels are computed
+  per-check instead of accumulated in a shared reconciler-wide map, so
+  labels can't leak between HealthChecks (the reference defect noted in
+  SURVEY.md §2 — workflowLabels at healthcheck_controller.go:140,910-928).
+- inject: GVK, namespace, generateName, ownerReference (controller=true
+  ⇒ workflows are GC'd with their HealthCheck), podGC OnPodCompletion
+  default, serviceAccountName, activeDeadlineSeconds default
+- timeout defaulting: an unset workflow timeout becomes repeatAfterSec
+  (mutating the in-memory spec, reference: :981-986); a remedy's
+  timeout is taken from its manifest's activeDeadlineSeconds when
+  numeric, else repeatAfterSec (:1107-1120)
+"""
+
+from __future__ import annotations
+
+
+import yaml
+
+from activemonitor_tpu import API_VERSION, KIND
+from activemonitor_tpu.api.types import HealthCheck
+from activemonitor_tpu.engine.base import (
+    WF_API_VERSION,
+    WF_INSTANCE_ID,
+    WF_INSTANCE_ID_LABEL_KEY,
+    WF_KIND,
+)
+from activemonitor_tpu.store import get_artifact_reader
+POD_GC_ON_POD_COMPLETION = "OnPodCompletion"
+
+
+class WorkflowSpecError(ValueError):
+    pass
+
+
+def _load_manifest(source) -> dict:
+    reader = get_artifact_reader(source)
+    content = reader.read()
+    data = yaml.safe_load(content)
+    if not isinstance(data, dict):
+        raise WorkflowSpecError("invalid spec file passed")
+    return data
+
+
+def _resolve_labels(data: dict) -> dict:
+    """Labels for the submitted workflow (per-check, no shared state)."""
+    metadata = data.get("metadata")
+    if isinstance(metadata, dict):
+        labels = metadata.get("labels")
+        if isinstance(labels, dict):
+            return {str(k): str(v) for k, v in labels.items()}
+    return {WF_INSTANCE_ID_LABEL_KEY: WF_INSTANCE_ID}
+
+
+def _owner_reference(hc: HealthCheck) -> dict:
+    # reference: healthcheck_controller.go:512-522
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "name": hc.metadata.name,
+        "uid": hc.metadata.uid,
+        "controller": True,
+    }
+
+
+def _injected_metadata(data: dict, generate_name: str, namespace: str, hc: HealthCheck) -> dict:
+    """Controller-owned metadata; manifest annotations are preserved
+    (the reference overwrites name/labels/ns/ownerRefs via setters,
+    which keeps other metadata keys — healthcheck_controller.go:505-522)."""
+    meta = {
+        "generateName": generate_name,
+        "namespace": namespace,
+        "labels": _resolve_labels(data),
+        "ownerReferences": [_owner_reference(hc)],
+    }
+    old = data.get("metadata")
+    if isinstance(old, dict) and isinstance(old.get("annotations"), dict):
+        meta["annotations"] = old["annotations"]
+    return meta
+
+
+def _spec_of(data: dict, what: str) -> dict:
+    spec = data.get("spec")
+    if spec is None:
+        raise WorkflowSpecError(f"invalid {what}, missing spec")
+    if not isinstance(spec, dict):
+        raise WorkflowSpecError(f"invalid {what}, spec is not a map")
+    return spec
+
+
+def _inject_tpu_placement(spec: dict, tpu) -> None:
+    """Place the probe onto a TPU node pool: GKE TPU node selectors at
+    the workflow level, chip resources on every container template
+    (framework extension — SURVEY.md §7.7)."""
+    if tpu.accelerator or tpu.topology:
+        selector = spec.get("nodeSelector")
+        if not isinstance(selector, dict):
+            selector = {}
+        if tpu.accelerator:
+            selector.setdefault("cloud.google.com/gke-tpu-accelerator", tpu.accelerator)
+        if tpu.topology:
+            selector.setdefault("cloud.google.com/gke-tpu-topology", tpu.topology)
+        spec["nodeSelector"] = selector
+    tolerations = spec.get("tolerations")
+    if not isinstance(tolerations, list):
+        tolerations = []
+    if not any(
+        isinstance(t, dict) and t.get("key") == "google.com/tpu" for t in tolerations
+    ):
+        tolerations.append(
+            {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+        )
+    spec["tolerations"] = tolerations
+    if tpu.chips > 0:
+        for template in spec.get("templates") or []:
+            if not isinstance(template, dict):
+                continue
+            for kind in ("container", "script"):  # both run as pods
+                runnable = template.get(kind)
+                if isinstance(runnable, dict):
+                    resources = runnable.setdefault("resources", {})
+                    limits = resources.setdefault("limits", {})
+                    limits.setdefault("google.com/tpu", tpu.chips)
+                    requests = resources.setdefault("requests", {})
+                    requests.setdefault("google.com/tpu", tpu.chips)
+
+
+def parse_workflow_from_healthcheck(hc: HealthCheck) -> dict:
+    """Build the probe workflow manifest
+    (reference: healthcheck_controller.go:876-1000 + submit-side
+    metadata at :502-522)."""
+    wf = hc.spec.workflow
+    if wf.resource is None:
+        raise WorkflowSpecError("workflow resource is nil")
+    data = _load_manifest(wf.resource.source)
+    spec = _spec_of(data, "workflow")
+
+    if spec.get("podGC") is None:
+        spec["podGC"] = {"strategy": POD_GC_ON_POD_COMPLETION}
+
+    # default the timeout from the repeat interval (reference: :981-986)
+    if wf.timeout == 0:
+        hc.spec.workflow.timeout = hc.spec.repeat_after_sec
+    timeout = hc.spec.workflow.timeout
+
+    if wf.resource.service_account:
+        spec["serviceAccountName"] = wf.resource.service_account
+    if spec.get("activeDeadlineSeconds") is None:
+        spec["activeDeadlineSeconds"] = timeout
+    if wf.tpu is not None:
+        _inject_tpu_placement(spec, wf.tpu)
+
+    data["apiVersion"] = WF_API_VERSION
+    data["kind"] = WF_KIND
+    data["metadata"] = _injected_metadata(
+        data, wf.generate_name, wf.resource.namespace, hc
+    )
+    data["spec"] = spec
+    return data
+
+
+def parse_remedy_workflow_from_healthcheck(hc: HealthCheck) -> dict:
+    """Build the remedy workflow manifest
+    (reference: healthcheck_controller.go:1002-1125 + :536-559)."""
+    remedy = hc.spec.remedy_workflow
+    if remedy.resource is None:
+        raise WorkflowSpecError("RemedyWorkflow Resource is nil")
+    data = _load_manifest(remedy.resource.source)
+    spec = _spec_of(data, "remedy workflow")
+
+    if spec.get("podGC") is None:
+        spec["podGC"] = {"strategy": POD_GC_ON_POD_COMPLETION}
+    if remedy.resource.service_account:
+        spec["serviceAccountName"] = remedy.resource.service_account
+
+    if remedy.tpu is not None:
+        # remedies inherit the placement machinery: a fix for a TPU node
+        # pool usually has to run on/next to that pool
+        _inject_tpu_placement(spec, remedy.tpu)
+
+    default_timeout = hc.spec.repeat_after_sec
+    deadline = spec.get("activeDeadlineSeconds")
+    if deadline is None:
+        spec["activeDeadlineSeconds"] = default_timeout
+        hc.spec.remedy_workflow.timeout = default_timeout
+    elif isinstance(deadline, (int, float)) and not isinstance(deadline, bool):
+        hc.spec.remedy_workflow.timeout = int(deadline)
+    else:
+        # non-numeric deadline in the manifest: fall back (reference: :1114-1119)
+        hc.spec.remedy_workflow.timeout = default_timeout
+
+    data["apiVersion"] = WF_API_VERSION
+    data["kind"] = WF_KIND
+    data["metadata"] = _injected_metadata(
+        data, remedy.generate_name, remedy.resource.namespace, hc
+    )
+    data["spec"] = spec
+    return data

@@ -1,0 +1,35 @@
+"""Workflow execution engines (submit/poll boundary)."""
+
+from activemonitor_tpu.engine.base import (
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    WF_API_VERSION,
+    WF_KIND,
+    WorkflowEngine,
+    generate_name,
+)
+from activemonitor_tpu.engine.fake import (
+    FakeWorkflowEngine,
+    fail_after,
+    never_complete,
+    succeed_after,
+)
+from activemonitor_tpu.engine.local import LocalProcessEngine
+
+__all__ = [
+    "FakeWorkflowEngine",
+    "LocalProcessEngine",
+    "PHASE_FAILED",
+    "PHASE_PENDING",
+    "PHASE_RUNNING",
+    "PHASE_SUCCEEDED",
+    "WF_API_VERSION",
+    "WF_KIND",
+    "WorkflowEngine",
+    "fail_after",
+    "generate_name",
+    "never_complete",
+    "succeed_after",
+]

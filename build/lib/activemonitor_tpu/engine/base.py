@@ -1,0 +1,63 @@
+"""Workflow execution boundary.
+
+The reference talks to Argo only through Workflow CRs — create one, then
+poll its ``status.phase`` across a process boundary
+(reference: healthcheck_controller.go:502-534 submit, :617 poll). That
+boundary is reproduced here as a small protocol with three
+implementations:
+
+- :class:`~activemonitor_tpu.engine.fake.FakeWorkflowEngine` — data model
+  real, no executor (the envtest trick, SURVEY.md §4): for tests.
+- :class:`~activemonitor_tpu.engine.local.LocalProcessEngine` — executes
+  workflow steps as local subprocesses: single-host TPU probe mode, no
+  Kubernetes required.
+- :class:`~activemonitor_tpu.engine.argo.ArgoWorkflowEngine` — real Argo
+  Workflow CRs via the Kubernetes API (import-gated).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+# GVK constants for Argo Workflow objects
+# (reference: healthcheck_controller.go:53-57)
+WF_API_VERSION = "argoproj.io/v1alpha1"
+WF_KIND = "Workflow"
+
+# instance-id label contract every submitted workflow carries
+# (reference: healthcheck_controller.go:64-65); also scopes the Argo
+# engine's watch cache to this controller's workflows
+WF_INSTANCE_ID_LABEL_KEY = "workflows.argoproj.io/controller-instanceid"
+WF_INSTANCE_ID = "activemonitor-workflows"
+
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+PHASE_RUNNING = "Running"
+PHASE_PENDING = "Pending"
+
+
+class WorkflowEngine(Protocol):
+    """Submit and poll probe workflows."""
+
+    async def submit(self, manifest: dict) -> str:
+        """Create the workflow; returns the generated name.
+
+        ``manifest`` carries metadata.namespace and metadata.generateName;
+        the engine resolves the final name (like the API server does for
+        generateName).
+        """
+        ...
+
+    async def get(self, namespace: str, name: str) -> Optional[dict]:
+        """Return the workflow object (with ``status.phase`` once known)
+        or None if it does not exist (deleted / GC'd)."""
+        ...
+
+
+def generate_name(prefix: str) -> str:
+    """Kubernetes-style generateName suffix: 5 chars from the reduced
+    alphanumeric alphabet the API server uses."""
+    import random
+
+    alphabet = "bcdfghjklmnpqrstvwxz2456789"
+    return prefix + "".join(random.choices(alphabet, k=5))

@@ -1,0 +1,274 @@
+"""Local-process workflow engine — single-host probe execution.
+
+Where the reference always delegates to the Argo controller to run probe
+pods (SURVEY.md §2 #14), TPU probes frequently run on the very host that
+owns the TPU: a GKE TPU VM, a bare v5e host, or a dev box. This engine
+executes a bounded subset of the Argo Workflow shape directly as local
+subprocesses, so the full check → probe → status → metrics loop works
+with no cluster at all.
+
+Supported template forms (the subset the probe library and the reference
+examples use):
+
+- ``container``: ``command`` + ``args`` exec'd locally (the image field
+  is ignored — the local host IS the probe environment)
+- ``script``: ``source`` written to a temp file and run with ``command``
+- ``steps``: sequential groups of template references
+
+``spec.entrypoint`` selects the template;
+``spec.activeDeadlineSeconds`` bounds execution (timeout ⇒ Failed, like
+Argo). Children run via the synchronous subprocess API on worker
+threads (``asyncio.to_thread``) rather than asyncio's subprocess
+transport: the transport only reports exit once the stdout pipe hits
+EOF, and a killed child's grandchildren (e.g. anything ``sh -c``
+forked) keep that pipe open — ``Popen`` lets the timeout path reap
+with ``wait()`` without draining the pipe.
+
+A probe's final stdout line, when it parses as the custom-metrics JSON
+contract (reference: internal/metrics/collector.go:68-115), is exposed
+as ``status.outputs.parameters[0]`` exactly like an Argo global output
+parameter, so custom metrics flow identically in all engines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from activemonitor_tpu.engine.base import (
+    PHASE_FAILED,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    generate_name,
+)
+
+
+class _StepFailed(RuntimeError):
+    pass
+
+
+class _DeadlineExceeded(RuntimeError):
+    pass
+
+
+class LocalProcessEngine:
+    def __init__(self, env: Optional[dict] = None, default_ttl_seconds: float = 3600.0):
+        self._workflows: Dict[str, dict] = {}
+        self._tasks: Dict[str, asyncio.Task] = {}
+        self._finished_at: Dict[str, float] = {}
+        self._env = env
+        # terminal workflows are pruned after their manifest's
+        # ttlSecondsAfterFinished (or this default) — the local stand-in
+        # for Argo's TTL controller, so a long-lived daemon's workflow
+        # map doesn't grow without bound
+        self._default_ttl = default_ttl_seconds
+
+    async def submit(self, manifest: dict) -> str:
+        self._prune()
+        manifest = copy.deepcopy(manifest)
+        meta = manifest.setdefault("metadata", {})
+        name = meta.get("name") or generate_name(meta.get("generateName", "wf-"))
+        meta["name"] = name
+        namespace = meta.get("namespace", "default")
+        key = f"{namespace}/{name}"
+        manifest["status"] = {"phase": PHASE_RUNNING}
+        # a reused key must shed its old finished-timestamp, or a later
+        # prune would evict the RUNNING resubmission
+        self._finished_at.pop(key, None)
+        self._workflows[key] = manifest
+        self._tasks[key] = asyncio.create_task(self._run(key, manifest))
+        return name
+
+    # effective TTLs are floored so a finished workflow always outlives
+    # the reconciler's slowest status poll: the poll backoff maxes at
+    # workflowtimeout/2, and activeDeadlineSeconds carries that timeout
+    # into the manifest — so the floor is max(60s, activeDeadlineSeconds)
+    MIN_TTL_SECONDS = 60.0
+
+    def _prune(self) -> None:
+        now = time.monotonic()
+        doomed = []
+        for key, finished in self._finished_at.items():
+            spec = (self._workflows.get(key) or {}).get("spec") or {}
+            ttl = spec.get("ttlSecondsAfterFinished", self._default_ttl)
+            try:
+                ttl = float(ttl)
+            except (TypeError, ValueError):
+                ttl = self._default_ttl
+            try:
+                deadline = float(spec.get("activeDeadlineSeconds") or 0)
+            except (TypeError, ValueError):
+                deadline = 0.0
+            if now - finished > max(ttl, self.MIN_TTL_SECONDS, deadline):
+                doomed.append(key)
+        for key in doomed:
+            self._workflows.pop(key, None)
+            self._tasks.pop(key, None)
+            self._finished_at.pop(key, None)
+
+    async def get(self, namespace: str, name: str) -> Optional[dict]:
+        wf = self._workflows.get(f"{namespace}/{name}")
+        return copy.deepcopy(wf) if wf is not None else None
+
+    async def shutdown(self) -> None:
+        """Wait out all in-flight workflow tasks (tests / clean exit)."""
+        tasks = [t for t in self._tasks.values() if not t.done()]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _run(self, key: str, manifest: dict) -> None:
+        try:
+            await self._run_inner(manifest)
+        finally:
+            # only the task currently owning the key may stamp it:
+            # a stale overlapping run must not mark a resubmitted
+            # RUNNING workflow as finished (and thus prunable)
+            if self._tasks.get(key) is asyncio.current_task():
+                self._finished_at[key] = time.monotonic()
+
+    async def _run_inner(self, manifest: dict) -> None:
+        spec = manifest.get("spec") or {}
+        deadline = spec.get("activeDeadlineSeconds")
+        deadline_at = (
+            time.monotonic() + float(deadline) if deadline else None
+        )
+        outputs_lines: List[str] = []
+        try:
+            await self._run_template_by_name(
+                spec, spec.get("entrypoint", ""), outputs_lines, deadline_at
+            )
+        except _DeadlineExceeded:
+            manifest["status"] = {
+                "phase": PHASE_FAILED,
+                "message": f"exceeded activeDeadlineSeconds {deadline}",
+            }
+            return
+        except _StepFailed as e:
+            manifest["status"] = {"phase": PHASE_FAILED, "message": str(e)}
+            self._attach_outputs(manifest, outputs_lines)
+            return
+        except Exception as e:  # malformed template etc.
+            manifest["status"] = {"phase": PHASE_FAILED, "message": repr(e)}
+            return
+        manifest["status"] = {"phase": PHASE_SUCCEEDED}
+        self._attach_outputs(manifest, outputs_lines)
+
+    def _attach_outputs(self, manifest: dict, lines: List[str]) -> None:
+        """Expose a trailing metrics-contract JSON line as a global
+        output parameter, mirroring Argo's outputs.parameters shape."""
+        for line in reversed(lines):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(doc, dict) and "metrics" in doc:
+                manifest["status"]["outputs"] = {
+                    "parameters": [{"name": "metrics", "value": line}]
+                }
+                return
+
+    async def _run_template_by_name(
+        self,
+        spec: dict,
+        name: str,
+        collect: List[str],
+        deadline_at: Optional[float],
+    ) -> None:
+        templates = {t.get("name"): t for t in spec.get("templates", [])}
+        if name not in templates:
+            raise ValueError(f"entrypoint template {name!r} not found")
+        await self._run_template(spec, templates[name], collect, deadline_at)
+
+    async def _run_template(
+        self,
+        spec: dict,
+        template: dict,
+        collect: List[str],
+        deadline_at: Optional[float],
+    ) -> None:
+        if "steps" in template:
+            for group in template["steps"]:
+                steps = group if isinstance(group, list) else [group]
+                for step in steps:
+                    await self._run_template_by_name(
+                        spec, step.get("template", ""), collect, deadline_at
+                    )
+            return
+        if "container" in template:
+            c = template["container"]
+            argv = list(c.get("command", [])) + [str(a) for a in c.get("args", [])]
+            if not argv:
+                raise ValueError("container template has no command")
+            await self._exec(argv, collect, deadline_at)
+            return
+        if "script" in template:
+            s = template["script"]
+            interpreter = list(s.get("command", [sys.executable]))
+            suffix = ".py" if "python" in " ".join(interpreter) else ".sh"
+            with tempfile.NamedTemporaryFile("w", suffix=suffix, delete=False) as f:
+                f.write(s.get("source", ""))
+                path = f.name
+            try:
+                await self._exec(interpreter + [path], collect, deadline_at)
+            finally:
+                os.unlink(path)
+            return
+        raise ValueError(f"unsupported template shape: {sorted(template.keys())}")
+
+    async def _exec(
+        self, argv: List[str], collect: List[str], deadline_at: Optional[float]
+    ) -> None:
+        if deadline_at is not None and time.monotonic() >= deadline_at:
+            raise _DeadlineExceeded()
+        remaining = (
+            None if deadline_at is None else max(0.01, deadline_at - time.monotonic())
+        )
+        out, returncode = await asyncio.to_thread(
+            self._exec_sync, argv, remaining
+        )
+        if returncode is None:
+            raise _DeadlineExceeded()
+        collect.extend(out.decode("utf-8", "replace").splitlines())
+        if returncode != 0:
+            tail = out.decode("utf-8", "replace").strip().splitlines()[-3:]
+            raise _StepFailed(f"{argv[0]} exited {returncode}: {' | '.join(tail)}")
+
+    def _exec_sync(self, argv: List[str], timeout: Optional[float]):
+        """Runs on a worker thread. Returns (output, returncode); a None
+        returncode means the deadline was hit and the child was killed."""
+        import subprocess
+
+        proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=self._env,
+            start_new_session=True,  # own process group so the deadline
+            # path can kill forked grandchildren too
+        )
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            # reap with wait(), NOT communicate(): grandchildren inherit
+            # the stdout pipe, so draining to EOF would block until the
+            # whole process tree exits, not just our child
+            proc.wait()
+            if proc.stdout is not None:
+                proc.stdout.close()
+            return b"", None
+        return out, proc.returncode

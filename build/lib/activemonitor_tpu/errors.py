@@ -1,0 +1,13 @@
+"""Shared exception types."""
+
+
+class MissingDependencyError(RuntimeError):
+    """A required credential/backend is unavailable (e.g. cluster mode
+    without any Kubernetes credentials). The CLI turns this into a
+    usage error."""
+
+
+class ConfigurationError(ValueError):
+    """An invalid flag/option combination. Subclasses ValueError so
+    library callers can catch broadly, while the CLI catches exactly
+    this (not every internal ValueError) for its usage-error exit."""

@@ -1,0 +1,23 @@
+"""Minimal native Kubernetes REST layer.
+
+The reference talks to the API server through client-go / controller-
+runtime (reference: cmd/main.go:70-118, healthcheck_controller.go:134,
+:155, :617). This package is the framework's own equivalent: a small
+async REST client built directly on aiohttp — no dependency on the
+``kubernetes`` Python package — plus an in-process stub API server
+(:mod:`activemonitor_tpu.kube.stub`) that plays the role the reference's
+envtest binaries play in its integration tier (reference:
+internal/controllers/suite_test.go:67-134).
+"""
+
+from activemonitor_tpu.kube.client import ApiError, KubeApi, api_path, core_path
+from activemonitor_tpu.kube.config import KubeConfig, load_kube_config
+
+__all__ = [
+    "ApiError",
+    "KubeApi",
+    "KubeConfig",
+    "api_path",
+    "core_path",
+    "load_kube_config",
+]

@@ -1,0 +1,149 @@
+"""Kubernetes-native scrape authn/z — TokenReview + SubjectAccessReview.
+
+The reference guards /metrics with controller-runtime's
+``WithAuthenticationAndAuthorization`` filter
+(/root/reference/cmd/main.go:74-81): every scrape's bearer token is
+validated by the API server (TokenReview) and the resulting identity
+is authorized for the endpoint (SubjectAccessReview on the
+non-resource URL). This module is that filter for the aiohttp metrics
+endpoint: the cluster decides who may scrape, per identity, with RBAC
+— no shared static secret to rotate.
+
+Decisions are cached per token for a short TTL (the filter would
+otherwise issue two API-server round trips per scrape; controller-
+runtime caches the same way). Infra failures return ``None`` so the
+caller can apply its fallback policy (static token if configured,
+else fail closed) — an API-server blip must not silently open the
+endpoint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import time
+from typing import Dict, List, Optional, Tuple
+
+from activemonitor_tpu.kube.client import KubeApi
+
+TOKENREVIEW_PATH = "/apis/authentication.k8s.io/v1/tokenreviews"
+SAR_PATH = "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+
+
+class KubeScrapeAuthorizer:
+    """allowed(token) -> True | False | None (infra failure)."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        path: str = "/metrics",
+        verb: str = "get",
+        cache_ttl: float = 60.0,
+        negative_ttl: float = 10.0,
+        monotonic=time.monotonic,
+        max_entries: int = 1024,
+    ):
+        self._api = api
+        self._path = path
+        self._verb = verb
+        self._ttl = cache_ttl
+        # denials age out faster: a scraper whose token/RBAC was just
+        # provisioned must not keep eating 401s for a full positive TTL
+        # (controller-runtime's filter uses a short failure TTL the
+        # same way)
+        self._neg_ttl = negative_ttl
+        self._monotonic = monotonic
+        self._max_entries = max_entries
+        # sha256(token) -> (expiry, verdict); only definitive verdicts
+        # cached. Hashing keeps raw bearer tokens out of process memory
+        # dumps, and eviction is per-entry so junk-token spam cannot
+        # flush the legitimate scraper's verdict wholesale
+        self._cache: Dict[str, Tuple[float, bool]] = {}
+        # (expiry, key) min-heap mirroring the cache, with lazy
+        # invalidation (a re-remembered key leaves its old heap entry
+        # behind; the pop loop skips entries whose expiry no longer
+        # matches). Keeps eviction O(log n) per insert — a junk-token
+        # flood at capacity must not pay a full-cache scan per request
+        self._expiries: List[Tuple[float, str]] = []
+
+    @staticmethod
+    def _key(token: str) -> str:
+        return hashlib.sha256(token.encode()).hexdigest()
+
+    async def allowed(self, token: str) -> Optional[bool]:
+        if not token:
+            return False
+        now = self._monotonic()
+        key = self._key(token)
+        hit = self._cache.get(key)
+        if hit is not None and hit[0] > now:
+            return hit[1]
+
+        try:
+            review = await self._api.create(
+                TOKENREVIEW_PATH,
+                {
+                    "apiVersion": "authentication.k8s.io/v1",
+                    "kind": "TokenReview",
+                    "spec": {"token": token},
+                },
+            )
+        except Exception:
+            # includes 401/403 on OUR credentials (a setup problem —
+            # missing system:auth-delegator binding — not a verdict on
+            # the scraper): every failure to ASK is an infra failure,
+            # never a deny
+            return None
+        status = review.get("status") or {}
+        if not status.get("authenticated"):
+            self._remember(key, False, now)
+            return False
+        user = status.get("user") or {}
+
+        try:
+            sar = await self._api.create(
+                SAR_PATH,
+                {
+                    "apiVersion": "authorization.k8s.io/v1",
+                    "kind": "SubjectAccessReview",
+                    "spec": {
+                        "user": user.get("username", ""),
+                        "groups": user.get("groups") or [],
+                        "uid": user.get("uid", ""),
+                        "nonResourceAttributes": {
+                            "path": self._path,
+                            "verb": self._verb,
+                        },
+                    },
+                },
+            )
+        except Exception:
+            return None
+        verdict = bool((sar.get("status") or {}).get("allowed"))
+        self._remember(key, verdict, now)
+        return verdict
+
+    def _remember(self, key: str, verdict: bool, now: float) -> None:
+        if key not in self._cache and len(self._cache) >= self._max_entries:
+            # bound memory under token churn WITHOUT collateral damage:
+            # the heap yields expired entries first, then the soonest-
+            # to-expire — a spammer cycling junk tokens (shortest,
+            # negative TTLs) evicts its own junk, not the legitimate
+            # scraper's fresh verdict
+            while self._expiries and len(self._cache) >= self._max_entries:
+                exp, k = heapq.heappop(self._expiries)
+                live = self._cache.get(k)
+                if live is not None and live[0] == exp:
+                    del self._cache[k]
+        ttl = self._ttl if verdict else self._neg_ttl
+        expiry = now + ttl
+        self._cache[key] = (expiry, verdict)
+        heapq.heappush(self._expiries, (expiry, key))
+        if len(self._expiries) > 2 * self._max_entries:
+            # compact stale (re-remembered) heap entries so the heap
+            # stays O(max_entries) even under verdict refresh churn
+            self._expiries = [
+                (exp, k)
+                for k, (exp, _v) in self._cache.items()
+            ]
+            heapq.heapify(self._expiries)

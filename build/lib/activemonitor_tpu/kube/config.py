@@ -1,0 +1,321 @@
+"""Cluster credential discovery.
+
+Mirrors client-go's loading rules in the order the reference relies on
+(reference: cmd/main.go:70 ``ctrl.GetConfigOrDie`` — in-cluster service
+account first, kubeconfig otherwise): the mounted service-account
+token/CA when running in a pod, else the file named by ``$KUBECONFIG``,
+else ``~/.kube/config``.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+import yaml
+
+from activemonitor_tpu.errors import MissingDependencyError
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeConfigError(MissingDependencyError):
+    """No usable cluster credentials were found."""
+
+
+@dataclass
+class KubeConfig:
+    server: str  # e.g. https://10.0.0.1:443 or http://127.0.0.1:8001
+    token: str = ""
+    # bound service-account tokens rotate (~1h); when set, the token is
+    # re-read from this file with a short TTL instead of cached forever
+    # (client-go re-reads per request for the same reason)
+    token_file: str = ""
+    ca_data: bytes = b""  # PEM; empty means system trust store
+    client_cert_data: bytes = b""  # PEM pair for mTLS kubeconfigs
+    client_key_data: bytes = b""
+    verify_tls: bool = True
+    namespace: str = "default"
+    # kubeconfig user.exec credential plugin (gke-gcloud-auth-plugin,
+    # aws eks get-token, ...): run on demand, cached until expiry
+    exec_spec: Optional[dict] = None
+    _tempfiles: list = field(default_factory=list, repr=False)
+    _file_token: object = field(default=None, repr=False)
+    _exec_valid_until: float = field(default=0.0, repr=False)
+
+    def cached_token(self) -> Optional[str]:
+        """The token WITHOUT any refresh, or None when a (potentially
+        slow, blocking) refresh is needed — the async client's lock-free
+        fast path. Owns the freshness rule so callers never touch the
+        internals."""
+        import time
+
+        if self.exec_spec is not None:
+            if time.monotonic() < self._exec_valid_until:
+                return self.token
+            return None
+        return None  # non-exec refreshes are cheap; take the slow path
+
+    def bearer_token(self) -> str:
+        """The current token, honoring file rotation and exec plugins."""
+        import time
+
+        if self.exec_spec is not None:
+            if time.monotonic() >= self._exec_valid_until:
+                self._run_exec_plugin()
+            return self.token
+        if self.token_file:
+            if self._file_token is None:
+                from activemonitor_tpu.utils.tokenfile import FileToken
+
+                self._file_token = FileToken(self.token_file, initial=self.token)
+            self.token = self._file_token.get() or self.token
+        return self.token
+
+    def _run_exec_plugin(self) -> None:
+        """client-go exec credential protocol: run the plugin, parse the
+        ExecCredential JSON it prints, cache the token until its
+        expirationTimestamp (minus slack), or for the default token TTL
+        when the plugin reports no expiry."""
+        import datetime
+        import json
+        import subprocess
+        import time
+
+        spec = self.exec_spec or {}
+        cmd = [spec.get("command", "")] + list(spec.get("args") or [])
+        env = dict(os.environ)
+        for entry in spec.get("env") or []:
+            env[entry.get("name", "")] = entry.get("value", "")
+        env["KUBERNETES_EXEC_INFO"] = json.dumps(
+            {
+                "apiVersion": spec.get(
+                    "apiVersion", "client.authentication.k8s.io/v1beta1"
+                ),
+                "kind": "ExecCredential",
+                "spec": {"interactive": False},
+            }
+        )
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, env=env, timeout=60, check=False
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise KubeConfigError(f"credential plugin {cmd[0]!r} failed: {e}") from e
+        if proc.returncode != 0:
+            raise KubeConfigError(
+                f"credential plugin {cmd[0]!r} exited {proc.returncode}: "
+                f"{proc.stderr.decode(errors='replace')[:300]}"
+            )
+        try:
+            status = (json.loads(proc.stdout) or {}).get("status") or {}
+        except json.JSONDecodeError as e:
+            raise KubeConfigError(
+                f"credential plugin {cmd[0]!r} printed invalid JSON"
+            ) from e
+        if status.get("clientCertificateData"):
+            raise KubeConfigError(
+                f"credential plugin {cmd[0]!r} returned client certificates, "
+                "which this client does not support; use a token-issuing "
+                "plugin (e.g. gke-gcloud-auth-plugin) or static credentials"
+            )
+        token = status.get("token", "")
+        if not token:
+            raise KubeConfigError(
+                f"credential plugin {cmd[0]!r} returned no token"
+            )
+        from activemonitor_tpu.utils.tokenfile import DEFAULT_TTL
+
+        self.token = token
+        valid = DEFAULT_TTL
+        expiry_raw = status.get("expirationTimestamp")
+        if expiry_raw:
+            try:
+                expiry = datetime.datetime.fromisoformat(
+                    str(expiry_raw).replace("Z", "+00:00")
+                )
+                now = datetime.datetime.now(datetime.timezone.utc)
+                valid = max(0.0, (expiry - now).total_seconds() - 60.0)
+            except ValueError:
+                pass
+        self._exec_valid_until = time.monotonic() + valid
+
+    def ssl_context(self) -> Optional[ssl.SSLContext]:
+        """An SSLContext for https servers; None for plain http (the
+        stub server / kubectl proxy)."""
+        if not self.server.startswith("https"):
+            return None
+        if not self.verify_tls:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_data:
+            ctx = ssl.create_default_context(cadata=self.ca_data.decode())
+        else:
+            ctx = ssl.create_default_context()
+        if self.client_cert_data and self.client_key_data:
+            # load_cert_chain only takes paths — stage the PEMs in files
+            # that live as long as this config object
+            cert = tempfile.NamedTemporaryFile(suffix=".pem", delete=False)
+            cert.write(self.client_cert_data)
+            cert.close()
+            key = tempfile.NamedTemporaryFile(suffix=".pem", delete=False)
+            key.write(self.client_key_data)
+            key.close()
+            self._tempfiles.extend([cert.name, key.name])
+            ctx.load_cert_chain(cert.name, key.name)
+        return ctx
+
+    def __del__(self):
+        for path in self._tempfiles:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def _read_maybe(path: str) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _b64_or_file(entry: dict, data_key: str, file_key: str) -> bytes:
+    if entry.get(data_key):
+        return base64.b64decode(entry[data_key])
+    if entry.get(file_key):
+        return _read_maybe(entry[file_key]) or b""
+    return b""
+
+
+def incluster_config() -> Optional[KubeConfig]:
+    """The mounted service-account credentials, if running in a pod."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_path = os.path.join(SERVICEACCOUNT_DIR, "token")
+    token = _read_maybe(token_path)
+    if not host or token is None:
+        return None
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # IPv6 literal must be bracketed in a URL
+    ca = _read_maybe(os.path.join(SERVICEACCOUNT_DIR, "ca.crt")) or b""
+    namespace = _read_maybe(os.path.join(SERVICEACCOUNT_DIR, "namespace")) or b"default"
+    return KubeConfig(
+        server=f"https://{host}:{port}",
+        token=token.decode().strip(),
+        token_file=token_path,
+        ca_data=ca,
+        namespace=namespace.decode().strip(),
+    )
+
+
+def kubeconfig_file_config(path: Optional[str] = None) -> Optional[KubeConfig]:
+    """Parse a kubeconfig file (current-context only). Without an
+    explicit path, $KUBECONFIG is honored as kubectl defines it — a
+    colon-separated list, first file with a usable current-context wins —
+    then ~/.kube/config."""
+    if path is None:
+        candidates = [
+            p for p in os.environ.get("KUBECONFIG", "").split(os.pathsep) if p
+        ] or [os.path.expanduser("~/.kube/config")]
+        first_error: KubeConfigError | None = None
+        for candidate in candidates:
+            try:
+                cfg = kubeconfig_file_config(candidate)
+            except KubeConfigError as e:
+                first_error = first_error or e
+                continue  # unusable credentials: try the next file
+            if cfg is not None:
+                return cfg
+        if first_error is not None:
+            # a file EXISTED but its credentials are unusable: silently
+            # falling through to other credential sources would connect
+            # to a different cluster than the operator named
+            raise first_error
+        return None
+    raw = _read_maybe(path)
+    if raw is None:
+        return None
+    try:
+        doc = yaml.safe_load(raw) or {}
+        contexts = {c["name"]: c.get("context", {}) for c in doc.get("contexts", [])}
+        clusters = {c["name"]: c.get("cluster", {}) for c in doc.get("clusters", [])}
+        users = {u["name"]: u.get("user", {}) for u in doc.get("users", [])}
+        current = doc.get("current-context")
+        if not current or current not in contexts:
+            return None
+        ctx = contexts[current]
+        cluster = clusters.get(ctx.get("cluster", ""), {})
+        user = users.get(ctx.get("user", ""), {})
+        server = cluster.get("server", "")
+        if not server:
+            return None
+        cfg = KubeConfig(
+            server=server,
+            token=user.get("token", ""),
+            ca_data=_b64_or_file(
+                cluster, "certificate-authority-data", "certificate-authority"
+            ),
+            client_cert_data=_b64_or_file(
+                user, "client-certificate-data", "client-certificate"
+            ),
+            client_key_data=_b64_or_file(user, "client-key-data", "client-key"),
+            verify_tls=not cluster.get("insecure-skip-tls-verify", False),
+            namespace=ctx.get("namespace", "default"),
+            exec_spec=user.get("exec"),
+        )
+        if (
+            server.startswith("https")
+            and not cfg.token
+            and not cfg.client_cert_data
+            and cfg.exec_spec is None
+        ):
+            # fail at load time with an explanation, not at runtime with
+            # anonymous 401s (http servers — kubectl proxy, test stubs —
+            # are legitimately unauthenticated)
+            auth_provider = (user.get("auth-provider") or {}).get("name", "none")
+            raise KubeConfigError(
+                f"kubeconfig user has no usable credentials (auth-provider "
+                f"{auth_provider!r} is not supported; supported: token, "
+                "client certificates, exec plugins)"
+            )
+        return cfg
+    except (KeyError, AttributeError, TypeError, yaml.YAMLError) as e:
+        # structurally malformed is NOT the same as missing: the operator
+        # named this file, so silently falling through to other
+        # credential sources could connect to the wrong cluster
+        raise KubeConfigError(
+            f"malformed kubeconfig at {path!r}: {type(e).__name__}: {e}"
+        ) from e
+
+
+def load_kube_config(kubeconfig: Optional[str] = None) -> KubeConfig:
+    """client-go / controller-runtime precedence: explicit path, then
+    $KUBECONFIG, then in-cluster credentials, then ~/.kube/config — a
+    pod that deliberately sets KUBECONFIG (hosted-control-plane pattern)
+    must reach THAT cluster, not its local one."""
+    if kubeconfig:
+        cfg = kubeconfig_file_config(kubeconfig)
+        if cfg is None:
+            raise KubeConfigError(f"unusable kubeconfig at {kubeconfig!r}")
+        return cfg
+    if os.environ.get("KUBECONFIG"):
+        # delegate the colon-separated-list iteration (first usable wins)
+        cfg = kubeconfig_file_config(None)
+        if cfg is not None:
+            return cfg
+    cfg = incluster_config() or kubeconfig_file_config(
+        os.path.expanduser("~/.kube/config")
+    )
+    if cfg is None:
+        raise KubeConfigError(
+            "no Kubernetes credentials found (not in a pod, and no kubeconfig "
+            "at $KUBECONFIG or ~/.kube/config); cluster mode needs one of these"
+        )
+    return cfg

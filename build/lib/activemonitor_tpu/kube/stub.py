@@ -1,0 +1,748 @@
+"""In-process stub Kubernetes API server.
+
+The reference's integration tier runs a real kube-apiserver via envtest
+(reference: internal/controllers/suite_test.go:67-134) — the data model
+is real, no controllers run. This module is that tier for this
+framework: a generic aiohttp server speaking enough of the Kubernetes
+REST dialect for every cluster-mode component to run against it for
+real — CRUD + generateName, resourceVersion conflict semantics, the
+status subresource, JSON merge patch, list + streaming watch, and
+optional bearer-token auth. Resource-agnostic by design: HealthChecks,
+Argo Workflows, RBAC objects, Leases and Events all flow through the
+same store, like an API server with ``x-kubernetes-preserve-unknown-
+fields`` CRDs installed (the reference's trick for Argo Workflows,
+config/crd/bases/argoproj_v1alpha1_workflows.yaml).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import secrets
+from typing import Dict, List, Tuple
+
+Key = Tuple[str, str, str]  # (group, version, plural); core v1 -> ("", "v1", ...)
+
+
+def _match_selector(obj: dict, selector: str) -> bool:
+    """Equality-based labelSelector (``k=v,k2=v2``) — the subset the
+    framework's clients use."""
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for clause in selector.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        k, _, v = clause.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+def _json_type(value) -> str:
+    """The JSON type name apiserver error messages use."""
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, int):
+        return "integer"
+    if isinstance(value, float):
+        return "number"
+    if isinstance(value, str):
+        return "string"
+    if isinstance(value, list):
+        return "array"
+    if isinstance(value, dict):
+        return "object"
+    return "null"
+
+
+def _validate_openapi(value, schema: dict, path: str, causes: list) -> None:
+    """Structural-schema subset of apiserver CRD validation: type,
+    required, enum, properties/items recursion. Renders causes in the
+    real wire shape ({reason, message, field}) so the 422 the stub
+    returns matches the machine format fixtures pin
+    (tests/fixtures/apiserver/invalid_422.json). Unknown fields are
+    accepted (the stub models preserve-unknown-fields CRDs; pruning is
+    out of scope), and ``metadata`` is skipped at the root — the real
+    apiserver validates ObjectMeta separately from the CRD schema."""
+    expected = schema.get("type")
+    if expected:
+        actual = _json_type(value)
+        if actual != expected and not (
+            expected == "number" and actual == "integer"
+        ):
+            causes.append(
+                {
+                    "reason": "FieldValueInvalid",
+                    "message": (
+                        f'Invalid value: "{actual}": {path or "body"} in '
+                        f'body must be of type {expected}: "{actual}"'
+                    ),
+                    "field": path or "<root>",
+                }
+            )
+            return  # children of a mistyped node can't be checked
+    if "enum" in schema and value not in schema["enum"]:
+        supported = ", ".join(f'"{v}"' for v in schema["enum"])
+        causes.append(
+            {
+                "reason": "FieldValueNotSupported",
+                "message": (
+                    f'Unsupported value: "{value}": supported values: '
+                    f"{supported}"
+                ),
+                "field": path or "<root>",
+            }
+        )
+    if isinstance(value, dict):
+        props = schema.get("properties") or {}
+        for req in schema.get("required") or []:
+            if req not in value:
+                causes.append(
+                    {
+                        "reason": "FieldValueRequired",
+                        "message": "Required value",
+                        "field": f"{path}.{req}" if path else req,
+                    }
+                )
+        for k, v in value.items():
+            if not path and k == "metadata":
+                continue
+            if k in props:
+                _validate_openapi(
+                    v, props[k], f"{path}.{k}" if path else k, causes
+                )
+    if isinstance(value, list) and "items" in schema:
+        for i, item in enumerate(value):
+            _validate_openapi(item, schema["items"], f"{path}[{i}]", causes)
+
+
+def merge_patch(target, patch):
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    result = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            result.pop(k, None)
+        else:
+            result[k] = merge_patch(result.get(k), v)
+    return result
+
+
+class StubApiServer:
+    """Start with :meth:`start`, point a :class:`KubeApi` at ``.url``."""
+
+    def __init__(self, token: str = ""):
+        self._token = token
+        self._objects: Dict[Key, Dict[Tuple[str, str], dict]] = {}
+        self._rv = 0
+        # bounded event history for watch resume; (rv, key, event)
+        self._history: List[Tuple[int, Key, str, dict]] = []
+        self._watchers: List[dict] = []
+        self._runner = None
+        self.url = ""
+        self.requests: List[Tuple[str, str]] = []  # (method, path) log
+        # every watch connection's query params, for tests asserting
+        # resume behavior (which resourceVersion a reconnect carried)
+        self.watch_params: List[dict] = []
+        # schema registry: key -> (Kind, openAPIV3Schema). Registered
+        # resources get real server-side 422 validation (see
+        # register_crd); unregistered ones stay schemaless, like CRDs
+        # with x-kubernetes-preserve-unknown-fields
+        self._schemas: Dict[Key, Tuple[str, dict]] = {}
+        self._kinds: Dict[Key, str] = {}  # last-seen kind per resource
+        # watch BOOKMARK cadence for clients that sent
+        # allowWatchBookmarks=true (real apiservers send them about
+        # once a minute; tests shrink this to exercise the path)
+        self.bookmark_interval = 60.0
+        # chaos injection (see inject_fault / drop_watches / latency)
+        self.faults: List[dict] = []
+        self.latency = 0.0
+        # TokenReview/SubjectAccessReview tables (kube-native scrape
+        # auth tests): token -> username it authenticates as, and the
+        # set of usernames allowed to GET non-resource /metrics
+        self.scrape_tokens: Dict[str, str] = {}
+        self.metrics_allowed_users: set = set()
+
+    # -- store ----------------------------------------------------------
+    def _bump(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _bucket(self, key: Key) -> Dict[Tuple[str, str], dict]:
+        return self._objects.setdefault(key, {})
+
+    def _broadcast(self, key: Key, namespace: str, type_: str, obj: dict) -> None:
+        event = {"type": type_, "object": copy.deepcopy(obj)}
+        self._history.append((self._rv, key, namespace, event))
+        del self._history[:-1000]
+        for w in self._watchers:
+            if (
+                w["key"] == key
+                and (not w["namespace"] or w["namespace"] == namespace)
+                and _match_selector(obj, w["selector"])
+            ):
+                w["queue"].put_nowait(event)
+
+    # test-visible accessors -------------------------------------------
+    def obj(self, group: str, version: str, plural: str, namespace: str, name: str):
+        return self._bucket((group, version, plural)).get((namespace, name))
+
+    def objs(self, group: str, version: str, plural: str) -> List[dict]:
+        return list(self._bucket((group, version, plural)).values())
+
+    def seed(self, group: str, version: str, plural: str, obj: dict) -> dict:
+        """Directly place an object (test fixture setup)."""
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("resourceVersion", self._bump())
+        meta.setdefault("uid", secrets.token_hex(8))
+        key = (group, version, plural)
+        if obj.get("kind"):
+            self._kinds.setdefault(key, obj["kind"])
+        namespace = meta.get("namespace", "")
+        self._bucket(key)[(namespace, meta["name"])] = obj
+        self._broadcast(key, namespace, "ADDED", obj)
+        return obj
+
+    # -- schema validation ----------------------------------------------
+    def register_schema(
+        self, group: str, version: str, plural: str, kind: str, schema: dict
+    ) -> None:
+        """Turn on server-side 422 validation for one resource. The
+        schema is an openAPIV3Schema dict (what a CRD manifest carries);
+        creates and updates of this resource are validated and rejected
+        with a real ``Invalid`` Status carrying ``details.causes``, the
+        way a real apiserver enforces structural CRD schemas."""
+        key = (group, version, plural)
+        self._schemas[key] = (kind, schema)
+        self._kinds[key] = kind
+
+    def register_crd(self, crd: dict) -> None:
+        """Install a CRD manifest (e.g. ``api.crd.build_crd()``):
+        registers the served version's schema for validation."""
+        spec = crd["spec"]
+        group = spec["group"]
+        plural = spec["names"]["plural"]
+        kind = spec["names"]["kind"]
+        for version in spec["versions"]:
+            schema = (version.get("schema") or {}).get("openAPIV3Schema")
+            if schema:
+                self.register_schema(
+                    group, version["name"], plural, kind, schema
+                )
+
+    def _invalid(self, key: Key, name: str, causes: List[dict]):
+        """422 Invalid the way apimachinery's NewInvalid renders it:
+        message aggregates every cause (bracketed when more than one),
+        details.kind is the KIND (unlike NotFound's resource)."""
+        kind = self._schemas[key][0]
+        group = key[0]
+        qualified = f"{kind}.{group}" if group else kind
+        parts = [f"{c['field']}: {c['message']}" for c in causes]
+        agg = parts[0] if len(parts) == 1 else "[" + ", ".join(parts) + "]"
+        return self._error(
+            422,
+            f'{qualified} "{name}" is invalid: {agg}',
+            reason="Invalid",
+            details={
+                "name": name,
+                "group": group,
+                "kind": kind,
+                "causes": causes,
+            },
+        )
+
+    def _schema_causes(self, key: Key, obj: dict) -> List[dict]:
+        entry = self._schemas.get(key)
+        if entry is None:
+            return []
+        causes: List[dict] = []
+        _validate_openapi(obj, entry[1], "", causes)
+        return causes
+
+    # -- chaos injection (the fault-injection tier: SURVEY.md §5.3) ----
+    def inject_fault(
+        self,
+        path_substr: str,
+        *,
+        status: int = 500,
+        times: int = 1,
+        method: str = "",
+    ) -> None:
+        """The next ``times`` requests whose path contains
+        ``path_substr`` (and match ``method``, if given) fail with
+        ``status``. Faults are consumed in registration order."""
+        self.faults.append(
+            {
+                "path_substr": path_substr,
+                "status": status,
+                "remaining": times,
+                "method": method.upper(),
+            }
+        )
+
+    def _consume_fault(self, request):
+        for fault in self.faults:
+            if fault["remaining"] <= 0:
+                continue
+            if fault["method"] and fault["method"] != request.method:
+                continue
+            if fault["path_substr"] not in request.path:
+                continue
+            fault["remaining"] -= 1
+            return self._error(
+                fault["status"], f"chaos: injected {fault['status']}"
+            )
+        return None
+
+    def drop_watches(self) -> int:
+        """Abruptly end every live watch stream (the client sees EOF and
+        must reconnect). Returns how many streams were dropped."""
+        dropped = 0
+        for w in list(self._watchers):
+            w["queue"].put_nowait(None)  # sentinel: close the stream
+            dropped += 1
+        return dropped
+
+    def emit_bookmarks(self) -> int:
+        """Push an immediate BOOKMARK to every live watch that asked
+        for them (``allowWatchBookmarks=true``) — the on-demand
+        counterpart of the interval cadence, so tests can exercise the
+        client's bookmark-resume path without waiting."""
+        sent = 0
+        for w in self._watchers:
+            if w["bookmarks"]:
+                # render NOW, not at dequeue: events already queued
+                # behind this bookmark must not be covered by its RV
+                # (a resume from the bookmark would skip them forever)
+                w["queue"].put_nowait(self._bookmark_event(w["key"]))
+                sent += 1
+        return sent
+
+    def _bookmark_event(self, key: Key) -> dict:
+        """Metadata-only progress event: just the resume RV, shaped
+        like the real wire (fixture watch_stream's BOOKMARK entry)."""
+        group, version, _plural = key
+        kind = self._kinds.get(key, "Object")
+        return {
+            "type": "BOOKMARK",
+            "object": {
+                "apiVersion": f"{group}/{version}" if group else version,
+                "kind": kind,
+                "metadata": {
+                    "resourceVersion": str(self._rv),
+                    "creationTimestamp": None,
+                },
+            },
+        }
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        from aiohttp import web
+
+        # accept bodies up to what etcd would (default 1 MiB is too small)
+        app = web.Application(
+            middlewares=[self._auth_middleware], client_max_size=4 * 1024**2
+        )
+        # longest patterns first: aiohttp resolves dynamic routes in
+        # registration order, and /apis/{g}/{v}/{plural}/{name} would
+        # otherwise swallow /apis/{g}/{v}/namespaces/{ns}/{plural}
+        patterns = [
+            ("/apis/{group}/{version}/namespaces/{namespace}/{plural}/{name}/status", True),
+            ("/apis/{group}/{version}/namespaces/{namespace}/{plural}/{name}", False),
+            ("/apis/{group}/{version}/namespaces/{namespace}/{plural}", None),
+            ("/apis/{group}/{version}/{plural}/{name}/status", True),
+            ("/apis/{group}/{version}/{plural}/{name}", False),
+            ("/apis/{group}/{version}/{plural}", None),
+            ("/api/v1/namespaces/{namespace}/{plural}/{name}", False),
+            ("/api/v1/namespaces/{namespace}/{plural}", None),
+            ("/api/v1/{plural}/{name}", False),
+            ("/api/v1/{plural}", None),
+        ]
+        for pattern, status_sub in patterns:
+            if status_sub is None:  # collection
+                app.router.add_get(pattern, self._handle_list_or_watch)
+                app.router.add_post(pattern, self._handle_create)
+            else:
+                handler = self._handle_status if status_sub else self._handle_object
+                app.router.add_get(pattern, handler)
+                app.router.add_put(pattern, handler)
+                app.router.add_patch(pattern, handler)
+                if not status_sub:
+                    app.router.add_delete(pattern, handler)
+        # don't wait out live watch streams on cleanup (default 60 s)
+        self._runner = web.AppRunner(app, shutdown_timeout=0.25)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://{host}:{actual_port}"
+        return self.url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- request plumbing ----------------------------------------------
+    @staticmethod
+    def _parse(request) -> Tuple[Key, str, str]:
+        info = request.match_info
+        group = info.get("group", "")
+        version = info.get("version", "v1")
+        return (
+            (group, version, info["plural"]),
+            info.get("namespace", ""),
+            info.get("name", ""),
+        )
+
+    # default StatusReason per HTTP code, mirroring apimachinery's
+    # reasonAndCodeForError mapping — the conformance fixtures
+    # (tests/fixtures/apiserver/) pin these against the real wire shape
+    _REASONS = {
+        400: "BadRequest",
+        401: "Unauthorized",
+        403: "Forbidden",
+        404: "NotFound",
+        405: "MethodNotAllowed",
+        409: "Conflict",
+        410: "Expired",
+        422: "Invalid",
+        500: "InternalError",
+        503: "ServiceUnavailable",
+    }
+
+    @staticmethod
+    def _qualified(key: Key) -> str:
+        """Resource rendering in real Status messages: grouped resources
+        as ``plural.group``, core (empty-group) resources as bare
+        ``plural`` — never a trailing dot."""
+        return f"{key[2]}.{key[0]}" if key[0] else key[2]
+
+    @classmethod
+    def _status_body(
+        cls, status: int, message: str, reason: str = "", details: dict | None = None
+    ) -> dict:
+        body = {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "metadata": {},
+            "status": "Failure",
+            "message": message,
+            "reason": reason or cls._REASONS.get(status, ""),
+            "code": status,
+        }
+        if details:
+            body["details"] = details
+        return body
+
+    @classmethod
+    def _error(
+        cls, status: int, message: str, reason: str = "", details: dict | None = None
+    ):
+        from aiohttp import web
+
+        return web.json_response(
+            cls._status_body(status, message, reason, details), status=status
+        )
+
+    from aiohttp import web as _web  # for the middleware decorator
+
+    @_web.middleware
+    async def _auth_middleware(self, request, handler):
+        self.requests.append((request.method, request.path))
+        if self._token:
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self._token}":
+                return self._error(401, "Unauthorized")
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        injected = self._consume_fault(request)
+        if injected is not None:
+            return injected
+        return await handler(request)
+
+    # -- handlers -------------------------------------------------------
+    async def _handle_list_or_watch(self, request):
+        from aiohttp import web
+
+        key, namespace, _ = self._parse(request)
+        if request.query.get("watch") == "true":
+            return await self._serve_watch(request, key, namespace)
+        selector = request.query.get("labelSelector", "")
+        items = [
+            copy.deepcopy(obj)
+            for (ns, _), obj in self._bucket(key).items()
+            if (not namespace or ns == namespace)
+            and _match_selector(obj, selector)
+        ]
+        return web.json_response(
+            {
+                "kind": "List",
+                "items": items,
+                "metadata": {"resourceVersion": str(self._rv)},
+            }
+        )
+
+    async def _serve_watch(self, request, key: Key, namespace: str):
+        from aiohttp import web
+
+        self.watch_params.append(dict(request.query))
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+
+        selector = request.query.get("labelSelector", "")
+        start_rv = request.query.get("resourceVersion", "")
+        bookmarks = request.query.get("allowWatchBookmarks") == "true"
+        if start_rv:
+            oldest = self._history[0][0] if self._history else self._rv + 1
+            if int(start_rv) + 1 < oldest and int(start_rv) < self._rv:
+                # requested window already evicted — real apiserver
+                # sends an ERROR event whose object is a full Status
+                # with reason Expired
+                line = json.dumps(
+                    {
+                        "type": "ERROR",
+                        "object": self._status_body(
+                            410,
+                            f"too old resource version: {start_rv} ({self._rv})",
+                            reason="Expired",
+                        ),
+                    }
+                )
+                await resp.write(line.encode() + b"\n")
+                return resp
+            backlog = [
+                ev
+                for rv, k, ns, ev in self._history
+                if k == key
+                and (not namespace or ns == namespace)
+                and rv > int(start_rv)
+                and _match_selector(ev.get("object", {}), selector)
+            ]
+        else:
+            # no resourceVersion: synthesize ADDED for current state
+            backlog = [
+                {"type": "ADDED", "object": copy.deepcopy(obj)}
+                for (ns, _), obj in self._bucket(key).items()
+                if (not namespace or ns == namespace)
+                and _match_selector(obj, selector)
+            ]
+        entry = {
+            "key": key,
+            "namespace": namespace,
+            "selector": selector,
+            "queue": queue,
+            "bookmarks": bookmarks,
+        }
+        self._watchers.append(entry)
+        try:
+            for ev in backlog:
+                await resp.write(json.dumps(ev).encode() + b"\n")
+            timeout = float(request.query.get("timeoutSeconds", "300"))
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + timeout
+            next_bookmark = (
+                loop.time() + self.bookmark_interval
+                if bookmarks and self.bookmark_interval > 0
+                else None
+            )
+            while True:
+                now = loop.time()
+                remaining = deadline - now
+                if remaining <= 0:
+                    break
+                wait = remaining
+                if next_bookmark is not None:
+                    wait = min(wait, max(next_bookmark - now, 0.0))
+                try:
+                    ev = await asyncio.wait_for(
+                        queue.get(), timeout=wait
+                    )
+                except asyncio.TimeoutError:
+                    if (
+                        next_bookmark is not None
+                        and loop.time() >= next_bookmark
+                    ):
+                        # queue is empty here (the wait timed out), so
+                        # a bookmark at the CURRENT rv covers nothing
+                        # undelivered on this stream
+                        ev = self._bookmark_event(key)
+                        next_bookmark = loop.time() + self.bookmark_interval
+                    else:
+                        break  # server-side timeoutSeconds elapsed
+                if ev is None:  # drop_watches sentinel: abrupt stream end
+                    break
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watchers.remove(entry)
+        return resp
+
+    async def _handle_create(self, request):
+        from aiohttp import web
+
+        key, namespace, _ = self._parse(request)
+        body = await request.json()
+        if key[2] in ("tokenreviews", "subjectaccessreviews"):
+            # review APIs evaluate and answer — nothing is stored
+            return web.json_response(self._evaluate_review(key[2], body), status=201)
+        meta = body.setdefault("metadata", {})
+        if namespace:
+            meta["namespace"] = namespace
+        name = meta.get("name", "")
+        if not name:
+            generate = meta.get("generateName")
+            if not generate:
+                return self._error(422, "name or generateName is required")
+            name = generate + secrets.token_hex(3)[:5]
+            meta["name"] = name
+        if body.get("kind"):
+            self._kinds.setdefault(key, body["kind"])
+        causes = self._schema_causes(key, body)
+        if causes:
+            # schema validation rejects before storage is consulted —
+            # an invalid duplicate gets 422, not AlreadyExists
+            return self._invalid(key, name, causes)
+        if (namespace, name) in self._bucket(key):
+            # real apiserver: 409 with reason AlreadyExists (distinct
+            # from optimistic-concurrency Conflict at the same code)
+            return self._error(
+                409,
+                f'{self._qualified(key)} "{name}" already exists',
+                reason="AlreadyExists",
+                details={"name": name, "group": key[0], "kind": key[2]},
+            )
+        meta["resourceVersion"] = self._bump()
+        meta["uid"] = secrets.token_hex(8)
+        meta.setdefault("creationTimestamp", _now_iso())
+        self._bucket(key)[(namespace, name)] = body
+        self._broadcast(key, namespace, "ADDED", body)
+        return web.json_response(copy.deepcopy(body), status=201)
+
+    def _evaluate_review(self, plural: str, body: dict) -> dict:
+        """The authentication/authorization review APIs, table-driven:
+        ``scrape_tokens`` authenticates, ``metrics_allowed_users``
+        authorizes GETs of the non-resource /metrics path."""
+        spec = body.get("spec") or {}
+        if plural == "tokenreviews":
+            username = self.scrape_tokens.get(spec.get("token", ""))
+            status = (
+                {"authenticated": True, "user": {"username": username, "groups": []}}
+                if username
+                else {"authenticated": False}
+            )
+        else:
+            attrs = spec.get("nonResourceAttributes") or {}
+            status = {
+                "allowed": (
+                    spec.get("user", "") in self.metrics_allowed_users
+                    and attrs.get("path") == "/metrics"
+                    and attrs.get("verb") == "get"
+                )
+            }
+        return {**body, "status": status}
+
+    async def _handle_object(self, request):
+        return await self._object_rw(request, status_only=False)
+
+    async def _handle_status(self, request):
+        if request.method == "GET":
+            return self._error(405, "GET on status subresource not supported")
+        return await self._object_rw(request, status_only=True)
+
+    async def _object_rw(self, request, status_only: bool):
+        from aiohttp import web
+
+        key, namespace, name = self._parse(request)
+        existing = self._bucket(key).get((namespace, name))
+        if existing is None:
+            return self._error(
+                404,
+                f'{self._qualified(key)} "{name}" not found',
+                details={"name": name, "group": key[0], "kind": key[2]},
+            )
+
+        if request.method == "GET":
+            return web.json_response(copy.deepcopy(existing))
+
+        if request.method == "DELETE":
+            del self._bucket(key)[(namespace, name)]
+            self._bump()
+            self._broadcast(key, namespace, "DELETED", existing)
+            return web.json_response(
+                {
+                    "kind": "Status",
+                    "apiVersion": "v1",
+                    "metadata": {},
+                    "status": "Success",
+                    "details": {
+                        "name": name,
+                        "group": key[0],
+                        "kind": key[2],
+                        "uid": existing["metadata"].get("uid", ""),
+                    },
+                }
+            )
+
+        body = await request.json()
+        # optimistic concurrency: a stale resourceVersion in the payload
+        # is a conflict (this is what RetryOnConflict paths exercise)
+        claimed = (body.get("metadata") or {}).get("resourceVersion")
+        if claimed and claimed != existing["metadata"]["resourceVersion"]:
+            return self._error(
+                409,
+                f'Operation cannot be fulfilled on {self._qualified(key)} "{name}": '
+                "the object has been modified; please apply your changes to "
+                "the latest version and try again",
+                reason="Conflict",
+                details={"name": name, "group": key[0], "kind": key[2]},
+            )
+
+        if request.method == "PUT":
+            updated = body
+            if status_only:
+                updated = copy.deepcopy(existing)
+                updated["status"] = body.get("status")
+            else:
+                # status is a subresource: a main-resource replace never
+                # touches it (real API-server behavior for CRDs with the
+                # status subresource enabled)
+                updated.pop("status", None)
+                if "status" in existing:
+                    updated["status"] = existing["status"]
+        else:  # PATCH (JSON merge patch)
+            patch = {"status": body.get("status")} if status_only else body
+            updated = merge_patch(existing, patch)
+        causes = self._schema_causes(key, updated)
+        if causes:
+            # updates are validated on the FULL post-merge object (the
+            # real apiserver validates what would be stored, so a merge
+            # patch cannot smuggle a schema-invalid field in)
+            return self._invalid(key, name, causes)
+        meta = updated.setdefault("metadata", {})
+        meta["name"] = name
+        if namespace:
+            meta["namespace"] = namespace
+        meta["uid"] = existing["metadata"].get("uid", secrets.token_hex(8))
+        meta["resourceVersion"] = self._bump()
+        self._bucket(key)[(namespace, name)] = updated
+        self._broadcast(key, namespace, "MODIFIED", updated)
+        return web.json_response(copy.deepcopy(updated))
+
+
+def _now_iso() -> str:
+    import datetime
+
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .replace(microsecond=0)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )

@@ -1,0 +1,17 @@
+"""Prometheus metrics collectors."""
+
+from activemonitor_tpu.metrics.collector import (
+    LABEL_HC,
+    LABEL_WF,
+    MetricsCollector,
+    WORKFLOW_LABEL_HEALTHCHECK,
+    WORKFLOW_LABEL_REMEDY,
+)
+
+__all__ = [
+    "LABEL_HC",
+    "LABEL_WF",
+    "MetricsCollector",
+    "WORKFLOW_LABEL_HEALTHCHECK",
+    "WORKFLOW_LABEL_REMEDY",
+]

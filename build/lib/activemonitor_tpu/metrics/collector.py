@@ -1,0 +1,256 @@
+"""Prometheus metrics.
+
+Exact metric names/labels of the reference
+(reference: internal/metrics/collector.go:19-48):
+
+- ``healthcheck_success_count``  counter {healthcheck_name, workflow}
+- ``healthcheck_error_count``    counter {healthcheck_name, workflow}
+- ``healthcheck_runtime_seconds`` gauge  {healthcheck_name, workflow}
+- ``healthcheck_starttime``      gauge   {healthcheck_name, workflow}
+- ``healthcheck_finishedtime``   gauge   {healthcheck_name, workflow}
+
+with ``workflow`` ∈ {healthCheck, remedy}, plus dynamic custom gauges
+parsed from workflow global output parameters in the
+``{"metrics": [{name, value, metrictype, help}]}`` contract
+(reference: collector.go:68-115). Two deliberate fixes over the
+reference: custom metrics are actually invoked from the controller (the
+reference implements but never calls them — SURVEY.md §2 known
+defects), and the metric-name sanitizer handles the metric's own name,
+not just the HealthCheck name (collector.go:90 only rewrites ``name``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from typing import Dict, Optional
+
+from prometheus_client import CollectorRegistry, Gauge, Histogram
+
+log = logging.getLogger(__name__)
+
+LABEL_HC = "healthcheck_name"
+LABEL_WF = "workflow"
+
+WORKFLOW_LABEL_HEALTHCHECK = "healthCheck"
+WORKFLOW_LABEL_REMEDY = "remedy"
+
+_INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    return _INVALID_CHARS.sub("_", name)
+
+
+def _prefix_dedupe(hc: str, metric: str) -> str:
+    """Join the hc-name prefix and metric name WITHOUT the reference's
+    stutter (collector.go:90 yields names like
+    ``tpu_ici_allreduce_ici_allreduce_busbw_gbps``): the longest token
+    suffix of the hc name that is also a token prefix of the metric
+    name is merged, so that example becomes
+    ``tpu_ici_allreduce_busbw_gbps``. Deliberate, documented divergence
+    (README metrics table): the per-check prefix survives (dashboards
+    can still group by it), the repetition does not. Distinct checks
+    whose merged names coincide stay separable via the
+    ``healthcheck_name`` label every custom gauge carries."""
+    hc_tokens = hc.split("_")
+    metric_tokens = metric.split("_")
+    for k in range(min(len(hc_tokens), len(metric_tokens)), 0, -1):
+        if hc_tokens[-k:] == metric_tokens[:k]:
+            return "_".join(hc_tokens + metric_tokens[k:])
+    return hc + "_" + metric
+
+
+class MetricsCollector:
+    """Holds a registry; constructible per-test (the reference's global
+    registry makes its own tests race — collector_test.go:82-88)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        labels = [LABEL_HC, LABEL_WF]
+        # The two counters are exposed as monotonically-increasing gauges:
+        # prometheus_client appends "_total" to Counter names in the
+        # exposition, the Go client does not — and the scrape contract is
+        # the exact name `healthcheck_success_count` (collector.go:20).
+        self.monitor_success = Gauge(
+            "healthcheck_success_count",
+            "The total number of successful healthcheck resources",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_error = Gauge(
+            "healthcheck_error_count",
+            "The total number of errored healthcheck resources",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_runtime = Gauge(
+            "healthcheck_runtime_seconds",
+            "Time taken for the workflow to complete.",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_started_time = Gauge(
+            "healthcheck_starttime",
+            "Time the workflow started.",
+            labels,
+            registry=self.registry,
+        )
+        self.monitor_finished_time = Gauge(
+            "healthcheck_finishedtime",
+            "Time the workflow finished.",
+            labels,
+            registry=self.registry,
+        )
+        # beyond the reference (SURVEY.md §5.1): a duration histogram so
+        # probe latency distributions are queryable, not just last-run
+        self.monitor_runtime_histogram = Histogram(
+            "healthcheck_runtime_histogram_seconds",
+            "Distribution of workflow run durations.",
+            labels,
+            registry=self.registry,
+            buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, float("inf")),
+        )
+        # fleet rollup (beyond the reference; cf. ML-productivity-goodput
+        # style metrics): what fraction of checks are healthy AND meeting
+        # their cadence — the one number a fleet dashboard leads with
+        self.cadence_goodput = Gauge(
+            "healthcheck_cadence_goodput",
+            "Fraction of HealthChecks whose last run succeeded within "
+            "2x their interval",
+            registry=self.registry,
+        )
+        # engine observability: is the per-namespace workflow watch
+        # stream (divergence 11) healthy, or is the controller paying
+        # direct-GET fallbacks? A sustained 0 here explains elevated
+        # apiserver load and slower failure detection
+        self.workflow_watch_healthy = Gauge(
+            "workflow_watch_healthy",
+            "1 while the namespace's workflow watch stream feeds the "
+            "status cache; 0 while degraded to direct GETs",
+            ["namespace"],
+            registry=self.registry,
+        )
+        self._custom_gauges: Dict[str, Gauge] = {}
+        # (hc_name, merged_name) -> raw metric name: two DIFFERENT
+        # metrics from one check must never collapse onto one series
+        # (e.g. check a-b emitting b-c and c both merge to a_b_c)
+        self._custom_origin: Dict[tuple, str] = {}
+        self._custom_lock = threading.Lock()
+
+    # -- run accounting (reference call sites:
+    #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
+    def record_success(
+        self, hc_name: str, workflow: str, started: float, finished: float
+    ) -> None:
+        self.monitor_success.labels(hc_name, workflow).inc()
+        self.monitor_runtime.labels(hc_name, workflow).set(finished - started)
+        self.monitor_started_time.labels(hc_name, workflow).set(started)
+        self.monitor_finished_time.labels(hc_name, workflow).set(finished)
+        self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
+            max(0.0, finished - started)
+        )
+
+    def record_failure(
+        self, hc_name: str, workflow: str, started: float, finished: float
+    ) -> None:
+        self.monitor_error.labels(hc_name, workflow).inc()
+        self.monitor_started_time.labels(hc_name, workflow).set(started)
+        self.monitor_finished_time.labels(hc_name, workflow).set(finished)
+        self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
+            max(0.0, finished - started)
+        )
+
+    def record_watch_health(self, namespace: str, healthy: bool) -> None:
+        self.workflow_watch_healthy.labels(namespace).set(1.0 if healthy else 0.0)
+
+    # -- dynamic custom metrics ---------------------------------------
+    def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:
+        """Parse workflow global output parameters for the custom-metric
+        contract and set gauges. Returns how many metrics were recorded.
+
+        Malformed JSON / entries are skipped with a log, never raised
+        (reference: collector.go:73-87).
+        """
+        outputs = (workflow_status or {}).get("outputs") or {}
+        parameters = outputs.get("parameters") or []
+        recorded = 0
+        for parameter in parameters:
+            value = parameter.get("value") if isinstance(parameter, dict) else None
+            if not isinstance(value, str):
+                continue
+            try:
+                doc = json.loads(value)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(doc, dict):
+                continue
+            for raw in doc.get("metrics") or []:
+                if not isinstance(raw, dict):
+                    continue
+                metric_name = raw.get("name") or ""
+                try:
+                    metric_value = float(raw.get("value"))
+                except (TypeError, ValueError):
+                    log.error("skipping custom metric with bad value: %r", raw)
+                    continue
+                if not metric_name:
+                    log.error("skipping invalid custom metric for %s: %r", hc_name, raw)
+                    continue
+                full_name = _prefix_dedupe(
+                    _sanitize(hc_name), _sanitize(metric_name)
+                )
+                with self._custom_lock:
+                    origin = self._custom_origin.setdefault(
+                        (hc_name, full_name), metric_name
+                    )
+                    if origin != metric_name:
+                        # same check, different raw metric, same merged
+                        # name: recording would silently overwrite the
+                        # other metric's series — skip loudly instead
+                        # (never-raise contract, like the registration
+                        # collision below)
+                        log.error(
+                            "custom metric %r of %s merges to %s, already "
+                            "taken by metric %r of the same check; skipping",
+                            metric_name,
+                            hc_name,
+                            full_name,
+                            origin,
+                        )
+                        continue
+                    gauge = self._custom_gauges.get(full_name)
+                    if gauge is None:
+                        try:
+                            gauge = Gauge(
+                                full_name,
+                                str(raw.get("help") or full_name),
+                                [LABEL_HC],
+                                registry=self.registry,
+                            )
+                        except ValueError:
+                            # name collides with an already-registered
+                            # metric (e.g. a static vec) — skip, keep the
+                            # never-raise contract
+                            log.error(
+                                "custom metric %s collides with an existing "
+                                "registration; skipping",
+                                full_name,
+                            )
+                            continue
+                        self._custom_gauges[full_name] = gauge
+                gauge.labels(hc_name).set(metric_value)
+                recorded += 1
+        return recorded
+
+    # -- exposition ----------------------------------------------------
+    def exposition(self) -> bytes:
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)
+
+    def sample_value(self, name: str, labels: dict) -> Optional[float]:
+        """Test helper: read a sample from the registry."""
+        return self.registry.get_sample_value(name, labels)

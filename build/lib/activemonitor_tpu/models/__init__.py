@@ -1,0 +1,21 @@
+"""Probe model zoo."""
+
+from activemonitor_tpu.models.probe_model import (
+    ProbeModelConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_count,
+    param_specs,
+    tiny_config,
+)
+
+__all__ = [
+    "ProbeModelConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "param_count",
+    "param_specs",
+    "tiny_config",
+]

@@ -1,0 +1,450 @@
+"""The probe transformer — flagship payload of the training-step and
+compile-smoke probes.
+
+A deliberately canonical decoder (embed → N×[LN, causal attention,
+residual, LN, MLP, residual] → LN → logits) written as a pure-functional
+JAX model: the parameter tree is an explicit dict built next to a
+parallel tree of `PartitionSpec`s, so the tensor/data-parallel layout is
+visible in one place instead of being threaded through module metadata.
+
+Design for the MXU: every matmul is a large dense einsum in bfloat16
+(params kept in float32, cast at use); shapes are static; no Python
+control flow under jit. Sharding follows the standard megatron layout —
+attention heads and MLP hidden dim split over the "model" axis, batch
+over "data" — so the only collectives jit inserts are the psums after
+the down-projections, riding ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ProbeModelConfig:
+    vocab_size: int = 4096
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+    # GQA/MQA: K/V heads (must divide n_heads); None = standard MHA.
+    # The fused kernel path (ops/flash_attention.py) runs grouped heads
+    # natively; the dense path repeats K/V heads for the einsum.
+    n_kv_heads: int | None = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approximate forward FLOPs/token (2·params matmul convention)."""
+        kv_dim = self.kv_heads * self.head_dim
+        per_layer = (
+            2 * 2 * self.d_model * self.d_model  # q + out projections
+            + 2 * 2 * self.d_model * kv_dim  # k + v projections
+            + 2 * 2 * self.d_model * self.d_ff  # up + down
+        )
+        embed = 2 * self.d_model * self.vocab_size
+        return per_layer * self.n_layers + embed
+
+
+def tiny_config() -> ProbeModelConfig:
+    """Small enough to train a step on CPU in tests."""
+    return ProbeModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq_len=64
+    )
+
+
+def init_params(key: jax.Array, cfg: ProbeModelConfig) -> Dict:
+    """Explicit parameter tree (float32 master copies)."""
+    keys = jax.random.split(key, cfg.n_layers * 6 + 2)
+    k = iter(keys)
+
+    def dense(kk, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / jnp.sqrt(shape[0]))
+        return (jax.random.normal(kk, shape, jnp.float32) * scale)
+
+    params: Dict = {
+        "embed": dense(next(k), (cfg.vocab_size, cfg.d_model), scale=0.02),
+        "layers": [],
+        "final_ln": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+    for _ in range(cfg.n_layers):
+        if cfg.kv_heads == cfg.n_heads:
+            # MHA keeps the single fused projection (and its specs);
+            # key-draw order is part of the init contract — wqkv first
+            attn = {"wqkv": dense(next(k), (cfg.d_model, 3, cfg.n_heads, cfg.head_dim))}
+        else:
+            # GQA: separate q and (narrower) kv projections
+            attn = {
+                "wq": dense(next(k), (cfg.d_model, cfg.n_heads, cfg.head_dim)),
+                "wkv": dense(next(k), (cfg.d_model, 2, cfg.kv_heads, cfg.head_dim)),
+            }
+        params["layers"].append(
+            {
+                "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+                **attn,
+                "wo": dense(next(k), (cfg.n_heads, cfg.head_dim, cfg.d_model)),
+                "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+                "w_up": dense(next(k), (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(next(k), (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return params
+
+
+def param_specs(cfg: ProbeModelConfig) -> Dict:
+    """PartitionSpec tree matching init_params: megatron tp over "model"."""
+    if cfg.kv_heads == cfg.n_heads:
+        attn = {"wqkv": P(None, None, "model", None)}  # heads sharded
+    else:
+        attn = {
+            "wq": P(None, "model", None),
+            "wkv": P(None, None, "model", None),  # kv heads sharded
+        }
+    layer = {
+        "ln1": {"scale": P()},
+        **attn,
+        "wo": P("model", None, None),
+        "ln2": {"scale": P()},
+        "w_up": P(None, "model"),  # hidden dim sharded
+        "w_down": P("model", None),
+    }
+    return {
+        "embed": P(None, None),
+        "layers": [layer] * cfg.n_layers,
+        "final_ln": {"scale": P()},
+    }
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def apply_block(
+    x: jax.Array, layer: Dict, cfg: ProbeModelConfig, attention_fn=None
+) -> jax.Array:
+    """One decoder block on [B, S, D]. ``attention_fn(q, k, v) -> attn``
+    overrides the attention mechanism (ring attention for the
+    context-parallel path); the default is dense causal. Shared by the
+    dense, context-parallel, and pipeline-parallel forwards so the
+    paths cannot drift."""
+    dt = cfg.dtype
+    if attention_fn is None:
+        attention_fn = partial(dense_causal_attention, cfg=cfg)
+    h = _rmsnorm(x, layer["ln1"]["scale"])
+    if "wqkv" in layer:
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(dt))
+        q, key, val = qkv[0], qkv[1], qkv[2]
+    else:  # GQA: separate q and narrower kv projections
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+        kv = jnp.einsum("bsd,dthk->tbshk", h, layer["wkv"].astype(dt))
+        key, val = kv[0], kv[1]
+    attn = attention_fn(q, key, val)  # [B, S, H, K]
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
+    h = _rmsnorm(x, layer["ln2"]["scale"])
+    up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt)))
+    return x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(dt))
+
+
+def flash_attention_fn(cfg: ProbeModelConfig, mesh=None, axis: str = "model"):
+    """Attention override running the fused Pallas kernel
+    (ops/flash_attention.py, differentiable via its custom VJP).
+
+    Unsharded (no mesh, or a 1-sized axis) the kernel is called
+    directly. With heads tensor-parallel over ``mesh[axis]`` it runs
+    under ``shard_map`` — attention is embarrassingly parallel across
+    heads, so each shard computes its local heads with zero
+    communication, exactly what XLA's sharding propagation does for the
+    unfused path. Unlike GSPMD (which pads uneven shardings for the
+    dense path), shard_map needs the heads dim to divide evenly — a
+    too-large tp axis is rejected up front with the actual constraint
+    rather than a trace-time shape error."""
+    from jax import shard_map
+
+    from activemonitor_tpu.ops.flash_attention import flash_attention
+
+    def fused(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return fused
+    axis_size = mesh.shape[axis]
+    if cfg.n_heads % axis_size:
+        raise ValueError(
+            f"flash attention needs n_heads ({cfg.n_heads}) divisible by "
+            f"the '{axis}' mesh axis ({axis_size}); use dense attention "
+            "or a smaller tensor-parallel group"
+        )
+    if cfg.kv_heads % axis_size:
+        raise ValueError(
+            f"flash attention needs n_kv_heads ({cfg.kv_heads}) divisible "
+            f"by the '{axis}' mesh axis ({axis_size}); each shard must "
+            "hold whole K/V heads for its query-head group"
+        )
+    spec = P("data" if "data" in mesh.shape else None, None, axis, None)
+    return shard_map(
+        fused, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
+    )
+
+
+def ring_attention_fn(
+    cfg: ProbeModelConfig, mesh, axis: str = "sp", tp_axis: str = "model"
+):
+    """Attention override running sequence-parallel ring attention
+    (ops/ring_attention.py, differentiable via its custom VJP) inside a
+    composed train step.
+
+    The sequence dim shards over ``mesh[axis]``; batch rides "data" and
+    heads ride ``tp_axis`` when those axes exist — both are
+    embarrassingly parallel for the ring (the only communication is the
+    K/V rotation over ``axis``), so a dp×tp×sp step needs no extra
+    collectives beyond what the ring and XLA's sharding propagation
+    already insert."""
+    from activemonitor_tpu.ops.ring_attention import ring_attention
+
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"ring attention needs a {axis!r} mesh axis, mesh has {dict(mesh.shape)}"
+        )
+    heads_axis = None
+    if tp_axis in mesh.shape and mesh.shape[tp_axis] > 1:
+        if cfg.n_heads % mesh.shape[tp_axis]:
+            raise ValueError(
+                f"ring attention needs n_heads ({cfg.n_heads}) divisible by "
+                f"the {tp_axis!r} mesh axis ({mesh.shape[tp_axis]})"
+            )
+        if cfg.kv_heads % mesh.shape[tp_axis]:
+            raise ValueError(
+                f"ring attention needs n_kv_heads ({cfg.kv_heads}) divisible "
+                f"by the {tp_axis!r} mesh axis ({mesh.shape[tp_axis]}); each "
+                "shard must hold whole K/V heads for its query-head group"
+            )
+        heads_axis = tp_axis
+    spec = P("data" if "data" in mesh.shape else None, axis, heads_axis, None)
+
+    def ring(q, k, v):
+        return ring_attention(q, k, v, mesh, axis, causal=True, in_spec=spec)
+
+    return ring
+
+
+def dense_causal_attention(q, k, v, cfg: ProbeModelConfig):
+    dt = cfg.dtype
+    seq = q.shape[1]
+    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads for the einsum
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(cfg.head_dim, dt)
+    )
+    scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e9, dt))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+    return jnp.einsum("bhst,bthk->bshk", probs, v)
+
+
+def _forward_with_attention(
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn,
+    remat: bool = False,
+) -> jax.Array:
+    """Shared decoder body around :func:`apply_block`. ``remat``
+    rematerializes each block's activations in the backward pass
+    (``jax.checkpoint``) — the standard FLOPs-for-HBM trade that lets
+    sequence length or depth grow past what saved activations allow."""
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]  # [B, S, D]
+
+    def block(x, layer):
+        return apply_block(x, layer, cfg, attention_fn)
+
+    if remat:
+        block = jax.checkpoint(block)
+    for layer in params["layers"]:
+        x = block(x, layer)
+    x = _rmsnorm(x, params["final_ln"]["scale"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt)).astype(jnp.float32)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: ProbeModelConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V]. Jit-friendly: static
+    shapes, lax-only control flow, bf16 compute."""
+    return _forward_with_attention(
+        params, tokens, cfg, partial(dense_causal_attention, cfg=cfg)
+    )
+
+
+def loss_fn(
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, attention_fn=None,
+    remat: bool = False,
+) -> jax.Array:
+    """Next-token cross-entropy (the training-step probe's objective).
+    ``attention_fn`` overrides the attention mechanism (e.g.
+    :func:`flash_attention_fn` for the fused-kernel training path);
+    None means dense causal (apply_block's default). ``remat``
+    rematerializes block activations in the backward."""
+    logits = _forward_with_attention(
+        params, tokens[:, :-1], cfg, attention_fn, remat=remat
+    )
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def forward_context_parallel(
+    params: Dict, tokens: jax.Array, cfg: ProbeModelConfig, mesh, axis: str = "sp"
+) -> jax.Array:
+    """Long-context forward: the sequence axis lives sharded across
+    ``mesh[axis]`` and attention runs as ring attention
+    (ops/ring_attention.py), so a sequence n× longer than one device's
+    memory fits. Everything else (embedding, norms, MLP) is pointwise
+    along the sequence and needs no communication — XLA keeps those ops
+    local to each shard; the only inter-device traffic is the K/V ring.
+    """
+    from activemonitor_tpu.ops.ring_attention import ring_attention
+
+    def ring(q, k, v):
+        return ring_attention(q, k, v, mesh, axis, causal=True)
+
+    return _forward_with_attention(params, tokens, cfg, ring)
+
+
+def init_kv_cache(cfg: ProbeModelConfig, batch: int, max_seq: int) -> Dict:
+    """KV cache for autoregressive decoding: one [B, Hkv, S, Dh] pair
+    per layer (heads-major — the fused decode kernel's tiling wants
+    contiguous [S, Dh] planes per head), float-typed in the compute
+    dtype. GQA caches only the kv_heads — the memory win that motivates
+    grouped heads in serving. Capacity rounds up to a multiple of 8
+    (Mosaic's tiling unit); position masking makes the slack inert."""
+    cap = -(-max_seq // 8) * 8
+    shape = (cfg.n_layers, batch, cfg.kv_heads, cap, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def prefill(
+    params: Dict,
+    cache: Dict,
+    tokens: jax.Array,
+    cfg: ProbeModelConfig,
+    use_flash: bool = False,
+):
+    """Batched prompt ingestion — the serving cold half.
+
+    Runs the causal forward over ``tokens`` [B, S] ONCE (big MXU-shaped
+    matmuls; ``use_flash`` routes attention through the fused kernel)
+    while writing every position's K/V into the cache, so decoding can
+    start at position S. Returns (last-token logits [B, V], cache) —
+    equivalent to S ``decode_step`` calls but without S tiny dispatches.
+    """
+    dt = cfg.dtype
+    seq = tokens.shape[1]
+    x = params["embed"].astype(dt)[tokens]  # [B, S, D]
+    if use_flash:
+        from activemonitor_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    else:
+        attention_fn = partial(dense_causal_attention, cfg=cfg)
+    for li, layer in enumerate(params["layers"]):
+        # reuse apply_block (the single decoder-block definition — the
+        # paths must not drift); the wrapper captures this layer's K/V
+        # projections at trace time for cache banking
+        banked: Dict = {}
+
+        def capturing(q, k, v, _banked=banked):
+            _banked["k"], _banked["v"] = k, v
+            return attention_fn(q, k, v)
+
+        x = apply_block(x, layer, cfg, capturing)
+        # bank K/V heads-major ([B, Hkv, S, K]) for the decode kernel
+        cache["k"] = cache["k"].at[li, :, :, :seq].set(
+            jnp.swapaxes(banked["k"], 1, 2)
+        )
+        cache["v"] = cache["v"].at[li, :, :, :seq].set(
+            jnp.swapaxes(banked["v"], 1, 2)
+        )
+    x = _rmsnorm(x[:, -1], params["final_ln"]["scale"])  # last position only
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(dt))
+    return logits.astype(jnp.float32), cache
+
+
+def decode_step(
+    params: Dict, cache: Dict, token: jax.Array, pos: jax.Array,
+    cfg: ProbeModelConfig, use_flash: bool = False,
+):
+    """One autoregressive decode step (the serving hot loop).
+
+    token: [B] int32, pos: scalar int32 position. Returns (logits [B,V],
+    updated cache). Static shapes throughout: the cache is full-length
+    and masked by position, so the step jits once and reruns for every
+    token (lax-friendly, no dynamic shapes). ``use_flash`` routes the
+    cache attention through the fused decode kernel
+    (ops/flash_attention.flash_decode): one blockwise HBM pass with the
+    online-softmax state in VMEM, dead cache capacity skipped."""
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[token]  # [B, D]
+    cap = cache["k"].shape[3]
+    visible = jnp.arange(cap) <= pos  # [S]
+    group = cfg.n_heads // cfg.kv_heads
+    if use_flash:
+        from activemonitor_tpu.ops.flash_attention import flash_decode
+    for li, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1"]["scale"])
+        if "wqkv" in layer:
+            qkv = jnp.einsum("bd,dthk->tbhk", h, layer["wqkv"].astype(dt))
+            q, k_new, v_new = qkv[0], qkv[1], qkv[2]  # [B, H, K]
+        else:  # GQA: q over n_heads, k/v over the narrower kv_heads
+            q = jnp.einsum("bd,dhk->bhk", h, layer["wq"].astype(dt))
+            kv = jnp.einsum("bd,dthk->tbhk", h, layer["wkv"].astype(dt))
+            k_new, v_new = kv[0], kv[1]  # [B, Hkv, K]
+        cache["k"] = cache["k"].at[li, :, :, pos].set(k_new)
+        cache["v"] = cache["v"].at[li, :, :, pos].set(v_new)
+        keys = cache["k"][li]  # [B, Hkv, S, K]
+        values = cache["v"][li]
+        if use_flash:
+            attn = flash_decode(q, keys, values, pos)  # [B, H, K]
+        else:
+            # grouped view: [B, H, K] -> [B, Hkv, G, K]; each group of
+            # query heads attends its shared kv head out of the cache
+            qg = q.reshape(q.shape[0], cfg.kv_heads, group, cfg.head_dim)
+            scores = jnp.einsum("bhgk,bhsk->bhgs", qg, keys) / jnp.sqrt(
+                jnp.asarray(cfg.head_dim, dt)
+            )
+            scores = jnp.where(
+                visible[None, None, None, :], scores, jnp.asarray(-1e9, dt)
+            )
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+            attn = jnp.einsum("bhgs,bhsk->bhgk", probs, values)
+            attn = attn.reshape(q.shape[0], cfg.n_heads, cfg.head_dim)
+        x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"].astype(dt))
+        h = _rmsnorm(x, layer["ln2"]["scale"])
+        up = jax.nn.gelu(jnp.einsum("bd,df->bf", h, layer["w_up"].astype(dt)))
+        x = x + jnp.einsum("bf,fd->bd", up, layer["w_down"].astype(dt))
+    x = _rmsnorm(x, params["final_ln"]["scale"])
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(dt))
+    return logits.astype(jnp.float32), cache
+
+
+def param_count(cfg: ProbeModelConfig) -> int:
+    d, f, v, h, k = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.head_dim
+    qkv = d * h * k + 2 * d * cfg.kv_heads * k  # q + (possibly grouped) kv
+    per_layer = d + qkv + h * k * d + d + d * f + f * d
+    return v * d + cfg.n_layers * per_layer + d

@@ -1,0 +1,6 @@
+"""TPU kernels (Pallas) used by probes."""
+
+from activemonitor_tpu.ops.flash_attention import flash_attention
+from activemonitor_tpu.ops.stream import stream_scale_pallas, stream_scale_xla
+
+__all__ = ["flash_attention", "stream_scale_pallas", "stream_scale_xla"]

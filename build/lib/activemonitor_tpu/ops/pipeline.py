@@ -1,0 +1,154 @@
+"""Pipeline parallelism — layer stages across the mesh, GPipe-style.
+
+The layer stack is split into S contiguous stages, one per device on
+the "pp" axis; a batch is split into M microbatches that flow through
+the stages, each hop a single neighbor ``ppermute``. The schedule is a
+``lax.scan`` over M + S − 1 ticks: at tick t, stage s computes
+microbatch t − s (bubbles at the ends are masked out).
+
+WEIGHT memory is the pipelined resource here: each device holds only
+its stage's layers — the property that lets a model taller than one
+device's HBM run at all. Activations are NOT minimized in this
+implementation: the microbatch set is replicated to every stage and
+outputs are combined with a full psum, which is the right fidelity for
+a correctness/health probe but not a memory-optimal training pipeline
+(production pipelines stream microbatches into stage 0 and emit from
+the last stage only).
+
+Layer parameters arrive STACKED: every leaf of the layer dict gains a
+leading ``n_layers`` axis (see :func:`stack_layer_params`), which is
+sharded over "pp" so each stage holds its own slice — inside
+``shard_map`` each device scans over its ``layers_per_stage`` local
+layers with the shared :func:`~activemonitor_tpu.models.probe_model.apply_block`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from activemonitor_tpu.models.probe_model import ProbeModelConfig, apply_block
+
+
+def stack_layer_params(layers) -> Dict:
+    """List-of-layer-dicts -> one dict whose leaves have a leading
+    n_layers axis (sharding-friendly: the leading axis splits over pp)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *layers)
+
+
+def stacked_layer_specs(pp_axis: str = "pp", tp_axis: str = "model") -> Dict:
+    """PartitionSpec tree matching :func:`stack_layer_params` output:
+    the leading layer axis splits over ``pp_axis`` (each stage holds
+    its own layers) and within each layer the megatron tensor-parallel
+    layout of probe_model.param_specs splits over ``tp_axis`` — the
+    spec tree that lets one parameter tree be pp×tp sharded at once."""
+    return {
+        "ln1": {"scale": P(pp_axis, None)},
+        "wqkv": P(pp_axis, None, None, tp_axis, None),  # heads sharded
+        "wo": P(pp_axis, tp_axis, None, None),
+        "ln2": {"scale": P(pp_axis, None)},
+        "w_up": P(pp_axis, None, tp_axis),  # hidden dim sharded
+        "w_down": P(pp_axis, tp_axis, None),
+    }
+
+
+def pipeline_forward_blocks(
+    stacked_layers: Dict,
+    x: jax.Array,
+    cfg: ProbeModelConfig,
+    mesh: Mesh,
+    axis: str = "pp",
+    num_microbatches: int = 0,
+    composed: bool = False,
+) -> jax.Array:
+    """Run the block stack over ``x`` [B, S, D] with the layers
+    pipelined across ``mesh[axis]``. Embedding/head stay outside (they
+    are cheap and replicated). Returns [B, S, D].
+
+    With ``composed=True`` the shard_map is MANUAL only over ``axis``
+    (``axis_names={axis}``): every other mesh axis stays
+    compiler-managed, so each stage's layer compute keeps whatever
+    data/tensor shardings its parameters and activations carry — this
+    is how dp×tp×pp composes on one mesh (the pipeline schedule is
+    hand-written ppermute over "pp"; the per-stage matmul collectives
+    over "model" and the gradient psum over "data" are still inserted
+    by XLA from the sharding annotations, the scaling-book split of
+    labor). Composed mode must run under ``jax.jit`` — partially-manual
+    shard_map has no eager path (JAX 0.9 rejects it outside a trace).
+    """
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    m = num_microbatches or n_stages
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible into {m} microbatches")
+    n_layers = jax.tree.leaves(stacked_layers)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers do not split over {n_stages} stages")
+
+    # composed mode keeps the shard_map boundary (inputs, carries, the
+    # final psum) in float32: XLA's CPU AllReducePromotion pass (as of
+    # ~2026-07) crashes cloning the bf16 all-reduces that the
+    # partially-manual transpose emits ("Invalid binary instruction
+    # opcode copy"). Stage compute still runs in cfg.dtype; on TPU this
+    # costs 2x ppermute bytes in a path whose job is correctness.
+    wire_dt = jnp.float32 if composed else x.dtype
+    micro = x.astype(wire_dt).reshape(m, batch // m, *x.shape[1:])  # [M, mb, S, D]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def stage_apply(local_layers, act):
+        """Scan this stage's local layers over the activation."""
+
+        def body(h, layer):
+            return apply_block(h, layer, cfg), None
+
+        out, _ = jax.lax.scan(body, act.astype(x.dtype), local_layers)
+        return out.astype(wire_dt)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(None, None, None, None)),
+        out_specs=P(None, None, None, None),
+        check_vma=False,
+        axis_names=frozenset({axis}) if composed else frozenset(),
+    )
+    def pipelined(local_layers, micro_all):
+        # local_layers leaves: [layers_per_stage, ...]; micro_all: [M, mb, S, D]
+        stage = jax.lax.axis_index(axis)
+        mb_shape = micro_all.shape[1:]
+
+        def tick(carry, t):
+            act, outputs = carry
+            # stage 0 injects microbatch t (clamped; bubbles are masked)
+            inject = jnp.clip(t, 0, m - 1)
+            x_in = jnp.where(stage == 0, micro_all[inject], act)
+            y = stage_apply(local_layers, x_in)
+            # the last stage banks microbatch t-(S-1) when it's real
+            out_idx = t - (n_stages - 1)
+            valid = (stage == n_stages - 1) & (out_idx >= 0)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(valid, y, outputs[jnp.clip(out_idx, 0, m - 1)]),
+                jnp.clip(out_idx, 0, m - 1),
+                axis=0,
+            )
+            # hand activations to the next stage
+            act = jax.lax.ppermute(y, axis, perm)
+            return (act, outputs), None
+
+        act0 = jnp.zeros(mb_shape, micro_all.dtype)
+        outputs0 = jnp.zeros((m, *mb_shape), micro_all.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (act0, outputs0), jnp.arange(m + n_stages - 1)
+        )
+        # broadcast the last stage's collected outputs to every stage
+        is_last = (stage == n_stages - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * is_last, axis)
+
+    out = pipelined(stacked_layers, micro)  # [M, mb, S, D]
+    return out.reshape(batch, *x.shape[1:]).astype(x.dtype)

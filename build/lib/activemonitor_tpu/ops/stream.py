@@ -1,0 +1,129 @@
+"""HBM streaming kernel (Pallas) — the memory-bandwidth probe's hot op.
+
+A blocked scale-copy: each grid step moves one (block, 1024) tile
+HBM → VMEM, scales on the VPU, and writes back — 2 bytes moved per
+payload byte, the STREAM "scale" pattern. A hand-set grid keeps each
+tile within VMEM while the pipeline overlaps the next tile's DMA with
+the current tile's compute (Pallas double-buffers automatically).
+
+On non-TPU platforms the kernel runs in interpret mode (correct but
+slow), so tests exercise the same code path on CPU; the probe falls
+back to a plain jnp expression for *timing* there.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+
+def _scale_copy_kernel(in_ref, out_ref, *, scale):
+    out_ref[:] = in_ref[:] * scale
+
+
+def stream_scale_pallas(x: jax.Array, scale: float = 2.0, block_rows: int = 512):
+    """Blocked scale-copy via Pallas; requires x.shape = (rows, 1024)
+    with rows % block_rows == 0."""
+    from jax.experimental import pallas as pl
+
+    rows, cols = x.shape
+    if rows % block_rows:
+        raise ValueError(f"rows {rows} not divisible by block {block_rows}")
+    interpret = jax.devices()[0].platform != "tpu"
+    return pl.pallas_call(
+        partial(_scale_copy_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x)
+
+
+def stream_scale_pallas_db(
+    x: jax.Array, scale: float = 2.0, block_rows: int = 512
+):
+    """Explicitly double-buffered variant: the whole array stays in HBM
+    (memory_space=ANY) and the kernel drives its own DMA pipeline — two
+    VMEM slots per direction, chunk i+1's copy-in and chunk i-2's
+    copy-out in flight while chunk i computes. This is what the
+    automatic grid pipeline of :func:`stream_scale_pallas` does under
+    the hood; owning the schedule lets the copy-out overlap too and
+    gives a second, independent measurement of achievable bandwidth."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, cols = x.shape
+    if rows % block_rows:
+        raise ValueError(f"rows {rows} not divisible by block {block_rows}")
+    num_chunks = rows // block_rows
+    interpret = jax.devices()[0].platform != "tpu"
+
+    def kernel(hbm_ref, out_ref):
+        def body(scratch_in, scratch_out, in_sems, out_sems):
+            def in_dma(slot, i):
+                return pltpu.make_async_copy(
+                    hbm_ref.at[pl.ds(i * block_rows, block_rows)],
+                    scratch_in.at[slot],
+                    in_sems.at[slot],
+                )
+
+            def out_dma(slot, i):
+                return pltpu.make_async_copy(
+                    scratch_out.at[slot],
+                    out_ref.at[pl.ds(i * block_rows, block_rows)],
+                    out_sems.at[slot],
+                )
+
+            in_dma(0, 0).start()
+
+            def loop_body(i, _):
+                slot = i % 2
+                nxt = (i + 1) % 2
+
+                @pl.when(i + 1 < num_chunks)
+                def _():
+                    in_dma(nxt, i + 1).start()
+
+                in_dma(slot, i).wait()
+
+                # this slot's previous copy-out must land before the
+                # compute below overwrites the scratch it reads from
+                @pl.when(i >= 2)
+                def _():
+                    out_dma(slot, i - 2).wait()
+
+                scratch_out[slot] = scratch_in[slot] * scale
+                out_dma(slot, i).start()
+
+            jax.lax.fori_loop(0, num_chunks, loop_body, None)
+            # drain the (up to two) outstanding copy-outs
+            @pl.when(num_chunks >= 2)
+            def _():
+                out_dma(num_chunks % 2, num_chunks - 2).wait()
+
+            out_dma((num_chunks - 1) % 2, num_chunks - 1).wait()
+
+        pl.run_scoped(
+            body,
+            scratch_in=pltpu.VMEM((2, block_rows, cols), x.dtype),
+            scratch_out=pltpu.VMEM((2, block_rows, cols), x.dtype),
+            in_sems=pltpu.SemaphoreType.DMA((2,)),
+            out_sems=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        interpret=interpret,
+    )(x)
+
+
+def stream_scale_xla(x: jax.Array, scale: float = 2.0):
+    """XLA fallback of the same op. The optimization barrier stops XLA
+    from algebraically collapsing a chain of these into a single
+    multiply (x * scale**k), which would fake k× the real bandwidth."""
+    return jax.lax.optimization_barrier(x * scale)

@@ -1,0 +1,29 @@
+"""Device mesh + timed collective helpers."""
+
+from activemonitor_tpu.parallel.collectives import (
+    CollectiveResult,
+    all_gather_bandwidth,
+    all_reduce_bandwidth,
+    all_to_all_bandwidth,
+    ppermute_ring_bandwidth,
+    reduce_scatter_bandwidth,
+)
+from activemonitor_tpu.parallel.mesh import (
+    best_2d_shape,
+    device_info,
+    make_1d_mesh,
+    make_2d_mesh,
+)
+
+__all__ = [
+    "CollectiveResult",
+    "all_gather_bandwidth",
+    "all_reduce_bandwidth",
+    "all_to_all_bandwidth",
+    "best_2d_shape",
+    "device_info",
+    "make_1d_mesh",
+    "make_2d_mesh",
+    "ppermute_ring_bandwidth",
+    "reduce_scatter_bandwidth",
+]

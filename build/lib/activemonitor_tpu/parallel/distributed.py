@@ -1,0 +1,97 @@
+"""Multi-host (multi-slice) initialization — the DCN story.
+
+Single-slice probes talk over ICI only. For multi-host slices and
+multislice topologies, JAX's distributed runtime must be initialized
+before any device access so all hosts join one global device set and
+collectives can ride DCN between slices
+(SURVEY.md §5.8: `jax.distributed.initialize` is the NCCL/MPI-backend
+equivalent).
+
+The probe CLI calls :func:`maybe_initialize_distributed` first thing;
+it is a no-op unless the standard TPU/GKE environment variables (or
+explicit arguments) indicate a multi-host run, so single-host probes
+stay zero-config.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def detect_multihost_env() -> bool:
+    """True when the pod/VM environment announces a multi-host topology
+    (GKE TPU injects these for multi-host node pools)."""
+    if os.environ.get("ACTIVEMONITOR_DISTRIBUTED") == "1":
+        return True
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return "," in hostnames  # more than one worker
+
+
+def maybe_initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    force: bool = False,
+) -> bool:
+    """Initialize jax.distributed when the environment calls for it.
+
+    Returns True if distributed mode was initialized. Explicit arguments
+    (or ``force``) win; otherwise JAX's own TPU auto-detection fills
+    everything in.
+    """
+    import jax
+
+    if not (force or coordinator_address or detect_multihost_env()):
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # double-init is fine ("distributed.initialize should only be
+        # called once" in jax 0.9); anything else should surface
+        if "once" in str(e) or "already" in str(e):
+            return True
+        raise
+    log.info(
+        "distributed initialized: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True
+
+
+def distribute(array, sharding):
+    """Place a host-resident (or local-device) array onto a sharding
+    that may span PROCESSES.
+
+    Single-process: plain ``device_put``. Multi-process: every process
+    passes the same GLOBAL logical array (deterministic construction —
+    same seed on every host) and contributes only its addressable
+    shards via ``make_array_from_callback`` — the multi-host answer to
+    "how does a global batch/parameter land on a DCN-spanning mesh"
+    without any host ever holding another host's shard on device.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    host = np.asarray(array)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def distribute_tree(tree, sharding_tree):
+    """:func:`distribute` over a pytree of arrays + matching shardings."""
+    import jax
+
+    return jax.tree.map(distribute, tree, sharding_tree)

@@ -1,0 +1,5 @@
+"""TPU probe payload library (the TPU-native graft; see BASELINE.md)."""
+
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+
+__all__ = ["ProbeMetric", "ProbeResult"]

@@ -1,0 +1,5 @@
+import sys
+
+from activemonitor_tpu.probes.cli import main
+
+sys.exit(main())

@@ -1,0 +1,52 @@
+"""Probe protocol and the custom-metrics output contract.
+
+A probe is a callable returning a :class:`ProbeResult`. Run as a
+workflow payload (any engine), its last stdout line is the JSON
+custom-metrics contract the controller parses into Prometheus gauges
+(reference contract: internal/metrics/collector.go:68-115 —
+``{"metrics": [{name, value, metrictype, help}]}``), and its exit code
+is the probe verdict Argo/the local engine turn into Succeeded/Failed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class ProbeMetric:
+    name: str
+    value: float
+    help: str = ""
+    metrictype: str = "gauge"
+
+    def to_contract(self) -> dict:
+        return {
+            "name": self.name,
+            "value": float(self.value),
+            "metrictype": self.metrictype,
+            "help": self.help,
+        }
+
+
+@dataclass
+class ProbeResult:
+    ok: bool
+    summary: str
+    metrics: List[ProbeMetric] = field(default_factory=list)
+    details: Dict = field(default_factory=dict)
+
+    def contract_line(self) -> str:
+        return json.dumps({"metrics": [m.to_contract() for m in self.metrics]})
+
+    def emit(self) -> int:
+        """Human-readable report to stderr, contract line to stdout,
+        exit code for the engine."""
+        print(("OK: " if self.ok else "FAIL: ") + self.summary, file=sys.stderr)
+        for key, value in sorted(self.details.items()):
+            print(f"  {key}: {value}", file=sys.stderr)
+        print(self.contract_line(), flush=True)
+        return 0 if self.ok else 1

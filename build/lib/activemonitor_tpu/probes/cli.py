@@ -1,0 +1,445 @@
+"""Probe CLI — ``python -m activemonitor_tpu.probes <probe> [options]``.
+
+This is what workflow templates invoke (container command or script) in
+every engine; stdout's final line is the custom-metrics contract, the
+exit code is the verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m activemonitor_tpu.probes",
+        description="TPU health probe payloads",
+    )
+    parser.add_argument(
+        "--profile",
+        default="",
+        metavar="DIR",
+        help="capture a jax.profiler trace of the probe into DIR "
+        "(view with TensorBoard / xprof)",
+    )
+    parser.add_argument(
+        "--distributed",
+        action="store_true",
+        help="force jax.distributed.initialize (multi-host slices; "
+        "auto-detected from TPU_WORKER_HOSTNAMES otherwise)",
+    )
+    parser.add_argument(
+        "--coordinator",
+        default=None,
+        metavar="HOST:PORT",
+        help="explicit jax.distributed coordinator (implies --distributed)",
+    )
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    sub = parser.add_subparsers(dest="probe", required=True)
+
+    p = sub.add_parser("devices", help="device inventory check")
+    p.add_argument("--expect", type=int, default=None, help="required device count")
+    p.add_argument(
+        "--require-platform", default="", help="required platform (e.g. tpu)"
+    )
+
+    p = sub.add_parser("ici-allreduce", help="ICI all-reduce bandwidth check")
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--threshold", type=float, default=0.9)
+    p.add_argument("--no-ring", action="store_true")
+
+    p = sub.add_parser(
+        "collectives",
+        help="full collective sweep: all-reduce/-gather, reduce-scatter, "
+        "all-to-all, ring hop",
+    )
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--threshold", type=float, default=0.8)
+    p.add_argument(
+        "--per-axis",
+        action="store_true",
+        help="measure each 2D-mesh axis separately (localizes which "
+        "torus direction is degraded)",
+    )
+
+    p = sub.add_parser("compile-smoke", help="XLA compile smoke test")
+    p.add_argument("--deadline", type=float, default=120.0)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--tiny", action="store_true")
+
+    p = sub.add_parser("training-step", help="sharded train-step probe")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--batch-per-device", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument(
+        "--attention",
+        choices=("dense", "flash", "ring"),
+        default="dense",
+        help="attention implementation: dense (XLA), the fused flash "
+        "kernel (custom-VJP Pallas; shard_map over tp heads), or "
+        "sequence-parallel ring attention (needs an 'sp' mesh axis)",
+    )
+    p.add_argument(
+        "--mfu-threshold",
+        type=float,
+        default=None,
+        help="fail the probe below this MFU (BASELINE.md single-chip "
+        "bar; the battery applies rated.TRAIN_MFU_BAR)",
+    )
+    p.add_argument(
+        "--zero1",
+        action="store_true",
+        help="ZeRO-1: shard AdamW mu/nu over the data axis too",
+    )
+    p.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialize block activations in the backward",
+    )
+    p.add_argument(
+        "--accum-steps",
+        type=int,
+        default=1,
+        help="gradient accumulation microbatches per step",
+    )
+
+    p = sub.add_parser("hbm", help="HBM bandwidth check")
+    p.add_argument("--size-mb", type=float, default=256.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--threshold", type=float, default=0.6)
+    p.add_argument("--no-pallas", action="store_true")
+
+    p = sub.add_parser("matmul", help="MXU matmul throughput check")
+    p.add_argument(
+        "--dim",
+        type=int,
+        default=None,
+        help="single dimension (default: sweep 4096/8192 and report best)",
+    )
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--threshold", type=float, default=0.75)
+    p.add_argument(
+        "--dtype",
+        choices=("bf16", "int8"),
+        default="bf16",
+        help="MXU throughput mode (int8 is rated 2x bf16 on v5e+)",
+    )
+
+    p = sub.add_parser(
+        "ring-attention", help="sequence-parallel attention correctness + throughput"
+    )
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--seq-per-device", type=int, default=1024)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--flash",
+        action="store_true",
+        help="run each ring step's block compute through the fused "
+        "Pallas kernel instead of XLA einsums",
+    )
+
+    p = sub.add_parser(
+        "flash-attention", help="fused attention kernel correctness + throughput"
+    )
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument(
+        "--seq",
+        type=int,
+        default=None,
+        help="sequence length (default: 4096, or 2048 for --sweep)",
+    )
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--no-causal", action="store_true")
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=2e-2,
+        help="forward max-abs-error gate; the gradient gate is a "
+        "documented 2.5x of this",
+    )
+    p.add_argument(
+        "--min-fraction",
+        type=float,
+        default=None,
+        help="fail the probe below this fraction of rated bf16 peak "
+        "(BASELINE.md single-chip bar; the battery applies "
+        "rated.FLASH_FRACTION_BAR)",
+    )
+    p.add_argument(
+        "--sweep",
+        action="store_true",
+        help="measure the (block_q, block_k) -> TFLOP/s tables the "
+        "kernel defaults cite (forward grid + backward shapes) "
+        "instead of the correctness/throughput probe",
+    )
+    p.add_argument(
+        "--sweep-rounds",
+        type=int,
+        default=2,
+        help="interleaved full passes over the sweep grid (per-config "
+        "best kept; guards against contention bursts)",
+    )
+
+    p = sub.add_parser("decode", help="KV-cache decode-step latency + consistency")
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--decode-tokens", type=int, default=32)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--flash",
+        action="store_true",
+        help="time the loop through the fused decode kernel "
+        "(flash_decode: one blockwise HBM pass over the cache)",
+    )
+
+    p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
+    p.add_argument("--probe-gb", type=float, default=1.0)
+
+    p = sub.add_parser(
+        "straggler", help="per-device timing/numerics spread — find the sick chip"
+    )
+    p.add_argument("--dim", type=int, default=0, help="matmul dim (0 = auto)")
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=1.25,
+        help="flag devices slower than this multiple of the median",
+    )
+
+    p = sub.add_parser("transfer", help="host<->device bandwidth (data-feed path)")
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument(
+        "--min-gbps",
+        type=float,
+        default=0.0,
+        help="fail below this bandwidth in either direction (0 = informational)",
+    )
+
+    p = sub.add_parser(
+        "checkpoint", help="sharded orbax save/restore round-trip + bandwidth"
+    )
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument(
+        "--directory",
+        default="",
+        help="checkpoint under this directory (default: throwaway temp dir)",
+    )
+
+    p = sub.add_parser(
+        "dcn-allreduce", help="cross-host all-reduce bandwidth + correctness"
+    )
+    p.add_argument("--size-mb", type=float, default=16.0)
+    p.add_argument("--iters", type=int, default=4)
+
+    p = sub.add_parser("all", help="run the whole probe battery in one payload")
+    p.add_argument("--quick", action="store_true", help="smaller/faster variants")
+    p.add_argument(
+        "--skip", action="append", default=[], metavar="PROBE", help="probe to skip"
+    )
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    # a "CPU" battery run must not silently land on (and wedge against)
+    # a site-plugin-registered remote device — shared rule, see
+    # utils/platform.py (env-var trigger only: a stale XLA_FLAGS must
+    # not silently downgrade a real-chip battery to interpret mode)
+    from activemonitor_tpu.utils.platform import force_cpu_if_requested
+
+    if force_cpu_if_requested() is False:
+        print(
+            "warning: JAX_PLATFORMS=cpu requested but the backend is "
+            "already initialized on another platform",
+            file=sys.stderr,
+        )
+    args = build_parser().parse_args(argv)
+    from activemonitor_tpu.parallel.distributed import maybe_initialize_distributed
+
+    if (
+        args.num_processes is not None or args.process_id is not None
+    ) and not (args.coordinator or args.distributed):
+        print(
+            "error: --num-processes/--process-id require --coordinator "
+            "(or --distributed)",
+            file=sys.stderr,
+        )
+        return 2
+    maybe_initialize_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        force=args.distributed,
+    )
+
+    if args.profile:
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            return _dispatch(args)
+    return _dispatch(args)
+
+
+def _dispatch(args) -> int:
+    if args.probe == "devices":
+        from activemonitor_tpu.probes import devices
+
+        result = devices.run(
+            expect_devices=args.expect, require_platform=args.require_platform
+        )
+    elif args.probe == "ici-allreduce":
+        from activemonitor_tpu.probes import ici
+
+        result = ici.run(
+            size_mb=args.size_mb,
+            iters=args.iters,
+            threshold=args.threshold,
+            include_ring=not args.no_ring,
+        )
+    elif args.probe == "collectives":
+        from activemonitor_tpu.probes import collectives
+
+        if args.per_axis:
+            result = collectives.run_per_axis(
+                size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
+            )
+        else:
+            result = collectives.run(
+                size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
+            )
+    elif args.probe == "compile-smoke":
+        from activemonitor_tpu.probes import compile_smoke
+
+        result = compile_smoke.run(
+            compile_deadline_seconds=args.deadline,
+            batch=args.batch,
+            seq=args.seq,
+            tiny=args.tiny,
+        )
+    elif args.probe == "training-step":
+        from activemonitor_tpu.probes import training_step
+
+        result = training_step.run(
+            tiny=args.tiny,
+            batch_per_device=args.batch_per_device,
+            seq=args.seq,
+            steps=args.steps,
+            attention=args.attention,
+            mfu_threshold=args.mfu_threshold,
+            zero1=args.zero1,
+            remat=args.remat,
+            accum_steps=args.accum_steps,
+        )
+    elif args.probe == "hbm":
+        from activemonitor_tpu.probes import hbm
+
+        result = hbm.run(
+            size_mb=args.size_mb,
+            iters=args.iters,
+            threshold=args.threshold,
+            use_pallas=not args.no_pallas,
+        )
+    elif args.probe == "matmul":
+        from activemonitor_tpu.probes import matmul
+
+        result = matmul.run(
+            dim=args.dim, iters=args.iters, threshold=args.threshold,
+            dtype=args.dtype,
+        )
+    elif args.probe == "ring-attention":
+        from activemonitor_tpu.probes import ring
+
+        result = ring.run(
+            batch=args.batch,
+            seq_per_device=args.seq_per_device,
+            heads=args.heads,
+            head_dim=args.head_dim,
+            iters=args.iters,
+            use_flash=args.flash,
+        )
+    elif args.probe == "flash-attention":
+        from activemonitor_tpu.probes import flash
+
+        if args.sweep:
+            result = flash.sweep(
+                batch=args.batch,
+                # None = per-mode default (clamped off-TPU); an explicit
+                # --seq reaches the probe verbatim and always wins
+                seq=args.seq,
+                heads=args.heads,
+                head_dim=args.head_dim,
+                iters=args.iters,
+                causal=not args.no_causal,
+                rounds=args.sweep_rounds,
+                min_fraction=args.min_fraction,
+            )
+        else:
+            result = flash.run(
+                batch=args.batch,
+                seq=args.seq,
+                heads=args.heads,
+                head_dim=args.head_dim,
+                iters=args.iters,
+                causal=not args.no_causal,
+                tolerance=args.tolerance,
+                min_fraction=args.min_fraction,
+            )
+    elif args.probe == "decode":
+        from activemonitor_tpu.probes import decode
+
+        result = decode.run(
+            tiny=args.tiny,
+            batch=args.batch,
+            prompt_len=args.prompt_len,
+            decode_tokens=args.decode_tokens,
+            iters=args.iters,
+            use_flash=args.flash,
+        )
+    elif args.probe == "memory":
+        from activemonitor_tpu.probes import memory
+
+        result = memory.run(probe_gb=args.probe_gb)
+    elif args.probe == "straggler":
+        from activemonitor_tpu.probes import straggler
+
+        result = straggler.run(
+            dim=args.dim, iters=args.iters, threshold=args.threshold
+        )
+    elif args.probe == "transfer":
+        from activemonitor_tpu.probes import transfer
+
+        result = transfer.run(
+            size_mb=args.size_mb, iters=args.iters, min_gbps=args.min_gbps
+        )
+    elif args.probe == "checkpoint":
+        from activemonitor_tpu.probes import checkpoint
+
+        result = checkpoint.run(size_mb=args.size_mb, directory=args.directory)
+    elif args.probe == "dcn-allreduce":
+        from activemonitor_tpu.probes import dcn
+
+        result = dcn.run(size_mb=args.size_mb, iters=args.iters)
+    elif args.probe == "all":
+        from activemonitor_tpu.probes import suite
+
+        result = suite.run(quick=args.quick, skip=args.skip)
+    else:  # pragma: no cover - argparse guards
+        raise SystemExit(2)
+    return result.emit()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

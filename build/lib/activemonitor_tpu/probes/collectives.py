@@ -1,0 +1,198 @@
+"""Collectives-sweep probe — the full XLA collective set over ICI.
+
+The ici-allreduce probe answers the north-star question; this probe
+characterizes the whole communication surface the parallelism code
+relies on: all-reduce (dp gradient sync), all-gather (tp/weight
+gather), reduce-scatter (ZeRO/psum_scatter), all-to-all (ep dispatch,
+ops/moe.py) and single-hop ppermute (ring attention, ops/ring_attention
+.py; pipeline, ops/pipeline.py). A degradation only one pattern hits —
+e.g. a routing fault that halves the bisection but leaves neighbor
+links intact — shows up here before it shows up as slow training.
+
+Exports, per collective C in {allreduce, allgather, reducescatter,
+alltoall, ringhop} (prefix ``collective-``, distinct from the
+north-star probe's ``ici-`` gauges so a merged battery contract never
+carries duplicate names):
+
+- ``collective-<C>-busbw-gbps`` — NCCL busbw convention
+- ``collective-<C>-fraction-of-rated`` — busbw / rated ceiling (TPU)
+
+Rated ceilings assume the same bidirectional-ring model as probes/ici:
+2 x unidir link bw for the ring collectives, 1 x for a single hop —
+except all-to-all, which is bisection-bound on a ring: each half
+exchanges n*S/4 bytes per direction across the cut's 2 links, capping
+busbw at 8*B*(n-1)/n^2.
+
+Verdict: every collective's fraction must clear ``threshold`` (rated
+hardware, >1 device); otherwise informational-pass, like the other
+bandwidth probes. No reference counterpart (the reference has no
+communication backend at all, SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from activemonitor_tpu.parallel.collectives import (
+    CollectiveResult,
+    all_gather_bandwidth,
+    all_reduce_bandwidth,
+    all_to_all_bandwidth,
+    ppermute_ring_bandwidth,
+    reduce_scatter_bandwidth,
+)
+from activemonitor_tpu.parallel.mesh import make_1d_mesh, make_2d_mesh
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+
+ALL_CASES = ("allreduce", "allgather", "reducescatter", "alltoall", "ringhop")
+
+_BENCH: Dict[str, Callable] = {
+    "allreduce": all_reduce_bandwidth,
+    "allgather": all_gather_bandwidth,
+    "reducescatter": reduce_scatter_bandwidth,
+    "alltoall": all_to_all_bandwidth,
+    "ringhop": ppermute_ring_bandwidth,
+}
+
+
+def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
+    """Achievable-busbw ceiling on a bidirectional ring of n devices
+    with per-direction link bandwidth ``unidir_gbps`` (see module doc)."""
+    if name == "ringhop":
+        return unidir_gbps
+    if name == "alltoall":
+        return 8 * unidir_gbps * (n - 1) / n**2
+    return 2 * unidir_gbps
+
+
+def _emit(
+    entries: List[Tuple[str, str, int, CollectiveResult]],
+    threshold: float,
+    context: str,
+    details: Dict,
+) -> ProbeResult:
+    """Shared emission scaffolding for the flat and per-axis sweeps.
+
+    ``entries``: (label, base_case, ring_n, result) — the label is the
+    metric suffix ("allreduce" or "allreduce-data"), the base case picks
+    the rated comparator, ring_n its ring size. ``context`` names the
+    measured surface in the summary."""
+    devices = jax.devices()
+    rated = rated_for(devices[0].device_kind)
+    on_tpu = devices[0].platform == "tpu"
+    metrics: List[ProbeMetric] = []
+    fractions: Dict[str, float] = {}
+    for label, base_case, ring_n, result in entries:
+        key = label.replace("-", "_")
+        metrics.append(
+            ProbeMetric(
+                f"collective-{label}-busbw-gbps",
+                result.busbw_gbps,
+                help=f"Measured {result.name} bus bandwidth (NCCL convention), GB/s",
+            )
+        )
+        details[f"{key}_busbw_gbps"] = round(result.busbw_gbps, 2)
+        if rated is not None and on_tpu:
+            rated_busbw = _rated_busbw(base_case, rated.ici_unidir_gbps, ring_n)
+            fraction = result.busbw_gbps / rated_busbw
+            fractions[label] = fraction
+            metrics.append(
+                ProbeMetric(
+                    f"collective-{label}-fraction-of-rated",
+                    fraction,
+                    help=f"{result.name} busbw / achievable ring ceiling",
+                )
+            )
+            details[f"{key}_fraction_of_rated"] = round(fraction, 3)
+
+    if fractions:
+        worst = min(fractions, key=fractions.get)
+        ok = fractions[worst] >= threshold
+        summary = (
+            f"{context}: worst {worst} at {fractions[worst]:.0%} of "
+            f"rated {rated.generation}"
+            + ("" if ok else f" (< {threshold:.0%} threshold)")
+        )
+    else:
+        ok = True
+        best = max(entries, key=lambda e: e[3].busbw_gbps)
+        summary = (
+            f"{context}: best {best[0]} {best[3].busbw_gbps:.1f} GB/s "
+            "(no rated comparison)"
+        )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+
+
+def run_per_axis(
+    size_mb: float = 64.0,
+    iters: int = 5,
+    threshold: float = 0.8,
+) -> ProbeResult:
+    """Per-axis variant over the 2D mesh: all-reduce and single-hop
+    ppermute restricted to EACH mesh axis. The mesh is built with
+    physical-topology alignment (parallel/mesh.make_2d_mesh uses
+    mesh_utils.create_device_mesh on TPU), so on a real slice the two
+    axes ride different torus dimensions and a degradation confined to
+    one link direction shows up as one axis's fraction dropping while
+    the other stays healthy — `collectives` alone can only say "slow",
+    this says "slow WHERE"."""
+    devices = jax.devices()
+    n = len(devices)
+    if n < 4:
+        return ProbeResult(
+            ok=True,
+            summary=f"per-axis sweep skipped: {n} device(s), no 2D mesh",
+            metrics=[],
+            details={"devices": n, "skipped": True},
+        )
+    mesh = make_2d_mesh()
+    entries = [
+        (f"{name}-{axis}", name, mesh.shape[axis],
+         bench(mesh, size_mb=size_mb, iters=iters, axis=axis))
+        for axis in mesh.axis_names
+        if mesh.shape[axis] >= 2  # nothing to move along a singleton axis
+        for name, bench in (("allreduce", all_reduce_bandwidth),
+                            ("ringhop", ppermute_ring_bandwidth))
+    ]
+    details = {
+        "devices": n,
+        "device_kind": devices[0].device_kind,
+        "mesh": dict(mesh.shape),
+    }
+    return _emit(
+        entries, threshold, f"per-axis sweep over mesh {dict(mesh.shape)}", details
+    )
+
+
+def run(
+    size_mb: float = 64.0,
+    iters: int = 5,
+    threshold: float = 0.8,
+    cases: Optional[Sequence[str]] = None,
+) -> ProbeResult:
+    cases = tuple(cases) if cases else ALL_CASES
+    unknown = [c for c in cases if c not in _BENCH]
+    if unknown:
+        raise ValueError(f"unknown collectives {unknown}; pick from {ALL_CASES}")
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return ProbeResult(
+            ok=True,
+            summary=f"collectives sweep skipped: {n} device(s), nothing to move",
+            metrics=[],
+            details={"devices": n, "skipped": True},
+        )
+
+    mesh = make_1d_mesh()
+    entries = [
+        (name, name, n, _BENCH[name](mesh, size_mb=size_mb, iters=iters))
+        for name in cases
+    ]
+    details = {"devices": n, "device_kind": devices[0].device_kind}
+    return _emit(
+        entries, threshold, f"{len(entries)} collectives over {n} device(s)", details
+    )

@@ -1,0 +1,96 @@
+"""XLA compile smoke-test probe.
+
+Detects the stuck-compile failure mode (SURVEY.md §5.3 TPU detectors):
+jits the canonical probe transformer forward, wall-clocks cold compile
+and measures warm execution, and fails if compile exceeds its deadline.
+First TPU compiles legitimately take tens of seconds — the default
+threshold reflects that; persistent-cache hits make subsequent runs
+fast.
+
+Timing discipline (utils/timing.py): the cold-compile number is wall
+clock forced through a scalar host readback (a transfer cannot lie,
+unlike ``block_until_ready`` on tunneled devices), and the warm
+execution number uses the chain-delta method so dispatch/transport
+overhead cancels.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.models.probe_model import (
+    ProbeModelConfig,
+    forward,
+    init_params,
+    tiny_config,
+)
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+
+def run(
+    compile_deadline_seconds: float = 120.0,
+    batch: int = 4,
+    seq: int = 128,
+    tiny: bool = False,
+) -> ProbeResult:
+    cfg = tiny_config() if tiny else ProbeModelConfig()
+    seq = min(seq, cfg.max_seq_len)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+
+    # cold compile: wall clock ending in a forced scalar readback
+    scalar_fwd = jax.jit(lambda p, t: forward(p, t, cfg).mean())
+    t0 = time.perf_counter()
+    float(scalar_fwd(params, tokens))
+    compile_seconds = time.perf_counter() - t0
+
+    # warm execution: chain-difference (constant overhead cancels). The
+    # chain is a lax.scan — ONE traced body regardless of k, so the
+    # chain compiles in ~constant time in a probe whose premise is that
+    # compiles can be slow (an unrolled Python loop would compile k
+    # copies of the forward).
+    def make_chain(k: int):
+        def chain(p, t):
+            def step(carry, _):
+                out = forward(p, carry, cfg)
+                # REAL data dependence between iterations (argmax of the
+                # logits feeds the next forward) — a foldable dependence
+                # gets CSE'd by XLA and the delta collapses
+                nxt = (jnp.argmax(out, axis=-1) % cfg.vocab_size).astype(jnp.int32)
+                return nxt, out.mean()
+            _, means = jax.lax.scan(step, t, None, length=k)
+            return means[-1]
+        return jax.jit(chain)
+
+    exec_seconds = chain_delta_seconds(make_chain, params, tokens)
+
+    ok = compile_seconds <= compile_deadline_seconds
+    return ProbeResult(
+        ok=ok,
+        summary=(
+            f"compile {compile_seconds:.2f}s (deadline {compile_deadline_seconds:.0f}s), "
+            f"exec {exec_seconds * 1e3:.2f}ms"
+        ),
+        metrics=[
+            ProbeMetric(
+                "xla-compile-seconds",
+                compile_seconds,
+                help="Cold jit compile wall-clock of the probe transformer forward",
+            ),
+            ProbeMetric(
+                "xla-exec-milliseconds",
+                exec_seconds * 1e3,
+                help="Warm per-forward device time (chain-delta estimate)",
+            ),
+        ],
+        details={
+            "batch": batch,
+            "seq": seq,
+            "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers,
+        },
+    )

@@ -1,0 +1,50 @@
+"""Device inventory probe.
+
+Asserts the TPU slice is fully visible: device count matches the
+expected topology (e.g. 8 for a v5e-8) and the platform is what the
+check demands. The BASELINE.md device-inventory target:
+``len(jax.devices()) == 8`` on a v5e-8, platform ``tpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from activemonitor_tpu.parallel.mesh import device_info
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+
+
+def run(expect_devices: Optional[int] = None, require_platform: str = "") -> ProbeResult:
+    info = device_info()
+    ok = True
+    problems = []
+    if expect_devices is not None and info["count"] != expect_devices:
+        ok = False
+        problems.append(f"expected {expect_devices} devices, found {info['count']}")
+    if require_platform and info["platform"] != require_platform:
+        ok = False
+        problems.append(
+            f"expected platform {require_platform!r}, found {info['platform']!r}"
+        )
+    summary = (
+        f"{info['count']}x {info['device_kind']} ({info['platform']})"
+        if ok
+        else "; ".join(problems)
+    )
+    return ProbeResult(
+        ok=ok,
+        summary=summary,
+        metrics=[
+            ProbeMetric(
+                "tpu-device-count",
+                info["count"],
+                help="Number of accelerator devices visible to the probe",
+            ),
+            ProbeMetric(
+                "tpu-device-healthy",
+                1.0 if ok else 0.0,
+                help="1 when the device inventory matches expectations",
+            ),
+        ],
+        details=info,
+    )

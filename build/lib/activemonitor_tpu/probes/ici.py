@@ -1,0 +1,94 @@
+"""ICI all-reduce bandwidth probe — the north-star check.
+
+Measures achieved all-reduce bus bandwidth over the chip mesh and
+compares against the rated ICI link bandwidth (BASELINE.md: ≥90 % of
+rated on a GKE v5e-8). Exports:
+
+- ``ici-allreduce-busbw-gbps`` — measured bus bandwidth (NCCL convention)
+- ``ici-allreduce-fraction-of-rated`` — measured / rated
+- ``ici-ring-hop-gbps`` — single-hop ppermute bandwidth
+"""
+
+from __future__ import annotations
+
+import jax
+
+from activemonitor_tpu.parallel.collectives import (
+    all_reduce_bandwidth,
+    ppermute_ring_bandwidth,
+)
+from activemonitor_tpu.parallel.mesh import make_1d_mesh
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+
+
+def run(
+    size_mb: float = 64.0,
+    iters: int = 10,
+    threshold: float = 0.9,
+    include_ring: bool = True,
+) -> ProbeResult:
+    devices = jax.devices()
+    n = len(devices)
+    mesh = make_1d_mesh()
+    result = all_reduce_bandwidth(mesh, size_mb=size_mb, iters=iters)
+    rated = rated_for(devices[0].device_kind)
+
+    metrics = [
+        ProbeMetric(
+            "ici-allreduce-busbw-gbps",
+            result.busbw_gbps,
+            help="Measured all-reduce bus bandwidth (NCCL busbw convention), GB/s",
+        ),
+        ProbeMetric(
+            "ici-allreduce-algbw-gbps",
+            result.algbw_gbps,
+            help="Measured all-reduce algorithm bandwidth, GB/s",
+        ),
+    ]
+    details = {
+        "devices": n,
+        "device_kind": devices[0].device_kind,
+        "payload_mb": result.payload_bytes / 1e6,
+        "seconds_per_op": result.seconds_per_op,
+        "busbw_gbps": round(result.busbw_gbps, 2),
+    }
+
+    ring = None
+    if include_ring and n > 1:
+        ring = ppermute_ring_bandwidth(mesh, size_mb=size_mb, iters=iters)
+        metrics.append(
+            ProbeMetric(
+                "ici-ring-hop-gbps",
+                ring.algbw_gbps,
+                help="Single-hop ppermute (ring neighbor shift) bandwidth, GB/s",
+            )
+        )
+        details["ring_hop_gbps"] = round(ring.algbw_gbps, 2)
+
+    ok = True
+    if rated is not None and n > 1 and devices[0].platform == "tpu":
+        # rated comparator: a 1D ring all-reduce is limited by one
+        # bidirectional link pair per hop ⇒ 2 × unidirectional link bw
+        rated_busbw = 2 * rated.ici_unidir_gbps
+        fraction = result.busbw_gbps / rated_busbw
+        metrics.append(
+            ProbeMetric(
+                "ici-allreduce-fraction-of-rated",
+                fraction,
+                help="Measured busbw / rated ring bandwidth (target ≥ 0.9)",
+            )
+        )
+        details["rated_busbw_gbps"] = rated_busbw
+        details["fraction_of_rated"] = round(fraction, 3)
+        ok = fraction >= threshold
+        summary = (
+            f"all-reduce busbw {result.busbw_gbps:.1f} GB/s = "
+            f"{fraction:.0%} of rated {rated_busbw:.0f} GB/s over {n}x {rated.generation}"
+        )
+    else:
+        summary = (
+            f"all-reduce busbw {result.busbw_gbps:.1f} GB/s over {n} device(s)"
+            " (no rated comparison: single device or unknown hardware)"
+        )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

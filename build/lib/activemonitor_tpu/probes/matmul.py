@@ -1,0 +1,131 @@
+"""MXU matmul probe.
+
+Times large bf16 matmuls — the op the systolic array exists for — and
+compares the best achieved TFLOP/s against the chip's rated bf16 peak.
+A chip delivering well under rated peak on a clean square matmul is
+throttled, misconfigured, or sick.
+
+A small dimension sweep, not one size: which dim the compiler tiles
+best varies by chip generation (on v5e, 4096 consistently lands nearer
+peak than 8192), and the probe's job is to measure what the chip CAN
+do — the max over dims is the right health signal, with the per-dim
+numbers kept in the details.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+log = logging.getLogger("activemonitor.probes")
+
+
+def _measure(dim: int, iters: int, dtype: str = "bf16") -> float:
+    """Achieved dense matmul T(FL)OP/s at one dimension. ``dtype`` is
+    "bf16" or "int8" (the MXU's two throughput modes on v5e+; int8 runs
+    at 2x the bf16 rate on paper and exercises a distinct data path)."""
+    if dtype == "int8":
+        a = jax.random.randint(jax.random.key(0), (dim, dim), -127, 127, jnp.int8)
+        b = jax.random.randint(jax.random.key(1), (dim, dim), -127, 127, jnp.int8)
+        # accumulate in int32 (the MXU's int8 contract); the wrap back
+        # to int8 keeps the chain data-dependent
+        accum, operand = jnp.int32, jnp.int8
+    else:
+        a = jax.random.normal(jax.random.key(0), (dim, dim), jnp.bfloat16)
+        b = jax.random.normal(jax.random.key(1), (dim, dim), jnp.bfloat16)
+        accum, operand = jnp.bfloat16, jnp.bfloat16
+
+    def make_chain(k):
+        @jax.jit
+        def chain(a, b):
+            x = b
+            for _ in range(k):  # data-dependent: each feeds the next
+                x = jnp.dot(a, x, preferred_element_type=accum).astype(operand)
+            return x.astype(jnp.float32).sum()
+
+        return chain
+
+    # wide k spread: the delta must tower over per-sample overhead
+    # variance, or the min-based estimate can overshoot physically
+    # impossible FLOP rates (>1.0 of rated) as easily as undershoot
+    seconds = chain_delta_seconds(make_chain, a, b, k1=4, k2=16, iters=iters)
+    return 2 * dim**3 / seconds / 1e12
+
+
+def run(
+    dim: Optional[int] = None,
+    iters: int = 10,
+    threshold: float = 0.75,
+    dims: Sequence[int] = (4096, 8192),
+    dtype: str = "bf16",
+) -> ProbeResult:
+    if dtype not in ("bf16", "int8"):
+        raise ValueError(f"dtype must be bf16 or int8, got {dtype!r}")
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if dim is not None:
+        dims = (dim,)  # explicit dim: no sweep (CLI --dim)
+    requested_dims = tuple(sorted(set(dims)))
+    dims = requested_dims
+    if not on_tpu:
+        # any large dim is downsized off-TPU (a 4096 bf16 chain takes
+        # minutes on CPU and there is no rated comparison there) —
+        # loudly, and recorded in the details below, so numbers are
+        # never silently compared across the clamp
+        dims = tuple(sorted({1024 if d > 2048 else d for d in requested_dims}))
+        if dims != requested_dims:
+            log.warning(
+                "matmul dims %s downsized to %s off-TPU; numbers are NOT "
+                "comparable to a TPU run", requested_dims, dims,
+            )
+
+    per_dim = {d: _measure(d, iters, dtype=dtype) for d in dims}
+    dim, tflops = max(per_dim.items(), key=lambda kv: kv[1])
+    seconds = 2 * dim**3 / tflops / 1e12
+    unit = "TOP/s" if dtype == "int8" else "TFLOP/s"
+
+    rated = rated_for(device.device_kind)
+    if dtype == "int8":
+        metrics = [
+            ProbeMetric("mxu-int8-matmul-tops", tflops, help="Achieved int8 matmul TOP/s")
+        ]
+        rated_peak = rated.int8_tops if rated is not None else 0.0
+        fraction_name = "mxu-int8-fraction-of-rated"
+        fraction_help = "Achieved / rated int8 peak"
+    else:
+        metrics = [
+            ProbeMetric("mxu-matmul-tflops", tflops, help="Achieved bf16 matmul TFLOP/s")
+        ]
+        rated_peak = rated.bf16_tflops if rated is not None else 0.0
+        fraction_name = "mxu-fraction-of-rated"
+        fraction_help = "Achieved / rated bf16 peak"
+    per_dim_key = "per_dim_tops" if dtype == "int8" else "per_dim_tflops"
+    details = {
+        "dim": dim,
+        "dtype": dtype,
+        per_dim_key: {d: round(v, 1) for d, v in per_dim.items()},
+        "seconds_per_op": seconds,
+        "device_kind": device.device_kind,
+    }
+    if tuple(dims) != requested_dims:
+        details["requested_dims"] = list(requested_dims)  # downsized off-TPU
+    ok = True
+    # rated_peak == 0 means the generation has no such mode (int8 on
+    # v4): informational pass rather than a division by zero
+    if rated is not None and on_tpu and rated_peak > 0:
+        fraction = tflops / rated_peak
+        metrics.append(ProbeMetric(fraction_name, fraction, help=fraction_help))
+        details["rated_tops" if dtype == "int8" else "rated_tflops"] = rated_peak
+        details["fraction"] = round(fraction, 3)
+        ok = fraction >= threshold
+        summary = f"{dtype} matmul {tflops:.0f} {unit} = {fraction:.0%} of rated {rated_peak:.0f}"
+    else:
+        summary = f"{dtype} matmul {tflops:.2f} {unit} on {device.platform} (no rated comparison)"
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

@@ -1,0 +1,85 @@
+"""HBM memory-headroom probe.
+
+Two layers of signal:
+
+1. ``memory_stats()`` from the PJRT device (bytes in use / limit /
+   peak) when the runtime exposes it — on-host TPUs do; tunneled or
+   virtual devices may not, in which case those gauges are omitted;
+2. an allocation smoke test: materialize-and-free a caller-sized
+   buffer, proving that much contiguous headroom actually exists (an
+   OOM here means the chip is carrying leaked buffers — the
+   slow-creep failure mode long-lived TPU workloads hit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+
+
+def run(probe_gb: float = 1.0) -> ProbeResult:
+    device = jax.devices()[0]
+    metrics = []
+    details = {"device_kind": device.device_kind}
+
+    stats = None
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        in_use = float(stats.get("bytes_in_use", 0))
+        limit = float(stats.get("bytes_limit", 0))
+        peak = float(stats.get("peak_bytes_in_use", 0))
+        metrics.append(
+            ProbeMetric("hbm-bytes-in-use", in_use, help="HBM bytes currently allocated")
+        )
+        if limit:
+            metrics.append(
+                ProbeMetric(
+                    "hbm-utilization",
+                    in_use / limit,
+                    help="HBM bytes in use / bytes limit",
+                )
+            )
+            details["bytes_limit_gb"] = round(limit / 1e9, 2)
+        if peak:
+            metrics.append(
+                ProbeMetric("hbm-peak-bytes", peak, help="Peak HBM bytes in use")
+            )
+        details["bytes_in_use_gb"] = round(in_use / 1e9, 3)
+    else:
+        details["memory_stats"] = "unavailable on this runtime"
+
+    # allocation smoke: the headroom must really exist
+    elems = max(1, int(probe_gb * 1e9 / 4))
+    cols = 1024
+    rows = max(1, elems // cols)
+    alloc_ok = True
+    try:
+        buf = jax.device_put(jnp.ones((rows, cols), jnp.float32), device)
+        float(buf[0, 0])  # force materialization
+        del buf
+    except Exception as e:
+        alloc_ok = False
+        details["allocation_error"] = repr(e)[:200]
+    metrics.append(
+        ProbeMetric(
+            "hbm-headroom-probe-ok",
+            1.0 if alloc_ok else 0.0,
+            help=f"1 when a {probe_gb} GB buffer could be allocated and freed",
+        )
+    )
+    details["probe_gb"] = probe_gb
+
+    summary = (
+        f"{probe_gb} GB headroom {'OK' if alloc_ok else 'FAILED'}"
+        + (
+            f", {details.get('bytes_in_use_gb', '?')} GB in use"
+            if stats
+            else " (no memory_stats on this runtime)"
+        )
+    )
+    return ProbeResult(ok=alloc_ok, summary=summary, metrics=metrics, details=details)

@@ -1,0 +1,85 @@
+"""Rated hardware specs per TPU generation.
+
+Denominators for the "fraction of rated" gauges the probes export
+(BASELINE.md north star: ICI all-reduce ≥90 % of rated on a v5e-8).
+Figures are the public per-chip numbers (cf. the "How to Scale Your
+Model" rooflines); every value can be overridden via environment
+variables for new silicon or corrected ratings:
+
+    ACTIVEMONITOR_RATED_BF16_TFLOPS
+    ACTIVEMONITOR_RATED_INT8_TOPS
+    ACTIVEMONITOR_RATED_HBM_GBPS
+    ACTIVEMONITOR_RATED_ICI_GBPS   (per-link, one direction)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RatedSpec:
+    generation: str
+    bf16_tflops: float  # peak dense bf16 matmul TFLOP/s per chip
+    hbm_gbps: float  # HBM bandwidth GB/s per chip
+    ici_unidir_gbps: float  # ICI bandwidth per link, one direction, GB/s
+    ici_links: int  # ICI links per chip
+    int8_tops: float = 0.0  # peak dense int8 matmul TOP/s per chip (0 = n/a)
+
+
+# device_kind substrings -> rated spec
+_RATED = [
+    ("v6", RatedSpec("v6e", bf16_tflops=918.0, hbm_gbps=1640.0, ici_unidir_gbps=90.0, ici_links=4, int8_tops=1836.0)),
+    ("v5p", RatedSpec("v5p", bf16_tflops=459.0, hbm_gbps=2765.0, ici_unidir_gbps=90.0, ici_links=6, int8_tops=918.0)),
+    ("v5 lite", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0)),
+    ("v5e", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0)),
+    # v4 has no int8 MXU mode (int8 ships with v5)
+    ("v4", RatedSpec("v4", bf16_tflops=275.0, hbm_gbps=1228.0, ici_unidir_gbps=45.0, ici_links=6)),
+]
+
+
+def _override(value: float, env: str) -> float:
+    raw = os.environ.get(env)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return value
+
+
+# Single-chip performance bars (BASELINE.md § single-chip bar): the
+# battery enforces these on real TPU hardware so an underperforming
+# chip FAILS its HealthCheck instead of merely reporting low gauges.
+# - flash fwd ≥0.40 of rated bf16 peak: measured ~0.46 on a healthy
+#   v5e (ops/flash_attention.py block-sweep tables; re-captured into
+#   SWEEP_TPU.md by hack/tpu_evidence.py) — 0.40 leaves headroom for
+#   shared-chip contention without passing a sick MXU/Mosaic path.
+# - training-step ≥0.15 MFU: PROVISIONAL floor for the probe
+#   transformer (small-model steps are overhead-bound well below the
+#   large-model 40-50% regime); raise once hack/tpu_evidence.py commits
+#   a measured train_mfu to BENCH_TPU.json. Overridable per run via
+#   --mfu-threshold / --min-fraction.
+TRAIN_MFU_BAR = float(os.environ.get("ACTIVEMONITOR_TRAIN_MFU_BAR", "0.15"))
+FLASH_FRACTION_BAR = float(
+    os.environ.get("ACTIVEMONITOR_FLASH_FRACTION_BAR", "0.40")
+)
+
+
+def rated_for(device_kind: str) -> Optional[RatedSpec]:
+    """Spec for a jax device_kind string (e.g. "TPU v5 lite"), or None
+    for unknown/non-TPU hardware."""
+    kind = device_kind.lower()
+    for needle, spec in _RATED:
+        if needle in kind:
+            return RatedSpec(
+                generation=spec.generation,
+                bf16_tflops=_override(spec.bf16_tflops, "ACTIVEMONITOR_RATED_BF16_TFLOPS"),
+                hbm_gbps=_override(spec.hbm_gbps, "ACTIVEMONITOR_RATED_HBM_GBPS"),
+                ici_unidir_gbps=_override(spec.ici_unidir_gbps, "ACTIVEMONITOR_RATED_ICI_GBPS"),
+                ici_links=spec.ici_links,
+                int8_tops=_override(spec.int8_tops, "ACTIVEMONITOR_RATED_INT8_TOPS"),
+            )
+    return None

@@ -1,0 +1,163 @@
+"""Straggler probe — find the one sick chip in a slice.
+
+Collective benchmarks (ici/collectives probes) measure the WHOLE mesh:
+one degraded chip drags every collective down but does not say which
+chip. This probe runs an identical single-chip matmul chain on every
+device independently — no collectives, so a slow chip cannot hide
+behind its neighbors — and compares:
+
+1. timing spread — worst device time over the median; a healthy slice
+   sits within a few percent, a throttled/sick chip sticks out;
+2. numeric agreement — all devices run the same computation on the
+   same inputs, so results must match bitwise on identical silicon; a
+   mismatch is the scariest failure (silent data corruption).
+
+SPMD collectives stall at the speed of the slowest participant, so the
+spread here is a direct forecast of the whole slice's training-step
+time. Complements the per-axis collective sweep (which localizes a
+torus DIRECTION); this localizes a CHIP.
+
+Single-device runs degrade to an informational pass (nothing to
+compare), mirroring the multi-chip probes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import statistics
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.utils.timing import chain_delta_seconds
+
+
+def _device_measure(device, dim: int, iters: int) -> tuple:
+    """(seconds-per-matmul, chain checksum) on one device.
+
+    Inputs are committed to the device, so the jitted chain executes
+    there; the chain-delta discipline cancels dispatch/tunnel overhead
+    the same way it does for the aggregate probes."""
+    a = jax.device_put(
+        jax.random.normal(jax.random.key(0), (dim, dim), jnp.bfloat16), device
+    )
+    b = jax.device_put(
+        jax.random.normal(jax.random.key(1), (dim, dim), jnp.bfloat16), device
+    )
+
+    def make_chain(k):
+        @jax.jit
+        def chain(a, x):
+            for _ in range(k):  # data-dependent: each feeds the next
+                x = jnp.dot(a, x, preferred_element_type=jnp.bfloat16)
+            return x.astype(jnp.float32).sum()
+
+        return chain
+
+    seconds = chain_delta_seconds(make_chain, a, b, k1=2, k2=8, iters=iters)
+
+    @jax.jit
+    def chain_full(a, x):
+        for _ in range(4):
+            x = jnp.dot(a, x, preferred_element_type=jnp.bfloat16)
+        return x
+
+    # digest of the raw result bytes — a scalar-sum checksum would let
+    # single-lane corruption vanish into the accumulator's rounding
+    digest = hashlib.sha256(
+        np.ascontiguousarray(np.asarray(chain_full(a, b))).tobytes()
+    ).hexdigest()
+    return seconds, digest
+
+
+def run(
+    dim: int = 0,
+    iters: int = 5,
+    threshold: float = 1.25,
+) -> ProbeResult:
+    """``threshold`` is the worst/median timing ratio above which a
+    device is flagged (collectives run at the slowest chip's pace, so
+    1.25 means ~25 % of the whole slice's throughput is being lost)."""
+    # local devices only: on multi-host slices most of jax.devices() is
+    # non-addressable from this process and device_put would raise —
+    # each host measures its own chips (run the probe once per host to
+    # cover a pod; the battery runs host-local by construction)
+    devices = jax.local_devices()
+    on_tpu = devices[0].platform == "tpu"
+    if dim <= 0:
+        dim = 2048 if on_tpu else 256
+
+    per_device = {}
+    checksums = {}
+    for device in devices:
+        seconds, checksum = _device_measure(device, dim, iters)
+        per_device[device.id] = seconds
+        checksums[device.id] = checksum
+
+    median = statistics.median(per_device.values())
+    worst_id, worst = max(per_device.items(), key=lambda kv: kv[1])
+    spread = worst / median if median > 0 else 1.0
+    slow = sorted(
+        d for d, s in per_device.items() if median > 0 and s / median > threshold
+    )
+    distinct_checksums = len(set(checksums.values()))
+    numerics_agree = distinct_checksums == 1
+
+    metrics = [
+        ProbeMetric(
+            "straggler-worst-over-median",
+            spread,
+            help="Slowest device's per-op time / median across devices",
+        ),
+        ProbeMetric(
+            "straggler-slow-devices",
+            float(len(slow)),
+            help="Devices slower than threshold x median",
+        ),
+        ProbeMetric(
+            "straggler-numeric-agreement",
+            1.0 if numerics_agree else 0.0,
+            help="1 if every device produced a bitwise-identical result",
+        ),
+    ]
+    details = {
+        "devices": len(devices),
+        "hosts": jax.process_count(),
+        "host_local": jax.process_count() > 1,
+        "dim": dim,
+        "per_device_ms": {d: round(s * 1e3, 3) for d, s in per_device.items()},
+        "median_ms": round(median * 1e3, 3),
+        "worst_device": worst_id,
+        "spread": round(spread, 3),
+        "slow_devices": slow,
+        "distinct_checksums": distinct_checksums,
+    }
+    if len(devices) < 2:
+        # nothing to compare against — informational pass
+        return ProbeResult(
+            ok=True,
+            summary=(
+                f"single device: {per_device[worst_id]*1e3:.2f} ms/op "
+                "(no straggler comparison possible)"
+            ),
+            metrics=metrics,
+            details=details,
+        )
+    # timing spread only gates on real TPU: virtual/CPU "devices" share
+    # host cores, so their spread is scheduler noise, not silicon health
+    ok = numerics_agree and (not slow or not on_tpu)
+    if not numerics_agree:
+        verdict = f"NUMERIC MISMATCH across devices ({distinct_checksums} distinct results)"
+    elif slow:
+        verdict = f"stragglers: devices {slow} at >{threshold:.2f}x median" + (
+            "" if on_tpu else " (informational off-TPU)"
+        )
+    else:
+        verdict = "no stragglers"
+    summary = (
+        f"{len(devices)} devices, spread {spread:.2f}x "
+        f"(worst: device {worst_id}) — {verdict}"
+    )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

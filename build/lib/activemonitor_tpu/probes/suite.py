@@ -1,0 +1,196 @@
+"""Aggregate probe suite — the whole battery in one payload.
+
+One workflow, one compile cache, one verdict: runs every applicable
+probe and merges their metrics into a single contract line. The
+natural payload for a single "is this TPU healthy" HealthCheck; probes
+inapplicable to the hardware (rated comparisons on unknown chips,
+multi-device checks on one chip) degrade the way they do individually.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from activemonitor_tpu.probes.base import ProbeResult
+
+log = logging.getLogger("activemonitor.probes")
+
+
+def enable_persistent_compile_cache(directory: str = "") -> Optional[str]:
+    """Point XLA's persistent compilation cache at a stable directory so
+    repeated battery runs (the steady state of a periodic HealthCheck)
+    skip recompilation — the dominant cost of a cold `probes all` run on
+    TPU. Override with $ACTIVEMONITOR_COMPILE_CACHE; returns the
+    directory, or None if the cache could not be enabled."""
+    import jax
+
+    directory = (
+        directory
+        or os.environ.get("ACTIVEMONITOR_COMPILE_CACHE")
+        or os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "activemonitor-tpu",
+            "xla-cache",
+        )
+    )
+    try:
+        os.makedirs(directory, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", directory)
+        # cache even fast compiles: the battery compiles dozens of small
+        # programs and their sum is what the cadence pays
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        return directory
+    except Exception as e:
+        log.warning("persistent compile cache unavailable (%s)", e)
+        return None
+
+
+def run(
+    quick: bool = False,
+    skip: Optional[List[str]] = None,
+    compile_cache: bool = True,
+) -> ProbeResult:
+    skip = set(skip or [])
+    if compile_cache:
+        enable_persistent_compile_cache()
+    results: List[Tuple[str, ProbeResult]] = []
+
+    def add(name: str, fn) -> None:
+        if name in skip:
+            return
+        try:
+            results.append((name, fn()))
+        except Exception as e:  # a crashing probe is a failing probe
+            results.append(
+                (name, ProbeResult(ok=False, summary=f"{name} crashed: {e!r}"))
+            )
+
+    from activemonitor_tpu.probes import (
+        compile_smoke,
+        decode,
+        devices,
+        hbm,
+        ici,
+        matmul,
+        memory,
+        ring,
+        training_step,
+    )
+
+    iters = 3 if quick else 5
+    add("devices", lambda: devices.run())
+    add("memory", lambda: memory.run(probe_gb=0.5 if quick else 1.0))
+    add("compile-smoke", lambda: compile_smoke.run(tiny=quick))
+    # quick mode narrows the sweep to the cheap dim; full mode uses the
+    # probe's own default sweep (single source of truth) so the battery
+    # reports the same max-over-dims signal as `probes matmul`. The
+    # probe itself owns the off-TPU downsizing.
+    if quick:
+        add("matmul", lambda: matmul.run(dims=(4096,), iters=iters))
+    else:
+        add("matmul", lambda: matmul.run(iters=iters))
+        # the MXU's other throughput mode (v5e+); v4/unknown chips
+        # degrade to an informational pass inside the probe. Same full
+        # dim sweep as bf16: which dim the compiler tiles best varies,
+        # and a single pinned dim could fail a healthy chip
+        add("matmul-int8", lambda: matmul.run(iters=iters, dtype="int8"))
+    add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
+    add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
+    from activemonitor_tpu.probes import collectives as collectives_probe
+
+    # the ici probe already measured all-reduce and the ring hop; the
+    # sweep adds only the patterns it hasn't covered
+    add(
+        "collectives",
+        lambda: collectives_probe.run(
+            size_mb=16 if quick else 64,
+            iters=iters,
+            cases=("allgather", "reducescatter", "alltoall"),
+        ),
+    )
+    add(
+        "ring-attention",
+        lambda: ring.run(seq_per_device=256 if quick else 1024, iters=iters),
+    )
+    from activemonitor_tpu.probes import flash
+
+    import jax as _jax
+
+    from activemonitor_tpu.probes.rated import FLASH_FRACTION_BAR, TRAIN_MFU_BAR
+
+    # seq=None: the per-platform default (4096 on TPU, the interpret-
+    # mode 512 cap elsewhere — an explicit seq is honored verbatim and
+    # would stall a CPU suite run); quick mode pins the short
+    # per-platform length the battery always used (1024 on TPU, 512 in
+    # interpret mode). The device lookup stays INSIDE the lambda so a
+    # backend-init failure is a failing probe, not an aborted battery.
+    # The full battery enforces the BASELINE.md single-chip bars — an
+    # underperforming chip FAILS, it doesn't just report low gauges;
+    # quick mode (tiny shapes, throwaway timings) skips the bars
+    def _quick_seq():
+        return 1024 if _jax.devices()[0].platform == "tpu" else 512
+
+    add(
+        "flash-attention",
+        lambda: flash.run(
+            seq=_quick_seq() if quick else None,
+            iters=iters,
+            min_fraction=None if quick else FLASH_FRACTION_BAR,
+        ),
+    )
+    # full mode runs the SAME shape bench.py's train() calibration
+    # measures (batch_per_device=8, seq=128) — the bar and the evidence
+    # it is raised from must see the same per-step workload, or a bar
+    # calibrated on big steps fails healthy chips on small ones
+    add(
+        "training-step",
+        lambda: training_step.run(
+            tiny=quick,
+            batch_per_device=4 if quick else 8,
+            seq=64 if quick else 128,
+            mfu_threshold=None if quick else TRAIN_MFU_BAR,
+        ),
+    )
+    add(
+        "decode",
+        lambda: decode.run(tiny=quick, batch=4, prompt_len=8, iters=iters),
+    )
+    from activemonitor_tpu.probes import straggler, transfer
+
+    add(
+        "straggler",
+        lambda: straggler.run(dim=1024 if quick else 0, iters=iters),
+    )
+    add("transfer", lambda: transfer.run(size_mb=16 if quick else 64, iters=iters))
+    from activemonitor_tpu.probes import checkpoint
+
+    add("checkpoint", lambda: checkpoint.run(size_mb=16 if quick else 64))
+    from activemonitor_tpu.probes import dcn
+
+    # informational pass on single-process runs; real coverage on
+    # multi-host slices where jax.distributed is initialized
+    add("dcn-allreduce", lambda: dcn.run(size_mb=4 if quick else 16, iters=iters))
+
+    metrics = []
+    failed = []
+    for name, result in results:
+        metrics.extend(result.metrics)
+        status = "OK " if result.ok else "FAIL"
+        print(f"  [{status}] {name}: {result.summary}", file=sys.stderr)
+        if not result.ok:
+            failed.append(name)
+    ok = not failed
+    summary = (
+        f"all {len(results)} probes passed"
+        if ok
+        else f"{len(failed)}/{len(results)} probes failed: {', '.join(failed)}"
+    )
+    return ProbeResult(
+        ok=ok,
+        summary=summary,
+        metrics=metrics,
+        details={"probes_run": len(results), "failed": failed},
+    )

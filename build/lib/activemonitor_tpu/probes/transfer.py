@@ -1,0 +1,167 @@
+"""Host↔device transfer probe — the data-feed path.
+
+Input pipelines live or die on host→device bandwidth (PCIe on TPU VMs):
+a training job whose h2d feed is degraded shows up as idle MXUs that
+every other probe scores healthy. This probe measures both directions:
+
+- h2d: ``jax.device_put`` of a host buffer, completion forced by a
+  jitted single-element read (any op on the array must wait for the
+  full transfer to land — a one-element readback costs nothing while a
+  full sum would add an HBM pass to the number);
+- d2h: ``np.asarray`` of a device buffer (the bytes arriving in host
+  memory cannot lie, tunneled or not).
+
+Fixed per-call overhead (dispatch, tunnel round-trips) is cancelled by
+the size-delta method — time a 2x payload and divide the difference —
+with the two payloads sampled ALTERNATELY (drift cannot land on one
+side of the difference) and the payload grown until the delta towers
+over the noise floor: the same discipline utils/timing.py applies to
+op chains. A delta still inside the noise after growth is reported as
+noise-limited instead of a fabricated bandwidth, and fails any
+``--min-gbps`` gate (unmeasurable ≠ certified).
+
+There is no rated denominator (host PCIe topology varies; behind a
+remote PJRT tunnel this measures the tunnel, which is then genuinely
+the feed path the device has) — gauges are informational, with an
+optional ``--min-gbps`` floor for deployments that know their fabric.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+
+
+def _interleaved_min_pair(fn1, fn2, iters: int, warmup: int = 1) -> tuple:
+    """(min t1, min t2) sampled alternately — utils/timing.py's rule:
+    phase-separated sampling lets drift (tunnel congestion, host load)
+    land entirely on one side of the difference."""
+    for _ in range(warmup):
+        fn1()
+        fn2()
+    t1s, t2s = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn1()
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fn2()
+        t2s.append(time.perf_counter() - t0)
+    return min(t1s), min(t2s)
+
+
+def _delta_gbps(make_fn, nbytes: int, iters: int, retries: int = 2) -> tuple:
+    """(GB/s, payload bytes used, noise_limited) for a transfer
+    direction. ``make_fn(nbytes)`` returns a zero-arg callable moving
+    that payload. The payload is grown when the 2x-1x delta sits inside
+    the noise floor rather than reporting a fabricated rate."""
+    from activemonitor_tpu.utils.timing import needs_longer_chain
+
+    for attempt in range(retries + 1):
+        t1, t2 = _interleaved_min_pair(
+            make_fn(nbytes), make_fn(2 * nbytes), iters
+        )
+        if not needs_longer_chain(t1, t2):
+            return nbytes / (t2 - t1) / 1e9, nbytes, False
+        if attempt < retries:
+            nbytes *= 4
+    return nbytes / max(t2 - t1, 1e-9) / 1e9, nbytes, True
+
+
+def _make_h2d(device):
+    @jax.jit
+    def first_element(x):
+        return x[0, 0]
+
+    def factory(nbytes: int):
+        host = np.ones((nbytes // 4 // 1024, 1024), np.float32)
+
+        def put():
+            x = jax.device_put(host, device)
+            float(first_element(x))  # forces the whole buffer onto the device
+
+        return put
+
+    return factory
+
+
+def _make_d2h(device):
+    @jax.jit
+    def bump(x):
+        return x + 1.0
+
+    def factory(nbytes: int):
+        x = jax.device_put(
+            jnp.ones((nbytes // 4 // 1024, 1024), jnp.float32), device
+        )
+        x = jax.block_until_ready(bump(x))
+
+        def get():
+            # jax.Array caches its host copy after the first np.asarray
+            # — reading the SAME array again times the cache, not the
+            # wire (observed: "32 PB/s" through a tunnel). Reading a
+            # fresh device-computed array per call forces a real
+            # transfer; the device-side bump is an HBM-bound op whose
+            # cost scales with size, so the size-delta folds it out of
+            # the fixed overhead and it only shades the estimate by
+            # ~HBM/PCIe-ratio percent.
+            np.asarray(bump(x))
+
+        return get
+
+    return factory
+
+
+def run(
+    size_mb: float = 64.0,
+    iters: int = 5,
+    min_gbps: float = 0.0,
+) -> ProbeResult:
+    # local device: jax.devices()[0] is non-addressable on processes
+    # other than 0 in multi-host runs — each host measures its own feed
+    device = jax.local_devices()[0]
+    nbytes = int(size_mb * 1e6)
+    nbytes -= nbytes % (4 * 1024)
+
+    h2d_gbps, h2d_bytes, h2d_noise = _delta_gbps(_make_h2d(device), nbytes, iters)
+    d2h_gbps, d2h_bytes, d2h_noise = _delta_gbps(_make_d2h(device), nbytes, iters)
+    noise_limited = h2d_noise or d2h_noise
+
+    metrics = [
+        ProbeMetric(
+            "transfer-h2d-gbps", h2d_gbps, help="Host-to-device bandwidth, GB/s"
+        ),
+        ProbeMetric(
+            "transfer-d2h-gbps", d2h_gbps, help="Device-to-host bandwidth, GB/s"
+        ),
+    ]
+    details = {
+        "h2d_payload_mb": h2d_bytes / 1e6,
+        "d2h_payload_mb": d2h_bytes / 1e6,
+        "device_kind": device.device_kind,
+        "platform": device.platform,
+    }
+    if noise_limited:
+        details["noise_limited"] = sorted(
+            d for d, n in (("h2d", h2d_noise), ("d2h", d2h_noise)) if n
+        )
+    ok = True
+    if min_gbps > 0:
+        # a noise-limited reading cannot certify the floor — fail closed
+        ok = (
+            not noise_limited
+            and h2d_gbps >= min_gbps
+            and d2h_gbps >= min_gbps
+        )
+        details["min_gbps"] = min_gbps
+    summary = (
+        f"h2d {h2d_gbps:.2f} GB/s, d2h {d2h_gbps:.2f} GB/s"
+        + (f" (floor {min_gbps:.1f})" if min_gbps > 0 else "")
+        + (" [noise-limited]" if noise_limited else "")
+    )
+    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)

@@ -1,0 +1,27 @@
+"""Scheduling engine: cron parsing, inverse-exponential backoff, timer wheel."""
+
+from activemonitor_tpu.scheduler.backoff import (
+    BackoffParams,
+    InverseExpBackoff,
+    compute_backoff_params,
+)
+from activemonitor_tpu.scheduler.cron import (
+    CronParseError,
+    CronSchedule,
+    EverySchedule,
+    parse_cron,
+    seconds_until_next,
+)
+from activemonitor_tpu.scheduler.timers import TimerWheel
+
+__all__ = [
+    "BackoffParams",
+    "CronParseError",
+    "CronSchedule",
+    "EverySchedule",
+    "InverseExpBackoff",
+    "TimerWheel",
+    "compute_backoff_params",
+    "parse_cron",
+    "seconds_until_next",
+]

@@ -1,0 +1,121 @@
+"""Inverse-exponential backoff with timeout.
+
+Status polling starts slow and speeds up: the first wait is ``max_delay``
+and each subsequent wait is multiplied by ``factor`` (<1), clamped at
+``min_delay`` — a workflow is unlikely to finish immediately, so early
+polls are wasted; late polls should be tight to minimize detection
+latency. Mirrors keikoproj/inverse-exp-backoff as the reference uses it
+(reference: healthcheck_controller.go:613,801).
+
+Parameter derivation from a HealthCheck spec lives in
+:func:`compute_backoff_params` (reference: healthcheck_controller.go:575-605).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from activemonitor_tpu.utils.clock import Clock
+
+DEFAULT_FACTOR = 0.5
+
+
+@dataclass(frozen=True)
+class BackoffParams:
+    max_delay: float  # seconds
+    min_delay: float  # seconds
+    factor: float
+    timeout: float  # seconds; <=0 means no deadline
+
+
+def compute_backoff_params(
+    *,
+    workflow_timeout: int,
+    backoff_max: int = 0,
+    backoff_min: int = 0,
+    backoff_factor: str = "",
+) -> BackoffParams:
+    """Derive polling parameters from spec fields.
+
+    Defaults: max = timeout/2, min = timeout/60, both clamped ≥ 1 s;
+    factor 0.5 unless the spec's string field parses as a float
+    (reference: healthcheck_controller.go:575-605 — unparseable factor
+    logs and falls back, it does not error). Spec values ≤ 0 are treated
+    as unset — a negative delay would otherwise become a hot poll loop.
+    """
+    if backoff_max <= 0:
+        max_delay = float(workflow_timeout // 2)
+        if max_delay <= 0:
+            max_delay = 1.0
+    else:
+        max_delay = float(backoff_max)
+    if backoff_min <= 0:
+        min_delay = float(workflow_timeout // 60)
+        if min_delay <= 0:
+            min_delay = 1.0
+    else:
+        min_delay = float(backoff_min)
+
+    factor = DEFAULT_FACTOR
+    if backoff_factor:
+        try:
+            factor = float(backoff_factor)
+        except ValueError:
+            factor = DEFAULT_FACTOR
+    return BackoffParams(
+        max_delay=max_delay,
+        min_delay=min_delay,
+        factor=factor,
+        timeout=float(workflow_timeout),
+    )
+
+
+class InverseExpBackoff:
+    """Async poll pacer.
+
+    Usage::
+
+        ieb = InverseExpBackoff(params, clock)
+        while True:
+            poll()
+            if not await ieb.next():
+                # deadline exceeded — synthesize failure
+                break
+
+    ``next`` returns False immediately (without sleeping) once the
+    deadline has passed, matching the reference loop shape where the
+    body runs once more with a synthesized Failed status
+    (reference: healthcheck_controller.go:627-632).
+    """
+
+    def __init__(self, params: BackoffParams, clock: Clock | None = None):
+        self._params = params
+        self._clock = clock or Clock()
+        self._delay = params.max_delay
+        self._deadline = (
+            self._clock.monotonic() + params.timeout if params.timeout > 0 else None
+        )
+
+    @property
+    def current_delay(self) -> float:
+        return self._delay
+
+    def expired(self) -> bool:
+        return (
+            self._deadline is not None
+            and self._clock.monotonic() >= self._deadline
+        )
+
+    def advance(self) -> float:
+        """Current delay, advancing the schedule — for callers that pace
+        themselves (e.g. waiting on a watch event bounded by the delay)
+        instead of sleeping here."""
+        delay = self._delay
+        self._delay = max(self._delay * self._params.factor, self._params.min_delay)
+        return delay
+
+    async def next(self) -> bool:
+        if self.expired():
+            return False
+        await self._clock.sleep(self.advance())
+        return True

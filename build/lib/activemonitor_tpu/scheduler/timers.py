@@ -1,0 +1,83 @@
+"""Named timer wheel for self-rescheduled runs.
+
+The reference keeps ``map[string]*time.Timer`` guarded by a RWMutex and
+reschedules each check via ``time.AfterFunc``
+(reference: healthcheck_controller.go:139-141,745-754). Here each timer
+is an asyncio task owned by the wheel — single-owner state on one event
+loop, so no lock is needed (SURVEY.md §5.2's discipline: scheduler state
+in a single-owner task instead of a shared map).
+
+Entries stay in the map after firing, so ``exists(name)`` means "this
+check has been scheduled at least once", not "a run is pending". The
+reconciler's dedupe deliberately uses ``pending(name)`` (a live, unfired
+timer): trusting a fired-but-bailed entry would wedge a check's schedule
+forever. ``exists`` remains for delete-time bookkeeping and tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict
+
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+
+class TimerWheel:
+    def __init__(self, clock: Clock | None = None):
+        self._clock = clock or Clock()
+        self._timers: Dict[str, asyncio.Task] = {}
+
+    def schedule(
+        self, name: str, delay_seconds: float, fn: Callable[[], Awaitable[None]]
+    ) -> None:
+        """(Re)schedule ``fn`` to run after ``delay_seconds``.
+
+        Any pending timer with the same name is stopped first
+        (reference: healthcheck_controller.go:747-752).
+        """
+        self.stop(name)
+        self._timers[name] = asyncio.create_task(
+            self._fire(name, delay_seconds, fn), name=f"timer:{name}"
+        )
+
+    async def _fire(
+        self, name: str, delay_seconds: float, fn: Callable[[], Awaitable[None]]
+    ) -> None:
+        try:
+            await self._clock.sleep(delay_seconds)
+            await fn()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("timer %s callback failed", name)
+
+    def exists(self, name: str) -> bool:
+        """True if the check has ever been scheduled (fired entries remain)."""
+        return name in self._timers
+
+    def pending(self, name: str) -> bool:
+        """True only while a run is still queued (not yet fired/cancelled)."""
+        t = self._timers.get(name)
+        return t is not None and not t.done()
+
+    def stop(self, name: str) -> bool:
+        """Cancel a pending run if any; keeps no map entry. Returns True
+        if a pending timer was actually cancelled. A timer task stopping
+        itself from within its own callback (the reschedule-at-watch-end
+        path) is popped but never cancelled mid-flight."""
+        t = self._timers.pop(name, None)
+        if t is None:
+            return False
+        if not t.done() and t is not asyncio.current_task():
+            t.cancel()
+            return True
+        return False
+
+    async def shutdown(self) -> None:
+        names = list(self._timers)
+        for name in names:
+            self.stop(name)
+        await asyncio.sleep(0)

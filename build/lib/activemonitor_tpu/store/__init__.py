@@ -1,0 +1,20 @@
+"""Artifact store — pluggable sources for workflow manifests.
+
+Capability match of the reference store (reference: internal/store/store.go:10-22)
+plus a real file reader (the reference declares the File field but never
+implements it — SURVEY.md §2 #12).
+"""
+
+from activemonitor_tpu.store.base import ArtifactReader, UnknownArtifactLocation, get_artifact_reader
+from activemonitor_tpu.store.inline import InlineReader
+from activemonitor_tpu.store.file import FileReader
+from activemonitor_tpu.store.url import URLReader
+
+__all__ = [
+    "ArtifactReader",
+    "FileReader",
+    "InlineReader",
+    "URLReader",
+    "UnknownArtifactLocation",
+    "get_artifact_reader",
+]

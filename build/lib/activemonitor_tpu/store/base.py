@@ -1,0 +1,39 @@
+"""ArtifactReader protocol and dispatch (reference: internal/store/store.go:10-22)."""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from activemonitor_tpu.api.types import ArtifactLocation
+
+
+class UnknownArtifactLocation(ValueError):
+    """No reader exists for the given artifact location."""
+
+
+@runtime_checkable
+class ArtifactReader(Protocol):
+    """Reads a workflow manifest from some source."""
+
+    def read(self) -> bytes:  # pragma: no cover - protocol
+        ...
+
+
+def get_artifact_reader(loc: ArtifactLocation) -> ArtifactReader:
+    """Return the reader for a location.
+
+    Dispatch order matches the reference (inline, then URL;
+    store/store.go:15-21) with file support added after, so existing
+    specs resolve identically.
+    """
+    from activemonitor_tpu.store.file import FileReader
+    from activemonitor_tpu.store.inline import InlineReader
+    from activemonitor_tpu.store.url import URLReader
+
+    if loc.inline is not None:
+        return InlineReader(loc.inline)
+    if loc.url is not None:
+        return URLReader(loc.url)
+    if loc.file is not None:
+        return FileReader(loc.file)
+    raise UnknownArtifactLocation(f"unknown artifact location: {loc!r}")

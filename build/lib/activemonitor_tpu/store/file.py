@@ -1,0 +1,26 @@
+"""File artifact reader.
+
+The reference declares the File source in its API
+(api/v1alpha1/healthcheck_types.go:134-136) but never implements a
+reader — GetArtifactReader falls through to "unknown artifact location"
+(store/store.go:15-21). This framework implements it for real
+(SURVEY.md §2 #12 lists the gap).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from activemonitor_tpu.api.types import FileArtifact
+
+
+class FileReader:
+    """Serves a manifest from the local filesystem."""
+
+    def __init__(self, file_artifact: FileArtifact):
+        if file_artifact is None or not file_artifact.path:
+            raise ValueError("FileArtifact path cannot be empty")
+        self._path = Path(file_artifact.path)
+
+    def read(self) -> bytes:
+        return self._path.read_bytes()

@@ -1,0 +1,15 @@
+"""Inline artifact reader (reference: internal/store/inline.go:10-26)."""
+
+from __future__ import annotations
+
+
+class InlineReader:
+    """Serves a manifest embedded directly in the HealthCheck spec."""
+
+    def __init__(self, inline: str):
+        if not inline:
+            raise ValueError("InlineArtifact does not exist")
+        self._inline = inline
+
+    def read(self) -> bytes:
+        return self._inline.encode("utf-8")

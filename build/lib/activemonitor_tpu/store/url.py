@@ -1,0 +1,40 @@
+"""URL artifact reader (reference: internal/store/url.go:20-57).
+
+Secure by default: TLS certificates are verified unless the spec
+explicitly sets verifyCert: false (reference: url.go:29-32).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import requests
+
+from activemonitor_tpu.api.types import URLArtifact
+
+log = logging.getLogger(__name__)
+
+_TIMEOUT_SECONDS = 30.0
+
+
+class URLReader:
+    """Fetches a manifest over HTTP(S)."""
+
+    def __init__(self, url_artifact: URLArtifact):
+        if url_artifact is None or not url_artifact.path:
+            raise ValueError("URLArtifact cannot be empty")
+        self._artifact = url_artifact
+
+    def read(self) -> bytes:
+        # Only an explicit verifyCert: false disables verification.
+        verify = self._artifact.verify_cert is not False
+        if not verify:
+            log.warning(
+                "TLS certificate verification is disabled for %s", self._artifact.path
+            )
+        resp = requests.get(
+            self._artifact.path, verify=verify, timeout=_TIMEOUT_SECONDS
+        )
+        if resp.status_code != 200:
+            raise IOError(f"status code {resp.status_code}")
+        return resp.content

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from activemonitor_tpu.utils.duration import parse_go_duration
+
+__all__ = ["parse_go_duration"]

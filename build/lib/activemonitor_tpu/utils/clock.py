@@ -1,0 +1,99 @@
+"""Clock abstraction so scheduler/backoff/timer code is deterministic in tests.
+
+The reference tests real timing with short cadences in envtest
+(SURVEY.md §4); we do better by injecting a fake clock and advancing it
+manually, so backoff/cron/timer tests run in milliseconds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import heapq
+import time
+from typing import List, Tuple
+
+
+class Clock:
+    """Real wall/monotonic clock."""
+
+    def now(self) -> datetime.datetime:
+        return datetime.datetime.now(datetime.timezone.utc)
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, seconds: float) -> None:
+        await asyncio.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Manually-advanced clock for tests.
+
+    ``sleep`` blocks until ``advance`` moves time past the wake point.
+    """
+
+    def __init__(self, start: float = 0.0, epoch: datetime.datetime | None = None):
+        self._t = start
+        self._epoch = epoch or datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+        self._start = start
+        self._sleepers: List[Tuple[float, int, asyncio.Future]] = []
+        self._seq = 0
+
+    def now(self) -> datetime.datetime:
+        return self._epoch + datetime.timedelta(seconds=self._t - self._start)
+
+    def monotonic(self) -> float:
+        return self._t
+
+    async def sleep(self, seconds: float) -> None:
+        if seconds <= 0:
+            await asyncio.sleep(0)
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self._sleepers, (self._t + seconds, self._seq, fut))
+        await fut
+
+    async def advance(self, seconds: float) -> None:
+        """Move time forward, waking sleepers in wake-time order."""
+        # Let tasks spawned-but-not-yet-started register their sleeps at
+        # the current time before it moves.
+        for _ in range(10):
+            await asyncio.sleep(0)
+        target = self._t + seconds
+        while self._sleepers and self._sleepers[0][0] <= target:
+            wake, _, fut = heapq.heappop(self._sleepers)
+            self._t = max(self._t, wake)
+            if not fut.done():
+                fut.set_result(None)
+            # Let the woken coroutine (and anything it spawns) run before
+            # advancing further, so causality matches real time.
+            for _ in range(10):
+                await asyncio.sleep(0)
+        self._t = target
+        for _ in range(10):
+            await asyncio.sleep(0)
+
+
+def micro_time(dt: datetime.datetime) -> str:
+    """Kubernetes ``MicroTime`` canonical wire format: RFC3339 with
+    EXACTLY six fractional digits (``2026-07-30T04:10:11.000123Z``) —
+    what client-go always writes.
+
+    ``datetime.isoformat()`` omits the fraction entirely when
+    ``microsecond == 0``. Older apiservers parsed MicroTime with the
+    strict RFC3339Micro layout (fraction REQUIRED → a flaky 400 on
+    lease renewal); current apimachinery falls back to lenient RFC3339,
+    but the canonical six-digit form is valid against every version and
+    is what fixed-epoch FakeClock tests (microsecond ALWAYS 0) would
+    otherwise silently diverge from. Documented in docs/conformance.md;
+    every MicroTime field (Lease renewTime/acquireTime) goes through
+    here. Naive datetimes are interpreted as UTC — the repo convention
+    — never as host-local time."""
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return (
+        dt.astimezone(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")
+        + "Z"
+    )

@@ -1,0 +1,49 @@
+"""Go-style duration parsing.
+
+The reference's cron library accepts "@every <duration>" with Go
+duration syntax ("300ms", "1.5h", "2h45m"); this parser accepts the
+same grammar so reference specs (e.g. examples/inlineHello.yaml
+"@every 1m") work unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+
+_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "μs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+_TOKEN = re.compile(r"(\d+(?:\.\d*)?|\.\d+)(ns|us|µs|μs|ms|s|m|h)")
+
+
+def parse_go_duration(text: str) -> float:
+    """Parse a Go duration string into seconds. Raises ValueError on bad input."""
+    s = text.strip()
+    if not s:
+        raise ValueError("empty duration")
+    sign = 1.0
+    if s[0] in "+-":
+        sign = -1.0 if s[0] == "-" else 1.0
+        s = s[1:]
+    if not s:
+        raise ValueError(f"invalid duration {text!r}")
+    if s == "0":
+        return 0.0
+    total = 0.0
+    pos = 0
+    for m in _TOKEN.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {text!r}")
+        total += float(m.group(1)) * _UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration {text!r}")
+    return sign * total

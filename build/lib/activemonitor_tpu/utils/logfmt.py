@@ -1,0 +1,42 @@
+"""Structured logging for the controller process.
+
+Reference parity: controller-runtime binds zap's flagset
+(reference: cmd/main.go:146-152), giving operators ``--zap-encoder
+json|console`` and a level flag. Here the same two knobs are
+``--log-format json|text`` and ``--log-level``, wired in __main__.
+JSON lines carry the fields log pipelines key on (ts/level/logger/msg,
+plus the exception traceback when present).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S%z"),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exception"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def configure_logging(level: str = "INFO", fmt: str = "text") -> None:
+    """Process-wide logging setup; ``fmt`` is "text" (console) or
+    "json" (structured lines)."""
+    if fmt == "json":
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonFormatter())
+        logging.basicConfig(level=level.upper(), handlers=[handler], force=True)
+    else:
+        logging.basicConfig(
+            level=level.upper(),
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+            force=True,
+        )

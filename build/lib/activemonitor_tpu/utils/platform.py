@@ -1,0 +1,51 @@
+"""Platform forcing — honoring a CPU run request over a site plugin.
+
+A site-installed PJRT plugin (e.g. a tunneled-device autoregistration
+on PYTHONPATH) can override the ``JAX_PLATFORMS`` environment variable;
+only the config API outranks it. Every entry point that promises "set
+JAX_PLATFORMS=cpu for a virtual mesh" must apply this rule or a "CPU"
+run silently lands on — and can wedge against — the remote device.
+One implementation, shared by the probe CLI, ``__graft_entry__`` and
+``bench.py``, so the trigger conditions cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu() -> bool:
+    """Unconditionally pin this process to the CPU backend (the config
+    API outranks env vars AND site plugins). Safe before or after the
+    first jax import; returns False if the config rejects it (backend
+    already initialized on another platform)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        return False
+    return True
+
+
+def force_cpu_if_requested(include_flags: bool = False):
+    """Apply :func:`force_cpu` when the environment asks for a virtual
+    CPU run. Returns True when forced, False when a request was present
+    but could not be applied, None when nothing requested it.
+
+    The base trigger is an explicit ``JAX_PLATFORMS=cpu``.
+    ``include_flags=True`` additionally triggers on the driver's
+    ``--xla_force_host_platform_device_count`` flag (a virtual device
+    mesh only the CPU backend provides) — that broad rule belongs to
+    the graft-driver contract (``__graft_entry__``), where the ambient
+    environment may pin another platform; operator-facing entry points
+    like the probe CLI deliberately do NOT use it, because a stale
+    XLA_FLAGS in a shell would otherwise silently turn a real-chip
+    battery run into CPU interpret-mode numbers labeled as chip
+    health."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or (
+        include_flags and "xla_force_host_platform_device_count" in flags
+    ):
+        return force_cpu()
+    return None

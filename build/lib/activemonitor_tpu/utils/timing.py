@@ -1,0 +1,117 @@
+"""Device timing that survives remote-tunneled TPUs.
+
+Two hazards in timing XLA work (SURVEY.md §7 hard part (d)):
+
+1. compile time — handled by warmup before measurement;
+2. dispatch/transport overhead — on tunneled devices (e.g. a TPU behind
+   a network PJRT proxy) ``block_until_ready`` can return before the
+   device finishes and every host sync costs a network roundtrip that
+   dwarfs the op (observed ~70 ms vs a ~6 ms matmul).
+
+The fix for both: force a scalar host readback (a transfer cannot lie)
+and measure the *difference* between a chain of k ops and a chain of 2k
+ops — constant overhead cancels, leaving pure device time per op.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+
+def median_readback_seconds(fn: Callable, *args, iters: int = 5, warmup: int = 2) -> float:
+    """Median wall-clock of fn(*args) forced through a scalar readback.
+    ``fn`` must return something float()-able (a scalar array)."""
+    return _readback_samples(fn, *args, iters=iters, warmup=warmup)[iters // 2]
+
+
+def _readback_samples(fn: Callable, *args, iters: int, warmup: int) -> list:
+    import time
+
+    for _ in range(warmup):
+        float(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples
+
+
+def _interleaved_min_pair(
+    fn1: Callable, fn2: Callable, *args, iters: int, warmup: int = 2
+) -> tuple:
+    """(min t1, min t2) with the two chains sampled alternately.
+
+    Sampling all of t1 then all of t2 lets anything that drifts between
+    the phases (clock throttle, tunnel congestion) land entirely on one
+    side of the difference; alternating spreads it across both. Both
+    mins see the same noise environment, so the min-bias of the delta
+    shrinks with iters instead of depending on which phase was lucky."""
+    import time
+
+    for _ in range(warmup):
+        float(fn1(*args))
+        float(fn2(*args))
+    t1s, t2s = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(fn1(*args))
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(fn2(*args))
+        t2s.append(time.perf_counter() - t0)
+    return min(t1s), min(t2s)
+
+
+# shared noise-floor policy for chain-delta measurements (also used by
+# probes that run their own chains, e.g. the training-step probe)
+CHAIN_GROWTH = 4
+CHAIN_RETRIES = 2
+
+
+def needs_longer_chain(t1: float, t2: float) -> bool:
+    """True when the (t2 - t1) delta is inside the noise floor and the
+    chain should be lengthened before trusting the rate."""
+    return (t2 - t1) < max(0.05 * t1, 1e-3)
+
+
+def chain_delta_seconds(
+    make_chain: Callable[[int], Callable],
+    *args,
+    k1: int = 4,
+    k2: int = 12,
+    iters: int = 5,
+    _retries: int = CHAIN_RETRIES,
+) -> float:
+    """Per-op device seconds via the difference method.
+
+    ``make_chain(k)`` must return a jitted callable running k
+    *data-dependent* repetitions of the op and returning a scalar.
+    Data dependence matters: independent ops get overlapped or CSE'd by
+    XLA and the difference collapses to zero.
+
+    When the measured difference is inside the noise floor (ops much
+    faster than dispatch jitter — tiny payloads, fast hardware), the
+    chain is lengthened and remeasured up to ``_retries`` times so the
+    delta towers over the noise instead of reporting a garbage rate.
+
+    The two chains are sampled ALTERNATELY (see _interleaved_min_pair):
+    phase-separated sampling let drift land on one side of the
+    difference, which is how the MXU probe once reported a physically
+    impossible >1.0-of-rated rate.
+    """
+    fn1, fn2 = make_chain(k1), make_chain(k2)
+    t1, t2 = _interleaved_min_pair(fn1, fn2, *args, iters=iters)
+    for _ in range(_retries):
+        if not needs_longer_chain(t1, t2):
+            break
+        k1, fn1 = k2, fn2
+        k2 = k2 * CHAIN_GROWTH
+        fn2 = make_chain(k2)
+        # fn1 is already warm; one warmup pass compiles fn2. Both sides
+        # of the delta come from THIS round — never min a side against a
+        # previous round, or cross-round drift skews the difference
+        t1, t2 = _interleaved_min_pair(fn1, fn2, *args, iters=iters, warmup=1)
+    return max((t2 - t1) / (k2 - k1), 1e-9)

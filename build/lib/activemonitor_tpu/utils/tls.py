@@ -1,0 +1,81 @@
+"""Self-signed certificate generation for the metrics endpoint.
+
+The reference serves metrics on :8443 secure-by-default, falling back
+to a generated self-signed certificate when none is supplied
+(reference: cmd/main.go:74-81 via controller-runtime's metrics-server
+self-signed fallback). Same contract here, via ``cryptography``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ssl
+import tempfile
+from typing import Tuple
+
+
+def generate_self_signed_cert(
+    common_name: str = "active-monitor-tpu-metrics", days: int = 365
+) -> Tuple[bytes, bytes]:
+    """Returns (cert_pem, key_pem) for an ephemeral self-signed cert."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"), x509.DNSName(common_name)]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    return cert_pem, key_pem
+
+
+def server_ssl_context(cert_file: str = "", key_file: str = "") -> ssl.SSLContext:
+    """An SSLContext from the given PEM files, or from a freshly
+    generated self-signed pair when none are supplied."""
+    from activemonitor_tpu.errors import ConfigurationError
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    if bool(cert_file) != bool(key_file):
+        # silently serving a self-signed cert instead of the operator's
+        # half-supplied pair would fail Prometheus verification with no
+        # hint of the misconfiguration (Manager validates this earlier;
+        # kept here for direct callers)
+        raise ConfigurationError(
+            "metrics TLS needs BOTH --metrics-cert-file and "
+            "--metrics-key-file (got only one)"
+        )
+    if cert_file and key_file:
+        ctx.load_cert_chain(cert_file, key_file)
+        return ctx
+    cert_pem, key_pem = generate_self_signed_cert()
+    # load_cert_chain only takes paths; stage the ephemeral pair
+    with tempfile.NamedTemporaryFile(suffix=".pem") as cert_tmp, \
+            tempfile.NamedTemporaryFile(suffix=".pem") as key_tmp:
+        cert_tmp.write(cert_pem)
+        cert_tmp.flush()
+        key_tmp.write(key_pem)
+        key_tmp.flush()
+        ctx.load_cert_chain(cert_tmp.name, key_tmp.name)
+    return ctx

@@ -1,0 +1,71 @@
+"""TTL-cached token file.
+
+Secrets mounted into pods rotate (bound SA tokens ~1h, scrape tokens on
+operator action); anything comparing or sending such a token must
+re-read the file periodically instead of snapshotting it at startup.
+One implementation, shared by the metrics auth filter and the cluster
+credentials (kube.config).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("activemonitor.tokenfile")
+
+DEFAULT_TTL = 60.0
+
+
+class FileToken:
+    """A token string, re-read from ``path`` at most every ``ttl``
+    seconds. With no path it is just a static value.
+
+    ``on_error`` picks the failure policy — the two consumers genuinely
+    differ: ``"keep"`` (default) holds the last good value, right for
+    CLIENT credentials where a transient kubelet-rotation glitch must
+    not drop cluster auth; ``"clear"`` empties the value, right for
+    SERVER-side auth where a deleted/unmounted token file means the
+    operator revoked access and the gate must fail closed."""
+
+    def __init__(
+        self,
+        path: str = "",
+        initial: str = "",
+        ttl: float = DEFAULT_TTL,
+        on_error: str = "keep",
+    ):
+        if on_error not in ("keep", "clear"):
+            # a typo silently meaning fail-open would defeat the very
+            # policy this parameter selects
+            raise ValueError(f"on_error must be 'keep' or 'clear', got {on_error!r}")
+        self.path = path
+        self._value = initial
+        self._ttl = ttl
+        self._on_error = on_error
+        # -inf, not 0.0: monotonic() starts near zero after host boot,
+        # and "never read" must always trigger the first read
+        self._read_at = float("-inf")
+
+    def get(self) -> str:
+        if self.path and time.monotonic() - self._read_at > self._ttl:
+            try:
+                with open(self.path) as f:
+                    self._value = f.read().strip()
+            except OSError:
+                if self._on_error == "clear":
+                    log.warning(
+                        "token file %s unreadable; clearing value (fail closed)",
+                        self.path,
+                    )
+                    self._value = ""
+                else:
+                    log.warning(
+                        "token file %s unreadable; keeping previous value", self.path
+                    )
+            self._read_at = time.monotonic()
+        return self._value
+
+    def expire(self) -> None:
+        """Force the next get() to re-read (tests)."""
+        self._read_at = float("-inf")

@@ -30,6 +30,18 @@ positive that makes `make lint` cry wolf is worse than a miss):
 - redefined-test: the same scope defines `def test_x` twice — pytest
   collects only the last one, silently dropping the first (F811 for
   the case that actually loses coverage).
+- unreachable-code: statements after a `return`/`raise`/`break`/
+  `continue` in the same block never execute (pylint W0101) — usually
+  a refactor left debris or an early-return was added above real work.
+- unused-parameter: a parameter of an undecorated plain function that
+  the body never mentions (ARG001), restricted hard against the
+  false-positive swamp: methods (override signatures), decorated
+  functions (callback contracts), `_`-prefixed names, `*args`/
+  `**kwargs`, and stub bodies are all exempt.
+- swallowed-exception: `except Exception:`/`except BaseException:`
+  whose whole body is `pass`/`...` — the broad catch that silently
+  eats errors (BLE001's harmful core). Handlers that log, re-raise,
+  return, or otherwise DO something are fine.
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -80,7 +92,7 @@ _SHADOW_BUILTINS = {
 class Scope:
     __slots__ = (
         "node", "bound", "loads", "global_names", "parent", "is_class",
-        "def_names",
+        "def_names", "params",
     )
 
     def __init__(self, node, parent=None, is_class=False):
@@ -91,6 +103,9 @@ class Scope:
         self.loads: list[tuple[str, int, int]] = []
         self.global_names: set[str] = set()
         self.def_names: set[str] = set()  # function defs seen in this scope
+        # (name, lineno) of parameters eligible for the
+        # unused-parameter check (empty when the function is exempt)
+        self.params: list[tuple[str, int]] = []
 
 
 class Checker(ast.NodeVisitor):
@@ -239,6 +254,7 @@ class Checker(ast.NodeVisitor):
                 )
         for annotation in self._annotations(node):
             self.visit(annotation)
+        in_class = self.scope.is_class
         self.push(node)
         args = node.args
         for a in (
@@ -250,6 +266,30 @@ class Checker(ast.NodeVisitor):
         ):
             self.scope.bound.add(a.arg)
             self._check_shadow(a.arg, a.lineno, "parameter")
+        # unused-parameter eligibility (the narrow slice where a flag
+        # means a bug, not a contract): plain undecorated functions
+        # outside class bodies, with a real body; positional/keyword
+        # params only, `_`-prefixed exempt
+        if (
+            not in_class
+            and not node.decorator_list
+            # pytest injects fixtures by PARAMETER NAME: a test's params
+            # are requests, not inputs the body must read
+            and not node.name.startswith("test_")
+            and not self._is_stub_body(node.body)
+            # docstring(s) followed by a trailing `raise` is the
+            # canonical not-implemented stub: params are the contract
+            and not (
+                node.body
+                and isinstance(node.body[-1], ast.Raise)
+                and self._is_stub_body(node.body[:-1])
+            )
+        ):
+            self.scope.params = [
+                (a.arg, a.lineno)
+                for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+                if not a.arg.startswith("_") and a.arg not in ("self", "cls")
+            ]
         for stmt in node.body:
             self.visit(stmt)
         self.pop()
@@ -326,14 +366,65 @@ class Checker(ast.NodeVisitor):
     visit_DictComp = _visit_comprehension
 
     # -- other checks ---------------------------------------------------
+    @staticmethod
+    def _is_stub_body(body: list) -> bool:
+        """Only docstrings, `pass`, and `...` — nothing executes."""
+        for stmt in body:
+            if isinstance(stmt, ast.Pass):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+                continue  # docstring or bare `...`
+            return False
+        return True
+
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         if node.type is None:
             self.findings.append(
                 (node.lineno, "bare-except", "bare `except:` (catches SystemExit)")
             )
+        else:
+            broad = {"Exception", "BaseException"}
+            caught = (
+                [node.type]
+                if not isinstance(node.type, ast.Tuple)
+                else list(node.type.elts)
+            )
+            if any(
+                isinstance(t, ast.Name) and t.id in broad for t in caught
+            ) and self._is_stub_body(node.body):
+                self.findings.append(
+                    (
+                        node.lineno,
+                        "swallowed-exception",
+                        "broad `except Exception:` whose body is only "
+                        "`pass` — errors vanish silently",
+                    )
+                )
         if node.name:
             self.bind(node.name)
         self.generic_visit(node)
+
+    _TERMINAL = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+    def _check_unreachable(self, body: list) -> None:
+        for i, stmt in enumerate(body[:-1]):
+            if isinstance(stmt, self._TERMINAL):
+                self.findings.append(
+                    (
+                        body[i + 1].lineno,
+                        "unreachable-code",
+                        "statement can never execute (follows "
+                        f"`{type(stmt).__name__.lower()}`)",
+                    )
+                )
+                break  # one finding per block is enough
+
+    def visit(self, node):
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(node, field, None)
+            if isinstance(block, list) and len(block) > 1:
+                self._check_unreachable(block)
+        return super().visit(node)
 
     def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
         # a format spec like `:.1e` parses as a placeholder-less
@@ -398,6 +489,29 @@ class Checker(ast.NodeVisitor):
                 if name not in self.referenced and name not in exported:
                     self.findings.append(
                         (lineno, "unused-import", f"`{name}` imported but unused")
+                    )
+        for scope in self.all_scopes:
+            if not scope.params:
+                continue
+            # every name mentioned in this scope OR any scope nested
+            # inside it (closures legitimately consume parameters)
+            mentioned = {name for name, _l, _c in scope.loads}
+            for inner in self.all_scopes:
+                cursor = inner.parent
+                while cursor is not None:
+                    if cursor is scope:
+                        mentioned |= {n for n, _l, _c in inner.loads}
+                        mentioned |= inner.bound
+                        break
+                    cursor = cursor.parent
+            for name, lineno in scope.params:
+                if name not in mentioned:
+                    self.findings.append(
+                        (
+                            lineno,
+                            "unused-parameter",
+                            f"parameter `{name}` is never used in the body",
+                        )
                     )
         for name, lineno in self.stmt_calls:
             if name in self.async_defs and name not in self.sync_defs:

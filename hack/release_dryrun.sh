@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Execute every release.yml step that can run without a docker daemon,
+# network egress, or GitHub credentials — the transcript that proves
+# the release path works before any tag is pushed
+# (docs/evidence/release-dryrun-*.md records a captured run).
+#
+# Usage: hack/release_dryrun.sh [expected-tag]   (default: v<pyproject version>)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PKG_VERSION=$(python -c "import tomllib;print(tomllib.load(open('pyproject.toml','rb'))['project']['version'])")
+TAG="${1:-v$PKG_VERSION}"
+
+echo "== test job: version-tag gate =="
+t="${TAG#v}"
+if [ "$PKG_VERSION" = "$t" ]; then
+  echo "tag $TAG matches pyproject version $PKG_VERSION"
+else
+  echo "pyproject version $PKG_VERSION != tag $t" >&2
+  exit 1
+fi
+
+echo "== test job: lint =="
+make lint
+
+echo "== test job: full suite =="
+python -m pytest tests/ -q
+
+echo "== publish job: regenerate install artifacts + drift check =="
+make crd
+python hack/gen_deploy.py
+git diff --exit-code config/ deploy/
+echo "release artifacts match the tree"
+
+echo "== image job: Dockerfile RUN steps, executed outside docker =="
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+# Dockerfile: RUN pip install --no-cache-dir .
+# Offline equivalent: deps come from the invoking environment at run
+# time; what this proves is that THIS package installs cleanly and its
+# entrypoints work from the installed copy, not the source checkout.
+pip install --no-cache-dir --no-deps --no-build-isolation \
+  --target "$STAGE" --quiet .
+echo "installed: $(ls "$STAGE" | grep dist-info)"
+# ENTRYPOINT ["python", "-m", "activemonitor_tpu"] + CMD ["run", "--help"]
+(cd /tmp && JAX_PLATFORMS=cpu PYTHONPATH="$STAGE" \
+  python -m activemonitor_tpu run --help >/dev/null)
+echo "image entrypoint OK from installed copy"
+# probe payload (what workflow templates exec inside probe pods)
+(cd /tmp && JAX_PLATFORMS=cpu PYTHONPATH="$STAGE" \
+  python -m activemonitor_tpu.probes devices >/dev/null)
+echo "probe CLI OK from installed copy"
+
+echo
+echo "Dry run complete. Still needs real infrastructure: docker build"
+echo "(multi-arch, nonroot runtime), JAX_VARIANT=jax[tpu] wheel pull,"
+echo "GHCR push, and the GitHub release step."

@@ -152,3 +152,124 @@ def test_two_process_checkpoint_resume_over_dcn(tmp_path):
     # both the pre-save loss and the post-restore continuation agree
     # across hosts (replicated loss, one shared checkpoint)
     assert lines[0] == lines[1], outputs
+
+
+def test_survivor_fails_fast_and_elastic_resume_after_peer_death(tmp_path):
+    """The failure half of the multi-host story: one process of a dp=2
+    mesh dies mid-training. The survivor must ERROR OUT of its next
+    collective (a hang here would wedge a real slice until the job
+    scheduler's own timeout), and a fresh single-process run must
+    restore the last durable checkpoint onto a 1-device mesh and keep
+    training — preemption recovery with a shrunken mesh, end to end."""
+    import time
+
+    shared = str(tmp_path / "ckpt")
+
+    def argv(rank, port):
+        saved_flag = str(tmp_path / f"saved-{rank}")
+        driver = (
+            "import jax, pathlib, time;"
+            "jax.config.update('jax_platforms', 'cpu');"
+            f"jax.distributed.initialize('127.0.0.1:{port}', 2, {rank});"
+            "from activemonitor_tpu.models.probe_model import tiny_config;"
+            "from activemonitor_tpu.parallel.mesh import make_2d_mesh;"
+            "from activemonitor_tpu.parallel.distributed import distribute;"
+            "from activemonitor_tpu.probes.training_step import ("
+            "    build_sharded_train_step, save_train_state);"
+            "cfg = tiny_config();"
+            "mesh = make_2d_mesh(shape=(2, 1));"
+            "step, params, opt, data_sh = build_sharded_train_step(cfg, mesh);"
+            "tokens = distribute(jax.random.randint("
+            "    jax.random.key(3), (4, 17), 0, cfg.vocab_size), data_sh);"
+            "params, opt, loss = step(params, opt, tokens);"
+            f"save_train_state({shared!r}, params, opt, step=1);"
+            f"pathlib.Path({saved_flag!r}).write_text('ok');"
+            "print('SAVED', flush=True);"
+            # keep training: every step's gradient psum crosses the
+            # process boundary, so the peer's death must surface here
+            "\nfor i in range(10000):\n"
+            "    params, opt, loss = step(params, opt, tokens)\n"
+            "    jax.block_until_ready(loss)\n"
+            "    time.sleep(0.05)\n"
+        )
+        return [sys.executable, "-c", driver]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workers = [
+        subprocess.Popen(
+            argv(rank, port),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=repo,
+        )
+        for rank in range(2)
+    ]
+    try:
+        # wait until BOTH ranks have committed the checkpoint
+        deadline = time.monotonic() + 180
+        flags = [tmp_path / "saved-0", tmp_path / "saved-1"]
+        while not all(f.exists() for f in flags):
+            for proc in workers:
+                assert proc.poll() is None, (
+                    "worker died before checkpointing: "
+                    + proc.communicate()[0].decode()[-1500:]
+                )
+            assert time.monotonic() < deadline, "checkpoint never committed"
+            time.sleep(0.2)
+
+        workers[1].kill()  # the peer vanishes mid-training
+
+        # the survivor must exit NONZERO on its own — before the
+        # timeout, without being killed. A hang is the failure mode.
+        try:
+            out, _ = workers[0].communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            raise AssertionError(
+                "survivor hung in a collective after peer death"
+            )
+        assert workers[0].returncode != 0, out.decode()[-800:]
+        assert b"SAVED" in out  # it got through the durable save first
+    finally:
+        for proc in workers:
+            if proc.poll() is None:
+                proc.kill()
+
+    # elastic resume: a FRESH 1-process run restores the 2-process
+    # checkpoint onto a 1-device mesh and trains on
+    resume = (
+        "import jax;"
+        "jax.config.update('jax_platforms', 'cpu');"
+        "from activemonitor_tpu.models.probe_model import tiny_config;"
+        "from activemonitor_tpu.parallel.mesh import make_2d_mesh;"
+        "from activemonitor_tpu.parallel.distributed import distribute;"
+        "from activemonitor_tpu.probes.training_step import ("
+        "    build_sharded_train_step, restore_train_state,"
+        "    train_state_templates);"
+        "cfg = tiny_config();"
+        "mesh = make_2d_mesh(shape=(1, 1));"
+        "step, _, _, data_sh = build_sharded_train_step(cfg, mesh);"
+        "p_like, o_like = train_state_templates(cfg, mesh);"
+        f"params, opt, at = restore_train_state({shared!r}, p_like, o_like);"
+        "assert at == 1, at;"
+        "tokens = distribute(jax.random.randint("
+        "    jax.random.key(3), (4, 17), 0, cfg.vocab_size), data_sh);"
+        "params, opt, loss = step(params, opt, tokens);"
+        "import math; assert math.isfinite(float(loss));"
+        "print('RESUMED', at, float(loss))"
+    )
+    done = subprocess.run(
+        [sys.executable, "-c", resume],
+        env=env,
+        capture_output=True,
+        cwd=repo,
+        timeout=240,
+    )
+    assert done.returncode == 0, done.stdout.decode()[-1500:] + done.stderr.decode()[-1500:]
+    assert b"RESUMED 1" in done.stdout

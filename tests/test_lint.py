@@ -149,3 +149,97 @@ def test_seeded_file_exits_nonzero(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def f():\n    return undefined_thing\n")
     assert lint.main([str(bad)]) == 1
+
+
+def test_unreachable_code_fires_and_stays_quiet(tmp_path):
+    got = findings(
+        tmp_path,
+        "def f(x):\n"
+        "    return x\n"
+        "    x += 1\n",
+    )
+    assert codes(got) == {"unreachable-code"}
+    # early return inside a branch: everything after the if is live
+    assert (
+        findings(
+            tmp_path,
+            "def f(x):\n"
+            "    if x:\n"
+            "        return 0\n"
+            "    return x + 1\n",
+        )
+        == []
+    )
+
+
+def test_unused_parameter_fires_on_plain_function(tmp_path):
+    got = findings(
+        tmp_path,
+        "def f(a, b):\n"
+        "    return a + 1\n",
+    )
+    assert codes(got) == {"unused-parameter"}
+
+
+def test_unused_parameter_exemptions_hold(tmp_path):
+    quiet = (
+        # method: override signatures are contracts
+        "class C:\n"
+        "    def m(self, unused):\n"
+        "        return 1\n"
+        # decorated: callback contracts
+        "import functools\n"
+        "@functools.cache\n"
+        "def g(unused):\n"
+        "    return 2\n"
+        # pytest fixture request by name
+        "def test_thing(capsys):\n"
+        "    assert True\n"
+        # underscore convention
+        "def h(_ignored, x):\n"
+        "    return x\n"
+        # closure consumes the parameter
+        "def outer(cb):\n"
+        "    def inner():\n"
+        "        return cb()\n"
+        "    return inner\n"
+        # stub body
+        "def stub(a, b):\n"
+        "    raise NotImplementedError\n"
+        # the canonical docstring-then-raise stub is exempt too
+        "def stub2(a, b):\n"
+        "    '''Interface contract.'''\n"
+        "    raise NotImplementedError\n"
+    )
+    assert findings(tmp_path, quiet) == []
+
+
+def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
+    got = findings(
+        tmp_path,
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def work():\n"
+        "    return 1\n",
+    )
+    assert codes(got) == {"swallowed-exception"}
+    # a handler that DOES something (log, return, re-raise) is fine,
+    # and narrow catches may pass silently
+    quiet = (
+        "import logging\n"
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        logging.debug('x', exc_info=True)\n"
+        "    try:\n"
+        "        work()\n"
+        "    except KeyError:\n"
+        "        pass\n"
+        "def work():\n"
+        "    return 1\n"
+    )
+    assert findings(tmp_path, quiet) == []

@@ -45,7 +45,7 @@ def make_manager(**kwargs):
     return Manager(client=client, reconciler=reconciler, max_parallel=1, **kwargs)
 
 
-async def fetch(url, token=None, verify=False, ca_pem=None):
+async def fetch(url, token=None, ca_pem=None):
     import aiohttp
 
     if url.startswith("https"):

@@ -11,6 +11,7 @@ import asyncio
 import pytest
 
 from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller.client import NotFoundError
 from activemonitor_tpu.controller import (
     EventRecorder,
     HealthCheckReconciler,
@@ -133,13 +134,196 @@ async def test_interleaved_apply_delete_storm():
             for i in range(10):
                 try:
                     await client.delete("health", f"stress-{i:03d}")
-                except Exception:
-                    pass
+                except NotFoundError:
+                    pass  # already gone in a previous churn round
             await asyncio.sleep(0.05)
         await asyncio.sleep(0.3)
         await reconciler.wait_watches()
         # all deleted: no pending timers may survive
         for i in range(10):
             assert not reconciler.timers.pending(f"health/stress-{i:03d}")
+    finally:
+        await manager.stop()
+
+
+# -- fake-clock soak tier ----------------------------------------------
+#
+# The reference's envtest runs minutes of wall-clock with a handful of
+# CRs (suite_test.go); nothing there proves the controller's resource
+# discipline over HOURS of schedule churn at fleet scale. This tier
+# does: 210 HealthChecks (interval / storm-aligned cron / failing
+# remedy), two simulated hours on the FakeClock with delete+re-apply
+# churn in the middle, then QUANTIFIED invariants — run counts per
+# cadence, remedy hysteresis bounds, watch-task and timer-wheel sizes,
+# and stable metrics cardinality across the churn (a leak in any of
+# those grows with simulated time and fails the bound).
+
+N_SOAK = 210  # divisible by 3: interval / cron / remedy thirds
+SIM_SECONDS = 2 * 3600
+
+
+def make_soak_hc(i: int):
+    kind = i % 3
+    spec = {
+        "level": "cluster",
+        "workflow": {
+            "generateName": f"soak-{i:03d}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": f"soak-sa-{i:03d}",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if kind == 0:
+        spec["repeatAfterSec"] = 600
+    elif kind == 1:
+        # every cron check shares the same fire minutes: a 70-check
+        # thundering herd at :00/:15/:30/:45
+        spec["schedule"] = {"cron": "*/15 * * * *"}
+    else:
+        spec["repeatAfterSec"] = 900
+        spec["remedyRunsLimit"] = 2
+        spec["remedyResetInterval"] = 1800
+        spec["remedyworkflow"] = {
+            "generateName": f"soak-fix-{i:03d}-",
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": f"soak-fix-sa-{i:03d}",
+                "source": {"inline": WF_INLINE},
+            },
+        }
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": f"soak-{i:03d}", "namespace": "health"},
+            "spec": spec,
+        }
+    )
+
+
+def _series_count(metrics: MetricsCollector) -> int:
+    return sum(
+        1
+        for line in metrics.exposition().decode().splitlines()
+        if line and not line.startswith("#")
+    )
+
+
+@pytest.mark.asyncio
+async def test_soak_two_simulated_hours_bounded_resources():
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    engine = FakeWorkflowEngine(succeed_after(1))
+    for i in range(2, N_SOAK, 3):  # remedy checks' health workflows fail
+        engine.on_prefix(f"soak-{i:03d}-", fail_after(1, f"soak-fail-{i:03d}"))
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(capacity=5000),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=10)
+    await manager.start()
+
+    async def settle(rounds: int = 40) -> None:
+        for _ in range(rounds):
+            await asyncio.sleep(0)
+
+    async def run_sim(seconds: int) -> None:
+        for _ in range(seconds // 60):
+            await clock.advance(60)
+            await settle()
+
+    churn = [f"soak-{i:03d}" for i in range(0, 60, 3)]  # 20 interval checks
+    try:
+        await asyncio.gather(*(client.apply(make_soak_hc(i)) for i in range(N_SOAK)))
+        await settle(80)
+
+        await run_sim(1800)
+        mid_cardinality = _series_count(metrics)
+        # churn: delete a slice, let half an hour pass, re-apply the
+        # SAME names (bounded label space), run out the clock
+        for name in churn:
+            await client.delete("health", name)
+        await settle(80)
+        for name in churn:
+            assert not reconciler.timers.pending(f"health/{name}"), name
+        await run_sim(1800)
+        await asyncio.gather(
+            *(client.apply(make_soak_hc(int(n.split("-")[1]))) for n in churn)
+        )
+        await settle(80)
+        await run_sim(SIM_SECONDS - 3600)
+        # drain in-flight watches: a few extra minutes of fake time
+        for _ in range(10):
+            if not any(t for t in reconciler._watch_tasks.values() if not t.done()):
+                break
+            await clock.advance(60)
+            await settle()
+        await reconciler.wait_watches()
+
+        # -- run-count invariants per cadence --------------------------
+        for i in range(N_SOAK):
+            name = f"soak-{i:03d}"
+            hc = await client.get("health", name)
+            runs = hc.status.total_healthcheck_runs
+            kind = i % 3
+            if kind == 0 and name not in churn:
+                # 600 s cadence over 7200 s: one run per period, the
+                # ±1-period slack covering start/drain edges
+                assert 9 <= runs <= 14, (name, runs)
+            elif kind == 0:
+                assert 5 <= runs <= 14, (name, runs)  # churn gap allowed
+            elif kind == 1:
+                # */15 cron: 8 fires in two hours (storm-aligned)
+                assert 7 <= runs <= 11, (name, runs)
+                assert hc.status.status == "Succeeded", name
+            else:
+                assert 7 <= runs <= 11, (name, runs)
+                assert hc.status.failed_count == runs, (name, hc.status)
+                # hysteresis: the limit counter CYCLES (reset → rerun),
+                # so the durable invariant is total submissions — at
+                # most 2 per 1800 s reset window, never 1:1 with the
+                # 900 s failure cadence
+                fixes = sum(
+                    1
+                    for wf in engine.submitted
+                    if wf["metadata"]["generateName"] == f"soak-fix-{i:03d}-"
+                )
+                assert 3 <= fixes <= 8, (name, fixes)
+                assert fixes < runs, (name, fixes, runs)
+                assert hc.status.remedy_total_runs <= 2, name
+
+        # -- resource-discipline invariants ----------------------------
+        alive_watches = sum(
+            1 for t in reconciler._watch_tasks.values() if not t.done()
+        )
+        assert alive_watches == 0
+        assert len(reconciler._watch_tasks) <= 2 * N_SOAK
+        pending_timers = sum(
+            1
+            for i in range(N_SOAK)
+            if reconciler.timers.pending(f"health/soak-{i:03d}")
+        )
+        # every live check keeps exactly one next-run timer
+        assert pending_timers == N_SOAK
+        assert len(reconciler.timers._timers) <= 2 * N_SOAK + 10
+        # cardinality: the second hour (with churn + re-apply of the
+        # same names) must not have grown the series space
+        end_cardinality = _series_count(metrics)
+        assert end_cardinality <= mid_cardinality + 5, (
+            mid_cardinality,
+            end_cardinality,
+        )
+        # per-check series budget: 5 scrape names + the runtime
+        # histogram's buckets/sum/count (~22 series per check observed)
+        assert end_cardinality <= 24 * N_SOAK + 200
+        assert len(reconciler.recorder._events) <= 5000  # capacity holds
     finally:
         await manager.stop()
