@@ -36,6 +36,8 @@ run: ## run the controller locally (file store + local engine)
 lint: ## syntax + AST lint (undefined names, unused imports, bare except, ...)
 	$(PYTHON) -m compileall -q activemonitor_tpu tests bench.py __graft_entry__.py
 	$(PYTHON) hack/lint.py
+	@for s in hack/*.sh deploy/*.sh; do bash -n "$$s" || exit 1; done; \
+	  echo "shell syntax OK"
 
 kind-e2e: ## real-cluster tier: kind + Argo + controller + a Succeeded check
 	./hack/kind-e2e.sh

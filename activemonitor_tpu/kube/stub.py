@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import json
+import re
 import secrets
 from typing import Dict, List, Tuple
 
@@ -39,6 +40,31 @@ def _match_selector(obj: dict, selector: str) -> bool:
         if labels.get(k) != v:
             return False
     return True
+
+
+# canonical MicroTime wire form: RFC3339 with EXACTLY six fractional
+# digits (what client-go always writes; docs/conformance.md "strict
+# field-format parsing"). Old apiservers rejected anything else with a
+# 400 decode error — the stub plays the strict parser so the leniency
+# of current apimachinery can't hide a non-canonical writer.
+_MICRO_TIME_RE = re.compile(
+    r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z$"
+)
+
+
+def _lease_decode_error(key: Key, obj: dict):
+    if key != ("coordination.k8s.io", "v1", "leases"):
+        return None
+    spec = obj.get("spec") or {}
+    for field in ("acquireTime", "renewTime"):
+        value = spec.get(field)
+        if value is not None and not _MICRO_TIME_RE.match(str(value)):
+            return (
+                f'Lease in version "v1" cannot be handled as a Lease: '
+                f'v1.LeaseSpec.{field}: unmarshalerDecoder: parsing time '
+                f'"{value}" as RFC3339Micro: non-canonical MicroTime'
+            )
+    return None
 
 
 def _json_type(value) -> str:
@@ -605,6 +631,9 @@ class StubApiServer:
             meta["name"] = name
         if body.get("kind"):
             self._kinds.setdefault(key, body["kind"])
+        decode_err = _lease_decode_error(key, body)
+        if decode_err:
+            return self._error(400, decode_err)
         causes = self._schema_causes(key, body)
         if causes:
             # schema validation rejects before storage is consulted —
@@ -625,6 +654,22 @@ class StubApiServer:
         self._bucket(key)[(namespace, name)] = body
         self._broadcast(key, namespace, "ADDED", body)
         return web.json_response(copy.deepcopy(body), status=201)
+
+    def _cascade_delete(self, owner_uid: str) -> None:
+        for key, bucket in list(self._objects.items()):
+            for (ns, name), obj in list(bucket.items()):
+                if (ns, name) not in bucket:
+                    # already removed by a recursive cascade (an object
+                    # may list several owners and be reachable twice)
+                    continue
+                refs = (obj.get("metadata") or {}).get("ownerReferences") or []
+                if any(r.get("uid") == owner_uid for r in refs):
+                    del bucket[(ns, name)]
+                    self._bump()
+                    self._broadcast(key, ns, "DELETED", obj)
+                    child_uid = obj["metadata"].get("uid")
+                    if child_uid:  # grandchildren cascade too
+                        self._cascade_delete(child_uid)
 
     def _evaluate_review(self, plural: str, body: dict) -> dict:
         """The authentication/authorization review APIs, table-driven:
@@ -676,6 +721,14 @@ class StubApiServer:
             del self._bucket(key)[(namespace, name)]
             self._bump()
             self._broadcast(key, namespace, "DELETED", existing)
+            # ownerReference garbage collection, the real apiserver's
+            # background cascade made synchronous: anything owned by
+            # the deleted object's uid goes too (how a HealthCheck's
+            # submitted Workflows disappear on HC delete — the
+            # controller's None-workflow path expects exactly this)
+            owner_uid = existing["metadata"].get("uid")
+            if owner_uid:
+                self._cascade_delete(owner_uid)
             return web.json_response(
                 {
                     "kind": "Status",
@@ -720,6 +773,9 @@ class StubApiServer:
         else:  # PATCH (JSON merge patch)
             patch = {"status": body.get("status")} if status_only else body
             updated = merge_patch(existing, patch)
+        decode_err = _lease_decode_error(key, updated)
+        if decode_err:
+            return self._error(400, decode_err)
         causes = self._schema_causes(key, updated)
         if causes:
             # updates are validated on the FULL post-merge object (the

@@ -34,7 +34,18 @@ echo "release artifacts match the tree"
 
 echo "== image job: Dockerfile RUN steps, executed outside docker =="
 STAGE=$(mktemp -d)
-trap 'rm -rf "$STAGE"' EXIT
+# the in-tree setuptools run leaves build/ + egg-info byproducts
+# (both gitignored). Clean up ONLY what this run creates — a developer
+# may have a pre-existing build/ or an editable-install egg-info that
+# is not ours to delete.
+PRE_BUILD=0; [ -e build ] && PRE_BUILD=1
+PRE_EGG=0; compgen -G "./*.egg-info" > /dev/null && PRE_EGG=1
+cleanup() {
+  rm -rf "$STAGE"
+  if [ "$PRE_BUILD" = 0 ]; then rm -rf build; fi
+  if [ "$PRE_EGG" = 0 ]; then rm -rf ./*.egg-info; fi
+}
+trap cleanup EXIT
 # Dockerfile: RUN pip install --no-cache-dir .
 # Offline equivalent: deps come from the invoking environment at run
 # time; what this proves is that THIS package installs cleanly and its

@@ -373,3 +373,165 @@ async def test_core_and_cluster_scoped_paths():
             )
         )
         assert got["metadata"]["name"] == "probe-role"
+
+
+@pytest.mark.asyncio
+async def test_owner_reference_cascade_delete():
+    """Deleting an owner garbage-collects everything carrying its uid
+    in ownerReferences — transitively — and the deletions travel as
+    watch DELETED events (the apiserver GC behavior the controller's
+    None-workflow path anticipates on HealthCheck delete)."""
+    async with stub_env() as (server, api):
+        hc_path = api_path(
+            "activemonitor.keikoproj.io", "v1alpha1", "healthchecks", "health"
+        )
+        hc = await api.create(
+            hc_path,
+            {
+                "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+                "kind": "HealthCheck",
+                "metadata": {"name": "owner", "namespace": "health"},
+                "spec": {"repeatAfterSec": 60},
+            },
+        )
+        uid = hc["metadata"]["uid"]
+        wf = await api.create(
+            api_path("argoproj.io", "v1alpha1", "workflows", "health"),
+            {
+                "kind": "Workflow",
+                "metadata": {
+                    "generateName": "owned-",
+                    "ownerReferences": [
+                        {"kind": "HealthCheck", "name": "owner", "uid": uid}
+                    ],
+                },
+            },
+        )
+        # a grandchild owned by the workflow cascades too
+        await api.create(
+            core_path("pods", "health"),
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": "owned-pod",
+                    "ownerReferences": [
+                        {"kind": "Workflow", "uid": wf["metadata"]["uid"]}
+                    ],
+                },
+            },
+        )
+        # an unrelated object with a DIFFERENT owner uid survives
+        await api.create(
+            api_path("argoproj.io", "v1alpha1", "workflows", "health"),
+            {
+                "kind": "Workflow",
+                "metadata": {
+                    "name": "unowned",
+                    "ownerReferences": [{"kind": "HealthCheck", "uid": "other"}],
+                },
+            },
+        )
+
+        events = []
+
+        async def watch_workflows():
+            async for ev in api.watch(
+                api_path("argoproj.io", "v1alpha1", "workflows"),
+                timeout_seconds=5,
+            ):
+                events.append((ev["type"], ev["object"]["metadata"].get("name")))
+                if ev["type"] == "DELETED":
+                    return
+
+        task = asyncio.ensure_future(watch_workflows())
+        await asyncio.sleep(0.05)
+        await api.delete(f"{hc_path}/owner")
+        await asyncio.wait_for(task, timeout=5)
+
+        remaining = {
+            o["metadata"].get("name")
+            for o in server.objs("argoproj.io", "v1alpha1", "workflows")
+        }
+        assert remaining == {"unowned"}
+        assert server.objs("", "v1", "pods") == []  # grandchild GC'd
+        assert ("DELETED", wf["metadata"]["name"]) in events
+
+
+@pytest.mark.asyncio
+async def test_cascade_delete_with_multiple_owners():
+    """Multiple ownerReferences are legal: an object reachable through
+    TWO owners in one cascade must be deleted exactly once, not crash
+    the DELETE with a double-remove."""
+    async with stub_env() as (server, api):
+        p = api_path("argoproj.io", "v1alpha1", "workflows", "health")
+        a = await api.create(p, {"kind": "Workflow", "metadata": {"name": "a"}})
+        b = await api.create(
+            p,
+            {
+                "kind": "Workflow",
+                "metadata": {
+                    "name": "b",
+                    "ownerReferences": [{"uid": a["metadata"]["uid"]}],
+                },
+            },
+        )
+        await api.create(
+            p,
+            {
+                "kind": "Workflow",
+                "metadata": {
+                    "name": "c",
+                    "ownerReferences": [
+                        {"uid": a["metadata"]["uid"]},
+                        {"uid": b["metadata"]["uid"]},
+                    ],
+                },
+            },
+        )
+        await api.delete(f"{p}/a")
+        assert server.objs("argoproj.io", "v1alpha1", "workflows") == []
+
+
+@pytest.mark.asyncio
+async def test_lease_non_canonical_microtime_rejected():
+    """The stub plays the STRICT RFC3339Micro parser old apiservers
+    shipped: a Lease write whose renewTime omits the six fractional
+    digits (datetime.isoformat at microsecond 0) is a 400 decode
+    error, while the canonical utils.clock.micro_time form is stored.
+    This pins the hardening docs/conformance.md could previously only
+    describe."""
+    import datetime
+
+    from activemonitor_tpu.utils.clock import micro_time
+
+    async with stub_env() as (_, api):
+        path = api_path("coordination.k8s.io", "v1", "leases", "kube-system")
+        now = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+        with pytest.raises(ApiError) as exc:
+            await api.create(
+                path,
+                {
+                    "kind": "Lease",
+                    "metadata": {"name": "am-leader"},
+                    # microsecond == 0: isoformat drops the fraction
+                    "spec": {"holderIdentity": "a", "renewTime": now.isoformat()},
+                },
+            )
+        assert exc.value.status == 400
+        assert "RFC3339Micro" in str(exc.value)
+        created = await api.create(
+            path,
+            {
+                "kind": "Lease",
+                "metadata": {"name": "am-leader"},
+                "spec": {"holderIdentity": "a", "renewTime": micro_time(now)},
+            },
+        )
+        # a PATCH smuggling a non-canonical time is rejected the same way
+        with pytest.raises(ApiError) as exc:
+            await api.merge_patch(
+                f"{path}/am-leader",
+                {"spec": {"acquireTime": "2026-01-01T00:00:00Z"}},
+            )
+        assert exc.value.status == 400
+        assert created["spec"]["renewTime"] == "2026-01-01T00:00:00.000000Z"
