@@ -48,7 +48,9 @@ def _match_selector(obj: dict, selector: str) -> bool:
 # 400 decode error — the stub plays the strict parser so the leniency
 # of current apimachinery can't hide a non-canonical writer.
 _MICRO_TIME_RE = re.compile(
-    r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z$"
+    # \Z, not $: '$' would accept a trailing newline, which a real
+    # strict parser rejects
+    r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z\Z"
 )
 
 
@@ -84,15 +86,39 @@ def _json_type(value) -> str:
     return "null"
 
 
+def _prune_unknown(value, schema: dict):
+    """Structural-schema pruning, the apiserver's decode-time behavior
+    for CRDs without ``x-kubernetes-preserve-unknown-fields``: unknown
+    object keys are silently DROPPED before validation or storage — a
+    writer relying on an unschema'd field loses it, which is exactly
+    the drift this models. Untyped objects (no ``properties``, e.g.
+    ObjectMeta or free-form maps) keep everything."""
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return value
+    if isinstance(value, dict):
+        props = schema.get("properties")
+        if props is None:
+            return value
+        return {
+            k: _prune_unknown(v, props[k])
+            for k, v in value.items()
+            if k in props
+        }
+    if isinstance(value, list) and "items" in schema:
+        return [_prune_unknown(v, schema["items"]) for v in value]
+    return value
+
+
 def _validate_openapi(value, schema: dict, path: str, causes: list) -> None:
     """Structural-schema subset of apiserver CRD validation: type,
     required, enum, properties/items recursion. Renders causes in the
     real wire shape ({reason, message, field}) so the 422 the stub
     returns matches the machine format fixtures pin
-    (tests/fixtures/apiserver/invalid_422.json). Unknown fields are
-    accepted (the stub models preserve-unknown-fields CRDs; pruning is
-    out of scope), and ``metadata`` is skipped at the root — the real
-    apiserver validates ObjectMeta separately from the CRD schema."""
+    (tests/fixtures/apiserver/invalid_422.json). Unknown fields never
+    reach this validator — ``_prune_unknown`` drops them first, like
+    the real decode path — and ``metadata`` is skipped at the root:
+    the real apiserver validates ObjectMeta separately from the CRD
+    schema."""
     expected = schema.get("type")
     if expected:
         actual = _json_type(value)
@@ -332,6 +358,12 @@ class StubApiServer:
             w["queue"].put_nowait(None)  # sentinel: close the stream
             dropped += 1
         return dropped
+
+    def live_watch_count(self) -> int:
+        """How many watch streams are connected right now — the public
+        face of the watcher list for boundedness assertions (tests must
+        not reach into ``_watchers``)."""
+        return len(self._watchers)
 
     def emit_bookmarks(self) -> int:
         """Push an immediate BOOKMARK to every live watch that asked
@@ -634,6 +666,11 @@ class StubApiServer:
         decode_err = _lease_decode_error(key, body)
         if decode_err:
             return self._error(400, decode_err)
+        entry = self._schemas.get(key)
+        if entry is not None:
+            # pruning precedes validation, like the real decode path
+            body = _prune_unknown(body, entry[1])
+            meta = body.setdefault("metadata", {})
         causes = self._schema_causes(key, body)
         if causes:
             # schema validation rejects before storage is consulted —
@@ -776,6 +813,9 @@ class StubApiServer:
         decode_err = _lease_decode_error(key, updated)
         if decode_err:
             return self._error(400, decode_err)
+        entry = self._schemas.get(key)
+        if entry is not None:
+            updated = _prune_unknown(updated, entry[1])
         causes = self._schema_causes(key, updated)
         if causes:
             # updates are validated on the FULL post-merge object (the

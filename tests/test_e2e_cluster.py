@@ -297,3 +297,137 @@ async def test_cluster_mode_remedy_lifecycle():
             assert server.obj("", "v1", "serviceaccounts", "health", "check-sa")
         finally:
             await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_cluster_mode_soak_with_churn_and_gc():
+    """Half an hour of simulated schedule churn through the FULL
+    cluster-mode stack — REST client, validating stub, argo engine
+    watch cache, real RBAC objects, ownerRef GC. Complements the
+    in-memory soak tier (tests/test_stress.py): here every status
+    write crosses HTTP and server-side schema validation, and deleted
+    checks' workflows must be garbage-collected by the stub, not
+    assumed away. Invariants are quantified: per-check run counts,
+    bounded live watch connections on the server, and zero surviving
+    workflows owned by deleted checks."""
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    N = 24
+    SIM = 1800  # 30 simulated minutes, 300 s cadence -> ~6 runs/check
+
+    def soak_check(i):
+        return HealthCheck.from_dict(
+            {
+                "metadata": {"name": f"csoak-{i:02d}", "namespace": "health"},
+                "spec": {
+                    "repeatAfterSec": 300,
+                    "level": "cluster",
+                    "workflow": {
+                        "generateName": f"csoak-{i:02d}-",
+                        "workflowtimeout": 30,
+                        "resource": {
+                            "namespace": "health",
+                            "serviceAccount": f"csoak-sa-{i:02d}",
+                            "source": {"inline": INLINE_HELLO},
+                        },
+                    },
+                },
+            }
+        )
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        client = KubernetesHealthCheckClient(api)
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=ArgoWorkflowEngine(api),
+            rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+            recorder=KubernetesEventRecorder(api),
+            metrics=MetricsCollector(),
+            clock=clock,
+        )
+        manager = Manager(client=client, reconciler=reconciler, max_parallel=8)
+        await manager.start()
+
+        async def play_argo():
+            """Complete every Running workflow, like Argo would."""
+            for wf in server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+                status = wf.get("status") or {}
+                if status.get("phase") in ("Succeeded", "Failed"):
+                    continue
+                await api.merge_patch(
+                    api_path(
+                        WF_GROUP, WF_VERSION, WF_PLURAL,
+                        wf["metadata"]["namespace"],
+                        wf["metadata"]["name"],
+                        "status",
+                    ),
+                    {"status": {"phase": "Succeeded"}},
+                )
+
+        async def run_sim(seconds):
+            for _ in range(seconds // 15):
+                await clock.advance(15)
+                await asyncio.sleep(0.03)  # let HTTP roundtrips land
+                await play_argo()
+                await asyncio.sleep(0.02)
+
+        churned = [f"csoak-{i:02d}" for i in range(6)]
+        deleted_uids = set()
+        try:
+            for i in range(N):
+                await client.apply(soak_check(i))
+            await asyncio.sleep(0.3)
+            await run_sim(600)
+            # churn: delete a quarter; their workflows must be GC'd
+            for name in churned:
+                hc = await client.get("health", name)
+                deleted_uids.add(hc.metadata.uid)
+                await client.delete("health", name)
+            await asyncio.sleep(0.3)
+            for wf in server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+                refs = wf["metadata"].get("ownerReferences") or []
+                assert not any(r.get("uid") in deleted_uids for r in refs), wf[
+                    "metadata"
+                ]["name"]
+            await run_sim(600)
+            for i, name in enumerate(churned):  # same names return
+                await client.apply(soak_check(i))
+            await asyncio.sleep(0.3)
+            await run_sim(SIM - 1200)
+            # drain any in-flight run then quiesce
+            for _ in range(6):
+                await clock.advance(15)
+                await asyncio.sleep(0.05)
+                await play_argo()
+            await reconciler.wait_watches()
+
+            for i in range(N):
+                name = f"csoak-{i:02d}"
+                hc = await client.get("health", name)
+                runs = hc.status.total_healthcheck_runs
+                if name in churned:
+                    assert 3 <= runs <= 9, (name, runs)
+                else:
+                    assert 4 <= runs <= 9, (name, runs)
+                assert hc.status.status == "Succeeded", (name, hc.status)
+            # live watch connections on the SERVER stay bounded: the
+            # controller's healthcheck watch + per-namespace argo watch
+            # (reconnects must replace, not accumulate)
+            assert server.live_watch_count() <= 4, server.live_watch_count()
+            # workflow population ≈ one per completed run (nothing
+            # double-submitted; deleted checks' workflows gone)
+            wf_count = len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+            total_runs = 0
+            for i in range(N):
+                hc = await client.get("health", f"csoak-{i:02d}")
+                total_runs += hc.status.total_healthcheck_runs
+            assert wf_count <= total_runs + N, (wf_count, total_runs)
+            # per-check RBAC is reused, not re-minted per run
+            sas = [
+                o["metadata"]["name"]
+                for o in server.objs("", "v1", "serviceaccounts")
+            ]
+            assert len(sas) == len(set(sas)) and len(sas) <= N
+        finally:
+            await manager.stop()

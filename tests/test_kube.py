@@ -140,11 +140,12 @@ async def test_watch_survives_large_objects():
     async with stub_env() as (_, api):
         col = api_path(GROUP, VERSION, PLURAL, namespace="health")
         big = hc_body("hc-big")
-        big["spec"]["payload"] = "x" * (1 << 20)  # ~1 MiB
+        # a schema'd string field (unknown keys would be pruned)
+        big["spec"]["description"] = "x" * (1 << 20)  # ~1 MiB
         await api.create(col, big)
         async for ev in api.watch(api_path(GROUP, VERSION, PLURAL), timeout_seconds=5):
             assert ev["object"]["metadata"]["name"] == "hc-big"
-            assert len(ev["object"]["spec"]["payload"]) == 1 << 20
+            assert len(ev["object"]["spec"]["description"]) == 1 << 20
             break
 
 
@@ -535,3 +536,50 @@ async def test_lease_non_canonical_microtime_rejected():
             )
         assert exc.value.status == 400
         assert created["spec"]["renewTime"] == "2026-01-01T00:00:00.000000Z"
+
+
+@pytest.mark.asyncio
+async def test_schema_registered_resource_prunes_unknown_fields():
+    """Structural-schema pruning: unknown fields vanish at decode time
+    (create AND post-merge patch), schema'd siblings survive, and
+    untyped subtrees (metadata, free-form maps) keep everything — so a
+    controller relying on an unschema'd field loses it in tests the
+    same way it would against a real apiserver."""
+    async with stub_env() as (server, api):
+        path = api_path(
+            "activemonitor.keikoproj.io", "v1alpha1", "healthchecks", "health"
+        )
+        created = await api.create(
+            path,
+            {
+                "apiVersion": "activemonitor.keikoproj.io/v1alpha1",
+                "kind": "HealthCheck",
+                "metadata": {
+                    "name": "pruned",
+                    "namespace": "health",
+                    "labels": {"free": "form"},  # untyped: preserved
+                },
+                "spec": {
+                    "repeatAfterSec": 60,
+                    "bogus": "dropped",
+                    "workflow": {
+                        "generateName": "p-",
+                        "extraneous": {"x": 1},
+                    },
+                },
+            },
+        )
+        assert "bogus" not in created["spec"]
+        assert "extraneous" not in created["spec"]["workflow"]
+        assert created["spec"]["repeatAfterSec"] == 60
+        assert created["metadata"]["labels"] == {"free": "form"}
+        stored = server.obj(
+            "activemonitor.keikoproj.io", "v1alpha1", "healthchecks",
+            "health", "pruned",
+        )
+        assert "bogus" not in stored["spec"]
+        patched = await api.merge_patch(
+            f"{path}/pruned", {"spec": {"smuggled": True, "repeatAfterSec": 90}}
+        )
+        assert "smuggled" not in patched["spec"]
+        assert patched["spec"]["repeatAfterSec"] == 90
