@@ -60,18 +60,64 @@ class HealthCheckClient(Protocol):
         ...
 
 
-async def retry_on_conflict(fn, *, attempts: int = 5, base_delay: float = 0.01):
-    """Conflict-retry with jittered backoff, the RetryOnConflict shape
-    (reference: healthcheck_controller.go:208-215)."""
+async def _retry(fn, *, retryable, attempts: int, base_delay: float, clock=None):
+    """One exponential-backoff ladder for every retry policy in this
+    layer; ``retryable(exc)`` decides what rides, everything else
+    propagates immediately. Sleeps on the injected clock when given so
+    fake-clock tests drive the backoff."""
+    sleep = clock.sleep if clock is not None else asyncio.sleep
     last: Exception | None = None
     for i in range(attempts):
         try:
             return await fn()
-        except ConflictError as e:
+        except Exception as e:
+            if not retryable(e):
+                raise
             last = e
             if i + 1 < attempts:  # no pointless sleep after the final try
-                await asyncio.sleep(base_delay * (2**i))
+                await sleep(base_delay * (2**i))
     raise last  # type: ignore[misc]
+
+
+async def retry_on_conflict(
+    fn, *, attempts: int = 5, base_delay: float = 0.01, clock=None
+):
+    """Conflict-retry with jittered backoff, the RetryOnConflict shape
+    (reference: healthcheck_controller.go:208-215)."""
+    return await _retry(
+        fn,
+        retryable=lambda e: isinstance(e, ConflictError),
+        attempts=attempts,
+        base_delay=base_delay,
+        clock=clock,
+    )
+
+
+# HTTP statuses worth retrying in place: server-side transients. 4xx
+# (other than 429) mean the REQUEST is wrong and a retry cannot help.
+TRANSIENT_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+async def retry_on_transient(
+    fn, *, attempts: int = 6, base_delay: float = 0.25, clock=None
+):
+    """Retry ``fn`` through transient server errors (5xx/429), duck-
+    typed on an exception's ``status`` attribute so this layer needs no
+    import of the REST client. Built for writes that record work which
+    ALREADY HAPPENED (a completed run's status): letting a blip
+    propagate turns into a full re-reconcile that re-runs the check —
+    duplicate workflow submissions for one scheduled fire (the
+    reference shares this shape: its workqueue requeues the whole
+    reconcile on any status-write error). Six attempts spread ~8 s of
+    backoff; a storm outlasting that degrades to the requeue ladder's
+    at-least-once semantics."""
+    return await _retry(
+        fn,
+        retryable=lambda e: getattr(e, "status", None) in TRANSIENT_STATUSES,
+        attempts=attempts,
+        base_delay=base_delay,
+        clock=clock,
+    )
 
 
 class InMemoryHealthCheckClient:

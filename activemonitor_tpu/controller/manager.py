@@ -83,6 +83,10 @@ class Manager:
     ):
         self.client = client
         self.reconciler = reconciler
+        # failed-run requeues ride this manager's workqueue: per-key
+        # serialized, stop-aware, re-rate-limited on crash — never a
+        # loop inside a dying watch/timer task
+        reconciler.requeue_hook = self.enqueue
         self.max_parallel = max_parallel
         self._metrics_addr = metrics_bind_address
         self._health_addr = health_probe_bind_address

@@ -53,6 +53,7 @@ from activemonitor_tpu.controller.client import (
     HealthCheckClient,
     NotFoundError,
     retry_on_conflict,
+    retry_on_transient,
 )
 from activemonitor_tpu.controller.events import (
     EVENT_NORMAL,
@@ -101,6 +102,12 @@ class HealthCheckReconciler:
         self.clock = clock or Clock()
         self.timers = TimerWheel(self.clock)
         self._watch_tasks: Dict[str, asyncio.Task] = {}
+        # set by the Manager: routes failed-run requeues through its
+        # workqueue (per-key serialized, stop-aware, retried on crash)
+        # instead of a loop inside the dying task
+        self.requeue_hook = None
+        self._stopping = False
+        self._requeue_loops: set = set()  # standalone-mode fallback loops
 
     # ------------------------------------------------------------------
     # entry point (reference: Reconcile, healthcheck_controller.go:170-188)
@@ -332,17 +339,40 @@ class HealthCheckReconciler:
             self.recorder.event(
                 hc, EVENT_WARNING, "Warning", "Error executing Workflow"
             )
-            # deregister before requeueing: the in-flight guard must not
-            # see this (still-running) task and skip the retry
-            if self._watch_tasks.get(hc.key) is asyncio.current_task():
-                del self._watch_tasks[hc.key]
-            # keep requeueing until a reconcile lands cleanly — a single
-            # shot would strand the schedule if the API-server outage
-            # outlives one retry (the reference's workqueue re-rate-
-            # limits indefinitely; deletion ends the loop via None)
+            await self._requeue_until_clean(hc)
+
+    async def _requeue_until_clean(self, hc: HealthCheck) -> None:
+        """Put the check back on the reconcile path after a failed run —
+        and keep it there until a reconcile lands cleanly (a single
+        shot would strand the schedule if the API-server outage
+        outlives one retry; the reference's workqueue re-rate-limits
+        indefinitely, deletion ends the loop via None). Deregisters
+        this task from the in-flight table first: the guard must not
+        see a (still-running) requeue and skip the retry.
+
+        Under a Manager the requeue goes through its WORKQUEUE
+        (requeue_hook): per-key serialized against event-driven
+        reconciles, honors stop, and a crashed reconcile re-rate-limits
+        at 1 s — so no reconcile ever runs outside the queue's
+        discipline, and nothing outlives Manager.stop(). The in-task
+        loop remains only for standalone reconcilers (no Manager), is
+        tracked in ``_requeue_loops``, and exits on shutdown."""
+        if self._watch_tasks.get(hc.key) is asyncio.current_task():
+            del self._watch_tasks[hc.key]
+        if self.requeue_hook is not None:
+            await self.clock.sleep(1.0)
+            if not self._stopping:
+                self.requeue_hook(hc.metadata.namespace, hc.metadata.name)
+            return
+        current = asyncio.current_task()
+        if current is not None:
+            self._requeue_loops.add(current)
+        try:
             delay: Optional[float] = 1.0
-            while delay:
+            while delay and not self._stopping:
                 await self.clock.sleep(delay)
+                if self._stopping:
+                    return
                 try:
                     delay = await self.reconcile(
                         hc.metadata.namespace, hc.metadata.name
@@ -352,6 +382,9 @@ class HealthCheckReconciler:
                 except Exception:
                     log.exception("requeued reconcile of %s failed", hc.key)
                     delay = 1.0
+        finally:
+            if current is not None:
+                self._requeue_loops.discard(current)
 
     async def wait_watches(self) -> None:
         """Test/shutdown helper: wait for all in-flight watches."""
@@ -360,10 +393,12 @@ class HealthCheckReconciler:
             await asyncio.gather(*tasks, return_exceptions=True)
 
     async def shutdown(self) -> None:
-        for t in self._watch_tasks.values():
+        self._stopping = True
+        stragglers = list(self._watch_tasks.values()) + list(self._requeue_loops)
+        for t in stragglers:
             if not t.done():
                 t.cancel()
-        await asyncio.gather(*self._watch_tasks.values(), return_exceptions=True)
+        await asyncio.gather(*stragglers, return_exceptions=True)
         await self.timers.shutdown()
 
     # ------------------------------------------------------------------
@@ -382,20 +417,47 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
-            # NOTE: a transient engine error here deliberately PROPAGATES
-            # (unlike the remedy watch below): _watch_guarded aborts this
-            # attempt and requeues the whole check at the reference's 1s
-            # cadence (:204) — each retry gets a fresh poll window, so a
-            # long apiserver storm cannot eat the check's own timeout.
-            # The check's RBAC is not ephemeral, so aborting leaks nothing.
-            if timed_out:
-                # the deadline verdict must come from the API server,
-                # not a possibly-lagging watch cache: a terminal phase
-                # that landed during a watch reconnect gap must win
-                getter = getattr(self.engine, "get_fresh", self.engine.get)
-                workflow = await getter(wf_namespace, wf_name)
-            else:
-                workflow = await self.engine.get(wf_namespace, wf_name)
+            try:
+                if timed_out:
+                    # the deadline verdict must come from the API server,
+                    # not a possibly-lagging watch cache: a terminal phase
+                    # that landed during a watch reconnect gap must win
+                    getter = getattr(self.engine, "get_fresh", self.engine.get)
+                    workflow = await getter(wf_namespace, wf_name)
+                else:
+                    workflow = await self.engine.get(wf_namespace, wf_name)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # transient engine errors ride out IN PLACE at the 1s
+                # requeue cadence, bounded by this watch's own poll
+                # deadline — same policy as the remedy watch below.
+                # Propagating instead (the previous design) aborts to
+                # _watch_guarded, whose requeued reconcile has no idea
+                # a workflow is already in flight and SUBMITS A
+                # DUPLICATE for the same scheduled fire: under the
+                # chaos-soak's sustained 500 drizzle that measured 7
+                # duplicate submissions per recorded run. A storm that
+                # outlives the deadline still converges — synthesized
+                # Failed after one authoritative confirm-read, exactly
+                # like the remedy path.
+                log.warning(
+                    "transient error polling workflow %s/%s",
+                    wf_namespace,
+                    wf_name,
+                    exc_info=True,
+                )
+                # the deadline may pass during the storm, but the
+                # VERDICT never comes from a failed read: keep retrying
+                # the authoritative confirm-read at the 1s cadence until
+                # the API answers (the liveness of the old
+                # requeue-forever ladder, without its duplicates). The
+                # workflow's own activeDeadlineSeconds bounds the run
+                # server-side regardless.
+                await self.clock.sleep(1.0)
+                if ieb.expired():
+                    timed_out = True
+                continue
             if workflow is None:
                 # workflow GC'd / healthcheck deleted: swallow, no reschedule
                 # (reference: :618-623)
@@ -558,6 +620,12 @@ class HealthCheckReconciler:
                 self.recorder.event(
                     hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
                 )
+                # the timer entry is consumed, so bailing here would end
+                # the check's schedule FOREVER (the chaos-soak tier
+                # caught exactly this: a 500 on the timer-fired resubmit
+                # left dead schedules — owed run, no timer, no watch).
+                # Ride the same requeue ladder a failed watch uses.
+                await self._requeue_until_clean(hc)
                 return
             # already registered in _watch_tasks at the top, so
             # reconcile's in-flight guard and wait_watches() saw this
@@ -758,5 +826,13 @@ class HealthCheckReconciler:
             fresh.status = hc.status.model_copy(deep=True)
             return await self.client.update_status(fresh)
 
-        updated = await retry_on_conflict(attempt)
+        async def write():
+            return await retry_on_conflict(attempt)
+
+        # transient 5xx ride out IN PLACE: this write records a run
+        # that already happened, and losing it sends the requeue path
+        # back through a full reconcile that submits a DUPLICATE
+        # workflow for the same scheduled fire (the chaos-soak tier
+        # measured 26 submissions for 3 recorded runs without this)
+        updated = await retry_on_transient(write, clock=self.clock)
         hc.metadata.resource_version = updated.metadata.resource_version

@@ -93,7 +93,11 @@ async def wait_for(predicate, timeout=15.0, interval=0.05):
 
 def argo_player(server, api):
     """Background task playing the Argo controller: marks every
-    submitted Workflow Succeeded, forever (survives resubmissions)."""
+    submitted Workflow Succeeded, forever (survives resubmissions AND
+    injected faults — the real Argo controller's workqueue retries a
+    failed status write, so ours must too or a single chaos 500 would
+    silently kill the player mid-test)."""
+    from activemonitor_tpu.kube import ApiError
 
     async def play():
         done = set()
@@ -102,14 +106,17 @@ def argo_player(server, api):
                 name = wf["metadata"]["name"]
                 if name in done:
                     continue
-                done.add(name)
-                await api.merge_patch(
-                    api_path(
-                        WF_GROUP, WF_VERSION, WF_PLURAL,
-                        wf["metadata"]["namespace"], name, "status",
-                    ),
-                    {"status": {"phase": "Succeeded"}},
-                )
+                try:
+                    await api.merge_patch(
+                        api_path(
+                            WF_GROUP, WF_VERSION, WF_PLURAL,
+                            wf["metadata"]["namespace"], name, "status",
+                        ),
+                        {"status": {"phase": "Succeeded"}},
+                    )
+                    done.add(name)  # only after the write landed
+                except ApiError:
+                    continue  # chaos 500: retry on the next sweep
             await asyncio.sleep(0.05)
 
     return asyncio.create_task(play())
@@ -171,6 +178,10 @@ async def test_degraded_workflow_watch_full_lifecycle():
 
             hc = await wait_for(succeeded, timeout=30.0)
             assert hc.status.success_count == 1
+            # transient poll errors ride out IN PLACE: the storm must
+            # not have produced duplicate submissions for this one
+            # scheduled fire
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 1
         finally:
             dropper_running = False
             drop_task.cancel()
@@ -404,3 +415,127 @@ async def test_ha_failover_without_double_submission():
                 b_start.cancel()
             await mgr_b.stop()
             await api_b.close()
+
+
+@pytest.mark.asyncio
+async def test_chaos_soak_sustained_faults_over_simulated_time():
+    """The chaos scenarios above are one-shot; this tier sustains them:
+    30 simulated minutes, 12 checks on a 300 s cadence, and EVERY
+    simulated minute injects a fresh fault burst — 500s on workflow
+    reads, 500s on status writes, dropped watch streams, with uniform
+    latency for the middle third. Quantified recovery: every check
+    keeps making scheduled progress (no dead schedule), nothing
+    double-submits past the cadence ceiling, and the server's live
+    watch connections stay bounded (reconnects replace, never
+    accumulate)."""
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    N = 12
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        client = KubernetesHealthCheckClient(api)
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=ArgoWorkflowEngine(api),
+            rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+            recorder=KubernetesEventRecorder(api),
+            metrics=MetricsCollector(),
+            clock=clock,
+        )
+        manager = Manager(client=client, reconciler=reconciler, max_parallel=6)
+        await manager.start()
+        player = argo_player(server, api)
+        try:
+            for i in range(N):
+                hc = chaos_check(f"chaos-soak-{i:02d}")
+                hc.spec.repeat_after_sec = 300
+                hc.spec.workflow.generate_name = f"chaos-soak-{i:02d}-"
+                hc.spec.workflow.timeout = 120  # chaos targets the API,
+                # not Argo slowness — keep synthesized timeouts out
+                await client.apply(hc)
+            await asyncio.sleep(0.3)
+
+            for minute in range(30):
+                # a fresh storm every simulated minute — but only once
+                # the last one was consumed. An unbounded fault backlog
+                # is not "sustained chaos", it is a permanently-down
+                # API for writes, which no controller (reference
+                # included) can make durable progress against.
+                if not any(f["remaining"] > 0 for f in server.faults):
+                    server.faults.clear()
+                    server.inject_fault(
+                        "/workflows", status=500, times=2, method="GET"
+                    )
+                    server.inject_fault(
+                        "/status", status=500, times=2, method="PATCH"
+                    )
+                    if minute % 3 == 0:
+                        server.inject_fault(
+                            f"/{WF_PLURAL}", status=500, times=2, method="POST"
+                        )
+                if minute % 5 == 0:
+                    server.drop_watches()
+                server.latency = 0.02 if 10 <= minute < 20 else 0.0
+                # watch-recovery backoffs sleep in REAL seconds: each
+                # simulated minute gets ~0.5 s of real air so recovery
+                # ladders can climb between storms
+                for _ in range(4):  # 4 x 15 s = one simulated minute
+                    await clock.advance(15)
+                    await asyncio.sleep(0.12)
+            server.latency = 0.0
+            server.faults.clear()
+            # quiesce: let in-flight runs, retries, and real-time watch
+            # reconnects complete
+            for _ in range(10):
+                await clock.advance(15)
+                await asyncio.sleep(0.15)
+            await reconciler.wait_watches()
+
+            for i in range(N):
+                name = f"chaos-soak-{i:02d}"
+                hc = await client.get("health", name)
+                runs = hc.status.total_healthcheck_runs
+                # 300 s cadence over 1800 s: every check must have kept
+                # its schedule alive through the storms (>=4 runs), and
+                # the retry ladder must not have double-submitted (<=9)
+                assert 4 <= runs <= 9, (name, runs, hc.status)
+                assert hc.status.status == "Succeeded", (name, hc.status)
+            assert server.live_watch_count() <= 4, server.live_watch_count()
+        finally:
+            player.cancel()
+            await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_timer_fired_resubmit_survives_submit_500s():
+    """A 500 storm hitting the TIMER-fired resubmission (not the first
+    submit) must not end the schedule: the timer entry is consumed, so
+    without the requeue ladder this is a permanently dead check —
+    owed run, no timer, no watch (the dead-schedule shape the
+    chaos-soak tier first caught)."""
+    async with stub_env() as (server, api):
+        client, manager = build_controller(api)
+        await manager.start()
+        player = argo_player(server, api)
+        try:
+            hc = chaos_check("timer-resubmit")
+            hc.spec.repeat_after_sec = 2  # fast cadence, real clock
+            await client.apply(hc)
+
+            async def first_done():
+                got = await client.get("health", "timer-resubmit")
+                return got if got and got.status.total_healthcheck_runs >= 1 else None
+
+            await wait_for(first_done, timeout=20.0)
+            # every submit for the next little while fails
+            server.inject_fault(f"/{WF_PLURAL}", status=500, times=3, method="POST")
+
+            async def second_done():
+                got = await client.get("health", "timer-resubmit")
+                return got if got and got.status.total_healthcheck_runs >= 2 else None
+
+            got = await wait_for(second_done, timeout=30.0)
+            assert got.status.status == "Succeeded"
+        finally:
+            player.cancel()
+            await manager.stop()

@@ -724,3 +724,45 @@ async def test_remedy_terminal_phase_on_final_poll_wins_over_timeout():
     assert st.remedy_status == "Succeeded"
     assert st.remedy_success_count == 1
     assert st.remedy_failed_count == 0
+
+
+@pytest.mark.asyncio
+async def test_shutdown_ends_standalone_requeue_loops():
+    """A standalone reconciler (no Manager workqueue) whose timer-fired
+    resubmit keeps failing lives in the in-task requeue ladder;
+    shutdown() must end that loop promptly — it may not keep
+    reconciling (and attempting submits) after the controller stopped.
+    With a Manager the loop never exists: requeues ride the workqueue
+    (requeue_hook)."""
+    h = Harness(succeed_after(1))
+
+    class FailSecondSubmitEngine:
+        """First submit works (run 1 completes + reschedules); every
+        later submit explodes, so the timer-fired resubmit falls onto
+        the requeue ladder and stays there."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.attempts = 0
+
+        async def submit(self, manifest):
+            self.attempts += 1
+            if self.attempts > 1:
+                raise RuntimeError("boom")
+            return await self._inner.submit(manifest)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    h.reconciler.engine = FailSecondSubmitEngine(h.engine)
+    await h.apply_and_reconcile(make_hc())  # run 1 completes
+    await h.settle(61.0)  # timer fires; resubmit fails -> ladder
+    for _ in range(3):
+        await h.settle(2.0)  # the ladder keeps retrying at 1 s cadence
+    assert h.reconciler.engine.attempts >= 3, h.reconciler.engine.attempts
+    await h.reconciler.shutdown()
+    assert not h.reconciler._requeue_loops
+    # nothing reconciles after shutdown even if time keeps passing
+    before = h.reconciler.engine.attempts
+    await h.settle(10.0)
+    assert h.reconciler.engine.attempts == before
