@@ -50,6 +50,7 @@ from activemonitor_tpu.api.types import (
     WORKFLOW_TYPE_REMEDY,
 )
 from activemonitor_tpu.controller.client import (
+    TRANSIENT_STATUSES,
     HealthCheckClient,
     NotFoundError,
     retry_on_conflict,
@@ -359,14 +360,21 @@ class HealthCheckReconciler:
         tracked in ``_requeue_loops``, and exits on shutdown."""
         if self._watch_tasks.get(hc.key) is asyncio.current_task():
             del self._watch_tasks[hc.key]
-        if self.requeue_hook is not None:
-            await self.clock.sleep(1.0)
-            if not self._stopping:
-                self.requeue_hook(hc.metadata.namespace, hc.metadata.name)
-            return
         current = asyncio.current_task()
         if current is not None:
+            # tracked for BOTH paths: the hook path's 1 s sleeper was
+            # deregistered from _watch_tasks above, so without this it
+            # would be invisible to shutdown() and outlive stop()
             self._requeue_loops.add(current)
+        if self.requeue_hook is not None:
+            try:
+                await self.clock.sleep(1.0)
+                if not self._stopping:
+                    self.requeue_hook(hc.metadata.namespace, hc.metadata.name)
+            finally:
+                if current is not None:
+                    self._requeue_loops.discard(current)
+            return
         try:
             delay: Optional[float] = 1.0
             while delay and not self._stopping:
@@ -401,6 +409,60 @@ class HealthCheckReconciler:
         await asyncio.gather(*stragglers, return_exceptions=True)
         await self.timers.shutdown()
 
+    async def _poll_workflow(
+        self,
+        wf_namespace: str,
+        wf_name: str,
+        ieb: InverseExpBackoff,
+        timed_out: bool,
+        *,
+        storm_rides_past_deadline: bool,
+        what: str = "workflow",
+    ):
+        """One poll step shared by the healthcheck and remedy watches —
+        the error policy lives HERE so the two loops cannot drift:
+
+        - pre-deadline errors always retry in place at the 1 s requeue
+          cadence (aborting to a requeued reconcile submits a DUPLICATE
+          workflow for the same fire — the defect the chaos soak found);
+        - past the deadline, the verdict comes from an authoritative
+          confirm-read. A TRANSIENT error (5xx/429) retries that read
+          when ``storm_rides_past_deadline`` (healthcheck watch: the
+          liveness of the old requeue-forever ladder, without its
+          duplicates); a DETERMINISTIC error (4xx, code bug) — or any
+          error on the remedy path, whose ephemeral WRITE-capable RBAC
+          must not stay alive under an unbounded storm — stops
+          retrying, and the caller synthesizes Failed.
+
+        Returns ``(workflow, timed_out, retry)``; ``retry=True`` means
+        the caller should ``continue`` its loop (workflow is None then).
+        """
+        try:
+            if timed_out:
+                # the deadline verdict must come from the API server,
+                # not a possibly-lagging watch cache: a terminal phase
+                # that landed during a watch reconnect gap must win
+                getter = getattr(self.engine, "get_fresh", self.engine.get)
+                return await getter(wf_namespace, wf_name), timed_out, False
+            return await self.engine.get(wf_namespace, wf_name), timed_out, False
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            transient = getattr(e, "status", None) in TRANSIENT_STATUSES
+            log.warning(
+                "transient error polling %s %s/%s",
+                what,
+                wf_namespace,
+                wf_name,
+                exc_info=True,
+            )
+            if timed_out and not (transient and storm_rides_past_deadline):
+                return {}, timed_out, False  # caller synthesizes Failed
+            await self.clock.sleep(1.0)
+            if ieb.expired():
+                timed_out = True
+            return None, timed_out, True
+
     # ------------------------------------------------------------------
     # watch + status + reschedule (reference: watchWorkflowReschedule, :607-757)
     # ------------------------------------------------------------------
@@ -417,46 +479,11 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
-            try:
-                if timed_out:
-                    # the deadline verdict must come from the API server,
-                    # not a possibly-lagging watch cache: a terminal phase
-                    # that landed during a watch reconnect gap must win
-                    getter = getattr(self.engine, "get_fresh", self.engine.get)
-                    workflow = await getter(wf_namespace, wf_name)
-                else:
-                    workflow = await self.engine.get(wf_namespace, wf_name)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                # transient engine errors ride out IN PLACE at the 1s
-                # requeue cadence, bounded by this watch's own poll
-                # deadline — same policy as the remedy watch below.
-                # Propagating instead (the previous design) aborts to
-                # _watch_guarded, whose requeued reconcile has no idea
-                # a workflow is already in flight and SUBMITS A
-                # DUPLICATE for the same scheduled fire: under the
-                # chaos-soak's sustained 500 drizzle that measured 7
-                # duplicate submissions per recorded run. A storm that
-                # outlives the deadline still converges — synthesized
-                # Failed after one authoritative confirm-read, exactly
-                # like the remedy path.
-                log.warning(
-                    "transient error polling workflow %s/%s",
-                    wf_namespace,
-                    wf_name,
-                    exc_info=True,
-                )
-                # the deadline may pass during the storm, but the
-                # VERDICT never comes from a failed read: keep retrying
-                # the authoritative confirm-read at the 1s cadence until
-                # the API answers (the liveness of the old
-                # requeue-forever ladder, without its duplicates). The
-                # workflow's own activeDeadlineSeconds bounds the run
-                # server-side regardless.
-                await self.clock.sleep(1.0)
-                if ieb.expired():
-                    timed_out = True
+            workflow, timed_out, retry = await self._poll_workflow(
+                wf_namespace, wf_name, ieb, timed_out,
+                storm_rides_past_deadline=True,
+            )
+            if retry:
                 continue
             if workflow is None:
                 # workflow GC'd / healthcheck deleted: swallow, no reschedule
@@ -719,38 +746,16 @@ class HealthCheckReconciler:
         timed_out = False
         while True:
             now = self.clock.now()
-            try:
-                if timed_out:
-                    # the deadline verdict must come from the API server,
-                    # not a possibly-lagging watch cache: a terminal phase
-                    # that landed during a watch reconnect gap must win
-                    getter = getattr(self.engine, "get_fresh", self.engine.get)
-                    workflow = await getter(wf_namespace, wf_name)
-                else:
-                    workflow = await self.engine.get(wf_namespace, wf_name)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                # transient errors must not abort the remedy watch: the
-                # finally in _process_remedy would tear down the WRITE-
-                # capable RBAC while the remedy workflow is still running
-                # and strand its later steps. Retry at the 1s requeue
-                # cadence; a persistent outage ends via the deadline
-                # (≈ the workflow's own activeDeadlineSeconds, so Argo
-                # is killing it too) and only then is the ephemeral
-                # identity reclaimed.
-                log.warning(
-                    "transient error polling remedy workflow %s/%s",
-                    wf_namespace,
-                    wf_name,
-                    exc_info=True,
-                )
-                if not timed_out:
-                    await self.clock.sleep(1.0)
-                    if ieb.expired():
-                        timed_out = True
-                    continue
-                workflow = {}  # deadline passed, confirm-read failed too
+            workflow, timed_out, retry = await self._poll_workflow(
+                wf_namespace, wf_name, ieb, timed_out,
+                # the finally in _process_remedy would otherwise hold the
+                # WRITE-capable ephemeral RBAC alive under an unbounded
+                # storm — the remedy path always converges at the deadline
+                storm_rides_past_deadline=False,
+                what="remedy workflow",
+            )
+            if retry:
+                continue
             if workflow is None:
                 return  # parent deleted / GC'd (reference: :806-810)
             status = workflow.get("status") or {}

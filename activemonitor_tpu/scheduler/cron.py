@@ -133,7 +133,16 @@ class ZonedSchedule:
     def next(self, after: datetime.datetime) -> datetime.datetime:
         if after.tzinfo is None:
             after = after.replace(tzinfo=datetime.timezone.utc)
-        return self.inner.next(after.astimezone(self.zone))
+        fire = self.inner.next(after.astimezone(self.zone))
+        # DST canonicalization: a fire computed inside the spring-
+        # forward gap (e.g. 02:30 on the skip day) is a NONEXISTENT
+        # wall time that zoneinfo renders with the pre-transition
+        # offset. Round-tripping through UTC maps it to the true
+        # instant's canonical rendering (02:30 EST -> 03:30 EDT), the
+        # same normalization Go's time.Date gives the reference's cron.
+        # Idempotent for every real wall time, and it keeps chained
+        # next(next(...)) calls monotonic in UTC across the gap.
+        return fire.astimezone(datetime.timezone.utc).astimezone(self.zone)
 
 
 def _parse_value(token: str, names: dict, what: str) -> int:

@@ -186,3 +186,52 @@ def test_tz_prefix_rejects_stacking_and_naive_seconds_until_next():
         "CRON_TZ=UTC 30 12 * * *", datetime.datetime(2026, 5, 1, 12, 0)
     )
     assert delta == 30 * 60 + 1
+
+
+def test_dst_spring_forward_gap_fire_is_canonical():
+    """US spring forward (2026-03-08, 02:00 EST -> 03:00 EDT): a fire
+    scheduled inside the skipped hour lands on the canonical
+    post-transition wall time (03:30 EDT) — the same normalization
+    Go's time.Date gives the reference's robfig cron — never a
+    nonexistent 02:30-05:00 rendering."""
+    s = parse_cron("TZ=America/New_York 30 2 * * *")
+    after = datetime.datetime(2026, 3, 7, 12, 0, tzinfo=datetime.timezone.utc)
+    fire = s.next(after)
+    assert fire.isoformat() == "2026-03-08T03:30:00-04:00"
+    # the day after, the schedule is back on its nominal wall time
+    fire2 = s.next(fire)
+    assert fire2.isoformat() == "2026-03-09T02:30:00-04:00"
+
+
+def test_dst_spring_forward_chained_fires_stay_monotonic_in_utc():
+    """Chaining next(next(...)) across the gap must be strictly
+    monotonic in REAL time — before canonicalization the gap produced
+    duplicate UTC instants rendered as different wall times."""
+    s = parse_cron("TZ=America/New_York */30 * * * *")
+    t = datetime.datetime(2026, 3, 8, 6, 45, tzinfo=datetime.timezone.utc)
+    instants = []
+    for _ in range(5):
+        t = s.next(t)
+        instants.append(t.astimezone(datetime.timezone.utc))
+    assert instants == sorted(set(instants)), instants
+    # half-hourly through the skip: 07:00Z (02:00 EST) then straight
+    # into EDT wall times — 30 real minutes apart throughout
+    deltas = {
+        (b - a).total_seconds() for a, b in zip(instants, instants[1:])
+    }
+    assert deltas == {1800.0}, instants
+
+
+def test_dst_fall_back_ambiguous_fire_runs_once():
+    """US fall back (2026-11-01, 02:00 EDT -> 01:00 EST): 01:30 exists
+    twice; the schedule fires ONCE (first occurrence) and resumes the
+    next day — no double-fire for the repeated hour."""
+    s = parse_cron("TZ=America/New_York 30 1 * * *")
+    t = datetime.datetime(2026, 10, 31, 12, 0, tzinfo=datetime.timezone.utc)
+    first = s.next(t)
+    assert (
+        first.astimezone(datetime.timezone.utc).isoformat()
+        == "2026-11-01T05:30:00+00:00"  # 01:30 EDT, the first pass
+    )
+    second = s.next(first)
+    assert second.date().isoformat() == "2026-11-02"

@@ -766,3 +766,39 @@ async def test_shutdown_ends_standalone_requeue_loops():
     before = h.reconciler.engine.attempts
     await h.settle(10.0)
     assert h.reconciler.engine.attempts == before
+
+
+@pytest.mark.asyncio
+async def test_persistent_deterministic_poll_error_converges():
+    """engine.get failing FOREVER with a non-transient error (revoked
+    RBAC, a code bug) must not wedge the watch in silent 1 s retries:
+    past the poll deadline the run synthesizes Failed and the schedule
+    keeps going. (Transient 5xx storms, by contrast, deliberately ride
+    past the deadline — the chaos tier pins that side.)"""
+    h = Harness(succeed_after(1))
+
+    class BrokenGetEngine:
+        def __init__(self, inner):
+            self._inner = inner
+
+        async def submit(self, manifest):
+            return await self._inner.submit(manifest)
+
+        async def get(self, namespace, name):
+            raise RuntimeError("deterministic boom")  # no .status attr
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    h.reconciler.engine = BrokenGetEngine(h.engine)
+    await h.apply_and_reconcile(make_hc(timeout=5))
+    # ride far past the poll deadline: 1 s retries, then the failed
+    # authoritative confirm-read, then the synthesized verdict
+    for _ in range(6):
+        await h.settle(5.0)
+    status = await h.status()
+    assert status.status == "Failed", status
+    assert status.failed_count == 1, status
+    assert status.total_healthcheck_runs == 1
+    # the schedule survived: the next run is armed
+    assert h.reconciler.timers.pending("health/hc-a")
