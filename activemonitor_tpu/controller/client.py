@@ -98,6 +98,14 @@ async def retry_on_conflict(
 TRANSIENT_STATUSES = frozenset({429, 500, 502, 503, 504})
 
 
+def is_transient(e: Exception) -> bool:
+    """The one duck-typed transient classification (an exception's
+    ``status`` attribute against TRANSIENT_STATUSES) — shared by the
+    retry ladder below and the reconciler's watch loops so the two can
+    never disagree on what counts as retryable."""
+    return getattr(e, "status", None) in TRANSIENT_STATUSES
+
+
 async def retry_on_transient(
     fn, *, attempts: int = 6, base_delay: float = 0.25, clock=None
 ):
@@ -113,7 +121,7 @@ async def retry_on_transient(
     at-least-once semantics."""
     return await _retry(
         fn,
-        retryable=lambda e: getattr(e, "status", None) in TRANSIENT_STATUSES,
+        retryable=is_transient,
         attempts=attempts,
         base_delay=base_delay,
         clock=clock,

@@ -50,9 +50,9 @@ from activemonitor_tpu.api.types import (
     WORKFLOW_TYPE_REMEDY,
 )
 from activemonitor_tpu.controller.client import (
-    TRANSIENT_STATUSES,
     HealthCheckClient,
     NotFoundError,
+    is_transient,
     retry_on_conflict,
     retry_on_transient,
 )
@@ -258,9 +258,34 @@ class HealthCheckReconciler:
     # ------------------------------------------------------------------
     # submit (reference: createSubmitWorkflow, :502-534)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _is_url_source(workflow_spec) -> bool:
+        resource = getattr(workflow_spec, "resource", None)
+        source = getattr(resource, "source", None)
+        if getattr(source, "inline", None):
+            # mirrors get_artifact_reader's dispatch priority: inline
+            # wins over url, and inline does zero I/O
+            return False
+        url = getattr(source, "url", None)
+        return bool(getattr(url, "path", ""))
+
+    async def _parse_manifest(self, parser, hc: HealthCheck, workflow_spec):
+        """A url-source artifact fetch is a BLOCKING requests.get with
+        a 30 s timeout — run inline on the loop it would freeze every
+        other check, the watches, AND lease renewal (whose ~2/3-lease
+        deadline a slow artifact server could eat, costing leadership
+        for a fetch). Only the url case pays the thread hop: inline and
+        local-file sources stay synchronous, keeping fake-clock tests
+        deterministic."""
+        if self._is_url_source(workflow_spec):
+            return await asyncio.to_thread(parser, hc)
+        return parser(hc)
+
     async def _submit_workflow(self, hc: HealthCheck) -> str:
         try:
-            manifest = parse_workflow_from_healthcheck(hc)
+            manifest = await self._parse_manifest(
+                parse_workflow_from_healthcheck, hc, hc.spec.workflow
+            )
         except Exception:
             self.recorder.event(
                 hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
@@ -448,12 +473,18 @@ class HealthCheckReconciler:
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            transient = getattr(e, "status", None) in TRANSIENT_STATUSES
+            transient = is_transient(e)
             log.warning(
-                "transient error polling %s %s/%s",
+                "%s error polling %s %s/%s%s",
+                "transient" if transient else "deterministic",
                 what,
                 wf_namespace,
                 wf_name,
+                (
+                    "; giving up on this run (synthesizing Failed)"
+                    if timed_out and not (transient and storm_rides_past_deadline)
+                    else "; retrying"
+                ),
                 exc_info=True,
             )
             if timed_out and not (transient and storm_rides_past_deadline):
@@ -709,7 +740,11 @@ class HealthCheckReconciler:
         # healthcheck_controller.go:773-784; we close it)
         try:
             try:
-                manifest = parse_remedy_workflow_from_healthcheck(hc)
+                manifest = await self._parse_manifest(
+                    parse_remedy_workflow_from_healthcheck,
+                    hc,
+                    hc.spec.remedy_workflow,
+                )
             except Exception:
                 self.recorder.event(
                     hc,

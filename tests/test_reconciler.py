@@ -802,3 +802,62 @@ async def test_persistent_deterministic_poll_error_converges():
     assert status.total_healthcheck_runs == 1
     # the schedule survived: the next run is armed
     assert h.reconciler.timers.pending("health/hc-a")
+
+
+@pytest.mark.asyncio
+async def test_slow_url_artifact_does_not_block_the_event_loop():
+    """A url-source artifact fetch is a BLOCKING requests.get; run
+    inline on the loop, a slow artifact server would freeze every
+    other check, the watches, and lease renewal (a ~1 s stall already
+    eats a sixth of a 10 s lease's renew deadline). The parse must
+    ride a worker thread: while the fetch drags, loop heartbeats keep
+    ticking."""
+    import threading
+    import time as time_mod
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    WF = b"apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec: {}\n"
+
+    class SlowHandler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            time_mod.sleep(1.2)  # a slow artifact server
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(WF)
+
+        def log_message(self, *args):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), SlowHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        h = Harness(succeed_after(1))
+        hc = make_hc()
+        hc.spec.workflow.resource.source.inline = None
+        from activemonitor_tpu.api.types import URLArtifact
+
+        hc.spec.workflow.resource.source.url = URLArtifact(
+            path=f"http://127.0.0.1:{srv.server_port}/wf.yaml"
+        )
+        created = await h.client.apply(hc)
+
+        heartbeats = []
+
+        async def heartbeat():
+            loop = asyncio.get_event_loop()
+            last = loop.time()
+            while True:
+                await asyncio.sleep(0.05)
+                now = loop.time()
+                heartbeats.append(now - last)
+                last = now
+
+        hb = asyncio.create_task(heartbeat())
+        await h.reconciler.reconcile(created.namespace, created.name)
+        await h.reconciler.wait_watches()
+        hb.cancel()
+        assert (await h.status()).status == "Succeeded"
+        # the loop never stalled anywhere near the fetch duration
+        assert heartbeats and max(heartbeats) < 0.6, max(heartbeats)
+    finally:
+        srv.shutdown()
