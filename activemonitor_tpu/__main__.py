@@ -598,6 +598,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "get": _get,
         "describe": _describe,
     }[args.command]
+    if args.command == "run":
+        # pre-import the controller's heavy dependency graph BEFORE the
+        # event loop exists: the per-verb lazy imports keep `--help`/
+        # `version`/`crd` fast, but resolved on-loop they block it for
+        # ~0.7 s right as the controller starts (pydantic, prometheus,
+        # requests, the reconciler graph). Harmless here — this process
+        # is about to run a controller anyway.
+        import activemonitor_tpu.controller.manager  # noqa: F401
+        import activemonitor_tpu.controller.reconciler  # noqa: F401
+        import activemonitor_tpu.engine.argo  # noqa: F401
+        import activemonitor_tpu.engine.local  # noqa: F401
+        import activemonitor_tpu.metrics.collector  # noqa: F401
     from activemonitor_tpu.errors import MissingDependencyError
 
     from activemonitor_tpu.errors import ConfigurationError

@@ -258,26 +258,19 @@ class HealthCheckReconciler:
     # ------------------------------------------------------------------
     # submit (reference: createSubmitWorkflow, :502-534)
     # ------------------------------------------------------------------
-    @staticmethod
-    def _is_url_source(workflow_spec) -> bool:
-        resource = getattr(workflow_spec, "resource", None)
-        source = getattr(resource, "source", None)
-        if getattr(source, "inline", None):
-            # mirrors get_artifact_reader's dispatch priority: inline
-            # wins over url, and inline does zero I/O
-            return False
-        url = getattr(source, "url", None)
-        return bool(getattr(url, "path", ""))
-
     async def _parse_manifest(self, parser, hc: HealthCheck, workflow_spec):
-        """A url-source artifact fetch is a BLOCKING requests.get with
-        a 30 s timeout — run inline on the loop it would freeze every
-        other check, the watches, AND lease renewal (whose ~2/3-lease
-        deadline a slow artifact server could eat, costing leadership
-        for a fetch). Only the url case pays the thread hop: inline and
-        local-file sources stay synchronous, keeping fake-clock tests
-        deterministic."""
-        if self._is_url_source(workflow_spec):
+        """A url/file artifact read is BLOCKING I/O (requests.get with
+        a 30 s timeout; a possibly-NFS disk read) — run inline on the
+        loop it would freeze every other check, the watches, AND lease
+        renewal (whose ~2/3-lease deadline a slow artifact server could
+        eat, costing leadership for a fetch). Only the I/O-bearing
+        sources pay the thread hop — the store layer owns that
+        classification next to its reader dispatch — so inline-source
+        fake-clock tests stay deterministic."""
+        from activemonitor_tpu.store import is_blocking_source
+
+        resource = getattr(workflow_spec, "resource", None)
+        if is_blocking_source(getattr(resource, "source", None)):
             return await asyncio.to_thread(parser, hc)
         return parser(hc)
 

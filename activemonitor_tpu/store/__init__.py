@@ -5,7 +5,12 @@ plus a real file reader (the reference declares the File field but never
 implements it — SURVEY.md §2 #12).
 """
 
-from activemonitor_tpu.store.base import ArtifactReader, UnknownArtifactLocation, get_artifact_reader
+from activemonitor_tpu.store.base import (
+    ArtifactReader,
+    UnknownArtifactLocation,
+    get_artifact_reader,
+    is_blocking_source,
+)
 from activemonitor_tpu.store.inline import InlineReader
 from activemonitor_tpu.store.file import FileReader
 from activemonitor_tpu.store.url import URLReader
@@ -17,4 +22,5 @@ __all__ = [
     "URLReader",
     "UnknownArtifactLocation",
     "get_artifact_reader",
+    "is_blocking_source",
 ]

@@ -37,3 +37,18 @@ def get_artifact_reader(loc: ArtifactLocation) -> ArtifactReader:
     if loc.file is not None:
         return FileReader(loc.file)
     raise UnknownArtifactLocation(f"unknown artifact location: {loc!r}")
+
+
+def is_blocking_source(loc) -> bool:
+    """True when reading this location performs real I/O (an HTTP
+    fetch, a disk/NFS read) — callers on an event loop should move the
+    read to a worker thread. Lives NEXT TO the dispatch above so the
+    two can never disagree about which reader a spec resolves to:
+    inline wins over everything and does zero I/O; every other reader
+    blocks."""
+    if loc is None or getattr(loc, "inline", None) is not None:
+        return False
+    return (
+        getattr(loc, "url", None) is not None
+        or getattr(loc, "file", None) is not None
+    )

@@ -857,7 +857,9 @@ async def test_slow_url_artifact_does_not_block_the_event_loop():
         await h.reconciler.wait_watches()
         hb.cancel()
         assert (await h.status()).status == "Succeeded"
-        # the loop never stalled anywhere near the fetch duration
-        assert heartbeats and max(heartbeats) < 0.6, max(heartbeats)
+        # the loop never stalled anywhere near the fetch duration — a
+        # blocked loop shows a ~1.2 s gap; the bound is relative to the
+        # fetch so CI scheduler hiccups don't flake the signal
+        assert heartbeats and max(heartbeats) < 0.9, max(heartbeats)
     finally:
         srv.shutdown()
