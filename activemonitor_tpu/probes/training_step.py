@@ -16,6 +16,7 @@ scaling-book recipe rather than hand-written communication.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Optional
 
@@ -40,6 +41,8 @@ from activemonitor_tpu.utils.timing import (
     CHAIN_RETRIES,
     needs_longer_chain,
 )
+
+log = logging.getLogger("activemonitor.probes")
 
 
 def build_sharded_train_step(
@@ -367,19 +370,93 @@ def restore_train_state(directory: str, params_like, opt_state_like,
     restores cleanly onto dp=4×tp=2, ZeRO-1 on or off — values
     identical, layout the new mesh's. Elastic resume is a restore-time
     property, not a save-time decision. ``step`` None restores the
-    latest committed checkpoint."""
+    newest RESTORABLE checkpoint: a step directory poisoned by a crash
+    (present but empty/truncated — orbax's tmp-dir rename prevents
+    most of these, not a filesystem dying mid-rename) is skipped with
+    a warning and restore falls back to the next older step, so one
+    bad directory cannot brick resume while durable state exists. An
+    EXPLICIT ``step`` raises as-is — the caller asked for exactly
+    that state and silently substituting another would be worse."""
     import orbax.checkpoint as ocp
+    from etils import epath  # orbax dependency; URI-safe (gs://, s3://)
 
     targets = restore_targets({"params": params_like, "opt": opt_state_like})
+    root = epath.Path(directory)
+
+    def scan_steps() -> list:
+        if not root.is_dir():
+            return []
+        return sorted(
+            (
+                int(p.name)
+                for p in root.iterdir()
+                if p.is_dir() and p.name.isdigit()
+            ),
+            reverse=True,
+        )
+
+    def direct(ckptr, s: int):
+        # the degraded path hardcodes CheckpointManager's current item
+        # layout (<dir>/<step>/default); it only runs AFTER the
+        # layout-agnostic manager restore failed, so an orbax layout
+        # change degrades this fallback, never the healthy path
+        item = root / str(s) / "default"
+        restored = ckptr.restore(
+            item if item.exists() else root / str(s), targets
+        )
+        return restored["params"], restored["opt"], s
+
     with ocp.CheckpointManager(directory) as manager:
-        if step is None:
-            step = manager.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no committed checkpoint under {directory!r}"
+        if step is not None:
+            try:
+                restored = manager.restore(
+                    step, args=ocp.args.StandardRestore(targets)
                 )
-        restored = manager.restore(step, args=ocp.args.StandardRestore(targets))
-    return restored["params"], restored["opt"], step
+                return restored["params"], restored["opt"], step
+            except Exception:
+                # the manager infers structure from the WHOLE directory,
+                # so a poisoned SIBLING step can break it for a healthy
+                # requested step — one direct attempt tells them apart;
+                # a genuinely-bad requested step raises from here
+                with ocp.StandardCheckpointer() as ckptr:
+                    return direct(ckptr, step)
+        latest = manager.latest_step()
+        if latest is not None:
+            try:
+                restored = manager.restore(
+                    latest, args=ocp.args.StandardRestore(targets)
+                )
+                return restored["params"], restored["opt"], latest
+            except Exception as e:
+                log.warning(
+                    "latest checkpoint step %s under %s is unrestorable "
+                    "(%s); scanning older steps directly",
+                    latest, directory, e,
+                )
+    steps = scan_steps()
+    if not steps:
+        raise FileNotFoundError(
+            f"no committed checkpoint under {directory!r}"
+        )
+    # degraded path: per-step restores are immune to a poisoned sibling
+    # (a crash between mkdir and data, a filesystem dying mid-rename)
+    last_exc: Exception | None = None
+    with ocp.StandardCheckpointer() as ckptr:
+        for candidate in steps:
+            try:
+                return direct(ckptr, candidate)
+            except Exception as e:
+                last_exc = e
+                log.warning(
+                    "checkpoint step %s under %s is unrestorable (%s); "
+                    "trying the next older step",
+                    candidate, directory, e,
+                )
+    # every step failed: a systemic problem (wrong templates, storage
+    # outage), NOT an empty directory — surface the real error rather
+    # than a FileNotFoundError a resume harness would read as
+    # "cold start, reinitialize"
+    raise last_exc  # type: ignore[misc]
 
 
 def _opt_shardings(opt_state, param_sh, replicated, state_sh=None):

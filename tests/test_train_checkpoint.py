@@ -142,3 +142,50 @@ def test_step_numbered_retention_and_explicit_restore(tmp_path):
     # step 1 aged out under keep=2
     with pytest.raises(Exception):
         restore_train_state(str(tmp_path / "ckpt"), p_like, o_like, step=1)
+
+
+def test_poisoned_step_dir_falls_back_to_previous(tmp_path):
+    """A step directory that EXISTS but cannot be restored (a crash
+    between mkdir and data, a filesystem dying mid-rename, manual
+    tampering) must not brick resume: restore-latest skips it with a
+    warning and lands on the newest restorable step. Orbax's tmp-dir
+    rename already hides interrupted saves; this covers the shapes it
+    can't. An explicit step= request still raises."""
+    import pathlib
+    import shutil
+
+    cfg = tiny_config()
+    mesh = make_2d_mesh()
+    step, params, opt, data_sh = build_sharded_train_step(cfg, mesh)
+    tokens = _tokens(data_sh)
+    d = str(tmp_path / "ckpt")
+    for i in (1, 2):
+        params, opt, _ = step(params, opt, tokens)
+        save_train_state(d, params, opt, step=i)
+    root = pathlib.Path(d)
+    # interrupted-save debris (orbax tmp dir): invisible to restore
+    shutil.copytree(root / "2", root / "3.orbax-checkpoint-tmp-99")
+    # the nastier shape: a committed-LOOKING but empty step dir
+    (root / "4").mkdir()
+
+    p_like, o_like = train_state_templates(cfg, mesh)
+    restored, _, at = restore_train_state(d, p_like, o_like)
+    assert at == 2  # fell back past the poisoned step 4
+    drift = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(jax.tree.leaves(jax.device_get(params)),
+                        jax.tree.leaves(restored))
+    )
+    assert drift == 0.0
+    # asking for the poisoned step EXPLICITLY surfaces the error
+    with pytest.raises(Exception):
+        restore_train_state(d, p_like, o_like, step=4)
+    # a SYSTEMIC failure (wrong templates) must surface as the real
+    # error, never FileNotFoundError — a resume harness reads that as
+    # "cold start, reinitialize" and would silently discard progress
+    bad_cfg = tiny_config()
+    bad_cfg = bad_cfg.__class__(**{**bad_cfg.__dict__, "d_model": bad_cfg.d_model * 2})
+    bp_like, bo_like = train_state_templates(bad_cfg, mesh)
+    with pytest.raises(Exception) as exc:
+        restore_train_state(d, bp_like, bo_like)
+    assert not isinstance(exc.value, FileNotFoundError), exc.value
