@@ -407,6 +407,14 @@ def restore_train_state(directory: str, params_like, opt_state_like,
         return restored["params"], restored["opt"], s
 
     with ocp.CheckpointManager(directory) as manager:
+        # the COMMITTED step set gates every direct restore below: the
+        # degraded path bypasses orbax's commit protocol, and on
+        # marker-committed storage (gs://) a readable-but-uncommitted
+        # step dir holds torn state the manager correctly refuses
+        try:
+            committed = set(manager.all_steps())
+        except Exception:
+            committed = None  # manager metadata itself unreadable
         if step is not None:
             try:
                 restored = manager.restore(
@@ -417,7 +425,9 @@ def restore_train_state(directory: str, params_like, opt_state_like,
                 # the manager infers structure from the WHOLE directory,
                 # so a poisoned SIBLING step can break it for a healthy
                 # requested step — one direct attempt tells them apart;
-                # a genuinely-bad requested step raises from here
+                # a genuinely-bad or UNCOMMITTED requested step raises
+                if committed is not None and step not in committed:
+                    raise
                 with ocp.StandardCheckpointer() as ckptr:
                     return direct(ckptr, step)
         latest = manager.latest_step()
@@ -433,7 +443,11 @@ def restore_train_state(directory: str, params_like, opt_state_like,
                     "(%s); scanning older steps directly",
                     latest, directory, e,
                 )
-    steps = scan_steps()
+    steps = (
+        sorted(committed, reverse=True)
+        if committed is not None
+        else scan_steps()
+    )
     if not steps:
         raise FileNotFoundError(
             f"no committed checkpoint under {directory!r}"

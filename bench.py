@@ -328,6 +328,46 @@ def _last_known_good_tpu(path: str | None = None) -> dict | None:
     return block or None
 
 
+def _last_driver_captured_tpu() -> dict | None:
+    """When no mid-round capture exists (the tunnel has wedged through
+    entire rounds), fall back to the newest DRIVER-captured real-TPU
+    bench from this repo's own history (BENCH_r*.json): honest, clearly
+    sourced, and better context than nothing."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def round_no(path: str) -> int:
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    # numeric, not lexicographic: 'r100' must outrank 'r99'
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                       key=round_no, reverse=True):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = doc.get("parsed") or {}
+        # a real-TPU datum never carries the CPU fallback marker — and
+        # rounds 2-3 predate the "platform" key, so the metric NAME is
+        # the reliable discriminator (the CPU fallback metric says so)
+        if not parsed or parsed.get("platform") == "cpu":
+            continue
+        if "cpu" in str(parsed.get("metric", "")):
+            continue
+        if parsed.get("vs_baseline") is None:
+            continue
+        return {
+            **{k: parsed[k] for k in ("metric", "value", "unit", "vs_baseline")
+               if k in parsed},
+            "source": f"{os.path.basename(path)} (driver-captured end-of-round)",
+        }
+    return None
+
+
 def _measure(want_cpu: bool, fallback: bool = False) -> dict:
     import jax
 
@@ -425,7 +465,7 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
         }
         if fallback:
             doc["fallback"] = True
-        lkg = _last_known_good_tpu()
+        lkg = _last_known_good_tpu() or _last_driver_captured_tpu()
         if lkg is not None:
             doc["last_known_good_tpu"] = lkg
     doc["platform"] = platform

@@ -183,8 +183,9 @@ def test_poisoned_step_dir_falls_back_to_previous(tmp_path):
     # a SYSTEMIC failure (wrong templates) must surface as the real
     # error, never FileNotFoundError — a resume harness reads that as
     # "cold start, reinitialize" and would silently discard progress
-    bad_cfg = tiny_config()
-    bad_cfg = bad_cfg.__class__(**{**bad_cfg.__dict__, "d_model": bad_cfg.d_model * 2})
+    import dataclasses
+
+    bad_cfg = dataclasses.replace(tiny_config(), d_model=tiny_config().d_model * 2)
     bp_like, bo_like = train_state_templates(bad_cfg, mesh)
     with pytest.raises(Exception) as exc:
         restore_train_state(d, bp_like, bo_like)
