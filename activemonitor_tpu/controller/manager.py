@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Optional, Set
 
 from activemonitor_tpu.controller.client import HealthCheckClient
@@ -154,6 +155,18 @@ class Manager:
                 raise ConfigurationError(
                     f"metrics TLS certificate unusable: {e}"
                 ) from e
+        # rotation baseline, captured at the moment the chain loaded: a
+        # rotation landing between now and the reload loop's first tick
+        # must be seen as a CHANGE, not recorded as the baseline
+        self._cert_baseline = None
+        if self._metrics_ssl is not None and metrics_cert_file:
+            try:
+                self._cert_baseline = (
+                    os.stat(metrics_cert_file).st_mtime_ns,
+                    os.stat(metrics_key_file).st_mtime_ns,
+                )
+            except OSError:
+                pass
         self._elector = leader_elector or AlwaysLeader()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: Set[str] = set()
@@ -246,6 +259,50 @@ class Manager:
         for hc in await self.client.list():
             self.enqueue(hc.metadata.namespace, hc.metadata.name)
         self._ready.set()
+
+    async def _cert_reload_loop(self, interval: float = 60.0) -> None:
+        """Poll the metrics TLS PEM files' mtimes and reload the serving
+        chain when they change. ``SSLContext.load_cert_chain`` on the
+        live context applies to NEW handshakes (established connections
+        keep their session), which is exactly rotation semantics. A
+        half-written pair mid-rotation fails the reload attempt loudly
+        and the old chain keeps serving until the next tick."""
+        import os as _os
+
+        clock = self.reconciler.clock
+
+        def mtimes():
+            return (
+                _os.stat(self._metrics_cert_file).st_mtime_ns,
+                _os.stat(self._metrics_key_file).st_mtime_ns,
+            )
+
+        # baseline from __init__ (when the chain actually loaded), so a
+        # rotation in the window before this task's first tick is seen
+        # as a change rather than silently adopted as the baseline
+        last = self._cert_baseline
+        while True:
+            await clock.sleep(interval)
+            try:
+                now = mtimes()
+            except OSError as e:
+                log.warning("metrics TLS files unreadable (%s); keeping "
+                            "the current chain", e)
+                continue
+            if now == last:
+                continue
+            try:
+                self._metrics_ssl.load_cert_chain(
+                    self._metrics_cert_file, self._metrics_key_file
+                )
+            except (OSError, ValueError) as e:
+                log.warning(
+                    "metrics TLS reload failed (%s); keeping the current "
+                    "chain until the next attempt", e,
+                )
+                continue  # retry; mtime stays != last so we re-attempt
+            last = now
+            log.info("metrics TLS certificate reloaded (rotation detected)")
 
     async def _goodput_loop(self, interval: float = 30.0) -> None:
         """Periodically roll up fleet health: the fraction of scheduled
@@ -348,6 +405,15 @@ class Manager:
     async def _start_http(self) -> None:
         if not self._metrics_addr and not self._health_addr:
             return
+        if self._metrics_ssl is not None and self._metrics_cert_file:
+            # cert-manager-style rotation: the PEM files on disk are
+            # renewed under the controller; without a reload loop the
+            # endpoint serves the ORIGINAL chain until restart and
+            # scrapes start failing at its expiry (controller-runtime
+            # ships a certwatcher for exactly this). Started HERE, not
+            # after leadership: a STANDBY replica serves TLS metrics
+            # too, and it may wait in acquire() across many rotations.
+            self._tasks.append(asyncio.create_task(self._cert_reload_loop()))
         from aiohttp import web
 
         def static_token_matches(request) -> Optional[bool]:

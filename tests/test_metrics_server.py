@@ -523,3 +523,67 @@ def test_cli_k8s_auth_on_requires_cluster_credentials():
     )
     with pytest.raises(ConfigurationError, match="cluster credentials"):
         aio.run(_run_controller(args, "file", None, None))
+
+
+@pytest.mark.asyncio
+async def test_metrics_tls_certificate_rotation_reloads(tmp_path):
+    """cert-manager-style rotation: the PEM files are renewed under the
+    running controller; new handshakes must serve the NEW chain without
+    a restart (controller-runtime's certwatcher behavior). Old chain
+    before the poll tick, new chain after — verified by which CA each
+    fetch trusts."""
+    import asyncio
+
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    old_cert, old_key = generate_self_signed_cert("metrics.test")
+    cert_file = tmp_path / "tls.crt"
+    key_file = tmp_path / "tls.key"
+    cert_file.write_bytes(old_cert)
+    key_file.write_bytes(old_key)
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=FakeWorkflowEngine(),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    port = free_port()
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        max_parallel=1,
+        metrics_bind_address=f"127.0.0.1:{port}",
+        metrics_secure=True,
+        metrics_cert_file=str(cert_file),
+        metrics_key_file=str(key_file),
+    )
+    await manager.start()
+    try:
+        status, _ = await fetch(
+            f"https://127.0.0.1:{port}/metrics", ca_pem=old_cert
+        )
+        assert status == 200
+
+        new_cert, new_key = generate_self_signed_cert("metrics.test")
+        assert new_cert != old_cert
+        cert_file.write_bytes(new_cert)
+        key_file.write_bytes(new_key)
+        import os
+
+        os.utime(cert_file, ns=(1, 1))  # force a visible mtime change
+        await clock.advance(61)  # one reload-poll tick
+        await asyncio.sleep(0.05)
+
+        status, _ = await fetch(
+            f"https://127.0.0.1:{port}/metrics", ca_pem=new_cert
+        )
+        assert status == 200  # new chain served to new handshakes
+        with pytest.raises(Exception):
+            await fetch(f"https://127.0.0.1:{port}/metrics", ca_pem=old_cert)
+    finally:
+        await manager.stop()
