@@ -142,11 +142,24 @@ class Manager:
         # build the TLS context NOW so a missing/malformed PEM is a
         # usage error before any side effects, not a bind-time traceback
         self._metrics_ssl = None
+        # rotation baseline, stat'ed BEFORE the chain loads: a rotation
+        # landing in the stat→load window then costs one harmless extra
+        # reload at the first tick, whereas stat-after-load would adopt
+        # it silently and never reload the stale chain
+        self._cert_baseline = None
         if metrics_secure and metrics_bind_address:
             import ssl as _ssl
 
             from activemonitor_tpu.utils.tls import server_ssl_context
 
+            if metrics_cert_file:
+                try:
+                    self._cert_baseline = (
+                        os.stat(metrics_cert_file).st_mtime_ns,
+                        os.stat(metrics_key_file).st_mtime_ns,
+                    )
+                except OSError:
+                    pass
             try:
                 self._metrics_ssl = server_ssl_context(
                     metrics_cert_file, metrics_key_file
@@ -155,18 +168,6 @@ class Manager:
                 raise ConfigurationError(
                     f"metrics TLS certificate unusable: {e}"
                 ) from e
-        # rotation baseline, captured at the moment the chain loaded: a
-        # rotation landing between now and the reload loop's first tick
-        # must be seen as a CHANGE, not recorded as the baseline
-        self._cert_baseline = None
-        if self._metrics_ssl is not None and metrics_cert_file:
-            try:
-                self._cert_baseline = (
-                    os.stat(metrics_cert_file).st_mtime_ns,
-                    os.stat(metrics_key_file).st_mtime_ns,
-                )
-            except OSError:
-                pass
         self._elector = leader_elector or AlwaysLeader()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: Set[str] = set()
@@ -265,16 +266,19 @@ class Manager:
         chain when they change. ``SSLContext.load_cert_chain`` on the
         live context applies to NEW handshakes (established connections
         keep their session), which is exactly rotation semantics. A
-        half-written pair mid-rotation fails the reload attempt loudly
-        and the old chain keeps serving until the next tick."""
-        import os as _os
+        half-written pair mid-rotation fails the DRY-RUN load into a
+        throwaway context, so the live chain is untouched until a
+        coherent pair appears — load_cert_chain installs the cert
+        before checking the key, so validating directly on the live
+        context would leave a torn new-cert/old-key pair behind."""
+        import ssl as _ssl
 
         clock = self.reconciler.clock
 
         def mtimes():
             return (
-                _os.stat(self._metrics_cert_file).st_mtime_ns,
-                _os.stat(self._metrics_key_file).st_mtime_ns,
+                os.stat(self._metrics_cert_file).st_mtime_ns,
+                os.stat(self._metrics_key_file).st_mtime_ns,
             )
 
         # baseline from __init__ (when the chain actually loaded), so a
@@ -292,6 +296,12 @@ class Manager:
             if now == last:
                 continue
             try:
+                # dry-run first: prove the pair is coherent in a
+                # throwaway context before touching the live one
+                probe_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+                probe_ctx.load_cert_chain(
+                    self._metrics_cert_file, self._metrics_key_file
+                )
                 self._metrics_ssl.load_cert_chain(
                     self._metrics_cert_file, self._metrics_key_file
                 )

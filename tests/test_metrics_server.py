@@ -571,11 +571,23 @@ async def test_metrics_tls_certificate_rotation_reloads(tmp_path):
 
         new_cert, new_key = generate_self_signed_cert("metrics.test")
         assert new_cert != old_cert
-        cert_file.write_bytes(new_cert)
-        key_file.write_bytes(new_key)
         import os
 
-        os.utime(cert_file, ns=(1, 1))  # force a visible mtime change
+        # a TORN rotation first: new cert, old key. The dry-run load
+        # must reject the pair and leave the LIVE chain untouched —
+        # load_cert_chain on the live context would strand a broken
+        # new-cert/old-key hybrid and fail every new handshake
+        cert_file.write_bytes(new_cert)
+        os.utime(cert_file, ns=(1, 1))
+        await clock.advance(61)
+        await asyncio.sleep(0.05)
+        status, _ = await fetch(
+            f"https://127.0.0.1:{port}/metrics", ca_pem=old_cert
+        )
+        assert status == 200  # old chain still serving
+
+        key_file.write_bytes(new_key)  # rotation completes
+        os.utime(cert_file, ns=(2, 2))
         await clock.advance(61)  # one reload-poll tick
         await asyncio.sleep(0.05)
 

@@ -604,3 +604,19 @@ def test_collectives_per_axis_on_cpu_mesh():
     }
     # each axis reports a positive number; no cross-axis name collision
     assert all(m.value > 0 for m in r.metrics)
+
+
+def test_cli_profile_writes_a_trace(tmp_path, capsys):
+    """--profile wraps the probe in jax.profiler.trace and must leave a
+    trace artifact behind (the tracing/profiling aux subsystem,
+    SURVEY.md §5.1) while the metrics contract still prints."""
+    import json
+
+    from activemonitor_tpu.probes.cli import main
+
+    rc = main(["--profile", str(tmp_path / "trace"), "devices"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["metrics"][0]["name"] == "tpu-device-count"
+    produced = list((tmp_path / "trace").rglob("*"))
+    assert any(p.is_file() for p in produced), produced
