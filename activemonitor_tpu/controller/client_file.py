@@ -75,12 +75,18 @@ class FileHealthCheckClient:
         return out
 
     def _merge_status(self, hc: HealthCheck) -> None:
+        # every read surfaces a resourceVersion — "0" before the first
+        # status write. An EMPTY rv would disarm update_status's CAS
+        # guard entirely (both-sides-non-empty check), so a snapshot
+        # taken before any status write could never conflict: the
+        # staleness the contract suite requires every client to detect
+        hc.metadata.resource_version = "0"
         path = self._status_path(hc.metadata.namespace, hc.metadata.name)
         if path.exists():
             try:
                 doc = json.loads(path.read_text())
                 hc.status = HealthCheckStatus.model_validate(doc.get("status", {}))
-                hc.metadata.resource_version = str(doc.get("resourceVersion", ""))
+                hc.metadata.resource_version = str(doc.get("resourceVersion", "0"))
             except (json.JSONDecodeError, ValueError) as e:
                 log.error("%s: corrupt status sidecar ignored: %s", path, e)
 
@@ -108,10 +114,12 @@ class FileHealthCheckClient:
         # update in place if the check already lives in a user-named
         # file: writing a second copy elsewhere would leave the
         # alphabetically-later (possibly stale) doc winning _load_all
-        if self._rewrite_in_place(hc.metadata.namespace, hc.metadata.name, doc):
-            return hc
-        path = self._dir / f"{hc.metadata.namespace}__{hc.metadata.name}.yaml"
-        path.write_text(yaml.safe_dump(doc, sort_keys=False))
+        if not self._rewrite_in_place(hc.metadata.namespace, hc.metadata.name, doc):
+            path = self._dir / f"{hc.metadata.namespace}__{hc.metadata.name}.yaml"
+            path.write_text(yaml.safe_dump(doc, sort_keys=False))
+        # like the other clients, apply returns an rv-bearing object so
+        # an apply→mutate→update_status sequence still CAS-protects
+        self._merge_status(hc)
         return hc
 
     def _rewrite_in_place(self, namespace: str, name: str, new_doc: dict) -> bool:
@@ -145,7 +153,16 @@ class FileHealthCheckClient:
             and hc.metadata.resource_version != existing.metadata.resource_version
         ):
             raise ConflictError(hc.key)
-        self._rv += 1
+        # the next rv derives from the DURABLE one, not just the
+        # in-memory counter: a restarted controller (or a second client
+        # instance on the same store) starts its counter at 0, and a
+        # regressed rv would let genuinely stale snapshots compare
+        # equal — silently clobbering newer status
+        try:
+            durable = int(existing.metadata.resource_version or 0)
+        except ValueError:
+            durable = 0
+        self._rv = max(self._rv, durable) + 1
         payload = {
             "status": hc.status.to_json_dict(),
             "resourceVersion": str(self._rv),

@@ -1,0 +1,200 @@
+"""One contract, three clients.
+
+The HealthCheckClient protocol (controller/client.py) has three real
+implementations — in-memory, file-backed, and Kubernetes-over-REST —
+and the reconciler/manager must behave identically on all of them. The
+reference has exactly one client (controller-runtime's), so THIS suite
+is the drift guard its architecture never needed: every semantic the
+controller relies on runs against each implementation through one
+parameterized scenario set. A behavior difference between clients is a
+bug here even if each client's own test file stays green.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import InMemoryHealthCheckClient
+from activemonitor_tpu.controller.client import ConflictError, NotFoundError
+from activemonitor_tpu.controller.client_file import FileHealthCheckClient
+from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+
+from tests.kube_harness import stub_env
+
+
+def make_hc(name="contract-a", namespace="health", repeat=60):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "repeatAfterSec": repeat,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": f"{name}-",
+                    "resource": {
+                        "namespace": namespace,
+                        "source": {"inline": "kind: Workflow\n"},
+                    },
+                },
+            },
+        }
+    )
+
+
+@contextlib.asynccontextmanager
+async def client_under_test(kind, tmp_path):
+    if kind == "memory":
+        yield InMemoryHealthCheckClient()
+    elif kind == "file":
+        yield FileHealthCheckClient(str(tmp_path), poll_seconds=0.05)
+    else:
+        async with stub_env() as (_server, api):
+            yield KubernetesHealthCheckClient(api)
+
+
+CLIENTS = ["memory", "file", "k8s"]
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_crud_and_status_roundtrip(kind, tmp_path):
+    async with client_under_test(kind, tmp_path) as client:
+        assert await client.get("health", "contract-a") is None
+        created = await client.apply(make_hc())
+        assert created.metadata.name == "contract-a"
+
+        got = await client.get("health", "contract-a")
+        assert got is not None and got.spec.repeat_after_sec == 60
+
+        listed = await client.list()
+        assert [h.metadata.name for h in listed] == ["contract-a"]
+
+        # status write lands; a later spec re-apply must NOT clobber it
+        got.status.status = "Succeeded"
+        got.status.success_count = 3
+        await client.update_status(got)
+        re_applied = await client.apply(make_hc(repeat=90))
+        assert re_applied.spec.repeat_after_sec == 90
+        fresh = await client.get("health", "contract-a")
+        assert fresh.status.success_count == 3, kind
+        assert fresh.spec.repeat_after_sec == 90
+
+        await client.delete("health", "contract-a")
+        assert await client.get("health", "contract-a") is None
+        with pytest.raises(NotFoundError):
+            await client.delete("health", "contract-a")
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_stale_status_write_conflicts(kind, tmp_path):
+    """Optimistic concurrency: a status write from a stale snapshot
+    (another writer bumped the object since) must raise ConflictError
+    on every client — the retry_on_conflict path depends on it."""
+    async with client_under_test(kind, tmp_path) as client:
+        await client.apply(make_hc())
+        stale = await client.get("health", "contract-a")
+        # another writer moves the object forward
+        current = await client.get("health", "contract-a")
+        current.status.status = "Succeeded"
+        await client.update_status(current)
+        stale.status.status = "Failed"
+        with pytest.raises(ConflictError):
+            await client.update_status(stale)
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_watch_delivers_adds_and_deletes(kind, tmp_path):
+    """The manager's event loop is driven by watch(): ADDED for new
+    (and pre-existing) checks, and deletion eventually surfacing as a
+    DELETED event, on every client."""
+    async with client_under_test(kind, tmp_path) as client:
+        events = []
+        seen = asyncio.Event()
+
+        async def consume():
+            async for ev in client.watch():
+                events.append((ev.type, ev.name))
+                if ("DELETED", "contract-a") in events:
+                    seen.set()
+                    return
+
+        task = asyncio.create_task(consume())
+        try:
+            await asyncio.sleep(0.15)  # watch registered
+            await client.apply(make_hc())
+
+            async def added():
+                return any(
+                    t == "ADDED" and n == "contract-a" for t, n in events
+                )
+
+            for _ in range(100):
+                if await added():
+                    break
+                await asyncio.sleep(0.05)
+            assert await added(), (kind, events)
+            await client.delete("health", "contract-a")
+            await asyncio.wait_for(seen.wait(), timeout=10)
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_namespace_scoping(kind, tmp_path):
+    async with client_under_test(kind, tmp_path) as client:
+        await client.apply(make_hc("a", namespace="ns1"))
+        await client.apply(make_hc("b", namespace="ns2"))
+        assert {h.metadata.name for h in await client.list()} == {"a", "b"}
+        only = await client.list("ns1")
+        assert [h.metadata.name for h in only] == ["a"]
+        # same name in a different namespace is a different object
+        assert await client.get("ns2", "a") is None
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_apply_returns_rv_bearing_object(kind, tmp_path):
+    """apply() must return an object whose resource_version arms the
+    CAS guard: apply -> (another writer bumps) -> update_status from
+    the apply snapshot must conflict on every client."""
+    async with client_under_test(kind, tmp_path) as client:
+        applied = await client.apply(make_hc())
+        assert applied.metadata.resource_version, kind
+        other = await client.get("health", "contract-a")
+        other.status.status = "Succeeded"
+        await client.update_status(other)
+        applied.status.status = "Failed"
+        with pytest.raises(ConflictError):
+            await client.update_status(applied)
+
+
+@pytest.mark.asyncio
+async def test_file_client_rv_survives_second_instance(tmp_path):
+    """The file store's rv is DURABLE: a second client instance (or a
+    restarted controller) starting its in-memory counter at zero must
+    not regress the persisted rv — a regression would let genuinely
+    stale snapshots compare equal and clobber newer status."""
+    a = FileHealthCheckClient(str(tmp_path), poll_seconds=0.05)
+    await a.apply(make_hc())
+    for _ in range(3):  # rv climbs to 3
+        cur = await a.get("health", "contract-a")
+        cur.status.success_count += 1
+        await a.update_status(cur)
+    stale = await a.get("health", "contract-a")  # rv 3
+
+    b = FileHealthCheckClient(str(tmp_path), poll_seconds=0.05)  # fresh counter
+    cur = await b.get("health", "contract-a")
+    cur.status.success_count += 1
+    updated = await b.update_status(cur)
+    assert int(updated.metadata.resource_version) > 3  # no regression
+    stale.status.success_count = 0
+    with pytest.raises(ConflictError):
+        await a.update_status(stale)
+    fresh = await a.get("health", "contract-a")
+    assert fresh.status.success_count == 4
