@@ -117,10 +117,38 @@ class FileHealthCheckClient:
         if not self._rewrite_in_place(hc.metadata.namespace, hc.metadata.name, doc):
             path = self._dir / f"{hc.metadata.namespace}__{hc.metadata.name}.yaml"
             path.write_text(yaml.safe_dump(doc, sort_keys=False))
+        # a spec apply BUMPS the durable rv like the other clients (the
+        # in-memory store and a k8s PUT both do), so a snapshot taken
+        # before the spec change conflicts on its next status write on
+        # every backend. Hand-edits to the YAML files bypass this —
+        # inherent to a user-editable store, and the watch poll still
+        # surfaces them as MODIFIED events.
+        self._bump_rv(hc.metadata.namespace, hc.metadata.name)
         # like the other clients, apply returns an rv-bearing object so
         # an apply→mutate→update_status sequence still CAS-protects
         self._merge_status(hc)
         return hc
+
+    def _bump_rv(self, namespace: str, name: str) -> None:
+        """Advance the durable rv in the status sidecar, preserving any
+        recorded status."""
+        path = self._status_path(namespace, name)
+        status: dict = {}
+        durable = 0
+        if path.exists():
+            try:
+                doc = json.loads(path.read_text())
+                status = doc.get("status", {})
+                durable = int(doc.get("resourceVersion", 0))
+            except (json.JSONDecodeError, ValueError):
+                pass
+        self._rv = max(self._rv, durable) + 1
+        path.write_text(
+            json.dumps(
+                {"status": status, "resourceVersion": str(self._rv)},
+                default=str,
+            )
+        )
 
     def _rewrite_in_place(self, namespace: str, name: str, new_doc: dict) -> bool:
         for path in list(self._dir.glob("*.yaml")) + list(self._dir.glob("*.yml")):
@@ -205,22 +233,31 @@ class FileHealthCheckClient:
 
     # -- watch --------------------------------------------------------------
     def watch(self) -> AsyncIterator[WatchEvent]:
-        """Poll the directory; emits ADDED/MODIFIED (spec change)/DELETED.
+        """Poll the directory; emits ADDED/MODIFIED/DELETED.
 
-        The baseline snapshot is taken SYNCHRONOUSLY at call time: specs
-        existing now are the manager's boot-resync job; anything that
-        changes after this call is a watch event — no gap between the
-        two (list-then-watch ordering)."""
-        known: Dict[str, dict] = {
-            k: hc.spec.to_json_dict() for k, hc in self._load_all().items()
-        }
+        MODIFIED covers spec AND status changes — the in-memory client
+        and a real apiserver both emit for status-subresource writes,
+        so the file backend must too or a manager reacting to MODIFIED
+        behaves differently per store (the reconciler's dedupe absorbs
+        the self-churn from its own status writes, same as cluster
+        mode). The baseline snapshot is taken SYNCHRONOUSLY at call
+        time: specs existing now are the manager's boot-resync job;
+        anything that changes after this call is a watch event — no gap
+        between the two (list-then-watch ordering)."""
+
+        def snapshot():
+            return {
+                k: (hc.spec.to_json_dict(), hc.metadata.resource_version)
+                for k, hc in self._load_all().items()
+            }
+
+        known: Dict[str, tuple] = snapshot()
 
         async def gen() -> AsyncIterator[WatchEvent]:
             nonlocal known
             while True:
                 await asyncio.sleep(self._poll)
-                current = self._load_all()
-                specs = {k: hc.spec.to_json_dict() for k, hc in current.items()}
+                specs = snapshot()
                 for key in specs.keys() - known.keys():
                     ns, _, name = key.partition("/")
                     yield WatchEvent(type="ADDED", namespace=ns, name=name)

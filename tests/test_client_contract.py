@@ -198,3 +198,49 @@ async def test_file_client_rv_survives_second_instance(tmp_path):
         await a.update_status(stale)
     fresh = await a.get("health", "contract-a")
     assert fresh.status.success_count == 4
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_spec_reapply_bumps_rv_and_conflicts_stale_writers(kind, tmp_path):
+    """A spec re-apply moves the object's rv on every backend, so a
+    snapshot taken BEFORE the spec change conflicts on its next status
+    write — status computed against an outdated spec never lands."""
+    async with client_under_test(kind, tmp_path) as client:
+        await client.apply(make_hc())
+        snap = await client.get("health", "contract-a")
+        await client.apply(make_hc(repeat=120))  # spec change by another
+        snap.status.status = "Failed"
+        with pytest.raises(ConflictError):
+            await client.update_status(snap)
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", CLIENTS)
+async def test_status_write_emits_modified(kind, tmp_path):
+    """update_status surfaces as a MODIFIED watch event on every
+    backend (status-subresource writes are watch events on a real
+    apiserver); a manager reacting to MODIFIED must see the same
+    stream whichever store backs it."""
+    async with client_under_test(kind, tmp_path) as client:
+        await client.apply(make_hc())
+        events = []
+
+        async def consume():
+            async for ev in client.watch():
+                events.append((ev.type, ev.name))
+
+        task = asyncio.create_task(consume())
+        try:
+            await asyncio.sleep(0.15)
+            hc = await client.get("health", "contract-a")
+            hc.status.status = "Succeeded"
+            await client.update_status(hc)
+            for _ in range(100):
+                if ("MODIFIED", "contract-a") in events:
+                    break
+                await asyncio.sleep(0.05)
+            assert ("MODIFIED", "contract-a") in events, (kind, events)
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)

@@ -1,0 +1,122 @@
+"""Property-based cron invariants (hypothesis).
+
+The example-based suite (test_cron.py) pins known behaviors; this one
+asserts the invariants that must hold for EVERY expression the parser
+accepts — the robfig-compatible contract the reconciler's scheduling
+math builds on. A violation here is a wedged or double-fired schedule
+in production, whatever the expression.
+"""
+
+import datetime
+
+from hypothesis import given, settings, strategies as st
+
+from activemonitor_tpu.scheduler.cron import parse_cron
+
+UTC = datetime.timezone.utc
+
+
+def field(lo, hi, names=()):
+    """One cron field: *, a value, a range, a step, or a small list."""
+    value = st.integers(lo, hi).map(str)
+    if names:
+        value = st.one_of(value, st.sampled_from(names))
+    rng = st.tuples(st.integers(lo, hi), st.integers(lo, hi)).map(
+        lambda ab: f"{min(ab)}-{max(ab)}"
+    )
+    step = st.tuples(rng, st.integers(1, 10)).map(lambda rs: f"{rs[0]}/{rs[1]}")
+    star_step = st.integers(1, 15).map(lambda s: f"*/{s}")
+    atom = st.one_of(st.just("*"), value, rng, step, star_step)
+    return st.lists(atom, min_size=1, max_size=3).map(",".join)
+
+
+DOW_NAMES = ("SUN", "MON", "TUE", "WED", "THU", "FRI", "SAT")
+MON_NAMES = ("JAN", "FEB", "MAR", "APR", "MAY", "JUN",
+             "JUL", "AUG", "SEP", "OCT", "NOV", "DEC")
+
+exprs = st.tuples(
+    field(0, 59),          # minute
+    field(0, 23),          # hour
+    field(1, 28),          # day of month (≤28: every month has it)
+    field(1, 12, MON_NAMES),
+    field(0, 6, DOW_NAMES),
+).map(" ".join)
+
+times = st.datetimes(
+    min_value=datetime.datetime(2024, 1, 1),
+    max_value=datetime.datetime(2028, 12, 31),
+).map(lambda d: d.replace(tzinfo=UTC))
+
+zones = st.sampled_from(
+    ["UTC", "America/New_York", "Asia/Tokyo", "Europe/Berlin",
+     "Australia/Sydney", "Pacific/Chatham"]  # incl. :45 offset + DST
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(expr=exprs, after=times)
+def test_next_is_strictly_future_and_on_schedule(expr, after):
+    s = parse_cron(expr)
+    fire = s.next(after)
+    assert fire > after
+    # the fire matches every field of the expression
+    minute_f, hour_f, dom_f, _mon_f, _dow_f = expr.split()
+    local = fire
+    if "*" not in minute_f and "/" not in minute_f and "," not in minute_f \
+            and "-" not in minute_f:
+        assert local.minute == int(minute_f), (expr, fire)
+    if "*" not in hour_f and "/" not in hour_f and "," not in hour_f \
+            and "-" not in hour_f:
+        assert local.hour == int(hour_f), (expr, fire)
+
+
+@settings(max_examples=100, deadline=None)
+@given(expr=exprs, after=times)
+def test_chained_fires_strictly_increase(expr, after):
+    s = parse_cron(expr)
+    t = after
+    prev_utc = after.astimezone(UTC)
+    for _ in range(4):
+        t = s.next(t)
+        t_utc = t.astimezone(UTC)
+        assert t_utc > prev_utc, (expr, after, t)
+        prev_utc = t_utc
+
+
+@settings(max_examples=100, deadline=None)
+@given(expr=exprs, after=times, zone=zones)
+def test_tz_prefixed_chain_is_monotonic_in_utc(expr, after, zone):
+    """Whatever the zone (DST gaps, 13:45 offsets), chained fires move
+    strictly forward in REAL time — the invariant the timer wheel's
+    delay math depends on."""
+    s = parse_cron(f"TZ={zone} {expr}")
+    t = after
+    prev_utc = after.astimezone(UTC)
+    for _ in range(3):
+        t = s.next(t)
+        t_utc = t.astimezone(UTC)
+        assert t_utc > prev_utc, (zone, expr, after, t)
+        prev_utc = t_utc
+
+
+@settings(max_examples=100, deadline=None)
+@given(after=times, zone=zones, minute=st.integers(0, 59),
+       hour=st.integers(0, 23))
+def test_daily_fire_lands_on_requested_wall_time_or_dst_shift(
+    after, zone, minute, hour
+):
+    """A daily 'M H * * *' fire lands exactly on the requested local
+    wall time — except on a DST transition day, where the canonical
+    normalization may shift it by the gap (never by more than 2h, and
+    never into the past)."""
+    s = parse_cron(f"TZ={zone} {minute} {hour} * * *")
+    fire = s.next(after)
+    assert fire > after
+    if fire.minute == minute and fire.hour == hour:
+        return  # nominal wall time
+    # shifted: must be a DST-gap day — the shift equals the UTC-offset
+    # change across the fire, bounded by 2 hours
+    same_day_earlier = fire - datetime.timedelta(hours=3)
+    gap = fire.utcoffset() - same_day_earlier.utcoffset()
+    assert gap != datetime.timedelta(0), (zone, minute, hour, fire)
+    assert abs(gap) <= datetime.timedelta(hours=2)
