@@ -117,6 +117,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="console text or structured JSON lines "
         "(reference parity: zap --zap-encoder, cmd/main.go:146-152)",
     )
+    run.add_argument(
+        "--trace-export",
+        default="",
+        metavar="PATH",
+        help="on shutdown, dump the retained reconcile-cycle traces as "
+        "JSON lines (one trace per line) to PATH; live traces are "
+        "always available at /debug/traces on the health endpoint",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -223,7 +231,9 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
 
         engine = ArgoWorkflowEngine(
-            kube_api, on_watch_health=metrics.record_watch_health
+            kube_api,
+            on_watch_health=metrics.record_watch_health,
+            on_watch_restart=metrics.record_watch_restart,
         )
     else:
         from activemonitor_tpu.engine.local import LocalProcessEngine
@@ -333,6 +343,21 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         closer = getattr(engine, "close", None)
         if closer is not None:
             await closer()  # stop workflow watch streams
+        trace_path = getattr(args, "trace_export", "")
+        if trace_path:
+            # after manager.stop(): the reconciler has shut down, so
+            # every in-flight cycle's spans have landed in the ring
+            try:
+                count = reconciler.tracer.export_jsonl(trace_path)
+                logging.getLogger("activemonitor").info(
+                    "exported %d trace(s) to %s", count, trace_path
+                )
+            except OSError as e:
+                # best-effort on the way out: a bad path must not turn
+                # a clean shutdown into a crash
+                logging.getLogger("activemonitor").error(
+                    "trace export to %s failed: %s", trace_path, e
+                )
     return 1 if lost_leadership else 0
 
 

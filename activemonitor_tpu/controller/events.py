@@ -22,6 +22,15 @@ log = logging.getLogger("activemonitor.events")
 EVENT_NORMAL = "Normal"
 EVENT_WARNING = "Warning"
 
+# Declared reason vocabulary — every EventRecorder.event() call site
+# must draw its reason from this table (the reference free-hands reason
+# strings at ~40 call sites; dashboards grouping on reason then break
+# on typos). tests/test_lint.py walks the AST of the whole package and
+# rejects any reason literal not listed here.
+REASON_NORMAL = "Normal"
+REASON_WARNING = "Warning"
+EVENT_REASONS = frozenset({REASON_NORMAL, REASON_WARNING})
+
 
 @dataclass
 class Event:
@@ -33,6 +42,21 @@ class Event:
     timestamp: datetime.datetime = field(
         default_factory=lambda: datetime.datetime.now(datetime.timezone.utc)
     )
+    # trace of the reconcile cycle that emitted this event ("" outside
+    # any span) — the correlation key shared with JSON log lines and
+    # /debug/traces
+    trace_id: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "reason": self.reason,
+            "message": self.message,
+            "namespace": self.namespace,
+            "name": self.name,
+            "timestamp": self.timestamp.isoformat(),
+            "trace_id": self.trace_id,
+        }
 
 
 class EventRecorder:
@@ -40,12 +64,15 @@ class EventRecorder:
         self._events: Deque[Event] = collections.deque(maxlen=capacity)
 
     def event(self, hc: HealthCheck, type_: str, reason: str, message: str) -> None:
+        from activemonitor_tpu.obs.trace import current_trace_id
+
         ev = Event(
             type=type_,
             reason=reason,
             message=message,
             namespace=hc.metadata.namespace,
             name=hc.metadata.name,
+            trace_id=current_trace_id(),
         )
         self._events.append(ev)
         level = logging.WARNING if type_ == EVENT_WARNING else logging.INFO

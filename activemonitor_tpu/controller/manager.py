@@ -21,11 +21,16 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-from typing import Optional, Set
+from typing import Dict, Optional, Set, Tuple
 
 from activemonitor_tpu.controller.client import HealthCheckClient
 from activemonitor_tpu.controller.leader import AlwaysLeader, LeaderElector
 from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
+from activemonitor_tpu.metrics.collector import (
+    RECONCILE_ERROR,
+    RECONCILE_REQUEUE_AFTER,
+    RECONCILE_SUCCESS,
+)
 
 log = logging.getLogger("activemonitor.manager")
 
@@ -173,11 +178,20 @@ class Manager:
         self._queued: Set[str] = set()
         self._processing: Set[str] = set()
         self._dirty: Set[str] = set()
+        # per queued key: (pre-minted trace id, enqueue monotonic) — the
+        # one hop contextvars cannot cross is the workqueue (enqueue and
+        # dequeue happen on different tasks), so the trace rides here
+        # and the worker roots the cycle's span on it; the enqueue time
+        # feeds the workqueue_queue_duration histogram and the trace's
+        # "dequeue" (queue wait) span
+        self._pending_trace: Dict[str, Tuple[str, float]] = {}
+        self._active_workers = 0
         self._ready = asyncio.Event()
         self._stopping = asyncio.Event()
         self._tasks: list = []
         self._requeue_tasks: Set[asyncio.Task] = set()
         self._http_runners: list = []
+        self.reconciler.metrics.set_max_concurrent(self.max_parallel)
 
     # -- queue ----------------------------------------------------------
     # controller-runtime workqueue semantics: a queued key coalesces new
@@ -185,33 +199,78 @@ class Manager:
     # its reconcile finishes, so one key never reconciles concurrently.
     def enqueue(self, namespace: str, name: str) -> None:
         key = f"{namespace}/{name}"
+        metrics = self.reconciler.metrics
         if key in self._processing:
             self._dirty.add(key)
+            # client-go counts EVERY Add() — coalesced and dirty-deferred
+            # included — so rate(workqueue_adds_total) reads true event
+            # pressure even when the queue absorbs it
+            metrics.record_queue_add(self._queue.qsize())
             return
         if key in self._queued:
+            metrics.record_queue_add(self._queue.qsize())
             return  # coalesce: already pending
         self._queued.add(key)
+        # the trace starts HERE — the cycle's invisible window opens at
+        # enqueue, and queue wait must be attributable like every other
+        # phase
+        self._pending_trace[key] = (
+            self.reconciler.tracer.new_trace_id(),
+            self.reconciler.clock.monotonic(),
+        )
         self._queue.put_nowait((namespace, name))
+        metrics.record_queue_add(self._queue.qsize())
 
     async def _watch_loop(self, iterator) -> None:
         async for event in iterator:
             self.enqueue(event.namespace, event.name)
 
     async def _worker(self, index: int) -> None:
+        metrics = self.reconciler.metrics
+        tracer = self.reconciler.tracer
+        clock = self.reconciler.clock
         while True:
             namespace, name = await self._queue.get()
             key = f"{namespace}/{name}"
             self._queued.discard(key)
             self._processing.add(key)
-            try:
-                requeue_after = await self.reconciler.reconcile(namespace, name)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                log.exception("reconcile %s/%s crashed", namespace, name)
-                requeue_after = 1.0
-            finally:
-                self._processing.discard(key)
+            trace_id, enqueued_at = self._pending_trace.pop(
+                key, (None, clock.monotonic())
+            )
+            dequeued_at = clock.monotonic()
+            metrics.record_queue_get(
+                self._queue.qsize(), dequeued_at - enqueued_at
+            )
+            self._active_workers += 1
+            metrics.set_active_workers(self._active_workers)
+            result = RECONCILE_SUCCESS
+            # a ROOT span per dequeue (never inherited: this task's
+            # contextvar still holds the previous iteration's context);
+            # the detached watch task the reconcile spawns inherits it,
+            # so poll/status-write spans land in the same trace
+            with tracer.trace(
+                "reconcile", trace_id=trace_id, healthcheck=key, worker=index
+            ):
+                tracer.record_span("dequeue", start=enqueued_at, healthcheck=key)
+                try:
+                    requeue_after = await self.reconciler.reconcile(
+                        namespace, name
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("reconcile %s/%s crashed", namespace, name)
+                    requeue_after = 1.0
+                    result = RECONCILE_ERROR
+                finally:
+                    self._processing.discard(key)
+                    work_seconds = clock.monotonic() - dequeued_at
+                    self._active_workers -= 1
+                    metrics.set_active_workers(self._active_workers)
+                    metrics.record_work_duration(work_seconds)
+            if result is not RECONCILE_ERROR and requeue_after:
+                result = RECONCILE_REQUEUE_AFTER
+            metrics.record_reconcile(result, work_seconds)
             if key in self._dirty:
                 self._dirty.discard(key)
                 self.enqueue(namespace, name)
@@ -447,10 +506,12 @@ class Manager:
                 f"Bearer {token}".encode(),
             )
 
-        async def metrics(request):
-            # auth filter on the metrics endpoint only, like the
-            # reference's authn/z-filtered :8443 (cmd/main.go:74-81);
-            # health probes stay open for the kubelet
+        async def denial(request) -> Optional["web.Response"]:
+            """The metrics auth filter (reference: authn/z-filtered
+            :8443, cmd/main.go:74-81) as a reusable gate: None when the
+            request may proceed, an error response otherwise. Health
+            probes stay open for the kubelet; /debug reuses this gate
+            when it is forced onto the same socket as /metrics."""
             if self._metrics_authorizer is not None:
                 # K8s-native path (TokenReview + SubjectAccessReview):
                 # the CLUSTER decides who scrapes, per identity, via
@@ -477,6 +538,12 @@ class Manager:
                 static = static_token_matches(request)
                 if static is False:
                     return web.Response(status=401, text="unauthorized")
+            return None
+
+        async def metrics(request):
+            denied = await denial(request)
+            if denied is not None:
+                return denied
             data = self.reconciler.metrics.exposition()
             return web.Response(
                 body=data, content_type="text/plain", charset="utf-8"
@@ -489,6 +556,50 @@ class Manager:
             if self._ready.is_set():
                 return web.Response(text="ok")
             return web.Response(status=503, text="not ready")
+
+        async def debug_traces(request):
+            # completed reconcile-cycle traces, newest last; ?trace_id=
+            # narrows to one (the id a correlated log line / event
+            # carries)
+            traces = self.reconciler.tracer.traces()
+            wanted = request.query.get("trace_id")
+            if wanted:
+                traces = [t for t in traces if t["trace_id"] == wanted]
+            return web.json_response({"traces": traces})
+
+        async def debug_events(request):
+            events = self.reconciler.recorder.all
+            wanted = request.query.get("trace_id")
+            if wanted:
+                events = [e for e in events if e.trace_id == wanted]
+            return web.json_response({"events": [e.to_dict() for e in events]})
+
+        # /debug rides the health-probe site (plaintext, kubelet-open) —
+        # trace/event payloads are operator diagnostics like /healthz,
+        # not scrape data behind the metrics auth filter
+        debug_routes = [
+            web.get("/debug/traces", debug_traces),
+            web.get("/debug/events", debug_events),
+        ]
+
+        def guarded(handler):
+            """On the MERGED site /debug shares a socket with the
+            auth-filtered /metrics — an operator who put a token in
+            front of that port meant all its operational data, so the
+            debug endpoints enforce the same gate there."""
+
+            async def wrapped(request):
+                denied = await denial(request)
+                if denied is not None:
+                    return denied
+                return await handler(request)
+
+            return wrapped
+
+        guarded_debug_routes = [
+            web.get("/debug/traces", guarded(debug_traces)),
+            web.get("/debug/events", guarded(debug_events)),
+        ]
 
         async def bind(addr: str, routes, secure: bool = False) -> None:
             host, _, port = addr.rpartition(":")
@@ -515,7 +626,8 @@ class Manager:
                     web.get("/metrics", metrics),
                     web.get("/healthz", healthz),
                     web.get("/readyz", readyz),
-                ],
+                ]
+                + guarded_debug_routes,
             )
             return
         if self._metrics_addr:
@@ -527,7 +639,8 @@ class Manager:
         if self._health_addr:
             await bind(
                 self._health_addr,
-                [web.get("/healthz", healthz), web.get("/readyz", readyz)],
+                [web.get("/healthz", healthz), web.get("/readyz", readyz)]
+                + debug_routes,
             )
 
     @property

@@ -72,6 +72,7 @@ from activemonitor_tpu.metrics.collector import (
     WORKFLOW_LABEL_HEALTHCHECK,
     WORKFLOW_LABEL_REMEDY,
 )
+from activemonitor_tpu.obs.trace import Tracer
 from activemonitor_tpu.scheduler import (
     CronParseError,
     InverseExpBackoff,
@@ -94,6 +95,7 @@ class HealthCheckReconciler:
         recorder: EventRecorder,
         metrics: MetricsCollector,
         clock: Optional[Clock] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.client = client
         self.engine = engine
@@ -101,6 +103,9 @@ class HealthCheckReconciler:
         self.recorder = recorder
         self.metrics = metrics
         self.clock = clock or Clock()
+        # the reconciler owns the tracer like it owns the clock — the
+        # manager and the CLI reach it through here
+        self.tracer = tracer or Tracer(self.clock)
         self.timers = TimerWheel(self.clock)
         self._watch_tasks: Dict[str, asyncio.Task] = {}
         # set by the Manager: routes failed-run requeues through its
@@ -274,17 +279,27 @@ class HealthCheckReconciler:
             return await asyncio.to_thread(parser, hc)
         return parser(hc)
 
+    @property
+    def _engine_name(self) -> str:
+        """Label value for the engine submit/poll counters."""
+        return getattr(self.engine, "name", type(self.engine).__name__)
+
     async def _submit_workflow(self, hc: HealthCheck) -> str:
         try:
-            manifest = await self._parse_manifest(
-                parse_workflow_from_healthcheck, hc, hc.spec.workflow
-            )
+            with self.tracer.span("parse", healthcheck=hc.key):
+                manifest = await self._parse_manifest(
+                    parse_workflow_from_healthcheck, hc, hc.spec.workflow
+                )
         except Exception:
             self.recorder.event(
                 hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
             )
             raise
-        wf_name = await self.engine.submit(manifest)
+        with self.tracer.span(
+            "submit", healthcheck=hc.key, engine=self._engine_name
+        ):
+            wf_name = await self.engine.submit(manifest)
+        self.metrics.record_engine_submit(self._engine_name)
         self.recorder.event(hc, EVENT_NORMAL, "Normal", "Successfully created workflow")
         return wf_name
 
@@ -455,6 +470,7 @@ class HealthCheckReconciler:
         Returns ``(workflow, timed_out, retry)``; ``retry=True`` means
         the caller should ``continue`` its loop (workflow is None then).
         """
+        self.metrics.record_engine_poll(self._engine_name)
         try:
             if timed_out:
                 # the deadline verdict must come from the API server,
@@ -501,93 +517,111 @@ class HealthCheckReconciler:
         )
         ieb = InverseExpBackoff(params, self.clock)
         timed_out = False
-        while True:
-            now = self.clock.now()
-            workflow, timed_out, retry = await self._poll_workflow(
-                wf_namespace, wf_name, ieb, timed_out,
-                storm_rides_past_deadline=True,
-            )
-            if retry:
-                continue
-            if workflow is None:
-                # workflow GC'd / healthcheck deleted: swallow, no reschedule
-                # (reference: :618-623)
-                self.recorder.event(
-                    hc,
-                    EVENT_WARNING,
-                    "Warning",
-                    "Error attempting to find workflow for healthcheck. This may "
-                    "indicate that either the healthcheck was removed or the "
-                    "Workflow was GC'd before active-monitor could obtain the status",
+        run_remedy = False
+        polls = 0
+        # one "poll" span bounds the whole detection window (submit →
+        # terminal phase); remedy and the status write are SIBLING
+        # phases recorded after it, so per-phase durations add up to the
+        # cycle instead of nesting remedy time inside poll time
+        with self.tracer.span(
+            "poll", healthcheck=hc.key, workflow=wf_name
+        ) as poll_span:
+            while True:
+                now = self.clock.now()
+                polls += 1
+                workflow, timed_out, retry = await self._poll_workflow(
+                    wf_namespace, wf_name, ieb, timed_out,
+                    storm_rides_past_deadline=True,
                 )
-                return
-            status = workflow.get("status") or {}
-            if timed_out and status.get("phase") not in (PHASE_SUCCEEDED, PHASE_FAILED):
-                # poll deadline exceeded ⇒ synthesized failure (reference:
-                # :627-632 — though unlike the reference, a terminal phase
-                # seen on this final poll is honored rather than discarded)
-                status = {"phase": PHASE_FAILED, "message": PHASE_FAILED}
-                self.recorder.event(hc, EVENT_WARNING, "Warning", "Workflow timed out")
-            phase = status.get("phase")
-
-            if phase == PHASE_SUCCEEDED:
-                self.recorder.event(
-                    hc, EVENT_NORMAL, "Normal", "Workflow status is Succeeded"
-                )
-                hc.status.status = PHASE_SUCCEEDED
-                hc.status.started_at = then
-                hc.status.finished_at = now
-                hc.status.success_count += 1
-                hc.status.total_healthcheck_runs = (
-                    hc.status.success_count + hc.status.failed_count
-                )
-                hc.status.last_successful_workflow = wf_name
-                self.metrics.record_success(
-                    hc.metadata.name,
-                    WORKFLOW_LABEL_HEALTHCHECK,
-                    then.timestamp(),
-                    now.timestamp(),
-                )
-                # custom metrics, wired for real (reference gap: SURVEY.md §2)
-                self.metrics.record_custom_metrics(hc.metadata.name, status)
-                if not hc.spec.remedy_workflow.is_empty() and hc.status.remedy_total_runs >= 1:
-                    hc.status.reset_remedy("HealthCheck Passed so Remedy is reset")
+                if retry:
+                    continue
+                if workflow is None:
+                    # workflow GC'd / healthcheck deleted: swallow, no reschedule
+                    # (reference: :618-623)
                     self.recorder.event(
-                        hc, EVENT_NORMAL, "Normal", "HealthCheck passed so Remedy is reset"
+                        hc,
+                        EVENT_WARNING,
+                        "Warning",
+                        "Error attempting to find workflow for healthcheck. This may "
+                        "indicate that either the healthcheck was removed or the "
+                        "Workflow was GC'd before active-monitor could obtain the status",
                     )
-                break
+                    poll_span.attrs["outcome"] = "gone"
+                    return
+                status = workflow.get("status") or {}
+                if timed_out and status.get("phase") not in (PHASE_SUCCEEDED, PHASE_FAILED):
+                    # poll deadline exceeded ⇒ synthesized failure (reference:
+                    # :627-632 — though unlike the reference, a terminal phase
+                    # seen on this final poll is honored rather than discarded)
+                    status = {"phase": PHASE_FAILED, "message": PHASE_FAILED}
+                    self.recorder.event(hc, EVENT_WARNING, "Warning", "Workflow timed out")
+                phase = status.get("phase")
 
-            if phase == PHASE_FAILED:
-                self.recorder.event(
-                    hc, EVENT_WARNING, "Warning", "Workflow status is Failed"
-                )
-                hc.status.status = PHASE_FAILED
-                hc.status.started_at = then
-                hc.status.finished_at = now
-                hc.status.last_failed_at = now
-                hc.status.error_message = str(status.get("message") or "")
-                hc.status.failed_count += 1
-                hc.status.total_healthcheck_runs = (
-                    hc.status.success_count + hc.status.failed_count
-                )
-                hc.status.last_failed_workflow = wf_name
-                self.metrics.record_failure(
-                    hc.metadata.name,
-                    WORKFLOW_LABEL_HEALTHCHECK,
-                    then.timestamp(),
-                    now.timestamp(),
-                )
-                self.metrics.record_custom_metrics(hc.metadata.name, status)
-                await self._maybe_run_remedy(hc)
-                break
+                if phase == PHASE_SUCCEEDED:
+                    self.recorder.event(
+                        hc, EVENT_NORMAL, "Normal", "Workflow status is Succeeded"
+                    )
+                    hc.status.status = PHASE_SUCCEEDED
+                    hc.status.started_at = then
+                    hc.status.finished_at = now
+                    hc.status.success_count += 1
+                    hc.status.total_healthcheck_runs = (
+                        hc.status.success_count + hc.status.failed_count
+                    )
+                    hc.status.last_successful_workflow = wf_name
+                    self.metrics.record_success(
+                        hc.metadata.name,
+                        WORKFLOW_LABEL_HEALTHCHECK,
+                        then.timestamp(),
+                        now.timestamp(),
+                    )
+                    # custom metrics, wired for real (reference gap: SURVEY.md §2)
+                    self.metrics.record_custom_metrics(hc.metadata.name, status)
+                    if not hc.spec.remedy_workflow.is_empty() and hc.status.remedy_total_runs >= 1:
+                        hc.status.reset_remedy("HealthCheck Passed so Remedy is reset")
+                        self.recorder.event(
+                            hc, EVENT_NORMAL, "Normal", "HealthCheck passed so Remedy is reset"
+                        )
+                    break
 
-            if not await self._pace_poll(ieb, wf_namespace, wf_name):
-                timed_out = True
+                if phase == PHASE_FAILED:
+                    self.recorder.event(
+                        hc, EVENT_WARNING, "Warning", "Workflow status is Failed"
+                    )
+                    hc.status.status = PHASE_FAILED
+                    hc.status.started_at = then
+                    hc.status.finished_at = now
+                    hc.status.last_failed_at = now
+                    hc.status.error_message = str(status.get("message") or "")
+                    hc.status.failed_count += 1
+                    hc.status.total_healthcheck_runs = (
+                        hc.status.success_count + hc.status.failed_count
+                    )
+                    hc.status.last_failed_workflow = wf_name
+                    self.metrics.record_failure(
+                        hc.metadata.name,
+                        WORKFLOW_LABEL_HEALTHCHECK,
+                        then.timestamp(),
+                        now.timestamp(),
+                    )
+                    self.metrics.record_custom_metrics(hc.metadata.name, status)
+                    run_remedy = True
+                    break
+
+                if not await self._pace_poll(ieb, wf_namespace, wf_name):
+                    timed_out = True
+            poll_span.attrs["outcome"] = phase
+            poll_span.attrs["polls"] = polls
+        if run_remedy:
+            # same position in the flow as the reference's in-loop call
+            # (:681): after failure accounting, before the status write
+            await self._maybe_run_remedy(hc)
 
         # status write + reschedule (reference: :732-755)
         if hc.metadata.deletion_timestamp is None:
             try:
-                await self._update_status(hc)
+                with self.tracer.span("status_write", healthcheck=hc.key):
+                    await self._update_status(hc)
             except NotFoundError:
                 self.timers.stop(hc.key)
                 return
@@ -661,27 +695,39 @@ class HealthCheckReconciler:
                     return
             if hc.spec.repeat_after_sec <= 0:
                 return  # paused since the timer was armed
-            try:
-                await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_HEALTHCHECK)
-                wf_name = await self._submit_workflow(hc)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                log.exception("error creating or submitting workflow for %s", hc.key)
-                self.recorder.event(
-                    hc, EVENT_WARNING, "Warning", "Error creating or submitting workflow"
-                )
-                # the timer entry is consumed, so bailing here would end
-                # the check's schedule FOREVER (the chaos-soak tier
-                # caught exactly this: a 500 on the timer-fired resubmit
-                # left dead schedules — owed run, no timer, no watch).
-                # Ride the same requeue ladder a failed watch uses.
-                await self._requeue_until_clean(hc)
-                return
-            # already registered in _watch_tasks at the top, so
-            # reconcile's in-flight guard and wait_watches() saw this
-            # timer-driven run from before the submit
-            await self._watch_guarded(hc, wf_name)
+            # a fresh ROOT trace per timer-driven run: the timer task's
+            # context snapshot was taken when the PREVIOUS cycle armed
+            # it, so inheriting would chain every run of this check into
+            # one unbounded trace
+            with self.tracer.trace("cycle", healthcheck=hc.key, origin="timer"):
+                try:
+                    await self.rbac.create_rbac_for_workflow(
+                        hc, WORKFLOW_TYPE_HEALTHCHECK
+                    )
+                    wf_name = await self._submit_workflow(hc)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception(
+                        "error creating or submitting workflow for %s", hc.key
+                    )
+                    self.recorder.event(
+                        hc,
+                        EVENT_WARNING,
+                        "Warning",
+                        "Error creating or submitting workflow",
+                    )
+                    # the timer entry is consumed, so bailing here would end
+                    # the check's schedule FOREVER (the chaos-soak tier
+                    # caught exactly this: a 500 on the timer-fired resubmit
+                    # left dead schedules — owed run, no timer, no watch).
+                    # Ride the same requeue ladder a failed watch uses.
+                    await self._requeue_until_clean(hc)
+                    return
+                # already registered in _watch_tasks at the top, so
+                # reconcile's in-flight guard and wait_watches() saw this
+                # timer-driven run from before the submit
+                await self._watch_guarded(hc, wf_name)
 
         return resubmit
 
@@ -724,6 +770,10 @@ class HealthCheckReconciler:
             await self._process_remedy(hc)
 
     async def _process_remedy(self, hc: HealthCheck) -> None:
+        with self.tracer.span("remedy", healthcheck=hc.key):
+            await self._process_remedy_inner(hc)
+
+    async def _process_remedy_inner(self, hc: HealthCheck) -> None:
         await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_REMEDY)
         # remedy RBAC is ephemeral (reference: :779-784) — and because
         # it is the WRITE-capable identity, it must be torn down on
@@ -733,11 +783,14 @@ class HealthCheckReconciler:
         # healthcheck_controller.go:773-784; we close it)
         try:
             try:
-                manifest = await self._parse_manifest(
-                    parse_remedy_workflow_from_healthcheck,
-                    hc,
-                    hc.spec.remedy_workflow,
-                )
+                with self.tracer.span(
+                    "parse", healthcheck=hc.key, workflow_type="remedy"
+                ):
+                    manifest = await self._parse_manifest(
+                        parse_remedy_workflow_from_healthcheck,
+                        hc,
+                        hc.spec.remedy_workflow,
+                    )
             except Exception:
                 self.recorder.event(
                     hc,
@@ -746,7 +799,14 @@ class HealthCheckReconciler:
                     "Error creating or submitting remedyworkflow",
                 )
                 raise
-            wf_name = await self.engine.submit(manifest)
+            with self.tracer.span(
+                "submit",
+                healthcheck=hc.key,
+                workflow_type="remedy",
+                engine=self._engine_name,
+            ):
+                wf_name = await self.engine.submit(manifest)
+            self.metrics.record_engine_submit(self._engine_name)
             self.recorder.event(
                 hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
             )
@@ -772,6 +832,29 @@ class HealthCheckReconciler:
         params = compute_backoff_params(workflow_timeout=hc.spec.workflow.timeout)
         ieb = InverseExpBackoff(params, self.clock)
         timed_out = False
+        with self.tracer.span(
+            "poll", healthcheck=hc.key, workflow=wf_name, workflow_type="remedy"
+        ):
+            write_owed = await self._watch_remedy_loop(
+                hc, wf_name, wf_namespace, then, ieb, timed_out
+            )
+        if not write_owed:
+            return
+        if hc.metadata.deletion_timestamp is None:
+            try:
+                with self.tracer.span(
+                    "status_write", healthcheck=hc.key, workflow_type="remedy"
+                ):
+                    await self._update_status(hc)
+            except NotFoundError:
+                self.timers.stop(hc.key)
+
+    async def _watch_remedy_loop(
+        self, hc, wf_name, wf_namespace, then, ieb, timed_out
+    ) -> bool:
+        """Poll the remedy workflow to a terminal verdict and record it
+        on ``hc.status``; returns False when the workflow vanished
+        (parent deleted / GC'd) and no status write is owed."""
         while True:
             now = self.clock.now()
             workflow, timed_out, retry = await self._poll_workflow(
@@ -785,7 +868,7 @@ class HealthCheckReconciler:
             if retry:
                 continue
             if workflow is None:
-                return  # parent deleted / GC'd (reference: :806-810)
+                return False  # parent deleted / GC'd (reference: :806-810)
             status = workflow.get("status") or {}
             if timed_out and status.get("phase") not in (PHASE_SUCCEEDED, PHASE_FAILED):
                 # same final-poll policy as the healthcheck loop above: a
@@ -841,12 +924,7 @@ class HealthCheckReconciler:
 
             if not await self._pace_poll(ieb, wf_namespace, wf_name):
                 timed_out = True
-
-        if hc.metadata.deletion_timestamp is None:
-            try:
-                await self._update_status(hc)
-            except NotFoundError:
-                self.timers.stop(hc.key)
+        return True
 
     # ------------------------------------------------------------------
     # status writes (reference: updateHealthCheckStatus, :1445-1462)

@@ -51,6 +51,7 @@ class _NamespaceWatch:
         api: KubeApi,
         namespace: str,
         on_health: Optional[Callable[[str, bool], None]] = None,
+        on_restart: Optional[Callable[[str], None]] = None,
     ):
         self._api = api
         self._namespace = namespace
@@ -58,6 +59,7 @@ class _NamespaceWatch:
         self._healthy = False
         self._task: Optional[asyncio.Task] = None
         self._on_health = on_health
+        self._on_restart = on_restart
         self.changed = asyncio.Condition()
 
     @property
@@ -75,6 +77,17 @@ class _NamespaceWatch:
         if healthy != self._healthy:
             self._emit_health(healthy)
         self._healthy = healthy
+
+    def _emit_restart(self) -> None:
+        """The stream is being re-established from scratch (410 re-list
+        or an error retry) — counted so watch churn is a queryable rate,
+        not a log-grep. Seamless end-of-stream reconnects from the last
+        resourceVersion are NOT restarts; the cache stayed warm."""
+        if self._on_restart is not None:
+            try:
+                self._on_restart(self._namespace)
+            except Exception:  # observability must never break the watch
+                log.exception("watch restart callback failed")
 
     def lookup(self, name: str) -> Optional[dict]:
         """Cached object, or None on a miss (caller falls back to GET —
@@ -176,9 +189,11 @@ class _NamespaceWatch:
             except ApiError as e:
                 if e.status == 410:
                     # history expired: full re-list, cache rebuilt
+                    self._emit_restart()
                     resource_version = ""
                     continue
                 self._set_healthy(False)
+                self._emit_restart()
                 await self._notify()
                 log.warning(
                     "workflow watch for %s degraded (%s); retrying in 1s",
@@ -189,6 +204,7 @@ class _NamespaceWatch:
                 resource_version = ""
             except Exception as e:
                 self._set_healthy(False)
+                self._emit_restart()
                 await self._notify()
                 log.warning(
                     "workflow watch for %s failed (%r); retrying in 1s",
@@ -200,15 +216,19 @@ class _NamespaceWatch:
 
 
 class ArgoWorkflowEngine:
+    name = "argo"  # engine label on submit/poll counters
+
     def __init__(
         self,
         api: Optional[KubeApi] = None,
         watch: bool = True,
         on_watch_health: Optional[Callable[[str, bool], None]] = None,
+        on_watch_restart: Optional[Callable[[str], None]] = None,
     ):
         self._api = api if api is not None else KubeApi.from_default_config()
         self._watch_enabled = watch
         self._on_watch_health = on_watch_health
+        self._on_watch_restart = on_watch_restart
         self._watches: Dict[str, _NamespaceWatch] = {}
 
     def _watch_for(self, namespace: str) -> Optional[_NamespaceWatch]:
@@ -217,7 +237,10 @@ class ArgoWorkflowEngine:
         watch = self._watches.get(namespace)
         if watch is None:
             watch = _NamespaceWatch(
-                self._api, namespace, on_health=self._on_watch_health
+                self._api,
+                namespace,
+                on_health=self._on_watch_health,
+                on_restart=self._on_watch_restart,
             )
             self._watches[namespace] = watch
         watch.ensure_started()

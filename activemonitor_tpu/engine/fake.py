@@ -51,6 +51,8 @@ def never_complete() -> Completer:
 
 
 class FakeWorkflowEngine:
+    name = "fake"  # engine label on submit/poll counters
+
     def __init__(self, completer: Completer | None = None):
         self._workflows: Dict[str, dict] = {}  # key: ns/name
         self._poll_counts: Dict[str, int] = {}

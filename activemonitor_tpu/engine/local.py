@@ -58,6 +58,8 @@ class _DeadlineExceeded(RuntimeError):
 
 
 class LocalProcessEngine:
+    name = "local"  # engine label on submit/poll counters
+
     def __init__(self, env: Optional[dict] = None, default_ttl_seconds: float = 3600.0):
         self._workflows: Dict[str, dict] = {}
         self._tasks: Dict[str, asyncio.Task] = {}

@@ -1,17 +1,27 @@
 """Prometheus metrics collectors."""
 
 from activemonitor_tpu.metrics.collector import (
+    CONTROLLER_NAME,
     LABEL_HC,
     LABEL_WF,
     MetricsCollector,
+    RECONCILE_ERROR,
+    RECONCILE_REQUEUE_AFTER,
+    RECONCILE_SUCCESS,
     WORKFLOW_LABEL_HEALTHCHECK,
     WORKFLOW_LABEL_REMEDY,
+    WORKQUEUE_NAME,
 )
 
 __all__ = [
+    "CONTROLLER_NAME",
     "LABEL_HC",
     "LABEL_WF",
     "MetricsCollector",
+    "RECONCILE_ERROR",
+    "RECONCILE_REQUEUE_AFTER",
+    "RECONCILE_SUCCESS",
     "WORKFLOW_LABEL_HEALTHCHECK",
     "WORKFLOW_LABEL_REMEDY",
+    "WORKQUEUE_NAME",
 ]

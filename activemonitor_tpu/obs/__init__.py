@@ -1,0 +1,17 @@
+"""Observability: span tracing correlated with logs, events, metrics."""
+
+from activemonitor_tpu.obs.trace import (
+    Span,
+    Tracer,
+    current_span,
+    current_trace_id,
+    detached,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "current_span",
+    "current_trace_id",
+    "detached",
+]

@@ -1,0 +1,286 @@
+"""Lightweight span tracer for the probe lifecycle.
+
+The reference operator has no tracing at all: between ``enqueue()`` and
+the final status write a HealthCheck cycle is invisible, which is
+exactly the window where a slow manifest fetch, a hung engine submit,
+or a starved workqueue hides. One trace per reconcile cycle with
+per-phase durations (dequeue → parse → submit → poll → status-write →
+remedy) makes that window attributable — the prerequisite for goodput
+work (PAPERS.md: per-cycle time attribution).
+
+Design constraints that shaped this module:
+
+- **contextvar propagation, explicit handoff across the queue.** The
+  current span lives in a :mod:`contextvars` variable, so it follows
+  ``await`` chains and ``asyncio.create_task`` (which snapshots the
+  context) for free — the reconciler's detached watch task inherits
+  the cycle's trace without any plumbing. The one place context cannot
+  flow by itself is the workqueue (enqueue happens on the watch task,
+  dequeue on a worker task that existed first), so the manager carries
+  the trace id in its pending-key table and the worker re-roots it.
+- **injectable clock.** All timestamps come from
+  :class:`~activemonitor_tpu.utils.clock.Clock`, so fake-clock tests
+  assert exact durations, and span timing composes with the repo's
+  no-sleeps test discipline.
+- **bounded memory.** Finished spans land in a ring
+  (``maxlen=capacity``); a long-lived controller can trace forever
+  without growing. Open spans are not tracked globally — an abandoned
+  span simply never reaches the ring.
+- **never raises into the traced path.** Tracing is observability;
+  every public entry point degrades to a no-op rather than break a
+  reconcile.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import datetime
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+from activemonitor_tpu.utils.clock import Clock
+
+# the active span, task-local via contextvars; None outside any span
+_CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "activemonitor_span", default=None
+)
+
+DEFAULT_CAPACITY = 4096  # finished spans retained (ring)
+
+
+def _new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_span() -> Optional["Span"]:
+    """The span the calling task is inside, or None."""
+    return _CURRENT.get()
+
+
+class detached:
+    """``with detached():`` — run a block outside any span. Deferred
+    callbacks (timer fires) execute under a context snapshot taken when
+    they were ARMED; without detaching, a stale span from the arming
+    cycle would adopt everything the callback does into a long-dead
+    trace."""
+
+    __slots__ = ("_token",)
+
+    def __enter__(self) -> None:
+        self._token = _CURRENT.set(None)
+
+    def __exit__(self, *_exc) -> None:
+        _CURRENT.reset(self._token)
+
+
+def current_trace_id() -> str:
+    """Trace id of the active span, or "" outside any span — what log
+    lines and events stamp for correlation."""
+    span = _CURRENT.get()
+    return span.trace_id if span is not None else ""
+
+
+@dataclass
+class Span:
+    """One timed phase of a trace. ``end``/``duration`` are stamped on
+    exit; ``error`` records the exception type that escaped the span."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str
+    name: str
+    start: float  # clock.monotonic() at entry
+    start_ts: str  # clock.now() ISO form, for humans reading exports
+    end: Optional[float] = None
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    error: str = ""
+
+    @property
+    def duration(self) -> Optional[float]:
+        if self.end is None:
+            return None
+        return max(0.0, self.end - self.start)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_ts": self.start_ts,
+            "duration_seconds": self.duration,
+            "attrs": dict(self.attrs),
+            "error": self.error,
+        }
+
+
+class _SpanScope:
+    """Context manager returned by :meth:`Tracer.span` /
+    :meth:`Tracer.trace`. Plain ``with`` works in async code too —
+    contextvars set/reset inside one task compose with ``await``."""
+
+    __slots__ = ("_tracer", "_span", "_token")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+        self._token: Optional[contextvars.Token] = None
+
+    def __enter__(self) -> Span:
+        self._token = _CURRENT.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+        if exc_type is not None and not self._span.error:
+            self._span.error = exc_type.__name__
+        self._tracer._finish(self._span)
+
+
+class Tracer:
+    """Creates spans and retains the finished ones in a bounded ring.
+
+    One tracer per controller process (the reconciler owns it, the
+    manager reaches it through ``reconciler.tracer`` — the same
+    ownership shape as the clock and the metrics collector).
+    """
+
+    def __init__(
+        self, clock: Optional[Clock] = None, capacity: int = DEFAULT_CAPACITY
+    ):
+        self.clock = clock or Clock()
+        self._finished: Deque[Span] = collections.deque(maxlen=max(1, capacity))
+
+    # -- span creation -------------------------------------------------
+    def new_trace_id(self) -> str:
+        """Pre-allocate a trace id for cross-task handoff (the manager
+        mints one at enqueue time; the worker roots the cycle on it so
+        queue wait and reconcile share a trace)."""
+        return _new_trace_id()
+
+    def span(self, name: str, **attrs: Any) -> _SpanScope:
+        """Open a child span of whatever span the task is inside, or a
+        fresh single-span trace outside any."""
+        parent = _CURRENT.get()
+        return self._scope(
+            name,
+            trace_id=parent.trace_id if parent else _new_trace_id(),
+            parent_id=parent.span_id if parent else "",
+            attrs=attrs,
+        )
+
+    def trace(
+        self, name: str, trace_id: Optional[str] = None, **attrs: Any
+    ) -> _SpanScope:
+        """Open a ROOT span, deliberately ignoring any inherited
+        context. The worker loop and timer-fired resubmissions need
+        this: both run in tasks whose snapshot may still carry a
+        previous cycle's span, and chaining cycles together would merge
+        every run of a check into one unbounded trace."""
+        return self._scope(
+            name, trace_id=trace_id or _new_trace_id(), parent_id="", attrs=attrs
+        )
+
+    def _scope(
+        self, name: str, trace_id: str, parent_id: str, attrs: Dict[str, Any]
+    ) -> _SpanScope:
+        span = Span(
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_id=parent_id,
+            name=name,
+            start=self.clock.monotonic(),
+            start_ts=self.clock.now().isoformat(),
+            attrs=attrs,
+        )
+        return _SpanScope(self, span)
+
+    def record_span(
+        self,
+        name: str,
+        start: float,
+        end: Optional[float] = None,
+        **attrs: Any,
+    ) -> Span:
+        """Record an already-elapsed phase (the queue-wait span: its
+        start happened on another task, before any span existed)."""
+        parent = _CURRENT.get()
+        end_m = self.clock.monotonic() if end is None else end
+        # start_ts must be the phase's START on the wall clock — project
+        # the monotonic elapsed back from now, or a 30 s queue wait
+        # would claim to begin at the instant it ended and the exported
+        # timeline wouldn't line up
+        elapsed = max(0.0, end_m - start)
+        span = Span(
+            trace_id=parent.trace_id if parent else _new_trace_id(),
+            span_id=_new_span_id(),
+            parent_id=parent.span_id if parent else "",
+            name=name,
+            start=start,
+            start_ts=(
+                self.clock.now() - datetime.timedelta(seconds=elapsed)
+            ).isoformat(),
+            end=end_m,
+            attrs=attrs,
+        )
+        self._finish(span)
+        return span
+
+    def _finish(self, span: Span) -> None:
+        if span.end is None:
+            span.end = self.clock.monotonic()
+        self._finished.append(span)  # deque maxlen evicts the oldest
+
+    # -- export --------------------------------------------------------
+    @property
+    def finished_spans(self) -> List[Span]:
+        return list(self._finished)
+
+    def traces(self) -> List[dict]:
+        """Finished spans grouped per trace, oldest trace first — the
+        `/debug/traces` payload and the JSONL export unit."""
+        grouped: Dict[str, List[Span]] = {}
+        order: List[str] = []
+        for span in self._finished:
+            if span.trace_id not in grouped:
+                grouped[span.trace_id] = []
+                order.append(span.trace_id)
+            grouped[span.trace_id].append(span)
+        out = []
+        for trace_id in order:
+            spans = grouped[trace_id]
+            out.append(
+                {
+                    "trace_id": trace_id,
+                    "span_count": len(spans),
+                    "spans": [s.to_dict() for s in spans],
+                }
+            )
+        return out
+
+    def export_jsonl(self, path: str) -> int:
+        """Dump one JSON line per trace; returns how many were written.
+        Best-effort by contract (shutdown path): an unwritable path
+        logs nothing here — the caller decides how loud to be."""
+        traces = self.traces()
+        with open(path, "w") as f:
+            for trace in traces:
+                f.write(json.dumps(trace, default=str) + "\n")
+        return len(traces)
+
+    @staticmethod
+    def read_jsonl(path: str) -> Iterator[dict]:
+        """Parse an export back (tests, offline analysis)."""
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)

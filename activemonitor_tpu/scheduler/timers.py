@@ -46,9 +46,16 @@ class TimerWheel:
     async def _fire(
         self, name: str, delay_seconds: float, fn: Callable[[], Awaitable[None]]
     ) -> None:
+        from activemonitor_tpu.obs.trace import detached
+
         try:
             await self._clock.sleep(delay_seconds)
-            await fn()
+            # the timer task's context snapshot was taken when the timer
+            # was ARMED (usually inside the previous cycle's trace) —
+            # fire trace-clean so the callback's spans never adopt into
+            # a long-finished trace
+            with detached():
+                await fn()
         except asyncio.CancelledError:
             raise
         except Exception:

@@ -5,13 +5,25 @@ Reference parity: controller-runtime binds zap's flagset
 json|console`` and a level flag. Here the same two knobs are
 ``--log-format json|text`` and ``--log-level``, wired in __main__.
 JSON lines carry the fields log pipelines key on (ts/level/logger/msg,
-plus the exception traceback when present).
+plus the exception traceback when present), every ``extra={...}``
+structured field the call site attached, and — inside an active trace
+span — ``trace_id``/``span`` so a log line joins its reconcile cycle's
+trace, events, and metrics.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+
+# the attribute names every LogRecord carries by construction — anything
+# beyond these on a record's __dict__ arrived via ``extra={...}`` (or an
+# adapter) and is a structured field the caller wants emitted. Derived
+# from a probe record, not hardcoded, so interpreter additions (3.12's
+# ``taskName``) never leak into log lines as phantom extras.
+_STANDARD_ATTRS = frozenset(
+    logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+) | {"message", "asctime", "taskName"}
 
 
 class JsonFormatter(logging.Formatter):
@@ -22,9 +34,26 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        # extra={...} fields survive (the silent-drop fix): anything the
+        # call site attached rides the line, losing only on a collision
+        # with the four envelope keys above
+        for key, value in record.__dict__.items():
+            if key in _STANDARD_ATTRS or key.startswith("_") or key in doc:
+                continue
+            doc[key] = value
+        # trace correlation: a line logged inside a span carries its
+        # trace so `grep trace_id` reconstructs one cycle across logs,
+        # events, and /debug/traces. Imported lazily: logfmt must stay
+        # importable from anywhere without dragging the obs package in.
+        from activemonitor_tpu.obs.trace import current_span
+
+        span = current_span()
+        if span is not None:
+            doc.setdefault("trace_id", span.trace_id)
+            doc.setdefault("span", span.name)
         if record.exc_info:
             doc["exception"] = self.formatException(record.exc_info)
-        return json.dumps(doc)
+        return json.dumps(doc, default=str)
 
 
 def configure_logging(level: str = "INFO", fmt: str = "text") -> None:

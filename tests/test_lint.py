@@ -214,6 +214,99 @@ def test_unused_parameter_exemptions_hold(tmp_path):
     assert findings(tmp_path, quiet) == []
 
 
+def test_event_reasons_come_from_declared_table():
+    """Every EventRecorder.event() call site in the package must draw
+    its reason (3rd argument) from events.EVENT_REASONS — the reference
+    free-hands ~40 reason strings and dashboards grouping on reason
+    break on the first typo. Literals are checked by value; names must
+    be the declared REASON_*/EVENT_* constants. events.py itself is
+    exempt (its recorder methods forward a `reason` parameter)."""
+    import ast
+
+    from activemonitor_tpu.controller import events as events_mod
+
+    declared = events_mod.EVENT_REASONS
+    const_names = {
+        name
+        for name in vars(events_mod)
+        if name.startswith(("REASON_", "EVENT_"))
+    }
+    violations = []
+    for path in sorted((REPO / "activemonitor_tpu").rglob("*.py")):
+        if path.name == "events.py":
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and len(node.args) + len(node.keywords) >= 4
+            ):
+                continue
+            # the reason may arrive positionally (3rd arg) or as a
+            # keyword — both forms must pass through the gate
+            reason = node.args[2] if len(node.args) >= 3 else None
+            for kw in node.keywords:
+                if kw.arg == "reason":
+                    reason = kw.value
+            if reason is None:
+                continue
+            if isinstance(reason, ast.Constant) and isinstance(reason.value, str):
+                if reason.value not in declared:
+                    violations.append(
+                        f"{path}:{node.lineno}: ad-hoc event reason "
+                        f"{reason.value!r} (declare it in events.EVENT_REASONS)"
+                    )
+            elif isinstance(reason, ast.Name):
+                if reason.id not in const_names:
+                    violations.append(
+                        f"{path}:{node.lineno}: event reason from "
+                        f"undeclared name {reason.id!r}"
+                    )
+            else:
+                violations.append(
+                    f"{path}:{node.lineno}: event reason is a computed "
+                    "expression — use a declared constant"
+                )
+    assert violations == []
+
+
+def test_declared_metric_names_pass_the_sanitizer():
+    """Every statically-declared Prometheus metric name in the package
+    must already be in sanitized, exposition-legal form — a name the
+    sanitizer would rewrite means the declared name and the scraped
+    name silently diverge."""
+    import ast
+    import re
+
+    from activemonitor_tpu.metrics.collector import _sanitize
+
+    legal = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+    names = []
+    for path in sorted((REPO / "activemonitor_tpu").rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in {"Gauge", "Counter", "Histogram", "Summary"}
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            names.append((path, node.lineno, node.args[0].value))
+    # the static families must actually be found (a refactor that moves
+    # them out of AST reach would hollow this gate out silently)
+    assert len(names) >= 15
+    for path, lineno, name in names:
+        assert legal.match(name), f"{path}:{lineno}: illegal metric name {name!r}"
+        assert _sanitize(name) == name, (
+            f"{path}:{lineno}: metric name {name!r} is not in sanitized form"
+        )
+
+
 def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
     got = findings(
         tmp_path,

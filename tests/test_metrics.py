@@ -176,6 +176,101 @@ def test_no_outputs_is_noop(collector):
     assert collector.record_custom_metrics("hc", {"outputs": {"parameters": None}}) == 0
 
 
+REFERENCE_SCRAPE_NAMES = (
+    # the exact names the reference exposes (collector.go:19-48) —
+    # dashboards and alerts scrape these verbatim
+    "healthcheck_success_count",
+    "healthcheck_error_count",
+    "healthcheck_runtime_seconds",
+    "healthcheck_starttime",
+    "healthcheck_finishedtime",
+)
+
+
+def test_scrape_text_pins_reference_names_without_total_suffix(collector):
+    """The exposition contract, asserted on the scrape text itself:
+    prometheus_client appends `_total` to Counter samples, so the two
+    reference counters are deliberately Gauges (collector.py) — this
+    test is the tripwire that keeps that workaround from regressing."""
+    collector.record_success("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 0, 1)
+    collector.record_failure("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 1, 2)
+    lines = collector.exposition().decode().splitlines()
+    for name in REFERENCE_SCRAPE_NAMES:
+        assert any(
+            line.startswith(name + "{") for line in lines
+        ), f"reference metric {name} missing from scrape"
+        assert not any(
+            line.startswith(name + "_total{") for line in lines
+        ), f"{name} grew a _total suffix — scrape contract broken"
+
+
+def test_scrape_text_exposes_controller_runtime_parity_families(collector):
+    collector.record_reconcile("success", 0.25)
+    collector.record_queue_add(1)
+    collector.record_queue_get(0, 0.05)
+    collector.record_work_duration(0.2)
+    collector.set_active_workers(1)
+    collector.set_max_concurrent(10)
+    collector.record_engine_submit("fake")
+    collector.record_engine_poll("fake")
+    collector.record_watch_restart("health")
+    lines = collector.exposition().decode().splitlines()
+
+    def sample(prefix):
+        return any(line.startswith(prefix) for line in lines)
+
+    assert sample('controller_runtime_reconcile_total{controller="healthcheck",result="success"}')
+    assert sample("controller_runtime_reconcile_time_seconds_bucket{")
+    assert sample("controller_runtime_reconcile_time_seconds_count{")
+    assert sample('controller_runtime_active_workers{controller="healthcheck"}')
+    assert sample("controller_runtime_max_concurrent_reconciles{")
+    assert sample('workqueue_depth{name="healthcheck"}')
+    assert sample('workqueue_adds_total{name="healthcheck"}')
+    assert sample("workqueue_queue_duration_seconds_bucket{")
+    assert sample("workqueue_work_duration_seconds_bucket{")
+    assert sample('engine_submit_total{engine="fake"}')
+    assert sample('engine_poll_total{engine="fake"}')
+    assert sample('workflow_watch_restarts_total{namespace="health"}')
+
+
+def test_reconcile_and_queue_recorders_accumulate(collector):
+    collector.record_reconcile("success", 0.5)
+    collector.record_reconcile("success", 1.5)
+    collector.record_reconcile("error", 0.1)
+    assert (
+        collector.sample_value(
+            "controller_runtime_reconcile_total",
+            {"controller": "healthcheck", "result": "success"},
+        )
+        == 2
+    )
+    assert (
+        collector.sample_value(
+            "controller_runtime_reconcile_time_seconds_sum",
+            {"controller": "healthcheck"},
+        )
+        == 2.1
+    )
+    collector.record_queue_add(3)
+    assert collector.sample_value("workqueue_depth", {"name": "healthcheck"}) == 3
+    collector.record_queue_get(2, 0.25)
+    assert collector.sample_value("workqueue_depth", {"name": "healthcheck"}) == 2
+    assert (
+        collector.sample_value(
+            "workqueue_queue_duration_seconds_sum", {"name": "healthcheck"}
+        )
+        == 0.25
+    )
+    # negative wait (clock skew) is clamped, never raises
+    collector.record_queue_get(1, -5.0)
+    assert (
+        collector.sample_value(
+            "workqueue_queue_duration_seconds_sum", {"name": "healthcheck"}
+        )
+        == 0.25
+    )
+
+
 def test_two_collectors_do_not_share_registries():
     # the reference's global registry caused a documented race
     # (collector_test.go:82-88); per-instance registries avoid it

@@ -1,0 +1,418 @@
+"""Tracing tests: the span tracer itself, its correlation with logs and
+events, and the full-lifecycle e2e trace of a FakeEngine reconcile —
+the acceptance slice of ISSUE 1 (one cycle ⇒ one trace with the
+dequeue/parse/submit/poll/status-write phases, all carrying the same
+trace_id as the cycle's log lines and events).
+"""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine, succeed_after
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs import Tracer, current_span, current_trace_id
+from activemonitor_tpu.utils.clock import FakeClock
+from activemonitor_tpu.utils.logfmt import JsonFormatter
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+
+def make_hc(name="hc-a", repeat=60):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": repeat,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": f"{name}-",
+                    "workflowtimeout": 5,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "sa",
+                        "source": {"inline": WF_INLINE},
+                    },
+                },
+            },
+        }
+    )
+
+
+# ---------------------------------------------------------------------
+# tracer unit behavior
+# ---------------------------------------------------------------------
+
+
+def test_span_nesting_and_context_restore():
+    tracer = Tracer(FakeClock())
+    assert current_span() is None
+    with tracer.span("outer") as outer:
+        assert current_span() is outer
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        assert current_span() is outer
+    assert current_span() is None
+    names = [s.name for s in tracer.finished_spans]
+    assert names == ["inner", "outer"]  # finish order: inner closed first
+
+
+def test_sibling_spans_without_root_get_separate_traces():
+    tracer = Tracer(FakeClock())
+    with tracer.span("a"):
+        pass
+    with tracer.span("b"):
+        pass
+    a, b = tracer.finished_spans
+    assert a.trace_id != b.trace_id
+
+
+def test_trace_forces_new_root_even_inside_a_span():
+    tracer = Tracer(FakeClock())
+    with tracer.span("old-cycle") as old:
+        with tracer.trace("new-cycle") as fresh:
+            assert fresh.trace_id != old.trace_id
+            assert fresh.parent_id == ""
+
+
+def test_durations_come_from_injected_clock():
+    clock = FakeClock()
+
+    async def run():
+        tracer = Tracer(clock)
+        with tracer.span("timed"):
+            await clock.advance(7.5)
+        return tracer.finished_spans[0]
+
+    span = asyncio.run(run())
+    assert span.duration == 7.5
+
+
+def test_span_records_escaped_exception_type():
+    tracer = Tracer(FakeClock())
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("x")
+    assert tracer.finished_spans[0].error == "ValueError"
+
+
+def test_ring_is_bounded():
+    tracer = Tracer(FakeClock(), capacity=10)
+    for i in range(35):
+        with tracer.span(f"s{i}"):
+            pass
+    spans = tracer.finished_spans
+    assert len(spans) <= 10
+    assert spans[-1].name == "s34"  # newest retained, oldest dropped
+
+
+def test_record_span_attaches_to_current_trace():
+    clock = FakeClock(start=100.0)
+    tracer = Tracer(clock)
+    with tracer.span("root") as root:
+        recorded = tracer.record_span("queue-wait", start=90.0)
+    assert recorded.trace_id == root.trace_id
+    assert recorded.parent_id == root.span_id
+    assert recorded.duration == 10.0
+
+
+def test_context_propagates_into_created_tasks():
+    tracer = Tracer(FakeClock())
+
+    async def run():
+        async def child():
+            return current_trace_id()
+
+        with tracer.span("parent") as span:
+            inherited = await asyncio.create_task(child())
+        return span.trace_id, inherited
+
+    trace_id, inherited = asyncio.run(run())
+    assert inherited == trace_id
+
+
+def test_timer_callbacks_fire_outside_any_span():
+    """A timer armed inside a cycle's span must not adopt its callback
+    into that (long-finished) trace — the wheel fires trace-clean."""
+    from activemonitor_tpu.scheduler import TimerWheel
+
+    clock = FakeClock()
+    tracer = Tracer(clock)
+
+    async def drive():
+        wheel = TimerWheel(clock)
+        seen = {}
+
+        async def callback():
+            seen["trace_id"] = current_trace_id()
+
+        with tracer.span("arming-cycle"):
+            wheel.schedule("k", 5.0, callback)
+        await clock.advance(6.0)
+        await wheel.shutdown()
+        return seen["trace_id"]
+
+    assert asyncio.run(drive()) == ""
+
+
+def test_export_jsonl_roundtrip(tmp_path):
+    tracer = Tracer(FakeClock())
+    with tracer.span("a"):
+        with tracer.span("b"):
+            pass
+    with tracer.span("c"):
+        pass
+    path = str(tmp_path / "traces.jsonl")
+    assert tracer.export_jsonl(path) == 2  # two traces, one line each
+    traces = list(Tracer.read_jsonl(path))
+    assert len(traces) == 2
+    assert traces[0]["span_count"] == 2
+    assert {s["name"] for s in traces[0]["spans"]} == {"a", "b"}
+
+
+# ---------------------------------------------------------------------
+# correlation: log lines and events carry the active trace
+# ---------------------------------------------------------------------
+
+
+def fmt_record(logger_name, msg, **extra):
+    record = logging.LogRecord(
+        logger_name, logging.INFO, __file__, 1, msg, (), None
+    )
+    for key, value in extra.items():
+        setattr(record, key, value)
+    return json.loads(JsonFormatter().format(record))
+
+
+def test_json_formatter_emits_extra_fields():
+    # the silent-drop fix: extra={...} structured fields survive
+    doc = fmt_record("x", "hello", healthcheck="ns/hc", attempt=3)
+    assert doc["msg"] == "hello"
+    assert doc["healthcheck"] == "ns/hc"
+    assert doc["attempt"] == 3
+
+
+def test_json_formatter_does_not_leak_record_internals():
+    doc = fmt_record("x", "hello")
+    for internal in ("args", "levelno", "msecs", "process", "taskName"):
+        assert internal not in doc
+
+
+def test_json_formatter_stamps_trace_inside_span():
+    tracer = Tracer(FakeClock())
+    with tracer.span("poll") as span:
+        doc = fmt_record("x", "polling")
+    assert doc["trace_id"] == span.trace_id
+    assert doc["span"] == "poll"
+    # outside any span: no phantom correlation keys
+    assert "trace_id" not in fmt_record("x", "idle")
+
+
+def test_event_recorder_stamps_trace_id():
+    tracer = Tracer(FakeClock())
+    recorder = EventRecorder()
+    hc = make_hc()
+    with tracer.span("cycle") as span:
+        recorder.event(hc, "Normal", "Normal", "inside")
+    recorder.event(hc, "Normal", "Normal", "outside")
+    inside, outside = recorder.events_for("health", "hc-a")
+    assert inside.trace_id == span.trace_id
+    assert outside.trace_id == ""
+    assert inside.to_dict()["trace_id"] == span.trace_id
+
+
+# ---------------------------------------------------------------------
+# e2e: one FakeEngine reconcile ⇒ one full-lifecycle trace
+# ---------------------------------------------------------------------
+
+
+class CapturingHandler(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(self.format(record))
+
+
+def make_stack(clock=None):
+    clock = clock or FakeClock()
+    client = InMemoryHealthCheckClient()
+    engine = FakeWorkflowEngine(succeed_after(1))
+    recorder = EventRecorder()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=recorder,
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+    return manager, client, reconciler
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+@pytest.mark.asyncio
+async def test_fake_engine_reconcile_produces_full_trace(tmp_path):
+    handler = CapturingHandler()
+    handler.setFormatter(JsonFormatter())
+    events_log = logging.getLogger("activemonitor.events")
+    events_log.addHandler(handler)
+    old_level = events_log.level
+    events_log.setLevel(logging.INFO)
+    manager, client, reconciler = make_stack()
+    await manager.start()
+    try:
+        await client.apply(make_hc())
+        await settle()
+        await reconciler.wait_watches()
+        await settle()
+    finally:
+        events_log.removeHandler(handler)
+        events_log.setLevel(old_level)
+        await manager.stop()
+
+    traces = reconciler.tracer.traces()
+    # exactly one cycle SUBMITS (the status write's own watch event
+    # re-enqueues, but that second cycle dedupes out as a no-op trace)
+    [trace] = [
+        t for t in traces if any(s["name"] == "submit" for s in t["spans"])
+    ]
+    names = [s["name"] for s in trace["spans"]]
+    for phase in ("dequeue", "parse", "submit", "poll", "status_write"):
+        assert phase in names, f"missing phase span {phase!r} in {names}"
+    assert trace["span_count"] >= 5
+    for span in trace["spans"]:
+        assert span["duration_seconds"] is not None
+        assert span["duration_seconds"] >= 0.0
+        assert span["trace_id"] == trace["trace_id"]
+
+    # events of the cycle carry the same trace_id
+    recorder = reconciler.recorder
+    cycle_events = [
+        e for e in recorder.events_for("health", "hc-a") if e.trace_id
+    ]
+    assert cycle_events, "no events stamped with the cycle trace"
+    assert {e.trace_id for e in cycle_events} == {trace["trace_id"]}
+
+    # ... and so do the JSON log lines those events emitted
+    logged = [json.loads(line) for line in handler.lines]
+    traced_lines = [d for d in logged if "trace_id" in d]
+    assert traced_lines, "no correlated log lines captured"
+    assert {d["trace_id"] for d in traced_lines} == {trace["trace_id"]}
+
+    # the --trace-export payload for this cycle round-trips
+    path = str(tmp_path / "export.jsonl")
+    assert reconciler.tracer.export_jsonl(path) == len(traces)
+    read_back = [t for t in Tracer.read_jsonl(path) if t["trace_id"] == trace["trace_id"]]
+    assert read_back and read_back[0]["span_count"] == trace["span_count"]
+
+
+@pytest.mark.asyncio
+async def test_debug_endpoints_serve_traces_and_events():
+    import aiohttp
+
+    manager, client, reconciler = make_stack()
+    manager._health_addr = "127.0.0.1:0"  # ephemeral: no port clashes
+    await manager.start()
+    port = manager._http_runners[0].addresses[0][1]
+    try:
+        await client.apply(make_hc())
+        await settle()
+        await reconciler.wait_watches()
+        await settle()
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/traces"
+            ) as r:
+                assert r.status == 200
+                payload = await r.json()
+        assert payload["traces"], "no traces served"
+        trace = next(
+            t
+            for t in payload["traces"]
+            if any(s["name"] == "submit" for s in t["spans"])
+        )
+        trace_id = trace["trace_id"]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/events",
+                params={"trace_id": trace_id},
+            ) as r:
+                assert r.status == 200
+                events = (await r.json())["events"]
+        assert events and all(e["trace_id"] == trace_id for e in events)
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_debug_endpoints_enforce_metrics_auth_on_shared_site():
+    """When /debug shares the socket with an auth-filtered /metrics,
+    the same token gate applies — the merged site must not leak the
+    operational data the operator put a token in front of."""
+    import aiohttp
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=FakeWorkflowEngine(succeed_after(1)),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        metrics_bind_address="127.0.0.1:0",
+        health_probe_bind_address="127.0.0.1:0",
+        metrics_auth_token="sekrit",
+    )
+    await manager.start()
+    port = manager._http_runners[0].addresses[0][1]
+    try:
+        async with aiohttp.ClientSession() as session:
+            for path in ("/debug/traces", "/debug/events", "/metrics"):
+                async with session.get(f"http://127.0.0.1:{port}{path}") as r:
+                    assert r.status == 401, path
+            # the kubelet's probes stay open
+            async with session.get(f"http://127.0.0.1:{port}/healthz") as r:
+                assert r.status == 200
+            headers = {"Authorization": "Bearer sekrit"}
+            for path in ("/debug/traces", "/debug/events", "/metrics"):
+                async with session.get(
+                    f"http://127.0.0.1:{port}{path}", headers=headers
+                ) as r:
+                    assert r.status == 200, path
+    finally:
+        await manager.stop()
+
+
+def test_trace_export_flag_is_wired():
+    from activemonitor_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(
+        ["run", "--trace-export", "/tmp/traces.jsonl"]
+    )
+    assert args.trace_export == "/tmp/traces.jsonl"
+    # default: no export
+    assert build_parser().parse_args(["run"]).trace_export == ""

@@ -1,0 +1,156 @@
+"""Concurrent workqueue behavior under the new telemetry: N workers
+draining M enqueued checks, with the depth/latency families asserted
+against the injectable clock — no real sleeps anywhere (ISSUE 1
+satellite). The reconcile body is a scripted hold on the fake clock so
+queue waves are fully deterministic: 4 workers × 3 waves of 10 s.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.utils.clock import FakeClock
+
+WORKERS = 4
+CHECKS = 12
+HOLD_SECONDS = 10.0
+
+Q = {"name": "healthcheck"}
+C = {"controller": "healthcheck"}
+
+
+def make_manager(clock):
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=FakeWorkflowEngine(),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    return Manager(client=client, reconciler=reconciler, max_parallel=WORKERS)
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+@pytest.mark.asyncio
+async def test_n_workers_drain_m_checks_with_monotone_depth():
+    clock = FakeClock()
+    manager = make_manager(clock)
+    metrics = manager.reconciler.metrics
+
+    async def held_reconcile(_namespace, _name):
+        await clock.sleep(HOLD_SECONDS)
+        return None
+
+    manager.reconciler.reconcile = held_reconcile
+    await manager.start()
+    try:
+        for i in range(CHECKS):
+            manager.enqueue("health", f"hc-{i}")
+        # all adds landed before any worker ran (no await yet)
+        assert metrics.sample_value("workqueue_adds_total", Q) == CHECKS
+        assert metrics.sample_value("workqueue_depth", Q) == CHECKS
+
+        depths = [metrics.sample_value("workqueue_depth", Q)]
+        await settle()  # workers claim the first wave
+        depths.append(metrics.sample_value("workqueue_depth", Q))
+        assert metrics.sample_value(
+            "controller_runtime_active_workers", C
+        ) == WORKERS
+        for _wave in range(CHECKS // WORKERS):
+            await clock.advance(HOLD_SECONDS)
+            depths.append(metrics.sample_value("workqueue_depth", Q))
+
+        # depth shrank monotonically and hit zero at drain
+        assert depths == sorted(depths, reverse=True)
+        assert depths[0] == CHECKS
+        assert depths[-1] == 0.0
+        assert manager._queue.qsize() == 0
+        assert metrics.sample_value(
+            "controller_runtime_active_workers", C
+        ) == 0
+
+        # queue-wait latency: wave k waited k * HOLD_SECONDS, so the sum
+        # over 3 waves of 4 is 4*(0 + 10 + 20) — exact on the fake clock
+        assert (
+            metrics.sample_value("workqueue_queue_duration_seconds_count", Q)
+            == CHECKS
+        )
+        assert metrics.sample_value(
+            "workqueue_queue_duration_seconds_sum", Q
+        ) == pytest.approx(4 * (0 + 10 + 20))
+
+        # work duration: every item held the worker for exactly 10 s
+        assert (
+            metrics.sample_value("workqueue_work_duration_seconds_count", Q)
+            == CHECKS
+        )
+        assert metrics.sample_value(
+            "workqueue_work_duration_seconds_sum", Q
+        ) == pytest.approx(CHECKS * HOLD_SECONDS)
+
+        # every reconcile completed cleanly and was timed
+        assert metrics.sample_value(
+            "controller_runtime_reconcile_total",
+            {"controller": "healthcheck", "result": "success"},
+        ) == CHECKS
+        assert metrics.sample_value(
+            "controller_runtime_reconcile_time_seconds_count", C
+        ) == CHECKS
+        assert metrics.sample_value(
+            "controller_runtime_max_concurrent_reconciles", C
+        ) == WORKERS
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_coalesced_enqueues_count_every_add_but_queue_once():
+    clock = FakeClock()
+    manager = make_manager(clock)
+    metrics = manager.reconciler.metrics
+    # client-go semantics: adds_total counts every Add() — coalesced
+    # included — while the queue itself holds the key once
+    manager.enqueue("health", "hc-a")
+    manager.enqueue("health", "hc-a")
+    manager.enqueue("health", "hc-a")
+    assert metrics.sample_value("workqueue_adds_total", Q) == 3
+    assert metrics.sample_value("workqueue_depth", Q) == 1
+    assert manager._queue.qsize() == 1
+
+
+@pytest.mark.asyncio
+async def test_crashing_reconcile_counts_as_error_result():
+    clock = FakeClock()
+    manager = make_manager(clock)
+    metrics = manager.reconciler.metrics
+
+    async def crashing_reconcile(_namespace, _name):
+        raise RuntimeError("boom")
+
+    manager.reconciler.reconcile = crashing_reconcile
+    await manager.start()
+    try:
+        manager.enqueue("health", "hc-a")
+        await settle()
+        assert metrics.sample_value(
+            "controller_runtime_reconcile_total",
+            {"controller": "healthcheck", "result": "error"},
+        ) == 1
+    finally:
+        await manager.stop()
