@@ -167,6 +167,27 @@ def build_parser() -> argparse.ArgumentParser:
     add_client_flags(describe)
     describe.add_argument("--namespace", "-n", default="default")
 
+    status = sub.add_parser(
+        "status",
+        help="fleet SLO summary from a running controller's /statusz",
+    )
+    status.add_argument(
+        "--url",
+        default="http://127.0.0.1:8081/statusz",
+        help="the controller's /statusz endpoint (the health-probe "
+        "address by default; point at the metrics address when the "
+        "sites are merged)",
+    )
+    status.add_argument(
+        "--token",
+        default="",
+        help="bearer token, needed only against a merged site whose "
+        "/metrics is auth-filtered",
+    )
+    status.add_argument(
+        "-o", "--output", choices=["table", "json"], default="table"
+    )
+
     sub.add_parser("crd", help="print the HealthCheck CRD manifest")
     sub.add_parser("version", help="print version")
     return parser
@@ -529,6 +550,95 @@ async def _get_inner(args, client) -> int:
     return 0
 
 
+def _fmt_ratio(value) -> str:
+    return "-" if value is None else f"{value * 100:.1f}%"
+
+
+def _fmt_seconds(value) -> str:
+    return "-" if value is None else f"{value:.2f}s"
+
+
+def render_status_table(payload: dict) -> str:
+    """The /statusz payload as the `am-tpu status` table. Pure so tests
+    pin the rendering against a canned payload."""
+    fleet = payload.get("fleet") or {}
+    lines = [
+        "FLEET  checks={}  window_runs={}  goodput={}".format(
+            fleet.get("checks", 0),
+            fleet.get("window_runs", 0),
+            _fmt_ratio(fleet.get("goodput_ratio")),
+        )
+    ]
+    headers = [
+        "NAME", "NAMESPACE", "STATUS", "RUNS", "AVAIL",
+        "P50", "P95", "P99", "BUDGET", "BURN", "LAST TRACE",
+    ]
+    rows = []
+    for check in payload.get("checks") or []:
+        window = check.get("window") or {}
+        slo = check.get("slo")
+        rows.append(
+            [
+                check.get("healthcheck", ""),
+                check.get("namespace", ""),
+                check.get("last_status", "") or "-",
+                str(window.get("results", 0)),
+                _fmt_ratio(window.get("availability")),
+                _fmt_seconds(window.get("p50_seconds")),
+                _fmt_seconds(window.get("p95_seconds")),
+                _fmt_seconds(window.get("p99_seconds")),
+                _fmt_ratio(slo.get("error_budget_remaining")) if slo else "-",
+                (
+                    f"{slo['burn_rate']:.2f}"
+                    if slo and slo.get("burn_rate") is not None
+                    else "-"
+                ),
+                (check.get("last_trace_id") or "-")[:16],
+            ]
+        )
+    if not rows:
+        lines.append("No HealthChecks found.")
+        return "\n".join(lines)
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+async def _status(args) -> int:
+    import json as _json
+
+    import aiohttp
+
+    headers = {"Authorization": f"Bearer {args.token}"} if args.token else {}
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(args.url, headers=headers) as resp:
+                if resp.status != 200:
+                    print(
+                        f"error: {args.url} returned {resp.status}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                payload = await resp.json()
+    except (aiohttp.ClientError, OSError) as e:
+        print(
+            f"error: cannot reach {args.url}: {e} (is the controller "
+            "running with a health-probe address?)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.output == "json":
+        print(_json.dumps(payload, indent=2))
+        return 0
+    print(render_status_table(payload))
+    return 0
+
+
 async def _describe(args) -> int:
     import yaml as _yaml
 
@@ -622,6 +732,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "delete": _delete,
         "get": _get,
         "describe": _describe,
+        "status": _status,
     }[args.command]
     if args.command == "run":
         # pre-import the controller's heavy dependency graph BEFORE the

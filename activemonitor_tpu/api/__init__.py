@@ -13,6 +13,7 @@ from activemonitor_tpu.api.types import (
     RemedyWorkflow,
     ResourceObject,
     ScheduleSpec,
+    SLOSpec,
     URLArtifact,
     Workflow,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "RemedyWorkflow",
     "ResourceObject",
     "ScheduleSpec",
+    "SLOSpec",
     "URLArtifact",
     "Workflow",
 ]

@@ -41,6 +41,27 @@ PRINTER_COLUMNS = [
 ]
 
 
+def _v1_exclusive_bounds(node: Any) -> Any:
+    """pydantic emits draft-2020-12 numeric exclusiveMinimum/Maximum;
+    apiextensions.k8s.io/v1 JSONSchemaProps declares them as BOOLEANS
+    (draft-4 style) beside minimum/maximum — a numeric form makes the
+    whole CRD fail to decode at apply time."""
+    if isinstance(node, dict):
+        out = {k: _v1_exclusive_bounds(v) for k, v in node.items()}
+        for exclusive, limit in (
+            ("exclusiveMinimum", "minimum"),
+            ("exclusiveMaximum", "maximum"),
+        ):
+            bound = out.get(exclusive)
+            if isinstance(bound, (int, float)) and not isinstance(bound, bool):
+                out[limit] = bound
+                out[exclusive] = True
+        return out
+    if isinstance(node, list):
+        return [_v1_exclusive_bounds(v) for v in node]
+    return node
+
+
 def _collapse_optionals(schema: Dict[str, Any]) -> Dict[str, Any]:
     """Optional fields produce anyOf[{...}, {type: null}] — CRD schemas
     want the plain type with the field simply not required."""
@@ -87,7 +108,7 @@ def build_crd() -> Dict[str, Any]:
                 return [inline(v) for v in node]
             return node
 
-        return _collapse_optionals(inline(raw))
+        return _v1_exclusive_bounds(_collapse_optionals(inline(raw)))
 
     return {
         "apiVersion": "apiextensions.k8s.io/v1",

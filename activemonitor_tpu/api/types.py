@@ -131,6 +131,25 @@ class RemedyWorkflow(Workflow):
         return self == RemedyWorkflow()
 
 
+class SLOSpec(_Base):
+    """Per-check service-level objective (extension; no counterpart in
+    the reference CRD — PAPERS.md: ML-productivity-goodput-style
+    rolling-window availability).
+
+    Declaring the block opts the check into error-budget accounting:
+    the controller evaluates availability over the rolling window and
+    exports ``healthcheck_slo_availability_ratio`` /
+    ``healthcheck_error_budget_remaining`` for it, and ``/statusz``
+    reports the budget state. Omitting the block (the default) changes
+    nothing.
+    """
+
+    # target availability ratio over the window, exclusive bounds: 1.0
+    # would allow a zero failure budget (division by zero in burn-rate)
+    objective: float = Field(gt=0.0, lt=1.0)
+    window_seconds: int = Field(default=3600, gt=0, alias="windowSeconds")
+
+
 class ScheduleSpec(_Base):
     """Cron schedule (reference: healthcheck_types.go:148-151).
 
@@ -162,6 +181,8 @@ class HealthCheckSpec(_Base):
     backoff_min: int = Field(default=0, alias="backoffMin")
     remedy_runs_limit: int = Field(default=0, alias="remedyRunsLimit")
     remedy_reset_interval: int = Field(default=0, alias="remedyResetInterval")
+    # optional SLO block — absent ⇒ no error-budget accounting
+    slo: Optional[SLOSpec] = None
 
 
 class HealthCheckStatus(_Base):

@@ -411,6 +411,10 @@ class Manager:
                 self.reconciler.metrics.cadence_goodput.set(
                     good / scheduled if scheduled else 1.0
                 )
+                # the run-weighted SLO goodput refreshes on the same
+                # cadence — it walks every check's result ring, which
+                # is rollup work, not reconcile-path work
+                self.reconciler.fleet.refresh_fleet_goodput()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -544,7 +548,21 @@ class Manager:
             denied = await denial(request)
             if denied is not None:
                 return denied
-            data = self.reconciler.metrics.exposition()
+            collector = self.reconciler.metrics
+            # content negotiation: OpenMetrics is the format that
+            # carries the trace-id exemplars on the latency histograms;
+            # the default text format stays the reference's exact
+            # scrape contract
+            if "application/openmetrics-text" in request.headers.get(
+                "Accept", ""
+            ):
+                return web.Response(
+                    body=collector.exposition(openmetrics=True),
+                    headers={
+                        "Content-Type": collector.OPENMETRICS_CONTENT_TYPE
+                    },
+                )
+            data = collector.exposition()
             return web.Response(
                 body=data, content_type="text/plain", charset="utf-8"
             )
@@ -574,12 +592,21 @@ class Manager:
                 events = [e for e in events if e.trace_id == wanted]
             return web.json_response({"events": [e.to_dict() for e in events]})
 
-        # /debug rides the health-probe site (plaintext, kubelet-open) —
-        # trace/event payloads are operator diagnostics like /healthz,
-        # not scrape data behind the metrics auth filter
+        async def statusz(_request):
+            # fleet SLO summary: the client's live check list joined
+            # with the reconciler's result history and budget state
+            # (obs/slo.py owns the schema; a contract test pins it)
+            checks = await self.client.list()
+            return web.json_response(self.reconciler.fleet.statusz(checks))
+
+        # /debug and /statusz ride the health-probe site (plaintext,
+        # kubelet-open) — trace/event/fleet payloads are operator
+        # diagnostics like /healthz, not scrape data behind the metrics
+        # auth filter
         debug_routes = [
             web.get("/debug/traces", debug_traces),
             web.get("/debug/events", debug_events),
+            web.get("/statusz", statusz),
         ]
 
         def guarded(handler):
@@ -599,6 +626,7 @@ class Manager:
         guarded_debug_routes = [
             web.get("/debug/traces", guarded(debug_traces)),
             web.get("/debug/events", guarded(debug_events)),
+            web.get("/statusz", guarded(statusz)),
         ]
 
         async def bind(addr: str, routes, secure: bool = False) -> None:

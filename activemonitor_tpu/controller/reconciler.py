@@ -72,6 +72,7 @@ from activemonitor_tpu.metrics.collector import (
     WORKFLOW_LABEL_HEALTHCHECK,
     WORKFLOW_LABEL_REMEDY,
 )
+from activemonitor_tpu.obs.slo import FleetStatus
 from activemonitor_tpu.obs.trace import Tracer
 from activemonitor_tpu.scheduler import (
     CronParseError,
@@ -106,6 +107,10 @@ class HealthCheckReconciler:
         # the reconciler owns the tracer like it owns the clock — the
         # manager and the CLI reach it through here
         self.tracer = tracer or Tracer(self.clock)
+        # fleet SLO aggregate (result history + error budgets), fed from
+        # the status-write path below and served by the manager's
+        # /statusz endpoint. Same ownership shape as the tracer.
+        self.fleet = FleetStatus(self.clock, metrics)
         self.timers = TimerWheel(self.clock)
         self._watch_tasks: Dict[str, asyncio.Task] = {}
         # set by the Manager: routes failed-run requeues through its
@@ -130,6 +135,9 @@ class HealthCheckReconciler:
             if self.timers.exists(key):
                 log.info("cancelling scheduled run for deleted healthcheck %s", key)
                 self.timers.stop(key)
+            # drop the check's result ring and SLO gauge series — the
+            # fleet summary must not advertise a deleted check's budget
+            self.fleet.forget(key, name, namespace)
             return None
         return await self._process_or_recover(hc)
 
@@ -577,6 +585,14 @@ class HealthCheckReconciler:
                     )
                     # custom metrics, wired for real (reference gap: SURVEY.md §2)
                     self.metrics.record_custom_metrics(hc.metadata.name, status)
+                    # the run lands in the result history on the same
+                    # path that writes status — one source for SLO math
+                    self.fleet.record(
+                        hc,
+                        ok=True,
+                        latency=(now - then).total_seconds(),
+                        workflow=wf_name,
+                    )
                     if not hc.spec.remedy_workflow.is_empty() and hc.status.remedy_total_runs >= 1:
                         hc.status.reset_remedy("HealthCheck Passed so Remedy is reset")
                         self.recorder.event(
@@ -605,6 +621,12 @@ class HealthCheckReconciler:
                         now.timestamp(),
                     )
                     self.metrics.record_custom_metrics(hc.metadata.name, status)
+                    self.fleet.record(
+                        hc,
+                        ok=False,
+                        latency=(now - then).total_seconds(),
+                        workflow=wf_name,
+                    )
                     run_remedy = True
                     break
 

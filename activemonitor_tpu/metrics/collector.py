@@ -29,6 +29,8 @@ from typing import Dict, Optional
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
+from activemonitor_tpu.obs.trace import current_trace_id
+
 log = logging.getLogger(__name__)
 
 LABEL_HC = "healthcheck_name"
@@ -56,6 +58,28 @@ _DURATION_BUCKETS = (
 
 WORKFLOW_LABEL_HEALTHCHECK = "healthCheck"
 WORKFLOW_LABEL_REMEDY = "remedy"
+
+# TPU probe workflows run seconds to tens of minutes; the client's
+# default histogram buckets cap at 10 s, which would fold every
+# multi-minute probe into +Inf. Log-spaced (~x3) 1 s .. 30 m instead —
+# and deliberately few: these buckets multiply across every
+# {healthcheck_name, workflow} pair, and the soak tier budgets the
+# fleet's series cardinality
+_PROBE_RUNTIME_BUCKETS = (
+    1, 3, 10, 30, 90, 300, 900, 1800, float("inf"),
+)
+
+# custom-metric contract types this collector implements; anything else
+# is rejected with a logged warning, never silently coerced to a gauge
+_CUSTOM_METRIC_KINDS = {"gauge", "counter"}
+
+
+def _exemplar() -> Optional[Dict[str, str]]:
+    """The active cycle's trace id as an OpenMetrics exemplar, or None
+    outside any span. Rendered only by the OpenMetrics exposition;
+    the plain-text scrape contract is untouched."""
+    trace_id = current_trace_id()
+    return {"trace_id": trace_id} if trace_id else None
 
 _INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -131,7 +155,52 @@ class MetricsCollector:
             "Distribution of workflow run durations.",
             labels,
             registry=self.registry,
-            buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, float("inf")),
+            buckets=_PROBE_RUNTIME_BUCKETS,
+        )
+        # probe-internal phase timings (the stdout contract's "timings"
+        # block): where inside the payload the time went — Reframe-style
+        # per-phase attribution, not just end-to-end latency
+        self.phase_seconds = Histogram(
+            "healthcheck_phase_seconds",
+            "Distribution of probe payload phase durations, from the "
+            "timings block of the probe's stdout contract.",
+            [LABEL_HC, "phase"],
+            registry=self.registry,
+            buckets=_PROBE_RUNTIME_BUCKETS,
+        )
+        # -- SLO families (obs/slo.py is the single writer). Unlike the
+        # reference-parity families these carry a namespace label: SLO
+        # gauges are SET per evaluation, and two same-named checks in
+        # different namespaces would otherwise flap one series between
+        # two unrelated budgets (the same bare-name clobber the
+        # reference has in its timer keys)
+        slo_labels = [LABEL_HC, "namespace"]
+        self.slo_availability = Gauge(
+            "healthcheck_slo_availability_ratio",
+            "Rolling-window availability of the check against its "
+            "declared slo: window",
+            slo_labels,
+            registry=self.registry,
+        )
+        self.slo_error_budget = Gauge(
+            "healthcheck_error_budget_remaining",
+            "Fraction of the window's error budget still unspent "
+            "(negative once the budget is blown)",
+            slo_labels,
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "healthcheck_slo_burn_rate",
+            "Observed failure ratio over the allowed failure ratio "
+            "(1.0 = burning exactly at budget)",
+            slo_labels,
+            registry=self.registry,
+        )
+        self.fleet_goodput = Gauge(
+            "healthcheck_fleet_goodput_ratio",
+            "Successful runs over total runs across every check's "
+            "rolling window (run-weighted fleet goodput)",
+            registry=self.registry,
         )
         # fleet rollup (beyond the reference; cf. ML-productivity-goodput
         # style metrics): what fraction of checks are healthy AND meeting
@@ -231,7 +300,10 @@ class MetricsCollector:
             ["namespace"],
             registry=self.registry,
         )
-        self._custom_gauges: Dict[str, Gauge] = {}
+        # full_name -> (kind, collector): the declared metrictype is
+        # part of a custom metric's identity — a name re-reported under
+        # a different type must be rejected, not silently re-typed
+        self._custom_metrics: Dict[str, tuple] = {}
         # (hc_name, merged_name) -> raw metric name: two DIFFERENT
         # metrics from one check must never collapse onto one series
         # (e.g. check a-b emitting b-c and c both merge to a_b_c)
@@ -248,7 +320,7 @@ class MetricsCollector:
         self.monitor_started_time.labels(hc_name, workflow).set(started)
         self.monitor_finished_time.labels(hc_name, workflow).set(finished)
         self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
-            max(0.0, finished - started)
+            max(0.0, finished - started), exemplar=_exemplar()
         )
 
     def record_failure(
@@ -258,7 +330,7 @@ class MetricsCollector:
         self.monitor_started_time.labels(hc_name, workflow).set(started)
         self.monitor_finished_time.labels(hc_name, workflow).set(finished)
         self.monitor_runtime_histogram.labels(hc_name, workflow).observe(
-            max(0.0, finished - started)
+            max(0.0, finished - started), exemplar=_exemplar()
         )
 
     def record_watch_health(self, namespace: str, healthy: bool) -> None:
@@ -307,10 +379,48 @@ class MetricsCollector:
     def record_engine_poll(self, engine: str) -> None:
         self.engine_polls.labels(engine).inc()
 
+    # -- SLO families (written by obs.slo.FleetStatus) -----------------
+    def set_slo(
+        self,
+        hc_name: str,
+        namespace: str,
+        *,
+        availability: float,
+        error_budget_remaining: float,
+        burn_rate: float,
+    ) -> None:
+        self.slo_availability.labels(hc_name, namespace).set(availability)
+        self.slo_error_budget.labels(hc_name, namespace).set(
+            error_budget_remaining
+        )
+        self.slo_burn_rate.labels(hc_name, namespace).set(burn_rate)
+
+    def clear_slo(self, hc_name: str, namespace: str) -> None:
+        """Deleted check (or an slo: block removed from a live spec):
+        drop its SLO series so the scrape does not advertise a budget
+        that no longer exists."""
+        for gauge in (
+            self.slo_availability,
+            self.slo_error_budget,
+            self.slo_burn_rate,
+        ):
+            try:
+                gauge.remove(hc_name, namespace)
+            except KeyError:
+                pass  # never recorded — nothing to drop
+
+    def set_fleet_goodput(self, ratio: float) -> None:
+        self.fleet_goodput.set(ratio)
+
     # -- dynamic custom metrics ---------------------------------------
     def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:
         """Parse workflow global output parameters for the custom-metric
-        contract and set gauges. Returns how many metrics were recorded.
+        contract: ``metrics`` entries become gauges or counters per the
+        declared ``metrictype`` (unknown types are rejected with a
+        logged warning, not coerced), and a ``timings`` block feeds the
+        ``healthcheck_phase_seconds`` histogram with the active cycle's
+        trace id as an OpenMetrics exemplar. Returns how many ``metrics``
+        entries were recorded.
 
         Malformed JSON / entries are skipped with a log, never raised
         (reference: collector.go:73-87).
@@ -329,65 +439,151 @@ class MetricsCollector:
             if not isinstance(doc, dict):
                 continue
             for raw in doc.get("metrics") or []:
-                if not isinstance(raw, dict):
-                    continue
-                metric_name = raw.get("name") or ""
-                try:
-                    metric_value = float(raw.get("value"))
-                except (TypeError, ValueError):
-                    log.error("skipping custom metric with bad value: %r", raw)
-                    continue
-                if not metric_name:
-                    log.error("skipping invalid custom metric for %s: %r", hc_name, raw)
-                    continue
-                full_name = _prefix_dedupe(
-                    _sanitize(hc_name), _sanitize(metric_name)
-                )
-                with self._custom_lock:
-                    origin = self._custom_origin.setdefault(
-                        (hc_name, full_name), metric_name
-                    )
-                    if origin != metric_name:
-                        # same check, different raw metric, same merged
-                        # name: recording would silently overwrite the
-                        # other metric's series — skip loudly instead
-                        # (never-raise contract, like the registration
-                        # collision below)
-                        log.error(
-                            "custom metric %r of %s merges to %s, already "
-                            "taken by metric %r of the same check; skipping",
-                            metric_name,
-                            hc_name,
-                            full_name,
-                            origin,
-                        )
-                        continue
-                    gauge = self._custom_gauges.get(full_name)
-                    if gauge is None:
-                        try:
-                            gauge = Gauge(
-                                full_name,
-                                str(raw.get("help") or full_name),
-                                [LABEL_HC],
-                                registry=self.registry,
-                            )
-                        except ValueError:
-                            # name collides with an already-registered
-                            # metric (e.g. a static vec) — skip, keep the
-                            # never-raise contract
-                            log.error(
-                                "custom metric %s collides with an existing "
-                                "registration; skipping",
-                                full_name,
-                            )
-                            continue
-                        self._custom_gauges[full_name] = gauge
-                gauge.labels(hc_name).set(metric_value)
-                recorded += 1
+                recorded += self._record_custom_metric(hc_name, raw)
+            self._record_phase_timings(hc_name, doc.get("timings"))
         return recorded
 
+    def _record_custom_metric(self, hc_name: str, raw) -> int:
+        """One contract entry -> one sample; returns 1 when recorded."""
+        if not isinstance(raw, dict):
+            return 0
+        metric_name = raw.get("name") or ""
+        try:
+            metric_value = float(raw.get("value"))
+        except (TypeError, ValueError):
+            log.error("skipping custom metric with bad value: %r", raw)
+            return 0
+        if not metric_name:
+            log.error("skipping invalid custom metric for %s: %r", hc_name, raw)
+            return 0
+        kind = str(raw.get("metrictype") or "gauge").lower()
+        if kind not in _CUSTOM_METRIC_KINDS:
+            log.warning(
+                "skipping custom metric %r of %s: unknown metrictype %r "
+                "(supported: %s)",
+                metric_name,
+                hc_name,
+                raw.get("metrictype"),
+                ", ".join(sorted(_CUSTOM_METRIC_KINDS)),
+            )
+            return 0
+        if kind == "counter" and metric_value < 0:
+            # the counter contract is a per-run increment; a negative
+            # delta would make prometheus_client raise
+            log.error(
+                "skipping counter metric %r of %s: negative increment %r",
+                metric_name,
+                hc_name,
+                metric_value,
+            )
+            return 0
+        full_name = _prefix_dedupe(_sanitize(hc_name), _sanitize(metric_name))
+        with self._custom_lock:
+            origin = self._custom_origin.setdefault(
+                (hc_name, full_name), metric_name
+            )
+            if origin != metric_name:
+                # same check, different raw metric, same merged name:
+                # recording would silently overwrite the other metric's
+                # series — skip loudly instead (never-raise contract,
+                # like the registration collision below)
+                log.error(
+                    "custom metric %r of %s merges to %s, already "
+                    "taken by metric %r of the same check; skipping",
+                    metric_name,
+                    hc_name,
+                    full_name,
+                    origin,
+                )
+                return 0
+            known = self._custom_metrics.get(full_name)
+            if known is not None and known[0] != kind:
+                log.error(
+                    "custom metric %s of %s re-declared as %s (registered "
+                    "as %s); skipping",
+                    full_name,
+                    hc_name,
+                    kind,
+                    known[0],
+                )
+                return 0
+            if known is None:
+                family = Counter if kind == "counter" else Gauge
+                try:
+                    collector = family(
+                        full_name,
+                        str(raw.get("help") or full_name),
+                        [LABEL_HC],
+                        registry=self.registry,
+                    )
+                except ValueError:
+                    # name collides with an already-registered metric
+                    # (e.g. a static vec) — skip, keep the never-raise
+                    # contract
+                    log.error(
+                        "custom metric %s collides with an existing "
+                        "registration; skipping",
+                        full_name,
+                    )
+                    return 0
+                known = self._custom_metrics[full_name] = (kind, collector)
+        _, collector = known
+        if kind == "counter":
+            # the reported value is this run's delta (counters cannot be
+            # set); the scraped series is the monotonic total
+            collector.labels(hc_name).inc(metric_value)
+        else:
+            collector.labels(hc_name).set(metric_value)
+        return 1
+
+    def _record_phase_timings(self, hc_name: str, timings) -> None:
+        """The contract's ``timings`` block -> phase histogram samples,
+        exemplar-stamped with the cycle's trace id."""
+        if timings is None:
+            return
+        if not isinstance(timings, dict):
+            log.warning(
+                "skipping timings block for %s: expected an object, got %r",
+                hc_name,
+                type(timings).__name__,
+            )
+            return
+        exemplar = _exemplar()
+        for phase, seconds in timings.items():
+            try:
+                seconds = float(seconds)
+            except (TypeError, ValueError):
+                log.warning(
+                    "skipping phase timing %r of %s: bad value %r",
+                    phase,
+                    hc_name,
+                    seconds,
+                )
+                continue
+            if not isinstance(phase, str) or not phase:
+                log.warning("skipping unnamed phase timing of %s", hc_name)
+                continue
+            self.phase_seconds.labels(hc_name, _sanitize(phase)).observe(
+                max(0.0, seconds), exemplar=exemplar
+            )
+
     # -- exposition ----------------------------------------------------
-    def exposition(self) -> bytes:
+    OPENMETRICS_CONTENT_TYPE = (
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
+    )
+
+    def exposition(self, openmetrics: bool = False) -> bytes:
+        """Scrape text. The default (Prometheus text format) is the
+        reference's exact contract; OpenMetrics is the format that
+        carries the trace-id exemplars on the latency histograms —
+        served when the scraper asks for it (Accept negotiation in the
+        manager's /metrics handler)."""
+        if openmetrics:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_generate_latest,
+            )
+
+            return om_generate_latest(self.registry)
         from prometheus_client import generate_latest
 
         return generate_latest(self.registry)

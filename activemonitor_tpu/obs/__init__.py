@@ -1,5 +1,15 @@
-"""Observability: span tracing correlated with logs, events, metrics."""
+"""Observability: span tracing correlated with logs, events, metrics,
+plus the per-check result history and rolling-window SLO layer."""
 
+from activemonitor_tpu.obs.history import CheckResult, ResultHistory
+from activemonitor_tpu.obs.slo import (
+    FleetStatus,
+    SLOConfig,
+    SLOState,
+    evaluate,
+    fleet_goodput,
+    slo_config_from_spec,
+)
 from activemonitor_tpu.obs.trace import (
     Span,
     Tracer,
@@ -9,9 +19,17 @@ from activemonitor_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CheckResult",
+    "FleetStatus",
+    "ResultHistory",
+    "SLOConfig",
+    "SLOState",
     "Span",
     "Tracer",
     "current_span",
     "current_trace_id",
     "detached",
+    "evaluate",
+    "fleet_goodput",
+    "slo_config_from_spec",
 ]

@@ -1,0 +1,119 @@
+"""Bounded per-HealthCheck result history.
+
+The CR status is a durable checkpoint of the LAST run plus lifetime
+counters — it cannot answer "how did this check do over the past hour",
+which is the question an SLO is (PAPERS.md: ML Productivity Goodput
+reports availability over a rolling window, not point-in-time
+verdicts). This module keeps the raw material for that answer: one
+bounded ring of :class:`CheckResult` per check, fed from the
+reconciler's status-write path — the single place every run (success,
+failure, synthesized timeout) converges.
+
+Design constraints, shared with the tracer (obs/trace.py):
+
+- **injectable clock**: result timestamps come from
+  :class:`~activemonitor_tpu.utils.clock.Clock`, so fake-clock tests
+  script exact windows and quantiles.
+- **bounded memory**: one ``deque(maxlen=capacity)`` per check; a
+  long-lived controller records forever in constant memory. Deleted
+  checks are dropped via :meth:`forget` from the reconciler's
+  deleted-resource path.
+- **never raises into the recording path**: history is observability;
+  the reconciler's status write must not fail because a ring did.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Deque, Dict, List, Optional
+
+from activemonitor_tpu.utils.clock import Clock
+
+# per-check results retained; at a 60 s cadence this is ~4 h of history,
+# comfortably more than any sane SLO window for an active prober
+DEFAULT_CAPACITY = 256
+
+
+@dataclass(frozen=True)
+class CheckResult:
+    """One finished run of one HealthCheck."""
+
+    ts: datetime  # finish wall time (clock.now() at record)
+    ok: bool
+    latency: float  # submit → terminal-phase seconds
+    workflow: str  # workflow object name, joins to engine/Argo state
+    trace_id: str  # joins to /debug/traces and correlated logs
+
+    def to_dict(self) -> dict:
+        return {
+            "ts": self.ts.isoformat(),
+            "ok": self.ok,
+            "latency_seconds": self.latency,
+            "workflow": self.workflow,
+            "trace_id": self.trace_id,
+        }
+
+
+class ResultHistory:
+    """Per-check rings of finished runs, keyed by ``namespace/name``."""
+
+    def __init__(
+        self, clock: Optional[Clock] = None, capacity: int = DEFAULT_CAPACITY
+    ):
+        self.clock = clock or Clock()
+        self._capacity = max(1, capacity)
+        self._rings: Dict[str, Deque[CheckResult]] = {}
+
+    def record(
+        self,
+        key: str,
+        *,
+        ok: bool,
+        latency: float,
+        workflow: str = "",
+        trace_id: str = "",
+    ) -> CheckResult:
+        """Append one finished run; the oldest entry falls off a full
+        ring. The timestamp is stamped HERE from the injected clock so
+        every caller records on the same timeline the windows use."""
+        result = CheckResult(
+            ts=self.clock.now(),
+            ok=bool(ok),
+            latency=max(0.0, float(latency)),
+            workflow=workflow,
+            trace_id=trace_id,
+        )
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = self._rings[key] = collections.deque(maxlen=self._capacity)
+        ring.append(result)
+        return result
+
+    def results(self, key: str) -> List[CheckResult]:
+        """All retained results for a check, oldest first."""
+        return list(self._rings.get(key, ()))
+
+    def tail(self, key: str, n: int = 10) -> List[CheckResult]:
+        """The newest ``n`` results, oldest-of-the-tail first — the
+        /statusz history excerpt."""
+        ring = self._rings.get(key)
+        if not ring or n <= 0:
+            return []
+        return list(ring)[-n:]
+
+    def last(self, key: str) -> Optional[CheckResult]:
+        ring = self._rings.get(key)
+        return ring[-1] if ring else None
+
+    def checks(self) -> List[str]:
+        """Keys with at least one recorded result."""
+        return list(self._rings.keys())
+
+    def forget(self, key: str) -> None:
+        """Drop a deleted check's ring (reconciler's deleted path)."""
+        self._rings.pop(key, None)
+
+    def __len__(self) -> int:
+        return sum(len(ring) for ring in self._rings.values())

@@ -1,0 +1,314 @@
+"""Rolling-window SLO accounting over the result history.
+
+ML Productivity Goodput (PAPERS.md, arXiv:2502.06982) frames fleet
+health as availability/goodput over a rolling window rather than the
+point-in-time verdict the CR status holds. This module is that math,
+kept as pure functions over :class:`~activemonitor_tpu.obs.history.
+CheckResult` lists so fake-clock tests assert exact values, plus
+:class:`FleetStatus` — the stateful aggregate the reconciler feeds and
+the ``/statusz`` endpoint serves.
+
+Definitions (documented in docs/observability.md):
+
+- **window**: results whose finish timestamp lies in
+  ``(now - window_seconds, now]``. Results age out of the SLO even
+  while they remain in the bounded ring.
+- **availability**: successful runs / total runs in the window.
+  ``None`` when the window is empty (no verdict beats a made-up one).
+- **latency quantiles**: nearest-rank (no interpolation) over the
+  window's latencies — ``sorted[ceil(q*n)-1]`` — so a scripted
+  sequence yields an exact recorded latency, never a blend.
+- **error budget**: the objective allows a failure ratio of
+  ``1 - objective`` per window. ``remaining = 1 - observed/allowed``
+  (may go negative once the budget is blown — that overdraft is the
+  signal, so it is not clamped); ``burn_rate = observed/allowed``
+  (1.0 = burning exactly at budget).
+- **fleet goodput**: successful runs / total runs across every check's
+  own window — run-weighted, so one flapping 10 s check moves the
+  number more than a healthy daily check, which is what a prober
+  fleet's "useful work fraction" should do.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Dict, List, Optional, Sequence
+
+from activemonitor_tpu.obs.history import CheckResult, ResultHistory
+from activemonitor_tpu.obs.trace import current_trace_id
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.slo")
+
+# display window when a check declares no slo: block — one hour of
+# context on /statusz without opting into budget accounting
+DEFAULT_WINDOW_SECONDS = 3600.0
+
+QUANTILES = (0.50, 0.95, 0.99)
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """A check's declared objective (spec.slo)."""
+
+    objective: float  # target availability ratio in (0, 1)
+    window_seconds: float
+
+    @property
+    def allowed_failure_ratio(self) -> float:
+        return 1.0 - self.objective
+
+
+def slo_config_from_spec(spec) -> Optional[SLOConfig]:
+    """The spec's ``slo:`` block as an :class:`SLOConfig`, or None when
+    absent or out of range (the API layer validates; this is the
+    defense for dicts that arrived around it)."""
+    slo = getattr(spec, "slo", None)
+    if slo is None:
+        return None
+    objective = float(getattr(slo, "objective", 0.0) or 0.0)
+    window = float(getattr(slo, "window_seconds", 0.0) or 0.0)
+    if not (0.0 < objective < 1.0) or window <= 0:
+        return None
+    return SLOConfig(objective=objective, window_seconds=window)
+
+
+def window_results(
+    results: Sequence[CheckResult], now: datetime, window_seconds: float
+) -> List[CheckResult]:
+    """The results that finished within the rolling window
+    ``(now - window_seconds, now]`` — exclusive on the left, so a
+    result exactly one window old has aged out."""
+    return [
+        r for r in results if (now - r.ts).total_seconds() < window_seconds
+    ]
+
+
+def availability(results: Sequence[CheckResult]) -> Optional[float]:
+    if not results:
+        return None
+    return sum(1 for r in results if r.ok) / len(results)
+
+
+def quantile(latencies: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank quantile: the smallest recorded latency such that at
+    least ``q`` of the sample is ≤ it. Exact by construction."""
+    if not latencies:
+        return None
+    ordered = sorted(latencies)
+    rank = min(len(ordered), max(1, math.ceil(q * len(ordered))))
+    return ordered[rank - 1]
+
+
+def latency_quantiles(
+    results: Sequence[CheckResult],
+) -> Dict[str, Optional[float]]:
+    latencies = [r.latency for r in results]
+    return {
+        f"p{int(q * 100)}_seconds": quantile(latencies, q) for q in QUANTILES
+    }
+
+
+@dataclass(frozen=True)
+class SLOState:
+    """One check's SLO verdict over its window."""
+
+    objective: float
+    window_seconds: float
+    availability: Optional[float]  # None: empty window
+    error_budget_remaining: Optional[float]
+    burn_rate: Optional[float]
+
+    def to_dict(self) -> dict:
+        return {
+            "objective": self.objective,
+            "window_seconds": self.window_seconds,
+            "availability": self.availability,
+            "error_budget_remaining": self.error_budget_remaining,
+            "burn_rate": self.burn_rate,
+        }
+
+
+def evaluate(
+    results: Sequence[CheckResult], config: SLOConfig, now: datetime
+) -> SLOState:
+    """SLO state over the config's window, exact over the given results."""
+    windowed = window_results(results, now, config.window_seconds)
+    avail = availability(windowed)
+    if avail is None:
+        return SLOState(
+            objective=config.objective,
+            window_seconds=config.window_seconds,
+            availability=None,
+            error_budget_remaining=None,
+            burn_rate=None,
+        )
+    observed_failure_ratio = 1.0 - avail
+    allowed = config.allowed_failure_ratio
+    burn = observed_failure_ratio / allowed
+    return SLOState(
+        objective=config.objective,
+        window_seconds=config.window_seconds,
+        availability=avail,
+        error_budget_remaining=1.0 - burn,
+        burn_rate=burn,
+    )
+
+
+def fleet_goodput(
+    history: ResultHistory,
+    configs: Dict[str, Optional[SLOConfig]],
+    now: datetime,
+) -> Optional[float]:
+    """Run-weighted goodput across the fleet: each check contributes the
+    runs inside ITS window (declared, else the display default)."""
+    good = total = 0
+    for key in history.checks():
+        config = configs.get(key)
+        window = (
+            config.window_seconds if config else DEFAULT_WINDOW_SECONDS
+        )
+        for result in window_results(history.results(key), now, window):
+            total += 1
+            good += 1 if result.ok else 0
+    if total == 0:
+        return None
+    return good / total
+
+
+class FleetStatus:
+    """The reconciler-owned aggregate behind ``/statusz``.
+
+    Owns the result history and the last-seen SLO config per check;
+    every recorded run updates the SLO gauge families so Prometheus and
+    /statusz can never disagree about the same window. Recording never
+    raises into the status-write path that feeds it.
+    """
+
+    HISTORY_TAIL = 10  # /statusz per-check history excerpt length
+
+    def __init__(self, clock: Optional[Clock] = None, metrics=None):
+        self.clock = clock or Clock()
+        self.history = ResultHistory(self.clock)
+        self.metrics = metrics
+        self._configs: Dict[str, Optional[SLOConfig]] = {}
+        self._last_status: Dict[str, str] = {}
+
+    # -- recording (reconciler status-write path) ----------------------
+    def record(self, hc, *, ok: bool, latency: float, workflow: str) -> None:
+        try:
+            self._record(hc, ok=ok, latency=latency, workflow=workflow)
+        except Exception:
+            # observability must not fail the status write that feeds it
+            log.exception("failed to record result for %s", getattr(hc, "key", "?"))
+
+    def _record(self, hc, *, ok: bool, latency: float, workflow: str) -> None:
+        key = hc.key
+        self.history.record(
+            key,
+            ok=ok,
+            latency=latency,
+            workflow=workflow,
+            trace_id=current_trace_id(),
+        )
+        self._last_status[key] = "Succeeded" if ok else "Failed"
+        config = slo_config_from_spec(hc.spec)
+        previous = self._configs.get(key)
+        self._configs[key] = config
+        if self.metrics is None:
+            return
+        if config is not None:
+            state = evaluate(self.history.results(key), config, self.clock.now())
+            if state.availability is not None:
+                self.metrics.set_slo(
+                    hc.metadata.name,
+                    hc.metadata.namespace,
+                    availability=state.availability,
+                    error_budget_remaining=state.error_budget_remaining,
+                    burn_rate=state.burn_rate,
+                )
+        elif previous is not None:
+            # the slo: block was edited off a live check — its series
+            # must stop advertising the last pre-edit budget forever
+            self.metrics.clear_slo(hc.metadata.name, hc.metadata.namespace)
+        # NB: the fleet-wide gauge is deliberately NOT recomputed here —
+        # it walks every check's ring, which is O(fleet x capacity) work
+        # that doesn't belong on the reconcile path. The manager's
+        # goodput loop and /statusz refresh it (refresh_fleet_goodput).
+
+    def refresh_fleet_goodput(self) -> Optional[float]:
+        """Recompute the fleet-wide goodput ratio and (when a collector
+        is attached) refresh its gauge. Called off the reconcile path:
+        the manager's periodic rollup loop and every /statusz build."""
+        ratio = fleet_goodput(self.history, self._configs, self.clock.now())
+        if self.metrics is not None:
+            # an empty fleet is vacuously healthy, same convention as
+            # the cadence-goodput gauge
+            self.metrics.set_fleet_goodput(1.0 if ratio is None else ratio)
+        return ratio
+
+    def forget(self, key: str, name: str = "", namespace: str = "") -> None:
+        """Deleted check: drop its ring, config, and gauge series."""
+        self.history.forget(key)
+        self._configs.pop(key, None)
+        self._last_status.pop(key, None)
+        if self.metrics is not None and name:
+            self.metrics.clear_slo(name, namespace)
+
+    # -- /statusz -------------------------------------------------------
+    def check_summary(self, hc) -> dict:
+        """One check's /statusz entry (schema pinned by contract test)."""
+        key = hc.key
+        now = self.clock.now()
+        results = self.history.results(key)
+        config = slo_config_from_spec(hc.spec)
+        display_window = (
+            config.window_seconds if config else DEFAULT_WINDOW_SECONDS
+        )
+        windowed = window_results(results, now, display_window)
+        last = self.history.last(key)
+        summary = {
+            "key": key,
+            "healthcheck": hc.metadata.name,
+            "namespace": hc.metadata.namespace,
+            "last_status": hc.status.status
+            or self._last_status.get(key, ""),
+            "last_trace_id": last.trace_id if last else "",
+            "runs_recorded": len(results),
+            "window": {
+                "seconds": display_window,
+                "results": len(windowed),
+                "availability": availability(windowed),
+                **latency_quantiles(windowed),
+            },
+            "slo": (
+                evaluate(results, config, now).to_dict()
+                if config is not None
+                else None
+            ),
+            "history": [r.to_dict() for r in self.history.tail(key, self.HISTORY_TAIL)],
+        }
+        return summary
+
+    def statusz(self, checks) -> dict:
+        """The fleet summary payload: the client's current check list
+        joined with history/SLO state. Checks deleted from the store
+        drop out here even before their reconcile prunes the ring."""
+        now = self.clock.now()
+        entries = [self.check_summary(hc) for hc in checks]
+        # refreshing here keeps the gauge and the payload telling the
+        # same number whenever anyone looks
+        ratio = self.refresh_fleet_goodput()
+        window_runs = sum(e["window"]["results"] for e in entries)
+        return {
+            "fleet": {
+                "checks": len(entries),
+                "window_runs": window_runs,
+                "goodput_ratio": ratio,
+                "generated_at": now.isoformat(),
+            },
+            "checks": entries,
+        }

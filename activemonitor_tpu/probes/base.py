@@ -2,18 +2,52 @@
 
 A probe is a callable returning a :class:`ProbeResult`. Run as a
 workflow payload (any engine), its last stdout line is the JSON
-custom-metrics contract the controller parses into Prometheus gauges
+custom-metrics contract the controller parses into Prometheus series
 (reference contract: internal/metrics/collector.go:68-115 —
 ``{"metrics": [{name, value, metrictype, help}]}``), and its exit code
 is the probe verdict Argo/the local engine turn into Succeeded/Failed.
+
+Beyond the reference, the contract carries an optional ``timings``
+block — ``{"timings": {phase: seconds}}`` — measured INSIDE the payload
+with :class:`PhaseTimings` (Reframe-style, PAPERS.md arXiv:2404.10536:
+regression detection needs per-phase timings from inside the benchmark,
+not just end-to-end latency). The controller turns it into
+``healthcheck_phase_seconds{healthcheck_name,phase}`` histograms.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Callable, Dict, Iterator, List
+
+
+class PhaseTimings(Dict[str, float]):
+    """Phase-name → seconds accumulator with a ``phase()`` context
+    manager. A plain dict underneath, so it drops straight into
+    :attr:`ProbeResult.timings`; re-entering a phase name accumulates
+    (a probe may iterate a phase). The time source is injectable for
+    deterministic tests."""
+
+    def __init__(self, monotonic: Callable[[], float] = time.monotonic):
+        super().__init__()
+        self._monotonic = monotonic
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """``with timings.phase("compile"): ...`` — time the block,
+        accumulating into ``self[name]``. The phase is recorded even
+        when the block raises: a probe that dies mid-phase still
+        reports where the time went."""
+        start = self._monotonic()
+        try:
+            yield
+        finally:
+            elapsed = max(0.0, self._monotonic() - start)
+            self[name] = self.get(name, 0.0) + elapsed
 
 
 @dataclass
@@ -38,9 +72,18 @@ class ProbeResult:
     summary: str
     metrics: List[ProbeMetric] = field(default_factory=list)
     details: Dict = field(default_factory=dict)
+    # phase-name -> seconds, measured inside the payload (PhaseTimings);
+    # empty means the probe doesn't attribute its time and the contract
+    # line stays byte-identical to the pre-timings form
+    timings: Dict[str, float] = field(default_factory=dict)
 
     def contract_line(self) -> str:
-        return json.dumps({"metrics": [m.to_contract() for m in self.metrics]})
+        doc: Dict = {"metrics": [m.to_contract() for m in self.metrics]}
+        if self.timings:
+            doc["timings"] = {
+                name: float(seconds) for name, seconds in self.timings.items()
+            }
+        return json.dumps(doc)
 
     def emit(self) -> int:
         """Human-readable report to stderr, contract line to stdout,
@@ -48,5 +91,7 @@ class ProbeResult:
         print(("OK: " if self.ok else "FAIL: ") + self.summary, file=sys.stderr)
         for key, value in sorted(self.details.items()):
             print(f"  {key}: {value}", file=sys.stderr)
+        for name, seconds in sorted(self.timings.items()):
+            print(f"  phase {name}: {seconds:.3f}s", file=sys.stderr)
         print(self.contract_line(), flush=True)
         return 0 if self.ok else 1

@@ -27,7 +27,7 @@ from activemonitor_tpu.models.probe_model import (
     init_params,
     tiny_config,
 )
-from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.base import PhaseTimings, ProbeMetric, ProbeResult
 from activemonitor_tpu.utils.timing import chain_delta_seconds
 
 
@@ -37,15 +37,18 @@ def run(
     seq: int = 128,
     tiny: bool = False,
 ) -> ProbeResult:
-    cfg = tiny_config() if tiny else ProbeModelConfig()
-    seq = min(seq, cfg.max_seq_len)
-    params = init_params(jax.random.key(0), cfg)
-    tokens = jnp.zeros((batch, seq), jnp.int32)
+    timings = PhaseTimings()
+    with timings.phase("init"):
+        cfg = tiny_config() if tiny else ProbeModelConfig()
+        seq = min(seq, cfg.max_seq_len)
+        params = init_params(jax.random.key(0), cfg)
+        tokens = jnp.zeros((batch, seq), jnp.int32)
 
     # cold compile: wall clock ending in a forced scalar readback
     scalar_fwd = jax.jit(lambda p, t: forward(p, t, cfg).mean())
     t0 = time.perf_counter()
-    float(scalar_fwd(params, tokens))
+    with timings.phase("compile"):
+        float(scalar_fwd(params, tokens))
     compile_seconds = time.perf_counter() - t0
 
     # warm execution: chain-difference (constant overhead cancels). The
@@ -66,7 +69,8 @@ def run(
             return means[-1]
         return jax.jit(chain)
 
-    exec_seconds = chain_delta_seconds(make_chain, params, tokens)
+    with timings.phase("execute"):
+        exec_seconds = chain_delta_seconds(make_chain, params, tokens)
 
     ok = compile_seconds <= compile_deadline_seconds
     return ProbeResult(
@@ -93,4 +97,5 @@ def run(
             "d_model": cfg.d_model,
             "n_layers": cfg.n_layers,
         },
+        timings=timings,
     )

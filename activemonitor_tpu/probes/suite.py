@@ -14,7 +14,7 @@ import os
 import sys
 from typing import List, Optional, Tuple
 
-from activemonitor_tpu.probes.base import ProbeResult
+from activemonitor_tpu.probes.base import PhaseTimings, ProbeResult
 
 log = logging.getLogger("activemonitor.probes")
 
@@ -57,16 +57,21 @@ def run(
     if compile_cache:
         enable_persistent_compile_cache()
     results: List[Tuple[str, ProbeResult]] = []
+    # each sub-probe is one phase of the battery payload: the timings
+    # block tells the controller where a slow `probes all` run spent its
+    # time without re-running anything
+    timings = PhaseTimings()
 
     def add(name: str, fn) -> None:
         if name in skip:
             return
-        try:
-            results.append((name, fn()))
-        except Exception as e:  # a crashing probe is a failing probe
-            results.append(
-                (name, ProbeResult(ok=False, summary=f"{name} crashed: {e!r}"))
-            )
+        with timings.phase(name):
+            try:
+                results.append((name, fn()))
+            except Exception as e:  # a crashing probe is a failing probe
+                results.append(
+                    (name, ProbeResult(ok=False, summary=f"{name} crashed: {e!r}"))
+                )
 
     from activemonitor_tpu.probes import (
         compile_smoke,
@@ -176,8 +181,14 @@ def run(
 
     metrics = []
     failed = []
+    merged_timings: dict = dict(timings)
     for name, result in results:
         metrics.extend(result.metrics)
+        # a sub-probe attributing its own phases nests under its name
+        # ("training-step.compile"), beside the battery's per-probe wall
+        # time
+        for phase, seconds in result.timings.items():
+            merged_timings[f"{name}.{phase}"] = seconds
         status = "OK " if result.ok else "FAIL"
         print(f"  [{status}] {name}: {result.summary}", file=sys.stderr)
         if not result.ok:
@@ -193,4 +204,5 @@ def run(
         summary=summary,
         metrics=metrics,
         details={"probes_run": len(results), "failed": failed},
+        timings=merged_timings,
     )

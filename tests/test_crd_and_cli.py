@@ -49,6 +49,37 @@ def test_crd_schema_has_reference_spec_fields():
     assert "totalHealthCheckRuns" in status_props
 
 
+def test_crd_slo_block_uses_v1_legal_exclusive_bounds():
+    """apiextensions.k8s.io/v1 JSONSchemaProps declares
+    exclusiveMinimum/Maximum as BOOLEANS beside minimum/maximum;
+    pydantic's draft-2020-12 numeric form would make the whole CRD
+    fail to decode at apply time."""
+    crd = build_crd()
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]
+    slo = props["spec"]["properties"]["slo"]
+    objective = slo["properties"]["objective"]
+    assert objective["minimum"] == 0.0
+    assert objective["exclusiveMinimum"] is True
+    assert objective["maximum"] == 1.0
+    assert objective["exclusiveMaximum"] is True
+    window = slo["properties"]["windowSeconds"]
+    assert window["minimum"] == 0
+    assert window["exclusiveMinimum"] is True
+
+    def no_numeric_exclusive_bounds(node):
+        if isinstance(node, dict):
+            for key in ("exclusiveMinimum", "exclusiveMaximum"):
+                if key in node:
+                    assert isinstance(node[key], bool), node
+            for value in node.values():
+                no_numeric_exclusive_bounds(value)
+        elif isinstance(node, list):
+            for value in node:
+                no_numeric_exclusive_bounds(value)
+
+    no_numeric_exclusive_bounds(crd)
+
+
 def test_crd_has_no_refs_or_nulls():
     text = crd_yaml()
     assert "$ref" not in text

@@ -307,6 +307,48 @@ def test_declared_metric_names_pass_the_sanitizer():
         )
 
 
+def test_collector_families_are_pinned_in_the_exposition_contract():
+    """Every Gauge/Counter/Histogram/Summary constructed in
+    metrics/collector.py must appear in tests/test_metrics.py's
+    PINNED_FAMILIES table — a new family cannot ship without its scrape
+    name being part of the exposition contract."""
+    import ast
+
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    pinned = set(contract.PINNED_FAMILIES)
+
+    collector_path = REPO / "activemonitor_tpu" / "metrics" / "collector.py"
+    tree = ast.parse(collector_path.read_text())
+    declared = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in {"Gauge", "Counter", "Histogram", "Summary"}
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            declared.append((node.lineno, node.args[0].value))
+    # the collector's static families must actually be found — an AST
+    # refactor that hides them would hollow this gate out silently
+    assert len(declared) >= 20
+    unpinned = [
+        f"collector.py:{lineno}: {name!r} not in PINNED_FAMILIES"
+        for lineno, name in declared
+        if name not in pinned
+    ]
+    assert unpinned == []
+    # and the pin list carries no dead names the collector dropped
+    declared_names = {name for _ln, name in declared}
+    stale = pinned - declared_names
+    assert stale == set(), f"PINNED_FAMILIES entries no longer declared: {stale}"
+
+
 def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
     got = findings(
         tmp_path,

@@ -186,6 +186,94 @@ REFERENCE_SCRAPE_NAMES = (
     "healthcheck_finishedtime",
 )
 
+# EVERY static family the collector constructs, by declared name —
+# the exposition contract. tests/test_lint.py walks collector.py's AST
+# and rejects any Gauge/Counter/Histogram/Summary constructed there
+# whose name is missing from this table, so a new family cannot ship
+# unpinned. Values are the prometheus type (drives which sample suffix
+# the scrape assertion looks for).
+PINNED_FAMILIES = {
+    "healthcheck_success_count": "gauge",
+    "healthcheck_error_count": "gauge",
+    "healthcheck_runtime_seconds": "gauge",
+    "healthcheck_starttime": "gauge",
+    "healthcheck_finishedtime": "gauge",
+    "healthcheck_runtime_histogram_seconds": "histogram",
+    "healthcheck_phase_seconds": "histogram",
+    "healthcheck_cadence_goodput": "gauge",
+    "healthcheck_fleet_goodput_ratio": "gauge",
+    "healthcheck_slo_availability_ratio": "gauge",
+    "healthcheck_error_budget_remaining": "gauge",
+    "healthcheck_slo_burn_rate": "gauge",
+    "workflow_watch_healthy": "gauge",
+    "controller_runtime_reconcile_total": "counter",
+    "controller_runtime_reconcile_time_seconds": "histogram",
+    "controller_runtime_active_workers": "gauge",
+    "controller_runtime_max_concurrent_reconciles": "gauge",
+    "workqueue_depth": "gauge",
+    "workqueue_adds_total": "counter",
+    "workqueue_queue_duration_seconds": "histogram",
+    "workqueue_work_duration_seconds": "histogram",
+    "engine_submit_total": "counter",
+    "engine_poll_total": "counter",
+    "workflow_watch_restarts_total": "counter",
+}
+
+
+def exercise_every_family(collector):
+    """Touch every static family so each one has at least one sample."""
+    collector.record_success("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 0, 1)
+    collector.record_failure("hc-a", WORKFLOW_LABEL_HEALTHCHECK, 1, 2)
+    collector.record_reconcile("success", 0.25)
+    collector.record_queue_add(1)
+    collector.record_queue_get(0, 0.05)
+    collector.record_work_duration(0.2)
+    collector.set_active_workers(1)
+    collector.set_max_concurrent(10)
+    collector.record_engine_submit("fake")
+    collector.record_engine_poll("fake")
+    collector.record_watch_restart("health")
+    collector.record_watch_health("health", True)
+    collector.cadence_goodput.set(1.0)
+    collector.set_fleet_goodput(1.0)
+    collector.set_slo(
+        "hc-a",
+        "health",
+        availability=0.9,
+        error_budget_remaining=0.5,
+        burn_rate=0.5,
+    )
+    collector.record_custom_metrics(
+        "hc-a",
+        {
+            "outputs": {
+                "parameters": [
+                    {"name": "m", "value": '{"metrics": [], "timings": {"p": 1.0}}'}
+                ]
+            }
+        },
+    )
+
+
+def test_every_pinned_family_appears_in_the_scrape(collector):
+    """The pinned table and the scrape text agree: every declared
+    family yields samples under its declared name (counters keep their
+    declared `_total`; histograms expose `_bucket`)."""
+    exercise_every_family(collector)
+    lines = collector.exposition().decode().splitlines()
+
+    def scraped(prefix):
+        return any(line.startswith(prefix) for line in lines)
+
+    for name, kind in PINNED_FAMILIES.items():
+        if kind == "histogram":
+            assert scraped(name + "_bucket{"), f"{name} missing from scrape"
+        else:
+            # labeled or unlabeled sample, exact declared name
+            assert scraped(name + "{") or scraped(name + " "), (
+                f"{name} missing from scrape"
+            )
+
 
 def test_scrape_text_pins_reference_names_without_total_suffix(collector):
     """The exposition contract, asserted on the scrape text itself:
@@ -269,6 +357,132 @@ def test_reconcile_and_queue_recorders_accumulate(collector):
         )
         == 0.25
     )
+
+
+def custom_status(*entries, timings=None):
+    import json as _json
+
+    doc = {"metrics": list(entries)}
+    if timings is not None:
+        doc["timings"] = timings
+    return {
+        "outputs": {"parameters": [{"name": "m", "value": _json.dumps(doc)}]}
+    }
+
+
+def test_custom_counter_metrictype_is_honored(collector):
+    """metrictype=counter increments a real Counter (per-run delta ->
+    monotonic total) instead of being coerced into a settable gauge."""
+    entry = {"name": "probe-errors", "value": 2, "metrictype": "counter"}
+    assert collector.record_custom_metrics("hc", custom_status(entry)) == 1
+    entry["value"] = 3
+    assert collector.record_custom_metrics("hc", custom_status(entry)) == 1
+    assert (
+        collector.sample_value(
+            "hc_probe_errors_total", {"healthcheck_name": "hc"}
+        )
+        == 5
+    )
+    # the scrape shows counter semantics: _total suffix + TYPE counter
+    text = collector.exposition().decode()
+    assert 'hc_probe_errors_total{healthcheck_name="hc"} 5.0' in text
+    assert "# TYPE hc_probe_errors_total counter" in text
+
+
+def test_unknown_metrictype_is_rejected_not_coerced(collector, caplog):
+    import logging as _logging
+
+    entry = {"name": "bw", "value": 1.0, "metrictype": "summary"}
+    with caplog.at_level(_logging.WARNING):
+        assert collector.record_custom_metrics("hc", custom_status(entry)) == 0
+    assert collector.sample_value("hc_bw", {"healthcheck_name": "hc"}) is None
+    assert any("unknown metrictype" in r.message for r in caplog.records)
+
+
+def test_custom_metric_type_conflict_is_skipped(collector):
+    gauge = {"name": "bw", "value": 1.0, "metrictype": "gauge"}
+    assert collector.record_custom_metrics("hc", custom_status(gauge)) == 1
+    retyped = {"name": "bw", "value": 2.0, "metrictype": "counter"}
+    assert collector.record_custom_metrics("hc", custom_status(retyped)) == 0
+    assert collector.sample_value("hc_bw", {"healthcheck_name": "hc"}) == 1.0
+
+
+def test_negative_counter_increment_is_skipped(collector):
+    entry = {"name": "errs", "value": -1, "metrictype": "counter"}
+    assert collector.record_custom_metrics("hc", custom_status(entry)) == 0
+
+
+def test_malformed_timings_entries_are_skipped(collector):
+    status = custom_status(
+        timings={"good": 2.0, "bad": "NaN-ish", "": 1.0}
+    )
+    collector.record_custom_metrics("hc", status)
+    assert (
+        collector.sample_value(
+            "healthcheck_phase_seconds_sum",
+            {"healthcheck_name": "hc", "phase": "good"},
+        )
+        == 2.0
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_phase_seconds_count",
+            {"healthcheck_name": "hc", "phase": "bad"},
+        )
+        is None
+    )
+    # a non-object timings block is ignored wholesale, never raised
+    bad = {"outputs": {"parameters": [{"name": "m", "value": '{"metrics": [], "timings": [1, 2]}'}]}}
+    assert collector.record_custom_metrics("hc", bad) == 0
+
+
+def test_runtime_buckets_are_log_spaced_and_cover_multi_minute_probes(collector):
+    """The satellite fix: the default client buckets cap at 10 s; TPU
+    probe workflows run minutes. Boundaries pinned here."""
+    from activemonitor_tpu.metrics.collector import _PROBE_RUNTIME_BUCKETS
+
+    finite = [b for b in _PROBE_RUNTIME_BUCKETS if b != float("inf")]
+    assert _PROBE_RUNTIME_BUCKETS[-1] == float("inf")
+    assert finite[0] <= 1
+    assert finite[-1] >= 1800  # 30 minutes of resolution
+    assert finite == sorted(finite)
+    # log-spaced: adjacent boundaries grow by a bounded factor, so
+    # resolution neither collapses nor explodes anywhere in the range
+    ratios = [b / a for a, b in zip(finite, finite[1:])]
+    assert all(1.5 <= r <= 5.0 for r in ratios), ratios
+    # a 10-minute run lands in a real bucket, not +Inf
+    collector.record_success("hc", WORKFLOW_LABEL_HEALTHCHECK, 0, 600)
+    assert (
+        collector.sample_value(
+            "healthcheck_runtime_histogram_seconds_bucket",
+            {**labels("hc"), "le": "900.0"},
+        )
+        == 1
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_runtime_histogram_seconds_bucket",
+            {**labels("hc"), "le": "300.0"},
+        )
+        == 0
+    )
+    # the phase histogram shares the probe-scale buckets
+    assert collector.phase_seconds._kwargs["buckets"] == _PROBE_RUNTIME_BUCKETS
+
+
+def test_openmetrics_exposition_carries_exemplars(collector):
+    """Exemplars render only in the OpenMetrics format; the default
+    text format (the reference scrape contract) stays exemplar-free."""
+    from activemonitor_tpu.obs import Tracer
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    tracer = Tracer(FakeClock())
+    with tracer.span("poll") as span:
+        collector.record_success("hc", WORKFLOW_LABEL_HEALTHCHECK, 0, 7)
+    om_text = collector.exposition(openmetrics=True).decode()
+    assert f'# {{trace_id="{span.trace_id}"}}' in om_text
+    assert "trace_id" not in collector.exposition().decode()
+    assert "openmetrics-text" in collector.OPENMETRICS_CONTENT_TYPE
 
 
 def test_two_collectors_do_not_share_registries():

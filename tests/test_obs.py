@@ -391,14 +391,14 @@ async def test_debug_endpoints_enforce_metrics_auth_on_shared_site():
     port = manager._http_runners[0].addresses[0][1]
     try:
         async with aiohttp.ClientSession() as session:
-            for path in ("/debug/traces", "/debug/events", "/metrics"):
+            for path in ("/debug/traces", "/debug/events", "/statusz", "/metrics"):
                 async with session.get(f"http://127.0.0.1:{port}{path}") as r:
                     assert r.status == 401, path
             # the kubelet's probes stay open
             async with session.get(f"http://127.0.0.1:{port}/healthz") as r:
                 assert r.status == 200
             headers = {"Authorization": "Bearer sekrit"}
-            for path in ("/debug/traces", "/debug/events", "/metrics"):
+            for path in ("/debug/traces", "/debug/events", "/statusz", "/metrics"):
                 async with session.get(
                     f"http://127.0.0.1:{port}{path}", headers=headers
                 ) as r:

@@ -1,0 +1,873 @@
+"""Fleet SLO layer tests: the result-history ring, the rolling-window
+SLO math, the /statusz contract, and the acceptance slice of ISSUE 2 —
+a FakeEngine + fake-clock scripted pass/fail sequence yielding exact
+availability / p95 / error-budget values via both /statusz and
+``sample_value()``, with the cycle's trace id riding the
+``healthcheck_phase_seconds`` histogram as an OpenMetrics exemplar.
+"""
+
+import asyncio
+import collections
+import datetime
+import json
+import re
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.engine.base import PHASE_FAILED, PHASE_SUCCEEDED
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs import FleetStatus, ResultHistory, SLOConfig
+from activemonitor_tpu.obs.slo import (
+    DEFAULT_WINDOW_SECONDS,
+    evaluate,
+    fleet_goodput,
+    quantile,
+    slo_config_from_spec,
+    window_results,
+)
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+
+def make_hc(name="hc-slo", repeat=60, slo=None):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        # backoffMin == backoffMax == 1 makes the poll pacer sleep
+        # exactly 1 s per step, so scripted poll counts translate to
+        # exact latencies on the fake clock
+        "backoffMax": 1,
+        "backoffMin": 1,
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if slo is not None:
+        spec["slo"] = slo
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+# ---------------------------------------------------------------------
+# history ring
+# ---------------------------------------------------------------------
+
+
+def test_history_eviction_order_under_wraparound():
+    clock = FakeClock()
+    history = ResultHistory(clock, capacity=5)
+    for i in range(12):
+        history.record("ns/hc", ok=True, latency=float(i), workflow=f"wf-{i}")
+    results = history.results("ns/hc")
+    assert len(results) == 5
+    # oldest evicted first; survivors keep insertion order
+    assert [r.workflow for r in results] == [f"wf-{i}" for i in range(7, 12)]
+    assert [r.latency for r in results] == [7.0, 8.0, 9.0, 10.0, 11.0]
+    assert history.last("ns/hc").workflow == "wf-11"
+
+
+def test_history_per_check_isolation():
+    history = ResultHistory(FakeClock(), capacity=3)
+    for i in range(5):
+        history.record("ns/a", ok=True, latency=1.0, workflow=f"a-{i}")
+    history.record("ns/b", ok=False, latency=2.0, workflow="b-0")
+    assert len(history.results("ns/a")) == 3  # a wrapped
+    assert len(history.results("ns/b")) == 1  # b untouched by a's churn
+    assert history.results("ns/b")[0].ok is False
+    assert sorted(history.checks()) == ["ns/a", "ns/b"]
+    history.forget("ns/a")
+    assert history.results("ns/a") == []
+    assert len(history.results("ns/b")) == 1
+
+
+def test_history_tail_and_timestamps_come_from_injected_clock():
+    clock = FakeClock()
+    history = ResultHistory(clock)
+
+    async def drive():
+        history.record("ns/hc", ok=True, latency=0.0, workflow="w1")
+        await clock.advance(10.0)
+        history.record("ns/hc", ok=True, latency=0.0, workflow="w2")
+
+    asyncio.run(drive())
+    first, second = history.results("ns/hc")
+    assert (second.ts - first.ts).total_seconds() == 10.0
+    assert [r.workflow for r in history.tail("ns/hc", 1)] == ["w2"]
+    assert history.tail("ns/hc", 0) == []
+    assert history.tail("ns/none") == []
+
+
+# ---------------------------------------------------------------------
+# SLO math (pure functions, exact values)
+# ---------------------------------------------------------------------
+
+
+def scripted_history(clock, verdicts_latencies, key="ns/hc"):
+    history = ResultHistory(clock)
+    for ok, latency in verdicts_latencies:
+        history.record(key, ok=ok, latency=latency, workflow="wf")
+    return history
+
+
+def test_quantiles_are_nearest_rank_exact():
+    latencies = [0.0, 1.0, 1.0, 2.0, 2.0, 3.0, 3.0, 4.0, 4.0, 9.0]
+    assert quantile(latencies, 0.50) == 2.0
+    assert quantile(latencies, 0.95) == 9.0
+    assert quantile(latencies, 0.99) == 9.0
+    assert quantile([5.0], 0.95) == 5.0
+    assert quantile([], 0.95) is None
+
+
+def test_evaluate_exact_budget_math():
+    clock = FakeClock()
+    # 8 passes, 2 failures; objective 0.8 allows a 0.2 failure ratio
+    history = scripted_history(
+        clock, [(True, 1.0)] * 8 + [(False, 1.0)] * 2
+    )
+    state = evaluate(
+        history.results("ns/hc"),
+        SLOConfig(objective=0.8, window_seconds=3600),
+        clock.now(),
+    )
+    assert state.availability == 0.8
+    assert state.burn_rate == pytest.approx(1.0)
+    assert state.error_budget_remaining == pytest.approx(0.0)
+    # a blown budget goes negative — the overdraft is the signal
+    history.record("ns/hc", ok=False, latency=1.0, workflow="wf")
+    state = evaluate(
+        history.results("ns/hc"),
+        SLOConfig(objective=0.8, window_seconds=3600),
+        clock.now(),
+    )
+    assert state.error_budget_remaining < 0
+
+
+def test_results_age_out_of_the_window():
+    clock = FakeClock()
+    history = ResultHistory(clock)
+
+    async def drive():
+        history.record("ns/hc", ok=False, latency=1.0, workflow="old")
+        await clock.advance(120.0)
+        history.record("ns/hc", ok=True, latency=1.0, workflow="new")
+
+    asyncio.run(drive())
+    config = SLOConfig(objective=0.9, window_seconds=60)
+    windowed = window_results(history.results("ns/hc"), clock.now(), 60)
+    assert [r.workflow for r in windowed] == ["new"]
+    state = evaluate(history.results("ns/hc"), config, clock.now())
+    # the old failure aged out: a clean window, full budget
+    assert state.availability == 1.0
+    assert state.error_budget_remaining == 1.0
+    assert state.burn_rate == 0.0
+
+
+def test_window_left_boundary_is_exclusive():
+    """The window is (now - windowSeconds, now]: a result EXACTLY one
+    window old has aged out."""
+    clock = FakeClock()
+    history = ResultHistory(clock)
+
+    async def drive():
+        history.record("ns/hc", ok=False, latency=1.0, workflow="boundary")
+        await clock.advance(60.0)
+        history.record("ns/hc", ok=True, latency=1.0, workflow="fresh")
+
+    asyncio.run(drive())
+    windowed = window_results(history.results("ns/hc"), clock.now(), 60.0)
+    assert [r.workflow for r in windowed] == ["fresh"]
+
+
+def test_evaluate_empty_window_reports_none():
+    clock = FakeClock()
+    state = evaluate([], SLOConfig(objective=0.9, window_seconds=60), clock.now())
+    assert state.availability is None
+    assert state.error_budget_remaining is None
+    assert state.burn_rate is None
+
+
+def test_slo_config_from_spec_defaults_off():
+    assert slo_config_from_spec(make_hc().spec) is None
+    config = slo_config_from_spec(
+        make_hc(slo={"objective": 0.99, "windowSeconds": 600}).spec
+    )
+    assert config == SLOConfig(objective=0.99, window_seconds=600.0)
+
+
+def test_fleet_goodput_is_run_weighted():
+    clock = FakeClock()
+    history = ResultHistory(clock)
+    for _ in range(9):
+        history.record("ns/flappy", ok=False, latency=1.0, workflow="wf")
+    history.record("ns/flappy", ok=True, latency=1.0, workflow="wf")
+    history.record("ns/steady", ok=True, latency=1.0, workflow="wf")
+    ratio = fleet_goodput(history, {}, clock.now())
+    assert ratio == pytest.approx(2 / 11)
+    assert fleet_goodput(ResultHistory(clock), {}, clock.now()) is None
+
+
+# ---------------------------------------------------------------------
+# FleetStatus: gauges + /statusz payload
+# ---------------------------------------------------------------------
+
+
+SLO_LABELS = {"healthcheck_name": "hc-slo", "namespace": "health"}
+
+
+def test_fleet_status_updates_slo_gauges_and_forget_clears_them():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    fleet = FleetStatus(clock, metrics)
+    hc = make_hc(slo={"objective": 0.8, "windowSeconds": 3600})
+    for ok in (True, True, True, False):
+        fleet.record(hc, ok=ok, latency=1.0, workflow="wf")
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        == 0.75
+    )
+    assert metrics.sample_value(
+        "healthcheck_error_budget_remaining", SLO_LABELS
+    ) == pytest.approx(1.0 - 0.25 / 0.2)
+    assert metrics.sample_value(
+        "healthcheck_slo_burn_rate", SLO_LABELS
+    ) == pytest.approx(0.25 / 0.2)
+    # the fleet rollup is refreshed off the record path (manager loop /
+    # statusz), not per run
+    assert fleet.refresh_fleet_goodput() == 0.75
+    assert metrics.sample_value("healthcheck_fleet_goodput_ratio", {}) == 0.75
+    fleet.forget(hc.key, hc.metadata.name, hc.metadata.namespace)
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        is None
+    )
+    assert fleet.history.results(hc.key) == []
+
+
+def test_fleet_status_without_slo_block_sets_no_slo_series():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    fleet = FleetStatus(clock, metrics)
+    fleet.record(make_hc(), ok=True, latency=1.0, workflow="wf")
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        is None
+    )
+    # fleet goodput still counts the run
+    assert fleet.refresh_fleet_goodput() == 1.0
+    assert metrics.sample_value("healthcheck_fleet_goodput_ratio", {}) == 1.0
+
+
+def test_removing_the_slo_block_clears_the_series():
+    """Editing spec.slo off a live check must stop its gauges from
+    advertising the last pre-edit budget forever."""
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    fleet = FleetStatus(clock, metrics)
+    with_slo = make_hc(slo={"objective": 0.9, "windowSeconds": 600})
+    fleet.record(with_slo, ok=True, latency=1.0, workflow="wf")
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        == 1.0
+    )
+    edited = make_hc()  # same check, slo block removed
+    fleet.record(edited, ok=True, latency=1.0, workflow="wf")
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        is None
+    )
+
+
+def test_same_name_checks_in_different_namespaces_keep_separate_series():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    fleet = FleetStatus(clock, metrics)
+    a = make_hc(slo={"objective": 0.9, "windowSeconds": 600})
+    b = make_hc(slo={"objective": 0.9, "windowSeconds": 600})
+    b.metadata.namespace = "staging"
+    fleet.record(a, ok=True, latency=1.0, workflow="wf")
+    fleet.record(b, ok=False, latency=1.0, workflow="wf")
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        == 1.0
+    )
+    assert (
+        metrics.sample_value(
+            "healthcheck_slo_availability_ratio",
+            {"healthcheck_name": "hc-slo", "namespace": "staging"},
+        )
+        == 0.0
+    )
+    # deleting one namespace's check leaves the other's series alone
+    fleet.forget(b.key, b.metadata.name, b.metadata.namespace)
+    assert (
+        metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+        == 1.0
+    )
+
+
+# the /statusz schema, locked field-by-field like the exposition test:
+# renaming or retyping any of these breaks dashboards and `am-tpu
+# status` alike, so it must be a deliberate, test-visible change
+FLEET_FIELDS = {
+    "checks": int,
+    "window_runs": int,
+    "goodput_ratio": (int, float, type(None)),
+    "generated_at": str,
+}
+CHECK_FIELDS = {
+    "key": str,
+    "healthcheck": str,
+    "namespace": str,
+    "last_status": str,
+    "last_trace_id": str,
+    "runs_recorded": int,
+    "window": dict,
+    "slo": (dict, type(None)),
+    "history": list,
+}
+WINDOW_FIELDS = {
+    "seconds": (int, float),
+    "results": int,
+    "availability": (int, float, type(None)),
+    "p50_seconds": (int, float, type(None)),
+    "p95_seconds": (int, float, type(None)),
+    "p99_seconds": (int, float, type(None)),
+}
+SLO_FIELDS = {
+    "objective": (int, float),
+    "window_seconds": (int, float),
+    "availability": (int, float, type(None)),
+    "error_budget_remaining": (int, float, type(None)),
+    "burn_rate": (int, float, type(None)),
+}
+HISTORY_FIELDS = {
+    "ts": str,
+    "ok": bool,
+    "latency_seconds": (int, float),
+    "workflow": str,
+    "trace_id": str,
+}
+
+
+def assert_schema(doc: dict, fields: dict, where: str):
+    assert set(doc.keys()) == set(fields.keys()), f"{where}: {sorted(doc)}"
+    for field_name, types in fields.items():
+        assert isinstance(doc[field_name], types), (
+            f"{where}.{field_name} is {type(doc[field_name]).__name__}"
+        )
+
+
+def test_statusz_schema_contract():
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    with_slo = make_hc(slo={"objective": 0.9, "windowSeconds": 600})
+    without = make_hc(name="hc-plain")
+    fleet.record(with_slo, ok=True, latency=2.0, workflow="wf-1")
+    fleet.record(with_slo, ok=False, latency=4.0, workflow="wf-2")
+    # JSON round-trip: the contract is what a client parses, not the
+    # Python objects
+    payload = json.loads(json.dumps(fleet.statusz([with_slo, without])))
+    assert_schema(payload["fleet"], FLEET_FIELDS, "fleet")
+    assert len(payload["checks"]) == 2
+    for check in payload["checks"]:
+        assert_schema(check, CHECK_FIELDS, "check")
+        assert_schema(check["window"], WINDOW_FIELDS, "window")
+        for entry in check["history"]:
+            assert_schema(entry, HISTORY_FIELDS, "history")
+    slo_check = payload["checks"][0]
+    assert_schema(slo_check["slo"], SLO_FIELDS, "slo")
+    assert slo_check["slo"]["availability"] == 0.5
+    assert slo_check["window"]["p95_seconds"] == 4.0
+    assert slo_check["history"][-1]["workflow"] == "wf-2"
+    assert payload["checks"][1]["slo"] is None
+    assert payload["checks"][1]["window"]["seconds"] == DEFAULT_WINDOW_SECONDS
+
+
+def test_statusz_history_is_a_bounded_tail():
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    for i in range(25):
+        fleet.record(hc, ok=True, latency=float(i), workflow=f"wf-{i}")
+    [entry] = fleet.statusz([hc])["checks"]
+    assert len(entry["history"]) == FleetStatus.HISTORY_TAIL
+    assert entry["history"][-1]["workflow"] == "wf-24"
+    assert entry["runs_recorded"] == 25
+
+
+# ---------------------------------------------------------------------
+# acceptance: FakeEngine + fake clock scripted sequence
+# ---------------------------------------------------------------------
+
+# (polls-until-terminal, verdict): latency is exactly polls-1 seconds
+# with the 1 s constant backoff the spec pins. Sorted latencies
+# [0,1,1,2,2,3,3,4,4,9] -> p50=2.0, p95=9.0; 9/10 ok with objective 0.8
+# -> availability 0.9, burn 0.5, budget remaining 0.5.
+SCRIPT = [
+    (1, True),
+    (2, True),
+    (2, True),
+    (3, True),
+    (3, True),
+    (4, True),
+    (4, True),
+    (5, True),
+    (5, True),
+    (10, False),
+]
+EXPECTED_AVAILABILITY = 0.9
+EXPECTED_P50 = 2.0
+EXPECTED_P95 = 9.0
+EXPECTED_BUDGET_REMAINING = 0.5
+EXPECTED_BURN = 0.5
+
+CONTRACT_DOC = json.dumps(
+    {
+        "metrics": [
+            {"name": "probe-bw-gbps", "value": 123.0, "metrictype": "gauge"}
+        ],
+        "timings": {"allreduce": 2.5, "compile": 30.0},
+    }
+)
+OUTPUTS = {"parameters": [{"name": "metrics", "value": CONTRACT_DOC}]}
+
+
+def scripted_engine(script):
+    """FakeEngine whose Nth submitted workflow follows the Nth script
+    entry: pending until the scripted poll count, then the scripted
+    verdict (successes carry the metrics+timings contract)."""
+    engine = FakeWorkflowEngine()
+    queue = collections.deque(script)
+    assigned = {}
+
+    def completer(wf, count):
+        name = wf["metadata"]["name"]
+        if name not in assigned:
+            if not queue:
+                return None  # off-script: stays pending
+            assigned[name] = queue.popleft()
+        polls, ok = assigned[name]
+        if count < polls:
+            return None
+        if ok:
+            return {"phase": PHASE_SUCCEEDED, "outputs": OUTPUTS}
+        return {"phase": PHASE_FAILED, "message": "scripted failure"}
+
+    engine._default_completer = completer
+    return engine
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+@pytest.mark.asyncio
+async def test_scripted_sequence_yields_exact_slo_values(tmp_path):
+    import aiohttp
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine(SCRIPT),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+    manager._health_addr = "127.0.0.1:0"
+    await manager.start()
+    try:
+        hc = make_hc(slo={"objective": 0.8, "windowSeconds": 3600})
+        await client.apply(hc)
+        first = True
+        for polls, _ok in SCRIPT:
+            if not first:
+                # fire the reschedule timer for the next run
+                await clock.advance(60.0)
+            first = False
+            await settle()
+            for _ in range(polls):
+                await clock.advance(1.0)
+            await settle()
+
+        key = "health/hc-slo"
+        results = reconciler.fleet.history.results(key)
+        assert [r.ok for r in results] == [ok for _p, ok in SCRIPT]
+        assert [r.latency for r in results] == [
+            float(p - 1) for p, _ok in SCRIPT
+        ]
+
+        # exact values through the registry...
+        assert (
+            metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+            == EXPECTED_AVAILABILITY
+        )
+        assert metrics.sample_value(
+            "healthcheck_error_budget_remaining", SLO_LABELS
+        ) == pytest.approx(EXPECTED_BUDGET_REMAINING)
+        assert metrics.sample_value(
+            "healthcheck_slo_burn_rate", SLO_LABELS
+        ) == pytest.approx(EXPECTED_BURN)
+        # phase timings flowed from the stdout contract of each of the
+        # 9 successful runs
+        assert metrics.sample_value(
+            "healthcheck_phase_seconds_sum",
+            {"healthcheck_name": "hc-slo", "phase": "allreduce"},
+        ) == pytest.approx(9 * 2.5)
+
+        # ... and the same exact values through /statusz
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port}/statusz") as r:
+                assert r.status == 200
+                payload = await r.json()
+        [entry] = payload["checks"]
+        assert entry["key"] == key
+        assert entry["window"]["availability"] == EXPECTED_AVAILABILITY
+        assert entry["window"]["p50_seconds"] == EXPECTED_P50
+        assert entry["window"]["p95_seconds"] == EXPECTED_P95
+        assert entry["slo"]["error_budget_remaining"] == pytest.approx(
+            EXPECTED_BUDGET_REMAINING
+        )
+        assert entry["slo"]["burn_rate"] == pytest.approx(EXPECTED_BURN)
+        assert payload["fleet"]["goodput_ratio"] == EXPECTED_AVAILABILITY
+        # serving /statusz refreshed the fleet gauge to the same number
+        assert (
+            metrics.sample_value("healthcheck_fleet_goodput_ratio", {})
+            == EXPECTED_AVAILABILITY
+        )
+        assert entry["last_status"] == "Failed"
+        assert entry["last_trace_id"]
+
+        # every recorded run is joinable to a retained trace
+        trace_ids = {t["trace_id"] for t in reconciler.tracer.traces()}
+        for result in results:
+            assert result.trace_id in trace_ids
+
+        # the phase histogram carries the cycle's trace id as an
+        # OpenMetrics exemplar, resolvable in /debug/traces
+        om_text = metrics.exposition(openmetrics=True).decode()
+        match = re.search(
+            r'healthcheck_phase_seconds_bucket\{[^}]*phase="allreduce"[^}]*\}'
+            r' [0-9.e+-]+ # \{trace_id="([0-9a-f]+)"\}',
+            om_text,
+        )
+        assert match, "no trace_id exemplar on healthcheck_phase_seconds"
+        exemplar_trace = match.group(1)
+        assert exemplar_trace in trace_ids
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/traces",
+                params={"trace_id": exemplar_trace},
+            ) as r:
+                traces = (await r.json())["traces"]
+        assert traces and traces[0]["trace_id"] == exemplar_trace
+        # the runtime histogram is exemplar-stamped too
+        assert re.search(
+            r'healthcheck_runtime_histogram_seconds_bucket\{[^}]*\}'
+            r' [0-9.e+-]+ # \{trace_id="[0-9a-f]+"\}',
+            om_text,
+        )
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_deleted_check_drops_out_of_statusz_and_gauges():
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine([(1, True)]),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=1)
+    await manager.start()
+    try:
+        hc = make_hc(slo={"objective": 0.9, "windowSeconds": 600})
+        await client.apply(hc)
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+        assert (
+            metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+            == 1.0
+        )
+        await client.delete("health", "hc-slo")
+        await settle()
+        assert (
+            metrics.sample_value("healthcheck_slo_availability_ratio", SLO_LABELS)
+            is None
+        )
+        assert reconciler.fleet.history.results("health/hc-slo") == []
+        assert reconciler.fleet.statusz(await client.list())["checks"] == []
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_accept_negotiation_serves_openmetrics():
+    """Default scrapes keep the reference's exact text format; a
+    scraper asking for OpenMetrics gets the exemplar-bearing format."""
+    import aiohttp
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine([]),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        max_parallel=1,
+        metrics_bind_address="127.0.0.1:0",
+        metrics_secure=False,
+    )
+    await manager.start()
+    try:
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                assert not (await r.text()).endswith("# EOF\n")
+            async with session.get(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            ) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "application/openmetrics-text"
+                )
+                assert (await r.text()).endswith("# EOF\n")
+    finally:
+        await manager.stop()
+
+
+# ---------------------------------------------------------------------
+# am-tpu status CLI
+# ---------------------------------------------------------------------
+
+
+def test_status_cli_flags_parse():
+    from activemonitor_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(["status"])
+    assert args.url.endswith("/statusz")
+    assert args.output == "table"
+    args = build_parser().parse_args(
+        ["status", "--url", "http://x:1/statusz", "-o", "json"]
+    )
+    assert args.output == "json"
+
+
+def test_render_status_table_shapes_rows():
+    from activemonitor_tpu.__main__ import render_status_table
+
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc(slo={"objective": 0.8, "windowSeconds": 3600})
+    fleet.record(hc, ok=True, latency=2.0, workflow="wf-1")
+    fleet.record(hc, ok=False, latency=6.0, workflow="wf-2")
+    payload = json.loads(json.dumps(fleet.statusz([hc])))
+    text = render_status_table(payload)
+    lines = text.splitlines()
+    assert lines[0].startswith("FLEET  checks=1")
+    assert "goodput=50.0%" in lines[0]
+    header, row = lines[1], lines[2]
+    assert header.split() == [
+        "NAME", "NAMESPACE", "STATUS", "RUNS", "AVAIL",
+        "P50", "P95", "P99", "BUDGET", "BURN", "LAST", "TRACE",
+    ]
+    cells = row.split()
+    assert cells[0] == "hc-slo"
+    assert "50.0%" in row  # availability
+    assert "6.00s" in row  # p95/p99
+    # budget: f=0.5, allowed=0.2 -> remaining 1 - 2.5 = -150%
+    assert "-150.0%" in row
+
+
+def test_render_status_table_empty_fleet():
+    from activemonitor_tpu.__main__ import render_status_table
+
+    text = render_status_table(
+        {
+            "fleet": {
+                "checks": 0,
+                "window_runs": 0,
+                "goodput_ratio": None,
+                "generated_at": "",
+            },
+            "checks": [],
+        }
+    )
+    assert "No HealthChecks found." in text
+    assert "goodput=-" in text
+
+
+@pytest.mark.asyncio
+async def test_status_cli_fetches_statusz(capsys):
+    from activemonitor_tpu.__main__ import _status, build_parser
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine([(1, True)]),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=1)
+    manager._health_addr = "127.0.0.1:0"
+    await manager.start()
+    try:
+        await client.apply(make_hc())
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+        port = manager._http_runners[0].addresses[0][1]
+        args = build_parser().parse_args(
+            ["status", "--url", f"http://127.0.0.1:{port}/statusz"]
+        )
+        assert await _status(args) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("FLEET  checks=1")
+        assert "hc-slo" in out
+        assert "100.0%" in out  # availability of the one passing run
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_status_cli_unreachable_controller_is_a_clean_error(capsys):
+    from activemonitor_tpu.__main__ import _status, build_parser
+
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    args = build_parser().parse_args(
+        ["status", "--url", f"http://127.0.0.1:{port}/statusz"]
+    )
+    assert await _status(args) == 1
+    assert "cannot reach" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# probe phase telemetry (the payload side of the contract)
+# ---------------------------------------------------------------------
+
+
+def test_phase_timings_context_manager_accumulates():
+    from activemonitor_tpu.probes.base import PhaseTimings
+
+    t = [0.0]
+
+    def monotonic():
+        return t[0]
+
+    timings = PhaseTimings(monotonic)
+    with timings.phase("compile"):
+        t[0] += 3.0
+    with timings.phase("execute"):
+        t[0] += 1.5
+    with timings.phase("execute"):  # re-entry accumulates
+        t[0] += 0.5
+    assert timings == {"compile": 3.0, "execute": 2.0}
+
+
+def test_phase_recorded_even_when_the_block_raises():
+    from activemonitor_tpu.probes.base import PhaseTimings
+
+    t = [0.0]
+    timings = PhaseTimings(lambda: t[0])
+    with pytest.raises(RuntimeError):
+        with timings.phase("boom"):
+            t[0] += 2.0
+            raise RuntimeError("x")
+    assert timings["boom"] == 2.0
+
+
+def test_contract_line_carries_timings():
+    from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+
+    result = ProbeResult(
+        ok=True,
+        summary="fine",
+        metrics=[ProbeMetric("bw", 1.0)],
+        timings={"compile": 3.25},
+    )
+    doc = json.loads(result.contract_line())
+    assert doc["timings"] == {"compile": 3.25}
+    # no timings -> the field is absent, keeping the pre-timings
+    # contract byte-compatible
+    bare = ProbeResult(ok=True, summary="fine")
+    assert "timings" not in json.loads(bare.contract_line())
+
+
+def test_emitted_contract_roundtrips_through_the_collector(capsys):
+    """stdout contract -> workflow outputs -> collector: the timings a
+    probe measures are the phases the controller exports."""
+    from activemonitor_tpu.probes.base import ProbeResult
+
+    result = ProbeResult(
+        ok=True, summary="fine", timings={"allreduce": 2.0, "all-gather": 1.0}
+    )
+    assert result.emit() == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    collector = MetricsCollector()
+    status = {"outputs": {"parameters": [{"name": "m", "value": line}]}}
+    collector.record_custom_metrics("hc", status)
+    assert collector.sample_value(
+        "healthcheck_phase_seconds_sum",
+        {"healthcheck_name": "hc", "phase": "allreduce"},
+    ) == 2.0
+    # phase names are sanitized into exposition-legal form
+    assert collector.sample_value(
+        "healthcheck_phase_seconds_sum",
+        {"healthcheck_name": "hc", "phase": "all_gather"},
+    ) == 1.0
+
+
+def test_statusz_generated_at_tracks_clock():
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    payload = fleet.statusz([])
+    assert payload["fleet"]["generated_at"] == clock.now().isoformat()
+    assert datetime.datetime.fromisoformat(payload["fleet"]["generated_at"])
