@@ -79,6 +79,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="maximum concurrent reconciles",
     )
     run.add_argument(
+        "--remedy-rate",
+        type=float,
+        default=0.0,
+        metavar="PER_MINUTE",
+        help="fleet-wide remedy rate cap in remedy runs per minute "
+        "(token bucket; layered on top of each check's "
+        "remedyRunsLimit/remedyResetInterval so one bad rollout can't "
+        "launch hundreds of self-healing workflows at once). 0 disables "
+        "the cap. Suppressed runs are evented and counted in "
+        "healthcheck_remedy_runs_total{result=\"suppressed\"}",
+    )
+    run.add_argument(
         "--engine",
         choices=["local", "argo"],
         default="local",
@@ -283,6 +295,11 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         recorder=recorder,
         metrics=metrics,
     )
+    if kube_api is not None:
+        # the shared circuit breaker observes every request crossing the
+        # cluster transport and gates the mutating ones (leases exempt)
+        # — the signal source for degraded mode (docs/resilience.md)
+        kube_api.set_breaker(reconciler.resilience.breaker)
     metrics_authorizer = None
     k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
     if k8s_auth == "on" and kube_api is None:
@@ -317,6 +334,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         metrics_key_file=args.metrics_key_file,
         metrics_auth_token_file=args.metrics_auth_token_file,
         metrics_authorizer=metrics_authorizer,
+        remedy_rate=args.remedy_rate,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -562,26 +580,35 @@ def render_status_table(payload: dict) -> str:
     """The /statusz payload as the `am-tpu status` table. Pure so tests
     pin the rendering against a canned payload."""
     fleet = payload.get("fleet") or {}
-    lines = [
-        "FLEET  checks={}  window_runs={}  goodput={}".format(
-            fleet.get("checks", 0),
-            fleet.get("window_runs", 0),
-            _fmt_ratio(fleet.get("goodput_ratio")),
+    fleet_line = "FLEET  checks={}  window_runs={}  goodput={}".format(
+        fleet.get("checks", 0),
+        fleet.get("window_runs", 0),
+        _fmt_ratio(fleet.get("goodput_ratio")),
+    )
+    if fleet.get("degraded"):
+        breaker = fleet.get("breaker") or {}
+        fleet_line += "  DEGRADED(breaker={}, queued_writes={})".format(
+            breaker.get("state", "open"),
+            fleet.get("status_writes_queued", 0),
         )
-    ]
+    if fleet.get("remedy_tokens") is not None:
+        fleet_line += f"  remedy_tokens={fleet['remedy_tokens']:.1f}"
+    lines = [fleet_line]
     headers = [
-        "NAME", "NAMESPACE", "STATUS", "RUNS", "AVAIL",
-        "P50", "P95", "P99", "BUDGET", "BURN", "LAST TRACE",
+        "NAME", "NAMESPACE", "STATUS", "STATE", "RUNS", "AVAIL",
+        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST TRACE",
     ]
     rows = []
     for check in payload.get("checks") or []:
         window = check.get("window") or {}
         slo = check.get("slo")
+        remedy_budget = check.get("remedy_budget_remaining")
         rows.append(
             [
                 check.get("healthcheck", ""),
                 check.get("namespace", ""),
                 check.get("last_status", "") or "-",
+                check.get("state", "") or "healthy",
                 str(window.get("results", 0)),
                 _fmt_ratio(window.get("availability")),
                 _fmt_seconds(window.get("p50_seconds")),
@@ -593,6 +620,7 @@ def render_status_table(payload: dict) -> str:
                     if slo and slo.get("burn_rate") is not None
                     else "-"
                 ),
+                "-" if remedy_budget is None else str(remedy_budget),
                 (check.get("last_trace_id") or "-")[:16],
             ]
         )

@@ -217,6 +217,11 @@ class HealthCheckStatus(_Base):
     total_healthcheck_runs: int = Field(default=0, alias="totalHealthCheckRuns")
     status: str = ""
     remedy_status: str = Field(default="", alias="remedyStatus")
+    # resilience state machine (extension; resilience/health.py):
+    # "" (healthy), "Flapping", or "Quarantined". Quarantined is the
+    # explicit user-clearable mark — clear the field (set it to "") to
+    # resume a quarantined check's schedule.
+    state: str = ""
 
     def reset_remedy(self, reason: str) -> None:
         """Zero all remedy bookkeeping (reference: healthcheck_controller.go:649-660,695-703)."""

@@ -28,6 +28,11 @@ PLURAL = "healthchecks"
 
 
 class KubernetesHealthCheckClient:
+    # outcomes flow to the shared circuit breaker at the KubeApi
+    # transport (when wired there) — the reconciler must not record
+    # them a second time at its own call sites
+    shares_kube_transport = True
+
     def __init__(self, api: Optional[KubeApi] = None):
         self._api = api if api is not None else KubeApi.from_default_config()
 
@@ -100,9 +105,17 @@ class KubernetesHealthCheckClient:
         return HealthCheck.from_dict(created)
 
     async def update_status(self, hc: HealthCheck) -> HealthCheck:
+        # the FULL status, defaults and Nones included — the in-process
+        # model is authoritative (the reconciler read-modify-writes it),
+        # so every field must be stated explicitly. An exclude-defaults
+        # dump under a MERGE patch can never move a field BACK to its
+        # default: a cleared Quarantined `state`, a reset remedy's
+        # zeroed counters and nulled timestamps (RFC 7386: null deletes
+        # the key), an emptied errorMessage — all would silently stick
+        # at their last non-default value forever.
         body = {
             "metadata": {"resourceVersion": hc.metadata.resource_version or None},
-            "status": hc.status.to_json_dict(),
+            "status": hc.status.model_dump(by_alias=True, mode="json"),
         }
         try:
             updated = await self._api.merge_patch(

@@ -86,9 +86,14 @@ class Manager:
         metrics_auth_token: str = "",  # static bearer token; "" = open
         metrics_auth_token_file: str = "",  # re-read with a TTL (rotation)
         metrics_authorizer=None,  # KubeScrapeAuthorizer: TokenReview+SAR
+        remedy_rate: float = 0.0,  # fleet-wide remedies/min; 0 = no cap
     ):
         self.client = client
         self.reconciler = reconciler
+        # fleet-wide remedy storm control (--remedy-rate) lives in the
+        # reconciler's resilience coordinator; the manager only carries
+        # the flag to it
+        reconciler.resilience.configure_remedy_rate(remedy_rate)
         # failed-run requeues ride this manager's workqueue: per-key
         # serialized, stop-aware, re-rate-limited on crash — never a
         # loop inside a dying watch/timer task
@@ -315,6 +320,7 @@ class Manager:
         for i in range(self.max_parallel):
             self._tasks.append(asyncio.create_task(self._worker(i)))
         self._tasks.append(asyncio.create_task(self._goodput_loop()))
+        self._tasks.append(asyncio.create_task(self._resilience_loop()))
         # boot resync: reconcile everything that already exists
         for hc in await self.client.list():
             self.enqueue(hc.metadata.namespace, hc.metadata.name)
@@ -420,6 +426,23 @@ class Manager:
             except Exception:
                 log.exception("goodput rollup failed")
             await clock.sleep(interval)
+
+    async def _resilience_loop(self, interval: float = 5.0) -> None:
+        """Drive time-based resilience state even while traffic is
+        quiet: the breaker's open → half-open transition happens on
+        state reads, the degraded gauge must follow it, and status
+        writes queued during degraded mode need a replay driver that
+        doesn't depend on new runs finishing (docs/resilience.md)."""
+        clock = self.reconciler.clock
+        while True:
+            await clock.sleep(interval)
+            try:
+                self.reconciler.resilience.refresh()
+                await self.reconciler.replay_status_writes()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("resilience sweep failed")
 
     async def _leadership_watch(self, lost: asyncio.Event) -> None:
         await lost.wait()

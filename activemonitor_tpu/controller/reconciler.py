@@ -74,6 +74,13 @@ from activemonitor_tpu.metrics.collector import (
 )
 from activemonitor_tpu.obs.slo import FleetStatus
 from activemonitor_tpu.obs.trace import Tracer
+from activemonitor_tpu.resilience import (
+    BreakerOpenError,
+    ResilienceCoordinator,
+    STATE_FLAPPING,
+    STATE_HEALTHY,
+    STATE_QUARANTINED,
+)
 from activemonitor_tpu.scheduler import (
     CronParseError,
     InverseExpBackoff,
@@ -97,6 +104,7 @@ class HealthCheckReconciler:
         metrics: MetricsCollector,
         clock: Optional[Clock] = None,
         tracer: Optional[Tracer] = None,
+        resilience: Optional[ResilienceCoordinator] = None,
     ):
         self.client = client
         self.engine = engine
@@ -111,6 +119,12 @@ class HealthCheckReconciler:
         # the status-write path below and served by the manager's
         # /statusz endpoint. Same ownership shape as the tracer.
         self.fleet = FleetStatus(self.clock, metrics)
+        # degradation policy (docs/resilience.md): the shared circuit
+        # breaker, the per-check health state machine, the remedy rate
+        # cap, and the queued-status-write replay. Same ownership shape
+        # as the tracer; /statusz reads it through the fleet aggregate.
+        self.resilience = resilience or ResilienceCoordinator(self.clock, metrics)
+        self.fleet.resilience = self.resilience
         self.timers = TimerWheel(self.clock)
         self._watch_tasks: Dict[str, asyncio.Task] = {}
         # set by the Manager: routes failed-run requeues through its
@@ -138,6 +152,10 @@ class HealthCheckReconciler:
             # drop the check's result ring and SLO gauge series — the
             # fleet summary must not advertise a deleted check's budget
             self.fleet.forget(key, name, namespace)
+            # ... and its resilience state: tracker record, any queued
+            # status write, and the one-hot state metric series
+            self.resilience.forget(key)
+            self.metrics.clear_check_state(name, namespace)
             return None
         return await self._process_or_recover(hc)
 
@@ -154,7 +172,13 @@ class HealthCheckReconciler:
             log.exception(
                 "error processing healthcheck %s", hc.key
             )
-            return 1.0  # 1s requeue on process error (reference: :204)
+            # count the pre-terminal error toward quarantine; a newly
+            # (or already) quarantined check stops requeueing entirely
+            if await self._note_cycle_error(hc):
+                return None
+            # 1s requeue on process error (reference: :204) — stretched
+            # while the controller is degraded (docs/resilience.md)
+            return self.resilience.requeue_delay(1.0)
 
     # ------------------------------------------------------------------
     # decision logic (reference: processHealthCheck, :225-291)
@@ -163,6 +187,20 @@ class HealthCheckReconciler:
         spec = hc.spec
         if spec.workflow.resource is None:
             return None  # nothing to run (reference guards on Resource != nil, :227)
+
+        # a queued (not-yet-replayed) status write is FRESHER truth than
+        # the durable status: overlay it, or a stale finished_at would
+        # make this reconcile re-submit the very run the queued write
+        # records (the duplicate the chaos soak guards against)
+        queued = self.resilience.queued_status(hc.key)
+        if queued is not None:
+            hc.status = queued.model_copy(deep=True)
+
+        # quarantine gate (docs/resilience.md): a check whose cycles
+        # repeatedly die pre-terminal stops running until a user clears
+        # the durable .status.state mark
+        if await self._quarantine_gate(hc):
+            return None
 
         # pause (reference: :238-250)
         if spec.repeat_after_sec <= 0 and not spec.schedule.cron:
@@ -250,23 +288,210 @@ class HealthCheckReconciler:
         controller was down). One definition serves both the dedupe
         guard (remaining is not None ⇒ nothing owed yet) and the
         restart-resume timer (anchored at finished_at, so downtime
-        neither double-runs nor stretches the cadence)."""
+        neither double-runs nor stretches the cadence). A flapping
+        check's interval is damped by the tracker's factor HERE as well
+        as at reschedule time — judging "owed" against the raw cadence
+        would let any reconcile event defeat the damping."""
         if hc.status.finished_at is None:
             return None  # never ran: owed now
         now = self.clock.now()
+        damp = self.resilience.checks.damp_factor(hc.key)
+        elapsed = (now - hc.status.finished_at).total_seconds()
         if hc.spec.schedule.cron:
             try:
                 schedule = parse_cron(hc.spec.schedule.cron)
                 next_after_finish = schedule.next(hc.status.finished_at)
             except CronParseError:
                 return None  # unparseable: let the normal path complain
-            if next_after_finish <= now:
-                return None  # a fire passed since the last finish: owed
-            return max(1.0, (next_after_finish - now).total_seconds())
-        elapsed = (now - hc.status.finished_at).total_seconds()
-        if elapsed >= hc.spec.repeat_after_sec:
+            period = (
+                next_after_finish - hc.status.finished_at
+            ).total_seconds() * damp
+            if elapsed >= period:
+                return None  # a (damped) fire passed since the last finish: owed
+            return max(1.0, period - elapsed)
+        interval = hc.spec.repeat_after_sec * damp
+        if elapsed >= interval:
             return None  # interval elapsed: owed
-        return max(1.0, hc.spec.repeat_after_sec - elapsed)
+        return max(1.0, interval - elapsed)
+
+    # ------------------------------------------------------------------
+    # resilience: per-check state machine + degraded-mode plumbing
+    # (docs/resilience.md; no reference counterpart — the reference
+    # retries every failure identically at a fixed 1 s cadence)
+    # ------------------------------------------------------------------
+    def _sync_state_metric(self, hc: HealthCheck) -> None:
+        self.metrics.set_check_state(
+            hc.metadata.name,
+            hc.metadata.namespace,
+            self.resilience.checks.state(hc.key),
+        )
+
+    async def _quarantine_gate(self, hc: HealthCheck) -> bool:
+        """True when the check is quarantined and must not run.
+        Reconciles the in-memory tracker with the durable
+        ``.status.state`` mark: adopts a mark written by a previous
+        controller incarnation, retries a mark whose write failed at
+        transition time, and — the user contract — lifts the quarantine
+        when the durable field we know we wrote comes back cleared."""
+        key = hc.key
+        tracker = self.resilience.checks
+        durable = hc.status.state == STATE_QUARANTINED
+        tracked = tracker.state(key) == STATE_QUARANTINED
+        if durable and not tracked:
+            # durable mark from a previous incarnation: adopt it (the
+            # restart-resume analogue of divergence 10, for quarantine)
+            log.info("adopting durable quarantine mark for %s", key)
+            tracker.quarantine(key)
+            self._sync_state_metric(hc)
+            return True
+        if durable and tracked:
+            tracker.mark_persisted(key)
+            return True
+        if not durable and tracked:
+            if tracker.persisted(key):
+                # we know the mark was written (or queued — the status
+                # overlay in _process keeps a queued mark visible), so
+                # an empty field now means a USER cleared it: resume
+                log.info("quarantine for %s cleared by user; resuming", key)
+                tracker.clear(key)
+                self._sync_state_metric(hc)
+                self.recorder.event(
+                    hc,
+                    EVENT_NORMAL,
+                    "Normal",
+                    "Quarantine cleared; resuming the check's schedule",
+                )
+                return False
+            # the transition-time write never landed: retry it now
+            hc.status.state = STATE_QUARANTINED
+            try:
+                await self._update_status(hc)
+                tracker.mark_persisted(key)
+            except NotFoundError:
+                pass  # deleted meanwhile; the deleted path cleans up
+            except Exception:
+                log.exception(
+                    "failed to persist quarantine mark for %s; will retry",
+                    key,
+                )
+            return True
+        return False
+
+    async def _note_cycle_error(self, hc: HealthCheck) -> bool:
+        """Count one pre-terminal cycle error (parse/submit/process/
+        watch crash) toward quarantine. Returns True when the check is
+        quarantined and its schedule must stop. Errors during degraded
+        mode are the FLEET's problem, not the check's — they never
+        count, or an apiserver outage would quarantine innocents."""
+        if self.resilience.degraded:
+            return False
+        tracker = self.resilience.checks
+        transition = tracker.note_preterminal_error(hc.key)
+        if transition is None:
+            # either below the threshold (keep requeueing) or already
+            # quarantined (a straggler error — stay stopped)
+            return tracker.state(hc.key) == STATE_QUARANTINED
+        key = hc.key
+        log.warning(
+            "quarantining %s after %d consecutive pre-terminal errors; "
+            "clear .status.state to resume",
+            key,
+            tracker.quarantine_after,
+        )
+        # the consumed timer must not refire a check we just parked
+        self.timers.stop(key)
+        self.recorder.event(
+            hc,
+            EVENT_WARNING,
+            "Warning",
+            "HealthCheck quarantined after repeated pre-terminal errors; "
+            "clear .status.state to resume",
+        )
+        self._sync_state_metric(hc)
+        hc.status.state = STATE_QUARANTINED
+        hc.status.error_message = (
+            "quarantined: the check's workflow repeatedly errored before "
+            "reaching a verdict; clear .status.state to resume"
+        )
+        try:
+            await self._update_status(hc)
+            tracker.mark_persisted(key)
+        except NotFoundError:
+            pass  # deleted meanwhile
+        except Exception:
+            # likely the same outage that caused the errors — the
+            # _quarantine_gate retries the mark on the next reconcile
+            log.exception("failed to persist quarantine mark for %s", key)
+        return True
+
+    def _note_verdict(self, hc: HealthCheck, ok: bool) -> None:
+        """Feed a terminal verdict to the flap state machine and keep
+        the durable ``.status.state`` mark in sync — it rides the same
+        status write that records the verdict."""
+        tracker = self.resilience.checks
+        transition = tracker.note_verdict(hc.key, ok)
+        state = tracker.state(hc.key)
+        if state != STATE_QUARANTINED:
+            hc.status.state = "" if state == STATE_HEALTHY else state
+        if transition is not None:
+            _old, new = transition
+            if new == STATE_FLAPPING:
+                log.warning(
+                    "%s is flapping (verdict keeps flipping); damping its "
+                    "schedule by %.1fx",
+                    hc.key,
+                    tracker.damp_factor(hc.key),
+                )
+                self.recorder.event(
+                    hc,
+                    EVENT_WARNING,
+                    "Warning",
+                    "HealthCheck verdict is flapping; schedule damped until "
+                    "it stabilizes",
+                )
+            else:
+                log.info("%s verdict stabilized; schedule restored", hc.key)
+                self.recorder.event(
+                    hc,
+                    EVENT_NORMAL,
+                    "Normal",
+                    "HealthCheck verdict stabilized; schedule restored",
+                )
+        self._sync_state_metric(hc)
+
+    async def replay_status_writes(self) -> int:
+        """Drain status writes queued while the breaker was open —
+        oldest first, stopping at the first failure (or if the breaker
+        re-opens mid-drain). Called by the manager's resilience sweep
+        and opportunistically after any successful live write."""
+        res = self.resilience
+        replayed = 0
+        while res.pending_status_writes():
+            if not res.breaker.allow():
+                break
+            item = res.next_status_write()
+            if item is None:
+                break
+            key, queued = item
+            try:
+                await self._write_status_now(queued)
+            except NotFoundError:
+                log.info("dropping queued status write for deleted %s", key)
+                continue
+            except asyncio.CancelledError:
+                res.requeue_status_write(key, queued)
+                raise
+            except Exception:
+                res.requeue_status_write(key, queued)
+                log.warning(
+                    "replay of queued status write for %s failed; will retry",
+                    key,
+                    exc_info=True,
+                )
+                break
+            replayed += 1
+            log.info("replayed queued status write for %s", key)
+        return replayed
 
     # ------------------------------------------------------------------
     # submit (reference: createSubmitWorkflow, :502-534)
@@ -292,6 +517,31 @@ class HealthCheckReconciler:
         """Label value for the engine submit/poll counters."""
         return getattr(self.engine, "name", type(self.engine).__name__)
 
+    @property
+    def _records_engine_outcomes(self) -> bool:
+        """Engines built on the KubeApi transport (Argo) feed the shared
+        breaker there; for everything else (local/fake) the reconciler's
+        own call sites are the breaker's only signal source."""
+        return not getattr(self.engine, "shares_kube_transport", False)
+
+    async def _engine_submit(self, manifest: dict) -> str:
+        """engine.submit behind the shared breaker: rejected fast while
+        open, outcome recorded for transport-less engines."""
+        breaker = self.resilience.breaker
+        if not breaker.allow():
+            raise BreakerOpenError(breaker.name, breaker.retry_after())
+        try:
+            wf_name = await self.engine.submit(manifest)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if self._records_engine_outcomes:
+                breaker.observe(e)
+            raise
+        if self._records_engine_outcomes:
+            breaker.observe(None)
+        return wf_name
+
     async def _submit_workflow(self, hc: HealthCheck) -> str:
         try:
             with self.tracer.span("parse", healthcheck=hc.key):
@@ -306,8 +556,11 @@ class HealthCheckReconciler:
         with self.tracer.span(
             "submit", healthcheck=hc.key, engine=self._engine_name
         ):
-            wf_name = await self.engine.submit(manifest)
+            wf_name = await self._engine_submit(manifest)
         self.metrics.record_engine_submit(self._engine_name)
+        # a clean submission breaks the pre-terminal error streak even
+        # if the run later fails its verdict
+        self.resilience.checks.note_submit_ok(hc.key)
         self.recorder.event(hc, EVENT_NORMAL, "Normal", "Successfully created workflow")
         return wf_name
 
@@ -381,6 +634,8 @@ class HealthCheckReconciler:
             self.recorder.event(
                 hc, EVENT_WARNING, "Warning", "Error executing Workflow"
             )
+            if await self._note_cycle_error(hc):
+                return  # quarantined: the schedule stops here
             await self._requeue_until_clean(hc)
 
     async def _requeue_until_clean(self, hc: HealthCheck) -> None:
@@ -409,7 +664,8 @@ class HealthCheckReconciler:
             self._requeue_loops.add(current)
         if self.requeue_hook is not None:
             try:
-                await self.clock.sleep(1.0)
+                # the reference's 1 s cadence, stretched while degraded
+                await self.clock.sleep(self.resilience.requeue_delay(1.0))
                 if not self._stopping:
                     self.requeue_hook(hc.metadata.namespace, hc.metadata.name)
             finally:
@@ -417,7 +673,7 @@ class HealthCheckReconciler:
                     self._requeue_loops.discard(current)
             return
         try:
-            delay: Optional[float] = 1.0
+            delay: Optional[float] = self.resilience.requeue_delay(1.0)
             while delay and not self._stopping:
                 await self.clock.sleep(delay)
                 if self._stopping:
@@ -430,7 +686,7 @@ class HealthCheckReconciler:
                     raise
                 except Exception:
                     log.exception("requeued reconcile of %s failed", hc.key)
-                    delay = 1.0
+                    delay = self.resilience.requeue_delay(1.0)
         finally:
             if current is not None:
                 self._requeue_loops.discard(current)
@@ -479,17 +735,27 @@ class HealthCheckReconciler:
         the caller should ``continue`` its loop (workflow is None then).
         """
         self.metrics.record_engine_poll(self._engine_name)
+        breaker = self.resilience.breaker
         try:
+            # the shared breaker gates polls too: while it is open no
+            # read is attempted (BreakerOpenError duck-types as a
+            # transient 503 below, so the loop retries in place at the
+            # degraded cadence instead of hammering a sick backend)
+            if not breaker.allow():
+                raise BreakerOpenError(breaker.name, breaker.retry_after())
             if timed_out:
                 # the deadline verdict must come from the API server,
                 # not a possibly-lagging watch cache: a terminal phase
                 # that landed during a watch reconnect gap must win
                 getter = getattr(self.engine, "get_fresh", self.engine.get)
-                return await getter(wf_namespace, wf_name), timed_out, False
-            return await self.engine.get(wf_namespace, wf_name), timed_out, False
+                workflow = await getter(wf_namespace, wf_name)
+            else:
+                workflow = await self.engine.get(wf_namespace, wf_name)
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            if self._records_engine_outcomes:
+                breaker.observe(e)
             transient = is_transient(e)
             log.warning(
                 "%s error polling %s %s/%s%s",
@@ -506,10 +772,14 @@ class HealthCheckReconciler:
             )
             if timed_out and not (transient and storm_rides_past_deadline):
                 return {}, timed_out, False  # caller synthesizes Failed
-            await self.clock.sleep(1.0)
+            # the reference's 1 s error cadence, stretched while degraded
+            await self.clock.sleep(self.resilience.requeue_delay(1.0))
             if ieb.expired():
                 timed_out = True
             return None, timed_out, True
+        if self._records_engine_outcomes:
+            breaker.observe(None)
+        return workflow, timed_out, False
 
     # ------------------------------------------------------------------
     # watch + status + reschedule (reference: watchWorkflowReschedule, :607-757)
@@ -593,6 +863,9 @@ class HealthCheckReconciler:
                         latency=(now - then).total_seconds(),
                         workflow=wf_name,
                     )
+                    # the verdict drives the flap state machine; the
+                    # durable .status.state mark rides this same write
+                    self._note_verdict(hc, ok=True)
                     if not hc.spec.remedy_workflow.is_empty() and hc.status.remedy_total_runs >= 1:
                         hc.status.reset_remedy("HealthCheck Passed so Remedy is reset")
                         self.recorder.event(
@@ -627,6 +900,7 @@ class HealthCheckReconciler:
                         latency=(now - then).total_seconds(),
                         workflow=wf_name,
                     )
+                    self._note_verdict(hc, ok=False)
                     run_remedy = True
                     break
 
@@ -666,15 +940,22 @@ class HealthCheckReconciler:
                 )
 
     def _effective_repeat_after(self, hc: HealthCheck) -> int:
-        """Divergence 2: recompute the interval at reschedule time."""
+        """Divergence 2: recompute the interval at reschedule time —
+        damped by the flap tracker's factor, so a flapping check burns
+        budget and apiserver capacity at a fraction of its cadence
+        until its verdict stabilizes."""
+        damp = self.resilience.checks.damp_factor(hc.key)
         if hc.spec.repeat_after_sec > 0 and not hc.spec.schedule.cron:
-            return hc.spec.repeat_after_sec
+            return int(hc.spec.repeat_after_sec * damp)
         if hc.spec.schedule.cron:
             try:
-                return seconds_until_next(hc.spec.schedule.cron, self.clock.now())
+                return int(
+                    seconds_until_next(hc.spec.schedule.cron, self.clock.now())
+                    * damp
+                )
             except CronParseError:
                 return 0
-        return hc.spec.repeat_after_sec
+        return int(hc.spec.repeat_after_sec * damp)
 
     def _resubmit_callback(self, prev_hc: HealthCheck):
         """Timer-fired resubmission (reference: createSubmitWorkflowHelper,
@@ -698,6 +979,17 @@ class HealthCheckReconciler:
 
             hc = await self.client.get(namespace, name)
             if hc is None:
+                return
+            # same freshest-truth overlay as _process: a status (or a
+            # quarantine mark) parked in the replay queue must win over
+            # the stale durable copy — without it the gate below would
+            # misread a queued Quarantined mark as a user clear
+            queued = self.resilience.queued_status(hc.key)
+            if queued is not None:
+                hc.status = queued.model_copy(deep=True)
+            # a check quarantined since the timer was armed must not
+            # refire (the gate also adopts/clears the durable mark)
+            if await self._quarantine_gate(hc):
                 return
             # the spec may have changed since this timer was armed: if
             # nothing is owed under the CURRENT spec (cadence slowed, or
@@ -743,7 +1035,10 @@ class HealthCheckReconciler:
                     # the check's schedule FOREVER (the chaos-soak tier
                     # caught exactly this: a 500 on the timer-fired resubmit
                     # left dead schedules — owed run, no timer, no watch).
-                    # Ride the same requeue ladder a failed watch uses.
+                    # Ride the same requeue ladder a failed watch uses —
+                    # unless the streak just quarantined the check.
+                    if await self._note_cycle_error(hc):
+                        return
                     await self._requeue_until_clean(hc)
                     return
                 # already registered in _watch_tasks at the top, so
@@ -763,7 +1058,7 @@ class HealthCheckReconciler:
             return
         if spec.remedy_runs_limit != 0 and spec.remedy_reset_interval != 0:
             if spec.remedy_runs_limit > hc.status.remedy_total_runs:
-                await self._process_remedy(hc)
+                await self._admit_remedy(hc)
             else:
                 # limit hit: wait out the reset interval, then reset and run
                 # (reference: :689-711)
@@ -786,10 +1081,35 @@ class HealthCheckReconciler:
                         "Normal",
                         "RemedyResetInterval elapsed so Remedy is reset",
                     )
-                    await self._process_remedy(hc)
+                    await self._admit_remedy(hc)
         else:
             # gates unset ⇒ always run (reference: :712-720)
-            await self._process_remedy(hc)
+            await self._admit_remedy(hc)
+
+    async def _admit_remedy(self, hc: HealthCheck) -> None:
+        """The fleet-wide remedy rate cap (docs/resilience.md), layered
+        ON TOP of the per-check gates above: one bad rollout failing
+        hundreds of checks at once must not launch hundreds of
+        self-healing workflows in the same minute. Suppressed runs are
+        evented and counted; the per-check gates are untouched, so the
+        next failure after refill runs the remedy normally."""
+        name, namespace = hc.metadata.name, hc.metadata.namespace
+        if not self.resilience.admit_remedy():
+            self.metrics.record_remedy_run(name, namespace, "suppressed")
+            log.warning(
+                "remedy for %s suppressed: fleet-wide remedy budget "
+                "(--remedy-rate) exhausted",
+                hc.key,
+            )
+            self.recorder.event(
+                hc,
+                EVENT_WARNING,
+                "Warning",
+                "Remedy suppressed by the fleet-wide remedy rate cap",
+            )
+            return
+        self.metrics.record_remedy_run(name, namespace, "admitted")
+        await self._process_remedy(hc)
 
     async def _process_remedy(self, hc: HealthCheck) -> None:
         with self.tracer.span("remedy", healthcheck=hc.key):
@@ -827,7 +1147,7 @@ class HealthCheckReconciler:
                 workflow_type="remedy",
                 engine=self._engine_name,
             ):
-                wf_name = await self.engine.submit(manifest)
+                wf_name = await self._engine_submit(manifest)
             self.metrics.record_engine_submit(self._engine_name)
             self.recorder.event(
                 hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
@@ -952,6 +1272,38 @@ class HealthCheckReconciler:
     # status writes (reference: updateHealthCheckStatus, :1445-1462)
     # ------------------------------------------------------------------
     async def _update_status(self, hc: HealthCheck) -> None:
+        res = self.resilience
+        if not res.breaker.allow():
+            # degraded mode: the write records a run that ALREADY
+            # happened — park it for replay instead of failing the
+            # cycle (the reschedule proceeds, so the cadence survives
+            # the outage and nothing double-submits meanwhile)
+            res.queue_status_write(hc)
+            return
+        try:
+            await self._write_status_now(hc)
+        except BreakerOpenError:
+            # the breaker tripped mid-ladder (these very failures fed
+            # it): same parking contract as above
+            res.queue_status_write(hc)
+            return
+        except Exception as e:
+            if is_transient(e) and not res.breaker.allow():
+                # the ladder exhausted on transients AND the breaker is
+                # now open (fed by those failures, possibly recorded
+                # only at ladder granularity): park instead of raising,
+                # or the requeue path would re-reconcile a stale status
+                # and double-submit the run this write records
+                res.queue_status_write(hc)
+                return
+            raise
+        if res.pending_status_writes():
+            # a live write just landed, so the path is back: drain the
+            # backlog opportunistically rather than waiting for the
+            # manager's next sweep
+            await self.replay_status_writes()
+
+    async def _write_status_now(self, hc: HealthCheck) -> None:
         async def attempt():
             fresh = await self.client.get(hc.metadata.namespace, hc.metadata.name)
             if fresh is None:
@@ -962,10 +1314,23 @@ class HealthCheckReconciler:
         async def write():
             return await retry_on_conflict(attempt)
 
-        # transient 5xx ride out IN PLACE: this write records a run
-        # that already happened, and losing it sends the requeue path
-        # back through a full reconcile that submits a DUPLICATE
-        # workflow for the same scheduled fire (the chaos-soak tier
-        # measured 26 submissions for 3 recorded runs without this)
-        updated = await retry_on_transient(write, clock=self.clock)
+        # client outcomes feed the shared breaker — at the KubeApi
+        # transport for cluster clients, here for everything else
+        record = not getattr(self.client, "shares_kube_transport", False)
+        try:
+            # transient 5xx ride out IN PLACE: this write records a run
+            # that already happened, and losing it sends the requeue path
+            # back through a full reconcile that submits a DUPLICATE
+            # workflow for the same scheduled fire (the chaos-soak tier
+            # measured 26 submissions for 3 recorded runs without this)
+            updated = await retry_on_transient(write, clock=self.clock)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if record:
+                res_breaker = self.resilience.breaker
+                res_breaker.observe(e)
+            raise
+        if record:
+            self.resilience.breaker.observe(None)
         hc.metadata.resource_version = updated.metadata.resource_version

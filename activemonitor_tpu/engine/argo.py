@@ -58,6 +58,7 @@ class _NamespaceWatch:
         self._cache: Dict[str, dict] = {}
         self._healthy = False
         self._task: Optional[asyncio.Task] = None
+        self._stopped = False
         self._on_health = on_health
         self._on_restart = on_restart
         self.changed = asyncio.Condition()
@@ -105,6 +106,8 @@ class _NamespaceWatch:
         return obj.get("metadata", {}).get("resourceVersion")
 
     def ensure_started(self) -> None:
+        if self._stopped:
+            return  # closed engines never resurrect their watches
         if self._task is None or self._task.done():
             if self._task is None:
                 # seed the gauge so a watch that is unhealthy from its
@@ -112,11 +115,20 @@ class _NamespaceWatch:
                 # the transition guard in _set_healthy would otherwise
                 # never emit for a startup-degraded watch
                 self._emit_health(self._healthy)
+            else:
+                # the task DIED (the retry loop never exits by design,
+                # so something escaped it or cancelled it from outside):
+                # whatever health state it left behind is stale, and the
+                # stream is being re-established from scratch — surface
+                # both before restarting
+                self._set_healthy(False)
+                self._emit_restart()
             self._task = asyncio.create_task(
                 self._run(), name=f"wfwatch:{self._namespace}"
             )
 
     async def stop(self) -> None:
+        self._stopped = True
         if self._task is not None and not self._task.done():
             self._task.cancel()
             try:
@@ -145,6 +157,25 @@ class _NamespaceWatch:
             self.changed.notify_all()
 
     async def _run(self) -> None:
+        try:
+            await self._run_loop()
+        finally:
+            # the loop only exits via cancellation (stop()) or a bug
+            # escaping the retry ladder; either way this task no longer
+            # feeds the cache, so the watch must not keep advertising
+            # its last health state — get() falls back to direct GETs
+            # and the gauge reads 0 instead of lying
+            self._set_healthy(False)
+            try:
+                await self._notify()  # wake wait_change off the dead watch
+            except (asyncio.CancelledError, Exception):
+                log.debug(
+                    "watch teardown notify for %s skipped",
+                    self._namespace,
+                    exc_info=True,
+                )
+
+    async def _run_loop(self) -> None:
         path = api_path(WF_GROUP, WF_VERSION, WF_PLURAL, self._namespace)
         resource_version = ""
         while True:
@@ -217,6 +248,10 @@ class _NamespaceWatch:
 
 class ArgoWorkflowEngine:
     name = "argo"  # engine label on submit/poll counters
+    # submit/poll outcomes reach the shared circuit breaker through the
+    # KubeApi transport (when wired there); the reconciler's engine
+    # wrapper must not double-record them
+    shares_kube_transport = True
 
     def __init__(
         self,

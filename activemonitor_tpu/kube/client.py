@@ -23,6 +23,22 @@ log = logging.getLogger("activemonitor.kube")
 # writes; the API server also accepts it for ordinary updates
 MERGE_PATCH = "application/merge-patch+json"
 
+# verbs the circuit breaker gates (resilience/breaker.py): writes are
+# what a sick apiserver must be protected from; reads stay open so
+# recovery remains observable and watch streams keep reconnecting
+MUTATING_METHODS = frozenset({"POST", "PUT", "PATCH", "DELETE"})
+
+
+def _breaker_exempt(path: str) -> bool:
+    """Leadership leases are the one write that must always be
+    ATTEMPTED: rejecting a renewal while the breaker is open would make
+    the controller abdicate leadership over an outage its lease timing
+    already handles — self-inflicted failover on top of degradation.
+    Matched on the coordination.k8s.io GROUP segment, not a bare
+    '/leases/' substring — a CR that happens to be named 'leases'
+    (…/healthchecks/leases/status) must not slip through the gate."""
+    return path.startswith("/apis/coordination.k8s.io/")
+
 
 def _json_default(obj):
     """Timestamps show up in status payloads as datetime objects; the
@@ -89,6 +105,17 @@ class KubeApi:
         self._session = None  # created lazily inside the running loop
         self._auth_lock = None  # serializes exec-plugin refreshes
         self._closed = False
+        # optional shared circuit breaker (resilience/): gates mutating
+        # verbs and records every request outcome. None (the default)
+        # keeps this client's behavior exactly as before.
+        self._breaker = None
+
+    def set_breaker(self, breaker) -> None:
+        """Attach the controller's shared circuit breaker to this
+        transport. Mutating verbs are rejected fast with
+        BreakerOpenError while it is open (leases exempt); every request
+        outcome — reads included — feeds its failure/success stream."""
+        self._breaker = breaker
 
     @classmethod
     def from_default_config(cls, kubeconfig: str | None = None) -> "KubeApi":
@@ -164,29 +191,50 @@ class KubeApi:
     ) -> dict:
         import aiohttp
 
+        breaker = self._breaker
+        if (
+            breaker is not None
+            and method.upper() in MUTATING_METHODS
+            and not _breaker_exempt(path)
+            and not breaker.allow()
+        ):
+            from activemonitor_tpu.resilience.breaker import BreakerOpenError
+
+            raise BreakerOpenError(breaker.name, breaker.retry_after())
         session = await self._ensure_session()
         data = None if body is None else json.dumps(body, default=_json_default).encode()
-        async with session.request(
-            method,
-            self._url(path),
-            data=data,
-            params=params,
-            headers=await self._headers(content_type),
-            timeout=aiohttp.ClientTimeout(total=timeout),
-        ) as resp:
-            text = await resp.text()
-            payload: Any = None
-            if text:
-                try:
-                    payload = json.loads(text)
-                except json.JSONDecodeError:
-                    payload = text
-            if resp.status >= 400:
-                reason = ""
-                if isinstance(payload, dict):
-                    reason = payload.get("message") or payload.get("reason") or ""
-                raise ApiError(resp.status, reason or text[:200], payload)
-            return payload if isinstance(payload, dict) else {}
+        try:
+            async with session.request(
+                method,
+                self._url(path),
+                data=data,
+                params=params,
+                headers=await self._headers(content_type),
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                text = await resp.text()
+                payload: Any = None
+                if text:
+                    try:
+                        payload = json.loads(text)
+                    except json.JSONDecodeError:
+                        payload = text
+                if resp.status >= 400:
+                    reason = ""
+                    if isinstance(payload, dict):
+                        reason = payload.get("message") or payload.get("reason") or ""
+                    raise ApiError(resp.status, reason or text[:200], payload)
+        except Exception as e:
+            # every outcome feeds the breaker: transient statuses and
+            # connection-level failures count toward tripping it, a
+            # deterministic 4xx proves liveness and resets the streak
+            # (classification lives in resilience/breaker.py)
+            if breaker is not None:
+                breaker.observe(e)
+            raise
+        if breaker is not None:
+            breaker.observe(None)
+        return payload if isinstance(payload, dict) else {}
 
     # -- verbs ----------------------------------------------------------
     async def get(self, path: str, params: Optional[dict] = None) -> dict:

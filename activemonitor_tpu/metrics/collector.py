@@ -211,6 +211,37 @@ class MetricsCollector:
             "2x their interval",
             registry=self.registry,
         )
+        # -- resilience families (resilience/ is the single writer;
+        # docs/resilience.md). Degraded mode is the one bit a fleet
+        # alert pages on: the controller is alive but failing soft —
+        # breaker open, cadence stretched, status writes queued.
+        self.controller_degraded = Gauge(
+            "healthcheck_controller_degraded",
+            "1 while the controller runs in degraded mode (the shared "
+            "circuit breaker is open or probing); 0 while healthy",
+            registry=self.registry,
+        )
+        self.status_write_queue_depth = Gauge(
+            "healthcheck_status_write_queue_depth",
+            "Status writes parked for replay while degraded",
+            registry=self.registry,
+        )
+        # per-check containment state as kube-state-metrics-style
+        # one-hot series: exactly one of the three state labels reads 1
+        self.check_state = Gauge(
+            "healthcheck_check_state",
+            "Per-check resilience state (healthy/flapping/quarantined); "
+            "1 on the current state's series, 0 on the others",
+            [LABEL_HC, "namespace", "state"],
+            registry=self.registry,
+        )
+        self.remedy_runs = Counter(
+            "healthcheck_remedy_runs_total",
+            "Remedy admission decisions per check: admitted runs and "
+            "runs suppressed by the fleet-wide --remedy-rate cap",
+            [LABEL_HC, "namespace", "result"],
+            registry=self.registry,
+        )
         # engine observability: is the per-namespace workflow watch
         # stream (divergence 11) healthy, or is the controller paying
         # direct-GET fallbacks? A sustained 0 here explains elevated
@@ -309,6 +340,9 @@ class MetricsCollector:
         # (e.g. check a-b emitting b-c and c both merge to a_b_c)
         self._custom_origin: Dict[tuple, str] = {}
         self._custom_lock = threading.Lock()
+        # (hc_name, namespace) pairs whose check_state trio has been
+        # materialized — see set_check_state's lazy-cardinality contract
+        self._state_series: set = set()
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -411,6 +445,47 @@ class MetricsCollector:
 
     def set_fleet_goodput(self, ratio: float) -> None:
         self.fleet_goodput.set(ratio)
+
+    # -- resilience families (written by resilience/) ------------------
+    def set_degraded(self, degraded: bool) -> None:
+        self.controller_degraded.set(1.0 if degraded else 0.0)
+
+    def set_status_write_queue_depth(self, depth: int) -> None:
+        self.status_write_queue_depth.set(depth)
+
+    def set_check_state(self, hc_name: str, namespace: str, state: str) -> None:
+        """One-hot the check's state series: the current state reads 1,
+        the other known states read 0 (so alerts can sum() cleanly).
+        LAZY by design: a check that has never left healthy carries no
+        state series at all — three series per healthy check would
+        dominate the fleet's cardinality budget (the soak tier pins
+        ~24 series/check) for zero signal; absence means healthy. Once
+        a check has degraded, the full trio persists so the recovery
+        transition is visible."""
+        key = (hc_name, namespace)
+        if state == "Healthy" and key not in self._state_series:
+            return
+        self._state_series.add(key)
+        from activemonitor_tpu.resilience.health import CHECK_STATES
+
+        for known in CHECK_STATES:
+            self.check_state.labels(hc_name, namespace, known.lower()).set(
+                1.0 if known == state else 0.0
+            )
+
+    def clear_check_state(self, hc_name: str, namespace: str) -> None:
+        """Deleted check: drop its state series."""
+        from activemonitor_tpu.resilience.health import CHECK_STATES
+
+        self._state_series.discard((hc_name, namespace))
+        for known in CHECK_STATES:
+            try:
+                self.check_state.remove(hc_name, namespace, known.lower())
+            except KeyError:
+                pass  # never recorded — nothing to drop
+
+    def record_remedy_run(self, hc_name: str, namespace: str, result: str) -> None:
+        self.remedy_runs.labels(hc_name, namespace, result).inc()
 
     # -- dynamic custom metrics ---------------------------------------
     def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:

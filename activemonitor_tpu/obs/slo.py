@@ -196,6 +196,11 @@ class FleetStatus:
         self.metrics = metrics
         self._configs: Dict[str, Optional[SLOConfig]] = {}
         self._last_status: Dict[str, str] = {}
+        # wired by the reconciler (resilience/coordinator.py): the
+        # degraded/breaker/remedy-budget state that /statusz and the
+        # status CLI report next to the SLO numbers. None (standalone
+        # FleetStatus, e.g. unit tests) reports a healthy controller.
+        self.resilience = None
 
     # -- recording (reconciler status-write path) ----------------------
     def record(self, hc, *, ok: bool, latency: float, workflow: str) -> None:
@@ -270,10 +275,32 @@ class FleetStatus:
         )
         windowed = window_results(results, now, display_window)
         last = self.history.last(key)
+        # resilience state: the durable .status.state mark wins (it
+        # survives restarts); the in-process tracker covers the window
+        # before a transition's write lands. Reported lowercase —
+        # "healthy" / "flapping" / "quarantined" — like the metric label.
+        durable_state = getattr(hc.status, "state", "")
+        tracked_state = (
+            self.resilience.checks.state(key)
+            if self.resilience is not None
+            else ""
+        )
+        state = (durable_state or tracked_state or "Healthy").lower()
+        # per-check remedy budget: runs left under remedyRunsLimit, or
+        # None when the check has no remedy / no limit configured
+        spec = hc.spec
+        if spec.remedy_workflow.is_empty() or spec.remedy_runs_limit <= 0:
+            remedy_budget = None
+        else:
+            remedy_budget = max(
+                0, spec.remedy_runs_limit - hc.status.remedy_total_runs
+            )
         summary = {
             "key": key,
             "healthcheck": hc.metadata.name,
             "namespace": hc.metadata.namespace,
+            "state": state,
+            "remedy_budget_remaining": remedy_budget,
             "last_status": hc.status.status
             or self._last_status.get(key, ""),
             "last_trace_id": last.trace_id if last else "",
@@ -303,12 +330,28 @@ class FleetStatus:
         # same number whenever anyone looks
         ratio = self.refresh_fleet_goodput()
         window_runs = sum(e["window"]["results"] for e in entries)
+        if self.resilience is not None:
+            resilience = self.resilience.snapshot()
+        else:
+            resilience = {
+                "degraded": False,
+                "breaker": None,
+                "status_writes_queued": 0,
+                "remedy_tokens": None,
+            }
         return {
             "fleet": {
                 "checks": len(entries),
                 "window_runs": window_runs,
                 "goodput_ratio": ratio,
                 "generated_at": now.isoformat(),
+                # degraded-mode telemetry (docs/resilience.md): the
+                # breaker's verdict, the replay backlog, and the
+                # fleet-wide remedy budget
+                "degraded": resilience["degraded"],
+                "breaker": resilience["breaker"],
+                "status_writes_queued": resilience["status_writes_queued"],
+                "remedy_tokens": resilience["remedy_tokens"],
             },
             "checks": entries,
         }

@@ -1,0 +1,49 @@
+"""Degradation-aware resilience layer (docs/resilience.md).
+
+Three containment mechanisms behind one coordinator:
+
+- :mod:`breaker` — the shared circuit breaker (closed/open/half-open)
+  around the kube transport's mutating calls and the engines'
+  submit/poll paths; open ⇒ the controller runs in *degraded mode*.
+- :mod:`health` — the per-check state machine
+  (healthy → flapping → quarantined) driven off terminal verdicts and
+  pre-terminal errors.
+- :mod:`storm` — the fleet-wide remedy token bucket (``--remedy-rate``).
+
+Everything in this package takes an injectable clock; ``time.time()``
+is banned here by the repo linter (hack/lint.py: wall-clock-in-resilience).
+"""
+
+from activemonitor_tpu.resilience.breaker import (
+    BreakerOpenError,
+    CircuitBreaker,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    is_transient_error,
+)
+from activemonitor_tpu.resilience.coordinator import ResilienceCoordinator
+from activemonitor_tpu.resilience.health import (
+    CHECK_STATES,
+    CheckStateTracker,
+    STATE_FLAPPING,
+    STATE_HEALTHY,
+    STATE_QUARANTINED,
+)
+from activemonitor_tpu.resilience.storm import TokenBucket
+
+__all__ = [
+    "BreakerOpenError",
+    "CHECK_STATES",
+    "CheckStateTracker",
+    "CircuitBreaker",
+    "ResilienceCoordinator",
+    "STATE_CLOSED",
+    "STATE_FLAPPING",
+    "STATE_HALF_OPEN",
+    "STATE_HEALTHY",
+    "STATE_OPEN",
+    "STATE_QUARANTINED",
+    "TokenBucket",
+    "is_transient_error",
+]

@@ -1,0 +1,231 @@
+"""Shared circuit breaker — closed / open / half-open, injectable clock.
+
+The controller's retry ladders (SURVEY.md §5.3: 1 s requeue,
+RetryOnConflict, retry-in-place transients) make every individual
+operation durable, but they are *local*: during a real apiserver or
+Argo-path outage each check's ladder keeps hammering the same dead
+endpoint at full cadence. The breaker is the *global* complement — it
+watches the stream of transient outcomes crossing the process boundary
+and, once failures run consecutive past a threshold, fails the
+controller FAST into degraded mode (docs/resilience.md) instead of
+letting a hundred ladders grind against a 500 storm.
+
+State machine (the classic Nygard shape):
+
+- **closed**: all traffic flows; ``failure_threshold`` transient
+  failures within ``failure_window`` seconds trip to open. Rate-window
+  counting, deliberately NOT consecutive: a status-write storm
+  interleaves failing PATCHes with healthy GETs (every conflict-retried
+  write re-reads first), so a consecutive counter would never trip on
+  the exact outage this breaker exists for. Successes while closed
+  therefore do not erase recent failures; only time does.
+- **open**: mutating traffic is rejected instantly with
+  :class:`BreakerOpenError` until ``recovery_seconds`` elapse on the
+  injected clock. Outcomes recorded while open (stragglers from
+  in-flight calls, ungated reads) change nothing.
+- **half-open**: traffic flows again; the first success closes the
+  breaker, the first transient failure re-opens it for another full
+  recovery window. Deliberately no probe budget: every admitted call IS
+  a probe, in-flight work is naturally bounded, and a budget counter
+  that callers could leak (allow() without a recorded outcome) is a
+  stuck-open bug waiting to happen.
+
+Only *transient* outcomes count (5xx/429, connection errors, timeouts).
+A 4xx proves the server is alive and answering — it resets the streak
+rather than feeding it, so a single misconfigured check can never trip
+the fleet into degraded mode.
+
+Clock discipline: every deadline reads ``clock.monotonic()`` — never the
+wall clock (hack/lint.py bans ``time.time()`` in this package) — so
+fake-clock tests script the open window exactly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+from typing import Callable, Optional
+
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.resilience")
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half-open"
+
+DEFAULT_FAILURE_THRESHOLD = 5
+DEFAULT_RECOVERY_SECONDS = 30.0
+
+
+class BreakerOpenError(Exception):
+    """Raised instead of attempting a call while the breaker is open.
+
+    Carries ``status = 503`` so the reconciler's duck-typed transient
+    classification (controller.client.is_transient) treats a rejected
+    call exactly like a server-side 503: retry later, never a
+    deterministic give-up. The breaker itself never counts this error
+    as a failure — no call happened.
+    """
+
+    status = 503  # duck-typed transient for is_transient()
+
+    def __init__(self, name: str, retry_after: float):
+        super().__init__(
+            f"circuit breaker {name!r} is open; retry in {retry_after:.1f}s"
+        )
+        self.breaker_name = name
+        self.retry_after = retry_after
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """Transient = worth counting toward tripping the breaker: a
+    server-side throttle/5xx status, a connection-level failure, or a
+    timeout. BreakerOpenError is explicitly NOT transient here — the
+    breaker must never feed on its own rejections."""
+    if isinstance(exc, BreakerOpenError):
+        return False
+    status = getattr(exc, "status", None)
+    if status is not None:
+        # one source of truth for the retryable status set (imported
+        # lazily: controller.client is higher in the layer stack)
+        from activemonitor_tpu.controller.client import TRANSIENT_STATUSES
+
+        return status in TRANSIENT_STATUSES
+    return isinstance(exc, (OSError, asyncio.TimeoutError))
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str = "api",
+        clock: Optional[Clock] = None,
+        failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+        failure_window: Optional[float] = None,
+        recovery_seconds: float = DEFAULT_RECOVERY_SECONDS,
+        on_transition: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.name = name
+        self.clock = clock or Clock()
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_seconds = max(0.0, recovery_seconds)
+        # rate window for tripping: threshold failures inside this many
+        # seconds open the circuit (default: the recovery window, but
+        # never so tight that slow retry ladders can't accumulate)
+        self.failure_window = (
+            failure_window
+            if failure_window is not None
+            else max(self.recovery_seconds, 10.0)
+        )
+        self._on_transition = on_transition
+        self._state = STATE_CLOSED
+        # monotonic timestamps of the last `threshold` transient failures
+        self._failures: collections.deque = collections.deque(
+            maxlen=self.failure_threshold
+        )
+        self._opened_at = 0.0
+        self._trip_count = 0  # lifetime opens, surfaced in snapshot()
+
+    # -- state ----------------------------------------------------------
+    def _transition(self, new_state: str) -> None:
+        old = self._state
+        if old == new_state:
+            return
+        self._state = new_state
+        log.log(
+            logging.WARNING if new_state == STATE_OPEN else logging.INFO,
+            "circuit breaker %r: %s -> %s",
+            self.name,
+            old,
+            new_state,
+        )
+        if self._on_transition is not None:
+            try:
+                self._on_transition(old, new_state)
+            except Exception:  # observability must never break the breaker
+                log.exception("breaker transition callback failed")
+
+    @property
+    def state(self) -> str:
+        """Current state; reading it performs the time-driven
+        open → half-open transition (no background task needed)."""
+        if (
+            self._state == STATE_OPEN
+            and self.clock.monotonic() >= self._opened_at + self.recovery_seconds
+        ):
+            self._transition(STATE_HALF_OPEN)
+        return self._state
+
+    def retry_after(self) -> float:
+        """Seconds until the open window elapses (0 when not open)."""
+        if self.state != STATE_OPEN:
+            return 0.0
+        return max(
+            0.0, self._opened_at + self.recovery_seconds - self.clock.monotonic()
+        )
+
+    def allow(self) -> bool:
+        """May a call be attempted right now? Open rejects; closed and
+        half-open admit (every half-open call is a recovery probe)."""
+        return self.state != STATE_OPEN
+
+    # -- outcomes -------------------------------------------------------
+    def record_success(self) -> None:
+        """A half-open success closes the circuit. A closed success
+        changes nothing — recent failures age out by TIME, not by
+        interleaved successes (see the rate-window rationale in the
+        module docstring) — and an open success is a straggler from an
+        in-flight call, ignored until the window elapses."""
+        if self.state == STATE_HALF_OPEN:
+            self._failures.clear()
+            self._transition(STATE_CLOSED)
+
+    def record_failure(self) -> None:
+        """One transient failure. Classification is the caller's job —
+        use :meth:`observe` to classify and record in one step."""
+        state = self.state
+        if state == STATE_HALF_OPEN:
+            # the recovery probe failed: a full new open window
+            self._trip()
+            return
+        if state == STATE_OPEN:
+            return  # stragglers while open change nothing
+        now = self.clock.monotonic()
+        self._failures.append(now)
+        if (
+            len(self._failures) == self.failure_threshold
+            and now - self._failures[0] <= self.failure_window
+        ):
+            self._trip()
+
+    def _trip(self) -> None:
+        self._opened_at = self.clock.monotonic()
+        self._failures.clear()
+        self._trip_count += 1
+        self._transition(STATE_OPEN)
+
+    def observe(self, exc: Optional[BaseException]) -> None:
+        """Record one finished call: ``None`` is a success; a transient
+        exception is a failure; a deterministic exception (4xx, a code
+        bug) proves the far side is answering and counts as a success
+        for circuit purposes. A :class:`BreakerOpenError` is NO outcome
+        at all — no call happened — and is ignored so the breaker can
+        neither feed on nor (worse) close itself off its own rejections."""
+        if isinstance(exc, BreakerOpenError):
+            return
+        if exc is None or not is_transient_error(exc):
+            self.record_success()
+        else:
+            self.record_failure()
+
+    # -- introspection --------------------------------------------------
+    def snapshot(self) -> dict:
+        """The /statusz view of this breaker."""
+        return {
+            "name": self.name,
+            "state": self.state,
+            "recent_failures": len(self._failures),
+            "retry_after_seconds": self.retry_after(),
+            "trips": self._trip_count,
+        }

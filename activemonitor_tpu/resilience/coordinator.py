@@ -1,0 +1,219 @@
+"""ResilienceCoordinator — the one object that owns degradation policy.
+
+The reconciler owns a coordinator the same way it owns the tracer and
+the fleet SLO aggregate. It bundles the three containment mechanisms of
+docs/resilience.md behind one façade so the reconciler, the manager and
+``/statusz`` can never disagree about whether the controller is
+degraded:
+
+- the shared :class:`~activemonitor_tpu.resilience.breaker.CircuitBreaker`
+  around the kube transport's mutating calls and the engines'
+  submit/poll paths;
+- the per-check :class:`~activemonitor_tpu.resilience.health.
+  CheckStateTracker` (healthy → flapping → quarantined);
+- the fleet-wide remedy :class:`~activemonitor_tpu.resilience.storm.
+  TokenBucket` (``--remedy-rate``).
+
+Degraded mode = the breaker is not closed. While degraded:
+
+- reconcile requeues stretch: each delay is drawn with FULL JITTER from
+  ``[0, time remaining in the breaker's open window]`` (floored at the
+  1 s base) — longest right after the trip, tightening to the base as
+  recovery nears, and spread so the fleet doesn't re-converge on the
+  apiserver in one synchronized wave. The envelope is computed from the
+  clock, deliberately NOT from a shared mutable backoff schedule: a
+  shared pacer advanced per call collapses to its floor after a handful
+  of draws once many checks are degraded at once;
+- status writes queue here for replay (latest status per check wins; the
+  queue is also the freshest-truth overlay the reconciler consults so a
+  stale durable status can't double-submit a run);
+- ``healthcheck_controller_degraded`` reads 1 and ``/statusz`` says so.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import random
+from typing import Optional, Tuple
+
+from activemonitor_tpu.resilience.breaker import STATE_CLOSED, CircuitBreaker
+from activemonitor_tpu.resilience.health import CheckStateTracker
+from activemonitor_tpu.resilience.storm import TokenBucket
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.resilience")
+
+# degraded-cadence floor: even right before recovery the controller
+# never requeues tighter than the reference's 1 s error cadence
+DEGRADED_MIN_DELAY = 1.0
+
+
+class ResilienceCoordinator:
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        metrics=None,
+        *,
+        breaker: Optional[CircuitBreaker] = None,
+        checks: Optional[CheckStateTracker] = None,
+        remedy_rate: float = 0.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.clock = clock or Clock()
+        self.metrics = metrics
+        self.breaker = breaker or CircuitBreaker("api", clock=self.clock)
+        # the coordinator funnels every transition (including those of an
+        # injected breaker) into the degraded gauge/pacer bookkeeping
+        self.breaker._on_transition = self._on_breaker_transition
+        self.checks = checks or CheckStateTracker()
+        self._rng = rng
+        self.remedy_bucket: Optional[TokenBucket] = None
+        self.configure_remedy_rate(remedy_rate)
+        # key -> queued HealthCheck (latest status wins); insertion order
+        # is replay order
+        self._status_queue: "collections.OrderedDict[str, object]" = (
+            collections.OrderedDict()
+        )
+        if self.metrics is not None:
+            self.metrics.set_degraded(False)
+            self.metrics.set_status_write_queue_depth(0)
+
+    # -- degraded mode --------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        """True while the breaker is open or probing (half-open): the
+        controller keeps reconciling but fails soft — stretched cadence,
+        queued status writes."""
+        return self.breaker.state != STATE_CLOSED
+
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        degraded = new != STATE_CLOSED
+        log.log(
+            logging.WARNING if degraded else logging.INFO,
+            "controller %s (breaker %r: %s -> %s)",
+            "DEGRADED" if degraded else "recovered",
+            self.breaker.name,
+            old,
+            new,
+        )
+        if self.metrics is not None:
+            self.metrics.set_degraded(degraded)
+
+    def refresh(self) -> None:
+        """Poll time-driven state (open → half-open happens on state
+        reads, which fire the transition callback) so the gauge moves
+        even when no traffic is flowing. Called from the manager's
+        resilience loop."""
+        degraded = self.degraded  # the read drives open -> half-open
+        if self.metrics is not None:
+            self.metrics.set_degraded(degraded)
+
+    def requeue_delay(self, base: float) -> float:
+        """The requeue/retry delay to use right now: ``base`` when
+        healthy; while degraded, a full-jitter draw from
+        ``[0, time remaining in the open window]``, floored at ``base``.
+        Time-based on purpose — the envelope is the breaker's own
+        ``retry_after()``, so concurrent callers each get an independent
+        draw and arrivals spread across the remainder of the outage (a
+        shared advancing backoff schedule would collapse to its floor
+        after a handful of fleet-wide calls). In half-open the envelope
+        is gone and retries tighten to ``base`` — fast recovery probing."""
+        if not self.degraded:
+            return base
+        envelope = max(DEGRADED_MIN_DELAY, self.breaker.retry_after())
+        uniform = self._rng.uniform if self._rng is not None else random.uniform
+        return max(base, uniform(0.0, envelope))
+
+    # -- status-write replay queue --------------------------------------
+    def queue_status_write(self, hc) -> None:
+        """Park a status write for replay once the breaker closes. The
+        latest status per check wins; replay order is FIFO by first
+        queueing."""
+        key = hc.key
+        queued = self._status_queue.get(key)
+        if queued is not None:
+            queued.status = hc.status.model_copy(deep=True)
+        else:
+            self._status_queue[key] = hc.deepcopy()
+        log.warning(
+            "status write for %s queued for replay (%d queued; breaker %s)",
+            key,
+            len(self._status_queue),
+            self.breaker.state,
+        )
+        self._sync_queue_gauge()
+
+    def queued_status(self, key: str):
+        """The freshest not-yet-persisted status for a check, or None.
+        The reconciler overlays this on the (stale) durable status so a
+        queued-but-unwritten run cannot be double-submitted."""
+        hc = self._status_queue.get(key)
+        return hc.status if hc is not None else None
+
+    def next_status_write(self) -> Optional[Tuple[str, object]]:
+        """Pop the oldest queued write for replay (None when empty).
+        Callers re-queue via :meth:`requeue_status_write` on failure."""
+        if not self._status_queue:
+            return None
+        key, hc = self._status_queue.popitem(last=False)
+        self._sync_queue_gauge()
+        return key, hc
+
+    def requeue_status_write(self, key: str, hc) -> None:
+        """A replay attempt failed: put the write back at the front
+        unless a fresher status was queued meanwhile."""
+        if key not in self._status_queue:
+            self._status_queue[key] = hc
+            self._status_queue.move_to_end(key, last=False)
+        self._sync_queue_gauge()
+
+    def drop_status_write(self, key: str) -> None:
+        """The check is gone: its queued write is moot."""
+        self._status_queue.pop(key, None)
+        self._sync_queue_gauge()
+
+    def pending_status_writes(self) -> int:
+        return len(self._status_queue)
+
+    def _sync_queue_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_status_write_queue_depth(len(self._status_queue))
+
+    # -- remedy storm control -------------------------------------------
+    def configure_remedy_rate(self, rate_per_minute: float) -> None:
+        """Install (or remove, with rate <= 0) the fleet-wide remedy
+        cap. Called once at manager construction from --remedy-rate."""
+        if rate_per_minute and rate_per_minute > 0:
+            self.remedy_bucket = TokenBucket(rate_per_minute, clock=self.clock)
+        else:
+            self.remedy_bucket = None
+
+    def admit_remedy(self) -> bool:
+        """Take a fleet-wide remedy token. Always True when no cap is
+        configured."""
+        if self.remedy_bucket is None:
+            return True
+        return self.remedy_bucket.try_take()
+
+    def remedy_tokens(self) -> Optional[float]:
+        """Tokens remaining (None when uncapped) — /statusz and the CLI."""
+        if self.remedy_bucket is None:
+            return None
+        return self.remedy_bucket.available()
+
+    # -- lifecycle ------------------------------------------------------
+    def forget(self, key: str) -> None:
+        """Deleted check: drop tracker state and any queued write."""
+        self.checks.forget(key)
+        self.drop_status_write(key)
+
+    # -- introspection --------------------------------------------------
+    def snapshot(self) -> dict:
+        """The /statusz ``fleet`` resilience block."""
+        return {
+            "degraded": self.degraded,
+            "breaker": self.breaker.snapshot(),
+            "status_writes_queued": len(self._status_queue),
+            "remedy_tokens": self.remedy_tokens(),
+        }

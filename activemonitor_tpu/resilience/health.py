@@ -1,0 +1,188 @@
+"""Per-check health state machine: healthy → flapping → quarantined.
+
+The SLO layer (obs/history, obs/slo) measures how a check is doing; this
+module DECIDES what the controller should do about it. Two independent
+failure shapes get two different containments (the Reframe framing from
+PAPERS.md: classify and contain faults, don't just count them):
+
+- **flapping** — the check reaches a verdict, but the verdict keeps
+  flipping. Every flip burns error budget AND apiserver/Argo capacity at
+  full cadence, while the signal content of each run approaches zero.
+  Containment: the schedule is *damped* (the effective interval is
+  multiplied by ``damp_factor``) until the verdict stays put for
+  ``calm_streak`` consecutive runs.
+- **quarantined** — the check never reaches a verdict: parse errors,
+  submit failures, crashes *pre-terminal*, ``quarantine_after`` times in
+  a row. Retrying a deterministically-broken check forever is pure
+  waste, so the schedule stops entirely and ``.status.state`` is set to
+  ``Quarantined`` — an explicit, durable, user-clearable mark (clear the
+  field to resume; docs/resilience.md walks through it).
+
+The tracker is pure bookkeeping — no clock, no I/O — so transitions are
+exactly reproducible from a scripted verdict sequence. The reconciler
+owns when to consult it and what each transition does (events, metrics,
+status writes, timer teardown).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Dict, Optional, Tuple
+
+# .status.state values (k8s-style CamelCase, like phase values).
+# Healthy is represented as "" in the durable status — absence of
+# trouble is not worth a field — but reported as "healthy" on /statusz.
+STATE_HEALTHY = "Healthy"
+STATE_FLAPPING = "Flapping"
+STATE_QUARANTINED = "Quarantined"
+
+CHECK_STATES = (STATE_HEALTHY, STATE_FLAPPING, STATE_QUARANTINED)
+
+DEFAULT_FLAP_WINDOW = 8  # verdicts considered for flip counting
+DEFAULT_FLAP_THRESHOLD = 3  # flips within the window => flapping
+DEFAULT_CALM_STREAK = 4  # equal verdicts in a row => healthy again
+DEFAULT_QUARANTINE_AFTER = 5  # consecutive pre-terminal errors
+DEFAULT_DAMP_FACTOR = 2.0  # interval multiplier while flapping
+
+
+class _CheckRecord:
+    __slots__ = ("verdicts", "error_streak", "state", "persisted")
+
+    def __init__(self, window: int):
+        self.verdicts: Deque[bool] = collections.deque(maxlen=window)
+        self.error_streak = 0
+        self.state = STATE_HEALTHY
+        # has the Quarantined mark reached durable .status.state? Until
+        # it has, an empty durable field means "not yet written", not
+        # "the user cleared it" — the reconciler's clear-detection
+        # hinges on this bit.
+        self.persisted = False
+
+
+class CheckStateTracker:
+    """Keyed by ``namespace/name`` like the timer wheel and result
+    rings. Transition-returning mutators let the caller event/metric
+    exactly once per edge."""
+
+    def __init__(
+        self,
+        flap_window: int = DEFAULT_FLAP_WINDOW,
+        flap_threshold: int = DEFAULT_FLAP_THRESHOLD,
+        calm_streak: int = DEFAULT_CALM_STREAK,
+        quarantine_after: int = DEFAULT_QUARANTINE_AFTER,
+        damp_factor: float = DEFAULT_DAMP_FACTOR,
+    ):
+        self.flap_window = max(2, flap_window)
+        self.flap_threshold = max(1, flap_threshold)
+        self.calm_streak = max(1, calm_streak)
+        self.quarantine_after = max(1, quarantine_after)
+        self._damp_factor = max(1.0, damp_factor)
+        self._records: Dict[str, _CheckRecord] = {}
+
+    def _record(self, key: str) -> _CheckRecord:
+        rec = self._records.get(key)
+        if rec is None:
+            rec = self._records[key] = _CheckRecord(self.flap_window)
+        return rec
+
+    # -- inputs ---------------------------------------------------------
+    def note_verdict(self, key: str, ok: bool) -> Optional[Tuple[str, str]]:
+        """One terminal verdict landed. Returns ``(old, new)`` on a
+        state transition, else None. A verdict also proves the submit
+        path works, so the pre-terminal error streak resets."""
+        rec = self._record(key)
+        rec.error_streak = 0
+        rec.verdicts.append(bool(ok))
+        if rec.state == STATE_QUARANTINED:
+            # a quarantined check does not run; a straggler verdict from
+            # an in-flight workflow must not resurrect it
+            return None
+        flips = sum(
+            1
+            for a, b in zip(rec.verdicts, list(rec.verdicts)[1:])
+            if a != b
+        )
+        if rec.state == STATE_HEALTHY and flips >= self.flap_threshold:
+            rec.state = STATE_FLAPPING
+            return (STATE_HEALTHY, STATE_FLAPPING)
+        if rec.state == STATE_FLAPPING:
+            tail = list(rec.verdicts)[-self.calm_streak:]
+            if len(tail) >= self.calm_streak and len(set(tail)) == 1:
+                rec.state = STATE_HEALTHY
+                # start the new healthy era with a clean window: the
+                # pre-calm flips still inside the ring would otherwise
+                # re-trip Flapping on the very next (identical) verdict
+                # — a damp/undamp oscillation on a stable check
+                rec.verdicts.clear()
+                return (STATE_FLAPPING, STATE_HEALTHY)
+        return None
+
+    def note_preterminal_error(self, key: str) -> Optional[Tuple[str, str]]:
+        """The cycle died before any verdict (parse/submit/process
+        error). Returns the transition into quarantine when the streak
+        crosses the threshold."""
+        rec = self._record(key)
+        if rec.state == STATE_QUARANTINED:
+            return None
+        rec.error_streak += 1
+        if rec.error_streak >= self.quarantine_after:
+            old = rec.state
+            rec.state = STATE_QUARANTINED
+            rec.persisted = False
+            return (old, STATE_QUARANTINED)
+        return None
+
+    def note_submit_ok(self, key: str) -> None:
+        """A workflow was submitted cleanly: the pre-terminal streak is
+        broken even if the run later fails its verdict."""
+        rec = self._records.get(key)
+        if rec is not None:
+            rec.error_streak = 0
+
+    # -- forced transitions ---------------------------------------------
+    def quarantine(self, key: str) -> None:
+        """Adopt a durable ``Quarantined`` mark found in status (e.g.
+        written by a previous controller incarnation)."""
+        rec = self._record(key)
+        rec.state = STATE_QUARANTINED
+        rec.persisted = True
+
+    def clear(self, key: str) -> None:
+        """User cleared the quarantine (or an operator reset): back to
+        healthy with all streaks zeroed."""
+        rec = self._record(key)
+        rec.state = STATE_HEALTHY
+        rec.error_streak = 0
+        rec.verdicts.clear()
+        rec.persisted = False
+
+    def mark_persisted(self, key: str) -> None:
+        rec = self._records.get(key)
+        if rec is not None:
+            rec.persisted = True
+
+    def persisted(self, key: str) -> bool:
+        rec = self._records.get(key)
+        return rec.persisted if rec is not None else False
+
+    # -- queries --------------------------------------------------------
+    def state(self, key: str) -> str:
+        rec = self._records.get(key)
+        return rec.state if rec is not None else STATE_HEALTHY
+
+    def damp_factor(self, key: str) -> float:
+        """Interval multiplier for the check's schedule: >1 while
+        flapping, 1.0 otherwise."""
+        return (
+            self._damp_factor
+            if self.state(key) == STATE_FLAPPING
+            else 1.0
+        )
+
+    def error_streak(self, key: str) -> int:
+        rec = self._records.get(key)
+        return rec.error_streak if rec is not None else 0
+
+    def forget(self, key: str) -> None:
+        """Deleted check: drop its record."""
+        self._records.pop(key, None)

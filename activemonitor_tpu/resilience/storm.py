@@ -1,0 +1,75 @@
+"""Remedy storm control — a fleet-wide token bucket.
+
+The per-check gates (``remedyRunsLimit`` / ``remedyResetInterval``,
+reference: healthcheck_controller.go:677-721) bound how often ONE check
+self-heals. They compose multiplicatively across a fleet: a bad rollout
+that fails 200 checks at once launches 200 remedy workflows in the same
+minute, each within its own per-check budget — a self-inflicted storm
+against the very cluster the remedies are supposed to heal. The token
+bucket is the fleet-wide cap layered on top (``--remedy-rate``): tokens
+refill continuously at ``rate_per_minute``; every admitted remedy takes
+one; when the bucket is dry the remedy is *suppressed* — evented and
+counted under ``healthcheck_remedy_runs_total{result="suppressed"}`` —
+and the next failure after refill runs it.
+
+Refill is computed lazily from the injected clock's monotonic time (no
+background task, no wall clock — hack/lint.py bans ``time.time()`` in
+this package), so fake-clock tests script exhaustion and refill exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from activemonitor_tpu.utils.clock import Clock
+
+
+class TokenBucket:
+    """Continuous-refill token bucket on an injectable monotonic clock.
+
+    ``rate_per_minute`` tokens accrue per minute up to ``burst``
+    (default: ``max(1, rate_per_minute)``, so a configured cap always
+    admits at least one remedy immediately after a quiet period).
+    """
+
+    def __init__(
+        self,
+        rate_per_minute: float,
+        burst: Optional[float] = None,
+        clock: Optional[Clock] = None,
+    ):
+        if rate_per_minute <= 0:
+            raise ValueError("rate_per_minute must be > 0 (omit the bucket for 'no cap')")
+        self.rate_per_second = rate_per_minute / 60.0
+        self.burst = float(burst) if burst is not None else max(1.0, rate_per_minute)
+        self.clock = clock or Clock()
+        self._tokens = self.burst  # start full: the cap bounds rate, not startup
+        self._stamp = self.clock.monotonic()
+
+    def _refill(self) -> None:
+        now = self.clock.monotonic()
+        elapsed = max(0.0, now - self._stamp)
+        self._stamp = now
+        self._tokens = min(self.burst, self._tokens + elapsed * self.rate_per_second)
+
+    def try_take(self, n: float = 1.0) -> bool:
+        """Take ``n`` tokens if available; False (nothing taken) when
+        the bucket cannot cover them."""
+        self._refill()
+        if self._tokens + 1e-9 < n:
+            return False
+        self._tokens -= n
+        return True
+
+    def available(self) -> float:
+        """Tokens on hand right now (refilled to now)."""
+        self._refill()
+        return self._tokens
+
+    def seconds_until(self, n: float = 1.0) -> float:
+        """How long until ``n`` tokens are on hand (0 when already)."""
+        self._refill()
+        deficit = n - self._tokens
+        if deficit <= 0:
+            return 0.0
+        return deficit / self.rate_per_second

@@ -13,7 +13,9 @@ Parameter derivation from a HealthCheck spec lives in
 
 from __future__ import annotations
 
+import random
 from dataclasses import dataclass
+from typing import Optional
 
 from activemonitor_tpu.utils.clock import Clock
 
@@ -86,12 +88,30 @@ class InverseExpBackoff:
     deadline has passed, matching the reference loop shape where the
     body runs once more with a synthesized Failed status
     (reference: healthcheck_controller.go:627-632).
+
+    ``jitter=True`` opts into FULL jitter (AWS-style): each returned
+    delay is drawn uniformly from ``[0, delay]`` while the underlying
+    schedule advances deterministically. Off by default — existing
+    callers keep exact delays (fake-clock tests script them) — and
+    turned on where synchronized sleepers would otherwise re-converge
+    on the apiserver in one wave after an outage (the degraded-mode
+    pacer in resilience/coordinator.py). ``rng`` injects a seeded
+    ``random.Random`` for deterministic tests.
     """
 
-    def __init__(self, params: BackoffParams, clock: Clock | None = None):
+    def __init__(
+        self,
+        params: BackoffParams,
+        clock: Clock | None = None,
+        *,
+        jitter: bool = False,
+        rng: Optional[random.Random] = None,
+    ):
         self._params = params
         self._clock = clock or Clock()
         self._delay = params.max_delay
+        self._jitter = jitter
+        self._rng = rng
         self._deadline = (
             self._clock.monotonic() + params.timeout if params.timeout > 0 else None
         )
@@ -109,9 +129,14 @@ class InverseExpBackoff:
     def advance(self) -> float:
         """Current delay, advancing the schedule — for callers that pace
         themselves (e.g. waiting on a watch event bounded by the delay)
-        instead of sleeping here."""
+        instead of sleeping here. With ``jitter`` on, the returned value
+        is uniform in ``[0, delay]``; the schedule itself advances
+        unjittered so the delay envelope stays deterministic."""
         delay = self._delay
         self._delay = max(self._delay * self._params.factor, self._params.min_delay)
+        if self._jitter:
+            uniform = self._rng.uniform if self._rng is not None else random.uniform
+            return uniform(0.0, delay)
         return delay
 
     async def next(self) -> bool:

@@ -42,6 +42,11 @@ positive that makes `make lint` cry wolf is worse than a miss):
   whose whole body is `pass`/`...` — the broad catch that silently
   eats errors (BLE001's harmful core). Handlers that log, re-raise,
   return, or otherwise DO something are fine.
+- wallclock-in-resilience: `time.time()` / `time.monotonic()` calls in
+  files under a `resilience/` directory — that package's whole contract
+  is the injectable Clock (breaker open windows and token-bucket refill
+  must be scriptable by fake-clock tests); a bare wall-clock read there
+  silently breaks determinism.
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -126,6 +131,8 @@ class Checker(ast.NodeVisitor):
         self.has_star_import = False
         self.is_init = path.endswith("__init__.py")
         self.source = source
+        # the injectable-clock package: bare wall-clock reads are banned
+        self.ban_wallclock = "resilience" in Path(path).parts
         # names defined `async def` / plain `def` anywhere in the file
         # (functions AND methods) — the unawaited-coroutine check only
         # fires on names that are EXCLUSIVELY async, so a sync function
@@ -425,6 +432,26 @@ class Checker(ast.NodeVisitor):
             if isinstance(block, list) and len(block) > 1:
                 self._check_unreachable(block)
         return super().visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.ban_wallclock:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+                and fn.attr in ("time", "monotonic")
+            ):
+                self.findings.append(
+                    (
+                        node.lineno,
+                        "wallclock-in-resilience",
+                        f"`time.{fn.attr}()` in resilience/ — use the "
+                        "injectable Clock so fake-clock tests stay "
+                        "deterministic",
+                    )
+                )
+        self.generic_visit(node)
 
     def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
         # a format spec like `:.1e` parses as a placeholder-less

@@ -188,6 +188,121 @@ async def test_watch_health_gauge_seeded_when_unhealthy_from_start():
 
 
 @pytest.mark.asyncio
+async def test_never_connecting_watch_reports_unhealthy_and_counts_restarts():
+    """A namespace watch that never connects (connection refused at
+    startup) must surface through BOTH wired callbacks — the health
+    gauge reads 0 and workflow_watch_restarts_total counts every
+    re-establishment attempt — instead of staying silently at its
+    initial state."""
+    from activemonitor_tpu.kube import KubeApi, KubeConfig
+    from activemonitor_tpu.metrics import MetricsCollector
+
+    collector = MetricsCollector()
+    api = KubeApi(KubeConfig(server="http://127.0.0.1:1"))
+    eng = ArgoWorkflowEngine(
+        api,
+        on_watch_health=collector.record_watch_health,
+        on_watch_restart=collector.record_watch_restart,
+    )
+    try:
+        # a read starts the namespace watch; the direct-GET fallback
+        # fails too (the server is down) — that error is the caller's
+        with pytest.raises(Exception):
+            await eng.get("health", "ghost")
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while True:
+            restarts = collector.sample_value(
+                "workflow_watch_restarts_total", {"namespace": "health"}
+            )
+            if restarts and restarts >= 1:
+                break
+            assert asyncio.get_event_loop().time() < deadline, (
+                "watch restarts never counted for a never-connecting watch"
+            )
+            await asyncio.sleep(0.05)
+        assert (
+            collector.sample_value(
+                "workflow_watch_healthy", {"namespace": "health"}
+            )
+            == 0.0
+        )
+    finally:
+        await eng.close()
+        await api.close()
+
+
+@pytest.mark.asyncio
+async def test_dead_watch_task_flips_unhealthy_and_restart_is_counted():
+    """A watch task that dies outright (not via stop()) must not leave
+    the cache advertising its last healthy state — and reviving it
+    counts as a stream restart."""
+    from activemonitor_tpu.metrics import MetricsCollector
+
+    collector = MetricsCollector()
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(
+            api,
+            on_watch_health=collector.record_watch_health,
+            on_watch_restart=collector.record_watch_restart,
+        )
+        try:
+            name = await eng.submit(dict(MANIFEST))
+            watch = await _warm_watch(eng)
+            assert (
+                collector.sample_value(
+                    "workflow_watch_healthy", {"namespace": "health"}
+                )
+                == 1.0
+            )
+            # kill the task from outside (a bug escaping the retry
+            # ladder looks the same): health must flip to 0
+            watch._task.cancel()
+            for _ in range(100):
+                if not watch.healthy:
+                    break
+                await asyncio.sleep(0.02)
+            assert not watch.healthy
+            assert (
+                collector.sample_value(
+                    "workflow_watch_healthy", {"namespace": "health"}
+                )
+                == 0.0
+            )
+            restarts_before = (
+                collector.sample_value(
+                    "workflow_watch_restarts_total", {"namespace": "health"}
+                )
+                or 0.0
+            )
+            # the next engine call revives the watch, counting a restart
+            await eng.get("health", name)
+            assert (
+                collector.sample_value(
+                    "workflow_watch_restarts_total", {"namespace": "health"}
+                )
+                == restarts_before + 1
+            )
+            await _warm_watch(eng)  # and it becomes healthy again
+        finally:
+            await eng.close()
+
+
+@pytest.mark.asyncio
+async def test_closed_engine_does_not_resurrect_watches():
+    async with stub_env() as (server, api):
+        eng = ArgoWorkflowEngine(api)
+        name = await eng.submit(dict(MANIFEST))
+        await _warm_watch(eng)
+        await eng.close()
+        # a straggler get() after close must not spawn a new watch task
+        watch = eng._watches["health"]
+        task_after_close = watch._task
+        await eng.get("health", name)
+        assert watch._task is task_after_close
+        assert task_after_close.done()
+
+
+@pytest.mark.asyncio
 async def test_cache_scoped_to_instance_id_label():
     async with stub_env() as (server, api):
         eng = ArgoWorkflowEngine(api)

@@ -68,6 +68,54 @@ async def test_delays_decrease_to_min():
     assert seen == [60.0, 30.0, 15.0, 7.5, 3.75, 2.0, 2.0]
 
 
+class TestFullJitter:
+    """Opt-in full jitter (ISSUE 3 satellite): synchronized checks must
+    not thundering-herd the apiserver after an outage, so a jittered
+    pacer draws each delay uniformly from [0, delay]."""
+
+    def test_property_jittered_delays_stay_within_zero_and_schedule(self):
+        # property test across many parameter sets and draws: every
+        # jittered delay lands in [0, delay] where delay is the exact
+        # value the unjittered schedule would have returned
+        import random
+
+        rng = random.Random(1234)
+        for case in range(50):
+            params = compute_backoff_params(
+                workflow_timeout=rng.randrange(1, 3600),
+                backoff_max=rng.randrange(0, 600),
+                backoff_min=rng.randrange(0, 60),
+                backoff_factor=str(rng.uniform(0.05, 0.95)),
+            )
+            clock = FakeClock()
+            plain = InverseExpBackoff(params, clock)
+            jittered = InverseExpBackoff(
+                params, clock, jitter=True, rng=random.Random(case)
+            )
+            for _ in range(20):
+                envelope = plain.advance()
+                drawn = jittered.advance()
+                assert 0.0 <= drawn <= envelope, (params, envelope, drawn)
+
+    def test_jitter_defaults_off_and_preserves_exact_schedule(self):
+        params = compute_backoff_params(workflow_timeout=120)  # max 60 min 2
+        ieb = InverseExpBackoff(params, FakeClock())
+        assert [ieb.advance() for _ in range(4)] == [60.0, 30.0, 15.0, 7.5]
+
+    def test_jittered_schedule_envelope_still_decays(self):
+        # the underlying schedule advances unjittered: after N draws the
+        # envelope equals the plain schedule's Nth delay
+        import random
+
+        params = compute_backoff_params(workflow_timeout=120)
+        ieb = InverseExpBackoff(
+            params, FakeClock(), jitter=True, rng=random.Random(0)
+        )
+        for _ in range(3):
+            ieb.advance()
+        assert ieb.current_delay == 7.5
+
+
 @pytest.mark.asyncio
 async def test_timeout_returns_false_without_sleeping():
     clock = FakeClock()

@@ -507,6 +507,168 @@ async def test_chaos_soak_sustained_faults_over_simulated_time():
 
 
 @pytest.mark.asyncio
+async def test_chaos_soak_breaker_degrades_and_recovers_without_duplicates():
+    """ISSUE-3 acceptance: a seeded soak (injected 500s + watch drops +
+    latency) in which the shared circuit breaker opens, the controller
+    enters degraded mode (gauge + snapshot), the terminal status write
+    queues for replay — and recovery closes the breaker, replays the
+    queued write, with exactly ONE workflow ever created per scheduled
+    fire (no duplicates through the whole storm)."""
+    import random
+
+    from activemonitor_tpu.kube import KubeApi, KubeConfig
+    from activemonitor_tpu.resilience import (
+        CircuitBreaker,
+        ResilienceCoordinator,
+        STATE_CLOSED,
+        STATE_OPEN,
+    )
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    from tests.kube_harness import drive_until
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        metrics = MetricsCollector()
+        breaker = CircuitBreaker(
+            "api", clock=clock, failure_threshold=5, recovery_seconds=30.0
+        )
+        resilience = ResilienceCoordinator(
+            clock, metrics, breaker=breaker, rng=random.Random(42)
+        )
+        client = KubernetesHealthCheckClient(api)
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=ArgoWorkflowEngine(api),
+            rbac=RBACProvisioner(KubernetesRBACBackend(api)),
+            recorder=KubernetesEventRecorder(api),
+            metrics=metrics,
+            clock=clock,
+            resilience=resilience,
+        )
+        # the breaker observes the controller's transport — NOT the
+        # test scaffolding's (the Argo player gets its own session)
+        api.set_breaker(breaker)
+        player_api = KubeApi(KubeConfig(server=server.url))
+        manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+        await manager.start()
+        player = argo_player(server, player_api)
+        key = "health/chaos-breaker"
+        try:
+            hc = chaos_check("chaos-breaker")
+            hc.spec.repeat_after_sec = 300
+            hc.spec.workflow.timeout = 120
+            await client.apply(hc)
+
+            # ---- baseline: run 1 completes cleanly -------------------
+            async def run_count(n):
+                async def check():
+                    got = await client.get("health", "chaos-breaker")
+                    return (
+                        got
+                        if got and got.status.total_healthcheck_runs >= n
+                        else None
+                    )
+
+                return check
+
+            await drive_until(clock, await run_count(1), max_seconds=150)
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 1
+            assert breaker.state == STATE_CLOSED
+            flush = getattr(reconciler.recorder, "flush", None)
+            if flush is not None:
+                await flush()
+
+            # ---- storm: every workflow read 500s, every healthcheck
+            # status write 500s, watch streams drop, uniform latency ---
+            server.inject_fault(
+                "/workflows", status=500, times=500, method="GET"
+            )
+            server.inject_fault(
+                "/healthchecks", status=500, times=500, method="PATCH"
+            )
+            server.latency = 0.01
+            server.drop_watches()
+
+            # the 300 s timer fires run 2: the submit (POST) lands — ONE
+            # new workflow — but its polls hit the 500 storm and the
+            # breaker opens
+            async def breaker_open():
+                server.drop_watches()
+                return breaker.state == STATE_OPEN
+
+            await drive_until(clock, breaker_open, max_seconds=400)
+            assert breaker.state == STATE_OPEN
+            # degraded mode is reported on the gauge and the snapshot
+            assert (
+                metrics.sample_value("healthcheck_controller_degraded", {})
+                == 1.0
+            )
+            assert resilience.snapshot()["degraded"] is True
+            # the degraded pacer stretches the retry cadence within the
+            # breaker's recovery window
+            assert 1.0 <= resilience.requeue_delay(1.0) <= 30.0
+            # exactly one new workflow for the fire, despite the storm
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2
+
+            # ---- partial recovery: reads heal, writes stay broken ----
+            server.latency = 0.0
+            server.faults[:] = [
+                f for f in server.faults if f["path_substr"] != "/workflows"
+            ]
+            # the open window elapses -> half-open -> a read succeeds and
+            # closes the breaker -> the verdict (the player marked wf2
+            # Succeeded long ago) lands -> the terminal status write hits
+            # the PATCH storm, re-trips the breaker, and QUEUES
+            async def write_parked():
+                return resilience.pending_status_writes() >= 1
+
+            await drive_until(clock, write_parked, max_seconds=400)
+            assert resilience.pending_status_writes() == 1
+            assert resilience.queued_status(key).total_healthcheck_runs == 2
+            assert breaker.state == STATE_OPEN  # re-tripped by the writes
+            assert (
+                metrics.sample_value("healthcheck_controller_degraded", {})
+                == 1.0
+            )
+            # the durable status still shows run 1 only...
+            got = await client.get("health", "chaos-breaker")
+            assert got.status.total_healthcheck_runs == 1
+            # ...and a reconcile poked while the write is parked must NOT
+            # double-submit (the queued status overlays the stale one)
+            await reconciler.reconcile("health", "chaos-breaker")
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2
+
+            # ---- full recovery: writes heal, the replay sweep drains --
+            server.faults.clear()
+
+            async def replayed():
+                got = await client.get("health", "chaos-breaker")
+                return got if got.status.total_healthcheck_runs >= 2 else None
+
+            await drive_until(clock, replayed, max_seconds=400)
+            assert resilience.pending_status_writes() == 0
+            assert breaker.state == STATE_CLOSED
+            await asyncio.sleep(0.1)
+            resilience.refresh()
+            assert (
+                metrics.sample_value("healthcheck_controller_degraded", {})
+                == 0.0
+            )
+            got = await client.get("health", "chaos-breaker")
+            assert got.status.status == "Succeeded"
+            assert got.status.success_count == 2
+            # the whole storm produced exactly one workflow per fire
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2
+            # and the schedule survived: the next fire is on the books
+            assert manager.reconciler.timers.exists(key)
+        finally:
+            player.cancel()
+            await manager.stop()
+            await player_api.close()
+
+
+@pytest.mark.asyncio
 async def test_timer_fired_resubmit_survives_submit_500s():
     """A 500 storm hitting the TIMER-fired resubmission (not the first
     submit) must not end the schedule: the timer entry is consumed, so

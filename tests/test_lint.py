@@ -349,6 +349,69 @@ def test_collector_families_are_pinned_in_the_exposition_contract():
     assert stale == set(), f"PINNED_FAMILIES entries no longer declared: {stale}"
 
 
+def test_wallclock_banned_in_resilience_package(tmp_path):
+    """resilience/ runs entirely on the injectable Clock — breaker open
+    windows and token-bucket refill must be scriptable by fake-clock
+    tests, so a bare time.time()/time.monotonic() there is a lint
+    error. The same code OUTSIDE resilience/ stays quiet."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    res_dir = tmp_path / "resilience"
+    res_dir.mkdir()
+    (res_dir / "mod.py").write_text(source)
+    got = lint.lint_file(res_dir / "mod.py")
+    assert {line.split(": ")[1] for line in got} == {"wallclock-in-resilience"}
+    assert len(got) == 2  # both the time() and the monotonic() call
+    # identical code outside resilience/: no finding
+    assert findings(tmp_path, source) == []
+    # clock-disciplined resilience code: no finding
+    clean = (
+        "def delay(clock):\n"
+        "    return clock.monotonic() + 1.0\n"
+    )
+    (res_dir / "clean.py").write_text(clean)
+    assert lint.lint_file(res_dir / "clean.py") == []
+
+
+def test_resilience_package_really_is_wallclock_free():
+    """The gate, applied: the shipped resilience/ package lints clean,
+    and the ban actually covers its files (path-scoping regression
+    guard)."""
+    package = REPO / "activemonitor_tpu" / "resilience"
+    files = sorted(package.rglob("*.py"))
+    assert files, "resilience package missing?"
+    for path in files:
+        assert lint.lint_file(path) == []
+        # the scope bit must be ON for these files — otherwise the
+        # check above passed vacuously
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock
+
+
+def test_resilience_metric_families_are_pinned():
+    """The ISSUE-3 families must stay in the exposition contract — a
+    rename breaks the degraded-mode alert every fleet dashboard leads
+    with."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_resilience", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_controller_degraded",
+        "healthcheck_check_state",
+        "healthcheck_remedy_runs_total",
+        "healthcheck_status_write_queue_depth",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
 def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
     got = findings(
         tmp_path,

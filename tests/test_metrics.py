@@ -206,6 +206,12 @@ PINNED_FAMILIES = {
     "healthcheck_error_budget_remaining": "gauge",
     "healthcheck_slo_burn_rate": "gauge",
     "workflow_watch_healthy": "gauge",
+    # resilience families (ISSUE 3: degraded mode, per-check state
+    # machine, remedy storm control — docs/resilience.md)
+    "healthcheck_controller_degraded": "gauge",
+    "healthcheck_status_write_queue_depth": "gauge",
+    "healthcheck_check_state": "gauge",
+    "healthcheck_remedy_runs_total": "counter",
     "controller_runtime_reconcile_total": "counter",
     "controller_runtime_reconcile_time_seconds": "histogram",
     "controller_runtime_active_workers": "gauge",
@@ -234,6 +240,12 @@ def exercise_every_family(collector):
     collector.record_engine_poll("fake")
     collector.record_watch_restart("health")
     collector.record_watch_health("health", True)
+    collector.set_degraded(False)
+    collector.set_status_write_queue_depth(0)
+    # a non-healthy state materializes the trio (healthy-only checks
+    # deliberately carry no state series — cardinality contract)
+    collector.set_check_state("hc-a", "health", "Flapping")
+    collector.record_remedy_run("hc-a", "health", "admitted")
     collector.cadence_goodput.set(1.0)
     collector.set_fleet_goodput(1.0)
     collector.set_slo(
@@ -253,6 +265,32 @@ def exercise_every_family(collector):
             }
         },
     )
+
+
+def test_check_state_series_are_lazy_for_healthy_checks(collector):
+    """Cardinality contract: a check that never leaves healthy carries
+    NO state series (absence = healthy); once degraded, the one-hot
+    trio persists so the recovery transition is visible; deletion
+    drops it (and re-arms the laziness)."""
+    labels = lambda state: {  # noqa: E731 - tiny local shorthand
+        "healthcheck_name": "hc-a",
+        "namespace": "health",
+        "state": state,
+    }
+    collector.set_check_state("hc-a", "health", "Healthy")
+    for state in ("healthy", "flapping", "quarantined"):
+        assert collector.sample_value("healthcheck_check_state", labels(state)) is None
+    collector.set_check_state("hc-a", "health", "Flapping")
+    assert collector.sample_value("healthcheck_check_state", labels("flapping")) == 1.0
+    assert collector.sample_value("healthcheck_check_state", labels("healthy")) == 0.0
+    collector.set_check_state("hc-a", "health", "Healthy")
+    assert collector.sample_value("healthcheck_check_state", labels("healthy")) == 1.0
+    assert collector.sample_value("healthcheck_check_state", labels("flapping")) == 0.0
+    collector.clear_check_state("hc-a", "health")
+    for state in ("healthy", "flapping", "quarantined"):
+        assert collector.sample_value("healthcheck_check_state", labels(state)) is None
+    collector.set_check_state("hc-a", "health", "Healthy")
+    assert collector.sample_value("healthcheck_check_state", labels("healthy")) is None
 
 
 def test_every_pinned_family_appears_in_the_scrape(collector):

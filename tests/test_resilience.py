@@ -1,0 +1,826 @@
+"""Resilience layer (ISSUE 3): circuit breaker, per-check state machine
+(healthy → flapping → quarantined), remedy storm control, degraded-mode
+status-write queueing — units on fake clocks plus reconciler-level
+lifecycles with FakeEngine, including the remedy-cap acceptance slice
+(suppressed with an event + counter while the bucket is dry, admitted
+after refill).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.engine.base import PHASE_FAILED, PHASE_SUCCEEDED
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.resilience import (
+    BreakerOpenError,
+    CheckStateTracker,
+    CircuitBreaker,
+    ResilienceCoordinator,
+    STATE_CLOSED,
+    STATE_FLAPPING,
+    STATE_HALF_OPEN,
+    STATE_HEALTHY,
+    STATE_OPEN,
+    STATE_QUARANTINED,
+    TokenBucket,
+)
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+
+class Transient(Exception):
+    status = 503
+
+
+class Deterministic(Exception):
+    status = 404
+
+
+def make_hc(name="hc-res", repeat=60, remedy_prefix=None, remedy_limit=0):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        "backoffMax": 1,
+        "backoffMin": 1,
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if remedy_prefix is not None:
+        spec["remedyworkflow"] = {
+            "generateName": remedy_prefix,
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        }
+        if remedy_limit:
+            spec["remedyRunsLimit"] = remedy_limit
+            spec["remedyResetInterval"] = 3600
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+async def settle():
+    for _ in range(60):
+        await asyncio.sleep(0)
+
+
+def build_reconciler(engine, clock, metrics=None, resilience=None):
+    metrics = metrics or MetricsCollector()
+    return HealthCheckReconciler(
+        client=InMemoryHealthCheckClient(),
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+        resilience=resilience,
+    )
+
+
+# ---------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_breaker_trips_on_failure_rate_and_recovers_half_open():
+    clock = FakeClock()
+    transitions = []
+    breaker = CircuitBreaker(
+        "api",
+        clock=clock,
+        failure_threshold=3,
+        recovery_seconds=30.0,
+        on_transition=lambda old, new: transitions.append((old, new)),
+    )
+    assert breaker.state == STATE_CLOSED and breaker.allow()
+    breaker.observe(Transient())
+    breaker.observe(Transient())
+    assert breaker.state == STATE_CLOSED  # below threshold
+    breaker.observe(Transient())
+    assert breaker.state == STATE_OPEN
+    assert not breaker.allow()
+    assert transitions == [(STATE_CLOSED, STATE_OPEN)]
+    assert 0 < breaker.retry_after() <= 30.0
+    # open window elapses on the injected clock only
+    await clock.advance(29.0)
+    assert not breaker.allow()
+    await clock.advance(2.0)
+    assert breaker.state == STATE_HALF_OPEN and breaker.allow()
+    # half-open probe succeeds: closed
+    breaker.observe(None)
+    assert breaker.state == STATE_CLOSED
+    assert transitions[-1] == (STATE_HALF_OPEN, STATE_CLOSED)
+
+
+@pytest.mark.asyncio
+async def test_breaker_half_open_failure_reopens_for_a_full_window():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        "api", clock=clock, failure_threshold=1, recovery_seconds=10.0
+    )
+    breaker.observe(Transient())
+    assert breaker.state == STATE_OPEN
+    await clock.advance(11.0)
+    assert breaker.state == STATE_HALF_OPEN
+    breaker.observe(Transient())  # the probe failed
+    assert breaker.state == STATE_OPEN
+    assert breaker.retry_after() == pytest.approx(10.0)
+    assert breaker.snapshot()["trips"] == 2
+
+
+def test_breaker_interleaved_successes_do_not_mask_a_write_storm():
+    """The rate-window rationale: every conflict-retried status write
+    interleaves a healthy GET with the failing PATCH, so consecutive
+    counting would never trip — the window counting must."""
+    breaker = CircuitBreaker(
+        "api", clock=FakeClock(), failure_threshold=3, failure_window=60.0
+    )
+    for _ in range(2):
+        breaker.observe(None)  # healthy read
+        breaker.observe(Transient())  # failing write
+    assert breaker.state == STATE_CLOSED
+    breaker.observe(None)
+    breaker.observe(Transient())  # third failure inside the window
+    assert breaker.state == STATE_OPEN
+
+
+@pytest.mark.asyncio
+async def test_breaker_failures_outside_the_window_age_out():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        "api", clock=clock, failure_threshold=2, failure_window=10.0
+    )
+    breaker.observe(Transient())
+    await clock.advance(11.0)
+    breaker.observe(Transient())  # the first failure has aged out
+    assert breaker.state == STATE_CLOSED
+
+
+def test_breaker_deterministic_errors_and_rejections_never_count():
+    breaker = CircuitBreaker("api", clock=FakeClock(), failure_threshold=1)
+    breaker.observe(Deterministic())  # 4xx: the server is answering
+    assert breaker.state == STATE_CLOSED
+    # the breaker must never feed on (or close off) its own rejections
+    breaker.observe(BreakerOpenError("api", 1.0))
+    assert breaker.state == STATE_CLOSED
+    breaker.observe(ConnectionRefusedError())  # connection-level: counts
+    assert breaker.state == STATE_OPEN
+    breaker.observe(BreakerOpenError("api", 1.0))
+    assert breaker.state == STATE_OPEN  # not closed by its own rejection
+
+
+# ---------------------------------------------------------------------
+# per-check state machine
+# ---------------------------------------------------------------------
+
+
+def test_tracker_flap_detection_and_calm_recovery():
+    tracker = CheckStateTracker()  # window 8, threshold 3, calm 4
+    key = "ns/hc"
+    assert tracker.note_verdict(key, True) is None
+    assert tracker.note_verdict(key, False) is None  # 1 flip
+    assert tracker.note_verdict(key, True) is None  # 2 flips
+    transition = tracker.note_verdict(key, False)  # 3 flips
+    assert transition == (STATE_HEALTHY, STATE_FLAPPING)
+    assert tracker.state(key) == STATE_FLAPPING
+    assert tracker.damp_factor(key) == 2.0
+    # three equal verdicts are not yet calm...
+    for _ in range(3):
+        assert tracker.note_verdict(key, True) is None
+    # ...the fourth is
+    assert tracker.note_verdict(key, True) == (STATE_FLAPPING, STATE_HEALTHY)
+    assert tracker.damp_factor(key) == 1.0
+    # the calm transition starts a clean window: the pre-calm flips
+    # still in the ring must not re-trip flapping on the next verdicts
+    # (the damp/undamp oscillation a stale window would cause)
+    for _ in range(6):
+        assert tracker.note_verdict(key, True) is None
+        assert tracker.state(key) == STATE_HEALTHY
+
+
+def test_tracker_quarantine_streak_reset_and_clear():
+    tracker = CheckStateTracker(quarantine_after=3)
+    key = "ns/hc"
+    assert tracker.note_preterminal_error(key) is None
+    assert tracker.note_preterminal_error(key) is None
+    tracker.note_submit_ok(key)  # a clean submit breaks the streak
+    assert tracker.note_preterminal_error(key) is None
+    assert tracker.note_preterminal_error(key) is None
+    transition = tracker.note_preterminal_error(key)
+    assert transition == (STATE_HEALTHY, STATE_QUARANTINED)
+    assert tracker.state(key) == STATE_QUARANTINED
+    # a straggler verdict from an in-flight workflow must not resurrect
+    assert tracker.note_verdict(key, True) is None
+    assert tracker.state(key) == STATE_QUARANTINED
+    # further errors are absorbed silently
+    assert tracker.note_preterminal_error(key) is None
+    tracker.clear(key)
+    assert tracker.state(key) == STATE_HEALTHY
+    assert tracker.error_streak(key) == 0
+
+
+def test_tracker_persisted_bit_and_forget():
+    tracker = CheckStateTracker(quarantine_after=1)
+    key = "ns/hc"
+    tracker.note_preterminal_error(key)
+    assert not tracker.persisted(key)
+    tracker.mark_persisted(key)
+    assert tracker.persisted(key)
+    tracker.forget(key)
+    assert tracker.state(key) == STATE_HEALTHY
+    # durable adoption (restart path) marks persisted directly
+    tracker.quarantine(key)
+    assert tracker.state(key) == STATE_QUARANTINED and tracker.persisted(key)
+
+
+# ---------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_token_bucket_exhausts_and_refills_on_the_injected_clock():
+    clock = FakeClock()
+    bucket = TokenBucket(rate_per_minute=1.0, clock=clock)
+    assert bucket.try_take()  # starts full (burst 1)
+    assert not bucket.try_take()
+    assert bucket.seconds_until() == pytest.approx(60.0)
+    await clock.advance(30.0)
+    assert not bucket.try_take()  # half a token
+    await clock.advance(30.0)
+    assert bucket.try_take()
+    assert bucket.available() == pytest.approx(0.0)
+
+
+@pytest.mark.asyncio
+async def test_token_bucket_burst_caps_accrual():
+    clock = FakeClock()
+    bucket = TokenBucket(rate_per_minute=60.0, burst=2.0, clock=clock)
+    await clock.advance(600.0)  # ten minutes of refill...
+    assert bucket.available() == pytest.approx(2.0)  # ...capped at burst
+    assert bucket.try_take() and bucket.try_take() and not bucket.try_take()
+
+
+def test_token_bucket_rejects_nonpositive_rate():
+    with pytest.raises(ValueError):
+        TokenBucket(rate_per_minute=0.0)
+
+
+# ---------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_coordinator_degraded_gauge_and_stretched_requeue_delay():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    res = ResilienceCoordinator(
+        clock,
+        metrics,
+        breaker=CircuitBreaker(
+            "api", clock=clock, failure_threshold=1, recovery_seconds=30.0
+        ),
+        rng=random.Random(42),
+    )
+    assert not res.degraded
+    assert res.requeue_delay(1.0) == 1.0
+    assert metrics.sample_value("healthcheck_controller_degraded", {}) == 0.0
+    res.breaker.observe(Transient())
+    assert res.degraded
+    assert metrics.sample_value("healthcheck_controller_degraded", {}) == 1.0
+    # stretched-and-jittered, never below the base, never above the
+    # breaker's recovery window
+    for _ in range(20):
+        delay = res.requeue_delay(1.0)
+        assert 1.0 <= delay <= 30.0
+    # the envelope is TIME-based (the remaining open window), not a
+    # shared advancing schedule: even after many draws, deep into the
+    # window the bound follows retry_after(), and concurrent callers
+    # can't collapse each other's stretch to the floor
+    await clock.advance(25.0)
+    for _ in range(20):
+        assert 1.0 <= res.requeue_delay(1.0) <= 5.0 + 1e-9
+    await clock.advance(6.0)
+    res.refresh()  # half-open: still degraded
+    assert res.degraded
+    res.breaker.observe(None)
+    res.refresh()
+    assert not res.degraded
+    assert metrics.sample_value("healthcheck_controller_degraded", {}) == 0.0
+    assert res.requeue_delay(1.0) == 1.0
+
+
+def test_coordinator_status_queue_latest_wins_and_replay_order():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    res = ResilienceCoordinator(clock, metrics)
+    hc_a, hc_b = make_hc("a"), make_hc("b")
+    hc_a.status.success_count = 1
+    res.queue_status_write(hc_a)
+    res.queue_status_write(hc_b)
+    hc_a.status.success_count = 2
+    res.queue_status_write(hc_a)  # fresher status for a queued key
+    assert res.pending_status_writes() == 2
+    assert metrics.sample_value("healthcheck_status_write_queue_depth", {}) == 2
+    assert res.queued_status("health/a").success_count == 2
+    key, queued = res.next_status_write()
+    assert key == "health/a" and queued.status.success_count == 2
+    # a failed replay goes back to the FRONT
+    res.requeue_status_write(key, queued)
+    assert res.next_status_write()[0] == "health/a"
+    res.drop_status_write("health/b")
+    assert res.pending_status_writes() == 0
+    assert res.queued_status("health/b") is None
+
+
+def test_coordinator_remedy_admission_and_snapshot():
+    clock = FakeClock()
+    res = ResilienceCoordinator(clock, None, remedy_rate=1.0)
+    assert res.admit_remedy()
+    assert not res.admit_remedy()
+    snap = res.snapshot()
+    assert snap["degraded"] is False
+    assert snap["remedy_tokens"] == pytest.approx(0.0)
+    assert snap["breaker"]["state"] == STATE_CLOSED
+    res.configure_remedy_rate(0.0)  # cap removed
+    assert res.admit_remedy() and res.remedy_tokens() is None
+
+
+# ---------------------------------------------------------------------
+# reconciler: quarantine lifecycle
+# ---------------------------------------------------------------------
+
+
+class ExplodingEngine:
+    """Deterministically broken submit path (a ValueError is NOT
+    transient, so the breaker stays closed and the errors count against
+    the CHECK, not the fleet)."""
+
+    name = "exploding"
+
+    def __init__(self):
+        self.submits = 0
+
+    async def submit(self, manifest):
+        self.submits += 1
+        raise ValueError("deterministically broken")
+
+    async def get(self, namespace, name):
+        return None
+
+
+@pytest.mark.asyncio
+async def test_quarantine_lifecycle_stop_mark_clear_resume():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    engine = ExplodingEngine()
+    reconciler = build_reconciler(engine, clock, metrics)
+    client = reconciler.client
+    hc = make_hc("hc-q")
+    await client.apply(hc)
+    key = "health/hc-q"
+
+    # 5 consecutive pre-terminal errors (default threshold) quarantine
+    for i in range(5):
+        await reconciler.reconcile("health", "hc-q")
+        expected = STATE_QUARANTINED if i >= 4 else STATE_HEALTHY
+        assert reconciler.resilience.checks.state(key) == expected
+    assert engine.submits == 5
+
+    # the durable mark landed and is user-visible
+    stored = await client.get("health", "hc-q")
+    assert stored.status.state == STATE_QUARANTINED
+    assert "quarantined" in stored.status.error_message
+    assert metrics.sample_value(
+        "healthcheck_check_state",
+        {"healthcheck_name": "hc-q", "namespace": "health", "state": "quarantined"},
+    ) == 1.0
+    events = reconciler.recorder.events_for("health", "hc-q")
+    assert any("quarantined" in e.message for e in events)
+
+    # further reconciles do NOT touch the engine: the schedule is parked
+    await reconciler.reconcile("health", "hc-q")
+    assert engine.submits == 5
+    assert not reconciler.timers.exists(key)
+
+    # the user clears .status.state -> the next reconcile resumes (and
+    # the now-working engine gets a submission)
+    stored.status.state = ""
+    await client.update_status(stored)
+    reconciler.engine = FakeWorkflowEngine()
+    await reconciler.reconcile("health", "hc-q")
+    assert reconciler.resilience.checks.state(key) == STATE_HEALTHY
+    assert len(reconciler.engine.submitted) == 1
+    events = reconciler.recorder.events_for("health", "hc-q")
+    assert any("Quarantine cleared" in e.message for e in events)
+    await reconciler.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_durable_quarantine_mark_is_adopted_after_restart():
+    """A fresh reconciler (restarted controller, empty tracker) must
+    honor a Quarantined mark found in durable status instead of
+    resubmitting the broken check."""
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    hc = make_hc("hc-adopt")
+    applied = await client.apply(hc)
+    applied.status.state = STATE_QUARANTINED
+    await client.update_status(applied)
+
+    engine = FakeWorkflowEngine()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    await reconciler.reconcile("health", "hc-adopt")
+    assert engine.submitted == []
+    assert (
+        reconciler.resilience.checks.state("health/hc-adopt")
+        == STATE_QUARANTINED
+    )
+    await reconciler.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_errors_during_degraded_mode_do_not_quarantine():
+    """An apiserver outage is the fleet's problem: with the breaker
+    open, per-check error streaks must not accumulate — innocents would
+    be quarantined by the outage."""
+    clock = FakeClock()
+    engine = ExplodingEngine()
+    reconciler = build_reconciler(engine, clock)
+    await reconciler.client.apply(make_hc("hc-deg"))
+    # trip the shared breaker: the controller is degraded
+    for _ in range(5):
+        reconciler.resilience.breaker.observe(Transient())
+    assert reconciler.resilience.degraded
+    for _ in range(8):
+        await reconciler.reconcile("health", "hc-deg")
+    assert reconciler.resilience.checks.state("health/hc-deg") == STATE_HEALTHY
+    assert reconciler.resilience.checks.error_streak("health/hc-deg") == 0
+    await reconciler.shutdown()
+
+
+# ---------------------------------------------------------------------
+# reconciler: flap damping
+# ---------------------------------------------------------------------
+
+
+def scripted_engine(script):
+    """FakeEngine whose Nth submitted workflow follows the Nth script
+    entry (polls-until-terminal, verdict)."""
+    import collections as _collections
+
+    engine = FakeWorkflowEngine()
+    queue = _collections.deque(script)
+    assigned = {}
+
+    def completer(wf, count):
+        name = wf["metadata"]["name"]
+        if name not in assigned:
+            if not queue:
+                return None
+            assigned[name] = queue.popleft()
+        polls, ok = assigned[name]
+        if count < polls:
+            return None
+        if ok:
+            return {"phase": PHASE_SUCCEEDED}
+        return {"phase": PHASE_FAILED, "message": "scripted failure"}
+
+    engine._default_completer = completer
+    return engine
+
+
+@pytest.mark.asyncio
+async def test_flapping_check_is_damped_then_restored():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    # T,F,T,F -> 3 flips -> flapping; then four Ts calm it back down
+    engine = scripted_engine(
+        [(1, True), (1, False)] * 2 + [(1, True)] * 4
+    )
+    reconciler = build_reconciler(engine, clock, metrics)
+    client = reconciler.client
+    await client.apply(make_hc("hc-flap", repeat=60))
+    key = "health/hc-flap"
+
+    async def run_one(first=False, cadence=60.0):
+        if not first:
+            await clock.advance(cadence)
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+
+    await reconciler.reconcile("health", "hc-flap")
+    await run_one(first=True)
+    for _ in range(3):
+        await run_one()
+    # four verdicts in: T,F,T,F -> flapping, damped 2x
+    assert reconciler.resilience.checks.state(key) == STATE_FLAPPING
+    stored = await client.get("health", "hc-flap")
+    assert stored.status.state == STATE_FLAPPING
+    assert metrics.sample_value(
+        "healthcheck_check_state",
+        {"healthcheck_name": "hc-flap", "namespace": "health", "state": "flapping"},
+    ) == 1.0
+    hc = await client.get("health", "hc-flap")
+    assert reconciler._effective_repeat_after(hc) == 120
+    assert any(
+        "flapping" in e.message
+        for e in reconciler.recorder.events_for("health", "hc-flap")
+    )
+
+    # damping is real: 60s (the raw cadence) does NOT fire the next run
+    submitted_before = len(engine.submitted)
+    await clock.advance(60.0)
+    await settle()
+    assert len(engine.submitted) == submitted_before
+    # ...the damped 120s does
+    await clock.advance(60.0)
+    await settle()
+    await clock.advance(1.0)
+    await settle()
+    assert len(engine.submitted) == submitted_before + 1
+
+    # three more calm runs at the damped cadence restore the schedule
+    for _ in range(3):
+        await run_one(cadence=120.0)
+    assert reconciler.resilience.checks.state(key) == STATE_HEALTHY
+    stored = await client.get("health", "hc-flap")
+    assert stored.status.state == ""
+    assert reconciler._effective_repeat_after(stored) == 60
+    assert any(
+        "stabilized" in e.message
+        for e in reconciler.recorder.events_for("health", "hc-flap")
+    )
+    await reconciler.shutdown()
+
+
+# ---------------------------------------------------------------------
+# reconciler: remedy storm control (the acceptance slice)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_fleet_remedy_cap_suppresses_then_admits_after_refill():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    engine = FakeWorkflowEngine()
+    from activemonitor_tpu.engine.fake import fail_after, succeed_after
+
+    # every healthcheck workflow fails on its first poll; every remedy
+    # workflow succeeds on its first poll
+    engine._default_completer = fail_after(1)
+    engine.on_prefix("remedy-", succeed_after(1))
+    reconciler = build_reconciler(engine, clock, metrics)
+    reconciler.resilience.configure_remedy_rate(1.0)  # 1/min, burst 1
+    client = reconciler.client
+
+    # hc-a's failure consumes the only token; its remedy runs
+    await client.apply(make_hc("hc-a", repeat=600, remedy_prefix="remedy-a-"))
+    await reconciler.reconcile("health", "hc-a")
+    await reconciler.wait_watches()
+    assert metrics.sample_value(
+        "healthcheck_remedy_runs_total",
+        {"healthcheck_name": "hc-a", "namespace": "health", "result": "admitted"},
+    ) == 1.0
+    assert any(
+        w["metadata"]["name"].startswith("remedy-a-")
+        for w in engine.submitted
+    )
+
+    # hc-b fails with the bucket dry: remedy suppressed, evented, counted
+    await client.apply(make_hc("hc-b", repeat=60, remedy_prefix="remedy-b-"))
+    await reconciler.reconcile("health", "hc-b")
+    await reconciler.wait_watches()
+    assert metrics.sample_value(
+        "healthcheck_remedy_runs_total",
+        {"healthcheck_name": "hc-b", "namespace": "health", "result": "suppressed"},
+    ) == 1.0
+    assert not any(
+        w["metadata"]["name"].startswith("remedy-b-")
+        for w in engine.submitted
+    )
+    assert any(
+        "Remedy suppressed by the fleet-wide remedy rate cap" in e.message
+        for e in reconciler.recorder.events_for("health", "hc-b")
+    )
+    stored = await client.get("health", "hc-b")
+    assert stored.status.remedy_total_runs == 0
+
+    # after refill, hc-b's next failing run gets its remedy admitted
+    await clock.advance(60.0)  # refills the bucket AND fires hc-b's timer
+    await settle()
+    await clock.advance(1.0)
+    await settle()
+    await reconciler.wait_watches()
+    assert metrics.sample_value(
+        "healthcheck_remedy_runs_total",
+        {"healthcheck_name": "hc-b", "namespace": "health", "result": "admitted"},
+    ) == 1.0
+    assert any(
+        w["metadata"]["name"].startswith("remedy-b-")
+        for w in engine.submitted
+    )
+    stored = await client.get("health", "hc-b")
+    assert stored.status.remedy_success_count == 1
+    await reconciler.shutdown()
+
+
+# ---------------------------------------------------------------------
+# reconciler: degraded-mode status-write queue + replay
+# ---------------------------------------------------------------------
+
+
+class FlakyStatusClient:
+    """Delegates to an InMemory client but fails the next N status
+    writes with a transient 503 — the write-storm shape that trips the
+    breaker and exercises the replay queue."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.fail_status = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def update_status(self, hc):
+        if self.fail_status > 0:
+            self.fail_status -= 1
+            raise Transient("injected status-write 503")
+        return await self._inner.update_status(hc)
+
+
+@pytest.mark.asyncio
+async def test_status_write_queues_while_degraded_and_replays_on_recovery():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    engine = scripted_engine([(1, True)])
+    client = FlakyStatusClient(InMemoryHealthCheckClient())
+    breaker = CircuitBreaker(
+        "api", clock=clock, failure_threshold=1, recovery_seconds=30.0
+    )
+    resilience = ResilienceCoordinator(
+        clock, metrics, breaker=breaker, rng=random.Random(7)
+    )
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+        resilience=resilience,
+    )
+    await client.apply(make_hc("hc-queue", repeat=60))
+    key = "health/hc-queue"
+
+    # the run completes, but every status-write attempt 503s: the
+    # exhausted ladder trips the breaker (threshold 1) and the write is
+    # parked instead of crashing the cycle
+    client.fail_status = 10
+    await reconciler.reconcile("health", "hc-queue")
+    await settle()
+    await clock.advance(1.0)  # terminal poll
+    # the transient-retry ladder sleeps ~7.75s on the clock
+    for _ in range(10):
+        await clock.advance(1.0)
+        await settle()
+    await reconciler.wait_watches()
+
+    assert resilience.pending_status_writes() == 1
+    assert resilience.degraded
+    assert metrics.sample_value("healthcheck_controller_degraded", {}) == 1.0
+    stored = await client.get("health", "hc-queue")
+    assert stored.status.success_count == 0  # nothing landed durably
+    assert resilience.queued_status(key).success_count == 1  # parked
+    assert len(engine.submitted) == 1
+    # the cadence survived: the next run is on the books
+    assert reconciler.timers.exists(key)
+
+    # a watch-event reconcile while the write is parked must NOT
+    # double-submit: the queued status overlays the stale durable one
+    await reconciler.reconcile("health", "hc-queue")
+    assert len(engine.submitted) == 1
+
+    # recovery: the open window elapses, the transport heals, and the
+    # replay sweep lands the parked write and closes the breaker
+    client.fail_status = 0
+    await clock.advance(31.0)
+    replayed = await reconciler.replay_status_writes()
+    assert replayed == 1
+    assert resilience.pending_status_writes() == 0
+    assert not resilience.degraded
+    resilience.refresh()
+    assert metrics.sample_value("healthcheck_controller_degraded", {}) == 0.0
+    assert metrics.sample_value("healthcheck_status_write_queue_depth", {}) == 0.0
+    stored = await client.get("health", "hc-queue")
+    assert stored.status.success_count == 1
+    assert len(engine.submitted) == 1  # still exactly one workflow
+    await reconciler.shutdown()
+
+
+def test_breaker_exemption_is_scoped_to_the_coordination_group():
+    """Only coordination.k8s.io lease writes bypass the gate — a CR
+    that happens to be NAMED 'leases' must not slip through."""
+    from activemonitor_tpu.kube.client import _breaker_exempt
+
+    assert _breaker_exempt(
+        "/apis/coordination.k8s.io/v1/namespaces/health/leases/am-leader"
+    )
+    assert _breaker_exempt("/apis/coordination.k8s.io/v1/namespaces/x/leases")
+    assert not _breaker_exempt(
+        "/apis/activemonitor.keikoproj.io/v1alpha1/namespaces/ns/"
+        "healthchecks/leases/status"
+    )
+    assert not _breaker_exempt("/api/v1/namespaces/leases/events")
+
+
+@pytest.mark.asyncio
+async def test_cluster_status_write_moves_fields_back_to_defaults():
+    """The status MERGE patch must state every field explicitly: a
+    cleared Quarantined mark, an emptied errorMessage, and a remedy
+    reset (zeroed counters, nulled timestamps) all have to LAND — an
+    exclude-defaults dump can never move a field back to its default
+    through a merge patch."""
+    from tests.kube_harness import stub_env
+    from activemonitor_tpu.controller.client_k8s import (
+        KubernetesHealthCheckClient,
+    )
+
+    async with stub_env() as (_server, api):
+        client = KubernetesHealthCheckClient(api)
+        hc = make_hc("sticky")
+        applied = await client.apply(hc)
+        applied.status.state = STATE_QUARANTINED
+        applied.status.error_message = "quarantined: broken"
+        applied.status.remedy_total_runs = 3
+        applied.status.remedy_success_count = 3
+        import datetime
+
+        applied.status.remedy_finished_at = datetime.datetime.now(
+            datetime.timezone.utc
+        )
+        written = await client.update_status(applied)
+        assert written.status.state == STATE_QUARANTINED
+        # now clear the mark and reset the remedy, like the reconciler
+        written.status.state = ""
+        written.status.error_message = ""
+        written.status.reset_remedy("HealthCheck Passed so Remedy is reset")
+        cleared = await client.update_status(written)
+        assert cleared.status.state == ""
+        assert cleared.status.error_message == ""
+        assert cleared.status.remedy_total_runs == 0
+        assert cleared.status.remedy_success_count == 0
+        assert cleared.status.remedy_finished_at is None
+        # and a fresh read agrees (nothing stuck server-side)
+        fresh = await client.get("health", "sticky")
+        assert fresh.status.state == ""
+        assert fresh.status.remedy_total_runs == 0
+        assert fresh.status.remedy_finished_at is None
+
+
+@pytest.mark.asyncio
+async def test_engine_submit_is_gated_while_breaker_open():
+    clock = FakeClock()
+    engine = FakeWorkflowEngine()
+    reconciler = build_reconciler(engine, clock)
+    for _ in range(5):
+        reconciler.resilience.breaker.observe(Transient())
+    await reconciler.client.apply(make_hc("hc-gate"))
+    delay = await reconciler.reconcile("health", "hc-gate")
+    # rejected fast, no workflow created, requeued on the stretched ladder
+    assert engine.submitted == []
+    assert delay is not None and delay >= 1.0
+    await reconciler.shutdown()

@@ -331,11 +331,19 @@ FLEET_FIELDS = {
     "window_runs": int,
     "goodput_ratio": (int, float, type(None)),
     "generated_at": str,
+    # resilience block (ISSUE 3): degraded mode, breaker verdict,
+    # replay backlog, fleet-wide remedy budget
+    "degraded": bool,
+    "breaker": (dict, type(None)),
+    "status_writes_queued": int,
+    "remedy_tokens": (int, float, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,
     "healthcheck": str,
     "namespace": str,
+    "state": str,  # healthy | flapping | quarantined
+    "remedy_budget_remaining": (int, type(None)),
     "last_status": str,
     "last_trace_id": str,
     "runs_recorded": int,
@@ -364,6 +372,13 @@ HISTORY_FIELDS = {
     "latency_seconds": (int, float),
     "workflow": str,
     "trace_id": str,
+}
+BREAKER_FIELDS = {
+    "name": str,
+    "state": str,
+    "recent_failures": int,
+    "retry_after_seconds": (int, float),
+    "trips": int,
 }
 
 
@@ -399,6 +414,23 @@ def test_statusz_schema_contract():
     assert slo_check["history"][-1]["workflow"] == "wf-2"
     assert payload["checks"][1]["slo"] is None
     assert payload["checks"][1]["window"]["seconds"] == DEFAULT_WINDOW_SECONDS
+    # standalone FleetStatus (no coordinator): a healthy controller
+    assert payload["fleet"]["degraded"] is False
+    assert payload["fleet"]["breaker"] is None
+    for check in payload["checks"]:
+        assert check["state"] == "healthy"
+        assert check["remedy_budget_remaining"] is None
+    # with the reconciler's coordinator attached, the fleet block
+    # carries the breaker snapshot and the fleet remedy budget
+    from activemonitor_tpu.resilience import ResilienceCoordinator
+
+    fleet.resilience = ResilienceCoordinator(clock, None, remedy_rate=2.0)
+    payload = json.loads(json.dumps(fleet.statusz([with_slo, without])))
+    assert_schema(payload["fleet"], FLEET_FIELDS, "fleet")
+    assert_schema(payload["fleet"]["breaker"], BREAKER_FIELDS, "breaker")
+    assert payload["fleet"]["degraded"] is False
+    assert payload["fleet"]["remedy_tokens"] == 2.0
+    assert payload["fleet"]["status_writes_queued"] == 0
 
 
 def test_statusz_history_is_a_bounded_tail():
@@ -707,8 +739,8 @@ def test_render_status_table_shapes_rows():
     assert "goodput=50.0%" in lines[0]
     header, row = lines[1], lines[2]
     assert header.split() == [
-        "NAME", "NAMESPACE", "STATUS", "RUNS", "AVAIL",
-        "P50", "P95", "P99", "BUDGET", "BURN", "LAST", "TRACE",
+        "NAME", "NAMESPACE", "STATUS", "STATE", "RUNS", "AVAIL",
+        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST", "TRACE",
     ]
     cells = row.split()
     assert cells[0] == "hc-slo"
