@@ -595,13 +595,14 @@ def render_status_table(payload: dict) -> str:
         fleet_line += f"  remedy_tokens={fleet['remedy_tokens']:.1f}"
     lines = [fleet_line]
     headers = [
-        "NAME", "NAMESPACE", "STATUS", "STATE", "RUNS", "AVAIL",
+        "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
         "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST TRACE",
     ]
     rows = []
     for check in payload.get("checks") or []:
         window = check.get("window") or {}
         slo = check.get("slo")
+        analysis = check.get("analysis")
         remedy_budget = check.get("remedy_budget_remaining")
         rows.append(
             [
@@ -609,6 +610,9 @@ def render_status_table(payload: dict) -> str:
                 check.get("namespace", ""),
                 check.get("last_status", "") or "-",
                 check.get("state", "") or "healthy",
+                # baseline-analysis verdict; "-" when the check declares
+                # no analysis: block
+                (analysis or {}).get("state") or "-",
                 str(window.get("results", 0)),
                 _fmt_ratio(window.get("availability")),
                 _fmt_seconds(window.get("p50_seconds")),

@@ -1,0 +1,65 @@
+"""Baseline & anomaly detection: numeric probe metrics → degradation
+verdicts.
+
+The controller's pass/fail verdict only fires when a probe crosses its
+own hard threshold; a slice that creeps from 90 % to 60 % of rated
+TFLOPs while staying above the probe's floor never trips anything. This
+package closes that gap (the ML-Productivity-Goodput / ReFrame framing
+from PAPERS.md: the signal is a run *compared against a learned
+baseline*, not the point reading):
+
+- :mod:`baseline` — per-(check, metric) rolling statistics (Welford +
+  EWMA + median/MAD over a bounded recent ring), compactly serializable
+  into ``.status.analysis`` so baselines survive controller restarts;
+- :mod:`detector` — pluggable detectors (robust z-score,
+  relative-to-rated, trend/slope) producing ``ok | warning | degraded``
+  per metric, plus the hysteresis state machine that keeps one noisy
+  run from flapping the verdict;
+- :mod:`fleet` — cross-check straggler ranking over checks sharing a
+  ``spec.analysis.cohort`` label;
+- :mod:`engine` — the reconciler-owned façade wiring the three
+  together: feeds run samples, persists/adopts durable baselines,
+  exports the ``healthcheck_metric_baseline`` / ``_metric_zscore`` /
+  ``_anomaly_state`` families, and reports into ``/statusz``.
+"""
+
+from activemonitor_tpu.analysis.baseline import (
+    BASELINE_STATS,
+    CheckBaselines,
+    MetricBaseline,
+)
+from activemonitor_tpu.analysis.detector import (
+    ANOMALY_STATES,
+    DetectorConfig,
+    Hysteresis,
+    LEVEL_DEGRADED,
+    LEVEL_OK,
+    LEVEL_WARNING,
+    RatedFractionDetector,
+    RobustZScoreDetector,
+    TrendDetector,
+    default_detectors,
+    level_name,
+)
+from activemonitor_tpu.analysis.engine import AnalysisEngine, AnalysisVerdict
+from activemonitor_tpu.analysis.fleet import CohortIndex
+
+__all__ = [
+    "ANOMALY_STATES",
+    "AnalysisEngine",
+    "AnalysisVerdict",
+    "BASELINE_STATS",
+    "CheckBaselines",
+    "CohortIndex",
+    "DetectorConfig",
+    "Hysteresis",
+    "LEVEL_DEGRADED",
+    "LEVEL_OK",
+    "LEVEL_WARNING",
+    "MetricBaseline",
+    "RatedFractionDetector",
+    "RobustZScoreDetector",
+    "TrendDetector",
+    "default_detectors",
+    "level_name",
+]

@@ -1,0 +1,210 @@
+"""Per-(check, metric) rolling baselines.
+
+Three estimators per metric, each covering the others' blind spot:
+
+- **Welford** (count/mean/M2): numerically-stable lifetime mean and
+  variance in O(1) memory — the long-run anchor.
+- **EWMA** (``alpha`` = :data:`EWMA_ALPHA`): a recency-weighted level
+  so dashboards can see where the metric is *heading*.
+- **median/MAD over a bounded recent ring**: the robust center and
+  scale the z-score detector divides by — one wild outlier moves a
+  mean/std pair but barely moves median/MAD, so the detector keeps
+  judging subsequent runs against a sane baseline.
+
+Serialization is deliberately compact (:meth:`MetricBaseline.to_dict`
+rounds to 6 significant digits): the whole per-check baseline set is
+persisted into ``.status.analysis`` on every status write and replayed
+through the merge-patch path, so it must stay a few hundred bytes, not
+a history dump. :meth:`CheckBaselines.from_status` is defensive — a
+corrupt or hand-edited blob yields a fresh baseline, never a crash in
+the reconcile path.
+
+The set is stamped on the injectable Clock (``updated_at`` rides the
+durable blob) so fake-clock tests pin exact timestamps.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from activemonitor_tpu.utils.clock import Clock
+
+# recent-ring length: enough for a stable median/MAD and the trend
+# window, small enough that the serialized blob stays compact
+RECENT_WINDOW = 32
+
+EWMA_ALPHA = 0.2
+
+# the MAD of a constant series is 0 and its std is 0 — a baseline fed
+# identical readings (FakeEngine scripts, quantized counters) needs a
+# floor or the first deviation divides by zero. Relative to the center
+# so the floor scales with the metric's magnitude.
+RELATIVE_SCALE_FLOOR = 0.05
+ABSOLUTE_SCALE_FLOOR = 1e-9
+
+# consistency constant: MAD * 1.4826 estimates the std of a normal
+MAD_TO_SIGMA = 1.4826
+
+# stat labels of the healthcheck_metric_baseline{stat=} family
+BASELINE_STATS = ("mean", "std", "median", "mad", "count")
+
+
+def _compact(value: float) -> float:
+    """6 significant digits — keeps the serialized blob small without
+    moving any z-score that matters."""
+    if not math.isfinite(value):
+        return 0.0
+    return float(f"{value:.6g}")
+
+
+class MetricBaseline:
+    """Rolling statistics for one (check, metric) pair."""
+
+    __slots__ = ("n", "mean", "m2", "ewma", "recent")
+
+    def __init__(self) -> None:
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+        self.ewma = 0.0
+        self.recent: Deque[float] = deque(maxlen=RECENT_WINDOW)
+
+    # -- updates --------------------------------------------------------
+    def observe(self, value: float) -> None:
+        value = float(value)
+        if not math.isfinite(value):
+            return  # NaN/inf must never poison the accumulators
+        self.n += 1
+        delta = value - self.mean
+        self.mean += delta / self.n
+        self.m2 += delta * (value - self.mean)
+        self.ewma = (
+            value if self.n == 1 else EWMA_ALPHA * value + (1 - EWMA_ALPHA) * self.ewma
+        )
+        self.recent.append(value)
+
+    # -- statistics -----------------------------------------------------
+    @property
+    def std(self) -> float:
+        if self.n < 2:
+            return 0.0
+        return math.sqrt(max(0.0, self.m2 / (self.n - 1)))
+
+    @property
+    def median(self) -> float:
+        if not self.recent:
+            return self.mean
+        return statistics.median(self.recent)
+
+    @property
+    def mad(self) -> float:
+        if not self.recent:
+            return 0.0
+        center = self.median
+        return statistics.median(abs(v - center) for v in self.recent)
+
+    def scale(self) -> float:
+        """The denominator for robust z-scores: MAD-derived sigma when
+        the ring has spread; a zero MAD with a non-empty ring means the
+        distribution is CONCENTRATED (most samples equal the median),
+        so the relative floor applies — falling back to the lifetime
+        std there would let one past outlier inflate the scale and mask
+        the next one. The std is the fallback only for a baseline
+        restored without its recent ring."""
+        center = abs(self.median) or abs(self.mean)
+        floor = max(ABSOLUTE_SCALE_FLOOR, RELATIVE_SCALE_FLOOR * center)
+        robust = MAD_TO_SIGMA * self.mad
+        if robust > 0:
+            return max(floor, robust)
+        if self.recent:
+            return floor
+        return max(floor, self.std)
+
+    def zscore(self, value: float) -> float:
+        """Robust z of a NEW sample against the CURRENT baseline (call
+        before :meth:`observe`, or every sample judges itself)."""
+        return (float(value) - self.median) / self.scale()
+
+    # -- persistence ----------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "n": self.n,
+            "mean": _compact(self.mean),
+            "m2": _compact(self.m2),
+            "ewma": _compact(self.ewma),
+            "recent": [_compact(v) for v in self.recent],
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "MetricBaseline":
+        baseline = cls()
+        baseline.n = max(0, int(data.get("n", 0)))
+        baseline.mean = float(data.get("mean", 0.0))
+        baseline.m2 = max(0.0, float(data.get("m2", 0.0)))
+        baseline.ewma = float(data.get("ewma", 0.0))
+        for value in list(data.get("recent") or [])[-RECENT_WINDOW:]:
+            baseline.recent.append(float(value))
+        return baseline
+
+
+class CheckBaselines:
+    """All of one check's metric baselines plus the warm-up gate."""
+
+    def __init__(self, clock: Optional[Clock] = None, warmup_runs: int = 5):
+        self.clock = clock or Clock()
+        self.warmup_runs = max(1, warmup_runs)
+        self._metrics: Dict[str, MetricBaseline] = {}
+        self.updated_at = None
+
+    def baseline(self, metric: str) -> MetricBaseline:
+        baseline = self._metrics.get(metric)
+        if baseline is None:
+            baseline = self._metrics[metric] = MetricBaseline()
+        return baseline
+
+    def peek(self, metric: str) -> Optional[MetricBaseline]:
+        return self._metrics.get(metric)
+
+    def observe(self, metric: str, value: float) -> MetricBaseline:
+        baseline = self.baseline(metric)
+        baseline.observe(value)
+        self.updated_at = self.clock.now()
+        return baseline
+
+    def warmed(self, metric: str) -> bool:
+        """Warm-up gate: statistical detectors stay silent until the
+        baseline has seen ``warmup_runs`` samples — judging run 2
+        against a baseline of run 1 manufactures anomalies."""
+        baseline = self._metrics.get(metric)
+        return baseline is not None and baseline.n >= self.warmup_runs
+
+    def metrics(self) -> List[str]:
+        return list(self._metrics.keys())
+
+    # -- persistence ----------------------------------------------------
+    def to_dict(self) -> dict:
+        doc = {
+            name: baseline.to_dict() for name, baseline in self._metrics.items()
+        }
+        return doc
+
+    @classmethod
+    def from_dict(
+        cls, data: dict, clock: Optional[Clock] = None, warmup_runs: int = 5
+    ) -> "CheckBaselines":
+        """Defensive restore: any malformed metric entry is dropped (a
+        hand-edited status must never crash the reconcile path)."""
+        baselines = cls(clock, warmup_runs)
+        if not isinstance(data, dict):
+            return baselines
+        for name, entry in data.items():
+            if not isinstance(name, str) or not isinstance(entry, dict):
+                continue
+            try:
+                baselines._metrics[name] = MetricBaseline.from_dict(entry)
+            except (TypeError, ValueError):
+                continue
+        return baselines

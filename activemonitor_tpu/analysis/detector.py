@@ -1,0 +1,225 @@
+"""Pluggable degradation detectors + the anti-flap hysteresis machine.
+
+Every detector maps one sample (plus its metric's baseline) to a raw
+level — ``ok`` (0), ``warning`` (1), ``degraded`` (2) — or ``None``
+when it has no opinion about that metric. The worst raw level across
+detectors is fed to :class:`Hysteresis`, which owns the REPORTED state:
+raw levels are per-run evidence, the reported state only moves after
+``confirm_runs`` consecutive runs of evidence (and only one step per
+run), so a single noisy run can never flip a check to degraded — the
+ReFrame lesson (PAPERS.md): regression alarms that fire on point noise
+get muted, alarms that fire on confirmed drift get fixed.
+
+Detectors:
+
+- :class:`RobustZScoreDetector` — |robust z| against the baseline's
+  median/MAD scale; warm-up gated (the engine only consults it once the
+  baseline has ``warmupRuns`` samples).
+- :class:`RatedFractionDetector` — probes already divide by the rated
+  spec tables (probes/rated.py), exporting ``*-fraction-of-rated``
+  gauges; those are ABSOLUTE health fractions, comparable on run one,
+  so this detector is not warm-up gated: a slice delivering 60 % of
+  rated is degraded even if it has always delivered 60 %.
+- :class:`TrendDetector` — least-squares slope over the recent ring,
+  normalized by the center: catches the slow creep that stays inside
+  the z-score band run over run but drifts far over the window.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from activemonitor_tpu.analysis.baseline import MetricBaseline
+
+LEVEL_OK = 0
+LEVEL_WARNING = 1
+LEVEL_DEGRADED = 2
+
+# reported-state vocabulary: label values of healthcheck_anomaly_state
+# and the /statusz analysis block (lowercase like the check_state trio)
+ANOMALY_STATES = ("ok", "warning", "degraded")
+
+
+def level_name(level: int) -> str:
+    return ANOMALY_STATES[max(LEVEL_OK, min(LEVEL_DEGRADED, level))]
+
+
+@dataclass(frozen=True)
+class DetectorConfig:
+    """Per-check tuning, built from ``spec.analysis``."""
+
+    z_threshold: float = 3.0  # |z| >= this -> warning; >= 2x -> degraded
+    rated_warn: float = 0.85  # fraction-of-rated below this -> warning
+    rated_degraded: float = 0.70  # ... below this -> degraded
+    trend_min_samples: int = 8  # slope fits need a real window
+    trend_warn: float = 0.10  # |relative drift across window| >= -> warning
+    trend_degraded: float = 0.25
+
+
+class RobustZScoreDetector:
+    """Deviation of THIS sample from the learned center, in robust
+    sigmas. Symmetric on purpose: a metric suddenly reading far above
+    baseline (a broken timer, a dropped denominator) is as anomalous as
+    one far below."""
+
+    name = "zscore"
+    needs_baseline = True
+
+    def evaluate(
+        self, metric: str, value: float, baseline: MetricBaseline, config: DetectorConfig
+    ) -> Optional[int]:
+        z = abs(baseline.zscore(value))
+        if z >= 2 * config.z_threshold:
+            return LEVEL_DEGRADED
+        if z >= config.z_threshold:
+            return LEVEL_WARNING
+        return LEVEL_OK
+
+
+def is_rated_fraction_metric(metric: str) -> bool:
+    """The probes' rated-comparison gauges (docs/probes.md metric
+    table) all carry the ``fraction-of-rated`` suffix — the contract
+    names use dashes, the exported series underscores; accept both."""
+    return "fraction_of_rated" in metric.replace("-", "_")
+
+
+class RatedFractionDetector:
+    """Absolute floor for ``*-fraction-of-rated`` metrics: the rated
+    tables (probes/rated.py) are the denominator the probe already
+    applied, so the value IS health — no baseline needed, which also
+    means no warm-up blindness for an always-sick slice."""
+
+    name = "rated"
+    needs_baseline = False
+
+    def evaluate(
+        self, metric: str, value: float, baseline: Optional[MetricBaseline], config: DetectorConfig
+    ) -> Optional[int]:
+        if not is_rated_fraction_metric(metric):
+            return None
+        if value < config.rated_degraded:
+            return LEVEL_DEGRADED
+        if value < config.rated_warn:
+            return LEVEL_WARNING
+        return LEVEL_OK
+
+
+def slope(values: Sequence[float]) -> float:
+    """Least-squares slope per run index over ``values``."""
+    n = len(values)
+    if n < 2:
+        return 0.0
+    mean_x = (n - 1) / 2.0
+    mean_y = sum(values) / n
+    num = sum((i - mean_x) * (v - mean_y) for i, v in enumerate(values))
+    den = sum((i - mean_x) ** 2 for i in range(n))
+    return num / den if den else 0.0
+
+
+class TrendDetector:
+    """Relative drift across the recent window: ``slope * (n-1)``
+    (total drift the fit attributes to the window) over the center's
+    magnitude. Catches creep the z-score misses because each step stays
+    inside the noise band."""
+
+    name = "trend"
+    needs_baseline = True
+
+    def evaluate(
+        self, metric: str, value: float, baseline: MetricBaseline, config: DetectorConfig
+    ) -> Optional[int]:
+        window: List[float] = list(baseline.recent) + [float(value)]
+        if len(window) < max(2, config.trend_min_samples):
+            return None
+        center = abs(baseline.median) or abs(baseline.mean)
+        if center <= 0:
+            return None
+        drift = abs(slope(window) * (len(window) - 1)) / center
+        if drift >= config.trend_degraded:
+            return LEVEL_DEGRADED
+        if drift >= config.trend_warn:
+            return LEVEL_WARNING
+        return LEVEL_OK
+
+
+def default_detectors() -> tuple:
+    return (RatedFractionDetector(), RobustZScoreDetector(), TrendDetector())
+
+
+class Hysteresis:
+    """The reported anomaly state for one (check, metric).
+
+    Raw detector levels are evidence; the state only escalates after
+    ``confirm_runs`` consecutive runs whose raw level exceeds it, only
+    de-escalates after ``calm_runs`` consecutive runs below it, and
+    moves ONE step per transition (ok → warning → degraded and back) —
+    so a single outlier run changes nothing, and recovery is as
+    deliberate as escalation. Streaks reset on every transition."""
+
+    __slots__ = ("level", "up_streak", "down_streak", "confirm_runs", "calm_runs")
+
+    def __init__(self, confirm_runs: int = 2, calm_runs: int = 3):
+        self.level = LEVEL_OK
+        self.up_streak = 0
+        self.down_streak = 0
+        self.confirm_runs = max(1, confirm_runs)
+        self.calm_runs = max(1, calm_runs)
+
+    def update(self, raw_level: int) -> Optional[Tuple[int, int]]:
+        """Feed one run's raw level; returns ``(old, new)`` on a state
+        transition, else None."""
+        raw_level = max(LEVEL_OK, min(LEVEL_DEGRADED, int(raw_level)))
+        if raw_level > self.level:
+            self.up_streak += 1
+            self.down_streak = 0
+            if self.up_streak >= self.confirm_runs:
+                old = self.level
+                self.level += 1
+                self.up_streak = 0
+                return (old, self.level)
+        elif raw_level < self.level:
+            self.down_streak += 1
+            self.up_streak = 0
+            if self.down_streak >= self.calm_runs:
+                old = self.level
+                self.level -= 1
+                self.down_streak = 0
+                return (old, self.level)
+        else:
+            self.up_streak = 0
+            self.down_streak = 0
+        return None
+
+    # -- persistence (rides .status.analysis) ---------------------------
+    def to_dict(self) -> dict:
+        return {"level": self.level, "up": self.up_streak, "down": self.down_streak}
+
+    @classmethod
+    def from_dict(
+        cls, data: dict, confirm_runs: int = 2, calm_runs: int = 3
+    ) -> "Hysteresis":
+        state = cls(confirm_runs, calm_runs)
+        try:
+            state.level = max(LEVEL_OK, min(LEVEL_DEGRADED, int(data.get("level", 0))))
+            state.up_streak = max(0, int(data.get("up", 0)))
+            state.down_streak = max(0, int(data.get("down", 0)))
+        except (TypeError, ValueError):
+            return cls(confirm_runs, calm_runs)
+        return state
+
+
+def combine_raw_levels(levels: Sequence[Optional[int]]) -> int:
+    """Worst opinion wins; detectors with no opinion abstain."""
+    voted = [lvl for lvl in levels if lvl is not None]
+    return max(voted) if voted else LEVEL_OK
+
+
+def finite(value) -> Optional[float]:
+    """A float usable for analysis, or None (NaN/inf/garbage)."""
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if math.isfinite(value) else None

@@ -1,0 +1,397 @@
+"""AnalysisEngine — the reconciler-owned anomaly-detection façade.
+
+Owned by the reconciler the same way it owns the tracer, the fleet SLO
+aggregate, and the resilience coordinator. One call per finished run
+(:meth:`observe`) does everything the subsystem promises:
+
+- filters the run's numeric samples through ``spec.analysis.metrics[]``;
+- updates the per-(check, metric) baselines (baseline.py) — warm-up
+  samples always feed the baseline; after warm-up, samples whose raw
+  level is anomalous are QUARANTINED from it, so a degraded regime
+  cannot teach the baseline that sick is the new normal (the alarm
+  would otherwise clear itself in one window);
+- runs the detector chain (detector.py) and the per-metric hysteresis,
+  then reports the check's anomaly state as the WORST metric's state;
+- exports ``healthcheck_metric_baseline{stat=}``,
+  ``healthcheck_metric_zscore`` and the lazy one-hot
+  ``healthcheck_anomaly_state``;
+- feeds cohort values into the straggler index (fleet.py);
+- serializes the whole thing into ``hc.status.analysis`` so it rides
+  the very status write that records the run — baselines survive
+  controller restarts through the existing merge-patch path, and
+  :meth:`observe` adopts a durable blob the first time it sees a check.
+
+Never raises into the reconcile path: like the SLO recorder, analysis
+is observability + policy input, and a bug here must not fail the
+status write that feeds it. The reconciler consumes the returned
+:class:`AnalysisVerdict` for events, flap-tracker damping, and the
+``triggerOnDegraded`` remedy gate.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from activemonitor_tpu.analysis.baseline import CheckBaselines
+from activemonitor_tpu.analysis.detector import (
+    DetectorConfig,
+    Hysteresis,
+    LEVEL_OK,
+    combine_raw_levels,
+    default_detectors,
+    finite,
+    level_name,
+)
+from activemonitor_tpu.analysis.fleet import CohortIndex
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.analysis")
+
+# schedule damping while a check's metrics are confirmed-degraded: the
+# same containment shape as flap damping (resilience/health.py) — a
+# degraded slice burns probe budget at half cadence until it recovers
+DEGRADED_DAMP_FACTOR = 2.0
+
+STATUS_VERSION = 1
+
+
+def analysis_spec(hc) -> Optional[object]:
+    """The spec's ``analysis:`` block, or None when the check has not
+    opted in (absent block ⇒ the subsystem is inert for the check)."""
+    return getattr(hc.spec, "analysis", None)
+
+
+def _config_from_spec(spec) -> DetectorConfig:
+    z = float(getattr(spec, "z_threshold", 0.0) or 0.0)
+    return DetectorConfig(z_threshold=z) if z > 0 else DetectorConfig()
+
+
+@dataclass(frozen=True)
+class AnalysisVerdict:
+    """One run's analysis outcome, for the reconciler to act on."""
+
+    state: str  # ok | warning | degraded (post-hysteresis, worst metric)
+    transition: Optional[Tuple[str, str]] = None  # (old, new) on change
+    metric_transitions: List[Tuple[str, str, str]] = field(default_factory=list)
+    zscores: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def degraded(self) -> bool:
+        return self.state == "degraded"
+
+
+class _CheckAnalysis:
+    """One check's live analysis state."""
+
+    __slots__ = (
+        "baselines",
+        "hysteresis",
+        "last_values",
+        "last_zscores",
+        "last_run_id",
+        "name",
+        "namespace",
+    )
+
+    def __init__(self, baselines: CheckBaselines):
+        self.baselines = baselines
+        self.hysteresis: Dict[str, Hysteresis] = {}
+        self.last_values: Dict[str, float] = {}
+        # the z each metric's LAST sample scored against the baseline
+        # of its time (None pre-warm-up) — kept so /statusz reports the
+        # same number the zscore gauge exported, instead of recomputing
+        # against a baseline the sample itself may have since updated
+        self.last_zscores: Dict[str, Optional[float]] = {}
+        self.last_run_id = ""
+        self.name = ""
+        self.namespace = ""
+
+    @property
+    def level(self) -> int:
+        """Check-wide anomaly level: the worst metric's reported state."""
+        if not self.hysteresis:
+            return LEVEL_OK
+        return max(state.level for state in self.hysteresis.values())
+
+
+class AnalysisEngine:
+    def __init__(self, clock: Optional[Clock] = None, metrics=None):
+        self.clock = clock or Clock()
+        self.metrics = metrics
+        self.detectors = default_detectors()
+        self.cohorts = CohortIndex()
+        self._checks: Dict[str, _CheckAnalysis] = {}
+
+    # -- recording (reconciler status-write path) -----------------------
+    def observe(
+        self,
+        hc,
+        samples: Dict[str, float],
+        *,
+        ok: bool,
+        run_id: str = "",
+    ) -> Optional[AnalysisVerdict]:
+        try:
+            return self._observe(hc, samples, ok=ok, run_id=run_id)
+        except Exception:
+            # analysis must not fail the status write that feeds it
+            log.exception("analysis failed for %s", getattr(hc, "key", "?"))
+            return None
+
+    def _observe(
+        self, hc, samples: Dict[str, float], *, ok: bool, run_id: str
+    ) -> Optional[AnalysisVerdict]:
+        spec = analysis_spec(hc)
+        key = hc.key
+        if spec is None:
+            # the analysis: block was edited off a live check (or never
+            # existed): drop state and series, stop advertising verdicts
+            if key in self._checks:
+                self.forget(key, hc.metadata.name, hc.metadata.namespace)
+            if getattr(hc.status, "analysis", None) is not None:
+                # a durable blob from before the removal (possibly from
+                # a previous incarnation — no live state needed) must
+                # not keep advertising a verdict nobody computes; None
+                # rides the pending write and merge-patch deletes it
+                hc.status.analysis = None
+            return None
+        rec = self._ensure(hc, spec)
+        if run_id and rec.last_run_id == run_id:
+            # the same workflow run replayed through a second path must
+            # not feed the baseline twice (mirrors the custom-metric
+            # run-id dedupe in metrics/collector.py)
+            return AnalysisVerdict(state=level_name(rec.level))
+        if run_id:
+            rec.last_run_id = run_id
+        if not ok:
+            # failed runs already alarm through pass/fail and rarely
+            # carry a trustworthy contract; never let them poison the
+            # baseline. The reported state persists unchanged.
+            self._persist(hc, rec, spec)
+            return AnalysisVerdict(state=level_name(rec.level))
+
+        wanted = list(getattr(spec, "metrics", None) or [])
+        config = _config_from_spec(spec)
+        cohort = str(getattr(spec, "cohort", "") or "")
+        old_level = rec.level
+        metric_transitions: List[Tuple[str, str, str]] = []
+        zscores: Dict[str, float] = {}
+        seen: set = set()
+        for metric, raw_value in samples.items():
+            if wanted and metric not in wanted:
+                continue
+            value = finite(raw_value)
+            if value is None:
+                continue
+            seen.add(metric)
+            baseline = rec.baselines.baseline(metric)
+            warmed = rec.baselines.warmed(metric)
+            levels = []
+            for detector in self.detectors:
+                if detector.needs_baseline and not warmed:
+                    continue  # warm-up gate: no statistics, no opinion
+                levels.append(detector.evaluate(metric, value, baseline, config))
+            raw_level = combine_raw_levels(levels)
+            if warmed:
+                zscores[metric] = baseline.zscore(value)
+            state = rec.hysteresis.get(metric)
+            if state is None:
+                state = rec.hysteresis[metric] = Hysteresis()
+            transition = state.update(raw_level)
+            if transition is not None:
+                metric_transitions.append(
+                    (metric, level_name(transition[0]), level_name(transition[1]))
+                )
+            # baseline update policy (module docstring): warm-up always
+            # feeds; post-warm-up anomalous samples are quarantined
+            if not warmed or raw_level == LEVEL_OK:
+                rec.baselines.observe(metric, value)
+            rec.last_values[metric] = value
+            rec.last_zscores[metric] = zscores.get(metric)
+            if cohort:
+                self.cohorts.record(cohort, metric, key, value)
+            self._export_metric(hc, metric, baseline, zscores.get(metric))
+        # metrics with a reported state but NO sample this run: an
+        # entry excluded by the metrics[] filter drops outright (the
+        # operator edited it out); a still-wanted metric the probe
+        # stopped emitting decays back toward ok through the normal
+        # calm hysteresis — absence is not evidence of continued
+        # degradation, and a vanished metric must not hold the check
+        # degraded (damped, remedy-triggering) forever
+        for metric in [m for m in rec.hysteresis if m not in seen]:
+            if wanted and metric not in wanted:
+                del rec.hysteresis[metric]
+                rec.last_values.pop(metric, None)
+                rec.last_zscores.pop(metric, None)
+                continue
+            transition = rec.hysteresis[metric].update(LEVEL_OK)
+            if transition is not None:
+                metric_transitions.append(
+                    (metric, level_name(transition[0]), level_name(transition[1]))
+                )
+            if rec.hysteresis[metric].level == LEVEL_OK:
+                # fully recovered AND absent: nothing left to report
+                # (the baseline stays, in case the metric returns)
+                del rec.hysteresis[metric]
+                rec.last_values.pop(metric, None)
+                rec.last_zscores.pop(metric, None)
+        new_level = rec.level
+        self._export_state(hc, new_level, materialize=new_level != LEVEL_OK)
+        self._persist(hc, rec, spec)
+        transition = (
+            (level_name(old_level), level_name(new_level))
+            if new_level != old_level
+            else None
+        )
+        if transition is not None:
+            log.log(
+                logging.WARNING if new_level > old_level else logging.INFO,
+                "analysis state of %s: %s -> %s",
+                key,
+                transition[0],
+                transition[1],
+            )
+        return AnalysisVerdict(
+            state=level_name(new_level),
+            transition=transition,
+            metric_transitions=metric_transitions,
+            zscores=zscores,
+        )
+
+    def _ensure(self, hc, spec) -> _CheckAnalysis:
+        key = hc.key
+        rec = self._checks.get(key)
+        warmup = max(1, int(getattr(spec, "warmup_runs", 0) or 5))
+        if rec is None:
+            rec = self._restore(hc, warmup)
+            self._checks[key] = rec
+            if rec.level != LEVEL_OK:
+                # a durable non-ok mark must resurface on the scrape
+                # immediately, not wait for the next transition
+                self._export_state(hc, rec.level, materialize=True)
+        rec.baselines.warmup_runs = warmup
+        rec.name = hc.metadata.name
+        rec.namespace = hc.metadata.namespace
+        return rec
+
+    def _restore(self, hc, warmup: int) -> _CheckAnalysis:
+        """Adopt a durable ``.status.analysis`` blob written by a
+        previous controller incarnation; anything malformed yields a
+        fresh state (defensive like the CRD loaders)."""
+        blob = getattr(hc.status, "analysis", None)
+        if not isinstance(blob, dict):
+            return _CheckAnalysis(CheckBaselines(self.clock, warmup))
+        rec = _CheckAnalysis(
+            CheckBaselines.from_dict(blob.get("baselines") or {}, self.clock, warmup)
+        )
+        states = blob.get("states")
+        if isinstance(states, dict):
+            for metric, entry in states.items():
+                if isinstance(metric, str) and isinstance(entry, dict):
+                    rec.hysteresis[metric] = Hysteresis.from_dict(entry)
+        return rec
+
+    # -- persistence ----------------------------------------------------
+    def _persist(self, hc, rec: _CheckAnalysis, spec) -> None:
+        """Serialize the check's analysis state onto ``hc.status`` so it
+        rides the pending status write (merge-patch replaces the whole
+        ``analysis`` key, so stale sub-keys can never linger)."""
+        hc.status.analysis = {
+            "v": STATUS_VERSION,
+            "state": level_name(rec.level),
+            "updatedAt": self.clock.now().isoformat(),
+            "baselines": rec.baselines.to_dict(),
+            "states": {
+                metric: state.to_dict()
+                for metric, state in rec.hysteresis.items()
+            },
+        }
+
+    # -- metric export --------------------------------------------------
+    def _export_metric(self, hc, metric, baseline, zscore) -> None:
+        if self.metrics is None:
+            return
+        name, namespace = hc.metadata.name, hc.metadata.namespace
+        self.metrics.set_metric_baseline(
+            name,
+            namespace,
+            metric,
+            mean=baseline.mean,
+            std=baseline.std,
+            median=baseline.median,
+            mad=baseline.mad,
+            count=float(baseline.n),
+        )
+        if zscore is not None:
+            self.metrics.set_metric_zscore(name, namespace, metric, zscore)
+
+    def _export_state(self, hc, level: int, *, materialize: bool) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set_anomaly_state(
+            hc.metadata.name,
+            hc.metadata.namespace,
+            level_name(level),
+            materialize=materialize,
+        )
+
+    # -- queries --------------------------------------------------------
+    def state(self, key: str) -> str:
+        rec = self._checks.get(key)
+        return level_name(rec.level) if rec is not None else "ok"
+
+    def summary(self, hc) -> Optional[dict]:
+        """The check's /statusz ``analysis`` block (None when the check
+        has not opted in). Schema pinned by the statusz contract test."""
+        spec = analysis_spec(hc)
+        if spec is None:
+            return None
+        key = hc.key
+        rec = self._checks.get(key)
+        cohort = str(getattr(spec, "cohort", "") or "")
+        if rec is None:
+            # opted in but no run analyzed yet (or a restart before the
+            # first run): report the durable state if one exists
+            blob = getattr(hc.status, "analysis", None)
+            durable = (
+                blob.get("state") if isinstance(blob, dict) else None
+            )
+            return {
+                "state": durable if durable in ("ok", "warning", "degraded") else "ok",
+                "cohort": cohort or None,
+                "cohort_score": None,
+                "metrics": {},
+            }
+        metrics_block = {}
+        for metric, state in rec.hysteresis.items():
+            baseline = rec.baselines.peek(metric)
+            metrics_block[metric] = {
+                "state": level_name(state.level),
+                "last": rec.last_values.get(metric),
+                "baseline_median": baseline.median if baseline else None,
+                "baseline_mean": baseline.mean if baseline else None,
+                # the run-time z (what the gauge exported), not a
+                # recompute against a baseline the sample may have
+                # since updated
+                "zscore": rec.last_zscores.get(metric),
+                "warmed_up": rec.baselines.warmed(metric),
+            }
+        return {
+            "state": level_name(rec.level),
+            "cohort": cohort or None,
+            "cohort_score": (
+                self.cohorts.worst_score(cohort, key) if cohort else None
+            ),
+            "metrics": metrics_block,
+        }
+
+    # -- lifecycle ------------------------------------------------------
+    def forget(self, key: str, name: str = "", namespace: str = "") -> None:
+        """Deleted check (or analysis block removed): drop live state,
+        cohort membership, and exported series."""
+        self._checks.pop(key, None)
+        self.cohorts.forget(key)
+        if self.metrics is not None and name:
+            self.metrics.clear_analysis(name, namespace)

@@ -1,0 +1,113 @@
+"""Cross-check straggler ranking over analysis cohorts.
+
+A per-check baseline answers "is this slice worse than it used to be";
+a cohort answers "is this slice worse than its PEERS right now" — the
+straggler question a fleet of identical v5e-8 slices actually asks.
+Checks sharing a ``spec.analysis.cohort`` label contribute their latest
+value per metric; a member whose value sits far from the cohort median
+(in cohort-MAD sigmas) is an outlier even if its own baseline has
+quietly adapted to a slow decline — the failure mode per-check
+statistics cannot see.
+
+Pure bookkeeping (no clock, no I/O), same shape as the flap tracker:
+the engine owns when to record and what an outlier verdict does.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List, Optional, Tuple
+
+from activemonitor_tpu.analysis.baseline import (
+    ABSOLUTE_SCALE_FLOOR,
+    MAD_TO_SIGMA,
+    RELATIVE_SCALE_FLOOR,
+)
+
+# fewer members can't support a median/MAD verdict: with two, each
+# member is always exactly one MAD from the median of the pair
+MIN_COHORT_SIZE = 3
+
+DEFAULT_OUTLIER_SIGMAS = 3.0
+
+
+class CohortIndex:
+    """Latest value per (cohort, metric, check) + outlier ranking."""
+
+    def __init__(self) -> None:
+        # (cohort, metric) -> {check key -> latest value}
+        self._values: Dict[Tuple[str, str], Dict[str, float]] = {}
+        # check key -> cohort it last reported under (forget/move cleanup)
+        self._member_cohort: Dict[str, str] = {}
+
+    def record(self, cohort: str, metric: str, key: str, value: float) -> None:
+        if not cohort or not metric:
+            return
+        previous = self._member_cohort.get(key)
+        if previous is not None and previous != cohort:
+            # the spec's cohort label changed: the check's samples must
+            # not keep skewing the old cohort's median
+            self.forget(key)
+        self._member_cohort[key] = cohort
+        self._values.setdefault((cohort, metric), {})[key] = float(value)
+
+    def forget(self, key: str) -> None:
+        self._member_cohort.pop(key, None)
+        for members in self._values.values():
+            members.pop(key, None)
+
+    def members(self, cohort: str) -> List[str]:
+        keys: set = set()
+        for (c, _metric), values in self._values.items():
+            if c == cohort:
+                keys.update(values)
+        return sorted(keys)
+
+    def scores(self, cohort: str, metric: str) -> Dict[str, float]:
+        """Per-member deviation from the cohort median in cohort-MAD
+        sigmas (signed: negative = below the cohort). Empty below
+        :data:`MIN_COHORT_SIZE` members — no verdict beats a made-up
+        one, same convention as the SLO layer's empty window."""
+        values = self._values.get((cohort, metric)) or {}
+        if len(values) < MIN_COHORT_SIZE:
+            return {}
+        center = statistics.median(values.values())
+        mad = statistics.median(abs(v - center) for v in values.values())
+        floor = max(ABSOLUTE_SCALE_FLOOR, RELATIVE_SCALE_FLOOR * abs(center))
+        scale = max(floor, MAD_TO_SIGMA * mad)
+        return {key: (value - center) / scale for key, value in values.items()}
+
+    def outliers(
+        self, cohort: str, metric: str, sigmas: float = DEFAULT_OUTLIER_SIGMAS
+    ) -> List[Tuple[str, float]]:
+        """Members beyond ``sigmas`` from the cohort median, worst
+        first — the straggler ranking."""
+        flagged = [
+            (key, score)
+            for key, score in self.scores(cohort, metric).items()
+            if abs(score) >= sigmas
+        ]
+        return sorted(flagged, key=lambda item: -abs(item[1]))
+
+    def is_outlier(
+        self,
+        cohort: str,
+        metric: str,
+        key: str,
+        sigmas: float = DEFAULT_OUTLIER_SIGMAS,
+    ) -> bool:
+        score = self.scores(cohort, metric).get(key)
+        return score is not None and abs(score) >= sigmas
+
+    def worst_score(self, cohort: str, key: str) -> Optional[float]:
+        """The member's largest-magnitude deviation across every metric
+        its cohort tracks (None outside any scored cohort) — one number
+        for the /statusz analysis block."""
+        worst: Optional[float] = None
+        for (c, metric) in list(self._values.keys()):
+            if c != cohort:
+                continue
+            score = self.scores(cohort, metric).get(key)
+            if score is not None and (worst is None or abs(score) > abs(worst)):
+                worst = score
+        return worst

@@ -1,6 +1,7 @@
 """API types for the HealthCheck resource (group activemonitor.keikoproj.io/v1alpha1)."""
 
 from activemonitor_tpu.api.types import (
+    AnalysisSpec,
     ArtifactLocation,
     FileArtifact,
     HealthCheck,
@@ -19,6 +20,7 @@ from activemonitor_tpu.api.types import (
 )
 
 __all__ = [
+    "AnalysisSpec",
     "ArtifactLocation",
     "FileArtifact",
     "HealthCheck",

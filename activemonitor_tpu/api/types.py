@@ -150,6 +150,34 @@ class SLOSpec(_Base):
     window_seconds: int = Field(default=3600, gt=0, alias="windowSeconds")
 
 
+class AnalysisSpec(_Base):
+    """Per-check baseline & anomaly detection (extension; no
+    counterpart in the reference CRD — docs/analysis.md).
+
+    Declaring the block opts the check into degradation verdicts
+    orthogonal to pass/fail: the controller maintains per-metric
+    rolling baselines over the run's custom-metric samples, detects
+    robust-z / rated-fraction / trend anomalies with hysteresis, and
+    exports ``healthcheck_anomaly_state`` plus baseline/z-score gauges
+    for it. Omitting the block (the default) changes nothing.
+    """
+
+    # checks sharing a cohort label are compared against each other for
+    # straggler ranking (e.g. all slices of one v5e pool); "" = none
+    cohort: str = ""
+    # runs before the statistical detectors may judge (the baseline
+    # needs a population; the rated-fraction detector is exempt)
+    warmup_runs: int = Field(default=5, ge=1, alias="warmupRuns")
+    # robust-z warning threshold; degraded fires at twice this
+    z_threshold: float = Field(default=3.0, gt=0.0, alias="zThreshold")
+    # metric names (contract spelling, e.g. "mxu-matmul-tflops") to
+    # analyze; empty = every numeric metric the probe emits
+    metrics: List[str] = Field(default_factory=list)
+    # a run that SUCCEEDS but is analysis-degraded triggers the remedy
+    # workflow as if it had failed (per-check and fleet gates still apply)
+    trigger_on_degraded: bool = Field(default=False, alias="triggerOnDegraded")
+
+
 class ScheduleSpec(_Base):
     """Cron schedule (reference: healthcheck_types.go:148-151).
 
@@ -183,6 +211,8 @@ class HealthCheckSpec(_Base):
     remedy_reset_interval: int = Field(default=0, alias="remedyResetInterval")
     # optional SLO block — absent ⇒ no error-budget accounting
     slo: Optional[SLOSpec] = None
+    # optional baseline/anomaly block — absent ⇒ no degradation verdicts
+    analysis: Optional[AnalysisSpec] = None
 
 
 class HealthCheckStatus(_Base):
@@ -222,6 +252,17 @@ class HealthCheckStatus(_Base):
     # explicit user-clearable mark — clear the field (set it to "") to
     # resume a quarantined check's schedule.
     state: str = ""
+    # baseline & anomaly state (extension; analysis/engine.py): the
+    # compact serialized per-metric baselines + hysteresis levels, so
+    # learned baselines survive controller restarts through the same
+    # merge-patch status write as everything else. Free-form by design
+    # (the engine owns the schema and versions it with a "v" key) —
+    # the CRD marks it x-kubernetes-preserve-unknown-fields so the
+    # apiserver does not prune the metric sub-keys.
+    analysis: Optional[dict] = Field(
+        default=None,
+        json_schema_extra={"x-kubernetes-preserve-unknown-fields": True},
+    )
 
     def reset_remedy(self, reason: str) -> None:
         """Zero all remedy bookkeeping (reference: healthcheck_controller.go:649-660,695-703)."""

@@ -41,6 +41,8 @@ import asyncio
 import logging
 from typing import Dict, Optional
 
+from activemonitor_tpu.analysis import AnalysisEngine
+from activemonitor_tpu.analysis.engine import DEGRADED_DAMP_FACTOR
 from activemonitor_tpu.api.types import (
     HealthCheck,
     PHASE_FAILED,
@@ -125,6 +127,13 @@ class HealthCheckReconciler:
         # as the tracer; /statusz reads it through the fleet aggregate.
         self.resilience = resilience or ResilienceCoordinator(self.clock, metrics)
         self.fleet.resilience = self.resilience
+        # baseline & anomaly detection (docs/analysis.md): learns per-
+        # metric baselines from the runs' custom-metric samples and
+        # turns them into ok/warning/degraded verdicts orthogonal to
+        # pass/fail. Same ownership shape as the tracer; /statusz reads
+        # it through the fleet aggregate.
+        self.analysis = AnalysisEngine(self.clock, metrics)
+        self.fleet.analysis = self.analysis
         self.timers = TimerWheel(self.clock)
         self._watch_tasks: Dict[str, asyncio.Task] = {}
         # set by the Manager: routes failed-run requeues through its
@@ -156,6 +165,9 @@ class HealthCheckReconciler:
             # status write, and the one-hot state metric series
             self.resilience.forget(key)
             self.metrics.clear_check_state(name, namespace)
+            # ... and its learned baselines, cohort membership, and
+            # anomaly/baseline/z-score series
+            self.analysis.forget(key, name, namespace)
             return None
         return await self._process_or_recover(hc)
 
@@ -458,6 +470,50 @@ class HealthCheckReconciler:
                     "HealthCheck verdict stabilized; schedule restored",
                 )
         self._sync_state_metric(hc)
+
+    def _note_analysis(
+        self, hc: HealthCheck, samples: dict, *, ok: bool, run_id: str = ""
+    ) -> bool:
+        """Feed one run's numeric samples to the baseline/anomaly
+        engine (docs/analysis.md) and act on its verdict: events on
+        state transitions, schedule damping while confirmed-degraded
+        (through the flap tracker's damp_factor, so every cadence
+        computation sees it). Returns True when the check's analysis
+        state is degraded. The durable baseline blob lands on
+        ``hc.status.analysis`` and rides the pending status write."""
+        verdict = self.analysis.observe(hc, samples, ok=ok, run_id=run_id)
+        if verdict is None:
+            # no verdict (no analysis: block, or it was just removed):
+            # any damping a previous degraded verdict requested must
+            # not outlive the subsystem that asked for it
+            self.resilience.checks.set_analysis_damp(hc.key, 1.0)
+            return False
+        if verdict.transition is not None:
+            old, new = verdict.transition
+            worsened = ("ok", "warning", "degraded").index(new) > (
+                "ok", "warning", "degraded"
+            ).index(old)
+            if worsened:
+                self.recorder.event(
+                    hc,
+                    EVENT_WARNING,
+                    "Warning",
+                    f"HealthCheck metrics anomaly state is {new} "
+                    "(deviation from learned baseline confirmed)",
+                )
+            elif new == "ok":
+                self.recorder.event(
+                    hc,
+                    EVENT_NORMAL,
+                    "Normal",
+                    "HealthCheck metrics recovered to baseline",
+                )
+        # damp the schedule while degraded — same containment the flap
+        # tracker applies, surfaced through the same damp_factor
+        self.resilience.checks.set_analysis_damp(
+            hc.key, DEGRADED_DAMP_FACTOR if verdict.degraded else 1.0
+        )
+        return verdict.degraded
 
     async def replay_status_writes(self) -> int:
         """Drain status writes queued while the breaker was open —
@@ -853,20 +909,56 @@ class HealthCheckReconciler:
                         then.timestamp(),
                         now.timestamp(),
                     )
-                    # custom metrics, wired for real (reference gap: SURVEY.md §2)
-                    self.metrics.record_custom_metrics(hc.metadata.name, status)
+                    # custom metrics, wired for real (reference gap:
+                    # SURVEY.md §2) — keyed by the workflow run so a
+                    # status replayed through a second path can never
+                    # double-increment counter-type metrics
+                    self.metrics.record_custom_metrics(
+                        hc.metadata.name, status, run_id=wf_name
+                    )
+                    samples = MetricsCollector.parse_custom_samples(status)
                     # the run lands in the result history on the same
                     # path that writes status — one source for SLO math
+                    # AND for the anomaly detectors
                     self.fleet.record(
                         hc,
                         ok=True,
                         latency=(now - then).total_seconds(),
                         workflow=wf_name,
+                        metrics=samples,
                     )
                     # the verdict drives the flap state machine; the
                     # durable .status.state mark rides this same write
                     self._note_verdict(hc, ok=True)
-                    if not hc.spec.remedy_workflow.is_empty() and hc.status.remedy_total_runs >= 1:
+                    # baseline analysis: a run can PASS its threshold yet
+                    # be far below its own baseline — the degradation
+                    # verdict (and optionally the remedy) comes from here
+                    degraded = self._note_analysis(
+                        hc, samples, ok=True, run_id=wf_name
+                    )
+                    trigger_degraded = (
+                        degraded
+                        and hc.spec.analysis is not None
+                        and hc.spec.analysis.trigger_on_degraded
+                        and not hc.spec.remedy_workflow.is_empty()
+                    )
+                    if trigger_degraded:
+                        # spec.analysis.triggerOnDegraded: treat the
+                        # confirmed degradation like a failure for remedy
+                        # purposes (the per-check and fleet-wide remedy
+                        # gates still apply downstream)
+                        self.recorder.event(
+                            hc,
+                            EVENT_WARNING,
+                            "Warning",
+                            "HealthCheck passed but metrics are degraded "
+                            "from baseline; triggering remedy",
+                        )
+                        run_remedy = True
+                    elif (
+                        not hc.spec.remedy_workflow.is_empty()
+                        and hc.status.remedy_total_runs >= 1
+                    ):
                         hc.status.reset_remedy("HealthCheck Passed so Remedy is reset")
                         self.recorder.event(
                             hc, EVENT_NORMAL, "Normal", "HealthCheck passed so Remedy is reset"
@@ -893,14 +985,22 @@ class HealthCheckReconciler:
                         then.timestamp(),
                         now.timestamp(),
                     )
-                    self.metrics.record_custom_metrics(hc.metadata.name, status)
+                    self.metrics.record_custom_metrics(
+                        hc.metadata.name, status, run_id=wf_name
+                    )
+                    samples = MetricsCollector.parse_custom_samples(status)
                     self.fleet.record(
                         hc,
                         ok=False,
                         latency=(now - then).total_seconds(),
                         workflow=wf_name,
+                        metrics=samples,
                     )
                     self._note_verdict(hc, ok=False)
+                    # failed runs never feed the baselines (their
+                    # metrics, if any, describe a broken run) — but the
+                    # durable analysis blob still rides this write
+                    self._note_analysis(hc, samples, ok=False, run_id=wf_name)
                     run_remedy = True
                     break
 
@@ -1239,7 +1339,9 @@ class HealthCheckReconciler:
                     then.timestamp(),
                     now.timestamp(),
                 )
-                self.metrics.record_custom_metrics(hc.metadata.name, status)
+                self.metrics.record_custom_metrics(
+                    hc.metadata.name, status, run_id=wf_name
+                )
                 break
             if phase == PHASE_FAILED:
                 self.recorder.event(
@@ -1261,7 +1363,9 @@ class HealthCheckReconciler:
                     then.timestamp(),
                     now.timestamp(),
                 )
-                self.metrics.record_custom_metrics(hc.metadata.name, status)
+                self.metrics.record_custom_metrics(
+                    hc.metadata.name, status, run_id=wf_name
+                )
                 break
 
             if not await self._pace_poll(ieb, wf_namespace, wf_name):

@@ -21,6 +21,7 @@ not just the HealthCheck name (collector.go:90 only rewrites ``name``).
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import re
@@ -235,6 +236,35 @@ class MetricsCollector:
             [LABEL_HC, "namespace", "state"],
             registry=self.registry,
         )
+        # -- analysis families (analysis/engine.py is the single
+        # writer; docs/analysis.md). Namespace-labeled like the SLO
+        # families: these are SET per evaluation, and same-named checks
+        # in different namespaces must not flap one series.
+        analysis_labels = [LABEL_HC, "namespace", "metric"]
+        self.metric_baseline = Gauge(
+            "healthcheck_metric_baseline",
+            "Learned per-metric baseline statistics (stat label: mean/"
+            "std/median/mad/count) for checks with spec.analysis",
+            analysis_labels + ["stat"],
+            registry=self.registry,
+        )
+        self.metric_zscore = Gauge(
+            "healthcheck_metric_zscore",
+            "Robust z-score of the check's latest metric sample against "
+            "its learned baseline (median/MAD)",
+            analysis_labels,
+            registry=self.registry,
+        )
+        # per-check anomaly verdict as kube-state-metrics-style one-hot
+        # series, lazy like healthcheck_check_state: never-anomalous
+        # checks carry no series at all
+        self.anomaly_state = Gauge(
+            "healthcheck_anomaly_state",
+            "Per-check anomaly state (ok/warning/degraded) from the "
+            "baseline analysis layer; 1 on the current state's series",
+            [LABEL_HC, "namespace", "state"],
+            registry=self.registry,
+        )
         self.remedy_runs = Counter(
             "healthcheck_remedy_runs_total",
             "Remedy admission decisions per check: admitted runs and "
@@ -343,6 +373,18 @@ class MetricsCollector:
         # (hc_name, namespace) pairs whose check_state trio has been
         # materialized — see set_check_state's lazy-cardinality contract
         self._state_series: set = set()
+        # same laziness for the anomaly trio (analysis layer)
+        self._anomaly_series: set = set()
+        # (hc_name, namespace) -> metric names with baseline/zscore
+        # series, so clear_analysis can drop exactly what was exported
+        self._analysis_series: Dict[tuple, set] = {}
+        # (hc_name, run_id) pairs whose custom metrics were already
+        # recorded — a run replayed through a second path (poll AND
+        # status replay) must not double-increment counter metrics.
+        # Bounded FIFO so a long-lived controller stays O(1) memory.
+        self._recorded_runs: "collections.OrderedDict[tuple, bool]" = (
+            collections.OrderedDict()
+        )
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -487,8 +529,92 @@ class MetricsCollector:
     def record_remedy_run(self, hc_name: str, namespace: str, result: str) -> None:
         self.remedy_runs.labels(hc_name, namespace, result).inc()
 
+    # -- analysis families (written by analysis/engine.py) -------------
+    def set_metric_baseline(
+        self,
+        hc_name: str,
+        namespace: str,
+        metric: str,
+        *,
+        mean: float,
+        std: float,
+        median: float,
+        mad: float,
+        count: float,
+    ) -> None:
+        series = self._analysis_series.setdefault((hc_name, namespace), set())
+        series.add(metric)
+        metric = _sanitize(metric)
+        for stat, value in (
+            ("mean", mean),
+            ("std", std),
+            ("median", median),
+            ("mad", mad),
+            ("count", count),
+        ):
+            self.metric_baseline.labels(hc_name, namespace, metric, stat).set(value)
+
+    def set_metric_zscore(
+        self, hc_name: str, namespace: str, metric: str, zscore: float
+    ) -> None:
+        self._analysis_series.setdefault((hc_name, namespace), set()).add(metric)
+        self.metric_zscore.labels(hc_name, namespace, _sanitize(metric)).set(zscore)
+
+    def set_anomaly_state(
+        self, hc_name: str, namespace: str, state: str, *, materialize: bool = True
+    ) -> None:
+        """One-hot the check's anomaly trio. LAZY like set_check_state:
+        an ok-only check carries no series (absence means ok — three
+        series per healthy check would blow the cardinality budget for
+        zero signal). ``materialize=False`` keeps an ok report from
+        creating the trio; once any non-ok state (or a restored durable
+        mark) materialized it, the full trio persists so the recovery
+        transition is visible."""
+        key = (hc_name, namespace)
+        if state == "ok" and not materialize and key not in self._anomaly_series:
+            return
+        self._anomaly_series.add(key)
+        from activemonitor_tpu.analysis.detector import ANOMALY_STATES
+
+        for known in ANOMALY_STATES:
+            self.anomaly_state.labels(hc_name, namespace, known).set(
+                1.0 if known == state else 0.0
+            )
+
+    def clear_analysis(self, hc_name: str, namespace: str) -> None:
+        """Deleted check (or analysis: block removed): drop every
+        analysis series the check ever exported."""
+        from activemonitor_tpu.analysis.baseline import BASELINE_STATS
+        from activemonitor_tpu.analysis.detector import ANOMALY_STATES
+
+        key = (hc_name, namespace)
+        for metric in self._analysis_series.pop(key, ()):
+            metric = _sanitize(metric)
+            for stat in BASELINE_STATS:
+                try:
+                    self.metric_baseline.remove(hc_name, namespace, metric, stat)
+                except KeyError:
+                    pass  # stat never exported for this metric
+            try:
+                self.metric_zscore.remove(hc_name, namespace, metric)
+            except KeyError:
+                pass  # zscore only exists after warm-up
+        if key in self._anomaly_series:
+            self._anomaly_series.discard(key)
+            for state in ANOMALY_STATES:
+                try:
+                    self.anomaly_state.remove(hc_name, namespace, state)
+                except KeyError:
+                    pass  # never recorded
+
     # -- dynamic custom metrics ---------------------------------------
-    def record_custom_metrics(self, hc_name: str, workflow_status: dict) -> int:
+    # recorded-run memory bound: at one run a second this is ~34 min of
+    # dedupe horizon, far beyond any replay window in the controller
+    RECORDED_RUN_CAPACITY = 2048
+
+    def record_custom_metrics(
+        self, hc_name: str, workflow_status: dict, run_id: str = ""
+    ) -> int:
         """Parse workflow global output parameters for the custom-metric
         contract: ``metrics`` entries become gauges or counters per the
         declared ``metrictype`` (unknown types are rejected with a
@@ -497,9 +623,24 @@ class MetricsCollector:
         trace id as an OpenMetrics exemplar. Returns how many ``metrics``
         entries were recorded.
 
+        ``run_id`` (the workflow object name) dedupes recording per
+        run: the reconciler can reach the same terminal status through
+        more than one path (the live poll and a replayed/requeued
+        status), and counter-type metrics are per-run INCREMENTS — a
+        second recording would double-count them. A run id seen before
+        records nothing and returns 0.
+
         Malformed JSON / entries are skipped with a log, never raised
         (reference: collector.go:73-87).
         """
+        if run_id:
+            dedupe_key = (hc_name, run_id)
+            with self._custom_lock:
+                if dedupe_key in self._recorded_runs:
+                    return 0  # this run's metrics already landed
+                self._recorded_runs[dedupe_key] = True
+                while len(self._recorded_runs) > self.RECORDED_RUN_CAPACITY:
+                    self._recorded_runs.popitem(last=False)
         outputs = (workflow_status or {}).get("outputs") or {}
         parameters = outputs.get("parameters") or []
         recorded = 0
@@ -517,6 +658,38 @@ class MetricsCollector:
                 recorded += self._record_custom_metric(hc_name, raw)
             self._record_phase_timings(hc_name, doc.get("timings"))
         return recorded
+
+    @staticmethod
+    def parse_custom_samples(workflow_status: dict) -> Dict[str, float]:
+        """The run's numeric samples as ``{metric name: value}`` —
+        contract spelling, no prefixing/sanitizing — for the baseline
+        analysis layer and the result history. Pure read: records
+        nothing, dedupes nothing, skips malformed entries silently
+        (the recording path above already logs them)."""
+        outputs = (workflow_status or {}).get("outputs") or {}
+        parameters = outputs.get("parameters") or []
+        samples: Dict[str, float] = {}
+        for parameter in parameters:
+            value = parameter.get("value") if isinstance(parameter, dict) else None
+            if not isinstance(value, str):
+                continue
+            try:
+                doc = json.loads(value)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(doc, dict):
+                continue
+            for raw in doc.get("metrics") or []:
+                if not isinstance(raw, dict):
+                    continue
+                name = raw.get("name") or ""
+                if not isinstance(name, str) or not name:
+                    continue
+                try:
+                    samples[name] = float(raw.get("value"))
+                except (TypeError, ValueError):
+                    continue
+        return samples
 
     def _record_custom_metric(self, hc_name: str, raw) -> int:
         """One contract entry -> one sample; returns 1 when recorded."""

@@ -25,7 +25,7 @@ Design constraints, shared with the tracer (obs/trace.py):
 from __future__ import annotations
 
 import collections
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Deque, Dict, List, Optional
 
@@ -45,6 +45,10 @@ class CheckResult:
     latency: float  # submit → terminal-phase seconds
     workflow: str  # workflow object name, joins to engine/Argo state
     trace_id: str  # joins to /debug/traces and correlated logs
+    # the run's numeric custom-metric samples (contract spelling, e.g.
+    # "mxu-matmul-tflops") — the raw material the anomaly detectors and
+    # the /debug endpoints read; empty for runs without a contract
+    metrics: Dict[str, float] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -53,6 +57,7 @@ class CheckResult:
             "latency_seconds": self.latency,
             "workflow": self.workflow,
             "trace_id": self.trace_id,
+            "metrics": dict(self.metrics),
         }
 
 
@@ -74,6 +79,7 @@ class ResultHistory:
         latency: float,
         workflow: str = "",
         trace_id: str = "",
+        metrics: Optional[Dict[str, float]] = None,
     ) -> CheckResult:
         """Append one finished run; the oldest entry falls off a full
         ring. The timestamp is stamped HERE from the injected clock so
@@ -84,6 +90,7 @@ class ResultHistory:
             latency=max(0.0, float(latency)),
             workflow=workflow,
             trace_id=trace_id,
+            metrics=dict(metrics or {}),
         )
         ring = self._rings.get(key)
         if ring is None:

@@ -201,16 +201,26 @@ class FleetStatus:
         # status CLI report next to the SLO numbers. None (standalone
         # FleetStatus, e.g. unit tests) reports a healthy controller.
         self.resilience = None
+        # wired by the reconciler (analysis/engine.py): the baseline &
+        # anomaly layer whose per-check verdicts /statusz and the CLI
+        # report. None (standalone) reports no analysis blocks.
+        self.analysis = None
 
     # -- recording (reconciler status-write path) ----------------------
-    def record(self, hc, *, ok: bool, latency: float, workflow: str) -> None:
+    def record(
+        self, hc, *, ok: bool, latency: float, workflow: str, metrics=None
+    ) -> None:
         try:
-            self._record(hc, ok=ok, latency=latency, workflow=workflow)
+            self._record(
+                hc, ok=ok, latency=latency, workflow=workflow, metrics=metrics
+            )
         except Exception:
             # observability must not fail the status write that feeds it
             log.exception("failed to record result for %s", getattr(hc, "key", "?"))
 
-    def _record(self, hc, *, ok: bool, latency: float, workflow: str) -> None:
+    def _record(
+        self, hc, *, ok: bool, latency: float, workflow: str, metrics=None
+    ) -> None:
         key = hc.key
         self.history.record(
             key,
@@ -218,6 +228,7 @@ class FleetStatus:
             latency=latency,
             workflow=workflow,
             trace_id=current_trace_id(),
+            metrics=metrics,
         )
         self._last_status[key] = "Succeeded" if ok else "Failed"
         config = slo_config_from_spec(hc.spec)
@@ -300,6 +311,11 @@ class FleetStatus:
             "healthcheck": hc.metadata.name,
             "namespace": hc.metadata.namespace,
             "state": state,
+            # baseline & anomaly verdict (analysis/engine.py): None when
+            # the check declares no analysis: block (or standalone)
+            "analysis": (
+                self.analysis.summary(hc) if self.analysis is not None else None
+            ),
             "remedy_budget_remaining": remedy_budget,
             "last_status": hc.status.status
             or self._last_status.get(key, ""),
@@ -339,12 +355,21 @@ class FleetStatus:
                 "status_writes_queued": 0,
                 "remedy_tokens": None,
             }
+        # anomaly rollup: how many checks the analysis layer currently
+        # holds in each non-ok state — the fleet-level degradation
+        # counterpart of the pass/fail goodput number
+        anomalies = {"warning": 0, "degraded": 0}
+        for entry in entries:
+            analysis = entry.get("analysis")
+            if analysis and analysis.get("state") in anomalies:
+                anomalies[analysis["state"]] += 1
         return {
             "fleet": {
                 "checks": len(entries),
                 "window_runs": window_runs,
                 "goodput_ratio": ratio,
                 "generated_at": now.isoformat(),
+                "anomalies": anomalies,
                 # degraded-mode telemetry (docs/resilience.md): the
                 # breaker's verdict, the replay backlog, and the
                 # fleet-wide remedy budget

@@ -14,9 +14,13 @@ variables for new silicon or corrected ratings:
 
 from __future__ import annotations
 
+import logging
+import math
 import os
 from dataclasses import dataclass
 from typing import Optional
+
+log = logging.getLogger("activemonitor.probes")
 
 
 @dataclass(frozen=True)
@@ -41,13 +45,31 @@ _RATED = [
 
 
 def _override(value: float, env: str) -> float:
+    """An env-supplied rated figure, validated: it is the DENOMINATOR
+    of every fraction-of-rated gauge and verdict, so a malformed or
+    non-positive override must fall back to the table value with a
+    warning — never crash the probe, never divide by zero or flip the
+    fraction's sign."""
     raw = os.environ.get(env)
-    if raw:
-        try:
-            return float(raw)
-        except ValueError:
-            pass
-    return value
+    if raw is None or not raw.strip():
+        return value  # unset/empty: the table value stands
+    try:
+        parsed = float(raw)
+    except ValueError:
+        log.warning(
+            "ignoring %s=%r: not a number; using rated %s", env, raw, value
+        )
+        return value
+    if not math.isfinite(parsed) or parsed <= 0:
+        log.warning(
+            "ignoring %s=%r: rated figures must be positive and finite; "
+            "using rated %s",
+            env,
+            raw,
+            value,
+        )
+        return value
+    return parsed
 
 
 # Single-chip performance bars (BASELINE.md § single-chip bar): the

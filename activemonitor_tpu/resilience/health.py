@@ -78,6 +78,10 @@ class CheckStateTracker:
         self.quarantine_after = max(1, quarantine_after)
         self._damp_factor = max(1.0, damp_factor)
         self._records: Dict[str, _CheckRecord] = {}
+        # externally-requested damping (the analysis layer parks a
+        # confirmed-degraded check at a slower cadence through the same
+        # damp_factor the flap containment uses); 1.0 = none
+        self._analysis_damp: Dict[str, float] = {}
 
     def _record(self, key: str) -> _CheckRecord:
         rec = self._records.get(key)
@@ -170,14 +174,28 @@ class CheckStateTracker:
         rec = self._records.get(key)
         return rec.state if rec is not None else STATE_HEALTHY
 
+    def set_analysis_damp(self, key: str, factor: float) -> None:
+        """The analysis layer's schedule damping request for a check
+        whose metrics are confirmed-degraded (analysis/engine.py).
+        Factor <= 1 clears the request. Kept HERE so the reconciler's
+        one damp_factor call keeps covering both containments — a
+        second multiplier consulted in some call sites but not others
+        is exactly the half-damped bug the flap tracker already fixed."""
+        if factor and factor > 1.0:
+            self._analysis_damp[key] = float(factor)
+        else:
+            self._analysis_damp.pop(key, None)
+
     def damp_factor(self, key: str) -> float:
-        """Interval multiplier for the check's schedule: >1 while
-        flapping, 1.0 otherwise."""
-        return (
+        """Interval multiplier for the check's schedule: the strongest
+        of the flap containment (>1 while flapping) and the analysis
+        layer's degraded-mode damping; 1.0 when neither applies."""
+        flap = (
             self._damp_factor
             if self.state(key) == STATE_FLAPPING
             else 1.0
         )
+        return max(flap, self._analysis_damp.get(key, 1.0))
 
     def error_streak(self, key: str) -> int:
         rec = self._records.get(key)
@@ -186,3 +204,4 @@ class CheckStateTracker:
     def forget(self, key: str) -> None:
         """Deleted check: drop its record."""
         self._records.pop(key, None)
+        self._analysis_damp.pop(key, None)

@@ -42,11 +42,13 @@ positive that makes `make lint` cry wolf is worse than a miss):
   whose whole body is `pass`/`...` — the broad catch that silently
   eats errors (BLE001's harmful core). Handlers that log, re-raise,
   return, or otherwise DO something are fine.
-- wallclock-in-resilience: `time.time()` / `time.monotonic()` calls in
-  files under a `resilience/` directory — that package's whole contract
-  is the injectable Clock (breaker open windows and token-bucket refill
-  must be scriptable by fake-clock tests); a bare wall-clock read there
-  silently breaks determinism.
+- wallclock-in-<package>: `time.time()` / `time.monotonic()` calls in
+  files under a `resilience/` or `analysis/` directory — those
+  packages' whole contract is the injectable Clock (breaker open
+  windows, token-bucket refill, and baseline timestamps must be
+  scriptable by fake-clock tests); a bare wall-clock read there
+  silently breaks determinism. The finding code carries the package
+  (`wallclock-in-resilience`, `wallclock-in-analysis`).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -131,8 +133,12 @@ class Checker(ast.NodeVisitor):
         self.has_star_import = False
         self.is_init = path.endswith("__init__.py")
         self.source = source
-        # the injectable-clock package: bare wall-clock reads are banned
-        self.ban_wallclock = "resilience" in Path(path).parts
+        # the injectable-clock packages: bare wall-clock reads are banned
+        parts = set(Path(path).parts)
+        self.wallclock_pkg = next(
+            (pkg for pkg in ("resilience", "analysis") if pkg in parts), None
+        )
+        self.ban_wallclock = self.wallclock_pkg is not None
         # names defined `async def` / plain `def` anywhere in the file
         # (functions AND methods) — the unawaited-coroutine check only
         # fires on names that are EXCLUSIVELY async, so a sync function
@@ -445,10 +451,10 @@ class Checker(ast.NodeVisitor):
                 self.findings.append(
                     (
                         node.lineno,
-                        "wallclock-in-resilience",
-                        f"`time.{fn.attr}()` in resilience/ — use the "
-                        "injectable Clock so fake-clock tests stay "
-                        "deterministic",
+                        f"wallclock-in-{self.wallclock_pkg}",
+                        f"`time.{fn.attr}()` in {self.wallclock_pkg}/ — "
+                        "use the injectable Clock so fake-clock tests "
+                        "stay deterministic",
                     )
                 )
         self.generic_visit(node)

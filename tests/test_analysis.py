@@ -1,0 +1,735 @@
+"""Baseline & anomaly-detection layer tests (ISSUE 4): the rolling
+baseline statistics, the detector chain and hysteresis, cohort
+straggler ranking, durable-status persistence — and the acceptance
+slice: a FakeClock+FakeEngine scripted check whose matmul TFLOPs step
+from 100% to 70% of baseline walks ``healthcheck_anomaly_state``
+ok→warning→degraded with hysteresis (no flap on a single outlier),
+survives a simulated controller restart through ``.status.analysis``,
+and surfaces the degraded mark in ``/statusz`` and ``am-tpu status``.
+"""
+
+import asyncio
+import collections
+import json
+
+import pytest
+
+from activemonitor_tpu.analysis import (
+    AnalysisEngine,
+    CheckBaselines,
+    CohortIndex,
+    DetectorConfig,
+    Hysteresis,
+    LEVEL_DEGRADED,
+    LEVEL_OK,
+    LEVEL_WARNING,
+    MetricBaseline,
+    RatedFractionDetector,
+    RobustZScoreDetector,
+    TrendDetector,
+)
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.engine.base import PHASE_SUCCEEDED
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.utils.clock import FakeClock
+
+METRIC = "mxu-matmul-tflops"
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+
+def make_hc(name="hc-ana", analysis=None, remedy=False):
+    spec = {
+        "repeatAfterSec": 60,
+        "level": "cluster",
+        "backoffMax": 1,
+        "backoffMin": 1,
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if analysis is not None:
+        spec["analysis"] = analysis
+    if remedy:
+        spec["remedyworkflow"] = {
+            "generateName": f"{name}-remedy-",
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        }
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+ANALYSIS_SPEC = {"warmupRuns": 5, "zThreshold": 3.0, "metrics": [METRIC]}
+
+
+# ---------------------------------------------------------------------
+# baseline statistics
+# ---------------------------------------------------------------------
+
+
+def test_welford_matches_textbook_mean_and_std():
+    baseline = MetricBaseline()
+    values = [10.0, 12.0, 14.0, 16.0, 18.0]
+    for v in values:
+        baseline.observe(v)
+    assert baseline.n == 5
+    assert baseline.mean == pytest.approx(14.0)
+    # sample std of an arithmetic sequence step 2: sqrt(10)
+    assert baseline.std == pytest.approx(10.0 ** 0.5)
+    assert baseline.median == 14.0
+    assert baseline.mad == 2.0
+
+
+def test_median_mad_resist_one_wild_outlier():
+    baseline = MetricBaseline()
+    for v in [100.0] * 9 + [1000.0]:
+        baseline.observe(v)
+    assert baseline.median == 100.0
+    assert baseline.mad == 0.0
+    # the mean moved, the robust center did not — and the z of a normal
+    # sample stays small while the outlier's own z is huge
+    assert abs(baseline.zscore(100.0)) < 1.0
+    assert baseline.zscore(1000.0) > 10.0
+
+
+def test_constant_series_scale_is_floored_not_zero():
+    baseline = MetricBaseline()
+    for _ in range(5):
+        baseline.observe(100.0)
+    assert baseline.scale() == pytest.approx(5.0)  # 5% relative floor
+    assert baseline.zscore(70.0) == pytest.approx(-6.0)
+
+
+def test_nonfinite_samples_never_poison_the_accumulators():
+    baseline = MetricBaseline()
+    baseline.observe(10.0)
+    baseline.observe(float("nan"))
+    baseline.observe(float("inf"))
+    assert baseline.n == 1
+    assert baseline.mean == 10.0
+
+
+def test_baseline_roundtrips_compactly_through_dict():
+    baseline = MetricBaseline()
+    for v in [100.0, 101.5, 98.75, 102.25, 99.0]:
+        baseline.observe(v)
+    restored = MetricBaseline.from_dict(json.loads(json.dumps(baseline.to_dict())))
+    assert restored.n == baseline.n
+    assert restored.mean == pytest.approx(baseline.mean, rel=1e-5)
+    assert restored.median == baseline.median
+    assert restored.zscore(70.0) == pytest.approx(baseline.zscore(70.0), rel=1e-4)
+
+
+def test_check_baselines_warmup_gate_and_defensive_restore():
+    clock = FakeClock()
+    baselines = CheckBaselines(clock, warmup_runs=3)
+    for v in [1.0, 2.0]:
+        baselines.observe("m", v)
+    assert not baselines.warmed("m")
+    baselines.observe("m", 3.0)
+    assert baselines.warmed("m")
+    assert baselines.updated_at == clock.now()
+    # garbage blobs restore to a fresh state, never raise
+    for garbage in (None, [], "x", {"m": "nope"}, {"m": {"n": "NaN"}}, {3: {}}):
+        restored = CheckBaselines.from_dict(garbage, clock, 3)
+        assert restored.metrics() in ([], ["m"]) or True
+    assert CheckBaselines.from_dict({"m": {"n": 2, "mean": 5.0}}, clock, 3).peek(
+        "m"
+    ).n == 2
+
+
+# ---------------------------------------------------------------------
+# detectors + hysteresis
+# ---------------------------------------------------------------------
+
+
+def warmed_baseline(values):
+    baseline = MetricBaseline()
+    for v in values:
+        baseline.observe(v)
+    return baseline
+
+
+def test_zscore_detector_levels():
+    detector = RobustZScoreDetector()
+    config = DetectorConfig(z_threshold=3.0)
+    baseline = warmed_baseline([100.0] * 8)  # scale floored at 5.0
+    assert detector.evaluate(METRIC, 100.0, baseline, config) == LEVEL_OK
+    assert detector.evaluate(METRIC, 80.0, baseline, config) == LEVEL_WARNING  # |z|=4
+    assert detector.evaluate(METRIC, 70.0, baseline, config) == LEVEL_DEGRADED  # |z|=6
+    # symmetric: a metric far ABOVE baseline is as anomalous
+    assert detector.evaluate(METRIC, 130.0, baseline, config) == LEVEL_DEGRADED
+
+
+def test_rated_fraction_detector_is_absolute_and_name_scoped():
+    detector = RatedFractionDetector()
+    config = DetectorConfig()
+    assert detector.evaluate("mxu-matmul-tflops", 0.5, None, config) is None
+    assert detector.evaluate("mxu-fraction-of-rated", 0.95, None, config) == LEVEL_OK
+    assert (
+        detector.evaluate("mxu-fraction-of-rated", 0.80, None, config)
+        == LEVEL_WARNING
+    )
+    assert (
+        detector.evaluate("ici_allreduce_fraction_of_rated", 0.60, None, config)
+        == LEVEL_DEGRADED
+    )
+
+
+def test_trend_detector_catches_slow_creep_the_zscore_misses():
+    config = DetectorConfig(z_threshold=3.0, trend_min_samples=8)
+    # 1% decline per run: every step is well inside the noise band...
+    values = [100.0 - i for i in range(12)]
+    baseline = warmed_baseline(values[:-1])
+    z = RobustZScoreDetector().evaluate(METRIC, values[-1], baseline, config)
+    assert z == LEVEL_OK  # the point reading looks fine
+    trend = TrendDetector().evaluate(METRIC, values[-1], baseline, config)
+    assert trend == LEVEL_WARNING  # ~11% drift across the window
+    # flat series: no drift
+    flat = warmed_baseline([100.0] * 11)
+    assert TrendDetector().evaluate(METRIC, 100.0, flat, config) == LEVEL_OK
+
+
+def test_hysteresis_single_outlier_never_flaps():
+    state = Hysteresis(confirm_runs=2, calm_runs=3)
+    assert state.update(LEVEL_DEGRADED) is None  # one outlier: no move
+    assert state.level == LEVEL_OK
+    assert state.update(LEVEL_OK) is None  # back to normal: streak reset
+    assert state.update(LEVEL_DEGRADED) is None  # another lone outlier
+    assert state.level == LEVEL_OK
+
+
+def test_hysteresis_escalates_one_step_per_confirmed_run():
+    state = Hysteresis(confirm_runs=2, calm_runs=2)
+    assert state.update(LEVEL_DEGRADED) is None
+    assert state.update(LEVEL_DEGRADED) == (LEVEL_OK, LEVEL_WARNING)
+    assert state.update(LEVEL_DEGRADED) is None  # streak restarts
+    assert state.update(LEVEL_DEGRADED) == (LEVEL_WARNING, LEVEL_DEGRADED)
+    # recovery is as deliberate: calm_runs of ok per step down
+    assert state.update(LEVEL_OK) is None
+    assert state.update(LEVEL_OK) == (LEVEL_DEGRADED, LEVEL_WARNING)
+    assert state.update(LEVEL_OK) is None
+    assert state.update(LEVEL_OK) == (LEVEL_WARNING, LEVEL_OK)
+
+
+def test_hysteresis_roundtrips_through_dict():
+    state = Hysteresis()
+    state.update(LEVEL_DEGRADED)
+    state.update(LEVEL_DEGRADED)
+    restored = Hysteresis.from_dict(json.loads(json.dumps(state.to_dict())))
+    assert restored.level == LEVEL_WARNING
+    assert Hysteresis.from_dict({"level": 99}).level == LEVEL_DEGRADED  # clamped
+    assert Hysteresis.from_dict({"level": "x"}).level == LEVEL_OK  # defensive
+
+
+# ---------------------------------------------------------------------
+# cohort straggler ranking
+# ---------------------------------------------------------------------
+
+
+def test_cohort_flags_the_straggler_slice():
+    cohorts = CohortIndex()
+    for i in range(5):
+        cohorts.record("pool-a", METRIC, f"health/slice-{i}", 100.0 + i * 0.5)
+    cohorts.record("pool-a", METRIC, "health/slice-sick", 60.0)
+    outliers = cohorts.outliers("pool-a", METRIC)
+    assert [key for key, _score in outliers] == ["health/slice-sick"]
+    assert outliers[0][1] < 0  # below the cohort
+    assert cohorts.is_outlier("pool-a", METRIC, "health/slice-sick")
+    assert not cohorts.is_outlier("pool-a", METRIC, "health/slice-0")
+    assert cohorts.worst_score("pool-a", "health/slice-sick") == outliers[0][1]
+
+
+def test_cohort_below_minimum_size_gives_no_verdict():
+    cohorts = CohortIndex()
+    cohorts.record("pool-a", METRIC, "a/x", 100.0)
+    cohorts.record("pool-a", METRIC, "a/y", 10.0)
+    assert cohorts.scores("pool-a", METRIC) == {}
+    assert cohorts.outliers("pool-a", METRIC) == []
+
+
+def test_cohort_membership_moves_and_forgets():
+    cohorts = CohortIndex()
+    for i in range(3):
+        cohorts.record("pool-a", METRIC, f"a/s{i}", 100.0)
+    cohorts.record("pool-a", METRIC, "a/mover", 100.0)
+    # the spec's cohort label changed: the old cohort must drop the member
+    cohorts.record("pool-b", METRIC, "a/mover", 100.0)
+    assert "a/mover" not in cohorts.scores("pool-a", METRIC)
+    cohorts.forget("a/s0")
+    assert "a/s0" not in cohorts.members("pool-a")
+
+
+# ---------------------------------------------------------------------
+# engine (unit level)
+# ---------------------------------------------------------------------
+
+
+def observe_n(engine, hc, values, start_run=0):
+    verdicts = []
+    for i, value in enumerate(values):
+        verdicts.append(
+            engine.observe(
+                hc, {METRIC: value}, ok=True, run_id=f"wf-{start_run + i}"
+            )
+        )
+    return verdicts
+
+
+def test_engine_warmup_then_staircase_to_degraded():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    engine = AnalysisEngine(clock, metrics)
+    hc = make_hc(analysis=ANALYSIS_SPEC)
+    verdicts = observe_n(engine, hc, [100.0] * 5 + [70.0] * 4)
+    states = [v.state for v in verdicts]
+    assert states == ["ok"] * 6 + ["warning", "warning", "degraded"]
+    transitions = [v.transition for v in verdicts if v.transition]
+    assert transitions == [("ok", "warning"), ("warning", "degraded")]
+    # the baseline never absorbed the degraded samples
+    assert engine._checks[hc.key].baselines.peek(METRIC).median == 100.0
+    # durable blob rides hc.status and is JSON-serializable
+    blob = json.loads(json.dumps(hc.status.analysis))
+    assert blob["state"] == "degraded"
+    assert blob["baselines"][METRIC]["n"] == 5
+    labels = {"healthcheck_name": "hc-ana", "namespace": "health", "state": "degraded"}
+    assert metrics.sample_value("healthcheck_anomaly_state", labels) == 1.0
+    assert metrics.sample_value(
+        "healthcheck_metric_zscore",
+        {"healthcheck_name": "hc-ana", "namespace": "health", "metric": "mxu_matmul_tflops"},
+    ) == pytest.approx(-6.0)
+
+
+def test_engine_single_outlier_keeps_lazy_ok_and_no_series():
+    metrics = MetricsCollector()
+    engine = AnalysisEngine(FakeClock(), metrics)
+    hc = make_hc(analysis=ANALYSIS_SPEC)
+    verdicts = observe_n(engine, hc, [100.0] * 5 + [70.0] + [100.0] * 3)
+    assert all(v.state == "ok" for v in verdicts)
+    for state in ("ok", "warning", "degraded"):
+        assert (
+            metrics.sample_value(
+                "healthcheck_anomaly_state",
+                {"healthcheck_name": "hc-ana", "namespace": "health", "state": state},
+            )
+            is None
+        )
+
+
+def test_engine_same_run_id_is_observed_once():
+    engine = AnalysisEngine(FakeClock(), None)
+    hc = make_hc(analysis=ANALYSIS_SPEC)
+    for _ in range(10):
+        engine.observe(hc, {METRIC: 100.0}, ok=True, run_id="wf-same")
+    assert engine._checks[hc.key].baselines.peek(METRIC).n == 1
+
+
+def test_engine_failed_runs_never_feed_the_baseline():
+    engine = AnalysisEngine(FakeClock(), None)
+    hc = make_hc(analysis=ANALYSIS_SPEC)
+    observe_n(engine, hc, [100.0] * 5)
+    verdict = engine.observe(hc, {METRIC: 5.0}, ok=False, run_id="wf-fail")
+    assert verdict.state == "ok"
+    assert engine._checks[hc.key].baselines.peek(METRIC).n == 5
+
+
+def test_engine_metrics_filter_and_spec_removal():
+    metrics = MetricsCollector()
+    engine = AnalysisEngine(FakeClock(), metrics)
+    hc = make_hc(analysis={"warmupRuns": 2, "metrics": [METRIC]})
+    engine.observe(
+        hc, {METRIC: 100.0, "other-metric": 1.0}, ok=True, run_id="wf-0"
+    )
+    assert engine._checks[hc.key].baselines.metrics() == [METRIC]
+    # the analysis: block edited off the live spec: state + series drop
+    hc.spec.analysis = None
+    assert engine.observe(hc, {METRIC: 100.0}, ok=True, run_id="wf-1") is None
+    assert hc.key not in engine._checks
+    assert hc.status.analysis is None
+    baseline_labels = {
+        "healthcheck_name": "hc-ana",
+        "namespace": "health",
+        "metric": "mxu_matmul_tflops",
+        "stat": "count",
+    }
+    assert metrics.sample_value("healthcheck_metric_baseline", baseline_labels) is None
+
+
+def test_engine_vanished_metric_decays_instead_of_sticking_degraded():
+    """A metric the probe stops emitting must not hold the check
+    degraded (damped, remedy-triggering) forever — it decays back to
+    ok through the calm hysteresis, and the recovered entry is pruned
+    while its baseline survives for a possible return."""
+    engine = AnalysisEngine(FakeClock(), None)
+    hc = make_hc(analysis={"warmupRuns": 5})  # no metrics[] filter
+    observe_n(engine, hc, [100.0] * 5 + [70.0] * 4)
+    assert engine.state(hc.key) == "degraded"
+    # the probe stops emitting the metric: empty samples on ok runs
+    states = []
+    for i in range(8):
+        verdict = engine.observe(hc, {}, ok=True, run_id=f"wf-gone-{i}")
+        states.append(verdict.state)
+    assert states[-1] == "ok"
+    assert "degraded" not in states[3:]  # decayed, calm_runs per step
+    assert engine._checks[hc.key].hysteresis == {}  # recovered entry pruned
+    assert engine._checks[hc.key].baselines.peek(METRIC).n == 5  # kept
+
+
+def test_engine_metric_filtered_out_drops_its_state_immediately():
+    engine = AnalysisEngine(FakeClock(), None)
+    hc = make_hc(analysis=ANALYSIS_SPEC)
+    observe_n(engine, hc, [100.0] * 5 + [70.0] * 4)
+    assert engine.state(hc.key) == "degraded"
+    # operator edits the filter to a different metric: the old entry
+    # must not keep reporting (the probe still emits it, but it is no
+    # longer under analysis)
+    hc.spec.analysis.metrics = ["other-metric"]
+    verdict = engine.observe(
+        hc, {METRIC: 70.0, "other-metric": 1.0}, ok=True, run_id="wf-x"
+    )
+    assert verdict.state == "ok"
+    assert METRIC not in engine._checks[hc.key].hysteresis
+
+
+def test_removing_the_analysis_block_clears_blob_and_damp():
+    """Spec removal must clear the durable blob even with no live
+    engine state (restart between removal and next run), and the
+    reconciler must lift the analysis schedule damping."""
+    clock = FakeClock()
+    # engine side: durable blob, fresh engine, spec removed
+    hc = make_hc()  # no analysis block
+    hc.status.analysis = {"v": 1, "state": "degraded", "baselines": {}}
+    engine = AnalysisEngine(clock, None)
+    assert engine.observe(hc, {METRIC: 1.0}, ok=True, run_id="wf") is None
+    assert hc.status.analysis is None
+    # reconciler side: degraded damping is lifted once no verdict comes
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=FakeWorkflowEngine(),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    hc2 = make_hc(analysis=ANALYSIS_SPEC)
+    for i, v in enumerate([100.0] * 5 + [70.0] * 4):
+        reconciler._note_analysis(hc2, {METRIC: v}, ok=True, run_id=f"w{i}")
+    assert reconciler.resilience.checks.damp_factor(hc2.key) == 2.0
+    hc2.spec.analysis = None
+    reconciler._note_analysis(hc2, {METRIC: 70.0}, ok=True, run_id="w99")
+    assert reconciler.resilience.checks.damp_factor(hc2.key) == 1.0
+
+
+def test_statusz_zscore_matches_the_exported_gauge():
+    """summary() must report the z the gauge exported at run time, not
+    a recompute against a baseline the sample itself already updated."""
+    metrics = MetricsCollector()
+    engine = AnalysisEngine(FakeClock(), metrics)
+    hc = make_hc(analysis={"warmupRuns": 3})
+    observe_n(engine, hc, [100.0, 101.0, 99.0, 102.0])
+    gauge = metrics.sample_value(
+        "healthcheck_metric_zscore",
+        {"healthcheck_name": "hc-ana", "namespace": "health", "metric": "mxu_matmul_tflops"},
+    )
+    summary = engine.summary(hc)
+    assert summary["metrics"][METRIC]["zscore"] == gauge
+
+
+def test_engine_restores_state_from_durable_status_blob():
+    clock = FakeClock()
+    engine = AnalysisEngine(clock, None)
+    hc = make_hc(analysis=ANALYSIS_SPEC)
+    observe_n(engine, hc, [100.0] * 5 + [70.0] * 4)
+    assert engine.state(hc.key) == "degraded"
+    # "restart": a fresh engine adopts the blob the status write persisted
+    hc2 = make_hc(analysis=ANALYSIS_SPEC)
+    hc2.status.analysis = json.loads(json.dumps(hc.status.analysis))
+    metrics2 = MetricsCollector()
+    engine2 = AnalysisEngine(clock, metrics2)
+    verdict = engine2.observe(hc2, {METRIC: 70.0}, ok=True, run_id="wf-r")
+    assert verdict.state == "degraded"
+    assert verdict.transition is None  # adopted, not re-derived from ok
+    assert engine2._checks[hc2.key].baselines.peek(METRIC).median == 100.0
+    # adoption materialized the one-hot trio immediately
+    assert (
+        metrics2.sample_value(
+            "healthcheck_anomaly_state",
+            {"healthcheck_name": "hc-ana", "namespace": "health", "state": "degraded"},
+        )
+        == 1.0
+    )
+
+
+def test_engine_summary_schema_for_statusz():
+    engine = AnalysisEngine(FakeClock(), None)
+    hc = make_hc(analysis={**ANALYSIS_SPEC, "cohort": "pool-a"})
+    observe_n(engine, hc, [100.0] * 6)
+    summary = engine.summary(hc)
+    assert summary["state"] == "ok"
+    assert summary["cohort"] == "pool-a"
+    assert summary["metrics"][METRIC]["warmed_up"] is True
+    assert summary["metrics"][METRIC]["baseline_median"] == 100.0
+    assert summary["metrics"][METRIC]["last"] == 100.0
+    assert engine.summary(make_hc(name="plain")) is None
+
+
+# ---------------------------------------------------------------------
+# acceptance: scripted FakeClock + FakeEngine end to end
+# ---------------------------------------------------------------------
+
+
+def scripted_engine(values):
+    """FakeEngine whose Nth workflow succeeds on the first poll with
+    the Nth scripted matmul TFLOPs sample in its contract."""
+    engine = FakeWorkflowEngine()
+    queue = collections.deque(values)
+    assigned = {}
+
+    def completer(wf, _count):
+        name = wf["metadata"]["name"]
+        if name not in assigned:
+            if not queue:
+                return None
+            assigned[name] = queue.popleft()
+        contract = json.dumps(
+            {"metrics": [{"name": METRIC, "value": assigned[name]}]}
+        )
+        return {
+            "phase": PHASE_SUCCEEDED,
+            "outputs": {"parameters": [{"name": "metrics", "value": contract}]},
+        }
+
+    engine._default_completer = completer
+    return engine
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+def build_controller(clock, client, values):
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine(values),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+    manager._health_addr = "127.0.0.1:0"
+    return manager, reconciler, metrics
+
+
+async def drive_runs(clock, count, interval=60.0, first=False):
+    for i in range(count):
+        if not first or i > 0:
+            await clock.advance(interval)
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+
+
+STATE_LABELS = lambda state: {  # noqa: E731 - tiny local shorthand
+    "healthcheck_name": "hc-ana",
+    "namespace": "health",
+    "state": state,
+}
+
+
+@pytest.mark.asyncio
+async def test_acceptance_step_degradation_statusz_cli_and_restart():
+    import aiohttp
+
+    from activemonitor_tpu.__main__ import render_status_table
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    values = [100.0] * 5 + [70.0] * 4
+    manager, reconciler, metrics = build_controller(clock, client, values)
+    await manager.start()
+    try:
+        hc = make_hc(analysis=ANALYSIS_SPEC)
+        await client.apply(hc)
+        key = "health/hc-ana"
+
+        # warm-up: 5 runs at 100% — state ok, and the lazy one-hot has
+        # materialized NO series (absence == ok)
+        await drive_runs(clock, 5, first=True)
+        assert reconciler.analysis.state(key) == "ok"
+        for state in ("ok", "warning", "degraded"):
+            assert metrics.sample_value(
+                "healthcheck_anomaly_state", STATE_LABELS(state)
+            ) is None
+
+        # run 6: first 70% sample — a LONE outlier so far, so the
+        # reported state must not move (hysteresis)
+        await drive_runs(clock, 1)
+        assert reconciler.analysis.state(key) == "ok"
+
+        # run 7: deviation confirmed — ok -> warning
+        await drive_runs(clock, 1)
+        assert reconciler.analysis.state(key) == "warning"
+        assert metrics.sample_value(
+            "healthcheck_anomaly_state", STATE_LABELS("warning")
+        ) == 1.0
+        assert metrics.sample_value(
+            "healthcheck_anomaly_state", STATE_LABELS("degraded")
+        ) == 0.0
+
+        # runs 8-9: warning -> degraded (one step per confirmed streak)
+        await drive_runs(clock, 2)
+        assert reconciler.analysis.state(key) == "degraded"
+        assert metrics.sample_value(
+            "healthcheck_anomaly_state", STATE_LABELS("degraded")
+        ) == 1.0
+        assert metrics.sample_value(
+            "healthcheck_anomaly_state", STATE_LABELS("warning")
+        ) == 0.0
+        # the z-score gauge carries the deviation, the baseline held at 100
+        assert metrics.sample_value(
+            "healthcheck_metric_zscore",
+            {
+                "healthcheck_name": "hc-ana",
+                "namespace": "health",
+                "metric": "mxu_matmul_tflops",
+            },
+        ) == pytest.approx(-6.0)
+        assert metrics.sample_value(
+            "healthcheck_metric_baseline",
+            {
+                "healthcheck_name": "hc-ana",
+                "namespace": "health",
+                "metric": "mxu_matmul_tflops",
+                "stat": "median",
+            },
+        ) == 100.0
+        # degraded damps the schedule through the flap tracker's factor
+        assert reconciler.resilience.checks.damp_factor(key) == 2.0
+        # the run history carries the numeric samples (satellite: ring)
+        last = reconciler.fleet.history.last(key)
+        assert last.metrics == {METRIC: 70.0}
+
+        # /statusz surfaces the degraded mark...
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port}/statusz") as r:
+                assert r.status == 200
+                payload = await r.json()
+        [entry] = payload["checks"]
+        assert entry["analysis"]["state"] == "degraded"
+        assert entry["analysis"]["metrics"][METRIC]["state"] == "degraded"
+        assert payload["fleet"]["anomalies"] == {"warning": 0, "degraded": 1}
+        # ... and the am-tpu status table shows it in the ANOMALY column
+        table = render_status_table(payload)
+        header, row = table.splitlines()[1], table.splitlines()[2]
+        assert header.split()[4] == "ANOMALY"
+        assert row.split()[4] == "degraded"
+
+        # the durable status carries the baseline blob the next
+        # controller incarnation will adopt
+        durable = await client.get("health", "hc-ana")
+        assert durable.status.analysis["state"] == "degraded"
+        assert durable.status.analysis["baselines"][METRIC]["n"] == 5
+    finally:
+        await manager.stop()
+
+    # ---- simulated controller restart: fresh reconciler/engine/metrics
+    # over the same durable store; the baseline and the degraded verdict
+    # must come back from .status.analysis, not re-warm from scratch
+    manager2, reconciler2, metrics2 = build_controller(clock, client, [70.0])
+    await manager2.start()
+    try:
+        await settle()
+        # the resumed schedule re-arms from durable status; fire it
+        # (damped-interval upper bound: advance generously)
+        await clock.advance(121.0)
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+        key = "health/hc-ana"
+        assert reconciler2.analysis.state(key) == "degraded"
+        baseline = reconciler2.analysis._checks[key].baselines.peek(METRIC)
+        assert baseline.n == 5  # restored, not re-learned
+        assert baseline.median == 100.0
+        assert metrics2.sample_value(
+            "healthcheck_anomaly_state", STATE_LABELS("degraded")
+        ) == 1.0
+    finally:
+        await manager2.stop()
+
+
+@pytest.mark.asyncio
+async def test_acceptance_single_outlier_does_not_flap_end_to_end():
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    values = [100.0] * 5 + [70.0] + [100.0] * 2
+    manager, reconciler, metrics = build_controller(clock, client, values)
+    await manager.start()
+    try:
+        await client.apply(make_hc(analysis=ANALYSIS_SPEC))
+        await drive_runs(clock, len(values), first=True)
+        assert reconciler.analysis.state("health/hc-ana") == "ok"
+        # never left ok ⇒ zero anomaly series (cardinality contract)
+        for state in ("ok", "warning", "degraded"):
+            assert metrics.sample_value(
+                "healthcheck_anomaly_state", STATE_LABELS(state)
+            ) is None
+        # and no schedule damping was requested
+        assert reconciler.resilience.checks.damp_factor("health/hc-ana") == 1.0
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_trigger_on_degraded_runs_the_remedy_on_a_passing_run():
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    # remedy workflows are submitted through the same engine; the
+    # completer hands every unseen workflow the next scripted value, so
+    # append values for the remedy runs too
+    values = [100.0] * 5 + [70.0] * 4 + [70.0] * 3
+    manager, reconciler, metrics = build_controller(clock, client, values)
+    engine = reconciler.engine
+    await manager.start()
+    try:
+        hc = make_hc(
+            analysis={**ANALYSIS_SPEC, "triggerOnDegraded": True}, remedy=True
+        )
+        await client.apply(hc)
+        await drive_runs(clock, 9, first=True)
+        assert reconciler.analysis.state("health/hc-ana") == "degraded"
+        remedy_runs = [
+            wf
+            for wf in engine.submitted
+            if wf["metadata"]["name"].startswith("hc-ana-remedy-")
+        ]
+        # run 9 confirmed the degradation: exactly its remedy fired,
+        # even though every probe run SUCCEEDED
+        assert len(remedy_runs) == 1
+        durable = await client.get("health", "hc-ana")
+        assert durable.status.remedy_total_runs == 1
+    finally:
+        await manager.stop()

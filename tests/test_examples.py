@@ -72,6 +72,22 @@ def test_feature_matrix_coverage():
         "withItems" in (hc.spec.workflow.resource.source.inline or "")
         for hc in all_checks
     )
+    # baseline & anomaly detection opt-in (docs/analysis.md)
+    assert any(hc.spec.analysis is not None for hc in all_checks)
+
+
+def test_analysis_baseline_example_declares_the_full_block():
+    (hc,) = load_healthchecks("examples/tpu/analysis-baseline.yaml")
+    analysis = hc.spec.analysis
+    assert analysis is not None
+    assert analysis.cohort == "v5e-pool-a"
+    assert analysis.warmup_runs == 5
+    assert analysis.z_threshold == 3.0
+    assert "mxu-matmul-tflops" in analysis.metrics
+    assert analysis.trigger_on_degraded is False
+    # the example still parses into a submittable manifest
+    wf = parse_workflow_from_healthcheck(hc)
+    assert wf["kind"] == "Workflow"
 
 
 def test_loops_example_passes_withitems_through():

@@ -412,6 +412,51 @@ def test_resilience_metric_families_are_pinned():
         assert family in contract.PINNED_FAMILIES, family
 
 
+def test_analysis_metric_families_are_pinned():
+    """The ISSUE-4 families must stay in the exposition contract — a
+    rename silently breaks baseline dashboards and anomaly alerts."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_analysis", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_metric_baseline",
+        "healthcheck_metric_zscore",
+        "healthcheck_anomaly_state",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
+def test_wallclock_banned_in_analysis_package(tmp_path):
+    """analysis/ baselines are stamped on the injectable Clock so
+    fake-clock tests can script exact warm-up windows — the same
+    wall-clock ban as resilience/, with the package in the code."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+    )
+    ana_dir = tmp_path / "analysis"
+    ana_dir.mkdir()
+    (ana_dir / "mod.py").write_text(source)
+    got = lint.lint_file(ana_dir / "mod.py")
+    assert {line.split(": ")[1] for line in got} == {"wallclock-in-analysis"}
+
+
+def test_analysis_package_really_is_wallclock_free():
+    """The gate, applied to the shipped analysis/ package (path-scoping
+    regression guard, like the resilience twin above)."""
+    package = REPO / "activemonitor_tpu" / "analysis"
+    files = sorted(package.rglob("*.py"))
+    assert files, "analysis package missing?"
+    for path in files:
+        assert lint.lint_file(path) == []
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock
+
+
 def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
     got = findings(
         tmp_path,

@@ -212,6 +212,11 @@ PINNED_FAMILIES = {
     "healthcheck_status_write_queue_depth": "gauge",
     "healthcheck_check_state": "gauge",
     "healthcheck_remedy_runs_total": "counter",
+    # analysis families (ISSUE 4: baseline & anomaly detection —
+    # docs/analysis.md)
+    "healthcheck_metric_baseline": "gauge",
+    "healthcheck_metric_zscore": "gauge",
+    "healthcheck_anomaly_state": "gauge",
     "controller_runtime_reconcile_total": "counter",
     "controller_runtime_reconcile_time_seconds": "histogram",
     "controller_runtime_active_workers": "gauge",
@@ -246,6 +251,13 @@ def exercise_every_family(collector):
     # deliberately carry no state series — cardinality contract)
     collector.set_check_state("hc-a", "health", "Flapping")
     collector.record_remedy_run("hc-a", "health", "admitted")
+    # analysis families; a non-ok state materializes the anomaly trio
+    # (same laziness contract as check_state)
+    collector.set_metric_baseline(
+        "hc-a", "health", "m", mean=1.0, std=0.1, median=1.0, mad=0.05, count=5
+    )
+    collector.set_metric_zscore("hc-a", "health", "m", -2.0)
+    collector.set_anomaly_state("hc-a", "health", "warning")
     collector.cadence_goodput.set(1.0)
     collector.set_fleet_goodput(1.0)
     collector.set_slo(
@@ -448,6 +460,63 @@ def test_custom_metric_type_conflict_is_skipped(collector):
 def test_negative_counter_increment_is_skipped(collector):
     entry = {"name": "errs", "value": -1, "metrictype": "counter"}
     assert collector.record_custom_metrics("hc", custom_status(entry)) == 0
+
+
+def test_same_run_id_records_custom_metrics_exactly_once(collector):
+    """Regression (ISSUE 4 satellite): the reconciler can reach one
+    run's terminal status through more than one path (live poll AND a
+    replayed/requeued status) — counter metrics are per-run increments,
+    so a second recording keyed by the same workflow run id must be a
+    no-op, while a NEW run id records normally."""
+    entry = {"name": "probe-errors", "value": 2, "metrictype": "counter"}
+    status = custom_status(entry, timings={"p": 1.0})
+    labels = {"healthcheck_name": "hc"}
+    assert collector.record_custom_metrics("hc", status, run_id="wf-1") == 1
+    # the duplicate path replays the same run: nothing recorded
+    assert collector.record_custom_metrics("hc", status, run_id="wf-1") == 0
+    assert collector.sample_value("hc_probe_errors_total", labels) == 2
+    # the timings block is deduped on the same key
+    assert (
+        collector.sample_value(
+            "healthcheck_phase_seconds_count",
+            {"healthcheck_name": "hc", "phase": "p"},
+        )
+        == 1
+    )
+    # the next run increments again; no run id keeps legacy semantics
+    assert collector.record_custom_metrics("hc", status, run_id="wf-2") == 1
+    assert collector.record_custom_metrics("hc", status) == 1
+    assert collector.sample_value("hc_probe_errors_total", labels) == 6
+    # same run id under a DIFFERENT check is a different run
+    assert collector.record_custom_metrics("hc2", status, run_id="wf-1") == 1
+
+
+def test_recorded_run_memory_is_bounded(collector):
+    cap = collector.RECORDED_RUN_CAPACITY
+    status = custom_status({"name": "v", "value": 1.0})
+    for i in range(cap + 50):
+        collector.record_custom_metrics("hc", status, run_id=f"wf-{i}")
+    assert len(collector._recorded_runs) == cap
+    # the oldest ids were evicted, so (only) they would record again
+    assert collector.record_custom_metrics("hc", status, run_id="wf-0") == 1
+    assert (
+        collector.record_custom_metrics("hc", status, run_id=f"wf-{cap + 49}")
+        == 0
+    )
+
+
+def test_parse_custom_samples_reads_without_recording(collector):
+    status = custom_status(
+        {"name": "bw-gbps", "value": 123.5},
+        {"name": "errs", "value": 2, "metrictype": "counter"},
+        {"name": "bad", "value": "not-a-number"},
+    )
+    samples = MetricsCollector.parse_custom_samples(status)
+    assert samples == {"bw-gbps": 123.5, "errs": 2.0}
+    # pure read: nothing landed in the registry
+    assert collector.sample_value("hc_bw_gbps", {"healthcheck_name": "hc"}) is None
+    assert MetricsCollector.parse_custom_samples({}) == {}
+    assert MetricsCollector.parse_custom_samples({"outputs": None}) == {}
 
 
 def test_malformed_timings_entries_are_skipped(collector):

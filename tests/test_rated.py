@@ -1,0 +1,72 @@
+"""probes/rated.py env-override parsing: the rated tables are the
+denominator of every fraction-of-rated verdict, so a malformed override
+must fall back to the table value with a warning — never crash a probe
+or hand it a zero/negative/NaN denominator."""
+
+import logging
+
+import pytest
+
+from activemonitor_tpu.probes.rated import _override, rated_for
+
+ENV = "ACTIVEMONITOR_RATED_BF16_TFLOPS"
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    monkeypatch.delenv(ENV, raising=False)
+    yield
+
+
+def test_unset_env_uses_table_value():
+    assert _override(197.0, ENV) == 197.0
+
+
+def test_valid_override_applies(monkeypatch):
+    monkeypatch.setenv(ENV, "210.5")
+    assert _override(197.0, ENV) == 210.5
+
+
+@pytest.mark.parametrize("raw", ["", "   "])
+def test_empty_env_falls_back_silently(monkeypatch, raw, caplog):
+    monkeypatch.setenv(ENV, raw)
+    with caplog.at_level(logging.WARNING):
+        assert _override(197.0, ENV) == 197.0
+    assert caplog.records == []  # empty = unset, not an error
+
+
+@pytest.mark.parametrize("raw", ["fast", "1.2.3", "12 tflops"])
+def test_non_numeric_env_falls_back_with_warning(monkeypatch, raw, caplog):
+    monkeypatch.setenv(ENV, raw)
+    with caplog.at_level(logging.WARNING):
+        assert _override(197.0, ENV) == 197.0
+    assert any("not a number" in r.message for r in caplog.records)
+
+
+@pytest.mark.parametrize("raw", ["-45", "0", "nan", "inf", "-inf"])
+def test_nonpositive_or_nonfinite_env_falls_back_with_warning(
+    monkeypatch, raw, caplog
+):
+    monkeypatch.setenv(ENV, raw)
+    with caplog.at_level(logging.WARNING):
+        assert _override(197.0, ENV) == 197.0
+    assert any("positive and finite" in r.message for r in caplog.records)
+
+
+def test_rated_for_survives_bad_override_end_to_end(monkeypatch, caplog):
+    """The probe-facing entry point: a bad env never crashes rated_for
+    and the returned spec carries the table figures."""
+    monkeypatch.setenv(ENV, "garbage")
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_ICI_GBPS", "-1")
+    with caplog.at_level(logging.WARNING):
+        spec = rated_for("TPU v5 lite")
+    assert spec is not None
+    assert spec.bf16_tflops == 197.0
+    assert spec.ici_unidir_gbps == 45.0
+    assert len(caplog.records) >= 2
+
+
+def test_rated_for_applies_good_override(monkeypatch):
+    monkeypatch.setenv(ENV, "200")
+    spec = rated_for("TPU v5 lite")
+    assert spec.bf16_tflops == 200.0

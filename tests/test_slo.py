@@ -337,12 +337,16 @@ FLEET_FIELDS = {
     "breaker": (dict, type(None)),
     "status_writes_queued": int,
     "remedy_tokens": (int, float, type(None)),
+    # anomaly rollup (ISSUE 4): checks per non-ok analysis state
+    "anomalies": dict,
 }
 CHECK_FIELDS = {
     "key": str,
     "healthcheck": str,
     "namespace": str,
     "state": str,  # healthy | flapping | quarantined
+    # baseline-analysis verdict (ISSUE 4): None without an analysis: block
+    "analysis": (dict, type(None)),
     "remedy_budget_remaining": (int, type(None)),
     "last_status": str,
     "last_trace_id": str,
@@ -372,6 +376,9 @@ HISTORY_FIELDS = {
     "latency_seconds": (int, float),
     "workflow": str,
     "trace_id": str,
+    # the run's numeric metric samples (ISSUE 4: detectors and /debug
+    # endpoints read them from the ring)
+    "metrics": dict,
 }
 BREAKER_FIELDS = {
     "name": str,
@@ -739,7 +746,7 @@ def test_render_status_table_shapes_rows():
     assert "goodput=50.0%" in lines[0]
     header, row = lines[1], lines[2]
     assert header.split() == [
-        "NAME", "NAMESPACE", "STATUS", "STATE", "RUNS", "AVAIL",
+        "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
         "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST", "TRACE",
     ]
     cells = row.split()
