@@ -173,7 +173,7 @@ def flash_attention_fn(cfg: ProbeModelConfig, mesh=None, axis: str = "model"):
     dense path), shard_map needs the heads dim to divide evenly — a
     too-large tp axis is rejected up front with the actual constraint
     rather than a trace-time shape error."""
-    from jax import shard_map
+    from activemonitor_tpu.utils.compat import shard_map
 
     from activemonitor_tpu.ops.flash_attention import flash_attention
 

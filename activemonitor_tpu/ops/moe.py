@@ -23,7 +23,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from activemonitor_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

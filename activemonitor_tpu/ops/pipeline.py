@@ -29,7 +29,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from activemonitor_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from activemonitor_tpu.models.probe_model import ProbeModelConfig, apply_block
@@ -65,6 +65,7 @@ def pipeline_forward_blocks(
     axis: str = "pp",
     num_microbatches: int = 0,
     composed: bool = False,
+    overlap: bool = False,
 ) -> jax.Array:
     """Run the block stack over ``x`` [B, S, D] with the layers
     pipelined across ``mesh[axis]``. Embedding/head stay outside (they
@@ -80,6 +81,19 @@ def pipeline_forward_blocks(
     by XLA from the sharding annotations, the scaling-book split of
     labor). Composed mode must run under ``jax.jit`` — partially-manual
     shard_map has no eager path (JAX 0.9 rejects it outside a trace).
+
+    With ``overlap=True`` the schedule pre-rotates stage activations:
+    each tick first ISSUES the ppermute of the previous tick's output
+    (an ``optimization_barrier`` pins the send ahead of the compute in
+    the schedule) and then runs this tick's stage compute on the
+    activation that arrived last tick — per-tick ICI time hides under
+    layer math instead of serializing after it. The stage boundary
+    gains one tick of latency, so fill/drain stretches from S−1 to
+    2(S−1) bubble ticks (M + 2(S−1) total): a win when hop time is a
+    visible slice of tick time (comm-bound), a small loss when
+    microbatches are so small that bubbles dominate (docs/training.md
+    "Compute–communication overlap"). Numerics are identical either
+    way — the schedule only changes WHEN activations ride the links.
     """
     n_stages = mesh.shape[axis]
     batch = x.shape[0]
@@ -122,30 +136,57 @@ def pipeline_forward_blocks(
         stage = jax.lax.axis_index(axis)
         mb_shape = micro_all.shape[1:]
 
+        def bank(outputs, y, out_idx):
+            """The last stage banks microbatch ``out_idx`` when real."""
+            valid = (stage == n_stages - 1) & (out_idx >= 0)
+            return jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(valid, y, outputs[jnp.clip(out_idx, 0, m - 1)]),
+                jnp.clip(out_idx, 0, m - 1),
+                axis=0,
+            )
+
         def tick(carry, t):
             act, outputs = carry
             # stage 0 injects microbatch t (clamped; bubbles are masked)
             inject = jnp.clip(t, 0, m - 1)
             x_in = jnp.where(stage == 0, micro_all[inject], act)
             y = stage_apply(local_layers, x_in)
-            # the last stage banks microbatch t-(S-1) when it's real
-            out_idx = t - (n_stages - 1)
-            valid = (stage == n_stages - 1) & (out_idx >= 0)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs,
-                jnp.where(valid, y, outputs[jnp.clip(out_idx, 0, m - 1)]),
-                jnp.clip(out_idx, 0, m - 1),
-                axis=0,
-            )
+            outputs = bank(outputs, y, t - (n_stages - 1))
             # hand activations to the next stage
             act = jax.lax.ppermute(y, axis, perm)
             return (act, outputs), None
 
+        def tick_overlap(carry, t):
+            act_recv, y_prev, outputs = carry
+            # pre-rotate: last tick's output starts its hop NOW, riding
+            # the links while this tick's stage compute runs (the
+            # barrier pins the send ahead of the compute)
+            act_next = jax.lax.ppermute(y_prev, axis, perm)
+            act_next, act_recv = jax.lax.optimization_barrier(
+                (act_next, act_recv)
+            )
+            inject = jnp.clip(t, 0, m - 1)
+            x_in = jnp.where(stage == 0, micro_all[inject], act_recv)
+            y = stage_apply(local_layers, x_in)
+            # each stage boundary costs 2 ticks (compute, then the
+            # overlapped transfer lands next tick): stage s runs
+            # microbatch t - 2s, the last stage banks t - 2(S-1)
+            outputs = bank(outputs, y, t - 2 * (n_stages - 1))
+            return (act_next, y, outputs), None
+
         act0 = jnp.zeros(mb_shape, micro_all.dtype)
         outputs0 = jnp.zeros((m, *mb_shape), micro_all.dtype)
-        (_, outputs), _ = jax.lax.scan(
-            tick, (act0, outputs0), jnp.arange(m + n_stages - 1)
-        )
+        if overlap:
+            (_, _, outputs), _ = jax.lax.scan(
+                tick_overlap,
+                (act0, act0, outputs0),
+                jnp.arange(m + 2 * (n_stages - 1)),
+            )
+        else:
+            (_, outputs), _ = jax.lax.scan(
+                tick, (act0, outputs0), jnp.arange(m + n_stages - 1)
+            )
         # broadcast the last stage's collected outputs to every stage
         is_last = (stage == n_stages - 1).astype(outputs.dtype)
         return jax.lax.psum(outputs * is_last, axis)

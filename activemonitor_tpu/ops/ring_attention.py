@@ -4,24 +4,51 @@ The long-context path of the framework: the sequence axis is sharded
 across devices, K/V blocks rotate around the ring via ``ppermute``
 while each device accumulates attention for its resident Q block with
 an online (flash-style) softmax — peak memory stays O(S/n) per device
-and all communication is neighbor-hop ICI traffic that overlaps with
-block compute under XLA's scheduler.
+and all communication is neighbor-hop ICI traffic.
+
+THREE SCHEDULES, one merge contract (``variant=``):
+
+- ``"serial"`` — attend the resident block, THEN move K/V. Every hop's
+  ICI time sits on the critical path between block attends. Kept as the
+  measured baseline the ``ring-overlap-efficiency`` probe metric
+  compares against; numerically it is the bitwise reference for the
+  overlapped schedule.
+- ``"overlap"`` (default) — double-buffered: the next-hop ``ppermute``
+  is issued BEFORE the resident block's attend (two-slot carry, a
+  ``lax.optimization_barrier`` pins the transfer ahead of the compute
+  in the schedule), so per-step ICI time hides under attention math.
+  Same blocks merged in the same order as serial ⇒ bit-identical
+  output, lse, and gradients.
+- ``"bidir"`` — K/V split into sequence halves permuted clockwise /
+  counter-clockwise simultaneously, driving BOTH directions of each
+  ICI link per hop (half the per-hop wire time on full-duplex links,
+  the NCCL bidirectional-ring trick). Step 0 attends the full local
+  (diagonal) block while the first hops are in flight; later steps
+  merge one half per direction. Merge ORDER differs from serial, so
+  agreement is numerical (same online-softmax state), not bitwise.
+
+Every schedule performs exactly n−1 K/V hops per direction: the old
+"send the blocks home" final rotation was a full-payload ppermute per
+call doing nothing (the homeward K/V are discarded), and is gone. The
+backward's dK/dV accumulators still make n hops — their last hop
+carries real gradients home.
 
 TRAINING-GRADE: the op carries a ``jax.custom_vjp``. The forward scan
 also produces the GLOBAL logsumexp per query row; the backward runs a
-second ring pass that rotates K/V again and recomputes each block's
-probabilities as ``p = exp(s − lse_global)`` — exact global attention
-probabilities, so per-block dK/dV contributions sum exactly. The dK/dV
-accumulators rotate WITH their K/V blocks (the accumulator for block j
-starts at home, visits every device collecting that device's Q-block
-contribution, and lands home after n hops), keeping backward memory
-O(S/n) per device too — the sequence-parallel axis can appear in a
-differentiated train step (build_sharded_train_step(attention="ring")).
+second ring pass that rotates K/V again (same variant schedule) and
+recomputes each block's probabilities as ``p = exp(s − lse_global)`` —
+exact global attention probabilities, so per-block dK/dV contributions
+sum exactly. The dK/dV accumulators rotate WITH their K/V blocks,
+keeping backward memory O(S/n) per device too — the sequence-parallel
+axis can appear in a differentiated train step
+(build_sharded_train_step(attention="ring")).
 
 Used by the ``ring-attention`` probe both as a correctness check
 (sequence-parallel result must match single-device attention) and as a
-sequence-parallelism bandwidth/throughput canary for long-context
-workloads.
+sequence-parallelism bandwidth/throughput canary — the probe times the
+serial schedule against the overlapped one and exports the ratio as
+``ring-overlap-efficiency`` plus the sustained fraction of rated ICI
+ring bandwidth.
 
 Shapes inside ``shard_map`` (per device): q, k, v are
 ``[batch, seq_local, heads, head_dim]``; the global sequence is
@@ -37,10 +64,46 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from activemonitor_tpu.utils.compat import shard_map
+
 _NEG_INF = -1e30
+
+VARIANTS = ("serial", "overlap", "bidir")
+
+# Test hook: when set to a list, every ring hop TRACED appends
+# (tag, direction). With ``unroll=True`` (python-loop schedule, same
+# body) each hop traces individually, so the log length IS the hop
+# count — tests assert the n−1-hop contract without HLO spelunking.
+_HOP_LOG = None
+
+
+def _hop(x, axis_name, perm, tag, direction="cw"):
+    """One ring hop (neighbor ppermute), routed through a single site so
+    the traced-hop counter sees every transfer a schedule issues."""
+    if _HOP_LOG is not None:
+        _HOP_LOG.append((tag, direction))
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def _run_steps(body, carry, n_steps, unroll, start=0):
+    """Drive ``body(carry, step)`` for steps start..start+n_steps−1.
+
+    Default is ``lax.scan`` — one traced step regardless of ring size,
+    so compile time and HLO size stay flat as slices grow.
+    ``unroll=True`` runs the SAME body in a python loop: numerics are
+    identical, but each hop traces individually for ``_HOP_LOG``."""
+    if n_steps <= 0:
+        return carry
+    if unroll:
+        for step in range(start, start + n_steps):
+            carry, _ = body(carry, step)
+        return carry
+    carry, _ = jax.lax.scan(
+        body, carry, jnp.arange(start, start + n_steps)
+    )
+    return carry
 
 
 def _block_attend(q, k, v, mask):
@@ -81,16 +144,28 @@ def _block_attend(q, k, v, mask):
     )
 
 
+def _flash_half_ok(use_flash: bool, seq_q: int, half_len: int) -> bool:
+    """The fused partial kernel tiles 8-aligned sequences only; a half
+    K/V block that doesn't tile falls back to the einsum block compute
+    (same merge contract, so the mixture is invisible to the merge)."""
+    return (
+        use_flash
+        and seq_q % 8 == 0
+        and half_len % 8 == 0
+        and half_len > 0
+    )
+
+
 def _ring_attention_sharded(
-    q, k, v, *, axis_name: str, n_devices: int, causal: bool, use_flash: bool
+    q, k, v, *, axis_name: str, n_devices: int, causal: bool,
+    use_flash: bool, variant: str = "overlap", unroll: bool = False,
 ):
     """Body run per device inside shard_map; returns ``(out, lse)``
     where ``lse`` is the GLOBAL logsumexp per query row (the backward
-    pass's residual). The ring rotation is a ``lax.scan`` — one traced
-    step regardless of ring size, so compile time and HLO size stay
-    flat as slices grow. With ``use_flash`` the per-step block compute
-    runs the fused Pallas kernel (ops/flash_attention.py partial mode)
-    instead of XLA einsums — same (max, unnormalized out, denom) merge
+    pass's residual). See the module docstring for the three schedule
+    variants. With ``use_flash`` the per-step block compute runs the
+    fused Pallas kernel (ops/flash_attention.py partial mode) instead
+    of XLA einsums — same (max, unnormalized out, denom) merge
     contract, but the local score matrix stays in VMEM."""
     my_idx = jax.lax.axis_index(axis_name)
     batch, seq_local, heads, head_dim = q.shape
@@ -102,28 +177,22 @@ def _ring_attention_sharded(
         from activemonitor_tpu.ops.flash_attention import flash_attention_partial
 
     qf = q.astype(jnp.float32)
-    init = (
-        k,  # rotated in input dtype — bf16 inputs keep bf16 ICI traffic
-        v,
-        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # acc
-        jnp.zeros((batch, heads, seq_local), jnp.float32),  # denom
-        jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),  # running max
-    )
 
-    def step_fn(carry, step):
-        kf, vf, acc, denom, running_max = carry
-        kv_idx = (my_idx - step) % n_devices  # owner of the current K/V block
-        def skip(_q_in, _kf, _vf):
-            # one skip state for every branch construct below: a
-            # (NEG_INF max, zero acc, zero denom) triple the merge
-            # treats as an empty block (operands arrive because every
-            # lax.cond branch shares the signature)
-            return (
-                jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),
-                jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),
-                jnp.zeros((batch, heads, seq_local), jnp.float32),
-            )
+    def skip(_q_in, _kf, _vf):
+        # one skip state for every branch construct below: a
+        # (NEG_INF max, zero acc, zero denom) triple the merge
+        # treats as an empty block (operands arrive because every
+        # lax.cond branch shares the signature)
+        return (
+            jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),
+            jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),
+            jnp.zeros((batch, heads, seq_local), jnp.float32),
+        )
 
+    def attend_block(kv_idx, kf, vf):
+        """(max, unnormalized out, denom) for the full K/V block owned
+        by ring position ``kv_idx``, with the causal skip/diag/full
+        selection."""
         if use_flash:
             # fused path: diagonal block runs the causal kernel, earlier
             # blocks the unmasked one — two pallas variants under
@@ -142,12 +211,11 @@ def _ring_attention_sharded(
                     (kv_idx < my_idx).astype(jnp.int32)
                     + 2 * (kv_idx == my_idx).astype(jnp.int32)
                 )  # 0 = skip (kv after us), 1 = full, 2 = diagonal
-                block_max, block_out, block_denom = jax.lax.switch(
+                return jax.lax.switch(
                     branch, (skip, attend_full, attend_diag), q, kf, vf
                 )
-            else:
-                block_max, block_out, block_denom = attend_full(q, kf, vf)
-        elif causal:
+            return attend_full(q, kf, vf)
+        if causal:
             # kv block strictly after our q block ⇒ nothing to attend:
             # skip the einsums entirely (lax.cond, so the dead ~half of
             # the causal grid costs nothing at runtime); diagonal block
@@ -158,11 +226,14 @@ def _ring_attention_sharded(
                 )
                 return _block_attend(qf, kf, vf, mask)
 
-            block_max, block_out, block_denom = jax.lax.cond(
-                kv_idx > my_idx, skip, attend, qf, kf, vf
-            )
-        else:
-            block_max, block_out, block_denom = _block_attend(qf, kf, vf, None)
+            return jax.lax.cond(kv_idx > my_idx, skip, attend, qf, kf, vf)
+        return _block_attend(qf, kf, vf, None)
+
+    def merge(stats, block):
+        """Online-softmax merge — the one contract every schedule and
+        both block-compute paths share."""
+        acc, denom, running_max = stats
+        block_max, block_out, block_denom = block
         new_max = jnp.maximum(running_max, block_max)
         old_scale = jnp.exp(running_max - new_max)
         blk_scale = jnp.exp(block_max - new_max)
@@ -170,15 +241,117 @@ def _ring_attention_sharded(
             blk_scale.transpose(0, 2, 1)[..., None]
         )
         denom = denom * old_scale + block_denom * blk_scale
-        # rotate K/V to the next neighbor (the final rotation returns
-        # them home — a no-op cost-wise next to n-1 real hops)
-        kf = jax.lax.ppermute(kf, axis_name, perm)
-        vf = jax.lax.ppermute(vf, axis_name, perm)
-        return (kf, vf, acc, denom, new_max), None
+        return acc, denom, new_max
 
-    (_, _, acc, denom, running_max), _ = jax.lax.scan(
-        step_fn, init, jnp.arange(n_devices)
+    stats0 = (
+        jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32),  # acc
+        jnp.zeros((batch, heads, seq_local), jnp.float32),  # denom
+        jnp.full((batch, heads, seq_local), _NEG_INF, jnp.float32),  # max
     )
+
+    if variant == "bidir":
+        def attend_diag_full(kf, vf):
+            if use_flash:
+                return flash_attention_partial(q, kf, vf, causal=causal)
+            return _block_attend(qf, kf, vf, causal_mask if causal else None)
+
+        def attend_half(kv_idx, kh, vh):
+            """Full-or-skip attend for a half K/V block from ring
+            position ``kv_idx`` — halves only ride for steps ≥ 1, so
+            the diagonal never lands here and no mask is needed."""
+            def attend(q_in, kh, vh):
+                if _flash_half_ok(use_flash, seq_local, kh.shape[1]):
+                    return flash_attention_partial(q_in, kh, vh, causal=False)
+                return _block_attend(q_in.astype(jnp.float32), kh, vh, None)
+
+            if causal:
+                return jax.lax.cond(kv_idx > my_idx, skip, attend, q, kh, vh)
+            return attend(q, kh, vh)
+
+        if n_devices == 1:
+            stats = merge(stats0, attend_diag_full(k, v))
+        else:
+            half = seq_local // 2
+            perm_ccw = [(i, (i - 1) % n_devices) for i in range(n_devices)]
+            k_cw, k_ccw = k[:, :half], k[:, half:]
+            v_cw, v_ccw = v[:, :half], v[:, half:]
+            # the first hop of each direction rides under the diagonal
+            # attend — both ICI link directions are busy from step 0
+            k_cw = _hop(k_cw, axis_name, perm, "k", "cw")
+            v_cw = _hop(v_cw, axis_name, perm, "v", "cw")
+            k_ccw = _hop(k_ccw, axis_name, perm_ccw, "k", "ccw")
+            v_ccw = _hop(v_ccw, axis_name, perm_ccw, "v", "ccw")
+            (k_cw, v_cw, k_ccw, v_ccw), (kd, vd) = jax.lax.optimization_barrier(
+                ((k_cw, v_cw, k_ccw, v_ccw), (k, v))
+            )
+            stats = merge(stats0, attend_diag_full(kd, vd))
+
+            def step_fn(carry, t):
+                k_cw, v_cw, k_ccw, v_ccw, stats = carry
+                kn_cw = _hop(k_cw, axis_name, perm, "k", "cw")
+                vn_cw = _hop(v_cw, axis_name, perm, "v", "cw")
+                kn_ccw = _hop(k_ccw, axis_name, perm_ccw, "k", "ccw")
+                vn_ccw = _hop(v_ccw, axis_name, perm_ccw, "v", "ccw")
+                (kn_cw, vn_cw, kn_ccw, vn_ccw), (k_cw, v_cw, k_ccw, v_ccw) = (
+                    jax.lax.optimization_barrier(
+                        ((kn_cw, vn_cw, kn_ccw, vn_ccw),
+                         (k_cw, v_cw, k_ccw, v_ccw))
+                    )
+                )
+                stats = merge(
+                    stats, attend_half((my_idx - t) % n_devices, k_cw, v_cw)
+                )
+                stats = merge(
+                    stats, attend_half((my_idx + t) % n_devices, k_ccw, v_ccw)
+                )
+                return (kn_cw, vn_cw, kn_ccw, vn_ccw, stats), None
+
+            # steps 1..n−2 prefetch inside the loop; the last pair of
+            # halves attends in place — n−1 hops per direction, no
+            # homeward rotation
+            carry = _run_steps(
+                step_fn, (k_cw, v_cw, k_ccw, v_ccw, stats),
+                n_devices - 2, unroll, start=1,
+            )
+            k_cw, v_cw, k_ccw, v_ccw, stats = carry
+            t_last = n_devices - 1
+            stats = merge(
+                stats, attend_half((my_idx - t_last) % n_devices, k_cw, v_cw)
+            )
+            stats = merge(
+                stats, attend_half((my_idx + t_last) % n_devices, k_ccw, v_ccw)
+            )
+    else:
+        def step_fn(carry, step):
+            kf, vf, stats = carry
+            kv_idx = (my_idx - step) % n_devices  # owner of the resident block
+            if variant == "overlap":
+                # double-buffered: issue the next-hop transfer BEFORE
+                # the block attend — the ppermute rides the ICI links
+                # while the MXU works; the barrier pins the collective
+                # ahead of the compute it should hide under
+                k_next = _hop(kf, axis_name, perm, "k")
+                v_next = _hop(vf, axis_name, perm, "v")
+                (k_next, v_next), (kf, vf) = jax.lax.optimization_barrier(
+                    ((k_next, v_next), (kf, vf))
+                )
+                stats = merge(stats, attend_block(kv_idx, kf, vf))
+            else:  # serial: attend, THEN move — the measured baseline
+                stats = merge(stats, attend_block(kv_idx, kf, vf))
+                k_next = _hop(kf, axis_name, perm, "k")
+                v_next = _hop(vf, axis_name, perm, "v")
+            return (k_next, v_next, stats), None
+
+        # n−1 real hops: the final block attends in place (K/V rotate in
+        # input dtype — bf16 inputs keep bf16 ICI traffic)
+        kf, vf, stats = _run_steps(
+            step_fn, (k, v, stats0), n_devices - 1, unroll
+        )
+        stats = merge(
+            stats, attend_block((my_idx - (n_devices - 1)) % n_devices, kf, vf)
+        )
+
+    acc, denom, running_max = stats
     out = acc / jnp.maximum(denom.transpose(0, 2, 1)[..., None], 1e-30)
     # global logsumexp per query row — the backward pass reconstructs
     # exact global probabilities from this (p = exp(s - lse)); clamped
@@ -191,19 +364,27 @@ def _ring_attention_sharded(
 
 def _ring_attention_bwd_sharded(
     q, k, v, out, lse, dout, *, axis_name: str, n_devices: int,
-    causal: bool, use_flash: bool,
+    causal: bool, use_flash: bool, variant: str = "overlap",
+    unroll: bool = False,
 ):
     """Second ring pass: dQ/dK/dV per device.
 
-    K/V rotate around the ring exactly as in the forward; the float32
-    dK/dV accumulators rotate IN LOCKSTEP, so the accumulator for block
-    j is always resident with block j itself — each device adds its
-    Q-block's contribution to whatever block is visiting, and after n
-    hops every accumulator has collected all contributions and sits on
-    its home device. dQ accumulates locally. With ``use_flash`` the
+    K/V rotate around the ring with the same schedule as the forward —
+    n−1 hops per direction, the overlapped variant prefetching the next
+    block under the current block's gradient math. The float32 dK/dV
+    accumulators rotate IN LOCKSTEP with their blocks, so the
+    accumulator for block j is always resident with block j itself;
+    each device adds its Q-block's contribution to whatever block is
+    visiting. Accumulators make n hops: n−1 alongside their blocks plus
+    ONE homeward hop after the final (in-place) block — that last hop
+    carries real gradients, unlike the discarded homeward K/V rotation
+    this layer removed. dQ accumulates locally. With ``use_flash`` the
     per-block gradient math runs the fused backward kernels against the
     global statistics (flash_attention_backward_block); otherwise XLA
-    einsums recompute s and p = exp(s − lse_global) directly."""
+    einsums recompute s and p = exp(s − lse_global) directly. The
+    bidirectional variant's half-blocks always use the einsum path (the
+    fused backward kernel wants square blocks); its full diagonal block
+    still honors ``use_flash``."""
     my_idx = jax.lax.axis_index(axis_name)
     batch, seq_local, heads, head_dim = q.shape
     heads_kv = k.shape[2]
@@ -217,6 +398,31 @@ def _ring_attention_bwd_sharded(
     # per-row correction Δ = rowsum(dO ∘ O), same as the single-chip
     # backward kernels (ops/flash_attention.py _backward_bhsd)
     delta = jnp.einsum("bqhd,bqhd->bhq", dof, out.astype(jnp.float32))
+
+    # grouped views: head index h = hkv*group + g, matching the
+    # forward's reshape; dK/dV einsums sum over the group axis
+    qg = qf.reshape(batch, seq_local, heads_kv, group, head_dim)
+    dog = dof.reshape(batch, seq_local, heads_kv, group, head_dim)
+    lse_g = lse.reshape(batch, heads_kv, group, seq_local)
+    delta_g = delta.reshape(batch, heads_kv, group, seq_local)
+
+    def _einsum_grads(kf, vf, mask):
+        """Per-block (dq, dk, dv) contributions against the GLOBAL row
+        statistics; ``kf``/``vf`` may be a half block (any Sk)."""
+        kff = kf.astype(jnp.float32)
+        vff = vf.astype(jnp.float32)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kff) * scale
+        if mask is not None:
+            s = jnp.where(mask[None, None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse_g[..., None])  # exact global probabilities
+        dv_blk = jnp.einsum("bhgqk,bqhgd->bkhd", p, dog)
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", dog, vff)
+        ds = p * (dp - delta_g[..., None]) * scale
+        dq_blk = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kff).reshape(
+            batch, seq_local, heads, head_dim
+        )
+        dk_blk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg)
+        return dq_blk, dk_blk, dv_blk
 
     if use_flash:
         from activemonitor_tpu.ops.flash_attention import (
@@ -233,41 +439,123 @@ def _ring_attention_bwd_sharded(
                 q_in, kf, vf, lse, delta, dout, causal=True
             )
     else:
-        # grouped views: head index h = hkv*group + g, matching the
-        # forward's reshape; dK/dV einsums sum over the group axis
-        qg = qf.reshape(batch, seq_local, heads_kv, group, head_dim)
-        dog = dof.reshape(batch, seq_local, heads_kv, group, head_dim)
-        lse_g = lse.reshape(batch, heads_kv, group, seq_local)
-        delta_g = delta.reshape(batch, heads_kv, group, seq_local)
+        def attend_full(_q_in, kf, vf):
+            return _einsum_grads(kf, vf, None)
 
-        def _attend(_q_in, kf, vf, diagonal):
-            kff = kf.astype(jnp.float32)
-            vff = vf.astype(jnp.float32)
-            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kff) * scale
-            if diagonal:
-                s = jnp.where(causal_mask[None, None, None], s, _NEG_INF)
-            p = jnp.exp(s - lse_g[..., None])  # exact global probabilities
-            dv_blk = jnp.einsum("bhgqk,bqhgd->bkhd", p, dog)
-            dp = jnp.einsum("bqhgd,bkhd->bhgqk", dog, vff)
-            ds = p * (dp - delta_g[..., None]) * scale
-            dq_blk = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kff).reshape(
-                batch, seq_local, heads, head_dim
-            )
-            dk_blk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg)
-            return dq_blk, dk_blk, dv_blk
+        def attend_diag(_q_in, kf, vf):
+            return _einsum_grads(kf, vf, causal_mask)
 
-        def attend_full(q_in, kf, vf):
-            return _attend(q_in, kf, vf, diagonal=False)
-
-        def attend_diag(q_in, kf, vf):
-            return _attend(q_in, kf, vf, diagonal=True)
-
-    def skip(_q_in, _kf, _vf):
+    def skip(_q_in, kf, _vf):
         # lax.cond-branch signature parity; an out-of-window block
-        # contributes zero to every gradient
+        # contributes zero to every gradient (zeros sized to the
+        # visiting block, so half blocks skip cleanly too)
         zq = jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32)
-        zkv = jnp.zeros((batch, seq_local, heads_kv, head_dim), jnp.float32)
+        zkv = jnp.zeros((batch, kf.shape[1], heads_kv, head_dim), jnp.float32)
         return zq, zkv, zkv
+
+    def contrib_block(kv_idx, kf, vf):
+        if causal:
+            branch = (
+                (kv_idx < my_idx).astype(jnp.int32)
+                + 2 * (kv_idx == my_idx).astype(jnp.int32)
+            )  # 0 = skip (kv after us), 1 = full, 2 = diagonal
+            return jax.lax.switch(
+                branch, (skip, attend_full, attend_diag), q, kf, vf
+            )
+        return attend_full(q, kf, vf)
+
+    if variant == "bidir":
+        def contrib_half(kv_idx, kh, vh):
+            def work(_q_in, kh, vh):
+                return _einsum_grads(kh, vh, None)
+
+            if causal:
+                return jax.lax.cond(kv_idx > my_idx, skip, work, q, kh, vh)
+            return work(q, kh, vh)
+
+        def diag_contrib(kf, vf):
+            if causal:
+                return attend_diag(q, kf, vf)
+            return attend_full(q, kf, vf)
+
+        if n_devices == 1:
+            dq, dk, dv = diag_contrib(k, v)
+            return (
+                dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+            )
+
+        half = seq_local // 2
+        perm_ccw = [(i, (i - 1) % n_devices) for i in range(n_devices)]
+        k_cw, k_ccw = k[:, :half], k[:, half:]
+        v_cw, v_ccw = v[:, :half], v[:, half:]
+        # first K/V hops ride under the diagonal's gradient math
+        k_cw = _hop(k_cw, axis_name, perm, "k", "cw")
+        v_cw = _hop(v_cw, axis_name, perm, "v", "cw")
+        k_ccw = _hop(k_ccw, axis_name, perm_ccw, "k", "ccw")
+        v_ccw = _hop(v_ccw, axis_name, perm_ccw, "v", "ccw")
+        (k_cw, v_cw, k_ccw, v_ccw), (kd, vd) = jax.lax.optimization_barrier(
+            ((k_cw, v_cw, k_ccw, v_ccw), (k, v))
+        )
+        dq, dk_d, dv_d = diag_contrib(kd, vd)
+        # the accumulators split like their blocks and start the ring
+        # journey alongside them
+        dk_cw = _hop(dk_d[:, :half], axis_name, perm, "dk", "cw")
+        dv_cw = _hop(dv_d[:, :half], axis_name, perm, "dv", "cw")
+        dk_ccw = _hop(dk_d[:, half:], axis_name, perm_ccw, "dk", "ccw")
+        dv_ccw = _hop(dv_d[:, half:], axis_name, perm_ccw, "dv", "ccw")
+
+        def step_fn(carry, t):
+            (k_cw, v_cw, k_ccw, v_ccw,
+             dk_cw, dv_cw, dk_ccw, dv_ccw, dq) = carry
+            kn_cw = _hop(k_cw, axis_name, perm, "k", "cw")
+            vn_cw = _hop(v_cw, axis_name, perm, "v", "cw")
+            kn_ccw = _hop(k_ccw, axis_name, perm_ccw, "k", "ccw")
+            vn_ccw = _hop(v_ccw, axis_name, perm_ccw, "v", "ccw")
+            (kn_cw, vn_cw, kn_ccw, vn_ccw), (k_cw, v_cw, k_ccw, v_ccw) = (
+                jax.lax.optimization_barrier(
+                    ((kn_cw, vn_cw, kn_ccw, vn_ccw),
+                     (k_cw, v_cw, k_ccw, v_ccw))
+                )
+            )
+            dq1, dkb_cw, dvb_cw = contrib_half(
+                (my_idx - t) % n_devices, k_cw, v_cw
+            )
+            dq2, dkb_ccw, dvb_ccw = contrib_half(
+                (my_idx + t) % n_devices, k_ccw, v_ccw
+            )
+            dq = dq + dq1 + dq2
+            dk_cw = _hop(dk_cw + dkb_cw, axis_name, perm, "dk", "cw")
+            dv_cw = _hop(dv_cw + dvb_cw, axis_name, perm, "dv", "cw")
+            dk_ccw = _hop(dk_ccw + dkb_ccw, axis_name, perm_ccw, "dk", "ccw")
+            dv_ccw = _hop(dv_ccw + dvb_ccw, axis_name, perm_ccw, "dv", "ccw")
+            return (
+                kn_cw, vn_cw, kn_ccw, vn_ccw,
+                dk_cw, dv_cw, dk_ccw, dv_ccw, dq,
+            ), None
+
+        carry = _run_steps(
+            step_fn,
+            (k_cw, v_cw, k_ccw, v_ccw, dk_cw, dv_cw, dk_ccw, dv_ccw, dq),
+            n_devices - 2, unroll, start=1,
+        )
+        (k_cw, v_cw, k_ccw, v_ccw,
+         dk_cw, dv_cw, dk_ccw, dv_ccw, dq) = carry
+        t_last = n_devices - 1
+        dq1, dkb_cw, dvb_cw = contrib_half(
+            (my_idx - t_last) % n_devices, k_cw, v_cw
+        )
+        dq2, dkb_ccw, dvb_ccw = contrib_half(
+            (my_idx + t_last) % n_devices, k_ccw, v_ccw
+        )
+        dq = dq + dq1 + dq2
+        # homeward hop: the accumulators' n-th — carrying real gradients
+        dk_cw = _hop(dk_cw + dkb_cw, axis_name, perm, "dk", "cw")
+        dv_cw = _hop(dv_cw + dvb_cw, axis_name, perm, "dv", "cw")
+        dk_ccw = _hop(dk_ccw + dkb_ccw, axis_name, perm_ccw, "dk", "ccw")
+        dv_ccw = _hop(dv_ccw + dvb_ccw, axis_name, perm_ccw, "dv", "ccw")
+        dk = jnp.concatenate([dk_cw, dk_ccw], axis=1)
+        dv = jnp.concatenate([dv_cw, dv_ccw], axis=1)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     init = (
         k,  # rotates in input dtype, like the forward
@@ -280,51 +568,63 @@ def _ring_attention_bwd_sharded(
     def step_fn(carry, step):
         kf, vf, dk, dv, dq = carry
         kv_idx = (my_idx - step) % n_devices
-        if causal:
-            branch = (
-                (kv_idx < my_idx).astype(jnp.int32)
-                + 2 * (kv_idx == my_idx).astype(jnp.int32)
-            )  # 0 = skip (kv after us), 1 = full, 2 = diagonal
-            dq_blk, dk_blk, dv_blk = jax.lax.switch(
-                branch, (skip, attend_full, attend_diag), q, kf, vf
+        if variant == "overlap":
+            # prefetch the next K/V block under this step's gradient
+            # math (the dominant per-step cost — ~3x the forward FLOPs)
+            k_next = _hop(kf, axis_name, perm, "k")
+            v_next = _hop(vf, axis_name, perm, "v")
+            (k_next, v_next), (kf, vf) = jax.lax.optimization_barrier(
+                ((k_next, v_next), (kf, vf))
             )
+            dq_blk, dk_blk, dv_blk = contrib_block(kv_idx, kf, vf)
         else:
-            dq_blk, dk_blk, dv_blk = attend_full(q, kf, vf)
+            dq_blk, dk_blk, dv_blk = contrib_block(kv_idx, kf, vf)
+            k_next = _hop(kf, axis_name, perm, "k")
+            v_next = _hop(vf, axis_name, perm, "v")
         dq = dq + dq_blk
-        dk = dk + dk_blk
-        dv = dv + dv_blk
-        kf = jax.lax.ppermute(kf, axis_name, perm)
-        vf = jax.lax.ppermute(vf, axis_name, perm)
-        dk = jax.lax.ppermute(dk, axis_name, perm)
-        dv = jax.lax.ppermute(dv, axis_name, perm)
-        return (kf, vf, dk, dv, dq), None
+        # accumulators travel WITH their block
+        dk = _hop(dk + dk_blk, axis_name, perm, "dk")
+        dv = _hop(dv + dv_blk, axis_name, perm, "dv")
+        return (k_next, v_next, dk, dv, dq), None
 
-    (_, _, dk, dv, dq), _ = jax.lax.scan(step_fn, init, jnp.arange(n_devices))
+    kf, vf, dk, dv, dq = _run_steps(step_fn, init, n_devices - 1, unroll)
+    dq_blk, dk_blk, dv_blk = contrib_block(
+        (my_idx - (n_devices - 1)) % n_devices, kf, vf
+    )
+    dq = dq + dq_blk
+    dk = dk + dk_blk
+    dv = dv + dv_blk
+    if n_devices > 1:
+        # homeward hop: the accumulators' n-th — carrying real gradients
+        dk = _hop(dk, axis_name, perm, "dk")
+        dv = _hop(dv, axis_name, perm, "dv")
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_diff(q, k, v, axis_name, n_devices, causal, use_flash):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_diff(q, k, v, axis_name, n_devices, causal, use_flash, variant, unroll):
     out, _ = _ring_attention_sharded(
         q, k, v, axis_name=axis_name, n_devices=n_devices,
-        causal=causal, use_flash=use_flash,
+        causal=causal, use_flash=use_flash, variant=variant, unroll=unroll,
     )
     return out
 
 
-def _ring_diff_fwd(q, k, v, axis_name, n_devices, causal, use_flash):
+def _ring_diff_fwd(q, k, v, axis_name, n_devices, causal, use_flash, variant, unroll):
     out, lse = _ring_attention_sharded(
         q, k, v, axis_name=axis_name, n_devices=n_devices,
-        causal=causal, use_flash=use_flash,
+        causal=causal, use_flash=use_flash, variant=variant, unroll=unroll,
     )
     return out, (q, k, v, out, lse)
 
 
-def _ring_diff_bwd(axis_name, n_devices, causal, use_flash, residuals, dout):
+def _ring_diff_bwd(
+    axis_name, n_devices, causal, use_flash, variant, unroll, residuals, dout
+):
     q, k, v, out, lse = residuals
     return _ring_attention_bwd_sharded(
         q, k, v, out, lse, dout, axis_name=axis_name, n_devices=n_devices,
-        causal=causal, use_flash=use_flash,
+        causal=causal, use_flash=use_flash, variant=variant, unroll=unroll,
     )
 
 
@@ -340,6 +640,8 @@ def ring_attention(
     causal: bool = True,
     use_flash: bool = False,
     in_spec: P | None = None,
+    variant: str = "overlap",
+    unroll: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention over ``mesh[axis]``, differentiable
     (custom VJP: the backward is a second K/V ring pass recomputing
@@ -351,6 +653,14 @@ def ring_attention(
     what rotates, so grouped heads shrink ICI traffic by the group
     factor, and dK/dV come back group-summed in K/V's own shape.
     Returns attention output with q's global shape/sharding.
+
+    ``variant`` picks the communication schedule (module docstring):
+    ``"overlap"`` (default) double-buffers the K/V rotation under the
+    block attends — bit-identical to ``"serial"``, which exists as the
+    measured baseline; ``"bidir"`` splits K/V halves over both ICI
+    link directions (numerically, not bitwise, equal). ``unroll``
+    trades flat compile time for a python-loop schedule whose hops are
+    individually traced (the probe/test hop counter).
     ``use_flash`` runs each ring step's block compute (forward AND
     backward) through the fused Pallas kernels. ``in_spec`` overrides
     the shard_map partitioning for composed meshes — e.g.
@@ -360,10 +670,17 @@ def ring_attention(
     ``axis``).
     """
     n = mesh.shape[axis]
+    if variant not in VARIANTS:
+        raise ValueError(f"variant must be one of {VARIANTS}, got {variant!r}")
     if q.shape[2] % k.shape[2]:
         raise ValueError(
             f"GQA needs n_heads ({q.shape[2]}) divisible by n_kv_heads "
             f"({k.shape[2]})"
+        )
+    if variant == "bidir" and n > 1 and q.shape[1] // n < 2:
+        raise ValueError(
+            "bidirectional ring attention needs >= 2 tokens per shard "
+            f"to split K/V halves (got {q.shape[1]} over {n} devices)"
         )
     spec = in_spec if in_spec is not None else P(None, axis, None, None)
     if len(spec) > 1 and spec[1] != axis:
@@ -372,7 +689,7 @@ def ring_attention(
         )
     def body(q, k, v):
         # positional call: custom_vjp rejects keyword arguments
-        return _ring_diff(q, k, v, axis, n, causal, use_flash)
+        return _ring_diff(q, k, v, axis, n, causal, use_flash, variant, unroll)
 
     fn = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False

@@ -28,7 +28,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from activemonitor_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from activemonitor_tpu.utils.timing import chain_delta_seconds
@@ -232,3 +232,35 @@ def ppermute_ring_bandwidth(
         return lambda x: jax.lax.ppermute(x, ax, perm)
 
     return _bench("ppermute_ring", mesh, axis, size_mb, dtype, iters, make_body)
+
+
+def ppermute_bidir_bandwidth(
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    axis: str = "",
+) -> CollectiveResult:
+    """Chained BIDIRECTIONAL neighbor shift: the shard splits in halves
+    permuted clockwise / counter-clockwise simultaneously — the wire
+    pattern of bidirectional ring attention
+    (ops/ring_attention.py variant="bidir"), driving both directions of
+    every ring link per round. Same payload accounting as the
+    unidirectional hop (full shard bytes per round), so on full-duplex
+    ICI the achievable ceiling is 2x the unidirectional link bandwidth
+    and the measured algbw approaching that ceiling is the evidence the
+    second direction is real."""
+
+    def make_body(n, ax):
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+        bwd = [(i, (i - 1) % n) for i in range(n)]
+
+        def body(x):
+            half = x.shape[0] // 2
+            a = jax.lax.ppermute(x[:half], ax, fwd)
+            b = jax.lax.ppermute(x[half:], ax, bwd)
+            return jnp.concatenate([a, b], axis=0)
+
+        return body
+
+    return _bench("ppermute_bidir", mesh, axis, size_mb, dtype, iters, make_body)

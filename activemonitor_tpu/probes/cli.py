@@ -146,6 +146,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="run each ring step's block compute through the fused "
         "Pallas kernel instead of XLA einsums",
     )
+    p.add_argument(
+        "--variant",
+        choices=("overlap", "serial", "bidir"),
+        default="overlap",
+        help="K/V rotation schedule: double-buffered overlap (default), "
+        "the serial baseline, or bidirectional halves over both ICI "
+        "link directions",
+    )
+    p.add_argument(
+        "--no-overlap-metrics",
+        action="store_true",
+        help="skip the serial-baseline timing pass (drops the "
+        "ring-overlap-efficiency and busbw gauges)",
+    )
 
     p = sub.add_parser(
         "flash-attention", help="fused attention kernel correctness + throughput"
@@ -369,6 +383,8 @@ def _dispatch(args) -> int:
             head_dim=args.head_dim,
             iters=args.iters,
             use_flash=args.flash,
+            variant=args.variant,
+            overlap_metrics=not args.no_overlap_metrics,
         )
     elif args.probe == "flash-attention":
         from activemonitor_tpu.probes import flash

@@ -10,15 +10,17 @@ e.g. a routing fault that halves the bisection but leaves neighbor
 links intact — shows up here before it shows up as slow training.
 
 Exports, per collective C in {allreduce, allgather, reducescatter,
-alltoall, ringhop} (prefix ``collective-``, distinct from the
-north-star probe's ``ici-`` gauges so a merged battery contract never
-carries duplicate names):
+alltoall, ringhop, ringhop-bidir} (prefix ``collective-``, distinct
+from the north-star probe's ``ici-`` gauges so a merged battery
+contract never carries duplicate names):
 
 - ``collective-<C>-busbw-gbps`` — NCCL busbw convention
 - ``collective-<C>-fraction-of-rated`` — busbw / rated ceiling (TPU)
 
 Rated ceilings assume the same bidirectional-ring model as probes/ici:
-2 x unidir link bw for the ring collectives, 1 x for a single hop —
+2 x unidir link bw for the ring collectives AND for the bidirectional
+hop (both directions of each link active at once — the ring-attention
+variant="bidir" wire pattern), 1 x for a single unidirectional hop —
 except all-to-all, which is bisection-bound on a ring: each half
 exchanges n*S/4 bytes per direction across the cut's 2 links, capping
 busbw at 8*B*(n-1)/n^2.
@@ -40,6 +42,7 @@ from activemonitor_tpu.parallel.collectives import (
     all_gather_bandwidth,
     all_reduce_bandwidth,
     all_to_all_bandwidth,
+    ppermute_bidir_bandwidth,
     ppermute_ring_bandwidth,
     reduce_scatter_bandwidth,
 )
@@ -47,7 +50,10 @@ from activemonitor_tpu.parallel.mesh import make_1d_mesh, make_2d_mesh
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 
-ALL_CASES = ("allreduce", "allgather", "reducescatter", "alltoall", "ringhop")
+ALL_CASES = (
+    "allreduce", "allgather", "reducescatter", "alltoall", "ringhop",
+    "ringhop-bidir",
+)
 
 _BENCH: Dict[str, Callable] = {
     "allreduce": all_reduce_bandwidth,
@@ -55,6 +61,7 @@ _BENCH: Dict[str, Callable] = {
     "reducescatter": reduce_scatter_bandwidth,
     "alltoall": all_to_all_bandwidth,
     "ringhop": ppermute_ring_bandwidth,
+    "ringhop-bidir": ppermute_bidir_bandwidth,
 }
 
 
@@ -63,6 +70,10 @@ def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
     with per-direction link bandwidth ``unidir_gbps`` (see module doc)."""
     if name == "ringhop":
         return unidir_gbps
+    if name == "ringhop-bidir":
+        # both link directions active per hop — full-duplex ceiling,
+        # the same 2x-unidir model as the ici probe's ring comparator
+        return 2 * unidir_gbps
     if name == "alltoall":
         return 8 * unidir_gbps * (n - 1) / n**2
     return 2 * unidir_gbps

@@ -6,7 +6,14 @@ rated on a GKE v5e-8). Exports:
 
 - ``ici-allreduce-busbw-gbps`` — measured bus bandwidth (NCCL convention)
 - ``ici-allreduce-fraction-of-rated`` — measured / rated
-- ``ici-ring-hop-gbps`` — single-hop ppermute bandwidth
+- ``ici-ring-hop-gbps`` — single-hop ppermute bandwidth (one direction)
+- ``ici-ring-hop-bidir-gbps`` — bidirectional hop (halves permuted
+  clockwise/counter-clockwise at once — the ring-attention
+  ``variant="bidir"`` wire pattern)
+- ``ici-ring-hop-fraction-of-rated`` / ``ici-ring-hop-bidir-fraction-of-rated``
+  — each hop flavor against its link-model ceiling (1x unidir for the
+  single direction, 2x unidir full-duplex for bidirectional), the same
+  model behind the all-reduce comparator below
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ import jax
 
 from activemonitor_tpu.parallel.collectives import (
     all_reduce_bandwidth,
+    ppermute_bidir_bandwidth,
     ppermute_ring_bandwidth,
 )
 from activemonitor_tpu.parallel.mesh import make_1d_mesh
@@ -54,7 +62,7 @@ def run(
         "busbw_gbps": round(result.busbw_gbps, 2),
     }
 
-    ring = None
+    ring = ring_bidir = None
     if include_ring and n > 1:
         ring = ppermute_ring_bandwidth(mesh, size_mb=size_mb, iters=iters)
         metrics.append(
@@ -65,6 +73,16 @@ def run(
             )
         )
         details["ring_hop_gbps"] = round(ring.algbw_gbps, 2)
+        ring_bidir = ppermute_bidir_bandwidth(mesh, size_mb=size_mb, iters=iters)
+        metrics.append(
+            ProbeMetric(
+                "ici-ring-hop-bidir-gbps",
+                ring_bidir.algbw_gbps,
+                help="Bidirectional ring hop (cw+ccw halves per round) "
+                "bandwidth, GB/s",
+            )
+        )
+        details["ring_hop_bidir_gbps"] = round(ring_bidir.algbw_gbps, 2)
 
     ok = True
     if rated is not None and n > 1 and devices[0].platform == "tpu":
@@ -81,6 +99,30 @@ def run(
         )
         details["rated_busbw_gbps"] = rated_busbw
         details["fraction_of_rated"] = round(fraction, 3)
+        if ring is not None:
+            # the hop flavors against the same link model: one direction
+            # of one link, and both directions of one link (full duplex)
+            metrics.append(
+                ProbeMetric(
+                    "ici-ring-hop-fraction-of-rated",
+                    ring.algbw_gbps / rated.ici_unidir_gbps,
+                    help="Single-hop bandwidth / rated unidirectional link",
+                )
+            )
+            metrics.append(
+                ProbeMetric(
+                    "ici-ring-hop-bidir-fraction-of-rated",
+                    ring_bidir.algbw_gbps / rated_busbw,
+                    help="Bidirectional-hop bandwidth / 2x rated link "
+                    "(full-duplex ceiling)",
+                )
+            )
+            details["ring_hop_fraction_of_rated"] = round(
+                ring.algbw_gbps / rated.ici_unidir_gbps, 3
+            )
+            details["ring_hop_bidir_fraction_of_rated"] = round(
+                ring_bidir.algbw_gbps / rated_busbw, 3
+            )
         ok = fraction >= threshold
         summary = (
             f"all-reduce busbw {result.busbw_gbps:.1f} GB/s = "

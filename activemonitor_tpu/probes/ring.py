@@ -1,13 +1,27 @@
 """Ring-attention (sequence-parallel) probe — the long-context canary.
 
-Two verdicts in one probe:
+Three verdicts in one probe:
 
 1. correctness — sequence-parallel ring attention over the mesh must
    match single-device attention (a wrong answer here means broken
    collectives/permutes, the scariest failure mode for long-context
-   training);
+   training), for BOTH the overlapped and bidirectional schedules; the
+   overlapped schedule must additionally be bit-identical to the serial
+   reference (same blocks merged in the same order — any divergence is
+   a scheduling bug, not rounding);
 2. throughput — attended tokens/s for a sequence n× longer than one
-   device could hold, exported as gauges.
+   device could hold, exported as gauges;
+3. overlap efficiency — the serial schedule (attend THEN hop) is timed
+   against the requested schedule and the ratio exported as
+   ``ring-overlap-efficiency``: >1 means the double-buffered/
+   bidirectional rotation actually hides ICI time under attention
+   math. Alongside it, ``ring-attention-busbw-gbps`` reports the K/V
+   bytes the ring moved per second of step time, and on rated TPU
+   hardware ``ring-attention-busbw-fraction-of-rated`` compares that
+   against the schedule's link ceiling (1x unidirectional link for
+   serial/overlap, 2x for bidir) — the fraction of rated ICI ring
+   bandwidth the op sustains while computing, the bench north star
+   applied to the attention hot path.
 """
 
 from __future__ import annotations
@@ -15,9 +29,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from activemonitor_tpu.ops.ring_attention import reference_attention, ring_attention
+from activemonitor_tpu.ops.ring_attention import (
+    VARIANTS,
+    reference_attention,
+    ring_attention,
+)
 from activemonitor_tpu.parallel.mesh import make_1d_mesh
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
 from activemonitor_tpu.utils.timing import chain_delta_seconds
 
 
@@ -29,7 +48,11 @@ def run(
     iters: int = 5,
     tolerance: float = 2e-2,
     use_flash: bool = False,
+    variant: str = "overlap",
+    overlap_metrics: bool = True,
 ) -> ProbeResult:
+    if variant not in VARIANTS:
+        raise ValueError(f"variant must be one of {VARIANTS}, got {variant!r}")
     mesh = make_1d_mesh("sp")
     n = mesh.devices.size
     seq = seq_per_device * n
@@ -42,27 +65,81 @@ def run(
     # correctness on a small slice (full reference attention is O(S^2)
     # on one device — keep it tractable)
     small = min(seq, 64 * n)
-    got = ring_attention(
-        q[:, :small], k[:, :small], v[:, :small], mesh, "sp", use_flash=use_flash
-    )
-    want = reference_attention(q[:, :small], k[:, :small], v[:, :small])
+    qs, ks, vs = q[:, :small], k[:, :small], v[:, :small]
+    got = ring_attention(qs, ks, vs, mesh, "sp", use_flash=use_flash, variant=variant)
+    want = reference_attention(qs, ks, vs)
     max_err = float(
         jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)))
     )
+    # schedule cross-checks (bundled with the overlap telemetry — each
+    # is an extra compile, so the cheap overlap_metrics=False mode
+    # skips them): overlapped must be BITWISE serial (same merges,
+    # different transfer timing); bidir merges halves in a different
+    # order, so it gets the reference tolerance
     correct = max_err <= tolerance
+    overlap_vs_serial = bidir_err = None
+    if overlap_metrics:
+        serial_small = ring_attention(
+            qs, ks, vs, mesh, "sp", use_flash=use_flash, variant="serial"
+        )
+        overlap_small = (
+            got
+            if variant == "overlap"
+            else ring_attention(
+                qs, ks, vs, mesh, "sp", use_flash=use_flash, variant="overlap"
+            )
+        )
+        overlap_vs_serial = float(
+            jnp.max(
+                jnp.abs(
+                    overlap_small.astype(jnp.float32)
+                    - serial_small.astype(jnp.float32)
+                )
+            )
+        )
+        bidir_small = (
+            got
+            if variant == "bidir"
+            else ring_attention(
+                qs, ks, vs, mesh, "sp", use_flash=use_flash, variant="bidir"
+            )
+        )
+        bidir_err = float(
+            jnp.max(
+                jnp.abs(
+                    bidir_small.astype(jnp.float32) - want.astype(jnp.float32)
+                )
+            )
+        )
+        # overlap-vs-serial is a bit-compat contract (identical merges)
+        # — but the verdict bound leaves room for a backend's fusion
+        # quirks: bf16 outputs quantize to ~2^-8 steps, so any REAL
+        # schedule bug clears 1e-6 by orders of magnitude (CPU tier-1
+        # asserts exact 0)
+        correct = (
+            correct and overlap_vs_serial <= 1e-6 and bidir_err <= tolerance
+        )
 
     # throughput: chained ring attentions (output feeds next Q)
-    def make_chain(kreps):
-        @jax.jit
-        def chain(q, k, v):
-            x = q
-            for _ in range(kreps):
-                x = ring_attention(x, k, v, mesh, "sp", use_flash=use_flash)
-            return x.astype(jnp.float32).sum()
+    def make_chain(chain_variant):
+        def make(kreps):
+            @jax.jit
+            def chain(q, k, v):
+                x = q
+                for _ in range(kreps):
+                    x = ring_attention(
+                        x, k, v, mesh, "sp",
+                        use_flash=use_flash, variant=chain_variant,
+                    )
+                return x.astype(jnp.float32).sum()
 
-        return chain
+            return chain
 
-    seconds = chain_delta_seconds(make_chain, q, k, v, k1=1, k2=3, iters=iters)
+        return make
+
+    seconds = chain_delta_seconds(
+        make_chain(variant), q, k, v, k1=1, k2=3, iters=iters
+    )
     tokens_per_second = batch * seq / seconds
     # attention FLOPs: 2 matmuls of [S, S] x head_dim per head, causal halves it
     flops = 2 * 2 * batch * heads * seq * seq * head_dim / 2
@@ -83,23 +160,85 @@ def run(
             "ring-attention-tflops", tflops, help="Achieved attention TFLOP/s"
         ),
     ]
+    details = {
+        "devices": n,
+        "block_compute": "flash" if use_flash else "xla",
+        "variant": variant,
+        "seq": seq,
+        "seq_per_device": seq_per_device,
+        "heads": heads,
+        "head_dim": head_dim,
+        "seconds_per_op": seconds,
+        "max_error": max_err,
+    }
+    if overlap_vs_serial is not None:
+        details["overlap_vs_serial_max_error"] = overlap_vs_serial
+        details["bidir_max_error"] = bidir_err
+
+    devices = jax.devices()
+    if overlap_metrics and n > 1:
+        # measured serial-vs-overlapped step time: the driver-evidenced
+        # win of issuing the K/V hop before the block attend
+        serial_seconds = (
+            seconds
+            if variant == "serial"
+            else chain_delta_seconds(
+                make_chain("serial"), q, k, v, k1=1, k2=3, iters=iters
+            )
+        )
+        efficiency = serial_seconds / max(seconds, 1e-12)
+        metrics.append(
+            ProbeMetric(
+                "ring-overlap-efficiency",
+                efficiency,
+                help="Serial-schedule step time / measured schedule step "
+                "time (>1 = ICI hops hidden under attention math)",
+            )
+        )
+        # K/V wire bytes per device per call: both tensors make n-1
+        # hops of one [B, S/n, Hkv, D] block in the ring dtype
+        hop_bytes = (
+            2 * batch * seq_per_device * heads * head_dim * jnp.dtype(dtype).itemsize
+        )
+        wire_bytes = hop_bytes * (n - 1)
+        busbw = wire_bytes / seconds / 1e9
+        metrics.append(
+            ProbeMetric(
+                "ring-attention-busbw-gbps",
+                busbw,
+                help="K/V ring bytes moved per second of step time, GB/s "
+                "(per device; compute-bound runs sit well below link rate)",
+            )
+        )
+        details["serial_seconds_per_op"] = serial_seconds
+        details["overlap_efficiency"] = round(efficiency, 3)
+        details["busbw_gbps"] = round(busbw, 3)
+        rated = rated_for(devices[0].device_kind)
+        if rated is not None and devices[0].platform == "tpu":
+            # the schedule's link ceiling: one direction per hop for
+            # serial/overlap, both directions (full duplex) for bidir —
+            # same model as probes/ici.py's ring comparator
+            ceiling = rated.ici_unidir_gbps * (2 if variant == "bidir" else 1)
+            metrics.append(
+                ProbeMetric(
+                    "ring-attention-busbw-fraction-of-rated",
+                    busbw / ceiling,
+                    help="Ring-attention sustained busbw / rated link "
+                    "ceiling for the schedule (1x unidir link; 2x for bidir)",
+                )
+            )
+            details["busbw_fraction_of_rated"] = round(busbw / ceiling, 4)
+
     summary = (
-        f"ring attention over {n} devices: err {max_err:.1e} "
+        f"ring attention ({variant}) over {n} devices: err {max_err:.1e} "
         f"({'OK' if correct else 'MISMATCH'}), "
         f"{tokens_per_second:,.0f} tok/s @ seq {seq}"
     )
+    if "overlap_efficiency" in details:
+        summary += f", overlap {details['overlap_efficiency']:.2f}x serial"
     return ProbeResult(
         ok=correct,
         metrics=metrics,
         summary=summary,
-        details={
-            "devices": n,
-            "block_compute": "flash" if use_flash else "xla",
-            "seq": seq,
-            "seq_per_device": seq_per_device,
-            "heads": heads,
-            "head_dim": head_dim,
-            "seconds_per_op": seconds,
-            "max_error": max_err,
-        },
+        details=details,
     )

@@ -116,9 +116,17 @@ def run(
             cases=("allgather", "reducescatter", "alltoall"),
         ),
     )
+    # quick mode skips the overlap telemetry (the serial-baseline pass
+    # and cross-schedule checks are extra compiles — same philosophy as
+    # skipping the perf bars); the full battery reports
+    # ring-overlap-efficiency and the sustained busbw fraction
     add(
         "ring-attention",
-        lambda: ring.run(seq_per_device=256 if quick else 1024, iters=iters),
+        lambda: ring.run(
+            seq_per_device=256 if quick else 1024,
+            iters=iters,
+            overlap_metrics=not quick,
+        ),
     )
     from activemonitor_tpu.probes import flash
 

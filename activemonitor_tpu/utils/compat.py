@@ -1,0 +1,85 @@
+"""JAX API compatibility — one import site for symbols that moved.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` export, and the kwargs moved with it: the
+replication check was renamed ``check_rep`` → ``check_vma`` and
+partially-manual meshes flipped polarity from ``auto`` (the axes that
+STAY compiler-managed) to ``axis_names`` (the axes that become manual).
+Installed containers carry either vintage, so every shard_map in this
+tree imports from here and writes the NEW calling convention; this
+adapter translates for legacy installs.
+"""
+
+from __future__ import annotations
+
+try:  # modern export (jax >= 0.6-era API)
+    from jax import shard_map as _shard_map_impl
+
+    _LEGACY = False
+except ImportError:  # legacy home, legacy kwargs
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _LEGACY = True
+
+# Partially-manual shard_map (manual over a subset of mesh axes, the
+# rest compiler-managed — ops/pipeline.py composed mode) is broken on
+# the legacy lowering: lax.axis_index becomes a PartitionId the SPMD
+# partitioner rejects, and the data-carried workaround trips a hard
+# CHECK in hlo_sharding_util once a scan is involved. Callers gate
+# composed-mode paths on this instead of discovering it as a crash.
+SUPPORTS_PARTIAL_MANUAL = not _LEGACY
+
+# True when the modern jax.shard_map export is missing — the same
+# vintage boundary behind every capability flag below. Exposed for
+# skip-gates that guard against legacy-runtime crashes (a tier-1 test
+# that SIGSEGVs the interpreter takes the whole suite down with it).
+LEGACY_JAX = _LEGACY
+
+# Legacy jaxlib's CPU backend rejects cross-process collectives
+# ("Multiprocess computations aren't implemented on the CPU backend"),
+# so the two-process DCN tests can only run on the modern runtime (or
+# on real TPU, where the capability has always existed).
+SUPPORTS_CPU_MULTIPROCESS = not _LEGACY
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+    axis_names=frozenset(),
+):
+    """``jax.shard_map`` calling convention on any installed JAX.
+
+    ``axis_names`` is the NEW polarity: the mesh axes the body is
+    manual over; empty means all of them (fully manual, the default).
+    On legacy installs it is translated to ``auto`` (its complement)
+    and ``check_vma`` to ``check_rep``.
+    """
+    if not _LEGACY:
+        kwargs = {}
+        if axis_names:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return _shard_map_impl(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+            **kwargs,
+        )
+    auto = (
+        frozenset(mesh.axis_names) - frozenset(axis_names)
+        if axis_names
+        else frozenset()
+    )
+    return _shard_map_impl(
+        f,
+        mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+        auto=auto,
+    )

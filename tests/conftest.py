@@ -26,6 +26,27 @@ if os.environ.get("ACTIVEMONITOR_TEST_TPU") != "1":
 
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, same pattern the probe battery uses
+# (probes/suite.enable_persistent_compile_cache): the suite compiles
+# hundreds of small 8-device mesh programs and their SUM is what the
+# tier-1 wall clock pays — a warm cache turns repeat runs from
+# compile-bound into execute-bound. Opt out with
+# ACTIVEMONITOR_TEST_NO_COMPILE_CACHE=1 (e.g. to time cold compiles).
+if os.environ.get("ACTIVEMONITOR_TEST_NO_COMPILE_CACHE") != "1":
+    try:
+        import jax
+
+        _cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "activemonitor-tpu",
+            "xla-test-cache",
+        )
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as exc:  # cache is a speedup, never a gate
+        sys.stderr.write(f"xla test compile cache disabled: {exc}\n")
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 # pytest-asyncio is not installed in this image; run coroutine tests
@@ -36,6 +57,11 @@ import inspect
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test in an event loop")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow') — deep "
+        "compile-heavy coverage that the soak/full tiers run",
+    )
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -9,6 +9,11 @@ in production, whatever the expression.
 
 import datetime
 
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this container"
+)
 from hypothesis import given, settings, strategies as st
 
 from activemonitor_tpu.scheduler.cron import parse_cron

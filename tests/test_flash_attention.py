@@ -421,6 +421,7 @@ def test_attention_flops_causal_half():
     assert abs(causal / full - 0.5) < 0.01  # (S+1)/2S
 
 
+@pytest.mark.slow  # minutes of interpret-mode compile; tier-2 coverage
 def test_model_flash_attention_matches_dense_on_mesh():
     # the probe model's flash path (shard_map over tp heads on the
     # dp x tp mesh) must agree with dense attention in loss and grads
@@ -460,6 +461,7 @@ def test_model_flash_rejects_oversized_tp_axis():
         flash_attention_fn(tiny_config(), mesh)
 
 
+@pytest.mark.slow  # minutes of interpret-mode compile; tier-2 coverage
 def test_probe_model_gqa_trains_and_decodes():
     """The probe model runs GQA end to end: dense and fused-kernel
     losses agree, a train step works, and the decode cache holds only
@@ -553,6 +555,7 @@ def test_flash_decode_validation():
         flash_decode(q[:, :4], bad, bad, jnp.int32(0))
 
 
+@pytest.mark.slow  # multi-position fused decode walk; tier-2 coverage
 def test_decode_step_flash_matches_dense():
     """The model's fused decode path reproduces the dense masked-cache
     path, MHA and GQA."""
@@ -648,6 +651,7 @@ def test_gqa_decode_matches_forward():
     assert float(jnp.max(jnp.abs(logits - want[:, -1]))) < 1e-4
 
 
+@pytest.mark.slow  # whole train-step compile through the fused kernel; tier-2 coverage
 def test_training_step_probe_flash_attention():
     from activemonitor_tpu.probes import training_step
 
@@ -658,6 +662,7 @@ def test_training_step_probe_flash_attention():
     assert result.details["attention"] == "flash"
 
 
+@pytest.mark.slow  # full probe battery slice in interpret mode; tier-2 coverage
 def test_probe_runs_on_cpu():
     from activemonitor_tpu.probes import flash
 
@@ -677,6 +682,7 @@ def test_probe_runs_on_cpu():
     assert all(isinstance(e, float) and e < 1e-2 for e in gen.values())
 
 
+@pytest.mark.slow  # probe + contract plumbing; tier-2 coverage
 def test_probe_contract_line_parses():
     import json
 
@@ -690,6 +696,7 @@ def test_probe_contract_line_parses():
     }
 
 
+@pytest.mark.slow  # probe re-runs per tolerance; tier-2 coverage
 def test_probe_tolerance_drives_gradient_gate():
     from activemonitor_tpu.probes import flash
 
@@ -700,6 +707,7 @@ def test_probe_tolerance_drives_gradient_gate():
     assert result.details["grad_tolerance"] == 2.5e-9
 
 
+@pytest.mark.slow  # minutes of interpret-mode compile; tier-2 coverage
 def test_sweep_produces_block_tables():
     from activemonitor_tpu.probes import flash
 

@@ -33,15 +33,20 @@ def test_entry_lowers_without_execution():
     assert "func" in lowered.as_text()[:2000]
 
 
-@pytest.mark.parametrize("n_devices", [2, 4, 8])
+@pytest.mark.parametrize(
+    "n_devices",
+    [2, pytest.param(4, marks=pytest.mark.slow), 8],
+)
 def test_dryrun_multichip_full_matrix(n_devices):
     """Mesh-shape edge cases stay covered as the parallelism code
-    evolves: 2 (degenerate 1x2), 4 (square 2x2), 8 (the driver's
-    non-square 2x4)."""
+    evolves: 2 (degenerate 1x2) and 8 (the driver's non-square 2x4) in
+    tier-1; the square 2x2 and the 16-device subprocess ride the slow
+    tier."""
     # conftest already forces the 8-device virtual CPU platform
     __graft_entry__.dryrun_multichip(n_devices)
 
 
+@pytest.mark.slow  # fresh-interpreter 16-device compile, ~30 s alone
 def test_dryrun_multichip_16_devices_subprocess():
     """16 devices exceeds this process's virtual platform — exercise the
     larger mesh (4x4, deeper pipeline staging) in a fresh interpreter."""
@@ -96,8 +101,13 @@ def test_bench_emits_single_json_line():
     assert doc["platform"] == "cpu"
     assert doc["n_devices"] == 8
     # honesty contract (VERDICT r3 weak #1): a CPU artifact must not
-    # read as "meets baseline", and must still evidence the kernels run
-    assert doc["vs_baseline"] is None
+    # read as "meets the TPU bar" — vs_baseline is either null or the
+    # CPU-vs-prior-CPU trajectory ratio, explicitly labeled as such
+    if doc["vs_baseline"] is not None:
+        assert "baseline_source" in doc
+        assert "cpu-mesh" in doc["baseline_source"]
+        assert doc["vs_baseline"] > 0
+    # ...and must still evidence the kernels run
     assert "flash_fwd_max_error_interpret" in doc["secondary"]
     assert doc["secondary"]["flash_fwd_max_error_interpret"] < 2e-2
     assert "flash_grad_rel_error_interpret" in doc["secondary"]
@@ -105,7 +115,18 @@ def test_bench_emits_single_json_line():
         "secondary"
     ].get("decode_interpret_error", doc["secondary"])
     assert doc["secondary"]["decode_fused_vs_dense_interpret"] < 1e-3
-    assert doc["secondary"]["composed_dp_tp_pp_loss"] > 0
+    # the overlap layer's evidence: bit-compat overlapped schedule and
+    # the bidirectional ring within tolerance
+    assert doc["secondary"]["ring_overlap_vs_serial_max_error"] == 0.0
+    assert doc["secondary"]["ring_bidir_max_error_interpret"] < 1e-3
+    from activemonitor_tpu.utils.compat import SUPPORTS_PARTIAL_MANUAL
+
+    if SUPPORTS_PARTIAL_MANUAL:
+        assert doc["secondary"]["composed_dp_tp_pp_loss"] > 0
+    else:
+        # legacy lowering cannot run the partially-manual composed step;
+        # the guarded secondary records the real diagnostic instead
+        assert "composed_step_error" in doc["secondary"]
 
 
 def test_last_known_good_tpu_block(tmp_path):

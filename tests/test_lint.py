@@ -457,6 +457,49 @@ def test_analysis_package_really_is_wallclock_free():
         assert checker.ban_wallclock
 
 
+def test_overlap_metric_names_are_pinned():
+    """The ISSUE-5 overlap-telemetry names are contract spelling: the
+    probes emit them, docs/probes.md's metric table registers them (the
+    names spec.analysis.metrics[] takes), and bench.py carries the
+    secondary keys — a rename in any one layer silently orphans the
+    others, so the gate pins all three."""
+    import ast
+
+    docs = (REPO / "docs" / "probes.md").read_text()
+    pinned_metrics = {
+        "ring-overlap-efficiency": "probes/ring.py",
+        "ring-attention-busbw-gbps": "probes/ring.py",
+        "ring-attention-busbw-fraction-of-rated": "probes/ring.py",
+        "ici-ring-hop-bidir-gbps": "probes/ici.py",
+        "ici-ring-hop-fraction-of-rated": "probes/ici.py",
+        "ici-ring-hop-bidir-fraction-of-rated": "probes/ici.py",
+    }
+    for name, rel in pinned_metrics.items():
+        assert name in docs, f"{name} missing from docs/probes.md metric table"
+        src = (REPO / "activemonitor_tpu" / rel).read_text()
+        tree = ast.parse(src)
+        declared = {
+            node.value
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Constant) and isinstance(node.value, str)
+        }
+        assert name in declared, f"{name} not declared in {rel}"
+    # the bidirectional collective case is part of the sweep contract
+    from activemonitor_tpu.probes.collectives import ALL_CASES, _BENCH
+
+    assert "ringhop-bidir" in ALL_CASES
+    assert "ringhop-bidir" in _BENCH
+    assert "ringhop-bidir" in docs
+    # bench.py's secondary keys for the overlap evidence
+    bench_src = (REPO / "bench.py").read_text()
+    for key in (
+        "ring_overlap_efficiency",
+        "ring_overlap_vs_serial_max_error",
+        "ring_bidir_max_error_interpret",
+    ):
+        assert key in bench_src, f"bench.py no longer records {key}"
+
+
 def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
     got = findings(
         tmp_path,

@@ -22,6 +22,17 @@ from activemonitor_tpu.engine import FakeWorkflowEngine
 from activemonitor_tpu.metrics import MetricsCollector
 from activemonitor_tpu.utils.tls import generate_self_signed_cert
 
+try:  # the TLS tests mint certs; gate on the optional dependency
+    import cryptography
+
+    _HAS_CRYPTO = cryptography is not None
+except ImportError:
+    _HAS_CRYPTO = False
+
+needs_cryptography = pytest.mark.skipif(
+    not _HAS_CRYPTO, reason="cryptography not installed in this container"
+)
+
 
 def free_port():
     import socket
@@ -64,6 +75,7 @@ async def fetch(url, token=None, ca_pem=None):
             return resp.status, await resp.text()
 
 
+@needs_cryptography
 @pytest.mark.asyncio
 async def test_metrics_tls_self_signed_by_default():
     port = free_port()
@@ -83,6 +95,7 @@ async def test_metrics_tls_self_signed_by_default():
         await manager.stop()
 
 
+@needs_cryptography
 @pytest.mark.asyncio
 async def test_metrics_tls_with_supplied_certificate(tmp_path):
     cert_pem, key_pem = generate_self_signed_cert("metrics.test")
@@ -525,6 +538,7 @@ def test_cli_k8s_auth_on_requires_cluster_credentials():
         aio.run(_run_controller(args, "file", None, None))
 
 
+@needs_cryptography
 @pytest.mark.asyncio
 async def test_metrics_tls_certificate_rotation_reloads(tmp_path):
     """cert-manager-style rotation: the PEM files are renewed under the

@@ -43,6 +43,44 @@ def test_pipeline_matches_dense(setup, microbatches):
     assert jnp.max(jnp.abs(got - ref)) < 1e-4
 
 
+@pytest.mark.parametrize("microbatches", [2, 4, 8])
+def test_pipeline_overlap_matches_dense(setup, microbatches):
+    # the overlapped schedule (activation pre-rotation under stage
+    # compute, M + 2(S-1) ticks) changes WHEN activations ride the
+    # links, never the math — same numbers as the dense reference
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    got = pipeline_forward_blocks(
+        stacked, x, cfg, mesh, "pp", num_microbatches=microbatches, overlap=True
+    )
+    assert jnp.max(jnp.abs(got - ref)) < 1e-4
+
+
+def test_pipeline_overlap_matches_serial_schedule(setup):
+    # overlapped and serial schedules run the same stage computes on
+    # the same microbatches — their outputs agree to float32 exactness
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    serial = pipeline_forward_blocks(
+        stacked, x, cfg, mesh, "pp", num_microbatches=4
+    )
+    overlap = pipeline_forward_blocks(
+        stacked, x, cfg, mesh, "pp", num_microbatches=4, overlap=True
+    )
+    assert jnp.max(jnp.abs(overlap - serial)) < 1e-5
+
+
+def test_pipeline_overlap_jits(setup):
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    out = jax.jit(
+        lambda layers, x: pipeline_forward_blocks(
+            layers, x, cfg, mesh, "pp", num_microbatches=4, overlap=True
+        )
+    )(stacked, x)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
 def test_pipeline_jits(setup):
     cfg, params, mesh, x, ref = setup
     stacked = stack_layer_params(params["layers"])
@@ -76,6 +114,16 @@ def test_stack_layer_params_shapes(setup):
 
 # -- composed dp×tp×pp -------------------------------------------------
 
+# partially-manual shard_map (manual "pp", compiler-managed data/model)
+# is unsupported by the legacy lowering — axis_index becomes a
+# PartitionId the SPMD partitioner rejects (utils/compat.py)
+from activemonitor_tpu.utils.compat import SUPPORTS_PARTIAL_MANUAL
+
+needs_partial_manual = pytest.mark.skipif(
+    not SUPPORTS_PARTIAL_MANUAL,
+    reason="legacy shard_map cannot lower partially-manual meshes",
+)
+
 
 def _composed_mesh():
     from activemonitor_tpu.parallel.mesh import make_mesh
@@ -83,6 +131,7 @@ def _composed_mesh():
     return make_mesh(("data", "model", "pp"), (2, 2, 2))
 
 
+@needs_partial_manual
 def test_pipeline_composed_matches_dense(setup):
     # manual only over "pp", data/model compiler-managed: the numbers
     # must still match the sequential reference exactly (f32). Jitted:
@@ -98,6 +147,23 @@ def test_pipeline_composed_matches_dense(setup):
     assert jnp.max(jnp.abs(got - ref)) < 1e-4
 
 
+@needs_partial_manual
+def test_pipeline_composed_overlap_matches_dense(setup):
+    # overlap composes with the partially-manual mesh: the pre-rotated
+    # schedule still hands XLA the data/model shardings to manage
+    cfg, params, mesh, x, ref = setup
+    stacked = stack_layer_params(params["layers"])
+    cmesh = _composed_mesh()
+    got = jax.jit(
+        lambda layers, x: pipeline_forward_blocks(
+            layers, x, cfg, cmesh, "pp",
+            num_microbatches=4, composed=True, overlap=True,
+        )
+    )(stacked, x)
+    assert jnp.max(jnp.abs(got - ref)) < 1e-4
+
+
+@needs_partial_manual
 def test_composed_train_step_matches_2d_loss():
     # the dp×tp×pp step must compute the same first-step loss as the
     # plain dp×tp step on the same params/tokens — the pipeline axis is

@@ -61,6 +61,46 @@ def test_collectives_run_and_report():
     assert p.algbw_gbps > 0
 
 
+def test_ppermute_bidir_chain_is_correct_and_reports():
+    """The bidirectional hop body must actually move both halves in
+    opposite directions (cw half arrives from the left neighbor, ccw
+    half from the right) and report a bandwidth."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from activemonitor_tpu.parallel.collectives import ppermute_bidir_bandwidth
+    from activemonitor_tpu.utils.compat import shard_map
+
+    mesh = make_1d_mesh()
+    n = 8
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+
+    @jax.jit
+    @partial(
+        shard_map, mesh=mesh, in_specs=P("ici"), out_specs=P("ici"),
+        check_vma=False,
+    )
+    def bidir(x):
+        half = x.shape[0] // 2
+        a = jax.lax.ppermute(x[:half], "ici", fwd)
+        b = jax.lax.ppermute(x[half:], "ici", bwd)
+        return jnp.concatenate([a, b], axis=0)
+
+    # shard d holds rows [4d, 4d+4): first two rows ride cw, last two ccw
+    x = jnp.arange(32.0)
+    out = bidir(x)
+    for d in range(n):
+        rows = out[4 * d: 4 * d + 4]
+        assert rows[0] == (4 * ((d - 1) % n)), (d, rows)  # from left
+        assert rows[2] == (4 * ((d + 1) % n) + 2), (d, rows)  # from right
+    r = ppermute_bidir_bandwidth(mesh, size_mb=0.5, iters=2)
+    assert r.name == "ppermute_bidir"
+    assert r.algbw_gbps > 0
+    assert r.busbw_gbps == pytest.approx(r.algbw_gbps)  # hop convention
+
+
 def test_reduce_scatter_and_all_to_all_report():
     mesh = make_1d_mesh()
     rs = reduce_scatter_bandwidth(mesh, size_mb=0.5, iters=2)
@@ -75,7 +115,7 @@ def test_all_to_all_chain_is_shape_preserving_and_correct():
     """One tiled all-to-all body round-trips shards correctly."""
     from functools import partial
 
-    from jax import shard_map
+    from activemonitor_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_1d_mesh()
@@ -105,6 +145,7 @@ def test_collectives_sweep_probe_on_cpu_mesh():
         "collective-reducescatter-busbw-gbps",
         "collective-alltoall-busbw-gbps",
         "collective-ringhop-busbw-gbps",
+        "collective-ringhop-bidir-busbw-gbps",
     }
     assert r.details["devices"] == 8
     # no name may collide with the north-star probe's gauges — a merged
@@ -123,9 +164,11 @@ def test_collectives_sweep_case_subset_and_validation():
 def test_alltoall_rated_ceiling_is_bisection_bound():
     from activemonitor_tpu.probes.collectives import _rated_busbw
 
-    # ring collectives: one bidirectional link pair; single hop: one link
+    # ring collectives: one bidirectional link pair; single hop: one link;
+    # bidirectional hop: both directions of the link pair (full duplex)
     assert _rated_busbw("allreduce", 45.0, 8) == 90.0
     assert _rated_busbw("ringhop", 45.0, 8) == 45.0
+    assert _rated_busbw("ringhop-bidir", 45.0, 8) == 90.0
     # all-to-all: bisection-bound, 8*B*(n-1)/n^2 < 2*B for every n >= 2
     a2a = _rated_busbw("alltoall", 45.0, 8)
     assert a2a == pytest.approx(8 * 45.0 * 7 / 64)
@@ -136,7 +179,7 @@ def test_collective_correctness():
     """The timing chain must still compute a correct mean-all-reduce."""
     from functools import partial
 
-    from jax import shard_map
+    from activemonitor_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_1d_mesh()
@@ -172,7 +215,10 @@ def test_ici_probe_on_cpu_mesh():
     names = [m.name for m in r.metrics]
     assert "ici-allreduce-busbw-gbps" in names
     assert "ici-ring-hop-gbps" in names
+    assert "ici-ring-hop-bidir-gbps" in names
     assert "ici-allreduce-fraction-of-rated" not in names  # unknown hardware
+    assert "ici-ring-hop-fraction-of-rated" not in names
+    assert "ici-ring-hop-bidir-fraction-of-rated" not in names
 
 
 def test_compile_smoke_probe():
@@ -317,6 +363,7 @@ def test_training_step_ring_attention_builds_sp_mesh():
     assert 0 < r.details["loss_last"] < 10
 
 
+@pytest.mark.slow  # interpret-mode probe re-run; tier-2 coverage
 def test_flash_probe_fraction_gate_inert_off_tpu():
     """min_fraction gates only where the fraction is measurable — a CPU
     run stays a correctness check, never a bogus perf verdict."""
@@ -527,11 +574,13 @@ def test_runtime_histogram_observed():
         {"healthcheck_name": "hc-a", "workflow": "healthCheck"},
     )
     assert count == 2
-    le15 = c.sample_value(
+    # buckets are log-spaced 1s..30m (PR 2): the 7 s run lands in le=10,
+    # the 40 s run doesn't
+    le10 = c.sample_value(
         "healthcheck_runtime_histogram_seconds_bucket",
-        {"healthcheck_name": "hc-a", "workflow": "healthCheck", "le": "15.0"},
+        {"healthcheck_name": "hc-a", "workflow": "healthCheck", "le": "10.0"},
     )
-    assert le15 == 1  # only the 7s run
+    assert le10 == 1  # only the 7s run
 
 
 def test_chain_delta_recovers_per_op_time_under_constant_overhead():
@@ -606,6 +655,7 @@ def test_collectives_per_axis_on_cpu_mesh():
     assert all(m.value > 0 for m in r.metrics)
 
 
+@pytest.mark.slow  # full probe run under the profiler CLI; tier-2 coverage
 def test_cli_profile_writes_a_trace(tmp_path, capsys):
     """--profile wraps the probe in jax.profiler.trace and must leave a
     trace artifact behind (the tracing/profiling aux subsystem,

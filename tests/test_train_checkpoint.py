@@ -23,6 +23,18 @@ from activemonitor_tpu.probes.training_step import (
 
 pytest.importorskip("orbax.checkpoint")
 
+from activemonitor_tpu.utils.compat import LEGACY_JAX
+
+if LEGACY_JAX:
+    # restoring orbax train state and stepping it SEGFAULTS the legacy
+    # CPU runtime (donated-buffer path) — a crash here aborts the whole
+    # pytest process, so the module is gated, not just failing
+    pytest.skip(
+        "legacy jax/jaxlib: orbax train-state resume segfaults the CPU "
+        "runtime",
+        allow_module_level=True,
+    )
+
 
 def _tokens(data_sh):
     cfg = tiny_config()
