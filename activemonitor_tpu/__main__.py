@@ -70,7 +70,31 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--leader-elect",
         action="store_true",
-        help="enable leader election for multi-replica HA",
+        help="enable leader election for multi-replica HA "
+        "(active/standby; superseded by --shards > 1, where per-shard "
+        "Leases ARE the election)",
+    )
+    run.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="shard the reconcile fleet across N controller replicas: "
+        "checks are consistent-hash-assigned to shards, each shard is "
+        "owned via its own coordination.k8s.io Lease, and a dead "
+        "shard's checks are adopted by the survivors without dropping "
+        "or double-firing a scheduled run (docs/operations.md "
+        "\"Sharded controller fleet\"). Needs --client k8s. 1 disables "
+        "sharding",
+    )
+    run.add_argument(
+        "--shard-id",
+        type=int,
+        default=0,
+        help="this replica's home shard in [0, --shards): acquired "
+        "eagerly (a fast restart reclaims it within the standby grace; "
+        "after a longer outage an adopting peer hands it back once this "
+        "replica is live again); every other shard is stood by for and "
+        "adopted if its owner dies",
     )
     run.add_argument(
         "--max-workers",
@@ -86,9 +110,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="fleet-wide remedy rate cap in remedy runs per minute "
         "(token bucket; layered on top of each check's "
         "remedyRunsLimit/remedyResetInterval so one bad rollout can't "
-        "launch hundreds of self-healing workflows at once). 0 disables "
-        "the cap. Suppressed runs are evented and counted in "
-        "healthcheck_remedy_runs_total{result=\"suppressed\"}",
+        "launch hundreds of self-healing workflows at once). With "
+        "--shards N the cap is apportioned by ownership: each "
+        "replica's bucket refills at rate x owned-shards/N — "
+        "re-applied on every handoff — so the fleet total stays at "
+        "the configured value even when survivors carry adopted "
+        "shards. 0 disables the cap. Suppressed runs are evented and "
+        "counted in healthcheck_remedy_runs_total{result=\"suppressed\"}",
     )
     run.add_argument(
         "--engine",
@@ -185,10 +213,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     status.add_argument(
         "--url",
-        default="http://127.0.0.1:8081/statusz",
-        help="the controller's /statusz endpoint (the health-probe "
-        "address by default; point at the metrics address when the "
-        "sites are merged)",
+        action="append",
+        default=None,
+        help="the controller's /statusz endpoint (default "
+        "http://127.0.0.1:8081/statusz — the health-probe address; "
+        "point at the metrics address when the sites are merged). "
+        "Repeat once per replica of a SHARDED fleet: the payloads are "
+        "rolled up into one fleet view (checks deduped, per-shard "
+        "ownership counts summed)",
     )
     status.add_argument(
         "--token",
@@ -237,11 +269,48 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
     from activemonitor_tpu.controller.reconciler import HealthCheckReconciler
     from activemonitor_tpu.metrics.collector import MetricsCollector
 
+    from activemonitor_tpu.errors import ConfigurationError as _ConfigError
+
+    metrics = MetricsCollector()
+    shards = getattr(args, "shards", 1)
+    shard_id = getattr(args, "shard_id", 0)
+    coordinator = None
+    if shards < 1:
+        # a typo'd 0/negative must not silently run UNSHARDED with no
+        # election — four such replicas would all reconcile everything
+        raise _ConfigError(
+            f"--shards must be >= 1 (got {shards}); 1 disables sharding"
+        )
+    if not (0 <= shard_id < shards):
+        raise _ConfigError(
+            f"--shard-id {shard_id} outside [0, {shards}) (--shards)"
+        )
+    if shards > 1:
+        if client_kind != "k8s":
+            raise _ConfigError(
+                "--shards needs the Kubernetes store (--client k8s): "
+                "shard ownership lives in coordination.k8s.io Leases"
+            )
+        from activemonitor_tpu.controller.sharding import ShardCoordinator
+
+        coordinator = ShardCoordinator(
+            api=kube_api,
+            namespace=kube_cfg.namespace or "default",
+            shards=shards,
+            shard_id=shard_id,
+            metrics=metrics,
+        )
+
     if client_kind == "k8s":
         from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
         from activemonitor_tpu.controller.events import KubernetesEventRecorder
 
-        client = KubernetesHealthCheckClient(kube_api)
+        client = KubernetesHealthCheckClient(
+            kube_api,
+            # shard-aware list/watch filtering: this replica parses and
+            # reconciles only the shards it owns (live predicate)
+            owns=coordinator.owns_event if coordinator is not None else None,
+        )
         recorder = KubernetesEventRecorder(kube_api)
     else:
         from activemonitor_tpu.controller.client_file import FileHealthCheckClient
@@ -259,7 +328,6 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         rbac_backend = KubernetesRBACBackend(kube_api)
     else:
         rbac_backend = InMemoryRBACBackend()
-    metrics = MetricsCollector()
     if args.engine == "argo":
         from activemonitor_tpu.engine.argo import ArgoWorkflowEngine
 
@@ -273,7 +341,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
 
         engine = LocalProcessEngine()
 
-    if args.leader_elect:
+    if args.leader_elect and coordinator is None:
         if client_kind == "k8s":
             from activemonitor_tpu.controller.leader import KubernetesLeaseElector
 
@@ -334,7 +402,13 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         metrics_key_file=args.metrics_key_file,
         metrics_auth_token_file=args.metrics_auth_token_file,
         metrics_authorizer=metrics_authorizer,
+        # the FLEET rate: the manager apportions it by owned shards
+        # (rate × owned/N, re-applied on handoff) so the per-replica
+        # buckets sum to the configured cap even when survivors carry
+        # adopted shards — a static rate/replica split would silently
+        # multiply the budget, a static rate/N split would shrink it
         remedy_rate=args.remedy_rate,
+        shard_coordinator=coordinator,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -593,7 +667,35 @@ def render_status_table(payload: dict) -> str:
         )
     if fleet.get("remedy_tokens") is not None:
         fleet_line += f"  remedy_tokens={fleet['remedy_tokens']:.1f}"
+    if fleet.get("replicas") is not None:
+        fleet_line += f"  replicas={fleet['replicas']}"
     lines = [fleet_line]
+    sharding = fleet.get("sharding")
+    if sharding:
+        from activemonitor_tpu.obs.slo import shard_sort_key
+
+        def shard_order(keys):
+            return sorted(keys, key=shard_sort_key)
+
+        owned = sharding.get("owned")
+        owners = sharding.get("owners")
+        if owners:  # rolled-up fleet view: shard -> owning replica
+            detail = "  ".join(
+                f"{shard}:{owners[shard]}" for shard in shard_order(owners)
+            )
+        else:  # single replica's own block
+            detail = "owned=" + ",".join(str(s) for s in owned or [])
+        per_shard = sharding.get("checks_per_shard") or {}
+        lines.append(
+            "SHARDS {}  {}  checks_per_shard={}".format(
+                sharding.get("shards", 0),
+                detail,
+                "{" + ", ".join(
+                    f"{shard}: {per_shard[shard]}"
+                    for shard in shard_order(per_shard)
+                ) + "}",
+            )
+        )
     headers = [
         "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
         "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST TRACE",
@@ -646,24 +748,62 @@ async def _status(args) -> int:
 
     import aiohttp
 
+    urls = args.url or ["http://127.0.0.1:8081/statusz"]
     headers = {"Authorization": f"Bearer {args.token}"} if args.token else {}
-    try:
-        async with aiohttp.ClientSession() as session:
-            async with session.get(args.url, headers=headers) as resp:
+    payloads = []
+    failures = []
+    # per-URL failures are warnings, not fatal, fetched concurrently
+    # under a short timeout: the failover runbook has the operator
+    # watching the rollup WHILE a replica is dead — all-or-nothing (or a
+    # black-holed node serially eating aiohttp's 300s default) would
+    # blind the CLI during the exact window it exists to observe.
+    # Connect/read-gap timeouts, NOT total: a 50k-check /statusz body is
+    # tens of MB and a total cap would misreport a healthy replica as
+    # unreachable just for being slow to stream it
+    timeout = aiohttp.ClientTimeout(connect=5, sock_connect=5, sock_read=15)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+
+        async def fetch(url):
+            async with session.get(url, headers=headers) as resp:
                 if resp.status != 200:
-                    print(
-                        f"error: {args.url} returned {resp.status}",
-                        file=sys.stderr,
-                    )
-                    return 1
-                payload = await resp.json()
-    except (aiohttp.ClientError, OSError) as e:
+                    return url, None, f"{url} returned {resp.status}"
+                return url, await resp.json(), None
+
+        results = await asyncio.gather(
+            *(fetch(url) for url in urls), return_exceptions=True
+        )
+    for url, result in zip(urls, results):
+        if isinstance(result, BaseException):
+            failures.append(f"cannot reach {url}: {result}")
+        elif result[2] is not None:
+            failures.append(result[2])
+        else:
+            payloads.append(result[1])
+    for failure in failures:
+        print(f"warning: {failure}", file=sys.stderr)
+    if not payloads:
         print(
-            f"error: cannot reach {args.url}: {e} (is the controller "
-            "running with a health-probe address?)",
+            "error: no replica reachable (is the controller running with "
+            "a health-probe address?)",
             file=sys.stderr,
         )
         return 1
+    if failures:
+        print(
+            f"warning: partial fleet view ({len(payloads)}/{len(urls)} "
+            "replicas reporting)",
+            file=sys.stderr,
+        )
+    if len(payloads) == 1:
+        payload = payloads[0]
+    else:
+        # sharded fleet: merge the per-replica payloads into one view
+        # (obs/slo.rollup_statusz — checks deduped by key, per-shard
+        # ownership counts summed, goodput the run-weighted mean of
+        # the replicas' own ratios)
+        from activemonitor_tpu.obs.slo import rollup_statusz
+
+        payload = rollup_statusz(payloads)
     if args.output == "json":
         print(_json.dumps(payload, indent=2))
         return 0

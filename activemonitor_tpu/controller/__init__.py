@@ -5,8 +5,15 @@ from activemonitor_tpu.controller.client import (
     HealthCheckClient,
     InMemoryHealthCheckClient,
     NotFoundError,
+    ShardFilteredClient,
     WatchEvent,
     retry_on_conflict,
+)
+from activemonitor_tpu.controller.sharding import (
+    ShardCoordinator,
+    ShardFencedError,
+    ShardRouter,
+    ShardSet,
 )
 from activemonitor_tpu.controller.events import (
     EVENT_NORMAL,
@@ -54,6 +61,11 @@ __all__ = [
     "RBACError",
     "RBACObject",
     "RBACProvisioner",
+    "ShardCoordinator",
+    "ShardFencedError",
+    "ShardFilteredClient",
+    "ShardRouter",
+    "ShardSet",
     "WF_INSTANCE_ID",
     "WF_INSTANCE_ID_LABEL_KEY",
     "WatchEvent",

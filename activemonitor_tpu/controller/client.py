@@ -128,6 +128,63 @@ async def retry_on_transient(
     )
 
 
+class ShardFilteredClient:
+    """Shard-aware view over any :class:`HealthCheckClient`.
+
+    ``list()`` and ``watch()`` surface only checks the ``owns``
+    predicate admits — evaluated at DELIVERY time, so ownership changes
+    (shard adoption, shed) apply to the live stream without
+    re-establishing it. ``get``/``apply``/``update_status``/``delete``
+    pass through unfiltered: handoff races legitimately read and write
+    across shard boundaries (the write fence, not the client, guards
+    those). The CLI's sharded mode uses the Kubernetes client's native
+    predicate (``KubernetesHealthCheckClient(owns=...)``, which also
+    skips parsing unowned items); this wrapper is for embedders that
+    build a sharded ``Manager`` directly on the in-memory/file
+    backends, and for the handoff test tiers.
+    """
+
+    def __init__(self, inner: HealthCheckClient, owns):
+        self._inner = inner
+        self._owns = owns  # (namespace, name) -> bool, live
+
+    async def get(self, namespace: str, name: str) -> Optional[HealthCheck]:
+        return await self._inner.get(namespace, name)
+
+    async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]:
+        return [
+            hc
+            for hc in await self._inner.list(namespace)
+            if self._owns(hc.metadata.namespace, hc.metadata.name)
+        ]
+
+    async def apply(self, hc: HealthCheck) -> HealthCheck:
+        return await self._inner.apply(hc)
+
+    async def update_status(self, hc: HealthCheck) -> HealthCheck:
+        return await self._inner.update_status(hc)
+
+    async def delete(self, namespace: str, name: str) -> None:
+        await self._inner.delete(namespace, name)
+
+    def watch(self) -> AsyncIterator[WatchEvent]:
+        # register the inner subscription SYNCHRONOUSLY at call time so
+        # the wrapper preserves the list-then-watch no-lost-events
+        # contract the manager relies on
+        inner_iter = self._inner.watch()
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            async for event in inner_iter:
+                if self._owns(event.namespace, event.name):
+                    yield event
+
+        return gen()
+
+    def __getattr__(self, name):
+        # test hooks and backend extras (force_conflicts, ...) pass through
+        return getattr(self._inner, name)
+
+
 class InMemoryHealthCheckClient:
     """In-memory store with resourceVersion CAS and watch events."""
 

@@ -33,8 +33,16 @@ class KubernetesHealthCheckClient:
     # them a second time at its own call sites
     shares_kube_transport = True
 
-    def __init__(self, api: Optional[KubeApi] = None):
+    def __init__(self, api: Optional[KubeApi] = None, owns=None):
         self._api = api if api is not None else KubeApi.from_default_config()
+        # shard filter (controller/sharding.py): a live
+        # ``(namespace, name) -> bool`` ownership predicate. Applied to
+        # the RAW items before the pydantic parse — at fleet scale
+        # (50k+ checks) parsing only the owned shards' slice is the
+        # difference between an O(fleet) and an O(fleet/N) resync.
+        # get/apply/update_status stay unfiltered: handoff races read
+        # and write across shard boundaries (the write fence guards).
+        self._owns = owns
 
     async def get(self, namespace: str, name: str) -> Optional[HealthCheck]:
         try:
@@ -47,7 +55,17 @@ class KubernetesHealthCheckClient:
 
     async def list(self, namespace: Optional[str] = None) -> List[HealthCheck]:
         raw = await self._api.get(api_path(GROUP, VERSION, PLURAL, namespace or ""))
-        return [HealthCheck.from_dict(item) for item in raw.get("items", [])]
+        items = raw.get("items", [])
+        if self._owns is not None:
+            items = [
+                item
+                for item in items
+                if self._owns(
+                    (item.get("metadata") or {}).get("namespace", ""),
+                    (item.get("metadata") or {}).get("name", ""),
+                )
+            ]
+        return [HealthCheck.from_dict(item) for item in items]
 
     async def apply(self, hc: HealthCheck) -> HealthCheck:
         """Create, or update an existing object. The spec is replaced
@@ -174,6 +192,11 @@ class KubernetesHealthCheckClient:
                             known.discard(key)
                         else:
                             known.add(key)
+                        # shard filter at YIELD time (ownership is live);
+                        # `known` still tracks the whole fleet so a
+                        # post-410 re-list stays correct across handoffs
+                        if self._owns is not None and not self._owns(*key):
+                            continue
                         yield WatchEvent(
                             type=event.get("type", "MODIFIED"),
                             namespace=key[0],
@@ -185,6 +208,8 @@ class KubernetesHealthCheckClient:
                         resource_version = ""
                         for ns, name in await self._vanished(known):
                             known.discard((ns, name))
+                            if self._owns is not None and not self._owns(ns, name):
+                                continue
                             yield WatchEvent(type="DELETED", namespace=ns, name=name)
                     else:
                         log.warning("watch broke (%s); re-establishing", e)

@@ -104,6 +104,8 @@ class KubernetesLeaseElector:
         identity: str = "",
         lease_seconds: float = 15.0,
         clock=None,
+        annotations=None,
+        takeover_grace: float = 0.0,
     ):
         import socket
         import uuid
@@ -120,11 +122,39 @@ class KubernetesLeaseElector:
         self._identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
         self._lease_seconds = float(lease_seconds)
         self._clock = clock or Clock()
+        # optional zero-arg callable merged into lease metadata.annotations
+        # on every write — rides the renewal PUT (a separate PATCH would
+        # race the renew loop's GET→PUT into a self-inflicted conflict).
+        # The sharded fleet publishes workqueue depth through this.
+        self._annotations = annotations
+        # extra staleness beyond lease_seconds before an EXPIRED holder
+        # is taken over. The sharded fleet hands every non-home standby
+        # one lease of grace so a prioritized claimant — the shard's
+        # restarted home replica, which contends with zero grace — wins
+        # the reclaim race whenever it comes back within the grace
+        # window.
+        self._takeover_grace = float(takeover_grace)
+        # a RELINQUISHED lease (empty holder: voluntary shed or home-
+        # return) is taken at once by a zero-grace claimant, but graced
+        # standbys sit out this shorter vacancy window first — longer
+        # than the prioritized claimant's poll period (lease/3), so a
+        # home-return relinquish deterministically lands HOME instead of
+        # on whichever peer polls first (each miss would cost a full
+        # adoption resync plus another return hop)
+        self._vacancy_grace = min(
+            self._takeover_grace, self._lease_seconds / 2.0
+        )
         self._stop = False
         self._acquired = False
         self._renew_task = None
         self._relinquish_task = None
         self.lost = asyncio.Event()
+        # fencing token: the lease resourceVersion after OUR last
+        # successful write, and when it landed (monotonic). A paused
+        # holder whose token no longer matches the server has been
+        # taken over — the sharding layer rejects its late writes.
+        self.fence_rv: str = ""
+        self.last_write: float = 0.0
 
     # -- lease plumbing -------------------------------------------------
     def _path(self) -> str:
@@ -132,6 +162,32 @@ class KubernetesLeaseElector:
             self.LEASE_GROUP, self.LEASE_VERSION, self.LEASE_PLURAL,
             self._namespace, self._name,
         )
+
+    @property
+    def path(self) -> str:
+        """The lease object's REST path (fence verification reads it)."""
+        return self._path()
+
+    def _note_write(self, obj: dict) -> None:
+        """Record the fencing token from a successful lease write."""
+        rv = ((obj or {}).get("metadata") or {}).get("resourceVersion")
+        if rv:
+            self.fence_rv = str(rv)
+        self.last_write = self._clock.monotonic()
+
+    def _apply_annotations(self, obj: dict) -> None:
+        if self._annotations is None:
+            return
+        try:
+            extra = self._annotations() or {}
+        except Exception:
+            log.exception("lease annotations callback failed")
+            return
+        if extra:
+            meta = obj.setdefault("metadata", {})
+            merged = dict(meta.get("annotations") or {})
+            merged.update({str(k): str(v) for k, v in extra.items()})
+            meta["annotations"] = merged
 
     def _collection_path(self) -> str:
         return api_path(
@@ -163,13 +219,28 @@ class KubernetesLeaseElector:
         renewing fine (controller-runtime does the same)."""
         observed_rv: str | None = None
         observed_at = 0.0
+        absent_since: float | None = None
         while not self._stop:
             try:
                 try:
                     existing = await self._api.get(self._path())
+                    absent_since = None
                 except ApiError as e:
                     if not e.not_found:
                         raise
+                    if self._takeover_grace > 0:
+                        # a graced contender must not win the CREATE race
+                        # either: at cold boot every shard lease is 404
+                        # and the prioritized claimant (the home replica,
+                        # grace 0) gets first crack at creating it — only
+                        # after a full grace of continuous absence does a
+                        # standby conclude nobody prioritized is coming
+                        now = self._clock.monotonic()
+                        if absent_since is None:
+                            absent_since = now
+                        if now - absent_since < self._takeover_grace:
+                            await self._clock.sleep(self._lease_seconds / 3)
+                            continue
                     # no lease yet: create it (a losing racer sees 409)
                     body = {
                         "apiVersion": f"{self.LEASE_GROUP}/{self.LEASE_VERSION}",
@@ -179,8 +250,12 @@ class KubernetesLeaseElector:
                             acquire_time=micro_time(self._clock.now())
                         ),
                     }
+                    self._apply_annotations(body)
                     try:
-                        await self._api.create(self._collection_path(), body)
+                        created = await self._api.create(
+                            self._collection_path(), body
+                        )
+                        self._note_write(created)
                         self._start_renewal()
                         return
                     except ApiError as e2:
@@ -190,7 +265,19 @@ class KubernetesLeaseElector:
                 spec = existing.get("spec", {}) or {}
                 rv = (existing.get("metadata") or {}).get("resourceVersion")
                 if not spec.get("holderIdentity") or not spec.get("renewTime"):
-                    expired = True  # relinquished or never renewed
+                    # relinquished or never renewed: immediate for a
+                    # zero-grace claimant, one vacancy window for graced
+                    # standbys (see _vacancy_grace above)
+                    if self._vacancy_grace <= 0:
+                        expired = True
+                    else:
+                        if rv != observed_rv:
+                            observed_rv = rv
+                            observed_at = self._clock.monotonic()
+                        expired = (
+                            self._clock.monotonic() - observed_at
+                            >= self._vacancy_grace
+                        )
                 elif rv != observed_rv:
                     # the record moved: the holder is alive; restart OUR
                     # local staleness window
@@ -198,7 +285,8 @@ class KubernetesLeaseElector:
                     expired = False
                 else:
                     expired = (
-                        self._clock.monotonic() - observed_at > self._lease_seconds
+                        self._clock.monotonic() - observed_at
+                        > self._lease_seconds + self._takeover_grace
                     )
                 if spec.get("holderIdentity") == self._identity or expired:
                     # preconditioned takeover: the PUT carries the
@@ -207,12 +295,14 @@ class KubernetesLeaseElector:
                     existing["spec"] = self._spec(
                         acquire_time=micro_time(self._clock.now())
                     )
+                    self._apply_annotations(existing)
                     try:
-                        await self._api.replace(self._path(), existing)
+                        updated = await self._api.replace(self._path(), existing)
                     except ApiError as e:
                         if not e.conflict:
                             raise
                         continue
+                    self._note_write(updated)
                     self._start_renewal()
                     return
             except asyncio.CancelledError:
@@ -309,22 +399,51 @@ class KubernetesLeaseElector:
                     return
                 spec["renewTime"] = micro_time(self._clock.now())
                 existing["spec"] = spec
-                await self._api.request(
+                self._apply_annotations(existing)
+                updated = await self._api.request(
                     "PUT", self._path(), body=existing, timeout=remaining()
                 )
+                self._note_write(updated)
                 last_renew = self._clock.monotonic()
                 delay = self._lease_seconds / 3
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                # ANY failure (aiohttp's ServerDisconnectedError is not
-                # an OSError) is transient only until the renew deadline
+                if isinstance(e, ApiError) and e.conflict:
+                    # a resourceVersion conflict mid-renew means another
+                    # holder replaced the lease between our GET and PUT:
+                    # leadership is ALREADY gone. Demote immediately —
+                    # retrying the renew would fight the new holder for
+                    # up to the whole renew deadline while this replica
+                    # keeps reconciling (split-brain window).
+                    log.error(
+                        "lease %s/%s renewal hit a resourceVersion conflict "
+                        "(another holder took over); leadership lost",
+                        self._namespace, self._name,
+                    )
+                    self.lost.set()
+                    return
+                # ANY other failure (aiohttp's ServerDisconnectedError is
+                # not an OSError) is transient only until the renew deadline
                 if self._clock.monotonic() - last_renew >= renew_deadline:
                     log.error("lease renewal failing (%s); leadership lost", e)
                     self.lost.set()
                     return
                 log.warning("lease renewal attempt failed (%s); retrying", e)
                 delay = retry_period
+
+    def demote(self) -> None:
+        """Externally-driven demotion (the shard fence's verdict): stop
+        renewing and declare leadership lost, without relinquishing —
+        the lease already belongs to someone else. One owner for the
+        transition: the renew loop's three self-demote paths and this
+        entry point share the same stop-renewing-then-signal shape, so
+        a fenced elector can never keep renewing behind its
+        replacement's back (two renew loops 409-dueling forever)."""
+        if self._renew_task is not None:
+            self._renew_task.cancel()
+            self._renew_task = None
+        self.lost.set()
 
     def release(self) -> None:
         """Stop renewing and relinquish the lease so a standby takes

@@ -87,13 +87,43 @@ class Manager:
         metrics_auth_token_file: str = "",  # re-read with a TTL (rotation)
         metrics_authorizer=None,  # KubeScrapeAuthorizer: TokenReview+SAR
         remedy_rate: float = 0.0,  # fleet-wide remedies/min; 0 = no cap
+        shard_coordinator=None,  # ShardCoordinator: sharded-fleet mode
+        goodput_interval: float = 30.0,  # rollup cadence; big fleets raise it
     ):
         self.client = client
         self.reconciler = reconciler
+        # sharded fleet (controller/sharding.py): ownership filters the
+        # workqueue, shard handoffs resync/release their keys, and the
+        # write fence rides the reconciler. None = classic single-owner
+        # mode behind the leader elector.
+        self._shards = shard_coordinator
+        # shards whose adoption resync failed (transient list error):
+        # retried by the shard loop until it lands — a one-shot resync
+        # would silently stop monitoring the adopted shard's existing
+        # checks (the watch only covers FUTURE events)
+        self._resync_pending: Set[int] = set()
+        self._boot_resynced = False
+        # home-shard losses seen so far: a re-acquisition (losses > 0)
+        # may never skip its adoption resync, even during boot
+        self._home_losses = 0
+        if shard_coordinator is not None:
+            reconciler.shards = shard_coordinator
+            reconciler.fleet.sharding = shard_coordinator
         # fleet-wide remedy storm control (--remedy-rate) lives in the
-        # reconciler's resilience coordinator; the manager only carries
-        # the flag to it
-        reconciler.resilience.configure_remedy_rate(remedy_rate)
+        # reconciler's resilience coordinator. Sharded fleets apportion
+        # the FLEET rate by owned shards (rate × owned/N, re-applied on
+        # every handoff by _apportion_remedy_rate) so the per-replica
+        # buckets always sum to the configured cap — a static rate/N
+        # split silently halves the fleet budget whenever survivors run
+        # with adopted shards (replicas < shards). Boot value is the
+        # home-shard share; the acquire hook corrects it immediately.
+        self._remedy_rate = remedy_rate
+        if shard_coordinator is not None and remedy_rate > 0:
+            reconciler.resilience.configure_remedy_rate(
+                remedy_rate / shard_coordinator.shards
+            )
+        else:
+            reconciler.resilience.configure_remedy_rate(remedy_rate)
         # failed-run requeues ride this manager's workqueue: per-key
         # serialized, stop-aware, re-rate-limited on crash — never a
         # loop inside a dying watch/timer task
@@ -101,6 +131,9 @@ class Manager:
         self.max_parallel = max_parallel
         self._metrics_addr = metrics_bind_address
         self._health_addr = health_probe_bind_address
+        # the goodput/shard-count rollup walks the whole (owned) check
+        # list — at 50k-check scale an operator stretches this cadence
+        self._goodput_interval = goodput_interval
         self._metrics_secure = metrics_secure
         self._metrics_cert_file = metrics_cert_file
         self._metrics_key_file = metrics_key_file
@@ -205,6 +238,8 @@ class Manager:
     def enqueue(self, namespace: str, name: str) -> None:
         key = f"{namespace}/{name}"
         metrics = self.reconciler.metrics
+        if self._shards is not None and not self._shards.owns_key(key):
+            return  # another shard's owner reconciles this key
         if key in self._processing:
             self._dirty.add(key)
             # client-go counts EVERY Add() — coalesced and dirty-deferred
@@ -238,6 +273,14 @@ class Manager:
             namespace, name = await self._queue.get()
             key = f"{namespace}/{name}"
             self._queued.discard(key)
+            if self._shards is not None and not self._shards.owns_key(key):
+                # the shard was handed off while the key sat queued: its
+                # new owner reconciles it — processing here would submit
+                # a duplicate run behind the fence
+                self._pending_trace.pop(key, None)
+                self._dirty.discard(key)
+                self._queue.task_done()
+                continue
             self._processing.add(key)
             trace_id, enqueued_at = self._pending_trace.pop(
                 key, (None, clock.monotonic())
@@ -296,18 +339,40 @@ class Manager:
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
-        """Acquire leadership, start HTTP endpoints, resync, serve."""
+        """Acquire leadership (or the home shard), start HTTP endpoints,
+        resync, serve."""
         await self._start_http()
-        log.info("waiting for leadership (%s)", type(self._elector).__name__)
-        await self._elector.acquire()
-        log.info("leadership acquired; starting %d workers", self.max_parallel)
+        if self._shards is not None:
+            # sharded fleet: per-shard Leases replace the single lock.
+            # Losing ONE shard releases its keys and keeps serving; the
+            # shard set keeps standing by for every shard forever.
+            self._shards.on_acquired = self._shard_acquired
+            self._shards.on_lost = self._shard_lost
+            self._shards.pre_shed = self._shard_pre_shed
+            log.info(
+                "waiting for a shard (%d shards, home %d)",
+                self._shards.shards, self._shards.shard_id,
+            )
+            await self._shards.start()
+            log.info(
+                "shard(s) %s acquired; starting %d workers",
+                self._shards.owned_shards(), self.max_parallel,
+            )
+            self._tasks.append(asyncio.create_task(self._shard_loop()))
+        else:
+            log.info("waiting for leadership (%s)", type(self._elector).__name__)
+            await self._elector.acquire()
+            log.info("leadership acquired; starting %d workers", self.max_parallel)
 
-        # a lost election must stop reconciling immediately — the other
-        # replica is already active (reference: controller-runtime
-        # terminates the process on lost leadership)
-        lost = getattr(self._elector, "lost", None)
-        if isinstance(lost, asyncio.Event):
-            self._tasks.append(asyncio.create_task(self._leadership_watch(lost)))
+            # a lost election must stop reconciling immediately — the
+            # other replica is already active (reference:
+            # controller-runtime terminates the process on lost
+            # leadership)
+            lost = getattr(self._elector, "lost", None)
+            if isinstance(lost, asyncio.Event):
+                self._tasks.append(
+                    asyncio.create_task(self._leadership_watch(lost))
+                )
 
         # watch FIRST, resync list second. No-lost-events rests on one of
         # two client guarantees: in-memory/file watches register
@@ -319,11 +384,16 @@ class Manager:
         self._tasks.append(asyncio.create_task(self._watch_loop(watch_iterator)))
         for i in range(self.max_parallel):
             self._tasks.append(asyncio.create_task(self._worker(i)))
-        self._tasks.append(asyncio.create_task(self._goodput_loop()))
+        self._tasks.append(
+            asyncio.create_task(self._goodput_loop(self._goodput_interval))
+        )
         self._tasks.append(asyncio.create_task(self._resilience_loop()))
         # boot resync: reconcile everything that already exists
         for hc in await self.client.list():
             self.enqueue(hc.metadata.namespace, hc.metadata.name)
+        # from here on, adopted shards resync themselves (the home
+        # shard's acquisition during start() rode this boot list)
+        self._boot_resynced = True
         self._ready.set()
 
     async def _cert_reload_loop(self, interval: float = 60.0) -> None:
@@ -421,6 +491,10 @@ class Manager:
                 # cadence — it walks every check's result ring, which
                 # is rollup work, not reconcile-path work
                 self.reconciler.fleet.refresh_fleet_goodput()
+                if self._shards is not None:
+                    # per-shard ownership counts for /statusz and the
+                    # healthcheck_shard_checks gauge (rollup work too)
+                    self._shards.update_check_counts(checks)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -443,6 +517,151 @@ class Manager:
                 raise
             except Exception:
                 log.exception("resilience sweep failed")
+
+    # -- sharded fleet ---------------------------------------------------
+    async def _shard_acquired(self, shard: int) -> None:
+        """Adopt a shard: reconcile every check it routes. The restart-
+        resume path (reconciler divergence 10) rebuilds each TimerWheel
+        entry from durable ``.status`` — current checks re-arm for the
+        remaining interval, checks whose fire passed while the shard was
+        orphaned run immediately — so the dead owner's owed runs fire
+        exactly once, here. A failed resync is parked for the shard
+        loop to retry: the watch stream only yields FUTURE events, so
+        giving up would silently stop monitoring the shard's existing
+        checks."""
+        self._apportion_remedy_rate()
+        if (
+            shard == self._shards.shard_id
+            and not self._boot_resynced
+            and self._home_losses == 0
+        ):
+            # the home shard is acquired while start() is still waiting
+            # on the shard set, and start()'s boot resync — which always
+            # follows — lists the whole owned slice anyway: a second
+            # full LIST here would double the O(fleet/N) boot cost for
+            # zero extra coverage. Only the FIRST acquisition may skip:
+            # a home shard lost and re-acquired while the boot list was
+            # in flight had its keys filtered out of that list (owns was
+            # False at enqueue time), so the re-acquisition must resync
+            # like any adoption or the shard's existing checks stay
+            # unmonitored until an unrelated watch event
+            return
+        if not await self._adopt_resync({shard}):
+            self._resync_pending.add(shard)
+
+    def _apportion_remedy_rate(self) -> None:
+        """This replica's share of the fleet --remedy-rate follows its
+        owned-shard count: rate × owned/N. Summed over the fleet the
+        buckets equal the configured cap exactly whenever every shard
+        has one owner — including survivors carrying adopted shards.
+        (A shardless standby gets rate/N rather than zero: a bucket
+        must exist for the fence-adjacent window where a just-lost
+        shard's in-flight run still reaches the remedy gate.)"""
+        if self._shards is None or self._remedy_rate <= 0:
+            return
+        owned = max(1, len(self._shards.set.owned))
+        self.reconciler.resilience.configure_remedy_rate(
+            self._remedy_rate * owned / self._shards.shards
+        )
+
+    async def _adopt_resync(self, shards: Set[int]) -> bool:
+        """Resync every check routed to ``shards`` — ONE list serves
+        the whole batch (a burst adoption of k shards must not cost k
+        identical O(owned-slice) LISTs)."""
+        try:
+            checks = await self.client.list()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception(
+                "adoption resync list for shard(s) %s failed; retrying "
+                "from the shard loop", sorted(shards),
+            )
+            return False
+        adopted = 0
+        for hc in checks:
+            if self._shards.shard_for(hc.key) in shards:
+                self.enqueue(hc.metadata.namespace, hc.metadata.name)
+                adopted += 1
+        log.info(
+            "shard(s) %s adopted: %d checks resynced", sorted(shards), adopted
+        )
+        return True
+
+    async def _shard_pre_shed(self, shard: int) -> bool:
+        """A voluntary shed must hand the adopter durable truth: defer
+        (try again next sweep) while any of the shard's work is still in
+        flight — a reconcile being processed, a watch tracking a
+        submitted workflow, or a queued status write. Shedding under any
+        of those drops the run's record at the fence and the adopter
+        re-submits the very cycle this replica already ran (the crash
+        path has no such choice; the voluntary path does)."""
+
+        def in_shard(key: str) -> bool:
+            return self._shards.shard_for(key) == shard
+
+        def defer() -> bool:
+            # the shard was DRAINING while this gate ran: any timer fire
+            # or dequeue in that window was dropped unsubmitted, and an
+            # aborted shed keeps ownership — so a resync must re-arm
+            # whatever the drain swallowed (it runs on the next sweep,
+            # after the coordinator lifts the draining mark)
+            self._resync_pending.add(shard)
+            return False
+
+        if any(in_shard(key) for key in self._processing):
+            return defer()
+        if self.reconciler.has_inflight(in_shard):
+            return defer()
+        res = self.reconciler.resilience
+        if res.pending_status_writes():
+            await self.reconciler.replay_status_writes()
+        if any(in_shard(key) for key in res.queued_status_keys()):
+            return defer()
+        return True
+
+    async def _shard_lost(self, shard: int) -> None:
+        """Handoff cleanup: every pending timer, in-flight watch, and
+        queued status write for the shard's keys dies HERE — whatever
+        survived would either double-fire against the new owner's
+        schedule or be rejected by the write fence."""
+        if shard == self._shards.shard_id:
+            self._home_losses += 1
+        self._apportion_remedy_rate()
+        self._resync_pending.discard(shard)
+        released = self.reconciler.release_keys(
+            lambda key: self._shards.shard_for(key) == shard
+        )
+        log.warning(
+            "shard %d handed off: released %d timers/watches", shard, released
+        )
+
+    async def _shard_loop(self, interval: float = 10.0) -> None:
+        """Publish this replica's workqueue depth (rides the shard lease
+        renewals) and run the work-stealing policy: shed an adopted
+        shard when our depth diverges above the fleet median."""
+        clock = self.reconciler.clock
+        while True:
+            await clock.sleep(interval)
+            try:
+                # retry adoption resyncs that failed at acquisition time
+                # (or were owed by an aborted shed) — still-owned shards
+                # only, batched behind one list. Subtract exactly what
+                # was attempted: a shard adopted DURING the awaited list
+                # may park its own failed resync concurrently, and a
+                # blanket clear() would silently drop it
+                self._resync_pending &= set(self._shards.set.owned)
+                attempted = set(self._resync_pending)
+                if attempted and await self._adopt_resync(attempted):
+                    self._resync_pending -= attempted
+                depth = self._queue.qsize()
+                shed = await self._shards.rebalance(depth)
+                if shed is not None:
+                    log.info("work-stealing shed shard %d", shed)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("shard rebalance sweep failed")
 
     async def _leadership_watch(self, lost: asyncio.Event) -> None:
         await lost.wait()
@@ -491,6 +710,8 @@ class Manager:
         self._http_runners.clear()
         # awaitable release guarantees the lease handoff completes before
         # the caller tears down the shared API session
+        if self._shards is not None:
+            await self._shards.stop()
         release_async = getattr(self._elector, "release_async", None)
         if release_async is not None:
             await release_async()

@@ -64,6 +64,7 @@ from activemonitor_tpu.controller.events import (
     EventRecorder,
 )
 from activemonitor_tpu.controller.rbac import RBACProvisioner
+from activemonitor_tpu.controller.sharding import ShardFencedError
 from activemonitor_tpu.controller.workflow_spec import (
     parse_remedy_workflow_from_healthcheck,
     parse_workflow_from_healthcheck,
@@ -135,6 +136,11 @@ class HealthCheckReconciler:
         self.analysis = AnalysisEngine(self.clock, metrics)
         self.fleet.analysis = self.analysis
         self.timers = TimerWheel(self.clock)
+        # sharded-fleet coordinator (controller/sharding.py), wired by
+        # the Manager when --shards > 1: ownership gates for timer-fired
+        # resubmits and the write fence that rejects a paused old
+        # owner's late status writes. None = unsharded (own everything).
+        self.shards = None
         self._watch_tasks: Dict[str, asyncio.Task] = {}
         # set by the Manager: routes failed-run requeues through its
         # workqueue (per-key serialized, stop-aware, retried on crash)
@@ -177,6 +183,11 @@ class HealthCheckReconciler:
             return await self._process(hc)
         except NotFoundError:
             # resource vanished mid-process: swallow (reference: :201-203)
+            return None
+        except ShardFencedError as e:
+            # the key's shard was handed off mid-cycle: its new owner
+            # drives the schedule — not an error, never quarantine fuel
+            log.info("cycle for %s stopped by the shard fence (%s)", hc.key, e)
             return None
         except asyncio.CancelledError:
             raise
@@ -534,6 +545,11 @@ class HealthCheckReconciler:
             except NotFoundError:
                 log.info("dropping queued status write for deleted %s", key)
                 continue
+            except ShardFencedError as e:
+                # the shard moved while this write sat in the queue: the
+                # new owner's status is the truth now — drop, don't spin
+                self._note_fenced_write(queued, e)
+                continue
             except asyncio.CancelledError:
                 res.requeue_status_write(key, queued)
                 raise
@@ -580,9 +596,17 @@ class HealthCheckReconciler:
         own call sites are the breaker's only signal source."""
         return not getattr(self.engine, "shares_kube_transport", False)
 
-    async def _engine_submit(self, manifest: dict) -> str:
+    async def _engine_submit(self, manifest: dict, key: str = "") -> str:
         """engine.submit behind the shared breaker: rejected fast while
-        open, outcome recorded for transport-less engines."""
+        open, outcome recorded for transport-less engines. In the
+        sharded fleet the SUBMIT is fenced too, not just the status
+        write — a paused old owner resuming mid-cycle would otherwise
+        still launch a duplicate workflow (whose record the write fence
+        then drops, so the adopter re-runs it a third time). Zero extra
+        I/O while our lease knowledge is fresh; one lease GET when
+        stale — exactly the admit_write discipline."""
+        if self.shards is not None and key:
+            await self.shards.admit_write(key)
         breaker = self.resilience.breaker
         if not breaker.allow():
             raise BreakerOpenError(breaker.name, breaker.retry_after())
@@ -612,7 +636,7 @@ class HealthCheckReconciler:
         with self.tracer.span(
             "submit", healthcheck=hc.key, engine=self._engine_name
         ):
-            wf_name = await self._engine_submit(manifest)
+            wf_name = await self._engine_submit(manifest, key=hc.key)
         self.metrics.record_engine_submit(self._engine_name)
         # a clean submission breaks the pre-terminal error streak even
         # if the run later fails its verdict
@@ -685,6 +709,12 @@ class HealthCheckReconciler:
             await self._watch_workflow_reschedule(hc, wf_name)
         except asyncio.CancelledError:
             raise
+        except ShardFencedError as e:
+            # handed off mid-watch (e.g. the remedy submit was fenced):
+            # the new owner drives this check now — no requeue, and
+            # never an error counted toward quarantine
+            log.info("watch for %s stopped by the shard fence (%s)", hc.key, e)
+            return
         except Exception:
             log.exception("watch failed for %s; requeueing in 1s", hc.key)
             self.recorder.event(
@@ -746,6 +776,38 @@ class HealthCheckReconciler:
         finally:
             if current is not None:
                 self._requeue_loops.discard(current)
+
+    def has_inflight(self, predicate) -> bool:
+        """True while any live watch task tracks a key matching
+        ``predicate`` — the shard layer defers voluntary sheds on this
+        (an in-flight run whose status write lands after the shed would
+        be fenced and dropped, and the adopter would re-run it)."""
+        return any(
+            predicate(key)
+            for key, task in self._watch_tasks.items()
+            if not task.done()
+        )
+
+    def release_keys(self, predicate) -> int:
+        """Shard handoff: drop every piece of LOCAL scheduling state for
+        keys matching ``predicate`` — pending timers, in-flight watch
+        tasks, queued status writes. The adopting owner rebuilds all of
+        it from durable status (divergence 10), so anything left here
+        could only double-fire or write fenced garbage. Returns how many
+        timers/watches were released."""
+        released = 0
+        for key in self.timers.names():
+            if predicate(key) and self.timers.stop(key):
+                released += 1
+        for key, task in list(self._watch_tasks.items()):
+            if not predicate(key):
+                continue
+            if not task.done():
+                task.cancel()
+                released += 1
+            self._watch_tasks.pop(key, None)
+        self.resilience.drop_status_writes_matching(predicate)
+        return released
 
     async def wait_watches(self) -> None:
         """Test/shutdown helper: wait for all in-flight watches."""
@@ -1077,6 +1139,14 @@ class HealthCheckReconciler:
             if current is not None:
                 self._watch_tasks[f"{namespace}/{name}"] = current
 
+            # sharded fleet: the shard may have been handed off since
+            # this timer was armed (shed, lease lost) — its new owner
+            # drives the schedule now, so firing here would double-run
+            if self.shards is not None and not self.shards.owns_key(
+                f"{namespace}/{name}"
+            ):
+                return
+
             hc = await self.client.get(namespace, name)
             if hc is None:
                 return
@@ -1121,6 +1191,14 @@ class HealthCheckReconciler:
                     wf_name = await self._submit_workflow(hc)
                 except asyncio.CancelledError:
                     raise
+                except ShardFencedError as e:
+                    # handed off between the ownership gate above and
+                    # the submit: the new owner fires this run
+                    log.info(
+                        "timer-fired run for %s stopped by the shard "
+                        "fence (%s)", hc.key, e,
+                    )
+                    return
                 except Exception:
                     log.exception(
                         "error creating or submitting workflow for %s", hc.key
@@ -1247,7 +1325,7 @@ class HealthCheckReconciler:
                 workflow_type="remedy",
                 engine=self._engine_name,
             ):
-                wf_name = await self._engine_submit(manifest)
+                wf_name = await self._engine_submit(manifest, key=hc.key)
             self.metrics.record_engine_submit(self._engine_name)
             self.recorder.event(
                 hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
@@ -1375,8 +1453,28 @@ class HealthCheckReconciler:
     # ------------------------------------------------------------------
     # status writes (reference: updateHealthCheckStatus, :1445-1462)
     # ------------------------------------------------------------------
+    def _note_fenced_write(self, hc: HealthCheck, why: Exception | None = None) -> None:
+        """A status write was rejected by the shard fence: the key's
+        shard has a new owner, so this replica's record of the run is
+        DROPPED (never queued — replaying it later would overwrite the
+        new owner's truth, the split-brain write the chaos soak pins)."""
+        log.warning(
+            "dropping status write for %s: shard fence rejected it (%s)",
+            hc.key, why or "shard not owned",
+        )
+        if self.shards is not None:
+            self.shards.note_fenced(hc.key)
+
     async def _update_status(self, hc: HealthCheck) -> None:
         res = self.resilience
+        if self.shards is not None and not self.shards.owns_for_write(hc.key):
+            # cheap local fence BEFORE the breaker check: a degraded old
+            # owner must not park a fenced write for replay either.
+            # owns_for_write, not owns_key: a shard mid-voluntary-shed
+            # (draining) still holds its lease, and an in-flight run
+            # finishing during the pre-shed scan must record its result
+            self._note_fenced_write(hc)
+            return
         if not res.breaker.allow():
             # degraded mode: the write records a run that ALREADY
             # happened — park it for replay instead of failing the
@@ -1386,6 +1484,9 @@ class HealthCheckReconciler:
             return
         try:
             await self._write_status_now(hc)
+        except ShardFencedError as e:
+            self._note_fenced_write(hc, e)
+            return
         except BreakerOpenError:
             # the breaker tripped mid-ladder (these very failures fed
             # it): same parking contract as above
@@ -1408,6 +1509,13 @@ class HealthCheckReconciler:
             await self.replay_status_writes()
 
     async def _write_status_now(self, hc: HealthCheck) -> None:
+        if self.shards is not None:
+            # resourceVersion fencing (controller/sharding.py): verify
+            # this replica still holds the key's shard lease before the
+            # write — a paused old owner's late write raises here and is
+            # dropped by every caller, never retried or queued
+            await self.shards.admit_write(hc.key)
+
         async def attempt():
             fresh = await self.client.get(hc.metadata.namespace, hc.metadata.name)
             if fresh is None:

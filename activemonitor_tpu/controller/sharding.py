@@ -1,0 +1,742 @@
+"""Sharded controller fleet — consistent-hash ownership, crash-safe handoff.
+
+ROADMAP item 1: a single manager process owning every HealthCheck
+behind active/standby election (controller/leader.py) stalls the whole
+fleet on one crash and scales to exactly one process. This module
+shards the reconcile fleet horizontally, the Maple direction (PAPERS.md:
+partitioned control planes that survive member churn) applied to our
+control plane:
+
+- :class:`ShardRouter` — consistent-hash assignment of check keys to N
+  shards (md5 ring with virtual nodes, stable across processes and
+  Python hash randomization; adding a shard moves ~1/(N+1) of the keys).
+- :class:`ShardSet` — one :class:`~activemonitor_tpu.controller.leader.
+  KubernetesLeaseElector` per shard, generalizing the single HA lock to
+  a shard map: a replica acquires its *home* shard eagerly and stands by
+  for every other shard, adopting any whose lease expires (shard death,
+  scale-down). Standbys wait one lease of grace past expiry, so a FAST
+  restart reclaims the home shard before any peer adopts it; after a
+  longer outage a peer adopts first, and the coordinator's home-return
+  rule hands the shard back once the restarted replica's presence lease
+  is moving again.
+- :class:`ShardCoordinator` — the manager/reconciler façade: ownership
+  checks for watch/list/queue filtering, resourceVersion fencing for
+  status writes (a paused old owner's late write is rejected), depth
+  publication via lease annotations riding the renewal write, and
+  shard-granular work-stealing when this replica's ``workqueue_depth``
+  diverges above the fleet median.
+
+Crash-safe handoff needs no new durable state: the adopting owner
+reconciles every check of the dead shard, and the restart-resume path
+(reconciler divergence 10) rebuilds each TimerWheel entry from the
+durable ``.status`` — current checks re-arm for the remaining interval,
+overdue checks fire immediately, and nothing double-fires because the
+old owner's timers died with it and its late status writes are fenced.
+
+Everything here runs on the injectable Clock (hack/lint.py bans bare
+wall-clock reads in this module, like resilience/ and analysis/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import hashlib
+import logging
+import statistics
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from activemonitor_tpu.controller.leader import ELECTION_ID, KubernetesLeaseElector
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.sharding")
+
+# workqueue depth published on each shard lease (rides the renewal PUT,
+# never a separate write — a separate PATCH would race the renew loop's
+# GET→PUT and self-inflict the conflict that demotes a holder)
+DEPTH_ANNOTATION = "activemonitor.keikoproj.io/workqueue-depth"
+
+# a replica sheds a shard only when its depth exceeds the fleet median
+# by at least this many queued keys — small divergence is noise, not
+# imbalance worth a handoff
+DEFAULT_STEAL_THRESHOLD = 16
+
+
+class ShardFencedError(Exception):
+    """A write was rejected because this replica no longer holds the
+    key's shard lease (expired, taken over, or shed). The new owner is
+    authoritative; the caller must DROP the write, never queue it."""
+
+    def __init__(self, shard: int, key: str, reason: str = ""):
+        super().__init__(
+            f"shard {shard} fence rejected write for {key}"
+            + (f": {reason}" if reason else "")
+        )
+        self.shard = shard
+        self.key = key
+
+
+def _point(data: str) -> int:
+    """Stable 64-bit ring position (md5, not ``hash()``: every replica
+    must map a key to the same shard across processes and restarts)."""
+    return int.from_bytes(hashlib.md5(data.encode()).digest()[:8], "big")
+
+
+class ShardRouter:
+    """Consistent-hash ring: check key -> shard id in [0, shards)."""
+
+    def __init__(self, shards: int, vnodes: int = 128):
+        if shards < 1:
+            raise ValueError(f"need at least one shard, got {shards}")
+        self.shards = shards
+        ring = sorted(
+            (_point(f"shard-{shard}/vnode-{v}"), shard)
+            for shard in range(shards)
+            for v in range(vnodes)
+        )
+        self._points = [p for p, _ in ring]
+        self._owners = [s for _, s in ring]
+
+    def shard_for(self, key: str) -> int:
+        if self.shards == 1:
+            return 0
+        i = bisect.bisect(self._points, _point(key)) % len(self._points)
+        return self._owners[i]
+
+
+def shard_lease_name(shard: int) -> str:
+    """Per-shard Lease object name — the single-lock ELECTION_ID
+    generalized to a shard map (one coordination.k8s.io Lease each)."""
+    return f"{ELECTION_ID}-shard-{shard:02d}"
+
+
+def member_lease_name(slot: int) -> str:
+    """Per-replica presence Lease (slot = the replica's home shard id).
+
+    Distinct from the shard leases on purpose: a replica that owns NO
+    shard right now (fresh restart whose home was adopted by a peer)
+    still renews its member lease, so its published workqueue depth
+    stays visible to the work-stealing median — otherwise an idle
+    standby could never be stolen FOR, and an overloaded survivor would
+    keep an adopted shard forever."""
+    return f"{ELECTION_ID}-member-{slot:02d}"
+
+
+class ShardSet:
+    """All N shard elections, driven from one replica.
+
+    The home shard is contended immediately; every other shard gets a
+    standby loop that sleeps one lease duration before contending, so a
+    healthy fleet converges to one shard per replica and an orphaned
+    shard is adopted by whichever survivor's standby wins the expired
+    lease (the elector's preconditioned takeover keeps that race safe).
+    """
+
+    def __init__(
+        self,
+        api,
+        namespace: str,
+        shards: int,
+        home_shard: int,
+        identity: str,
+        clock: Optional[Clock] = None,
+        lease_seconds: float = 15.0,
+        annotations: Optional[Callable[[], dict]] = None,
+        on_acquired: Optional[Callable[[int], Awaitable[None]]] = None,
+        on_lost: Optional[Callable[[int], Awaitable[None]]] = None,
+    ):
+        if not (0 <= home_shard < shards):
+            raise ValueError(f"shard id {home_shard} outside [0, {shards})")
+        self._api = api
+        self._namespace = namespace
+        self.shards = shards
+        self.home_shard = home_shard
+        self.identity = identity
+        self.clock = clock or Clock()
+        self.lease_seconds = float(lease_seconds)
+        self._annotations = annotations
+        self.on_acquired = on_acquired
+        self.on_lost = on_lost
+        self.owned: Dict[int, KubernetesLeaseElector] = {}
+        # this replica's presence lease (member_lease_name); None while
+        # contending (e.g. a same-slot twin holds it)
+        self.member: Optional[KubernetesLeaseElector] = None
+        # shards in acquisition order; the tail is the most recently
+        # adopted one — the first candidate for work-stealing shed
+        self.adopt_order: List[int] = []
+        self.first_owned = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+        # monotonic deadline before which a shed shard is not
+        # re-contended (another replica must get a clean shot at it)
+        self._cooldown: Dict[int, float] = {}
+        self._shedding: set = set()
+
+    def _make_elector(self, shard: int) -> KubernetesLeaseElector:
+        return KubernetesLeaseElector(
+            api=self._api,
+            namespace=self._namespace,
+            name=shard_lease_name(shard),
+            identity=self.identity,
+            lease_seconds=self.lease_seconds,
+            clock=self.clock,
+            annotations=self._annotations,
+            # standby grace, enforced INSIDE the contend loop so it
+            # holds in steady state (not just on the first loop entry):
+            # non-home standbys wait one extra lease past expiry before
+            # takeover, while the shard's home replica contends with no
+            # grace — a home replica restarting within the grace window
+            # reclaims its shard before any peer adopts it. Relinquished
+            # leases (voluntary shed / home-return) get a SHORTER
+            # vacancy window (elector's _vacancy_grace): the home
+            # replica still takes them immediately, graced standbys a
+            # beat later — so a home-return lands home, not on whichever
+            # peer polls first.
+            takeover_grace=(
+                0.0 if shard == self.home_shard else self.lease_seconds
+            ),
+        )
+
+    async def start(self, wait_first: bool = True) -> None:
+        """Spawn one election loop per shard plus the presence loop; by
+        default blocks until this replica owns at least one shard (its
+        home, on a healthy fleet) so the manager never serves
+        shardless."""
+        self._tasks.append(
+            asyncio.create_task(self._run_member(), name="shard-member")
+        )
+        for shard in range(self.shards):
+            self._tasks.append(
+                asyncio.create_task(
+                    self._run_shard(shard), name=f"shard-election:{shard}"
+                )
+            )
+        if wait_first:
+            await self.first_owned.wait()
+
+    async def _run_member(self) -> None:
+        """Hold the replica's presence lease continuously — it exists
+        only to carry the depth annotation, so losing it never touches
+        shard ownership; the loop just re-contends."""
+        while not self._stopping:
+            elector = KubernetesLeaseElector(
+                api=self._api,
+                namespace=self._namespace,
+                name=member_lease_name(self.home_shard),
+                identity=self.identity,
+                lease_seconds=self.lease_seconds,
+                clock=self.clock,
+                annotations=self._annotations,
+            )
+            await elector.acquire()
+            self.member = elector
+            await elector.lost.wait()
+            self.member = None
+
+    async def _run_shard(self, shard: int) -> None:
+        while not self._stopping:
+            wait = self._cooldown.pop(shard, 0.0) - self.clock.monotonic()
+            if wait > 0:
+                await self.clock.sleep(wait)
+            # (the standby grace for non-home shards lives inside the
+            # elector's contend loop — takeover_grace in _make_elector —
+            # so it applies in steady state, not just at loop entry)
+            if self._stopping:
+                return
+            elector = self._make_elector(shard)
+            await elector.acquire()
+            self.owned[shard] = elector
+            self.adopt_order.append(shard)
+            self.first_owned.set()
+            log.info(
+                "shard %d acquired by %s (%d/%d owned)",
+                shard, self.identity, len(self.owned), self.shards,
+            )
+            if self.on_acquired is not None:
+                try:
+                    await self.on_acquired(shard)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("on_acquired(%d) callback failed", shard)
+            await elector.lost.wait()
+            self.owned.pop(shard, None)
+            try:
+                self.adopt_order.remove(shard)
+            except ValueError:
+                pass
+            shed = shard in self._shedding
+            self._shedding.discard(shard)
+            log.warning(
+                "shard %d %s by %s (%d/%d owned)",
+                shard, "shed" if shed else "lost", self.identity,
+                len(self.owned), self.shards,
+            )
+            if self.on_lost is not None:
+                try:
+                    await self.on_lost(shard)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("on_lost(%d) callback failed", shard)
+
+    async def shed(self, shard: int) -> bool:
+        """Voluntarily release an adopted shard (work-stealing): the
+        lease is relinquished so an underloaded peer's standby takes it
+        within one short vacancy window, and this replica sits out two
+        lease durations before contending again. The home shard is
+        never shed."""
+        elector = self.owned.get(shard)
+        if elector is None or shard == self.home_shard:
+            return False
+        self._shedding.add(shard)
+        self._cooldown[shard] = self.clock.monotonic() + self.lease_seconds * 2
+        await elector.release_async()
+        # wake the _run_shard loop: release() suppresses the elector's
+        # own lost signal (orderly stop), so the shed path fires it
+        elector.lost.set()
+        return True
+
+    async def stop(self) -> None:
+        """Orderly shutdown: stop contending, relinquish every owned
+        lease so survivors adopt immediately instead of waiting out the
+        lease durations."""
+        self._stopping = True
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        for elector in list(self.owned.values()):
+            await elector.release_async()
+        if self.member is not None:
+            await self.member.release_async()
+            self.member = None
+        self.owned.clear()
+        self.adopt_order.clear()
+
+
+class ShardCoordinator:
+    """The sharded fleet's face toward the manager and reconciler.
+
+    Bundles the router (who owns a key), the shard set (which leases
+    this replica holds), write fencing (reject a paused old owner's
+    late status writes), depth publication, and the shard-granular
+    work-stealing policy. ``/statusz`` serves :meth:`snapshot`;
+    :func:`activemonitor_tpu.obs.slo.rollup_statusz` merges the
+    per-replica snapshots into the fleet view.
+    """
+
+    def __init__(
+        self,
+        api,
+        namespace: str,
+        shards: int,
+        shard_id: int,
+        identity: str = "",
+        clock: Optional[Clock] = None,
+        metrics=None,
+        lease_seconds: float = 15.0,
+        steal_threshold: int = DEFAULT_STEAL_THRESHOLD,
+        vnodes: int = 128,
+    ):
+        import socket
+        import uuid
+
+        self.api = api
+        self.namespace = namespace
+        self.shards = shards
+        self.shard_id = shard_id
+        self.clock = clock or Clock()
+        self.metrics = metrics
+        self.lease_seconds = float(lease_seconds)
+        self.steal_threshold = steal_threshold
+        self.identity = (
+            identity or f"{socket.gethostname()}-s{shard_id}-{uuid.uuid4().hex[:8]}"
+        )
+        self.router = ShardRouter(shards, vnodes=vnodes)
+        self._depth = 0
+        self._check_counts: Dict[int, int] = {}
+        self._shed_pending: set = set()
+        # shards mid-voluntary-handoff: owns_key() reports them unowned
+        # so no NEW work starts while the pre-shed gate scans and the
+        # lease is released (closing the dequeue-during-shed race), but
+        # in-flight writes still land — we hold the lease until the
+        # release, and owns_for_write()/admit_write ignore draining
+        self.draining: set = set()
+        # member-lease liveness by LOCALLY-OBSERVED resourceVersion
+        # movement (slot -> (rv, monotonic first seen at this rv)) —
+        # the same skew-immune discipline the elector's expiry uses;
+        # trusting the holder's renewTime wall-clock stamp would wedge
+        # home-return behind clock skew
+        self._member_seen: Dict[int, Tuple[str, float]] = {}
+        # member rv observed on the first sweep after adopting a shard:
+        # the corpse's final renewal must not read as presence — only
+        # MOVEMENT from this baseline proves the home replica is back
+        self._member_baseline: Dict[int, str] = {}
+        self.fenced_writes = 0
+        # wired by the Manager before start(): adoption resync / handoff
+        # cleanup. The coordinator's own hooks keep the metrics honest
+        # even when no manager is attached (unit tests).
+        self.on_acquired: Optional[Callable[[int], Awaitable[None]]] = None
+        self.on_lost: Optional[Callable[[int], Awaitable[None]]] = None
+        # awaited before a VOLUNTARY shed; returning False aborts it.
+        # The manager uses this to drain the shard's queued status
+        # writes first — a shed must hand the new owner durable truth,
+        # not strand recorded runs in this process's replay queue
+        # (crash handoffs have no such luxury: durable status is all
+        # the corpse leaves behind, and the fence blocks its late
+        # corrections).
+        self.pre_shed: Optional[Callable[[int], Awaitable[bool]]] = None
+        self.set = ShardSet(
+            api,
+            namespace,
+            shards,
+            shard_id,
+            self.identity,
+            clock=self.clock,
+            lease_seconds=lease_seconds,
+            annotations=self._lease_annotations,
+            on_acquired=self._acquired,
+            on_lost=self._lost,
+        )
+
+    # -- ownership -------------------------------------------------------
+    def shard_for(self, key: str) -> int:
+        return self.router.shard_for(key)
+
+    def owns_key(self, key: str) -> bool:
+        """May NEW work for this key start here? False for unowned AND
+        for draining shards (a voluntary handoff in progress must not
+        admit fresh dequeues/timer fires it would immediately strand)."""
+        shard = self.router.shard_for(key)
+        return shard in self.set.owned and shard not in self.draining
+
+    def owns_for_write(self, key: str) -> bool:
+        """May a status write for this key be attempted? Unlike
+        :meth:`owns_key` this ignores ``draining`` — the lease is held
+        until the release lands, and an in-flight run finishing during
+        the pre-shed scan must record its result, not get dropped."""
+        elector = self.set.owned.get(self.router.shard_for(key))
+        return elector is not None and not elector.lost.is_set()
+
+    def owns_event(self, namespace: str, name: str) -> bool:
+        """Watch/list filter predicate (namespace, name) — the shape
+        the shard-aware clients take."""
+        return self.owns_key(f"{namespace}/{name}")
+
+    def owned_shards(self) -> List[int]:
+        return sorted(self.set.owned)
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self, wait_first: bool = True) -> None:
+        await self.set.start(wait_first=wait_first)
+
+    async def stop(self) -> None:
+        await self.set.stop()
+        if self.metrics is not None:
+            for shard in range(self.shards):
+                self.metrics.set_shard_owned(shard, False)
+
+    async def _acquired(self, shard: int) -> None:
+        if self.metrics is not None:
+            self.metrics.set_shard_owned(shard, True)
+            self.metrics.record_shard_handoff(shard, "acquired")
+        if self.on_acquired is not None:
+            await self.on_acquired(shard)
+
+    async def _lost(self, shard: int) -> None:
+        self._check_counts.pop(shard, None)
+        self._member_baseline.pop(shard, None)
+        shed = shard in self._shed_pending
+        self._shed_pending.discard(shard)
+        if self.metrics is not None:
+            self.metrics.set_shard_owned(shard, False)
+            self.metrics.clear_shard_checks(shard)
+            self.metrics.record_shard_handoff(shard, "shed" if shed else "lost")
+        if self.on_lost is not None:
+            await self.on_lost(shard)
+
+    # -- write fencing ---------------------------------------------------
+    async def admit_write(self, key: str) -> None:
+        """Gate a status write on still owning the key's shard.
+
+        Fast path: our last successful lease write is younger than the
+        renew deadline (2/3 lease), so no challenger's takeover window
+        can have opened — admit without I/O. Stale path (a paused
+        process, a wedged renew loop): re-read the shard's lease and
+        check ``spec.holderIdentity`` is still us; anyone else holding
+        it means a takeover happened while we were paused, so the shard
+        is released locally and the write is rejected. The fence is
+        still resourceVersion-based end to end — takeover PUTs are
+        rv-fenced at the elector, and ``fence_rv``/``last_write`` (the
+        rv recorded at our last successful write) is what arms this
+        stale path — but the verification itself compares identity, not
+        rv (see inline comment: an rv compare would false-positive
+        against our own racing renew loop). Transient GET failures
+        propagate to the caller's normal retry/queue machinery rather
+        than silently dropping the write."""
+        shard = self.router.shard_for(key)
+        elector = self.set.owned.get(shard)
+        if elector is None or elector.lost.is_set():
+            raise ShardFencedError(shard, key, "shard not owned")
+        fresh_window = self.lease_seconds * 2.0 / 3.0
+        if self.clock.monotonic() - elector.last_write <= fresh_window:
+            return
+        lease = await self.api.get(elector.path)
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        if holder != self.identity:
+            # taken over while we were paused: drop the shard NOW — the
+            # _run_shard loop cleans up and goes back to standing by.
+            # The identity IS the verdict: nobody else ever writes OUR
+            # uuid-suffixed identity, and takeover PUTs are themselves
+            # resourceVersion-fenced at the elector. Comparing the rv
+            # against our recorded fence token here would false-positive
+            # against our OWN renew loop racing this GET (the stale path
+            # runs exactly while that loop is retrying near its
+            # deadline) and drop a healthy shard.
+            elector.demote()
+            raise ShardFencedError(
+                shard, key, f"lease held by {holder!r}"
+            )
+        # deliberately NOT refreshing last_write here: a read-only GET
+        # proves we held the lease at verification time but does not
+        # renew it — challengers' takeover clocks keep running from the
+        # last real WRITE, so extending the no-I/O fast path from a read
+        # would re-open exactly the paused-owner window this fence
+        # closes. Every stale-path write pays the GET until the renew
+        # loop lands a real renewal (only _note_write advances the token).
+
+    def note_fenced(self, key: str) -> None:
+        """Account one rejected write (metric + counter for /statusz)."""
+        self.fenced_writes += 1
+        if self.metrics is not None:
+            self.metrics.record_fenced_write(self.router.shard_for(key))
+
+    # -- depth publication + work stealing -------------------------------
+    def _lease_annotations(self) -> dict:
+        return {DEPTH_ANNOTATION: str(self._depth)}
+
+    def publish_depth(self, depth: int) -> None:
+        """Record this replica's workqueue depth; it rides every owned
+        shard's next lease renewal as an annotation."""
+        self._depth = int(depth)
+
+    async def _lease_table(self, prefix: str) -> Dict[int, Tuple[str, int, str]]:
+        """id -> (holder identity, published depth, resourceVersion)
+        for leases named ``<prefix><NN>`` (one LIST of the namespace's
+        leases)."""
+        from activemonitor_tpu.kube import api_path
+
+        raw = await self.api.get(
+            api_path(
+                KubernetesLeaseElector.LEASE_GROUP,
+                KubernetesLeaseElector.LEASE_VERSION,
+                KubernetesLeaseElector.LEASE_PLURAL,
+                self.namespace,
+            )
+        )
+        out: Dict[int, Tuple[str, int, str]] = {}
+        for item in raw.get("items", []):
+            meta = item.get("metadata") or {}
+            name = meta.get("name", "")
+            if not name.startswith(prefix):
+                continue
+            try:
+                ident = int(name[len(prefix):])
+            except ValueError:
+                continue
+            holder = (item.get("spec") or {}).get("holderIdentity") or ""
+            try:
+                depth = int((meta.get("annotations") or {}).get(DEPTH_ANNOTATION, 0))
+            except (TypeError, ValueError):
+                depth = 0
+            out[ident] = (holder, depth, str(meta.get("resourceVersion") or ""))
+        return out
+
+    async def fleet_depths(self) -> Dict[int, Tuple[str, int]]:
+        """shard -> (holder identity, last published depth), read from
+        the shard Leases."""
+        table = await self._lease_table(f"{ELECTION_ID}-shard-")
+        return {k: (holder, depth) for k, (holder, depth, _rv) in table.items()}
+
+    def _member_alive(self, slot: int, rv: str) -> bool:
+        """Liveness by locally-observed rv movement, never by the
+        holder's renewTime wall clock (a skewed-clock peer must not
+        look dead — same discipline as the elector's expiry): the lease
+        is alive while its rv keeps moving; static for two lease
+        durations on OUR monotonic clock means the holder is gone. A
+        crashed replica's member lease keeps its holderIdentity forever
+        (nothing re-contends a presence slot except a same-slot twin),
+        so without this a ghost's stale depth would skew the
+        work-stealing median indefinitely."""
+        now = self.clock.monotonic()
+        seen = self._member_seen.get(slot)
+        if seen is None or seen[0] != rv:
+            self._member_seen[slot] = (rv, now)
+            return True  # moved (or first sighting): start the window
+        return now - seen[1] <= self.lease_seconds * 2.0
+
+    def _alive_members(
+        self, members: Dict[int, Tuple[str, int, str]]
+    ) -> Dict[int, Tuple[str, int, str]]:
+        return {
+            slot: entry
+            for slot, entry in members.items()
+            if entry[0] and self._member_alive(slot, entry[2])
+        }
+
+    async def member_depths(self) -> Dict[str, int]:
+        """replica identity -> published depth, read from the LIVE
+        member (presence) leases — these include replicas that
+        currently own no shard at all, which is exactly who
+        work-stealing sheds for."""
+        members = await self._lease_table(f"{ELECTION_ID}-member-")
+        return {
+            holder: depth
+            for holder, depth, _rv in self._alive_members(members).values()
+        }
+
+    async def rebalance(self, my_depth: int) -> Optional[int]:
+        """The periodic placement policy, two rules in priority order:
+
+        1. **Home return.** An adopted shard whose HOME replica's
+           member (presence) lease is fresh again is handed back — the
+           replica restarted, and without this it could never reacquire
+           its shard on a balanced fleet (its eager acquire only beats
+           EXPIRED leases), wedging ``Manager.start`` → ``/readyz``
+           and every rolling update behind it.
+        2. **Work-stealing.** When this replica's queue depth diverges
+           above the fleet median (over every live member's published
+           depth) by more than the threshold AND it owns more than one
+           shard, shed the most recently adopted non-home shard for an
+           underloaded peer.
+
+        Returns the shed shard id, or None. Both rules move whole
+        shards on purpose — moving individual keys would break the
+        consistent-hash routing every replica relies on, and they share
+        ONE member-lease LIST per sweep."""
+        self.publish_depth(my_depth)
+        if not any(s != self.shard_id for s in self.set.adopt_order):
+            return None  # nothing adopted: nothing returnable or sheddable
+        members = await self._lease_table(f"{ELECTION_ID}-member-")
+        alive = self._alive_members(members)
+        returned = await self._return_home_shard(members, alive)
+        if returned is not None:
+            return returned
+        if len(self.set.owned) <= 1:
+            # never STEAL-shed the last owned shard — but this guard must
+            # not sit above home-return: a replica holding ONLY an
+            # adopted shard (home shard fenced away while its peer was
+            # dead) would otherwise never hand it back, and the restarted
+            # home replica would wedge in Manager.start forever
+            return None
+        per_member = {
+            holder: depth for holder, depth, _rv in alive.values()
+        }
+        per_member[self.identity] = my_depth
+        if len(per_member) < 2:
+            return None  # nobody to steal for
+        median = statistics.median(per_member.values())
+        if my_depth - median < self.steal_threshold:
+            return None
+        candidates = [s for s in self.set.adopt_order if s != self.shard_id]
+        if not candidates:
+            return None
+        shard = candidates[-1]
+        if not await self._shed(shard):
+            return None
+        log.warning(
+            "workqueue depth %d diverged above fleet median %.0f; "
+            "shed shard %d for an underloaded peer",
+            my_depth, median, shard,
+        )
+        return shard
+
+    async def _shed(self, shard: int) -> bool:
+        """A voluntary handoff, quiesced: the shard drains FIRST (new
+        dequeues/timer fires see it unowned) so no fresh work can slip
+        in between the pre-shed in-flight scan and the lease release —
+        the run it started would finish after the handoff and lose its
+        status record at the fence. In-flight writes still land
+        (``owns_for_write`` ignores draining). The ``pre_shed`` gate
+        then defers while anything is still in flight; an aborted shed
+        un-drains and retries next sweep."""
+        self.draining.add(shard)
+        try:
+            if self.pre_shed is not None and not await self.pre_shed(shard):
+                log.warning(
+                    "shard %d shed deferred: its in-flight work / queued "
+                    "status writes have not drained yet", shard,
+                )
+                return False
+            self._shed_pending.add(shard)
+            if await self.set.shed(shard):
+                return True
+            self._shed_pending.discard(shard)
+            return False
+        finally:
+            self.draining.discard(shard)
+
+    async def _return_home_shard(self, members, alive) -> Optional[int]:
+        """Hand an adopted shard back once its home replica is ALIVE
+        again AND its member lease has moved past the baseline recorded
+        on our first sweep after adoption — the dead incarnation's last
+        renewal must never read as presence, or we would return the
+        shard to a corpse and orphan it for another expiry round. The
+        freed lease is relinquished; the home replica's zero-grace
+        acquire takes it immediately while every other standby sits out
+        the elector's vacancy window, so the return deterministically
+        lands home."""
+        adopted = [s for s in self.set.adopt_order if s != self.shard_id]
+        for shard in adopted:
+            rv_now = (members.get(shard) or ("", 0, ""))[2]
+            baseline = self._member_baseline.get(shard)
+            if baseline is None:
+                self._member_baseline[shard] = rv_now
+                continue
+            entry = alive.get(shard)
+            if entry is None:
+                continue  # home replica still absent
+            holder, _depth, rv = entry
+            if holder == self.identity or rv == baseline:
+                continue  # us, or no movement since we adopted
+            if not await self._shed(shard):
+                continue
+            log.info(
+                "shard %d's home replica %s is back; returned the shard",
+                shard, holder,
+            )
+            return shard
+        return None
+
+    # -- statusz ---------------------------------------------------------
+    def update_check_counts(self, checks) -> None:
+        """Per-shard ownership counts over the given (owned) check list
+        — the numbers the fleet /statusz rollup sums against the check
+        total. Refreshed by the manager's rollup loop and every statusz
+        build, never on the reconcile path."""
+        counts: Dict[int, int] = {shard: 0 for shard in self.set.owned}
+        for hc in checks:
+            shard = self.router.shard_for(hc.key)
+            if shard in counts:
+                counts[shard] += 1
+        self._check_counts = counts
+        if self.metrics is not None:
+            for shard, count in counts.items():
+                self.metrics.set_shard_checks(shard, count)
+
+    def snapshot(self) -> dict:
+        """The /statusz ``fleet.sharding`` block."""
+        return {
+            "shards": self.shards,
+            "shard_id": self.shard_id,
+            "identity": self.identity,
+            "owned": self.owned_shards(),
+            "checks_per_shard": {
+                str(shard): count
+                for shard, count in sorted(self._check_counts.items())
+            },
+            "workqueue_depth": self._depth,
+            "fenced_writes": self.fenced_writes,
+        }

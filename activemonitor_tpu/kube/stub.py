@@ -259,6 +259,28 @@ class StubApiServer:
         self._broadcast(key, namespace, "ADDED", obj)
         return obj
 
+    def bulk_seed(self, group: str, version: str, plural: str, objs) -> int:
+        """Fixture-scale seeding for soak tiers (50k+ objects): place
+        many objects WITHOUT per-object watch broadcast or history —
+        a fleet seeded before any client connects doesn't need 50k
+        ADDED events queued per watcher, and the bounded event history
+        would evict them all anyway. Clients started afterwards see the
+        objects through list() / the no-resourceVersion watch replay,
+        exactly like state that predates the controller. Returns the
+        count seeded."""
+        key = (group, version, plural)
+        bucket = self._bucket(key)
+        count = 0
+        for obj in objs:
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("resourceVersion", self._bump())
+            meta.setdefault("uid", secrets.token_hex(8))
+            if obj.get("kind"):
+                self._kinds.setdefault(key, obj["kind"])
+            bucket[(meta.get("namespace", ""), meta["name"])] = obj
+            count += 1
+        return count
+
     # -- schema validation ----------------------------------------------
     def register_schema(
         self, group: str, version: str, plural: str, kind: str, schema: dict

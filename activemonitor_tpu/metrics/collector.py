@@ -265,6 +265,40 @@ class MetricsCollector:
             [LABEL_HC, "namespace", "state"],
             registry=self.registry,
         )
+        # -- sharding families (controller/sharding.py is the single
+        # writer; docs/operations.md "Sharded controller fleet"). Shard
+        # ids are label values: a fleet dashboard sums
+        # healthcheck_shard_checks across replicas and compares against
+        # the check total — the rollup invariant the chaos soak pins.
+        self.shard_owned = Gauge(
+            "healthcheck_shard_owned",
+            "1 while this replica holds the shard's Lease; 0 after a "
+            "handoff (lost, shed, or released)",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.shard_checks = Gauge(
+            "healthcheck_shard_checks",
+            "HealthChecks consistent-hash-assigned to a shard this "
+            "replica owns (refreshed by the rollup loop)",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.shard_handoffs = Counter(
+            "healthcheck_shard_handoffs_total",
+            "Shard ownership transitions on this replica "
+            "(reason: acquired, lost, shed)",
+            ["shard", "reason"],
+            registry=self.registry,
+        )
+        self.shard_fenced_writes = Counter(
+            "healthcheck_shard_fenced_writes_total",
+            "Status writes rejected by the shard fence (the lease was "
+            "taken over while this replica was paused — split-brain "
+            "protection)",
+            ["shard"],
+            registry=self.registry,
+        )
         self.remedy_runs = Counter(
             "healthcheck_remedy_runs_total",
             "Remedy admission decisions per check: admitted runs and "
@@ -528,6 +562,27 @@ class MetricsCollector:
 
     def record_remedy_run(self, hc_name: str, namespace: str, result: str) -> None:
         self.remedy_runs.labels(hc_name, namespace, result).inc()
+
+    # -- sharding families (written by controller/sharding.py) ---------
+    def set_shard_owned(self, shard: int, owned: bool) -> None:
+        self.shard_owned.labels(str(shard)).set(1.0 if owned else 0.0)
+
+    def set_shard_checks(self, shard: int, count: int) -> None:
+        self.shard_checks.labels(str(shard)).set(count)
+
+    def clear_shard_checks(self, shard: int) -> None:
+        """Shard handed off: its check-count series must not advertise
+        a stale ownership claim next to the new owner's."""
+        try:
+            self.shard_checks.remove(str(shard))
+        except KeyError:
+            pass  # never recorded — nothing to drop
+
+    def record_shard_handoff(self, shard: int, reason: str) -> None:
+        self.shard_handoffs.labels(str(shard), reason).inc()
+
+    def record_fenced_write(self, shard: int) -> None:
+        self.shard_fenced_writes.labels(str(shard)).inc()
 
     # -- analysis families (written by analysis/engine.py) -------------
     def set_metric_baseline(

@@ -205,6 +205,10 @@ class FleetStatus:
         # anomaly layer whose per-check verdicts /statusz and the CLI
         # report. None (standalone) reports no analysis blocks.
         self.analysis = None
+        # wired by the manager (controller/sharding.py): the shard
+        # coordinator whose ownership snapshot rides the fleet block.
+        # None (unsharded / standalone) reports sharding: null.
+        self.sharding = None
 
     # -- recording (reconciler status-write path) ----------------------
     def record(
@@ -345,7 +349,12 @@ class FleetStatus:
         # refreshing here keeps the gauge and the payload telling the
         # same number whenever anyone looks
         ratio = self.refresh_fleet_goodput()
-        window_runs = sum(e["window"]["results"] for e in entries)
+        # window-run + anomaly counting shared with the fleet rollup
+        # (goodput itself comes from fleet_goodput above: history +
+        # declared SLO windows, not the serialized entries)
+        agg = aggregate_entries(entries)
+        window_runs = agg["window_runs"]
+        anomalies = agg["anomalies"]
         if self.resilience is not None:
             resilience = self.resilience.snapshot()
         else:
@@ -355,14 +364,14 @@ class FleetStatus:
                 "status_writes_queued": 0,
                 "remedy_tokens": None,
             }
-        # anomaly rollup: how many checks the analysis layer currently
-        # holds in each non-ok state — the fleet-level degradation
-        # counterpart of the pass/fail goodput number
-        anomalies = {"warning": 0, "degraded": 0}
-        for entry in entries:
-            analysis = entry.get("analysis")
-            if analysis and analysis.get("state") in anomalies:
-                anomalies[analysis["state"]] += 1
+        if self.sharding is not None:
+            # refresh the per-shard ownership counts against the very
+            # check list this payload reports, so the sharding block and
+            # the checks array can never disagree
+            self.sharding.update_check_counts(checks)
+            sharding = self.sharding.snapshot()
+        else:
+            sharding = None
         return {
             "fleet": {
                 "checks": len(entries),
@@ -377,6 +386,157 @@ class FleetStatus:
                 "breaker": resilience["breaker"],
                 "status_writes_queued": resilience["status_writes_queued"],
                 "remedy_tokens": resilience["remedy_tokens"],
+                # sharded-fleet ownership (controller/sharding.py): this
+                # replica's owned shards and their check counts — the
+                # per-shard section rollup_statusz() merges fleet-wide
+                "sharding": sharding,
             },
             "checks": entries,
         }
+
+
+def aggregate_entries(entries) -> dict:
+    """Window-run and anomaly-state counting over ``/statusz`` check
+    entries, shared by :meth:`FleetStatus.statusz` and
+    :func:`rollup_statusz` so the per-replica payload and the fleet
+    rollup the runbook compares it against count these two by one rule.
+    (Goodput is NOT computed here: each replica derives it from its
+    result history + declared SLO windows — ``fleet_goodput`` — and the
+    rollup averages those replica ratios rather than re-deriving a
+    different number from the serialized entries.)"""
+    total = 0
+    anomalies = {"warning": 0, "degraded": 0}
+    for entry in entries:
+        window = entry.get("window") or {}
+        total += int(window.get("results") or 0)
+        analysis = entry.get("analysis")
+        if analysis and analysis.get("state") in anomalies:
+            anomalies[analysis["state"]] += 1
+    return {"window_runs": total, "anomalies": anomalies}
+
+
+def shard_sort_key(shard) -> int:
+    """Numeric sort key for stringly-keyed shard ids (JSON maps): a
+    plain string sort reads 0,1,10,11,2,... on 10+-shard fleets. Shared
+    by the rollup here and the CLI status table."""
+    try:
+        return int(shard)
+    except (TypeError, ValueError):
+        return -1
+
+
+def rollup_statusz(payloads: Sequence[dict]) -> dict:
+    """Merge per-replica ``/statusz`` payloads into ONE fleet view.
+
+    Each sharded replica serves its own shards' checks; the operator
+    (or a dashboard) collects every replica's payload and feeds them
+    here. Checks are deduped by key (a handoff in flight may briefly
+    double-report; first-seen wins), fleet goodput is the run-weighted
+    mean of the replicas' own ratios (same definition as a single
+    replica's /statusz), degraded is any-replica, and
+    the sharding sections merge into ``shards`` / ``owners`` /
+    ``checks_per_shard`` — whose counts sum to the merged check total
+    whenever every shard has exactly one owner (the invariant the
+    handoff soak pins before and after a kill).
+    """
+    merged: Dict[str, dict] = {}
+    owners: Dict[str, str] = {}  # shard id -> owning replica identity
+    checks_per_shard: Dict[str, int] = {}
+    shards = 0
+    saw_sharding = False
+    degraded = False
+    status_writes_queued = 0
+    fenced_writes = 0
+    generated_at = ""
+    breaker = None
+    # worst-state-wins: each replica has its own breaker, and the fleet
+    # line reports the one in the most degraded state (an unknown state
+    # string is treated as worst — better to over-alarm than to hide a
+    # breaker the renderer doesn't recognize)
+    breaker_rank = {"closed": 0, "half-open": 1, "open": 2}
+    remedy_tokens = None
+    # fleet goodput: the run-weighted mean of the REPLICAS' own ratios,
+    # each derived from its history + declared SLO windows — the same
+    # definition a single /statusz reports, so the number doesn't
+    # change meaning with how many replicas answered. (During a handoff
+    # a briefly double-reported check weighs in twice, consistent with
+    # the summed per-shard counts: the overlap is the signal.)
+    goodput_weighted = goodput_runs = 0.0
+    for payload in payloads:
+        fleet = payload.get("fleet") or {}
+        replica_ratio = fleet.get("goodput_ratio")
+        replica_runs = int(fleet.get("window_runs") or 0)
+        if replica_ratio is not None and replica_runs > 0:
+            goodput_weighted += replica_ratio * replica_runs
+            goodput_runs += replica_runs
+        degraded = degraded or bool(fleet.get("degraded"))
+        status_writes_queued += int(fleet.get("status_writes_queued") or 0)
+        generated_at = max(generated_at, str(fleet.get("generated_at") or ""))
+        replica_breaker = fleet.get("breaker")
+        if replica_breaker is not None:
+            rank = breaker_rank.get(str(replica_breaker.get("state")), 3)
+            if breaker is None or rank > breaker_rank.get(
+                str(breaker.get("state")), 3
+            ):
+                breaker = replica_breaker
+        replica_tokens = fleet.get("remedy_tokens")
+        if replica_tokens is not None:
+            # per-replica buckets sum to the fleet's total remedy budget
+            remedy_tokens = (remedy_tokens or 0.0) + float(replica_tokens)
+        sharding = fleet.get("sharding")
+        if sharding:
+            saw_sharding = True
+            shards = max(shards, int(sharding.get("shards") or 0))
+            identity = str(sharding.get("identity") or "")
+            fenced_writes += int(sharding.get("fenced_writes") or 0)
+            for shard in sharding.get("owned") or []:
+                owners[str(shard)] = identity
+            for shard, count in (sharding.get("checks_per_shard") or {}).items():
+                # SUMMED, not last-wins: while a handoff is in flight two
+                # replicas may both claim a shard, and the overlap must
+                # surface as counts exceeding the deduped check total —
+                # that divergence IS the double-ownership signal
+                checks_per_shard[str(shard)] = (
+                    checks_per_shard.get(str(shard), 0) + int(count)
+                )
+        for entry in payload.get("checks") or []:
+            key = entry.get("key", "")
+            if key not in merged:
+                merged[key] = entry
+    entries = [merged[key] for key in sorted(merged)]
+    agg = aggregate_entries(entries)
+    if saw_sharding:
+        sharding_block = {
+            "shards": shards,
+            "owners": {
+                k: owners[k] for k in sorted(owners, key=shard_sort_key)
+            },
+            "checks_per_shard": {
+                k: checks_per_shard[k]
+                for k in sorted(checks_per_shard, key=shard_sort_key)
+            },
+            "fenced_writes": fenced_writes,
+        }
+    else:
+        # a classic --leader-elect fleet: every replica reported
+        # sharding=null, and so must the rollup (a truthy empty block
+        # would render a bogus SHARDS line in the status table)
+        sharding_block = None
+    return {
+        "fleet": {
+            "replicas": len(payloads),
+            "checks": len(entries),
+            "window_runs": agg["window_runs"],
+            "goodput_ratio": (
+                (goodput_weighted / goodput_runs) if goodput_runs else None
+            ),
+            "generated_at": generated_at,
+            "degraded": degraded,
+            "breaker": breaker,
+            "status_writes_queued": status_writes_queued,
+            "remedy_tokens": remedy_tokens,
+            "anomalies": agg["anomalies"],
+            "sharding": sharding_block,
+        },
+        "checks": entries,
+    }

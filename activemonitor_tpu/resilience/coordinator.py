@@ -173,8 +173,25 @@ class ResilienceCoordinator:
         self._status_queue.pop(key, None)
         self._sync_queue_gauge()
 
+    def drop_status_writes_matching(self, predicate) -> int:
+        """Shard handoff: queued writes for keys matching ``predicate``
+        would only be fenced at replay (the shard's new owner is
+        authoritative) — drop them now. Returns how many were dropped."""
+        dropped = [key for key in self._status_queue if predicate(key)]
+        for key in dropped:
+            self._status_queue.pop(key, None)
+        if dropped:
+            self._sync_queue_gauge()
+        return len(dropped)
+
     def pending_status_writes(self) -> int:
         return len(self._status_queue)
+
+    def queued_status_keys(self) -> list:
+        """Keys with a write parked for replay — the shard layer checks
+        these before a voluntary handoff (a shed must not strand a
+        recorded run in this process's queue)."""
+        return list(self._status_queue)
 
     def _sync_queue_gauge(self) -> None:
         if self.metrics is not None:
@@ -182,10 +199,17 @@ class ResilienceCoordinator:
 
     # -- remedy storm control -------------------------------------------
     def configure_remedy_rate(self, rate_per_minute: float) -> None:
-        """Install (or remove, with rate <= 0) the fleet-wide remedy
-        cap. Called once at manager construction from --remedy-rate."""
+        """Install, adjust, or remove (rate <= 0) the fleet-wide remedy
+        cap. Called at manager construction from --remedy-rate, and on
+        every shard handoff in a sharded fleet (the replica's share of
+        the fleet cap follows its owned-shard count). Adjusting a live
+        bucket preserves its accrued tokens — a handoff never mints a
+        fresh burst of remedy budget."""
         if rate_per_minute and rate_per_minute > 0:
-            self.remedy_bucket = TokenBucket(rate_per_minute, clock=self.clock)
+            if self.remedy_bucket is not None:
+                self.remedy_bucket.set_rate(rate_per_minute)
+            else:
+                self.remedy_bucket = TokenBucket(rate_per_minute, clock=self.clock)
         else:
             self.remedy_bucket = None
 

@@ -52,6 +52,20 @@ class TokenBucket:
         self._stamp = now
         self._tokens = min(self.burst, self._tokens + elapsed * self.rate_per_second)
 
+    def set_rate(self, rate_per_minute: float) -> None:
+        """Re-rate a LIVE bucket without granting a fresh burst: tokens
+        accrued so far are settled at the old rate, then the refill rate
+        and default burst change in place. Sharded fleets re-apportion
+        each replica's share of the fleet remedy cap as shard ownership
+        moves — replacing the bucket instead would refill it to burst on
+        every handoff, and a flapping shard could mint remedy budget."""
+        if rate_per_minute <= 0:
+            raise ValueError("rate_per_minute must be > 0 (omit the bucket for 'no cap')")
+        self._refill()
+        self.rate_per_second = rate_per_minute / 60.0
+        self.burst = max(1.0, rate_per_minute)
+        self._tokens = min(self._tokens, self.burst)
+
     def try_take(self, n: float = 1.0) -> bool:
         """Take ``n`` tokens if available; False (nothing taken) when
         the bucket cannot cover them."""

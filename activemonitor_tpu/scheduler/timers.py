@@ -29,6 +29,9 @@ class TimerWheel:
     def __init__(self, clock: Clock | None = None):
         self._clock = clock or Clock()
         self._timers: Dict[str, asyncio.Task] = {}
+        # monotonic fire deadline per PENDING timer — the serializable
+        # owed-run state a shard handoff carries to the adopting owner
+        self._deadlines: Dict[str, float] = {}
 
     def schedule(
         self, name: str, delay_seconds: float, fn: Callable[[], Awaitable[None]]
@@ -39,6 +42,7 @@ class TimerWheel:
         (reference: healthcheck_controller.go:747-752).
         """
         self.stop(name)
+        self._deadlines[name] = self._clock.monotonic() + max(0.0, delay_seconds)
         self._timers[name] = asyncio.create_task(
             self._fire(name, delay_seconds, fn), name=f"timer:{name}"
         )
@@ -50,6 +54,13 @@ class TimerWheel:
 
         try:
             await self._clock.sleep(delay_seconds)
+            # consumed: the entry stays (exists semantics) but no run is
+            # pending anymore, so the deadline must not outlive it.
+            # Identity-guarded: if this task was REPLACED while asleep
+            # (schedule() raced its wake-up), the deadline now belongs
+            # to the replacement and must survive
+            if self._timers.get(name) is asyncio.current_task():
+                self._deadlines.pop(name, None)
             # the timer task's context snapshot was taken when the timer
             # was ARMED (usually inside the previous cycle's trace) —
             # fire trace-clean so the callback's spans never adopt into
@@ -70,12 +81,57 @@ class TimerWheel:
         t = self._timers.get(name)
         return t is not None and not t.done()
 
+    def names(self) -> list:
+        """Every known timer name (fired entries included) — shard
+        handoff iterates this to release a dead shard's schedules."""
+        return list(self._timers)
+
+    def remaining(self, name: str) -> float | None:
+        """Seconds until a PENDING timer fires (None when nothing is
+        pending): the owed-run state a handoff serializes."""
+        if not self.pending(name):
+            return None
+        deadline = self._deadlines.get(name)
+        if deadline is None:
+            return None
+        return max(0.0, deadline - self._clock.monotonic())
+
+    def snapshot(self) -> Dict[str, float]:
+        """``{name: seconds until fire}`` for every pending timer — the
+        portable form of this wheel's owed-run state, for IN-PROCESS
+        wheel migrations (and the handoff contract tests). Cross-process
+        shard handoff deliberately does not ship snapshots: the adopting
+        owner rebuilds from durable status (reconciler divergence 10),
+        and fired/cancelled entries are absent here for the same reason
+        — no pending run, nothing to carry."""
+        out: Dict[str, float] = {}
+        for name in self._timers:
+            left = self.remaining(name)
+            if left is not None:
+                out[name] = left
+        return out
+
+    def restore(
+        self,
+        snapshot: Dict[str, float],
+        fn_factory: Callable[[str], Callable[[], Awaitable[None]]],
+    ) -> int:
+        """Rebuild pending timers from a :meth:`snapshot` — the adopted
+        shard's owed runs fire at their original deadlines on the new
+        owner's wheel (no dropped, no duplicated runs: each restored
+        name replaces any same-named pending entry). Returns how many
+        timers were restored."""
+        for name, left in snapshot.items():
+            self.schedule(name, max(0.0, left), fn_factory(name))
+        return len(snapshot)
+
     def stop(self, name: str) -> bool:
         """Cancel a pending run if any; keeps no map entry. Returns True
         if a pending timer was actually cancelled. A timer task stopping
         itself from within its own callback (the reschedule-at-watch-end
         path) is popped but never cancelled mid-flight."""
         t = self._timers.pop(name, None)
+        self._deadlines.pop(name, None)
         if t is None:
             return False
         if not t.done() and t is not asyncio.current_task():

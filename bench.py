@@ -44,11 +44,24 @@ _PROBE_SRC = (
 )
 
 
-def _device_reachable() -> bool:
+# consecutive hangs after which the probe gives up early: r02–r05 all
+# wedged for entire rounds — once two full timeouts hang back-to-back
+# the tunnel is not transiently blipping, and burning the remaining
+# retry window only delays the (inevitable) CPU fallback
+_PROBE_HANG_FAIL_FAST = 2
+
+
+def _device_reachable() -> tuple:
     """Probe the device in a killable subprocess, retrying across a
     ~10-minute window: tunnel wedges are transient (BENCH_r02 lost its
     TPU artifact to a single 180s attempt that would have succeeded
-    minutes later)."""
+    minutes later). Returns ``(reachable, reason)`` — the reason string
+    lands in the artifact as ``fallback_reason`` so degraded rounds
+    (r02–r05 fell back with zero recorded cause) say WHY on the JSON
+    line, not just in scrollback. Two consecutive hangs fail fast: a
+    tunnel that ate two full timeouts is wedged, not blipping."""
+    reason = ""
+    consecutive_hangs = 0
     for attempt in range(1, _PROBE_ATTEMPTS + 1):
         try:
             proc = subprocess.run(
@@ -57,17 +70,33 @@ def _device_reachable() -> bool:
                 capture_output=True,
             )
         except subprocess.TimeoutExpired:
-            print(
-                f"device probe attempt {attempt}/{_PROBE_ATTEMPTS} hung past "
-                f"{_PROBE_TIMEOUT:.0f}s (wedged tunnel?)",
-                file=sys.stderr,
+            consecutive_hangs += 1
+            reason = (
+                f"device probe hung past {_PROBE_TIMEOUT:.0f}s on attempt "
+                f"{attempt}/{_PROBE_ATTEMPTS} (wedged tunnel?)"
             )
+            print(reason, file=sys.stderr)
+            if consecutive_hangs >= _PROBE_HANG_FAIL_FAST:
+                reason += (
+                    f"; {consecutive_hangs} consecutive hangs — failing fast"
+                )
+                print(
+                    f"{consecutive_hangs} consecutive probe hangs; failing "
+                    "fast to the CPU fallback",
+                    file=sys.stderr,
+                )
+                return False, reason
         else:
             if proc.returncode == 0:
-                return True
+                return True, ""
+            consecutive_hangs = 0
             # surface the real diagnostic (libtpu init error, plugin
             # mismatch, OOM) instead of a misleading timeout claim
             tail = proc.stderr.decode(errors="replace").strip().splitlines()[-8:]
+            reason = (
+                f"device probe exited with {proc.returncode} on attempt "
+                f"{attempt}/{_PROBE_ATTEMPTS}: " + " | ".join(tail[-2:])
+            )
             print(
                 f"device probe attempt {attempt}/{_PROBE_ATTEMPTS} exited with "
                 f"{proc.returncode}:\n" + "\n".join(tail),
@@ -77,7 +106,7 @@ def _device_reachable() -> bool:
             delay = 30.0 * attempt  # 30/60/90s between 4 attempts ≈ 11 min worst case
             print(f"retrying device probe in {delay:.0f}s", file=sys.stderr)
             time.sleep(delay)
-    return False
+    return False, reason or "device probe exhausted every attempt"
 
 
 def _force_cpu_mesh() -> None:
@@ -462,7 +491,7 @@ def _prior_cpu_mesh_value() -> tuple | None:
     return None
 
 
-def _measure(want_cpu: bool, fallback: bool = False) -> dict:
+def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") -> dict:
     import jax
 
     if want_cpu:
@@ -569,6 +598,9 @@ def _measure(want_cpu: bool, fallback: bool = False) -> dict:
             )
         if fallback:
             doc["fallback"] = True
+            # WHY this round degraded, in the artifact itself (r02–r05
+            # fell back with the cause only in lost stderr scrollback)
+            doc["fallback_reason"] = fallback_reason or "unknown"
         lkg = _last_known_good_tpu() or _last_driver_captured_tpu()
         if lkg is not None:
             doc["last_known_good_tpu"] = lkg
@@ -591,7 +623,8 @@ def main() -> int:
         print(json.dumps(_measure(want_cpu=True)))
         return 0
 
-    if _device_reachable():
+    reachable, fallback_reason = _device_reachable()
+    if reachable:
         # the measurement itself can also hit a mid-run wedge — run it
         # killable so the driver never hangs on us
         try:
@@ -601,11 +634,11 @@ def main() -> int:
                 capture_output=True,
             )
         except subprocess.TimeoutExpired:
-            print(
+            fallback_reason = (
                 f"TPU measurement hung past {_MEASURE_TIMEOUT:.0f}s "
-                "(tunnel wedged mid-run?)",
-                file=sys.stderr,
+                "(tunnel wedged mid-run?)"
             )
+            print(fallback_reason, file=sys.stderr)
         else:
             sys.stderr.write(proc.stderr.decode(errors="replace"))
             lines = [
@@ -619,15 +652,19 @@ def main() -> int:
                 if doc is not None:
                     print(json.dumps(doc))
                     return 0
-            print(
+            fallback_reason = (
                 f"TPU measurement exited with {proc.returncode}; "
-                "stdout tail: " + " | ".join(lines[-3:]),
-                file=sys.stderr,
+                "stdout tail: " + " | ".join(lines[-3:])
             )
+            print(fallback_reason, file=sys.stderr)
 
     print("falling back to the virtual CPU mesh", file=sys.stderr)
     _force_cpu_mesh()
-    print(json.dumps(_measure(want_cpu=True, fallback=True)))
+    print(
+        json.dumps(
+            _measure(want_cpu=True, fallback=True, fallback_reason=fallback_reason)
+        )
+    )
     return 0
 
 

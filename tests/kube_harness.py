@@ -33,6 +33,24 @@ async def stub_env(token: str = ""):
         await server.stop()
 
 
+def hard_kill_shards(coord) -> None:
+    """Simulate a process crash for a shard coordinator: every lease
+    (shard AND member/presence) stops renewing WITHOUT release — the
+    corpse a real crash leaves behind for the survivors' expiry-based
+    adoption. One definition so the tier-1 chaos slice, the 50k soak,
+    and the unit tier can never drift on what 'hard kill' means."""
+    electors = list(coord.set.owned.values())
+    if coord.set.member is not None:
+        electors.append(coord.set.member)
+    for elector in electors:
+        if elector._renew_task is not None:
+            elector._renew_task.cancel()
+        elector._stop = True
+    for task in coord.set._tasks:
+        task.cancel()
+    coord.set._stopping = True
+
+
 async def advance(clock, seconds, step=2.5):
     """Advance a FakeClock in small steps with real-time pauses so HTTP
     roundtrips triggered by woken coroutines can complete."""

@@ -669,6 +669,179 @@ async def test_chaos_soak_breaker_degrades_and_recovers_without_duplicates():
 
 
 @pytest.mark.asyncio
+async def test_sharded_fleet_handoff_fires_owed_runs_exactly_once():
+    """ISSUE-6 acceptance (tier-1 slice; the ≥50k version lives in
+    tests/test_stress.py): a 3-replica sharded fleet on the stub
+    apiserver, seeded FakeClock. One replica is hard-killed mid-cycle
+    (no release — its shard lease rots); a survivor adopts the dead
+    shard, rebuilds timers from durable status, and the next cycle's
+    owed runs fire EXACTLY once fleet-wide. The corpse's late status
+    write is rejected by the resourceVersion fence, and the /statusz
+    rollup's per-shard ownership counts sum to the check total before
+    and after the handoff."""
+    from activemonitor_tpu.controller.sharding import ShardCoordinator
+    from activemonitor_tpu.kube import KubeApi, KubeConfig
+    from activemonitor_tpu.obs.slo import rollup_statusz
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    from tests.kube_harness import drive_until
+
+    N = 24
+    async with stub_env() as (server, api_a):
+        clock = FakeClock()
+        apis = {
+            "a": api_a,
+            "b": KubeApi(KubeConfig(server=server.url)),
+            "c": KubeApi(KubeConfig(server=server.url)),
+        }
+        player_api = KubeApi(KubeConfig(server=server.url))
+        managers, coords, mets = {}, {}, {}
+        for i, tag in enumerate("abc"):
+            metrics = MetricsCollector()
+            coord = ShardCoordinator(
+                api=apis[tag],
+                namespace="health",
+                shards=3,
+                shard_id=i,
+                identity=f"replica-{tag}",
+                clock=clock,
+                metrics=metrics,
+                lease_seconds=15.0,
+                # this scenario pins the handoff invariants; the
+                # work-stealing policy has its own test — a shed mid-
+                # adoption would only churn the ownership assertions
+                steal_threshold=10**6,
+            )
+            client = KubernetesHealthCheckClient(apis[tag], owns=coord.owns_event)
+            reconciler = HealthCheckReconciler(
+                client=client,
+                engine=ArgoWorkflowEngine(apis[tag]),
+                rbac=RBACProvisioner(KubernetesRBACBackend(apis[tag])),
+                recorder=KubernetesEventRecorder(apis[tag]),
+                metrics=metrics,
+                clock=clock,
+            )
+            managers[tag] = Manager(
+                client=client,
+                reconciler=reconciler,
+                max_parallel=4,
+                shard_coordinator=coord,
+            )
+            coords[tag], mets[tag] = coord, metrics
+        seeder = KubernetesHealthCheckClient(apis["a"])  # unfiltered view
+        player = argo_player(server, player_api)
+        names = [f"shard-chk-{i:02d}" for i in range(N)]
+        try:
+            await asyncio.gather(*(m.start() for m in managers.values()))
+            for name in names:
+                hc = chaos_check(name)
+                hc.spec.repeat_after_sec = 300
+                hc.spec.workflow.timeout = 120
+                hc.spec.workflow.generate_name = f"{name}-"
+                await seeder.apply(hc)
+            # the router must spread these names over all 3 shards
+            # (deterministic md5 routing; renaming would re-roll)
+            spread = {coords["a"].shard_for(f"health/{n}") for n in names}
+            assert spread == {0, 1, 2}
+
+            def all_ran(n):
+                async def check():
+                    for name in names:
+                        got = await seeder.get("health", name)
+                        if got is None or got.status.total_healthcheck_runs < n:
+                            return False
+                    return True
+
+                return check
+
+            await drive_until(clock, all_ran(1), max_seconds=200)
+            # every check fired exactly once across the whole fleet
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == N
+            for i, tag in enumerate("abc"):
+                assert coords[tag].owned_shards() == [i]
+
+            async def payloads(tags):
+                out = []
+                for tag in tags:
+                    manager = managers[tag]
+                    out.append(
+                        manager.reconciler.fleet.statusz(
+                            await manager.client.list()
+                        )
+                    )
+                return out
+
+            rollup = rollup_statusz(await payloads("abc"))
+            assert rollup["fleet"]["checks"] == N
+            assert (
+                sum(rollup["fleet"]["sharding"]["checks_per_shard"].values()) == N
+            )
+
+            # ---- hard-kill replica b mid-cycle (no lease release) ----
+            from tests.kube_harness import hard_kill_shards
+
+            victim = managers["b"]
+            for task in list(victim._tasks) + list(victim._requeue_tasks):
+                task.cancel()
+            hard_kill_shards(coords["b"])
+            # a real crash takes the timers and watches with the process
+            await victim.reconciler.shutdown()
+
+            # a survivor's standby adopts shard 1 once the lease expires
+            await drive_until(
+                clock,
+                lambda: asyncio.sleep(
+                    0, 1 in coords["a"].set.owned or 1 in coords["c"].set.owned
+                ),
+                max_seconds=120,
+            )
+
+            # the next cycle: EVERY owed run (dead shard's included)
+            # fires exactly once on the surviving owners
+            await drive_until(clock, all_ran(2), max_seconds=500)
+            assert len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL)) == 2 * N
+            for name in names:
+                got = await seeder.get("health", name)
+                assert got.status.total_healthcheck_runs == 2, name
+
+            # ---- the fenced old owner's late status write ------------
+            fenced_name = next(
+                n for n in names if coords["b"].shard_for(f"health/{n}") == 1
+            )
+            stale = await seeder.get("health", fenced_name)
+            stale.status.error_message = "stale split-brain write"
+            await victim.reconciler._update_status(stale)  # dropped, no raise
+            fresh = await seeder.get("health", fenced_name)
+            assert fresh.status.error_message != "stale split-brain write"
+            assert (
+                mets["b"].sample_value(
+                    "healthcheck_shard_fenced_writes_total", {"shard": "1"}
+                )
+                == 1.0
+            )
+            # dropped means DROPPED: nothing parked for replay either
+            assert victim.reconciler.resilience.pending_status_writes() == 0
+
+            # ---- rollup after handoff: counts still sum, shard 1 has
+            # exactly one (surviving) owner
+            rollup = rollup_statusz(await payloads("ac"))
+            assert rollup["fleet"]["checks"] == N
+            assert (
+                sum(rollup["fleet"]["sharding"]["checks_per_shard"].values()) == N
+            )
+            owners = rollup["fleet"]["sharding"]["owners"]
+            assert set(owners) == {"0", "1", "2"}
+            assert owners["1"] in ("replica-a", "replica-c")
+        finally:
+            player.cancel()
+            for manager in managers.values():
+                await manager.stop()
+            for tag in ("b", "c"):
+                await apis[tag].close()
+            await player_api.close()
+
+
+@pytest.mark.asyncio
 async def test_timer_fired_resubmit_survives_submit_500s():
     """A 500 storm hitting the TIMER-fired resubmission (not the first
     submit) must not end the schedule: the timer entry is consumed, so

@@ -129,6 +129,71 @@ def test_bench_emits_single_json_line():
         assert "composed_step_error" in doc["secondary"]
 
 
+def test_device_probe_watchdog_fails_fast_on_consecutive_hangs(monkeypatch):
+    """ISSUE-6 satellite: r02–r05 silently wedged on the device probe.
+    Two consecutive full-timeout hangs must end the probe ladder
+    immediately (a wedged tunnel is not a transient blip) with a reason
+    string for the artifact — not burn the remaining ~10-minute retry
+    window before the inevitable CPU fallback."""
+    sys.path.insert(0, str(REPO))
+    import subprocess as sp
+
+    import bench
+
+    calls = {"run": 0, "slept": 0.0}
+
+    def hang(*_a, **_kw):
+        calls["run"] += 1
+        raise sp.TimeoutExpired(cmd="probe", timeout=bench._PROBE_TIMEOUT)
+
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    monkeypatch.setattr(
+        bench.time, "sleep", lambda s: calls.__setitem__("slept", calls["slept"] + s)
+    )
+    reachable, reason = bench._device_reachable()
+    assert reachable is False
+    assert calls["run"] == bench._PROBE_HANG_FAIL_FAST  # fail fast, no ladder
+    assert "hung past" in reason and "failing fast" in reason
+
+
+def test_device_probe_watchdog_retries_clean_exits_and_reports_reason(
+    monkeypatch,
+):
+    """Non-hang failures (libtpu init error, plugin mismatch) stay on
+    the full retry ladder — they really are transient on this tunnel —
+    and the LAST diagnostic becomes the fallback_reason."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    class Proc:
+        returncode = 1
+        stdout = b""
+        stderr = b"RuntimeError: libtpu init failed\n"
+
+    calls = {"run": 0}
+
+    def fail(*_a, **_kw):
+        calls["run"] += 1
+        return Proc()
+
+    monkeypatch.setattr(bench.subprocess, "run", fail)
+    monkeypatch.setattr(bench.time, "sleep", lambda _s: None)
+    reachable, reason = bench._device_reachable()
+    assert reachable is False
+    assert calls["run"] == bench._PROBE_ATTEMPTS
+    assert "exited with 1" in reason and "libtpu init failed" in reason
+
+    # a success anywhere on the ladder reports reachable with no reason
+    class Good(Proc):
+        returncode = 0
+
+    outcomes = [Proc(), Good()]
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *_a, **_kw: outcomes.pop(0)
+    )
+    assert bench._device_reachable() == (True, "")
+
+
 def test_last_known_good_tpu_block(tmp_path):
     """The CPU fallback embeds the opportunistic harness's capture,
     trimmed to the summary keys, with its timestamp."""

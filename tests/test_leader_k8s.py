@@ -195,6 +195,54 @@ async def test_takeover_observed_by_renewal_fires_lost():
 
 
 @pytest.mark.asyncio
+async def test_renew_conflict_demotes_immediately():
+    """ISSUE-6 satellite: a resourceVersion conflict mid-renew means
+    another holder replaced the lease between our GET and PUT — the
+    holder must demote on the spot (fire ``lost``), NOT retry the renew
+    for the rest of the deadline while still reconciling (that window
+    is split-brain)."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+        assert a.fence_rv  # fencing token recorded at acquisition
+
+        # the NEXT renew PUT hits a conflict (the stub's CAS rejects a
+        # stale resourceVersion exactly like a real takeover race); the
+        # GET still shows us as holder, so only the PUT sees the race
+        server.inject_fault(
+            f"/leases/{a._name}", status=409, times=1, method="PUT"
+        )
+        await advance(clock, LEASE / 3 + 1)  # one renew period
+        # demoted at the FIRST conflict — well before the 2/3-lease
+        # renew deadline the generic-transient path would burn
+        await asyncio.wait_for(a.lost.wait(), 5)
+        assert clock.monotonic() < LEASE * 2 / 3
+        a.release()
+
+
+@pytest.mark.asyncio
+async def test_lease_writes_record_the_fencing_token():
+    """Every successful lease write (create, takeover, renew) records
+    the object's resourceVersion — the token the sharding layer's write
+    fence compares against the server."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = elector(api, clock, "replica-a")
+        await asyncio.wait_for(a.acquire(), 5)
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert a.fence_rv == lease["metadata"]["resourceVersion"]
+        first_rv, first_write = a.fence_rv, a.last_write
+
+        await advance(clock, LEASE)  # several renewals
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", a._name)
+        assert a.fence_rv == lease["metadata"]["resourceVersion"]
+        assert a.fence_rv != first_rv
+        assert a.last_write > first_write
+        a.release()
+
+
+@pytest.mark.asyncio
 async def test_release_relinquishes_for_fast_handover():
     async with stub_env() as (server, api):
         clock = FakeClock()
@@ -209,6 +257,62 @@ async def test_release_relinquishes_for_fast_handover():
         b = elector(api, clock, "replica-b")
         await asyncio.wait_for(b.acquire(), 5)
         b.release()
+
+
+@pytest.mark.asyncio
+async def test_relinquished_lease_lands_on_the_zero_grace_claimant():
+    """A relinquished (home-return) shard lease must go HOME: the
+    zero-grace claimant polls every lease/3 while graced standbys sit
+    out the shorter vacancy window, so the prioritized replica wins the
+    vacancy race deterministically — not whichever peer GETs first.
+    (Regression: graced standbys used to treat an empty holder as
+    instantly expired, racing the home replica 50/50 per hop.)"""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        holder = elector(api, clock, "replica-c")
+        await asyncio.wait_for(holder.acquire(), 5)
+
+        # a graced standby (a peer's non-home standby loop) watching
+        graced = KubernetesLeaseElector(
+            api=api, namespace="health", identity="replica-b",
+            lease_seconds=LEASE, clock=clock, takeover_grace=LEASE,
+        )
+        graced_task = asyncio.create_task(graced.acquire())
+        await advance(clock, LEASE / 3)  # it has observed the live holder
+
+        holder.release()
+        await asyncio.sleep(0.2)  # relinquish task runs in real time
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", holder._name)
+        assert lease["spec"]["holderIdentity"] == ""
+
+        # the home replica starts contending AFTER the relinquish with
+        # zero grace; the graced standby has a head start but must
+        # still sit out the vacancy window (lease/2 > home's lease/3
+        # poll) — home wins
+        home = elector(api, clock, "replica-home")
+        home_task = asyncio.create_task(home.acquire())
+        await advance(clock, LEASE / 2)
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", holder._name)
+        assert lease["spec"]["holderIdentity"] == "replica-home"
+        await asyncio.wait_for(home_task, 5)
+        graced_task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await graced_task
+        home.release()
+
+        # with NO zero-grace claimant around, the graced standby does
+        # adopt the vacancy — after the window, not never
+        await asyncio.sleep(0.2)
+        orphan = KubernetesLeaseElector(
+            api=api, namespace="health", identity="replica-b2",
+            lease_seconds=LEASE, clock=clock, takeover_grace=LEASE,
+        )
+        orphan_task = asyncio.create_task(orphan.acquire())
+        await advance(clock, LEASE)
+        lease = server.obj("coordination.k8s.io", "v1", "leases", "health", holder._name)
+        assert lease["spec"]["holderIdentity"] == "replica-b2"
+        await asyncio.wait_for(orphan_task, 5)
+        orphan.release()
 
 
 @pytest.mark.asyncio

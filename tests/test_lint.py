@@ -428,6 +428,58 @@ def test_analysis_metric_families_are_pinned():
         assert family in contract.PINNED_FAMILIES, family
 
 
+def test_shard_metric_families_are_pinned():
+    """The ISSUE-6 families must stay in the exposition contract — the
+    fleet rollup dashboard sums healthcheck_shard_checks against the
+    check total, and a rename silently breaks the handoff alert."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_sharding", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_shard_owned",
+        "healthcheck_shard_checks",
+        "healthcheck_shard_handoffs_total",
+        "healthcheck_shard_fenced_writes_total",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
+def test_wallclock_banned_in_sharding_module(tmp_path):
+    """controller/sharding.py runs entirely on the injectable Clock —
+    lease expiry, fencing freshness windows, and shed cooldowns must be
+    scriptable by fake-clock tests, so a bare time.time()/monotonic()
+    there is a lint error (same ban as resilience/ and analysis/, keyed
+    by MODULE name because sharding is a file, not a package)."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    (tmp_path / "sharding.py").write_text(source)
+    got = lint.lint_file(tmp_path / "sharding.py")
+    assert {line.split(": ")[1] for line in got} == {"wallclock-in-sharding"}
+    assert len(got) == 2
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="scheduling.py") == []
+
+
+def test_sharding_module_really_is_wallclock_free():
+    """The gate, applied: the shipped sharding module lints clean and
+    the ban actually covers it (path-scoping regression guard, like the
+    resilience/analysis twins)."""
+    path = REPO / "activemonitor_tpu" / "controller" / "sharding.py"
+    assert path.exists(), "sharding module missing?"
+    assert lint.lint_file(path) == []
+    src = path.read_text()
+    checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+    assert checker.ban_wallclock
+    assert checker.wallclock_pkg == "sharding"
+
+
 def test_wallclock_banned_in_analysis_package(tmp_path):
     """analysis/ baselines are stamped on the injectable Clock so
     fake-clock tests can script exact warm-up windows — the same

@@ -217,6 +217,12 @@ PINNED_FAMILIES = {
     "healthcheck_metric_baseline": "gauge",
     "healthcheck_metric_zscore": "gauge",
     "healthcheck_anomaly_state": "gauge",
+    # sharding families (ISSUE 6: sharded controller fleet —
+    # docs/operations.md "Sharded controller fleet")
+    "healthcheck_shard_owned": "gauge",
+    "healthcheck_shard_checks": "gauge",
+    "healthcheck_shard_handoffs_total": "counter",
+    "healthcheck_shard_fenced_writes_total": "counter",
     "controller_runtime_reconcile_total": "counter",
     "controller_runtime_reconcile_time_seconds": "histogram",
     "controller_runtime_active_workers": "gauge",
@@ -258,6 +264,11 @@ def exercise_every_family(collector):
     )
     collector.set_metric_zscore("hc-a", "health", "m", -2.0)
     collector.set_anomaly_state("hc-a", "health", "warning")
+    # sharding families
+    collector.set_shard_owned(0, True)
+    collector.set_shard_checks(0, 3)
+    collector.record_shard_handoff(0, "acquired")
+    collector.record_fenced_write(0)
     collector.cadence_goodput.set(1.0)
     collector.set_fleet_goodput(1.0)
     collector.set_slo(

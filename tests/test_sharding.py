@@ -1,0 +1,1172 @@
+"""Sharded controller fleet — router, shard elections, fencing,
+work-stealing, and the statusz rollup (controller/sharding.py).
+
+Everything time-driven runs on the FakeClock against the stub API
+server, the same determinism discipline as the leader-election tier
+(tests/test_leader_k8s.py).
+"""
+
+import asyncio
+from collections import Counter
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    InMemoryHealthCheckClient,
+    ShardCoordinator,
+    ShardFencedError,
+    ShardFilteredClient,
+    ShardRouter,
+)
+from activemonitor_tpu.controller.sharding import (
+    DEPTH_ANNOTATION,
+    shard_lease_name,
+)
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.utils.clock import FakeClock
+
+from tests.kube_harness import advance, drive_until, stub_env
+
+LEASE = 15.0
+
+
+def make_hc(name: str, namespace: str = "health"):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"repeatAfterSec": 300},
+        }
+    )
+
+
+from tests.kube_harness import hard_kill_shards as crash  # noqa: E402
+
+
+def coordinator(api, clock, shards, shard_id, metrics=None, **kw):
+    return ShardCoordinator(
+        api=api,
+        namespace="health",
+        shards=shards,
+        shard_id=shard_id,
+        identity=f"replica-{shard_id}",
+        clock=clock,
+        metrics=metrics,
+        lease_seconds=LEASE,
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------
+# consistent-hash router
+# ---------------------------------------------------------------------
+
+
+def test_router_is_deterministic_and_covers_every_shard():
+    a, b = ShardRouter(5), ShardRouter(5)
+    keys = [f"health/chk-{i:05d}" for i in range(5000)]
+    assert [a.shard_for(k) for k in keys] == [b.shard_for(k) for k in keys]
+    counts = Counter(a.shard_for(k) for k in keys)
+    assert set(counts) == set(range(5))
+    # consistent hashing is never perfectly uniform; the bound that
+    # matters for capacity planning is "no shard is a hotspot"
+    assert max(counts.values()) < 2 * min(counts.values())
+
+
+def test_router_scale_up_moves_a_minority_of_keys():
+    """Adding a shard must remap roughly 1/(N+1) of the keys — the
+    consistent-hash property that makes scale-up a partial handoff
+    instead of a full fleet reshuffle."""
+    keys = [f"health/chk-{i:05d}" for i in range(6000)]
+    r3, r4 = ShardRouter(3), ShardRouter(4)
+    moved = sum(1 for k in keys if r3.shard_for(k) != r4.shard_for(k))
+    assert moved / len(keys) < 0.45  # modulo hashing would move ~0.75
+    # and every moved key landed on the NEW shard's id space
+    assert all(
+        r4.shard_for(k) == 3 for k in keys if r3.shard_for(k) != r4.shard_for(k)
+    )
+
+
+def test_router_single_shard_owns_everything():
+    r = ShardRouter(1)
+    assert {r.shard_for(f"k-{i}") for i in range(100)} == {0}
+
+
+def test_shard_lease_names_are_distinct_and_prefixed():
+    names = {shard_lease_name(s) for s in range(16)}
+    assert len(names) == 16
+    assert all(n.startswith("689451f8.keikoproj.io-shard-") for n in names)
+
+
+def test_cli_shards_flag_requires_k8s_client(capsys):
+    """--shards > 1 without the Kubernetes store is a usage error (the
+    shard map lives in coordination Leases), surfaced as exit 2 before
+    any side effects."""
+    from activemonitor_tpu.__main__ import main
+
+    rc = main(
+        ["run", "--shards", "3", "--shard-id", "1", "--client", "file",
+         "--metrics-bind-address", "0", "--health-probe-bind-address", "0"]
+    )
+    assert rc == 2
+    assert "--shards" in capsys.readouterr().err
+    # a typo'd 0/negative must error, not silently run unsharded with
+    # no election (four such replicas would all reconcile everything)
+    rc = main(
+        ["run", "--shards", "0", "--client", "file",
+         "--metrics-bind-address", "0", "--health-probe-bind-address", "0"]
+    )
+    assert rc == 2
+    assert "--shards" in capsys.readouterr().err
+    # and a shard-id outside [0, shards) is a usage error even sharded
+    rc = main(
+        ["run", "--shards", "3", "--shard-id", "3", "--client", "file",
+         "--metrics-bind-address", "0", "--health-probe-bind-address", "0"]
+    )
+    assert rc == 2
+    assert "--shard-id" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# shard-filtered client
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_shard_filtered_client_filters_list_and_watch_live():
+    inner = InMemoryHealthCheckClient()
+    owned = {"hc-a"}
+    client = ShardFilteredClient(inner, lambda ns, name: name in owned)
+    seen = []
+    # the wrapper registers the inner subscription at watch() CALL time
+    # (list-then-watch contract) — before any apply below
+    watch_iter = client.watch()
+
+    async def consume():
+        async for ev in watch_iter:
+            seen.append((ev.type, ev.name))
+
+    task = asyncio.create_task(consume())
+    try:
+        await inner.apply(make_hc("hc-a"))
+        await inner.apply(make_hc("hc-b"))
+        listed = [hc.metadata.name for hc in await client.list()]
+        assert listed == ["hc-a"]
+        # unfiltered verbs pass through (handoff races read across shards)
+        assert await client.get("health", "hc-b") is not None
+        await asyncio.sleep(0.05)
+        assert seen == [("ADDED", "hc-a")]
+        # ownership is LIVE: adopting hc-b's shard admits its events
+        # without re-establishing the stream
+        owned.add("hc-b")
+        await inner.apply(make_hc("hc-b"))
+        await asyncio.sleep(0.05)
+        assert ("MODIFIED", "hc-b") in seen
+        assert [hc.metadata.name for hc in await client.list()] == ["hc-a", "hc-b"]
+    finally:
+        task.cancel()
+
+
+@pytest.mark.asyncio
+async def test_k8s_client_owns_predicate_filters_before_parse():
+    from activemonitor_tpu.controller.client_k8s import KubernetesHealthCheckClient
+
+    async with stub_env() as (server, api):
+        seeder = KubernetesHealthCheckClient(api)
+        for name in ("hc-a", "hc-b", "hc-c"):
+            await seeder.apply(make_hc(name))
+        owned = {"hc-a", "hc-c"}
+        client = KubernetesHealthCheckClient(
+            api, owns=lambda ns, name: name in owned
+        )
+        listed = [hc.metadata.name for hc in await client.list()]
+        assert listed == ["hc-a", "hc-c"]
+        seen = []
+
+        async def consume():
+            async for ev in client.watch():
+                seen.append((ev.type, ev.name))
+
+        task = asyncio.create_task(consume())
+        try:
+            await seeder.apply(make_hc("hc-b"))
+            await seeder.apply(make_hc("hc-c"))
+
+            async def got_c():
+                return ("MODIFIED", "hc-c") in seen
+
+            for _ in range(100):
+                if await got_c():
+                    break
+                await asyncio.sleep(0.05)
+            assert ("MODIFIED", "hc-c") in seen
+            assert not any(name == "hc-b" for _t, name in seen)
+        finally:
+            task.cancel()
+
+
+# ---------------------------------------------------------------------
+# shard elections: home preference, adoption, shed
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_home_shards_acquired_eagerly_peers_stand_by():
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0)
+        b = coordinator(api, clock, 2, 1)
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(a.start(), b.start()), 5
+            )
+            # each replica holds exactly its home shard…
+            await advance(clock, LEASE * 2)
+            assert a.owned_shards() == [0]
+            assert b.owned_shards() == [1]
+            # …and the leases carry the holders' identities
+            lease0 = server.obj(
+                "coordination.k8s.io", "v1", "leases", "health", shard_lease_name(0)
+            )
+            lease1 = server.obj(
+                "coordination.k8s.io", "v1", "leases", "health", shard_lease_name(1)
+            )
+            assert lease0["spec"]["holderIdentity"] == "replica-0"
+            assert lease1["spec"]["holderIdentity"] == "replica-1"
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_dead_owners_shard_is_adopted_by_the_survivor():
+    """Crash-safe handoff at the lease layer: a dead owner's shard is
+    adopted by the survivor's standby once the lease expires (no
+    release, no cooperation from the corpse required)."""
+    acquired = []
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0)
+        b = coordinator(api, clock, 2, 1)
+
+        async def on_acquired(shard):
+            acquired.append(("a", shard))
+
+        a.on_acquired = on_acquired
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            # b dies WITHOUT releasing (crash): every lease rots
+            crash(b)
+
+            # survivor's standby takes shard 1 over once the lease expires
+            await drive_until(
+                clock,
+                lambda: asyncio.sleep(0, 1 in a.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            assert sorted(a.owned_shards()) == [0, 1]
+            assert ("a", 1) in acquired
+            assert a.owns_key("health/anything")  # owns every shard now
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_fence_rejects_paused_old_owners_write():
+    """The split-brain acceptance slice: a paused old owner (renew loop
+    dead, lease taken over) asking to write must get ShardFencedError —
+    verified against the server via the recorded resourceVersion
+    fencing token — and the shard is released locally."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        metrics_b = MetricsCollector()
+        a = coordinator(api, clock, 1, 0)
+        a.identity = "replica-old"
+        a.set.identity = "replica-old"
+        try:
+            await asyncio.wait_for(a.start(), 5)
+            key = "health/fenced-check"
+            # fresh owner: writes admitted without any extra I/O
+            requests_before = len(server.requests)
+            await a.admit_write(key)
+            assert len(server.requests) == requests_before
+
+            # pause the owner: renew loop dies, lease left to rot
+            elector = a.set.owned[0]
+            elector._renew_task.cancel()
+
+            # another replica takes the expired lease over (a second
+            # coordinator with the same home shard, different identity)
+            b = coordinator(api, clock, 1, 0, metrics=metrics_b)
+            b.identity = "replica-new"
+            b.set.identity = "replica-new"
+            start_b = asyncio.create_task(b.start())
+            await drive_until(
+                clock,
+                lambda: asyncio.sleep(0, 0 in b.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            await start_b
+
+            # the paused owner's late write: stale local knowledge →
+            # server verification → fenced, and the shard drops locally
+            with pytest.raises(ShardFencedError):
+                await a.admit_write(key)
+            assert elector.lost.is_set()
+            # once dropped, the fast local check rejects without I/O too
+            with pytest.raises(ShardFencedError):
+                await a.admit_write(key)
+            # the NEW owner's writes are admitted
+            await b.admit_write(key)
+            await b.stop()
+        finally:
+            await a.stop()
+
+
+# ---------------------------------------------------------------------
+# depth publication + work stealing
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_depth_rides_lease_renewals_as_annotation():
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 1, 0)
+        try:
+            await asyncio.wait_for(a.start(), 5)
+            a.publish_depth(37)
+            await advance(clock, LEASE)  # a few renewals
+            lease = server.obj(
+                "coordination.k8s.io", "v1", "leases", "health", shard_lease_name(0)
+            )
+            assert lease["metadata"]["annotations"][DEPTH_ANNOTATION] == "37"
+            depths = await a.fleet_depths()
+            assert depths[0] == ("replica-0", 37)
+        finally:
+            await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_work_stealing_sheds_adopted_shard_on_depth_divergence():
+    """An overloaded replica owning an adopted shard sheds it when its
+    depth diverges above the fleet median of live shard OWNERS; an
+    underloaded peer's standby adopts the freed lease. The home shard
+    is never shed, and a lone owner (nobody to steal for) never sheds."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 3, 0, steal_threshold=10)
+        b = coordinator(api, clock, 3, 1, steal_threshold=10)
+        c = coordinator(api, clock, 3, 2, steal_threshold=10)
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(a.start(), b.start(), c.start()), 5
+            )
+            # b crashes; a (or c) adopts shard 1 — drive until adopted
+            crash(b)
+            await drive_until(
+                clock,
+                lambda: asyncio.sleep(
+                    0, 1 in a.set.owned or 1 in c.set.owned
+                ),
+                max_seconds=LEASE * 6,
+            )
+            heavy, light = (a, c) if 1 in a.set.owned else (c, a)
+            light.publish_depth(0)
+            await advance(clock, LEASE)  # the light owner publishes depth
+
+            # balanced fleet: no shed
+            assert await heavy.rebalance(my_depth=5) is None
+            assert len(heavy.owned_shards()) == 2
+
+            # diverged: the heavy owner sheds its ADOPTED shard (1),
+            # never its home
+            shed = await heavy.rebalance(my_depth=500)
+            assert shed == 1
+            await advance(clock, 1)
+            assert heavy.owned_shards() == [heavy.shard_id]
+
+            # the freed lease was relinquished, so the light owner's
+            # standby adopts without waiting out an expiry — and the
+            # heavy owner's shed cooldown keeps it from re-adopting
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in light.set.owned),
+                max_seconds=LEASE * 8,
+            )
+            assert sorted(light.owned_shards()) == sorted(
+                {light.shard_id, 1}
+            )
+            assert heavy.owned_shards() == [heavy.shard_id]
+
+            # a lone owner never sheds (nobody visible to steal for)
+            depths = await heavy.fleet_depths()
+            assert depths[1][0] == light.identity
+        finally:
+            await a.stop()
+            await b.stop()
+            await c.stop()
+
+
+@pytest.mark.asyncio
+async def test_fenced_submit_never_launches_a_duplicate_workflow():
+    """The fence guards the SUBMIT, not just the status write: a paused
+    old owner resuming mid-cycle must not launch a workflow at all (a
+    fenced write after a real submit would just make the adopter re-run
+    the duplicated cycle a third time). The fenced cycle is also not an
+    error — no quarantine fuel, no requeue."""
+    from activemonitor_tpu.controller import (
+        EventRecorder,
+        HealthCheckReconciler,
+        InMemoryHealthCheckClient,
+        InMemoryRBACBackend,
+        RBACProvisioner,
+    )
+    from activemonitor_tpu.engine import FakeWorkflowEngine
+    from activemonitor_tpu.resilience import STATE_HEALTHY
+
+    WF = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        old = coordinator(api, clock, 1, 0)
+        old.identity = "replica-old"
+        old.set.identity = "replica-old"
+        await asyncio.wait_for(old.start(), 5)
+        client = InMemoryHealthCheckClient()
+        engine = FakeWorkflowEngine()
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=engine,
+            rbac=RBACProvisioner(InMemoryRBACBackend()),
+            recorder=EventRecorder(),
+            metrics=MetricsCollector(),
+            clock=clock,
+        )
+        reconciler.shards = old
+        hc = HealthCheck.from_dict(
+            {
+                "metadata": {"name": "fenced-sub", "namespace": "health"},
+                "spec": {
+                    "repeatAfterSec": 300,
+                    "level": "cluster",
+                    "workflow": {
+                        "generateName": "fenced-sub-",
+                        "workflowtimeout": 30,
+                        "resource": {
+                            "namespace": "health",
+                            "serviceAccount": "sa",
+                            "source": {"inline": WF},
+                        },
+                    },
+                },
+            }
+        )
+        await client.apply(hc)
+
+        # pause the owner; a new incarnation takes the lease over
+        old.set.owned[0]._renew_task.cancel()
+        new = coordinator(api, clock, 1, 0)
+        new.identity = "replica-new"
+        new.set.identity = "replica-new"
+        start_new = asyncio.create_task(new.start())
+        await drive_until(
+            clock, lambda: asyncio.sleep(0, 0 in new.set.owned),
+            max_seconds=LEASE * 6,
+        )
+        await start_new
+        try:
+            # the paused owner resumes its cycle: the submit is fenced
+            # BEFORE any workflow is created, quietly (returns None)
+            assert await reconciler.reconcile("health", "fenced-sub") is None
+            assert engine.submitted == []
+            # and the fenced cycle counted no pre-terminal error
+            assert (
+                reconciler.resilience.checks.state("health/fenced-sub")
+                == STATE_HEALTHY
+            )
+        finally:
+            await new.stop()
+            await old.stop()
+            await reconciler.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_restarted_home_replica_gets_its_shard_back():
+    """Rolling-update safety: after a crash+adoption, the restarted
+    home replica can't out-elect a healthy adopter (its eager acquire
+    only beats EXPIRED leases) — the adopter must hand the shard back
+    once the home replica's member lease is renewed AGAIN (a stamp
+    newer than the adoption; the dead incarnation's last renewal must
+    not count). Without this, the restarted replica blocks forever in
+    start() and the rollout wedges."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0)
+        b = coordinator(api, clock, 2, 1)
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            crash(b)
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in a.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            # no home replica yet: nothing to return (the dead
+            # incarnation's member stamp predates the adoption)
+            assert await a.rebalance(my_depth=0) is None
+            assert sorted(a.owned_shards()) == [0, 1]
+
+            # the home replica restarts; start() blocks until it owns
+            # its shard — exactly the wedge the home-return breaks
+            b2 = coordinator(api, clock, 2, 1)
+            b2_started = asyncio.create_task(b2.start())
+            # b2 first re-takes its member (presence) lease...
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, b2.set.member is not None),
+                max_seconds=LEASE * 8,
+            )
+            # ...then a's next sweep returns the shard and b2 acquires
+            shed = None
+            for _ in range(12):
+                shed = await a.rebalance(my_depth=0)
+                if shed is not None:
+                    break
+                await advance(clock, LEASE / 3)
+            assert shed == 1
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in b2.set.owned),
+                max_seconds=LEASE * 8,
+            )
+            await asyncio.wait_for(b2_started, 5)  # start() unwedged
+            assert b2.owned_shards() == [1]
+            assert a.owned_shards() == [0]
+            await b2.stop()
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_fast_home_restart_reclaims_before_steady_state_peers():
+    """The standby grace must hold in STEADY STATE, not just at boot:
+    peers park inside the elector's contend loop forever, so a grace
+    that only delays the first loop entry evaporates after the first
+    sweep — and every rolling-update restart would pay a double
+    handoff (peer adopt + resync, home-return + resync). A home
+    replica restarting within the grace window must win the reclaim
+    race against peers that have been standing by for many leases."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0)
+        b = coordinator(api, clock, 2, 1)
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            # steady state: standbys have long been parked in acquire()
+            await advance(clock, LEASE * 4)
+            assert a.owned_shards() == [0] and b.owned_shards() == [1]
+
+            crash(a)
+            # a fast restart: well inside the peers' one-lease grace
+            a2 = coordinator(api, clock, 2, 0)
+            a2_started = asyncio.create_task(a2.start())
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 0 in a2.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            await asyncio.wait_for(a2_started, 5)
+            assert a2.owned_shards() == [0]
+            # the peer never adopted the shard in between — the restart
+            # cost ZERO cross-replica handoffs
+            assert b.owned_shards() == [1]
+            assert b.set.adopt_order == [1]
+            await a2.stop()
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_sole_adopted_shard_is_still_handed_home():
+    """A replica can end up owning ONLY an adopted shard (its home
+    shard fenced/demoted away while the peer was dead). The rebalance
+    sweep's never-shed-the-last-shard guard must not sit above
+    home-return — it is a STEALING guard, not a returning guard — or
+    the adopted shard is never handed back and the restarted home
+    replica wedges in start() forever."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0)
+        b = coordinator(api, clock, 2, 1)
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            crash(b)
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in a.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            # record the shard-1 member baseline (sweep while b is dead)
+            assert await a.rebalance(my_depth=0) is None
+            # a's HOME shard is taken over by another holder (the fence
+            # verdict's scenario) and the elector demoted: a now holds
+            # only the adopted shard 1 — its eager home re-acquire can't
+            # beat the intruder's unexpired lease
+            elector0 = a.set.owned[0]
+            lease = await api.get(elector0.path)
+            lease["spec"]["holderIdentity"] = "intruder"
+            lease["spec"]["leaseDurationSeconds"] = 3600
+            await api.replace(elector0.path, lease)
+            elector0.demote()
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, a.owned_shards() == [1]),
+                max_seconds=LEASE / 3, step=1.0,
+            )
+
+            b2 = coordinator(api, clock, 2, 1)
+            b2_started = asyncio.create_task(b2.start())
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, b2.set.member is not None),
+                max_seconds=LEASE * 8,
+            )
+            shed = None
+            for _ in range(6):
+                # the scenario under test is owning JUST the adopted
+                # shard — if a re-took its expired home lease the sweep
+                # would pass via the ordinary two-shard home-return path
+                assert a.owned_shards() == [1]
+                shed = await a.rebalance(my_depth=0)
+                if shed is not None:
+                    break
+                await advance(clock, 1.0)
+            assert shed == 1
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in b2.set.owned),
+                max_seconds=LEASE * 8,
+            )
+            await asyncio.wait_for(b2_started, 5)
+            assert b2.owned_shards() == [1]
+            await b2.stop()
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_pre_shed_gate_defers_shed_until_writes_drain():
+    """A voluntary shed is deferred while the shard's queued status
+    writes haven't drained — the adopter must inherit durable truth,
+    not re-run the cycles those writes record."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0, steal_threshold=1)
+        b = coordinator(api, clock, 2, 1, steal_threshold=1)
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            # a adopts shard 1 after b's crash
+            crash(b)
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in a.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            # a fresh peer is visible in the fleet (so the median math
+            # would otherwise admit the shed)
+            c = coordinator(api, clock, 2, 1, steal_threshold=1)
+            start_c = asyncio.create_task(c.start(wait_first=False))
+            await advance(clock, 1)
+
+            drained = {"ok": False}
+
+            async def pre_shed(_shard):
+                return drained["ok"]
+
+            a.pre_shed = pre_shed
+            # c adopts the expired member (presence) lease and publishes
+            # its idle depth — only then is it visible to the median
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, c.set.member is not None),
+                max_seconds=LEASE * 6,
+            )
+            await advance(clock, LEASE)  # depths published
+            assert await a.rebalance(my_depth=1000) is None  # deferred
+            assert sorted(a.owned_shards()) == [0, 1]
+            drained["ok"] = True
+            assert await a.rebalance(my_depth=1000) == 1  # drained: shed
+            start_c.cancel()
+            await c.stop()
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+# ---------------------------------------------------------------------
+# statusz: per-shard block + fleet rollup
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_member_depths_exclude_stale_ghost_leases():
+    """A crashed replica's member lease keeps its holderIdentity
+    forever (nothing re-contends a presence slot except a same-slot
+    twin) — its stale depth must drop out of the work-stealing median
+    once renewTime goes stale, or a ghost at depth 0 would drag the
+    median down and trigger sheds for nobody."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0)
+        b = coordinator(api, clock, 2, 1)
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            a.publish_depth(40)
+            b.publish_depth(20)
+            await advance(clock, LEASE)  # both renew with their depths
+            depths = await a.member_depths()
+            assert depths == {"replica-0": 40, "replica-1": 20}
+
+            crash(b)
+            await advance(clock, LEASE * 3)  # b's renewTime goes stale
+            depths = await a.member_depths()
+            assert "replica-1" not in depths
+            assert set(depths) == {"replica-0"}
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_verification_get_does_not_extend_the_fence_fast_path():
+    """The stale-path verification GET proves the lease was held at
+    verification time but does NOT renew it — so it must not refresh
+    the no-I/O fast-path window (a paused owner could otherwise admit a
+    post-takeover write unverified). Every stale-path admit keeps
+    paying the GET until a real renewal lands."""
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 1, 0)
+        try:
+            await asyncio.wait_for(a.start(), 5)
+            elector = a.set.owned[0]
+            elector._renew_task.cancel()  # pause: no more real writes
+            last_write = elector.last_write
+            await clock.advance(LEASE * 0.8)  # past the 2/3 fresh window
+
+            requests_before = len(server.requests)
+            await a.admit_write("health/x")  # verified via GET (still held)
+            assert len(server.requests) == requests_before + 1
+            assert elector.last_write == last_write  # NOT refreshed
+            # the very next admit pays the GET again — no fast path
+            await a.admit_write("health/x")
+            assert len(server.requests) == requests_before + 2
+        finally:
+            await a.stop()
+
+
+def test_rollup_sums_double_claimed_shard_counts():
+    """While a handoff is in flight two replicas may both report a
+    shard; the rollup SUMS their counts so the overlap surfaces as
+    counts exceeding the deduped check total — last-wins would read
+    clean exactly when it should flag double ownership."""
+    from activemonitor_tpu.obs.slo import rollup_statusz
+
+    def payload(identity, count):
+        return {
+            "fleet": {
+                "checks": count,
+                "window_runs": 0,
+                "generated_at": "",
+                "degraded": False,
+                "status_writes_queued": 0,
+                "sharding": {
+                    "shards": 1,
+                    "identity": identity,
+                    "owned": [0],
+                    "checks_per_shard": {"0": count},
+                    "fenced_writes": 0,
+                },
+            },
+            "checks": [
+                {"key": f"health/chk-{i}", "window": {"results": 0}}
+                for i in range(count)
+            ],
+        }
+
+    rollup = rollup_statusz([payload("old-owner", 3), payload("new-owner", 3)])
+    assert rollup["fleet"]["checks"] == 3  # deduped by key
+    assert rollup["fleet"]["sharding"]["checks_per_shard"]["0"] == 6
+    assert (
+        sum(rollup["fleet"]["sharding"]["checks_per_shard"].values())
+        > rollup["fleet"]["checks"]
+    )  # the double-ownership signal
+
+
+def test_rollup_carries_worst_breaker_and_summed_remedy_tokens():
+    """Each replica has its own circuit breaker and remedy bucket; the
+    merged fleet line must report the WORST breaker state (not a
+    fabricated default — the renderer used to print 'open' for every
+    degraded rollup because the field was dropped) and the summed
+    remedy budget."""
+    from activemonitor_tpu.obs.slo import rollup_statusz
+
+    def payload(state, degraded, tokens):
+        return {
+            "fleet": {
+                "checks": 0,
+                "window_runs": 0,
+                "generated_at": "",
+                "degraded": degraded,
+                "breaker": {"name": "kube", "state": state, "trips": 1},
+                "status_writes_queued": 0,
+                "remedy_tokens": tokens,
+            },
+            "checks": [],
+        }
+
+    rollup = rollup_statusz(
+        [payload("closed", False, 2.5), payload("half-open", True, 1.0)]
+    )
+    assert rollup["fleet"]["degraded"] is True
+    assert rollup["fleet"]["breaker"]["state"] == "half-open"
+    assert rollup["fleet"]["remedy_tokens"] == pytest.approx(3.5)
+
+    # an unrecognized state string outranks every known one (better to
+    # over-alarm than to hide a breaker the renderer doesn't know)
+    rollup = rollup_statusz(
+        [payload("open", True, None), payload("melted", True, None)]
+    )
+    assert rollup["fleet"]["breaker"]["state"] == "melted"
+    assert rollup["fleet"]["remedy_tokens"] is None
+
+    # replicas without a resilience layer report breaker=None — the
+    # rollup must not invent one
+    rollup = rollup_statusz(
+        [
+            {
+                "fleet": {"checks": 0, "breaker": None, "degraded": False},
+                "checks": [],
+            }
+        ]
+    )
+    assert rollup["fleet"]["breaker"] is None
+
+
+@pytest.mark.asyncio
+async def test_adoption_resync_failure_is_retried_by_the_shard_loop():
+    """A transient list() failure during shard adoption must not strand
+    the shard's existing checks unmonitored (the watch only yields
+    FUTURE events): the failed resync parks in _resync_pending and the
+    shard loop retries it until it lands."""
+    from activemonitor_tpu.controller import (
+        EventRecorder,
+        HealthCheckReconciler,
+        InMemoryRBACBackend,
+        RBACProvisioner,
+    )
+    from activemonitor_tpu.controller.client_k8s import (
+        KubernetesHealthCheckClient,
+    )
+    from activemonitor_tpu.controller.manager import Manager
+    from activemonitor_tpu.engine import FakeWorkflowEngine
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        # two shards, one replica: the home shard rides the boot resync
+        # (no separate list — the startup-cost finding), and shard 1 is
+        # ADOPTED later, which is the path that must resync on its own
+        coord = coordinator(api, clock, 2, 0, metrics=MetricsCollector())
+        inner = KubernetesHealthCheckClient(api, owns=coord.owns_event)
+        fail = {"n": 0}
+
+        class FlakyList:
+            def __getattr__(self, name):
+                return getattr(inner, name)
+
+            async def list(self, namespace=None):
+                if fail["n"] > 0:
+                    fail["n"] -= 1
+                    raise RuntimeError("transient list outage")
+                return await inner.list(namespace)
+
+        reconciler = HealthCheckReconciler(
+            client=FlakyList(),
+            engine=FakeWorkflowEngine(),
+            rbac=RBACProvisioner(InMemoryRBACBackend()),
+            recorder=EventRecorder(),
+            metrics=MetricsCollector(),
+            clock=clock,
+        )
+        manager = Manager(
+            client=FlakyList(),
+            reconciler=reconciler,
+            max_parallel=2,
+            shard_coordinator=coord,
+        )
+        try:
+            await manager.start()  # home shard: boot resync, no extra list
+            assert manager._resync_pending == set()
+            # shard 1 is orphaned (no owner); the standby adopts it
+            # after its grace — with the list broken at adoption time
+            fail["n"] = 1
+            await drive_until(
+                clock, lambda: asyncio.sleep(0, 1 in coord.set.owned),
+                max_seconds=LEASE * 6,
+            )
+            assert manager._resync_pending == {1}
+            # the shard loop's next sweep retries and clears it
+            await advance(clock, 15)
+            assert manager._resync_pending == set()
+        finally:
+            await manager.stop()
+
+
+def test_status_table_renders_the_sharded_fleet_rollup():
+    """`am-tpu status --url a --url b` merges the replicas' payloads;
+    the table leads with the fleet line plus a SHARDS line mapping each
+    shard to its owning replica."""
+    from activemonitor_tpu.__main__ import render_status_table
+    from activemonitor_tpu.obs.slo import rollup_statusz
+
+    def payload(identity, owned, checks):
+        return {
+            "fleet": {
+                "checks": len(checks),
+                "window_runs": len(checks),
+                "goodput_ratio": 1.0,
+                "generated_at": "2026-08-03T00:00:00+00:00",
+                "degraded": False,
+                "breaker": None,
+                "status_writes_queued": 0,
+                "remedy_tokens": None,
+                "anomalies": {"warning": 0, "degraded": 0},
+                "sharding": {
+                    "shards": 2,
+                    "shard_id": owned[0],
+                    "identity": identity,
+                    "owned": owned,
+                    "checks_per_shard": {str(owned[0]): len(checks)},
+                    "workqueue_depth": 0,
+                    "fenced_writes": 0,
+                },
+            },
+            "checks": [
+                {
+                    "key": f"health/{name}",
+                    "healthcheck": name,
+                    "namespace": "health",
+                    "state": "healthy",
+                    "analysis": None,
+                    "remedy_budget_remaining": None,
+                    "last_status": "Succeeded",
+                    "last_trace_id": "",
+                    "runs_recorded": 1,
+                    "window": {
+                        "seconds": 3600,
+                        "results": 1,
+                        "availability": 1.0,
+                        "p50_seconds": 1.0,
+                        "p95_seconds": 1.0,
+                        "p99_seconds": 1.0,
+                    },
+                    "slo": None,
+                    "history": [],
+                }
+                for name in checks
+            ],
+        }
+
+    rollup = rollup_statusz(
+        [
+            payload("replica-a", [0], ["chk-0", "chk-1"]),
+            payload("replica-b", [1], ["chk-2"]),
+        ]
+    )
+    assert rollup["fleet"]["checks"] == 3
+    assert sum(rollup["fleet"]["sharding"]["checks_per_shard"].values()) == 3
+    table = render_status_table(rollup)
+    assert "replicas=2" in table
+    assert "SHARDS 2" in table
+    assert "0:replica-a" in table and "1:replica-b" in table
+    assert "chk-2" in table
+
+
+def test_remedy_rate_apportioned_by_owned_shards():
+    """--remedy-rate is a FLEET cap: each replica's bucket refills at
+    rate × owned/N, re-applied on every handoff. (Regression: a static
+    rate/N split silently shrank the fleet budget whenever survivors
+    carried adopted shards — 4 replicas × 8 shards ran at half the
+    configured cap.) Re-rating the live bucket must never grant a
+    fresh burst."""
+    from activemonitor_tpu.controller import (
+        EventRecorder,
+        HealthCheckReconciler,
+        InMemoryRBACBackend,
+        RBACProvisioner,
+    )
+    from activemonitor_tpu.controller.manager import Manager
+    from activemonitor_tpu.engine import FakeWorkflowEngine, succeed_after
+
+    class FakeSet:
+        owned = {0: None}
+
+    class FakeShards:
+        shards = 8
+        shard_id = 0
+        set = FakeSet()
+
+        def shard_for(self, key):
+            return 0
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=FakeWorkflowEngine(succeed_after(1)),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    shards = FakeShards()
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        shard_coordinator=shards,
+        remedy_rate=60.0,
+    )
+    bucket = reconciler.resilience.remedy_bucket
+    # boot: the home-shard share, not the full fleet rate
+    assert bucket.rate_per_second == pytest.approx(60.0 / 8 / 60.0)
+
+    # drain most of the burst so a fresh-burst regression is visible
+    while bucket.try_take():
+        pass
+    leftover = bucket.available()
+
+    # survivor adopts two more shards: its share follows ownership,
+    # IN PLACE (same bucket), with the accrued tokens preserved
+    FakeShards.set.owned = {0: None, 1: None, 2: None}
+    manager._apportion_remedy_rate()
+    assert reconciler.resilience.remedy_bucket is bucket
+    assert bucket.rate_per_second == pytest.approx(60.0 * 3 / 8 / 60.0)
+    assert bucket.available() == pytest.approx(leftover)
+
+    # fleet invariant: every shard owned exactly once ⇒ shares sum to
+    # the configured cap (here: 3/8 + 5 surviving homes × 1/8 = 1)
+    assert (
+        sum([3, 1, 1, 1, 1, 1]) / FakeShards.shards * 60.0
+        == pytest.approx(60.0)
+    )
+
+    # handoff back down: the share shrinks, tokens clamp to burst
+    FakeShards.set.owned = {0: None}
+    manager._apportion_remedy_rate()
+    assert bucket.rate_per_second == pytest.approx(60.0 / 8 / 60.0)
+
+    # a shardless standby keeps a minimal bucket (in-flight runs can
+    # still reach the remedy gate during the fence window), never None
+    FakeShards.set.owned = {}
+    manager._apportion_remedy_rate()
+    assert reconciler.resilience.remedy_bucket is not None
+    assert bucket.rate_per_second == pytest.approx(60.0 / 8 / 60.0)
+
+
+def test_unsharded_rollup_carries_no_sharding_block():
+    """Rolling up a classic --leader-elect fleet (every replica reports
+    sharding=null) must yield sharding=None, not a truthy empty block —
+    the status table used to print a bogus `SHARDS 0` line for it."""
+    from activemonitor_tpu.__main__ import render_status_table
+    from activemonitor_tpu.obs.slo import rollup_statusz
+
+    def payload(checks):
+        return {
+            "fleet": {
+                "checks": len(checks),
+                "window_runs": 0,
+                "goodput_ratio": None,
+                "generated_at": "",
+                "degraded": False,
+                "breaker": None,
+                "status_writes_queued": 0,
+                "remedy_tokens": None,
+                "anomalies": {"warning": 0, "degraded": 0},
+                "sharding": None,
+            },
+            "checks": [
+                {
+                    "key": f"health/{name}",
+                    "healthcheck": name,
+                    "namespace": "health",
+                    "state": "healthy",
+                    "analysis": None,
+                    "remedy_budget_remaining": None,
+                    "last_status": "Succeeded",
+                    "last_trace_id": "",
+                    "runs_recorded": 0,
+                    "window": {
+                        "seconds": 3600,
+                        "results": 0,
+                        "availability": None,
+                        "p50_seconds": None,
+                        "p95_seconds": None,
+                        "p99_seconds": None,
+                    },
+                    "slo": None,
+                    "history": [],
+                }
+                for name in checks
+            ],
+        }
+
+    rollup = rollup_statusz([payload(["chk-0"]), payload(["chk-1"])])
+    assert rollup["fleet"]["sharding"] is None
+    table = render_status_table(rollup)
+    assert "SHARDS" not in table
+    assert "chk-0" in table and "chk-1" in table
+
+
+@pytest.mark.asyncio
+async def test_statusz_sharding_block_and_fleet_rollup_sum():
+    from activemonitor_tpu.obs.slo import FleetStatus, rollup_statusz
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        a = coordinator(api, clock, 2, 0, metrics=MetricsCollector())
+        b = coordinator(api, clock, 2, 1, metrics=MetricsCollector())
+        try:
+            await asyncio.wait_for(asyncio.gather(a.start(), b.start()), 5)
+            checks = [make_hc(f"chk-{i:03d}") for i in range(40)]
+
+            def statusz_for(coord):
+                fleet = FleetStatus(clock, coord.metrics)
+                fleet.sharding = coord
+                owned = [
+                    hc for hc in checks if coord.owns_key(hc.key)
+                ]
+                for hc in owned:
+                    fleet.record(hc, ok=True, latency=1.0, workflow="wf")
+                return fleet.statusz(owned)
+
+            pa, pb = statusz_for(a), statusz_for(b)
+            # per-replica block: owned shards + the counts gauge agree
+            assert pa["fleet"]["sharding"]["owned"] == [0]
+            assert pb["fleet"]["sharding"]["owned"] == [1]
+            count_a = sum(pa["fleet"]["sharding"]["checks_per_shard"].values())
+            assert count_a == len(pa["checks"])
+            assert a.metrics.sample_value(
+                "healthcheck_shard_checks", {"shard": "0"}
+            ) == count_a
+
+            # the fleet rollup: per-shard ownership counts sum to the
+            # check total, every shard has exactly one owner
+            rollup = rollup_statusz([pa, pb])
+            assert rollup["fleet"]["replicas"] == 2
+            assert rollup["fleet"]["checks"] == len(checks)
+            assert (
+                sum(rollup["fleet"]["sharding"]["checks_per_shard"].values())
+                == len(checks)
+            )
+            assert rollup["fleet"]["sharding"]["owners"] == {
+                "0": "replica-0",
+                "1": "replica-1",
+            }
+            assert rollup["fleet"]["goodput_ratio"] == 1.0
+        finally:
+            await a.stop()
+            await b.stop()

@@ -339,6 +339,9 @@ FLEET_FIELDS = {
     "remedy_tokens": (int, float, type(None)),
     # anomaly rollup (ISSUE 4): checks per non-ok analysis state
     "anomalies": dict,
+    # sharded-fleet ownership (ISSUE 6): this replica's owned shards
+    # and per-shard check counts; None when unsharded
+    "sharding": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,
@@ -723,12 +726,19 @@ def test_status_cli_flags_parse():
     from activemonitor_tpu.__main__ import build_parser
 
     args = build_parser().parse_args(["status"])
-    assert args.url.endswith("/statusz")
+    # --url is repeatable for sharded fleets; None means the default
+    # health-probe endpoint (resolved in _status)
+    assert args.url is None
     assert args.output == "table"
     args = build_parser().parse_args(
         ["status", "--url", "http://x:1/statusz", "-o", "json"]
     )
+    assert args.url == ["http://x:1/statusz"]
     assert args.output == "json"
+    args = build_parser().parse_args(
+        ["status", "--url", "http://x:1/statusz", "--url", "http://y:1/statusz"]
+    )
+    assert len(args.url) == 2
 
 
 def test_render_status_table_shapes_rows():
@@ -807,6 +817,57 @@ async def test_status_cli_fetches_statusz(capsys):
         assert out.startswith("FLEET  checks=1")
         assert "hc-slo" in out
         assert "100.0%" in out  # availability of the one passing run
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_status_cli_partial_fleet_renders_survivors(capsys):
+    """During a failover one replica URL is dead — exactly when the
+    operator is running `am-tpu status` to watch the handoff. A dead
+    replica must degrade to a stderr warning, not abort the whole
+    rollup (all-or-nothing would blind the CLI for the entire runbook
+    window)."""
+    import socket
+
+    from activemonitor_tpu.__main__ import _status, build_parser
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine([(1, True)]),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=1)
+    manager._health_addr = "127.0.0.1:0"
+    await manager.start()
+    try:
+        await client.apply(make_hc())
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+        port = manager._http_runners[0].addresses[0][1]
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        args = build_parser().parse_args(
+            [
+                "status",
+                "--url", f"http://127.0.0.1:{port}/statusz",
+                "--url", f"http://127.0.0.1:{dead_port}/statusz",
+            ]
+        )
+        assert await _status(args) == 0
+        captured = capsys.readouterr()
+        assert "hc-slo" in captured.out  # the survivor's checks rendered
+        assert "cannot reach" in captured.err
+        assert "partial fleet view (1/2 replicas reporting)" in captured.err
     finally:
         await manager.stop()
 

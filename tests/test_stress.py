@@ -207,6 +207,307 @@ def make_soak_hc(i: int):
     )
 
 
+# -- sharded-fleet soak (ISSUE 6 acceptance, full-scale tier) ----------
+#
+# ≥50k synthetic checks on the stub apiserver, 3 sharded controller
+# replicas on one seeded FakeClock. One replica is hard-killed
+# mid-cycle; the surviving owners adopt its shard and every owed run
+# fires EXACTLY once fleet-wide — the tier-1 slice of this scenario
+# (24 checks) lives in tests/test_chaos.py; this is the scale proof.
+
+N_SHARD_SOAK = 50_000
+OWED_BOOT = 900  # never ran: owed the moment the fleet boots
+OWED_LATER = 600  # become owed at t≈120, AFTER the kill — the handoff's runs
+SOAK_INTERVAL = 7200  # current checks never re-fire inside the window
+
+
+def _soak_obj(i: int, epoch_iso: str, finished_iso) -> dict:
+    from activemonitor_tpu import GROUP, VERSION
+
+    doc = {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "HealthCheck",
+        "metadata": {"name": f"s50-{i:05d}", "namespace": "health"},
+        "spec": {
+            "repeatAfterSec": SOAK_INTERVAL,
+            "level": "cluster",
+            "workflow": {
+                "generateName": f"s50-{i:05d}-",
+                "workflowtimeout": 300,
+                "resource": {
+                    "namespace": "health",
+                    "serviceAccount": "s50-sa",
+                    "source": {"inline": WF_INLINE},
+                },
+            },
+        },
+    }
+    if finished_iso is not None:
+        doc["status"] = {
+            "status": "Succeeded",
+            "startedAt": epoch_iso,
+            "finishedAt": finished_iso,
+            "successCount": 1,
+            "totalHealthCheckRuns": 1,
+        }
+    return doc
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_shard_soak_50k_checks_survive_owner_kill_exactly_once():
+    import datetime
+
+    from activemonitor_tpu import GROUP, VERSION
+    from activemonitor_tpu.controller.client_k8s import (
+        KubernetesHealthCheckClient,
+    )
+    from activemonitor_tpu.controller.sharding import ShardCoordinator
+    from activemonitor_tpu.engine.argo import (
+        WF_GROUP,
+        WF_PLURAL,
+        WF_VERSION,
+        ArgoWorkflowEngine,
+    )
+    from activemonitor_tpu.kube import KubeApi, KubeConfig
+    from activemonitor_tpu.obs.slo import rollup_statusz
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    from tests.kube_harness import advance, drive_until, stub_env
+
+    async with stub_env() as (server, api_a):
+        clock = FakeClock()
+        now = clock.now()
+
+        def iso(dt):
+            return dt.isoformat()
+
+        # seed 50k checks WITHOUT watch broadcast (bulk fixture): 900
+        # owed at boot (never ran), 600 owed at t≈120 (after the kill),
+        # the rest current until far outside the window
+        objs = []
+        for i in range(N_SHARD_SOAK):
+            if i < OWED_BOOT:
+                finished = None
+            elif i < OWED_BOOT + OWED_LATER:
+                finished = iso(
+                    now - datetime.timedelta(seconds=SOAK_INTERVAL - 120)
+                )
+            else:
+                finished = iso(now - datetime.timedelta(seconds=60))
+            objs.append(_soak_obj(i, iso(now), finished))
+
+        apis = {
+            "a": api_a,
+            "b": KubeApi(KubeConfig(server=server.url)),
+            "c": KubeApi(KubeConfig(server=server.url)),
+        }
+        player_api = KubeApi(KubeConfig(server=server.url))
+        managers, coords, mets = {}, {}, {}
+        for idx, tag in enumerate("abc"):
+            metrics = MetricsCollector()
+            coord = ShardCoordinator(
+                api=apis[tag],
+                namespace="health",
+                shards=3,
+                shard_id=idx,
+                identity=f"replica-{tag}",
+                clock=clock,
+                metrics=metrics,
+                lease_seconds=15.0,
+                steal_threshold=10**9,  # adoption backlogs must not shed
+            )
+            client = KubernetesHealthCheckClient(apis[tag], owns=coord.owns_event)
+            reconciler = HealthCheckReconciler(
+                client=client,
+                engine=ArgoWorkflowEngine(apis[tag]),
+                rbac=RBACProvisioner(InMemoryRBACBackend()),
+                recorder=EventRecorder(capacity=5000),
+                metrics=metrics,
+                clock=clock,
+            )
+            managers[tag] = Manager(
+                client=client,
+                reconciler=reconciler,
+                max_parallel=24,
+                shard_coordinator=coord,
+                goodput_interval=600.0,  # 50k-list rollups stay off-path
+            )
+            coords[tag], mets[tag] = coord, metrics
+
+        def argo_player():
+            from activemonitor_tpu.kube import ApiError, api_path
+
+            async def play():
+                done = set()
+                while True:
+                    for wf in server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+                        name = wf["metadata"]["name"]
+                        if name in done:
+                            continue
+                        try:
+                            await player_api.merge_patch(
+                                api_path(
+                                    WF_GROUP, WF_VERSION, WF_PLURAL,
+                                    wf["metadata"]["namespace"], name, "status",
+                                ),
+                                {"status": {"phase": "Succeeded"}},
+                            )
+                            done.add(name)
+                        except ApiError:
+                            continue
+                    await asyncio.sleep(0.05)
+
+            return asyncio.create_task(play())
+
+        def run_totals():
+            """(total recorded runs, workflows created) from the stub's
+            store directly — the exactly-once ledger, no HTTP."""
+            runs = 0
+            for hc in server.objs(GROUP, VERSION, "healthchecks"):
+                runs += ((hc.get("status") or {}).get("totalHealthCheckRuns") or 0)
+            return runs, len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+
+        player = argo_player()
+        try:
+            # start the fleet FIRST (empty store: boot resync is a
+            # no-op), then bulk-seed and resync by hand — the stub's
+            # bulk path skips per-object broadcast, so 150k synthetic
+            # watch events don't dominate the soak's wall clock
+            await asyncio.gather(*(m.start() for m in managers.values()))
+            server.bulk_seed(GROUP, VERSION, "healthchecks", objs)
+            for manager in managers.values():
+                for hc in await manager.client.list():
+                    manager.enqueue(hc.metadata.namespace, hc.metadata.name)
+
+            # drain the 50k-key resync (workers run in real time; only
+            # the workflow polls need fake-clock pacing)
+            for _ in range(2400):
+                if all(m._queue.qsize() == 0 for m in managers.values()):
+                    break
+                await asyncio.sleep(0.25)
+            assert all(m._queue.qsize() == 0 for m in managers.values())
+
+            seeded_runs = N_SHARD_SOAK - OWED_BOOT  # pre-seeded history
+
+            async def boot_batch_done():
+                runs, workflows = run_totals()
+                return (
+                    runs >= seeded_runs + OWED_BOOT
+                    and workflows >= OWED_BOOT
+                )
+
+            await drive_until(clock, boot_batch_done, max_seconds=90)
+            runs, workflows = run_totals()
+            # exactly once: every owed-at-boot check ran, nothing else did
+            assert workflows == OWED_BOOT, workflows
+            assert runs == seeded_runs + OWED_BOOT, runs
+
+            # every replica owns exactly its home shard, and the fleet
+            # rollup's per-shard counts sum to the 50k total
+            for idx, tag in enumerate("abc"):
+                assert coords[tag].owned_shards() == [idx]
+            payloads = []
+            for tag in "abc":
+                manager = managers[tag]
+                payloads.append(
+                    manager.reconciler.fleet.statusz(await manager.client.list())
+                )
+            rollup = rollup_statusz(payloads)
+            assert rollup["fleet"]["checks"] == N_SHARD_SOAK
+            assert (
+                sum(rollup["fleet"]["sharding"]["checks_per_shard"].values())
+                == N_SHARD_SOAK
+            )
+
+            # ---- hard-kill replica b mid-cycle (before the t=120 owed
+            # batch; its lease rots unreleased) ------------------------
+            from tests.kube_harness import hard_kill_shards
+
+            victim = managers["b"]
+            for task in list(victim._tasks) + list(victim._requeue_tasks):
+                task.cancel()
+            hard_kill_shards(coords["b"])
+            await victim.reconciler.shutdown()
+
+            await drive_until(
+                clock,
+                lambda: asyncio.sleep(
+                    0, 1 in coords["a"].set.owned or 1 in coords["c"].set.owned
+                ),
+                max_seconds=120,
+            )
+            adopter = "a" if 1 in coords["a"].set.owned else "c"
+            # adoption resync re-queues the dead shard's keys; drain it
+            for _ in range(2400):
+                if managers[adopter]._queue.qsize() == 0:
+                    break
+                await asyncio.sleep(0.25)
+
+            # ---- the t≈120 owed batch fires on the SURVIVORS only ----
+            async def later_batch_done():
+                runs, workflows = run_totals()
+                return workflows >= OWED_BOOT + OWED_LATER
+
+            await drive_until(clock, later_batch_done, max_seconds=300)
+            # let in-flight status writes land
+            for _ in range(40):
+                runs, workflows = run_totals()
+                if runs >= seeded_runs + OWED_BOOT + OWED_LATER:
+                    break
+                await advance(clock, 2.5)
+            runs, workflows = run_totals()
+            # THE exactly-once ledger: one workflow per owed fire, one
+            # recorded run per workflow, zero spurious fires across
+            # 50k checks and a mid-cycle owner kill
+            assert workflows == OWED_BOOT + OWED_LATER, workflows
+            assert runs == seeded_runs + OWED_BOOT + OWED_LATER, runs
+            for i in range(OWED_BOOT + OWED_LATER, OWED_BOOT + OWED_LATER + 50):
+                hc = server.obj(GROUP, VERSION, "healthchecks", "health", f"s50-{i:05d}")
+                assert (hc["status"].get("totalHealthCheckRuns") or 0) == 1
+
+            # ---- the fenced old owner's late status write ------------
+            fenced_name = next(
+                f"s50-{i:05d}"
+                for i in range(N_SHARD_SOAK)
+                if coords["b"].shard_for(f"health/s50-{i:05d}") == 1
+            )
+            seeder = KubernetesHealthCheckClient(apis["a"])
+            stale = await seeder.get("health", fenced_name)
+            stale.status.error_message = "stale split-brain write"
+            await victim.reconciler._update_status(stale)
+            fresh = await seeder.get("health", fenced_name)
+            assert fresh.status.error_message != "stale split-brain write"
+            assert (
+                mets["b"].sample_value(
+                    "healthcheck_shard_fenced_writes_total", {"shard": "1"}
+                )
+                == 1.0
+            )
+
+            # ---- rollup after handoff: counts still sum to 50k -------
+            payloads = []
+            for tag in ("a", "c"):
+                manager = managers[tag]
+                payloads.append(
+                    manager.reconciler.fleet.statusz(await manager.client.list())
+                )
+            rollup = rollup_statusz(payloads)
+            assert rollup["fleet"]["checks"] == N_SHARD_SOAK
+            assert (
+                sum(rollup["fleet"]["sharding"]["checks_per_shard"].values())
+                == N_SHARD_SOAK
+            )
+            assert set(rollup["fleet"]["sharding"]["owners"]) == {"0", "1", "2"}
+        finally:
+            player.cancel()
+            for manager in managers.values():
+                await manager.stop()
+            for tag in ("b", "c"):
+                await apis[tag].close()
+            await player_api.close()
+
+
 def _series_count(metrics: MetricsCollector) -> int:
     return sum(
         1

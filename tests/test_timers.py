@@ -93,6 +93,73 @@ async def test_callback_exception_does_not_kill_wheel(caplog):
 
 
 @pytest.mark.asyncio
+async def test_snapshot_reports_pending_fire_times_only():
+    clock = FakeClock()
+    wheel = TimerWheel(clock)
+
+    async def cb():
+        pass
+
+    wheel.schedule("hc-a", 30, cb)
+    wheel.schedule("hc-b", 90, cb)
+    wheel.schedule("hc-fired", 1, cb)
+    await clock.advance(10)
+    snap = wheel.snapshot()
+    # fired entries carry no pending run and must be absent — restoring
+    # them would duplicate a run the old owner already fired
+    assert set(snap) == {"hc-a", "hc-b"}
+    assert snap["hc-a"] == pytest.approx(20.0)
+    assert snap["hc-b"] == pytest.approx(80.0)
+    assert wheel.remaining("hc-fired") is None
+    await wheel.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_owed_run_adoption_across_owner_change():
+    """ISSUE-6 satellite: serialize pending fire times on one wheel (the
+    dying shard owner), restore onto a fresh wheel (the adopting owner)
+    with the shared injectable Clock, and assert every owed run fires
+    EXACTLY once, at its original deadline — no dropped, no duplicated
+    runs across the handoff."""
+    clock = FakeClock()
+    old_owner = TimerWheel(clock)
+    fired = []
+
+    def cb_factory(name):
+        async def cb():
+            fired.append((name, clock.monotonic()))
+        return cb
+
+    for i, delay in enumerate((30, 60, 90, 120)):
+        old_owner.schedule(f"health/hc-{i}", delay, cb_factory(f"health/hc-{i}"))
+    await clock.advance(45)  # hc-0 fires on the OLD owner before it dies
+    assert fired == [("health/hc-0", 30.0)]
+
+    # owner change: the dying owner's pending state is serialized, its
+    # wheel torn down (crash semantics: every timer task dies with it)
+    snap = old_owner.snapshot()
+    await old_owner.shutdown()
+    assert set(snap) == {"health/hc-1", "health/hc-2", "health/hc-3"}
+
+    new_owner = TimerWheel(clock)
+    assert new_owner.restore(snap, cb_factory) == 3
+    # no early fire: restored deadlines are the ORIGINAL ones
+    await clock.advance(10)  # t=55, next deadline is 60
+    assert len(fired) == 1
+    await clock.advance(100)  # t=155: every owed run has fired
+    assert fired == [
+        ("health/hc-0", 30.0),
+        ("health/hc-1", 60.0),
+        ("health/hc-2", 90.0),
+        ("health/hc-3", 120.0),
+    ]
+    # exactly once: nothing re-fires later on either wheel
+    await clock.advance(200)
+    assert len(fired) == 4
+    await new_owner.shutdown()
+
+
+@pytest.mark.asyncio
 async def test_shutdown_cancels_everything():
     clock = FakeClock()
     wheel = TimerWheel(clock)
