@@ -165,6 +165,17 @@ def build_parser() -> argparse.ArgumentParser:
         "JSON lines (one trace per line) to PATH; live traces are "
         "always available at /debug/traces on the health endpoint",
     )
+    run.add_argument(
+        "--flight-dir",
+        default="",
+        metavar="DIR",
+        help="persist degradation flight-recorder bundles (confirmed "
+        "ok->degraded transitions, breaker trips, quarantines, shard "
+        "handoffs — each with its correlated spans/history/baseline "
+        "evidence) as JSONL under DIR; live bundles are always served "
+        "at /debug/flightrec on the health endpoint "
+        "(docs/operations.md \"Reading a flight recording\")",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -207,29 +218,64 @@ def build_parser() -> argparse.ArgumentParser:
     add_client_flags(describe)
     describe.add_argument("--namespace", "-n", default="default")
 
+    def add_statusz_flags(p) -> None:
+        """The /statusz fetch knobs every fleet-introspection verb
+        shares (status/why/goodput): repeatable --url for sharded
+        fleets, bearer token for merged auth-filtered sites. ONE
+        definition so a future knob cannot silently skip a verb."""
+        p.add_argument(
+            "--url",
+            action="append",
+            default=None,
+            help="the controller's /statusz endpoint (default "
+            "http://127.0.0.1:8081/statusz — the health-probe address; "
+            "point at the metrics address when the sites are merged). "
+            "Repeat once per replica of a SHARDED fleet: the payloads "
+            "are rolled up into one fleet view (checks deduped, "
+            "per-shard ownership counts summed)",
+        )
+        p.add_argument(
+            "--token",
+            default="",
+            help="bearer token, needed only against a merged site whose "
+            "/metrics is auth-filtered",
+        )
+
     status = sub.add_parser(
         "status",
         help="fleet SLO summary from a running controller's /statusz",
     )
-    status.add_argument(
-        "--url",
-        action="append",
-        default=None,
-        help="the controller's /statusz endpoint (default "
-        "http://127.0.0.1:8081/statusz — the health-probe address; "
-        "point at the metrics address when the sites are merged). "
-        "Repeat once per replica of a SHARDED fleet: the payloads are "
-        "rolled up into one fleet view (checks deduped, per-shard "
-        "ownership counts summed)",
-    )
-    status.add_argument(
-        "--token",
-        default="",
-        help="bearer token, needed only against a merged site whose "
-        "/metrics is auth-filtered",
-    )
+    add_statusz_flags(status)
     status.add_argument(
         "-o", "--output", choices=["table", "json"], default="table"
+    )
+
+    why = sub.add_parser(
+        "why",
+        help="explain what is costing ONE check goodput: its lost-"
+        "goodput attribution, the evidence line, and trace deep links",
+    )
+    why.add_argument("name", help="HealthCheck name")
+    why.add_argument(
+        "--namespace",
+        "-n",
+        default=None,
+        help="namespace filter (default: every namespace with that name)",
+    )
+    add_statusz_flags(why)
+    why.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
+    goodput = sub.add_parser(
+        "goodput",
+        help="fleet lost-goodput attribution: which subsystem (ici/hbm/"
+        "compile/scheduling/control_plane/unknown) is costing goodput "
+        "right now, and the top offending checks",
+    )
+    add_statusz_flags(goodput)
+    goodput.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
     )
 
     sub.add_parser("crd", help="print the HealthCheck CRD manifest")
@@ -409,6 +455,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         # multiply the budget, a static rate/N split would shrink it
         remedy_rate=args.remedy_rate,
         shard_coordinator=coordinator,
+        flight_dir=getattr(args, "flight_dir", ""),
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -650,6 +697,16 @@ def _fmt_seconds(value) -> str:
     return "-" if value is None else f"{value:.2f}s"
 
 
+def _why_cell(attribution) -> str:
+    """The status table's WHY cell: `bucket:lost%` for a check losing
+    goodput, "-" otherwise — one token, so the table stays greppable."""
+    if not attribution or not attribution.get("top"):
+        return "-"
+    return "{}:{:.0f}%".format(
+        attribution["top"], 100 * (attribution.get("lost_ratio") or 0)
+    )
+
+
 def render_status_table(payload: dict) -> str:
     """The /statusz payload as the `am-tpu status` table. Pure so tests
     pin the rendering against a canned payload."""
@@ -698,13 +755,14 @@ def render_status_table(payload: dict) -> str:
         )
     headers = [
         "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
-        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST TRACE",
+        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "WHY", "LAST TRACE",
     ]
     rows = []
     for check in payload.get("checks") or []:
         window = check.get("window") or {}
         slo = check.get("slo")
         analysis = check.get("analysis")
+        attribution = check.get("attribution")
         remedy_budget = check.get("remedy_budget_remaining")
         rows.append(
             [
@@ -727,6 +785,10 @@ def render_status_table(payload: dict) -> str:
                     else "-"
                 ),
                 "-" if remedy_budget is None else str(remedy_budget),
+                # goodput attribution headline: the bucket costing this
+                # check goodput right now ("-" while nothing is lost);
+                # `am-tpu why <check>` has the full evidence
+                _why_cell(attribution),
                 (check.get("last_trace_id") or "-")[:16],
             ]
         )
@@ -743,9 +805,11 @@ def render_status_table(payload: dict) -> str:
     return "\n".join(lines)
 
 
-async def _status(args) -> int:
-    import json as _json
-
+async def _fetch_fleet_payload(args):
+    """Fetch /statusz from every --url (default: the local health
+    endpoint) and return ONE fleet payload — rolled up across replicas
+    when more than one answered — or None when none did. Shared by the
+    status/why/goodput verbs so they all see the same fleet view."""
     import aiohttp
 
     urls = args.url or ["http://127.0.0.1:8081/statusz"]
@@ -787,7 +851,7 @@ async def _status(args) -> int:
             "a health-probe address?)",
             file=sys.stderr,
         )
-        return 1
+        return None
     if failures:
         print(
             f"warning: partial fleet view ({len(payloads)}/{len(urls)} "
@@ -795,19 +859,191 @@ async def _status(args) -> int:
             file=sys.stderr,
         )
     if len(payloads) == 1:
-        payload = payloads[0]
-    else:
-        # sharded fleet: merge the per-replica payloads into one view
-        # (obs/slo.rollup_statusz — checks deduped by key, per-shard
-        # ownership counts summed, goodput the run-weighted mean of
-        # the replicas' own ratios)
-        from activemonitor_tpu.obs.slo import rollup_statusz
+        return payloads[0]
+    # sharded fleet: merge the per-replica payloads into one view
+    # (obs/slo.rollup_statusz — checks deduped by key, per-shard
+    # ownership counts summed, goodput the run-weighted mean of
+    # the replicas' own ratios, attribution merged run-weighted)
+    from activemonitor_tpu.obs.slo import rollup_statusz
 
-        payload = rollup_statusz(payloads)
+    return rollup_statusz(payloads)
+
+
+async def _status(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
     if args.output == "json":
         print(_json.dumps(payload, indent=2))
         return 0
     print(render_status_table(payload))
+    return 0
+
+
+def render_goodput(payload: dict) -> str:
+    """The `am-tpu goodput` report: the fleet's lost-goodput
+    decomposition plus the top offending checks. Pure over a /statusz
+    (or rollup) payload so tests pin the rendering."""
+    fleet = payload.get("fleet") or {}
+    block = fleet.get("goodput") or {}
+    ratios = block.get("attribution") or {}
+    lost_runs = block.get("lost_runs") or {}
+    lines = [
+        "FLEET  goodput={}  lost={}  window_runs={}  top={}".format(
+            _fmt_ratio(fleet.get("goodput_ratio")),
+            _fmt_ratio(block.get("lost_ratio") or 0.0),
+            block.get("window_runs", fleet.get("window_runs", 0)),
+            block.get("top") or "none",
+        )
+    ]
+    lines.append("SUBSYSTEM        LOST    RUNS")
+    for bucket in sorted(ratios, key=lambda b: -(ratios[b] or 0)):
+        runs = lost_runs.get(bucket, 0)
+        lines.append(
+            "{:<13}  {:>6}  {:>6}".format(
+                bucket,
+                _fmt_ratio(ratios[bucket] or 0.0),
+                f"{runs:.0f}" if isinstance(runs, float) else str(runs),
+            )
+        )
+    offenders = []
+    for check in payload.get("checks") or []:
+        attribution = check.get("attribution")
+        if attribution and attribution.get("lost_runs"):
+            offenders.append((attribution["lost_runs"], check, attribution))
+    offenders.sort(key=lambda item: -item[0])
+    if offenders:
+        lines.append("TOP OFFENDERS")
+        for lost, check, attribution in offenders[:10]:
+            lines.append(
+                "  {}/{}  lost={}  {}  {}".format(
+                    check.get("namespace", ""),
+                    check.get("healthcheck", ""),
+                    _fmt_ratio(attribution.get("lost_ratio")),
+                    _why_cell(attribution),
+                    (attribution.get("why") or "")[:60],
+                ).rstrip()
+            )
+    return "\n".join(lines)
+
+
+async def _goodput(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
+    if args.output == "json":
+        fleet = payload.get("fleet") or {}
+        print(_json.dumps(fleet.get("goodput") or {}, indent=2))
+        return 0
+    print(render_goodput(payload))
+    return 0
+
+
+def render_why(check: dict) -> str:
+    """One check's `am-tpu why` explanation: verdict, attribution
+    decomposition, the evidence line, and /debug deep links. Pure over
+    a /statusz check entry so tests pin the rendering."""
+    key = check.get("key") or "{}/{}".format(
+        check.get("namespace", ""), check.get("healthcheck", "")
+    )
+    window = check.get("window") or {}
+    analysis = check.get("analysis")
+    attribution = check.get("attribution")
+    lines = [
+        "{}  state={}  anomaly={}  last={}".format(
+            key,
+            check.get("state") or "healthy",
+            (analysis or {}).get("state") or "-",
+            check.get("last_status") or "-",
+        ),
+        "  window: {} runs / {:.0f}s, availability {}".format(
+            window.get("results", 0),
+            window.get("seconds") or 0,
+            _fmt_ratio(window.get("availability")),
+        ),
+    ]
+    if not attribution or not attribution.get("lost_runs"):
+        lines.append("  no goodput lost in the window")
+    else:
+        parts = [
+            "{} {} ({} runs)".format(
+                bucket, _fmt_ratio(ratio), attribution["counts"][bucket]
+            )
+            for bucket, ratio in sorted(
+                (attribution.get("buckets") or {}).items(),
+                key=lambda kv: -(kv[1] or 0),
+            )
+            if ratio
+        ]
+        lines.append(
+            "  lost {} of goodput: {}".format(
+                _fmt_ratio(attribution.get("lost_ratio")), ", ".join(parts)
+            )
+        )
+    if attribution and attribution.get("why"):
+        lines.append(f"  why: {attribution['why']}")
+    lost_tail = [
+        entry
+        for entry in check.get("history") or []
+        if not entry.get("ok") or entry.get("bucket")
+    ]
+    if lost_tail:
+        lines.append("  recent attributed runs:")
+        for entry in lost_tail[-5:]:
+            lines.append(
+                "    {}  {}  {:<13} trace={}  {}".format(
+                    entry.get("ts", ""),
+                    "FAIL" if not entry.get("ok") else "ok  ",
+                    entry.get("bucket") or "-",
+                    (entry.get("trace_id") or "-")[:16],
+                    (entry.get("why") or "")[:60],
+                ).rstrip()
+            )
+        last = lost_tail[-1]
+        if last.get("trace_id"):
+            lines.append(
+                "  deep link: /debug/traces?trace_id={}  (all cycles: "
+                "/debug/traces?check={})".format(last["trace_id"], key)
+            )
+    return "\n".join(lines)
+
+
+async def _why(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
+    matches = [
+        check
+        for check in payload.get("checks") or []
+        if check.get("healthcheck") == args.name
+        and (args.namespace is None or check.get("namespace") == args.namespace)
+    ]
+    if not matches:
+        where = f" in namespace {args.namespace!r}" if args.namespace else ""
+        print(
+            f"healthcheck {args.name!r}{where} not found in the fleet view",
+            file=sys.stderr,
+        )
+        return 1
+    if args.output == "json":
+        docs = [
+            {
+                "key": check.get("key"),
+                "attribution": check.get("attribution"),
+                "analysis": check.get("analysis"),
+                "history": check.get("history"),
+            }
+            for check in matches
+        ]
+        print(_json.dumps(docs[0] if len(docs) == 1 else docs, indent=2))
+        return 0
+    print("\n".join(render_why(check) for check in matches))
     return 0
 
 
@@ -905,6 +1141,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "get": _get,
         "describe": _describe,
         "status": _status,
+        "why": _why,
+        "goodput": _goodput,
     }[args.command]
     if args.command == "run":
         # pre-import the controller's heavy dependency graph BEFORE the

@@ -342,6 +342,24 @@ class AnalysisEngine:
         rec = self._checks.get(key)
         return level_name(rec.level) if rec is not None else "ok"
 
+    def metric_states(self, key: str) -> Dict[str, str]:
+        """Per-metric post-hysteresis states ({} when the check has no
+        live analysis) — the goodput attribution layer's evidence that
+        a specific subsystem metric is confirmed-off-baseline."""
+        rec = self._checks.get(key)
+        if rec is None:
+            return {}
+        return {
+            metric: level_name(state.level)
+            for metric, state in rec.hysteresis.items()
+        }
+
+    def baselines_snapshot(self, key: str) -> Optional[dict]:
+        """The check's learned baseline stats in durable-blob form, or
+        None — the flight recorder's evidence slice."""
+        rec = self._checks.get(key)
+        return rec.baselines.to_dict() if rec is not None else None
+
     def summary(self, hc) -> Optional[dict]:
         """The check's /statusz ``analysis`` block (None when the check
         has not opted in). Schema pinned by the statusz contract test."""

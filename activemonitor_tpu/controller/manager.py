@@ -89,6 +89,7 @@ class Manager:
         remedy_rate: float = 0.0,  # fleet-wide remedies/min; 0 = no cap
         shard_coordinator=None,  # ShardCoordinator: sharded-fleet mode
         goodput_interval: float = 30.0,  # rollup cadence; big fleets raise it
+        flight_dir: str = "",  # durable flight-bundle JSONL dir; "" = memory only
     ):
         self.client = client
         self.reconciler = reconciler
@@ -109,6 +110,13 @@ class Manager:
         if shard_coordinator is not None:
             reconciler.shards = shard_coordinator
             reconciler.fleet.sharding = shard_coordinator
+            # flight bundles on a sharded fleet carry the ownership
+            # snapshot of the moment — who held what when it degraded
+            reconciler.flightrec.sharding = shard_coordinator
+        # --flight-dir: every bundle also lands as one JSONL line on
+        # disk, so a postmortem survives the controller that wrote it
+        if flight_dir:
+            reconciler.flightrec.flight_dir = flight_dir
         # fleet-wide remedy storm control (--remedy-rate) lives in the
         # reconciler's resilience coordinator. Sharded fleets apportion
         # the FLEET rate by owned shards (rate × owned/N, re-applied on
@@ -530,6 +538,11 @@ class Manager:
         giving up would silently stop monitoring the shard's existing
         checks."""
         self._apportion_remedy_rate()
+        from activemonitor_tpu.obs.flightrec import KIND_HANDOFF
+
+        self.reconciler.flightrec.record(
+            KIND_HANDOFF, shard=shard, event="acquired"
+        )
         if (
             shard == self._shards.shard_id
             and not self._boot_resynced
@@ -628,6 +641,11 @@ class Manager:
         if shard == self._shards.shard_id:
             self._home_losses += 1
         self._apportion_remedy_rate()
+        from activemonitor_tpu.obs.flightrec import KIND_HANDOFF
+
+        self.reconciler.flightrec.record(
+            KIND_HANDOFF, shard=shard, event="lost"
+        )
         self._resync_pending.discard(shard)
         released = self.reconciler.release_keys(
             lambda key: self._shards.shard_for(key) == shard
@@ -822,12 +840,33 @@ class Manager:
         async def debug_traces(request):
             # completed reconcile-cycle traces, newest last; ?trace_id=
             # narrows to one (the id a correlated log line / event
-            # carries)
+            # carries), ?check= to one check's cycles — the deep links
+            # `am-tpu why` and the flight recorder hand out, so a
+            # single cycle is addressable without client-side filtering
             traces = self.reconciler.tracer.traces()
             wanted = request.query.get("trace_id")
             if wanted:
                 traces = [t for t in traces if t["trace_id"] == wanted]
+            check = request.query.get("check")
+            if check:
+                traces = [
+                    t
+                    for t in traces
+                    if any(
+                        s["attrs"].get("healthcheck") == check
+                        for s in t["spans"]
+                    )
+                ]
             return web.json_response({"traces": traces})
+
+        async def debug_flightrec(request):
+            # degradation flight bundles, oldest first; ?kind= / ?check=
+            # narrow (docs/operations.md "Reading a flight recording")
+            bundles = self.reconciler.flightrec.bundles(
+                kind=request.query.get("kind"),
+                check=request.query.get("check"),
+            )
+            return web.json_response({"bundles": bundles})
 
         async def debug_events(request):
             events = self.reconciler.recorder.all
@@ -850,6 +889,7 @@ class Manager:
         debug_routes = [
             web.get("/debug/traces", debug_traces),
             web.get("/debug/events", debug_events),
+            web.get("/debug/flightrec", debug_flightrec),
             web.get("/statusz", statusz),
         ]
 
@@ -870,6 +910,7 @@ class Manager:
         guarded_debug_routes = [
             web.get("/debug/traces", guarded(debug_traces)),
             web.get("/debug/events", guarded(debug_events)),
+            web.get("/debug/flightrec", guarded(debug_flightrec)),
             web.get("/statusz", guarded(statusz)),
         ]
 

@@ -75,6 +75,11 @@ from activemonitor_tpu.metrics.collector import (
     WORKFLOW_LABEL_HEALTHCHECK,
     WORKFLOW_LABEL_REMEDY,
 )
+from activemonitor_tpu.obs.flightrec import (
+    FlightRecorder,
+    KIND_DEGRADED,
+    KIND_QUARANTINE,
+)
 from activemonitor_tpu.obs.slo import FleetStatus
 from activemonitor_tpu.obs.trace import Tracer
 from activemonitor_tpu.resilience import (
@@ -135,6 +140,26 @@ class HealthCheckReconciler:
         # it through the fleet aggregate.
         self.analysis = AnalysisEngine(self.clock, metrics)
         self.fleet.analysis = self.analysis
+        # goodput attribution reads the cycle's spans at record time
+        # (queue wait -> the scheduling bucket, errored spans -> the
+        # control-plane bucket)
+        self.fleet.tracer = self.tracer
+        # degradation flight recorder (docs/operations.md "Reading a
+        # flight recording"): on confirmed ok→degraded, breaker-open,
+        # quarantine, or shard handoff it snapshots the correlated
+        # evidence — spans, result-ring tail, baselines, breaker/shard
+        # state, attribution — into a bundle served at /debug/flightrec
+        # (durable JSONL under --flight-dir). Same ownership shape as
+        # the tracer.
+        self.flightrec = FlightRecorder(self.clock)
+        self.flightrec.tracer = self.tracer
+        self.flightrec.history = self.fleet.history
+        self.flightrec.fleet = self.fleet
+        self.flightrec.resilience = self.resilience
+        self.flightrec.analysis = self.analysis
+        # the coordinator triggers a breaker-open bundle the moment the
+        # breaker trips (the transition callback already funnels here)
+        self.resilience.flightrec = self.flightrec
         self.timers = TimerWheel(self.clock)
         # sharded-fleet coordinator (controller/sharding.py), wired by
         # the Manager when --shards > 1: ownership gates for timer-fired
@@ -423,6 +448,13 @@ class HealthCheckReconciler:
         )
         # the consumed timer must not refire a check we just parked
         self.timers.stop(key)
+        # ship the postmortem with the verdict: spans, ring tail,
+        # breaker state — everything that explains the error streak
+        self.flightrec.record(
+            KIND_QUARANTINE,
+            key=key,
+            error_streak=tracker.quarantine_after,
+        )
         self.recorder.event(
             hc,
             EVENT_WARNING,
@@ -504,6 +536,17 @@ class HealthCheckReconciler:
             worsened = ("ok", "warning", "degraded").index(new) > (
                 "ok", "warning", "degraded"
             ).index(old)
+            if new == "degraded":
+                # confirmed arrival at degraded (once per episode — the
+                # hysteresis staircase passes warning first): snapshot
+                # the evidence while the triggering cycle's spans and
+                # the pre-transition baselines are still live
+                self.flightrec.record(
+                    KIND_DEGRADED,
+                    key=hc.key,
+                    transition=list(verdict.transition),
+                    zscores=dict(verdict.zscores),
+                )
             if worsened:
                 self.recorder.event(
                     hc,
@@ -979,15 +1022,17 @@ class HealthCheckReconciler:
                         hc.metadata.name, status, run_id=wf_name
                     )
                     samples = MetricsCollector.parse_custom_samples(status)
+                    timings = MetricsCollector.parse_phase_timings(status)
                     # the run lands in the result history on the same
-                    # path that writes status — one source for SLO math
-                    # AND for the anomaly detectors
+                    # path that writes status — one source for SLO math,
+                    # the anomaly detectors AND goodput attribution
                     self.fleet.record(
                         hc,
                         ok=True,
                         latency=(now - then).total_seconds(),
                         workflow=wf_name,
                         metrics=samples,
+                        timings=timings,
                     )
                     # the verdict drives the flap state machine; the
                     # durable .status.state mark rides this same write
@@ -1051,12 +1096,14 @@ class HealthCheckReconciler:
                         hc.metadata.name, status, run_id=wf_name
                     )
                     samples = MetricsCollector.parse_custom_samples(status)
+                    timings = MetricsCollector.parse_phase_timings(status)
                     self.fleet.record(
                         hc,
                         ok=False,
                         latency=(now - then).total_seconds(),
                         workflow=wf_name,
                         metrics=samples,
+                        timings=timings,
                     )
                     self._note_verdict(hc, ok=False)
                     # failed runs never feed the baselines (their

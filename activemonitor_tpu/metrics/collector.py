@@ -203,6 +203,39 @@ class MetricsCollector:
             "rolling window (run-weighted fleet goodput)",
             registry=self.registry,
         )
+        # -- lost-goodput attribution (obs/attribution.py is the single
+        # writer; docs/observability.md "Goodput attribution"). The
+        # per-subsystem values are CONSERVATIVE: they sum to
+        # 1 - healthcheck_fleet_goodput_ratio, so a dashboard can stack
+        # them under the goodput line without double counting (tested).
+        self.goodput_lost = Gauge(
+            "healthcheck_goodput_lost_ratio",
+            "Fraction of the fleet's windowed runs lost to each "
+            "subsystem bucket (ici/hbm/compile/scheduling/"
+            "control_plane/unknown); the buckets sum to "
+            "1 - healthcheck_fleet_goodput_ratio",
+            ["subsystem"],
+            registry=self.registry,
+        )
+        self.goodput_attribution_info = Gauge(
+            "healthcheck_goodput_attribution_info",
+            "Attribution taxonomy metadata (always 1): the taxonomy "
+            "version and the subsystem currently costing the most "
+            "goodput ('none' while nothing is lost)",
+            ["version", "top"],
+            registry=self.registry,
+        )
+        # probe/controller contract drift: timings-block entries the
+        # collector had to drop (previously only a log warning —
+        # invisible on /metrics)
+        self.phase_timings_skipped = Counter(
+            "healthcheck_phase_timings_skipped_total",
+            "Phase-timing entries dropped while parsing the stdout "
+            "contract's timings block (contract drift between probe "
+            "and controller versions)",
+            ["reason"],
+            registry=self.registry,
+        )
         # fleet rollup (beyond the reference; cf. ML-productivity-goodput
         # style metrics): what fraction of checks are healthy AND meeting
         # their cadence — the one number a fleet dashboard leads with
@@ -419,6 +452,9 @@ class MetricsCollector:
         self._recorded_runs: "collections.OrderedDict[tuple, bool]" = (
             collections.OrderedDict()
         )
+        # the attribution info series' current (version, top) labels, so
+        # a top change drops the stale series instead of leaving two 1s
+        self._attribution_info: Optional[tuple] = None
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -521,6 +557,27 @@ class MetricsCollector:
 
     def set_fleet_goodput(self, ratio: float) -> None:
         self.fleet_goodput.set(ratio)
+
+    def set_goodput_attribution(
+        self, ratios: Dict[str, float], top: Optional[str], version: int = 1
+    ) -> None:
+        """Refresh the lost-goodput decomposition (obs/attribution.py
+        is the single writer, off the reconcile path). ``ratios`` maps
+        every taxonomy bucket to its lost share; ``top`` is the bucket
+        currently costing the most ('none' while nothing is lost)."""
+        for subsystem, ratio in ratios.items():
+            self.goodput_lost.labels(subsystem).set(ratio)
+        labels = (str(version), top or "none")
+        if self._attribution_info is not None and self._attribution_info != labels:
+            try:
+                self.goodput_attribution_info.remove(*self._attribution_info)
+            except KeyError:
+                pass  # never materialized — nothing to drop
+        self._attribution_info = labels
+        self.goodput_attribution_info.labels(*labels).set(1.0)
+
+    def record_phase_timing_skipped(self, reason: str) -> None:
+        self.phase_timings_skipped.labels(reason).inc()
 
     # -- resilience families (written by resilience/) ------------------
     def set_degraded(self, degraded: bool) -> None:
@@ -746,6 +803,38 @@ class MetricsCollector:
                     continue
         return samples
 
+    @staticmethod
+    def parse_phase_timings(workflow_status: dict) -> Dict[str, float]:
+        """The run's ``timings`` block as ``{phase: seconds}`` —
+        contract spelling, no sanitizing — for the result history and
+        goodput attribution. Pure read like ``parse_custom_samples``:
+        records nothing, counts nothing, skips malformed entries
+        silently (the recording path above logs AND counts them)."""
+        outputs = (workflow_status or {}).get("outputs") or {}
+        parameters = outputs.get("parameters") or []
+        timings: Dict[str, float] = {}
+        for parameter in parameters:
+            value = parameter.get("value") if isinstance(parameter, dict) else None
+            if not isinstance(value, str):
+                continue
+            try:
+                doc = json.loads(value)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(doc, dict):
+                continue
+            block = doc.get("timings")
+            if not isinstance(block, dict):
+                continue
+            for phase, seconds in block.items():
+                if not isinstance(phase, str) or not phase:
+                    continue
+                try:
+                    timings[phase] = float(seconds)
+                except (TypeError, ValueError):
+                    continue
+        return timings
+
     def _record_custom_metric(self, hc_name: str, raw) -> int:
         """One contract entry -> one sample; returns 1 when recorded."""
         if not isinstance(raw, dict):
@@ -841,7 +930,10 @@ class MetricsCollector:
 
     def _record_phase_timings(self, hc_name: str, timings) -> None:
         """The contract's ``timings`` block -> phase histogram samples,
-        exemplar-stamped with the cycle's trace id."""
+        exemplar-stamped with the cycle's trace id. Dropped entries are
+        COUNTED (``healthcheck_phase_timings_skipped_total{reason}``),
+        not just logged — contract drift between probe and controller
+        versions must be visible on /metrics, not only in scrollback."""
         if timings is None:
             return
         if not isinstance(timings, dict):
@@ -850,6 +942,7 @@ class MetricsCollector:
                 hc_name,
                 type(timings).__name__,
             )
+            self.record_phase_timing_skipped("not_object")
             return
         exemplar = _exemplar()
         for phase, seconds in timings.items():
@@ -862,9 +955,11 @@ class MetricsCollector:
                     hc_name,
                     seconds,
                 )
+                self.record_phase_timing_skipped("bad_value")
                 continue
             if not isinstance(phase, str) or not phase:
                 log.warning("skipping unnamed phase timing of %s", hc_name)
+                self.record_phase_timing_skipped("unnamed")
                 continue
             self.phase_seconds.labels(hc_name, _sanitize(phase)).observe(
                 max(0.0, seconds), exemplar=exemplar

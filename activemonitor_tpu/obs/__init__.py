@@ -1,6 +1,14 @@
 """Observability: span tracing correlated with logs, events, metrics,
-plus the per-check result history and rolling-window SLO layer."""
+plus the per-check result history, the rolling-window SLO layer, the
+lost-goodput attribution engine, and the degradation flight recorder."""
 
+from activemonitor_tpu.obs.attribution import (
+    BUCKETS,
+    Attribution,
+    classify_run,
+    subsystem_for_metric,
+)
+from activemonitor_tpu.obs.flightrec import FlightRecorder
 from activemonitor_tpu.obs.history import CheckResult, ResultHistory
 from activemonitor_tpu.obs.slo import (
     FleetStatus,
@@ -19,8 +27,13 @@ from activemonitor_tpu.obs.trace import (
 )
 
 __all__ = [
+    "Attribution",
+    "BUCKETS",
     "CheckResult",
     "FleetStatus",
+    "FlightRecorder",
+    "classify_run",
+    "subsystem_for_metric",
     "ResultHistory",
     "SLOConfig",
     "SLOState",

@@ -1,0 +1,404 @@
+"""Lost-goodput attribution: *why* is the fleet below 1.0 right now.
+
+The SLO layer measures goodput (obs/slo.py ``fleet_goodput``); the ML
+Productivity Goodput paper (PAPERS.md, arXiv:2502.06982) argues the
+payoff of fleet telemetry is attribution — splitting lost goodput
+across subsystems so remediation targets the right layer — and ReFrame
+(arXiv:2404.10536) shows per-phase timings from inside the benchmark
+are the raw material. This module is that decomposition: every
+failed/degraded/late run is classified into exactly ONE bucket of a
+fixed taxonomy, and the per-bucket lost ratios are **conservative by
+construction** — each not-ok run lands in exactly one bucket, so the
+bucket ratios sum to ``1 - goodput_ratio`` exactly (a contract test
+pins it to ±1e-9, per check and across a sharded rollup).
+
+Taxonomy (``BUCKETS``, docs/observability.md "Goodput attribution"):
+
+- ``ici`` — interconnect evidence: a floored/anomalous metric on the
+  ICI/DCN path (``ici-*``, ``*allreduce*``, ``*busbw*``, ``ring*``…).
+- ``hbm`` — memory-path evidence (``hbm-*``, ``*stream*``,
+  ``*transfer*``).
+- ``compile`` — the run's phase timings are compile-dominated, or a
+  compile-path metric is anomalous.
+- ``scheduling`` — the cycle spent its time waiting in the workqueue
+  (enqueue→dequeue lag dominated the cadence), not running.
+- ``control_plane`` — the controller itself was degraded (breaker
+  open/probing), the cycle's submit/poll/status-write spans errored,
+  or the run was fenced during a shard handoff.
+- ``unknown`` — a lost run with no attributable evidence. An honest
+  bucket: it shrinking over time is the measure of this module.
+
+Classification priority (first match wins, documented in the docs):
+evidence from INSIDE the payload (rated-fraction floors, anomaly
+verdicts, compile-heavy timings) outranks environment evidence
+(queue wait, controller degradation) — a probe that ran and measured a
+sick link is attributable to the link even if the controller was also
+having a bad day.
+
+Like every obs/ module: injectable-clock discipline (timestamps come
+in as arguments; ``hack/lint.py`` bans wall-clock reads here), pure
+functions over :class:`~activemonitor_tpu.obs.history.CheckResult`
+sequences so fake-clock tests assert exact ratios, and nothing here
+ever raises into the recording path (the callers guard).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Dict, Iterable, Optional, Sequence
+
+BUCKETS = (
+    "ici",
+    "hbm",
+    "compile",
+    "scheduling",
+    "control_plane",
+    "unknown",
+)
+
+# bumped when bucket semantics change; exported on
+# healthcheck_goodput_attribution_info so dashboards can gate parsers
+TAXONOMY_VERSION = 1
+
+# rated-fraction evidence floor: mirrors the analysis layer's warning
+# floor (analysis/detector.py) so a run the detectors would flag is
+# attributable even for checks without a spec.analysis block
+RATED_FLOOR = 0.85
+RATED_SUFFIX = "-fraction-of-rated"
+
+# queue wait above max(floor, fraction × cadence) reads as a scheduling
+# loss: the run was late because it sat in the workqueue, not because
+# the probe was slow
+SCHEDULING_WAIT_FRACTION = 0.1
+SCHEDULING_WAIT_FLOOR = 1.0
+
+# compile-phase share of the payload's own timed seconds above which a
+# lost run is a compile loss (a probe that spent its budget compiling
+# never got to measure)
+COMPILE_DOMINANCE = 0.5
+
+# metric-name vocabulary → subsystem. Tokens, not substrings: the name
+# is split on -_. so "ici-allreduce-busbw-gbps" yields clean tokens and
+# "pricing" can never match "ici". Order matters (first hit wins).
+_SUBSYSTEM_TOKENS = (
+    (
+        "ici",
+        {
+            "ici", "dcn", "allreduce", "allgather", "reducescatter",
+            "busbw", "ring", "ringhop", "bidir", "permute", "ppermute",
+            "collective", "collectives", "hop",
+        },
+    ),
+    ("hbm", {"hbm", "stream", "memory", "transfer", "h2d", "d2h"}),
+    ("compile", {"compile", "compilation", "jit", "lowering"}),
+)
+
+_TOKEN_SPLIT = re.compile(r"[-_.]")
+
+
+def subsystem_for_metric(name: str) -> Optional[str]:
+    """The taxonomy bucket a metric name's vocabulary points at, or
+    None for metrics with no subsystem mapping (e.g. ``mxu-*`` compute
+    numbers — the taxonomy deliberately has no compute bucket, so those
+    stay ``unknown`` rather than mislabeled)."""
+    tokens = set(_TOKEN_SPLIT.split(str(name).lower()))
+    for subsystem, vocabulary in _SUBSYSTEM_TOKENS:
+        if tokens & vocabulary:
+            return subsystem
+    return None
+
+
+@dataclass(frozen=True)
+class Attribution:
+    """One run's attribution verdict: the bucket and a one-line human
+    ``why`` (the WHY column / ``am-tpu why`` evidence line)."""
+
+    bucket: str
+    why: str
+
+
+def classify_run(
+    *,
+    ok: bool,
+    metrics: Optional[Dict[str, float]] = None,
+    timings: Optional[Dict[str, float]] = None,
+    anomalies: Optional[Dict[str, str]] = None,
+    anomaly_state: str = "ok",
+    queue_wait: float = 0.0,
+    interval: float = 0.0,
+    degraded_controller: bool = False,
+    errored_spans: Iterable[str] = (),
+) -> Optional[Attribution]:
+    """Classify one finished run. Returns None for an unremarkable OK
+    run (nothing to attribute); otherwise exactly one bucket.
+
+    Inputs are all captured AT RECORD TIME by the caller (FleetStatus):
+    the run's own contract ``metrics``/``timings``, the analysis
+    layer's per-metric verdicts, the cycle's queue wait from its
+    ``dequeue`` span, and the resilience coordinator's degraded bit —
+    so classification never depends on state that has moved on by the
+    time an operator asks.
+    """
+    # 1) payload evidence: a floored rated-fraction metric names its
+    #    subsystem directly — the WORST floor wins when several are low
+    worst: Optional[tuple] = None
+    for name, value in (metrics or {}).items():
+        if not name.endswith(RATED_SUFFIX):
+            continue
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            continue
+        if value < RATED_FLOOR and (worst is None or value < worst[0]):
+            worst = (value, name)
+    if worst is not None:
+        value, name = worst
+        bucket = subsystem_for_metric(name) or "unknown"
+        return Attribution(
+            bucket,
+            f"{name} {value:.3g} below rated floor {RATED_FLOOR:g}",
+        )
+    # 2) confirmed anomaly verdicts (analysis/engine.py hysteresis) on
+    #    a metric whose name maps to a subsystem
+    for name, state in sorted((anomalies or {}).items()):
+        if state not in ("warning", "degraded"):
+            continue
+        bucket = subsystem_for_metric(name)
+        if bucket is not None:
+            return Attribution(
+                bucket, f"{name} anomaly state {state} vs learned baseline"
+            )
+    # 3) compile-dominated payload timings — explains a LOST run only:
+    #    a healthy compile-heavy run is just a probe with a warm-up
+    #    cost, not lost goodput
+    timed = {k: float(v) for k, v in (timings or {}).items() if v is not None}
+    total = sum(v for v in timed.values() if v > 0)
+    if not ok and total > 0:
+        compile_seconds = sum(
+            v
+            for k, v in timed.items()
+            if v > 0 and (subsystem_for_metric(k) == "compile" or k == "init")
+        )
+        if compile_seconds / total >= COMPILE_DOMINANCE:
+            return Attribution(
+                "compile",
+                f"compile phases took {compile_seconds:.3g}s of "
+                f"{total:.3g}s timed ({compile_seconds / total:.0%})",
+            )
+    # 4) the cycle sat in the workqueue — LATE runs are remarkable even
+    #    when they pass (the cadence the SLO promises was not kept)
+    wait_threshold = max(
+        SCHEDULING_WAIT_FLOOR, SCHEDULING_WAIT_FRACTION * max(0.0, interval)
+    )
+    if queue_wait > wait_threshold:
+        return Attribution(
+            "scheduling",
+            f"queue wait {queue_wait:.3g}s exceeded {wait_threshold:.3g}s "
+            "(workqueue backlog)",
+        )
+    # 5) the control plane was the sick party (lost runs only — a run
+    #    that SUCCEEDED under a degraded controller lost nothing)
+    if not ok:
+        errored = [s for s in errored_spans if s]
+        if degraded_controller:
+            return Attribution(
+                "control_plane", "controller degraded (breaker open/probing)"
+            )
+        if errored:
+            return Attribution(
+                "control_plane",
+                "cycle span(s) errored: " + ", ".join(sorted(set(errored))[:3]),
+            )
+        return Attribution("unknown", "run failed with no attributable evidence")
+    if anomaly_state in ("warning", "degraded"):
+        # passing but confirmed-degraded on an unmapped metric: still a
+        # remarkable run, honestly unattributed
+        return Attribution(
+            "unknown", f"metrics {anomaly_state} from baseline (unmapped subsystem)"
+        )
+    return None
+
+
+# ---------------------------------------------------------------------
+# aggregation (conservation lives here)
+# ---------------------------------------------------------------------
+
+
+def _windowed(results: Sequence, now: datetime, window_seconds: float):
+    """Same window rule as obs/slo.py ``window_results`` — exclusive on
+    the left — re-stated locally because slo imports this module."""
+    return [
+        r for r in results if (now - r.ts).total_seconds() < window_seconds
+    ]
+
+
+def summarize_results(windowed: Sequence) -> Optional[dict]:
+    """One check's attribution block over an already-windowed result
+    list (None when the window is empty). Conservation: the per-bucket
+    ratios sum to ``lost_ratio`` == ``1 - availability`` exactly —
+    every not-ok run lands in exactly one bucket."""
+    if not windowed:
+        return None
+    total = len(windowed)
+    counts = {bucket: 0 for bucket in BUCKETS}
+    for result in windowed:
+        if result.ok:
+            continue
+        bucket = result.bucket if result.bucket in BUCKETS else "unknown"
+        counts[bucket] += 1
+    lost = sum(counts.values())
+    why = next((r.why for r in reversed(windowed) if r.why), "")
+    top = None
+    if lost:
+        top = max(BUCKETS, key=lambda b: counts[b])
+    return {
+        "window_runs": total,
+        "lost_runs": lost,
+        "lost_ratio": lost / total,
+        "buckets": {bucket: counts[bucket] / total for bucket in BUCKETS},
+        "counts": counts,
+        "top": top,
+        "why": why,
+    }
+
+
+def fleet_attribution(
+    history, configs: Dict[str, object], now: datetime, default_window: float
+) -> dict:
+    """The fleet's goodput + attribution in ONE walk, so the ratio and
+    its decomposition are computed over the very same windowed runs
+    (the conservation contract: ``sum(attribution.values()) ==
+    1 - ratio`` to float precision). Mirrors ``fleet_goodput``'s
+    iteration exactly: each check contributes the runs inside ITS
+    declared window (else ``default_window``), run-weighted."""
+    total = good = 0
+    counts = {bucket: 0 for bucket in BUCKETS}
+    for key in history.checks():
+        config = configs.get(key)
+        window = getattr(config, "window_seconds", None) or default_window
+        for result in _windowed(history.results(key), now, window):
+            total += 1
+            if result.ok:
+                good += 1
+            else:
+                bucket = (
+                    result.bucket if result.bucket in BUCKETS else "unknown"
+                )
+                counts[bucket] += 1
+    ratio = (good / total) if total else None
+    lost = total - good
+    top = None
+    if lost:
+        top = max(BUCKETS, key=lambda b: counts[b])
+    return {
+        "ratio": ratio,
+        "window_runs": total,
+        "lost_ratio": (lost / total) if total else 0.0,
+        "lost_runs": {bucket: counts[bucket] for bucket in BUCKETS},
+        "attribution": {
+            bucket: (counts[bucket] / total) if total else 0.0
+            for bucket in BUCKETS
+        },
+        "top": top,
+        "version": TAXONOMY_VERSION,
+    }
+
+
+def merge_goodput_blocks(payload_fleets: Sequence[dict]) -> dict:
+    """Roll per-replica ``fleet.goodput`` blocks into one fleet block
+    (obs/slo.py ``rollup_statusz`` calls this). Run-weighted like the
+    goodput rollup itself. **Version skew is first-class**: a replica
+    payload with NO goodput block (an old binary mid rolling-update)
+    still conserves — its entire lost share lands in ``unknown`` rather
+    than vanishing, so the rolled-up buckets keep summing to
+    ``1 - rolled-up goodput``."""
+    total_runs = 0.0
+    good_runs = 0.0
+    lost_weight = {bucket: 0.0 for bucket in BUCKETS}
+    for fleet in payload_fleets:
+        ratio = (fleet or {}).get("goodput_ratio")
+        runs = int((fleet or {}).get("window_runs") or 0)
+        if ratio is None or runs <= 0:
+            continue
+        total_runs += runs
+        good_runs += ratio * runs
+        block = (fleet or {}).get("goodput")
+        buckets = (
+            block.get("attribution") if isinstance(block, dict) else None
+        )
+        if isinstance(buckets, dict):
+            for bucket, value in buckets.items():
+                key = bucket if bucket in BUCKETS else "unknown"
+                try:
+                    lost_weight[key] += float(value) * runs
+                except (TypeError, ValueError):
+                    continue
+        else:
+            # old binary: it measured goodput but cannot explain it
+            lost_weight["unknown"] += (1.0 - ratio) * runs
+    top = None
+    if total_runs and any(lost_weight.values()):
+        top = max(BUCKETS, key=lambda b: lost_weight[b])
+    return {
+        "ratio": (good_runs / total_runs) if total_runs else None,
+        "window_runs": int(total_runs),
+        "lost_ratio": (
+            sum(lost_weight.values()) / total_runs if total_runs else 0.0
+        ),
+        "lost_runs": {
+            bucket: lost_weight[bucket] for bucket in BUCKETS
+        },
+        "attribution": {
+            bucket: (lost_weight[bucket] / total_runs) if total_runs else 0.0
+            for bucket in BUCKETS
+        },
+        "top": top,
+        "version": TAXONOMY_VERSION,
+    }
+
+
+# ---------------------------------------------------------------------
+# bench.py round attribution (same taxonomy, artifact-side)
+# ---------------------------------------------------------------------
+
+
+def classify_bench_round(doc: dict) -> dict:
+    """Attribute ONE bench round's lost goodput, stamped into the
+    BENCH_r*.json artifact next to ``fallback_reason`` — so a degraded
+    round says WHY on the JSON line (CPU fallback vs probe hang vs real
+    regression), not just that it degraded. Pure over the artifact
+    dict; bucket ``none`` means the round lost nothing."""
+    if doc.get("fallback"):
+        reason = str(doc.get("fallback_reason") or "device unreachable")
+        lowered = reason.lower()
+        if "hung" in lowered or "wedged" in lowered or "timeout" in lowered:
+            why = f"CPU fallback: device probe hang ({reason[:160]})"
+        else:
+            why = f"CPU fallback: {reason[:160]}"
+        # a wedged tunnel / unreachable device is infrastructure between
+        # the driver and the chip — the control plane's loss
+        return {"bucket": "control_plane", "why": why}
+    vs_baseline = doc.get("vs_baseline")
+    metric = str(doc.get("metric") or "")
+    if isinstance(vs_baseline, (int, float)) and vs_baseline < 1.0:
+        if doc.get("platform") == "cpu" or "cpu" in metric:
+            # a CPU-mesh round below its prior CPU artifact is host
+            # noise, not a subsystem regression — never label it ici
+            return {
+                "bucket": "unknown",
+                "why": (
+                    f"{metric} at {vs_baseline:.3f}x of the prior CPU-mesh "
+                    "round (host variance, not the TPU bar)"
+                ),
+            }
+        bucket = subsystem_for_metric(metric) or "unknown"
+        return {
+            "bucket": bucket,
+            "why": (
+                f"{metric} at {vs_baseline:.3f}x of the target bar "
+                "(real regression)"
+            ),
+        }
+    return {"bucket": "none", "why": "round met its bar; no goodput lost"}

@@ -1,0 +1,194 @@
+"""Degradation flight recorder: every verdict ships its postmortem.
+
+When a check confirms ok→degraded, the breaker opens, a check is
+quarantined, or a shard hands off, the evidence an operator needs is
+scattered across four ring buffers that will have wrapped by the time
+anyone greps: the span ring (/debug/traces), the result history, the
+learned baselines, and the breaker/shard state. The flight recorder
+snapshots the CORRELATED slice of all of them at the moment of the
+transition into one bundle — bounded in memory, optionally durable as
+JSONL (``--flight-dir``), served at ``/debug/flightrec``.
+
+Bundle contract (pinned by the statusz schema contract test):
+
+- ``id``/``kind``/``check``/``ts`` — identity; kind is one of
+  :data:`KINDS`.
+- ``trace_id`` + ``spans`` — the triggering cycle's trace (the spans
+  finished so far), joinable back to ``/debug/traces?trace_id=``.
+- ``results`` — the check's result-ring tail (each entry carries its
+  own trace_id, attribution bucket and why).
+- ``baselines`` — the analysis layer's learned stats at trigger time.
+- ``resilience``/``sharding`` — breaker + shard-ownership snapshots.
+- ``attribution`` — the check's windowed lost-goodput decomposition.
+- ``extra`` — trigger-specific context (the transition, the shard id…).
+
+Design constraints shared with the tracer/history (obs/trace.py):
+injectable clock (``hack/lint.py`` bans wall-clock reads here), bounded
+ring, and **never raises into the triggering path** — a recorder bug
+must not fail the reconcile/transition that fed it. The durable sink is
+append-only JSONL: one bundle per line, replayable with ``jq``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+from typing import Deque, List, Optional
+
+from activemonitor_tpu.obs.trace import current_trace_id
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.flightrec")
+
+KIND_DEGRADED = "degraded-transition"
+KIND_BREAKER = "breaker-open"
+KIND_QUARANTINE = "quarantine"
+KIND_HANDOFF = "shard-handoff"
+KINDS = (KIND_DEGRADED, KIND_BREAKER, KIND_QUARANTINE, KIND_HANDOFF)
+
+DEFAULT_CAPACITY = 256  # bundles retained in memory
+SPAN_TAIL = 20  # fallback span excerpt when no trace is active
+RESULT_TAIL = 10  # result-ring excerpt per bundle
+
+FLIGHT_FILE = "flightrec.jsonl"
+
+
+class FlightRecorder:
+    """Owned by the reconciler like the tracer; evidence sources are
+    wired post-construction (same shape as FleetStatus): ``tracer``,
+    ``history``, ``fleet``, ``resilience``, ``analysis``, ``sharding``
+    — any of them may stay None (standalone/unit-test recorders record
+    null evidence for that source rather than failing)."""
+
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        capacity: int = DEFAULT_CAPACITY,
+        flight_dir: str = "",
+    ):
+        self.clock = clock or Clock()
+        self.flight_dir = flight_dir
+        self._ring: Deque[dict] = collections.deque(maxlen=max(1, capacity))
+        self._seq = 0
+        self.tracer = None
+        self.history = None
+        self.fleet = None
+        self.resilience = None
+        self.analysis = None
+        self.sharding = None
+
+    # -- recording ------------------------------------------------------
+    def record(self, kind: str, key: str = "", **extra) -> Optional[dict]:
+        """Snapshot one transition's evidence bundle. Returns the bundle
+        (or None on an internal failure — never raises into the
+        transition that triggered it)."""
+        try:
+            return self._record(kind, key, extra)
+        except Exception:
+            log.exception("flight recording failed for %s/%s", kind, key)
+            return None
+
+    def _record(self, kind: str, key: str, extra: dict) -> dict:
+        self._seq += 1
+        trace_id = current_trace_id()
+        if not trace_id and self.history is not None and key:
+            # outside any span (e.g. a sweep-driven breaker trip): the
+            # check's last recorded run is the best correlated trace
+            last = self.history.last(key)
+            trace_id = last.trace_id if last is not None else ""
+        spans: List[dict] = []
+        if self.tracer is not None:
+            if trace_id:
+                spans = [
+                    s.to_dict() for s in self.tracer.spans_for_trace(trace_id)
+                ]
+            if not spans:
+                spans = [
+                    s.to_dict()
+                    for s in self.tracer.finished_spans[-SPAN_TAIL:]
+                ]
+        results: List[dict] = []
+        if self.history is not None and key:
+            results = [r.to_dict() for r in self.history.tail(key, RESULT_TAIL)]
+        baselines = None
+        if self.analysis is not None and key:
+            baselines = self.analysis.baselines_snapshot(key)
+        resilience = (
+            self.resilience.snapshot() if self.resilience is not None else None
+        )
+        sharding = (
+            self.sharding.snapshot() if self.sharding is not None else None
+        )
+        attribution = None
+        if self.fleet is not None and key:
+            attribution = self.fleet.check_attribution(key)
+        bundle = {
+            "id": f"fr-{self._seq:06d}",
+            "kind": kind,
+            "check": key,
+            "ts": self.clock.now().isoformat(),
+            "trace_id": trace_id,
+            "spans": spans,
+            "results": results,
+            "baselines": baselines,
+            "resilience": resilience,
+            "sharding": sharding,
+            "attribution": attribution,
+            # JSON round-trip now: the ring must hold exactly what the
+            # JSONL sink and /debug/flightrec serve (tuples → lists,
+            # exotic values stringified), not a Python-only shape
+            "extra": json.loads(json.dumps(extra, default=str)),
+        }
+        self._ring.append(bundle)
+        self._persist(bundle)
+        log.warning(
+            "flight bundle %s recorded (%s%s)",
+            bundle["id"],
+            kind,
+            f" for {key}" if key else "",
+        )
+        return bundle
+
+    def _persist(self, bundle: dict) -> None:
+        """Append one JSONL line to ``flight_dir``; best-effort (an
+        unwritable disk costs durability, never the transition)."""
+        if not self.flight_dir:
+            return
+        try:
+            os.makedirs(self.flight_dir, exist_ok=True)
+            path = os.path.join(self.flight_dir, FLIGHT_FILE)
+            with open(path, "a") as f:
+                f.write(json.dumps(bundle, default=str) + "\n")
+        except OSError:
+            log.exception(
+                "failed to persist flight bundle %s to %s",
+                bundle.get("id"),
+                self.flight_dir,
+            )
+
+    # -- reading --------------------------------------------------------
+    def bundles(
+        self, kind: Optional[str] = None, check: Optional[str] = None
+    ) -> List[dict]:
+        """Retained bundles, oldest first; ``kind``/``check`` narrow —
+        the ``/debug/flightrec`` query parameters."""
+        out = list(self._ring)
+        if kind:
+            out = [b for b in out if b["kind"] == kind]
+        if check:
+            out = [b for b in out if b["check"] == check]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @staticmethod
+    def read_jsonl(path: str):
+        """Parse a durable flight file back (tests, offline analysis)."""
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)

@@ -49,6 +49,14 @@ class CheckResult:
     # "mxu-matmul-tflops") — the raw material the anomaly detectors and
     # the /debug endpoints read; empty for runs without a contract
     metrics: Dict[str, float] = field(default_factory=dict)
+    # the payload's own phase timings (the stdout contract's "timings"
+    # block) — the ReFrame-style raw material goodput attribution reads
+    timings: Dict[str, float] = field(default_factory=dict)
+    # lost-goodput attribution, stamped AT RECORD TIME while the cycle's
+    # spans / anomaly verdicts / breaker state are all still live
+    # (obs/attribution.py); "" for unremarkable ok runs
+    bucket: str = ""
+    why: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -58,6 +66,9 @@ class CheckResult:
             "workflow": self.workflow,
             "trace_id": self.trace_id,
             "metrics": dict(self.metrics),
+            "timings": dict(self.timings),
+            "bucket": self.bucket,
+            "why": self.why,
         }
 
 
@@ -80,6 +91,9 @@ class ResultHistory:
         workflow: str = "",
         trace_id: str = "",
         metrics: Optional[Dict[str, float]] = None,
+        timings: Optional[Dict[str, float]] = None,
+        bucket: str = "",
+        why: str = "",
     ) -> CheckResult:
         """Append one finished run; the oldest entry falls off a full
         ring. The timestamp is stamped HERE from the injected clock so
@@ -91,6 +105,9 @@ class ResultHistory:
             workflow=workflow,
             trace_id=trace_id,
             metrics=dict(metrics or {}),
+            timings=dict(timings or {}),
+            bucket=bucket,
+            why=why,
         )
         ring = self._rings.get(key)
         if ring is None:

@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from datetime import datetime
 from typing import Dict, List, Optional, Sequence
 
+from activemonitor_tpu.obs import attribution
 from activemonitor_tpu.obs.history import CheckResult, ResultHistory
 from activemonitor_tpu.obs.trace import current_trace_id
 from activemonitor_tpu.utils.clock import Clock
@@ -209,23 +210,111 @@ class FleetStatus:
         # coordinator whose ownership snapshot rides the fleet block.
         # None (unsharded / standalone) reports sharding: null.
         self.sharding = None
+        # wired by the reconciler: the span tracer whose dequeue spans
+        # carry the cycle's queue wait — the scheduling-bucket evidence
+        # goodput attribution reads at record time. None = no span
+        # evidence (standalone), classification still works.
+        self.tracer = None
+        # the last fleet attribution rollup (refresh_fleet_goodput), so
+        # /statusz serves a block computed over the same windowed runs
+        # as the goodput ratio it rides next to
+        self._goodput_block = attribution.fleet_attribution(
+            self.history, {}, self.clock.now(), DEFAULT_WINDOW_SECONDS
+        )
 
     # -- recording (reconciler status-write path) ----------------------
     def record(
-        self, hc, *, ok: bool, latency: float, workflow: str, metrics=None
+        self,
+        hc,
+        *,
+        ok: bool,
+        latency: float,
+        workflow: str,
+        metrics=None,
+        timings=None,
     ) -> None:
         try:
             self._record(
-                hc, ok=ok, latency=latency, workflow=workflow, metrics=metrics
+                hc,
+                ok=ok,
+                latency=latency,
+                workflow=workflow,
+                metrics=metrics,
+                timings=timings,
             )
         except Exception:
             # observability must not fail the status write that feeds it
             log.exception("failed to record result for %s", getattr(hc, "key", "?"))
 
+    def _classify(self, hc, *, ok: bool, metrics, timings) -> tuple:
+        """The run's lost-goodput attribution, judged AT RECORD TIME
+        while every evidence source is still live: the cycle's dequeue
+        span (queue wait), the analysis layer's confirmed per-metric
+        verdicts (as of the PREVIOUS run for passing runs — the engine
+        observes this run's samples after the record lands, so a
+        passing run's display bucket can lag one run; failed runs never
+        feed the hysteresis, so their classification has no lag), and
+        the breaker's degraded bit. Returns ``(bucket, why)`` —
+        ("", "") for an unremarkable ok run. Never raises: attribution
+        is garnish on the SLO record, and a classification bug must not
+        cost the run its availability/goodput accounting."""
+        try:
+            return self._classify_inner(hc, ok=ok, metrics=metrics, timings=timings)
+        except Exception:
+            log.exception(
+                "attribution classification failed for %s", getattr(hc, "key", "?")
+            )
+            return "", ""
+
+    def _classify_inner(self, hc, *, ok: bool, metrics, timings) -> tuple:
+        key = hc.key
+        trace_id = current_trace_id()
+        queue_wait = 0.0
+        errored_spans = []
+        if self.tracer is not None and trace_id:
+            for span in self.tracer.spans_for_trace(trace_id):
+                if span.name == "dequeue" and span.duration:
+                    queue_wait = max(queue_wait, span.duration)
+                if span.error:
+                    errored_spans.append(span.name)
+        anomalies = (
+            self.analysis.metric_states(key)
+            if self.analysis is not None
+            else {}
+        )
+        anomaly_state = (
+            self.analysis.state(key) if self.analysis is not None else "ok"
+        )
+        degraded = (
+            self.resilience.degraded if self.resilience is not None else False
+        )
+        verdict = attribution.classify_run(
+            ok=ok,
+            metrics=metrics,
+            timings=timings,
+            anomalies=anomalies,
+            anomaly_state=anomaly_state,
+            queue_wait=queue_wait,
+            interval=float(getattr(hc.spec, "repeat_after_sec", 0) or 0),
+            degraded_controller=degraded,
+            errored_spans=errored_spans,
+        )
+        if verdict is None:
+            return "", ""
+        return verdict.bucket, verdict.why
+
     def _record(
-        self, hc, *, ok: bool, latency: float, workflow: str, metrics=None
+        self,
+        hc,
+        *,
+        ok: bool,
+        latency: float,
+        workflow: str,
+        metrics=None,
+        timings=None,
     ) -> None:
         key = hc.key
+        bucket, why = self._classify(hc, ok=ok, metrics=metrics, timings=timings)
         self.history.record(
             key,
             ok=ok,
@@ -233,6 +322,9 @@ class FleetStatus:
             workflow=workflow,
             trace_id=current_trace_id(),
             metrics=metrics,
+            timings=timings,
+            bucket=bucket,
+            why=why,
         )
         self._last_status[key] = "Succeeded" if ok else "Failed"
         config = slo_config_from_spec(hc.spec)
@@ -260,15 +352,39 @@ class FleetStatus:
         # goodput loop and /statusz refresh it (refresh_fleet_goodput).
 
     def refresh_fleet_goodput(self) -> Optional[float]:
-        """Recompute the fleet-wide goodput ratio and (when a collector
-        is attached) refresh its gauge. Called off the reconcile path:
-        the manager's periodic rollup loop and every /statusz build."""
-        ratio = fleet_goodput(self.history, self._configs, self.clock.now())
+        """Recompute the fleet-wide goodput ratio AND its lost-goodput
+        attribution in one walk (the decomposition must cover the very
+        same windowed runs as the ratio, or conservation breaks), then
+        refresh the gauges when a collector is attached. Called off the
+        reconcile path: the manager's periodic rollup loop and every
+        /statusz build."""
+        block = attribution.fleet_attribution(
+            self.history, self._configs, self.clock.now(), DEFAULT_WINDOW_SECONDS
+        )
+        self._goodput_block = block
+        ratio = block["ratio"]
         if self.metrics is not None:
             # an empty fleet is vacuously healthy, same convention as
-            # the cadence-goodput gauge
+            # the cadence-goodput gauge (all-zero lost buckets agree:
+            # they sum to 1 - 1.0)
             self.metrics.set_fleet_goodput(1.0 if ratio is None else ratio)
+            self.metrics.set_goodput_attribution(
+                block["attribution"],
+                block["top"],
+                version=attribution.TAXONOMY_VERSION,
+            )
         return ratio
+
+    def check_attribution(self, key: str) -> Optional[dict]:
+        """One check's windowed attribution block (None when its window
+        is empty) — served per check in /statusz and snapshotted into
+        flight bundles. Same window rule as the check's SLO display."""
+        config = self._configs.get(key)
+        window = config.window_seconds if config else DEFAULT_WINDOW_SECONDS
+        windowed = window_results(
+            self.history.results(key), self.clock.now(), window
+        )
+        return attribution.summarize_results(windowed)
 
     def forget(self, key: str, name: str = "", namespace: str = "") -> None:
         """Deleted check: drop its ring, config, and gauge series."""
@@ -321,6 +437,10 @@ class FleetStatus:
                 self.analysis.summary(hc) if self.analysis is not None else None
             ),
             "remedy_budget_remaining": remedy_budget,
+            # lost-goodput attribution over the SAME windowed runs the
+            # availability above counts (None when the window is empty)
+            # — the per-bucket ratios sum to 1 - availability exactly
+            "attribution": attribution.summarize_results(windowed),
             "last_status": hc.status.status
             or self._last_status.get(key, ""),
             "last_trace_id": last.trace_id if last else "",
@@ -377,6 +497,11 @@ class FleetStatus:
                 "checks": len(entries),
                 "window_runs": window_runs,
                 "goodput_ratio": ratio,
+                # lost-goodput decomposition over the same windowed runs
+                # as the ratio above (obs/attribution.py; the per-bucket
+                # ratios sum to 1 - goodput ratio — "what is costing us
+                # goodput right now", docs/observability.md)
+                "goodput": self._goodput_block,
                 "generated_at": now.isoformat(),
                 "anomalies": anomalies,
                 # degraded-mode telemetry (docs/resilience.md): the
@@ -440,6 +565,7 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     handoff soak pins before and after a kill).
     """
     merged: Dict[str, dict] = {}
+    fleet_blocks: List[dict] = []  # per-replica fleet dicts, for goodput merge
     owners: Dict[str, str] = {}  # shard id -> owning replica identity
     checks_per_shard: Dict[str, int] = {}
     shards = 0
@@ -464,6 +590,7 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     goodput_weighted = goodput_runs = 0.0
     for payload in payloads:
         fleet = payload.get("fleet") or {}
+        fleet_blocks.append(fleet)
         replica_ratio = fleet.get("goodput_ratio")
         replica_runs = int(fleet.get("window_runs") or 0)
         if replica_ratio is not None and replica_runs > 0:
@@ -530,6 +657,10 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "goodput_ratio": (
                 (goodput_weighted / goodput_runs) if goodput_runs else None
             ),
+            # attribution merged run-weighted like the ratio; a replica
+            # payload WITHOUT the block (old binary mid rolling update)
+            # conserves by landing its whole lost share in `unknown`
+            "goodput": attribution.merge_goodput_blocks(fleet_blocks),
             "generated_at": generated_at,
             "degraded": degraded,
             "breaker": breaker,

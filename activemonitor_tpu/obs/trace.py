@@ -157,7 +157,15 @@ class Tracer:
         self, clock: Optional[Clock] = None, capacity: int = DEFAULT_CAPACITY
     ):
         self.clock = clock or Clock()
-        self._finished: Deque[Span] = collections.deque(maxlen=max(1, capacity))
+        self._capacity = max(1, capacity)
+        # NB: eviction is manual (no deque maxlen) so the per-trace
+        # index below stays consistent with the ring
+        self._finished: Deque[Span] = collections.deque()
+        # trace_id -> that trace's retained spans, oldest first — the
+        # O(trace) lookup behind spans_for_trace (goodput attribution
+        # consults it on EVERY recorded run; an O(ring) scan there
+        # would put 4096 comparisons on each status write)
+        self._by_trace: Dict[str, List[Span]] = {}
 
     # -- span creation -------------------------------------------------
     def new_trace_id(self) -> str:
@@ -237,12 +245,31 @@ class Tracer:
     def _finish(self, span: Span) -> None:
         if span.end is None:
             span.end = self.clock.monotonic()
-        self._finished.append(span)  # deque maxlen evicts the oldest
+        self._finished.append(span)
+        self._by_trace.setdefault(span.trace_id, []).append(span)
+        while len(self._finished) > self._capacity:
+            evicted = self._finished.popleft()
+            trace = self._by_trace.get(evicted.trace_id)
+            if trace:
+                # spans of one trace finish in ring order, so the
+                # evicted one is the trace list's head
+                trace.pop(0)
+                if not trace:
+                    del self._by_trace[evicted.trace_id]
 
     # -- export --------------------------------------------------------
     @property
     def finished_spans(self) -> List[Span]:
         return list(self._finished)
+
+    def spans_for_trace(self, trace_id: str) -> List[Span]:
+        """The finished spans of one trace, oldest first — the
+        correlated-evidence lookup attribution and the flight recorder
+        use (a cycle's dequeue span carries its queue wait). O(trace),
+        not O(ring): served from the per-trace index."""
+        if not trace_id:
+            return []
+        return list(self._by_trace.get(trace_id, ()))
 
     def traces(self) -> List[dict]:
         """Finished spans grouped per trace, oldest trace first — the

@@ -12,7 +12,15 @@ block — ``{"timings": {phase: seconds}}`` — measured INSIDE the payload
 with :class:`PhaseTimings` (Reframe-style, PAPERS.md arXiv:2404.10536:
 regression detection needs per-phase timings from inside the benchmark,
 not just end-to-end latency). The controller turns it into
-``healthcheck_phase_seconds{healthcheck_name,phase}`` histograms.
+``healthcheck_phase_seconds{healthcheck_name,phase}`` histograms, AND
+feeds it to goodput attribution (obs/attribution.py): a lost run whose
+timed seconds are dominated by compile-vocabulary phases (``compile``,
+``init``, ``jit``…) is attributed to the ``compile`` bucket — so name
+your phases from the probe's real structure (``init``/``compile``/
+``execute``), not generically. Entries the controller cannot parse are
+counted in ``healthcheck_phase_timings_skipped_total{reason}`` — watch
+it after upgrading probes and controller at different times (contract
+drift is visible on /metrics, not just in logs).
 """
 
 from __future__ import annotations

@@ -37,7 +37,11 @@ import logging
 import random
 from typing import Optional, Tuple
 
-from activemonitor_tpu.resilience.breaker import STATE_CLOSED, CircuitBreaker
+from activemonitor_tpu.resilience.breaker import (
+    STATE_CLOSED,
+    STATE_OPEN,
+    CircuitBreaker,
+)
 from activemonitor_tpu.resilience.health import CheckStateTracker
 from activemonitor_tpu.resilience.storm import TokenBucket
 from activemonitor_tpu.utils.clock import Clock
@@ -68,6 +72,11 @@ class ResilienceCoordinator:
         self.breaker._on_transition = self._on_breaker_transition
         self.checks = checks or CheckStateTracker()
         self._rng = rng
+        # wired by the reconciler (obs/flightrec.py): a breaker trip is
+        # one of the flight recorder's trigger transitions — the bundle
+        # snapshots what the fleet looked like the moment the controller
+        # went degraded. None (standalone) records nothing.
+        self.flightrec = None
         self.remedy_bucket: Optional[TokenBucket] = None
         self.configure_remedy_rate(remedy_rate)
         # key -> queued HealthCheck (latest status wins); insertion order
@@ -99,6 +108,16 @@ class ResilienceCoordinator:
         )
         if self.metrics is not None:
             self.metrics.set_degraded(degraded)
+        if new == STATE_OPEN and self.flightrec is not None:
+            # the trip itself is the postmortem moment: snapshot the
+            # breaker stats and recent spans before the outage noise
+            # wraps the rings (flightrec never raises back into here;
+            # imported lazily — obs/flightrec sits above this layer)
+            from activemonitor_tpu.obs.flightrec import KIND_BREAKER
+
+            self.flightrec.record(
+                KIND_BREAKER, breaker=self.breaker.snapshot()
+            )
 
     def refresh(self) -> None:
         """Poll time-driven state (open → half-open happens on state

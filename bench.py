@@ -607,7 +607,22 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     doc["platform"] = platform
     doc["n_devices"] = n
     doc["device_kind"] = devices[0].device_kind
+    _stamp_attribution(doc)
     return doc
+
+
+def _stamp_attribution(doc: dict) -> None:
+    """Stamp the round's lost-goodput attribution next to
+    fallback_reason, using the controller's taxonomy
+    (obs/attribution.py) — so BENCH_r*.json records WHY a round lost
+    goodput (CPU fallback vs probe hang vs real regression), not just
+    that it did. Guarded: a broken import must not cost the artifact."""
+    try:
+        from activemonitor_tpu.obs.attribution import classify_bench_round
+
+        doc["goodput_attribution"] = classify_bench_round(doc)
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"attribution stamp failed: {exc!r}", file=sys.stderr)
 
 
 def main() -> int:

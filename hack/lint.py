@@ -42,15 +42,17 @@ positive that makes `make lint` cry wolf is worse than a miss):
   whose whole body is `pass`/`...` — the broad catch that silently
   eats errors (BLE001's harmful core). Handlers that log, re-raise,
   return, or otherwise DO something are fine.
-- wallclock-in-<package>: `time.time()` / `time.monotonic()` calls in
+- wallclock-in-<unit>: `time.time()` / `time.monotonic()` calls in
   files under a `resilience/` or `analysis/` directory, or in the
-  sharding module (`sharding.py`) — those units' whole contract is the
-  injectable Clock (breaker open windows, token-bucket refill,
-  baseline timestamps, and shard lease expiry/fencing windows must be
-  scriptable by fake-clock tests); a bare wall-clock read there
-  silently breaks determinism. The finding code carries the unit
-  (`wallclock-in-resilience`, `wallclock-in-analysis`,
-  `wallclock-in-sharding`).
+  clock-disciplined modules (`sharding.py`, `attribution.py`,
+  `flightrec.py`) — those units' whole contract is the injectable
+  Clock (breaker open windows, token-bucket refill, baseline
+  timestamps, shard lease expiry/fencing windows, attribution windows
+  and flight-bundle timestamps must be scriptable by fake-clock
+  tests); a bare wall-clock read there silently breaks determinism.
+  The finding code carries the unit (`wallclock-in-resilience`,
+  `wallclock-in-analysis`, `wallclock-in-sharding`,
+  `wallclock-in-attribution`, `wallclock-in-flightrec`).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -140,10 +142,14 @@ class Checker(ast.NodeVisitor):
         self.wallclock_pkg = next(
             (pkg for pkg in ("resilience", "analysis") if pkg in parts), None
         )
-        if self.wallclock_pkg is None and Path(path).name == "sharding.py":
-            # the sharding module (lease expiry, fencing windows, shed
-            # cooldowns) carries the same injectable-Clock contract
-            self.wallclock_pkg = "sharding"
+        if self.wallclock_pkg is None and Path(path).name in (
+            "sharding.py",  # lease expiry, fencing windows, shed cooldowns
+            "attribution.py",  # goodput windows judged on result timestamps
+            "flightrec.py",  # bundle timestamps ride scripted transitions
+        ):
+            # single-file modules carrying the same injectable-Clock
+            # contract as the resilience/analysis packages
+            self.wallclock_pkg = Path(path).stem
         self.ban_wallclock = self.wallclock_pkg is not None
         # names defined `async def` / plain `def` anywhere in the file
         # (functions AND methods) — the unawaited-coroutine check only

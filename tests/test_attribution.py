@@ -1,0 +1,739 @@
+"""Goodput attribution + flight recorder (ISSUE 7): the bucket
+taxonomy, the conservation property (per-subsystem lost ratios sum to
+1 − goodput, per check, fleet-wide, and across a 3-replica sharded
+rollup — including version skew), the flight-recorder triggers with
+their /debug/traces joins, and the `am-tpu why` / `am-tpu goodput`
+surfaces.
+"""
+
+import asyncio
+import collections
+import json
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.engine.base import PHASE_FAILED, PHASE_SUCCEEDED
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs import FleetStatus
+from activemonitor_tpu.obs.attribution import (
+    BUCKETS,
+    classify_bench_round,
+    classify_run,
+    merge_goodput_blocks,
+    subsystem_for_metric,
+    summarize_results,
+)
+from activemonitor_tpu.obs.flightrec import FlightRecorder
+from activemonitor_tpu.obs.slo import rollup_statusz
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+ICI_METRIC = "ici-allreduce-fraction-of-rated"
+HBM_METRIC = "hbm-fraction-of-rated"
+
+
+def make_hc(name="hc-att", repeat=60, analysis=None, slo=None):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        "backoffMax": 1,
+        "backoffMin": 1,
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if analysis is not None:
+        spec["analysis"] = analysis
+    if slo is not None:
+        spec["slo"] = slo
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+# ---------------------------------------------------------------------
+# classification units
+# ---------------------------------------------------------------------
+
+
+def test_subsystem_vocabulary_mapping():
+    assert subsystem_for_metric("ici-allreduce-fraction-of-rated") == "ici"
+    assert subsystem_for_metric("ring-attention-busbw-gbps") == "ici"
+    assert subsystem_for_metric("dcn-transfer-gbps") == "ici"  # first hit wins
+    assert subsystem_for_metric("hbm-stream-gbps") == "hbm"
+    assert subsystem_for_metric("compile-smoke-seconds") == "compile"
+    # bench artifact spelling (underscores) maps identically
+    assert subsystem_for_metric("ici_allreduce_fraction_of_rated") == "ici"
+    # compute metrics have no subsystem — honest unknown, not a guess
+    assert subsystem_for_metric("mxu-matmul-tflops") is None
+    # token match, not substring: "pricing" must not read as ici
+    assert subsystem_for_metric("pricing-total") is None
+
+
+def test_classify_run_buckets_and_priority():
+    # 1) payload evidence wins over everything, worst floor first
+    got = classify_run(
+        ok=False,
+        metrics={ICI_METRIC: 0.41, HBM_METRIC: 0.6},
+        degraded_controller=True,
+    )
+    assert got.bucket == "ici"
+    assert ICI_METRIC in got.why
+    # a passing-but-floored run is still classified (degraded evidence)
+    assert classify_run(ok=True, metrics={HBM_METRIC: 0.5}).bucket == "hbm"
+    # 2) confirmed anomaly verdict on a mapped metric
+    got = classify_run(ok=False, anomalies={"ici-ring-hop-gbps": "degraded"})
+    assert got.bucket == "ici"
+    # an anomalous UNMAPPED metric is no subsystem evidence
+    got = classify_run(ok=False, anomalies={"mxu-matmul-tflops": "degraded"})
+    assert got.bucket == "unknown"
+    # 3) compile-dominated timings
+    got = classify_run(ok=False, timings={"compile": 30.0, "execute": 2.0})
+    assert got.bucket == "compile"
+    got = classify_run(ok=False, timings={"compile": 1.0, "execute": 30.0})
+    assert got.bucket == "unknown"
+    # 4) queue-wait dominated (late) runs
+    got = classify_run(ok=False, queue_wait=45.0, interval=60.0)
+    assert got.bucket == "scheduling"
+    assert classify_run(ok=False, queue_wait=0.5, interval=60.0).bucket == "unknown"
+    # 5) control plane: degraded controller / errored cycle spans
+    assert classify_run(ok=False, degraded_controller=True).bucket == "control_plane"
+    assert (
+        classify_run(ok=False, errored_spans=["submit"]).bucket == "control_plane"
+    )
+    # unremarkable ok run: nothing to attribute
+    assert classify_run(ok=True) is None
+    # passing but confirmed-degraded on an unmapped metric: honest unknown
+    got = classify_run(ok=True, anomaly_state="degraded")
+    assert got.bucket == "unknown"
+
+
+def test_summarize_results_conserves_per_check():
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    fleet.record(hc, ok=True, latency=1.0, workflow="w1")
+    fleet.record(
+        hc, ok=False, latency=1.0, workflow="w2", metrics={ICI_METRIC: 0.4}
+    )
+    fleet.record(
+        hc, ok=False, latency=1.0, workflow="w3", metrics={HBM_METRIC: 0.5}
+    )
+    fleet.record(hc, ok=False, latency=1.0, workflow="w4")
+    [entry] = fleet.statusz([hc])["checks"]
+    att = entry["attribution"]
+    assert att["window_runs"] == 4
+    assert att["lost_runs"] == 3
+    assert att["buckets"]["ici"] == 0.25
+    assert att["buckets"]["hbm"] == 0.25
+    assert att["buckets"]["unknown"] == 0.25
+    # conservation, per check: buckets sum to 1 - availability
+    assert sum(att["buckets"].values()) == pytest.approx(
+        1.0 - entry["window"]["availability"], abs=1e-9
+    )
+    assert att["top"] in ("ici", "hbm", "unknown")
+    assert ICI_METRIC in entry["history"][1]["why"]
+    assert summarize_results([]) is None
+
+
+def test_classification_failure_never_drops_the_run():
+    """Attribution is garnish on the SLO record: a classification bug
+    (here: unfloatable timings from a caller outside the reconciler's
+    parse path) must cost the bucket, never the run's availability."""
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    fleet.record(
+        hc, ok=True, latency=1.0, workflow="w", timings={"init": "abc"}
+    )
+    [result] = fleet.history.results(hc.key)
+    assert result.ok and result.bucket == ""
+    [entry] = fleet.statusz([hc])["checks"]
+    assert entry["window"]["availability"] == 1.0
+
+
+def test_fleet_gauges_conserve_against_goodput_ratio():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    fleet = FleetStatus(clock, metrics)
+    a, b = make_hc("hc-a"), make_hc("hc-b")
+    for _ in range(3):
+        fleet.record(a, ok=True, latency=1.0, workflow="w")
+    fleet.record(a, ok=False, latency=1.0, workflow="w", metrics={ICI_METRIC: 0.3})
+    for _ in range(5):
+        fleet.record(b, ok=True, latency=1.0, workflow="w")
+    fleet.record(b, ok=False, latency=1.0, workflow="w")
+    ratio = fleet.refresh_fleet_goodput()
+    assert ratio == pytest.approx(8 / 10)
+    lost = {
+        bucket: metrics.sample_value(
+            "healthcheck_goodput_lost_ratio", {"subsystem": bucket}
+        )
+        for bucket in BUCKETS
+    }
+    assert lost["ici"] == pytest.approx(1 / 10)
+    assert lost["unknown"] == pytest.approx(1 / 10)
+    # THE conservation property: per-subsystem lost ratios sum to
+    # 1 - healthcheck_fleet_goodput_ratio
+    assert sum(lost.values()) == pytest.approx(
+        1.0 - metrics.sample_value("healthcheck_fleet_goodput_ratio", {}),
+        abs=1e-9,
+    )
+    assert (
+        metrics.sample_value(
+            "healthcheck_goodput_attribution_info",
+            {"version": "1", "top": lost["ici"] >= lost["unknown"] and "ici" or "unknown"},
+        )
+        == 1.0
+    )
+
+
+# ---------------------------------------------------------------------
+# sharded rollup conservation + version skew
+# ---------------------------------------------------------------------
+
+
+def replica_payload(name, records):
+    """One replica's /statusz payload (JSON round-tripped, like a real
+    fetch) for a single check with the scripted (ok, metrics) runs."""
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc(name)
+    for ok, metrics in records:
+        fleet.record(hc, ok=ok, latency=1.0, workflow="w", metrics=metrics)
+    return json.loads(json.dumps(fleet.statusz([hc])))
+
+
+def test_rollup_conservation_across_three_replicas():
+    payloads = [
+        replica_payload(
+            "hc-a", [(True, None)] * 3 + [(False, {ICI_METRIC: 0.4})]
+        ),
+        replica_payload(
+            "hc-b", [(True, None)] * 2 + [(False, {ICI_METRIC: 0.3})] * 2
+        ),
+        replica_payload("hc-c", [(True, None)] * 2),
+    ]
+    rollup = rollup_statusz(payloads)
+    fleet = rollup["fleet"]
+    block = fleet["goodput"]
+    # run-weighted: 10 runs, 3 lost, all ici
+    assert fleet["goodput_ratio"] == pytest.approx(7 / 10)
+    assert block["attribution"]["ici"] == pytest.approx(3 / 10)
+    assert block["top"] == "ici"
+    assert sum(block["attribution"].values()) == pytest.approx(
+        1.0 - fleet["goodput_ratio"], abs=1e-9
+    )
+
+
+def test_rollup_version_skew_lands_in_unknown_and_still_conserves():
+    """Satellite: a replica payload WITHOUT the goodput.attribution
+    block (old binary mid rolling update) must not crash the rollup,
+    and its lost share must surface as `unknown` — conservation holds
+    because nothing vanishes."""
+    payloads = [
+        replica_payload(
+            "hc-a", [(True, None)] * 3 + [(False, {ICI_METRIC: 0.4})]
+        ),
+        replica_payload(
+            "hc-b", [(True, None)] * 2 + [(False, {ICI_METRIC: 0.3})] * 2
+        ),
+    ]
+    # strip the new block from replica B, as an old binary would serve
+    del payloads[1]["fleet"]["goodput"]
+    rollup = rollup_statusz(payloads)
+    fleet = rollup["fleet"]
+    block = fleet["goodput"]
+    assert fleet["goodput_ratio"] == pytest.approx(5 / 8)
+    # replica A's loss keeps its bucket; replica B's is unattributable
+    assert block["attribution"]["ici"] == pytest.approx(1 / 8)
+    assert block["attribution"]["unknown"] == pytest.approx(2 / 8)
+    assert block["top"] == "unknown"
+    assert sum(block["attribution"].values()) == pytest.approx(
+        1.0 - fleet["goodput_ratio"], abs=1e-9
+    )
+    # belt: a payload with NO fleet block at all doesn't crash either
+    assert merge_goodput_blocks([{}])["ratio"] is None
+
+
+# ---------------------------------------------------------------------
+# acceptance: scripted FakeClock + FakeEngine fleet, end to end
+# ---------------------------------------------------------------------
+
+# (verdict, contract metrics): 7 clean passes, then one ici-floored
+# failure, one hbm-floored failure, one bare failure → goodput 0.7,
+# lost = ici 0.1 + hbm 0.1 + unknown 0.1
+SCRIPT = (
+    [(True, {ICI_METRIC: 0.97})] * 7
+    + [
+        (False, {ICI_METRIC: 0.41}),
+        (False, {HBM_METRIC: 0.52}),
+        (False, None),
+    ]
+)
+
+
+def scripted_engine(script):
+    engine = FakeWorkflowEngine()
+    queue = collections.deque(script)
+    assigned = {}
+
+    def completer(wf, _count):
+        name = wf["metadata"]["name"]
+        if name not in assigned:
+            if not queue:
+                return None
+            assigned[name] = queue.popleft()
+        ok, metrics = assigned[name]
+        status = {"phase": PHASE_SUCCEEDED if ok else PHASE_FAILED}
+        if not ok:
+            status["message"] = "scripted failure"
+        if metrics is not None:
+            contract = json.dumps(
+                {
+                    "metrics": [
+                        {"name": name_, "value": value}
+                        for name_, value in metrics.items()
+                    ],
+                    "timings": {"execute": 1.5},
+                }
+            )
+            status["outputs"] = {
+                "parameters": [{"name": "metrics", "value": contract}]
+            }
+        return status
+
+    engine._default_completer = completer
+    return engine
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+async def drive_runs(clock, count, interval=60.0, first=False):
+    for i in range(count):
+        if not first or i > 0:
+            await clock.advance(interval)
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+
+
+def build_controller(clock, client, engine):
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+    manager._health_addr = "127.0.0.1:0"
+    return manager, reconciler, metrics
+
+
+@pytest.mark.asyncio
+async def test_acceptance_conservation_statusz_and_cli():
+    import aiohttp
+
+    from activemonitor_tpu.__main__ import render_goodput, render_why
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    manager, reconciler, metrics = build_controller(
+        clock, client, scripted_engine(SCRIPT)
+    )
+    await manager.start()
+    try:
+        hc = make_hc("hc-ici")
+        await client.apply(hc)
+        await drive_runs(clock, len(SCRIPT), first=True)
+        key = "health/hc-ici"
+        results = reconciler.fleet.history.results(key)
+        assert [r.ok for r in results] == [ok for ok, _m in SCRIPT]
+        # record-time attribution landed on the ring
+        assert results[7].bucket == "ici"
+        assert results[8].bucket == "hbm"
+        assert results[9].bucket == "unknown"
+        assert ICI_METRIC in results[7].why
+        # the contract timings rode into the ring too
+        assert results[0].timings == {"execute": 1.5}
+
+        # /statusz: fleet goodput block + per-check attribution
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port}/statusz") as r:
+                assert r.status == 200
+                payload = await r.json()
+        fleet = payload["fleet"]
+        assert fleet["goodput_ratio"] == pytest.approx(0.7)
+        assert fleet["goodput"]["attribution"]["ici"] == pytest.approx(0.1)
+        assert fleet["goodput"]["attribution"]["hbm"] == pytest.approx(0.1)
+        assert fleet["goodput"]["attribution"]["unknown"] == pytest.approx(0.1)
+        assert sum(fleet["goodput"]["attribution"].values()) == pytest.approx(
+            1.0 - fleet["goodput_ratio"], abs=1e-9
+        )
+        [entry] = payload["checks"]
+        att = entry["attribution"]
+        assert sum(att["buckets"].values()) == pytest.approx(
+            1.0 - entry["window"]["availability"], abs=1e-9
+        )
+
+        # the exact same numbers through the gauges (the acceptance
+        # criterion): per-subsystem lost ratios sum to 1 - fleet ratio
+        lost = {
+            bucket: metrics.sample_value(
+                "healthcheck_goodput_lost_ratio", {"subsystem": bucket}
+            )
+            for bucket in BUCKETS
+        }
+        fleet_ratio = metrics.sample_value(
+            "healthcheck_fleet_goodput_ratio", {}
+        )
+        assert fleet_ratio == pytest.approx(0.7)
+        assert sum(lost.values()) == pytest.approx(1.0 - fleet_ratio, abs=1e-9)
+        assert lost["ici"] == pytest.approx(0.1)
+
+        # ... and after a 3-replica sharded rollup (this replica's
+        # payload + two synthetic peers), conservation still holds
+        peers = [
+            replica_payload(
+                "hc-peer1", [(True, None)] * 4 + [(False, {ICI_METRIC: 0.2})]
+            ),
+            replica_payload("hc-peer2", [(True, None)] * 5),
+        ]
+        rollup = rollup_statusz([payload] + peers)
+        rolled = rollup["fleet"]
+        assert rolled["goodput_ratio"] == pytest.approx(16 / 20)
+        assert sum(rolled["goodput"]["attribution"].values()) == pytest.approx(
+            1.0 - rolled["goodput_ratio"], abs=1e-9
+        )
+        assert rolled["goodput"]["attribution"]["ici"] == pytest.approx(2 / 20)
+
+        # CLI surfaces render from the same payload
+        why_text = render_why(entry)
+        assert "lost 30.0% of goodput" in why_text
+        assert "ici" in why_text and "/debug/traces?trace_id=" in why_text
+        goodput_text = render_goodput(payload)
+        assert goodput_text.splitlines()[0].startswith("FLEET  goodput=70.0%")
+        assert "TOP OFFENDERS" in goodput_text
+        from activemonitor_tpu.__main__ import render_status_table
+
+        table = render_status_table(payload)
+        header, row = table.splitlines()[1], table.splitlines()[2]
+        assert "WHY" in header.split()
+        assert any(cell.endswith(":30%") for cell in row.split())
+
+        # every lost run's trace joins back to /debug/traces?trace_id=
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/traces",
+                params={"trace_id": results[7].trace_id},
+            ) as r:
+                traces = (await r.json())["traces"]
+        assert traces and traces[0]["trace_id"] == results[7].trace_id
+        # and the new ?check= filter narrows to this check's cycles
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/traces",
+                params={"check": key},
+            ) as r:
+                by_check = (await r.json())["traces"]
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/traces",
+                params={"check": "health/nope"},
+            ) as r:
+                none = (await r.json())["traces"]
+        assert {t["trace_id"] for t in by_check} >= {
+            r_.trace_id for r_ in results
+        }
+        assert none == []
+    finally:
+        await manager.stop()
+
+
+# ---------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------
+
+ANALYSIS_SPEC = {
+    "warmupRuns": 5,
+    "zThreshold": 3.0,
+    "metrics": ["mxu-matmul-tflops"],
+}
+
+
+def analysis_engine_script(values):
+    """FakeEngine whose Nth workflow succeeds immediately with the Nth
+    scripted matmul sample (the test_analysis degradation walk)."""
+    return scripted_engine(
+        [(True, {"mxu-matmul-tflops": value}) for value in values]
+    )
+
+
+@pytest.mark.asyncio
+async def test_forced_degradation_produces_exactly_one_joinable_bundle(tmp_path):
+    """Acceptance: a forced ok→degraded transition produces exactly ONE
+    flight bundle whose span/trace ids join back to
+    /debug/traces?trace_id=, durable under --flight-dir."""
+    import aiohttp
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=analysis_engine_script([100.0] * 5 + [70.0] * 4),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        max_parallel=2,
+        flight_dir=str(tmp_path),
+    )
+    manager._health_addr = "127.0.0.1:0"
+    await manager.start()
+    try:
+        hc = make_hc("hc-deg", analysis=ANALYSIS_SPEC)
+        await client.apply(hc)
+        key = "health/hc-deg"
+        # 5 warmup runs at 100, then the 70s walk ok→warning→degraded
+        await drive_runs(clock, 9, first=True)
+        assert reconciler.analysis.state(key) == "degraded"
+        bundles = reconciler.flightrec.bundles(kind="degraded-transition")
+        assert len(bundles) == 1  # exactly one per confirmed episode
+        [bundle] = bundles
+        assert bundle["check"] == key
+        assert bundle["trace_id"]
+        assert bundle["spans"], "bundle carries the triggering cycle's spans"
+        assert all(s["trace_id"] == bundle["trace_id"] for s in bundle["spans"])
+        assert bundle["baselines"] is not None
+        assert bundle["results"][-1]["metrics"] == {"mxu-matmul-tflops": 70.0}
+        assert bundle["extra"]["transition"] == ["warning", "degraded"]
+
+        # the bundle's trace joins back to /debug/traces?trace_id=
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/traces",
+                params={"trace_id": bundle["trace_id"]},
+            ) as r:
+                traces = (await r.json())["traces"]
+            assert traces and traces[0]["trace_id"] == bundle["trace_id"]
+            span_ids = {s["span_id"] for s in traces[0]["spans"]}
+            assert {s["span_id"] for s in bundle["spans"]} <= span_ids
+            # served at /debug/flightrec with kind/check filters
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/flightrec",
+                params={"kind": "degraded-transition", "check": key},
+            ) as r:
+                served = (await r.json())["bundles"]
+            assert [b["id"] for b in served] == [bundle["id"]]
+            async with session.get(
+                f"http://127.0.0.1:{port}/debug/flightrec",
+                params={"kind": "breaker-open"},
+            ) as r:
+                assert (await r.json())["bundles"] == []
+        # durable: the same bundle landed as one JSONL line
+        lines = list(
+            FlightRecorder.read_jsonl(str(tmp_path / "flightrec.jsonl"))
+        )
+        assert [b["id"] for b in lines] == [bundle["id"]]
+        # driving more degraded runs must NOT produce another bundle
+        # (the transition already confirmed; no new episode)
+    finally:
+        await manager.stop()
+
+
+def test_breaker_open_and_quarantine_trigger_bundles():
+    from activemonitor_tpu.resilience import ResilienceCoordinator
+
+    clock = FakeClock()
+    coordinator = ResilienceCoordinator(clock, None)
+    recorder = FlightRecorder(clock)
+    recorder.resilience = coordinator
+    coordinator.flightrec = recorder
+    for _ in range(coordinator.breaker.failure_threshold):
+        coordinator.breaker.record_failure()
+    bundles = recorder.bundles(kind="breaker-open")
+    assert len(bundles) == 1
+    assert bundles[0]["resilience"]["breaker"]["state"] == "open"
+    # a recorder failure must never raise into the transition path
+    broken = FlightRecorder(clock)
+    broken.tracer = object()  # no finished_spans attr -> internal error
+    assert broken.record("breaker-open") is None
+    assert len(broken) == 0
+
+
+@pytest.mark.asyncio
+async def test_quarantine_records_a_bundle():
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    engine = FakeWorkflowEngine()
+
+    async def explode(_manifest):
+        raise RuntimeError("boom")
+
+    engine.submit = explode
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    hc = make_hc("hc-q")
+    await client.apply(hc)
+    threshold = reconciler.resilience.checks.quarantine_after
+    for _ in range(threshold + 1):
+        await reconciler.reconcile("health", "hc-q")
+        await asyncio.sleep(0)
+    bundles = reconciler.flightrec.bundles(kind="quarantine")
+    assert len(bundles) == 1
+    assert bundles[0]["check"] == "health/hc-q"
+
+
+# ---------------------------------------------------------------------
+# bench-round attribution (artifact-side, same taxonomy)
+# ---------------------------------------------------------------------
+
+
+def test_classify_bench_round():
+    hang = classify_bench_round(
+        {
+            "fallback": True,
+            "fallback_reason": "device probe hung past 120s on attempt 2/4 "
+            "(wedged tunnel?)",
+        }
+    )
+    assert hang == {
+        "bucket": "control_plane",
+        "why": "CPU fallback: device probe hang (device probe hung past "
+        "120s on attempt 2/4 (wedged tunnel?))",
+    }
+    exited = classify_bench_round(
+        {"fallback": True, "fallback_reason": "device probe exited with 1"}
+    )
+    assert exited["bucket"] == "control_plane"
+    assert "exited with 1" in exited["why"]
+    regression = classify_bench_round(
+        {
+            "metric": "ici_allreduce_fraction_of_rated",
+            "value": 0.72,
+            "vs_baseline": 0.8,
+        }
+    )
+    assert regression["bucket"] == "ici"
+    assert "real regression" in regression["why"]
+    compute = classify_bench_round(
+        {"metric": "mxu_bf16_fraction_of_rated", "vs_baseline": 0.9}
+    )
+    assert compute["bucket"] == "unknown"
+    # a CPU-mesh round below its prior CPU artifact is host variance,
+    # never an ici regression claim
+    cpu_noise = classify_bench_round(
+        {
+            "metric": "allreduce_busbw_cpu_mesh",
+            "platform": "cpu",
+            "vs_baseline": 0.8,
+        }
+    )
+    assert cpu_noise["bucket"] == "unknown"
+    assert "host variance" in cpu_noise["why"]
+    healthy = classify_bench_round(
+        {"metric": "ici_allreduce_fraction_of_rated", "vs_baseline": 1.03}
+    )
+    assert healthy["bucket"] == "none"
+
+
+def test_bench_stamps_attribution_next_to_fallback_reason():
+    """The satellite wiring gate: bench.py calls classify_bench_round
+    on every artifact (the stamp helper is importable and the call site
+    exists), so BENCH_r*.json records WHY a round lost goodput."""
+    from pathlib import Path
+
+    src = (Path(__file__).resolve().parent.parent / "bench.py").read_text()
+    assert "classify_bench_round" in src
+    assert "goodput_attribution" in src
+
+
+# ---------------------------------------------------------------------
+# CLI plumbing
+# ---------------------------------------------------------------------
+
+
+def test_why_and_goodput_cli_flags_parse():
+    from activemonitor_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(["why", "hc-ici"])
+    assert args.name == "hc-ici"
+    assert args.namespace is None
+    assert args.url is None
+    assert args.output == "text"
+    args = build_parser().parse_args(
+        ["goodput", "--url", "http://x:1/statusz", "--url", "http://y:1/statusz",
+         "-o", "json"]
+    )
+    assert len(args.url) == 2
+    assert args.output == "json"
+    args = build_parser().parse_args(["run", "--flight-dir", "/tmp/fl"])
+    assert args.flight_dir == "/tmp/fl"
+
+
+@pytest.mark.asyncio
+async def test_why_cli_fetches_and_explains(capsys):
+    from activemonitor_tpu.__main__ import _goodput, _why, build_parser
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    manager, reconciler, _metrics = build_controller(
+        clock, client, scripted_engine([(False, {ICI_METRIC: 0.4})])
+    )
+    await manager.start()
+    try:
+        await client.apply(make_hc("hc-ici"))
+        await drive_runs(clock, 1, first=True)
+        port = manager._http_runners[0].addresses[0][1]
+        url = f"http://127.0.0.1:{port}/statusz"
+        args = build_parser().parse_args(["why", "hc-ici", "--url", url])
+        assert await _why(args) == 0
+        out = capsys.readouterr().out
+        assert "health/hc-ici" in out
+        assert "ici" in out and "below rated floor" in out
+        args = build_parser().parse_args(["goodput", "--url", url])
+        assert await _goodput(args) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("FLEET  goodput=0.0%")
+        assert "ici" in out
+        # an unknown check name is a clean usage failure, not a traceback
+        args = build_parser().parse_args(["why", "nope", "--url", url])
+        assert await _why(args) == 1
+    finally:
+        await manager.stop()

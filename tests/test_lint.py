@@ -480,6 +480,61 @@ def test_sharding_module_really_is_wallclock_free():
     assert checker.wallclock_pkg == "sharding"
 
 
+def test_wallclock_banned_in_attribution_and_flightrec(tmp_path):
+    """obs/attribution.py and obs/flightrec.py carry the injectable-
+    Clock contract (ISSUE 7 satellite): attribution windows are judged
+    on result timestamps and flight bundles are stamped on scripted
+    transitions, so a bare wall-clock read there is a lint error —
+    same module-name keying as the sharding ban."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    for module in ("attribution", "flightrec"):
+        (tmp_path / f"{module}.py").write_text(source)
+        got = lint.lint_file(tmp_path / f"{module}.py")
+        assert {line.split(": ")[1] for line in got} == {
+            f"wallclock-in-{module}"
+        }, module
+        assert len(got) == 2
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="summarizer.py") == []
+
+
+def test_attribution_and_flightrec_really_are_wallclock_free():
+    """The gate, applied: the shipped modules lint clean and the ban
+    covers them (path-scoping regression guard, like the sharding
+    twin)."""
+    for module in ("attribution", "flightrec"):
+        path = REPO / "activemonitor_tpu" / "obs" / f"{module}.py"
+        assert path.exists(), f"{module} module missing?"
+        assert lint.lint_file(path) == []
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock
+        assert checker.wallclock_pkg == module
+
+
+def test_goodput_attribution_families_are_pinned():
+    """The ISSUE-7 families must stay in the exposition contract — the
+    conservation dashboard stacks healthcheck_goodput_lost_ratio under
+    the fleet goodput line, and a rename silently breaks it."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_goodput", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_goodput_lost_ratio",
+        "healthcheck_goodput_attribution_info",
+        "healthcheck_phase_timings_skipped_total",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
 def test_wallclock_banned_in_analysis_package(tmp_path):
     """analysis/ baselines are stamped on the injectable Clock so
     fake-clock tests can script exact warm-up windows — the same

@@ -202,6 +202,11 @@ PINNED_FAMILIES = {
     "healthcheck_phase_seconds": "histogram",
     "healthcheck_cadence_goodput": "gauge",
     "healthcheck_fleet_goodput_ratio": "gauge",
+    # goodput attribution families (ISSUE 7: lost-goodput decomposition
+    # — docs/observability.md "Goodput attribution")
+    "healthcheck_goodput_lost_ratio": "gauge",
+    "healthcheck_goodput_attribution_info": "gauge",
+    "healthcheck_phase_timings_skipped_total": "counter",
     "healthcheck_slo_availability_ratio": "gauge",
     "healthcheck_error_budget_remaining": "gauge",
     "healthcheck_slo_burn_rate": "gauge",
@@ -271,6 +276,9 @@ def exercise_every_family(collector):
     collector.record_fenced_write(0)
     collector.cadence_goodput.set(1.0)
     collector.set_fleet_goodput(1.0)
+    # goodput attribution families
+    collector.set_goodput_attribution({"ici": 0.0, "unknown": 0.0}, None)
+    collector.record_phase_timing_skipped("bad_value")
     collector.set_slo(
         "hc-a",
         "health",
@@ -552,6 +560,64 @@ def test_malformed_timings_entries_are_skipped(collector):
     # a non-object timings block is ignored wholesale, never raised
     bad = {"outputs": {"parameters": [{"name": "m", "value": '{"metrics": [], "timings": [1, 2]}'}]}}
     assert collector.record_custom_metrics("hc", bad) == 0
+    # the drops are COUNTED per reason (ISSUE 7 satellite): contract
+    # drift between probe and controller versions must be visible on
+    # /metrics, not only as a log warning
+    skipped = lambda reason: collector.sample_value(  # noqa: E731
+        "healthcheck_phase_timings_skipped_total", {"reason": reason}
+    )
+    assert skipped("bad_value") == 1.0
+    assert skipped("unnamed") == 1.0
+    assert skipped("not_object") == 1.0
+
+
+def test_parse_phase_timings_reads_without_recording(collector):
+    """The pure timings reader (feeds the result ring + attribution):
+    same skip policy as the recording path, zero registry effects."""
+    status = custom_status(timings={"compile": 30.0, "bad": "x", "": 1.0})
+    timings = MetricsCollector.parse_phase_timings(status)
+    assert timings == {"compile": 30.0}
+    assert (
+        collector.sample_value(
+            "healthcheck_phase_seconds_count",
+            {"healthcheck_name": "hc", "phase": "compile"},
+        )
+        is None
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_phase_timings_skipped_total", {"reason": "bad_value"}
+        )
+        is None
+    )
+    assert MetricsCollector.parse_phase_timings({}) == {}
+
+
+def test_goodput_attribution_info_series_follows_the_top_bucket(collector):
+    """The info series is one-hot on (version, top): a change of the
+    dominant bucket drops the stale series rather than leaving two 1s
+    on the scrape."""
+    labels = lambda top: {"version": "1", "top": top}  # noqa: E731
+    collector.set_goodput_attribution({"ici": 0.25, "hbm": 0.0}, "ici")
+    assert (
+        collector.sample_value("healthcheck_goodput_lost_ratio", {"subsystem": "ici"})
+        == 0.25
+    )
+    assert collector.sample_value(
+        "healthcheck_goodput_attribution_info", labels("ici")
+    ) == 1.0
+    collector.set_goodput_attribution({"ici": 0.0, "hbm": 0.1}, "hbm")
+    assert collector.sample_value(
+        "healthcheck_goodput_attribution_info", labels("ici")
+    ) is None
+    assert collector.sample_value(
+        "healthcheck_goodput_attribution_info", labels("hbm")
+    ) == 1.0
+    # nothing lost: the top label reads "none"
+    collector.set_goodput_attribution({"ici": 0.0, "hbm": 0.0}, None)
+    assert collector.sample_value(
+        "healthcheck_goodput_attribution_info", labels("none")
+    ) == 1.0
 
 
 def test_runtime_buckets_are_log_spaced_and_cover_multi_minute_probes(collector):

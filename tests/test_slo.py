@@ -330,6 +330,9 @@ FLEET_FIELDS = {
     "checks": int,
     "window_runs": int,
     "goodput_ratio": (int, float, type(None)),
+    # lost-goodput attribution block (ISSUE 7): the decomposition that
+    # sums to 1 - goodput_ratio (obs/attribution.py)
+    "goodput": dict,
     "generated_at": str,
     # resilience block (ISSUE 3): degraded mode, breaker verdict,
     # replay backlog, fleet-wide remedy budget
@@ -350,6 +353,9 @@ CHECK_FIELDS = {
     "state": str,  # healthy | flapping | quarantined
     # baseline-analysis verdict (ISSUE 4): None without an analysis: block
     "analysis": (dict, type(None)),
+    # lost-goodput attribution over the check's window (ISSUE 7): None
+    # while the window is empty
+    "attribution": (dict, type(None)),
     "remedy_budget_remaining": (int, type(None)),
     "last_status": str,
     "last_trace_id": str,
@@ -382,6 +388,46 @@ HISTORY_FIELDS = {
     # the run's numeric metric samples (ISSUE 4: detectors and /debug
     # endpoints read them from the ring)
     "metrics": dict,
+    # the run's phase timings + record-time attribution (ISSUE 7)
+    "timings": dict,
+    "bucket": str,
+    "why": str,
+}
+# the fleet.goodput / per-check attribution blocks (ISSUE 7), locked
+# like everything else here: the conservation dashboards stack these
+GOODPUT_FIELDS = {
+    "ratio": (int, float, type(None)),
+    "window_runs": int,
+    "lost_ratio": (int, float),
+    "lost_runs": dict,
+    "attribution": dict,
+    "top": (str, type(None)),
+    "version": int,
+}
+ATTRIBUTION_FIELDS = {
+    "window_runs": int,
+    "lost_runs": int,
+    "lost_ratio": (int, float),
+    "buckets": dict,
+    "counts": dict,
+    "top": (str, type(None)),
+    "why": str,
+}
+# one flight-recorder bundle (obs/flightrec.py), as served at
+# /debug/flightrec and written to --flight-dir JSONL
+BUNDLE_FIELDS = {
+    "id": str,
+    "kind": str,
+    "check": str,
+    "ts": str,
+    "trace_id": str,
+    "spans": list,
+    "results": list,
+    "baselines": (dict, type(None)),
+    "resilience": (dict, type(None)),
+    "sharding": (dict, type(None)),
+    "attribution": (dict, type(None)),
+    "extra": dict,
 }
 BREAKER_FIELDS = {
     "name": str,
@@ -411,10 +457,15 @@ def test_statusz_schema_contract():
     # Python objects
     payload = json.loads(json.dumps(fleet.statusz([with_slo, without])))
     assert_schema(payload["fleet"], FLEET_FIELDS, "fleet")
+    assert_schema(payload["fleet"]["goodput"], GOODPUT_FIELDS, "goodput")
     assert len(payload["checks"]) == 2
     for check in payload["checks"]:
         assert_schema(check, CHECK_FIELDS, "check")
         assert_schema(check["window"], WINDOW_FIELDS, "window")
+        if check["attribution"] is not None:
+            assert_schema(
+                check["attribution"], ATTRIBUTION_FIELDS, "attribution"
+            )
         for entry in check["history"]:
             assert_schema(entry, HISTORY_FIELDS, "history")
     slo_check = payload["checks"][0]
@@ -441,6 +492,44 @@ def test_statusz_schema_contract():
     assert payload["fleet"]["degraded"] is False
     assert payload["fleet"]["remedy_tokens"] == 2.0
     assert payload["fleet"]["status_writes_queued"] == 0
+
+
+def test_flight_bundle_schema_contract(tmp_path):
+    """The flight-recorder bundle schema (ISSUE 7), locked like the
+    statusz payload: /debug/flightrec clients and offline JSONL readers
+    parse the same shape, so renaming a field must be deliberate."""
+    from activemonitor_tpu.analysis import AnalysisEngine
+    from activemonitor_tpu.obs import FlightRecorder, Tracer
+    from activemonitor_tpu.resilience import ResilienceCoordinator
+
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    fleet.record(hc, ok=False, latency=2.0, workflow="wf-1")
+    recorder = FlightRecorder(clock, flight_dir=str(tmp_path))
+    recorder.tracer = Tracer(clock)
+    recorder.history = fleet.history
+    recorder.fleet = fleet
+    recorder.resilience = ResilienceCoordinator(clock, None)
+    recorder.analysis = AnalysisEngine(clock)
+    bundle = recorder.record(
+        "degraded-transition", key=hc.key, transition=("ok", "degraded")
+    )
+    # the contract is what a client parses: JSON round-trip first
+    doc = json.loads(json.dumps(bundle))
+    assert_schema(doc, BUNDLE_FIELDS, "bundle")
+    assert doc["kind"] == "degraded-transition"
+    assert doc["check"] == hc.key
+    for entry in doc["results"]:
+        assert_schema(entry, HISTORY_FIELDS, "bundle.results")
+    assert_schema(doc["attribution"], ATTRIBUTION_FIELDS, "bundle.attribution")
+    # tuples in extra were normalized to JSON shapes at record time:
+    # the in-memory ring serves exactly what the JSONL sink holds
+    assert doc["extra"] == {"transition": ["ok", "degraded"]}
+    # the durable JSONL line is the same document
+    [line] = list(FlightRecorder.read_jsonl(str(tmp_path / "flightrec.jsonl")))
+    assert_schema(line, BUNDLE_FIELDS, "jsonl bundle")
+    assert line["id"] == doc["id"]
 
 
 def test_statusz_history_is_a_bounded_tail():
@@ -757,7 +846,8 @@ def test_render_status_table_shapes_rows():
     header, row = lines[1], lines[2]
     assert header.split() == [
         "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
-        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "LAST", "TRACE",
+        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "WHY", "LAST",
+        "TRACE",
     ]
     cells = row.split()
     assert cells[0] == "hc-slo"
@@ -765,6 +855,9 @@ def test_render_status_table_shapes_rows():
     assert "6.00s" in row  # p95/p99
     # budget: f=0.5, allowed=0.2 -> remaining 1 - 2.5 = -150%
     assert "-150.0%" in row
+    # the WHY column carries the attribution headline: one failed run
+    # of two, no evidence -> unknown:50%
+    assert "unknown:50%" in row
 
 
 def test_render_status_table_empty_fleet():
