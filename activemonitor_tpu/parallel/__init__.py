@@ -1,4 +1,6 @@
-"""Device mesh + timed collective helpers."""
+"""Device mesh + timed collective helpers (XLA builtins in
+``collectives``, the explicit ppermute schedule zoo in ``schedules``,
+the message-size autotuner over both in ``autotune``)."""
 
 from activemonitor_tpu.parallel.collectives import (
     CollectiveResult,
@@ -14,11 +16,23 @@ from activemonitor_tpu.parallel.mesh import (
     make_1d_mesh,
     make_2d_mesh,
 )
+from activemonitor_tpu.parallel.schedules import (
+    all_gather_recdouble_bandwidth,
+    all_gather_ring_bandwidth,
+    all_reduce_recdouble_bandwidth,
+    all_reduce_rsag_bandwidth,
+    all_reduce_tree_bandwidth,
+)
 
 __all__ = [
     "CollectiveResult",
     "all_gather_bandwidth",
+    "all_gather_recdouble_bandwidth",
+    "all_gather_ring_bandwidth",
     "all_reduce_bandwidth",
+    "all_reduce_recdouble_bandwidth",
+    "all_reduce_rsag_bandwidth",
+    "all_reduce_tree_bandwidth",
     "all_to_all_bandwidth",
     "best_2d_shape",
     "device_info",

@@ -1,0 +1,337 @@
+"""Message-size autotuner over the collective algorithm zoo.
+
+The zoo (parallel/schedules.py) gives 2–3 schedules per collective,
+each winning a distinct latency-vs-bandwidth regime (Demystifying
+NCCL); this module picks the winner per **(collective, axis size,
+payload bucket, dtype)** from *measured* busbw — the PR-5 discipline:
+the decision table is evidence, serialized into the sweep probe's
+details and the bench artifact, never an asserted preference.
+
+Layers:
+
+- ``record()`` / ``lookup()`` — the in-process decision table. Keys
+  bucket payload bytes by powers of two (one decision per octave, so a
+  64 MB tuning point serves 48..96 MB gradients).
+- ``tune()`` — run every schedule across a payload grid on a live mesh
+  and record winners. The measurement function is injectable so unit
+  tests script fake timings and watch the decision flip across the
+  crossover without hardware.
+- ``crossover_points()`` — where the winner changes along a swept
+  grid (the per-topology crossovers the sweep probe reports).
+- ``all_reduce()`` / ``all_gather()`` — the tuned surface for
+  shard_map bodies: ``schedule="auto"`` consults the table at trace
+  time (decisions bake into the jitted computation; retune → retrace).
+
+No wall clocks here: the table stores busbw handed in by callers, so
+fake-timing tests stay deterministic.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.parallel import schedules as zoo
+from activemonitor_tpu.utils.compat import axis_size
+
+
+@dataclass(frozen=True)
+class TuneKey:
+    collective: str  # "allreduce" | "allgather"
+    axis_n: int  # devices along the reduced mesh axis
+    bucket: int  # floor(log2(payload bytes))
+    dtype: str  # canonical dtype name ("bfloat16", "float32", ...)
+
+
+@dataclass
+class Decision:
+    schedule: str  # winning schedule token ("xla", "rsag", ...)
+    busbw_gbps: float
+    runner_up: str = ""
+    margin: float = 1.0  # winner busbw / runner-up busbw (≥ 1)
+    per_schedule: Dict[str, float] = field(default_factory=dict)
+
+
+_TABLE: Dict[TuneKey, Decision] = {}
+
+
+def payload_bucket(payload_bytes: int) -> int:
+    """Power-of-two octave of the payload: one decision per doubling."""
+    return max(0, int(math.floor(math.log2(max(1, payload_bytes)))))
+
+
+def clear() -> None:
+    _TABLE.clear()
+
+
+def record(
+    collective: str,
+    axis_n: int,
+    payload_bytes: int,
+    dtype,
+    busbw_by_schedule: Dict[str, float],
+) -> Decision:
+    """Fold one measurement point into the table and return the
+    decision. ``busbw_by_schedule`` maps schedule token → busbw GB/s
+    (the NCCL-convention number, comparable across schedules)."""
+    if not busbw_by_schedule:
+        raise ValueError("no schedules measured")
+    ranked = sorted(
+        busbw_by_schedule.items(), key=lambda kv: kv[1], reverse=True
+    )
+    winner, best = ranked[0]
+    runner_up, second = ranked[1] if len(ranked) > 1 else ("", 0.0)
+    decision = Decision(
+        schedule=winner,
+        busbw_gbps=best,
+        runner_up=runner_up,
+        margin=(best / second) if second > 0 else 1.0,
+        per_schedule=dict(busbw_by_schedule),
+    )
+    key = TuneKey(
+        collective, int(axis_n), payload_bucket(payload_bytes),
+        jnp.dtype(dtype).name,
+    )
+    _TABLE[key] = decision
+    return decision
+
+
+def lookup(
+    collective: str,
+    axis_n: int,
+    payload_bytes: int,
+    dtype,
+    max_distance: int = 2,
+) -> Optional[str]:
+    """Winning schedule for the exact bucket, else the nearest tuned
+    bucket within ``max_distance`` octaves for the same (collective,
+    axis, dtype) — a 48 MB gradient should ride the 64 MB decision,
+    but a 4 KB scalar-ish payload must NOT ride a 64 MB cell from the
+    wrong side of the crossover; past the distance bound the caller
+    falls back to the XLA builtin."""
+    name = jnp.dtype(dtype).name
+    bucket = payload_bucket(payload_bytes)
+    exact = _TABLE.get(TuneKey(collective, int(axis_n), bucket, name))
+    if exact is not None:
+        return exact.schedule
+    near = [
+        k
+        for k in _TABLE
+        if k.collective == collective and k.axis_n == int(axis_n)
+        and k.dtype == name and abs(k.bucket - bucket) <= max_distance
+    ]
+    if not near:
+        return None
+    # equidistant octaves tie-break toward the smaller payload's
+    # decision (the latency-safe side of the crossover)
+    best = min(near, key=lambda k: (abs(k.bucket - bucket), k.bucket))
+    return _TABLE[best].schedule
+
+
+def table_as_dict(keys: Optional[Sequence[TuneKey]] = None) -> dict:
+    """JSON-serializable snapshot — the evidence block the sweep probe
+    and bench.py stamp into their artifacts. ``keys`` restricts the
+    snapshot (e.g. to the cells ONE tune() run measured, so a
+    long-lived process never stamps stale cells from earlier tunes as
+    this run's evidence)."""
+    selected = _TABLE if keys is None else {
+        k: _TABLE[k] for k in keys if k in _TABLE
+    }
+    out: dict = {}
+    for key, d in sorted(
+        selected.items(),
+        key=lambda kv: (kv[0].collective, kv[0].axis_n, kv[0].bucket),
+    ):
+        out[f"{key.collective}/n{key.axis_n}/2^{key.bucket}B/{key.dtype}"] = {
+            "schedule": d.schedule,
+            "busbw_gbps": round(d.busbw_gbps, 3),
+            "runner_up": d.runner_up,
+            "margin": round(d.margin, 3),
+            "per_schedule_busbw_gbps": {
+                s: round(v, 3) for s, v in d.per_schedule.items()
+            },
+        }
+    return out
+
+
+def crossover_points(
+    points: Iterable[Tuple[float, str]],
+) -> List[dict]:
+    """Where the winner flips along a swept payload grid.
+
+    ``points``: (payload_mb, winning schedule), any order. Returns one
+    entry per flip with the bracketing payloads — "rsag takes over from
+    tree between 4 and 16 MB" is the per-topology crossover the NCCL
+    paper catalogs."""
+    ordered = sorted(points)
+    flips = []
+    for (lo_mb, lo_s), (hi_mb, hi_s) in zip(ordered, ordered[1:]):
+        if lo_s != hi_s:
+            flips.append(
+                {
+                    "below_mb": lo_mb,
+                    "above_mb": hi_mb,
+                    "from": lo_s,
+                    "to": hi_s,
+                }
+            )
+    return flips
+
+
+# measurement functions per (collective, schedule token); injectable in
+# tune() so fake-timing tests can script regime flips
+def _default_benches() -> Dict[Tuple[str, str], Callable]:
+    from activemonitor_tpu.parallel import collectives as xla
+
+    return {
+        ("allreduce", "xla"): xla.all_reduce_bandwidth,
+        ("allreduce", "rsag"): zoo.all_reduce_rsag_bandwidth,
+        ("allreduce", "recdouble"): zoo.all_reduce_recdouble_bandwidth,
+        ("allreduce", "tree"): zoo.all_reduce_tree_bandwidth,
+        ("allgather", "xla"): xla.all_gather_bandwidth,
+        ("allgather", "ring"): zoo.all_gather_ring_bandwidth,
+        ("allgather", "recdouble"): zoo.all_gather_recdouble_bandwidth,
+    }
+
+
+# log-spaced payload grid ≈ 256 KB → 256 MB — the regimes the NCCL
+# paper's crossovers live in. Single source of truth: the sweep probe
+# re-exports this; edit it here.
+DEFAULT_SWEEP_SIZES_MB = (0.25, 1.0, 4.0, 16.0, 64.0, 256.0)
+
+
+@dataclass
+class TuneRun:
+    """One tune() invocation: raw busbw per (collective, size,
+    schedule) plus the exact table keys it recorded — the slice of the
+    global table that is THIS run's evidence."""
+
+    results: Dict[str, Dict[float, Dict[str, float]]]
+    keys: List[TuneKey]
+
+
+def tune(
+    mesh,
+    axis: str = "",
+    collectives: Sequence[str] = ("allreduce",),
+    sizes_mb: Sequence[float] = DEFAULT_SWEEP_SIZES_MB,
+    dtype=jnp.bfloat16,
+    iters: int = 3,
+    bench: Optional[Callable] = None,
+) -> TuneRun:
+    """Measure every schedule at every payload size and record winners.
+
+    ``bench(collective, schedule, mesh, axis, size_mb, dtype, iters)``
+    must return an object with ``busbw_gbps`` and ``payload_bytes``
+    (CollectiveResult shape) — tests inject a fake to script timings.
+    The decision table is updated as a side effect; the returned
+    ``TuneRun.keys`` identify exactly the cells this run wrote."""
+    schedules_for = {
+        "allreduce": zoo.ALL_REDUCE_SCHEDULES,
+        "allgather": zoo.ALL_GATHER_SCHEDULES,
+    }
+    unknown = [c for c in collectives if c not in schedules_for]
+    if unknown:
+        raise ValueError(
+            f"unknown collectives {unknown}; pick from "
+            f"{tuple(schedules_for)}"
+        )
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    benches = _default_benches()
+
+    def run_one(collective, schedule, size_mb):
+        if bench is not None:
+            return bench(collective, schedule, mesh, axis, size_mb, dtype, iters)
+        return benches[(collective, schedule)](
+            mesh, size_mb=size_mb, dtype=dtype, iters=iters, axis=axis
+        )
+
+    raw: dict = {}
+    keys: List[TuneKey] = []
+    for collective in collectives:
+        raw[collective] = {}
+        for size_mb in sizes_mb:
+            busbw: Dict[str, float] = {}
+            payload = int(size_mb * 1e6)
+            for schedule in schedules_for[collective]:
+                result = run_one(collective, schedule, size_mb)
+                busbw[schedule] = result.busbw_gbps
+                payload = result.payload_bytes
+            record(collective, n, payload, dtype, busbw)
+            keys.append(
+                TuneKey(
+                    collective, int(n), payload_bucket(payload),
+                    jnp.dtype(dtype).name,
+                )
+            )
+            raw[collective][size_mb] = busbw
+    return TuneRun(results=raw, keys=keys)
+
+
+# ---------------------------------------------------------------------------
+# the tuned surface — called INSIDE shard_map bodies
+# ---------------------------------------------------------------------------
+
+_ALL_REDUCE_IMPL = {
+    "rsag": zoo.all_reduce_rsag,
+    "recdouble": zoo.all_reduce_recdouble,
+    "tree": zoo.all_reduce_tree,
+}
+
+_ALL_GATHER_IMPL = {
+    "ring": zoo.all_gather_ring,
+    "recdouble": zoo.all_gather_recdouble,
+}
+
+
+def all_reduce(x, axis_name: str, schedule: str = "auto", n: int | None = None):
+    """psum with a schedule knob, for shard_map bodies. ``"auto"``
+    consults the decision table (trace-time: the choice bakes into the
+    jitted computation) and falls back to the XLA builtin when nothing
+    is tuned within 2 octaves of this (axis size, payload, dtype) —
+    or when the input has no leading axis to chunk (scalars always
+    ride the builtin)."""
+    n = int(n) if n is not None else axis_size(axis_name)
+    if schedule == "auto":
+        if x.ndim == 0:
+            schedule = "xla"  # nothing to chunk/rotate on a scalar
+        else:
+            payload = x.size * jnp.dtype(x.dtype).itemsize
+            schedule = lookup("allreduce", n, payload, x.dtype) or "xla"
+    if schedule == "xla":
+        return jax.lax.psum(x, axis_name)
+    try:
+        impl = _ALL_REDUCE_IMPL[schedule]
+    except KeyError:
+        raise ValueError(
+            f"unknown all-reduce schedule {schedule!r}; pick from "
+            f"{('auto',) + zoo.ALL_REDUCE_SCHEDULES}"
+        ) from None
+    return impl(x, axis_name, n)
+
+
+def all_gather(x, axis_name: str, schedule: str = "auto", n: int | None = None):
+    """Tiled all-gather with a schedule knob (output [n·rows, ...] in
+    device order, like ``lax.all_gather(..., tiled=True)``)."""
+    n = int(n) if n is not None else axis_size(axis_name)
+    if schedule == "auto":
+        if x.ndim == 0:
+            schedule = "xla"  # no leading axis to tile
+        else:
+            payload = x.size * jnp.dtype(x.dtype).itemsize * n
+            schedule = lookup("allgather", n, payload, x.dtype) or "xla"
+    if schedule == "xla":
+        return jax.lax.all_gather(x, axis_name, tiled=True)
+    try:
+        impl = _ALL_GATHER_IMPL[schedule]
+    except KeyError:
+        raise ValueError(
+            f"unknown all-gather schedule {schedule!r}; pick from "
+            f"{('auto',) + zoo.ALL_GATHER_SCHEDULES}"
+        ) from None
+    return impl(x, axis_name, n)

@@ -18,6 +18,11 @@ Bandwidth conventions follow NCCL-tests:
 - *busbw* = algbw × 2(n-1)/n for all-reduce (ring transfer volume),
   algbw × (n-1)/n for all-gather / reduce-scatter / all-to-all — the
   number comparable against rated link bandwidth.
+
+This module times the XLA builtins; the explicit ppermute schedule
+zoo (ring reduce-scatter+all-gather, recursive doubling, tree) lives
+in parallel/schedules.py and reuses ``_bench`` so both report through
+the same ``CollectiveResult``/busbw accounting.
 """
 
 from __future__ import annotations

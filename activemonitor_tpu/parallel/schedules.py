@@ -1,0 +1,340 @@
+"""Collective algorithm zoo — explicit ppermute schedules for
+all-reduce / all-gather (ROADMAP item 2, the Demystifying-NCCL family).
+
+``parallel/collectives.py`` times the XLA-built-in collectives (psum /
+all_gather) plus raw ring hops; this module implements the classical
+alternative *schedules* as explicit ``ppermute`` compositions so each
+regime of the latency-vs-bandwidth tradeoff has a measurable
+representative:
+
+- **ring reduce-scatter + all-gather** (``all_reduce_rsag``) — the
+  NCCL ring decomposition: 2(n−1) rounds of (shard/n)-sized chunks.
+  Bandwidth-optimal (per-device wire volume 2(n−1)/n × S, the
+  theoretical minimum), latency-poor (rounds grow linearly with n).
+- **recursive doubling/halving** (``all_reduce_recdouble``) — log2(n)
+  full-payload pairwise exchanges. Latency-optimal (fewest rounds),
+  bandwidth-poor (log2(n) × S wire volume). Power-of-two native; other
+  sizes fold the remainder ranks in/out with one extra round each way.
+- **binomial tree reduce + broadcast** (``all_reduce_tree``) —
+  2·ceil(log2 n) rounds, each a one-direction full-payload hop; the
+  logical tree NCCL uses for small payloads on high-diameter rings.
+- **ring all-gather** (``all_gather_ring``) and **recursive-doubling
+  all-gather** (``all_gather_recdouble``) — the same two regimes for
+  the gather family (recdouble falls back to the ring off power-of-two
+  sizes, where block-doubling has no clean pairing).
+
+Every schedule is shape-polymorphic (rsag pads odd rows internally),
+numerically equivalent to the ``jax.lax.psum`` / ``all_gather``
+reference (tests/test_schedules.py: allclose across meshes n∈{2,3,4,8},
+bitwise where the schedule only moves data), and traced through the
+``_hop`` choke point so the PR-5 hop-budget contract applies: each
+schedule sends exactly its theoretical round count (``theoretical_hops``)
+— asserted by tests, not asserted in comments.
+
+Timed wrappers (``*_bandwidth``) reuse the chain-delta scaffold and
+``CollectiveResult``/busbw accounting from parallel/collectives.py, so
+zoo numbers are directly comparable against the XLA baselines; the
+per-schedule *rated ceilings* (wire volume ≠ busbw convention) live in
+probes/collectives._rated_busbw.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from activemonitor_tpu.parallel.collectives import CollectiveResult, _bench
+from activemonitor_tpu.utils.compat import axis_size
+from jax.sharding import Mesh
+
+
+# Schedule tokens, in the spelling the probes/autotuner/docs share.
+# "xla" is the psum/all_gather builtin the zoo is raced against.
+ALL_REDUCE_SCHEDULES = ("xla", "rsag", "recdouble", "tree")
+ALL_GATHER_SCHEDULES = ("xla", "ring", "recdouble")
+
+# Test hook (the ops/ring_attention.py pattern): when set to a list,
+# every ppermute round a schedule issues appends (schedule_tag, round).
+# Schedules unroll python loops, so one traced application logs each
+# round individually and the log length IS the hop count.
+_HOP_LOG = None
+
+
+def _hop(x, axis_name, perm, tag, step):
+    """One ppermute round, routed through a single site so the traced
+    hop counter sees every transfer a schedule issues."""
+    if _HOP_LOG is not None:
+        _HOP_LOG.append((tag, step))
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def _resolve_n(axis_name, n=None) -> int:
+    return int(n) if n is not None else axis_size(axis_name)
+
+
+def theoretical_hops(schedule: str, n: int, collective: str = "allreduce") -> int:
+    """Rounds (ppermute calls) schedule issues on an n-device axis —
+    the contract the hop-budget tests pin.
+
+    The public token "recdouble" names a different algorithm per
+    family (ALL_REDUCE_SCHEDULES vs ALL_GATHER_SCHEDULES), so pass
+    ``collective="allgather"`` for the gather variant — its non-pow2
+    fallback is the ring (n−1 hops), not the fold/unfold."""
+    if collective == "allgather":
+        schedule = {"recdouble": "ag-recdouble"}.get(schedule, schedule)
+    if n <= 1:
+        return 0
+    p = 1 << (n.bit_length() - 1)  # largest power of two ≤ n
+    r = n - p
+    if schedule == "rsag":
+        return 2 * (n - 1)
+    if schedule == "recdouble":
+        return int(math.log2(p)) + (2 if r else 0)
+    if schedule == "tree":
+        return 2 * math.ceil(math.log2(n))
+    if schedule == "ring":  # all-gather ring
+        return n - 1
+    if schedule == "ag-recdouble":
+        # falls back to the ring off power-of-two sizes
+        return int(math.log2(n)) if r == 0 else n - 1
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+# ---------------------------------------------------------------------------
+# all-reduce schedules (per-shard x → per-shard sum over axis)
+# ---------------------------------------------------------------------------
+
+
+def all_reduce_rsag(x, axis_name: str, n: int | None = None):
+    """Ring reduce-scatter + all-gather (the NCCL ring decomposition).
+
+    Phase 1 rotates (shard/n)-chunks clockwise n−1 times, accumulating
+    so device i ends holding the fully-reduced chunk (i+1) mod n; phase
+    2 rotates the reduced chunks n−1 more times to rebuild the full
+    sum everywhere. 2(n−1) rounds of S/n bytes — the bandwidth-optimal
+    2(n−1)/n × S wire volume. Rows that don't divide n are zero-padded
+    for the rotation and trimmed after (zeros are psum-neutral).
+    """
+    n = _resolve_n(axis_name, n)
+    if n == 1:
+        return x
+    rows = x.shape[0]
+    pad = (-rows) % n
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+    chunk = x.shape[0] // n
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def take(j):
+        return jax.lax.dynamic_slice_in_dim(x, j * chunk, chunk, axis=0)
+
+    # reduce-scatter: after round s the arriving partial is of chunk
+    # (idx − s − 1) mod n; add the local copy and pass it on
+    buf = take(idx)
+    for s in range(n - 1):
+        buf = _hop(buf, axis_name, perm, "rsag-rs", s)
+        buf = buf + take((idx - s - 1) % n)
+    # all-gather: own reduced chunk is (idx + 1) mod n; each further
+    # round delivers chunk (idx − s) mod n from the left neighbor
+    out = jnp.zeros_like(x)
+    out = jax.lax.dynamic_update_slice_in_dim(
+        out, buf, ((idx + 1) % n) * chunk, axis=0
+    )
+    cur = buf
+    for s in range(n - 1):
+        cur = _hop(cur, axis_name, perm, "rsag-ag", s)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, cur, ((idx - s) % n) * chunk, axis=0
+        )
+    return out[:rows] if pad else out
+
+
+def all_reduce_recdouble(x, axis_name: str, n: int | None = None):
+    """Recursive doubling: log2(n) full-payload pairwise exchanges
+    (partner = idx XOR 2^s), latency-optimal. Off power-of-two sizes
+    the r = n − 2^⌊log2 n⌋ remainder ranks fold their vector into rank
+    (idx − p) first and receive the finished sum back last — one extra
+    round each way, the standard MPI_Allreduce fixup."""
+    n = _resolve_n(axis_name, n)
+    if n == 1:
+        return x
+    p = 1 << (n.bit_length() - 1)
+    r = n - p
+    idx = jax.lax.axis_index(axis_name)
+    step = 0
+    if r:
+        # fold: ranks p+j send into j (non-destinations receive zeros)
+        fold = [(p + j, j) for j in range(r)]
+        x = x + _hop(x, axis_name, fold, "recdouble-fold", step)
+        step += 1
+    bit = 1
+    while bit < p:
+        pairs = [(i, i ^ bit) for i in range(p)]
+        x = x + _hop(x, axis_name, pairs, "recdouble-xchg", step)
+        bit <<= 1
+        step += 1
+    if r:
+        # unfold: ranks j broadcast the finished sum back to p+j
+        unfold = [(j, p + j) for j in range(r)]
+        got = _hop(x, axis_name, unfold, "recdouble-unfold", step)
+        x = jnp.where(idx >= p, got, x)
+    return x
+
+
+def all_reduce_tree(x, axis_name: str, n: int | None = None):
+    """Binomial-tree reduce to rank 0, then binomial broadcast back:
+    2·ceil(log2 n) one-direction full-payload rounds. Works for any n
+    (ranks whose partner would fall off the end just sit the round
+    out); the latency/bandwidth middle ground NCCL's tree algorithm
+    occupies."""
+    n = _resolve_n(axis_name, n)
+    if n == 1:
+        return x
+    rounds = math.ceil(math.log2(n))
+    idx = jax.lax.axis_index(axis_name)
+    # reduce: at round s, ranks ≡ 2^s (mod 2^{s+1}) send down to
+    # idx − 2^s and retire; non-receivers add zeros
+    for s in range(rounds):
+        stride = 1 << s
+        pairs = [
+            (i, i - stride) for i in range(n) if i % (2 * stride) == stride
+        ]
+        x = x + _hop(x, axis_name, pairs, "tree-reduce", s)
+    # broadcast: mirror image, receivers REPLACE their (stale) vector
+    for s in reversed(range(rounds)):
+        stride = 1 << s
+        pairs = [
+            (i, i + stride)
+            for i in range(n)
+            if i % (2 * stride) == 0 and i + stride < n
+        ]
+        got = _hop(x, axis_name, pairs, "tree-bcast", s)
+        x = jnp.where(idx % (2 * stride) == stride, got, x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# all-gather schedules (per-shard x[rows,...] → concatenated [n*rows,...])
+# ---------------------------------------------------------------------------
+
+
+def all_gather_ring(x, axis_name: str, n: int | None = None):
+    """Ring all-gather: rotate shards clockwise n−1 times, placing each
+    arrival at its owner's slot — tiled output ([n·rows, ...], device
+    order), bitwise-identical to ``lax.all_gather(..., tiled=True)``."""
+    n = _resolve_n(axis_name, n)
+    if n == 1:
+        return x
+    rows = x.shape[0]
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((n * rows,) + x.shape[1:], x.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(out, x, idx * rows, axis=0)
+    cur = x
+    for s in range(n - 1):
+        cur = _hop(cur, axis_name, perm, "ag-ring", s)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, cur, ((idx - s - 1) % n) * rows, axis=0
+        )
+    return out
+
+
+def all_gather_recdouble(x, axis_name: str, n: int | None = None):
+    """Recursive-doubling all-gather: log2(n) exchanges, the gathered
+    block doubling each round (partner = idx XOR 2^s; the half owning
+    the lower ranks prepends what it receives). Power-of-two only —
+    other sizes fall back to the ring schedule, where the ISSUE-pinned
+    hop contract records n−1 ring hops instead."""
+    n = _resolve_n(axis_name, n)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        return all_gather_ring(x, axis_name, n)
+    idx = jax.lax.axis_index(axis_name)
+    g = x
+    bit = 1
+    step = 0
+    while bit < n:
+        pairs = [(i, i ^ bit) for i in range(n)]
+        got = _hop(g, axis_name, pairs, "ag-recdouble", step)
+        # partner above me: my block comes first; partner below: second
+        g = jnp.where(
+            (idx & bit) == 0,
+            jnp.concatenate([g, got], axis=0),
+            jnp.concatenate([got, g], axis=0),
+        )
+        bit <<= 1
+        step += 1
+    return g
+
+
+# ---------------------------------------------------------------------------
+# timed wrappers — CollectiveResult/busbw accounting shared with the
+# XLA baselines (parallel/collectives._bench)
+# ---------------------------------------------------------------------------
+
+
+def _allreduce_bench(name: str, schedule_fn):
+    def bench(
+        mesh: Mesh,
+        size_mb: float = 64.0,
+        dtype=jnp.bfloat16,
+        iters: int = 5,
+        axis: str = "",
+    ) -> CollectiveResult:
+        def make_body(n, ax):
+            inv_n = jnp.asarray(1.0 / n, dtype)
+            return lambda x: schedule_fn(x, ax, n) * inv_n  # mean: stable chain
+
+        return _bench(
+            name, mesh, axis, size_mb, dtype, iters, make_body,
+            rows_multiple_of_n=True,  # time the rotation, not the padding
+            busbw_factor=lambda n: 2 * (n - 1) / n,
+        )
+
+    return bench
+
+
+all_reduce_rsag_bandwidth = _allreduce_bench("all_reduce_rsag", all_reduce_rsag)
+all_reduce_recdouble_bandwidth = _allreduce_bench(
+    "all_reduce_recdouble", all_reduce_recdouble
+)
+all_reduce_tree_bandwidth = _allreduce_bench("all_reduce_tree", all_reduce_tree)
+
+
+def _allgather_bench(name: str, schedule_fn):
+    def bench(
+        mesh: Mesh,
+        size_mb: float = 64.0,
+        dtype=jnp.bfloat16,
+        iters: int = 5,
+        axis: str = "",
+    ) -> CollectiveResult:
+        def make_body(n, ax):
+            inv_n = jnp.asarray(1.0 / n, dtype)
+
+            def body(x):
+                g = schedule_fn(x, ax, n)  # [n*rows, cols]
+                return jnp.sum(g.reshape((n,) + x.shape), axis=0) * inv_n
+
+            return body
+
+        n = mesh.shape[axis or mesh.axis_names[0]]
+        return _bench(
+            name, mesh, axis, size_mb, dtype, iters, make_body,
+            payload_mult=float(n),  # NCCL all-gather: total gathered data
+            busbw_factor=lambda n: (n - 1) / n,
+        )
+
+    return bench
+
+
+all_gather_ring_bandwidth = _allgather_bench("all_gather_ring", all_gather_ring)
+all_gather_recdouble_bandwidth = _allgather_bench(
+    "all_gather_recdouble", all_gather_recdouble
+)

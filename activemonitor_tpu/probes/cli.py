@@ -51,11 +51,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--threshold", type=float, default=0.9)
     p.add_argument("--no-ring", action="store_true")
+    p.add_argument(
+        "--schedules",
+        default="",
+        help="comma-separated zoo schedules (rsag,recdouble,tree) to "
+        "also measure, each against its own algorithmic ceiling",
+    )
 
     p = sub.add_parser(
         "collectives",
         help="full collective sweep: all-reduce/-gather, reduce-scatter, "
-        "all-to-all, ring hop",
+        "all-to-all, ring hop, plus the explicit-schedule zoo and the "
+        "message-size autotune sweep (--sweep)",
     )
     p.add_argument("--size-mb", type=float, default=64.0)
     p.add_argument("--iters", type=int, default=5)
@@ -65,6 +72,31 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="measure each 2D-mesh axis separately (localizes which "
         "torus direction is degraded)",
+    )
+    p.add_argument(
+        "--cases",
+        default="",
+        help="comma-separated case subset (builtin cases and/or zoo "
+        "schedules, e.g. allreduce,allreduce-rsag); works with "
+        "--per-axis too",
+    )
+    p.add_argument(
+        "--sweep",
+        action="store_true",
+        help="message-size autotune sweep: race every schedule across "
+        "a log-spaced payload grid, report crossovers + the decision "
+        "table",
+    )
+    p.add_argument(
+        "--sweep-sizes-mb",
+        default="",
+        help="comma-separated payload grid for --sweep (default "
+        "0.25..256 MB log-spaced)",
+    )
+    p.add_argument(
+        "--quick",
+        action="store_true",
+        help="--sweep budget mode: 2 payload sizes, reduced iters",
     )
 
     p = sub.add_parser("compile-smoke", help="XLA compile smoke test")
@@ -322,17 +354,39 @@ def _dispatch(args) -> int:
             iters=args.iters,
             threshold=args.threshold,
             include_ring=not args.no_ring,
+            schedules=tuple(s for s in args.schedules.split(",") if s),
         )
     elif args.probe == "collectives":
         from activemonitor_tpu.probes import collectives
 
-        if args.per_axis:
+        cases = tuple(c for c in args.cases.split(",") if c) or None
+        if args.sweep:
+            if cases or args.per_axis:
+                # refuse rather than silently ignore: the sweep races
+                # the full schedule set on the 1D mesh by design
+                raise SystemExit(
+                    "--sweep races the whole schedule zoo on the 1D mesh; "
+                    "it does not combine with --cases/--per-axis"
+                )
+            sizes = tuple(
+                float(s) for s in args.sweep_sizes_mb.split(",") if s
+            ) or None
+            result = collectives.sweep(
+                sizes_mb=sizes, iters=args.iters, quick=args.quick
+            )
+        elif args.per_axis:
             result = collectives.run_per_axis(
-                size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
+                size_mb=args.size_mb,
+                iters=args.iters,
+                threshold=args.threshold,
+                cases=cases,
             )
         else:
             result = collectives.run(
-                size_mb=args.size_mb, iters=args.iters, threshold=args.threshold
+                size_mb=args.size_mb,
+                iters=args.iters,
+                threshold=args.threshold,
+                cases=cases,
             )
     elif args.probe == "compile-smoke":
         from activemonitor_tpu.probes import compile_smoke

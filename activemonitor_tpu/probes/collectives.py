@@ -1,4 +1,5 @@
-"""Collectives-sweep probe — the full XLA collective set over ICI.
+"""Collectives-sweep probe — the full XLA collective set over ICI,
+plus the explicit-schedule zoo and its message-size autotune sweep.
 
 The ici-allreduce probe answers the north-star question; this probe
 characterizes the whole communication surface the parallelism code
@@ -9,21 +10,39 @@ ops/moe.py) and single-hop ppermute (ring attention, ops/ring_attention
 e.g. a routing fault that halves the bisection but leaves neighbor
 links intact — shows up here before it shows up as slow training.
 
+On top of the XLA builtins, the zoo cases time the explicit ppermute
+schedules (parallel/schedules.py): ring reduce-scatter+all-gather,
+recursive doubling, tree reduce-broadcast for all-reduce; ring and
+recursive-doubling all-gather. Each gets a **schedule-specific** rated
+ceiling below (its own wire volume and direction usage), so a schedule
+merely hitting its own algorithmic ceiling is distinguishable from a
+degraded link.
+
 Exports, per collective C in {allreduce, allgather, reducescatter,
-alltoall, ringhop, ringhop-bidir} (prefix ``collective-``, distinct
-from the north-star probe's ``ici-`` gauges so a merged battery
-contract never carries duplicate names):
+alltoall, ringhop, ringhop-bidir} plus the zoo cases
+{allreduce-rsag, allreduce-recdouble, allreduce-tree, allgather-ring,
+allgather-recdouble} (prefix ``collective-``, distinct from the
+north-star probe's ``ici-`` gauges so a merged battery contract never
+carries duplicate names):
 
 - ``collective-<C>-busbw-gbps`` — NCCL busbw convention
-- ``collective-<C>-fraction-of-rated`` — busbw / rated ceiling (TPU)
+- ``collective-<C>-fraction-of-rated`` — busbw / schedule ceiling (TPU)
+
+``sweep()`` is the message-size autotune entrypoint: every schedule
+across a log-spaced payload grid (~256 KB → 256 MB), winners folded
+into the parallel/autotune decision table, crossover points located,
+and the whole table serialized into ``details`` as evidence. Sweep
+headline gauges: ``collective-sweep-zoo-best-win`` (best zoo busbw /
+XLA-builtin busbw over the grid — >1 means a zoo schedule measurably
+beat the builtin somewhere) and ``collective-sweep-crossovers``
+(winner flips along the grid). ``quick=True`` (2 payload sizes,
+reduced iters) keeps CPU-interpret/tier-1 runs cheap.
 
 Rated ceilings assume the same bidirectional-ring model as probes/ici:
-2 x unidir link bw for the ring collectives AND for the bidirectional
-hop (both directions of each link active at once — the ring-attention
-variant="bidir" wire pattern), 1 x for a single unidirectional hop —
-except all-to-all, which is bisection-bound on a ring: each half
-exchanges n*S/4 bytes per direction across the cut's 2 links, capping
-busbw at 8*B*(n-1)/n^2.
+2 x unidir link bw for the XLA ring collectives AND for the
+bidirectional hop, 1 x for a single unidirectional hop; all-to-all is
+bisection-bound (8*B*(n-1)/n^2); the zoo schedules carry their own
+per-algorithm ceilings (see _rated_busbw).
 
 Verdict: every collective's fraction must clear ``threshold`` (rated
 hardware, >1 device); otherwise informational-pass, like the other
@@ -37,6 +56,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from activemonitor_tpu.parallel import autotune
 from activemonitor_tpu.parallel.collectives import (
     CollectiveResult,
     all_gather_bandwidth,
@@ -46,13 +66,29 @@ from activemonitor_tpu.parallel.collectives import (
     ppermute_ring_bandwidth,
     reduce_scatter_bandwidth,
 )
-from activemonitor_tpu.parallel.mesh import make_1d_mesh, make_2d_mesh
+from activemonitor_tpu.parallel.mesh import best_2d_shape, make_1d_mesh, make_2d_mesh
+from activemonitor_tpu.parallel.schedules import (
+    all_gather_recdouble_bandwidth,
+    all_gather_ring_bandwidth,
+    all_reduce_rsag_bandwidth,
+    all_reduce_recdouble_bandwidth,
+    all_reduce_tree_bandwidth,
+    theoretical_hops,
+)
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 
+# the XLA-builtin set (the default `run` sweep — cost-stable since PR 5)
 ALL_CASES = (
     "allreduce", "allgather", "reducescatter", "alltoall", "ringhop",
     "ringhop-bidir",
+)
+
+# the explicit-schedule zoo (parallel/schedules.py) — opt-in cases for
+# `run`, always raced by `sweep`
+ZOO_CASES = (
+    "allreduce-rsag", "allreduce-recdouble", "allreduce-tree",
+    "allgather-ring", "allgather-recdouble",
 )
 
 _BENCH: Dict[str, Callable] = {
@@ -62,12 +98,52 @@ _BENCH: Dict[str, Callable] = {
     "alltoall": all_to_all_bandwidth,
     "ringhop": ppermute_ring_bandwidth,
     "ringhop-bidir": ppermute_bidir_bandwidth,
+    "allreduce-rsag": all_reduce_rsag_bandwidth,
+    "allreduce-recdouble": all_reduce_recdouble_bandwidth,
+    "allreduce-tree": all_reduce_tree_bandwidth,
+    "allgather-ring": all_gather_ring_bandwidth,
+    "allgather-recdouble": all_gather_recdouble_bandwidth,
 }
+
+# sweep headline gauges — contract spelling (pinned by tests/test_lint)
+SWEEP_ZOO_BEST_WIN_METRIC = "collective-sweep-zoo-best-win"
+SWEEP_CROSSOVERS_METRIC = "collective-sweep-crossovers"
 
 
 def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
     """Achievable-busbw ceiling on a bidirectional ring of n devices
-    with per-direction link bandwidth ``unidir_gbps`` (see module doc)."""
+    with per-direction link bandwidth ``unidir_gbps``.
+
+    XLA builtins keep the module-doc ring model. Zoo schedules get
+    **per-algorithm** ceilings from their own wire volume and link
+    usage ON THAT RING — non-neighbor exchanges pay ring contention,
+    not just round count — so "losing to its own ceiling" (an
+    algorithmic property) is distinguishable from a slow link:
+
+    - ``allreduce-rsag``: unidirectional neighbor ring, 2(n−1)/n × S
+      volume one way — busbw ceiling is ONE link direction (half the
+      XLA bidir ring's 2x).
+    - ``allreduce-recdouble``: round s exchanges full payloads with
+      the partner 2^s ring-hops away, so every link carries 2^s
+      concurrent flows: per-direction link time ≥ Σ 2^s · S/B =
+      (p−1)·S/B (+ ~2 neighbor-ish rounds folding the non-pow2
+      remainder in/out) ⇒ busbw ≤ 2(n−1)/n · B/(p−1+fold). The
+      latency-optimal schedule's bandwidth ceiling collapses as n
+      grows — by design, and now by routing too.
+    - ``allreduce-tree``: 2·ceil(log2 n) rounds; each round's
+      messages span disjoint ring segments and pipeline through
+      intermediates, so a round costs ~S/B ⇒ busbw ≤
+      2(n−1)/n · B/rounds.
+    - ``allgather-ring``: per-device send volume is (n−1)/n of the
+      gathered payload over neighbor links ⇒ one link direction.
+    - ``allgather-recdouble``: block at round s is 2^s shards crossing
+      2^s links ⇒ per-link (n−1)·shard/B both ways — same ceiling as
+      the ring (its win is rounds/latency, never bandwidth).
+
+    These are modeled ceilings (routing assumptions included), not
+    rated-silicon guarantees — which is why zoo fractions are
+    informational in ``_emit`` and never gate the verdict.
+    """
     if name == "ringhop":
         return unidir_gbps
     if name == "ringhop-bidir":
@@ -76,6 +152,16 @@ def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
         return 2 * unidir_gbps
     if name == "alltoall":
         return 8 * unidir_gbps * (n - 1) / n**2
+    if name in ("allreduce-rsag", "allgather-ring", "allgather-recdouble"):
+        return unidir_gbps
+    if name == "allreduce-recdouble":
+        p = 1 << (max(2, n).bit_length() - 1)  # largest pow2 ≤ n
+        fold = 2 if n - p else 0
+        link_rounds = (p - 1) + fold  # Σ 2^s contention + fold/unfold
+        return 2 * (n - 1) / n * unidir_gbps / link_rounds
+    if name == "allreduce-tree":
+        rounds = max(1, theoretical_hops("tree", n))
+        return 2 * (n - 1) / n * unidir_gbps / rounds
     return 2 * unidir_gbps
 
 
@@ -90,12 +176,19 @@ def _emit(
     ``entries``: (label, base_case, ring_n, result) — the label is the
     metric suffix ("allreduce" or "allreduce-data"), the base case picks
     the rated comparator, ring_n its ring size. ``context`` names the
-    measured surface in the summary."""
+    measured surface in the summary.
+
+    Zoo-schedule fractions are exported but NEVER gate the verdict:
+    their denominators are modeled algorithmic ceilings (routing
+    assumptions included, see _rated_busbw), and a modeling error must
+    misread as an off gauge, not a failed HealthCheck. The XLA-builtin
+    cases keep the rated-silicon comparison and the verdict."""
     devices = jax.devices()
     rated = rated_for(devices[0].device_kind)
     on_tpu = devices[0].platform == "tpu"
     metrics: List[ProbeMetric] = []
     fractions: Dict[str, float] = {}
+    verdict_fractions: Dict[str, float] = {}
     for label, base_case, ring_n, result in entries:
         key = label.replace("-", "_")
         metrics.append(
@@ -110,22 +203,29 @@ def _emit(
             rated_busbw = _rated_busbw(base_case, rated.ici_unidir_gbps, ring_n)
             fraction = result.busbw_gbps / rated_busbw
             fractions[label] = fraction
+            if base_case not in ZOO_CASES:
+                verdict_fractions[label] = fraction
             metrics.append(
                 ProbeMetric(
                     f"collective-{label}-fraction-of-rated",
                     fraction,
-                    help=f"{result.name} busbw / achievable ring ceiling",
+                    help=f"{result.name} busbw / schedule-specific ring ceiling"
+                    + (" (informational)" if base_case in ZOO_CASES else ""),
                 )
             )
             details[f"{key}_fraction_of_rated"] = round(fraction, 3)
 
     if fractions:
-        worst = min(fractions, key=fractions.get)
-        ok = fractions[worst] >= threshold
+        # the verdict (and the summary's "worst") judge only the
+        # rated-silicon comparisons; zoo ceilings are informational
+        judged = verdict_fractions or fractions
+        worst = min(judged, key=judged.get)
+        ok = not verdict_fractions or verdict_fractions[worst] >= threshold
         summary = (
-            f"{context}: worst {worst} at {fractions[worst]:.0%} of "
+            f"{context}: worst {worst} at {judged[worst]:.0%} of "
             f"rated {rated.generation}"
             + ("" if ok else f" (< {threshold:.0%} threshold)")
+            + ("" if verdict_fractions else " (zoo ceilings: informational)")
         )
     else:
         ok = True
@@ -137,19 +237,33 @@ def _emit(
     return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
 
 
+def _validate_cases(cases: Sequence[str]) -> Tuple[str, ...]:
+    cases = tuple(cases)
+    unknown = [c for c in cases if c not in _BENCH]
+    if unknown:
+        raise ValueError(
+            f"unknown collectives {unknown}; pick from {ALL_CASES + ZOO_CASES}"
+        )
+    return cases
+
+
 def run_per_axis(
     size_mb: float = 64.0,
     iters: int = 5,
     threshold: float = 0.8,
+    cases: Optional[Sequence[str]] = None,
 ) -> ProbeResult:
-    """Per-axis variant over the 2D mesh: all-reduce and single-hop
-    ppermute restricted to EACH mesh axis. The mesh is built with
+    """Per-axis variant over the 2D mesh: the chosen collectives
+    restricted to EACH mesh axis (default: all-reduce + single-hop
+    ppermute; any ``_BENCH`` case — including zoo schedules — can be
+    threaded through ``cases``). The mesh is built with
     physical-topology alignment (parallel/mesh.make_2d_mesh uses
     mesh_utils.create_device_mesh on TPU), so on a real slice the two
     axes ride different torus dimensions and a degradation confined to
     one link direction shows up as one axis's fraction dropping while
     the other stays healthy — `collectives` alone can only say "slow",
     this says "slow WHERE"."""
+    cases = _validate_cases(cases or ("allreduce", "ringhop"))
     devices = jax.devices()
     n = len(devices)
     if n < 4:
@@ -157,16 +271,21 @@ def run_per_axis(
             ok=True,
             summary=f"per-axis sweep skipped: {n} device(s), no 2D mesh",
             metrics=[],
-            details={"devices": n, "skipped": True},
+            details={
+                "devices": n,
+                "skipped": True,
+                # the shape a 2D mesh WOULD have taken — so a skip in a
+                # fleet rollup still says what topology was absent
+                "mesh": dict(zip(("data", "model"), best_2d_shape(n))),
+            },
         )
     mesh = make_2d_mesh()
     entries = [
         (f"{name}-{axis}", name, mesh.shape[axis],
-         bench(mesh, size_mb=size_mb, iters=iters, axis=axis))
+         _BENCH[name](mesh, size_mb=size_mb, iters=iters, axis=axis))
         for axis in mesh.axis_names
         if mesh.shape[axis] >= 2  # nothing to move along a singleton axis
-        for name, bench in (("allreduce", all_reduce_bandwidth),
-                            ("ringhop", ppermute_ring_bandwidth))
+        for name in cases
     ]
     details = {
         "devices": n,
@@ -184,10 +303,7 @@ def run(
     threshold: float = 0.8,
     cases: Optional[Sequence[str]] = None,
 ) -> ProbeResult:
-    cases = tuple(cases) if cases else ALL_CASES
-    unknown = [c for c in cases if c not in _BENCH]
-    if unknown:
-        raise ValueError(f"unknown collectives {unknown}; pick from {ALL_CASES}")
+    cases = _validate_cases(cases or ALL_CASES)
     devices = jax.devices()
     n = len(devices)
     if n < 2:
@@ -195,7 +311,7 @@ def run(
             ok=True,
             summary=f"collectives sweep skipped: {n} device(s), nothing to move",
             metrics=[],
-            details={"devices": n, "skipped": True},
+            details={"devices": n, "skipped": True, "mesh": {"ici": n}},
         )
 
     mesh = make_1d_mesh()
@@ -207,3 +323,137 @@ def run(
     return _emit(
         entries, threshold, f"{len(entries)} collectives over {n} device(s)", details
     )
+
+
+# the full log-spaced payload grid lives with the tuner (single
+# source of truth); quick mode keeps the endpoints' spirit at
+# CPU-interpret-affordable sizes
+SWEEP_SIZES_MB = autotune.DEFAULT_SWEEP_SIZES_MB
+QUICK_SWEEP_SIZES_MB = (0.25, 2.0)
+
+
+def sweep(
+    sizes_mb: Optional[Sequence[float]] = None,
+    iters: int = 3,
+    quick: bool = False,
+    collectives: Sequence[str] = ("allreduce", "allgather"),
+    dtype=None,
+    bench: Optional[Callable] = None,
+) -> ProbeResult:
+    """Message-size autotune sweep: race every schedule (XLA builtin +
+    zoo) across the payload grid, fold winners into the
+    parallel/autotune decision table, and report crossover points.
+
+    ``quick=True``: 2 payload sizes, reduced iters — the tier-1 /
+    CPU-interpret budget mode (the full grid at 256 MB × several
+    schedules is a TPU-sized bill). ``bench`` is the injectable
+    measurement hook (parallel/autotune.tune contract) — tests script
+    fake timings through it."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+    if sizes_mb is None:
+        sizes_mb = QUICK_SWEEP_SIZES_MB if quick else SWEEP_SIZES_MB
+    if quick:
+        iters = min(iters, 2)
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return ProbeResult(
+            ok=True,
+            summary=f"autotune sweep skipped: {n} device(s), nothing to tune",
+            metrics=[],
+            details={"devices": n, "skipped": True, "mesh": {"ici": n}},
+        )
+    mesh = make_1d_mesh()
+    tuned = autotune.tune(
+        mesh,
+        collectives=tuple(collectives),
+        sizes_mb=tuple(sizes_mb),
+        dtype=dtype,
+        iters=iters,
+        bench=bench,
+    )
+    raw = tuned.results
+
+    # crossovers + the zoo-vs-builtin headline, per collective family
+    crossovers: Dict[str, list] = {}
+    zoo_best_win = 0.0
+    best_cell = None
+    for family, by_size in raw.items():
+        points = []
+        for size_mb, busbw in by_size.items():
+            winner = max(busbw, key=busbw.get)
+            points.append((size_mb, winner))
+            xla_bw = busbw.get("xla", 0.0)
+            for schedule, bw in busbw.items():
+                if schedule == "xla" or xla_bw <= 0:
+                    continue
+                win = bw / xla_bw
+                if win > zoo_best_win:
+                    zoo_best_win = win
+                    best_cell = {
+                        "collective": family,
+                        "schedule": schedule,
+                        "size_mb": size_mb,
+                        "busbw_gbps": round(bw, 3),
+                        "xla_busbw_gbps": round(xla_bw, 3),
+                    }
+        crossovers[family] = autotune.crossover_points(points)
+
+    if zoo_best_win <= 1.0:
+        # no zoo schedule actually beat the builtin anywhere — a
+        # "best cell" naming a LOSING (schedule, payload) pair must
+        # not sit in the artifact where the acceptance evidence goes
+        best_cell = None
+    n_crossovers = sum(len(v) for v in crossovers.values())
+    metrics = [
+        ProbeMetric(
+            SWEEP_ZOO_BEST_WIN_METRIC,
+            zoo_best_win,
+            help="Best zoo-schedule busbw / XLA-builtin busbw over the "
+            "sweep grid (>1: a zoo schedule measurably won a cell)",
+        ),
+        ProbeMetric(
+            SWEEP_CROSSOVERS_METRIC,
+            float(n_crossovers),
+            help="Winner flips along the payload grid (per-topology "
+            "crossover count)",
+        ),
+    ]
+    details = {
+        "devices": n,
+        "device_kind": devices[0].device_kind,
+        "dtype": jnp.dtype(dtype).name,
+        "sizes_mb": list(sizes_mb),
+        "quick": quick,
+        "results_busbw_gbps": {
+            family: {
+                f"{size_mb}MB": {s: round(bw, 3) for s, bw in busbw.items()}
+                for size_mb, busbw in by_size.items()
+            }
+            for family, by_size in raw.items()
+        },
+        # only the cells THIS run measured — a long-lived process's
+        # earlier tunes are not this sweep's evidence
+        "autotune_table": autotune.table_as_dict(keys=tuned.keys),
+        "crossovers": crossovers,
+        "zoo_best_win": round(zoo_best_win, 3),
+        "zoo_best_cell": best_cell,
+    }
+    summary = (
+        f"autotune sweep over {n} device(s), {len(sizes_mb)} sizes: "
+        f"{n_crossovers} crossover(s), best zoo win "
+        f"{zoo_best_win:.2f}x vs XLA"
+        + (
+            f" ({best_cell['schedule']} @ {best_cell['size_mb']}MB "
+            f"{best_cell['collective']})"
+            if best_cell and zoo_best_win > 1.0
+            else ""
+        )
+    )
+    # informational: the sweep produces evidence (the decision table),
+    # not a pass/fail verdict — correctness is the equivalence suite's
+    # job, regressions are the analysis layer's
+    return ProbeResult(ok=True, summary=summary, metrics=metrics, details=details)

@@ -14,9 +14,22 @@ rated on a GKE v5e-8). Exports:
   — each hop flavor against its link-model ceiling (1x unidir for the
   single direction, 2x unidir full-duplex for bidirectional), the same
   model behind the all-reduce comparator below
+
+With ``schedules=(...)`` (zoo tokens from parallel/schedules.py:
+"rsag", "recdouble", "tree") the probe also measures each explicit
+all-reduce schedule and exports, per schedule:
+
+- ``ici-allreduce-<sched>-busbw-gbps``
+- ``ici-allreduce-<sched>-fraction-of-rated`` — against that
+  schedule's OWN transfer-volume ceiling
+  (probes/collectives._rated_busbw), so a latency-optimal schedule
+  sitting at its low bandwidth ceiling reads healthy while the same
+  busbw from the XLA ring would read as a sick link.
 """
 
 from __future__ import annotations
+
+from typing import Sequence
 
 import jax
 
@@ -26,8 +39,33 @@ from activemonitor_tpu.parallel.collectives import (
     ppermute_ring_bandwidth,
 )
 from activemonitor_tpu.parallel.mesh import make_1d_mesh
+from activemonitor_tpu.parallel.schedules import (
+    all_reduce_recdouble_bandwidth,
+    all_reduce_rsag_bandwidth,
+    all_reduce_tree_bandwidth,
+)
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
+
+# zoo-schedule gauge names, declared (not f-string-built) so the
+# contract-spelling gates (tests/test_lint) see them as constants
+_SCHEDULE_GAUGES = {
+    "rsag": (
+        "ici-allreduce-rsag-busbw-gbps",
+        "ici-allreduce-rsag-fraction-of-rated",
+        all_reduce_rsag_bandwidth,
+    ),
+    "recdouble": (
+        "ici-allreduce-recdouble-busbw-gbps",
+        "ici-allreduce-recdouble-fraction-of-rated",
+        all_reduce_recdouble_bandwidth,
+    ),
+    "tree": (
+        "ici-allreduce-tree-busbw-gbps",
+        "ici-allreduce-tree-fraction-of-rated",
+        all_reduce_tree_bandwidth,
+    ),
+}
 
 
 def run(
@@ -35,7 +73,14 @@ def run(
     iters: int = 10,
     threshold: float = 0.9,
     include_ring: bool = True,
+    schedules: Sequence[str] = (),
 ) -> ProbeResult:
+    unknown = [s for s in schedules if s not in _SCHEDULE_GAUGES]
+    if unknown:
+        raise ValueError(
+            f"unknown all-reduce schedules {unknown}; pick from "
+            f"{tuple(_SCHEDULE_GAUGES)}"
+        )
     devices = jax.devices()
     n = len(devices)
     mesh = make_1d_mesh()
@@ -61,6 +106,22 @@ def run(
         "seconds_per_op": result.seconds_per_op,
         "busbw_gbps": round(result.busbw_gbps, 2),
     }
+
+    sched_results = {}
+    if n > 1:
+        for sched in schedules:
+            bw_name, _frac_name, bench = _SCHEDULE_GAUGES[sched]
+            res = bench(mesh, size_mb=size_mb, iters=iters)
+            sched_results[sched] = res
+            metrics.append(
+                ProbeMetric(
+                    bw_name,
+                    res.busbw_gbps,
+                    help=f"all-reduce via the explicit {sched} schedule "
+                    "(parallel/schedules.py), busbw GB/s",
+                )
+            )
+            details[f"allreduce_{sched}_busbw_gbps"] = round(res.busbw_gbps, 2)
 
     ring = ring_bidir = None
     if include_ring and n > 1:
@@ -123,6 +184,31 @@ def run(
             details["ring_hop_bidir_fraction_of_rated"] = round(
                 ring_bidir.algbw_gbps / rated_busbw, 3
             )
+        if sched_results:
+            # each zoo schedule against its OWN transfer-volume ceiling
+            # (probes/collectives._rated_busbw): a schedule losing to
+            # its algorithm is not a slow link
+            from activemonitor_tpu.probes.collectives import (
+                _rated_busbw as _schedule_ceiling,
+            )
+
+            for sched, res in sched_results.items():
+                _bw_name, frac_name, _bench = _SCHEDULE_GAUGES[sched]
+                ceiling = _schedule_ceiling(
+                    f"allreduce-{sched}", rated.ici_unidir_gbps, n
+                )
+                metrics.append(
+                    ProbeMetric(
+                        frac_name,
+                        res.busbw_gbps / ceiling,
+                        help=f"{sched} busbw / its own schedule ceiling "
+                        f"({ceiling:.0f} GB/s here) — informational, "
+                        "not part of the north-star verdict",
+                    )
+                )
+                details[f"allreduce_{sched}_fraction_of_rated"] = round(
+                    res.busbw_gbps / ceiling, 3
+                )
         ok = fraction >= threshold
         summary = (
             f"all-reduce busbw {result.busbw_gbps:.1f} GB/s = "

@@ -116,6 +116,15 @@ def run(
             cases=("allgather", "reducescatter", "alltoall"),
         ),
     )
+    if not quick:
+        # the message-size autotune sweep (schedule zoo vs XLA builtins
+        # across the payload grid) — quick grid even in the full
+        # battery: the full 256 MB grid is a dedicated-probe bill, and
+        # the battery only needs the decision-table evidence refreshed
+        add(
+            "collectives-sweep",
+            lambda: collectives_probe.sweep(quick=True, iters=iters),
+        )
     # quick mode skips the overlap telemetry (the serial-baseline pass
     # and cross-schedule checks are extra compiles — same philosophy as
     # skipping the perf bars); the full battery reports

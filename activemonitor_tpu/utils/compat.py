@@ -42,6 +42,25 @@ LEGACY_JAX = _LEGACY
 SUPPORTS_CPU_MULTIPROCESS = not _LEGACY
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside a shard_map body.
+
+    Modern JAX exposes ``jax.lax.axis_size``; the legacy runtime keeps
+    the size on the axis frame (``jax.core.axis_frame`` returns the
+    bare int there). Schedules in parallel/schedules.py unroll python
+    loops over ring/tree rounds, so the size must be a concrete int at
+    trace time — a traced ``psum(1, axis)`` would not do.
+    """
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis_name))
+    from jax.core import axis_frame
+
+    frame = axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
 def shard_map(
     f,
     *,

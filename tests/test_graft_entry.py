@@ -119,6 +119,13 @@ def test_bench_emits_single_json_line():
     # the bidirectional ring within tolerance
     assert doc["secondary"]["ring_overlap_vs_serial_max_error"] == 0.0
     assert doc["secondary"]["ring_bidir_max_error_interpret"] < 1e-3
+    # the autotune evidence block (ISSUE 8): interpret-mode table,
+    # labeled so it can never be read against a TPU bar
+    autotune = doc["collective_autotune"]
+    assert autotune["interpret_mode"] is True
+    assert autotune["table"]  # winners actually recorded
+    for entry in autotune["table"].values():
+        assert entry["schedule"] in ("xla", "rsag", "recdouble", "tree")
     from activemonitor_tpu.utils.compat import SUPPORTS_PARTIAL_MANUAL
 
     if SUPPORTS_PARTIAL_MANUAL:

@@ -607,6 +607,59 @@ def test_overlap_metric_names_are_pinned():
         assert key in bench_src, f"bench.py no longer records {key}"
 
 
+def test_zoo_metric_names_are_pinned():
+    """The ISSUE-8 collective-zoo/autotune names are contract spelling
+    across three layers: the probes emit them, docs/probes.md's metric
+    table registers them (the names spec.analysis.metrics[] takes),
+    and bench.py stamps the autotune evidence block — a rename in any
+    one layer silently orphans the others, so the gate pins all three
+    (the same gate the ring-overlap metrics got)."""
+    import ast
+
+    docs = (REPO / "docs" / "probes.md").read_text()
+    pinned_metrics = {
+        "collective-sweep-zoo-best-win": "probes/collectives.py",
+        "collective-sweep-crossovers": "probes/collectives.py",
+        "ici-allreduce-rsag-fraction-of-rated": "probes/ici.py",
+        "ici-allreduce-recdouble-fraction-of-rated": "probes/ici.py",
+        "ici-allreduce-tree-fraction-of-rated": "probes/ici.py",
+        "ici-allreduce-rsag-busbw-gbps": "probes/ici.py",
+        "ici-allreduce-recdouble-busbw-gbps": "probes/ici.py",
+        "ici-allreduce-tree-busbw-gbps": "probes/ici.py",
+    }
+    for name, rel in pinned_metrics.items():
+        assert name in docs, f"{name} missing from docs/probes.md metric table"
+        src = (REPO / "activemonitor_tpu" / rel).read_text()
+        tree = ast.parse(src)
+        declared = {
+            node.value
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Constant) and isinstance(node.value, str)
+        }
+        assert name in declared, f"{name} not declared in {rel}"
+    # the zoo cases are part of the collectives-probe sweep contract
+    from activemonitor_tpu.probes.collectives import ZOO_CASES, _BENCH
+
+    for case in (
+        "allreduce-rsag", "allreduce-recdouble", "allreduce-tree",
+        "allgather-ring", "allgather-recdouble",
+    ):
+        assert case in ZOO_CASES
+        assert case in _BENCH
+        assert case in docs, f"zoo case {case} missing from docs/probes.md"
+    # the catalog section the metric table points at must exist
+    training = (REPO / "docs" / "training.md").read_text()
+    assert "Collective schedule catalog" in training
+    assert "autotune_table" in training
+    # bench.py's autotune evidence block (both TPU and CPU-fallback
+    # paths stamp it; interpret-mode tables are labeled as such)
+    bench_src = (REPO / "bench.py").read_text()
+    for key in (
+        "collective_autotune", "interpret_mode", "zoo_best_win", "crossovers",
+    ):
+        assert key in bench_src, f"bench.py no longer records {key}"
+
+
 def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
     got = findings(
         tmp_path,

@@ -175,6 +175,136 @@ def test_alltoall_rated_ceiling_is_bisection_bound():
     assert a2a < 90.0
 
 
+def test_zoo_schedule_ceilings_are_per_algorithm():
+    """Each zoo schedule's rated ceiling reflects ITS wire volume and
+    link usage, not the XLA bidir-ring model — the gauge that makes
+    "losing to its own algorithm" distinguishable from a slow link."""
+    from activemonitor_tpu.probes.collectives import _rated_busbw
+
+    b, n = 45.0, 8
+    # unidirectional ring rs+ag: one link direction, half the XLA 2x
+    assert _rated_busbw("allreduce-rsag", b, n) == b
+    # recursive doubling pays ring contention, not just rounds: round
+    # s partners sit 2^s hops apart, so per-link time sums to
+    # (p-1)·S/B — at n=8 the ceiling is 2(7/8)·B/7 = B/4, NOT B/3
+    assert _rated_busbw("allreduce-recdouble", b, n) == pytest.approx(
+        2 * 7 / 8 * b / 7
+    )
+    # non-pow2 adds the fold/unfold rounds: (4-1) + 2 = 5 at n=5
+    assert _rated_busbw("allreduce-recdouble", b, 5) == pytest.approx(
+        2 * 4 / 5 * b / 5
+    )
+    # tree: 2*ceil(log2 8) = 6 one-direction rounds
+    assert _rated_busbw("allreduce-tree", b, n) == pytest.approx(
+        2 * 7 / 8 * b / 6
+    )
+    # gather family: (n-1)/n of the payload each way -> one direction
+    assert _rated_busbw("allgather-ring", b, n) == b
+    assert _rated_busbw("allgather-recdouble", b, n) == b
+    # every zoo ceiling sits at or below the XLA bidir-ring ceiling
+    for case in (
+        "allreduce-rsag", "allreduce-recdouble", "allreduce-tree",
+        "allgather-ring", "allgather-recdouble",
+    ):
+        assert _rated_busbw(case, b, n) <= 2 * b
+
+
+class _FakeSweepResult:
+    def __init__(self, busbw_gbps, payload_bytes):
+        self.busbw_gbps = busbw_gbps
+        self.payload_bytes = payload_bytes
+
+
+def _scripted_sweep_bench(_collective, schedule, mesh, axis, size_mb, _dt, _it):
+    """alpha-beta regime script: recdouble wins small payloads, rsag
+    wins large, XLA in between — deterministic crossovers."""
+    n = mesh.shape[axis]
+    payload = int(size_mb * 1e6)
+    rounds, beta = {
+        "xla": (14, 5.0),
+        "rsag": (14, 10.0),
+        "recdouble": (3, 1.0),
+        "tree": (6, 0.5),
+        "ring": (7, 8.0),
+    }[schedule]
+    seconds = 150e-6 * rounds + payload / (beta * 1e9)
+    return _FakeSweepResult(payload / seconds / 1e9 * 2 * (n - 1) / n, payload)
+
+
+def test_collectives_sweep_entrypoint_with_scripted_timings():
+    """The sweep probe contract on a scripted regime: headline gauges,
+    the serialized decision table, and a located crossover — without
+    timing real collectives (tier-1 budget; the real-measurement path
+    is the slow test below)."""
+    from activemonitor_tpu.parallel import autotune
+
+    autotune.clear()
+    try:
+        # a stale cell from an earlier tune in the same process must
+        # NOT be serialized as this sweep's evidence
+        autotune.record("allgather", 99, 2**30, jnp.float32, {"ring": 1.0})
+        r = collectives_probe.sweep(
+            sizes_mb=(0.01, 50.0),
+            collectives=("allreduce",),
+            bench=_scripted_sweep_bench,
+        )
+        assert not any("n99" in k for k in r.details["autotune_table"])
+        assert r.ok
+        names = [m.name for m in r.metrics]
+        assert names == [
+            "collective-sweep-zoo-best-win", "collective-sweep-crossovers",
+        ]
+        by_name = {m.name: m.value for m in r.metrics}
+        # rsag beats xla 2x at the bandwidth end of the scripted regime
+        assert by_name["collective-sweep-zoo-best-win"] > 1.0
+        assert by_name["collective-sweep-crossovers"] >= 1.0
+        flips = r.details["crossovers"]["allreduce"]
+        assert flips and flips[0]["from"] == "recdouble"
+        assert flips[0]["to"] == "rsag"
+        # the headline win cell is the latency end: recdouble's 3
+        # rounds vs the builtin's 14 dwarf rsag's 2x bandwidth edge
+        assert r.details["zoo_best_cell"]["schedule"] == "recdouble"
+        assert r.details["zoo_best_cell"]["size_mb"] == 0.01
+        # the autotune table is serialized evidence, one entry per size
+        assert len(r.details["autotune_table"]) == 2
+        for entry in r.details["autotune_table"].values():
+            assert set(entry) >= {"schedule", "busbw_gbps", "per_schedule_busbw_gbps"}
+        # and the in-process table now serves the tuned decisions
+        assert autotune.lookup("allreduce", 8, int(50e6), jnp.bfloat16) == "rsag"
+    finally:
+        autotune.clear()
+
+
+@pytest.mark.slow  # real chain-delta measurements across 7 schedules
+def test_collectives_sweep_quick_mode_measures_for_real():
+    from activemonitor_tpu.parallel import autotune
+
+    autotune.clear()
+    try:
+        r = collectives_probe.sweep(quick=True)
+        assert r.ok
+        assert r.details["quick"] is True
+        assert len(r.details["sizes_mb"]) == 2
+        assert r.details["autotune_table"]  # winners actually recorded
+        # a losing zoo must not leave a "best cell" in the evidence
+        if r.details["zoo_best_win"] <= 1.0:
+            assert r.details["zoo_best_cell"] is None
+        for by_size in r.details["results_busbw_gbps"].values():
+            for busbw in by_size.values():
+                assert all(bw > 0 for bw in busbw.values())
+    finally:
+        autotune.clear()
+
+
+def test_collectives_run_accepts_zoo_cases():
+    r = collectives_probe.run(size_mb=0.25, iters=2, cases=("allreduce-tree",))
+    assert [m.name for m in r.metrics] == ["collective-allreduce-tree-busbw-gbps"]
+    # the gauge is the unrounded value; the details copy rounds to
+    # 2 decimals and can legitimately floor to 0.0 on a loaded CPU
+    assert r.metrics[0].value > 0
+    assert "allreduce_tree_busbw_gbps" in r.details
+
+
 def test_collective_correctness():
     """The timing chain must still compute a correct mean-all-reduce."""
     from functools import partial
@@ -653,6 +783,60 @@ def test_collectives_per_axis_on_cpu_mesh():
     }
     # each axis reports a positive number; no cross-axis name collision
     assert all(m.value > 0 for m in r.metrics)
+
+
+def test_collectives_per_axis_threads_cases():
+    """The per-axis sweep takes the same case vocabulary as the flat
+    run — zoo schedules included — so a chosen schedule can be
+    exercised along each torus direction (ISSUE-8 small fix)."""
+    r = collectives_probe.run_per_axis(
+        size_mb=0.25, iters=2, cases=("allreduce-recdouble",)
+    )
+    assert r.ok
+    assert {m.name for m in r.metrics} == {
+        "collective-allreduce-recdouble-data-busbw-gbps",
+        "collective-allreduce-recdouble-model-busbw-gbps",
+    }
+    with pytest.raises(ValueError, match="unknown collectives"):
+        collectives_probe.run_per_axis(cases=("bogus",))
+
+
+def test_collectives_skip_details_carry_mesh_shape(monkeypatch):
+    """Skip reasons must say what topology was absent: the per-axis
+    skip records the 2D shape it would have used, the flat skip the
+    1D ring size."""
+    real = jax.devices()
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: real[:2])
+    r = collectives_probe.run_per_axis(size_mb=0.25, iters=2)
+    assert r.ok and r.details["skipped"]
+    assert r.details["mesh"] == {"data": 1, "model": 2}
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: real[:1])
+    flat = collectives_probe.run(size_mb=0.25, iters=2)
+    assert flat.ok and flat.details["skipped"]
+    assert flat.details["mesh"] == {"ici": 1}
+    swept = collectives_probe.sweep(quick=True)
+    assert swept.ok and swept.details["skipped"]
+    assert swept.details["mesh"] == {"ici": 1}
+
+
+def test_ici_probe_rejects_unknown_schedules_cheaply():
+    # validation precedes any measurement, so the error is instant
+    with pytest.raises(ValueError, match="unknown all-reduce schedules"):
+        ici_probe.run(schedules=("bogus",))
+
+
+@pytest.mark.slow  # real chain-delta measurement of two zoo schedules
+def test_ici_probe_zoo_schedule_gauges():
+    """schedules=(...) adds per-algorithm busbw gauges (fractions are
+    TPU-only, like every rated comparison)."""
+    r = ici_probe.run(size_mb=0.25, iters=2, schedules=("tree", "recdouble"))
+    names = {m.name for m in r.metrics}
+    assert "ici-allreduce-tree-busbw-gbps" in names
+    assert "ici-allreduce-recdouble-busbw-gbps" in names
+    assert r.details["allreduce_tree_busbw_gbps"] > 0
+    assert r.details["allreduce_recdouble_busbw_gbps"] > 0
+    # no fraction gauges off-TPU — same rule as the north-star fraction
+    assert not any("tree-fraction" in n for n in names)
 
 
 @pytest.mark.slow  # full probe run under the profiler CLI; tier-2 coverage

@@ -1,0 +1,485 @@
+"""Collective algorithm zoo + autotuner tests (ISSUE 8).
+
+Every zoo schedule must be numerically equivalent to the
+``jax.lax.psum`` / ``all_gather`` reference across mesh sizes
+n ∈ {2, 3, 4, 8} (odd-row shards included, bf16 and f32), send exactly
+its theoretical hop count (the PR-5 ``_HOP_LOG`` contract), and the
+autotuner must demonstrably flip its decision across a scripted
+crossover — all on the virtual 8-device CPU mesh."""
+
+import collections
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import activemonitor_tpu.parallel.schedules as schedules
+from activemonitor_tpu.parallel import autotune
+from activemonitor_tpu.parallel.schedules import (
+    all_gather_recdouble,
+    all_gather_recdouble_bandwidth,
+    all_gather_ring,
+    all_gather_ring_bandwidth,
+    all_reduce_recdouble,
+    all_reduce_recdouble_bandwidth,
+    all_reduce_rsag,
+    all_reduce_rsag_bandwidth,
+    all_reduce_tree,
+    all_reduce_tree_bandwidth,
+    theoretical_hops,
+)
+from activemonitor_tpu.utils.compat import shard_map
+
+AXIS = "zoo"
+
+ALL_REDUCE_FNS = {
+    "rsag": all_reduce_rsag,
+    "recdouble": all_reduce_recdouble,
+    "tree": all_reduce_tree,
+}
+ALL_GATHER_FNS = {
+    "ring": all_gather_ring,
+    "ag-recdouble": all_gather_recdouble,
+}
+
+
+def submesh(n):
+    return Mesh(np.array(jax.devices()[:n]), (AXIS,))
+
+
+def apply_sharded(mesh, fn, x, gathered=False):
+    """Run ``fn(shard)`` under shard_map; gathered=True means fn's
+    output is already the full (replicated-content) array."""
+    out_specs = P(None) if gathered else P(AXIS)
+    run = shard_map(
+        fn, mesh=mesh, in_specs=P(AXIS), out_specs=out_specs, check_vma=False
+    )
+    return run(x)
+
+
+@pytest.mark.parametrize("sched", sorted(ALL_REDUCE_FNS))
+@pytest.mark.parametrize(
+    "n", [2, 3, 4, pytest.param(8, marks=pytest.mark.slow)]
+)
+def test_all_reduce_schedules_match_psum(sched, n):
+    """allclose equivalence vs the XLA reference, odd-row shards (5
+    rows/shard exercise the rsag padding path on every non-divisible
+    n), f32."""
+    mesh = submesh(n)
+    fn = ALL_REDUCE_FNS[sched]
+    rows = 5  # odd: 5 % n != 0 for n in {2,3,4,8}
+    x = jax.random.normal(jax.random.key(n), (n * rows, 3), jnp.float32)
+    got = apply_sharded(mesh, lambda v: fn(v, AXIS), x)
+    want = apply_sharded(mesh, lambda v: jax.lax.psum(v, AXIS), x)
+    assert jnp.allclose(got, want, atol=1e-5), (
+        sched, n, float(jnp.max(jnp.abs(got - want)))
+    )
+
+
+@pytest.mark.parametrize("sched", sorted(ALL_REDUCE_FNS))
+def test_all_reduce_schedules_match_psum_bf16(sched):
+    """bf16 shards: integer-valued payloads keep every partial sum
+    exactly representable, so the schedules must agree with psum
+    BITWISE — any extra rounding (an upcast the reference doesn't do,
+    a lost chunk) shows as a hard mismatch."""
+    n = 4
+    mesh = submesh(n)
+    fn = ALL_REDUCE_FNS[sched]
+    x = jnp.arange(n * 4 * 2, dtype=jnp.bfloat16).reshape(n * 4, 2) % 7
+    got = apply_sharded(mesh, lambda v: fn(v, AXIS), x)
+    want = apply_sharded(mesh, lambda v: jax.lax.psum(v, AXIS), x)
+    assert got.dtype == jnp.bfloat16
+    assert bool((got == want).all()), (sched, got - want)
+
+
+@pytest.mark.parametrize("sched", sorted(ALL_GATHER_FNS))
+@pytest.mark.parametrize(
+    "n", [2, 3, 4, pytest.param(8, marks=pytest.mark.slow)]
+)
+def test_all_gather_schedules_match_reference_bitwise(sched, n):
+    """The gather schedules only MOVE data — bitwise equality with
+    ``lax.all_gather(tiled=True)`` is the contract, odd rows included."""
+    mesh = submesh(n)
+    fn = ALL_GATHER_FNS[sched]
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(None),
+        check_vma=False,
+    )
+    def diff(v):
+        got = fn(v, AXIS)
+        want = jax.lax.all_gather(v, AXIS, tiled=True)
+        return jnp.max(jnp.abs(got - want))[None]
+
+    x = jax.random.normal(jax.random.key(10 + n), (n * 5, 3), jnp.float32)
+    assert float(diff(x)[0]) == 0.0
+
+
+@pytest.mark.parametrize(
+    "sched,n",
+    [
+        ("rsag", 2), ("rsag", 3),
+        pytest.param("rsag", 8, marks=pytest.mark.slow),
+        ("recdouble", 2), ("recdouble", 3), ("recdouble", 8),
+        ("tree", 2), ("tree", 3), ("tree", 8),
+    ],
+)
+def test_all_reduce_hop_budget(sched, n):
+    """Traced-hop contract: each schedule issues exactly its
+    theoretical round count (rsag 2(n−1); recdouble log2(p) + 2-round
+    non-pow2 fold/unfold; tree 2·ceil(log2 n)). The schedules unroll
+    python loops, so one traced application logs every ppermute."""
+    mesh = submesh(n)
+    fn = ALL_REDUCE_FNS[sched]
+    # unique shape per case so cached traces can't swallow the log
+    x = jnp.ones((n * 4, 2 + n), jnp.float32)
+    schedules._HOP_LOG = log = []
+    try:
+        apply_sharded(mesh, lambda v: fn(v, AXIS), x)
+    finally:
+        schedules._HOP_LOG = None
+    assert len(log) == theoretical_hops(sched, n), (sched, n, log)
+
+
+@pytest.mark.parametrize(
+    "n", [2, 3, pytest.param(8, marks=pytest.mark.slow)]
+)
+def test_all_gather_hop_budget(n):
+    mesh = submesh(n)
+    for sched, fn in ALL_GATHER_FNS.items():
+        x = jnp.ones((n * 2, 1 + n), jnp.float32)
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(None),
+            check_vma=False,
+        )
+        def gathered(v):
+            return fn(v, AXIS)
+
+        schedules._HOP_LOG = log = []
+        try:
+            gathered(x)
+        finally:
+            schedules._HOP_LOG = None
+        assert len(log) == theoretical_hops(sched, n), (sched, n, log)
+
+
+def test_recdouble_non_pow2_fold_unfold_tags():
+    """n=3 recursive doubling: one fold, log2(2)=1 exchange, one
+    unfold — the hop tags prove the non-pow2 path really folds the
+    remainder rank instead of silently falling back to another
+    schedule."""
+    n = 3
+    mesh = submesh(n)
+    x = jnp.ones((n * 2, 9), jnp.float32)
+    schedules._HOP_LOG = log = []
+    try:
+        apply_sharded(mesh, lambda v: all_reduce_recdouble(v, AXIS), x)
+    finally:
+        schedules._HOP_LOG = None
+    tags = collections.Counter(tag for tag, _step in log)
+    assert tags == {
+        "recdouble-fold": 1, "recdouble-xchg": 1, "recdouble-unfold": 1
+    }
+
+
+def test_ag_recdouble_falls_back_to_ring_off_pow2():
+    n = 3
+    mesh = submesh(n)
+    x = jnp.ones((n * 2, 11), jnp.float32)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(None),
+        check_vma=False,
+    )
+    def gathered(v):
+        return all_gather_recdouble(v, AXIS)
+
+    schedules._HOP_LOG = log = []
+    try:
+        gathered(x)
+    finally:
+        schedules._HOP_LOG = None
+    assert all(tag == "ag-ring" for tag, _step in log), log
+    assert len(log) == n - 1
+
+
+def test_bandwidth_wrappers_report_conventions():
+    """Zoo benches share the XLA benches' CollectiveResult/busbw
+    accounting: allreduce busbw = algbw·2(n−1)/n, allgather payload is
+    the gathered total with busbw = algbw·(n−1)/n."""
+    from activemonitor_tpu.parallel.mesh import make_1d_mesh
+
+    n = 4  # half the virtual mesh: conventions don't need all 8
+    mesh = make_1d_mesh(devices=jax.devices()[:n])
+    for bench in (
+        all_reduce_rsag_bandwidth,
+        all_reduce_recdouble_bandwidth,
+        all_reduce_tree_bandwidth,
+    ):
+        r = bench(mesh, size_mb=0.25, iters=2)
+        assert r.n_devices == n
+        assert r.algbw_gbps > 0
+        assert r.busbw_gbps == pytest.approx(r.algbw_gbps * 2 * (n - 1) / n)
+    for bench in (all_gather_ring_bandwidth, all_gather_recdouble_bandwidth):
+        r = bench(mesh, size_mb=0.25, iters=2)
+        assert r.busbw_gbps == pytest.approx(r.algbw_gbps * (n - 1) / n)
+        assert r.algbw_gbps > 0
+
+
+def test_theoretical_hops_table():
+    assert theoretical_hops("rsag", 8) == 14
+    assert theoretical_hops("recdouble", 8) == 3
+    assert theoretical_hops("recdouble", 3) == 3  # fold + 1 xchg + unfold
+    assert theoretical_hops("tree", 8) == 6
+    assert theoretical_hops("tree", 3) == 4
+    assert theoretical_hops("ring", 8) == 7
+    assert theoretical_hops("ag-recdouble", 8) == 3
+    assert theoretical_hops("ag-recdouble", 3) == 2  # ring fallback
+    assert theoretical_hops("rsag", 1) == 0
+    with pytest.raises(ValueError, match="unknown schedule"):
+        theoretical_hops("bogus", 8)
+    # the public "recdouble" token names a DIFFERENT algorithm per
+    # family: the gather variant's non-pow2 fallback is the ring
+    assert theoretical_hops("recdouble", 6, collective="allgather") == 5
+    assert theoretical_hops("recdouble", 8, collective="allgather") == 3
+    assert theoretical_hops("recdouble", 6) == 4  # allreduce fold/unfold
+
+
+# ---------------------------------------------------------------------------
+# autotuner
+# ---------------------------------------------------------------------------
+
+
+class _FakeResult:
+    def __init__(self, busbw_gbps, payload_bytes):
+        self.busbw_gbps = busbw_gbps
+        self.payload_bytes = payload_bytes
+
+
+def _regime_bench(alpha_us, beta_by_schedule):
+    """Scripted alpha-beta timings: time = alpha·rounds + bytes/beta.
+    Latency-optimal schedules (few rounds, low effective beta) win
+    small payloads; bandwidth-optimal ones win large — the NCCL
+    crossover in miniature, no hardware involved."""
+
+    def bench(_collective, schedule, mesh, axis, size_mb, _dtype, _iters):
+        n = mesh.shape[axis]
+        payload = int(size_mb * 1e6)
+        rounds, beta_gbps = beta_by_schedule[schedule]
+        seconds = alpha_us * 1e-6 * rounds + payload / (beta_gbps * 1e9)
+        algbw = payload / seconds / 1e9
+        busbw = algbw * 2 * (n - 1) / n
+        return _FakeResult(busbw, payload)
+
+    return bench
+
+
+def test_autotuner_decision_flips_across_the_crossover():
+    """The acceptance-criterion unit test: with scripted timings where
+    recdouble has few rounds but low bandwidth and rsag many rounds
+    but high bandwidth, the winner must flip from recdouble (small
+    payloads) to rsag (large payloads), and lookup() must serve each
+    regime its own schedule."""
+    from activemonitor_tpu.parallel.mesh import make_1d_mesh
+
+    autotune.clear()
+    mesh = make_1d_mesh()  # fake bench: no collective actually runs
+    # (rounds, effective beta GB/s): recdouble pays 3 rounds at 1 GB/s,
+    # rsag pays 14 rounds at 10 GB/s — crossover ~a few hundred KB
+    bench = _regime_bench(
+        alpha_us=200.0,
+        beta_by_schedule={
+            "xla": (14, 5.0),
+            "rsag": (14, 10.0),
+            "recdouble": (3, 1.0),
+            "tree": (6, 0.5),
+        },
+    )
+    tuned = autotune.tune(
+        mesh,
+        collectives=("allreduce",),
+        sizes_mb=(0.01, 100.0),
+        dtype=jnp.bfloat16,
+        iters=1,
+        bench=bench,
+    )
+    raw = tuned.results
+    assert len(tuned.keys) == 2  # one recorded cell per swept size
+    small = raw["allreduce"][0.01]
+    large = raw["allreduce"][100.0]
+    assert max(small, key=small.get) == "recdouble"
+    assert max(large, key=large.get) == "rsag"
+    # the table serves each regime its winner
+    assert autotune.lookup("allreduce", 8, int(0.01 * 1e6), jnp.bfloat16) == "recdouble"
+    assert autotune.lookup("allreduce", 8, int(100 * 1e6), jnp.bfloat16) == "rsag"
+    # crossover detection sees exactly one flip
+    points = [
+        (mb, max(bw, key=bw.get)) for mb, bw in raw["allreduce"].items()
+    ]
+    flips = autotune.crossover_points(points)
+    assert len(flips) == 1
+    assert flips[0]["from"] == "recdouble" and flips[0]["to"] == "rsag"
+    autotune.clear()
+
+
+def test_autotune_lookup_nearest_bucket_and_serialization():
+    autotune.clear()
+    decision = autotune.record(
+        "allreduce", 8, 64 * 2**20, jnp.float32,
+        {"xla": 5.0, "rsag": 8.0, "tree": 1.0},
+    )
+    assert decision.schedule == "rsag"
+    assert decision.runner_up == "xla"
+    assert decision.margin == pytest.approx(8.0 / 5.0)
+    # a nearby (untuned) payload rides the nearest tuned octave
+    assert autotune.lookup("allreduce", 8, 48 * 2**20, jnp.float32) == "rsag"
+    # other axis sizes / dtypes are NOT served by this entry
+    assert autotune.lookup("allreduce", 4, 64 * 2**20, jnp.float32) is None
+    assert autotune.lookup("allreduce", 8, 64 * 2**20, jnp.bfloat16) is None
+    table = autotune.table_as_dict()
+    (key,) = table
+    assert table[key]["schedule"] == "rsag"
+    assert table[key]["per_schedule_busbw_gbps"]["tree"] == 1.0
+    # keyed snapshots exclude cells other runs recorded
+    other = autotune.TuneKey("allgather", 4, 10, "float32")
+    assert autotune.table_as_dict(keys=[other]) == {}
+    with pytest.raises(ValueError, match="no schedules"):
+        autotune.record("allreduce", 8, 1, jnp.float32, {})
+    autotune.clear()
+
+
+def test_tune_rejects_unknown_collectives():
+    from activemonitor_tpu.parallel.mesh import make_1d_mesh
+
+    with pytest.raises(ValueError, match="unknown collectives"):
+        autotune.tune(make_1d_mesh(), collectives=("reducescatter",))
+
+
+def test_tuned_all_reduce_surface_consults_the_table():
+    """all_reduce(x, schedule="auto") must dispatch to the tuned
+    winner — proven by hop signature: after recording tree as the
+    winner for this (n, payload octave, dtype), the auto path issues
+    tree hops; after clear() it falls back to XLA psum (zero zoo
+    hops)."""
+    n = 4
+    mesh = submesh(n)
+    x = jnp.ones((n * 2, 13), jnp.float32)
+    payload = (x.size // n) * x.dtype.itemsize
+
+    autotune.clear()
+    autotune.record("allreduce", n, payload, jnp.float32, {"tree": 2.0, "xla": 1.0})
+
+    def auto(v):
+        return autotune.all_reduce(v, AXIS, schedule="auto")
+
+    schedules._HOP_LOG = log = []
+    try:
+        got = apply_sharded(mesh, auto, x)
+    finally:
+        schedules._HOP_LOG = None
+    assert {tag for tag, _s in log} == {"tree-reduce", "tree-bcast"}
+    want = apply_sharded(mesh, lambda v: jax.lax.psum(v, AXIS), x)
+    assert jnp.allclose(got, want)
+
+    autotune.clear()
+    schedules._HOP_LOG = log = []
+    try:
+        # fresh shape: the previous trace must not be replayed
+        apply_sharded(mesh, auto, jnp.ones((n * 2, 17), jnp.float32))
+    finally:
+        schedules._HOP_LOG = None
+    assert log == []  # untuned → XLA builtin, no explicit hops
+
+
+def test_tuned_surfaces_reject_unknown_schedules():
+    n = 2
+    mesh = submesh(n)
+    x = jnp.ones((n * 2, 3), jnp.float32)
+    with pytest.raises(ValueError, match="unknown all-reduce schedule"):
+        apply_sharded(mesh, lambda v: autotune.all_reduce(v, AXIS, "bogus"), x)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(None),
+        check_vma=False,
+    )
+    def bad_gather(v):
+        return autotune.all_gather(v, AXIS, "bogus")
+
+    with pytest.raises(ValueError, match="unknown all-gather schedule"):
+        bad_gather(x)
+
+
+def test_tuned_all_gather_explicit_schedule():
+    n = 4
+    mesh = submesh(n)
+    x = jnp.arange(n * 2 * 3, dtype=jnp.float32).reshape(n * 2, 3)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(None),
+        check_vma=False,
+    )
+    def diff(v):
+        got = autotune.all_gather(v, AXIS, "ring")
+        want = jax.lax.all_gather(v, AXIS, tiled=True)
+        return jnp.max(jnp.abs(got - want))[None]
+
+    assert float(diff(x)[0]) == 0.0
+
+
+def test_auto_dispatch_is_safe_for_scalars_and_distant_payloads():
+    """A tuned 64 MB cell must not crash (or even steer) a scalar
+    psum: 0-d inputs always ride the builtin, and the nearest-octave
+    fallback is bounded so a 4 KB payload on the wrong side of the
+    crossover falls back to XLA instead of riding the 64 MB rsag
+    decision."""
+    n = 4
+    mesh = submesh(n)
+    autotune.clear()
+    try:
+        autotune.record(
+            "allreduce", n, 64 * 2**20, jnp.float32, {"rsag": 10.0, "xla": 5.0}
+        )
+        # bounded fallback: 4 KB is ~14 octaves away — no decision
+        assert autotune.lookup("allreduce", n, 4096, jnp.float32) is None
+        # ...but 48 MB (1 octave) still rides the 64 MB cell
+        assert autotune.lookup("allreduce", n, 48 * 2**20, jnp.float32) == "rsag"
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(None),
+            check_vma=False,
+        )
+        def scalar_auto(v):
+            return autotune.all_reduce(jnp.sum(v), AXIS, schedule="auto")[None]
+
+        got = scalar_auto(jnp.ones((n * 2, 3), jnp.float32))
+        assert float(got[0]) == n * 2 * 3
+    finally:
+        autotune.clear()
+
+
+def test_lookup_equidistant_octaves_tie_break_toward_smaller():
+    """Two tuned octaves at equal distance must not crash (TuneKeys
+    are unorderable) and resolve to the smaller payload's decision —
+    the latency-safe side of the crossover."""
+    autotune.clear()
+    try:
+        autotune.record("allreduce", 8, 2**20, jnp.float32, {"recdouble": 2.0})
+        autotune.record("allreduce", 8, 2**24, jnp.float32, {"rsag": 2.0})
+        # bucket 22: exactly two octaves from both tuned entries
+        assert (
+            autotune.lookup("allreduce", 8, 2**22, jnp.float32) == "recdouble"
+        )
+    finally:
+        autotune.clear()
+
+
+def test_payload_bucket_octaves():
+    assert autotune.payload_bucket(1) == 0
+    assert autotune.payload_bucket(2**20) == 20
+    assert autotune.payload_bucket(2**20 + 1) == 20
+    assert autotune.payload_bucket(2**21 - 1) == 20
+    assert autotune.payload_bucket(2**21) == 21
