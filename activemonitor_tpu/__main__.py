@@ -267,6 +267,25 @@ def build_parser() -> argparse.ArgumentParser:
         "-o", "--output", choices=["text", "json"], default="text"
     )
 
+    roofline = sub.add_parser(
+        "roofline",
+        help="cost-model evidence under ONE check's fractions: per "
+        "metric, the arithmetic intensity, compute/memory/comm bound, "
+        "ceiling, achieved rate and fraction-of-roofline "
+        "(docs/observability.md \"Reading a roofline\")",
+    )
+    roofline.add_argument("name", help="HealthCheck name")
+    roofline.add_argument(
+        "--namespace",
+        "-n",
+        default=None,
+        help="namespace filter (default: every namespace with that name)",
+    )
+    add_statusz_flags(roofline)
+    roofline.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
     goodput = sub.add_parser(
         "goodput",
         help="fleet lost-goodput attribution: which subsystem (ici/hbm/"
@@ -1047,6 +1066,106 @@ async def _why(args) -> int:
     return 0
 
 
+def _fmt_rate(value, bound: str) -> str:
+    """Human ceiling/achieved cell: TFLOP/s on the compute/memory
+    rooflines, GB/s on the comm one (where the block's *_flops fields
+    carry byte/s by convention — obs/roofline.classify_comm)."""
+    if not isinstance(value, (int, float)):
+        return "-"
+    if bound == "comm":
+        return f"{value / 1e9:.1f} GB/s"
+    return f"{value / 1e12:.1f} TF/s"
+
+
+def render_roofline(check: dict) -> str:
+    """One check's `am-tpu roofline` table: per-metric intensity,
+    bound, ceiling, achieved and fraction-of-roofline, with the cost
+    source spelled out. Pure over a /statusz check entry so tests pin
+    the rendering."""
+    key = check.get("key") or "{}/{}".format(
+        check.get("namespace", ""), check.get("healthcheck", "")
+    )
+    snapshot = check.get("roofline")
+    if not snapshot or not snapshot.get("metrics"):
+        return f"{key}: no roofline evidence recorded yet (quick-mode runs and old probes ship none)"
+    lines = [
+        "{}  worst={} {:.2f} ({}-bound)  run {}  trace={}".format(
+            key,
+            snapshot.get("worst", "-"),
+            snapshot.get("worst_fraction") or 0.0,
+            snapshot.get("worst_bound", "?"),
+            snapshot.get("ts", "-"),
+            (snapshot.get("trace_id") or "-")[:16],
+        )
+    ]
+    headers = [
+        "METRIC", "BOUND", "INTENSITY", "RIDGE", "CEILING", "ACHIEVED",
+        "FRACTION", "SOURCE",
+    ]
+    rows = []
+    for metric in sorted(snapshot["metrics"]):
+        entry = snapshot["metrics"][metric]
+        bound = entry.get("bound", "?")
+        rows.append(
+            [
+                metric,
+                bound,
+                f"{entry.get('intensity', 0.0):.3g} F/B",
+                (
+                    f"{entry.get('ridge', 0.0):.3g} F/B"
+                    if bound != "comm"
+                    else "-"
+                ),
+                _fmt_rate(entry.get("ceiling_flops"), bound),
+                _fmt_rate(entry.get("achieved_flops"), bound),
+                f"{entry.get('fraction', 0.0):.3f}",
+                entry.get("cost_source", "?"),
+            ]
+        )
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) for i, h in enumerate(headers)
+    ]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    if any(r[7] == "model" for r in rows):
+        lines.append(
+            "note: 'model' rows are analytic estimates (interpret mode / "
+            "old JAX) — informational, never compared against a TPU bar"
+        )
+    return "\n".join(lines)
+
+
+async def _roofline(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
+    matches = [
+        check
+        for check in payload.get("checks") or []
+        if check.get("healthcheck") == args.name
+        and (args.namespace is None or check.get("namespace") == args.namespace)
+    ]
+    if not matches:
+        where = f" in namespace {args.namespace!r}" if args.namespace else ""
+        print(
+            f"healthcheck {args.name!r}{where} not found in the fleet view",
+            file=sys.stderr,
+        )
+        return 1
+    if args.output == "json":
+        docs = [
+            {"key": check.get("key"), "roofline": check.get("roofline")}
+            for check in matches
+        ]
+        print(_json.dumps(docs[0] if len(docs) == 1 else docs, indent=2))
+        return 0
+    print("\n".join(render_roofline(check) for check in matches))
+    return 0
+
+
 async def _describe(args) -> int:
     import yaml as _yaml
 
@@ -1143,6 +1262,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "status": _status,
         "why": _why,
         "goodput": _goodput,
+        "roofline": _roofline,
     }[args.command]
     if args.command == "run":
         # pre-import the controller's heavy dependency graph BEFORE the

@@ -81,14 +81,25 @@ class RobustZScoreDetector:
 def is_rated_fraction_metric(metric: str) -> bool:
     """The probes' rated-comparison gauges (docs/probes.md metric
     table) all carry the ``fraction-of-rated`` suffix — the contract
-    names use dashes, the exported series underscores; accept both."""
-    return "fraction_of_rated" in metric.replace("-", "_")
+    names use dashes, the exported series underscores; accept both.
+    Roofline fractions (``*-roofline-fraction``, obs/roofline.py) are
+    the same kind of absolute health ratio with a SHARPER denominator —
+    achieved over the kernel's own ceiling rather than the flat peak —
+    so the rated-floor detector floors them too: a memory-bound kernel
+    at 0.6 of flat rated reads healthy (its roofline fraction is near
+    1.0), while 0.6 of its own ceiling is a confirmed degradation on
+    either suffix."""
+    normalized = metric.replace("-", "_")
+    return (
+        "fraction_of_rated" in normalized or "roofline_fraction" in normalized
+    )
 
 
 class RatedFractionDetector:
-    """Absolute floor for ``*-fraction-of-rated`` metrics: the rated
-    tables (probes/rated.py) are the denominator the probe already
-    applied, so the value IS health — no baseline needed, which also
+    """Absolute floor for ``*-fraction-of-rated`` AND
+    ``*-roofline-fraction`` metrics: the rated tables (probes/rated.py)
+    — flat or roofline-derived — are the denominator the probe already
+    applied, so the value IS health; no baseline needed, which also
     means no warm-up blindness for an always-sick slice."""
 
     name = "rated"

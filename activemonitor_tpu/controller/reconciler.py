@@ -1023,6 +1023,7 @@ class HealthCheckReconciler:
                     )
                     samples = MetricsCollector.parse_custom_samples(status)
                     timings = MetricsCollector.parse_phase_timings(status)
+                    roofline = MetricsCollector.parse_roofline(status)
                     # the run lands in the result history on the same
                     # path that writes status — one source for SLO math,
                     # the anomaly detectors AND goodput attribution
@@ -1033,6 +1034,7 @@ class HealthCheckReconciler:
                         workflow=wf_name,
                         metrics=samples,
                         timings=timings,
+                        roofline=roofline,
                     )
                     # the verdict drives the flap state machine; the
                     # durable .status.state mark rides this same write
@@ -1097,6 +1099,7 @@ class HealthCheckReconciler:
                     )
                     samples = MetricsCollector.parse_custom_samples(status)
                     timings = MetricsCollector.parse_phase_timings(status)
+                    roofline = MetricsCollector.parse_roofline(status)
                     self.fleet.record(
                         hc,
                         ok=False,
@@ -1104,6 +1107,7 @@ class HealthCheckReconciler:
                         workflow=wf_name,
                         metrics=samples,
                         timings=timings,
+                        roofline=roofline,
                     )
                     self._note_verdict(hc, ok=False)
                     # failed runs never feed the baselines (their

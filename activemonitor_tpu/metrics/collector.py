@@ -225,6 +225,46 @@ class MetricsCollector:
             ["version", "top"],
             registry=self.registry,
         )
+        # -- roofline families (the stdout contract's `roofline` block,
+        # obs/roofline.py; docs/observability.md "Reading a roofline").
+        # Per-(check, metric-prefix) gauges: the achieved fraction OF
+        # ROOFLINE (not flat rated) with the bound as a label, and the
+        # arithmetic intensity under it. prometheus_client gauges carry
+        # no exemplars, so the runs counter below is the exemplar
+        # carrier joining roofline records back to /debug/traces.
+        self.probe_roofline_fraction = Gauge(
+            "healthcheck_probe_roofline_fraction",
+            "Achieved fraction of the probe metric's own roofline "
+            "ceiling (bound label: compute/memory/comm — the ceiling "
+            "the kernel could ever reach, not the flat rated peak)",
+            [LABEL_HC, "metric", "bound"],
+            registry=self.registry,
+        )
+        self.probe_arithmetic_intensity = Gauge(
+            "healthcheck_probe_arithmetic_intensity",
+            "Arithmetic intensity (FLOPs per HBM byte) of the probe "
+            "metric's kernel, from the XLA or analytic cost model",
+            [LABEL_HC, "metric"],
+            registry=self.registry,
+        )
+        self.hbm_peak_bytes = Gauge(
+            "healthcheck_hbm_peak_bytes",
+            "Peak HBM bytes in use during the probe payload (the "
+            "roofline block's device-memory snapshot; compare against "
+            "the rated HBM capacity)",
+            [LABEL_HC],
+            registry=self.registry,
+        )
+        self.probe_roofline_runs = Counter(
+            "healthcheck_probe_roofline_runs_total",
+            "Probe runs that shipped at least one roofline verdict on "
+            "the bound (one increment per run per bound, however many "
+            "metrics carried it) — carries the cycle's trace id as an "
+            "OpenMetrics exemplar (gauges cannot), joining verdicts to "
+            "/debug/traces",
+            [LABEL_HC, "bound"],
+            registry=self.registry,
+        )
         # probe/controller contract drift: timings-block entries the
         # collector had to drop (previously only a log warning —
         # invisible on /metrics)
@@ -455,6 +495,10 @@ class MetricsCollector:
         # the attribution info series' current (version, top) labels, so
         # a top change drops the stale series instead of leaving two 1s
         self._attribution_info: Optional[tuple] = None
+        # (hc_name, metric) -> last exported bound label: a kernel
+        # crossing the ridge (shape change, new compiler) must move its
+        # fraction series to the new bound, not leave both populated
+        self._roofline_bounds: Dict[tuple, str] = {}
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -769,6 +813,7 @@ class MetricsCollector:
             for raw in doc.get("metrics") or []:
                 recorded += self._record_custom_metric(hc_name, raw)
             self._record_phase_timings(hc_name, doc.get("timings"))
+            self._record_roofline(hc_name, doc.get("roofline"))
         return recorded
 
     @staticmethod
@@ -834,6 +879,91 @@ class MetricsCollector:
                 except (TypeError, ValueError):
                     continue
         return timings
+
+    @staticmethod
+    def parse_roofline(workflow_status: dict) -> Dict[str, dict]:
+        """The run's contract ``roofline`` block as ``{metric-prefix:
+        verdict dict}`` — contract spelling, validated through
+        obs/roofline.py (entries the controller cannot trust are
+        dropped here, once, for every consumer: the result history,
+        attribution, /statusz, flight bundles). Pure read like
+        ``parse_phase_timings``."""
+        from activemonitor_tpu.obs import roofline as roofline_model
+
+        outputs = (workflow_status or {}).get("outputs") or {}
+        parameters = outputs.get("parameters") or []
+        block: Dict[str, dict] = {}
+        for parameter in parameters:
+            value = parameter.get("value") if isinstance(parameter, dict) else None
+            if not isinstance(value, str):
+                continue
+            try:
+                doc = json.loads(value)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(doc, dict):
+                continue
+            raw = doc.get("roofline")
+            if not isinstance(raw, dict):
+                continue
+            for prefix, entry in raw.items():
+                if not isinstance(prefix, str) or not prefix:
+                    continue
+                if roofline_model.valid_entry(entry):
+                    block[prefix] = entry
+        return block
+
+    def _record_roofline(self, hc_name: str, block) -> None:
+        """The contract's ``roofline`` block -> the pinned roofline
+        families. Per entry: fraction gauge under its bound label (a
+        bound flip drops the stale series), intensity gauge, and the
+        exemplar-carrying runs counter; the device-memory snapshot's
+        peak feeds ``healthcheck_hbm_peak_bytes`` (max over entries —
+        they all observed the same device). Invalid entries are skipped
+        silently: the probe-side details already carry the structured
+        skip, and this path must never raise."""
+        if not isinstance(block, dict) or not block:
+            return
+        from activemonitor_tpu.obs import roofline as roofline_model
+
+        exemplar = _exemplar()
+        peak = 0.0
+        bounds_seen = set()
+        for prefix, entry in block.items():
+            if not isinstance(prefix, str) or not prefix:
+                continue
+            if not roofline_model.valid_entry(entry):
+                continue
+            metric = _sanitize(prefix)
+            bound = str(entry["bound"])
+            key = (hc_name, metric)
+            previous = self._roofline_bounds.get(key)
+            if previous is not None and previous != bound:
+                try:
+                    self.probe_roofline_fraction.remove(hc_name, metric, previous)
+                except KeyError:
+                    pass  # never materialized — nothing to drop
+            self._roofline_bounds[key] = bound
+            self.probe_roofline_fraction.labels(hc_name, metric, bound).set(
+                float(entry["fraction"])
+            )
+            self.probe_arithmetic_intensity.labels(hc_name, metric).set(
+                float(entry["intensity"])
+            )
+            bounds_seen.add(bound)
+            try:
+                peak = max(peak, float(entry.get("hbm_peak_bytes") or 0.0))
+            except (TypeError, ValueError):
+                pass  # snapshot field is optional garnish
+        # one increment per run per bound (a battery block carries many
+        # metrics; counting entries would inflate the run count any
+        # coverage dashboard divides by)
+        for bound in sorted(bounds_seen):
+            self.probe_roofline_runs.labels(hc_name, bound).inc(
+                1.0, exemplar=exemplar
+            )
+        if peak > 0:
+            self.hbm_peak_bytes.labels(hc_name).set(peak)
 
     def _record_custom_metric(self, hc_name: str, raw) -> int:
         """One contract entry -> one sample; returns 1 when recorded."""

@@ -1,6 +1,7 @@
 """Observability: span tracing correlated with logs, events, metrics,
 plus the per-check result history, the rolling-window SLO layer, the
-lost-goodput attribution engine, and the degradation flight recorder."""
+lost-goodput attribution engine, the degradation flight recorder, and
+the roofline layer (cost-model evidence under every fraction)."""
 
 from activemonitor_tpu.obs.attribution import (
     BUCKETS,
@@ -10,6 +11,12 @@ from activemonitor_tpu.obs.attribution import (
 )
 from activemonitor_tpu.obs.flightrec import FlightRecorder
 from activemonitor_tpu.obs.history import CheckResult, ResultHistory
+from activemonitor_tpu.obs.roofline import (
+    BOUNDS,
+    RooflineVerdict,
+    classify,
+    classify_comm,
+)
 from activemonitor_tpu.obs.slo import (
     FleetStatus,
     SLOConfig,
@@ -28,7 +35,11 @@ from activemonitor_tpu.obs.trace import (
 
 __all__ = [
     "Attribution",
+    "BOUNDS",
     "BUCKETS",
+    "RooflineVerdict",
+    "classify",
+    "classify_comm",
     "CheckResult",
     "FleetStatus",
     "FlightRecorder",

@@ -64,9 +64,13 @@ TAXONOMY_VERSION = 1
 
 # rated-fraction evidence floor: mirrors the analysis layer's warning
 # floor (analysis/detector.py) so a run the detectors would flag is
-# attributable even for checks without a spec.analysis block
+# attributable even for checks without a spec.analysis block.
+# Roofline fractions (obs/roofline.py) carry the same floor: they are
+# achieved-over-CEILING, so a floored one is stronger evidence still —
+# the kernel is below what it could ever do here, no flat-peak excuse.
 RATED_FLOOR = 0.85
 RATED_SUFFIX = "-fraction-of-rated"
+ROOFLINE_SUFFIX = "-roofline-fraction"
 
 # queue wait above max(floor, fraction × cadence) reads as a scheduling
 # loss: the run was late because it sat in the workqueue, not because
@@ -98,6 +102,24 @@ _SUBSYSTEM_TOKENS = (
 _TOKEN_SPLIT = re.compile(r"[-_.]")
 
 
+def roofline_entry_for(
+    roofline: Optional[Dict[str, dict]], metric: str
+) -> Optional[dict]:
+    """The run's roofline verdict underlying ``metric``, if the payload
+    shipped one (obs/roofline.py block, longest-prefix match)."""
+    from activemonitor_tpu.obs import roofline as roofline_model
+
+    return roofline_model.entry_for_metric(roofline, metric)
+
+
+def roofline_citation(entry: dict) -> str:
+    """The evidence phrase a why-line carries for a roofline verdict:
+    '0.41 of memory-bound ceiling (xla cost model)'."""
+    from activemonitor_tpu.obs import roofline as roofline_model
+
+    return roofline_model.verdict_line(entry)
+
+
 def subsystem_for_metric(name: str) -> Optional[str]:
     """The taxonomy bucket a metric name's vocabulary points at, or
     None for metrics with no subsystem mapping (e.g. ``mxu-*`` compute
@@ -124,6 +146,7 @@ def classify_run(
     ok: bool,
     metrics: Optional[Dict[str, float]] = None,
     timings: Optional[Dict[str, float]] = None,
+    roofline: Optional[Dict[str, dict]] = None,
     anomalies: Optional[Dict[str, str]] = None,
     anomaly_state: str = "ok",
     queue_wait: float = 0.0,
@@ -141,11 +164,16 @@ def classify_run(
     so classification never depends on state that has moved on by the
     time an operator asks.
     """
-    # 1) payload evidence: a floored rated-fraction metric names its
-    #    subsystem directly — the WORST floor wins when several are low
+    # 1) payload evidence: a floored rated- or roofline-fraction metric
+    #    names its subsystem directly — the WORST floor wins when
+    #    several are low. When the run shipped a roofline verdict for
+    #    the floored metric (obs/roofline.py), the evidence line cites
+    #    it: "0.41 of memory-bound ceiling" distinguishes a kernel
+    #    genuinely underperforming its ceiling from one merely far from
+    #    the flat peak.
     worst: Optional[tuple] = None
     for name, value in (metrics or {}).items():
-        if not name.endswith(RATED_SUFFIX):
+        if not name.endswith((RATED_SUFFIX, ROOFLINE_SUFFIX)):
             continue
         try:
             value = float(value)
@@ -156,10 +184,11 @@ def classify_run(
     if worst is not None:
         value, name = worst
         bucket = subsystem_for_metric(name) or "unknown"
-        return Attribution(
-            bucket,
-            f"{name} {value:.3g} below rated floor {RATED_FLOOR:g}",
-        )
+        why = f"{name} {value:.3g} below rated floor {RATED_FLOOR:g}"
+        entry = roofline_entry_for(roofline, name)
+        if entry is not None:
+            why += "; " + roofline_citation(entry)
+        return Attribution(bucket, why)
     # 2) confirmed anomaly verdicts (analysis/engine.py hysteresis) on
     #    a metric whose name maps to a subsystem
     for name, state in sorted((anomalies or {}).items()):

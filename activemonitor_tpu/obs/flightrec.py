@@ -20,6 +20,10 @@ Bundle contract (pinned by the statusz schema contract test):
 - ``baselines`` — the analysis layer's learned stats at trigger time.
 - ``resilience``/``sharding`` — breaker + shard-ownership snapshots.
 - ``attribution`` — the check's windowed lost-goodput decomposition.
+- ``roofline`` — the check's latest roofline snapshot (obs/roofline.py:
+  per-metric bound/intensity/fraction with its cost source) so a
+  postmortem reader sees WHERE against the hardware ceilings the check
+  sat when it degraded.
 - ``extra`` — trigger-specific context (the transition, the shard id…).
 
 Design constraints shared with the tracer/history (obs/trace.py):
@@ -122,8 +126,10 @@ class FlightRecorder:
             self.sharding.snapshot() if self.sharding is not None else None
         )
         attribution = None
+        roofline = None
         if self.fleet is not None and key:
             attribution = self.fleet.check_attribution(key)
+            roofline = self.fleet.check_roofline(key)
         bundle = {
             "id": f"fr-{self._seq:06d}",
             "kind": kind,
@@ -136,6 +142,7 @@ class FlightRecorder:
             "resilience": resilience,
             "sharding": sharding,
             "attribution": attribution,
+            "roofline": roofline,
             # JSON round-trip now: the ring must hold exactly what the
             # JSONL sink and /debug/flightrec serve (tuples → lists,
             # exotic values stringified), not a Python-only shape

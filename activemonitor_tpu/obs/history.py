@@ -52,6 +52,12 @@ class CheckResult:
     # the payload's own phase timings (the stdout contract's "timings"
     # block) — the ReFrame-style raw material goodput attribution reads
     timings: Dict[str, float] = field(default_factory=dict)
+    # the payload's roofline verdicts (the contract's "roofline" block,
+    # obs/roofline.py): metric-prefix -> {bound, intensity, fraction,
+    # cost_source, ...} — the cost-model evidence /statusz, `am-tpu
+    # roofline`, attribution and flight bundles read; empty for runs
+    # without a block (quick mode, old probes)
+    roofline: Dict[str, Dict] = field(default_factory=dict)
     # lost-goodput attribution, stamped AT RECORD TIME while the cycle's
     # spans / anomaly verdicts / breaker state are all still live
     # (obs/attribution.py); "" for unremarkable ok runs
@@ -67,6 +73,7 @@ class CheckResult:
             "trace_id": self.trace_id,
             "metrics": dict(self.metrics),
             "timings": dict(self.timings),
+            "roofline": dict(self.roofline),
             "bucket": self.bucket,
             "why": self.why,
         }
@@ -92,6 +99,7 @@ class ResultHistory:
         trace_id: str = "",
         metrics: Optional[Dict[str, float]] = None,
         timings: Optional[Dict[str, float]] = None,
+        roofline: Optional[Dict[str, Dict]] = None,
         bucket: str = "",
         why: str = "",
     ) -> CheckResult:
@@ -106,6 +114,7 @@ class ResultHistory:
             trace_id=trace_id,
             metrics=dict(metrics or {}),
             timings=dict(timings or {}),
+            roofline=dict(roofline or {}),
             bucket=bucket,
             why=why,
         )

@@ -232,6 +232,7 @@ class FleetStatus:
         workflow: str,
         metrics=None,
         timings=None,
+        roofline=None,
     ) -> None:
         try:
             self._record(
@@ -241,12 +242,13 @@ class FleetStatus:
                 workflow=workflow,
                 metrics=metrics,
                 timings=timings,
+                roofline=roofline,
             )
         except Exception:
             # observability must not fail the status write that feeds it
             log.exception("failed to record result for %s", getattr(hc, "key", "?"))
 
-    def _classify(self, hc, *, ok: bool, metrics, timings) -> tuple:
+    def _classify(self, hc, *, ok: bool, metrics, timings, roofline=None) -> tuple:
         """The run's lost-goodput attribution, judged AT RECORD TIME
         while every evidence source is still live: the cycle's dequeue
         span (queue wait), the analysis layer's confirmed per-metric
@@ -259,14 +261,18 @@ class FleetStatus:
         is garnish on the SLO record, and a classification bug must not
         cost the run its availability/goodput accounting."""
         try:
-            return self._classify_inner(hc, ok=ok, metrics=metrics, timings=timings)
+            return self._classify_inner(
+                hc, ok=ok, metrics=metrics, timings=timings, roofline=roofline
+            )
         except Exception:
             log.exception(
                 "attribution classification failed for %s", getattr(hc, "key", "?")
             )
             return "", ""
 
-    def _classify_inner(self, hc, *, ok: bool, metrics, timings) -> tuple:
+    def _classify_inner(
+        self, hc, *, ok: bool, metrics, timings, roofline=None
+    ) -> tuple:
         key = hc.key
         trace_id = current_trace_id()
         queue_wait = 0.0
@@ -292,6 +298,7 @@ class FleetStatus:
             ok=ok,
             metrics=metrics,
             timings=timings,
+            roofline=roofline,
             anomalies=anomalies,
             anomaly_state=anomaly_state,
             queue_wait=queue_wait,
@@ -312,9 +319,12 @@ class FleetStatus:
         workflow: str,
         metrics=None,
         timings=None,
+        roofline=None,
     ) -> None:
         key = hc.key
-        bucket, why = self._classify(hc, ok=ok, metrics=metrics, timings=timings)
+        bucket, why = self._classify(
+            hc, ok=ok, metrics=metrics, timings=timings, roofline=roofline
+        )
         self.history.record(
             key,
             ok=ok,
@@ -323,6 +333,7 @@ class FleetStatus:
             trace_id=current_trace_id(),
             metrics=metrics,
             timings=timings,
+            roofline=roofline,
             bucket=bucket,
             why=why,
         )
@@ -386,6 +397,17 @@ class FleetStatus:
         )
         return attribution.summarize_results(windowed)
 
+    def check_roofline(self, key: str) -> Optional[dict]:
+        """One check's latest roofline snapshot (obs/roofline.py):
+        the newest run that shipped a validated ``roofline`` block —
+        per-metric bound/intensity/fraction plus the worst-fraction
+        headline — or None when no retained run carried one. Served per
+        check in /statusz (`am-tpu roofline` renders it) and
+        snapshotted into flight bundles."""
+        from activemonitor_tpu.obs import roofline as roofline_model
+
+        return roofline_model.latest_snapshot(self.history.results(key))
+
     def forget(self, key: str, name: str = "", namespace: str = "") -> None:
         """Deleted check: drop its ring, config, and gauge series."""
         self.history.forget(key)
@@ -441,6 +463,10 @@ class FleetStatus:
             # availability above counts (None when the window is empty)
             # — the per-bucket ratios sum to 1 - availability exactly
             "attribution": attribution.summarize_results(windowed),
+            # latest roofline snapshot (obs/roofline.py): the cost-model
+            # verdict under the check's fractions; None until a run
+            # ships the contract's roofline block
+            "roofline": self.check_roofline(key),
             "last_status": hc.status.status
             or self._last_status.get(key, ""),
             "last_trace_id": last.trace_id if last else "",

@@ -21,6 +21,19 @@ your phases from the probe's real structure (``init``/``compile``/
 counted in ``healthcheck_phase_timings_skipped_total{reason}`` — watch
 it after upgrading probes and controller at different times (contract
 drift is visible on /metrics, not just in logs).
+
+The contract also carries an optional ``roofline`` block —
+``{"roofline": {prefix: {bound, intensity, fraction, cost_source,
+...}}}`` (obs/roofline.py ``VERDICT_FIELDS``) — the cost-model verdict
+under each ``<prefix>-roofline-fraction`` gauge: which roofline the
+kernel is on (compute/memory/comm), where it sits against that ceiling,
+and whether the numbers came from XLA's compile-time cost analysis
+(``cost_source: xla``) or the probe's analytic fallback (``model``,
+interpret mode / old JAX — never compared against a TPU bar). The
+controller exports it as ``healthcheck_probe_roofline_fraction{bound}``
+/ ``healthcheck_probe_arithmetic_intensity`` /
+``healthcheck_hbm_peak_bytes`` and threads it through /statusz,
+``am-tpu roofline``, goodput attribution, and flight bundles.
 """
 
 from __future__ import annotations
@@ -84,12 +97,20 @@ class ProbeResult:
     # empty means the probe doesn't attribute its time and the contract
     # line stays byte-identical to the pre-timings form
     timings: Dict[str, float] = field(default_factory=dict)
+    # metric-prefix -> roofline verdict (obs/roofline.py): the cost-model
+    # evidence under each roofline-fraction gauge; skips stay in
+    # `details` only, so the contract carries verdicts exclusively
+    roofline: Dict[str, Dict] = field(default_factory=dict)
 
     def contract_line(self) -> str:
         doc: Dict = {"metrics": [m.to_contract() for m in self.metrics]}
         if self.timings:
             doc["timings"] = {
                 name: float(seconds) for name, seconds in self.timings.items()
+            }
+        if self.roofline:
+            doc["roofline"] = {
+                prefix: dict(entry) for prefix, entry in self.roofline.items()
             }
         return json.dumps(doc)
 

@@ -25,6 +25,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(view with TensorBoard / xprof)",
     )
     parser.add_argument(
+        "--roofline",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="capture the roofline verdict under every numeric probe "
+        "(XLA compile-time cost analysis on TPU, analytic model "
+        "elsewhere — docs/observability.md \"Reading a roofline\"); "
+        "--no-roofline drops the capture and records a structured "
+        "skip in the details",
+    )
+    parser.add_argument(
         "--distributed",
         action="store_true",
         help="force jax.distributed.initialize (multi-host slices; "
@@ -355,6 +365,7 @@ def _dispatch(args) -> int:
             threshold=args.threshold,
             include_ring=not args.no_ring,
             schedules=tuple(s for s in args.schedules.split(",") if s),
+            roofline=args.roofline,
         )
     elif args.probe == "collectives":
         from activemonitor_tpu.probes import collectives
@@ -380,6 +391,7 @@ def _dispatch(args) -> int:
                 iters=args.iters,
                 threshold=args.threshold,
                 cases=cases,
+                roofline=args.roofline,
             )
         else:
             result = collectives.run(
@@ -387,6 +399,7 @@ def _dispatch(args) -> int:
                 iters=args.iters,
                 threshold=args.threshold,
                 cases=cases,
+                roofline=args.roofline,
             )
     elif args.probe == "compile-smoke":
         from activemonitor_tpu.probes import compile_smoke
@@ -410,6 +423,7 @@ def _dispatch(args) -> int:
             zero1=args.zero1,
             remat=args.remat,
             accum_steps=args.accum_steps,
+            roofline=args.roofline,
         )
     elif args.probe == "hbm":
         from activemonitor_tpu.probes import hbm
@@ -419,13 +433,14 @@ def _dispatch(args) -> int:
             iters=args.iters,
             threshold=args.threshold,
             use_pallas=not args.no_pallas,
+            roofline=args.roofline,
         )
     elif args.probe == "matmul":
         from activemonitor_tpu.probes import matmul
 
         result = matmul.run(
             dim=args.dim, iters=args.iters, threshold=args.threshold,
-            dtype=args.dtype,
+            dtype=args.dtype, roofline=args.roofline,
         )
     elif args.probe == "ring-attention":
         from activemonitor_tpu.probes import ring
@@ -439,6 +454,7 @@ def _dispatch(args) -> int:
             use_flash=args.flash,
             variant=args.variant,
             overlap_metrics=not args.no_overlap_metrics,
+            roofline=args.roofline,
         )
     elif args.probe == "flash-attention":
         from activemonitor_tpu.probes import flash
@@ -466,6 +482,7 @@ def _dispatch(args) -> int:
                 causal=not args.no_causal,
                 tolerance=args.tolerance,
                 min_fraction=args.min_fraction,
+                roofline=args.roofline,
             )
     elif args.probe == "decode":
         from activemonitor_tpu.probes import decode
@@ -477,6 +494,7 @@ def _dispatch(args) -> int:
             decode_tokens=args.decode_tokens,
             iters=args.iters,
             use_flash=args.flash,
+            roofline=args.roofline,
         )
     elif args.probe == "memory":
         from activemonitor_tpu.probes import memory
@@ -505,7 +523,9 @@ def _dispatch(args) -> int:
     elif args.probe == "all":
         from activemonitor_tpu.probes import suite
 
-        result = suite.run(quick=args.quick, skip=args.skip)
+        result = suite.run(
+            quick=args.quick, skip=args.skip, roofline=args.roofline
+        )
     else:  # pragma: no cover - argparse guards
         raise SystemExit(2)
     return result.emit()

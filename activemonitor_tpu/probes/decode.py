@@ -41,6 +41,7 @@ def run(
     decode_tokens: int = 32,
     iters: int = 5,
     use_flash: bool = False,
+    roofline: bool = True,
 ) -> ProbeResult:
     """``use_flash`` times the loop through the fused decode kernel
     (ops/flash_attention.flash_decode). Either way a fused-vs-dense
@@ -189,7 +190,7 @@ def run(
             "(informational: near-tie argmax flips are benign)",
         ),
     ]
-    return ProbeResult(
+    result = ProbeResult(
         ok=consistent,
         summary=(
             f"decode {seconds * 1e3:.2f}ms/token, {tokens_per_second:,.0f} tok/s, "
@@ -209,3 +210,31 @@ def run(
             "token_agreement": token_agreement,
         },
     )
+    # roofline verdict under the latency (obs/roofline.py): a decode
+    # step streams every parameter plus the live KV cache per token —
+    # ~2 FLOPs per weight byte, far left of the ridge, so the healthy
+    # verdict is memory-bound near its bandwidth ceiling; a decode step
+    # reading compute-bound means the batch is carrying it (or the
+    # model is tiny). Analytic cost model: the measured program is a
+    # scanned multi-step chain whose XLA totals are per-chain, not
+    # per-token.
+    from activemonitor_tpu.models.probe_model import param_count
+    from activemonitor_tpu.obs import roofline as roofline_model
+
+    dtype_bytes = jnp.dtype(cfg.dtype).itemsize
+    param_bytes = param_count(cfg) * dtype_bytes
+    cache_bytes = (
+        2 * batch * max_seq * cfg.n_layers * cfg.kv_heads
+        * cfg.head_dim * dtype_bytes
+    )
+    roofline_model.apply(
+        result,
+        roofline_model.capture(
+            "decode",
+            seconds=seconds,
+            model_flops=2.0 * param_count(cfg) * batch,
+            model_bytes=float(param_bytes + cache_bytes),
+            enabled=roofline,
+        ),
+    )
+    return result

@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from activemonitor_tpu.obs import roofline as roofline_model
 from activemonitor_tpu.ops.flash_attention import attention_flops, flash_attention
 from activemonitor_tpu.ops.ring_attention import reference_attention
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
@@ -238,6 +239,7 @@ def run(
     causal: bool = True,
     tolerance: float = 2e-2,
     min_fraction: float | None = None,
+    roofline: bool = True,
 ) -> ProbeResult:
     """``min_fraction`` gates the verdict on achieved fwd TFLOP/s as a
     fraction of the chip's rated bf16 peak (BASELINE.md single-chip
@@ -488,4 +490,24 @@ def run(
             f"({'OK' if correct else 'MISMATCH'}) on {device.platform} "
             f"(timing via {kernel})"
         )
-    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    result = ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    # roofline verdict under the fraction (obs/roofline.py): the fused
+    # kernel's whole contract is one blockwise HBM pass — q/k/v read +
+    # out/lse write — which at S=4096 puts intensity far right of the
+    # ridge (compute-bound). Analytic cost model by design: XLA's
+    # compile-time numbers for a Mosaic custom call say nothing about
+    # the kernel's real traffic, and the unfused expression's cost
+    # (materialized [S,S] scores) is the wrong algorithm.
+    tensor_bytes = batch * seq * heads * head_dim * jnp.dtype(dtype).itemsize
+    roofline_model.apply(
+        result,
+        roofline_model.capture(
+            "flash-attention",
+            seconds=flops / (tflops * 1e12) if tflops > 0 else 0.0,
+            model_flops=float(flops),
+            # 3 inputs + output, plus the f32 logsumexp per (b, h, s)
+            model_bytes=float(4 * tensor_bytes + batch * heads * seq * 4),
+            enabled=roofline,
+        ),
+    )
+    return result

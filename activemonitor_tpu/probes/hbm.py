@@ -17,6 +17,7 @@ from activemonitor_tpu.ops.stream import (
     stream_scale_pallas_db,
     stream_scale_xla,
 )
+from activemonitor_tpu.obs import roofline as roofline_model
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 from activemonitor_tpu.utils.timing import chain_delta_seconds
@@ -27,6 +28,7 @@ def run(
     iters: int = 10,
     threshold: float = 0.6,
     use_pallas: bool = True,
+    roofline: bool = True,
 ) -> ProbeResult:
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
@@ -71,6 +73,22 @@ def run(
     seconds = 2 * payload / gbps / 1e9
 
     rated = rated_for(device.device_kind)
+    # roofline evidence (obs/roofline.py): STREAM-scale is the textbook
+    # memory-bound op — one multiply per element against a full
+    # read+write of the payload puts the intensity far left of the
+    # ridge, so a healthy chip reads memory-bound near its ceiling. The
+    # XLA cost comes from the fused XLA expression (same semantics the
+    # Pallas pipelines implement; Mosaic custom calls carry no usable
+    # compile-time cost), the analytic model is the fallback.
+    roofline_capture = roofline_model.capture(
+        "hbm",
+        seconds=seconds,
+        fn=lambda v: stream_scale_xla(v, scale),
+        args=(jax.ShapeDtypeStruct((rows, cols), dtype),),
+        model_flops=float(rows * cols),
+        model_bytes=2.0 * payload,
+        enabled=roofline,
+    )
     metrics = [
         ProbeMetric("hbm-stream-gbps", gbps, help="Achieved STREAM-scale bandwidth, GB/s")
     ]
@@ -97,4 +115,6 @@ def run(
         summary = f"HBM {gbps:.0f} GB/s = {fraction:.0%} of rated {rated.hbm_gbps:.0f} GB/s"
     else:
         summary = f"memory bandwidth {gbps:.1f} GB/s on {device.platform} (no rated comparison)"
-    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    result = ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    roofline_model.apply(result, roofline_capture)
+    return result

@@ -44,6 +44,7 @@ from activemonitor_tpu.parallel.schedules import (
     all_reduce_rsag_bandwidth,
     all_reduce_tree_bandwidth,
 )
+from activemonitor_tpu.obs import roofline as roofline_model
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 
@@ -74,6 +75,7 @@ def run(
     threshold: float = 0.9,
     include_ring: bool = True,
     schedules: Sequence[str] = (),
+    roofline: bool = True,
 ) -> ProbeResult:
     unknown = [s for s in schedules if s not in _SCHEDULE_GAUGES]
     if unknown:
@@ -214,9 +216,31 @@ def run(
             f"all-reduce busbw {result.busbw_gbps:.1f} GB/s = "
             f"{fraction:.0%} of rated {rated_busbw:.0f} GB/s over {n}x {rated.generation}"
         )
+        ceiling = rated_busbw
     else:
         summary = (
             f"all-reduce busbw {result.busbw_gbps:.1f} GB/s over {n} device(s)"
             " (no rated comparison: single device or unknown hardware)"
         )
-    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+        ceiling = None
+    # ICI-roofline verdict under the north-star fraction
+    # (obs/roofline.py): comm-bound by construction, the ceiling is the
+    # same 2x-unidir ring model the fraction already divides by — so
+    # attribution/why lines can cite "0.41 of comm-bound ceiling"
+    # instead of a bare fraction. The intensity is the all-reduce's one
+    # add per wire byte.
+    probe_result = ProbeResult(
+        ok=ok, summary=summary, metrics=metrics, details=details
+    )
+    roofline_model.apply(
+        probe_result,
+        roofline_model.comm_capture(
+            "ici-allreduce",
+            busbw_gbps=result.busbw_gbps,
+            rated_busbw_gbps=ceiling,
+            payload_bytes=float(result.payload_bytes),
+            flops=float(result.payload_bytes) / 2.0,  # bf16: one add/elem
+            enabled=roofline,
+        ),
+    )
+    return probe_result

@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from activemonitor_tpu.obs import roofline as roofline_model
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.probes.rated import rated_for
 from activemonitor_tpu.utils.timing import chain_delta_seconds
@@ -65,6 +66,7 @@ def run(
     threshold: float = 0.75,
     dims: Sequence[int] = (4096, 8192),
     dtype: str = "bf16",
+    roofline: bool = True,
 ) -> ProbeResult:
     if dtype not in ("bf16", "int8"):
         raise ValueError(f"dtype must be bf16 or int8, got {dtype!r}")
@@ -116,6 +118,57 @@ def run(
     }
     if tuple(dims) != requested_dims:
         details["requested_dims"] = list(requested_dims)  # downsized off-TPU
+    # roofline evidence under the fraction (obs/roofline.py): a square
+    # matmul sits far right of the ridge, so the verdict should read
+    # compute-bound with the ceiling at the flat peak — anything else
+    # (or a low fraction) says the MXU itself is sick, not the memory
+    # system. XLA's compiled cost is captured over ONE chain op (the
+    # dot + the dtype wrap that keeps the chain data-dependent);
+    # int8 runs are classified against the int8 roofline.
+    if dtype == "int8":
+        accum, operand = jnp.int32, jnp.int8
+    else:
+        accum, operand = jnp.bfloat16, jnp.bfloat16
+
+    def one_op(a, b):
+        return jnp.dot(a, b, preferred_element_type=accum).astype(operand)
+
+    itemsize = jnp.dtype(operand).itemsize
+    roofline_prefix = "mxu-int8" if dtype == "int8" else "mxu"
+    roofline_spec = None
+    if rated is not None and rated_peak > 0:
+        import dataclasses
+
+        # the generation's peak for THIS throughput mode (int8 is rated
+        # 2x bf16 on v5e+, which also doubles the ridge point)
+        roofline_spec = dataclasses.replace(rated, bf16_tflops=rated_peak)
+    if not roofline:
+        roofline_capture = roofline_model.skip_capture(
+            roofline_prefix, "disabled (--no-roofline)"
+        )
+    elif rated is not None and rated_peak <= 0:
+        # the generation has no such mode (int8 on v4): there is NO
+        # roofline to stand this run on — an explicit skip, because
+        # letting capture() fall back to the device spec would judge
+        # the int8 kernel against the bf16 ceiling and flag a healthy
+        # chip as a confirmed rated degradation
+        roofline_capture = roofline_model.skip_capture(
+            roofline_prefix,
+            f"no rated {dtype} roofline for {rated.generation}",
+        )
+    else:
+        shape = jax.ShapeDtypeStruct((dim, dim), operand)
+        roofline_capture = roofline_model.capture(
+            roofline_prefix,
+            seconds=seconds,
+            fn=one_op,
+            args=(shape, shape),
+            model_flops=2.0 * dim**3,
+            model_bytes=3.0 * dim * dim * itemsize,
+            spec=roofline_spec,
+            enabled=roofline,
+        )
+
     ok = True
     # rated_peak == 0 means the generation has no such mode (int8 on
     # v4): informational pass rather than a division by zero
@@ -128,4 +181,6 @@ def run(
         summary = f"{dtype} matmul {tflops:.0f} {unit} = {fraction:.0%} of rated {rated_peak:.0f}"
     else:
         summary = f"{dtype} matmul {tflops:.2f} {unit} on {device.platform} (no rated comparison)"
-    return ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    result = ProbeResult(ok=ok, summary=summary, metrics=metrics, details=details)
+    roofline_model.apply(result, roofline_capture)
+    return result

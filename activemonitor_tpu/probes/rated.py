@@ -10,6 +10,14 @@ variables for new silicon or corrected ratings:
     ACTIVEMONITOR_RATED_INT8_TOPS
     ACTIVEMONITOR_RATED_HBM_GBPS
     ACTIVEMONITOR_RATED_ICI_GBPS   (per-link, one direction)
+    ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE   (roofline ridge point)
+
+The bf16 peak and HBM bandwidth together define the chip's roofline
+(obs/roofline.py): the ridge point — peak FLOP/s over HBM byte/s, in
+FLOPs per byte — is where the memory-bandwidth ceiling meets the
+compute ceiling. :func:`ridge_point` derives it from the (already
+override-validated) table figures, with its own validated override for
+silicon whose effective ridge diverges from the paper numbers.
 """
 
 from __future__ import annotations
@@ -31,6 +39,16 @@ class RatedSpec:
     ici_unidir_gbps: float  # ICI bandwidth per link, one direction, GB/s
     ici_links: int  # ICI links per chip
     int8_tops: float = 0.0  # peak dense int8 matmul TOP/s per chip (0 = n/a)
+
+    @property
+    def ridge_flops_per_byte(self) -> float:
+        """Roofline ridge point: rated peak FLOP/s / rated HBM byte/s.
+        Below this arithmetic intensity a kernel is memory-bound (its
+        ceiling is intensity x bandwidth); above it, compute-bound
+        (the ceiling is the flat bf16 peak). Derived, so the validated
+        bf16/HBM overrides flow through; :func:`ridge_point` adds the
+        direct override."""
+        return self.bf16_tflops * 1e12 / (self.hbm_gbps * 1e9)
 
 
 # device_kind substrings -> rated spec
@@ -88,6 +106,18 @@ TRAIN_MFU_BAR = float(os.environ.get("ACTIVEMONITOR_TRAIN_MFU_BAR", "0.15"))
 FLASH_FRACTION_BAR = float(
     os.environ.get("ACTIVEMONITOR_FLASH_FRACTION_BAR", "0.40")
 )
+
+
+def ridge_point(spec: RatedSpec) -> float:
+    """The spec's roofline ridge point (FLOPs/byte), env-overridable
+    through the same validation as every other rated figure: it is the
+    DENOMINATOR-side pivot of every bound classification, so a
+    malformed or non-positive override falls back to the derived value
+    with a warning — it must never flip a healthy memory-bound kernel
+    into a "badly underperforming compute-bound" verdict."""
+    return _override(
+        spec.ridge_flops_per_byte, "ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE"
+    )
 
 
 def rated_for(device_kind: str) -> Optional[RatedSpec]:

@@ -52,6 +52,7 @@ def run(
     quick: bool = False,
     skip: Optional[List[str]] = None,
     compile_cache: bool = True,
+    roofline: bool = True,
 ) -> ProbeResult:
     skip = set(skip or [])
     if compile_cache:
@@ -94,16 +95,16 @@ def run(
     # reports the same max-over-dims signal as `probes matmul`. The
     # probe itself owns the off-TPU downsizing.
     if quick:
-        add("matmul", lambda: matmul.run(dims=(4096,), iters=iters))
+        add("matmul", lambda: matmul.run(dims=(4096,), iters=iters, roofline=roofline))
     else:
-        add("matmul", lambda: matmul.run(iters=iters))
+        add("matmul", lambda: matmul.run(iters=iters, roofline=roofline))
         # the MXU's other throughput mode (v5e+); v4/unknown chips
         # degrade to an informational pass inside the probe. Same full
         # dim sweep as bf16: which dim the compiler tiles best varies,
         # and a single pinned dim could fail a healthy chip
-        add("matmul-int8", lambda: matmul.run(iters=iters, dtype="int8"))
-    add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters))
-    add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters))
+        add("matmul-int8", lambda: matmul.run(iters=iters, dtype="int8", roofline=roofline))
+    add("hbm", lambda: hbm.run(size_mb=128 if quick else 256, iters=iters, roofline=roofline))
+    add("ici-allreduce", lambda: ici.run(size_mb=16 if quick else 64, iters=iters, roofline=roofline))
     from activemonitor_tpu.probes import collectives as collectives_probe
 
     # the ici probe already measured all-reduce and the ring hop; the
@@ -114,6 +115,7 @@ def run(
             size_mb=16 if quick else 64,
             iters=iters,
             cases=("allgather", "reducescatter", "alltoall"),
+            roofline=roofline,
         ),
     )
     if not quick:
@@ -135,6 +137,7 @@ def run(
             seq_per_device=256 if quick else 1024,
             iters=iters,
             overlap_metrics=not quick,
+            roofline=roofline,
         ),
     )
     from activemonitor_tpu.probes import flash
@@ -161,6 +164,7 @@ def run(
             seq=_quick_seq() if quick else None,
             iters=iters,
             min_fraction=None if quick else FLASH_FRACTION_BAR,
+            roofline=roofline,
         ),
     )
     # full mode runs the SAME shape bench.py's train() calibration
@@ -174,11 +178,14 @@ def run(
             batch_per_device=4 if quick else 8,
             seq=64 if quick else 128,
             mfu_threshold=None if quick else TRAIN_MFU_BAR,
+            roofline=roofline,
         ),
     )
     add(
         "decode",
-        lambda: decode.run(tiny=quick, batch=4, prompt_len=8, iters=iters),
+        lambda: decode.run(
+            tiny=quick, batch=4, prompt_len=8, iters=iters, roofline=roofline
+        ),
     )
     from activemonitor_tpu.probes import straggler, transfer
 
@@ -199,6 +206,8 @@ def run(
     metrics = []
     failed = []
     merged_timings: dict = dict(timings)
+    merged_roofline: dict = {}
+    roofline_skipped: dict = {}
     for name, result in results:
         metrics.extend(result.metrics)
         # a sub-probe attributing its own phases nests under its name
@@ -206,6 +215,15 @@ def run(
         # time
         for phase, seconds in result.timings.items():
             merged_timings[f"{name}.{phase}"] = seconds
+        # roofline verdicts merge under their own metric prefixes (the
+        # prefixes are battery-unique by construction); STRUCTURED
+        # skips are collected too — a quick-mode/interpret run that
+        # could not run cost analysis must say so in the details, not
+        # silently omit the roofline fields
+        merged_roofline.update(result.roofline)
+        for prefix, entry in (result.details.get("roofline") or {}).items():
+            if isinstance(entry, dict) and "skipped" in entry:
+                roofline_skipped[prefix] = entry["skipped"]
         status = "OK " if result.ok else "FAIL"
         print(f"  [{status}] {name}: {result.summary}", file=sys.stderr)
         if not result.ok:
@@ -216,10 +234,14 @@ def run(
         if ok
         else f"{len(failed)}/{len(results)} probes failed: {', '.join(failed)}"
     )
+    details = {"probes_run": len(results), "failed": failed}
+    if roofline_skipped:
+        details["roofline_skipped"] = roofline_skipped
     return ProbeResult(
         ok=ok,
         summary=summary,
         metrics=metrics,
-        details={"probes_run": len(results), "failed": failed},
+        details=details,
         timings=merged_timings,
+        roofline=merged_roofline,
     )

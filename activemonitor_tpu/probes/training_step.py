@@ -509,6 +509,7 @@ def run(
     zero1: bool = False,
     remat: bool = False,
     accum_steps: int = 1,
+    roofline: bool = True,
 ) -> ProbeResult:
     """``mfu_threshold`` turns the MFU gauge into a VERDICT: when set
     and a rated spec exists for the hardware, achieved MFU below the
@@ -542,9 +543,26 @@ def run(
         data_sh,
     )
 
-    # cold step (compile), forced through a host readback
+    # cold step (compile), forced through a host readback. The compile
+    # goes through the AOT path when it can, for two reasons: the
+    # roofline capture below reads cost_analysis() off the VERY
+    # executable the timing measures (a second lower+compile of the
+    # battery's most expensive program would roughly double the probe's
+    # compile bill), and the timed loop then calls the same compiled
+    # object the traced path would have cached anyway.
     t0 = time.perf_counter()
-    params, opt_state, loss = step_fn(params, opt_state, tokens)
+    step_callable = step_fn
+    xla_cost = None
+    try:
+        compiled_step = step_fn.lower(params, opt_state, tokens).compile()
+    except Exception:
+        compiled_step = None  # legacy lowering quirk: traced jit path
+    if compiled_step is not None:
+        from activemonitor_tpu.utils.compat import compiled_cost_analysis
+
+        step_callable = compiled_step
+        xla_cost = compiled_cost_analysis(compiled_step)
+    params, opt_state, loss = step_callable(params, opt_state, tokens)
     losses = [float(loss)]
     compile_seconds = time.perf_counter() - t0
 
@@ -555,7 +573,7 @@ def run(
         t0 = time.perf_counter()
         loss = None
         for _ in range(k):
-            params, opt_state, loss = step_fn(params, opt_state, tokens)
+            params, opt_state, loss = step_callable(params, opt_state, tokens)
         value = float(loss)
         return time.perf_counter() - t0, value
 
@@ -638,7 +656,7 @@ def run(
             ok = False
         else:
             details["mfu_gate"] = "passed"
-    return ProbeResult(
+    result = ProbeResult(
         ok=bool(ok),
         summary=(
             f"train step {step_seconds * 1e3:.1f}ms, "
@@ -647,3 +665,35 @@ def run(
         metrics=metrics,
         details=details,
     )
+    # roofline evidence under the MFU (obs/roofline.py): the XLA cost
+    # was read off the COMPILED step executable itself — the very
+    # program the timing measured, no second compile — so on TPU the
+    # intensity reflects what the compiler actually scheduled
+    # (remat/zero1/accum change it), with the 3x-fwd analytic model
+    # plus one parameter+optimizer streaming pass as the
+    # interpret-mode/legacy fallback. Small probe models are often
+    # memory-bound: a LOW MFU with a healthy memory-bound roofline
+    # fraction is an overhead-bound probe shape, not a sick chip —
+    # exactly the ambiguity this verdict exists to resolve.
+    from activemonitor_tpu.obs import roofline as roofline_model
+
+    n_devices = mesh.devices.size
+    param_bytes = param_count(cfg) * 4  # f32 master weights
+    roofline_model.apply(
+        result,
+        roofline_model.capture(
+            "train",
+            seconds=step_seconds,
+            xla_cost=xla_cost,
+            model_flops=model_flops / n_devices,
+            # per device: activations ~ 3 passes over token embeddings
+            # per layer, plus params + AdamW mu/nu read and written
+            model_bytes=float(3 * param_bytes / n_devices)
+            + float(
+                3 * cfg.n_layers * tokens_per_step * cfg.d_model * 2 / n_devices
+            ),
+            device=mesh_device,
+            enabled=roofline,
+        ),
+    )
+    return result

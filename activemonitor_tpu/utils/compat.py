@@ -61,6 +61,62 @@ def axis_size(axis_name) -> int:
     return int(getattr(frame, "size", frame))
 
 
+def compiled_cost_analysis(compiled):
+    """Normalize an ALREADY-compiled executable's ``cost_analysis()``
+    to ``{"flops": float, "bytes_accessed": float, "output_bytes":
+    float}``, or None when any vintage boundary gets in the way.
+
+    The raw API moved twice: it returns a one-dict LIST on older
+    jaxlibs and a bare dict on newer ones, and the keys are XLA's
+    space-separated spellings ("bytes accessed", "bytes
+    accessedout{}"). Roofline classification (obs/roofline.py) must
+    not care, and a backend without the analysis (some plugin
+    runtimes) must read as "unavailable", never as a crash inside a
+    health probe. Callers holding a compiled object (AOT probes that
+    time the very executable they analyze) come here directly;
+    :func:`compile_cost_analysis` wraps the lower-and-compile step for
+    everyone else.
+    """
+    try:
+        raw = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    try:
+        flops = float(raw.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(raw.get("bytes accessed", 0.0) or 0.0)
+        output_bytes = float(raw.get("bytes accessedout{}", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if flops <= 0 or bytes_accessed <= 0:
+        # an analysis missing either half is no analysis: some plugin
+        # backends report flops with zero bytes (or vice versa), and
+        # handing that downstream would discard the caller's analytic
+        # fallback in favor of a degenerate-cost skip
+        return None
+    return {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "output_bytes": output_bytes,
+    }
+
+
+def compile_cost_analysis(fn, *args, **kwargs):
+    """XLA's compile-time cost analysis for ``fn(*args)`` — lower +
+    compile + :func:`compiled_cost_analysis`, never raising."""
+    import jax
+
+    try:
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jitted.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    return compiled_cost_analysis(compiled)
+
+
 def shard_map(
     f,
     *,

@@ -525,10 +525,12 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     n = len(devices)
     platform = devices[0].platform
 
+    primary_result = None
     if platform == "tpu" and n > 1:
         from activemonitor_tpu.probes import ici
 
         result = ici.run(size_mb=64, iters=5, threshold=_TARGET_FRACTION)
+        primary_result = result
         by_name = {m.name: m.value for m in result.metrics}
         fraction = by_name.get("ici-allreduce-fraction-of-rated")
         if fraction is not None:
@@ -556,9 +558,9 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
         runs = []
         for _ in range(3):
             result = matmul.run(iters=5, threshold=_TARGET_FRACTION)
-            runs.append({m.name: m.value for m in result.metrics})
-        runs.sort(key=lambda r: r.get("mxu-matmul-tflops", 0))
-        by_name = runs[len(runs) // 2]
+            runs.append((result, {m.name: m.value for m in result.metrics}))
+        runs.sort(key=lambda r: r[1].get("mxu-matmul-tflops", 0))
+        primary_result, by_name = runs[len(runs) // 2]
         fraction = by_name.get("mxu-fraction-of-rated")
         if fraction is not None:
             doc = {
@@ -579,6 +581,7 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
         from activemonitor_tpu.probes import ici
 
         result = ici.run(size_mb=8, iters=3)
+        primary_result = result
         by_name = {m.name: m.value for m in result.metrics}
         # a CPU number measures nothing against the TPU baseline — but
         # it CAN be compared against the previous CPU-mesh round, so
@@ -612,6 +615,7 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     doc["device_kind"] = devices[0].device_kind
     _stamp_attribution(doc)
     _stamp_autotune(doc)
+    _stamp_roofline(doc, primary_result)
     return doc
 
 
@@ -654,6 +658,43 @@ def _stamp_autotune(doc: dict) -> None:
         }
     except Exception as exc:  # pragma: no cover - defensive
         print(f"autotune stamp failed: {exc!r}", file=sys.stderr)
+
+
+def _stamp_roofline(doc: dict, result) -> None:
+    """Stamp the primary probe's roofline evidence (obs/roofline.py)
+    into the artifact as ``roofline_summary`` — per metric prefix the
+    bound, arithmetic intensity, fraction-of-roofline and cost source,
+    plus any structured skip reasons — so every BENCH_r*.json says
+    whether its fraction was measured against a real ceiling and where
+    the cost numbers came from. CPU-fallback rounds carry
+    ``interpret_mode: true`` with ``cost_source: model`` entries (or
+    skips): labeled evidence, never read against a TPU bar. Guarded:
+    a broken block costs this stamp, not the artifact."""
+    try:
+        block = dict(getattr(result, "roofline", None) or {})
+        detail = (getattr(result, "details", None) or {}).get("roofline") or {}
+        skipped = {
+            prefix: entry["skipped"]
+            for prefix, entry in detail.items()
+            if isinstance(entry, dict) and "skipped" in entry
+        }
+        summary = {
+            "interpret_mode": doc.get("platform") != "tpu",
+            "metrics": {
+                prefix: {
+                    "bound": entry.get("bound"),
+                    "intensity": round(float(entry.get("intensity", 0.0)), 4),
+                    "fraction": round(float(entry.get("fraction", 0.0)), 4),
+                    "cost_source": entry.get("cost_source"),
+                }
+                for prefix, entry in block.items()
+            },
+        }
+        if skipped:
+            summary["skipped"] = skipped
+        doc["roofline_summary"] = summary
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"roofline stamp failed: {exc!r}", file=sys.stderr)
 
 
 def _stamp_attribution(doc: dict) -> None:

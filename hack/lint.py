@@ -45,14 +45,16 @@ positive that makes `make lint` cry wolf is worse than a miss):
 - wallclock-in-<unit>: `time.time()` / `time.monotonic()` calls in
   files under a `resilience/` or `analysis/` directory, or in the
   clock-disciplined modules (`sharding.py`, `attribution.py`,
-  `flightrec.py`) — those units' whole contract is the injectable
-  Clock (breaker open windows, token-bucket refill, baseline
+  `flightrec.py`, `roofline.py`) — those units' whole contract is the
+  injectable Clock (breaker open windows, token-bucket refill, baseline
   timestamps, shard lease expiry/fencing windows, attribution windows
-  and flight-bundle timestamps must be scriptable by fake-clock
-  tests); a bare wall-clock read there silently breaks determinism.
+  and flight-bundle timestamps must be scriptable by fake-clock tests;
+  roofline classification is pure math over seconds passed IN as
+  arguments); a bare wall-clock read there silently breaks determinism.
   The finding code carries the unit (`wallclock-in-resilience`,
   `wallclock-in-analysis`, `wallclock-in-sharding`,
-  `wallclock-in-attribution`, `wallclock-in-flightrec`).
+  `wallclock-in-attribution`, `wallclock-in-flightrec`,
+  `wallclock-in-roofline`).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -146,6 +148,7 @@ class Checker(ast.NodeVisitor):
             "sharding.py",  # lease expiry, fencing windows, shed cooldowns
             "attribution.py",  # goodput windows judged on result timestamps
             "flightrec.py",  # bundle timestamps ride scripted transitions
+            "roofline.py",  # pure math over seconds passed in as args
         ):
             # single-file modules carrying the same injectable-Clock
             # contract as the resilience/analysis packages

@@ -689,3 +689,106 @@ def test_swallowed_exception_fires_and_stays_quiet(tmp_path):
         "    return 1\n"
     )
     assert findings(tmp_path, quiet) == []
+
+
+def test_wallclock_banned_in_roofline_module(tmp_path):
+    """obs/roofline.py is pure math over seconds passed IN as
+    arguments (ISSUE 9 satellite): a bare wall-clock read there would
+    silently couple bound classification to real time — same
+    module-name keying as the sharding/attribution bans."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    (tmp_path / "roofline.py").write_text(source)
+    got = lint.lint_file(tmp_path / "roofline.py")
+    assert {line.split(": ")[1] for line in got} == {"wallclock-in-roofline"}
+    assert len(got) == 2
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="ceilings.py") == []
+
+
+def test_roofline_module_really_is_wallclock_free():
+    """The gate, applied: the shipped module lints clean and the ban
+    covers it (path-scoping regression guard)."""
+    path = REPO / "activemonitor_tpu" / "obs" / "roofline.py"
+    assert path.exists(), "roofline module missing?"
+    assert lint.lint_file(path) == []
+    src = path.read_text()
+    checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+    assert checker.ban_wallclock
+    assert checker.wallclock_pkg == "roofline"
+
+
+def test_roofline_families_are_pinned():
+    """The ISSUE-9 families must stay in the exposition contract — the
+    roofline dashboards key on the bound label and a rename silently
+    orphans them."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_roofline", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_probe_roofline_fraction",
+        "healthcheck_probe_arithmetic_intensity",
+        "healthcheck_hbm_peak_bytes",
+        "healthcheck_probe_roofline_runs_total",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
+def test_roofline_metric_names_are_pinned():
+    """The ISSUE-9 contract suffixes and the per-probe capture are
+    pinned across three layers — the probes build the gauges from the
+    obs/roofline.py suffix constants, docs/probes.md's roofline table
+    registers the names (the spellings spec.analysis.metrics[] takes),
+    and bench.py stamps the roofline_summary block — so a rename in
+    any one layer cannot silently orphan the others (the same gate the
+    overlap/zoo metrics got)."""
+    from activemonitor_tpu.obs import roofline as roofline_model
+
+    assert roofline_model.INTENSITY_SUFFIX == "-arithmetic-intensity"
+    assert roofline_model.FRACTION_SUFFIX == "-roofline-fraction"
+    docs = (REPO / "docs" / "probes.md").read_text()
+    for name in (
+        "mxu-arithmetic-intensity",
+        "mxu-roofline-fraction",
+        "hbm-arithmetic-intensity",
+        "hbm-roofline-fraction",
+        "flash-attention-roofline-fraction",
+        "train-roofline-fraction",
+        "decode-roofline-fraction",
+        "ring-attention-roofline-fraction",
+        "ici-allreduce-roofline-fraction",
+        "healthcheck_probe_roofline_fraction",
+        "healthcheck_probe_arithmetic_intensity",
+        "healthcheck_hbm_peak_bytes",
+    ):
+        assert name in docs, f"{name} missing from docs/probes.md"
+    # every integrated probe routes through the capture helpers, so the
+    # suffix constants are the single spelling source
+    for rel, symbol in (
+        ("probes/matmul.py", "roofline_model.capture"),
+        ("probes/hbm.py", "roofline_model.capture"),
+        ("probes/flash.py", "roofline_model.capture"),
+        ("probes/training_step.py", "roofline_model.capture"),
+        ("probes/decode.py", "roofline_model.capture"),
+        ("probes/ring.py", "roofline_model.capture"),
+        ("probes/ici.py", "roofline_model.comm_capture"),
+        ("probes/collectives.py", "roofline_model.comm_capture"),
+    ):
+        src = (REPO / "activemonitor_tpu" / rel).read_text()
+        assert symbol in src, f"{rel} no longer captures a roofline"
+    # the "Reading a roofline" section the metric table points at
+    observability = (REPO / "docs" / "observability.md").read_text()
+    assert "Reading a roofline" in observability
+    assert "ridge point" in observability.lower()
+    assert "am-tpu roofline" in observability
+    # bench.py's artifact stamp (both paths; interpret runs labeled)
+    bench_src = (REPO / "bench.py").read_text()
+    for key in ("roofline_summary", "_stamp_roofline", "cost_source"):
+        assert key in bench_src, f"bench.py no longer records {key}"

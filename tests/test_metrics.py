@@ -207,6 +207,12 @@ PINNED_FAMILIES = {
     "healthcheck_goodput_lost_ratio": "gauge",
     "healthcheck_goodput_attribution_info": "gauge",
     "healthcheck_phase_timings_skipped_total": "counter",
+    # roofline families (ISSUE 9: cost-model evidence under every
+    # fraction — docs/observability.md "Reading a roofline")
+    "healthcheck_probe_roofline_fraction": "gauge",
+    "healthcheck_probe_arithmetic_intensity": "gauge",
+    "healthcheck_hbm_peak_bytes": "gauge",
+    "healthcheck_probe_roofline_runs_total": "counter",
     "healthcheck_slo_availability_ratio": "gauge",
     "healthcheck_error_budget_remaining": "gauge",
     "healthcheck_slo_burn_rate": "gauge",
@@ -286,15 +292,16 @@ def exercise_every_family(collector):
         error_budget_remaining=0.5,
         burn_rate=0.5,
     )
+    contract = (
+        '{"metrics": [], "timings": {"p": 1.0}, "roofline": {"mxu": '
+        '{"bound": "compute", "intensity": 2048.0, "fraction": 0.9, '
+        '"ceiling_flops": 1.97e14, "achieved_flops": 1.77e14, '
+        '"ridge": 240.5, "cost_source": "xla", "flops": 1.0e11, '
+        '"hbm_bytes": 5.0e7, "hbm_peak_bytes": 2.0e9}}}'
+    )
     collector.record_custom_metrics(
         "hc-a",
-        {
-            "outputs": {
-                "parameters": [
-                    {"name": "m", "value": '{"metrics": [], "timings": {"p": 1.0}}'}
-                ]
-            }
-        },
+        {"outputs": {"parameters": [{"name": "m", "value": contract}]}},
     )
 
 

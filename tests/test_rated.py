@@ -70,3 +70,35 @@ def test_rated_for_applies_good_override(monkeypatch):
     monkeypatch.setenv(ENV, "200")
     spec = rated_for("TPU v5 lite")
     assert spec.bf16_tflops == 200.0
+
+
+def test_every_generation_has_a_finite_ridge_point():
+    """ISSUE-9 satellite: the roofline ridge point (peak FLOP/s over
+    HBM byte/s) must be derivable — positive and finite — for every
+    generation in the table; it is the pivot of every bound
+    classification (obs/roofline.py)."""
+    import math
+
+    from activemonitor_tpu.probes.rated import _RATED, ridge_point
+
+    for _needle, spec in _RATED:
+        ridge = spec.ridge_flops_per_byte
+        assert math.isfinite(ridge) and ridge > 0, spec.generation
+        assert ridge == spec.bf16_tflops * 1e12 / (spec.hbm_gbps * 1e9)
+        assert ridge_point(spec) == ridge  # no override set
+
+
+def test_ridge_point_override_follows_hbm_override(monkeypatch):
+    """The ridge derives from the (already validated) bf16/HBM figures,
+    so overriding the HBM bandwidth moves the ridge consistently; the
+    direct ridge override then wins, with the same fallback rules."""
+    from activemonitor_tpu.probes.rated import rated_for, ridge_point
+
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_HBM_GBPS", "1638")  # 2x v5e
+    spec = rated_for("TPU v5 lite")
+    assert spec.hbm_gbps == 1638.0
+    assert spec.ridge_flops_per_byte == spec.bf16_tflops * 1e12 / 1638e9
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", "99.5")
+    assert ridge_point(spec) == 99.5
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", "-4")
+    assert ridge_point(spec) == spec.ridge_flops_per_byte

@@ -89,7 +89,11 @@ def test_probe_runs_and_reports(mesh):
         "ring-attention-tflops",
         "ring-overlap-efficiency",
         "ring-attention-busbw-gbps",
+        # roofline evidence (ISSUE 9): intensity always; the fraction
+        # needs a rated spec, absent on the CPU mesh (structured skip)
+        "ring-attention-arithmetic-intensity",
     }
+    assert "skipped" in result.details["roofline"]["ring-attention"]
     assert result.details["devices"] == 8
     assert result.details["seq"] == 16 * 8
     assert result.details["variant"] == "overlap"

@@ -1,0 +1,900 @@
+"""Roofline layer (ISSUE 9): ridge-point math and bound classification
+(obs/roofline.py), the compat cost-analysis shim, probe-side capture
+with structured skips (`cost_source: model` off-TPU, never a TPU-bar
+comparison), the contract `roofline` block through the collector's
+pinned families, /statusz + flight bundles + `am-tpu roofline`, and
+the attribution↔roofline consistency acceptance (memory-bound verdict
+⇒ `hbm` bucket, conservation intact).
+"""
+
+import asyncio
+import collections
+import json
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.engine.base import PHASE_FAILED, PHASE_SUCCEEDED
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs import FleetStatus
+from activemonitor_tpu.obs import roofline as roofline_model
+from activemonitor_tpu.obs.attribution import BUCKETS, classify_run
+from activemonitor_tpu.probes.rated import RatedSpec, ridge_point
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+V5E = RatedSpec(
+    "v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4
+)
+
+
+def make_hc(name="hc-roof", repeat=60):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": repeat,
+                "level": "cluster",
+                "backoffMax": 1,
+                "backoffMin": 1,
+                "workflow": {
+                    "generateName": f"{name}-",
+                    "workflowtimeout": 30,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "sa",
+                        "source": {"inline": WF_INLINE},
+                    },
+                },
+            },
+        }
+    )
+
+
+def verdict_entry(
+    bound="memory",
+    fraction=0.41,
+    intensity=0.5,
+    cost_source="xla",
+    **extra,
+):
+    entry = {
+        "bound": bound,
+        "intensity": intensity,
+        "fraction": fraction,
+        "ceiling_flops": 4.1e11,
+        "achieved_flops": 1.7e11,
+        "ridge": 240.5,
+        "cost_source": cost_source,
+        "flops": 8.4e6,
+        "hbm_bytes": 1.7e7,
+    }
+    entry.update(extra)
+    return entry
+
+
+# ---------------------------------------------------------------------
+# rated table: ridge point + validated override (ISSUE satellite)
+# ---------------------------------------------------------------------
+
+
+def test_ridge_point_derivation_and_override(monkeypatch):
+    # v5e: 197e12 / 819e9 ≈ 240.5 FLOPs/byte, exactly P/B
+    assert V5E.ridge_flops_per_byte == pytest.approx(197e12 / 819e9)
+    assert ridge_point(V5E) == pytest.approx(V5E.ridge_flops_per_byte)
+    # valid override wins
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", "120.5")
+    assert ridge_point(V5E) == pytest.approx(120.5)
+    # malformed / non-positive / non-finite fall back (same _override
+    # rules as every rated figure) — the ridge is the pivot of every
+    # bound classification and must never go invalid
+    for bad in ("twelve", "0", "-3", "inf", "nan"):
+        monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", bad)
+        assert ridge_point(V5E) == pytest.approx(V5E.ridge_flops_per_byte)
+
+
+# ---------------------------------------------------------------------
+# pure classification math
+# ---------------------------------------------------------------------
+
+
+def test_classify_memory_bound_exact():
+    # intensity 0.5 F/B, far left of the ridge: ceiling = I × B
+    v = roofline_model.classify(
+        flops=1e6, hbm_bytes=2e6, seconds=1e-3, spec=V5E
+    )
+    assert v.bound == "memory"
+    assert v.intensity == pytest.approx(0.5)
+    assert v.ceiling_flops == pytest.approx(0.5 * 819e9)
+    assert v.achieved_flops == pytest.approx(1e9)
+    assert v.fraction == pytest.approx(1e9 / (0.5 * 819e9))
+    assert v.ridge == pytest.approx(197e12 / 819e9)
+
+
+def test_classify_compute_bound_exact():
+    # a 4096³ matmul: intensity ≈ 1365 F/B, right of the ridge
+    dim = 4096
+    flops = 2 * dim**3
+    hbm_bytes = 3 * dim * dim * 2
+    v = roofline_model.classify(
+        flops=flops, hbm_bytes=hbm_bytes, seconds=flops / 150e12, spec=V5E
+    )
+    assert v.bound == "compute"
+    assert v.intensity > v.ridge
+    assert v.ceiling_flops == pytest.approx(197e12)
+    assert v.fraction == pytest.approx(150e12 / 197e12)
+
+
+def test_classify_honors_the_ridge_override(monkeypatch):
+    # intensity 100 F/B sits LEFT of the derived v5e ridge (~240) —
+    # memory-bound by default; an operator declaring the effective
+    # ridge at 50 (silicon diverging from paper numbers) must flip the
+    # bound to compute, ceiling at the flat peak — the override is the
+    # pivot of classification, not just a displayed field
+    kwargs = dict(flops=100e6, hbm_bytes=1e6, seconds=1e-3, spec=V5E)
+    default = roofline_model.classify(**kwargs)
+    assert default.bound == "memory"
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", "50")
+    overridden = roofline_model.classify(**kwargs)
+    assert overridden.bound == "compute"
+    assert overridden.ridge == pytest.approx(50.0)
+    assert overridden.ceiling_flops == pytest.approx(197e12)
+
+
+def test_memory_ceiling_is_clamped_to_the_flat_peak(monkeypatch):
+    # ridge overridden ABOVE the derived one: intensity 300 F/B is now
+    # memory-bound, but I×B (~246 TF/s) exceeds the 197 TF/s peak — the
+    # ceiling must clamp to min(P, I×B) or a healthy chip at 96% of
+    # peak reads as a sub-floor degradation
+    monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", "500")
+    v = roofline_model.classify(
+        flops=300e6, hbm_bytes=1e6, seconds=300e6 / 190e12, spec=V5E
+    )
+    assert v.bound == "memory"
+    assert v.ceiling_flops == pytest.approx(197e12)
+    assert v.fraction == pytest.approx(190e12 / 197e12)
+
+
+def test_classify_rejects_degenerate_inputs():
+    for kwargs in (
+        {"flops": 0, "hbm_bytes": 1, "seconds": 1},
+        {"flops": 1, "hbm_bytes": 0, "seconds": 1},
+        {"flops": 1, "hbm_bytes": 1, "seconds": 0},
+    ):
+        assert roofline_model.classify(spec=V5E, **kwargs) is None
+
+
+def test_classify_comm_uses_the_ici_roofline():
+    v = roofline_model.classify_comm(
+        busbw_gbps=60.0, rated_busbw_gbps=90.0, payload_bytes=1e6, flops=5e5
+    )
+    assert v.bound == "comm"
+    assert v.fraction == pytest.approx(60.0 / 90.0)
+    assert v.intensity == pytest.approx(0.5)
+    assert roofline_model.classify_comm(busbw_gbps=1.0, rated_busbw_gbps=0) is None
+
+
+def test_entry_validation_and_prefix_match():
+    good = verdict_entry()
+    assert roofline_model.valid_entry(good)
+    assert not roofline_model.valid_entry({"bound": "comm"})  # trio missing
+    assert not roofline_model.valid_entry(verdict_entry(bound="weird"))
+    assert not roofline_model.valid_entry(verdict_entry(fraction="0.4"))
+    assert not roofline_model.valid_entry("nope")
+    block = {"mxu": verdict_entry(bound="compute"), "mxu-int8": good}
+    # longest prefix wins: the int8 fraction maps to the int8 verdict
+    assert (
+        roofline_model.entry_for_metric(block, "mxu-int8-fraction-of-rated")
+        is block["mxu-int8"]
+    )
+    assert (
+        roofline_model.entry_for_metric(block, "mxu-fraction-of-rated")
+        is block["mxu"]
+    )
+    assert roofline_model.entry_for_metric(block, "hbm-stream-gbps") is None
+    assert roofline_model.entry_for_metric(None, "mxu") is None
+
+
+def test_valid_entry_rejects_non_finite_values():
+    # JSON round-trips NaN/Infinity without error; the trust gate must
+    # drop them before they poison min(), the gauges, or strict-JSON
+    # /statusz consumers
+    for bad in (float("nan"), float("inf"), float("-inf")):
+        assert not roofline_model.valid_entry(verdict_entry(fraction=bad))
+        assert not roofline_model.valid_entry(verdict_entry(intensity=bad))
+    nan_payload = json.loads(json.dumps({"fraction": float("nan")}))
+    assert nan_payload["fraction"] != nan_payload["fraction"]  # NaN survives
+
+
+def test_int8_without_an_int8_mode_skips_instead_of_misjudging(monkeypatch):
+    # v4 has no int8 MXU mode (int8_tops=0): the probe must record an
+    # explicit skip, NOT let the capture fall back to the device's bf16
+    # roofline and flag a healthy chip as a rated degradation
+    from activemonitor_tpu.probes import matmul
+
+    v4 = RatedSpec(
+        "v4", bf16_tflops=275.0, hbm_gbps=1228.0, ici_unidir_gbps=45.0,
+        ici_links=6,
+    )
+    monkeypatch.setattr(matmul, "rated_for", lambda _kind: v4)
+    result = matmul.run(dim=128, iters=1, dtype="int8")
+    names = [m.name for m in result.metrics]
+    assert "mxu-int8-roofline-fraction" not in names
+    assert "mxu-int8-arithmetic-intensity" not in names
+    skip = result.details["roofline"]["mxu-int8"]["skipped"]
+    assert "no rated int8 roofline" in skip and "v4" in skip
+
+
+def test_verdict_line_spelling():
+    assert (
+        roofline_model.verdict_line(verdict_entry())
+        == "0.41 of memory-bound ceiling (xla cost model)"
+    )
+
+
+# ---------------------------------------------------------------------
+# compat shim
+# ---------------------------------------------------------------------
+
+
+def test_compile_cost_analysis_normalizes_shapes():
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.utils.compat import compile_cost_analysis
+
+    cost = compile_cost_analysis(
+        lambda a, b: a @ b,
+        jnp.ones((128, 128), jnp.bfloat16),
+        jnp.ones((128, 128), jnp.bfloat16),
+    )
+    # this container's jaxlib returns a one-dict LIST with XLA's
+    # space-separated keys; the shim must hand back the normalized trio
+    assert cost is not None
+    assert cost["flops"] >= 2 * 128**3
+    assert cost["bytes_accessed"] > 0
+    assert set(cost) == {"flops", "bytes_accessed", "output_bytes"}
+    # a non-lowerable input reads as unavailable, never a raise
+    assert compile_cost_analysis("not a function") is None
+    # an analysis missing either half is no analysis: the caller's
+    # analytic fallback must engage instead of a degenerate-cost skip
+    from activemonitor_tpu.utils.compat import compiled_cost_analysis
+
+    class FlopsOnly:
+        @staticmethod
+        def cost_analysis():
+            return [{"flops": 5.0}]
+
+    class BytesOnly:
+        @staticmethod
+        def cost_analysis():
+            return {"bytes accessed": 7.0}
+
+    assert compiled_cost_analysis(FlopsOnly()) is None
+    assert compiled_cost_analysis(BytesOnly()) is None
+
+
+def test_capture_reuses_a_precomputed_xla_cost_on_tpu():
+    # an AOT probe (training-step) hands capture() the cost analysis of
+    # the VERY executable it timed — no second compile; honored only on
+    # TPU (interpret-mode policy: analytic model, labeled as such)
+    class FakeTpu:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+        @staticmethod
+        def memory_stats():
+            return {}
+
+    cost = {"flops": 2e12, "bytes_accessed": 4e9, "output_bytes": 1e9}
+    cap = roofline_model.capture(
+        "train", seconds=0.02, xla_cost=cost, spec=V5E, device=FakeTpu()
+    )
+    entry = cap.block["train"]
+    assert entry["cost_source"] == "xla"
+    assert entry["intensity"] == pytest.approx(2e12 / 4e9)
+    assert entry["achieved_flops"] == pytest.approx(2e12 / 0.02)
+    # off-TPU the same precomputed cost is ignored for the analytic model
+    cap = roofline_model.capture(
+        "train", seconds=0.02, xla_cost=cost,
+        model_flops=1e12, model_bytes=2e9, spec=V5E,
+    )
+    assert cap.block["train"]["cost_source"] == "model"
+    assert cap.block["train"]["flops"] == pytest.approx(1e12)
+
+
+# ---------------------------------------------------------------------
+# probe-side capture
+# ---------------------------------------------------------------------
+
+
+def test_capture_model_fallback_is_labeled_and_verdicts(monkeypatch):
+    # CPU + injected spec: the analytic model classifies, labeled
+    # `model` — the full verdict path without TPU hardware
+    cap = roofline_model.capture(
+        "mxu",
+        seconds=1e-3,
+        model_flops=2 * 4096**3,
+        model_bytes=3 * 4096 * 4096 * 2,
+        spec=V5E,
+    )
+    assert not cap.skipped
+    assert cap.block["mxu"]["cost_source"] == "model"
+    assert cap.block["mxu"]["bound"] == "compute"
+    names = [m.name for m in cap.metrics]
+    assert names == ["mxu-arithmetic-intensity", "mxu-roofline-fraction"]
+    assert cap.details["roofline"]["mxu"] is cap.block["mxu"]
+
+
+def test_capture_without_spec_keeps_intensity_and_skips_fraction():
+    # interpret mode on unknown silicon: intensity is still evidence,
+    # but there is no rated roofline — the fraction is a STRUCTURED
+    # skip, never a TPU-bar comparison
+    cap = roofline_model.capture(
+        "hbm", seconds=1e-3, model_flops=1e6, model_bytes=2e6
+    )
+    assert [m.name for m in cap.metrics] == ["hbm-arithmetic-intensity"]
+    assert cap.block == {}
+    assert "no rated roofline" in cap.details["roofline"]["hbm"]["skipped"]
+
+
+def test_capture_skip_reasons_are_structured():
+    disabled = roofline_model.capture("mxu", seconds=1.0, enabled=False)
+    assert disabled.skipped
+    assert "disabled" in disabled.details["roofline"]["mxu"]["skipped"]
+    no_model = roofline_model.capture("mxu", seconds=1.0, spec=V5E)
+    assert "no analytic model" in no_model.details["roofline"]["mxu"]["skipped"]
+    degenerate = roofline_model.capture(
+        "mxu", seconds=0.0, model_flops=1.0, model_bytes=1.0, spec=V5E
+    )
+    assert "degenerate" in degenerate.details["roofline"]["mxu"]["skipped"]
+
+
+def test_probe_contract_carries_the_roofline_block():
+    from activemonitor_tpu.probes.base import ProbeResult
+
+    result = ProbeResult(ok=True, summary="s")
+    roofline_model.apply(
+        result,
+        roofline_model.capture(
+            "mxu", seconds=1e-3, model_flops=2e12, model_bytes=3e7, spec=V5E
+        ),
+    )
+    doc = json.loads(result.contract_line())
+    assert "roofline" in doc
+    assert doc["roofline"]["mxu"]["bound"] == "compute"
+    # and skips stay OUT of the contract (details-only)
+    skipped = ProbeResult(ok=True, summary="s")
+    roofline_model.apply(
+        skipped, roofline_model.capture("mxu", seconds=1.0, enabled=False)
+    )
+    assert "roofline" not in json.loads(skipped.contract_line())
+    assert "roofline" in skipped.details
+
+
+def test_matmul_probe_emits_intensity_and_structured_skip_on_cpu():
+    from activemonitor_tpu.probes import matmul
+
+    result = matmul.run(dim=128, iters=1)
+    names = [m.name for m in result.metrics]
+    assert "mxu-arithmetic-intensity" in names
+    # CPU: no rated spec, so no fraction — and the omission is recorded
+    assert "mxu-roofline-fraction" not in names
+    assert "skipped" in result.details["roofline"]["mxu"]
+    # --no-roofline drops the capture but still records why
+    result = matmul.run(dim=128, iters=1, roofline=False)
+    assert "mxu-arithmetic-intensity" not in [m.name for m in result.metrics]
+    assert "disabled" in result.details["roofline"]["mxu"]["skipped"]
+
+
+def test_collectives_probe_records_skips_on_non_rated_hardware():
+    # the collectives sweep on CPU/interpret hardware has no ICI
+    # roofline: every builtin case must record a structured skip, not
+    # silently omit the fields (the same contract as every capture).
+    # Driven through _emit with canned measurements — the skip logic
+    # lives there, and real collectives would spend tier-1 budget on
+    # compiles that prove nothing extra.
+    from activemonitor_tpu.parallel.collectives import CollectiveResult
+    from activemonitor_tpu.probes import collectives
+
+    def entry(label, base):
+        return (
+            label, base, 4,
+            CollectiveResult(
+                name=base, payload_bytes=1 << 20, n_devices=4,
+                seconds_per_op=1e-3, algbw_gbps=1.0, busbw_gbps=1.0,
+            ),
+        )
+
+    # CPU run (this test's platform): no rated spec ⇒ structured skip
+    result = collectives._emit(
+        [entry("allgather", "allgather")], 0.8, "ctx", {}
+    )
+    skip = result.details["roofline"]["collective-allgather"]["skipped"]
+    assert "no rated ICI ceiling" in skip
+    # zoo cases say WHY they carry no verdict even on rated silicon
+    result = collectives._emit(
+        [entry("allgather-ring", "allgather-ring")], 0.8, "ctx", {}
+    )
+    skip = result.details["roofline"]["collective-allgather-ring"]["skipped"]
+    assert "modeled algorithmic bar" in skip
+    # --no-roofline wins over every other reason
+    result = collectives._emit(
+        [entry("allgather", "allgather")], 0.8, "ctx", {}, roofline=False
+    )
+    skip = result.details["roofline"]["collective-allgather"]["skipped"]
+    assert "disabled" in skip
+
+
+def test_suite_collects_structured_skip_reasons():
+    # the quick-mode contract (ISSUE satellite): a battery whose probes
+    # could not run cost analysis carries the reasons in details —
+    # asserted on the suite's merge logic with canned sub-results
+    from activemonitor_tpu.probes import suite as suite_module
+    from activemonitor_tpu.probes.base import ProbeResult
+
+    verdict = ProbeResult(ok=True, summary="ok")
+    roofline_model.apply(
+        verdict,
+        roofline_model.capture(
+            "mxu", seconds=1e-3, model_flops=2e12, model_bytes=3e7, spec=V5E
+        ),
+    )
+    skipped = ProbeResult(ok=True, summary="ok")
+    roofline_model.apply(
+        skipped,
+        roofline_model.capture("hbm", seconds=1.0, model_flops=1e6, model_bytes=2e6),
+    )
+
+    results = [("matmul", verdict), ("hbm", skipped)]
+    merged: dict = {}
+    skips: dict = {}
+    for _name, result in results:
+        merged.update(result.roofline)
+        for prefix, entry in (result.details.get("roofline") or {}).items():
+            if isinstance(entry, dict) and "skipped" in entry:
+                skips[prefix] = entry["skipped"]
+    assert "mxu" in merged and "hbm" not in merged
+    assert "no rated roofline" in skips["hbm"]
+    # the shipped suite.run really implements that merge (source pin —
+    # the fake above must not drift from the real battery)
+    import inspect
+
+    src = inspect.getsource(suite_module.run)
+    assert "roofline_skipped" in src and "merged_roofline" in src
+
+
+# ---------------------------------------------------------------------
+# collector: parse + pinned families
+# ---------------------------------------------------------------------
+
+
+def contract_status(metrics=None, roofline=None):
+    doc = {"metrics": metrics or []}
+    if roofline is not None:
+        doc["roofline"] = roofline
+    return {
+        "outputs": {
+            "parameters": [{"name": "metrics", "value": json.dumps(doc)}]
+        }
+    }
+
+
+def test_parse_roofline_validates_entries():
+    status = contract_status(
+        roofline={
+            "hbm": verdict_entry(),
+            "bad-bound": verdict_entry(bound="mystery"),
+            "bad-types": {"bound": "memory", "intensity": "x", "fraction": 1},
+            "": verdict_entry(),
+        }
+    )
+    parsed = MetricsCollector.parse_roofline(status)
+    assert list(parsed) == ["hbm"]
+    assert MetricsCollector.parse_roofline({}) == {}
+    assert MetricsCollector.parse_roofline({"outputs": {"parameters": [
+        {"name": "m", "value": "not json"}
+    ]}}) == {}
+
+
+def test_record_roofline_families_and_bound_flip():
+    mc = MetricsCollector()
+    labels = lambda bound: {  # noqa: E731 - tiny local shorthand
+        "healthcheck_name": "hc-a", "metric": "hbm", "bound": bound,
+    }
+    mc.record_custom_metrics(
+        "hc-a",
+        contract_status(roofline={"hbm": verdict_entry(hbm_peak_bytes=2.5e9)}),
+        run_id="wf-1",
+    )
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_fraction", labels("memory")
+    ) == pytest.approx(0.41)
+    assert mc.sample_value(
+        "healthcheck_probe_arithmetic_intensity",
+        {"healthcheck_name": "hc-a", "metric": "hbm"},
+    ) == pytest.approx(0.5)
+    assert mc.sample_value(
+        "healthcheck_hbm_peak_bytes", {"healthcheck_name": "hc-a"}
+    ) == pytest.approx(2.5e9)
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_runs_total",
+        {"healthcheck_name": "hc-a", "bound": "memory"},
+    ) == 1.0
+    # a replay with the same run id records nothing (shared dedupe)
+    mc.record_custom_metrics(
+        "hc-a", contract_status(roofline={"hbm": verdict_entry()}), run_id="wf-1"
+    )
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_runs_total",
+        {"healthcheck_name": "hc-a", "bound": "memory"},
+    ) == 1.0
+    # a multi-metric block on ONE bound increments the runs counter
+    # once (per run per bound), not once per entry — coverage
+    # dashboards divide by it as a run count
+    mc.record_custom_metrics(
+        "hc-b",
+        contract_status(
+            roofline={
+                "hbm": verdict_entry(),
+                "decode": verdict_entry(fraction=0.8),
+                "mxu": verdict_entry(bound="compute"),
+            }
+        ),
+        run_id="wf-b1",
+    )
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_runs_total",
+        {"healthcheck_name": "hc-b", "bound": "memory"},
+    ) == 1.0
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_runs_total",
+        {"healthcheck_name": "hc-b", "bound": "compute"},
+    ) == 1.0
+    # the kernel crosses the ridge: the stale bound series must drop,
+    # not linger beside the new one
+    mc.record_custom_metrics(
+        "hc-a",
+        contract_status(roofline={"hbm": verdict_entry(bound="compute")}),
+        run_id="wf-2",
+    )
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_fraction", labels("memory")
+    ) is None
+    assert mc.sample_value(
+        "healthcheck_probe_roofline_fraction", labels("compute")
+    ) == pytest.approx(0.41)
+
+
+# ---------------------------------------------------------------------
+# /statusz + flight bundle + history snapshots
+# ---------------------------------------------------------------------
+
+
+def test_latest_snapshot_skips_blockless_runs():
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    fleet.record(
+        hc, ok=True, latency=1.0, workflow="w1",
+        roofline={"hbm": verdict_entry(fraction=0.9)},
+    )
+    fleet.record(hc, ok=True, latency=1.0, workflow="w2")  # quick run: none
+    snapshot = fleet.check_roofline(hc.key)
+    assert snapshot is not None
+    assert snapshot["worst"] == "hbm"
+    assert snapshot["worst_fraction"] == pytest.approx(0.9)
+    assert snapshot["worst_bound"] == "memory"
+    # and the /statusz entry carries it (schema test pins the field)
+    entry = json.loads(json.dumps(fleet.check_summary(hc)))
+    assert entry["roofline"]["metrics"]["hbm"]["fraction"] == pytest.approx(0.9)
+    # history entries round-trip the block
+    assert entry["history"][0]["roofline"]["hbm"]["bound"] == "memory"
+    assert entry["history"][1]["roofline"] == {}
+
+
+def test_worst_fraction_headline_picks_the_minimum():
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    fleet.record(
+        hc, ok=True, latency=1.0, workflow="w",
+        roofline={
+            "mxu": verdict_entry(bound="compute", fraction=0.93),
+            "hbm": verdict_entry(fraction=0.58),
+        },
+    )
+    snapshot = fleet.check_roofline(hc.key)
+    assert snapshot["worst"] == "hbm"
+    assert snapshot["worst_fraction"] == pytest.approx(0.58)
+
+
+def test_flight_bundle_attaches_the_roofline_snapshot():
+    from activemonitor_tpu.obs.flightrec import KIND_DEGRADED, FlightRecorder
+
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    hc = make_hc()
+    fleet.record(
+        hc, ok=False, latency=1.0, workflow="w",
+        metrics={"hbm-fraction-of-rated": 0.41},
+        roofline={"hbm": verdict_entry()},
+    )
+    recorder = FlightRecorder(clock)
+    recorder.fleet = fleet
+    recorder.history = fleet.history
+    bundle = recorder.record(KIND_DEGRADED, hc.key)
+    assert bundle["roofline"]["worst"] == "hbm"
+    assert bundle["roofline"]["metrics"]["hbm"]["cost_source"] == "xla"
+    # a bundle for a check with no roofline evidence carries null
+    fleet.record(make_hc("hc-bare"), ok=True, latency=1.0, workflow="w")
+    bare = recorder.record(KIND_DEGRADED, "health/hc-bare")
+    assert bare["roofline"] is None
+
+
+# ---------------------------------------------------------------------
+# attribution ↔ roofline consistency
+# ---------------------------------------------------------------------
+
+
+def test_classify_run_cites_the_roofline_verdict():
+    verdict = classify_run(
+        ok=False,
+        metrics={"hbm-fraction-of-rated": 0.41},
+        roofline={"hbm": verdict_entry()},
+    )
+    assert verdict.bucket == "hbm"
+    assert "0.41 of memory-bound ceiling (xla cost model)" in verdict.why
+    # floored roofline fractions are first-class floor evidence too
+    verdict = classify_run(
+        ok=False,
+        metrics={"ici-allreduce-roofline-fraction": 0.3},
+        roofline={"ici-allreduce": verdict_entry(bound="comm", fraction=0.3)},
+    )
+    assert verdict.bucket == "ici"
+    assert "comm-bound ceiling" in verdict.why
+    # without a matching block entry the why stays the bare floor line
+    verdict = classify_run(ok=False, metrics={"hbm-fraction-of-rated": 0.41})
+    assert verdict.bucket == "hbm"
+    assert "ceiling" not in verdict.why
+
+
+# acceptance (ISSUE satellite): scripted FakeClock+FakeEngine fleet —
+# the roofline verdict says memory-bound, the lost-goodput share lands
+# in `hbm`, and conservation still holds through the gauges.
+
+SCRIPT = (
+    [(True, {"hbm-fraction-of-rated": 0.95}, {"hbm": verdict_entry(fraction=0.97)})]
+    * 8
+    + [
+        (
+            False,
+            {"hbm-fraction-of-rated": 0.41},
+            {"hbm": verdict_entry(fraction=0.41)},
+        )
+    ]
+    * 2
+)
+
+
+def scripted_engine(script):
+    engine = FakeWorkflowEngine()
+    queue = collections.deque(script)
+    assigned = {}
+
+    def completer(wf, _count):
+        name = wf["metadata"]["name"]
+        if name not in assigned:
+            if not queue:
+                return None
+            assigned[name] = queue.popleft()
+        ok, metrics, roofline = assigned[name]
+        status = {"phase": PHASE_SUCCEEDED if ok else PHASE_FAILED}
+        if not ok:
+            status["message"] = "scripted failure"
+        doc = {
+            "metrics": [
+                {"name": name_, "value": value}
+                for name_, value in (metrics or {}).items()
+            ]
+        }
+        if roofline is not None:
+            doc["roofline"] = roofline
+        status["outputs"] = {
+            "parameters": [{"name": "metrics", "value": json.dumps(doc)}]
+        }
+        return status
+
+    engine._default_completer = completer
+    return engine
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+async def drive_runs(clock, count, interval=60.0, first=False):
+    for i in range(count):
+        if not first or i > 0:
+            await clock.advance(interval)
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+
+
+def build_controller(clock, client, engine):
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=2)
+    manager._health_addr = "127.0.0.1:0"
+    return manager, reconciler, metrics
+
+
+@pytest.mark.asyncio
+async def test_acceptance_memory_bound_lands_in_hbm_and_conserves(capsys):
+    import aiohttp
+
+    from activemonitor_tpu.__main__ import _roofline, build_parser
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    manager, reconciler, metrics = build_controller(
+        clock, client, scripted_engine(SCRIPT)
+    )
+    await manager.start()
+    try:
+        hc = make_hc("hc-roof")
+        await client.apply(hc)
+        await drive_runs(clock, len(SCRIPT), first=True)
+        key = "health/hc-roof"
+        results = reconciler.fleet.history.results(key)
+        assert [r.ok for r in results] == [ok for ok, _m, _r in SCRIPT]
+        # record-time attribution: the memory-bound roofline verdict
+        # lands the lost runs in the hbm bucket, citing the ceiling
+        for lost in results[8:]:
+            assert lost.bucket == "hbm"
+            assert "0.41 of memory-bound ceiling" in lost.why
+            assert lost.roofline["hbm"]["bound"] == "memory"
+
+        # /statusz: per-check roofline block + conservation intact
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port}/statusz") as r:
+                assert r.status == 200
+                payload = await r.json()
+        fleet = payload["fleet"]
+        assert fleet["goodput_ratio"] == pytest.approx(0.8)
+        assert fleet["goodput"]["attribution"]["hbm"] == pytest.approx(0.2)
+        assert sum(fleet["goodput"]["attribution"].values()) == pytest.approx(
+            1.0 - fleet["goodput_ratio"], abs=1e-9
+        )
+        [entry] = payload["checks"]
+        assert entry["roofline"]["worst"] == "hbm"
+        assert entry["roofline"]["worst_bound"] == "memory"
+        assert entry["roofline"]["metrics"]["hbm"]["fraction"] == pytest.approx(0.41)
+
+        # the same conservation through the gauges
+        lost = {
+            bucket: metrics.sample_value(
+                "healthcheck_goodput_lost_ratio", {"subsystem": bucket}
+            )
+            for bucket in BUCKETS
+        }
+        ratio = metrics.sample_value("healthcheck_fleet_goodput_ratio", {})
+        assert ratio == pytest.approx(0.8)
+        assert sum(lost.values()) == pytest.approx(1.0 - ratio, abs=1e-9)
+        assert lost["hbm"] == pytest.approx(0.2)
+        # the roofline families landed from the same contract
+        assert metrics.sample_value(
+            "healthcheck_probe_roofline_fraction",
+            {"healthcheck_name": "hc-roof", "metric": "hbm", "bound": "memory"},
+        ) == pytest.approx(0.41)
+        assert metrics.sample_value(
+            "healthcheck_probe_roofline_runs_total",
+            {"healthcheck_name": "hc-roof", "bound": "memory"},
+        ) == float(len(SCRIPT))
+
+        # `am-tpu roofline` renders from the live endpoint
+        url = f"http://127.0.0.1:{port}/statusz"
+        args = build_parser().parse_args(["roofline", "hc-roof", "--url", url])
+        assert await _roofline(args) == 0
+        out = capsys.readouterr().out
+        assert "worst=hbm" in out
+        assert "memory" in out and "0.410" in out
+        # unknown check: clean usage failure
+        args = build_parser().parse_args(["roofline", "nope", "--url", url])
+        assert await _roofline(args) == 1
+    finally:
+        await manager.stop()
+
+
+# ---------------------------------------------------------------------
+# CLI rendering + flags
+# ---------------------------------------------------------------------
+
+
+def test_roofline_cli_flags_parse():
+    from activemonitor_tpu.__main__ import build_parser
+
+    args = build_parser().parse_args(["roofline", "hc-a"])
+    assert args.name == "hc-a"
+    assert args.namespace is None and args.url is None
+    assert args.output == "text"
+    args = build_parser().parse_args(
+        ["roofline", "hc-a", "-n", "prod", "-o", "json", "--url", "http://x/statusz"]
+    )
+    assert args.namespace == "prod" and args.output == "json"
+    # the probe CLI grew the --roofline toggle
+    from activemonitor_tpu.probes.cli import build_parser as probe_parser
+
+    probe_args = probe_parser().parse_args(["matmul"])
+    assert probe_args.roofline is True
+    probe_args = probe_parser().parse_args(["--no-roofline", "matmul"])
+    assert probe_args.roofline is False
+
+
+def test_render_roofline_pins_the_table():
+    from activemonitor_tpu.__main__ import render_roofline
+
+    check = {
+        "key": "health/hc-a",
+        "roofline": {
+            "ts": "2026-01-01T00:00:00+00:00",
+            "trace_id": "abc123",
+            "worst": "hbm",
+            "worst_fraction": 0.58,
+            "worst_bound": "memory",
+            "metrics": {
+                "hbm": verdict_entry(fraction=0.58),
+                "mxu": verdict_entry(
+                    bound="compute",
+                    fraction=0.93,
+                    intensity=1365.0,
+                    cost_source="model",
+                    ceiling_flops=197e12,
+                    achieved_flops=183e12,
+                ),
+                "ici-allreduce": verdict_entry(
+                    bound="comm",
+                    fraction=0.91,
+                    intensity=0.5,
+                    ceiling_flops=90e9,
+                    achieved_flops=82e9,
+                ),
+            },
+        },
+    }
+    text = render_roofline(check)
+    lines = text.splitlines()
+    assert lines[0].startswith("health/hc-a  worst=hbm 0.58 (memory-bound)")
+    header = lines[1].split()
+    assert header == [
+        "METRIC", "BOUND", "INTENSITY", "RIDGE", "CEILING", "ACHIEVED",
+        "FRACTION", "SOURCE",
+    ]
+    body = "\n".join(lines[2:])
+    assert "memory" in body and "compute" in body and "comm" in body
+    # comm rows render GB/s against their byte/s ceilings, no ridge
+    assert "90.0 GB/s" in body and "197.0 TF/s" in body
+    # model-sourced rows get the never-a-TPU-bar note
+    assert "never compared against a TPU bar" in lines[-1]
+    # a check with no evidence says so instead of an empty table
+    empty = render_roofline({"key": "health/hc-b", "roofline": None})
+    assert "no roofline evidence" in empty

@@ -356,6 +356,9 @@ CHECK_FIELDS = {
     # lost-goodput attribution over the check's window (ISSUE 7): None
     # while the window is empty
     "attribution": (dict, type(None)),
+    # latest roofline snapshot (ISSUE 9): None until a run ships the
+    # contract's roofline block
+    "roofline": (dict, type(None)),
     "remedy_budget_remaining": (int, type(None)),
     "last_status": str,
     "last_trace_id": str,
@@ -390,6 +393,9 @@ HISTORY_FIELDS = {
     "metrics": dict,
     # the run's phase timings + record-time attribution (ISSUE 7)
     "timings": dict,
+    # the run's roofline verdicts (ISSUE 9: the contract's roofline
+    # block riding the ring into every surface)
+    "roofline": dict,
     "bucket": str,
     "why": str,
 }
@@ -427,6 +433,8 @@ BUNDLE_FIELDS = {
     "resilience": (dict, type(None)),
     "sharding": (dict, type(None)),
     "attribution": (dict, type(None)),
+    # the check's latest roofline snapshot (ISSUE 9)
+    "roofline": (dict, type(None)),
     "extra": dict,
 }
 BREAKER_FIELDS = {
