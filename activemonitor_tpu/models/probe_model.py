@@ -105,28 +105,34 @@ def init_params(key: jax.Array, cfg: ProbeModelConfig) -> Dict:
     return params
 
 
-def param_specs(cfg: ProbeModelConfig) -> Dict:
-    """PartitionSpec tree matching init_params: megatron tp over "model"."""
-    if cfg.kv_heads == cfg.n_heads:
-        attn = {"wqkv": P(None, None, "model", None)}  # heads sharded
-    else:
-        attn = {
-            "wq": P(None, "model", None),
-            "wkv": P(None, None, "model", None),  # kv heads sharded
-        }
-    layer = {
-        "ln1": {"scale": P()},
-        **attn,
-        "wo": P("model", None, None),
-        "ln2": {"scale": P()},
-        "w_up": P(None, "model"),  # hidden dim sharded
-        "w_down": P("model", None),
-    }
-    return {
-        "embed": P(None, None),
-        "layers": [layer] * cfg.n_layers,
-        "final_ln": {"scale": P()},
-    }
+def param_partition_rules(tp_axis: str = "model"):
+    """The megatron tensor-parallel layout as DATA — regex partition
+    rules resolved over the (MHA or GQA) parameter tree by
+    ``parallel/partition.match_partition_rules``. Attention heads and
+    the MLP hidden dim shard over ``tp_axis``; norms/embeddings fall
+    through to the replicated default. Re-meshing the probe model is an
+    edit to this tuple, never to the forward code."""
+    return (
+        ("^embed$", P(None, None)),
+        (r"wqkv$", P(None, None, tp_axis, None)),  # heads sharded
+        (r"wkv$", P(None, None, tp_axis, None)),  # kv heads sharded
+        (r"wq$", P(None, tp_axis, None)),
+        (r"wo$", P(tp_axis, None, None)),
+        (r"w_up$", P(None, tp_axis)),  # hidden dim sharded
+        (r"w_down$", P(tp_axis, None)),
+        # ln/final_ln scales: unmatched → replicated P()
+    )
+
+
+def param_specs(cfg: ProbeModelConfig, tp_axis: str = "model") -> Dict:
+    """PartitionSpec tree matching init_params — the
+    :func:`param_partition_rules` regex rules resolved over the
+    abstract parameter tree (tests pin the result against the
+    hand-threaded megatron layout this replaced)."""
+    from activemonitor_tpu.parallel.partition import match_partition_rules
+
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    return match_partition_rules(param_partition_rules(tp_axis), abstract)
 
 
 def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
@@ -173,7 +179,7 @@ def flash_attention_fn(cfg: ProbeModelConfig, mesh=None, axis: str = "model"):
     dense path), shard_map needs the heads dim to divide evenly — a
     too-large tp axis is rejected up front with the actual constraint
     rather than a trace-time shape error."""
-    from activemonitor_tpu.utils.compat import shard_map
+    from activemonitor_tpu.parallel.partition import shard_map
 
     from activemonitor_tpu.ops.flash_attention import flash_attention
 
@@ -234,10 +240,18 @@ def ring_attention_fn(
                 "shard must hold whole K/V heads for its query-head group"
             )
         heads_axis = tp_axis
-    spec = P("data" if "data" in mesh.shape else None, axis, heads_axis, None)
+    # the composed layout is DATA: a rules tuple resolved inside
+    # ring_attention, not a spec threaded through kernel code
+    from activemonitor_tpu.ops.ring_attention import ring_partition_rules
+
+    rules = ring_partition_rules(
+        axis,
+        batch_axis="data" if "data" in mesh.shape else None,
+        heads_axis=heads_axis,
+    )
 
     def ring(q, k, v):
-        return ring_attention(q, k, v, mesh, axis, causal=True, in_spec=spec)
+        return ring_attention(q, k, v, mesh, axis, causal=True, rules=rules)
 
     return ring
 

@@ -14,6 +14,15 @@ masks (dense dispatch) — exactly correct, static-shaped, and the right
 fidelity for a *health probe* of expert-parallel collectives; a
 production MoE would add capacity-based gather/scatter to skip the
 masked compute.
+
+The layout is DATA: regex partition rules (:func:`moe_partition_rules`
+by default) resolve the shard_map specs, the token (scatter) dimension
+is DERIVED from the resolved spec rather than hard-coded — a re-meshed
+layout carrying a leading replicated batch/group dim scatters the right
+axis instead of silently scattering dim 0 — and the token all-gather
+routes through ``parallel/autotune.all_gather(schedule="auto")`` so the
+tuned decision table runs in the dispatch hot path (untuned: the XLA
+builtin, bitwise-identical to before).
 """
 
 from __future__ import annotations
@@ -23,8 +32,24 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-from activemonitor_tpu.utils.compat import shard_map
+from activemonitor_tpu.parallel.partition import (
+    match_partition_rules,
+    shard_map,
+    spec_axes,
+)
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def moe_partition_rules(axis: str = "ep"):
+    """Default rules for the expert-parallel pytree: the router
+    replicates, expert weights split their leading (expert) dim over
+    ``axis``, and the token tensor splits its token dim (position 0 in
+    the default [T, D] layout) over the same axis."""
+    return (
+        ("^router$", P(None, None)),
+        (r"^w_(up|down)$", P(axis, None, None)),
+        ("^x$", P(axis, None)),
+    )
 
 
 def init_moe_params(
@@ -41,53 +66,131 @@ def init_moe_params(
 
 
 def moe_ffn_reference(params: Dict, x: jax.Array) -> jax.Array:
-    """Single-device dense MoE (top-1): the correctness oracle."""
-    logits = x @ params["router"]  # [T, E]
-    expert = jnp.argmax(logits, axis=-1)  # [T]
+    """Single-device dense MoE (top-1): the correctness oracle.
+    ``x`` is [..., T, D] — leading batch dims broadcast."""
+    logits = x @ params["router"]  # [..., T, E]
+    expert = jnp.argmax(logits, axis=-1)  # [..., T]
     gate = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.take_along_axis(gate, expert[:, None], axis=-1)  # [T, 1]
-    h = jnp.einsum("td,edf->tef", x, params["w_up"])
+    gate = jnp.take_along_axis(gate, expert[..., None], axis=-1)  # [..., T, 1]
+    h = jnp.einsum("...td,edf->...tef", x, params["w_up"])
     h = jax.nn.gelu(h)
-    y = jnp.einsum("tef,efd->ted", h, params["w_down"])  # [T, E, D]
-    chosen = jnp.take_along_axis(y, expert[:, None, None], axis=1)[:, 0]
+    y = jnp.einsum("...tef,efd->...ted", h, params["w_down"])  # [..., T, E, D]
+    chosen = jnp.take_along_axis(
+        y, expert[..., None, None], axis=-2
+    )[..., 0, :]
     return chosen * gate
 
 
+def _token_dim(spec: P, axis: str, ndim: int) -> int:
+    """The dimension the resolved spec shards over ``axis`` — the
+    gather/scatter dimension. Derived, not hard-coded: a rules dict
+    that re-meshes the token layout moves the scatter with it."""
+    entries = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    hits = [
+        d
+        for d, entry in enumerate(entries)
+        if entry == axis
+        or (isinstance(entry, (tuple, list)) and axis in entry)
+    ]
+    if len(hits) != 1:
+        raise ValueError(
+            f"resolved token spec {spec} must shard exactly one dim over "
+            f"{axis!r} (found {len(hits)})"
+        )
+    return hits[0]
+
+
 def moe_ffn_expert_parallel(
-    params: Dict, x: jax.Array, mesh: Mesh, axis: str = "ep"
+    params: Dict, x: jax.Array, mesh: Mesh, axis: str = "ep", rules=None
 ) -> jax.Array:
-    """x: [T, D] with T sharded over ``mesh[axis]``; experts sharded the
-    same way. Returns [T, D] sharded like x."""
+    """x: [..., T, D] with the token dim sharded over ``mesh[axis]``
+    (which dim that is comes from the resolved rules — position 0 of
+    the default 2D layout); experts sharded the same way. Leading dims
+    beyond the sharded one are replicated batch dims. Returns an array
+    shaped and sharded like x."""
     n = mesh.shape[axis]
     n_experts = params["router"].shape[1]
     if n_experts % n:
         raise ValueError(f"{n_experts} experts do not split over {n} devices")
-    if x.shape[0] % n:
-        raise ValueError(f"{x.shape[0]} tokens do not shard over {n} devices")
+    resolved = match_partition_rules(
+        rules if rules is not None else moe_partition_rules(axis),
+        {**params, "x": x},
+        mesh=mesh,
+    )
+    x_spec = resolved["x"]
+    if axis not in spec_axes(x_spec):
+        raise ValueError(
+            f"resolved spec for the token tensor ({x_spec}) does not "
+            f"shard over {axis!r}"
+        )
+    # the dispatch math below indexes w_up[e]/w_down[e] as THIS shard's
+    # local experts and computes router logits identically everywhere —
+    # rules that leave the expert weights unsharded (each shard would
+    # reuse the first e_local GLOBAL experts) or shard the router must
+    # fail here, not produce silently wrong outputs
+    for name in ("w_up", "w_down"):
+        w_spec = tuple(resolved[name])
+        leading = w_spec[0] if w_spec else None
+        if not (
+            leading == axis
+            or (isinstance(leading, (tuple, list)) and axis in leading)
+        ):
+            raise ValueError(
+                f"resolved spec for {name!r} ({resolved[name]}) must "
+                f"shard the leading (expert) dim over {axis!r}"
+            )
+    if axis in spec_axes(resolved["router"]):
+        raise ValueError(
+            f"resolved spec for 'router' ({resolved['router']}) must "
+            f"not shard over {axis!r} — every shard routes the full "
+            "token set"
+        )
+    token_dim = _token_dim(x_spec, axis, x.ndim)
+    if x.shape[token_dim] % n:
+        raise ValueError(
+            f"{x.shape[token_dim]} tokens do not shard over {n} devices"
+        )
     e_local = n_experts // n
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(None, None), P(axis, None, None), P(axis, None, None), P(axis, None)),
-        out_specs=P(axis, None),
+        in_specs=(
+            resolved["router"], resolved["w_up"], resolved["w_down"], x_spec,
+        ),
+        out_specs=x_spec,
         check_vma=False,
     )
     def run(router, w_up, w_down, x_shard):
         my_rank = jax.lax.axis_index(axis)
-        tokens = jax.lax.all_gather(x_shard, axis, tiled=True)  # [T, D]
+        # dispatch: every device sees all tokens — the tuned surface
+        # picks the gather schedule per payload octave (dim-0 token
+        # layouts; a derived token dim elsewhere rides the XLA builtin,
+        # which gathers any dimension)
+        from activemonitor_tpu.parallel import autotune
+
+        if token_dim == 0:
+            tokens = autotune.all_gather(x_shard, axis, schedule="auto", n=n)
+        else:
+            tokens = jax.lax.all_gather(
+                x_shard, axis, axis=token_dim, tiled=True
+            )
         logits = tokens @ router
         expert = jnp.argmax(logits, axis=-1)
         gate = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.take_along_axis(gate, expert[:, None], axis=-1)  # [T, 1]
+        gate = jnp.take_along_axis(gate, expert[..., None], axis=-1)
         out = jnp.zeros_like(tokens)
         for e in range(e_local):  # static loop over this device's experts
             eid = my_rank * e_local + e
-            mask = (expert == eid)[:, None].astype(tokens.dtype)
+            mask = (expert == eid)[..., None].astype(tokens.dtype)
             h = jax.nn.gelu(tokens @ w_up[e])
             out = out + mask * gate * (h @ w_down[e])
-        # each token's output exists on exactly one device: the scatter-sum
-        # both combines and re-shards back to the token owners
-        return jax.lax.psum_scatter(out, axis, scatter_dimension=0, tiled=True)
+        # each token's output exists on exactly one device: the
+        # scatter-sum both combines and re-shards back to the token
+        # owners, along the dim the RESOLVED spec shards (derived above
+        # — never a hard-coded 0)
+        return jax.lax.psum_scatter(
+            out, axis, scatter_dimension=token_dim, tiled=True
+        )
 
     return run(params["router"], params["w_up"], params["w_down"], x)

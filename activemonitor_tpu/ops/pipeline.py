@@ -29,7 +29,10 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-from activemonitor_tpu.utils.compat import shard_map
+from activemonitor_tpu.parallel.partition import (
+    match_partition_rules,
+    shard_map,
+)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from activemonitor_tpu.models.probe_model import ProbeModelConfig, apply_block
@@ -41,20 +44,54 @@ def stack_layer_params(layers) -> Dict:
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *layers)
 
 
-def stacked_layer_specs(pp_axis: str = "pp", tp_axis: str = "model") -> Dict:
-    """PartitionSpec tree matching :func:`stack_layer_params` output:
-    the leading layer axis splits over ``pp_axis`` (each stage holds
+def stacked_layer_rules(pp_axis: str = "pp", tp_axis: str = "model"):
+    """Partition rules for a :func:`stack_layer_params` tree: every
+    leaf's leading layer axis splits over ``pp_axis`` (each stage holds
     its own layers) and within each layer the megatron tensor-parallel
-    layout of probe_model.param_specs splits over ``tp_axis`` — the
-    spec tree that lets one parameter tree be pp×tp sharded at once."""
-    return {
-        "ln1": {"scale": P(pp_axis, None)},
-        "wqkv": P(pp_axis, None, None, tp_axis, None),  # heads sharded
-        "wo": P(pp_axis, tp_axis, None, None),
-        "ln2": {"scale": P(pp_axis, None)},
-        "w_up": P(pp_axis, None, tp_axis),  # hidden dim sharded
-        "w_down": P(pp_axis, tp_axis, None),
-    }
+    layout (probe_model.param_partition_rules, shifted one dim right)
+    splits over ``tp_axis``. Being DATA, the pp×tp layout re-meshes —
+    including the GQA wq/wkv split the hand-written spec dict never
+    covered — by editing this tuple, not the pipeline schedule."""
+    return (
+        (r"scale$", P(pp_axis, None)),
+        (r"wqkv$", P(pp_axis, None, None, tp_axis, None)),  # heads sharded
+        (r"wkv$", P(pp_axis, None, None, tp_axis, None)),  # kv heads sharded
+        (r"wq$", P(pp_axis, None, tp_axis, None)),
+        (r"wo$", P(pp_axis, tp_axis, None, None)),
+        (r"w_up$", P(pp_axis, None, tp_axis)),  # hidden dim sharded
+        (r"w_down$", P(pp_axis, tp_axis, None)),
+    )
+
+
+def stacked_layer_specs(
+    pp_axis: str = "pp", tp_axis: str = "model", layers=None
+) -> Dict:
+    """PartitionSpec tree matching :func:`stack_layer_params` output —
+    :func:`stacked_layer_rules` resolved over ``layers`` (a stacked
+    parameter tree; default: an abstract MHA-shaped template, the
+    layout the hand-threaded spec dict this replaced covered)."""
+    if layers is None:
+        leaf = jax.ShapeDtypeStruct
+        layers = {
+            "ln1": {"scale": leaf((2, 2), jnp.float32)},
+            "wqkv": leaf((2, 2, 3, 2, 2), jnp.float32),
+            "wo": leaf((2, 2, 2, 2), jnp.float32),
+            "ln2": {"scale": leaf((2, 2), jnp.float32)},
+            "w_up": leaf((2, 2, 2), jnp.float32),
+            "w_down": leaf((2, 2, 2), jnp.float32),
+        }
+    return match_partition_rules(stacked_layer_rules(pp_axis, tp_axis), layers)
+
+
+def pipeline_io_rules(axis: str = "pp"):
+    """Rules for the pipelined shard_map boundary itself: stacked layer
+    leaves shard their leading layer axis over ``axis``; the microbatch
+    block (and the collected outputs) replicate to every stage (module
+    docstring: probe fidelity, not a memory-optimal pipeline)."""
+    return (
+        (r"^layers(/|$)", P(axis)),
+        (r"^(micro|out)$", P(None, None, None, None)),
+    )
 
 
 def pipeline_forward_blocks(
@@ -66,6 +103,8 @@ def pipeline_forward_blocks(
     num_microbatches: int = 0,
     composed: bool = False,
     overlap: bool = False,
+    rules=None,
+    allreduce_schedule: str = "auto",
 ) -> jax.Array:
     """Run the block stack over ``x`` [B, S, D] with the layers
     pipelined across ``mesh[axis]``. Embedding/head stay outside (they
@@ -94,6 +133,14 @@ def pipeline_forward_blocks(
     microbatches are so small that bubbles dominate (docs/training.md
     "Compute–communication overlap"). Numerics are identical either
     way — the schedule only changes WHEN activations ride the links.
+
+    The shard_map boundary's specs resolve from partition RULES
+    (:func:`pipeline_io_rules` by default; pass ``rules=`` to re-mesh).
+    The final output combine routes through
+    ``parallel/autotune.all_reduce`` with ``allreduce_schedule``
+    (default ``"auto"``: the tuned decision table picks the schedule
+    per payload octave, falling back to the bitwise-identical XLA psum
+    when nothing is tuned for this axis size).
     """
     n_stages = mesh.shape[axis]
     batch = x.shape[0]
@@ -114,6 +161,12 @@ def pipeline_forward_blocks(
     micro = x.astype(wire_dt).reshape(m, batch // m, *x.shape[1:])  # [M, mb, S, D]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
+    io_specs = match_partition_rules(
+        rules if rules is not None else pipeline_io_rules(axis),
+        {"layers": stacked_layers, "micro": micro, "out": micro},
+        mesh=mesh,
+    )
+
     def stage_apply(local_layers, act):
         """Scan this stage's local layers over the activation."""
 
@@ -126,8 +179,8 @@ def pipeline_forward_blocks(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(None, None, None, None)),
-        out_specs=P(None, None, None, None),
+        in_specs=(io_specs["layers"], io_specs["micro"]),
+        out_specs=io_specs["out"],
         check_vma=False,
         axis_names=frozenset({axis}) if composed else frozenset(),
     )
@@ -187,9 +240,16 @@ def pipeline_forward_blocks(
             (_, outputs), _ = jax.lax.scan(
                 tick, (act0, outputs0), jnp.arange(m + n_stages - 1)
             )
-        # broadcast the last stage's collected outputs to every stage
+        # broadcast the last stage's collected outputs to every stage —
+        # the ops-layer reduction the PR-8 decision table now reaches:
+        # schedule="auto" dispatches the tuned winner for this payload
+        # octave (untuned: the XLA psum, bitwise-identical to before)
+        from activemonitor_tpu.parallel import autotune
+
         is_last = (stage == n_stages - 1).astype(outputs.dtype)
-        return jax.lax.psum(outputs * is_last, axis)
+        return autotune.all_reduce(
+            outputs * is_last, axis, schedule=allreduce_schedule, n=n_stages
+        )
 
     out = pipelined(stacked_layers, micro)  # [M, mb, S, D]
     return out.reshape(batch, *x.shape[1:]).astype(x.dtype)

@@ -66,11 +66,26 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from activemonitor_tpu.utils.compat import shard_map
+from activemonitor_tpu.parallel.partition import (
+    match_partition_rules,
+    shard_map,
+)
 
 _NEG_INF = -1e30
 
 VARIANTS = ("serial", "overlap", "bidir")
+
+
+def ring_partition_rules(
+    axis: str = "sp", batch_axis=None, heads_axis=None
+):
+    """Default partition rules for the ring's q/k/v pytree: the
+    sequence dim (position 1) rides the ring axis; batch and heads are
+    embarrassingly parallel and take whatever axes the composed mesh
+    offers. The layout is DATA — a composed dp×tp×sp step re-meshes by
+    passing different axes here (or its own rules), never by editing
+    the schedule code below."""
+    return (("^(q|k|v)$", P(batch_axis, axis, heads_axis, None)),)
 
 # Test hook: when set to a list, every ring hop TRACED appends
 # (tag, direction). With ``unroll=True`` (python-loop schedule, same
@@ -642,6 +657,7 @@ def ring_attention(
     in_spec: P | None = None,
     variant: str = "overlap",
     unroll: bool = False,
+    rules=None,
 ) -> jax.Array:
     """Sequence-parallel attention over ``mesh[axis]``, differentiable
     (custom VJP: the backward is a second K/V ring pass recomputing
@@ -662,12 +678,18 @@ def ring_attention(
     trades flat compile time for a python-loop schedule whose hops are
     individually traced (the probe/test hop counter).
     ``use_flash`` runs each ring step's block compute (forward AND
-    backward) through the fused Pallas kernels. ``in_spec`` overrides
-    the shard_map partitioning for composed meshes — e.g.
-    ``P("data", "sp", "model", None)`` to run the ring inside a
-    dp×tp×sp train step (batch and heads are embarrassingly parallel
-    for the ring; only position 1, the sequence dim, must carry
-    ``axis``).
+    backward) through the fused Pallas kernels.
+
+    The shard_map partitioning resolves from regex partition RULES
+    (:func:`ring_partition_rules` by default) matched over the
+    ``{"q","k","v"}`` pytree — pass ``rules=`` to re-mesh a composed
+    probe without touching the schedules, e.g.
+    ``(("^(q|k|v)$", P("data", "sp", "model", None)),)`` to run the
+    ring inside a dp×tp×sp train step (batch and heads are
+    embarrassingly parallel for the ring; only position 1, the
+    sequence dim, must carry ``axis``). ``in_spec`` is the legacy
+    spelling of the same override (one spec for all three operands)
+    and is mutually exclusive with ``rules``.
     """
     n = mesh.shape[axis]
     if variant not in VARIANTS:
@@ -682,17 +704,31 @@ def ring_attention(
             "bidirectional ring attention needs >= 2 tokens per shard "
             f"to split K/V halves (got {q.shape[1]} over {n} devices)"
         )
-    spec = in_spec if in_spec is not None else P(None, axis, None, None)
-    if len(spec) > 1 and spec[1] != axis:
-        raise ValueError(
-            f"in_spec must shard the sequence dim (position 1) over {axis!r}, got {spec}"
+    if rules is not None and in_spec is not None:
+        raise ValueError("pass rules= or in_spec=, not both")
+    if rules is None:
+        rules = (
+            ring_partition_rules(axis)
+            if in_spec is None
+            else (("^(q|k|v)$", in_spec),)
         )
+    resolved = match_partition_rules(rules, {"q": q, "k": k, "v": v}, mesh=mesh)
+    for name in ("q", "k", "v"):
+        spec = resolved[name]
+        if len(spec) <= 1 or spec[1] != axis:
+            raise ValueError(
+                f"resolved spec for {name!r} must shard the sequence dim "
+                f"(position 1) over {axis!r}, got {spec}"
+            )
+    in_specs = (resolved["q"], resolved["k"], resolved["v"])
+
     def body(q, k, v):
         # positional call: custom_vjp rejects keyword arguments
         return _ring_diff(q, k, v, axis, n, causal, use_flash, variant, unroll)
 
     fn = shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        body, mesh=mesh, in_specs=in_specs, out_specs=resolved["q"],
+        check_vma=False,
     )
     return fn(q, k, v)
 

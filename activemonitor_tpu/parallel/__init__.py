@@ -1,6 +1,8 @@
 """Device mesh + timed collective helpers (XLA builtins in
 ``collectives``, the explicit ppermute schedule zoo in ``schedules``,
-the message-size autotuner over both in ``autotune``)."""
+the message-size autotuner over both in ``autotune``) plus the one
+sharding surface (regex partition rules + the single shard_map entry
+point in ``partition``)."""
 
 from activemonitor_tpu.parallel.collectives import (
     CollectiveResult,
@@ -15,6 +17,14 @@ from activemonitor_tpu.parallel.mesh import (
     device_info,
     make_1d_mesh,
     make_2d_mesh,
+)
+from activemonitor_tpu.parallel.partition import (
+    make_gather_fns,
+    make_shard_fns,
+    match_partition_rules,
+    named_tree_map,
+    shard_tree,
+    validate_rules,
 )
 from activemonitor_tpu.parallel.schedules import (
     all_gather_recdouble_bandwidth,
@@ -38,6 +48,12 @@ __all__ = [
     "device_info",
     "make_1d_mesh",
     "make_2d_mesh",
+    "make_gather_fns",
+    "make_shard_fns",
+    "match_partition_rules",
+    "named_tree_map",
     "ppermute_ring_bandwidth",
     "reduce_scatter_bandwidth",
+    "shard_tree",
+    "validate_rules",
 ]

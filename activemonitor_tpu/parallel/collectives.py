@@ -33,7 +33,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from activemonitor_tpu.utils.compat import shard_map
+from activemonitor_tpu.parallel.partition import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from activemonitor_tpu.utils.timing import chain_delta_seconds

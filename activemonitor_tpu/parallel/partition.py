@@ -1,0 +1,252 @@
+"""One sharding surface — regex partition rules resolved over pytrees.
+
+Sharding used to be hand-threaded per op: every shard_map call site
+built its own `PartitionSpec`s inline, so re-meshing a composed
+DP×TP×PP probe meant editing kernel code. This module is the single
+surface the ops layer goes through instead (ROADMAP item 5, the
+SNIPPETS.md [2] `named_tree_map` + regex-rule pattern):
+
+- :func:`named_tree_map` — tree_map whose callback also receives the
+  leaf's '/'-joined path name ("layers/wqkv", "opt/mu/embed").
+- :func:`match_partition_rules` — resolve an ordered list of
+  ``(regex, PartitionSpec)`` rules over an arbitrary pytree. FIRST
+  match wins (``re.search``), scalars/size-1 leaves never partition,
+  and unmatched leaves fall back to replicated (``P()``) unless the
+  caller asks for a hard error. Because the rules are plain data, a
+  mesh layout is an edit to a rules dict, not to kernel code — the
+  Maple portability argument (PAPERS.md) applied to our ops.
+- :func:`validate_rules` / :func:`validate_specs` — a rule naming a
+  mesh axis the mesh doesn't carry is a ValueError up front, never a
+  tracer crash from inside shard_map.
+- :func:`make_shard_fns` / :func:`make_gather_fns` /
+  :func:`shard_tree` — per-leaf placement/gather callables derived
+  from resolved specs (the fmengine ``make_shard_and_gather_fns``
+  shape).
+- :func:`shard_map` — THE single entry point over the
+  ``utils/compat.py`` vintage adapter. Every manual-collective region
+  in the tree routes through here (lint-enforced:
+  ``shard-map-outside-partition`` in hack/lint.py), so spec validation
+  happens in exactly one place and a JAX API move is absorbed in
+  exactly one file pair.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Iterable, Mapping, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from activemonitor_tpu.utils.compat import shard_map as _compat_shard_map
+
+# Rules are ordered (pattern, spec) pairs; a Mapping works too (dicts
+# preserve insertion order, which IS the precedence order).
+Rules = Iterable[Tuple[str, P]]
+
+
+def _is_spec(x) -> bool:
+    # PartitionSpec is a tuple subclass on legacy JAX, so every spec
+    # tree walk must stop AT the spec instead of descending into it
+    return isinstance(x, P)
+
+
+def _key_name(entry) -> str:
+    """One path entry (DictKey/SequenceKey/GetAttrKey/...) → its bare
+    name, without the type's repr decoration."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def tree_path_name(path, sep: str = "/") -> str:
+    """'/'-joined name of a jax.tree_util key path."""
+    return sep.join(_key_name(entry) for entry in path)
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree, *, sep: str = "/",
+                   is_leaf=None):
+    """``tree_map`` that hands the callback ``(name, leaf)`` where
+    ``name`` is the sep-joined key path ("layers/wqkv") — the walker
+    the regex rules match against."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(tree_path_name(path, sep), leaf),
+        tree,
+        is_leaf=is_leaf,
+    )
+
+
+def normalize_rules(rules: Rules | Mapping[str, P]) -> Tuple[Tuple[Any, P], ...]:
+    """(pattern, spec) pairs with patterns compiled; accepts a Mapping
+    (insertion order = precedence) or any (pattern, spec) sequence."""
+    pairs = rules.items() if isinstance(rules, Mapping) else rules
+    out = []
+    for pattern, spec in pairs:
+        out.append((re.compile(pattern), spec))
+    return tuple(out)
+
+
+def spec_axes(spec: P) -> set:
+    """Mesh axis names a PartitionSpec mentions (tuple entries — one
+    dim sharded over several axes — included)."""
+    axes: set = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.update(entry)
+        else:
+            axes.add(entry)
+    return axes
+
+
+def validate_specs(specs, mesh: Mesh) -> None:
+    """Every axis named by any spec in the tree must exist on the mesh
+    — a ValueError here, not a tracer crash inside shard_map later."""
+    mesh_axes = set(mesh.axis_names)
+    for spec in jax.tree.leaves(specs, is_leaf=_is_spec):
+        if not _is_spec(spec):
+            continue
+        unknown = spec_axes(spec) - mesh_axes
+        if unknown:
+            raise ValueError(
+                f"PartitionSpec {spec} names mesh ax"
+                f"{'es' if len(unknown) > 1 else 'is'} "
+                f"{sorted(unknown)} absent from the mesh "
+                f"{dict(mesh.shape)}"
+            )
+
+
+def validate_rules(rules: Rules | Mapping[str, P], mesh: Mesh) -> None:
+    """Every mesh axis any RULE names must exist on the mesh; the error
+    carries the offending pattern so a rules-dict typo is a one-line
+    fix, not a shard_map stack trace."""
+    mesh_axes = set(mesh.axis_names)
+    for regex, spec in normalize_rules(rules):
+        unknown = spec_axes(spec) - mesh_axes
+        if unknown:
+            raise ValueError(
+                f"partition rule {regex.pattern!r} -> {spec} names mesh "
+                f"ax{'es' if len(unknown) > 1 else 'is'} {sorted(unknown)} "
+                f"absent from the mesh {dict(mesh.shape)}"
+            )
+
+
+def match_partition_rules(
+    rules: Rules | Mapping[str, P],
+    tree,
+    *,
+    sep: str = "/",
+    mesh: Mesh | None = None,
+    on_unmatched: str = "replicate",
+) -> Any:
+    """Resolve regex partition rules over ``tree`` into a parallel tree
+    of PartitionSpecs.
+
+    Precedence is FIRST MATCH WINS in rule order (``re.search`` against
+    the leaf's sep-joined path name) — an earlier broad rule shadows a
+    later specific one, so order rules most-specific-first. Scalar and
+    size-1 leaves always resolve to ``P()`` (nothing to partition).
+    Unmatched leaves fall back to replicated ``P()``;
+    ``on_unmatched="error"`` turns that into a ValueError naming the
+    leaf (the fmengine behavior) for param trees that must be fully
+    covered. Passing ``mesh`` validates the rules' axes up front."""
+    if on_unmatched not in ("replicate", "error"):
+        raise ValueError(
+            f"on_unmatched must be 'replicate' or 'error', got {on_unmatched!r}"
+        )
+    compiled = normalize_rules(rules)
+    if mesh is not None:
+        validate_rules(rules, mesh)
+
+    def resolve(name: str, leaf) -> P:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and (len(shape) == 0 or math.prod(shape) == 1):
+            return P()  # never partition scalars
+        for regex, spec in compiled:
+            if regex.search(name) is not None:
+                return spec
+        if on_unmatched == "error":
+            raise ValueError(f"no partition rule matched leaf {name!r}")
+        return P()  # replicated fallback
+
+    return named_tree_map(resolve, tree, sep=sep)
+
+
+def sharding_tree(specs, mesh: Mesh):
+    """Spec tree → NamedSharding tree (validated against the mesh)."""
+    validate_specs(specs, mesh)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs, is_leaf=_is_spec
+    )
+
+
+def make_shard_fns(specs, mesh: Mesh):
+    """Per-leaf placement callables derived from resolved specs: each
+    fn device_puts its leaf onto the spec's NamedSharding (host arrays
+    in, globally-sharded arrays out)."""
+    validate_specs(specs, mesh)
+
+    def one(spec: P):
+        sharding = NamedSharding(mesh, spec)
+        return lambda x: jax.device_put(x, sharding)
+
+    return jax.tree.map(one, specs, is_leaf=_is_spec)
+
+
+def make_gather_fns(specs, mesh: Mesh):
+    """Per-leaf gather callables: the inverse of :func:`make_shard_fns`
+    — each fn replicates its (possibly sharded) leaf and returns a
+    host-readable full array."""
+    validate_specs(specs, mesh)
+    replicated = NamedSharding(mesh, P())
+
+    def one(_spec: P):
+        return lambda x: jax.device_get(jax.device_put(x, replicated))
+
+    return jax.tree.map(one, specs, is_leaf=_is_spec)
+
+
+def shard_tree(tree, rules: Rules | Mapping[str, P], mesh: Mesh, *,
+               sep: str = "/", on_unmatched: str = "replicate"):
+    """Resolve ``rules`` over ``tree`` and place every leaf on its
+    resolved sharding. Returns (sharded_tree, specs)."""
+    specs = match_partition_rules(
+        rules, tree, sep=sep, mesh=mesh, on_unmatched=on_unmatched
+    )
+    fns = make_shard_fns(specs, mesh)
+    return jax.tree.map(lambda fn, x: fn(x), fns, tree), specs
+
+
+def shard_map(
+    f,
+    *,
+    mesh: Mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+    axis_names: frozenset = frozenset(),
+):
+    """THE shard_map entry point — the only call site of the
+    ``utils/compat.py`` vintage adapter (lint-pinned). Validates every
+    spec (and the manual-axes set) against the mesh before tracing, so
+    a bad rules dict fails with the axis name instead of a tracer
+    crash."""
+    validate_specs(in_specs, mesh)
+    validate_specs(out_specs, mesh)
+    unknown = frozenset(axis_names) - set(mesh.axis_names)
+    if unknown:
+        raise ValueError(
+            f"axis_names {sorted(unknown)} absent from the mesh "
+            f"{dict(mesh.shape)}"
+        )
+    return _compat_shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=check_vma,
+        axis_names=frozenset(axis_names),
+    )

@@ -151,6 +151,21 @@ def build_parser() -> argparse.ArgumentParser:
         default=1,
         help="gradient accumulation microbatches per step",
     )
+    p.add_argument(
+        "--grad-sync",
+        choices=("implicit", "auto", "xla", "rsag", "recdouble", "tree"),
+        default="auto",
+        help="gradient-sync route: implicit (XLA-inserted reduction) "
+        "or an explicit schedule through the tuned collective surface "
+        "(auto consults the autotune decision table; dp-only meshes "
+        "only — docs/training.md 'Partition rules')",
+    )
+    p.add_argument(
+        "--tune-sync",
+        action="store_true",
+        help="race every all-reduce schedule at the gradient payload "
+        "first, so --grad-sync auto dispatches a measured winner",
+    )
 
     p = sub.add_parser("hbm", help="HBM bandwidth check")
     p.add_argument("--size-mb", type=float, default=256.0)
@@ -424,6 +439,8 @@ def _dispatch(args) -> int:
             remat=args.remat,
             accum_steps=args.accum_steps,
             roofline=args.roofline,
+            grad_sync=args.grad_sync,
+            tune_sync=args.tune_sync,
         )
     elif args.probe == "hbm":
         from activemonitor_tpu.probes import hbm

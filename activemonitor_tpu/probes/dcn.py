@@ -50,7 +50,7 @@ def run(size_mb: float = 16.0, iters: int = 4) -> ProbeResult:
 
     # correctness: psum over the dcn axis of a rank-tagged payload must
     # equal the sum over all hosts, identically on every host
-    from activemonitor_tpu.utils.compat import shard_map
+    from activemonitor_tpu.parallel.partition import shard_map
     from jax.sharding import PartitionSpec as P
 
     @jax.jit

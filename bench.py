@@ -177,7 +177,9 @@ def _secondary_metrics() -> dict:
     def train():
         from activemonitor_tpu.probes import training_step as train_probe
 
-        result = train_probe.run(batch_per_device=8, seq=128, steps=3)
+        result = train_probe.run(
+            batch_per_device=8, seq=128, steps=3, tune_sync=True
+        )
         by_name = {m.name: m.value for m in result.metrics}
         if "train-mfu" in by_name:
             # the measured value BASELINE.md's provisional TRAIN_MFU_BAR
@@ -186,6 +188,16 @@ def _secondary_metrics() -> dict:
         secondary["train_tokens_per_second"] = round(
             by_name["train-tokens-per-second"]
         )
+        # tuned-dispatch evidence: which schedule the gradient sync
+        # rode (or why it stayed implicit), plus the measured
+        # tuned-vs-builtin step-time speedup when a zoo schedule won
+        secondary["train_allreduce_schedule"] = result.details.get(
+            "allreduce_schedule", "xla(implicit)"
+        )
+        if "training-step-allreduce-sched" in by_name:
+            secondary["train_allreduce_sched_speedup"] = round(
+                by_name["training-step-allreduce-sched"], 3
+            )
 
     def decode():
         from activemonitor_tpu.probes import decode as decode_probe
@@ -366,9 +378,11 @@ def _cpu_secondary_metrics() -> dict:
 
     try:
         import jax
+        import jax.numpy as jnp
 
         if len(jax.devices()) >= 8:
             from activemonitor_tpu.models.probe_model import tiny_config
+            from activemonitor_tpu.parallel import autotune
             from activemonitor_tpu.parallel.mesh import make_mesh
             from activemonitor_tpu.probes.training_step import (
                 build_composed_train_step,
@@ -378,6 +392,31 @@ def _cpu_secondary_metrics() -> dict:
                 ("data", "model", "pp"), (2, 2, 2), devices=jax.devices()[:8]
             )
             cfg = tiny_config()
+            # tuned-dispatch evidence for the composed hot path: race
+            # every all-reduce schedule on the pp axis at the pipeline
+            # output-combine payload, then report the schedule the
+            # composed step's autotune.all_reduce(schedule="auto")
+            # resolves. Interpret-mode timings (labeled): table SHAPE,
+            # never read against a TPU bar. Stamped before the step so
+            # the evidence survives a legacy-gated composed mode.
+            combine_payload = 2 * 2 * 16 * cfg.d_model * 4  # [M,mb,S,D] f32
+            tuned = autotune.tune(
+                mesh, axis="pp", collectives=("allreduce",),
+                sizes_mb=(max(0.05, combine_payload / 1e6),),
+                dtype=jnp.float32, iters=2,
+            )
+            cell = next(iter(tuned.results["allreduce"].values()))
+            sched = (
+                autotune.lookup(
+                    "allreduce", mesh.shape["pp"], combine_payload, jnp.float32
+                )
+                or "xla"
+            )
+            secondary["composed_allreduce_schedule"] = sched
+            if cell.get("xla", 0.0) > 0 and sched in cell:
+                secondary["composed_allreduce_tuned_vs_builtin_interpret"] = (
+                    round(cell[sched] / cell["xla"], 3)
+                )
             step, params, opt, data_sh = build_composed_train_step(cfg, mesh)
             tokens = jax.device_put(
                 jax.random.randint(jax.random.key(7), (4, 17), 0, cfg.vocab_size),
@@ -658,6 +697,56 @@ def _stamp_autotune(doc: dict) -> None:
         }
     except Exception as exc:  # pragma: no cover - defensive
         print(f"autotune stamp failed: {exc!r}", file=sys.stderr)
+    _stamp_grad_sync(doc)
+
+
+def _stamp_grad_sync(doc: dict) -> None:
+    """Stamp the training-step gradient-sync decision next to the
+    collective_autotune table: race every all-reduce schedule at the
+    probe model's dominant gradient payload on a dp-only mesh, then
+    record the schedule ``training_step.grad_sync_plan`` resolves and
+    its measured busbw ratio over the XLA builtin
+    (``tuned_vs_builtin``). Both paths stamp it; CPU-fallback rounds
+    are ``interpret_mode: true`` — table shape, never a TPU bar.
+    Guarded: a failing tune costs this block, not the artifact."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if len(jax.devices()) < 2 or "collective_autotune" not in doc:
+            return
+        from activemonitor_tpu.models.probe_model import (
+            ProbeModelConfig,
+            tiny_config,
+        )
+        from activemonitor_tpu.parallel import autotune
+        from activemonitor_tpu.parallel.mesh import make_mesh
+        from activemonitor_tpu.probes.training_step import grad_sync_plan
+
+        on_tpu = doc.get("platform") == "tpu"
+        n = len(jax.devices())
+        mesh = make_mesh(("data", "model"), (n, 1))
+        cfg = ProbeModelConfig() if on_tpu else tiny_config()
+        payload = grad_sync_plan(cfg, mesh)["largest_leaf_bytes"]
+        tuned = autotune.tune(
+            mesh, axis="data", collectives=("allreduce",),
+            sizes_mb=(max(0.25, payload / 1e6),), dtype=jnp.float32, iters=2,
+        )
+        cell = next(iter(tuned.results["allreduce"].values()))
+        plan = grad_sync_plan(cfg, mesh)
+        entry = {
+            "allreduce_schedule": plan["schedule"],
+            "axis_n": plan["axis_n"],
+            "payload_bytes": plan["largest_leaf_bytes"],
+            "interpret_mode": not on_tpu,
+        }
+        if cell.get("xla", 0.0) > 0 and plan["schedule"] in cell:
+            entry["tuned_vs_builtin"] = round(
+                cell[plan["schedule"]] / cell["xla"], 3
+            )
+        doc["collective_autotune"]["training_step_grad_sync"] = entry
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"grad-sync stamp failed: {exc!r}", file=sys.stderr)
 
 
 def _stamp_roofline(doc: dict, result) -> None:

@@ -42,6 +42,14 @@ positive that makes `make lint` cry wolf is worse than a miss):
   whose whole body is `pass`/`...` — the broad catch that silently
   eats errors (BLE001's harmful core). Handlers that log, re-raise,
   return, or otherwise DO something are fine.
+- shard-map-outside-partition: a direct `shard_map` import (from
+  `jax`, `jax.experimental.shard_map`, or the `utils/compat` vintage
+  adapter) anywhere except `parallel/partition.py` and
+  `utils/compat.py` — the one-sharding-surface invariant: every
+  manual-collective region routes through partition.py's validated
+  entry point, so the compat adapter keeps exactly one call site and a
+  JAX API move is absorbed in one file pair. Import it from
+  `activemonitor_tpu.parallel.partition` instead.
 - wallclock-in-<unit>: `time.time()` / `time.monotonic()` calls in
   files under a `resilience/` or `analysis/` directory, or in the
   clock-disciplined modules (`sharding.py`, `attribution.py`,
@@ -154,6 +162,10 @@ class Checker(ast.NodeVisitor):
             # contract as the resilience/analysis packages
             self.wallclock_pkg = Path(path).stem
         self.ban_wallclock = self.wallclock_pkg is not None
+        # the one-sharding-surface invariant: only these two files may
+        # import shard_map directly (partition.py is the single call
+        # site of the compat adapter; compat.py is the adapter itself)
+        self.allow_shard_map = Path(path).name in ("partition.py", "compat.py")
         # names defined `async def` / plain `def` anywhere in the file
         # (functions AND methods) — the unawaited-coroutine check only
         # fires on names that are EXCLUSIVELY async, so a sync function
@@ -222,8 +234,39 @@ class Checker(ast.NodeVisitor):
         if self.scope is self.module_scope and not alias.name.startswith("__"):
             self.imports.setdefault(name, node.lineno)
 
+    def _check_shard_map_import(self, node, module: str, name: str) -> None:
+        """shard-map-outside-partition: direct shard_map imports are
+        banned outside the two surface files. Banned sources: the
+        legacy `jax.experimental.shard_map` home, the modern top-level
+        `jax` export, and the in-tree `utils/compat` adapter (absolute
+        or relative — any module path ending in `compat`). Importing
+        from `activemonitor_tpu.parallel.partition` is the sanctioned
+        spelling and stays quiet."""
+        if self.allow_shard_map:
+            return
+        banned_module = (
+            module in ("jax", "jax.experimental", "jax.experimental.shard_map")
+            # the in-tree adapter, absolute or relative (`...utils.compat`,
+            # `.compat`) — NOT any third-party module merely named *compat
+            or module == "compat"
+            or module.endswith(".compat")
+        )
+        if (name == "shard_map" and banned_module) or (
+            module == "" and name == "jax.experimental.shard_map"
+        ):
+            self.findings.append(
+                (
+                    node.lineno,
+                    "shard-map-outside-partition",
+                    "direct shard_map import — route through "
+                    "activemonitor_tpu.parallel.partition (the one "
+                    "sharding surface)",
+                )
+            )
+
     def visit_Import(self, node: ast.Import) -> None:
         for alias in node.names:
+            self._check_shard_map_import(node, "", alias.name)
             self._record_import(alias, node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -232,6 +275,7 @@ class Checker(ast.NodeVisitor):
                 self.bind(alias.asname or alias.name)
             return
         for alias in node.names:
+            self._check_shard_map_import(node, node.module or "", alias.name)
             self._record_import(alias, node)
 
     def _check_shadow(self, name: str, lineno: int, what: str) -> None:

@@ -1,0 +1,329 @@
+"""parallel/partition.py — the one sharding surface.
+
+Rule-matching semantics (precedence, fallback, validation), the
+shard/gather fns, the single shard_map entry point, and the tuned
+collective dispatch it unlocked in the ops-layer hot paths (the
+training-step gradient sync asserted through the schedules' traced
+``_hop`` choke point).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from activemonitor_tpu.parallel import autotune, partition, schedules
+from activemonitor_tpu.parallel.mesh import make_1d_mesh, make_2d_mesh, make_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_autotune_table():
+    autotune.clear()
+    yield
+    autotune.clear()
+
+
+# ---------------------------------------------------------------------------
+# named_tree_map / rule matching
+# ---------------------------------------------------------------------------
+
+
+def test_named_tree_map_paths_cover_dicts_and_lists():
+    tree = {"a": {"b": 1}, "layers": [{"w": 2}, {"w": 3}]}
+    seen = {}
+    partition.named_tree_map(
+        lambda name, leaf: seen.setdefault(name, leaf), tree
+    )
+    assert seen == {"a/b": 1, "layers/0/w": 2, "layers/1/w": 3}
+
+
+def test_first_match_wins_over_later_more_specific_rule():
+    """Precedence is first-match, not most-specific: an earlier broad
+    rule shadows a later exact one (so rules are ordered
+    most-specific-first by convention)."""
+    tree = {"layers": {"wqkv": jnp.zeros((4, 4))}}
+    rules = (
+        ("w", P("model", None)),  # broad, first: wins
+        (r"^layers/wqkv$", P(None, "model")),  # exact, second: shadowed
+    )
+    specs = partition.match_partition_rules(rules, tree)
+    assert specs["layers"]["wqkv"] == P("model", None)
+    # flipped order: the exact rule now wins
+    specs = partition.match_partition_rules(tuple(reversed(rules)), tree)
+    assert specs["layers"]["wqkv"] == P(None, "model")
+
+
+def test_unmatched_leaf_falls_back_to_replicated():
+    tree = {"w": jnp.zeros((4, 4)), "stray": jnp.zeros((8,))}
+    specs = partition.match_partition_rules((("^w$", P("model", None)),), tree)
+    assert specs["w"] == P("model", None)
+    assert specs["stray"] == P()  # replicated, never an error by default
+    with pytest.raises(ValueError, match="no partition rule matched.*stray"):
+        partition.match_partition_rules(
+            (("^w$", P("model", None)),), tree, on_unmatched="error"
+        )
+
+
+def test_scalars_and_size_one_leaves_never_partition():
+    tree = {
+        "count": jnp.zeros(()),
+        "one": jnp.zeros((1, 1)),
+        "w": jnp.zeros((4, 4)),
+    }
+    # a greedy rule matches everything; scalars still resolve P()
+    specs = partition.match_partition_rules(((".*", P("model", None)),), tree)
+    assert specs["count"] == P()
+    assert specs["one"] == P()
+    assert specs["w"] == P("model", None)
+
+
+def test_rule_naming_absent_mesh_axis_is_a_validation_error():
+    """A rules-dict typo fails up front with the axis name — never a
+    tracer crash from inside shard_map."""
+    mesh = make_2d_mesh()
+    tree = {"w": jnp.zeros((4, 4))}
+    with pytest.raises(ValueError, match="sp.*absent from the mesh"):
+        partition.match_partition_rules(
+            (("^w$", P("sp", None)),), tree, mesh=mesh
+        )
+    with pytest.raises(ValueError, match="absent from the mesh"):
+        partition.validate_specs({"w": P(None, ("data", "nope"))}, mesh)
+    # the shard_map entry point guards the same way
+    with pytest.raises(ValueError, match="absent from the mesh"):
+        partition.shard_map(
+            lambda x: x, mesh=mesh, in_specs=P("bogus"), out_specs=P("bogus"),
+            check_vma=False,
+        )
+    with pytest.raises(ValueError, match="absent from the mesh"):
+        partition.shard_map(
+            lambda x: x, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False, axis_names=frozenset({"phantom"}),
+        )
+
+
+def test_mapping_rules_and_precedence_order_preserved():
+    tree = {"wq": jnp.zeros((4, 4))}
+    specs = partition.match_partition_rules(
+        {"wq": P("model", None), ".*": P()}, tree
+    )
+    assert specs["wq"] == P("model", None)
+
+
+# ---------------------------------------------------------------------------
+# shard/gather fns + the entry point
+# ---------------------------------------------------------------------------
+
+
+def test_shard_tree_places_leaves_on_resolved_shardings():
+    mesh = make_2d_mesh()
+    tree = {"w": jnp.arange(32.0).reshape(8, 4), "b": jnp.arange(8.0)}
+    rules = (("^w$", P("data", "model")), ("^b$", P(None)),)
+    sharded, specs = partition.shard_tree(tree, rules, mesh)
+    assert specs["w"] == P("data", "model")
+    assert sharded["w"].sharding.spec == P("data", "model")
+    # gather fns invert the placement
+    gather = partition.make_gather_fns(specs, mesh)
+    back = jax.tree.map(lambda fn, x: fn(x), gather, sharded)
+    assert (back["w"] == tree["w"]).all()
+    assert (back["b"] == tree["b"]).all()
+
+
+def test_shard_map_entry_point_runs_a_collective():
+    mesh = make_1d_mesh("ici")
+    n = mesh.devices.size
+    fn = partition.shard_map(
+        lambda x: jax.lax.psum(x, "ici"),
+        mesh=mesh, in_specs=P("ici", None), out_specs=P(None, None),
+        check_vma=False,
+    )
+    out = fn(jnp.ones((n * 2, 3)))
+    assert (out == n).all()
+
+
+def test_compat_adapter_has_exactly_one_call_site():
+    """The one-sharding-surface invariant, asserted structurally: the
+    only module importing the compat shard_map adapter is
+    parallel/partition.py (the lint twin checks the rule fires; this
+    checks the tree actually honors it)."""
+    import ast
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    importers = []
+    for path in sorted((repo / "activemonitor_tpu").rglob("*.py")) + sorted(
+        (repo / "tests").glob("*.py")
+    ):
+        if path.name == "compat.py":
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and (node.module or "").endswith(
+                "compat"
+            ):
+                if any(a.name == "shard_map" for a in node.names):
+                    importers.append(str(path))
+    assert importers == [
+        str(repo / "activemonitor_tpu" / "parallel" / "partition.py")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# tuned dispatch in the ops-layer hot paths
+# ---------------------------------------------------------------------------
+
+
+def _record_for_every_octave(collective, n, payloads, schedule, dtype):
+    for payload in payloads:
+        autotune.record(
+            collective, n, payload, dtype, {schedule: 10.0, "xla": 1.0}
+        )
+
+
+def test_training_step_grad_sync_dispatches_tuned_schedule():
+    """The acceptance gate: with the decision table tuned,
+    autotune.all_reduce(schedule="auto") demonstrably runs in the
+    training-step gradient sync — asserted via the schedules' traced
+    ``_hop`` choke point, and the chosen schedule lands in the probe's
+    stdout-contract plan."""
+    import math
+
+    from activemonitor_tpu.models.probe_model import init_params, tiny_config
+    from activemonitor_tpu.probes import training_step as ts
+
+    cfg = tiny_config()
+    mesh = make_mesh(("data", "model"), (4, 1), devices=jax.devices()[:4])
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    payloads = {
+        int(math.prod(leaf.shape)) * 4 for leaf in jax.tree.leaves(abstract)
+    }
+    _record_for_every_octave("allreduce", 4, payloads, "rsag", jnp.float32)
+
+    plan = ts.grad_sync_plan(cfg, mesh)
+    assert plan["schedule"] == "rsag"
+    assert plan["by_schedule"] == {"rsag": len(jax.tree.leaves(abstract))}
+
+    step_fn, params, opt_state, data_sh = ts.build_sharded_train_step(
+        cfg, mesh, grad_sync="auto"
+    )
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (8, 17), 0, cfg.vocab_size),
+        data_sh,
+    )
+    schedules._HOP_LOG = log = []
+    try:
+        step_fn.lower(params, opt_state, tokens)
+    finally:
+        schedules._HOP_LOG = None
+    tags = {tag for tag, _step in log}
+    assert tags == {"rsag-rs", "rsag-ag"}, tags
+
+
+def test_grad_sync_explicit_matches_implicit_when_untuned():
+    """Untuned "auto" resolves to the XLA psum: the explicit sync's
+    loss matches the implicit (XLA-inserted) reduction on a dp-only
+    mesh to float tolerance (the sync computes the identical global
+    mean as a mean-of-equal-shard-means — same math, reassociated),
+    so flipping the default cost nothing."""
+    from activemonitor_tpu.models.probe_model import tiny_config
+    from activemonitor_tpu.probes import training_step as ts
+
+    cfg = tiny_config()
+    mesh = make_mesh(("data", "model"), (4, 1), devices=jax.devices()[:4])
+    tokens = jax.random.randint(jax.random.key(1), (8, 17), 0, cfg.vocab_size)
+    losses = {}
+    for grad_sync in ("implicit", "auto"):
+        step_fn, params, opt_state, data_sh = ts.build_sharded_train_step(
+            cfg, mesh, grad_sync=grad_sync
+        )
+        placed = jax.device_put(tokens, data_sh)
+        for _ in range(2):
+            params, opt_state, loss = step_fn(params, opt_state, placed)
+        losses[grad_sync] = float(loss)
+    assert losses["implicit"] == pytest.approx(losses["auto"], rel=1e-4), losses
+
+
+def test_grad_sync_gates_fall_back_to_implicit():
+    from activemonitor_tpu.probes import training_step as ts
+
+    # live non-data axis: compiler keeps the reduction
+    mesh = make_2d_mesh()  # (2, 4) on the 8-device CPU platform
+    mode, reason = ts.resolve_grad_sync(mesh, "dense", "auto")
+    assert mode == "implicit" and "model" in reason
+    # no data axis to reduce over
+    mode, reason = ts.resolve_grad_sync(make_1d_mesh("ici"), "dense", "auto")
+    assert mode == "implicit"
+    # ring attention runs its own shard_map — cannot nest
+    dp = make_mesh(("data", "sp"), (4, 2))
+    assert ts.resolve_grad_sync(dp, "ring", "auto")[0] == "implicit"
+    dp_only = make_mesh(("data", "model"), (8, 1))
+    assert ts.resolve_grad_sync(dp_only, "dense", "auto") == ("explicit", "")
+    # accumulation keeps the global-batch % accum_steps contract: the
+    # sync body would split the LOCAL shard instead
+    mode, reason = ts.resolve_grad_sync(dp_only, "dense", "auto", accum_steps=4)
+    assert mode == "implicit" and "accum" in reason
+    with pytest.raises(ValueError, match="grad_sync"):
+        ts.resolve_grad_sync(dp_only, "dense", "bogus")
+
+
+def test_pipeline_final_combine_dispatches_tuned_schedule():
+    """The pipeline's output combine rides the tuned surface: tune the
+    combine payload's octave and the traced hop log shows the zoo
+    schedule instead of the builtin psum."""
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        init_params,
+    )
+    from activemonitor_tpu.ops.pipeline import (
+        pipeline_forward_blocks,
+        stack_layer_params,
+    )
+
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    mesh = make_mesh(("pp",), (2,), devices=jax.devices()[:2])
+    params = init_params(jax.random.key(0), cfg)
+    stacked = stack_layer_params(params["layers"])
+    x = jax.random.normal(jax.random.key(1), (2, 8, cfg.d_model), jnp.float32)
+    # combine payload: [M=2, mb=1, S=8, D=16] f32
+    payload = 2 * 1 * 8 * 16 * 4
+    _record_for_every_octave("allreduce", 2, {payload}, "tree", jnp.float32)
+    schedules._HOP_LOG = log = []
+    try:
+        out = pipeline_forward_blocks(stacked, x, cfg, mesh, "pp")
+    finally:
+        schedules._HOP_LOG = None
+    tags = {tag for tag, _step in log}
+    assert {"tree-reduce", "tree-bcast"} <= tags, tags
+    # untuned: the builtin psum — bitwise-identical output
+    autotune.clear()
+    want = pipeline_forward_blocks(stacked, x, cfg, mesh, "pp")
+    assert jnp.allclose(out, want, atol=1e-6)
+
+
+def test_moe_dispatch_gather_rides_tuned_schedule():
+    from activemonitor_tpu.ops.moe import (
+        init_moe_params,
+        moe_ffn_expert_parallel,
+    )
+
+    mesh = make_1d_mesh("ep")
+    n = mesh.devices.size
+    params = init_moe_params(jax.random.key(0), d_model=16, d_ff=32, n_experts=8)
+    x = jax.random.normal(jax.random.key(1), (8 * n, 16), jnp.float32)
+    shard_bytes = (x.shape[0] // n) * 16 * 4
+    # all_gather decisions key on the GATHERED payload (x n)
+    _record_for_every_octave(
+        "allgather", n, {shard_bytes * n}, "ring", jnp.float32
+    )
+    fn = lambda p, x: moe_ffn_expert_parallel(p, x, mesh, "ep")
+    schedules._HOP_LOG = log = []
+    try:
+        got = jax.jit(fn)(params, x)
+    finally:
+        schedules._HOP_LOG = None
+    assert {tag for tag, _step in log} == {"ag-ring"}
+    autotune.clear()
+    want = jax.jit(fn)(params, x)
+    assert jnp.max(jnp.abs(got - want)) < 1e-5

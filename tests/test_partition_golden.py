@@ -1,0 +1,417 @@
+"""Golden-equivalence suite for the partition-rule refactor.
+
+Pins that rule-resolved PartitionSpecs are identical to the
+hand-threaded layouts they replaced, and that rule-driven op outputs
+(forward AND gradients) bitwise-match reconstructions of the
+pre-refactor hand-threaded paths — for ring attention
+(serial/overlap/bidir), the pipeline (overlap on/off), MoE, and the
+composed DP×TP×PP step — on meshes n ∈ {2, 4, 8}. The hand layouts
+live HERE as snapshots: the production code only has rules now, and
+this suite is what licensed deleting the hand-threaded call sites.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from activemonitor_tpu.parallel import autotune, partition
+from activemonitor_tpu.parallel.mesh import make_1d_mesh, make_mesh
+
+# the output-level goldens re-run every schedule twice (hand + rules)
+# with gradients — n=2 carries the tier-1 gate and the wider meshes
+# ride the slow tier (the test_graft_entry / test_schedules precedent:
+# tier-1 keeps the 870s budget, the soak tiers run the full matrix).
+# Correctness-vs-oracle at n=8 stays tier-1 in the per-op suites.
+MESH_SIZES = (
+    2,
+    pytest.param(4, marks=pytest.mark.slow),
+    pytest.param(8, marks=pytest.mark.slow),
+)
+
+
+@pytest.fixture(autouse=True)
+def _untuned_table():
+    # golden runs pin the UNTUNED dispatch (schedule="auto" → builtin)
+    autotune.clear()
+    yield
+    autotune.clear()
+
+
+def _spec_trees_equal(got, want):
+    same = jax.tree.map(
+        lambda a, b: a == b, got, want, is_leaf=lambda x: isinstance(x, P)
+    )
+    return all(jax.tree.leaves(same))
+
+
+def _mesh(n, axis):
+    return make_mesh((axis,), (n,), devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# spec-level golden: rules resolve to the exact hand-threaded layouts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("gqa", [False, True])
+def test_param_specs_match_hand_threaded_megatron_layout(gqa):
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        param_specs,
+    )
+
+    cfg = ProbeModelConfig(n_kv_heads=2 if gqa else None)
+    if gqa:
+        attn = {
+            "wq": P(None, "model", None),
+            "wkv": P(None, None, "model", None),
+        }
+    else:
+        attn = {"wqkv": P(None, None, "model", None)}
+    layer = {
+        "ln1": {"scale": P()},
+        **attn,
+        "wo": P("model", None, None),
+        "ln2": {"scale": P()},
+        "w_up": P(None, "model"),
+        "w_down": P("model", None),
+    }
+    hand = {
+        "embed": P(None, None),
+        "layers": [layer] * cfg.n_layers,
+        "final_ln": {"scale": P()},
+    }
+    assert _spec_trees_equal(param_specs(cfg), hand)
+
+
+def test_stacked_layer_specs_match_hand_threaded_layout():
+    from activemonitor_tpu.ops.pipeline import stacked_layer_specs
+
+    hand = {
+        "ln1": {"scale": P("pp", None)},
+        "wqkv": P("pp", None, None, "model", None),
+        "wo": P("pp", "model", None, None),
+        "ln2": {"scale": P("pp", None)},
+        "w_up": P("pp", None, "model"),
+        "w_down": P("pp", "model", None),
+    }
+    assert _spec_trees_equal(stacked_layer_specs("pp", "model"), hand)
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 1, 1), (1, 2, 2), (2, 2, 2)], ids=["n2", "n4", "n8"]
+)
+def test_composed_param_rules_match_hand_threaded_layout(shape):
+    from activemonitor_tpu.models.probe_model import init_params, tiny_config
+    from activemonitor_tpu.ops.pipeline import (
+        stack_layer_params,
+        stacked_layer_specs,
+    )
+    from activemonitor_tpu.probes.training_step import composed_param_rules
+
+    n = shape[0] * shape[1] * shape[2]
+    mesh = make_mesh(
+        ("data", "model", "pp"), shape, devices=jax.devices()[:n]
+    )
+    cfg = tiny_config()
+    raw = init_params(jax.random.key(0), cfg)
+    stacked = {
+        "embed": raw["embed"],
+        "layers": stack_layer_params(raw["layers"]),
+        "final_ln": raw["final_ln"],
+    }
+    hand = {
+        "embed": P(None, None),
+        "layers": stacked_layer_specs("pp", "model"),
+        "final_ln": {"scale": P()},
+    }
+    got = partition.match_partition_rules(
+        composed_param_rules("pp", "model"), stacked, mesh=mesh
+    )
+    assert _spec_trees_equal(got, hand)
+
+
+def test_moe_rules_match_hand_threaded_specs():
+    from activemonitor_tpu.ops.moe import (
+        init_moe_params,
+        moe_partition_rules,
+    )
+
+    params = init_moe_params(jax.random.key(0), 16, 32, 8)
+    x = jnp.zeros((32, 16))
+    got = partition.match_partition_rules(
+        moe_partition_rules("ep"), {**params, "x": x}
+    )
+    # the pre-refactor hand-threaded in_specs, verbatim
+    assert got["router"] == P(None, None)
+    assert got["w_up"] == P("ep", None, None)
+    assert got["w_down"] == P("ep", None, None)
+    assert got["x"] == P("ep", None)
+
+
+def test_ring_rules_match_hand_threaded_spec():
+    from activemonitor_tpu.ops.ring_attention import ring_partition_rules
+
+    q = jnp.zeros((1, 8, 2, 4))
+    got = partition.match_partition_rules(
+        ring_partition_rules("sp"), {"q": q, "k": q, "v": q}
+    )
+    for name in ("q", "k", "v"):
+        assert got[name] == P(None, "sp", None, None)
+    composed = partition.match_partition_rules(
+        ring_partition_rules("sp", batch_axis="data", heads_axis="model"),
+        {"q": q, "k": q, "v": q},
+    )
+    assert composed["q"] == P("data", "sp", "model", None)
+
+
+# ---------------------------------------------------------------------------
+# output-level golden: rule-driven == hand-threaded reconstruction, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", MESH_SIZES)
+@pytest.mark.parametrize("variant", ["serial", "overlap", "bidir"])
+def test_ring_attention_golden_fwd_and_grads(n, variant):
+    """Rule-resolved ring attention bitwise-matches the pre-refactor
+    hand-threaded shard_map call (reconstructed here with the exact
+    old spec), forward and gradients, for every schedule variant."""
+    from activemonitor_tpu.ops import ring_attention as ra
+
+    mesh = _mesh(n, "sp")
+    keys = jax.random.split(jax.random.key(n), 3)
+    q, k, v = (
+        jax.random.normal(kk, (1, 4 * n, 2, 8), jnp.float32) for kk in keys
+    )
+
+    def hand_path(q, k, v):
+        # the pre-refactor call: one hand-built spec threaded straight
+        # into shard_map around the same differentiable body
+        spec = P(None, "sp", None, None)
+        fn = partition.shard_map(
+            lambda a, b, c: ra._ring_diff(
+                a, b, c, "sp", n, True, False, variant, False
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    def rules_path(q, k, v):
+        return ra.ring_attention(q, k, v, mesh, "sp", variant=variant)
+
+    want = jax.jit(hand_path)(q, k, v)
+    got = jax.jit(rules_path)(q, k, v)
+    assert (got == want).all(), float(jnp.max(jnp.abs(got - want)))
+
+    def loss(fn):
+        return lambda a, b, c: jnp.sum(fn(a, b, c).astype(jnp.float32) ** 2)
+
+    g_hand = jax.jit(jax.grad(loss(hand_path), argnums=(0, 1, 2)))(q, k, v)
+    g_rules = jax.jit(jax.grad(loss(rules_path), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_rules, g_hand):
+        assert (a == b).all()
+
+
+@pytest.fixture(scope="module")
+def pipeline_setup():
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        init_params,
+    )
+    from activemonitor_tpu.ops.pipeline import stack_layer_params
+
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=8, d_ff=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    stacked = stack_layer_params(params["layers"])
+    x = jax.random.normal(jax.random.key(1), (8, 8, cfg.d_model), jnp.float32)
+    return cfg, stacked, x
+
+
+@pytest.mark.parametrize("n", MESH_SIZES)
+@pytest.mark.parametrize("overlap", [False, True], ids=["serial", "overlap"])
+def test_pipeline_golden_fwd_and_grads(pipeline_setup, n, overlap):
+    """Rule-resolved pipeline bitwise-matches the hand-threaded
+    boundary (the exact pre-refactor in_specs passed as explicit
+    rules, with the builtin psum pinned), forward and gradients."""
+    from activemonitor_tpu.ops.pipeline import pipeline_forward_blocks
+
+    cfg, stacked, x = pipeline_setup
+    mesh = _mesh(n, "pp")
+    hand_rules = (
+        (r"^layers(/|$)", P("pp")),
+        (r"^(micro|out)$", P(None, None, None, None)),
+    )
+
+    def hand_path(stacked, x):
+        return pipeline_forward_blocks(
+            stacked, x, cfg, mesh, "pp", overlap=overlap,
+            rules=hand_rules, allreduce_schedule="xla",
+        )
+
+    def rules_path(stacked, x):
+        return pipeline_forward_blocks(
+            stacked, x, cfg, mesh, "pp", overlap=overlap
+        )
+
+    want = jax.jit(hand_path)(stacked, x)
+    got = jax.jit(rules_path)(stacked, x)
+    assert (got == want).all()
+
+    def loss(fn):
+        return lambda layers, x: jnp.sum(fn(layers, x) ** 2)
+
+    try:
+        g_rules = jax.jit(jax.grad(loss(rules_path)))(stacked, x)
+    except NotImplementedError:
+        # lax.optimization_barrier has no differentiation rule on this
+        # runtime vintage, so the OVERLAPPED schedule's backward never
+        # existed pre-refactor either — forward bitwise above is the
+        # whole hand-threaded surface for that cell
+        assert overlap
+        return
+    g_hand = jax.jit(jax.grad(loss(hand_path)))(stacked, x)
+    same = jax.tree.map(lambda a, b: bool((a == b).all()), g_rules, g_hand)
+    assert all(jax.tree.leaves(same)), same
+
+
+@pytest.mark.parametrize("n", MESH_SIZES)
+def test_moe_golden_fwd_and_grads(n):
+    """Rule-driven MoE bitwise-matches the pre-refactor hand-threaded
+    body (hand in_specs, `lax.all_gather`, `scatter_dimension=0`
+    hard-coded), forward and gradients."""
+    from functools import partial as fpartial
+
+    from activemonitor_tpu.ops.moe import (
+        init_moe_params,
+        moe_ffn_expert_parallel,
+    )
+
+    mesh = _mesh(n, "ep")
+    params = init_moe_params(jax.random.key(0), d_model=16, d_ff=32, n_experts=8)
+    x = jax.random.normal(jax.random.key(1), (32, 16), jnp.float32)
+    e_local = 8 // n
+
+    def hand_path(params, x):
+        # the pre-refactor body, verbatim (dense top-1 dispatch with
+        # hand specs and the hard-coded dim-0 scatter)
+        @fpartial(
+            partition.shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(None, None), P("ep", None, None), P("ep", None, None),
+                P("ep", None),
+            ),
+            out_specs=P("ep", None),
+            check_vma=False,
+        )
+        def run(router, w_up, w_down, x_shard):
+            my_rank = jax.lax.axis_index("ep")
+            tokens = jax.lax.all_gather(x_shard, "ep", tiled=True)
+            logits = tokens @ router
+            expert = jnp.argmax(logits, axis=-1)
+            gate = jax.nn.softmax(logits, axis=-1)
+            gate = jnp.take_along_axis(gate, expert[:, None], axis=-1)
+            out = jnp.zeros_like(tokens)
+            for e in range(e_local):
+                eid = my_rank * e_local + e
+                mask = (expert == eid)[:, None].astype(tokens.dtype)
+                h = jax.nn.gelu(tokens @ w_up[e])
+                out = out + mask * gate * (h @ w_down[e])
+            return jax.lax.psum_scatter(out, "ep", scatter_dimension=0, tiled=True)
+
+        return run(params["router"], params["w_up"], params["w_down"], x)
+
+    def rules_path(params, x):
+        return moe_ffn_expert_parallel(params, x, mesh, "ep")
+
+    want = jax.jit(hand_path)(params, x)
+    got = jax.jit(rules_path)(params, x)
+    assert (got == want).all()
+
+    def loss(fn):
+        return lambda p, x: jnp.sum(fn(p, x) ** 2)
+
+    g_hand = jax.jit(jax.grad(loss(hand_path), argnums=(0, 1)))(params, x)
+    g_rules = jax.jit(jax.grad(loss(rules_path), argnums=(0, 1)))(params, x)
+    same = jax.tree.map(lambda a, b: bool((a == b).all()), g_rules, g_hand)
+    assert all(jax.tree.leaves(same)), same
+
+
+def test_moe_re_meshed_layout_scatters_the_derived_axis():
+    """The satellite fix: a re-meshed token layout (leading replicated
+    group dim, tokens sharded on dim 1) gathers/scatters the RIGHT
+    axis — derived from the resolved spec, never the hard-coded 0 —
+    and still matches the dense oracle."""
+    from activemonitor_tpu.ops.moe import (
+        init_moe_params,
+        moe_ffn_expert_parallel,
+        moe_ffn_reference,
+        moe_partition_rules,
+    )
+
+    mesh = make_1d_mesh("ep")
+    params = init_moe_params(jax.random.key(0), d_model=16, d_ff=32, n_experts=8)
+    x = jax.random.normal(jax.random.key(1), (3, 32, 16), jnp.float32)
+    rules = (
+        ("^router$", P(None, None)),
+        (r"^w_(up|down)$", P("ep", None, None)),
+        ("^x$", P(None, "ep", None)),  # tokens on dim 1, groups replicated
+    )
+    got = jax.jit(
+        lambda p, x: moe_ffn_expert_parallel(p, x, mesh, "ep", rules=rules)
+    )(params, x)
+    want = moe_ffn_reference(params, x)
+    assert got.shape == x.shape
+    assert jnp.max(jnp.abs(got - want)) < 1e-5
+    # a layout that does not shard tokens over the axis is a clear error
+    bad = moe_partition_rules("ep")[:-1] + (("^x$", P(None, None)),)
+    with pytest.raises(ValueError, match="does not shard over"):
+        moe_ffn_expert_parallel(
+            params, x[0], mesh, "ep", rules=bad
+        )
+    # rules leaving the expert weights unsharded would silently reuse
+    # the first local-expert block on every shard — hard error instead
+    with pytest.raises(ValueError, match="leading \\(expert\\) dim"):
+        moe_ffn_expert_parallel(
+            params, x[0], mesh, "ep", rules=(("^x$", P("ep", None)),)
+        )
+    # a sharded router would route differently per shard — same gate
+    sharded_router = (("^router$", P("ep", None)),) + moe_partition_rules("ep")[1:]
+    with pytest.raises(ValueError, match="router"):
+        moe_ffn_expert_parallel(
+            params, x[0], mesh, "ep", rules=sharded_router
+        )
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 1, 1), (1, 2, 2), (2, 2, 2)], ids=["n2", "n4", "n8"]
+)
+def test_composed_train_step_golden(shape):
+    """The composed DP×TP×PP step under rule-resolved specs: the
+    resolved sharding tree equals the hand-threaded one (asserted for
+    every mesh above), and a step executes to a finite loss — bitwise
+    identity of the program follows from spec identity, which is the
+    part the legacy runtime can also check."""
+    from activemonitor_tpu.models.probe_model import tiny_config
+    from activemonitor_tpu.probes.training_step import (
+        build_composed_train_step,
+    )
+    from activemonitor_tpu.utils.compat import SUPPORTS_PARTIAL_MANUAL
+
+    if not SUPPORTS_PARTIAL_MANUAL:
+        pytest.skip("legacy shard_map: no partial-manual composed mode")
+    n = shape[0] * shape[1] * shape[2]
+    mesh = make_mesh(("data", "model", "pp"), shape, devices=jax.devices()[:n])
+    cfg = tiny_config()
+    step, params, opt, data_sh = build_composed_train_step(cfg, mesh)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(7), (4, 17), 0, cfg.vocab_size),
+        data_sh,
+    )
+    _, _, loss = step(params, opt, tokens)
+    assert bool(jnp.isfinite(loss))

@@ -70,7 +70,7 @@ def test_ppermute_bidir_chain_is_correct_and_reports():
     from jax.sharding import PartitionSpec as P
 
     from activemonitor_tpu.parallel.collectives import ppermute_bidir_bandwidth
-    from activemonitor_tpu.utils.compat import shard_map
+    from activemonitor_tpu.parallel.partition import shard_map
 
     mesh = make_1d_mesh()
     n = 8
@@ -115,7 +115,7 @@ def test_all_to_all_chain_is_shape_preserving_and_correct():
     """One tiled all-to-all body round-trips shards correctly."""
     from functools import partial
 
-    from activemonitor_tpu.utils.compat import shard_map
+    from activemonitor_tpu.parallel.partition import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_1d_mesh()
@@ -309,7 +309,7 @@ def test_collective_correctness():
     """The timing chain must still compute a correct mean-all-reduce."""
     from functools import partial
 
-    from activemonitor_tpu.utils.compat import shard_map
+    from activemonitor_tpu.parallel.partition import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_1d_mesh()

@@ -329,7 +329,7 @@ def _sharded_fwd(m, n, variant, causal=True, unroll=False):
     from jax.sharding import PartitionSpec as P
 
     from activemonitor_tpu.ops.ring_attention import _ring_attention_sharded
-    from activemonitor_tpu.utils.compat import shard_map
+    from activemonitor_tpu.parallel.partition import shard_map
 
     spec = P(None, "sp", None, None)
     lse_spec = P(None, None, "sp")

@@ -31,7 +31,7 @@ from activemonitor_tpu.parallel.schedules import (
     all_reduce_tree_bandwidth,
     theoretical_hops,
 )
-from activemonitor_tpu.utils.compat import shard_map
+from activemonitor_tpu.parallel.partition import shard_map
 
 AXIS = "zoo"
 
