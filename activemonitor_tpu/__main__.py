@@ -176,6 +176,17 @@ def build_parser() -> argparse.ArgumentParser:
         "at /debug/flightrec on the health endpoint "
         "(docs/operations.md \"Reading a flight recording\")",
     )
+    run.add_argument(
+        "--matrix-state",
+        default="",
+        metavar="PATH",
+        help="serve the scenario matrix's latest round from this "
+        "durable sidecar (bench.py's BENCH_BASELINES.json) in the "
+        "/statusz fleet block and `am-tpu matrix`; a corrupt or "
+        "version-skewed sidecar reports a structured warning instead "
+        "of failing the payload (docs/observability.md \"Reading the "
+        "matrix\")",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -283,6 +294,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_statusz_flags(roofline)
     roofline.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
+    matrix = sub.add_parser(
+        "matrix",
+        help="the scenario matrix's latest round: one row per cell "
+        "(op x mesh x dtype x schedule) with VERDICT/CEILING/"
+        "VS-BASELINE, structured skip reasons, and any confirmed "
+        "regressions with their bisect outcomes "
+        "(docs/observability.md \"Reading the matrix\")",
+    )
+    add_statusz_flags(matrix)
+    matrix.add_argument(
         "-o", "--output", choices=["text", "json"], default="text"
     )
 
@@ -433,6 +457,14 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         # cluster transport and gates the mutating ones (leases exempt)
         # — the signal source for degraded mode (docs/resilience.md)
         kube_api.set_breaker(reconciler.resilience.breaker)
+    matrix_state = getattr(args, "matrix_state", "")
+    if matrix_state:
+        # /statusz serves the scenario matrix's latest round from the
+        # durable sidecar bench.py maintains (read-only: the controller
+        # did not run the round, it reports the evidence)
+        from activemonitor_tpu.analysis.matrix import SidecarView
+
+        reconciler.fleet.matrix = SidecarView(matrix_state)
     metrics_authorizer = None
     k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
     if k8s_auth == "on" and kube_api is None:
@@ -1166,6 +1198,113 @@ async def _roofline(args) -> int:
     return 0
 
 
+def render_matrix(block) -> str:
+    """The /statusz fleet ``matrix`` block as the `am-tpu matrix` cell
+    table: per cell the hysteresis VERDICT, the roofline CEILING a
+    regression would name, and VS-BASELINE against the learned median.
+    Pure over the payload block so tests pin the rendering."""
+    if not block:
+        return (
+            "no scenario-matrix rounds recorded yet (run bench.py, or "
+            "point the controller at the sidecar with --matrix-state)"
+        )
+    lines = [
+        "matrix round {}  interpret_mode={}  ok={} skipped={} error={}".format(
+            block.get("generated_at", "-"),
+            str(bool(block.get("interpret_mode"))).lower(),
+            (block.get("counts") or {}).get("ok", 0),
+            (block.get("counts") or {}).get("skipped", 0),
+            (block.get("counts") or {}).get("error", 0),
+        )
+    ]
+    if block.get("fallback_reason"):
+        lines.append(f"fallback_reason: {block['fallback_reason']}")
+    warning = block.get("restore_warning")
+    if warning:
+        lines.append(
+            "sidecar restored fresh: {} ({})".format(
+                warning.get("reason", "?"), warning.get("detail", "")
+            )
+        )
+    cells = block.get("cells") or {}
+    headers = [
+        "CELL", "STATUS", "VERDICT", "VALUE", "VS-BASELINE", "CEILING",
+        "SCHED", "REASON",
+    ]
+    rows = []
+    for cell_id in sorted(cells):
+        entry = cells[cell_id]
+        roofline = entry.get("roofline") or {}
+        if entry.get("status") == "ok":
+            ceiling = (
+                roofline.get("bound", "-")
+                if "bound" in roofline
+                else f"({roofline.get('skipped', 'no verdict')[:28]})"
+            )
+        else:
+            ceiling = "-"
+        value = entry.get("value")
+        vs_baseline = entry.get("vs_baseline")
+        rows.append(
+            [
+                cell_id,
+                entry.get("status", "?"),
+                entry.get("verdict", "-"),
+                (
+                    f"{value:.4g}{entry.get('unit', '')}"
+                    if isinstance(value, (int, float))
+                    else "-"
+                ),
+                (
+                    f"{vs_baseline:.2f}x"
+                    if isinstance(vs_baseline, (int, float))
+                    else "-"
+                ),
+                ceiling,
+                entry.get("schedule") or "-",
+                (entry.get("reason") or "")[:60],
+            ]
+        )
+    if rows:
+        widths = [
+            max(len(h), *(len(r[i]) for r in rows))
+            for i, h in enumerate(headers)
+        ]
+        lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in rows:
+            lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for regression in block.get("regressions") or []:
+        lines.append(
+            "REGRESSION {}: {} {} -> {}  ceiling={}  bisect={}".format(
+                regression.get("cell", "?"),
+                regression.get("metric", "?"),
+                *(regression.get("transition") or ["?", "?"])[:2],
+                regression.get("ceiling") or "unstamped",
+                regression.get("bisect_outcome", "not-run"),
+            )
+        )
+    if block.get("interpret_mode"):
+        lines.append(
+            "note: interpret-mode round — analytic cost models and CPU "
+            "timings, never compared against a TPU bar"
+        )
+    return "\n".join(lines)
+
+
+async def _matrix(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
+    block = (payload.get("fleet") or {}).get("matrix")
+    if args.output == "json":
+        print(_json.dumps(block, indent=2))
+        return 0
+    print(render_matrix(block))
+    return 0
+
+
 async def _describe(args) -> int:
     import yaml as _yaml
 
@@ -1263,6 +1402,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "why": _why,
         "goodput": _goodput,
         "roofline": _roofline,
+        "matrix": _matrix,
     }[args.command]
     if args.command == "run":
         # pre-import the controller's heavy dependency graph BEFORE the

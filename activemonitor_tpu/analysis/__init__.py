@@ -20,7 +20,12 @@ baseline*, not the point reading):
 - :mod:`engine` — the reconciler-owned façade wiring the three
   together: feeds run samples, persists/adopts durable baselines,
   exports the ``healthcheck_metric_baseline`` / ``_metric_zscore`` /
-  ``_anomaly_state`` families, and reports into ``/statusz``.
+  ``_anomaly_state`` families, and reports into ``/statusz``;
+- :mod:`matrix` — the declarative scenario matrix (ISSUE 12): a
+  config-file spec expanded into bench cells, each riding the same
+  baseline/hysteresis/roofline evidence stack with a durable
+  ``BENCH_BASELINES.json`` sidecar, auto-bisect on confirmed
+  regression, and the ``healthcheck_matrix_*`` /statusz/CLI surfaces.
 """
 
 from activemonitor_tpu.analysis.baseline import (
@@ -43,6 +48,7 @@ from activemonitor_tpu.analysis.detector import (
 )
 from activemonitor_tpu.analysis.engine import AnalysisEngine, AnalysisVerdict
 from activemonitor_tpu.analysis.fleet import CohortIndex
+from activemonitor_tpu.analysis.matrix import MatrixObservatory, SidecarView
 
 __all__ = [
     "ANOMALY_STATES",
@@ -56,7 +62,9 @@ __all__ = [
     "LEVEL_DEGRADED",
     "LEVEL_OK",
     "LEVEL_WARNING",
+    "MatrixObservatory",
     "MetricBaseline",
+    "SidecarView",
     "RatedFractionDetector",
     "RobustZScoreDetector",
     "TrendDetector",

@@ -25,10 +25,12 @@ durable blob) so fake-clock tests pin exact timestamps.
 
 from __future__ import annotations
 
+import json
 import math
+import os
 import statistics
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from activemonitor_tpu.utils.clock import Clock
 
@@ -208,3 +210,71 @@ class CheckBaselines:
             except (TypeError, ValueError):
                 continue
         return baselines
+
+
+# ---------------------------------------------------------------------
+# durable sidecar blob (BENCH_BASELINES.json — the scenario matrix's
+# cross-round persistence, analysis/matrix.py)
+# ---------------------------------------------------------------------
+
+# bump on any incompatible blob layout change: a version-skewed sidecar
+# restores FRESH (with a structured warning), never half-parsed — the
+# same discipline .status.analysis blobs follow (STATUS_VERSION)
+BLOB_VERSION = 1
+
+
+def save_blob(path: str, doc: dict) -> Optional[dict]:
+    """Persist a versioned baseline sidecar atomically (tmp + replace —
+    a crash mid-write must leave the previous round's blob intact, not
+    a truncated JSON the next round then discards as corrupt). Returns
+    a structured error dict on failure (never raises: persistence is
+    evidence, not a gate on the round that produced it)."""
+    payload = {"blob_version": BLOB_VERSION, **doc}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as exc:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return {"reason": "write-failed", "detail": str(exc)[:200]}
+    return None
+
+
+def load_blob(path: str) -> Tuple[Optional[dict], Optional[dict]]:
+    """Restore a sidecar written by :func:`save_blob`.
+
+    Returns ``(doc, warning)`` where exactly one of the two carries
+    information: a readable current-version blob yields ``(doc,
+    None)``; a missing file yields ``(None, None)`` (first round —
+    nothing to warn about); anything else — unreadable file, corrupt
+    JSON, non-dict top level, or a version the reader doesn't speak —
+    yields ``(None, warning)`` with a structured reason so the caller
+    starts a FRESH baseline and surfaces WHY instead of crashing or
+    silently judging against half-parsed statistics."""
+    try:
+        with open(path) as fh:
+            raw = fh.read()
+    except FileNotFoundError:
+        return None, None
+    except OSError as exc:
+        return None, {"reason": "unreadable", "detail": str(exc)[:200]}
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError as exc:
+        return None, {"reason": "corrupt-json", "detail": str(exc)[:200]}
+    if not isinstance(doc, dict):
+        return None, {
+            "reason": "corrupt-shape",
+            "detail": f"top level is {type(doc).__name__}, expected object",
+        }
+    version = doc.get("blob_version")
+    if version != BLOB_VERSION:
+        return None, {
+            "reason": "version-skew",
+            "detail": f"blob_version {version!r}, reader speaks {BLOB_VERSION}",
+        }
+    return doc, None

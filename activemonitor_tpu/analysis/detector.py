@@ -167,32 +167,64 @@ class Hysteresis:
     de-escalates after ``calm_runs`` consecutive runs below it, and
     moves ONE step per transition (ok → warning → degraded and back) —
     so a single outlier run changes nothing, and recovery is as
-    deliberate as escalation. Streaks reset on every transition."""
+    deliberate as escalation. Streaks reset on every transition.
 
-    __slots__ = ("level", "up_streak", "down_streak", "confirm_runs", "calm_runs")
+    ``jump_to_raw=True`` (the scenario-matrix contract,
+    analysis/matrix.py): a confirmed escalation moves directly to the
+    WEAKEST raw level the streak sustained instead of one step — so
+    two confirming degraded rounds report degraded, while a lone noisy
+    round still never moves the state, and recovery stays one
+    deliberate step per calm streak either way."""
 
-    def __init__(self, confirm_runs: int = 2, calm_runs: int = 3):
+    __slots__ = (
+        "level", "up_streak", "down_streak", "confirm_runs", "calm_runs",
+        "jump_to_raw", "up_floor",
+    )
+
+    def __init__(
+        self,
+        confirm_runs: int = 2,
+        calm_runs: int = 3,
+        jump_to_raw: bool = False,
+    ):
         self.level = LEVEL_OK
         self.up_streak = 0
         self.down_streak = 0
         self.confirm_runs = max(1, confirm_runs)
         self.calm_runs = max(1, calm_runs)
+        self.jump_to_raw = jump_to_raw
+        # weakest raw level seen during the CURRENT up streak — the
+        # level a confirmed jump_to_raw escalation lands on (a streak
+        # of [degraded, warning] confirms only warning)
+        self.up_floor = LEVEL_OK
 
     def update(self, raw_level: int) -> Optional[Tuple[int, int]]:
         """Feed one run's raw level; returns ``(old, new)`` on a state
         transition, else None."""
         raw_level = max(LEVEL_OK, min(LEVEL_DEGRADED, int(raw_level)))
         if raw_level > self.level:
+            self.up_floor = (
+                raw_level
+                if self.up_streak == 0
+                else min(self.up_floor, raw_level)
+            )
             self.up_streak += 1
             self.down_streak = 0
             if self.up_streak >= self.confirm_runs:
                 old = self.level
-                self.level += 1
+                if self.jump_to_raw:
+                    self.level = max(self.level + 1, self.up_floor)
+                else:
+                    self.level += 1
                 self.up_streak = 0
+                self.up_floor = LEVEL_OK
                 return (old, self.level)
         elif raw_level < self.level:
             self.down_streak += 1
             self.up_streak = 0
+            # a broken up streak must clear its floor, or the stale
+            # nonzero value serializes into every later blob
+            self.up_floor = LEVEL_OK
             if self.down_streak >= self.calm_runs:
                 old = self.level
                 self.level -= 1
@@ -201,23 +233,36 @@ class Hysteresis:
         else:
             self.up_streak = 0
             self.down_streak = 0
+            self.up_floor = LEVEL_OK
         return None
 
     # -- persistence (rides .status.analysis) ---------------------------
     def to_dict(self) -> dict:
-        return {"level": self.level, "up": self.up_streak, "down": self.down_streak}
+        doc = {"level": self.level, "up": self.up_streak, "down": self.down_streak}
+        if self.up_floor:
+            # only mid-streak state needs the floor; omitting the zero
+            # keeps pre-existing blobs byte-identical
+            doc["floor"] = self.up_floor
+        return doc
 
     @classmethod
     def from_dict(
-        cls, data: dict, confirm_runs: int = 2, calm_runs: int = 3
+        cls,
+        data: dict,
+        confirm_runs: int = 2,
+        calm_runs: int = 3,
+        jump_to_raw: bool = False,
     ) -> "Hysteresis":
-        state = cls(confirm_runs, calm_runs)
+        state = cls(confirm_runs, calm_runs, jump_to_raw)
         try:
             state.level = max(LEVEL_OK, min(LEVEL_DEGRADED, int(data.get("level", 0))))
             state.up_streak = max(0, int(data.get("up", 0)))
             state.down_streak = max(0, int(data.get("down", 0)))
+            state.up_floor = max(
+                LEVEL_OK, min(LEVEL_DEGRADED, int(data.get("floor", 0)))
+            )
         except (TypeError, ValueError):
-            return cls(confirm_runs, calm_runs)
+            return cls(confirm_runs, calm_runs, jump_to_raw)
         return state
 
 

@@ -1,0 +1,1203 @@
+"""Declarative scenario matrix — the bench/probe observatory.
+
+bench.py used to run one hand-picked metric per round, so scenario
+coverage grew only when someone wrote a new probe, and a regression
+between rounds was invisible unless a human diffed artifacts. This
+module is the ReFrame-style answer (PAPERS.md, arXiv:2404.10536 —
+benchmarking ML on heterogeneous architectures): a config-file spec —
+mesh shape × dtype × op × schedule variant — expands into a run
+matrix, and every cell's result flows through the SAME evidence stack
+the controller's checks already ride:
+
+- **cells are config, not code**: each cell re-meshes by a
+  partition-rule tuple (the ops layer resolves layouts from rules,
+  parallel/partition.py, PR 10) and picks its collective from the
+  autotune decision table (parallel/autotune.py, PR 8) — adding a
+  scenario is an edit to ``config/bench_matrix.json``, not a PR.
+- **per-(cell, metric) rolling baselines** (analysis/baseline.py)
+  persisted to a durable ``BENCH_BASELINES.json`` sidecar
+  (:func:`activemonitor_tpu.analysis.baseline.save_blob`) so they
+  survive across rounds; a corrupt or version-skewed sidecar restores
+  FRESH with a structured warning, never a crash or half-parsed stats.
+- **hysteresis verdicts** (analysis/detector.py, ``jump_to_raw``): a
+  lone noisy round never moves the reported state; two confirming
+  rounds escalate it to the confirmed raw level.
+- **a roofline stamp per cell** (obs/roofline.py): a confirmed
+  regression names WHICH ceiling moved (compute/memory/comm), with the
+  cost source labeled (always ``model`` here — analytic estimates,
+  interpret-mode runs are never compared against a TPU bar).
+- **auto-bisect on confirmed regression**: the cell re-runs exactly
+  once against the prior artifact's value, and a flight-recorder
+  bundle (obs/flightrec.py, ``matrix-regression``) captures both
+  rounds' cell evidence plus the bisect verdict.
+
+Surfaces: the pinned ``healthcheck_matrix_*`` Prometheus families
+(metrics/collector.py), the ``/statusz`` fleet ``matrix`` block
+(obs/slo.py — :class:`SidecarView` serves the durable sidecar to a
+controller that didn't run the round), the ``am-tpu matrix`` CLI verb,
+and bench.py stamping ``matrix_summary`` into every artifact on both
+the TPU and CPU-fallback paths (the fallback labels ``interpret_mode``
+and carries ``fallback_reason`` into every cell).
+
+Clock discipline like the rest of analysis/: no wall-clock reads
+(``hack/lint.py`` bans them here) — the executor's timer is injectable
+(the :class:`~activemonitor_tpu.probes.base.PhaseTimings` idiom), and
+all verdict machinery runs on the injectable Clock so scripted-timing
+tests are deterministic.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from activemonitor_tpu.analysis import baseline as baseline_store
+from activemonitor_tpu.analysis.baseline import CheckBaselines
+from activemonitor_tpu.analysis.detector import (
+    DetectorConfig,
+    Hysteresis,
+    LEVEL_DEGRADED,
+    LEVEL_OK,
+    combine_raw_levels,
+    default_detectors,
+    finite,
+    level_name,
+)
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.matrix")
+
+MATRIX_VERSION = 1
+
+# the durable sidecar's conventional basename (bench.py writes it next
+# to the BENCH_r*.json artifacts; the controller's --matrix-state
+# points at the same file)
+SIDECAR_BASENAME = "BENCH_BASELINES.json"
+
+STATUS_OK = "ok"
+STATUS_SKIPPED = "skipped"
+STATUS_ERROR = "error"
+
+# structured per-cell skip reasons (the silent-omission ban: a cell
+# that cannot run is a visible, machine-readable hole with the thing
+# it lacked named — never a crash, never silently absent)
+SKIP_UNKNOWN_OP = "unknown-op"
+SKIP_UNKNOWN_DTYPE = "unknown-dtype"
+SKIP_UNSUPPORTED_DTYPE = "unsupported-dtype"
+SKIP_MISSING_AXIS = "missing-mesh-axis"
+SKIP_UNKNOWN_SCHEDULE = "unknown-schedule"
+SKIP_DEVICES = "insufficient-devices"
+SKIP_QUICK = "quick-mode"
+
+# schedule tokens an accepts_schedule op can honor: "auto" (the
+# autotune decision table) plus the zoo tokens the tuned dispatch
+# implements (parallel/autotune._ALL_REDUCE_IMPL — mirrored here so
+# expansion stays jax-free; the expansion test pins the mirror against
+# the probe layer's GRAD_SYNC_SCHEDULES)
+KNOWN_SCHEDULES = ("auto", "xla", "rsag", "recdouble", "tree")
+
+# auto-bisect outcomes (healthcheck_matrix_bisect_runs_total{outcome=})
+BISECT_REPRODUCED = "reproduced"
+BISECT_RECOVERED = "recovered"
+BISECT_ERROR = "error"
+
+_DTYPE_ALIASES = {
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+    "f32": "float32",
+    "fp32": "float32",
+    "float32": "float32",
+}
+_DTYPE_SHORT = {"bfloat16": "bf16", "float32": "f32"}
+
+
+def canonical_dtype(token) -> Optional[str]:
+    """Canonical dtype name for a spec token, or None (unknown tokens
+    become structured skips, not KeyErrors)."""
+    return _DTYPE_ALIASES.get(str(token).strip().lower())
+
+
+@dataclass(frozen=True)
+class OpDef:
+    """One scenario op's declared requirements — what :func:`expand`
+    validates cells against, so an impossible combination is a
+    structured skip at expansion time, never a tracer crash inside a
+    runner."""
+
+    name: str
+    required_axes: Tuple[str, ...]  # mesh axes the op shards over
+    dtypes: Tuple[str, ...]  # canonical dtype names it supports
+    # which autotune decision table the op's dominant collective rides
+    # ("allreduce" | "allgather" | "" = no tuned collective), and
+    # whether an EXPLICIT schedule token can actually be threaded into
+    # the op — an op whose dispatch is internal (always schedule
+    # "auto") must not expand over variants it cannot honor: the
+    # matrix would report distinct scenarios for identical runs
+    collective: str = ""
+    accepts_schedule: bool = False
+
+
+# the op registry: flash/ring/moe/pipeline/decode/training-step — the
+# scenario classes ROADMAP item 2 names. decode is deliberately
+# float32-only (its fused-vs-dense gate is a numerics contract): a
+# bf16 decode cell in the spec exercises the unsupported-dtype skip.
+# moe's token gather is an internal autotune.all_gather("auto") —
+# tuned, but not variant-addressable; ring rides hand-written ppermute
+# schedules, not the autotune table.
+OPS: Dict[str, OpDef] = {
+    "flash": OpDef("flash", (), ("bfloat16", "float32")),
+    "ring": OpDef("ring", ("sp",), ("bfloat16", "float32")),
+    "moe": OpDef(
+        "moe", ("ep",), ("bfloat16", "float32"), collective="allgather"
+    ),
+    "pipeline": OpDef(
+        "pipeline",
+        ("pp",),
+        ("bfloat16", "float32"),
+        collective="allreduce",
+        accepts_schedule=True,
+    ),
+    "decode": OpDef("decode", (), ("float32",)),
+    "training-step": OpDef(
+        "training-step",
+        ("data", "model"),
+        ("bfloat16", "float32"),
+        collective="allreduce",
+        accepts_schedule=True,
+    ),
+}
+
+
+@dataclass(frozen=True)
+class CellSpec:
+    """One expanded matrix cell. ``mesh`` is the ordered partition-rule
+    tuple of (axis, size) pairs the cell re-meshes by — restricted to
+    the op's required axes, so two meshes that agree on them yield the
+    SAME cell (deduped at expansion)."""
+
+    op: str
+    mesh: Tuple[Tuple[str, int], ...]
+    dtype: str  # canonical dtype name
+    schedule: str  # "auto" | explicit zoo token | "-" (no collective)
+
+    @property
+    def mesh_id(self) -> str:
+        if not self.mesh:
+            return "1chip"
+        return "x".join(f"{axis}{size}" for axis, size in self.mesh)
+
+    @property
+    def cell_id(self) -> str:
+        short = _DTYPE_SHORT.get(self.dtype, self.dtype)
+        parts = [self.op, self.mesh_id, short]
+        if self.schedule != "-":
+            parts.append(self.schedule)
+        return "/".join(parts)
+
+    @property
+    def devices_needed(self) -> int:
+        n = 1
+        for _axis, size in self.mesh:
+            n *= size
+        return n
+
+
+@dataclass
+class CellResult:
+    """One cell's outcome for one round — measured by a runner,
+    scripted by a test executor, or pre-skipped at expansion."""
+
+    cell: CellSpec
+    status: str
+    reason: str = ""
+    value: Optional[float] = None  # headline measurement
+    metric: str = "seconds"  # headline metric name
+    unit: str = "s"
+    seconds: float = 0.0  # measured seconds per op (roofline input)
+    flops: float = 0.0  # analytic cost model: FLOPs per op
+    bytes_accessed: float = 0.0  # analytic cost model: HBM bytes per op
+    schedule: str = ""  # resolved collective schedule token
+    details: Dict = field(default_factory=dict)
+
+
+def skipped_result(cell: CellSpec, reason_code: str, detail: str) -> CellResult:
+    return CellResult(
+        cell,
+        STATUS_SKIPPED,
+        reason=f"{reason_code}: {detail}",
+        details={"skip": {"code": reason_code, "detail": detail}},
+    )
+
+
+# ---------------------------------------------------------------------
+# spec loading + expansion
+# ---------------------------------------------------------------------
+
+DEFAULT_SPEC: dict = {
+    "version": MATRIX_VERSION,
+    "ops": ["flash", "ring", "moe", "pipeline", "decode", "training-step"],
+    "meshes": [{"sp": 8}, {"ep": 8}, {"data": 2, "model": 2, "pp": 2}],
+    "dtypes": ["bf16", "f32"],
+    "schedules": ["auto"],
+}
+
+
+def load_spec(path: Optional[str]) -> Tuple[dict, Optional[dict]]:
+    """The matrix spec from a config file, defensively: a missing path
+    is the default spec (no warning — config is optional); anything
+    unreadable/corrupt/mis-shaped is the default spec PLUS a structured
+    warning, so a fat-fingered config degrades to known coverage
+    instead of crashing the bench round."""
+    if not path:
+        return dict(DEFAULT_SPEC), None
+    import json
+
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return dict(DEFAULT_SPEC), None
+    except (OSError, json.JSONDecodeError) as exc:
+        return dict(DEFAULT_SPEC), {
+            "reason": "spec-unreadable",
+            "detail": f"{path}: {exc}"[:200],
+        }
+    if not isinstance(doc, dict):
+        return dict(DEFAULT_SPEC), {
+            "reason": "spec-shape",
+            "detail": f"{path}: top level is {type(doc).__name__}",
+        }
+    spec = dict(DEFAULT_SPEC)
+    for key in ("ops", "meshes", "dtypes", "schedules"):
+        value = doc.get(key)
+        if isinstance(value, list) and value:
+            spec[key] = value
+    if "version" in doc:
+        spec["version"] = doc["version"]
+    return spec, None
+
+
+def expand(
+    spec: dict, n_devices: Optional[int] = None
+) -> Tuple[List[CellSpec], List[CellResult]]:
+    """Expand a spec into ``(runnable, skipped)``.
+
+    Every invalid combination is a structured skipped
+    :class:`CellResult` naming exactly what the cell lacked (the absent
+    mesh axis, the unsupported dtype, the device deficit) — the matrix
+    has no silent holes and expansion never raises on spec content.
+    Cells that agree on an op's required axes dedupe (first mesh wins);
+    ops that use no collective do not multiply over schedule variants.
+    """
+    runnable: List[CellSpec] = []
+    skipped: List[CellResult] = []
+    seen: set = set()
+    for op_token in spec.get("ops") or []:
+        op = OPS.get(str(op_token))
+        for mesh_doc in spec.get("meshes") or [{}]:
+            mesh_doc = mesh_doc if isinstance(mesh_doc, dict) else {}
+            try:
+                full_mesh = tuple(
+                    (str(axis), int(size)) for axis, size in mesh_doc.items()
+                )
+            except (TypeError, ValueError):
+                full_mesh = ()
+            for dtype_token in spec.get("dtypes") or ["f32"]:
+                canonical = canonical_dtype(dtype_token)
+                schedules = list(spec.get("schedules") or ["auto"])
+                if op is None or not op.collective:
+                    schedules = ["-"]
+                elif not op.accepts_schedule:
+                    # internal dispatch is always "auto": explicit
+                    # variants cannot be threaded in, so expanding
+                    # them would label identical runs as distinct
+                    # scenarios
+                    schedules = ["auto"]
+                for schedule in schedules:
+                    cell = CellSpec(
+                        op=str(op_token),
+                        mesh=full_mesh,
+                        dtype=canonical or str(dtype_token),
+                        schedule=str(schedule),
+                    )
+                    if cell.cell_id in seen:
+                        # alias dtype tokens ("bf16" + "bfloat16") and
+                        # repeated entries canonicalize to the same
+                        # cell: one row, one count — runnable or skip
+                        continue
+                    if op is None:
+                        seen.add(cell.cell_id)
+                        skipped.append(
+                            skipped_result(
+                                cell,
+                                SKIP_UNKNOWN_OP,
+                                f"op {op_token!r} not in registry "
+                                f"({', '.join(sorted(OPS))})",
+                            )
+                        )
+                        continue
+                    missing = [
+                        axis
+                        for axis in op.required_axes
+                        if axis not in dict(full_mesh)
+                    ]
+                    if missing:
+                        # inherently mesh-specific: the skip names THIS
+                        # mesh, so it keeps the full-mesh cell id
+                        seen.add(cell.cell_id)
+                        skipped.append(
+                            skipped_result(
+                                cell,
+                                SKIP_MISSING_AXIS,
+                                f"op {op.name!r} needs mesh axis "
+                                f"{missing[0]!r}; mesh has "
+                                f"{dict(full_mesh) or '{}'}",
+                            )
+                        )
+                        continue
+                    # the cell's partition-rule tuple: ONLY the op's
+                    # required axes (two meshes agreeing on them are
+                    # the same scenario) — restricted BEFORE the dtype
+                    # checks, so a dtype skip carries the same
+                    # canonical id its runnable siblings use and
+                    # dedupes across meshes like they do
+                    cell = replace(
+                        cell,
+                        mesh=tuple(
+                            (axis, dict(full_mesh)[axis])
+                            for axis in op.required_axes
+                        ),
+                    )
+                    if cell.cell_id in seen:
+                        continue  # dedupe, not a hole: same scenario
+                    seen.add(cell.cell_id)
+                    if canonical is None:
+                        skipped.append(
+                            skipped_result(
+                                cell,
+                                SKIP_UNKNOWN_DTYPE,
+                                f"dtype token {dtype_token!r} is not a "
+                                "known dtype",
+                            )
+                        )
+                        continue
+                    if canonical not in op.dtypes:
+                        skipped.append(
+                            skipped_result(
+                                cell,
+                                SKIP_UNSUPPORTED_DTYPE,
+                                f"op {op.name!r} does not support "
+                                f"{canonical} (supports: "
+                                f"{', '.join(op.dtypes)})",
+                            )
+                        )
+                        continue
+                    if (
+                        cell.schedule != "-"
+                        and cell.schedule not in KNOWN_SCHEDULES
+                    ):
+                        # a config typo must read as a structured skip,
+                        # not a raw ValueError from deep in a runner
+                        skipped.append(
+                            skipped_result(
+                                cell,
+                                SKIP_UNKNOWN_SCHEDULE,
+                                f"schedule {cell.schedule!r} is not a "
+                                "known token (known: "
+                                f"{', '.join(KNOWN_SCHEDULES)})",
+                            )
+                        )
+                        continue
+                    if (
+                        n_devices is not None
+                        and cell.devices_needed > n_devices
+                    ):
+                        skipped.append(
+                            skipped_result(
+                                cell,
+                                SKIP_DEVICES,
+                                f"needs {cell.devices_needed} devices, "
+                                f"have {n_devices}",
+                            )
+                        )
+                        continue
+                    runnable.append(cell)
+    return runnable, skipped
+
+
+def quick_slice(cells: List[CellSpec], limit: int = 2) -> List[CellSpec]:
+    """The cheap tier-1 slice: single-device cells first (flash/decode
+    compile in seconds on the CPU platform), then whatever else, capped
+    at ``limit`` — the full matrix is the slow-marked soak's job."""
+    ordered = sorted(cells, key=lambda c: (c.devices_needed, c.cell_id))
+    return ordered[: max(0, limit)]
+
+
+# ---------------------------------------------------------------------
+# the default executor (the only jax-touching corner; imports lazy)
+# ---------------------------------------------------------------------
+
+
+def _time_op(fn, args, iters: int, timer: Callable[[], float]) -> float:
+    """Min-of-iters seconds for one compiled op (first call pays the
+    compile and is discarded)."""
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    best = math.inf
+    for _ in range(max(1, iters)):
+        start = timer()
+        jax.block_until_ready(fn(*args))
+        best = min(best, timer() - start)
+    return max(best, 1e-9)
+
+
+def _cell_mesh(cell: CellSpec):
+    import jax
+
+    from activemonitor_tpu.parallel.mesh import make_mesh
+
+    need = cell.devices_needed
+    devices = jax.devices()
+    if need > len(devices):
+        raise _CellSkip(
+            SKIP_DEVICES, f"needs {need} devices, have {len(devices)}"
+        )
+    return make_mesh(
+        tuple(axis for axis, _size in cell.mesh),
+        tuple(size for _axis, size in cell.mesh),
+        devices=devices[:need],
+    )
+
+
+class _CellSkip(Exception):
+    def __init__(self, code: str, detail: str):
+        super().__init__(f"{code}: {detail}")
+        self.code = code
+        self.detail = detail
+
+
+def _resolve_schedule(
+    cell: CellSpec,
+    axis_n: int,
+    payload_bytes: int,
+    dtype,
+    collective: str = "allreduce",
+):
+    """The collective schedule the cell rides: an explicit token
+    passes through; ``auto`` consults the op's OWN autotune decision
+    table (``collective`` names it — an all-gather op must not stamp
+    an allreduce-table token) and falls back to the XLA builtin when
+    nothing is tuned for this (axis size, payload octave, dtype)."""
+    if cell.schedule not in ("auto", "-"):
+        return cell.schedule
+    if cell.schedule == "-":
+        return ""
+    from activemonitor_tpu.parallel import autotune
+
+    return autotune.lookup(collective, axis_n, payload_bytes, dtype) or "xla"
+
+
+def _run_flash(cell: CellSpec, iters: int, timer) -> CellResult:
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.ops.flash_attention import flash_attention
+
+    dt = jnp.dtype(cell.dtype)
+    b, s, h, d = 1, 128, 2, 64
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), dt) for kk in keys)
+    fn = jax.jit(
+        lambda a, bb, c: flash_attention(
+            a, bb, c, causal=True, block_q=64, block_k=64
+        )
+    )
+    seconds = _time_op(fn, (q, k, v), iters, timer)
+    flops = 4.0 * b * h * s * s * d * 0.5  # causal halves the score work
+    hbm = 4.0 * b * s * h * d * dt.itemsize
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm,
+    )
+
+
+def _run_ring(cell: CellSpec, iters: int, timer) -> CellResult:
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.ops.ring_attention import ring_attention
+
+    mesh = _cell_mesh(cell)
+    n = dict(cell.mesh)["sp"]
+    dt = jnp.dtype(cell.dtype)
+    b, s, h, d = 1, 16 * n, 2, 16
+    keys = jax.random.split(jax.random.key(1), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), dt) for kk in keys)
+    fn = jax.jit(
+        lambda a, bb, c: ring_attention(
+            a, bb, c, mesh, "sp", causal=True, variant="overlap"
+        )
+    )
+    seconds = _time_op(fn, (q, k, v), iters, timer)
+    flops = 4.0 * b * h * s * s * d * 0.5
+    hbm = 4.0 * b * s * h * d * dt.itemsize
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm,
+    )
+
+
+def _run_moe(cell: CellSpec, iters: int, timer) -> CellResult:
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.ops.moe import init_moe_params, moe_ffn_expert_parallel
+
+    mesh = _cell_mesh(cell)
+    n = dict(cell.mesh)["ep"]
+    dt = jnp.dtype(cell.dtype)
+    d_model, d_ff, tokens = 32, 64, 8 * n
+    params = init_moe_params(jax.random.key(2), d_model, d_ff, n_experts=n)
+    x = jax.random.normal(jax.random.key(3), (tokens, d_model), dt)
+    fn = jax.jit(lambda p, xx: moe_ffn_expert_parallel(p, xx, mesh, axis="ep"))
+    seconds = _time_op(fn, (params, x), iters, timer)
+    payload = tokens * d_model * dt.itemsize
+    flops = 4.0 * tokens * d_model * d_ff + 2.0 * tokens * d_model * n
+    hbm = (
+        float(sum(leaf.size for leaf in jax.tree.leaves(params))) * 4
+        + 2.0 * tokens * d_model * dt.itemsize
+    )
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm,
+        # the token gather is autotune.all_gather("auto") inside the
+        # op: stamp the ALLGATHER table's decision, the one that ran
+        schedule=_resolve_schedule(cell, n, payload, dt, "allgather"),
+    )
+
+
+def _run_pipeline(cell: CellSpec, iters: int, timer) -> CellResult:
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import ProbeModelConfig, init_params
+    from activemonitor_tpu.ops.pipeline import (
+        pipeline_forward_blocks,
+        stack_layer_params,
+    )
+
+    mesh = _cell_mesh(cell)
+    n = dict(cell.mesh)["pp"]
+    dt = jnp.dtype(cell.dtype)
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=n, d_ff=64,
+        max_seq_len=32, dtype=dt,
+    )
+    stacked = stack_layer_params(init_params(jax.random.key(4), cfg)["layers"])
+    b, s = n, 16
+    x = jax.random.normal(jax.random.key(5), (b, s, cfg.d_model), dt)
+    schedule = _resolve_schedule(
+        cell, n, b * s * cfg.d_model * dt.itemsize, dt
+    )
+    fn = jax.jit(
+        lambda layers, xx: pipeline_forward_blocks(
+            layers, xx, cfg, mesh, axis="pp",
+            allreduce_schedule=schedule or "auto",
+        )
+    )
+    seconds = _time_op(fn, (stacked, x), iters, timer)
+    flops = 32.0 * cfg.n_layers * b * s * cfg.d_model * cfg.d_model
+    hbm = (
+        float(sum(leaf.size for leaf in jax.tree.leaves(stacked))) * 4
+        + 2.0 * b * s * cfg.d_model * dt.itemsize
+    )
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm, schedule=schedule,
+    )
+
+
+def _run_decode(cell: CellSpec, iters: int, timer) -> CellResult:
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        decode_step,
+        init_kv_cache,
+        init_params,
+    )
+
+    dt = jnp.dtype(cell.dtype)
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq_len=16, dtype=dt,
+    )
+    params = init_params(jax.random.key(6), cfg)
+    batch, steps = 2, 4
+    tokens = jax.random.randint(
+        jax.random.key(7), (batch, steps), 0, cfg.vocab_size
+    )
+
+    def run(p, toks):
+        cache = init_kv_cache(cfg, batch, 8)
+        logits = None
+        for pos in range(steps):
+            logits, cache = decode_step(
+                p, cache, toks[:, pos], jnp.int32(pos), cfg, use_flash=True
+            )
+        return logits
+
+    fn = jax.jit(run)
+    seconds = _time_op(fn, (params, tokens), iters, timer)
+    n_params = float(sum(leaf.size for leaf in jax.tree.leaves(params)))
+    flops = 2.0 * n_params * batch * steps
+    hbm = n_params * 4 * steps
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm,
+    )
+
+
+def _run_training_step(cell: CellSpec, iters: int, timer) -> CellResult:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import tiny_config
+    from activemonitor_tpu.probes.training_step import (
+        build_sharded_train_step,
+        grad_sync_plan,
+        resolve_grad_sync,
+    )
+
+    mesh = _cell_mesh(cell)
+    dt = jnp.dtype(cell.dtype)
+    cfg = dataclasses.replace(tiny_config(), dtype=dt)
+    requested = cell.schedule if cell.schedule != "-" else "auto"
+    # stamp what actually RAN: the explicit tuned sync only engages on
+    # a data-only mesh (resolve_grad_sync gates everything else back to
+    # the XLA-inserted reduction) — reporting the tuned token on a mesh
+    # where it never dispatched would misstate the evidence
+    sync_mode, sync_reason = resolve_grad_sync(mesh, "dense", requested)
+    if sync_mode == "explicit":
+        plan = grad_sync_plan(cfg, mesh)
+        schedule = (
+            plan["schedule"]
+            if requested == "auto"
+            else _resolve_schedule(
+                cell, plan["axis_n"], plan["largest_leaf_bytes"], dt
+            )
+        )
+        details = {"grad_sync": {"mode": sync_mode, "axis_n": plan["axis_n"]}}
+    else:
+        schedule = "xla(implicit)"
+        details = {"grad_sync": {"mode": sync_mode, "reason": sync_reason}}
+    step, params, opt, data_sh = build_sharded_train_step(
+        cfg, mesh, grad_sync=requested
+    )
+    batch = 2 * dict(cell.mesh)["data"]
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(8), (batch, 17), 0, cfg.vocab_size),
+        data_sh,
+    )
+    # the step donates params/opt: thread the new state through each
+    # timed iteration instead of re-passing deleted buffers
+    params, opt, loss = step(params, opt, tokens)
+    jax.block_until_ready(loss)
+    seconds = math.inf
+    for _ in range(max(1, iters)):
+        start = timer()
+        params, opt, loss = step(params, opt, tokens)
+        jax.block_until_ready(loss)
+        seconds = min(seconds, timer() - start)
+    seconds = max(seconds, 1e-9)
+    n_params = float(sum(leaf.size for leaf in jax.tree.leaves(params)))
+    flops = 6.0 * n_params * batch * 16
+    hbm = 3.0 * n_params * 4
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm, schedule=schedule,
+        details=details,
+    )
+
+
+_RUNNERS: Dict[str, Callable] = {
+    "flash": _run_flash,
+    "ring": _run_ring,
+    "moe": _run_moe,
+    "pipeline": _run_pipeline,
+    "decode": _run_decode,
+    "training-step": _run_training_step,
+}
+
+
+def execute_cell(
+    cell: CellSpec,
+    *,
+    iters: int = 2,
+    timer: Callable[[], float] = time.monotonic,
+) -> CellResult:
+    """Run one cell with the real ops. Never raises: a runner bug is a
+    visible ``error`` cell in the matrix, a device deficit a structured
+    ``skipped`` one. The timer is injectable (PhaseTimings idiom) so
+    the module keeps the analysis/ no-wall-clock-call contract."""
+    runner = _RUNNERS.get(cell.op)
+    if runner is None:
+        return skipped_result(
+            cell, SKIP_UNKNOWN_OP, f"no runner for op {cell.op!r}"
+        )
+    try:
+        return runner(cell, iters, timer)
+    except _CellSkip as skip:
+        return skipped_result(cell, skip.code, skip.detail)
+    except Exception as exc:  # a cell bug must not sink the matrix
+        log.exception("matrix cell %s failed", cell.cell_id)
+        return CellResult(cell, STATUS_ERROR, reason=repr(exc)[:200])
+
+
+def make_executor(
+    *, iters: int = 2, timer: Callable[[], float] = time.monotonic
+) -> Callable[[CellSpec], CellResult]:
+    """The executor the observatory re-runs bisects through."""
+    return lambda cell: execute_cell(cell, iters=iters, timer=timer)
+
+
+# ---------------------------------------------------------------------
+# the observatory: baselines + hysteresis + roofline + bisect + bundle
+# ---------------------------------------------------------------------
+
+
+class MatrixObservatory:
+    """Per-(cell, metric) rolling baselines, hysteresis verdicts, and
+    the regression loop, persisted to the durable sidecar.
+
+    Evidence sinks are wired post-construction like the flight
+    recorder's sources: ``metrics`` (MetricsCollector — the pinned
+    ``healthcheck_matrix_*`` families) and ``flightrec``
+    (FlightRecorder — one ``matrix-regression`` bundle per confirmed
+    regression). Either may stay None.
+    """
+
+    def __init__(
+        self,
+        *,
+        clock: Optional[Clock] = None,
+        path: str = "",
+        warmup_runs: int = 3,
+        confirm_runs: int = 2,
+        calm_runs: int = 3,
+        config: Optional[DetectorConfig] = None,
+        rated_spec=None,
+        metrics=None,
+        flightrec=None,
+    ):
+        self.clock = clock or Clock()
+        self.path = path
+        self.warmup_runs = max(1, warmup_runs)
+        self.confirm_runs = max(1, confirm_runs)
+        self.calm_runs = max(1, calm_runs)
+        self.config = config or DetectorConfig()
+        # the rated roofline the cells' analytic cost models are judged
+        # against (probes/rated.RatedSpec). None — unknown silicon /
+        # interpret mode — stamps a structured skip instead of a
+        # verdict: model numbers are never compared against a TPU bar.
+        self.rated_spec = rated_spec
+        self.metrics = metrics
+        self.flightrec = flightrec
+        self.detectors = default_detectors()
+        self.baselines = CheckBaselines(self.clock, self.warmup_runs)
+        self.hysteresis: Dict[str, Hysteresis] = {}
+        self.last_round: Optional[dict] = None
+        self.restore_warning: Optional[dict] = None
+        if path:
+            self._restore(path)
+
+    # -- persistence (analysis/baseline.py blob helpers) ----------------
+    def _restore(self, path: str) -> None:
+        doc, warning = baseline_store.load_blob(path)
+        if warning is not None:
+            # defensive restore: fresh baselines + a structured warning
+            # that rides every subsequent round summary (the
+            # .status.analysis discipline applied to the sidecar)
+            self.restore_warning = warning
+            log.warning(
+                "matrix sidecar %s restored fresh: %s (%s)",
+                path,
+                warning.get("reason"),
+                warning.get("detail"),
+            )
+            return
+        if doc is None:
+            return  # first round: nothing durable yet
+        self.baselines = CheckBaselines.from_dict(
+            doc.get("baselines") or {}, self.clock, self.warmup_runs
+        )
+        hysteresis = doc.get("hysteresis")
+        if isinstance(hysteresis, dict):
+            for key, entry in hysteresis.items():
+                if isinstance(key, str) and isinstance(entry, dict):
+                    self.hysteresis[key] = Hysteresis.from_dict(
+                        entry, self.confirm_runs, self.calm_runs,
+                        jump_to_raw=True,
+                    )
+        last_round = doc.get("last_round")
+        if isinstance(last_round, dict):
+            self.last_round = last_round
+
+    def save(self) -> Optional[dict]:
+        if not self.path:
+            return None
+        return baseline_store.save_blob(
+            self.path,
+            {
+                "updated_at": self.clock.now().isoformat(),
+                "baselines": self.baselines.to_dict(),
+                "hysteresis": {
+                    key: state.to_dict()
+                    for key, state in self.hysteresis.items()
+                },
+                "last_round": self.last_round,
+            },
+        )
+
+    def snapshot(self) -> Optional[dict]:
+        """The /statusz ``matrix`` block: the latest observed round."""
+        return self.last_round
+
+    # -- the round loop --------------------------------------------------
+    def observe_round(
+        self,
+        results: List[CellResult],
+        *,
+        executor: Optional[Callable[[CellSpec], CellResult]] = None,
+        interpret_mode: bool = False,
+        fallback_reason: str = "",
+    ) -> dict:
+        """Fold one round of cell results through the evidence stack
+        and return the round summary (the bench ``matrix_summary``
+        block, the /statusz ``matrix`` block, and the sidecar's
+        ``last_round`` are all this one dict)."""
+        cells: Dict[str, dict] = {}
+        counts = {STATUS_OK: 0, STATUS_SKIPPED: 0, STATUS_ERROR: 0}
+        regressions: List[dict] = []
+        bisects: List[dict] = []
+        prior_cells = (self.last_round or {}).get("cells") or {}
+        for result in results:
+            cell_id = result.cell.cell_id
+            if cell_id in cells:
+                # defensive vs colliding scripted results: one cell id,
+                # one row, one count — the counts header and the table
+                # must never disagree
+                continue
+            entry = self._cell_entry(result, interpret_mode, fallback_reason)
+            counts[result.status] = counts.get(result.status, 0) + 1
+            cells[cell_id] = entry
+            if result.status != STATUS_OK:
+                continue
+            transitions = self._evaluate(cell_id, entry, result, interpret_mode)
+            cell_fired = False
+            cell_bisect: Optional[dict] = None
+            for metric, old, new in transitions:
+                entry.setdefault("transitions", []).append([metric, old, new])
+                if new != level_name(LEVEL_DEGRADED):
+                    continue
+                # confirmed regression: name the moved ceiling, bisect
+                # exactly ONCE PER CELL per round (a real slowdown moves
+                # seconds and the roofline fraction in tandem — both
+                # metrics confirming together is one regression, not
+                # two re-runs and two bundles), and ship the postmortem
+                # bundle carrying BOTH artifacts' evidence
+                prior = prior_cells.get(cell_id)
+                roofline = entry.get("roofline") or {}
+                regression = {
+                    "cell": cell_id,
+                    "metric": metric,
+                    "transition": [old, new],
+                    "ceiling": roofline.get("bound"),
+                    "cost_source": roofline.get("cost_source"),
+                }
+                if not cell_fired:
+                    cell_fired = True
+                    cell_bisect = self._bisect(
+                        result, prior, executor, interpret_mode
+                    )
+                    if cell_bisect is not None:
+                        bisects.append(cell_bisect)
+                    if self.flightrec is not None:
+                        from activemonitor_tpu.obs.flightrec import KIND_MATRIX
+
+                        self.flightrec.record(
+                            KIND_MATRIX,
+                            f"matrix/{cell_id}",
+                            cell=dict(entry),
+                            prior_cell=prior,
+                            bisect=cell_bisect,
+                            regression=dict(regression),
+                        )
+                if cell_bisect is not None:
+                    regression["bisect_outcome"] = cell_bisect["outcome"]
+                regressions.append(regression)
+        summary: dict = {
+            "matrix_version": MATRIX_VERSION,
+            "generated_at": self.clock.now().isoformat(),
+            "interpret_mode": interpret_mode,
+            "fallback_reason": fallback_reason,
+            "cells": cells,
+            "counts": counts,
+            "regressions": regressions,
+            "bisects": bisects,
+        }
+        if self.restore_warning is not None:
+            summary["restore_warning"] = dict(self.restore_warning)
+        self.last_round = summary
+        persist_error = self.save()
+        if persist_error is not None:
+            summary["persist_error"] = persist_error
+        if self.metrics is not None:
+            try:
+                self.metrics.record_matrix_round(summary)
+            except Exception:
+                log.exception("matrix metrics export failed")
+        return summary
+
+    # -- internals -------------------------------------------------------
+    def _cell_entry(
+        self, result: CellResult, interpret_mode: bool, fallback_reason: str
+    ) -> dict:
+        cell = result.cell
+        entry: dict = {
+            "op": cell.op,
+            "mesh": {axis: size for axis, size in cell.mesh},
+            "dtype": cell.dtype,
+            "schedule_requested": cell.schedule,
+            "schedule": result.schedule,
+            "status": result.status,
+            "metric": result.metric,
+            "unit": result.unit,
+            # interpret-mode/fallback labeling rides EVERY cell (the
+            # r02–r05 lesson: degraded rounds must carry their cause in
+            # the evidence itself, not in lost stderr scrollback)
+            "interpret_mode": interpret_mode,
+        }
+        if fallback_reason:
+            entry["fallback_reason"] = fallback_reason
+        if result.status != STATUS_OK:
+            entry["reason"] = result.reason
+            return entry
+        entry["value"] = result.value
+        entry["roofline"] = self._roofline_entry(result)
+        return entry
+
+    def _roofline_entry(self, result: CellResult) -> dict:
+        """The cell's roofline stamp (obs/roofline.py): an analytic
+        cost-model verdict against the configured rated spec, or a
+        structured skip — never a silent omission."""
+        from activemonitor_tpu.obs import roofline as roofline_model
+
+        if self.rated_spec is None:
+            return {"skipped": "no rated roofline (interpret mode / unknown silicon)"}
+        if result.flops <= 0 or result.bytes_accessed <= 0 or result.seconds <= 0:
+            return {
+                "skipped": (
+                    f"degenerate cost model (flops={result.flops}, "
+                    f"bytes={result.bytes_accessed}, seconds={result.seconds})"
+                )
+            }
+        verdict = roofline_model.classify(
+            flops=result.flops,
+            hbm_bytes=result.bytes_accessed,
+            seconds=result.seconds,
+            spec=self.rated_spec,
+            cost_source=roofline_model.COST_SOURCE_MODEL,
+        )
+        if verdict is None:
+            return {"skipped": "classification rejected the cost model"}
+        return verdict.to_dict()
+
+    @staticmethod
+    def _metric_key(cell_id: str, metric: str, interpret_mode: bool) -> str:
+        """Baselines and hysteresis are PER PLATFORM MODE: a
+        CPU-fallback round judged against TPU-learned seconds (or vice
+        versa) would confirm-degrade every cell with platform noise —
+        the r02–r05 wedge scenario again, this time self-inflicted.
+        Interpret rounds compare only against prior interpret rounds
+        (the `_prior_cpu_mesh_value` discipline bench.py already
+        applies to its headline metric)."""
+        mode = "cpu" if interpret_mode else "tpu"
+        return f"{mode}:{cell_id}|{metric}"
+
+    def _samples(
+        self, entry: dict, result: CellResult, interpret_mode: bool
+    ) -> Dict[str, float]:
+        samples: Dict[str, float] = {}
+        value = finite(result.value)
+        if value is not None:
+            samples[result.metric] = value
+        roofline = entry.get("roofline") or {}
+        fraction = finite(roofline.get("fraction"))
+        if fraction is not None and not interpret_mode:
+            # named so the rated-floor detector recognizes it as an
+            # absolute health fraction (judged from round one). Gated
+            # off in interpret mode: a model-sourced fraction on the
+            # CPU mesh is evidence (it rides the stamp and the gauges,
+            # labeled) but must never be COMPARED against a TPU bar —
+            # the headline metric still gets the baseline-relative
+            # zscore/trend detectors either way.
+            samples["roofline-fraction"] = fraction
+        return samples
+
+    def _evaluate(
+        self, cell_id: str, entry: dict, result: CellResult,
+        interpret_mode: bool,
+    ) -> List[Tuple[str, str, str]]:
+        """One cell's detector chain + hysteresis, the engine's
+        discipline: warm-up always feeds the baseline, post-warm-up
+        anomalous samples are quarantined from it, the reported verdict
+        is the worst metric's hysteresis state."""
+        transitions: List[Tuple[str, str, str]] = []
+        worst = LEVEL_OK
+        for metric, value in self._samples(entry, result, interpret_mode).items():
+            key = self._metric_key(cell_id, metric, interpret_mode)
+            baseline = self.baselines.baseline(key)
+            warmed = self.baselines.warmed(key)
+            levels = []
+            for detector in self.detectors:
+                if detector.needs_baseline and not warmed:
+                    continue
+                levels.append(
+                    detector.evaluate(metric, value, baseline, self.config)
+                )
+            raw_level = combine_raw_levels(levels)
+            if metric == result.metric and warmed and baseline.median > 0:
+                entry["vs_baseline"] = round(value / baseline.median, 4)
+            state = self.hysteresis.get(key)
+            if state is None:
+                state = self.hysteresis[key] = Hysteresis(
+                    self.confirm_runs, self.calm_runs, jump_to_raw=True
+                )
+            moved = state.update(raw_level)
+            if moved is not None:
+                transitions.append(
+                    (metric, level_name(moved[0]), level_name(moved[1]))
+                )
+            if not warmed or raw_level == LEVEL_OK:
+                self.baselines.observe(key, value)
+            worst = max(worst, state.level)
+        entry["verdict"] = level_name(worst)
+        return transitions
+
+    def _raw_level(
+        self, cell_id: str, entry: dict, result: CellResult,
+        interpret_mode: bool,
+    ) -> int:
+        """The detector chain's opinion of one measurement WITHOUT
+        feeding baselines or hysteresis — how a bisect re-run is
+        judged."""
+        worst = LEVEL_OK
+        for metric, value in self._samples(entry, result, interpret_mode).items():
+            key = self._metric_key(cell_id, metric, interpret_mode)
+            baseline = self.baselines.peek(key)
+            warmed = self.baselines.warmed(key)
+            levels = []
+            for detector in self.detectors:
+                if detector.needs_baseline and not warmed:
+                    continue
+                levels.append(
+                    detector.evaluate(metric, value, baseline, self.config)
+                )
+            worst = max(worst, combine_raw_levels(levels))
+        return worst
+
+    def _bisect(
+        self,
+        result: CellResult,
+        prior: Optional[dict],
+        executor: Optional[Callable[[CellSpec], CellResult]],
+        interpret_mode: bool,
+    ) -> Optional[dict]:
+        """Exactly one re-run of the regressing cell, judged against
+        the live baseline and compared with the prior artifact's value.
+        None when no executor is wired (a read-only observer — e.g. a
+        controller replaying the sidecar — cannot re-run cells)."""
+        if executor is None:
+            return None
+        cell_id = result.cell.cell_id
+        prior = prior or {}
+        record: dict = {
+            "cell": cell_id,
+            "metric": result.metric,
+            "round_value": result.value,
+            # comparable only within one platform mode: a TPU round's
+            # seconds are not the baseline for a CPU-fallback re-run
+            "prior_value": (
+                prior.get("value")
+                if prior.get("interpret_mode") == interpret_mode
+                else None
+            ),
+        }
+        try:
+            rerun = executor(result.cell)
+        except Exception as exc:  # executor bug: a visible error record
+            record.update(outcome=BISECT_ERROR, reason=repr(exc)[:200])
+            return record
+        if rerun.status != STATUS_OK:
+            record.update(outcome=BISECT_ERROR, reason=rerun.reason)
+            return record
+        record["rerun_value"] = rerun.value
+        entry = {"roofline": self._roofline_entry(rerun)}
+        raw = self._raw_level(cell_id, entry, rerun, interpret_mode)
+        record["outcome"] = (
+            BISECT_REPRODUCED if raw > LEVEL_OK else BISECT_RECOVERED
+        )
+        return record
+
+
+class SidecarView:
+    """Read-only /statusz source over the durable sidecar — the
+    controller (``am-tpu run --matrix-state``) serves the matrix block
+    without having run the round. Defensive like every restore path:
+    a corrupt or version-skewed sidecar is a structured warning block,
+    never a crash in the statusz handler. The parsed snapshot is
+    cached on (mtime, size): the blob carries every cell's rolling
+    baseline ring and changes at most once per bench round, so only
+    the first read after a round pays the parse."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._stamp: Optional[Tuple[float, int]] = None
+        self._cached: Optional[dict] = None
+
+    def snapshot(self) -> Optional[dict]:
+        import os
+
+        try:
+            stat = os.stat(self.path)
+            stamp: Optional[Tuple[float, int]] = (stat.st_mtime, stat.st_size)
+        except OSError:
+            stamp = None
+        if stamp is not None and stamp == self._stamp:
+            return self._cached
+        doc, warning = baseline_store.load_blob(self.path)
+        if warning is not None:
+            snapshot: Optional[dict] = {
+                "matrix_version": MATRIX_VERSION,
+                "cells": {},
+                "counts": {},
+                "regressions": [],
+                "bisects": [],
+                "restore_warning": warning,
+            }
+        elif doc is None:
+            snapshot = None
+        else:
+            last_round = doc.get("last_round")
+            snapshot = last_round if isinstance(last_round, dict) else None
+        self._stamp = stamp
+        self._cached = snapshot
+        return snapshot

@@ -499,6 +499,10 @@ class Manager:
                 # cadence — it walks every check's result ring, which
                 # is rollup work, not reconcile-path work
                 self.reconciler.fleet.refresh_fleet_goodput()
+                # scenario-matrix gauges (--matrix-state): export the
+                # sidecar's latest round into the healthcheck_matrix_*
+                # families, once per new round
+                self.reconciler.fleet.refresh_matrix_metrics()
                 if self._shards is not None:
                     # per-shard ownership counts for /statusz and the
                     # healthcheck_shard_checks gauge (rollup work too)

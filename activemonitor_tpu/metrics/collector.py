@@ -499,6 +499,58 @@ class MetricsCollector:
         # crossing the ridge (shape change, new compiler) must move its
         # fraction series to the new bound, not leave both populated
         self._roofline_bounds: Dict[tuple, str] = {}
+        # -- scenario-matrix families (analysis/matrix.py is the single
+        # writer; docs/observability.md "Reading the matrix"). One
+        # bounded series set per declared cell: the matrix spec is
+        # config, so cardinality is the config's cell count, not the
+        # fleet's.
+        self.matrix_cell_value = Gauge(
+            "healthcheck_matrix_cell_value",
+            "A scenario-matrix cell's headline measurement (metric "
+            "label names it; seconds for compute cells) from the last "
+            "observed round",
+            ["cell", "metric"],
+            registry=self.registry,
+        )
+        self.matrix_cell_state = Gauge(
+            "healthcheck_matrix_cell_state",
+            "One-hot hysteresis verdict per matrix cell (ok/warning/"
+            "degraded — the REPORTED state, which a lone noisy round "
+            "never moves)",
+            ["cell", "state"],
+            registry=self.registry,
+        )
+        self.matrix_cell_roofline_fraction = Gauge(
+            "healthcheck_matrix_cell_roofline_fraction",
+            "The cell's achieved fraction of its own roofline ceiling, "
+            "with the bound (compute/memory/comm) as a label — the "
+            "ceiling a confirmed regression names",
+            ["cell", "bound"],
+            registry=self.registry,
+        )
+        self.matrix_cells = Gauge(
+            "healthcheck_matrix_cells",
+            "Scenario-matrix cells per round status (ok/skipped/error): "
+            "skipped cells carry structured reasons in the round "
+            "summary, never silent holes",
+            ["status"],
+            registry=self.registry,
+        )
+        self.matrix_bisect_runs = Counter(
+            "healthcheck_matrix_bisect_runs_total",
+            "Auto-bisect re-runs fired by confirmed matrix-cell "
+            "regressions, by outcome (reproduced/recovered/error)",
+            ["cell", "outcome"],
+            registry=self.registry,
+        )
+        # cells whose state series have materialized, each cell's last
+        # exported roofline bound, and every (cell, metric) value
+        # series exported last round — same stale-series hygiene as
+        # check_state / _roofline_bounds: a cell removed or renamed in
+        # the spec must drop its series, not alert forever
+        self._matrix_state_series: set = set()
+        self._matrix_cell_bounds: Dict[str, str] = {}
+        self._matrix_value_series: set = set()
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -762,6 +814,98 @@ class MetricsCollector:
                     self.anomaly_state.remove(hc_name, namespace, state)
                 except KeyError:
                     pass  # never recorded
+
+    # -- scenario-matrix families (analysis/matrix.py round summary) ---
+    def record_matrix_round(self, summary: dict) -> None:
+        """Export one matrix round summary into the pinned
+        ``healthcheck_matrix_*`` families. Defensive over the summary
+        shape (it also rides bench artifacts and the sidecar, so a
+        version-skewed blob must degrade to partial gauges, not raise
+        into the observatory)."""
+        from activemonitor_tpu.analysis.detector import ANOMALY_STATES
+
+        if not isinstance(summary, dict):
+            return
+        cells = summary.get("cells")
+        cells = cells if isinstance(cells, dict) else {}
+        counts = {"ok": 0, "skipped": 0, "error": 0}
+        live_values: set = set()
+        live_states: set = set()
+        live_bounds: set = set()
+        for cell_id, entry in cells.items():
+            if not isinstance(entry, dict):
+                continue
+            cell = _sanitize(str(cell_id))
+            status = str(entry.get("status", "error"))
+            counts[status if status in counts else "error"] += 1
+            value = entry.get("value")
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                metric = _sanitize(str(entry.get("metric") or "value"))
+                live_values.add((cell, metric))
+                self.matrix_cell_value.labels(cell, metric).set(float(value))
+            verdict = entry.get("verdict")
+            if verdict in ANOMALY_STATES:
+                live_states.add(cell)
+                # one-hot like check_state, lazily materialized: a cell
+                # that has never left ok carries no state series
+                if verdict != "ok" or cell in self._matrix_state_series:
+                    self._matrix_state_series.add(cell)
+                    for state in ANOMALY_STATES:
+                        self.matrix_cell_state.labels(cell, state).set(
+                            1.0 if state == verdict else 0.0
+                        )
+            roofline = entry.get("roofline")
+            if isinstance(roofline, dict):
+                bound = roofline.get("bound")
+                fraction = roofline.get("fraction")
+                if isinstance(bound, str) and isinstance(fraction, (int, float)):
+                    live_bounds.add(cell)
+                    previous = self._matrix_cell_bounds.get(cell)
+                    if previous is not None and previous != bound:
+                        try:
+                            self.matrix_cell_roofline_fraction.remove(
+                                cell, previous
+                            )
+                        except KeyError:
+                            pass  # never exported under the old bound
+                    self._matrix_cell_bounds[cell] = bound
+                    self.matrix_cell_roofline_fraction.labels(cell, bound).set(
+                        float(fraction)
+                    )
+        # stale-series hygiene, judged PER SERIES KIND on this round's
+        # fresh evidence: a cell renamed away, or one that flipped to
+        # skipped/error (no verdict, no roofline this round — e.g. the
+        # TPU wedged to a smaller fallback platform), must drop its
+        # series with this round, not alert on stale evidence forever
+        for cell, metric in self._matrix_value_series - live_values:
+            try:
+                self.matrix_cell_value.remove(cell, metric)
+            except KeyError:
+                pass  # already gone
+        self._matrix_value_series = live_values
+        for cell in list(self._matrix_state_series - live_states):
+            self._matrix_state_series.discard(cell)
+            for state in ANOMALY_STATES:
+                try:
+                    self.matrix_cell_state.remove(cell, state)
+                except KeyError:
+                    pass  # never recorded
+        for cell in list(self._matrix_cell_bounds):
+            if cell in live_bounds:
+                continue
+            bound = self._matrix_cell_bounds.pop(cell)
+            try:
+                self.matrix_cell_roofline_fraction.remove(cell, bound)
+            except KeyError:
+                pass  # never recorded
+        for status, count in counts.items():
+            self.matrix_cells.labels(status).set(count)
+        for bisect in summary.get("bisects") or []:
+            if isinstance(bisect, dict):
+                self.matrix_bisect_runs.labels(
+                    _sanitize(str(bisect.get("cell", "?"))),
+                    str(bisect.get("outcome", "error")),
+                ).inc()
 
     # -- dynamic custom metrics ---------------------------------------
     # recorded-run memory bound: at one run a second this is ~34 min of

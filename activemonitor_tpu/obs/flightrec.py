@@ -50,7 +50,12 @@ KIND_DEGRADED = "degraded-transition"
 KIND_BREAKER = "breaker-open"
 KIND_QUARANTINE = "quarantine"
 KIND_HANDOFF = "shard-handoff"
-KINDS = (KIND_DEGRADED, KIND_BREAKER, KIND_QUARANTINE, KIND_HANDOFF)
+# a scenario-matrix cell's hysteresis verdict confirmed degraded
+# (analysis/matrix.py): the bundle's extra carries both artifacts'
+# evidence (the regressing round's cell entry, the prior round's, and
+# the auto-bisect verdict)
+KIND_MATRIX = "matrix-regression"
+KINDS = (KIND_DEGRADED, KIND_BREAKER, KIND_QUARANTINE, KIND_HANDOFF, KIND_MATRIX)
 
 DEFAULT_CAPACITY = 256  # bundles retained in memory
 SPAN_TAIL = 20  # fallback span excerpt when no trace is active

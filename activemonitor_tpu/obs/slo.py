@@ -215,6 +215,15 @@ class FleetStatus:
         # goodput attribution reads at record time. None = no span
         # evidence (standalone), classification still works.
         self.tracer = None
+        # wired by the manager (--matrix-state): anything with a
+        # ``snapshot()`` returning the scenario matrix's latest round
+        # summary (analysis/matrix.py MatrixObservatory or its durable
+        # SidecarView). None (no matrix configured) reports matrix: null.
+        self.matrix = None
+        # generated_at of the last round exported to the gauges, so the
+        # rollup loop re-serving an unchanged sidecar never
+        # double-counts the bisect counter
+        self._matrix_exported = ""
         # the last fleet attribution rollup (refresh_fleet_goodput), so
         # /statusz serves a block computed over the same windowed runs
         # as the goodput ratio it rides next to
@@ -541,9 +550,47 @@ class FleetStatus:
                 # replica's owned shards and their check counts — the
                 # per-shard section rollup_statusz() merges fleet-wide
                 "sharding": sharding,
+                # scenario-matrix round summary (analysis/matrix.py):
+                # per-cell verdicts/rooflines from the latest observed
+                # round; null until a matrix source is wired
+                # (--matrix-state) and a round has been recorded
+                "matrix": self.check_matrix(),
             },
             "checks": entries,
         }
+
+    def check_matrix(self) -> Optional[dict]:
+        """The matrix source's latest round summary, or None (no source
+        wired / no round recorded / a source error — observability must
+        not fail the payload that carries it)."""
+        if self.matrix is None:
+            return None
+        try:
+            return self.matrix.snapshot()
+        except Exception:
+            log.exception("matrix snapshot failed")
+            return None
+
+    def refresh_matrix_metrics(self) -> None:
+        """Export the matrix source's latest round into the pinned
+        ``healthcheck_matrix_*`` families — at most once per round
+        (keyed on the round's ``generated_at``, so the bisect counter
+        never double-counts a re-served sidecar). Called from the
+        manager's rollup loop; a controller without ``--matrix-state``
+        is a no-op."""
+        if self.matrix is None or self.metrics is None:
+            return
+        snapshot = self.check_matrix()
+        if not snapshot:
+            return
+        stamp = str(snapshot.get("generated_at") or "")
+        if stamp and stamp == self._matrix_exported:
+            return
+        self._matrix_exported = stamp
+        try:
+            self.metrics.record_matrix_round(snapshot)
+        except Exception:
+            log.exception("matrix metrics export failed")
 
 
 def aggregate_entries(entries) -> dict:
@@ -607,6 +654,10 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     # breaker the renderer doesn't recognize)
     breaker_rank = {"closed": 0, "half-open": 1, "open": 2}
     remedy_tokens = None
+    # the scenario-matrix block is whole-round evidence, not per-check:
+    # the replica reporting the NEWEST round wins (replicas without a
+    # matrix source report null and never displace a real round)
+    matrix_block = None
     # fleet goodput: the run-weighted mean of the REPLICAS' own ratios,
     # each derived from its history + declared SLO windows — the same
     # definition a single /statusz reports, so the number doesn't
@@ -652,6 +703,13 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
                 checks_per_shard[str(shard)] = (
                     checks_per_shard.get(str(shard), 0) + int(count)
                 )
+        replica_matrix = fleet.get("matrix")
+        if isinstance(replica_matrix, dict) and (
+            matrix_block is None
+            or str(replica_matrix.get("generated_at") or "")
+            > str(matrix_block.get("generated_at") or "")
+        ):
+            matrix_block = replica_matrix
         for entry in payload.get("checks") or []:
             key = entry.get("key", "")
             if key not in merged:
@@ -694,6 +752,7 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "remedy_tokens": remedy_tokens,
             "anomalies": agg["anomalies"],
             "sharding": sharding_block,
+            "matrix": matrix_block,
         },
         "checks": entries,
     }

@@ -655,6 +655,7 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     _stamp_attribution(doc)
     _stamp_autotune(doc)
     _stamp_roofline(doc, primary_result)
+    _stamp_matrix(doc)
     return doc
 
 
@@ -784,6 +785,88 @@ def _stamp_roofline(doc: dict, result) -> None:
         doc["roofline_summary"] = summary
     except Exception as exc:  # pragma: no cover - defensive
         print(f"roofline stamp failed: {exc!r}", file=sys.stderr)
+
+
+def _stamp_matrix(doc: dict) -> None:
+    """Stamp the declarative scenario matrix's round summary
+    (analysis/matrix.py) into the artifact as ``matrix_summary`` —
+    per-cell values, hysteresis verdicts, roofline stamps, structured
+    skips, and any confirmed regressions with their auto-bisect
+    outcomes. BOTH paths stamp it: CPU-fallback rounds are
+    ``interpret_mode: true`` with the round's ``fallback_reason``
+    carried into every cell (the r02–r05 lesson — a wedged round must
+    never again produce an artifact that silently omits the evidence
+    block). Baselines persist across rounds in the BENCH_BASELINES.json
+    sidecar next to this file (override: ACTIVEMONITOR_BENCH_BASELINES).
+    Guarded: a failing matrix costs this block, not the artifact.
+    ACTIVEMONITOR_BENCH_MATRIX=off disables, =full runs every cell on
+    the CPU path too (default there is the quick 2-cell slice so the
+    graft contract test stays inside the tier-1 budget)."""
+    mode = os.environ.get("ACTIVEMONITOR_BENCH_MATRIX", "")
+    if mode == "off":
+        return  # before any import: =off must skip ALL matrix cost
+    try:
+        import jax
+
+        from activemonitor_tpu.analysis import matrix as matrix_mod
+        from activemonitor_tpu.obs.flightrec import FlightRecorder
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec, spec_warning = matrix_mod.load_spec(
+            os.path.join(here, "config", "bench_matrix.json")
+        )
+        on_tpu = doc.get("platform") == "tpu"
+        cells, skipped = matrix_mod.expand(
+            spec, n_devices=len(jax.devices())
+        )
+        if mode != "full" and not on_tpu:
+            quick = matrix_mod.quick_slice(cells)
+            # cells outside the slice are structured skips, not silent
+            # holes: the artifact says WHY each cell has no measurement
+            skipped.extend(
+                matrix_mod.skipped_result(
+                    cell,
+                    matrix_mod.SKIP_QUICK,
+                    "not in the interpret-mode quick slice "
+                    "(ACTIVEMONITOR_BENCH_MATRIX=full runs every cell)",
+                )
+                for cell in cells
+                if cell not in quick
+            )
+            cells = quick
+        rated = None
+        if on_tpu:
+            from activemonitor_tpu.probes.rated import rated_for
+
+            rated = rated_for(doc.get("device_kind", ""))
+        executor = matrix_mod.make_executor(iters=3 if on_tpu else 2)
+        sidecar = os.environ.get(
+            "ACTIVEMONITOR_BENCH_BASELINES",
+            os.path.join(here, matrix_mod.SIDECAR_BASENAME),
+        )
+        # confirmed regressions ship durable postmortems: one JSONL
+        # bundle per transition, next to the sidecar (flightrec.jsonl)
+        observatory = matrix_mod.MatrixObservatory(
+            path=sidecar,
+            rated_spec=rated,
+            flightrec=FlightRecorder(
+                flight_dir=os.path.dirname(os.path.abspath(sidecar))
+            ),
+        )
+        results = [executor(cell) for cell in cells] + skipped
+        summary = observatory.observe_round(
+            results,
+            executor=executor,
+            interpret_mode=not on_tpu,
+            fallback_reason=(
+                doc.get("fallback_reason", "") if doc.get("fallback") else ""
+            ),
+        )
+        if spec_warning is not None:
+            summary["spec_warning"] = spec_warning
+        doc["matrix_summary"] = summary
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"matrix stamp failed: {exc!r}", file=sys.stderr)
 
 
 def _stamp_attribution(doc: dict) -> None:

@@ -62,7 +62,9 @@ positive that makes `make lint` cry wolf is worse than a miss):
   The finding code carries the unit (`wallclock-in-resilience`,
   `wallclock-in-analysis`, `wallclock-in-sharding`,
   `wallclock-in-attribution`, `wallclock-in-flightrec`,
-  `wallclock-in-roofline`).
+  `wallclock-in-roofline`, `wallclock-in-matrix` — the scenario
+  matrix's verdict machinery runs on the Clock and its executor timer
+  is injectable, wherever a matrix.py lands in the tree).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -157,6 +159,7 @@ class Checker(ast.NodeVisitor):
             "attribution.py",  # goodput windows judged on result timestamps
             "flightrec.py",  # bundle timestamps ride scripted transitions
             "roofline.py",  # pure math over seconds passed in as args
+            "matrix.py",  # verdicts on the Clock; executor timer injectable
         ):
             # single-file modules carrying the same injectable-Clock
             # contract as the resilience/analysis packages

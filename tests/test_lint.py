@@ -893,3 +893,111 @@ def test_roofline_metric_names_are_pinned():
     bench_src = (REPO / "bench.py").read_text()
     for key in ("roofline_summary", "_stamp_roofline", "cost_source"):
         assert key in bench_src, f"bench.py no longer records {key}"
+
+
+def test_wallclock_banned_in_matrix_module(tmp_path):
+    """The scenario-matrix module (ISSUE 12) carries the injectable-
+    Clock contract wherever it lands: verdicts/baselines run on the
+    Clock and the executor's timer is injectable (the PhaseTimings
+    idiom), so a bare wall-clock CALL in any matrix.py is a lint error
+    — under analysis/ via the package ban, elsewhere via the
+    module-name keying the sharding/attribution bans use."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    # a matrix.py outside any banned package: module-name keyed ban
+    got = findings(tmp_path, source, name="matrix.py")
+    assert codes(got) == {"wallclock-in-matrix"}
+    assert len(got) == 2
+    # the shipped location (analysis/matrix.py): the package ban wins
+    analysis_dir = tmp_path / "analysis"
+    analysis_dir.mkdir()
+    (analysis_dir / "matrix.py").write_text(source)
+    got = lint.lint_file(analysis_dir / "matrix.py")
+    assert codes(got) == {"wallclock-in-analysis"}
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="scenario.py") == []
+    # referencing time.monotonic WITHOUT calling it (the injectable
+    # default-timer idiom) stays quiet
+    clean = (
+        "import time\n"
+        "def run(timer=time.monotonic):\n"
+        "    return timer()\n"
+    )
+    assert findings(tmp_path, clean, name="matrix.py") == []
+
+
+def test_matrix_module_really_is_wallclock_free():
+    """The gate, applied: the shipped analysis/matrix.py lints clean
+    and the ban covers it (path-scoping regression guard)."""
+    path = REPO / "activemonitor_tpu" / "analysis" / "matrix.py"
+    assert path.exists(), "matrix module missing?"
+    assert lint.lint_file(path) == []
+    src = path.read_text()
+    checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+    assert checker.ban_wallclock
+    assert checker.wallclock_pkg == "analysis"
+
+
+def test_matrix_families_are_pinned():
+    """The ISSUE-12 families must stay in the exposition contract —
+    the matrix dashboard keys cells by label and a rename silently
+    orphans it (same pin gate as every other subsystem's families)."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_matrix", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_matrix_cell_value",
+        "healthcheck_matrix_cell_state",
+        "healthcheck_matrix_cell_roofline_fraction",
+        "healthcheck_matrix_cells",
+        "healthcheck_matrix_bisect_runs_total",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
+def test_matrix_contract_names_are_pinned():
+    """The ISSUE-12 contract spellings across the layers: the spec file
+    ships the declared dimensions, docs register the cell schema and
+    CLI verb, and bench.py stamps matrix_summary on BOTH paths with the
+    interpret/fallback labeling — a rename in any one layer silently
+    orphans the others (the roofline/zoo gate applied to the matrix)."""
+    import json
+
+    spec_doc = json.loads((REPO / "config" / "bench_matrix.json").read_text())
+    from activemonitor_tpu.analysis import matrix as matrix_model
+
+    for op in spec_doc["ops"]:
+        assert op in matrix_model.OPS, f"spec op {op!r} not in registry"
+    # expansion over the shipped spec must stay crash-free and produce
+    # both runnable cells and structured skips on the 8-device platform
+    cells, skipped = matrix_model.expand(spec_doc, n_devices=8)
+    assert cells and skipped
+    for result in skipped:
+        assert result.status == matrix_model.STATUS_SKIPPED
+        assert result.details["skip"]["code"]
+    docs = (REPO / "docs" / "observability.md").read_text()
+    assert "Reading the matrix" in docs
+    assert "am-tpu matrix" in docs
+    assert "BENCH_BASELINES.json" in docs
+    probes_docs = (REPO / "docs" / "probes.md").read_text()
+    for family in (
+        "healthcheck_matrix_cell_value",
+        "healthcheck_matrix_cell_state",
+        "healthcheck_matrix_cell_roofline_fraction",
+        "healthcheck_matrix_cells",
+        "healthcheck_matrix_bisect_runs_total",
+    ):
+        assert family in probes_docs, f"{family} missing from docs/probes.md"
+    bench_src = (REPO / "bench.py").read_text()
+    for key in (
+        "matrix_summary", "_stamp_matrix", "interpret_mode",
+        "fallback_reason", "BENCH_BASELINES",
+    ):
+        assert key in bench_src, f"bench.py no longer records {key}"

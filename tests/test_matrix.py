@@ -1,0 +1,841 @@
+"""Scenario-matrix observatory (ISSUE 12 — analysis/matrix.py).
+
+Covers the declarative expansion (every impossible combination is a
+structured per-cell skip, never a crash or a silent hole), the durable
+BENCH_BASELINES.json sidecar (defensive restore), and the closed
+regression loop: scripted timings seed a regression into one cell
+across two rounds — the hysteresis verdict escalates to degraded, the
+roofline stamp names the moved ceiling, exactly one auto-bisect re-run
+and one flight bundle fire, and the verdict is visible in `am-tpu
+matrix`, /statusz, and the pinned gauges; a lone-outlier round does
+not flap. The quick 2-cell real-executor slice runs in tier-1; the
+full default matrix rides the slow tier.
+"""
+
+import json
+
+import pytest
+
+from activemonitor_tpu.analysis import baseline as baseline_store
+from activemonitor_tpu.analysis import matrix as matrix_mod
+from activemonitor_tpu.analysis.detector import (
+    Hysteresis,
+    LEVEL_DEGRADED,
+    LEVEL_OK,
+    LEVEL_WARNING,
+)
+from activemonitor_tpu.metrics.collector import MetricsCollector
+from activemonitor_tpu.obs.flightrec import KIND_MATRIX, FlightRecorder
+from activemonitor_tpu.probes.rated import RatedSpec
+from activemonitor_tpu.utils.clock import FakeClock
+
+RATED = RatedSpec(
+    "v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4
+)
+
+
+# ---------------------------------------------------------------------
+# expansion edge cases
+# ---------------------------------------------------------------------
+
+
+def skip_codes(skipped):
+    return {r.cell.cell_id: r.details["skip"]["code"] for r in skipped}
+
+
+def test_expand_missing_axis_is_a_structured_skip_naming_the_axis():
+    spec = {"ops": ["ring"], "meshes": [{"ep": 8}], "dtypes": ["f32"]}
+    cells, skipped = matrix_mod.expand(spec)
+    assert cells == []
+    [result] = skipped
+    assert result.status == matrix_mod.STATUS_SKIPPED
+    assert result.details["skip"]["code"] == matrix_mod.SKIP_MISSING_AXIS
+    # the skip names the mesh/axis the cell lacked
+    assert "'sp'" in result.reason
+    assert "ep" in result.reason
+
+
+def test_expand_unsupported_dtype_is_a_structured_skip_naming_the_dtype():
+    spec = {"ops": ["decode"], "meshes": [{}], "dtypes": ["bf16"]}
+    cells, skipped = matrix_mod.expand(spec)
+    assert cells == []
+    [result] = skipped
+    assert result.details["skip"]["code"] == matrix_mod.SKIP_UNSUPPORTED_DTYPE
+    assert "bfloat16" in result.reason
+    assert "float32" in result.reason  # what it DOES support
+
+
+def test_expand_unknown_op_and_dtype_tokens_never_crash():
+    spec = {
+        "ops": ["warp-drive", "flash"],
+        "meshes": [{}],
+        "dtypes": ["complex128", "f32"],
+    }
+    cells, skipped = matrix_mod.expand(spec)
+    assert [c.cell_id for c in cells] == ["flash/1chip/f32"]
+    codes = {r.details["skip"]["code"] for r in skipped}
+    assert matrix_mod.SKIP_UNKNOWN_OP in codes
+    assert matrix_mod.SKIP_UNKNOWN_DTYPE in codes
+
+
+def test_expand_insufficient_devices_is_a_structured_skip_with_counts():
+    spec = {"ops": ["ring"], "meshes": [{"sp": 64}], "dtypes": ["f32"]}
+    cells, skipped = matrix_mod.expand(spec, n_devices=8)
+    assert cells == []
+    [result] = skipped
+    assert result.details["skip"]["code"] == matrix_mod.SKIP_DEVICES
+    assert "64" in result.reason and "8" in result.reason
+
+
+def test_expand_dedupes_cells_that_agree_on_required_axes():
+    # flash shards over no axis: three meshes, ONE cell
+    spec = {
+        "ops": ["flash"],
+        "meshes": [{"sp": 8}, {"ep": 8}, {}],
+        "dtypes": ["f32"],
+        "schedules": ["auto", "rsag"],
+    }
+    cells, skipped = matrix_mod.expand(spec)
+    # and a collective-free op does not multiply over schedule variants
+    assert [c.cell_id for c in cells] == ["flash/1chip/f32"]
+    assert skipped == []
+
+
+def test_expand_default_spec_covers_every_op_on_the_test_platform():
+    spec, warning = matrix_mod.load_spec(None)
+    assert warning is None
+    cells, skipped = matrix_mod.expand(spec, n_devices=8)
+    assert {c.op for c in cells} == set(spec["ops"])
+    assert skipped  # the honest holes: ops x meshes that don't combine
+    quick = matrix_mod.quick_slice(cells)
+    assert len(quick) == 2
+    assert all(c.devices_needed == 1 for c in quick)
+
+
+def test_load_spec_corrupt_file_degrades_to_default_with_warning(tmp_path):
+    path = tmp_path / "matrix.json"
+    path.write_text("{never json")
+    spec, warning = matrix_mod.load_spec(str(path))
+    assert spec["ops"] == matrix_mod.DEFAULT_SPEC["ops"]
+    assert warning["reason"] == "spec-unreadable"
+    # a list top level is a shape warning, same fallback
+    path.write_text("[1, 2]")
+    spec, warning = matrix_mod.load_spec(str(path))
+    assert spec["ops"] == matrix_mod.DEFAULT_SPEC["ops"]
+    assert warning["reason"] == "spec-shape"
+    # and a missing file is simply the default (config is optional)
+    spec, warning = matrix_mod.load_spec(str(tmp_path / "absent.json"))
+    assert spec["ops"] and warning is None
+
+
+# ---------------------------------------------------------------------
+# hysteresis jump-to-raw (the matrix contract on detector.py)
+# ---------------------------------------------------------------------
+
+
+def test_hysteresis_jump_to_raw_escalates_to_confirmed_level():
+    state = Hysteresis(confirm_runs=2, calm_runs=3, jump_to_raw=True)
+    assert state.update(LEVEL_DEGRADED) is None  # lone outlier: no move
+    assert state.update(LEVEL_DEGRADED) == (LEVEL_OK, LEVEL_DEGRADED)
+    # the jump lands on the WEAKEST level the streak sustained
+    state = Hysteresis(confirm_runs=2, calm_runs=3, jump_to_raw=True)
+    assert state.update(LEVEL_DEGRADED) is None
+    assert state.update(LEVEL_WARNING) == (LEVEL_OK, LEVEL_WARNING)
+    # recovery stays one deliberate step per calm streak
+    state = Hysteresis(confirm_runs=2, calm_runs=2, jump_to_raw=True)
+    state.update(LEVEL_DEGRADED)
+    state.update(LEVEL_DEGRADED)
+    assert state.update(LEVEL_OK) is None
+    assert state.update(LEVEL_OK) == (LEVEL_DEGRADED, LEVEL_WARNING)
+
+
+def test_hysteresis_floor_roundtrips_and_stays_out_of_calm_blobs():
+    state = Hysteresis(confirm_runs=3, jump_to_raw=True)
+    state.update(LEVEL_DEGRADED)
+    doc = json.loads(json.dumps(state.to_dict()))
+    assert doc["floor"] == LEVEL_DEGRADED
+    restored = Hysteresis.from_dict(doc, 3, 3, jump_to_raw=True)
+    assert restored.up_floor == LEVEL_DEGRADED
+    assert restored.update(LEVEL_DEGRADED) is None
+    assert restored.update(LEVEL_DEGRADED) == (LEVEL_OK, LEVEL_DEGRADED)
+    # a calm state serializes without the floor key (pre-existing
+    # .status.analysis blobs stay byte-identical)
+    assert "floor" not in Hysteresis().to_dict()
+
+
+# ---------------------------------------------------------------------
+# durable sidecar (analysis/baseline.py blob helpers)
+# ---------------------------------------------------------------------
+
+
+def test_blob_roundtrip_and_defensive_restores(tmp_path):
+    path = str(tmp_path / "BENCH_BASELINES.json")
+    assert baseline_store.load_blob(path) == (None, None)  # first round
+    assert baseline_store.save_blob(path, {"x": 1}) is None
+    doc, warning = baseline_store.load_blob(path)
+    assert warning is None and doc["x"] == 1
+    assert doc["blob_version"] == baseline_store.BLOB_VERSION
+
+    (tmp_path / "BENCH_BASELINES.json").write_text("{truncated")
+    doc, warning = baseline_store.load_blob(path)
+    assert doc is None and warning["reason"] == "corrupt-json"
+
+    (tmp_path / "BENCH_BASELINES.json").write_text('["not", "an", "object"]')
+    doc, warning = baseline_store.load_blob(path)
+    assert doc is None and warning["reason"] == "corrupt-shape"
+
+    (tmp_path / "BENCH_BASELINES.json").write_text(
+        json.dumps({"blob_version": 999, "x": 1})
+    )
+    doc, warning = baseline_store.load_blob(path)
+    assert doc is None and warning["reason"] == "version-skew"
+    assert "999" in warning["detail"]
+
+
+def test_observatory_restores_fresh_from_corrupt_sidecar_with_warning(tmp_path):
+    path = tmp_path / "BENCH_BASELINES.json"
+    path.write_text("}{")
+    observatory = matrix_mod.MatrixObservatory(
+        clock=FakeClock(), path=str(path)
+    )
+    assert observatory.restore_warning["reason"] == "corrupt-json"
+    assert observatory.baselines.metrics() == []
+    # the warning rides the round summary so the artifact says WHY the
+    # baselines started over
+    summary = observatory.observe_round([])
+    assert summary["restore_warning"]["reason"] == "corrupt-json"
+    # and the round save repairs the sidecar for the next reader
+    doc, warning = baseline_store.load_blob(str(path))
+    assert warning is None and doc["last_round"]["cells"] == {}
+
+
+# ---------------------------------------------------------------------
+# the closed loop (acceptance)
+# ---------------------------------------------------------------------
+
+CELL = matrix_mod.CellSpec("flash", (), "bfloat16", "-")
+
+
+def scripted(seconds, cell=CELL):
+    """A scripted measurement: 4 GFLOP over 2 MB — compute-bound on the
+    v5e roofline, so the stamp should name the compute ceiling."""
+    return matrix_mod.CellResult(
+        cell,
+        matrix_mod.STATUS_OK,
+        value=seconds,
+        seconds=seconds,
+        flops=4e9,
+        bytes_accessed=2e6,
+    )
+
+
+class ScriptedExecutor:
+    """Bisect executor returning a fixed re-run value, counting calls."""
+
+    def __init__(self, seconds):
+        self.seconds = seconds
+        self.calls = 0
+
+    def __call__(self, cell):
+        self.calls += 1
+        return scripted(self.seconds, cell)
+
+
+def build_observatory(tmp_path, **kwargs):
+    clock = FakeClock()
+    recorder = FlightRecorder(clock=clock)
+    collector = MetricsCollector()
+    observatory = matrix_mod.MatrixObservatory(
+        clock=clock,
+        path=str(tmp_path / "BENCH_BASELINES.json"),
+        warmup_runs=3,
+        confirm_runs=2,
+        calm_runs=3,
+        rated_spec=RATED,
+        metrics=collector,
+        flightrec=recorder,
+        **kwargs,
+    )
+    return observatory, recorder, collector, clock
+
+
+def tick(clock, seconds=60.0):
+    # FakeClock.advance is async (it wakes sleepers); matrix rounds only
+    # need the timestamp to move
+    clock._t += seconds
+
+
+def observe(observatory, clock, seconds, executor=None):
+    tick(clock)
+    return observatory.observe_round(
+        [scripted(seconds)], executor=executor, interpret_mode=True
+    )
+
+
+def test_closed_loop_regression_escalates_bisects_and_bundles(tmp_path):
+    observatory, recorder, collector, clock = build_observatory(tmp_path)
+    executor = ScriptedExecutor(0.004)  # the re-run still reproduces
+    for _ in range(5):
+        summary = observe(observatory, clock, 0.001, executor)
+        assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+        assert summary["regressions"] == []
+    # roofline stamped on every round, model-sourced, compute-bound
+    stamp = summary["cells"][CELL.cell_id]["roofline"]
+    assert stamp["bound"] == "compute"
+    assert stamp["cost_source"] == "model"
+
+    # a lone noisy round never flaps: no transition, no bisect, no bundle
+    summary = observe(observatory, clock, 0.004, executor)
+    assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+    assert summary["regressions"] == [] and summary["bisects"] == []
+    assert executor.calls == 0
+    summary = observe(observatory, clock, 0.001, executor)
+    assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+
+    # seed the regression across two rounds: the verdict escalates to
+    # degraded on the confirming round
+    first = observe(observatory, clock, 0.004, executor)
+    assert first["cells"][CELL.cell_id]["verdict"] == "ok"
+    assert first["regressions"] == []
+    second = observe(observatory, clock, 0.004, executor)
+    entry = second["cells"][CELL.cell_id]
+    assert entry["verdict"] == "degraded"
+    assert entry["vs_baseline"] == pytest.approx(4.0)
+
+    # the regression names the moved ceiling from the roofline stamp
+    [regression] = second["regressions"]
+    assert regression["cell"] == CELL.cell_id
+    assert regression["ceiling"] == "compute"
+    assert regression["cost_source"] == "model"
+
+    # exactly one auto-bisect re-run fired, and it reproduced
+    assert executor.calls == 1
+    [bisect] = second["bisects"]
+    assert bisect["outcome"] == matrix_mod.BISECT_REPRODUCED
+    assert bisect["round_value"] == 0.004
+    assert bisect["prior_value"] == 0.004  # the prior artifact's value
+    assert bisect["rerun_value"] == 0.004
+
+    # exactly one flight bundle, carrying BOTH artifacts' evidence
+    [bundle] = recorder.bundles(kind=KIND_MATRIX)
+    assert bundle["check"] == f"matrix/{CELL.cell_id}"
+    assert bundle["extra"]["cell"]["verdict"] == "degraded"
+    assert bundle["extra"]["prior_cell"]["value"] == 0.004
+    assert bundle["extra"]["bisect"]["outcome"] == "reproduced"
+
+    # the verdict is visible on the pinned gauges
+    cell_label = "flash_1chip_bf16"
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_state",
+            {"cell": cell_label, "state": "degraded"},
+        )
+        == 1.0
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_value",
+            {"cell": cell_label, "metric": "seconds"},
+        )
+        == 0.004
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_bisect_runs_total",
+            {"cell": cell_label, "outcome": "reproduced"},
+        )
+        == 1.0
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_roofline_fraction",
+            {"cell": cell_label, "bound": "compute"},
+        )
+        == pytest.approx(stamp["fraction"] / 4.0, rel=1e-3)
+    )
+
+    # ...and in `am-tpu matrix` and /statusz
+    from activemonitor_tpu.__main__ import render_matrix
+    from activemonitor_tpu.obs.slo import FleetStatus, rollup_statusz
+
+    text = render_matrix(second)
+    assert "REGRESSION flash/1chip/bf16" in text
+    assert "ceiling=compute" in text
+    assert "bisect=reproduced" in text
+    assert "degraded" in text
+
+    fleet = FleetStatus(clock, MetricsCollector())
+    fleet.matrix = observatory
+    payload = json.loads(json.dumps(fleet.statusz([])))
+    assert (
+        payload["fleet"]["matrix"]["cells"][CELL.cell_id]["verdict"]
+        == "degraded"
+    )
+    # the rollup carries the newest round over matrix-less replicas
+    bare = FleetStatus(clock, MetricsCollector())
+    merged = rollup_statusz([bare.statusz([]), payload])
+    assert merged["fleet"]["matrix"]["cells"][CELL.cell_id]["verdict"] == (
+        "degraded"
+    )
+
+    # still exactly one bisect/bundle after another degraded round (the
+    # hysteresis already reports degraded: no new transition)
+    third = observe(observatory, clock, 0.004, executor)
+    assert third["regressions"] == [] and third["bisects"] == []
+    assert executor.calls == 1
+    assert len(recorder.bundles(kind=KIND_MATRIX)) == 1
+
+
+def test_bisect_recovered_when_rerun_is_healthy(tmp_path):
+    observatory, recorder, _collector, clock = build_observatory(tmp_path)
+    executor = ScriptedExecutor(0.001)  # the re-run comes back healthy
+    for _ in range(4):
+        observe(observatory, clock, 0.001, executor)
+    observe(observatory, clock, 0.004, executor)
+    summary = observe(observatory, clock, 0.004, executor)
+    [bisect] = summary["bisects"]
+    assert bisect["outcome"] == matrix_mod.BISECT_RECOVERED
+    [bundle] = recorder.bundles(kind=KIND_MATRIX)
+    assert bundle["extra"]["bisect"]["outcome"] == "recovered"
+
+
+def test_error_and_skipped_cells_never_feed_baselines(tmp_path):
+    observatory, _recorder, _collector, clock = build_observatory(tmp_path)
+    broken = matrix_mod.CellResult(CELL, matrix_mod.STATUS_ERROR, reason="boom")
+    other = matrix_mod.CellSpec("decode", (), "float32", "-")
+    skipped = matrix_mod.skipped_result(
+        other, matrix_mod.SKIP_DEVICES, "needs 64 devices, have 8"
+    )
+    tick(clock)
+    # a duplicate cell_id contributes one row and one count (the header
+    # and the table must never disagree)
+    summary = observatory.observe_round([broken, skipped, skipped])
+    assert summary["counts"] == {"ok": 0, "skipped": 1, "error": 1}
+    assert len(summary["cells"]) == 2
+    entry = summary["cells"][CELL.cell_id]
+    assert "verdict" not in entry
+    assert observatory.baselines.metrics() == []
+
+
+def test_round_survives_restart_through_the_sidecar(tmp_path):
+    observatory, _recorder, _collector, clock = build_observatory(tmp_path)
+    executor = ScriptedExecutor(0.004)
+    for _ in range(4):
+        observe(observatory, clock, 0.001, executor)
+    observe(observatory, clock, 0.004, executor)
+
+    # a fresh process restores baselines AND the mid-escalation streak:
+    # the confirming round after restart still escalates to degraded
+    restored, recorder2, _collector2, clock2 = build_observatory(tmp_path)
+    assert restored.restore_warning is None
+    summary = observe(restored, clock2, 0.004, ScriptedExecutor(0.004))
+    assert summary["cells"][CELL.cell_id]["verdict"] == "degraded"
+    assert len(recorder2.bundles(kind=KIND_MATRIX)) == 1
+    # and the sidecar view serves the restored round to /statusz
+    view = matrix_mod.SidecarView(str(tmp_path / "BENCH_BASELINES.json"))
+    assert view.snapshot()["cells"][CELL.cell_id]["verdict"] == "degraded"
+
+
+def test_sidecar_view_reports_structured_warning_on_corrupt_blob(tmp_path):
+    path = tmp_path / "BENCH_BASELINES.json"
+    view = matrix_mod.SidecarView(str(path))
+    assert view.snapshot() is None  # no rounds yet
+    path.write_text("{nope")
+    snapshot = view.snapshot()
+    assert snapshot["restore_warning"]["reason"] == "corrupt-json"
+    assert snapshot["cells"] == {}
+    # and render_matrix surfaces it instead of crashing
+    from activemonitor_tpu.__main__ import render_matrix
+
+    assert "sidecar restored fresh: corrupt-json" in render_matrix(snapshot)
+
+
+def test_fallback_reason_and_interpret_mode_ride_every_cell(tmp_path):
+    observatory, _recorder, _collector, clock = build_observatory(tmp_path)
+    other = matrix_mod.CellSpec("decode", (), "float32", "-")
+    skipped = matrix_mod.skipped_result(
+        other, matrix_mod.SKIP_DEVICES, "needs 8 devices, have 1"
+    )
+    tick(clock)
+    summary = observatory.observe_round(
+        [scripted(0.001), skipped],
+        interpret_mode=True,
+        fallback_reason="device probe hung past 120s (wedged tunnel?)",
+    )
+    assert summary["interpret_mode"] is True
+    assert summary["fallback_reason"].startswith("device probe hung")
+    for entry in summary["cells"].values():
+        # EVERY cell — measured and skipped alike — carries the labels
+        assert entry["interpret_mode"] is True
+        assert entry["fallback_reason"].startswith("device probe hung")
+
+
+# ---------------------------------------------------------------------
+# the real executor (quick slice in tier-1, full matrix on the slow tier)
+# ---------------------------------------------------------------------
+
+
+def run_real_cells(cells, tmp_path):
+    executor = matrix_mod.make_executor(iters=1)
+    observatory = matrix_mod.MatrixObservatory(
+        clock=FakeClock(), path=str(tmp_path / "BENCH_BASELINES.json")
+    )
+    results = [executor(cell) for cell in cells]
+    return observatory.observe_round(
+        results, executor=executor, interpret_mode=True
+    )
+
+
+def test_quick_slice_measures_real_cells_on_the_cpu_platform(tmp_path):
+    spec, _warning = matrix_mod.load_spec(None)
+    cells, _skipped = matrix_mod.expand(spec, n_devices=8)
+    summary = run_real_cells(matrix_mod.quick_slice(cells), tmp_path)
+    assert summary["counts"]["ok"] == 2
+    for entry in summary["cells"].values():
+        assert entry["status"] == "ok"
+        assert entry["value"] > 0
+        # interpret mode: no rated roofline — the omission is a
+        # structured skip, never a silent hole
+        assert "skipped" in entry["roofline"]
+        assert entry["verdict"] == "ok"
+
+
+@pytest.mark.slow  # the full default matrix: 11 cells incl. 8-device meshes
+def test_full_matrix_soak_runs_every_default_cell(tmp_path):
+    spec, _warning = matrix_mod.load_spec(None)
+    cells, skipped = matrix_mod.expand(spec, n_devices=8)
+    summary = run_real_cells(cells, tmp_path)
+    assert summary["counts"]["ok"] == len(cells)
+    assert summary["counts"]["error"] == 0
+    by_op = {matrix_mod.CellSpec(**{  # noqa: F841 - readability only
+        "op": c.op, "mesh": c.mesh, "dtype": c.dtype, "schedule": c.schedule
+    }).op for c in cells}
+    assert by_op == set(spec["ops"])
+    # collective-riding cells resolved a schedule from the table (or
+    # the XLA fallback when nothing is tuned)
+    for cell_id, entry in summary["cells"].items():
+        if entry["schedule_requested"] == "auto":
+            assert entry["schedule"], cell_id
+        if cell_id.startswith("training-step/"):
+            # the default mesh carries model=2, which gates the tuned
+            # sync back to the XLA-inserted reduction — the stamp must
+            # report what RAN, not the requested token
+            assert entry["schedule"] == "xla(implicit)", entry
+    # the skips stayed structured
+    assert all(
+        r.details["skip"]["code"] == matrix_mod.SKIP_MISSING_AXIS
+        or r.details["skip"]["code"] == matrix_mod.SKIP_UNSUPPORTED_DTYPE
+        for r in skipped
+    )
+
+
+def test_expand_alias_dtype_tokens_dedupe_to_one_cell_and_one_skip():
+    # "bf16" and "bfloat16" canonicalize identically: one row, one
+    # count — runnable or skip — so the counts header and the table can
+    # never disagree
+    spec = {
+        "ops": ["flash", "decode"],
+        "meshes": [{}],
+        "dtypes": ["bf16", "bfloat16"],
+    }
+    cells, skipped = matrix_mod.expand(spec)
+    assert [c.cell_id for c in cells] == ["flash/1chip/bf16"]
+    assert [r.cell.cell_id for r in skipped] == ["decode/1chip/bf16"]
+
+
+def test_hysteresis_floor_resets_when_the_streak_breaks():
+    # an ordinary (non-jump) check that sees one noisy run must not
+    # serialize a stale "floor" key forever
+    state = Hysteresis(confirm_runs=3)
+    state.update(LEVEL_WARNING)
+    assert "floor" in state.to_dict()
+    state.update(LEVEL_OK)  # streak broken
+    assert "floor" not in state.to_dict()
+
+
+def test_collector_drops_series_of_cells_removed_from_the_spec():
+    collector = MetricsCollector()
+    degraded_round = {
+        "cells": {
+            "ring/sp8/bf16": {
+                "status": "ok",
+                "metric": "seconds",
+                "value": 0.005,
+                "verdict": "degraded",
+                "roofline": {"bound": "comm", "fraction": 0.2},
+            }
+        },
+        "bisects": [],
+    }
+    collector.record_matrix_round(degraded_round)
+    labels = {"cell": "ring_sp8_bf16", "state": "degraded"}
+    assert collector.sample_value("healthcheck_matrix_cell_state", labels) == 1.0
+    # the operator renames the cell away: the next round must drop the
+    # old series instead of alerting degraded=1 until restart
+    collector.record_matrix_round({"cells": {}, "bisects": []})
+    assert collector.sample_value("healthcheck_matrix_cell_state", labels) is None
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_value",
+            {"cell": "ring_sp8_bf16", "metric": "seconds"},
+        )
+        is None
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_roofline_fraction",
+            {"cell": "ring_sp8_bf16", "bound": "comm"},
+        )
+        is None
+    )
+
+
+def test_controller_exports_matrix_gauges_from_sidecar_once_per_round(tmp_path):
+    from activemonitor_tpu.obs.slo import FleetStatus
+
+    observatory, _recorder, _collector, clock = build_observatory(tmp_path)
+    executor = ScriptedExecutor(0.004)
+    for _ in range(4):
+        observe(observatory, clock, 0.001, executor)
+    observe(observatory, clock, 0.004, executor)
+    observe(observatory, clock, 0.004, executor)  # confirmed: 1 bisect
+
+    collector = MetricsCollector()
+    fleet = FleetStatus(FakeClock(), collector)
+    fleet.matrix = matrix_mod.SidecarView(
+        str(tmp_path / "BENCH_BASELINES.json")
+    )
+    fleet.refresh_matrix_metrics()
+    bisect_labels = {"cell": "flash_1chip_bf16", "outcome": "reproduced"}
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_state",
+            {"cell": "flash_1chip_bf16", "state": "degraded"},
+        )
+        == 1.0
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_bisect_runs_total", bisect_labels
+        )
+        == 1.0
+    )
+    # the rollup loop re-serving an UNCHANGED sidecar must not
+    # double-count the bisect counter
+    fleet.refresh_matrix_metrics()
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_bisect_runs_total", bisect_labels
+        )
+        == 1.0
+    )
+    # a controller without --matrix-state is a no-op
+    FleetStatus(FakeClock(), MetricsCollector()).refresh_matrix_metrics()
+
+
+def test_sidecar_view_caches_on_mtime_and_size(tmp_path, monkeypatch):
+    import os
+
+    path = tmp_path / "BENCH_BASELINES.json"
+    baseline_store.save_blob(str(path), {"last_round": {"cells": {"a": {}}}})
+    view = matrix_mod.SidecarView(str(path))
+    assert view.snapshot()["cells"] == {"a": {}}
+    # unchanged file: the parse must not re-run
+    monkeypatch.setattr(
+        baseline_store,
+        "load_blob",
+        lambda _p: (_ for _ in ()).throw(AssertionError("reparsed")),
+    )
+    assert view.snapshot()["cells"] == {"a": {}}
+    monkeypatch.undo()
+    # a new round re-reads (mtime/size move)
+    baseline_store.save_blob(str(path), {"last_round": {"cells": {"b": {}}}})
+    os.utime(path, (1e9, 1e9))
+    assert view.snapshot()["cells"] == {"b": {}}
+
+
+def test_baselines_are_scoped_per_platform_mode(tmp_path):
+    # TPU-learned seconds must never judge a CPU-fallback round (the
+    # r02-r05 wedge scenario): each mode warms its own baseline
+    observatory, recorder, _collector, clock = build_observatory(tmp_path)
+    # 4 GFLOP in 22 us = 0.92 of the v5e compute ceiling: a HEALTHY
+    # TPU reading (the rated-floor detector judges tpu-mode fractions
+    # absolutely, so the scripted value must sit ON the roofline)
+    tpu_seconds = 2.2e-5
+    executor = ScriptedExecutor(tpu_seconds)
+    for _ in range(5):
+        tick(clock)
+        summary = observatory.observe_round(
+            [scripted(tpu_seconds)], executor=executor, interpret_mode=False
+        )
+        assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+    # the tunnel wedges: interpret rounds run ~50x slower — platform
+    # noise, not a regression; no verdict, no bisect, no bundle
+    for _ in range(3):
+        tick(clock)
+        summary = observatory.observe_round(
+            [scripted(0.1)], executor=executor, interpret_mode=True,
+            fallback_reason="wedged tunnel",
+        )
+        assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+        assert summary["regressions"] == []
+    assert executor.calls == 0
+    assert recorder.bundles(kind=KIND_MATRIX) == []
+    # recovery back to TPU: the tpu-mode baseline is untainted
+    tick(clock)
+    summary = observatory.observe_round(
+        [scripted(tpu_seconds)], executor=executor, interpret_mode=False
+    )
+    assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+
+
+def test_internal_dispatch_ops_do_not_expand_over_schedule_variants():
+    # moe's token gather is an internal autotune.all_gather("auto"):
+    # explicit variants cannot be threaded in, so expanding them would
+    # label identical runs as distinct scenarios
+    spec = {
+        "ops": ["moe", "pipeline"],
+        "meshes": [{"ep": 8, "pp": 2}],
+        "dtypes": ["f32"],
+        "schedules": ["auto", "rsag"],
+    }
+    cells, _skipped = matrix_mod.expand(spec)
+    assert [c.cell_id for c in cells] == [
+        "moe/ep8/f32/auto",
+        "pipeline/pp2/f32/auto",
+        "pipeline/pp2/f32/rsag",
+    ]
+
+
+def test_insufficient_devices_skips_dedupe_alias_dtypes():
+    spec = {
+        "ops": ["ring"],
+        "meshes": [{"sp": 8}],
+        "dtypes": ["bf16", "bfloat16"],
+    }
+    cells, skipped = matrix_mod.expand(spec, n_devices=4)
+    assert cells == []
+    assert [r.cell.cell_id for r in skipped] == ["ring/sp8/bf16"]
+
+
+def test_tpu_mode_double_metric_regression_fires_exactly_one_bisect(tmp_path):
+    # on a real-TPU round both 'seconds' and 'roofline-fraction' can
+    # confirm degraded together — that is ONE regression: one re-run,
+    # one bundle (the documented invariant), with per-metric regression
+    # entries sharing the bisect outcome
+    observatory, recorder, collector, clock = build_observatory(tmp_path)
+    healthy, sick = 2.2e-5, 8.8e-5  # 0.92 -> 0.23 of the compute ceiling
+    executor = ScriptedExecutor(sick)
+    for _ in range(5):
+        tick(clock)
+        summary = observatory.observe_round(
+            [scripted(healthy)], executor=executor, interpret_mode=False
+        )
+        assert summary["cells"][CELL.cell_id]["verdict"] == "ok"
+    for _ in range(2):
+        tick(clock)
+        summary = observatory.observe_round(
+            [scripted(sick)], executor=executor, interpret_mode=False
+        )
+    entry = summary["cells"][CELL.cell_id]
+    assert entry["verdict"] == "degraded"
+    # both metrics transitioned, but exactly one re-run and one bundle
+    assert len(summary["regressions"]) == 2
+    assert {r["metric"] for r in summary["regressions"]} == {
+        "seconds", "roofline-fraction",
+    }
+    assert len(summary["bisects"]) == 1
+    assert executor.calls == 1
+    assert len(recorder.bundles(kind=KIND_MATRIX)) == 1
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_bisect_runs_total",
+            {"cell": "flash_1chip_bf16", "outcome": "reproduced"},
+        )
+        == 1.0
+    )
+
+
+def test_collector_drops_state_series_when_cell_flips_to_skipped():
+    # a degraded cell whose next round is skipped (the TPU wedged to a
+    # smaller fallback platform) has no fresh verdict: the stale
+    # degraded one-hot and roofline fraction must drop, not alert on
+    # last round's evidence forever
+    collector = MetricsCollector()
+    collector.record_matrix_round(
+        {
+            "cells": {
+                "ring/sp8/bf16": {
+                    "status": "ok",
+                    "metric": "seconds",
+                    "value": 0.005,
+                    "verdict": "degraded",
+                    "roofline": {"bound": "comm", "fraction": 0.3},
+                }
+            },
+            "bisects": [],
+        }
+    )
+    collector.record_matrix_round(
+        {
+            "cells": {
+                "ring/sp8/bf16": {
+                    "status": "skipped",
+                    "reason": "insufficient-devices: needs 8, have 1",
+                }
+            },
+            "bisects": [],
+        }
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_state",
+            {"cell": "ring_sp8_bf16", "state": "degraded"},
+        )
+        is None
+    )
+    assert (
+        collector.sample_value(
+            "healthcheck_matrix_cell_roofline_fraction",
+            {"cell": "ring_sp8_bf16", "bound": "comm"},
+        )
+        is None
+    )
+    # ...and the skipped cell still counts in the round totals
+    assert (
+        collector.sample_value("healthcheck_matrix_cells", {"status": "skipped"})
+        == 1.0
+    )
+
+
+def test_dtype_skips_carry_the_canonical_cell_id_across_meshes():
+    # one logical scenario = one skip row under the id its runnable
+    # siblings would use, however many meshes the spec lists
+    spec = {
+        "ops": ["decode"],
+        "meshes": [{"sp": 8}, {"ep": 8}, {"data": 2, "model": 2, "pp": 2}],
+        "dtypes": ["bf16", "f32"],
+    }
+    cells, skipped = matrix_mod.expand(spec)
+    assert [c.cell_id for c in cells] == ["decode/1chip/f32"]
+    assert [r.cell.cell_id for r in skipped] == ["decode/1chip/bf16"]
+
+
+def test_unknown_schedule_token_is_a_structured_skip_not_a_runner_error():
+    spec = {
+        "ops": ["training-step"],
+        "meshes": [{"data": 2, "model": 2}],
+        "dtypes": ["f32"],
+        "schedules": ["ringz"],  # config typo
+    }
+    cells, skipped = matrix_mod.expand(spec)
+    assert cells == []
+    [result] = skipped
+    assert result.details["skip"]["code"] == matrix_mod.SKIP_UNKNOWN_SCHEDULE
+    assert "ringz" in result.reason and "rsag" in result.reason
+    # the mirror stays in lockstep with the probe layer's token set
+    from activemonitor_tpu.probes.training_step import GRAD_SYNC_SCHEDULES
+
+    assert set(matrix_mod.KNOWN_SCHEDULES) == set(GRAD_SYNC_SCHEDULES) - {
+        "implicit"
+    }

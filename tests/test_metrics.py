@@ -228,6 +228,13 @@ PINNED_FAMILIES = {
     "healthcheck_metric_baseline": "gauge",
     "healthcheck_metric_zscore": "gauge",
     "healthcheck_anomaly_state": "gauge",
+    # scenario-matrix families (ISSUE 12: declarative bench/probe
+    # matrix — docs/observability.md "Reading the matrix")
+    "healthcheck_matrix_cell_value": "gauge",
+    "healthcheck_matrix_cell_state": "gauge",
+    "healthcheck_matrix_cell_roofline_fraction": "gauge",
+    "healthcheck_matrix_cells": "gauge",
+    "healthcheck_matrix_bisect_runs_total": "counter",
     # sharding families (ISSUE 6: sharded controller fleet —
     # docs/operations.md "Sharded controller fleet")
     "healthcheck_shard_owned": "gauge",
@@ -302,6 +309,27 @@ def exercise_every_family(collector):
     collector.record_custom_metrics(
         "hc-a",
         {"outputs": {"parameters": [{"name": "m", "value": contract}]}},
+    )
+    # scenario-matrix families (ISSUE 12): one round summary with a
+    # non-ok verdict (materializes the lazy state trio), a roofline
+    # stamp, a skipped cell, and a bisect record
+    collector.record_matrix_round(
+        {
+            "cells": {
+                "flash/1chip/bf16": {
+                    "status": "ok",
+                    "metric": "seconds",
+                    "value": 0.004,
+                    "verdict": "degraded",
+                    "roofline": {"bound": "compute", "fraction": 0.4},
+                },
+                "decode/1chip/bf16": {
+                    "status": "skipped",
+                    "reason": "unsupported-dtype: decode is float32-only",
+                },
+            },
+            "bisects": [{"cell": "flash/1chip/bf16", "outcome": "reproduced"}],
+        }
     )
 
 

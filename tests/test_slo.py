@@ -345,6 +345,9 @@ FLEET_FIELDS = {
     # sharded-fleet ownership (ISSUE 6): this replica's owned shards
     # and per-shard check counts; None when unsharded
     "sharding": (dict, type(None)),
+    # scenario-matrix round summary (ISSUE 12): the latest observed
+    # round's per-cell verdicts; None until a matrix source is wired
+    "matrix": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,
