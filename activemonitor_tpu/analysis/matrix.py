@@ -138,6 +138,19 @@ class OpDef:
     # matrix would report distinct scenarios for identical runs
     collective: str = ""
     accepts_schedule: bool = False
+    # whether the op expands over the spec's payload octaves
+    # ("payloads_kb") — raw collective ops whose regime IS the payload
+    # (the hierarchical all-reduce's latency-vs-bandwidth crossover);
+    # compute ops carry their own fixed shapes and never multiply
+    accepts_payload: bool = False
+
+
+# payload octaves (KB) a payload-accepting op expands over when the
+# spec doesn't say: one cell below the default latency threshold
+# (64 KB — parallel/autotune.DEFAULT_LATENCY_THRESHOLD_BYTES) and one
+# well above it, so both sides of the small-message crossover get a
+# baseline from round one
+DEFAULT_PAYLOADS_KB = (16, 4096)
 
 
 # the op registry: flash/ring/moe/pipeline/decode/training-step — the
@@ -168,6 +181,17 @@ OPS: Dict[str, OpDef] = {
         collective="allreduce",
         accepts_schedule=True,
     ),
+    # the hierarchical DCN×ICI all-reduce (parallel/schedules.py):
+    # dispatch is the tuned two-tier surface (autotune.hier_plan picks
+    # latency vs bandwidth per payload), so it expands over payload
+    # octaves, not schedule variants — the payload IS the scenario
+    "hier-allreduce": OpDef(
+        "hier-allreduce",
+        ("dcn", "ici"),
+        ("bfloat16", "float32"),
+        collective="allreduce",
+        accepts_payload=True,
+    ),
 }
 
 
@@ -176,12 +200,15 @@ class CellSpec:
     """One expanded matrix cell. ``mesh`` is the ordered partition-rule
     tuple of (axis, size) pairs the cell re-meshes by — restricted to
     the op's required axes, so two meshes that agree on them yield the
-    SAME cell (deduped at expansion)."""
+    SAME cell (deduped at expansion). ``payload_kb`` is set only for
+    payload-accepting ops (None keeps every pre-existing cell id
+    stable — baselines in the sidecar survive the field's arrival)."""
 
     op: str
     mesh: Tuple[Tuple[str, int], ...]
     dtype: str  # canonical dtype name
     schedule: str  # "auto" | explicit zoo token | "-" (no collective)
+    payload_kb: Optional[int] = None  # payload octave (accepts_payload ops)
 
     @property
     def mesh_id(self) -> str:
@@ -195,6 +222,8 @@ class CellSpec:
         parts = [self.op, self.mesh_id, short]
         if self.schedule != "-":
             parts.append(self.schedule)
+        if self.payload_kb is not None:
+            parts.append(f"{self.payload_kb}kb")
         return "/".join(parts)
 
     @property
@@ -238,10 +267,23 @@ def skipped_result(cell: CellSpec, reason_code: str, detail: str) -> CellResult:
 
 DEFAULT_SPEC: dict = {
     "version": MATRIX_VERSION,
-    "ops": ["flash", "ring", "moe", "pipeline", "decode", "training-step"],
-    "meshes": [{"sp": 8}, {"ep": 8}, {"data": 2, "model": 2, "pp": 2}],
+    "ops": [
+        "flash", "ring", "moe", "pipeline", "decode", "training-step",
+        "hier-allreduce",
+    ],
+    "meshes": [
+        {"sp": 8},
+        {"ep": 8},
+        {"data": 2, "model": 2, "pp": 2},
+        # the two-tier rows: 2x4 runs on the 8-device test platform;
+        # 2x8 is the deliberate single-process impossibility that must
+        # land as a structured device-deficit skip, not a hole
+        {"dcn": 2, "ici": 4},
+        {"dcn": 2, "ici": 8},
+    ],
     "dtypes": ["bf16", "f32"],
     "schedules": ["auto"],
+    "payloads_kb": list(DEFAULT_PAYLOADS_KB),
 }
 
 
@@ -271,7 +313,7 @@ def load_spec(path: Optional[str]) -> Tuple[dict, Optional[dict]]:
             "detail": f"{path}: top level is {type(doc).__name__}",
         }
     spec = dict(DEFAULT_SPEC)
-    for key in ("ops", "meshes", "dtypes", "schedules"):
+    for key in ("ops", "meshes", "dtypes", "schedules", "payloads_kb"):
         value = doc.get(key)
         if isinstance(value, list) and value:
             spec[key] = value
@@ -295,6 +337,18 @@ def expand(
     runnable: List[CellSpec] = []
     skipped: List[CellResult] = []
     seen: set = set()
+    # payload octaves for accepts_payload ops, parsed ONCE per expand:
+    # malformed tokens degrade to the default octaves (known coverage
+    # over a crashed round)
+    parsed_payloads: List[int] = []
+    for token in spec.get("payloads_kb") or list(DEFAULT_PAYLOADS_KB):
+        try:
+            value = int(token)
+        except (TypeError, ValueError):
+            continue
+        if value > 0:
+            parsed_payloads.append(value)
+    payload_octaves = parsed_payloads or list(DEFAULT_PAYLOADS_KB)
     for op_token in spec.get("ops") or []:
         op = OPS.get(str(op_token))
         for mesh_doc in spec.get("meshes") or [{}]:
@@ -316,12 +370,22 @@ def expand(
                     # them would label identical runs as distinct
                     # scenarios
                     schedules = ["auto"]
-                for schedule in schedules:
+                # payload octaves only for ops whose regime IS the
+                # payload (the hierarchical all-reduce crossover)
+                payloads: List[Optional[int]] = (
+                    list(payload_octaves)
+                    if op is not None and op.accepts_payload
+                    else [None]
+                )
+                for schedule, payload_kb in (
+                    (s, p) for s in schedules for p in payloads
+                ):
                     cell = CellSpec(
                         op=str(op_token),
                         mesh=full_mesh,
                         dtype=canonical or str(dtype_token),
                         schedule=str(schedule),
+                        payload_kb=payload_kb,
                     )
                     if cell.cell_id in seen:
                         # alias dtype tokens ("bf16" + "bfloat16") and
@@ -727,6 +791,55 @@ def _run_training_step(cell: CellSpec, iters: int, timer) -> CellResult:
     )
 
 
+def _run_hier_allreduce(cell: CellSpec, iters: int, timer) -> CellResult:
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.parallel import autotune
+    from activemonitor_tpu.parallel.partition import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _cell_mesh(cell)
+    sizes = dict(cell.mesh)
+    n_dcn, n_ici = sizes["dcn"], sizes["ici"]
+    n = n_dcn * n_ici
+    dt = jnp.dtype(cell.dtype)
+    payload_kb = cell.payload_kb or DEFAULT_PAYLOADS_KB[0]
+    # per-shard payload ≈ the cell's octave; rows divide n so the
+    # two-level chunking stays static-shaped
+    cols = 8
+    rows = max(n, (payload_kb * 1024 // dt.itemsize) // cols)
+    rows -= rows % n
+    shard_payload = rows * cols * dt.itemsize
+    plan = autotune.hier_plan("allreduce", n_dcn, n_ici, shard_payload, dt)
+    x = jnp.ones((rows * n, cols), dt)
+
+    fn = jax.jit(
+        shard_map(
+            lambda v: autotune.all_reduce(
+                v, ("dcn", "ici"), schedule="auto", n=(n_dcn, n_ici)
+            ),
+            mesh=mesh,
+            in_specs=P(("dcn", "ici"), None),
+            out_specs=P(("dcn", "ici"), None),
+            check_vma=False,
+        )
+    )
+    seconds = _time_op(fn, (x,), iters, timer)
+    # one spelling with the probe's stdout evidence (hier_plan_label)
+    schedule = autotune.hier_plan_label(plan)
+    # analytic cost model: one add per element per tier pass plus the
+    # wire bytes in and out of HBM — comm-shaped, so the roofline stamp
+    # reads memory-bound (the honest verdict for a collective cell)
+    flops = float(x.size)
+    hbm = 2.0 * x.size * dt.itemsize
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm, schedule=schedule,
+        details={"hier_plan": plan},
+    )
+
+
 _RUNNERS: Dict[str, Callable] = {
     "flash": _run_flash,
     "ring": _run_ring,
@@ -734,6 +847,7 @@ _RUNNERS: Dict[str, Callable] = {
     "pipeline": _run_pipeline,
     "decode": _run_decode,
     "training-step": _run_training_step,
+    "hier-allreduce": _run_hier_allreduce,
 }
 
 
@@ -985,6 +1099,8 @@ class MatrixObservatory:
             # the evidence itself, not in lost stderr scrollback)
             "interpret_mode": interpret_mode,
         }
+        if cell.payload_kb is not None:
+            entry["payload_kb"] = cell.payload_kb
         if fallback_reason:
             entry["fallback_reason"] = fallback_reason
         if result.status != STATUS_OK:

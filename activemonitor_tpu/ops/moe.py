@@ -27,6 +27,7 @@ builtin, bitwise-identical to before).
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Dict
 
@@ -34,17 +35,19 @@ import jax
 import jax.numpy as jnp
 from activemonitor_tpu.parallel.partition import (
     match_partition_rules,
+    resolve_tiers,
     shard_map,
     spec_axes,
 )
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def moe_partition_rules(axis: str = "ep"):
+def moe_partition_rules(axis="ep"):
     """Default rules for the expert-parallel pytree: the router
     replicates, expert weights split their leading (expert) dim over
     ``axis``, and the token tensor splits its token dim (position 0 in
-    the default [T, D] layout) over the same axis."""
+    the default [T, D] layout) over the same axis. ``axis`` may be a
+    tuple of mesh axes — the two-tier ("dcn", "ici") expert layout."""
     return (
         ("^router$", P(None, None)),
         (r"^w_(up|down)$", P(axis, None, None)),
@@ -81,21 +84,27 @@ def moe_ffn_reference(params: Dict, x: jax.Array) -> jax.Array:
     return chosen * gate
 
 
-def _token_dim(spec: P, axis: str, ndim: int) -> int:
-    """The dimension the resolved spec shards over ``axis`` — the
+def _entry_covers(entry, axes: tuple) -> bool:
+    """True when one spec ENTRY shards its dim over every axis in
+    ``axes`` (a bare name for a single axis, or a tuple entry carrying
+    them all — the two-tier layout)."""
+    named = (
+        set(entry) if isinstance(entry, (tuple, list))
+        else {entry} if entry is not None else set()
+    )
+    return set(axes) <= named
+
+
+def _token_dim(spec: P, axes: tuple, ndim: int) -> int:
+    """The dimension the resolved spec shards over ``axes`` — the
     gather/scatter dimension. Derived, not hard-coded: a rules dict
     that re-meshes the token layout moves the scatter with it."""
     entries = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
-    hits = [
-        d
-        for d, entry in enumerate(entries)
-        if entry == axis
-        or (isinstance(entry, (tuple, list)) and axis in entry)
-    ]
+    hits = [d for d, entry in enumerate(entries) if _entry_covers(entry, axes)]
     if len(hits) != 1:
         raise ValueError(
             f"resolved token spec {spec} must shard exactly one dim over "
-            f"{axis!r} (found {len(hits)})"
+            f"{axes if len(axes) > 1 else axes[0]!r} (found {len(hits)})"
         )
     return hits[0]
 
@@ -107,21 +116,31 @@ def moe_ffn_expert_parallel(
     (which dim that is comes from the resolved rules — position 0 of
     the default 2D layout); experts sharded the same way. Leading dims
     beyond the sharded one are replicated batch dims. Returns an array
-    shaped and sharded like x."""
-    n = mesh.shape[axis]
+    shaped and sharded like x.
+
+    On a two-tier ("dcn", "ici") mesh that carries the tiers instead
+    of ``axis`` (``parallel/partition.resolve_tiers``), experts span
+    both tiers dcn-major and the token gather dispatches the
+    HIERARCHICAL composition (``autotune.all_gather`` over the axis
+    pair: slice gather over ICI, cross-slice over DCN) — zero
+    call-site changes."""
+    axes, _tier_reason = resolve_tiers(mesh, axis)
+    axis_token = axes[0] if len(axes) == 1 else axes
+    tier_n = tuple(mesh.shape[a] for a in axes)
+    n = math.prod(tier_n)
     n_experts = params["router"].shape[1]
     if n_experts % n:
         raise ValueError(f"{n_experts} experts do not split over {n} devices")
     resolved = match_partition_rules(
-        rules if rules is not None else moe_partition_rules(axis),
+        rules if rules is not None else moe_partition_rules(axis_token),
         {**params, "x": x},
         mesh=mesh,
     )
     x_spec = resolved["x"]
-    if axis not in spec_axes(x_spec):
+    if not set(axes) <= spec_axes(x_spec):
         raise ValueError(
             f"resolved spec for the token tensor ({x_spec}) does not "
-            f"shard over {axis!r}"
+            f"shard over {axis_token!r}"
         )
     # the dispatch math below indexes w_up[e]/w_down[e] as THIS shard's
     # local experts and computes router logits identically everywhere —
@@ -131,21 +150,18 @@ def moe_ffn_expert_parallel(
     for name in ("w_up", "w_down"):
         w_spec = tuple(resolved[name])
         leading = w_spec[0] if w_spec else None
-        if not (
-            leading == axis
-            or (isinstance(leading, (tuple, list)) and axis in leading)
-        ):
+        if not _entry_covers(leading, axes):
             raise ValueError(
                 f"resolved spec for {name!r} ({resolved[name]}) must "
-                f"shard the leading (expert) dim over {axis!r}"
+                f"shard the leading (expert) dim over {axis_token!r}"
             )
-    if axis in spec_axes(resolved["router"]):
+    if spec_axes(resolved["router"]) & set(axes):
         raise ValueError(
             f"resolved spec for 'router' ({resolved['router']}) must "
-            f"not shard over {axis!r} — every shard routes the full "
-            "token set"
+            f"not shard over {axis_token!r} — every shard routes the "
+            "full token set"
         )
-    token_dim = _token_dim(x_spec, axis, x.ndim)
+    token_dim = _token_dim(x_spec, axes, x.ndim)
     if x.shape[token_dim] % n:
         raise ValueError(
             f"{x.shape[token_dim]} tokens do not shard over {n} devices"
@@ -162,18 +178,22 @@ def moe_ffn_expert_parallel(
         check_vma=False,
     )
     def run(router, w_up, w_down, x_shard):
-        my_rank = jax.lax.axis_index(axis)
+        my_rank = jax.lax.axis_index(axis_token)
         # dispatch: every device sees all tokens — the tuned surface
         # picks the gather schedule per payload octave (dim-0 token
         # layouts; a derived token dim elsewhere rides the XLA builtin,
-        # which gathers any dimension)
+        # which gathers any dimension). Tuple axes dispatch the
+        # hierarchical gather with per-tier winners.
         from activemonitor_tpu.parallel import autotune
 
         if token_dim == 0:
-            tokens = autotune.all_gather(x_shard, axis, schedule="auto", n=n)
+            tokens = autotune.all_gather(
+                x_shard, axis_token, schedule="auto",
+                n=tier_n if len(axes) > 1 else n,
+            )
         else:
             tokens = jax.lax.all_gather(
-                x_shard, axis, axis=token_dim, tiled=True
+                x_shard, axis_token, axis=token_dim, tiled=True
             )
         logits = tokens @ router
         expert = jnp.argmax(logits, axis=-1)
@@ -188,9 +208,10 @@ def moe_ffn_expert_parallel(
         # each token's output exists on exactly one device: the
         # scatter-sum both combines and re-shards back to the token
         # owners, along the dim the RESOLVED spec shards (derived above
-        # — never a hard-coded 0)
+        # — never a hard-coded 0); tuple axes scatter dcn-major, the
+        # same linearization the gather and the P(axes) layout use
         return jax.lax.psum_scatter(
-            out, axis, scatter_dimension=token_dim, tiled=True
+            out, axis_token, scatter_dimension=token_dim, tiled=True
         )
 
     return run(params["router"], params["w_up"], params["w_down"], x)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from activemonitor_tpu.parallel.partition import (
     match_partition_rules,
+    resolve_tiers,
     shard_map,
 )
 from jax.sharding import Mesh, PartitionSpec as P
@@ -141,8 +142,27 @@ def pipeline_forward_blocks(
     (default ``"auto"``: the tuned decision table picks the schedule
     per payload octave, falling back to the bitwise-identical XLA psum
     when nothing is tuned for this axis size).
+
+    On a two-tier ("dcn", "ici") mesh that carries the tiers instead
+    of ``axis`` (``parallel/partition.resolve_tiers``), the stage ring
+    linearizes over both tiers dcn-major (the inter-stage ppermute
+    rides an axis pair) and the output combine dispatches the
+    HIERARCHICAL all-reduce with per-tier tuned winners — zero
+    call-site changes.
     """
-    n_stages = mesh.shape[axis]
+    stage_axes, _tier_reason = resolve_tiers(mesh, axis)
+    axis = stage_axes[0] if len(stage_axes) == 1 else stage_axes
+    if len(stage_axes) > 1 and allreduce_schedule not in ("auto", "xla"):
+        # a flat zoo token names a single-tier schedule; silently
+        # downgrading it to "auto" would attribute measurements to a
+        # schedule that never ran (the resolve_grad_sync discipline)
+        raise ValueError(
+            f"allreduce_schedule {allreduce_schedule!r} is a flat "
+            "schedule token; the two-tier combine takes auto/xla"
+        )
+    n_stages = 1
+    for a in stage_axes:
+        n_stages *= mesh.shape[a]
     batch = x.shape[0]
     m = num_microbatches or n_stages
     if batch % m:
@@ -182,7 +202,7 @@ def pipeline_forward_blocks(
         in_specs=(io_specs["layers"], io_specs["micro"]),
         out_specs=io_specs["out"],
         check_vma=False,
-        axis_names=frozenset({axis}) if composed else frozenset(),
+        axis_names=frozenset(stage_axes) if composed else frozenset(),
     )
     def pipelined(local_layers, micro_all):
         # local_layers leaves: [layers_per_stage, ...]; micro_all: [M, mb, S, D]
@@ -247,8 +267,16 @@ def pipeline_forward_blocks(
         from activemonitor_tpu.parallel import autotune
 
         is_last = (stage == n_stages - 1).astype(outputs.dtype)
+        # on a two-tier stage ring the combine reduces over the axis
+        # PAIR — the hierarchical dispatch (per-tier n sizes required;
+        # flat zoo tokens were rejected up front)
+        combine_n = (
+            tuple(mesh.shape[a] for a in stage_axes)
+            if len(stage_axes) > 1 else n_stages
+        )
         return autotune.all_reduce(
-            outputs * is_last, axis, schedule=allreduce_schedule, n=n_stages
+            outputs * is_last, axis, schedule=allreduce_schedule,
+            n=combine_n,
         )
 
     out = pipelined(stacked_layers, micro)  # [M, mb, S, D]

@@ -1,8 +1,9 @@
 """Device mesh + timed collective helpers (XLA builtins in
-``collectives``, the explicit ppermute schedule zoo in ``schedules``,
-the message-size autotuner over both in ``autotune``) plus the one
-sharding surface (regex partition rules + the single shard_map entry
-point in ``partition``)."""
+``collectives``, the explicit ppermute schedule zoo — flat AND
+hierarchical DCN×ICI compositions — in ``schedules``, the tier-keyed
+message-size autotuner over both in ``autotune``) plus the one
+sharding surface (regex partition rules, topology-tier resolution,
+and the single shard_map entry point in ``partition``)."""
 
 from activemonitor_tpu.parallel.collectives import (
     CollectiveResult,
@@ -23,6 +24,7 @@ from activemonitor_tpu.parallel.partition import (
     make_shard_fns,
     match_partition_rules,
     named_tree_map,
+    resolve_tiers,
     shard_tree,
     validate_rules,
 )
@@ -32,6 +34,7 @@ from activemonitor_tpu.parallel.schedules import (
     all_reduce_recdouble_bandwidth,
     all_reduce_rsag_bandwidth,
     all_reduce_tree_bandwidth,
+    hier_all_reduce_bandwidth,
 )
 
 __all__ = [
@@ -46,6 +49,7 @@ __all__ = [
     "all_to_all_bandwidth",
     "best_2d_shape",
     "device_info",
+    "hier_all_reduce_bandwidth",
     "make_1d_mesh",
     "make_2d_mesh",
     "make_gather_fns",
@@ -54,6 +58,7 @@ __all__ = [
     "named_tree_map",
     "ppermute_ring_bandwidth",
     "reduce_scatter_bandwidth",
+    "resolve_tiers",
     "shard_tree",
     "validate_rules",
 ]

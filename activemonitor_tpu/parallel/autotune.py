@@ -11,16 +11,27 @@ Layers:
 
 - ``record()`` / ``lookup()`` — the in-process decision table. Keys
   bucket payload bytes by powers of two (one decision per octave, so a
-  64 MB tuning point serves 48..96 MB gradients).
+  64 MB tuning point serves 48..96 MB gradients), and carry a
+  **topology tier** ("ici" — the default, every flat decision — or
+  "dcn"): the same payload wants different schedules on a fast
+  intra-slice link than on the slow cross-slice one.
 - ``tune()`` — run every schedule across a payload grid on a live mesh
   and record winners. The measurement function is injectable so unit
   tests script fake timings and watch the decision flip across the
   crossover without hardware.
+- ``tune_hierarchical()`` / ``latency_threshold()`` — tune BOTH tiers
+  of a ("dcn", "ici") mesh, race the hierarchical bandwidth vs latency
+  compositions (and the flat single-level psum), and record the
+  payload threshold below which the latency path wins — the
+  LL-protocol-style small-message crossover.
 - ``crossover_points()`` — where the winner changes along a swept
   grid (the per-topology crossovers the sweep probe reports).
 - ``all_reduce()`` / ``all_gather()`` — the tuned surface for
   shard_map bodies: ``schedule="auto"`` consults the table at trace
   time (decisions bake into the jitted computation; retune → retrace).
+  Passing a TUPLE of axis names ("dcn", "ici") dispatches the
+  hierarchical composition with per-tier winners (:func:`hier_plan`),
+  falling back to the flat path on degenerate single-slice meshes.
 
 No wall clocks here: the table stores busbw handed in by callers, so
 fake-timing tests stay deterministic.
@@ -45,6 +56,9 @@ class TuneKey:
     axis_n: int  # devices along the reduced mesh axis
     bucket: int  # floor(log2(payload bytes))
     dtype: str  # canonical dtype name ("bfloat16", "float32", ...)
+    # topology tier the decision was measured on: "ici" (flat/default
+    # — every pre-hierarchy cell) or "dcn" (the slow cross-slice tier)
+    tier: str = "ici"
 
 
 @dataclass
@@ -58,6 +72,25 @@ class Decision:
 
 _TABLE: Dict[TuneKey, Decision] = {}
 
+# tuned latency-path thresholds for the hierarchical compositions:
+# payloads strictly below the threshold ride the latency path. Keyed
+# like the decision table minus the bucket (the threshold IS the
+# bucket boundary); untuned topologies ride the LL-style default.
+_LATENCY_THRESHOLDS: Dict["HierTuneKey", int] = {}
+
+# untuned default for the small-message crossover (the NCCL LL regime
+# sits in the tens of KB on fast links; a measured threshold from
+# tune_hierarchical always replaces this)
+DEFAULT_LATENCY_THRESHOLD_BYTES = 64 * 1024
+
+
+@dataclass(frozen=True)
+class HierTuneKey:
+    collective: str
+    n_dcn: int
+    n_ici: int
+    dtype: str
+
 
 def payload_bucket(payload_bytes: int) -> int:
     """Power-of-two octave of the payload: one decision per doubling."""
@@ -66,6 +99,7 @@ def payload_bucket(payload_bytes: int) -> int:
 
 def clear() -> None:
     _TABLE.clear()
+    _LATENCY_THRESHOLDS.clear()
 
 
 def record(
@@ -74,6 +108,7 @@ def record(
     payload_bytes: int,
     dtype,
     busbw_by_schedule: Dict[str, float],
+    tier: str = "ici",
 ) -> Decision:
     """Fold one measurement point into the table and return the
     decision. ``busbw_by_schedule`` maps schedule token → busbw GB/s
@@ -94,7 +129,7 @@ def record(
     )
     key = TuneKey(
         collective, int(axis_n), payload_bucket(payload_bytes),
-        jnp.dtype(dtype).name,
+        jnp.dtype(dtype).name, str(tier),
     )
     _TABLE[key] = decision
     return decision
@@ -106,23 +141,26 @@ def lookup(
     payload_bytes: int,
     dtype,
     max_distance: int = 2,
+    tier: str = "ici",
 ) -> Optional[str]:
     """Winning schedule for the exact bucket, else the nearest tuned
     bucket within ``max_distance`` octaves for the same (collective,
-    axis, dtype) — a 48 MB gradient should ride the 64 MB decision,
-    but a 4 KB scalar-ish payload must NOT ride a 64 MB cell from the
-    wrong side of the crossover; past the distance bound the caller
-    falls back to the XLA builtin."""
+    axis, dtype, tier) — a 48 MB gradient should ride the 64 MB
+    decision, but a 4 KB scalar-ish payload must NOT ride a 64 MB cell
+    from the wrong side of the crossover; past the distance bound the
+    caller falls back to the XLA builtin. Tiers never cross-serve: a
+    fast-ICI decision says nothing about the slow DCN link."""
     name = jnp.dtype(dtype).name
     bucket = payload_bucket(payload_bytes)
-    exact = _TABLE.get(TuneKey(collective, int(axis_n), bucket, name))
+    exact = _TABLE.get(TuneKey(collective, int(axis_n), bucket, name, tier))
     if exact is not None:
         return exact.schedule
     near = [
         k
         for k in _TABLE
         if k.collective == collective and k.axis_n == int(axis_n)
-        and k.dtype == name and abs(k.bucket - bucket) <= max_distance
+        and k.dtype == name and k.tier == tier
+        and abs(k.bucket - bucket) <= max_distance
     ]
     if not near:
         return None
@@ -130,6 +168,29 @@ def lookup(
     # decision (the latency-safe side of the crossover)
     best = min(near, key=lambda k: (abs(k.bucket - bucket), k.bucket))
     return _TABLE[best].schedule
+
+
+def record_latency_threshold(
+    collective: str, n_dcn: int, n_ici: int, dtype, threshold_bytes: int
+) -> None:
+    """Record the tuned small-message threshold for a two-tier
+    topology: payloads strictly below it ride the latency composition."""
+    if threshold_bytes < 0:
+        raise ValueError(
+            f"threshold must be >= 0 bytes, got {threshold_bytes}"
+        )
+    _LATENCY_THRESHOLDS[
+        HierTuneKey(collective, int(n_dcn), int(n_ici), jnp.dtype(dtype).name)
+    ] = int(threshold_bytes)
+
+
+def latency_threshold(collective: str, n_dcn: int, n_ici: int, dtype) -> int:
+    """The tuned latency-path threshold for this topology, or the
+    LL-style default when nothing is tuned."""
+    return _LATENCY_THRESHOLDS.get(
+        HierTuneKey(collective, int(n_dcn), int(n_ici), jnp.dtype(dtype).name),
+        DEFAULT_LATENCY_THRESHOLD_BYTES,
+    )
 
 
 def table_as_dict(keys: Optional[Sequence[TuneKey]] = None) -> dict:
@@ -144,9 +205,17 @@ def table_as_dict(keys: Optional[Sequence[TuneKey]] = None) -> dict:
     out: dict = {}
     for key, d in sorted(
         selected.items(),
-        key=lambda kv: (kv[0].collective, kv[0].axis_n, kv[0].bucket),
+        key=lambda kv: (
+            kv[0].collective, kv[0].tier, kv[0].axis_n, kv[0].bucket,
+        ),
     ):
-        out[f"{key.collective}/n{key.axis_n}/2^{key.bucket}B/{key.dtype}"] = {
+        # the flat/"ici" spelling predates tiers: only non-default
+        # tiers grow a suffix, so pre-hierarchy readers keep parsing
+        tier_suffix = "" if key.tier == "ici" else f"@{key.tier}"
+        out[
+            f"{key.collective}/n{key.axis_n}/2^{key.bucket}B/"
+            f"{key.dtype}{tier_suffix}"
+        ] = {
             "schedule": d.schedule,
             "busbw_gbps": round(d.busbw_gbps, 3),
             "runner_up": d.runner_up,
@@ -198,10 +267,13 @@ def _default_benches() -> Dict[Tuple[str, str], Callable]:
     }
 
 
-# log-spaced payload grid ≈ 256 KB → 256 MB — the regimes the NCCL
-# paper's crossovers live in. Single source of truth: the sweep probe
-# re-exports this; edit it here.
-DEFAULT_SWEEP_SIZES_MB = (0.25, 1.0, 4.0, 16.0, 64.0, 256.0)
+# log-spaced payload grid ≈ 4 KB → 256 MB — the regimes the NCCL
+# paper's crossovers live in, now reaching DOWN into the LL/latency
+# regime (the old 256 KB floor meant the octave table bottomed out
+# above the small-message crossover, so the latency path could never
+# be measured into a decision). Single source of truth: the sweep
+# probe re-exports this; edit it here.
+DEFAULT_SWEEP_SIZES_MB = (0.004, 0.016, 0.064, 0.25, 1.0, 4.0, 16.0, 64.0, 256.0)
 
 
 @dataclass
@@ -222,14 +294,16 @@ def tune(
     dtype=jnp.bfloat16,
     iters: int = 3,
     bench: Optional[Callable] = None,
+    tier: str = "ici",
 ) -> TuneRun:
     """Measure every schedule at every payload size and record winners.
 
     ``bench(collective, schedule, mesh, axis, size_mb, dtype, iters)``
     must return an object with ``busbw_gbps`` and ``payload_bytes``
     (CollectiveResult shape) — tests inject a fake to script timings.
-    The decision table is updated as a side effect; the returned
-    ``TuneRun.keys`` identify exactly the cells this run wrote."""
+    The decision table is updated as a side effect (under ``tier``,
+    "ici" for every flat tune); the returned ``TuneRun.keys`` identify
+    exactly the cells this run wrote."""
     schedules_for = {
         "allreduce": zoo.ALL_REDUCE_SCHEDULES,
         "allgather": zoo.ALL_GATHER_SCHEDULES,
@@ -262,15 +336,121 @@ def tune(
                 result = run_one(collective, schedule, size_mb)
                 busbw[schedule] = result.busbw_gbps
                 payload = result.payload_bytes
-            record(collective, n, payload, dtype, busbw)
+            record(collective, n, payload, dtype, busbw, tier=tier)
             keys.append(
                 TuneKey(
                     collective, int(n), payload_bucket(payload),
-                    jnp.dtype(dtype).name,
+                    jnp.dtype(dtype).name, str(tier),
                 )
             )
             raw[collective][size_mb] = busbw
     return TuneRun(results=raw, keys=keys)
+
+
+@dataclass
+class HierTuneRun:
+    """One tune_hierarchical() invocation: the per-tier flat tunes,
+    the bandwidth/latency/flat composition race, and the recorded
+    latency-path threshold — the evidence bench.py stamps as
+    ``hierarchical_autotune``."""
+
+    tier_runs: Dict[str, TuneRun]  # "dcn" / "ici"
+    variant_results: Dict[float, Dict[str, float]]  # size_mb → busbw
+    threshold_bytes: int
+    threshold_source: str  # "crossover" | "latency-everywhere" | ...
+    keys: List[TuneKey]
+
+
+def tune_hierarchical(
+    mesh,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    sizes_mb: Sequence[float] = DEFAULT_SWEEP_SIZES_MB,
+    dtype=jnp.bfloat16,
+    iters: int = 3,
+    bench: Optional[Callable] = None,
+    hier_bench: Optional[Callable] = None,
+) -> HierTuneRun:
+    """Tune a two-tier ("dcn", "ici") mesh end to end.
+
+    1. Flat-tunes EACH tier of the mesh separately (``tune`` over the
+       dcn axis records under ``tier="dcn"``, the ici axis under
+       ``tier="ici"``) so :func:`hier_plan` has per-tier winners.
+    2. Races the hierarchical bandwidth vs latency compositions (and
+       the flat single-level psum baseline) across the payload grid
+       and records the threshold below which the latency path wins —
+       the LL-protocol small-message crossover,
+       :func:`latency_threshold`.
+
+    ``bench`` is the flat per-tier injectable (``tune`` contract);
+    ``hier_bench(variant, mesh, dcn_axis, ici_axis, size_mb, dtype,
+    iters)`` returns a CollectiveResult-shaped object for the composed
+    paths ("bandwidth" | "latency" | "flat") — tests script both to
+    prove the decision flip without hardware."""
+    from activemonitor_tpu.parallel import schedules as zoo
+
+    n_dcn = mesh.shape[dcn_axis]
+    n_ici = mesh.shape[ici_axis]
+    tier_runs: Dict[str, TuneRun] = {}
+    keys: List[TuneKey] = []
+    for tier, axis, n in (("dcn", dcn_axis, n_dcn), ("ici", ici_axis, n_ici)):
+        if n < 2:
+            continue  # nothing to race on a singleton tier
+        run = tune(
+            mesh, axis=axis, collectives=("allreduce",), sizes_mb=sizes_mb,
+            dtype=dtype, iters=iters, bench=bench, tier=tier,
+        )
+        tier_runs[tier] = run
+        keys.extend(run.keys)
+
+    def run_hier(variant, size_mb):
+        if hier_bench is not None:
+            return hier_bench(
+                variant, mesh, dcn_axis, ici_axis, size_mb, dtype, iters
+            )
+        return zoo.hier_all_reduce_bandwidth(
+            mesh, size_mb=size_mb, dtype=dtype, iters=iters,
+            dcn_axis=dcn_axis, ici_axis=ici_axis, variant=variant,
+        )
+
+    variant_results: Dict[float, Dict[str, float]] = {}
+    payload_of: Dict[float, int] = {}
+    for size_mb in sizes_mb:
+        row: Dict[str, float] = {}
+        for variant in ("bandwidth", "latency", "flat"):
+            result = run_hier(variant, size_mb)
+            row[variant] = result.busbw_gbps
+            payload_of[size_mb] = result.payload_bytes
+        variant_results[size_mb] = row
+
+    # the threshold: payloads below the smallest measured payload where
+    # the bandwidth composition catches the latency one ride the
+    # latency path. Latency winning the whole grid pushes the
+    # threshold past the largest payload; bandwidth winning everywhere
+    # (including the floor) leaves only the unmeasured region below
+    # the floor on the latency side — the α-dominated regime the floor
+    # can't see, where fewer rounds is the safe default.
+    ordered = sorted(variant_results)
+    threshold = None
+    source = "crossover"
+    for size_mb in ordered:
+        row = variant_results[size_mb]
+        if row["bandwidth"] >= row["latency"]:
+            threshold = payload_of[size_mb]
+            if size_mb == ordered[0]:
+                source = "bandwidth-everywhere"
+            break
+    if threshold is None:
+        threshold = 2 * payload_of[ordered[-1]]
+        source = "latency-everywhere"
+    record_latency_threshold("allreduce", n_dcn, n_ici, dtype, threshold)
+    return HierTuneRun(
+        tier_runs=tier_runs,
+        variant_results=variant_results,
+        threshold_bytes=int(threshold),
+        threshold_source=source,
+        keys=keys,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -288,14 +468,235 @@ _ALL_GATHER_IMPL = {
     "recdouble": zoo.all_gather_recdouble,
 }
 
+# schedule tokens the hierarchical surface accepts: "auto" consults
+# threshold + per-tier tables, "xla" is the joint psum/all_gather
+# builtin, the variants force one composition
+HIER_SCHEDULES = ("auto", "xla", "bandwidth", "latency")
 
-def all_reduce(x, axis_name: str, schedule: str = "auto", n: int | None = None):
+
+def _tier_sizes(axes, n):
+    """Per-axis sizes for a tuple-axis call: ``n`` may be a matching
+    tuple of sizes or None (resolved from the trace's axis frames)."""
+    if n is None:
+        return tuple(axis_size(a) for a in axes)
+    if isinstance(n, (tuple, list)):
+        if len(n) != len(axes):
+            raise ValueError(
+                f"n {tuple(n)} does not match axes {tuple(axes)}"
+            )
+        return tuple(int(v) for v in n)
+    raise ValueError(
+        f"a tuple-axis collective needs a tuple n per axis, got {n!r}"
+    )
+
+
+def _normalize_axes(axis_name, n):
+    """Shared tuple-axis handling for the tuned surfaces: returns
+    ``(axis_name, n, tiers)`` where ``tiers`` is None on the flat path
+    (a bare axis, or a 1-tuple unwrapped to one) and the per-tier
+    ``(n_dcn, n_ici)`` sizes for a 2-tuple. 3+ tiers are an error."""
+    if not isinstance(axis_name, (tuple, list)):
+        return axis_name, n, None
+    axes = tuple(axis_name)
+    if len(axes) == 1:
+        if n is not None and isinstance(n, (tuple, list)):
+            n = n[0]
+        return axes[0], n, None
+    if len(axes) == 2:
+        return axes, None, _tier_sizes(axes, n)
+    raise ValueError(
+        f"hierarchical dispatch takes exactly two tiers, got {axes}"
+    )
+
+
+def hier_plan(
+    collective: str,
+    n_dcn: int,
+    n_ici: int,
+    payload_bytes: int,
+    dtype,
+    schedule: str = "auto",
+) -> dict:
+    """The per-tier decision for one hierarchical dispatch — which
+    path (latency vs bandwidth vs flat fallback) and which schedule
+    each tier rides, with the threshold that decided it. This dict IS
+    the evidence surface: the training-step probe exports it in its
+    stdout contract and bench.py stamps it into the artifact."""
+    if schedule not in HIER_SCHEDULES:
+        raise ValueError(
+            f"unknown hierarchical schedule {schedule!r}; pick from "
+            f"{HIER_SCHEDULES}"
+        )
+    base = {"n_dcn": int(n_dcn), "n_ici": int(n_ici),
+            "payload_bytes": int(payload_bytes)}
+    if n_dcn <= 1:
+        return {
+            **base,
+            "path": "flat",
+            "reason": "degenerate single-slice mesh (dcn=1): flat ici path",
+        }
+    threshold = latency_threshold(collective, n_dcn, n_ici, dtype)
+    if schedule == "auto":
+        variant = "latency" if payload_bytes < threshold else "bandwidth"
+    elif schedule == "xla":
+        return {**base, "path": "hierarchical", "variant": "xla",
+                "threshold_bytes": threshold}
+    else:
+        variant = schedule
+    if variant == "latency":
+        ici_schedule = (
+            lookup(collective, n_ici, payload_bytes, dtype, tier="ici")
+            or "recdouble"
+        )
+        dcn_schedule = (
+            lookup(collective, n_dcn, payload_bytes, dtype, tier="dcn")
+            or "recdouble"
+        )
+    else:
+        # the bandwidth composition's ICI phases are the rs/ag ring by
+        # construction; only the scattered DCN exchange has a choice
+        ici_schedule = "rsag"
+        chunk = max(1, int(payload_bytes) // max(1, n_ici))
+        dcn_schedule = (
+            lookup(collective, n_dcn, chunk, dtype, tier="dcn")
+            or "recdouble"
+        )
+    return {
+        **base,
+        "path": "hierarchical",
+        "variant": variant,
+        "ici_schedule": ici_schedule,
+        "dcn_schedule": dcn_schedule,
+        "threshold_bytes": threshold,
+    }
+
+
+def hier_plan_label(plan: dict) -> str:
+    """One canonical spelling of a :func:`hier_plan` decision for
+    evidence surfaces (probe details, matrix schedule stamps) — built
+    here so the probe stdout spelling and the bench/matrix artifact
+    spelling cannot drift apart."""
+    if plan.get("path") == "flat":
+        return "hier-flat(dcn=1)"
+    if plan.get("variant") == "xla":
+        return "hier/xla"
+    return (
+        f"hier/{plan['variant']}"
+        f"(dcn={plan['dcn_schedule']},ici={plan['ici_schedule']})"
+    )
+
+
+def hier_all_reduce(
+    x,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    schedule: str = "auto",
+    n_dcn: int | None = None,
+    n_ici: int | None = None,
+):
+    """The tuned two-tier all-reduce surface, for shard_map bodies
+    manual over both tiers. ``"auto"`` consults the tuned latency
+    threshold (below → the latency composition, above → the bandwidth
+    one) and the per-tier decision tables; degenerate single-slice
+    meshes fall back to the FLAT tuned surface over the ici axis —
+    bitwise the flat schedule, with the reason in :func:`hier_plan`."""
+    n_dcn = int(n_dcn) if n_dcn is not None else axis_size(dcn_axis)
+    n_ici = int(n_ici) if n_ici is not None else axis_size(ici_axis)
+    if schedule not in HIER_SCHEDULES:
+        raise ValueError(
+            f"unknown hierarchical schedule {schedule!r}; pick from "
+            f"{HIER_SCHEDULES}"
+        )
+    if n_dcn <= 1:
+        # degenerate single-slice: the flat tuned surface IS the
+        # composition (hier_plan records the reason)
+        return all_reduce(
+            x, ici_axis, schedule="xla" if schedule == "xla" else "auto",
+            n=n_ici,
+        )
+    if x.ndim == 0 or schedule == "xla":
+        # nothing to chunk on a scalar; "xla" is the joint builtin
+        return jax.lax.psum(x, (dcn_axis, ici_axis))
+    payload = x.size * jnp.dtype(x.dtype).itemsize
+    plan = hier_plan("allreduce", n_dcn, n_ici, payload, x.dtype, schedule)
+    if plan["variant"] == "latency":
+        return zoo.hier_all_reduce_latency(
+            x, dcn_axis, ici_axis, n_dcn, n_ici,
+            ici_schedule=plan["ici_schedule"],
+            dcn_schedule=plan["dcn_schedule"],
+        )
+    return zoo.hier_all_reduce(
+        x, dcn_axis, ici_axis, n_dcn, n_ici,
+        dcn_schedule=plan["dcn_schedule"],
+    )
+
+
+def hier_all_gather(
+    x,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    schedule: str = "auto",
+    n_dcn: int | None = None,
+    n_ici: int | None = None,
+):
+    """The tuned two-tier all-gather surface: per-tier winners from
+    the tier-keyed "allgather" tables (default: the ring), dcn-major
+    tiled output like ``lax.all_gather(x, (dcn, ici), tiled=True)``.
+    Unlike all-reduce, the gather has no latency/bandwidth composition
+    variants (both tiers always gather once), so only "auto"/"xla"
+    are accepted — a forced variant must error, not silently auto."""
+    n_dcn = int(n_dcn) if n_dcn is not None else axis_size(dcn_axis)
+    n_ici = int(n_ici) if n_ici is not None else axis_size(ici_axis)
+    if schedule not in ("auto", "xla"):
+        raise ValueError(
+            f"unknown hierarchical all-gather schedule {schedule!r}; "
+            "the two-tier gather takes auto/xla (it has no "
+            "latency/bandwidth variants)"
+        )
+    if n_dcn <= 1:
+        return all_gather(
+            x, ici_axis, schedule="xla" if schedule == "xla" else "auto",
+            n=n_ici,
+        )
+    if x.ndim == 0 or schedule == "xla":
+        return jax.lax.all_gather(x, (dcn_axis, ici_axis), tiled=True)
+    itemsize = jnp.dtype(x.dtype).itemsize
+    # allgather tables key on gathered-total payload per tier
+    ici_schedule = (
+        lookup("allgather", n_ici, x.size * itemsize * n_ici, x.dtype,
+               tier="ici")
+        or "ring"
+    )
+    dcn_schedule = (
+        lookup("allgather", n_dcn, x.size * itemsize * n_ici * n_dcn,
+               x.dtype, tier="dcn")
+        or "ring"
+    )
+    return zoo.hier_all_gather(
+        x, dcn_axis, ici_axis, n_dcn, n_ici,
+        ici_schedule=ici_schedule, dcn_schedule=dcn_schedule,
+    )
+
+
+def all_reduce(x, axis_name, schedule: str = "auto", n=None):
     """psum with a schedule knob, for shard_map bodies. ``"auto"``
     consults the decision table (trace-time: the choice bakes into the
     jitted computation) and falls back to the XLA builtin when nothing
     is tuned within 2 octaves of this (axis size, payload, dtype) —
     or when the input has no leading axis to chunk (scalars always
-    ride the builtin)."""
+    ride the builtin).
+
+    ``axis_name`` may be a TUPLE of two axis names (slow outer tier
+    first — the ("dcn", "ici") pair a two-tier mesh carries): the
+    reduction then dispatches the hierarchical composition through
+    :func:`hier_all_reduce` with per-tier tuned winners (``n``: a
+    matching tuple of sizes, or None)."""
+    axis_name, n, tiers = _normalize_axes(axis_name, n)
+    if tiers is not None:
+        return hier_all_reduce(
+            x, axis_name[0], axis_name[1], schedule=schedule,
+            n_dcn=tiers[0], n_ici=tiers[1],
+        )
     n = int(n) if n is not None else axis_size(axis_name)
     if schedule == "auto":
         if x.ndim == 0:
@@ -315,9 +716,17 @@ def all_reduce(x, axis_name: str, schedule: str = "auto", n: int | None = None):
     return impl(x, axis_name, n)
 
 
-def all_gather(x, axis_name: str, schedule: str = "auto", n: int | None = None):
+def all_gather(x, axis_name, schedule: str = "auto", n=None):
     """Tiled all-gather with a schedule knob (output [n·rows, ...] in
-    device order, like ``lax.all_gather(..., tiled=True)``)."""
+    device order, like ``lax.all_gather(..., tiled=True)``). A TUPLE
+    ``axis_name`` (slow tier first) dispatches the hierarchical gather
+    through :func:`hier_all_gather`, like :func:`all_reduce`."""
+    axis_name, n, tiers = _normalize_axes(axis_name, n)
+    if tiers is not None:
+        return hier_all_gather(
+            x, axis_name[0], axis_name[1], schedule=schedule,
+            n_dcn=tiers[0], n_ici=tiers[1],
+        )
     n = int(n) if n is not None else axis_size(axis_name)
     if schedule == "auto":
         if x.ndim == 0:

@@ -51,8 +51,13 @@ class CollectiveResult:
 
 def _payload(size_mb: float, dtype) -> tuple[int, int, int]:
     itemsize = jnp.dtype(dtype).itemsize
-    cols = 1024
-    rows = max(8, int(size_mb * 1e6 / itemsize) // cols)
+    elems = max(64, int(size_mb * 1e6 / itemsize))
+    # payloads >= 8K elements keep the historical [rows, 1024] shape;
+    # smaller ones narrow the row so the ~4KB latency-regime floor of
+    # the sweep grid measures ~4KB, not a silently clamped 16KB (the
+    # old max(8, ...) row floor under 1024 fixed cols)
+    cols = 1024 if elems >= 8 * 1024 else max(8, elems // 8)
+    rows = max(8, elems // cols)
     return rows, cols, rows * cols * itemsize
 
 

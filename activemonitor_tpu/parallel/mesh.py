@@ -94,6 +94,23 @@ def make_mesh(
     return Mesh(np.array(devices).reshape(tuple(shape)), tuple(axes))
 
 
+def make_synthetic_two_tier_mesh(
+    devices: Optional[Sequence] = None,
+) -> Optional[Mesh]:
+    """A single-process stand-in for a multislice topology: the flat
+    device set re-meshed into (2, n/2) ("dcn", "ici") tiers — what the
+    hierarchical collective cases/bench stamps measure when no real
+    cross-host tier exists (probes/dcn.py owns the real one). Returns
+    None when the set cannot form the shape (odd or < 4 devices), so
+    callers surface a structured skip naming {"dcn": 2, "ici": n//2}
+    instead of crashing — one rule, shared by every synthetic site."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n < 4 or n % 2:
+        return None
+    return Mesh(np.array(devices).reshape(2, n // 2), ("dcn", "ici"))
+
+
 def make_multihost_mesh(axes: Tuple[str, str] = ("dcn", "ici")) -> Mesh:
     """Hierarchical mesh for multi-host runs: the outer axis spans
     processes (hosts — traffic rides DCN between slices/hosts), the

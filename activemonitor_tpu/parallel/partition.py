@@ -175,6 +175,44 @@ def match_partition_rules(
     return named_tree_map(resolve, tree, sep=sep)
 
 
+# the canonical two-tier topology axes: slow cross-slice DCN outside,
+# fast intra-slice ICI inside (parallel/mesh.make_multihost_mesh order)
+TIER_AXES = ("dcn", "ici")
+
+
+def resolve_tiers(mesh: Mesh, axis: str) -> Tuple[Tuple[str, ...], str]:
+    """Map a logical collective axis onto the mesh's topology tiers.
+
+    The ops layer asks for a reduction/gather over a LOGICAL axis
+    ("data", "ep", "pp"); the answer depends on the mesh, not the call
+    site — this is the one rule that lets the hot paths dispatch
+    hierarchically on two-tier meshes with zero call-site changes:
+
+    - the mesh carries ``axis`` → ``((axis,), reason)``: the flat
+      path, as before.
+    - the mesh carries the ``("dcn", "ici")`` tier pair instead →
+      ``(("dcn", "ici"), "")``: the collective spans both tiers and
+      parallel/autotune dispatches the hierarchical composition.
+    - the tier pair with a degenerate single-slice dcn →
+      ``(("ici",), reason)``: flat over ici, the reason recorded.
+
+    A mesh carrying neither is a ValueError naming both spellings —
+    the same fail-early discipline as :func:`validate_rules`.
+    """
+    shape = dict(mesh.shape)
+    if axis in shape:
+        return (axis,), f"flat: mesh carries {axis!r}"
+    dcn, ici = TIER_AXES
+    if dcn in shape and ici in shape:
+        if shape[dcn] > 1:
+            return TIER_AXES, ""
+        return (ici,), "degenerate single-slice mesh (dcn=1): flat ici path"
+    raise ValueError(
+        f"mesh {shape} carries neither axis {axis!r} nor the "
+        f"{TIER_AXES} tier pair"
+    )
+
+
 def sharding_tree(specs, mesh: Mesh):
     """Spec tree → NamedSharding tree (validated against the mesh)."""
     validate_specs(specs, mesh)

@@ -31,6 +31,30 @@ bitwise where the schedule only moves data), and traced through the
 schedule sends exactly its theoretical round count (``theoretical_hops``)
 — asserted by tests, not asserted in comments.
 
+**Hierarchical (DCN×ICI) compositions** — real scale is two-tier: fast
+ICI inside a slice, slow DCN between slices (the topology-aware
+algorithm split Demystifying NCCL analyzes). Over a ``("dcn", "ici")``
+mesh:
+
+- :func:`hier_all_reduce` — the bandwidth path: intra-slice ring
+  reduce-scatter over ICI, inter-slice all-reduce of the scattered
+  1/n_ici shard over DCN (any zoo schedule, or the psum builtin), and
+  an intra-slice ring all-gather back. DCN carries only 1/n_ici of the
+  payload — the whole point of the hierarchy.
+- :func:`hier_all_reduce_latency` — the small-message path (the NCCL
+  LL-protocol insight): full-payload few-round schedules per tier
+  (recursive doubling / tree), no chunking — fewer rounds beat thinner
+  wires below the α/B crossover.
+- :func:`hier_all_gather` / :func:`hier_reduce_scatter` — the same
+  two-tier factoring for the gather/scatter family; gather output is
+  dcn-major (the ``P(("dcn", "ici"))`` layout).
+
+Each tier's hops are traced through the same ``_hop`` choke point and
+additionally logged per tier via ``_HOP_TIER_LOG``, so
+:func:`theoretical_hier_hops` is a per-tier contract, not prose. On a
+degenerate single-slice mesh (n_dcn == 1) the bandwidth composition IS
+the flat ``all_reduce_rsag`` — bitwise, by construction.
+
 Timed wrappers (``*_bandwidth``) reuse the chain-delta scaffold and
 ``CollectiveResult``/busbw accounting from parallel/collectives.py, so
 zoo numbers are directly comparable against the XLA baselines; the
@@ -55,11 +79,22 @@ from jax.sharding import Mesh
 ALL_REDUCE_SCHEDULES = ("xla", "rsag", "recdouble", "tree")
 ALL_GATHER_SCHEDULES = ("xla", "ring", "recdouble")
 
+# Hierarchical composition variants (the two sides of the LL-style
+# small-message crossover parallel/autotune tunes a threshold for).
+HIER_VARIANTS = ("bandwidth", "latency")
+
 # Test hook (the ops/ring_attention.py pattern): when set to a list,
 # every ppermute round a schedule issues appends (schedule_tag, round).
 # Schedules unroll python loops, so one traced application logs each
 # round individually and the log length IS the hop count.
 _HOP_LOG = None
+
+# Per-tier hook for the hierarchical compositions: appends
+# (axis_name, schedule_tag, round), so a test can count the ICI tier's
+# hops separately from the DCN tier's. Kept as a SECOND hook (not a
+# wider tuple in _HOP_LOG) so the PR-5/PR-8 hop-contract tests keep
+# their 2-tuple spelling.
+_HOP_TIER_LOG = None
 
 
 def _hop(x, axis_name, perm, tag, step):
@@ -67,6 +102,8 @@ def _hop(x, axis_name, perm, tag, step):
     hop counter sees every transfer a schedule issues."""
     if _HOP_LOG is not None:
         _HOP_LOG.append((tag, step))
+    if _HOP_TIER_LOG is not None:
+        _HOP_TIER_LOG.append((axis_name, tag, step))
     return jax.lax.ppermute(x, axis_name, perm)
 
 
@@ -107,6 +144,60 @@ def theoretical_hops(schedule: str, n: int, collective: str = "allreduce") -> in
 # ---------------------------------------------------------------------------
 
 
+def _pad_rows(x, multiple: int):
+    """Zero-pad the leading dim up to ``multiple`` (zeros are
+    psum-neutral). Returns (padded, original_rows, pad)."""
+    rows = x.shape[0]
+    pad = (-rows) % multiple
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+    return x, rows, pad
+
+
+def _ring_reduce_scatter(x, axis_name: str, n: int, tag: str):
+    """Ring reduce-scatter of ``x`` (rows divisible by n): n−1 rounds of
+    (rows/n)-chunks, accumulating; this device ends holding the fully
+    reduced chunk (idx + 1) mod n. The scatter half of the NCCL ring."""
+    chunk = x.shape[0] // n
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def take(j):
+        return jax.lax.dynamic_slice_in_dim(x, j * chunk, chunk, axis=0)
+
+    # after round s the arriving partial is of chunk (idx − s − 1)
+    # mod n; add the local copy and pass it on
+    buf = take(idx)
+    for s in range(n - 1):
+        buf = _hop(buf, axis_name, perm, tag, s)
+        buf = buf + take((idx - s - 1) % n)
+    return buf
+
+
+def _ring_all_gather_chunks(buf, axis_name: str, n: int, tag: str):
+    """Inverse of :func:`_ring_reduce_scatter`: ``buf`` is chunk
+    (idx + 1) mod n; n−1 more rotations rebuild the full [n·chunk, ...]
+    array on every device."""
+    chunk = buf.shape[0]
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((n * chunk,) + buf.shape[1:], buf.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(
+        out, buf, ((idx + 1) % n) * chunk, axis=0
+    )
+    # own reduced chunk is (idx + 1) mod n; each further round delivers
+    # chunk (idx − s) mod n from the left neighbor
+    cur = buf
+    for s in range(n - 1):
+        cur = _hop(cur, axis_name, perm, tag, s)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, cur, ((idx - s) % n) * chunk, axis=0
+        )
+    return out
+
+
 def all_reduce_rsag(x, axis_name: str, n: int | None = None):
     """Ring reduce-scatter + all-gather (the NCCL ring decomposition).
 
@@ -120,37 +211,9 @@ def all_reduce_rsag(x, axis_name: str, n: int | None = None):
     n = _resolve_n(axis_name, n)
     if n == 1:
         return x
-    rows = x.shape[0]
-    pad = (-rows) % n
-    if pad:
-        x = jnp.concatenate(
-            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
-        )
-    chunk = x.shape[0] // n
-    idx = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def take(j):
-        return jax.lax.dynamic_slice_in_dim(x, j * chunk, chunk, axis=0)
-
-    # reduce-scatter: after round s the arriving partial is of chunk
-    # (idx − s − 1) mod n; add the local copy and pass it on
-    buf = take(idx)
-    for s in range(n - 1):
-        buf = _hop(buf, axis_name, perm, "rsag-rs", s)
-        buf = buf + take((idx - s - 1) % n)
-    # all-gather: own reduced chunk is (idx + 1) mod n; each further
-    # round delivers chunk (idx − s) mod n from the left neighbor
-    out = jnp.zeros_like(x)
-    out = jax.lax.dynamic_update_slice_in_dim(
-        out, buf, ((idx + 1) % n) * chunk, axis=0
-    )
-    cur = buf
-    for s in range(n - 1):
-        cur = _hop(cur, axis_name, perm, "rsag-ag", s)
-        out = jax.lax.dynamic_update_slice_in_dim(
-            out, cur, ((idx - s) % n) * chunk, axis=0
-        )
+    x, rows, pad = _pad_rows(x, n)
+    buf = _ring_reduce_scatter(x, axis_name, n, "rsag-rs")
+    out = _ring_all_gather_chunks(buf, axis_name, n, "rsag-ag")
     return out[:rows] if pad else out
 
 
@@ -274,6 +337,214 @@ def all_gather_recdouble(x, axis_name: str, n: int | None = None):
 
 
 # ---------------------------------------------------------------------------
+# hierarchical (DCN×ICI) compositions — two-tier schedules over a
+# ("dcn", "ici") mesh. The dcn/ici axis NAMES are parameters; "dcn" is
+# the slow outer tier, "ici" the fast inner one.
+# ---------------------------------------------------------------------------
+
+# per-tier schedule resolvers: "xla" rides the builtin for that tier
+_ALL_REDUCE_TIER_IMPL = {
+    "xla": lambda x, axis, n: jax.lax.psum(x, axis),
+}
+
+
+def _tier_all_reduce(schedule: str):
+    if schedule in _ALL_REDUCE_TIER_IMPL:
+        return _ALL_REDUCE_TIER_IMPL[schedule]
+    impl = {
+        "rsag": all_reduce_rsag,
+        "recdouble": all_reduce_recdouble,
+        "tree": all_reduce_tree,
+    }.get(schedule)
+    if impl is None:
+        raise ValueError(
+            f"unknown tier all-reduce schedule {schedule!r}; pick from "
+            f"{ALL_REDUCE_SCHEDULES}"
+        )
+    return impl
+
+
+def _tier_all_gather(schedule: str):
+    impl = {
+        "xla": lambda x, axis, n: jax.lax.all_gather(x, axis, tiled=True),
+        "ring": all_gather_ring,
+        "recdouble": all_gather_recdouble,
+    }.get(schedule)
+    if impl is None:
+        raise ValueError(
+            f"unknown tier all-gather schedule {schedule!r}; pick from "
+            f"{ALL_GATHER_SCHEDULES}"
+        )
+    return impl
+
+
+def hier_all_reduce(
+    x,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    n_dcn: int | None = None,
+    n_ici: int | None = None,
+    dcn_schedule: str = "recdouble",
+):
+    """Two-tier all-reduce, bandwidth path: intra-slice ring
+    reduce-scatter over ICI → inter-slice all-reduce of the scattered
+    1/n_ici shard over DCN (``dcn_schedule``: any zoo token or "xla"
+    psum) → intra-slice ring all-gather. The slow tier carries only
+    S/n_ici bytes per device, the fast tier the full 2(n_ici−1)/n_ici·S
+    ring volume — the NCCL two-level decomposition.
+
+    On a degenerate single-slice mesh (n_dcn == 1) this IS the flat
+    :func:`all_reduce_rsag`, bitwise — the composition collapses to its
+    ICI phases. Rows that don't divide n_ici are zero-padded/trimmed
+    like the flat rsag."""
+    n_dcn = _resolve_n(dcn_axis, n_dcn)
+    n_ici = _resolve_n(ici_axis, n_ici)
+    if n_dcn == 1:
+        return all_reduce_rsag(x, ici_axis, n_ici)
+    if n_ici == 1:
+        return _tier_all_reduce(dcn_schedule)(x, dcn_axis, n_dcn)
+    x, rows, pad = _pad_rows(x, n_ici)
+    shard = _ring_reduce_scatter(x, ici_axis, n_ici, "hier-rs")
+    shard = _tier_all_reduce(dcn_schedule)(shard, dcn_axis, n_dcn)
+    out = _ring_all_gather_chunks(shard, ici_axis, n_ici, "hier-ag")
+    return out[:rows] if pad else out
+
+
+def hier_all_reduce_latency(
+    x,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    n_dcn: int | None = None,
+    n_ici: int | None = None,
+    ici_schedule: str = "recdouble",
+    dcn_schedule: str = "recdouble",
+):
+    """Two-tier all-reduce, latency path (the LL-protocol analog):
+    full-payload few-round schedules per tier — slice-local sum over
+    ICI, then cross-slice sum over DCN — no chunking, no scatter/gather
+    bookends. More wire bytes than :func:`hier_all_reduce` (log₂ rounds
+    of the FULL payload per tier), far fewer rounds: below the α/B
+    crossover the round count is the bill, so small messages ride this
+    path (parallel/autotune tunes the threshold)."""
+    n_dcn = _resolve_n(dcn_axis, n_dcn)
+    n_ici = _resolve_n(ici_axis, n_ici)
+    if n_ici > 1:
+        x = _tier_all_reduce(ici_schedule)(x, ici_axis, n_ici)
+    if n_dcn > 1:
+        x = _tier_all_reduce(dcn_schedule)(x, dcn_axis, n_dcn)
+    return x
+
+
+def hier_all_gather(
+    x,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    n_dcn: int | None = None,
+    n_ici: int | None = None,
+    ici_schedule: str = "ring",
+    dcn_schedule: str = "ring",
+):
+    """Two-tier all-gather: gather the slice over ICI first, then the
+    slices over DCN. Output is [n_dcn·n_ici·rows, ...] in **dcn-major**
+    device order — exactly the ``P(("dcn", "ici"))`` tiled layout, so
+    it bitwise-matches ``lax.all_gather(x, (dcn, ici), tiled=True)``.
+    Degenerate single-slice meshes collapse to the flat ICI gather."""
+    n_dcn = _resolve_n(dcn_axis, n_dcn)
+    n_ici = _resolve_n(ici_axis, n_ici)
+    if n_ici > 1:
+        x = _tier_all_gather(ici_schedule)(x, ici_axis, n_ici)
+    if n_dcn > 1:
+        x = _tier_all_gather(dcn_schedule)(x, dcn_axis, n_dcn)
+    return x
+
+
+def hier_reduce_scatter_slot(
+    n_dcn: int, n_ici: int, dcn_rank: int, ici_rank: int
+) -> int:
+    """Global chunk index device (dcn_rank, ici_rank) holds after
+    :func:`hier_reduce_scatter`, with rows split into n_ici·n_dcn
+    chunks ici-major: the ICI ring leaves chunk (i+1) mod n_ici, the
+    DCN ring sub-scatters it to (d+1) mod n_dcn."""
+    return ((ici_rank + 1) % n_ici) * n_dcn + (dcn_rank + 1) % n_dcn
+
+
+def hier_reduce_scatter(
+    x,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    n_dcn: int | None = None,
+    n_ici: int | None = None,
+):
+    """Two-tier reduce-scatter: ICI ring reduce-scatter into rows/n_ici
+    chunks, then a DCN ring reduce-scatter of that chunk into
+    rows/(n_ici·n_dcn). Device (d, i) ends holding the fully reduced
+    global chunk :func:`hier_reduce_scatter_slot`. Rows must divide
+    n_ici·n_dcn (a scattered output has no clean trim for padding)."""
+    n_dcn = _resolve_n(dcn_axis, n_dcn)
+    n_ici = _resolve_n(ici_axis, n_ici)
+    if x.shape[0] % max(1, n_ici * n_dcn):
+        raise ValueError(
+            f"{x.shape[0]} rows do not split into {n_ici * n_dcn} "
+            "hierarchical chunks (pad the payload: a scattered output "
+            "cannot trim)"
+        )
+    if n_ici > 1:
+        x = _ring_reduce_scatter(x, ici_axis, n_ici, "hier-rs")
+    if n_dcn > 1:
+        x = _ring_reduce_scatter(x, dcn_axis, n_dcn, "hier-rs-dcn")
+    return x
+
+
+def theoretical_hier_hops(
+    n_dcn: int,
+    n_ici: int,
+    variant: str = "bandwidth",
+    collective: str = "allreduce",
+    ici_schedule: str = "",
+    dcn_schedule: str = "",
+) -> dict:
+    """Per-tier hop budget of the hierarchical compositions — the
+    contract tests count against ``_HOP_TIER_LOG``. Returns
+    ``{"ici": rounds, "dcn": rounds}``; a tier riding its XLA builtin
+    issues zero explicit hops by definition."""
+
+    def tier(schedule, n, family="allreduce"):
+        if n <= 1 or schedule == "xla":
+            return 0
+        return theoretical_hops(schedule, n, collective=family)
+
+    if collective == "allreduce":
+        dcn_schedule = dcn_schedule or "recdouble"
+        if variant == "bandwidth":
+            # n_dcn == 1 collapses to flat rsag (ici only); n_ici == 1
+            # runs the dcn schedule on the full payload (dcn only)
+            return {
+                "ici": 2 * (n_ici - 1) if n_ici > 1 else 0,
+                "dcn": tier(dcn_schedule, n_dcn),
+            }
+        if variant == "latency":
+            return {
+                "ici": tier(ici_schedule or "recdouble", n_ici),
+                "dcn": tier(dcn_schedule, n_dcn),
+            }
+        raise ValueError(
+            f"unknown hierarchical variant {variant!r}; pick from "
+            f"{HIER_VARIANTS}"
+        )
+    if collective == "allgather":
+        return {
+            "ici": tier(ici_schedule or "ring", n_ici, "allgather"),
+            "dcn": tier(dcn_schedule or "ring", n_dcn, "allgather"),
+        }
+    if collective == "reducescatter":
+        return {
+            "ici": max(0, n_ici - 1),
+            "dcn": max(0, n_dcn - 1),
+        }
+    raise ValueError(f"unknown hierarchical collective {collective!r}")
+
+
+# ---------------------------------------------------------------------------
 # timed wrappers — CollectiveResult/busbw accounting shared with the
 # XLA baselines (parallel/collectives._bench)
 # ---------------------------------------------------------------------------
@@ -338,3 +609,88 @@ all_gather_ring_bandwidth = _allgather_bench("all_gather_ring", all_gather_ring)
 all_gather_recdouble_bandwidth = _allgather_bench(
     "all_gather_recdouble", all_gather_recdouble
 )
+
+
+def hier_all_reduce_bandwidth(
+    mesh: Mesh,
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 5,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    variant: str = "bandwidth",
+    dcn_schedule: str = "recdouble",
+    ici_schedule: str = "recdouble",
+) -> CollectiveResult:
+    """Timed hierarchical all-reduce over a two-tier mesh.
+
+    ``variant``: "bandwidth" (rs→dcn-exchange→ag), "latency"
+    (full-payload per-tier schedules), or "flat" (one psum over both
+    axes — the single-level baseline the tiered compositions are judged
+    against). busbw uses the flat all-reduce convention 2(n−1)/n with
+    n = total devices, so tiered and flat numbers compare directly."""
+    from functools import partial as _partial
+
+    from activemonitor_tpu.parallel.partition import shard_map
+    from activemonitor_tpu.utils.timing import chain_delta_seconds
+    from jax.sharding import PartitionSpec as P
+
+    n_dcn = mesh.shape[dcn_axis]
+    n_ici = mesh.shape[ici_axis]
+    n = n_dcn * n_ici
+    itemsize = jnp.dtype(dtype).itemsize
+    cols = 128
+    rows = max(1, int(size_mb * 1e6 / itemsize) // cols)
+    # divisible shards keep the two-level chunking static-shaped
+    rows = max(n, rows - rows % n)
+    shard_bytes = rows * cols * itemsize
+    inv_n = jnp.asarray(1.0 / n, dtype)
+
+    if variant == "bandwidth":
+        body = lambda x: hier_all_reduce(  # noqa: E731 - bench lambda idiom
+            x, dcn_axis, ici_axis, n_dcn, n_ici, dcn_schedule=dcn_schedule
+        ) * inv_n
+    elif variant == "latency":
+        body = lambda x: hier_all_reduce_latency(  # noqa: E731
+            x, dcn_axis, ici_axis, n_dcn, n_ici,
+            ici_schedule=ici_schedule, dcn_schedule=dcn_schedule,
+        ) * inv_n
+    elif variant == "flat":
+        axes = (dcn_axis, ici_axis)
+        body = lambda x: jax.lax.psum(x, axes) * inv_n  # noqa: E731
+    else:
+        raise ValueError(
+            f"unknown hierarchical bench variant {variant!r}; pick from "
+            f"{HIER_VARIANTS + ('flat',)}"
+        )
+
+    def chain_of(k):
+        @jax.jit
+        @_partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=P((dcn_axis, ici_axis), None),
+            out_specs=P(None),
+            check_vma=False,
+        )
+        def chain(x):
+            for _ in range(k):
+                x = body(x)
+            return jax.lax.psum(
+                x.astype(jnp.float32).sum(), (dcn_axis, ici_axis)
+            )[None]
+
+        return lambda x: chain(x)[0]
+
+    x = jnp.ones((rows * n, cols), dtype=dtype)
+    seconds = chain_delta_seconds(chain_of, x, k1=2, k2=6, iters=iters)
+    algbw = shard_bytes / seconds / 1e9
+    busbw = algbw * 2 * (n - 1) / n if n > 1 else algbw
+    return CollectiveResult(
+        name=f"hier_all_reduce_{variant}",
+        payload_bytes=shard_bytes,
+        n_devices=n,
+        seconds_per_op=seconds,
+        algbw_gbps=algbw,
+        busbw_gbps=busbw,
+    )

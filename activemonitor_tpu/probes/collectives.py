@@ -21,9 +21,13 @@ degraded link.
 Exports, per collective C in {allreduce, allgather, reducescatter,
 alltoall, ringhop, ringhop-bidir} plus the zoo cases
 {allreduce-rsag, allreduce-recdouble, allreduce-tree, allgather-ring,
-allgather-recdouble} (prefix ``collective-``, distinct from the
-north-star probe's ``ici-`` gauges so a merged battery contract never
-carries duplicate names):
+allgather-recdouble} and the hierarchical cases {allreduce-hier,
+allreduce-hier-latency} (two-tier compositions over a synthetic
+(2, n/2) ("dcn", "ici") re-mesh of the flat device set; an odd or
+<4-device set records a structured ``hier_skipped`` detail naming the
+mesh it lacked) (prefix ``collective-``, distinct from the north-star
+probe's ``ici-`` gauges so a merged battery contract never carries
+duplicate names):
 
 - ``collective-<C>-busbw-gbps`` — NCCL busbw convention
 - ``collective-<C>-fraction-of-rated`` — busbw / schedule ceiling (TPU)
@@ -66,7 +70,12 @@ from activemonitor_tpu.parallel.collectives import (
     ppermute_ring_bandwidth,
     reduce_scatter_bandwidth,
 )
-from activemonitor_tpu.parallel.mesh import best_2d_shape, make_1d_mesh, make_2d_mesh
+from activemonitor_tpu.parallel.mesh import (
+    best_2d_shape,
+    make_1d_mesh,
+    make_2d_mesh,
+    make_synthetic_two_tier_mesh,
+)
 from activemonitor_tpu.parallel.schedules import (
     all_gather_recdouble_bandwidth,
     all_gather_ring_bandwidth,
@@ -91,6 +100,47 @@ ZOO_CASES = (
     "allgather-ring", "allgather-recdouble",
 )
 
+# the hierarchical (DCN×ICI) compositions, measured over a SYNTHETIC
+# two-tier re-mesh of the flat device set (2 × n/2 — the single-
+# process stand-in for a real multislice topology; probes/dcn.py owns
+# the real cross-host measurement). Opt-in like the zoo; an odd or
+# <4-device set records a structured skip naming the mesh it lacked.
+HIER_CASES = ("allreduce-hier", "allreduce-hier-latency")
+
+
+def _hier_case_bench(variant: str) -> Callable:
+    def bench(mesh, size_mb=64.0, dtype=None, iters=5, axis=""):
+        if axis:
+            # the re-mesh always spans ALL devices; a per-axis caller
+            # reaching this bench is a bug, not a silent ignore
+            raise ValueError(
+                "hierarchical cases re-mesh the full device set; "
+                f"per-axis restriction ({axis!r}) is not supported"
+            )
+        import jax.numpy as jnp
+
+        from activemonitor_tpu.parallel.mesh import (
+            make_synthetic_two_tier_mesh,
+        )
+        from activemonitor_tpu.parallel.schedules import (
+            hier_all_reduce_bandwidth,
+        )
+
+        devices = list(mesh.devices.flat)
+        hier_mesh = make_synthetic_two_tier_mesh(devices)
+        if hier_mesh is None:  # callers pre-filter; bug if reached
+            raise ValueError(
+                f"{len(devices)} device(s) cannot form the synthetic "
+                "(2, n/2) two-tier mesh"
+            )
+        return hier_all_reduce_bandwidth(
+            hier_mesh, size_mb=size_mb, dtype=dtype or jnp.bfloat16,
+            iters=iters, variant=variant,
+        )
+
+    return bench
+
+
 _BENCH: Dict[str, Callable] = {
     "allreduce": all_reduce_bandwidth,
     "allgather": all_gather_bandwidth,
@@ -103,6 +153,8 @@ _BENCH: Dict[str, Callable] = {
     "allreduce-tree": all_reduce_tree_bandwidth,
     "allgather-ring": all_gather_ring_bandwidth,
     "allgather-recdouble": all_gather_recdouble_bandwidth,
+    "allreduce-hier": _hier_case_bench("bandwidth"),
+    "allreduce-hier-latency": _hier_case_bench("latency"),
 }
 
 # sweep headline gauges — contract spelling (pinned by tests/test_lint)
@@ -154,6 +206,21 @@ def _rated_busbw(name: str, unidir_gbps: float, n: int) -> float:
         return 8 * unidir_gbps * (n - 1) / n**2
     if name in ("allreduce-rsag", "allgather-ring", "allgather-recdouble"):
         return unidir_gbps
+    if name == "allreduce-hier":
+        # bandwidth composition on the synthetic 2×(n/2) re-mesh: the
+        # ICI rs/ag phases ride one ring direction (the rsag bound);
+        # the halved-payload dcn exchange shares the same links here
+        # (no real second tier on a flat device set), costing ~one
+        # more chunk round ⇒ informational bar at the rsag ceiling
+        return unidir_gbps
+    if name == "allreduce-hier-latency":
+        # full-payload few-round schedules per synthetic tier: the
+        # recdouble collapse applied to each tier in sequence —
+        # latency path wins rounds, never bandwidth (by design)
+        ici_n = max(2, n // 2)
+        p = 1 << (ici_n.bit_length() - 1)
+        link_rounds = (p - 1) + (2 if ici_n - p else 0) + 1  # + dcn round
+        return 2 * (n - 1) / n * unidir_gbps / link_rounds
     if name == "allreduce-recdouble":
         p = 1 << (max(2, n).bit_length() - 1)  # largest pow2 ≤ n
         fold = 2 if n - p else 0
@@ -179,11 +246,13 @@ def _emit(
     the rated comparator, ring_n its ring size. ``context`` names the
     measured surface in the summary.
 
-    Zoo-schedule fractions are exported but NEVER gate the verdict:
-    their denominators are modeled algorithmic ceilings (routing
-    assumptions included, see _rated_busbw), and a modeling error must
-    misread as an off gauge, not a failed HealthCheck. The XLA-builtin
-    cases keep the rated-silicon comparison and the verdict."""
+    Zoo-schedule (and hierarchical-case) fractions are exported but
+    NEVER gate the verdict: their denominators are modeled algorithmic
+    ceilings (routing assumptions included, see _rated_busbw), and a
+    modeling error must misread as an off gauge, not a failed
+    HealthCheck. The XLA-builtin cases keep the rated-silicon
+    comparison and the verdict."""
+    informational = ZOO_CASES + HIER_CASES
     devices = jax.devices()
     rated = rated_for(devices[0].device_kind)
     on_tpu = devices[0].platform == "tpu"
@@ -204,14 +273,14 @@ def _emit(
             rated_busbw = _rated_busbw(base_case, rated.ici_unidir_gbps, ring_n)
             fraction = result.busbw_gbps / rated_busbw
             fractions[label] = fraction
-            if base_case not in ZOO_CASES:
+            if base_case not in informational:
                 verdict_fractions[label] = fraction
             metrics.append(
                 ProbeMetric(
                     f"collective-{label}-fraction-of-rated",
                     fraction,
                     help=f"{result.name} busbw / schedule-specific ring ceiling"
-                    + (" (informational)" if base_case in ZOO_CASES else ""),
+                    + (" (informational)" if base_case in informational else ""),
                 )
             )
             details[f"{key}_fraction_of_rated"] = round(fraction, 3)
@@ -252,7 +321,7 @@ def _emit(
         prefix = f"collective-{label}"
         if not roofline:
             cap = roofline_model.skip_capture(prefix, "disabled (--no-roofline)")
-        elif base_case in ZOO_CASES:
+        elif base_case in informational:
             cap = roofline_model.skip_capture(
                 prefix,
                 "zoo ceiling is a modeled algorithmic bar, not rated "
@@ -282,13 +351,25 @@ def _emit(
     return probe_result
 
 
-def _validate_cases(cases: Sequence[str]) -> Tuple[str, ...]:
+def _validate_cases(
+    cases: Sequence[str], allow_hier: bool = True
+) -> Tuple[str, ...]:
     cases = tuple(cases)
     unknown = [c for c in cases if c not in _BENCH]
     if unknown:
         raise ValueError(
-            f"unknown collectives {unknown}; pick from {ALL_CASES + ZOO_CASES}"
+            f"unknown collectives {unknown}; pick from "
+            f"{ALL_CASES + ZOO_CASES + HIER_CASES}"
         )
+    if not allow_hier:
+        hier = [c for c in cases if c in HIER_CASES]
+        if hier:
+            raise ValueError(
+                f"hierarchical cases {hier} re-mesh the FULL device set "
+                "into a synthetic (dcn, ici) topology; they cannot be "
+                "restricted to one axis — run them through the flat "
+                "sweep (`collectives --cases ...`) instead"
+            )
     return cases
 
 
@@ -309,7 +390,7 @@ def run_per_axis(
     one link direction shows up as one axis's fraction dropping while
     the other stays healthy — `collectives` alone can only say "slow",
     this says "slow WHERE"."""
-    cases = _validate_cases(cases or ("allreduce", "ringhop"))
+    cases = _validate_cases(cases or ("allreduce", "ringhop"), allow_hier=False)
     devices = jax.devices()
     n = len(devices)
     if n < 4:
@@ -363,11 +444,41 @@ def run(
         )
 
     mesh = make_1d_mesh()
+    details: Dict = {"devices": n, "device_kind": devices[0].device_kind}
+    # hierarchical cases need the synthetic (2, n/2) two-tier re-mesh
+    # (one shared rule: parallel/mesh.make_synthetic_two_tier_mesh) —
+    # an impossible expansion is a structured skip naming the mesh it
+    # lacked, never a crash or a silent hole (the run_per_axis skip
+    # contract)
+    if make_synthetic_two_tier_mesh(devices) is None:
+        impossible = [c for c in cases if c in HIER_CASES]
+        if impossible:
+            details["hier_skipped"] = {
+                case: {
+                    "reason": (
+                        f"{n} device(s) cannot form the synthetic "
+                        "(2, n/2) two-tier mesh (needs an even count "
+                        ">= 4)"
+                    ),
+                    "mesh": {"dcn": 2, "ici": max(1, n // 2)},
+                }
+                for case in impossible
+            }
+            cases = tuple(c for c in cases if c not in HIER_CASES)
     entries = [
         (name, name, n, _BENCH[name](mesh, size_mb=size_mb, iters=iters))
         for name in cases
     ]
-    details = {"devices": n, "device_kind": devices[0].device_kind}
+    if not entries:
+        return ProbeResult(
+            ok=True,
+            summary=(
+                f"collectives sweep: every requested case skipped on "
+                f"{n} device(s)"
+            ),
+            metrics=[],
+            details=details,
+        )
     return _emit(
         entries, threshold, f"{len(entries)} collectives over {n} device(s)",
         details, roofline=roofline,
@@ -376,9 +487,11 @@ def run(
 
 # the full log-spaced payload grid lives with the tuner (single
 # source of truth); quick mode keeps the endpoints' spirit at
-# CPU-interpret-affordable sizes
+# CPU-interpret-affordable sizes — the small end sits at the ~4KB
+# latency-regime floor the full grid now reaches, so even quick
+# tables carry a cell on the latency side of the crossover
 SWEEP_SIZES_MB = autotune.DEFAULT_SWEEP_SIZES_MB
-QUICK_SWEEP_SIZES_MB = (0.25, 2.0)
+QUICK_SWEEP_SIZES_MB = (0.004, 2.0)
 
 
 def sweep(

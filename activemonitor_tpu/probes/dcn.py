@@ -4,8 +4,23 @@ Runs on every host of a multi-host slice (or multislice topology) with
 jax.distributed initialized, builds the hierarchical (dcn, ici) mesh,
 and measures the all-reduce over the cross-host axis — traffic that
 rides DCN between slices (or the host interconnect within one) rather
-than intra-host ICI. A correctness gate (psum of a known payload over
-all hosts) catches broken cross-host collectives outright.
+than intra-host ICI. Two correctness gates catch broken cross-host
+collectives outright: a psum of a known payload over all hosts, and
+the HIERARCHICAL composition (parallel/schedules.hier_all_reduce —
+intra-slice reduce-scatter over ICI, cross-slice exchange over DCN,
+all-gather back) against the same psum reference over the full
+two-tier mesh.
+
+Per-tier exports (the ("dcn", "ici") spelling of the ici probe's
+north-star gauges; pinned in docs/probes.md):
+
+- ``dcn-xslice-busbw-gbps`` — cross-slice all-reduce busbw over the
+  DCN tier (one representative device per host, so per-host NIC
+  contention doesn't understate the number)
+- ``dcn-xslice-fraction-of-rated`` — busbw / rated per-host DCN
+  egress (probes/rated.RatedSpec.dcn_gbps; TPU + known rating only)
+- ``dcn-hier-allreduce-correct`` — 1 when the hierarchical
+  composition matches psum over the full (dcn, ici) mesh
 
 Every worker of the workflow runs the same command; exit codes combine
 through the workflow's parallel steps:
@@ -27,10 +42,12 @@ import jax.numpy as jnp
 from activemonitor_tpu.parallel.collectives import all_reduce_bandwidth
 from activemonitor_tpu.parallel.mesh import make_multihost_mesh
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
+from activemonitor_tpu.probes.rated import rated_for
 
 
 def run(size_mb: float = 16.0, iters: int = 4) -> ProbeResult:
     n_proc = jax.process_count()
+    local = jax.local_device_count()
     if n_proc < 2:
         return ProbeResult(
             ok=True,
@@ -43,7 +60,14 @@ def run(size_mb: float = 16.0, iters: int = 4) -> ProbeResult:
                     "dcn-hosts", 1, help="Number of hosts in the distributed run"
                 )
             ],
-            details={"processes": 1},
+            details={
+                "processes": 1,
+                "skipped": True,
+                # the two-tier shape the probe WOULD have measured —
+                # so a skip in a fleet rollup still says what topology
+                # was absent (the run_per_axis skip contract)
+                "mesh": {"dcn": 1, "ici": local},
+            },
         )
 
     mesh = make_multihost_mesh()
@@ -70,6 +94,37 @@ def run(size_mb: float = 16.0, iters: int = 4) -> ProbeResult:
     expected = jnp.broadcast_to(x.sum(axis=0), (1, local))
     correct = bool(jnp.allclose(got, expected))
 
+    # the hierarchical composition over the FULL (dcn, ici) mesh —
+    # reduce-scatter inside the slice over ICI, exchange over DCN,
+    # gather back — must agree with the joint psum: this is the
+    # schedule the two-tier grad sync / autotune surface dispatches,
+    # proven on the very topology it targets
+    from activemonitor_tpu.parallel.schedules import hier_all_reduce
+
+    rows = 4 * n_proc * local
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(("dcn", "ici"), None),
+        out_specs=P(None, None),
+        check_vma=False,
+    )
+    def hier_vs_psum(v):
+        got = hier_all_reduce(v, "dcn", "ici", n_proc, local)
+        want = jax.lax.psum(v, ("dcn", "ici"))
+        # pmax replicates the verdict: a mismatch on ANY device must
+        # reach the one shard the host reads, not just a first-device
+        # local diff (check_vma=False would silently read one shard)
+        return jax.lax.pmax(
+            jnp.max(jnp.abs(got - want)), ("dcn", "ici")
+        )[None, None]
+
+    payload = jnp.arange(rows * 3, dtype=jnp.float32).reshape(rows, 3) % 11
+    hier_diff = float(hier_vs_psum(payload)[0, 0])
+    hier_correct = hier_diff == 0.0
+
     # bandwidth is measured over ONE device per host: on the full
     # (dcn, ici) mesh the payload would be replicated across the ici
     # axis and every local device would run an identical concurrent
@@ -89,23 +144,66 @@ def run(size_mb: float = 16.0, iters: int = 4) -> ProbeResult:
             help="Cross-host all-reduce bus bandwidth, GB/s",
         ),
         ProbeMetric(
+            "dcn-xslice-busbw-gbps",
+            result.busbw_gbps,
+            help="Cross-slice (DCN tier) all-reduce bus bandwidth, GB/s "
+            "— the slow tier of the (dcn, ici) hierarchy",
+        ),
+        ProbeMetric(
             "dcn-allreduce-correct",
             1.0 if correct else 0.0,
             help="1 when the cross-host psum result is correct",
         ),
+        ProbeMetric(
+            "dcn-hier-allreduce-correct",
+            1.0 if hier_correct else 0.0,
+            help="1 when the hierarchical (ICI reduce-scatter → DCN "
+            "exchange → ICI all-gather) composition matches psum over "
+            "the full two-tier mesh",
+        ),
     ]
+    details = {
+        "processes": n_proc,
+        "local_devices": local,
+        "mesh": {"dcn": n_proc, "ici": local},
+        "payload_mb": result.payload_bytes / 1e6,
+        "seconds_per_op": result.seconds_per_op,
+        "hier_allreduce_max_diff": hier_diff,
+    }
+
+    # rated comparison: the DCN tier gets the same fraction-of-rated
+    # treatment the ICI probe's north-star gauge has — per-host egress
+    # is the ceiling one cross-host ring direction can use. TPU with a
+    # known DCN rating only: CPU two-process runs are a CI substrate,
+    # never judged against a datacenter NIC.
+    devices = jax.devices()
+    rated = rated_for(devices[0].device_kind)
+    if (
+        rated is not None
+        and rated.dcn_gbps > 0
+        and devices[0].platform == "tpu"
+    ):
+        fraction = result.busbw_gbps / rated.dcn_gbps
+        metrics.append(
+            ProbeMetric(
+                "dcn-xslice-fraction-of-rated",
+                fraction,
+                help="Cross-slice busbw / rated per-host DCN egress "
+                "(ACTIVEMONITOR_RATED_DCN_GBPS overrides)",
+            )
+        )
+        details["rated_dcn_gbps"] = rated.dcn_gbps
+        details["xslice_fraction_of_rated"] = round(fraction, 3)
+
+    ok = correct and hier_correct
     return ProbeResult(
-        ok=correct,
+        ok=ok,
         summary=(
             f"cross-host all-reduce over {n_proc} hosts: "
             f"{result.busbw_gbps:.2f} GB/s busbw, "
-            f"correctness {'OK' if correct else 'MISMATCH'}"
+            f"correctness {'OK' if correct else 'MISMATCH'}, "
+            f"hierarchical {'OK' if hier_correct else 'MISMATCH'}"
         ),
         metrics=metrics,
-        details={
-            "processes": n_proc,
-            "local_devices": local,
-            "payload_mb": result.payload_bytes / 1e6,
-            "seconds_per_op": result.seconds_per_op,
-        },
+        details=details,
     )

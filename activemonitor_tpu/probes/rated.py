@@ -10,7 +10,14 @@ variables for new silicon or corrected ratings:
     ACTIVEMONITOR_RATED_INT8_TOPS
     ACTIVEMONITOR_RATED_HBM_GBPS
     ACTIVEMONITOR_RATED_ICI_GBPS   (per-link, one direction)
+    ACTIVEMONITOR_RATED_DCN_GBPS   (cross-slice, per host, one direction)
     ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE   (roofline ridge point)
+
+The DCN figures are per-HOST egress for the multislice data-center
+network tier (the slow axis of a ("dcn", "ici") mesh) — approximate
+public numbers, deliberately overridable per fleet: unlike ICI, DCN
+provisioning varies by deployment, so the env override is the
+expected path for a fleet that knows its NICs.
 
 The bf16 peak and HBM bandwidth together define the chip's roofline
 (obs/roofline.py): the ridge point — peak FLOP/s over HBM byte/s, in
@@ -39,6 +46,10 @@ class RatedSpec:
     ici_unidir_gbps: float  # ICI bandwidth per link, one direction, GB/s
     ici_links: int  # ICI links per chip
     int8_tops: float = 0.0  # peak dense int8 matmul TOP/s per chip (0 = n/a)
+    # cross-slice DCN egress per host, one direction, GB/s (0 = n/a —
+    # single-slice hardware or unknown provisioning); approximate and
+    # meant to be overridden via ACTIVEMONITOR_RATED_DCN_GBPS
+    dcn_gbps: float = 0.0
 
     @property
     def ridge_flops_per_byte(self) -> float:
@@ -51,14 +62,16 @@ class RatedSpec:
         return self.bf16_tflops * 1e12 / (self.hbm_gbps * 1e9)
 
 
-# device_kind substrings -> rated spec
+# device_kind substrings -> rated spec (DCN: ~200 Gbps/host NICs on
+# the v5/v6 multislice generations, ~100 Gbps on v4 — per-host one
+# direction, the denominator of dcn-xslice-fraction-of-rated)
 _RATED = [
-    ("v6", RatedSpec("v6e", bf16_tflops=918.0, hbm_gbps=1640.0, ici_unidir_gbps=90.0, ici_links=4, int8_tops=1836.0)),
-    ("v5p", RatedSpec("v5p", bf16_tflops=459.0, hbm_gbps=2765.0, ici_unidir_gbps=90.0, ici_links=6, int8_tops=918.0)),
-    ("v5 lite", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0)),
-    ("v5e", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0)),
+    ("v6", RatedSpec("v6e", bf16_tflops=918.0, hbm_gbps=1640.0, ici_unidir_gbps=90.0, ici_links=4, int8_tops=1836.0, dcn_gbps=25.0)),
+    ("v5p", RatedSpec("v5p", bf16_tflops=459.0, hbm_gbps=2765.0, ici_unidir_gbps=90.0, ici_links=6, int8_tops=918.0, dcn_gbps=25.0)),
+    ("v5 lite", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0, dcn_gbps=25.0)),
+    ("v5e", RatedSpec("v5e", bf16_tflops=197.0, hbm_gbps=819.0, ici_unidir_gbps=45.0, ici_links=4, int8_tops=394.0, dcn_gbps=25.0)),
     # v4 has no int8 MXU mode (int8 ships with v5)
-    ("v4", RatedSpec("v4", bf16_tflops=275.0, hbm_gbps=1228.0, ici_unidir_gbps=45.0, ici_links=6)),
+    ("v4", RatedSpec("v4", bf16_tflops=275.0, hbm_gbps=1228.0, ici_unidir_gbps=45.0, ici_links=6, dcn_gbps=12.5)),
 ]
 
 
@@ -133,5 +146,6 @@ def rated_for(device_kind: str) -> Optional[RatedSpec]:
                 ici_unidir_gbps=_override(spec.ici_unidir_gbps, "ACTIVEMONITOR_RATED_ICI_GBPS"),
                 ici_links=spec.ici_links,
                 int8_tops=_override(spec.int8_tops, "ACTIVEMONITOR_RATED_INT8_TOPS"),
+                dcn_gbps=_override(spec.dcn_gbps, "ACTIVEMONITOR_RATED_DCN_GBPS"),
             )
     return None

@@ -38,6 +38,7 @@ from activemonitor_tpu.parallel.mesh import make_2d_mesh
 from activemonitor_tpu.parallel.partition import (
     match_partition_rules,
     named_tree_map,
+    resolve_tiers,
     shard_map,
 )
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
@@ -61,7 +62,9 @@ def resolve_grad_sync(
     mesh: Mesh, attention: str, grad_sync: str, accum_steps: int = 1
 ):
     """``("explicit", "")`` when the tuned-dispatch gradient sync can
-    run, else ``("implicit", why)``.
+    run, ``("hierarchical", "")`` when the mesh is a two-tier
+    ("dcn", "ici") data-parallel mesh and the sync should ride the
+    hierarchical composition, else ``("implicit", why)``.
 
     The explicit sync shard_maps the loss+grad computation over the
     ``"data"`` axis and reduces through ``autotune.all_reduce`` — the
@@ -70,9 +73,18 @@ def resolve_grad_sync(
     is fully manual; a live tp/sp axis would need the partial-manual
     lowering the legacy runtime lacks), and dense attention (flash/ring
     run their own shard_map, which cannot nest inside the sync body).
-    Anything else falls back to the implicit XLA-inserted reduction,
-    with the reason recorded in the probe details — a gate, never a
-    crash."""
+
+    The hierarchical sync applies the same gates to a mesh that
+    carries the tier pair INSTEAD of a "data" axis (the resolution
+    rides ``parallel/partition.resolve_tiers``, so the probe's call
+    sites never change): batch shards over ("dcn", "ici"), gradients
+    reduce through ``autotune.all_reduce(("dcn", "ici"))`` — intra-
+    slice reduce-scatter over ICI, cross-slice exchange over DCN,
+    all-gather back, or the latency path below the tuned threshold.
+    Only "auto"/"xla" are meaningful there (a flat zoo token names a
+    single-tier schedule). Anything else falls back to the implicit
+    XLA-inserted reduction, with the reason recorded in the probe
+    details — a gate, never a crash."""
     if grad_sync not in GRAD_SYNC_SCHEDULES:
         raise ValueError(
             f"grad_sync must be one of {GRAD_SYNC_SCHEDULES}, got "
@@ -80,6 +92,28 @@ def resolve_grad_sync(
         )
     if grad_sync == "implicit":
         return "implicit", "requested"
+    shape = dict(mesh.shape)
+    if "data" not in shape and "dcn" in shape and "ici" in shape:
+        # two-tier data parallelism: the hierarchical sync (multi-
+        # process allowed — cross-slice DCN traffic is the point)
+        if grad_sync not in ("auto", "xla"):
+            return "implicit", (
+                f"flat schedule {grad_sync!r} on a two-tier mesh "
+                "(hierarchical sync takes auto/xla)"
+            )
+        others = [
+            a for a in mesh.axis_names
+            if a not in ("dcn", "ici") and shape[a] > 1
+        ]
+        if others:
+            return "implicit", f"non-tier axes {others} stay compiler-managed"
+        if attention != "dense":
+            return "implicit", f"attention={attention!r} runs its own shard_map"
+        if accum_steps > 1:
+            return "implicit", "accum_steps keeps the global-batch contract"
+        if shape["dcn"] * shape["ici"] < 2:
+            return "implicit", "single-device mesh: nothing to reduce"
+        return "hierarchical", ""
     if jax.process_count() > 1:
         # DCN-spanning meshes keep the XLA-inserted reduction: the
         # tuned ICI schedules are wrong for cross-host links anyway,
@@ -102,6 +136,20 @@ def resolve_grad_sync(
     return "explicit", ""
 
 
+def _leaf_payloads(cfg: ProbeModelConfig, dtype) -> dict:
+    """name → gradient payload bytes over the abstract param tree."""
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    itemsize = jnp.dtype(dtype).itemsize
+    payloads: dict = {}
+    named_tree_map(
+        lambda name, leaf: payloads.__setitem__(
+            name, int(math.prod(leaf.shape)) * itemsize
+        ),
+        abstract,
+    )
+    return payloads
+
+
 def grad_sync_plan(cfg: ProbeModelConfig, mesh: Mesh, dtype=jnp.float32) -> dict:
     """The per-leaf tuned-dispatch plan for the explicit gradient sync:
     which schedule ``autotune.all_reduce(schedule="auto")`` resolves
@@ -111,20 +159,12 @@ def grad_sync_plan(cfg: ProbeModelConfig, mesh: Mesh, dtype=jnp.float32) -> dict
     stdout contract and bench.py stamps into the artifact."""
     from activemonitor_tpu.parallel import autotune
 
-    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
     n = mesh.shape.get("data", 1)
-    itemsize = jnp.dtype(dtype).itemsize
-    plan: dict = {}
-
-    def visit(name, leaf):
-        payload = int(math.prod(leaf.shape)) * itemsize
-        plan[name] = (
-            autotune.lookup("allreduce", n, payload, dtype) or "xla",
-            payload,
-        )
-        return None
-
-    named_tree_map(visit, abstract)
+    payloads = _leaf_payloads(cfg, dtype)
+    plan = {
+        name: (autotune.lookup("allreduce", n, payload, dtype) or "xla", payload)
+        for name, payload in payloads.items()
+    }
     largest = max(plan, key=lambda name: plan[name][1])
     by_schedule: dict = {}
     for schedule, _payload in plan.values():
@@ -136,6 +176,37 @@ def grad_sync_plan(cfg: ProbeModelConfig, mesh: Mesh, dtype=jnp.float32) -> dict
         "largest_leaf_bytes": plan[largest][1],
         "by_schedule": by_schedule,
     }
+
+
+def hier_sync_plan(
+    cfg: ProbeModelConfig, mesh: Mesh, dtype=jnp.float32,
+    schedule: str = "auto",
+) -> dict:
+    """The per-TIER decision for the hierarchical gradient sync on a
+    two-tier ("dcn", "ici") mesh: which path the dominant gradient
+    leaf rides (latency below the tuned threshold, bandwidth above)
+    and which schedule each tier resolved — the per-tier evidence the
+    probe exports in its stdout contract (``details["hier_sync"]``)."""
+    from activemonitor_tpu.parallel import autotune
+
+    data_axes, reason = resolve_tiers(mesh, "data")
+    payloads = _leaf_payloads(cfg, dtype)
+    largest = max(payloads, key=payloads.get)
+    if len(data_axes) < 2:
+        return {
+            "path": "flat",
+            "reason": reason,
+            "largest_leaf": largest,
+            "largest_leaf_bytes": payloads[largest],
+        }
+    plan = autotune.hier_plan(
+        "allreduce", mesh.shape["dcn"], mesh.shape["ici"],
+        payloads[largest], dtype,
+        schedule if schedule in autotune.HIER_SCHEDULES else "auto",
+    )
+    plan["largest_leaf"] = largest
+    plan["largest_leaf_bytes"] = payloads[largest]
+    return plan
 
 
 def build_sharded_train_step(
@@ -188,13 +259,33 @@ def build_sharded_train_step(
     from activemonitor_tpu.parallel.distributed import distribute_tree
 
     optimizer = optax.adamw(learning_rate)
-    data_sh = NamedSharding(mesh, P("data", None))
+    # the batch axis resolves through the partition tier rule: a mesh
+    # carrying ("dcn", "ici") instead of "data" shards the batch over
+    # BOTH tiers (dcn-major) with zero call-site changes
+    data_axes, _tier_reason = resolve_tiers(mesh, "data")
+    tiered = "data" not in mesh.shape
+    data_entry = data_axes[0] if len(data_axes) == 1 else data_axes
+    data_sh = NamedSharding(mesh, P(data_entry, None))
 
     # shardings derive from ABSTRACT shapes — nothing allocated yet
     abstract_params = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
-    param_sh, state_sh, replicated = _state_shardings(
-        cfg, mesh, zero1, abstract_params
-    )
+    if tiered:
+        # two-tier data parallelism: params/optimizer replicate (the
+        # megatron param specs name a "model" axis these meshes don't
+        # carry; tensor parallelism inside a slice is a composed-mesh
+        # follow-up, not this path)
+        if zero1:
+            raise ValueError(
+                "zero1 needs a 'data' mesh axis; two-tier ('dcn', 'ici') "
+                "meshes keep optimizer state replicated"
+            )
+        replicated = NamedSharding(mesh, P())
+        param_sh = jax.tree.map(lambda _: replicated, abstract_params)
+        state_sh = param_sh
+    else:
+        param_sh, state_sh, replicated = _state_shardings(
+            cfg, mesh, zero1, abstract_params
+        )
     abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
     opt_sh = _opt_shardings(abstract_opt, param_sh, replicated, state_sh=state_sh)
     if init_state:
@@ -255,14 +346,22 @@ def build_sharded_train_step(
         )
 
     sync_mode, _sync_reason = resolve_grad_sync(mesh, attention, grad_sync, accum_steps)
-    if sync_mode == "explicit":
+    if sync_mode in ("explicit", "hierarchical"):
         # the one-sharding-surface sync: each data shard computes grads
         # on its local microbatch, then the reduction rides the tuned
         # collective surface (schedule="auto" consults the PR-8
         # decision table per leaf payload; untuned leaves take the XLA
         # psum). Mean-of-shard-means equals the global mean — shard
         # sizes are equal by the batch % data check in jit's sharding.
-        n_data = mesh.shape["data"]
+        # On a two-tier mesh the SAME call dispatches hierarchically:
+        # axis is the ("dcn", "ici") pair, so autotune.all_reduce
+        # routes through the latency/bandwidth compositions per leaf
+        # payload (parallel/autotune.hier_plan).
+        sync_axes = ("data",) if sync_mode == "explicit" else data_axes
+        axis_token = sync_axes[0] if len(sync_axes) == 1 else sync_axes
+        sync_ns = tuple(mesh.shape[a] for a in sync_axes)
+        sync_n = sync_ns[0] if len(sync_ns) == 1 else sync_ns
+        n_sync = math.prod(sync_ns)
 
         def local_grads(params, tokens):
             from activemonitor_tpu.parallel import autotune
@@ -270,25 +369,25 @@ def build_sharded_train_step(
             loss, grads = compute_grads(params, tokens)
             grads = jax.tree.map(
                 lambda g: autotune.all_reduce(
-                    g, "data", schedule=grad_sync, n=n_data
+                    g, axis_token, schedule=grad_sync, n=sync_n
                 )
-                / n_data,
+                / n_sync,
                 grads,
             )
-            return jax.lax.psum(loss, "data") / n_data, grads
+            return jax.lax.psum(loss, axis_token) / n_sync, grads
 
         synced_grads = shard_map(
             local_grads,
             mesh=mesh,
             # params replicate over the (trivial-other-axes) mesh; only
             # the token batch is manual-sharded
-            in_specs=(P(), P("data", None)),
+            in_specs=(P(), P(data_entry, None)),
             out_specs=(P(), P()),
             check_vma=False,
         )
 
     def step(params, opt_state, tokens):
-        if sync_mode == "explicit":
+        if sync_mode in ("explicit", "hierarchical"):
             loss, grads = synced_grads(params, tokens)
         else:
             loss, grads = compute_grads(params, tokens)
@@ -694,7 +793,11 @@ def run(
         sp = 2 if n % 2 == 0 else 1
         mesh = make_mesh(("data", "model", "sp"), (n // sp, 1, sp))
     mesh = mesh or make_2d_mesh()
-    n_data = mesh.shape["data"]
+    # the batch axis resolves through the partition tier rule: "data"
+    # when the mesh carries it, the ("dcn", "ici") pair on a two-tier
+    # mesh (hierarchical sync), "ici" on a degenerate single slice
+    data_axes, _tier_reason = resolve_tiers(mesh, "data")
+    n_data = math.prod(mesh.shape[a] for a in data_axes)
     batch = batch_per_device * n_data
 
     from activemonitor_tpu.parallel.distributed import distribute
@@ -710,6 +813,20 @@ def run(
         autotune.tune(
             mesh, axis="data", collectives=("allreduce",),
             sizes_mb=(max(0.25, largest_mb),), dtype=jnp.float32, iters=2,
+        )
+    if (
+        tune_sync and sync_mode == "hierarchical"
+        and jax.process_count() == 1 and len(data_axes) > 1
+    ):
+        # two-tier targeted tune: per-tier winners AND the latency-path
+        # threshold, both at the dominant gradient payload (plus one
+        # small-message point so the threshold brackets a crossover)
+        from activemonitor_tpu.parallel import autotune
+
+        largest_mb = max(_leaf_payloads(cfg, jnp.float32).values()) / 1e6
+        autotune.tune_hierarchical(
+            mesh, sizes_mb=(0.004, max(0.016, largest_mb)),
+            dtype=jnp.float32, iters=2,
         )
 
     step_fn, params, opt_state, data_sh = build_sharded_train_step(
@@ -857,6 +974,27 @@ def run(
             )
         )
         details["allreduce_sched_speedup"] = round(allreduce_speedup, 4)
+    elif sync_mode == "hierarchical":
+        # the per-tier evidence: which path the dominant gradient leaf
+        # rode (latency vs bandwidth vs degenerate-flat, with the tuned
+        # threshold that decided it) and the schedule each tier
+        # resolved — exported in the stdout contract both as the
+        # details block and as a numeric gauge (1 = latency path)
+        from activemonitor_tpu.parallel.autotune import hier_plan_label
+
+        details["grad_sync"] = "hierarchical"
+        plan = hier_sync_plan(cfg, mesh, schedule=grad_sync)
+        details["hier_sync"] = plan
+        details["allreduce_schedule"] = hier_plan_label(plan)
+        metrics.append(
+            ProbeMetric(
+                "training-step-hier-sync",
+                1.0 if plan.get("variant") == "latency" else 0.0,
+                help="Hierarchical grad sync dispatched: "
+                f"{details['allreduce_schedule']} "
+                "(1 = latency path, 0 = bandwidth/flat)",
+            )
+        )
     else:
         details["grad_sync"] = f"implicit({sync_reason})"
         details["allreduce_schedule"] = "xla(implicit)"

@@ -654,6 +654,7 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     doc["device_kind"] = devices[0].device_kind
     _stamp_attribution(doc)
     _stamp_autotune(doc)
+    _stamp_hier_autotune(doc)
     _stamp_roofline(doc, primary_result)
     _stamp_matrix(doc)
     return doc
@@ -748,6 +749,70 @@ def _stamp_grad_sync(doc: dict) -> None:
         doc["collective_autotune"]["training_step_grad_sync"] = entry
     except Exception as exc:  # pragma: no cover - defensive
         print(f"grad-sync stamp failed: {exc!r}", file=sys.stderr)
+
+
+def _stamp_hier_autotune(doc: dict) -> None:
+    """Stamp the hierarchical DCN×ICI autotune evidence next to
+    ``collective_autotune``: the per-tier decision table (dcn cells
+    suffixed ``@dcn``), the tuned latency-path threshold (payloads
+    below it ride the full-payload few-round composition), and the
+    best tiered-vs-flat busbw ratio over the swept grid. The device
+    set is re-meshed into a synthetic (2, n/2) two-tier topology —
+    single-process stand-in; probes/dcn.py owns the real cross-host
+    tier — and CPU-fallback rounds are ``interpret_mode: true``, never
+    read against a TPU bar. Guarded: a failing tune costs this block,
+    not the artifact. ``ACTIVEMONITOR_BENCH_HIER=off`` disables."""
+    if os.environ.get("ACTIVEMONITOR_BENCH_HIER", "") == "off":
+        return
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from activemonitor_tpu.parallel.mesh import (
+            make_synthetic_two_tier_mesh,
+        )
+
+        devices = jax.devices()
+        n = len(devices)
+        mesh = make_synthetic_two_tier_mesh(devices)
+        if mesh is None:
+            return  # no two-tier re-mesh to race
+        from activemonitor_tpu.parallel import autotune
+
+        on_tpu = doc.get("platform") == "tpu"
+        # small grid: the latency-vs-bandwidth crossover lives at the
+        # small end; one mid payload anchors the bandwidth side
+        sizes = (0.016, 1.0, 16.0) if on_tpu else (0.004, 0.25)
+        tuned = autotune.tune_hierarchical(
+            mesh, sizes_mb=sizes, dtype=jnp.bfloat16,
+            iters=3 if on_tpu else 2,
+        )
+        tiered_vs_flat = None
+        best_cell = None
+        for size_mb, row in tuned.variant_results.items():
+            flat = row.get("flat", 0.0)
+            if flat <= 0:
+                continue
+            for variant in ("bandwidth", "latency"):
+                ratio = row.get(variant, 0.0) / flat
+                if tiered_vs_flat is None or ratio > tiered_vs_flat:
+                    tiered_vs_flat = round(ratio, 3)
+                    best_cell = {"variant": variant, "size_mb": size_mb}
+        doc["hierarchical_autotune"] = {
+            "interpret_mode": not on_tpu,
+            "mesh": {"dcn": 2, "ici": n // 2},
+            "tier_table": autotune.table_as_dict(keys=tuned.keys),
+            "latency_threshold_bytes": tuned.threshold_bytes,
+            "threshold_source": tuned.threshold_source,
+            "variant_busbw_gbps": {
+                f"{size_mb}MB": {k: round(v, 3) for k, v in row.items()}
+                for size_mb, row in tuned.variant_results.items()
+            },
+            "tiered_vs_flat": tiered_vs_flat,
+            "tiered_vs_flat_cell": best_cell,
+        }
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"hierarchical autotune stamp failed: {exc!r}", file=sys.stderr)
 
 
 def _stamp_roofline(doc: dict, result) -> None:

@@ -25,17 +25,28 @@ def test_single_process_degrades_gracefully():
     assert result.ok
     assert result.details["processes"] == 1
     assert result.metrics[0].name == "dcn-hosts"
+    # the skip names the two-tier topology it lacked (the run_per_axis
+    # skip contract applied to the dcn probe)
+    assert result.details["skipped"] is True
+    assert result.details["mesh"]["dcn"] == 1
+    assert result.details["mesh"]["ici"] >= 1
 
 
-def _run_two_workers(make_argv, timeout: float):
+def _run_two_workers(make_argv, timeout: float, local_devices: int = 1):
     """Spawn two worker processes against a fresh localhost coordinator
     and reap them. ``make_argv(rank, port)`` returns each worker's
-    argv. Survivors are ALWAYS killed — a worker wedged in a collective
-    (the exact failure these tests guard) must not outlive the test and
-    leak into the rest of the CI run."""
+    argv. ``local_devices`` > 1 forces a virtual per-process device
+    count so the (dcn, ici) mesh has a real inner tier. Survivors are
+    ALWAYS killed — a worker wedged in a collective (the exact failure
+    these tests guard) must not outlive the test and leak into the
+    rest of the CI run."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # 1 local device per process keeps it fast
+    if local_devices > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={local_devices}"
+        )
     # pick a free port so concurrent/parallel test runs don't collide
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -87,6 +98,57 @@ def test_two_process_dcn_allreduce():
         assert by_name["dcn-hosts"] == 2
         assert by_name["dcn-allreduce-correct"] == 1.0
         assert by_name["dcn-allreduce-busbw-gbps"] > 0
+        # the per-tier spelling + the hierarchical-composition gate
+        # ride the same contract line
+        assert by_name["dcn-xslice-busbw-gbps"] > 0
+        assert by_name["dcn-hier-allreduce-correct"] == 1.0
+
+
+@needs_cpu_multiprocess
+def test_two_process_hier_composition_over_real_tiers():
+    """The ISSUE-13 acceptance composition on REAL two-process tiers:
+    each worker carries 2 virtual local devices, the two processes
+    form one (dcn=2, ici=2) mesh, and the hierarchical all-reduce —
+    ICI reduce-scatter inside each process, DCN exchange between
+    them, ICI all-gather back — must match the joint psum bitwise-
+    deterministically on both workers, with the latency composition
+    agreeing too."""
+
+    def argv(rank, port):
+        driver = (
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "import jax.numpy as jnp;"
+            f"jax.distributed.initialize('127.0.0.1:{port}', 2, {rank});"
+            "from functools import partial;"
+            "from jax.sharding import PartitionSpec as P;"
+            "from activemonitor_tpu.parallel.mesh import make_multihost_mesh;"
+            "from activemonitor_tpu.parallel.partition import shard_map;"
+            "from activemonitor_tpu.parallel.schedules import ("
+            "    hier_all_reduce, hier_all_reduce_latency);"
+            "mesh = make_multihost_mesh();"
+            "assert dict(mesh.shape) == {'dcn': 2, 'ici': 2}, mesh.shape;"
+            "x = (jnp.arange(4 * 6 * 3, dtype=jnp.float32)"
+            "     .reshape(4 * 6, 3) % 7);"
+            "\n"
+            "@partial(shard_map, mesh=mesh, in_specs=P(('dcn', 'ici')),\n"
+            "         out_specs=P(None), check_vma=False)\n"
+            "def diffs(v):\n"
+            "    want = jax.lax.psum(v, ('dcn', 'ici'))\n"
+            "    bw = hier_all_reduce(v, 'dcn', 'ici', 2, 2)\n"
+            "    lat = hier_all_reduce_latency(v, 'dcn', 'ici', 2, 2)\n"
+            "    return jnp.stack([\n"
+            "        jnp.max(jnp.abs(bw - want)),\n"
+            "        jnp.max(jnp.abs(lat - want)),\n"
+            "    ])[None]\n"
+            "out = jax.jit(diffs)(x)\n"
+            "print('DIFFS', float(out[0, 0]), float(out[0, 1]))\n"
+        )
+        return [sys.executable, "-c", driver]
+
+    outputs = _run_two_workers(argv, timeout=240, local_devices=2)
+    for out in outputs:
+        (line,) = [l for l in out.splitlines() if l.startswith("DIFFS ")]
+        assert line == "DIFFS 0.0 0.0", out[-1200:]
 
 
 @needs_cpu_multiprocess

@@ -1,0 +1,872 @@
+"""Hierarchical DCN×ICI collective tests (ISSUE 13).
+
+The two-tier compositions (parallel/schedules.py) must be allclose to
+the joint ``lax.psum`` across composed meshes, send exactly their
+per-tier hop budgets (``_HOP_TIER_LOG`` vs ``theoretical_hier_hops``),
+and collapse BITWISE to the flat schedules on a degenerate 1-slice
+mesh. The tier-keyed autotuner must keep its tiers separate, tune a
+latency-path threshold from an injectable bench, and the tuned
+surface must demonstrably flip between the latency and bandwidth
+compositions across it — all on the virtual 8-device CPU mesh."""
+
+import collections
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import activemonitor_tpu.parallel.schedules as schedules
+from activemonitor_tpu.parallel import autotune
+from activemonitor_tpu.parallel.partition import (
+    resolve_tiers,
+    shard_map,
+)
+from activemonitor_tpu.parallel.schedules import (
+    hier_all_gather,
+    hier_all_reduce,
+    hier_all_reduce_bandwidth,
+    hier_all_reduce_latency,
+    hier_reduce_scatter,
+    hier_reduce_scatter_slot,
+    theoretical_hier_hops,
+)
+
+DCN, ICI = "dcn", "ici"
+
+
+def tier_mesh(n_dcn, n_ici):
+    devices = jax.devices()[: n_dcn * n_ici]
+    return Mesh(np.array(devices).reshape(n_dcn, n_ici), (DCN, ICI))
+
+
+def apply_tiered(mesh, fn, x, gathered=False):
+    out_specs = P(None) if gathered else P((DCN, ICI))
+    run = shard_map(
+        fn, mesh=mesh, in_specs=P((DCN, ICI)), out_specs=out_specs,
+        check_vma=False,
+    )
+    return run(x)
+
+
+def tier_hops(mesh, fn, x):
+    """Per-tier hop counts of one traced application."""
+    schedules._HOP_TIER_LOG = log = []
+    try:
+        apply_tiered(mesh, fn, x)
+    finally:
+        schedules._HOP_TIER_LOG = None
+    counts = collections.Counter(axis for axis, _tag, _step in log)
+    return {DCN: counts.get(DCN, 0), ICI: counts.get(ICI, 0)}
+
+
+# ---------------------------------------------------------------------------
+# schedule correctness + per-tier hop contracts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (2, 3), (2, 4), (4, 2)])
+@pytest.mark.parametrize("variant", ["bandwidth", "latency"])
+def test_hier_all_reduce_matches_psum(shape, variant):
+    """allclose vs the joint psum across composed meshes, odd 5
+    rows/shard so the bandwidth path's ici padding is exercised."""
+    n_dcn, n_ici = shape
+    mesh = tier_mesh(n_dcn, n_ici)
+    n = n_dcn * n_ici
+    x = jax.random.normal(jax.random.key(n), (n * 5, 3), jnp.float32)
+    fn = (
+        (lambda v: hier_all_reduce(v, DCN, ICI, n_dcn, n_ici))
+        if variant == "bandwidth"
+        else (lambda v: hier_all_reduce_latency(v, DCN, ICI, n_dcn, n_ici))
+    )
+    got = apply_tiered(mesh, fn, x)
+    want = apply_tiered(mesh, lambda v: jax.lax.psum(v, (DCN, ICI)), x)
+    assert jnp.allclose(got, want, atol=1e-5), (
+        shape, variant, float(jnp.max(jnp.abs(got - want)))
+    )
+
+
+@pytest.mark.parametrize(
+    "shape,dcn_schedule",
+    [((2, 4), "recdouble"), ((2, 4), "tree"), ((2, 2), "rsag"),
+     ((4, 2), "recdouble")],
+)
+def test_hier_bandwidth_per_tier_hop_budget(shape, dcn_schedule):
+    """The bandwidth composition sends exactly 2(n_ici−1) ICI rounds
+    (rs+ag) and the dcn schedule's own round count over DCN — counted
+    per tier via _HOP_TIER_LOG, pinned by theoretical_hier_hops."""
+    n_dcn, n_ici = shape
+    mesh = tier_mesh(n_dcn, n_ici)
+    x = jnp.ones((n_dcn * n_ici * 4, 2 + n_dcn + n_ici), jnp.float32)
+    got = tier_hops(
+        mesh,
+        lambda v: hier_all_reduce(
+            v, DCN, ICI, n_dcn, n_ici, dcn_schedule=dcn_schedule
+        ),
+        x,
+    )
+    want = theoretical_hier_hops(
+        n_dcn, n_ici, "bandwidth", dcn_schedule=dcn_schedule
+    )
+    assert got == want, (shape, dcn_schedule, got, want)
+
+
+@pytest.mark.parametrize("ici_schedule", ["recdouble", "tree"])
+def test_hier_latency_per_tier_hop_budget(ici_schedule):
+    n_dcn, n_ici = 2, 4
+    mesh = tier_mesh(n_dcn, n_ici)
+    x = jnp.ones((8 * 2, 3 + len(ici_schedule)), jnp.float32)
+    got = tier_hops(
+        mesh,
+        lambda v: hier_all_reduce_latency(
+            v, DCN, ICI, n_dcn, n_ici, ici_schedule=ici_schedule
+        ),
+        x,
+    )
+    want = theoretical_hier_hops(
+        n_dcn, n_ici, "latency", ici_schedule=ici_schedule
+    )
+    assert got == want, (ici_schedule, got, want)
+
+
+def test_hier_xla_dcn_tier_issues_no_explicit_dcn_hops():
+    """A tier riding its XLA builtin ("xla" psum for the scattered
+    exchange) issues zero explicit hops on that tier — the contract
+    theoretical_hier_hops states."""
+    mesh = tier_mesh(2, 4)
+    x = jnp.ones((8 * 4, 5), jnp.float32)
+    got = tier_hops(
+        mesh,
+        lambda v: hier_all_reduce(v, DCN, ICI, 2, 4, dcn_schedule="xla"),
+        x,
+    )
+    assert got == {DCN: 0, ICI: 6}
+    assert theoretical_hier_hops(2, 4, "bandwidth", dcn_schedule="xla") == {
+        "ici": 6, "dcn": 0,
+    }
+
+
+def test_hier_degenerate_single_slice_is_bitwise_flat():
+    """On a 1-slice ("dcn"=1) mesh the bandwidth composition IS the
+    flat rsag — bitwise — and the gather composition the flat ring."""
+    mesh = tier_mesh(1, 8)
+    x = jax.random.normal(jax.random.key(7), (8 * 5, 3), jnp.float32)
+    got = apply_tiered(mesh, lambda v: hier_all_reduce(v, DCN, ICI, 1, 8), x)
+    want = apply_tiered(
+        mesh, lambda v: schedules.all_reduce_rsag(v, ICI, 8), x
+    )
+    assert bool((got == want).all())
+    gathered = apply_tiered(
+        mesh, lambda v: hier_all_gather(v, DCN, ICI, 1, 8), x, gathered=True
+    )
+    flat = apply_tiered(
+        mesh, lambda v: schedules.all_gather_ring(v, ICI, 8), x,
+        gathered=True,
+    )
+    assert bool((gathered == flat).all())
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (2, 3), (4, 2)])
+def test_hier_all_gather_bitwise_matches_joint_gather(shape):
+    """The two-tier gather only MOVES data: bitwise equality with the
+    joint ``lax.all_gather((dcn, ici), tiled=True)`` — the dcn-major
+    P(("dcn","ici")) layout — is the contract."""
+    n_dcn, n_ici = shape
+    mesh = tier_mesh(n_dcn, n_ici)
+    n = n_dcn * n_ici
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P((DCN, ICI)), out_specs=P(None),
+        check_vma=False,
+    )
+    def diff(v):
+        got = hier_all_gather(v, DCN, ICI, n_dcn, n_ici)
+        want = jax.lax.all_gather(v, (DCN, ICI), tiled=True)
+        return jnp.max(jnp.abs(got - want))[None]
+
+    x = jax.random.normal(jax.random.key(3 + n), (n * 5, 2), jnp.float32)
+    assert float(diff(x)[0]) == 0.0
+
+
+def test_hier_reduce_scatter_slots_and_divisibility():
+    n_dcn, n_ici = 2, 4
+    n = n_dcn * n_ici
+    mesh = tier_mesh(n_dcn, n_ici)
+    rows = n  # one row per global chunk
+    x = jax.random.normal(jax.random.key(9), (n * rows, 2), jnp.float32)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P((DCN, ICI)),
+        out_specs=P((DCN, ICI)), check_vma=False,
+    )
+    def scattered(v):
+        return hier_reduce_scatter(v, DCN, ICI, n_dcn, n_ici)
+
+    got = scattered(x)
+    full = np.asarray(x).reshape(n, rows, 2).sum(axis=0)
+    for d in range(n_dcn):
+        for i in range(n_ici):
+            device = d * n_ici + i
+            slot = hier_reduce_scatter_slot(n_dcn, n_ici, d, i)
+            assert np.allclose(
+                np.asarray(got)[device], full[slot], atol=1e-5
+            ), (d, i, slot)
+    with pytest.raises(ValueError, match="hierarchical chunks"):
+        apply_tiered(
+            mesh,
+            lambda v: hier_reduce_scatter(v, DCN, ICI, n_dcn, n_ici),
+            jnp.ones((n * 3, 2), jnp.float32),  # 3 rows/shard: not /8
+        )
+
+
+def test_theoretical_hier_hops_table():
+    assert theoretical_hier_hops(2, 4, "bandwidth") == {"ici": 6, "dcn": 1}
+    assert theoretical_hier_hops(2, 4, "latency") == {"ici": 2, "dcn": 1}
+    assert theoretical_hier_hops(1, 8, "bandwidth") == {"ici": 14, "dcn": 0}
+    assert theoretical_hier_hops(4, 1, "bandwidth") == {"ici": 0, "dcn": 2}
+    assert theoretical_hier_hops(
+        2, 3, "bandwidth", dcn_schedule="tree"
+    ) == {"ici": 4, "dcn": 2}
+    assert theoretical_hier_hops(
+        2, 2, "latency", ici_schedule="tree"
+    ) == {"ici": 2, "dcn": 1}
+    assert theoretical_hier_hops(2, 4, collective="allgather") == {
+        "ici": 3, "dcn": 1,
+    }
+    assert theoretical_hier_hops(2, 4, collective="reducescatter") == {
+        "ici": 3, "dcn": 1,
+    }
+    with pytest.raises(ValueError, match="unknown hierarchical variant"):
+        theoretical_hier_hops(2, 4, "bogus")
+    with pytest.raises(ValueError, match="unknown hierarchical collective"):
+        theoretical_hier_hops(2, 4, collective="alltoall")
+
+
+def test_hier_bench_wrapper_reports_flat_conventions():
+    """The timed wrapper reports busbw in the flat all-reduce
+    convention (2(n−1)/n, n = TOTAL devices) for all three variants,
+    so tiered and flat numbers compare directly."""
+    mesh = tier_mesh(2, 4)
+    for variant in ("bandwidth", "latency", "flat"):
+        r = hier_all_reduce_bandwidth(
+            mesh, size_mb=0.01, iters=1, variant=variant
+        )
+        assert r.n_devices == 8
+        assert r.algbw_gbps > 0
+        assert r.busbw_gbps == pytest.approx(r.algbw_gbps * 2 * 7 / 8)
+    with pytest.raises(ValueError, match="unknown hierarchical bench"):
+        hier_all_reduce_bandwidth(mesh, size_mb=0.01, variant="bogus")
+
+
+# ---------------------------------------------------------------------------
+# tier-keyed autotuner + latency threshold
+# ---------------------------------------------------------------------------
+
+
+def test_tier_keyed_table_keeps_tiers_separate():
+    autotune.clear()
+    try:
+        autotune.record(
+            "allreduce", 2, 4096, jnp.float32, {"tree": 2.0, "xla": 1.0},
+            tier="dcn",
+        )
+        assert (
+            autotune.lookup("allreduce", 2, 4096, jnp.float32, tier="dcn")
+            == "tree"
+        )
+        # the ici tier (and the tier-less default spelling) never
+        # serves a dcn decision
+        assert autotune.lookup("allreduce", 2, 4096, jnp.float32) is None
+        assert (
+            autotune.lookup("allreduce", 2, 4096, jnp.float32, tier="ici")
+            is None
+        )
+        # serialized cells carry the tier suffix; default-tier cells
+        # keep the pre-hierarchy spelling
+        autotune.record("allreduce", 2, 4096, jnp.float32, {"rsag": 3.0})
+        table = autotune.table_as_dict()
+        assert set(table) == {
+            "allreduce/n2/2^12B/float32@dcn",
+            "allreduce/n2/2^12B/float32",
+        }
+    finally:
+        autotune.clear()
+
+
+def test_latency_threshold_default_recorded_and_cleared():
+    autotune.clear()
+    try:
+        assert (
+            autotune.latency_threshold("allreduce", 2, 4, jnp.bfloat16)
+            == autotune.DEFAULT_LATENCY_THRESHOLD_BYTES
+        )
+        autotune.record_latency_threshold("allreduce", 2, 4, jnp.bfloat16, 1 << 20)
+        assert (
+            autotune.latency_threshold("allreduce", 2, 4, jnp.bfloat16)
+            == 1 << 20
+        )
+        # other topologies/dtypes keep the default
+        assert (
+            autotune.latency_threshold("allreduce", 2, 8, jnp.bfloat16)
+            == autotune.DEFAULT_LATENCY_THRESHOLD_BYTES
+        )
+        with pytest.raises(ValueError, match=">= 0"):
+            autotune.record_latency_threshold("allreduce", 2, 4, jnp.bfloat16, -1)
+    finally:
+        autotune.clear()
+    # clear() wipes thresholds too
+    assert (
+        autotune.latency_threshold("allreduce", 2, 4, jnp.bfloat16)
+        == autotune.DEFAULT_LATENCY_THRESHOLD_BYTES
+    )
+
+
+def test_sweep_grid_reaches_the_latency_floor_and_octave_bound_holds():
+    """ISSUE satellite: the default grid reaches ~4KB, the payload
+    shaper actually produces ~4KB (not a silently clamped 16KB), and
+    the ±2-octave lookup fallback still holds at the new floor."""
+    from activemonitor_tpu.parallel.collectives import _payload
+
+    assert min(autotune.DEFAULT_SWEEP_SIZES_MB) == pytest.approx(0.004)
+    _rows, _cols, nbytes = _payload(0.004, jnp.bfloat16)
+    assert 2048 <= nbytes <= 8192, nbytes  # ~4KB, not the old 16KB floor
+    # the historical shape is untouched above the old floor
+    rows, cols, big = _payload(0.25, jnp.bfloat16)
+    assert cols == 1024 and big >= 244 * 1024
+    autotune.clear()
+    try:
+        floor_payload = nbytes  # bucket 11 for ~4KB
+        autotune.record(
+            "allreduce", 8, floor_payload, jnp.bfloat16,
+            {"recdouble": 2.0, "xla": 1.0},
+        )
+        bucket = autotune.payload_bucket(floor_payload)
+        # within 2 octaves below the floor: served
+        assert (
+            autotune.lookup(
+                "allreduce", 8, 1 << (bucket - 2), jnp.bfloat16
+            )
+            == "recdouble"
+        )
+        # 3 octaves below: the bound holds — fall back to the builtin
+        assert (
+            autotune.lookup("allreduce", 8, 1 << (bucket - 3), jnp.bfloat16)
+            is None
+        )
+    finally:
+        autotune.clear()
+
+
+class _FakeResult:
+    def __init__(self, busbw_gbps, payload_bytes):
+        self.busbw_gbps = busbw_gbps
+        self.payload_bytes = payload_bytes
+
+
+def _scripted_hier_benches(alpha_us=200.0, dcn_alpha_us=2000.0):
+    """Scripted α/B timings for both injectables: the latency
+    composition pays few rounds at full payload, the bandwidth one
+    many rounds of chunks at higher effective bandwidth — the
+    crossover in miniature, no hardware involved."""
+
+    def flat_bench(_collective, schedule, mesh, axis, size_mb, _dt, _it):
+        n = mesh.shape[axis]
+        payload = int(size_mb * 1e6)
+        rounds, beta = {
+            "xla": (2 * (n - 1), 5.0),
+            "rsag": (2 * (n - 1), 10.0),
+            "recdouble": (2, 1.0),
+            "tree": (3, 0.5),
+        }[schedule]
+        alpha = dcn_alpha_us if axis == "dcn" else alpha_us
+        seconds = alpha * 1e-6 * rounds + payload / (beta * 1e9)
+        return _FakeResult(payload / seconds / 1e9, payload)
+
+    def hier_bench(variant, mesh, dcn_axis, ici_axis, size_mb, _dt, _it):
+        n_dcn, n_ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+        payload = int(size_mb * 1e6)
+        if variant == "latency":
+            rounds = 2 + 1  # few full-payload rounds
+            seconds = alpha_us * 1e-6 * rounds + payload / (1.0 * 1e9)
+        elif variant == "bandwidth":
+            rounds = 2 * (n_ici - 1) + 1
+            seconds = alpha_us * 1e-6 * rounds + payload / (10.0 * 1e9)
+        else:  # flat: one slow joint ring
+            rounds = 2 * (n_dcn * n_ici - 1)
+            seconds = dcn_alpha_us * 1e-6 * rounds + payload / (8.0 * 1e9)
+        return _FakeResult(payload / seconds / 1e9, payload)
+
+    return flat_bench, hier_bench
+
+
+def test_tune_hierarchical_records_threshold_and_decision_flips():
+    """The acceptance-criterion unit test (PR-8 style, injectable
+    bench): tune_hierarchical finds the scripted latency/bandwidth
+    crossover, records the threshold, and the tuned surface then
+    dispatches the LATENCY composition below it and the BANDWIDTH one
+    above — proven by per-tier hop signatures."""
+    mesh = tier_mesh(2, 4)
+    flat_bench, hier_bench = _scripted_hier_benches()
+    autotune.clear()
+    try:
+        run = autotune.tune_hierarchical(
+            mesh, sizes_mb=(0.01, 2.0), dtype=jnp.float32, iters=1,
+            bench=flat_bench, hier_bench=hier_bench,
+        )
+        # scripted regime (α crossover ≈ 0.9 MB): latency wins 10KB,
+        # bandwidth wins 2MB → the threshold lands between them
+        assert run.threshold_source == "crossover"
+        assert int(0.01 * 1e6) < run.threshold_bytes <= int(2.0 * 1e6)
+        assert (
+            autotune.latency_threshold("allreduce", 2, 4, jnp.float32)
+            == run.threshold_bytes
+        )
+        # both tiers were flat-tuned under their own tier key
+        assert set(run.tier_runs) == {"dcn", "ici"}
+        assert any(k.tier == "dcn" for k in run.keys)
+        assert any(k.tier == "ici" for k in run.keys)
+
+        # decision flip, hop-proven: a small payload rides the latency
+        # composition (few full-payload rounds), a large one the
+        # bandwidth composition (hier-rs/hier-ag ici rings)
+        small = jnp.ones((8 * 2, 4), jnp.float32)  # 32B/shard
+        big = jnp.ones((8 * 2, 1 << 19), jnp.float32)  # 4MB/shard > threshold
+
+        def auto(v):
+            return autotune.all_reduce(
+                v, (DCN, ICI), schedule="auto", n=(2, 4)
+            )
+
+        schedules._HOP_LOG = log = []
+        try:
+            apply_tiered(mesh, auto, small)
+        finally:
+            schedules._HOP_LOG = None
+        small_tags = {tag for tag, _s in log}
+        assert not small_tags & {"hier-rs", "hier-ag"}, small_tags
+
+        schedules._HOP_LOG = log = []
+        try:
+            apply_tiered(mesh, auto, big)
+        finally:
+            schedules._HOP_LOG = None
+        big_tags = {tag for tag, _s in log}
+        assert {"hier-rs", "hier-ag"} <= big_tags, big_tags
+    finally:
+        autotune.clear()
+
+
+def test_tune_hierarchical_threshold_edge_sources():
+    mesh = tier_mesh(2, 4)
+    flat_bench, _ = _scripted_hier_benches()
+
+    def latency_always(variant, *_a):
+        return _FakeResult(
+            {"latency": 5.0, "bandwidth": 1.0, "flat": 0.5}[variant], 10**6
+        )
+
+    def bandwidth_always(variant, *_a):
+        return _FakeResult(
+            {"latency": 1.0, "bandwidth": 5.0, "flat": 0.5}[variant], 10**6
+        )
+
+    autotune.clear()
+    try:
+        run = autotune.tune_hierarchical(
+            mesh, sizes_mb=(1.0, 2.0), dtype=jnp.float32, iters=1,
+            bench=flat_bench, hier_bench=latency_always,
+        )
+        assert run.threshold_source == "latency-everywhere"
+        assert run.threshold_bytes == 2 * 10**6
+        run = autotune.tune_hierarchical(
+            mesh, sizes_mb=(1.0, 2.0), dtype=jnp.float32, iters=1,
+            bench=flat_bench, hier_bench=bandwidth_always,
+        )
+        assert run.threshold_source == "bandwidth-everywhere"
+        assert run.threshold_bytes == 10**6
+    finally:
+        autotune.clear()
+
+
+def test_hier_plan_paths_and_tuned_tier_winners():
+    autotune.clear()
+    try:
+        flat = autotune.hier_plan("allreduce", 1, 8, 4096, jnp.float32)
+        assert flat["path"] == "flat" and "dcn=1" in flat["reason"]
+        plan = autotune.hier_plan("allreduce", 2, 4, 4096, jnp.float32)
+        assert plan["variant"] == "latency"  # below the default 64KB
+        assert plan["threshold_bytes"] == autotune.DEFAULT_LATENCY_THRESHOLD_BYTES
+        big = autotune.hier_plan("allreduce", 2, 4, 1 << 20, jnp.float32)
+        assert big["variant"] == "bandwidth"
+        assert big["ici_schedule"] == "rsag"  # the composition's rings
+        # a tuned dcn cell at the CHUNK payload steers the exchange
+        autotune.record(
+            "allreduce", 2, (1 << 20) // 4, jnp.float32,
+            {"tree": 9.0, "recdouble": 1.0}, tier="dcn",
+        )
+        assert (
+            autotune.hier_plan("allreduce", 2, 4, 1 << 20, jnp.float32)[
+                "dcn_schedule"
+            ]
+            == "tree"
+        )
+        with pytest.raises(ValueError, match="unknown hierarchical schedule"):
+            autotune.hier_plan("allreduce", 2, 4, 4096, jnp.float32, "rsag")
+    finally:
+        autotune.clear()
+
+
+def test_tuple_axis_surface_edges():
+    mesh = tier_mesh(2, 4)
+    x = jnp.ones((8 * 2, 3), jnp.float32)
+    autotune.clear()
+    try:
+        # a 1-tuple degrades to the flat path
+        got = apply_tiered(
+            mesh,
+            lambda v: autotune.all_reduce(
+                jax.lax.psum(v, DCN), (ICI,), schedule="auto"
+            ),
+            x,
+        )
+        want = apply_tiered(mesh, lambda v: jax.lax.psum(v, (DCN, ICI)), x)
+        assert jnp.allclose(got, want)
+        # >2 tiers is a hard error, as is a scalar n for tuple axes
+        with pytest.raises(ValueError, match="exactly two tiers"):
+            apply_tiered(
+                mesh,
+                lambda v: autotune.all_reduce(v, (DCN, ICI, "x")),
+                x,
+            )
+        with pytest.raises(ValueError, match="tuple n per axis"):
+            apply_tiered(
+                mesh,
+                lambda v: autotune.all_reduce(v, (DCN, ICI), n=8),
+                x,
+            )
+        # "xla" is the joint builtin; scalars always ride it
+        got = apply_tiered(
+            mesh,
+            lambda v: autotune.all_reduce(v, (DCN, ICI), schedule="xla"),
+            x,
+        )
+        assert jnp.allclose(got, want)
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=P((DCN, ICI)),
+            out_specs=P(None), check_vma=False,
+        )
+        def scalar_auto(v):
+            return autotune.all_reduce(
+                jnp.sum(v), (DCN, ICI), schedule="auto", n=(2, 4)
+            )[None]
+
+        assert float(scalar_auto(x)[0]) == pytest.approx(8 * 2 * 3)
+        with pytest.raises(ValueError, match="unknown hierarchical schedule"):
+            apply_tiered(
+                mesh, lambda v: autotune.all_reduce(v, (DCN, ICI), "rsag"), x
+            )
+        # the gather surface has NO latency/bandwidth variants: a
+        # forced one errors instead of silently auto-tuning
+        with pytest.raises(ValueError, match="no\\s+latency/bandwidth"):
+            apply_tiered(
+                mesh,
+                lambda v: autotune.all_gather(v, (DCN, ICI), "latency"),
+                x,
+            )
+    finally:
+        autotune.clear()
+
+
+def test_degenerate_tuple_dispatch_is_bitwise_flat():
+    """auto over a ("dcn", "ici") pair with dcn=1 must be BITWISE the
+    flat auto dispatch — the acceptance criterion's degenerate-mesh
+    equivalence, at the tuned-surface level."""
+    mesh = tier_mesh(1, 8)
+    x = jnp.arange(8 * 4 * 3, dtype=jnp.float32).reshape(8 * 4, 3) % 13
+    autotune.clear()
+    try:
+        # tune a flat ici cell so BOTH paths dispatch the same zoo
+        # schedule (not just the builtin)
+        payload = (x.size // 8) * x.dtype.itemsize
+        autotune.record(
+            "allreduce", 8, payload, jnp.float32, {"tree": 2.0, "xla": 1.0}
+        )
+        got = apply_tiered(
+            mesh,
+            lambda v: autotune.all_reduce(v, (DCN, ICI), "auto", n=(1, 8)),
+            x,
+        )
+        want = apply_tiered(
+            mesh,
+            lambda v: autotune.all_reduce(v, ICI, "auto", n=8),
+            x,
+        )
+        assert bool((got == want).all())
+    finally:
+        autotune.clear()
+
+
+# ---------------------------------------------------------------------------
+# partition tier resolution + ops dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_tiers_rules():
+    mesh2 = tier_mesh(2, 4)
+    assert resolve_tiers(mesh2, "data") == (("dcn", "ici"), "")
+    axes, reason = resolve_tiers(tier_mesh(1, 8), "data")
+    assert axes == ("ici",) and "dcn=1" in reason
+    from activemonitor_tpu.parallel.mesh import make_2d_mesh
+
+    flat = make_2d_mesh(shape=(2, 4))
+    axes, reason = resolve_tiers(flat, "data")
+    assert axes == ("data",) and "flat" in reason
+    with pytest.raises(ValueError, match="neither axis"):
+        resolve_tiers(flat, "ep")
+
+
+def test_moe_dispatches_hierarchically_on_tier_mesh():
+    from activemonitor_tpu.ops.moe import (
+        init_moe_params,
+        moe_ffn_expert_parallel,
+        moe_ffn_reference,
+    )
+
+    mesh = tier_mesh(2, 4)
+    params = init_moe_params(jax.random.key(2), 16, 32, n_experts=8)
+    x = jax.random.normal(jax.random.key(3), (16, 16), jnp.float32)
+    autotune.clear()
+    try:
+        schedules._HOP_TIER_LOG = log = []
+        try:
+            got = moe_ffn_expert_parallel(params, x, mesh, axis="ep")
+        finally:
+            schedules._HOP_TIER_LOG = None
+        want = moe_ffn_reference(params, x)
+        assert jnp.allclose(got, want, atol=1e-4)
+        # the token gather really rode the two-tier composition
+        assert {axis for axis, _t, _s in log} == {"dcn", "ici"}
+    finally:
+        autotune.clear()
+
+
+def test_pipeline_combines_hierarchically_on_tier_mesh():
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        init_params,
+    )
+    from activemonitor_tpu.ops.pipeline import (
+        pipeline_forward_blocks,
+        stack_layer_params,
+    )
+
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=8, d_ff=32,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    stacked = stack_layer_params(
+        init_params(jax.random.key(4), cfg)["layers"]
+    )
+    x = jax.random.normal(jax.random.key(5), (8, 8, cfg.d_model), jnp.float32)
+    autotune.clear()
+    try:
+        hier = pipeline_forward_blocks(
+            stacked, x, cfg, tier_mesh(2, 4), axis="pp"
+        )
+        flat = pipeline_forward_blocks(
+            stacked, x, cfg, Mesh(np.array(jax.devices()), ("pp",)),
+            axis="pp",
+        )
+        # same stage ring (dcn-major linearization == flat device
+        # order), same combine sum: bitwise
+        assert bool((hier == flat).all())
+        # a flat zoo token on the two-tier combine is an error, not a
+        # silent downgrade to "auto"
+        with pytest.raises(ValueError, match="flat\\s+schedule token"):
+            pipeline_forward_blocks(
+                stacked, x, cfg, tier_mesh(2, 4), axis="pp",
+                allreduce_schedule="tree",
+            )
+    finally:
+        autotune.clear()
+
+
+# ---------------------------------------------------------------------------
+# training-step hierarchical grad sync
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_grad_sync_tier_gates():
+    from activemonitor_tpu.probes.training_step import resolve_grad_sync
+
+    mesh = tier_mesh(2, 4)
+    assert resolve_grad_sync(mesh, "dense", "auto") == ("hierarchical", "")
+    assert resolve_grad_sync(mesh, "dense", "xla") == ("hierarchical", "")
+    mode, why = resolve_grad_sync(mesh, "dense", "rsag")
+    assert mode == "implicit" and "two-tier" in why
+    mode, why = resolve_grad_sync(mesh, "flash", "auto")
+    assert mode == "implicit" and "flash" in why
+    mode, why = resolve_grad_sync(mesh, "dense", "auto", accum_steps=2)
+    assert mode == "implicit" and "accum" in why
+    # degenerate single-slice still rides the hierarchical resolve
+    # (the surface falls back to flat internally, reason recorded)
+    assert resolve_grad_sync(tier_mesh(1, 8), "dense", "auto") == (
+        "hierarchical", "",
+    )
+
+
+def test_training_step_hier_zero1_is_a_clear_error():
+    from activemonitor_tpu.models.probe_model import tiny_config
+    from activemonitor_tpu.probes.training_step import (
+        build_sharded_train_step,
+    )
+
+    with pytest.raises(ValueError, match="zero1 needs a 'data' mesh axis"):
+        build_sharded_train_step(
+            tiny_config(), tier_mesh(2, 4), zero1=True, init_state=False
+        )
+
+
+def test_training_step_runs_hierarchical_sync_and_exports_plan():
+    """The flagship acceptance path: run() on a ("dcn", "ici") mesh
+    dispatches the hierarchical grad sync with zero call-site changes
+    and exports the per-tier plan in its stdout-contract details."""
+    from activemonitor_tpu.probes import training_step
+
+    autotune.clear()
+    try:
+        r = training_step.run(
+            tiny=True, batch_per_device=2, seq=16, steps=1,
+            mesh=tier_mesh(2, 4), roofline=False,
+        )
+        assert r.ok, r.summary
+        assert r.details["grad_sync"] == "hierarchical"
+        plan = r.details["hier_sync"]
+        assert plan["path"] == "hierarchical"
+        assert plan["n_dcn"] == 2 and plan["n_ici"] == 4
+        assert {"variant", "ici_schedule", "dcn_schedule",
+                "threshold_bytes"} <= set(plan)
+        assert r.details["allreduce_schedule"].startswith(
+            f"hier/{plan['variant']}"
+        )
+        assert r.details["mesh"] == {"dcn": 2, "ici": 4}
+        assert r.details["batch"] == 2 * 8  # batch_per_device × n_dcn×n_ici
+        # the decision also rides the contract LINE as a gauge (help
+        # carries the per-tier schedule string)
+        by_name = {m.name: m for m in r.metrics}
+        gauge = by_name["training-step-hier-sync"]
+        assert gauge.value == (1.0 if plan["variant"] == "latency" else 0.0)
+        assert r.details["allreduce_schedule"] in gauge.help
+    finally:
+        autotune.clear()
+
+
+def test_training_step_degenerate_tier_mesh_reports_flat():
+    from activemonitor_tpu.probes import training_step
+
+    autotune.clear()
+    try:
+        r = training_step.run(
+            tiny=True, batch_per_device=2, seq=16, steps=1,
+            mesh=tier_mesh(1, 8), roofline=False,
+        )
+        assert r.ok, r.summary
+        assert r.details["grad_sync"] == "hierarchical"
+        assert r.details["allreduce_schedule"] == "hier-flat(dcn=1)"
+        assert r.details["hier_sync"]["path"] == "flat"
+    finally:
+        autotune.clear()
+
+
+# ---------------------------------------------------------------------------
+# probes + matrix surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_collectives_probe_hier_cases_and_structured_skip(monkeypatch):
+    from activemonitor_tpu.probes import collectives as collectives_probe
+
+    r = collectives_probe.run(
+        size_mb=0.01, iters=1,
+        cases=("allreduce-hier", "allreduce-hier-latency"),
+    )
+    assert r.ok
+    names = [m.name for m in r.metrics]
+    assert "collective-allreduce-hier-busbw-gbps" in names
+    assert "collective-allreduce-hier-latency-busbw-gbps" in names
+
+    real = jax.devices()
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: real[:2])
+    skipped = collectives_probe.run(
+        size_mb=0.01, iters=1, cases=("allreduce", "allreduce-hier")
+    )
+    assert skipped.ok
+    skip = skipped.details["hier_skipped"]["allreduce-hier"]
+    assert skip["mesh"] == {"dcn": 2, "ici": 1}
+    assert "even" in skip["reason"]
+    # only the possible case was measured
+    assert [m.name for m in skipped.metrics if "busbw" in m.name] == [
+        "collective-allreduce-busbw-gbps"
+    ]
+    with pytest.raises(ValueError, match="cannot be restricted"):
+        collectives_probe.run_per_axis(cases=("allreduce-hier",))
+
+
+def test_matrix_expands_hier_cells_with_payload_octaves():
+    from activemonitor_tpu.analysis import matrix as matrix_mod
+
+    spec = {
+        "ops": ["hier-allreduce"],
+        "meshes": [{"dcn": 2, "ici": 4}, {"dcn": 2, "ici": 8}],
+        "dtypes": ["bf16"],
+        "payloads_kb": [16, 4096],
+    }
+    cells, skipped = matrix_mod.expand(spec, n_devices=8)
+    assert [c.cell_id for c in cells] == [
+        "hier-allreduce/dcn2xici4/bf16/auto/16kb",
+        "hier-allreduce/dcn2xici4/bf16/auto/4096kb",
+    ]
+    # the impossible single-process expansion is a structured
+    # device-deficit skip, not a hole
+    deficit = [
+        r for r in skipped
+        if r.cell.mesh_id == "dcn2xici8"
+    ]
+    assert len(deficit) == 2
+    assert all("needs 16 devices" in r.reason for r in deficit)
+    # malformed payload tokens degrade to the default octaves
+    bad = dict(spec, payloads_kb=["x", -3])
+    cells, _ = matrix_mod.expand(bad, n_devices=8)
+    assert [c.payload_kb for c in cells] == list(
+        matrix_mod.DEFAULT_PAYLOADS_KB
+    )
+    # non-payload ops never multiply and keep their stable ids
+    flash = matrix_mod.expand(
+        {"ops": ["flash"], "meshes": [{}], "dtypes": ["f32"],
+         "payloads_kb": [16, 4096]},
+        n_devices=8,
+    )[0]
+    assert [c.cell_id for c in flash] == ["flash/1chip/f32"]
+
+
+def test_matrix_hier_runner_stamps_plan(monkeypatch):
+    import time
+
+    from activemonitor_tpu.analysis import matrix as matrix_mod
+
+    autotune.clear()
+    try:
+        cell = matrix_mod.CellSpec(
+            op="hier-allreduce", mesh=(("dcn", 2), ("ici", 4)),
+            dtype="float32", schedule="auto", payload_kb=16,
+        )
+        result = matrix_mod.execute_cell(cell, iters=1, timer=time.monotonic)
+        assert result.status == matrix_mod.STATUS_OK
+        assert result.schedule.startswith("hier/")
+        assert result.details["hier_plan"]["n_ici"] == 4
+        assert result.seconds > 0
+    finally:
+        autotune.clear()

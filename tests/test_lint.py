@@ -895,6 +895,85 @@ def test_roofline_metric_names_are_pinned():
         assert key in bench_src, f"bench.py no longer records {key}"
 
 
+def test_hierarchical_metric_names_are_pinned():
+    """The ISSUE-13 hierarchical-collective names are contract
+    spelling across the layers: the dcn probe emits the per-tier
+    gauges, the collectives probe the composition cases, docs register
+    every spelling, and bench.py stamps the hierarchical_autotune
+    evidence block — a rename in any one layer silently orphans the
+    others (the same gate the overlap/zoo/roofline names got)."""
+    import ast
+
+    docs = (REPO / "docs" / "probes.md").read_text()
+    pinned_metrics = {
+        "dcn-xslice-busbw-gbps": "probes/dcn.py",
+        "dcn-xslice-fraction-of-rated": "probes/dcn.py",
+        "dcn-hier-allreduce-correct": "probes/dcn.py",
+        "training-step-hier-sync": "probes/training_step.py",
+        "collective-allreduce-hier-busbw-gbps": None,  # f-string-built
+        "collective-allreduce-hier-latency-busbw-gbps": None,
+    }
+    for name, rel in pinned_metrics.items():
+        assert name in docs, f"{name} missing from docs/probes.md metric table"
+        if rel is None:
+            continue
+        src = (REPO / "activemonitor_tpu" / rel).read_text()
+        declared = {
+            node.value
+            for node in ast.walk(ast.parse(src))
+            if isinstance(node, ast.Constant) and isinstance(node.value, str)
+        }
+        assert name in declared, f"{name} not declared in {rel}"
+    # the composition cases are part of the collectives-probe contract
+    from activemonitor_tpu.probes.collectives import HIER_CASES, _BENCH
+
+    for case in ("allreduce-hier", "allreduce-hier-latency"):
+        assert case in HIER_CASES
+        assert case in _BENCH
+        assert case in docs, f"hier case {case} missing from docs/probes.md"
+    # the rated DCN denominator + its override are registered
+    from activemonitor_tpu.probes.rated import RatedSpec
+
+    assert "dcn_gbps" in {f.name for f in __import__("dataclasses").fields(RatedSpec)}
+    rated_src = (
+        REPO / "activemonitor_tpu" / "probes" / "rated.py"
+    ).read_text()
+    assert "ACTIVEMONITOR_RATED_DCN_GBPS" in rated_src
+    assert "ACTIVEMONITOR_RATED_DCN_GBPS" in docs
+    # the catalog section the metric rows point at
+    training = (REPO / "docs" / "training.md").read_text()
+    for anchor in (
+        "Hierarchical collectives",
+        "hier_all_reduce",
+        "latency_threshold",
+        "resolve_tiers",
+        "hier_plan",
+    ):
+        assert anchor in training, f"training.md lost {anchor}"
+    # bench.py's hierarchical evidence block (both paths stamp it;
+    # interpret-mode tables labeled) and the matrix's two-tier rows
+    bench_src = (REPO / "bench.py").read_text()
+    for key in (
+        "hierarchical_autotune",
+        "latency_threshold_bytes",
+        "tiered_vs_flat",
+        "tier_table",
+    ):
+        assert key in bench_src, f"bench.py no longer records {key}"
+    import json
+
+    matrix_spec = json.loads(
+        (REPO / "config" / "bench_matrix.json").read_text()
+    )
+    assert "hier-allreduce" in matrix_spec["ops"]
+    assert {"dcn": 2, "ici": 4} in matrix_spec["meshes"]
+    assert matrix_spec.get("payloads_kb"), "matrix lost its payload octaves"
+    # the matrix op registry carries the runner-backed op
+    from activemonitor_tpu.analysis.matrix import OPS, _RUNNERS
+
+    assert "hier-allreduce" in OPS and "hier-allreduce" in _RUNNERS
+
+
 def test_wallclock_banned_in_matrix_module(tmp_path):
     """The scenario-matrix module (ISSUE 12) carries the injectable-
     Clock contract wherever it lands: verdicts/baselines run on the

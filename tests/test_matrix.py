@@ -521,10 +521,19 @@ def test_full_matrix_soak_runs_every_default_cell(tmp_path):
             # sync back to the XLA-inserted reduction — the stamp must
             # report what RAN, not the requested token
             assert entry["schedule"] == "xla(implicit)", entry
-    # the skips stayed structured
+    # the skips stayed structured (SKIP_DEVICES: the default spec's
+    # deliberate dcn2xici8 single-process impossibility)
     assert all(
-        r.details["skip"]["code"] == matrix_mod.SKIP_MISSING_AXIS
-        or r.details["skip"]["code"] == matrix_mod.SKIP_UNSUPPORTED_DTYPE
+        r.details["skip"]["code"] in (
+            matrix_mod.SKIP_MISSING_AXIS,
+            matrix_mod.SKIP_UNSUPPORTED_DTYPE,
+            matrix_mod.SKIP_DEVICES,
+        )
+        for r in skipped
+    )
+    assert any(
+        r.details["skip"]["code"] == matrix_mod.SKIP_DEVICES
+        and r.cell.mesh_id == "dcn2xici8"
         for r in skipped
     )
 
