@@ -143,6 +143,10 @@ class OpDef:
     # (the hierarchical all-reduce's latency-vs-bandwidth crossover);
     # compute ops carry their own fixed shapes and never multiply
     accepts_payload: bool = False
+    # whether the op expands over the spec's batch ceilings
+    # ("batch_ceilings") — the serving op's admission regime IS the
+    # in-flight batch ceiling, the way a collective's is its payload
+    accepts_batch: bool = False
 
 
 # payload octaves (KB) a payload-accepting op expands over when the
@@ -151,6 +155,11 @@ class OpDef:
 # well above it, so both sides of the small-message crossover get a
 # baseline from round one
 DEFAULT_PAYLOADS_KB = (16, 4096)
+
+# batch-ceiling octaves a batch-accepting op (serving) expands over
+# when the spec doesn't say: a narrow and a wide admission ceiling, so
+# occupancy-vs-latency tradeoffs get a baseline from round one
+DEFAULT_BATCH_CEILINGS = (2, 4)
 
 
 # the op registry: flash/ring/moe/pipeline/decode/training-step — the
@@ -192,6 +201,15 @@ OPS: Dict[str, OpDef] = {
         collective="allreduce",
         accepts_payload=True,
     ),
+    # the continuous-batching serving loop (ops/kv_cache.py paged KV +
+    # scheduler/serving.py admission; probes/serving.py engine): kv
+    # heads shard over "model" via the kv partition rules, and the
+    # scenario dimension is the admission BATCH CEILING, not a payload
+    # or schedule. float32-only like decode (the continuous-vs-static
+    # logits gate is a numerics contract).
+    "serving": OpDef(
+        "serving", ("model",), ("float32",), accepts_batch=True
+    ),
 }
 
 
@@ -209,6 +227,7 @@ class CellSpec:
     dtype: str  # canonical dtype name
     schedule: str  # "auto" | explicit zoo token | "-" (no collective)
     payload_kb: Optional[int] = None  # payload octave (accepts_payload ops)
+    batch: Optional[int] = None  # admission ceiling (accepts_batch ops)
 
     @property
     def mesh_id(self) -> str:
@@ -224,6 +243,8 @@ class CellSpec:
             parts.append(self.schedule)
         if self.payload_kb is not None:
             parts.append(f"{self.payload_kb}kb")
+        if self.batch is not None:
+            parts.append(f"b{self.batch}")
         return "/".join(parts)
 
     @property
@@ -269,7 +290,7 @@ DEFAULT_SPEC: dict = {
     "version": MATRIX_VERSION,
     "ops": [
         "flash", "ring", "moe", "pipeline", "decode", "training-step",
-        "hier-allreduce",
+        "hier-allreduce", "serving",
     ],
     "meshes": [
         {"sp": 8},
@@ -284,6 +305,7 @@ DEFAULT_SPEC: dict = {
     "dtypes": ["bf16", "f32"],
     "schedules": ["auto"],
     "payloads_kb": list(DEFAULT_PAYLOADS_KB),
+    "batch_ceilings": list(DEFAULT_BATCH_CEILINGS),
 }
 
 
@@ -313,7 +335,10 @@ def load_spec(path: Optional[str]) -> Tuple[dict, Optional[dict]]:
             "detail": f"{path}: top level is {type(doc).__name__}",
         }
     spec = dict(DEFAULT_SPEC)
-    for key in ("ops", "meshes", "dtypes", "schedules", "payloads_kb"):
+    for key in (
+        "ops", "meshes", "dtypes", "schedules", "payloads_kb",
+        "batch_ceilings",
+    ):
         value = doc.get(key)
         if isinstance(value, list) and value:
             spec[key] = value
@@ -349,6 +374,16 @@ def expand(
         if value > 0:
             parsed_payloads.append(value)
     payload_octaves = parsed_payloads or list(DEFAULT_PAYLOADS_KB)
+    # batch ceilings for accepts_batch ops, same degradation contract
+    parsed_batches: List[int] = []
+    for token in spec.get("batch_ceilings") or list(DEFAULT_BATCH_CEILINGS):
+        try:
+            value = int(token)
+        except (TypeError, ValueError):
+            continue
+        if value > 0:
+            parsed_batches.append(value)
+    batch_ceilings = parsed_batches or list(DEFAULT_BATCH_CEILINGS)
     for op_token in spec.get("ops") or []:
         op = OPS.get(str(op_token))
         for mesh_doc in spec.get("meshes") or [{}]:
@@ -371,14 +406,24 @@ def expand(
                     # scenarios
                     schedules = ["auto"]
                 # payload octaves only for ops whose regime IS the
-                # payload (the hierarchical all-reduce crossover)
+                # payload (the hierarchical all-reduce crossover);
+                # batch ceilings only for the serving-shaped ops whose
+                # regime is the admission ceiling
                 payloads: List[Optional[int]] = (
                     list(payload_octaves)
                     if op is not None and op.accepts_payload
                     else [None]
                 )
-                for schedule, payload_kb in (
-                    (s, p) for s in schedules for p in payloads
+                batches: List[Optional[int]] = (
+                    list(batch_ceilings)
+                    if op is not None and op.accepts_batch
+                    else [None]
+                )
+                for schedule, payload_kb, batch in (
+                    (s, p, b)
+                    for s in schedules
+                    for p in payloads
+                    for b in batches
                 ):
                     cell = CellSpec(
                         op=str(op_token),
@@ -386,6 +431,7 @@ def expand(
                         dtype=canonical or str(dtype_token),
                         schedule=str(schedule),
                         payload_kb=payload_kb,
+                        batch=batch,
                     )
                     if cell.cell_id in seen:
                         # alias dtype tokens ("bf16" + "bfloat16") and
@@ -840,6 +886,58 @@ def _run_hier_allreduce(cell: CellSpec, iters: int, timer) -> CellResult:
     )
 
 
+def _run_serving(cell: CellSpec, _iters: int, timer) -> CellResult:
+    # _iters: the soak already repeats its decode step many times, so
+    # the shared per-runner repeat knob has nothing further to add
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import ProbeModelConfig
+    from activemonitor_tpu.probes import serving as serving_probe
+    from activemonitor_tpu.scheduler.serving import open_loop_requests
+
+    mesh = _cell_mesh(cell)
+    tp = dict(cell.mesh)["model"]
+    dt = jnp.dtype(cell.dtype)
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq_len=32, dtype=dt,
+    )
+    batch = cell.batch or DEFAULT_BATCH_CEILINGS[0]
+    # a saturating arrival burst (rate far above service): the cell
+    # measures steady decode-step seconds under a full batch, and the
+    # kv partition rules re-mesh the paged storage over "model" (a
+    # wrong layout raises into the visible error path)
+    requests = open_loop_requests(
+        2 * batch, 1e6, seed=9,
+        prompt_len_choices=(4, 8), output_choices=(3, 4),
+    )
+    soak = serving_probe.run_soak(
+        cfg, requests, max_batch=batch, block_size=8, timer=timer,
+        mesh=mesh, tp_axis="model",
+    )
+    # ONE analytic cost model, the probe's own (serving_probe.
+    # roofline_inputs — measured occupancy + banked-KV footprint): the
+    # roofline stamp under a confirmed regression must be the same
+    # model the probe exports, not a hand-copied twin
+    cost = serving_probe.roofline_inputs(soak, cfg, batch)
+    seconds = max(cost["seconds"], 1e-9)
+    flops = cost["flops"]
+    hbm = cost["bytes"]
+    cons = soak.scheduler.conservation()
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm,
+        details={
+            "serving": {
+                "tokens_per_s": round(soak.tokens_per_second, 2),
+                "occupancy": round(soak.occupancy, 4),
+                "conserved": bool(cons["ok"]),
+                "tp_axis_n": tp,
+            }
+        },
+    )
+
+
 _RUNNERS: Dict[str, Callable] = {
     "flash": _run_flash,
     "ring": _run_ring,
@@ -848,6 +946,7 @@ _RUNNERS: Dict[str, Callable] = {
     "decode": _run_decode,
     "training-step": _run_training_step,
     "hier-allreduce": _run_hier_allreduce,
+    "serving": _run_serving,
 }
 
 
@@ -1101,6 +1200,8 @@ class MatrixObservatory:
         }
         if cell.payload_kb is not None:
             entry["payload_kb"] = cell.payload_kb
+        if cell.batch is not None:
+            entry["batch"] = cell.batch
         if fallback_reason:
             entry["fallback_reason"] = fallback_reason
         if result.status != STATUS_OK:

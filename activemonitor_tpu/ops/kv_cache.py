@@ -1,0 +1,353 @@
+"""Paged KV cache — fixed-size blocks with per-sequence block tables.
+
+The serving runtime's memory system (ROADMAP item 5). Static-batch
+decode gives every sequence a contiguous ``[B, S]`` cache slab sized
+for the worst case, so admission is all-or-nothing and the slack in
+short sequences is dead HBM. Continuous batching instead pools K/V in
+fixed-size BLOCKS: a sequence owns an ordered block table, admission is
+a free-list question, retirement returns blocks for immediate reuse,
+and the only waste is the measurable slack inside each sequence's last
+partially-filled block (the vLLM PagedAttention idea, sized for the
+probe model).
+
+Three layers, same file so the layout story has one home:
+
+- :class:`KVBlockManager` — the pure-Python allocator: free list,
+  per-sequence block tables, allocate/append/free, and EXPLICIT
+  fragmentation accounting (:meth:`~KVBlockManager.fragmentation_ratio`
+  — reserved-but-unwritten slots over reserved slots). Deficits are
+  structured refusals (``None``/``False``), never exceptions: the
+  admission scheduler turns them into queueing decisions, and an
+  out-of-blocks storm must not crash the serving loop.
+- the jax storage — :func:`init_paged_kv` allocates
+  ``[n_layers, n_blocks, kv_heads, block_size, head_dim]`` pools whose
+  layout is expressed as PARTITION RULES (:func:`kv_partition_rules`)
+  resolved through ``parallel/partition.py`` like every other op:
+  kv heads shard over the tensor-parallel axis, the block pool is
+  replicated, re-meshing is an edit to a rules tuple, a rule naming an
+  axis the mesh lacks raises up front, and scalar leaves never
+  partition.
+- the compute — :func:`bank_prompt` scatters a prefilled sequence's
+  K/V into its blocks; :func:`paged_decode_step` is ``decode_step``'s
+  paged sibling: per-sequence positions (a continuous batch has no
+  single scalar ``pos``), K/V gathered through the block tables, new
+  K/V scattered to each sequence's (block, offset). The serving probe
+  pins its logits against the static per-sequence path — the two
+  implementations must not drift.
+
+Slot-padding convention for fixed-shape batches: callers reserve one
+block index OUTSIDE the manager's pool as a trash block (the serving
+engine allocates ``n_blocks + 1`` storage blocks and points every
+inactive slot's table at the last one), so inactive batch slots scatter
+into garbage no live sequence reads instead of corrupting block 0.
+
+No wall-clock reads here (``hack/lint.py`` bans them: the manager's
+whole state is allocation arithmetic and the compute is pure) — any
+timing belongs to the caller's injectable timer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from activemonitor_tpu.models.probe_model import ProbeModelConfig, _rmsnorm
+
+
+def kv_bytes_per_token(cfg: ProbeModelConfig) -> float:
+    """HBM bytes one generated token ADDS to the cache (K and V, every
+    layer) — the single bytes-per-token figure both the static decode
+    probe (``decode-kv-bytes-per-token``) and the serving probe's
+    memory-bound ceiling derive from, so the two roofline inputs cannot
+    drift apart."""
+    return float(
+        2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+
+
+# ---------------------------------------------------------------------
+# the allocator (pure Python — no jax, no clock)
+# ---------------------------------------------------------------------
+
+
+class KVBlockManager:
+    """Free-list block allocator with per-sequence block tables.
+
+    Capacity is reserved whole at :meth:`allocate` (admission time) and
+    consumed by :meth:`append` as tokens bank their K/V — so a sequence
+    admitted under the block budget can never hit a mid-flight
+    out-of-memory; the only refusal point is admission itself, where
+    the scheduler can queue. Freed blocks return to the free list LIFO,
+    so a retirement's blocks are the very next admission's grant
+    (locality + a deterministic reuse order tests can pin).
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"need n_blocks >= 1 and block_size >= 1, got "
+                f"{n_blocks}/{block_size}"
+            )
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        # stack: pop() grants from the END, so seed it reversed (first
+        # grant is block 0) and append frees for LIFO reuse
+        self._free: List[int] = list(range(n_blocks - 1, -1, -1))
+        self._tables: Dict[int, List[int]] = {}
+        self._lengths: Dict[int, int] = {}  # tokens appended (banked K/V)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` K/V entries."""
+        return -(-max(0, n_tokens) // self.block_size)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    @property
+    def banked_tokens(self) -> int:
+        """Total K/V entries written across live sequences — the live
+        cache footprint the serving roofline's bytes model reads."""
+        return sum(self._lengths.values())
+
+    def can_allocate(self, capacity_tokens: int) -> bool:
+        return self.blocks_for(capacity_tokens) <= len(self._free)
+
+    def allocate(self, seq_id: int, capacity_tokens: int) -> Optional[List[int]]:
+        """Reserve blocks for a sequence's full K/V capacity. Returns
+        the granted block table, or ``None`` when the free list cannot
+        cover it — the structured admission refusal, never a raise.
+        Re-allocating a live sequence id IS a raise: that is a caller
+        bug, not a capacity condition."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already holds blocks")
+        need = self.blocks_for(capacity_tokens)
+        if need > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = blocks
+        self._lengths[seq_id] = 0
+        return list(blocks)
+
+    def table(self, seq_id: int) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def length(self, seq_id: int) -> int:
+        return self._lengths[seq_id]
+
+    def append(self, seq_id: int, n_tokens: int = 1) -> bool:
+        """Advance a sequence's banked-token count. ``False`` (the
+        structured refusal) when the reserved capacity cannot hold the
+        new tokens — the caller under-reserved at admission."""
+        if seq_id not in self._tables:
+            return False
+        capacity = len(self._tables[seq_id]) * self.block_size
+        if self._lengths[seq_id] + n_tokens > capacity:
+            return False
+        self._lengths[seq_id] += n_tokens
+        return True
+
+    def free(self, seq_id: int) -> int:
+        """Return a retired sequence's blocks to the free list (LIFO —
+        the next allocation reuses them first). Returns the number of
+        blocks released; freeing an unknown id is 0, not a raise."""
+        blocks = self._tables.pop(seq_id, None)
+        if blocks is None:
+            return 0
+        del self._lengths[seq_id]
+        self._free.extend(blocks)
+        return len(blocks)
+
+    def fragmentation_ratio(self) -> float:
+        """Reserved-but-unwritten K/V slots over all reserved slots —
+        the explicit fragmentation account: block-granular reservation
+        means every sequence carries up to ``block_size - 1`` slack
+        slots plus whatever capacity it reserved but has not banked
+        yet. 0.0 with nothing allocated (no reservation, no waste)."""
+        reserved = self.used_blocks * self.block_size
+        if reserved == 0:
+            return 0.0
+        used = sum(self._lengths.values())
+        return (reserved - used) / reserved
+
+    def stats(self) -> dict:
+        return {
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+            "free_blocks": self.free_blocks,
+            "used_blocks": self.used_blocks,
+            "sequences": len(self._tables),
+            "fragmentation_ratio": self.fragmentation_ratio(),
+        }
+
+
+# ---------------------------------------------------------------------
+# the storage + its partition rules
+# ---------------------------------------------------------------------
+
+
+def init_paged_kv(
+    cfg: ProbeModelConfig, n_blocks: int, block_size: int
+) -> Dict[str, jax.Array]:
+    """The pooled K/V storage: ``[L, n_blocks, Hkv, block_size, Dh]``
+    per tensor, compute-dtyped. Block-major so one sequence's gather is
+    a take along dim 1; heads on dim 2 so the tensor-parallel shard is
+    whole kv heads (the same GQA memory story as ``init_kv_cache``)."""
+    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def kv_partition_rules(tp_axis: str = "model"):
+    """The paged-cache layout as DATA (parallel/partition.py): kv heads
+    shard over ``tp_axis`` — each shard owns whole heads of every block
+    — and the block pool itself is replicated across the axis, the same
+    megatron split the probe model's attention weights use. Re-meshing
+    the cache is an edit to this tuple, never to the compute."""
+    return ((r"^k$|^v$", P(None, None, tp_axis, None, None)),)
+
+
+def paged_kv_specs(
+    cfg: ProbeModelConfig,
+    n_blocks: int,
+    block_size: int,
+    tp_axis: str = "model",
+    mesh: Optional[Mesh] = None,
+):
+    """The rules resolved over the abstract storage tree. Passing
+    ``mesh`` validates up front: a rules tuple naming an axis the mesh
+    does not carry is a ValueError here, never a tracer crash inside
+    the serving loop — and scalar leaves resolve to ``P()`` like
+    everywhere else."""
+    from activemonitor_tpu.parallel.partition import match_partition_rules
+
+    abstract = jax.eval_shape(lambda: init_paged_kv(cfg, n_blocks, block_size))
+    return match_partition_rules(
+        kv_partition_rules(tp_axis), abstract, mesh=mesh
+    )
+
+
+def shard_paged_kv(
+    storage: Dict[str, jax.Array],
+    cfg: ProbeModelConfig,
+    mesh: Mesh,
+    tp_axis: str = "model",
+):
+    """Place the storage on its resolved shardings (validated). Returns
+    the sharded tree; the specs come from the same rules tuple, so a
+    wrong layout raises before any device_put."""
+    from activemonitor_tpu.parallel.partition import make_shard_fns
+
+    n_blocks, block_size = storage["k"].shape[1], storage["k"].shape[3]
+    specs = paged_kv_specs(cfg, n_blocks, block_size, tp_axis, mesh=mesh)
+    fns = make_shard_fns(specs, mesh)
+    return jax.tree.map(lambda fn, x: fn(x), fns, storage)
+
+
+# ---------------------------------------------------------------------
+# the compute: bank a prefilled prompt, step a continuous batch
+# ---------------------------------------------------------------------
+
+
+def bank_prompt(
+    storage: Dict[str, jax.Array],
+    prompt_k: jax.Array,
+    prompt_v: jax.Array,
+    blocks: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Scatter one prefilled sequence's K/V (``[L, Hkv, S, Dh]``,
+    heads-major like the contiguous cache) into its block table. The
+    tail of the last block stays zero — inert slack the position mask
+    never exposes, and exactly what the fragmentation ratio counts."""
+    n_layers, heads, seq, head_dim = prompt_k.shape
+    blocks = jnp.asarray(blocks, jnp.int32)
+    block_size = storage["k"].shape[3]
+    cap = int(blocks.shape[0]) * block_size
+    pad = [(0, 0), (0, 0), (0, cap - seq), (0, 0)]
+
+    def blocked(x: jax.Array) -> jax.Array:
+        x = jnp.pad(x, pad)  # [L, Hkv, cap, Dh]
+        x = x.reshape(n_layers, heads, blocks.shape[0], block_size, head_dim)
+        return jnp.moveaxis(x, 1, 2)  # [L, n_blk, Hkv, bs, Dh]
+
+    return {
+        "k": storage["k"].at[:, blocks].set(blocked(prompt_k)),
+        "v": storage["v"].at[:, blocks].set(blocked(prompt_v)),
+    }
+
+
+def paged_decode_step(
+    params: Dict,
+    storage: Dict[str, jax.Array],
+    token: jax.Array,
+    pos: jax.Array,
+    block_tables: jax.Array,
+    cfg: ProbeModelConfig,
+):
+    """One decode step over a continuous batch of paged sequences.
+
+    ``token``: ``[B]`` int32; ``pos``: ``[B]`` int32 — each sequence's
+    own write position (a continuous batch has no shared scalar pos);
+    ``block_tables``: ``[B, max_blocks]`` int32, inactive slots padded
+    with a trash block id (module docstring). Returns
+    ``(logits [B, V], storage)``. Static shapes throughout: the batch
+    width and table width are fixed, so the step jits once and reruns
+    for the whole soak — the same contract as ``decode_step``, whose
+    per-position math this must match within numeric tolerance (the
+    serving probe's correctness gate)."""
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[token]  # [B, D]
+    batch = token.shape[0]
+    block_size = storage["k"].shape[3]
+    cap = block_tables.shape[1] * block_size
+    visible = jnp.arange(cap)[None, :] <= pos[:, None]  # [B, S]
+    group = cfg.n_heads // cfg.kv_heads
+    write_block = jnp.take_along_axis(
+        block_tables, (pos // block_size)[:, None], axis=1
+    )[:, 0]  # [B]
+    offset = pos % block_size  # [B]
+    for li, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1"]["scale"])
+        if "wqkv" in layer:
+            qkv = jnp.einsum("bd,dthk->tbhk", h, layer["wqkv"].astype(dt))
+            q, k_new, v_new = qkv[0], qkv[1], qkv[2]  # [B, H, K]
+        else:  # GQA: q over n_heads, k/v over the narrower kv_heads
+            q = jnp.einsum("bd,dhk->bhk", h, layer["wq"].astype(dt))
+            kv = jnp.einsum("bd,dthk->tbhk", h, layer["wkv"].astype(dt))
+            k_new, v_new = kv[0], kv[1]  # [B, Hkv, K]
+        # scatter each sequence's new K/V to its own (block, offset)
+        storage["k"] = storage["k"].at[li, write_block, :, offset].set(k_new)
+        storage["v"] = storage["v"].at[li, write_block, :, offset].set(v_new)
+        # gather the batch's caches through the block tables:
+        # [B, n_blk, Hkv, bs, Dh] -> heads-major contiguous [B, Hkv, S, Dh]
+        keys = jnp.moveaxis(storage["k"][li][block_tables], 2, 1).reshape(
+            batch, cfg.kv_heads, cap, cfg.head_dim
+        )
+        values = jnp.moveaxis(storage["v"][li][block_tables], 2, 1).reshape(
+            batch, cfg.kv_heads, cap, cfg.head_dim
+        )
+        qg = q.reshape(batch, cfg.kv_heads, group, cfg.head_dim)
+        scores = jnp.einsum("bhgk,bhsk->bhgs", qg, keys) / jnp.sqrt(
+            jnp.asarray(cfg.head_dim, dt)
+        )
+        scores = jnp.where(
+            visible[:, None, None, :], scores, jnp.asarray(-1e9, dt)
+        )
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        attn = jnp.einsum("bhgs,bhsk->bhgk", probs, values).reshape(
+            batch, cfg.n_heads, cfg.head_dim
+        )
+        x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"].astype(dt))
+        h = _rmsnorm(x, layer["ln2"]["scale"])
+        up = jax.nn.gelu(jnp.einsum("bd,df->bf", h, layer["w_up"].astype(dt)))
+        x = x + jnp.einsum("bf,fd->bd", up, layer["w_down"].astype(dt))
+    x = _rmsnorm(x, params["final_ln"]["scale"])
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(dt))
+    return logits.astype(jnp.float32), storage

@@ -275,6 +275,27 @@ def build_parser() -> argparse.ArgumentParser:
         "(flash_decode: one blockwise HBM pass over the cache)",
     )
 
+    p = sub.add_parser(
+        "serving",
+        help="continuous-batching serving loop: paged KV cache + "
+        "in-flight admission under open-loop Poisson traffic "
+        "(tokens/s, TTFT/inter-token tails, occupancy, KV "
+        "fragmentation; gates on continuous-vs-static logits "
+        "agreement and exact token conservation)",
+    )
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--requests", type=int, default=10)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=8)
+    p.add_argument(
+        "--rate-rps",
+        type=float,
+        default=None,
+        help="open-loop arrival rate (default: calibrate to ~half the "
+        "measured token capacity so admission churn is exercised)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+
     p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
     p.add_argument("--probe-gb", type=float, default=1.0)
 
@@ -511,6 +532,18 @@ def _dispatch(args) -> int:
             decode_tokens=args.decode_tokens,
             iters=args.iters,
             use_flash=args.flash,
+            roofline=args.roofline,
+        )
+    elif args.probe == "serving":
+        from activemonitor_tpu.probes import serving
+
+        result = serving.run(
+            tiny=args.tiny,
+            n_requests=args.requests,
+            max_batch=args.max_batch,
+            block_size=args.block_size,
+            rate_rps=args.rate_rps,
+            seed=args.seed,
             roofline=args.roofline,
         )
     elif args.probe == "memory":

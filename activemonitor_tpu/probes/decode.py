@@ -30,6 +30,7 @@ from activemonitor_tpu.models.probe_model import (
     prefill,
     tiny_config,
 )
+from activemonitor_tpu.ops.kv_cache import kv_bytes_per_token
 from activemonitor_tpu.probes.base import ProbeMetric, ProbeResult
 from activemonitor_tpu.utils.timing import chain_delta_seconds
 
@@ -55,7 +56,16 @@ def run(
             f"prompt_len {prompt_len} leaves no decode room in "
             f"max_seq_len {cfg.max_seq_len}"
         )
-    max_seq = min(cfg.max_seq_len, prompt_len + decode_tokens + 1)
+    # the cache is sized for prompt + decode_tokens + 1; a model whose
+    # max_seq_len cannot hold that used to clamp SILENTLY and decode
+    # fewer distinct positions than requested. The clamp stays (the
+    # probe still measures something on a small model) but is now
+    # recorded in the details with the effective token budget, so the
+    # artifact says the position window shrank instead of implying the
+    # full request ran.
+    requested_seq = prompt_len + decode_tokens + 1
+    max_seq = min(cfg.max_seq_len, requested_seq)
+    decode_tokens_effective = max_seq - prompt_len - 1
     params = init_params(jax.random.key(0), cfg)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
@@ -189,6 +199,13 @@ def run(
             help="Fraction of greedy tokens agreeing across paths "
             "(informational: near-tie argmax flips are benign)",
         ),
+        ProbeMetric(
+            "decode-kv-bytes-per-token",
+            kv_bytes_per_token(cfg),
+            help="HBM bytes one generated token adds to the KV cache — "
+            "the shared roofline-ceiling input the serving probe "
+            "cross-checks (serving-kv-bytes-per-token)",
+        ),
     ]
     result = ProbeResult(
         ok=consistent,
@@ -203,6 +220,9 @@ def run(
             "batch": batch,
             "prompt_len": prompt_len,
             "max_seq": max_seq,
+            "decode_tokens_requested": decode_tokens,
+            "decode_tokens_effective": decode_tokens_effective,
+            "decode_tokens_clamped": decode_tokens_effective < decode_tokens,
             "attention": "flash" if use_flash else "dense",
             "seconds_per_token": seconds,
             "max_rel_logit_diff": max_rel_diff,

@@ -187,6 +187,21 @@ def run(
             tiny=quick, batch=4, prompt_len=8, iters=iters, roofline=roofline
         ),
     )
+    from activemonitor_tpu.probes import serving as serving_probe
+
+    # the continuous-batching serving loop rides the battery next to
+    # the static decode probe (its compiles share the persistent
+    # cache); quick mode shrinks the soak, not the gates — logits
+    # agreement and token conservation are checked either way
+    add(
+        "serving",
+        lambda: serving_probe.run(
+            tiny=quick,
+            n_requests=6 if quick else 12,
+            max_batch=4,
+            roofline=roofline,
+        ),
+    )
     from activemonitor_tpu.probes import straggler, transfer
 
     add(

@@ -1,0 +1,308 @@
+"""Continuous-batching admission scheduler + open-loop traffic model.
+
+The control half of the serving runtime (ROADMAP item 5): pure policy
+over the paged KV cache's block budget (ops/kv_cache.KVBlockManager),
+with the model execution and the clock both owned by the caller — the
+scheduler never touches jax and never reads time (``hack/lint.py``
+bans wall-clock calls here; every timestamp arrives as an argument, so
+scripted-clock tests are deterministic by construction).
+
+Admission model: every engine step, arrived requests are admitted FIFO
+into the in-flight batch while (a) a batch slot is free under the
+``max_batch`` ceiling and (b) the block manager can reserve the
+sequence's FULL capacity (prompt + output tokens) up front — so an
+admitted sequence can never hit a mid-flight out-of-blocks, and the
+only refusal point is admission, where refusals are structured counts
+(``refusals["batch"]`` / ``refusals["blocks"]``), never exceptions.
+Head-of-line order is preserved (no skip-ahead past a blocked head:
+a stream of small requests must not starve a large one).
+
+Phases are separated the way serving runtimes separate them: a newly
+admitted sequence runs PREFILL (the caller banks the whole prompt and
+reports the first generated token — TTFT), then joins the shared
+DECODE batch; finished sequences retire, their blocks recycle, and the
+freed slot admits the next arrival — all within one engine step.
+
+Traffic is OPEN-LOOP (:func:`open_loop_requests`): seeded Poisson
+arrivals with mixed prompt/output lengths, generated up front so the
+arrival process never adapts to service latency (the FlowMesh serving
+framing: closed-loop generators hide overload by slowing down with the
+server; an open-loop one keeps offering load and lets TTFT show the
+queueing truth).
+
+Accounting is conservation-by-construction: ``admitted = completed +
+in-flight`` for sequences AND generated tokens, per tenant and in
+total (:meth:`ContinuousBatchingScheduler.conservation`) — the serving
+probe gates on the equality being exact.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from activemonitor_tpu.ops.kv_cache import KVBlockManager
+
+
+@dataclass(frozen=True)
+class Request:
+    """One serving request as the open-loop generator emits it."""
+
+    rid: int
+    tenant: str
+    arrival: float  # seconds since soak start
+    prompt_len: int
+    output_tokens: int  # generated tokens wanted (>= 1; #1 from prefill)
+
+
+def open_loop_requests(
+    n_requests: int,
+    rate_rps: float,
+    seed: int,
+    prompt_len_choices: Sequence[int] = (4, 6, 8),
+    output_choices: Sequence[int] = (2, 3, 5),
+    tenants: Sequence[str] = ("tenant-a", "tenant-b"),
+) -> List[Request]:
+    """Seeded Poisson arrival schedule: exponential inter-arrivals at
+    ``rate_rps``, prompt/output lengths drawn from small choice sets
+    (bounded sets keep the engine's per-prompt-length compiles bounded
+    too), tenants round-robin. Same seed ⇒ byte-identical schedule —
+    the determinism the scheduler-trace test pins."""
+    if n_requests < 1 or rate_rps <= 0:
+        raise ValueError(
+            f"need n_requests >= 1 and rate_rps > 0, got "
+            f"{n_requests}/{rate_rps}"
+        )
+    rng = random.Random(seed)
+    now = 0.0
+    out: List[Request] = []
+    for rid in range(n_requests):
+        now += rng.expovariate(rate_rps)
+        out.append(
+            Request(
+                rid=rid,
+                tenant=tenants[rid % len(tenants)],
+                arrival=now,
+                prompt_len=rng.choice(tuple(prompt_len_choices)),
+                output_tokens=rng.choice(tuple(output_choices)),
+            )
+        )
+    return out
+
+
+@dataclass
+class SequenceState:
+    """One admitted sequence's lifecycle bookkeeping."""
+
+    req: Request
+    slot: int  # fixed batch-slot index while in flight
+    admitted_at: float
+    generated: int = 0  # tokens produced so far (prefill's counts)
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)  # generated token ids
+
+
+class ContinuousBatchingScheduler:
+    """Admission + phase + retirement policy over the block budget.
+
+    The caller drives it once per engine step::
+
+        arrived = sched.admit(now)            # new sequences (prefill phase)
+        ... prefill each; sched.record_first_token(seq, token, now) ...
+        batch = sched.decode_batch()          # the in-flight decode set
+        ... one paged decode step ...
+        sched.record_decode_step(tokens_by_slot, now)  # retire + recycle
+
+    ``capacity_tokens`` per sequence is ``prompt + output`` — the last
+    generated token's K/V slot is reserved though never banked, a
+    documented one-slot slack that keeps the reservation arithmetic
+    obvious (and shows up honestly in the fragmentation ratio).
+    """
+
+    def __init__(
+        self,
+        requests: Sequence[Request],
+        manager: KVBlockManager,
+        max_batch: int,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.manager = manager
+        self.max_batch = max_batch
+        self.waiting: Deque[Request] = deque(
+            sorted(requests, key=lambda r: (r.arrival, r.rid))
+        )
+        self.active: Dict[int, SequenceState] = {}  # slot -> state
+        self.completed: List[SequenceState] = []
+        self._free_slots: List[int] = list(range(max_batch - 1, -1, -1))
+        self._admitted = 0
+        self._tokens_emitted = 0
+        # independent per-tenant tallies, counted at the admit/emit
+        # EVENTS — conservation() cross-checks them against the sums
+        # derived from the sequence objects, so a tenant-attribution
+        # bug cannot hide behind balanced global totals
+        self._tenant_admitted: Dict[str, int] = {}
+        self._tenant_tokens: Dict[str, int] = {}
+        self.refusals: Dict[str, int] = {"batch": 0, "blocks": 0}
+        self.occupancy_samples: List[float] = []
+        # (event, rid, t): the admission-order trace the seeded
+        # determinism test pins — same seed, same schedule, same trace
+        self.trace: List[Tuple[str, int, float]] = []
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return not self.waiting and not self.active
+
+    def next_arrival(self) -> Optional[float]:
+        return self.waiting[0].arrival if self.waiting else None
+
+    def decode_batch(self) -> List[SequenceState]:
+        """In-flight sequences that have had their first token (i.e.
+        prefilled) and still owe output, in slot order."""
+        return [
+            self.active[slot]
+            for slot in sorted(self.active)
+            if self.active[slot].first_token_at is not None
+            and self.active[slot].generated < self.active[slot].req.output_tokens
+        ]
+
+    # -- the step protocol ----------------------------------------------
+    def capacity_tokens(self, req: Request) -> int:
+        return req.prompt_len + req.output_tokens
+
+    def admit(self, now: float) -> List[SequenceState]:
+        """Admit arrived requests FIFO while a slot AND the full block
+        reservation are available. A blocked head stops admission for
+        this step (no skip-ahead) and counts a structured refusal."""
+        admitted: List[SequenceState] = []
+        while self.waiting and self.waiting[0].arrival <= now:
+            req = self.waiting[0]
+            if not self._free_slots:
+                self.refusals["batch"] += 1
+                self.trace.append(("defer-batch", req.rid, now))
+                break
+            blocks = self.manager.allocate(req.rid, self.capacity_tokens(req))
+            if blocks is None:
+                self.refusals["blocks"] += 1
+                self.trace.append(("defer-blocks", req.rid, now))
+                break
+            self.waiting.popleft()
+            self.manager.append(req.rid, req.prompt_len)  # prompt K/V banked
+            seq = SequenceState(
+                req=req, slot=self._free_slots.pop(), admitted_at=now
+            )
+            self.active[seq.slot] = seq
+            self._admitted += 1
+            self._tenant_admitted[req.tenant] = (
+                self._tenant_admitted.get(req.tenant, 0) + 1
+            )
+            self.trace.append(("admit", req.rid, now))
+            admitted.append(seq)
+        return admitted
+
+    def record_first_token(
+        self, seq: SequenceState, token: int, now: float
+    ) -> None:
+        """Prefill produced the sequence's first generated token (the
+        TTFT event). A 1-token request completes right here."""
+        seq.generated = 1
+        seq.first_token_at = now
+        seq.tokens.append(token)
+        self._emit_token(seq)
+        self.trace.append(("first-token", seq.req.rid, now))
+        if seq.generated >= seq.req.output_tokens:
+            self._retire(seq, now)
+
+    def record_decode_step(
+        self, tokens_by_slot: Dict[int, int], now: float
+    ) -> List[SequenceState]:
+        """One shared decode step finished: each participating sequence
+        banked the K/V of the token it fed in and produced one more
+        token. Finished sequences retire and their blocks recycle.
+        Returns the retired list; also samples batch occupancy."""
+        stepped = 0
+        finished: List[SequenceState] = []
+        for slot, token in sorted(tokens_by_slot.items()):
+            seq = self.active.get(slot)
+            if seq is None:
+                continue
+            self.manager.append(seq.req.rid, 1)
+            seq.generated += 1
+            seq.tokens.append(token)
+            self._emit_token(seq)
+            stepped += 1
+            if seq.generated >= seq.req.output_tokens:
+                self._retire(seq, now)
+                finished.append(seq)
+        self.occupancy_samples.append(stepped / self.max_batch)
+        return finished
+
+    def _emit_token(self, seq: SequenceState) -> None:
+        self._tokens_emitted += 1
+        self._tenant_tokens[seq.req.tenant] = (
+            self._tenant_tokens.get(seq.req.tenant, 0) + 1
+        )
+
+    def _retire(self, seq: SequenceState, now: float) -> None:
+        seq.finished_at = now
+        self.manager.free(seq.req.rid)
+        del self.active[seq.slot]
+        self._free_slots.append(seq.slot)
+        self.completed.append(seq)
+        self.trace.append(("retire", seq.req.rid, now))
+
+    # -- accounting ------------------------------------------------------
+    def conservation(self) -> dict:
+        """The exact-conservation ledger: admitted sequences and
+        emitted tokens must equal completed + in-flight, in total AND
+        per tenant. The per-tenant side cross-checks two independent
+        accounts — event-time tallies (counted at admit/emit) against
+        sums derived from the sequence objects — so a
+        tenant-attribution bug cannot hide behind balanced global
+        totals. ``ok`` is the AND of every equality — the serving
+        probe's accounting gate."""
+        in_flight = list(self.active.values())
+        tokens_completed = sum(s.generated for s in self.completed)
+        tokens_in_flight = sum(s.generated for s in in_flight)
+        tenants: Dict[str, Dict[str, int]] = {}
+        for seq, bucket in [(s, "completed") for s in self.completed] + [
+            (s, "in_flight") for s in in_flight
+        ]:
+            row = tenants.setdefault(
+                seq.req.tenant,
+                {"completed": 0, "in_flight": 0, "tokens": 0},
+            )
+            row[bucket] += 1
+            row["tokens"] += seq.generated
+        tenants_ok = True
+        for tenant in set(tenants) | set(self._tenant_admitted) | set(
+            self._tenant_tokens
+        ):
+            row = tenants.setdefault(
+                tenant, {"completed": 0, "in_flight": 0, "tokens": 0}
+            )
+            row["admitted"] = self._tenant_admitted.get(tenant, 0)
+            row["tokens_emitted"] = self._tenant_tokens.get(tenant, 0)
+            tenants_ok = tenants_ok and (
+                row["admitted"] == row["completed"] + row["in_flight"]
+                and row["tokens_emitted"] == row["tokens"]
+            )
+        return {
+            "admitted": self._admitted,
+            "completed": len(self.completed),
+            "in_flight": len(in_flight),
+            "tokens_emitted": self._tokens_emitted,
+            "tokens_completed": tokens_completed,
+            "tokens_in_flight": tokens_in_flight,
+            "tenants": tenants,
+            "ok": (
+                tenants_ok
+                and self._admitted == len(self.completed) + len(in_flight)
+                and self._tokens_emitted
+                == tokens_completed + tokens_in_flight
+            ),
+        }

@@ -657,6 +657,7 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     _stamp_hier_autotune(doc)
     _stamp_roofline(doc, primary_result)
     _stamp_matrix(doc)
+    _stamp_serving(doc)
     return doc
 
 
@@ -932,6 +933,56 @@ def _stamp_matrix(doc: dict) -> None:
         doc["matrix_summary"] = summary
     except Exception as exc:  # pragma: no cover - defensive
         print(f"matrix stamp failed: {exc!r}", file=sys.stderr)
+
+
+def _stamp_serving(doc: dict) -> None:
+    """Stamp the continuous-batching serving probe's round evidence
+    (probes/serving.py) into the artifact as ``serving_summary`` —
+    tokens/s, TTFT/inter-token tails, batch occupancy, KV
+    fragmentation, the continuous-vs-static consistency gate and the
+    exact token-conservation ledger, plus the roofline verdict (or its
+    structured skip). BOTH paths stamp it: CPU-fallback rounds are
+    ``interpret_mode: true`` (tiny model, ``cost_source: model`` —
+    never read against a TPU bar) and carry the round's
+    ``fallback_reason`` like every other evidence block. Guarded: a
+    failing soak costs this block, not the artifact.
+    ``ACTIVEMONITOR_BENCH_SERVING=off`` disables."""
+    if os.environ.get("ACTIVEMONITOR_BENCH_SERVING", "") == "off":
+        return
+    try:
+        from activemonitor_tpu.probes import serving as serving_probe
+
+        on_tpu = doc.get("platform") == "tpu"
+        result = serving_probe.run(
+            tiny=not on_tpu,
+            n_requests=16 if on_tpu else 8,
+            max_batch=8 if on_tpu else 4,
+        )
+        by_name = {m.name: m.value for m in result.metrics}
+        summary = {
+            "interpret_mode": not on_tpu,
+            "ok": result.ok,
+            "tokens_per_s": round(by_name["serving-tokens-per-s"], 2),
+            "ttft_p50_ms": round(by_name["serving-ttft-p50-ms"], 3),
+            "ttft_p99_ms": round(by_name["serving-ttft-p99-ms"], 3),
+            "intertoken_p99_ms": round(
+                by_name["serving-intertoken-p99-ms"], 3
+            ),
+            "batch_occupancy": round(by_name["serving-batch-occupancy"], 4),
+            "kv_frag_ratio": round(by_name["serving-kv-frag-ratio"], 4),
+            "kv_bytes_per_token": by_name["serving-kv-bytes-per-token"],
+            "consistency": by_name["serving-consistency"] == 1.0,
+            "conservation": result.details["conservation"],
+            "refusals": result.details["refusals"],
+            # the verdict when a rated roofline exists (TPU), else the
+            # structured skip reason — never a silent omission
+            "roofline": (result.details.get("roofline") or {}).get("serving"),
+        }
+        if doc.get("fallback"):
+            summary["fallback_reason"] = doc.get("fallback_reason", "")
+        doc["serving_summary"] = summary
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"serving stamp failed: {exc!r}", file=sys.stderr)
 
 
 def _stamp_attribution(doc: dict) -> None:

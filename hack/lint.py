@@ -64,7 +64,13 @@ positive that makes `make lint` cry wolf is worse than a miss):
   `wallclock-in-attribution`, `wallclock-in-flightrec`,
   `wallclock-in-roofline`, `wallclock-in-matrix` — the scenario
   matrix's verdict machinery runs on the Clock and its executor timer
-  is injectable, wherever a matrix.py lands in the tree).
+  is injectable, wherever a matrix.py lands in the tree — and the
+  serving runtime's `wallclock-in-serving` / `wallclock-in-kv_cache`:
+  the admission scheduler takes every timestamp as an argument and the
+  serving probe's soak runs on an injectable timer or the scripted
+  StepCosts virtual clock, so the open-loop acceptance tests replay
+  deterministically; the paged-cache manager is pure allocation
+  arithmetic with no time in it at all).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -160,6 +166,9 @@ class Checker(ast.NodeVisitor):
             "flightrec.py",  # bundle timestamps ride scripted transitions
             "roofline.py",  # pure math over seconds passed in as args
             "matrix.py",  # verdicts on the Clock; executor timer injectable
+            "serving.py",  # scheduler takes timestamps as args; probe
+            # soak runs on an injectable timer / scripted StepCosts
+            "kv_cache.py",  # pure allocation arithmetic — no time at all
         ):
             # single-file modules carrying the same injectable-Clock
             # contract as the resilience/analysis packages

@@ -196,8 +196,8 @@ def test_probe_suite_quick(capsys):
         quick=True,
         skip=[
             "matmul", "hbm", "ici-allreduce", "collectives", "ring-attention",
-            "flash-attention", "training-step", "decode", "dcn-allreduce",
-            "straggler", "transfer", "checkpoint",
+            "flash-attention", "training-step", "decode", "serving",
+            "dcn-allreduce", "straggler", "transfer", "checkpoint",
         ],
     )
     assert result.ok

@@ -134,6 +134,17 @@ def test_bench_emits_single_json_line():
         # legacy lowering cannot run the partially-manual composed step;
         # the guarded secondary records the real diagnostic instead
         assert "composed_step_error" in doc["secondary"]
+    # the serving evidence block (ISSUE 14): continuous batching ran,
+    # labeled interpret-mode, with the correctness gate and the exact
+    # token-conservation ledger in the artifact itself
+    serving = doc["serving_summary"]
+    assert serving["interpret_mode"] is True
+    assert serving["ok"] is True and serving["consistency"] is True
+    assert serving["conservation"]["ok"] is True
+    assert serving["tokens_per_s"] > 0
+    assert serving["ttft_p99_ms"] >= serving["ttft_p50_ms"] >= 0
+    # off-TPU the roofline is a structured skip, never a silent hole
+    assert serving["roofline"] is not None
 
 
 def test_device_probe_watchdog_fails_fast_on_consecutive_hangs(monkeypatch):

@@ -974,6 +974,141 @@ def test_hierarchical_metric_names_are_pinned():
     assert "hier-allreduce" in OPS and "hier-allreduce" in _RUNNERS
 
 
+def test_wallclock_banned_in_serving_and_kv_cache_modules(tmp_path):
+    """The ISSUE-14 serving runtime carries the injectable-clock
+    contract wherever its modules land: the admission scheduler takes
+    every timestamp as an argument, the serving probe's soak runs on
+    an injectable timer or the scripted StepCosts virtual clock, and
+    the paged-cache manager is pure allocation arithmetic — so a bare
+    wall-clock CALL in any serving.py or kv_cache.py is a lint error
+    (same module-name keying as the sharding/matrix bans)."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    for module in ("serving", "kv_cache"):
+        got = findings(tmp_path, source, name=f"{module}.py")
+        assert codes(got) == {f"wallclock-in-{module}"}, module
+        assert len(got) == 2
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="admission.py") == []
+    # the injectable default-timer idiom (referencing time.monotonic
+    # WITHOUT calling it) stays quiet — it is how the probe does it
+    clean = (
+        "import time\n"
+        "def run(timer=time.monotonic):\n"
+        "    return timer()\n"
+    )
+    assert findings(tmp_path, clean, name="serving.py") == []
+
+
+def test_serving_and_kv_cache_modules_really_are_wallclock_free():
+    """The gate, applied: every shipped serving/kv module lints clean
+    and the ban actually covers it (path-scoping regression guard —
+    BOTH serving.py homes, scheduler and probe, plus the cache)."""
+    for rel, pkg in (
+        ("activemonitor_tpu/scheduler/serving.py", "serving"),
+        ("activemonitor_tpu/probes/serving.py", "serving"),
+        ("activemonitor_tpu/ops/kv_cache.py", "kv_cache"),
+    ):
+        path = REPO / rel
+        assert path.exists(), f"{rel} missing?"
+        assert lint.lint_file(path) == []
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock, rel
+        assert checker.wallclock_pkg == pkg, rel
+
+
+def test_serving_metric_names_are_pinned():
+    """The ISSUE-14 serving names are contract spelling across the
+    layers: the probe emits the serving-* gauges, the static decode
+    probe exports the shared kv-bytes figure, docs/probes.md +
+    docs/serving.md register the spellings (the names
+    spec.analysis.metrics[] takes), bench.py stamps serving_summary on
+    BOTH paths, and the matrix registry carries the runner-backed op
+    with its batch-ceiling dimension and the deliberately impossible
+    config cell — a rename in any one layer silently orphans the
+    others (the same gate every prior subsystem's names got)."""
+    import ast
+
+    docs = (REPO / "docs" / "probes.md").read_text()
+    serving_docs = (REPO / "docs" / "serving.md").read_text()
+    pinned_metrics = {
+        "serving-tokens-per-s": "probes/serving.py",
+        "serving-ttft-p50-ms": "probes/serving.py",
+        "serving-ttft-p99-ms": "probes/serving.py",
+        "serving-intertoken-p99-ms": "probes/serving.py",
+        "serving-batch-occupancy": "probes/serving.py",
+        "serving-kv-frag-ratio": "probes/serving.py",
+        "serving-consistency": "probes/serving.py",
+        "serving-kv-bytes-per-token": "probes/serving.py",
+        "decode-kv-bytes-per-token": "probes/decode.py",
+    }
+    for name, rel in pinned_metrics.items():
+        assert name in docs, f"{name} missing from docs/probes.md metric table"
+        src = (REPO / "activemonitor_tpu" / rel).read_text()
+        declared = {
+            node.value
+            for node in ast.walk(ast.parse(src))
+            if isinstance(node, ast.Constant) and isinstance(node.value, str)
+        }
+        assert name in declared, f"{name} not declared in {rel}"
+    # the runtime pieces the docs describe must exist under the
+    # documented names (block tables, admission, open-loop, ceiling)
+    for anchor in (
+        "block table",
+        "admission",
+        "open-loop",
+        "memory-bound",
+        "fragmentation",
+        "kv_bytes_per_token",
+    ):
+        assert anchor.lower() in serving_docs.lower(), (
+            f"docs/serving.md lost {anchor!r}"
+        )
+    assert "docs/serving.md" in (REPO / "README.md").read_text()
+    # the shared kv-bytes figure has ONE source both probes import
+    for rel in ("probes/serving.py", "probes/decode.py"):
+        assert "kv_bytes_per_token" in (
+            REPO / "activemonitor_tpu" / rel
+        ).read_text(), f"{rel} no longer uses the shared kv-bytes source"
+    # bench.py's serving evidence block (both paths stamp it;
+    # interpret-mode labeled, env-disableable)
+    bench_src = (REPO / "bench.py").read_text()
+    for key in (
+        "serving_summary",
+        "_stamp_serving",
+        "ACTIVEMONITOR_BENCH_SERVING",
+        "kv_frag_ratio",
+        "ttft_p99_ms",
+    ):
+        assert key in bench_src, f"bench.py no longer records {key}"
+    # the matrix registry: runner-backed op, batch-ceiling expansion,
+    # and the config's serving rows with the impossible model16 cell
+    import json
+
+    from activemonitor_tpu.analysis.matrix import OPS, _RUNNERS
+
+    assert "serving" in OPS and "serving" in _RUNNERS
+    assert OPS["serving"].accepts_batch
+    matrix_spec = json.loads(
+        (REPO / "config" / "bench_matrix.json").read_text()
+    )
+    assert "serving" in matrix_spec["ops"]
+    assert matrix_spec.get("batch_ceilings"), "matrix lost batch ceilings"
+    assert {"model": 16} in matrix_spec["meshes"]  # the deliberate deficit
+    # CLI + battery registration
+    cli_src = (REPO / "activemonitor_tpu" / "probes" / "cli.py").read_text()
+    assert '"serving"' in cli_src
+    assert "serving" in (
+        REPO / "activemonitor_tpu" / "probes" / "suite.py"
+    ).read_text()
+
+
 def test_wallclock_banned_in_matrix_module(tmp_path):
     """The scenario-matrix module (ISSUE 12) carries the injectable-
     Clock contract wherever it lands: verdicts/baselines run on the

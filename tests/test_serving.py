@@ -1,0 +1,526 @@
+"""Continuous-batching serving runtime (ISSUE 14).
+
+Covers the paged KV cache's block lifecycle edges (free-list reuse
+after retirement, structured out-of-blocks refusal, fragmentation
+exactness), the partition-rule layout surface (wrong layouts raise,
+scalars never partition), the paged-vs-static decode equivalence, the
+seeded open-loop generator + scheduler-trace determinism, and the
+closed-loop acceptance soak: an open-loop Poisson soak on the scripted
+virtual clock shows continuous batching beating sequential
+static-batch decode on tokens/s, with TTFT/inter-token tails in the
+stdout contract, exact token conservation, logits agreement with the
+static path, and the `serving` matrix cell producing a
+baseline-tracked, roofline-stamped verdict in the durable sidecar.
+"""
+
+import json
+
+import pytest
+
+from activemonitor_tpu.ops.kv_cache import KVBlockManager, kv_bytes_per_token
+from activemonitor_tpu.scheduler.serving import (
+    ContinuousBatchingScheduler,
+    Request,
+    open_loop_requests,
+)
+
+
+# ---------------------------------------------------------------------
+# KV block lifecycle edges
+# ---------------------------------------------------------------------
+
+
+def test_block_manager_allocate_append_free_roundtrip():
+    mgr = KVBlockManager(n_blocks=8, block_size=4)
+    blocks = mgr.allocate(1, 10)  # 10 tokens -> 3 blocks
+    assert blocks == [0, 1, 2]
+    assert mgr.used_blocks == 3 and mgr.free_blocks == 5
+    assert mgr.table(1) == [0, 1, 2]
+    assert mgr.append(1, 10)
+    assert mgr.length(1) == 10 and mgr.banked_tokens == 10
+    # appending past the reserved capacity is a structured refusal
+    assert mgr.append(1, 3) is False
+    assert mgr.length(1) == 10  # refused append must not half-apply
+    assert mgr.free(1) == 3
+    assert mgr.free_blocks == 8 and mgr.banked_tokens == 0
+    # freeing an unknown id is 0, not a raise
+    assert mgr.free(99) == 0
+
+
+def test_block_manager_free_list_reuse_after_retirement():
+    """A retired sequence's blocks are the very next admission's grant
+    (LIFO reuse) — recycling, not pool growth."""
+    mgr = KVBlockManager(n_blocks=4, block_size=2)
+    first = mgr.allocate(1, 4)  # blocks [0, 1]
+    second = mgr.allocate(2, 4)  # blocks [2, 3]
+    assert first == [0, 1] and second == [2, 3]
+    mgr.free(1)
+    reused = mgr.allocate(3, 4)
+    assert set(reused) == {0, 1}  # exactly the retired blocks, reused
+    assert mgr.free_blocks == 0
+
+
+def test_block_manager_out_of_blocks_is_structured_refusal():
+    mgr = KVBlockManager(n_blocks=2, block_size=4)
+    assert mgr.allocate(1, 8) == [0, 1]
+    # deficit: None, never a raise — and no partial grant
+    assert mgr.can_allocate(1) is False
+    assert mgr.allocate(2, 1) is None
+    assert mgr.used_blocks == 2 and mgr.free_blocks == 0
+    # a double-allocate for a LIVE id is a caller bug and does raise
+    with pytest.raises(ValueError):
+        mgr.allocate(1, 4)
+
+
+def test_block_manager_fragmentation_ratio_is_exact():
+    mgr = KVBlockManager(n_blocks=8, block_size=4)
+    assert mgr.fragmentation_ratio() == 0.0  # nothing reserved, no waste
+    mgr.allocate(1, 6)  # 2 blocks = 8 slots reserved
+    assert mgr.fragmentation_ratio() == 1.0  # reserved, nothing banked
+    mgr.append(1, 5)
+    assert mgr.fragmentation_ratio() == (8 - 5) / 8
+    mgr.allocate(2, 4)  # +1 block = 12 slots reserved total
+    mgr.append(2, 4)
+    assert mgr.fragmentation_ratio() == (12 - 9) / 12
+    mgr.free(1)
+    assert mgr.fragmentation_ratio() == 0.0  # seq 2 fills its block exactly
+    assert mgr.stats()["fragmentation_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------
+# partition-rule layout surface
+# ---------------------------------------------------------------------
+
+
+def test_kv_partition_rules_shard_heads_and_reject_bad_mesh():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from activemonitor_tpu.models.probe_model import tiny_config
+    from activemonitor_tpu.ops.kv_cache import paged_kv_specs
+    from activemonitor_tpu.parallel.mesh import make_mesh
+
+    cfg = tiny_config()
+    mesh = make_mesh(("model",), (2,), devices=jax.devices()[:2])
+    specs = paged_kv_specs(cfg, n_blocks=4, block_size=8, mesh=mesh)
+    assert specs["k"] == P(None, None, "model", None, None)
+    assert specs["v"] == P(None, None, "model", None, None)
+    # a layout naming an axis the mesh lacks raises UP FRONT with the
+    # rule in the message, never a tracer crash inside the serving loop
+    data_mesh = make_mesh(("data",), (2,), devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="model"):
+        paged_kv_specs(cfg, n_blocks=4, block_size=8, mesh=data_mesh)
+
+
+def test_kv_partition_rules_never_partition_scalars():
+    from jax.sharding import PartitionSpec as P
+
+    from activemonitor_tpu.ops.kv_cache import kv_partition_rules
+    from activemonitor_tpu.parallel.partition import match_partition_rules
+
+    import numpy as np
+
+    # a scalar leaf whose NAME matches the k/v rule still resolves P()
+    specs = match_partition_rules(
+        kv_partition_rules(), {"k": np.float32(1.0), "v": np.zeros(())}
+    )
+    assert specs["k"] == P() and specs["v"] == P()
+
+
+# ---------------------------------------------------------------------
+# paged decode == static decode (the runtime's numerics contract)
+# ---------------------------------------------------------------------
+
+
+def test_paged_decode_step_matches_static_decode_step():
+    import jax
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import (
+        decode_step,
+        init_kv_cache,
+        init_params,
+        prefill,
+        tiny_config,
+    )
+    from activemonitor_tpu.ops.kv_cache import (
+        bank_prompt,
+        init_paged_kv,
+        paged_decode_step,
+    )
+
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    prompt_len, steps, block_size = 6, 4, 4
+    prompt = jax.random.randint(
+        jax.random.key(1), (1, prompt_len), 0, cfg.vocab_size
+    )
+    # static path: contiguous cache, scalar positions
+    cache = init_kv_cache(cfg, 1, prompt_len + steps + 1)
+    static_logits, cache = prefill(params, cache, prompt, cfg)
+    # paged path: bank the same prefill into non-contiguous blocks via
+    # a scrambled-ish table (allocate a decoy first so ids aren't 0..n)
+    n_blocks = 8
+    storage = init_paged_kv(cfg, n_blocks + 1, block_size)
+    blocks = [3, 4, 5]  # any distinct ids: the table IS the layout
+    # cache is [L, B, Hkv, S, Dh]: take seq 0 heads-major [L, Hkv, S, Dh]
+    pk = cache["k"][:, 0, :, :prompt_len]
+    pv = cache["v"][:, 0, :, :prompt_len]
+    storage = bank_prompt(storage, pk, pv, jnp.asarray(blocks, jnp.int32))
+    tables = jnp.asarray([blocks + [n_blocks]], jnp.int32)  # pad w/ trash
+    token_s = jnp.argmax(static_logits, axis=-1)
+    token_p = token_s
+    for i in range(steps):
+        pos = prompt_len + i
+        static_logits, cache = decode_step(
+            params, cache, token_s, jnp.asarray(pos), cfg
+        )
+        paged_logits, storage = paged_decode_step(
+            params,
+            storage,
+            token_p,
+            jnp.asarray([pos], jnp.int32),
+            tables,
+            cfg,
+        )
+        scale = max(float(jnp.max(jnp.abs(static_logits))), 1e-6)
+        rel = float(jnp.max(jnp.abs(paged_logits - static_logits))) / scale
+        assert rel < 2e-2, f"step {i}: paged diverged {rel}"
+        # teacher-force the static tokens into both paths
+        token_s = jnp.argmax(static_logits, axis=-1)
+        token_p = token_s
+
+
+# ---------------------------------------------------------------------
+# open-loop generator + scheduler determinism
+# ---------------------------------------------------------------------
+
+
+def test_open_loop_generator_is_seeded_and_mixed():
+    a = open_loop_requests(16, 4.0, seed=5)
+    b = open_loop_requests(16, 4.0, seed=5)
+    assert a == b  # same seed, byte-identical schedule
+    c = open_loop_requests(16, 4.0, seed=6)
+    assert a != c
+    assert all(x.arrival < y.arrival for x, y in zip(a, a[1:]))
+    assert len({r.prompt_len for r in a}) > 1  # mixed lengths
+    assert {r.tenant for r in a} == {"tenant-a", "tenant-b"}
+    with pytest.raises(ValueError):
+        open_loop_requests(0, 4.0, seed=1)
+
+
+def _scripted_schedule(requests, max_batch, n_blocks, block_size=4):
+    """Drive the scheduler purely (no model): every 'decode step' emits
+    token 7 for each in-flight sequence at virtual 1s per step."""
+    mgr = KVBlockManager(n_blocks, block_size)
+    sched = ContinuousBatchingScheduler(requests, mgr, max_batch)
+    now = 0.0
+    while not sched.done:
+        nxt = sched.next_arrival()
+        if not sched.active and nxt is not None and nxt > now:
+            now = nxt
+        for seq in sched.admit(now):
+            sched.record_first_token(seq, 7, now)
+        batch = sched.decode_batch()
+        now += 1.0
+        if batch:
+            sched.record_decode_step({s.slot: 7 for s in batch}, now)
+    return sched
+
+
+def test_scheduler_trace_is_deterministic_per_seed():
+    reqs_a = open_loop_requests(12, 3.0, seed=11, output_choices=(2, 3))
+    reqs_b = open_loop_requests(12, 3.0, seed=11, output_choices=(2, 3))
+    trace_a = _scripted_schedule(reqs_a, max_batch=3, n_blocks=12).trace
+    trace_b = _scripted_schedule(reqs_b, max_batch=3, n_blocks=12).trace
+    assert trace_a == trace_b  # same seed => identical admission order
+    admits = [rid for ev, rid, _t in trace_a if ev == "admit"]
+    assert admits == sorted(admits)  # FIFO admission order held
+
+
+def test_scheduler_refusals_are_structured_and_conservation_exact():
+    # 1 batch slot, 2 blocks of 4: the second arrival must defer, the
+    # ledger must still balance to the token at every point
+    reqs = [
+        Request(0, "tenant-a", 0.0, prompt_len=4, output_tokens=3),
+        Request(1, "tenant-b", 0.0, prompt_len=4, output_tokens=2),
+    ]
+    sched = _scripted_schedule(reqs, max_batch=1, n_blocks=2, block_size=4)
+    assert sched.refusals["batch"] >= 1 or sched.refusals["blocks"] >= 1
+    cons = sched.conservation()
+    assert cons["ok"] is True
+    assert cons["admitted"] == 2 and cons["completed"] == 2
+    assert cons["tokens_emitted"] == 3 + 2
+    assert cons["tenants"]["tenant-a"]["tokens"] == 3
+    assert cons["tenants"]["tenant-b"]["tokens"] == 2
+
+
+# ---------------------------------------------------------------------
+# the closed-loop acceptance soak (scripted virtual clock)
+# ---------------------------------------------------------------------
+
+
+def test_acceptance_continuous_batching_beats_sequential_static():
+    """ISSUE-14 acceptance: open-loop Poisson soak on the injectable
+    clock — continuous batching must beat sequential static-batch
+    decode on tokens/s under the memory-bound cost model (a decode
+    step streams the weights regardless of batch width), with logits
+    agreeing with the static path, conservation exact, and the tails
+    exported through the stdout contract."""
+    import jax
+
+    from activemonitor_tpu.models.probe_model import init_params, tiny_config
+    from activemonitor_tpu.probes import serving as serving_probe
+
+    cfg = tiny_config()
+    requests = open_loop_requests(
+        8, 2.0, seed=7, prompt_len_choices=(4, 6), output_choices=(3, 4)
+    )
+    costs = serving_probe.StepCosts(
+        prefill=lambda plen: 0.01 * plen, decode=lambda _n: 1.0
+    )
+    soak = serving_probe.run_soak(
+        cfg, requests, max_batch=4, costs=costs, collect=3, seed=0
+    )
+    cons = soak.scheduler.conservation()
+    assert cons["ok"] is True
+    assert cons["completed"] == len(requests)
+    total_tokens = sum(r.output_tokens for r in requests)
+    assert cons["tokens_emitted"] == total_tokens  # exact, to the token
+    # continuous batching: many sequences share each 1s decode step
+    continuous_tps = total_tokens / soak.busy_seconds
+    sequential_tps = total_tokens / serving_probe.sequential_static_seconds(
+        requests, costs
+    )
+    assert continuous_tps > sequential_tps, (
+        f"continuous {continuous_tps:.3f} <= sequential {sequential_tps:.3f}"
+    )
+    # logits agreement with the per-sequence static path
+    params = init_params(jax.random.key(0), cfg)
+    rel = serving_probe._check_against_static(cfg, params, soak)
+    assert rel <= 0.05
+    assert len(soak.logit_trace) == 3  # the checked sequences really ran
+
+
+def test_serving_probe_contract_line_and_gates():
+    """The probe end to end on a deterministic fake timer: every
+    pinned serving-* metric rides the stdout contract, the verdict
+    gates hold, and the roofline capture lands as a structured skip on
+    CPU (cost_source model territory — never a TPU-bar fraction)."""
+    from activemonitor_tpu.probes import serving as serving_probe
+
+    ticks = {"t": 0.0}
+
+    def fake_timer() -> float:
+        ticks["t"] += 0.25
+        return ticks["t"]
+
+    result = serving_probe.run(
+        tiny=True, n_requests=6, max_batch=3, timer=fake_timer
+    )
+    assert result.ok, result.summary
+    doc = json.loads(result.contract_line())
+    names = {m["name"]: m["value"] for m in doc["metrics"]}
+    for metric in (
+        "serving-tokens-per-s",
+        "serving-ttft-p50-ms",
+        "serving-ttft-p99-ms",
+        "serving-intertoken-p99-ms",
+        "serving-batch-occupancy",
+        "serving-kv-frag-ratio",
+        "serving-consistency",
+        "serving-kv-bytes-per-token",
+    ):
+        assert metric in names, f"{metric} missing from the contract"
+    assert names["serving-consistency"] == 1.0
+    assert names["serving-ttft-p99-ms"] >= names["serving-ttft-p50-ms"] > 0
+    assert 0 < names["serving-batch-occupancy"] <= 1.0
+    assert 0 <= names["serving-kv-frag-ratio"] < 1.0
+    assert result.details["conservation"]["ok"] is True
+    # phase timings rode the contract (the attribution layer's food)
+    assert "soak" in doc["timings"]
+    # structured roofline skip on CPU — never a silent omission
+    roofline_detail = result.details["roofline"]["serving"]
+    assert "skipped" in roofline_detail or "bound" in roofline_detail
+
+
+def test_serving_and_decode_share_one_kv_bytes_figure():
+    """The ceiling cross-check satellite: both probes derive their
+    memory-bound ceiling input from ops/kv_cache.kv_bytes_per_token,
+    and the static decode probe now exports it."""
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import ProbeModelConfig, tiny_config
+
+    cfg = tiny_config()
+    expected = (
+        2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    assert kv_bytes_per_token(cfg) == expected
+    # GQA halves the figure with half the kv heads
+    gqa = ProbeModelConfig(n_kv_heads=ProbeModelConfig().n_heads // 2)
+    assert kv_bytes_per_token(gqa) == kv_bytes_per_token(ProbeModelConfig()) / 2
+
+
+def test_decode_probe_records_clamp_and_kv_bytes():
+    """The silent-truncation satellite: a decode_tokens request the
+    model's max_seq_len cannot hold is recorded in the details with
+    the effective budget — and the kv-bytes metric rides the
+    contract."""
+    from activemonitor_tpu.probes import decode
+
+    # tiny max_seq_len=64: prompt 8 + 200 + 1 clamps to 64
+    result = decode.run(
+        tiny=True, batch=2, prompt_len=8, decode_tokens=200, iters=2
+    )
+    assert result.details["decode_tokens_requested"] == 200
+    assert result.details["decode_tokens_effective"] == 64 - 8 - 1
+    assert result.details["decode_tokens_clamped"] is True
+    by_name = {m.name: m.value for m in result.metrics}
+    assert by_name["decode-kv-bytes-per-token"] > 0
+    # an unclamped run says so
+    result = decode.run(
+        tiny=True, batch=2, prompt_len=4, decode_tokens=4, iters=2
+    )
+    assert result.details["decode_tokens_clamped"] is False
+    assert result.details["decode_tokens_effective"] == 4
+
+
+# ---------------------------------------------------------------------
+# the serving matrix cell: baseline-tracked, roofline-stamped verdict
+# ---------------------------------------------------------------------
+
+
+def test_serving_matrix_cell_lands_in_the_durable_sidecar(tmp_path):
+    """The acceptance's observatory leg: a serving cell observed over
+    rounds gets a per-cell baseline and a roofline stamp persisted in
+    BENCH_BASELINES.json, and a regressing round produces a confirmed
+    degraded verdict naming the moved ceiling."""
+    from activemonitor_tpu.analysis import matrix as matrix_mod
+    from activemonitor_tpu.probes.rated import RatedSpec
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    rated = RatedSpec(
+        "v5e", bf16_tflops=197.0, hbm_gbps=819.0,
+        ici_unidir_gbps=45.0, ici_links=4,
+    )
+    [cell], skipped = matrix_mod.expand(
+        {
+            "ops": ["serving"],
+            "meshes": [{"model": 2}],
+            "dtypes": ["f32"],
+            "batch_ceilings": [2],
+        },
+        n_devices=8,
+    )
+    assert skipped == []
+    assert cell.cell_id == "serving/model2/f32/b2"
+
+    def scripted(seconds):
+        return matrix_mod.CellResult(
+            cell, matrix_mod.STATUS_OK, value=seconds, seconds=seconds,
+            flops=1e9, bytes_accessed=1e9,
+        )
+
+    path = str(tmp_path / "BENCH_BASELINES.json")
+    observatory = matrix_mod.MatrixObservatory(
+        clock=FakeClock(), path=path, warmup_runs=2, confirm_runs=2,
+        rated_spec=rated,
+    )
+    for _ in range(4):
+        observatory.observe_round([scripted(0.01)])
+    # regress the cell for two confirming rounds
+    for _ in range(2):
+        summary = observatory.observe_round([scripted(0.1)])
+    entry = summary["cells"]["serving/model2/f32/b2"]
+    assert entry["verdict"] == "degraded"
+    assert entry["roofline"]["bound"] in ("memory", "compute")
+    assert summary["regressions"] and summary["regressions"][0]["ceiling"]
+    # the verdict is DURABLE: the sidecar restores with the baseline
+    doc = json.loads((tmp_path / "BENCH_BASELINES.json").read_text())
+    assert any("serving/model2/f32/b2" in key for key in doc["baselines"])
+    restored = matrix_mod.MatrixObservatory(
+        clock=FakeClock(), path=path, warmup_runs=2, confirm_runs=2,
+        rated_spec=rated,
+    )
+    assert restored.snapshot()["cells"]["serving/model2/f32/b2"]["verdict"] == (
+        "degraded"
+    )
+
+
+def test_serving_matrix_impossible_cell_is_structured_device_skip():
+    """The config ships a deliberately impossible serving cell
+    ({"model": 16} on the 8-device platform) proving the structured
+    device-deficit skip path — PR 13's {dcn:2,ici:8} pattern."""
+    from activemonitor_tpu.analysis import matrix as matrix_mod
+
+    spec, warning = matrix_mod.load_spec("config/bench_matrix.json")
+    assert warning is None
+    cells, skipped = matrix_mod.expand(spec, n_devices=8)
+    runnable = {c.cell_id for c in cells if c.op == "serving"}
+    assert "serving/model2/f32/b2" in runnable
+    assert "serving/model2/f32/b4" in runnable
+    deficits = {
+        r.cell.cell_id: r.details["skip"]["code"]
+        for r in skipped
+        if r.cell.op == "serving"
+        and r.details["skip"]["code"] == matrix_mod.SKIP_DEVICES
+    }
+    assert "serving/model16/f32/b2" in deficits
+    assert "16" in next(
+        r.reason for r in skipped
+        if r.cell.cell_id == "serving/model16/f32/b2"
+    )
+
+
+def test_serving_matrix_runner_executes_on_the_real_engine():
+    """One real serving cell through execute_cell: re-meshed over
+    model2 via the kv partition rules, measured, conserved."""
+    from activemonitor_tpu.analysis import matrix as matrix_mod
+
+    [cell], _ = matrix_mod.expand(
+        {
+            "ops": ["serving"],
+            "meshes": [{"model": 2}],
+            "dtypes": ["f32"],
+            "batch_ceilings": [2],
+        },
+        n_devices=8,
+    )
+    result = matrix_mod.execute_cell(cell, iters=1)
+    assert result.status == matrix_mod.STATUS_OK, result.reason
+    assert result.value > 0 and result.seconds > 0
+    assert result.flops > 0 and result.bytes_accessed > 0
+    assert result.details["serving"]["conserved"] is True
+    assert result.details["serving"]["tp_axis_n"] == 2
+
+
+@pytest.mark.slow
+def test_long_open_loop_soak_stays_conserved_and_consistent():
+    """The deep soak (slow tier): a longer Poisson stream with churny
+    lengths — accounting must balance to the token and the paged path
+    must track the static path the whole way."""
+    import jax
+
+    from activemonitor_tpu.models.probe_model import init_params, tiny_config
+    from activemonitor_tpu.probes import serving as serving_probe
+
+    cfg = tiny_config()
+    requests = open_loop_requests(
+        48, 3.0, seed=13, prompt_len_choices=(4, 6, 8, 10),
+        output_choices=(2, 3, 5, 8),
+    )
+    costs = serving_probe.StepCosts(
+        prefill=lambda plen: 0.005 * plen, decode=lambda _n: 0.5
+    )
+    soak = serving_probe.run_soak(
+        cfg, requests, max_batch=6, costs=costs, collect=4, seed=0
+    )
+    cons = soak.scheduler.conservation()
+    assert cons["ok"] is True and cons["completed"] == 48
+    assert cons["tokens_emitted"] == sum(r.output_tokens for r in requests)
+    params = init_params(jax.random.key(0), cfg)
+    assert serving_probe._check_against_static(cfg, params, soak) <= 0.05
+    assert soak.scheduler.occupancy_samples  # batching actually batched
+    assert max(soak.frag_samples) < 1.0
