@@ -177,6 +177,37 @@ def build_parser() -> argparse.ArgumentParser:
         "(docs/operations.md \"Reading a flight recording\")",
     )
     run.add_argument(
+        "--frontdoor",
+        action="store_true",
+        help="serve the probe-as-a-service front door on the health "
+        "endpoint (POST /frontdoor/submit): tenants submit one-shot "
+        "check requests or probe DAGs at high QPS without touching "
+        "the apiserver — per-tenant quota admission, request "
+        "coalescing against the result rings (N identical questions "
+        "share one probe run), degraded-mode parking "
+        "(docs/operations.md \"Probe-as-a-service front door\")",
+    )
+    run.add_argument(
+        "--frontdoor-quota",
+        type=float,
+        default=600.0,
+        metavar="PER_MINUTE",
+        help="default per-tenant admission quota in requests/minute "
+        "(token bucket per tenant, lazily created — an open fleet "
+        "where every tenant gets this budget; refusals are structured "
+        "and counted in healthcheck_frontdoor_refusals_total)",
+    )
+    run.add_argument(
+        "--frontdoor-freshness",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="default freshness window: a cached result younger than "
+        "this satisfies a front-door request without a new probe run "
+        "(requests may narrow it per call; the coalescing-vs-staleness "
+        "tradeoff is documented in docs/operations.md)",
+    )
+    run.add_argument(
         "--matrix-state",
         default="",
         metavar="PATH",
@@ -465,6 +496,34 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         from activemonitor_tpu.analysis.matrix import SidecarView
 
         reconciler.fleet.matrix = SidecarView(matrix_state)
+    frontdoor = None
+    if getattr(args, "frontdoor", False):
+        # probe-as-a-service ingestion (frontdoor/service.py): quota
+        # admission rides the storm token bucket per tenant, routing
+        # rides the SAME consistent-hash ring the sharded fleet uses,
+        # and triggered runs ride the manager's enqueue path below
+        from activemonitor_tpu.controller.sharding import ShardRouter
+        from activemonitor_tpu.frontdoor import (
+            AdmissionController,
+            FrontDoor,
+            TenantQuota,
+        )
+
+        quota = getattr(args, "frontdoor_quota", 600.0)
+        if quota <= 0:
+            raise _ConfigError(
+                f"--frontdoor-quota must be > 0 (got {quota})"
+            )
+        frontdoor = FrontDoor(
+            reconciler.fleet.history,
+            AdmissionController(
+                default_quota=TenantQuota(rate_per_minute=quota),
+                router=ShardRouter(shards) if shards > 1 else None,
+            ),
+            metrics=metrics,
+            resilience=reconciler.resilience,
+            default_freshness=getattr(args, "frontdoor_freshness", 30.0),
+        )
     metrics_authorizer = None
     k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
     if k8s_auth == "on" and kube_api is None:
@@ -507,6 +566,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         remedy_rate=args.remedy_rate,
         shard_coordinator=coordinator,
         flight_dir=getattr(args, "flight_dir", ""),
+        frontdoor=frontdoor,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -778,6 +838,37 @@ def render_status_table(payload: dict) -> str:
     if fleet.get("replicas") is not None:
         fleet_line += f"  replicas={fleet['replicas']}"
     lines = [fleet_line]
+    frontdoor = fleet.get("frontdoor")
+    if frontdoor:
+        # the probe-as-a-service ingestion line: offered load, how much
+        # of it the coalescing cache absorbed, what the door is holding
+        # open, and who is being refused (docs/operations.md
+        # "Probe-as-a-service front door")
+        coalescing = frontdoor.get("coalescing") or {}
+        requests = frontdoor.get("requests") or {}
+        line = (
+            "FRONTDOOR  qps={:.1f}  hit={}  join={}  queue_depth={}  "
+            "runs={}".format(
+                frontdoor.get("qps") or 0.0,
+                _fmt_ratio(coalescing.get("hit")),
+                _fmt_ratio(coalescing.get("join")),
+                frontdoor.get("queue_depth", 0),
+                requests.get("probe_runs", 0),
+            )
+        )
+        refusals = {
+            tenant: row["refused"]
+            for tenant, row in (frontdoor.get("tenants") or {}).items()
+            if row.get("refused")
+        }
+        if refusals:
+            line += "  refusals={" + ", ".join(
+                f"{tenant}: {count}"
+                for tenant, count in sorted(refusals.items())
+            ) + "}"
+        if not frontdoor.get("conservation_ok", True):
+            line += "  CONSERVATION-BROKEN"
+        lines.append(line)
     sharding = fleet.get("sharding")
     if sharding:
         from activemonitor_tpu.obs.slo import shard_sort_key

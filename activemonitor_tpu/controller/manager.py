@@ -90,6 +90,7 @@ class Manager:
         shard_coordinator=None,  # ShardCoordinator: sharded-fleet mode
         goodput_interval: float = 30.0,  # rollup cadence; big fleets raise it
         flight_dir: str = "",  # durable flight-bundle JSONL dir; "" = memory only
+        frontdoor=None,  # FrontDoor: probe-as-a-service ingestion surface
     ):
         self.client = client
         self.reconciler = reconciler
@@ -117,6 +118,21 @@ class Manager:
         # disk, so a postmortem survives the controller that wrote it
         if flight_dir:
             reconciler.flightrec.flight_dir = flight_dir
+        # --frontdoor (frontdoor/service.py): triggered runs ride THIS
+        # manager's enqueue (same workqueue, sharding, tracing, SLO
+        # accounting as watch-path runs), the snapshot rides /statusz,
+        # and the resilience sweep pumps degraded-mode parked requests
+        self._frontdoor = frontdoor
+        if frontdoor is not None:
+            frontdoor.bind(self._frontdoor_trigger)
+            reconciler.fleet.frontdoor = frontdoor
+            if shard_coordinator is not None:
+                # sharded fleet: a miss for a key another replica owns
+                # must refuse `unrouted` (naming its shard) instead of
+                # triggering locally — enqueue would drop the unowned
+                # key and this replica's rings never see the owner's
+                # results, so the waiters would hang until reap
+                frontdoor.owns = shard_coordinator.owns_key
         # fleet-wide remedy storm control (--remedy-rate) lives in the
         # reconciler's resilience coordinator. Sharded fleets apportion
         # the FLEET rate by owned shards (rate × owned/N, re-applied on
@@ -238,6 +254,15 @@ class Manager:
         self._requeue_tasks: Set[asyncio.Task] = set()
         self._http_runners: list = []
         self.reconciler.metrics.set_max_concurrent(self.max_parallel)
+
+    def _frontdoor_trigger(self, namespace: str, name: str) -> None:
+        """The front door's run trigger: mark the cycle demand-driven
+        (the schedule-current dedupe must not swallow it — the tenant
+        asked for a fresher answer than the rings hold) and ride the
+        ordinary workqueue, so sharding/tracing/attribution/SLO
+        accounting apply to the triggered run unchanged."""
+        self.reconciler.demand(namespace, name)
+        self.enqueue(namespace, name)
 
     # -- queue ----------------------------------------------------------
     # controller-runtime workqueue semantics: a queued key coalesces new
@@ -525,6 +550,13 @@ class Manager:
             try:
                 self.reconciler.resilience.refresh()
                 await self.reconciler.replay_status_writes()
+                if self._frontdoor is not None:
+                    # degraded-mode parked requests replay next to the
+                    # queued status writes (same recovery signal), and
+                    # stranded in-flight entries (deleted check,
+                    # disowned shard) are reaped on the same sweep
+                    self._frontdoor.pump()
+                    self._frontdoor.reap()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -886,15 +918,123 @@ class Manager:
             checks = await self.client.list()
             return web.json_response(self.reconciler.fleet.statusz(checks))
 
+        async def frontdoor_submit(request):
+            # the async ingestion surface (frontdoor/service.py):
+            # tenants POST one-shot check requests at high QPS without
+            # touching the apiserver. wait=false returns the admission
+            # decision immediately; the default awaits the fanned-out
+            # result (cache hit, coalesced join, or the triggered run)
+            door = self._frontdoor
+            if door is None:
+                return web.Response(status=404, text="no front door configured")
+            try:
+                body = await request.json()
+                tenant = str(body["tenant"])
+                check = str(body["check"])
+                freshness = body.get("freshness")
+                freshness = None if freshness is None else float(freshness)
+                wait = bool(body.get("wait", True))
+                dag_spec = body.get("dag")
+            except (KeyError, TypeError, ValueError) as e:
+                return web.Response(status=400, text=f"bad request: {e}")
+
+            def ticket_doc(ticket) -> dict:
+                result = ticket.result
+                return {
+                    "outcome": ticket.outcome,
+                    "reason": ticket.reason,
+                    "tenant": ticket.tenant,
+                    "check": ticket.check,
+                    "shard": ticket.shard,
+                    "trace_id": ticket.trace_id,
+                    "result": result.to_dict() if result is not None else None,
+                }
+
+            ticket = None
+            try:
+                if dag_spec:
+                    # composable probe DAG: the check field names the
+                    # DAG, the dag field carries the arrow syntax
+                    # (docs/operations.md "Probe DAGs")
+                    from activemonitor_tpu.frontdoor.dag import parse_dag
+
+                    dag = parse_dag(check, str(dag_spec), freshness)
+                    if not wait:
+                        # fire-and-forget: the DAG executes in the
+                        # background (results land in the rings and the
+                        # metric families); 202 acknowledges admission
+                        # of the request, not its outcome
+                        task = asyncio.create_task(
+                            door.run_dag(tenant, dag)
+                        )
+                        self._requeue_tasks.add(task)
+                        task.add_done_callback(self._requeue_tasks.discard)
+                        return web.json_response(
+                            {
+                                "dag": check,
+                                "accepted": True,
+                                "steps": [s.name for s in dag.steps],
+                            },
+                            status=202,
+                        )
+                    tickets = await door.run_dag(tenant, dag)
+                    return web.json_response(
+                        {
+                            "dag": check,
+                            "steps": {
+                                name: ticket_doc(t)
+                                for name, t in tickets.items()
+                            },
+                        }
+                    )
+                ticket = door.submit(tenant, check, freshness)
+                if wait and ticket.future is not None:
+                    # shield: a handler-task cancellation (client gone,
+                    # server stopping) must NOT cancel the shared
+                    # fan-in future other waiters ride — and it keeps
+                    # the two cancellation sources distinguishable
+                    await asyncio.shield(ticket.future)
+                    ticket.result = ticket.future.result()
+            except ValueError as e:
+                return web.Response(status=400, text=f"bad request: {e}")
+            except asyncio.CancelledError:
+                # the reap sweep cancels waiters of stranded runs
+                # (deleted/quarantined/stopped checks record no
+                # result) — that is a gateway timeout for THIS
+                # request, not a dying server: the shield above means
+                # the ticket's future is cancelled ONLY on reap, so
+                # re-raise for a genuine handler-task cancellation
+                if (
+                    ticket is None
+                    or ticket.future is None
+                    or not ticket.future.cancelled()
+                ):
+                    raise
+                return web.Response(
+                    status=504,
+                    text="probe run recorded no result (check deleted, "
+                    "quarantined, or stopped); request reaped",
+                )
+            return web.json_response(ticket_doc(ticket))
+
+        async def frontdoor_status(_request):
+            if self._frontdoor is None:
+                return web.Response(status=404, text="no front door configured")
+            return web.json_response(self._frontdoor.snapshot())
+
         # /debug and /statusz ride the health-probe site (plaintext,
         # kubelet-open) — trace/event/fleet payloads are operator
         # diagnostics like /healthz, not scrape data behind the metrics
-        # auth filter
+        # auth filter. The front door rides the same site: its tenants
+        # are the cluster's own workloads, and the admission layer IS
+        # its protection (quota refusals, not transport auth).
         debug_routes = [
             web.get("/debug/traces", debug_traces),
             web.get("/debug/events", debug_events),
             web.get("/debug/flightrec", debug_flightrec),
             web.get("/statusz", statusz),
+            web.post("/frontdoor/submit", frontdoor_submit),
+            web.get("/frontdoor", frontdoor_status),
         ]
 
         def guarded(handler):
@@ -916,9 +1056,11 @@ class Manager:
             web.get("/debug/events", guarded(debug_events)),
             web.get("/debug/flightrec", guarded(debug_flightrec)),
             web.get("/statusz", guarded(statusz)),
+            web.post("/frontdoor/submit", guarded(frontdoor_submit)),
+            web.get("/frontdoor", guarded(frontdoor_status)),
         ]
 
-        async def bind(addr: str, routes, secure: bool = False) -> None:
+        async def bind_site(addr: str, routes, secure: bool = False) -> None:
             host, _, port = addr.rpartition(":")
             app = web.Application()
             app.add_routes(routes)
@@ -937,7 +1079,7 @@ class Manager:
             # identical sockets only (addr_same in __init__); overlapping
             # -but-different hosts were refused there, so this merge
             # cannot change either endpoint's exposure
-            await bind(
+            await bind_site(
                 self._metrics_addr,
                 [
                     web.get("/metrics", metrics),
@@ -948,13 +1090,13 @@ class Manager:
             )
             return
         if self._metrics_addr:
-            await bind(
+            await bind_site(
                 self._metrics_addr,
                 [web.get("/metrics", metrics)],
                 secure=self._metrics_secure,
             )
         if self._health_addr:
-            await bind(
+            await bind_site(
                 self._health_addr,
                 [web.get("/healthz", healthz), web.get("/readyz", readyz)]
                 + debug_routes,

@@ -167,6 +167,13 @@ class HealthCheckReconciler:
         # owner's late status writes. None = unsharded (own everything).
         self.shards = None
         self._watch_tasks: Dict[str, asyncio.Task] = {}
+        # demand-driven runs (frontdoor/service.py): keys whose next
+        # reconcile must SUBMIT even though the schedule is current —
+        # a tenant asked for a fresher answer than the rings hold. The
+        # mark is consumed by the cycle that acts on it (submits, or
+        # finds an in-flight watch already satisfying the demand), so
+        # ordinary watch-event reconciles never see it.
+        self._demanded: set = set()
         # set by the Manager: routes failed-run requeues through its
         # workqueue (per-key serialized, stop-aware, retried on crash)
         # instead of a loop inside the dying task
@@ -177,6 +184,15 @@ class HealthCheckReconciler:
     # ------------------------------------------------------------------
     # entry point (reference: Reconcile, healthcheck_controller.go:170-188)
     # ------------------------------------------------------------------
+    def demand(self, namespace: str, name: str) -> None:
+        """Mark the check's next reconcile as demand-driven (the front
+        door's trigger): the schedule-current dedupe must not swallow
+        it — the cycle submits a run NOW, exactly like an owed fire.
+        The caller still enqueues the key; a run already in flight
+        satisfies the demand instead (its result fans out to the same
+        waiters), so a demand can never stack a duplicate run."""
+        self._demanded.add(f"{namespace}/{name}")
+
     async def reconcile(self, namespace: str, name: str) -> Optional[float]:
         """Returns a requeue-after delay in seconds, or None."""
         hc = await self.client.get(namespace, name)
@@ -186,6 +202,7 @@ class HealthCheckReconciler:
             # bare name (:139), letting same-named checks in different
             # namespaces clobber each other's schedules.
             key = f"{namespace}/{name}"
+            self._demanded.discard(key)  # nothing left to demand-run
             if self.timers.exists(key):
                 log.info("cancelling scheduled run for deleted healthcheck %s", key)
                 self.timers.stop(key)
@@ -231,9 +248,25 @@ class HealthCheckReconciler:
     # ------------------------------------------------------------------
     # decision logic (reference: processHealthCheck, :225-291)
     # ------------------------------------------------------------------
+    def _demand_unservable(self, key: str) -> None:
+        """This cycle can never record a result (quarantined, stopped,
+        no workflow resource): consume any pending demand mark — a
+        stale mark would fire a surprise run when the condition clears
+        — and cancel the front door's fanned-in waiters NOW, at
+        reconcile speed, instead of leaving a dead in-flight entry
+        absorbing joins until the reap sweep."""
+        self._demanded.discard(key)
+        frontdoor = self.fleet.frontdoor
+        if frontdoor is not None:
+            try:
+                frontdoor.cache.forget(key)
+            except Exception:
+                log.exception("frontdoor waiter cancel failed for %s", key)
+
     async def _process(self, hc: HealthCheck) -> Optional[float]:
         spec = hc.spec
         if spec.workflow.resource is None:
+            self._demand_unservable(hc.key)
             return None  # nothing to run (reference guards on Resource != nil, :227)
 
         # a queued (not-yet-replayed) status write is FRESHER truth than
@@ -246,12 +279,16 @@ class HealthCheckReconciler:
 
         # quarantine gate (docs/resilience.md): a check whose cycles
         # repeatedly die pre-terminal stops running until a user clears
-        # the durable .status.state mark
+        # the durable .status.state mark. A pending front-door demand
+        # is consumed unserved and its waiters cancelled at reconcile
+        # speed — never a surprise run when the user clears the mark
         if await self._quarantine_gate(hc):
+            self._demand_unservable(hc.key)
             return None
 
         # pause (reference: :238-250)
         if spec.repeat_after_sec <= 0 and not spec.schedule.cron:
+            self._demand_unservable(hc.key)  # stopped: demand unserved
             hc.status.status = STATUS_STOPPED
             hc.status.error_message = (
                 "workflow execution is stopped; either spec.RepeatAfterSec or "
@@ -289,16 +326,24 @@ class HealthCheckReconciler:
         # against the delta-to-NEXT-fire is wrong for absolute schedules
         # reconciled late in a period).
         remaining = self._schedule_remaining(hc)
+        # a demand-driven cycle (frontdoor/service.py): the tenant asked
+        # for a fresher answer than the schedule owes, so the current-
+        # schedule dedupe below must not swallow this cycle — it submits
+        # like an owed fire. Consumed here (one demand, one run).
+        demanded = hc.key in self._demanded
         # nothing owed yet AND a live (unfired) timer ⇒ the schedule is
         # healthy; let the timer drive the next run. Time-bounding the
         # guard matters: a fired-but-bailed timer entry must not wedge
         # the check forever, and a spec edited to a faster cadence must
         # not wait out the old timer.
-        if remaining is not None and self.timers.pending(hc.key):
+        if remaining is not None and self.timers.pending(hc.key) and not demanded:
             return None
         # a watch for this check is still in flight (workflow running
-        # longer than the interval): don't stack a duplicate run
+        # longer than the interval): don't stack a duplicate run — and
+        # it satisfies any pending demand (its result fans out to the
+        # same front-door waiters)
         if self._watch_active(hc.key):
+            self._demanded.discard(hc.key)
             return None
         # Divergence 10: true resume after a controller restart. The
         # reference's dedupe needs its process-local timer, so a restart
@@ -308,7 +353,7 @@ class HealthCheckReconciler:
         # timer from durable status for the remaining time to the owed
         # fire. Overdue checks (a fire passed while down) fall through
         # and run immediately.
-        if remaining is not None:
+        if remaining is not None and not demanded:
             self.timers.schedule(hc.key, remaining, self._resubmit_callback(hc))
             self.recorder.event(
                 hc,
@@ -317,9 +362,12 @@ class HealthCheckReconciler:
                 "Schedule resumed from durable status for the remaining interval",
             )
             return None
-        # a run is owed NOW: cancel any still-pending timer first (the
-        # sub-second rounding sliver, or a stale long timer after a spec
-        # edit) so it cannot double-fire behind this submission
+        # a run is owed NOW (or demanded now): cancel any still-pending
+        # timer first (the sub-second rounding sliver, or a stale long
+        # timer after a spec edit) so it cannot double-fire behind this
+        # submission — a demanded run re-anchors the cadence at its own
+        # finish, which is correct: a fresh result just landed
+        self._demanded.discard(hc.key)
         self.timers.stop(hc.key)
 
         # per-run RBAC (reference: :269)

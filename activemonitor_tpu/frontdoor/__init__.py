@@ -1,0 +1,64 @@
+"""Probe-as-a-service front door (ROADMAP item 3).
+
+High-QPS async ingestion in front of the sharded fleet: per-tenant
+admission quotas riding the storm token bucket, a request-coalescing
+cache over the result rings (N identical tenant questions share ONE
+probe run), composable probe DAGs compiled into the Manager enqueue
+path, and degraded-mode parking instead of drops. docs/operations.md
+"Probe-as-a-service front door" is the operator contract.
+"""
+
+from activemonitor_tpu.frontdoor.admission import (
+    AdmissionController,
+    AdmissionDecision,
+    OVERFLOW_TENANT,
+    REFUSE_ABANDONED,
+    REFUSE_PARKED_FULL,
+    REFUSE_QUOTA,
+    REFUSE_TENANT_CAPACITY,
+    REFUSE_UNKNOWN_TENANT,
+    REFUSE_UNROUTED,
+    TenantQuota,
+)
+from activemonitor_tpu.frontdoor.coalesce import (
+    CoalescingCache,
+    DEFAULT_FRESHNESS_SECONDS,
+)
+from activemonitor_tpu.frontdoor.dag import DagStep, ProbeDag, parse_dag
+from activemonitor_tpu.frontdoor.service import (
+    FrontDoor,
+    OUTCOME_HIT,
+    OUTCOME_JOINED,
+    OUTCOME_PARKED,
+    OUTCOME_REFUSED,
+    OUTCOME_RUN,
+    Ticket,
+)
+from activemonitor_tpu.frontdoor.traffic import CheckRequest, open_loop_checks
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "CheckRequest",
+    "CoalescingCache",
+    "DEFAULT_FRESHNESS_SECONDS",
+    "DagStep",
+    "FrontDoor",
+    "OUTCOME_HIT",
+    "OUTCOME_JOINED",
+    "OUTCOME_PARKED",
+    "OUTCOME_REFUSED",
+    "OUTCOME_RUN",
+    "OVERFLOW_TENANT",
+    "ProbeDag",
+    "REFUSE_ABANDONED",
+    "REFUSE_PARKED_FULL",
+    "REFUSE_QUOTA",
+    "REFUSE_TENANT_CAPACITY",
+    "REFUSE_UNKNOWN_TENANT",
+    "REFUSE_UNROUTED",
+    "TenantQuota",
+    "Ticket",
+    "open_loop_checks",
+    "parse_dag",
+]

@@ -1,0 +1,198 @@
+"""Per-tenant admission control for the probe-as-a-service front door.
+
+ROADMAP item 3: millions of users, one fleet. The apiserver-watch path
+has no admission story — every CR is reconciled — but an open ingestion
+surface needs one before anything else: a single hot tenant must not be
+able to starve the fleet's measurement capacity. The quota primitive is
+the existing :class:`~activemonitor_tpu.resilience.storm.TokenBucket`
+(the fleet-wide remedy cap's bucket, reused per tenant), and routing is
+the existing :class:`~activemonitor_tpu.controller.sharding.
+ShardRouter` — a front-door request for check X lands on the SAME shard
+the watch path would route X's reconcile to, so the sharded fleet's
+ownership math applies unchanged to front-door traffic.
+
+Refusals are STRUCTURED, never exceptions: a refusal names its tenant
+and reason (``quota`` / ``unknown_tenant`` / ``parked_full``) and is
+counted, because the per-tenant conservation ledger
+(frontdoor/service.py) must account for every submitted request
+exactly — a raised refusal would vanish from the books.
+
+Everything here runs on the injectable Clock; ``hack/lint.py`` bans
+bare wall-clock reads in the ``frontdoor`` package like resilience/
+and analysis/.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from activemonitor_tpu.controller.sharding import ShardRouter
+from activemonitor_tpu.resilience.storm import TokenBucket
+from activemonitor_tpu.utils.clock import Clock
+
+# refusal reasons (the structured vocabulary the refusal counters and
+# the healthcheck_frontdoor_refusals_total{reason} label carry).
+# quota/unknown_tenant/tenant_capacity refuse BEFORE admission;
+# parked_full/abandoned/unrouted are post-admission outcomes the
+# conservation ledger books separately
+REFUSE_QUOTA = "quota"
+REFUSE_UNKNOWN_TENANT = "unknown_tenant"
+REFUSE_TENANT_CAPACITY = "tenant_capacity"  # max_tenants reached
+REFUSE_PARKED_FULL = "parked_full"
+REFUSE_ABANDONED = "abandoned"  # parked waiter cancelled before the pump
+REFUSE_UNROUTED = "unrouted"  # sharded fleet: another replica owns the key
+
+# the reasons refused before the tenant's bucket admitted the request
+PRE_ADMISSION_REASONS = (
+    REFUSE_QUOTA,
+    REFUSE_UNKNOWN_TENANT,
+    REFUSE_TENANT_CAPACITY,
+)
+
+# the ledger row never-seen tenants' refusals are booked under: the
+# front door faces an open endpoint, so per-tenant state (buckets,
+# tallies, refusal rows, metric series) must stay bounded by the
+# admission config — a stranger spraying random tenant names mints ONE
+# shared row, not one per name
+OVERFLOW_TENANT = "(overflow)"
+
+# default bound on lazily-minted tenant buckets (named quotas are
+# config, bounded by definition; this caps the default-quota fleet)
+DEFAULT_MAX_TENANTS = 1024
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's admission budget: requests/minute with a burst
+    ceiling (defaults to the rate, the TokenBucket convention)."""
+
+    rate_per_minute: float
+    burst: Optional[float] = None
+
+    def bucket(self, clock: Clock) -> TokenBucket:
+        return TokenBucket(self.rate_per_minute, burst=self.burst, clock=clock)
+
+
+@dataclass(frozen=True)
+class AdmissionDecision:
+    """The structured admit/refuse verdict for one request.
+
+    ``tenant`` is the caller's spelling (echoed in the reply);
+    ``booked`` is the ledger row the decision was accounted under —
+    identical for every known tenant, ``(overflow)`` for never-seen
+    names refused without minting per-name state.
+    """
+
+    admitted: bool
+    tenant: str
+    shard: int  # ShardRouter assignment of the check key (0 unsharded)
+    reason: str = ""  # refusal vocabulary above; "" when admitted
+    booked: str = ""  # ledger row (defaults to tenant in __post_init__)
+
+    def __post_init__(self):
+        if not self.booked:
+            object.__setattr__(self, "booked", self.tenant)
+
+
+class AdmissionController:
+    """Per-tenant token buckets + shard routing, with refusals counted.
+
+    ``quotas`` names the known tenants; ``default_quota`` (optional)
+    admits tenants that were never configured — omit it and an unknown
+    tenant is a structured ``unknown_tenant`` refusal (a closed fleet),
+    set it and new tenants get the default budget lazily (an open
+    fleet). Buckets are created on first use so a million-tenant fleet
+    pays memory only for tenants that actually talk.
+    """
+
+    def __init__(
+        self,
+        quotas: Optional[Dict[str, TenantQuota]] = None,
+        *,
+        default_quota: Optional[TenantQuota] = None,
+        router: Optional[ShardRouter] = None,
+        clock: Optional[Clock] = None,
+        max_tenants: int = DEFAULT_MAX_TENANTS,
+    ):
+        self.clock = clock or Clock()
+        self._quotas = dict(quotas or {})
+        self._default = default_quota
+        self._router = router
+        self.max_tenants = max(1, int(max_tenants))
+        self._buckets: Dict[str, TokenBucket] = {}
+        # per-tenant ledger: admitted counts and refusals by reason —
+        # the raw material of the conservation property test. Keyed by
+        # the BOOKED name (never-seen tenants' refusals share the
+        # (overflow) row), so the endpoint cannot mint unbounded state
+        self.admitted: Dict[str, int] = {}
+        self.refused: Dict[str, Dict[str, int]] = {}
+
+    def shard_for(self, key: str) -> int:
+        """The check key's shard under the fleet's router (0 when the
+        front door serves an unsharded fleet)."""
+        return self._router.shard_for(key) if self._router is not None else 0
+
+    def _resolve(self, tenant: str) -> tuple:
+        """(bucket|None, refusal-reason|None): an existing bucket or
+        named quota always resolves; a default-quota tenant mints a
+        bucket only under the ``max_tenants`` cap (beyond it the
+        refusal books under the shared overflow row); no default means
+        a closed fleet."""
+        bucket = self._buckets.get(tenant)
+        if bucket is not None:
+            return bucket, None
+        quota = self._quotas.get(tenant)
+        if quota is None:
+            if self._default is None:
+                return None, REFUSE_UNKNOWN_TENANT
+            if len(self._buckets) >= self.max_tenants:
+                return None, REFUSE_TENANT_CAPACITY
+            quota = self._default
+        bucket = self._buckets[tenant] = quota.bucket(self.clock)
+        return bucket, None
+
+    def refuse(
+        self, tenant: str, reason: str, booked: Optional[str] = None
+    ) -> AdmissionDecision:
+        """Count and return a structured refusal (also used by the
+        service for post-admission refusals like a full parking lot, so
+        every refusal path shares one ledger). ``booked`` overrides the
+        ledger row — never-seen tenants share ``(overflow)`` so random
+        names cannot mint unbounded rows or metric series."""
+        row = booked if booked is not None else tenant
+        per_tenant = self.refused.setdefault(row, {})
+        per_tenant[reason] = per_tenant.get(reason, 0) + 1
+        return AdmissionDecision(
+            admitted=False, tenant=tenant, shard=0, reason=reason, booked=row
+        )
+
+    def admit(self, tenant: str, key: str) -> AdmissionDecision:
+        """One request's admission verdict: unknown tenants refuse
+        (closed fleet), a tenant beyond the lazily-minted bucket cap
+        refuses ``tenant_capacity`` (booked under the overflow row),
+        then the tenant's bucket pays one token or the request refuses
+        with ``quota``. Admissions and refusals both land in the
+        per-tenant ledger."""
+        bucket, reason = self._resolve(tenant)
+        if bucket is None:
+            return self.refuse(tenant, reason, booked=OVERFLOW_TENANT)
+        if not bucket.try_take():
+            return self.refuse(tenant, REFUSE_QUOTA)
+        self.admitted[tenant] = self.admitted.get(tenant, 0) + 1
+        return AdmissionDecision(
+            admitted=True, tenant=tenant, shard=self.shard_for(key)
+        )
+
+    def snapshot(self) -> dict:
+        """The admission half of the front door's /statusz block."""
+        tenants = sorted(set(self.admitted) | set(self.refused))
+        return {
+            "tenants": {
+                tenant: {
+                    "admitted": self.admitted.get(tenant, 0),
+                    "refused": dict(self.refused.get(tenant, {})),
+                }
+                for tenant in tenants
+            },
+        }

@@ -1,0 +1,182 @@
+"""Request-coalescing cache keyed (check identity, freshness window).
+
+The goodput lever of the front door (PAPERS.md: *ML Productivity
+Goodput* — every deduplicated run is measurement capacity returned to
+real work; FlowMesh calls the same move request coalescing): N tenants
+asking "is slice X healthy?" inside one freshness window share ONE
+probe run. Three outcomes per lookup:
+
+- **hit** — the check's result ring (:class:`~activemonitor_tpu.obs.
+  history.ResultHistory`) holds a result younger than the freshness
+  window: served immediately, no run, no queue.
+- **in-flight join** — a run for the check is already in flight
+  (triggered by an earlier front-door request OR by the check's own
+  schedule — the watch path's run coalesces front-door traffic too):
+  the request fans IN onto it and fans back OUT on completion.
+- **miss** — neither: the caller triggers exactly one run and becomes
+  the in-flight entry every duplicate joins.
+
+Fan-out rides the history's record-time subscription: the reconciler
+records the run's :class:`CheckResult` (status write path — the single
+place every run converges, including synthesized timeouts, so a hung
+engine can never strand waiters forever), and every waiter's future
+resolves with that SAME result object — same ``trace_id``, so the N
+fanned-out responses are joinable to the one underlying reconcile
+cycle at ``/debug/traces``.
+
+State is single-owner on the event loop (the same discipline as the
+manager's queue sets): lookup → begin has no await point, so a
+duplicate can never slip between them. All freshness math runs on the
+injected Clock — the SAME clock the history stamps results with —
+and ``hack/lint.py`` bans wall-clock reads here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from activemonitor_tpu.obs.history import CheckResult, ResultHistory
+from activemonitor_tpu.utils.clock import Clock
+
+# a cached result younger than this many seconds satisfies a request
+# that didn't name its own freshness window
+DEFAULT_FRESHNESS_SECONDS = 30.0
+
+LOOKUP_HIT = "hit"
+LOOKUP_INFLIGHT = "inflight"
+LOOKUP_MISS = "miss"
+
+
+@dataclass
+class InFlightRun:
+    """One probe run in flight with every request fanned in on it."""
+
+    key: str
+    started: float  # clock.monotonic() at begin
+    waiters: List[asyncio.Future] = field(default_factory=list)
+
+    def join(self) -> asyncio.Future:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.waiters.append(fut)
+        return fut
+
+
+class CoalescingCache:
+    """Freshness-window lookups over the result rings plus the
+    in-flight fan-in/fan-out registry."""
+
+    def __init__(
+        self,
+        history: ResultHistory,
+        *,
+        clock: Optional[Clock] = None,
+        default_freshness: float = DEFAULT_FRESHNESS_SECONDS,
+    ):
+        self.history = history
+        self.clock = clock or Clock()
+        self.default_freshness = max(0.0, float(default_freshness))
+        self._inflight: Dict[str, InFlightRun] = {}
+        # running fan-in count, so waiter_count() is O(1) on the
+        # submit hot path instead of a walk over every in-flight run
+        self._waiters = 0
+        history.subscribe(self._on_result)
+
+    # -- lookups ---------------------------------------------------------
+    def fresh_result(
+        self, key: str, freshness: Optional[float] = None
+    ) -> Optional[CheckResult]:
+        """The check's newest recorded result if it is younger than the
+        freshness window, else None. A per-request window may only
+        NARROW the door's default (the documented contract: the
+        operator's default is the staleness ceiling — a request asking
+        for a wider window clamps down to it). Freshness is judged on
+        the SAME clock the history stamped the result with, so
+        fake-clock tests script exact expiry edges."""
+        window = (
+            self.default_freshness
+            if freshness is None
+            else min(freshness, self.default_freshness)
+        )
+        last = self.history.last(key)
+        if last is None or window <= 0:
+            return None
+        age = (self.clock.now() - last.ts).total_seconds()
+        return last if age < window else None
+
+    def lookup(
+        self, key: str, freshness: Optional[float] = None
+    ) -> Tuple[str, Optional[CheckResult]]:
+        """(outcome, fresh result|None): ``hit`` beats ``inflight``
+        beats ``miss`` — a fresh-enough result serves even while a
+        newer run is in flight (the requester asked for freshness, not
+        for the newest possible answer; that tradeoff is the documented
+        coalescing-vs-staleness contract in docs/operations.md)."""
+        fresh = self.fresh_result(key, freshness)
+        if fresh is not None:
+            return LOOKUP_HIT, fresh
+        if key in self._inflight:
+            return LOOKUP_INFLIGHT, None
+        return LOOKUP_MISS, None
+
+    # -- in-flight registry ----------------------------------------------
+    def begin(self, key: str) -> InFlightRun:
+        """Register the one in-flight run every duplicate joins. The
+        caller triggers the actual probe; begin() only claims the slot
+        (a second begin for a live key is a programming error — the
+        service always looks up first, with no await in between)."""
+        if key in self._inflight:
+            raise RuntimeError(f"run already in flight for {key}")
+        run = InFlightRun(key=key, started=self.clock.monotonic())
+        self._inflight[key] = run
+        return run
+
+    def join(self, key: str) -> asyncio.Future:
+        """Fan a request in on the in-flight run (the triggering
+        request itself joins its own run the same way)."""
+        run = self._inflight.get(key)
+        if run is None:
+            raise KeyError(f"no run in flight for {key}")
+        self._waiters += 1
+        return run.join()
+
+    def inflight_keys(self) -> List[str]:
+        return list(self._inflight)
+
+    def stale_inflight(self, cutoff_monotonic: float) -> List[str]:
+        """Keys whose run has been in flight since before ``cutoff``
+        (the reap sweep's candidates)."""
+        return [
+            key
+            for key, run in self._inflight.items()
+            if run.started < cutoff_monotonic
+        ]
+
+    def waiter_count(self) -> int:
+        """Requests currently fanned in on in-flight runs (O(1))."""
+        return self._waiters
+
+    def forget(self, key: str) -> None:
+        """Drop a deleted check's in-flight entry; waiters are cancelled
+        (the check is gone — there is no result to fan out)."""
+        run = self._inflight.pop(key, None)
+        if run is not None:
+            self._waiters -= len(run.waiters)
+            for fut in run.waiters:
+                if not fut.done():
+                    fut.cancel()
+
+    # -- fan-out ---------------------------------------------------------
+    def _on_result(self, key: str, result: CheckResult) -> None:
+        """History recorded a run for ``key``: resolve every fanned-in
+        waiter with the SAME result (shared trace_id) and retire the
+        in-flight entry. Runs the reconciler's own record call, so it
+        must never raise (the subscribe contract) and never block."""
+        run = self._inflight.pop(key, None)
+        if run is None:
+            return
+        self._waiters -= len(run.waiters)
+        for fut in run.waiters:
+            if not fut.done():
+                fut.set_result(result)

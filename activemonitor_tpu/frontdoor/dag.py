@@ -1,0 +1,151 @@
+"""Composable probe DAGs — compile-smoke → ICI sweep → training-step.
+
+The FlowMesh framing (PAPERS.md: composable LLM workflows) applied to
+probes: a tenant's question is rarely one probe — "is my slice ready
+for training?" is a compile smoke, then an ICI sweep, then a
+training-step probe, where a failed upstream makes the downstream
+meaningless. A :class:`ProbeDag` declares that shape; the front door
+executes it stage by stage through the SAME submit path every one-shot
+request rides, which buys two things for free:
+
+- **reuse instead of re-probing**: every step is a coalescing-cache
+  submission, so a step whose check already has a fresh-enough result
+  (because another tenant's DAG — or the check's own schedule — just
+  ran it) serves from the ring, and N tenants submitting the same DAG
+  inside one freshness window share ONE run per step.
+- **unchanged backend semantics**: a step that does run is compiled
+  into the existing Manager enqueue path, so sharding, tracing,
+  attribution, and SLO accounting all apply to DAG steps exactly as
+  they do to watch-path runs.
+
+Syntax (docs/operations.md "Probe DAGs"): stages separated by ``->``,
+siblings within a stage by ``,`` — every step of a stage depends on
+every step of the previous stage::
+
+    health/compile-smoke -> health/ici-sweep -> health/training-step
+    health/compile-smoke -> health/ici-sweep, health/hbm -> health/train
+
+No clock, no I/O — pure declaration + validation (wall-clock lint ban
+applies to this package; here there is simply no time at all).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class DagStep:
+    """One step: a check identity plus the step names it waits on."""
+
+    name: str  # unique step label within the DAG
+    check: str  # "namespace/name" — the check identity submitted
+    after: Tuple[str, ...] = ()  # upstream step names (all must finish)
+    freshness: Optional[float] = None  # per-step window; None = DAG default
+
+
+@dataclass(frozen=True)
+class ProbeDag:
+    """A validated DAG: unique step names, known dependencies, acyclic.
+
+    ``stages()`` is the execution plan — Kahn levels, declaration-order
+    stable, so the same DAG always executes in the same order (the
+    determinism the acceptance tests pin).
+    """
+
+    name: str
+    steps: Tuple[DagStep, ...]
+    _stages: Tuple[Tuple[DagStep, ...], ...] = field(
+        default=(), compare=False, repr=False
+    )
+
+    def __post_init__(self):
+        names = [s.name for s in self.steps]
+        if not names:
+            raise ValueError(f"dag {self.name!r} has no steps")
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"dag {self.name!r} repeats step name(s) {sorted(dupes)}; "
+                "a repeated check rides the coalescing cache — list it once"
+            )
+        known = set(names)
+        for step in self.steps:
+            unknown = [dep for dep in step.after if dep not in known]
+            if unknown:
+                raise ValueError(
+                    f"dag {self.name!r} step {step.name!r} depends on "
+                    f"unknown step(s) {unknown}"
+                )
+            if step.name in step.after:
+                raise ValueError(
+                    f"dag {self.name!r} step {step.name!r} depends on itself"
+                )
+        # Kahn layering, declaration-order stable; leftovers = a cycle
+        remaining: Dict[str, DagStep] = {s.name: s for s in self.steps}
+        done: set = set()
+        stages: List[Tuple[DagStep, ...]] = []
+        while remaining:
+            ready = tuple(
+                step
+                for step in self.steps
+                if step.name in remaining
+                and all(dep in done for dep in step.after)
+            )
+            if not ready:
+                raise ValueError(
+                    f"dag {self.name!r} has a dependency cycle among "
+                    f"{sorted(remaining)}"
+                )
+            for step in ready:
+                del remaining[step.name]
+                done.add(step.name)
+            stages.append(ready)
+        object.__setattr__(self, "_stages", tuple(stages))
+
+    def stages(self) -> Tuple[Tuple[DagStep, ...], ...]:
+        """Execution levels: every step of level i waits for all of its
+        dependencies, which live in earlier levels by construction."""
+        return self._stages
+
+
+def parse_dag(
+    name: str, text: str, freshness: Optional[float] = None
+) -> ProbeDag:
+    """The arrow syntax: ``a -> b, c -> d`` builds three stages where
+    each stage's steps depend on ALL of the previous stage's (the
+    common pipeline shape; richer shapes construct :class:`DagStep`
+    directly). Tokens are check identities (``namespace/name``) and
+    double as step names, so a malformed spec names its own token."""
+    stages = [
+        [token.strip() for token in stage.split(",") if token.strip()]
+        for stage in text.split("->")
+    ]
+    stages = [stage for stage in stages if stage]
+    if not stages:
+        raise ValueError(f"dag {name!r}: empty spec {text!r}")
+    for stage in stages:
+        for token in stage:
+            # validated at PARSE time: a malformed later-stage token
+            # must reject the whole request before any earlier stage
+            # pays quota or launches a probe run
+            if "/" not in token:
+                raise ValueError(
+                    f"dag {name!r}: step {token!r} is not a "
+                    "namespace/name check identity"
+                )
+    steps: List[DagStep] = []
+    previous: Sequence[str] = ()
+    for stage in stages:
+        for token in stage:
+            steps.append(
+                DagStep(
+                    name=token,
+                    check=token,
+                    after=tuple(previous),
+                    freshness=freshness,
+                )
+            )
+        previous = tuple(stage)
+    return ProbeDag(name=name, steps=tuple(steps))

@@ -1,0 +1,614 @@
+"""The front door: high-QPS async ingestion for probe-as-a-service.
+
+ROADMAP item 3. Checks used to arrive only as CRs through the
+apiserver watch, so the fleet's throughput ceiling was the control
+plane's. The front door is the FlowMesh-style fabric in front of the
+sharded fleet: tenants submit one-shot check requests (or whole probe
+DAGs) at high QPS *without touching the apiserver* — a request either
+rides a cached result, fans in on an in-flight run, or triggers
+exactly one run through the existing Manager enqueue path (so
+sharding, tracing, attribution, and SLO accounting apply unchanged).
+
+One request's path, in order:
+
+1. **admission** (frontdoor/admission.py): the tenant's token bucket
+   pays one token or the request is a structured ``quota`` refusal.
+2. **coalescing cache** (frontdoor/coalesce.py): fresh ring result ⇒
+   ``cache_hit`` (served immediately — even in degraded mode: cached
+   answers are exactly what a wounded control plane can still afford);
+   in-flight run ⇒ ``joined`` (fans in, fans out on completion).
+3. **miss**: degraded mode (breaker open) PARKS the request in a
+   bounded lot instead of dropping it — the pump replays it when the
+   breaker closes; healthy mode triggers one probe run via the bound
+   backend (Manager.enqueue) and registers the in-flight entry every
+   duplicate joins.
+
+The decision path is synchronous (``submit`` returns a
+:class:`Ticket`; ``Ticket.wait()`` awaits the fanned-out result), so
+admission latency is pure policy arithmetic — the 10k-requests/s soak
+measures it without event-loop scheduling noise. Accounting is
+conservation-by-construction, the serving scheduler's discipline
+applied per tenant: every submitted request lands in EXACTLY one of
+{cache_hit, joined, run, parked, refused}, and :meth:`FrontDoor.
+conservation` cross-checks the admission ledger against the outcome
+ledger so a tenant-attribution bug cannot hide behind balanced global
+totals.
+
+Everything timed runs on the injectable Clock (``hack/lint.py`` bans
+wall-clock reads in this package); state is single-owner on the event
+loop like the manager's queue sets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, Optional
+
+from activemonitor_tpu.frontdoor.admission import (
+    PRE_ADMISSION_REASONS,
+    REFUSE_ABANDONED,
+    REFUSE_PARKED_FULL,
+    REFUSE_UNROUTED,
+    AdmissionController,
+)
+from activemonitor_tpu.frontdoor.coalesce import (
+    LOOKUP_HIT,
+    LOOKUP_INFLIGHT,
+    CoalescingCache,
+)
+from activemonitor_tpu.frontdoor.dag import ProbeDag
+from activemonitor_tpu.obs.history import CheckResult, ResultHistory
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.frontdoor")
+
+# one-of-exactly-one outcome vocabulary (the conservation ledger's
+# columns and the healthcheck_frontdoor_requests_total{outcome} label)
+OUTCOME_HIT = "cache_hit"
+OUTCOME_JOINED = "joined"
+OUTCOME_RUN = "run"
+OUTCOME_PARKED = "parked"
+OUTCOME_REFUSED = "refused"
+
+# degraded-mode parking lot bound: beyond this the refusal is
+# structured (parked_full), never an unbounded queue
+DEFAULT_PARK_CAPACITY = 1024
+
+# QPS is reported over rotating buckets of this many seconds
+QPS_WINDOW_SECONDS = 5.0
+
+# an in-flight run older than this is reaped (waiters cancelled): the
+# reconciler's synthesized-timeout path records SOMETHING for every
+# owned check, so only an unroutable key (deleted check, disowned
+# shard) can strand an entry this long
+DEFAULT_REAP_SECONDS = 600.0
+
+
+@dataclass
+class Ticket:
+    """One submitted request's decision + (eventually) its result."""
+
+    rid: int
+    tenant: str
+    check: str
+    outcome: str  # decision-time outcome (vocabulary above)
+    shard: int = 0
+    reason: str = ""  # refusal reason; "" otherwise
+    result: Optional[CheckResult] = None  # immediate for cache hits
+    future: Optional[asyncio.Future] = None  # joined / run / parked
+
+    @property
+    def trace_id(self) -> str:
+        """The underlying run's trace id (joins the N fanned-out
+        responses to the ONE reconcile cycle at /debug/traces)."""
+        return self.result.trace_id if self.result is not None else ""
+
+    async def wait(self) -> Optional[CheckResult]:
+        """The fanned-out result (immediately for hits/refusals)."""
+        if self.result is None and self.future is not None:
+            self.result = await self.future
+        return self.result
+
+
+@dataclass
+class _Parked:
+    """A degraded-mode request awaiting the pump."""
+
+    tenant: str  # the ledger (booked) name
+    check: str
+    freshness: Optional[float]
+    future: asyncio.Future
+    shard: int
+    parked_at: float
+
+
+@dataclass
+class _Tally:
+    """One tenant's outcome ledger (admission keeps its own)."""
+
+    submitted: int = 0
+    cache_hits: int = 0
+    joins: int = 0
+    runs: int = 0
+    parked: int = 0  # currently parked (decrements when pumped)
+
+    def to_dict(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "cache_hits": self.cache_hits,
+            "coalesced_joins": self.joins,
+            "probe_runs": self.runs,
+            "parked": self.parked,
+        }
+
+
+class FrontDoor:
+    """Admission + coalescing + DAG execution over a bound backend."""
+
+    def __init__(
+        self,
+        history: ResultHistory,
+        admission: AdmissionController,
+        *,
+        clock: Optional[Clock] = None,
+        metrics=None,  # MetricsCollector (duck-typed; optional)
+        resilience=None,  # ResilienceCoordinator: .degraded drives parking
+        default_freshness: float = 30.0,
+        park_capacity: int = DEFAULT_PARK_CAPACITY,
+    ):
+        self.clock = clock or Clock()
+        self.admission = admission
+        self.cache = CoalescingCache(
+            history, clock=self.clock, default_freshness=default_freshness
+        )
+        self.metrics = metrics
+        self.resilience = resilience
+        self.park_capacity = max(0, int(park_capacity))
+        self._parked: Deque[_Parked] = deque()
+        # shard -> trigger(namespace, name); None key = default backend
+        self._backends: Dict[Optional[int], Callable[[str, str], None]] = {}
+        # sharded fleet: the live ownership predicate (Manager wires
+        # coordinator.owns_key). A miss for an unowned key is a
+        # structured `unrouted` refusal naming its shard — this
+        # replica's rings never see the owner's results, so triggering
+        # (or parking) here would strand the waiters until reap
+        self.owns: Optional[Callable[[str], bool]] = None
+        self._rid = 0
+        self._tallies: Dict[str, _Tally] = {}
+        # fleet-wide running totals in lockstep with the per-tenant
+        # tallies, so the per-submit gauge refresh is O(1), not a walk
+        self._totals = _Tally()
+        self.reaped_runs = 0
+        # QPS over rotating buckets on the injected clock
+        self._qps_bucket_start: Optional[float] = None
+        self._qps_bucket_count = 0
+        self._qps_last = 0.0
+
+    # -- wiring ----------------------------------------------------------
+    def bind(self, trigger: Callable[[str, str], None]) -> None:
+        """The default backend — Manager.enqueue's (namespace, name)
+        signature, so a triggered run IS a normal workqueue cycle."""
+        self._backends[None] = trigger
+
+    def bind_shard(self, shard: int, trigger: Callable[[str, str], None]) -> None:
+        """Per-shard backends for a fleet where this front door fans
+        out to several replicas; keys route via the admission router."""
+        self._backends[shard] = trigger
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.resilience is not None and self.resilience.degraded)
+
+    # -- the submit path -------------------------------------------------
+    def submit(
+        self,
+        tenant: str,
+        check: str,
+        freshness: Optional[float] = None,
+    ) -> Ticket:
+        """One request, decided synchronously. ``check`` is the check
+        identity (``namespace/name``); ``freshness`` the seconds a
+        cached result stays acceptable (None: the door's default)."""
+        if "/" not in check:
+            raise ValueError(
+                f"check identity must be namespace/name, got {check!r}"
+            )
+        started = self.clock.monotonic()
+        self._rid += 1
+        rid = self._rid
+        self._note_qps(started)
+        decision = self.admission.admit(tenant, check)
+        # ledger rows are keyed by the BOOKED name (never-seen tenants
+        # share the overflow row), so open-endpoint traffic cannot mint
+        # unbounded tallies or metric series
+        booked = decision.booked
+        tally = self._tallies.setdefault(booked, _Tally())
+        tally.submitted += 1
+        self._totals.submitted += 1
+        if not decision.admitted:
+            ticket = Ticket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                outcome=OUTCOME_REFUSED,
+                reason=decision.reason,
+            )
+            self._account(ticket, started, booked)
+            return ticket
+        if self.owns is not None and not self.owns(check):
+            # sharded fleet, another replica's key: this replica's
+            # rings never receive the owner's results, so a run or a
+            # parked wait here would strand every waiter until reap.
+            # Refuse with the shard id so a fronting router re-aims.
+            refusal = self.admission.refuse(
+                tenant, REFUSE_UNROUTED, booked=booked
+            )
+            ticket = Ticket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                outcome=OUTCOME_REFUSED,
+                shard=decision.shard,
+                reason=refusal.reason,
+            )
+            self._account(ticket, started, booked)
+            return ticket
+        outcome, fresh = self.cache.lookup(check, freshness)
+        if outcome == LOOKUP_HIT:
+            tally.cache_hits += 1
+            self._totals.cache_hits += 1
+            ticket = Ticket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                outcome=OUTCOME_HIT,
+                shard=decision.shard,
+                result=fresh,
+            )
+        elif outcome == LOOKUP_INFLIGHT:
+            tally.joins += 1
+            self._totals.joins += 1
+            ticket = Ticket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                outcome=OUTCOME_JOINED,
+                shard=decision.shard,
+                future=self.cache.join(check),
+            )
+        elif self.degraded:
+            # breaker open: PARK, never drop — the cache already served
+            # what it could; a miss is real demand the pump replays the
+            # moment the control plane recovers (docs/resilience.md)
+            if len(self._parked) >= self.park_capacity:
+                refusal = self.admission.refuse(
+                    tenant, REFUSE_PARKED_FULL, booked=booked
+                )
+                ticket = Ticket(
+                    rid=rid,
+                    tenant=tenant,
+                    check=check,
+                    outcome=OUTCOME_REFUSED,
+                    shard=decision.shard,
+                    reason=refusal.reason,
+                )
+            else:
+                tally.parked += 1
+                self._totals.parked += 1
+                fut: asyncio.Future = (
+                    asyncio.get_running_loop().create_future()
+                )
+                self._parked.append(
+                    _Parked(
+                        tenant=booked,
+                        check=check,
+                        freshness=freshness,
+                        future=fut,
+                        shard=decision.shard,
+                        parked_at=started,
+                    )
+                )
+                ticket = Ticket(
+                    rid=rid,
+                    tenant=tenant,
+                    check=check,
+                    outcome=OUTCOME_PARKED,
+                    shard=decision.shard,
+                    future=fut,
+                )
+        else:
+            tally.runs += 1
+            self._totals.runs += 1
+            self.cache.begin(check)
+            self._trigger(check, decision.shard)
+            ticket = Ticket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                outcome=OUTCOME_RUN,
+                shard=decision.shard,
+                future=self.cache.join(check),
+            )
+        self._account(ticket, started, booked)
+        return ticket
+
+    # -- DAG execution ---------------------------------------------------
+    async def run_dag(
+        self, tenant: str, dag: ProbeDag
+    ) -> Dict[str, Ticket]:
+        """Execute a probe DAG stage by stage: each step is a normal
+        front-door submission (quota paid per step, coalescing per
+        step), and a stage starts only when every step of the previous
+        stage has its result — downstream steps therefore reuse
+        upstream results through the cache instead of re-probing. A
+        refused or result-less step (cancelled waiter) stops the DAG:
+        its downstream steps are never submitted (reported absent in
+        the returned map, so the caller sees exactly how far it got)."""
+        tickets: Dict[str, Ticket] = {}
+        for stage in dag.stages():
+            stage_tickets = [
+                (step, self.submit(tenant, step.check, step.freshness))
+                for step in stage
+            ]
+            for step, ticket in stage_tickets:
+                tickets[step.name] = ticket
+            results = await asyncio.gather(
+                *(t.wait() for _s, t in stage_tickets),
+                return_exceptions=True,
+            )
+            for (step, ticket), outcome in zip(stage_tickets, results):
+                if ticket.outcome == OUTCOME_REFUSED or isinstance(
+                    outcome, BaseException
+                ):
+                    return tickets  # stop: downstream is meaningless
+        return tickets
+
+    # -- degraded-mode pump ---------------------------------------------
+    def pump(self) -> int:
+        """Replay parked requests once the controller is healthy again:
+        each re-decides against the cache (the outage may have left a
+        fresh result or an in-flight run to ride) and otherwise
+        triggers its run. Returns how many were resolved; stops the
+        moment degraded mode re-trips mid-replay. Driven by the
+        manager's resilience sweep next to the status-write replay."""
+        pumped = 0
+        while self._parked and not self.degraded:
+            parked = self._parked.popleft()
+            tally = self._tallies.setdefault(parked.tenant, _Tally())
+            tally.parked -= 1
+            self._totals.parked -= 1
+            if parked.future.done():
+                # waiter gave up while parked (cancelled wait): booked
+                # as a structured post-admission refusal so the ledger
+                # stays exact
+                self._refuse_parked(parked, REFUSE_ABANDONED)
+                pumped += 1
+                continue
+            if self.owns is not None and not self.owns(parked.check):
+                # the shard was handed off while this request sat
+                # parked: same verdict the submit path gives — a
+                # structured unrouted refusal, never a run this
+                # replica's rings could not resolve
+                self._refuse_parked(parked, REFUSE_UNROUTED)
+                parked.future.cancel()
+                pumped += 1
+                continue
+            outcome, fresh = self.cache.lookup(parked.check, parked.freshness)
+            if outcome == LOOKUP_HIT:
+                tally.cache_hits += 1
+                self._totals.cache_hits += 1
+                parked.future.set_result(fresh)
+            elif outcome == LOOKUP_INFLIGHT:
+                tally.joins += 1
+                self._totals.joins += 1
+                self._chain(self.cache.join(parked.check), parked.future)
+            else:
+                tally.runs += 1
+                self._totals.runs += 1
+                self.cache.begin(parked.check)
+                self._trigger(parked.check, parked.shard)
+                self._chain(self.cache.join(parked.check), parked.future)
+            pumped += 1
+        self._refresh_gauges()
+        return pumped
+
+    def reap(self, max_age_seconds: float = DEFAULT_REAP_SECONDS) -> int:
+        """Cancel waiters of in-flight entries older than ``max_age``.
+        A deleted, quarantined, or stopped check's demanded run records
+        no result (the reconciler consumes the demand unserved); the
+        synthesized-timeout path covers every other owned run. Counted,
+        driven by the same resilience sweep as the pump."""
+        stale = self.cache.stale_inflight(
+            self.clock.monotonic() - max_age_seconds
+        )
+        for key in stale:
+            self.cache.forget(key)
+            self.reaped_runs += 1
+        if stale:
+            self._refresh_gauges()
+        return len(stale)
+
+    # -- internals -------------------------------------------------------
+    def _refuse_parked(self, parked: _Parked, reason: str) -> None:
+        """A parked request refused at pump time: the ledger AND the
+        refusal counter both record it (the submit-path counters fire
+        from _account, which pump-time refusals never pass through)."""
+        self.admission.refuse(parked.tenant, reason)
+        if self.metrics is not None:
+            self.metrics.record_frontdoor_refusal(parked.tenant, reason)
+
+    @staticmethod
+    def _chain(source: asyncio.Future, target: asyncio.Future) -> None:
+        """Resolve ``target`` from ``source`` (a parked request's
+        pre-existing future joined onto a live run)."""
+
+        def _copy(fut: asyncio.Future) -> None:
+            if target.done():
+                return
+            if fut.cancelled():
+                target.cancel()
+            else:
+                target.set_result(fut.result())
+
+        source.add_done_callback(_copy)
+
+    def _trigger(self, check: str, shard: int) -> None:
+        trigger = self._backends.get(shard, self._backends.get(None))
+        if trigger is None:
+            raise RuntimeError(
+                "front door has no backend bound (FrontDoor.bind)"
+            )
+        namespace, _, name = check.partition("/")
+        trigger(namespace, name)
+
+    def _note_qps(self, now: float) -> None:
+        if self._qps_bucket_start is None:
+            self._qps_bucket_start = now
+        elif now - self._qps_bucket_start >= QPS_WINDOW_SECONDS:
+            elapsed = now - self._qps_bucket_start
+            self._qps_last = self._qps_bucket_count / elapsed
+            self._qps_bucket_start = now
+            self._qps_bucket_count = 0
+        self._qps_bucket_count += 1
+
+    def qps(self) -> float:
+        """Submissions/second: the live bucket once it holds ≥1s of
+        data, else the last completed bucket's rate."""
+        if self._qps_bucket_start is not None:
+            elapsed = self.clock.monotonic() - self._qps_bucket_start
+            if elapsed >= 1.0:
+                return self._qps_bucket_count / elapsed
+        return self._qps_last
+
+    def _account(self, ticket: Ticket, started: float, booked: str) -> None:
+        # metric labels carry the BOOKED name — bounded by the
+        # admission config even on an open endpoint
+        if self.metrics is not None:
+            self.metrics.record_frontdoor_request(booked, ticket.outcome)
+            if ticket.outcome == OUTCOME_REFUSED:
+                self.metrics.record_frontdoor_refusal(booked, ticket.reason)
+            self.metrics.observe_frontdoor_admission(
+                max(0.0, self.clock.monotonic() - started)
+            )
+        self._refresh_gauges()
+
+    def coalesce_ratios(self) -> dict:
+        """hit / miss / join fractions over every admitted lookup (the
+        pinned healthcheck_frontdoor_coalesce_ratio{kind} gauges), from
+        the O(1) running totals. ``miss`` counts requests that became
+        runs or parked — demand the cache could not absorb."""
+        hits = self._totals.cache_hits
+        joins = self._totals.joins
+        misses = self._totals.runs + self._totals.parked
+        total = hits + joins + misses
+        if not total:
+            return {"hit": 0.0, "miss": 0.0, "join": 0.0, "lookups": 0}
+        return {
+            "hit": hits / total,
+            "miss": misses / total,
+            "join": joins / total,
+            "lookups": total,
+        }
+
+    def queue_depth(self) -> int:
+        """Parked requests + waiters fanned in on in-flight runs — the
+        demand the door is currently holding open."""
+        return len(self._parked) + self.cache.waiter_count()
+
+    def _refresh_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set_frontdoor_queue_depth(self.queue_depth())
+        ratios = self.coalesce_ratios()
+        self.metrics.set_frontdoor_coalesce(
+            hit=ratios["hit"], miss=ratios["miss"], join=ratios["join"]
+        )
+
+    # -- accounting ------------------------------------------------------
+    def conservation(self) -> dict:
+        """The exact per-tenant ledger: every submitted request lands in
+        exactly one of {cache_hit, join, run, parked, refused}, so
+
+            submitted == cache_hits + joins + runs + parked + refused
+
+        per tenant AND in total — and the admission controller's
+        independent event-time ledger must agree (admitted == the four
+        non-refused outcomes + post-admission parked_full refusals),
+        so a tenant-attribution bug cannot hide behind balanced global
+        totals. ``ok`` is the AND of every equality — the property
+        test's and the stress soak's gate."""
+        tenants = sorted(
+            set(self._tallies)
+            | set(self.admission.admitted)
+            | set(self.admission.refused)
+        )
+        rows: Dict[str, dict] = {}
+        all_ok = True
+        for tenant in tenants:
+            tally = self._tallies.get(tenant, _Tally())
+            refused = dict(self.admission.refused.get(tenant, {}))
+            refused_total = sum(refused.values())
+            admitted = self.admission.admitted.get(tenant, 0)
+            # quota/unknown_tenant refuse BEFORE the bucket admits;
+            # parked_full/abandoned refuse an already-admitted request
+            pre = sum(refused.get(r, 0) for r in PRE_ADMISSION_REASONS)
+            post = refused_total - pre
+            row = tally.to_dict()
+            row["admitted"] = admitted
+            row["refused"] = refused
+            row["refused_total"] = refused_total
+            outcomes = (
+                tally.cache_hits + tally.joins + tally.runs + tally.parked
+            )
+            row["ok"] = (
+                tally.submitted == outcomes + refused_total
+                and tally.submitted == admitted + pre
+                and admitted == outcomes + post
+            )
+            all_ok = all_ok and row["ok"]
+            rows[tenant] = row
+        return {
+            "tenants": rows,
+            "submitted": sum(r["submitted"] for r in rows.values()),
+            "refused": sum(r["refused_total"] for r in rows.values()),
+            "cache_hits": sum(r["cache_hits"] for r in rows.values()),
+            "coalesced_joins": sum(
+                r["coalesced_joins"] for r in rows.values()
+            ),
+            "probe_runs": sum(r["probe_runs"] for r in rows.values()),
+            "parked": sum(r["parked"] for r in rows.values()),
+            "ok": all_ok,
+        }
+
+    def snapshot(self) -> dict:
+        """The /statusz fleet block (schema pinned by the contract
+        test; rollup_statusz merges these across replicas)."""
+        conservation = self.conservation()
+        return {
+            "qps": self.qps(),
+            "coalescing": self.coalesce_ratios(),
+            "queue_depth": self.queue_depth(),
+            "parked": len(self._parked),
+            "inflight_runs": len(self.cache.inflight_keys()),
+            "reaped_runs": self.reaped_runs,
+            "degraded": self.degraded,
+            "conservation_ok": conservation["ok"],
+            "requests": {
+                "submitted": conservation["submitted"],
+                "refused": conservation["refused"],
+                "cache_hits": conservation["cache_hits"],
+                "coalesced_joins": conservation["coalesced_joins"],
+                "probe_runs": conservation["probe_runs"],
+            },
+            "tenants": {
+                tenant: {
+                    "submitted": row["submitted"],
+                    "refused": row["refused_total"],
+                    "refusals": row["refused"],
+                }
+                for tenant, row in conservation["tenants"].items()
+            },
+        }

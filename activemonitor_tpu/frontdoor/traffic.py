@@ -1,0 +1,66 @@
+"""Open-loop front-door traffic: seeded Poisson check requests.
+
+The stress soak's offered load, built on the SAME seeded-determinism
+contract as the serving probe's generator
+(:class:`~activemonitor_tpu.scheduler.arrivals.PoissonArrivals` — one
+rng, fixed draw order: arrival then check identity, tenants
+round-robin like serving's). Open-loop on purpose: the schedule never
+adapts to admission latency, so an overloaded front door shows up as
+queue depth and refusals, not as a generator politely slowing down.
+
+A bounded ``checks`` set is the coalescing knob: duplicate traffic is
+the POINT (N tenants asking about the same slice), and shrinking the
+set raises the duplicate rate the soak's hit-ratio gate measures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from activemonitor_tpu.scheduler.arrivals import PoissonArrivals
+
+
+@dataclass(frozen=True)
+class CheckRequest:
+    """One front-door request as the generator emits it."""
+
+    rid: int
+    tenant: str
+    arrival: float  # seconds since schedule start
+    check: str  # "namespace/name" identity submitted
+    freshness: Optional[float]  # per-request window; None = door default
+
+
+def open_loop_checks(
+    n_requests: int,
+    rate_rps: float,
+    seed: int,
+    checks: Sequence[str],
+    tenants: Sequence[str] = ("tenant-a", "tenant-b"),
+    freshness: Optional[float] = None,
+) -> List[CheckRequest]:
+    """Seeded Poisson schedule of check requests: exponential
+    inter-arrivals at ``rate_rps``, check identities drawn from the
+    bounded ``checks`` set, tenants round-robin. Same seed ⇒
+    byte-identical schedule — the same contract the serving trace
+    tests pin for their generator."""
+    if n_requests < 1 or not checks:
+        raise ValueError(
+            f"need n_requests >= 1 and a non-empty check set, got "
+            f"{n_requests}/{len(checks)}"
+        )
+    process = PoissonArrivals(rate_rps, seed)
+    out: List[CheckRequest] = []
+    for rid in range(n_requests):
+        now = process.next()
+        out.append(
+            CheckRequest(
+                rid=rid,
+                tenant=tenants[rid % len(tenants)],
+                arrival=now,
+                check=process.choice(checks),
+                freshness=freshness,
+            )
+        )
+    return out

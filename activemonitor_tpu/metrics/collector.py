@@ -70,6 +70,13 @@ _PROBE_RUNTIME_BUCKETS = (
     1, 3, 10, 30, 90, 300, 900, 1800, float("inf"),
 )
 
+# front-door admission decisions are policy arithmetic (microseconds
+# healthy, milliseconds under event-loop pressure) — log-spaced from
+# 50µs so the 10k-requests/s soak's bounded-p99 gate is readable
+_FRONTDOOR_ADMISSION_BUCKETS = (
+    0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0, float("inf"),
+)
+
 # custom-metric contract types this collector implements; anything else
 # is rejected with a logged warning, never silently coerced to a gauge
 _CUSTOM_METRIC_KINDS = {"gauge", "counter"}
@@ -551,6 +558,61 @@ class MetricsCollector:
         self._matrix_state_series: set = set()
         self._matrix_cell_bounds: Dict[str, str] = {}
         self._matrix_value_series: set = set()
+        # -- front-door families (frontdoor/ is the single writer;
+        # docs/operations.md "Probe-as-a-service front door"). Tenant
+        # cardinality is bounded by the admission config (the quota map
+        # plus whoever the default quota admits), outcome/reason/kind by
+        # fixed vocabularies.
+        self.frontdoor_requests = Counter(
+            "healthcheck_frontdoor_requests_total",
+            "Front-door check requests per tenant by DECISION-TIME "
+            "outcome (cache_hit / joined / run / parked / refused) — "
+            "every submitted request lands in exactly one; a parked "
+            "request's later pump conversion moves the live ledger "
+            "(/statusz, coalesce ratios), not this counter",
+            ["tenant", "outcome"],
+            registry=self.registry,
+        )
+        self.frontdoor_refusals = Counter(
+            "healthcheck_frontdoor_refusals_total",
+            "Front-door refusals per tenant by structured reason "
+            "(quota / unknown_tenant / tenant_capacity / parked_full / "
+            "abandoned / unrouted); never-seen tenants book under the "
+            "shared (overflow) row, so the label space stays bounded",
+            ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.frontdoor_coalesce_ratio = Gauge(
+            "healthcheck_frontdoor_coalesce_ratio",
+            "Coalescing-cache outcome fractions over admitted lookups "
+            "(kind: hit = served from a fresh ring result, join = "
+            "fanned in on an in-flight run, miss = demand the cache "
+            "could not absorb); hit+join is measurement capacity "
+            "returned to real work",
+            ["kind"],
+            registry=self.registry,
+        )
+        # children pre-resolved: the front door refreshes these on its
+        # admission hot path, and a labels() lookup per request is
+        # registry-lock work the 10k-rps soak would pay for nothing
+        self._frontdoor_ratio = {
+            kind: self.frontdoor_coalesce_ratio.labels(kind)
+            for kind in ("hit", "miss", "join")
+        }
+        self.frontdoor_queue_depth = Gauge(
+            "healthcheck_frontdoor_queue_depth",
+            "Requests the front door is holding open: degraded-mode "
+            "parked requests plus waiters fanned in on in-flight runs",
+            registry=self.registry,
+        )
+        self.frontdoor_admission_seconds = Histogram(
+            "healthcheck_frontdoor_admission_seconds",
+            "Front-door admission decision latency (submit to "
+            "outcome decision — quota check, cache lookup, and the "
+            "trigger enqueue; NOT the probe run itself)",
+            registry=self.registry,
+            buckets=_FRONTDOOR_ADMISSION_BUCKETS,
+        )
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -906,6 +968,26 @@ class MetricsCollector:
                     _sanitize(str(bisect.get("cell", "?"))),
                     str(bisect.get("outcome", "error")),
                 ).inc()
+
+    # -- front door (frontdoor/service.py is the single writer) --------
+    def record_frontdoor_request(self, tenant: str, outcome: str) -> None:
+        self.frontdoor_requests.labels(tenant, outcome).inc()
+
+    def record_frontdoor_refusal(self, tenant: str, reason: str) -> None:
+        self.frontdoor_refusals.labels(tenant, reason).inc()
+
+    def set_frontdoor_coalesce(
+        self, *, hit: float, miss: float, join: float
+    ) -> None:
+        self._frontdoor_ratio["hit"].set(hit)
+        self._frontdoor_ratio["miss"].set(miss)
+        self._frontdoor_ratio["join"].set(join)
+
+    def set_frontdoor_queue_depth(self, depth: int) -> None:
+        self.frontdoor_queue_depth.set(depth)
+
+    def observe_frontdoor_admission(self, seconds: float) -> None:
+        self.frontdoor_admission_seconds.observe(seconds)
 
     # -- dynamic custom metrics ---------------------------------------
     # recorded-run memory bound: at one run a second this is ~34 min of

@@ -25,11 +25,14 @@ Design constraints, shared with the tracer (obs/trace.py):
 from __future__ import annotations
 
 import collections
+import logging
 from dataclasses import dataclass, field
 from datetime import datetime
-from typing import Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.history")
 
 # per-check results retained; at a 60 s cadence this is ~4 h of history,
 # comfortably more than any sane SLO window for an active prober
@@ -88,6 +91,17 @@ class ResultHistory:
         self.clock = clock or Clock()
         self._capacity = max(1, capacity)
         self._rings: Dict[str, Deque[CheckResult]] = {}
+        # record-time observers (frontdoor/coalesce.py fans in-flight
+        # waiters out on the very result the reconciler records) —
+        # exceptions are swallowed per the never-raises constraint above
+        self._subscribers: List[Callable[[str, CheckResult], None]] = []
+
+    def subscribe(self, fn: Callable[[str, CheckResult], None]) -> None:
+        """Call ``fn(key, result)`` after every recorded run. The hook
+        runs on the recording path, so it must be cheap; a raising
+        subscriber is logged and dropped from that record, never
+        propagated into the reconciler's status write."""
+        self._subscribers.append(fn)
 
     def record(
         self,
@@ -122,6 +136,11 @@ class ResultHistory:
         if ring is None:
             ring = self._rings[key] = collections.deque(maxlen=self._capacity)
         ring.append(result)
+        for fn in self._subscribers:
+            try:
+                fn(key, result)
+            except Exception:
+                log.exception("result subscriber failed for %s", key)
         return result
 
     def results(self, key: str) -> List[CheckResult]:

@@ -220,6 +220,11 @@ class FleetStatus:
         # summary (analysis/matrix.py MatrixObservatory or its durable
         # SidecarView). None (no matrix configured) reports matrix: null.
         self.matrix = None
+        # wired by the manager (--frontdoor): the probe-as-a-service
+        # front door (frontdoor/service.py) whose QPS / coalescing /
+        # per-tenant refusal snapshot rides the fleet block. None (no
+        # front door) reports frontdoor: null.
+        self.frontdoor = None
         # generated_at of the last round exported to the gauges, so the
         # rollup loop re-serving an unchanged sidecar never
         # double-counts the bisect counter
@@ -418,10 +423,18 @@ class FleetStatus:
         return roofline_model.latest_snapshot(self.history.results(key))
 
     def forget(self, key: str, name: str = "", namespace: str = "") -> None:
-        """Deleted check: drop its ring, config, and gauge series."""
+        """Deleted check: drop its ring, config, and gauge series —
+        and cancel any front-door waiters fanned in on a run that can
+        now never record (a typo'd or just-deleted check must fail its
+        requests at reconcile speed, not at the reap sweep's bound)."""
         self.history.forget(key)
         self._configs.pop(key, None)
         self._last_status.pop(key, None)
+        if self.frontdoor is not None:
+            try:
+                self.frontdoor.cache.forget(key)
+            except Exception:
+                log.exception("frontdoor forget failed for %s", key)
         if self.metrics is not None and name:
             self.metrics.clear_slo(name, namespace)
 
@@ -555,9 +568,24 @@ class FleetStatus:
                 # round; null until a matrix source is wired
                 # (--matrix-state) and a round has been recorded
                 "matrix": self.check_matrix(),
+                # front-door ingestion summary (frontdoor/service.py):
+                # QPS, coalescing ratios, queue depth, per-tenant
+                # refusals; null when no front door is wired
+                "frontdoor": self.check_frontdoor(),
             },
             "checks": entries,
         }
+
+    def check_frontdoor(self) -> Optional[dict]:
+        """The front door's live snapshot, or None (not wired / a
+        snapshot error — observability must not fail the payload)."""
+        if self.frontdoor is None:
+            return None
+        try:
+            return self.frontdoor.snapshot()
+        except Exception:
+            log.exception("frontdoor snapshot failed")
+            return None
 
     def check_matrix(self) -> Optional[dict]:
         """The matrix source's latest round summary, or None (no source
@@ -658,6 +686,10 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     # the replica reporting the NEWEST round wins (replicas without a
     # matrix source report null and never displace a real round)
     matrix_block = None
+    # front-door blocks SUM: each replica's door serves its own slice
+    # of the ingestion traffic, so fleet QPS/requests/refusals are the
+    # totals and the coalescing ratios re-derive lookup-weighted
+    frontdoor_blocks: List[dict] = []
     # fleet goodput: the run-weighted mean of the REPLICAS' own ratios,
     # each derived from its history + declared SLO windows — the same
     # definition a single /statusz reports, so the number doesn't
@@ -710,6 +742,9 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             > str(matrix_block.get("generated_at") or "")
         ):
             matrix_block = replica_matrix
+        replica_frontdoor = fleet.get("frontdoor")
+        if isinstance(replica_frontdoor, dict):
+            frontdoor_blocks.append(replica_frontdoor)
         for entry in payload.get("checks") or []:
             key = entry.get("key", "")
             if key not in merged:
@@ -753,6 +788,78 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "anomalies": agg["anomalies"],
             "sharding": sharding_block,
             "matrix": matrix_block,
+            "frontdoor": merge_frontdoor_blocks(frontdoor_blocks),
         },
         "checks": entries,
+    }
+
+
+def merge_frontdoor_blocks(blocks: Sequence[dict]) -> Optional[dict]:
+    """Merge per-replica front-door snapshots into one fleet block:
+    QPS, request/refusal counts, and queue depths SUM (each replica's
+    door serves its own slice of the traffic), coalescing ratios
+    re-derive lookup-weighted from the summed outcome counts, degraded
+    is any-replica, and conservation_ok only if every replica's own
+    ledger balanced. None when no replica reported a front door."""
+    if not blocks:
+        return None
+    requests = {
+        "submitted": 0,
+        "refused": 0,
+        "cache_hits": 0,
+        "coalesced_joins": 0,
+        "probe_runs": 0,
+    }
+    tenants: Dict[str, dict] = {}
+    qps = 0.0
+    queue_depth = parked = inflight = reaped = 0
+    degraded = False
+    conservation_ok = True
+    for block in blocks:
+        qps += float(block.get("qps") or 0.0)
+        queue_depth += int(block.get("queue_depth") or 0)
+        parked += int(block.get("parked") or 0)
+        inflight += int(block.get("inflight_runs") or 0)
+        reaped += int(block.get("reaped_runs") or 0)
+        degraded = degraded or bool(block.get("degraded"))
+        conservation_ok = conservation_ok and bool(
+            block.get("conservation_ok", True)
+        )
+        for field_name in requests:
+            requests[field_name] += int(
+                (block.get("requests") or {}).get(field_name) or 0
+            )
+        for tenant, row in (block.get("tenants") or {}).items():
+            merged_row = tenants.setdefault(
+                str(tenant), {"submitted": 0, "refused": 0, "refusals": {}}
+            )
+            merged_row["submitted"] += int(row.get("submitted") or 0)
+            merged_row["refused"] += int(row.get("refused") or 0)
+            for reason, count in (row.get("refusals") or {}).items():
+                merged_row["refusals"][str(reason)] = merged_row[
+                    "refusals"
+                ].get(str(reason), 0) + int(count)
+    # lookup-weighted coalescing over the fleet: parked demand is still
+    # a miss the cache couldn't absorb, same rule as a single replica
+    hits = requests["cache_hits"]
+    joins = requests["coalesced_joins"]
+    misses = requests["probe_runs"] + parked
+    lookups = hits + joins + misses
+    coalescing = {
+        "hit": hits / lookups if lookups else 0.0,
+        "miss": misses / lookups if lookups else 0.0,
+        "join": joins / lookups if lookups else 0.0,
+        "lookups": lookups,
+    }
+    return {
+        "qps": qps,
+        "coalescing": coalescing,
+        "queue_depth": queue_depth,
+        "parked": parked,
+        "inflight_runs": inflight,
+        "reaped_runs": reaped,
+        "degraded": degraded,
+        "conservation_ok": conservation_ok,
+        "requests": requests,
+        "tenants": {t: tenants[t] for t in sorted(tenants)},
     }

@@ -1,5 +1,8 @@
-"""Scheduling engine: cron parsing, inverse-exponential backoff, timer wheel."""
+"""Scheduling engine: cron parsing, inverse-exponential backoff, timer
+wheel, and the shared seeded Poisson arrival process (the one open-loop
+traffic contract the serving probe and the front door both ride)."""
 
+from activemonitor_tpu.scheduler.arrivals import PoissonArrivals
 from activemonitor_tpu.scheduler.backoff import (
     BackoffParams,
     InverseExpBackoff,
@@ -16,6 +19,7 @@ from activemonitor_tpu.scheduler.timers import TimerWheel
 
 __all__ = [
     "BackoffParams",
+    "PoissonArrivals",
     "CronParseError",
     "CronSchedule",
     "EverySchedule",

@@ -1,0 +1,58 @@
+"""Seeded Poisson arrival process — the one open-loop traffic contract.
+
+Both open-loop generators in the tree — the serving probe's request
+schedule (:func:`scheduler.serving.open_loop_requests`) and the front
+door's check-request schedule (:func:`frontdoor.traffic.
+open_loop_checks`) — draw their arrival times from this process, so
+"same seed ⇒ byte-identical schedule" is ONE contract with one
+implementation, not two generators that can drift apart.
+
+The determinism contract is the *draw order* against a single
+``random.Random(seed)``: one ``expovariate`` per arrival, with any
+payload draws (prompt lengths, tenants, check identities) interleaved
+by the caller through :meth:`PoissonArrivals.choice` on the SAME rng.
+Callers must keep their draw order stable across refactors — the
+serving scheduler-trace tests pin it byte-for-byte.
+
+Open-loop on purpose (the FlowMesh serving framing): the schedule is
+generated up front and never adapts to service latency, so overload
+shows up as queueing delay instead of a coordinated-omission slowdown.
+No wall clock anywhere — arrival times are plain floats on the
+caller's timeline (``hack/lint.py`` bans ``time.time()`` here like the
+other clock-disciplined modules).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+class PoissonArrivals:
+    """Seeded exponential inter-arrival generator plus the rng the
+    caller interleaves payload draws on.
+
+    ``next()`` advances the cumulative arrival time by one
+    ``expovariate(rate_per_s)`` draw and returns it; ``choice(seq)``
+    draws a payload attribute from the same rng (tuple-normalized, so
+    list vs tuple spellings of a choice set cannot change the draw).
+    """
+
+    def __init__(self, rate_per_s: float, seed: int):
+        if rate_per_s <= 0:
+            raise ValueError(f"rate_per_s must be > 0, got {rate_per_s}")
+        self.rate_per_s = rate_per_s
+        self.rng = random.Random(seed)
+        self.now = 0.0
+
+    def next(self) -> float:
+        """The next arrival's time (seconds since schedule start)."""
+        self.now += self.rng.expovariate(self.rate_per_s)
+        return self.now
+
+    def choice(self, seq: Sequence[T]) -> T:
+        """One payload draw from the shared rng (draw-order is part of
+        the determinism contract — see module docstring)."""
+        return self.rng.choice(tuple(seq))

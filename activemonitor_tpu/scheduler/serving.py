@@ -38,12 +38,12 @@ probe gates on the equality being exact.
 
 from __future__ import annotations
 
-import random
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from activemonitor_tpu.ops.kv_cache import KVBlockManager
+from activemonitor_tpu.scheduler.arrivals import PoissonArrivals
 
 
 @dataclass(frozen=True)
@@ -69,24 +69,33 @@ def open_loop_requests(
     ``rate_rps``, prompt/output lengths drawn from small choice sets
     (bounded sets keep the engine's per-prompt-length compiles bounded
     too), tenants round-robin. Same seed ⇒ byte-identical schedule —
-    the determinism the scheduler-trace test pins."""
-    if n_requests < 1 or rate_rps <= 0:
+    the determinism the scheduler-trace test pins. The arrival process
+    is the shared :class:`~activemonitor_tpu.scheduler.arrivals.
+    PoissonArrivals` contract (one rng, fixed draw order: arrival,
+    prompt, output — pinned by the trace tests, so this generator and
+    the front door's cannot drift on what "seeded" means)."""
+    if n_requests < 1:
         raise ValueError(
             f"need n_requests >= 1 and rate_rps > 0, got "
             f"{n_requests}/{rate_rps}"
         )
-    rng = random.Random(seed)
-    now = 0.0
+    try:
+        process = PoissonArrivals(rate_rps, seed)
+    except ValueError:
+        raise ValueError(
+            f"need n_requests >= 1 and rate_rps > 0, got "
+            f"{n_requests}/{rate_rps}"
+        ) from None
     out: List[Request] = []
     for rid in range(n_requests):
-        now += rng.expovariate(rate_rps)
+        now = process.next()
         out.append(
             Request(
                 rid=rid,
                 tenant=tenants[rid % len(tenants)],
                 arrival=now,
-                prompt_len=rng.choice(tuple(prompt_len_choices)),
-                output_tokens=rng.choice(tuple(output_choices)),
+                prompt_len=process.choice(prompt_len_choices),
+                output_tokens=process.choice(output_choices),
             )
         )
     return out

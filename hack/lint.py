@@ -51,14 +51,17 @@ positive that makes `make lint` cry wolf is worse than a miss):
   JAX API move is absorbed in one file pair. Import it from
   `activemonitor_tpu.parallel.partition` instead.
 - wallclock-in-<unit>: `time.time()` / `time.monotonic()` calls in
-  files under a `resilience/` or `analysis/` directory, or in the
-  clock-disciplined modules (`sharding.py`, `attribution.py`,
-  `flightrec.py`, `roofline.py`) — those units' whole contract is the
-  injectable Clock (breaker open windows, token-bucket refill, baseline
-  timestamps, shard lease expiry/fencing windows, attribution windows
-  and flight-bundle timestamps must be scriptable by fake-clock tests;
-  roofline classification is pure math over seconds passed IN as
-  arguments); a bare wall-clock read there silently breaks determinism.
+  files under a `resilience/`, `analysis/`, or `frontdoor/` directory,
+  or in the clock-disciplined modules (`sharding.py`, `attribution.py`,
+  `flightrec.py`, `roofline.py`, `arrivals.py`) — those units' whole
+  contract is the injectable Clock (breaker open windows, token-bucket
+  refill, baseline timestamps, shard lease expiry/fencing windows,
+  attribution windows and flight-bundle timestamps, front-door quota
+  refill / freshness-window / QPS math must all be scriptable by
+  fake-clock tests; roofline classification is pure math over seconds
+  passed IN as arguments, and the seeded arrival schedules live on the
+  caller's timeline); a bare wall-clock read there silently breaks
+  determinism.
   The finding code carries the unit (`wallclock-in-resilience`,
   `wallclock-in-analysis`, `wallclock-in-sharding`,
   `wallclock-in-attribution`, `wallclock-in-flightrec`,
@@ -158,7 +161,12 @@ class Checker(ast.NodeVisitor):
         # the injectable-clock packages: bare wall-clock reads are banned
         parts = set(Path(path).parts)
         self.wallclock_pkg = next(
-            (pkg for pkg in ("resilience", "analysis") if pkg in parts), None
+            (
+                pkg
+                for pkg in ("resilience", "analysis", "frontdoor")
+                if pkg in parts
+            ),
+            None,
         )
         if self.wallclock_pkg is None and Path(path).name in (
             "sharding.py",  # lease expiry, fencing windows, shed cooldowns
@@ -169,6 +177,7 @@ class Checker(ast.NodeVisitor):
             "serving.py",  # scheduler takes timestamps as args; probe
             # soak runs on an injectable timer / scripted StepCosts
             "kv_cache.py",  # pure allocation arithmetic — no time at all
+            "arrivals.py",  # seeded schedules on the caller's timeline
         ):
             # single-file modules carrying the same injectable-Clock
             # contract as the resilience/analysis packages

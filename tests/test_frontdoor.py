@@ -1,0 +1,988 @@
+"""Probe-as-a-service front door (ISSUE 15).
+
+Units (admission quotas, freshness windows, fan-in/fan-out, DAG
+validation), the per-tenant conservation property under concurrent
+submission, degraded-mode parking, and the scripted FakeClock
+acceptance: N duplicate requests → ONE probe run through the Manager
+enqueue path → N fanned-out results joinable by trace_id, visible in
+/statusz, the gauges, and the `am-tpu status` FRONTDOOR block.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.controller.sharding import ShardRouter
+from activemonitor_tpu.engine import FakeWorkflowEngine, succeed_after
+from activemonitor_tpu.frontdoor import (
+    AdmissionController,
+    FrontDoor,
+    OUTCOME_HIT,
+    OUTCOME_JOINED,
+    OUTCOME_PARKED,
+    OUTCOME_REFUSED,
+    OUTCOME_RUN,
+    REFUSE_PARKED_FULL,
+    REFUSE_QUOTA,
+    REFUSE_UNKNOWN_TENANT,
+    TenantQuota,
+    open_loop_checks,
+    parse_dag,
+)
+from activemonitor_tpu.frontdoor.dag import DagStep, ProbeDag
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs.history import ResultHistory
+from activemonitor_tpu.obs.slo import merge_frontdoor_blocks, rollup_statusz
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = (
+    "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+)
+
+
+def make_door(
+    clock,
+    *,
+    quotas=None,
+    default_quota=TenantQuota(rate_per_minute=600.0),
+    router=None,
+    resilience=None,
+    metrics=None,
+    freshness=30.0,
+    park_capacity=8,
+):
+    history = ResultHistory(clock)
+    door = FrontDoor(
+        history,
+        AdmissionController(
+            quotas, default_quota=default_quota, router=router, clock=clock
+        ),
+        clock=clock,
+        metrics=metrics,
+        resilience=resilience,
+        default_freshness=freshness,
+        park_capacity=park_capacity,
+    )
+    triggered = []
+    door.bind(lambda ns, name: triggered.append(f"{ns}/{name}"))
+    return door, history, triggered
+
+
+class FakeResilience:
+    """Just the .degraded bit the front door reads."""
+
+    def __init__(self):
+        self.degraded = False
+
+
+# -- admission ---------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_quota_refusal_is_structured_and_refills():
+    clock = FakeClock()
+    door, _history, triggered = make_door(
+        clock,
+        quotas={"t-a": TenantQuota(rate_per_minute=2.0, burst=2.0)},
+        default_quota=None,
+    )
+    first = door.submit("t-a", "health/x")
+    second = door.submit("t-a", "health/y")
+    third = door.submit("t-a", "health/z")
+    assert (first.outcome, second.outcome) == (OUTCOME_RUN, OUTCOME_RUN)
+    assert third.outcome == OUTCOME_REFUSED
+    assert third.reason == REFUSE_QUOTA
+    assert door.admission.refused["t-a"] == {REFUSE_QUOTA: 1}
+    assert triggered == ["health/x", "health/y"]
+    # 2/min refills one token every 30 s — the next submit admits
+    await clock.advance(30.0)
+    assert door.submit("t-a", "health/z").outcome == OUTCOME_RUN
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_unknown_tenant_refused_without_default_quota():
+    clock = FakeClock()
+    door, _history, triggered = make_door(
+        clock, quotas={"known": TenantQuota(60.0)}, default_quota=None
+    )
+    ticket = door.submit("stranger", "health/x")
+    assert ticket.outcome == OUTCOME_REFUSED
+    assert ticket.reason == REFUSE_UNKNOWN_TENANT
+    assert triggered == []
+    # with a default quota the same stranger is admitted lazily
+    open_door, _h, _t = make_door(clock)
+    assert open_door.submit("stranger", "health/x").outcome == OUTCOME_RUN
+    assert door.conservation()["ok"] and open_door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_front_door_routes_through_the_fleet_shard_router():
+    """A front-door request for check X lands on the SAME shard the
+    watch path would route X's reconcile to — per-shard backends get
+    exactly their own keys."""
+    clock = FakeClock()
+    router = ShardRouter(3)
+    history = ResultHistory(clock)
+    door = FrontDoor(
+        history,
+        AdmissionController(
+            default_quota=TenantQuota(6000.0), router=router, clock=clock
+        ),
+        clock=clock,
+    )
+    by_shard = {shard: [] for shard in range(3)}
+    for shard in range(3):
+        door.bind_shard(
+            shard,
+            lambda ns, name, s=shard: by_shard[s].append(f"{ns}/{name}"),
+        )
+    keys = [f"health/check-{i:03d}" for i in range(60)]
+    for key in keys:
+        ticket = door.submit("t", key)
+        assert ticket.outcome == OUTCOME_RUN
+        assert ticket.shard == router.shard_for(key)
+    for shard in range(3):
+        assert by_shard[shard] == [
+            k for k in keys if router.shard_for(k) == shard
+        ]
+    assert sum(len(v) for v in by_shard.values()) == len(keys)
+
+
+@pytest.mark.asyncio
+async def test_tenant_cardinality_is_bounded_by_max_tenants():
+    """An open endpoint cannot mint unbounded per-tenant state: beyond
+    max_tenants, new names refuse `tenant_capacity` booked under the
+    shared (overflow) row — one ledger row and one metric series for
+    ANY number of sprayed tenant strings."""
+    from activemonitor_tpu.frontdoor import (
+        OVERFLOW_TENANT,
+        REFUSE_TENANT_CAPACITY,
+    )
+
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    history = ResultHistory(clock)
+    door = FrontDoor(
+        history,
+        AdmissionController(
+            default_quota=TenantQuota(6000.0), clock=clock, max_tenants=2
+        ),
+        clock=clock,
+        metrics=metrics,
+    )
+    door.bind(lambda ns, name: None)
+    assert door.submit("t-1", "health/a").outcome == OUTCOME_RUN
+    assert door.submit("t-2", "health/b").outcome == OUTCOME_RUN
+    for i in range(50):  # 50 sprayed names, ONE overflow row
+        ticket = door.submit(f"sprayed-{i}", "health/c")
+        assert ticket.outcome == OUTCOME_REFUSED
+        assert ticket.reason == REFUSE_TENANT_CAPACITY
+    # known tenants keep being admitted
+    assert door.submit("t-1", "health/d").outcome == OUTCOME_RUN
+    conservation = door.conservation()
+    assert conservation["ok"]
+    assert set(conservation["tenants"]) == {"t-1", "t-2", OVERFLOW_TENANT}
+    overflow = conservation["tenants"][OVERFLOW_TENANT]
+    assert overflow["refused"] == {REFUSE_TENANT_CAPACITY: 50}
+    assert (
+        metrics.sample_value(
+            "healthcheck_frontdoor_refusals_total",
+            {"tenant": OVERFLOW_TENANT, "reason": REFUSE_TENANT_CAPACITY},
+        )
+        == 50
+    )
+    # unknown-tenant refusals on a closed fleet share the row too
+    closed, _h, _t = make_door(clock, quotas={}, default_quota=None)
+    for i in range(10):
+        assert closed.submit(f"x-{i}", "health/a").reason == (
+            REFUSE_UNKNOWN_TENANT
+        )
+    assert set(closed.conservation()["tenants"]) == {OVERFLOW_TENANT}
+
+
+@pytest.mark.asyncio
+async def test_unowned_key_is_a_structured_unrouted_refusal():
+    """Sharded fleet: a miss for a key another replica owns refuses
+    `unrouted` (with the owning shard id) instead of triggering a run
+    this replica's rings would never resolve."""
+    from activemonitor_tpu.frontdoor import REFUSE_UNROUTED
+
+    clock = FakeClock()
+    router = ShardRouter(3)
+    door, history, triggered = make_door(clock, router=router)
+    door.owns = lambda key: router.shard_for(key) == 0
+    owned = next(
+        f"health/c-{i}" for i in range(50)
+        if router.shard_for(f"health/c-{i}") == 0
+    )
+    unowned = next(
+        f"health/c-{i}" for i in range(50)
+        if router.shard_for(f"health/c-{i}") != 0
+    )
+    assert door.submit("t", owned).outcome == OUTCOME_RUN
+    ticket = door.submit("t", unowned)
+    assert ticket.outcome == OUTCOME_REFUSED
+    assert ticket.reason == REFUSE_UNROUTED
+    assert ticket.shard == router.shard_for(unowned)  # re-aim target
+    assert triggered == [owned]  # never triggered locally
+    assert door.cache.inflight_keys() == [owned]  # nothing stranded
+    # a fresh ring result still serves even for an unowned key? No —
+    # the owns gate runs before the lookup, so ownership is authoritative
+    history.record(unowned, ok=True, latency=1.0, workflow="wf", trace_id="t")
+    assert door.submit("t", unowned).outcome == OUTCOME_REFUSED
+    assert door.conservation()["ok"]
+
+
+# -- coalescing --------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_freshness_window_edges_and_per_request_override():
+    clock = FakeClock()
+    door, history, triggered = make_door(clock, freshness=30.0)
+    history.record("health/x", ok=True, latency=1.0, workflow="wf", trace_id="t0")
+    await clock.advance(29.0)
+    assert door.submit("a", "health/x").outcome == OUTCOME_HIT
+    # a stricter per-request window misses where the default hits
+    strict = door.submit("a", "health/x", freshness=10.0)
+    assert strict.outcome == OUTCOME_RUN
+    # resolve that run so the expiry probe below starts clean
+    history.record("health/x", ok=True, latency=1.0, workflow="wf", trace_id="t1")
+    await clock.advance(30.0)  # 30 s past the newest result: aged out
+    # a WIDER per-request window clamps down to the operator's default
+    # — the default is the staleness ceiling, not a suggestion
+    assert door.cache.fresh_result("health/x", 86400.0) is None
+    assert door.submit("a", "health/x").outcome == OUTCOME_RUN
+    assert triggered == ["health/x", "health/x"]
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_duplicates_fan_in_on_one_run_and_share_the_trace_id():
+    clock = FakeClock()
+    door, history, triggered = make_door(clock)
+    tickets = [door.submit(f"tenant-{i}", "health/x") for i in range(5)]
+    assert [t.outcome for t in tickets] == [OUTCOME_RUN] + [OUTCOME_JOINED] * 4
+    assert triggered == ["health/x"]  # ONE trigger for five requests
+    recorded = history.record(
+        "health/x", ok=True, latency=2.0, workflow="wf-9", trace_id="trace-9"
+    )
+    results = await asyncio.gather(*(t.wait() for t in tickets))
+    assert all(r is recorded for r in results)
+    assert {t.trace_id for t in tickets} == {"trace-9"}
+    ratios = door.coalesce_ratios()
+    assert ratios["join"] == pytest.approx(0.8)
+    assert ratios["miss"] == pytest.approx(0.2)
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_scheduled_run_coalesces_front_door_traffic():
+    """An in-flight entry resolves on ANY recorded result for the key —
+    including one the check's own schedule produced — so the watch
+    path's run absorbs front-door demand too."""
+    clock = FakeClock()
+    door, history, _triggered = make_door(clock)
+    ticket = door.submit("a", "health/x")
+    assert ticket.outcome == OUTCOME_RUN
+    # the SCHEDULED run records first; the front door's waiter rides it
+    scheduled = history.record(
+        "health/x", ok=False, latency=3.0, workflow="wf-sched", trace_id="ts"
+    )
+    assert await ticket.wait() is scheduled
+
+
+# -- degraded mode -----------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_degraded_misses_park_and_pump_replays_them():
+    clock = FakeClock()
+    resilience = FakeResilience()
+    door, history, triggered = make_door(clock, resilience=resilience)
+    history.record("health/y", ok=True, latency=1.0, workflow="wf", trace_id="ty")
+    resilience.degraded = True
+    # cache hits still serve while degraded — that's the point of the
+    # cache in an outage
+    assert door.submit("a", "health/y").outcome == OUTCOME_HIT
+    parked = door.submit("a", "health/x")
+    assert parked.outcome == OUTCOME_PARKED
+    assert triggered == []  # parked, never triggered
+    assert door.queue_depth() == 1
+    # pump during degraded is a no-op
+    assert door.pump() == 0
+    resilience.degraded = False
+    assert door.pump() == 1
+    assert triggered == ["health/x"]  # replayed, not dropped
+    recorded = history.record(
+        "health/x", ok=True, latency=1.0, workflow="wf2", trace_id="tx"
+    )
+    assert await parked.wait() is recorded
+    conservation = door.conservation()
+    assert conservation["ok"]
+    assert conservation["tenants"]["a"]["parked"] == 0
+    assert conservation["tenants"]["a"]["probe_runs"] == 1
+
+
+@pytest.mark.asyncio
+async def test_deleted_check_cancels_waiters_at_reconcile_speed():
+    """A typo'd or just-deleted check must fail its front-door waiters
+    the moment the reconciler notices (fleet.forget), not at the reap
+    sweep's 600s bound."""
+    from activemonitor_tpu.obs.slo import FleetStatus
+
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    door = FrontDoor(
+        fleet.history,
+        AdmissionController(default_quota=TenantQuota(600.0), clock=clock),
+        clock=clock,
+    )
+    door.bind(lambda ns, name: None)
+    fleet.frontdoor = door
+    ticket = door.submit("t", "health/typo")
+    assert ticket.outcome == OUTCOME_RUN
+    fleet.forget("health/typo")  # the reconciler's deleted path
+    with pytest.raises(asyncio.CancelledError):
+        await ticket.wait()
+    assert door.cache.inflight_keys() == []
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_pump_rechecks_ownership_and_records_refusal_metrics():
+    """A request parked before a shard handoff must get the same
+    structured `unrouted` verdict the submit path gives — and pump-time
+    refusals (unrouted, abandoned) reach the Prometheus counter, not
+    just the in-memory ledger."""
+    from activemonitor_tpu.frontdoor import REFUSE_ABANDONED, REFUSE_UNROUTED
+
+    clock = FakeClock()
+    resilience = FakeResilience()
+    resilience.degraded = True
+    metrics = MetricsCollector()
+    history = ResultHistory(clock)
+    door = FrontDoor(
+        history,
+        AdmissionController(default_quota=TenantQuota(600.0), clock=clock),
+        clock=clock,
+        metrics=metrics,
+        resilience=resilience,
+    )
+    triggered = []
+    door.bind(lambda ns, name: triggered.append(f"{ns}/{name}"))
+    handed_off = door.submit("t", "health/a")
+    abandoned = door.submit("t", "health/b")
+    live = door.submit("t", "health/c")
+    assert [
+        handed_off.outcome, abandoned.outcome, live.outcome
+    ] == [OUTCOME_PARKED] * 3
+    # the shard moves away while all three sit parked; one waiter gives up
+    door.owns = lambda key: key != "health/a"
+    abandoned.future.cancel()
+    resilience.degraded = False
+    assert door.pump() == 3
+    assert triggered == ["health/c"]  # only the live, still-owned key ran
+    with pytest.raises(asyncio.CancelledError):
+        await handed_off.wait()
+    for reason in (REFUSE_UNROUTED, REFUSE_ABANDONED):
+        assert (
+            metrics.sample_value(
+                "healthcheck_frontdoor_refusals_total",
+                {"tenant": "t", "reason": reason},
+            )
+            == 1.0
+        ), reason
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_park_capacity_overflow_is_a_structured_refusal():
+    clock = FakeClock()
+    resilience = FakeResilience()
+    resilience.degraded = True
+    door, _history, _triggered = make_door(
+        clock, resilience=resilience, park_capacity=1
+    )
+    assert door.submit("a", "health/x").outcome == OUTCOME_PARKED
+    overflow = door.submit("a", "health/z")
+    assert overflow.outcome == OUTCOME_REFUSED
+    assert overflow.reason == REFUSE_PARKED_FULL
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_reap_cancels_stranded_inflight_waiters():
+    """An in-flight entry whose run never records (deleted check,
+    disowned shard) is reaped after the age bound: waiters are
+    cancelled — a visible outcome, not an eternal hang — and the
+    counter records it."""
+    clock = FakeClock()
+    door, _history, _triggered = make_door(clock)
+    ticket = door.submit("a", "health/ghost")
+    assert ticket.outcome == OUTCOME_RUN
+    assert door.reap(max_age_seconds=600.0) == 0  # too young
+    await clock.advance(601.0)
+    assert door.reap(max_age_seconds=600.0) == 1
+    assert door.reaped_runs == 1
+    assert door.cache.inflight_keys() == []
+    with pytest.raises(asyncio.CancelledError):
+        await ticket.wait()
+    # outcome-counted at decision time, so the ledger stays exact
+    assert door.conservation()["ok"]
+
+
+# -- DAGs --------------------------------------------------------------
+
+
+def test_dag_parse_stages_and_validation():
+    dag = parse_dag(
+        "readiness",
+        "health/compile -> health/ici, health/hbm -> health/train",
+    )
+    stages = dag.stages()
+    assert [[s.name for s in stage] for stage in stages] == [
+        ["health/compile"],
+        ["health/ici", "health/hbm"],
+        ["health/train"],
+    ]
+    # every second-stage step waits on the whole first stage, etc.
+    assert stages[1][0].after == ("health/compile",)
+    assert stages[2][0].after == ("health/ici", "health/hbm")
+    with pytest.raises(ValueError, match="empty spec"):
+        parse_dag("nothing", " -> ")
+    with pytest.raises(ValueError, match="repeats step name"):
+        parse_dag("dup", "health/a -> health/a")
+    # malformed tokens reject at PARSE time — before any earlier stage
+    # could pay quota or launch a run
+    with pytest.raises(ValueError, match="badtoken"):
+        parse_dag("typo", "health/a -> badtoken")
+    with pytest.raises(ValueError, match="unknown step"):
+        ProbeDag("bad", (DagStep(name="a", check="h/a", after=("ghost",)),))
+    with pytest.raises(ValueError, match="cycle"):
+        ProbeDag(
+            "loop",
+            (
+                DagStep(name="a", check="h/a", after=("b",)),
+                DagStep(name="b", check="h/b", after=("a",)),
+            ),
+        )
+
+
+@pytest.mark.asyncio
+async def test_dag_executes_in_stages_and_reuses_upstream_results():
+    clock = FakeClock()
+    door, history, triggered = make_door(clock, freshness=300.0)
+
+    async def resolve_runs():
+        # play the backend: every triggered run records a result
+        while True:
+            await asyncio.sleep(0)
+            for key in list(door.cache.inflight_keys()):
+                history.record(
+                    key, ok=True, latency=1.0, workflow="wf", trace_id=f"t-{key}"
+                )
+
+    player = asyncio.create_task(resolve_runs())
+    try:
+        dag = parse_dag(
+            "readiness", "health/compile -> health/ici -> health/train"
+        )
+        tickets = await door.run_dag("tenant-a", dag)
+        assert [t.outcome for t in tickets.values()] == [OUTCOME_RUN] * 3
+        # stage order reached the backend in dependency order
+        assert triggered == ["health/compile", "health/ici", "health/train"]
+        # a second tenant running the SAME dag inside the freshness
+        # window re-probes NOTHING — every step serves from the rings
+        again = await door.run_dag("tenant-b", dag)
+        assert [t.outcome for t in again.values()] == [OUTCOME_HIT] * 3
+        assert triggered == ["health/compile", "health/ici", "health/train"]
+        # per-step trace ids join each step to its one underlying run
+        assert again["health/ici"].trace_id == "t-health/ici"
+    finally:
+        player.cancel()
+        await asyncio.gather(player, return_exceptions=True)
+    assert door.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_dag_stops_at_a_refused_step():
+    clock = FakeClock()
+    door, _history, triggered = make_door(
+        clock,
+        quotas={"t": TenantQuota(rate_per_minute=60.0, burst=1.0)},
+        default_quota=None,
+    )
+    async def resolve_runs():
+        while True:
+            await asyncio.sleep(0)
+            for key in list(door.cache.inflight_keys()):
+                _history.record(
+                    key, ok=True, latency=1.0, workflow="wf", trace_id="t"
+                )
+
+    player = asyncio.create_task(resolve_runs())
+    try:
+        dag = parse_dag("readiness", "health/compile -> health/train")
+        tickets = await door.run_dag("t", dag)
+    finally:
+        player.cancel()
+        await asyncio.gather(player, return_exceptions=True)
+    # the single-token bucket admits the first step; the second stage
+    # refuses on quota and the DAG reports exactly how far it got
+    assert tickets["health/compile"].outcome == OUTCOME_RUN
+    assert triggered == ["health/compile"]
+    assert (
+        "health/train" not in tickets
+        or tickets["health/train"].outcome == OUTCOME_REFUSED
+    )
+    assert door.conservation()["ok"]
+
+
+# -- conservation property under concurrent submission -----------------
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("seed", [3, 11, 42, 1337])
+async def test_per_tenant_conservation_property(seed):
+    """Property: whatever the interleaving of concurrent submissions,
+    scheduled result recordings, degraded flips, pumps, and quota
+    refusals, every tenant's ledger stays EXACT —
+    submitted == cache_hits + joins + runs + parked + refused — and
+    the admission controller's independent tally agrees."""
+    clock = FakeClock()
+    rng = random.Random(seed)
+    resilience = FakeResilience()
+    door, history, _triggered = make_door(
+        clock,
+        quotas={"t-throttled": TenantQuota(rate_per_minute=60.0, burst=3.0)},
+        default_quota=TenantQuota(rate_per_minute=100_000.0),
+        resilience=resilience,
+        freshness=20.0,
+        park_capacity=16,
+    )
+    checks = [f"health/chk-{i:02d}" for i in range(6)]
+    tenants = ["t-a", "t-b", "t-c", "t-throttled"]
+    requests = open_loop_checks(
+        300, rate_rps=50.0, seed=seed, checks=checks, tenants=tenants
+    )
+    tickets = []
+
+    async def submit_slice(slice_requests):
+        for req in slice_requests:
+            tickets.append(door.submit(req.tenant, req.check))
+            if rng.random() < 0.2:
+                await asyncio.sleep(0)  # yield mid-slice: interleave
+
+    i = 0
+    while i < len(requests):
+        width = rng.randrange(1, 5)
+        batch = requests[i : i + 40]
+        i += 40
+        # concurrent submitters over interleaved slices of the batch
+        await asyncio.gather(
+            *(submit_slice(batch[w::width]) for w in range(width))
+        )
+        event = rng.random()
+        if event < 0.3:
+            resilience.degraded = not resilience.degraded
+        if event < 0.5:
+            for key in list(door.cache.inflight_keys()):
+                if rng.random() < 0.7:
+                    history.record(
+                        key, ok=True, latency=0.5, workflow="wf", trace_id="t"
+                    )
+        door.pump()
+        await clock.advance(rng.uniform(0.0, 10.0))
+        # mid-storm: the ledger is already exact, parked and all
+        assert door.conservation()["ok"]
+    # quiesce: recover, pump everything, resolve every in-flight run
+    resilience.degraded = False
+    door.pump()
+    for key in list(door.cache.inflight_keys()):
+        history.record(key, ok=True, latency=0.5, workflow="wf", trace_id="t")
+    conservation = door.conservation()
+    assert conservation["ok"]
+    assert conservation["submitted"] == len(requests)
+    assert conservation["parked"] == 0
+    # the throttled tenant really was throttled, and every refusal is
+    # on its ledger, not vanished
+    throttled = conservation["tenants"]["t-throttled"]
+    assert throttled["refused"].get(REFUSE_QUOTA, 0) > 0
+    assert throttled["submitted"] == throttled["admitted"] + sum(
+        throttled["refused"].values()
+    ) - throttled["refused"].get(REFUSE_PARKED_FULL, 0)
+    # every ticket eventually resolved or was refused/parked-resolved
+    for ticket in tickets:
+        if ticket.outcome not in (OUTCOME_REFUSED,):
+            assert await ticket.wait() is not None
+
+
+# -- traffic generator -------------------------------------------------
+
+
+def test_open_loop_checks_seeded_determinism():
+    checks = ["health/a", "health/b", "health/c"]
+    first = open_loop_checks(32, 8.0, seed=7, checks=checks)
+    second = open_loop_checks(32, 8.0, seed=7, checks=checks)
+    assert first == second
+    assert first != open_loop_checks(32, 8.0, seed=8, checks=checks)
+    arrivals = [r.arrival for r in first]
+    assert arrivals == sorted(arrivals)
+    assert {r.tenant for r in first} == {"tenant-a", "tenant-b"}
+    with pytest.raises(ValueError):
+        open_loop_checks(0, 8.0, seed=7, checks=checks)
+    with pytest.raises(ValueError):
+        open_loop_checks(4, 8.0, seed=7, checks=[])
+
+
+# -- rollup + CLI ------------------------------------------------------
+
+
+def test_rollup_merges_frontdoor_blocks_lookup_weighted():
+    def payload(frontdoor):
+        return {
+            "fleet": {
+                "checks": 0,
+                "window_runs": 0,
+                "goodput_ratio": None,
+                "goodput": {},
+                "generated_at": "",
+                "degraded": False,
+                "breaker": None,
+                "status_writes_queued": 0,
+                "remedy_tokens": None,
+                "anomalies": {"warning": 0, "degraded": 0},
+                "sharding": None,
+                "matrix": None,
+                "frontdoor": frontdoor,
+            },
+            "checks": [],
+        }
+
+    a = {
+        "qps": 100.0,
+        "coalescing": {"hit": 0.5, "miss": 0.5, "join": 0.0, "lookups": 20},
+        "queue_depth": 2,
+        "parked": 1,
+        "inflight_runs": 1,
+        "reaped_runs": 0,
+        "degraded": False,
+        "conservation_ok": True,
+        "requests": {
+            "submitted": 22,
+            "refused": 2,
+            "cache_hits": 10,
+            "coalesced_joins": 0,
+            "probe_runs": 9,
+        },
+        "tenants": {
+            "t-a": {"submitted": 22, "refused": 2, "refusals": {"quota": 2}}
+        },
+    }
+    b = {
+        "qps": 50.0,
+        "coalescing": {"hit": 0.0, "miss": 0.0, "join": 1.0, "lookups": 10},
+        "queue_depth": 0,
+        "parked": 0,
+        "inflight_runs": 0,
+        "reaped_runs": 1,
+        "degraded": True,
+        "conservation_ok": True,
+        "requests": {
+            "submitted": 10,
+            "refused": 0,
+            "cache_hits": 0,
+            "coalesced_joins": 10,
+            "probe_runs": 0,
+        },
+        "tenants": {
+            "t-a": {"submitted": 4, "refused": 0, "refusals": {}},
+            "t-b": {"submitted": 6, "refused": 0, "refusals": {}},
+        },
+    }
+    rollup = rollup_statusz([payload(a), payload(b)])
+    merged = rollup["fleet"]["frontdoor"]
+    assert merged["qps"] == pytest.approx(150.0)
+    assert merged["degraded"] is True
+    assert merged["queue_depth"] == 2
+    assert merged["requests"]["submitted"] == 32
+    assert merged["tenants"]["t-a"]["submitted"] == 26
+    assert merged["tenants"]["t-a"]["refusals"] == {"quota": 2}
+    # lookup-weighted: 10 hits + 10 joins + (9 runs + 1 parked) = 30
+    assert merged["coalescing"]["lookups"] == 30
+    assert merged["coalescing"]["hit"] == pytest.approx(10 / 30)
+    assert merged["coalescing"]["join"] == pytest.approx(10 / 30)
+    # replicas without a front door roll up to null, like matrix
+    assert rollup_statusz([payload(None)])["fleet"]["frontdoor"] is None
+    assert merge_frontdoor_blocks([]) is None
+
+
+def test_status_table_renders_the_frontdoor_block():
+    from activemonitor_tpu.__main__ import render_status_table
+
+    payload = {
+        "fleet": {
+            "checks": 1,
+            "window_runs": 4,
+            "goodput_ratio": 1.0,
+            "frontdoor": {
+                "qps": 1234.5,
+                "coalescing": {
+                    "hit": 0.75,
+                    "miss": 0.05,
+                    "join": 0.20,
+                    "lookups": 400,
+                },
+                "queue_depth": 3,
+                "parked": 0,
+                "inflight_runs": 1,
+                "reaped_runs": 0,
+                "degraded": False,
+                "conservation_ok": True,
+                "requests": {
+                    "submitted": 420,
+                    "refused": 20,
+                    "cache_hits": 300,
+                    "coalesced_joins": 80,
+                    "probe_runs": 20,
+                },
+                "tenants": {
+                    "t-noisy": {
+                        "submitted": 100,
+                        "refused": 20,
+                        "refusals": {"quota": 20},
+                    },
+                    "t-quiet": {"submitted": 320, "refused": 0, "refusals": {}},
+                },
+            },
+        },
+        "checks": [],
+    }
+    text = render_status_table(payload)
+    assert "FRONTDOOR" in text
+    assert "qps=1234.5" in text
+    assert "hit=75.0%" in text
+    assert "join=20.0%" in text
+    assert "queue_depth=3" in text
+    assert "refusals={t-noisy: 20}" in text
+    # a payload without a front door renders no FRONTDOOR line
+    assert "FRONTDOOR" not in render_status_table(
+        {"fleet": {"checks": 0}, "checks": []}
+    )
+
+
+# -- the scripted FakeClock acceptance ---------------------------------
+
+
+def make_hc(name, repeat=3600):
+    return HealthCheck.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "health"},
+            "spec": {
+                "repeatAfterSec": repeat,
+                "level": "cluster",
+                "workflow": {
+                    "generateName": f"{name}-",
+                    "workflowtimeout": 30,
+                    "resource": {
+                        "namespace": "health",
+                        "serviceAccount": "sa",
+                        "source": {"inline": WF_INLINE},
+                    },
+                },
+            },
+        }
+    )
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+@pytest.mark.asyncio
+async def test_acceptance_n_duplicates_one_run_n_fanned_results():
+    """The fast-tier acceptance (ISSUE 15): N duplicate requests → 1
+    probe run through the Manager enqueue path → N fanned-out results
+    joinable by trace_id at /debug/traces — with the evidence visible
+    in /statusz, the pinned gauges, and the status table."""
+    import aiohttp
+
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    engine = FakeWorkflowEngine(succeed_after(1))
+    metrics = MetricsCollector()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    door = FrontDoor(
+        reconciler.fleet.history,
+        AdmissionController(
+            default_quota=TenantQuota(rate_per_minute=6000.0), clock=clock
+        ),
+        clock=clock,
+        metrics=metrics,
+        resilience=reconciler.resilience,
+        default_freshness=30.0,
+    )
+    manager = Manager(
+        client=client, reconciler=reconciler, max_parallel=2, frontdoor=door
+    )
+    manager._health_addr = "127.0.0.1:0"
+    await manager.start()
+    try:
+        await client.apply(make_hc("hc-slice"))
+        # boot run: the watch-path reconcile records the first result
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+        boot = reconciler.fleet.history.last("health/hc-slice")
+        assert boot is not None and boot.ok
+        boot_workflows = len(engine.submitted)
+
+        # inside the freshness window: every tenant is a cache hit on
+        # the SCHEDULED run's result — zero new workflows
+        for i in range(3):
+            ticket = door.submit(f"tenant-{i}", "health/hc-slice")
+            assert ticket.outcome == OUTCOME_HIT
+            assert ticket.trace_id == boot.trace_id
+        assert len(engine.submitted) == boot_workflows
+
+        # age the result out, then storm N duplicate requests
+        await clock.advance(31.0)
+        n = 6
+        tickets = [
+            door.submit(f"tenant-{i}", "health/hc-slice") for i in range(n)
+        ]
+        assert [t.outcome for t in tickets] == (
+            [OUTCOME_RUN] + [OUTCOME_JOINED] * (n - 1)
+        )
+        # drive the ONE triggered reconcile to completion
+        await settle()
+        await clock.advance(1.0)
+        await settle()
+        results = await asyncio.gather(*(t.wait() for t in tickets))
+        assert len(engine.submitted) == boot_workflows + 1  # ONE run
+        trace_ids = {t.trace_id for t in tickets}
+        assert len(trace_ids) == 1 and results[0].trace_id in trace_ids
+        assert all(r is results[0] for r in results)
+
+        # the fanned-out trace_id joins to the one reconcile cycle
+        trace_id = tickets[0].trace_id
+        traces = [
+            t
+            for t in reconciler.tracer.traces()
+            if t["trace_id"] == trace_id
+        ]
+        assert len(traces) == 1
+        assert any(
+            s["attrs"].get("healthcheck") == "health/hc-slice"
+            for s in traces[0]["spans"]
+        )
+
+        # /statusz carries the frontdoor block; HTTP ingestion works
+        port = manager._http_runners[0].addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/statusz"
+            ) as resp:
+                payload = await resp.json()
+            frontdoor = payload["fleet"]["frontdoor"]
+            assert frontdoor["conservation_ok"] is True
+            assert frontdoor["requests"]["cache_hits"] == 3
+            assert frontdoor["requests"]["coalesced_joins"] == n - 1
+            assert frontdoor["requests"]["probe_runs"] == 1
+            # POST /frontdoor/submit: the HTTP surface serves a hit
+            # for the just-recorded run without touching the engine
+            async with session.post(
+                f"http://127.0.0.1:{port}/frontdoor/submit",
+                json={"tenant": "tenant-http", "check": "health/hc-slice"},
+            ) as resp:
+                assert resp.status == 200
+                doc = await resp.json()
+            assert doc["outcome"] == OUTCOME_HIT
+            assert doc["trace_id"] == trace_id
+            assert doc["result"]["ok"] is True
+            # malformed body is a 400, not a traceback
+            async with session.post(
+                f"http://127.0.0.1:{port}/frontdoor/submit",
+                json={"tenant": "t"},
+            ) as resp:
+                assert resp.status == 400
+            # a malformed DAG token rejects before any stage runs
+            async with session.post(
+                f"http://127.0.0.1:{port}/frontdoor/submit",
+                json={
+                    "tenant": "t",
+                    "check": "readiness",
+                    "dag": "health/hc-slice -> badtoken",
+                },
+            ) as resp:
+                assert resp.status == 400
+            # wait=false on a DAG is fire-and-forget: 202 accepted
+            async with session.post(
+                f"http://127.0.0.1:{port}/frontdoor/submit",
+                json={
+                    "tenant": "tenant-dag",
+                    "check": "readiness",
+                    "dag": "health/hc-slice",
+                    "wait": False,
+                },
+            ) as resp:
+                assert resp.status == 202
+                accepted = await resp.json()
+            assert accepted["accepted"] is True
+        assert len(engine.submitted) == boot_workflows + 1
+
+        # pinned gauges populated from the same ledger
+        assert (
+            metrics.sample_value(
+                "healthcheck_frontdoor_requests_total",
+                {"tenant": "tenant-0", "outcome": "cache_hit"},
+            )
+            == 1.0
+        )
+        assert (
+            metrics.sample_value(
+                "healthcheck_frontdoor_requests_total",
+                {"tenant": "tenant-1", "outcome": "joined"},
+            )
+            == 1.0
+        )
+        assert (
+            metrics.sample_value(
+                "healthcheck_frontdoor_queue_depth", {}
+            )
+            == 0.0
+        )
+        hit = metrics.sample_value(
+            "healthcheck_frontdoor_coalesce_ratio", {"kind": "hit"}
+        )
+        join = metrics.sample_value(
+            "healthcheck_frontdoor_coalesce_ratio", {"kind": "join"}
+        )
+        assert hit and hit > 0 and join and join > 0
+
+        # the status table leads with the same evidence
+        from activemonitor_tpu.__main__ import render_status_table
+
+        assert "FRONTDOOR" in render_status_table(payload)
+    finally:
+        await manager.stop()

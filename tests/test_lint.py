@@ -1215,3 +1215,107 @@ def test_matrix_contract_names_are_pinned():
         "fallback_reason", "BENCH_BASELINES",
     ):
         assert key in bench_src, f"bench.py no longer records {key}"
+
+
+# -- front door (ISSUE 15) ---------------------------------------------
+
+
+def test_wallclock_banned_in_frontdoor_package(tmp_path):
+    """frontdoor/ runs entirely on the injectable Clock — quota-bucket
+    refill, freshness-window expiry, and the QPS buckets must all be
+    scriptable by fake-clock tests, so a bare time.time()/
+    time.monotonic() anywhere under a frontdoor/ directory is a lint
+    error (package-scoped like resilience/ and analysis/). The same
+    code OUTSIDE frontdoor/ stays quiet."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    pkg_dir = tmp_path / "frontdoor"
+    pkg_dir.mkdir()
+    (pkg_dir / "mod.py").write_text(source)
+    got = lint.lint_file(pkg_dir / "mod.py")
+    assert codes(got) == {"wallclock-in-frontdoor"}
+    assert len(got) == 2  # both the time() and the monotonic() call
+    # identical code outside frontdoor/: no finding
+    assert findings(tmp_path, source) == []
+    # clock-disciplined front-door code: no finding
+    clean = (
+        "def fresh(clock, window):\n"
+        "    return clock.monotonic() + window\n"
+    )
+    (pkg_dir / "clean.py").write_text(clean)
+    assert lint.lint_file(pkg_dir / "clean.py") == []
+
+
+def test_frontdoor_package_really_is_wallclock_free():
+    """The gate, applied: the shipped frontdoor/ package lints clean,
+    and the ban actually covers its files (path-scoping regression
+    guard, like the resilience/analysis twins)."""
+    package = REPO / "activemonitor_tpu" / "frontdoor"
+    files = sorted(package.rglob("*.py"))
+    assert files, "frontdoor package missing?"
+    for path in files:
+        assert lint.lint_file(path) == []
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock, path
+        assert checker.wallclock_pkg == "frontdoor", path
+
+
+def test_wallclock_banned_in_arrivals_module(tmp_path):
+    """scheduler/arrivals.py is the ONE seeded open-loop arrival
+    contract (serving's generator and the front door's share it):
+    schedules live on the caller's timeline, so a wall-clock read
+    there would smuggle nondeterminism into both generators at once.
+    Module-name keyed like serving.py/kv_cache.py."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+    )
+    got = findings(tmp_path, source, name="arrivals.py")
+    assert codes(got) == {"wallclock-in-arrivals"}
+    path = REPO / "activemonitor_tpu" / "scheduler" / "arrivals.py"
+    assert path.exists()
+    assert lint.lint_file(path) == []
+    src = path.read_text()
+    checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+    assert checker.ban_wallclock
+    assert checker.wallclock_pkg == "arrivals"
+
+
+def test_frontdoor_metric_families_are_pinned():
+    """The ISSUE-15 families must stay in the exposition contract — the
+    coalescing dashboard reads the hit/join ratios next to the request
+    counters, and a rename silently breaks the per-tenant refusal
+    alert."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_frontdoor", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_frontdoor_requests_total",
+        "healthcheck_frontdoor_refusals_total",
+        "healthcheck_frontdoor_coalesce_ratio",
+        "healthcheck_frontdoor_queue_depth",
+        "healthcheck_frontdoor_admission_seconds",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+    # and the operator docs register every family next to the runbook
+    docs = (REPO / "docs" / "observability.md").read_text()
+    for family in (
+        "healthcheck_frontdoor_requests_total",
+        "healthcheck_frontdoor_refusals_total",
+        "healthcheck_frontdoor_coalesce_ratio",
+        "healthcheck_frontdoor_queue_depth",
+        "healthcheck_frontdoor_admission_seconds",
+    ):
+        assert family in docs, f"{family} missing from docs/observability.md"
+    ops_docs = (REPO / "docs" / "operations.md").read_text()
+    assert "Probe-as-a-service front door" in ops_docs
+    assert "/frontdoor/submit" in ops_docs

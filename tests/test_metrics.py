@@ -235,6 +235,13 @@ PINNED_FAMILIES = {
     "healthcheck_matrix_cell_roofline_fraction": "gauge",
     "healthcheck_matrix_cells": "gauge",
     "healthcheck_matrix_bisect_runs_total": "counter",
+    # front-door families (ISSUE 15: probe-as-a-service ingestion —
+    # docs/operations.md "Probe-as-a-service front door")
+    "healthcheck_frontdoor_requests_total": "counter",
+    "healthcheck_frontdoor_refusals_total": "counter",
+    "healthcheck_frontdoor_coalesce_ratio": "gauge",
+    "healthcheck_frontdoor_queue_depth": "gauge",
+    "healthcheck_frontdoor_admission_seconds": "histogram",
     # sharding families (ISSUE 6: sharded controller fleet —
     # docs/operations.md "Sharded controller fleet")
     "healthcheck_shard_owned": "gauge",
@@ -282,6 +289,12 @@ def exercise_every_family(collector):
     )
     collector.set_metric_zscore("hc-a", "health", "m", -2.0)
     collector.set_anomaly_state("hc-a", "health", "warning")
+    # front-door families (ISSUE 15)
+    collector.record_frontdoor_request("tenant-a", "cache_hit")
+    collector.record_frontdoor_refusal("tenant-a", "quota")
+    collector.set_frontdoor_coalesce(hit=0.5, miss=0.25, join=0.25)
+    collector.set_frontdoor_queue_depth(2)
+    collector.observe_frontdoor_admission(0.0004)
     # sharding families
     collector.set_shard_owned(0, True)
     collector.set_shard_checks(0, 3)

@@ -348,6 +348,9 @@ FLEET_FIELDS = {
     # scenario-matrix round summary (ISSUE 12): the latest observed
     # round's per-cell verdicts; None until a matrix source is wired
     "matrix": (dict, type(None)),
+    # front-door ingestion summary (ISSUE 15): QPS, coalescing ratios,
+    # queue depth, per-tenant refusals; None when no front door is wired
+    "frontdoor": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,

@@ -508,6 +508,268 @@ async def test_shard_soak_50k_checks_survive_owner_kill_exactly_once():
             await player_api.close()
 
 
+# -- front-door soak (ISSUE 15 acceptance, full-scale tier) ------------
+#
+# ≥10k requests/s of open-loop tenant traffic against the stub
+# apiserver: duplicate questions coalesce onto ONE probe run per check
+# per freshness window, admission latency stays bounded at p99, a
+# throttled tenant's refusals are structured and counted, and the
+# per-tenant conservation ledger stays exact through two storm phases
+# (a miss-heavy one that triggers runs and a hit-heavy one served from
+# the rings). The fast-tier slice of this scenario lives in
+# tests/test_frontdoor.py; this is the throughput proof.
+
+N_FD_CHECKS = 48
+N_FD_REQUESTS = 30_000  # per storm phase (two phases measured together)
+FD_FRESHNESS = 300.0  # seconds a ring result satisfies a request
+FD_TENANTS = [f"fd-tenant-{i}" for i in range(8)] + ["fd-throttled"]
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_frontdoor_soak_10k_rps_against_the_stub_apiserver():
+    import time as _time
+
+    from activemonitor_tpu import GROUP, VERSION
+    from activemonitor_tpu.controller.client_k8s import (
+        KubernetesHealthCheckClient,
+    )
+    from activemonitor_tpu.engine.argo import (
+        WF_GROUP,
+        WF_PLURAL,
+        WF_VERSION,
+        ArgoWorkflowEngine,
+    )
+    from activemonitor_tpu.frontdoor import (
+        AdmissionController,
+        FrontDoor,
+        OUTCOME_HIT,
+        OUTCOME_JOINED,
+        OUTCOME_REFUSED,
+        OUTCOME_RUN,
+        REFUSE_QUOTA,
+        TenantQuota,
+        open_loop_checks,
+    )
+    from activemonitor_tpu.kube import ApiError, api_path
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    from tests.kube_harness import advance, drive_until, stub_env
+
+    async with stub_env() as (server, api):
+        clock = FakeClock()
+        objs = [
+            {
+                "apiVersion": f"{GROUP}/{VERSION}",
+                "kind": "HealthCheck",
+                "metadata": {"name": f"fd-{i:03d}", "namespace": "health"},
+                "spec": {
+                    "repeatAfterSec": 86_400,  # never due inside the soak
+                    "level": "cluster",
+                    "workflow": {
+                        "generateName": f"fd-{i:03d}-",
+                        "workflowtimeout": 300,
+                        "resource": {
+                            "namespace": "health",
+                            "serviceAccount": "fd-sa",
+                            "source": {"inline": WF_INLINE},
+                        },
+                    },
+                },
+            }
+            for i in range(N_FD_CHECKS)
+        ]
+        metrics = MetricsCollector()
+        client = KubernetesHealthCheckClient(api)
+        reconciler = HealthCheckReconciler(
+            client=client,
+            engine=ArgoWorkflowEngine(api),
+            rbac=RBACProvisioner(InMemoryRBACBackend()),
+            recorder=EventRecorder(capacity=5000),
+            metrics=metrics,
+            clock=clock,
+        )
+        door = FrontDoor(
+            reconciler.fleet.history,
+            AdmissionController(
+                quotas={
+                    "fd-throttled": TenantQuota(
+                        rate_per_minute=60.0, burst=50.0
+                    )
+                },
+                default_quota=TenantQuota(rate_per_minute=10**9),
+                clock=clock,
+            ),
+            clock=clock,
+            metrics=metrics,
+            resilience=reconciler.resilience,
+            default_freshness=FD_FRESHNESS,
+        )
+        manager = Manager(
+            client=client,
+            reconciler=reconciler,
+            max_parallel=24,
+            frontdoor=door,
+            goodput_interval=600.0,
+        )
+
+        async def play():
+            done = set()
+            while True:
+                for wf in server.objs(WF_GROUP, WF_VERSION, WF_PLURAL):
+                    name = wf["metadata"]["name"]
+                    if name in done:
+                        continue
+                    try:
+                        await api.merge_patch(
+                            api_path(
+                                WF_GROUP, WF_VERSION, WF_PLURAL,
+                                wf["metadata"]["namespace"], name, "status",
+                            ),
+                            {"status": {"phase": "Succeeded"}},
+                        )
+                        done.add(name)
+                    except ApiError:
+                        continue
+                await asyncio.sleep(0.05)
+
+        def run_totals():
+            runs = 0
+            for hc in server.objs(GROUP, VERSION, "healthchecks"):
+                runs += (
+                    (hc.get("status") or {}).get("totalHealthCheckRuns") or 0
+                )
+            return runs, len(server.objs(WF_GROUP, WF_VERSION, WF_PLURAL))
+
+        player = asyncio.create_task(play())
+        try:
+            await manager.start()
+            server.bulk_seed(GROUP, VERSION, "healthchecks", objs)
+            for hc in await client.list():
+                manager.enqueue(hc.metadata.namespace, hc.metadata.name)
+
+            # boot: every never-ran check fires exactly once
+            async def booted():
+                runs, workflows = run_totals()
+                return runs >= N_FD_CHECKS and workflows >= N_FD_CHECKS
+            await drive_until(clock, booted, max_seconds=120)
+            assert run_totals()[1] == N_FD_CHECKS
+
+            # age the boot results out of the freshness window
+            await advance(clock, FD_FRESHNESS + 100.0)
+
+            storm = open_loop_checks(
+                N_FD_REQUESTS,
+                rate_rps=20_000.0,
+                seed=1915,
+                checks=[f"health/fd-{i:03d}" for i in range(N_FD_CHECKS)],
+                tenants=FD_TENANTS,
+            )
+
+            def submit_storm():
+                tickets, latencies = [], []
+                for req in storm:
+                    t0 = _time.perf_counter()
+                    tickets.append(door.submit(req.tenant, req.check))
+                    latencies.append(_time.perf_counter() - t0)
+                return tickets, latencies
+
+            # ---- phase A: miss-heavy (every check's first asker
+            # triggers ONE demand-run; every duplicate fans in) --------
+            wall_a0 = _time.perf_counter()
+            tickets_a, lat_a = submit_storm()
+            wall_a = _time.perf_counter() - wall_a0
+            outcomes_a = [t.outcome for t in tickets_a]
+            assert outcomes_a.count(OUTCOME_RUN) == N_FD_CHECKS
+            assert outcomes_a.count(OUTCOME_JOINED) > 0
+            # mid-storm the ledger is already exact, per tenant
+            assert door.conservation()["ok"]
+
+            # the 48 demanded runs complete through the normal
+            # reconcile path against the stub apiserver
+            async def phase_a_done():
+                runs, workflows = run_totals()
+                return workflows >= 2 * N_FD_CHECKS
+            await drive_until(clock, phase_a_done, max_seconds=300)
+            runs, workflows = run_totals()
+            # exactly ONE workflow per check per storm — 30k requests
+            # cost 48 runs, everything else coalesced
+            assert workflows == 2 * N_FD_CHECKS, workflows
+            for ticket in tickets_a:
+                if ticket.outcome != OUTCOME_REFUSED:
+                    assert await ticket.wait() is not None
+            # every fanned-out waiter of one check shares its run's
+            # trace id (joinable at /debug/traces)
+            by_check = {}
+            for ticket in tickets_a:
+                if ticket.outcome in (OUTCOME_RUN, OUTCOME_JOINED):
+                    by_check.setdefault(ticket.check, set()).add(
+                        ticket.trace_id
+                    )
+            assert by_check and all(
+                len(ids) == 1 for ids in by_check.values()
+            )
+
+            # ---- phase B: hit-heavy (fresh rings serve everything the
+            # quota admits; zero new workflows) ------------------------
+            wall_b0 = _time.perf_counter()
+            tickets_b, lat_b = submit_storm()
+            wall_b = _time.perf_counter() - wall_b0
+            outcomes_b = [t.outcome for t in tickets_b]
+            assert outcomes_b.count(OUTCOME_RUN) == 0
+            assert outcomes_b.count(OUTCOME_HIT) > 0
+            assert run_totals()[1] == 2 * N_FD_CHECKS  # no new runs
+
+            # ---- the acceptance gates --------------------------------
+            total = 2 * N_FD_REQUESTS
+            measured_rps = total / (wall_a + wall_b)
+            assert measured_rps >= 10_000, (
+                f"front door sustained only {measured_rps:,.0f} req/s"
+            )
+            latencies = sorted(lat_a + lat_b)
+            p99 = latencies[int(0.99 * len(latencies)) - 1]
+            assert p99 < 0.005, f"admission p99 {p99 * 1e3:.2f}ms"
+            ratios = door.coalesce_ratios()
+            assert ratios["hit"] > 0  # coalescing under duplicate traffic
+            assert ratios["join"] > 0
+            # the throttled tenant was refused — structured and counted
+            refused = door.admission.refused["fd-throttled"]
+            assert refused.get(REFUSE_QUOTA, 0) > 0
+            assert (
+                metrics.sample_value(
+                    "healthcheck_frontdoor_refusals_total",
+                    {"tenant": "fd-throttled", "reason": REFUSE_QUOTA},
+                )
+                == refused[REFUSE_QUOTA]
+            )
+            # exact per-tenant conservation across both phases
+            conservation = door.conservation()
+            assert conservation["ok"]
+            assert conservation["submitted"] == total
+            assert conservation["probe_runs"] == N_FD_CHECKS
+            assert conservation["parked"] == 0
+            per_tenant = conservation["tenants"]
+            assert sum(r["submitted"] for r in per_tenant.values()) == total
+            for tenant in FD_TENANTS:
+                row = per_tenant[tenant]
+                assert row["submitted"] == (
+                    row["cache_hits"]
+                    + row["coalesced_joins"]
+                    + row["probe_runs"]
+                    + row["parked"]
+                    + row["refused_total"]
+                ), tenant
+            # the evidence surfaces: /statusz fleet block agrees
+            payload = reconciler.fleet.statusz(await client.list())
+            frontdoor = payload["fleet"]["frontdoor"]
+            assert frontdoor["conservation_ok"] is True
+            assert frontdoor["requests"]["submitted"] == total
+        finally:
+            player.cancel()
+            await asyncio.gather(player, return_exceptions=True)
+            await manager.stop()
+
+
 def _series_count(metrics: MetricsCollector) -> int:
     return sum(
         1
