@@ -218,6 +218,29 @@ def build_parser() -> argparse.ArgumentParser:
         "of failing the payload (docs/observability.md \"Reading the "
         "matrix\")",
     )
+    run.add_argument(
+        "--journal-dir",
+        default="",
+        metavar="DIR",
+        help="durable telemetry journal (docs/observability.md "
+        "\"Durable telemetry journal\"): append check results, "
+        "attribution verdicts, and front-door arrival events as "
+        "segmented JSONL under DIR, and replay the tail at boot so "
+        "SLO windows, error-budget burn, and goodput attribution "
+        "survive restarts; the arrival stream doubles as the workload "
+        "trace `am-tpu replay` and the frontdoor-replay matrix cell "
+        "consume",
+    )
+    run.add_argument(
+        "--journal-max-bytes",
+        type=int,
+        default=0,
+        metavar="BYTES",
+        help="journal segment size cap before rotation (0: the "
+        "journal's default, 1 MiB); compaction drops the oldest "
+        "segments beyond the retained-segment cap so the directory "
+        "stays bounded",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -349,6 +372,90 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_statusz_flags(goodput)
     goodput.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
+    journal = sub.add_parser(
+        "journal",
+        help="durable telemetry journal: segment table, per-stream "
+        "event counts, replay coverage of the recorded workload trace "
+        "(docs/observability.md \"Durable telemetry journal\")",
+    )
+    journal.add_argument(
+        "--journal-dir",
+        default="",
+        metavar="DIR",
+        help="inspect a journal directory on disk instead of a running "
+        "controller's /statusz journal block (the on-disk view adds "
+        "the replay-coverage line — coverage needs the recorded "
+        "events, not just the counters)",
+    )
+    add_statusz_flags(journal)
+    journal.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
+    record = sub.add_parser(
+        "record",
+        help="record a seeded front-door traffic trace into a journal "
+        "directory: drives open-loop Poisson check requests through a "
+        "real front door on a fake clock, journaling every arrival "
+        "(docs/operations.md \"Recording and replaying a traffic "
+        "trace\")",
+    )
+    record.add_argument(
+        "--journal-dir", required=True, metavar="DIR",
+        help="journal directory the arrival trace is appended to",
+    )
+    record.add_argument(
+        "--requests", type=int, default=64,
+        help="number of requests to drive (default 64)",
+    )
+    record.add_argument(
+        "--rate", type=float, default=200.0,
+        help="offered load in requests/second (default 200)",
+    )
+    record.add_argument(
+        "--seed", type=int, default=17,
+        help="rng seed — same seed, same byte-identical schedule",
+    )
+    record.add_argument(
+        "--check", action="append", default=None, metavar="NS/NAME",
+        help="check identity in the offered set (repeatable; default "
+        "bench/hc-a bench/hc-b bench/hc-c). A SMALL set is the point: "
+        "duplicates exercise the coalescing cache",
+    )
+    record.add_argument(
+        "--tenant", action="append", default=None,
+        help="tenant in the round-robin mix (repeatable; default "
+        "tenant-a tenant-b)",
+    )
+    record.add_argument(
+        "--freshness", type=float, default=30.0,
+        help="cache-freshness window in seconds (default 30; pass the "
+        "same value to `replay` to reproduce the outcome sequence)",
+    )
+    record.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
+    replay = sub.add_parser(
+        "replay",
+        help="replay a recorded traffic trace through a fresh front "
+        "door on a fake clock: same tenant mix, same arrival order, "
+        "deterministic outcomes (docs/operations.md \"Recording and "
+        "replaying a traffic trace\")",
+    )
+    replay.add_argument(
+        "--journal-dir", required=True, metavar="DIR",
+        help="journal directory holding the recorded arrival stream",
+    )
+    replay.add_argument(
+        "--freshness", type=float, default=30.0,
+        help="cache-freshness window in seconds (default 30; match the "
+        "recording's)",
+    )
+    replay.add_argument(
         "-o", "--output", choices=["text", "json"], default="text"
     )
 
@@ -524,6 +631,17 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
             resilience=reconciler.resilience,
             default_freshness=getattr(args, "frontdoor_freshness", 30.0),
         )
+    journal_dir = getattr(args, "journal_dir", "")
+    journal_max_bytes = getattr(args, "journal_max_bytes", 0) or 0
+    if journal_max_bytes < 0:
+        raise _ConfigError(
+            f"--journal-max-bytes must be >= 0 (got {journal_max_bytes}); "
+            "0 uses the journal's default segment cap"
+        )
+    if journal_max_bytes and not journal_dir:
+        raise _ConfigError(
+            "--journal-max-bytes needs --journal-dir (no journal to cap)"
+        )
     metrics_authorizer = None
     k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
     if k8s_auth == "on" and kube_api is None:
@@ -567,6 +685,8 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         shard_coordinator=coordinator,
         flight_dir=getattr(args, "flight_dir", ""),
         frontdoor=frontdoor,
+        journal_dir=journal_dir,
+        journal_max_bytes=journal_max_bytes,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -1396,6 +1516,269 @@ async def _matrix(args) -> int:
     return 0
 
 
+def render_journal(block) -> str:
+    """The `am-tpu journal` report: segment table, per-stream event
+    counts, replay coverage. Pure over either journal view — the
+    on-disk block (``--journal-dir``: segments + ``events`` counts +
+    ``coverage``) or the /statusz fleet block (``appended`` /
+    ``replayed`` counters, possibly rolled up across replicas) — so
+    tests pin the rendering."""
+    if not block:
+        return (
+            "no journal recorded (run the controller with "
+            "--journal-dir, or point --journal-dir here at a journal "
+            "directory)"
+        )
+    header = "journal"
+    if block.get("dir"):
+        header += " {}".format(block["dir"])
+    header += "  segments={}".format(
+        block.get("segment_count", len(block.get("segments") or []))
+    )
+    if block.get("replicas"):
+        header += "  replicas={}".format(block["replicas"])
+    if isinstance(block.get("lag_seconds"), (int, float)):
+        header += "  lag={:.1f}s".format(block["lag_seconds"])
+    lines = [header]
+    warning = block.get("restore_warning")
+    if warning:
+        lines.append(
+            "restored fresh: {} ({})".format(
+                warning.get("reason", "?"), warning.get("detail", "")
+            )
+        )
+    segments = block.get("segments") or []
+    if segments:
+        lines.append("SEGMENT              BYTES  ACTIVE")
+        for seg in segments:
+            lines.append(
+                "{:<19}  {:>5}  {}".format(
+                    seg.get("name", seg.get("segment", "?")),
+                    seg.get("bytes", 0),
+                    "*" if seg.get("active") else "",
+                ).rstrip()
+            )
+    events = block.get("events")
+    if events:
+        lines.append("STREAM        EVENTS")
+        for stream in sorted(events):
+            lines.append("{:<12}  {:>6}".format(stream, events[stream]))
+    appended = block.get("appended")
+    if appended:
+        replayed = block.get("replayed") or {}
+        lines.append("STREAM        APPENDED  REPLAYED")
+        for stream in sorted(appended):
+            lines.append(
+                "{:<12}  {:>8}  {:>8}".format(
+                    stream, appended[stream], replayed.get(stream, 0)
+                )
+            )
+    if "dropped" in block:
+        lines.append(
+            "dropped={}  compacted_segments={}".format(
+                block.get("dropped", 0), block.get("compacted_segments", 0)
+            )
+        )
+    coverage = block.get("coverage")
+    if coverage is not None:
+        lines.append(
+            "replay coverage: {} arrivals over {:.1f}s  tenants={}  "
+            "checks={}".format(
+                coverage.get("events", 0),
+                coverage.get("span_seconds") or 0.0,
+                ",".join(coverage.get("tenants") or []) or "-",
+                ",".join(coverage.get("checks") or []) or "-",
+            )
+        )
+    return "\n".join(lines)
+
+
+def _local_journal_block(journal_dir: str):
+    """The on-disk journal view the `am-tpu journal --journal-dir`
+    path renders: segment table from the directory, per-stream event
+    counts and replay coverage from an all-or-nothing read (a torn
+    journal shows the structured warning and zero events, exactly what
+    a restart would restore). None when the directory does not exist."""
+    import os
+
+    from activemonitor_tpu.obs.journal import (
+        STREAM_ARRIVAL,
+        STREAMS,
+        list_segments,
+        read_journal,
+    )
+    from activemonitor_tpu.obs.replay import RecordedArrivals
+
+    if not os.path.isdir(journal_dir):
+        return None
+    events, warnings = read_journal(journal_dir)
+    counts = {stream: 0 for stream in STREAMS}
+    for event in events:
+        stream = event.get("stream")
+        if stream in counts:
+            counts[stream] += 1
+    schedule = RecordedArrivals(
+        [ev for ev in events if ev.get("stream") == STREAM_ARRIVAL]
+    )
+    pairs = list_segments(journal_dir)
+    segments = []
+    for seq, path in pairs:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        segments.append(
+            {
+                "segment": seq,
+                "name": os.path.basename(path),
+                "bytes": size,
+                "active": seq == pairs[-1][0],
+            }
+        )
+    return {
+        "dir": journal_dir,
+        "segment_count": len(segments),
+        "segments": segments,
+        "events": counts,
+        "coverage": schedule.coverage(),
+        "restore_warning": warnings[0] if warnings else None,
+    }
+
+
+async def _journal(args) -> int:
+    import json as _json
+
+    journal_dir = getattr(args, "journal_dir", "")
+    if journal_dir:
+        block = _local_journal_block(journal_dir)
+        if block is None:
+            print(
+                f"error: {journal_dir} is not a directory", file=sys.stderr
+            )
+            return 1
+    else:
+        payload = await _fetch_fleet_payload(args)
+        if payload is None:
+            return 1
+        block = (payload.get("fleet") or {}).get("journal")
+    if args.output == "json":
+        print(_json.dumps(block, indent=2))
+        return 0
+    print(render_journal(block))
+    return 0
+
+
+def render_drive_summary(verb: str, summary: dict) -> str:
+    """The shared `am-tpu record`/`replay` report: how many requests
+    were driven, the tenant mix, the outcome ledger and whether the
+    per-tenant conservation identity held. Pure over the
+    ``drive_requests`` summary so tests pin the rendering."""
+    mix = summary.get("tenant_mix") or {}
+    outcomes = summary.get("outcome_counts") or {}
+    lines = [
+        "{}: {} requests driven  conservation={}".format(
+            verb,
+            summary.get("requests", 0),
+            "ok" if summary.get("conservation_ok") else "VIOLATED",
+        ),
+        "tenant mix: "
+        + (
+            "  ".join(f"{t}={mix[t]}" for t in sorted(mix)) or "none"
+        ),
+        "outcomes:   "
+        + (
+            "  ".join(f"{o}={outcomes[o]}" for o in sorted(outcomes))
+            or "none"
+        ),
+    ]
+    return "\n".join(lines)
+
+
+async def _record(args) -> int:
+    import json as _json
+
+    from activemonitor_tpu.errors import ConfigurationError as _ConfigError
+    from activemonitor_tpu.frontdoor.traffic import open_loop_checks
+    from activemonitor_tpu.obs.journal import TelemetryJournal
+    from activemonitor_tpu.obs.replay import drive_requests
+
+    if args.requests < 1:
+        raise _ConfigError(f"--requests must be >= 1, got {args.requests}")
+    if args.rate <= 0:
+        raise _ConfigError(f"--rate must be > 0, got {args.rate}")
+    checks = tuple(args.check or ("bench/hc-a", "bench/hc-b", "bench/hc-c"))
+    tenants = tuple(args.tenant or ("tenant-a", "tenant-b"))
+    requests = open_loop_checks(
+        args.requests, args.rate, args.seed, checks, tenants=tenants
+    )
+    journal = TelemetryJournal(args.journal_dir)
+    try:
+        summary = await drive_requests(
+            requests, journal=journal, default_freshness=args.freshness
+        )
+    finally:
+        journal.close()
+    if args.output == "json":
+        doc = dict(summary)
+        doc["journal"] = journal.snapshot()
+        print(_json.dumps(doc, indent=2))
+        return 0 if summary["conservation_ok"] else 1
+    lines = [render_drive_summary("recorded", summary)]
+    lines.append(
+        "journal:    {}  segments={}  arrivals appended={}".format(
+            args.journal_dir,
+            len(journal.segments()),
+            journal.appended.get("arrival", 0),
+        )
+    )
+    print("\n".join(lines))
+    return 0 if summary["conservation_ok"] else 1
+
+
+async def _replay(args) -> int:
+    import json as _json
+
+    from activemonitor_tpu.frontdoor.traffic import replayed_checks
+    from activemonitor_tpu.obs.replay import drive_requests, load_trace
+
+    schedule, warnings = load_trace(args.journal_dir)
+    if warnings:
+        warning = warnings[0]
+        print(
+            "error: journal unusable: {} ({})".format(
+                warning.get("reason", "?"), warning.get("detail", "")
+            ),
+            file=sys.stderr,
+        )
+        return 1
+    if not len(schedule):
+        print(
+            f"error: no arrival events recorded in {args.journal_dir} "
+            "(run `am-tpu record` first, or point a controller at it "
+            "with --journal-dir)",
+            file=sys.stderr,
+        )
+        return 1
+    coverage = schedule.coverage()
+    requests = replayed_checks(schedule)
+    summary = await drive_requests(
+        requests, default_freshness=args.freshness
+    )
+    if args.output == "json":
+        doc = dict(summary)
+        doc["coverage"] = coverage
+        print(_json.dumps(doc, indent=2))
+        return 0 if summary["conservation_ok"] else 1
+    lines = [render_drive_summary("replayed", summary)]
+    lines.append(
+        "coverage:   {} arrivals over {:.1f}s".format(
+            coverage.get("events", 0), coverage.get("span_seconds") or 0.0
+        )
+    )
+    print("\n".join(lines))
+    return 0 if summary["conservation_ok"] else 1
+
+
 async def _describe(args) -> int:
     import yaml as _yaml
 
@@ -1494,6 +1877,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "goodput": _goodput,
         "roofline": _roofline,
         "matrix": _matrix,
+        "journal": _journal,
+        "record": _record,
+        "replay": _replay,
     }[args.command]
     if args.command == "run":
         # pre-import the controller's heavy dependency graph BEFORE the

@@ -91,6 +91,10 @@ SKIP_MISSING_AXIS = "missing-mesh-axis"
 SKIP_UNKNOWN_SCHEDULE = "unknown-schedule"
 SKIP_DEVICES = "insufficient-devices"
 SKIP_QUICK = "quick-mode"
+# frontdoor-replay pointed at an ACTIVEMONITOR_REPLAY_TRACE journal
+# that is empty or restored fresh (torn chain): a structured skip, not
+# a bogus zero-request measurement
+SKIP_NO_TRACE = "no-trace"
 
 # schedule tokens an accepts_schedule op can honor: "auto" (the
 # autotune decision table) plus the zoo tokens the tuned dispatch
@@ -210,6 +214,13 @@ OPS: Dict[str, OpDef] = {
     "serving": OpDef(
         "serving", ("model",), ("float32",), accepts_batch=True
     ),
+    # recorded front-door traffic replayed through the real submit path
+    # (obs/replay.py over obs/journal.py's arrival stream): the bench
+    # measures the traffic users actually sent, not a synthetic Poisson
+    # stand-in. Single-chip and jax-free (the workload is admission +
+    # coalescing arithmetic); float32-only so the spec's bf16 column
+    # exercises the unsupported-dtype skip like decode's.
+    "frontdoor-replay": OpDef("frontdoor-replay", (), ("float32",)),
 }
 
 
@@ -290,7 +301,7 @@ DEFAULT_SPEC: dict = {
     "version": MATRIX_VERSION,
     "ops": [
         "flash", "ring", "moe", "pipeline", "decode", "training-step",
-        "hier-allreduce", "serving",
+        "hier-allreduce", "serving", "frontdoor-replay",
     ],
     "meshes": [
         {"sp": 8},
@@ -938,6 +949,100 @@ def _run_serving(cell: CellSpec, _iters: int, timer) -> CellResult:
     )
 
 
+# canonical seeded workload for a frontdoor-replay cell with no
+# recorded trace wired: a record→replay round trip over this schedule,
+# so the cell still measures the replay machinery deterministically
+REPLAY_CANON_REQUESTS = 64
+REPLAY_CANON_RATE_RPS = 200.0
+REPLAY_CANON_SEED = 17
+REPLAY_CANON_CHECKS = ("bench/hc-a", "bench/hc-b", "bench/hc-c")
+
+
+def _run_frontdoor_replay(cell: CellSpec, _iters: int, timer) -> CellResult:
+    # _iters: the schedule already carries its own request count.
+    # jax-free on purpose: the workload is the front door's pure-python
+    # admission + coalescing path, so the cell runs on any platform.
+    import asyncio
+    import os
+
+    from activemonitor_tpu.frontdoor.traffic import (
+        open_loop_checks,
+        replayed_checks,
+    )
+    from activemonitor_tpu.obs.replay import (
+        RecordedArrivals,
+        drive_requests,
+        load_trace,
+    )
+
+    trace_dir = os.environ.get("ACTIVEMONITOR_REPLAY_TRACE", "")
+    if trace_dir:
+        schedule, warnings = load_trace(trace_dir)
+        if warnings:
+            raise _CellSkip(
+                SKIP_NO_TRACE,
+                f"trace at {trace_dir} restored fresh: "
+                f"{warnings[0].get('reason')}",
+            )
+        if not len(schedule):
+            raise _CellSkip(
+                SKIP_NO_TRACE, f"no arrival events journaled in {trace_dir}"
+            )
+        source = trace_dir
+    else:
+        # no recorded trace: a canonical seeded schedule recorded into
+        # an in-memory trace and replayed — the same round trip, so the
+        # baseline tracks the replay machinery either way
+        seeded = open_loop_checks(
+            REPLAY_CANON_REQUESTS,
+            REPLAY_CANON_RATE_RPS,
+            seed=REPLAY_CANON_SEED,
+            checks=REPLAY_CANON_CHECKS,
+        )
+        events = []
+        prev = 0.0
+        for req in seeded:
+            events.append(
+                {
+                    "tenant": req.tenant,
+                    "check": req.check,
+                    "gap": req.arrival - prev,
+                    "freshness": req.freshness,
+                }
+            )
+            prev = req.arrival
+        schedule = RecordedArrivals(events)
+        source = "canonical-seeded"
+    requests = replayed_checks(schedule)
+    started = timer()
+    summary = asyncio.run(drive_requests(requests))
+    elapsed = max(timer() - started, 1e-9)
+    seconds = elapsed / len(requests)
+    if not summary["conservation_ok"]:
+        return CellResult(
+            cell,
+            STATUS_ERROR,
+            reason="per-tenant conservation violated during replay",
+        )
+    # no analytic FLOP/byte model: the roofline entry reports its
+    # structured no-cost-model reason, same as any costless cell
+    return CellResult(
+        cell,
+        STATUS_OK,
+        value=seconds,
+        seconds=seconds,
+        details={
+            "replay": {
+                "source": source,
+                "requests": summary["requests"],
+                "tenant_mix": summary["tenant_mix"],
+                "outcomes": summary["outcome_counts"],
+                "conserved": True,
+            }
+        },
+    )
+
+
 _RUNNERS: Dict[str, Callable] = {
     "flash": _run_flash,
     "ring": _run_ring,
@@ -947,6 +1052,7 @@ _RUNNERS: Dict[str, Callable] = {
     "training-step": _run_training_step,
     "hier-allreduce": _run_hier_allreduce,
     "serving": _run_serving,
+    "frontdoor-replay": _run_frontdoor_replay,
 }
 
 

@@ -91,6 +91,8 @@ class Manager:
         goodput_interval: float = 30.0,  # rollup cadence; big fleets raise it
         flight_dir: str = "",  # durable flight-bundle JSONL dir; "" = memory only
         frontdoor=None,  # FrontDoor: probe-as-a-service ingestion surface
+        journal_dir: str = "",  # durable telemetry journal dir; "" = no journal
+        journal_max_bytes: int = 0,  # per-segment byte cap; 0 = journal default
     ):
         self.client = client
         self.reconciler = reconciler
@@ -133,6 +135,29 @@ class Manager:
                 # key and this replica's rings never see the owner's
                 # results, so the waiters would hang until reap
                 frontdoor.owns = shard_coordinator.owns_key
+        # --journal-dir (obs/journal.py): the durable telemetry journal.
+        # Replay-then-subscribe via attach_journal restores the SLO /
+        # goodput windows the restart would otherwise lose, the front
+        # door records its arrival stream (the workload trace), the
+        # goodput loop exports the gauges + compacts aged segments, and
+        # the snapshot rides /statusz.
+        self._journal = None
+        if journal_dir:
+            from activemonitor_tpu.obs.journal import (
+                DEFAULT_MAX_BYTES,
+                TelemetryJournal,
+            )
+
+            journal = TelemetryJournal(
+                journal_dir,
+                clock=reconciler.clock,
+                max_bytes=journal_max_bytes or DEFAULT_MAX_BYTES,
+                metrics=reconciler.metrics,
+            )
+            self._journal = journal
+            reconciler.fleet.attach_journal(journal)
+            if frontdoor is not None:
+                frontdoor.journal = journal
         # fleet-wide remedy storm control (--remedy-rate) lives in the
         # reconciler's resilience coordinator. Sharded fleets apportion
         # the FLEET rate by owned shards (rate × owned/N, re-applied on
@@ -528,6 +553,11 @@ class Manager:
                 # sidecar's latest round into the healthcheck_matrix_*
                 # families, once per new round
                 self.reconciler.fleet.refresh_matrix_metrics()
+                # journal level gauges (--journal-dir) + compaction of
+                # aged-out segments — rollup-cadence work like the rest
+                self.reconciler.fleet.refresh_journal_metrics()
+                if self._journal is not None:
+                    self._journal.compact()
                 if self._shards is not None:
                     # per-shard ownership counts for /statusz and the
                     # healthcheck_shard_checks gauge (rollup work too)

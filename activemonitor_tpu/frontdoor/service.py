@@ -177,6 +177,14 @@ class FrontDoor:
         # (or parking) here would strand the waiters until reap
         self.owns: Optional[Callable[[str], bool]] = None
         self._rid = 0
+        # durable workload trace (obs/journal.py), wired by the Manager
+        # post-construction like the backend/ownership hooks: every
+        # submit's decision is journaled as one `arrival` event from
+        # _account — the single point every outcome passes through
+        self.journal = None
+        self._last_arrival: Optional[float] = None
+        # the DAG shape note for arrival events submitted via run_dag
+        self._dag_shape: Optional[dict] = None
         self._tallies: Dict[str, _Tally] = {}
         # fleet-wide running totals in lockstep with the per-tenant
         # tallies, so the per-submit gauge refresh is O(1), not a walk
@@ -348,23 +356,35 @@ class FrontDoor:
         its downstream steps are never submitted (reported absent in
         the returned map, so the caller sees exactly how far it got)."""
         tickets: Dict[str, Ticket] = {}
-        for stage in dag.stages():
-            stage_tickets = [
-                (step, self.submit(tenant, step.check, step.freshness))
-                for step in stage
-            ]
-            for step, ticket in stage_tickets:
-                tickets[step.name] = ticket
-            results = await asyncio.gather(
-                *(t.wait() for _s, t in stage_tickets),
-                return_exceptions=True,
-            )
-            for (step, ticket), outcome in zip(stage_tickets, results):
-                if ticket.outcome == OUTCOME_REFUSED or isinstance(
-                    outcome, BaseException
-                ):
-                    return tickets  # stop: downstream is meaningless
-        return tickets
+        stages = dag.stages()
+        # stamp the DAG shape on every arrival event this execution
+        # journals (the workload trace records the *structure* of the
+        # demand, not just its flat request stream)
+        self._dag_shape = {
+            "name": getattr(dag, "name", ""),
+            "steps": sum(len(stage) for stage in stages),
+            "stages": len(stages),
+        }
+        try:
+            for stage in stages:
+                stage_tickets = [
+                    (step, self.submit(tenant, step.check, step.freshness))
+                    for step in stage
+                ]
+                for step, ticket in stage_tickets:
+                    tickets[step.name] = ticket
+                results = await asyncio.gather(
+                    *(t.wait() for _s, t in stage_tickets),
+                    return_exceptions=True,
+                )
+                for (step, ticket), outcome in zip(stage_tickets, results):
+                    if ticket.outcome == OUTCOME_REFUSED or isinstance(
+                        outcome, BaseException
+                    ):
+                        return tickets  # stop: downstream is meaningless
+            return tickets
+        finally:
+            self._dag_shape = None
 
     # -- degraded-mode pump ---------------------------------------------
     def pump(self) -> int:
@@ -484,6 +504,27 @@ class FrontDoor:
         return self._qps_last
 
     def _account(self, ticket: Ticket, started: float, booked: str) -> None:
+        if self.journal is not None:
+            gap = (
+                started - self._last_arrival
+                if self._last_arrival is not None
+                else 0.0
+            )
+            self._last_arrival = started
+            # never raises by the journal's own contract, but the
+            # submit path tolerates a hostile duck-typed journal too
+            try:
+                self.journal.record_arrival(
+                    tenant=booked,
+                    check=ticket.check,
+                    outcome=ticket.outcome,
+                    gap=gap,
+                    reason=ticket.reason,
+                    shard=ticket.shard,
+                    dag=self._dag_shape,
+                )
+            except Exception:
+                log.exception("arrival journaling failed")
         # metric labels carry the BOOKED name — bounded by the
         # admission config even on an open endpoint
         if self.metrics is not None:

@@ -64,3 +64,26 @@ def open_loop_checks(
             )
         )
     return out
+
+
+def replayed_checks(schedule) -> List[CheckRequest]:
+    """A recorded trace as front-door requests: same emission shape as
+    :func:`open_loop_checks`, but arrivals/tenants/checks come from a
+    :class:`~activemonitor_tpu.obs.replay.RecordedArrivals` schedule
+    (draw order per its contract: ``next()``, then tenant, then check)
+    instead of a seeded Poisson process. Same recording ⇒
+    byte-identical request list — replay's half of the determinism
+    contract."""
+    out: List[CheckRequest] = []
+    for rid in range(len(schedule)):
+        now = schedule.next()
+        out.append(
+            CheckRequest(
+                rid=rid,
+                tenant=schedule.choice(schedule.tenants),
+                arrival=now,
+                check=schedule.choice(schedule.checks),
+                freshness=schedule.freshness,
+            )
+        )
+    return out

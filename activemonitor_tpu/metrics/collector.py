@@ -613,6 +613,53 @@ class MetricsCollector:
             registry=self.registry,
             buckets=_FRONTDOOR_ADMISSION_BUCKETS,
         )
+        # -- durable-journal families (obs/journal.py is the single
+        # writer; docs/observability.md "Durable telemetry journal").
+        # Stream cardinality is the fixed three-stream vocabulary
+        # (result / attribution / arrival).
+        self.journal_appended = Counter(
+            "healthcheck_journal_appended_total",
+            "Telemetry-journal events appended per stream (result / "
+            "attribution / arrival) — the durable tail the next boot "
+            "replays its SLO windows and workload trace from",
+            ["stream"],
+            registry=self.registry,
+        )
+        self.journal_replayed = Counter(
+            "healthcheck_journal_replayed_total",
+            "Telemetry-journal events replayed into the fresh rings at "
+            "boot, per stream; zero on a first boot or after a "
+            "fresh-restore (see the journal block's restore_warning)",
+            ["stream"],
+            registry=self.registry,
+        )
+        self.journal_dropped = Counter(
+            "healthcheck_journal_dropped_total",
+            "Telemetry-journal events lost to append failures (full "
+            "disk, unwritable directory) or skipped during replay — "
+            "durability cost, never a recording-path failure",
+            registry=self.registry,
+        )
+        self.journal_segments = Gauge(
+            "healthcheck_journal_segments",
+            "Journal segments currently on disk (size-capped rotation, "
+            "compaction drops the oldest beyond --journal-max-bytes × "
+            "the retained-segment cap)",
+            registry=self.registry,
+        )
+        self.journal_lag_seconds = Gauge(
+            "healthcheck_journal_lag_seconds",
+            "Seconds between now and the newest journaled event — how "
+            "much window a crash right now would lose",
+            registry=self.registry,
+        )
+        # children pre-resolved: the journal appends on the reconciler's
+        # record path and the front door's submit path — same hot-path
+        # hygiene as the coalesce-ratio gauges above
+        self._journal_appended = {
+            stream: self.journal_appended.labels(stream)
+            for stream in ("result", "attribution", "arrival")
+        }
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -988,6 +1035,26 @@ class MetricsCollector:
 
     def observe_frontdoor_admission(self, seconds: float) -> None:
         self.frontdoor_admission_seconds.observe(seconds)
+
+    # -- durable journal (obs/journal.py is the single writer) ---------
+    def record_journal_append(self, stream: str) -> None:
+        child = self._journal_appended.get(stream)
+        if child is None:
+            child = self.journal_appended.labels(stream)
+        child.inc()
+
+    def record_journal_replayed(self, stream: str, n: int = 1) -> None:
+        if n > 0:
+            self.journal_replayed.labels(stream).inc(n)
+
+    def record_journal_dropped(self) -> None:
+        self.journal_dropped.inc()
+
+    def set_journal_segments(self, count: int) -> None:
+        self.journal_segments.set(count)
+
+    def set_journal_lag(self, seconds: float) -> None:
+        self.journal_lag_seconds.set(max(0.0, seconds))
 
     # -- dynamic custom metrics ---------------------------------------
     # recorded-run memory bound: at one run a second this is ~34 min of

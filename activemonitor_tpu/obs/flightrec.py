@@ -62,6 +62,12 @@ SPAN_TAIL = 20  # fallback span excerpt when no trace is active
 RESULT_TAIL = 10  # result-ring excerpt per bundle
 
 FLIGHT_FILE = "flightrec.jsonl"
+# size-capped rotation for the durable sink (same discipline as the
+# telemetry journal's segments): the active file stays FLIGHT_FILE —
+# what the tests and jq pipelines read — and aged content shifts to
+# flightrec-1.jsonl, flightrec-2.jsonl, … with the oldest dropped
+DEFAULT_MAX_BYTES = 4 << 20
+DEFAULT_KEEP_ROTATIONS = 4
 
 
 class FlightRecorder:
@@ -76,9 +82,11 @@ class FlightRecorder:
         clock: Optional[Clock] = None,
         capacity: int = DEFAULT_CAPACITY,
         flight_dir: str = "",
+        max_bytes: int = DEFAULT_MAX_BYTES,
     ):
         self.clock = clock or Clock()
         self.flight_dir = flight_dir
+        self.max_bytes = max(0, int(max_bytes))
         self._ring: Deque[dict] = collections.deque(maxlen=max(1, capacity))
         self._seq = 0
         self.tracer = None
@@ -165,12 +173,18 @@ class FlightRecorder:
 
     def _persist(self, bundle: dict) -> None:
         """Append one JSONL line to ``flight_dir``; best-effort (an
-        unwritable disk costs durability, never the transition)."""
+        unwritable disk costs durability, never the transition). The
+        sink is size-capped: at ``max_bytes`` the active file rotates
+        (journal.rotate_capped) so a long-lived controller's flight
+        directory is bounded like its in-memory ring."""
         if not self.flight_dir:
             return
         try:
+            from activemonitor_tpu.obs.journal import rotate_capped
+
             os.makedirs(self.flight_dir, exist_ok=True)
             path = os.path.join(self.flight_dir, FLIGHT_FILE)
+            rotate_capped(path, self.max_bytes, keep=DEFAULT_KEEP_ROTATIONS)
             with open(path, "a") as f:
                 f.write(json.dumps(bundle, default=str) + "\n")
         except OSError:

@@ -143,6 +143,19 @@ class ResultHistory:
                 log.exception("result subscriber failed for %s", key)
         return result
 
+    def restore(self, key: str, result: CheckResult) -> None:
+        """Append an already-built result WITHOUT stamping a timestamp
+        and WITHOUT notifying subscribers — the journal's boot-replay
+        path (obs/journal.py). Replayed results keep the timestamps
+        they were recorded with (the windows must survive the restart
+        unchanged), and the journal itself is a subscriber: notifying
+        here would re-journal every replayed event — the double-count
+        the split record/restore API exists to prevent."""
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = self._rings[key] = collections.deque(maxlen=self._capacity)
+        ring.append(result)
+
     def results(self, key: str) -> List[CheckResult]:
         """All retained results for a check, oldest first."""
         return list(self._rings.get(key, ()))

@@ -1,0 +1,516 @@
+"""Durable telemetry journal: restart-proof observability windows.
+
+Every window built in PRs 1–15 — result rings, SLO availability,
+error-budget burn, goodput attribution, front-door ledgers — lives in
+bounded in-memory deques and dies with the process. The journal is the
+append-only sidecar that makes the "measure" leg of ML Productivity
+Goodput (PAPERS.md) survive a restart: three event streams recorded at
+their EXISTING choke points (no new call sites), replayed into the
+fresh rings on boot, and doubling as the workload-trace recorder the
+replay bench (obs/replay.py, the ``frontdoor-replay`` matrix op)
+consumes.
+
+Streams (the ``stream`` field of every line):
+
+- ``result`` — one finished check run, the full
+  :class:`~activemonitor_tpu.obs.history.CheckResult` wire dict plus
+  its ``key``. Tapped via ``ResultHistory.subscribe`` — the hook PR 15
+  added for the coalescing cache — so the reconciler's record path is
+  untouched.
+- ``attribution`` — the lost-goodput bucket/why-line stamped on the
+  same record path (``result.bucket`` non-empty). Redundant with the
+  result stream BY DESIGN: ``hack/journal_check.py`` cross-checks the
+  two (conservation across streams) so a dropped line cannot silently
+  skew the attribution decomposition.
+- ``arrival`` — one front-door submission: booked tenant, check key,
+  outcome, refusal reason, shard, inter-arrival gap and (when the
+  submit came from ``run_dag``) the DAG shape. This is the workload
+  trace ROADMAP item 6 asks for.
+
+Wire format: segmented JSONL. Segments are ``journal-000001.jsonl``,
+``journal-000002.jsonl``, … — a contiguous chain whose highest sequence
+number is the active segment. Every segment opens with a header line
+``{"v": 1, "stream": "header", "segment": N, "ts": …}``; every event
+line carries the same ``"v"`` so version skew is detected per line.
+Rotation is size-capped (``max_bytes`` per segment); compaction drops
+the oldest segments beyond ``max_segments`` so the sidecar directory is
+bounded like every other ring in the repo.
+
+Restore discipline (the ``analysis/baseline.py`` ``load_blob``
+contract): :func:`read_journal` either returns the full event list with
+no warnings, or returns NOTHING plus a structured warning —
+``{"reason": "version-skew" | "corrupt-line" | "missing-segment" |
+"corrupt-header" | "unreadable", "detail": …}``. A torn journal
+restores FRESH: partially applying a corrupt chain is how windows
+silently double-count, and a fresh window is merely short, never wrong.
+(The writer flushes whole lines, so a SIGKILL between events leaves a
+clean chain — the fresh-restore path is for real corruption, not for
+ordinary crashes.)
+
+Design constraints shared with the rest of ``obs/``: **injectable
+Clock** (``hack/lint.py`` bans wall-clock reads in this module, same
+module-name keying as ``flightrec.py``) and **never raises into the
+recording path** — a full disk costs durability and increments the
+``dropped`` counter, never the reconcile or the submit that fed it.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from activemonitor_tpu.obs.history import CheckResult
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.journal")
+
+JOURNAL_VERSION = 1
+
+STREAM_RESULT = "result"
+STREAM_ATTRIBUTION = "attribution"
+STREAM_ARRIVAL = "arrival"
+STREAMS = (STREAM_RESULT, STREAM_ATTRIBUTION, STREAM_ARRIVAL)
+
+# header pseudo-stream: the first line of every segment
+STREAM_HEADER = "header"
+
+SEGMENT_PREFIX = "journal-"
+SEGMENT_SUFFIX = ".jsonl"
+_SEGMENT_RE = re.compile(r"^journal-(\d{6})\.jsonl$")
+
+# one segment's byte cap before rotation; small enough that compaction
+# granularity is useful, large enough that a day of 60 s-cadence checks
+# fits in a handful of segments
+DEFAULT_MAX_BYTES = 1 << 20
+# segments retained by compaction (cap × count bounds the directory)
+DEFAULT_MAX_SEGMENTS = 8
+
+
+def segment_name(seq: int) -> str:
+    return f"{SEGMENT_PREFIX}{seq:06d}{SEGMENT_SUFFIX}"
+
+
+def list_segments(journal_dir: str) -> List[Tuple[int, str]]:
+    """``(seq, absolute path)`` for every segment, oldest first."""
+    try:
+        names = os.listdir(journal_dir)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _SEGMENT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(journal_dir, name)))
+    out.sort()
+    return out
+
+
+def rotate_capped(path: str, max_bytes: int, keep: int = 4) -> bool:
+    """Size-capped shift rotation for a single-file JSONL sink (the
+    flight recorder's ``flightrec.jsonl``): when ``path`` has reached
+    ``max_bytes``, shift ``<stem>-(keep-1)`` off the end, bump every
+    ``<stem>-N`` to ``<stem>-(N+1)``, and move the active file to
+    ``<stem>-1`` — so ``path`` itself stays the active file the tests
+    and ``jq`` pipelines read. Returns True when a rotation happened.
+    Best-effort: an OSError costs the rotation, never the append."""
+    if max_bytes <= 0:
+        return False
+    try:
+        if not os.path.exists(path) or os.path.getsize(path) < max_bytes:
+            return False
+        stem, ext = os.path.splitext(path)
+        oldest = f"{stem}-{keep}{ext}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for n in range(keep - 1, 0, -1):
+            src = f"{stem}-{n}{ext}"
+            if os.path.exists(src):
+                os.replace(src, f"{stem}-{n + 1}{ext}")
+        os.replace(path, f"{stem}-1{ext}")
+        return True
+    except OSError:
+        log.exception("rotation failed for %s", path)
+        return False
+
+
+def _parse_ts(value) -> Optional[datetime.datetime]:
+    try:
+        ts = datetime.datetime.fromisoformat(str(value))
+    except (TypeError, ValueError):
+        return None
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=datetime.timezone.utc)
+    return ts
+
+
+def result_from_doc(doc: dict) -> CheckResult:
+    """Rebuild a :class:`CheckResult` from its journaled wire dict
+    (the ``to_dict`` spelling: ``latency_seconds``, isoformat ts)."""
+    ts = _parse_ts(doc.get("ts"))
+    if ts is None:
+        raise ValueError(f"unparseable result ts: {doc.get('ts')!r}")
+    return CheckResult(
+        ts=ts,
+        ok=bool(doc.get("ok")),
+        latency=max(0.0, float(doc.get("latency_seconds", 0.0))),
+        workflow=str(doc.get("workflow", "")),
+        trace_id=str(doc.get("trace_id", "")),
+        metrics={str(k): float(v) for k, v in (doc.get("metrics") or {}).items()},
+        timings={str(k): float(v) for k, v in (doc.get("timings") or {}).items()},
+        roofline=dict(doc.get("roofline") or {}),
+        bucket=str(doc.get("bucket", "")),
+        why=str(doc.get("why", "")),
+    )
+
+
+def read_journal(journal_dir: str) -> Tuple[List[dict], List[dict]]:
+    """Read every event from a journal directory, oldest first.
+
+    Returns ``(events, warnings)``. All-or-nothing per the module
+    docstring: any warning means ``events`` is empty (restore fresh).
+    An absent or empty directory is a clean first boot — no events, no
+    warning."""
+    segments = list_segments(journal_dir)
+    if not segments:
+        return [], []
+    seqs = [seq for seq, _ in segments]
+    expected = list(range(seqs[0], seqs[0] + len(seqs)))
+    if seqs != expected:
+        missing = sorted(set(range(seqs[0], seqs[-1] + 1)) - set(seqs))
+        return [], [
+            {
+                "reason": "missing-segment",
+                "detail": (
+                    f"chain {seqs[0]}..{seqs[-1]} is missing segment(s) "
+                    f"{missing}"
+                ),
+            }
+        ]
+    events: List[dict] = []
+    for seq, path in segments:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError as exc:
+            return [], [{"reason": "unreadable", "detail": f"{name}: {exc}"}]
+        if not lines:
+            return [], [{"reason": "corrupt-header", "detail": f"{name}: empty segment"}]
+        try:
+            header = json.loads(lines[0])
+        except ValueError:
+            return [], [
+                {"reason": "corrupt-header", "detail": f"{name}:1 is not JSON"}
+            ]
+        if not isinstance(header, dict) or header.get("stream") != STREAM_HEADER:
+            return [], [
+                {"reason": "corrupt-header", "detail": f"{name}:1 is not a header"}
+            ]
+        if header.get("v") != JOURNAL_VERSION:
+            return [], [
+                {
+                    "reason": "version-skew",
+                    "detail": (
+                        f"{name} is journal version {header.get('v')!r}, "
+                        f"this build reads {JOURNAL_VERSION}"
+                    ),
+                }
+            ]
+        for lineno, line in enumerate(lines[1:], start=2):
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                return [], [
+                    {
+                        "reason": "corrupt-line",
+                        "detail": f"{name}:{lineno} is truncated or not JSON",
+                    }
+                ]
+            if (
+                not isinstance(doc, dict)
+                or doc.get("v") != JOURNAL_VERSION
+                or doc.get("stream") not in STREAMS
+            ):
+                return [], [
+                    {
+                        "reason": "corrupt-line",
+                        "detail": f"{name}:{lineno} has no valid stream/version",
+                    }
+                ]
+            events.append(doc)
+    return events, []
+
+
+class TelemetryJournal:
+    """Append-only, segmented, never-raises telemetry sidecar.
+
+    One instance per journal directory, owned by the Manager (wired via
+    ``--journal-dir``); ``FleetStatus.attach_journal`` replays it into
+    the fresh rings and then subscribes :meth:`record_result` as a
+    result-history tap, and the front door records its arrival stream
+    through :meth:`record_arrival`."""
+
+    def __init__(
+        self,
+        journal_dir: str,
+        *,
+        clock: Optional[Clock] = None,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        max_segments: int = DEFAULT_MAX_SEGMENTS,
+        metrics=None,  # MetricsCollector (duck-typed; optional)
+    ):
+        if not journal_dir:
+            raise ValueError("journal_dir is required")
+        self.journal_dir = journal_dir
+        self.clock = clock or Clock()
+        self.max_bytes = max(1024, int(max_bytes))
+        self.max_segments = max(1, int(max_segments))
+        self.metrics = metrics
+        self.appended: Dict[str, int] = {s: 0 for s in STREAMS}
+        self.replayed: Dict[str, int] = {s: 0 for s in STREAMS}
+        self.dropped = 0
+        self.compacted_segments = 0
+        self.restore_warning: Optional[dict] = None
+        self._fh = None
+        self._bytes = 0
+        self._header_bytes = 0
+        # continue an existing chain: the next append rotates onto a
+        # NEW segment past the highest existing one, never appends into
+        # a segment an earlier incarnation may have torn
+        segments = list_segments(journal_dir)
+        self._seq = segments[-1][0] if segments else 0
+        # newest event's wall ts (isoformat) for the lag gauge
+        self._last_event_iso: Optional[str] = None
+
+    # -- recording taps --------------------------------------------------
+    def record_result(self, key: str, result: CheckResult) -> None:
+        """``ResultHistory.subscribe`` tap: journal the run, and — when
+        the record path stamped a lost-goodput bucket — the attribution
+        event alongside it."""
+        doc = dict(result.to_dict())
+        doc["key"] = key
+        self._append(STREAM_RESULT, doc)
+        if result.bucket:
+            self._append(
+                STREAM_ATTRIBUTION,
+                {
+                    "key": key,
+                    "ts": doc["ts"],
+                    "ok": result.ok,
+                    "bucket": result.bucket,
+                    "why": result.why,
+                },
+            )
+
+    def record_arrival(
+        self,
+        *,
+        tenant: str,
+        check: str,
+        outcome: str,
+        gap: float,
+        reason: str = "",
+        shard: int = 0,
+        freshness: Optional[float] = None,
+        dag: Optional[dict] = None,
+    ) -> None:
+        """One front-door submission (the workload trace). ``gap`` is
+        the inter-arrival gap in seconds on the door's monotonic
+        timeline; ``dag`` the shape dict when the submit came from
+        ``run_dag``."""
+        self._append(
+            STREAM_ARRIVAL,
+            {
+                "ts": self.clock.now().isoformat(),
+                "tenant": tenant,
+                "check": check,
+                "outcome": outcome,
+                "reason": reason,
+                "shard": int(shard),
+                "gap": max(0.0, float(gap)),
+                "freshness": freshness,
+                "dag": dag,
+            },
+        )
+
+    # -- the append path (never raises) ----------------------------------
+    def _append(self, stream: str, doc: dict) -> None:
+        try:
+            line = json.dumps(
+                {"v": JOURNAL_VERSION, "stream": stream, **doc}, default=str
+            )
+            self._ensure_segment(len(line) + 1)
+            self._fh.write(line + "\n")
+            # whole-line flush: a kill between appends leaves a clean
+            # chain, which is what makes fresh-restore-on-corruption an
+            # acceptable discipline (see module docstring)
+            self._fh.flush()
+            self._bytes += len(line) + 1
+            self.appended[stream] += 1
+            ts = doc.get("ts")
+            if ts:
+                self._last_event_iso = str(ts)
+            if self.metrics is not None:
+                self.metrics.record_journal_append(stream)
+        except Exception:
+            self.dropped += 1
+            log.exception("journal append failed (%s)", stream)
+            if self.metrics is not None:
+                try:
+                    self.metrics.record_journal_dropped()
+                except Exception:
+                    log.exception("journal drop counter failed")
+
+    def _ensure_segment(self, incoming: int) -> None:
+        if (
+            self._fh is not None
+            and self._bytes + incoming > self.max_bytes
+            # a segment always takes at least one event past its
+            # header, so an oversized single event cannot wedge the
+            # writer into rotating forever
+            and self._bytes > self._header_bytes
+        ):
+            self._fh.close()
+            self._fh = None
+        if self._fh is None:
+            os.makedirs(self.journal_dir, exist_ok=True)
+            self._seq += 1
+            path = os.path.join(self.journal_dir, segment_name(self._seq))
+            self._fh = open(path, "w")
+            header = json.dumps(
+                {
+                    "v": JOURNAL_VERSION,
+                    "stream": STREAM_HEADER,
+                    "segment": self._seq,
+                    "ts": self.clock.now().isoformat(),
+                }
+            )
+            self._fh.write(header + "\n")
+            self._fh.flush()
+            self._bytes = self._header_bytes = len(header) + 1
+            self.compact()
+
+    def compact(self) -> int:
+        """Drop the oldest segments beyond ``max_segments`` (never the
+        active one). Returns how many were removed; driven inline on
+        rotation and by the manager's goodput loop."""
+        removed = 0
+        try:
+            segments = list_segments(self.journal_dir)
+            while len(segments) > self.max_segments:
+                _seq, path = segments.pop(0)
+                os.remove(path)
+                removed += 1
+        except OSError:
+            log.exception("journal compaction failed in %s", self.journal_dir)
+        self.compacted_segments += removed
+        return removed
+
+    def close(self) -> None:
+        try:
+            if self._fh is not None:
+                self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+
+    # -- replay ----------------------------------------------------------
+    def replay_into(self, history=None) -> dict:
+        """Replay the journal tail into a fresh ``ResultHistory`` (and
+        count every stream). All-or-nothing: a torn chain restores
+        fresh and parks the structured warning on
+        :attr:`restore_warning` — never crashes, never double-counts.
+        Result events bypass ``ResultHistory.record`` (via
+        ``restore``) so replay re-stamps nothing and re-notifies no
+        subscriber — re-journaling the journal is the double-count this
+        API shape exists to prevent."""
+        events, warnings = read_journal(self.journal_dir)
+        counts = {s: 0 for s in STREAMS}
+        if warnings:
+            self.restore_warning = warnings[0]
+            log.warning("journal restored fresh: %s", warnings[0])
+            return {"replayed": counts, "warnings": warnings}
+        for doc in events:
+            stream = doc["stream"]
+            if stream == STREAM_RESULT and history is not None:
+                try:
+                    history.restore(doc["key"], result_from_doc(doc))
+                except Exception:
+                    # one unbuildable result (schema drift inside a
+                    # valid line) is dropped, counted, and logged —
+                    # the window stays conservative, never wrong
+                    self.dropped += 1
+                    log.exception("journal replay skipped a result")
+                    continue
+            counts[stream] += 1
+            self.replayed[stream] += 1
+            ts = doc.get("ts")
+            if ts:
+                self._last_event_iso = str(ts)
+        if self.metrics is not None:
+            for stream, n in counts.items():
+                if n:
+                    self.metrics.record_journal_replayed(stream, n)
+        return {"replayed": counts, "warnings": []}
+
+    # -- surfaces --------------------------------------------------------
+    def lag_seconds(self) -> float:
+        """Seconds between now and the newest journaled event — how
+        stale the durable tail is. 0.0 before any event."""
+        ts = _parse_ts(self._last_event_iso) if self._last_event_iso else None
+        if ts is None:
+            return 0.0
+        return max(0.0, (self.clock.now() - ts).total_seconds())
+
+    def segments(self) -> List[dict]:
+        out = []
+        for seq, path in list_segments(self.journal_dir):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            out.append(
+                {
+                    "segment": seq,
+                    "name": os.path.basename(path),
+                    "bytes": size,
+                    "active": seq == self._seq,
+                }
+            )
+        return out
+
+    def snapshot(self) -> dict:
+        """The /statusz fleet ``journal`` block (rollup_statusz merges
+        these across replicas via ``merge_journal_blocks``)."""
+        segments = self.segments()
+        return {
+            "dir": self.journal_dir,
+            "segments": segments,
+            "segment_count": len(segments),
+            "max_bytes": self.max_bytes,
+            "max_segments": self.max_segments,
+            "appended": dict(self.appended),
+            "replayed": dict(self.replayed),
+            "dropped": self.dropped,
+            "compacted_segments": self.compacted_segments,
+            "lag_seconds": self.lag_seconds(),
+            "restore_warning": self.restore_warning,
+        }
+
+    def export_gauges(self) -> None:
+        """Refresh the level gauges (segment count, lag) — driven by
+        the manager's goodput loop next to the fleet-goodput refresh;
+        the counters increment at append/replay/drop time."""
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.set_journal_segments(len(list_segments(self.journal_dir)))
+            self.metrics.set_journal_lag(self.lag_seconds())
+        except Exception:
+            log.exception("journal gauge export failed")

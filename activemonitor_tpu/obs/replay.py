@@ -1,0 +1,216 @@
+"""Deterministic replay of a recorded front-door workload trace.
+
+The journal's ``arrival`` stream (obs/journal.py) is the workload
+trace ROADMAP item 6 asks for: every front-door submission with its
+booked tenant, check key, outcome and inter-arrival gap. This module
+turns that trace back into load:
+
+- :class:`RecordedArrivals` — a deterministic arrival schedule with
+  the SAME interface as ``scheduler/arrivals.PoissonArrivals``
+  (``next()`` / ``choice()`` / ``now``), so
+  ``frontdoor/traffic.replayed_checks`` emits ``CheckRequest``s the
+  exact way ``open_loop_checks`` does, just from the recording instead
+  of a seeded Poisson process.
+- :func:`load_trace` — journal directory → schedule + the structured
+  restore warnings (``load_blob`` discipline, via ``read_journal``).
+- :func:`drive_requests` — the shared FakeClock harness that pushes a
+  schedule through a real ``FrontDoor`` (admission → coalescing →
+  trigger) with a synthetic always-ok backend, recording through a
+  journal when one is wired. The ``am-tpu record``/``replay`` verbs,
+  the ``frontdoor-replay`` matrix op and the acceptance tests all
+  drive THIS function, so "replay is deterministic" is one property
+  proven in one place.
+
+Determinism contract, mirroring PoissonArrivals': one pass, fixed draw
+order per request — ``next()`` (arrival time from the recorded gap),
+then ``choice(tenants)`` (the recorded tenant), then ``choice(checks)``
+(the recorded check). ``choice`` answers from the recording when the
+recorded value is in the offered universe and falls back to the first
+element otherwise (a trace replayed against a shrunken check set stays
+deterministic instead of crashing).
+
+Wall-clock-free by construction (``hack/lint.py`` bans ``time.time()``
+/ ``time.monotonic()`` here, same module-name keying as journal.py):
+the schedule lives on the recorded timeline and the harness lives on a
+FakeClock advanced to each arrival.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from activemonitor_tpu.obs.journal import STREAM_ARRIVAL, read_journal
+
+# synthetic backend latency the drive harness stamps on every resolved
+# run — any positive constant works, the point is that it is the SAME
+# for record and replay so outcome sequences compare bit-exactly
+DRIVE_LATENCY_SECONDS = 0.01
+
+
+class RecordedArrivals:
+    """A recorded arrival stream as a deterministic schedule.
+
+    ``events`` are journal ``arrival`` dicts (or anything with
+    ``tenant``/``check``/``gap`` keys), oldest first."""
+
+    def __init__(self, events: Sequence[dict]):
+        self._events: List[dict] = [
+            {
+                "tenant": str(ev.get("tenant", "")),
+                "check": str(ev.get("check", "")),
+                "gap": max(0.0, float(ev.get("gap", 0.0) or 0.0)),
+                "freshness": ev.get("freshness"),
+            }
+            for ev in events
+        ]
+        self.now = 0.0
+        self._i = -1
+        # the pending replay draws for the current request, popped by
+        # choice() in the documented order: tenant first, then check
+        self._pending: List[str] = []
+        self.tenants: Tuple[str, ...] = tuple(
+            sorted({ev["tenant"] for ev in self._events if ev["tenant"]})
+        )
+        self.checks: Tuple[str, ...] = tuple(
+            sorted({ev["check"] for ev in self._events if ev["check"]})
+        )
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    @property
+    def freshness(self) -> Optional[float]:
+        """The current request's recorded per-request freshness
+        override (None: the door default was used)."""
+        if 0 <= self._i < len(self._events):
+            value = self._events[self._i]["freshness"]
+            return float(value) if value is not None else None
+        return None
+
+    def next(self) -> float:
+        """The next recorded arrival time (cumulative gaps), advancing
+        to the next recorded request — PoissonArrivals.next()'s
+        contract on the recorded timeline."""
+        self._i += 1
+        if self._i >= len(self._events):
+            raise IndexError("recorded trace exhausted")
+        event = self._events[self._i]
+        self.now += event["gap"]
+        self._pending = [event["tenant"], event["check"]]
+        return self.now
+
+    def choice(self, seq: Sequence[str]) -> str:
+        """The recorded draw when it is in ``seq``; deterministic
+        fallback (first element) otherwise — PoissonArrivals.choice()'s
+        signature without the rng."""
+        options = tuple(seq)
+        if not options:
+            raise IndexError("choice from an empty sequence")
+        if self._pending:
+            want = self._pending.pop(0)
+            if want in options:
+                return want
+        return options[0]
+
+    def coverage(self) -> dict:
+        """The replay-coverage summary the ``am-tpu journal`` verb
+        prints: how much recorded traffic a replay would reproduce."""
+        return {
+            "events": len(self._events),
+            "span_seconds": sum(ev["gap"] for ev in self._events),
+            "tenants": list(self.tenants),
+            "checks": list(self.checks),
+        }
+
+
+def load_trace(journal_dir: str) -> Tuple[RecordedArrivals, List[dict]]:
+    """Journal directory → (schedule, warnings). A torn journal yields
+    an EMPTY schedule plus the structured warning (never a partial
+    trace — same all-or-nothing discipline as the boot replay)."""
+    events, warnings = read_journal(journal_dir)
+    arrivals = [ev for ev in events if ev.get("stream") == STREAM_ARRIVAL]
+    return RecordedArrivals(arrivals), warnings
+
+
+async def drive_requests(
+    requests,
+    *,
+    journal=None,
+    quota_per_minute: float = 1_000_000.0,
+    default_freshness: float = 30.0,
+) -> dict:
+    """Push ``CheckRequest``s through a real front door on a FakeClock.
+
+    Builds the full submit path — AdmissionController → CoalescingCache
+    → trigger — with a synthetic backend that records an ok result
+    (fixed :data:`DRIVE_LATENCY_SECONDS`) immediately after each
+    submit, so runs resolve, later duplicates ride the cache, and the
+    whole drive is a deterministic function of the request sequence.
+    When ``journal`` is wired the door records its arrival stream
+    through it (the ``am-tpu record`` path)."""
+    from activemonitor_tpu.frontdoor.admission import (
+        AdmissionController,
+        TenantQuota,
+    )
+    from activemonitor_tpu.frontdoor.service import FrontDoor
+    from activemonitor_tpu.obs.history import ResultHistory
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    history = ResultHistory(clock)
+    door = FrontDoor(
+        history,
+        AdmissionController(
+            default_quota=TenantQuota(rate_per_minute=quota_per_minute),
+            clock=clock,
+        ),
+        clock=clock,
+        default_freshness=default_freshness,
+    )
+    if journal is not None:
+        door.journal = journal
+    triggered: List[str] = []
+    door.bind(lambda ns, name: triggered.append(f"{ns}/{name}"))
+
+    outcomes: List[str] = []
+    tenants: List[str] = []
+    checks: List[str] = []
+    arrivals: List[float] = []
+    tenant_mix: Dict[str, int] = {}
+    n = 0
+    for req in requests:
+        n += 1
+        ahead = req.arrival - clock.monotonic()
+        if ahead > 0:
+            await clock.advance(ahead)
+        ticket = door.submit(req.tenant, req.check, req.freshness)
+        while triggered:
+            key = triggered.pop(0)
+            history.record(
+                key,
+                ok=True,
+                latency=DRIVE_LATENCY_SECONDS,
+                workflow="replay-drive",
+                trace_id=f"replay-{req.rid}",
+            )
+        await ticket.wait()
+        outcomes.append(ticket.outcome)
+        tenants.append(req.tenant)
+        checks.append(req.check)
+        arrivals.append(req.arrival)
+        tenant_mix[req.tenant] = tenant_mix.get(req.tenant, 0) + 1
+    conservation = door.conservation()
+    return {
+        "requests": n,
+        "outcomes": outcomes,
+        "tenants": tenants,
+        "checks": checks,
+        "arrivals": arrivals,
+        "tenant_mix": dict(sorted(tenant_mix.items())),
+        "outcome_counts": {
+            outcome: outcomes.count(outcome) for outcome in sorted(set(outcomes))
+        },
+        "conservation": conservation,
+        "conservation_ok": conservation["ok"],
+        "snapshot": door.snapshot(),
+    }

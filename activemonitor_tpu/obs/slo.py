@@ -225,6 +225,11 @@ class FleetStatus:
         # per-tenant refusal snapshot rides the fleet block. None (no
         # front door) reports frontdoor: null.
         self.frontdoor = None
+        # wired by the manager (--journal-dir) via attach_journal: the
+        # durable telemetry journal (obs/journal.py) whose segment /
+        # stream-count snapshot rides the fleet block. None (no
+        # journal) reports journal: null.
+        self.journal = None
         # generated_at of the last round exported to the gauges, so the
         # rollup loop re-serving an unchanged sidecar never
         # double-counts the bisect counter
@@ -572,6 +577,10 @@ class FleetStatus:
                 # QPS, coalescing ratios, queue depth, per-tenant
                 # refusals; null when no front door is wired
                 "frontdoor": self.check_frontdoor(),
+                # durable telemetry journal (obs/journal.py): segment
+                # table, per-stream appended/replayed counts, lag;
+                # null when no --journal-dir is wired
+                "journal": self.check_journal(),
             },
             "checks": entries,
         }
@@ -586,6 +595,46 @@ class FleetStatus:
         except Exception:
             log.exception("frontdoor snapshot failed")
             return None
+
+    def attach_journal(self, journal) -> None:
+        """Wire the durable telemetry journal: replay its tail into the
+        fresh result history FIRST (restoring the windows the SLO /
+        goodput math reads), then subscribe the journal's result tap —
+        strictly in that order, so replayed events are never
+        re-journaled (the double-count the record/restore split in
+        ResultHistory exists to prevent). Replayed results also restore
+        the per-check last-status map the /statusz summaries read."""
+        self.journal = journal
+        journal.replay_into(self.history)
+        for key in self.history.checks():
+            last = self.history.last(key)
+            if last is not None:
+                self._last_status[key] = "Succeeded" if last.ok else "Failed"
+        self.history.subscribe(journal.record_result)
+
+    def check_journal(self) -> Optional[dict]:
+        """The journal's snapshot, or None (not wired / a snapshot
+        error — observability must not fail the payload)."""
+        if self.journal is None:
+            return None
+        try:
+            return self.journal.snapshot()
+        except Exception:
+            log.exception("journal snapshot failed")
+            return None
+
+    def refresh_journal_metrics(self) -> None:
+        """Export the journal's level gauges (segment count, lag
+        seconds) into the pinned ``healthcheck_journal_*`` families —
+        driven by the manager's goodput loop; the per-event counters
+        increment on the append/replay paths themselves. A controller
+        without ``--journal-dir`` is a no-op."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.export_gauges()
+        except Exception:
+            log.exception("journal gauge export failed")
 
     def check_matrix(self) -> Optional[dict]:
         """The matrix source's latest round summary, or None (no source
@@ -690,6 +739,10 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     # of the ingestion traffic, so fleet QPS/requests/refusals are the
     # totals and the coalescing ratios re-derive lookup-weighted
     frontdoor_blocks: List[dict] = []
+    # journal blocks SUM their event counters (each replica journals
+    # its own slice), lag is the fleet's worst, and any replica's
+    # restore warning surfaces (first-seen wins)
+    journal_blocks: List[dict] = []
     # fleet goodput: the run-weighted mean of the REPLICAS' own ratios,
     # each derived from its history + declared SLO windows — the same
     # definition a single /statusz reports, so the number doesn't
@@ -745,6 +798,9 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
         replica_frontdoor = fleet.get("frontdoor")
         if isinstance(replica_frontdoor, dict):
             frontdoor_blocks.append(replica_frontdoor)
+        replica_journal = fleet.get("journal")
+        if isinstance(replica_journal, dict):
+            journal_blocks.append(replica_journal)
         for entry in payload.get("checks") or []:
             key = entry.get("key", "")
             if key not in merged:
@@ -789,8 +845,47 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "sharding": sharding_block,
             "matrix": matrix_block,
             "frontdoor": merge_frontdoor_blocks(frontdoor_blocks),
+            "journal": merge_journal_blocks(journal_blocks),
         },
         "checks": entries,
+    }
+
+
+def merge_journal_blocks(blocks: Sequence[dict]) -> Optional[dict]:
+    """Merge per-replica journal snapshots into one fleet block: the
+    per-stream appended/replayed counters, drops, compactions and
+    segment counts SUM (each replica journals its own directory), lag
+    is the fleet's WORST (the staleness alert keys on the laggiest
+    replica), and the first restore warning seen surfaces — a replica
+    that restored fresh must not be hidden by healthy peers. None when
+    no replica reported a journal."""
+    if not blocks:
+        return None
+    appended: Dict[str, int] = {}
+    replayed: Dict[str, int] = {}
+    dropped = compacted = segment_count = 0
+    lag = 0.0
+    restore_warning = None
+    for block in blocks:
+        for stream, count in (block.get("appended") or {}).items():
+            appended[str(stream)] = appended.get(str(stream), 0) + int(count)
+        for stream, count in (block.get("replayed") or {}).items():
+            replayed[str(stream)] = replayed.get(str(stream), 0) + int(count)
+        dropped += int(block.get("dropped") or 0)
+        compacted += int(block.get("compacted_segments") or 0)
+        segment_count += int(block.get("segment_count") or 0)
+        lag = max(lag, float(block.get("lag_seconds") or 0.0))
+        if restore_warning is None and block.get("restore_warning"):
+            restore_warning = block["restore_warning"]
+    return {
+        "replicas": len(blocks),
+        "segment_count": segment_count,
+        "appended": {s: appended[s] for s in sorted(appended)},
+        "replayed": {s: replayed[s] for s in sorted(replayed)},
+        "dropped": dropped,
+        "compacted_segments": compacted,
+        "lag_seconds": lag,
+        "restore_warning": restore_warning,
     }
 
 

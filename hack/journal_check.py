@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Telemetry-journal integrity checker — the CI gate for a journal dir.
+
+Standalone: ``python hack/journal_check.py <journal-dir>``. Exit 0 when
+the journal is internally consistent, 1 with one finding per line when
+it is not. A tier-1 test (tests/test_journal.py) runs it as a
+subprocess against a freshly recorded trace, so a regression in the
+journal's on-disk format fails CI the same way a lint finding does.
+
+Checks, in order:
+
+- segment chain: the ``journal-NNNNNN.jsonl`` sequence numbers are
+  contiguous — a gap means a segment was lost outside compaction's
+  oldest-first discipline.
+- per-segment header: the first line of every segment is a ``header``
+  record carrying the schema version this checker understands
+  (obs/journal.JOURNAL_VERSION).
+- per-line validity: every event line is JSON with the versioned
+  envelope (``v``, ``stream`` in the known stream set).
+- conservation across streams: every ``result`` event whose payload
+  carries a non-empty attribution bucket has EXACTLY one ``attribution``
+  event (the journal writes both from the same append — a mismatch
+  means torn writes or double-counting, the failure mode the restart
+  acceptance test guards against).
+
+The line checks deliberately reuse ``obs.journal.read_journal`` — the
+checker must agree bit-for-bit with what a restarting controller would
+accept, or CI would bless journals the boot path rejects.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from activemonitor_tpu.obs.journal import (  # noqa: E402
+    STREAM_ATTRIBUTION,
+    STREAM_RESULT,
+    STREAMS,
+    list_segments,
+    read_journal,
+)
+
+
+def check_journal(journal_dir: str) -> list:
+    """Every integrity finding for ``journal_dir`` as
+    ``"<code>: <detail>"`` strings; empty = consistent. Pure so the
+    tier-1 test can call it in-process too."""
+    findings = []
+    path = Path(journal_dir)
+    if not path.is_dir():
+        return [f"missing-dir: {journal_dir} is not a directory"]
+    segments = list_segments(journal_dir)
+    events, warnings = read_journal(journal_dir)
+    # read_journal is all-or-nothing: ANY warning means a restarting
+    # controller would restore fresh, so every warning is a finding
+    for warning in warnings:
+        findings.append(
+            "{}: {}".format(
+                warning.get("reason", "corrupt"), warning.get("detail", "")
+            )
+        )
+    if not segments and not warnings:
+        # an absent/empty journal is a clean first boot, not a finding
+        return findings
+    counts = {stream: 0 for stream in STREAMS}
+    buckets = 0
+    for event in events:
+        stream = event.get("stream")
+        if stream in counts:
+            counts[stream] += 1
+        if stream == STREAM_RESULT and event.get("bucket"):
+            buckets += 1
+    if not warnings and buckets != counts[STREAM_ATTRIBUTION]:
+        findings.append(
+            "conservation: {} result events carry an attribution bucket "
+            "but {} attribution events were journaled".format(
+                buckets, counts[STREAM_ATTRIBUTION]
+            )
+        )
+    return findings
+
+
+def main(argv) -> int:
+    if len(argv) != 1:
+        print(
+            "usage: python hack/journal_check.py <journal-dir>",
+            file=sys.stderr,
+        )
+        return 2
+    journal_dir = argv[0]
+    findings = check_journal(journal_dir)
+    segments = list_segments(journal_dir)
+    events, _warnings = read_journal(journal_dir)
+    counts = {stream: 0 for stream in STREAMS}
+    for event in events:
+        if event.get("stream") in counts:
+            counts[event.get("stream")] += 1
+    summary = "  ".join(f"{stream}={counts[stream]}" for stream in STREAMS)
+    print(f"{journal_dir}: {len(segments)} segment(s)  {summary}")
+    for finding in findings:
+        print(f"FINDING {finding}")
+    if findings:
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -53,7 +53,8 @@ positive that makes `make lint` cry wolf is worse than a miss):
 - wallclock-in-<unit>: `time.time()` / `time.monotonic()` calls in
   files under a `resilience/`, `analysis/`, or `frontdoor/` directory,
   or in the clock-disciplined modules (`sharding.py`, `attribution.py`,
-  `flightrec.py`, `roofline.py`, `arrivals.py`) — those units' whole
+  `flightrec.py`, `roofline.py`, `arrivals.py`, `journal.py`,
+  `replay.py`) — those units' whole
   contract is the injectable Clock (breaker open windows, token-bucket
   refill, baseline timestamps, shard lease expiry/fencing windows,
   attribution windows and flight-bundle timestamps, front-door quota
@@ -73,7 +74,10 @@ positive that makes `make lint` cry wolf is worse than a miss):
   serving probe's soak runs on an injectable timer or the scripted
   StepCosts virtual clock, so the open-loop acceptance tests replay
   deterministically; the paged-cache manager is pure allocation
-  arithmetic with no time in it at all).
+  arithmetic with no time in it at all; `wallclock-in-journal` /
+  `wallclock-in-replay`: the durable telemetry journal stamps events
+  and computes lag on the injected Clock, and trace replay lives on
+  the recorded timeline driven by a FakeClock).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -178,6 +182,8 @@ class Checker(ast.NodeVisitor):
             # soak runs on an injectable timer / scripted StepCosts
             "kv_cache.py",  # pure allocation arithmetic — no time at all
             "arrivals.py",  # seeded schedules on the caller's timeline
+            "journal.py",  # event timestamps + lag on the injected Clock
+            "replay.py",  # recorded timelines + FakeClock drive harness
         ):
             # single-file modules carrying the same injectable-Clock
             # contract as the resilience/analysis packages

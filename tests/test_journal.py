@@ -1,0 +1,733 @@
+"""Durable telemetry journal tests (ISSUE 16): segmented rotation +
+compaction, the all-or-nothing corrupt-chain restore discipline, the
+restart-survival acceptance slice (a FakeClock fleet killed mid-window
+and restarted against its journal reports bit-identical SLO
+availability / error-budget burn / goodput attribution through
+/statusz, the gauges, and the `am-tpu goodput` rendering), the
+record→replay determinism acceptance (trace → schedule → front door →
+same tenant mix / arrival order / outcomes, landing a baseline-tracked
+``frontdoor-replay`` matrix cell), the flight-recorder size cap, and
+the `hack/journal_check.py` integrity gate run as a subprocess.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from activemonitor_tpu.analysis import matrix as matrix_mod
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.frontdoor.traffic import (
+    open_loop_checks,
+    replayed_checks,
+)
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs import FleetStatus, ResultHistory
+from activemonitor_tpu.obs.flightrec import KIND_BREAKER, FlightRecorder
+from activemonitor_tpu.obs.history import CheckResult
+from activemonitor_tpu.obs.journal import (
+    JOURNAL_VERSION,
+    TelemetryJournal,
+    list_segments,
+    read_journal,
+    rotate_capped,
+)
+from activemonitor_tpu.obs.replay import drive_requests, load_trace
+from activemonitor_tpu.obs.slo import (
+    DEFAULT_WINDOW_SECONDS,
+    merge_journal_blocks,
+    rollup_statusz,
+)
+from activemonitor_tpu.utils.clock import FakeClock
+from activemonitor_tpu.__main__ import main, render_goodput, render_journal
+
+REPO = Path(__file__).resolve().parent.parent
+
+WF_INLINE = "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+
+
+def make_hc(name="hc-dur", slo=None):
+    spec = {
+        "repeatAfterSec": 60,
+        "level": "cluster",
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if slo is not None:
+        spec["slo"] = slo
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+def tick(clock, seconds=60.0):
+    # FakeClock.advance is async (it wakes sleepers); these tests only
+    # need the timestamp to move — same idiom as test_matrix
+    clock._t += seconds
+
+
+def make_result(clock, ok=True, bucket="", why="", latency=1.0):
+    return CheckResult(
+        ts=clock.now(),
+        ok=ok,
+        latency=latency,
+        workflow="wf-j",
+        trace_id="tr-j",
+        bucket=bucket,
+        why=why,
+    )
+
+
+def seeded_arrival_dir(tmp_path, n=30, name="j"):
+    """A journal dir of ``n`` arrival events across several 1 KiB
+    segments (each line is ~160 bytes, so ~6 per segment)."""
+    path = str(tmp_path / name)
+    journal = TelemetryJournal(path, clock=FakeClock(), max_bytes=1024)
+    for i in range(n):
+        journal.record_arrival(
+            tenant=f"t-{i % 2}", check="ns/hc", outcome="run", gap=1.0
+        )
+    journal.close()
+    return path
+
+
+# ---------------------------------------------------------------------
+# segments: rotation, compaction, chain continuation
+# ---------------------------------------------------------------------
+
+
+def test_segments_rotate_at_the_size_cap(tmp_path):
+    path = seeded_arrival_dir(tmp_path)
+    segments = list_segments(path)
+    assert len(segments) >= 3
+    # contiguous chain from 1, every segment under cap + one line
+    assert [seq for seq, _ in segments] == list(
+        range(1, len(segments) + 1)
+    )
+    events, warnings = read_journal(path)
+    assert warnings == []
+    assert len(events) == 30
+    assert all(ev["stream"] == "arrival" for ev in events)
+
+
+def test_compaction_bounds_the_directory(tmp_path):
+    journal = TelemetryJournal(
+        str(tmp_path / "j"), clock=FakeClock(), max_bytes=1024, max_segments=2
+    )
+    for _ in range(40):
+        journal.record_arrival(
+            tenant="t", check="ns/hc", outcome="run", gap=1.0
+        )
+    journal.close()
+    segments = list_segments(str(tmp_path / "j"))
+    assert len(segments) <= 2
+    assert journal.compacted_segments > 0
+    # the surviving suffix of the chain still reads clean (contiguity
+    # is judged from the oldest SURVIVOR, not from segment 1)
+    events, warnings = read_journal(str(tmp_path / "j"))
+    assert warnings == [] and events
+
+
+def test_reopen_continues_the_chain_on_a_new_segment(tmp_path):
+    path = str(tmp_path / "j")
+    first = TelemetryJournal(path, clock=FakeClock())
+    for _ in range(3):
+        first.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=1.0)
+    first.close()
+    second = TelemetryJournal(path, clock=FakeClock())
+    second.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=1.0)
+    # never appends into a segment an earlier incarnation may have torn
+    assert [seq for seq, _ in list_segments(path)] == [1, 2]
+    events, warnings = read_journal(path)
+    assert warnings == [] and len(events) == 4
+
+
+def test_append_never_raises_into_the_recording_path(tmp_path):
+    blocker = tmp_path / "blocked"
+    blocker.write_text("i am a file, not a directory")
+    journal = TelemetryJournal(str(blocker), clock=FakeClock())
+    journal.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=0.0)
+    assert journal.dropped == 1
+    assert journal.appended["arrival"] == 0
+
+
+def test_oversized_single_event_cannot_wedge_the_writer(tmp_path):
+    journal = TelemetryJournal(
+        str(tmp_path / "j"), clock=FakeClock(), max_bytes=1024
+    )
+    journal.record_arrival(
+        tenant="t", check="ns/hc", outcome="refused", reason="x" * 5000, gap=0.0
+    )
+    journal.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=1.0)
+    assert journal.dropped == 0
+    assert journal.appended["arrival"] == 2
+    events, warnings = read_journal(str(tmp_path / "j"))
+    assert warnings == [] and len(events) == 2
+
+
+def test_lag_tracks_the_newest_event_on_the_injected_clock(tmp_path):
+    clock = FakeClock()
+    journal = TelemetryJournal(str(tmp_path / "j"), clock=clock)
+    assert journal.lag_seconds() == 0.0
+    journal.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=0.0)
+    tick(clock, 42.0)
+    assert journal.lag_seconds() == pytest.approx(42.0)
+
+
+def test_rotate_capped_shifts_and_drops_the_oldest(tmp_path):
+    path = tmp_path / "sink.jsonl"
+    assert rotate_capped(str(path), 10) is False  # absent: nothing to do
+    for round_no in range(6):
+        path.write_text(f"round-{round_no}\n" * 50)
+        assert rotate_capped(str(path), 10, keep=2) is True
+        assert not path.exists()  # active moved aside; append recreates
+    assert (tmp_path / "sink-1.jsonl").exists()
+    assert (tmp_path / "sink-2.jsonl").exists()
+    assert not (tmp_path / "sink-3.jsonl").exists()  # keep bounds it
+    path.write_text("tiny")
+    assert rotate_capped(str(path), 1 << 20) is False  # under the cap
+    assert rotate_capped(str(path), 0) is False  # cap disabled
+
+
+# ---------------------------------------------------------------------
+# corrupt / truncated segments: all-or-nothing fresh restore
+# ---------------------------------------------------------------------
+
+
+def assert_fresh_restore(journal_dir, reason):
+    events, warnings = read_journal(journal_dir)
+    assert events == []
+    assert [w["reason"] for w in warnings] == [reason]
+    journal = TelemetryJournal(journal_dir, clock=FakeClock())
+    history = ResultHistory(FakeClock())
+    out = journal.replay_into(history)
+    # fresh restore: nothing replayed, nothing double-counted, the
+    # structured warning parked for /statusz
+    assert out["replayed"] == {"result": 0, "attribution": 0, "arrival": 0}
+    assert journal.restore_warning["reason"] == reason
+    assert len(history) == 0
+    return journal
+
+
+def test_mid_line_truncation_restores_fresh(tmp_path):
+    path = seeded_arrival_dir(tmp_path)
+    _seq, last = list_segments(path)[-1]
+    raw = Path(last).read_bytes()
+    Path(last).write_bytes(raw[:-10])  # SIGKILL mid-write, doctored
+    journal = assert_fresh_restore(path, "corrupt-line")
+    assert "truncated" in journal.restore_warning["detail"]
+    # a new append after the fresh restore opens a NEW segment past the
+    # torn chain — the corruption is never appended into
+    before = [seq for seq, _ in list_segments(path)]
+    journal.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=0.0)
+    assert max(s for s, _ in list_segments(path)) == max(before) + 1
+
+
+def test_version_skew_restores_fresh(tmp_path):
+    path = seeded_arrival_dir(tmp_path)
+    _seq, first = list_segments(path)[0]
+    lines = Path(first).read_text().splitlines()
+    header = json.loads(lines[0])
+    header["v"] = JOURNAL_VERSION + 1
+    lines[0] = json.dumps(header)
+    Path(first).write_text("\n".join(lines) + "\n")
+    journal = assert_fresh_restore(path, "version-skew")
+    assert str(JOURNAL_VERSION + 1) in journal.restore_warning["detail"]
+
+
+def test_missing_segment_restores_fresh(tmp_path):
+    path = seeded_arrival_dir(tmp_path)
+    segments = list_segments(path)
+    assert len(segments) >= 3
+    Path(segments[1][1]).unlink()  # hole in the middle of the chain
+    journal = assert_fresh_restore(path, "missing-segment")
+    assert str(segments[1][0]) in journal.restore_warning["detail"]
+
+
+def test_corrupt_header_restores_fresh(tmp_path):
+    path = seeded_arrival_dir(tmp_path)
+    _seq, first = list_segments(path)[0]
+    Path(first).write_text("")  # an empty segment has no header
+    assert_fresh_restore(path, "corrupt-header")
+
+
+def test_clean_kill_between_appends_loses_nothing(tmp_path):
+    # the writer flushes whole lines, so abandoning the handle (a
+    # SIGKILL between appends) leaves a clean chain that restores fully
+    path = str(tmp_path / "j")
+    journal = TelemetryJournal(path, clock=FakeClock())
+    for _ in range(5):
+        journal.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=1.0)
+    # no close(): the process just died
+    events, warnings = read_journal(path)
+    assert warnings == [] and len(events) == 5
+
+
+# ---------------------------------------------------------------------
+# boot replay into the fleet + /statusz + rollup
+# ---------------------------------------------------------------------
+
+
+def test_attach_journal_replays_then_subscribes_without_double_count(tmp_path):
+    path = str(tmp_path / "j")
+    clock = FakeClock()
+    hc = make_hc()
+    fleet1 = FleetStatus(clock, MetricsCollector())
+    journal1 = TelemetryJournal(path, clock=clock)
+    fleet1.attach_journal(journal1)
+    fleet1.record(hc, ok=True, latency=1.0, workflow="wf-1")
+    fleet1.record(hc, ok=False, latency=2.0, workflow="wf-2")
+    assert journal1.appended["result"] == 2
+    journal1.close()
+
+    fleet2 = FleetStatus(clock, MetricsCollector())
+    journal2 = TelemetryJournal(path, clock=clock)
+    fleet2.attach_journal(journal2)
+    assert journal2.replayed["result"] == 2
+    # replayed events were NOT re-journaled (restore bypasses the
+    # subscriber tap); only genuinely new records append
+    assert journal2.appended["result"] == 0
+    assert [r.workflow for r in fleet2.history.results(hc.key)] == [
+        "wf-1",
+        "wf-2",
+    ]
+    # the /statusz last-status map is restored from the replayed tail
+    assert fleet2._last_status[hc.key] == "Failed"
+    fleet2.record(hc, ok=True, latency=1.0, workflow="wf-3")
+    assert journal2.appended["result"] == 1
+    events, warnings = read_journal(path)
+    assert warnings == []
+    assert sum(1 for ev in events if ev["stream"] == "result") == 3
+
+
+def test_statusz_journal_block_and_rollup(tmp_path):
+    clock = FakeClock()
+    hc = make_hc()
+    with_journal = FleetStatus(clock, MetricsCollector())
+    journal = TelemetryJournal(str(tmp_path / "j"), clock=clock)
+    with_journal.attach_journal(journal)
+    with_journal.record(hc, ok=True, latency=1.0, workflow="wf")
+    without = FleetStatus(clock, MetricsCollector())
+    without.record(hc, ok=True, latency=1.0, workflow="wf")
+
+    p1 = with_journal.statusz([hc])
+    p2 = without.statusz([hc])
+    assert p1["fleet"]["journal"]["appended"]["result"] == 1
+    assert p1["fleet"]["journal"]["segment_count"] >= 1
+    assert p2["fleet"]["journal"] is None
+    merged = rollup_statusz([p1, p2])
+    block = merged["fleet"]["journal"]
+    assert block["replicas"] == 1
+    assert block["appended"]["result"] == 1
+
+
+def test_merge_journal_blocks_sums_counters_and_keeps_worst_lag():
+    assert merge_journal_blocks([]) is None
+    merged = merge_journal_blocks(
+        [
+            {
+                "appended": {"result": 2, "arrival": 1},
+                "replayed": {"result": 2},
+                "dropped": 1,
+                "compacted_segments": 0,
+                "segment_count": 2,
+                "lag_seconds": 5.0,
+                "restore_warning": None,
+            },
+            {
+                "appended": {"result": 3},
+                "replayed": {},
+                "dropped": 0,
+                "compacted_segments": 4,
+                "segment_count": 1,
+                "lag_seconds": 9.0,
+                "restore_warning": {"reason": "corrupt-line", "detail": "d"},
+            },
+        ]
+    )
+    assert merged["replicas"] == 2
+    assert merged["appended"] == {"arrival": 1, "result": 5}
+    assert merged["replayed"] == {"result": 2}
+    assert merged["segment_count"] == 3
+    assert merged["dropped"] == 1 and merged["compacted_segments"] == 4
+    assert merged["lag_seconds"] == 9.0  # the fleet's WORST, not the sum
+    assert merged["restore_warning"]["reason"] == "corrupt-line"
+
+
+# ---------------------------------------------------------------------
+# acceptance: restart survival (kill mid-window, bit-identical windows)
+# ---------------------------------------------------------------------
+
+SLO = {"objective": 0.9, "windowSeconds": int(DEFAULT_WINDOW_SECONDS)}
+SLO_LABELS = {"healthcheck_name": "hc-dur", "namespace": "health"}
+
+
+def test_restart_survival_acceptance(tmp_path):
+    """A FakeClock fleet killed mid-window and restarted against its
+    journal reports SLO availability, error-budget burn and goodput
+    attribution identical (±1e-9; the dict comparisons are exact) to an
+    uninterrupted twin — through /statusz, the gauges, and the `am-tpu
+    goodput` rendering. Conservation (Σ per-subsystem lost ratios =
+    1 − goodput) holds on both sides of the kill."""
+    journal_dir = str(tmp_path / "journal")
+    clock = FakeClock()
+    hc = make_hc(slo=SLO)
+    control_metrics = MetricsCollector()
+    control = FleetStatus(clock, control_metrics)
+
+    fleet1 = FleetStatus(clock, MetricsCollector())
+    journal1 = TelemetryJournal(journal_dir, clock=clock)
+    fleet1.attach_journal(journal1)
+
+    head = [i % 4 != 3 for i in range(12)]  # 9 ok, 3 failed
+    for ok in head:
+        tick(clock)
+        control.record(hc, ok=ok, latency=2.0, workflow="wf")
+        fleet1.record(hc, ok=ok, latency=2.0, workflow="wf")
+    journal1.close()  # the kill: in-memory rings die with fleet1
+
+    metrics2 = MetricsCollector()
+    fleet2 = FleetStatus(clock, metrics2)
+    journal2 = TelemetryJournal(journal_dir, clock=clock, metrics=metrics2)
+    fleet2.attach_journal(journal2)
+    assert journal2.restore_warning is None
+    assert journal2.replayed["result"] == 12
+
+    tail = [True, True, False, True, True, True, True, True]  # 7 ok, 1 failed
+    for ok in tail:
+        tick(clock)
+        control.record(hc, ok=ok, latency=2.0, workflow="wf")
+        fleet2.record(hc, ok=ok, latency=2.0, workflow="wf")
+
+    payload_c = control.statusz([hc])
+    payload_j = fleet2.statusz([hc])
+
+    # /statusz: fleet goodput + the full attribution decomposition are
+    # bit-identical (isoformat timestamps and JSON floats round-trip
+    # exactly, so the windows ARE the same numbers, not near ones)
+    assert payload_j["fleet"]["goodput_ratio"] == pytest.approx(
+        payload_c["fleet"]["goodput_ratio"], abs=1e-9
+    )
+    assert payload_j["fleet"]["goodput"] == payload_c["fleet"]["goodput"]
+    expected = (9 + 7) / 20
+    assert payload_j["fleet"]["goodput_ratio"] == pytest.approx(expected)
+    # conservation: Σ lost ratios = 1 − goodput, on the restarted side
+    block = payload_j["fleet"]["goodput"]
+    lost = sum(v or 0.0 for v in block["attribution"].values())
+    assert lost == pytest.approx(1.0 - payload_j["fleet"]["goodput_ratio"], abs=1e-9)
+
+    # the per-check SLO block (availability / budget / burn) matches
+    entry_c = payload_c["checks"][0]
+    entry_j = payload_j["checks"][0]
+    assert entry_j["slo"] == entry_c["slo"]
+    assert entry_j["attribution"] == entry_c["attribution"]
+
+    # the gauges: both collectors report the same window
+    for family in (
+        "healthcheck_slo_availability_ratio",
+        "healthcheck_error_budget_remaining",
+        "healthcheck_slo_burn_rate",
+    ):
+        want = control_metrics.sample_value(family, SLO_LABELS)
+        got = metrics2.sample_value(family, SLO_LABELS)
+        assert got == pytest.approx(want, abs=1e-9), family
+    assert metrics2.sample_value(
+        "healthcheck_fleet_goodput_ratio", {}
+    ) == pytest.approx(
+        control_metrics.sample_value("healthcheck_fleet_goodput_ratio", {}),
+        abs=1e-9,
+    )
+
+    # the `am-tpu goodput` rendering is byte-identical
+    assert render_goodput(payload_j) == render_goodput(payload_c)
+
+    # the journal block itself reports the split: 12 replayed, 8 new
+    jblock = payload_j["fleet"]["journal"]
+    assert jblock["replayed"]["result"] == 12
+    assert jblock["appended"]["result"] == 8
+    # and the level gauges export through the pinned families
+    fleet2.refresh_journal_metrics()
+    assert metrics2.sample_value("healthcheck_journal_segments", {}) >= 1
+    assert metrics2.sample_value("healthcheck_journal_lag_seconds", {}) >= 0.0
+
+
+# ---------------------------------------------------------------------
+# acceptance: record → replay determinism + the matrix cell
+# ---------------------------------------------------------------------
+
+TRACE_CHECKS = ("bench/hc-a", "bench/hc-b", "bench/hc-c")
+
+
+def record_trace(journal_dir, n=48, seed=7):
+    requests = open_loop_checks(n, 200.0, seed, TRACE_CHECKS)
+    journal = TelemetryJournal(journal_dir, clock=FakeClock())
+    summary = asyncio.run(drive_requests(requests, journal=journal))
+    journal.close()
+    return requests, journal, summary
+
+
+def test_record_replay_reproduces_the_recorded_workload(tmp_path):
+    journal_dir = str(tmp_path / "trace")
+    requests, journal, first = record_trace(journal_dir)
+    assert first["conservation_ok"]
+    assert journal.appended["arrival"] == 48
+
+    schedule, warnings = load_trace(journal_dir)
+    assert warnings == [] and len(schedule) == 48
+    replay_reqs = replayed_checks(schedule)
+    # recorded tenant mix and per-request identity order, reproduced
+    assert [r.tenant for r in replay_reqs] == [r.tenant for r in requests]
+    assert [r.check for r in replay_reqs] == [r.check for r in requests]
+    # arrival ORDER and spacing: the recorded inter-arrival gaps are
+    # the original schedule's (the timeline is shifted to the first
+    # arrival, gaps are preserved)
+    deltas = [
+        requests[i].arrival - requests[i - 1].arrival for i in range(1, 48)
+    ]
+    rdeltas = [
+        replay_reqs[i].arrival - replay_reqs[i - 1].arrival
+        for i in range(1, 48)
+    ]
+    assert rdeltas == pytest.approx(deltas, abs=1e-9)
+
+    second = asyncio.run(drive_requests(replay_reqs))
+    assert second["conservation_ok"]
+    assert second["outcomes"] == first["outcomes"]
+    assert second["tenant_mix"] == first["tenant_mix"]
+    assert second["outcome_counts"] == first["outcome_counts"]
+    # per-tenant conservation is exact on the replayed side too
+    assert second["conservation"]["ok"] is True
+
+
+def test_frontdoor_replay_matrix_cell(tmp_path, monkeypatch):
+    monkeypatch.delenv("ACTIVEMONITOR_REPLAY_TRACE", raising=False)
+    cell = matrix_mod.CellSpec("frontdoor-replay", (), "float32", "-")
+    assert cell.cell_id == "frontdoor-replay/1chip/f32"
+
+    # canonical seeded round trip when no trace is wired
+    result = matrix_mod.execute_cell(cell)
+    assert result.status == matrix_mod.STATUS_OK
+    replay = result.details["replay"]
+    assert replay["source"] == "canonical-seeded"
+    assert replay["requests"] == matrix_mod.REPLAY_CANON_REQUESTS
+    assert replay["conserved"] is True
+    assert result.value and result.value > 0
+
+    # a recorded trace wired via the env knob drives the SAME cell
+    journal_dir = str(tmp_path / "trace")
+    _requests, _journal, recorded = record_trace(journal_dir, n=24)
+    monkeypatch.setenv("ACTIVEMONITOR_REPLAY_TRACE", journal_dir)
+    traced = matrix_mod.execute_cell(cell)
+    assert traced.status == matrix_mod.STATUS_OK
+    assert traced.details["replay"]["source"] == journal_dir
+    assert traced.details["replay"]["requests"] == 24
+    assert traced.details["replay"]["tenant_mix"] == recorded["tenant_mix"]
+
+    # a torn trace is a structured skip, never a bogus measurement
+    _seq, last = list_segments(journal_dir)[-1]
+    raw = Path(last).read_bytes()
+    Path(last).write_bytes(raw[:-10])
+    torn = matrix_mod.execute_cell(cell)
+    assert torn.status == matrix_mod.STATUS_SKIPPED
+    assert matrix_mod.SKIP_NO_TRACE in torn.reason
+
+
+def test_frontdoor_replay_cell_lands_a_tracked_baseline(tmp_path, monkeypatch):
+    monkeypatch.delenv("ACTIVEMONITOR_REPLAY_TRACE", raising=False)
+    clock = FakeClock()
+    path = tmp_path / "BENCH_BASELINES.json"
+    observatory = matrix_mod.MatrixObservatory(
+        clock=clock, path=str(path), warmup_runs=1
+    )
+    cell = matrix_mod.CellSpec("frontdoor-replay", (), "float32", "-")
+    tick(clock)
+    summary = observatory.observe_round([matrix_mod.execute_cell(cell)])
+    entry = summary["cells"]["frontdoor-replay/1chip/f32"]
+    assert entry["status"] == "ok"
+    # the BENCH_BASELINES.json sidecar carries the cell like any other
+    doc = json.loads(path.read_text())
+    assert "frontdoor-replay/1chip/f32" in doc["last_round"]["cells"]
+    # the next round compares against the learned baseline
+    tick(clock)
+    summary2 = observatory.observe_round([matrix_mod.execute_cell(cell)])
+    entry2 = summary2["cells"]["frontdoor-replay/1chip/f32"]
+    assert isinstance(entry2.get("vs_baseline"), float)
+
+
+def test_frontdoor_replay_expansion_is_single_chip_f32_only():
+    spec = dict(matrix_mod.DEFAULT_SPEC)
+    spec["ops"] = ["frontdoor-replay"]
+    spec["meshes"] = [{"sp": 8}]
+    spec["dtypes"] = ["bf16", "f32"]
+    cells, skipped = matrix_mod.expand(spec, n_devices=8)
+    assert [c.cell_id for c in cells] == ["frontdoor-replay/1chip/f32"]
+    # the bf16 column exercises the unsupported-dtype structured skip
+    assert any(
+        s.cell.cell_id == "frontdoor-replay/1chip/bf16" for s in skipped
+    )
+
+
+# ---------------------------------------------------------------------
+# flight recorder: size-capped durable sink (regression)
+# ---------------------------------------------------------------------
+
+
+def test_flightrec_sink_is_size_capped(tmp_path):
+    recorder = FlightRecorder(
+        clock=FakeClock(), flight_dir=str(tmp_path), max_bytes=2048
+    )
+    for i in range(40):
+        recorder.record(KIND_BREAKER, "ns/hc", note="x" * 200, i=i)
+    active = tmp_path / "flightrec.jsonl"
+    # the active file keeps its pinned name (tests and jq pipelines
+    # read it) and stays bounded: under the cap plus one bundle
+    assert active.exists()
+    assert active.stat().st_size <= 2048 + 4096
+    assert (tmp_path / "flightrec-1.jsonl").exists()
+    assert not (tmp_path / "flightrec-5.jsonl").exists()  # keep=4 bounds it
+    bundles = list(FlightRecorder.read_jsonl(str(active)))
+    assert bundles and all(b["kind"] == KIND_BREAKER for b in bundles)
+
+
+# ---------------------------------------------------------------------
+# CLI: am-tpu journal / record / replay
+# ---------------------------------------------------------------------
+
+
+def test_cli_record_journal_replay_roundtrip(tmp_path, capsys):
+    d = str(tmp_path / "trace")
+    assert main(["record", "--journal-dir", d, "--requests", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "recorded: 16 requests driven  conservation=ok" in out
+    assert "arrivals appended=16" in out
+
+    assert main(["journal", "--journal-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "journal-000001.jsonl" in out  # the segment table
+    assert "arrival" in out  # the stream counts
+    assert "replay coverage: 16 arrivals" in out
+
+    assert main(["replay", "--journal-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "replayed: 16 requests driven  conservation=ok" in out
+
+
+def test_cli_replay_refuses_empty_or_torn_journals(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["replay", "--journal-dir", str(empty)]) == 1
+    assert "no arrival events" in capsys.readouterr().err
+    torn = tmp_path / "torn"
+    torn.mkdir()
+    (torn / "journal-000001.jsonl").write_text("")
+    (torn / "journal-000003.jsonl").write_text("")
+    assert main(["replay", "--journal-dir", str(torn)]) == 1
+    assert "missing-segment" in capsys.readouterr().err
+
+
+def test_cli_record_rejects_bad_flags(tmp_path, capsys):
+    rc = main(["record", "--journal-dir", str(tmp_path), "--requests", "0"])
+    assert rc == 2
+    assert "--requests" in capsys.readouterr().err
+
+
+def test_render_journal_views():
+    assert "no journal recorded" in render_journal(None)
+    block = {
+        "replicas": 2,
+        "segment_count": 3,
+        "appended": {"result": 5, "arrival": 2},
+        "replayed": {"result": 5},
+        "dropped": 1,
+        "compacted_segments": 0,
+        "lag_seconds": 2.0,
+        "restore_warning": {"reason": "corrupt-line", "detail": "x:3"},
+    }
+    text = render_journal(block)
+    assert "replicas=2" in text
+    assert "lag=2.0s" in text
+    assert "APPENDED" in text and "REPLAYED" in text
+    assert "restored fresh: corrupt-line (x:3)" in text
+    assert "dropped=1" in text
+
+
+# ---------------------------------------------------------------------
+# hack/journal_check.py: the integrity gate, run as CI runs it
+# ---------------------------------------------------------------------
+
+
+def run_journal_check(journal_dir):
+    return subprocess.run(
+        [sys.executable, str(REPO / "hack" / "journal_check.py"), journal_dir],
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_journal_check_passes_a_clean_journal(tmp_path):
+    path = str(tmp_path / "j")
+    clock = FakeClock()
+    journal = TelemetryJournal(path, clock=clock)
+    journal.record_result(
+        "ns/hc", make_result(clock, ok=False, bucket="hbm", why="bw floor")
+    )
+    journal.record_result("ns/hc", make_result(clock, ok=True))
+    journal.record_arrival(tenant="t", check="ns/hc", outcome="run", gap=0.0)
+    journal.close()
+    proc = run_journal_check(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+    assert "result=2" in proc.stdout and "attribution=1" in proc.stdout
+
+
+def test_journal_check_flags_broken_conservation_and_torn_chains(tmp_path):
+    path = str(tmp_path / "j")
+    clock = FakeClock()
+    journal = TelemetryJournal(path, clock=clock)
+    journal.record_result(
+        "ns/hc", make_result(clock, ok=False, bucket="hbm", why="bw floor")
+    )
+    journal.close()
+    # a bucket-carrying result line with no attribution twin: the
+    # cross-stream conservation check must catch it
+    _seq, active = list_segments(path)[-1]
+    with open(active, "a") as f:
+        f.write(
+            json.dumps(
+                {
+                    "v": JOURNAL_VERSION,
+                    "stream": "result",
+                    "key": "ns/hc",
+                    "ts": "2026-01-01T00:00:00+00:00",
+                    "ok": False,
+                    "latency_seconds": 1.0,
+                    "bucket": "ici",
+                    "why": "orphaned",
+                }
+            )
+            + "\n"
+        )
+    proc = run_journal_check(path)
+    assert proc.returncode == 1
+    assert "conservation" in proc.stdout
+
+    torn = seeded_arrival_dir(tmp_path, name="torn")
+    segments = list_segments(torn)
+    Path(segments[1][1]).unlink()
+    proc = run_journal_check(torn)
+    assert proc.returncode == 1
+    assert "missing-segment" in proc.stdout
+
+    proc = run_journal_check(str(tmp_path / "nope"))
+    assert proc.returncode == 1
+    assert "missing-dir" in proc.stdout

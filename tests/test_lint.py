@@ -1319,3 +1319,92 @@ def test_frontdoor_metric_families_are_pinned():
     ops_docs = (REPO / "docs" / "operations.md").read_text()
     assert "Probe-as-a-service front door" in ops_docs
     assert "/frontdoor/submit" in ops_docs
+
+
+def test_wallclock_banned_in_journal_and_replay(tmp_path):
+    """obs/journal.py and obs/replay.py carry the injectable-Clock
+    contract (ISSUE 16): event timestamps, lag and the replay drive all
+    live on the injected Clock/FakeClock, so a bare wall-clock read
+    there is a lint error — same module-name keying as the
+    attribution/flightrec twins."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    for module in ("journal", "replay"):
+        (tmp_path / f"{module}.py").write_text(source)
+        got = lint.lint_file(tmp_path / f"{module}.py")
+        assert {line.split(": ")[1] for line in got} == {
+            f"wallclock-in-{module}"
+        }, module
+        assert len(got) == 2
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="summarizer.py") == []
+
+
+def test_journal_and_replay_really_are_wallclock_free():
+    """The gate, applied: the shipped modules lint clean and the ban
+    covers them (path-scoping regression guard, like the sharding
+    twin)."""
+    for module in ("journal", "replay"):
+        path = REPO / "activemonitor_tpu" / "obs" / f"{module}.py"
+        assert path.exists(), f"{module} module missing?"
+        assert lint.lint_file(path) == []
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock
+        assert checker.wallclock_pkg == module
+
+
+JOURNAL_FAMILIES = (
+    "healthcheck_journal_appended_total",
+    "healthcheck_journal_replayed_total",
+    "healthcheck_journal_dropped_total",
+    "healthcheck_journal_segments",
+    "healthcheck_journal_lag_seconds",
+)
+
+
+def test_journal_metric_families_are_pinned():
+    """The ISSUE-16 families must stay in the exposition contract — the
+    durability dashboard stacks the appended/replayed counters next to
+    the lag gauge, and a rename silently breaks the staleness alert."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_journal", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in JOURNAL_FAMILIES:
+        assert family in contract.PINNED_FAMILIES, family
+    # and the operator docs register every family next to the runbook
+    docs = (REPO / "docs" / "observability.md").read_text()
+    for family in JOURNAL_FAMILIES:
+        assert family in docs, f"{family} missing from docs/observability.md"
+    assert "Durable telemetry journal" in docs
+
+
+def test_frontdoor_replay_op_is_cross_pinned():
+    """The ``frontdoor-replay`` matrix op must exist everywhere an
+    operator meets it: the op registry + runner table + default spec,
+    the shipped config matrix, the record/replay runbook, and the
+    integrity checker the runbook points at — a rename in one place
+    strands the others."""
+    from activemonitor_tpu.analysis import matrix as matrix_mod
+
+    assert "frontdoor-replay" in matrix_mod.OPS
+    assert "frontdoor-replay" in matrix_mod._RUNNERS
+    assert "frontdoor-replay" in matrix_mod.DEFAULT_SPEC["ops"]
+    assert "frontdoor-replay" in (
+        REPO / "config" / "bench_matrix.json"
+    ).read_text()
+    ops_docs = (REPO / "docs" / "operations.md").read_text()
+    assert "Recording and replaying a traffic trace" in ops_docs
+    assert "am-tpu record" in ops_docs
+    assert "am-tpu replay" in ops_docs
+    assert "hack/journal_check.py" in ops_docs
+    assert (REPO / "hack" / "journal_check.py").exists()
+    obs_docs = (REPO / "docs" / "observability.md").read_text()
+    assert "frontdoor-replay" in obs_docs

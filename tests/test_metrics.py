@@ -242,6 +242,13 @@ PINNED_FAMILIES = {
     "healthcheck_frontdoor_coalesce_ratio": "gauge",
     "healthcheck_frontdoor_queue_depth": "gauge",
     "healthcheck_frontdoor_admission_seconds": "histogram",
+    # durable-journal families (ISSUE 16: restart-proof telemetry
+    # journal — docs/observability.md "Durable telemetry journal")
+    "healthcheck_journal_appended_total": "counter",
+    "healthcheck_journal_replayed_total": "counter",
+    "healthcheck_journal_dropped_total": "counter",
+    "healthcheck_journal_segments": "gauge",
+    "healthcheck_journal_lag_seconds": "gauge",
     # sharding families (ISSUE 6: sharded controller fleet —
     # docs/operations.md "Sharded controller fleet")
     "healthcheck_shard_owned": "gauge",
@@ -295,6 +302,12 @@ def exercise_every_family(collector):
     collector.set_frontdoor_coalesce(hit=0.5, miss=0.25, join=0.25)
     collector.set_frontdoor_queue_depth(2)
     collector.observe_frontdoor_admission(0.0004)
+    # durable-journal families (ISSUE 16)
+    collector.record_journal_append("result")
+    collector.record_journal_replayed("result", 2)
+    collector.record_journal_dropped()
+    collector.set_journal_segments(1)
+    collector.set_journal_lag(0.5)
     # sharding families
     collector.set_shard_owned(0, True)
     collector.set_shard_checks(0, 3)

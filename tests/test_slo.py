@@ -351,6 +351,9 @@ FLEET_FIELDS = {
     # front-door ingestion summary (ISSUE 15): QPS, coalescing ratios,
     # queue depth, per-tenant refusals; None when no front door is wired
     "frontdoor": (dict, type(None)),
+    # durable telemetry journal (ISSUE 16): segment table, per-stream
+    # counts, lag; None when no --journal-dir is wired
+    "journal": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,
