@@ -241,6 +241,35 @@ def build_parser() -> argparse.ArgumentParser:
         "segments beyond the retained-segment cap so the directory "
         "stays bounded",
     )
+    run.add_argument(
+        "--profile-on-anomaly",
+        default="",
+        metavar="DIR",
+        help="capture ONE bounded jax.profiler trace of the next probe "
+        "run after a confirmed degradation or an SLO burn-rate breach, "
+        "writing the capture under DIR (per-check cooldown, directory "
+        "size-capped, off by default — docs/observability.md "
+        "\"Profile-on-anomaly\")",
+    )
+    run.add_argument(
+        "--profile-cooldown",
+        type=float,
+        default=600.0,
+        metavar="SECONDS",
+        help="minimum seconds between profile-on-anomaly captures for "
+        "the SAME check (default 600); the captured run re-confirms "
+        "its own anomaly, so the cooldown is what stops a degraded "
+        "check from profiling every cycle",
+    )
+    run.add_argument(
+        "--profile-max-bytes",
+        type=int,
+        default=0,
+        metavar="BYTES",
+        help="total size cap on the profile-on-anomaly directory "
+        "(0: the default, 256 MiB); oldest captures are pruned first, "
+        "the newest always survives",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -329,6 +358,26 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_statusz_flags(why)
     why.add_argument(
+        "-o", "--output", choices=["text", "json"], default="text"
+    )
+
+    waterfall = sub.add_parser(
+        "waterfall",
+        help="ONE check's critical-path latency decomposition: per-"
+        "stage p50/p95/p99 over the SLO window (queue-wait/admission/"
+        "schedule/submit/poll/probe-phase/status-write, gaps booked as "
+        "untracked) plus an ASCII waterfall of the last run "
+        "(docs/observability.md \"Reading a waterfall\")",
+    )
+    waterfall.add_argument("name", help="HealthCheck name")
+    waterfall.add_argument(
+        "--namespace",
+        "-n",
+        default=None,
+        help="namespace filter (default: every namespace with that name)",
+    )
+    add_statusz_flags(waterfall)
+    waterfall.add_argument(
         "-o", "--output", choices=["text", "json"], default="text"
     )
 
@@ -642,6 +691,23 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         raise _ConfigError(
             "--journal-max-bytes needs --journal-dir (no journal to cap)"
         )
+    profile_dir = getattr(args, "profile_on_anomaly", "")
+    profile_cooldown = getattr(args, "profile_cooldown", 600.0)
+    profile_max_bytes = getattr(args, "profile_max_bytes", 0) or 0
+    if profile_cooldown < 0:
+        raise _ConfigError(
+            f"--profile-cooldown must be >= 0 (got {profile_cooldown})"
+        )
+    if profile_max_bytes < 0:
+        raise _ConfigError(
+            f"--profile-max-bytes must be >= 0 (got {profile_max_bytes}); "
+            "0 uses the default directory cap"
+        )
+    if profile_max_bytes and not profile_dir:
+        raise _ConfigError(
+            "--profile-max-bytes needs --profile-on-anomaly "
+            "(no capture directory to cap)"
+        )
     metrics_authorizer = None
     k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
     if k8s_auth == "on" and kube_api is None:
@@ -687,6 +753,9 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         frontdoor=frontdoor,
         journal_dir=journal_dir,
         journal_max_bytes=journal_max_bytes,
+        profile_on_anomaly_dir=profile_dir,
+        profile_cooldown=profile_cooldown,
+        profile_max_bytes=profile_max_bytes,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -1309,6 +1378,124 @@ async def _why(args) -> int:
     return 0
 
 
+def _fmt_secs(value) -> str:
+    """A stage duration cell: millisecond precision below a second,
+    so a 3 ms schedule stage doesn't render as an all-zero 0.00s."""
+    if not isinstance(value, (int, float)):
+        return "-"
+    if value < 1.0:
+        return f"{value * 1e3:.1f}ms"
+    return f"{value:.2f}s"
+
+
+def render_waterfall(check: dict, width: int = 40) -> str:
+    """One check's `am-tpu waterfall` report: the per-stage percentile
+    table over the SLO window plus an ASCII waterfall of the last run's
+    segments. Pure over a /statusz check entry so tests pin the
+    rendering byte-for-byte."""
+    from activemonitor_tpu.obs.criticalpath import (
+        QUANTILE_KEYS,
+        STAGES,
+    )
+
+    key = check.get("key") or "{}/{}".format(
+        check.get("namespace", ""), check.get("healthcheck", "")
+    )
+    block = check.get("critical_path")
+    if not block or not block.get("stages"):
+        return (
+            f"{key}: no critical-path evidence in the window yet "
+            "(runs need a retained trace to decompose)"
+        )
+    skewed = block.get("skewed_runs") or 0
+    header = "{}  dominant={}  runs={}{}  wall p95 {}".format(
+        key,
+        block.get("dominant_stage", "-"),
+        block.get("runs", 0),
+        f" ({skewed} skewed)" if skewed else "",
+        _fmt_secs((block.get("wall") or {}).get("p95")),
+    )
+    lines = [header]
+    headers = ["STAGE", "P50", "P95", "P99"]
+    rows = []
+    stages = block["stages"]
+    for stage in STAGES:
+        quantiles = stages.get(stage)
+        if not quantiles:
+            continue
+        rows.append(
+            [stage]
+            + [_fmt_secs(quantiles.get(q)) for q in QUANTILE_KEYS]
+        )
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+    lines.append(
+        "  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+    )
+    for row in rows:
+        lines.append(
+            "  " + "  ".join(c.ljust(w) for c, w in zip(row, widths))
+        )
+    last = block.get("last")
+    if last and last.get("segments") and last.get("wall_seconds"):
+        wall = last["wall_seconds"]
+        lines.append(
+            "  last run (trace {}, wall {}):".format(
+                (last.get("trace_id") or "-")[:16], _fmt_secs(wall)
+            )
+        )
+        label_w = max(len(seg.get("stage", "")) for seg in last["segments"])
+        for seg in last["segments"]:
+            offset = max(0.0, min(seg.get("offset_seconds", 0.0), wall))
+            seconds = max(0.0, min(seg.get("seconds", 0.0), wall - offset))
+            lead = int(round(width * offset / wall))
+            bar = max(1, int(round(width * seconds / wall)))
+            bar = min(bar, width - min(lead, width - 1))
+            lines.append(
+                "  {}  |{}|  {}".format(
+                    seg.get("stage", "").ljust(label_w),
+                    (" " * lead + "#" * bar).ljust(width),
+                    _fmt_secs(seg.get("seconds")),
+                )
+            )
+    return "\n".join(lines)
+
+
+async def _waterfall(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
+    matches = [
+        check
+        for check in payload.get("checks") or []
+        if check.get("healthcheck") == args.name
+        and (args.namespace is None or check.get("namespace") == args.namespace)
+    ]
+    if not matches:
+        where = f" in namespace {args.namespace!r}" if args.namespace else ""
+        print(
+            f"healthcheck {args.name!r}{where} not found in the fleet view",
+            file=sys.stderr,
+        )
+        return 1
+    if args.output == "json":
+        docs = [
+            {
+                "key": check.get("key"),
+                "critical_path": check.get("critical_path"),
+            }
+            for check in matches
+        ]
+        print(_json.dumps(docs[0] if len(docs) == 1 else docs, indent=2))
+        return 0
+    print("\n".join(render_waterfall(check) for check in matches))
+    return 0
+
+
 def _fmt_rate(value, bound: str) -> str:
     """Human ceiling/achieved cell: TFLOP/s on the compute/memory
     rooflines, GB/s on the comm one (where the block's *_flops fields
@@ -1874,6 +2061,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "describe": _describe,
         "status": _status,
         "why": _why,
+        "waterfall": _waterfall,
         "goodput": _goodput,
         "roofline": _roofline,
         "matrix": _matrix,

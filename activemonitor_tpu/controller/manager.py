@@ -71,6 +71,228 @@ def addr_same(a: str, b: str) -> bool:
     return port_a == port_b and host_a == host_b
 
 
+def _jax_profiler_trace(path: str):
+    """The default capture backend — imported lazily so a controller
+    that never arms a capture never pays the jax import."""
+    import jax
+
+    return jax.profiler.trace(path)
+
+
+class ProfileOnAnomaly:
+    """One bounded ``jax.profiler.trace`` capture per confirmed anomaly
+    (``--profile-on-anomaly DIR``; off by default).
+
+    The trigger sites — attribution confirming ok→degraded
+    (reconciler ``_note_analysis``) and a run pushing its SLO burn rate
+    past 1.0 (``FleetStatus._record``) — call :meth:`arm`; the NEXT
+    reconcile of that check then runs inside a profiler capture
+    (:meth:`capture`, wrapped around the worker's reconcile call).
+    Profiling the *next* run rather than the one that fired keeps the
+    trigger path free of profiler overhead and captures a run end to
+    end instead of from mid-flight.
+
+    Bounded three ways: a per-check cooldown (a flapping check cannot
+    fill the disk with captures), an armed-dedupe (N triggers between
+    runs arm ONE capture), and a directory byte cap — oldest capture
+    dirs prune beyond ``max_bytes``, and the ``captures.jsonl`` index
+    rotates through the shared ``rotate_capped`` like the flight
+    recorder's sink. Empty capture dirs (a probe that died before the
+    first device event) are swept, never shipped. Every landed capture
+    bumps ``healthcheck_profile_captures_total{reason}`` and records a
+    ``profile-capture`` flight bundle carrying the capture path and the
+    profiled run's waterfall. Never raises into the reconcile it wraps.
+    """
+
+    DEFAULT_COOLDOWN_SECONDS = 600.0
+    DEFAULT_MAX_BYTES = 256 << 20
+    CAPTURE_INDEX = "captures.jsonl"
+    INDEX_MAX_BYTES = 1 << 20
+
+    def __init__(
+        self,
+        clock,
+        directory: str = "",
+        cooldown: float = DEFAULT_COOLDOWN_SECONDS,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        metrics=None,
+        flightrec=None,
+        capture_factory=None,  # (path) -> context manager; tests inject
+    ):
+        self.clock = clock
+        self.directory = directory
+        self.cooldown = max(0.0, float(cooldown))
+        self.max_bytes = max(0, int(max_bytes))
+        self.metrics = metrics
+        self.flightrec = flightrec
+        self.capture_factory = capture_factory or _jax_profiler_trace
+        self._armed: Dict[str, str] = {}  # key -> trigger reason
+        self._last_capture: Dict[str, float] = {}
+        self._capture_paths: list = []  # oldest first, for the byte cap
+        self._seq = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.directory)
+
+    def arm(self, key: str, reason: str) -> bool:
+        """Request one capture of ``key``'s next run. Returns whether it
+        armed (False: disabled, already armed, or inside the per-check
+        cooldown). Never raises — trigger sites sit on the record path."""
+        try:
+            if not self.enabled or key in self._armed:
+                return False
+            last = self._last_capture.get(key)
+            if last is not None and (
+                self.clock.monotonic() - last < self.cooldown
+            ):
+                return False
+            self._armed[key] = reason
+            log.info("profile-on-anomaly armed for %s (%s)", key, reason)
+            return True
+        except Exception:
+            log.exception("profile arm failed for %s", key)
+            return False
+
+    def capture(self, key: str):
+        """The context manager the reconciler wraps one watch (probe
+        run) in: a real profiler capture when ``key`` is armed, a no-op
+        otherwise."""
+        reason = self._armed.pop(key, None)
+        if reason is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return _ProfileCapture(self, key, reason)
+
+    # -- internals (driven by _ProfileCapture) -------------------------
+    def _begin(self, key: str) -> str:
+        # the cooldown stamps at CAPTURE time: the armed run's own
+        # record may re-fire the trigger (its burn rate is still hot),
+        # and that re-arm must land inside the cooldown, not restart it
+        self._last_capture[key] = self.clock.monotonic()
+        self._seq += 1
+        safe = key.replace("/", "_").replace(os.sep, "_")
+        return os.path.join(self.directory, f"{safe}-{self._seq:06d}")
+
+    def _finish(self, key: str, reason: str, path: str) -> None:
+        from activemonitor_tpu.obs.journal import prune_empty_dirs, rotate_capped
+
+        # a capture that produced no device events leaves an empty dir
+        # tree — sweep it rather than shipping an empty artifact
+        prune_empty_dirs(path)
+        captured = os.path.isdir(path)
+        if captured:
+            self._capture_paths.append(path)
+            self._enforce_cap()
+            try:
+                os.makedirs(self.directory, exist_ok=True)
+                index = os.path.join(self.directory, self.CAPTURE_INDEX)
+                rotate_capped(index, self.INDEX_MAX_BYTES)
+                import json
+
+                with open(index, "a") as f:
+                    f.write(
+                        json.dumps(
+                            {
+                                "ts": self.clock.now().isoformat(),
+                                "check": key,
+                                "reason": reason,
+                                "path": path,
+                            }
+                        )
+                        + "\n"
+                    )
+            except OSError:
+                log.exception("capture index append failed")
+        if self.metrics is not None:
+            self.metrics.record_profile_capture(reason)
+        if self.flightrec is not None:
+            from activemonitor_tpu.obs.flightrec import KIND_PROFILE
+
+            self.flightrec.record(
+                KIND_PROFILE,
+                key=key,
+                reason=reason,
+                capture_path=path if captured else "",
+                captured=captured,
+            )
+        log.warning(
+            "profile capture for %s (%s): %s",
+            key,
+            reason,
+            path if captured else "no device events (dir swept)",
+        )
+
+    def _enforce_cap(self) -> None:
+        """Prune oldest capture dirs beyond the byte cap (the newest
+        always survives — a cap smaller than one capture still keeps
+        the evidence that was just paid for)."""
+        if self.max_bytes <= 0:
+            return
+
+        def _tree_bytes(root: str) -> int:
+            total = 0
+            for dirpath, _dirs, files in os.walk(root):
+                for name in files:
+                    try:
+                        total += os.path.getsize(os.path.join(dirpath, name))
+                    except OSError:
+                        pass
+            return total
+
+        sizes = {p: _tree_bytes(p) for p in self._capture_paths}
+        while len(self._capture_paths) > 1 and (
+            sum(sizes[p] for p in self._capture_paths) > self.max_bytes
+        ):
+            import shutil
+
+            oldest = self._capture_paths.pop(0)
+            sizes.pop(oldest, None)
+            try:
+                shutil.rmtree(oldest)
+            except OSError:
+                log.exception("capture prune failed for %s", oldest)
+                break
+
+
+class _ProfileCapture:
+    """One armed capture's lifecycle around a reconcile. Both edges are
+    best-effort: a profiler that fails to start (no jax, no devices)
+    still books the attempt — cooldown, counter, bundle — so a broken
+    profiler cannot re-arm itself into a tight capture loop."""
+
+    def __init__(self, profiler: ProfileOnAnomaly, key: str, reason: str):
+        self.profiler = profiler
+        self.key = key
+        self.reason = reason
+        self.path = ""
+        self._cm = None
+
+    def __enter__(self):
+        prof = self.profiler
+        try:
+            self.path = prof._begin(self.key)
+            self._cm = prof.capture_factory(self.path)
+            self._cm.__enter__()
+        except Exception:
+            log.exception("profiler capture start failed for %s", self.key)
+            self._cm = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            if self._cm is not None:
+                self._cm.__exit__(exc_type, exc, tb)
+        except Exception:
+            log.exception("profiler capture stop failed for %s", self.key)
+        try:
+            self.profiler._finish(self.key, self.reason, self.path)
+        except Exception:
+            log.exception("profiler capture finish failed for %s", self.key)
+        return False  # never swallow the reconcile's own exception
+
+
 class Manager:
     def __init__(
         self,
@@ -93,6 +315,9 @@ class Manager:
         frontdoor=None,  # FrontDoor: probe-as-a-service ingestion surface
         journal_dir: str = "",  # durable telemetry journal dir; "" = no journal
         journal_max_bytes: int = 0,  # per-segment byte cap; 0 = journal default
+        profile_on_anomaly_dir: str = "",  # capture dir; "" = profiling off
+        profile_cooldown: float = ProfileOnAnomaly.DEFAULT_COOLDOWN_SECONDS,
+        profile_max_bytes: int = 0,  # capture-dir byte cap; 0 = default
     ):
         self.client = client
         self.reconciler = reconciler
@@ -128,6 +353,9 @@ class Manager:
         if frontdoor is not None:
             frontdoor.bind(self._frontdoor_trigger)
             reconciler.fleet.frontdoor = frontdoor
+            # the door's admission decisions land as spans on the runs
+            # they trigger/join — the waterfall's `admission` stage
+            frontdoor.tracer = reconciler.tracer
             if shard_coordinator is not None:
                 # sharded fleet: a miss for a key another replica owns
                 # must refuse `unrouted` (naming its shard) instead of
@@ -173,6 +401,25 @@ class Manager:
             )
         else:
             reconciler.resilience.configure_remedy_rate(remedy_rate)
+        # --profile-on-anomaly (ProfileOnAnomaly above): a confirmed
+        # degradation or a burn-rate crossing arms ONE bounded profiler
+        # capture of the check's next run; both trigger sites are wired
+        # here so a standalone reconciler/fleet never profiles
+        self._profiler = ProfileOnAnomaly(
+            clock=reconciler.clock,
+            directory=profile_on_anomaly_dir,
+            cooldown=profile_cooldown,
+            max_bytes=profile_max_bytes or ProfileOnAnomaly.DEFAULT_MAX_BYTES,
+            metrics=reconciler.metrics,
+            flightrec=reconciler.flightrec,
+        )
+        if self._profiler.enabled:
+            reconciler.profile_hook = self._profiler.arm
+            reconciler.fleet.profile_hook = self._profiler.arm
+            # the capture itself wraps the WATCH task (the probe run),
+            # not the scheduling reconcile — a no-op reconcile must not
+            # consume an armed capture
+            reconciler.profile_capture = self._profiler.capture
         # failed-run requeues ride this manager's workqueue: per-key
         # serialized, stop-aware, re-rate-limited on crash — never a
         # loop inside a dying watch/timer task
@@ -280,44 +527,53 @@ class Manager:
         self._http_runners: list = []
         self.reconciler.metrics.set_max_concurrent(self.max_parallel)
 
-    def _frontdoor_trigger(self, namespace: str, name: str) -> None:
+    def _frontdoor_trigger(self, namespace: str, name: str) -> Optional[str]:
         """The front door's run trigger: mark the cycle demand-driven
         (the schedule-current dedupe must not swallow it — the tenant
         asked for a fresher answer than the rings hold) and ride the
         ordinary workqueue, so sharding/tracing/attribution/SLO
-        accounting apply to the triggered run unchanged."""
+        accounting apply to the triggered run unchanged. Returns the
+        cycle's trace id (enqueue pre-mints it) so the door can book
+        its admission span on the run it just triggered."""
         self.reconciler.demand(namespace, name)
-        self.enqueue(namespace, name)
+        return self.enqueue(namespace, name)
 
     # -- queue ----------------------------------------------------------
     # controller-runtime workqueue semantics: a queued key coalesces new
     # events; a key being PROCESSED is marked dirty and re-queued after
     # its reconcile finishes, so one key never reconciles concurrently.
-    def enqueue(self, namespace: str, name: str) -> None:
+    def enqueue(self, namespace: str, name: str) -> Optional[str]:
+        """Queue one reconcile; returns the cycle's pre-minted trace id
+        (the pending one when the key coalesced, None when the key is
+        unowned or deferred dirty) — the front door attaches its
+        admission span to the trace this returns."""
         key = f"{namespace}/{name}"
         metrics = self.reconciler.metrics
         if self._shards is not None and not self._shards.owns_key(key):
-            return  # another shard's owner reconciles this key
+            return None  # another shard's owner reconciles this key
         if key in self._processing:
             self._dirty.add(key)
             # client-go counts EVERY Add() — coalesced and dirty-deferred
             # included — so rate(workqueue_adds_total) reads true event
             # pressure even when the queue absorbs it
             metrics.record_queue_add(self._queue.qsize())
-            return
+            return None
         if key in self._queued:
             metrics.record_queue_add(self._queue.qsize())
-            return  # coalesce: already pending
+            pending = self._pending_trace.get(key)
+            return pending[0] if pending else None  # coalesce: already pending
         self._queued.add(key)
         # the trace starts HERE — the cycle's invisible window opens at
         # enqueue, and queue wait must be attributable like every other
         # phase
+        trace_id = self.reconciler.tracer.new_trace_id()
         self._pending_trace[key] = (
-            self.reconciler.tracer.new_trace_id(),
+            trace_id,
             self.reconciler.clock.monotonic(),
         )
         self._queue.put_nowait((namespace, name))
         metrics.record_queue_add(self._queue.qsize())
+        return trace_id
 
     async def _watch_loop(self, iterator) -> None:
         async for event in iterator:
@@ -553,6 +809,10 @@ class Manager:
                 # sidecar's latest round into the healthcheck_matrix_*
                 # families, once per new round
                 self.reconciler.fleet.refresh_matrix_metrics()
+                # critical-path stage gauges: walks every check's
+                # windowed traces — rollup-cadence work, never
+                # reconcile-path work (obs/criticalpath.py)
+                self.reconciler.fleet.refresh_critical_path_metrics(checks)
                 # journal level gauges (--journal-dir) + compaction of
                 # aged-out segments — rollup-cadence work like the rest
                 self.reconciler.fleet.refresh_journal_metrics()

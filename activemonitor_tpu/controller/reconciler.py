@@ -178,6 +178,16 @@ class HealthCheckReconciler:
         # workqueue (per-key serialized, stop-aware, retried on crash)
         # instead of a loop inside the dying task
         self.requeue_hook = None
+        # set by the Manager (--profile-on-anomaly): called with
+        # (key, reason) when attribution confirms ok→degraded, arming
+        # one bounded profiler capture of the check's next run. None:
+        # profiling off.
+        self.profile_hook = None
+        # also set by the Manager: a context-manager factory (key) ->
+        # profiler capture wrapping the check's next WATCH (the actual
+        # probe run: submit..poll..status write), not the scheduling
+        # reconcile. None: no-op.
+        self.profile_capture = None
         self._stopping = False
         self._requeue_loops: set = set()  # standalone-mode fallback loops
 
@@ -595,6 +605,16 @@ class HealthCheckReconciler:
                     transition=list(verdict.transition),
                     zscores=dict(verdict.zscores),
                 )
+                if self.profile_hook is not None:
+                    # a confirmed degradation is the other trigger for
+                    # profile-on-anomaly (burn-rate lives in the SLO
+                    # layer): arm one capture of this check's NEXT run
+                    try:
+                        self.profile_hook(hc.key, "degraded")
+                    except Exception:
+                        log.exception(
+                            "profile hook failed for %s", hc.key
+                        )
             if worsened:
                 self.recorder.event(
                     hc,
@@ -797,7 +817,14 @@ class HealthCheckReconciler:
         engine/client error must not silently kill the check's schedule
         — emulate the reference's 1s requeue (:204) by re-reconciling."""
         try:
-            await self._watch_workflow_reschedule(hc, wf_name)
+            if self.profile_capture is not None:
+                # an armed profile-on-anomaly capture wraps exactly this
+                # run (the watch IS the probe run: poll + status write);
+                # a no-op context otherwise
+                with self.profile_capture(hc.key):
+                    await self._watch_workflow_reschedule(hc, wf_name)
+            else:
+                await self._watch_workflow_reschedule(hc, wf_name)
         except asyncio.CancelledError:
             raise
         except ShardFencedError as e:

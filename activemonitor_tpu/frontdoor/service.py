@@ -44,8 +44,8 @@ from __future__ import annotations
 import asyncio
 import logging
 from collections import deque
-from dataclasses import dataclass
-from typing import Callable, Deque, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from activemonitor_tpu.frontdoor.admission import (
     PRE_ADMISSION_REASONS,
@@ -99,6 +99,10 @@ class Ticket:
     reason: str = ""  # refusal reason; "" otherwise
     result: Optional[CheckResult] = None  # immediate for cache hits
     future: Optional[asyncio.Future] = None  # joined / run / parked
+    # the decision's lifecycle on the door's monotonic clock —
+    # ("admit"|"coalesce-join"|"demand-fire"|"enqueue"|"parked", t) in
+    # order; the critical-path waterfall's front-door evidence
+    lifecycle: List[Tuple[str, float]] = field(default_factory=list)
 
     @property
     def trace_id(self) -> str:
@@ -182,6 +186,15 @@ class FrontDoor:
         # submit's decision is journaled as one `arrival` event from
         # _account — the single point every outcome passes through
         self.journal = None
+        # span tracer (obs/trace.py), wired by the Manager: the door's
+        # admission decision is recorded as an `admission` span INTO
+        # the triggered cycle's trace, so the critical-path waterfall
+        # sees the front-door hop. None: lifecycle-only evidence.
+        self.tracer = None
+        # check -> the trace id of its most recently triggered run, so
+        # coalesce-joins attach their admission spans to the run they
+        # actually ride (bounded by the fleet's check count)
+        self._inflight_trace: Dict[str, str] = {}
         self._last_arrival: Optional[float] = None
         # the DAG shape note for arrival events submitted via run_dag
         self._dag_shape: Optional[dict] = None
@@ -264,6 +277,7 @@ class FrontDoor:
             )
             self._account(ticket, started, booked)
             return ticket
+        lifecycle: List[Tuple[str, float]] = [("admit", started)]
         outcome, fresh = self.cache.lookup(check, freshness)
         if outcome == LOOKUP_HIT:
             tally.cache_hits += 1
@@ -275,10 +289,17 @@ class FrontDoor:
                 outcome=OUTCOME_HIT,
                 shard=decision.shard,
                 result=fresh,
+                lifecycle=lifecycle,
             )
         elif outcome == LOOKUP_INFLIGHT:
             tally.joins += 1
             self._totals.joins += 1
+            lifecycle.append(("coalesce-join", self.clock.monotonic()))
+            # the join rides an in-flight run: its admission decision
+            # is front-door time ON that run's critical path too
+            self._record_admission(
+                self._inflight_trace.get(check, ""), started
+            )
             ticket = Ticket(
                 rid=rid,
                 tenant=tenant,
@@ -286,6 +307,7 @@ class FrontDoor:
                 outcome=OUTCOME_JOINED,
                 shard=decision.shard,
                 future=self.cache.join(check),
+                lifecycle=lifecycle,
             )
         elif self.degraded:
             # breaker open: PARK, never drop — the cache already served
@@ -306,6 +328,7 @@ class FrontDoor:
             else:
                 tally.parked += 1
                 self._totals.parked += 1
+                lifecycle.append(("parked", self.clock.monotonic()))
                 fut: asyncio.Future = (
                     asyncio.get_running_loop().create_future()
                 )
@@ -326,12 +349,18 @@ class FrontDoor:
                     outcome=OUTCOME_PARKED,
                     shard=decision.shard,
                     future=fut,
+                    lifecycle=lifecycle,
                 )
         else:
             tally.runs += 1
             self._totals.runs += 1
             self.cache.begin(check)
-            self._trigger(check, decision.shard)
+            lifecycle.append(("demand-fire", self.clock.monotonic()))
+            run_trace = self._trigger(check, decision.shard)
+            lifecycle.append(("enqueue", self.clock.monotonic()))
+            if run_trace:
+                self._inflight_trace[check] = run_trace
+            self._record_admission(run_trace, started)
             ticket = Ticket(
                 rid=rid,
                 tenant=tenant,
@@ -339,6 +368,7 @@ class FrontDoor:
                 outcome=OUTCOME_RUN,
                 shard=decision.shard,
                 future=self.cache.join(check),
+                lifecycle=lifecycle,
             )
         self._account(ticket, started, booked)
         return ticket
@@ -429,7 +459,12 @@ class FrontDoor:
                 tally.runs += 1
                 self._totals.runs += 1
                 self.cache.begin(parked.check)
-                self._trigger(parked.check, parked.shard)
+                run_trace = self._trigger(parked.check, parked.shard)
+                if run_trace:
+                    self._inflight_trace[parked.check] = run_trace
+                # the pumped run's admission span covers the whole
+                # parked wait — that IS where the request's time went
+                self._record_admission(run_trace, parked.parked_at)
                 self._chain(self.cache.join(parked.check), parked.future)
             pumped += 1
         self._refresh_gauges()
@@ -446,6 +481,7 @@ class FrontDoor:
         )
         for key in stale:
             self.cache.forget(key)
+            self._inflight_trace.pop(key, None)
             self.reaped_runs += 1
         if stale:
             self._refresh_gauges()
@@ -475,14 +511,34 @@ class FrontDoor:
 
         source.add_done_callback(_copy)
 
-    def _trigger(self, check: str, shard: int) -> None:
+    def _trigger(self, check: str, shard: int) -> Optional[str]:
         trigger = self._backends.get(shard, self._backends.get(None))
         if trigger is None:
             raise RuntimeError(
                 "front door has no backend bound (FrontDoor.bind)"
             )
         namespace, _, name = check.partition("/")
-        trigger(namespace, name)
+        # Manager.enqueue returns the cycle's (pending) trace id so the
+        # admission span lands on the run it triggered; a plain
+        # backend returning None costs the span, never the trigger
+        return trigger(namespace, name)
+
+    def _record_admission(self, trace_id: Optional[str], started: float) -> None:
+        """Book the admission decision as a span on the triggered (or
+        joined) run's trace — the waterfall's ``admission`` stage.
+        Best-effort: no tracer / no trace id / a recording error costs
+        the span, never the submit."""
+        if self.tracer is None or not trace_id:
+            return
+        try:
+            self.tracer.record_span(
+                "admission",
+                start=started,
+                end=self.clock.monotonic(),
+                trace_id=trace_id,
+            )
+        except Exception:
+            log.exception("admission span recording failed")
 
     def _note_qps(self, now: float) -> None:
         if self._qps_bucket_start is None:

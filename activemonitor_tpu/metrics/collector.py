@@ -660,6 +660,30 @@ class MetricsCollector:
             stream: self.journal_appended.labels(stream)
             for stream in ("result", "attribution", "arrival")
         }
+        # -- critical-path families (obs/criticalpath.py is the single
+        # writer; docs/observability.md "Reading a waterfall"). Stage
+        # cardinality is the fixed vocabulary CRITICAL_PATH_STAGES and
+        # quantile the fixed p50/p95/p99 trio — 24 series per check,
+        # the same budget line the check-state laziness defends.
+        self.critical_path_seconds = Gauge(
+            "healthcheck_critical_path_seconds",
+            "Per-stage critical-path latency quantiles over the "
+            "check's recent runs (queue_wait / admission / schedule / "
+            "submit / poll / probe_phase / status_write, with every "
+            "uninstrumented second booked as untracked) — the stage "
+            "durations of one run sum to its wall span exactly",
+            [LABEL_HC, "namespace", "stage", "quantile"],
+            registry=self.registry,
+        )
+        self.profile_captures = Counter(
+            "healthcheck_profile_captures_total",
+            "Bounded jax.profiler.trace captures fired by "
+            "profile-on-anomaly, by trigger reason (degraded / "
+            "burn_rate); cooldown-suppressed repeats do not count",
+            ["reason"],
+            registry=self.registry,
+        )
+        self._critical_path_series: set = set()
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -1055,6 +1079,45 @@ class MetricsCollector:
 
     def set_journal_lag(self, seconds: float) -> None:
         self.journal_lag_seconds.set(max(0.0, seconds))
+
+    # -- critical-path families (written by obs/criticalpath via
+    #    obs/slo.py's refresh loop, off the reconcile path) ------------
+    def set_critical_path(
+        self, hc_name: str, namespace: str, block: Optional[dict]
+    ) -> None:
+        """Refresh a check's per-stage quantile gauges from its
+        aggregated ``critical_path`` block (same dict /statusz serves,
+        so the two surfaces cannot drift). A None/empty block clears
+        the series — a check whose window emptied stops advertising a
+        stale decomposition."""
+        if not block or not block.get("stages"):
+            self.clear_critical_path(hc_name, namespace)
+            return
+        self._critical_path_series.add((hc_name, namespace))
+        for stage, quantiles in block["stages"].items():
+            for key, value in quantiles.items():
+                self.critical_path_seconds.labels(
+                    hc_name, namespace, stage, key
+                ).set(float(value))
+
+    def clear_critical_path(self, hc_name: str, namespace: str) -> None:
+        """Deleted (or windowless) check: drop its stage series."""
+        if (hc_name, namespace) not in self._critical_path_series:
+            return
+        self._critical_path_series.discard((hc_name, namespace))
+        from activemonitor_tpu.obs.criticalpath import QUANTILE_KEYS, STAGES
+
+        for stage in STAGES:
+            for key in QUANTILE_KEYS:
+                try:
+                    self.critical_path_seconds.remove(
+                        hc_name, namespace, stage, key
+                    )
+                except KeyError:
+                    pass  # never recorded — nothing to drop
+
+    def record_profile_capture(self, reason: str) -> None:
+        self.profile_captures.labels(reason).inc()
 
     # -- dynamic custom metrics ---------------------------------------
     # recorded-run memory bound: at one run a second this is ~34 min of

@@ -1,0 +1,393 @@
+"""Cross-layer critical-path waterfall: where one request's time went.
+
+Attribution (obs/attribution.py) says which SUBSYSTEM loses goodput and
+the roofline (obs/roofline.py) says which hardware ceiling a probe
+hits, but neither answers the operator's first question about a slow
+check: *where did the milliseconds of this run go?* The evidence is
+already recorded — the cycle's spans (obs/trace.py), the probe's
+``PhaseTimings``, the front door's admission span, the serving
+scheduler's token-exact stamps — it just lives in four places that
+nothing joins. This module is that join, kept pure and wall-clock-free
+(``hack/lint.py`` bans ``time.time()``/``time.monotonic()`` here; every
+timestamp arrives inside a span or a scheduler stamp, so fake-clock
+tests replay exact waterfalls):
+
+- :func:`build_waterfall` — one trace's finished spans (+ the run's
+  phase timings) folded into per-stage seconds over the fixed stage
+  vocabulary :data:`STAGES`, with a computed ``dominant_stage`` and
+  every second the spans do not cover booked honestly as ``untracked``
+  — the per-stage seconds (``untracked`` included) sum to the trace's
+  wall span exactly, the conservation the acceptance test pins to
+  ±1e-9.
+- :func:`queue_wait` / :func:`errored_span_names` — THE queue-wait and
+  span-error definitions. Attribution's ``scheduling`` bucket
+  (``FleetStatus._classify_inner``) and the waterfall's ``queue_wait``
+  stage both read these, so the two surfaces can never disagree about
+  how long a run sat in the workqueue.
+- :func:`aggregate_waterfalls` — rolling p50/p95/p99 per stage over a
+  check's recent waterfalls: the ``/statusz`` ``critical_path`` block
+  and the ``healthcheck_critical_path_seconds{stage,quantile}`` gauges.
+- :func:`merge_critical_path_blocks` / :func:`skew_block` — the
+  multi-replica rollup (run-weighted, the goodput merge's convention);
+  an old-binary replica that reports no block books its whole measured
+  latency under ``untracked`` rather than vanishing from the fleet
+  view.
+- :func:`decompose_ttft` — the serving probe's TTFT split into
+  queue-wait vs prefill vs first-decode, read off the PR 14
+  scheduler's token-exact ``admitted_at`` / ``first_token_at`` /
+  ``first_decode_at`` stamps.
+
+Stage semantics (the vocabulary table in docs/observability.md):
+``queue_wait`` is the workqueue's dequeue span, ``admission`` the
+front door's submit-decision span, ``schedule`` the reconciler's
+parse/decision span, ``submit``/``poll``/``status_write`` the engine
+spans, ``probe_phase`` the probe's own ``PhaseTimings`` carved out of
+the poll window it ran inside, and ``untracked`` everything the spans
+leave uncovered — booked, never hidden.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+# ---------------------------------------------------------------------
+# stage vocabulary — pinned by tests/test_lint.py across criticalpath,
+# the metrics collector's stage-label validation, and the docs table.
+# Path order: the order a healthy cycle traverses them.
+# ---------------------------------------------------------------------
+
+STAGE_QUEUE_WAIT = "queue_wait"
+STAGE_ADMISSION = "admission"
+STAGE_SCHEDULE = "schedule"
+STAGE_SUBMIT = "submit"
+STAGE_POLL = "poll"
+STAGE_PROBE_PHASE = "probe_phase"
+STAGE_STATUS_WRITE = "status_write"
+STAGE_UNTRACKED = "untracked"
+
+STAGES = (
+    STAGE_QUEUE_WAIT,
+    STAGE_ADMISSION,
+    STAGE_SCHEDULE,
+    STAGE_SUBMIT,
+    STAGE_POLL,
+    STAGE_PROBE_PHASE,
+    STAGE_STATUS_WRITE,
+    STAGE_UNTRACKED,
+)
+
+# span name -> stage. Root spans ("reconcile" from the workqueue path,
+# "cycle" from the timer path) are deliberately unmapped: they cover
+# the whole window, and the booked stages are their children.
+SPAN_STAGES = {
+    "dequeue": STAGE_QUEUE_WAIT,
+    "admission": STAGE_ADMISSION,
+    "parse": STAGE_SCHEDULE,
+    "submit": STAGE_SUBMIT,
+    "poll": STAGE_POLL,
+    "status_write": STAGE_STATUS_WRITE,
+}
+
+QUANTILES = (0.50, 0.95, 0.99)
+QUANTILE_KEYS = tuple(f"p{int(q * 100)}" for q in QUANTILES)
+
+
+def _quantile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile — ``sorted[ceil(q*n)-1]``, the SLO layer's
+    exact convention (obs/slo.quantile; re-stated here rather than
+    imported because slo.py imports THIS module for the classify-time
+    queue-wait — the parity test in test_lint pins the two against each
+    other). Callers guarantee a non-empty sample."""
+    ordered = sorted(values)
+    rank = min(len(ordered), max(1, math.ceil(q * len(ordered))))
+    return float(ordered[rank - 1])
+
+
+# ---------------------------------------------------------------------
+# the one queue-wait / span-error definition (satellite of ISSUE 17:
+# attribution's scheduling bucket and the waterfall read the same code)
+# ---------------------------------------------------------------------
+
+
+def queue_wait(spans) -> float:
+    """Seconds the cycle sat in the workqueue: the longest finished
+    ``dequeue`` span in the trace (the manager records exactly one per
+    cycle; max is the defensive fold if a replay ever doubles it)."""
+    wait = 0.0
+    for span in spans:
+        if getattr(span, "name", "") == "dequeue":
+            duration = getattr(span, "duration", None)
+            if duration:
+                wait = max(wait, float(duration))
+    return wait
+
+
+def errored_span_names(spans) -> List[str]:
+    """Names of spans an exception escaped — the control-plane evidence
+    attribution feeds to ``classify_run(errored_spans=...)``."""
+    return [
+        span.name for span in spans if getattr(span, "error", "")
+    ]
+
+
+# ---------------------------------------------------------------------
+# per-request waterfall
+# ---------------------------------------------------------------------
+
+
+def build_waterfall(
+    spans, timings: Optional[dict] = None, trace_id: str = ""
+) -> Optional[dict]:
+    """Fold one trace's finished spans into a waterfall dict::
+
+        {"trace_id", "wall_seconds", "stages": {stage: seconds},
+         "dominant_stage", "segments": [{stage, offset_seconds, seconds}]}
+
+    ``stages`` carries every name in :data:`STAGES` and sums to
+    ``wall_seconds`` exactly (``untracked`` included). Booking is
+    innermost-wins segmentation over the mapped spans: every elementary
+    interval between span boundaries goes to the covering span that
+    started LAST, so a nested span carves time out of its parent and
+    cross-stage overlap can never double-book. The probe's
+    ``PhaseTimings`` (durations without absolute placement) carve out
+    of the ``poll`` stage they ran inside, capped at it. Returns None
+    when the trace has no finished spans."""
+    finished = [
+        s for s in spans if getattr(s, "end", None) is not None
+    ]
+    if not finished:
+        return None
+    t0 = min(s.start for s in finished)
+    t1 = max(s.end for s in finished)
+    wall = max(0.0, t1 - t0)
+    stages = {stage: 0.0 for stage in STAGES}
+    mapped = [
+        (s.start, s.end, SPAN_STAGES[s.name])
+        for s in finished
+        if s.name in SPAN_STAGES and s.end > s.start
+    ]
+    points = sorted({p for a, b, _stage in mapped for p in (a, b)})
+    for a, b in zip(points, points[1:]):
+        covering = [m for m in mapped if m[0] <= a and m[1] >= b]
+        if not covering:
+            continue
+        stage = max(covering, key=lambda m: (m[0], -m[1]))[2]
+        stages[stage] += b - a
+    # probe phases: measured inside the probe process, so they subdivide
+    # the poll window — never exceed it (a probe timing more work than
+    # the controller polled for would un-conserve the sum)
+    phase_total = 0.0
+    for value in (timings or {}).values():
+        try:
+            phase_total += max(0.0, float(value))
+        except (TypeError, ValueError):
+            continue
+    probe_phase = min(phase_total, stages[STAGE_POLL])
+    stages[STAGE_POLL] -= probe_phase
+    stages[STAGE_PROBE_PHASE] = probe_phase
+    stages[STAGE_UNTRACKED] = max(
+        0.0, wall - sum(stages[s] for s in STAGES if s != STAGE_UNTRACKED)
+    )
+    # earliest booked offset per stage, for the ASCII waterfall — the
+    # probe phases inherit the poll window's start
+    offsets: Dict[str, float] = {}
+    for a, _b, stage in mapped:
+        offsets[stage] = min(offsets.get(stage, a - t0), a - t0)
+    if probe_phase > 0 and STAGE_POLL in offsets:
+        offsets[STAGE_PROBE_PHASE] = offsets[STAGE_POLL]
+    segments = [
+        {
+            "stage": stage,
+            "offset_seconds": offsets.get(stage, 0.0),
+            "seconds": stages[stage],
+        }
+        for stage in STAGES
+        if stages[stage] > 0.0 and stage != STAGE_UNTRACKED
+    ]
+    segments.sort(key=lambda seg: (seg["offset_seconds"], STAGES.index(seg["stage"])))
+    return {
+        "trace_id": trace_id or getattr(finished[0], "trace_id", ""),
+        "wall_seconds": wall,
+        "stages": stages,
+        "dominant_stage": dominant_stage(stages),
+        "segments": segments,
+    }
+
+
+def dominant_stage(stages: Dict[str, float]) -> str:
+    """The stage holding the most seconds; ties break in path order
+    (:data:`STAGES`), so a deterministic answer on scripted clocks."""
+    return max(STAGES, key=lambda s: float(stages.get(s) or 0.0))
+
+
+# ---------------------------------------------------------------------
+# rolling aggregation: the /statusz critical_path block
+# ---------------------------------------------------------------------
+
+
+def aggregate_waterfalls(waterfalls: Sequence[dict]) -> Optional[dict]:
+    """p50/p95/p99 per stage over a window of waterfalls (oldest first;
+    ``last`` is the newest run's full waterfall). ``dominant_stage`` is
+    the stage with the largest p95 — the tail is what pages. Returns
+    None over an empty window; ``skewed_runs`` is 0 here and non-zero
+    only in :func:`skew_block` / the rollup merge."""
+    if not waterfalls:
+        return None
+    walls = [float(w.get("wall_seconds") or 0.0) for w in waterfalls]
+    stages = {}
+    for stage in STAGES:
+        values = [
+            float((w.get("stages") or {}).get(stage) or 0.0)
+            for w in waterfalls
+        ]
+        stages[stage] = {
+            key: _quantile(values, q)
+            for key, q in zip(QUANTILE_KEYS, QUANTILES)
+        }
+    return {
+        "runs": len(waterfalls),
+        "skewed_runs": 0,
+        "wall": {
+            key: _quantile(walls, q)
+            for key, q in zip(QUANTILE_KEYS, QUANTILES)
+        },
+        "stages": stages,
+        "dominant_stage": max(
+            STAGES, key=lambda s: stages[s][QUANTILE_KEYS[1]]
+        ),
+        "last": waterfalls[-1],
+    }
+
+
+def skew_block(payload: dict) -> Optional[dict]:
+    """Version-skew fallback for the rollup: an old-binary replica
+    serves no ``critical_path`` block, but its per-check window
+    quantiles still measure the path end to end — so its runs merge
+    with their WHOLE latency booked under ``untracked`` (run-weighted
+    mean of the per-check quantiles), never silently dropped. Returns
+    None when the replica has no windowed runs either."""
+    runs = 0
+    weighted = {key: 0.0 for key in QUANTILE_KEYS}
+    for entry in payload.get("checks") or []:
+        window = entry.get("window") or {}
+        n = int(window.get("results") or 0)
+        if n <= 0:
+            continue
+        runs += n
+        for key in QUANTILE_KEYS:
+            weighted[key] += float(window.get(f"{key}_seconds") or 0.0) * n
+    if runs == 0:
+        return None
+    untracked = {key: weighted[key] / runs for key in QUANTILE_KEYS}
+    zero = {key: 0.0 for key in QUANTILE_KEYS}
+    return {
+        "runs": runs,
+        "skewed_runs": runs,
+        "wall": dict(untracked),
+        "stages": {
+            stage: (
+                dict(untracked) if stage == STAGE_UNTRACKED else dict(zero)
+            )
+            for stage in STAGES
+        },
+        "dominant_stage": STAGE_UNTRACKED,
+        "last": None,
+    }
+
+
+def merge_critical_path_blocks(
+    blocks: Sequence[Optional[dict]],
+) -> Optional[dict]:
+    """Run-weighted merge of per-replica fleet blocks — the goodput
+    merge's convention: each percentile value is the mean of the
+    replicas' values weighted by their windowed runs (an approximation,
+    same as the merged goodput ratio, and labelled as such in the
+    docs). ``skewed_runs`` sums, so the fleet view says how much of the
+    path is old-binary ``untracked`` rather than measured. ``last`` is
+    first-seen-wins like the rollup's check dedupe."""
+    real = [
+        b for b in blocks
+        if isinstance(b, dict) and int(b.get("runs") or 0) > 0
+    ]
+    if not real:
+        return None
+    total = sum(int(b["runs"]) for b in real)
+    stages = {}
+    for stage in STAGES:
+        stages[stage] = {
+            key: sum(
+                float(
+                    ((b.get("stages") or {}).get(stage) or {}).get(key)
+                    or 0.0
+                )
+                * int(b["runs"])
+                for b in real
+            )
+            / total
+            for key in QUANTILE_KEYS
+        }
+    wall = {
+        key: sum(
+            float((b.get("wall") or {}).get(key) or 0.0) * int(b["runs"])
+            for b in real
+        )
+        / total
+        for key in QUANTILE_KEYS
+    }
+    last = next(
+        (b["last"] for b in real if isinstance(b.get("last"), dict)), None
+    )
+    return {
+        "runs": total,
+        "skewed_runs": sum(int(b.get("skewed_runs") or 0) for b in real),
+        "wall": wall,
+        "stages": stages,
+        "dominant_stage": max(
+            STAGES, key=lambda s: stages[s][QUANTILE_KEYS[1]]
+        ),
+        "last": last,
+    }
+
+
+# ---------------------------------------------------------------------
+# serving TTFT decomposition (scheduler/serving.py token-exact stamps)
+# ---------------------------------------------------------------------
+
+
+def decompose_ttft(sequences) -> Optional[dict]:
+    """TTFT split per sequence from the continuous-batching scheduler's
+    stamps: ``queue_wait`` (arrival → admission), ``prefill``
+    (admission → first token; the two sum to TTFT exactly) and
+    ``first_decode`` (first token → the first shared decode step's
+    token; 0.0 for one-token requests). p50/p95/p99 over sequences that
+    produced a first token; None when none did."""
+    rows = []
+    for seq in sequences:
+        first_token = getattr(seq, "first_token_at", None)
+        if first_token is None:
+            continue
+        arrival = seq.req.arrival
+        admitted = seq.admitted_at
+        first_decode = getattr(seq, "first_decode_at", None)
+        rows.append(
+            (
+                max(0.0, admitted - arrival),
+                max(0.0, first_token - admitted),
+                (
+                    max(0.0, first_decode - first_token)
+                    if first_decode is not None
+                    else 0.0
+                ),
+            )
+        )
+    if not rows:
+        return None
+    out = {"samples": len(rows)}
+    for index, name in enumerate(("queue_wait", "prefill", "first_decode")):
+        values = [row[index] for row in rows]
+        out[name] = {
+            key: _quantile(values, q)
+            for key, q in zip(QUANTILE_KEYS, QUANTILES)
+        }
+    return out

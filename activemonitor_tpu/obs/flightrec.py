@@ -20,6 +20,10 @@ Bundle contract (pinned by the statusz schema contract test):
 - ``baselines`` — the analysis layer's learned stats at trigger time.
 - ``resilience``/``sharding`` — breaker + shard-ownership snapshots.
 - ``attribution`` — the check's windowed lost-goodput decomposition.
+- ``waterfall`` — the triggering trace's critical-path decomposition
+  (obs/criticalpath.py: per-stage seconds summing to the wall span,
+  gaps booked as ``untracked``); null when the trace has no finished
+  spans.
 - ``roofline`` — the check's latest roofline snapshot (obs/roofline.py:
   per-metric bound/intensity/fraction with its cost source) so a
   postmortem reader sees WHERE against the hardware ceilings the check
@@ -55,7 +59,18 @@ KIND_HANDOFF = "shard-handoff"
 # evidence (the regressing round's cell entry, the prior round's, and
 # the auto-bisect verdict)
 KIND_MATRIX = "matrix-regression"
-KINDS = (KIND_DEGRADED, KIND_BREAKER, KIND_QUARANTINE, KIND_HANDOFF, KIND_MATRIX)
+# a profile-on-anomaly capture landed (controller/manager.py
+# ProfileOnAnomaly): the bundle's extra carries the capture directory
+# path and the trigger reason, next to the profiled run's waterfall
+KIND_PROFILE = "profile-capture"
+KINDS = (
+    KIND_DEGRADED,
+    KIND_BREAKER,
+    KIND_QUARANTINE,
+    KIND_HANDOFF,
+    KIND_MATRIX,
+    KIND_PROFILE,
+)
 
 DEFAULT_CAPACITY = 256  # bundles retained in memory
 SPAN_TAIL = 20  # fallback span excerpt when no trace is active
@@ -116,11 +131,29 @@ class FlightRecorder:
             last = self.history.last(key)
             trace_id = last.trace_id if last is not None else ""
         spans: List[dict] = []
+        waterfall = None
         if self.tracer is not None:
-            if trace_id:
-                spans = [
-                    s.to_dict() for s in self.tracer.spans_for_trace(trace_id)
-                ]
+            live_spans = (
+                self.tracer.spans_for_trace(trace_id) if trace_id else []
+            )
+            if live_spans:
+                # the waterfall must fold LIVE Span objects: to_dict()
+                # deliberately drops the raw monotonic start/end floats
+                # (wall timestamps only), so it cannot be rebuilt from
+                # the serialized spans below
+                from activemonitor_tpu.obs import criticalpath
+
+                last = (
+                    self.history.last(key)
+                    if self.history is not None and key
+                    else None
+                )
+                waterfall = criticalpath.build_waterfall(
+                    live_spans,
+                    timings=getattr(last, "timings", None),
+                    trace_id=trace_id,
+                )
+                spans = [s.to_dict() for s in live_spans]
             if not spans:
                 spans = [
                     s.to_dict()
@@ -156,6 +189,7 @@ class FlightRecorder:
             "sharding": sharding,
             "attribution": attribution,
             "roofline": roofline,
+            "waterfall": waterfall,
             # JSON round-trip now: the ring must hold exactly what the
             # JSONL sink and /debug/flightrec serve (tuples → lists,
             # exotic values stringified), not a Python-only shape

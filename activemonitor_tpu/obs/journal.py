@@ -137,6 +137,28 @@ def rotate_capped(path: str, max_bytes: int, keep: int = 4) -> bool:
         return False
 
 
+def prune_empty_dirs(root: str) -> int:
+    """Remove empty directories under (and including) ``root``,
+    deepest first; returns how many were removed. The profiler paths
+    share this: ``jax.profiler.trace`` creates its capture directory
+    eagerly, so a probe that dies before the first device event leaves
+    an empty dir behind — both ``probes/cli.py --profile`` and the
+    manager's profile-on-anomaly captures sweep it away rather than
+    shipping operators an empty artifact. Best-effort: an OSError
+    (concurrent writer, permissions) costs the prune, never the run."""
+    removed = 0
+    try:
+        for dirpath, _dirnames, _filenames in os.walk(root, topdown=False):
+            # re-list: bottom-up pruning may have just emptied dirpath,
+            # and the walk's cached listing wouldn't know
+            if not os.listdir(dirpath):
+                os.rmdir(dirpath)
+                removed += 1
+    except OSError:
+        log.debug("empty-dir prune failed under %s", root, exc_info=True)
+    return removed
+
+
 def _parse_ts(value) -> Optional[datetime.datetime]:
     try:
         ts = datetime.datetime.fromisoformat(str(value))

@@ -37,7 +37,7 @@ from dataclasses import dataclass
 from datetime import datetime
 from typing import Dict, List, Optional, Sequence
 
-from activemonitor_tpu.obs import attribution
+from activemonitor_tpu.obs import attribution, criticalpath
 from activemonitor_tpu.obs.history import CheckResult, ResultHistory
 from activemonitor_tpu.obs.trace import current_trace_id
 from activemonitor_tpu.utils.clock import Clock
@@ -230,6 +230,11 @@ class FleetStatus:
         # stream-count snapshot rides the fleet block. None (no
         # journal) reports journal: null.
         self.journal = None
+        # wired by the manager (--profile-on-anomaly): called with
+        # (key, reason) when a run's burn rate crosses its threshold,
+        # arming one bounded profiler capture of the check's next run.
+        # None (profiling off / standalone) — no capture ever fires.
+        self.profile_hook = None
         # generated_at of the last round exported to the gauges, so the
         # rollup loop re-serving an unchanged sidecar never
         # double-counts the bisect counter
@@ -297,11 +302,14 @@ class FleetStatus:
         queue_wait = 0.0
         errored_spans = []
         if self.tracer is not None and trace_id:
-            for span in self.tracer.spans_for_trace(trace_id):
-                if span.name == "dequeue" and span.duration:
-                    queue_wait = max(queue_wait, span.duration)
-                if span.error:
-                    errored_spans.append(span.name)
+            # THE queue-wait / span-error definitions live in
+            # obs/criticalpath.py, shared with the waterfall's
+            # queue_wait stage — one definition, so attribution's
+            # scheduling bucket and `am-tpu waterfall` can never
+            # disagree about how long this run sat in the queue
+            spans = self.tracer.spans_for_trace(trace_id)
+            queue_wait = criticalpath.queue_wait(spans)
+            errored_spans = criticalpath.errored_span_names(spans)
         anomalies = (
             self.analysis.metric_states(key)
             if self.analysis is not None
@@ -360,6 +368,17 @@ class FleetStatus:
         config = slo_config_from_spec(hc.spec)
         previous = self._configs.get(key)
         self._configs[key] = config
+        if config is not None and self.profile_hook is not None:
+            # burn-rate trigger for profile-on-anomaly: a check burning
+            # budget faster than it accrues (>1.0) arms one bounded
+            # capture of its next run. The hook's own cooldown absorbs
+            # the repeat-fire every subsequent failing run would cause.
+            state = evaluate(self.history.results(key), config, self.clock.now())
+            if state.burn_rate is not None and state.burn_rate > 1.0:
+                try:
+                    self.profile_hook(key, "burn_rate")
+                except Exception:
+                    log.exception("profile hook failed for %s", key)
         if self.metrics is None:
             return
         if config is not None:
@@ -416,6 +435,67 @@ class FleetStatus:
         )
         return attribution.summarize_results(windowed)
 
+    def check_waterfalls(self, key: str) -> List[dict]:
+        """Per-run waterfalls (obs/criticalpath.py) for the check's
+        windowed results, oldest first — each run's trace joined with
+        its phase timings while the spans are still in the ring. Runs
+        whose trace has aged out of the span ring simply drop out of
+        the aggregation (the window quantiles still cover them)."""
+        if self.tracer is None:
+            return []
+        config = self._configs.get(key)
+        window = config.window_seconds if config else DEFAULT_WINDOW_SECONDS
+        windowed = window_results(
+            self.history.results(key), self.clock.now(), window
+        )
+        waterfalls = []
+        for result in windowed:
+            if not result.trace_id:
+                continue
+            waterfall = criticalpath.build_waterfall(
+                self.tracer.spans_for_trace(result.trace_id),
+                timings=result.timings,
+                trace_id=result.trace_id,
+            )
+            if waterfall is not None:
+                waterfalls.append(waterfall)
+        return waterfalls
+
+    def check_critical_path(self, key: str) -> Optional[dict]:
+        """One check's rolling critical-path block: p50/p95/p99 per
+        stage over its windowed waterfalls plus the newest run's full
+        decomposition — the ``critical_path`` block /statusz serves and
+        the ``healthcheck_critical_path_seconds`` gauges export. None
+        when no windowed run still has spans in the ring (or on any
+        internal error: the block is garnish on the payload)."""
+        try:
+            return criticalpath.aggregate_waterfalls(
+                self.check_waterfalls(key)
+            )
+        except Exception:
+            log.exception("critical-path aggregation failed for %s", key)
+            return None
+
+    def refresh_critical_path_metrics(self, checks) -> None:
+        """Export every check's critical-path block into the pinned
+        ``healthcheck_critical_path_seconds`` family — driven by the
+        manager's goodput loop and every /statusz build (via
+        check_summary), so the gauges and the payload always read the
+        same aggregation."""
+        if self.metrics is None:
+            return
+        for hc in checks:
+            try:
+                self.metrics.set_critical_path(
+                    hc.metadata.name,
+                    hc.metadata.namespace,
+                    self.check_critical_path(hc.key),
+                )
+            except Exception:
+                log.exception(
+                    "critical-path gauge export failed for %s", hc.key
+                )
+
     def check_roofline(self, key: str) -> Optional[dict]:
         """One check's latest roofline snapshot (obs/roofline.py):
         the newest run that shipped a validated ``roofline`` block —
@@ -442,6 +522,7 @@ class FleetStatus:
                 log.exception("frontdoor forget failed for %s", key)
         if self.metrics is not None and name:
             self.metrics.clear_slo(name, namespace)
+            self.metrics.clear_critical_path(name, namespace)
 
     # -- /statusz -------------------------------------------------------
     def check_summary(self, hc) -> dict:
@@ -475,6 +556,13 @@ class FleetStatus:
             remedy_budget = max(
                 0, spec.remedy_runs_limit - hc.status.remedy_total_runs
             )
+        critical_path = self.check_critical_path(key)
+        if self.metrics is not None:
+            # refresh the gauges from the very block this payload
+            # serves, so /statusz and the scrape can never disagree
+            self.metrics.set_critical_path(
+                hc.metadata.name, hc.metadata.namespace, critical_path
+            )
         summary = {
             "key": key,
             "healthcheck": hc.metadata.name,
@@ -497,6 +585,12 @@ class FleetStatus:
             "last_status": hc.status.status
             or self._last_status.get(key, ""),
             "last_trace_id": last.trace_id if last else "",
+            # critical-path decomposition (obs/criticalpath.py): rolling
+            # per-stage p50/p95/p99 over the windowed runs whose spans
+            # are still in the ring, plus the newest run's waterfall;
+            # None until a traced run lands. The per-run stage seconds
+            # (untracked included) sum to that run's wall span exactly.
+            "critical_path": critical_path,
             "runs_recorded": len(results),
             "window": {
                 "seconds": display_window,
@@ -581,6 +675,13 @@ class FleetStatus:
                 # table, per-stream appended/replayed counts, lag;
                 # null when no --journal-dir is wired
                 "journal": self.check_journal(),
+                # fleet critical-path rollup (obs/criticalpath.py):
+                # run-weighted merge of the per-check blocks above —
+                # "where do this replica's milliseconds go"; null until
+                # a traced run lands anywhere
+                "critical_path": criticalpath.merge_critical_path_blocks(
+                    [entry.get("critical_path") for entry in entries]
+                ),
             },
             "checks": entries,
         }
@@ -743,6 +844,11 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     # its own slice), lag is the fleet's worst, and any replica's
     # restore warning surfaces (first-seen wins)
     journal_blocks: List[dict] = []
+    # critical-path blocks merge run-weighted; an old-binary replica
+    # that serves no block still has its measured latency merged — its
+    # whole path books under `untracked` via the skew fallback, never
+    # silently dropped from the fleet decomposition
+    critical_path_blocks: List[dict] = []
     # fleet goodput: the run-weighted mean of the REPLICAS' own ratios,
     # each derived from its history + declared SLO windows — the same
     # definition a single /statusz reports, so the number doesn't
@@ -801,6 +907,13 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
         replica_journal = fleet.get("journal")
         if isinstance(replica_journal, dict):
             journal_blocks.append(replica_journal)
+        replica_critical_path = fleet.get("critical_path")
+        if not isinstance(replica_critical_path, dict):
+            # version skew: an old binary reports no block (or null) —
+            # book its windowed runs' whole latency as untracked
+            replica_critical_path = criticalpath.skew_block(payload)
+        if replica_critical_path:
+            critical_path_blocks.append(replica_critical_path)
         for entry in payload.get("checks") or []:
             key = entry.get("key", "")
             if key not in merged:
@@ -846,6 +959,9 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "matrix": matrix_block,
             "frontdoor": merge_frontdoor_blocks(frontdoor_blocks),
             "journal": merge_journal_blocks(journal_blocks),
+            "critical_path": criticalpath.merge_critical_path_blocks(
+                critical_path_blocks
+            ),
         },
         "checks": entries,
     }

@@ -216,10 +216,15 @@ class Tracer:
         name: str,
         start: float,
         end: Optional[float] = None,
+        trace_id: str = "",
         **attrs: Any,
     ) -> Span:
         """Record an already-elapsed phase (the queue-wait span: its
-        start happened on another task, before any span existed)."""
+        start happened on another task, before any span existed). An
+        explicit ``trace_id`` attaches the span to a trace the caller
+        is NOT inside — the front door's admission span belongs to the
+        cycle it triggered, but the decision runs on the request task,
+        outside that cycle's context."""
         parent = _CURRENT.get()
         end_m = self.clock.monotonic() if end is None else end
         # start_ts must be the phase's START on the wall clock — project
@@ -228,9 +233,16 @@ class Tracer:
         # timeline wouldn't line up
         elapsed = max(0.0, end_m - start)
         span = Span(
-            trace_id=parent.trace_id if parent else _new_trace_id(),
+            trace_id=trace_id
+            or (parent.trace_id if parent else _new_trace_id()),
             span_id=_new_span_id(),
-            parent_id=parent.span_id if parent else "",
+            # a span grafted onto ANOTHER trace must not claim the
+            # ambient span (of some unrelated trace) as its parent
+            parent_id=(
+                parent.span_id
+                if parent and (not trace_id or parent.trace_id == trace_id)
+                else ""
+            ),
             name=name,
             start=start,
             start_ts=(
@@ -293,12 +305,32 @@ class Tracer:
             )
         return out
 
-    def export_jsonl(self, path: str) -> int:
+    # --trace-export rotation: the export appends (a long-lived
+    # controller restarting into the same path keeps prior shutdowns'
+    # traces) and rotates through the shared size cap first — the same
+    # discipline the flight recorder applies to flightrec.jsonl
+    DEFAULT_EXPORT_MAX_BYTES = 4 << 20
+    DEFAULT_EXPORT_KEEP = 4
+
+    def export_jsonl(
+        self,
+        path: str,
+        max_bytes: int = DEFAULT_EXPORT_MAX_BYTES,
+        keep: int = DEFAULT_EXPORT_KEEP,
+    ) -> int:
         """Dump one JSON line per trace; returns how many were written.
-        Best-effort by contract (shutdown path): an unwritable path
-        logs nothing here — the caller decides how loud to be."""
+        Size-capped: when the file at ``path`` already exceeds
+        ``max_bytes`` it rotates aside (``<stem>-1 .. <stem>-keep``)
+        before this export appends — an operator pointing
+        ``--trace-export`` at one path forever gets a bounded set of
+        files, never one unbounded JSONL. Best-effort by contract
+        (shutdown path): an unwritable path logs nothing here — the
+        caller decides how loud to be."""
+        from activemonitor_tpu.obs.journal import rotate_capped
+
+        rotate_capped(path, max_bytes, keep=keep)
         traces = self.traces()
-        with open(path, "w") as f:
+        with open(path, "a") as f:
             for trace in traces:
                 f.write(json.dumps(trace, default=str) + "\n")
         return len(traces)

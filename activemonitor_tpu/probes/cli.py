@@ -380,8 +380,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.profile:
         import jax
 
-        with jax.profiler.trace(args.profile):
-            return _dispatch(args)
+        try:
+            with jax.profiler.trace(args.profile):
+                return _dispatch(args)
+        finally:
+            # a probe that dies before the first device event leaves an
+            # empty capture tree behind — prune it so operators (and the
+            # profile-on-anomaly size cap) never chase hollow captures
+            from activemonitor_tpu.obs.journal import prune_empty_dirs
+
+            prune_empty_dirs(args.profile)
     return _dispatch(args)
 
 

@@ -532,6 +532,37 @@ def run(
             "shared roofline-ceiling input with decode-kv-bytes-per-token",
         ),
     ]
+    # TTFT decomposition (obs/criticalpath.py, ISSUE 17): the
+    # scheduler's token-exact stamps split TTFT into queue-wait
+    # (arrival -> admission), prefill (admission -> first token; the
+    # two sum to TTFT exactly) and first-decode (first token -> the
+    # first shared decode step's token)
+    from activemonitor_tpu.obs.criticalpath import decompose_ttft
+
+    ttft_split = decompose_ttft(soak.scheduler.completed)
+    if ttft_split is not None:
+        metrics.extend(
+            [
+                ProbeMetric(
+                    "serving-ttft-queue-wait-p99-ms",
+                    ttft_split["queue_wait"]["p99"] * 1e3,
+                    help="TTFT queue-wait component, p99 (arrival -> "
+                    "batch admission)",
+                ),
+                ProbeMetric(
+                    "serving-ttft-prefill-p99-ms",
+                    ttft_split["prefill"]["p99"] * 1e3,
+                    help="TTFT prefill component, p99 (admission -> "
+                    "first token; queue-wait + prefill == TTFT)",
+                ),
+                ProbeMetric(
+                    "serving-ttft-first-decode-p99-ms",
+                    ttft_split["first_decode"]["p99"] * 1e3,
+                    help="First shared decode step after the prefill "
+                    "token, p99 (the decode scheduler's handoff cost)",
+                ),
+            ]
+        )
     result = ProbeResult(
         ok=ok,
         summary=(
@@ -558,6 +589,7 @@ def run(
             "refusals": dict(soak.scheduler.refusals),
             "kv_frag_peak": max(soak.frag_samples, default=0.0),
             "kv_bytes_per_token": bytes_per_token,
+            "ttft_decomposition": ttft_split,
         },
         timings=timings,
     )

@@ -110,6 +110,11 @@ class SequenceState:
     admitted_at: float
     generated: int = 0  # tokens produced so far (prefill's counts)
     first_token_at: Optional[float] = None
+    # the first SHARED decode step's token (generated == 2) — with
+    # arrival/admitted_at/first_token_at this decomposes TTFT into
+    # queue-wait / prefill / first-decode (obs/criticalpath.py);
+    # None for 1-token requests that retire at prefill
+    first_decode_at: Optional[float] = None
     finished_at: Optional[float] = None
     tokens: List[int] = field(default_factory=list)  # generated token ids
 
@@ -241,6 +246,8 @@ class ContinuousBatchingScheduler:
                 continue
             self.manager.append(seq.req.rid, 1)
             seq.generated += 1
+            if seq.generated == 2 and seq.first_decode_at is None:
+                seq.first_decode_at = now
             seq.tokens.append(token)
             self._emit_token(seq)
             stepped += 1

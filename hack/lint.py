@@ -54,7 +54,7 @@ positive that makes `make lint` cry wolf is worse than a miss):
   files under a `resilience/`, `analysis/`, or `frontdoor/` directory,
   or in the clock-disciplined modules (`sharding.py`, `attribution.py`,
   `flightrec.py`, `roofline.py`, `arrivals.py`, `journal.py`,
-  `replay.py`) — those units' whole
+  `replay.py`, `criticalpath.py`) — those units' whole
   contract is the injectable Clock (breaker open windows, token-bucket
   refill, baseline timestamps, shard lease expiry/fencing windows,
   attribution windows and flight-bundle timestamps, front-door quota
@@ -77,7 +77,11 @@ positive that makes `make lint` cry wolf is worse than a miss):
   arithmetic with no time in it at all; `wallclock-in-journal` /
   `wallclock-in-replay`: the durable telemetry journal stamps events
   and computes lag on the injected Clock, and trace replay lives on
-  the recorded timeline driven by a FakeClock).
+  the recorded timeline driven by a FakeClock;
+  `wallclock-in-criticalpath`: the waterfall decomposition is pure
+  math over span monotonics and PhaseTimings passed IN — a wall-clock
+  read there would desync the stage sums from the trace's own
+  timeline).
 
 Usage: python hack/lint.py [paths...]   (default: the package + tests
 + the root entry points). Exit 1 on any finding.
@@ -184,6 +188,7 @@ class Checker(ast.NodeVisitor):
             "arrivals.py",  # seeded schedules on the caller's timeline
             "journal.py",  # event timestamps + lag on the injected Clock
             "replay.py",  # recorded timelines + FakeClock drive harness
+            "criticalpath.py",  # pure waterfall math over span monotonics
         ):
             # single-file modules carrying the same injectable-Clock
             # contract as the resilience/analysis packages

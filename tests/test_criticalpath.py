@@ -1,0 +1,590 @@
+"""Critical-path latency observatory (ISSUE 17).
+
+Units for the pure waterfall math (conservation to the wall span,
+innermost-wins segmentation, the probe-phase carve, the TTFT split) and
+the scripted FakeClock + FakeEngine acceptance: a front-door submit
+that coalesces into a scheduled run yields ONE waterfall whose stage
+durations sum to the trace's wall span (±1e-9, ``untracked`` included),
+visible identically via /statusz, the
+``healthcheck_critical_path_seconds`` gauges, and ``am-tpu waterfall``;
+an injected queue-wait degradation flips the dominant stage to
+``queue_wait``, fires exactly one profile-on-anomaly capture (a second
+trigger inside the cooldown fires none), and the flight bundle carries
+both the waterfall and the capture path.
+"""
+
+import argparse
+import asyncio
+import collections
+import json
+import os
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.controller.manager import Manager
+from activemonitor_tpu.engine import FakeWorkflowEngine
+from activemonitor_tpu.engine.base import PHASE_FAILED, PHASE_SUCCEEDED
+from activemonitor_tpu.frontdoor import (
+    AdmissionController,
+    FrontDoor,
+    OUTCOME_JOINED,
+    OUTCOME_RUN,
+    TenantQuota,
+)
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs import criticalpath
+from activemonitor_tpu.obs.criticalpath import (
+    STAGES,
+    build_waterfall,
+    decompose_ttft,
+    dominant_stage,
+    errored_span_names,
+    queue_wait,
+)
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = (
+    "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+)
+
+
+# ---------------------------------------------------------------------
+# unit: the pure waterfall math
+# ---------------------------------------------------------------------
+
+
+class FakeSpan:
+    def __init__(self, name, start, end, error="", trace_id="t-1"):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.error = error
+        self.trace_id = trace_id
+
+    @property
+    def duration(self):
+        return max(0.0, (self.end or self.start) - self.start)
+
+
+def test_build_waterfall_conserves_wall_with_untracked_gap():
+    spans = [
+        FakeSpan("reconcile", 0.0, 10.0),
+        FakeSpan("dequeue", 0.0, 2.0),
+        FakeSpan("parse", 2.0, 2.5),
+        FakeSpan("submit", 2.5, 3.0),
+        # [3.0, 3.2] is covered by no mapped span: the untracked gap
+        FakeSpan("poll", 3.2, 9.0),
+        FakeSpan("status_write", 9.0, 9.7),
+    ]
+    w = build_waterfall(spans, timings={"allreduce": 1.5, "compile": 1.5})
+    assert w["wall_seconds"] == pytest.approx(10.0)
+    assert set(w["stages"]) == set(STAGES)
+    assert sum(w["stages"].values()) == pytest.approx(10.0, abs=1e-9)
+    assert w["stages"]["queue_wait"] == pytest.approx(2.0)
+    # probe phases carve out of poll, never double-book
+    assert w["stages"]["probe_phase"] == pytest.approx(3.0)
+    assert w["stages"]["poll"] == pytest.approx(5.8 - 3.0)
+    # the uncovered gap plus the post-status_write tail, booked honestly
+    assert w["stages"]["untracked"] == pytest.approx(0.2 + 0.3)
+    assert w["dominant_stage"] == "probe_phase"
+    # segments are orderable for the ASCII rendering and exclude the
+    # placement-free untracked residual
+    assert [s["stage"] for s in w["segments"]] == [
+        "queue_wait", "schedule", "submit", "poll", "probe_phase",
+        "status_write",
+    ]
+
+
+def test_nested_spans_book_innermost_wins():
+    # status_write nested inside poll: the overlap belongs to the child
+    spans = [
+        FakeSpan("poll", 0.0, 8.0),
+        FakeSpan("status_write", 6.0, 8.0),
+    ]
+    w = build_waterfall(spans)
+    assert w["stages"]["poll"] == pytest.approx(6.0)
+    assert w["stages"]["status_write"] == pytest.approx(2.0)
+    assert sum(w["stages"].values()) == pytest.approx(8.0, abs=1e-9)
+
+
+def test_probe_phase_carve_is_capped_at_the_poll_stage():
+    spans = [FakeSpan("poll", 0.0, 2.0)]
+    # the probe claims more phase time than the controller polled for:
+    # the carve caps at the poll window so the sum stays conserved
+    w = build_waterfall(spans, timings={"soak": 50.0, "bogus": "x"})
+    assert w["stages"]["probe_phase"] == pytest.approx(2.0)
+    assert w["stages"]["poll"] == 0.0
+    assert sum(w["stages"].values()) == pytest.approx(2.0, abs=1e-9)
+
+
+def test_build_waterfall_needs_a_finished_span():
+    assert build_waterfall([]) is None
+    assert build_waterfall([FakeSpan("poll", 1.0, None)]) is None
+
+
+def test_queue_wait_and_errored_span_names_definitions():
+    spans = [
+        FakeSpan("dequeue", 0.0, 3.0),
+        FakeSpan("poll", 3.0, 4.0, error="TimeoutError"),
+        FakeSpan("dequeue", 0.0, 1.0),
+    ]
+    assert queue_wait(spans) == pytest.approx(3.0)
+    assert errored_span_names(spans) == ["poll"]
+    assert queue_wait([]) == 0.0
+
+
+def test_dominant_stage_ties_break_in_path_order():
+    assert dominant_stage({"poll": 1.0, "queue_wait": 1.0}) == "queue_wait"
+    assert dominant_stage({}) == "queue_wait"
+
+
+def test_decompose_ttft_reads_the_scheduler_stamps():
+    class Req:
+        def __init__(self, arrival):
+            self.arrival = arrival
+
+    class Seq:
+        def __init__(self, arrival, admitted, first_token, first_decode):
+            self.req = Req(arrival)
+            self.admitted_at = admitted
+            self.first_token_at = first_token
+            self.first_decode_at = first_decode
+
+    split = decompose_ttft(
+        [
+            Seq(0.0, 1.0, 3.0, 3.5),
+            Seq(0.0, 2.0, 5.0, None),  # one-token request: no decode
+            Seq(0.0, 0.0, None, None),  # never produced a token: skipped
+        ]
+    )
+    assert split["samples"] == 2
+    assert split["queue_wait"]["p95"] == pytest.approx(2.0)
+    assert split["prefill"]["p95"] == pytest.approx(3.0)
+    assert split["first_decode"]["p95"] == pytest.approx(0.5)
+    assert decompose_ttft([]) is None
+
+
+def test_render_waterfall_stage_table_and_ascii_bars():
+    from activemonitor_tpu.__main__ import render_waterfall
+
+    block = criticalpath.aggregate_waterfalls(
+        [
+            build_waterfall(
+                [
+                    FakeSpan("dequeue", 0.0, 4.0),
+                    FakeSpan("poll", 4.0, 5.0),
+                ]
+            )
+        ]
+    )
+    out = render_waterfall({"key": "health/hc-x", "critical_path": block})
+    assert "dominant=queue_wait" in out
+    assert "STAGE" in out and "P95" in out
+    assert "queue_wait" in out and "4.00s" in out
+    # the last-run ASCII waterfall: offset-indented bars
+    assert "last run (trace" in out
+    lines = out.splitlines()
+    qw_bar = next(l for l in lines if l.strip().startswith("queue_wait") and "|" in l)
+    poll_bar = next(l for l in lines if l.strip().startswith("poll") and "|" in l)
+    assert "#" in qw_bar and "#" in poll_bar
+    # poll starts after queue_wait on the timeline
+    assert poll_bar.index("#") > qw_bar.index("#")
+    # a check with no evidence renders a structured explanation
+    assert "no critical-path evidence" in render_waterfall(
+        {"key": "health/hc-y", "critical_path": None}
+    )
+
+
+# ---------------------------------------------------------------------
+# acceptance: front door -> coalesced run -> one waterfall everywhere,
+# queue-wait degradation flips the dominant stage, one bounded capture
+# ---------------------------------------------------------------------
+
+CONTRACT_DOC = json.dumps(
+    {
+        "metrics": [
+            {"name": "probe-bw-gbps", "value": 123.0, "metrictype": "gauge"}
+        ],
+        "timings": {"allreduce": 0.25, "compile": 0.25},
+    }
+)
+OUTPUTS = {"parameters": [{"name": "metrics", "value": CONTRACT_DOC}]}
+
+
+def make_hc(name, repeat=600, slo=None):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        "backoffMax": 1,
+        "backoffMin": 1,
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 60,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if slo:
+        spec["slo"] = slo
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+def scripted_engine(script, clock=None):
+    """FakeEngine whose Nth SUBMITTED workflow follows the Nth script
+    entry: pending until the scripted poll count, then the scripted
+    verdict (successes carry the metrics+timings contract). Setting
+    ``engine.submit_delay`` makes the NEXT submit await that many fake
+    seconds (consumed once) — submits run inside the reconcile worker,
+    so a slow one pins the worker and injects real queue wait."""
+    engine = FakeWorkflowEngine()
+    queue = collections.deque(script)
+    assigned = {}
+    real_submit = engine.submit
+    engine.submit_delay = 0.0
+
+    async def submit(manifest):
+        delay, engine.submit_delay = engine.submit_delay, 0.0
+        if delay and clock is not None:
+            await clock.sleep(delay)
+        name = await real_submit(manifest)
+        if queue:
+            assigned[name] = queue.popleft()
+        return name
+
+    engine.submit = submit
+
+    def completer(wf, count):
+        entry = assigned.get(wf["metadata"]["name"])
+        if entry is None:
+            return None
+        polls, ok = entry
+        if count < polls:
+            return None
+        if ok:
+            return {"phase": PHASE_SUCCEEDED, "outputs": OUTPUTS}
+        return {"phase": PHASE_FAILED, "message": "scripted failure"}
+
+    engine._default_completer = completer
+    return engine
+
+
+async def settle():
+    for _ in range(50):
+        await asyncio.sleep(0)
+
+
+async def drive(clock, polls):
+    await settle()
+    for _ in range(polls):
+        await clock.advance(1.0)
+    await settle()
+
+
+class FakeCapture:
+    """Injected capture factory: stands in for jax.profiler.trace and
+    writes one artifact so the capture dir is non-empty."""
+
+    calls: list = []
+
+    def __init__(self, path):
+        self.path = path
+
+    def __enter__(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, "trace.pb"), "w") as f:
+            f.write("profile")
+        FakeCapture.calls.append(self.path)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+SCRIPT = [
+    (2, True),  # hc-cp boot run
+    (2, True),  # hc-cp front-door coalesced run
+    (2, False),  # hc-cp failure: burn rate 3.33 arms the profiler
+    (31, True),  # hc-busy: its SLOW submit pins the worker (injection)
+    (2, True),  # hc-cp queue-delayed run: CAPTURED, queue_wait dominates
+    (2, True),  # hc-cp follow-up: still burning, but inside the cooldown
+]
+
+
+@pytest.mark.asyncio
+async def test_acceptance_waterfall_everywhere_and_one_bounded_capture(
+    tmp_path, capsys
+):
+    import aiohttp
+
+    from activemonitor_tpu.__main__ import _waterfall, render_waterfall
+
+    FakeCapture.calls = []
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    metrics = MetricsCollector()
+    engine = scripted_engine(SCRIPT, clock=clock)
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=engine,
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=metrics,
+        clock=clock,
+    )
+    door = FrontDoor(
+        reconciler.fleet.history,
+        AdmissionController(
+            default_quota=TenantQuota(rate_per_minute=6000.0), clock=clock
+        ),
+        clock=clock,
+        metrics=metrics,
+        resilience=reconciler.resilience,
+        default_freshness=30.0,
+    )
+    capture_dir = tmp_path / "captures"
+    manager = Manager(
+        client=client,
+        reconciler=reconciler,
+        max_parallel=1,  # ONE worker, so a busy check delays the queue
+        frontdoor=door,
+        profile_on_anomaly_dir=str(capture_dir),
+    )
+    manager._profiler.capture_factory = FakeCapture
+    manager._health_addr = "127.0.0.1:0"
+    await manager.start()
+    try:
+        key = "health/hc-cp"
+        hc = make_hc("hc-cp", slo={"objective": 0.9, "windowSeconds": 3600})
+        await client.apply(hc)
+        await drive(clock, 2)  # boot run (ok)
+
+        # --- front-door submit coalescing into ONE scheduled run ------
+        await clock.advance(31.0)  # age the boot result past freshness
+        run_ticket = door.submit("tenant-a", key)
+        join_ticket = door.submit("tenant-b", key)
+        assert run_ticket.outcome == OUTCOME_RUN
+        assert join_ticket.outcome == OUTCOME_JOINED
+        # the ticket lifecycle rides the waterfall's evidence chain
+        assert [ev for ev, _t in run_ticket.lifecycle] == [
+            "admit", "demand-fire", "enqueue",
+        ]
+        assert [ev for ev, _t in join_ticket.lifecycle] == [
+            "admit", "coalesce-join",
+        ]
+        await drive(clock, 2)
+        result = await run_ticket.wait()
+        joined = await join_ticket.wait()
+        assert result.ok and result.trace_id == run_ticket.trace_id
+        assert joined is result  # ONE run fanned out to both tenants
+        assert join_ticket.trace_id == run_ticket.trace_id
+
+        # --- surface 1: /statusz ---------------------------------------
+        port = manager._http_runners[0].addresses[0][1]
+
+        async def fetch_statusz():
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"http://127.0.0.1:{port}/statusz"
+                ) as r:
+                    assert r.status == 200
+                    return await r.json()
+
+        payload = await fetch_statusz()
+        [entry] = payload["checks"]
+        block = entry["critical_path"]
+        assert block["runs"] == 2 and block["skewed_runs"] == 0
+        last = block["last"]
+        # the coalesced submit produced ONE waterfall, on the shared trace
+        assert last["trace_id"] == run_ticket.trace_id
+        # conservation: stage durations sum to the trace's wall span
+        # (untracked included) to within 1e-9
+        assert set(last["stages"]) == set(STAGES)
+        assert sum(last["stages"].values()) == pytest.approx(
+            last["wall_seconds"], abs=1e-9
+        )
+        # the front door's admission span landed in the run's trace
+        assert last["stages"]["admission"] >= 0.0
+        assert block["dominant_stage"] != "queue_wait"  # healthy so far
+        assert payload["fleet"]["critical_path"]["runs"] == 2
+
+        # --- surface 2: the pinned gauges (synced by the statusz build)
+        for stage in STAGES:
+            gauge = metrics.sample_value(
+                "healthcheck_critical_path_seconds",
+                {
+                    "healthcheck_name": "hc-cp",
+                    "namespace": "health",
+                    "stage": stage,
+                    "quantile": "p95",
+                },
+            )
+            assert gauge == pytest.approx(block["stages"][stage]["p95"])
+
+        # --- surface 3: `am-tpu waterfall` over the live endpoint ------
+        args = argparse.Namespace(
+            url=[f"http://127.0.0.1:{port}/statusz"],
+            token="",
+            name="hc-cp",
+            namespace=None,
+            output="json",
+        )
+        assert await _waterfall(args) == 0
+        cli_doc = json.loads(capsys.readouterr().out)
+        assert cli_doc["key"] == key
+        assert cli_doc["critical_path"]["last"]["stages"] == pytest.approx(
+            last["stages"]
+        )
+        rendered = render_waterfall(entry)
+        assert "hc-cp" in rendered and "#" in rendered
+
+        # --- inject a queue-wait degradation ---------------------------
+        # run 3, demanded off-schedule (the front door's demand-fire
+        # path, minus its freshness cache): the scripted failure
+        await drive(clock, 0)
+        reconciler.demand("health", "hc-cp")
+        manager.enqueue("health", "hc-cp")
+        await drive(clock, 2)
+        assert not reconciler.fleet.history.last(key).ok
+        # the burn-rate breach armed exactly one capture of the next run
+        assert manager._profiler._armed == {key: "burn_rate"}
+        assert FakeCapture.calls == []
+
+        # hc-busy's SLOW submit pins the single reconcile worker for
+        # 30 fake seconds (watches poll in detached tasks, so only the
+        # submit path can occupy a worker); hc-cp then waits its whole
+        # enqueue-to-dequeue gap in the queue
+        engine.submit_delay = 30.0
+        await client.apply(make_hc("hc-busy"))
+        await settle()
+        reconciler.demand("health", "hc-cp")
+        trace_id = manager.enqueue("health", "hc-cp")
+        assert trace_id  # the pre-minted trace the dequeue span joins
+        for _ in range(31):
+            await clock.advance(1.0)
+        await settle()
+        await drive(clock, 2)  # hc-cp's own (captured) run
+
+        payload = await fetch_statusz()
+        entry = next(c for c in payload["checks"] if c["key"] == key)
+        block = entry["critical_path"]
+        # the dominant stage flipped to queue_wait, in the window AND
+        # in the newest run's own decomposition — conservation holds
+        assert block["dominant_stage"] == "queue_wait"
+        assert block["last"]["dominant_stage"] == "queue_wait"
+        assert block["last"]["trace_id"] == trace_id
+        assert block["last"]["stages"]["queue_wait"] >= 30.0
+        assert sum(block["last"]["stages"].values()) == pytest.approx(
+            block["last"]["wall_seconds"], abs=1e-9
+        )
+        assert payload["fleet"]["critical_path"]["dominant_stage"] == (
+            "queue_wait"
+        )
+        rendered = render_waterfall(entry)
+        assert "dominant=queue_wait" in rendered
+
+        # --- exactly ONE bounded capture -------------------------------
+        assert len(FakeCapture.calls) == 1
+        capture_path = FakeCapture.calls[0]
+        assert os.path.isfile(os.path.join(capture_path, "trace.pb"))
+        assert (
+            metrics.sample_value(
+                "healthcheck_profile_captures_total", {"reason": "burn_rate"}
+            )
+            == 1.0
+        )
+        # the capture index journals the capture for offline tooling
+        index_lines = (
+            (capture_dir / "captures.jsonl").read_text().splitlines()
+        )
+        assert len(index_lines) == 1
+        index_doc = json.loads(index_lines[0])
+        assert index_doc["check"] == key
+        assert index_doc["reason"] == "burn_rate"
+        assert index_doc["path"] == capture_path
+        # the flight bundle carries BOTH the waterfall and the path
+        [bundle] = reconciler.flightrec.bundles(
+            kind="profile-capture", check=key
+        )
+        assert bundle["extra"]["capture_path"] == capture_path
+        assert bundle["extra"]["captured"] is True
+        assert bundle["waterfall"] is not None
+        assert bundle["waterfall"]["dominant_stage"] == "queue_wait"
+        assert sum(bundle["waterfall"]["stages"].values()) == pytest.approx(
+            bundle["waterfall"]["wall_seconds"], abs=1e-9
+        )
+
+        # the captured run's own record re-fired the trigger (its burn
+        # rate is still hot) — the cooldown absorbed it: nothing armed
+        assert manager._profiler._armed == {}
+        # and a whole further run fires no second capture
+        reconciler.demand("health", "hc-cp")
+        manager.enqueue("health", "hc-cp")
+        await drive(clock, 2)
+        assert len(FakeCapture.calls) == 1
+        assert (
+            metrics.sample_value(
+                "healthcheck_profile_captures_total", {"reason": "burn_rate"}
+            )
+            == 1.0
+        )
+    finally:
+        await manager.stop()
+
+
+@pytest.mark.asyncio
+async def test_profiler_disabled_by_default_never_arms():
+    clock = FakeClock()
+    client = InMemoryHealthCheckClient()
+    reconciler = HealthCheckReconciler(
+        client=client,
+        engine=scripted_engine([(2, False)]),
+        rbac=RBACProvisioner(InMemoryRBACBackend()),
+        recorder=EventRecorder(),
+        metrics=MetricsCollector(),
+        clock=clock,
+    )
+    manager = Manager(client=client, reconciler=reconciler, max_parallel=1)
+    assert not manager._profiler.enabled
+    assert reconciler.profile_hook is None
+    assert reconciler.fleet.profile_hook is None
+    assert manager._profiler.arm("health/hc-x", "burn_rate") is False
+
+
+def test_profile_capture_directory_size_cap(tmp_path):
+    """The shared size cap: oldest captures are pruned once the
+    directory exceeds --profile-max-bytes; the newest always survives."""
+    from activemonitor_tpu.controller.manager import ProfileOnAnomaly
+
+    clock = FakeClock()
+    prof = ProfileOnAnomaly(
+        clock=clock,
+        directory=str(tmp_path),
+        cooldown=0.0,
+        max_bytes=1500,
+        capture_factory=FakeCapture,
+    )
+    for i in range(3):
+        assert prof.arm(f"health/hc-{i}", "degraded")
+        with prof.capture(f"health/hc-{i}"):
+            pass
+        # each fake capture holds a 7-byte file; pad it past the cap
+        newest = prof._capture_paths[-1]
+        with open(os.path.join(newest, "pad.bin"), "w") as f:
+            f.write("x" * 1000)
+    surviving = [p for p in prof._capture_paths if os.path.isdir(p)]
+    # the cap pruned the oldest captures; the newest is always kept
+    assert surviving and surviving[-1].endswith("-000003")
+    assert len(surviving) < 3

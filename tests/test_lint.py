@@ -1408,3 +1408,109 @@ def test_frontdoor_replay_op_is_cross_pinned():
     assert (REPO / "hack" / "journal_check.py").exists()
     obs_docs = (REPO / "docs" / "observability.md").read_text()
     assert "frontdoor-replay" in obs_docs
+
+
+def test_wallclock_banned_in_criticalpath(tmp_path):
+    """obs/criticalpath.py is pure math over span monotonics and
+    PhaseTimings passed IN (ISSUE 17): a bare wall-clock read there
+    would desync the stage sums from the trace's own timeline — same
+    module-name keying as the journal/replay twins."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    (tmp_path / "criticalpath.py").write_text(source)
+    got = lint.lint_file(tmp_path / "criticalpath.py")
+    assert {line.split(": ")[1] for line in got} == {
+        "wallclock-in-criticalpath"
+    }
+    assert len(got) == 2
+    # identical code under any other module name: no finding
+    assert findings(tmp_path, source, name="waterfaller.py") == []
+
+
+def test_criticalpath_really_is_wallclock_free():
+    """The gate, applied: the shipped module lints clean and the ban
+    covers it (path-scoping regression guard, like the journal twin)."""
+    path = REPO / "activemonitor_tpu" / "obs" / "criticalpath.py"
+    assert path.exists(), "criticalpath module missing?"
+    assert lint.lint_file(path) == []
+    src = path.read_text()
+    checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+    assert checker.ban_wallclock
+    assert checker.wallclock_pkg == "criticalpath"
+
+
+CRITICAL_PATH_FAMILIES = (
+    "healthcheck_critical_path_seconds",
+    "healthcheck_profile_captures_total",
+)
+
+
+def test_critical_path_metric_families_are_pinned():
+    """The ISSUE-17 families must stay in the exposition contract — the
+    latency dashboard stacks the per-stage percentile gauge under the
+    capture counter, and a rename silently breaks the dominant-stage
+    alert."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_criticalpath",
+        REPO / "tests" / "test_metrics.py",
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in CRITICAL_PATH_FAMILIES:
+        assert family in contract.PINNED_FAMILIES, family
+    # and the operator docs register every family next to the runbook
+    docs = (REPO / "docs" / "observability.md").read_text()
+    for family in CRITICAL_PATH_FAMILIES:
+        assert family in docs, f"{family} missing from docs/observability.md"
+    assert "Reading a waterfall" in docs
+
+
+def test_critical_path_stage_vocabulary_is_cross_pinned():
+    """The stage vocabulary is a cross-layer contract: the waterfall
+    builder emits it, the gauge labels carry it, the /statusz block
+    serializes it, and the docs table teaches it. One rename strands
+    dashboards and the runbook — pin the literal tuple and check every
+    surface against it."""
+    from activemonitor_tpu.obs import criticalpath
+
+    assert criticalpath.STAGES == (
+        "queue_wait",
+        "admission",
+        "schedule",
+        "submit",
+        "poll",
+        "probe_phase",
+        "status_write",
+        "untracked",
+    )
+    # every mapped span stage is in the vocabulary, and untracked is
+    # never a span mapping target (it's the residual, not a span)
+    assert set(criticalpath.SPAN_STAGES.values()) <= set(criticalpath.STAGES)
+    assert "untracked" not in criticalpath.SPAN_STAGES.values()
+    # the docs stage table names every stage
+    docs = (REPO / "docs" / "observability.md").read_text()
+    for stage in criticalpath.STAGES:
+        assert f"`{stage}`" in docs, f"{stage} missing from the docs table"
+    # the gauge helper clears exactly this vocabulary (metrics ↔
+    # criticalpath can't drift: collector imports STAGES directly)
+    collector_src = (
+        REPO / "activemonitor_tpu" / "metrics" / "collector.py"
+    ).read_text()
+    assert "from activemonitor_tpu.obs.criticalpath import" in collector_src
+
+
+def test_criticalpath_quantile_matches_slo():
+    """Both percentile surfaces use the same nearest-rank estimator and
+    the same quantile triple — a drift would make the waterfall's p95
+    disagree with the SLO window's p95 over identical samples."""
+    from activemonitor_tpu.obs import criticalpath, slo
+
+    assert criticalpath.QUANTILES == slo.QUANTILES == (0.50, 0.95, 0.99)
+    samples = [0.1, 0.5, 0.2, 4.0, 0.9, 1.5, 0.3]
+    for q in criticalpath.QUANTILES:
+        assert criticalpath._quantile(samples, q) == slo.quantile(samples, q)

@@ -242,6 +242,10 @@ PINNED_FAMILIES = {
     "healthcheck_frontdoor_coalesce_ratio": "gauge",
     "healthcheck_frontdoor_queue_depth": "gauge",
     "healthcheck_frontdoor_admission_seconds": "histogram",
+    # critical-path families (ISSUE 17: cross-layer waterfall
+    # decomposition — docs/observability.md "Reading a waterfall")
+    "healthcheck_critical_path_seconds": "gauge",
+    "healthcheck_profile_captures_total": "counter",
     # durable-journal families (ISSUE 16: restart-proof telemetry
     # journal — docs/observability.md "Durable telemetry journal")
     "healthcheck_journal_appended_total": "counter",
@@ -302,6 +306,17 @@ def exercise_every_family(collector):
     collector.set_frontdoor_coalesce(hit=0.5, miss=0.25, join=0.25)
     collector.set_frontdoor_queue_depth(2)
     collector.observe_frontdoor_admission(0.0004)
+    # critical-path families (ISSUE 17)
+    collector.set_critical_path(
+        "hc-a",
+        "health",
+        {
+            "stages": {
+                "queue_wait": {"p50": 0.1, "p95": 0.2, "p99": 0.3},
+            }
+        },
+    )
+    collector.record_profile_capture("degraded")
     # durable-journal families (ISSUE 16)
     collector.record_journal_append("result")
     collector.record_journal_replayed("result", 2)

@@ -182,6 +182,28 @@ def test_export_jsonl_roundtrip(tmp_path):
     assert {s["name"] for s in traces[0]["spans"]} == {"a", "b"}
 
 
+def test_export_jsonl_rotates_through_the_shared_cap(tmp_path):
+    """Satellite 1 (ISSUE 17): --trace-export routes through the same
+    rotate_capped the journal and flight recorder use — a restart loop
+    can no longer grow one unbounded trace dump, and the previous
+    incarnation's export survives as the -1 rotation."""
+    path = tmp_path / "traces.jsonl"
+    for round_no in range(2):
+        tracer = Tracer(FakeClock())
+        with tracer.span(f"cycle-{round_no}"):
+            pass
+        # a 1-byte cap forces rotation on every export after the first
+        assert tracer.export_jsonl(str(path), max_bytes=1) == 1
+    assert path.exists()
+    assert (tmp_path / "traces-1.jsonl").exists()
+    # both generations still parse: the active file holds the newest
+    # export, the rotation the previous one
+    [current] = list(Tracer.read_jsonl(str(path)))
+    [previous] = list(Tracer.read_jsonl(str(tmp_path / "traces-1.jsonl")))
+    assert current["spans"][0]["name"] == "cycle-1"
+    assert previous["spans"][0]["name"] == "cycle-0"
+
+
 # ---------------------------------------------------------------------
 # correlation: log lines and events carry the active trace
 # ---------------------------------------------------------------------

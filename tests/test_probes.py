@@ -854,3 +854,47 @@ def test_cli_profile_writes_a_trace(tmp_path, capsys):
     assert doc["metrics"][0]["name"] == "tpu-device-count"
     produced = list((tmp_path / "trace").rglob("*"))
     assert any(p.is_file() for p in produced), produced
+    # the empty-dir sweep (ISSUE 17 satellite) only prunes HOLLOW
+    # capture trees: a successful capture's directories all hold files
+    # somewhere beneath them and must survive
+    empties = [
+        p
+        for p in (tmp_path / "trace").rglob("*")
+        if p.is_dir() and not any(p.iterdir())
+    ]
+    assert empties == []
+
+
+def test_cli_profile_prunes_an_empty_capture_dir(tmp_path, capsys, monkeypatch):
+    """A probe that dies before the first device event used to leave an
+    empty capture tree behind (ISSUE 17 satellite): the operator — and
+    the profile-on-anomaly size cap — then chases hollow captures. The
+    CLI now sweeps empty directories after the profiler exits."""
+    from activemonitor_tpu.probes import cli
+
+    def boom(args):
+        raise SystemExit(3)
+
+    monkeypatch.setattr(cli, "_dispatch", boom)
+
+    class FakeTrace:
+        def __init__(self, path):
+            # jax.profiler.trace creates the directory eagerly; the
+            # crash then leaves it with no events written
+            import os
+
+            os.makedirs(path, exist_ok=True)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "trace", FakeTrace)
+    target = tmp_path / "trace"
+    with pytest.raises(SystemExit):
+        cli.main(["--profile", str(target), "devices"])
+    assert not target.exists()

@@ -32,6 +32,7 @@ from activemonitor_tpu.obs.slo import (
     evaluate,
     fleet_goodput,
     quantile,
+    rollup_statusz,
     slo_config_from_spec,
     window_results,
 )
@@ -354,6 +355,10 @@ FLEET_FIELDS = {
     # durable telemetry journal (ISSUE 16): segment table, per-stream
     # counts, lag; None when no --journal-dir is wired
     "journal": (dict, type(None)),
+    # critical-path latency decomposition (ISSUE 17): run-weighted
+    # merge of the per-check blocks; None until a windowed run still
+    # has spans in the ring
+    "critical_path": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,
@@ -375,6 +380,9 @@ CHECK_FIELDS = {
     "window": dict,
     "slo": (dict, type(None)),
     "history": list,
+    # per-stage p50/p95/p99 waterfall aggregation (ISSUE 17): None
+    # while no windowed run still has spans in the ring
+    "critical_path": (dict, type(None)),
 }
 WINDOW_FIELDS = {
     "seconds": (int, float),
@@ -444,6 +452,9 @@ BUNDLE_FIELDS = {
     "attribution": (dict, type(None)),
     # the check's latest roofline snapshot (ISSUE 9)
     "roofline": (dict, type(None)),
+    # the triggering run's critical-path waterfall (ISSUE 17): None
+    # when the bundle's trace has no finished spans in the ring
+    "waterfall": (dict, type(None)),
     "extra": dict,
 }
 BREAKER_FIELDS = {
@@ -452,6 +463,26 @@ BREAKER_FIELDS = {
     "recent_failures": int,
     "retry_after_seconds": (int, float),
     "trips": int,
+}
+# the critical_path block (ISSUE 17, obs/criticalpath.py): served
+# per check, merged into the fleet block, and rollup-merged across
+# replicas — one schema for all three surfaces
+CRITICAL_PATH_FIELDS = {
+    "runs": int,
+    # runs from version-skewed (old-binary) replicas whose whole
+    # latency is booked under untracked
+    "skewed_runs": int,
+    "wall": dict,
+    "stages": dict,
+    "dominant_stage": str,
+    "last": (dict, type(None)),
+}
+WATERFALL_FIELDS = {
+    "trace_id": str,
+    "wall_seconds": (int, float),
+    "stages": dict,
+    "dominant_stage": str,
+    "segments": list,
 }
 
 
@@ -547,6 +578,105 @@ def test_flight_bundle_schema_contract(tmp_path):
     [line] = list(FlightRecorder.read_jsonl(str(tmp_path / "flightrec.jsonl")))
     assert_schema(line, BUNDLE_FIELDS, "jsonl bundle")
     assert line["id"] == doc["id"]
+
+
+def _traced_fleet(clock, hc, span_plan, *, latency):
+    """A FleetStatus whose one recorded run still has live spans in the
+    tracer ring — the precondition for a non-None critical_path block.
+    ``span_plan`` is (name, start, end) triples on the fake monotonic
+    timeline; the run's probe timings carve 1s of probe_phase out of
+    its poll stage."""
+    from activemonitor_tpu.obs import Tracer
+
+    fleet = FleetStatus(clock, MetricsCollector())
+    tracer = Tracer(clock)
+    fleet.tracer = tracer
+    with tracer.trace("reconcile"):
+        for name, start, end in span_plan:
+            tracer.record_span(name, start=start, end=end)
+        fleet.record(
+            hc,
+            ok=True,
+            latency=latency,
+            workflow="wf",
+            timings={"calibrate": 1.0},
+        )
+    return fleet
+
+
+def test_statusz_critical_path_block_and_rollup():
+    """Satellite 3 (ISSUE 17): the critical_path block rides /statusz
+    per check AND per fleet, and the 3-replica rollup run-weights the
+    percentiles — an old-binary replica (no block at all) merges with
+    its whole windowed latency booked under untracked instead of
+    silently vanishing from the fleet view."""
+    clock = FakeClock()
+    hc = make_hc()
+    # replica A: a healthy path — poll dominates (4s window, 1s of it
+    # carved into probe_phase by the run's timings)
+    fleet_a = _traced_fleet(
+        clock, hc, [("dequeue", 0.0, 1.0), ("poll", 1.0, 5.0)], latency=5.0
+    )
+    # replica B: queue-wait degraded — 4 of its 5 seconds in the queue
+    fleet_b = _traced_fleet(
+        clock, hc, [("dequeue", 0.0, 4.0), ("poll", 4.0, 5.0)], latency=5.0
+    )
+    # replica C: an old binary — records runs but serves no block
+    fleet_c = FleetStatus(clock, MetricsCollector())
+    fleet_c.record(hc, ok=True, latency=3.0, workflow="wf")
+
+    p_a = json.loads(json.dumps(fleet_a.statusz([hc])))
+    p_b = json.loads(json.dumps(fleet_b.statusz([hc])))
+    p_c = json.loads(json.dumps(fleet_c.statusz([hc])))
+    for payload in (p_a, p_b):
+        [entry] = payload["checks"]
+        assert_schema(
+            entry["critical_path"], CRITICAL_PATH_FIELDS, "critical_path"
+        )
+        assert_schema(
+            entry["critical_path"]["last"], WATERFALL_FIELDS, "last waterfall"
+        )
+        assert_schema(
+            payload["fleet"]["critical_path"],
+            CRITICAL_PATH_FIELDS,
+            "fleet.critical_path",
+        )
+        # single-run conservation survives serialization: the per-stage
+        # p95s sum back to the wall p95
+        block = entry["critical_path"]
+        assert sum(
+            q["p95"] for q in block["stages"].values()
+        ) == pytest.approx(block["wall"]["p95"], abs=1e-9)
+    assert p_a["fleet"]["critical_path"]["dominant_stage"] == "poll"
+    assert p_b["fleet"]["critical_path"]["dominant_stage"] == "queue_wait"
+    # probe_phase was carved out of poll, not double-booked
+    assert p_a["checks"][0]["critical_path"]["stages"]["probe_phase"][
+        "p95"
+    ] == pytest.approx(1.0)
+    assert p_a["checks"][0]["critical_path"]["stages"]["poll"][
+        "p95"
+    ] == pytest.approx(3.0)
+
+    # simulate the old binary: the key is absent, not null
+    p_c["fleet"].pop("critical_path")
+    for entry in p_c["checks"]:
+        entry.pop("critical_path")
+
+    merged = rollup_statusz([p_a, p_b, p_c])
+    block = merged["fleet"]["critical_path"]
+    assert_schema(block, CRITICAL_PATH_FIELDS, "rollup.critical_path")
+    assert block["runs"] == 3
+    assert block["skewed_runs"] == 1
+    # run-weighted means: A(qw=1) B(qw=4) C(qw=0) -> 5/3, and the old
+    # binary's 3s window lands entirely under untracked -> 1.0
+    assert block["stages"]["queue_wait"]["p95"] == pytest.approx(5.0 / 3.0)
+    assert block["stages"]["untracked"]["p95"] == pytest.approx(1.0)
+    assert block["stages"]["poll"]["p95"] == pytest.approx(1.0)
+    assert block["wall"]["p95"] == pytest.approx(13.0 / 3.0)
+    assert block["dominant_stage"] == "queue_wait"
+    # the newest measured run's waterfall survives the merge for the
+    # CLI's ASCII rendering (first-seen-wins, like the check dedupe)
+    assert_schema(block["last"], WATERFALL_FIELDS, "rollup last")
 
 
 def test_statusz_history_is_a_bounded_tail():
