@@ -1007,6 +1007,21 @@ def _why_cell(attribution) -> str:
     )
 
 
+def _adapt_cell(adapt) -> str:
+    """The status table's ADAPT cell: the levers currently holding the
+    check, with the cadence factor inlined (`cadence:0.5+placement`) —
+    one token, "-" while no lever touches it."""
+    if not adapt or not adapt.get("levers"):
+        return "-"
+    parts = []
+    for lever in adapt["levers"]:
+        if lever == "cadence" and adapt.get("cadence_factor") is not None:
+            parts.append("cadence:{:g}".format(adapt["cadence_factor"]))
+        else:
+            parts.append(lever)
+    return "+".join(parts)
+
+
 def render_status_table(payload: dict) -> str:
     """The /statusz payload as the `am-tpu status` table. Pure so tests
     pin the rendering against a canned payload."""
@@ -1058,6 +1073,23 @@ def render_status_table(payload: dict) -> str:
         if not frontdoor.get("conservation_ok", True):
             line += "  CONSERVATION-BROKEN"
         lines.append(line)
+    adaptive = fleet.get("adaptive")
+    if adaptive and adaptive.get("engaged"):
+        # the closed-loop control line: which levers hold how many
+        # checks, and the front-door degraded posture while it lasts
+        # (docs/resilience.md "Adaptive control loop")
+        levers = adaptive.get("levers") or {}
+        held = {k: v for k, v in sorted(levers.items()) if v}
+        line = "ADAPTIVE  levers={" + ", ".join(
+            f"{lever}: {count}" for lever, count in held.items()
+        ) + "}"
+        adaptive_frontdoor = adaptive.get("frontdoor") or {}
+        if adaptive_frontdoor.get("engaged"):
+            line += "  DEGRADED-FRONTDOOR(ceiling={:g}s, shed=x{:g})".format(
+                adaptive_frontdoor.get("freshness_ceiling") or 0.0,
+                adaptive_frontdoor.get("shed_factor") or 0.0,
+            )
+        lines.append(line)
     sharding = fleet.get("sharding")
     if sharding:
         from activemonitor_tpu.obs.slo import shard_sort_key
@@ -1086,7 +1118,8 @@ def render_status_table(payload: dict) -> str:
         )
     headers = [
         "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
-        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "WHY", "LAST TRACE",
+        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "ADAPT", "WHY",
+        "LAST TRACE",
     ]
     rows = []
     for check in payload.get("checks") or []:
@@ -1116,6 +1149,9 @@ def render_status_table(payload: dict) -> str:
                     else "-"
                 ),
                 "-" if remedy_budget is None else str(remedy_budget),
+                # adaptive levers currently reshaping this check's
+                # schedule ("-" while the loop leaves it alone)
+                _adapt_cell(check.get("adapt")),
                 # goodput attribution headline: the bucket costing this
                 # check goodput right now ("-" while nothing is lost);
                 # `am-tpu why <check>` has the full evidence
@@ -1317,6 +1353,30 @@ def render_why(check: dict) -> str:
         )
     if attribution and attribution.get("why"):
         lines.append(f"  why: {attribution['why']}")
+    adapt = check.get("adapt")
+    if adapt:
+        # the adaptation episode: which levers hold this check, why,
+        # and since when — the operator's answer to "who changed my
+        # probe cadence" (docs/resilience.md "Adaptive control loop")
+        held = "+".join(adapt.get("levers") or [])
+        line = f"  adaptation: {held}"
+        if adapt.get("cadence_factor") is not None:
+            line += "  interval x{:g}".format(adapt["cadence_factor"])
+        if adapt.get("cause"):
+            line += "  cause={}".format(adapt["cause"])
+        if adapt.get("since"):
+            line += "  since={}".format(adapt["since"])
+        lines.append(line)
+        if adapt.get("cohort"):
+            lines.append(
+                "    placement: cohort {} contended — probes parked at "
+                "wider cadence".format(adapt["cohort"])
+            )
+        if adapt.get("remedy_bucket"):
+            lines.append(
+                "    remedy: byBucket[{}] targeted over the plain "
+                "fallback".format(adapt["remedy_bucket"])
+            )
     lost_tail = [
         entry
         for entry in check.get("history") or []
@@ -1368,6 +1428,7 @@ async def _why(args) -> int:
                 "key": check.get("key"),
                 "attribution": check.get("attribution"),
                 "analysis": check.get("analysis"),
+                "adapt": check.get("adapt"),
                 "history": check.get("history"),
             }
             for check in matches

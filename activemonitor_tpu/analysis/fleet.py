@@ -63,6 +63,12 @@ class CohortIndex:
                 keys.update(values)
         return sorted(keys)
 
+    def cohorts(self) -> List[str]:
+        """Every cohort with at least one recorded value — the sweep
+        surface for fleet-wide consumers (resilience/adapt.py walks it
+        looking for contended members)."""
+        return sorted({c for (c, _metric) in self._values})
+
     def scores(self, cohort: str, metric: str) -> Dict[str, float]:
         """Per-member deviation from the cohort median in cohort-MAD
         sigmas (signed: negative = below the cohort). Empty below

@@ -13,7 +13,7 @@ YAML written for the reference loads unchanged.
 from __future__ import annotations
 
 import datetime
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 from pydantic import BaseModel, ConfigDict, Field
 
@@ -123,12 +123,40 @@ class Workflow(_Base):
 class RemedyWorkflow(Workflow):
     """Describes the self-healing workflow (reference: healthcheck_types.go:97-106).
 
-    Same schema as Workflow; only the emptiness test differs.
+    Same schema as Workflow, plus ``byBucket``: an optional map from
+    attribution bucket (obs/attribution.py taxonomy: ``ici``, ``hbm``,
+    ``compile``, ``scheduling``, ``control_plane``, ``unknown``) to a
+    bucket-specific remedy workflow. When the failing run's attribution
+    names a mapped bucket, that workflow runs INSTEAD of the plain
+    remedy; otherwise the plain remedy is the fallback. Values are
+    plain :class:`Workflow` (not ``RemedyWorkflow``) — nesting does not
+    recurse, by construction and by CRD schema.
     """
 
+    by_bucket: Dict[str, Workflow] = Field(
+        default_factory=dict, alias="byBucket"
+    )
+
     def is_empty(self) -> bool:
-        """True when no remedy is configured (reference: healthcheck_types.go:104-106)."""
+        """True when no remedy is configured (reference: healthcheck_types.go:104-106).
+
+        A remedy carrying only ``byBucket`` entries is NOT empty: the
+        targeted workflows are real remedies even without a fallback.
+        """
         return self == RemedyWorkflow()
+
+    def select_for_bucket(self, bucket: str) -> Optional[Workflow]:
+        """The workflow to run for a failure attributed to ``bucket`` —
+        the bucket-targeted entry when one exists, else this remedy
+        itself (the documented fallback), else None when the remedy has
+        ONLY unmatched ``byBucket`` entries and no fallback content.
+        Callers detect targeting via ``selected is not remedy``."""
+        selected = self.by_bucket.get(bucket or "")
+        if selected is not None:
+            return selected
+        if self.model_copy(update={"by_bucket": {}}) == RemedyWorkflow():
+            return None
+        return self
 
 
 class SLOSpec(_Base):

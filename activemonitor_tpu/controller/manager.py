@@ -363,6 +363,10 @@ class Manager:
                 # key and this replica's rings never see the owner's
                 # results, so the waiters would hang until reap
                 frontdoor.owns = shard_coordinator.owns_key
+            # adaptive lever 4 (resilience/adapt.py): a confirmed
+            # control-plane burn widens the door's freshness ceiling
+            # and sheds low-priority tenants before the breaker trips
+            reconciler.adapt.frontdoor = frontdoor
         # --journal-dir (obs/journal.py): the durable telemetry journal.
         # Replay-then-subscribe via attach_journal restores the SLO /
         # goodput windows the restart would otherwise lose, the front
@@ -840,6 +844,11 @@ class Manager:
             try:
                 self.reconciler.resilience.refresh()
                 await self.reconciler.replay_status_writes()
+                # adaptive-control sweep (resilience/adapt.py): refresh
+                # the contention-placement lever from the cohort index,
+                # the derived front-door degraded mode, and the lever
+                # gauges — never raises by its own contract
+                self.reconciler.adapt.sweep()
                 if self._frontdoor is not None:
                     # degraded-mode parked requests replay next to the
                     # queued status writes (same recovery signal), and

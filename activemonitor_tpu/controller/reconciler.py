@@ -89,6 +89,7 @@ from activemonitor_tpu.resilience import (
     STATE_HEALTHY,
     STATE_QUARANTINED,
 )
+from activemonitor_tpu.resilience.adapt import AdaptiveController
 from activemonitor_tpu.scheduler import (
     CronParseError,
     InverseExpBackoff,
@@ -160,6 +161,19 @@ class HealthCheckReconciler:
         # the coordinator triggers a breaker-open bundle the moment the
         # breaker trips (the transition callback already funnels here)
         self.resilience.flightrec = self.flightrec
+        # closed-loop adaptive control (resilience/adapt.py): consumes
+        # burn rate + attribution off the fleet's record path and works
+        # the four levers — cadence (through the checks tracker's one
+        # damp rule), bucket-targeted remedies, contention placement
+        # (through the analysis cohort index), and front-door degraded
+        # mode (wired by the Manager when a front door exists). Same
+        # ownership shape as the tracer.
+        self.adapt = AdaptiveController(
+            self.clock, metrics, checks=self.resilience.checks
+        )
+        self.adapt.flightrec = self.flightrec
+        self.adapt.cohorts = self.analysis.cohorts
+        self.fleet.adaptive = self.adapt
         self.timers = TimerWheel(self.clock)
         # sharded-fleet coordinator (controller/sharding.py), wired by
         # the Manager when --shards > 1: ownership gates for timer-fired
@@ -226,6 +240,9 @@ class HealthCheckReconciler:
             # ... and its learned baselines, cohort membership, and
             # anomaly/baseline/z-score series
             self.analysis.forget(key, name, namespace)
+            # ... and its adaptive-control episodes (releases the
+            # cadence gauge series and any derived front-door lever)
+            self.adapt.forget(key)
             return None
         return await self._process_or_recover(hc)
 
@@ -1229,21 +1246,32 @@ class HealthCheckReconciler:
 
     def _effective_repeat_after(self, hc: HealthCheck) -> int:
         """Divergence 2: recompute the interval at reschedule time —
-        damped by the flap tracker's factor, so a flapping check burns
-        budget and apiserver capacity at a fraction of its cadence
-        until its verdict stabilizes."""
+        damped by the flap tracker's composed factor, so a flapping
+        check burns budget and apiserver capacity at a fraction of its
+        cadence until its verdict stabilizes, and a burning check
+        (resilience/adapt.py, factor < 1) confirms recovery sooner.
+        Floored at 1s: a tightened short interval truncating to 0 would
+        read as "paused", silently stopping the very check the adaptive
+        loop wants to run MORE often."""
         damp = self.resilience.checks.damp_factor(hc.key)
         if hc.spec.repeat_after_sec > 0 and not hc.spec.schedule.cron:
-            return int(hc.spec.repeat_after_sec * damp)
+            return max(1, int(hc.spec.repeat_after_sec * damp))
         if hc.spec.schedule.cron:
             try:
-                return int(
-                    seconds_until_next(hc.spec.schedule.cron, self.clock.now())
-                    * damp
+                return max(
+                    1,
+                    int(
+                        seconds_until_next(
+                            hc.spec.schedule.cron, self.clock.now()
+                        )
+                        * damp
+                    ),
                 )
             except CronParseError:
                 return 0
-        return int(hc.spec.repeat_after_sec * damp)
+        if hc.spec.repeat_after_sec > 0:
+            return max(1, int(hc.spec.repeat_after_sec * damp))
+        return 0
 
     def _resubmit_callback(self, prev_hc: HealthCheck):
         """Timer-fired resubmission (reference: createSubmitWorkflowHelper,
@@ -1420,6 +1448,36 @@ class HealthCheckReconciler:
             await self._process_remedy_inner(hc)
 
     async def _process_remedy_inner(self, hc: HealthCheck) -> None:
+        # attribution-targeted selection (resilience/adapt.py lever 2):
+        # the failing run's bucket — recorded by the fleet BEFORE the
+        # remedy gate ran — picks a byBucket workflow over the plain
+        # fallback. RBAC below still provisions from the plain entry
+        # (the documented contract: byBucket entries ride the fallback's
+        # serviceAccount unless they name a pre-provisioned one).
+        last = self.fleet.history.last(hc.key)
+        bucket = last.bucket if last is not None else ""
+        remedy = hc.spec.remedy_workflow.select_for_bucket(bucket)
+        if remedy is None:
+            # only unmatched byBucket entries, no fallback: a remedy is
+            # configured but not for THIS failure mode — evented, never
+            # an error (the next failure may hit a mapped bucket)
+            self.recorder.event(
+                hc,
+                EVENT_NORMAL,
+                "Normal",
+                "No remedy configured for attribution bucket "
+                f"'{bucket or 'unknown'}'; skipping remedy run",
+            )
+            return
+        if remedy is not hc.spec.remedy_workflow:
+            self.adapt.note_remedy_selected(hc.key, bucket)
+            self.recorder.event(
+                hc,
+                EVENT_NORMAL,
+                "Normal",
+                f"Selected byBucket['{bucket}'] remedy workflow for this "
+                "failure's attribution",
+            )
         await self.rbac.create_rbac_for_workflow(hc, WORKFLOW_TYPE_REMEDY)
         # remedy RBAC is ephemeral (reference: :779-784) — and because
         # it is the WRITE-capable identity, it must be torn down on
@@ -1433,9 +1491,11 @@ class HealthCheckReconciler:
                     "parse", healthcheck=hc.key, workflow_type="remedy"
                 ):
                     manifest = await self._parse_manifest(
-                        parse_remedy_workflow_from_healthcheck,
+                        lambda h: parse_remedy_workflow_from_healthcheck(
+                            h, remedy=remedy
+                        ),
                         hc,
-                        hc.spec.remedy_workflow,
+                        remedy,
                     )
             except Exception:
                 self.recorder.event(
@@ -1456,7 +1516,7 @@ class HealthCheckReconciler:
             self.recorder.event(
                 hc, EVENT_NORMAL, "Normal", "Successfully created remedyWorkflow"
             )
-            await self._watch_remedy_workflow(hc, wf_name)
+            await self._watch_remedy_workflow(hc, wf_name, remedy)
         finally:
             try:
                 await self.rbac.delete_rbac_for_workflow(hc)
@@ -1470,8 +1530,15 @@ class HealthCheckReconciler:
                     exc_info=True,
                 )
 
-    async def _watch_remedy_workflow(self, hc: HealthCheck, wf_name: str) -> None:
-        wf_namespace = hc.spec.remedy_workflow.resource.namespace
+    async def _watch_remedy_workflow(
+        self, hc: HealthCheck, wf_name: str, remedy=None
+    ) -> None:
+        # watch the namespace the SELECTED remedy actually submitted to
+        # (a byBucket entry may target a different namespace than the
+        # plain fallback)
+        if remedy is None:
+            remedy = hc.spec.remedy_workflow
+        wf_namespace = remedy.resource.namespace
         then = self.clock.now()
         # remedy polling derives from the CHECK's timeout with default
         # factor — parity with the reference (:791-801)

@@ -166,10 +166,18 @@ def parse_workflow_from_healthcheck(hc: HealthCheck) -> dict:
     return data
 
 
-def parse_remedy_workflow_from_healthcheck(hc: HealthCheck) -> dict:
+def parse_remedy_workflow_from_healthcheck(hc: HealthCheck, remedy=None) -> dict:
     """Build the remedy workflow manifest
-    (reference: healthcheck_controller.go:1002-1125 + :536-559)."""
-    remedy = hc.spec.remedy_workflow
+    (reference: healthcheck_controller.go:1002-1125 + :536-559).
+
+    ``remedy`` is the workflow to build — the plain
+    ``spec.remedyworkflow`` by default, or a bucket-targeted entry the
+    reconciler selected from ``byBucket``. A targeted entry without its
+    own serviceAccount inherits the plain remedy's (the one the RBAC
+    provisioner actually created)."""
+    fallback = hc.spec.remedy_workflow
+    if remedy is None:
+        remedy = fallback
     if remedy.resource is None:
         raise WorkflowSpecError("RemedyWorkflow Resource is nil")
     data = _load_manifest(remedy.resource.source)
@@ -177,8 +185,13 @@ def parse_remedy_workflow_from_healthcheck(hc: HealthCheck) -> dict:
 
     if spec.get("podGC") is None:
         spec["podGC"] = {"strategy": POD_GC_ON_POD_COMPLETION}
-    if remedy.resource.service_account:
-        spec["serviceAccountName"] = remedy.resource.service_account
+    service_account = remedy.resource.service_account or (
+        fallback.resource.service_account
+        if fallback.resource is not None
+        else ""
+    )
+    if service_account:
+        spec["serviceAccountName"] = service_account
 
     if remedy.tpu is not None:
         # remedies inherit the placement machinery: a fix for a TPU node
@@ -189,12 +202,12 @@ def parse_remedy_workflow_from_healthcheck(hc: HealthCheck) -> dict:
     deadline = spec.get("activeDeadlineSeconds")
     if deadline is None:
         spec["activeDeadlineSeconds"] = default_timeout
-        hc.spec.remedy_workflow.timeout = default_timeout
+        remedy.timeout = default_timeout
     elif isinstance(deadline, (int, float)) and not isinstance(deadline, bool):
-        hc.spec.remedy_workflow.timeout = int(deadline)
+        remedy.timeout = int(deadline)
     else:
         # non-numeric deadline in the manifest: fall back (reference: :1114-1119)
-        hc.spec.remedy_workflow.timeout = default_timeout
+        remedy.timeout = default_timeout
 
     data["apiVersion"] = WF_API_VERSION
     data["kind"] = WF_KIND

@@ -61,14 +61,23 @@ OVERFLOW_TENANT = "(overflow)"
 # config, bounded by definition; this caps the default-quota fleet)
 DEFAULT_MAX_TENANTS = 1024
 
+# tenant priority classes: ``low``-priority tenants are the shed class
+# under adaptive degraded mode (resilience/adapt.py re-prices their
+# quotas before the breaker has to trip); everyone else is ``normal``
+PRIORITY_NORMAL = "normal"
+PRIORITY_LOW = "low"
+
 
 @dataclass(frozen=True)
 class TenantQuota:
     """One tenant's admission budget: requests/minute with a burst
-    ceiling (defaults to the rate, the TokenBucket convention)."""
+    ceiling (defaults to the rate, the TokenBucket convention), plus a
+    priority class (``low`` marks the tenant sheddable under adaptive
+    degraded mode)."""
 
     rate_per_minute: float
     burst: Optional[float] = None
+    priority: str = PRIORITY_NORMAL
 
     def bucket(self, clock: Clock) -> TokenBucket:
         return TokenBucket(self.rate_per_minute, burst=self.burst, clock=clock)
@@ -121,6 +130,14 @@ class AdmissionController:
         self._router = router
         self.max_tenants = max(1, int(max_tenants))
         self._buckets: Dict[str, TokenBucket] = {}
+        # quota each live bucket was minted from, so degraded-mode
+        # re-pricing (shed_low_priority) can find the low-priority
+        # buckets and restore_quotas can return them to configured rate
+        self._bucket_quota: Dict[str, TenantQuota] = {}
+        # active shed factor (None = normal mode); applied to already-
+        # minted low-priority buckets at engage time and to any minted
+        # while degraded
+        self.shed_factor: Optional[float] = None
         # per-tenant ledger: admitted counts and refusals by reason —
         # the raw material of the conservation property test. Keyed by
         # the BOOKED name (never-seen tenants' refusals share the
@@ -150,7 +167,43 @@ class AdmissionController:
                 return None, REFUSE_TENANT_CAPACITY
             quota = self._default
         bucket = self._buckets[tenant] = quota.bucket(self.clock)
+        self._bucket_quota[tenant] = quota
+        if self.shed_factor is not None and quota.priority == PRIORITY_LOW:
+            bucket.set_rate(quota.rate_per_minute * self.shed_factor)
         return bucket, None
+
+    # -- degraded-mode quota re-pricing (resilience/adapt.py) -----------
+    def shed_low_priority(self, factor: float) -> int:
+        """Re-price every low-priority tenant's bucket to ``factor`` of
+        its configured rate (and apply the same to buckets minted while
+        degraded). Sheds are ordinary structured ``quota`` refusals —
+        the conservation ledger needs no new vocabulary, and normal-
+        priority tenants are untouched. Returns how many live buckets
+        were re-priced."""
+        self.shed_factor = max(0.01, min(1.0, float(factor)))
+        repriced = 0
+        for tenant, bucket in self._buckets.items():
+            quota = self._bucket_quota.get(tenant)
+            if quota is not None and quota.priority == PRIORITY_LOW:
+                bucket.set_rate(quota.rate_per_minute * self.shed_factor)
+                repriced += 1
+        return repriced
+
+    def restore_quotas(self) -> int:
+        """Release degraded mode: every re-priced bucket returns to its
+        configured rate (settled in place — no fresh burst is granted,
+        the :meth:`TokenBucket.set_rate` contract). Returns how many
+        buckets were restored."""
+        if self.shed_factor is None:
+            return 0
+        self.shed_factor = None
+        restored = 0
+        for tenant, bucket in self._buckets.items():
+            quota = self._bucket_quota.get(tenant)
+            if quota is not None and quota.priority == PRIORITY_LOW:
+                bucket.set_rate(quota.rate_per_minute)
+                restored += 1
+        return restored
 
     def refuse(
         self, tenant: str, reason: str, booked: Optional[str] = None

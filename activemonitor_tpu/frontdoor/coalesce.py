@@ -77,28 +77,74 @@ class CoalescingCache:
         self.history = history
         self.clock = clock or Clock()
         self.default_freshness = max(0.0, float(default_freshness))
+        # degraded-mode ceiling (resilience/adapt.py): while the
+        # adaptive loop confirms a control-plane burn, the effective
+        # staleness ceiling stretches ABOVE the operator default so
+        # cached answers absorb demand. None = normal mode. Always >=
+        # default_freshness — the two-ceiling rule widens, never
+        # narrows, so the documented "per-request windows only narrow"
+        # contract stays honest in both modes.
+        self.degraded_ceiling: Optional[float] = None
         self._inflight: Dict[str, InFlightRun] = {}
         # running fan-in count, so waiter_count() is O(1) on the
         # submit hot path instead of a walk over every in-flight run
         self._waiters = 0
         history.subscribe(self._on_result)
 
+    # -- freshness ceilings ----------------------------------------------
+    def set_degraded_ceiling(self, ceiling: Optional[float]) -> None:
+        """Engage (or with None, release) the degraded-mode staleness
+        ceiling. Clamped up to the operator default: a degraded ceiling
+        below it would turn the widening lever into a narrowing one."""
+        if ceiling is None:
+            self.degraded_ceiling = None
+        else:
+            self.degraded_ceiling = max(self.default_freshness, float(ceiling))
+
+    def freshness_ceiling(self) -> float:
+        """The staleness ceiling currently in force: the degraded-mode
+        ceiling while engaged, else the operator default."""
+        if self.degraded_ceiling is not None:
+            return self.degraded_ceiling
+        return self.default_freshness
+
+    def clamp(self, freshness: Optional[float]) -> dict:
+        """The two-ceiling freshness rule, as a structured decision the
+        ledger can surface instead of a silent narrow. A per-request
+        window may only NARROW the ceiling in force (the documented
+        contract: the ceiling is the staleness bound — a request asking
+        for a wider window clamps down to it); which ceiling is in
+        force depends on degraded mode. Returns ``window`` (the
+        effective seconds), ``asked`` (the request's own window or
+        None), ``ceiling``, ``mode`` (``"degraded"``/``"default"``) and
+        ``clamped`` (True when the request asked for more staleness
+        than the ceiling allows)."""
+        ceiling = self.freshness_ceiling()
+        mode = "degraded" if self.degraded_ceiling is not None else "default"
+        if freshness is None:
+            window = ceiling
+            clamped = False
+        else:
+            asked = float(freshness)
+            window = min(asked, ceiling)
+            clamped = asked > ceiling
+        return {
+            "window": window,
+            "asked": freshness,
+            "ceiling": ceiling,
+            "mode": mode,
+            "clamped": clamped,
+        }
+
     # -- lookups ---------------------------------------------------------
     def fresh_result(
         self, key: str, freshness: Optional[float] = None
     ) -> Optional[CheckResult]:
         """The check's newest recorded result if it is younger than the
-        freshness window, else None. A per-request window may only
-        NARROW the door's default (the documented contract: the
-        operator's default is the staleness ceiling — a request asking
-        for a wider window clamps down to it). Freshness is judged on
-        the SAME clock the history stamped the result with, so
-        fake-clock tests script exact expiry edges."""
-        window = (
-            self.default_freshness
-            if freshness is None
-            else min(freshness, self.default_freshness)
-        )
+        effective freshness window (:meth:`clamp`), else None.
+        Freshness is judged on the SAME clock the history stamped the
+        result with, so fake-clock tests script exact expiry edges."""
+        window = self.clamp(freshness)["window"]
         last = self.history.last(key)
         if last is None or window <= 0:
             return None

@@ -99,6 +99,10 @@ class Ticket:
     reason: str = ""  # refusal reason; "" otherwise
     result: Optional[CheckResult] = None  # immediate for cache hits
     future: Optional[asyncio.Future] = None  # joined / run / parked
+    # the two-ceiling freshness decision (CoalescingCache.clamp) the
+    # lookup ran under — structured, so a narrowed window is visible to
+    # the caller instead of silent; None for pre-lookup refusals
+    clamp: Optional[dict] = None
     # the decision's lifecycle on the door's monotonic clock —
     # ("admit"|"coalesce-join"|"demand-fire"|"enqueue"|"parked", t) in
     # order; the critical-path waterfall's front-door evidence
@@ -138,6 +142,10 @@ class _Tally:
     joins: int = 0
     runs: int = 0
     parked: int = 0  # currently parked (decrements when pumped)
+    # requests whose asked freshness exceeded the ceiling in force and
+    # was narrowed (the two-ceiling rule) — informational, orthogonal
+    # to the one-of-exactly-one outcome columns
+    clamped: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -146,6 +154,7 @@ class _Tally:
             "coalesced_joins": self.joins,
             "probe_runs": self.runs,
             "parked": self.parked,
+            "clamped": self.clamped,
         }
 
 
@@ -223,6 +232,23 @@ class FrontDoor:
     def degraded(self) -> bool:
         return bool(self.resilience is not None and self.resilience.degraded)
 
+    # -- adaptive degraded mode (resilience/adapt.py) --------------------
+    def widen_freshness(self, factor: float) -> float:
+        """Engage the degraded-mode staleness ceiling at ``factor`` ×
+        the operator default (clamped to widen-only), so cached answers
+        absorb demand under a confirmed control-plane burn. Returns the
+        ceiling now in force."""
+        self.cache.set_degraded_ceiling(
+            self.cache.default_freshness * max(1.0, float(factor))
+        )
+        return self.cache.freshness_ceiling()
+
+    def restore_freshness(self) -> None:
+        """Release the degraded-mode ceiling: back to the operator
+        default. Parked requests keep the freshness they asked for —
+        the pump re-decides them under the restored ceiling."""
+        self.cache.set_degraded_ceiling(None)
+
     # -- the submit path -------------------------------------------------
     def submit(
         self,
@@ -277,6 +303,15 @@ class FrontDoor:
             )
             self._account(ticket, started, booked)
             return ticket
+        # the two-ceiling freshness rule, decided ONCE and surfaced on
+        # the ticket + ledger: a request asking for more staleness than
+        # the ceiling in force narrows audibly, never silently
+        clamp = self.cache.clamp(freshness)
+        if clamp["clamped"]:
+            tally.clamped += 1
+            self._totals.clamped += 1
+            if self.metrics is not None:
+                self.metrics.record_frontdoor_clamp(booked, clamp["mode"])
         lifecycle: List[Tuple[str, float]] = [("admit", started)]
         outcome, fresh = self.cache.lookup(check, freshness)
         if outcome == LOOKUP_HIT:
@@ -370,6 +405,7 @@ class FrontDoor:
                 future=self.cache.join(check),
                 lifecycle=lifecycle,
             )
+        ticket.clamp = clamp
         self._account(ticket, started, booked)
         return ticket
 
@@ -693,6 +729,12 @@ class FrontDoor:
             "reaped_runs": self.reaped_runs,
             "degraded": self.degraded,
             "conservation_ok": conservation["ok"],
+            "freshness": {
+                "default": self.cache.default_freshness,
+                "ceiling": self.cache.freshness_ceiling(),
+                "widened": self.cache.degraded_ceiling is not None,
+                "clamped": self._totals.clamped,
+            },
             "requests": {
                 "submitted": conservation["submitted"],
                 "refused": conservation["refused"],
@@ -705,6 +747,7 @@ class FrontDoor:
                     "submitted": row["submitted"],
                     "refused": row["refused_total"],
                     "refusals": row["refused"],
+                    "clamped": row.get("clamped", 0),
                 }
                 for tenant, row in conservation["tenants"].items()
             },

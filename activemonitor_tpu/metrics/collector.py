@@ -684,6 +684,54 @@ class MetricsCollector:
             registry=self.registry,
         )
         self._critical_path_series: set = set()
+        # -- adaptive-control families (resilience/adapt.py is the
+        # single writer; docs/resilience.md "Adaptive control loop").
+        # Lever cardinality is the fixed four-lever vocabulary; the
+        # per-check cadence gauge is LAZY (series only while an episode
+        # is engaged, removed on release/forget) — a fleet at rest
+        # carries zero adaptive per-check series.
+        self.adaptive_cadence_factor = Gauge(
+            "healthcheck_adaptive_cadence_factor",
+            "Burn-rate cadence factor folded into the check's "
+            "damp_factor composition while an adaptation episode is "
+            "engaged (0.5 = probing at 2x cadence); series absent when "
+            "no episode is engaged",
+            [LABEL_HC, "namespace"],
+            registry=self.registry,
+        )
+        self.adaptive_lever_active = Gauge(
+            "healthcheck_adaptive_lever_active",
+            "Whether an adaptive-control lever currently touches any "
+            "check (1/0), by lever (cadence / remedy / placement / "
+            "frontdoor)",
+            ["lever"],
+            registry=self.registry,
+        )
+        self.adaptive_transitions = Counter(
+            "healthcheck_adaptive_transitions_total",
+            "Adaptive-control decisions by lever and action (engage / "
+            "release / target) — each increment has a matching "
+            "flight-recorder bundle and decision-log entry",
+            ["lever", "action"],
+            registry=self.registry,
+        )
+        self.adaptive_freshness_ceiling = Gauge(
+            "healthcheck_adaptive_freshness_ceiling_seconds",
+            "Front-door staleness ceiling currently in force: the "
+            "operator default, stretched while the frontdoor lever is "
+            "engaged under a confirmed control-plane burn; 0 when no "
+            "front door is wired",
+            registry=self.registry,
+        )
+        self.frontdoor_clamps = Counter(
+            "healthcheck_frontdoor_freshness_clamped_total",
+            "Front-door requests whose asked freshness exceeded the "
+            "ceiling in force and was narrowed (the two-ceiling rule), "
+            "by booked tenant and ceiling mode (default / degraded)",
+            ["tenant", "mode"],
+            registry=self.registry,
+        )
+        self._adaptive_cadence_series: set = set()
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -1118,6 +1166,39 @@ class MetricsCollector:
 
     def record_profile_capture(self, reason: str) -> None:
         self.profile_captures.labels(reason).inc()
+
+    # -- adaptive control loop ----------------------------------------
+
+    def set_adaptive_cadence(
+        self, hc_name: str, namespace: str, factor: float
+    ) -> None:
+        """Advertise the burn-driven cadence factor the adaptive
+        controller applied to a check (<1 = probing tightened)."""
+        self._adaptive_cadence_series.add((hc_name, namespace))
+        self.adaptive_cadence_factor.labels(hc_name, namespace).set(float(factor))
+
+    def clear_adaptive_cadence(self, hc_name: str, namespace: str) -> None:
+        """Episode released (or check deleted): drop the cadence series
+        so a stale factor can't outlive the engagement."""
+        if (hc_name, namespace) not in self._adaptive_cadence_series:
+            return
+        self._adaptive_cadence_series.discard((hc_name, namespace))
+        try:
+            self.adaptive_cadence_factor.remove(hc_name, namespace)
+        except KeyError:
+            pass  # never recorded — nothing to drop
+
+    def set_adaptive_lever(self, lever: str, active: bool) -> None:
+        self.adaptive_lever_active.labels(lever).set(1.0 if active else 0.0)
+
+    def record_adaptive_transition(self, lever: str, action: str) -> None:
+        self.adaptive_transitions.labels(lever, action).inc()
+
+    def set_adaptive_freshness_ceiling(self, seconds: float) -> None:
+        self.adaptive_freshness_ceiling.set(float(seconds))
+
+    def record_frontdoor_clamp(self, tenant: str, mode: str) -> None:
+        self.frontdoor_clamps.labels(tenant, mode).inc()
 
     # -- dynamic custom metrics ---------------------------------------
     # recorded-run memory bound: at one run a second this is ~34 min of

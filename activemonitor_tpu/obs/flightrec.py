@@ -63,6 +63,12 @@ KIND_MATRIX = "matrix-regression"
 # ProfileOnAnomaly): the bundle's extra carries the capture directory
 # path and the trigger reason, next to the profiled run's waterfall
 KIND_PROFILE = "profile-capture"
+# an adaptive-control lever engaged, released, or targeted a remedy
+# (resilience/adapt.py): the bundle's extra carries the lever, action,
+# attributed cause, and the human-readable decision detail — one bundle
+# per engage/release, so an adaptation episode is bracketed in the
+# flight log
+KIND_ADAPTIVE = "adaptive-lever"
 KINDS = (
     KIND_DEGRADED,
     KIND_BREAKER,
@@ -70,6 +76,7 @@ KINDS = (
     KIND_HANDOFF,
     KIND_MATRIX,
     KIND_PROFILE,
+    KIND_ADAPTIVE,
 )
 
 DEFAULT_CAPACITY = 256  # bundles retained in memory

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence
 from activemonitor_tpu.obs import attribution, criticalpath
 from activemonitor_tpu.obs.history import CheckResult, ResultHistory
 from activemonitor_tpu.obs.trace import current_trace_id
+from activemonitor_tpu.resilience.adapt import DECISION_LOG_CAPACITY
 from activemonitor_tpu.utils.clock import Clock
 
 log = logging.getLogger("activemonitor.slo")
@@ -235,6 +236,11 @@ class FleetStatus:
         # arming one bounded profiler capture of the check's next run.
         # None (profiling off / standalone) — no capture ever fires.
         self.profile_hook = None
+        # wired by the reconciler (resilience/adapt.py): the adaptive
+        # controller observing every SLO'd run's burn rate + bucket on
+        # the record path and serving the /statusz adaptive blocks.
+        # None (standalone) — no adaptation, adaptive: null.
+        self.adaptive = None
         # generated_at of the last round exported to the gauges, so the
         # rollup loop re-serving an unchanged sidecar never
         # double-counts the bisect counter
@@ -368,21 +374,38 @@ class FleetStatus:
         config = slo_config_from_spec(hc.spec)
         previous = self._configs.get(key)
         self._configs[key] = config
-        if config is not None and self.profile_hook is not None:
+        # one evaluate per record, shared by every burn-rate consumer —
+        # the profile trigger, the adaptive controller, and the gauges
+        # must all see the SAME state or they disagree mid-episode
+        state = (
+            evaluate(self.history.results(key), config, self.clock.now())
+            if config is not None
+            else None
+        )
+        if state is not None and self.profile_hook is not None:
             # burn-rate trigger for profile-on-anomaly: a check burning
             # budget faster than it accrues (>1.0) arms one bounded
             # capture of its next run. The hook's own cooldown absorbs
             # the repeat-fire every subsequent failing run would cause.
-            state = evaluate(self.history.results(key), config, self.clock.now())
             if state.burn_rate is not None and state.burn_rate > 1.0:
                 try:
                     self.profile_hook(key, "burn_rate")
                 except Exception:
                     log.exception("profile hook failed for %s", key)
+        if state is not None and self.adaptive is not None:
+            # closed-loop control (resilience/adapt.py): the adaptive
+            # controller sees every SLO'd run's burn rate with the
+            # run's own attribution bucket — the two signals its levers
+            # key on, captured at the one place both exist
+            try:
+                self.adaptive.observe(
+                    hc, burn_rate=state.burn_rate, bucket=bucket
+                )
+            except Exception:
+                log.exception("adaptive observe failed for %s", key)
         if self.metrics is None:
             return
-        if config is not None:
-            state = evaluate(self.history.results(key), config, self.clock.now())
+        if state is not None:
             if state.availability is not None:
                 self.metrics.set_slo(
                     hc.metadata.name,
@@ -603,6 +626,10 @@ class FleetStatus:
                 if config is not None
                 else None
             ),
+            # adaptive-control episode (resilience/adapt.py): which
+            # levers currently touch this check and why; None when no
+            # lever is engaged (or standalone)
+            "adapt": self.check_adapt(key),
             "history": [r.to_dict() for r in self.history.tail(key, self.HISTORY_TAIL)],
         }
         return summary
@@ -671,6 +698,11 @@ class FleetStatus:
                 # QPS, coalescing ratios, queue depth, per-tenant
                 # refusals; null when no front door is wired
                 "frontdoor": self.check_frontdoor(),
+                # adaptive-control state (resilience/adapt.py): engaged
+                # levers, per-check cadence episodes, front-door
+                # degraded mode, and the recent decision log; null when
+                # no adaptive controller is wired (standalone)
+                "adaptive": self.check_adaptive(),
                 # durable telemetry journal (obs/journal.py): segment
                 # table, per-stream appended/replayed counts, lag;
                 # null when no --journal-dir is wired
@@ -695,6 +727,28 @@ class FleetStatus:
             return self.frontdoor.snapshot()
         except Exception:
             log.exception("frontdoor snapshot failed")
+            return None
+
+    def check_adaptive(self) -> Optional[dict]:
+        """The adaptive controller's fleet snapshot, or None (not wired
+        / a snapshot error — observability must not fail the payload)."""
+        if self.adaptive is None:
+            return None
+        try:
+            return self.adaptive.snapshot()
+        except Exception:
+            log.exception("adaptive snapshot failed")
+            return None
+
+    def check_adapt(self, key: str) -> Optional[dict]:
+        """The adaptive controller's per-check block, or None (no lever
+        engaged / not wired / an error)."""
+        if self.adaptive is None:
+            return None
+        try:
+            return self.adaptive.check_adapt(key)
+        except Exception:
+            log.exception("adaptive check block failed for %s", key)
             return None
 
     def attach_journal(self, journal) -> None:
@@ -844,6 +898,9 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     # its own slice), lag is the fleet's worst, and any replica's
     # restore warning surfaces (first-seen wins)
     journal_blocks: List[dict] = []
+    # adaptive blocks merge lever-wise: counts sum, engaged is any,
+    # per-check episodes union (first-seen, like the checks array)
+    adaptive_blocks: List[dict] = []
     # critical-path blocks merge run-weighted; an old-binary replica
     # that serves no block still has its measured latency merged — its
     # whole path books under `untracked` via the skew fallback, never
@@ -907,6 +964,9 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
         replica_journal = fleet.get("journal")
         if isinstance(replica_journal, dict):
             journal_blocks.append(replica_journal)
+        replica_adaptive = fleet.get("adaptive")
+        if isinstance(replica_adaptive, dict):
+            adaptive_blocks.append(replica_adaptive)
         replica_critical_path = fleet.get("critical_path")
         if not isinstance(replica_critical_path, dict):
             # version skew: an old binary reports no block (or null) —
@@ -958,12 +1018,68 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "sharding": sharding_block,
             "matrix": matrix_block,
             "frontdoor": merge_frontdoor_blocks(frontdoor_blocks),
+            "adaptive": merge_adaptive_blocks(adaptive_blocks),
             "journal": merge_journal_blocks(journal_blocks),
             "critical_path": criticalpath.merge_critical_path_blocks(
                 critical_path_blocks
             ),
         },
         "checks": entries,
+    }
+
+
+def merge_adaptive_blocks(blocks: Sequence[dict]) -> Optional[dict]:
+    """Merge per-replica adaptive-control snapshots into one fleet
+    block: lever counts SUM (each replica adapts its own checks),
+    ``engaged`` is any-replica, the per-check cadence/placement maps
+    union first-seen (a check is reconciled by one replica, same dedupe
+    rule as the checks array), the front-door sub-block reports the
+    widest ceiling any replica runs, and the decision logs interleave
+    by timestamp (newest-last, capped at one replica's log length).
+    None when no replica reported an adaptive controller."""
+    if not blocks:
+        return None
+    levers: Dict[str, int] = {}
+    cadence: Dict[str, dict] = {}
+    placement: Dict[str, str] = {}
+    frontdoor = {
+        "engaged": False,
+        "since": None,
+        "freshness_ceiling": None,
+        "shed_factor": None,
+    }
+    recent: List[dict] = []
+    for block in blocks:
+        for lever, count in (block.get("levers") or {}).items():
+            levers[str(lever)] = levers.get(str(lever), 0) + int(count or 0)
+        for key, episode in (block.get("cadence") or {}).items():
+            cadence.setdefault(str(key), episode)
+        for key, cohort in (block.get("placement") or {}).items():
+            placement.setdefault(str(key), cohort)
+        replica_fd = block.get("frontdoor") or {}
+        if replica_fd.get("engaged"):
+            frontdoor["engaged"] = True
+            if frontdoor["since"] is None:
+                frontdoor["since"] = replica_fd.get("since")
+            if replica_fd.get("shed_factor") is not None:
+                frontdoor["shed_factor"] = replica_fd.get("shed_factor")
+        ceiling = replica_fd.get("freshness_ceiling")
+        if ceiling is not None:
+            frontdoor["freshness_ceiling"] = max(
+                float(frontdoor["freshness_ceiling"] or 0.0), float(ceiling)
+            )
+        recent.extend(
+            e for e in (block.get("recent") or []) if isinstance(e, dict)
+        )
+    recent.sort(key=lambda e: str(e.get("ts") or ""))
+    recent = recent[-DECISION_LOG_CAPACITY:]
+    return {
+        "engaged": any(levers.values()),
+        "levers": levers,
+        "cadence": {k: cadence[k] for k in sorted(cadence)},
+        "placement": {k: placement[k] for k in sorted(placement)},
+        "frontdoor": frontdoor,
+        "recent": recent,
     }
 
 
@@ -1026,6 +1142,7 @@ def merge_frontdoor_blocks(blocks: Sequence[dict]) -> Optional[dict]:
     queue_depth = parked = inflight = reaped = 0
     degraded = False
     conservation_ok = True
+    freshness: Optional[dict] = None
     for block in blocks:
         qps += float(block.get("qps") or 0.0)
         queue_depth += int(block.get("queue_depth") or 0)
@@ -1036,16 +1153,43 @@ def merge_frontdoor_blocks(blocks: Sequence[dict]) -> Optional[dict]:
         conservation_ok = conservation_ok and bool(
             block.get("conservation_ok", True)
         )
+        # two-ceiling freshness state: clamp counts sum; the ceiling is
+        # the widest any replica runs (widened = any). Absent on
+        # pre-upgrade replicas, so the merged block may stay None.
+        replica_freshness = block.get("freshness")
+        if isinstance(replica_freshness, dict):
+            if freshness is None:
+                freshness = {
+                    "default": replica_freshness.get("default"),
+                    "ceiling": float(
+                        replica_freshness.get("ceiling") or 0.0
+                    ),
+                    "widened": bool(replica_freshness.get("widened")),
+                    "clamped": int(replica_freshness.get("clamped") or 0),
+                }
+            else:
+                freshness["ceiling"] = max(
+                    freshness["ceiling"],
+                    float(replica_freshness.get("ceiling") or 0.0),
+                )
+                freshness["widened"] = freshness["widened"] or bool(
+                    replica_freshness.get("widened")
+                )
+                freshness["clamped"] += int(
+                    replica_freshness.get("clamped") or 0
+                )
         for field_name in requests:
             requests[field_name] += int(
                 (block.get("requests") or {}).get(field_name) or 0
             )
         for tenant, row in (block.get("tenants") or {}).items():
             merged_row = tenants.setdefault(
-                str(tenant), {"submitted": 0, "refused": 0, "refusals": {}}
+                str(tenant),
+                {"submitted": 0, "refused": 0, "refusals": {}, "clamped": 0},
             )
             merged_row["submitted"] += int(row.get("submitted") or 0)
             merged_row["refused"] += int(row.get("refused") or 0)
+            merged_row["clamped"] += int(row.get("clamped") or 0)
             for reason, count in (row.get("refusals") or {}).items():
                 merged_row["refusals"][str(reason)] = merged_row[
                     "refusals"
@@ -1071,6 +1215,7 @@ def merge_frontdoor_blocks(blocks: Sequence[dict]) -> Optional[dict]:
         "reaped_runs": reaped,
         "degraded": degraded,
         "conservation_ok": conservation_ok,
+        "freshness": freshness,
         "requests": requests,
         "tenants": {t: tenants[t] for t in sorted(tenants)},
     }

@@ -1,0 +1,444 @@
+"""Closed-loop goodput control: the layer that ACTS on what the fleet
+measures (ROADMAP item 1 — measure → attribute → act; the ML
+Productivity Goodput direction from PAPERS.md, with Maple-style policy
+portability: the policy consumes only the fleet's own burn-rate and
+attribution signals, so it behaves identically on any cluster).
+
+Four levers, each reusing an existing mechanism rather than growing a
+parallel one:
+
+- **cadence** — while a check's error budget burns, its probe interval
+  tightens through the ONE ``damp_factor`` composition in
+  resilience/health.py (``set_burn_damp``); calm releases it. Hysteresis
+  (``ENGAGE_AFTER`` burning observations to engage, ``RELEASE_AFTER``
+  calm ones to release) means a single burn spike never flaps the
+  cadence.
+- **remedy** — the failing run's attribution bucket selects a
+  bucket-targeted remedy workflow (``spec.remedyworkflow.byBucket``,
+  api/types.py); the reconciler reports each targeted selection here so
+  the episode is visible in /statusz and ``am-tpu why``.
+- **placement** — cohort straggler scores (analysis/fleet.py
+  ``CohortIndex``) steer probe traffic away from contended slices: a
+  member beyond ``CONTENTION_SIGMAS`` is parked at ``CONTENTION_DAMP``×
+  cadence through the same damp rule.
+- **frontdoor** — under a confirmed ``control_plane`` burn the
+  coalescing freshness ceiling widens (an explicit degraded-mode
+  ceiling, frontdoor/coalesce.py) and low-priority tenants are shed by
+  quota re-pricing, so cached answers absorb demand while the control
+  plane heals — before the breaker has to trip.
+
+Every engage/release/target decision is evented into a bounded decision
+log (served on /statusz and in ``am-tpu why``), exported through the
+pinned ``healthcheck_adaptive_*`` metric families, and recorded as a
+flight-recorder bundle — an operator can always answer "why is this
+check probing at 2× cadence right now".
+
+No wall clock anywhere (hack/lint.py bans it for all of resilience/):
+time flows in through the injected clock only, so every episode is
+exactly reproducible under FakeClock.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Deque, Dict, List, Optional
+
+from activemonitor_tpu.resilience.health import CheckStateTracker
+
+log = logging.getLogger("activemonitor.adapt")
+
+# A burn rate above 1.0 means the error budget is being spent faster
+# than the SLO window replenishes it — the same threshold the profile
+# hook uses (obs/slo.py), so the two anomaly responders always agree on
+# what "burning" means.
+BURN_THRESHOLD = 1.0
+
+# Hysteresis: engage after this many CONSECUTIVE burning observations,
+# release after this many consecutive calm ones. Asymmetric on purpose —
+# quick to tighten (a real burn costs budget every minute), slower to
+# relax (releasing on the first good run would flap the cadence on a
+# 50%-failing check).
+ENGAGE_AFTER = 2
+RELEASE_AFTER = 3
+
+# Cadence tightening factor while burning (0.5 = probe twice as often).
+# Composed through resilience/health.py damp_factor, whose
+# MIN_BURN_DAMP floor caps total tightening at 4×.
+TIGHTEN_FACTOR = 0.5
+
+# Placement: a cohort member whose worst straggler score reaches this
+# many sigmas is contended; its cadence is damped by CONTENTION_DAMP
+# (strongest-wins with flap/analysis damping, capped at
+# MAX_COMPOSED_DAMP).
+CONTENTION_SIGMAS = 3.0
+CONTENTION_DAMP = 2.0
+
+# Front-door degraded mode: the coalescing freshness ceiling stretches
+# to this multiple of the operator default, and low-priority tenant
+# quotas are re-priced to this fraction of their configured rate.
+DEGRADED_FRESHNESS_FACTOR = 4.0
+SHED_FACTOR = 0.25
+
+LEVER_CADENCE = "cadence"
+LEVER_REMEDY = "remedy"
+LEVER_PLACEMENT = "placement"
+LEVER_FRONTDOOR = "frontdoor"
+LEVERS = (LEVER_CADENCE, LEVER_REMEDY, LEVER_PLACEMENT, LEVER_FRONTDOOR)
+
+ACTION_ENGAGE = "engage"
+ACTION_RELEASE = "release"
+ACTION_TARGET = "target"
+
+# bounded decision log: at one decision a minute this is an hour of
+# history — enough to read an episode end-to-end from /statusz alone
+DECISION_LOG_CAPACITY = 64
+
+
+class AdaptiveController:
+    """Owns the four levers. The reconciler constructs it beside the
+    flight recorder; the Manager wires ``frontdoor`` when the front
+    door is configured and drives ``sweep()`` from the resilience loop.
+    ``observe`` rides the fleet's record path (obs/slo.py) — the same
+    place the burn rate is already computed — so acting costs no extra
+    evaluation."""
+
+    def __init__(self, clock, metrics, checks: CheckStateTracker):
+        self.clock = clock
+        self.metrics = metrics
+        self.checks = checks
+        # wired after construction (same pattern as FlightRecorder):
+        self.flightrec = None  # obs/flightrec.py — engage/release bundles
+        self.frontdoor = None  # frontdoor/service.py — lever 4
+        self.cohorts = None  # analysis/fleet.py CohortIndex — lever 3
+        # hysteresis streaks per check key
+        self._hot: Dict[str, int] = {}
+        self._calm: Dict[str, int] = {}
+        # engaged cadence episodes: key -> {factor, cause, since, burn}
+        self._engaged: Dict[str, dict] = {}
+        # contended placements: key -> cohort name
+        self._contended: Dict[str, str] = {}
+        # last bucket-targeted remedy per key
+        self._remedy_selected: Dict[str, str] = {}
+        self._frontdoor_engaged = False
+        self._frontdoor_since = ""
+        self._log: Deque[dict] = collections.deque(
+            maxlen=DECISION_LOG_CAPACITY
+        )
+
+    # -- shared plumbing ------------------------------------------------
+    def _now_iso(self) -> str:
+        return self.clock.now().isoformat()
+
+    def _decide(
+        self, lever: str, action: str, key: str, cause: str, detail: str
+    ) -> None:
+        """One adaptation decision: decision log + transition counter +
+        flight-recorder bundle. Never raises — a broken observability
+        sink must not stop the control loop."""
+        entry = {
+            "ts": self._now_iso(),
+            "lever": lever,
+            "action": action,
+            "key": key,
+            "cause": cause,
+            "detail": detail,
+        }
+        self._log.append(entry)
+        try:
+            self.metrics.record_adaptive_transition(lever, action)
+        except Exception:
+            log.exception("adaptive transition metric failed")
+        if self.flightrec is not None:
+            try:
+                from activemonitor_tpu.obs.flightrec import KIND_ADAPTIVE
+
+                self.flightrec.record(
+                    KIND_ADAPTIVE,
+                    key=key,
+                    lever=lever,
+                    action=action,
+                    cause=cause,
+                    detail=detail,
+                )
+            except Exception:
+                log.exception("adaptive flight bundle failed")
+
+    def _refresh_lever_gauges(self) -> None:
+        active = {
+            LEVER_CADENCE: bool(self._engaged),
+            LEVER_REMEDY: bool(self._remedy_selected),
+            LEVER_PLACEMENT: bool(self._contended),
+            LEVER_FRONTDOOR: self._frontdoor_engaged,
+        }
+        try:
+            for lever, on in active.items():
+                self.metrics.set_adaptive_lever(lever, on)
+        except Exception:
+            log.exception("adaptive lever gauges failed")
+
+    @staticmethod
+    def _split_key(key: str):
+        namespace, _, name = key.partition("/")
+        return namespace, name
+
+    # -- lever 1: burn-rate cadence -------------------------------------
+    def observe(self, hc, *, burn_rate, bucket: str) -> None:
+        """One recorded run for an SLO'd check, with its freshly
+        evaluated burn rate and attribution bucket. Called by
+        FleetStatus._record — the single place both signals exist."""
+        if burn_rate is None:
+            return
+        key = hc.key
+        burning = float(burn_rate) > BURN_THRESHOLD
+        episode = self._engaged.get(key)
+        if burning:
+            self._calm.pop(key, None)
+            self._hot[key] = self._hot.get(key, 0) + 1
+            if episode is not None:
+                episode["burn"] = round(float(burn_rate), 3)
+                # the first burning runs may classify as unknown; adopt
+                # the first real attribution so the frontdoor lever (and
+                # the operator) see the true cause
+                if bucket and episode["cause"] in ("", "unknown"):
+                    episode["cause"] = bucket
+            elif self._hot[key] >= ENGAGE_AFTER:
+                self._engage_cadence(hc, burn_rate, bucket)
+        else:
+            self._hot.pop(key, None)
+            self._calm[key] = self._calm.get(key, 0) + 1
+            if episode is not None and self._calm[key] >= RELEASE_AFTER:
+                self._release_cadence(hc)
+        self._sync_frontdoor()
+        self._refresh_lever_gauges()
+
+    def _engage_cadence(self, hc, burn_rate, bucket: str) -> None:
+        key = hc.key
+        cause = bucket or "unknown"
+        self.checks.set_burn_damp(key, TIGHTEN_FACTOR)
+        self._engaged[key] = {
+            "factor": TIGHTEN_FACTOR,
+            "cause": cause,
+            "since": self._now_iso(),
+            "burn": round(float(burn_rate), 3),
+        }
+        try:
+            self.metrics.set_adaptive_cadence(
+                hc.metadata.name, hc.metadata.namespace, TIGHTEN_FACTOR
+            )
+        except Exception:
+            log.exception("adaptive cadence gauge failed")
+        self._decide(
+            LEVER_CADENCE,
+            ACTION_ENGAGE,
+            key,
+            cause,
+            f"burn {float(burn_rate):.3g} > {BURN_THRESHOLD:g} for "
+            f"{ENGAGE_AFTER} runs; interval x{TIGHTEN_FACTOR:g}",
+        )
+
+    def _release_cadence(self, hc) -> None:
+        key = hc.key
+        episode = self._engaged.pop(key, {})
+        self.checks.set_burn_damp(key, 1.0)
+        try:
+            self.metrics.clear_adaptive_cadence(
+                hc.metadata.name, hc.metadata.namespace
+            )
+        except Exception:
+            log.exception("adaptive cadence gauge failed")
+        self._decide(
+            LEVER_CADENCE,
+            ACTION_RELEASE,
+            key,
+            str(episode.get("cause", "")),
+            f"burn <= {BURN_THRESHOLD:g} for {RELEASE_AFTER} runs; "
+            "interval restored",
+        )
+
+    # -- lever 2: bucket-targeted remedies ------------------------------
+    def note_remedy_selected(self, key: str, bucket: str) -> None:
+        """The reconciler picked a ``byBucket`` remedy over the plain
+        fallback for this check's latest failure."""
+        self._remedy_selected[key] = bucket
+        self._decide(
+            LEVER_REMEDY,
+            ACTION_TARGET,
+            key,
+            bucket,
+            f"byBucket[{bucket}] remedy selected over fallback",
+        )
+        self._refresh_lever_gauges()
+
+    # -- lever 3: interference-aware placement --------------------------
+    def _sweep_placement(self) -> None:
+        if self.cohorts is None:
+            return
+        contended_now: Dict[str, str] = {}
+        for cohort in self.cohorts.cohorts():
+            for key in self.cohorts.members(cohort):
+                score = self.cohorts.worst_score(cohort, key)
+                if score is not None and abs(score) >= CONTENTION_SIGMAS:
+                    contended_now[key] = cohort
+        for key, cohort in contended_now.items():
+            if key not in self._contended:
+                self.checks.set_contention_damp(key, CONTENTION_DAMP)
+                self._decide(
+                    LEVER_PLACEMENT,
+                    ACTION_ENGAGE,
+                    key,
+                    "contention",
+                    f"cohort {cohort} straggler >= "
+                    f"{CONTENTION_SIGMAS:g} sigmas; interval "
+                    f"x{CONTENTION_DAMP:g}",
+                )
+        for key, cohort in list(self._contended.items()):
+            if key not in contended_now:
+                self.checks.set_contention_damp(key, 1.0)
+                self._decide(
+                    LEVER_PLACEMENT,
+                    ACTION_RELEASE,
+                    key,
+                    "contention",
+                    f"cohort {cohort} back within "
+                    f"{CONTENTION_SIGMAS:g} sigmas; interval restored",
+                )
+        self._contended = contended_now
+
+    # -- lever 4: front-door degraded mode ------------------------------
+    def _sync_frontdoor(self) -> None:
+        """Derive the front-door lever from the engaged cadence
+        episodes: any episode whose cause is ``control_plane`` engages
+        it; none releases it. Derived (not edge-triggered) so a forget
+        of the last control-plane episode releases on the next sweep."""
+        if self.frontdoor is None:
+            return
+        want = any(
+            ep.get("cause") == "control_plane"
+            for ep in self._engaged.values()
+        )
+        if want and not self._frontdoor_engaged:
+            self._frontdoor_engaged = True
+            self._frontdoor_since = self._now_iso()
+            try:
+                self.frontdoor.widen_freshness(DEGRADED_FRESHNESS_FACTOR)
+                self.frontdoor.admission.shed_low_priority(SHED_FACTOR)
+            except Exception:
+                log.exception("frontdoor degraded-mode engage failed")
+            self._decide(
+                LEVER_FRONTDOOR,
+                ACTION_ENGAGE,
+                "",
+                "control_plane",
+                f"freshness ceiling x{DEGRADED_FRESHNESS_FACTOR:g}; "
+                f"low-priority quotas x{SHED_FACTOR:g}",
+            )
+        elif not want and self._frontdoor_engaged:
+            self._frontdoor_engaged = False
+            self._frontdoor_since = ""
+            try:
+                self.frontdoor.restore_freshness()
+                self.frontdoor.admission.restore_quotas()
+            except Exception:
+                log.exception("frontdoor degraded-mode release failed")
+            self._decide(
+                LEVER_FRONTDOOR,
+                ACTION_RELEASE,
+                "",
+                "control_plane",
+                "freshness ceiling and tenant quotas restored",
+            )
+        try:
+            ceiling = 0.0
+            if self.frontdoor is not None:
+                ceiling = float(self.frontdoor.cache.freshness_ceiling())
+            self.metrics.set_adaptive_freshness_ceiling(ceiling)
+        except Exception:
+            log.exception("adaptive freshness ceiling gauge failed")
+
+    # -- periodic sweep (Manager resilience loop) -----------------------
+    def sweep(self) -> None:
+        """Refresh the non-run-driven levers: placement contention from
+        the cohort index, the derived front-door state, and the lever
+        gauges. Never raises — it shares a loop with the breaker."""
+        try:
+            self._sweep_placement()
+            self._sync_frontdoor()
+            self._refresh_lever_gauges()
+        except Exception:
+            log.exception("adaptive sweep failed")
+
+    # -- lifecycle ------------------------------------------------------
+    def forget(self, key: str) -> None:
+        """Deleted check: drop its episodes and release its damping.
+        The damp entries live in the shared tracker, which the
+        reconciler forgets separately; popping here keeps the snapshot
+        honest even if sweep never runs again."""
+        self._hot.pop(key, None)
+        self._calm.pop(key, None)
+        episode = self._engaged.pop(key, None)
+        self._contended.pop(key, None)
+        self._remedy_selected.pop(key, None)
+        if episode is not None:
+            namespace, name = self._split_key(key)
+            try:
+                self.metrics.clear_adaptive_cadence(name, namespace)
+            except Exception:
+                log.exception("adaptive cadence gauge failed")
+        self._sync_frontdoor()
+        self._refresh_lever_gauges()
+
+    # -- read side ------------------------------------------------------
+    def check_adapt(self, key: str) -> Optional[dict]:
+        """Per-check adaptation block for /statusz ``checks[]`` and
+        ``am-tpu why``; None when no lever touches the check."""
+        levers: List[str] = []
+        episode = self._engaged.get(key)
+        if episode is not None:
+            levers.append(LEVER_CADENCE)
+        if key in self._contended:
+            levers.append(LEVER_PLACEMENT)
+        if key in self._remedy_selected:
+            levers.append(LEVER_REMEDY)
+        if not levers:
+            return None
+        return {
+            "levers": levers,
+            "cadence_factor": (
+                episode["factor"] if episode is not None else None
+            ),
+            "cause": episode["cause"] if episode is not None else None,
+            "since": episode["since"] if episode is not None else None,
+            "cohort": self._contended.get(key),
+            "remedy_bucket": self._remedy_selected.get(key),
+        }
+
+    def snapshot(self) -> dict:
+        """Fleet-level adaptive block for /statusz."""
+        ceiling = None
+        if self.frontdoor is not None:
+            try:
+                ceiling = float(self.frontdoor.cache.freshness_ceiling())
+            except Exception:
+                ceiling = None
+        levers = {
+            LEVER_CADENCE: len(self._engaged),
+            LEVER_REMEDY: len(self._remedy_selected),
+            LEVER_PLACEMENT: len(self._contended),
+            LEVER_FRONTDOOR: 1 if self._frontdoor_engaged else 0,
+        }
+        return {
+            "engaged": any(levers.values()),
+            "levers": levers,
+            "cadence": {k: dict(v) for k, v in self._engaged.items()},
+            "placement": dict(self._contended),
+            "frontdoor": {
+                "engaged": self._frontdoor_engaged,
+                "since": self._frontdoor_since or None,
+                "freshness_ceiling": ceiling,
+                "shed_factor": (
+                    SHED_FACTOR if self._frontdoor_engaged else None
+                ),
+            },
+            "recent": list(self._log),
+        }

@@ -44,6 +44,16 @@ DEFAULT_CALM_STREAK = 4  # equal verdicts in a row => healthy again
 DEFAULT_QUARANTINE_AFTER = 5  # consecutive pre-terminal errors
 DEFAULT_DAMP_FACTOR = 2.0  # interval multiplier while flapping
 
+# Hard bounds on the COMPOSED damp factor (docs/resilience.md pins both).
+# The slow side caps at MAX_COMPOSED_DAMP so stacked containments (flap ×
+# analysis × contention) can never damp a check into effectively never
+# running — at the cap a 60s check still owes a run every 16 minutes.
+# The fast side floors at MIN_BURN_DAMP so burn-rate tightening
+# (resilience/adapt.py) can at most 4× a check's cadence — tighter would
+# let the adaptive loop DDoS the very control plane it is trying to heal.
+MAX_COMPOSED_DAMP = 16.0
+MIN_BURN_DAMP = 0.25
+
 
 class _CheckRecord:
     __slots__ = ("verdicts", "error_streak", "state", "persisted")
@@ -82,6 +92,12 @@ class CheckStateTracker:
         # confirmed-degraded check at a slower cadence through the same
         # damp_factor the flap containment uses); 1.0 = none
         self._analysis_damp: Dict[str, float] = {}
+        # interference-aware placement damping (resilience/adapt.py parks
+        # a cohort-confirmed straggler at a slower cadence); 1.0 = none
+        self._contention_damp: Dict[str, float] = {}
+        # burn-rate cadence tightening (resilience/adapt.py): < 1.0
+        # SHRINKS the effective interval while error budget burns
+        self._burn_damp: Dict[str, float] = {}
 
     def _record(self, key: str) -> _CheckRecord:
         rec = self._records.get(key)
@@ -186,16 +202,53 @@ class CheckStateTracker:
         else:
             self._analysis_damp.pop(key, None)
 
+    def set_contention_damp(self, key: str, factor: float) -> None:
+        """Interference-aware placement damping (resilience/adapt.py):
+        a cohort-confirmed straggler is probed less often so its slice
+        stops absorbing probe traffic while contended. Factor <= 1
+        clears the request. Same single-rule contract as
+        ``set_analysis_damp``."""
+        if factor and factor > 1.0:
+            self._contention_damp[key] = float(factor)
+        else:
+            self._contention_damp.pop(key, None)
+
+    def set_burn_damp(self, key: str, factor: float) -> None:
+        """Burn-rate cadence tightening (resilience/adapt.py): while a
+        check's error budget burns, its interval SHRINKS (factor < 1)
+        so the fleet confirms recovery sooner. Factor >= 1 clears the
+        request. Clamped to ``MIN_BURN_DAMP`` — the adaptive loop can
+        never tighten beyond 4× cadence."""
+        if factor and 0.0 < factor < 1.0:
+            self._burn_damp[key] = max(MIN_BURN_DAMP, float(factor))
+        else:
+            self._burn_damp.pop(key, None)
+
     def damp_factor(self, key: str) -> float:
-        """Interval multiplier for the check's schedule: the strongest
-        of the flap containment (>1 while flapping) and the analysis
-        layer's degraded-mode damping; 1.0 when neither applies."""
+        """Interval multiplier for the check's schedule — the ONE rule
+        every call site consults. Slow-side containments compose by
+        strongest-wins: the flap containment (>1 while flapping), the
+        analysis layer's degraded-mode damping, and the placement
+        layer's contention damping, capped at ``MAX_COMPOSED_DAMP`` so
+        a check can never be damped into never running. The burn-rate
+        tightener then multiplies the result (< 1 while burning), so a
+        check that is BOTH flapping and burning still slows down —
+        containment outranks urgency — while a healthy burning check
+        tightens to at most ``MIN_BURN_DAMP`` of its spec cadence."""
         flap = (
             self._damp_factor
             if self.state(key) == STATE_FLAPPING
             else 1.0
         )
-        return max(flap, self._analysis_damp.get(key, 1.0))
+        slow = min(
+            MAX_COMPOSED_DAMP,
+            max(
+                flap,
+                self._analysis_damp.get(key, 1.0),
+                self._contention_damp.get(key, 1.0),
+            ),
+        )
+        return max(MIN_BURN_DAMP, slow * self._burn_damp.get(key, 1.0))
 
     def error_streak(self, key: str) -> int:
         rec = self._records.get(key)
@@ -205,3 +258,5 @@ class CheckStateTracker:
         """Deleted check: drop its record."""
         self._records.pop(key, None)
         self._analysis_damp.pop(key, None)
+        self._contention_damp.pop(key, None)
+        self._burn_damp.pop(key, None)

@@ -58,8 +58,11 @@ positive that makes `make lint` cry wolf is worse than a miss):
   contract is the injectable Clock (breaker open windows, token-bucket
   refill, baseline timestamps, shard lease expiry/fencing windows,
   attribution windows and flight-bundle timestamps, front-door quota
-  refill / freshness-window / QPS math must all be scriptable by
-  fake-clock tests; roofline classification is pure math over seconds
+  refill / freshness-window / QPS math, and the adaptive controller's
+  burn-streak hysteresis and episode `since` stamps — resilience/adapt.py
+  rides the `resilience/` path key, so the closed-loop chaos tests can
+  script engage→release purely on a FakeClock — must all be scriptable
+  by fake-clock tests; roofline classification is pure math over seconds
   passed IN as arguments, and the seeded arrival schedules live on the
   caller's timeline); a bare wall-clock read there silently breaks
   determinism.

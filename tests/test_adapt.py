@@ -1,0 +1,677 @@
+"""Closed-loop goodput control (ISSUE 18): the damp_factor composition
+(flap × analysis × contention × burn through the ONE rule, with its
+hard cap and floor), the owed-run math at reschedule time, the
+AdaptiveController's four levers with their hysteresis, and the
+acceptance chaos script — inject an ICI degradation under FakeEngine +
+FakeClock, watch the cadence tighten, the bucket-targeted remedy fire
+exactly once, the front door stretch freshness and shed the
+low-priority tenant under a confirmed control-plane burn, then watch
+every lever relax after recovery, asserted via /statusz, the pinned
+gauges, ``am-tpu why``, and the flight-recorder bundles.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from activemonitor_tpu.api import HealthCheck
+from activemonitor_tpu.controller import (
+    EventRecorder,
+    HealthCheckReconciler,
+    InMemoryHealthCheckClient,
+    InMemoryRBACBackend,
+    RBACProvisioner,
+)
+from activemonitor_tpu.engine import FakeWorkflowEngine, succeed_after
+from activemonitor_tpu.engine.base import PHASE_FAILED, PHASE_SUCCEEDED
+from activemonitor_tpu.frontdoor import AdmissionController, FrontDoor, TenantQuota
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs.history import ResultHistory
+from activemonitor_tpu.resilience.adapt import (
+    AdaptiveController,
+    BURN_THRESHOLD,
+    CONTENTION_DAMP,
+    DECISION_LOG_CAPACITY,
+    DEGRADED_FRESHNESS_FACTOR,
+    ENGAGE_AFTER,
+    RELEASE_AFTER,
+    SHED_FACTOR,
+    TIGHTEN_FACTOR,
+)
+from activemonitor_tpu.resilience.health import (
+    MAX_COMPOSED_DAMP,
+    MIN_BURN_DAMP,
+    STATE_FLAPPING,
+    CheckStateTracker,
+)
+from activemonitor_tpu.utils.clock import FakeClock
+
+WF_INLINE = (
+    "apiVersion: argoproj.io/v1alpha1\nkind: Workflow\nspec:\n  entrypoint: m\n"
+)
+
+ICI_METRIC = "ici-allreduce-fraction-of-rated"
+KEY = "health/hc-x"
+
+
+def make_hc(
+    name="hc-x",
+    repeat=60,
+    slo=None,
+    remedy=None,
+    remedy_runs_limit=0,
+    remedy_reset_interval=0,
+):
+    spec = {
+        "repeatAfterSec": repeat,
+        "level": "cluster",
+        "backoffMax": 1,
+        "backoffMin": 1,
+        "workflow": {
+            "generateName": f"{name}-",
+            "workflowtimeout": 30,
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "sa",
+                "source": {"inline": WF_INLINE},
+            },
+        },
+    }
+    if slo is not None:
+        spec["slo"] = slo
+    if remedy is not None:
+        spec["remedyworkflow"] = remedy
+    if remedy_runs_limit:
+        spec["remedyRunsLimit"] = remedy_runs_limit
+    if remedy_reset_interval:
+        spec["remedyResetInterval"] = remedy_reset_interval
+    return HealthCheck.from_dict(
+        {"metadata": {"name": name, "namespace": "health"}, "spec": spec}
+    )
+
+
+# ---------------------------------------------------------------------
+# damp_factor composition: the ONE rule (resilience/health.py)
+# ---------------------------------------------------------------------
+
+
+def flap(tracker, key):
+    """Drive a key into Flapping: alternating verdicts flip fast."""
+    for ok in (True, False, True, False):
+        tracker.note_verdict(key, ok)
+    assert tracker.state(key) == STATE_FLAPPING
+
+
+def test_slow_side_composes_strongest_wins():
+    t = CheckStateTracker()
+    assert t.damp_factor(KEY) == 1.0
+    flap(t, KEY)
+    assert t.damp_factor(KEY) == 2.0  # default flap damp
+    t.set_analysis_damp(KEY, 8.0)
+    assert t.damp_factor(KEY) == 8.0  # strongest wins, not product
+    t.set_contention_damp(KEY, CONTENTION_DAMP)
+    assert t.damp_factor(KEY) == 8.0  # 2.0 contention loses to 8.0
+    t.set_analysis_damp(KEY, 1.0)  # <=1 clears the request
+    assert t.damp_factor(KEY) == CONTENTION_DAMP
+    t.set_contention_damp(KEY, 0.0)
+    assert t.damp_factor(KEY) == 2.0  # flap containment remains
+
+
+def test_composed_damp_caps_at_max():
+    t = CheckStateTracker()
+    t.set_analysis_damp(KEY, 50.0)
+    assert t.damp_factor(KEY) == MAX_COMPOSED_DAMP
+    # the burn tightener multiplies the CAPPED slow side
+    t.set_burn_damp(KEY, TIGHTEN_FACTOR)
+    assert t.damp_factor(KEY) == MAX_COMPOSED_DAMP * TIGHTEN_FACTOR
+
+
+def test_burn_damp_clamps_and_clears():
+    t = CheckStateTracker()
+    t.set_burn_damp(KEY, 0.1)  # tighter than the floor: clamped
+    assert t.damp_factor(KEY) == MIN_BURN_DAMP
+    t.set_burn_damp(KEY, 0.5)
+    assert t.damp_factor(KEY) == 0.5
+    t.set_burn_damp(KEY, 1.0)  # >= 1 releases the request
+    assert t.damp_factor(KEY) == 1.0
+    # the composed result floors at MIN_BURN_DAMP too
+    t.set_burn_damp(KEY, MIN_BURN_DAMP)
+    assert t.damp_factor(KEY) == MIN_BURN_DAMP
+
+
+def test_flap_times_burn_still_slows_down():
+    # containment outranks urgency: a flapping AND burning check still
+    # runs slower than spec cadence, never faster
+    t = CheckStateTracker()
+    flap(t, KEY)
+    t.set_burn_damp(KEY, TIGHTEN_FACTOR)
+    assert t.damp_factor(KEY) == 2.0 * TIGHTEN_FACTOR == 1.0
+
+
+def test_forget_clears_every_damp_source():
+    t = CheckStateTracker()
+    flap(t, KEY)
+    t.set_analysis_damp(KEY, 4.0)
+    t.set_contention_damp(KEY, 2.0)
+    t.set_burn_damp(KEY, 0.5)
+    t.forget(KEY)
+    assert t.damp_factor(KEY) == 1.0
+
+
+# ---------------------------------------------------------------------
+# owed-run math: reschedule-time interval (controller/reconciler.py)
+# ---------------------------------------------------------------------
+
+
+class Harness:
+    def __init__(self, completer=None):
+        self.clock = FakeClock()
+        self.client = InMemoryHealthCheckClient()
+        self.engine = FakeWorkflowEngine(completer)
+        self.metrics = MetricsCollector()
+        self.recorder = EventRecorder()
+        self.reconciler = HealthCheckReconciler(
+            client=self.client,
+            engine=self.engine,
+            rbac=RBACProvisioner(InMemoryRBACBackend()),
+            recorder=self.recorder,
+            metrics=self.metrics,
+            clock=self.clock,
+        )
+
+    async def apply_and_reconcile(self, hc):
+        created = await self.client.apply(hc)
+        await self.reconciler.reconcile(created.namespace, created.name)
+        return created
+
+    async def settle(self):
+        for _ in range(50):
+            await asyncio.sleep(0)
+
+
+@pytest.mark.asyncio
+async def test_effective_repeat_after_tightens_and_never_pauses():
+    h = Harness()
+    hc = make_hc(repeat=60)
+    checks = h.reconciler.resilience.checks
+    assert h.reconciler._effective_repeat_after(hc) == 60
+    checks.set_burn_damp(hc.key, TIGHTEN_FACTOR)
+    assert h.reconciler._effective_repeat_after(hc) == 30
+    # a 1s check at the MIN_BURN_DAMP floor must still owe a run every
+    # second — int(0.25) == 0 would read as "paused", silently stopping
+    # the very check the adaptive loop wants to run MORE often
+    short = make_hc(name="hc-short", repeat=1)
+    checks.set_burn_damp(short.key, MIN_BURN_DAMP)
+    assert h.reconciler._effective_repeat_after(short) == 1
+    # slow side: the composed cap keeps a stacked containment finite
+    checks.set_burn_damp(hc.key, 1.0)
+    checks.set_analysis_damp(hc.key, 100.0)
+    assert h.reconciler._effective_repeat_after(hc) == 60 * MAX_COMPOSED_DAMP
+
+
+# ---------------------------------------------------------------------
+# AdaptiveController units (resilience/adapt.py)
+# ---------------------------------------------------------------------
+
+
+def make_controller():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    checks = CheckStateTracker()
+    return AdaptiveController(clock, metrics, checks), clock, metrics, checks
+
+
+def test_cadence_hysteresis_engages_and_releases():
+    ctrl, _, metrics, checks = make_controller()
+    hc = make_hc()
+    # one burning run is a spike, not an episode
+    ctrl.observe(hc, burn_rate=2.0, bucket="ici")
+    assert ctrl.check_adapt(hc.key) is None
+    assert checks.damp_factor(hc.key) == 1.0
+    # the second consecutive one engages
+    ctrl.observe(hc, burn_rate=2.0, bucket="ici")
+    block = ctrl.check_adapt(hc.key)
+    assert block["levers"] == ["cadence"]
+    assert block["cadence_factor"] == TIGHTEN_FACTOR
+    assert block["cause"] == "ici"
+    assert checks.damp_factor(hc.key) == TIGHTEN_FACTOR
+    assert (
+        metrics.sample_value(
+            "healthcheck_adaptive_cadence_factor",
+            {"healthcheck_name": "hc-x", "namespace": "health"},
+        )
+        == TIGHTEN_FACTOR
+    )
+    # burn AT the threshold is calm (strictly greater engages)
+    ctrl.observe(hc, burn_rate=BURN_THRESHOLD, bucket="")
+    ctrl.observe(hc, burn_rate=0.5, bucket="")
+    assert ctrl.check_adapt(hc.key) is not None  # 2 calm < RELEASE_AFTER
+    ctrl.observe(hc, burn_rate=0.5, bucket="")
+    assert ctrl.check_adapt(hc.key) is None
+    assert checks.damp_factor(hc.key) == 1.0
+    assert (
+        metrics.sample_value(
+            "healthcheck_adaptive_cadence_factor",
+            {"healthcheck_name": "hc-x", "namespace": "health"},
+        )
+        is None
+    )
+    # a calm run in the middle of a hot streak resets the streak
+    ctrl.observe(hc, burn_rate=2.0, bucket="ici")
+    ctrl.observe(hc, burn_rate=0.2, bucket="")
+    ctrl.observe(hc, burn_rate=2.0, bucket="ici")
+    assert ctrl.check_adapt(hc.key) is None
+    # a None burn rate (no SLO evaluation) is no observation at all
+    ctrl.observe(hc, burn_rate=None, bucket="ici")
+    assert ctrl.check_adapt(hc.key) is None
+
+
+def test_first_real_attribution_adopted_as_cause():
+    ctrl, _, _, _ = make_controller()
+    hc = make_hc()
+    for _ in range(ENGAGE_AFTER):
+        ctrl.observe(hc, burn_rate=3.0, bucket="")
+    assert ctrl.check_adapt(hc.key)["cause"] == "unknown"
+    ctrl.observe(hc, burn_rate=3.0, bucket="hbm")
+    assert ctrl.check_adapt(hc.key)["cause"] == "hbm"
+    # the adopted cause is sticky — later buckets don't rewrite history
+    ctrl.observe(hc, burn_rate=3.0, bucket="ici")
+    assert ctrl.check_adapt(hc.key)["cause"] == "hbm"
+    # the episode's burn tracks the latest observation
+    assert ctrl.snapshot()["cadence"][hc.key]["burn"] == 3.0
+
+
+class FakeCohorts:
+    """The CohortIndex surface _sweep_placement consumes."""
+
+    def __init__(self):
+        self.scores = {}
+
+    def cohorts(self):
+        return ["pool-a"]
+
+    def members(self, cohort):
+        return list(self.scores)
+
+    def worst_score(self, cohort, key):
+        return self.scores.get(key)
+
+
+def test_placement_sweep_parks_and_releases_contended_member():
+    ctrl, _, _, checks = make_controller()
+    ctrl.cohorts = FakeCohorts()
+    ctrl.cohorts.scores[KEY] = -3.5  # |score| >= 3 sigmas: contended
+    ctrl.sweep()
+    assert checks.damp_factor(KEY) == CONTENTION_DAMP
+    block = ctrl.check_adapt(KEY)
+    assert block["levers"] == ["placement"]
+    assert block["cohort"] == "pool-a"
+    # a second sweep at the same score is idempotent (no new decision)
+    decisions = len(ctrl.snapshot()["recent"])
+    ctrl.sweep()
+    assert len(ctrl.snapshot()["recent"]) == decisions
+    ctrl.cohorts.scores[KEY] = 0.4  # back within the envelope
+    ctrl.sweep()
+    assert checks.damp_factor(KEY) == 1.0
+    assert ctrl.check_adapt(KEY) is None
+
+
+def make_door(clock, metrics, quotas=None):
+    door = FrontDoor(
+        ResultHistory(clock),
+        AdmissionController(
+            quotas,
+            default_quota=TenantQuota(rate_per_minute=600.0),
+            clock=clock,
+        ),
+        clock=clock,
+        metrics=metrics,
+        default_freshness=30.0,
+        park_capacity=8,
+    )
+    door.bind(lambda ns, name: None)
+    return door
+
+
+def test_frontdoor_lever_follows_control_plane_episodes():
+    ctrl, clock, metrics, _ = make_controller()
+    door = make_door(clock, metrics)
+    ctrl.frontdoor = door
+    hc = make_hc()
+    # an ici-caused episode does NOT touch the front door
+    for _ in range(ENGAGE_AFTER):
+        ctrl.observe(hc, burn_rate=2.0, bucket="ici")
+    assert door.cache.freshness_ceiling() == 30.0
+    assert ctrl.snapshot()["frontdoor"]["engaged"] is False
+    # a control-plane episode on another check engages it
+    cp = make_hc(name="hc-cp")
+    for _ in range(ENGAGE_AFTER):
+        ctrl.observe(cp, burn_rate=2.0, bucket="control_plane")
+    fd = ctrl.snapshot()["frontdoor"]
+    assert fd["engaged"] is True
+    assert fd["freshness_ceiling"] == 30.0 * DEGRADED_FRESHNESS_FACTOR
+    assert fd["shed_factor"] == SHED_FACTOR
+    assert (
+        metrics.sample_value(
+            "healthcheck_adaptive_freshness_ceiling_seconds", {}
+        )
+        == 30.0 * DEGRADED_FRESHNESS_FACTOR
+    )
+    # releasing the control-plane episode releases the door
+    for _ in range(RELEASE_AFTER):
+        ctrl.observe(cp, burn_rate=0.1, bucket="")
+    assert ctrl.snapshot()["frontdoor"]["engaged"] is False
+    assert door.cache.freshness_ceiling() == 30.0
+    assert door.admission.shed_factor is None
+
+
+def test_forget_drops_episodes_and_releases_frontdoor():
+    ctrl, clock, metrics, checks = make_controller()
+    door = make_door(clock, metrics)
+    ctrl.frontdoor = door
+    cp = make_hc(name="hc-cp")
+    for _ in range(ENGAGE_AFTER):
+        ctrl.observe(cp, burn_rate=2.0, bucket="control_plane")
+    ctrl.note_remedy_selected(cp.key, "control_plane")
+    assert ctrl.snapshot()["frontdoor"]["engaged"] is True
+    ctrl.forget(cp.key)
+    assert ctrl.check_adapt(cp.key) is None
+    assert ctrl.snapshot()["frontdoor"]["engaged"] is False
+    assert door.cache.freshness_ceiling() == 30.0
+    assert (
+        metrics.sample_value(
+            "healthcheck_adaptive_cadence_factor",
+            {"healthcheck_name": "hc-cp", "namespace": "health"},
+        )
+        is None
+    )
+
+
+def test_decision_log_is_bounded():
+    ctrl, _, _, _ = make_controller()
+    for i in range(DECISION_LOG_CAPACITY + 10):
+        ctrl.note_remedy_selected(f"health/hc-{i}", "ici")
+    recent = ctrl.snapshot()["recent"]
+    assert len(recent) == DECISION_LOG_CAPACITY
+    # oldest entries fell off the front; the newest survives
+    assert recent[-1]["key"] == f"health/hc-{DECISION_LOG_CAPACITY + 9}"
+
+
+def test_snapshot_and_check_adapt_shapes():
+    ctrl, _, _, _ = make_controller()
+    snap = ctrl.snapshot()
+    assert snap["engaged"] is False
+    assert snap["levers"] == {
+        "cadence": 0,
+        "remedy": 0,
+        "placement": 0,
+        "frontdoor": 0,
+    }
+    assert snap["frontdoor"]["freshness_ceiling"] is None  # no door wired
+    ctrl.note_remedy_selected(KEY, "ici")
+    snap = ctrl.snapshot()
+    assert snap["engaged"] is True
+    assert snap["levers"]["remedy"] == 1
+    block = ctrl.check_adapt(KEY)
+    assert block["levers"] == ["remedy"]
+    assert block["remedy_bucket"] == "ici"
+    assert block["cadence_factor"] is None
+
+
+# ---------------------------------------------------------------------
+# acceptance: the closed loop end-to-end on a fake clock
+# ---------------------------------------------------------------------
+
+
+def contract(value):
+    return json.dumps({"metrics": [{"name": ICI_METRIC, "value": value}]})
+
+
+@pytest.mark.asyncio
+async def test_closed_loop_chaos_burn_to_recovery():
+    from activemonitor_tpu.__main__ import render_status_table, render_why
+
+    h = Harness()
+    mode = {"fail": True}
+
+    def check_completer(_wf, _polls):
+        if mode["fail"]:
+            return {
+                "phase": PHASE_FAILED,
+                "message": "ici allreduce below rated floor",
+                "outputs": {
+                    "parameters": [
+                        {"name": "metrics", "value": contract(0.4)}
+                    ]
+                },
+            }
+        return {
+            "phase": PHASE_SUCCEEDED,
+            "outputs": {
+                "parameters": [{"name": "metrics", "value": contract(0.97)}]
+            },
+        }
+
+    h.engine.on_prefix("hc-ici-", check_completer)
+    h.engine.on_prefix("ici-remedy-", succeed_after(1))
+
+    ici = make_hc(
+        name="hc-ici",
+        repeat=60,
+        slo={"objective": 0.5, "windowSeconds": 3600},
+        remedy={
+            "generateName": "generic-remedy-",
+            "resource": {
+                "namespace": "health",
+                "serviceAccount": "remedy-sa",
+                "source": {"inline": WF_INLINE},
+            },
+            "byBucket": {
+                "ici": {
+                    "generateName": "ici-remedy-",
+                    "resource": {
+                        "namespace": "health",
+                        "source": {"inline": WF_INLINE},
+                    },
+                }
+            },
+        },
+        remedy_runs_limit=1,
+        remedy_reset_interval=86400,  # both gates set => limit enforced
+    )
+    adapt = h.reconciler.adapt
+    fleet = h.reconciler.fleet
+    checks = h.reconciler.resilience.checks
+
+    door = make_door(
+        h.clock,
+        h.metrics,
+        quotas={
+            "prod": TenantQuota(rate_per_minute=600.0),
+            "batch": TenantQuota(rate_per_minute=4.0, priority="low"),
+        },
+    )
+    adapt.frontdoor = door
+
+    # -- inject: three failing runs with ici payload evidence ----------
+    await h.apply_and_reconcile(ici)  # run 1 fires immediately
+    await h.settle()
+    await h.clock.advance(1.0)
+    await h.settle()
+    st = (await h.client.get("health", "hc-ici")).status
+    assert st.failed_count == 1
+    # run 1 is a spike: the remedy already targeted its bucket, but no
+    # cadence episode yet — interval still 60s
+    assert adapt.check_adapt(ici.key)["levers"] == ["remedy"]
+    assert h.reconciler._effective_repeat_after(ici) == 60
+    await h.clock.advance(61.0)  # run 2: engages, interval tightens
+    await h.settle()
+    await h.clock.advance(1.0)
+    await h.settle()
+    await h.clock.advance(31.0)  # run 3: already at the 30s cadence
+    await h.settle()
+    await h.clock.advance(1.0)
+    await h.settle()
+    st = (await h.client.get("health", "hc-ici")).status
+    assert st.failed_count == 3
+
+    # the cadence lever engaged on run 2 (burn 2.0 > 1.0 twice)
+    block = adapt.check_adapt(ici.key)
+    assert "cadence" in block["levers"]
+    assert block["cause"] == "ici"
+    assert checks.damp_factor(ici.key) == TIGHTEN_FACTOR
+    assert h.reconciler._effective_repeat_after(ici) == 30
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_adaptive_cadence_factor",
+            {"healthcheck_name": "hc-ici", "namespace": "health"},
+        )
+        == TIGHTEN_FACTOR
+    )
+
+    # the byBucket['ici'] remedy fired EXACTLY once (runs limit), and
+    # the plain fallback never did
+    names = [m["metadata"]["name"] for m in h.engine.submitted]
+    assert sum(1 for n in names if n.startswith("ici-remedy-")) == 1
+    assert sum(1 for n in names if n.startswith("generic-remedy-")) == 0
+    assert block["remedy_bucket"] == "ici"
+
+    # visible end-to-end: /statusz and am-tpu why/status
+    doc = fleet.statusz([await h.client.get("health", "hc-ici")])
+    assert doc["fleet"]["adaptive"]["engaged"] is True
+    assert doc["fleet"]["adaptive"]["levers"]["cadence"] == 1
+    [entry] = doc["checks"]
+    why = render_why(entry)
+    assert "adaptation:" in why
+    assert "interval x0.5" in why
+    table = render_status_table(doc)
+    assert "ADAPT" in table and "cadence:0.5" in table
+
+    # -- confirmed control-plane burn: breaker open + failing runs -----
+    breaker = h.reconciler.resilience.breaker
+    for _ in range(breaker.failure_threshold):
+        breaker.record_failure()
+    assert h.reconciler.resilience.degraded
+    cp = make_hc(name="hc-cp", slo={"objective": 0.5, "windowSeconds": 3600})
+    for i in range(3):
+        fleet.record(cp, ok=False, latency=1.0, workflow=f"cp-w{i}")
+    assert adapt.check_adapt(cp.key)["cause"] == "control_plane"
+
+    # the front-door lever engaged: freshness ceiling stretched ...
+    snap = door.snapshot()
+    assert snap["freshness"]["widened"] is True
+    assert snap["freshness"]["ceiling"] == 120.0
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_adaptive_freshness_ceiling_seconds", {}
+        )
+        == 120.0
+    )
+    # ... an over-asking request clamps AUDIBLY to the degraded ceiling
+    ticket = door.submit("prod", "health/hc-ici", freshness=500.0)
+    assert ticket.clamp["clamped"] is True
+    assert ticket.clamp["mode"] == "degraded"
+    assert ticket.clamp["window"] == 120.0
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_frontdoor_freshness_clamped_total",
+            {"tenant": "prod", "mode": "degraded"},
+        )
+        == 1.0
+    )
+    # ... and the low-priority tenant is shed by quota re-pricing while
+    # the healthy tenant is untouched
+    batch = [door.submit("batch", f"health/b-{i}").outcome for i in range(3)]
+    assert batch.count("refused") == 2  # re-priced to 1 token
+    prod = [door.submit("prod", f"health/p-{i}").outcome for i in range(3)]
+    assert prod.count("refused") == 0
+    assert door.conservation()["ok"]  # every request still accounted
+
+    # -- recovery: runs pass again, breaker probe closes the circuit ---
+    mode["fail"] = False
+    for _ in range(5):  # five passing ici runs at the tightened cadence
+        await h.clock.advance(31.0)
+        await h.settle()
+        await h.clock.advance(1.0)
+        await h.settle()
+    st = (await h.client.get("health", "hc-ici")).status
+    assert st.success_count == 5
+    assert not h.reconciler.resilience.degraded  # probe closed it
+    # burn 6/(3+k): calm at k=3,4,5 -> released on the fifth success
+    assert adapt.check_adapt(ici.key)["levers"] == ["remedy"]  # sticky tag
+    assert checks.damp_factor(ici.key) == 1.0
+    assert h.reconciler._effective_repeat_after(ici) == 60
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_adaptive_cadence_factor",
+            {"healthcheck_name": "hc-ici", "namespace": "health"},
+        )
+        is None
+    )
+    # the control-plane episode releases the same way
+    for i in range(5):
+        fleet.record(cp, ok=True, latency=1.0, workflow=f"cp-ok-{i}")
+    assert adapt.check_adapt(cp.key) is None
+    snap = adapt.snapshot()
+    assert snap["levers"]["cadence"] == 0
+    assert snap["levers"]["frontdoor"] == 0
+    assert snap["frontdoor"]["engaged"] is False
+    assert door.cache.freshness_ceiling() == 30.0
+    assert door.admission.shed_factor is None
+    assert (
+        h.metrics.sample_value(
+            "healthcheck_adaptive_freshness_ceiling_seconds", {}
+        )
+        == 30.0
+    )
+    for lever, want in (
+        ("cadence", 0.0),
+        ("frontdoor", 0.0),
+        ("placement", 0.0),
+        ("remedy", 1.0),  # the targeted-selection tag outlives release
+    ):
+        assert (
+            h.metrics.sample_value(
+                "healthcheck_adaptive_lever_active", {"lever": lever}
+            )
+            == want
+        )
+
+    # every engage has a matching release in the transition counters
+    # AND one flight bundle each
+    for lever, action, want in (
+        ("cadence", "engage", 2.0),  # ici + cp episodes
+        ("cadence", "release", 2.0),
+        ("frontdoor", "engage", 1.0),
+        ("frontdoor", "release", 1.0),
+        ("remedy", "target", 1.0),
+    ):
+        assert (
+            h.metrics.sample_value(
+                "healthcheck_adaptive_transitions_total",
+                {"lever": lever, "action": action},
+            )
+            == want
+        ), (lever, action)
+    bundles = h.reconciler.flightrec.bundles(kind="adaptive-lever")
+
+    def count(lever, action):
+        return sum(
+            1
+            for b in bundles
+            if b["extra"]["lever"] == lever and b["extra"]["action"] == action
+        )
+
+    assert count("cadence", "engage") == 2
+    assert count("cadence", "release") == 2
+    assert count("frontdoor", "engage") == 1
+    assert count("frontdoor", "release") == 1
+    assert count("remedy", "target") == 1
+
+    # the fleet doc and CLI read idle again (remedy tag aside)
+    doc = fleet.statusz([await h.client.get("health", "hc-ici"), cp])
+    assert doc["fleet"]["adaptive"]["levers"]["cadence"] == 0
+    assert doc["fleet"]["adaptive"]["frontdoor"]["engaged"] is False
+    entry = next(c for c in doc["checks"] if c["healthcheck"] == "hc-ici")
+    assert "interval x0.5" not in render_why(entry)

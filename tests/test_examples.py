@@ -74,6 +74,39 @@ def test_feature_matrix_coverage():
     )
     # baseline & anomaly detection opt-in (docs/analysis.md)
     assert any(hc.spec.analysis is not None for hc in all_checks)
+    # bucket-targeted remedies (ISSUE 18: closed-loop goodput control)
+    assert any(hc.spec.remedy_workflow.by_bucket for hc in all_checks)
+
+
+def test_bucket_remedy_example_selects_by_attribution():
+    """The byBucket example must honor the selection contract: mapped
+    buckets get their targeted workflow, unmapped buckets fall back to
+    the plain remedy, and every selected workflow still parses into a
+    submittable manifest inheriting the fallback's serviceAccount when
+    it declares none of its own."""
+    from activemonitor_tpu.controller import parse_remedy_workflow_from_healthcheck
+
+    (hc,) = load_healthchecks("examples/remedy/bucket-remedy.yaml")
+    remedy = hc.spec.remedy_workflow
+    assert set(remedy.by_bucket) == {"ici", "control_plane"}
+    # the RBAC contract: the plain fallback carries resource + SA
+    assert remedy.resource is not None
+    assert remedy.resource.service_account == "activemonitor-remedy-sa"
+
+    ici = remedy.select_for_bucket("ici")
+    assert ici is remedy.by_bucket["ici"]
+    wf = parse_remedy_workflow_from_healthcheck(hc, remedy=ici)
+    assert wf["kind"] == "Workflow"
+    # no serviceAccount of its own → inherits the plain remedy's
+    assert wf["spec"]["serviceAccountName"] == "activemonitor-remedy-sa"
+
+    cp = remedy.select_for_bucket("control_plane")
+    wf = parse_remedy_workflow_from_healthcheck(hc, remedy=cp)
+    assert wf["spec"]["serviceAccountName"] == "activemonitor-remedy-admin-sa"
+
+    # unmapped bucket → the plain remedy itself
+    assert remedy.select_for_bucket("hbm") is remedy
+    assert remedy.select_for_bucket("") is remedy
 
 
 def test_analysis_baseline_example_declares_the_full_block():

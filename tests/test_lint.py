@@ -412,6 +412,42 @@ def test_resilience_metric_families_are_pinned():
         assert family in contract.PINNED_FAMILIES, family
 
 
+def test_adaptive_module_rides_the_resilience_wallclock_ban():
+    """resilience/adapt.py (ISSUE 18) must be covered by the path-keyed
+    wall-clock ban — the adaptive controller's hysteresis streaks and
+    episode `since` stamps ride the injected Clock, and the closed-loop
+    chaos test scripts engage→release purely on a FakeClock. An
+    accidental move out of resilience/ would silently drop the ban."""
+    path = REPO / "activemonitor_tpu" / "resilience" / "adapt.py"
+    assert path.exists(), "adaptive controller module missing?"
+    src = path.read_text()
+    checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+    assert checker.ban_wallclock
+    assert checker.wallclock_pkg == "resilience"
+    assert lint.lint_file(path) == []
+
+
+def test_adaptive_metric_families_are_pinned():
+    """The ISSUE-18 families must stay in the exposition contract — the
+    adaptation runbook (docs/resilience.md "Adaptive control loop")
+    alerts on lever engagement and the cadence factor; a rename
+    silently blinds the operator to a controller that is actively
+    reshaping the probe schedule."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_adaptive", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    for family in (
+        "healthcheck_adaptive_cadence_factor",
+        "healthcheck_adaptive_lever_active",
+        "healthcheck_adaptive_transitions_total",
+        "healthcheck_adaptive_freshness_ceiling_seconds",
+        "healthcheck_frontdoor_freshness_clamped_total",
+    ):
+        assert family in contract.PINNED_FAMILIES, family
+
+
 def test_analysis_metric_families_are_pinned():
     """The ISSUE-4 families must stay in the exposition contract — a
     rename silently breaks baseline dashboards and anomaly alerts."""

@@ -246,6 +246,13 @@ PINNED_FAMILIES = {
     # decomposition — docs/observability.md "Reading a waterfall")
     "healthcheck_critical_path_seconds": "gauge",
     "healthcheck_profile_captures_total": "counter",
+    # adaptive-control families (ISSUE 18: closed-loop goodput control
+    # — docs/resilience.md "Adaptive control loop")
+    "healthcheck_adaptive_cadence_factor": "gauge",
+    "healthcheck_adaptive_lever_active": "gauge",
+    "healthcheck_adaptive_transitions_total": "counter",
+    "healthcheck_adaptive_freshness_ceiling_seconds": "gauge",
+    "healthcheck_frontdoor_freshness_clamped_total": "counter",
     # durable-journal families (ISSUE 16: restart-proof telemetry
     # journal — docs/observability.md "Durable telemetry journal")
     "healthcheck_journal_appended_total": "counter",
@@ -317,6 +324,12 @@ def exercise_every_family(collector):
         },
     )
     collector.record_profile_capture("degraded")
+    # adaptive-control families (ISSUE 18)
+    collector.set_adaptive_cadence("hc-a", "health", 0.5)
+    collector.set_adaptive_lever("cadence", True)
+    collector.record_adaptive_transition("cadence", "engage")
+    collector.set_adaptive_freshness_ceiling(120.0)
+    collector.record_frontdoor_clamp("tenant-a", "degraded")
     # durable-journal families (ISSUE 16)
     collector.record_journal_append("result")
     collector.record_journal_replayed("result", 2)

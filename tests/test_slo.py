@@ -359,6 +359,10 @@ FLEET_FIELDS = {
     # merge of the per-check blocks; None until a windowed run still
     # has spans in the ring
     "critical_path": (dict, type(None)),
+    # closed-loop adaptive control (ISSUE 18): engaged levers, cadence
+    # episodes, front-door degraded state, recent decisions; None when
+    # no AdaptiveController is wired
+    "adaptive": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,
@@ -383,6 +387,9 @@ CHECK_FIELDS = {
     # per-stage p50/p95/p99 waterfall aggregation (ISSUE 17): None
     # while no windowed run still has spans in the ring
     "critical_path": (dict, type(None)),
+    # this check's adaptation episode (ISSUE 18): None unless the
+    # adaptive controller currently holds a lever on the check
+    "adapt": (dict, type(None)),
 }
 WINDOW_FIELDS = {
     "seconds": (int, float),
@@ -993,8 +1000,8 @@ def test_render_status_table_shapes_rows():
     header, row = lines[1], lines[2]
     assert header.split() == [
         "NAME", "NAMESPACE", "STATUS", "STATE", "ANOMALY", "RUNS", "AVAIL",
-        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "WHY", "LAST",
-        "TRACE",
+        "P50", "P95", "P99", "BUDGET", "BURN", "REMEDY", "ADAPT", "WHY",
+        "LAST", "TRACE",
     ]
     cells = row.split()
     assert cells[0] == "hc-slo"
