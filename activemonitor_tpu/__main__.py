@@ -270,6 +270,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(0: the default, 256 MiB); oldest captures are pruned first, "
         "the newest always survives",
     )
+    run.add_argument(
+        "--federation-config",
+        default="",
+        metavar="PATH",
+        help="YAML federation document (liveness_seconds + clusters "
+        "with name/url/device_kind/chips/topology/slices/dcn_gbps, see "
+        "examples/federation/): this controller polls every listed "
+        "cluster's /statusz, judges liveness by payload MOVEMENT, "
+        "routes capability-constrained checks, and serves the "
+        "federation block on its own /statusz (docs/operations.md "
+        "\"Federating clusters\")",
+    )
 
     def add_client_flags(p) -> None:
         """kubectl-verb parity: every CLI verb can target the file store
@@ -341,6 +353,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_statusz_flags(status)
     status.add_argument(
+        "--federation",
+        action="store_true",
+        help="treat each --url as a CLUSTER (not a replica of one "
+        "sharded fleet) and merge at the federation level: per-cluster "
+        "rows, run-weighted global goodput, old-binary clusters folded "
+        "into the unknown attribution bucket "
+        "(docs/operations.md \"Federating clusters\")",
+    )
+    status.add_argument(
+        "-o", "--output", choices=["table", "json"], default="table"
+    )
+
+    clusters = sub.add_parser(
+        "clusters",
+        help="the federation registry from a running federating "
+        "controller's /statusz: one row per member cluster with "
+        "health state, capability card, and movement age "
+        "(docs/operations.md \"Federating clusters\")",
+    )
+    add_statusz_flags(clusters)
+    clusters.add_argument(
         "-o", "--output", choices=["table", "json"], default="table"
     )
 
@@ -708,6 +741,48 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
             "--profile-max-bytes needs --profile-on-anomaly "
             "(no capture directory to cap)"
         )
+    federation = None
+    federation_config = getattr(args, "federation_config", "")
+    if federation_config:
+        # the federation document is config, not a manifest: parse and
+        # shape-check it HERE so a typo'd file is a usage error before
+        # the Manager (and its bound sockets) exist
+        import yaml as _yaml
+
+        from activemonitor_tpu.federation import FederationPlane
+
+        try:
+            with open(federation_config) as f:
+                fed_doc = _yaml.safe_load(f.read())
+        except (OSError, _yaml.YAMLError) as e:
+            raise _ConfigError(
+                f"cannot read federation config {federation_config!r}: {e}"
+            ) from e
+        if not isinstance(fed_doc, dict):
+            raise _ConfigError(
+                f"federation config {federation_config!r} must be a "
+                "mapping (liveness_seconds + clusters)"
+            )
+        entries = fed_doc.get("clusters") or []
+        names = [str(entry.get("name") or "") for entry in entries]
+        if not names:
+            raise _ConfigError(
+                f"federation config {federation_config!r} lists no "
+                "clusters (nothing to federate)"
+            )
+        if "" in names or len(set(names)) != len(names):
+            raise _ConfigError(
+                f"federation config {federation_config!r}: every "
+                "cluster needs a unique non-empty name"
+            )
+        liveness = float(fed_doc.get("liveness_seconds") or 90.0)
+        if liveness <= 0:
+            raise _ConfigError(
+                f"federation config {federation_config!r}: "
+                f"liveness_seconds must be > 0 (got {liveness:g})"
+            )
+        federation = FederationPlane.from_config(fed_doc, metrics=metrics)
+
     metrics_authorizer = None
     k8s_auth = getattr(args, "metrics_k8s_auth", "auto")
     if k8s_auth == "on" and kube_api is None:
@@ -756,6 +831,7 @@ async def _run_controller(args, client_kind, kube_api, kube_cfg) -> int:
         profile_on_anomaly_dir=profile_dir,
         profile_cooldown=profile_cooldown,
         profile_max_bytes=profile_max_bytes,
+        federation=federation,
     )
     for path in args.filename:
         await client.apply(_load_manifest(HealthCheck, path))
@@ -1041,7 +1117,45 @@ def render_status_table(payload: dict) -> str:
         fleet_line += f"  remedy_tokens={fleet['remedy_tokens']:.1f}"
     if fleet.get("replicas") is not None:
         fleet_line += f"  replicas={fleet['replicas']}"
+    if fleet.get("clusters") is not None:
+        fleet_line += f"  clusters={fleet['clusters']}"
     lines = [fleet_line]
+    per_cluster = fleet.get("per_cluster")
+    if per_cluster:
+        # federation-level view (`status --federation`): one line per
+        # member cluster; SKEWED marks an old-binary cluster whose
+        # goodput evidence folded into the unknown bucket
+        for name in sorted(per_cluster):
+            row = per_cluster[name]
+            line = (
+                "CLUSTER {}  replicas={}  checks={}  window_runs={}  "
+                "goodput={}".format(
+                    name,
+                    row.get("replicas", 0),
+                    row.get("checks", 0),
+                    row.get("window_runs", 0),
+                    _fmt_ratio(row.get("goodput_ratio")),
+                )
+            )
+            if row.get("degraded"):
+                line += "  DEGRADED"
+            if row.get("skewed"):
+                line += "  SKEWED(old binary: goodput under unknown)"
+            lines.append(line)
+    federation = fleet.get("federation")
+    if federation:
+        # a federating controller's own /statusz: the registry headline
+        # (`am-tpu clusters` has the full per-member table)
+        registry = federation.get("registry") or {}
+        line = "FEDERATION  clusters={}  healthy={}  unhealthy={}".format(
+            len(registry.get("clusters") or {}),
+            registry.get("healthy", 0),
+            registry.get("unhealthy", 0),
+        )
+        door = federation.get("door")
+        if door and not door.get("conservation_ok", True):
+            line += "  CONSERVATION-BROKEN"
+        lines.append(line)
     frontdoor = fleet.get("frontdoor")
     if frontdoor:
         # the probe-as-a-service ingestion line: offered load, how much
@@ -1172,11 +1286,12 @@ def render_status_table(payload: dict) -> str:
     return "\n".join(lines)
 
 
-async def _fetch_fleet_payload(args):
+async def _fetch_statusz_payloads(args):
     """Fetch /statusz from every --url (default: the local health
-    endpoint) and return ONE fleet payload — rolled up across replicas
-    when more than one answered — or None when none did. Shared by the
-    status/why/goodput verbs so they all see the same fleet view."""
+    endpoint) concurrently, returning the ordered ``(url, payload)``
+    pairs that answered (warnings on stderr for the ones that did
+    not). The merge policy — replica rollup vs federation merge — is
+    the CALLER's: this helper only gathers the payloads."""
     import aiohttp
 
     urls = args.url or ["http://127.0.0.1:8081/statusz"]
@@ -1209,7 +1324,7 @@ async def _fetch_fleet_payload(args):
         elif result[2] is not None:
             failures.append(result[2])
         else:
-            payloads.append(result[1])
+            payloads.append((url, result[1]))
     for failure in failures:
         print(f"warning: {failure}", file=sys.stderr)
     if not payloads:
@@ -1225,27 +1340,146 @@ async def _fetch_fleet_payload(args):
             "replicas reporting)",
             file=sys.stderr,
         )
+    return payloads
+
+
+async def _fetch_fleet_payload(args):
+    """Fetch /statusz from every --url and return ONE fleet payload —
+    rolled up across replicas when more than one answered — or None
+    when none did. Shared by the status/why/goodput verbs so they all
+    see the same fleet view."""
+    payloads = await _fetch_statusz_payloads(args)
+    if payloads is None:
+        return None
     if len(payloads) == 1:
-        return payloads[0]
+        return payloads[0][1]
     # sharded fleet: merge the per-replica payloads into one view
     # (obs/slo.rollup_statusz — checks deduped by key, per-shard
     # ownership counts summed, goodput the run-weighted mean of
     # the replicas' own ratios, attribution merged run-weighted)
     from activemonitor_tpu.obs.slo import rollup_statusz
 
-    return rollup_statusz(payloads)
+    return rollup_statusz([payload for _, payload in payloads])
+
+
+def _cluster_name_for_url(url: str) -> str:
+    """A stable cluster label for `status --federation`'s per-URL
+    payloads: the URL's host:port (the part an operator recognizes),
+    falling back to the raw URL."""
+    from urllib.parse import urlsplit
+
+    try:
+        return urlsplit(url).netloc or url
+    except ValueError:
+        return url
 
 
 async def _status(args) -> int:
     import json as _json
 
-    payload = await _fetch_fleet_payload(args)
-    if payload is None:
-        return 1
+    if getattr(args, "federation", False):
+        # each --url is a CLUSTER: merge at the federation level
+        # (federation/rollup.federate_statusz — per-cluster rows kept,
+        # goodput run-weighted, old binaries folded into unknown)
+        pairs = await _fetch_statusz_payloads(args)
+        if pairs is None:
+            return 1
+        from activemonitor_tpu.federation import federate_statusz
+
+        payload = federate_statusz(
+            {_cluster_name_for_url(url): body for url, body in pairs}
+        )
+    else:
+        payload = await _fetch_fleet_payload(args)
+        if payload is None:
+            return 1
     if args.output == "json":
         print(_json.dumps(payload, indent=2))
         return 0
     print(render_status_table(payload))
+    return 0
+
+
+def render_clusters(federation: dict) -> str:
+    """The `am-tpu clusters` table over a /statusz ``federation``
+    block: one row per member cluster. Pure so tests pin the
+    rendering against a canned block."""
+    registry = (federation or {}).get("registry") or {}
+    members = registry.get("clusters") or {}
+    lines = [
+        "FEDERATION  clusters={}  healthy={}  unhealthy={}  "
+        "liveness={:g}s".format(
+            len(members),
+            registry.get("healthy", 0),
+            registry.get("unhealthy", 0),
+            registry.get("liveness_seconds") or 0.0,
+        )
+    ]
+    door = (federation or {}).get("door")
+    if door:
+        requests = door.get("requests") or {}
+        line = (
+            "GLOBAL-DOOR  submitted={}  refused={}  forwarded={}".format(
+                requests.get("submitted", 0),
+                requests.get("refused", 0),
+                requests.get("forwarded", 0),
+            )
+        )
+        if not door.get("conservation_ok", True):
+            line += "  CONSERVATION-BROKEN"
+        lines.append(line)
+    headers = [
+        "NAME", "STATE", "GEN", "CHIPS", "TOPOLOGY", "DCN", "SLICES",
+        "MOVED", "TRANSITIONS",
+    ]
+    rows = []
+    for name in sorted(members):
+        member = members[name]
+        age = member.get("movement_age_seconds")
+        rows.append(
+            [
+                name,
+                member.get("state", ""),
+                member.get("generation", "") or "-",
+                str(member.get("chips", 0)),
+                member.get("topology", "") or "-",
+                "{:g}".format(member.get("dcn_gbps") or 0.0),
+                ",".join(member.get("slices") or []) or "-",
+                "-" if age is None else f"{age:.0f}s ago",
+                str(member.get("transitions", 0)),
+            ]
+        )
+    if not rows:
+        lines.append("No clusters joined.")
+        return "\n".join(lines)
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+async def _clusters(args) -> int:
+    import json as _json
+
+    payload = await _fetch_fleet_payload(args)
+    if payload is None:
+        return 1
+    federation = (payload.get("fleet") or {}).get("federation")
+    if not federation:
+        print(
+            "error: no federation block on /statusz (is the controller "
+            "running with --federation-config?)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.output == "json":
+        print(_json.dumps(federation, indent=2))
+        return 0
+    print(render_clusters(federation))
     return 0
 
 
@@ -2121,6 +2355,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "get": _get,
         "describe": _describe,
         "status": _status,
+        "clusters": _clusters,
         "why": _why,
         "waterfall": _waterfall,
         "goodput": _goodput,

@@ -206,6 +206,34 @@ class AnalysisSpec(_Base):
     trigger_on_degraded: bool = Field(default=False, alias="triggerOnDegraded")
 
 
+class RequiresSpec(_Base):
+    """Capability requirement for multi-cluster routing (extension; no
+    counterpart in the reference CRD — docs/operations.md "Federating
+    clusters").
+
+    Declaring the block tells the federation's capability router WHERE
+    the check may run: the cluster owning ``slice``, or any healthy
+    cluster matching the generation / mesh-shape / DCN-tier floors
+    (tightest fit wins). No healthy cluster qualifying is a structured
+    ``no_capable_cluster`` refusal, never a silent local run. Omitting
+    the block (the default) routes by a stable hash over the healthy
+    set — and changes nothing on an unfederated controller.
+    """
+
+    # rated-table generation the check needs (e.g. "v5p"); "" = any
+    generation: str = ""
+    # mesh shape the probe wants, e.g. "4x4" — its chip footprint
+    # becomes the cluster-size floor
+    topology: str = ""
+    min_chips: int = Field(default=0, ge=0, alias="minChips")
+    # per-host DCN tier floor (GB/s, one direction) for cross-slice
+    # probes that need the fat NICs
+    min_dcn_gbps: float = Field(default=0.0, ge=0.0, alias="minDcnGbps")
+    # pin to the cluster owning this named slice (falls through to the
+    # capability match while that cluster is unhealthy — the reroute)
+    slice_name: str = Field(default="", alias="slice")
+
+
 class ScheduleSpec(_Base):
     """Cron schedule (reference: healthcheck_types.go:148-151).
 
@@ -241,6 +269,9 @@ class HealthCheckSpec(_Base):
     slo: Optional[SLOSpec] = None
     # optional baseline/anomaly block — absent ⇒ no degradation verdicts
     analysis: Optional[AnalysisSpec] = None
+    # optional capability requirement — absent ⇒ default routing on a
+    # federated controller, ignored on a single-cluster one
+    requires: Optional[RequiresSpec] = None
 
 
 class HealthCheckStatus(_Base):

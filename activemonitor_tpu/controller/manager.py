@@ -318,6 +318,7 @@ class Manager:
         profile_on_anomaly_dir: str = "",  # capture dir; "" = profiling off
         profile_cooldown: float = ProfileOnAnomaly.DEFAULT_COOLDOWN_SECONDS,
         profile_max_bytes: int = 0,  # capture-dir byte cap; 0 = default
+        federation=None,  # FederationPlane: multi-cluster control plane
     ):
         self.client = client
         self.reconciler = reconciler
@@ -390,6 +391,22 @@ class Manager:
             reconciler.fleet.attach_journal(journal)
             if frontdoor is not None:
                 frontdoor.journal = journal
+        # --federation-config (federation/plane.py): the multi-cluster
+        # control plane. Cluster-transition flight bundles ride THIS
+        # controller's recorder, the registry gauges its collector, the
+        # /statusz federation block the fleet, and transport stays out
+        # of the package: the plane polls through the aiohttp hook
+        # below. The goodput loop drives the poll/sweep cadence.
+        self._federation = federation
+        if federation is not None:
+            reconciler.fleet.federation = federation
+            federation.registry.flightrec = reconciler.flightrec
+            if federation.registry.metrics is None:
+                federation.registry.metrics = reconciler.metrics
+            if federation.router.metrics is None:
+                federation.router.metrics = reconciler.metrics
+            if federation.fetch is None:
+                federation.fetch = self._fetch_cluster_statusz
         # fleet-wide remedy storm control (--remedy-rate) lives in the
         # reconciler's resilience coordinator. Sharded fleets apportion
         # the FLEET rate by owned shards (rate × owned/N, re-applied on
@@ -530,6 +547,30 @@ class Manager:
         self._requeue_tasks: Set[asyncio.Task] = set()
         self._http_runners: list = []
         self.reconciler.metrics.set_max_concurrent(self.max_parallel)
+
+    async def _fetch_cluster_statusz(self, url: str) -> Optional[dict]:
+        """The federation plane's transport hook: one member cluster's
+        /statusz, fetched under the same connect/read-gap timeouts as
+        the CLI's multi-URL fetch (a total cap would misreport a slow-
+        streaming healthy cluster as dead). Any failure returns None —
+        absence of movement, which the liveness window judges; the
+        error itself never decides health."""
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(
+            connect=5, sock_connect=5, sock_read=15
+        )
+        try:
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                async with session.get(url) as resp:
+                    if resp.status != 200:
+                        return None
+                    return await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.warning("federation statusz fetch failed for %s", url)
+            return None
 
     def _frontdoor_trigger(self, namespace: str, name: str) -> Optional[str]:
         """The front door's run trigger: mark the cycle demand-driven
@@ -826,6 +867,13 @@ class Manager:
                     # per-shard ownership counts for /statusz and the
                     # healthcheck_shard_checks gauge (rollup work too)
                     self._shards.update_check_counts(checks)
+                if self._federation is not None:
+                    # federation round (--federation-config): poll every
+                    # member cluster's /statusz (observed movement IS
+                    # the liveness signal), sweep health transitions,
+                    # refresh the federation gauges — rollup-cadence
+                    # work riding the same loop as the other rollups
+                    await self._federation.poll()
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -1,0 +1,58 @@
+"""Planet-scale federation: the multi-cluster control plane.
+
+ROADMAP item 2 grown to its fleet-of-fleets form: every surface in
+this repo — sharded scheduling, attribution-conserving rollups, the
+probe-as-a-service front door — stops at one cluster, while the ML
+Productivity Goodput paper (PAPERS.md) frames measurement fleet-wide
+and Maple argues the control plane must be portable across
+heterogeneous clusters (v5e vs v5p) the way the data plane already is
+after the DCN×ICI collectives. This package is that control plane:
+
+- :mod:`registry` — per-cluster capability descriptors (derived from
+  the ``probes/rated.py`` rated tables) with health judged by observed
+  ``/statusz`` movement, the same locally-observed-liveness discipline
+  as sharding's member leases.
+- :mod:`routing` — capability-aware routing: a check lands on the
+  cluster owning its target slice or best matching its declared
+  requirements (generation, mesh shape, dcn tier), with a structured
+  ``no_capable_cluster`` refusal otherwise.
+- :mod:`rollup` — the federated rollup: ``obs/slo.rollup_statusz``
+  generalized from replicas to clusters (two-level merge, run-weighted
+  goodput, attribution conservation preserved exactly; an old-binary
+  cluster folds its lost share into ``unknown``).
+- :mod:`globaldoor` — one submit surface in front of the per-cluster
+  front doors: coalescing works ACROSS clusters (N tenants asking
+  about one pod share one run and one trace id), per-tenant quota is
+  enforced once globally, and the conservation ledger
+  ``submitted == hits + joins + runs + parked + refused + forwarded``
+  is exact per tenant per cluster and sums at the federation level.
+- :mod:`plane` — the manager-facing façade wiring the pieces into the
+  ``/statusz`` ``federation`` block, the pinned
+  ``healthcheck_federation_*`` families, and the flight recorder.
+
+Everything timed runs on the injectable Clock; ``hack/lint.py`` bans
+bare wall-clock reads in this package like ``frontdoor/`` and
+``resilience/``.
+"""
+
+from activemonitor_tpu.federation.globaldoor import (  # noqa: F401
+    FEDERATION_TENANT,
+    OUTCOME_FORWARDED,
+    GlobalFrontDoor,
+    GlobalTicket,
+    federation_quota,
+)
+from activemonitor_tpu.federation.plane import FederationPlane  # noqa: F401
+from activemonitor_tpu.federation.registry import (  # noqa: F401
+    STATE_HEALTHY,
+    STATE_UNHEALTHY,
+    ClusterDescriptor,
+    ClusterRegistry,
+)
+from activemonitor_tpu.federation.rollup import federate_statusz  # noqa: F401
+from activemonitor_tpu.federation.routing import (  # noqa: F401
+    NO_CAPABLE_CLUSTER,
+    CapabilityRouter,
+    Requirement,
+    RouteDecision,
+)

@@ -1,0 +1,438 @@
+"""The global front door: one submit surface over many clusters.
+
+PR 15's :class:`~activemonitor_tpu.frontdoor.service.FrontDoor` stops
+at one cluster: quota, coalescing, and the conservation ledger are all
+per-cluster, so N tenants asking different clusters about the same pod
+pay N runs and a hot tenant gets a fresh budget in every region. The
+global door fixes both by composing, not replacing:
+
+- **quota once, globally**: one :class:`~activemonitor_tpu.frontdoor.
+  admission.AdmissionController` (the same token-bucket policy, the
+  same structured refusal vocabulary) admits the tenant BEFORE routing.
+  The per-cluster doors underneath admit the federation's traffic under
+  :func:`federation_quota` — effectively unlimited, because paying
+  quota twice would double-refuse — so a tenant's budget is one number
+  no matter how many clusters serve it.
+- **coalescing across clusters**: the capability router is
+  deterministic (slice owner, tightest capability fit, or a stable
+  hash), so every submission of one check lands on the SAME cluster's
+  door, whose coalescing cache fans them in — N tenants in different
+  regions share one run and one trace id, exactly the single-cluster
+  guarantee lifted a level.
+- **conservation, one level up**: every submitted request lands in
+  exactly one of {cache_hit, joined, run, parked, refused, forwarded},
+  booked per tenant PER CLUSTER, and :meth:`GlobalFrontDoor.
+  conservation` cross-checks the outcome ledger against the global
+  admission ledger — per cell and summed at the federation level — so
+  a routing bug cannot hide demand between clusters.
+
+``forwarded`` is the new column: a request routed to a cluster this
+door has no in-process :meth:`attach` for is handed to that cluster's
+forwarder hook (the manager wires an HTTP submit there). The ledger
+books it at hand-off — the remote cluster's own door accounts for the
+rest, in ITS ledger, under the federation tenant.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from activemonitor_tpu.federation.registry import ClusterRegistry
+from activemonitor_tpu.federation.routing import CapabilityRouter, Requirement
+from activemonitor_tpu.frontdoor.admission import (
+    PRE_ADMISSION_REASONS,
+    AdmissionController,
+    TenantQuota,
+)
+from activemonitor_tpu.frontdoor.service import (
+    OUTCOME_HIT,
+    OUTCOME_JOINED,
+    OUTCOME_PARKED,
+    OUTCOME_REFUSED,
+    OUTCOME_RUN,
+    Ticket,
+)
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.federation")
+
+# the sixth outcome column, unique to the global ledger: handed to a
+# remote cluster's own front door (accounted there from that point on)
+OUTCOME_FORWARDED = "forwarded"
+
+# the tenant name the global door uses on the per-cluster doors: quota
+# is already paid globally, so the inner doors must always admit it
+# (give it federation_quota() in their admission config)
+FEDERATION_TENANT = "(federation)"
+
+# post-admission refusal reasons minted at this level (the routing
+# verdict's no_capable_cluster joins them via the router)
+REFUSE_CLUSTER_UNATTACHED = "cluster_unattached"
+
+# ledger column for requests refused before any cluster was chosen
+UNROUTED_CLUSTER = "(none)"
+
+
+def federation_quota() -> TenantQuota:
+    """The quota the per-cluster doors grant :data:`FEDERATION_TENANT`:
+    effectively unlimited, because the global door already charged the
+    real tenant's bucket — a second, per-cluster charge would refuse
+    traffic the federation admitted (and split one budget into N)."""
+    return TenantQuota(rate_per_minute=1e12)
+
+
+@dataclass
+class GlobalTicket:
+    """One globally-submitted request's decision: which cluster, how it
+    was matched, and the per-cluster :class:`Ticket` underneath (None
+    for refusals and forwards)."""
+
+    rid: int
+    tenant: str
+    check: str
+    cluster: str = ""
+    outcome: str = OUTCOME_REFUSED
+    matched: str = ""  # routing match kind (slice|capability|default)
+    reason: str = ""  # refusal reason; "" otherwise
+    ticket: Optional[Ticket] = None
+    # the forwarder hook's return value (opaque: the manager's HTTP
+    # forwarder returns the remote response, tests return sentinels)
+    forwarded: object = None
+
+    @property
+    def trace_id(self) -> str:
+        """The underlying run's trace id — SHARED by every tenant that
+        coalesced onto it, across clusters (the global fan-in proof)."""
+        return self.ticket.trace_id if self.ticket is not None else ""
+
+    async def wait(self):
+        """The fanned-out result (None for refusals and forwards)."""
+        if self.ticket is None:
+            return None
+        return await self.ticket.wait()
+
+
+@dataclass
+class _Cell:
+    """One (tenant, cluster) ledger cell — the global conservation
+    table's unit."""
+
+    submitted: int = 0
+    cache_hits: int = 0
+    joins: int = 0
+    runs: int = 0
+    parked: int = 0
+    refused: int = 0
+    forwarded: int = 0
+    refusals: Dict[str, int] = field(default_factory=dict)
+
+    def outcomes(self) -> int:
+        return (
+            self.cache_hits
+            + self.joins
+            + self.runs
+            + self.parked
+            + self.refused
+            + self.forwarded
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "cache_hits": self.cache_hits,
+            "coalesced_joins": self.joins,
+            "probe_runs": self.runs,
+            "parked": self.parked,
+            "refused": self.refused,
+            "forwarded": self.forwarded,
+            "refusals": dict(self.refusals),
+            "ok": self.submitted == self.outcomes(),
+        }
+
+
+class GlobalFrontDoor:
+    """One submit surface over the federation's per-cluster doors."""
+
+    def __init__(
+        self,
+        registry: ClusterRegistry,
+        router: CapabilityRouter,
+        admission: AdmissionController,
+        *,
+        clock: Optional[Clock] = None,
+        metrics=None,  # MetricsCollector (duck-typed; optional)
+    ):
+        self.clock = clock or Clock()
+        self.registry = registry
+        self.router = router
+        self.admission = admission
+        self.metrics = metrics
+        # cluster name -> in-process FrontDoor (co-hosted / tests)
+        self._doors: Dict[str, object] = {}
+        # cluster name -> forwarder hook for remote clusters:
+        # fn(tenant, check, freshness) -> opaque handle
+        self._forwarders: Dict[str, Callable] = {}
+        # tenant (booked) -> cluster -> ledger cell
+        self._cells: Dict[str, Dict[str, _Cell]] = {}
+        self._rid = 0
+
+    # -- wiring ----------------------------------------------------------
+    def attach(self, name: str, door) -> None:
+        """Wire a cluster's in-process :class:`FrontDoor`. Its admission
+        config must grant :data:`FEDERATION_TENANT` the
+        :func:`federation_quota` — quota was already paid globally."""
+        self._doors[name] = door
+
+    def attach_forwarder(self, name: str, forward: Callable) -> None:
+        """Wire a remote cluster's submit hook — called as
+        ``forward(tenant, check, freshness)``; its return value rides
+        the ticket opaquely. The ledger books ``forwarded`` at hand-off."""
+        self._forwarders[name] = forward
+
+    # -- the submit path -------------------------------------------------
+    def submit(
+        self,
+        tenant: str,
+        check: str,
+        freshness: Optional[float] = None,
+        requirement: Optional[Requirement] = None,
+    ) -> GlobalTicket:
+        """One request, decided synchronously: global quota first, then
+        the capability route, then the chosen cluster's own door (whose
+        decision — hit / join / run / parked / refused — mirrors into
+        the global (tenant, cluster) cell)."""
+        self._rid += 1
+        rid = self._rid
+        decision = self.admission.admit(tenant, check)
+        booked = decision.booked
+        if not decision.admitted:
+            # pre-admission refusal (quota / unknown_tenant / capacity):
+            # already in the admission ledger; no cluster was chosen
+            ticket = GlobalTicket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                cluster=UNROUTED_CLUSTER,
+                outcome=OUTCOME_REFUSED,
+                reason=decision.reason,
+            )
+            self._book(booked, ticket)
+            return ticket
+        route = self.router.route(check, requirement)
+        if not route.routed:
+            # post-admission: the token was paid, so the refusal books
+            # through admission.refuse to keep the cross-check exact
+            refusal = self.admission.refuse(tenant, route.reason, booked=booked)
+            ticket = GlobalTicket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                cluster=UNROUTED_CLUSTER,
+                outcome=OUTCOME_REFUSED,
+                reason=refusal.reason,
+            )
+            self._book(booked, ticket)
+            return ticket
+        cluster = route.cluster
+        door = self._doors.get(cluster)
+        if door is not None:
+            inner = door.submit(FEDERATION_TENANT, check, freshness)
+            ticket = GlobalTicket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                cluster=cluster,
+                outcome=inner.outcome,
+                matched=route.matched,
+                reason=inner.reason,
+                ticket=inner,
+            )
+            if inner.outcome == OUTCOME_REFUSED:
+                # the cluster's door refused an admitted request (full
+                # parking lot, unrouted shard): a post-admission refusal
+                # at this level too, same reason, same exact books
+                self.admission.refuse(tenant, inner.reason, booked=booked)
+            self._book(booked, ticket)
+            return ticket
+        forward = self._forwarders.get(cluster)
+        if forward is not None:
+            handle = forward(tenant, check, freshness)
+            ticket = GlobalTicket(
+                rid=rid,
+                tenant=tenant,
+                check=check,
+                cluster=cluster,
+                outcome=OUTCOME_FORWARDED,
+                matched=route.matched,
+                forwarded=handle,
+            )
+            self._book(booked, ticket)
+            return ticket
+        # routed to a cluster nothing is wired for: a structured
+        # post-admission refusal naming the cluster, never an exception
+        refusal = self.admission.refuse(
+            tenant, REFUSE_CLUSTER_UNATTACHED, booked=booked
+        )
+        ticket = GlobalTicket(
+            rid=rid,
+            tenant=tenant,
+            check=check,
+            cluster=cluster,
+            outcome=OUTCOME_REFUSED,
+            matched=route.matched,
+            reason=refusal.reason,
+        )
+        self._book(booked, ticket)
+        return ticket
+
+    # -- accounting ------------------------------------------------------
+    def _book(self, booked: str, ticket: GlobalTicket) -> None:
+        cell = self._cells.setdefault(booked, {}).setdefault(
+            ticket.cluster, _Cell()
+        )
+        cell.submitted += 1
+        if ticket.outcome == OUTCOME_HIT:
+            cell.cache_hits += 1
+        elif ticket.outcome == OUTCOME_JOINED:
+            cell.joins += 1
+        elif ticket.outcome == OUTCOME_RUN:
+            cell.runs += 1
+        elif ticket.outcome == OUTCOME_PARKED:
+            cell.parked += 1
+        elif ticket.outcome == OUTCOME_FORWARDED:
+            cell.forwarded += 1
+        else:
+            cell.refused += 1
+            cell.refusals[ticket.reason] = (
+                cell.refusals.get(ticket.reason, 0) + 1
+            )
+        if self.metrics is not None:
+            self.metrics.record_federation_request(
+                ticket.cluster, ticket.outcome
+            )
+            if ticket.outcome == OUTCOME_REFUSED:
+                self.metrics.record_federation_refusal(booked, ticket.reason)
+
+    def conservation(self) -> dict:
+        """The federation-level conservation table: per (tenant,
+        cluster) cell
+
+            submitted == cache_hits + joins + runs + parked
+                         + refused + forwarded
+
+        exactly, the per-tenant rows sum their cells, AND the summed
+        outcome ledger must agree with the global admission
+        controller's independent event-time ledger (submitted ==
+        admitted + pre-admission refusals; admitted == non-refused
+        outcomes + post-admission refusals) — so a routing bug cannot
+        hide demand between clusters, and a quota bug cannot hide
+        behind balanced per-cluster books."""
+        tenants = sorted(
+            set(self._cells)
+            | set(self.admission.admitted)
+            | set(self.admission.refused)
+        )
+        rows: Dict[str, dict] = {}
+        all_ok = True
+        for tenant in tenants:
+            cells = self._cells.get(tenant, {})
+            clusters = {
+                cluster: cells[cluster].to_dict()
+                for cluster in sorted(cells)
+            }
+            total = _Cell()
+            for cell in cells.values():
+                total.submitted += cell.submitted
+                total.cache_hits += cell.cache_hits
+                total.joins += cell.joins
+                total.runs += cell.runs
+                total.parked += cell.parked
+                total.refused += cell.refused
+                total.forwarded += cell.forwarded
+                for reason, count in cell.refusals.items():
+                    total.refusals[reason] = (
+                        total.refusals.get(reason, 0) + count
+                    )
+            refused_by_reason = self.admission.refused.get(tenant, {})
+            admitted = self.admission.admitted.get(tenant, 0)
+            pre = sum(
+                refused_by_reason.get(r, 0) for r in PRE_ADMISSION_REASONS
+            )
+            post = sum(refused_by_reason.values()) - pre
+            row = total.to_dict()
+            row["clusters"] = clusters
+            row["admitted"] = admitted
+            non_refused = (
+                total.cache_hits
+                + total.joins
+                + total.runs
+                + total.parked
+                + total.forwarded
+            )
+            row["ok"] = (
+                total.submitted == total.outcomes()
+                and all(c["ok"] for c in clusters.values())
+                and total.submitted == admitted + pre
+                and admitted == non_refused + post
+            )
+            all_ok = all_ok and row["ok"]
+            rows[tenant] = row
+        return {
+            "tenants": rows,
+            "submitted": sum(r["submitted"] for r in rows.values()),
+            "refused": sum(r["refused"] for r in rows.values()),
+            "forwarded": sum(r["forwarded"] for r in rows.values()),
+            "ok": all_ok,
+        }
+
+    def snapshot(self) -> dict:
+        """The global door's half of the /statusz federation block."""
+        conservation = self.conservation()
+        per_cluster: Dict[str, Dict[str, int]] = {}
+        for cells in self._cells.values():
+            for cluster, cell in cells.items():
+                agg = per_cluster.setdefault(
+                    cluster,
+                    {
+                        "submitted": 0,
+                        "cache_hits": 0,
+                        "coalesced_joins": 0,
+                        "probe_runs": 0,
+                        "parked": 0,
+                        "refused": 0,
+                        "forwarded": 0,
+                    },
+                )
+                agg["submitted"] += cell.submitted
+                agg["cache_hits"] += cell.cache_hits
+                agg["coalesced_joins"] += cell.joins
+                agg["probe_runs"] += cell.runs
+                agg["parked"] += cell.parked
+                agg["refused"] += cell.refused
+                agg["forwarded"] += cell.forwarded
+        return {
+            "attached": sorted(self._doors),
+            "forwarders": sorted(self._forwarders),
+            "conservation_ok": conservation["ok"],
+            "requests": {
+                "submitted": conservation["submitted"],
+                "refused": conservation["refused"],
+                "forwarded": conservation["forwarded"],
+            },
+            "per_cluster": {
+                cluster: per_cluster[cluster]
+                for cluster in sorted(per_cluster)
+            },
+            "tenants": {
+                tenant: {
+                    "submitted": row["submitted"],
+                    "refused": row["refused"],
+                    "forwarded": row["forwarded"],
+                    "refusals": row["refusals"],
+                    "ok": row["ok"],
+                }
+                for tenant, row in conservation["tenants"].items()
+            },
+        }

@@ -1,0 +1,154 @@
+"""The federation plane: the manager-facing façade over the pieces.
+
+One object the Manager wires (``--federation-config``) and the
+FleetStatus reads: it owns the cluster registry, the capability
+router, and (optionally) the global front door, drives the poll/sweep
+cadence from the manager's goodput loop, and serves the ``/statusz``
+``federation`` block plus the pinned ``healthcheck_federation_*``
+gauges.
+
+Config is a plain YAML/JSON document (see ``examples/federation/``)::
+
+    liveness_seconds: 90
+    clusters:
+      - name: us-east1-v5p
+        url: http://us-east1.monitor:8080
+        device_kind: TPU v5p
+        chips: 64
+        topology: 4x4x4
+        slices: [train-pod-a]
+        dcn_gbps: 25
+
+Transport stays OUT of this package: :attr:`FederationPlane.fetch` is
+an async hook the manager wires to its aiohttp fetch (tests wire a
+stub), so the whole plane runs under a FakeClock with no sockets.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Awaitable, Callable, Optional
+
+from activemonitor_tpu.federation.registry import (
+    DEFAULT_LIVENESS_SECONDS,
+    ClusterDescriptor,
+    ClusterRegistry,
+)
+from activemonitor_tpu.federation.rollup import federate_statusz
+from activemonitor_tpu.federation.routing import CapabilityRouter
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.federation")
+
+
+class FederationPlane:
+    """Registry + router + (optional) global door, as one wired unit."""
+
+    def __init__(
+        self,
+        registry: ClusterRegistry,
+        router: CapabilityRouter,
+        door=None,  # GlobalFrontDoor (optional: registry-only planes)
+    ):
+        self.registry = registry
+        self.router = router
+        self.door = door
+        # async url -> payload hook, wired by the Manager (aiohttp) or
+        # a test stub; None disables polling (observe() fed directly)
+        self.fetch: Optional[Callable[[str], Awaitable[Optional[dict]]]] = None
+
+    @classmethod
+    def from_config(
+        cls,
+        doc: dict,
+        *,
+        clock: Optional[Clock] = None,
+        metrics=None,
+        flightrec=None,
+        door=None,
+    ) -> "FederationPlane":
+        """Build a plane from the ``--federation-config`` document:
+        every entry under ``clusters`` becomes a descriptor (capability
+        card derived from its ``device_kind`` via the rated tables) and
+        joins the registry immediately."""
+        doc = doc or {}
+        registry = ClusterRegistry(
+            clock=clock,
+            liveness_seconds=float(
+                doc.get("liveness_seconds") or DEFAULT_LIVENESS_SECONDS
+            ),
+            metrics=metrics,
+            flightrec=flightrec,
+        )
+        for entry in doc.get("clusters") or []:
+            registry.join(
+                ClusterDescriptor.build(
+                    str(entry.get("name") or ""),
+                    url=str(entry.get("url") or ""),
+                    device_kind=str(entry.get("device_kind") or ""),
+                    chips=int(entry.get("chips") or 0),
+                    topology=str(entry.get("topology") or ""),
+                    slices=entry.get("slices") or (),
+                    dcn_gbps=float(entry.get("dcn_gbps") or 0.0),
+                )
+            )
+        router = CapabilityRouter(registry, metrics=metrics)
+        return cls(registry, router, door=door)
+
+    # -- the poll/sweep cadence (manager's goodput loop) -----------------
+    async def poll(self) -> int:
+        """One federation round: fetch every url'd cluster's /statusz
+        into the registry (movement judges liveness), then sweep and
+        refresh the gauges. A failed fetch is just absence of movement
+        — the liveness window, not the error, decides health. Returns
+        how many polls landed a payload."""
+        landed = 0
+        if self.fetch is not None:
+            for descriptor in [
+                self.registry.get(name) for name in self.registry.names()
+            ]:
+                if descriptor is None or not descriptor.url:
+                    continue
+                try:
+                    payload = await self.fetch(descriptor.url)
+                except Exception:
+                    log.exception(
+                        "federation poll failed for %s", descriptor.name
+                    )
+                    payload = None
+                if isinstance(payload, dict):
+                    self.registry.observe(descriptor.name, payload)
+                    landed += 1
+        self.sweep()
+        return landed
+
+    def sweep(self) -> None:
+        """Liveness judgment + gauge refresh (also callable standalone
+        for in-process clusters that feed ``registry.observe``
+        directly)."""
+        self.registry.sweep()
+        self.registry.export_metrics()
+        if self.registry.metrics is not None:
+            try:
+                ratio = self.federated()["fleet"]["goodput_ratio"]
+                if ratio is not None:
+                    self.registry.metrics.set_federation_goodput(ratio)
+            except Exception:
+                log.exception("federation goodput export failed")
+
+    # -- reading ---------------------------------------------------------
+    def federated(self) -> dict:
+        """The federation-level rollup over every cluster's latest
+        observed payload (two-level merge: each payload is already a
+        replica payload or a per-cluster rollup)."""
+        return federate_statusz(self.registry.payloads())
+
+    def snapshot(self) -> dict:
+        """The ``/statusz`` ``federation`` block: registry states plus
+        the global door's ledger summary (None-door planes report
+        door: null)."""
+        snap = {
+            "registry": self.registry.snapshot(),
+            "door": self.door.snapshot() if self.door is not None else None,
+        }
+        return snap

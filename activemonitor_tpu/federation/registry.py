@@ -1,0 +1,311 @@
+"""Cluster registry: capability descriptors + movement-judged health.
+
+Each member cluster is described by a :class:`ClusterDescriptor` —
+generation and rated figures derived from the ``probes/rated.py``
+tables (one source of truth with the probes' fraction-of-rated
+denominators) plus the deployment facts no table can know: chip count,
+mesh topology, the slices it owns, and a per-host ``dcn_gbps``
+override for fleets that know their NICs.
+
+Health is judged the way sharding's member leases are: by
+LOCALLY-OBSERVED movement, never by the remote's own wall-clock
+stamps. Every ``/statusz`` poll lands in :meth:`ClusterRegistry.
+observe`; a payload whose ``fleet.generated_at`` differs from the last
+one seen is movement, stamped on OUR monotonic clock. A cluster whose
+payload stops moving for ``liveness_seconds`` is unhealthy —
+a skewed remote clock can neither fake liveness nor fake death.
+
+Transitions (join / leave / unhealthy / recovered) each fire exactly
+ONE flight-recorder bundle (state-change gated, so a cluster that
+stays dark does not re-fire every sweep) and one
+``healthcheck_federation_transitions_total`` increment.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from activemonitor_tpu.probes.rated import capability_summary
+from activemonitor_tpu.utils.clock import Clock
+
+log = logging.getLogger("activemonitor.federation")
+
+STATE_HEALTHY = "healthy"
+STATE_UNHEALTHY = "unhealthy"
+
+# flight-bundle kinds (one bundle per transition, exactly once)
+KIND_CLUSTER_JOIN = "cluster-join"
+KIND_CLUSTER_LEAVE = "cluster-leave"
+KIND_CLUSTER_UNHEALTHY = "cluster-unhealthy"
+KIND_CLUSTER_RECOVERED = "cluster-recovered"
+
+# a cluster whose /statusz stops moving for this long is unhealthy —
+# deliberately longer than sharding's lease window (15 s): cross-
+# cluster polls ride WAN links and the goodput-loop cadence (30 s)
+DEFAULT_LIVENESS_SECONDS = 90.0
+
+
+@dataclass(frozen=True)
+class ClusterDescriptor:
+    """One cluster's capability card, as the router and ``am-tpu
+    clusters`` see it. ``capability`` carries the rated figures
+    (:func:`~activemonitor_tpu.probes.rated.capability_summary`) for
+    the declared ``device_kind``; empty for unknown hardware."""
+
+    name: str
+    url: str = ""  # /statusz endpoint; "" = in-process (tests, co-hosted)
+    device_kind: str = ""  # jax device_kind string, e.g. "TPU v5p"
+    generation: str = ""  # rated-table generation, e.g. "v5p"
+    chips: int = 0
+    topology: str = ""  # mesh shape, e.g. "4x4" / "2x2x2"
+    slices: Tuple[str, ...] = ()
+    dcn_gbps: float = 0.0  # per-host, one direction
+    capability: dict = field(default_factory=dict)
+
+    @classmethod
+    def build(
+        cls,
+        name: str,
+        *,
+        url: str = "",
+        device_kind: str = "",
+        chips: int = 0,
+        topology: str = "",
+        slices=(),
+        dcn_gbps: float = 0.0,
+    ) -> "ClusterDescriptor":
+        """Derive the capability card from the rated tables: generation
+        and dcn tier come from ``capability_summary(device_kind)`` (env
+        overrides flow through), with the explicit ``dcn_gbps`` winning
+        when the deployment declares its own NIC provisioning."""
+        cap = capability_summary(device_kind) or {}
+        return cls(
+            name=str(name),
+            url=str(url),
+            device_kind=str(device_kind),
+            generation=str(cap.get("generation") or ""),
+            chips=max(0, int(chips)),
+            topology=str(topology),
+            slices=tuple(str(s) for s in slices),
+            dcn_gbps=(
+                float(dcn_gbps)
+                if float(dcn_gbps) > 0
+                else float(cap.get("dcn_gbps") or 0.0)
+            ),
+            capability=cap,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "device_kind": self.device_kind,
+            "generation": self.generation,
+            "chips": self.chips,
+            "topology": self.topology,
+            "slices": list(self.slices),
+            "dcn_gbps": self.dcn_gbps,
+            "capability": dict(self.capability),
+        }
+
+
+class _Member:
+    """One cluster's mutable liveness record."""
+
+    __slots__ = (
+        "descriptor",
+        "state",
+        "last_generated_at",
+        "last_movement",
+        "payload",
+        "transitions",
+    )
+
+    def __init__(self, descriptor: ClusterDescriptor, joined_mono: float):
+        self.descriptor = descriptor
+        self.state = STATE_HEALTHY
+        # the last fleet.generated_at seen — REMOTE data used only for
+        # inequality (movement), never compared against our clock
+        self.last_generated_at = ""
+        # OUR monotonic stamp of the last observed movement; join time
+        # seeds it so a fresh member gets a full liveness window before
+        # the first poll can land
+        self.last_movement = joined_mono
+        self.payload: Optional[dict] = None  # latest observed /statusz
+        self.transitions = 0
+
+
+class ClusterRegistry:
+    """The federation's membership + liveness table (single-owner on
+    the event loop, like the manager's queue sets)."""
+
+    def __init__(
+        self,
+        *,
+        clock: Optional[Clock] = None,
+        liveness_seconds: float = DEFAULT_LIVENESS_SECONDS,
+        metrics=None,  # MetricsCollector (duck-typed; optional)
+        flightrec=None,  # FlightRecorder (duck-typed; optional)
+    ):
+        self.clock = clock or Clock()
+        self.liveness_seconds = max(1.0, float(liveness_seconds))
+        self.metrics = metrics
+        self.flightrec = flightrec
+        self._members: Dict[str, _Member] = {}
+
+    # -- membership ------------------------------------------------------
+    def join(self, descriptor: ClusterDescriptor) -> None:
+        """Register (or re-register) a cluster, healthy until its
+        liveness window passes with no observed movement."""
+        member = _Member(descriptor, self.clock.monotonic())
+        self._members[descriptor.name] = member
+        self._transition(member, KIND_CLUSTER_JOIN)
+
+    def leave(self, name: str) -> None:
+        """Drop a cluster from the federation (operator action — an
+        unhealthy cluster stays listed so its absence is visible)."""
+        member = self._members.pop(name, None)
+        if member is None:
+            return
+        self._transition(member, KIND_CLUSTER_LEAVE)
+
+    # -- liveness --------------------------------------------------------
+    def observe(self, name: str, payload: dict) -> bool:
+        """One ``/statusz`` poll landed for ``name``. Movement — a
+        ``fleet.generated_at`` different from the last one seen — is
+        stamped on the local monotonic clock and recovers an unhealthy
+        cluster (firing one ``cluster-recovered`` bundle). Returns
+        whether the poll counted as movement."""
+        member = self._members.get(name)
+        if member is None:
+            return False
+        member.payload = payload
+        stamp = str(((payload or {}).get("fleet") or {}).get("generated_at") or "")
+        if not stamp or stamp == member.last_generated_at:
+            return False
+        member.last_generated_at = stamp
+        member.last_movement = self.clock.monotonic()
+        if member.state == STATE_UNHEALTHY:
+            member.state = STATE_HEALTHY
+            self._transition(member, KIND_CLUSTER_RECOVERED)
+        return True
+
+    def sweep(self) -> List[Tuple[str, str]]:
+        """Judge liveness: any healthy cluster whose observed movement
+        is older than the liveness window transitions to unhealthy,
+        firing exactly one ``cluster-unhealthy`` bundle (the state gate
+        — not a cooldown — is what makes repeat sweeps quiet). Returns
+        the ``(name, kind)`` transitions this sweep produced."""
+        now = self.clock.monotonic()
+        transitions: List[Tuple[str, str]] = []
+        for member in self._members.values():
+            if (
+                member.state == STATE_HEALTHY
+                and now - member.last_movement >= self.liveness_seconds
+            ):
+                member.state = STATE_UNHEALTHY
+                self._transition(member, KIND_CLUSTER_UNHEALTHY)
+                transitions.append((member.descriptor.name, KIND_CLUSTER_UNHEALTHY))
+        return transitions
+
+    # -- reading ---------------------------------------------------------
+    def healthy(self) -> List[ClusterDescriptor]:
+        """Healthy clusters, name-sorted (the router's candidate list —
+        deterministic order so routing is reproducible)."""
+        return [
+            m.descriptor
+            for _name, m in sorted(self._members.items())
+            if m.state == STATE_HEALTHY
+        ]
+
+    def get(self, name: str) -> Optional[ClusterDescriptor]:
+        member = self._members.get(name)
+        return member.descriptor if member is not None else None
+
+    def state(self, name: str) -> str:
+        member = self._members.get(name)
+        return member.state if member is not None else ""
+
+    def names(self) -> List[str]:
+        return sorted(self._members)
+
+    def payloads(self) -> Dict[str, dict]:
+        """Latest observed ``/statusz`` payload per cluster (unhealthy
+        clusters included — their last evidence still merges into the
+        federated rollup, flagged by the clusters block's state)."""
+        return {
+            name: m.payload
+            for name, m in sorted(self._members.items())
+            if m.payload is not None
+        }
+
+    def snapshot(self) -> dict:
+        """The registry half of the ``/statusz`` federation block."""
+        now = self.clock.monotonic()
+        healthy = unhealthy = 0
+        clusters = {}
+        for name, member in sorted(self._members.items()):
+            if member.state == STATE_HEALTHY:
+                healthy += 1
+            else:
+                unhealthy += 1
+            d = member.descriptor
+            clusters[name] = {
+                "state": member.state,
+                "url": d.url,
+                "generation": d.generation,
+                "chips": d.chips,
+                "topology": d.topology,
+                "slices": list(d.slices),
+                "dcn_gbps": d.dcn_gbps,
+                "generated_at": member.last_generated_at,
+                "movement_age_seconds": max(0.0, now - member.last_movement),
+                "transitions": member.transitions,
+            }
+        return {
+            "liveness_seconds": self.liveness_seconds,
+            "healthy": healthy,
+            "unhealthy": unhealthy,
+            "clusters": clusters,
+        }
+
+    def export_metrics(self) -> None:
+        """Refresh the registry gauges (cluster counts by state, the
+        per-cluster health bit). Driven by the plane's sweep; a
+        registry without a collector is a no-op."""
+        if self.metrics is None:
+            return
+        snap = self.snapshot()
+        self.metrics.set_federation_clusters(snap["healthy"], snap["unhealthy"])
+        for name, row in snap["clusters"].items():
+            self.metrics.set_federation_cluster_health(
+                name, row["state"] == STATE_HEALTHY
+            )
+
+    # -- internals -------------------------------------------------------
+    def _transition(self, member: _Member, kind: str) -> None:
+        """Book one membership/health transition: counted, metered, and
+        flight-recorded with the capability card and liveness evidence
+        of the moment. Never raises into the sweep/poll that drove it
+        (the recorder's own contract plus a guard for hostile ducks)."""
+        member.transitions += 1
+        name = member.descriptor.name
+        log.warning("federation cluster %s: %s", name, kind)
+        if self.metrics is not None:
+            try:
+                self.metrics.record_federation_transition(name, kind)
+            except Exception:
+                log.exception("federation transition metric failed")
+        if self.flightrec is not None:
+            try:
+                self.flightrec.record(
+                    kind,
+                    cluster=name,
+                    state=member.state,
+                    descriptor=member.descriptor.to_dict(),
+                    last_generated_at=member.last_generated_at,
+                )
+            except Exception:
+                log.exception("federation flight bundle failed for %s", name)

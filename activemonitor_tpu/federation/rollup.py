@@ -1,0 +1,87 @@
+"""The federated rollup: replicas → cluster → federation.
+
+``obs/slo.rollup_statusz`` merges sharded REPLICAS of one cluster;
+this module applies the SAME merge math one level up, over whole
+clusters, through the shared :func:`~activemonitor_tpu.obs.slo.
+merge_blocks` seam — one implementation of the run-weighted goodput
+mean, the attribution merge, the lookup-weighted front-door ratios,
+and the critical-path skew fallback, so the two levels can never
+disagree about what a number means.
+
+Conservation survives the second level for free: a cluster serving an
+OLD-BINARY payload (no ``goodput`` attribution block — a whole cluster
+mid rolling update, not just a replica) has its entire lost share
+folded into ``unknown`` by the same ``merge_goodput_blocks`` rule PR 7
+proved across replicas, so the federation's per-bucket ratios still
+sum to ``1 - goodput_ratio`` exactly.
+
+Checks concatenate and dedupe first-seen by key, annotated with the
+cluster that reported them — the capability router lands each check on
+exactly one cluster, so a collision is the same transient
+double-report the replica-level dedupe already absorbs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+from activemonitor_tpu.obs import slo
+
+
+def federate_statusz(cluster_payloads: Mapping[str, dict]) -> dict:
+    """Merge per-cluster ``/statusz`` payloads (each itself a replica
+    payload or a :func:`~activemonitor_tpu.obs.slo.rollup_statusz`
+    output) into ONE federation view, keyed by cluster name. The fleet
+    block mirrors the rollup's schema plus ``clusters`` /
+    ``per_cluster``; each merged check entry gains a ``cluster`` field
+    naming the cluster that reported it."""
+    names = sorted(cluster_payloads)
+    payloads = [cluster_payloads[name] for name in names]
+    shared = slo.merge_blocks(payloads, level=slo.MERGE_LEVEL_CLUSTER)
+    merged: Dict[str, dict] = {}
+    per_cluster: Dict[str, dict] = {}
+    for name, payload in zip(names, payloads):
+        fleet = payload.get("fleet") or {}
+        per_cluster[name] = {
+            "replicas": int(fleet.get("replicas") or 1),
+            "checks": len(payload.get("checks") or []),
+            "window_runs": int(fleet.get("window_runs") or 0),
+            "goodput_ratio": fleet.get("goodput_ratio"),
+            "degraded": bool(fleet.get("degraded")),
+            "generated_at": str(fleet.get("generated_at") or ""),
+            # an old binary ships no attribution block: its lost share
+            # lands under `unknown` in the merged goodput above — flag
+            # the skew here so the dashboard can say WHICH cluster
+            "skewed": not isinstance(fleet.get("goodput"), dict),
+        }
+        for entry in payload.get("checks") or []:
+            key = entry.get("key", "")
+            if key not in merged:
+                tagged = dict(entry)
+                tagged["cluster"] = name
+                merged[key] = tagged
+    entries = [merged[key] for key in sorted(merged)]
+    agg = slo.aggregate_entries(entries)
+    return {
+        "fleet": {
+            "clusters": len(payloads),
+            "replicas": shared["replicas"],
+            "checks": len(entries),
+            "window_runs": agg["window_runs"],
+            "goodput_ratio": shared["goodput_ratio"],
+            "goodput": shared["goodput"],
+            "generated_at": shared["generated_at"],
+            "degraded": shared["degraded"],
+            "breaker": shared["breaker"],
+            "status_writes_queued": shared["status_writes_queued"],
+            "remedy_tokens": shared["remedy_tokens"],
+            "anomalies": agg["anomalies"],
+            "matrix": shared["matrix"],
+            "frontdoor": shared["frontdoor"],
+            "adaptive": shared["adaptive"],
+            "journal": shared["journal"],
+            "critical_path": shared["critical_path"],
+            "per_cluster": per_cluster,
+        },
+        "checks": entries,
+    }

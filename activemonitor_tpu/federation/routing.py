@@ -1,0 +1,207 @@
+"""Capability-aware routing: which cluster should run this check?
+
+Decision precedence, strictest claim first:
+
+1. **Slice ownership** — a check targeting a named slice lands on the
+   HEALTHY cluster that declares that slice. An unhealthy owner falls
+   through (this is the reroute path: when a cluster goes dark its
+   slice-pinned checks start matching by capability instead).
+2. **Capability match** — among healthy clusters satisfying every
+   declared requirement (generation equality, chips >= what the mesh
+   shape needs, dcn tier), pick the TIGHTEST fit: the fewest chips,
+   name as the tiebreak. Tightest-fit keeps the big pods free for the
+   checks that actually need them — the same bin-packing instinct as
+   the paper's goodput argument (idle v5p is badness you paid for).
+3. **Default spread** — no requirements at all: a stable hash of the
+   routing key over the healthy set, so repeat submissions of one
+   check land on one cluster (cache/coalescing locality at the global
+   door) without any cluster owning the unclaimed traffic.
+
+No healthy cluster can satisfy the requirements -> a structured
+``no_capable_cluster`` refusal (decision, not exception): the global
+front door books it in the tenant's refused ledger and the caller gets
+the machine-readable why.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional
+
+from activemonitor_tpu.federation.registry import ClusterDescriptor, ClusterRegistry
+
+NO_CAPABLE_CLUSTER = "no_capable_cluster"
+
+
+def _chips_in(topology: str) -> int:
+    """Chips implied by a "4x4" / "2x2x4"-style mesh shape (product of
+    the axis sizes); 0 for empty/malformed shapes — a requirement that
+    cannot be parsed must not silently match everything big."""
+    text = str(topology).strip().lower()
+    if not text:
+        return 0
+    total = 1
+    for part in text.split("x"):
+        try:
+            dim = int(part.strip())
+        except ValueError:
+            return 0
+        if dim <= 0:
+            return 0
+        total *= dim
+    return total
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """What a check declares it needs from a cluster (all fields
+    optional; an empty Requirement routes by default spread)."""
+
+    generation: str = ""  # rated-table generation, e.g. "v5p"
+    topology: str = ""  # mesh shape the check wants, e.g. "4x4"
+    min_chips: int = 0
+    min_dcn_gbps: float = 0.0
+    slice_name: str = ""  # pin to the cluster owning this slice
+
+    @classmethod
+    def from_spec(cls, spec) -> "Requirement":
+        """Build from an api.types.RequiresSpec (or any duck with the
+        same fields); None -> the empty requirement."""
+        if spec is None:
+            return cls()
+        return cls(
+            generation=str(getattr(spec, "generation", "") or ""),
+            topology=str(getattr(spec, "topology", "") or ""),
+            min_chips=int(getattr(spec, "min_chips", 0) or 0),
+            min_dcn_gbps=float(getattr(spec, "min_dcn_gbps", 0.0) or 0.0),
+            slice_name=str(getattr(spec, "slice_name", "") or ""),
+        )
+
+    def chips_needed(self) -> int:
+        """The chip floor: the declared mesh shape's footprint or the
+        explicit min_chips, whichever is larger."""
+        return max(self.min_chips, _chips_in(self.topology))
+
+    def empty(self) -> bool:
+        return not (
+            self.generation
+            or self.topology
+            or self.min_chips
+            or self.min_dcn_gbps
+            or self.slice_name
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "topology": self.topology,
+            "min_chips": self.min_chips,
+            "min_dcn_gbps": self.min_dcn_gbps,
+            "slice_name": self.slice_name,
+        }
+
+
+@dataclass(frozen=True)
+class RouteDecision:
+    """The routing verdict: either a cluster plus how it was matched
+    (``slice`` / ``capability`` / ``default``), or a structured refusal
+    with a human-readable ``why``."""
+
+    routed: bool
+    cluster: str = ""
+    matched: str = ""  # slice | capability | default
+    reason: str = ""  # refusal code (NO_CAPABLE_CLUSTER) when not routed
+    why: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "routed": self.routed,
+            "cluster": self.cluster,
+            "matched": self.matched,
+            "reason": self.reason,
+            "why": self.why,
+        }
+
+
+MATCHED_SLICE = "slice"
+MATCHED_CAPABILITY = "capability"
+MATCHED_DEFAULT = "default"
+
+
+class CapabilityRouter:
+    """Routes checks over the registry's healthy set. Stateless beyond
+    the registry reference — every decision re-reads health, so a
+    cluster going unhealthy between submissions reroutes automatically."""
+
+    def __init__(self, registry: ClusterRegistry, *, metrics=None):
+        self.registry = registry
+        self.metrics = metrics
+
+    def route(self, key: str, requirement: Optional[Requirement] = None) -> RouteDecision:
+        """Decide where ``key`` (the routing identity — check name or
+        coalescing key) should run given its declared requirement."""
+        req = requirement or Requirement()
+        healthy = self.registry.healthy()
+        decision = self._decide(key, req, healthy)
+        if self.metrics is not None:
+            self.metrics.record_federation_route(
+                decision.cluster or "(none)",
+                decision.matched or decision.reason or "(none)",
+            )
+        return decision
+
+    def _decide(
+        self, key: str, req: Requirement, healthy: List[ClusterDescriptor]
+    ) -> RouteDecision:
+        if not healthy:
+            return RouteDecision(
+                routed=False,
+                reason=NO_CAPABLE_CLUSTER,
+                why="no healthy clusters in the federation",
+            )
+
+        # 1. slice ownership, healthy owners only (unhealthy owner
+        # falls through to capability/default — the reroute path)
+        if req.slice_name:
+            for descriptor in healthy:
+                if req.slice_name in descriptor.slices:
+                    return RouteDecision(
+                        routed=True,
+                        cluster=descriptor.name,
+                        matched=MATCHED_SLICE,
+                    )
+
+        # 2. capability filter, tightest fit wins
+        if not req.empty():
+            needed = req.chips_needed()
+            candidates = [
+                d
+                for d in healthy
+                if (not req.generation or d.generation == req.generation)
+                and (needed <= 0 or d.chips >= needed)
+                and (req.min_dcn_gbps <= 0 or d.dcn_gbps >= req.min_dcn_gbps)
+            ]
+            if candidates:
+                best = min(candidates, key=lambda d: (d.chips, d.name))
+                return RouteDecision(
+                    routed=True, cluster=best.name, matched=MATCHED_CAPABILITY
+                )
+            return RouteDecision(
+                routed=False,
+                reason=NO_CAPABLE_CLUSTER,
+                why=(
+                    "no healthy cluster matches requirement "
+                    f"{req.to_dict()} (healthy: "
+                    f"{[d.name for d in healthy]})"
+                ),
+            )
+
+        # 3. default spread: stable hash over the healthy set so one
+        # key keeps landing on one cluster (global-door coalescing
+        # locality) while unclaimed traffic still spreads
+        digest = hashlib.sha1(str(key).encode("utf-8", "replace")).digest()
+        index = int.from_bytes(digest[:8], "big") % len(healthy)
+        return RouteDecision(
+            routed=True, cluster=healthy[index].name, matched=MATCHED_DEFAULT
+        )

@@ -732,6 +732,73 @@ class MetricsCollector:
             registry=self.registry,
         )
         self._adaptive_cadence_series: set = set()
+        # -- federation families (federation/ is the single writer;
+        # docs/observability.md "Federation"). Cluster cardinality is
+        # the registry config (a handful of clusters, operator-bounded);
+        # tenant labels carry the BOOKED name, bounded by the global
+        # admission config like the frontdoor families above.
+        self.federation_clusters = Gauge(
+            "healthcheck_federation_clusters",
+            "Clusters currently in the federation registry, by health "
+            "state (healthy / unhealthy — judged by locally-observed "
+            "/statusz movement, never remote wall-clock stamps)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.federation_cluster_healthy = Gauge(
+            "healthcheck_federation_cluster_healthy",
+            "Whether the named cluster's /statusz is still moving "
+            "within the liveness window (1/0) — the bit the capability "
+            "router keys rerouting on",
+            ["cluster"],
+            registry=self.registry,
+        )
+        self.federation_transitions = Counter(
+            "healthcheck_federation_transitions_total",
+            "Cluster membership/health transitions (cluster-join / "
+            "cluster-leave / cluster-unhealthy / cluster-recovered) — "
+            "each increment has a matching flight-recorder bundle",
+            ["cluster", "kind"],
+            registry=self.registry,
+        )
+        self.federation_requests = Counter(
+            "healthcheck_federation_requests_total",
+            "Global front-door submissions by chosen cluster and "
+            "outcome (cache_hit / joined / run / parked / refused / "
+            "forwarded) — the conservation ledger's columns, one level "
+            "above the per-cluster frontdoor families",
+            ["cluster", "outcome"],
+            registry=self.registry,
+        )
+        self.federation_refusals = Counter(
+            "healthcheck_federation_refusals_total",
+            "Global front-door structured refusals by booked tenant "
+            "and reason (quota / unknown_tenant / no_capable_cluster / "
+            "cluster_unattached / the per-cluster door's reasons)",
+            ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.federation_routes = Counter(
+            "healthcheck_federation_routes_total",
+            "Capability-routing decisions by chosen cluster and match "
+            "kind (slice / capability / default; (none) with "
+            "no_capable_cluster when nothing healthy qualifies)",
+            ["cluster", "matched"],
+            registry=self.registry,
+        )
+        self.federation_goodput_ratio = Gauge(
+            "healthcheck_federation_goodput_ratio",
+            "Run-weighted goodput ratio over every cluster's latest "
+            "observed /statusz — the federation-level twin of "
+            "healthcheck_fleet_goodput_ratio, conserving attribution "
+            "across clusters exactly as the rollup does across replicas",
+            registry=self.registry,
+        )
+        # children pre-resolved for the registry's sweep-time refresh
+        self._federation_clusters = {
+            state: self.federation_clusters.labels(state)
+            for state in ("healthy", "unhealthy")
+        }
 
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
@@ -1199,6 +1266,31 @@ class MetricsCollector:
 
     def record_frontdoor_clamp(self, tenant: str, mode: str) -> None:
         self.frontdoor_clamps.labels(tenant, mode).inc()
+
+    # -- federation (federation/ is the single writer) -----------------
+    def set_federation_clusters(self, healthy: int, unhealthy: int) -> None:
+        self._federation_clusters["healthy"].set(healthy)
+        self._federation_clusters["unhealthy"].set(unhealthy)
+
+    def set_federation_cluster_health(self, cluster: str, healthy: bool) -> None:
+        self.federation_cluster_healthy.labels(cluster).set(
+            1.0 if healthy else 0.0
+        )
+
+    def record_federation_transition(self, cluster: str, kind: str) -> None:
+        self.federation_transitions.labels(cluster, kind).inc()
+
+    def record_federation_request(self, cluster: str, outcome: str) -> None:
+        self.federation_requests.labels(cluster, outcome).inc()
+
+    def record_federation_refusal(self, tenant: str, reason: str) -> None:
+        self.federation_refusals.labels(tenant, reason).inc()
+
+    def record_federation_route(self, cluster: str, matched: str) -> None:
+        self.federation_routes.labels(cluster, matched).inc()
+
+    def set_federation_goodput(self, ratio: float) -> None:
+        self.federation_goodput_ratio.set(float(ratio))
 
     # -- dynamic custom metrics ---------------------------------------
     # recorded-run memory bound: at one run a second this is ~34 min of

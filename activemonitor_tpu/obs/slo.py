@@ -241,6 +241,11 @@ class FleetStatus:
         # the record path and serving the /statusz adaptive blocks.
         # None (standalone) — no adaptation, adaptive: null.
         self.adaptive = None
+        # wired by the manager (--federation-config): the federation
+        # plane (federation/plane.py) whose cluster-registry / routing /
+        # global-door snapshot rides the fleet block. None (single
+        # cluster) reports federation: null.
+        self.federation = None
         # generated_at of the last round exported to the gauges, so the
         # rollup loop re-serving an unchanged sidecar never
         # double-counts the bisect counter
@@ -707,6 +712,11 @@ class FleetStatus:
                 # table, per-stream appended/replayed counts, lag;
                 # null when no --journal-dir is wired
                 "journal": self.check_journal(),
+                # multi-cluster federation (federation/plane.py):
+                # cluster registry states, routing, and the global
+                # front-door ledger; null when this controller is not
+                # federating (--federation-config unset)
+                "federation": self.check_federation(),
                 # fleet critical-path rollup (obs/criticalpath.py):
                 # run-weighted merge of the per-check blocks above —
                 # "where do this replica's milliseconds go"; null until
@@ -727,6 +737,17 @@ class FleetStatus:
             return self.frontdoor.snapshot()
         except Exception:
             log.exception("frontdoor snapshot failed")
+            return None
+
+    def check_federation(self) -> Optional[dict]:
+        """The federation plane's snapshot, or None (not federating / a
+        snapshot error — observability must not fail the payload)."""
+        if self.federation is None:
+            return None
+        try:
+            return self.federation.snapshot()
+        except Exception:
+            log.exception("federation snapshot failed")
             return None
 
     def check_adaptive(self) -> Optional[dict]:
@@ -855,6 +876,134 @@ def shard_sort_key(shard) -> int:
         return -1
 
 
+MERGE_LEVEL_REPLICA = "replica"
+MERGE_LEVEL_CLUSTER = "cluster"
+
+
+def merge_blocks(
+    payloads: Sequence[dict], *, level: str = MERGE_LEVEL_REPLICA
+) -> dict:
+    """The level-agnostic half of the ``/statusz`` merge: every fleet
+    field whose math is the same whether the inputs are sharded
+    REPLICAS of one cluster or whole CLUSTERS of a federation. One seam
+    so the cluster-level merge (``federation/rollup.py``) reuses the
+    lookup-weighted front-door ratios, the run-weighted goodput /
+    attribution merge, and the skew fallbacks instead of duplicating
+    them — :func:`rollup_statusz` keeps only the genuinely
+    replica-shaped parts (check dedupe, shard ownership).
+
+    ``level`` is echoed back and picks the meaning of ``replicas``: at
+    replica level each payload IS one replica; at cluster level each
+    payload is already a rollup carrying its own ``replicas`` count, so
+    the federation total sums them (a payload without the count — an
+    old binary — counts as one).
+
+    Merge rules, identical at both levels:
+
+    - ``goodput_ratio``: run-weighted mean of the inputs' own ratios —
+      the same definition a single /statusz reports, so the number does
+      not change meaning with how many units answered.
+    - ``goodput`` attribution: merged run-weighted; a payload WITHOUT
+      the block (old binary mid rolling update — replica or whole
+      cluster) conserves by landing its whole lost share in `unknown`.
+    - ``breaker``: worst-state-wins (unknown state ranks worst —
+      better to over-alarm than hide a breaker the renderer doesn't
+      recognize); ``degraded`` is any-unit; ``generated_at`` is the
+      newest stamp; ``status_writes_queued`` / ``remedy_tokens`` sum.
+    - ``matrix``: whole-round evidence — the newest round wins, units
+      without a matrix source report null and never displace one.
+    - ``frontdoor`` / ``journal`` / ``adaptive``: the block-wise merges
+      below (counters sum, ratios re-derive lookup-weighted, worst lag,
+      first restore warning).
+    - ``critical_path``: run-weighted merge with the version-skew
+      fallback — a unit serving no block books its windowed runs' whole
+      latency as ``untracked``, never silently dropped.
+    """
+    fleet_blocks: List[dict] = []  # per-unit fleet dicts, for goodput merge
+    replicas = 0
+    degraded = False
+    status_writes_queued = 0
+    window_runs = 0
+    generated_at = ""
+    breaker = None
+    breaker_rank = {"closed": 0, "half-open": 1, "open": 2}
+    remedy_tokens = None
+    matrix_block = None
+    frontdoor_blocks: List[dict] = []
+    journal_blocks: List[dict] = []
+    adaptive_blocks: List[dict] = []
+    critical_path_blocks: List[dict] = []
+    goodput_weighted = goodput_runs = 0.0
+    for payload in payloads:
+        fleet = payload.get("fleet") or {}
+        fleet_blocks.append(fleet)
+        replicas += int(fleet.get("replicas") or 1)
+        unit_ratio = fleet.get("goodput_ratio")
+        unit_runs = int(fleet.get("window_runs") or 0)
+        window_runs += unit_runs
+        if unit_ratio is not None and unit_runs > 0:
+            goodput_weighted += unit_ratio * unit_runs
+            goodput_runs += unit_runs
+        degraded = degraded or bool(fleet.get("degraded"))
+        status_writes_queued += int(fleet.get("status_writes_queued") or 0)
+        generated_at = max(generated_at, str(fleet.get("generated_at") or ""))
+        unit_breaker = fleet.get("breaker")
+        if unit_breaker is not None:
+            rank = breaker_rank.get(str(unit_breaker.get("state")), 3)
+            if breaker is None or rank > breaker_rank.get(
+                str(breaker.get("state")), 3
+            ):
+                breaker = unit_breaker
+        unit_tokens = fleet.get("remedy_tokens")
+        if unit_tokens is not None:
+            # per-unit buckets sum to the merged total remedy budget
+            remedy_tokens = (remedy_tokens or 0.0) + float(unit_tokens)
+        unit_matrix = fleet.get("matrix")
+        if isinstance(unit_matrix, dict) and (
+            matrix_block is None
+            or str(unit_matrix.get("generated_at") or "")
+            > str(matrix_block.get("generated_at") or "")
+        ):
+            matrix_block = unit_matrix
+        unit_frontdoor = fleet.get("frontdoor")
+        if isinstance(unit_frontdoor, dict):
+            frontdoor_blocks.append(unit_frontdoor)
+        unit_journal = fleet.get("journal")
+        if isinstance(unit_journal, dict):
+            journal_blocks.append(unit_journal)
+        unit_adaptive = fleet.get("adaptive")
+        if isinstance(unit_adaptive, dict):
+            adaptive_blocks.append(unit_adaptive)
+        unit_critical_path = fleet.get("critical_path")
+        if not isinstance(unit_critical_path, dict):
+            # version skew: an old binary reports no block (or null) —
+            # book its windowed runs' whole latency as untracked
+            unit_critical_path = criticalpath.skew_block(payload)
+        if unit_critical_path:
+            critical_path_blocks.append(unit_critical_path)
+    return {
+        "level": level,
+        "replicas": replicas,
+        "window_runs": window_runs,
+        "goodput_ratio": (
+            (goodput_weighted / goodput_runs) if goodput_runs else None
+        ),
+        "goodput": attribution.merge_goodput_blocks(fleet_blocks),
+        "generated_at": generated_at,
+        "degraded": degraded,
+        "breaker": breaker,
+        "status_writes_queued": status_writes_queued,
+        "remedy_tokens": remedy_tokens,
+        "matrix": matrix_block,
+        "frontdoor": merge_frontdoor_blocks(frontdoor_blocks),
+        "adaptive": merge_adaptive_blocks(adaptive_blocks),
+        "journal": merge_journal_blocks(journal_blocks),
+        "critical_path": criticalpath.merge_critical_path_blocks(
+            critical_path_blocks
+        ),
+    }
+
+
 def rollup_statusz(payloads: Sequence[dict]) -> dict:
     """Merge per-replica ``/statusz`` payloads into ONE fleet view.
 
@@ -868,73 +1017,25 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
     ``checks_per_shard`` — whose counts sum to the merged check total
     whenever every shard has exactly one owner (the invariant the
     handoff soak pins before and after a kill).
+
+    The level-agnostic fields (goodput + attribution, breaker, matrix,
+    frontdoor/journal/adaptive/critical-path blocks) come from
+    :func:`merge_blocks`, shared with the federation's cluster-level
+    merge; only check dedupe and shard ownership live here, because
+    clusters don't share a shard ring. (During a handoff a briefly
+    double-reported check weighs in twice in the run-weighted goodput,
+    consistent with the summed per-shard counts: the overlap is the
+    signal.)
     """
+    shared = merge_blocks(payloads, level=MERGE_LEVEL_REPLICA)
     merged: Dict[str, dict] = {}
-    fleet_blocks: List[dict] = []  # per-replica fleet dicts, for goodput merge
     owners: Dict[str, str] = {}  # shard id -> owning replica identity
     checks_per_shard: Dict[str, int] = {}
     shards = 0
     saw_sharding = False
-    degraded = False
-    status_writes_queued = 0
     fenced_writes = 0
-    generated_at = ""
-    breaker = None
-    # worst-state-wins: each replica has its own breaker, and the fleet
-    # line reports the one in the most degraded state (an unknown state
-    # string is treated as worst — better to over-alarm than to hide a
-    # breaker the renderer doesn't recognize)
-    breaker_rank = {"closed": 0, "half-open": 1, "open": 2}
-    remedy_tokens = None
-    # the scenario-matrix block is whole-round evidence, not per-check:
-    # the replica reporting the NEWEST round wins (replicas without a
-    # matrix source report null and never displace a real round)
-    matrix_block = None
-    # front-door blocks SUM: each replica's door serves its own slice
-    # of the ingestion traffic, so fleet QPS/requests/refusals are the
-    # totals and the coalescing ratios re-derive lookup-weighted
-    frontdoor_blocks: List[dict] = []
-    # journal blocks SUM their event counters (each replica journals
-    # its own slice), lag is the fleet's worst, and any replica's
-    # restore warning surfaces (first-seen wins)
-    journal_blocks: List[dict] = []
-    # adaptive blocks merge lever-wise: counts sum, engaged is any,
-    # per-check episodes union (first-seen, like the checks array)
-    adaptive_blocks: List[dict] = []
-    # critical-path blocks merge run-weighted; an old-binary replica
-    # that serves no block still has its measured latency merged — its
-    # whole path books under `untracked` via the skew fallback, never
-    # silently dropped from the fleet decomposition
-    critical_path_blocks: List[dict] = []
-    # fleet goodput: the run-weighted mean of the REPLICAS' own ratios,
-    # each derived from its history + declared SLO windows — the same
-    # definition a single /statusz reports, so the number doesn't
-    # change meaning with how many replicas answered. (During a handoff
-    # a briefly double-reported check weighs in twice, consistent with
-    # the summed per-shard counts: the overlap is the signal.)
-    goodput_weighted = goodput_runs = 0.0
     for payload in payloads:
         fleet = payload.get("fleet") or {}
-        fleet_blocks.append(fleet)
-        replica_ratio = fleet.get("goodput_ratio")
-        replica_runs = int(fleet.get("window_runs") or 0)
-        if replica_ratio is not None and replica_runs > 0:
-            goodput_weighted += replica_ratio * replica_runs
-            goodput_runs += replica_runs
-        degraded = degraded or bool(fleet.get("degraded"))
-        status_writes_queued += int(fleet.get("status_writes_queued") or 0)
-        generated_at = max(generated_at, str(fleet.get("generated_at") or ""))
-        replica_breaker = fleet.get("breaker")
-        if replica_breaker is not None:
-            rank = breaker_rank.get(str(replica_breaker.get("state")), 3)
-            if breaker is None or rank > breaker_rank.get(
-                str(breaker.get("state")), 3
-            ):
-                breaker = replica_breaker
-        replica_tokens = fleet.get("remedy_tokens")
-        if replica_tokens is not None:
-            # per-replica buckets sum to the fleet's total remedy budget
-            remedy_tokens = (remedy_tokens or 0.0) + float(replica_tokens)
         sharding = fleet.get("sharding")
         if sharding:
             saw_sharding = True
@@ -951,29 +1052,6 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
                 checks_per_shard[str(shard)] = (
                     checks_per_shard.get(str(shard), 0) + int(count)
                 )
-        replica_matrix = fleet.get("matrix")
-        if isinstance(replica_matrix, dict) and (
-            matrix_block is None
-            or str(replica_matrix.get("generated_at") or "")
-            > str(matrix_block.get("generated_at") or "")
-        ):
-            matrix_block = replica_matrix
-        replica_frontdoor = fleet.get("frontdoor")
-        if isinstance(replica_frontdoor, dict):
-            frontdoor_blocks.append(replica_frontdoor)
-        replica_journal = fleet.get("journal")
-        if isinstance(replica_journal, dict):
-            journal_blocks.append(replica_journal)
-        replica_adaptive = fleet.get("adaptive")
-        if isinstance(replica_adaptive, dict):
-            adaptive_blocks.append(replica_adaptive)
-        replica_critical_path = fleet.get("critical_path")
-        if not isinstance(replica_critical_path, dict):
-            # version skew: an old binary reports no block (or null) —
-            # book its windowed runs' whole latency as untracked
-            replica_critical_path = criticalpath.skew_block(payload)
-        if replica_critical_path:
-            critical_path_blocks.append(replica_critical_path)
         for entry in payload.get("checks") or []:
             key = entry.get("key", "")
             if key not in merged:
@@ -1002,27 +1080,20 @@ def rollup_statusz(payloads: Sequence[dict]) -> dict:
             "replicas": len(payloads),
             "checks": len(entries),
             "window_runs": agg["window_runs"],
-            "goodput_ratio": (
-                (goodput_weighted / goodput_runs) if goodput_runs else None
-            ),
-            # attribution merged run-weighted like the ratio; a replica
-            # payload WITHOUT the block (old binary mid rolling update)
-            # conserves by landing its whole lost share in `unknown`
-            "goodput": attribution.merge_goodput_blocks(fleet_blocks),
-            "generated_at": generated_at,
-            "degraded": degraded,
-            "breaker": breaker,
-            "status_writes_queued": status_writes_queued,
-            "remedy_tokens": remedy_tokens,
+            "goodput_ratio": shared["goodput_ratio"],
+            "goodput": shared["goodput"],
+            "generated_at": shared["generated_at"],
+            "degraded": shared["degraded"],
+            "breaker": shared["breaker"],
+            "status_writes_queued": shared["status_writes_queued"],
+            "remedy_tokens": shared["remedy_tokens"],
             "anomalies": agg["anomalies"],
             "sharding": sharding_block,
-            "matrix": matrix_block,
-            "frontdoor": merge_frontdoor_blocks(frontdoor_blocks),
-            "adaptive": merge_adaptive_blocks(adaptive_blocks),
-            "journal": merge_journal_blocks(journal_blocks),
-            "critical_path": criticalpath.merge_critical_path_blocks(
-                critical_path_blocks
-            ),
+            "matrix": shared["matrix"],
+            "frontdoor": shared["frontdoor"],
+            "adaptive": shared["adaptive"],
+            "journal": shared["journal"],
+            "critical_path": shared["critical_path"],
         },
         "checks": entries,
     }

@@ -149,3 +149,25 @@ def rated_for(device_kind: str) -> Optional[RatedSpec]:
                 dcn_gbps=_override(spec.dcn_gbps, "ACTIVEMONITOR_RATED_DCN_GBPS"),
             )
     return None
+
+
+def capability_summary(device_kind: str) -> Optional[dict]:
+    """The generation's rated figures as one plain dict — the single
+    source of truth behind the federation's cluster capability cards
+    and the ``am-tpu clusters`` table, so they can never drift from the
+    probes' fraction-of-rated denominators. Env overrides flow through
+    (same :func:`_override` validation: malformed / non-positive values
+    warn and fall back). Returns None for unknown/non-TPU hardware."""
+    spec = rated_for(device_kind)
+    if spec is None:
+        return None
+    return {
+        "generation": spec.generation,
+        "bf16_tflops": spec.bf16_tflops,
+        "int8_tops": spec.int8_tops,
+        "hbm_gbps": spec.hbm_gbps,
+        "ici_unidir_gbps": spec.ici_unidir_gbps,
+        "ici_links": spec.ici_links,
+        "dcn_gbps": spec.dcn_gbps,
+        "ridge_flops_per_byte": ridge_point(spec),
+    }

@@ -51,8 +51,11 @@ positive that makes `make lint` cry wolf is worse than a miss):
   JAX API move is absorbed in one file pair. Import it from
   `activemonitor_tpu.parallel.partition` instead.
 - wallclock-in-<unit>: `time.time()` / `time.monotonic()` calls in
-  files under a `resilience/`, `analysis/`, or `frontdoor/` directory,
-  or in the clock-disciplined modules (`sharding.py`, `attribution.py`,
+  files under a `resilience/`, `analysis/`, `frontdoor/`, or
+  `federation/` directory (the multi-cluster control plane's liveness
+  judgment, routing, and global-door ledgers all run on the injectable
+  Clock so the federation acceptance tests script entirely on a
+  FakeClock), or in the clock-disciplined modules (`sharding.py`, `attribution.py`,
   `flightrec.py`, `roofline.py`, `arrivals.py`, `journal.py`,
   `replay.py`, `criticalpath.py`) — those units' whole
   contract is the injectable Clock (breaker open windows, token-bucket
@@ -174,7 +177,7 @@ class Checker(ast.NodeVisitor):
         self.wallclock_pkg = next(
             (
                 pkg
-                for pkg in ("resilience", "analysis", "frontdoor")
+                for pkg in ("resilience", "analysis", "frontdoor", "federation")
                 if pkg in parts
             ),
             None,

@@ -13,7 +13,9 @@ from activemonitor_tpu.controller import parse_workflow_from_healthcheck
 EXAMPLES = sorted(
     p
     for p in glob.glob("examples/**/*.yaml", recursive=True)
-    if "workflows/" not in p
+    # workflows/ are Argo Workflow bodies, federation-config is a
+    # controller config document — neither is a HealthCheck manifest
+    if "workflows/" not in p and "federation-config" not in p
 )
 
 
@@ -76,6 +78,44 @@ def test_feature_matrix_coverage():
     assert any(hc.spec.analysis is not None for hc in all_checks)
     # bucket-targeted remedies (ISSUE 18: closed-loop goodput control)
     assert any(hc.spec.remedy_workflow.by_bucket for hc in all_checks)
+    # capability requirements for federation routing (ISSUE 19)
+    assert any(hc.spec.requires is not None for hc in all_checks)
+
+
+def test_federation_config_example_builds_a_plane():
+    """examples/federation/federation-config.yaml is the
+    --federation-config contract: it must build a working plane with
+    the capability cards the rated tables imply."""
+    import yaml as _yaml
+
+    from activemonitor_tpu.federation import FederationPlane
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    doc = _yaml.safe_load(
+        Path("examples/federation/federation-config.yaml").read_text()
+    )
+    plane = FederationPlane.from_config(doc, clock=FakeClock())
+    assert plane.registry.names() == ["us-east1-v5e", "us-west1-v5p"]
+    west = plane.registry.get("us-west1-v5p")
+    assert west.generation == "v5p"
+    assert west.dcn_gbps == 100.0  # the explicit per-host override wins
+    east = plane.registry.get("us-east1-v5e")
+    assert east.dcn_gbps == 25.0  # the rated tier applies when omitted
+    assert east.slices == ("edge-pod",)
+
+
+def test_federation_check_declares_v5p_mesh_requirement():
+    """The v5p-mesh example's `requires` block must parse into a
+    Requirement the router honors: generation-pinned, 64-chip mesh."""
+    from activemonitor_tpu.federation import Requirement
+
+    [hc] = load_healthchecks("examples/federation/v5p-mesh-check.yaml")
+    assert hc.spec.requires is not None
+    assert hc.spec.requires.generation == "v5p"
+    assert hc.spec.requires.topology == "4x4x4"
+    req = Requirement.from_spec(hc.spec.requires)
+    assert req.chips_needed() == 64
+    assert not req.empty()
 
 
 def test_bucket_remedy_example_selects_by_attribution():

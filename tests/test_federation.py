@@ -1,0 +1,865 @@
+"""Planet-scale federation (ISSUE 19).
+
+Units for the cluster registry (capability descriptors, movement-judged
+liveness, exactly-one flight bundle per transition), capability-aware
+routing (slice / tightest-fit / default spread / structured
+``no_capable_cluster``), the federated rollup (two-level merge,
+attribution conservation under version skew), the global front door
+(global quota, cross-cluster coalescing, the ``forwarded`` column, the
+per-(tenant, cluster) conservation ledger), the federation plane +
+/statusz block + CLI rendering, and the scripted FakeClock acceptance.
+"""
+
+import asyncio
+
+import pytest
+
+from activemonitor_tpu.federation import (
+    FEDERATION_TENANT,
+    NO_CAPABLE_CLUSTER,
+    OUTCOME_FORWARDED,
+    STATE_HEALTHY,
+    STATE_UNHEALTHY,
+    CapabilityRouter,
+    ClusterDescriptor,
+    ClusterRegistry,
+    FederationPlane,
+    GlobalFrontDoor,
+    Requirement,
+    federate_statusz,
+    federation_quota,
+)
+from activemonitor_tpu.federation.globaldoor import (
+    REFUSE_CLUSTER_UNATTACHED,
+    UNROUTED_CLUSTER,
+)
+from activemonitor_tpu.federation.registry import (
+    KIND_CLUSTER_JOIN,
+    KIND_CLUSTER_LEAVE,
+    KIND_CLUSTER_RECOVERED,
+    KIND_CLUSTER_UNHEALTHY,
+)
+from activemonitor_tpu.federation.routing import (
+    MATCHED_CAPABILITY,
+    MATCHED_DEFAULT,
+    MATCHED_SLICE,
+    _chips_in,
+)
+from activemonitor_tpu.frontdoor import (
+    OUTCOME_JOINED,
+    OUTCOME_REFUSED,
+    OUTCOME_RUN,
+    REFUSE_QUOTA,
+    REFUSE_TENANT_CAPACITY,
+    AdmissionController,
+    FrontDoor,
+    TenantQuota,
+)
+from activemonitor_tpu.metrics import MetricsCollector
+from activemonitor_tpu.obs.flightrec import FlightRecorder
+from activemonitor_tpu.obs.history import ResultHistory
+from activemonitor_tpu.obs.slo import (
+    MERGE_LEVEL_CLUSTER,
+    MERGE_LEVEL_REPLICA,
+    merge_blocks,
+)
+from activemonitor_tpu.utils.clock import FakeClock
+
+
+def desc(name, device_kind="TPU v5e", chips=16, topology="4x4",
+         slices=(), dcn_gbps=0.0, url=""):
+    return ClusterDescriptor.build(
+        name,
+        url=url,
+        device_kind=device_kind,
+        chips=chips,
+        topology=topology,
+        slices=slices,
+        dcn_gbps=dcn_gbps,
+    )
+
+
+def cluster_payload(generated_at, *, ratio=1.0, runs=10, old_binary=False,
+                    checks=()):
+    """A minimal replica-shaped /statusz payload. ``old_binary`` drops
+    the goodput attribution block — the version-skew shape a pre-
+    attribution binary serves."""
+    lost = 1.0 - ratio
+    fleet = {
+        "replicas": 1,
+        "checks": len(checks),
+        "window_runs": runs,
+        "goodput_ratio": ratio,
+        "generated_at": generated_at,
+        "degraded": False,
+        "breaker": {"state": "closed"},
+        "status_writes_queued": 0,
+        "remedy_tokens": None,
+    }
+    if not old_binary:
+        fleet["goodput"] = {
+            "window_runs": runs,
+            "lost_runs": {"ici": runs * lost},
+            "attribution": {"ici": lost},
+            "lost_ratio": lost,
+            "top": "ici" if lost > 0 else None,
+        }
+    return {
+        "fleet": fleet,
+        "checks": [
+            {
+                "key": key,
+                "namespace": key.split("/")[0],
+                "healthcheck": key.split("/")[1],
+                "window": {"results": runs},
+            }
+            for key in checks
+        ],
+    }
+
+
+# -- descriptors -------------------------------------------------------
+
+
+def test_descriptor_derives_capability_card_from_rated_tables():
+    d = desc("c1", device_kind="TPU v5p", chips=64, topology="4x4x4")
+    assert d.generation == "v5p"
+    assert d.capability["bf16_tflops"] == pytest.approx(459.0)
+    # the rated dcn tier is the default...
+    assert d.dcn_gbps == pytest.approx(25.0)
+    # ...and an explicit per-host figure wins over it
+    fat = desc("c2", device_kind="TPU v5p", dcn_gbps=100.0)
+    assert fat.dcn_gbps == pytest.approx(100.0)
+    # unknown hardware: no card, no generation — still a valid member
+    weird = desc("c3", device_kind="FPGA x1")
+    assert weird.generation == ""
+    assert weird.capability == {}
+
+
+# -- registry: movement-judged liveness --------------------------------
+
+
+def test_join_and_leave_fire_exactly_one_bundle_each():
+    clock = FakeClock()
+    flightrec = FlightRecorder(clock)
+    registry = ClusterRegistry(clock=clock, flightrec=flightrec)
+    registry.join(desc("east"))
+    registry.join(desc("west"))
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_JOIN)) == 2
+    registry.leave("east")
+    registry.leave("east")  # already gone: no second bundle
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_LEAVE)) == 1
+    assert registry.names() == ["west"]
+
+
+@pytest.mark.asyncio
+async def test_liveness_is_observed_movement_not_remote_wallclock():
+    """Health is judged by whether the remote's payload MOVES as seen
+    on our monotonic clock — a remote stamping absurd future times
+    cannot fake liveness by the size of its stamps, and a frozen
+    payload goes unhealthy no matter what its stamp claims."""
+    clock = FakeClock()
+    flightrec = FlightRecorder(clock)
+    registry = ClusterRegistry(
+        clock=clock, liveness_seconds=90.0, flightrec=flightrec
+    )
+    registry.join(desc("moving"))
+    registry.join(desc("frozen"))
+    # "frozen" serves one payload with a HUGE wall-clock stamp, then
+    # freezes; "moving" serves small but CHANGING stamps
+    assert registry.observe("frozen", cluster_payload(9e12))
+    step = 0
+    for _ in range(4):
+        await clock.advance(30.0)
+        step += 1
+        assert registry.observe("moving", cluster_payload(100.0 + step))
+        # same stamp again: not movement
+        assert not registry.observe("frozen", cluster_payload(9e12))
+        registry.sweep()
+    assert registry.state("moving") == STATE_HEALTHY
+    assert registry.state("frozen") == STATE_UNHEALTHY
+    # exactly ONE unhealthy bundle despite four sweeps past the window
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_UNHEALTHY)) == 1
+
+
+@pytest.mark.asyncio
+async def test_recovery_fires_one_bundle_and_restores_routing():
+    clock = FakeClock()
+    flightrec = FlightRecorder(clock)
+    registry = ClusterRegistry(
+        clock=clock, liveness_seconds=90.0, flightrec=flightrec
+    )
+    registry.join(desc("east"))
+    await clock.advance(90.0)
+    assert registry.sweep() == [("east", KIND_CLUSTER_UNHEALTHY)]
+    assert registry.healthy() == []
+    # movement recovers it — exactly one recovered bundle
+    assert registry.observe("east", cluster_payload(1.0))
+    assert registry.state("east") == STATE_HEALTHY
+    assert registry.observe("east", cluster_payload(2.0))
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_RECOVERED)) == 1
+    assert [d.name for d in registry.healthy()] == ["east"]
+
+
+@pytest.mark.asyncio
+async def test_registry_snapshot_and_metrics_export():
+    clock = FakeClock()
+    metrics = MetricsCollector()
+    registry = ClusterRegistry(
+        clock=clock, liveness_seconds=90.0, metrics=metrics
+    )
+    registry.join(desc("east", device_kind="TPU v5e"))
+    registry.join(desc("west", device_kind="TPU v5p", chips=64,
+                       topology="4x4x4"))
+    registry.observe("east", cluster_payload(10.0))
+    await clock.advance(120.0)
+    registry.observe("west", cluster_payload(20.0))
+    registry.sweep()
+    snap = registry.snapshot()
+    assert snap["healthy"] == 1 and snap["unhealthy"] == 1
+    assert snap["clusters"]["east"]["state"] == STATE_UNHEALTHY
+    assert snap["clusters"]["west"]["generation"] == "v5p"
+    assert snap["clusters"]["west"]["movement_age_seconds"] == pytest.approx(0.0)
+    registry.export_metrics()  # exercises the gauges; families pinned
+    # unhealthy clusters keep serving their LAST payload to the rollup
+    assert set(registry.payloads()) == {"east", "west"}
+
+
+# -- capability-aware routing ------------------------------------------
+
+
+def _registry_with(clock, *descriptors):
+    registry = ClusterRegistry(clock=clock, liveness_seconds=90.0)
+    for d in descriptors:
+        registry.join(d)
+    return registry
+
+
+def test_topology_chip_math():
+    assert _chips_in("4x4") == 16
+    assert _chips_in("2x2x4") == 16
+    assert _chips_in("") == 0
+    assert _chips_in("4xbanana") == 0  # malformed must not match big pods
+    assert _chips_in("0x4") == 0
+
+
+def test_slice_ownership_wins_over_capability():
+    clock = FakeClock()
+    registry = _registry_with(
+        clock,
+        desc("edge", device_kind="TPU v5e", slices=("train-pod-a",)),
+        desc("big", device_kind="TPU v5p", chips=64, topology="4x4x4"),
+    )
+    router = CapabilityRouter(registry)
+    decision = router.route(
+        "bench/hc", Requirement(generation="v5e", slice_name="train-pod-a")
+    )
+    assert decision.routed and decision.cluster == "edge"
+    assert decision.matched == MATCHED_SLICE
+
+
+def test_tightest_capability_fit_keeps_big_pods_free():
+    clock = FakeClock()
+    registry = _registry_with(
+        clock,
+        desc("huge", device_kind="TPU v5p", chips=256, topology="8x8x4"),
+        desc("small", device_kind="TPU v5p", chips=64, topology="4x4x4"),
+    )
+    router = CapabilityRouter(registry)
+    decision = router.route(
+        "bench/hc", Requirement(generation="v5p", topology="4x4x4")
+    )
+    assert decision.cluster == "small"  # 64 >= 64, tightest fit
+    assert decision.matched == MATCHED_CAPABILITY
+    # a bigger ask only the huge pod satisfies
+    assert router.route(
+        "bench/hc", Requirement(generation="v5p", min_chips=128)
+    ).cluster == "huge"
+
+
+def test_no_capable_cluster_is_a_structured_refusal():
+    clock = FakeClock()
+    registry = _registry_with(
+        clock, desc("edge", device_kind="TPU v5e", chips=16)
+    )
+    router = CapabilityRouter(registry)
+    decision = router.route("bench/hc", Requirement(generation="v6e"))
+    assert not decision.routed
+    assert decision.reason == NO_CAPABLE_CLUSTER
+    assert "edge" in decision.why  # names the healthy set it searched
+    # an empty federation refuses too, structured, never raising
+    empty = CapabilityRouter(ClusterRegistry(clock=clock))
+    assert empty.route("bench/hc").reason == NO_CAPABLE_CLUSTER
+
+
+def test_default_spread_is_deterministic_per_key():
+    clock = FakeClock()
+    registry = _registry_with(
+        clock, desc("a"), desc("b"), desc("c")
+    )
+    router = CapabilityRouter(registry)
+    first = router.route("bench/hc-1")
+    assert first.matched == MATCHED_DEFAULT
+    # same key, same cluster, every time (global-door coalescing
+    # locality depends on this)
+    assert all(
+        router.route("bench/hc-1").cluster == first.cluster
+        for _ in range(8)
+    )
+    # many keys actually spread over the healthy set
+    landed = {router.route(f"bench/hc-{i}").cluster for i in range(64)}
+    assert landed == {"a", "b", "c"}
+
+
+@pytest.mark.asyncio
+async def test_unhealthy_slice_owner_falls_through_to_capability():
+    """The reroute path: when a slice's owner goes dark its pinned
+    checks start matching by capability instead of black-holing."""
+    clock = FakeClock()
+    registry = _registry_with(
+        clock,
+        desc("owner", device_kind="TPU v5p", chips=64, topology="4x4x4",
+             slices=("train-pod-a",)),
+        desc("spare", device_kind="TPU v5p", chips=64, topology="4x4x4"),
+    )
+    router = CapabilityRouter(registry)
+    req = Requirement(generation="v5p", slice_name="train-pod-a")
+    assert router.route("bench/hc", req).cluster == "owner"
+    await clock.advance(90.0)
+    registry.observe("spare", cluster_payload(1.0))
+    registry.sweep()
+    decision = router.route("bench/hc", req)
+    assert decision.cluster == "spare"
+    assert decision.matched == MATCHED_CAPABILITY
+
+
+# -- the merge seam + federated rollup ---------------------------------
+
+
+def test_merge_blocks_levels_and_replica_counting():
+    # replica payloads count 1 each unless they carry a rollup's count
+    merged = merge_blocks(
+        [cluster_payload(1.0, runs=10), cluster_payload(2.0, runs=30)],
+        level=MERGE_LEVEL_REPLICA,
+    )
+    assert merged["level"] == MERGE_LEVEL_REPLICA
+    assert merged["replicas"] == 2
+    assert merged["window_runs"] == 40
+    # a cluster-level merge over per-cluster ROLLUPS sums their replica
+    # counts (two-level merge, not flattening)
+    rollup_a = cluster_payload(1.0, runs=10)
+    rollup_a["fleet"]["replicas"] = 3
+    merged = merge_blocks(
+        [rollup_a, cluster_payload(2.0, runs=30)], level=MERGE_LEVEL_CLUSTER
+    )
+    assert merged["level"] == MERGE_LEVEL_CLUSTER
+    assert merged["replicas"] == 4
+    # goodput is run-weighted, never a naive mean
+    merged = merge_blocks(
+        [
+            cluster_payload(1.0, ratio=0.9, runs=100),
+            cluster_payload(2.0, ratio=0.5, runs=0),
+        ],
+        level=MERGE_LEVEL_CLUSTER,
+    )
+    assert merged["goodput_ratio"] == pytest.approx(0.9)
+
+
+def test_federated_rollup_checks_tagged_and_deduped_by_cluster():
+    fed = federate_statusz(
+        {
+            "east": cluster_payload(1.0, checks=("bench/a", "bench/b")),
+            "west": cluster_payload(2.0, checks=("bench/b", "bench/c")),
+        }
+    )
+    assert fed["fleet"]["clusters"] == 2
+    assert fed["fleet"]["checks"] == 3  # bench/b deduped, first cluster wins
+    by_key = {c["key"]: c["cluster"] for c in fed["checks"]}
+    assert by_key == {
+        "bench/a": "east", "bench/b": "east", "bench/c": "west"
+    }
+    assert set(fed["fleet"]["per_cluster"]) == {"east", "west"}
+
+
+def test_cluster_version_skew_folds_into_unknown_and_conserves():
+    """Satellite: an old-binary cluster (no attribution block) must
+    fold its whole share into the ``unknown`` bucket WITHOUT breaking
+    conservation — sum(attribution) + goodput == 1 to ±1e-9."""
+    fed = federate_statusz(
+        {
+            "new-east": cluster_payload(1.0, ratio=0.9, runs=100),
+            "new-west": cluster_payload(2.0, ratio=0.8, runs=50),
+            "legacy": cluster_payload(3.0, ratio=0.7, runs=50,
+                                      old_binary=True),
+        }
+    )
+    fleet = fed["fleet"]
+    # run-weighted: (0.9*100 + 0.8*50 + 0.7*50) / 200
+    assert fleet["goodput_ratio"] == pytest.approx(0.825)
+    attribution = fleet["goodput"]["attribution"]
+    assert sum(attribution.values()) + fleet["goodput_ratio"] == pytest.approx(
+        1.0, abs=1e-9
+    )
+    # legacy's entire lost share (50 runs * 0.3 / 200) is unknown's
+    assert attribution["unknown"] == pytest.approx(0.075, abs=1e-9)
+    assert fleet["per_cluster"]["legacy"]["skewed"]
+    assert not fleet["per_cluster"]["new-east"]["skewed"]
+
+
+# -- the global front door ---------------------------------------------
+
+
+def make_global_door(clock, registry, *, quotas=None, default_quota=None,
+                     max_tenants=1024, metrics=None):
+    router = CapabilityRouter(registry, metrics=metrics)
+    admission = AdmissionController(
+        quotas,
+        default_quota=default_quota,
+        clock=clock,
+        max_tenants=max_tenants,
+    )
+    return GlobalFrontDoor(
+        registry, router, admission, clock=clock, metrics=metrics
+    )
+
+
+def make_cluster_door(clock, fleet_history=None):
+    """A per-cluster door admitting the federation tenant under the
+    effectively-unlimited federation quota."""
+    history = fleet_history or ResultHistory(clock)
+    door = FrontDoor(
+        history,
+        AdmissionController(
+            {FEDERATION_TENANT: federation_quota()}, clock=clock
+        ),
+        clock=clock,
+    )
+    triggered = []
+    door.bind(lambda ns, name: triggered.append(f"{ns}/{name}"))
+    return door, history, triggered
+
+
+@pytest.mark.asyncio
+async def test_global_quota_is_paid_once_and_refuses_structured():
+    clock = FakeClock()
+    registry = _registry_with(clock, desc("only"))
+    gdoor = make_global_door(
+        clock,
+        registry,
+        quotas={"t-a": TenantQuota(rate_per_minute=2.0, burst=2.0)},
+    )
+    door, _history, triggered = make_cluster_door(clock)
+    gdoor.attach("only", door)
+    a = gdoor.submit("t-a", "bench/x")
+    b = gdoor.submit("t-a", "bench/y")
+    c = gdoor.submit("t-a", "bench/z")
+    assert (a.outcome, b.outcome) == (OUTCOME_RUN, OUTCOME_RUN)
+    assert c.outcome == OUTCOME_REFUSED and c.reason == REFUSE_QUOTA
+    assert c.cluster == UNROUTED_CLUSTER  # refused before routing
+    # the inner door saw only the admitted two, as the federation tenant
+    assert triggered == ["bench/x", "bench/y"]
+    assert door.admission.refused.get(FEDERATION_TENANT) is None
+    conservation = gdoor.conservation()
+    assert conservation["ok"]
+    assert conservation["tenants"]["t-a"]["refusals"] == {REFUSE_QUOTA: 1}
+
+
+@pytest.mark.asyncio
+async def test_cross_cluster_coalescing_shares_one_run_and_trace_id():
+    """N tenants, one check, doors in two clusters: deterministic
+    routing lands every submission on ONE cluster's door, whose cache
+    fans them in — one probe run, one shared trace id, globally."""
+    clock = FakeClock()
+    registry = _registry_with(clock, desc("east"), desc("west"))
+    gdoor = make_global_door(
+        clock, registry, default_quota=TenantQuota(rate_per_minute=600.0)
+    )
+    doors = {}
+    histories = {}
+    triggered = {}
+    for name in ("east", "west"):
+        doors[name], histories[name], triggered[name] = make_cluster_door(clock)
+        gdoor.attach(name, doors[name])
+    tickets = [
+        gdoor.submit(f"tenant-{i}", "bench/shared") for i in range(5)
+    ]
+    landed = {t.cluster for t in tickets}
+    assert len(landed) == 1  # every tenant's copy routed to ONE cluster
+    cluster = landed.pop()
+    assert [t.outcome for t in tickets] == [OUTCOME_RUN] + [OUTCOME_JOINED] * 4
+    assert len(triggered[cluster]) == 1  # ONE probe run for all five
+    histories[cluster].record(
+        "bench/shared", ok=True, latency=1.0, workflow="wf", trace_id="tr-1"
+    )
+    results = await asyncio.gather(*(t.wait() for t in tickets))
+    assert all(r is not None and r.trace_id == "tr-1" for r in results)
+    assert {t.trace_id for t in tickets} == {"tr-1"}
+    conservation = gdoor.conservation()
+    assert conservation["ok"]
+    assert conservation["submitted"] == 5
+    # each tenant's cell sits under the SAME cluster column
+    for i in range(5):
+        row = conservation["tenants"][f"tenant-{i}"]
+        assert set(row["clusters"]) == {cluster}
+
+
+@pytest.mark.asyncio
+async def test_forwarded_books_at_handoff_and_conserves():
+    clock = FakeClock()
+    registry = _registry_with(
+        clock,
+        desc("local", slices=("here",)),
+        desc("remote", slices=("there",)),
+    )
+    gdoor = make_global_door(
+        clock, registry, default_quota=TenantQuota(rate_per_minute=600.0)
+    )
+    door, _history, _triggered = make_cluster_door(clock)
+    gdoor.attach("local", door)
+    handed = []
+    gdoor.attach_forwarder(
+        "remote",
+        lambda tenant, check, freshness: handed.append((tenant, check))
+        or "handle-1",
+    )
+    near = gdoor.submit("t", "bench/near", requirement=Requirement(slice_name="here"))
+    far = gdoor.submit("t", "bench/far", requirement=Requirement(slice_name="there"))
+    assert near.outcome == OUTCOME_RUN and near.cluster == "local"
+    assert far.outcome == OUTCOME_FORWARDED and far.cluster == "remote"
+    assert far.forwarded == "handle-1"
+    assert handed == [("t", "bench/far")]
+    assert await far.wait() is None  # accounted on the remote from here on
+    conservation = gdoor.conservation()
+    assert conservation["ok"]
+    assert conservation["forwarded"] == 1
+    assert conservation["tenants"]["t"]["clusters"]["remote"]["forwarded"] == 1
+
+
+@pytest.mark.asyncio
+async def test_unattached_cluster_is_a_structured_refusal():
+    clock = FakeClock()
+    registry = _registry_with(clock, desc("ghost"))
+    gdoor = make_global_door(
+        clock, registry, default_quota=TenantQuota(rate_per_minute=600.0)
+    )
+    ticket = gdoor.submit("t", "bench/x")
+    assert ticket.outcome == OUTCOME_REFUSED
+    assert ticket.reason == REFUSE_CLUSTER_UNATTACHED
+    assert ticket.cluster == "ghost"  # names the cluster it routed to
+    conservation = gdoor.conservation()
+    assert conservation["ok"]  # post-admission refusal: books stay exact
+    assert gdoor.admission.refused["t"] == {REFUSE_CLUSTER_UNATTACHED: 1}
+
+
+@pytest.mark.asyncio
+async def test_no_capable_cluster_refusal_reaches_the_tenant_ledger():
+    clock = FakeClock()
+    registry = _registry_with(clock, desc("edge", device_kind="TPU v5e"))
+    gdoor = make_global_door(
+        clock, registry, default_quota=TenantQuota(rate_per_minute=600.0)
+    )
+    ticket = gdoor.submit(
+        "t", "bench/x", requirement=Requirement(generation="v6e")
+    )
+    assert ticket.outcome == OUTCOME_REFUSED
+    assert ticket.reason == NO_CAPABLE_CLUSTER
+    assert gdoor.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_inner_door_refusal_mirrors_into_the_global_books():
+    """A cluster door refusing an ADMITTED request (here: unrouted by a
+    sharded inner fleet) must book a post-admission refusal globally —
+    otherwise admitted > outcomes and conservation breaks."""
+    clock = FakeClock()
+    registry = _registry_with(clock, desc("only"))
+    gdoor = make_global_door(
+        clock, registry, default_quota=TenantQuota(rate_per_minute=600.0)
+    )
+    door, _history, _triggered = make_cluster_door(clock)
+    door.owns = lambda key: False  # another replica owns every key
+    gdoor.attach("only", door)
+    ticket = gdoor.submit("t", "bench/x")
+    assert ticket.outcome == OUTCOME_REFUSED
+    assert gdoor.conservation()["ok"]
+
+
+@pytest.mark.asyncio
+async def test_global_door_snapshot_shape():
+    clock = FakeClock()
+    registry = _registry_with(clock, desc("only"))
+    gdoor = make_global_door(
+        clock, registry, default_quota=TenantQuota(rate_per_minute=600.0)
+    )
+    door, _history, _triggered = make_cluster_door(clock)
+    gdoor.attach("only", door)
+    gdoor.submit("t", "bench/x")
+    snap = gdoor.snapshot()
+    assert snap["attached"] == ["only"]
+    assert snap["conservation_ok"]
+    assert snap["requests"]["submitted"] == 1
+    assert snap["per_cluster"]["only"]["probe_runs"] == 1
+    assert snap["tenants"]["t"]["ok"]
+
+
+# -- the federation plane ----------------------------------------------
+
+FED_CONFIG = {
+    "liveness_seconds": 90,
+    "clusters": [
+        {
+            "name": "us-east1-v5e",
+            "url": "http://east.monitor:8081/statusz",
+            "device_kind": "TPU v5e",
+            "chips": 16,
+            "topology": "4x4",
+            "slices": ["edge-pod"],
+        },
+        {
+            "name": "us-west1-v5p",
+            "url": "http://west.monitor:8081/statusz",
+            "device_kind": "TPU v5p",
+            "chips": 64,
+            "topology": "4x4x4",
+            "dcn_gbps": 100,
+        },
+    ],
+}
+
+
+@pytest.mark.asyncio
+async def test_plane_from_config_polls_and_federates():
+    clock = FakeClock()
+    plane = FederationPlane.from_config(FED_CONFIG, clock=clock)
+    assert plane.registry.names() == ["us-east1-v5e", "us-west1-v5p"]
+    assert plane.registry.get("us-west1-v5p").dcn_gbps == pytest.approx(100.0)
+    stamps = {"n": 0}
+    served = {
+        "http://east.monitor:8081/statusz": lambda: cluster_payload(
+            stamps["n"], ratio=0.9, runs=10
+        ),
+        "http://west.monitor:8081/statusz": lambda: cluster_payload(
+            stamps["n"] + 0.5, ratio=0.8, runs=30
+        ),
+    }
+
+    async def fetch(url):
+        return served[url]()
+
+    plane.fetch = fetch
+    stamps["n"] = 1
+    assert await plane.poll() == 2
+    fed = plane.federated()
+    assert fed["fleet"]["clusters"] == 2
+    assert fed["fleet"]["goodput_ratio"] == pytest.approx(0.825)
+    snap = plane.snapshot()
+    assert snap["registry"]["healthy"] == 2
+    assert snap["door"] is None
+    # a cluster whose fetch starts failing goes dark by the liveness
+    # window, not by the error itself
+    async def flaky(url):
+        if "west" in url:
+            raise OSError("conn reset")
+        stamps["n"] += 1
+        return served[url]()
+
+    plane.fetch = flaky
+    for _ in range(4):
+        await clock.advance(30.0)
+        await plane.poll()
+    assert plane.registry.state("us-west1-v5p") == STATE_UNHEALTHY
+    assert plane.registry.state("us-east1-v5e") == STATE_HEALTHY
+
+
+def test_statusz_federation_block_rides_the_fleet_payload():
+    from activemonitor_tpu.obs.slo import FleetStatus
+
+    clock = FakeClock()
+    fleet = FleetStatus(clock, MetricsCollector())
+    payload = fleet.statusz([])
+    assert payload["fleet"]["federation"] is None  # unfederated: null
+    plane = FederationPlane.from_config(FED_CONFIG, clock=clock)
+    fleet.federation = plane
+    block = fleet.statusz([])["fleet"]["federation"]
+    assert block["registry"]["healthy"] == 2
+    assert "door" in block
+
+
+# -- CLI rendering -----------------------------------------------------
+
+
+def test_render_clusters_table():
+    from activemonitor_tpu.__main__ import render_clusters
+
+    clock = FakeClock()
+    registry = _registry_with(
+        clock,
+        desc("east", device_kind="TPU v5e", slices=("edge",)),
+        desc("west", device_kind="TPU v5p", chips=64, topology="4x4x4"),
+    )
+    plane = FederationPlane(registry, CapabilityRouter(registry))
+    text = render_clusters(plane.snapshot())
+    assert "FEDERATION  clusters=2  healthy=2  unhealthy=0" in text
+    assert "east" in text and "west" in text and "v5p" in text
+    # an empty registry renders, never crashes
+    empty = render_clusters({"registry": {"clusters": {}}})
+    assert "No clusters joined." in empty
+
+
+def test_render_status_table_federation_lines():
+    from activemonitor_tpu.__main__ import render_status_table
+
+    fed = federate_statusz(
+        {
+            "east": cluster_payload(1.0, ratio=0.9, runs=100,
+                                    checks=("bench/a",)),
+            "legacy": cluster_payload(2.0, ratio=0.7, runs=50,
+                                      old_binary=True),
+        }
+    )
+    text = render_status_table(fed)
+    assert "clusters=2" in text
+    assert "CLUSTER east" in text
+    assert "SKEWED" in text  # the old binary is called out, not hidden
+
+
+def test_cluster_name_for_url():
+    from activemonitor_tpu.__main__ import _cluster_name_for_url
+
+    assert _cluster_name_for_url("http://east.monitor:8081/statusz") == (
+        "east.monitor:8081"
+    )
+    assert _cluster_name_for_url("not a url") == "not a url"
+
+
+# -- the scripted FakeClock acceptance ---------------------------------
+
+
+@pytest.mark.asyncio
+async def test_federation_acceptance():
+    """ISSUE 19's scripted acceptance: three stub clusters (v5e, v5p,
+    old binary), a capability-routed check landing on the v5p mesh, N
+    tenants across two clusters coalescing to ONE run with a shared
+    trace id, the global per-tenant quota refusing the (N+1)th tenant
+    with a structured reason, the federated rollup conserving
+    attribution to ±1e-9, and a cluster going unhealthy firing exactly
+    one flight bundle while its checks reroute."""
+    N = 4
+    clock = FakeClock()
+    flightrec = FlightRecorder(clock)
+    metrics = MetricsCollector()
+    registry = ClusterRegistry(
+        clock=clock, liveness_seconds=90.0, metrics=metrics,
+        flightrec=flightrec,
+    )
+    registry.join(desc("edge-v5e", device_kind="TPU v5e", chips=16,
+                       topology="4x4", slices=("edge-pod",)))
+    registry.join(desc("pod-v5p", device_kind="TPU v5p", chips=64,
+                       topology="4x4x4"))
+    registry.join(desc("legacy", device_kind="TPU v4", chips=32,
+                       topology="4x4x2"))
+    router = CapabilityRouter(registry, metrics=metrics)
+    plane = FederationPlane(registry, router)
+    gdoor = GlobalFrontDoor(
+        registry,
+        router,
+        AdmissionController(
+            default_quota=TenantQuota(rate_per_minute=600.0),
+            clock=clock,
+            max_tenants=N,  # the global cap the (N+1)th tenant hits
+        ),
+        clock=clock,
+        metrics=metrics,
+    )
+    plane.door = gdoor
+    doors, histories, triggered = {}, {}, {}
+    for name in ("edge-v5e", "pod-v5p"):
+        doors[name], histories[name], triggered[name] = make_cluster_door(clock)
+        gdoor.attach(name, doors[name])
+
+    # 1. the capability-routed check lands on the v5p mesh
+    routed = gdoor.submit(
+        "tenant-0", "bench/matmul-4x4x4",
+        requirement=Requirement(generation="v5p", topology="4x4x4"),
+    )
+    assert routed.cluster == "pod-v5p"
+    assert routed.matched == MATCHED_CAPABILITY
+    assert routed.outcome == OUTCOME_RUN
+    histories["pod-v5p"].record(
+        "bench/matmul-4x4x4", ok=True, latency=1.0, workflow="wf",
+        trace_id="tr-matmul",
+    )
+
+    # 2. N tenants, doors in two clusters, ONE coalesced run + trace id
+    tickets = [
+        gdoor.submit(f"tenant-{i}", "bench/shared") for i in range(N)
+    ]
+    assert len({t.cluster for t in tickets}) == 1
+    cluster = tickets[0].cluster
+    assert sorted(t.outcome for t in tickets) == (
+        [OUTCOME_JOINED] * (N - 1) + [OUTCOME_RUN]
+    )
+    assert len(triggered[cluster]) == (
+        2 if cluster == "pod-v5p" else 1
+    )  # the matmul run above also triggered on pod-v5p
+    histories[cluster].record(
+        "bench/shared", ok=True, latency=1.0, workflow="wf",
+        trace_id="tr-shared",
+    )
+    results = await asyncio.gather(*(t.wait() for t in tickets))
+    assert {r.trace_id for r in results} == {"tr-shared"}
+
+    # 3. the (N+1)th tenant is refused with a structured reason
+    extra = gdoor.submit("tenant-extra", "bench/shared")
+    assert extra.outcome == OUTCOME_REFUSED
+    assert extra.reason == REFUSE_TENANT_CAPACITY
+    conservation = gdoor.conservation()
+    assert conservation["ok"]
+    assert conservation["submitted"] == N + 2
+
+    # 4. the federated rollup conserves attribution to ±1e-9 with the
+    # old binary folded into unknown
+    registry.observe("edge-v5e", cluster_payload(1.0, ratio=0.9, runs=100))
+    registry.observe("pod-v5p", cluster_payload(1.5, ratio=0.8, runs=60))
+    registry.observe(
+        "legacy", cluster_payload(2.0, ratio=0.6, runs=40, old_binary=True)
+    )
+    fed = plane.federated()
+    fleet = fed["fleet"]
+    attribution = fleet["goodput"]["attribution"]
+    assert sum(attribution.values()) + fleet["goodput_ratio"] == pytest.approx(
+        1.0, abs=1e-9
+    )
+    assert attribution["unknown"] >= 40 * 0.4 / 200 - 1e-9
+    assert fleet["per_cluster"]["legacy"]["skewed"]
+
+    # 5. pod-v5p goes dark: exactly one bundle, and its capability-
+    # routed checks land elsewhere only if capable — the v5p-pinned
+    # check refuses (structured) rather than landing on weaker hardware,
+    # while a slice-free default check reroutes to the survivors
+    for step in range(4):
+        await clock.advance(30.0)
+        registry.observe(
+            "edge-v5e", cluster_payload(10.0 + step, ratio=0.9, runs=100)
+        )
+        registry.observe(
+            "legacy",
+            cluster_payload(20.0 + step, ratio=0.6, runs=40, old_binary=True),
+        )
+        plane.sweep()
+    assert registry.state("pod-v5p") == STATE_UNHEALTHY
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_UNHEALTHY)) == 1
+    rerouted = gdoor.submit("tenant-0", "bench/shared")
+    assert rerouted.cluster != "pod-v5p"
+    strict = gdoor.submit(
+        "tenant-0", "bench/matmul-4x4x4",
+        requirement=Requirement(generation="v5p", topology="4x4x4"),
+    )
+    assert strict.outcome == OUTCOME_REFUSED
+    assert strict.reason == NO_CAPABLE_CLUSTER
+    assert gdoor.conservation()["ok"]
+
+    # the /statusz federation block reflects all of it
+    snap = plane.snapshot()
+    assert snap["registry"]["unhealthy"] == 1
+    assert snap["door"]["conservation_ok"]

@@ -1550,3 +1550,83 @@ def test_criticalpath_quantile_matches_slo():
     samples = [0.1, 0.5, 0.2, 4.0, 0.9, 1.5, 0.3]
     for q in criticalpath.QUANTILES:
         assert criticalpath._quantile(samples, q) == slo.quantile(samples, q)
+
+
+# -- federation (ISSUE 19) ---------------------------------------------
+
+
+def test_wallclock_banned_in_federation_package(tmp_path):
+    """federation/ is the multi-cluster control plane: liveness is
+    judged by locally-observed payload movement on the injected Clock,
+    routing must be reproducible, and the global-door ledgers ride the
+    same token buckets as frontdoor/ — a bare time.time()/
+    time.monotonic() anywhere under a federation/ directory is a lint
+    error (package-scoped like resilience/analysis/frontdoor). The
+    same code OUTSIDE federation/ stays quiet."""
+    source = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+        "def tick():\n"
+        "    return time.monotonic()\n"
+    )
+    pkg_dir = tmp_path / "federation"
+    pkg_dir.mkdir()
+    (pkg_dir / "mod.py").write_text(source)
+    got = lint.lint_file(pkg_dir / "mod.py")
+    assert codes(got) == {"wallclock-in-federation"}
+    assert len(got) == 2  # both the time() and the monotonic() call
+    # identical code outside federation/: no finding
+    assert findings(tmp_path, source) == []
+    # clock-disciplined federation code: no finding
+    clean = (
+        "def moved(clock, last, window):\n"
+        "    return clock.monotonic() - last >= window\n"
+    )
+    (pkg_dir / "clean.py").write_text(clean)
+    assert lint.lint_file(pkg_dir / "clean.py") == []
+
+
+def test_federation_package_really_is_wallclock_free():
+    """The gate, applied: the shipped federation/ package lints clean,
+    and the ban actually covers its files (path-scoping regression
+    guard, like the resilience/analysis/frontdoor twins)."""
+    package = REPO / "activemonitor_tpu" / "federation"
+    files = sorted(package.rglob("*.py"))
+    assert files, "federation package missing?"
+    for path in files:
+        assert lint.lint_file(path) == []
+        src = path.read_text()
+        checker = lint.Checker(str(path), __import__("ast").parse(src), src)
+        assert checker.ban_wallclock, path
+        assert checker.wallclock_pkg == "federation", path
+
+
+def test_federation_metric_families_are_pinned():
+    """The ISSUE-19 families must stay in the exposition contract — the
+    federation dashboard reads cluster health next to the per-cluster
+    request counters, and a rename silently breaks the unhealthy-
+    cluster alert."""
+    spec = importlib.util.spec_from_file_location(
+        "test_metrics_contract_federation", REPO / "tests" / "test_metrics.py"
+    )
+    contract = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contract)
+    families = (
+        "healthcheck_federation_clusters",
+        "healthcheck_federation_cluster_healthy",
+        "healthcheck_federation_transitions_total",
+        "healthcheck_federation_requests_total",
+        "healthcheck_federation_refusals_total",
+        "healthcheck_federation_routes_total",
+        "healthcheck_federation_goodput_ratio",
+    )
+    for family in families:
+        assert family in contract.PINNED_FAMILIES, family
+    # and the operator docs register every family next to the runbook
+    docs = (REPO / "docs" / "observability.md").read_text()
+    for family in families:
+        assert family in docs, f"{family} missing from docs/observability.md"
+    ops_docs = (REPO / "docs" / "operations.md").read_text()
+    assert "Federating clusters" in ops_docs
+    assert "--federation-config" in ops_docs

@@ -260,6 +260,15 @@ PINNED_FAMILIES = {
     "healthcheck_journal_dropped_total": "counter",
     "healthcheck_journal_segments": "gauge",
     "healthcheck_journal_lag_seconds": "gauge",
+    # federation families (ISSUE 19: planet-scale federation —
+    # docs/operations.md "Federating clusters")
+    "healthcheck_federation_clusters": "gauge",
+    "healthcheck_federation_cluster_healthy": "gauge",
+    "healthcheck_federation_transitions_total": "counter",
+    "healthcheck_federation_requests_total": "counter",
+    "healthcheck_federation_refusals_total": "counter",
+    "healthcheck_federation_routes_total": "counter",
+    "healthcheck_federation_goodput_ratio": "gauge",
     # sharding families (ISSUE 6: sharded controller fleet —
     # docs/operations.md "Sharded controller fleet")
     "healthcheck_shard_owned": "gauge",
@@ -336,6 +345,14 @@ def exercise_every_family(collector):
     collector.record_journal_dropped()
     collector.set_journal_segments(1)
     collector.set_journal_lag(0.5)
+    # federation families (ISSUE 19)
+    collector.set_federation_clusters(2, 1)
+    collector.set_federation_cluster_health("us-east1", True)
+    collector.record_federation_transition("us-east1", "cluster-join")
+    collector.record_federation_request("us-east1", "run")
+    collector.record_federation_refusal("tenant-a", "no_capable_cluster")
+    collector.record_federation_route("us-east1", "capability")
+    collector.set_federation_goodput(0.97)
     # sharding families
     collector.set_shard_owned(0, True)
     collector.set_shard_checks(0, 3)

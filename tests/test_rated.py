@@ -102,3 +102,47 @@ def test_ridge_point_override_follows_hbm_override(monkeypatch):
     assert ridge_point(spec) == 99.5
     monkeypatch.setenv("ACTIVEMONITOR_RATED_RIDGE_FLOPS_PER_BYTE", "-4")
     assert ridge_point(spec) == spec.ridge_flops_per_byte
+
+
+# -- capability_summary (the federation's capability card) -------------
+
+
+def test_capability_summary_matches_the_rated_spec():
+    from activemonitor_tpu.probes.rated import capability_summary, ridge_point
+
+    card = capability_summary("TPU v5p")
+    spec = rated_for("TPU v5p")
+    assert card == {
+        "generation": "v5p",
+        "bf16_tflops": spec.bf16_tflops,
+        "int8_tops": spec.int8_tops,
+        "hbm_gbps": spec.hbm_gbps,
+        "ici_unidir_gbps": spec.ici_unidir_gbps,
+        "ici_links": spec.ici_links,
+        "dcn_gbps": spec.dcn_gbps,
+        "ridge_flops_per_byte": ridge_point(spec),
+    }
+
+
+def test_capability_summary_unknown_hardware_is_none():
+    from activemonitor_tpu.probes.rated import capability_summary
+
+    assert capability_summary("FPGA x1") is None
+    assert capability_summary("") is None
+
+
+def test_capability_summary_applies_validated_env_overrides(
+    monkeypatch, caplog
+):
+    from activemonitor_tpu.probes.rated import capability_summary
+
+    monkeypatch.setenv(ENV, "500")
+    assert capability_summary("TPU v5e")["bf16_tflops"] == 500.0
+    # a malformed override falls back to the table figure, warned —
+    # the federation's routing denominators get the same validation
+    # the probe verdict denominators do
+    monkeypatch.setenv(ENV, "garbage")
+    with caplog.at_level(logging.WARNING):
+        card = capability_summary("TPU v5e")
+    assert card["bf16_tflops"] == 197.0
+    assert any("not a number" in r.message for r in caplog.records)

@@ -363,6 +363,10 @@ FLEET_FIELDS = {
     # episodes, front-door degraded state, recent decisions; None when
     # no AdaptiveController is wired
     "adaptive": (dict, type(None)),
+    # multi-cluster federation (ISSUE 19): cluster registry states,
+    # routing, global front-door ledger; None when this controller is
+    # not federating (--federation-config unset)
+    "federation": (dict, type(None)),
 }
 CHECK_FIELDS = {
     "key": str,

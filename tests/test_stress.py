@@ -896,3 +896,163 @@ async def test_soak_two_simulated_hours_bounded_resources():
         assert len(reconciler.recorder._events) <= 5000  # capacity holds
     finally:
         await manager.stop()
+
+
+# -- federation soak (ISSUE 19) ----------------------------------------
+
+N_FED_TENANTS = 24
+N_FED_KEYS = 18
+N_FED_ROUNDS = 12
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_federation_soak_three_clusters_conserve_exactly():
+    """Slow-tier federation soak: three stub clusters take thousands of
+    coalesced submissions across a dozen liveness windows while one
+    cluster goes dark mid-soak and recovers. The invariants under
+    volume: the global per-(tenant, cluster) ledger stays EXACT
+    (``submitted == hits + joins + runs + parked + refused +
+    forwarded``), each membership transition fires exactly one flight
+    bundle, nothing ever lands on the unhealthy cluster while it is
+    dark, and every resolved coalition shares its run's trace_id."""
+    from activemonitor_tpu.federation import (
+        FEDERATION_TENANT,
+        STATE_HEALTHY,
+        STATE_UNHEALTHY,
+        CapabilityRouter,
+        ClusterDescriptor,
+        ClusterRegistry,
+        GlobalFrontDoor,
+        federation_quota,
+    )
+    from activemonitor_tpu.federation.registry import (
+        KIND_CLUSTER_JOIN,
+        KIND_CLUSTER_RECOVERED,
+        KIND_CLUSTER_UNHEALTHY,
+    )
+    from activemonitor_tpu.frontdoor import (
+        OUTCOME_REFUSED,
+        REFUSE_QUOTA,
+        AdmissionController,
+        FrontDoor,
+        TenantQuota,
+    )
+    from activemonitor_tpu.obs.flightrec import FlightRecorder
+    from activemonitor_tpu.obs.history import ResultHistory
+    from activemonitor_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    flightrec = FlightRecorder(clock)
+    registry = ClusterRegistry(
+        clock=clock, liveness_seconds=90.0, flightrec=flightrec
+    )
+    names = ("east", "west", "pod")
+    kinds = {"east": "TPU v5e", "west": "TPU v5e", "pod": "TPU v5p"}
+    for name in names:
+        registry.join(
+            ClusterDescriptor.build(name, device_kind=kinds[name])
+        )
+    gdoor = GlobalFrontDoor(
+        registry,
+        CapabilityRouter(registry),
+        AdmissionController(
+            {"throttled": TenantQuota(rate_per_minute=0.5, burst=1.0)},
+            default_quota=TenantQuota(rate_per_minute=10**9),
+            clock=clock,
+        ),
+        clock=clock,
+    )
+    doors, histories, triggered = {}, {}, {}
+    for name in names:
+        history = ResultHistory(clock)
+        door = FrontDoor(
+            history,
+            AdmissionController(
+                {FEDERATION_TENANT: federation_quota()}, clock=clock
+            ),
+            clock=clock,
+        )
+        probes = []
+        door.bind(lambda ns, hc, _p=probes: _p.append(f"{ns}/{hc}"))
+        gdoor.attach(name, door)
+        doors[name], histories[name], triggered[name] = door, history, probes
+
+    keys = [f"soak/hc-{k:02d}" for k in range(N_FED_KEYS)]
+    tickets = []
+    throttled = []
+    seen = {name: 0 for name in names}
+    stamp = 0.0
+    for round_no in range(N_FED_ROUNDS):
+        # movement polls: "pod" freezes for rounds 4..7 (dark for >3
+        # liveness windows), then starts moving again
+        stamp += 1.0
+        for name in names:
+            if name == "pod" and 4 <= round_no < 8:
+                continue
+            registry.observe(
+                name, {"fleet": {"generated_at": stamp, "replicas": 1}}
+            )
+        registry.sweep()
+        dark = registry.state("pod") == STATE_UNHEALTHY
+        round_tickets = []
+        for key in keys:
+            for i in range(N_FED_TENANTS):
+                round_tickets.append(gdoor.submit(f"tenant-{i:02d}", key))
+        throttled.append(gdoor.submit("throttled", keys[0]))
+        if dark:
+            assert all(t.cluster != "pod" for t in round_tickets)
+        # resolve every probe the round triggered; a coalition's
+        # joiners must all surface their run's trace_id
+        for name in names:
+            fresh = triggered[name][seen[name] :]
+            seen[name] = len(triggered[name])
+            for key in sorted(set(fresh)):
+                histories[name].record(
+                    key,
+                    ok=True,
+                    latency=1.0,
+                    workflow=f"wf-{round_no}",
+                    trace_id=f"tr-{round_no}-{name}-{key}",
+                )
+        results = await asyncio.gather(*(t.wait() for t in round_tickets))
+        by_key = {}
+        for t, r in zip(round_tickets, results):
+            if t.outcome == OUTCOME_REFUSED:
+                continue
+            assert r is not None, (t.outcome, t.check)
+            by_key.setdefault((t.cluster, t.check), set()).add(r.trace_id)
+        for coalition, traces in by_key.items():
+            assert len(traces) == 1, coalition  # one shared trace each
+        tickets.extend(round_tickets)
+        await clock.advance(30.0)
+
+    # membership transitions: one bundle per join, ONE unhealthy and
+    # ONE recovery for "pod" despite many sweeps past the window
+    assert registry.state("pod") == STATE_HEALTHY
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_JOIN)) == 3
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_UNHEALTHY)) == 1
+    assert len(flightrec.bundles(kind=KIND_CLUSTER_RECOVERED)) == 1
+
+    # the throttled tenant burned its burst then got structured
+    # quota refusals, all booked pre-admission
+    refused = [t for t in throttled if t.outcome == OUTCOME_REFUSED]
+    assert len(refused) >= N_FED_ROUNDS - 8
+    assert {t.reason for t in refused} == {REFUSE_QUOTA}
+
+    # the global ledger is exact at volume, per tenant per cluster
+    conservation = gdoor.conservation()
+    assert conservation["ok"], conservation
+    total = N_FED_ROUNDS * (N_FED_TENANTS * N_FED_KEYS + 1)
+    assert conservation["submitted"] == total
+    assert len(tickets) + len(throttled) == total
+    per_cluster = gdoor.snapshot()["per_cluster"]
+    booked = sum(
+        cell["submitted"]
+        for cell in per_cluster.values()
+    )
+    assert booked == total
+    # the fan-in held: at most one probe run per (round, key) coalition
+    # — never one per tenant — and round one ran every key exactly once
+    runs = sum(cell["probe_runs"] for cell in per_cluster.values())
+    assert N_FED_KEYS <= runs <= N_FED_ROUNDS * N_FED_KEYS
