@@ -151,6 +151,12 @@ class OpDef:
     # ("batch_ceilings") — the serving op's admission regime IS the
     # in-flight batch ceiling, the way a collective's is its payload
     accepts_batch: bool = False
+    # fixed op-declared scenario variants (ISSUE 20: the serving-disagg
+    # op's topology ladder — colocated baseline, pool split, split with
+    # prefix cache, split with speculation). Declared on the op, not
+    # the spec: the ladder is the op's contract, and a spec cannot
+    # invent a variant no runner implements
+    variants: Tuple[str, ...] = ()
 
 
 # payload octaves (KB) a payload-accepting op expands over when the
@@ -214,6 +220,19 @@ OPS: Dict[str, OpDef] = {
     "serving": OpDef(
         "serving", ("model",), ("float32",), accepts_batch=True
     ),
+    # the disaggregated serving ladder (ISSUE 20: scheduler/pools.py
+    # split + ops/kv_cache.py prefix cache + speculative decoding):
+    # one cell per topology variant under the SAME mixed hot-prefix
+    # workload, so colocated-vs-split regressions are adjacent rows.
+    # Needs devices for both pools ("model" axis product), so the
+    # {model:16} spec row lands as a structured device-deficit skip —
+    # an infeasible pool shape is a visible skip, not a hole.
+    "serving-disagg": OpDef(
+        "serving-disagg",
+        ("model",),
+        ("float32",),
+        variants=("colo", "split", "split-prefix", "split-spec"),
+    ),
     # recorded front-door traffic replayed through the real submit path
     # (obs/replay.py over obs/journal.py's arrival stream): the bench
     # measures the traffic users actually sent, not a synthetic Poisson
@@ -239,6 +258,7 @@ class CellSpec:
     schedule: str  # "auto" | explicit zoo token | "-" (no collective)
     payload_kb: Optional[int] = None  # payload octave (accepts_payload ops)
     batch: Optional[int] = None  # admission ceiling (accepts_batch ops)
+    variant: Optional[str] = None  # op-declared topology variant
 
     @property
     def mesh_id(self) -> str:
@@ -256,6 +276,8 @@ class CellSpec:
             parts.append(f"{self.payload_kb}kb")
         if self.batch is not None:
             parts.append(f"b{self.batch}")
+        if self.variant is not None:
+            parts.append(self.variant)
         return "/".join(parts)
 
     @property
@@ -301,7 +323,7 @@ DEFAULT_SPEC: dict = {
     "version": MATRIX_VERSION,
     "ops": [
         "flash", "ring", "moe", "pipeline", "decode", "training-step",
-        "hier-allreduce", "serving", "frontdoor-replay",
+        "hier-allreduce", "serving", "serving-disagg", "frontdoor-replay",
     ],
     "meshes": [
         {"sp": 8},
@@ -430,11 +452,17 @@ def expand(
                     if op is not None and op.accepts_batch
                     else [None]
                 )
-                for schedule, payload_kb, batch in (
-                    (s, p, b)
+                variants: List[Optional[str]] = (
+                    list(op.variants)
+                    if op is not None and op.variants
+                    else [None]
+                )
+                for schedule, payload_kb, batch, variant in (
+                    (s, p, b, v)
                     for s in schedules
                     for p in payloads
                     for b in batches
+                    for v in variants
                 ):
                     cell = CellSpec(
                         op=str(op_token),
@@ -443,6 +471,7 @@ def expand(
                         schedule=str(schedule),
                         payload_kb=payload_kb,
                         batch=batch,
+                        variant=variant,
                     )
                     if cell.cell_id in seen:
                         # alias dtype tokens ("bf16" + "bfloat16") and
@@ -949,6 +978,93 @@ def _run_serving(cell: CellSpec, _iters: int, timer) -> CellResult:
     )
 
 
+def _run_serving_disagg(cell: CellSpec, _iters: int, timer) -> CellResult:
+    # _iters: the soak repeats its decode step per generated token.
+    # One cell per topology variant, all under the SAME seeded mixed
+    # hot-prefix workload — colo is the PR 14 engine verbatim, split*
+    # the disaggregated pools (scheduler/pools.py), so a perf delta
+    # between adjacent rows is the topology, not the workload.
+    import jax.numpy as jnp
+
+    from activemonitor_tpu.models.probe_model import (
+        ProbeModelConfig,
+        param_count,
+    )
+    from activemonitor_tpu.ops.kv_cache import kv_bytes_per_token
+    from activemonitor_tpu.probes import serving as serving_probe
+    from activemonitor_tpu.scheduler.serving import mixed_open_loop_requests
+
+    _cell_mesh(cell)  # infeasible pool shapes -> structured device skip
+    dt = jnp.dtype(cell.dtype)
+    cfg = ProbeModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq_len=32, dtype=dt,
+    )
+    variant = cell.variant or "colo"
+    # saturating burst (rate far above service) with a hot shared
+    # prefix, so the prefix-cache variants actually hit
+    requests = mixed_open_loop_requests(
+        6, 1e6, seed=9, prefix_len=4,
+        prompt_len_choices=(8, 12), output_choices=(2, 3),
+        vocab=cfg.vocab_size,
+    )
+    param_bytes = param_count(cfg) * dt.itemsize
+    if variant == "colo":
+        soak = serving_probe.run_soak(
+            cfg, requests, max_batch=4, block_size=4, timer=timer,
+        )
+        cost = serving_probe.roofline_inputs(soak, cfg, 4)
+        seconds = max(cost["seconds"], 1e-9)
+        flops, hbm = cost["flops"], cost["bytes"]
+        conserved = bool(soak.scheduler.conservation()["ok"])
+        block = {"mode": "colocated", "conserved": conserved}
+    else:
+        soak = serving_probe.run_disagg_soak(
+            cfg, requests, prefill_slots=2, decode_slots=4, block_size=4,
+            prefix_cache=variant in ("split-prefix", "split-spec"),
+            speculate=2 if variant == "split-spec" else 0,
+            timer=timer,
+        )
+        seconds = max(soak.decode_busy / max(1, soak.decode_steps), 1e-9)
+        steps = max(1, soak.decode_steps)
+        mean_width = (
+            len(soak.intertoken_ms) / steps if soak.intertoken_ms else 1.0
+        )
+        mean_banked = (
+            sum(soak.banked_samples) / len(soak.banked_samples)
+            if soak.banked_samples
+            else 0.0
+        )
+        flops = 2.0 * param_count(cfg) * max(1.0, mean_width)
+        hbm = float(param_bytes + mean_banked * kv_bytes_per_token(cfg))
+        migration = soak.scheduler.migration_ledger()
+        conserved = bool(
+            soak.scheduler.conservation()["ok"] and migration["ok"]
+        )
+        cache = soak.scheduler.prefix_cache
+        block = {
+            "mode": "disaggregated",
+            "conserved": conserved,
+            "migration_transfers": migration["transfers"],
+            "migration_bytes": migration["bytes_total"],
+            "prefix_hit_ratio": (
+                cache.stats()["hit_ratio"] if cache is not None else None
+            ),
+            "spec_acceptance": soak.scheduler.speculation()["acceptance"],
+        }
+    if not conserved:
+        return CellResult(
+            cell,
+            STATUS_ERROR,
+            reason="token conservation violated across the pool boundary",
+        )
+    return CellResult(
+        cell, STATUS_OK, value=seconds, seconds=seconds,
+        flops=flops, bytes_accessed=hbm,
+        details={"serving_disagg": block},
+    )
+
+
 # canonical seeded workload for a frontdoor-replay cell with no
 # recorded trace wired: a record→replay round trip over this schedule,
 # so the cell still measures the replay machinery deterministically
@@ -1052,6 +1168,7 @@ _RUNNERS: Dict[str, Callable] = {
     "training-step": _run_training_step,
     "hier-allreduce": _run_hier_allreduce,
     "serving": _run_serving,
+    "serving-disagg": _run_serving_disagg,
     "frontdoor-replay": _run_frontdoor_replay,
 }
 

@@ -800,6 +800,45 @@ class MetricsCollector:
             for state in ("healthy", "unhealthy")
         }
 
+        # -- disaggregated serving (ISSUE 20): the prefill/decode pool
+        #    split's ledgers on /metrics — prefix-cache traffic, the
+        #    pool-boundary migration channel, speculative acceptance,
+        #    and per-pool TTFT, fed by the probe's serving_disagg block
+        #    through record_custom_metrics -------------------------------
+        self.serving_prefix_cache_events = Counter(
+            "healthcheck_serving_prefix_cache_events_total",
+            "Content-addressed KV prefix-cache events by kind (hit / "
+            "miss / insert / evict) — block-granular, the conservation "
+            "ledger prompt_tokens == prefix_hits + prefill_tokens "
+            "counts the same hits",
+            ["event"],
+            registry=self.registry,
+        )
+        self.serving_kv_migration_bytes = Counter(
+            "healthcheck_serving_kv_migration_bytes_total",
+            "KV bytes handed prefill pool -> decode pool over the "
+            "migration channel, by tier (ici intra-slice / dcn "
+            "cross-slice; alpha/B-modeled transfers, receipts exact "
+            "to the token)",
+            ["tier"],
+            registry=self.registry,
+        )
+        self.serving_spec_accept_fraction = Gauge(
+            "healthcheck_serving_spec_accept_fraction",
+            "Speculative-decode draft acceptance fraction (accepted "
+            "drafts over drafted) from the latest disagg serving probe "
+            "— the rated-fraction the detector floors judge",
+            registry=self.registry,
+        )
+        self.serving_pool_ttft_seconds = Gauge(
+            "healthcheck_serving_pool_ttft_seconds",
+            "Time-to-first-token quantiles per serving pool topology "
+            "(pool: prefill for the disaggregated split, colocated for "
+            "the single-pool baseline) — same requests, same cost model",
+            ["pool", "quantile"],
+            registry=self.registry,
+        )
+
     # -- run accounting (reference call sites:
     #    healthcheck_controller.go:645-648,673-675,831-834,847-849) ----
     def record_success(
@@ -1343,6 +1382,7 @@ class MetricsCollector:
                 recorded += self._record_custom_metric(hc_name, raw)
             self._record_phase_timings(hc_name, doc.get("timings"))
             self._record_roofline(hc_name, doc.get("roofline"))
+            self._record_serving_disagg(doc.get("serving_disagg"))
         return recorded
 
     @staticmethod
@@ -1493,6 +1533,53 @@ class MetricsCollector:
             )
         if peak > 0:
             self.hbm_peak_bytes.labels(hc_name).set(peak)
+
+    def _record_serving_disagg(self, block) -> None:
+        """The contract's ``serving_disagg`` block (probes/serving.
+        run_disagg details) -> the ISSUE 20 families. Same posture as
+        ``_record_roofline``: malformed fields are skipped, never
+        raised — the probe-side details carry the authoritative copy."""
+        if not isinstance(block, dict) or not block:
+            return
+        counters = block.get("prefix_counters")
+        if isinstance(counters, dict):
+            for event, key in (
+                ("hit", "hits"),
+                ("miss", "misses"),
+                ("insert", "inserted"),
+                ("evict", "evictions"),
+            ):
+                try:
+                    count = float(counters.get(key) or 0.0)
+                except (TypeError, ValueError):
+                    continue
+                if count > 0:
+                    self.serving_prefix_cache_events.labels(event).inc(count)
+        by_tier = block.get("migration_by_tier")
+        if isinstance(by_tier, dict):
+            for tier, row in by_tier.items():
+                if not isinstance(tier, str) or not isinstance(row, dict):
+                    continue
+                try:
+                    n_bytes = float(row.get("bytes") or 0.0)
+                except (TypeError, ValueError):
+                    continue
+                if n_bytes > 0:
+                    self.serving_kv_migration_bytes.labels(tier).inc(n_bytes)
+        acceptance = block.get("spec_acceptance")
+        if isinstance(acceptance, (int, float)):
+            self.serving_spec_accept_fraction.set(float(acceptance))
+        for pool, key in (
+            ("prefill", "disagg_ttft_p99_ms"),
+            ("colocated", "colocated_ttft_p99_ms"),
+        ):
+            try:
+                ttft_ms = float(block.get(key))
+            except (TypeError, ValueError):
+                continue
+            self.serving_pool_ttft_seconds.labels(pool, "p99").set(
+                ttft_ms / 1e3
+            )
 
     def _record_custom_metric(self, hc_name: str, raw) -> int:
         """One contract entry -> one sample; returns 1 when recorded."""

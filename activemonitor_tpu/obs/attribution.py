@@ -92,11 +92,16 @@ _SUBSYSTEM_TOKENS = (
         {
             "ici", "dcn", "allreduce", "allgather", "reducescatter",
             "busbw", "ring", "ringhop", "bidir", "permute", "ppermute",
-            "collective", "collectives", "hop",
+            "collective", "collectives", "hop", "migration",
         },
     ),
     ("hbm", {"hbm", "stream", "memory", "transfer", "h2d", "d2h"}),
     ("compile", {"compile", "compilation", "jit", "lowering"}),
+    # the serving scheduler's own knobs (ISSUE 20): speculative-decode
+    # acceptance is a policy outcome, not a wire or memory property —
+    # a low serving-spec-accept-fraction-of-rated attributes to
+    # scheduling, where the draft depth/gamma knobs live
+    ("scheduling", {"spec", "speculative", "accept", "acceptance"}),
 )
 
 _TOKEN_SPLIT = re.compile(r"[-_.]")

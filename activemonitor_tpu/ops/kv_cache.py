@@ -48,7 +48,9 @@ timing belongs to the caller's injectable timer.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +101,17 @@ class KVBlockManager:
         self._free: List[int] = list(range(n_blocks - 1, -1, -1))
         self._tables: Dict[int, List[int]] = {}
         self._lengths: Dict[int, int] = {}  # tokens appended (banked K/V)
+        # structured-refusal counters (ISSUE 20 small fix): the silent
+        # return-value contracts below stay — the scheduler depends on
+        # them — but each refusal now lands in a named bucket that
+        # stats() surfaces, so a migration bug that frees a handed-off
+        # sequence twice or appends past its reservation is attributable
+        # instead of a quietly ignored no-op
+        self.refusal_counts: Dict[str, int] = {
+            "free_unknown_seq": 0,
+            "append_unknown_seq": 0,
+            "append_over_capacity": 0,
+        }
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` K/V entries."""
@@ -148,9 +161,11 @@ class KVBlockManager:
         structured refusal) when the reserved capacity cannot hold the
         new tokens — the caller under-reserved at admission."""
         if seq_id not in self._tables:
+            self.refusal_counts["append_unknown_seq"] += 1
             return False
         capacity = len(self._tables[seq_id]) * self.block_size
         if self._lengths[seq_id] + n_tokens > capacity:
+            self.refusal_counts["append_over_capacity"] += 1
             return False
         self._lengths[seq_id] += n_tokens
         return True
@@ -158,13 +173,47 @@ class KVBlockManager:
     def free(self, seq_id: int) -> int:
         """Return a retired sequence's blocks to the free list (LIFO —
         the next allocation reuses them first). Returns the number of
-        blocks released; freeing an unknown id is 0, not a raise."""
+        blocks released; freeing an unknown id is 0, not a raise (but
+        the refusal is counted — a double-free is a migration bug)."""
         blocks = self._tables.pop(seq_id, None)
         if blocks is None:
+            self.refusal_counts["free_unknown_seq"] += 1
             return 0
         del self._lengths[seq_id]
         self._free.extend(blocks)
         return len(blocks)
+
+    def transfer_prefix(self, seq_id: int, n_blocks: int, owner_id: int) -> List[int]:
+        """Move the first ``n_blocks`` FULL blocks of ``seq_id``'s
+        table — banked tokens included — to ``owner_id`` (a
+        :class:`PrefixCache` entry's pseudo-sequence). Ownership
+        bookkeeping only: no block ids change and no K/V moves, so a
+        sequence reading through ``[shared..., private...]`` tables sees
+        identical storage before and after. The transferred blocks must
+        be fully banked (a partially-written block has no stable
+        content hash to share under). Caller bugs here ARE raises:
+        this is cache plumbing, not a capacity condition."""
+        if seq_id not in self._tables:
+            raise KeyError(f"sequence {seq_id} holds no blocks")
+        if owner_id in self._tables:
+            raise ValueError(f"owner {owner_id} already holds blocks")
+        if n_blocks < 1 or n_blocks > len(self._tables[seq_id]):
+            raise ValueError(
+                f"cannot transfer {n_blocks} of "
+                f"{len(self._tables[seq_id])} blocks"
+            )
+        moved_tokens = n_blocks * self.block_size
+        if self._lengths[seq_id] < moved_tokens:
+            raise ValueError(
+                f"prefix blocks not fully banked: {self._lengths[seq_id]} "
+                f"tokens over {n_blocks} blocks of {self.block_size}"
+            )
+        moved = self._tables[seq_id][:n_blocks]
+        self._tables[seq_id] = self._tables[seq_id][n_blocks:]
+        self._lengths[seq_id] -= moved_tokens
+        self._tables[owner_id] = list(moved)
+        self._lengths[owner_id] = moved_tokens
+        return list(moved)
 
     def fragmentation_ratio(self) -> float:
         """Reserved-but-unwritten K/V slots over all reserved slots —
@@ -186,6 +235,259 @@ class KVBlockManager:
             "used_blocks": self.used_blocks,
             "sequences": len(self._tables),
             "fragmentation_ratio": self.fragmentation_ratio(),
+            "refusals": dict(self.refusal_counts),
+        }
+
+
+# ---------------------------------------------------------------------
+# content-addressed prefix cache (pure Python — no jax, no clock)
+# ---------------------------------------------------------------------
+
+
+class _PrefixEntry:
+    """One cached full block of shared prompt K/V."""
+
+    __slots__ = ("key", "owner_id", "block", "refcount", "last_used")
+
+    def __init__(self, key: str, owner_id: int, block: int, tick: int):
+        self.key = key
+        self.owner_id = owner_id  # the manager pseudo-sequence holding it
+        self.block = block
+        self.refcount = 0
+        self.last_used = tick
+
+
+class PrefixCache:
+    """Content-addressed, ref-counted index over shared prompt blocks.
+
+    The KV analog of the front door's request coalescing (ISSUE 20):
+    prompt-token prefixes hash at BLOCK granularity — entry *i*'s key
+    is the chained hash of tokens ``[0, (i+1)·block_size)`` — so a hot
+    shared system prompt banks once and every later sequence opening
+    with the same tokens reads the same blocks. Same tokens ⇒ same
+    model ⇒ bitwise-identical K/V, which is why sharing is safe and
+    the serving consistency gate covers it for free.
+
+    Ownership: cached blocks live in the SAME :class:`KVBlockManager`
+    pool as live sequences, held under negative pseudo-sequence ids
+    (one per entry, so eviction is one ``free``). A sequence's
+    effective block table is ``held_blocks(rid) + manager.table(rid)``
+    — the shared prefix in acquisition order, then its private tail.
+
+    Safety contract (the satellite's property tests): an entry is
+    evictable ONLY at refcount zero, eviction order is LRU over a
+    logical tick (no wall clock here — hack/lint.py bans it), and
+    entries free through their own pseudo-id exactly once (the
+    manager's ``free_unknown_seq`` counter is the double-free
+    tripwire).
+
+    Conservation ledger, exact per tenant: every admitted prompt books
+    ``prompt_tokens == prefix_hits + prefill_tokens`` — hits counted at
+    :meth:`acquire` (event time), prefill counted at :meth:`publish`
+    (when the caller reports the remainder actually prefilled) — two
+    independent accounts the ledger cross-checks, the same discipline
+    as the scheduler's per-tenant tallies.
+    """
+
+    def __init__(self, manager: KVBlockManager, max_entries: Optional[int] = None):
+        self.manager = manager
+        self.block_size = manager.block_size
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[str, _PrefixEntry]" = OrderedDict()
+        self._held: Dict[int, List[str]] = {}  # rid -> entry keys (in order)
+        self._tick = 0  # logical LRU clock
+        self._next_owner = -1  # pseudo-sequence ids count down from -1
+        self.counters: Dict[str, int] = {
+            "hits": 0,  # block-granular lookups served from the index
+            "misses": 0,  # block-granular lookups that fell through
+            "inserted": 0,  # blocks published into the index
+            "evictions": 0,  # zero-ref blocks reclaimed (LRU)
+            "hit_tokens": 0,  # prompt tokens NOT re-prefilled
+        }
+        self._tenants: Dict[str, Dict[str, int]] = {}
+
+    # -- hashing -------------------------------------------------------
+    @staticmethod
+    def chain_key(prev: str, block_tokens: Sequence[int]) -> str:
+        """The content address of one more full block: hash of the
+        previous block's key plus this block's token ids — O(1) per
+        block, and equal prefixes get equal chains by induction."""
+        payload = prev + ":" + ",".join(str(int(t)) for t in block_tokens)
+        return hashlib.sha256(payload.encode()).hexdigest()
+
+    def _keys_for(self, tokens: Sequence[int]) -> List[str]:
+        keys: List[str] = []
+        prev = ""
+        for i in range(len(tokens) // self.block_size):
+            block = tokens[i * self.block_size : (i + 1) * self.block_size]
+            prev = self.chain_key(prev, block)
+            keys.append(prev)
+        return keys
+
+    # -- queries -------------------------------------------------------
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Pure read: (shared block ids, hit token count) for the
+        longest cached full-block prefix of ``tokens``. Takes no refs,
+        books no ledger — admission uses it to size the private
+        reservation BEFORE committing."""
+        blocks: List[int] = []
+        for key in self._keys_for(tokens):
+            entry = self._entries.get(key)
+            if entry is None:
+                break
+            blocks.append(entry.block)
+        return blocks, len(blocks) * self.block_size
+
+    @property
+    def entries(self) -> int:
+        return len(self._entries)
+
+    def held_blocks(self, rid: int) -> List[int]:
+        """The shared blocks sequence ``rid`` holds refs on, in prompt
+        order — the front of its effective block table."""
+        return [self._entries[k].block for k in self._held.get(rid, [])]
+
+    def refcount(self, tokens: Sequence[int]) -> List[int]:
+        """Refcounts along ``tokens``' cached prefix (tests/debugging)."""
+        out = []
+        for key in self._keys_for(tokens):
+            entry = self._entries.get(key)
+            if entry is None:
+                break
+            out.append(entry.refcount)
+        return out
+
+    # -- the acquire / publish / release lifecycle ---------------------
+    def _tenant_row(self, tenant: str) -> Dict[str, int]:
+        return self._tenants.setdefault(
+            tenant, {"prompt_tokens": 0, "prefix_hits": 0, "prefill_tokens": 0}
+        )
+
+    def acquire(self, rid: int, tenant: str, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Take refs on ``tokens``' cached prefix for sequence ``rid``
+        and book the per-tenant ledger's admission side. Returns
+        (shared block ids, hit tokens); the caller reserves and
+        prefills only ``len(tokens) - hit`` privately."""
+        if rid in self._held:
+            raise ValueError(f"sequence {rid} already holds prefix refs")
+        self._tick += 1
+        keys = self._keys_for(tokens)
+        held: List[str] = []
+        for key in keys:
+            entry = self._entries.get(key)
+            if entry is None:
+                break
+            entry.refcount += 1
+            entry.last_used = self._tick
+            self._entries.move_to_end(key)
+            held.append(key)
+        self._held[rid] = held
+        hit_tokens = len(held) * self.block_size
+        self.counters["hits"] += len(held)
+        self.counters["misses"] += len(keys) - len(held)
+        self.counters["hit_tokens"] += hit_tokens
+        row = self._tenant_row(tenant)
+        row["prompt_tokens"] += len(tokens)
+        row["prefix_hits"] += hit_tokens
+        return [self._entries[k].block for k in held], hit_tokens
+
+    def publish(self, rid: int, tenant: str, tokens: Sequence[int]) -> int:
+        """The caller prefilled ``rid``'s non-shared remainder: book
+        the ledger's prefill side and adopt the newly banked FULL
+        blocks into the index (ownership transfer out of the
+        sequence's table — no data moves, the ids are unchanged, so
+        the sequence's effective table is stable). Partial tail blocks
+        stay private. Returns the number of blocks published."""
+        held = self._held.get(rid)
+        if held is None:
+            raise ValueError(f"sequence {rid} never acquired (admission bug)")
+        keys = self._keys_for(tokens)
+        hit_tokens = len(held) * self.block_size
+        row = self._tenant_row(tenant)
+        row["prefill_tokens"] += len(tokens) - hit_tokens
+        published = 0
+        self._tick += 1
+        for key in keys[len(held) :]:
+            if key in self._entries:
+                # a concurrent admission published the same content
+                # first — share it? No: this sequence's OWN copy stays
+                # private (its table already points there); adopting a
+                # duplicate would strand the existing entry's block.
+                break
+            owner = self._next_owner
+            self._next_owner -= 1
+            moved = self.manager.transfer_prefix(rid, 1, owner)
+            entry = _PrefixEntry(key, owner, moved[0], self._tick)
+            entry.refcount = 1  # held by rid until release
+            self._entries[key] = entry
+            held.append(key)
+            published += 1
+            self.counters["inserted"] += 1
+        if self.max_entries is not None:
+            overflow = len(self._entries) - self.max_entries
+            if overflow > 0:
+                self.evict(blocks_needed=overflow)
+        return published
+
+    def release(self, rid: int) -> int:
+        """Sequence ``rid`` left the prefill pool (migrated or
+        retired): drop its refs. Entries stay cached at refcount zero
+        — that is the whole point — until LRU eviction needs the
+        blocks. Unknown/double release is a counted no-op (0), the
+        same structured-refusal posture as the manager."""
+        held = self._held.pop(rid, None)
+        if held is None:
+            return 0
+        for key in held:
+            self._entries[key].refcount -= 1
+        return len(held)
+
+    def evict(self, blocks_needed: int = 1) -> int:
+        """Reclaim up to ``blocks_needed`` blocks from LRU entries at
+        refcount ZERO (a live shared block is never evicted). Returns
+        blocks actually freed — the caller retries its allocation and
+        takes the structured refusal if the cache could not help."""
+        freed = 0
+        while freed < blocks_needed:
+            victim = None
+            for key, entry in self._entries.items():  # oldest-first
+                if entry.refcount == 0:
+                    victim = key
+                    break
+            if victim is None:
+                break
+            entry = self._entries.pop(victim)
+            freed += self.manager.free(entry.owner_id)
+            self.counters["evictions"] += 1
+        return freed
+
+    # -- accounting ----------------------------------------------------
+    def ledger(self) -> dict:
+        """The per-tenant conservation ledger: ``prompt_tokens ==
+        prefix_hits + prefill_tokens`` EXACT for every tenant (hits
+        booked at acquire, prefill at publish — two event-time
+        accounts), plus the global counters. ``ok`` gates the serving
+        probe exactly like the scheduler's conservation bit."""
+        tenants_ok = all(
+            row["prompt_tokens"] == row["prefix_hits"] + row["prefill_tokens"]
+            for row in self._tenants.values()
+        )
+        return {
+            "tenants": {t: dict(r) for t, r in sorted(self._tenants.items())},
+            "counters": dict(self.counters),
+            "entries": len(self._entries),
+            "live_refs": sum(e.refcount for e in self._entries.values()),
+            "ok": tenants_ok,
+        }
+
+    def stats(self) -> dict:
+        lookups = self.counters["hits"] + self.counters["misses"]
+        return {
+            "entries": len(self._entries),
+            "shared_blocks": len(self._entries),
+            "live_refs": sum(e.refcount for e in self._entries.values()),
+            "hit_ratio": self.counters["hits"] / lookups if lookups else 0.0,
+            "counters": dict(self.counters),
         }
 
 
@@ -280,6 +582,35 @@ def bank_prompt(
     return {
         "k": storage["k"].at[:, blocks].set(blocked(prompt_k)),
         "v": storage["v"].at[:, blocks].set(blocked(prompt_v)),
+    }
+
+
+def migrate_blocks(
+    src: Dict[str, jax.Array],
+    dst: Dict[str, jax.Array],
+    src_blocks: jax.Array,
+    dst_blocks: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Copy one sequence's banked K/V blocks from the prefill pool's
+    storage into the decode pool's — the data half of the KV handoff
+    (ISSUE 20). A pure gather/scatter along the block dim: the source
+    table may interleave shared prefix-cache blocks with private ones
+    (the copy private-izes them on the decode side — decode pools do
+    not share), and block contents move verbatim, so the consistency
+    gate's logit check spans the pool boundary. Transfer COST is the
+    migration channel's α/B model (scheduler/pools.py), not measured
+    here — on one host this is a memcpy; the model prices the ICI/DCN
+    wire."""
+    src_blocks = jnp.asarray(src_blocks, jnp.int32)
+    dst_blocks = jnp.asarray(dst_blocks, jnp.int32)
+    if src_blocks.shape != dst_blocks.shape:
+        raise ValueError(
+            f"block table shapes differ: {src_blocks.shape} vs "
+            f"{dst_blocks.shape} — a handoff must map 1:1"
+        )
+    return {
+        "k": dst["k"].at[:, dst_blocks].set(src["k"][:, src_blocks]),
+        "v": dst["v"].at[:, dst_blocks].set(src["v"][:, src_blocks]),
     }
 
 

@@ -296,6 +296,39 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--seed", type=int, default=0)
 
+    p = sub.add_parser(
+        "serving-disagg",
+        help="disaggregated serving: prefill/decode pool split with "
+        "priced KV migration, content-addressed prefix caching, and "
+        "speculative decoding under a mixed hot-prefix workload "
+        "(per-pool TTFT/tokens-per-s, colocated-vs-split comparison; "
+        "gates on token-exact pool-boundary conservation, the "
+        "per-tenant prefix ledger, and greedy-identical emissions)",
+    )
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--prefill-slots", type=int, default=2)
+    p.add_argument("--decode-slots", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=4)
+    p.add_argument("--rate-rps", type=float, default=60.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--no-prefix-cache",
+        action="store_true",
+        help="disable the content-addressed prefix cache",
+    )
+    p.add_argument(
+        "--speculate",
+        type=int,
+        default=2,
+        help="draft tokens per speculative round (0 disables)",
+    )
+    p.add_argument(
+        "--cross-slice",
+        action="store_true",
+        help="price KV migration at the DCN tier instead of ICI",
+    )
+
     p = sub.add_parser("memory", help="HBM usage stats + headroom allocation smoke")
     p.add_argument("--probe-gb", type=float, default=1.0)
 
@@ -553,6 +586,21 @@ def _dispatch(args) -> int:
             rate_rps=args.rate_rps,
             seed=args.seed,
             roofline=args.roofline,
+        )
+    elif args.probe == "serving-disagg":
+        from activemonitor_tpu.probes import serving
+
+        result = serving.run_disagg(
+            tiny=args.tiny,
+            n_requests=args.requests,
+            prefill_slots=args.prefill_slots,
+            decode_slots=args.decode_slots,
+            block_size=args.block_size,
+            rate_rps=args.rate_rps,
+            seed=args.seed,
+            prefix_cache=not args.no_prefix_cache,
+            speculate=args.speculate,
+            cross_slice=args.cross_slice,
         )
     elif args.probe == "memory":
         from activemonitor_tpu.probes import memory

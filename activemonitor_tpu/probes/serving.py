@@ -587,6 +587,10 @@ def run(
             "checked_sequences": len(soak.logit_trace),
             "conservation": conservation,
             "refusals": dict(soak.scheduler.refusals),
+            # the block manager's structured refusal counters (ISSUE 20
+            # small fix): a double-free or over-capacity append is a
+            # scheduler bug — nonzero here is attributable, not silent
+            "kv_refusals": soak.scheduler.manager.stats()["refusals"],
             "kv_frag_peak": max(soak.frag_samples, default=0.0),
             "kv_bytes_per_token": bytes_per_token,
             "ttft_decomposition": ttft_split,
@@ -609,6 +613,725 @@ def run(
             seconds=cost["seconds"],
             model_flops=cost["flops"],
             model_bytes=cost["bytes"],
+            enabled=roofline,
+        ),
+    )
+    return result
+
+
+# ---------------------------------------------------------------------
+# disaggregated serving (ISSUE 20): prefill/decode pool split with KV
+# handoff, content-addressed prefix caching, speculative decoding
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class DisaggSoakResult:
+    """One disaggregated soak's measurements — the two-lane analog of
+    :class:`SoakResult` (same duck-typed surface for the static
+    consistency check: ``scheduler.completed`` / ``logit_trace`` /
+    ``prompts``)."""
+
+    scheduler: object  # DisaggregatedScheduler
+    elapsed: float = 0.0  # max of the two lane clocks at drain
+    prefill_busy: float = 0.0  # prefill-pool engine-busy virtual seconds
+    decode_busy: float = 0.0
+    decode_steps: int = 0  # real target decode steps (verify included)
+    spec_rounds: int = 0
+    prefill_tokens: int = 0  # prompt tokens actually prefilled (hits excluded)
+    ttft_ms: List[float] = field(default_factory=list)
+    intertoken_ms: List[float] = field(default_factory=list)
+    migration_ms: List[float] = field(default_factory=list)  # modeled, per transfer
+    prefill_frag_samples: List[float] = field(default_factory=list)
+    decode_frag_samples: List[float] = field(default_factory=list)
+    banked_samples: List[int] = field(default_factory=list)  # decode pool
+    logit_trace: Dict[int, List] = field(default_factory=dict)
+    prompts: Dict[int, jax.Array] = field(default_factory=dict)
+
+    @property
+    def tokens_generated(self) -> int:
+        return self.scheduler.conservation()["tokens_emitted"]
+
+    @property
+    def decode_tokens_per_second(self) -> float:
+        return self.tokens_generated / max(self.decode_busy, 1e-9)
+
+    @property
+    def prefill_tokens_per_second(self) -> float:
+        return self.prefill_tokens / max(self.prefill_busy, 1e-9)
+
+
+def default_disagg_costs() -> StepCosts:
+    """The scripted cost model the disagg probe replays when no real
+    per-op timing is wanted (CPU tier-1): prefill linear in prompt
+    tokens (compute-bound — 2·P FLOPs per token), decode flat per step
+    (memory-bound — the step streams the weights regardless of width).
+    Virtual seconds, deterministic; the probe labels the evidence
+    ``cost_source: scripted`` so nobody reads it as a TPU measurement."""
+    per_token = 2e-3
+    return StepCosts(
+        prefill=lambda plen: per_token * plen,
+        decode=lambda width: per_token,
+    )
+
+
+def run_disagg_soak(
+    cfg: ProbeModelConfig,
+    requests: Sequence[Request],
+    *,
+    prefill_slots: int,
+    decode_slots: int,
+    block_size: int = 4,
+    prefill_blocks: Optional[int] = None,
+    decode_blocks: Optional[int] = None,
+    prefix_cache: bool = False,
+    speculate: int = 0,
+    draft_layers: Optional[int] = None,
+    cross_slice: bool = False,
+    costs: Optional[StepCosts] = None,
+    timer: Callable[[], float] = time.monotonic,
+    collect: int = 0,
+    seed: int = 0,
+    params: Optional[Dict] = None,
+):
+    """Run one disaggregated soak: prefill pool and decode pool on a
+    TWO-LANE virtual clock (the pools are separate worker sets — that
+    independence is the disaggregation win), KV block tables handed off
+    over the priced migration channel with the actual K/V copied
+    between the pools' storages (``ops/kv_cache.migrate_blocks``), an
+    optional content-addressed prefix cache on the prefill pool, and
+    optional early-exit speculative decoding on the decode pool.
+
+    Speculation drafts with the target's own FIRST ``draft_layers``
+    layers (a shrunk ``ProbeModelConfig`` sharing the real params and a
+    throwaway slice of the banked K/V) and verifies with the real
+    target step, feeding only already-confirmed tokens — so every
+    emitted token is EXACTLY what plain greedy decode would emit and
+    the static consistency gate covers the speculative path unchanged.
+    The virtual clock charges one target-step cost per verify ROUND
+    (the batched-verify memory-bound claim) plus a layer-fraction cost
+    per draft step; acceptance is measured, not assumed.
+    """
+    import dataclasses as _dc
+
+    from activemonitor_tpu.ops.kv_cache import PrefixCache, migrate_blocks
+    from activemonitor_tpu.scheduler.pools import (
+        DisaggregatedScheduler,
+        PoolTopology,
+    )
+
+    if params is None:
+        params = init_params(jax.random.key(seed), cfg)
+    probe_key = jax.random.fold_in(jax.random.key(seed), 1)
+    arith = KVBlockManager(1, block_size)
+    pre_max_blk = max(arith.blocks_for(r.prompt_len) for r in requests)
+    dec_max_blk = max(
+        arith.blocks_for(r.prompt_len + r.output_tokens + max(0, speculate))
+        for r in requests
+    )
+    if prefill_blocks is None:
+        # slots' worth of prompts plus the same again for cache entries
+        prefill_blocks = prefill_slots * pre_max_blk * (2 if prefix_cache else 1)
+    if decode_blocks is None:
+        decode_blocks = decode_slots * dec_max_blk
+    pm = KVBlockManager(prefill_blocks, block_size)
+    dm = KVBlockManager(decode_blocks, block_size)
+    pre_trash, dec_trash = prefill_blocks, decode_blocks
+    storage_p = init_paged_kv(cfg, prefill_blocks + 1, block_size)
+    storage_d = init_paged_kv(cfg, decode_blocks + 1, block_size)
+    cache = PrefixCache(pm) if prefix_cache else None
+    sched = DisaggregatedScheduler(
+        requests,
+        PoolTopology.disaggregated(prefill_slots, decode_slots, cross_slice),
+        prefill_manager=pm,
+        decode_manager=dm,
+        bytes_per_token=float(kv_bytes_per_token(cfg)),
+        prefix_cache=cache,
+    )
+    prompts = {
+        r.rid: (
+            jnp.asarray([list(r.prompt_tokens)], jnp.int32)
+            if r.prompt_tokens is not None
+            else jax.random.randint(
+                jax.random.fold_in(probe_key, r.rid),
+                (1, r.prompt_len),
+                0,
+                cfg.vocab_size,
+            )
+        )
+        for r in requests
+    }
+    collected = {r.rid for r in requests if r.rid < collect}
+
+    step_fn, prefill_fn = _jitted(cfg)
+    stage_cap = max(pre_max_blk, dec_max_blk) * block_size
+    draft_step = None
+    k_layers = 0
+    if speculate > 0:
+        k_layers = draft_layers or max(1, cfg.n_layers // 2)
+        cfg_draft = _dc.replace(cfg, n_layers=k_layers)
+        draft_step = _jitted(cfg_draft)[0]
+        params_draft = {**params, "layers": params["layers"][:k_layers]}
+
+    # warm the compiles off the virtual timeline
+    for plen in sorted({r.prompt_len for r in requests}):
+        warm = prefill_fn(
+            params,
+            _fresh_prefill_cache(cfg, stage_cap),
+            jnp.zeros((1, plen), jnp.int32),
+        )
+        jax.block_until_ready(warm[0])
+    warm_tables = jnp.full((decode_slots, dec_max_blk), dec_trash, jnp.int32)
+    warm_logits, storage_d = step_fn(
+        params,
+        storage_d,
+        jnp.zeros((decode_slots,), jnp.int32),
+        jnp.zeros((decode_slots,), jnp.int32),
+        warm_tables,
+    )
+    jax.block_until_ready(warm_logits)
+
+    result = DisaggSoakResult(
+        scheduler=sched,
+        prompts={rid: prompts[rid] for rid in collected},
+    )
+    costs_live = costs
+    t_pre = 0.0
+    t_dec = 0.0
+    ready_at: Dict[int, float] = {}
+
+    def _charge(measured_start: float, scripted: float) -> float:
+        if costs_live is not None:
+            return scripted
+        return max(0.0, timer() - measured_start)
+
+    while not sched.done:
+        moved = False
+        # -- pool boundary: drain the handoff queue, copy the K/V ------
+        for rec in sched.pump_migrations(t_pre):
+            src = rec["src_blocks"]
+            dst = rec["dst_blocks"][: len(src)]
+            storage_d = migrate_blocks(storage_p, storage_d, src, dst)
+            ready_at[rec["rid"]] = rec["ready_at"]
+            result.migration_ms.append(rec["seconds"] * 1e3)
+            moved = True
+        # -- prefill lane ---------------------------------------------
+        sched.sample_prefill_occupancy()
+        for seq in sched.admit(t_pre):
+            rid = seq.req.rid
+            plen = seq.req.prompt_len
+            hit = sched.hit_tokens(rid)
+            start = timer()
+            logits, staged = prefill_fn(
+                params, _fresh_prefill_cache(cfg, stage_cap), prompts[rid]
+            )
+            if hit < plen:
+                # bank only the non-cached remainder into the private
+                # table (the shared prefix is already banked — that IS
+                # the hit); block-granular hits keep this block-aligned
+                storage_p = bank_prompt(
+                    storage_p,
+                    staged["k"][:, 0, :, hit:plen],
+                    staged["v"][:, 0, :, hit:plen],
+                    jnp.asarray(pm.table(rid), jnp.int32),
+                )
+                jax.block_until_ready(storage_p["k"])
+            elapsed = _charge(start, costs.prefill(plen - hit) if costs else 0.0)
+            t_pre += elapsed
+            result.prefill_busy += elapsed
+            result.prefill_tokens += plen - hit
+            result.prefill_frag_samples.append(pm.fragmentation_ratio())
+            token = int(jnp.argmax(logits[0]))
+            if rid in collected:
+                result.logit_trace.setdefault(rid, []).append(
+                    jax.device_get(logits[0])
+                )
+            sched.record_first_token(seq, token, t_pre)
+            result.ttft_ms.append((t_pre - seq.req.arrival) * 1e3)
+            moved = True
+        # -- decode lane ----------------------------------------------
+        batch = sched.decode_batch(t_dec)
+        if not batch and sched.decode_active:
+            pending = [
+                ready_at.get(s.req.rid, 0.0)
+                for s in sched.decode_active.values()
+            ]
+            horizon = min(pending)
+            if horizon > t_dec:
+                t_dec = horizon
+                batch = sched.decode_batch(t_dec)
+        if batch and speculate > 0:
+            storage_d, cost = _speculative_round(
+                sched,
+                batch,
+                params,
+                params_draft,
+                step_fn,
+                draft_step,
+                storage_d,
+                dm,
+                dec_trash,
+                dec_max_blk,
+                decode_slots,
+                speculate,
+                k_layers,
+                cfg.n_layers,
+                costs_live,
+                timer,
+                t_dec,
+                collected,
+                result,
+            )
+            t_dec += cost
+            result.decode_busy += cost
+            moved = True
+        elif batch:
+            tokens = [0] * decode_slots
+            positions = [0] * decode_slots
+            tables = [[dec_trash] * dec_max_blk for _ in range(decode_slots)]
+            for seq in batch:
+                tokens[seq.slot] = seq.tokens[-1]
+                positions[seq.slot] = seq.req.prompt_len + seq.generated - 1
+                row = dm.table(seq.req.rid)
+                tables[seq.slot] = row + [dec_trash] * (dec_max_blk - len(row))
+            start = timer()
+            logits, storage_d = step_fn(
+                params,
+                storage_d,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(tables, jnp.int32),
+            )
+            jax.block_until_ready(logits)
+            elapsed = _charge(start, costs.decode(len(batch)) if costs else 0.0)
+            result.decode_steps += 1
+            result.intertoken_ms.extend([elapsed * 1e3] * len(batch))
+            result.banked_samples.append(dm.banked_tokens)
+            result.decode_frag_samples.append(dm.fragmentation_ratio())
+            by_slot = {s.slot: int(jnp.argmax(logits[s.slot])) for s in batch}
+            for seq in batch:
+                if seq.req.rid in collected:
+                    result.logit_trace.setdefault(seq.req.rid, []).append(
+                        jax.device_get(logits[seq.slot])
+                    )
+            t_dec += elapsed
+            result.decode_busy += elapsed
+            sched.record_decode_step(by_slot, t_dec)
+            moved = True
+        if not moved:
+            nxt = sched.next_arrival()
+            if nxt is not None and nxt > t_pre:
+                t_pre = nxt
+                continue
+            raise RuntimeError(
+                "disagg soak stalled: no admissible, migratable or "
+                "decodable work but the scheduler is not done"
+            )
+    result.elapsed = max(t_pre, t_dec)
+    return result
+
+
+def _speculative_round(
+    sched,
+    batch,
+    params,
+    params_draft,
+    step_fn,
+    draft_step,
+    storage_d,
+    dm,
+    dec_trash,
+    dec_max_blk,
+    decode_slots,
+    speculate,
+    k_layers,
+    n_layers,
+    costs,
+    timer,
+    t_dec,
+    collected,
+    result,
+):
+    """One draft/verify round on the decode pool. Draft: ``speculate``
+    early-exit steps on a throwaway K/V slice (its bankings die with
+    the slice). Verify: sequential target steps feeding ONLY confirmed
+    tokens, so banked K/V and emitted tokens are exactly greedy's; a
+    mismatch or completion drops the slot out of the round (trash-table
+    padding keeps the batch shape static). Returns the updated storage
+    and the round's charged seconds — scripted cost charges ONE target
+    step per round plus a ``k/L`` fraction per draft step (the modeled
+    batched-verify claim; measured mode charges real seconds)."""
+    start = timer()
+    # ---- draft ------------------------------------------------------
+    draft_storage = {
+        "k": storage_d["k"][:k_layers],
+        "v": storage_d["v"][:k_layers],
+    }
+    fed = {s.slot: s.tokens[-1] for s in batch}
+    pos = {s.slot: s.req.prompt_len + s.generated - 1 for s in batch}
+    rows = {}
+    for s in batch:
+        row = dm.table(s.req.rid)
+        rows[s.slot] = row + [dec_trash] * (dec_max_blk - len(row))
+    proposals: Dict[int, List[int]] = {s.slot: [] for s in batch}
+    for _ in range(speculate):
+        tokens = [0] * decode_slots
+        positions = [0] * decode_slots
+        tables = [[dec_trash] * dec_max_blk for _ in range(decode_slots)]
+        for s in batch:
+            tokens[s.slot] = fed[s.slot]
+            positions[s.slot] = pos[s.slot]
+            tables[s.slot] = rows[s.slot]
+        dlogits, draft_storage = draft_step(
+            params_draft,
+            draft_storage,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(tables, jnp.int32),
+        )
+        for s in batch:
+            t = int(jnp.argmax(dlogits[s.slot]))
+            proposals[s.slot].append(t)
+            fed[s.slot] = t
+            pos[s.slot] += 1
+    # ---- verify -----------------------------------------------------
+    active = {s.slot: s for s in batch}
+    emitted: Dict[int, List[int]] = {s.slot: [] for s in batch}
+    accepted: Dict[int, int] = {s.slot: 0 for s in batch}
+    vfed = {s.slot: s.tokens[-1] for s in batch}
+    vpos = {s.slot: s.req.prompt_len + s.generated - 1 for s in batch}
+    for j in range(speculate + 1):
+        if not active:
+            break
+        tokens = [0] * decode_slots
+        positions = [0] * decode_slots
+        tables = [[dec_trash] * dec_max_blk for _ in range(decode_slots)]
+        for slot in active:
+            tokens[slot] = vfed[slot]
+            positions[slot] = vpos[slot]
+            tables[slot] = rows[slot]
+        logits, storage_d = step_fn(
+            params,
+            storage_d,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(tables, jnp.int32),
+        )
+        jax.block_until_ready(logits)
+        result.decode_steps += 1
+        for slot, seq in list(active.items()):
+            t_true = int(jnp.argmax(logits[slot]))
+            emitted[slot].append(t_true)
+            if seq.req.rid in collected:
+                result.logit_trace.setdefault(seq.req.rid, []).append(
+                    jax.device_get(logits[slot])
+                )
+            matched = j < speculate and t_true == proposals[slot][j]
+            if matched:
+                accepted[slot] += 1
+            completes = (
+                seq.generated + len(emitted[slot]) >= seq.req.output_tokens
+            )
+            if matched and not completes:
+                vfed[slot] = t_true
+                vpos[slot] += 1
+            else:
+                del active[slot]
+    width = len(batch)
+    scripted = 0.0
+    if costs is not None:
+        scripted = costs.decode(width) * (1.0 + speculate * k_layers / n_layers)
+    elapsed = scripted if costs is not None else max(0.0, timer() - start)
+    result.spec_rounds += 1
+    result.banked_samples.append(dm.banked_tokens)
+    result.decode_frag_samples.append(dm.fragmentation_ratio())
+    for slot in emitted:
+        n = len(emitted[slot])
+        if n:
+            result.intertoken_ms.extend([elapsed * 1e3 / n] * n)
+    sched.record_speculative_step(
+        {slot: toks for slot, toks in emitted.items() if toks},
+        {slot: speculate for slot in emitted},
+        accepted,
+        t_dec + elapsed,
+    )
+    return storage_d, elapsed
+
+
+def run_disagg(
+    tiny: bool = False,
+    n_requests: int = 12,
+    prefill_slots: int = 2,
+    decode_slots: int = 4,
+    block_size: int = 4,
+    rate_rps: float = 60.0,
+    seed: int = 0,
+    check_sequences: int = 2,
+    prefix_cache: bool = True,
+    speculate: int = 2,
+    cross_slice: bool = False,
+    roofline: bool = True,
+    costs: Optional[StepCosts] = None,
+    timer: Callable[[], float] = time.monotonic,
+) -> ProbeResult:
+    """The disaggregated serving probe (ISSUE 20): one mixed open-loop
+    workload with a hot shared prefix (scheduler/arrivals.
+    TenantPrefixMix) served twice under the SAME scripted cost model —
+    once colocated (the PR 14 scheduler verbatim), once split across
+    prefill/decode pools with prefix caching and speculative decoding —
+    and the TTFT comparison exported with per-pool throughput, the
+    migration channel's receipts, the prefix-cache ledger and the
+    speculative acceptance fraction.
+
+    Evidence discipline: the clock is the scripted virtual one
+    (``default_disagg_costs`` unless the caller scripts their own), so
+    the TTFT claim is deterministic, seed-reproducible MODEL evidence —
+    ``details["serving_disagg"]["cost_source"] = "scripted"`` and
+    bench.py labels the CPU path ``interpret_mode: true``. The logits
+    underneath are REAL compute either way: the static consistency gate
+    spans prefill, the block migration copy, and the speculative verify
+    path."""
+    cfg = tiny_config() if tiny else ProbeModelConfig()
+    prompt_lens = (12, 16) if tiny else (16, 32, 48)
+    outputs = (2, 3, 5) if tiny else (6, 10)
+    prefix_len = 2 * block_size  # two shared blocks — hits are visible
+    timings = PhaseTimings(monotonic=timer)
+    params = init_params(jax.random.key(seed), cfg)
+    if costs is None:
+        costs = default_disagg_costs()
+
+    from activemonitor_tpu.scheduler.serving import mixed_open_loop_requests
+
+    requests = mixed_open_loop_requests(
+        n_requests,
+        rate_rps,
+        seed,
+        prefix_len=prefix_len,
+        prompt_len_choices=prompt_lens,
+        output_choices=outputs,
+        vocab=cfg.vocab_size,
+    )
+    max_batch = decode_slots  # the colocated pool gets the same width
+    with timings.phase("soak-colocated"):
+        colo = run_soak(
+            cfg,
+            requests,
+            max_batch=max_batch,
+            block_size=block_size,
+            costs=costs,
+            seed=seed,
+            params=params,
+        )
+    with timings.phase("soak-disagg"):
+        soak = run_disagg_soak(
+            cfg,
+            requests,
+            prefill_slots=prefill_slots,
+            decode_slots=decode_slots,
+            block_size=block_size,
+            prefix_cache=prefix_cache,
+            speculate=speculate,
+            cross_slice=cross_slice,
+            costs=costs,
+            collect=check_sequences,
+            seed=seed,
+            params=params,
+        )
+    with timings.phase("verify"):
+        max_rel_diff = _check_against_static(cfg, params, soak)
+
+    consistent = max_rel_diff <= 0.05
+    conservation = soak.scheduler.conservation()
+    migration = soak.scheduler.migration_ledger()
+    speculation = soak.scheduler.speculation()
+    prefix_ledger = (
+        soak.scheduler.prefix_cache.ledger()
+        if soak.scheduler.prefix_cache is not None
+        else None
+    )
+    pool_stats = soak.scheduler.pool_stats()
+    kv_refusals = {
+        "prefill": pool_stats["prefill"]["refusals"],
+        "decode": pool_stats["decode"]["refusals"],
+    }
+    clean_kv = all(
+        count == 0 for pool in kv_refusals.values() for count in pool.values()
+    )
+    ok = (
+        consistent
+        and bool(conservation["ok"])
+        and bool(migration["ok"])
+        and bool(speculation["ok"])
+        and (prefix_ledger is None or bool(prefix_ledger["ok"]))
+        and clean_kv
+    )
+
+    colo_p99 = _percentile(colo.ttft_ms, 0.99)
+    disagg_p99 = _percentile(soak.ttft_ms, 0.99)
+    improvement = (colo_p99 - disagg_p99) / max(colo_p99, 1e-9)
+    cache_stats = pool_stats.get("prefix_cache") or {}
+    hit_ratio = float(cache_stats.get("hit_ratio", 0.0))
+    evictions = float((cache_stats.get("counters") or {}).get("evictions", 0))
+
+    metrics = [
+        ProbeMetric(
+            "serving-pool-prefill-ttft-p99-ms",
+            disagg_p99,
+            help="Time to first token p99 under disaggregated pools "
+            "(TTFT lives entirely in the prefill pool)",
+        ),
+        ProbeMetric(
+            "serving-pool-prefill-tokens-per-s",
+            soak.prefill_tokens_per_second,
+            help="Prompt tokens prefilled per prefill-pool busy second "
+            "(prefix-cache hits excluded — they were never recomputed)",
+        ),
+        ProbeMetric(
+            "serving-pool-decode-tokens-per-s",
+            soak.decode_tokens_per_second,
+            help="Generated tokens per decode-pool busy second",
+        ),
+        ProbeMetric(
+            "serving-disagg-ttft-improvement",
+            improvement,
+            help="Fractional TTFT p99 improvement of disaggregated+"
+            "prefix-cache over colocated, same requests and cost model",
+        ),
+        ProbeMetric(
+            "serving-kv-migration-bytes",
+            float(migration["bytes_total"]),
+            help="Total KV bytes handed prefill pool -> decode pool "
+            "over the migration channel (alpha/B modeled)",
+        ),
+        ProbeMetric(
+            "serving-kv-migration-p99-ms",
+            _percentile(soak.migration_ms, 0.99),
+            help="Per-transfer modeled migration latency p99 (ICI "
+            "intra-slice, DCN cross-slice)",
+        ),
+        ProbeMetric(
+            "serving-prefix-hit-ratio",
+            hit_ratio,
+            help="Block-granular prefix-cache hit ratio (hits over "
+            "lookups); 0 when the cache is disabled",
+        ),
+        ProbeMetric(
+            "serving-prefix-evictions",
+            evictions,
+            help="Prefix-cache entries evicted (LRU, refcount zero only)",
+        ),
+        ProbeMetric(
+            "serving-disagg-consistency",
+            1.0 if consistent else 0.0,
+            help="1 when disaggregated logits (prefill, migrated KV, "
+            "speculative verify) match the static decode path",
+        ),
+    ]
+    if speculation["acceptance"] is not None:
+        metrics.append(
+            ProbeMetric(
+                "serving-spec-accept-fraction-of-rated",
+                float(speculation["acceptance"]),
+                help="Speculative-decode draft acceptance fraction "
+                "(accepted drafts over drafted) — a rated-fraction "
+                "metric: analysis/detector.py floors and am-tpu why "
+                "attribution judge it like any other subsystem",
+            )
+        )
+
+    serving_disagg = {
+        "mode": pool_stats["mode"],
+        "prefill_slots": prefill_slots,
+        "decode_slots": decode_slots,
+        "cross_slice": cross_slice,
+        "prefix_cache": prefix_cache,
+        "speculate": speculate,
+        "colocated_ttft_p99_ms": colo_p99,
+        "disagg_ttft_p99_ms": disagg_p99,
+        "ttft_improvement": improvement,
+        "prefix_hit_ratio": hit_ratio,
+        "prefix_evictions": evictions,
+        "prefix_counters": dict(cache_stats.get("counters") or {}),
+        "spec_acceptance": speculation["acceptance"],
+        "migration_transfers": migration["transfers"],
+        "migration_bytes_total": migration["bytes_total"],
+        "migration_by_tier": migration["by_tier"],
+        "cost_source": "scripted",
+    }
+    result = ProbeResult(
+        ok=ok,
+        summary=(
+            f"disagg ttft p99 {disagg_p99:.1f}ms vs colocated "
+            f"{colo_p99:.1f}ms ({improvement:+.0%}), prefix hit ratio "
+            f"{hit_ratio:.2f}, spec acceptance "
+            + (
+                f"{speculation['acceptance']:.2f}"
+                if speculation["acceptance"] is not None
+                else "n/a"
+            )
+            + f", consistency {'OK' if consistent else 'MISMATCH'} "
+            f"(rel diff {max_rel_diff:.1e}), boundary "
+            f"{'conserved' if migration['ok'] else 'LEAKED'}"
+        ),
+        metrics=metrics,
+        details={
+            "n_requests": n_requests,
+            "block_size": block_size,
+            "rate_rps": rate_rps,
+            "tokens_generated": soak.tokens_generated,
+            "decode_steps": soak.decode_steps,
+            "spec_rounds": soak.spec_rounds,
+            "max_rel_logit_diff": max_rel_diff,
+            "checked_sequences": len(soak.logit_trace),
+            "conservation": conservation,
+            "migration_ledger": migration,
+            "speculation": speculation,
+            "prefix_ledger": prefix_ledger,
+            "refusals": dict(soak.scheduler.refusals),
+            "kv_refusals": kv_refusals,
+            "pool_stats": pool_stats,
+            "serving_disagg": serving_disagg,
+        },
+        timings=timings,
+    )
+    # per-pool roofline verdicts against each pool's OWN ceiling:
+    # prefill is compute-shaped (2*P FLOPs per prompt token, params
+    # read once per prefill), decode is memory-shaped (params plus
+    # banked KV streamed per step) — the disaggregation thesis stated
+    # as two captures instead of one blended number
+    from activemonitor_tpu.obs import roofline as roofline_model
+
+    n_prefills = max(1, len(soak.ttft_ms))
+    param_bytes = param_count(cfg) * jnp.dtype(cfg.dtype).itemsize
+    mean_prefill_tokens = soak.prefill_tokens / n_prefills
+    roofline_model.apply(
+        result,
+        roofline_model.capture(
+            "serving-prefill",
+            seconds=soak.prefill_busy / n_prefills,
+            model_flops=2.0 * param_count(cfg) * max(1.0, mean_prefill_tokens),
+            model_bytes=float(param_bytes),
+            enabled=roofline,
+        ),
+    )
+    mean_banked = (
+        sum(soak.banked_samples) / len(soak.banked_samples)
+        if soak.banked_samples
+        else 0.0
+    )
+    dec_steps = max(1, soak.decode_steps)
+    mean_width = (
+        len(soak.intertoken_ms) / dec_steps if soak.intertoken_ms else 1.0
+    )
+    roofline_model.apply(
+        result,
+        roofline_model.capture(
+            "serving-decode",
+            seconds=soak.decode_busy / dec_steps,
+            model_flops=2.0 * param_count(cfg) * max(1.0, mean_width),
+            model_bytes=float(
+                param_bytes + mean_banked * kv_bytes_per_token(cfg)
+            ),
             enabled=roofline,
         ),
     )

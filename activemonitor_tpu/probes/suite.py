@@ -202,6 +202,18 @@ def run(
             roofline=roofline,
         ),
     )
+    # the disaggregated pools ride next to the colocated soak: same
+    # scripted cost model both sides, so the TTFT comparison is the
+    # topology and the ledgers (pool boundary, prefix, speculation)
+    # gate either way
+    add(
+        "serving-disagg",
+        lambda: serving_probe.run_disagg(
+            tiny=quick,
+            n_requests=8 if quick else 12,
+            roofline=roofline,
+        ),
+    )
     from activemonitor_tpu.probes import straggler, transfer
 
     add(

@@ -25,7 +25,8 @@ other clock-disciplined modules).
 from __future__ import annotations
 
 import random
-from typing import Sequence, TypeVar
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple, TypeVar
 
 T = TypeVar("T")
 
@@ -56,3 +57,108 @@ class PoissonArrivals:
         """One payload draw from the shared rng (draw-order is part of
         the determinism contract — see module docstring)."""
         return self.rng.choice(tuple(seq))
+
+
+@dataclass(frozen=True)
+class MixedArrival:
+    """One arrival of the tenant/prefix-mix trace: an explicit token
+    prompt (shared hot prefix or cold unique), ready for either the
+    serving scheduler (``scheduler.serving.mixed_open_loop_requests``
+    wraps it into a ``Request``) or front-door traffic shaping."""
+
+    rid: int
+    tenant: str
+    arrival: float  # seconds since schedule start
+    prompt_tokens: Tuple[int, ...]
+    output_tokens: int
+    hot: bool  # prompt starts with the shared system-prompt prefix
+
+
+class TenantPrefixMix:
+    """Seeded tenant/prefix-mix trace generator — the disaggregated
+    serving workload's shape (ISSUE 20), shared by the serving probe
+    and front-door traffic so "hot shared prefix" means ONE thing.
+
+    A fraction of arrivals (``hot_fraction``) open with the same
+    system-prompt token prefix across every tenant — the traffic the
+    content-addressed prefix cache (ops/kv_cache.PrefixCache) banks
+    once — and the rest carry unique cold prompts. Total prompt
+    lengths stay inside the bounded ``prompt_len_choices`` set (the
+    same bounded-compiles contract as :func:`scheduler.serving.
+    open_loop_requests`), so hot and cold requests share shapes.
+
+    Determinism is the module's one-rng contract, with this generator's
+    OWN pinned draw order per arrival: expovariate inter-arrival,
+    tenant, hot-coin (``random()``), prompt length, output length, then
+    one ``randrange`` per non-prefix prompt token. The shared prefix
+    itself is drawn once at construction from the same rng, BEFORE any
+    arrivals. :class:`PoissonArrivals` is untouched — the existing
+    serving/front-door schedules stay byte-identical per seed.
+    """
+
+    def __init__(
+        self,
+        rate_per_s: float,
+        seed: int,
+        *,
+        tenants: Sequence[str] = ("tenant-a", "tenant-b"),
+        prefix_len: int = 8,
+        hot_fraction: float = 0.6,
+        prompt_len_choices: Sequence[int] = (12, 16),
+        output_choices: Sequence[int] = (2, 3, 5),
+        vocab: int = 256,
+    ):
+        if prefix_len < 1 or vocab < 2 or not tenants:
+            raise ValueError(
+                f"need prefix_len >= 1, vocab >= 2 and tenants, got "
+                f"{prefix_len}/{vocab}/{len(tuple(tenants))}"
+            )
+        if min(prompt_len_choices) <= prefix_len:
+            raise ValueError(
+                f"every prompt_len choice must exceed prefix_len="
+                f"{prefix_len} (a hot prompt is prefix + unique tail), "
+                f"got {tuple(prompt_len_choices)}"
+            )
+        if not 0.0 <= hot_fraction <= 1.0:
+            raise ValueError(f"hot_fraction must be in [0,1], got {hot_fraction}")
+        self.process = PoissonArrivals(rate_per_s, seed)
+        self.tenants = tuple(tenants)
+        self.hot_fraction = float(hot_fraction)
+        self.prompt_len_choices = tuple(prompt_len_choices)
+        self.output_choices = tuple(output_choices)
+        self.vocab = int(vocab)
+        rng = self.process.rng
+        self.prefix: Tuple[int, ...] = tuple(
+            rng.randrange(self.vocab) for _ in range(prefix_len)
+        )
+        self._next_rid = 0
+
+    def generate(self, n_arrivals: int) -> List[MixedArrival]:
+        """The next ``n_arrivals`` of the trace (resumable: a second
+        call continues the same schedule)."""
+        if n_arrivals < 1:
+            raise ValueError(f"need n_arrivals >= 1, got {n_arrivals}")
+        rng = self.process.rng
+        out: List[MixedArrival] = []
+        start = self._next_rid
+        self._next_rid += n_arrivals
+        for i in range(n_arrivals):
+            now = self.process.next()
+            tenant = self.process.choice(self.tenants)
+            hot = rng.random() < self.hot_fraction
+            plen = self.process.choice(self.prompt_len_choices)
+            output = self.process.choice(self.output_choices)
+            tail_len = plen - len(self.prefix) if hot else plen
+            tail = tuple(rng.randrange(self.vocab) for _ in range(tail_len))
+            tokens = (self.prefix + tail) if hot else tail
+            out.append(
+                MixedArrival(
+                    rid=start + i,
+                    tenant=tenant,
+                    arrival=now,
+                    prompt_tokens=tokens,
+                    output_tokens=output,
+                    hot=hot,
+                )
+            )
+        return out

@@ -1,0 +1,668 @@
+"""Disaggregated serving pools: prefill/decode split with KV handoff.
+
+The pool half of ISSUE 20. PR 14's continuous-batching scheduler
+colocates prefill (compute-bound) and decode (memory-bound) in one
+pool, so each phase steals the other's roofline ceiling
+(obs/roofline.py states both). This module splits them:
+
+- :class:`PoolTopology` names the shape — a prefill pool and a decode
+  pool with their own batch slots and block managers, or the
+  ``colocated`` fallback, which delegates to the PR 14 scheduler
+  verbatim (same object, same draw-for-draw event order), so its
+  conservation ledger and trace are bitwise-identical to today's —
+  the disagg tests pin that equality.
+- :class:`MigrationChannel` prices the KV handoff on the PR 13
+  latency-path collectives' α/B regime (``parallel/schedules.
+  hier_all_reduce_latency``): a banked block table is a SMALL message,
+  so below the crossover the per-hop launch latency α dominates and
+  the wire term is bytes/B on the tier it rides — ICI intra-slice,
+  DCN cross-slice. Every transfer's bytes, hops, tier and modeled
+  seconds are recorded (the probe exports them), and the channel's
+  ledger cross-checks tokens-out against tokens-in exactly.
+- :class:`DisaggregatedScheduler` runs the lifecycle: admit into the
+  prefill pool (FIFO, full prompt reservation, structured refusals —
+  the PR 14 posture), prefill produces the first token (TTFT lives in
+  the prefill pool), then the sequence *hands off*: the decode pool
+  reserves prompt+output capacity, the channel prices the block-table
+  transfer, the prefill side releases its blocks (and its prefix-cache
+  refs), and the sequence decodes to completion in the decode pool.
+  A decode pool with no room backpressures the handoff queue FIFO —
+  the sequence keeps its prefill slot, so prefill stalls honestly
+  instead of leaking.
+
+Prefix caching (ops/kv_cache.PrefixCache) plugs into the prefill pool
+only — that is where prompts bank; the decode side is private by
+construction (the handoff copy private-izes shared blocks).
+Speculative decoding plugs into the decode pool:
+:meth:`DisaggregatedScheduler.record_speculative_step` books a
+draft/verify round's emitted tokens and the draft acceptance ledger
+the probe exports as a rated-fraction metric.
+
+Pure policy, like the module it extends: no jax, no wall clock
+(hack/lint.py bans clock calls here) — every timestamp arrives as an
+argument, and the channel's seconds are MODEL outputs, not sleeps.
+
+Token-exact conservation across the pool boundary is the contract:
+``admitted == completed + in_flight`` (sequences and tokens, per
+tenant — same schema as the colocated ledger) AND
+``handed_off_tokens == received_tokens`` with per-transfer receipts
+(:meth:`DisaggregatedScheduler.migration_ledger`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Sequence
+
+from activemonitor_tpu.ops.kv_cache import KVBlockManager, PrefixCache
+from activemonitor_tpu.scheduler.serving import (
+    ContinuousBatchingScheduler,
+    Request,
+    SequenceState,
+)
+
+
+@dataclass(frozen=True)
+class MigrationModel:
+    """The α/B transfer-cost model for one KV handoff, mirroring the
+    latency-path collectives' regime (parallel/schedules.py): seconds =
+    hops·α + bytes/B. Defaults are the v5e rated figures
+    (probes/rated.py: 45 GB/s unidirectional ICI per link, 25 GB/s
+    DCN per host) with one ICI hop intra-slice and two DCN hops
+    (host→spine→host) cross-slice."""
+
+    alpha_s: float = 2e-6  # per-hop launch latency (the LL-regime α)
+    ici_gbps: float = 45.0
+    dcn_gbps: float = 25.0
+    ici_hops: int = 1
+    dcn_hops: int = 2
+
+    @classmethod
+    def from_rated(cls, spec) -> "MigrationModel":
+        """Price the channel off a probes/rated.py RatedSpec (its
+        ``ici_unidir_gbps`` / ``dcn_gbps`` columns)."""
+        return cls(
+            ici_gbps=float(spec.ici_unidir_gbps),
+            dcn_gbps=float(spec.dcn_gbps) or cls.dcn_gbps,
+        )
+
+
+class MigrationChannel:
+    """The priced pipe between the pools, with a per-transfer receipt
+    ledger. ``cross_slice`` picks the tier: pools on one slice hand
+    off over ICI; pools on different slices ride DCN."""
+
+    def __init__(
+        self,
+        model: Optional[MigrationModel] = None,
+        cross_slice: bool = False,
+    ):
+        self.model = model or MigrationModel()
+        self.cross_slice = bool(cross_slice)
+        self.transfers: List[dict] = []
+        self.tokens_total = 0
+        self.bytes_total = 0.0
+        self.seconds_total = 0.0
+
+    def transfer(self, rid: int, n_tokens: int, bytes_per_token: float) -> dict:
+        """Price one handoff and book its receipt: tier, hops, bytes,
+        modeled seconds. The policy layer never sleeps — the engine
+        charges the seconds on its virtual clock."""
+        tier = "dcn" if self.cross_slice else "ici"
+        hops = self.model.dcn_hops if self.cross_slice else self.model.ici_hops
+        gbps = self.model.dcn_gbps if self.cross_slice else self.model.ici_gbps
+        n_bytes = float(n_tokens) * float(bytes_per_token)
+        seconds = hops * self.model.alpha_s + n_bytes / max(gbps * 1e9, 1e-9)
+        record = {
+            "rid": rid,
+            "tokens": int(n_tokens),
+            "bytes": n_bytes,
+            "tier": tier,
+            "hops": hops,
+            "seconds": seconds,
+        }
+        self.transfers.append(record)
+        self.tokens_total += int(n_tokens)
+        self.bytes_total += n_bytes
+        self.seconds_total += seconds
+        return record
+
+    def ledger(self) -> dict:
+        by_tier: Dict[str, Dict[str, float]] = {}
+        for rec in self.transfers:
+            row = by_tier.setdefault(
+                rec["tier"], {"transfers": 0, "bytes": 0.0, "hops": 0}
+            )
+            row["transfers"] += 1
+            row["bytes"] += rec["bytes"]
+            row["hops"] += rec["hops"]
+        return {
+            "transfers": len(self.transfers),
+            "tokens_total": self.tokens_total,
+            "bytes_total": self.bytes_total,
+            "seconds_total": self.seconds_total,
+            "by_tier": by_tier,
+        }
+
+
+@dataclass(frozen=True)
+class PoolTopology:
+    """The serving pool shape. ``colocated`` is PR 14's single pool
+    (``decode_slots`` is its batch ceiling; ``prefill_slots`` unused);
+    ``disaggregated`` gives each phase its own slots, block budget and
+    roofline regime."""
+
+    mode: str = "colocated"  # "colocated" | "disaggregated"
+    prefill_slots: int = 0
+    decode_slots: int = 4
+    cross_slice: bool = False
+
+    def __post_init__(self):
+        if self.mode not in ("colocated", "disaggregated"):
+            raise ValueError(f"unknown pool mode {self.mode!r}")
+        if self.decode_slots < 1:
+            raise ValueError(f"decode_slots must be >= 1, got {self.decode_slots}")
+        if self.mode == "disaggregated" and self.prefill_slots < 1:
+            raise ValueError(
+                f"disaggregated needs prefill_slots >= 1, got "
+                f"{self.prefill_slots}"
+            )
+
+    @classmethod
+    def colocated(cls, max_batch: int) -> "PoolTopology":
+        return cls(mode="colocated", decode_slots=max_batch)
+
+    @classmethod
+    def disaggregated(
+        cls,
+        prefill_slots: int,
+        decode_slots: int,
+        cross_slice: bool = False,
+    ) -> "PoolTopology":
+        return cls(
+            mode="disaggregated",
+            prefill_slots=prefill_slots,
+            decode_slots=decode_slots,
+            cross_slice=cross_slice,
+        )
+
+    @property
+    def disagg(self) -> bool:
+        return self.mode == "disaggregated"
+
+
+class DisaggregatedScheduler:
+    """Pool-aware admission/handoff/retirement policy.
+
+    Colocated mode IS the PR 14 scheduler — one inner
+    :class:`ContinuousBatchingScheduler` every call delegates to, so
+    ledger and trace are bitwise what today's scheduler produces.
+    Disaggregated mode runs the split lifecycle::
+
+        sched.pump_migrations(now)      # drain the handoff queue
+        for seq in sched.admit(now):    # prefill-pool admissions
+            ... prefill (remainder only on a prefix hit) ...
+            sched.record_first_token(seq, token, now)
+        records = sched.pump_migrations(now)  # newly priced handoffs
+        ... copy blocks per record (ops/kv_cache.migrate_blocks) ...
+        batch = sched.decode_batch(now)
+        ... one paged decode step (or a draft/verify round) ...
+        sched.record_decode_step(tokens_by_slot, now)
+    """
+
+    def __init__(
+        self,
+        requests: Sequence[Request],
+        topology: PoolTopology,
+        *,
+        manager: Optional[KVBlockManager] = None,
+        prefill_manager: Optional[KVBlockManager] = None,
+        decode_manager: Optional[KVBlockManager] = None,
+        bytes_per_token: float = 0.0,
+        channel: Optional[MigrationChannel] = None,
+        prefix_cache: Optional[PrefixCache] = None,
+    ):
+        self.topology = topology
+        self.bytes_per_token = float(bytes_per_token)
+        self.prefix_cache = prefix_cache
+        self._inner: Optional[ContinuousBatchingScheduler] = None
+        if not topology.disagg:
+            if manager is None:
+                raise ValueError("colocated mode needs `manager`")
+            if prefix_cache is not None:
+                raise ValueError(
+                    "prefix caching rides the prefill pool — use the "
+                    "disaggregated topology"
+                )
+            self._inner = ContinuousBatchingScheduler(
+                requests, manager, topology.decode_slots
+            )
+            self.channel = channel or MigrationChannel(
+                cross_slice=topology.cross_slice
+            )
+            return
+        if prefill_manager is None or decode_manager is None:
+            raise ValueError(
+                "disaggregated mode needs prefill_manager AND decode_manager"
+            )
+        if prefix_cache is not None and prefix_cache.manager is not prefill_manager:
+            raise ValueError("prefix_cache must index the PREFILL pool's manager")
+        self.prefill_manager = prefill_manager
+        self.decode_manager = decode_manager
+        self.channel = channel or MigrationChannel(cross_slice=topology.cross_slice)
+        self.waiting: Deque[Request] = deque(
+            sorted(requests, key=lambda r: (r.arrival, r.rid))
+        )
+        self.prefill_active: Dict[int, SequenceState] = {}  # slot -> state
+        self.decode_active: Dict[int, SequenceState] = {}
+        self.completed: List[SequenceState] = []
+        self._free_prefill_slots: List[int] = list(
+            range(topology.prefill_slots - 1, -1, -1)
+        )
+        self._free_decode_slots: List[int] = list(
+            range(topology.decode_slots - 1, -1, -1)
+        )
+        # sequences whose prefill finished, FIFO-waiting for decode-pool
+        # capacity; they HOLD their prefill slot and blocks until the
+        # handoff lands (honest backpressure, not a leak)
+        self.migrating: Deque[SequenceState] = deque()
+        self._admitted = 0
+        self._tokens_emitted = 0
+        self._tenant_admitted: Dict[str, int] = {}
+        self._tenant_tokens: Dict[str, int] = {}
+        self.refusals: Dict[str, int] = {
+            "batch": 0,
+            "blocks": 0,
+            "migrate_slots": 0,
+            "migrate_blocks": 0,
+        }
+        self.occupancy_samples: List[float] = []  # decode pool
+        self.prefill_occupancy_samples: List[float] = []
+        self.trace: List[tuple] = []
+        # prefix-cache bookkeeping per live sequence
+        self._hit_tokens: Dict[int, int] = {}
+        # pool-boundary token ledger: two independent event-time
+        # accounts the migration_ledger cross-checks
+        self._handed_off_tokens = 0
+        self._received_tokens = 0
+        self._ready_at: Dict[int, float] = {}  # rid -> handoff completes
+        # speculative-decoding acceptance ledger (decode pool)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        if self._inner is not None:
+            return self._inner.done
+        return not self.waiting and not self.prefill_active and not self.decode_active
+
+    def next_arrival(self) -> Optional[float]:
+        if self._inner is not None:
+            return self._inner.next_arrival()
+        return self.waiting[0].arrival if self.waiting else None
+
+    def decode_batch(self, now: Optional[float] = None) -> List[SequenceState]:
+        """Decode-pool sequences owing output whose handoff has landed
+        (``ready_at <= now``; ``now=None`` skips the readiness filter),
+        in slot order."""
+        if self._inner is not None:
+            return self._inner.decode_batch()
+        out = []
+        for slot in sorted(self.decode_active):
+            seq = self.decode_active[slot]
+            if seq.generated >= seq.req.output_tokens:
+                continue
+            if now is not None and self._ready_at.get(seq.req.rid, 0.0) > now:
+                continue
+            out.append(seq)
+        return out
+
+    def effective_table(self, rid: int) -> List[int]:
+        """The prefill pool's full block table for ``rid``: shared
+        prefix-cache blocks (acquisition order) then the private tail
+        — what prefill compute gathers through and what the handoff
+        copies from."""
+        if self._inner is not None:
+            return self._inner.manager.table(rid)
+        shared = self.prefix_cache.held_blocks(rid) if self.prefix_cache else []
+        return shared + self.prefill_manager.table(rid)
+
+    def hit_tokens(self, rid: int) -> int:
+        """Prompt tokens ``rid`` did NOT have to prefill (prefix-cache
+        hits at admission)."""
+        return self._hit_tokens.get(rid, 0)
+
+    # -- delegation plumbing (colocated = PR 14 verbatim) ----------------
+    def __getattr__(self, name: str):
+        inner = self.__dict__.get("_inner")
+        if inner is not None:
+            return getattr(inner, name)
+        raise AttributeError(name)
+
+    # -- the step protocol ----------------------------------------------
+    def admit(self, now: float) -> List[SequenceState]:
+        """FIFO admission into the prefill pool: a slot plus a private
+        reservation for the NON-CACHED prompt remainder (the prefill
+        pool never decodes, so it reserves prompt capacity only; the
+        decode pool reserves prompt+output at handoff). A blocked head
+        stops admission — no skip-ahead — after one prefix-cache
+        eviction attempt at refcount zero."""
+        if self._inner is not None:
+            return self._inner.admit(now)
+        admitted: List[SequenceState] = []
+        while self.waiting and self.waiting[0].arrival <= now:
+            req = self.waiting[0]
+            if not self._free_prefill_slots:
+                self.refusals["batch"] += 1
+                self.trace.append(("defer-batch", req.rid, now))
+                break
+            hit = 0
+            if self.prefix_cache is not None and req.prompt_tokens is not None:
+                _, hit = self.prefix_cache.lookup(req.prompt_tokens)
+            need_tokens = req.prompt_len - hit
+            need_blocks = self.prefill_manager.blocks_for(need_tokens)
+            if need_blocks > self.prefill_manager.free_blocks:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.evict(
+                        need_blocks - self.prefill_manager.free_blocks
+                    )
+            blocks = self.prefill_manager.allocate(req.rid, need_tokens)
+            if blocks is None:
+                self.refusals["blocks"] += 1
+                self.trace.append(("defer-blocks", req.rid, now))
+                break
+            if self.prefix_cache is not None and req.prompt_tokens is not None:
+                _, hit = self.prefix_cache.acquire(
+                    req.rid, req.tenant, req.prompt_tokens
+                )
+            self.waiting.popleft()
+            self._hit_tokens[req.rid] = hit
+            self.prefill_manager.append(req.rid, need_tokens)  # prompt banked
+            seq = SequenceState(
+                req=req, slot=self._free_prefill_slots.pop(), admitted_at=now
+            )
+            self.prefill_active[seq.slot] = seq
+            self._admitted += 1
+            self._tenant_admitted[req.tenant] = (
+                self._tenant_admitted.get(req.tenant, 0) + 1
+            )
+            self.trace.append(("admit", req.rid, now))
+            admitted.append(seq)
+        return admitted
+
+    def record_first_token(self, seq: SequenceState, token: int, now: float) -> None:
+        """Prefill produced the first token (TTFT, in the prefill
+        pool). Newly banked full blocks publish into the prefix cache;
+        then the sequence either retires here (1-token requests never
+        touch the decode pool) or queues for handoff."""
+        if self._inner is not None:
+            return self._inner.record_first_token(seq, token, now)
+        seq.generated = 1
+        seq.first_token_at = now
+        seq.tokens.append(token)
+        self._emit_token(seq)
+        self.trace.append(("first-token", seq.req.rid, now))
+        if self.prefix_cache is not None and seq.req.prompt_tokens is not None:
+            self.prefix_cache.publish(
+                seq.req.rid, seq.req.tenant, seq.req.prompt_tokens
+            )
+        if seq.generated >= seq.req.output_tokens:
+            self._retire_from_prefill(seq, now)
+            return
+        self.migrating.append(seq)
+
+    def pump_migrations(self, now: float) -> List[dict]:
+        """Drain the handoff queue FIFO while the decode pool can take
+        the head: reserve prompt+output there, price the transfer on
+        the channel, release the prefill side. Returns the transfer
+        receipts (with source/destination tables) so the engine can
+        move the actual K/V and charge the modeled seconds."""
+        if self._inner is not None:
+            return []
+        records: List[dict] = []
+        while self.migrating:
+            seq = self.migrating[0]
+            rid = seq.req.rid
+            if not self._free_decode_slots:
+                self.refusals["migrate_slots"] += 1
+                self.trace.append(("defer-migrate", rid, now))
+                break
+            capacity = seq.req.prompt_len + seq.req.output_tokens
+            dst_blocks = self.decode_manager.allocate(rid, capacity)
+            if dst_blocks is None:
+                self.refusals["migrate_blocks"] += 1
+                self.trace.append(("defer-migrate", rid, now))
+                break
+            self.migrating.popleft()
+            src_blocks = self.effective_table(rid)
+            record = self.channel.transfer(
+                rid, seq.req.prompt_len, self.bytes_per_token
+            )
+            self._handed_off_tokens += seq.req.prompt_len
+            self.decode_manager.append(rid, seq.req.prompt_len)
+            self._received_tokens += self.decode_manager.length(rid)
+            # source side releases: cache refs first (shared blocks stay
+            # cached at refcount-1), then the private tail
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(rid)
+            self.prefill_manager.free(rid)
+            del self.prefill_active[seq.slot]
+            self._free_prefill_slots.append(seq.slot)
+            self._hit_tokens.pop(rid, None)
+            seq.slot = self._free_decode_slots.pop()
+            self.decode_active[seq.slot] = seq
+            record["src_blocks"] = src_blocks
+            record["dst_blocks"] = dst_blocks
+            record["ready_at"] = now + record["seconds"]
+            self._ready_at[rid] = record["ready_at"]
+            self.trace.append(("migrate", rid, now))
+            records.append(record)
+        return records
+
+    def record_decode_step(
+        self, tokens_by_slot: Dict[int, int], now: float
+    ) -> List[SequenceState]:
+        """One shared decode-pool step (same contract as PR 14's):
+        each participating sequence banks its fed token's K/V and
+        gains one generated token; finished sequences retire."""
+        if self._inner is not None:
+            return self._inner.record_decode_step(tokens_by_slot, now)
+        finished: List[SequenceState] = []
+        stepped = 0
+        for slot, token in sorted(tokens_by_slot.items()):
+            seq = self.decode_active.get(slot)
+            if seq is None:
+                continue
+            self.decode_manager.append(seq.req.rid, 1)
+            seq.generated += 1
+            if seq.generated == 2 and seq.first_decode_at is None:
+                seq.first_decode_at = now
+            seq.tokens.append(token)
+            self._emit_token(seq)
+            stepped += 1
+            if seq.generated >= seq.req.output_tokens:
+                self._retire_from_decode(seq, now)
+                finished.append(seq)
+        self.occupancy_samples.append(stepped / self.topology.decode_slots)
+        return finished
+
+    def record_speculative_step(
+        self,
+        tokens_by_slot: Dict[int, List[int]],
+        drafted_by_slot: Dict[int, int],
+        accepted_by_slot: Dict[int, int],
+        now: float,
+    ) -> List[SequenceState]:
+        """One draft/verify round on the decode pool: per slot, the
+        verify pass confirmed ``tokens_by_slot[slot]`` (one target
+        argmax per verify position — identical to what plain greedy
+        decode would have emitted, so the consistency gate holds), of
+        which ``accepted`` of ``drafted`` draft proposals matched.
+        Books every confirmed token (K/V banks per token, same as a
+        decode step) and the acceptance ledger."""
+        if self._inner is not None:
+            raise ValueError("speculative decoding needs the disaggregated pools")
+        finished: List[SequenceState] = []
+        stepped = 0
+        for slot, tokens in sorted(tokens_by_slot.items()):
+            seq = self.decode_active.get(slot)
+            if seq is None or not tokens:
+                continue
+            drafted = int(drafted_by_slot.get(slot, 0))
+            accepted = int(accepted_by_slot.get(slot, 0))
+            if not 0 <= accepted <= drafted:
+                raise ValueError(
+                    f"slot {slot}: accepted {accepted} outside [0, {drafted}]"
+                )
+            self._spec_drafted += drafted
+            self._spec_accepted += accepted
+            for token in tokens:
+                self.decode_manager.append(seq.req.rid, 1)
+                seq.generated += 1
+                if seq.generated == 2 and seq.first_decode_at is None:
+                    seq.first_decode_at = now
+                seq.tokens.append(token)
+                self._emit_token(seq)
+            stepped += 1
+            self.trace.append(("spec", seq.req.rid, now))
+            if seq.generated >= seq.req.output_tokens:
+                self._retire_from_decode(seq, now)
+                finished.append(seq)
+        self.occupancy_samples.append(stepped / self.topology.decode_slots)
+        return finished
+
+    def sample_prefill_occupancy(self) -> None:
+        self.prefill_occupancy_samples.append(
+            len(self.prefill_active) / max(1, self.topology.prefill_slots)
+        )
+
+    # -- internals -------------------------------------------------------
+    def _emit_token(self, seq: SequenceState) -> None:
+        self._tokens_emitted += 1
+        self._tenant_tokens[seq.req.tenant] = (
+            self._tenant_tokens.get(seq.req.tenant, 0) + 1
+        )
+
+    def _retire_from_prefill(self, seq: SequenceState, now: float) -> None:
+        seq.finished_at = now
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(seq.req.rid)
+        self.prefill_manager.free(seq.req.rid)
+        del self.prefill_active[seq.slot]
+        self._free_prefill_slots.append(seq.slot)
+        self._hit_tokens.pop(seq.req.rid, None)
+        self.completed.append(seq)
+        self.trace.append(("retire", seq.req.rid, now))
+
+    def _retire_from_decode(self, seq: SequenceState, now: float) -> None:
+        seq.finished_at = now
+        self.decode_manager.free(seq.req.rid)
+        del self.decode_active[seq.slot]
+        self._free_decode_slots.append(seq.slot)
+        self._ready_at.pop(seq.req.rid, None)
+        self.completed.append(seq)
+        self.trace.append(("retire", seq.req.rid, now))
+
+    # -- accounting ------------------------------------------------------
+    def conservation(self) -> dict:
+        """The PR 14 ledger schema over the split pools: in colocated
+        mode this IS the inner scheduler's dict (bitwise — the
+        fallback test pins it); disaggregated, in-flight spans both
+        pools (handoff-queued sequences still hold their prefill
+        slot, so nothing double-counts and nothing vanishes
+        mid-boundary)."""
+        if self._inner is not None:
+            return self._inner.conservation()
+        in_flight = list(self.prefill_active.values()) + list(
+            self.decode_active.values()
+        )
+        tokens_completed = sum(s.generated for s in self.completed)
+        tokens_in_flight = sum(s.generated for s in in_flight)
+        tenants: Dict[str, Dict[str, int]] = {}
+        for seq, bucket in [(s, "completed") for s in self.completed] + [
+            (s, "in_flight") for s in in_flight
+        ]:
+            row = tenants.setdefault(
+                seq.req.tenant, {"completed": 0, "in_flight": 0, "tokens": 0}
+            )
+            row[bucket] += 1
+            row["tokens"] += seq.generated
+        tenants_ok = True
+        for tenant in set(tenants) | set(self._tenant_admitted) | set(
+            self._tenant_tokens
+        ):
+            row = tenants.setdefault(
+                tenant, {"completed": 0, "in_flight": 0, "tokens": 0}
+            )
+            row["admitted"] = self._tenant_admitted.get(tenant, 0)
+            row["tokens_emitted"] = self._tenant_tokens.get(tenant, 0)
+            tenants_ok = tenants_ok and (
+                row["admitted"] == row["completed"] + row["in_flight"]
+                and row["tokens_emitted"] == row["tokens"]
+            )
+        return {
+            "admitted": self._admitted,
+            "completed": len(self.completed),
+            "in_flight": len(in_flight),
+            "tokens_emitted": self._tokens_emitted,
+            "tokens_completed": tokens_completed,
+            "tokens_in_flight": tokens_in_flight,
+            "tenants": tenants,
+            "ok": (
+                tenants_ok
+                and self._admitted == len(self.completed) + len(in_flight)
+                and self._tokens_emitted == tokens_completed + tokens_in_flight
+            ),
+        }
+
+    def migration_ledger(self) -> dict:
+        """The pool-boundary receipt: tokens handed off (prefill side,
+        booked at transfer pricing) must equal tokens received (decode
+        side, booked from the decode manager's banked length after the
+        arrival append) must equal the channel's per-transfer sum —
+        three independent accounts, exact to the token."""
+        channel = self.channel.ledger()
+        if self._inner is not None:
+            return {**channel, "handed_off_tokens": 0, "received_tokens": 0, "ok": True}
+        ok = (
+            self._handed_off_tokens
+            == self._received_tokens
+            == channel["tokens_total"]
+        )
+        return {
+            **channel,
+            "handed_off_tokens": self._handed_off_tokens,
+            "received_tokens": self._received_tokens,
+            "ok": ok,
+        }
+
+    def speculation(self) -> dict:
+        """The draft acceptance ledger: ``acceptance`` is the
+        rated-fraction the probe exports (None before any draft ran —
+        absence, not a fake 0.0 that would floor as degraded)."""
+        drafted, accepted = self._spec_drafted, self._spec_accepted
+        return {
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance": (accepted / drafted) if drafted else None,
+            "ok": 0 <= accepted <= drafted,
+        }
+
+    def pool_stats(self) -> dict:
+        if self._inner is not None:
+            return {
+                "mode": "colocated",
+                "manager": self._inner.manager.stats(),
+            }
+        return {
+            "mode": "disaggregated",
+            "cross_slice": self.topology.cross_slice,
+            "prefill": self.prefill_manager.stats(),
+            "decode": self.decode_manager.stats(),
+            "prefix_cache": (
+                self.prefix_cache.stats() if self.prefix_cache else None
+            ),
+            "migrating": len(self.migrating),
+        }

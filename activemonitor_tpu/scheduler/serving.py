@@ -55,6 +55,11 @@ class Request:
     arrival: float  # seconds since soak start
     prompt_len: int
     output_tokens: int  # generated tokens wanted (>= 1; #1 from prefill)
+    # explicit prompt token ids (the tenant/prefix-mix generator sets
+    # them so the prefix cache can content-address the prompt); None
+    # keeps the classic generator's contract — the engine draws a
+    # seeded random prompt per rid, byte-identical to before
+    prompt_tokens: Optional[Tuple[int, ...]] = None
 
 
 def open_loop_requests(
@@ -99,6 +104,52 @@ def open_loop_requests(
             )
         )
     return out
+
+
+def mixed_open_loop_requests(
+    n_requests: int,
+    rate_rps: float,
+    seed: int,
+    *,
+    tenants: Sequence[str] = ("tenant-a", "tenant-b"),
+    prefix_len: int = 8,
+    hot_fraction: float = 0.6,
+    prompt_len_choices: Sequence[int] = (12, 16),
+    output_choices: Sequence[int] = (2, 3, 5),
+    vocab: int = 256,
+) -> List[Request]:
+    """The tenant/prefix-mix workload as serving ``Request``s: seeded
+    Poisson arrivals where ``hot_fraction`` of prompts open with one
+    shared system-prompt prefix across every tenant (the traffic the
+    content-addressed prefix cache banks once) and the rest are cold
+    unique prompts. A thin wrapper over :class:`~activemonitor_tpu.
+    scheduler.arrivals.TenantPrefixMix` — the SAME generator the front
+    door can shape traffic with — leaving :func:`open_loop_requests`'s
+    draw order untouched, so existing seeded traces stay
+    byte-identical."""
+    from activemonitor_tpu.scheduler.arrivals import TenantPrefixMix
+
+    mix = TenantPrefixMix(
+        rate_rps,
+        seed,
+        tenants=tenants,
+        prefix_len=prefix_len,
+        hot_fraction=hot_fraction,
+        prompt_len_choices=prompt_len_choices,
+        output_choices=output_choices,
+        vocab=vocab,
+    )
+    return [
+        Request(
+            rid=a.rid,
+            tenant=a.tenant,
+            arrival=a.arrival,
+            prompt_len=len(a.prompt_tokens),
+            output_tokens=a.output_tokens,
+            prompt_tokens=a.prompt_tokens,
+        )
+        for a in mix.generate(n_requests)
+    ]
 
 
 @dataclass

@@ -658,6 +658,7 @@ def _measure(want_cpu: bool, fallback: bool = False, fallback_reason: str = "") 
     _stamp_roofline(doc, primary_result)
     _stamp_matrix(doc)
     _stamp_serving(doc)
+    _stamp_serving_disagg(doc)
     return doc
 
 
@@ -983,6 +984,41 @@ def _stamp_serving(doc: dict) -> None:
         doc["serving_summary"] = summary
     except Exception as exc:  # pragma: no cover - defensive
         print(f"serving stamp failed: {exc!r}", file=sys.stderr)
+
+
+def _stamp_serving_disagg(doc: dict) -> None:
+    """Stamp the disaggregated-serving probe's round evidence
+    (probes/serving.run_disagg) into the artifact as ``serving_disagg``
+    — the colocated-vs-split TTFT comparison under one scripted cost
+    model, the pool-boundary migration ledger, the per-tenant prefix
+    ledger, and the speculative acceptance fraction. BOTH paths stamp
+    it: CPU-fallback rounds are ``interpret_mode: true`` (tiny model,
+    ``cost_source: scripted`` — a policy/ledger artifact, never read
+    against a TPU bar) and carry the round's ``fallback_reason`` like
+    every other evidence block. Guarded: a failing soak costs this
+    block, not the artifact. ``ACTIVEMONITOR_BENCH_SERVING_DISAGG=off``
+    disables."""
+    if os.environ.get("ACTIVEMONITOR_BENCH_SERVING_DISAGG", "") == "off":
+        return
+    try:
+        from activemonitor_tpu.probes import serving as serving_probe
+
+        on_tpu = doc.get("platform") == "tpu"
+        result = serving_probe.run_disagg(
+            tiny=not on_tpu,
+            n_requests=16 if on_tpu else 10,
+        )
+        block = dict(result.details["serving_disagg"])
+        block["ttft_improvement"] = round(block["ttft_improvement"], 4)
+        block["interpret_mode"] = not on_tpu
+        block["ok"] = result.ok
+        block["conservation"] = result.details["conservation"]
+        block["prefix_ledger"] = result.details["prefix_ledger"]
+        if doc.get("fallback"):
+            block["fallback_reason"] = doc.get("fallback_reason", "")
+        doc["serving_disagg"] = block
+    except Exception as exc:  # pragma: no cover - defensive
+        print(f"serving disagg stamp failed: {exc!r}", file=sys.stderr)
 
 
 def _stamp_attribution(doc: dict) -> None:

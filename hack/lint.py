@@ -192,6 +192,8 @@ class Checker(ast.NodeVisitor):
             # soak runs on an injectable timer / scripted StepCosts
             "kv_cache.py",  # pure allocation arithmetic — no time at all
             "arrivals.py",  # seeded schedules on the caller's timeline
+            "pools.py",  # pool policy + priced migration: timestamps
+            # are args, channel seconds are alpha/B MODEL outputs
             "journal.py",  # event timestamps + lag on the injected Clock
             "replay.py",  # recorded timelines + FakeClock drive harness
             "criticalpath.py",  # pure waterfall math over span monotonics

@@ -197,7 +197,8 @@ def test_probe_suite_quick(capsys):
         skip=[
             "matmul", "hbm", "ici-allreduce", "collectives", "ring-attention",
             "flash-attention", "training-step", "decode", "serving",
-            "dcn-allreduce", "straggler", "transfer", "checkpoint",
+            "serving-disagg", "dcn-allreduce", "straggler", "transfer",
+            "checkpoint",
         ],
     )
     assert result.ok

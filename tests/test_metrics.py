@@ -269,6 +269,13 @@ PINNED_FAMILIES = {
     "healthcheck_federation_refusals_total": "counter",
     "healthcheck_federation_routes_total": "counter",
     "healthcheck_federation_goodput_ratio": "gauge",
+    # disaggregated-serving families (ISSUE 20: prefill/decode pool
+    # split, prefix caching, speculative decoding — docs/serving.md
+    # "Disaggregated serving")
+    "healthcheck_serving_prefix_cache_events_total": "counter",
+    "healthcheck_serving_kv_migration_bytes_total": "counter",
+    "healthcheck_serving_spec_accept_fraction": "gauge",
+    "healthcheck_serving_pool_ttft_seconds": "gauge",
     # sharding families (ISSUE 6: sharded controller fleet —
     # docs/operations.md "Sharded controller fleet")
     "healthcheck_shard_owned": "gauge",
@@ -375,7 +382,16 @@ def exercise_every_family(collector):
         '{"bound": "compute", "intensity": 2048.0, "fraction": 0.9, '
         '"ceiling_flops": 1.97e14, "achieved_flops": 1.77e14, '
         '"ridge": 240.5, "cost_source": "xla", "flops": 1.0e11, '
-        '"hbm_bytes": 5.0e7, "hbm_peak_bytes": 2.0e9}}}'
+        '"hbm_bytes": 5.0e7, "hbm_peak_bytes": 2.0e9}}, '
+        # disaggregated-serving block (ISSUE 20): the probe's
+        # serving_disagg details verbatim — prefix-cache traffic, the
+        # migration channel's per-tier bytes, the acceptance fraction
+        # and both topologies' TTFT p99
+        '"serving_disagg": {"prefix_counters": {"hits": 4, "misses": '
+        '30, "inserted": 23, "evictions": 14}, "migration_by_tier": '
+        '{"ici": {"transfers": 10, "bytes": 69632.0, "hops": 10}}, '
+        '"spec_acceptance": 0.09, "disagg_ttft_p99_ms": 131.9, '
+        '"colocated_ttft_p99_ms": 165.2}}'
     )
     collector.record_custom_metrics(
         "hc-a",
